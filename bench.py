@@ -1,0 +1,115 @@
+"""North-star benchmark: exact cosine kNN on a SIFT-1M-shaped corpus.
+
+Measures the TPU batched matmul + top-k path (BASELINE.md config 1:
+SIFT-1M-like, 128-d, cosine, single shard/chip) against a model of the
+reference's execution: a per-document scripted scoring loop
+(`ScoreScriptUtils.cosineSimilarity` invoked per doc per query from the
+Lucene collector, `QueryPhase.java:171`), emulated here as a per-doc numpy
+dot loop over a subsample and extrapolated. Recall@10 is computed against
+exact f32 search (ours is exact brute force, so recall measures only bf16
+rounding, and must stay >= 0.95 to count — same gate as BASELINE).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import knn as knn_ops
+    from elasticsearch_tpu.ops import similarity as sim
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n = 100_000 if small else 1_000_000
+    d = 128
+    k = 10
+    batch = 128
+    n_batches = 4 if small else 8
+    n_queries = batch * n_batches
+
+    rng = np.random.default_rng(1234)
+    # SIFT-like: clustered data so near-neighbor structure exists
+    centers = rng.standard_normal((256, d)).astype(np.float32) * 2.0
+    assign = rng.integers(0, 256, size=n)
+    vectors = centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
+    q_assign = rng.integers(0, n, size=n_queries)
+    queries = vectors[q_assign] + 0.3 * rng.standard_normal((n_queries, d)).astype(np.float32)
+
+    corpus = knn_ops.build_corpus(vectors, metric=sim.COSINE, dtype="bf16")
+    qdev = jnp.asarray(queries)
+    jax.block_until_ready(corpus)
+
+    def search(qb):
+        return knn_ops.knn_search(qb, corpus, k=k, metric=sim.COSINE, precision="bf16")
+
+    # warmup/compile
+    s, i = search(qdev[:batch])
+    jax.block_until_ready((s, i))
+
+    # timed: per-batch latencies
+    lat = []
+    all_ids = []
+    for b in range(n_batches):
+        qb = qdev[b * batch:(b + 1) * batch]
+        t0 = time.perf_counter()
+        s, ids = search(qb)
+        jax.block_until_ready(ids)
+        lat.append(time.perf_counter() - t0)
+        all_ids.append(np.asarray(ids))
+    total_time = sum(lat)
+    qps = n_queries / total_time
+    p50_ms = float(np.median(lat) * 1000.0)
+
+    # recall@10 of the bf16 path vs exact f32 (one batch)
+    s_ref, ids_ref = knn_ops.knn_search(qdev[:batch], corpus, k=k,
+                                        metric=sim.COSINE, precision="f32")
+    ids_ref = np.asarray(ids_ref)
+    hits = sum(len(set(all_ids[0][r]) & set(ids_ref[r])) for r in range(batch))
+    recall = hits / (batch * k)
+
+    # baseline: per-doc scripted loop emulation (reference's per-doc
+    # CosineSimilarity call), measured on a subsample and scaled to n docs
+    sub = 20_000
+    subv = vectors[:sub]
+    sub_norms = np.linalg.norm(subv, axis=1)
+    q0 = queries[0]
+    q0n = np.linalg.norm(q0)
+    t0 = time.perf_counter()
+    scores = np.empty(sub, dtype=np.float32)
+    for j in range(sub):
+        v = subv[j]
+        scores[j] = float(np.dot(q0, v)) / (q0n * sub_norms[j])
+    np.argpartition(-scores, k)[:k]
+    t_loop = time.perf_counter() - t0
+    baseline_qps = 1.0 / (t_loop * (n / sub))
+
+    out = {
+        "metric": "exact_knn_qps_sift1m_cosine",
+        "value": round(qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(qps / baseline_qps, 1),
+        "recall_at_10": round(recall, 4),
+        "p50_batch_ms": round(p50_ms, 2),
+        "batch_size": batch,
+        "n_docs": n,
+        "dims": d,
+        "baseline_qps_scripted_loop": round(baseline_qps, 4),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(out))
+    if recall < 0.95:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

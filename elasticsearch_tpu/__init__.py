@@ -1,0 +1,25 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A from-scratch re-design of the capability surface of Elasticsearch
+(reference: ywangd/elasticsearch @ v8.0.0-pre) for TPU hardware:
+
+- Host runtime (Python/asyncio + C++ hot paths): REST API, cluster
+  coordination, replication, durability (translog/snapshots).
+- Device programs (JAX/XLA/Pallas): dense_vector kNN as batched
+  matmul + top-k, sharded over a `jax.sharding.Mesh`; cross-shard
+  top-k merge as ICI all-gather; aggregations as device-side partial
+  reductions.
+
+Package layout:
+  common/    settings, xcontent parsing, versioned binary serialization
+  ops/       device kernels: similarity, top-k, kNN, quantization
+  parallel/  mesh management, shard_map-sharded kNN, collective merges
+  vectors/   HBM-resident sharded vector store (delta blocks + compaction)
+  index/     mappings, analysis, inverted index, engine, translog, seqno
+  search/    query DSL, BM25, query-then-fetch phases, aggregations
+  cluster/   cluster state, coordination, routing, allocation
+  transport/ framed async RPC + in-memory test transport
+  rest/      HTTP server + RestController + handlers
+"""
+
+from elasticsearch_tpu.version import __version__  # noqa: F401

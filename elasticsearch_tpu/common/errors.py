@@ -1,0 +1,113 @@
+"""Exception hierarchy.
+
+Parallels the reference's ElasticsearchException tree
+(`server/src/main/java/org/elasticsearch/ElasticsearchException.java`) with the
+subset of status-carrying exceptions the REST layer needs. Each exception maps
+to an HTTP status so RestController can render structured error bodies.
+"""
+
+from __future__ import annotations
+
+
+class SearchEngineError(Exception):
+    """Base of all framework errors. Carries an HTTP status for the REST layer."""
+
+    status = 500
+
+    def __init__(self, message: str = "", **metadata):
+        super().__init__(message)
+        self.message = message
+        self.metadata = metadata
+
+    @property
+    def error_type(self) -> str:
+        # e.g. IndexNotFoundError -> index_not_found_exception, matching the
+        # reference's snake_cased exception names in REST error bodies.
+        name = type(self).__name__
+        if name.endswith("Error"):
+            name = name[: -len("Error")]
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out) + "_exception"
+
+    def to_dict(self) -> dict:
+        d = {"type": self.error_type, "reason": self.message}
+        d.update(self.metadata)
+        return d
+
+
+class IllegalArgumentError(SearchEngineError):
+    status = 400
+
+
+class ParsingError(SearchEngineError):
+    status = 400
+
+
+class MapperParsingError(SearchEngineError):
+    status = 400
+
+
+class ValidationError(SearchEngineError):
+    status = 400
+
+
+class ResourceNotFoundError(SearchEngineError):
+    status = 404
+
+
+class IndexNotFoundError(ResourceNotFoundError):
+    status = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+        self.index = index
+
+
+class DocumentMissingError(ResourceNotFoundError):
+    status = 404
+
+
+class ResourceAlreadyExistsError(SearchEngineError):
+    status = 400
+
+
+class VersionConflictError(SearchEngineError):
+    """Optimistic concurrency failure (seq_no/primary_term or version mismatch).
+
+    Reference: `index/engine/VersionConflictEngineException.java`.
+    """
+
+    status = 409
+
+
+class CircuitBreakingError(SearchEngineError):
+    status = 429
+
+
+class NodeNotConnectedError(SearchEngineError):
+    status = 503
+
+
+class MasterNotDiscoveredError(SearchEngineError):
+    status = 503
+
+
+class ClusterBlockError(SearchEngineError):
+    status = 503
+
+
+class TaskCancelledError(SearchEngineError):
+    status = 400
+
+
+class SearchPhaseExecutionError(SearchEngineError):
+    status = 503
+
+    def __init__(self, phase: str, message: str, shard_failures=()):
+        super().__init__(message, phase=phase)
+        self.phase = phase
+        self.shard_failures = list(shard_failures)

@@ -1,0 +1,328 @@
+"""Typed, validated, dynamic settings registry.
+
+Re-design of the reference's settings system (§5.6 of SURVEY.md):
+`common/settings/Setting.java` (typed Setting<T> with NodeScope/IndexScope/
+Dynamic properties), `Settings.java` (flat string map), and
+`AbstractScopedSettings` (dynamic-update appliers). Kept deliberately small:
+a Setting knows how to parse + validate its value from a flat map; scoped
+registries (ClusterSettings / IndexScopedSettings) validate maps and dispatch
+update consumers on dynamic changes.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from typing import Any, Callable, Dict, Generic, Iterable, Optional, TypeVar
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+T = TypeVar("T")
+
+_TIME_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(nanos|micros|ms|s|m|h|d)$")
+_BYTES_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(b|kb|mb|gb|tb|pb)?$")
+_TIME_FACTORS = {"nanos": 1e-9, "micros": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+_BYTE_FACTORS = {"b": 1, "kb": 1024, "mb": 1024**2, "gb": 1024**3, "tb": 1024**4, "pb": 1024**5}
+
+
+def parse_time_value(value: Any, setting_name: str = "") -> float:
+    """Parse '30s' / '500ms' / '-1' into seconds (reference: TimeValue.java)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    if s in ("-1", "0"):
+        return float(s)
+    m = _TIME_RE.match(s)
+    if not m:
+        raise IllegalArgumentError(f"failed to parse setting [{setting_name}] with value [{value}] as a time value")
+    return float(m.group(1)) * _TIME_FACTORS[m.group(2)]
+
+
+def parse_byte_size(value: Any, setting_name: str = "") -> int:
+    """Parse '512mb' / '2gb' into bytes (reference: ByteSizeValue.java)."""
+    if isinstance(value, int):
+        return value
+    s = str(value).strip().lower()
+    m = _BYTES_RE.match(s)
+    if not m:
+        raise IllegalArgumentError(f"failed to parse setting [{setting_name}] with value [{value}] as a byte size")
+    return int(float(m.group(1)) * _BYTE_FACTORS[m.group(2) or "b"])
+
+
+class Property(enum.Flag):
+    NODE_SCOPE = enum.auto()
+    INDEX_SCOPE = enum.auto()
+    DYNAMIC = enum.auto()
+    FINAL = enum.auto()
+    DEPRECATED = enum.auto()
+    FILTERED = enum.auto()  # hidden from APIs (secrets)
+
+
+class Setting(Generic[T]):
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        parser: Callable[[Any], T],
+        *properties: Property,
+        validator: Optional[Callable[[T], None]] = None,
+    ):
+        self.key = key
+        self._default = default
+        self._parser = parser
+        self.properties = Property(0)
+        for p in properties:
+            self.properties |= p
+        self._validator = validator
+        if (self.properties & Property.DYNAMIC) and (self.properties & Property.FINAL):
+            raise IllegalArgumentError(f"setting [{key}] cannot be both dynamic and final")
+
+    # -- factory helpers mirroring Setting.intSetting / boolSetting / etc. ----
+    @staticmethod
+    def bool_setting(key: str, default: bool, *props: Property) -> "Setting[bool]":
+        def parse(v):
+            if isinstance(v, bool):
+                return v
+            s = str(v).lower()
+            if s in ("true", "1"):
+                return True
+            if s in ("false", "0"):
+                return False
+            raise IllegalArgumentError(f"cannot parse boolean [{v}] for setting [{key}]")
+
+        return Setting(key, default, parse, *props)
+
+    @staticmethod
+    def int_setting(key: str, default: int, *props: Property, min_value: Optional[int] = None,
+                    max_value: Optional[int] = None) -> "Setting[int]":
+        def validate(v: int):
+            if min_value is not None and v < min_value:
+                raise IllegalArgumentError(f"failed to parse value [{v}] for setting [{key}] must be >= {min_value}")
+            if max_value is not None and v > max_value:
+                raise IllegalArgumentError(f"failed to parse value [{v}] for setting [{key}] must be <= {max_value}")
+
+        return Setting(key, default, lambda v: int(v), *props, validator=validate)
+
+    @staticmethod
+    def float_setting(key: str, default: float, *props: Property) -> "Setting[float]":
+        return Setting(key, default, lambda v: float(v), *props)
+
+    @staticmethod
+    def string_setting(key: str, default: str = "", *props: Property) -> "Setting[str]":
+        return Setting(key, default, str, *props)
+
+    @staticmethod
+    def time_setting(key: str, default: str, *props: Property) -> "Setting[float]":
+        return Setting(key, default, lambda v: parse_time_value(v, key), *props)
+
+    @staticmethod
+    def byte_size_setting(key: str, default: str, *props: Property) -> "Setting[int]":
+        return Setting(key, default, lambda v: parse_byte_size(v, key), *props)
+
+    @staticmethod
+    def list_setting(key: str, default: Iterable[str] = (), *props: Property) -> "Setting[list]":
+        def parse(v):
+            if isinstance(v, (list, tuple)):
+                return list(v)
+            return [p.strip() for p in str(v).split(",") if p.strip()]
+
+        return Setting(key, list(default), parse, *props)
+
+    @staticmethod
+    def enum_setting(key: str, default: str, choices: Iterable[str], *props: Property) -> "Setting[str]":
+        choice_set = set(choices)
+
+        def validate(v: str):
+            if v not in choice_set:
+                raise IllegalArgumentError(f"unknown value [{v}] for setting [{key}], expected one of {sorted(choice_set)}")
+
+        return Setting(key, default, str, *props, validator=validate)
+
+    # -------------------------------------------------------------------------
+    @property
+    def dynamic(self) -> bool:
+        return bool(self.properties & Property.DYNAMIC)
+
+    def default(self, settings: "Settings") -> T:
+        d = self._default(settings) if callable(self._default) else self._default
+        return self._parser(d)
+
+    def exists(self, settings: "Settings") -> bool:
+        return self.key in settings
+
+    def get(self, settings: "Settings") -> T:
+        raw = settings.get(self.key)
+        if raw is None:
+            value = self.default(settings)
+        else:
+            value = self._parser(raw)
+        if self._validator is not None:
+            self._validator(value)
+        return value
+
+
+class Settings:
+    """Immutable flat key→value map (reference: common/settings/Settings.java).
+
+    Values may be scalars or lists; nested dicts flatten with dotted keys the
+    way elasticsearch.yml does.
+    """
+
+    EMPTY: "Settings"
+
+    def __init__(self, flat: Optional[Dict[str, Any]] = None):
+        self._map: Dict[str, Any] = dict(flat or {})
+
+    @staticmethod
+    def of(obj: Optional[Dict[str, Any]] = None, **kwargs) -> "Settings":
+        b = Settings.builder()
+        if obj:
+            b.put_dict(obj)
+        for k, v in kwargs.items():
+            b.put(k.replace("__", "."), v)
+        return b.build()
+
+    @staticmethod
+    def builder() -> "SettingsBuilder":
+        return SettingsBuilder()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._map.get(key, default)
+
+    def keys(self):
+        return self._map.keys()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._map
+
+    def __len__(self):
+        return len(self._map)
+
+    def __eq__(self, other):
+        return isinstance(other, Settings) and self._map == other._map
+
+    def __repr__(self):
+        return f"Settings({self._map!r})"
+
+    def as_flat_dict(self) -> Dict[str, Any]:
+        return dict(self._map)
+
+    def as_nested_dict(self) -> Dict[str, Any]:
+        root: Dict[str, Any] = {}
+        for key in sorted(self._map):
+            parts = key.split(".")
+            node = root
+            ok = True
+            for p in parts[:-1]:
+                nxt = node.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    ok = False
+                    break
+                node = nxt
+            if ok and isinstance(node, dict):
+                node[parts[-1]] = self._map[key]
+        return root
+
+    def by_prefix(self, prefix: str) -> "Settings":
+        return Settings({k[len(prefix):]: v for k, v in self._map.items() if k.startswith(prefix)})
+
+    def filtered(self, predicate: Callable[[str], bool]) -> "Settings":
+        return Settings({k: v for k, v in self._map.items() if predicate(k)})
+
+    def merge(self, other: "Settings") -> "Settings":
+        m = dict(self._map)
+        m.update(other._map)
+        return Settings(m)
+
+
+class SettingsBuilder:
+    def __init__(self):
+        self._map: Dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> "SettingsBuilder":
+        self._map[key] = value
+        return self
+
+    def put_dict(self, obj: Dict[str, Any], prefix: str = "") -> "SettingsBuilder":
+        for k, v in obj.items():
+            full = f"{prefix}{k}"
+            if isinstance(v, dict):
+                self.put_dict(v, prefix=full + ".")
+            else:
+                self._map[full] = v
+        return self
+
+    def put_settings(self, settings: Settings) -> "SettingsBuilder":
+        self._map.update(settings.as_flat_dict())
+        return self
+
+    def remove(self, key: str) -> "SettingsBuilder":
+        self._map.pop(key, None)
+        return self
+
+    def build(self) -> Settings:
+        return Settings(self._map)
+
+
+Settings.EMPTY = Settings()
+
+
+class ScopedSettings:
+    """Registry of known settings for a scope + dynamic-update dispatch.
+
+    Reference: `common/settings/AbstractScopedSettings.java` — validates maps
+    against registered settings and runs update consumers when dynamic values
+    change (`ClusterSettings` for node scope, `IndexScopedSettings` for index
+    scope).
+    """
+
+    def __init__(self, settings: Settings, registered: Iterable[Setting], scope: Property):
+        self.scope = scope
+        self._settings = settings
+        self._registry: Dict[str, Setting] = {}
+        for s in registered:
+            if not (s.properties & scope):
+                raise IllegalArgumentError(f"setting [{s.key}] is not registered for scope [{scope}]")
+            if s.key in self._registry:
+                raise IllegalArgumentError(f"duplicate setting [{s.key}]")
+            self._registry[s.key] = s
+        self._consumers: list = []  # (setting, callback)
+        self._applied = Settings.EMPTY
+
+    def register(self, setting: Setting) -> None:
+        self._registry[setting.key] = setting
+
+    def get_setting(self, key: str) -> Optional[Setting]:
+        return self._registry.get(key)
+
+    def get(self, setting: Setting):
+        current = self._settings.merge(self._applied)
+        return setting.get(current)
+
+    def add_settings_update_consumer(self, setting: Setting, consumer: Callable[[Any], None]) -> None:
+        if not setting.dynamic:
+            raise IllegalArgumentError(f"setting [{setting.key}] is not dynamic")
+        self._consumers.append((setting, consumer))
+
+    def validate(self, settings: Settings, *, for_update: bool = False) -> None:
+        for key in settings.keys():
+            s = self._registry.get(key)
+            if s is None:
+                # archived/unknown settings are rejected, matching
+                # AbstractScopedSettings#validate's unknown-setting error.
+                raise IllegalArgumentError(f"unknown setting [{key}]")
+            if for_update and not s.dynamic:
+                raise IllegalArgumentError(f"setting [{key}], not dynamically updateable")
+            s.get(settings)  # parse + validate value
+
+    def apply_settings(self, update: Settings) -> Settings:
+        """Apply a dynamic settings update, firing consumers whose value changed."""
+        self.validate(update, for_update=True)
+        before = self._settings.merge(self._applied)
+        self._applied = self._applied.merge(update)
+        after = self._settings.merge(self._applied)
+        for setting, consumer in self._consumers:
+            old, new = setting.get(before), setting.get(after)
+            if old != new:
+                consumer(new)
+        return after

@@ -1,0 +1,196 @@
+"""Exact kNN as batched matmul + top-k: the north-star device program.
+
+Replaces the reference's O(N·D) per-document scripted loop inside the Lucene
+collector (`ScoreScriptUtils.java:151-171` called per doc from
+`search/query/QueryPhase.java:171`'s BulkScorer) with one MXU-shaped program:
+
+    scores = queries @ corpus^T          (bf16 MXU, f32 accumulate)
+    top-k  = lax.top_k(scores + masks)
+
+Two execution shapes:
+  * single-shot for corpora whose [Q, N] score matrix fits comfortably;
+  * blocked `lax.scan` over corpus tiles with a running top-k merge, for
+    corpora where materializing [Q, N] would blow HBM — the structural
+    analog of ring attention's KV rotation, but over corpus blocks
+    (SURVEY.md §5.7).
+
+The corpus lives in a `Corpus` pytree built once at index/refresh time
+(normalization, squared norms, optional int8 quantization), matching the
+reference's encode-at-parse-time design (`DenseVectorFieldMapper.parse`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.ops import topk as topk_ops
+from elasticsearch_tpu.ops.quantization import quantize_int8
+from elasticsearch_tpu.ops.similarity import NEG_INF
+
+LANE = 128  # TPU lane width; corpus rows are padded to a multiple of this.
+
+
+class Corpus(NamedTuple):
+    """Device-resident searchable vector block (a pytree).
+
+    matrix:    [N_pad, D] f32 / bf16 / int8 storage
+    sq_norms:  [N_pad] f32 — ||row||^2 (post-normalization for cosine)
+    scales:    [N_pad] f32 — int8 per-row scales (all-ones when unquantized)
+    num_valid: int32 scalar — rows beyond this are padding and never match
+    """
+
+    matrix: jax.Array
+    sq_norms: jax.Array
+    scales: jax.Array
+    num_valid: jax.Array
+
+
+def pad_rows(n: int, multiple: int = LANE) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def build_corpus(
+    vectors: np.ndarray,
+    metric: str = sim.COSINE,
+    dtype: str = "bf16",
+    pad_to: Optional[int] = None,
+) -> Corpus:
+    """Build the device corpus from raw host vectors.
+
+    dtype: "f32" | "bf16" | "int8" storage for the matrix.
+    For cosine, rows are L2-normalized here, once — so query-time work is a
+    pure dot product (the reference instead stores the magnitude beside each
+    vector and divides per doc per query, `ScoreScriptUtils.java:161`).
+    """
+    vectors = np.asarray(vectors, dtype=np.float32)
+    n, d = vectors.shape
+    n_pad = pad_to if pad_to is not None else pad_rows(max(n, 1))
+    if n_pad < n:
+        raise ValueError(f"pad_to {n_pad} < corpus size {n}")
+
+    if metric == sim.COSINE:
+        norms = np.linalg.norm(vectors, axis=-1, keepdims=True)
+        vectors = vectors / np.maximum(norms, 1e-30)
+
+    padded = np.zeros((n_pad, d), dtype=np.float32)
+    padded[:n] = vectors
+    sq_norms = jnp.asarray((padded * padded).sum(axis=-1), dtype=jnp.float32)
+
+    if dtype == "int8":
+        matrix, scales = quantize_int8(jnp.asarray(padded))
+    else:
+        matrix = jnp.asarray(padded, dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32)
+        scales = jnp.ones((n_pad,), dtype=jnp.float32)
+
+    return Corpus(matrix=matrix, sq_norms=sq_norms, scales=scales,
+                  num_valid=jnp.int32(n))
+
+
+def _block_scores(queries, matrix, sq_norms, scales, metric: str, precision: str):
+    """Raw similarity for one corpus block, handling int8 dequant-after-matmul.
+
+    Queries arrive already metric-prepped (see _prep_queries) — in particular
+    cosine queries are unit vectors, so no renormalization happens per block.
+    """
+    if matrix.dtype == jnp.int8:
+        if precision == "f32":
+            mat = matrix.astype(jnp.float32)
+            dots = jax.lax.dot_general(
+                queries, mat,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) * scales[None, :]
+        else:
+            dots = jax.lax.dot_general(
+                queries.astype(jnp.bfloat16), matrix.astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scales[None, :]
+        if metric == sim.L2_NORM:
+            return sim.l2_raw_from_dots(dots, queries, sq_norms)
+        return dots
+    return sim.similarity_scores(queries, matrix, sq_norms, metric=metric,
+                                 precision=precision, normalize_queries=False)
+
+
+def _prep_queries(queries, metric: str):
+    queries = queries.astype(jnp.float32)
+    if metric == sim.COSINE:
+        qn = jnp.linalg.norm(queries, axis=-1, keepdims=True)
+        queries = queries / jnp.maximum(qn, 1e-30)
+    return queries
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "precision", "block_size"),
+)
+def knn_search(
+    queries: jax.Array,
+    corpus: Corpus,
+    k: int,
+    metric: str = sim.COSINE,
+    filter_mask: Optional[jax.Array] = None,
+    precision: str = "bf16",
+    block_size: Optional[int] = None,
+):
+    """Exact top-k search of `queries` [Q, D] against `corpus`.
+
+    filter_mask: optional [N_pad] or [Q, N_pad] bool — True = searchable
+    (filtered kNN; host-computed bitset from the boolean pre-filter).
+
+    Returns (scores [Q, k] raw similarity, ids [Q, k] int32 row indices).
+    Padded / filtered-out rows return score NEG_INF (callers treat those as
+    "fewer than k hits").
+    """
+    n_pad = corpus.matrix.shape[0]
+    q = _prep_queries(queries, metric)
+    # cosine corpus rows are already normalized; its sq_norms are 1 for valid
+    # rows, 0 for padding — handled by the validity mask below either way.
+    valid = jnp.arange(n_pad, dtype=jnp.int32) < corpus.num_valid
+    if filter_mask is not None:
+        valid = valid & filter_mask  # broadcasts [N] or [Q, N]
+
+    if block_size is None or block_size >= n_pad:
+        scores = _block_scores(q, corpus.matrix, corpus.sq_norms, corpus.scales, metric, precision)
+        return topk_ops.masked_top_k(scores, valid, k)
+
+    # Blocked path: scan corpus tiles with a running top-k. Keeps peak HBM at
+    # [Q, block_size] scores instead of [Q, N].
+    if n_pad % block_size != 0:
+        raise ValueError(f"n_pad {n_pad} not divisible by block_size {block_size}")
+    nblocks = n_pad // block_size
+    mat = corpus.matrix.reshape(nblocks, block_size, -1)
+    sqn = corpus.sq_norms.reshape(nblocks, block_size)
+    scl = corpus.scales.reshape(nblocks, block_size)
+    if valid.ndim == 1:
+        vmask = valid.reshape(nblocks, 1, block_size)
+    else:
+        vmask = valid.reshape(-1, nblocks, block_size).transpose(1, 0, 2)
+
+    nq = q.shape[0]
+    init = (jnp.full((nq, k), NEG_INF, dtype=jnp.float32),
+            jnp.zeros((nq, k), dtype=jnp.int32))
+
+    def body(carry, xs):
+        best_s, best_i = carry
+        block_mat, block_sqn, block_scl, block_valid, block_idx = xs
+        s = _block_scores(q, block_mat, block_sqn, block_scl, metric, precision)
+        s = jnp.where(block_valid, s, NEG_INF)
+        ids = block_idx * block_size + jnp.arange(block_size, dtype=jnp.int32)[None, :]
+        ids = jnp.broadcast_to(ids, s.shape)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate([best_i, ids], axis=1)
+        vals, pos = jax.lax.top_k(cat_s, k)
+        return (vals, jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+    xs = (mat, sqn, scl, vmask, jnp.arange(nblocks, dtype=jnp.int32))
+    (best_s, best_i), _ = jax.lax.scan(body, init, xs)
+    return best_s, best_i

@@ -1,0 +1,56 @@
+"""Top-k selection and cross-block merge.
+
+Replaces the reference's per-shard Lucene top-k heaps and the coordinator's
+`SearchPhaseController.mergeTopDocs` (`action/search/SearchPhaseController.java:221-243`)
+with `lax.top_k` plus a concat-and-reselect merge. `lax.top_k` is stable
+(ties resolve to the lower index), so ordering the concatenation by shard
+index reproduces the reference's tie-break-by-shard-index semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops.similarity import NEG_INF
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k(scores: jax.Array, k: int):
+    """scores [..., N] → (values [..., k], indices [..., k]) descending."""
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_top_k(scores: jax.Array, mask: jax.Array, k: int):
+    """Top-k over scores where mask==True; masked-out slots score -inf.
+
+    This is the device half of filtered kNN (BASELINE config 5): the host
+    computes the filter bitset from the boolean query, ships it as a packed
+    bool array, and the device applies it as an additive mask — the
+    reference's collector-level filter composition
+    (`BoolQueryBuilder` + `script_score`) doesn't translate to XLA.
+    """
+    masked = jnp.where(mask, scores, NEG_INF)
+    return jax.lax.top_k(masked, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_top_k(scores_blocks: jax.Array, index_blocks: jax.Array, k: int):
+    """Merge per-block top-k results into a global top-k.
+
+    scores_blocks: [B, Q, k_b] per-block descending scores
+    index_blocks:  [B, Q, k_b] matching global doc ids
+    Returns (scores [Q, k], ids [Q, k]).
+
+    Concatenation is ordered by block (shard) index, so lax.top_k's stability
+    gives the reference's tie-break (`mergeTopDocs:221` breaks equal scores by
+    shard index).
+    """
+    b, q, kb = scores_blocks.shape
+    flat_scores = jnp.transpose(scores_blocks, (1, 0, 2)).reshape(q, b * kb)
+    flat_ids = jnp.transpose(index_blocks, (1, 0, 2)).reshape(q, b * kb)
+    vals, pos = jax.lax.top_k(flat_scores, k)
+    return vals, jnp.take_along_axis(flat_ids, pos, axis=1)

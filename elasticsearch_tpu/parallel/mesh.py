@@ -1,0 +1,56 @@
+"""Device-mesh management.
+
+The reference scales by hash-sharding the corpus across nodes and
+scatter-gathering searches (`cluster/routing/OperationRouting.java`,
+`AbstractSearchAsyncAction.java:214`). The TPU-native analog is a 2-D
+`jax.sharding.Mesh`:
+
+  axis "dp"    — query-batch data parallelism (independent searches)
+  axis "shard" — corpus partitioning (one Elasticsearch shard ≈ one mesh
+                 column's slice of the HBM-resident matrix)
+
+Cross-shard merges ride ICI collectives inside the compiled program instead
+of coordinator-side RPC reduces (`SearchPhaseController.mergeTopDocs:221`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+SHARD_AXIS = "shard"
+
+
+def make_mesh(num_shards: Optional[int] = None, dp: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (dp, shard) mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_shards is None:
+        num_shards = len(devices) // dp
+    if dp * num_shards > len(devices):
+        raise ValueError(f"mesh {dp}x{num_shards} needs {dp * num_shards} devices, have {len(devices)}")
+    grid = np.array(devices[: dp * num_shards]).reshape(dp, num_shards)
+    return Mesh(grid, (DP_AXIS, SHARD_AXIS))
+
+
+def corpus_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows of the corpus matrix split across the shard axis."""
+    return NamedSharding(mesh, P(SHARD_AXIS, None))
+
+
+def per_shard_sharding(mesh: Mesh) -> NamedSharding:
+    """1-D per-row metadata (norms, scales) split across the shard axis."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def query_sharding(mesh: Mesh) -> NamedSharding:
+    """Query batches split across dp, replicated across shards."""
+    return NamedSharding(mesh, P(DP_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,12 @@
+"""Framework version.
+
+Mirrors the role of `server/src/main/java/org/elasticsearch/Version.java:81`
+(reference Version.CURRENT = V_8_0_0): a single version constant that also
+participates in the wire protocol handshake (see common/serialization.py).
+"""
+
+__version__ = "0.1.0"
+
+# Wire-format version id, monotonically increasing. Peers negotiate the
+# minimum of their versions on connect (reference: TcpTransport.java:796).
+WIRE_VERSION = 1

@@ -1,0 +1,114 @@
+"""Tests for settings, serialization, and x-content."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.common.serialization import (
+    NamedWriteable, NamedWriteableRegistry, StreamInput, StreamOutput,
+)
+from elasticsearch_tpu.common.settings import (
+    Property, ScopedSettings, Setting, Settings, parse_byte_size, parse_time_value,
+)
+from elasticsearch_tpu.common import xcontent
+from elasticsearch_tpu.common.xcontent import ObjectParser, XContentType
+
+
+def test_settings_flatten_and_nest():
+    s = Settings.of({"index": {"number_of_shards": 3, "refresh_interval": "1s"}})
+    assert s.get("index.number_of_shards") == 3
+    assert s.as_nested_dict()["index"]["refresh_interval"] == "1s"
+    assert s.by_prefix("index.").get("number_of_shards") == 3
+
+
+def test_typed_settings():
+    shards = Setting.int_setting("index.number_of_shards", 1, Property.INDEX_SCOPE, min_value=1)
+    s = Settings.of(index__number_of_shards="4")
+    assert shards.get(s) == 4
+    assert shards.get(Settings.EMPTY) == 1
+    with pytest.raises(IllegalArgumentError):
+        shards.get(Settings.of(index__number_of_shards="0"))
+
+
+def test_time_and_bytes():
+    assert parse_time_value("30s") == 30.0
+    assert parse_time_value("500ms") == 0.5
+    assert parse_time_value("-1") == -1
+    assert parse_byte_size("2kb") == 2048
+    assert parse_byte_size("1gb") == 1024 ** 3
+
+
+def test_dynamic_settings_update():
+    interval = Setting.time_setting("index.refresh_interval", "1s",
+                                    Property.INDEX_SCOPE, Property.DYNAMIC)
+    static = Setting.int_setting("index.number_of_shards", 1, Property.INDEX_SCOPE)
+    scoped = ScopedSettings(Settings.EMPTY, [interval, static], Property.INDEX_SCOPE)
+    seen = []
+    scoped.add_settings_update_consumer(interval, seen.append)
+    scoped.apply_settings(Settings.of({"index.refresh_interval": "5s"}))
+    assert seen == [5.0]
+    with pytest.raises(IllegalArgumentError):
+        scoped.apply_settings(Settings.of({"index.number_of_shards": 2}))
+    with pytest.raises(IllegalArgumentError):
+        scoped.apply_settings(Settings.of({"bogus.key": 1}))
+
+
+def test_stream_roundtrip():
+    out = StreamOutput()
+    out.write_vint(12345)
+    out.write_zlong(-42)
+    out.write_string("héllo")
+    out.write_optional_string(None)
+    out.write_generic({"a": [1, 2.5, True, None], "b": "x"})
+    inp = StreamInput(out.bytes())
+    assert inp.read_vint() == 12345
+    assert inp.read_zlong() == -42
+    assert inp.read_string() == "héllo"
+    assert inp.read_optional_string() is None
+    assert inp.read_generic() == {"a": [1, 2.5, True, None], "b": "x"}
+    assert inp.remaining() == 0
+
+
+class _Probe(NamedWriteable):
+    def __init__(self, x):
+        self.x = x
+
+    def writeable_name(self):
+        return "probe"
+
+    def write_to(self, out):
+        out.write_vint(self.x)
+
+
+def test_named_writeable():
+    reg = NamedWriteableRegistry()
+    reg.register(_Probe, "probe", lambda inp: _Probe(inp.read_vint()))
+    out = StreamOutput()
+    out.write_named_writeable(_Probe(7))
+    inp = StreamInput(out.bytes(), registry=reg)
+    assert inp.read_named_writeable(_Probe).x == 7
+
+
+def test_xcontent_json_and_cbor():
+    doc = {"name": "tpu", "dims": 768, "v": [0.5, -1.25], "ok": True, "none": None}
+    for ct in (XContentType.JSON, XContentType.CBOR):
+        data = xcontent.dumps(doc, ct)
+        assert xcontent.loads(data, ct) == doc
+    assert xcontent.loads_auto(xcontent.dumps(doc, XContentType.CBOR)) == doc
+    with pytest.raises(IllegalArgumentError):
+        xcontent.dumps(doc, XContentType.YAML)
+
+
+def test_object_parser():
+    class Req:
+        def __init__(self):
+            self.size = 10
+            self.query = None
+
+    p = ObjectParser("search", Req)
+    p.declare_field("size", lambda o, v: setattr(o, "size", v))
+    p.declare_field("query", lambda o, v: setattr(o, "query", v))
+    r = p.parse({"size": 5, "query": {"match_all": {}}})
+    assert r.size == 5 and r.query == {"match_all": {}}
+    from elasticsearch_tpu.common.errors import ParsingError
+    with pytest.raises(ParsingError):
+        p.parse({"sizee": 5})

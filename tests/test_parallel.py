@@ -1,0 +1,72 @@
+"""Sharded kNN on an 8-device virtual CPU mesh must equal the single-device result."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from elasticsearch_tpu.ops import knn as knn_ops
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.parallel import mesh as mesh_lib
+from elasticsearch_tpu.parallel.sharded_knn import build_sharded_corpus, distributed_knn_search
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def vectors():
+    return RNG.standard_normal((3000, 32)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    return RNG.standard_normal((8, 32)).astype(np.float32)
+
+
+def exact_ids(queries, vectors, metric, k):
+    q = queries / np.linalg.norm(queries, axis=-1, keepdims=True)
+    c = vectors / np.linalg.norm(vectors, axis=-1, keepdims=True)
+    scores = q @ c.T
+    return np.argsort(-scores, axis=1)[:, :k], np.sort(scores, axis=1)[:, ::-1][:, :k]
+
+
+@pytest.mark.parametrize("dp,shards", [(1, 8), (2, 4), (1, 4)])
+def test_distributed_matches_exact(vectors, queries, dp, shards):
+    assert jax.device_count() >= dp * shards, "conftest must force 8 cpu devices"
+    mesh = mesh_lib.make_mesh(num_shards=shards, dp=dp)
+    corpus, layout = build_sharded_corpus(vectors, mesh, metric=sim.COSINE, dtype="f32")
+    scores, gids = distributed_knn_search(jnp.asarray(queries), corpus, k=10,
+                                          mesh=mesh, metric=sim.COSINE, precision="f32")
+    orig = layout.to_original_ids(np.asarray(gids))
+    ref_ids, ref_scores = exact_ids(queries, vectors, sim.COSINE, 10)
+    overlap = np.mean([
+        len(set(orig[i].tolist()) & set(ref_ids[i].tolist())) / 10.0
+        for i in range(queries.shape[0])
+    ])
+    assert overlap == 1.0
+    np.testing.assert_allclose(np.asarray(scores), ref_scores, rtol=1e-4, atol=1e-4)
+
+
+def test_distributed_filtered(vectors, queries):
+    mesh = mesh_lib.make_mesh(num_shards=4, dp=2)
+    corpus, layout = build_sharded_corpus(vectors, mesh, metric=sim.COSINE, dtype="f32")
+    n_pad = corpus.matrix.shape[0]
+    mask = np.zeros(n_pad, dtype=bool)
+    keep = RNG.choice(vectors.shape[0], size=200, replace=False)
+    mask[layout.to_global_ids(keep)] = True
+    fm = jax.device_put(jnp.asarray(mask), mesh_lib.per_shard_sharding(mesh))
+    scores, gids = distributed_knn_search(jnp.asarray(queries), corpus, k=10,
+                                          mesh=mesh, metric=sim.COSINE,
+                                          filter_mask=fm, precision="f32")
+    orig = layout.to_original_ids(np.asarray(gids))
+    assert set(orig.flatten().tolist()) <= set(keep.tolist())
+
+
+def test_layout_headroom():
+    mesh = mesh_lib.make_mesh(num_shards=4, dp=1)
+    v = RNG.standard_normal((4 * 256, 8)).astype(np.float32)
+    corpus, layout = build_sharded_corpus(v, mesh, min_headroom=8)
+    assert layout.docs_per_shard == 256
+    assert layout.rows_per_shard >= 256 + 8
+    nv = np.asarray(corpus.num_valid)
+    assert (nv == 256).all()
