@@ -164,6 +164,10 @@ class StreamInput:
             if not b & 0x80:
                 return result
             shift += 7
+            if shift > 63:
+                # reference StreamInput caps vint/vlong width; unbounded
+                # varints from untrusted input become giant allocations
+                raise SearchEngineError("variable-length int is too long")
 
     def read_vlong(self) -> int:
         return self.read_vint()
@@ -174,7 +178,10 @@ class StreamInput:
 
     def read_string(self) -> str:
         n = self.read_vint()
-        return bytes(self._take(n)).decode("utf-8")
+        try:
+            return bytes(self._take(n)).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise SearchEngineError(f"malformed UTF-8 string on stream: {e}") from None
 
     def read_optional_string(self) -> Optional[str]:
         return self.read_string() if self.read_boolean() else None

@@ -33,7 +33,8 @@ def parse_time_value(value: Any, setting_name: str = "") -> float:
     if s in ("-1", "0"):
         return float(s)
     m = _TIME_RE.match(s)
-    if not m:
+    if not m or float(m.group(1)) < 0:
+        # only the -1 sentinel may be negative (reference: TimeValue)
         raise IllegalArgumentError(f"failed to parse setting [{setting_name}] with value [{value}] as a time value")
     return float(m.group(1)) * _TIME_FACTORS[m.group(2)]
 
@@ -290,6 +291,11 @@ class ScopedSettings:
         self._applied = Settings.EMPTY
 
     def register(self, setting: Setting) -> None:
+        if not (setting.properties & self.scope):
+            raise IllegalArgumentError(
+                f"setting [{setting.key}] is not registered for scope [{self.scope}]")
+        if setting.key in self._registry:
+            raise IllegalArgumentError(f"duplicate setting [{setting.key}]")
         self._registry[setting.key] = setting
 
     def get_setting(self, key: str) -> Optional[Setting]:

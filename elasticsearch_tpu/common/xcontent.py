@@ -174,9 +174,16 @@ _CODECS: Dict[str, _Codec] = {
     XContentType.JSON: _Codec(lambda o: json.dumps(o, separators=(",", ":")).encode("utf-8"), _json_loads),
     XContentType.CBOR: _Codec(
         lambda o: bytes(memoryview(_encode_cbor_root(o))),
-        lambda d: _cbor_decode(d, 0)[0],
+        lambda d: _cbor_decode_root(d),
     ),
 }
+
+
+def _cbor_decode_root(data: bytes) -> Any:
+    value, pos = _cbor_decode(data, 0)
+    if pos != len(data):
+        raise ParsingError(f"trailing bytes after CBOR value ({len(data) - pos} extra)")
+    return value
 
 
 def _encode_cbor_root(obj: Any) -> bytearray:

@@ -1,0 +1,285 @@
+"""Text analysis: analyzers, tokenizers, token filters.
+
+Re-design of the reference's analysis registry (`index/analysis/`,
+`modules/analysis-common/` — SURVEY.md §2.4): a small pluggable registry of
+named analyzers built from tokenizer + filter chains. Covers the built-in
+analyzers the core API surface needs (standard, simple, whitespace, keyword,
+stop, english) — language plugins can register more.
+
+Analysis is host-side by design: it feeds the inverted index, which stays on
+host; only scoring-relevant statistics cross to the device.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Callable, Dict, Iterable, List, NamedTuple, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+
+class Token(NamedTuple):
+    term: str
+    position: int
+    start_offset: int
+    end_offset: int
+
+
+# ---------------------------------------------------------------------------
+# Tokenizers
+# ---------------------------------------------------------------------------
+
+_WORD_RE = re.compile(r"[^\W_]+(?:['’][^\W_]+)?", re.UNICODE)
+_WHITESPACE_RE = re.compile(r"\S+")
+_LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+def _regex_tokenizer(pattern: re.Pattern) -> Callable[[str], List[Token]]:
+    def tokenize(text: str) -> List[Token]:
+        return [Token(m.group(0), i, m.start(), m.end())
+                for i, m in enumerate(pattern.finditer(text))]
+
+    return tokenize
+
+
+standard_tokenizer = _regex_tokenizer(_WORD_RE)     # unicode word segmentation (approx UAX#29)
+whitespace_tokenizer = _regex_tokenizer(_WHITESPACE_RE)
+letter_tokenizer = _regex_tokenizer(_LETTER_RE)
+
+
+def keyword_tokenizer(text: str) -> List[Token]:
+    return [Token(text, 0, 0, len(text))] if text else []
+
+
+def ngram_tokenizer(min_gram: int = 1, max_gram: int = 2) -> Callable[[str], List[Token]]:
+    def tokenize(text: str) -> List[Token]:
+        out = []
+        pos = 0
+        for n in range(min_gram, max_gram + 1):
+            for i in range(0, max(0, len(text) - n + 1)):
+                out.append(Token(text[i:i + n], pos, i, i + n))
+                pos += 1
+        return out
+
+    return tokenize
+
+
+def edge_ngram_tokenizer(min_gram: int = 1, max_gram: int = 10) -> Callable[[str], List[Token]]:
+    def tokenize(text: str) -> List[Token]:
+        return [Token(text[:n], 0, 0, n)
+                for n in range(min_gram, min(max_gram, len(text)) + 1)]
+
+    return tokenize
+
+
+# ---------------------------------------------------------------------------
+# Token filters
+# ---------------------------------------------------------------------------
+
+ENGLISH_STOPWORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split()
+)
+
+
+def lowercase_filter(tokens: Iterable[Token]) -> List[Token]:
+    return [t._replace(term=t.term.lower()) for t in tokens]
+
+
+def asciifolding_filter(tokens: Iterable[Token]) -> List[Token]:
+    def fold(s: str) -> str:
+        return "".join(c for c in unicodedata.normalize("NFKD", s)
+                       if not unicodedata.combining(c))
+
+    return [t._replace(term=fold(t.term)) for t in tokens]
+
+
+def stop_filter(stopwords: frozenset = ENGLISH_STOPWORDS):
+    def apply(tokens: Iterable[Token]) -> List[Token]:
+        return [t for t in tokens if t.term not in stopwords]
+
+    return apply
+
+
+def _porter_stem(word: str) -> str:
+    """Porter stemmer (reference uses Lucene's PorterStemFilter for 'english').
+
+    Compact implementation of the classic algorithm, steps 1-5.
+    """
+    if len(word) <= 2:
+        return word
+
+    vowels = "aeiou"
+
+    def is_cons(w, i):
+        c = w[i]
+        if c in vowels:
+            return False
+        if c == "y":
+            return i == 0 or not is_cons(w, i - 1)
+        return True
+
+    def measure(w):
+        m, prev_v = 0, False
+        for i in range(len(w)):
+            v = not is_cons(w, i)
+            if prev_v and not v:
+                m += 1
+            prev_v = v
+        return m
+
+    def has_vowel(w):
+        return any(not is_cons(w, i) for i in range(len(w)))
+
+    def ends_double_cons(w):
+        return len(w) >= 2 and w[-1] == w[-2] and is_cons(w, len(w) - 1)
+
+    def cvc(w):
+        if len(w) < 3:
+            return False
+        return (is_cons(w, len(w) - 3) and not is_cons(w, len(w) - 2)
+                and is_cons(w, len(w) - 1) and w[-1] not in "wxy")
+
+    w = word
+
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+
+    # step 1b
+    if w.endswith("eed"):
+        if measure(w[:-3]) > 0:
+            w = w[:-1]
+    else:
+        flag = False
+        if w.endswith("ed") and has_vowel(w[:-2]):
+            w, flag = w[:-2], True
+        elif w.endswith("ing") and has_vowel(w[:-3]):
+            w, flag = w[:-3], True
+        if flag:
+            if w.endswith(("at", "bl", "iz")):
+                w += "e"
+            elif ends_double_cons(w) and not w.endswith(("l", "s", "z")):
+                w = w[:-1]
+            elif measure(w) == 1 and cvc(w):
+                w += "e"
+
+    # step 1c
+    if w.endswith("y") and has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+
+    # step 2
+    step2 = [("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
+             ("izer", "ize"), ("abli", "able"), ("alli", "al"), ("entli", "ent"),
+             ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+             ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+             ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble")]
+    for suf, rep in step2:
+        if w.endswith(suf):
+            if measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+
+    # step 3
+    step3 = [("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+             ("ical", "ic"), ("ful", ""), ("ness", "")]
+    for suf, rep in step3:
+        if w.endswith(suf):
+            if measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+
+    # step 4
+    step4 = ["al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+             "ment", "ent", "ou", "ism", "ate", "iti", "ous", "ive", "ize"]
+    for suf in step4:
+        if w.endswith(suf):
+            stem = w[: -len(suf)]
+            if suf == "ent" and w.endswith(("sion", "tion")):
+                # 'ion' handled below
+                pass
+            if measure(stem) > 1:
+                if suf in ("ate",) or True:
+                    w = stem
+            break
+    else:
+        if w.endswith("ion") and len(w) > 3 and w[-4] in "st" and measure(w[:-3]) > 1:
+            w = w[:-3]
+
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = measure(stem)
+        if m > 1 or (m == 1 and not cvc(stem)):
+            w = stem
+    # step 5b
+    if measure(w) > 1 and ends_double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+
+    return w
+
+
+def porter_stem_filter(tokens: Iterable[Token]) -> List[Token]:
+    return [t._replace(term=_porter_stem(t.term)) for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Analyzer
+# ---------------------------------------------------------------------------
+
+class Analyzer:
+    def __init__(self, name: str, tokenizer: Callable[[str], List[Token]],
+                 filters: Iterable[Callable[[Iterable[Token]], List[Token]]] = ()):
+        self.name = name
+        self.tokenizer = tokenizer
+        self.filters = list(filters)
+
+    def analyze(self, text: str) -> List[Token]:
+        tokens = self.tokenizer(str(text))
+        for f in self.filters:
+            tokens = f(tokens)
+        return tokens
+
+    def terms(self, text: str) -> List[str]:
+        return [t.term for t in self.analyze(text)]
+
+
+class AnalysisRegistry:
+    """Named analyzers per index (reference: AnalysisRegistry.java)."""
+
+    def __init__(self):
+        self._analyzers: Dict[str, Analyzer] = {}
+        for a in built_in_analyzers():
+            self._analyzers[a.name] = a
+
+    def register(self, analyzer: Analyzer) -> None:
+        self._analyzers[analyzer.name] = analyzer
+
+    def get(self, name: str) -> Analyzer:
+        a = self._analyzers.get(name)
+        if a is None:
+            raise IllegalArgumentError(f"failed to find analyzer [{name}]")
+        return a
+
+    def names(self):
+        return sorted(self._analyzers)
+
+
+def built_in_analyzers() -> List[Analyzer]:
+    return [
+        Analyzer("standard", standard_tokenizer, [lowercase_filter]),
+        Analyzer("simple", letter_tokenizer, [lowercase_filter]),
+        Analyzer("whitespace", whitespace_tokenizer),
+        Analyzer("keyword", keyword_tokenizer),
+        Analyzer("stop", letter_tokenizer, [lowercase_filter, stop_filter()]),
+        Analyzer("english", standard_tokenizer,
+                 [lowercase_filter, stop_filter(), porter_stem_filter]),
+    ]
+
+
+DEFAULT_REGISTRY = AnalysisRegistry()

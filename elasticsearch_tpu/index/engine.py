@@ -1,0 +1,393 @@
+"""The per-shard storage engine: versioned indexing, NRT refresh, commits.
+
+Re-design of `index/engine/InternalEngine.java` (SURVEY.md §2.4, §3.3):
+
+- every operation gets a seq_no from the LocalCheckpointTracker (`:821`)
+  and an internal version; the LiveVersionMap resolves id→latest for
+  version conflicts and realtime get (`planIndexingAsPrimary:996`);
+- documents land in an in-memory SegmentBuilder; `refresh()` seals it into
+  an immutable searchable segment (NRT visibility, default 1s in the
+  reference `IndexService.maybeRefreshEngine:757`);
+- `flush()` persists sealed segments + commit metadata and trims the
+  translog below the commit, like Lucene commits + translog generations;
+- updates/deletes are tombstones over earlier rows; `merge()` compacts
+  segments dropping dead docs (Lucene background merges);
+- on open, the engine recovers: load last commit, replay translog ops
+  above the commit's local checkpoint (`recoverFromTranslog`).
+
+Each document occupies a global "row" (monotonic per shard). The dense-vector
+columns of sealed segments feed the device vector store at refresh; rows are
+the join key between host postings/doc-values and device matrices.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError, SearchEngineError, VersionConflictError,
+)
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import (
+    Segment, SegmentBuilder, SegmentView, ShardReader,
+)
+from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
+from elasticsearch_tpu.index.translog import OP_DELETE, OP_INDEX, OP_NOOP, Translog
+
+
+class VersionValue(NamedTuple):
+    seq_no: int
+    primary_term: int
+    version: int
+    row: int          # global row of the live doc; -1 if deleted
+    deleted: bool
+
+
+class EngineResult(NamedTuple):
+    doc_id: str
+    seq_no: int
+    primary_term: int
+    version: int
+    result: str       # "created" | "updated" | "deleted" | "noop"
+    row: int
+
+
+class Engine:
+    def __init__(self, path: str, mapper_service: MapperService,
+                 primary_term: int = 1, translog_sync: str = "request"):
+        self.path = path
+        self.mapper_service = mapper_service
+        self.primary_term = primary_term
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.RLock()
+
+        self.segments: List[Segment] = []
+        self.deleted_rows: Dict[int, set] = {}     # seg_id -> set(local ids)
+        self.version_map: Dict[str, VersionValue] = {}
+        self.tracker = LocalCheckpointTracker()
+        self._next_row = 0
+        self._next_seg_id = 0
+        self._builder: Optional[SegmentBuilder] = None
+        self._refresh_listeners: List[Callable[[ShardReader], None]] = []
+        self._reader: Optional[ShardReader] = None
+
+        self._load_commit()
+        self.translog = Translog(os.path.join(path, "translog"), sync_policy=translog_sync)
+        self._recover_from_translog()
+        self.refresh()
+
+    # ------------------------------------------------------------------ write
+    def index(self, doc_id: str, source: dict, *,
+              seq_no: Optional[int] = None,
+              primary_term: Optional[int] = None,
+              version: Optional[int] = None,
+              version_type: str = "internal",
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None,
+              op_type: str = "index",
+              origin: str = "primary") -> EngineResult:
+        """Index one document (primary assigns seq_no; replica replays it).
+
+        Reference: `InternalEngine.index:843` → plan (`:996`) → Lucene add
+        (`:902`) → translog (`:911`).
+        """
+        with self._lock:
+            existing = self.version_map.get(doc_id)
+
+            if origin == "primary":
+                self._check_conflicts(doc_id, existing, version, version_type,
+                                      if_seq_no, if_primary_term, op_type)
+                seq_no = self.tracker.generate_seq_no()
+                primary_term = self.primary_term
+                if version_type == "external":
+                    new_version = version
+                else:
+                    new_version = 1 if existing is None or existing.deleted else existing.version + 1
+            else:
+                if seq_no is None:
+                    raise SearchEngineError("replica operations require a seq_no")
+                primary_term = primary_term if primary_term is not None else self.primary_term
+                new_version = version if version is not None else 1
+                # replica out-of-order delivery: ignore ops older than current
+                if existing is not None and existing.seq_no >= seq_no:
+                    self.tracker.mark_processed(seq_no)
+                    return EngineResult(doc_id, seq_no, primary_term,
+                                        existing.version, "noop", existing.row)
+
+            parsed = self.mapper_service.parse_document(doc_id, source)
+            builder = self._get_builder()
+            local = builder.add(parsed, seq_no)
+            row = builder.base + local
+            self._next_row = row + 1
+
+            created = existing is None or existing.deleted
+            if existing is not None and not existing.deleted:
+                self._tombstone(existing.row)
+
+            self.version_map[doc_id] = VersionValue(seq_no, primary_term, new_version, row, False)
+            self.translog.add({"op": OP_INDEX, "id": doc_id, "source": source,
+                               "seq_no": seq_no, "primary_term": primary_term,
+                               "version": new_version})
+            self.tracker.mark_processed(seq_no)
+            return EngineResult(doc_id, seq_no, primary_term, new_version,
+                                "created" if created else "updated", row)
+
+    def delete(self, doc_id: str, *,
+               seq_no: Optional[int] = None,
+               primary_term: Optional[int] = None,
+               version: Optional[int] = None,
+               version_type: str = "internal",
+               if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None,
+               origin: str = "primary") -> EngineResult:
+        with self._lock:
+            existing = self.version_map.get(doc_id)
+
+            if origin == "primary":
+                if existing is None or existing.deleted:
+                    raise DocumentMissingError(f"[{doc_id}]: document missing")
+                self._check_conflicts(doc_id, existing, version, version_type,
+                                      if_seq_no, if_primary_term, "delete")
+                seq_no = self.tracker.generate_seq_no()
+                primary_term = self.primary_term
+                new_version = existing.version + 1 if version_type == "internal" else version
+            else:
+                if seq_no is None:
+                    raise SearchEngineError("replica operations require a seq_no")
+                primary_term = primary_term if primary_term is not None else self.primary_term
+                new_version = version if version is not None else 1
+                if existing is not None and existing.seq_no >= seq_no:
+                    self.tracker.mark_processed(seq_no)
+                    return EngineResult(doc_id, seq_no, primary_term,
+                                        existing.version, "noop", existing.row)
+
+            if existing is not None and not existing.deleted:
+                self._tombstone(existing.row)
+            self.version_map[doc_id] = VersionValue(seq_no, primary_term,
+                                                    new_version or 1, -1, True)
+            self.translog.add({"op": OP_DELETE, "id": doc_id, "seq_no": seq_no,
+                               "primary_term": primary_term, "version": new_version or 1})
+            self.tracker.mark_processed(seq_no)
+            return EngineResult(doc_id, seq_no, primary_term, new_version or 1,
+                                "deleted", -1)
+
+    def noop(self, seq_no: int, reason: str = "") -> None:
+        """Fill a seq_no gap (reference: InternalEngine.noOp for primary failover)."""
+        with self._lock:
+            self.translog.add({"op": OP_NOOP, "seq_no": seq_no, "reason": reason,
+                               "primary_term": self.primary_term})
+            self.tracker.mark_processed(seq_no)
+
+    def _check_conflicts(self, doc_id, existing, version, version_type,
+                         if_seq_no, if_primary_term, op_type) -> None:
+        if op_type == "create" and existing is not None and not existing.deleted:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, document already exists "
+                f"(current version [{existing.version}])")
+        if if_seq_no is not None or if_primary_term is not None:
+            if existing is None or existing.deleted:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, document does not exist")
+            if (if_seq_no is not None and existing.seq_no != if_seq_no) or \
+               (if_primary_term is not None and existing.primary_term != if_primary_term):
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
+                    f"primary term [{if_primary_term}], current document has "
+                    f"seqNo [{existing.seq_no}] and primary term [{existing.primary_term}]")
+        if version_type == "external" and version is not None:
+            current = 0 if existing is None or existing.deleted else existing.version
+            if version <= current:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, current version [{current}] is higher "
+                    f"or equal to the one provided [{version}]")
+        elif version is not None and version_type == "internal":
+            current = None if existing is None or existing.deleted else existing.version
+            if current != version:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, current version [{current}] is "
+                    f"different than the one provided [{version}]")
+
+    def _tombstone(self, row: int) -> None:
+        for seg in self.segments:
+            if seg.base <= row < seg.base + seg.num_docs:
+                self.deleted_rows.setdefault(seg.seg_id, set()).add(row - seg.base)
+                return
+        builder = self._builder
+        if builder is not None and builder.base <= row < builder.base + builder.num_docs:
+            # tombstone applies when the builder seals
+            self.deleted_rows.setdefault(builder.seg_id, set()).add(row - builder.base)
+
+    def _get_builder(self) -> SegmentBuilder:
+        if self._builder is None:
+            self._builder = SegmentBuilder(self._next_seg_id, self._next_row)
+            self._next_seg_id += 1
+        return self._builder
+
+    # ------------------------------------------------------------------- read
+    def get(self, doc_id: str, realtime: bool = True) -> Optional[dict]:
+        """Realtime GET (reference: ShardGetService — reads through the
+        version map / translog without waiting for refresh)."""
+        with self._lock:
+            vv = self.version_map.get(doc_id)
+            if vv is None or vv.deleted:
+                return None
+            if not realtime:
+                reader = self.acquire_searcher()
+                src = reader.get_source(vv.row)
+                return None if src is None else {
+                    "_id": doc_id, "_version": vv.version, "_seq_no": vv.seq_no,
+                    "_primary_term": vv.primary_term, "_source": src, "_row": vv.row}
+            src = self._source_of_row(vv.row)
+            if src is None:
+                return None
+            return {"_id": doc_id, "_version": vv.version, "_seq_no": vv.seq_no,
+                    "_primary_term": vv.primary_term, "_source": src, "_row": vv.row}
+
+    def _source_of_row(self, row: int) -> Optional[dict]:
+        for seg in self.segments:
+            if seg.base <= row < seg.base + seg.num_docs:
+                return seg.sources[row - seg.base]
+        b = self._builder
+        if b is not None and b.base <= row < b.base + b.num_docs:
+            return b._sources[row - b.base]
+        return None
+
+    def refresh(self) -> ShardReader:
+        """Seal the indexing buffer; make recent ops searchable (NRT refresh)."""
+        with self._lock:
+            if self._builder is not None and self._builder.num_docs > 0:
+                self.segments.append(self._builder.seal())
+                self._builder = None
+            views = [SegmentView(seg, self.deleted_rows.get(seg.seg_id))
+                     for seg in self.segments]
+            self._reader = ShardReader(views)
+            for listener in self._refresh_listeners:
+                listener(self._reader)
+            return self._reader
+
+    def acquire_searcher(self) -> ShardReader:
+        with self._lock:
+            if self._reader is None:
+                self.refresh()
+            return self._reader
+
+    def add_refresh_listener(self, listener: Callable[[ShardReader], None]) -> None:
+        self._refresh_listeners.append(listener)
+
+    # ------------------------------------------------------------- durability
+    def flush(self) -> None:
+        """Commit: persist segments + metadata, trim translog (Lucene commit)."""
+        with self._lock:
+            self.refresh()
+            commit = {
+                "local_checkpoint": self.tracker.checkpoint,
+                "max_seq_no": self.tracker.max_seq_no,
+                "primary_term": self.primary_term,
+                "next_row": self._next_row,
+                "next_seg_id": self._next_seg_id,
+            }
+            tmp = os.path.join(self.path, "commit.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump({
+                    "segments": self.segments,
+                    "deleted_rows": self.deleted_rows,
+                    "version_map": self.version_map,
+                    "meta": commit,
+                }, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.path, "commit.bin"))
+            with open(os.path.join(self.path, "commit.json"), "w") as f:
+                json.dump(commit, f)
+            self.translog.roll_generation()
+            self.translog.trim_below(self.translog.generation)
+
+    def _load_commit(self) -> None:
+        path = os.path.join(self.path, "commit.bin")
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        self.segments = data["segments"]
+        self.deleted_rows = data["deleted_rows"]
+        self.version_map = data["version_map"]
+        meta = data["meta"]
+        self._next_row = meta["next_row"]
+        self._next_seg_id = meta["next_seg_id"]
+        self.tracker = LocalCheckpointTracker(meta["max_seq_no"], meta["local_checkpoint"])
+
+    def _recover_from_translog(self) -> None:
+        """Replay translog ops above the last commit's checkpoint."""
+        from_seq = self.tracker.checkpoint + 1
+        for op in self.translog.read_ops(from_seq):
+            kind = op.get("op")
+            if kind == OP_INDEX:
+                self.index(op["id"], op["source"], seq_no=op["seq_no"],
+                           primary_term=op.get("primary_term"),
+                           version=op.get("version"), origin="replica")
+            elif kind == OP_DELETE:
+                try:
+                    self.delete(op["id"], seq_no=op["seq_no"],
+                                primary_term=op.get("primary_term"),
+                                version=op.get("version"), origin="replica")
+                except DocumentMissingError:
+                    pass
+            elif kind == OP_NOOP:
+                self.tracker.mark_processed(op["seq_no"])
+
+    # ---------------------------------------------------------------- merging
+    def merge(self) -> None:
+        """Compact all sealed segments into one, dropping tombstoned docs.
+
+        The analog of a Lucene force-merge; rows are reassigned, so the
+        device vector store must re-ingest after a merge (same contract as
+        the reference rebuilding doc ids on merge).
+        """
+        with self._lock:
+            self.refresh()
+            if len(self.segments) <= 1 and not any(self.deleted_rows.values()):
+                return
+            builder = SegmentBuilder(self._next_seg_id, self._next_row)
+            self._next_seg_id += 1
+            reader = self._reader
+            new_map: Dict[str, VersionValue] = {}
+            for view in reader.views:
+                seg = view.segment
+                for local in range(seg.num_docs):
+                    if not view.live[local]:
+                        continue
+                    doc_id = seg.ids[local]
+                    vv = self.version_map.get(doc_id)
+                    if vv is None or vv.deleted or vv.row != seg.base + local:
+                        continue
+                    parsed = self.mapper_service.parse_document(doc_id, seg.sources[local])
+                    new_local = builder.add(parsed, int(seg.seq_nos[local]))
+                    new_map[doc_id] = vv._replace(row=builder.base + new_local)
+            self._next_row = builder.base + builder.num_docs
+            self.segments = [builder.seal()] if builder.num_docs else []
+            self.deleted_rows = {}
+            for doc_id, vv in self.version_map.items():
+                if vv.deleted:
+                    new_map.setdefault(doc_id, vv)
+            self.version_map = new_map
+            self.refresh()
+
+    # ------------------------------------------------------------------ stats
+    @property
+    def local_checkpoint(self) -> int:
+        return self.tracker.checkpoint
+
+    @property
+    def max_seq_no(self) -> int:
+        return self.tracker.max_seq_no
+
+    def doc_count(self) -> int:
+        return sum(1 for v in self.version_map.values() if not v.deleted)
+
+    def close(self) -> None:
+        with self._lock:
+            self.translog.close()

@@ -1,0 +1,542 @@
+"""Mappings: field types, document parsing, dynamic mapping.
+
+Re-design of the reference's mapper layer (`index/mapper/` — MapperService,
+DocumentMapper, DocumentParser, FieldMapper subclasses; SURVEY.md §2.4).
+A mapping is a tree of typed field definitions; parsing a JSON document
+produces a `ParsedDocument`: analyzed terms for the inverted index, typed
+values for doc-values columns, dense vectors for the device matrix, and the
+stored `_source`.
+
+Field types covered: text, keyword, long/integer/short/byte, double/float/
+half_float, boolean, date, ip, geo_point, dense_vector, object, nested
+(stored flattened with nested paths), plus dynamic inference for unmapped
+fields (reference `DynamicTemplates`/`DocumentParser.parseDynamicValue`).
+
+dense_vector follows `x-pack/plugin/vectors/.../DenseVectorFieldMapper.java:45`
+semantics: fixed `dims`, float array values, one vector per doc — but the
+2048-dim cap is lifted (the TPU path has no BinaryDocValues encoding limit)
+and a `similarity` parameter selects the device metric at index time.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress
+import math
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, MapperParsingError
+from elasticsearch_tpu.index.analysis import AnalysisRegistry, DEFAULT_REGISTRY
+
+# ---------------------------------------------------------------------------
+# Parsed output containers
+# ---------------------------------------------------------------------------
+
+
+class ParsedDocument:
+    """Everything the engine needs to index one document."""
+
+    __slots__ = ("doc_id", "source", "terms", "term_positions", "doc_values",
+                 "vectors", "field_lengths", "dynamic_updates")
+
+    def __init__(self, doc_id: str, source: dict):
+        self.doc_id = doc_id
+        self.source = source
+        # field -> list of terms (with duplicates, for tf)
+        self.terms: Dict[str, List[str]] = {}
+        # field -> term -> positions list
+        self.term_positions: Dict[str, Dict[str, List[int]]] = {}
+        # field -> scalar or list (kept typed: int/float/str/bool)
+        self.doc_values: Dict[str, Any] = {}
+        # field -> np.ndarray[dims] f32
+        self.vectors: Dict[str, np.ndarray] = {}
+        # field -> token count (for BM25 norms)
+        self.field_lengths: Dict[str, int] = {}
+        # mapping updates triggered by dynamic fields (field path -> mapper def)
+        self.dynamic_updates: Dict[str, dict] = {}
+
+
+# ---------------------------------------------------------------------------
+# Field mappers
+# ---------------------------------------------------------------------------
+
+EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+_DATE_PATTERNS = (
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%dT%H:%M:%S.%f",
+    "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%Y/%m/%d",
+)
+
+
+def parse_date_millis(value: Any) -> int:
+    """Parse a date into epoch millis (reference: DateFieldMapper, strict_date_optional_time||epoch_millis)."""
+    if isinstance(value, bool):
+        raise MapperParsingError(f"cannot parse date from boolean [{value}]")
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip()
+    if re.fullmatch(r"-?\d{10,}", s):
+        return int(s)
+    norm = s.replace("Z", "+0000")
+    if re.search(r"[+-]\d{2}:\d{2}$", norm):
+        norm = norm[:-3] + norm[-2:]
+    for pat in _DATE_PATTERNS:
+        try:
+            dt = _dt.datetime.strptime(norm, pat)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    raise MapperParsingError(f"failed to parse date field [{value}]")
+
+
+class FieldMapper:
+    type_name = "base"
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        self.name = name
+        self.params = dict(params or {})
+
+    # returns list of index terms; default: none
+    def index_terms(self, value: Any) -> List[str]:
+        return []
+
+    # returns the doc-values representation (comparable/sortable), or None
+    def doc_value(self, value: Any) -> Any:
+        return None
+
+    def to_def(self) -> dict:
+        d = {"type": self.type_name}
+        d.update(self.params)
+        return d
+
+
+class KeywordFieldMapper(FieldMapper):
+    type_name = "keyword"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.ignore_above = self.params.get("ignore_above")
+
+    def index_terms(self, value):
+        s = str(value)
+        if self.ignore_above is not None and len(s) > self.ignore_above:
+            return []
+        return [s]
+
+    def doc_value(self, value):
+        return str(value)
+
+
+class TextFieldMapper(FieldMapper):
+    type_name = "text"
+
+    def __init__(self, name, params=None, registry: AnalysisRegistry = DEFAULT_REGISTRY):
+        super().__init__(name, params)
+        self.analyzer = registry.get(self.params.get("analyzer", "standard"))
+        self.search_analyzer = registry.get(
+            self.params.get("search_analyzer", self.params.get("analyzer", "standard")))
+
+    def analyze(self, value) -> List[str]:
+        return self.analyzer.terms(str(value))
+
+    def analyze_positions(self, value):
+        return self.analyzer.analyze(str(value))
+
+    def index_terms(self, value):
+        return self.analyze(value)
+
+    def doc_value(self, value):
+        return None  # text has no doc_values (reference: fielddata disabled by default)
+
+
+class _NumericMapper(FieldMapper):
+    py_type = float
+
+    def coerce(self, value: Any):
+        if isinstance(value, bool):
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type [{self.type_name}]: boolean")
+        try:
+            v = self.py_type(value)
+        except (TypeError, ValueError):
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type [{self.type_name}] value [{value}]")
+        if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+            raise MapperParsingError(f"[{self.name}] non-finite value [{value}]")
+        return v
+
+    def index_terms(self, value):
+        return [repr(self.coerce(value))]
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class LongFieldMapper(_NumericMapper):
+    type_name = "long"
+    py_type = int
+
+
+class IntegerFieldMapper(LongFieldMapper):
+    type_name = "integer"
+
+
+class ShortFieldMapper(LongFieldMapper):
+    type_name = "short"
+
+
+class ByteFieldMapper(LongFieldMapper):
+    type_name = "byte"
+
+
+class DoubleFieldMapper(_NumericMapper):
+    type_name = "double"
+    py_type = float
+
+
+class FloatFieldMapper(DoubleFieldMapper):
+    type_name = "float"
+
+
+class HalfFloatFieldMapper(DoubleFieldMapper):
+    type_name = "half_float"
+
+
+class ScaledFloatFieldMapper(_NumericMapper):
+    type_name = "scaled_float"
+    py_type = float
+
+    def doc_value(self, value):
+        factor = self.params.get("scaling_factor", 100)
+        return round(self.coerce(value) * factor) / factor
+
+
+class BooleanFieldMapper(FieldMapper):
+    type_name = "boolean"
+
+    def coerce(self, value):
+        if isinstance(value, bool):
+            return value
+        if value in ("true", "True"):
+            return True
+        if value in ("false", "False", ""):
+            return False
+        raise MapperParsingError(f"failed to parse boolean field [{self.name}] value [{value}]")
+
+    def index_terms(self, value):
+        return ["T" if self.coerce(value) else "F"]
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class DateFieldMapper(FieldMapper):
+    type_name = "date"
+
+    def index_terms(self, value):
+        return [str(parse_date_millis(value))]
+
+    def doc_value(self, value):
+        return parse_date_millis(value)
+
+
+class IpFieldMapper(FieldMapper):
+    type_name = "ip"
+
+    def coerce(self, value) -> int:
+        try:
+            return int(ipaddress.ip_address(str(value)))
+        except ValueError:
+            raise MapperParsingError(f"failed to parse IP [{value}] for field [{self.name}]")
+
+    def index_terms(self, value):
+        return [str(self.coerce(value))]
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class GeoPointFieldMapper(FieldMapper):
+    type_name = "geo_point"
+
+    def coerce(self, value) -> Tuple[float, float]:
+        """Returns (lat, lon)."""
+        if isinstance(value, dict):
+            try:
+                return float(value["lat"]), float(value["lon"])
+            except (KeyError, TypeError, ValueError):
+                raise MapperParsingError(f"failed to parse geo_point [{value}]")
+        if isinstance(value, (list, tuple)) and len(value) == 2:
+            return float(value[1]), float(value[0])  # [lon, lat] GeoJSON order
+        if isinstance(value, str):
+            parts = value.split(",")
+            if len(parts) == 2:
+                return float(parts[0]), float(parts[1])
+        raise MapperParsingError(f"failed to parse geo_point [{value}]")
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class DenseVectorFieldMapper(FieldMapper):
+    """`dense_vector` (reference: DenseVectorFieldMapper.java:45).
+
+    params: dims (required), similarity (cosine|dot_product|l2_norm,
+    default cosine), index_options.type (flat|int8_flat — storage dtype of
+    the device matrix).
+    """
+
+    type_name = "dense_vector"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.dims = self.params.get("dims")
+        if self.dims is None:
+            raise MapperParsingError(f"[{name}] dense_vector requires [dims]")
+        self.dims = int(self.dims)
+        self.similarity = self.params.get("similarity", "cosine")
+        if self.similarity not in ("cosine", "dot_product", "l2_norm", "max_inner_product"):
+            raise MapperParsingError(f"[{name}] unknown similarity [{self.similarity}]")
+
+    def coerce(self, value) -> np.ndarray:
+        if not isinstance(value, (list, tuple)):
+            raise MapperParsingError(f"[{self.name}] dense_vector value must be an array")
+        arr = np.asarray(value, dtype=np.float32)
+        if arr.ndim != 1 or arr.shape[0] != self.dims:
+            raise MapperParsingError(
+                f"[{self.name}] vector has [{arr.shape[0] if arr.ndim == 1 else '?'}] "
+                f"dimensions, mapping requires [{self.dims}]")
+        if not np.isfinite(arr).all():
+            raise MapperParsingError(f"[{self.name}] vector contains non-finite values")
+        return arr
+
+
+class ObjectMapper(FieldMapper):
+    type_name = "object"
+
+
+class NestedMapper(FieldMapper):
+    type_name = "nested"
+
+
+FIELD_TYPES = {
+    m.type_name: m
+    for m in (KeywordFieldMapper, TextFieldMapper, LongFieldMapper, IntegerFieldMapper,
+              ShortFieldMapper, ByteFieldMapper, DoubleFieldMapper, FloatFieldMapper,
+              HalfFloatFieldMapper, ScaledFloatFieldMapper, BooleanFieldMapper,
+              DateFieldMapper, IpFieldMapper, GeoPointFieldMapper,
+              DenseVectorFieldMapper, ObjectMapper, NestedMapper)
+}
+
+
+def build_mapper(name: str, definition: dict) -> FieldMapper:
+    t = definition.get("type", "object" if "properties" in definition else None)
+    if t is None:
+        raise MapperParsingError(f"no type specified for field [{name}]")
+    cls = FIELD_TYPES.get(t)
+    if cls is None:
+        raise MapperParsingError(f"No handler for type [{t}] declared on field [{name}]")
+    params = {k: v for k, v in definition.items() if k not in ("type", "properties", "fields")}
+    return cls(name, params)
+
+
+# ---------------------------------------------------------------------------
+# MapperService / DocumentMapper
+# ---------------------------------------------------------------------------
+
+class MapperService:
+    """Holds the (mutable, additive-only) mapping for one index.
+
+    Reference: MapperService.java — mappings merge additively; changing an
+    existing field's type is rejected.
+    """
+
+    def __init__(self, mapping: Optional[dict] = None, dynamic: bool = True):
+        # flat map "a.b.c" -> FieldMapper
+        self._mappers: Dict[str, FieldMapper] = {}
+        # fields with subfields (multi-fields), e.g. text with .keyword
+        self._multi_fields: Dict[str, Dict[str, FieldMapper]] = {}
+        self.dynamic = dynamic
+        self._meta: dict = {}
+        if mapping:
+            self.merge(mapping)
+
+    # -- mapping CRUD --------------------------------------------------------
+    def merge(self, mapping: dict) -> None:
+        props = mapping.get("properties", mapping if "properties" not in mapping else {})
+        if "dynamic" in mapping:
+            dyn = mapping["dynamic"]
+            self.dynamic = dyn if isinstance(dyn, bool) else dyn == "true"
+        if "_meta" in mapping:
+            self._meta = mapping["_meta"]
+        self._merge_props(props, prefix="")
+
+    def _merge_props(self, props: dict, prefix: str) -> None:
+        for name, definition in props.items():
+            if not isinstance(definition, dict):
+                raise MapperParsingError(f"invalid mapping definition for [{prefix}{name}]")
+            path = f"{prefix}{name}"
+            if "properties" in definition:
+                self._merge_props(definition["properties"], prefix=path + ".")
+                if definition.get("type") == "nested":
+                    self._put(path, NestedMapper(path, {}))
+                continue
+            mapper = build_mapper(path, definition)
+            self._put(path, mapper)
+            for sub_name, sub_def in (definition.get("fields") or {}).items():
+                sub_path = f"{path}.{sub_name}"
+                sub = build_mapper(sub_path, sub_def)
+                self._multi_fields.setdefault(path, {})[sub_name] = sub
+                self._put(sub_path, sub)
+
+    def _put(self, path: str, mapper: FieldMapper) -> None:
+        existing = self._mappers.get(path)
+        if existing is not None and existing.type_name != mapper.type_name:
+            raise IllegalArgumentError(
+                f"mapper [{path}] cannot be changed from type [{existing.type_name}] "
+                f"to [{mapper.type_name}]")
+        self._mappers[path] = mapper
+
+    def get(self, path: str) -> Optional[FieldMapper]:
+        return self._mappers.get(path)
+
+    def field_names(self) -> List[str]:
+        return sorted(self._mappers)
+
+    def vector_fields(self) -> Dict[str, DenseVectorFieldMapper]:
+        return {p: m for p, m in self._mappers.items()
+                if isinstance(m, DenseVectorFieldMapper)}
+
+    def to_dict(self) -> dict:
+        """Render back to the API mapping shape (GET /index/_mapping)."""
+        root: dict = {}
+        for path in sorted(self._mappers):
+            mapper = self._mappers[path]
+            if isinstance(mapper, (ObjectMapper,)):
+                continue
+            parts = path.split(".")
+            # multi-fields render under "fields", not "properties"
+            parent = ".".join(parts[:-1])
+            if parent in self._multi_fields and parts[-1] in self._multi_fields[parent]:
+                continue
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault("properties", {}).setdefault(p, {})
+            leaf = node.setdefault("properties", {}).setdefault(parts[-1], {})
+            leaf.update(mapper.to_def())
+            if path in self._multi_fields:
+                leaf["fields"] = {sub: m.to_def()
+                                  for sub, m in self._multi_fields[path].items()}
+        out = {"properties": root.get("properties", {})}
+        if self._meta:
+            out["_meta"] = self._meta
+        return out
+
+    # -- document parsing ----------------------------------------------------
+    def parse_document(self, doc_id: str, source: dict) -> ParsedDocument:
+        """Parse a source document (reference: DocumentParser.parseDocument)."""
+        if not isinstance(source, dict):
+            raise MapperParsingError("document source must be an object")
+        parsed = ParsedDocument(doc_id, source)
+        self._parse_object(source, "", parsed)
+        return parsed
+
+    def _parse_object(self, obj: dict, prefix: str, parsed: ParsedDocument) -> None:
+        for key, value in obj.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, dict) and self.get(path) is None or (
+                    isinstance(value, dict) and isinstance(self.get(path), (ObjectMapper, NestedMapper))):
+                self._parse_object(value, path + ".", parsed)
+                continue
+            if isinstance(value, dict) and isinstance(self.get(path), GeoPointFieldMapper):
+                self._parse_field(path, value, parsed)
+                continue
+            if isinstance(value, list) and value and isinstance(value[0], dict):
+                # array of objects (nested docs stored flattened)
+                for item in value:
+                    if isinstance(item, dict):
+                        self._parse_object(item, path + ".", parsed)
+                continue
+            self._parse_field(path, value, parsed)
+
+    def _parse_field(self, path: str, value: Any, parsed: ParsedDocument) -> None:
+        mapper = self.get(path)
+        if mapper is None:
+            if value is None:
+                return
+            if not self.dynamic:
+                return  # dynamic:false — unmapped fields not indexed, still in _source
+            mapper = self._dynamic_mapper(path, value)
+            if mapper is None:
+                return
+            self._put(path, mapper)
+            parsed.dynamic_updates[path] = mapper.to_def()
+            # dynamic strings get the reference's default text + .keyword multi-field
+            if isinstance(mapper, TextFieldMapper):
+                kw = KeywordFieldMapper(f"{path}.keyword", {"ignore_above": 256})
+                self._multi_fields.setdefault(path, {})["keyword"] = kw
+                self._put(f"{path}.keyword", kw)
+
+        # dense_vector: the array IS the single value, not multi-values
+        if isinstance(mapper, DenseVectorFieldMapper):
+            values = [value]
+        else:
+            values = value if isinstance(value, list) else [value]
+        for v in values:
+            if v is None:
+                continue
+            self._index_one(path, mapper, v, parsed)
+            for sub_name, sub in self._multi_fields.get(path, {}).items():
+                self._index_one(f"{path}.{sub_name}", sub, v, parsed)
+
+    def _index_one(self, path: str, mapper: FieldMapper, v: Any, parsed: ParsedDocument) -> None:
+        if isinstance(mapper, DenseVectorFieldMapper):
+            if path in parsed.vectors:
+                raise MapperParsingError(f"[{path}] only one vector per document")
+            parsed.vectors[path] = mapper.coerce(v)
+            return
+        if isinstance(mapper, TextFieldMapper):
+            tokens = mapper.analyze_positions(v)
+            bucket = parsed.terms.setdefault(path, [])
+            pos_map = parsed.term_positions.setdefault(path, {})
+            base = parsed.field_lengths.get(path, 0)
+            for t in tokens:
+                bucket.append(t.term)
+                pos_map.setdefault(t.term, []).append(base + t.position)
+            parsed.field_lengths[path] = base + len(tokens)
+            return
+        terms = mapper.index_terms(v)
+        if terms:
+            parsed.terms.setdefault(path, []).extend(terms)
+        dv = mapper.doc_value(v)
+        if dv is not None:
+            existing = parsed.doc_values.get(path)
+            if existing is None:
+                parsed.doc_values[path] = dv
+            elif isinstance(existing, list):
+                existing.append(dv)
+            else:
+                parsed.doc_values[path] = [existing, dv]
+
+    def _dynamic_mapper(self, path: str, value: Any) -> Optional[FieldMapper]:
+        probe = value[0] if isinstance(value, list) and value else value
+        if isinstance(probe, bool):
+            return BooleanFieldMapper(path, {})
+        if isinstance(probe, int):
+            return LongFieldMapper(path, {})
+        if isinstance(probe, float):
+            return FloatFieldMapper(path, {})
+        if isinstance(probe, str):
+            try:
+                parse_date_millis(probe) if re.match(r"\d{4}-\d{2}-\d{2}", probe) else None
+            except MapperParsingError:
+                pass
+            else:
+                if re.match(r"\d{4}-\d{2}-\d{2}", probe):
+                    return DateFieldMapper(path, {})
+            return TextFieldMapper(path, {})
+        return None

@@ -1,0 +1,295 @@
+"""Segment storage: inverted index, doc values, stored fields, vectors.
+
+Plays the role Lucene's segment files play under the reference's engine
+(`index/engine/InternalEngine.java` writes via IndexWriter; segments are
+immutable, deletes are tombstones, merges compact). Re-designed for the TPU
+stack:
+
+- postings are numpy arrays (doc ids ascending, freqs parallel) so BM25
+  scoring vectorizes on host and can batch to the device;
+- doc values are columnar numpy arrays (numerics) / ordinal-encoded string
+  columns, feeding sorts and aggregations;
+- each segment's dense-vector columns are contiguous [num_docs, dims] f32
+  blocks — exactly the shape the device corpus ingests at refresh.
+
+A `SegmentBuilder` accumulates the in-memory indexing buffer; `seal()`
+freezes it into an immutable `Segment` (the analog of a Lucene flush making
+an NRT reader visible). `ShardReader` is a point-in-time view over sealed
+segments + tombstone bitmaps (the analog of acquiring an IndexSearcher).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+
+class DocValuesColumn:
+    """Columnar per-doc values for one field within one segment.
+
+    values: object array (None = missing); for numerics additionally a
+    float64 view + presence mask for vectorized math.
+    """
+
+    __slots__ = ("values", "numeric", "present")
+
+    def __init__(self, values: List[Any]):
+        self.values = values
+        first = next((v for v in values if v is not None), None)
+        if isinstance(first, (int, float)) and not isinstance(first, bool):
+            arr = np.zeros(len(values), dtype=np.float64)
+            present = np.zeros(len(values), dtype=bool)
+            for i, v in enumerate(values):
+                if v is None:
+                    continue
+                if isinstance(v, list):
+                    arr[i] = float(v[0]) if v else 0.0
+                    present[i] = bool(v)
+                else:
+                    arr[i] = float(v)
+                    present[i] = True
+            self.numeric = arr
+            self.present = present
+        else:
+            self.numeric = None
+            self.present = np.asarray([v is not None for v in values], dtype=bool)
+
+    def get(self, local_doc: int) -> Any:
+        return self.values[local_doc]
+
+
+class Postings:
+    """Term postings within one segment: ascending local doc ids + freqs."""
+
+    __slots__ = ("doc_ids", "freqs", "positions")
+
+    def __init__(self, doc_ids: np.ndarray, freqs: np.ndarray,
+                 positions: Optional[List[List[int]]] = None):
+        self.doc_ids = doc_ids
+        self.freqs = freqs
+        self.positions = positions
+
+    @property
+    def doc_freq(self) -> int:
+        return len(self.doc_ids)
+
+
+class Segment:
+    """Immutable sealed segment."""
+
+    __slots__ = ("seg_id", "base", "num_docs", "postings", "field_lengths",
+                 "total_terms", "doc_values", "vectors", "ids", "sources",
+                 "seq_nos")
+
+    def __init__(self, seg_id: int, base: int, num_docs: int,
+                 postings: Dict[str, Dict[str, Postings]],
+                 field_lengths: Dict[str, np.ndarray],
+                 total_terms: Dict[str, int],
+                 doc_values: Dict[str, DocValuesColumn],
+                 vectors: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                 ids: List[str], sources: List[dict], seq_nos: np.ndarray):
+        self.seg_id = seg_id
+        self.base = base          # global row id of local doc 0
+        self.num_docs = num_docs
+        self.postings = postings  # field -> term -> Postings
+        self.field_lengths = field_lengths  # field -> int32[num_docs]
+        self.total_terms = total_terms      # field -> sum of lengths
+        self.doc_values = doc_values        # field -> DocValuesColumn
+        self.vectors = vectors              # field -> (matrix [n,d] f32, present bool[n])
+        self.ids = ids                      # local doc -> _id
+        self.sources = sources              # local doc -> source dict
+        self.seq_nos = seq_nos              # local doc -> seq_no
+
+    def get_postings(self, field: str, term: str) -> Optional[Postings]:
+        f = self.postings.get(field)
+        return f.get(term) if f else None
+
+    def terms_of(self, field: str) -> Iterable[str]:
+        return self.postings.get(field, {}).keys()
+
+
+class SegmentBuilder:
+    """In-memory indexing buffer (the analog of Lucene's IndexWriter RAM buffer)."""
+
+    def __init__(self, seg_id: int, base: int):
+        self.seg_id = seg_id
+        self.base = base
+        self._postings: Dict[str, Dict[str, List[Tuple[int, int, Optional[List[int]]]]]] = {}
+        self._field_lengths: Dict[str, Dict[int, int]] = {}
+        self._doc_values: Dict[str, Dict[int, Any]] = {}
+        self._vectors: Dict[str, Dict[int, np.ndarray]] = {}
+        self._ids: List[str] = []
+        self._sources: List[dict] = []
+        self._seq_nos: List[int] = []
+
+    @property
+    def num_docs(self) -> int:
+        return len(self._ids)
+
+    def add(self, parsed, seq_no: int) -> int:
+        """Add a parsed document; returns its local doc id."""
+        local = len(self._ids)
+        self._ids.append(parsed.doc_id)
+        self._sources.append(parsed.source)
+        self._seq_nos.append(seq_no)
+
+        for field, terms in parsed.terms.items():
+            fp = self._postings.setdefault(field, {})
+            counts: Dict[str, int] = {}
+            for t in terms:
+                counts[t] = counts.get(t, 0) + 1
+            pos_map = parsed.term_positions.get(field, {})
+            for term, freq in counts.items():
+                fp.setdefault(term, []).append((local, freq, pos_map.get(term)))
+
+        for field, length in parsed.field_lengths.items():
+            self._field_lengths.setdefault(field, {})[local] = length
+
+        for field, value in parsed.doc_values.items():
+            self._doc_values.setdefault(field, {})[local] = value
+
+        for field, vec in parsed.vectors.items():
+            self._vectors.setdefault(field, {})[local] = vec
+
+        return local
+
+    def seal(self) -> Segment:
+        n = self.num_docs
+        postings: Dict[str, Dict[str, Postings]] = {}
+        for field, terms in self._postings.items():
+            out: Dict[str, Postings] = {}
+            for term, entries in terms.items():
+                entries.sort(key=lambda e: e[0])
+                doc_ids = np.asarray([e[0] for e in entries], dtype=np.int32)
+                freqs = np.asarray([e[1] for e in entries], dtype=np.int32)
+                positions = [e[2] for e in entries] if any(e[2] for e in entries) else None
+                out[term] = Postings(doc_ids, freqs, positions)
+            postings[field] = out
+
+        field_lengths = {}
+        total_terms = {}
+        for field, lengths in self._field_lengths.items():
+            arr = np.zeros(n, dtype=np.int32)
+            for local, length in lengths.items():
+                arr[local] = length
+            field_lengths[field] = arr
+            total_terms[field] = int(arr.sum())
+
+        doc_values = {}
+        for field, vals in self._doc_values.items():
+            col = [vals.get(i) for i in range(n)]
+            doc_values[field] = DocValuesColumn(col)
+
+        vectors = {}
+        for field, vecs in self._vectors.items():
+            dims = len(next(iter(vecs.values())))
+            mat = np.zeros((n, dims), dtype=np.float32)
+            present = np.zeros(n, dtype=bool)
+            for local, v in vecs.items():
+                mat[local] = v
+                present[local] = True
+            vectors[field] = (mat, present)
+
+        return Segment(self.seg_id, self.base, n, postings, field_lengths,
+                       total_terms, doc_values, vectors, list(self._ids),
+                       list(self._sources), np.asarray(self._seq_nos, dtype=np.int64))
+
+
+class SegmentView:
+    """One segment + its tombstone bitmap inside a point-in-time reader."""
+
+    __slots__ = ("segment", "live")
+
+    def __init__(self, segment: Segment, deleted_locals: Optional[set] = None):
+        self.segment = segment
+        live = np.ones(segment.num_docs, dtype=bool)
+        if deleted_locals:
+            live[list(deleted_locals)] = False
+        self.live = live
+
+    @property
+    def live_count(self) -> int:
+        return int(self.live.sum())
+
+
+class ShardReader:
+    """Point-in-time searcher view over sealed segments.
+
+    The analog of the reference engine's `acquireSearcher`
+    (`InternalEngine.java` / `ContextIndexSearcher.java:73`): immutable
+    snapshot; concurrent writes/deletes after acquisition are invisible.
+    """
+
+    def __init__(self, views: List[SegmentView]):
+        self.views = views
+
+    @property
+    def num_docs(self) -> int:
+        return sum(v.live_count for v in self.views)
+
+    @property
+    def max_doc(self) -> int:
+        return sum(v.segment.num_docs for v in self.views)
+
+    def doc_freq(self, field: str, term: str) -> int:
+        total = 0
+        for v in self.views:
+            p = v.segment.get_postings(field, term)
+            if p is not None:
+                # count only live postings
+                total += int(v.live[p.doc_ids].sum())
+        return total
+
+    def total_term_count(self, field: str) -> int:
+        return sum(v.segment.total_terms.get(field, 0) for v in self.views)
+
+    def docs_with_field_count(self, field: str) -> int:
+        total = 0
+        for v in self.views:
+            fl = v.segment.field_lengths.get(field)
+            if fl is not None:
+                total += int((v.live & (fl > 0)).sum())
+            else:
+                dv = v.segment.doc_values.get(field)
+                if dv is not None:
+                    total += int((v.live & dv.present).sum())
+        return total
+
+    def avg_field_length(self, field: str) -> float:
+        docs = self.docs_with_field_count(field)
+        if docs == 0:
+            return 0.0
+        return self.total_term_count(field) / docs
+
+    # -- global row helpers ---------------------------------------------------
+    def resolve(self, global_row: int) -> Optional[Tuple[SegmentView, int]]:
+        for v in self.views:
+            if v.segment.base <= global_row < v.segment.base + v.segment.num_docs:
+                return v, global_row - v.segment.base
+        return None
+
+    def get_id(self, global_row: int) -> Optional[str]:
+        hit = self.resolve(global_row)
+        return hit[0].segment.ids[hit[1]] if hit else None
+
+    def get_source(self, global_row: int) -> Optional[dict]:
+        hit = self.resolve(global_row)
+        return hit[0].segment.sources[hit[1]] if hit else None
+
+    def get_doc_value(self, field: str, global_row: int) -> Any:
+        hit = self.resolve(global_row)
+        if hit is None:
+            return None
+        view, local = hit
+        col = view.segment.doc_values.get(field)
+        return col.get(local) if col else None
+
+    def live_global_rows(self) -> np.ndarray:
+        parts = []
+        for v in self.views:
+            rows = np.nonzero(v.live)[0] + v.segment.base
+            parts.append(rows)
+        if not parts:
+            return np.zeros(0, dtype=np.int64)
+        return np.concatenate(parts)

@@ -1,0 +1,187 @@
+"""Sequence numbers: local checkpoints, global checkpoints, retention leases.
+
+Re-design of `index/seqno/` (SURVEY.md §2.4):
+
+- `LocalCheckpointTracker` (`LocalCheckpointTracker.java`): tracks which
+  seq_nos have been processed and advances the contiguous-acknowledgement
+  checkpoint.
+- `ReplicationTracker` (`ReplicationTracker.java:79`): primary-side view of
+  all copies — in-sync set, per-copy local checkpoints, the global
+  checkpoint (min over in-sync copies, `:996`), and retention leases
+  (`:308,390`) pinning operation history for ops-based recovery.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, SearchEngineError
+
+NO_OPS_PERFORMED = -1
+UNASSIGNED_SEQ_NO = -2
+
+
+class LocalCheckpointTracker:
+    def __init__(self, max_seq_no: int = NO_OPS_PERFORMED,
+                 local_checkpoint: int = NO_OPS_PERFORMED):
+        self._processed: Set[int] = set()
+        self.checkpoint = local_checkpoint
+        self.max_seq_no = max_seq_no
+        self._next_seq_no = max_seq_no + 1
+
+    def generate_seq_no(self) -> int:
+        s = self._next_seq_no
+        self._next_seq_no += 1
+        return s
+
+    def advance_max_seq_no(self, seq_no: int) -> None:
+        if seq_no > self.max_seq_no:
+            self.max_seq_no = seq_no
+        if seq_no >= self._next_seq_no:
+            self._next_seq_no = seq_no + 1
+
+    def mark_processed(self, seq_no: int) -> None:
+        self.advance_max_seq_no(seq_no)
+        if seq_no <= self.checkpoint:
+            return
+        self._processed.add(seq_no)
+        while self.checkpoint + 1 in self._processed:
+            self.checkpoint += 1
+            self._processed.remove(self.checkpoint)
+
+    def pending_gaps(self) -> int:
+        return len(self._processed)
+
+
+class RetentionLease:
+    __slots__ = ("lease_id", "retaining_seq_no", "timestamp_ms", "source")
+
+    def __init__(self, lease_id: str, retaining_seq_no: int, source: str,
+                 timestamp_ms: Optional[int] = None):
+        self.lease_id = lease_id
+        self.retaining_seq_no = retaining_seq_no
+        self.source = source
+        self.timestamp_ms = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
+
+    def to_dict(self) -> dict:
+        return {"id": self.lease_id, "retaining_seq_no": self.retaining_seq_no,
+                "timestamp": self.timestamp_ms, "source": self.source}
+
+
+class CheckpointState:
+    __slots__ = ("local_checkpoint", "global_checkpoint", "in_sync", "tracked")
+
+    def __init__(self, local_checkpoint: int = UNASSIGNED_SEQ_NO,
+                 in_sync: bool = False, tracked: bool = False):
+        self.local_checkpoint = local_checkpoint
+        self.global_checkpoint = UNASSIGNED_SEQ_NO
+        self.in_sync = in_sync
+        self.tracked = tracked
+
+
+class ReplicationTracker:
+    """Primary-mode tracker of replication progress across shard copies."""
+
+    def __init__(self, allocation_id: str, retention_lease_expiry_ms: int = 12 * 3600 * 1000):
+        self.allocation_id = allocation_id
+        self.primary_mode = False
+        self.checkpoints: Dict[str, CheckpointState] = {
+            allocation_id: CheckpointState(in_sync=True, tracked=True)
+        }
+        self.global_checkpoint = NO_OPS_PERFORMED
+        self.retention_leases: Dict[str, RetentionLease] = {}
+        self.retention_lease_expiry_ms = retention_lease_expiry_ms
+
+    # -- membership -----------------------------------------------------------
+    def activate_primary_mode(self, local_checkpoint: int) -> None:
+        self.primary_mode = True
+        self.checkpoints[self.allocation_id].local_checkpoint = local_checkpoint
+        self._recompute_global_checkpoint()
+
+    def init_tracking(self, allocation_id: str) -> None:
+        """A new copy starts recovery: tracked but not yet in-sync."""
+        self._assert_primary()
+        if allocation_id not in self.checkpoints:
+            self.checkpoints[allocation_id] = CheckpointState(tracked=True)
+
+    def mark_in_sync(self, allocation_id: str, local_checkpoint: int) -> None:
+        """Recovery finished and the copy caught up (`markAllocationIdAsInSync:119`)."""
+        self._assert_primary()
+        state = self.checkpoints.get(allocation_id)
+        if state is None:
+            raise SearchEngineError(f"unknown allocation [{allocation_id}]")
+        state.local_checkpoint = max(state.local_checkpoint, local_checkpoint)
+        state.in_sync = True
+        self._recompute_global_checkpoint()
+
+    def remove_copy(self, allocation_id: str) -> None:
+        self._assert_primary()
+        if allocation_id == self.allocation_id:
+            raise IllegalArgumentError("cannot remove the primary's own tracking")
+        self.checkpoints.pop(allocation_id, None)
+        self._recompute_global_checkpoint()
+
+    def in_sync_ids(self) -> Set[str]:
+        return {aid for aid, s in self.checkpoints.items() if s.in_sync}
+
+    # -- checkpoints ----------------------------------------------------------
+    def update_local_checkpoint(self, allocation_id: str, checkpoint: int) -> None:
+        state = self.checkpoints.get(allocation_id)
+        if state is None:
+            return
+        if checkpoint > state.local_checkpoint:
+            state.local_checkpoint = checkpoint
+            self._recompute_global_checkpoint()
+
+    def update_global_checkpoint_on_replica(self, checkpoint: int) -> None:
+        if checkpoint > self.global_checkpoint:
+            self.global_checkpoint = checkpoint
+
+    def _recompute_global_checkpoint(self) -> None:
+        in_sync = [s.local_checkpoint for s in self.checkpoints.values() if s.in_sync]
+        if not in_sync or any(c == UNASSIGNED_SEQ_NO for c in in_sync):
+            return
+        new_ckpt = min(in_sync)
+        if new_ckpt > self.global_checkpoint:
+            self.global_checkpoint = new_ckpt
+
+    def _assert_primary(self) -> None:
+        if not self.primary_mode:
+            raise SearchEngineError("tracker is not in primary mode")
+
+    # -- retention leases -----------------------------------------------------
+    def add_retention_lease(self, lease_id: str, retaining_seq_no: int, source: str) -> RetentionLease:
+        self._assert_primary()
+        if lease_id in self.retention_leases:
+            raise IllegalArgumentError(f"retention lease [{lease_id}] already exists")
+        lease = RetentionLease(lease_id, retaining_seq_no, source)
+        self.retention_leases[lease_id] = lease
+        return lease
+
+    def renew_retention_lease(self, lease_id: str, retaining_seq_no: int) -> RetentionLease:
+        self._assert_primary()
+        lease = self.retention_leases.get(lease_id)
+        if lease is None:
+            raise IllegalArgumentError(f"retention lease [{lease_id}] not found")
+        lease.retaining_seq_no = max(lease.retaining_seq_no, retaining_seq_no)
+        lease.timestamp_ms = int(time.time() * 1000)
+        return lease
+
+    def remove_retention_lease(self, lease_id: str) -> None:
+        self._assert_primary()
+        self.retention_leases.pop(lease_id, None)
+
+    def expire_leases(self, now_ms: Optional[int] = None) -> List[str]:
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        expired = [lid for lid, l in self.retention_leases.items()
+                   if now_ms - l.timestamp_ms > self.retention_lease_expiry_ms]
+        for lid in expired:
+            self.retention_leases.pop(lid)
+        return expired
+
+    def min_retained_seq_no(self) -> int:
+        """History below this may be discarded (trim translog / compact)."""
+        if self.retention_leases:
+            return min(l.retaining_seq_no for l in self.retention_leases.values())
+        return self.global_checkpoint + 1

@@ -99,20 +99,10 @@ def _block_scores(queries, matrix, sq_norms, scales, metric: str, precision: str
     cosine queries are unit vectors, so no renormalization happens per block.
     """
     if matrix.dtype == jnp.int8:
-        if precision == "f32":
-            mat = matrix.astype(jnp.float32)
-            dots = jax.lax.dot_general(
-                queries, mat,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ) * scales[None, :]
-        else:
-            dots = jax.lax.dot_general(
-                queries.astype(jnp.bfloat16), matrix.astype(jnp.bfloat16),
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scales[None, :]
+        # upcast the int8 rows, delegate to the one authoritative matmul
+        # (precision policy lives in sim._matmul), de-scale after
+        mat = matrix.astype(jnp.float32 if precision == "f32" else jnp.bfloat16)
+        dots = sim._matmul(queries, mat, precision) * scales[None, :]
         if metric == sim.L2_NORM:
             return sim.l2_raw_from_dots(dots, queries, sq_norms)
         return dots

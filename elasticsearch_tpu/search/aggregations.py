@@ -1,0 +1,781 @@
+"""Aggregations: bucket, metric, and pipeline aggs over candidate rows.
+
+Re-design of `search/aggregations/` (SURVEY.md §2.5, ~45k LoC): instead of
+per-doc collector trees, every aggregation reduces **vectorized** over the
+matching row set (numpy today; the partial-reduction shape is chosen so
+per-shard partials can later batch onto the device and merge cross-shard
+like `InternalAggregation.reduce`).
+
+Buckets carry their row subsets so sub-aggregations recurse naturally.
+Pipeline aggs post-process sibling/parent bucket outputs, mirroring
+`search/aggregations/pipeline/`.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from elasticsearch_tpu.index.mapping import parse_date_millis
+from elasticsearch_tpu.search.queries import SearchContext, parse_query
+
+# ---------------------------------------------------------------------------
+# value source helpers
+# ---------------------------------------------------------------------------
+
+
+def numeric_values(ctx: SearchContext, rows: np.ndarray, field: str,
+                   missing: Optional[float] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """(values float64[], present bool[]) for one field over rows.
+
+    Multi-valued docs contribute their first value here; use all_values for
+    per-value expansion (terms/cardinality need it).
+    """
+    vals = np.full(len(rows), np.nan, dtype=np.float64)
+    present = np.zeros(len(rows), dtype=bool)
+    for i, row in enumerate(rows):
+        v = ctx.reader.get_doc_value(field, int(row))
+        if isinstance(v, list):
+            v = v[0] if v else None
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            v = 1.0 if v else 0.0
+        if isinstance(v, (int, float)):
+            vals[i] = float(v)
+            present[i] = True
+        elif isinstance(v, tuple):  # geo_point
+            continue
+    if missing is not None:
+        vals[~present] = missing
+        present[:] = True
+    return vals, present
+
+
+def all_values(ctx: SearchContext, rows: np.ndarray, field: str) -> List[Tuple[int, Any]]:
+    """[(row_index, value)] expanded over multi-valued fields."""
+    out = []
+    for i, row in enumerate(rows):
+        v = ctx.reader.get_doc_value(field, int(row))
+        if v is None:
+            continue
+        if isinstance(v, list):
+            for item in v:
+                if item is not None:
+                    out.append((i, item))
+        else:
+            out.append((i, v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metric aggregations
+# ---------------------------------------------------------------------------
+
+def _metric_stats(vals: np.ndarray, present: np.ndarray) -> dict:
+    v = vals[present]
+    n = len(v)
+    if n == 0:
+        return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
+    return {"count": int(n), "min": float(v.min()), "max": float(v.max()),
+            "avg": float(v.mean()), "sum": float(v.sum())}
+
+
+def _extended_stats(vals: np.ndarray, present: np.ndarray, sigma: float = 2.0) -> dict:
+    base = _metric_stats(vals, present)
+    v = vals[present]
+    if len(v) == 0:
+        base.update({"sum_of_squares": None, "variance": None, "std_deviation": None,
+                     "std_deviation_bounds": {"upper": None, "lower": None}})
+        return base
+    ss = float((v ** 2).sum())
+    var = float(v.var())
+    std = float(v.std())
+    mean = base["avg"]
+    base.update({
+        "sum_of_squares": ss, "variance": var,
+        "variance_population": var, "variance_sampling":
+            float(v.var(ddof=1)) if len(v) > 1 else 0.0,
+        "std_deviation": std,
+        "std_deviation_bounds": {"upper": mean + sigma * std, "lower": mean - sigma * std},
+    })
+    return base
+
+
+def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) -> Any:
+    field = spec.get("field")
+    missing = spec.get("missing")
+    script = spec.get("script")
+
+    if kind == "top_hits":
+        size = int(spec.get("size", 3))
+        hits = []
+        for row in rows[:size]:
+            hits.append({
+                "_id": ctx.reader.get_id(int(row)),
+                "_source": ctx.reader.get_source(int(row)),
+                "_score": None,
+            })
+        return {"hits": {"total": {"value": len(rows), "relation": "eq"},
+                         "hits": hits}}
+
+    if kind == "value_count":
+        if field is None:
+            return {"value": len(rows)}
+        return {"value": len(all_values(ctx, rows, field))}
+
+    if kind == "cardinality":
+        values = all_values(ctx, rows, field)
+        return {"value": len({_hashable(v) for _, v in values})}
+
+    if script is not None and field is None:
+        from elasticsearch_tpu.search.script_score import Script
+        s = Script(script)
+        vals = s.evaluate(ctx, rows, np.zeros(len(rows), dtype=np.float32)).astype(np.float64)
+        present = np.ones(len(rows), dtype=bool)
+    else:
+        vals, present = numeric_values(ctx, rows, field, missing)
+
+    if kind == "avg":
+        v = vals[present]
+        return {"value": float(v.mean()) if len(v) else None}
+    if kind == "sum":
+        return {"value": float(vals[present].sum())}
+    if kind == "min":
+        v = vals[present]
+        return {"value": float(v.min()) if len(v) else None}
+    if kind == "max":
+        v = vals[present]
+        return {"value": float(v.max()) if len(v) else None}
+    if kind == "stats":
+        return _metric_stats(vals, present)
+    if kind == "extended_stats":
+        return _extended_stats(vals, present, float(spec.get("sigma", 2.0)))
+    if kind == "median_absolute_deviation":
+        v = vals[present]
+        if len(v) == 0:
+            return {"value": None}
+        med = np.median(v)
+        return {"value": float(np.median(np.abs(v - med)))}
+    if kind == "percentiles":
+        pcts = spec.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        v = vals[present]
+        out = {}
+        for p in pcts:
+            out[f"{float(p)}"] = float(np.percentile(v, p)) if len(v) else None
+        return {"values": out}
+    if kind == "percentile_ranks":
+        targets = spec.get("values", [])
+        v = np.sort(vals[present])
+        out = {}
+        for t in targets:
+            if len(v) == 0:
+                out[f"{float(t)}"] = None
+            else:
+                out[f"{float(t)}"] = float(100.0 * np.searchsorted(v, t, side="right") / len(v))
+        return {"values": out}
+    if kind == "weighted_avg":
+        vspec = spec.get("value", {})
+        wspec = spec.get("weight", {})
+        vv, vp = numeric_values(ctx, rows, vspec.get("field"), vspec.get("missing"))
+        wv, wp = numeric_values(ctx, rows, wspec.get("field"), wspec.get("missing", 1.0))
+        both = vp & wp
+        den = wv[both].sum()
+        return {"value": float((vv[both] * wv[both]).sum() / den) if den else None}
+    raise ParsingError(f"unknown metric aggregation [{kind}]")
+
+
+def _hashable(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+# ---------------------------------------------------------------------------
+# bucket aggregations
+# ---------------------------------------------------------------------------
+
+BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
+               "filters", "filter", "missing", "global", "composite",
+               "significant_terms", "rare_terms", "sampler", "ip_range",
+               "auto_date_histogram", "adjacency_matrix"}
+METRIC_AGGS = {"avg", "sum", "min", "max", "stats", "extended_stats", "value_count",
+               "cardinality", "percentiles", "percentile_ranks", "top_hits",
+               "weighted_avg", "median_absolute_deviation"}
+PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
+                 "stats_bucket", "derivative", "cumulative_sum", "bucket_script",
+                 "bucket_selector", "bucket_sort", "serial_diff", "moving_fn"}
+
+
+def compute_aggs(ctx: SearchContext, rows: np.ndarray, aggs_spec: dict) -> dict:
+    """Compute an aggregation tree over candidate rows."""
+    out: Dict[str, Any] = {}
+    pipelines: List[Tuple[str, str, dict]] = []
+    for name, spec in (aggs_spec or {}).items():
+        if not isinstance(spec, dict):
+            raise ParsingError(f"aggregation [{name}] must be an object")
+        sub = spec.get("aggs") or spec.get("aggregations") or {}
+        kinds = [k for k in spec if k not in ("aggs", "aggregations", "meta")]
+        if len(kinds) != 1:
+            raise ParsingError(f"aggregation [{name}] must define exactly one type")
+        kind = kinds[0]
+        if kind in PIPELINE_AGGS:
+            pipelines.append((name, kind, spec[kind]))
+            continue
+        if kind in METRIC_AGGS:
+            out[name] = compute_metric(ctx, rows, kind, spec[kind])
+        elif kind in BUCKET_AGGS or kind == "nested":
+            # parent pipelines (cumulative_sum/derivative/... declared as
+            # sub-aggs) run over the parent's bucket list after it's built
+            sub_normal, sub_pipes = {}, []
+            for sname, sspec in sub.items():
+                skinds = [k for k in sspec if k not in ("aggs", "aggregations", "meta")]
+                if len(skinds) == 1 and skinds[0] in PIPELINE_AGGS:
+                    sub_pipes.append((sname, skinds[0], sspec[skinds[0]]))
+                else:
+                    sub_normal[sname] = sspec
+            out[name] = _compute_bucket(ctx, rows, kind, spec[kind], sub_normal)
+            for pname, pkind, pspec in sub_pipes:
+                wrapper = {"__parent__": out[name]}
+                pspec2 = dict(pspec)
+                bp = pspec2.get("buckets_path")
+                if isinstance(bp, str):
+                    pspec2["buckets_path"] = "__parent__>" + bp
+                elif isinstance(bp, dict):
+                    pspec2["buckets_path"] = {k: "__parent__>" + v for k, v in bp.items()}
+                res = _compute_pipeline(wrapper, pkind, pspec2, pname)
+                if not (isinstance(res, dict) and "_applied" in res):
+                    out[name].setdefault("__pipeline_results__", {})[pname] = res
+        else:
+            raise ParsingError(f"unknown aggregation type [{kind}]")
+    for name, kind, spec in pipelines:
+        res = _compute_pipeline(out, kind, spec, name)
+        # in-place pipelines (derivative, cumulative_sum, bucket_script/
+        # selector/sort) mutate parent buckets and emit no sibling output
+        if not (isinstance(res, dict) and "_applied" in res):
+            out[name] = res
+    return out
+
+
+def _bucketize(ctx, rows, sub_aggs, buckets: List[Tuple[Any, np.ndarray]],
+               key_name: str = "key") -> List[dict]:
+    out = []
+    for key, brows in buckets:
+        b = {key_name: key, "doc_count": int(len(brows))}
+        if sub_aggs:
+            b.update(compute_aggs(ctx, brows, sub_aggs))
+        out.append(b)
+    return out
+
+
+def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
+                    spec: dict, sub_aggs: dict) -> dict:
+    field = spec.get("field")
+
+    if kind == "filter" or (kind == "filters" and False):
+        q = parse_query(spec) if kind == "filter" else None
+        match = q.execute(ctx).rows
+        brows = rows[np.isin(rows, match)]
+        b = {"doc_count": int(len(brows))}
+        if sub_aggs:
+            b.update(compute_aggs(ctx, brows, sub_aggs))
+        return b
+
+    if kind == "filters":
+        filters = spec.get("filters", {})
+        named = isinstance(filters, dict)
+        items = filters.items() if named else enumerate(filters)
+        buckets = {} if named else []
+        for key, qspec in items:
+            match = parse_query(qspec).execute(ctx).rows
+            brows = rows[np.isin(rows, match)]
+            b = {"doc_count": int(len(brows))}
+            if sub_aggs:
+                b.update(compute_aggs(ctx, brows, sub_aggs))
+            if named:
+                buckets[key] = b
+            else:
+                buckets.append(b)
+        return {"buckets": buckets}
+
+    if kind == "global":
+        grows = ctx.all_rows()
+        b = {"doc_count": int(len(grows))}
+        if sub_aggs:
+            b.update(compute_aggs(ctx, grows, sub_aggs))
+        return b
+
+    if kind == "missing":
+        vals = [ctx.reader.get_doc_value(field, int(r)) for r in rows]
+        brows = rows[[v is None for v in vals]]
+        b = {"doc_count": int(len(brows))}
+        if sub_aggs:
+            b.update(compute_aggs(ctx, brows, sub_aggs))
+        return b
+
+    if kind in ("terms", "significant_terms", "rare_terms"):
+        size = int(spec.get("size", 10))
+        values = all_values(ctx, rows, field)
+        groups: Dict[Any, List[int]] = {}
+        for idx, v in values:
+            groups.setdefault(_hashable(v), []).append(idx)
+        # sort: doc_count desc then key asc (reference terms agg default)
+        order_spec = spec.get("order")
+        items = [(k, np.asarray(sorted(set(i_list)), dtype=np.int64))
+                 for k, i_list in groups.items()]
+        if kind == "rare_terms":
+            max_count = int(spec.get("max_doc_count", 1))
+            items = [(k, i) for k, i in items if len(i) <= max_count]
+            items.sort(key=lambda kv: (len(kv[1]), _sort_key(kv[0])))
+        elif order_spec and isinstance(order_spec, dict):
+            ((okey, odir),) = order_spec.items()
+            reverse = odir == "desc"
+            if okey == "_key":
+                items.sort(key=lambda kv: _sort_key(kv[0]), reverse=reverse)
+            elif okey == "_count":
+                items.sort(key=lambda kv: (len(kv[1]),), reverse=reverse)
+            else:
+                def metric_val(kv):
+                    sub_out = compute_aggs(ctx, rows[kv[1]], sub_aggs)
+                    node = sub_out
+                    for part in okey.split("."):
+                        node = node[part] if isinstance(node, dict) else None
+                    return node if isinstance(node, (int, float)) else (node or {}).get("value", 0)
+                items.sort(key=metric_val, reverse=reverse)
+        else:
+            items.sort(key=lambda kv: (-len(kv[1]), _sort_key(kv[0])))
+        total_other = sum(len(i) for _, i in items[size:])
+        buckets = _bucketize(ctx, rows, sub_aggs,
+                             [(k, rows[i]) for k, i in items[:size]])
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": int(total_other), "buckets": buckets}
+
+    if kind == "histogram":
+        interval = float(spec["interval"])
+        offset = float(spec.get("offset", 0.0))
+        min_count = int(spec.get("min_doc_count", 0))
+        vals, present = numeric_values(ctx, rows, field, spec.get("missing"))
+        keys = np.floor((vals - offset) / interval) * interval + offset
+        return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
+                              spec.get("extended_bounds"), interval)
+
+    if kind == "date_histogram":
+        interval_ms, calendar = _date_interval(spec)
+        min_count = int(spec.get("min_doc_count", 0))
+        vals, present = numeric_values(ctx, rows, field)
+        if calendar:
+            keys = np.asarray([_calendar_floor(int(v), calendar) if p else np.nan
+                               for v, p in zip(vals, present)], dtype=np.float64)
+        else:
+            keys = np.floor(vals / interval_ms) * interval_ms
+        return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
+                              None, interval_ms, date=True)
+
+    if kind == "auto_date_histogram":
+        target = int(spec.get("buckets", 10))
+        vals, present = numeric_values(ctx, rows, field)
+        v = vals[present]
+        if len(v) == 0:
+            return {"buckets": [], "interval": "1ms"}
+        span = max(v.max() - v.min(), 1.0)
+        interval_ms = max(span / target, 1.0)
+        # snap to a sane unit
+        for unit in (1, 1000, 60_000, 3_600_000, 86_400_000, 2_592_000_000, 31_536_000_000):
+            if interval_ms <= unit:
+                interval_ms = unit
+                break
+        keys = np.floor(vals / interval_ms) * interval_ms
+        out = _histo_buckets(ctx, rows, sub_aggs, keys, present, 0, None,
+                             interval_ms, date=True)
+        out["interval"] = f"{int(interval_ms)}ms"
+        return out
+
+    if kind in ("range", "date_range", "ip_range"):
+        ranges = spec.get("ranges", [])
+        vals, present = numeric_values(ctx, rows, field, spec.get("missing"))
+        if kind == "date_range":
+            def conv(x):
+                return float(parse_date_millis(x)) if x is not None else None
+        else:
+            def conv(x):
+                return float(x) if x is not None else None
+        buckets = []
+        for r in ranges:
+            frm = conv(r.get("from"))
+            to = conv(r.get("to"))
+            mask = present.copy()
+            if frm is not None:
+                mask &= vals >= frm
+            if to is not None:
+                mask &= vals < to
+            brows = rows[mask]
+            key = r.get("key")
+            if key is None:
+                key = f"{r.get('from', '*')}-{r.get('to', '*')}"
+            b = {"key": key, "doc_count": int(len(brows))}
+            if frm is not None:
+                b["from"] = frm
+            if to is not None:
+                b["to"] = to
+            if sub_aggs:
+                b.update(compute_aggs(ctx, brows, sub_aggs))
+            buckets.append(b)
+        return {"buckets": buckets}
+
+    if kind == "sampler":
+        shard_size = int(spec.get("shard_size", 100))
+        brows = rows[:shard_size]
+        b = {"doc_count": int(len(brows))}
+        if sub_aggs:
+            b.update(compute_aggs(ctx, brows, sub_aggs))
+        return b
+
+    if kind == "composite":
+        sources = spec.get("sources", [])
+        size = int(spec.get("size", 10))
+        after = spec.get("after")
+        # build per-row composite keys
+        keyed: Dict[tuple, List[int]] = {}
+        names = []
+        per_source_vals = []
+        for src in sources:
+            ((sname, sdef),) = src.items()
+            names.append(sname)
+            ((stype, sspec),) = sdef.items()
+            col = {}
+            if stype == "terms":
+                for idx, v in all_values(ctx, rows, sspec["field"]):
+                    col.setdefault(idx, v)
+            elif stype == "histogram":
+                vals, present = numeric_values(ctx, rows, sspec["field"])
+                interval = float(sspec["interval"])
+                for idx in np.nonzero(present)[0]:
+                    col[int(idx)] = float(np.floor(vals[idx] / interval) * interval)
+            elif stype == "date_histogram":
+                vals, present = numeric_values(ctx, rows, sspec["field"])
+                ims, cal = _date_interval(sspec)
+                for idx in np.nonzero(present)[0]:
+                    v = int(vals[idx])
+                    col[int(idx)] = _calendar_floor(v, cal) if cal else float(np.floor(v / ims) * ims)
+            per_source_vals.append(col)
+        for i in range(len(rows)):
+            key = tuple(col.get(i) for col in per_source_vals)
+            if any(k is None for k in key):
+                continue
+            keyed.setdefault(key, []).append(i)
+        items = sorted(keyed.items(), key=lambda kv: tuple(_sort_key(k) for k in kv[0]))
+        if after is not None:
+            after_key = tuple(after.get(n) for n in names)
+            items = [it for it in items
+                     if tuple(_sort_key(k) for k in it[0]) > tuple(_sort_key(k) for k in after_key)]
+        items = items[:size]
+        buckets = []
+        for key, idxs in items:
+            b = {"key": dict(zip(names, key)), "doc_count": len(idxs)}
+            if sub_aggs:
+                b.update(compute_aggs(ctx, rows[np.asarray(idxs, dtype=np.int64)], sub_aggs))
+            buckets.append(b)
+        out = {"buckets": buckets}
+        if buckets:
+            out["after_key"] = buckets[-1]["key"]
+        return out
+
+    if kind == "adjacency_matrix":
+        filters = spec.get("filters", {})
+        matches = {name: parse_query(q).execute(ctx).rows for name, q in filters.items()}
+        names = sorted(matches)
+        buckets = []
+        for i, a in enumerate(names):
+            ra = rows[np.isin(rows, matches[a])]
+            if len(ra):
+                b = {"key": a, "doc_count": int(len(ra))}
+                if sub_aggs:
+                    b.update(compute_aggs(ctx, ra, sub_aggs))
+                buckets.append(b)
+            for bname in names[i + 1:]:
+                rb = ra[np.isin(ra, matches[bname])]
+                if len(rb):
+                    b = {"key": f"{a}&{bname}", "doc_count": int(len(rb))}
+                    if sub_aggs:
+                        b.update(compute_aggs(ctx, rb, sub_aggs))
+                    buckets.append(b)
+        return {"buckets": buckets}
+
+    if kind == "nested":
+        # nested docs are stored flattened; nested agg scopes to docs having the path
+        b = {"doc_count": int(len(rows))}
+        if sub_aggs:
+            b.update(compute_aggs(ctx, rows, sub_aggs))
+        return b
+
+    raise ParsingError(f"unknown bucket aggregation [{kind}]")
+
+
+def _sort_key(v):
+    if v is None:
+        return (2, "")
+    if isinstance(v, bool):
+        return (1, str(v))
+    if isinstance(v, (int, float)):
+        return (0, float(v))
+    return (1, str(v))
+
+
+def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
+                   extended_bounds, interval, date=False) -> dict:
+    groups: Dict[float, np.ndarray] = {}
+    valid = present & ~np.isnan(keys)
+    for key in np.unique(keys[valid]):
+        groups[float(key)] = rows[valid & (keys == key)]
+    all_keys = sorted(groups)
+    if extended_bounds and interval:
+        lo, hi = float(extended_bounds.get("min", np.inf)), float(extended_bounds.get("max", -np.inf))
+        k = min([lo] + all_keys) if all_keys or lo != np.inf else lo
+        top = max([hi] + all_keys) if all_keys or hi != -np.inf else hi
+        cur = k
+        full = []
+        while cur <= top + 1e-9:
+            full.append(round(cur, 10))
+            cur += interval
+        all_keys = full
+    elif min_count == 0 and all_keys and interval:
+        full = []
+        cur = all_keys[0]
+        while cur <= all_keys[-1] + 1e-9:
+            full.append(round(cur, 10))
+            cur += interval
+        all_keys = full
+    buckets = []
+    for key in all_keys:
+        brows = groups.get(key, np.zeros(0, dtype=np.int64))
+        if len(brows) < min_count and min_count > 0:
+            continue
+        b = {"key": int(key) if date else key, "doc_count": int(len(brows))}
+        if date:
+            b["key_as_string"] = _millis_to_iso(int(key))
+        if sub_aggs:
+            b.update(compute_aggs(ctx, brows, sub_aggs))
+        buckets.append(b)
+    return {"buckets": buckets}
+
+
+_CAL_UNITS = {"minute": "T", "1m": "T", "hour": "H", "1h": "H", "day": "D", "1d": "D",
+              "week": "W", "1w": "W", "month": "M", "1M": "M", "quarter": "Q",
+              "1q": "Q", "year": "Y", "1y": "Y"}
+_FIXED_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
+_FIXED_FACTORS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+
+
+def _date_interval(spec: dict) -> Tuple[float, Optional[str]]:
+    cal = spec.get("calendar_interval")
+    if cal:
+        unit = _CAL_UNITS.get(cal)
+        if unit is None:
+            raise ParsingError(f"unknown calendar interval [{cal}]")
+        return 0.0, unit
+    fixed = spec.get("fixed_interval") or spec.get("interval")
+    if fixed is None:
+        raise ParsingError("date_histogram requires calendar_interval or fixed_interval")
+    if isinstance(fixed, (int, float)):
+        return float(fixed), None
+    m = _FIXED_RE.match(str(fixed))
+    if m:
+        return float(int(m.group(1)) * _FIXED_FACTORS[m.group(2)]), None
+    unit = _CAL_UNITS.get(str(fixed))
+    if unit:
+        return 0.0, unit
+    raise ParsingError(f"unknown interval [{fixed}]")
+
+
+def _calendar_floor(millis: int, unit: str) -> float:
+    import datetime as dt
+    d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+    if unit == "T":
+        d = d.replace(second=0, microsecond=0)
+    elif unit == "H":
+        d = d.replace(minute=0, second=0, microsecond=0)
+    elif unit == "D":
+        d = d.replace(hour=0, minute=0, second=0, microsecond=0)
+    elif unit == "W":
+        d = (d - dt.timedelta(days=d.weekday())).replace(hour=0, minute=0, second=0, microsecond=0)
+    elif unit == "M":
+        d = d.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    elif unit == "Q":
+        d = d.replace(month=((d.month - 1) // 3) * 3 + 1, day=1, hour=0, minute=0,
+                      second=0, microsecond=0)
+    elif unit == "Y":
+        d = d.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+    return float(int(d.timestamp() * 1000))
+
+
+def _millis_to_iso(millis: int) -> str:
+    import datetime as dt
+    d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+    return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{d.microsecond // 1000:03d}Z"
+
+
+# ---------------------------------------------------------------------------
+# pipeline aggregations
+# ---------------------------------------------------------------------------
+
+def _resolve_buckets_path(sibling_outputs: dict, path: str):
+    """Resolve 'agg>metric' / 'agg.value' buckets_path over computed outputs."""
+    agg_path, _, metric = path.partition(">")
+    node = sibling_outputs.get(agg_path)
+    if node is None:
+        raise ParsingError(f"buckets_path [{path}] references unknown aggregation")
+    buckets = node.get("buckets")
+    if buckets is None:
+        raise ParsingError(f"buckets_path [{path}] target has no buckets")
+    values = []
+    for b in (buckets.values() if isinstance(buckets, dict) else buckets):
+        if not metric or metric == "_count":
+            values.append(float(b["doc_count"]))
+        else:
+            m = b
+            for part in metric.split("."):
+                m = m.get(part) if isinstance(m, dict) else None
+            if isinstance(m, dict):
+                m = m.get("value")
+            values.append(float(m) if m is not None else None)
+    return node, buckets, values
+
+
+def _compute_pipeline(outputs: dict, kind: str, spec: dict, name: str = "") -> Any:
+    if kind in ("bucket_script", "bucket_selector", "bucket_sort"):
+        return _compute_bucket_pipeline(outputs, kind, spec, name)
+    path = spec.get("buckets_path")
+    node, buckets, values = _resolve_buckets_path(outputs, path)
+    present = [v for v in values if v is not None]
+    if kind == "avg_bucket":
+        return {"value": sum(present) / len(present) if present else None}
+    if kind == "sum_bucket":
+        return {"value": sum(present) if present else 0.0}
+    if kind == "max_bucket":
+        if not present:
+            return {"value": None, "keys": []}
+        mx = max(present)
+        keys = [str(b.get("key")) for b, v in zip(buckets, values) if v == mx]
+        return {"value": mx, "keys": keys}
+    if kind == "min_bucket":
+        if not present:
+            return {"value": None, "keys": []}
+        mn = min(present)
+        keys = [str(b.get("key")) for b, v in zip(buckets, values) if v == mn]
+        return {"value": mn, "keys": keys}
+    if kind == "stats_bucket":
+        if not present:
+            return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
+        return {"count": len(present), "min": min(present), "max": max(present),
+                "avg": sum(present) / len(present), "sum": sum(present)}
+    if kind == "cumulative_sum":
+        total = 0.0
+        for b, v in zip(buckets, values):
+            total += v or 0.0
+            b.setdefault(name, {})["value"] = total
+        return {"_applied": True}
+    if kind == "derivative":
+        prev = None
+        for b, v in zip(buckets, values):
+            if prev is not None and v is not None:
+                b.setdefault(name, {})["value"] = v - prev
+            prev = v
+        return {"_applied": True}
+    if kind == "serial_diff":
+        lag = int(spec.get("lag", 1))
+        for i, b in enumerate(buckets):
+            if i >= lag and values[i] is not None and values[i - lag] is not None:
+                b.setdefault(name, {})["value"] = values[i] - values[i - lag]
+        return {"_applied": True}
+    if kind == "moving_fn":
+        window = int(spec.get("window", 5))
+        for i, b in enumerate(buckets):
+            win = [v for v in values[max(0, i - window):i] if v is not None]
+            b.setdefault(name, {})["value"] = (sum(win) / len(win)) if win else None
+        return {"_applied": True}
+    raise ParsingError(f"unknown pipeline aggregation [{kind}]")
+
+
+def _compute_bucket_pipeline(outputs: dict, kind: str, spec: dict, name: str = "") -> Any:
+    paths: Dict[str, str] = spec.get("buckets_path", {})
+    # all paths must target the same parent agg buckets
+    parents = set()
+    series: Dict[str, List[Optional[float]]] = {}
+    buckets_ref = None
+    for var, path in paths.items():
+        agg_path = path.partition(">")[0]
+        parents.add(agg_path)
+        _, buckets_ref, values = _resolve_buckets_path(outputs, path)
+        series[var] = values
+    if buckets_ref is None:
+        return {"_applied": False}
+    script = spec.get("script", "")
+    source = script["source"] if isinstance(script, dict) else script
+    import ast as _ast
+
+    def eval_for(i: int):
+        env = {var: vals[i] for var, vals in series.items()}
+        if any(v is None for v in env.values()):
+            return None
+        tree = _ast.parse(source.replace("params.", ""), mode="eval")
+
+        def ev(node):
+            if isinstance(node, _ast.Expression):
+                return ev(node.body)
+            if isinstance(node, _ast.Constant):
+                return node.value
+            if isinstance(node, _ast.Name):
+                if node.id in env:
+                    return env[node.id]
+                raise ParsingError(f"unknown variable [{node.id}] in bucket script")
+            if isinstance(node, _ast.BinOp):
+                ops = {_ast.Add: lambda a, b: a + b, _ast.Sub: lambda a, b: a - b,
+                       _ast.Mult: lambda a, b: a * b, _ast.Div: lambda a, b: a / b}
+                return ops[type(node.op)](ev(node.left), ev(node.right))
+            if isinstance(node, _ast.Compare):
+                left = ev(node.left)
+                right = ev(node.comparators[0])
+                ops = {_ast.Gt: left > right, _ast.GtE: left >= right,
+                       _ast.Lt: left < right, _ast.LtE: left <= right,
+                       _ast.Eq: left == right, _ast.NotEq: left != right}
+                return ops[type(node.ops[0])]
+            if isinstance(node, _ast.UnaryOp) and isinstance(node.op, _ast.USub):
+                return -ev(node.operand)
+            raise ParsingError("unsupported bucket script construct")
+
+        return ev(tree)
+
+    bl = buckets_ref if isinstance(buckets_ref, list) else list(buckets_ref.values())
+    if kind == "bucket_script":
+        name = spec.get("_name", "bucket_script")
+        for i, b in enumerate(bl):
+            v = eval_for(i)
+            if v is not None:
+                b.setdefault(name, {})["value"] = float(v)
+        return {"_applied": True}
+    if kind == "bucket_selector":
+        keep = [bool(eval_for(i)) for i in range(len(bl))]
+        bl[:] = [b for b, k in zip(bl, keep) if k]
+        return {"_applied": True}
+    if kind == "bucket_sort":
+        sort_spec = spec.get("sort", [])
+        size = spec.get("size")
+        frm = int(spec.get("from", 0))
+        for s in reversed(sort_spec):
+            if isinstance(s, dict):
+                ((path, order),) = s.items()
+                direction = order.get("order", "asc") if isinstance(order, dict) else order
+                def keyfn(b, p=path):
+                    node = b
+                    for part in p.split("."):
+                        node = node.get(part) if isinstance(node, dict) else None
+                    if isinstance(node, dict):
+                        node = node.get("value")
+                    return node if node is not None else -math.inf
+                bl.sort(key=keyfn, reverse=direction == "desc")
+        end = frm + size if size is not None else None
+        bl[:] = bl[frm:end]
+        return {"_applied": True}
+    return {"_applied": False}

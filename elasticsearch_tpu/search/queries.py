@@ -1,0 +1,992 @@
+"""Query DSL: executable queries over a ShardReader.
+
+Re-design of the reference's query layer (`index/query/` — 73 builder files —
+plus Lucene's scorers; SURVEY.md §2.5). Instead of per-document iterator
+scorers (BulkScorer over postings), every query evaluates **vectorized**:
+
+    execute(ctx) -> DocSet(rows: int64[], scores: float32[] | None)
+
+Rows are sorted global row ids; scores align with rows. Boolean composition
+is set algebra on sorted arrays (intersect/union/diff) with score summing —
+the same query/filter-context semantics as the reference (filter clauses
+never score, `BoolQueryBuilder`), shaped so score math stays in numpy and
+can batch to the device.
+
+BM25 matches Lucene's BM25Similarity (k1=1.2, b=0.75):
+    idf = ln(1 + (N - df + 0.5) / (df + 0.5))
+    tf  = f / (f + k1 * (1 - b + b * len / avg_len))
+    score = idf * tf * (k1 + 1)
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from elasticsearch_tpu.index.mapping import (
+    BooleanFieldMapper, DateFieldMapper, DenseVectorFieldMapper, IpFieldMapper,
+    KeywordFieldMapper, MapperService, TextFieldMapper, _NumericMapper,
+    parse_date_millis,
+)
+from elasticsearch_tpu.index.segment import ShardReader
+
+BM25_K1 = 1.2
+BM25_B = 0.75
+
+
+class DocSet:
+    """Sorted matching rows + aligned scores (None in filter context)."""
+
+    __slots__ = ("rows", "scores")
+
+    def __init__(self, rows: np.ndarray, scores: Optional[np.ndarray] = None):
+        self.rows = rows
+        self.scores = scores
+
+    @staticmethod
+    def empty() -> "DocSet":
+        return DocSet(np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float32))
+
+    def with_scores(self) -> "DocSet":
+        if self.scores is None:
+            return DocSet(self.rows, np.zeros(len(self.rows), dtype=np.float32))
+        return self
+
+    def constant(self, value: float = 0.0) -> "DocSet":
+        return DocSet(self.rows, np.full(len(self.rows), value, dtype=np.float32))
+
+
+class SearchContext:
+    """Per-shard execution context (reference: SearchContext/QueryShardContext)."""
+
+    def __init__(self, reader: ShardReader, mapper_service: MapperService):
+        self.reader = reader
+        self.mapper_service = mapper_service
+        self._all_rows: Optional[np.ndarray] = None
+
+    def all_rows(self) -> np.ndarray:
+        if self._all_rows is None:
+            self._all_rows = np.sort(self.reader.live_global_rows())
+        return self._all_rows
+
+
+class Query:
+    def execute(self, ctx: SearchContext) -> DocSet:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Leaf queries
+# ---------------------------------------------------------------------------
+
+class MatchAllQuery(Query):
+    def __init__(self, boost: float = 1.0):
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows = ctx.all_rows()
+        return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
+
+    def to_dict(self):
+        return {"match_all": {}}
+
+
+class MatchNoneQuery(Query):
+    def execute(self, ctx):
+        return DocSet.empty()
+
+    def to_dict(self):
+        return {"match_none": {}}
+
+
+def _term_postings(ctx: SearchContext, field: str, term: str):
+    """Collect (rows, freqs) for a term across segments, live docs only."""
+    rows_parts, freq_parts = [], []
+    for view in ctx.reader.views:
+        p = view.segment.get_postings(field, term)
+        if p is None:
+            continue
+        live = view.live[p.doc_ids]
+        ids = p.doc_ids[live]
+        rows_parts.append(ids.astype(np.int64) + view.segment.base)
+        freq_parts.append(p.freqs[live])
+    if not rows_parts:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int32)
+    return np.concatenate(rows_parts), np.concatenate(freq_parts)
+
+
+def _field_lengths_for(ctx: SearchContext, field: str, rows: np.ndarray) -> np.ndarray:
+    out = np.zeros(len(rows), dtype=np.float32)
+    for view in ctx.reader.views:
+        seg = view.segment
+        fl = seg.field_lengths.get(field)
+        if fl is None:
+            continue
+        in_seg = (rows >= seg.base) & (rows < seg.base + seg.num_docs)
+        out[in_seg] = fl[rows[in_seg] - seg.base]
+    return out
+
+
+def bm25_scores(ctx: SearchContext, field: str, rows: np.ndarray,
+                freqs: np.ndarray, boost: float = 1.0) -> np.ndarray:
+    n = max(ctx.reader.docs_with_field_count(field), 1)
+    df = len(rows)
+    idf = math.log(1.0 + (n - df + 0.5) / (df + 0.5))
+    avg_len = ctx.reader.avg_field_length(field) or 1.0
+    lengths = _field_lengths_for(ctx, field, rows)
+    f = freqs.astype(np.float32)
+    tf = f / (f + BM25_K1 * (1.0 - BM25_B + BM25_B * lengths / avg_len))
+    return (boost * idf * (BM25_K1 + 1.0) * tf).astype(np.float32)
+
+
+def _index_term_for(mapper, value: Any) -> Optional[str]:
+    """Coerce a query value to the indexed term representation."""
+    if mapper is None:
+        return str(value)
+    try:
+        terms = mapper.index_terms(value)
+    except Exception:
+        return None
+    return terms[0] if terms else None
+
+
+class TermQuery(Query):
+    def __init__(self, field: str, value: Any, boost: float = 1.0):
+        self.field = field
+        self.value = value
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, TextFieldMapper):
+            # term query on text matches the single analyzed-or-raw token as-is
+            term = str(self.value)
+        else:
+            term = _index_term_for(mapper, self.value)
+            if term is None:
+                return DocSet.empty()
+        rows, freqs = _term_postings(ctx, self.field, term)
+        order = np.argsort(rows, kind="stable")
+        rows, freqs = rows[order], freqs[order]
+        if isinstance(mapper, TextFieldMapper):
+            scores = bm25_scores(ctx, self.field, rows, freqs, self.boost)
+        else:
+            scores = np.full(len(rows), self.boost, dtype=np.float32)
+        return DocSet(rows, scores)
+
+    def to_dict(self):
+        return {"term": {self.field: {"value": self.value, "boost": self.boost}}}
+
+
+class TermsQuery(Query):
+    def __init__(self, field: str, values: List[Any], boost: float = 1.0):
+        self.field = field
+        self.values = values
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        all_rows = []
+        for v in self.values:
+            term = str(v) if isinstance(mapper, TextFieldMapper) else _index_term_for(mapper, v)
+            if term is None:
+                continue
+            rows, _ = _term_postings(ctx, self.field, term)
+            all_rows.append(rows)
+        if not all_rows:
+            return DocSet.empty()
+        rows = np.unique(np.concatenate(all_rows))
+        return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
+
+    def to_dict(self):
+        return {"terms": {self.field: self.values}}
+
+
+class MatchQuery(Query):
+    def __init__(self, field: str, text: Any, operator: str = "or",
+                 minimum_should_match: Optional[int] = None, boost: float = 1.0,
+                 fuzziness: Optional[str] = None):
+        self.field = field
+        self.text = text
+        self.operator = operator.lower()
+        self.minimum_should_match = minimum_should_match
+        self.boost = boost
+        self.fuzziness = fuzziness
+
+    def _analyzed_terms(self, ctx: SearchContext) -> List[str]:
+        mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, TextFieldMapper):
+            return mapper.search_analyzer.terms(str(self.text))
+        term = str(self.text) if mapper is None else _index_term_for(mapper, self.text)
+        return [term] if term is not None else []
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        terms = self._analyzed_terms(ctx)
+        if not terms:
+            return DocSet.empty()
+        if self.fuzziness is not None:
+            expanded = []
+            for t in terms:
+                expanded.extend(_fuzzy_expand(ctx, self.field, t, self.fuzziness))
+            terms = expanded or terms
+        clause_sets = []
+        for t in terms:
+            rows, freqs = _term_postings(ctx, self.field, t)
+            order = np.argsort(rows, kind="stable")
+            rows, freqs = rows[order], freqs[order]
+            scores = bm25_scores(ctx, self.field, rows, freqs, self.boost)
+            clause_sets.append(DocSet(rows, scores))
+        required = len(clause_sets) if self.operator == "and" else (self.minimum_should_match or 1)
+        return _combine_should(clause_sets, required)
+
+    def to_dict(self):
+        return {"match": {self.field: {"query": self.text, "operator": self.operator}}}
+
+
+class MatchPhraseQuery(Query):
+    def __init__(self, field: str, text: str, slop: int = 0, boost: float = 1.0):
+        self.field = field
+        self.text = text
+        self.slop = slop
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        if not isinstance(mapper, TextFieldMapper):
+            return TermQuery(self.field, self.text, self.boost).execute(ctx)
+        terms = mapper.search_analyzer.terms(str(self.text))
+        if not terms:
+            return DocSet.empty()
+        rows_out, scores_out = [], []
+        for view in ctx.reader.views:
+            seg = view.segment
+            plists = [seg.get_postings(self.field, t) for t in terms]
+            if any(p is None or p.positions is None for p in plists):
+                if any(p is None for p in plists):
+                    continue
+            # candidate docs: intersection of all term postings
+            cand = plists[0].doc_ids
+            for p in plists[1:]:
+                cand = np.intersect1d(cand, p.doc_ids, assume_unique=True)
+            for local in cand:
+                if not view.live[local]:
+                    continue
+                pos_lists = []
+                ok = True
+                for p in plists:
+                    idx = int(np.searchsorted(p.doc_ids, local))
+                    pl = p.positions[idx] if p.positions else None
+                    if pl is None:
+                        ok = False
+                        break
+                    pos_lists.append(set(pl))
+                if not ok:
+                    continue
+                if _phrase_match(pos_lists, self.slop):
+                    rows_out.append(seg.base + int(local))
+        if not rows_out:
+            return DocSet.empty()
+        rows = np.asarray(sorted(rows_out), dtype=np.int64)
+        # phrase scoring: sum of member-term BM25, like Lucene's PhraseQuery approx
+        total = np.zeros(len(rows), dtype=np.float32)
+        for t in terms:
+            trows, tfreqs = _term_postings(ctx, self.field, t)
+            order = np.argsort(trows, kind="stable")
+            trows, tfreqs = trows[order], tfreqs[order]
+            ts = bm25_scores(ctx, self.field, trows, tfreqs, self.boost)
+            idx = np.searchsorted(trows, rows)
+            idx = np.clip(idx, 0, len(trows) - 1)
+            hit = trows[idx] == rows
+            total[hit] += ts[idx][hit]
+        return DocSet(rows, total)
+
+    def to_dict(self):
+        return {"match_phrase": {self.field: {"query": self.text, "slop": self.slop}}}
+
+
+def _phrase_match(pos_sets: List[set], slop: int) -> bool:
+    first = pos_sets[0]
+    for start in first:
+        if _phrase_from(pos_sets, 1, start, slop):
+            return True
+    return False
+
+
+def _phrase_from(pos_sets, i, prev, slop) -> bool:
+    if i == len(pos_sets):
+        return True
+    for p in pos_sets[i]:
+        if 0 < p - prev <= 1 + slop:
+            if _phrase_from(pos_sets, i + 1, p, slop):
+                return True
+    return False
+
+
+class RangeQuery(Query):
+    def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
+                 boost: float = 1.0, fmt: Optional[str] = None):
+        self.field = field
+        self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
+        self.boost = boost
+
+    def _coerce_bound(self, ctx, value):
+        mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, DateFieldMapper):
+            return float(parse_date_millis(value))
+        if isinstance(mapper, IpFieldMapper):
+            return float(mapper.coerce(value))
+        return float(value)
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        lo = -np.inf
+        hi = np.inf
+        lo_inc = hi_inc = True
+        if self.gte is not None:
+            lo = self._coerce_bound(ctx, self.gte)
+        if self.gt is not None:
+            lo, lo_inc = self._coerce_bound(ctx, self.gt), False
+        if self.lte is not None:
+            hi = self._coerce_bound(ctx, self.lte)
+        if self.lt is not None:
+            hi, hi_inc = self._coerce_bound(ctx, self.lt), False
+
+        rows_parts = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            col = seg.doc_values.get(self.field)
+            if col is None or col.numeric is None:
+                # fall back to string doc values (keyword ranges)
+                if col is not None:
+                    locs = [i for i, v in enumerate(col.values)
+                            if v is not None and view.live[i]
+                            and _str_in_range(v, self.gte, self.gt, self.lte, self.lt)]
+                    if locs:
+                        rows_parts.append(np.asarray(locs, dtype=np.int64) + seg.base)
+                continue
+            vals = col.numeric
+            mask = col.present & view.live
+            mask &= (vals >= lo) if lo_inc else (vals > lo)
+            mask &= (vals <= hi) if hi_inc else (vals < hi)
+            locs = np.nonzero(mask)[0]
+            if len(locs):
+                rows_parts.append(locs.astype(np.int64) + seg.base)
+        if not rows_parts:
+            return DocSet.empty()
+        rows = np.sort(np.concatenate(rows_parts))
+        return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
+
+    def to_dict(self):
+        body = {}
+        for k in ("gte", "gt", "lte", "lt"):
+            v = getattr(self, k)
+            if v is not None:
+                body[k] = v
+        return {"range": {self.field: body}}
+
+
+def _str_in_range(v, gte, gt, lte, lt) -> bool:
+    s = str(v)
+    if gte is not None and s < str(gte):
+        return False
+    if gt is not None and s <= str(gt):
+        return False
+    if lte is not None and s > str(lte):
+        return False
+    if lt is not None and s >= str(lt):
+        return False
+    return True
+
+
+class ExistsQuery(Query):
+    def __init__(self, field: str, boost: float = 1.0):
+        self.field = field
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows_parts = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            mask = None
+            col = seg.doc_values.get(self.field)
+            if col is not None:
+                mask = col.present.copy()
+            fl = seg.field_lengths.get(self.field)
+            if fl is not None:
+                m = fl > 0
+                mask = m if mask is None else (mask | m)
+            vec = seg.vectors.get(self.field)
+            if vec is not None:
+                mask = vec[1] if mask is None else (mask | vec[1])
+            if mask is None:
+                continue
+            locs = np.nonzero(mask & view.live)[0]
+            if len(locs):
+                rows_parts.append(locs.astype(np.int64) + seg.base)
+        if not rows_parts:
+            return DocSet.empty()
+        rows = np.sort(np.concatenate(rows_parts))
+        return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
+
+    def to_dict(self):
+        return {"exists": {"field": self.field}}
+
+
+class IdsQuery(Query):
+    def __init__(self, values: List[str], boost: float = 1.0):
+        self.values = values
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        wanted = set(map(str, self.values))
+        rows = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            for local, doc_id in enumerate(seg.ids):
+                if doc_id in wanted and view.live[local]:
+                    rows.append(seg.base + local)
+        rows = np.asarray(sorted(rows), dtype=np.int64)
+        return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
+
+    def to_dict(self):
+        return {"ids": {"values": self.values}}
+
+
+def _pattern_terms(ctx: SearchContext, field: str, predicate) -> List[str]:
+    seen = set()
+    for view in ctx.reader.views:
+        for term in view.segment.terms_of(field):
+            if term not in seen and predicate(term):
+                seen.add(term)
+    return sorted(seen)
+
+
+class PrefixQuery(Query):
+    def __init__(self, field: str, value: str, boost: float = 1.0):
+        self.field = field
+        self.value = str(value)
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        terms = _pattern_terms(ctx, self.field, lambda t: t.startswith(self.value))
+        return TermsQuery(self.field, terms, self.boost).execute(ctx) if terms else DocSet.empty()
+
+    def to_dict(self):
+        return {"prefix": {self.field: {"value": self.value}}}
+
+
+class WildcardQuery(Query):
+    def __init__(self, field: str, value: str, boost: float = 1.0):
+        self.field = field
+        self.value = str(value)
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        pattern = re.compile(
+            "^" + "".join(".*" if c == "*" else "." if c == "?" else re.escape(c)
+                          for c in self.value) + "$")
+        terms = _pattern_terms(ctx, self.field, lambda t: pattern.match(t) is not None)
+        return TermsQuery(self.field, terms, self.boost).execute(ctx) if terms else DocSet.empty()
+
+    def to_dict(self):
+        return {"wildcard": {self.field: {"value": self.value}}}
+
+
+class RegexpQuery(Query):
+    def __init__(self, field: str, value: str, boost: float = 1.0):
+        self.field = field
+        self.value = str(value)
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        try:
+            pattern = re.compile("^" + self.value + "$")
+        except re.error as e:
+            raise IllegalArgumentError(f"invalid regexp [{self.value}]: {e}")
+        terms = _pattern_terms(ctx, self.field, lambda t: pattern.match(t) is not None)
+        return TermsQuery(self.field, terms, self.boost).execute(ctx) if terms else DocSet.empty()
+
+    def to_dict(self):
+        return {"regexp": {self.field: {"value": self.value}}}
+
+
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        best = cur[0]
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            best = min(best, cur[j])
+        if best > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
+def _fuzzy_expand(ctx: SearchContext, field: str, term: str, fuzziness) -> List[str]:
+    if fuzziness in ("AUTO", "auto", None):
+        k = 0 if len(term) <= 2 else 1 if len(term) <= 5 else 2
+    else:
+        k = int(fuzziness)
+    if k == 0:
+        return [term]
+    return _pattern_terms(ctx, field, lambda t: _edit_distance_le(term, t, k))
+
+
+class FuzzyQuery(Query):
+    def __init__(self, field: str, value: str, fuzziness="AUTO", boost: float = 1.0):
+        self.field = field
+        self.value = str(value)
+        self.fuzziness = fuzziness
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        terms = _fuzzy_expand(ctx, self.field, self.value, self.fuzziness)
+        if not terms:
+            return DocSet.empty()
+        sets = [TermQuery(self.field, t, self.boost).execute(ctx) for t in terms]
+        return _combine_should(sets, 1)
+
+    def to_dict(self):
+        return {"fuzzy": {self.field: {"value": self.value, "fuzziness": self.fuzziness}}}
+
+
+class MatchPhrasePrefixQuery(Query):
+    def __init__(self, field: str, text: str, boost: float = 1.0):
+        self.field = field
+        self.text = str(text)
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        if not isinstance(mapper, TextFieldMapper):
+            return PrefixQuery(self.field, self.text, self.boost).execute(ctx)
+        terms = mapper.search_analyzer.terms(self.text)
+        if not terms:
+            return DocSet.empty()
+        *head, last = terms
+        expansions = _pattern_terms(ctx, self.field, lambda t: t.startswith(last))[:50]
+        if not expansions:
+            return DocSet.empty()
+        sets = []
+        for exp in expansions:
+            phrase = " ".join(head + [exp]) if head else exp
+            sets.append(MatchPhraseQuery(self.field, phrase, boost=self.boost).execute(ctx))
+        return _combine_should(sets, 1)
+
+    def to_dict(self):
+        return {"match_phrase_prefix": {self.field: {"query": self.text}}}
+
+
+class MultiMatchQuery(Query):
+    def __init__(self, query: str, fields: List[str], mm_type: str = "best_fields",
+                 operator: str = "or", boost: float = 1.0):
+        self.query = query
+        self.fields = fields
+        self.mm_type = mm_type
+        self.operator = operator
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        def split_boost(f):
+            if "^" in f:
+                name, b = f.split("^", 1)
+                return name, float(b)
+            return f, 1.0
+
+        sets = []
+        for f in self.fields:
+            name, fboost = split_boost(f)
+            sets.append(MatchQuery(name, self.query, operator=self.operator,
+                                   boost=self.boost * fboost).execute(ctx))
+        if not sets:
+            return DocSet.empty()
+        if self.mm_type == "best_fields":
+            return _combine_max(sets)
+        return _combine_should(sets, 1)  # most_fields: sum
+
+    def to_dict(self):
+        return {"multi_match": {"query": self.query, "fields": self.fields,
+                                "type": self.mm_type}}
+
+
+class ConstantScoreQuery(Query):
+    def __init__(self, filter_query: Query, boost: float = 1.0):
+        self.filter_query = filter_query
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        inner = self.filter_query.execute(ctx)
+        return DocSet(inner.rows, np.full(len(inner.rows), self.boost, dtype=np.float32))
+
+    def to_dict(self):
+        return {"constant_score": {"filter": self.filter_query.to_dict(),
+                                   "boost": self.boost}}
+
+
+class BoostingQuery(Query):
+    def __init__(self, positive: Query, negative: Query, negative_boost: float):
+        self.positive = positive
+        self.negative = negative
+        self.negative_boost = negative_boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        pos = self.positive.execute(ctx).with_scores()
+        neg = self.negative.execute(ctx)
+        scores = pos.scores.copy()
+        in_neg = np.isin(pos.rows, neg.rows)
+        scores[in_neg] *= self.negative_boost
+        return DocSet(pos.rows, scores)
+
+    def to_dict(self):
+        return {"boosting": {"positive": self.positive.to_dict(),
+                             "negative": self.negative.to_dict(),
+                             "negative_boost": self.negative_boost}}
+
+
+class DisMaxQuery(Query):
+    def __init__(self, queries: List[Query], tie_breaker: float = 0.0, boost: float = 1.0):
+        self.queries = queries
+        self.tie_breaker = tie_breaker
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        sets = [q.execute(ctx).with_scores() for q in self.queries]
+        if not sets:
+            return DocSet.empty()
+        rows = np.unique(np.concatenate([s.rows for s in sets]))
+        best = np.zeros(len(rows), dtype=np.float32)
+        total = np.zeros(len(rows), dtype=np.float32)
+        for s in sets:
+            idx = np.searchsorted(rows, s.rows)
+            np.maximum.at(best, idx, s.scores)
+            np.add.at(total, idx, s.scores)
+        scores = best + self.tie_breaker * (total - best)
+        return DocSet(rows, scores * self.boost)
+
+    def to_dict(self):
+        return {"dis_max": {"queries": [q.to_dict() for q in self.queries],
+                            "tie_breaker": self.tie_breaker}}
+
+
+# ---------------------------------------------------------------------------
+# Bool composition
+# ---------------------------------------------------------------------------
+
+def _combine_should(sets: List[DocSet], minimum_match: int) -> DocSet:
+    """Union with score summing; keep docs matching >= minimum_match clauses."""
+    sets = [s for s in sets]
+    if not sets:
+        return DocSet.empty()
+    rows = np.unique(np.concatenate([s.rows for s in sets]))
+    scores = np.zeros(len(rows), dtype=np.float32)
+    counts = np.zeros(len(rows), dtype=np.int32)
+    for s in sets:
+        if len(s.rows) == 0:
+            continue
+        idx = np.searchsorted(rows, s.rows)
+        np.add.at(scores, idx, s.scores if s.scores is not None else 0.0)
+        np.add.at(counts, idx, 1)
+    keep = counts >= minimum_match
+    return DocSet(rows[keep], scores[keep])
+
+
+def _combine_max(sets: List[DocSet]) -> DocSet:
+    rows = np.unique(np.concatenate([s.rows for s in sets])) if sets else np.zeros(0, np.int64)
+    scores = np.zeros(len(rows), dtype=np.float32)
+    for s in sets:
+        if len(s.rows) == 0:
+            continue
+        idx = np.searchsorted(rows, s.rows)
+        np.maximum.at(scores, idx, s.scores if s.scores is not None else 0.0)
+    return DocSet(rows, scores)
+
+
+class BoolQuery(Query):
+    """must/filter/should/must_not with reference semantics
+    (`index/query/BoolQueryBuilder.java`): filter and must_not never score;
+    should adds to the score; minimum_should_match defaults to 1 when there
+    are no must/filter clauses, else 0."""
+
+    def __init__(self, must: List[Query] = (), filter: List[Query] = (),
+                 should: List[Query] = (), must_not: List[Query] = (),
+                 minimum_should_match: Optional[int] = None, boost: float = 1.0):
+        self.must = list(must)
+        self.filter = list(filter)
+        self.should = list(should)
+        self.must_not = list(must_not)
+        self.minimum_should_match = minimum_should_match
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows: Optional[np.ndarray] = None
+        scores: Optional[np.ndarray] = None
+
+        for q in self.must:
+            s = q.execute(ctx).with_scores()
+            if rows is None:
+                rows, scores = s.rows, s.scores.copy()
+            else:
+                rows, i1, i2 = np.intersect1d(rows, s.rows, assume_unique=True,
+                                              return_indices=True)
+                scores = scores[i1] + s.scores[i2]
+
+        for q in self.filter:
+            s = q.execute(ctx)
+            if rows is None:
+                rows = s.rows
+                scores = np.zeros(len(rows), dtype=np.float32)
+            else:
+                rows, i1, _ = np.intersect1d(rows, s.rows, assume_unique=True,
+                                             return_indices=True)
+                scores = scores[i1]
+
+        msm = self.minimum_should_match
+        if self.should:
+            should_set = _combine_should([q.execute(ctx).with_scores() for q in self.should],
+                                         msm if msm is not None else 1)
+            if rows is None:
+                rows, scores = should_set.rows, should_set.scores
+            else:
+                if msm is None or msm == 0:
+                    # optional should: add scores where they match
+                    idx = np.searchsorted(should_set.rows, rows)
+                    idx = np.clip(idx, 0, max(len(should_set.rows) - 1, 0))
+                    if len(should_set.rows):
+                        hit = should_set.rows[idx] == rows
+                        scores[hit] += should_set.scores[idx][hit]
+                else:
+                    rows, i1, i2 = np.intersect1d(rows, should_set.rows,
+                                                  assume_unique=True, return_indices=True)
+                    scores = scores[i1] + should_set.scores[i2]
+
+        if rows is None:
+            rows = ctx.all_rows()
+            scores = np.zeros(len(rows), dtype=np.float32)
+
+        for q in self.must_not:
+            s = q.execute(ctx)
+            keep = ~np.isin(rows, s.rows, assume_unique=True)
+            rows, scores = rows[keep], scores[keep]
+
+        return DocSet(rows, scores * self.boost)
+
+    def to_dict(self):
+        out = {}
+        if self.must:
+            out["must"] = [q.to_dict() for q in self.must]
+        if self.filter:
+            out["filter"] = [q.to_dict() for q in self.filter]
+        if self.should:
+            out["should"] = [q.to_dict() for q in self.should]
+        if self.must_not:
+            out["must_not"] = [q.to_dict() for q in self.must_not]
+        if self.minimum_should_match is not None:
+            out["minimum_should_match"] = self.minimum_should_match
+        return {"bool": out}
+
+
+# ---------------------------------------------------------------------------
+# Scoring wrappers
+# ---------------------------------------------------------------------------
+
+class FunctionScoreQuery(Query):
+    """Subset of function_score (`index/query/functionscore/`): weight,
+    field_value_factor, and script-free boost_mode/score_mode algebra."""
+
+    def __init__(self, query: Query, functions: List[dict],
+                 boost_mode: str = "multiply", score_mode: str = "multiply"):
+        self.query = query
+        self.functions = functions
+        self.boost_mode = boost_mode
+        self.score_mode = score_mode
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        base = self.query.execute(ctx).with_scores()
+        if len(base.rows) == 0 or not self.functions:
+            return base
+        func_scores = []
+        for fn in self.functions:
+            weight = float(fn.get("weight", 1.0))
+            if "field_value_factor" in fn:
+                spec = fn["field_value_factor"]
+                field = spec["field"]
+                factor = float(spec.get("factor", 1.0))
+                missing = float(spec.get("missing", 1.0))
+                modifier = spec.get("modifier", "none")
+                vals = np.full(len(base.rows), missing, dtype=np.float64)
+                for i, row in enumerate(base.rows):
+                    v = ctx.reader.get_doc_value(field, int(row))
+                    if v is not None and not isinstance(v, (list, str, bool)):
+                        vals[i] = float(v)
+                vals = vals * factor
+                if modifier == "log1p":
+                    vals = np.log1p(np.maximum(vals, 0))
+                elif modifier == "sqrt":
+                    vals = np.sqrt(np.maximum(vals, 0))
+                elif modifier == "square":
+                    vals = vals ** 2
+                func_scores.append(weight * vals.astype(np.float32))
+            else:
+                func_scores.append(np.full(len(base.rows), weight, dtype=np.float32))
+        combined = func_scores[0]
+        for fs in func_scores[1:]:
+            if self.score_mode == "sum":
+                combined = combined + fs
+            elif self.score_mode == "max":
+                combined = np.maximum(combined, fs)
+            elif self.score_mode == "min":
+                combined = np.minimum(combined, fs)
+            elif self.score_mode == "avg":
+                combined = (combined + fs) / 2
+            else:
+                combined = combined * fs
+        if self.boost_mode == "replace":
+            new = combined
+        elif self.boost_mode == "sum":
+            new = base.scores + combined
+        elif self.boost_mode == "max":
+            new = np.maximum(base.scores, combined)
+        elif self.boost_mode == "min":
+            new = np.minimum(base.scores, combined)
+        elif self.boost_mode == "avg":
+            new = (base.scores + combined) / 2
+        else:
+            new = base.scores * combined
+        return DocSet(base.rows, new.astype(np.float32))
+
+    def to_dict(self):
+        return {"function_score": {"query": self.query.to_dict(),
+                                   "functions": self.functions}}
+
+
+# ---------------------------------------------------------------------------
+# Parser: DSL dict -> Query
+# ---------------------------------------------------------------------------
+
+def parse_query(body: Optional[dict]) -> Query:
+    """Parse the JSON query DSL (reference: QueryBuilders registered in
+    `SearchModule.registerQueryParsers`)."""
+    if body is None:
+        return MatchAllQuery()
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingError(f"query must be an object with exactly one key, got {body!r}")
+    kind, spec = next(iter(body.items()))
+
+    if kind == "match_all":
+        return MatchAllQuery(boost=float(spec.get("boost", 1.0)) if isinstance(spec, dict) else 1.0)
+    if kind == "match_none":
+        return MatchNoneQuery()
+    if kind == "term":
+        field, v = _single(spec, "term")
+        if isinstance(v, dict):
+            return TermQuery(field, v.get("value"), float(v.get("boost", 1.0)))
+        return TermQuery(field, v)
+    if kind == "terms":
+        spec = dict(spec)
+        boost = float(spec.pop("boost", 1.0))
+        field, values = _single(spec, "terms")
+        if not isinstance(values, list):
+            raise ParsingError("[terms] query requires an array of values")
+        return TermsQuery(field, values, boost)
+    if kind == "match":
+        field, v = _single(spec, "match")
+        if isinstance(v, dict):
+            return MatchQuery(field, v.get("query"), v.get("operator", "or"),
+                              v.get("minimum_should_match"),
+                              float(v.get("boost", 1.0)), v.get("fuzziness"))
+        return MatchQuery(field, v)
+    if kind == "match_phrase":
+        field, v = _single(spec, "match_phrase")
+        if isinstance(v, dict):
+            return MatchPhraseQuery(field, v.get("query"), int(v.get("slop", 0)),
+                                    float(v.get("boost", 1.0)))
+        return MatchPhraseQuery(field, v)
+    if kind == "match_phrase_prefix":
+        field, v = _single(spec, "match_phrase_prefix")
+        text = v.get("query") if isinstance(v, dict) else v
+        return MatchPhrasePrefixQuery(field, text)
+    if kind == "multi_match":
+        return MultiMatchQuery(spec.get("query"), spec.get("fields", []),
+                               spec.get("type", "best_fields"),
+                               spec.get("operator", "or"))
+    if kind == "range":
+        field, v = _single(spec, "range")
+        return RangeQuery(field, gte=v.get("gte", v.get("from")), gt=v.get("gt"),
+                          lte=v.get("lte", v.get("to")), lt=v.get("lt"),
+                          boost=float(v.get("boost", 1.0)))
+    if kind == "exists":
+        return ExistsQuery(spec["field"])
+    if kind == "ids":
+        return IdsQuery(spec.get("values", []))
+    if kind == "prefix":
+        field, v = _single(spec, "prefix")
+        return PrefixQuery(field, v.get("value") if isinstance(v, dict) else v)
+    if kind == "wildcard":
+        field, v = _single(spec, "wildcard")
+        return WildcardQuery(field, (v.get("value") or v.get("wildcard")) if isinstance(v, dict) else v)
+    if kind == "regexp":
+        field, v = _single(spec, "regexp")
+        return RegexpQuery(field, v.get("value") if isinstance(v, dict) else v)
+    if kind == "fuzzy":
+        field, v = _single(spec, "fuzzy")
+        if isinstance(v, dict):
+            return FuzzyQuery(field, v.get("value"), v.get("fuzziness", "AUTO"))
+        return FuzzyQuery(field, v)
+    if kind == "bool":
+        def clause(name):
+            c = spec.get(name, [])
+            if isinstance(c, dict):
+                c = [c]
+            return [parse_query(q) for q in c]
+
+        return BoolQuery(must=clause("must"), filter=clause("filter"),
+                         should=clause("should"), must_not=clause("must_not"),
+                         minimum_should_match=spec.get("minimum_should_match"),
+                         boost=float(spec.get("boost", 1.0)))
+    if kind == "constant_score":
+        return ConstantScoreQuery(parse_query(spec["filter"]),
+                                  float(spec.get("boost", 1.0)))
+    if kind == "boosting":
+        return BoostingQuery(parse_query(spec["positive"]),
+                             parse_query(spec["negative"]),
+                             float(spec.get("negative_boost", 0.5)))
+    if kind == "dis_max":
+        return DisMaxQuery([parse_query(q) for q in spec.get("queries", [])],
+                           float(spec.get("tie_breaker", 0.0)))
+    if kind == "function_score":
+        inner = parse_query(spec.get("query", {"match_all": {}}))
+        functions = spec.get("functions")
+        if functions is None:
+            functions = [{k: v for k, v in spec.items()
+                          if k in ("field_value_factor", "weight")}]
+        return FunctionScoreQuery(inner, functions,
+                                  spec.get("boost_mode", "multiply"),
+                                  spec.get("score_mode", "multiply"))
+    if kind == "script_score":
+        from elasticsearch_tpu.search.script_score import ScriptScoreQuery
+        return ScriptScoreQuery(parse_query(spec.get("query", {"match_all": {}})),
+                                spec.get("script", {}))
+    if kind == "knn":
+        from elasticsearch_tpu.search.knn_query import KnnQuery
+        return KnnQuery(field=spec["field"], query_vector=spec["query_vector"],
+                        k=int(spec.get("k", 10)),
+                        num_candidates=int(spec.get("num_candidates", spec.get("k", 10))),
+                        filter_query=parse_query(spec["filter"]) if "filter" in spec else None,
+                        boost=float(spec.get("boost", 1.0)))
+    raise ParsingError(f"unknown query [{kind}]")
+
+
+def _single(spec: Any, kind: str) -> Tuple[str, Any]:
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingError(f"[{kind}] query malformed, expected a single field")
+    return next(iter(spec.items()))

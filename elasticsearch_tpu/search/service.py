@@ -1,0 +1,481 @@
+"""Per-shard search execution: query phase → (sorted top docs, aggs) → fetch.
+
+Re-design of `search/SearchService.java:365` + `search/query/QueryPhase.java:171`
++ `search/fetch/FetchPhase.java` (SURVEY.md §3.2). One shard executes:
+
+  1. parse the request body (query + knn + post_filter + sort + aggs ...),
+  2. QUERY phase: evaluate the query to (rows, scores) — vectorized/device —
+     apply min_score/post_filter, sort (score or doc-values), cut the
+     [from, from+size) window, compute aggregations,
+  3. FETCH phase: materialize hits (_source filtering, docvalue_fields,
+     script_fields, highlight, sort values).
+
+The shard-level result (`QuerySearchResult` analog) carries enough for the
+coordinator's cross-shard merge: sort keys, scores, shard-local doc rows.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from elasticsearch_tpu.index.mapping import MapperService, TextFieldMapper
+from elasticsearch_tpu.index.segment import ShardReader
+from elasticsearch_tpu.search.aggregations import compute_aggs
+from elasticsearch_tpu.search.queries import (
+    BoolQuery, DocSet, MatchAllQuery, Query, SearchContext, parse_query,
+)
+
+DEFAULT_SIZE = 10
+MAX_RESULT_WINDOW = 10_000
+TRACK_TOTAL_HITS_DEFAULT = 10_000
+
+
+class ShardSearchResult:
+    """Per-shard query-phase output (QuerySearchResult analog)."""
+
+    __slots__ = ("shard_id", "rows", "scores", "sort_values", "total_hits",
+                 "total_relation", "aggregations", "max_score")
+
+    def __init__(self, shard_id, rows, scores, sort_values, total_hits,
+                 total_relation, aggregations, max_score):
+        self.shard_id = shard_id
+        self.rows = rows
+        self.scores = scores
+        self.sort_values = sort_values  # list of per-doc sort key tuples (or None)
+        self.total_hits = total_hits
+        self.total_relation = total_relation
+        self.aggregations = aggregations
+        self.max_score = max_score
+
+
+def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
+                        body: dict, shard_id: int = 0,
+                        vector_store=None) -> ShardSearchResult:
+    ctx = SearchContext(reader, mapper_service)
+    ctx.vector_store = vector_store
+
+    query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
+
+    # top-level knn (ES 8 API shape): combined as should-clause with the query
+    knn_spec = body.get("knn")
+    if knn_spec is not None:
+        from elasticsearch_tpu.search.knn_query import KnnQuery
+        specs = knn_spec if isinstance(knn_spec, list) else [knn_spec]
+        knn_queries: List[Query] = []
+        for spec in specs:
+            knn_queries.append(KnnQuery(
+                field=spec["field"], query_vector=spec["query_vector"],
+                k=int(spec.get("k", spec.get("num_candidates", 10))),
+                num_candidates=int(spec.get("num_candidates", spec.get("k", 10))),
+                filter_query=parse_query(spec["filter"]) if spec.get("filter") else None,
+                boost=float(spec.get("boost", 1.0))))
+        if body.get("query") is None:
+            query = knn_queries[0] if len(knn_queries) == 1 else BoolQuery(should=knn_queries)
+        else:
+            query = BoolQuery(should=[query] + knn_queries)
+
+    result = query.execute(ctx).with_scores()
+    rows, scores = result.rows, result.scores
+
+    # post_filter: applied after aggs scope (reference: POST_FILTER applies to
+    # hits only, not aggs)
+    agg_rows = rows
+    post_filter = body.get("post_filter")
+    if post_filter is not None:
+        pf_rows = parse_query(post_filter).execute(ctx).rows
+        keep = np.isin(rows, pf_rows)
+        rows, scores = rows[keep], scores[keep]
+
+    min_score = body.get("min_score")
+    if min_score is not None:
+        keep = scores >= float(min_score)
+        rows, scores = rows[keep], scores[keep]
+
+    # rescore on the top window (reference: search/rescore/ — the BM25+kNN
+    # fusion point)
+    rescore_spec = body.get("rescore")
+    if rescore_spec is not None:
+        rows, scores = _apply_rescore(ctx, rows, scores, rescore_spec)
+
+    total_hits = int(len(rows))
+    track = body.get("track_total_hits", TRACK_TOTAL_HITS_DEFAULT)
+    if track is True:
+        relation = "eq"
+    else:
+        limit = TRACK_TOTAL_HITS_DEFAULT if track is False else int(track)
+        relation = "eq" if total_hits <= limit else "gte"
+        if relation == "gte":
+            total_hits = limit
+
+    # sorting
+    sort_spec = _normalize_sort(body.get("sort"))
+    order, sort_values = _sort_docs(ctx, rows, scores, sort_spec)
+    rows, scores = rows[order], scores[order]
+    if sort_values is not None:
+        sort_values = [sort_values[i] for i in order]
+
+    # search_after
+    search_after = body.get("search_after")
+    if search_after is not None:
+        if sort_spec is None:
+            raise IllegalArgumentError("search_after requires a sort")
+        start = _search_after_cut(sort_values, scores, search_after, sort_spec)
+        rows, scores = rows[start:], scores[start:]
+        if sort_values is not None:
+            sort_values = sort_values[start:]
+
+    frm = int(body.get("from", 0) or 0)
+    size = int(body.get("size", DEFAULT_SIZE) if body.get("size") is not None else DEFAULT_SIZE)
+    if frm + size > MAX_RESULT_WINDOW:
+        raise IllegalArgumentError(
+            f"Result window is too large, from + size must be less than or equal "
+            f"to: [{MAX_RESULT_WINDOW}] but was [{frm + size}]")
+    window = slice(0, frm + size)  # shard returns from+size, coordinator skips
+    w_rows, w_scores = rows[window], scores[window]
+    w_sort = sort_values[window.start:window.stop] if sort_values is not None else None
+
+    aggs = None
+    aggs_spec = body.get("aggs") or body.get("aggregations")
+    if aggs_spec:
+        aggs = compute_aggs(ctx, agg_rows, aggs_spec)
+
+    max_score = float(scores.max()) if len(scores) and sort_spec is None else None
+    return ShardSearchResult(shard_id, w_rows, w_scores, w_sort, total_hits,
+                             relation, aggs, max_score)
+
+
+def _apply_rescore(ctx, rows, scores, rescore_spec):
+    specs = rescore_spec if isinstance(rescore_spec, list) else [rescore_spec]
+    for spec in specs:
+        window = int(spec.get("window_size", 10))
+        rq = spec.get("query", {})
+        rescore_query = parse_query(rq.get("rescore_query"))
+        qw = float(rq.get("query_weight", 1.0))
+        rqw = float(rq.get("rescore_query_weight", 1.0))
+        mode = rq.get("score_mode", "total")
+        # take current top-window docs
+        order = np.argsort(-scores, kind="stable")
+        top = order[:window]
+        rest = order[window:]
+        rs = rescore_query.execute(ctx).with_scores()
+        idx = np.searchsorted(rs.rows, rows[top])
+        idx = np.clip(idx, 0, max(len(rs.rows) - 1, 0))
+        matched = len(rs.rows) > 0
+        new_scores = scores.copy()
+        if matched:
+            hit = rs.rows[idx] == rows[top]
+            second = np.where(hit, rs.scores[idx], 0.0)
+            if mode == "total":
+                new_scores[top] = qw * scores[top] + rqw * second
+            elif mode == "multiply":
+                new_scores[top] = np.where(hit, scores[top] * qw * second * rqw, scores[top] * qw)
+            elif mode == "max":
+                new_scores[top] = np.maximum(qw * scores[top], rqw * second)
+            elif mode == "min":
+                new_scores[top] = np.where(hit, np.minimum(qw * scores[top], rqw * second),
+                                           qw * scores[top])
+            elif mode == "avg":
+                new_scores[top] = np.where(hit, (qw * scores[top] + rqw * second) / 2,
+                                           qw * scores[top])
+        scores = new_scores
+    return rows, scores
+
+
+def _normalize_sort(sort) -> Optional[List[Tuple[str, str, Any]]]:
+    """Returns [(field, order, spec)] or None for default score sort."""
+    if sort is None:
+        return None
+    if isinstance(sort, (str, dict)):
+        sort = [sort]
+    out = []
+    for item in sort:
+        if isinstance(item, str):
+            if item == "_score":
+                out.append(("_score", "desc", {}))
+            elif item == "_doc":
+                out.append(("_doc", "asc", {}))
+            else:
+                out.append((item, "asc", {}))
+        elif isinstance(item, dict):
+            ((field, spec),) = item.items()
+            if isinstance(spec, str):
+                out.append((field, spec, {}))
+            else:
+                out.append((field, spec.get("order", "asc" if field != "_score" else "desc"),
+                            spec))
+        else:
+            raise ParsingError(f"malformed sort clause {item!r}")
+    if len(out) == 1 and out[0][0] == "_score":
+        return None
+    return out
+
+
+_MISSING_LAST = float("inf")
+
+
+def _sort_docs(ctx: SearchContext, rows, scores, sort_spec):
+    """Returns (order array, per-doc sort value tuples or None)."""
+    if sort_spec is None:
+        # score desc, row asc tiebreak (stable shard-level order)
+        order = np.lexsort((rows, -scores))
+        return order, None
+    keys = []
+    sort_values = [[] for _ in range(len(rows))]
+    for field, direction, spec in sort_spec:
+        if field == "_score":
+            vals = scores.astype(np.float64)
+            for i, v in enumerate(vals):
+                sort_values[i].append(float(v))
+        elif field == "_doc":
+            vals = rows.astype(np.float64)
+            for i, v in enumerate(vals):
+                sort_values[i].append(int(v))
+        else:
+            from elasticsearch_tpu.search.aggregations import numeric_values
+            nums, present = numeric_values(ctx, rows, field)
+            if present.any() or ctx.mapper_service.get(field) is None or \
+               ctx.mapper_service.get(field).type_name in (
+                   "long", "integer", "short", "byte", "double", "float",
+                   "half_float", "date", "boolean", "ip", "scaled_float"):
+                missing = spec.get("missing", "_last")
+                fill = _MISSING_LAST if (missing == "_last") == (direction == "asc") else -_MISSING_LAST
+                if isinstance(missing, (int, float)) and not isinstance(missing, bool):
+                    fill = float(missing)
+                vals = np.where(present, nums, fill)
+                for i in range(len(rows)):
+                    sort_values[i].append(float(nums[i]) if present[i] else None)
+            else:
+                # string sort via object dtype
+                svals = []
+                for r in rows:
+                    v = ctx.reader.get_doc_value(field, int(r))
+                    if isinstance(v, list):
+                        v = v[0] if v else None
+                    svals.append(v)
+                for i, v in enumerate(svals):
+                    sort_values[i].append(v)
+                # encode strings to sortable floats via rank
+                uniq = sorted({s for s in svals if s is not None}, key=str)
+                rank = {s: float(i) for i, s in enumerate(uniq)}
+                vals = np.asarray([rank.get(s, _MISSING_LAST if direction == "asc" else -_MISSING_LAST)
+                                   for s in svals], dtype=np.float64)
+        keys.append(vals if direction == "asc" else -vals)
+    keys.append(rows.astype(np.float64))  # final tiebreak
+    order = np.lexsort(tuple(reversed(keys)))
+    return order, [tuple(sort_values[i]) for i in range(len(rows))]
+
+
+def _search_after_cut(sort_values, scores, after, sort_spec) -> int:
+    """Index of the first doc strictly after the search_after key."""
+    def cmp_key(sv):
+        out = []
+        for (field, direction, _), v in zip(sort_spec, sv):
+            if v is None:
+                v = _MISSING_LAST
+            if isinstance(v, str):
+                out.append((v, direction))
+            else:
+                out.append((float(v), direction))
+        return out
+
+    def is_after(sv):
+        for (v, direction), a in zip(cmp_key(sv), after):
+            av = float(a) if isinstance(a, (int, float)) and not isinstance(a, bool) else a
+            try:
+                if v == av:
+                    continue
+                gt = v > av
+            except TypeError:
+                continue
+            return gt if direction == "asc" else not gt
+        return False
+
+    for i, sv in enumerate(sort_values):
+        if is_after(sv):
+            return i
+    return len(sort_values)
+
+
+# ---------------------------------------------------------------------------
+# fetch phase
+# ---------------------------------------------------------------------------
+
+def _filter_source(source: dict, includes, excludes) -> dict:
+    if not includes and not excludes:
+        return source
+
+    def flatten(obj, prefix=""):
+        for k, v in obj.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                yield from flatten(v, path + ".")
+            else:
+                yield path, v
+
+    def matches(path, patterns):
+        return any(fnmatch.fnmatch(path, p) or path.startswith(p + ".")
+                   for p in patterns)
+
+    out: dict = {}
+    for path, v in flatten(source):
+        if includes and not matches(path, includes):
+            continue
+        if excludes and matches(path, excludes):
+            continue
+        node = out
+        parts = path.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
+                        body: dict, result: ShardSearchResult,
+                        index_name: str = "index",
+                        from_offset: int = 0) -> List[dict]:
+    """Materialize hits for the (already coordinator-trimmed) doc window."""
+    ctx = SearchContext(reader, mapper_service)
+    source_spec = body.get("_source", True)
+    includes: List[str] = []
+    excludes: List[str] = []
+    want_source = True
+    if source_spec is False:
+        want_source = False
+    elif isinstance(source_spec, str):
+        includes = [source_spec]
+    elif isinstance(source_spec, list):
+        includes = source_spec
+    elif isinstance(source_spec, dict):
+        includes = source_spec.get("includes", source_spec.get("include", [])) or []
+        excludes = source_spec.get("excludes", source_spec.get("exclude", [])) or []
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+
+    docvalue_fields = body.get("docvalue_fields", [])
+    script_fields = body.get("script_fields", {})
+    highlight_spec = body.get("highlight")
+    sort_spec = _normalize_sort(body.get("sort"))
+    explain = bool(body.get("explain", False))
+
+    hits = []
+    for i in range(from_offset, len(result.rows)):
+        row = int(result.rows[i])
+        hit: Dict[str, Any] = {
+            "_index": index_name,
+            "_id": reader.get_id(row),
+            "_score": None if sort_spec is not None else float(result.scores[i]),
+        }
+        if sort_spec is not None and result.sort_values is not None:
+            hit["sort"] = list(result.sort_values[i])
+        if want_source:
+            src = reader.get_source(row) or {}
+            hit["_source"] = _filter_source(src, includes, excludes)
+        if docvalue_fields:
+            fields = {}
+            for f in docvalue_fields:
+                fname = f["field"] if isinstance(f, dict) else f
+                v = reader.get_doc_value(fname, row)
+                if v is not None:
+                    fields[fname] = v if isinstance(v, list) else [v]
+            if fields:
+                hit["fields"] = fields
+        if script_fields:
+            from elasticsearch_tpu.search.script_score import Script
+            sf = hit.setdefault("fields", {})
+            for name, spec in script_fields.items():
+                s = Script(spec.get("script", spec))
+                val = s.evaluate(ctx, np.asarray([row]), np.zeros(1, dtype=np.float32))
+                sf[name] = [float(val[0])]
+        if highlight_spec:
+            hl = _highlight(ctx, mapper_service, body, highlight_spec, row)
+            if hl:
+                hit["highlight"] = hl
+        if explain:
+            hit["_explanation"] = {"value": hit["_score"] or 0.0,
+                                   "description": "vectorized score", "details": []}
+        hits.append(hit)
+    return hits
+
+
+_TAG_DEFAULT = ("<em>", "</em>")
+
+
+def _highlight(ctx, mapper_service, body, spec, row) -> Dict[str, List[str]]:
+    """Plain highlighter: re-analyze the stored field, wrap matched terms.
+
+    Reference: `search/fetch/subphase/highlight/` plain highlighter.
+    """
+    source = ctx.reader.get_source(row) or {}
+    query_terms: Dict[str, set] = {}
+
+    def collect_terms(q: dict, default_fields: List[str]):
+        if not isinstance(q, dict):
+            return
+        for kind, qspec in q.items():
+            if kind in ("match", "match_phrase", "term", "match_phrase_prefix"):
+                ((field, v),) = qspec.items() if isinstance(qspec, dict) else []
+                text = v.get("query", v.get("value")) if isinstance(v, dict) else v
+                mapper = mapper_service.get(field)
+                if isinstance(mapper, TextFieldMapper):
+                    terms = mapper.search_analyzer.terms(str(text))
+                else:
+                    terms = [str(text)]
+                query_terms.setdefault(field, set()).update(terms)
+            elif kind == "multi_match":
+                for f in qspec.get("fields", []):
+                    fname = f.split("^")[0]
+                    mapper = mapper_service.get(fname)
+                    text = qspec.get("query", "")
+                    if isinstance(mapper, TextFieldMapper):
+                        query_terms.setdefault(fname, set()).update(
+                            mapper.search_analyzer.terms(str(text)))
+            elif kind == "bool":
+                for clause in ("must", "should", "filter"):
+                    items = qspec.get(clause, [])
+                    if isinstance(items, dict):
+                        items = [items]
+                    for sub in items:
+                        collect_terms(sub, default_fields)
+
+    collect_terms(body.get("query", {}), [])
+    pre = spec.get("pre_tags", [_TAG_DEFAULT[0]])[0]
+    post = spec.get("post_tags", [_TAG_DEFAULT[1]])[0]
+    out = {}
+    for field in spec.get("fields", {}):
+        terms = query_terms.get(field)
+        if not terms:
+            continue
+        raw = _get_path(source, field)
+        if raw is None:
+            continue
+        mapper = mapper_service.get(field)
+        if not isinstance(mapper, TextFieldMapper):
+            continue
+        text = str(raw)
+        tokens = mapper.analyzer.analyze(text)
+        matched = [(t.start_offset, t.end_offset) for t in tokens if t.term in terms]
+        if not matched:
+            continue
+        frag = text
+        for start, end in sorted(matched, reverse=True):
+            frag = frag[:start] + pre + frag[start:end] + post + frag[end:]
+        out[field] = [frag]
+    return out
+
+
+def _get_path(obj: dict, path: str):
+    node = obj
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node

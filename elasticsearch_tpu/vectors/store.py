@@ -1,0 +1,134 @@
+"""Per-shard device vector store: segments → HBM-resident corpus.
+
+The TPU-side half of `dense_vector` (SURVEY.md §2.8): where the reference
+stores one BinaryDocValues blob per doc and scores with a per-doc scripted
+loop, this store mirrors each vector field of a shard into a device-resident
+`Corpus` (padded matrix + norms + optional int8) rebuilt from the engine's
+sealed segments at refresh, with a row map joining device rows back to the
+engine's global rows (and thence _id).
+
+Refresh contract: the engine's reader is the source of truth; `sync(reader)`
+re-ingests when the segment set or tombstones changed. Vectors are
+append-mostly, so unchanged segments' blocks are cached and concatenation is
+cheap; a full device upload happens only for new/changed segments
+(refresh-cycle analog of Lucene NRT reopen).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
+from elasticsearch_tpu.index.segment import ShardReader
+from elasticsearch_tpu.ops import knn as knn_ops
+from elasticsearch_tpu.ops import similarity as sim
+
+_METRIC_MAP = {
+    "cosine": sim.COSINE,
+    "dot_product": sim.DOT_PRODUCT,
+    "l2_norm": sim.L2_NORM,
+    "max_inner_product": sim.MAX_INNER_PRODUCT,
+}
+
+
+class FieldCorpus:
+    """Device corpus for one vector field + host-side row maps."""
+
+    __slots__ = ("corpus", "row_map", "metric", "dims", "version")
+
+    def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int, version: tuple):
+        self.corpus = corpus          # knn_ops.Corpus (device pytree)
+        self.row_map = row_map        # device row -> engine global row
+        self.metric = metric
+        self.dims = dims
+        self.version = version        # cache key: segment/tombstone fingerprint
+
+
+class VectorStoreShard:
+    def __init__(self, dtype: str = "bf16"):
+        self.dtype = dtype
+        self._fields: Dict[str, FieldCorpus] = {}
+
+    @staticmethod
+    def _fingerprint(reader: ShardReader, field: str) -> tuple:
+        parts = []
+        for view in reader.views:
+            seg = view.segment
+            if field in seg.vectors:
+                parts.append((seg.seg_id, seg.num_docs, int(view.live.sum())))
+        return tuple(parts)
+
+    def sync(self, reader: ShardReader,
+             vector_mappers: Dict[str, DenseVectorFieldMapper]) -> None:
+        """Re-ingest vector fields whose segment composition changed."""
+        for field, mapper in vector_mappers.items():
+            version = self._fingerprint(reader, field)
+            cached = self._fields.get(field)
+            if cached is not None and cached.version == version:
+                continue
+            mats: List[np.ndarray] = []
+            rows: List[np.ndarray] = []
+            for view in reader.views:
+                seg = view.segment
+                if field not in seg.vectors:
+                    continue
+                mat, present = seg.vectors[field]
+                keep = present & view.live
+                locs = np.nonzero(keep)[0]
+                if len(locs) == 0:
+                    continue
+                mats.append(mat[locs])
+                rows.append(locs.astype(np.int64) + seg.base)
+            metric = _METRIC_MAP[mapper.similarity]
+            if not mats:
+                self._fields[field] = FieldCorpus(None, np.zeros(0, dtype=np.int64),
+                                                  metric, mapper.dims, version)
+                continue
+            full = np.concatenate(mats, axis=0)
+            row_map = np.concatenate(rows)
+            dtype = self.dtype
+            if mapper.params.get("index_options", {}).get("type") == "int8_flat":
+                dtype = "int8"
+            corpus = knn_ops.build_corpus(full, metric=metric, dtype=dtype)
+            self._fields[field] = FieldCorpus(corpus, row_map, metric,
+                                              mapper.dims, version)
+
+    def field(self, name: str) -> Optional[FieldCorpus]:
+        return self._fields.get(name)
+
+    def search(self, field: str, query_vector: np.ndarray, k: int,
+               filter_rows: Optional[np.ndarray] = None,
+               precision: str = "bf16") -> Tuple[np.ndarray, np.ndarray]:
+        """Top-k device search. Returns (global_rows [m], raw_scores [m]),
+        m <= k (padding/filtered slots removed).
+
+        filter_rows: sorted engine global rows allowed to match (pre-filter
+        bitset from a boolean query; host → device additive mask).
+        """
+        import jax.numpy as jnp
+
+        fc = self._fields.get(field)
+        if fc is None or fc.corpus is None or len(fc.row_map) == 0:
+            return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float32)
+
+        mask = None
+        if filter_rows is not None:
+            allowed = np.isin(fc.row_map, filter_rows)
+            n_pad = fc.corpus.matrix.shape[0]
+            m = np.zeros(n_pad, dtype=bool)
+            m[: len(allowed)] = allowed
+            mask = jnp.asarray(m)
+
+        k_eff = min(k, fc.corpus.matrix.shape[0])
+        q = jnp.asarray(np.asarray(query_vector, dtype=np.float32)[None, :])
+        scores, ids = knn_ops.knn_search(q, fc.corpus, k=k_eff, metric=fc.metric,
+                                         filter_mask=mask, precision=precision)
+        scores = np.asarray(scores[0])
+        ids = np.asarray(ids[0])
+        valid = scores > -1e37
+        ids, scores = ids[valid], scores[valid]
+        in_range = ids < len(fc.row_map)
+        ids, scores = ids[in_range], scores[in_range]
+        return fc.row_map[ids], scores

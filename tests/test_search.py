@@ -1,0 +1,382 @@
+"""Search layer tests: query DSL, BM25, knn, script_score, sort, fetch, aggs."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.search.queries import SearchContext, parse_query
+from elasticsearch_tpu.search.service import execute_fetch_phase, execute_query_phase
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text", "analyzer": "english"},
+        "tag": {"type": "keyword"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "price": {"type": "float"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "vec": {"type": "dense_vector", "dims": 3, "similarity": "cosine"},
+    }
+}
+
+DOCS = [
+    {"title": "the quick brown fox", "body": "foxes are quick animals", "tag": "animal",
+     "tags": ["wild", "fast"], "views": 100, "price": 9.99,
+     "published": "2020-01-15", "active": True, "vec": [1.0, 0.0, 0.0]},
+    {"title": "lazy dogs sleep", "body": "dogs sleeping lazily all day", "tag": "animal",
+     "tags": ["domestic"], "views": 50, "price": 19.99,
+     "published": "2020-02-20", "active": False, "vec": [0.9, 0.1, 0.0]},
+    {"title": "quick sort algorithm", "body": "sorting quickly with quicksort", "tag": "cs",
+     "tags": ["code"], "views": 500, "price": 0.0,
+     "published": "2020-03-10", "active": True, "vec": [0.0, 1.0, 0.0]},
+    {"title": "brown bread recipe", "body": "baking brown bread", "tag": "food",
+     "tags": ["baking", "fast"], "views": 75, "price": 4.5,
+     "published": "2021-01-05", "active": True, "vec": [0.0, 0.0, 1.0]},
+    {"title": "fox hunting banned", "body": "the fox is safe now", "tag": "news",
+     "tags": ["wild"], "views": 200, "price": 2.0,
+     "published": "2021-06-30", "active": False, "vec": [0.7, 0.7, 0.0]},
+]
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    e = Engine(str(tmp_path_factory.mktemp("search") / "shard"), MapperService(MAPPING))
+    for i, d in enumerate(DOCS):
+        e.index(str(i), d)
+    e.refresh()
+    yield e
+    e.close()
+
+
+@pytest.fixture(scope="module")
+def ctx(engine):
+    return SearchContext(engine.acquire_searcher(), engine.mapper_service)
+
+
+def run_query(ctx, q):
+    ds = parse_query(q).execute(ctx)
+    ids = [ctx.reader.get_id(int(r)) for r in ds.rows]
+    return ids, ds
+
+
+def test_match_all(ctx):
+    ids, _ = run_query(ctx, {"match_all": {}})
+    assert sorted(ids) == ["0", "1", "2", "3", "4"]
+
+
+def test_term_keyword(ctx):
+    ids, _ = run_query(ctx, {"term": {"tag": "animal"}})
+    assert sorted(ids) == ["0", "1"]
+
+
+def test_terms_multivalued(ctx):
+    ids, _ = run_query(ctx, {"terms": {"tags": ["wild", "code"]}})
+    assert sorted(ids) == ["0", "2", "4"]
+
+
+def test_match_bm25_ranking(ctx):
+    ids, ds = run_query(ctx, {"match": {"title": "quick fox"}})
+    assert set(ids) >= {"0", "2", "4"}
+    # doc 0 matches both terms -> highest score
+    best = ids[int(np.argmax(ds.scores))]
+    assert best == "0"
+
+
+def test_match_operator_and(ctx):
+    ids, _ = run_query(ctx, {"match": {"title": {"query": "quick fox", "operator": "and"}}})
+    assert ids == ["0"]
+
+
+def test_match_with_stemming(ctx):
+    # english analyzer: "sleeping" stems to match "sleep"... body has "sleeping"
+    ids, _ = run_query(ctx, {"match": {"body": "sleep"}})
+    assert "1" in ids
+
+
+def test_match_phrase(ctx):
+    ids, _ = run_query(ctx, {"match_phrase": {"title": "quick brown fox"}})
+    assert ids == ["0"]
+    ids, _ = run_query(ctx, {"match_phrase": {"title": "brown quick"}})
+    assert ids == []
+
+
+def test_range_numeric(ctx):
+    ids, _ = run_query(ctx, {"range": {"views": {"gte": 100, "lt": 500}}})
+    assert sorted(ids) == ["0", "4"]
+
+
+def test_range_date(ctx):
+    ids, _ = run_query(ctx, {"range": {"published": {"gte": "2021-01-01"}}})
+    assert sorted(ids) == ["3", "4"]
+
+
+def test_bool_query(ctx):
+    q = {"bool": {
+        "must": [{"match": {"title": "quick"}}],
+        "filter": [{"term": {"active": True}}],
+        "must_not": [{"term": {"tag": "cs"}}],
+    }}
+    ids, _ = run_query(ctx, q)
+    assert ids == ["0"]
+
+
+def test_bool_should_scoring(ctx):
+    q = {"bool": {"should": [{"match": {"title": "fox"}}, {"term": {"tag": "food"}}]}}
+    ids, _ = run_query(ctx, q)
+    assert sorted(ids) == ["0", "3", "4"]
+
+
+def test_exists(ctx):
+    ids, _ = run_query(ctx, {"exists": {"field": "vec"}})
+    assert len(ids) == 5
+
+
+def test_ids_query(ctx):
+    ids, _ = run_query(ctx, {"ids": {"values": ["1", "3"]}})
+    assert sorted(ids) == ["1", "3"]
+
+
+def test_prefix_wildcard_regexp_fuzzy(ctx):
+    ids, _ = run_query(ctx, {"prefix": {"tag": "ani"}})
+    assert sorted(ids) == ["0", "1"]
+    ids, _ = run_query(ctx, {"wildcard": {"tag": "f*d"}})
+    assert ids == ["3"]
+    ids, _ = run_query(ctx, {"regexp": {"tag": "c[st]"}})
+    assert ids == ["2"]
+    ids, _ = run_query(ctx, {"fuzzy": {"tag": {"value": "animol"}}})
+    assert sorted(ids) == ["0", "1"]
+
+
+def test_constant_score_and_boost(ctx):
+    _, ds = run_query(ctx, {"constant_score": {"filter": {"term": {"tag": "cs"}}, "boost": 3.0}})
+    assert np.allclose(ds.scores, 3.0)
+
+
+def test_knn_query(ctx):
+    ids, ds = run_query(ctx, {"knn": {"field": "vec", "query_vector": [1.0, 0.05, 0.0], "k": 2}})
+    assert set(ids) == {"0", "1"}
+    # scores follow (1+cos)/2 convention
+    assert (ds.scores <= 1.0).all() and (ds.scores >= 0.0).all()
+
+
+def test_knn_with_filter(ctx):
+    q = {"knn": {"field": "vec", "query_vector": [1.0, 0.0, 0.0], "k": 3,
+                 "filter": {"term": {"active": True}}}}
+    ids, _ = run_query(ctx, q)
+    assert "1" not in ids and "4" not in ids
+
+
+def test_script_score_vector(ctx):
+    q = {"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                   "params": {"qv": [1.0, 0.0, 0.0]}}}}
+    ids, ds = run_query(ctx, q)
+    assert len(ids) == 5
+    best = ids[int(np.argmax(ds.scores))]
+    assert best == "0"
+    assert ds.scores.max() == pytest.approx(2.0, abs=1e-5)
+
+
+def test_script_score_doc_values(ctx):
+    q = {"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "doc['views'].value * 2 + params.base",
+                   "params": {"base": 1}}}}
+    ids, ds = run_query(ctx, q)
+    by_id = dict(zip(ids, ds.scores))
+    assert by_id["2"] == pytest.approx(1001.0)
+
+
+def test_function_score(ctx):
+    q = {"function_score": {
+        "query": {"match_all": {}},
+        "functions": [{"field_value_factor": {"field": "views", "factor": 0.01}}],
+        "boost_mode": "replace"}}
+    ids, ds = run_query(ctx, q)
+    by_id = dict(zip(ids, ds.scores))
+    assert by_id["2"] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# full query phase + fetch
+# ---------------------------------------------------------------------------
+
+def search(engine, body):
+    reader = engine.acquire_searcher()
+    result = execute_query_phase(reader, engine.mapper_service, body)
+    hits = execute_fetch_phase(reader, engine.mapper_service, body, result,
+                               from_offset=int(body.get("from", 0) or 0))
+    return result, hits
+
+
+def test_query_phase_sort_by_field(engine):
+    result, hits = search(engine, {"query": {"match_all": {}},
+                                   "sort": [{"views": "desc"}], "size": 3})
+    assert [h["_id"] for h in hits] == ["2", "4", "0"]
+    assert hits[0]["sort"] == [500.0]
+
+
+def test_query_phase_from_size(engine):
+    _, hits = search(engine, {"query": {"match_all": {}},
+                              "sort": [{"views": "asc"}], "from": 2, "size": 2})
+    assert [h["_id"] for h in hits] == ["0", "4"]
+
+
+def test_search_after(engine):
+    _, hits = search(engine, {"query": {"match_all": {}}, "sort": [{"views": "asc"}],
+                              "search_after": [75], "size": 10})
+    assert [h["_id"] for h in hits] == ["0", "4", "2"]
+
+
+def test_source_filtering(engine):
+    _, hits = search(engine, {"query": {"ids": {"values": ["0"]}},
+                              "_source": ["title", "views"]})
+    assert set(hits[0]["_source"].keys()) == {"title", "views"}
+
+
+def test_docvalue_and_script_fields(engine):
+    _, hits = search(engine, {"query": {"ids": {"values": ["2"]}},
+                              "docvalue_fields": ["views"],
+                              "script_fields": {"double_views": {
+                                  "script": {"source": "doc['views'].value * 2"}}}})
+    assert hits[0]["fields"]["views"] == [500]
+    assert hits[0]["fields"]["double_views"] == [1000.0]
+
+
+def test_highlight(engine):
+    _, hits = search(engine, {"query": {"match": {"title": "fox"}},
+                              "highlight": {"fields": {"title": {}}}})
+    hl = {h["_id"]: h.get("highlight", {}) for h in hits}
+    assert "<em>fox</em>" in hl["0"]["title"][0]
+
+
+def test_min_score_and_total(engine):
+    result, _ = search(engine, {"query": {"match": {"title": "quick"}}, "min_score": 1e9})
+    assert result.total_hits == 0
+
+
+def test_post_filter_does_not_affect_aggs(engine):
+    result, hits = search(engine, {
+        "query": {"match_all": {}},
+        "post_filter": {"term": {"tag": "cs"}},
+        "aggs": {"by_tag": {"terms": {"field": "tag"}}}})
+    assert len(hits) == 1 and hits[0]["_id"] == "2"
+    buckets = {b["key"]: b["doc_count"] for b in result.aggregations["by_tag"]["buckets"]}
+    assert buckets["animal"] == 2  # aggs scope ignores post_filter
+
+
+def test_rescore_window(engine):
+    result, hits = search(engine, {
+        "query": {"match": {"title": "quick"}},
+        "rescore": {"window_size": 10, "query": {
+            "rescore_query": {"term": {"tag": "cs"}},
+            "query_weight": 1.0, "rescore_query_weight": 100.0}}})
+    assert hits[0]["_id"] == "2"  # boosted by rescore
+
+
+# ---------------------------------------------------------------------------
+# aggregations
+# ---------------------------------------------------------------------------
+
+def agg(engine, aggs, query=None):
+    body = {"query": query or {"match_all": {}}, "aggs": aggs, "size": 0}
+    result = execute_query_phase(engine.acquire_searcher(), engine.mapper_service, body)
+    return result.aggregations
+
+
+def test_terms_agg(engine):
+    out = agg(engine, {"t": {"terms": {"field": "tag"}}})
+    buckets = out["t"]["buckets"]
+    assert buckets[0]["key"] == "animal" and buckets[0]["doc_count"] == 2
+
+
+def test_terms_agg_multivalued(engine):
+    out = agg(engine, {"t": {"terms": {"field": "tags"}}})
+    counts = {b["key"]: b["doc_count"] for b in out["t"]["buckets"]}
+    assert counts["wild"] == 2 and counts["fast"] == 2
+
+
+def test_metric_aggs(engine):
+    out = agg(engine, {
+        "avg_views": {"avg": {"field": "views"}},
+        "stats_price": {"stats": {"field": "price"}},
+        "extended": {"extended_stats": {"field": "views"}},
+        "card": {"cardinality": {"field": "tag"}},
+        "pct": {"percentiles": {"field": "views", "percents": [50]}},
+    })
+    assert out["avg_views"]["value"] == pytest.approx(185.0)
+    assert out["stats_price"]["max"] == pytest.approx(19.99)
+    assert out["card"]["value"] == 4
+    assert out["pct"]["values"]["50.0"] == pytest.approx(100.0)
+    assert out["extended"]["std_deviation"] > 0
+
+
+def test_histogram_agg(engine):
+    out = agg(engine, {"h": {"histogram": {"field": "views", "interval": 100}}})
+    counts = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]}
+    assert counts[0.0] == 2 and counts[100.0] == 1 and counts[500.0] == 1
+
+
+def test_date_histogram_agg(engine):
+    out = agg(engine, {"d": {"date_histogram": {"field": "published",
+                                                "calendar_interval": "year"}}})
+    buckets = out["d"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [3, 2]
+    assert buckets[0]["key_as_string"].startswith("2020-01-01")
+
+
+def test_range_agg_with_subagg(engine):
+    out = agg(engine, {"r": {"range": {"field": "views",
+                                       "ranges": [{"to": 100}, {"from": 100}]},
+                             "aggs": {"avg_price": {"avg": {"field": "price"}}}}})
+    b = out["r"]["buckets"]
+    assert b[0]["doc_count"] == 2 and b[1]["doc_count"] == 3
+    assert b[0]["avg_price"]["value"] == pytest.approx((19.99 + 4.5) / 2)
+
+
+def test_filters_agg(engine):
+    out = agg(engine, {"f": {"filters": {"filters": {
+        "animals": {"term": {"tag": "animal"}},
+        "active": {"term": {"active": True}}}}}})
+    assert out["f"]["buckets"]["animals"]["doc_count"] == 2
+    assert out["f"]["buckets"]["active"]["doc_count"] == 3
+
+
+def test_pipeline_aggs(engine):
+    out = agg(engine, {
+        "years": {"date_histogram": {"field": "published", "calendar_interval": "year"},
+                  "aggs": {"total_views": {"sum": {"field": "views"}}}},
+        "avg_per_year": {"avg_bucket": {"buckets_path": "years>total_views"}},
+        "max_year": {"max_bucket": {"buckets_path": "years>total_views"}},
+    })
+    assert out["avg_per_year"]["value"] == pytest.approx((650 + 275) / 2)
+    assert out["max_year"]["value"] == pytest.approx(650.0)
+
+
+def test_cumulative_and_derivative(engine):
+    out = agg(engine, {
+        "years": {"date_histogram": {"field": "published", "calendar_interval": "year"},
+                  "aggs": {"v": {"sum": {"field": "views"}},
+                           "cum": {"cumulative_sum": {"buckets_path": "v"}},
+                           "deriv": {"derivative": {"buckets_path": "v"}}}}})
+    buckets = out["years"]["buckets"]
+    assert buckets[0]["cum"]["value"] == pytest.approx(650.0)
+    assert buckets[1]["cum"]["value"] == pytest.approx(925.0)
+    assert buckets[1]["deriv"]["value"] == pytest.approx(275.0 - 650.0)
+
+
+def test_composite_agg(engine):
+    out = agg(engine, {"c": {"composite": {
+        "sources": [{"tag": {"terms": {"field": "tag"}}}], "size": 2}}})
+    assert len(out["c"]["buckets"]) == 2
+    after = out["c"]["after_key"]
+    out2 = agg(engine, {"c": {"composite": {
+        "sources": [{"tag": {"terms": {"field": "tag"}}}], "size": 10, "after": after}}})
+    keys = [b["key"]["tag"] for b in out2["c"]["buckets"]]
+    assert keys == sorted(keys)
+    total = len(out["c"]["buckets"]) + len(out2["c"]["buckets"])
+    assert total == 4
