@@ -1,0 +1,54 @@
+"""Document → shard routing.
+
+Re-design of `cluster/routing/OperationRouting.java`: shard = murmur3_32(
+routing_key) mod num_shards, where routing key defaults to the document id.
+The murmur3 implementation matches the x86 32-bit variant the reference uses
+(`common/hash/MurmurHash3`/Lucene StringHelper.murmurhash3_x86_32 over the
+UTF-8 bytes, seed 0), so routing is wire-compatible with the reference's
+placement for the same ids.
+"""
+
+from __future__ import annotations
+
+
+def murmur3_x86_32(data: bytes, seed: int = 0) -> int:
+    """32-bit MurmurHash3 (x86 variant), returns signed-style int in [0, 2^32)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    length = len(data)
+    rounded = length & ~0x3
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = length & 0x3
+    if tail >= 3:
+        k ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k ^= data[rounded]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def shard_id_for(routing: str, num_shards: int, routing_partition_size: int = 1) -> int:
+    """OperationRouting.generateShardId: murmur3(routing) floorMod num_shards."""
+    h = murmur3_x86_32(routing.encode("utf-8"))
+    # to Java signed int then floorMod
+    signed = h - (1 << 32) if h >= (1 << 31) else h
+    return signed % num_shards

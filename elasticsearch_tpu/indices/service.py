@@ -1,0 +1,270 @@
+"""Index and shard lifecycle on one node.
+
+Re-design of `indices/IndicesService` + `index/IndexService` + `IndexShard`
+(SURVEY.md §2.4, layer 9): an index is settings + mappings + N shard engines;
+each shard pairs a host engine (postings/doc-values/translog) with a device
+vector store. Single-node scope here; the cluster layer routes shard copies
+across nodes on top of this.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, IndexNotFoundError, ResourceAlreadyExistsError,
+    ValidationError,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.cluster.routing import shard_id_for
+from elasticsearch_tpu.index.engine import Engine, EngineResult
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import ShardReader, SegmentView
+from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+_INDEX_NAME_RE = re.compile(r"^[^A-Z\\/*?\"<>| ,#:][^A-Z\\/*?\"<>| ,#]*$")
+
+# Rebased multi-shard row space: shard s contributes rows in
+# [s * SHARD_ROW_SPACE, (s+1) * SHARD_ROW_SPACE).
+SHARD_ROW_SPACE = 1 << 40
+
+
+class IndexShardHandle:
+    """One local shard: engine + device vector store + refresh plumbing."""
+
+    def __init__(self, index_name: str, shard_id: int, path: str,
+                 mapper_service: MapperService, translog_sync: str = "request",
+                 vector_dtype: str = "bf16"):
+        self.index_name = index_name
+        self.shard_id = shard_id
+        self.engine = Engine(path, mapper_service, translog_sync=translog_sync)
+        self.vector_store = VectorStoreShard(dtype=vector_dtype)
+        self.mapper_service = mapper_service
+        self._sync_vectors(self.engine.acquire_searcher())
+        self.engine.add_refresh_listener(self._sync_vectors)
+
+    def _sync_vectors(self, reader: ShardReader) -> None:
+        vf = self.mapper_service.vector_fields()
+        if vf:
+            self.vector_store.sync(reader, vf)
+
+    def close(self):
+        self.engine.close()
+
+
+class IndexService:
+    def __init__(self, name: str, path: str, settings: Settings, mapping: dict,
+                 uuid: str):
+        self.name = name
+        self.path = path
+        self.uuid = uuid
+        self.settings = settings
+        self.creation_date = int(time.time() * 1000)
+        self.mapper_service = MapperService(mapping or {"properties": {}})
+        self.num_shards = int(settings.get("index.number_of_shards", 1))
+        self.num_replicas = int(settings.get("index.number_of_replicas", 1))
+        if self.num_shards < 1 or self.num_shards > 1024:
+            raise IllegalArgumentError(
+                f"index [{name}]: number_of_shards must be in [1, 1024], "
+                f"got {self.num_shards}")
+        sync = settings.get("index.translog.durability", "request")
+        sync = "request" if sync == "request" else "async"
+        vec_dtype = settings.get("index.knn.vector_dtype", "bf16")
+        self.shards: List[IndexShardHandle] = []
+        for s in range(self.num_shards):
+            self.shards.append(IndexShardHandle(
+                name, s, os.path.join(path, str(s)), self.mapper_service,
+                translog_sync=sync, vector_dtype=vec_dtype))
+        self.aliases: Dict[str, dict] = {}
+
+    def route(self, doc_id: str, routing: Optional[str] = None) -> IndexShardHandle:
+        sid = shard_id_for(routing if routing is not None else doc_id, self.num_shards)
+        return self.shards[sid]
+
+    def refresh(self):
+        for s in self.shards:
+            s.engine.refresh()
+
+    def flush(self):
+        for s in self.shards:
+            s.engine.flush()
+
+    def force_merge(self):
+        for s in self.shards:
+            s.engine.merge()
+
+    def doc_count(self) -> int:
+        return sum(s.engine.doc_count() for s in self.shards)
+
+    def combined_reader(self) -> ShardReader:
+        """A reader spanning all local shards with rebased global rows.
+
+        Single-node aggregation scope: cross-shard aggs run over this merged
+        view (the distributed layer replaces this with per-shard partials +
+        coordinator reduce, `SearchPhaseController.reduceAggs`).
+        """
+        views = []
+        for s in self.shards:
+            offset = s.shard_id * SHARD_ROW_SPACE
+            for view in s.engine.acquire_searcher().views:
+                seg = copy.copy(view.segment)
+                seg.base = view.segment.base + offset
+                v2 = SegmentView.__new__(SegmentView)
+                v2.segment = seg
+                v2.live = view.live
+                views.append(v2)
+        return ShardReader(views)
+
+    def shard_of_row(self, row: int) -> IndexShardHandle:
+        return self.shards[row // SHARD_ROW_SPACE]
+
+    def close(self):
+        for s in self.shards:
+            s.close()
+
+
+class IndicesService:
+    def __init__(self, data_path: str):
+        self.data_path = data_path
+        os.makedirs(data_path, exist_ok=True)
+        self.indices: Dict[str, IndexService] = {}
+        self._uuid_counter = 0
+        self._load_existing()
+
+    # -- persistence of index metadata ---------------------------------------
+    def _meta_path(self, name: str) -> str:
+        return os.path.join(self.data_path, name, "index_meta.json")
+
+    def _load_existing(self) -> None:
+        import json
+        if not os.path.isdir(self.data_path):
+            return
+        for name in sorted(os.listdir(self.data_path)):
+            meta_file = self._meta_path(name)
+            if os.path.exists(meta_file):
+                with open(meta_file) as f:
+                    meta = json.load(f)
+                svc = IndexService(name, os.path.join(self.data_path, name),
+                                   Settings(meta.get("settings", {})),
+                                   meta.get("mappings", {}),
+                                   meta.get("uuid", name))
+                svc.aliases = meta.get("aliases", {})
+                self.indices[name] = svc
+
+    def _persist_meta(self, svc: IndexService) -> None:
+        import json
+        os.makedirs(os.path.dirname(self._meta_path(svc.name)), exist_ok=True)
+        with open(self._meta_path(svc.name), "w") as f:
+            json.dump({"settings": svc.settings.as_flat_dict(),
+                       "mappings": svc.mapper_service.to_dict(),
+                       "aliases": svc.aliases,
+                       "uuid": svc.uuid}, f)
+
+    # -- CRUD -----------------------------------------------------------------
+    def create_index(self, name: str, settings: Optional[dict] = None,
+                     mappings: Optional[dict] = None,
+                     aliases: Optional[dict] = None) -> IndexService:
+        self.validate_index_name(name)
+        if name in self.indices:
+            raise ResourceAlreadyExistsError(f"index [{name}] already exists", index=name)
+        flat = Settings.builder()
+        flat.put("index.number_of_shards", 1)
+        flat.put("index.number_of_replicas", 1)
+        if settings:
+            flat.put_dict(settings if "index" in settings or any(
+                k.startswith("index.") for k in settings) else {"index": settings})
+        s = flat.build()
+        self._uuid_counter += 1
+        uuid = f"{name}-{self._uuid_counter:08x}"
+        svc = IndexService(name, os.path.join(self.data_path, name), s,
+                           mappings, uuid)
+        if aliases:
+            svc.aliases = {a: (spec or {}) for a, spec in aliases.items()}
+        self.indices[name] = svc
+        self._persist_meta(svc)
+        return svc
+
+    def delete_index(self, name: str) -> None:
+        svc = self.indices.pop(name, None)
+        if svc is None:
+            raise IndexNotFoundError(name)
+        svc.close()
+        shutil.rmtree(svc.path, ignore_errors=True)
+
+    def get(self, name: str) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            # alias resolution
+            for s in self.indices.values():
+                if name in s.aliases:
+                    return s
+            raise IndexNotFoundError(name)
+        return svc
+
+    def exists(self, name: str) -> bool:
+        if name in self.indices:
+            return True
+        return any(name in s.aliases for s in self.indices.values())
+
+    def resolve(self, expression: Optional[str]) -> List[IndexService]:
+        """Resolve a comma/wildcard index expression (reference:
+        IndexNameExpressionResolver)."""
+        if expression in (None, "", "_all", "*"):
+            return list(self.indices.values())
+        out = []
+        seen = set()
+        for part in expression.split(","):
+            part = part.strip()
+            if "*" in part:
+                pat = re.compile("^" + part.replace(".", r"\.").replace("*", ".*") + "$")
+                matched = [s for n, s in self.indices.items() if pat.match(n)]
+                for s in self.indices.values():
+                    if any(pat.match(a) for a in s.aliases):
+                        matched.append(s)
+                for m in matched:
+                    if m.name not in seen:
+                        seen.add(m.name)
+                        out.append(m)
+            else:
+                svc = self.get(part)
+                if svc.name not in seen:
+                    seen.add(svc.name)
+                    out.append(svc)
+        return out
+
+    @staticmethod
+    def validate_index_name(name: str) -> None:
+        if not name or name in (".", "..") or name.startswith(("-", "_", "+")) \
+                or not _INDEX_NAME_RE.match(name) or len(name.encode()) > 255:
+            raise ValidationError(
+                f"Invalid index name [{name}]", index=name)
+
+    def update_mapping(self, name: str, mapping: dict) -> None:
+        svc = self.get(name)
+        svc.mapper_service.merge(mapping)
+        self._persist_meta(svc)
+
+    def update_aliases(self, actions: List[dict]) -> None:
+        for action in actions:
+            if "add" in action:
+                spec = action["add"]
+                svc = self.get(spec["index"])
+                svc.aliases[spec["alias"]] = {
+                    k: v for k, v in spec.items() if k not in ("index", "alias")}
+                self._persist_meta(svc)
+            elif "remove" in action:
+                spec = action["remove"]
+                svc = self.get(spec["index"])
+                svc.aliases.pop(spec["alias"], None)
+                self._persist_meta(svc)
+            else:
+                raise IllegalArgumentError("alias action must be add or remove")
+
+    def close(self):
+        for svc in self.indices.values():
+            svc.close()

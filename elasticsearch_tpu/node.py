@@ -1,0 +1,531 @@
+"""Node: the composition root and API facade.
+
+Re-design of `node/Node.java:275` (layer 3) for a single node: wires
+IndicesService, the search coordinator, and the document APIs the REST layer
+exposes. The cluster layer (coordination/replication over the transport)
+mounts on top of these same internal APIs, mirroring how the reference's
+TransportActions call into the node's services.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import uuid as _uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError, IllegalArgumentError, SearchEngineError,
+    VersionConflictError,
+)
+from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY
+from elasticsearch_tpu.indices.service import (
+    SHARD_ROW_SPACE, IndexService, IndicesService,
+)
+from elasticsearch_tpu.search.service import (
+    execute_fetch_phase, execute_query_phase,
+)
+from elasticsearch_tpu.version import __version__
+
+
+class _MultiShardVectorStore:
+    """Scatter-gather adapter: per-shard device kNN + host merge, with rows
+    rebased into the combined reader's row space.
+
+    This is the host-coordinated analog of the compiled ICI all-gather merge
+    (`parallel/sharded_knn.py`); on one node the per-shard corpora may live on
+    one or several devices.
+    """
+
+    def __init__(self, svc: IndexService):
+        self.svc = svc
+
+    def field(self, name: str):
+        for shard in self.svc.shards:
+            fc = shard.vector_store.field(name)
+            if fc is not None:
+                return fc
+        return None
+
+    def search(self, field: str, query_vector, k: int, filter_rows=None,
+               precision: str = "bf16"):
+        all_rows, all_scores = [], []
+        for shard in self.svc.shards:
+            offset = shard.shard_id * SHARD_ROW_SPACE
+            frows = None
+            if filter_rows is not None:
+                local = filter_rows[(filter_rows >= offset)
+                                    & (filter_rows < offset + SHARD_ROW_SPACE)] - offset
+                frows = local
+            rows, scores = shard.vector_store.search(field, query_vector, k,
+                                                     filter_rows=frows,
+                                                     precision=precision)
+            all_rows.append(rows + offset)
+            all_scores.append(scores)
+        if not all_rows:
+            return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float32)
+        rows = np.concatenate(all_rows)
+        scores = np.concatenate(all_scores)
+        # global top-k with shard-order tie-break (stable sort over concat)
+        order = np.argsort(-scores, kind="stable")[:k]
+        return rows[order], scores[order]
+
+
+class Node:
+    def __init__(self, data_path: str, node_name: str = "node-0",
+                 cluster_name: str = "tpu-search"):
+        self.node_id = _uuid.uuid4().hex[:20]
+        self.node_name = node_name
+        self.cluster_name = cluster_name
+        self.indices = IndicesService(data_path)
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------- documents
+    def index_doc(self, index: str, doc_id: Optional[str], body: dict,
+                  op_type: str = "index", refresh: Optional[str] = None,
+                  routing: Optional[str] = None,
+                  if_seq_no: Optional[int] = None,
+                  if_primary_term: Optional[int] = None,
+                  version: Optional[int] = None,
+                  version_type: str = "internal") -> dict:
+        svc = self._index_or_autocreate(index)
+        if doc_id is None:
+            doc_id = _uuid.uuid4().hex[:20]
+            op_type = "create"
+        shard = svc.route(doc_id, routing)
+        result = shard.engine.index(
+            doc_id, body, op_type=op_type, if_seq_no=if_seq_no,
+            if_primary_term=if_primary_term, version=version,
+            version_type=version_type)
+        self._maybe_refresh(svc, refresh)
+        self.indices._persist_meta(svc)  # dynamic mapping updates
+        return {
+            "_index": svc.name, "_id": doc_id, "_version": result.version,
+            "result": result.result, "_seq_no": result.seq_no,
+            "_primary_term": result.primary_term,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+        }
+
+    def get_doc(self, index: str, doc_id: str, routing: Optional[str] = None,
+                source_includes=None) -> dict:
+        svc = self.indices.get(index)
+        shard = svc.route(doc_id, routing)
+        doc = shard.engine.get(doc_id)
+        if doc is None:
+            return {"_index": svc.name, "_id": doc_id, "found": False}
+        out = {"_index": svc.name, "_id": doc_id, "_version": doc["_version"],
+               "_seq_no": doc["_seq_no"], "_primary_term": doc["_primary_term"],
+               "found": True, "_source": doc["_source"]}
+        return out
+
+    def delete_doc(self, index: str, doc_id: str, refresh: Optional[str] = None,
+                   routing: Optional[str] = None,
+                   if_seq_no: Optional[int] = None,
+                   if_primary_term: Optional[int] = None) -> dict:
+        svc = self.indices.get(index)
+        shard = svc.route(doc_id, routing)
+        result = shard.engine.delete(doc_id, if_seq_no=if_seq_no,
+                                     if_primary_term=if_primary_term)
+        self._maybe_refresh(svc, refresh)
+        return {"_index": svc.name, "_id": doc_id, "_version": result.version,
+                "result": "deleted", "_seq_no": result.seq_no,
+                "_primary_term": result.primary_term,
+                "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   refresh: Optional[str] = None) -> dict:
+        """_update API: partial doc merge, script update, upsert.
+
+        Reference: `action/update/UpdateHelper.java`.
+        """
+        svc = self.indices.get(index)
+        shard = svc.route(doc_id, None)
+        existing = shard.engine.get(doc_id)
+        if existing is None:
+            if "upsert" in body:
+                return self.index_doc(index, doc_id, body["upsert"], refresh=refresh)
+            if body.get("doc_as_upsert") and "doc" in body:
+                return self.index_doc(index, doc_id, body["doc"], refresh=refresh)
+            raise DocumentMissingError(f"[{doc_id}]: document missing")
+        source = copy.deepcopy(existing["_source"])
+        if "doc" in body:
+            _deep_merge(source, body["doc"])
+        elif "script" in body:
+            source = _apply_update_script(source, body["script"])
+        else:
+            raise IllegalArgumentError("update requires [doc] or [script]")
+        out = self.index_doc(index, doc_id, source, refresh=refresh,
+                             if_seq_no=existing["_seq_no"],
+                             if_primary_term=existing["_primary_term"])
+        out["result"] = "updated"
+        return out
+
+    def mget(self, body: dict, default_index: Optional[str] = None) -> dict:
+        docs = []
+        for spec in body.get("docs", []):
+            index = spec.get("_index", default_index)
+            try:
+                docs.append(self.get_doc(index, spec["_id"]))
+            except SearchEngineError as e:
+                docs.append({"_index": index, "_id": spec.get("_id"),
+                             "error": e.to_dict()})
+        if "ids" in body and default_index:
+            for doc_id in body["ids"]:
+                docs.append(self.get_doc(default_index, doc_id))
+        return {"docs": docs}
+
+    def bulk(self, operations: List[dict], default_index: Optional[str] = None,
+             refresh: Optional[str] = None) -> dict:
+        """_bulk: list of {action: meta} / source pairs already decoded.
+
+        Reference: `TransportBulkAction` §3.3 — here single-node, grouped by
+        shard implicitly by the engine's per-shard lock.
+        """
+        items = []
+        errors = False
+        touched = set()
+        i = 0
+        while i < len(operations):
+            action_line = operations[i]
+            i += 1
+            ((action, meta),) = action_line.items()
+            index = meta.get("_index", default_index)
+            doc_id = meta.get("_id")
+            try:
+                if action in ("index", "create"):
+                    source = operations[i]
+                    i += 1
+                    resp = self.index_doc(index, doc_id, source,
+                                          op_type="create" if action == "create" else "index")
+                    status = 201 if resp["result"] == "created" else 200
+                elif action == "update":
+                    body = operations[i]
+                    i += 1
+                    resp = self.update_doc(index, doc_id, body)
+                    status = 200
+                elif action == "delete":
+                    resp = self.delete_doc(index, doc_id)
+                    status = 200
+                else:
+                    raise IllegalArgumentError(
+                        f"Malformed action/metadata line, found [{action}]")
+                touched.add(resp["_index"])
+                items.append({action: {**resp, "status": status}})
+            except SearchEngineError as e:
+                errors = True
+                if action in ("index", "create", "update") and i <= len(operations):
+                    pass
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": e.status, "error": e.to_dict()}})
+        if refresh in ("true", "wait_for", True):
+            for name in touched:
+                self.indices.get(name).refresh()
+        return {"took": 0, "errors": errors, "items": items}
+
+    def _index_or_autocreate(self, index: str) -> IndexService:
+        if not self.indices.exists(index):
+            # auto-create with defaults (reference: TransportBulkAction auto-create)
+            return self.indices.create_index(index)
+        return self.indices.get(index)
+
+    @staticmethod
+    def _maybe_refresh(svc: IndexService, refresh) -> None:
+        if refresh in ("true", "wait_for", True, ""):
+            svc.refresh()
+
+    # ---------------------------------------------------------------- search
+    def search(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
+        body = body or {}
+        start = time.perf_counter()
+        services = self.indices.resolve(index_expr)
+        readers = []
+        for svc in services:
+            reader = svc.combined_reader()
+            store = _MultiShardVectorStore(svc)
+            readers.append((svc, reader, store))
+
+        # execute per index, merge across indices by score/sort
+        all_hits = []
+        total = 0
+        relation = "eq"
+        max_score = None
+        merged_aggs = None
+        for svc, reader, store in readers:
+            result = execute_query_phase(reader, svc.mapper_service, body,
+                                         vector_store=store)
+            total += result.total_hits
+            if result.total_relation == "gte":
+                relation = "gte"
+            if result.max_score is not None:
+                max_score = max(max_score or -1e30, result.max_score)
+            hits = execute_fetch_phase(reader, svc.mapper_service, body, result,
+                                       index_name=svc.name)
+            for h, score, sv in zip(hits, result.scores,
+                                    result.sort_values or [None] * len(hits)):
+                all_hits.append((h, float(score), sv))
+            if result.aggregations is not None:
+                if merged_aggs is None:
+                    merged_aggs = result.aggregations
+                else:
+                    merged_aggs = _merge_agg_trees(merged_aggs, result.aggregations)
+
+        sort_spec = body.get("sort")
+        if sort_spec:
+            all_hits.sort(key=lambda t: _sort_key_tuple(t[2], body))
+        else:
+            all_hits.sort(key=lambda t: -t[1])
+        frm = int(body.get("from", 0) or 0)
+        size = int(body.get("size", 10) if body.get("size") is not None else 10)
+        window = all_hits[frm:frm + size]
+
+        resp = {
+            "took": int((time.perf_counter() - start) * 1000),
+            "timed_out": False,
+            "_shards": {"total": sum(s.num_shards for s, _, _ in readers),
+                        "successful": sum(s.num_shards for s, _, _ in readers),
+                        "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": total, "relation": relation},
+                "max_score": max_score,
+                "hits": [h for h, _, _ in window],
+            },
+        }
+        if merged_aggs is not None:
+            resp["aggregations"] = merged_aggs
+        return resp
+
+    def count(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        body.pop("sort", None)
+        total = 0
+        for svc in self.indices.resolve(index_expr):
+            reader = svc.combined_reader()
+            result = execute_query_phase(reader, svc.mapper_service,
+                                         {**body, "track_total_hits": True},
+                                         vector_store=_MultiShardVectorStore(svc))
+            total += result.total_hits
+        return {"count": total, "_shards": {"total": 1, "successful": 1,
+                                            "skipped": 0, "failed": 0}}
+
+    def msearch(self, lines: List[dict]) -> dict:
+        responses = []
+        i = 0
+        while i < len(lines):
+            header = lines[i]
+            i += 1
+            body = lines[i] if i < len(lines) else {}
+            i += 1
+            try:
+                responses.append(self.search(header.get("index"), body))
+            except SearchEngineError as e:
+                responses.append({"error": e.to_dict(), "status": e.status})
+        return {"took": 0, "responses": responses}
+
+    def analyze(self, body: dict) -> dict:
+        text = body.get("text", "")
+        texts = text if isinstance(text, list) else [text]
+        analyzer = DEFAULT_REGISTRY.get(body.get("analyzer", "standard"))
+        tokens = []
+        pos = 0
+        for t in texts:
+            for tok in analyzer.analyze(str(t)):
+                tokens.append({"token": tok.term, "start_offset": tok.start_offset,
+                               "end_offset": tok.end_offset, "type": "<ALPHANUM>",
+                               "position": pos + tok.position})
+            pos += len(tokens)
+        return {"tokens": tokens}
+
+    # ----------------------------------------------------------------- stats
+    def cluster_health(self) -> dict:
+        n = len(self.indices.indices)
+        shards = sum(s.num_shards for s in self.indices.indices.values())
+        return {
+            "cluster_name": self.cluster_name, "status": "green",
+            "timed_out": False, "number_of_nodes": 1,
+            "number_of_data_nodes": 1, "active_primary_shards": shards,
+            "active_shards": shards, "relocating_shards": 0,
+            "initializing_shards": 0, "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0, "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0, "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def index_stats(self, name: str) -> dict:
+        svc = self.indices.get(name)
+        docs = svc.doc_count()
+        segs = sum(len(s.engine.segments) for s in svc.shards)
+        return {"_all": {"primaries": {"docs": {"count": docs, "deleted": 0},
+                                       "segments": {"count": segs}}},
+                "indices": {svc.name: {"primaries": {"docs": {"count": docs}}}}}
+
+    def close(self):
+        self.indices.close()
+
+
+# ---------------------------------------------------------------------------
+
+def _deep_merge(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _apply_update_script(source: dict, script_spec) -> dict:
+    """Update scripts: support `ctx._source.field = expr` statements."""
+    import ast
+
+    if isinstance(script_spec, str):
+        script_spec = {"source": script_spec}
+    src = script_spec.get("source", "")
+    params = script_spec.get("params", {})
+    ctx_obj = {"_source": source}
+
+    class Ctx:
+        pass
+
+    for stmt in src.split(";"):
+        stmt = stmt.strip()
+        if not stmt:
+            continue
+        try:
+            tree = ast.parse(stmt, mode="exec")
+        except SyntaxError as e:
+            raise IllegalArgumentError(f"compile error in update script: {e}")
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                path = _attr_path(target)
+                if not path or path[0] != "ctx" or path[1] != "_source":
+                    raise IllegalArgumentError("update scripts may only assign ctx._source.*")
+                value = _eval_simple(node.value, source, params)
+                obj = source
+                for p in path[2:-1]:
+                    obj = obj.setdefault(p, {})
+                obj[path[-1]] = value
+            elif isinstance(node, ast.AugAssign):
+                path = _attr_path(node.target)
+                if not path or path[0] != "ctx" or path[1] != "_source":
+                    raise IllegalArgumentError("update scripts may only assign ctx._source.*")
+                obj = source
+                for p in path[2:-1]:
+                    obj = obj.setdefault(p, {})
+                cur = obj.get(path[-1], 0)
+                delta = _eval_simple(node.value, source, params)
+                if isinstance(node.op, ast.Add):
+                    obj[path[-1]] = cur + delta
+                elif isinstance(node.op, ast.Sub):
+                    obj[path[-1]] = cur - delta
+                elif isinstance(node.op, ast.Mult):
+                    obj[path[-1]] = cur * delta
+                else:
+                    raise IllegalArgumentError("unsupported update operator")
+            else:
+                raise IllegalArgumentError("update scripts support only assignments")
+    return source
+
+
+def _attr_path(node) -> Optional[List[str]]:
+    import ast
+    parts: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        else:
+            if isinstance(node.slice, ast.Constant):
+                parts.append(str(node.slice.value))
+            node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _eval_simple(node, source: dict, params: dict):
+    import ast
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.List):
+        return [_eval_simple(e, source, params) for e in node.elts]
+    if isinstance(node, (ast.Attribute, ast.Subscript, ast.Name)):
+        path = _attr_path(node)
+        if path and path[0] == "params":
+            obj: Any = params
+            for p in path[1:]:
+                obj = obj[p]
+            return obj
+        if path and path[0] == "ctx" and len(path) > 1 and path[1] == "_source":
+            obj = source
+            for p in path[2:]:
+                obj = obj.get(p) if isinstance(obj, dict) else None
+            return obj
+    if isinstance(node, ast.BinOp):
+        left = _eval_simple(node.left, source, params)
+        right = _eval_simple(node.right, source, params)
+        ops = {ast.Add: lambda a, b: a + b, ast.Sub: lambda a, b: a - b,
+               ast.Mult: lambda a, b: a * b, ast.Div: lambda a, b: a / b}
+        return ops[type(node.op)](left, right)
+    raise IllegalArgumentError("unsupported expression in update script")
+
+
+def _sort_key_tuple(sort_values, body):
+    sort = body.get("sort")
+    if isinstance(sort, (str, dict)):
+        sort = [sort]
+    keys = []
+    for spec, v in zip(sort or [], sort_values or []):
+        direction = "asc"
+        if isinstance(spec, dict):
+            ((_, o),) = spec.items()
+            direction = o if isinstance(o, str) else o.get("order", "asc")
+        if v is None:
+            v = float("inf")
+        if isinstance(v, str):
+            keys.append(v if direction == "asc" else _InvStr(v))
+        else:
+            keys.append(float(v) if direction == "asc" else -float(v))
+    return tuple(keys)
+
+
+class _InvStr:
+    """Inverted string ordering for desc sorts in tuple keys."""
+
+    __slots__ = ("s",)
+
+    def __init__(self, s):
+        self.s = s
+
+    def __lt__(self, other):
+        return self.s > other.s
+
+    def __eq__(self, other):
+        return self.s == other.s
+
+
+def _merge_agg_trees(a: dict, b: dict) -> dict:
+    """Best-effort cross-index agg merge (single-node scope: same-shaped trees)."""
+    out = dict(a)
+    for k, v in b.items():
+        if k not in out:
+            out[k] = v
+        elif isinstance(v, dict) and isinstance(out[k], dict):
+            if "buckets" in v and "buckets" in out[k]:
+                merged: Dict[Any, dict] = {}
+                for bucket in (out[k]["buckets"] if isinstance(out[k]["buckets"], list) else []):
+                    merged[bucket.get("key")] = dict(bucket)
+                for bucket in (v["buckets"] if isinstance(v["buckets"], list) else []):
+                    key = bucket.get("key")
+                    if key in merged:
+                        merged[key]["doc_count"] += bucket.get("doc_count", 0)
+                    else:
+                        merged[key] = dict(bucket)
+                out[k] = {**out[k], "buckets": sorted(
+                    merged.values(), key=lambda x: -x.get("doc_count", 0))}
+            elif "value" in v and "value" in out[k]:
+                # sums merge; others take max sensibly? keep first (documented limit)
+                out[k] = out[k]
+    return out

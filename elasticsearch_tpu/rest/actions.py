@@ -1,0 +1,400 @@
+"""REST handlers: the API surface table.
+
+Covers the core of the reference's 124 handlers (`action/ActionModule.java`
+initRestHandlers + `rest-api-spec/api/*.json` contract): document CRUD,
+_bulk/_mget/_update, _search/_count/_msearch, index admin (create/delete/
+mapping/settings/refresh/flush/forcemerge/aliases/stats/exists), _analyze,
+cluster health/state/stats, _cat APIs, and the root banner.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError, IllegalArgumentError, IndexNotFoundError,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.version import __version__
+
+
+def register_all(rc: RestController, node: Node) -> None:
+    # ------------------------------------------------------------------ root
+    def root(req):
+        return 200, {
+            "name": node.node_name, "cluster_name": node.cluster_name,
+            "cluster_uuid": node.node_id,
+            "version": {"number": __version__,
+                        "build_flavor": "tpu", "lucene_version": "none"},
+            "tagline": "You Know, for (TPU) Search",
+        }
+
+    rc.register("GET", "/", root)
+
+    # ------------------------------------------------------------- documents
+    def put_doc(req):
+        resp = node.index_doc(
+            req.params["index"], req.params.get("id"), req.json() or {},
+            op_type=req.param("op_type", "index"),
+            refresh=req.param("refresh"),
+            routing=req.param("routing"),
+            if_seq_no=req.int_param("if_seq_no"),
+            if_primary_term=req.int_param("if_primary_term"),
+            version=req.int_param("version"),
+            version_type=req.param("version_type", "internal"))
+        return (201 if resp["result"] == "created" else 200), resp
+
+    def post_doc_auto_id(req):
+        resp = node.index_doc(req.params["index"], None, req.json() or {},
+                              refresh=req.param("refresh"))
+        return 201, resp
+
+    def create_doc(req):
+        resp = node.index_doc(req.params["index"], req.params["id"],
+                              req.json() or {}, op_type="create",
+                              refresh=req.param("refresh"))
+        return 201, resp
+
+    def get_doc(req):
+        resp = node.get_doc(req.params["index"], req.params["id"],
+                            routing=req.param("routing"))
+        return (200 if resp.get("found") else 404), resp
+
+    def get_source(req):
+        resp = node.get_doc(req.params["index"], req.params["id"])
+        if not resp.get("found"):
+            return 404, {"error": f"document [{req.params['id']}] not found"}
+        return 200, resp["_source"]
+
+    def delete_doc(req):
+        try:
+            resp = node.delete_doc(req.params["index"], req.params["id"],
+                                   refresh=req.param("refresh"),
+                                   if_seq_no=req.int_param("if_seq_no"),
+                                   if_primary_term=req.int_param("if_primary_term"))
+            return 200, resp
+        except DocumentMissingError:
+            return 404, {"_index": req.params["index"], "_id": req.params["id"],
+                         "result": "not_found"}
+
+    def update_doc(req):
+        return 200, node.update_doc(req.params["index"], req.params["id"],
+                                    req.json() or {}, refresh=req.param("refresh"))
+
+    rc.register("PUT", "/{index}/_doc/{id}", put_doc)
+    rc.register("POST", "/{index}/_doc/{id}", put_doc)
+    rc.register("POST", "/{index}/_doc", post_doc_auto_id)
+    rc.register("PUT", "/{index}/_create/{id}", create_doc)
+    rc.register("POST", "/{index}/_create/{id}", create_doc)
+    rc.register("GET", "/{index}/_doc/{id}", get_doc)
+    rc.register("HEAD", "/{index}/_doc/{id}", get_doc)
+    rc.register("GET", "/{index}/_source/{id}", get_source)
+    rc.register("DELETE", "/{index}/_doc/{id}", delete_doc)
+    rc.register("POST", "/{index}/_update/{id}", update_doc)
+
+    def bulk(req):
+        return 200, node.bulk(req.ndjson(),
+                              default_index=req.params.get("index"),
+                              refresh=req.param("refresh"))
+
+    rc.register("POST", "/_bulk", bulk)
+    rc.register("PUT", "/_bulk", bulk)
+    rc.register("POST", "/{index}/_bulk", bulk)
+
+    def mget(req):
+        return 200, node.mget(req.json() or {}, req.params.get("index"))
+
+    rc.register("GET", "/_mget", mget)
+    rc.register("POST", "/_mget", mget)
+    rc.register("GET", "/{index}/_mget", mget)
+    rc.register("POST", "/{index}/_mget", mget)
+
+    # ---------------------------------------------------------------- search
+    def search(req):
+        body = req.json() or {}
+        # URI-search params (q=, size=, from=, sort=)
+        q = req.param("q")
+        if q:
+            body.setdefault("query", {"query_string": {"query": q}})
+            # minimal query_string: treat as multi-field match
+            body["query"] = _query_string_to_dsl(q)
+        for p, key in (("size", "size"), ("from", "from")):
+            v = req.int_param(p)
+            if v is not None:
+                body[key] = v
+        sort = req.param("sort")
+        if sort:
+            body["sort"] = [
+                {s.split(":")[0]: s.split(":")[1]} if ":" in s else s
+                for s in sort.split(",")]
+        return 200, node.search(req.params.get("index"), body)
+
+    rc.register("GET", "/_search", search)
+    rc.register("POST", "/_search", search)
+    rc.register("GET", "/{index}/_search", search)
+    rc.register("POST", "/{index}/_search", search)
+
+    def count(req):
+        return 200, node.count(req.params.get("index"), req.json())
+
+    rc.register("GET", "/_count", count)
+    rc.register("POST", "/_count", count)
+    rc.register("GET", "/{index}/_count", count)
+    rc.register("POST", "/{index}/_count", count)
+
+    def msearch(req):
+        return 200, node.msearch(req.ndjson())
+
+    rc.register("GET", "/_msearch", msearch)
+    rc.register("POST", "/_msearch", msearch)
+    rc.register("POST", "/{index}/_msearch", msearch)
+
+    def analyze(req):
+        return 200, node.analyze(req.json() or {})
+
+    rc.register("GET", "/_analyze", analyze)
+    rc.register("POST", "/_analyze", analyze)
+    rc.register("GET", "/{index}/_analyze", analyze)
+    rc.register("POST", "/{index}/_analyze", analyze)
+
+    # ----------------------------------------------------------- index admin
+    def create_index(req):
+        body = req.json() or {}
+        svc = node.indices.create_index(
+            req.params["index"], settings=body.get("settings"),
+            mappings=body.get("mappings"), aliases=body.get("aliases"))
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "index": svc.name}
+
+    def delete_index(req):
+        for svc in node.indices.resolve(req.params["index"]):
+            node.indices.delete_index(svc.name)
+        return 200, {"acknowledged": True}
+
+    def get_index(req):
+        out = {}
+        for svc in node.indices.resolve(req.params["index"]):
+            out[svc.name] = {
+                "aliases": svc.aliases,
+                "mappings": svc.mapper_service.to_dict(),
+                "settings": {"index": {
+                    **{k.replace("index.", "", 1): v
+                       for k, v in svc.settings.as_flat_dict().items()},
+                    "uuid": svc.uuid,
+                    "creation_date": str(svc.creation_date),
+                    "provided_name": svc.name,
+                }},
+            }
+        if not out:
+            raise IndexNotFoundError(req.params["index"])
+        return 200, out
+
+    def index_exists(req):
+        return (200 if all(node.indices.exists(p) or "*" in p
+                           for p in req.params["index"].split(","))
+                else 404), None
+
+    rc.register("PUT", "/{index}", create_index)
+    rc.register("DELETE", "/{index}", delete_index)
+    rc.register("GET", "/{index}", get_index)
+    rc.register("HEAD", "/{index}", index_exists)
+
+    def get_mapping(req):
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            out[svc.name] = {"mappings": svc.mapper_service.to_dict()}
+        return 200, out
+
+    def put_mapping(req):
+        node.indices.update_mapping(req.params["index"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    rc.register("GET", "/_mapping", get_mapping)
+    rc.register("GET", "/{index}/_mapping", get_mapping)
+    rc.register("PUT", "/{index}/_mapping", put_mapping)
+    rc.register("POST", "/{index}/_mapping", put_mapping)
+
+    def get_settings(req):
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            out[svc.name] = {"settings": {"index": {
+                **{k.replace("index.", "", 1): v
+                   for k, v in svc.settings.as_flat_dict().items()}}}}
+        return 200, out
+
+    rc.register("GET", "/_settings", get_settings)
+    rc.register("GET", "/{index}/_settings", get_settings)
+
+    def refresh(req):
+        for svc in node.indices.resolve(req.params.get("index")):
+            svc.refresh()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def flush(req):
+        for svc in node.indices.resolve(req.params.get("index")):
+            svc.flush()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def forcemerge(req):
+        for svc in node.indices.resolve(req.params.get("index")):
+            svc.force_merge()
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    rc.register("POST", "/_refresh", refresh)
+    rc.register("POST", "/{index}/_refresh", refresh)
+    rc.register("GET", "/{index}/_refresh", refresh)
+    rc.register("POST", "/_flush", flush)
+    rc.register("POST", "/{index}/_flush", flush)
+    rc.register("POST", "/_forcemerge", forcemerge)
+    rc.register("POST", "/{index}/_forcemerge", forcemerge)
+
+    def index_stats(req):
+        return 200, node.index_stats(req.params["index"])
+
+    rc.register("GET", "/{index}/_stats", index_stats)
+
+    def aliases_post(req):
+        node.indices.update_aliases((req.json() or {}).get("actions", []))
+        return 200, {"acknowledged": True}
+
+    def get_aliases(req):
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            out[svc.name] = {"aliases": svc.aliases}
+        return 200, out
+
+    def put_alias(req):
+        node.indices.update_aliases([{"add": {
+            "index": req.params["index"], "alias": req.params["alias"]}}])
+        return 200, {"acknowledged": True}
+
+    def delete_alias(req):
+        node.indices.update_aliases([{"remove": {
+            "index": req.params["index"], "alias": req.params["alias"]}}])
+        return 200, {"acknowledged": True}
+
+    rc.register("POST", "/_aliases", aliases_post)
+    rc.register("GET", "/_alias", get_aliases)
+    rc.register("GET", "/{index}/_alias", get_aliases)
+    rc.register("PUT", "/{index}/_alias/{alias}", put_alias)
+    rc.register("DELETE", "/{index}/_alias/{alias}", delete_alias)
+
+    # ---------------------------------------------------------------- cluster
+    def cluster_health(req):
+        return 200, node.cluster_health()
+
+    def cluster_stats(req):
+        total_docs = sum(s.doc_count() for s in node.indices.indices.values())
+        return 200, {
+            "cluster_name": node.cluster_name, "status": "green",
+            "indices": {"count": len(node.indices.indices),
+                        "docs": {"count": total_docs}},
+            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+        }
+
+    def cluster_state(req):
+        meta = {}
+        for name, svc in node.indices.indices.items():
+            meta[name] = {"settings": svc.settings.as_flat_dict(),
+                          "mappings": svc.mapper_service.to_dict(),
+                          "aliases": list(svc.aliases)}
+        return 200, {"cluster_name": node.cluster_name,
+                     "cluster_uuid": node.node_id, "version": 1,
+                     "master_node": node.node_id,
+                     "nodes": {node.node_id: {"name": node.node_name}},
+                     "metadata": {"indices": meta}}
+
+    def nodes_info(req):
+        return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                     "cluster_name": node.cluster_name,
+                     "nodes": {node.node_id: {
+                         "name": node.node_name, "version": __version__,
+                         "roles": ["master", "data", "ingest"]}}}
+
+    def nodes_stats(req):
+        import resource
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                     "cluster_name": node.cluster_name,
+                     "nodes": {node.node_id: {
+                         "name": node.node_name,
+                         "jvm": {"mem": {"heap_used_in_bytes": usage.ru_maxrss * 1024}},
+                         "process": {"cpu": {"total_in_millis": int(
+                             (usage.ru_utime + usage.ru_stime) * 1000)}},
+                         "indices": {"docs": {"count": sum(
+                             s.doc_count() for s in node.indices.indices.values())}}}}}
+
+    rc.register("GET", "/_cluster/health", cluster_health)
+    rc.register("GET", "/_cluster/stats", cluster_stats)
+    rc.register("GET", "/_cluster/state", cluster_state)
+    rc.register("GET", "/_nodes", nodes_info)
+    rc.register("GET", "/_nodes/stats", nodes_stats)
+
+    # -------------------------------------------------------------------- cat
+    def _cat_table(req, headers, rows) -> Tuple[int, Any]:
+        if req.param("format") == "json":
+            return 200, [dict(zip(headers, r)) for r in rows]
+        verbose = req.bool_param("v")
+        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+                  for i, h in enumerate(headers)]
+        lines = []
+        if verbose:
+            lines.append(" ".join(str(h).ljust(w) for h, w in zip(headers, widths)))
+        for r in rows:
+            lines.append(" ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+        return 200, "\n".join(lines) + "\n"
+
+    def cat_indices(req):
+        rows = []
+        for name, svc in sorted(node.indices.indices.items()):
+            rows.append(["green", "open", name, svc.uuid, svc.num_shards,
+                         svc.num_replicas, svc.doc_count(), 0, "0b", "0b"])
+        return _cat_table(req, ["health", "status", "index", "uuid", "pri",
+                                "rep", "docs.count", "docs.deleted",
+                                "store.size", "pri.store.size"], rows)
+
+    def cat_health(req):
+        h = node.cluster_health()
+        return _cat_table(req, ["cluster", "status", "node.total", "shards"],
+                          [[h["cluster_name"], h["status"],
+                            h["number_of_nodes"], h["active_shards"]]])
+
+    def cat_shards(req):
+        rows = []
+        for name, svc in sorted(node.indices.indices.items()):
+            for shard in svc.shards:
+                rows.append([name, shard.shard_id, "p", "STARTED",
+                             shard.engine.doc_count(), node.node_name])
+        return _cat_table(req, ["index", "shard", "prirep", "state",
+                                "docs", "node"], rows)
+
+    def cat_nodes(req):
+        return _cat_table(req, ["name", "node.role", "master"],
+                          [[node.node_name, "dim", "*"]])
+
+    def cat_count(req):
+        total = sum(s.doc_count() for s in node.indices.indices.values())
+        return _cat_table(req, ["epoch", "timestamp", "count"],
+                          [[int(time.time()), time.strftime("%H:%M:%S"), total]])
+
+    def cat_aliases(req):
+        rows = []
+        for name, svc in sorted(node.indices.indices.items()):
+            for alias in svc.aliases:
+                rows.append([alias, name, "-", "-", "-"])
+        return _cat_table(req, ["alias", "index", "filter", "routing.index",
+                                "routing.search"], rows)
+
+    rc.register("GET", "/_cat/indices", cat_indices)
+    rc.register("GET", "/_cat/health", cat_health)
+    rc.register("GET", "/_cat/shards", cat_shards)
+    rc.register("GET", "/_cat/nodes", cat_nodes)
+    rc.register("GET", "/_cat/count", cat_count)
+    rc.register("GET", "/_cat/aliases", cat_aliases)
+
+
+def _query_string_to_dsl(q: str) -> dict:
+    return {"query_string": {"query": q}}

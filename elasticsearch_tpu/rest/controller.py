@@ -1,0 +1,152 @@
+"""REST routing and dispatch.
+
+Re-design of `rest/RestController.java:62,146,168,271`: a path trie with
+{param} segments routes (method, path) to handlers; errors render as the
+reference's structured error body {"error": {...}, "status": N}. Handlers
+receive a RestRequest (params, query args, decoded body) and return
+(status, body) — transport-agnostic so the same table serves HTTP and tests.
+"""
+
+from __future__ import annotations
+
+import re
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common import xcontent
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError, SearchEngineError,
+)
+
+
+class RestRequest:
+    def __init__(self, method: str, path: str, params: Dict[str, str],
+                 query: Dict[str, str], body: bytes,
+                 content_type: Optional[str] = None):
+        self.method = method
+        self.path = path
+        self.params = params          # path template params
+        self.query = query            # query-string args
+        self.raw_body = body
+        self.content_type = content_type
+
+    def json(self) -> Any:
+        if not self.raw_body:
+            return None
+        ct = xcontent.XContentType.from_media_type(self.content_type)
+        return xcontent.loads(self.raw_body, ct)
+
+    def ndjson(self) -> List[Any]:
+        """Newline-delimited JSON bodies (_bulk, _msearch)."""
+        out = []
+        for line in self.raw_body.split(b"\n"):
+            line = line.strip()
+            if line:
+                out.append(xcontent.loads(line, xcontent.XContentType.JSON))
+        return out
+
+    def param(self, name: str, default: Any = None) -> Any:
+        if name in self.params:
+            return self.params[name]
+        return self.query.get(name, default)
+
+    def bool_param(self, name: str, default: bool = False) -> bool:
+        v = self.param(name)
+        if v is None:
+            return default
+        return v in ("", "true", "1", True)
+
+    def int_param(self, name: str, default: Optional[int] = None) -> Optional[int]:
+        v = self.param(name)
+        if v is None or v == "":
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise IllegalArgumentError(f"Failed to parse int parameter [{name}] with value [{v}]")
+
+
+Handler = Callable[[RestRequest], Tuple[int, Any]]
+
+
+class _TrieNode:
+    __slots__ = ("children", "param_child", "param_name", "handlers")
+
+    def __init__(self):
+        self.children: Dict[str, _TrieNode] = {}
+        self.param_child: Optional[_TrieNode] = None
+        self.param_name: Optional[str] = None
+        self.handlers: Dict[str, Handler] = {}
+
+
+class RestController:
+    def __init__(self):
+        self._root = _TrieNode()
+
+    def register(self, method: str, template: str, handler: Handler) -> None:
+        node = self._root
+        for seg in [s for s in template.split("/") if s]:
+            if seg.startswith("{") and seg.endswith("}"):
+                if node.param_child is None:
+                    node.param_child = _TrieNode()
+                    node.param_name = seg[1:-1]
+                node = node.param_child
+            else:
+                node = node.children.setdefault(seg, _TrieNode())
+        node.handlers[method.upper()] = handler
+
+    def _resolve(self, path: str) -> Tuple[Optional[_TrieNode], Dict[str, str]]:
+        segments = [s for s in path.split("/") if s]
+
+        def walk(node: _TrieNode, i: int, params: Dict[str, str]):
+            if i == len(segments):
+                return node if node.handlers else None, params
+            seg = segments[i]
+            child = node.children.get(seg)
+            if child is not None:
+                found, p = walk(child, i + 1, params)
+                if found:
+                    return found, p
+            if node.param_child is not None:
+                p2 = dict(params)
+                p2[node.param_name] = seg
+                found, p = walk(node.param_child, i + 1, p2)
+                if found:
+                    return found, p
+            return None, params
+
+        return walk(self._root, 0, {})
+
+    def dispatch(self, method: str, path: str, query: Dict[str, str],
+                 body: bytes, content_type: Optional[str] = None) -> Tuple[int, Any]:
+        try:
+            node, params = self._resolve(path)
+            if node is None:
+                return 400, _error_body(
+                    "invalid_index_name_exception" if False else "illegal_argument_exception",
+                    f"no handler found for uri [{path}] and method [{method}]", 400)
+            handler = node.handlers.get(method.upper())
+            if handler is None:
+                if method.upper() == "HEAD" and "GET" in node.handlers:
+                    status, _ = node.handlers["GET"](
+                        RestRequest("HEAD", path, params, query, body, content_type))
+                    return status, None
+                allowed = ", ".join(sorted(node.handlers))
+                return 405, _error_body(
+                    "method_not_allowed_exception",
+                    f"Incorrect HTTP method for uri [{path}], allowed: [{allowed}]", 405)
+            req = RestRequest(method.upper(), path, params, query, body, content_type)
+            return handler(req)
+        except SearchEngineError as e:
+            return e.status, {"error": {**e.to_dict(),
+                                        "root_cause": [e.to_dict()]},
+                              "status": e.status}
+        except Exception as e:  # unexpected: 500 with reason, never a raw traceback
+            tb = traceback.format_exc(limit=5)
+            return 500, _error_body("internal_server_error",
+                                    f"{type(e).__name__}: {e}", 500, stack_trace=tb)
+
+
+def _error_body(err_type: str, reason: str, status: int, **extra) -> dict:
+    err = {"type": err_type, "reason": reason, **extra}
+    return {"error": {**err, "root_cause": [err]}, "status": status}

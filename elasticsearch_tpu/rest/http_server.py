@@ -1,0 +1,132 @@
+"""Minimal asyncio HTTP/1.1 server fronting the RestController.
+
+Plays the role of `Netty4HttpServerTransport` (reference layer 4): accepts
+keep-alive connections, parses request line + headers + Content-Length
+bodies, dispatches to the controller on a worker thread pool (handlers do
+blocking engine work), renders JSON (or text for _cat) responses. No
+external dependencies — stdlib asyncio only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import urllib.parse
+from typing import Optional, Tuple
+
+from elasticsearch_tpu.rest.controller import RestController
+
+MAX_BODY = 100 * 1024 * 1024  # reference http.max_content_length default 100mb
+
+
+class HttpServer:
+    def __init__(self, controller: RestController, host: str = "127.0.0.1",
+                 port: int = 9200, max_workers: int = 8):
+        self.controller = controller
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers,
+                                                           thread_name_prefix="http_worker")
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self._pool.shutdown(wait=False)
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                method, path, query, headers, body = request
+                loop = asyncio.get_running_loop()
+                status, payload = await loop.run_in_executor(
+                    self._pool, self.controller.dispatch, method, path, query,
+                    body, headers.get("content-type"))
+                keep_alive = headers.get("connection", "keep-alive").lower() != "close"
+                await self._write_response(writer, status, payload, keep_alive)
+                if not keep_alive:
+                    break
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        try:
+            request_line = await reader.readline()
+        except (asyncio.LimitOverrunError, ValueError):
+            return None
+        if not request_line:
+            return None
+        try:
+            method, target, _version = request_line.decode("latin-1").split(" ", 2)
+        except ValueError:
+            return None
+        parsed = urllib.parse.urlsplit(target)
+        path = urllib.parse.unquote(parsed.path)
+        query = {k: v[-1] for k, v in urllib.parse.parse_qs(
+            parsed.query, keep_blank_values=True).items()}
+
+        headers = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+
+        body = b""
+        length = int(headers.get("content-length", 0) or 0)
+        if length > MAX_BODY:
+            return None
+        if length:
+            body = await reader.readexactly(length)
+        elif headers.get("transfer-encoding", "").lower() == "chunked":
+            chunks = []
+            while True:
+                size_line = await reader.readline()
+                size = int(size_line.strip() or b"0", 16)
+                if size == 0:
+                    await reader.readline()
+                    break
+                chunks.append(await reader.readexactly(size))
+                await reader.readline()
+            body = b"".join(chunks)
+        return method.upper(), path, query, headers, body
+
+    async def _write_response(self, writer: asyncio.StreamWriter, status: int,
+                              payload, keep_alive: bool) -> None:
+        reasons = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
+                   405: "Method Not Allowed", 409: "Conflict", 429: "Too Many Requests",
+                   500: "Internal Server Error", 503: "Service Unavailable"}
+        if payload is None:
+            data = b""
+            ctype = "application/json"
+        elif isinstance(payload, str):
+            data = payload.encode("utf-8")
+            ctype = "text/plain; charset=UTF-8"
+        else:
+            data = json.dumps(payload).encode("utf-8")
+            ctype = "application/json"
+        head = (f"HTTP/1.1 {status} {reasons.get(status, 'Unknown')}\r\n"
+                f"content-type: {ctype}\r\n"
+                f"content-length: {len(data)}\r\n"
+                f"connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+                f"X-elastic-product: Elasticsearch\r\n\r\n")
+        writer.write(head.encode("latin-1") + data)
+        await writer.drain()

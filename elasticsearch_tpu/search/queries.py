@@ -587,6 +587,103 @@ class MatchPhrasePrefixQuery(Query):
         return {"match_phrase_prefix": {self.field: {"query": self.text}}}
 
 
+class QueryStringQuery(Query):
+    """Lucene-lite query_string (reference: `index/query/QueryStringQueryBuilder`
+    via Lucene's classic QueryParser): supports `field:value`, quoted phrases,
+    AND/OR/NOT operators, and free terms over default_field or all text
+    fields."""
+
+    def __init__(self, query: str, default_fields=None,
+                 default_operator: str = "or", boost: float = 1.0):
+        self.query = str(query)
+        if isinstance(default_fields, str):
+            default_fields = [default_fields]
+        self.default_fields_param = list(default_fields or [])
+        op = str(default_operator).strip().lower()
+        if op not in ("and", "or"):
+            raise ParsingError(f"invalid default_operator [{default_operator}], expected AND or OR")
+        self.default_operator = op
+        self.boost = boost
+
+    _TOKEN_RE = re.compile(r'([+-]?)(?:(\w[\w.]*):)?("(?:[^"]*)"|\S+)')
+
+    def _default_fields(self, ctx: SearchContext) -> List[str]:
+        fields = [f for f in self.default_fields_param if f != "*"]
+        if fields:
+            return [f.split("^")[0] for f in fields]
+        return [p for p in ctx.mapper_service.field_names()
+                if isinstance(ctx.mapper_service.get(p), TextFieldMapper)]
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        if self.query.strip() == "*":
+            return MatchAllQuery(self.boost).execute(ctx)
+
+        # pass 1: tokenize into clauses and the connectors between them
+        clauses: List[dict] = []       # {sign, field, text, phrase, negated}
+        connectors: List[Optional[str]] = []  # between clause i and i+1
+        negate_next = False
+        for m in self._TOKEN_RE.finditer(self.query):
+            sign, field, text = m.group(1), m.group(2), m.group(3)
+            if text in ("AND", "OR"):
+                if connectors:
+                    connectors[-1] = text
+                continue
+            if text == "NOT":
+                negate_next = True
+                continue
+            phrase = text.startswith('"') and text.endswith('"')
+            clauses.append({"sign": sign, "field": field,
+                            "text": text[1:-1] if phrase else text,
+                            "phrase": phrase, "negated": negate_next})
+            negate_next = False
+            connectors.append(None)
+
+        if not clauses:
+            return DocSet.empty()
+
+        # pass 2: resolve required/optional — an explicit AND binds BOTH
+        # neighbors; an explicit OR makes both optional; otherwise the
+        # default operator decides (Lucene classic parser semantics).
+        n = len(clauses)
+        required = [self.default_operator == "and"] * n
+        for i in range(n - 1):
+            if connectors[i] == "AND":
+                required[i] = required[i + 1] = True
+            elif connectors[i] == "OR":
+                required[i] = required[i + 1] = False
+
+        must: List[Query] = []
+        should: List[Query] = []
+        must_not: List[Query] = []
+        for i, c in enumerate(clauses):
+            # sub-queries carry boost 1.0 — the wrapping BoolQuery applies
+            # self.boost exactly once
+            if c["field"]:
+                sub: Query = (MatchPhraseQuery(c["field"], c["text"]) if c["phrase"]
+                              else MatchQuery(c["field"], c["text"]))
+            else:
+                fields = self._default_fields(ctx)
+                subs: List[Query] = [
+                    MatchPhraseQuery(f, c["text"]) if c["phrase"] else MatchQuery(f, c["text"])
+                    for f in fields]
+                if not subs:
+                    continue
+                sub = subs[0] if len(subs) == 1 else DisMaxQuery(subs)
+            if c["sign"] == "-" or c["negated"]:
+                must_not.append(sub)
+            elif c["sign"] == "+" or required[i]:
+                must.append(sub)
+            else:
+                should.append(sub)
+        if not (must or should or must_not):
+            return DocSet.empty()
+        return BoolQuery(must=must, should=should, must_not=must_not,
+                         boost=self.boost).execute(ctx)
+
+    def to_dict(self):
+        return {"query_string": {"query": self.query}}
+
+
 class MultiMatchQuery(Query):
     def __init__(self, query: str, fields: List[str], mm_type: str = "best_fields",
                  operator: str = "or", boost: float = 1.0):
@@ -915,6 +1012,12 @@ def parse_query(body: Optional[dict]) -> Query:
         field, v = _single(spec, "match_phrase_prefix")
         text = v.get("query") if isinstance(v, dict) else v
         return MatchPhrasePrefixQuery(field, text)
+    if kind in ("query_string", "simple_query_string"):
+        fields = spec.get("fields") or (
+            [spec["default_field"]] if spec.get("default_field") else [])
+        return QueryStringQuery(spec.get("query", ""), fields,
+                                spec.get("default_operator", "or"),
+                                float(spec.get("boost", 1.0)))
     if kind == "multi_match":
         return MultiMatchQuery(spec.get("query"), spec.get("fields", []),
                                spec.get("type", "best_fields"),

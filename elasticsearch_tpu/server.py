@@ -1,0 +1,55 @@
+"""Server bootstrap: `python -m elasticsearch_tpu.server [--port N] [--data DIR]`.
+
+The CLI/bootstrap layer (reference: `bootstrap/Elasticsearch.main:75` →
+`Bootstrap.init:334` → `Node.start:682`): builds the node, registers REST
+handlers, binds HTTP, installs signal handlers, runs until stopped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="elasticsearch-tpu")
+    parser.add_argument("--port", type=int, default=9200)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--data", default="./data")
+    parser.add_argument("--name", default="node-0")
+    parser.add_argument("--cluster-name", default="tpu-search")
+    args = parser.parse_args(argv)
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.http_server import HttpServer
+
+    node = Node(args.data, node_name=args.name, cluster_name=args.cluster_name)
+    controller = RestController()
+    register_all(controller, node)
+    server = HttpServer(controller, host=args.host, port=args.port)
+
+    async def run():
+        await server.start()
+        print(f"[{args.name}] listening on http://{args.host}:{server.port} "
+              f"(data: {args.data})", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await server.stop()
+        node.close()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

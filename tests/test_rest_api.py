@@ -1,0 +1,310 @@
+"""Black-box REST API conformance tests (the analog of the reference's
+306 YAML suites under rest-api-spec): drive the full controller the way an
+HTTP client would, asserting response shapes."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    """Test client: dispatches through the controller like the HTTP layer."""
+
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = b""
+        if body is not None:
+            if isinstance(body, (list, tuple)):  # ndjson
+                raw = b"\n".join(json.dumps(l).encode() for l in body) + b"\n"
+            else:
+                raw = json.dumps(body).encode()
+        q = {k: str(v) for k, v in query.items()}
+        return self.rc.dispatch(method, path, q, raw, "application/json")
+
+
+@pytest.fixture
+def client(tmp_path):
+    node = Node(str(tmp_path / "data"))
+    yield Client(node)
+    node.close()
+
+
+def test_root(client):
+    status, body = client.req("GET", "/")
+    assert status == 200
+    assert body["tagline"] == "You Know, for (TPU) Search"
+
+
+def test_document_crud(client):
+    status, body = client.req("PUT", "/books/_doc/1",
+                              {"title": "Dune", "pages": 412})
+    assert status == 201 and body["result"] == "created" and body["_seq_no"] == 0
+
+    status, body = client.req("GET", "/books/_doc/1")
+    assert status == 200 and body["found"] and body["_source"]["title"] == "Dune"
+
+    status, body = client.req("PUT", "/books/_doc/1", {"title": "Dune", "pages": 500})
+    assert status == 200 and body["result"] == "updated" and body["_version"] == 2
+
+    status, body = client.req("GET", "/books/_source/1")
+    assert status == 200 and body == {"title": "Dune", "pages": 500}
+
+    status, body = client.req("DELETE", "/books/_doc/1")
+    assert status == 200 and body["result"] == "deleted"
+
+    status, body = client.req("GET", "/books/_doc/1")
+    assert status == 404 and not body["found"]
+
+    status, body = client.req("DELETE", "/books/_doc/1")
+    assert status == 404 and body["result"] == "not_found"
+
+
+def test_create_conflict_and_optimistic_concurrency(client):
+    client.req("PUT", "/idx/_doc/1", {"a": 1})
+    status, body = client.req("PUT", "/idx/_create/1", {"a": 2})
+    assert status == 409
+    assert body["error"]["type"] == "version_conflict_exception"
+
+    status, ok = client.req("GET", "/idx/_doc/1")
+    status, body = client.req("PUT", "/idx/_doc/1", {"a": 3},
+                              if_seq_no=ok["_seq_no"], if_primary_term=ok["_primary_term"])
+    assert status == 200
+    status, body = client.req("PUT", "/idx/_doc/1", {"a": 4},
+                              if_seq_no=ok["_seq_no"], if_primary_term=ok["_primary_term"])
+    assert status == 409
+
+
+def test_auto_id_and_update(client):
+    status, body = client.req("POST", "/idx/_doc", {"x": 1})
+    assert status == 201 and body["_id"]
+    doc_id = body["_id"]
+    status, body = client.req("POST", f"/idx/_update/{doc_id}",
+                              {"doc": {"y": 2}})
+    assert status == 200
+    _, body = client.req("GET", f"/idx/_doc/{doc_id}")
+    assert body["_source"] == {"x": 1, "y": 2}
+
+    status, body = client.req("POST", f"/idx/_update/{doc_id}",
+                              {"script": {"source": "ctx._source.x += params.n",
+                                          "params": {"n": 10}}})
+    assert status == 200
+    _, body = client.req("GET", f"/idx/_doc/{doc_id}")
+    assert body["_source"]["x"] == 11
+
+    status, body = client.req("POST", "/idx/_update/missing",
+                              {"doc": {"a": 1}, "doc_as_upsert": True})
+    assert status == 200
+    _, body = client.req("GET", "/idx/_doc/missing")
+    assert body["found"]
+
+
+def test_bulk(client):
+    ops = [
+        {"index": {"_index": "bulk1", "_id": "1"}}, {"n": 1},
+        {"index": {"_index": "bulk1", "_id": "2"}}, {"n": 2},
+        {"create": {"_index": "bulk1", "_id": "1"}}, {"n": 99},   # conflict
+        {"delete": {"_index": "bulk1", "_id": "2"}},
+        {"update": {"_index": "bulk1", "_id": "1"}}, {"doc": {"m": 5}},
+    ]
+    status, body = client.req("POST", "/_bulk", ops, refresh="true")
+    assert status == 200
+    assert body["errors"] is True
+    results = [next(iter(i.values())) for i in body["items"]]
+    assert results[0]["status"] == 201
+    assert results[2]["status"] == 409
+    assert results[3]["status"] == 200
+    assert results[4]["status"] == 200
+    status, body = client.req("GET", "/bulk1/_count")
+    assert body["count"] == 1
+
+
+def test_index_admin(client):
+    status, body = client.req("PUT", "/catalog", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"name": {"type": "text"},
+                                    "sku": {"type": "keyword"}}},
+        "aliases": {"products": {}}})
+    assert status == 200 and body["acknowledged"]
+
+    status, body = client.req("PUT", "/catalog", {})
+    assert status == 400  # already exists
+
+    status, body = client.req("GET", "/catalog")
+    assert body["catalog"]["mappings"]["properties"]["sku"]["type"] == "keyword"
+    assert body["catalog"]["settings"]["index"]["number_of_shards"] == 2
+
+    status, _ = client.req("HEAD", "/catalog")
+    assert status == 200
+    status, _ = client.req("HEAD", "/nope")
+    assert status == 404
+
+    # write via alias
+    status, _ = client.req("PUT", "/products/_doc/1", {"name": "widget", "sku": "W1"})
+    assert status == 201
+    status, body = client.req("GET", "/catalog/_search",
+                              {"query": {"term": {"sku": "W1"}}}, refresh=True)
+    # needs refresh first
+    client.req("POST", "/catalog/_refresh")
+    status, body = client.req("GET", "/products/_search",
+                              {"query": {"term": {"sku": "W1"}}})
+    assert body["hits"]["total"]["value"] == 1
+
+    status, body = client.req("PUT", "/catalog/_mapping",
+                              {"properties": {"price": {"type": "float"}}})
+    assert body["acknowledged"]
+    _, body = client.req("GET", "/catalog/_mapping")
+    assert body["catalog"]["mappings"]["properties"]["price"]["type"] == "float"
+
+    status, body = client.req("DELETE", "/catalog")
+    assert body["acknowledged"]
+    status, _ = client.req("GET", "/catalog")
+    assert status == 404
+
+
+def test_search_end_to_end(client):
+    docs = [
+        {"title": "quick brown fox", "tag": "a", "n": 1},
+        {"title": "lazy dog", "tag": "b", "n": 2},
+        {"title": "quick dog", "tag": "b", "n": 3},
+    ]
+    for i, d in enumerate(docs):
+        client.req("PUT", f"/s/_doc/{i}", d)
+    client.req("POST", "/s/_refresh")
+
+    status, body = client.req("POST", "/s/_search", {
+        "query": {"match": {"title": "quick"}},
+        "aggs": {"tags": {"terms": {"field": "tag.keyword"}}}})
+    assert status == 200
+    assert body["hits"]["total"] == {"value": 2, "relation": "eq"}
+    assert {h["_id"] for h in body["hits"]["hits"]} == {"0", "2"}
+    assert body["hits"]["hits"][0]["_score"] > 0
+    buckets = {b["key"]: b["doc_count"] for b in body["aggregations"]["tags"]["buckets"]}
+    assert buckets == {"a": 1, "b": 1}
+
+    # URI search q=field:value
+    status, body = client.req("GET", "/s/_search", q="title:dog", size=10)
+    assert body["hits"]["total"]["value"] == 2
+
+    # sort + from/size
+    status, body = client.req("POST", "/s/_search",
+                              {"sort": [{"n": "desc"}], "size": 2})
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["2", "1"]
+    assert body["hits"]["hits"][0]["sort"] == [3.0]
+
+
+def test_msearch_and_mget(client):
+    for i in range(3):
+        client.req("PUT", f"/m/_doc/{i}", {"n": i}, refresh="true")
+    status, body = client.req("POST", "/_msearch", [
+        {"index": "m"}, {"query": {"range": {"n": {"gte": 1}}}},
+        {"index": "missing-idx"}, {"query": {"match_all": {}}},
+    ])
+    assert body["responses"][0]["hits"]["total"]["value"] == 2
+    assert body["responses"][1]["status"] == 404
+
+    status, body = client.req("POST", "/_mget", {
+        "docs": [{"_index": "m", "_id": "0"}, {"_index": "m", "_id": "77"}]})
+    assert body["docs"][0]["found"] is True
+    assert body["docs"][1]["found"] is False
+
+
+def test_multi_shard_routing(client):
+    client.req("PUT", "/sharded", {"settings": {"number_of_shards": 4}})
+    for i in range(40):
+        client.req("PUT", f"/sharded/_doc/{i}", {"n": i})
+    client.req("POST", "/sharded/_refresh")
+    _, body = client.req("GET", "/sharded/_count")
+    assert body["count"] == 40
+    _, body = client.req("POST", "/sharded/_search",
+                         {"query": {"range": {"n": {"lt": 10}}}, "size": 20,
+                          "sort": [{"n": "asc"}]})
+    assert body["hits"]["total"]["value"] == 10
+    assert [h["_source"]["n"] for h in body["hits"]["hits"]] == list(range(10))
+    # GET routes to the right shard
+    _, body = client.req("GET", "/sharded/_doc/17")
+    assert body["found"] and body["_source"]["n"] == 17
+    # _cat/shards shows 4 primaries
+    _, text = client.req("GET", "/_cat/shards")
+    assert sum(1 for line in text.strip().split("\n") if line.startswith("sharded")) == 4
+
+
+def test_knn_over_rest(client):
+    client.req("PUT", "/vec", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "v": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+            "cat": {"type": "keyword"}}}})
+    import random
+    random.seed(3)
+    for i in range(30):
+        client.req("PUT", f"/vec/_doc/{i}",
+                   {"v": [random.gauss(0, 1) for _ in range(4)], "cat": f"c{i % 3}"})
+    client.req("POST", "/vec/_refresh")
+    _, target = client.req("GET", "/vec/_doc/7")
+    qv = target["_source"]["v"]
+    _, body = client.req("POST", "/vec/_search",
+                         {"knn": {"field": "v", "query_vector": qv, "k": 5}})
+    assert body["hits"]["hits"][0]["_id"] == "7"
+    assert body["hits"]["hits"][0]["_score"] == pytest.approx(1.0, abs=5e-3)
+    # filtered knn
+    _, body = client.req("POST", "/vec/_search",
+                         {"knn": {"field": "v", "query_vector": qv, "k": 5,
+                                  "filter": {"term": {"cat": "c1"}}}})
+    ids = [int(h["_id"]) for h in body["hits"]["hits"]]
+    assert all(i % 3 == 1 for i in ids)
+    assert 7 in ids
+
+
+def test_analyze(client):
+    _, body = client.req("POST", "/_analyze",
+                         {"text": "The Quick-Brown FOXES", "analyzer": "english"})
+    tokens = [t["token"] for t in body["tokens"]]
+    assert "quick" in tokens and "fox" in tokens  # stemmed, stopword removed
+
+
+def test_cluster_and_cat(client):
+    client.req("PUT", "/one/_doc/1", {"a": 1})
+    _, body = client.req("GET", "/_cluster/health")
+    assert body["status"] == "green" and body["number_of_nodes"] == 1
+    _, body = client.req("GET", "/_cluster/state")
+    assert "one" in body["metadata"]["indices"]
+    _, body = client.req("GET", "/_nodes")
+    assert body["_nodes"]["total"] == 1
+    _, body = client.req("GET", "/_cat/indices", format="json")
+    assert body[0]["index"] == "one"
+    _, text = client.req("GET", "/_cat/health", v="")
+    assert "cluster" in text  # header line with v
+
+
+def test_error_shapes(client):
+    status, body = client.req("GET", "/missing/_search", {"query": {"match_all": {}}})
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_exception"
+    assert body["status"] == 404
+
+    status, body = client.req("POST", "/e/_doc/1", {"a": 1})
+    status, body = client.req("POST", "/e/_search", {"query": {"bogus": {}}})
+    assert status == 400 and body["error"]["type"] == "parsing_exception"
+
+    status, body = client.req("PUT", "/INVALID-UPPER", {})
+    assert status == 400
+
+    status, body = client.req("POST", "/", None)
+    assert status == 405  # method not allowed on root
+
+
+def test_flush_persists_and_stats(client, tmp_path):
+    client.req("PUT", "/p/_doc/1", {"a": 1})
+    _, body = client.req("POST", "/p/_flush")
+    assert body["_shards"]["failed"] == 0
+    _, body = client.req("GET", "/p/_stats")
+    assert body["_all"]["primaries"]["docs"]["count"] == 1
+    _, body = client.req("POST", "/p/_forcemerge")
+    assert body["_shards"]["failed"] == 0

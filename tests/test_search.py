@@ -380,3 +380,26 @@ def test_composite_agg(engine):
     assert keys == sorted(keys)
     total = len(out["c"]["buckets"]) + len(out2["c"]["buckets"])
     assert total == 4
+
+
+def test_query_string(ctx):
+    # AND binds both neighbors
+    ids, _ = run_query(ctx, {"query_string": {"query": "quick AND fox", "fields": ["title"]}})
+    assert ids == ["0"]
+    # OR overrides default_operator=and
+    ids, _ = run_query(ctx, {"query_string": {"query": "bread OR algorithm",
+                                              "fields": ["title"], "default_operator": "and"}})
+    assert sorted(ids) == ["2", "3"]
+    # field:value + negation + phrase
+    ids, _ = run_query(ctx, {"query_string": {"query": 'title:fox -title:banned'}})
+    assert ids == ["0"]
+    ids, _ = run_query(ctx, {"query_string": {"query": '"brown bread"', "fields": ["title"]}})
+    assert ids == ["3"]
+    # free text over all text fields
+    ids, _ = run_query(ctx, {"query_string": {"query": "quicksort"}})
+    assert ids == ["2"]
+    # invalid operator rejected
+    import pytest as _pt
+    from elasticsearch_tpu.common.errors import ParsingError
+    with _pt.raises(ParsingError):
+        run_query(ctx, {"query_string": {"query": "a", "default_operator": "snd"}})
