@@ -1,0 +1,570 @@
+"""Cluster coordination: the Raft-like consensus of the reference.
+
+Re-design of `cluster/coordination/` (SURVEY.md §2.3). Two pieces:
+
+- `CoordinationState` — the safety core, a faithful port of the protocol
+  semantics of `CoordinationState.java` (573 LoC), which SURVEY calls
+  well-specified and deterministic-testable: terms with single join votes,
+  election quorums over BOTH last-committed and last-accepted voting
+  configurations (`isElectionQuorum:109`), two-phase publish/commit with
+  the freshness invariant on accepted states.
+
+- `Coordinator` — the liveness machinery (`Coordinator.java`, 1,467 LoC):
+  CANDIDATE/LEADER/FOLLOWER modes, randomized election backoff
+  (`ElectionSchedulerFactory.java:47`), leader→follower heartbeats
+  (`FollowersChecker.java:64`), follower→leader checks
+  (`LeaderChecker.java:62`), join handling, node-left removal, and
+  publication fan-out (`Publication.java:255`). Scheduling and messaging go
+  through injected abstractions so the whole thing runs identically on the
+  deterministic simulator (tests) and on the asyncio TCP transport
+  (production).
+
+Safety invariants the simulation suite asserts:
+  * at most one leader per term;
+  * a committed (term, version) is never lost by later leaders;
+  * accepted states only move forward in (term, version) order.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, DiscoveryNode, VotingConfiguration,
+)
+
+CANDIDATE = "CANDIDATE"
+LEADER = "LEADER"
+FOLLOWER = "FOLLOWER"
+
+# transport action names (reference: JoinHelper / PublicationTransportHandler)
+START_JOIN_ACTION = "internal:cluster/coordination/start_join"
+JOIN_ACTION = "internal:cluster/coordination/join"
+PUBLISH_ACTION = "internal:cluster/coordination/publish_state"
+COMMIT_ACTION = "internal:cluster/coordination/commit_state"
+FOLLOWER_CHECK_ACTION = "internal:coordination/fault_detection/follower_check"
+LEADER_CHECK_ACTION = "internal:coordination/fault_detection/leader_check"
+PEER_FIND_ACTION = "internal:discovery/request_peers"
+
+
+class CoordinationError(Exception):
+    pass
+
+
+class PersistedState:
+    """Durable (term, lastAcceptedState) — reference: gateway
+    PersistedClusterStateService (§2.10); in-memory for tests, file-backed in
+    production (gateway.py)."""
+
+    def __init__(self, term: int = 0, state: Optional[ClusterState] = None):
+        self.current_term = term
+        self.last_accepted = state or ClusterState()
+
+    def set_term(self, term: int) -> None:
+        self.current_term = term
+
+    def set_last_accepted(self, state: ClusterState) -> None:
+        self.last_accepted = state
+
+    def mark_committed(self) -> None:
+        pass
+
+
+class CoordinationState:
+    """Safety core. All mutations validate preconditions and raise
+    CoordinationError on violations, mirroring CoordinationState.java."""
+
+    def __init__(self, node_id: str, persisted: PersistedState):
+        self.node_id = node_id
+        self.persisted = persisted
+        self.join_votes: Set[str] = set()
+        self.election_won = False
+        self.publish_votes: Set[str] = set()
+        self.last_published_version = 0
+        self.last_published_config = VotingConfiguration.EMPTY
+
+    # -- accessors ------------------------------------------------------------
+    @property
+    def current_term(self) -> int:
+        return self.persisted.current_term
+
+    @property
+    def last_accepted(self) -> ClusterState:
+        return self.persisted.last_accepted
+
+    @property
+    def last_accepted_term(self) -> int:
+        return self.last_accepted.term
+
+    @property
+    def last_accepted_version(self) -> int:
+        return self.last_accepted.version
+
+    def is_election_quorum(self, votes: Set[str]) -> bool:
+        """Quorum in BOTH the last-committed and last-accepted configs
+        (`isElectionQuorum:109`) — the key to safe reconfiguration."""
+        return (self.last_accepted.last_committed_config.has_quorum(votes)
+                and self.last_accepted.last_accepted_config.has_quorum(votes))
+
+    def is_publish_quorum(self, votes: Set[str]) -> bool:
+        return (self.last_accepted.last_committed_config.has_quorum(votes)
+                and self.last_published_config.has_quorum(votes))
+
+    # -- elections ------------------------------------------------------------
+    def handle_start_join(self, source_node: str, term: int) -> dict:
+        """A candidate asked us to join its term; grants at most one join
+        per term (`handleStartJoin:170`)."""
+        if term <= self.current_term:
+            raise CoordinationError(
+                f"incoming term {term} not greater than current term {self.current_term}")
+        self.persisted.set_term(term)
+        self.join_votes = set()
+        self.election_won = False
+        self.publish_votes = set()
+        self.last_published_version = 0
+        self.last_published_config = VotingConfiguration.EMPTY
+        return {"source": self.node_id, "target": source_node, "term": term,
+                "last_accepted_term": self.last_accepted_term,
+                "last_accepted_version": self.last_accepted_version}
+
+    def handle_join(self, join: dict) -> bool:
+        """Candidate-side: count a vote. The freshness check guarantees the
+        winner's accepted state is at least as recent as any voter's
+        (`handleJoin` safety argument)."""
+        if join["term"] != self.current_term:
+            raise CoordinationError(
+                f"join term {join['term']} != current term {self.current_term}")
+        last_term, last_version = join["last_accepted_term"], join["last_accepted_version"]
+        if last_term > self.last_accepted_term or (
+                last_term == self.last_accepted_term
+                and last_version > self.last_accepted_version):
+            raise CoordinationError(
+                "joining node has a fresher accepted state than the candidate")
+        self.join_votes.add(join["source"])
+        prev = self.election_won
+        self.election_won = self.is_election_quorum(self.join_votes)
+        return self.election_won and not prev
+
+    # -- publication ----------------------------------------------------------
+    def handle_client_value(self, state: ClusterState) -> dict:
+        """Leader proposes the next state (`handleClientValue`)."""
+        if not self.election_won:
+            raise CoordinationError("cannot publish: election not won")
+        if state.term != self.current_term:
+            raise CoordinationError(
+                f"proposed state term {state.term} != current term {self.current_term}")
+        if state.version <= max(self.last_published_version, self.last_accepted_version):
+            raise CoordinationError(
+                f"proposed version {state.version} not ahead of published "
+                f"{self.last_published_version} / accepted {self.last_accepted_version}")
+        self.publish_votes = set()
+        self.last_published_version = state.version
+        self.last_published_config = state.last_accepted_config
+        return {"term": state.term, "version": state.version,
+                "state": state.to_dict()}
+
+    def handle_publish_request(self, request: dict) -> dict:
+        """Any node accepts a proposal newer than what it has
+        (`handlePublishRequest`)."""
+        term, version = request["term"], request["version"]
+        if term != self.current_term:
+            raise CoordinationError(
+                f"publish term {term} != current term {self.current_term}")
+        if term == self.last_accepted_term and version <= self.last_accepted_version:
+            raise CoordinationError(
+                f"publish version {version} not newer than accepted "
+                f"{self.last_accepted_version} in same term")
+        state = ClusterState.from_dict(request["state"])
+        self.persisted.set_last_accepted(state)
+        return {"source": self.node_id, "term": term, "version": version}
+
+    def handle_publish_response(self, response: dict) -> Optional[dict]:
+        """Leader-side: count acks; at quorum emit the commit
+        (`handlePublishResponse`)."""
+        if not self.election_won:
+            raise CoordinationError("not the elected leader")
+        if response["term"] != self.current_term or \
+                response["version"] != self.last_published_version:
+            raise CoordinationError("publish response for a different round")
+        self.publish_votes.add(response["source"])
+        if self.is_publish_quorum(self.publish_votes):
+            return {"term": response["term"], "version": response["version"]}
+        return None
+
+    def handle_commit(self, commit: dict) -> ClusterState:
+        """Any node marks its accepted state committed (`handleCommit`)."""
+        if commit["term"] != self.current_term:
+            raise CoordinationError(
+                f"commit term {commit['term']} != current term {self.current_term}")
+        if commit["term"] != self.last_accepted_term or \
+                commit["version"] != self.last_accepted_version:
+            raise CoordinationError("commit does not match accepted state")
+        committed = self.last_accepted.with_(
+            last_committed_config=self.last_accepted.last_accepted_config)
+        self.persisted.set_last_accepted(committed)
+        self.persisted.mark_committed()
+        return committed
+
+
+def bootstrap_state(initial_master_nodes: List[str],
+                    cluster_name: str = "tpu-search") -> ClusterState:
+    """Initial cluster formation (`ClusterBootstrapService`): a version-0
+    state whose voting configuration is the configured initial master nodes.
+    Every node persists this same state before first start."""
+    config = VotingConfiguration(initial_master_nodes)
+    return ClusterState(term=0, version=0, cluster_name=cluster_name,
+                        nodes={},
+                        last_committed_config=config,
+                        last_accepted_config=config)
+
+
+class Coordinator:
+    """Liveness: mode transitions, elections, heartbeats, publication."""
+
+    def __init__(self, node: DiscoveryNode, persisted: PersistedState,
+                 transport, scheduler, seed_peers: List[str],
+                 on_committed: Optional[Callable[[ClusterState], None]] = None,
+                 election_min_ms: int = 100, election_max_ms: int = 1000,
+                 heartbeat_interval_ms: int = 500, fault_timeout_ms: int = 3000,
+                 rng: Optional[random.Random] = None):
+        self.node = node
+        self.state = CoordinationState(node.node_id, persisted)
+        self.transport = transport
+        self.scheduler = scheduler     # DeterministicTaskQueue-compatible
+        self.seed_peers = list(seed_peers)
+        self.on_committed = on_committed or (lambda s: None)
+        self.mode = CANDIDATE
+        self.known_leader: Optional[str] = None
+        self.last_leader_ping_ms = 0
+        self.election_min_ms = election_min_ms
+        self.election_max_ms = election_max_ms
+        self.heartbeat_interval_ms = heartbeat_interval_ms
+        self.fault_timeout_ms = fault_timeout_ms
+        self.rng = rng or random.Random(hash(node.node_id) & 0xFFFF)
+        self.committed_state: ClusterState = persisted.last_accepted
+        self.stopped = False
+        self._election_round = 0
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ wiring
+    def _register_handlers(self) -> None:
+        t = self.transport
+        me = self.node.node_id
+        t.register(me, START_JOIN_ACTION, self._on_start_join)
+        t.register(me, JOIN_ACTION, self._on_join)
+        t.register(me, PUBLISH_ACTION, self._on_publish)
+        t.register(me, COMMIT_ACTION, self._on_commit)
+        t.register(me, FOLLOWER_CHECK_ACTION, self._on_follower_check)
+        t.register(me, LEADER_CHECK_ACTION, self._on_leader_check)
+        t.register(me, PEER_FIND_ACTION, self._on_peer_find)
+
+    def start(self) -> None:
+        self._schedule_election()
+        self._schedule_fault_check()
+
+    def stop(self) -> None:
+        self.stopped = True
+
+    # -------------------------------------------------------------- elections
+    def _schedule_election(self) -> None:
+        """(Re)start the election timer chain. A generation token ensures at
+        most ONE live chain: demotions bump the generation, orphaning any
+        older chain at its next tick, and the chain dies on leaving CANDIDATE
+        instead of ticking for the node's lifetime."""
+        if self.stopped:
+            return
+        self._election_generation = getattr(self, "_election_generation", 0) + 1
+        self._chain_election(self._election_generation)
+
+    def _chain_election(self, generation: int) -> None:
+        self._election_round += 1
+        # randomized backoff grows with consecutive failed rounds
+        upper = min(self.election_max_ms * self._election_round, 10 * self.election_max_ms)
+        delay = self.rng.randint(self.election_min_ms, max(upper, self.election_min_ms + 1))
+
+        def maybe_elect():
+            if self.stopped or generation != self._election_generation:
+                return  # orphaned chain: a newer chain owns elections now
+            if self.mode != CANDIDATE:
+                return  # chain ends; _become_candidate starts a fresh one
+            self._start_election()
+            self._chain_election(generation)
+
+        self.scheduler.schedule_in(delay, maybe_elect, f"election:{self.node.node_id}")
+
+    def _voting_nodes(self) -> Set[str]:
+        config = (self.state.last_accepted.last_accepted_config.node_ids
+                  | self.state.last_accepted.last_committed_config.node_ids)
+        return set(config) if config else set(self.seed_peers) | {self.node.node_id}
+
+    def _broadcast_targets(self) -> Set[str]:
+        return (set(self.seed_peers) | set(self.state.last_accepted.nodes)
+                | self._voting_nodes() | {self.node.node_id})
+
+    def _start_election(self) -> None:
+        term = self.state.current_term + 1
+        for target in self._broadcast_targets():
+            self.transport.send(self.node.node_id, target, START_JOIN_ACTION,
+                                {"source": self.node.node_id, "term": term})
+
+    def _on_start_join(self, sender: str, request: dict, respond) -> None:
+        try:
+            join = self.state.handle_start_join(request["source"], request["term"])
+        except CoordinationError:
+            return
+        # a higher term always knocks a leader/follower back to candidate
+        if self.mode != CANDIDATE:
+            self._become_candidate("received start-join for a newer term")
+        self.transport.send(self.node.node_id, request["source"], JOIN_ACTION, join)
+        respond({"ack": True})
+
+    def _on_join(self, sender: str, join: dict, respond) -> None:
+        try:
+            won_now = self.state.handle_join(join)
+        except CoordinationError:
+            return
+        if won_now and self.mode == CANDIDATE:
+            self._become_leader()
+        elif self.mode == LEADER and join["term"] == self.state.current_term:
+            # node joining an established leader → add to the cluster
+            self._leader_add_node(join["source"])
+        respond({"ack": True})
+
+    def _become_leader(self) -> None:
+        self.mode = LEADER
+        self.known_leader = self.node.node_id
+        self._publish_first_state()
+        self._schedule_heartbeat()
+
+    def _become_candidate(self, reason: str) -> None:
+        if self.mode == CANDIDATE:
+            return
+        self.mode = CANDIDATE
+        self.known_leader = None
+        self._election_round = 0
+        self._schedule_election()
+
+    def _become_follower(self, leader_id: str) -> None:
+        self.mode = FOLLOWER
+        self.known_leader = leader_id
+        self.last_leader_ping_ms = self.scheduler.now_ms
+
+    # ------------------------------------------------------------ publication
+    def _next_state_base(self) -> ClusterState:
+        return self.state.last_accepted
+
+    def _publish_first_state(self) -> None:
+        base = self._next_state_base()
+        nodes = dict(base.nodes)
+        nodes[self.node.node_id] = self.node
+        for voter in self.state.join_votes:
+            nodes.setdefault(voter, DiscoveryNode(voter))
+        config = self._choose_voting_config(nodes)
+        state = base.with_(
+            term=self.state.current_term,
+            version=max(base.version, self.state.last_published_version) + 1,
+            master_node_id=self.node.node_id, nodes=nodes,
+            last_accepted_config=config)
+        self._publish(state)
+
+    def publish_state_update(self, updater: Callable[[ClusterState], ClusterState]) -> bool:
+        """MasterService entry: compute and publish the next state."""
+        if self.mode != LEADER:
+            return False
+        base = self._next_state_base()
+        new_state = updater(base)
+        if new_state is base:
+            return False
+        new_state = new_state.with_(
+            term=self.state.current_term,
+            version=max(base.version, self.state.last_published_version) + 1,
+            master_node_id=self.node.node_id)
+        self._publish(new_state)
+        return True
+
+    def _choose_voting_config(self, nodes: Dict[str, DiscoveryNode]) -> VotingConfiguration:
+        """Reconfigurator (`Reconfigurator.java:38`): largest odd subset of
+        master-eligible live nodes, keeping the current config's members
+        preferred for stability."""
+        eligible = sorted(n.node_id for n in nodes.values() if n.is_master_eligible)
+        if not eligible:
+            return self.state.last_accepted.last_accepted_config
+        count = len(eligible) if len(eligible) % 2 == 1 else len(eligible) - 1
+        current = self.state.last_accepted.last_accepted_config.node_ids
+        preferred = sorted(eligible, key=lambda n: (n not in current, n))
+        return VotingConfiguration(preferred[:max(count, 1)])
+
+    def _publish(self, state: ClusterState) -> None:
+        try:
+            request = self.state.handle_client_value(state)
+        except CoordinationError:
+            return
+        # publication timeout (reference: Coordinator.publishTimeout →
+        # becomeCandidate): a leader that cannot commit steps down, which is
+        # what lets a healed stale leader re-enter the election flow
+        publish_term, publish_version = state.term, state.version
+
+        def check_committed():
+            if (self.mode == LEADER
+                    and self.state.current_term == publish_term
+                    and self.committed_state.version < publish_version):
+                self._become_candidate("publication timed out without commit")
+
+        self.scheduler.schedule_in(self.fault_timeout_ms, check_committed,
+                                   f"publish_timeout:{self.node.node_id}")
+        # self-ack first (the leader accepts its own proposal)
+        try:
+            response = self.state.handle_publish_request(request)
+            self._count_publish_response(response, state)
+        except CoordinationError:
+            pass
+        for target in set(state.nodes) - {self.node.node_id}:
+            self.transport.send(
+                self.node.node_id, target, PUBLISH_ACTION, request,
+                on_response=lambda resp, s=state: self._count_publish_response(resp, s))
+
+    def _count_publish_response(self, response: dict, state: ClusterState) -> None:
+        try:
+            commit = self.state.handle_publish_response(response)
+        except CoordinationError:
+            return
+        if commit is not None:
+            # quorum reached: commit locally and broadcast
+            try:
+                committed = self.state.handle_commit(commit)
+                self._apply_committed(committed)
+            except CoordinationError:
+                pass
+            for target in set(state.nodes) - {self.node.node_id}:
+                self.transport.send(self.node.node_id, target, COMMIT_ACTION, commit)
+
+    def _on_publish(self, sender: str, request: dict, respond) -> None:
+        if request["term"] > self.state.current_term:
+            # implicit join of a newer term via publication (reference:
+            # Coordinator#handlePublishRequest joins the term)
+            try:
+                self.state.handle_start_join(sender, request["term"])
+            except CoordinationError:
+                pass
+        try:
+            response = self.state.handle_publish_request(request)
+        except CoordinationError:
+            return
+        master = request["state"].get("master_node")
+        if master and master != self.node.node_id:
+            self._become_follower(master)
+        respond(response)
+
+    def _on_commit(self, sender: str, commit: dict, respond) -> None:
+        try:
+            committed = self.state.handle_commit(commit)
+        except CoordinationError:
+            return
+        self._apply_committed(committed)
+        respond({"ack": True})
+
+    def _apply_committed(self, state: ClusterState) -> None:
+        if state.version <= self.committed_state.version and \
+                state.term <= self.committed_state.term:
+            if (state.term, state.version) <= (self.committed_state.term,
+                                               self.committed_state.version):
+                return
+        self.committed_state = state
+        self.last_leader_ping_ms = self.scheduler.now_ms
+        self.on_committed(state)
+
+    # ---------------------------------------------------------- reconfiguration
+    def _leader_add_node(self, node_id: str) -> None:
+        def add(base: ClusterState) -> ClusterState:
+            if node_id in base.nodes:
+                return base
+            nodes = dict(base.nodes)
+            nodes[node_id] = DiscoveryNode(node_id)
+            return base.with_(nodes=nodes,
+                              last_accepted_config=self._choose_voting_config(nodes))
+
+        self.publish_state_update(add)
+
+    def _leader_remove_node(self, node_id: str) -> None:
+        def remove(base: ClusterState) -> ClusterState:
+            if node_id not in base.nodes:
+                return base
+            nodes = dict(base.nodes)
+            nodes.pop(node_id)
+            return base.with_(nodes=nodes,
+                              last_accepted_config=self._choose_voting_config(nodes))
+
+        self.publish_state_update(remove)
+
+    # ------------------------------------------------------------ fault checks
+    def _schedule_heartbeat(self) -> None:
+        if self.stopped or self.mode != LEADER:
+            return
+
+        def beat():
+            if self.stopped or self.mode != LEADER:
+                return
+            for target in set(self.committed_state.nodes) - {self.node.node_id}:
+                self.transport.send(
+                    self.node.node_id, target, FOLLOWER_CHECK_ACTION,
+                    {"term": self.state.current_term, "leader": self.node.node_id},
+                    on_response=lambda resp, t=target: self._note_follower_ok(t))
+            self._check_followers()
+            self._schedule_heartbeat()
+
+        self.scheduler.schedule_in(self.heartbeat_interval_ms, beat,
+                                   f"heartbeat:{self.node.node_id}")
+
+    def _note_follower_ok(self, node_id: str) -> None:
+        self._follower_last_ok = getattr(self, "_follower_last_ok", {})
+        self._follower_last_ok[node_id] = self.scheduler.now_ms
+
+    def _check_followers(self) -> None:
+        """Remove followers that missed fault_timeout of acks
+        (`FollowersChecker` removal)."""
+        last_ok = getattr(self, "_follower_last_ok", {})
+        now = self.scheduler.now_ms
+        for target in set(self.committed_state.nodes) - {self.node.node_id}:
+            seen = last_ok.get(target)
+            if seen is None:
+                last_ok[target] = now  # grace period starts now
+            elif now - seen > self.fault_timeout_ms:
+                self._leader_remove_node(target)
+        self._follower_last_ok = last_ok
+
+    def _on_follower_check(self, sender: str, request: dict, respond) -> None:
+        if request["term"] < self.state.current_term:
+            return  # stale leader
+        if request["term"] > self.state.current_term:
+            try:
+                self.state.handle_start_join(sender, request["term"])
+            except CoordinationError:
+                pass
+        if self.mode != FOLLOWER or self.known_leader != request["leader"]:
+            self._become_follower(request["leader"])
+        self.last_leader_ping_ms = self.scheduler.now_ms
+        respond({"ack": True, "term": self.state.current_term})
+
+    def _schedule_fault_check(self) -> None:
+        if self.stopped:
+            return
+
+        def check():
+            if self.stopped:
+                return
+            if self.mode == FOLLOWER and \
+                    self.scheduler.now_ms - self.last_leader_ping_ms > self.fault_timeout_ms:
+                self._become_candidate("leader check timeout")
+            self._schedule_fault_check()
+
+        self.scheduler.schedule_in(self.heartbeat_interval_ms, check,
+                                   f"leader_check:{self.node.node_id}")
+
+    def _on_leader_check(self, sender: str, request: dict, respond) -> None:
+        respond({"is_leader": self.mode == LEADER, "term": self.state.current_term})
+
+    def _on_peer_find(self, sender: str, request: dict, respond) -> None:
+        respond({"leader": self.known_leader if self.mode != CANDIDATE else None,
+                 "peers": sorted(self.committed_state.nodes),
+                 "term": self.state.current_term})

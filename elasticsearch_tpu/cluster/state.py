@@ -1,0 +1,220 @@
+"""Immutable cluster state + diffs.
+
+Re-design of `cluster/ClusterState.java` (746 LoC) + `AbstractDiffable`:
+the cluster-wide value replicated by the coordination layer. Carries the
+elected master, node membership, index metadata, and the routing table
+(shard copies → nodes). States are versioned (term, version) and support
+diff-based publication (`PublicationTransportHandler.java:404` sends diffs
+to nodes that have the previous version).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, FrozenSet, List, Optional, Set
+
+
+class DiscoveryNode:
+    __slots__ = ("node_id", "name", "address", "roles")
+
+    def __init__(self, node_id: str, name: str = "", address: str = "",
+                 roles: Optional[Set[str]] = None):
+        self.node_id = node_id
+        self.name = name or node_id
+        self.address = address
+        self.roles = frozenset(roles or {"master", "data"})
+
+    @property
+    def is_master_eligible(self) -> bool:
+        return "master" in self.roles
+
+    def to_dict(self) -> dict:
+        return {"id": self.node_id, "name": self.name, "address": self.address,
+                "roles": sorted(self.roles)}
+
+    @staticmethod
+    def from_dict(d: dict) -> "DiscoveryNode":
+        return DiscoveryNode(d["id"], d.get("name", ""), d.get("address", ""),
+                             set(d.get("roles", [])))
+
+    def __eq__(self, other):
+        return isinstance(other, DiscoveryNode) and self.node_id == other.node_id
+
+    def __hash__(self):
+        return hash(self.node_id)
+
+    def __repr__(self):
+        return f"DiscoveryNode({self.node_id})"
+
+
+class VotingConfiguration:
+    """A quorum-defining node-id set (`CoordinationMetaData.VotingConfiguration`)."""
+
+    __slots__ = ("node_ids",)
+
+    EMPTY: "VotingConfiguration"
+
+    def __init__(self, node_ids):
+        self.node_ids: FrozenSet[str] = frozenset(node_ids)
+
+    def has_quorum(self, votes) -> bool:
+        if not self.node_ids:
+            return False
+        count = sum(1 for v in votes if v in self.node_ids)
+        return count * 2 > len(self.node_ids)
+
+    def __eq__(self, other):
+        return isinstance(other, VotingConfiguration) and self.node_ids == other.node_ids
+
+    def __repr__(self):
+        return f"VotingConfiguration({sorted(self.node_ids)})"
+
+
+VotingConfiguration.EMPTY = VotingConfiguration(())
+
+
+class ShardRoutingEntry:
+    """One shard copy's assignment (`cluster/routing/ShardRouting.java`)."""
+
+    __slots__ = ("index", "shard", "primary", "node_id", "state", "allocation_id")
+
+    UNASSIGNED = "UNASSIGNED"
+    INITIALIZING = "INITIALIZING"
+    STARTED = "STARTED"
+    RELOCATING = "RELOCATING"
+
+    def __init__(self, index: str, shard: int, primary: bool,
+                 node_id: Optional[str], state: str, allocation_id: str):
+        self.index = index
+        self.shard = shard
+        self.primary = primary
+        self.node_id = node_id
+        self.state = state
+        self.allocation_id = allocation_id
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "shard": self.shard, "primary": self.primary,
+                "node": self.node_id, "state": self.state,
+                "allocation_id": self.allocation_id}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ShardRoutingEntry":
+        return ShardRoutingEntry(d["index"], d["shard"], d["primary"],
+                                 d.get("node"), d["state"], d["allocation_id"])
+
+    def copy(self, **kw) -> "ShardRoutingEntry":
+        d = self.to_dict()
+        d.update({"node" if k == "node_id" else k: v for k, v in kw.items()})
+        return ShardRoutingEntry.from_dict(d)
+
+
+class ClusterState:
+    """Immutable; build modified copies via `with_(...)`."""
+
+    __slots__ = ("term", "version", "cluster_name", "master_node_id", "nodes",
+                 "metadata", "routing", "last_committed_config",
+                 "last_accepted_config", "in_sync_allocations")
+
+    def __init__(self, term: int = 0, version: int = 0,
+                 cluster_name: str = "tpu-search",
+                 master_node_id: Optional[str] = None,
+                 nodes: Optional[Dict[str, DiscoveryNode]] = None,
+                 metadata: Optional[Dict[str, dict]] = None,
+                 routing: Optional[List[ShardRoutingEntry]] = None,
+                 last_committed_config: VotingConfiguration = VotingConfiguration.EMPTY,
+                 last_accepted_config: VotingConfiguration = VotingConfiguration.EMPTY,
+                 in_sync_allocations: Optional[Dict[tuple, Set[str]]] = None):
+        self.term = term
+        self.version = version
+        self.cluster_name = cluster_name
+        self.master_node_id = master_node_id
+        self.nodes = dict(nodes or {})
+        self.metadata = metadata or {}          # index name -> {settings, mappings, ...}
+        self.routing = list(routing or [])
+        self.last_committed_config = last_committed_config
+        self.last_accepted_config = last_accepted_config
+        self.in_sync_allocations = dict(in_sync_allocations or {})
+
+    def with_(self, **kw) -> "ClusterState":
+        fields = dict(
+            term=self.term, version=self.version, cluster_name=self.cluster_name,
+            master_node_id=self.master_node_id, nodes=self.nodes,
+            metadata=self.metadata, routing=self.routing,
+            last_committed_config=self.last_committed_config,
+            last_accepted_config=self.last_accepted_config,
+            in_sync_allocations=self.in_sync_allocations)
+        fields.update(kw)
+        return ClusterState(**fields)
+
+    # -- routing helpers ------------------------------------------------------
+    def shards_of(self, index: str) -> List[ShardRoutingEntry]:
+        return [r for r in self.routing if r.index == index]
+
+    def primary_of(self, index: str, shard: int) -> Optional[ShardRoutingEntry]:
+        for r in self.routing:
+            if r.index == index and r.shard == shard and r.primary \
+                    and r.state in (ShardRoutingEntry.STARTED, ShardRoutingEntry.RELOCATING):
+                return r
+        return None
+
+    def replicas_of(self, index: str, shard: int) -> List[ShardRoutingEntry]:
+        return [r for r in self.routing
+                if r.index == index and r.shard == shard and not r.primary]
+
+    def shards_on_node(self, node_id: str) -> List[ShardRoutingEntry]:
+        return [r for r in self.routing if r.node_id == node_id]
+
+    # -- serialization --------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "term": self.term, "version": self.version,
+            "cluster_name": self.cluster_name,
+            "master_node": self.master_node_id,
+            "nodes": {nid: n.to_dict() for nid, n in self.nodes.items()},
+            "metadata": self.metadata,
+            "routing": [r.to_dict() for r in self.routing],
+            "last_committed_config": sorted(self.last_committed_config.node_ids),
+            "last_accepted_config": sorted(self.last_accepted_config.node_ids),
+            "in_sync_allocations": {f"{i}:{s}": sorted(a) for (i, s), a
+                                    in self.in_sync_allocations.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ClusterState":
+        isa = {}
+        for key, ids in d.get("in_sync_allocations", {}).items():
+            index, _, shard = key.rpartition(":")
+            isa[(index, int(shard))] = set(ids)
+        return ClusterState(
+            term=d["term"], version=d["version"],
+            cluster_name=d.get("cluster_name", "tpu-search"),
+            master_node_id=d.get("master_node"),
+            nodes={nid: DiscoveryNode.from_dict(nd)
+                   for nid, nd in d.get("nodes", {}).items()},
+            metadata=d.get("metadata", {}),
+            routing=[ShardRoutingEntry.from_dict(r) for r in d.get("routing", [])],
+            last_committed_config=VotingConfiguration(d.get("last_committed_config", [])),
+            last_accepted_config=VotingConfiguration(d.get("last_accepted_config", [])),
+            in_sync_allocations=isa)
+
+    def diff_from(self, previous: "ClusterState") -> dict:
+        """Publication diff: full state only where sections changed
+        (`DiffableUtils` analog at section granularity)."""
+        d: dict = {"prev_version": previous.version, "term": self.term,
+                   "version": self.version, "master_node": self.master_node_id}
+        full = self.to_dict()
+        prev = previous.to_dict()
+        for section in ("nodes", "metadata", "routing", "last_committed_config",
+                        "last_accepted_config", "in_sync_allocations", "cluster_name"):
+            if full[section] != prev[section]:
+                d[section] = full[section]
+        return d
+
+    def apply_diff(self, diff: dict) -> "ClusterState":
+        if diff.get("prev_version") != self.version:
+            raise ValueError("diff does not apply to this state version")
+        base = self.to_dict()
+        for k, v in diff.items():
+            if k != "prev_version":
+                base[k] = v
+        return ClusterState.from_dict(base)

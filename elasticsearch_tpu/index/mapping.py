@@ -362,6 +362,8 @@ class MapperService:
         self._multi_fields: Dict[str, Dict[str, FieldMapper]] = {}
         self.dynamic = dynamic
         self._meta: dict = {}
+        # set on any mapping mutation; cleared by whoever persists the mapping
+        self.dirty = False
         if mapping:
             self.merge(mapping)
 
@@ -399,6 +401,8 @@ class MapperService:
             raise IllegalArgumentError(
                 f"mapper [{path}] cannot be changed from type [{existing.type_name}] "
                 f"to [{mapper.type_name}]")
+        if existing is None:
+            self.dirty = True
         self._mappers[path] = mapper
 
     def get(self, path: str) -> Optional[FieldMapper]:

@@ -100,7 +100,10 @@ class Node:
             if_primary_term=if_primary_term, version=version,
             version_type=version_type)
         self._maybe_refresh(svc, refresh)
-        self.indices._persist_meta(svc)  # dynamic mapping updates
+        if svc.mapper_service.dirty:
+            # persist only on real dynamic-mapping changes, not per document
+            self.indices._persist_meta(svc)
+            svc.mapper_service.dirty = False
         return {
             "_index": svc.name, "_id": doc_id, "_version": result.version,
             "result": result.result, "_seq_no": result.seq_no,
@@ -173,7 +176,11 @@ class Node:
                              "error": e.to_dict()})
         if "ids" in body and default_index:
             for doc_id in body["ids"]:
-                docs.append(self.get_doc(default_index, doc_id))
+                try:
+                    docs.append(self.get_doc(default_index, doc_id))
+                except SearchEngineError as e:
+                    docs.append({"_index": default_index, "_id": doc_id,
+                                 "error": e.to_dict()})
         return {"docs": docs}
 
     def bulk(self, operations: List[dict], default_index: Optional[str] = None,
@@ -331,11 +338,13 @@ class Node:
         tokens = []
         pos = 0
         for t in texts:
-            for tok in analyzer.analyze(str(t)):
+            text_tokens = analyzer.analyze(str(t))
+            for tok in text_tokens:
                 tokens.append({"token": tok.term, "start_offset": tok.start_offset,
                                "end_offset": tok.end_offset, "type": "<ALPHANUM>",
                                "position": pos + tok.position})
-            pos += len(tokens)
+            # position gap of 1 between texts, like multi-valued fields
+            pos += len(text_tokens) + 1
         return {"tokens": tokens}
 
     # ----------------------------------------------------------------- stats
@@ -482,10 +491,13 @@ def _sort_key_tuple(sort_values, body):
         if isinstance(spec, dict):
             ((_, o),) = spec.items()
             direction = o if isinstance(o, str) else o.get("order", "asc")
-        if v is None:
-            v = float("inf")
         if isinstance(v, str):
             keys.append(v if direction == "asc" else _InvStr(v))
+        elif v is None:
+            # missing sorts last regardless of direction; _MissingLast
+            # compares greater than both floats and strings so mixed-type
+            # columns (string field absent on some docs) don't TypeError
+            keys.append(_MISSING_SENTINEL)
         else:
             keys.append(float(v) if direction == "asc" else -float(v))
     return tuple(keys)
@@ -500,10 +512,30 @@ class _InvStr:
         self.s = s
 
     def __lt__(self, other):
+        if isinstance(other, _MissingLast):
+            return True
         return self.s > other.s
 
     def __eq__(self, other):
-        return self.s == other.s
+        return isinstance(other, _InvStr) and self.s == other.s
+
+
+class _MissingLast:
+    """Compares greater than every other sort key (missing sorts last)."""
+
+    __slots__ = ()
+
+    def __lt__(self, other):
+        return False
+
+    def __gt__(self, other):
+        return not isinstance(other, _MissingLast)
+
+    def __eq__(self, other):
+        return isinstance(other, _MissingLast)
+
+
+_MISSING_SENTINEL = _MissingLast()
 
 
 def _merge_agg_trees(a: dict, b: dict) -> dict:

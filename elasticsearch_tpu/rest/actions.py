@@ -89,8 +89,9 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_doc", post_doc_auto_id)
     rc.register("PUT", "/{index}/_create/{id}", create_doc)
     rc.register("POST", "/{index}/_create/{id}", create_doc)
+    # no direct HEAD registration: RestController's HEAD fallback reuses GET
+    # and strips the body (a HEAD body would desync keep-alive connections)
     rc.register("GET", "/{index}/_doc/{id}", get_doc)
-    rc.register("HEAD", "/{index}/_doc/{id}", get_doc)
     rc.register("GET", "/{index}/_source/{id}", get_source)
     rc.register("DELETE", "/{index}/_doc/{id}", delete_doc)
     rc.register("POST", "/{index}/_update/{id}", update_doc)
@@ -118,8 +119,9 @@ def register_all(rc: RestController, node: Node) -> None:
         # URI-search params (q=, size=, from=, sort=)
         q = req.param("q")
         if q:
-            body.setdefault("query", {"query_string": {"query": q}})
-            # minimal query_string: treat as multi-field match
+            if "query" in body:
+                raise IllegalArgumentError(
+                    "cannot specify both [q] parameter and a request body query")
             body["query"] = _query_string_to_dsl(q)
         for p, key in (("size", "size"), ("from", "from")):
             v = req.int_param(p)

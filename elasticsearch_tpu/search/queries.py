@@ -243,7 +243,10 @@ class MatchQuery(Query):
             rows, freqs = rows[order], freqs[order]
             scores = bm25_scores(ctx, self.field, rows, freqs, self.boost)
             clause_sets.append(DocSet(rows, scores))
-        required = len(clause_sets) if self.operator == "and" else (self.minimum_should_match or 1)
+        if self.operator == "and":
+            required = len(clause_sets)
+        else:
+            required = resolve_msm(self.minimum_should_match, len(clause_sets))
         return _combine_should(clause_sets, required)
 
     def to_dict(self):
@@ -779,6 +782,30 @@ class DisMaxQuery(Query):
 # Bool composition
 # ---------------------------------------------------------------------------
 
+def resolve_msm(msm, n_clauses: int) -> int:
+    """Parse minimum_should_match: int, numeric string, or 'N%' of clauses
+    (reference: `Queries.calculateMinShouldMatch`). Negative values mean
+    'all but N'."""
+    if msm is None:
+        return 1
+    if isinstance(msm, int):
+        value = msm
+    else:
+        s = str(msm).strip()
+        try:
+            if s.endswith("%"):
+                pct = int(s[:-1])
+                value = (n_clauses * pct) // 100 if pct >= 0 else \
+                    n_clauses + (n_clauses * pct) // 100
+            else:
+                value = int(s)
+        except ValueError:
+            raise ParsingError(f"invalid minimum_should_match [{msm}]")
+    if value < 0:
+        value = n_clauses + value
+    return max(min(value, n_clauses), 0)
+
+
 def _combine_should(sets: List[DocSet], minimum_match: int) -> DocSet:
     """Union with score summing; keep docs matching >= minimum_match clauses."""
     sets = [s for s in sets]
@@ -848,6 +875,8 @@ class BoolQuery(Query):
                 scores = scores[i1]
 
         msm = self.minimum_should_match
+        if msm is not None:
+            msm = resolve_msm(msm, len(self.should))
         if self.should:
             should_set = _combine_should([q.execute(ctx).with_scores() for q in self.should],
                                          msm if msm is not None else 1)

@@ -1,0 +1,184 @@
+"""Deterministic simulation: virtual clock, seeded scheduling, disruptable
+in-memory transport.
+
+Re-design of the reference's crown-jewel test harness (SURVEY.md §4.3):
+`DeterministicTaskQueue` + `DisruptableMockTransport`
+(`test/framework/.../cluster/coordination/`). Whole clusters run on one
+thread with a virtual clock; message delivery order is shuffled by a seeded
+RNG; partitions/drops/delays are injected; every run is reproducible from
+its seed. The coordination layer is validated against safety invariants
+under these schedules (the LinearizabilityChecker analog lives in the tests:
+single-leader-per-term + committed-state durability).
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+
+class DeterministicTaskQueue:
+    """Virtual-time scheduler. Tasks run one at a time; `run_random_task`
+    picks among currently-runnable tasks with the seeded RNG, matching the
+    reference's randomized interleavings."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.now_ms = 0
+        self._runnable: List[Tuple[int, Callable[[], None], str]] = []
+        self._deferred: List[Tuple[int, int, Callable[[], None], str]] = []  # (time, tiebreak, fn)
+        self._counter = 0
+
+    def schedule(self, fn: Callable[[], None], label: str = "") -> None:
+        self._counter += 1
+        self._runnable.append((self._counter, fn, label))
+
+    def schedule_at(self, time_ms: int, fn: Callable[[], None], label: str = "") -> None:
+        self._counter += 1
+        heapq.heappush(self._deferred, (max(time_ms, self.now_ms), self._counter, fn, label))
+
+    def schedule_in(self, delay_ms: int, fn: Callable[[], None], label: str = "") -> None:
+        self.schedule_at(self.now_ms + delay_ms, fn, label)
+
+    @property
+    def has_runnable(self) -> bool:
+        return bool(self._runnable)
+
+    @property
+    def has_deferred(self) -> bool:
+        return bool(self._deferred)
+
+    def _promote_due(self) -> None:
+        while self._deferred and self._deferred[0][0] <= self.now_ms:
+            _, counter, fn, label = heapq.heappop(self._deferred)
+            self._runnable.append((counter, fn, label))
+
+    def run_random_task(self) -> bool:
+        """Run one runnable task chosen at random; advance clock if none."""
+        self._promote_due()
+        if not self._runnable:
+            if not self._deferred:
+                return False
+            self.now_ms = self._deferred[0][0]
+            self._promote_due()
+        idx = self.rng.randrange(len(self._runnable))
+        _, fn, _label = self._runnable.pop(idx)
+        fn()
+        return True
+
+    def run_all_runnable(self) -> None:
+        while self._runnable:
+            self.run_random_task()
+
+    def run_for(self, duration_ms: int) -> None:
+        """Run everything scheduled within the next duration_ms of virtual time."""
+        deadline = self.now_ms + duration_ms
+        while True:
+            self._promote_due()
+            if self._runnable:
+                self.run_random_task()
+                continue
+            if self._deferred and self._deferred[0][0] <= deadline:
+                self.now_ms = self._deferred[0][0]
+                continue
+            break
+        self.now_ms = deadline
+
+
+class DisruptableTransport:
+    """In-memory message bus between named nodes with fault injection.
+
+    The analog of `DisruptableMockTransport`: every message is a scheduled
+    task; blackholed or partitioned links silently drop (like a network
+    timeout); delays are randomized within [min,max] from the seeded RNG.
+    """
+
+    def __init__(self, queue: DeterministicTaskQueue,
+                 min_delay_ms: int = 1, max_delay_ms: int = 50):
+        self.queue = queue
+        self.min_delay_ms = min_delay_ms
+        self.max_delay_ms = max_delay_ms
+        self._handlers: Dict[str, Dict[str, Callable]] = {}   # node -> action -> fn
+        self._blackholed: Set[str] = set()                    # nodes dropping everything
+        self._partitions: Set[frozenset] = set()              # {a,b} pairs cut
+        self._disconnected: Set[Tuple[str, str]] = set()      # one-way cuts
+
+    # -- wiring ---------------------------------------------------------------
+    def register(self, node_id: str, action: str,
+                 handler: Callable[[str, Any, Callable[[Any], None]], None]) -> None:
+        """handler(sender, request, respond) — respond sends the reply back."""
+        self._handlers.setdefault(node_id, {})[action] = handler
+
+    # -- faults ---------------------------------------------------------------
+    def blackhole(self, node_id: str) -> None:
+        self._blackholed.add(node_id)
+
+    def heal_node(self, node_id: str) -> None:
+        self._blackholed.discard(node_id)
+
+    def partition(self, side_a: Set[str], side_b: Set[str]) -> None:
+        for a in side_a:
+            for b in side_b:
+                self._partitions.add(frozenset((a, b)))
+
+    def heal_all(self) -> None:
+        self._partitions.clear()
+        self._blackholed.clear()
+        self._disconnected.clear()
+
+    def _delivery_ok(self, sender: str, target: str) -> bool:
+        if sender in self._blackholed or target in self._blackholed:
+            return False
+        if frozenset((sender, target)) in self._partitions:
+            return False
+        if (sender, target) in self._disconnected:
+            return False
+        return True
+
+    # -- sending --------------------------------------------------------------
+    def send(self, sender: str, target: str, action: str, request: Any,
+             on_response: Optional[Callable[[Any], None]] = None,
+             on_failure: Optional[Callable[[Exception], None]] = None) -> None:
+        delay = self.queue.rng.randint(self.min_delay_ms, self.max_delay_ms)
+
+        def deliver():
+            if not self._delivery_ok(sender, target):
+                return  # dropped silently, like a network timeout
+            handler = self._handlers.get(target, {}).get(action)
+            if handler is None:
+                if on_failure:
+                    self.queue.schedule(lambda: on_failure(
+                        RuntimeError(f"no handler for [{action}] on [{target}]")))
+                return
+
+            def respond(response: Any) -> None:
+                rdelay = self.queue.rng.randint(self.min_delay_ms, self.max_delay_ms)
+
+                def deliver_response():
+                    if not self._delivery_ok(target, sender):
+                        return
+                    if on_response is not None:
+                        on_response(response)
+
+                self.queue.schedule_in(rdelay, deliver_response,
+                                       f"response:{action}:{target}->{sender}")
+
+            def fail(error: Exception) -> None:
+                rdelay = self.queue.rng.randint(self.min_delay_ms, self.max_delay_ms)
+
+                def deliver_failure():
+                    if not self._delivery_ok(target, sender):
+                        return
+                    if on_failure is not None:
+                        on_failure(error)
+
+                self.queue.schedule_in(rdelay, deliver_failure,
+                                       f"failure:{action}:{target}->{sender}")
+
+            try:
+                handler(sender, request, respond)
+            except Exception as e:
+                fail(e)
+
+        self.queue.schedule_in(delay, deliver, f"request:{action}:{sender}->{target}")
