@@ -1,0 +1,200 @@
+"""Shard allocation: assigning shard copies to nodes.
+
+Re-design of `routing/allocation/AllocationService.java` + the balanced
+allocator + deciders (SURVEY.md §2.3): pure functions from (cluster state,
+event) to a new routing table. Deciders enforced here:
+  - same-shard: never two copies of one shard on a node
+    (`SameShardAllocationDecider`)
+  - balance: new copies go to data nodes with the fewest shards
+    (`BalancedShardsAllocator`, weight = shard count)
+Events: index created, node joined (allocate unassigned), node left
+(promote replicas / reallocate), shard started, shard failed.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.cluster.state import ClusterState, ShardRoutingEntry
+
+_alloc_counter = itertools.count()
+
+
+def _new_allocation_id(index: str, shard: int) -> str:
+    return f"{index}[{shard}]#{next(_alloc_counter)}"
+
+
+def _data_nodes(state: ClusterState) -> List[str]:
+    return sorted(nid for nid, n in state.nodes.items() if "data" in n.roles)
+
+
+def _shard_counts(routing: List[ShardRoutingEntry]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for r in routing:
+        if r.node_id:
+            counts[r.node_id] = counts.get(r.node_id, 0) + 1
+    return counts
+
+
+def _pick_node(routing: List[ShardRoutingEntry], candidates: List[str],
+               exclude: Set[str]) -> Optional[str]:
+    counts = _shard_counts(routing)
+    usable = [n for n in candidates if n not in exclude]
+    if not usable:
+        return None
+    return min(usable, key=lambda n: (counts.get(n, 0), n))
+
+
+def allocate_new_index(state: ClusterState, index: str, num_shards: int,
+                       num_replicas: int) -> ClusterState:
+    """Create INITIALIZING entries for a new index's shards."""
+    routing = list(state.routing)
+    nodes = _data_nodes(state)
+    isa = dict(state.in_sync_allocations)
+    for shard in range(num_shards):
+        occupied: Set[str] = set()
+        primary_node = _pick_node(routing, nodes, occupied)
+        primary = ShardRoutingEntry(index, shard, True, primary_node,
+                                    ShardRoutingEntry.INITIALIZING if primary_node
+                                    else ShardRoutingEntry.UNASSIGNED,
+                                    _new_allocation_id(index, shard))
+        routing.append(primary)
+        if primary_node:
+            occupied.add(primary_node)
+        for _ in range(num_replicas):
+            rnode = _pick_node(routing, nodes, occupied)
+            routing.append(ShardRoutingEntry(
+                index, shard, False, rnode,
+                ShardRoutingEntry.INITIALIZING if rnode else ShardRoutingEntry.UNASSIGNED,
+                _new_allocation_id(index, shard)))
+            if rnode:
+                occupied.add(rnode)
+        isa[(index, shard)] = set()
+    return state.with_(routing=routing, in_sync_allocations=isa)
+
+
+def remove_index(state: ClusterState, index: str) -> ClusterState:
+    return state.with_(
+        routing=[r for r in state.routing if r.index != index],
+        in_sync_allocations={k: v for k, v in state.in_sync_allocations.items()
+                             if k[0] != index},
+        metadata={k: v for k, v in state.metadata.items() if k != index})
+
+
+def shard_started(state: ClusterState, allocation_id: str) -> ClusterState:
+    routing = []
+    isa = dict(state.in_sync_allocations)
+    for r in state.routing:
+        if r.allocation_id == allocation_id and r.state == ShardRoutingEntry.INITIALIZING:
+            r = r.copy(state=ShardRoutingEntry.STARTED)
+            key = (r.index, r.shard)
+            isa[key] = set(isa.get(key, set())) | {allocation_id}
+        routing.append(r)
+    return state.with_(routing=routing, in_sync_allocations=isa)
+
+
+def shard_failed(state: ClusterState, allocation_id: str) -> ClusterState:
+    """Fail one copy: primaries promote an in-sync replica; the failed copy
+    is reallocated if a node is free (`ReplicationOperation` failure path +
+    `AllocationService.applyFailedShards`)."""
+    failed = next((r for r in state.routing if r.allocation_id == allocation_id), None)
+    if failed is None:
+        return state
+    return _handle_copy_loss(state, [failed])
+
+
+def node_left(state: ClusterState, node_id: str) -> ClusterState:
+    lost = [r for r in state.routing if r.node_id == node_id]
+    if not lost:
+        return state
+    return _handle_copy_loss(state, lost)
+
+
+def _handle_copy_loss(state: ClusterState, lost: List[ShardRoutingEntry]) -> ClusterState:
+    lost_ids = {r.allocation_id for r in lost}
+    routing = [r for r in state.routing if r.allocation_id not in lost_ids]
+    isa = {k: set(v) for k, v in state.in_sync_allocations.items()}
+
+    for r in lost:
+        key = (r.index, r.shard)
+        isa.get(key, set()).discard(r.allocation_id)
+        if r.primary:
+            # promote an in-sync STARTED replica (reference: primary failover
+            # only from the in-sync set — data-loss safety)
+            promoted = False
+            for i, cand in enumerate(routing):
+                if (cand.index, cand.shard) == key and not cand.primary \
+                        and cand.state == ShardRoutingEntry.STARTED \
+                        and cand.allocation_id in isa.get(key, set()):
+                    routing[i] = cand.copy(primary=True)
+                    promoted = True
+                    break
+            if not promoted:
+                # no safe copy: shard red/unassigned primary
+                routing.append(ShardRoutingEntry(
+                    r.index, r.shard, True, None, ShardRoutingEntry.UNASSIGNED,
+                    _new_allocation_id(r.index, r.shard)))
+
+    state = state.with_(routing=routing, in_sync_allocations=isa)
+    return reroute(state)
+
+
+def reroute(state: ClusterState) -> ClusterState:
+    """Allocate unassigned copies and top up missing replicas
+    (`AllocationService.reroute`). Balance via an incrementally-updated
+    shard-count map (no double counting)."""
+    nodes = _data_nodes(state)
+    counts = _shard_counts(state.routing)
+
+    def pick(exclude: Set[str]) -> Optional[str]:
+        usable = [n for n in nodes if n not in exclude]
+        if not usable:
+            return None
+        chosen = min(usable, key=lambda n: (counts.get(n, 0), n))
+        counts[chosen] = counts.get(chosen, 0) + 1
+        return chosen
+
+    by_shard: Dict[Tuple[str, int], List[ShardRoutingEntry]] = {}
+    for r in state.routing:
+        by_shard.setdefault((r.index, r.shard), []).append(r)
+
+    new_routing: List[ShardRoutingEntry] = []
+    for key, copies in sorted(by_shard.items()):
+        index, shard = key
+        desired_replicas = int(state.metadata.get(index, {}).get(
+            "settings", {}).get("index.number_of_replicas", 1))
+        occupied = {r.node_id for r in copies if r.node_id}
+        out = []
+        for r in copies:
+            if r.state == ShardRoutingEntry.UNASSIGNED and r.node_id is None:
+                if r.primary:
+                    # NEVER auto-allocate an unassigned primary: no node holds
+                    # in-sync data for it, so assigning would fabricate an
+                    # empty shard — silent data loss. The shard stays red
+                    # until an operator forces allocation (reference:
+                    # primaries allocate only to in-sync copy holders;
+                    # allocate_empty_primary is an explicit dangerous command)
+                    out.append(r)
+                    continue
+                node = pick(occupied)
+                if node is not None:
+                    r = r.copy(node=node, state=ShardRoutingEntry.INITIALIZING)
+                    occupied.add(node)
+            out.append(r)
+        # top up replicas only when a live primary exists to recover from
+        has_active_primary = any(
+            r.primary and r.node_id and r.state != ShardRoutingEntry.UNASSIGNED
+            for r in out)
+        replica_count = sum(1 for r in out if not r.primary)
+        while has_active_primary and replica_count < desired_replicas:
+            node = pick(occupied)
+            if node is None:
+                break
+            out.append(ShardRoutingEntry(index, shard, False, node,
+                                         ShardRoutingEntry.INITIALIZING,
+                                         _new_allocation_id(index, shard)))
+            occupied.add(node)
+            replica_count += 1
+        new_routing.extend(out)
+    return state.with_(routing=new_routing)

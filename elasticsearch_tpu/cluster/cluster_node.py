@@ -1,0 +1,589 @@
+"""A full cluster-aware node: coordination + shard lifecycle + replication +
+distributed search.
+
+This composes the layers the reference wires in `node/Node.java`:
+
+- `IndicesClusterStateService.applyClusterState` (reference `:210`): on every
+  committed cluster state, diff the routing table against local shards —
+  create INITIALIZING copies assigned here (primaries activate the
+  replication tracker; replicas run ops-based peer recovery from the
+  primary), promote on failover, remove unassigned copies.
+- `TransportReplicationAction` / `ReplicationOperation` (§3.3): writes route
+  to the primary, execute under the primary term, fan out to in-sync replica
+  copies, and acknowledge when all copies respond; a failed copy is reported
+  to the master (`shard_failed`) which reroutes.
+- Peer recovery (§3.5): ops-based — the replica pulls all operations above
+  its local checkpoint from the primary's translog, replays them, and the
+  primary marks it in-sync (retention-lease-free simplification of
+  `RecoverySourceHandler` phase2).
+- Scatter-gather search (§3.2): the coordinating node fans per-shard
+  query(+fetch) requests to one STARTED copy per shard and merges hits by
+  score/sort with shard-order tie-break — the host-RPC analog of the
+  compiled ICI merge in `parallel/sharded_knn.py`.
+
+Transport/scheduler are injected (same API as testing.deterministic), so the
+whole stack runs under the deterministic simulator or a real asyncio TCP
+transport unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.cluster import allocation
+from elasticsearch_tpu.cluster.coordination import (
+    LEADER, Coordinator, PersistedState,
+)
+from elasticsearch_tpu.cluster.routing import shard_id_for
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, DiscoveryNode, ShardRoutingEntry,
+)
+from elasticsearch_tpu.common.errors import (
+    IndexNotFoundError, SearchEngineError,
+)
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.seqno import ReplicationTracker
+from elasticsearch_tpu.search.service import (
+    execute_fetch_phase, execute_query_phase,
+)
+from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+# transport actions (reference: action names in TransportService registry)
+WRITE_PRIMARY = "indices:data/write/primary"
+WRITE_REPLICA = "indices:data/write/replica"
+QUERY_SHARD = "indices:data/read/query"
+RECOVERY_START = "internal:index/shard/recovery/start_recovery"
+MASTER_CREATE_INDEX = "cluster:admin/indices/create"
+MASTER_DELETE_INDEX = "cluster:admin/indices/delete"
+MASTER_SHARD_STARTED = "internal:cluster/shard/started"
+MASTER_SHARD_FAILED = "internal:cluster/shard/failure"
+
+
+class LocalShard:
+    def __init__(self, routing: ShardRoutingEntry, engine: Engine,
+                 mapper_service: MapperService):
+        self.routing = routing
+        self.engine = engine
+        self.mapper_service = mapper_service
+        self.tracker = ReplicationTracker(routing.allocation_id)
+        self.vector_store = VectorStoreShard()
+        engine.add_refresh_listener(self._sync_vectors)
+        self._sync_vectors(engine.acquire_searcher())
+
+    def _sync_vectors(self, reader):
+        vf = self.mapper_service.vector_fields()
+        if vf:
+            self.vector_store.sync(reader, vf)
+
+
+class ClusterNode:
+    def __init__(self, node_id: str, data_path: str, transport, scheduler,
+                 seed_peers: List[str], initial_state: ClusterState,
+                 rng=None):
+        self.node_id = node_id
+        self.data_path = data_path
+        self.transport = transport
+        self.scheduler = scheduler
+        self.local_shards: Dict[Tuple[str, int], LocalShard] = {}
+        self.mappers: Dict[str, MapperService] = {}
+        node = DiscoveryNode(node_id)
+        self.coordinator = Coordinator(
+            node, PersistedState(0, initial_state), transport, scheduler,
+            seed_peers=seed_peers, on_committed=self.apply_cluster_state, rng=rng)
+        self.coordinator.membership_listener = self._on_membership_change
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ admin
+    def start(self):
+        self.coordinator.start()
+
+    def stop(self):
+        self.coordinator.stop()
+        for shard in self.local_shards.values():
+            shard.engine.close()
+
+    @property
+    def cluster_state(self) -> ClusterState:
+        return self.coordinator.committed_state
+
+    @property
+    def is_master(self) -> bool:
+        return self.coordinator.mode == LEADER
+
+    # ------------------------------------------------- master-side state tasks
+    def _on_membership_change(self, state: ClusterState, added: Set[str],
+                              removed: Set[str]) -> ClusterState:
+        for nid in removed:
+            state = allocation.node_left(state, nid)
+        if added:
+            state = allocation.reroute(state)
+        return state
+
+    def _require_master(self):
+        if self.coordinator.mode != LEADER:
+            # raising fails the transport call → sender's retry loop finds
+            # the new master (reference: NotMasterException)
+            raise SearchEngineError(f"[{self.node_id}] is not the elected master")
+
+    def _master_create_index(self, sender, request, respond):
+        self._require_master()
+        name = request["index"]
+
+        def update(base: ClusterState) -> ClusterState:
+            if name in base.metadata:
+                return base
+            settings = dict(request.get("settings") or {})
+            settings.setdefault("index.number_of_shards", 1)
+            settings.setdefault("index.number_of_replicas", 1)
+            meta = dict(base.metadata)
+            meta[name] = {"settings": settings,
+                          "mappings": request.get("mappings") or {"properties": {}}}
+            state = base.with_(metadata=meta)
+            return allocation.allocate_new_index(
+                state, name, int(settings["index.number_of_shards"]),
+                int(settings["index.number_of_replicas"]))
+
+        ok = self.coordinator.publish_state_update(update)
+        respond({"acknowledged": ok})
+
+    def _master_delete_index(self, sender, request, respond):
+        self._require_master()
+        name = request["index"]
+        ok = self.coordinator.publish_state_update(
+            lambda base: allocation.remove_index(base, name)
+            if name in base.metadata else base)
+        respond({"acknowledged": ok})
+
+    def _master_shard_started(self, sender, request, respond):
+        self._require_master()
+        aid = request["allocation_id"]
+        self.coordinator.publish_state_update(
+            lambda base: allocation.shard_started(base, aid))
+        respond({"ack": True})
+
+    def _master_shard_failed(self, sender, request, respond):
+        self._require_master()
+        aid = request["allocation_id"]
+        self.coordinator.publish_state_update(
+            lambda base: allocation.shard_failed(base, aid))
+        respond({"ack": True})
+
+    def _send_to_master(self, action: str, request: dict,
+                        on_response=None, on_failure=None, retries: int = 60):
+        """Master-node action with retry-until-master-known semantics
+        (reference: TransportMasterNodeAction observes cluster state and
+        retries on NotMasterException / no-master)."""
+        master = self.cluster_state.master_node_id
+        if self.is_master:
+            master = self.node_id
+
+        def retry(_err=None):
+            if retries <= 0:
+                if on_failure:
+                    on_failure(SearchEngineError("no elected master"))
+                return
+            self.scheduler.schedule_in(
+                500, lambda: self._send_to_master(action, request, on_response,
+                                                  on_failure, retries - 1),
+                f"master_retry:{action}")
+
+        if master is None:
+            retry()
+            return
+        self.transport.send(self.node_id, master, action, request,
+                            on_response=on_response, on_failure=retry)
+
+    # --------------------------------------------------- cluster state applier
+    def apply_cluster_state(self, state: ClusterState) -> None:
+        """IndicesClusterStateService.applyClusterState analog."""
+        my_entries = {(r.index, r.shard): r for r in state.routing
+                      if r.node_id == self.node_id}
+
+        # remove shards no longer assigned here
+        for key in list(self.local_shards):
+            mine = my_entries.get(key)
+            if mine is None or mine.allocation_id != self.local_shards[key].routing.allocation_id:
+                if mine is None:
+                    shard = self.local_shards.pop(key)
+                    shard.engine.close()
+
+        # create / update assigned shards
+        for key, entry in my_entries.items():
+            index, shard_id = key
+            meta = state.metadata.get(index)
+            if meta is None:
+                continue
+            local = self.local_shards.get(key)
+            if local is None:
+                mapper = self.mappers.setdefault(index, MapperService(
+                    meta.get("mappings") or {"properties": {}}))
+                path = os.path.join(self.data_path, index, str(shard_id),
+                                    entry.allocation_id.replace("/", "_").replace("#", "_"))
+                engine = Engine(path, mapper, translog_sync="async")
+                local = LocalShard(entry, engine, mapper)
+                self.local_shards[key] = local
+                if entry.primary:
+                    local.tracker.activate_primary_mode(engine.local_checkpoint)
+                    self._send_to_master(MASTER_SHARD_STARTED,
+                                         {"allocation_id": entry.allocation_id})
+                else:
+                    self._start_replica_recovery(local, state)
+            else:
+                was_primary = local.routing.primary
+                local.routing = entry
+                if entry.primary and not was_primary:
+                    # failover promotion (reference: IndexShard#activateWithPrimaryContext)
+                    local.tracker = ReplicationTracker(entry.allocation_id)
+                    local.tracker.activate_primary_mode(local.engine.local_checkpoint)
+
+    def _start_replica_recovery(self, local: LocalShard, state: ClusterState) -> None:
+        entry = local.routing
+        primary = state.primary_of(entry.index, entry.shard)
+        if primary is None or primary.node_id is None:
+            # retry when a primary shows up
+            self.scheduler.schedule_in(500, lambda: self._retry_recovery(entry),
+                                       f"recovery_retry:{entry.allocation_id}")
+            return
+
+        def on_ops(response):
+            for op in response["ops"]:
+                self._apply_replica_op(local, op)
+            self._send_to_master(MASTER_SHARD_STARTED,
+                                 {"allocation_id": entry.allocation_id})
+
+        def on_fail(_err):
+            # primary not ready yet (e.g. promotion not applied there) or the
+            # request raced a topology change: retry while still INITIALIZING
+            self.scheduler.schedule_in(1000, lambda: self._retry_recovery(entry),
+                                       f"recovery_retry:{entry.allocation_id}")
+
+        self.transport.send(
+            self.node_id, primary.node_id, RECOVERY_START,
+            {"index": entry.index, "shard": entry.shard,
+             "allocation_id": entry.allocation_id,
+             "from_seq_no": local.engine.local_checkpoint + 1},
+            on_response=on_ops, on_failure=on_fail)
+        # dropped-message safety net: if neither response nor failure arrives
+        # (partition during recovery), retry while still INITIALIZING
+        self.scheduler.schedule_in(5000, lambda: self._retry_recovery(entry),
+                                   f"recovery_timeout:{entry.allocation_id}")
+
+    def _retry_recovery(self, entry: ShardRoutingEntry) -> None:
+        local = self.local_shards.get((entry.index, entry.shard))
+        if local is not None and local.routing.allocation_id == entry.allocation_id \
+                and local.routing.state == ShardRoutingEntry.INITIALIZING:
+            self._start_replica_recovery(local, self.cluster_state)
+
+    def _on_recovery_start(self, sender, request, respond):
+        """Primary side: hand over history + mark the copy in-sync."""
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None or not local.routing.primary:
+            raise SearchEngineError(f"not primary for {key}")
+        ops = local.engine.translog.read_ops(0)
+        local.tracker.init_tracking(request["allocation_id"])
+        local.tracker.mark_in_sync(request["allocation_id"],
+                                   local.engine.local_checkpoint)
+        respond({"ops": ops, "global_checkpoint": local.tracker.global_checkpoint})
+
+    # ------------------------------------------------------------- write path
+    def client_write(self, index: str, op: dict,
+                     on_done: Callable[[dict], None],
+                     on_failure: Optional[Callable[[Exception], None]] = None) -> None:
+        """op: {type: index|delete, id, source?}; routes to the primary."""
+        state = self.cluster_state
+        meta = state.metadata.get(index)
+        if meta is None:
+            (on_failure or on_done)(IndexNotFoundError(index)
+                                    if on_failure else {"error": "index_not_found"})
+            return
+        num_shards = int(meta["settings"].get("index.number_of_shards", 1))
+        sid = shard_id_for(op.get("routing") or op["id"], num_shards)
+        primary = state.primary_of(index, sid)
+        if primary is None or primary.node_id is None:
+            if on_failure:
+                on_failure(SearchEngineError(f"no active primary for [{index}][{sid}]"))
+            return
+        request = {"index": index, "shard": sid, "op": op}
+        if primary.node_id == self.node_id:
+            self._on_write_primary(self.node_id, request, on_done)
+        else:
+            self.transport.send(self.node_id, primary.node_id, WRITE_PRIMARY,
+                                request, on_response=on_done, on_failure=on_failure)
+
+    def _on_write_primary(self, sender, request, respond):
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None or not local.routing.primary:
+            raise SearchEngineError(f"[{key}] not primary on [{self.node_id}]")
+        op = request["op"]
+        if op["type"] == "index":
+            result = local.engine.index(op["id"], op["source"],
+                                        op_type=op.get("op_type", "index"))
+        else:
+            result = local.engine.delete(op["id"])
+        local.tracker.update_local_checkpoint(local.routing.allocation_id,
+                                              local.engine.local_checkpoint)
+
+        state = self.cluster_state
+        replicas = [r for r in state.replicas_of(*key)
+                    if r.state == ShardRoutingEntry.STARTED and r.node_id]
+        response = {"_index": request["index"], "_shard": request["shard"],
+                    "_id": op["id"], "_seq_no": result.seq_no,
+                    "_primary_term": result.primary_term,
+                    "_version": result.version, "result": result.result}
+        if not replicas:
+            respond(response)
+            return
+
+        pending = {"count": len(replicas)}
+
+        def one_ack(_resp, rep=None):
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                respond(response)
+
+        def one_fail(err, rep):
+            # replica failed to apply: ask master to fail that copy, then ack
+            # (reference: ReplicationOperation#onPrimaryOperationFailure path)
+            self._send_to_master(MASTER_SHARD_FAILED,
+                                 {"allocation_id": rep.allocation_id})
+            one_ack(None)
+
+        replica_req = {"index": request["index"], "shard": request["shard"],
+                       "op": op, "seq_no": result.seq_no,
+                       "primary_term": result.primary_term,
+                       "version": result.version,
+                       "global_checkpoint": local.tracker.global_checkpoint}
+        for rep in replicas:
+            self.transport.send(self.node_id, rep.node_id, WRITE_REPLICA,
+                                replica_req,
+                                on_response=one_ack,
+                                on_failure=lambda e, rep=rep: one_fail(e, rep))
+
+    def _on_write_replica(self, sender, request, respond):
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None:
+            raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        self._apply_replica_op(local, {**request["op"],
+                                       "seq_no": request["seq_no"],
+                                       "primary_term": request["primary_term"],
+                                       "version": request["version"]})
+        local.tracker.update_global_checkpoint_on_replica(
+            request.get("global_checkpoint", -1))
+        respond({"ack": True, "local_checkpoint": local.engine.local_checkpoint})
+
+    def _apply_replica_op(self, local: LocalShard, op: dict) -> None:
+        if op.get("type", op.get("op")) in ("index", None):
+            local.engine.index(op["id"], op.get("source") or {},
+                               seq_no=op["seq_no"],
+                               primary_term=op.get("primary_term"),
+                               version=op.get("version"), origin="replica")
+        else:
+            try:
+                local.engine.delete(op["id"], seq_no=op["seq_no"],
+                                    primary_term=op.get("primary_term"),
+                                    version=op.get("version"), origin="replica")
+            except SearchEngineError:
+                pass
+
+    # ------------------------------------------------------------ search path
+    def client_search(self, index: str, body: dict,
+                      on_done: Callable[[dict], None]) -> None:
+        state = self.cluster_state
+        if index not in state.metadata:
+            on_done({"error": {"type": "index_not_found_exception",
+                               "reason": f"no such index [{index}]"},
+                     "status": 404})
+            return
+        num_shards = int(state.metadata[index]["settings"].get("index.number_of_shards", 1))
+        targets = []
+        unsearchable = 0  # red shards: no STARTED copy anywhere
+        for sid in range(num_shards):
+            copies = [r for r in state.routing
+                      if r.index == index and r.shard == sid
+                      and r.state == ShardRoutingEntry.STARTED and r.node_id]
+            if not copies:
+                unsearchable += 1
+                continue
+            # adaptive-replica-selection-lite: spread by shard id
+            chosen = copies[sid % len(copies)]
+            targets.append(chosen)
+        if not targets:
+            on_done({"hits": {"total": {"value": 0, "relation": "eq"}, "hits": []},
+                     "_shards": {"total": num_shards, "successful": 0,
+                                 "failed": unsearchable}})
+            return
+
+        results: List[Optional[dict]] = [None] * len(targets)
+        pending = {"count": len(targets)}
+
+        def finish():
+            merged = self._merge_shard_results(results, body, num_shards)
+            merged["_shards"]["failed"] += unsearchable
+            merged["_shards"]["successful"] -= 0
+            on_done(merged)
+
+        for i, entry in enumerate(targets):
+            req = {"index": index, "shard": entry.shard, "body": body}
+
+            def on_resp(resp, i=i):
+                results[i] = resp
+                pending["count"] -= 1
+                if pending["count"] == 0:
+                    finish()
+
+            def on_fail(err, i=i):
+                results[i] = {"failed": str(err)}
+                pending["count"] -= 1
+                if pending["count"] == 0:
+                    finish()
+
+            if entry.node_id == self.node_id:
+                try:
+                    self._on_query_shard(self.node_id, req, lambda r, i=i: on_resp(r, i))
+                except Exception as e:
+                    on_fail(e, i)
+            else:
+                self.transport.send(self.node_id, entry.node_id, QUERY_SHARD, req,
+                                    on_response=on_resp, on_failure=on_fail)
+
+    def _on_query_shard(self, sender, request, respond):
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None:
+            raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        body = request["body"]
+        reader = local.engine.acquire_searcher()
+        result = execute_query_phase(reader, local.mapper_service, body,
+                                     shard_id=request["shard"],
+                                     vector_store=local.vector_store)
+        hits = execute_fetch_phase(reader, local.mapper_service, body, result,
+                                   index_name=request["index"])
+        respond({
+            "shard": request["shard"],
+            "total": result.total_hits,
+            "relation": result.total_relation,
+            "max_score": result.max_score,
+            "hits": hits,
+            "scores": [float(s) for s in result.scores],
+            "sort_values": [list(sv) for sv in result.sort_values]
+            if result.sort_values is not None else None,
+            "aggregations": result.aggregations,
+        })
+
+    def _merge_shard_results(self, results: List[Optional[dict]], body: dict,
+                             num_shards: int) -> dict:
+        """Coordinator reduce (`SearchPhaseController.merge:293` analog)."""
+        from elasticsearch_tpu.node import _merge_agg_trees, _sort_key_tuple
+
+        all_hits = []
+        total = 0
+        relation = "eq"
+        max_score = None
+        aggs = None
+        failed = 0
+        for res in results:
+            if res is None or "failed" in res:
+                failed += 1
+                continue
+            total += res["total"]
+            if res.get("relation") == "gte":
+                relation = "gte"
+            if res.get("max_score") is not None:
+                max_score = max(max_score or -1e30, res["max_score"])
+            for h, score, sv in zip(res["hits"], res["scores"],
+                                    res["sort_values"] or [None] * len(res["hits"])):
+                all_hits.append((h, score, sv, res["shard"]))
+            if res.get("aggregations") is not None:
+                aggs = res["aggregations"] if aggs is None else \
+                    _merge_agg_trees(aggs, res["aggregations"])
+
+        if body.get("sort"):
+            all_hits.sort(key=lambda t: (_sort_key_tuple(t[2], body), t[3]))
+        else:
+            all_hits.sort(key=lambda t: (-t[1], t[3]))
+        frm = int(body.get("from", 0) or 0)
+        size = int(body.get("size", 10) if body.get("size") is not None else 10)
+        window = all_hits[frm:frm + size]
+        out = {
+            "took": 0, "timed_out": False,
+            "_shards": {"total": num_shards,
+                        "successful": len(results) - failed,
+                        "skipped": 0, "failed": failed},
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": max_score,
+                     "hits": [h for h, _, _, _ in window]},
+        }
+        if aggs is not None:
+            out["aggregations"] = aggs
+        return out
+
+    def client_get(self, index: str, doc_id: str,
+                   on_done: Callable[[dict], None]) -> None:
+        state = self.cluster_state
+        meta = state.metadata.get(index)
+        if meta is None:
+            on_done({"found": False, "error": "index_not_found"})
+            return
+        num_shards = int(meta["settings"].get("index.number_of_shards", 1))
+        sid = shard_id_for(doc_id, num_shards)
+        primary = state.primary_of(index, sid)
+        if primary is None:
+            on_done({"found": False, "error": "no_primary"})
+            return
+
+        request = {"index": index, "shard": sid, "id": doc_id}
+        if primary.node_id == self.node_id:
+            self._on_get(self.node_id, request, on_done)
+        else:
+            self.transport.send(self.node_id, primary.node_id,
+                                "indices:data/read/get", request,
+                                on_response=on_done)
+
+    def _on_get(self, sender, request, respond):
+        local = self.local_shards.get((request["index"], request["shard"]))
+        if local is None:
+            respond({"found": False})
+            return
+        doc = local.engine.get(request["id"])
+        if doc is None:
+            respond({"_index": request["index"], "_id": request["id"], "found": False})
+        else:
+            respond({"_index": request["index"], "_id": request["id"],
+                     "found": True, "_source": doc["_source"],
+                     "_seq_no": doc["_seq_no"], "_version": doc["_version"]})
+
+    def refresh_all(self) -> None:
+        for shard in self.local_shards.values():
+            shard.engine.refresh()
+
+    # ------------------------------------------------------------------ wiring
+    def _register_handlers(self):
+        t = self.transport
+        me = self.node_id
+        t.register(me, WRITE_PRIMARY, self._on_write_primary)
+        t.register(me, WRITE_REPLICA, self._on_write_replica)
+        t.register(me, QUERY_SHARD, self._on_query_shard)
+        t.register(me, "indices:data/read/get", self._on_get)
+        t.register(me, RECOVERY_START, self._on_recovery_start)
+        t.register(me, MASTER_CREATE_INDEX, self._master_create_index)
+        t.register(me, MASTER_DELETE_INDEX, self._master_delete_index)
+        t.register(me, MASTER_SHARD_STARTED, self._master_shard_started)
+        t.register(me, MASTER_SHARD_FAILED, self._master_shard_failed)
+
+    # client admin helpers ----------------------------------------------------
+    def client_create_index(self, name: str, settings: Optional[dict] = None,
+                            mappings: Optional[dict] = None,
+                            on_done: Optional[Callable] = None) -> None:
+        self._send_to_master(MASTER_CREATE_INDEX,
+                             {"index": name, "settings": settings,
+                              "mappings": mappings},
+                             on_response=on_done or (lambda r: None))
+
+    def client_delete_index(self, name: str, on_done: Optional[Callable] = None) -> None:
+        self._send_to_master(MASTER_DELETE_INDEX, {"index": name},
+                             on_response=on_done or (lambda r: None))

@@ -245,6 +245,11 @@ class Coordinator:
         self.committed_state: ClusterState = persisted.last_accepted
         self.stopped = False
         self._election_round = 0
+        # optional hook: (state, added_ids, removed_ids) -> state, applied by
+        # the leader after membership changes so shard allocation follows
+        # node join/leave (reference: AllocationService wired into
+        # JoinTaskExecutor / NodeRemovalClusterStateTaskExecutor)
+        self.membership_listener: Optional[Callable[[ClusterState, Set[str], Set[str]], ClusterState]] = None
         self._register_handlers()
 
     # ------------------------------------------------------------------ wiring
@@ -366,6 +371,13 @@ class Coordinator:
             version=max(base.version, self.state.last_published_version) + 1,
             master_node_id=self.node.node_id, nodes=nodes,
             last_accepted_config=config)
+        if self.membership_listener is not None:
+            # nodes (re)joining via election-time join votes must trigger
+            # allocation just like post-election joins, or shards left
+            # unassigned by their departure never re-allocate
+            added = set(nodes) - set(base.nodes)
+            removed = set(base.nodes) - set(nodes)
+            state = self.membership_listener(state, added, removed)
         self._publish(state)
 
     def publish_state_update(self, updater: Callable[[ClusterState], ClusterState]) -> bool:
@@ -481,8 +493,11 @@ class Coordinator:
                 return base
             nodes = dict(base.nodes)
             nodes[node_id] = DiscoveryNode(node_id)
-            return base.with_(nodes=nodes,
-                              last_accepted_config=self._choose_voting_config(nodes))
+            state = base.with_(nodes=nodes,
+                               last_accepted_config=self._choose_voting_config(nodes))
+            if self.membership_listener is not None:
+                state = self.membership_listener(state, {node_id}, set())
+            return state
 
         self.publish_state_update(add)
 
@@ -492,8 +507,11 @@ class Coordinator:
                 return base
             nodes = dict(base.nodes)
             nodes.pop(node_id)
-            return base.with_(nodes=nodes,
-                              last_accepted_config=self._choose_voting_config(nodes))
+            state = base.with_(nodes=nodes,
+                               last_accepted_config=self._choose_voting_config(nodes))
+            if self.membership_listener is not None:
+                state = self.membership_listener(state, set(), {node_id})
+            return state
 
         self.publish_state_update(remove)
 

@@ -140,6 +140,9 @@ class Translog:
         ops-based peer recovery / CCR shard-changes
         (`RecoverySourceHandler.java:290`, `ShardChangesAction.java:59`).
         """
+        # async-durability shards buffer appends; flush (no fsync needed for a
+        # same-process read) so recovery sees every operation
+        self._file.flush()
         ops = []
         for gen in range(self.min_generation, self.generation + 1):
             for op in self._read_gen(gen):

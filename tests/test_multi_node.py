@@ -1,0 +1,240 @@
+"""In-process multi-node cluster tests (InternalTestCluster analog, §4.2):
+full ClusterNodes over the deterministic transport — replication, recovery,
+primary failover, distributed search."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+from elasticsearch_tpu.cluster.coordination import bootstrap_state
+from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+from elasticsearch_tpu.testing.deterministic import (
+    DeterministicTaskQueue, DisruptableTransport,
+)
+
+
+class TestCluster:
+    def __init__(self, tmp_path, n_nodes=3, seed=0):
+        self.queue = DeterministicTaskQueue(seed=seed)
+        self.transport = DisruptableTransport(self.queue)
+        ids = [f"n{i}" for i in range(n_nodes)]
+        initial = bootstrap_state(ids)
+        self.nodes = {}
+        for nid in ids:
+            self.nodes[nid] = ClusterNode(
+                nid, str(tmp_path / nid), self.transport, self.queue,
+                seed_peers=[p for p in ids if p != nid], initial_state=initial)
+        for n in self.nodes.values():
+            n.start()
+
+    def run_until(self, cond, max_ms=120_000, step=200):
+        waited = 0
+        while waited < max_ms:
+            self.queue.run_for(step)
+            waited += step
+            if cond():
+                return True
+        return cond()
+
+    def master(self):
+        for n in self.nodes.values():
+            if n.is_master and not n.coordinator.stopped:
+                return n
+        return None
+
+    def any_node(self, exclude=()):
+        for nid, n in self.nodes.items():
+            if nid not in exclude and not n.coordinator.stopped:
+                return n
+        raise AssertionError("no live node")
+
+    def all_started(self, index):
+        n = self.any_node()
+        shards = n.cluster_state.shards_of(index)
+        return bool(shards) and all(
+            s.state == ShardRoutingEntry.STARTED for s in shards)
+
+    def call(self, fn, *args, **kw):
+        """Invoke a callback-style client method; run the sim until it responds."""
+        box = {}
+        fn(*args, **kw, on_done=lambda r: box.update(r=r))
+        ok = self.run_until(lambda: "r" in box)
+        assert ok, f"no response from {fn.__name__}"
+        return box["r"]
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = TestCluster(tmp_path, n_nodes=3, seed=17)
+    assert c.run_until(lambda: c.master() is not None), "no master elected"
+    yield c
+    for n in c.nodes.values():
+        if not n.coordinator.stopped:
+            n.stop()
+
+
+def test_replicated_index_and_search(cluster):
+    c = cluster
+    c.any_node().client_create_index(
+        "docs", settings={"index.number_of_shards": 2, "index.number_of_replicas": 1},
+        mappings={"properties": {"title": {"type": "text"}, "n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("docs")), "shards did not start"
+
+    # write through a non-master node; replicate synchronously
+    writer = c.any_node()
+    for i in range(20):
+        r = c.call(writer.client_write, "docs",
+                   {"type": "index", "id": str(i),
+                    "source": {"title": f"doc number {i}", "n": i}})
+        assert r["result"] == "created", r
+
+    # every copy holds its shard's docs: primary count == replica count
+    counts = {}
+    for nid, node in c.nodes.items():
+        for key, shard in node.local_shards.items():
+            counts.setdefault((key, shard.routing.primary), 0)
+            counts[(key, shard.routing.primary)] += shard.engine.doc_count()
+    for (key, _), cnt in counts.items():
+        primary_cnt = counts.get((key, True))
+        assert cnt == primary_cnt, f"replica of {key} diverged: {counts}"
+
+    for node in c.nodes.values():
+        node.refresh_all()
+
+    # distributed search through any node
+    resp = c.call(c.any_node().client_search, "docs",
+                  {"query": {"match": {"title": "doc"}}, "size": 25,
+                   "sort": [{"n": "asc"}]})
+    assert resp["hits"]["total"]["value"] == 20
+    assert [h["_source"]["n"] for h in resp["hits"]["hits"]] == list(range(20))
+    assert resp["_shards"]["failed"] == 0
+
+    # realtime get routed to the primary
+    got = c.call(c.any_node().client_get, "docs", "13")
+    assert got["found"] and got["_source"]["n"] == 13
+
+
+def test_primary_failover_preserves_data(cluster):
+    c = cluster
+    c.any_node().client_create_index(
+        "ha", settings={"index.number_of_shards": 1, "index.number_of_replicas": 1},
+        mappings={"properties": {"v": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("ha"))
+
+    for i in range(10):
+        c.call(c.any_node().client_write, "ha",
+               {"type": "index", "id": str(i), "source": {"v": i}})
+
+    state = c.any_node().cluster_state
+    primary = state.primary_of("ha", 0)
+    victim = primary.node_id
+    # kill the node holding the primary
+    c.transport.blackhole(victim)
+    c.nodes[victim].stop()
+
+    def promoted():
+        # every LIVE node must see the post-failover primary, else a client
+        # on a stale node would still route to the dead one
+        for nid, n in c.nodes.items():
+            if nid == victim or n.coordinator.stopped:
+                continue
+            p = n.cluster_state.primary_of("ha", 0)
+            if p is None or not p.node_id or p.node_id == victim:
+                return False
+        return True
+
+    assert c.run_until(promoted, max_ms=240_000), "no failover promotion"
+
+    survivor = c.any_node(exclude={victim})
+    # all 10 docs survive on the promoted replica
+    for i in range(10):
+        got = c.call(survivor.client_get, "ha", str(i))
+        assert got["found"], f"doc {i} lost in failover"
+    # and writes continue on the new primary
+    r = c.call(survivor.client_write, "ha",
+               {"type": "index", "id": "99", "source": {"v": 99}})
+    assert r["result"] == "created"
+
+    # replica gets re-allocated on the remaining third node and recovers
+    def green_again():
+        shards = survivor.cluster_state.shards_of("ha")
+        started = [s for s in shards if s.state == ShardRoutingEntry.STARTED
+                   and s.node_id != victim]
+        return len(started) >= 2
+
+    assert c.run_until(green_again, max_ms=240_000), "replica not re-established"
+    # the recovered replica holds all 11 docs
+    for nid, n in c.nodes.items():
+        if nid == victim or n.coordinator.stopped:
+            continue
+        for key, shard in n.local_shards.items():
+            if key == ("ha", 0) and not shard.routing.primary:
+                assert shard.engine.doc_count() == 11, \
+                    f"recovered replica has {shard.engine.doc_count()} docs"
+
+
+def test_write_through_any_node_routes_to_primary(cluster):
+    c = cluster
+    c.any_node().client_create_index(
+        "routed", settings={"index.number_of_shards": 3, "index.number_of_replicas": 0})
+    assert c.run_until(lambda: c.all_started("routed"))
+    for i in range(30):
+        writer = list(c.nodes.values())[i % 3]
+        r = c.call(writer.client_write, "routed",
+                   {"type": "index", "id": f"k{i}", "source": {"i": i}})
+        assert r["result"] == "created"
+    total = sum(s.engine.doc_count()
+                for n in c.nodes.values() for s in n.local_shards.values())
+    assert total == 30
+    # shard counts are balanced-ish across the 3 nodes (each has exactly 1 shard)
+    per_node = {nid: len(n.local_shards) for nid, n in c.nodes.items()}
+    assert all(v == 1 for v in per_node.values()), per_node
+
+
+def test_delete_index_cleans_up(cluster):
+    c = cluster
+    c.any_node().client_create_index("temp", settings={"index.number_of_shards": 1})
+    assert c.run_until(lambda: c.all_started("temp"))
+    c.any_node().client_delete_index("temp")
+    assert c.run_until(lambda: all(
+        ("temp", 0) not in n.local_shards for n in c.nodes.values()))
+    assert "temp" not in c.any_node().cluster_state.metadata
+
+
+def test_total_copy_loss_goes_red_not_empty(tmp_path):
+    """Losing every copy of a shard must leave it red/unassigned — never
+    fabricate a fresh empty primary (silent data loss). Needs 5 nodes so the
+    master quorum survives losing both copy holders."""
+    c = TestCluster(tmp_path, n_nodes=5, seed=23)
+    assert c.run_until(lambda: c.master() is not None)
+    c.any_node().client_create_index(
+        "red", settings={"index.number_of_shards": 1, "index.number_of_replicas": 1})
+    assert c.run_until(lambda: c.all_started("red"))
+    for i in range(5):
+        c.call(c.any_node().client_write, "red",
+               {"type": "index", "id": str(i), "source": {"v": i}})
+    state = c.any_node().cluster_state
+    holders = {r.node_id for r in state.shards_of("red") if r.node_id}
+    assert len(holders) == 2
+    for nid in holders:
+        c.transport.blackhole(nid)
+        c.nodes[nid].stop()
+    survivor = c.any_node(exclude=holders)
+
+    def holders_removed():
+        return all(h not in c.any_node(exclude=holders).cluster_state.nodes
+                   for h in holders)
+
+    assert c.run_until(holders_removed, max_ms=240_000), "dead nodes not removed"
+    c.queue.run_for(60_000)
+    shards = survivor.cluster_state.shards_of("red")
+    primaries = [r for r in shards if r.primary]
+    assert primaries, "primary entry disappeared"
+    for p in primaries:
+        assert p.state == ShardRoutingEntry.UNASSIGNED, \
+            f"red shard was silently re-allocated: {p.to_dict()}"
+    resp = c.call(survivor.client_search, "red", {"query": {"match_all": {}}})
+    assert resp["_shards"]["failed"] >= 1
+    assert resp["hits"]["total"]["value"] == 0
+    for n in c.nodes.values():
+        if not n.coordinator.stopped:
+            n.stop()
