@@ -1,19 +1,27 @@
-"""North-star benchmark: exact cosine kNN on a SIFT-1M-shaped corpus.
+"""North-star benchmark: kNN QPS @ recall@10 >= 0.95 on a SIFT-1M-shaped corpus.
 
-Measures the TPU batched matmul + top-k path (BASELINE.md config 1:
-SIFT-1M-like, 128-d, cosine, single shard/chip) against a model of the
-reference's execution: a per-document scripted scoring loop
-(`ScoreScriptUtils.cosineSimilarity` invoked per doc per query from the
-Lucene collector, `QueryPhase.java:171`), emulated here as a per-doc numpy
-dot loop over a subsample and extrapolated. Recall@10 is computed against
-exact f32 search (ours is exact brute force, so recall measures only bf16
-rounding, and must stay >= 0.95 to count — same gate as BASELINE).
+Measures the TPU device path (BASELINE.md config 1: SIFT-1M-like, 128-d,
+cosine, single chip): the binned-reduction Pallas kernel
+(`ops/pallas_knn_binned.py` — matmul + in-VMEM bin-max, one small top-k)
+driven through the one-dispatch multi-batch harness (this environment adds a
+~68 ms tunnel round-trip per dispatch, so batches are scanned inside a
+single compiled program, as a production search node would batch concurrent
+queries).
+
+Baseline model: the reference's execution is a per-document scripted scoring
+loop (`ScoreScriptUtils.cosineSimilarity` per doc per query from the Lucene
+collector, `QueryPhase.java:171`), emulated as a per-doc numpy dot loop over
+a subsample and extrapolated to the full corpus.
+
+Recall@10 is measured against the exact f32 result and gates the metric
+(same recall >= 0.95 gate as BASELINE).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -28,13 +36,14 @@ def main():
 
     from elasticsearch_tpu.ops import knn as knn_ops
     from elasticsearch_tpu.ops import similarity as sim
+    from elasticsearch_tpu.ops.pallas_knn_binned import binned_knn_search
 
     small = os.environ.get("BENCH_SMALL") == "1"
-    n = 100_000 if small else 1_000_000
+    n = 131_072 if small else 1_000_000
     d = 128
     k = 10
     batch = 128
-    n_batches = 4 if small else 8
+    n_batches = 4 if small else 20
     n_queries = batch * n_batches
 
     rng = np.random.default_rng(1234)
@@ -46,38 +55,49 @@ def main():
     queries = vectors[q_assign] + 0.3 * rng.standard_normal((n_queries, d)).astype(np.float32)
 
     corpus = knn_ops.build_corpus(vectors, metric=sim.COSINE, dtype="bf16")
-    qdev = jnp.asarray(queries)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    qstack = jnp.asarray(queries.reshape(n_batches, batch, d))
     jax.block_until_ready(corpus)
 
-    def search(qb):
-        return knn_ops.knn_search(qb, corpus, k=k, metric=sim.COSINE, precision="bf16")
+    if on_tpu:
+        @functools.partial(jax.jit, static_argnames=("kk",))
+        def search_all(qs, c, kk):
+            def body(carry, qb):
+                return carry, binned_knn_search(qb, c, kk)
+            _, out = jax.lax.scan(body, None, qs)
+            return out
+    else:
+        @functools.partial(jax.jit, static_argnames=("kk",))
+        def search_all(qs, c, kk):
+            def body(carry, qb):
+                return carry, knn_ops.knn_search(qb, c, kk, metric=sim.COSINE)
+            _, out = jax.lax.scan(body, None, qs)
+            return out
 
     # warmup/compile
-    s, i = search(qdev[:batch])
-    jax.block_until_ready((s, i))
+    out = search_all(qstack, corpus, k)
+    np.asarray(out[1])
 
-    # timed: per-batch latencies
-    lat = []
-    all_ids = []
-    for b in range(n_batches):
-        qb = qdev[b * batch:(b + 1) * batch]
+    # timed runs: whole stack in one dispatch; report amortized throughput
+    # and the single-dispatch wall time
+    runs = []
+    for _ in range(3 if not small else 2):
         t0 = time.perf_counter()
-        s, ids = search(qb)
-        jax.block_until_ready(ids)
-        lat.append(time.perf_counter() - t0)
-        all_ids.append(np.asarray(ids))
-    total_time = sum(lat)
+        out = search_all(qstack, corpus, k)
+        all_ids = np.asarray(out[1])
+        runs.append(time.perf_counter() - t0)
+    total_time = float(np.median(runs))
     qps = n_queries / total_time
-    p50_ms = float(np.median(lat) * 1000.0)
+    batch_ms = total_time / n_batches * 1000.0
 
-    # recall@10 of the bf16 path vs exact f32 (one batch)
-    s_ref, ids_ref = knn_ops.knn_search(qdev[:batch], corpus, k=k,
+    # recall@10 of the fast path vs exact f32 (first batch)
+    s_ref, ids_ref = knn_ops.knn_search(qstack[0], corpus, k=k,
                                         metric=sim.COSINE, precision="f32")
     ids_ref = np.asarray(ids_ref)
     hits = sum(len(set(all_ids[0][r]) & set(ids_ref[r])) for r in range(batch))
     recall = hits / (batch * k)
 
-    # baseline: per-doc scripted loop emulation (reference's per-doc
+    # baseline: per-doc scripted loop emulation (the reference's per-doc
     # CosineSimilarity call), measured on a subsample and scaled to n docs
     sub = 20_000
     subv = vectors[:sub]
@@ -94,15 +114,16 @@ def main():
     baseline_qps = 1.0 / (t_loop * (n / sub))
 
     out = {
-        "metric": "exact_knn_qps_sift1m_cosine",
+        "metric": "knn_qps_sift1m_cosine_recall_gated",
         "value": round(qps, 2),
         "unit": "qps",
         "vs_baseline": round(qps / baseline_qps, 1),
         "recall_at_10": round(recall, 4),
-        "p50_batch_ms": round(p50_ms, 2),
+        "amortized_batch_ms": round(batch_ms, 2),
         "batch_size": batch,
         "n_docs": n,
         "dims": d,
+        "kernel": "pallas_binned" if on_tpu else "xla_exact",
         "baseline_qps_scripted_loop": round(baseline_qps, 4),
         "device": str(jax.devices()[0]),
     }

@@ -201,13 +201,14 @@ class ClusterNode:
         my_entries = {(r.index, r.shard): r for r in state.routing
                       if r.node_id == self.node_id}
 
-        # remove shards no longer assigned here
+        # remove shards no longer assigned here — including copies reassigned
+        # to this node under a NEW allocation_id: the stale engine must go so
+        # the create loop below builds the new copy and runs its recovery
         for key in list(self.local_shards):
             mine = my_entries.get(key)
             if mine is None or mine.allocation_id != self.local_shards[key].routing.allocation_id:
-                if mine is None:
-                    shard = self.local_shards.pop(key)
-                    shard.engine.close()
+                shard = self.local_shards.pop(key)
+                shard.engine.close()
 
         # create / update assigned shards
         for key, entry in my_entries.items():
@@ -282,7 +283,7 @@ class ClusterNode:
         local = self.local_shards.get(key)
         if local is None or not local.routing.primary:
             raise SearchEngineError(f"not primary for {key}")
-        ops = local.engine.translog.read_ops(0)
+        ops = local.engine.translog.read_ops(int(request.get("from_seq_no", 0)))
         local.tracker.init_tracking(request["allocation_id"])
         local.tracker.mark_in_sync(request["allocation_id"],
                                    local.engine.local_checkpoint)
@@ -328,8 +329,14 @@ class ClusterNode:
                                               local.engine.local_checkpoint)
 
         state = self.cluster_state
+        # fan out to every ASSIGNED copy, INITIALIZING included — a copy mid-
+        # recovery must see concurrent ops or they are silently lost when it
+        # is later promoted (reference: ReplicationOperation replicates to
+        # the tracked set, not just started copies; replica engines dedup by
+        # seq_no so recovery-replay overlap is safe)
         replicas = [r for r in state.replicas_of(*key)
-                    if r.state == ShardRoutingEntry.STARTED and r.node_id]
+                    if r.state in (ShardRoutingEntry.STARTED,
+                                   ShardRoutingEntry.INITIALIZING) and r.node_id]
         response = {"_index": request["index"], "_shard": request["shard"],
                     "_id": op["id"], "_seq_no": result.seq_no,
                     "_primary_term": result.primary_term,
@@ -424,7 +431,6 @@ class ClusterNode:
         def finish():
             merged = self._merge_shard_results(results, body, num_shards)
             merged["_shards"]["failed"] += unsearchable
-            merged["_shards"]["successful"] -= 0
             on_done(merged)
 
         for i, entry in enumerate(targets):

@@ -55,6 +55,20 @@ def pad_rows(n: int, multiple: int = LANE) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def preferred_pad_multiple(n: int, metric: str = sim.COSINE) -> int:
+    """Pad large dot-metric corpora to the binned kernel's tile size on TPU
+    backends so the fast path stays eligible; everywhere the fast path can't
+    trigger (CPU, l2), keep minimal lane padding — no wasted HBM/FLOPs."""
+    if n < 8192 or metric == sim.L2_NORM:
+        return LANE
+    try:
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return LANE
+    except Exception:
+        return LANE
+    return 8192
+
+
 def build_corpus(
     vectors: np.ndarray,
     metric: str = sim.COSINE,
@@ -70,7 +84,7 @@ def build_corpus(
     """
     vectors = np.asarray(vectors, dtype=np.float32)
     n, d = vectors.shape
-    n_pad = pad_to if pad_to is not None else pad_rows(max(n, 1))
+    n_pad = pad_to if pad_to is not None else pad_rows(max(n, 1), preferred_pad_multiple(n, metric))
     if n_pad < n:
         raise ValueError(f"pad_to {n_pad} < corpus size {n}")
 
@@ -116,6 +130,39 @@ def _prep_queries(queries, metric: str):
         qn = jnp.linalg.norm(queries, axis=-1, keepdims=True)
         queries = queries / jnp.maximum(qn, 1e-30)
     return queries
+
+
+def knn_search_auto(
+    queries: jax.Array,
+    corpus: Corpus,
+    k: int,
+    metric: str = sim.COSINE,
+    filter_mask: Optional[jax.Array] = None,
+    precision: str = "bf16",
+):
+    """Route to the fastest eligible kernel.
+
+    Preference order:
+      1. binned Pallas kernel (TPU, dot-like metric, no filter, tiled
+         corpus, k within candidate budget) — ~7x the exact path at
+         recall ≈ 1.0 for 1M-doc corpora (pallas_knn_binned.py);
+      2. exact XLA matmul + lax.top_k (all metrics, filters, any backend).
+    """
+    from elasticsearch_tpu.ops import pallas_knn_binned as binned
+
+    n_pad = corpus.matrix.shape[0]
+    if (filter_mask is None
+            and metric in (sim.COSINE, sim.DOT_PRODUCT, sim.MAX_INNER_PRODUCT)
+            and n_pad % binned.BLOCK_N == 0
+            and k <= 64
+            and precision == "bf16"):
+        try:
+            if jax.devices()[0].platform in ("tpu", "axon"):
+                return binned.binned_knn_search(queries, corpus, k, metric=metric)
+        except Exception:
+            pass
+    return knn_search(queries, corpus, k, metric=metric, filter_mask=filter_mask,
+                      precision=precision)
 
 
 @functools.partial(

@@ -1,0 +1,124 @@
+"""Binned-reduction Pallas kNN: the peak-throughput path.
+
+The TPU-KNN recipe (Chern et al., "TPU-KNN: K Nearest Neighbor Search at
+Peak FLOP/s", 2022 — PAPERS.md pattern): instead of exact top-k inside the
+scan, keep only the max of every BIN_SIZE-column bin — one packed VPU
+reduction per tile, fully fused behind the MXU matmul in VMEM — then one
+small `lax.top_k` over the [Q, n_bins] candidates. A bin can hold at most
+one of the true top-k, so recall@k ≈ 1 - C(k,2)/n_bins (≈0.997 for k=10,
+2048 bins over 1M docs); BASELINE's gate is recall@10 ≥ 0.95.
+
+Score+index travel together through the reduction by packing the bin-local
+column index into the low mantissa bits of the (positively-shifted) f32
+score — max over the packed int32 is simultaneously argmax.
+
+Grid: one step per corpus tile of BLOCK_N rows; each step writes its
+(Q, BINS_PER_TILE) packed maxima to its own output column block, so there is
+no cross-step carry at all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.ops.knn import Corpus, _prep_queries
+
+BLOCK_N = 8192
+BIN_SIZE = 64
+BINS_PER_TILE = BLOCK_N // BIN_SIZE   # 128 — one aligned lane tile
+IDX_BITS = 6                          # log2(BIN_SIZE)
+# cosine scores live in [-1, 1]; dot products are clamped into this window
+SHIFT = 4.0
+CLAMP = 3.0
+
+
+def _kernel(nvalid_ref, q_ref, c_ref, out_ref):
+    """Bins are STRIDED (column j belongs to bin j % 128): the per-bin max
+    then reduces as 64 elementwise maxes of contiguous lane-aligned [Q, 128]
+    chunks — Mosaic cannot lane-split reshapes, but elementwise max of
+    aligned slices is native VPU."""
+    i = pl.program_id(0)
+    q = q_ref[:]
+    c = c_ref[:]
+    scores = jax.lax.dot_general(
+        q, c, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    nq = scores.shape[0]
+    col_global = i * BLOCK_N + jax.lax.broadcasted_iota(jnp.int32, (nq, BLOCK_N), 1)
+    valid = col_global < nvalid_ref[0]
+    # shift positive so IEEE ordering == integer ordering; invalid cols -> 0
+    s = jnp.where(valid, jnp.clip(scores, -CLAMP, CLAMP) + SHIFT, 0.0)
+    p = jax.lax.bitcast_convert_type(s, jnp.int32)
+    mask = jnp.int32(~((1 << IDX_BITS) - 1))
+
+    def chunk(t):
+        # static slice (python unroll): dynamic_slice on values is not
+        # lowerable in Mosaic
+        piece = p[:, t * BINS_PER_TILE:(t + 1) * BINS_PER_TILE]
+        return (piece & mask) | t
+
+    acc = chunk(0)
+    for t in range(1, BIN_SIZE):
+        acc = jnp.maximum(acc, chunk(t))
+    out_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "interpret"))
+def binned_knn_search(
+    queries: jax.Array,
+    corpus: Corpus,
+    k: int,
+    metric: str = sim.COSINE,
+    interpret: bool = False,
+):
+    """Approximate (recall ≈ 1 - C(k,2)·BIN_SIZE/N) top-k.
+
+    Supports dot-metric corpora (cosine pre-normalized / dot_product);
+    callers route l2 / filtered / tiny corpora to the exact XLA path.
+    Returns (raw_scores [Q, k], ids [Q, k]).
+    """
+    n_pad, d = corpus.matrix.shape
+    if n_pad % BLOCK_N != 0:
+        raise ValueError(f"corpus rows {n_pad} not divisible by {BLOCK_N}")
+    q = _prep_queries(queries, metric)
+    nq = q.shape[0]
+    mat = corpus.matrix
+    if mat.dtype == jnp.int8:
+        mat = mat.astype(jnp.bfloat16) * corpus.scales[:, None].astype(jnp.bfloat16)
+    qb = q.astype(jnp.bfloat16)
+    mb = mat.astype(jnp.bfloat16)
+
+    n_tiles = n_pad // BLOCK_N
+    packed = pl.pallas_call(
+        _kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((nq, d), lambda i: (0, 0)),
+            pl.BlockSpec((BLOCK_N, d), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((nq, BINS_PER_TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((nq, n_tiles * BINS_PER_TILE), jnp.int32),
+        interpret=interpret,
+    )(jnp.asarray([corpus.num_valid], dtype=jnp.int32).reshape(1), qb, mb)
+
+    # column layout: global id = tile_base + t*BINS_PER_TILE + bin_lane,
+    # where t is the packed chunk index and bin_lane the output column
+    # within its tile
+    ncols = packed.shape[1]
+    cols = jnp.arange(ncols, dtype=jnp.int32)[None, :]
+    tile_base = (cols // BINS_PER_TILE) * BLOCK_N
+    bin_lane = cols % BINS_PER_TILE
+    t = packed & ((1 << IDX_BITS) - 1)
+    cand_s = jax.lax.bitcast_convert_type(
+        packed & jnp.int32(~((1 << IDX_BITS) - 1)), jnp.float32) - SHIFT
+    cand_i = tile_base + t * BINS_PER_TILE + bin_lane
+    vals, pos = jax.lax.top_k(cand_s, k)
+    return vals, jnp.take_along_axis(cand_i, pos, axis=1)

