@@ -141,20 +141,11 @@ class IndicesService:
         return os.path.join(self.data_path, name, "index_meta.json")
 
     def _load_existing(self) -> None:
-        import json
         if not os.path.isdir(self.data_path):
             return
         for name in sorted(os.listdir(self.data_path)):
-            meta_file = self._meta_path(name)
-            if os.path.exists(meta_file):
-                with open(meta_file) as f:
-                    meta = json.load(f)
-                svc = IndexService(name, os.path.join(self.data_path, name),
-                                   Settings(meta.get("settings", {})),
-                                   meta.get("mappings", {}),
-                                   meta.get("uuid", name))
-                svc.aliases = meta.get("aliases", {})
-                self.indices[name] = svc
+            if os.path.exists(self._meta_path(name)):
+                self.open_index(name)
 
     def _persist_meta(self, svc: IndexService) -> None:
         import json
@@ -166,6 +157,23 @@ class IndicesService:
                        "uuid": svc.uuid}, f)
 
     # -- CRUD -----------------------------------------------------------------
+    def open_index(self, name: str) -> IndexService:
+        """Open an index from an existing on-disk data directory (restore path)."""
+        import json
+        meta_file = self._meta_path(name)
+        if not os.path.exists(meta_file):
+            raise IndexNotFoundError(name)
+        if name in self.indices:
+            raise ResourceAlreadyExistsError(f"index [{name}] already open")
+        with open(meta_file) as f:
+            meta = json.load(f)
+        svc = IndexService(name, os.path.join(self.data_path, name),
+                           Settings(meta.get("settings", {})),
+                           meta.get("mappings", {}), meta.get("uuid", name))
+        svc.aliases = meta.get("aliases", {})
+        self.indices[name] = svc
+        return svc
+
     def create_index(self, name: str, settings: Optional[dict] = None,
                      mappings: Optional[dict] = None,
                      aliases: Optional[dict] = None) -> IndexService:

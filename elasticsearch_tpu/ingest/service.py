@@ -1,0 +1,523 @@
+"""Ingest pipelines: node-side document transforms before indexing.
+
+Re-design of `ingest/IngestService.java` + `modules/ingest-common/`
+(SURVEY.md §2.4): named pipelines of processors applied to documents on
+index/bulk when `?pipeline=` or the index's `default_pipeline` setting says
+so. Processor set covers the common core of ingest-common: set, remove,
+rename, lowercase/uppercase/trim, split/join, convert, gsub, append, date,
+drop, fail, script (painless-lite), dissect-lite, user_agent/geoip are
+stubbed as unavailable (external databases).
+
+Documents flow as a mutable ctx dict with `_source` plus metadata fields
+(`_index`, `_id`), the same shape Painless ingest scripts see.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError, ResourceNotFoundError, SearchEngineError,
+)
+from elasticsearch_tpu.index.mapping import parse_date_millis
+
+
+class DropDocument(Exception):
+    """Raised by the drop processor: the document is silently discarded."""
+
+
+class IngestProcessorError(SearchEngineError):
+    status = 400
+
+
+def _get_path(doc: dict, path: str):
+    node = doc
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _set_path(doc: dict, path: str, value) -> None:
+    parts = path.split(".")
+    node = doc
+    for p in parts[:-1]:
+        nxt = node.get(p)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            node[p] = nxt
+        node = nxt
+    node[parts[-1]] = value
+
+
+def _del_path(doc: dict, path: str) -> bool:
+    parts = path.split(".")
+    node = doc
+    for p in parts[:-1]:
+        node = node.get(p)
+        if not isinstance(node, dict):
+            return False
+    return node.pop(parts[-1], None) is not None
+
+
+def _render(template: Any, ctx: dict):
+    """Mustache-lite {{field}} substitution (reference: lang-mustache)."""
+    if not isinstance(template, str):
+        return template
+
+    def sub(m):
+        v = _get_path(ctx, m.group(1).strip())
+        return "" if v is None else str(v)
+
+    return re.sub(r"\{\{([^}]+)\}\}", sub, template)
+
+
+class Processor:
+    kind = "base"
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.field = spec.get("field")
+        self.ignore_missing = bool(spec.get("ignore_missing", False))
+        self.condition = spec.get("if")
+        self.on_failure = spec.get("on_failure")
+        self.ignore_failure = bool(spec.get("ignore_failure", False))
+        self.tag = spec.get("tag")
+
+    def should_run(self, ctx: dict) -> bool:
+        if self.condition is None:
+            return True
+        # condition is a painless-lite boolean over ctx
+        import ast
+
+        try:
+            tree = ast.parse(self.condition.replace("ctx.", "__ctx__."), mode="eval")
+        except SyntaxError:
+            raise IngestProcessorError(f"invalid [if] condition [{self.condition}]")
+
+        def ev(node):
+            if isinstance(node, ast.Expression):
+                return ev(node.body)
+            if isinstance(node, ast.Constant):
+                return node.value
+            if isinstance(node, ast.Attribute):
+                path = []
+                n = node
+                while isinstance(n, ast.Attribute):
+                    path.append(n.attr)
+                    n = n.value
+                if isinstance(n, ast.Name) and n.id == "__ctx__":
+                    return _get_path(ctx, ".".join(reversed(path)))
+                raise IngestProcessorError("condition may only access ctx.*")
+            if isinstance(node, ast.Compare):
+                left = ev(node.left)
+                right = ev(node.comparators[0])
+                ops = {ast.Eq: left == right, ast.NotEq: left != right}
+                import ast as _a
+                if isinstance(node.ops[0], (_a.Lt, _a.LtE, _a.Gt, _a.GtE)):
+                    try:
+                        return {_a.Lt: left < right, _a.LtE: left <= right,
+                                _a.Gt: left > right, _a.GtE: left >= right}[type(node.ops[0])]
+                    except TypeError:
+                        return False
+                return ops.get(type(node.ops[0]), False)
+            if isinstance(node, ast.BoolOp):
+                vals = [ev(v) for v in node.values]
+                return all(vals) if isinstance(node.op, ast.And) else any(vals)
+            if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+                return not ev(node.operand)
+            raise IngestProcessorError("unsupported condition construct")
+
+        return bool(ev(tree))
+
+    def run(self, ctx: dict) -> None:
+        raise NotImplementedError
+
+    def process(self, ctx: dict, pipeline_registry=None) -> None:
+        if not self.should_run(ctx):
+            return
+        self._registry = pipeline_registry
+        try:
+            self.run(ctx)
+        except DropDocument:
+            raise
+        except Exception as e:
+            if self.ignore_failure:
+                return
+            if self.on_failure:
+                for spec in self.on_failure:
+                    build_processor(spec).process(ctx, pipeline_registry)
+                return
+            raise
+
+
+class SetProcessor(Processor):
+    kind = "set"
+
+    def run(self, ctx):
+        if not self.spec.get("override", True) and _get_path(ctx, self.field) is not None:
+            return
+        _set_path(ctx, self.field, _render(self.spec.get("value"), ctx)
+                  if "value" in self.spec else _get_path(ctx, self.spec["copy_from"]))
+
+
+class RemoveProcessor(Processor):
+    kind = "remove"
+
+    def run(self, ctx):
+        fields = self.field if isinstance(self.field, list) else [self.field]
+        for f in fields:
+            if not _del_path(ctx, f) and not self.ignore_missing:
+                raise IngestProcessorError(f"field [{f}] not present")
+
+
+class RenameProcessor(Processor):
+    kind = "rename"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        _del_path(ctx, self.field)
+        _set_path(ctx, self.spec["target_field"], v)
+
+
+class _StringProcessor(Processor):
+    fn = staticmethod(lambda s: s)
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        target = self.spec.get("target_field", self.field)
+        if isinstance(v, list):
+            _set_path(ctx, target, [self.fn(str(x)) for x in v])
+        else:
+            _set_path(ctx, target, self.fn(str(v)))
+
+
+class LowercaseProcessor(_StringProcessor):
+    kind = "lowercase"
+    fn = staticmethod(str.lower)
+
+
+class UppercaseProcessor(_StringProcessor):
+    kind = "uppercase"
+    fn = staticmethod(str.upper)
+
+
+class TrimProcessor(_StringProcessor):
+    kind = "trim"
+    fn = staticmethod(str.strip)
+
+
+class SplitProcessor(Processor):
+    kind = "split"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        sep = self.spec.get("separator", ",")
+        _set_path(ctx, self.spec.get("target_field", self.field),
+                  re.split(sep, str(v)))
+
+
+class JoinProcessor(Processor):
+    kind = "join"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if not isinstance(v, list):
+            raise IngestProcessorError(f"field [{self.field}] is not a list")
+        _set_path(ctx, self.spec.get("target_field", self.field),
+                  self.spec.get("separator", ",").join(str(x) for x in v))
+
+
+class ConvertProcessor(Processor):
+    kind = "convert"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        t = self.spec.get("type")
+        try:
+            if t == "integer" or t == "long":
+                out = int(v)
+            elif t == "float" or t == "double":
+                out = float(v)
+            elif t == "boolean":
+                out = str(v).lower() in ("true", "1")
+            elif t == "string":
+                out = str(v)
+            elif t == "auto":
+                s = str(v)
+                try:
+                    out = int(s)
+                except ValueError:
+                    try:
+                        out = float(s)
+                    except ValueError:
+                        out = True if s.lower() == "true" else False if s.lower() == "false" else s
+            else:
+                raise IngestProcessorError(f"unknown convert type [{t}]")
+        except (TypeError, ValueError):
+            raise IngestProcessorError(f"cannot convert [{v}] to [{t}]")
+        _set_path(ctx, self.spec.get("target_field", self.field), out)
+
+
+class GsubProcessor(Processor):
+    kind = "gsub"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        _set_path(ctx, self.spec.get("target_field", self.field),
+                  re.sub(self.spec["pattern"], self.spec["replacement"], str(v)))
+
+
+class AppendProcessor(Processor):
+    kind = "append"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        add = self.spec.get("value")
+        add = add if isinstance(add, list) else [add]
+        add = [_render(a, ctx) for a in add]
+        if v is None:
+            _set_path(ctx, self.field, add)
+        elif isinstance(v, list):
+            v.extend(add)
+        else:
+            _set_path(ctx, self.field, [v] + add)
+
+
+class DateProcessor(Processor):
+    kind = "date"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        millis = parse_date_millis(v)
+        import datetime as dt
+        iso = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc
+                                        ).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+        _set_path(ctx, self.spec.get("target_field", "@timestamp"), iso)
+
+
+class DropProcessor(Processor):
+    kind = "drop"
+
+    def run(self, ctx):
+        raise DropDocument()
+
+
+class FailProcessor(Processor):
+    kind = "fail"
+
+    def run(self, ctx):
+        raise IngestProcessorError(_render(self.spec.get("message", "fail processor"), ctx))
+
+
+class ScriptProcessor(Processor):
+    kind = "script"
+
+    def run(self, ctx):
+        from elasticsearch_tpu.node import _apply_update_script
+
+        if "source" in self.spec:
+            spec = self.spec          # {"source": ..., "params": ...}
+        else:
+            spec = self.spec.get("script") or self.spec
+        if isinstance(spec, str):
+            spec = {"source": spec}
+        src = spec.get("source", "")
+        # ingest scripts address ctx.field directly; reuse the update-script
+        # evaluator by mapping ctx.* -> ctx._source.*
+        rewritten = re.sub(r"\bctx\.(?!_source)", "ctx._source.", src)
+        _apply_update_script(ctx, {"source": rewritten,
+                                   "params": spec.get("params", {})})
+
+
+class DissectProcessor(Processor):
+    kind = "dissect"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] not present")
+        pattern = self.spec["pattern"]
+        # %{key} delimited extraction (reference: libs/dissect). Keys may be
+        # dotted / duplicated — regex group names can't, so use positional
+        # groups mapped back to keys.
+        keys = re.findall(r"%\{([^}]*)\}", pattern)
+        regex = re.escape(pattern)
+        for key in keys:
+            regex = regex.replace(re.escape("%{" + key + "}"),
+                                  "(.*?)" if key else "(?:.*?)", 1)
+        regex = "^" + regex + "$"
+        try:
+            m = re.match(regex, str(v))
+        except re.error as e:
+            raise IngestProcessorError(f"invalid dissect pattern [{pattern}]: {e}")
+        if m is None:
+            raise IngestProcessorError(
+                f"dissect pattern [{pattern}] does not match [{v}]")
+        named = [k for k in keys if k]
+        for key, value in zip(named, m.groups()):
+            if not key.startswith("?"):
+                _set_path(ctx, key, value)
+
+
+class PipelineProcessor(Processor):
+    kind = "pipeline"
+
+    def run(self, ctx):
+        # base process() handles if/ignore_failure/on_failure and stashes the
+        # registry on self._registry before calling run
+        registry = getattr(self, "_registry", None)
+        if registry is None:
+            raise IngestProcessorError("pipeline processor requires a registry")
+        registry.run(self.spec["name"], ctx)
+
+
+PROCESSORS = {p.kind: p for p in (
+    SetProcessor, RemoveProcessor, RenameProcessor, LowercaseProcessor,
+    UppercaseProcessor, TrimProcessor, SplitProcessor, JoinProcessor,
+    ConvertProcessor, GsubProcessor, AppendProcessor, DateProcessor,
+    DropProcessor, FailProcessor, ScriptProcessor, DissectProcessor,
+    PipelineProcessor,
+)}
+
+
+def build_processor(spec: dict) -> Processor:
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingError("each processor must be an object with one key")
+    ((kind, body),) = spec.items()
+    cls = PROCESSORS.get(kind)
+    if cls is None:
+        raise ParsingError(f"No processor type exists with name [{kind}]")
+    return cls(body or {})
+
+
+class Pipeline:
+    def __init__(self, pipeline_id: str, definition: dict):
+        self.pipeline_id = pipeline_id
+        self.description = definition.get("description", "")
+        self.definition = definition
+        self.processors = [build_processor(p) for p in definition.get("processors", [])]
+        self.on_failure = [build_processor(p) for p in definition.get("on_failure", [])]
+
+    def run(self, ctx: dict, registry=None) -> Optional[dict]:
+        """Returns the transformed ctx, or None if the document was dropped."""
+        try:
+            for p in self.processors:
+                p.process(ctx, registry)
+        except DropDocument:
+            return None
+        except Exception:
+            if self.on_failure:
+                for p in self.on_failure:
+                    p.process(ctx, registry)
+                return ctx
+            raise
+        return ctx
+
+
+class IngestService:
+    """Pipeline registry (reference: IngestService.java:712)."""
+
+    def __init__(self):
+        self.pipelines: Dict[str, Pipeline] = {}
+        import threading
+        self._running = threading.local()
+
+    def put_pipeline(self, pipeline_id: str, definition: dict) -> None:
+        self.pipelines[pipeline_id] = Pipeline(pipeline_id, definition)
+
+    def get_pipeline(self, pipeline_id: str) -> Pipeline:
+        p = self.pipelines.get(pipeline_id)
+        if p is None:
+            raise ResourceNotFoundError(f"pipeline [{pipeline_id}] is missing")
+        return p
+
+    def delete_pipeline(self, pipeline_id: str) -> None:
+        if pipeline_id not in self.pipelines:
+            raise ResourceNotFoundError(f"pipeline [{pipeline_id}] is missing")
+        del self.pipelines[pipeline_id]
+
+    def run(self, pipeline_id: str, ctx: dict) -> Optional[dict]:
+        stack = getattr(self._running, "stack", None)
+        if stack is None:
+            stack = self._running.stack = []
+        if pipeline_id in stack:
+            raise IngestProcessorError(
+                f"Cycle detected for pipeline: {pipeline_id} "
+                f"(execution chain: {' -> '.join(stack + [pipeline_id])})")
+        stack.append(pipeline_id)
+        try:
+            return self.get_pipeline(pipeline_id).run(ctx, self)
+        finally:
+            stack.pop()
+
+    def execute(self, pipeline_id: str, index: str, doc_id: Optional[str],
+                source: dict) -> Optional[dict]:
+        """Run a pipeline over one document source; returns the new source
+        or None when dropped.
+
+        Ingest ctx exposes source fields at TOP level (`ctx.field`) with
+        metadata beside them (`ctx._index`, `ctx._id`) — the shape Painless
+        ingest scripts see in the reference."""
+        import copy as _copy
+        # deep copy: engine.get hands out stored _source by reference; a
+        # shallow copy would let nested/append mutations corrupt the stored
+        # document of the SOURCE index (reindex-with-pipeline case)
+        ctx = _copy.deepcopy(source)
+        ctx["_index"] = index
+        ctx["_id"] = doc_id
+        out = self.run(pipeline_id, ctx)
+        if out is None:
+            return None
+        return {k: v for k, v in out.items() if k not in ("_index", "_id")}
+
+    def simulate(self, definition_or_id, docs: List[dict]) -> List[dict]:
+        """_ingest/pipeline/_simulate."""
+        if isinstance(definition_or_id, str):
+            pipeline = self.get_pipeline(definition_or_id)
+        else:
+            pipeline = Pipeline("_simulate", definition_or_id)
+        results = []
+        for doc in docs:
+            ctx = dict(doc.get("_source", {}))
+            ctx["_index"] = doc.get("_index", "_index")
+            ctx["_id"] = doc.get("_id", "_id")
+            try:
+                out = pipeline.run(ctx, self)
+                if out is None:
+                    results.append({"doc": None, "dropped": True})
+                else:
+                    results.append({"doc": {
+                        "_index": out.get("_index"), "_id": out.get("_id"),
+                        "_source": {k: v for k, v in out.items()
+                                    if k not in ("_index", "_id")}}})
+            except Exception as e:
+                results.append({"error": {"type": "ingest_processor_exception",
+                                          "reason": str(e)}})
+        return results

@@ -27,7 +27,10 @@ from elasticsearch_tpu.indices.service import (
 from elasticsearch_tpu.search.service import (
     execute_fetch_phase, execute_query_phase,
 )
+from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.version import __version__
+
+MAX_RESULT_WINDOW_SCROLL = 10_000
 
 
 class _MultiShardVectorStore:
@@ -76,10 +79,22 @@ class _MultiShardVectorStore:
 class Node:
     def __init__(self, data_path: str, node_name: str = "node-0",
                  cluster_name: str = "tpu-search"):
+        from elasticsearch_tpu.ingest.service import IngestService
+        from elasticsearch_tpu.node_admin import (
+            AsyncSearchService, ScrollService, TaskManager, TemplateService,
+        )
+
         self.node_id = _uuid.uuid4().hex[:20]
         self.node_name = node_name
         self.cluster_name = cluster_name
         self.indices = IndicesService(data_path)
+        self.ingest = IngestService()
+        self.scrolls = ScrollService()
+        self.async_search = AsyncSearchService()
+        self.tasks = TaskManager(self.node_id)
+        self.templates = TemplateService()
+        from elasticsearch_tpu.snapshots.service import SnapshotService
+        self.snapshots = SnapshotService(self)
         self.start_time = time.time()
 
     # ------------------------------------------------------------- documents
@@ -89,8 +104,17 @@ class Node:
                   if_seq_no: Optional[int] = None,
                   if_primary_term: Optional[int] = None,
                   version: Optional[int] = None,
-                  version_type: str = "internal") -> dict:
+                  version_type: str = "internal",
+                  pipeline: Optional[str] = None) -> dict:
         svc = self._index_or_autocreate(index)
+        if pipeline is None:
+            pipeline = svc.settings.get("index.default_pipeline")
+        if pipeline and pipeline != "_none":
+            body = self.ingest.execute(pipeline, svc.name, doc_id, body)
+            if body is None:  # dropped by the pipeline
+                return {"_index": svc.name, "_id": doc_id, "result": "noop",
+                        "_version": -1, "_seq_no": -1, "_primary_term": 0,
+                        "_shards": {"total": 0, "successful": 0, "failed": 0}}
         if doc_id is None:
             doc_id = _uuid.uuid4().hex[:20]
             op_type = "create"
@@ -233,9 +257,33 @@ class Node:
 
     def _index_or_autocreate(self, index: str) -> IndexService:
         if not self.indices.exists(index):
-            # auto-create with defaults (reference: TransportBulkAction auto-create)
-            return self.indices.create_index(index)
+            # auto-create applying matching templates (reference:
+            # TransportBulkAction auto-create + MetaDataIndexTemplateService)
+            resolved = self.templates.resolve(index)
+            return self.indices.create_index(
+                index, settings=resolved["settings"] or None,
+                mappings=resolved["mappings"] if resolved["mappings"]["properties"] else None,
+                aliases=resolved["aliases"] or None)
         return self.indices.get(index)
+
+    def create_index_with_templates(self, name: str, settings=None,
+                                    mappings=None, aliases=None) -> IndexService:
+        """Explicit create: template values apply under the request's own."""
+        resolved = self.templates.resolve(name)
+        merged_settings = dict(resolved["settings"])
+        if settings:
+            merged_settings.update(settings)
+        merged_mappings = {"properties": dict(resolved["mappings"]["properties"])}
+        for k, v in ((mappings or {}).get("properties") or {}).items():
+            merged_mappings["properties"][k] = v
+        if mappings and "dynamic" in mappings:
+            merged_mappings["dynamic"] = mappings["dynamic"]
+        merged_aliases = dict(resolved["aliases"])
+        merged_aliases.update(aliases or {})
+        return self.indices.create_index(
+            name, settings=merged_settings or None,
+            mappings=merged_mappings if merged_mappings["properties"] or mappings else mappings,
+            aliases=merged_aliases or None)
 
     @staticmethod
     def _maybe_refresh(svc: IndexService, refresh) -> None:
@@ -301,7 +349,88 @@ class Node:
         }
         if merged_aggs is not None:
             resp["aggregations"] = merged_aggs
+
+        suggest_spec = body.get("suggest")
+        if suggest_spec:
+            from elasticsearch_tpu.search.extras import execute_suggest
+            from elasticsearch_tpu.search.queries import SearchContext
+            merged_suggest: Dict[str, list] = {}
+            for svc, reader, _ in readers:
+                ctx = SearchContext(reader, svc.mapper_service)
+                for name, entries in execute_suggest(ctx, suggest_spec).items():
+                    if name not in merged_suggest:
+                        merged_suggest[name] = entries
+                    else:
+                        for a, b in zip(merged_suggest[name], entries):
+                            a["options"] = sorted(
+                                a["options"] + b["options"],
+                                key=lambda o: -o.get("score", o.get("_score", 0.0)))
+            resp["suggest"] = merged_suggest
         return resp
+
+    # ----------------------------------------------------------------- scroll
+    def search_scroll_start(self, index_expr: Optional[str], body: Optional[dict],
+                            keep_alive: str = "1m") -> dict:
+        """Initial search with ?scroll=: snapshot all matching docs in order,
+        return the first page + a scroll id."""
+        body = dict(body or {})
+        size = int(body.get("size", 10) if body.get("size") is not None else 10)
+        entries = []  # (svc, reader, row, score, sort_values)
+        total = 0
+        for svc in self.indices.resolve(index_expr):
+            reader = svc.combined_reader()
+            store = _MultiShardVectorStore(svc)
+            # scroll snapshots EVERY matching doc — deep pagination past the
+            # 10k window is the point of scrolling
+            big = dict(body)
+            big["size"] = max(reader.num_docs, 1)
+            big["__unbounded_window__"] = True
+            big["track_total_hits"] = True
+            big.pop("from", None)
+            result = execute_query_phase(reader, svc.mapper_service, big,
+                                         vector_store=store)
+            total += result.total_hits
+            for i, row in enumerate(result.rows):
+                sv = result.sort_values[i] if result.sort_values is not None else None
+                entries.append((svc, reader, int(row), float(result.scores[i]), sv))
+        if body.get("sort"):
+            entries.sort(key=lambda t: _sort_key_tuple(t[4], body))
+        else:
+            entries.sort(key=lambda t: -t[3])
+        keep_s = parse_time_value(keep_alive, "scroll")
+        scroll_id = self.scrolls.create(entries, body, keep_s)
+        sc = self.scrolls.get(scroll_id)
+        sc.total = total
+        resp = self._scroll_page(sc, size)
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def search_scroll_next(self, scroll_id: str,
+                           keep_alive: Optional[str] = None) -> dict:
+        sc = self.scrolls.get(scroll_id)
+        if keep_alive:
+            sc.keep_alive = parse_time_value(keep_alive, "scroll")
+        size = int(sc.body.get("size", 10) if sc.body.get("size") is not None else 10)
+        resp = self._scroll_page(sc, size)
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def _scroll_page(self, sc, size: int) -> dict:
+        page = sc.slices[sc.cursor: sc.cursor + size]
+        sc.cursor += len(page)
+        hits = []
+        for svc, reader, row, score, sv in page:
+            hit = {"_index": svc.name, "_id": reader.get_id(row),
+                   "_score": score if not sc.body.get("sort") else None,
+                   "_source": reader.get_source(row)}
+            if sv is not None:
+                hit["sort"] = list(sv)
+            hits.append(hit)
+        total = getattr(sc, "total", len(sc.slices))
+        return {"took": 0, "timed_out": False,
+                "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+                "hits": {"total": {"value": total, "relation": "eq"},
+                         "max_score": None, "hits": hits}}
 
     def count(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
         body = dict(body or {})

@@ -1,0 +1,462 @@
+"""Node feature services: scroll, async-search, tasks, templates, reindex
+family, field caps, validate, explain.
+
+Kept beside `node.py` (the document/search facade) the way the reference
+splits TransportActions by package: scroll contexts (`SearchService` scroll
+keepalives), async-search (`x-pack/async-search`), task manager
+(`tasks/TaskManager.java:63`), index templates
+(`MetaDataIndexTemplateService`), reindex/update-by-query/delete-by-query
+(`modules/reindex`), field caps, query validation and explain.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+import threading
+import time
+import uuid as _uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError, ResourceNotFoundError, SearchEngineError,
+)
+from elasticsearch_tpu.common.settings import parse_time_value
+
+
+class ScrollContext:
+    __slots__ = ("scroll_id", "slices", "cursor", "body", "expiry",
+                 "keep_alive", "total")
+
+    def __init__(self, scroll_id, slices, body, keep_alive_s):
+        self.scroll_id = scroll_id
+        # slices: list of (svc, reader, rows, scores, sort_values)
+        self.slices = slices
+        self.cursor = 0
+        self.body = body
+        self.keep_alive = keep_alive_s
+        self.expiry = time.time() + keep_alive_s
+        self.total = len(slices)
+
+
+class ScrollService:
+    """Scroll cursors over point-in-time readers (reference:
+    SearchService scroll contexts + SearchScrollAsyncAction)."""
+
+    def __init__(self):
+        self._contexts: Dict[str, ScrollContext] = {}
+
+    def create(self, slices, body, keep_alive_s: float) -> str:
+        scroll_id = _uuid.uuid4().hex
+        self._contexts[scroll_id] = ScrollContext(scroll_id, slices, body, keep_alive_s)
+        return scroll_id
+
+    def get(self, scroll_id: str) -> ScrollContext:
+        self.evict_expired()
+        sc = self._contexts.get(scroll_id)
+        if sc is None:
+            raise ResourceNotFoundError(f"No search context found for id [{scroll_id}]",
+                                        scroll_id=scroll_id)
+        sc.expiry = time.time() + sc.keep_alive
+        return sc
+
+    def delete(self, scroll_id: str) -> bool:
+        return self._contexts.pop(scroll_id, None) is not None
+
+    def delete_all(self) -> int:
+        n = len(self._contexts)
+        self._contexts.clear()
+        return n
+
+    def evict_expired(self) -> None:
+        now = time.time()
+        for sid in [s for s, c in self._contexts.items() if c.expiry < now]:
+            del self._contexts[sid]
+
+
+class AsyncSearchService:
+    """x-pack async-search shape: submit returns an id immediately; results
+    are retrievable until deleted/expired. Executes on a worker thread."""
+
+    def __init__(self):
+        self._results: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, run: Callable[[], dict],
+               wait_for_completion_s: float = 1.0,
+               keep_alive_s: float = 300.0) -> dict:
+        search_id = _uuid.uuid4().hex
+        entry = {"id": search_id, "is_running": True, "is_partial": True,
+                 "start_time_in_millis": int(time.time() * 1000),
+                 "expiration_time_in_millis": int((time.time() + keep_alive_s) * 1000),
+                 "response": None, "error": None}
+        with self._lock:
+            self._results[search_id] = entry
+
+        done = threading.Event()
+
+        def work():
+            try:
+                resp = run()
+                with self._lock:
+                    entry["response"] = resp
+            except SearchEngineError as e:
+                with self._lock:
+                    entry["error"] = e.to_dict()
+            except Exception as e:  # never lose the terminal state
+                with self._lock:
+                    entry["error"] = {"type": "exception", "reason": str(e)}
+            finally:
+                with self._lock:
+                    entry["is_running"] = False
+                    entry["is_partial"] = False
+                done.set()
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        done.wait(timeout=wait_for_completion_s)
+        return self.status(search_id)
+
+    def _evict_expired(self) -> None:
+        now_ms = time.time() * 1000
+        for sid in [s for s, e in self._results.items()
+                    if not e["is_running"] and e["expiration_time_in_millis"] < now_ms]:
+            del self._results[sid]
+
+    def status(self, search_id: str) -> dict:
+        with self._lock:
+            self._evict_expired()
+            entry = self._results.get(search_id)
+            if entry is None:
+                raise ResourceNotFoundError(f"async search [{search_id}] not found")
+            out = {"id": search_id, "is_running": entry["is_running"],
+                   "is_partial": entry["is_partial"],
+                   "start_time_in_millis": entry["start_time_in_millis"],
+                   "expiration_time_in_millis": entry["expiration_time_in_millis"]}
+            if entry["response"] is not None:
+                out["response"] = entry["response"]
+            if entry["error"] is not None:
+                out["error"] = entry["error"]
+            return out
+
+    def delete(self, search_id: str) -> bool:
+        with self._lock:
+            return self._results.pop(search_id, None) is not None
+
+
+class Task:
+    __slots__ = ("task_id", "action", "description", "start_ms", "cancellable",
+                 "cancelled", "status")
+
+    def __init__(self, task_id, action, description, cancellable=True):
+        self.task_id = task_id
+        self.action = action
+        self.description = description
+        self.start_ms = int(time.time() * 1000)
+        self.cancellable = cancellable
+        self.cancelled = False
+        self.status: dict = {}
+
+    def to_dict(self, node_id: str) -> dict:
+        return {"node": node_id, "id": int(self.task_id.split(":")[1]),
+                "type": "transport", "action": self.action,
+                "description": self.description,
+                "start_time_in_millis": self.start_ms,
+                "running_time_in_nanos": int(
+                    (time.time() * 1000 - self.start_ms) * 1e6),
+                "cancellable": self.cancellable,
+                "cancelled": self.cancelled,
+                "status": self.status or None}
+
+
+class TaskManager:
+    """Per-node task registry with cancellation (TaskManager.java:63)."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._counter = 0
+        self._tasks: Dict[str, Task] = {}
+        self._lock = threading.Lock()
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = True) -> Task:
+        with self._lock:
+            self._counter += 1
+            task = Task(f"{self.node_id}:{self._counter}", action, description,
+                        cancellable)
+            self._tasks[task.task_id] = task
+            return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.task_id, None)
+
+    def list_tasks(self, actions: Optional[str] = None) -> List[Task]:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            patterns = actions.split(",")
+            tasks = [t for t in tasks
+                     if any(fnmatch.fnmatch(t.action, p) for p in patterns)]
+        return tasks
+
+    def get(self, task_id: str) -> Task:
+        with self._lock:
+            t = self._tasks.get(task_id)
+        if t is None:
+            raise ResourceNotFoundError(f"task [{task_id}] isn't running and hasn't stored its results")
+        return t
+
+    def cancel(self, task_id: str) -> Task:
+        t = self.get(task_id)
+        if not t.cancellable:
+            raise IllegalArgumentError(f"task [{task_id}] is not cancellable")
+        t.cancelled = True
+        return t
+
+
+class TemplateService:
+    """Index templates (legacy `_template` + composable `_index_template`):
+    matched by index_patterns at index auto-creation, merged by priority."""
+
+    def __init__(self):
+        self.templates: Dict[str, dict] = {}          # legacy
+        self.index_templates: Dict[str, dict] = {}    # composable
+
+    def put(self, name: str, body: dict, composable: bool = False) -> None:
+        store = self.index_templates if composable else self.templates
+        patterns = body.get("index_patterns")
+        if not patterns:
+            raise IllegalArgumentError("index template must define index_patterns")
+        store[name] = body
+
+    def get(self, name: str, composable: bool = False) -> dict:
+        store = self.index_templates if composable else self.templates
+        if name not in store:
+            raise ResourceNotFoundError(f"index template matching [{name}] not found")
+        return store[name]
+
+    def delete(self, name: str, composable: bool = False) -> None:
+        store = self.index_templates if composable else self.templates
+        if name not in store:
+            raise ResourceNotFoundError(f"index template matching [{name}] not found")
+        del store[name]
+
+    def resolve(self, index_name: str) -> dict:
+        """Merged settings/mappings/aliases for a new index."""
+        matches: List[tuple] = []
+        for name, t in self.templates.items():
+            if any(fnmatch.fnmatch(index_name, p) for p in t.get("index_patterns", [])):
+                matches.append((int(t.get("order", 0)), 0, name, t))
+        for name, t in self.index_templates.items():
+            if any(fnmatch.fnmatch(index_name, p) for p in t.get("index_patterns", [])):
+                body = t.get("template", {})
+                matches.append((int(t.get("priority", 0)), 1, name,
+                                {**body, "index_patterns": t["index_patterns"]}))
+        matches.sort(key=lambda m: (m[0], m[1]))
+        settings: dict = {}
+        mappings: dict = {"properties": {}}
+        aliases: dict = {}
+        for _, _, _, t in matches:
+            settings.update(t.get("settings") or {})
+            props = (t.get("mappings") or {}).get("properties") or {}
+            mappings["properties"].update(props)
+            aliases.update(t.get("aliases") or {})
+        return {"settings": settings, "mappings": mappings, "aliases": aliases}
+
+
+# ---------------------------------------------------------------------------
+# reindex family — executed against the Node facade
+# ---------------------------------------------------------------------------
+
+def reindex(node, body: dict) -> dict:
+    """POST /_reindex (reference: modules/reindex): scan source, bulk into
+    dest, optional query filter + ingest pipeline + script."""
+    src = body.get("source", {})
+    dest = body.get("dest", {})
+    if "index" not in src or "index" not in dest:
+        raise IllegalArgumentError("reindex requires source.index and dest.index")
+    query = src.get("query", {"match_all": {}})
+    pipeline = dest.get("pipeline")
+    script = body.get("script")
+    max_docs = body.get("max_docs")
+    task = node.tasks.register("indices:data/write/reindex",
+                               f"reindex from [{src['index']}] to [{dest['index']}]")
+    created = updated = 0
+    failures = []
+    try:
+        docs = _scan_all(node, src["index"], query)
+        for doc in docs:
+            if task.cancelled or (max_docs is not None and created + updated >= max_docs):
+                break
+            source = doc["_source"]
+            if script is not None:
+                from elasticsearch_tpu.node import _apply_update_script
+                ctx_doc = dict(source)
+                source = _apply_update_script(ctx_doc, script)
+            if pipeline is not None:
+                source = node.ingest.execute(pipeline, dest["index"], doc["_id"], source)
+                if source is None:
+                    continue
+            try:
+                r = node.index_doc(dest["index"], doc["_id"], source)
+                if r["result"] == "created":
+                    created += 1
+                else:
+                    updated += 1
+            except SearchEngineError as e:
+                failures.append({"id": doc["_id"], "cause": e.to_dict()})
+        for svc_name in {dest["index"]}:
+            node.indices.get(svc_name).refresh()
+    finally:
+        node.tasks.unregister(task)
+    return {"took": 0, "timed_out": False, "total": created + updated,
+            "created": created, "updated": updated, "deleted": 0,
+            "batches": 1, "version_conflicts": 0, "noops": 0,
+            "retries": {"bulk": 0, "search": 0}, "failures": failures}
+
+
+def update_by_query(node, index: str, body: dict) -> dict:
+    query = (body or {}).get("query", {"match_all": {}})
+    script = (body or {}).get("script")
+    task = node.tasks.register("indices:data/write/update/byquery",
+                               f"update-by-query [{index}]")
+    updated = 0
+    failures = []
+    try:
+        for doc in _scan_all(node, index, query):
+            if task.cancelled:
+                break
+            source = doc["_source"]
+            if script is not None:
+                from elasticsearch_tpu.node import _apply_update_script
+                source = _apply_update_script(dict(source), script)
+            try:
+                node.index_doc(doc["_index"], doc["_id"], source,
+                               if_seq_no=doc.get("_seq_no"),
+                               if_primary_term=doc.get("_primary_term"))
+                updated += 1
+            except SearchEngineError as e:
+                failures.append({"id": doc["_id"], "cause": e.to_dict()})
+        node.indices.get(index).refresh()
+    finally:
+        node.tasks.unregister(task)
+    return {"took": 0, "total": updated, "updated": updated, "deleted": 0,
+            "version_conflicts": len(failures), "noops": 0, "failures": failures}
+
+
+def delete_by_query(node, index: str, body: dict) -> dict:
+    query = (body or {}).get("query")
+    if query is None:
+        raise IllegalArgumentError("delete_by_query requires a query")
+    task = node.tasks.register("indices:data/write/delete/byquery",
+                               f"delete-by-query [{index}]")
+    deleted = 0
+    failures = []
+    try:
+        for doc in _scan_all(node, index, query):
+            if task.cancelled:
+                break
+            try:
+                node.delete_doc(doc["_index"], doc["_id"])
+                deleted += 1
+            except SearchEngineError as e:
+                failures.append({"id": doc["_id"], "cause": e.to_dict()})
+        node.indices.get(index).refresh()
+    finally:
+        node.tasks.unregister(task)
+    return {"took": 0, "total": deleted, "deleted": deleted,
+            "version_conflicts": len(failures), "failures": failures}
+
+
+def _scan_all(node, index_expr: str, query: dict) -> List[dict]:
+    """Materialize all matching docs (id + source + seqno) across indices."""
+    out = []
+    for svc in node.indices.resolve(index_expr):
+        svc.refresh()
+        reader = svc.combined_reader()
+        from elasticsearch_tpu.search.queries import SearchContext, parse_query
+        ctx = SearchContext(reader, svc.mapper_service)
+        ds = parse_query(query).execute(ctx)
+        for row in ds.rows:
+            doc_id = reader.get_id(int(row))
+            full = None
+            shard = svc.shard_of_row(int(row))
+            got = shard.engine.get(doc_id)
+            if got is not None:
+                import copy as _copy
+                # deep copy: callers (reindex scripts/pipelines) mutate these
+                out.append({"_index": svc.name, "_id": doc_id,
+                            "_source": _copy.deepcopy(got["_source"]),
+                            "_seq_no": got["_seq_no"],
+                            "_primary_term": got["_primary_term"]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# field caps / validate / explain
+# ---------------------------------------------------------------------------
+
+_AGGREGATABLE = {"keyword", "long", "integer", "short", "byte", "double", "float",
+                 "half_float", "scaled_float", "date", "boolean", "ip"}
+
+
+def field_caps(node, index_expr: Optional[str], fields: str) -> dict:
+    patterns = [f.strip() for f in (fields or "*").split(",")]
+    out: Dict[str, dict] = {}
+    indices = node.indices.resolve(index_expr)
+    for svc in indices:
+        for path in svc.mapper_service.field_names():
+            if not any(fnmatch.fnmatch(path, p) for p in patterns):
+                continue
+            mapper = svc.mapper_service.get(path)
+            t = mapper.type_name
+            if t in ("object", "nested"):
+                continue
+            entry = out.setdefault(path, {}).setdefault(t, {
+                "type": t, "metadata_field": False,
+                "searchable": True,
+                "aggregatable": t in _AGGREGATABLE,
+            })
+    return {"indices": [s.name for s in indices], "fields": out}
+
+
+def validate_query(node, index_expr: Optional[str], body: dict) -> dict:
+    from elasticsearch_tpu.search.queries import parse_query
+    try:
+        q = parse_query((body or {}).get("query"))
+        explanation = str(q.to_dict())
+        return {"valid": True, "_shards": {"total": 1, "successful": 1, "failed": 0},
+                "explanations": [{"index": s.name, "valid": True,
+                                  "explanation": explanation}
+                                 for s in node.indices.resolve(index_expr)]}
+    except (ParsingError, IllegalArgumentError) as e:
+        return {"valid": False,
+                "_shards": {"total": 1, "successful": 1, "failed": 0},
+                "error": str(e)}
+
+
+def explain_doc(node, index: str, doc_id: str, body: dict) -> dict:
+    from elasticsearch_tpu.search.queries import SearchContext, parse_query
+    svc = node.indices.get(index)
+    svc.refresh()
+    reader = svc.combined_reader()
+    ctx = SearchContext(reader, svc.mapper_service)
+    q = parse_query((body or {}).get("query"))
+    ds = q.execute(ctx)
+    target_rows = [int(r) for r in ds.rows if reader.get_id(int(r)) == doc_id]
+    if not target_rows:
+        doc_exists = any(reader.get_id(int(r)) == doc_id
+                         for r in reader.live_global_rows())
+        return {"_index": svc.name, "_id": doc_id, "matched": False,
+                "explanation": {"value": 0.0,
+                                "description": "no matching term" if doc_exists
+                                else "document not found", "details": []}}
+    idx = list(ds.rows).index(target_rows[0])
+    score = float(ds.scores[idx]) if ds.scores is not None else 1.0
+    return {"_index": svc.name, "_id": doc_id, "matched": True,
+            "explanation": {"value": score,
+                            "description": f"score from query {q.to_dict()}",
+                            "details": []}}

@@ -22,6 +22,8 @@ from elasticsearch_tpu.version import __version__
 
 
 def register_all(rc: RestController, node: Node) -> None:
+    from elasticsearch_tpu.rest.actions_extra import register_extra
+    register_extra(rc, node)
     # ------------------------------------------------------------------ root
     def root(req):
         return 200, {
@@ -44,7 +46,8 @@ def register_all(rc: RestController, node: Node) -> None:
             if_seq_no=req.int_param("if_seq_no"),
             if_primary_term=req.int_param("if_primary_term"),
             version=req.int_param("version"),
-            version_type=req.param("version_type", "internal"))
+            version_type=req.param("version_type", "internal"),
+            pipeline=req.param("pipeline"))
         return (201 if resp["result"] == "created" else 200), resp
 
     def post_doc_auto_id(req):
@@ -132,6 +135,10 @@ def register_all(rc: RestController, node: Node) -> None:
             body["sort"] = [
                 {s.split(":")[0]: s.split(":")[1]} if ":" in s else s
                 for s in sort.split(",")]
+        scroll = req.param("scroll")
+        if scroll:
+            return 200, node.search_scroll_start(req.params.get("index"), body,
+                                                 keep_alive=scroll)
         return 200, node.search(req.params.get("index"), body)
 
     rc.register("GET", "/_search", search)
@@ -165,7 +172,7 @@ def register_all(rc: RestController, node: Node) -> None:
     # ----------------------------------------------------------- index admin
     def create_index(req):
         body = req.json() or {}
-        svc = node.indices.create_index(
+        svc = node.create_index_with_templates(
             req.params["index"], settings=body.get("settings"),
             mappings=body.get("mappings"), aliases=body.get("aliases"))
         return 200, {"acknowledged": True, "shards_acknowledged": True,

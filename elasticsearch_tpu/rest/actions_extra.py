@@ -1,0 +1,244 @@
+"""REST handlers for the extended API surface: scroll, async-search, tasks,
+ingest pipelines, templates, reindex family, field caps, validate, explain,
+rank-eval, snapshots.
+
+Registered alongside rest/actions.py's core table — together they cover the
+bulk of the reference's 124-handler surface (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.node_admin import (
+    delete_by_query, explain_doc, field_caps, reindex, update_by_query,
+    validate_query,
+)
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.search.extras import rank_eval
+
+
+def register_extra(rc: RestController, node: Node) -> None:
+    # ------------------------------------------------------------------ scroll
+    # (scroll START is a ?scroll= branch in the core _search handler,
+    # rest/actions.py; only continuation/cleanup routes live here)
+    def scroll_next(req):
+        body = req.json() or {}
+        scroll_id = body.get("scroll_id") or req.param("scroll_id")
+        if not scroll_id:
+            raise IllegalArgumentError("scroll_id is required")
+        return 200, node.search_scroll_next(scroll_id, body.get("scroll"))
+
+    def scroll_delete(req):
+        body = req.json() or {}
+        ids = body.get("scroll_id", [])
+        if isinstance(ids, str):
+            ids = [ids]
+        freed = 0
+        if body.get("scroll_id") == "_all" or req.path.endswith("/_all"):
+            freed = node.scrolls.delete_all()
+        else:
+            for sid in ids:
+                freed += 1 if node.scrolls.delete(sid) else 0
+        return 200, {"succeeded": True, "num_freed": freed}
+
+    rc.register("POST", "/_search/scroll", scroll_next)
+    rc.register("GET", "/_search/scroll", scroll_next)
+    rc.register("DELETE", "/_search/scroll", scroll_delete)
+    rc.register("DELETE", "/_search/scroll/_all", scroll_delete)
+
+    # ------------------------------------------------------------ async search
+    def async_submit(req):
+        body = req.json() or {}
+        index = req.params.get("index")
+        wait = req.param("wait_for_completion_timeout", "1s")
+        from elasticsearch_tpu.common.settings import parse_time_value
+        out = node.async_search.submit(lambda: node.search(index, body),
+                                       wait_for_completion_s=parse_time_value(wait, "wait"))
+        return 200, out
+
+    def async_get(req):
+        return 200, node.async_search.status(req.params["id"])
+
+    def async_delete(req):
+        ok = node.async_search.delete(req.params["id"])
+        return (200 if ok else 404), {"acknowledged": ok}
+
+    rc.register("POST", "/_async_search", async_submit)
+    rc.register("POST", "/{index}/_async_search", async_submit)
+    rc.register("GET", "/_async_search/{id}", async_get)
+    rc.register("DELETE", "/_async_search/{id}", async_delete)
+
+    # ------------------------------------------------------------------- tasks
+    def list_tasks(req):
+        tasks = node.tasks.list_tasks(req.param("actions"))
+        return 200, {"nodes": {node.node_id: {
+            "name": node.node_name,
+            "tasks": {t.task_id: t.to_dict(node.node_id) for t in tasks}}}}
+
+    def get_task(req):
+        t = node.tasks.get(req.params["task_id"])
+        return 200, {"completed": False, "task": t.to_dict(node.node_id)}
+
+    def cancel_task(req):
+        t = node.tasks.cancel(req.params["task_id"])
+        return 200, {"nodes": {node.node_id: {
+            "tasks": {t.task_id: t.to_dict(node.node_id)}}}}
+
+    rc.register("GET", "/_tasks", list_tasks)
+    rc.register("GET", "/_tasks/{task_id}", get_task)
+    rc.register("POST", "/_tasks/{task_id}/_cancel", cancel_task)
+
+    # ------------------------------------------------------------------ ingest
+    def put_pipeline(req):
+        node.ingest.put_pipeline(req.params["id"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def get_pipeline(req):
+        pid = req.params.get("id")
+        if pid:
+            p = node.ingest.get_pipeline(pid)
+            return 200, {pid: p.definition}
+        return 200, {pid: p.definition for pid, p in node.ingest.pipelines.items()}
+
+    def delete_pipeline(req):
+        node.ingest.delete_pipeline(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    def simulate_pipeline(req):
+        body = req.json() or {}
+        pid = req.params.get("id")
+        pipeline = pid if pid else body.get("pipeline", {})
+        docs = body.get("docs", [])
+        return 200, {"docs": node.ingest.simulate(pipeline, docs)}
+
+    rc.register("PUT", "/_ingest/pipeline/{id}", put_pipeline)
+    rc.register("GET", "/_ingest/pipeline/{id}", get_pipeline)
+    rc.register("GET", "/_ingest/pipeline", get_pipeline)
+    rc.register("DELETE", "/_ingest/pipeline/{id}", delete_pipeline)
+    rc.register("POST", "/_ingest/pipeline/_simulate", simulate_pipeline)
+    rc.register("POST", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
+
+    # --------------------------------------------------------------- templates
+    def put_template(req):
+        node.templates.put(req.params["name"], req.json() or {},
+                           composable="_index_template" in req.path)
+        return 200, {"acknowledged": True}
+
+    def get_template(req):
+        composable = "_index_template" in req.path
+        name = req.params.get("name")
+        if composable:
+            if name:
+                return 200, {"index_templates": [
+                    {"name": name, "index_template": node.templates.get(name, True)}]}
+            return 200, {"index_templates": [
+                {"name": n, "index_template": t}
+                for n, t in node.templates.index_templates.items()]}
+        if name:
+            return 200, {name: node.templates.get(name)}
+        return 200, dict(node.templates.templates)
+
+    def delete_template(req):
+        node.templates.delete(req.params["name"],
+                              composable="_index_template" in req.path)
+        return 200, {"acknowledged": True}
+
+    for base in ("/_template/{name}", "/_index_template/{name}"):
+        rc.register("PUT", base, put_template)
+        rc.register("POST", base, put_template)
+        rc.register("GET", base, get_template)
+        rc.register("DELETE", base, delete_template)
+    rc.register("GET", "/_template", get_template)
+    rc.register("GET", "/_index_template", get_template)
+
+    # ----------------------------------------------------------------- reindex
+    def do_reindex(req):
+        return 200, reindex(node, req.json() or {})
+
+    def do_update_by_query(req):
+        return 200, update_by_query(node, req.params["index"], req.json())
+
+    def do_delete_by_query(req):
+        return 200, delete_by_query(node, req.params["index"], req.json() or {})
+
+    rc.register("POST", "/_reindex", do_reindex)
+    rc.register("POST", "/{index}/_update_by_query", do_update_by_query)
+    rc.register("POST", "/{index}/_delete_by_query", do_delete_by_query)
+
+    # ----------------------------------------------- field caps / validate / explain
+    def do_field_caps(req):
+        body = req.json() or {}
+        fields = req.param("fields") or ",".join(body.get("fields", ["*"]))
+        return 200, field_caps(node, req.params.get("index"), fields)
+
+    rc.register("GET", "/_field_caps", do_field_caps)
+    rc.register("POST", "/_field_caps", do_field_caps)
+    rc.register("GET", "/{index}/_field_caps", do_field_caps)
+    rc.register("POST", "/{index}/_field_caps", do_field_caps)
+
+    def do_validate(req):
+        return 200, validate_query(node, req.params.get("index"), req.json())
+
+    rc.register("GET", "/{index}/_validate/query", do_validate)
+    rc.register("POST", "/{index}/_validate/query", do_validate)
+
+    def do_explain(req):
+        return 200, explain_doc(node, req.params["index"], req.params["id"],
+                                req.json())
+
+    rc.register("GET", "/{index}/_explain/{id}", do_explain)
+    rc.register("POST", "/{index}/_explain/{id}", do_explain)
+
+    # --------------------------------------------------------------- rank eval
+    def do_rank_eval(req):
+        return 200, rank_eval(lambda idx, b: node.search(idx, b),
+                              req.json() or {}, req.params.get("index"))
+
+    rc.register("GET", "/_rank_eval", do_rank_eval)
+    rc.register("POST", "/_rank_eval", do_rank_eval)
+    rc.register("GET", "/{index}/_rank_eval", do_rank_eval)
+    rc.register("POST", "/{index}/_rank_eval", do_rank_eval)
+
+    # --------------------------------------------------------------- snapshots
+    def put_repo(req):
+        node.snapshots.put_repository(req.params["repo"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def get_repo(req):
+        name = req.params.get("repo")
+        if name:
+            repo = node.snapshots.get_repository(name)
+            return 200, {name: {"type": "fs", "settings": repo.settings}}
+        return 200, {name: {"type": "fs", "settings": r.settings}
+                     for name, r in node.snapshots.repositories.items()}
+
+    def delete_repo(req):
+        node.snapshots.delete_repository(req.params["repo"])
+        return 200, {"acknowledged": True}
+
+    def create_snapshot(req):
+        return 200, node.snapshots.create_snapshot(
+            req.params["repo"], req.params["snapshot"], req.json())
+
+    def get_snapshot(req):
+        return 200, node.snapshots.get_snapshots(
+            req.params["repo"], req.params.get("snapshot", "_all"))
+
+    def delete_snapshot(req):
+        node.snapshots.delete_snapshot(req.params["repo"], req.params["snapshot"])
+        return 200, {"acknowledged": True}
+
+    def restore_snapshot(req):
+        return 200, node.snapshots.restore_snapshot(
+            req.params["repo"], req.params["snapshot"], req.json())
+
+    rc.register("PUT", "/_snapshot/{repo}", put_repo)
+    rc.register("GET", "/_snapshot/{repo}", get_repo)
+    rc.register("GET", "/_snapshot", get_repo)
+    rc.register("DELETE", "/_snapshot/{repo}", delete_repo)
+    rc.register("PUT", "/_snapshot/{repo}/{snapshot}", create_snapshot)
+    rc.register("POST", "/_snapshot/{repo}/{snapshot}", create_snapshot)
+    rc.register("GET", "/_snapshot/{repo}/{snapshot}", get_snapshot)
+    rc.register("DELETE", "/_snapshot/{repo}/{snapshot}", delete_snapshot)
+    rc.register("POST", "/_snapshot/{repo}/{snapshot}/_restore", restore_snapshot)

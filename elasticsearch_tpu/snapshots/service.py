@@ -1,0 +1,226 @@
+"""Snapshot/restore over a content-addressed blob repository.
+
+Re-design of `snapshots/SnapshotsService` + `repositories/blobstore/
+BlobStoreRepository.java` (SURVEY.md §2.10): repositories hold immutable
+blobs addressed by content hash — re-snapshotting unchanged shard data
+uploads nothing (the reference dedups at segment-file granularity; here the
+unit is the shard commit file + translog state). Snapshot manifests list
+index metadata + shard blob references; restore materializes data
+directories from blobs and re-opens the index.
+
+Backends: `fs` implemented; s3/gcs/azure/hdfs are registered-but-unavailable
+(network egress), same gating as the reference's repository plugins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, ResourceAlreadyExistsError, ResourceNotFoundError,
+    SearchEngineError,
+)
+
+
+class RepositoryError(SearchEngineError):
+    status = 500
+
+
+class FsRepository:
+    def __init__(self, name: str, settings: dict):
+        self.name = name
+        self.settings = settings
+        location = settings.get("location")
+        if not location:
+            raise IllegalArgumentError("[location] is required for fs repositories")
+        self.root = location
+        os.makedirs(os.path.join(self.root, "blobs"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "snapshots"), exist_ok=True)
+
+    # -- content-addressed blobs ---------------------------------------------
+    def put_blob(self, path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        digest = h.hexdigest()
+        target = os.path.join(self.root, "blobs", digest)
+        if not os.path.exists(target):          # incremental dedup
+            shutil.copyfile(path, target + ".tmp")
+            os.replace(target + ".tmp", target)
+        return digest
+
+    def get_blob(self, digest: str, dest_path: str) -> None:
+        src = os.path.join(self.root, "blobs", digest)
+        if not os.path.exists(src):
+            raise RepositoryError(f"missing blob [{digest}] in repository [{self.name}]")
+        os.makedirs(os.path.dirname(dest_path), exist_ok=True)
+        shutil.copyfile(src, dest_path)
+
+    # -- manifests ------------------------------------------------------------
+    def _manifest_path(self, snapshot: str) -> str:
+        return os.path.join(self.root, "snapshots", f"{snapshot}.json")
+
+    def put_manifest(self, snapshot: str, manifest: dict) -> None:
+        path = self._manifest_path(snapshot)
+        with open(path + ".tmp", "w") as f:
+            json.dump(manifest, f)
+        os.replace(path + ".tmp", path)
+
+    def get_manifest(self, snapshot: str) -> dict:
+        path = self._manifest_path(snapshot)
+        if not os.path.exists(path):
+            raise ResourceNotFoundError(
+                f"snapshot [{self.name}:{snapshot}] is missing")
+        with open(path) as f:
+            return json.load(f)
+
+    def list_snapshots(self) -> List[str]:
+        out = []
+        for fn in sorted(os.listdir(os.path.join(self.root, "snapshots"))):
+            if fn.endswith(".json"):
+                out.append(fn[:-5])
+        return out
+
+    def delete_manifest(self, snapshot: str) -> None:
+        path = self._manifest_path(snapshot)
+        if not os.path.exists(path):
+            raise ResourceNotFoundError(f"snapshot [{self.name}:{snapshot}] is missing")
+        os.remove(path)
+
+
+REPOSITORY_TYPES = {"fs": FsRepository}
+UNAVAILABLE_TYPES = {"s3", "gcs", "azure", "hdfs", "url"}
+
+
+class SnapshotService:
+    def __init__(self, node):
+        self.node = node
+        self.repositories: Dict[str, FsRepository] = {}
+
+    # -- repositories ---------------------------------------------------------
+    def put_repository(self, name: str, body: dict) -> None:
+        rtype = body.get("type")
+        if rtype in UNAVAILABLE_TYPES:
+            raise IllegalArgumentError(
+                f"repository type [{rtype}] requires an external service and is "
+                f"not available in this build; use [fs]")
+        cls = REPOSITORY_TYPES.get(rtype)
+        if cls is None:
+            raise IllegalArgumentError(f"unknown repository type [{rtype}]")
+        self.repositories[name] = cls(name, body.get("settings", {}))
+
+    def get_repository(self, name: str) -> FsRepository:
+        repo = self.repositories.get(name)
+        if repo is None:
+            raise ResourceNotFoundError(f"[{name}] missing", repository=name)
+        return repo
+
+    def delete_repository(self, name: str) -> None:
+        if name not in self.repositories:
+            raise ResourceNotFoundError(f"[{name}] missing")
+        del self.repositories[name]
+
+    # -- snapshot -------------------------------------------------------------
+    def create_snapshot(self, repo_name: str, snapshot: str,
+                        body: Optional[dict] = None) -> dict:
+        repo = self.get_repository(repo_name)
+        if snapshot in repo.list_snapshots():
+            raise ResourceAlreadyExistsError(
+                f"snapshot with the same name [{snapshot}] already exists")
+        body = body or {}
+        index_expr = body.get("indices", "_all")
+        services = self.node.indices.resolve(
+            index_expr if isinstance(index_expr, str) else ",".join(index_expr))
+        manifest = {"snapshot": snapshot, "state": "SUCCESS",
+                    "start_time_in_millis": int(time.time() * 1000),
+                    "indices": {}, "shards": {"total": 0, "failed": 0,
+                                              "successful": 0}}
+        for svc in services:
+            svc.flush()  # commit everything so commit.bin is complete
+            index_entry = {"settings": svc.settings.as_flat_dict(),
+                           "mappings": svc.mapper_service.to_dict(),
+                           "aliases": svc.aliases,
+                           "shards": {}}
+            for shard in svc.shards:
+                commit = os.path.join(shard.engine.path, "commit.bin")
+                files = {}
+                if os.path.exists(commit):
+                    files["commit.bin"] = repo.put_blob(commit)
+                index_entry["shards"][str(shard.shard_id)] = {"files": files}
+                manifest["shards"]["total"] += 1
+                manifest["shards"]["successful"] += 1
+            manifest["indices"][svc.name] = index_entry
+        manifest["end_time_in_millis"] = int(time.time() * 1000)
+        repo.put_manifest(snapshot, manifest)
+        return {"snapshot": {"snapshot": snapshot, "state": "SUCCESS",
+                             "indices": sorted(manifest["indices"]),
+                             "shards": manifest["shards"]}}
+
+    def get_snapshots(self, repo_name: str, expr: str = "_all") -> dict:
+        repo = self.get_repository(repo_name)
+        names = repo.list_snapshots()
+        if expr not in ("_all", "*"):
+            import fnmatch
+            wanted = expr.split(",")
+            names = [n for n in names
+                     if any(fnmatch.fnmatch(n, w) for w in wanted)]
+        out = []
+        for n in names:
+            m = repo.get_manifest(n)
+            out.append({"snapshot": n, "state": m.get("state", "SUCCESS"),
+                        "indices": sorted(m.get("indices", {})),
+                        "start_time_in_millis": m.get("start_time_in_millis"),
+                        "end_time_in_millis": m.get("end_time_in_millis")})
+        return {"snapshots": out}
+
+    def delete_snapshot(self, repo_name: str, snapshot: str) -> None:
+        self.get_repository(repo_name).delete_manifest(snapshot)
+
+    # -- restore --------------------------------------------------------------
+    def restore_snapshot(self, repo_name: str, snapshot: str,
+                         body: Optional[dict] = None) -> dict:
+        repo = self.get_repository(repo_name)
+        manifest = repo.get_manifest(snapshot)
+        body = body or {}
+        indices_expr = body.get("indices", "_all")
+        rename_pattern = body.get("rename_pattern")
+        rename_replacement = body.get("rename_replacement", "")
+        restored = []
+        import fnmatch
+        import re as _re
+        for index_name, entry in manifest["indices"].items():
+            if indices_expr not in ("_all", "*"):
+                wanted = indices_expr if isinstance(indices_expr, list) \
+                    else indices_expr.split(",")
+                if not any(fnmatch.fnmatch(index_name, w) for w in wanted):
+                    continue
+            target = index_name
+            if rename_pattern:
+                target = _re.sub(rename_pattern, rename_replacement, index_name)
+            if self.node.indices.exists(target):
+                raise IllegalArgumentError(
+                    f"cannot restore index [{target}] because an open index with "
+                    f"same name already exists")
+            # materialize the data directory, then open the index from disk
+            index_path = os.path.join(self.node.indices.data_path, target)
+            num_shards = int(entry["settings"].get("index.number_of_shards", 1))
+            for shard_id in range(num_shards):
+                shard_entry = entry["shards"].get(str(shard_id), {"files": {}})
+                for fname, digest in shard_entry["files"].items():
+                    repo.get_blob(digest, os.path.join(index_path, str(shard_id), fname))
+            meta = {"settings": entry["settings"], "mappings": entry["mappings"],
+                    "aliases": entry.get("aliases", {}), "uuid": f"{target}-restored"}
+            os.makedirs(index_path, exist_ok=True)
+            with open(os.path.join(index_path, "index_meta.json"), "w") as f:
+                json.dump(meta, f)
+            self.node.indices.open_index(target)
+            restored.append(target)
+        return {"snapshot": {"snapshot": snapshot, "indices": restored,
+                             "shards": {"total": len(restored), "failed": 0,
+                                        "successful": len(restored)}}}

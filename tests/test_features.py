@@ -1,0 +1,400 @@
+"""REST-level tests for the extended surface: ingest, scroll, async-search,
+tasks, templates, reindex family, rank-eval, field caps, validate, explain,
+suggesters, snapshots."""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = b""
+        if body is not None:
+            if isinstance(body, (list, tuple)):
+                raw = b"\n".join(json.dumps(l).encode() for l in body) + b"\n"
+            else:
+                raw = json.dumps(body).encode()
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+def seed(client, n=25, index="logs"):
+    for i in range(n):
+        client.req("PUT", f"/{index}/_doc/{i}",
+                   {"msg": f"event number {i}", "level": "error" if i % 5 == 0 else "info",
+                    "n": i})
+    client.req("POST", f"/{index}/_refresh")
+
+
+# ---------------------------------------------------------------- ingest
+
+def test_ingest_pipeline(client):
+    status, _ = client.req("PUT", "/_ingest/pipeline/clean", {
+        "description": "test",
+        "processors": [
+            {"set": {"field": "env", "value": "prod"}},
+            {"rename": {"field": "raw", "target_field": "message"}},
+            {"lowercase": {"field": "message"}},
+            {"convert": {"field": "count", "type": "integer"}},
+            {"split": {"field": "tags_csv", "separator": ",", "target_field": "tags"}},
+            {"remove": {"field": "tags_csv"}},
+        ]})
+    assert status == 200
+    status, body = client.req("PUT", "/idx/_doc/1",
+                              {"raw": "HELLO World", "count": "42",
+                               "tags_csv": "a,b,c"}, pipeline="clean", refresh="true")
+    assert status == 201
+    _, doc = client.req("GET", "/idx/_doc/1")
+    assert doc["_source"] == {"env": "prod", "message": "hello world",
+                              "count": 42, "tags": ["a", "b", "c"]}
+
+
+def test_ingest_conditionals_drop_and_simulate(client):
+    client.req("PUT", "/_ingest/pipeline/filter", {
+        "processors": [
+            {"drop": {"if": "ctx.level == 'debug'"}},
+            {"set": {"field": "kept", "value": True}},
+        ]})
+    status, body = client.req("POST", "/_ingest/pipeline/filter/_simulate", {
+        "docs": [{"_source": {"level": "debug"}},
+                 {"_source": {"level": "error"}}]})
+    assert body["docs"][0].get("dropped") is True
+    assert body["docs"][1]["doc"]["_source"]["kept"] is True
+    # dropped doc is not indexed
+    r = client.req("PUT", "/d/_doc/1", {"level": "debug"}, pipeline="filter")
+    assert r[1]["result"] == "noop"
+    _, doc = client.req("GET", "/d/_doc/1")
+    assert not doc["found"]
+
+
+def test_ingest_default_pipeline_and_failure(client):
+    client.req("PUT", "/_ingest/pipeline/strict", {
+        "processors": [{"fail": {"message": "boom {{reason}}",
+                                 "if": "ctx.bad == True"}}]})
+    client.req("PUT", "/defp", {"settings": {"index.default_pipeline": "strict"}})
+    status, _ = client.req("PUT", "/defp/_doc/1", {"ok": 1})
+    assert status == 201
+    status, body = client.req("PUT", "/defp/_doc/2", {"bad": True, "reason": "x"})
+    assert status == 400
+    assert "boom" in body["error"]["reason"]
+
+
+def test_ingest_dissect_and_script(client):
+    client.req("PUT", "/_ingest/pipeline/parse", {
+        "processors": [
+            {"dissect": {"field": "line", "pattern": "%{client} - %{verb} %{path}"}},
+            {"script": {"source": "ctx.score = params.base + 1",
+                        "params": {"base": 10}}},
+        ]})
+    _, body = client.req("POST", "/_ingest/pipeline/parse/_simulate", {
+        "docs": [{"_source": {"line": "1.2.3.4 - GET /index.html"}}]})
+    src = body["docs"][0]["doc"]["_source"]
+    assert src["client"] == "1.2.3.4" and src["verb"] == "GET"
+    assert src["score"] == 11
+
+
+# ---------------------------------------------------------------- scroll
+
+def test_scroll(client):
+    seed(client, 25)
+    status, page1 = client.req("POST", "/logs/_search",
+                               {"size": 10, "sort": [{"n": "asc"}]}, scroll="1m")
+    assert status == 200
+    sid = page1["_scroll_id"]
+    assert [h["_source"]["n"] for h in page1["hits"]["hits"]] == list(range(10))
+    _, page2 = client.req("POST", "/_search/scroll", {"scroll_id": sid, "scroll": "1m"})
+    assert [h["_source"]["n"] for h in page2["hits"]["hits"]] == list(range(10, 20))
+    _, page3 = client.req("POST", "/_search/scroll", {"scroll_id": sid})
+    assert [h["_source"]["n"] for h in page3["hits"]["hits"]] == list(range(20, 25))
+    _, page4 = client.req("POST", "/_search/scroll", {"scroll_id": sid})
+    assert page4["hits"]["hits"] == []
+    status, body = client.req("DELETE", "/_search/scroll", {"scroll_id": sid})
+    assert body["num_freed"] == 1
+    status, _ = client.req("POST", "/_search/scroll", {"scroll_id": sid})
+    assert status == 404
+
+
+# ------------------------------------------------------------ async search
+
+def test_async_search(client):
+    seed(client, 10)
+    status, body = client.req("POST", "/logs/_async_search",
+                              {"query": {"match_all": {}}, "size": 3})
+    assert status == 200
+    sid = body["id"]
+    deadline = time.time() + 5
+    while body.get("is_running") and time.time() < deadline:
+        time.sleep(0.05)
+        _, body = client.req("GET", f"/_async_search/{sid}")
+    assert body["is_running"] is False
+    assert body["response"]["hits"]["total"]["value"] == 10
+    status, _ = client.req("DELETE", f"/_async_search/{sid}")
+    assert status == 200
+    status, _ = client.req("GET", f"/_async_search/{sid}")
+    assert status == 404
+
+
+# ----------------------------------------------------------------- tasks
+
+def test_tasks_api(client, node):
+    t = node.tasks.register("indices:data/read/search", "test task")
+    status, body = client.req("GET", "/_tasks")
+    tasks = body["nodes"][node.node_id]["tasks"]
+    assert t.task_id in tasks
+    status, body = client.req("POST", f"/_tasks/{t.task_id}/_cancel")
+    assert node.tasks.get(t.task_id).cancelled
+    node.tasks.unregister(t)
+    status, _ = client.req("GET", f"/_tasks/{t.task_id}")
+    assert status == 404
+
+
+# -------------------------------------------------------------- templates
+
+def test_legacy_template_applied_on_autocreate(client):
+    client.req("PUT", "/_template/logs_t", {
+        "index_patterns": ["logs-*"],
+        "settings": {"index.number_of_shards": 2},
+        "mappings": {"properties": {"ts": {"type": "date"}}}})
+    client.req("PUT", "/logs-2024/_doc/1", {"ts": "2024-01-01", "x": 1})
+    _, body = client.req("GET", "/logs-2024")
+    assert body["logs-2024"]["settings"]["index"]["number_of_shards"] == 2
+    assert body["logs-2024"]["mappings"]["properties"]["ts"]["type"] == "date"
+
+
+def test_composable_template_priority(client):
+    client.req("PUT", "/_index_template/base", {
+        "index_patterns": ["app-*"], "priority": 1,
+        "template": {"settings": {"index.number_of_replicas": 0},
+                     "mappings": {"properties": {"a": {"type": "keyword"}}}}})
+    client.req("PUT", "/_index_template/override", {
+        "index_patterns": ["app-prod-*"], "priority": 10,
+        "template": {"mappings": {"properties": {"b": {"type": "long"}}}}})
+    client.req("PUT", "/app-prod-1/_doc/1", {"a": "x", "b": 2})
+    _, body = client.req("GET", "/app-prod-1")
+    props = body["app-prod-1"]["mappings"]["properties"]
+    assert props["a"]["type"] == "keyword" and props["b"]["type"] == "long"
+    status, body = client.req("GET", "/_index_template/base")
+    assert body["index_templates"][0]["name"] == "base"
+
+
+# ---------------------------------------------------------- reindex family
+
+def test_reindex_with_query_and_script(client):
+    seed(client, 10, index="src")
+    status, body = client.req("POST", "/_reindex", {
+        "source": {"index": "src", "query": {"range": {"n": {"gte": 5}}}},
+        "dest": {"index": "dst"},
+        "script": {"source": "ctx._source.n = ctx._source.n * 10"}})
+    assert status == 200 and body["created"] == 5
+    _, body = client.req("GET", "/dst/_count")
+    assert body["count"] == 5
+    _, doc = client.req("GET", "/dst/_doc/7")
+    assert doc["_source"]["n"] == 70
+
+
+def test_update_and_delete_by_query(client):
+    seed(client, 10, index="ud")
+    status, body = client.req("POST", "/ud/_update_by_query", {
+        "query": {"term": {"level": "error"}},
+        "script": {"source": "ctx._source.flagged = True"}})
+    assert body["updated"] == 2  # i=0,5
+    client.req("POST", "/ud/_refresh")
+    _, cnt = client.req("POST", "/ud/_count", {"query": {"term": {"flagged": True}}})
+    assert cnt["count"] == 2
+    status, body = client.req("POST", "/ud/_delete_by_query",
+                              {"query": {"term": {"level": "error"}}})
+    assert body["deleted"] == 2
+    _, cnt = client.req("GET", "/ud/_count")
+    assert cnt["count"] == 8
+
+
+# ---------------------------------------------------- field caps / validate
+
+def test_field_caps_validate_explain(client):
+    seed(client, 5)
+    _, body = client.req("GET", "/logs/_field_caps", fields="*")
+    assert body["fields"]["n"]["long"]["aggregatable"] is True
+    assert body["fields"]["msg"]["text"]["searchable"] is True
+
+    _, body = client.req("POST", "/logs/_validate/query",
+                         {"query": {"match": {"msg": "event"}}})
+    assert body["valid"] is True
+    _, body = client.req("POST", "/logs/_validate/query",
+                         {"query": {"bogus": {}}})
+    assert body["valid"] is False
+
+    _, body = client.req("POST", "/logs/_explain/3",
+                         {"query": {"match": {"msg": "event"}}})
+    assert body["matched"] is True and body["explanation"]["value"] > 0
+    _, body = client.req("POST", "/logs/_explain/3",
+                         {"query": {"term": {"level": "error"}}})
+    assert body["matched"] is False
+
+
+# ------------------------------------------------------------- rank eval
+
+def test_rank_eval(client):
+    seed(client, 10)
+    body = {
+        "requests": [{
+            "id": "q1",
+            "request": {"query": {"term": {"level": "error"}}},
+            "ratings": [
+                {"_index": "logs", "_id": "0", "rating": 1},
+                {"_index": "logs", "_id": "5", "rating": 1},
+                {"_index": "logs", "_id": "1", "rating": 0},
+            ]}],
+        "metric": {"recall": {"k": 10}}}
+    status, out = client.req("POST", "/logs/_rank_eval", body)
+    assert status == 200
+    assert out["metric_score"] == 1.0  # both relevant docs found
+    body["metric"] = {"mean_reciprocal_rank": {"k": 10}}
+    _, out = client.req("POST", "/logs/_rank_eval", body)
+    assert out["metric_score"] == 1.0
+
+
+# ------------------------------------------------------------- suggesters
+
+def test_suggesters(client):
+    for i, word in enumerate(["elastic", "elastic", "search", "searching", "engine"]):
+        client.req("PUT", f"/s/_doc/{i}", {"body": word, "tag": word})
+    client.req("POST", "/s/_refresh")
+    _, body = client.req("POST", "/s/_search", {
+        "size": 0,
+        "suggest": {
+            "fix": {"text": "elastik serch", "term": {"field": "body"}},
+            "phrase_fix": {"text": "elastik serch", "phrase": {"field": "body"}},
+            "auto": {"prefix": "sea", "completion": {"field": "tag"}},
+        }})
+    sug = body["suggest"]
+    fix = sug["fix"]
+    assert fix[0]["options"][0]["text"] == "elastic"
+    assert fix[1]["options"][0]["text"] == "search"
+    assert sug["phrase_fix"][0]["options"][0]["text"] == "elastic search"
+    opts = [o["text"] for o in sug["auto"][0]["options"]]
+    assert "search" in opts and "searching" in opts
+
+
+# -------------------------------------------------------------- snapshots
+
+def test_snapshot_and_restore(client, tmp_path):
+    seed(client, 12, index="snap_src")
+    repo_path = str(tmp_path / "repo")
+    status, _ = client.req("PUT", "/_snapshot/backup",
+                           {"type": "fs", "settings": {"location": repo_path}})
+    assert status == 200
+    status, body = client.req("PUT", "/_snapshot/backup/snap1", {"indices": "snap_src"})
+    assert body["snapshot"]["state"] == "SUCCESS"
+
+    # second snapshot of unchanged data dedups blobs (content-addressed)
+    import os
+    blobs_before = len(os.listdir(os.path.join(repo_path, "blobs")))
+    client.req("PUT", "/_snapshot/backup/snap2", {"indices": "snap_src"})
+    blobs_after = len(os.listdir(os.path.join(repo_path, "blobs")))
+    assert blobs_after == blobs_before
+
+    _, listing = client.req("GET", "/_snapshot/backup/_all")
+    assert [s["snapshot"] for s in listing["snapshots"]] == ["snap1", "snap2"]
+
+    status, body = client.req("POST", "/_snapshot/backup/snap1/_restore",
+                              {"indices": "snap_src",
+                               "rename_pattern": "snap_src",
+                               "rename_replacement": "restored"})
+    assert "restored" in body["snapshot"]["indices"]
+    _, cnt = client.req("GET", "/restored/_count")
+    assert cnt["count"] == 12
+    _, doc = client.req("GET", "/restored/_doc/7")
+    assert doc["found"] and doc["_source"]["n"] == 7
+
+    # restoring over an existing open index is rejected
+    status, body = client.req("POST", "/_snapshot/backup/snap1/_restore",
+                              {"indices": "snap_src"})
+    assert status == 400
+
+    status, _ = client.req("DELETE", "/_snapshot/backup/snap2")
+    _, listing = client.req("GET", "/_snapshot/backup/_all")
+    assert [s["snapshot"] for s in listing["snapshots"]] == ["snap1"]
+
+    # unavailable repository types are gated with a clear error
+    status, body = client.req("PUT", "/_snapshot/cloud",
+                              {"type": "s3", "settings": {"bucket": "b"}})
+    assert status == 400 and "not available" in body["error"]["reason"]
+
+
+def test_scroll_past_10k(client):
+    """Scroll must page past the 10k result window (regression: truncation)."""
+    ops = []
+    for i in range(10_500):
+        ops.append({"index": {"_index": "big", "_id": str(i)}})
+        ops.append({"n": i})
+    client.req("POST", "/_bulk", ops)
+    client.req("POST", "/big/_refresh")
+    _, page = client.req("POST", "/big/_search", {"size": 5000, "sort": [{"n": "asc"}]},
+                         scroll="1m")
+    sid = page["_scroll_id"]
+    assert page["hits"]["total"]["value"] == 10_500
+    seen = len(page["hits"]["hits"])
+    while True:
+        _, page = client.req("POST", "/_search/scroll", {"scroll_id": sid})
+        assert page["hits"]["total"]["value"] == 10_500  # stable across pages
+        if not page["hits"]["hits"]:
+            break
+        seen += len(page["hits"]["hits"])
+    assert seen == 10_500
+
+
+def test_ingest_cycle_detection(client):
+    client.req("PUT", "/_ingest/pipeline/a", {
+        "processors": [{"pipeline": {"name": "b"}}]})
+    client.req("PUT", "/_ingest/pipeline/b", {
+        "processors": [{"pipeline": {"name": "a"}}]})
+    status, body = client.req("PUT", "/c/_doc/1", {"x": 1}, pipeline="a")
+    assert status == 400
+    assert "Cycle detected" in body["error"]["reason"]
+
+
+def test_dissect_dotted_keys(client):
+    client.req("PUT", "/_ingest/pipeline/dd", {
+        "processors": [{"dissect": {"field": "line",
+                                    "pattern": "%{client.ip} %{verb}"}}]})
+    _, body = client.req("POST", "/_ingest/pipeline/dd/_simulate",
+                         {"docs": [{"_source": {"line": "1.2.3.4 GET"}}]})
+    src = body["docs"][0]["doc"]["_source"]
+    assert src["client"]["ip"] == "1.2.3.4" and src["verb"] == "GET"
+
+
+def test_reindex_pipeline_does_not_corrupt_source(client):
+    client.req("PUT", "/_ingest/pipeline/tagger", {
+        "processors": [{"append": {"field": "tags", "value": "copied"}},
+                       {"set": {"field": "meta.copied", "value": True}}]})
+    client.req("PUT", "/orig/_doc/1", {"tags": ["a"], "meta": {"x": 1}}, refresh="true")
+    client.req("POST", "/_reindex", {"source": {"index": "orig"},
+                                     "dest": {"index": "copy", "pipeline": "tagger"}})
+    _, src_doc = client.req("GET", "/orig/_doc/1")
+    assert src_doc["_source"] == {"tags": ["a"], "meta": {"x": 1}}, \
+        "source index corrupted by reindex pipeline"
+    _, dst_doc = client.req("GET", "/copy/_doc/1")
+    assert dst_doc["_source"]["tags"] == ["a", "copied"]
+    assert dst_doc["_source"]["meta"] == {"x": 1, "copied": True}
