@@ -93,6 +93,11 @@ class Node:
         self.async_search = AsyncSearchService()
         self.tasks = TaskManager(self.node_id)
         self.templates = TemplateService()
+        from elasticsearch_tpu.script.service import GLOBAL_SCRIPTS
+        self.scripts = GLOBAL_SCRIPTS
+        import os as _os
+        self.scripts.attach_storage(_os.path.join(data_path, "_state",
+                                                  "stored_scripts.json"))
         from elasticsearch_tpu.snapshots.service import SnapshotService
         self.snapshots = SnapshotService(self)
         self.start_time = time.time()
@@ -519,6 +524,15 @@ def _apply_update_script(source: dict, script_spec) -> dict:
 
     if isinstance(script_spec, str):
         script_spec = {"source": script_spec}
+    if isinstance(script_spec, dict) and "id" in script_spec and "source" not in script_spec:
+        from elasticsearch_tpu.script.service import GLOBAL_SCRIPTS
+        resolved = GLOBAL_SCRIPTS.resolve(script_spec)
+        if resolved["lang"] == "mustache":
+            raise IllegalArgumentError(
+                f"stored script [{script_spec['id']}] is a [mustache] template, "
+                "not usable as an update script")
+        script_spec = {"source": resolved["source"],
+                       "params": script_spec.get("params", {})}
     src = script_spec.get("source", "")
     params = script_spec.get("params", {})
     ctx_obj = {"_source": source}

@@ -24,6 +24,8 @@ from elasticsearch_tpu.version import __version__
 def register_all(rc: RestController, node: Node) -> None:
     from elasticsearch_tpu.rest.actions_extra import register_extra
     register_extra(rc, node)
+    from elasticsearch_tpu.rest.actions_script import register_script
+    register_script(rc, node)
     # ------------------------------------------------------------------ root
     def root(req):
         return 200, {

@@ -1,0 +1,84 @@
+"""REST handlers for scripting: stored scripts + search templates.
+
+Reference handlers: `rest/action/admin/cluster/RestPutStoredScriptAction`
+(PUT `_scripts/{id}`), `RestGetStoredScriptAction`,
+`RestDeleteStoredScriptAction`, and lang-mustache's
+`RestSearchTemplateAction` (`_search/template`), `RestRenderSearchTemplateAction`
+(`_render/template`), `RestMultiSearchTemplateAction` (`_msearch/template`).
+"""
+
+from __future__ import annotations
+
+import json
+
+from elasticsearch_tpu.common.errors import ParsingError, SearchEngineError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+
+
+def register_script(rc: RestController, node: Node) -> None:
+    # ------------------------------------------------------- stored scripts
+    def put_script(req):
+        node.scripts.put_stored(req.params["id"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def get_script(req):
+        script = node.scripts.get_stored(req.params["id"])
+        return 200, {"_id": req.params["id"], "found": True,
+                     "script": script.to_dict()}
+
+    def delete_script(req):
+        node.scripts.delete_stored(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    rc.register("PUT", "/_scripts/{id}", put_script)
+    rc.register("POST", "/_scripts/{id}", put_script)
+    rc.register("GET", "/_scripts/{id}", get_script)
+    rc.register("DELETE", "/_scripts/{id}", delete_script)
+
+    # ------------------------------------------------------ search templates
+    def search_template(req):
+        body = req.json() or {}
+        rendered = node.scripts.render_template(body)
+        index = req.params.get("index")
+        if body.get("explain"):
+            rendered["explain"] = True
+        result = node.search(index, rendered)
+        return 200, result
+
+    def render_template(req):
+        body = req.json() or {}
+        if "id" in req.params and "id" not in body:
+            body["id"] = req.params["id"]
+        return 200, {"template_output": node.scripts.render_template(body)}
+
+    def msearch_template(req):
+        # NDJSON body: alternating header / template lines, like _msearch
+        # (reference: RestMultiSearchTemplateAction).
+        lines = req.ndjson()
+        if len(lines) % 2 != 0:
+            raise ParsingError("_msearch/template expects header/body line pairs")
+        responses = []
+        for i in range(0, len(lines), 2):
+            header = lines[i]
+            tmpl = lines[i + 1]
+            index = header.get("index") or req.params.get("index")
+            try:
+                rendered = node.scripts.render_template(tmpl)
+                responses.append({**node.search(index, rendered), "status": 200})
+            except SearchEngineError as e:  # per-item failure, like _msearch
+                responses.append({"error": e.to_dict(), "status": e.status})
+        return 200, {"responses": responses}
+
+    rc.register("GET", "/_search/template", search_template)
+    rc.register("POST", "/_search/template", search_template)
+    rc.register("GET", "/{index}/_search/template", search_template)
+    rc.register("POST", "/{index}/_search/template", search_template)
+    rc.register("GET", "/_render/template", render_template)
+    rc.register("POST", "/_render/template", render_template)
+    rc.register("GET", "/_render/template/{id}", render_template)
+    rc.register("POST", "/_render/template/{id}", render_template)
+    rc.register("GET", "/_msearch/template", msearch_template)
+    rc.register("POST", "/_msearch/template", msearch_template)
+    rc.register("GET", "/{index}/_msearch/template", msearch_template)
+    rc.register("POST", "/{index}/_msearch/template", msearch_template)

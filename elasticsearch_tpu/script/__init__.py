@@ -1,0 +1,10 @@
+"""Scripting subsystem: ScriptService, stored scripts, mustache templates.
+
+Reference layers: `server/.../script/` (ScriptService, Script, contexts),
+`modules/lang-painless` (expression engine — here `search/script_score.py`),
+`modules/lang-mustache` (search templates — here `script/mustache.py`).
+"""
+
+from elasticsearch_tpu.script.service import ScriptService, StoredScript
+
+__all__ = ["ScriptService", "StoredScript"]

@@ -1,0 +1,170 @@
+"""ScriptService: stored scripts + language dispatch.
+
+Reference: `server/src/main/java/org/elasticsearch/script/ScriptService.java:62`
+— stored scripts live in cluster state (`StoredScriptSource`), are addressed
+by id from any `"script": {"id": ...}` spec, and compile through per-language
+engines (painless, mustache, expression). Here the two engines are the
+painless-lite expression evaluator (`search/script_score.py`) and the
+mustache renderer (`script/mustache.py`); `resolve()` is the single entry
+that turns any script spec (inline `source` / stored `id`) into a concrete
+source + params, which every call-site (script_score, script fields, ingest
+script processor, update-by-script, search templates) funnels through.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    ParsingError,
+    ResourceNotFoundError,
+)
+
+#: languages the service accepts; "painless" is the default like the
+#: reference's Script.DEFAULT_SCRIPT_LANG.
+SUPPORTED_LANGS = ("painless", "mustache", "expression")
+
+
+class StoredScript:
+    def __init__(self, lang: str, source: str, options: Optional[dict] = None):
+        if lang not in SUPPORTED_LANGS:
+            raise IllegalArgumentError(f"unable to parse unsupported lang [{lang}]")
+        self.lang = lang
+        self.source = source
+        self.options = options or {}
+
+    def to_dict(self) -> dict:
+        return {"lang": self.lang, "source": self.source}
+
+
+class ScriptService:
+    """Stored-script registry + spec resolution.
+
+    The reference persists stored scripts in cluster-state metadata
+    (`ScriptMetaData`), replicated to every node and written to the gateway
+    state store. Here the registry is process-wide (the single-process analog
+    of replicated cluster state) and persists to a JSON file under the data
+    path of the most recently constructed node (every node attaches, so in a
+    multi-node process each write lands in the latest node's state dir).
+    """
+
+    def __init__(self):
+        self._stored: Dict[str, StoredScript] = {}
+        self.compilations = 0
+        self._path: Optional[str] = None
+
+    def attach_storage(self, path: str) -> None:
+        """Load persisted scripts and persist future changes to `path`.
+        Mirrors GatewayMetaState recovering ScriptMetaData on node boot."""
+        import json
+        import os
+        self._path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                for sid, spec in json.load(f).items():
+                    self._stored.setdefault(
+                        sid, StoredScript(spec["lang"], spec["source"]))
+
+    def _persist(self) -> None:
+        if self._path is None:
+            return
+        import json
+        import os
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        with open(self._path, "w") as f:
+            json.dump(self.list_stored(), f)
+
+    def clear(self) -> None:
+        """Drop all stored scripts (test isolation helper)."""
+        self._stored.clear()
+
+    # -- stored scripts API (`_scripts/{id}`) --------------------------------
+    def put_stored(self, script_id: str, body: dict) -> None:
+        spec = body.get("script")
+        if not isinstance(spec, dict) or "source" not in spec:
+            raise ParsingError("stored script must define [script.source]")
+        lang = spec.get("lang", "painless")
+        source = spec["source"]
+        if not isinstance(source, str):
+            import json
+            source = json.dumps(source)
+        script = StoredScript(lang, source)
+        self._compile_check(script)
+        self._stored[script_id] = script
+        self._persist()
+
+    def get_stored(self, script_id: str) -> StoredScript:
+        if script_id not in self._stored:
+            raise ResourceNotFoundError(f"stored script [{script_id}] not found")
+        return self._stored[script_id]
+
+    def delete_stored(self, script_id: str) -> None:
+        if script_id not in self._stored:
+            raise ResourceNotFoundError(f"stored script [{script_id}] not found")
+        del self._stored[script_id]
+        self._persist()
+
+    def list_stored(self) -> Dict[str, dict]:
+        return {k: v.to_dict() for k, v in self._stored.items()}
+
+    def _compile_check(self, script: StoredScript) -> None:
+        """Compile at store time, like the reference (`putStoredScript`
+        compiles against every context to surface errors early)."""
+        self.compilations += 1
+        if script.lang == "mustache":
+            from elasticsearch_tpu.script import mustache
+            mustache._Parser(script.source).parse()
+        else:
+            import ast
+            try:
+                ast.parse(script.source, mode="eval")
+            except SyntaxError:
+                # multi-statement update/ingest scripts are exec-mode
+                try:
+                    ast.parse(_strip_semicolons(script.source), mode="exec")
+                except SyntaxError as e:
+                    raise ParsingError(f"compile error: {e}")
+
+    # -- spec resolution ------------------------------------------------------
+    def resolve(self, spec: Any) -> dict:
+        """Turn any `"script"` value (str | {source}|{id}) into
+        {"lang", "source", "params"}."""
+        if isinstance(spec, str):
+            return {"lang": "painless", "source": spec, "params": {}}
+        if not isinstance(spec, dict):
+            raise ParsingError("script must be a string or object")
+        params = spec.get("params", {})
+        if "id" in spec:
+            stored = self.get_stored(spec["id"])
+            return {"lang": stored.lang, "source": stored.source, "params": params}
+        if "source" not in spec:
+            raise ParsingError("script must define [source] or [id]")
+        return {"lang": spec.get("lang", "painless"),
+                "source": spec["source"], "params": params}
+
+    # -- search templates -----------------------------------------------------
+    def render_template(self, body: dict) -> dict:
+        """`_render/template` / `_search/template`: resolve source (inline or
+        stored id) and mustache-render with params into a search body."""
+        from elasticsearch_tpu.script import mustache
+        params = body.get("params", {})
+        if "id" in body:
+            stored = self.get_stored(body["id"])
+            source = stored.source
+        else:
+            source = body.get("source")
+            if source is None:
+                raise ParsingError("search template must define [source] or [id]")
+        return mustache.render_search_template(source, params)
+
+
+def _strip_semicolons(source: str) -> str:
+    """Painless statements end with `;` — normalize to Python exec form."""
+    return "\n".join(s.strip() for s in source.split(";") if s.strip())
+
+
+#: Cluster-wide stored-script registry. The reference keeps stored scripts in
+#: cluster-state metadata replicated to every node; a process-global registry
+#: is the single-process analog, shared by all in-process nodes of a cluster.
+GLOBAL_SCRIPTS = ScriptService()

@@ -267,6 +267,18 @@ class Script:
     def __init__(self, spec: Any):
         if isinstance(spec, str):
             spec = {"source": spec}
+        if isinstance(spec, dict) and "id" in spec and "source" not in spec:
+            # stored-script reference — resolved against the cluster-wide
+            # registry (reference: ScriptService looks up ScriptMetaData
+            # from cluster state at compile time)
+            from elasticsearch_tpu.script.service import GLOBAL_SCRIPTS
+            resolved = GLOBAL_SCRIPTS.resolve(spec)
+            if resolved["lang"] == "mustache":
+                raise IllegalArgumentError(
+                    f"stored script [{spec['id']}] is a [mustache] template, "
+                    "not usable in this context")
+            spec = {"source": resolved["source"],
+                    "params": spec.get("params", {})}
         if not isinstance(spec, dict) or "source" not in spec:
             raise ParsingError("script must define [source]")
         self.source = spec["source"]

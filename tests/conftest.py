@@ -16,3 +16,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _isolate_stored_scripts():
+    """GLOBAL_SCRIPTS is the process-wide cluster-state analog; clear it
+    between tests so stored scripts don't leak across test cases."""
+    yield
+    from elasticsearch_tpu.script.service import GLOBAL_SCRIPTS
+    GLOBAL_SCRIPTS.clear()
+    GLOBAL_SCRIPTS._path = None

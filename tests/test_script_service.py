@@ -1,0 +1,195 @@
+"""Scripting subsystem: stored scripts, search templates, mustache engine.
+
+Reference behavior: `script/ScriptService.java` (stored scripts),
+`modules/lang-mustache` (search templates), stored-script use inside
+script_score specs (`Script.java` id resolution).
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.script import mustache
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = b""
+        if body is not None:
+            if isinstance(body, (list, tuple)):
+                raw = b"\n".join(json.dumps(l).encode() for l in body) + b"\n"
+            else:
+                raw = json.dumps(body).encode()
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+def _seed(client):
+    for i, (name, price) in enumerate(
+            [("red shirt", 10), ("blue shirt", 25), ("green hat", 5)]):
+        client.req("PUT", f"/products/_doc/{i}",
+                   {"name": name, "price": price})
+    client.req("POST", "/products/_refresh")
+
+
+# ---------------------------------------------------------------- mustache
+
+def test_mustache_variables_and_sections():
+    assert mustache.render("hello {{name}}", {"name": "world"}) == "hello world"
+    assert mustache.render("{{#xs}}[{{.}}]{{/xs}}", {"xs": [1, 2]}) == "[1][2]"
+    assert mustache.render("{{^xs}}empty{{/xs}}", {"xs": []}) == "empty"
+    assert mustache.render("{{a.b}}", {"a": {"b": 3}}) == "3"
+    assert mustache.render("{{! comment }}x", {}) == "x"
+
+
+def test_mustache_to_json_and_join():
+    out = mustache.render('{"ids": {{#toJson}}ids{{/toJson}}}', {"ids": [1, 2]})
+    assert json.loads(out) == {"ids": [1, 2]}
+    assert mustache.render("{{#join}}tags{{/join}}",
+                           {"tags": ["a", "b"]}) == "a,b"
+
+
+def test_render_search_template_conditional():
+    src = ('{"query": {"bool": {"must": {"match": {"name": "{{q}}"}}'
+           '{{#min_price}}, "filter": {"range": {"price": '
+           '{"gte": {{min_price}}}}}{{/min_price}} }}}')
+    with_filter = mustache.render_search_template(src, {"q": "shirt",
+                                                        "min_price": 20})
+    assert "filter" in with_filter["query"]["bool"]
+    without = mustache.render_search_template(src, {"q": "shirt"})
+    assert "filter" not in without["query"]["bool"]
+
+
+# ---------------------------------------------------------- stored scripts
+
+def test_stored_script_crud(client):
+    st, body = client.req("PUT", "/_scripts/my-calc",
+                          {"script": {"lang": "painless",
+                                      "source": "doc['price'].value * 2"}})
+    assert st == 200 and body["acknowledged"]
+    st, body = client.req("GET", "/_scripts/my-calc")
+    assert body["found"] and body["script"]["source"] == "doc['price'].value * 2"
+    st, _ = client.req("DELETE", "/_scripts/my-calc")
+    assert st == 200
+    st, _ = client.req("GET", "/_scripts/my-calc")
+    assert st == 404
+
+
+def test_stored_script_compile_error(client):
+    st, body = client.req("PUT", "/_scripts/bad",
+                          {"script": {"lang": "painless", "source": "1 +*/ 2"}})
+    assert st == 400
+
+
+def test_script_score_with_stored_id(client):
+    _seed(client)
+    client.req("PUT", "/_scripts/price-boost",
+               {"script": {"lang": "painless",
+                           "source": "doc['price'].value * params.f"}})
+    st, body = client.req("POST", "/products/_search", {
+        "query": {"script_score": {"query": {"match_all": {}},
+                                   "script": {"id": "price-boost",
+                                              "params": {"f": 2}}}}})
+    assert st == 200
+    hits = body["hits"]["hits"]
+    assert hits[0]["_score"] == 50.0  # price 25 * 2
+
+
+# --------------------------------------------------------- search template
+
+def test_search_template_inline(client):
+    _seed(client)
+    st, body = client.req("POST", "/products/_search/template", {
+        "source": {"query": {"match": {"name": "{{q}}"}}},
+        "params": {"q": "shirt"}})
+    assert st == 200
+    assert body["hits"]["total"]["value"] == 2
+
+
+def test_search_template_stored(client):
+    _seed(client)
+    client.req("PUT", "/_scripts/find-by-name",
+               {"script": {"lang": "mustache",
+                           "source": '{"query": {"match": {"name": "{{q}}"}}}'}})
+    st, body = client.req("POST", "/products/_search/template",
+                          {"id": "find-by-name", "params": {"q": "hat"}})
+    assert st == 200
+    assert body["hits"]["total"]["value"] == 1
+
+
+def test_render_template(client):
+    client.req("PUT", "/_scripts/tpl",
+               {"script": {"lang": "mustache",
+                           "source": '{"size": {{n}}}'}})
+    st, body = client.req("POST", "/_render/template/tpl", {"params": {"n": 5}})
+    assert body["template_output"] == {"size": 5}
+
+
+def test_msearch_template(client):
+    _seed(client)
+    st, body = client.req("POST", "/_msearch/template", [
+        {"index": "products"},
+        {"source": {"query": {"match": {"name": "{{q}}"}}},
+         "params": {"q": "shirt"}},
+        {"index": "products"},
+        {"source": {"query": {"match_all": {}}}, "params": {}},
+    ])
+    assert st == 200
+    assert body["responses"][0]["hits"]["total"]["value"] == 2
+    assert body["responses"][1]["hits"]["total"]["value"] == 3
+
+
+def test_update_with_stored_script(client, node):
+    _seed(client)
+    client.req("PUT", "/_scripts/bump",
+               {"script": {"lang": "painless",
+                           "source": "ctx._source.price += params.n"}})
+    st, body = client.req("POST", "/products/_update/0",
+                          {"script": {"id": "bump", "params": {"n": 7}}})
+    assert st == 200
+    _, doc = client.req("GET", "/products/_doc/0")
+    assert doc["_source"]["price"] == 17
+
+
+def test_stored_mustache_rejected_in_score_context(client):
+    _seed(client)
+    client.req("PUT", "/_scripts/tpl2",
+               {"script": {"lang": "mustache", "source": '{"a": 1}'}})
+    st, body = client.req("POST", "/products/_search", {
+        "query": {"script_score": {"query": {"match_all": {}},
+                                   "script": {"id": "tpl2"}}}})
+    assert st == 400
+
+
+def test_stored_scripts_persist_across_restart(tmp_path):
+    from elasticsearch_tpu.script.service import GLOBAL_SCRIPTS
+    n1 = Node(str(tmp_path / "data"))
+    c1 = Client(n1)
+    c1.req("PUT", "/_scripts/persisted",
+           {"script": {"lang": "painless", "source": "1 + 1"}})
+    n1.close()
+    GLOBAL_SCRIPTS.clear()   # simulate process restart
+    n2 = Node(str(tmp_path / "data"))
+    c2 = Client(n2)
+    st, body = c2.req("GET", "/_scripts/persisted")
+    assert st == 200 and body["script"]["source"] == "1 + 1"
+    n2.close()
