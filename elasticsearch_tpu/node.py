@@ -78,7 +78,8 @@ class _MultiShardVectorStore:
 
 class Node:
     def __init__(self, data_path: str, node_name: str = "node-0",
-                 cluster_name: str = "tpu-search"):
+                 cluster_name: str = "tpu-search",
+                 settings: Optional[dict] = None):
         from elasticsearch_tpu.ingest.service import IngestService
         from elasticsearch_tpu.node_admin import (
             AsyncSearchService, ScrollService, TaskManager, TemplateService,
@@ -98,6 +99,13 @@ class Node:
         import os as _os
         self.scripts.attach_storage(_os.path.join(data_path, "_state",
                                                   "stored_scripts.json"))
+        self.settings = settings or {}
+        from elasticsearch_tpu.security import SecurityService, SecurityStore
+        self.security = SecurityService(
+            SecurityStore(_os.path.join(data_path, "_state", "security.json")),
+            enabled=bool(self.settings.get("xpack.security.enabled", False)),
+            bootstrap_password=str(
+                self.settings.get("bootstrap.password", "changeme")))
         from elasticsearch_tpu.snapshots.service import SnapshotService
         self.snapshots = SnapshotService(self)
         self.start_time = time.time()

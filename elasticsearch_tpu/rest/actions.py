@@ -26,6 +26,11 @@ def register_all(rc: RestController, node: Node) -> None:
     register_extra(rc, node)
     from elasticsearch_tpu.rest.actions_script import register_script
     register_script(rc, node)
+    from elasticsearch_tpu.security.rest_filter import (
+        make_security_filter, register_security,
+    )
+    register_security(rc, node)
+    rc.add_filter(make_security_filter(node.security))
     # ------------------------------------------------------------------ root
     def root(req):
         return 200, {

@@ -22,13 +22,16 @@ from elasticsearch_tpu.common.errors import (
 class RestRequest:
     def __init__(self, method: str, path: str, params: Dict[str, str],
                  query: Dict[str, str], body: bytes,
-                 content_type: Optional[str] = None):
+                 content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
         self.method = method
         self.path = path
         self.params = params          # path template params
         self.query = query            # query-string args
         self.raw_body = body
         self.content_type = content_type
+        self.headers = headers or {}  # lower-cased header names
+        self.context: Dict[str, Any] = {}  # filter-populated (e.g. auth)
 
     def json(self) -> Any:
         if not self.raw_body:
@@ -82,6 +85,7 @@ class _TrieNode:
 class RestController:
     def __init__(self):
         self._root = _TrieNode()
+        self._filters: List[Any] = []
 
     def register(self, method: str, template: str, handler: Handler) -> None:
         node = self._root
@@ -117,8 +121,17 @@ class RestController:
 
         return walk(self._root, 0, {})
 
+    def add_filter(self, f) -> None:
+        """Install a pre-handler filter (reference: SecurityRestFilter wraps
+        every handler via RestController). A filter receives the RestRequest
+        and either returns None (continue) or a (status, body) short-circuit
+        response; it may mutate the request (e.g. rewrite the body for
+        document-level security)."""
+        self._filters.append(f)
+
     def dispatch(self, method: str, path: str, query: Dict[str, str],
-                 body: bytes, content_type: Optional[str] = None) -> Tuple[int, Any]:
+                 body: bytes, content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         try:
             node, params = self._resolve(path)
             if node is None:
@@ -128,14 +141,24 @@ class RestController:
             handler = node.handlers.get(method.upper())
             if handler is None:
                 if method.upper() == "HEAD" and "GET" in node.handlers:
-                    status, _ = node.handlers["GET"](
-                        RestRequest("HEAD", path, params, query, body, content_type))
+                    req = RestRequest("HEAD", path, params, query, body,
+                                      content_type, headers)
+                    for f in self._filters:
+                        short = f(req)
+                        if short is not None:
+                            return short[0], None
+                    status, _ = node.handlers["GET"](req)
                     return status, None
                 allowed = ", ".join(sorted(node.handlers))
                 return 405, _error_body(
                     "method_not_allowed_exception",
                     f"Incorrect HTTP method for uri [{path}], allowed: [{allowed}]", 405)
-            req = RestRequest(method.upper(), path, params, query, body, content_type)
+            req = RestRequest(method.upper(), path, params, query, body,
+                              content_type, headers)
+            for f in self._filters:
+                short = f(req)
+                if short is not None:
+                    return short
             return handler(req)
         except SearchEngineError as e:
             return e.status, {"error": {**e.to_dict(),

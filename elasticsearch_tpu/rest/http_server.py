@@ -52,7 +52,7 @@ class HttpServer:
                 loop = asyncio.get_running_loop()
                 status, payload = await loop.run_in_executor(
                     self._pool, self.controller.dispatch, method, path, query,
-                    body, headers.get("content-type"))
+                    body, headers.get("content-type"), headers)
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
                 await self._write_response(writer, status, payload, keep_alive)
                 if not keep_alive:

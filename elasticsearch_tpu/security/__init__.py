@@ -1,0 +1,17 @@
+"""Security subsystem: authn realms (native users, API keys), RBAC authz,
+document/field-level security, audit trail.
+
+Reference: `x-pack/plugin/security` (§2.11) — composes onto the REST layer
+via a filter without touching it.
+"""
+
+from elasticsearch_tpu.security.service import (
+    Authentication,
+    AuthenticationError,
+    AuthorizationError,
+    SecurityService,
+)
+from elasticsearch_tpu.security.store import SecurityStore
+
+__all__ = ["Authentication", "AuthenticationError", "AuthorizationError",
+           "SecurityService", "SecurityStore"]

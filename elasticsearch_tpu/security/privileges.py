@@ -1,0 +1,191 @@
+"""Privilege model + route → required-privilege classification.
+
+Reference: `x-pack/plugin/core/.../security/authz/privilege/ClusterPrivilege.java`
+and `IndexPrivilege.java` define named privilege sets; `RBACEngine` checks a
+request's action name against them. Here REST routes are classified directly
+(the single-process analog of action-name matching).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import FrozenSet, List, Optional, Tuple
+
+# -- cluster privileges (subset of ClusterPrivilege.java's registry) ---------
+CLUSTER_ALL = "all"
+CLUSTER_MONITOR = "monitor"
+CLUSTER_MANAGE = "manage"
+CLUSTER_MANAGE_SECURITY = "manage_security"
+CLUSTER_MANAGE_ILM = "manage_ilm"
+CLUSTER_MANAGE_PIPELINE = "manage_pipeline"
+CLUSTER_MANAGE_WATCHER = "manage_watcher"
+CLUSTER_MANAGE_ML = "manage_ml"
+CLUSTER_MANAGE_TRANSFORM = "manage_transform"
+CLUSTER_MANAGE_CCR = "manage_ccr"
+CLUSTER_MANAGE_ROLLUP = "manage_rollup"
+
+#: which named cluster privileges imply which others
+_CLUSTER_IMPLIES = {
+    CLUSTER_ALL: {CLUSTER_MONITOR, CLUSTER_MANAGE, CLUSTER_MANAGE_SECURITY,
+                  CLUSTER_MANAGE_ILM, CLUSTER_MANAGE_PIPELINE,
+                  CLUSTER_MANAGE_WATCHER, CLUSTER_MANAGE_ML,
+                  CLUSTER_MANAGE_TRANSFORM, CLUSTER_MANAGE_CCR,
+                  CLUSTER_MANAGE_ROLLUP},
+    CLUSTER_MANAGE: {CLUSTER_MONITOR, CLUSTER_MANAGE_ILM,
+                     CLUSTER_MANAGE_PIPELINE, CLUSTER_MANAGE_ROLLUP},
+}
+
+# -- index privileges (IndexPrivilege.java) ----------------------------------
+IDX_ALL = "all"
+IDX_READ = "read"
+IDX_WRITE = "write"
+IDX_INDEX = "index"
+IDX_CREATE = "create"
+IDX_DELETE = "delete"
+IDX_CREATE_INDEX = "create_index"
+IDX_DELETE_INDEX = "delete_index"
+IDX_MANAGE = "manage"
+IDX_VIEW_METADATA = "view_index_metadata"
+IDX_MONITOR = "monitor"
+
+_INDEX_IMPLIES = {
+    IDX_ALL: {IDX_READ, IDX_WRITE, IDX_INDEX, IDX_CREATE, IDX_DELETE,
+              IDX_CREATE_INDEX, IDX_DELETE_INDEX, IDX_MANAGE,
+              IDX_VIEW_METADATA, IDX_MONITOR},
+    IDX_WRITE: {IDX_INDEX, IDX_CREATE, IDX_DELETE},
+    IDX_MANAGE: {IDX_CREATE_INDEX, IDX_DELETE_INDEX, IDX_VIEW_METADATA,
+                 IDX_MONITOR},
+}
+
+
+def expand_cluster(privs) -> FrozenSet[str]:
+    out = set(privs)
+    for p in list(out):
+        out |= _CLUSTER_IMPLIES.get(p, set())
+    return frozenset(out)
+
+
+def expand_index(privs) -> FrozenSet[str]:
+    out = set(privs)
+    for p in list(out):
+        out |= _INDEX_IMPLIES.get(p, set())
+    return frozenset(out)
+
+
+def index_pattern_matches(patterns: List[str], index: str) -> bool:
+    return any(fnmatch.fnmatchcase(index, p) for p in patterns)
+
+
+class RouteRequirement:
+    """What a request needs: either a cluster privilege, or an index
+    privilege on each target index."""
+
+    def __init__(self, cluster: Optional[str] = None,
+                 index_priv: Optional[str] = None,
+                 indices: Optional[List[str]] = None):
+        self.cluster = cluster
+        self.index_priv = index_priv
+        self.indices = indices or []
+
+
+# path-prefix → cluster privilege. Checked before index classification.
+_CLUSTER_ROUTES: List[Tuple[str, str]] = [
+    ("_security", CLUSTER_MANAGE_SECURITY),
+    ("_ilm", CLUSTER_MANAGE_ILM),
+    ("_slm", CLUSTER_MANAGE_ILM),
+    ("_ingest", CLUSTER_MANAGE_PIPELINE),
+    ("_watcher", CLUSTER_MANAGE_WATCHER),
+    ("_ml", CLUSTER_MANAGE_ML),
+    ("_transform", CLUSTER_MANAGE_TRANSFORM),
+    ("_ccr", CLUSTER_MANAGE_CCR),
+    ("_rollup", CLUSTER_MANAGE_ROLLUP),
+    ("_snapshot", CLUSTER_MANAGE),
+    ("_scripts", CLUSTER_MANAGE),
+    ("_template", CLUSTER_MANAGE),
+    ("_index_template", CLUSTER_MANAGE),
+    ("_cluster", CLUSTER_MONITOR),
+    ("_nodes", CLUSTER_MONITOR),
+    ("_cat", CLUSTER_MONITOR),
+    ("_tasks", CLUSTER_MONITOR),
+    ("_remote", CLUSTER_MONITOR),
+]
+
+#: index-API suffixes that only read
+_READ_SUFFIXES = {"_search", "_count", "_msearch", "_mget", "_explain",
+                  "_field_caps", "_validate", "_rank_eval", "_termvectors",
+                  "_source", "_analyze", "_search/template", "_msearch/template",
+                  "_async_search", "_graph", "_eql", "_pit", "_knn_search"}
+#: suffixes that write documents
+_WRITE_SUFFIXES = {"_doc", "_create", "_update", "_bulk", "_update_by_query",
+                   "_delete_by_query"}
+#: suffixes that manage the index
+_MANAGE_SUFFIXES = {"_mapping", "_settings", "_alias", "_aliases", "_refresh",
+                    "_flush", "_forcemerge", "_open", "_close", "_rollover",
+                    "_shrink", "_split", "_clone", "_freeze", "_unfreeze"}
+#: suffixes that only view metadata / stats
+_MONITOR_SUFFIXES = {"_stats", "_segments", "_recovery", "_shard_stores"}
+
+# cluster-level read endpoints that fan out over indices (no {index} in path)
+_GLOBAL_READ_PREFIXES = {"_search", "_count", "_msearch", "_mget",
+                         "_field_caps", "_rank_eval", "_render", "_async_search",
+                         "_eql", "_sql", "_validate", "_analyze", "_aliases",
+                         "_alias", "_mapping", "_settings", "_resolve",
+                         "_reindex", "_scripts"}
+
+
+def classify(method: str, path: str,
+             index_param: Optional[str]) -> RouteRequirement:
+    """Map a request to its required privilege.
+
+    Reference analog: each TransportAction's name (`indices:data/read/search`,
+    `cluster:admin/...`) determines the privilege; here the REST route shape
+    does, which the 124-handler surface makes 1:1.
+    """
+    segs = [s for s in path.split("/") if s]
+    if not segs:
+        return RouteRequirement(cluster=CLUSTER_MONITOR)
+    if index_param is None and segs[0].startswith("_"):
+        # any authenticated principal may introspect itself / change its own
+        # password (reference: RestAuthenticateAction and
+        # RestChangePasswordAction run as the current user)
+        if path.rstrip("/").endswith("_security/_authenticate") or \
+                path.rstrip("/").endswith("_security/user/_password"):
+            return RouteRequirement(index_priv=None, indices=[])
+        for prefix, priv in _CLUSTER_ROUTES:
+            if segs[0] == prefix:
+                return RouteRequirement(cluster=priv)
+        if segs[0] == "_reindex":
+            # reindex touches source+dest; conservatively require write on all
+            return RouteRequirement(index_priv=IDX_WRITE, indices=["*"])
+        # global search-ish endpoints read across all indices
+        if segs[0] in _GLOBAL_READ_PREFIXES or segs[0] in _READ_SUFFIXES:
+            if method in ("PUT", "POST", "DELETE") and segs[0] in (
+                    "_aliases", "_settings", "_scripts"):
+                return RouteRequirement(cluster=CLUSTER_MANAGE)
+            return RouteRequirement(index_priv=IDX_READ, indices=["*"])
+        if segs[0] == "_bulk":
+            return RouteRequirement(index_priv=IDX_WRITE, indices=["*"])
+        return RouteRequirement(cluster=CLUSTER_MONITOR)
+
+    indices = (index_param or "*").split(",")
+    api = next((s for s in segs if s.startswith("_")), None)
+    if api is None:
+        # bare /{index} — index admin (create/delete/get)
+        if method == "PUT":
+            return RouteRequirement(index_priv=IDX_CREATE_INDEX, indices=indices)
+        if method == "DELETE":
+            return RouteRequirement(index_priv=IDX_DELETE_INDEX, indices=indices)
+        return RouteRequirement(index_priv=IDX_VIEW_METADATA, indices=indices)
+    if api in _WRITE_SUFFIXES:
+        if api == "_doc" and method in ("GET", "HEAD"):
+            return RouteRequirement(index_priv=IDX_READ, indices=indices)
+        return RouteRequirement(index_priv=IDX_WRITE, indices=indices)
+    if api in _READ_SUFFIXES:
+        return RouteRequirement(index_priv=IDX_READ, indices=indices)
+    if api in _MANAGE_SUFFIXES:
+        if method in ("GET", "HEAD"):
+            return RouteRequirement(index_priv=IDX_VIEW_METADATA, indices=indices)
+        return RouteRequirement(index_priv=IDX_MANAGE, indices=indices)
+    if api in _MONITOR_SUFFIXES:
+        return RouteRequirement(index_priv=IDX_MONITOR, indices=indices)
+    return RouteRequirement(index_priv=IDX_READ, indices=indices)

@@ -1,0 +1,178 @@
+"""Security REST integration: the filter + `_security/*` endpoints.
+
+Reference: `SecurityRestFilter.java:30` wraps every REST handler (authn),
+`SecurityActionFilter.java:42` authorizes; the `_security` API handlers live
+in `x-pack/plugin/security/.../rest/action/`. DLS/FLS composes by rewriting
+the search body before the handler parses it — the single-process analog of
+`SecurityIndexSearcherWrapper` wrapping the shard searcher.
+"""
+
+from __future__ import annotations
+
+import json
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.security.service import (
+    Authentication,
+    SecurityService,
+)
+
+#: paths reachable without credentials (reference: `RestRequestFilter`
+#: allowlist — only the root banner and _security/_authenticate error path)
+_ANONYMOUS_PATHS = set()
+
+
+def make_security_filter(svc: SecurityService):
+    def security_filter(req: RestRequest):
+        if not svc.enabled:
+            return None
+        auth = svc.authenticate(req.headers)   # raises 401 → controller renders
+        req.context["authentication"] = auth
+        index_param = req.params.get("index")
+        svc.authorize(auth, req.method, req.path, index_param)
+        _maybe_rewrite_for_dls_fls(svc, auth, req, index_param)
+        return None
+    return security_filter
+
+
+def _maybe_rewrite_for_dls_fls(svc: SecurityService, auth: Authentication,
+                               req: RestRequest, index_param) -> None:
+    if auth.is_superuser or index_param is None:
+        return
+    is_search = req.path.endswith(("_search", "_count", "_async_search")) or \
+        "_search/template" in req.path
+    if not is_search:
+        return
+    indices = index_param.split(",")
+    # restrictions are per-index; for multi-index requests apply the union
+    # of each index's rewrite only when all indices share the restrictions
+    body = {}
+    if req.raw_body:
+        try:
+            body = json.loads(req.raw_body)
+        except ValueError:
+            return
+    rewritten = body
+    for index in indices:
+        rewritten = svc.rewrite_search_body(auth, index, rewritten)
+    if rewritten is not body:
+        req.raw_body = json.dumps(rewritten).encode()
+
+
+def register_security(rc: RestController, node) -> None:
+    svc: SecurityService = node.security
+
+    def authenticate(req):
+        auth: Authentication = req.context.get("authentication")
+        if auth is None:
+            # security disabled: report the anonymous built-in like the
+            # reference does with a disabled realm chain
+            return 200, {"username": "_anonymous", "roles": ["superuser"],
+                         "authentication_type": "anonymous", "enabled": True}
+        return 200, {"username": auth.username, "roles": auth.role_names,
+                     "authentication_type": auth.auth_type, "enabled": True}
+
+    rc.register("GET", "/_security/_authenticate", authenticate)
+
+    # ------------------------------------------------------------- users
+    def put_user(req):
+        created = svc.store.put_user(req.params["name"], req.json() or {})
+        return 200, {"created": created}
+
+    def get_user(req):
+        name = req.params.get("name")
+        if name:
+            return 200, {name: svc.store.get_user(name)}
+        return 200, {n: svc.store.get_user(n) for n in svc.store.users}
+
+    def delete_user(req):
+        svc.store.delete_user(req.params["name"])
+        return 200, {"found": True}
+
+    def change_password(req):
+        body = req.json() or {}
+        pw = body.get("password")
+        if not pw:
+            raise IllegalArgumentError("password is required")
+        name = req.params.get("name")
+        if name is None:
+            auth = req.context.get("authentication")
+            if auth is None:
+                raise IllegalArgumentError("no user in context")
+            name = auth.username
+        svc.store.change_password(name, pw)
+        return 200, {}
+
+    def enable_user(req):
+        svc.store.set_enabled(req.params["name"], True)
+        return 200, {}
+
+    def disable_user(req):
+        svc.store.set_enabled(req.params["name"], False)
+        return 200, {}
+
+    rc.register("PUT", "/_security/user/{name}", put_user)
+    rc.register("POST", "/_security/user/{name}", put_user)
+    rc.register("GET", "/_security/user/{name}", get_user)
+    rc.register("GET", "/_security/user", get_user)
+    rc.register("DELETE", "/_security/user/{name}", delete_user)
+    rc.register("PUT", "/_security/user/{name}/_password", change_password)
+    rc.register("POST", "/_security/user/{name}/_password", change_password)
+    rc.register("PUT", "/_security/user/_password", change_password)
+    rc.register("POST", "/_security/user/_password", change_password)
+    rc.register("PUT", "/_security/user/{name}/_enable", enable_user)
+    rc.register("POST", "/_security/user/{name}/_enable", enable_user)
+    rc.register("PUT", "/_security/user/{name}/_disable", disable_user)
+    rc.register("POST", "/_security/user/{name}/_disable", disable_user)
+
+    # ------------------------------------------------------------- roles
+    def put_role(req):
+        created = svc.store.put_role(req.params["name"], req.json() or {})
+        return 200, {"role": {"created": created}}
+
+    def get_role(req):
+        name = req.params.get("name")
+        if name:
+            return 200, {name: svc.store.get_role(name)}
+        from elasticsearch_tpu.security.store import RESERVED_ROLES
+        out = dict(RESERVED_ROLES)
+        out.update(svc.store.roles)
+        return 200, out
+
+    def delete_role(req):
+        svc.store.delete_role(req.params["name"])
+        return 200, {"found": True}
+
+    rc.register("PUT", "/_security/role/{name}", put_role)
+    rc.register("POST", "/_security/role/{name}", put_role)
+    rc.register("GET", "/_security/role/{name}", get_role)
+    rc.register("GET", "/_security/role", get_role)
+    rc.register("DELETE", "/_security/role/{name}", delete_role)
+
+    # ---------------------------------------------------------- API keys
+    def create_api_key(req):
+        auth = req.context.get("authentication")
+        if auth is None:
+            # security disabled — synthesize the anonymous superuser
+            auth = Authentication("_anonymous",
+                                  [{"cluster": ["all"],
+                                    "indices": [{"names": ["*"],
+                                                 "privileges": ["all"]}]}],
+                                  ["superuser"])
+        return 200, svc.create_api_key(auth, req.json() or {})
+
+    def get_api_key(req):
+        return 200, svc.get_api_keys(key_id=req.param("id"),
+                                     owner=req.param("username"))
+
+    def invalidate_api_key(req):
+        body = req.json() or {}
+        ids = body.get("ids") or ([body["id"]] if "id" in body else None)
+        return 200, svc.invalidate_api_keys(ids=ids, name=body.get("name"),
+                                            owner=body.get("username"))
+
+    rc.register("PUT", "/_security/api_key", create_api_key)
+    rc.register("POST", "/_security/api_key", create_api_key)
+    rc.register("GET", "/_security/api_key", get_api_key)
+    rc.register("DELETE", "/_security/api_key", invalidate_api_key)

@@ -1,0 +1,276 @@
+"""SecurityService: authentication + RBAC authorization + audit.
+
+Reference composition (§2.11 "Hook mechanism"): security wraps layers 4-6
+without touching them — `SecurityRestFilter.java:30` authenticates every REST
+request, `SecurityActionFilter.java:42` authorizes the action, and the
+authenticated user propagates in thread context. Here one REST filter does
+both (the REST route is 1:1 with the action in this stack), plus request-body
+rewriting for document/field-level security.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import secrets
+import time
+from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    SearchEngineError,
+)
+from elasticsearch_tpu.security import privileges as priv
+from elasticsearch_tpu.security.store import SecurityStore, hash_password, verify_password
+
+
+class AuthenticationError(SearchEngineError):
+    status = 401
+
+    @property
+    def error_type(self) -> str:
+        return "security_exception"
+
+
+class AuthorizationError(SearchEngineError):
+    status = 403
+
+    @property
+    def error_type(self) -> str:
+        return "security_exception"
+
+
+class Authentication:
+    """The authenticated principal + its resolved roles."""
+
+    def __init__(self, username: str, roles: List[dict], role_names: List[str],
+                 auth_type: str = "realm", api_key_id: Optional[str] = None):
+        self.username = username
+        self.roles = roles
+        self.role_names = role_names
+        self.auth_type = auth_type
+        self.api_key_id = api_key_id
+
+    @property
+    def is_superuser(self) -> bool:
+        return any("all" in r.get("cluster", []) for r in self.roles)
+
+
+class SecurityService:
+    def __init__(self, store: SecurityStore, enabled: bool = True,
+                 bootstrap_password: str = "changeme"):
+        self.store = store
+        self.enabled = enabled
+        self.audit: List[dict] = []
+        # reserved superuser, like the `elastic` user bootstrapped from the
+        # keystore (`ReservedRealm.java`)
+        if "elastic" not in store.users:
+            store.users["elastic"] = {
+                "password_hash": hash_password(bootstrap_password),
+                "roles": ["superuser"], "enabled": True, "reserved": True,
+            }
+            store.persist()
+
+    # ------------------------------------------------------------ audit
+    def _audit(self, event: str, **fields) -> None:
+        self.audit.append({"ts": time.time(), "event": event, **fields})
+        if len(self.audit) > 10_000:
+            del self.audit[:5_000]
+
+    # ---------------------------------------------------------- API keys
+    def create_api_key(self, auth: Authentication, body: dict) -> dict:
+        name = body.get("name")
+        if not name:
+            raise IllegalArgumentError("api key name is required")
+        key_id = secrets.token_urlsafe(12)
+        key_secret = secrets.token_urlsafe(24)
+        expiration = body.get("expiration")
+        expires_at = None
+        if expiration:
+            from elasticsearch_tpu.common.settings import parse_time_value
+            expires_at = time.time() + parse_time_value(expiration, "expiration")
+        # role_descriptors restrict below the owner's roles; empty = inherit
+        self.store.api_keys[key_id] = {
+            "name": name,
+            "hash": hashlib.sha256(key_secret.encode()).hexdigest(),
+            "owner": auth.username,
+            "owner_roles": auth.role_names,
+            "role_descriptors": body.get("role_descriptors", {}),
+            "created": time.time(),
+            "expires_at": expires_at,
+            "invalidated": False,
+        }
+        self.store.persist()
+        self._audit("create_api_key", user=auth.username, key_id=key_id)
+        encoded = base64.b64encode(f"{key_id}:{key_secret}".encode()).decode()
+        return {"id": key_id, "name": name, "api_key": key_secret,
+                "encoded": encoded,
+                "expiration": int(expires_at * 1000) if expires_at else None}
+
+    def invalidate_api_keys(self, ids: Optional[List[str]] = None,
+                            name: Optional[str] = None,
+                            owner: Optional[str] = None) -> dict:
+        invalidated = []
+        for kid, rec in self.store.api_keys.items():
+            if rec["invalidated"]:
+                continue
+            if ids and kid not in ids:
+                continue
+            if name and rec["name"] != name:
+                continue
+            if owner and rec["owner"] != owner:
+                continue
+            if not (ids or name or owner):
+                continue
+            rec["invalidated"] = True
+            invalidated.append(kid)
+        self.store.persist()
+        return {"invalidated_api_keys": invalidated,
+                "previously_invalidated_api_keys": [], "error_count": 0}
+
+    def get_api_keys(self, key_id: Optional[str] = None,
+                     owner: Optional[str] = None) -> dict:
+        out = []
+        for kid, rec in self.store.api_keys.items():
+            if key_id and kid != key_id:
+                continue
+            if owner and rec["owner"] != owner:
+                continue
+            out.append({"id": kid, "name": rec["name"],
+                        "creation": int(rec["created"] * 1000),
+                        "invalidated": rec["invalidated"],
+                        "username": rec["owner"], "realm": "native"})
+        return {"api_keys": out}
+
+    # ------------------------------------------------------ authentication
+    def authenticate(self, headers: Dict[str, str]) -> Authentication:
+        header = headers.get("authorization", "")
+        if header.startswith("Basic "):
+            try:
+                userpass = base64.b64decode(header[6:]).decode()
+                username, _, password = userpass.partition(":")
+            except Exception:
+                raise AuthenticationError("failed to decode basic authentication header")
+            user = self.store.authenticate(username, password)
+            if user is None:
+                self._audit("authentication_failed", user=username)
+                raise AuthenticationError(
+                    f"unable to authenticate user [{username}] for REST request")
+            roles = self.store.resolve_roles(user["roles"])
+            self._audit("authentication_success", user=username)
+            return Authentication(username, roles, user["roles"])
+        if header.startswith("ApiKey "):
+            try:
+                decoded = base64.b64decode(header[7:]).decode()
+                key_id, _, key_secret = decoded.partition(":")
+            except Exception:
+                raise AuthenticationError("failed to decode API key header")
+            rec = self.store.api_keys.get(key_id)
+            if (rec is None or rec["invalidated"]
+                    or rec["hash"] != hashlib.sha256(key_secret.encode()).hexdigest()):
+                self._audit("authentication_failed", api_key_id=key_id)
+                raise AuthenticationError("unable to authenticate with provided api key")
+            if rec["expires_at"] and time.time() > rec["expires_at"]:
+                raise AuthenticationError("api key is expired")
+            if rec["role_descriptors"]:
+                roles = [
+                    {"cluster": d.get("cluster", []),
+                     "indices": d.get("indices", d.get("index", []))}
+                    for d in rec["role_descriptors"].values()
+                ]
+                role_names = list(rec["role_descriptors"].keys())
+            else:
+                roles = self.store.resolve_roles(rec["owner_roles"])
+                role_names = rec["owner_roles"]
+            self._audit("authentication_success", api_key_id=key_id)
+            return Authentication(rec["owner"], roles, role_names,
+                                  auth_type="api_key", api_key_id=key_id)
+        self._audit("anonymous_access_denied")
+        raise AuthenticationError(
+            "missing authentication credentials for REST request")
+
+    # ------------------------------------------------------- authorization
+    def authorize(self, auth: Authentication, method: str, path: str,
+                  index_param: Optional[str]) -> priv.RouteRequirement:
+        req = priv.classify(method, path, index_param)
+        if req.cluster is not None:
+            allowed = set()
+            for role in auth.roles:
+                allowed |= priv.expand_cluster(role.get("cluster", []))
+            if req.cluster not in allowed:
+                self._audit("access_denied", user=auth.username,
+                            privilege=req.cluster, path=path)
+                raise AuthorizationError(
+                    f"action [cluster:{req.cluster}] is unauthorized for user "
+                    f"[{auth.username}]")
+        else:
+            for index in req.indices:
+                if not self._index_allowed(auth, index, req.index_priv):
+                    self._audit("access_denied", user=auth.username,
+                                privilege=req.index_priv, index=index, path=path)
+                    raise AuthorizationError(
+                        f"action [indices:{req.index_priv}] is unauthorized for "
+                        f"user [{auth.username}] on index [{index}]")
+        self._audit("access_granted", user=auth.username, path=path)
+        return req
+
+    def _index_allowed(self, auth: Authentication, index: str,
+                       index_priv: str) -> bool:
+        for role in auth.roles:
+            for grant in role.get("indices", []):
+                names = grant.get("names", [])
+                if not priv.index_pattern_matches(names, index) and index != "*":
+                    continue
+                if index == "*" and names != ["*"]:
+                    # searching all indices needs a wildcard grant
+                    continue
+                if index_priv in priv.expand_index(grant.get("privileges", [])):
+                    return True
+        return False
+
+    # -------------------------------------- document/field-level security
+    def restrictions_for(self, auth: Authentication,
+                         index: str) -> Tuple[Optional[List[dict]], Optional[List[str]]]:
+        """Collect DLS queries and FLS grant patterns that apply to `index`.
+
+        Reference: `authz/accesscontrol/IndicesAccessControl` carries per-index
+        DLS queries + FLS field permissions from the matched role grants.
+        A grant with no restrictions wins (union semantics): if any matching
+        grant is unrestricted, no restriction applies.
+        """
+        dls: List[dict] = []
+        fls: List[str] = []
+        unrestricted = False
+        for role in auth.roles:
+            for grant in role.get("indices", []):
+                if not priv.index_pattern_matches(grant.get("names", []), index):
+                    continue
+                q = grant.get("query")
+                f = grant.get("field_security", {}).get("grant")
+                if q is None and f is None:
+                    unrestricted = True
+                if q is not None:
+                    dls.append(json.loads(q) if isinstance(q, str) else q)
+                if f is not None:
+                    fls.extend(f)
+        if unrestricted:
+            return None, None
+        return (dls or None), (fls or None)
+
+    def rewrite_search_body(self, auth: Authentication, index: str,
+                            body: dict) -> dict:
+        """Apply DLS (wrap query in a bool filter) and FLS (_source
+        includes) to a search body."""
+        dls, fls = self.restrictions_for(auth, index)
+        if dls is None and fls is None:
+            return body
+        body = dict(body or {})
+        if dls:
+            original = body.get("query", {"match_all": {}})
+            body["query"] = {"bool": {"must": [original],
+                                      "filter": [{"bool": {"should": dls,
+                                                           "minimum_should_match": 1}}]}}
+        if fls:
+            body["_source"] = {"includes": fls}
+        return body

@@ -1,0 +1,187 @@
+"""Native user + role stores with PBKDF2 password hashing.
+
+Reference: `x-pack/plugin/security/.../authc/esnative/NativeUsersStore.java`
+(users in the `.security` index), `authz/store/NativeRolesStore.java`,
+`ReservedRolesStore.java` (builtin roles), `authc/support/Hasher.java`
+(bcrypt/pbkdf2 — pbkdf2 here). Persistence is a JSON file under the node
+state dir, the single-process analog of the `.security` system index.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import secrets
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    ResourceNotFoundError,
+)
+
+_PBKDF2_ITERS = 5000  # reference default is 10000; lower keeps tests snappy
+
+
+def hash_password(password: str, salt: Optional[bytes] = None) -> str:
+    salt = salt or secrets.token_bytes(16)
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _PBKDF2_ITERS)
+    return "{PBKDF2}" + base64.b64encode(salt).decode() + "$" + base64.b64encode(dk).decode()
+
+
+def verify_password(password: str, hashed: str) -> bool:
+    if not hashed.startswith("{PBKDF2}"):
+        return False
+    try:
+        salt_b64, dk_b64 = hashed[len("{PBKDF2}"):].split("$", 1)
+        salt = base64.b64decode(salt_b64)
+        expect = base64.b64decode(dk_b64)
+    except Exception:
+        return False
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, _PBKDF2_ITERS)
+    return secrets.compare_digest(dk, expect)
+
+
+#: builtin roles (ReservedRolesStore.java) — superuser gets everything
+RESERVED_ROLES: Dict[str, dict] = {
+    "superuser": {
+        "cluster": ["all"],
+        "indices": [{"names": ["*"], "privileges": ["all"]}],
+    },
+    "monitoring_user": {
+        "cluster": ["monitor"],
+        "indices": [{"names": ["*"], "privileges": ["monitor"]}],
+    },
+    "viewer": {
+        "cluster": [],
+        "indices": [{"names": ["*"], "privileges": ["read", "view_index_metadata"]}],
+    },
+    "editor": {
+        "cluster": [],
+        "indices": [{"names": ["*"], "privileges": ["read", "write",
+                                                    "view_index_metadata"]}],
+    },
+}
+
+
+class SecurityStore:
+    """Users + roles + API-key records, persisted as one JSON document."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self.users: Dict[str, dict] = {}
+        self.roles: Dict[str, dict] = {}
+        self.api_keys: Dict[str, dict] = {}
+        if path and os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            self.users = data.get("users", {})
+            self.roles = data.get("roles", {})
+            self.api_keys = data.get("api_keys", {})
+
+    def persist(self) -> None:
+        if not self._path:
+            return
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        with open(self._path, "w") as f:
+            json.dump({"users": self.users, "roles": self.roles,
+                       "api_keys": self.api_keys}, f)
+
+    # -- users ---------------------------------------------------------------
+    def put_user(self, username: str, body: dict) -> bool:
+        existing = username in self.users
+        record = self.users.get(username, {})
+        if "password" in body:
+            pw = body["password"]
+            if not isinstance(pw, str) or len(pw) < 6:
+                raise IllegalArgumentError(
+                    "passwords must be at least [6] characters long")
+            record["password_hash"] = hash_password(pw)
+        elif not existing:
+            raise IllegalArgumentError("password is required for new users")
+        record["roles"] = body.get("roles", record.get("roles", []))
+        record["full_name"] = body.get("full_name", record.get("full_name"))
+        record["email"] = body.get("email", record.get("email"))
+        record["metadata"] = body.get("metadata", record.get("metadata", {}))
+        record.setdefault("enabled", True)
+        self.users[username] = record
+        self.persist()
+        return not existing
+
+    def get_user(self, username: str) -> dict:
+        if username not in self.users:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        u = self.users[username]
+        return {"username": username, "roles": u.get("roles", []),
+                "full_name": u.get("full_name"), "email": u.get("email"),
+                "metadata": u.get("metadata", {}),
+                "enabled": u.get("enabled", True)}
+
+    def delete_user(self, username: str) -> None:
+        if username not in self.users:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        del self.users[username]
+        self.persist()
+
+    def set_enabled(self, username: str, enabled: bool) -> None:
+        if username not in self.users:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        self.users[username]["enabled"] = enabled
+        self.persist()
+
+    def change_password(self, username: str, password: str) -> None:
+        if username not in self.users:
+            raise ResourceNotFoundError(f"user [{username}] not found")
+        if len(password) < 6:
+            raise IllegalArgumentError(
+                "passwords must be at least [6] characters long")
+        self.users[username]["password_hash"] = hash_password(password)
+        self.persist()
+
+    def authenticate(self, username: str, password: str) -> Optional[dict]:
+        u = self.users.get(username)
+        if u is None or not u.get("enabled", True):
+            return None
+        if not verify_password(password, u.get("password_hash", "")):
+            return None
+        return self.get_user(username)
+
+    # -- roles ---------------------------------------------------------------
+    def put_role(self, name: str, body: dict) -> bool:
+        if name in RESERVED_ROLES:
+            raise IllegalArgumentError(f"role [{name}] is reserved")
+        existing = name in self.roles
+        self.roles[name] = {
+            "cluster": body.get("cluster", []),
+            "indices": body.get("indices", []),
+            "metadata": body.get("metadata", {}),
+        }
+        self.persist()
+        return not existing
+
+    def get_role(self, name: str) -> dict:
+        if name in RESERVED_ROLES:
+            return RESERVED_ROLES[name]
+        if name not in self.roles:
+            raise ResourceNotFoundError(f"role [{name}] not found")
+        return self.roles[name]
+
+    def delete_role(self, name: str) -> None:
+        if name in RESERVED_ROLES:
+            raise IllegalArgumentError(f"role [{name}] is reserved")
+        if name not in self.roles:
+            raise ResourceNotFoundError(f"role [{name}] not found")
+        del self.roles[name]
+        self.persist()
+
+    def resolve_roles(self, names: List[str]) -> List[dict]:
+        out = []
+        for n in names:
+            if n in RESERVED_ROLES:
+                out.append(RESERVED_ROLES[n])
+            elif n in self.roles:
+                out.append(self.roles[n])
+            # unknown roles are skipped, like the reference (missing role ==
+            # no privileges, not an error)
+        return out

@@ -20,14 +20,21 @@ def main(argv=None) -> int:
     parser.add_argument("--data", default="./data")
     parser.add_argument("--name", default="node-0")
     parser.add_argument("--cluster-name", default="tpu-search")
+    parser.add_argument("-E", action="append", default=[], metavar="KEY=VALUE",
+                        help="setting override, e.g. -E xpack.security.enabled=true")
     args = parser.parse_args(argv)
+    settings = {}
+    for kv in args.E:
+        key, _, value = kv.partition("=")
+        settings[key] = {"true": True, "false": False}.get(value.lower(), value)
 
     from elasticsearch_tpu.node import Node
     from elasticsearch_tpu.rest.actions import register_all
     from elasticsearch_tpu.rest.controller import RestController
     from elasticsearch_tpu.rest.http_server import HttpServer
 
-    node = Node(args.data, node_name=args.name, cluster_name=args.cluster_name)
+    node = Node(args.data, node_name=args.name, cluster_name=args.cluster_name,
+                settings=settings)
     controller = RestController()
     register_all(controller, node)
     server = HttpServer(controller, host=args.host, port=args.port)

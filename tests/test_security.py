@@ -1,0 +1,226 @@
+"""Security: authn (basic/API key), RBAC authz, DLS/FLS, audit.
+
+Reference behaviors: x-pack/plugin/security — SecurityRestFilter (401 on
+missing creds), RBACEngine (403 on missing privilege), NativeUsersStore,
+ApiKeyService, role-based document/field-level security.
+"""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, user=None, api_key=None, **query):
+        raw = b""
+        if body is not None:
+            raw = json.dumps(body).encode()
+        headers = {}
+        if user is not None:
+            name, pw = user
+            headers["authorization"] = "Basic " + base64.b64encode(
+                f"{name}:{pw}".encode()).decode()
+        if api_key is not None:
+            headers["authorization"] = "ApiKey " + api_key
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json", headers)
+
+
+ELASTIC = ("elastic", "changeme")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"),
+             settings={"xpack.security.enabled": True})
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+def _seed(client):
+    for i, doc in enumerate([
+            {"dept": "eng", "name": "alpha", "salary": 100},
+            {"dept": "eng", "name": "beta", "salary": 120},
+            {"dept": "hr", "name": "gamma", "salary": 90}]):
+        st, _ = client.req("PUT", f"/staff/_doc/{i}", doc, user=ELASTIC)
+        assert st in (200, 201)
+    client.req("POST", "/staff/_refresh", user=ELASTIC)
+
+
+# ------------------------------------------------------------ authentication
+
+def test_missing_credentials_401(client):
+    st, body = client.req("GET", "/_cluster/health")
+    assert st == 401
+    assert body["error"]["type"] == "security_exception"
+
+
+def test_basic_auth_elastic_superuser(client):
+    st, body = client.req("GET", "/_cluster/health", user=ELASTIC)
+    assert st == 200
+    st, body = client.req("GET", "/_security/_authenticate", user=ELASTIC)
+    assert body["username"] == "elastic"
+    assert "superuser" in body["roles"]
+
+
+def test_wrong_password_401(client):
+    st, _ = client.req("GET", "/_cluster/health", user=("elastic", "nope"))
+    assert st == 401
+
+
+# ------------------------------------------------------------------- users
+
+def test_user_crud_and_login(client):
+    st, body = client.req("PUT", "/_security/user/alice",
+                          {"password": "s3cret1", "roles": ["viewer"]},
+                          user=ELASTIC)
+    assert st == 200 and body["created"]
+    st, body = client.req("GET", "/_security/_authenticate",
+                          user=("alice", "s3cret1"))
+    assert st == 200 and body["username"] == "alice"
+    # viewer can read but not write
+    _seed(client)
+    st, _ = client.req("POST", "/staff/_search", {"query": {"match_all": {}}},
+                       user=("alice", "s3cret1"))
+    assert st == 200
+    st, body = client.req("PUT", "/staff/_doc/99", {"x": 1},
+                          user=("alice", "s3cret1"))
+    assert st == 403
+    # disable then fail login
+    client.req("PUT", "/_security/user/alice/_disable", user=ELASTIC)
+    st, _ = client.req("GET", "/_security/_authenticate",
+                       user=("alice", "s3cret1"))
+    assert st == 401
+
+
+def test_change_password(client):
+    client.req("PUT", "/_security/user/bob",
+               {"password": "first1", "roles": ["editor"]}, user=ELASTIC)
+    client.req("POST", "/_security/user/bob/_password",
+               {"password": "second2"}, user=ELASTIC)
+    st, _ = client.req("GET", "/_security/_authenticate", user=("bob", "first1"))
+    assert st == 401
+    st, _ = client.req("GET", "/_security/_authenticate", user=("bob", "second2"))
+    assert st == 200
+
+
+# ------------------------------------------------------------------- roles
+
+def test_custom_role_index_scoping(client):
+    _seed(client)
+    client.req("PUT", "/_security/role/staff-reader", {
+        "cluster": [],
+        "indices": [{"names": ["staff*"], "privileges": ["read"]}]},
+        user=ELASTIC)
+    client.req("PUT", "/_security/user/carol",
+               {"password": "pw12345", "roles": ["staff-reader"]}, user=ELASTIC)
+    carol = ("carol", "pw12345")
+    st, _ = client.req("POST", "/staff/_search", {"query": {"match_all": {}}},
+                       user=carol)
+    assert st == 200
+    # other index denied
+    client.req("PUT", "/secret/_doc/1", {"x": 1}, user=ELASTIC)
+    st, _ = client.req("POST", "/secret/_search", {"query": {"match_all": {}}},
+                       user=carol)
+    assert st == 403
+    # cluster APIs denied
+    st, _ = client.req("GET", "/_cluster/health", user=carol)
+    assert st == 403
+
+
+# ----------------------------------------------------------------- API keys
+
+def test_api_key_roundtrip(client):
+    st, created = client.req("POST", "/_security/api_key",
+                             {"name": "ci-key"}, user=ELASTIC)
+    assert st == 200 and created["api_key"]
+    st, body = client.req("GET", "/_security/_authenticate",
+                          api_key=created["encoded"])
+    assert st == 200
+    assert body["authentication_type"] == "api_key"
+    # invalidate → 401
+    client.req("DELETE", "/_security/api_key", {"ids": [created["id"]]},
+               user=ELASTIC)
+    st, _ = client.req("GET", "/_cluster/health", api_key=created["encoded"])
+    assert st == 401
+
+
+def test_api_key_restricted_role_descriptors(client):
+    _seed(client)
+    st, created = client.req("POST", "/_security/api_key", {
+        "name": "limited",
+        "role_descriptors": {
+            "ro": {"cluster": [],
+                   "indices": [{"names": ["staff"], "privileges": ["read"]}]}}},
+        user=ELASTIC)
+    key = created["encoded"]
+    st, _ = client.req("POST", "/staff/_search", {"query": {"match_all": {}}},
+                       api_key=key)
+    assert st == 200
+    st, _ = client.req("PUT", "/staff/_doc/50", {"x": 1}, api_key=key)
+    assert st == 403
+
+
+# ------------------------------------------------------------------ DLS/FLS
+
+def test_document_level_security(client):
+    _seed(client)
+    client.req("PUT", "/_security/role/eng-only", {
+        "indices": [{"names": ["staff"], "privileges": ["read"],
+                     "query": {"term": {"dept": "eng"}}}]}, user=ELASTIC)
+    client.req("PUT", "/_security/user/dave",
+               {"password": "pw12345", "roles": ["eng-only"]}, user=ELASTIC)
+    st, body = client.req("POST", "/staff/_search",
+                          {"query": {"match_all": {}}},
+                          user=("dave", "pw12345"))
+    assert st == 200
+    assert body["hits"]["total"]["value"] == 2
+    depts = {h["_source"]["dept"] for h in body["hits"]["hits"]}
+    assert depts == {"eng"}
+
+
+def test_field_level_security(client):
+    _seed(client)
+    client.req("PUT", "/_security/role/no-salary", {
+        "indices": [{"names": ["staff"], "privileges": ["read"],
+                     "field_security": {"grant": ["dept", "name"]}}]},
+        user=ELASTIC)
+    client.req("PUT", "/_security/user/erin",
+               {"password": "pw12345", "roles": ["no-salary"]}, user=ELASTIC)
+    st, body = client.req("POST", "/staff/_search",
+                          {"query": {"match_all": {}}},
+                          user=("erin", "pw12345"))
+    assert st == 200
+    for h in body["hits"]["hits"]:
+        assert "salary" not in h["_source"]
+        assert "name" in h["_source"]
+
+
+# -------------------------------------------------------------------- audit
+
+def test_audit_trail_records_denials(client, node):
+    client.req("GET", "/_cluster/health")  # anonymous → denied
+    events = [e["event"] for e in node.security.audit]
+    assert "anonymous_access_denied" in events
+
+
+def test_security_disabled_passthrough(tmp_path):
+    n = Node(str(tmp_path / "data2"))
+    c = Client(n)
+    st, _ = c.req("GET", "/_cluster/health")
+    assert st == 200
+    n.close()
