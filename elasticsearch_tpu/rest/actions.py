@@ -26,6 +26,8 @@ def register_all(rc: RestController, node: Node) -> None:
     register_extra(rc, node)
     from elasticsearch_tpu.rest.actions_script import register_script
     register_script(rc, node)
+    from elasticsearch_tpu.rest.actions_xpack import register_xpack
+    register_xpack(rc, node)
     from elasticsearch_tpu.security.rest_filter import (
         make_security_filter, register_security,
     )

@@ -1,0 +1,45 @@
+"""REST handlers for x-pack features: SQL, EQL (more arrive per feature).
+
+Reference: each x-pack plugin registers its own Rest*Action handlers
+(`x-pack/plugin/sql/.../RestSqlQueryAction.java`, eql's RestEqlSearchAction).
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+
+
+def register_xpack(rc: RestController, node: Node) -> None:
+    from elasticsearch_tpu.xpack.eql import EqlEngine
+    from elasticsearch_tpu.xpack.sql import SqlEngine, to_text_table
+
+    sql_engine = SqlEngine(node)
+    eql_engine = EqlEngine(node)
+
+    # ------------------------------------------------------------------ SQL
+    def sql_query(req):
+        body = req.json() or {}
+        result = sql_engine.execute(body)
+        if req.param("format") == "txt":
+            return 200, to_text_table(result)
+        return 200, result
+
+    def sql_translate(req):
+        return 200, sql_engine.translate(req.json() or {})
+
+    def sql_close(req):
+        return 200, sql_engine.close_cursor(req.json() or {})
+
+    rc.register("POST", "/_sql", sql_query)
+    rc.register("GET", "/_sql", sql_query)
+    rc.register("POST", "/_sql/translate", sql_translate)
+    rc.register("GET", "/_sql/translate", sql_translate)
+    rc.register("POST", "/_sql/close", sql_close)
+
+    # ------------------------------------------------------------------ EQL
+    def eql_search(req):
+        return 200, eql_engine.search(req.params["index"], req.json() or {})
+
+    rc.register("POST", "/{index}/_eql/search", eql_search)
+    rc.register("GET", "/{index}/_eql/search", eql_search)

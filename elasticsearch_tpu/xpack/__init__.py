@@ -1,0 +1,4 @@
+"""X-Pack-tier feature plugins (SURVEY.md §2.11): SQL, EQL, ILM, watcher,
+transform, rollup, ML, CCR — each composes onto the core layers the way the
+reference's x-pack plugins compose onto layer-14 extension points.
+"""

@@ -1,0 +1,416 @@
+"""EQL: event query language — event queries + sequences.
+
+Reference: `x-pack/plugin/eql` (10.5k LoC; shares the `ql/` frontend with
+SQL). Grammar subset:
+
+    <category> where <condition>
+    sequence [by <field>] [with maxspan=<time>]
+      [ <category> where <cond> ] [by <field>]
+      [ <category> where <cond> ] [by <field>]
+      ...
+
+Conditions: ==, !=, <, <=, >, >=, and/or/not, `in (...)`, `like "pat*"`,
+wildcard(field, "pat*"), field == "literal". Event queries fold into bool
+DSL filters (category term + condition), executed timestamp-ordered;
+sequence matching is the host-side state machine the reference runs in
+`eql/execution/sequence/` (TumblingWindow / SequenceMatcher), keyed by the
+join field.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ParsingError
+from elasticsearch_tpu.common.settings import parse_time_value
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+\.\d+|\d+)
+    | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+    | (?P<op>==|!=|<=|>=|<|>|\[|\]|\(|\)|,|=)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {"where", "and", "or", "not", "in", "like", "sequence", "by",
+             "with", "maxspan", "true", "false", "null", "any", "until"}
+
+
+class _Tok:
+    def __init__(self, kind, value):
+        self.kind = kind
+        self.value = value
+
+
+def _lex(text: str) -> List[_Tok]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None or m.end() == pos:
+            if text[pos:].strip():
+                raise ParsingError(f"EQL lexing error at: {text[pos:pos+20]!r}")
+            break
+        pos = m.end()
+        if m.group("number") is not None:
+            t = m.group("number")
+            out.append(_Tok("number", float(t) if "." in t else int(t)))
+        elif m.group("string") is not None:
+            raw = m.group("string")[1:-1]
+            out.append(_Tok("string", raw.replace('\\"', '"').replace("\\'", "'")))
+        elif m.group("ident") is not None:
+            w = m.group("ident")
+            out.append(_Tok("kw", w.lower()) if w.lower() in _KEYWORDS
+                       else _Tok("ident", w))
+        else:
+            out.append(_Tok("op", m.group("op")))
+    out.append(_Tok("eof", None))
+    return out
+
+
+class EventQuery:
+    def __init__(self, category: Optional[str], condition: Optional[Any],
+                 join_field: Optional[str] = None):
+        self.category = category        # None == `any`
+        self.condition = condition
+        self.join_field = join_field    # per-step `by`
+
+
+class EqlPlan:
+    def __init__(self):
+        self.mode = "event"             # event | sequence
+        self.events: List[EventQuery] = []
+        self.by: Optional[str] = None   # global join key
+        self.maxspan_s: Optional[float] = None
+
+
+class _Parser:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws):
+        if self.peek().kind == "kw" and self.peek().value in kws:
+            return self.next().value
+        return None
+
+    def accept_op(self, op):
+        if self.peek().kind == "op" and self.peek().value == op:
+            self.next()
+            return True
+        return False
+
+    def parse(self) -> EqlPlan:
+        plan = EqlPlan()
+        if self.accept_kw("sequence"):
+            plan.mode = "sequence"
+            if self.accept_kw("by"):
+                plan.by = self._ident()
+            if self.accept_kw("with"):
+                if not self.accept_kw("maxspan"):
+                    raise ParsingError("expected maxspan after WITH")
+                if not self.accept_op("="):
+                    raise ParsingError("expected = after maxspan")
+                t = self.next()
+                # maxspan value may lex as number+ident (10 s) or ident (10s)
+                if t.kind == "number" and self.peek().kind == "ident":
+                    unit = self.next().value
+                    plan.maxspan_s = parse_time_value(f"{t.value}{unit}", "maxspan")
+                elif t.kind == "ident":
+                    plan.maxspan_s = parse_time_value(t.value, "maxspan")
+                else:
+                    plan.maxspan_s = float(t.value)
+            while self.accept_op("["):
+                ev = self._event_query(terminator="]")
+                if not self.accept_op("]"):
+                    raise ParsingError("expected ] to close sequence step")
+                if self.accept_kw("by"):
+                    ev.join_field = self._ident()
+                plan.events.append(ev)
+            if len(plan.events) < 2:
+                raise ParsingError("sequence requires at least two steps")
+        else:
+            plan.events.append(self._event_query(terminator=None))
+        if self.peek().kind != "eof":
+            raise ParsingError(f"unexpected trailing input [{self.peek().value}]")
+        return plan
+
+    def _ident(self) -> str:
+        t = self.next()
+        if t.kind != "ident":
+            raise ParsingError(f"expected identifier, got [{t.value}]")
+        return t.value
+
+    def _event_query(self, terminator) -> EventQuery:
+        t = self.next()
+        if t.kind == "kw" and t.value == "any":
+            category = None
+        elif t.kind in ("ident", "string"):
+            category = t.value
+        else:
+            raise ParsingError(f"expected event category, got [{t.value}]")
+        if not self.accept_kw("where"):
+            raise ParsingError("expected WHERE after event category")
+        if self.accept_kw("true"):
+            return EventQuery(category, None)
+        return EventQuery(category, self._expr())
+
+    def _expr(self):
+        left = self._and()
+        while self.accept_kw("or"):
+            left = ("or", left, self._and())
+        return left
+
+    def _and(self):
+        left = self._not()
+        while self.accept_kw("and"):
+            left = ("and", left, self._not())
+        return left
+
+    def _not(self):
+        if self.accept_kw("not"):
+            return ("not", self._not())
+        if self.accept_op("("):
+            e = self._expr()
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return e
+        return self._predicate()
+
+    def _predicate(self):
+        t = self.next()
+        if t.kind == "ident" and t.value == "wildcard" and self.accept_op("("):
+            field = self._ident()
+            if not self.accept_op(","):
+                raise ParsingError("wildcard(field, pattern)")
+            pat = self.next().value
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return ("like", field, pat)
+        if t.kind != "ident":
+            raise ParsingError(f"expected field name, got [{t.value}]")
+        field = t.value
+        if self.accept_kw("like"):
+            pat = self.next().value
+            return ("like", field, pat)
+        if self.accept_kw("in"):
+            if not self.accept_op("("):
+                raise ParsingError("IN expects (...)")
+            vals = [self.next().value]
+            while self.accept_op(","):
+                vals.append(self.next().value)
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return ("in", field, vals)
+        op_tok = self.next()
+        if op_tok.kind != "op" or op_tok.value not in (
+                "==", "!=", "<", "<=", ">", ">="):
+            raise ParsingError(f"expected comparison, got [{op_tok.value}]")
+        v = self.next()
+        if v.kind == "kw" and v.value in ("true", "false"):
+            value: Any = v.value == "true"
+        elif v.kind == "kw" and v.value == "null":
+            value = None
+        elif v.kind in ("number", "string", "ident"):
+            value = v.value
+        else:
+            raise ParsingError(f"expected literal, got [{v.value}]")
+        return ("cmp", op_tok.value, field, value)
+
+
+def parse_eql(text: str) -> EqlPlan:
+    return _Parser(_lex(text)).parse()
+
+
+# -- condition → query DSL ---------------------------------------------------
+
+def _ident_resolver(field: str) -> str:
+    return field
+
+
+def condition_to_dsl(expr, exact=_ident_resolver) -> dict:
+    kind = expr[0]
+    if kind == "and":
+        return {"bool": {"must": [condition_to_dsl(expr[1], exact),
+                                  condition_to_dsl(expr[2], exact)]}}
+    if kind == "or":
+        return {"bool": {"should": [condition_to_dsl(expr[1], exact),
+                                    condition_to_dsl(expr[2], exact)],
+                         "minimum_should_match": 1}}
+    if kind == "not":
+        return {"bool": {"must_not": [condition_to_dsl(expr[1], exact)]}}
+    if kind == "like":
+        return {"wildcard": {exact(expr[1]): {"value": expr[2]}}}
+    if kind == "in":
+        return {"terms": {exact(expr[1]): expr[2]}}
+    if kind == "cmp":
+        op, field, value = expr[1], expr[2], expr[3]
+        if op == "==":
+            if value is None:
+                return {"bool": {"must_not": [{"exists": {"field": field}}]}}
+            if isinstance(value, str):
+                field = exact(field)
+            return {"term": {field: {"value": value}}}
+        if op == "!=":
+            if value is None:
+                return {"exists": {"field": field}}
+            if isinstance(value, str):
+                field = exact(field)
+            return {"bool": {"must_not": [{"term": {field: {"value": value}}}]}}
+        range_op = {"<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}[op]
+        return {"range": {field: {range_op: value}}}
+    raise ParsingError(f"unsupported EQL construct [{kind}]")
+
+
+def event_to_dsl(ev: EventQuery, category_field: str,
+                 exact=_ident_resolver) -> dict:
+    filters = []
+    if ev.category is not None:
+        filters.append({"term": {exact(category_field): {"value": ev.category}}})
+    if ev.condition is not None:
+        filters.append(condition_to_dsl(ev.condition, exact))
+    if not filters:
+        return {"match_all": {}}
+    return {"bool": {"filter": filters}}
+
+
+# -- execution ---------------------------------------------------------------
+
+class EqlEngine:
+    def __init__(self, node):
+        self.node = node
+
+    def _exact(self, index: str):
+        """Field → exact-match field (`.keyword` subfield for text), same
+        resolution SQL uses — the shared `ql/` frontend in the reference."""
+        defs: Dict[str, dict] = {}
+        try:
+            services = self.node.indices.resolve(index)
+        except Exception:
+            services = []
+        for svc in services:
+            def walk(props, prefix=""):
+                for fname, fdef in props.items():
+                    full = prefix + fname
+                    if "properties" in fdef:
+                        walk(fdef["properties"], full + ".")
+                    else:
+                        defs[full] = fdef
+            walk(svc.mapper_service.to_dict().get("properties", {}))
+
+        def resolve(field: str) -> str:
+            d = defs.get(field)
+            if d is not None and d.get("type") == "text" and \
+                    "keyword" in d.get("fields", {}):
+                return field + ".keyword"
+            return field
+        return resolve
+
+    def search(self, index: str, body: dict) -> dict:
+        plan = parse_eql(body.get("query", ""))
+        category_field = body.get("event_category_field", "event.category")
+        ts_field = body.get("timestamp_field", "@timestamp")
+        size = int(body.get("size", 10))
+        fetch_size = int(body.get("fetch_size", 1000))
+        exact = self._exact(index)
+        if plan.mode == "event":
+            dsl = event_to_dsl(plan.events[0], category_field, exact)
+            if body.get("filter"):
+                dsl = {"bool": {"must": [dsl], "filter": [body["filter"]]}}
+            result = self.node.search(index, {
+                "query": dsl, "size": size,
+                "sort": [{ts_field: {"order": "asc"}}]})
+            events = [self._event(h) for h in result["hits"]["hits"]]
+            return {"is_partial": False, "is_running": False,
+                    "took": result.get("took", 0), "timed_out": False,
+                    "hits": {"total": result["hits"]["total"],
+                             "events": events}}
+        # sequence: fetch each step's matching events time-ordered, then run
+        # the state machine over the merged stream
+        step_events: List[List[dict]] = []
+        for ev in plan.events:
+            dsl = event_to_dsl(ev, category_field, exact)
+            result = self.node.search(index, {
+                "query": dsl, "size": fetch_size,
+                "sort": [{ts_field: {"order": "asc"}}]})
+            step_events.append(result["hits"]["hits"])
+        sequences = self._match_sequences(plan, step_events, ts_field, size)
+        return {"is_partial": False, "is_running": False, "took": 0,
+                "timed_out": False,
+                "hits": {"total": {"value": len(sequences), "relation": "eq"},
+                         "sequences": sequences}}
+
+    def _event(self, hit: dict) -> dict:
+        return {"_index": hit["_index"], "_id": hit["_id"],
+                "_source": hit.get("_source", {})}
+
+    def _match_sequences(self, plan: EqlPlan, step_events: List[List[dict]],
+                         ts_field: str, size: int) -> List[dict]:
+        def ts(h):
+            v = _get_dotted(h.get("_source", {}), ts_field)
+            if isinstance(v, str):
+                from elasticsearch_tpu.index.mapping import parse_date_millis
+                return parse_date_millis(v)
+            return v if v is not None else 0
+
+        def join_key(h, step_idx):
+            field = plan.events[step_idx].join_field or plan.by
+            if field is None:
+                return "__all__"
+            return str(_get_dotted(h.get("_source", {}), field))
+
+        # merged time-ordered stream of (ts, step, hit)
+        stream: List[Tuple[Any, int, dict]] = []
+        for step, hits in enumerate(step_events):
+            for h in hits:
+                stream.append((ts(h), step, h))
+        stream.sort(key=lambda x: x[0])
+
+        n_steps = len(plan.events)
+        # per join key: list of partial sequences, each = list of hits so far
+        partial: Dict[str, List[List[Tuple[Any, dict]]]] = {}
+        done: List[dict] = []
+        maxspan_ms = plan.maxspan_s * 1000 if plan.maxspan_s else None
+        for t, step, h in stream:
+            key = join_key(h, step)
+            partial.setdefault(key, [])
+            if step == 0:
+                partial[key].append([(t, h)])
+                continue
+            # extend the oldest partial waiting at step-1 (reference semantics:
+            # each stage consumes the earliest in-progress sequence)
+            for seq in partial[key]:
+                if len(seq) != step:
+                    continue
+                if maxspan_ms is not None and t - seq[0][0] > maxspan_ms:
+                    continue
+                if t < seq[-1][0]:
+                    continue
+                seq.append((t, h))
+                if len(seq) == n_steps:
+                    done.append({
+                        "join_keys": [] if key == "__all__" else [key],
+                        "events": [self._event(hit) for _, hit in seq]})
+                    partial[key].remove(seq)
+                    if len(done) >= size:
+                        return done
+                break
+        return done
+
+
+def _get_dotted(src: dict, path: str):
+    cur: Any = src
+    for p in path.split("."):
+        if not isinstance(cur, dict) or p not in cur:
+            return None
+        cur = cur[p]
+    return cur

@@ -1,0 +1,676 @@
+"""SQL engine: parse SQL, plan onto query DSL + aggregations, execute.
+
+Reference: `x-pack/plugin/sql` (69k LoC — ANTLR parser, logical/physical
+planner, query folding into search requests). This implementation keeps the
+same lowering strategy the reference uses:
+
+- filter-only queries fold into a `_search` body (WHERE → bool query,
+  ORDER BY → sort, LIMIT → size, SELECT list → _source filtering)
+- GROUP BY folds into a `composite` aggregation with metric sub-aggs
+  (the reference folds into composite too — `QueryFolder`/`Aggs.java`)
+- HAVING is applied to reduced buckets (reference: bucket_selector pipeline)
+- `_sql/translate` exposes the folded search body verbatim
+
+Cursors paginate filter queries by from-offset, base64-encoded like the
+reference's opaque cursor strings.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+\.\d+|\d+)
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<qident>"[^"]+")
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_.*-]*)
+    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|\.)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "and", "or", "not", "like", "in", "between", "is", "null", "as", "asc",
+    "desc", "distinct", "match", "count", "sum", "avg", "min", "max",
+}
+
+
+class _Tok:
+    def __init__(self, kind: str, value: Any):
+        self.kind = kind       # number | string | ident | kw | op | eof
+        self.value = value
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def _lex(sql: str) -> List[_Tok]:
+    out: List[_Tok] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None or m.end() == pos:
+            if sql[pos:].strip():
+                raise ParsingError(f"SQL lexing error at: {sql[pos:pos+20]!r}")
+            break
+        pos = m.end()
+        if m.group("number") is not None:
+            text = m.group("number")
+            out.append(_Tok("number", float(text) if "." in text else int(text)))
+        elif m.group("string") is not None:
+            out.append(_Tok("string", m.group("string")[1:-1].replace("''", "'")))
+        elif m.group("qident") is not None:
+            out.append(_Tok("ident", m.group("qident")[1:-1]))
+        elif m.group("ident") is not None:
+            word = m.group("ident")
+            if word.lower() in _KEYWORDS:
+                out.append(_Tok("kw", word.lower()))
+            else:
+                out.append(_Tok("ident", word))
+        else:
+            out.append(_Tok("op", m.group("op")))
+    out.append(_Tok("eof", None))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST + parser (recursive descent)
+# ---------------------------------------------------------------------------
+
+AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+
+class SelectItem:
+    def __init__(self, expr: Any, alias: Optional[str]):
+        self.expr = expr        # ("col", name) | ("func", fname, arg) | ("lit", v)
+        self.alias = alias
+
+    @property
+    def name(self) -> str:
+        if self.alias:
+            return self.alias
+        e = self.expr
+        if e[0] == "col":
+            return e[1]
+        if e[0] == "func":
+            arg = "*" if e[2] is None else e[2]
+            return f"{e[1].upper()}({arg})"
+        return str(e[1])
+
+    @property
+    def is_agg(self) -> bool:
+        return self.expr[0] == "func" and self.expr[1] in AGG_FUNCS
+
+
+class SqlQuery:
+    def __init__(self):
+        self.select: List[SelectItem] = []
+        self.star = False
+        self.table: str = ""
+        self.where: Optional[Any] = None
+        self.group_by: List[str] = []
+        self.having: Optional[Any] = None
+        self.order_by: List[Tuple[Any, str]] = []   # (expr, asc|desc)
+        self.limit: Optional[int] = None
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> _Tok:
+        return self.toks[self.i]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "kw" and t.value in kws:
+            self.next()
+            return t.value
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ParsingError(f"expected {kw.upper()}, got [{self.peek().value}]")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "op" and t.value == op:
+            self.next()
+            return True
+        return False
+
+    # -- grammar -------------------------------------------------------------
+    def parse(self) -> SqlQuery:
+        q = SqlQuery()
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))  # DISTINCT cols == GROUP BY
+        if self.accept_op("*"):
+            q.star = True
+        else:
+            q.select.append(self._select_item())
+            while self.accept_op(","):
+                q.select.append(self._select_item())
+        self.expect_kw("from")
+        t = self.next()
+        if t.kind not in ("ident", "string"):
+            raise ParsingError(f"expected table name, got [{t.value}]")
+        q.table = t.value
+        if self.accept_kw("where"):
+            q.where = self._expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            q.group_by.append(self._column_name())
+            while self.accept_op(","):
+                q.group_by.append(self._column_name())
+        elif distinct and q.select and all(it.expr[0] == "col" for it in q.select):
+            q.group_by = [it.expr[1] for it in q.select]
+        if self.accept_kw("having"):
+            q.having = self._expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            q.order_by.append(self._order_item())
+            while self.accept_op(","):
+                q.order_by.append(self._order_item())
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "number":
+                raise ParsingError("LIMIT expects a number")
+            q.limit = int(t.value)
+        if self.peek().kind != "eof":
+            raise ParsingError(f"unexpected trailing input [{self.peek().value}]")
+        return q
+
+    def _column_name(self) -> str:
+        t = self.next()
+        if t.kind != "ident":
+            raise ParsingError(f"expected column name, got [{t.value}]")
+        return t.value
+
+    def _order_item(self) -> Tuple[Any, str]:
+        expr = self._operand()
+        direction = self.accept_kw("asc", "desc") or "asc"
+        return expr, direction
+
+    def _select_item(self) -> SelectItem:
+        expr = self._operand()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self._column_name()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return SelectItem(expr, alias)
+
+    def _operand(self) -> Any:
+        t = self.peek()
+        if t.kind == "kw" and t.value in AGG_FUNCS:
+            fname = self.next().value
+            if not self.accept_op("("):
+                raise ParsingError(f"{fname.upper()} requires (...)")
+            if self.accept_op("*"):
+                arg = None
+            else:
+                self.accept_kw("distinct")
+                arg = self._column_name()
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return ("func", fname, arg)
+        if t.kind == "kw" and t.value == "match":
+            self.next()
+            if not self.accept_op("("):
+                raise ParsingError("MATCH requires (field, 'text')")
+            field = self._column_name()
+            if not self.accept_op(","):
+                raise ParsingError("MATCH requires (field, 'text')")
+            text = self.next()
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return ("match", field, text.value)
+        if t.kind == "ident":
+            return ("col", self.next().value)
+        if t.kind in ("number", "string"):
+            return ("lit", self.next().value)
+        if t.kind == "kw" and t.value == "null":
+            self.next()
+            return ("lit", None)
+        raise ParsingError(f"unexpected token [{t.value}]")
+
+    def _expr(self) -> Any:
+        left = self._and_expr()
+        while self.accept_kw("or"):
+            left = ("or", left, self._and_expr())
+        return left
+
+    def _and_expr(self) -> Any:
+        left = self._not_expr()
+        while self.accept_kw("and"):
+            left = ("and", left, self._not_expr())
+        return left
+
+    def _not_expr(self) -> Any:
+        if self.accept_kw("not"):
+            return ("not", self._not_expr())
+        if self.accept_op("("):
+            e = self._expr()
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return e
+        return self._predicate()
+
+    def _predicate(self) -> Any:
+        left = self._operand()
+        if left[0] == "match":
+            return left
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            op = self.next().value
+            right = self._operand()
+            return ("cmp", op, left, right)
+        if self.accept_kw("like"):
+            pat = self.next()
+            if pat.kind != "string":
+                raise ParsingError("LIKE expects a string pattern")
+            return ("like", left, pat.value)
+        if self.accept_kw("is"):
+            negate = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return ("isnull", left, negate)
+        if self.accept_kw("in"):
+            if not self.accept_op("("):
+                raise ParsingError("IN expects (...)")
+            vals = [self._operand()]
+            while self.accept_op(","):
+                vals.append(self._operand())
+            if not self.accept_op(")"):
+                raise ParsingError("expected )")
+            return ("in", left, [v[1] for v in vals])
+        if self.accept_kw("between"):
+            lo = self._operand()
+            self.expect_kw("and")
+            hi = self._operand()
+            return ("between", left, lo[1], hi[1])
+        raise ParsingError(f"incomplete predicate near [{t.value}]")
+
+
+def parse_sql(sql: str) -> SqlQuery:
+    q = _Parser(_lex(sql)).parse()
+    q._original = sql   # retained for cursor state round-trips
+    return q
+
+
+# ---------------------------------------------------------------------------
+# planner: WHERE expr → query DSL
+# ---------------------------------------------------------------------------
+
+def _col_of(e) -> str:
+    if e[0] != "col":
+        raise IllegalArgumentError("expected a column on the left of a predicate")
+    return e[1]
+
+
+def _lit_of(e) -> Any:
+    if e[0] != "lit":
+        raise IllegalArgumentError("expected a literal on the right of a predicate")
+    return e[1]
+
+
+def _ident_resolver(field: str) -> str:
+    return field
+
+
+def where_to_dsl(expr, exact=_ident_resolver) -> dict:
+    """`exact` maps a column to its exact-match field (the `.keyword`
+    subfield for analyzed text — reference: SQL's FieldAttribute.exactAttribute)."""
+    kind = expr[0]
+    if kind == "and":
+        return {"bool": {"must": [where_to_dsl(expr[1], exact),
+                                  where_to_dsl(expr[2], exact)]}}
+    if kind == "or":
+        return {"bool": {"should": [where_to_dsl(expr[1], exact),
+                                    where_to_dsl(expr[2], exact)],
+                         "minimum_should_match": 1}}
+    if kind == "not":
+        return {"bool": {"must_not": [where_to_dsl(expr[1], exact)]}}
+    if kind == "cmp":
+        op, left, right = expr[1], expr[2], expr[3]
+        col, lit = _col_of(left), _lit_of(right)
+        if op == "=":
+            return {"term": {exact(col): {"value": lit}}}
+        if op in ("!=", "<>"):
+            return {"bool": {"must_not": [{"term": {exact(col): {"value": lit}}}]}}
+        range_op = {"<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}[op]
+        return {"range": {col: {range_op: lit}}}
+    if kind == "like":
+        pattern = expr[2].replace("%", "*").replace("_", "?")
+        return {"wildcard": {exact(_col_of(expr[1])): {"value": pattern}}}
+    if kind == "isnull":
+        exists = {"exists": {"field": _col_of(expr[1])}}
+        if expr[2]:   # IS NOT NULL
+            return exists
+        return {"bool": {"must_not": [exists]}}
+    if kind == "in":
+        return {"terms": {exact(_col_of(expr[1])): expr[2]}}
+    if kind == "between":
+        return {"range": {_col_of(expr[1]): {"gte": expr[2], "lte": expr[3]}}}
+    if kind == "match":
+        return {"match": {expr[1]: {"query": expr[2]}}}
+    raise IllegalArgumentError(f"unsupported WHERE construct [{kind}]")
+
+
+_AGG_DSL = {"sum": "sum", "avg": "avg", "min": "min", "max": "max",
+            "count": "value_count"}
+
+
+def translate(q: SqlQuery, default_fetch_size: int = 1000,
+              exact=_ident_resolver, sort_field=_ident_resolver) -> dict:
+    """Fold the parsed query into one `_search` body (`_sql/translate`)."""
+    body: dict = {}
+    if q.where is not None:
+        body["query"] = where_to_dsl(q.where, exact)
+    has_aggs = q.group_by or any(it.is_agg for it in q.select)
+    if not has_aggs:
+        body["size"] = q.limit if q.limit is not None else default_fetch_size
+        if q.order_by:
+            body["sort"] = [{sort_field(e[1]): {"order": d}}
+                            for e, d in q.order_by if e[0] == "col"]
+        if not q.star:
+            cols = [it.expr[1] for it in q.select if it.expr[0] == "col"]
+            body["_source"] = {"includes": cols}
+        return body
+    # aggregation fold
+    body["size"] = 0
+    metric_aggs = {}
+    for i, it in enumerate(q.select):
+        if not it.is_agg:
+            continue
+        fname, arg = it.expr[1], it.expr[2]
+        if fname == "count" and arg is None:
+            continue   # doc_count
+        metric_aggs[f"m{i}"] = {_AGG_DSL[fname]: {"field": arg}}
+    if q.group_by:
+        sources = [{g: {"terms": {"field": sort_field(g)}}} for g in q.group_by]
+        comp: dict = {"composite": {"sources": sources, "size": 1000}}
+        if metric_aggs:
+            comp["aggs"] = metric_aggs
+        body["aggs"] = {"groupby": comp}
+    else:
+        body["aggs"] = metric_aggs or {}
+    return body
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+_TYPE_MAP = {
+    "keyword": "keyword", "text": "text", "long": "long", "integer": "integer",
+    "short": "short", "byte": "byte", "double": "double", "float": "float",
+    "half_float": "half_float", "scaled_float": "scaled_float", "date": "datetime",
+    "boolean": "boolean", "ip": "ip", "dense_vector": "dense_vector",
+}
+
+
+def _eval_having(expr, row_vals: Dict[str, Any]) -> bool:
+    kind = expr[0]
+    if kind == "and":
+        return _eval_having(expr[1], row_vals) and _eval_having(expr[2], row_vals)
+    if kind == "or":
+        return _eval_having(expr[1], row_vals) or _eval_having(expr[2], row_vals)
+    if kind == "not":
+        return not _eval_having(expr[1], row_vals)
+    if kind == "cmp":
+        op, left, right = expr[1], expr[2], expr[3]
+        lv = _having_operand(left, row_vals)
+        rv = _lit_of(right)
+        if lv is None:
+            return False
+        return {"=": lv == rv, "!=": lv != rv, "<>": lv != rv, "<": lv < rv,
+                "<=": lv <= rv, ">": lv > rv, ">=": lv >= rv}[op]
+    raise IllegalArgumentError(f"unsupported HAVING construct [{kind}]")
+
+
+def _having_operand(e, row_vals: Dict[str, Any]):
+    if e[0] == "col":
+        return row_vals.get(e[1])
+    if e[0] == "func":
+        arg = "*" if e[2] is None else e[2]
+        return row_vals.get(f"{e[1].upper()}({arg})")
+    if e[0] == "lit":
+        return e[1]
+    return None
+
+
+class SqlEngine:
+    def __init__(self, node):
+        self.node = node
+
+    def translate(self, body: dict) -> dict:
+        q = parse_sql(body.get("query", ""))
+        exact = self._exact(q.table)
+        return translate(q, body.get("fetch_size", 1000), exact, exact)
+
+    def execute(self, body: dict) -> dict:
+        cursor = body.get("cursor")
+        if cursor:
+            return self._fetch_cursor(cursor)
+        sql = body.get("query", "")
+        fetch_size = int(body.get("fetch_size", 1000))
+        q = parse_sql(sql)
+        has_aggs = bool(q.group_by or any(it.is_agg for it in q.select))
+        if has_aggs:
+            return self._execute_aggs(q)
+        return self._execute_filter(q, fetch_size, from_=0)
+
+    def close_cursor(self, body: dict) -> dict:
+        return {"succeeded": True}
+
+    # -- filter-mode ---------------------------------------------------------
+    def _columns_for(self, q: SqlQuery, index: str) -> List[dict]:
+        mappings = self._field_types(index)
+        if q.star:
+            return [{"name": n, "type": _TYPE_MAP.get(t, t)}
+                    for n, t in sorted(mappings.items())]
+        cols = []
+        for it in q.select:
+            if it.expr[0] == "col":
+                t = mappings.get(it.expr[1], "keyword")
+                cols.append({"name": it.name, "type": _TYPE_MAP.get(t, t)})
+            elif it.expr[0] == "lit":
+                cols.append({"name": it.name, "type": "keyword"})
+        return cols
+
+    def _field_defs(self, index: str) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        try:
+            services = self.node.indices.resolve(index)
+        except Exception:
+            return out
+        for svc in services:
+            def walk(props, prefix=""):
+                for fname, fdef in props.items():
+                    full = prefix + fname
+                    if "properties" in fdef:
+                        walk(fdef["properties"], full + ".")
+                    else:
+                        out[full] = fdef
+            walk(svc.mapper_service.to_dict().get("properties", {}))
+        return out
+
+    def _field_types(self, index: str) -> Dict[str, str]:
+        return {n: d.get("type", "object")
+                for n, d in self._field_defs(index).items()}
+
+    def _exact(self, index: str):
+        """Column → exact-match field: text with a keyword subfield resolves
+        to `col.keyword` (reference: FieldAttribute.exactAttribute())."""
+        defs = self._field_defs(index)
+
+        def resolve(field: str) -> str:
+            d = defs.get(field)
+            if d is not None and d.get("type") == "text" and \
+                    "keyword" in d.get("fields", {}):
+                return field + ".keyword"
+            return field
+        return resolve
+
+    def _execute_filter(self, q: SqlQuery, fetch_size: int, from_: int) -> dict:
+        exact = self._exact(q.table)
+        search_body = translate(q, fetch_size, exact, exact)
+        total_wanted = q.limit if q.limit is not None else None
+        page = fetch_size if total_wanted is None else min(fetch_size, total_wanted - from_)
+        search_body["size"] = max(page, 0)
+        search_body["from"] = from_
+        result = self.node.search(q.table, search_body)
+        hits = result["hits"]["hits"]
+        columns = self._columns_for(q, q.table)
+        col_names = [c["name"] for c in columns]
+        select_exprs = None if q.star else [it.expr for it in q.select]
+        rows = []
+        for h in hits:
+            src = h.get("_source", {})
+            if q.star:
+                rows.append([_get_dotted(src, n) for n in col_names])
+            else:
+                row = []
+                for e in select_exprs:
+                    row.append(_get_dotted(src, e[1]) if e[0] == "col" else e[1])
+                rows.append(row)
+        out = {"columns": columns, "rows": rows}
+        total = result["hits"]["total"]["value"]
+        next_from = from_ + len(hits)
+        remaining = (total if total_wanted is None else min(total, total_wanted))
+        if len(hits) == search_body["size"] and next_from < remaining:
+            state = {"sql": _unparse(q), "fetch_size": fetch_size, "from": next_from}
+            out["cursor"] = base64.b64encode(json.dumps(state).encode()).decode()
+        return out
+
+    def _fetch_cursor(self, cursor: str) -> dict:
+        try:
+            state = json.loads(base64.b64decode(cursor))
+        except Exception:
+            raise IllegalArgumentError("invalid cursor")
+        q = parse_sql(state["sql"])
+        return self._execute_filter(q, state["fetch_size"], state["from"])
+
+    # -- agg-mode ------------------------------------------------------------
+    def _execute_aggs(self, q: SqlQuery) -> dict:
+        exact = self._exact(q.table)
+        search_body = translate(q, exact=exact, sort_field=exact)
+        result = self.node.search(q.table, search_body)
+        aggs = result.get("aggregations", {})
+        columns = []
+        mappings = self._field_types(q.table)
+        for it in q.select:
+            if it.is_agg:
+                fname = it.expr[1]
+                typ = "long" if fname == "count" else "double"
+                columns.append({"name": it.name, "type": typ})
+            else:
+                t = mappings.get(it.expr[1], "keyword")
+                columns.append({"name": it.name, "type": _TYPE_MAP.get(t, t)})
+        rows = []
+        if q.group_by:
+            buckets = aggs.get("groupby", {}).get("buckets", [])
+            for b in buckets:
+                row_vals: Dict[str, Any] = {}
+                for g in q.group_by:
+                    row_vals[g] = b["key"].get(g)
+                for i, it in enumerate(q.select):
+                    if not it.is_agg:
+                        continue
+                    fname, arg = it.expr[1], it.expr[2]
+                    if fname == "count" and arg is None:
+                        row_vals[it.name] = b["doc_count"]
+                    else:
+                        row_vals[it.name] = b.get(f"m{i}", {}).get("value")
+                if q.having is not None and not _eval_having(q.having, row_vals):
+                    continue
+                row = []
+                for it in q.select:
+                    row.append(row_vals.get(it.name if it.is_agg else it.expr[1]))
+                rows.append(row)
+            rows = _order_rows(rows, q, columns)
+            if q.limit is not None:
+                rows = rows[:q.limit]
+        else:
+            row = []
+            total = None
+            for i, it in enumerate(q.select):
+                fname, arg = it.expr[1], it.expr[2]
+                if fname == "count" and arg is None:
+                    if total is None:
+                        r2 = self.node.search(
+                            q.table, {"size": 0,
+                                      **({"query": where_to_dsl(q.where)}
+                                         if q.where else {})})
+                        total = r2["hits"]["total"]["value"]
+                    row.append(total)
+                else:
+                    row.append(aggs.get(f"m{i}", {}).get("value"))
+            rows = [row]
+        return {"columns": columns, "rows": rows}
+
+
+def _order_rows(rows, q: SqlQuery, columns) -> list:
+    if not q.order_by:
+        return rows
+    names = [c["name"] for c in columns]
+    for expr, direction in reversed(q.order_by):
+        if expr[0] == "col":
+            key_name = expr[1]
+        else:
+            arg = "*" if expr[2] is None else expr[2]
+            key_name = f"{expr[1].upper()}({arg})"
+        if key_name not in names:
+            continue
+        idx = names.index(key_name)
+        rows.sort(key=lambda r: (r[idx] is None, r[idx]),
+                  reverse=(direction == "desc"))
+    return rows
+
+
+def _get_dotted(src: dict, path: str):
+    cur: Any = src
+    for p in path.split("."):
+        if not isinstance(cur, dict) or p not in cur:
+            return None
+        cur = cur[p]
+    return cur
+
+
+def _unparse(q: SqlQuery) -> str:
+    """Round-trip the query for cursor state (we re-parse the original)."""
+    return q._original
+
+
+# ---------------------------------------------------------------------------
+# text format (the CLI table renderer, `format=txt`)
+# ---------------------------------------------------------------------------
+
+def to_text_table(result: dict) -> str:
+    cols = [c["name"] for c in result["columns"]]
+    rows = [[("null" if v is None else str(v)) for v in r]
+            for r in result["rows"]]
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    header = "|".join(c.center(w + 2) for c, w in zip(cols, widths))
+    sep = "+".join("-" * (w + 2) for w in widths)
+    lines = [header, sep]
+    for r in rows:
+        lines.append("|".join(v.ljust(w + 1).rjust(w + 2)
+                              for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"

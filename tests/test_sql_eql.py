@@ -1,0 +1,224 @@
+"""SQL + EQL engines.
+
+Reference behaviors: x-pack/plugin/sql (query folding into _search bodies,
+composite-agg GROUP BY, cursors, txt format), x-pack/plugin/eql (event
+queries, sequences with by/maxspan).
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.xpack.sql import parse_sql, translate, where_to_dsl
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+def _seed_emp(client):
+    rows = [
+        ("alice", "eng", 100, 30), ("bob", "eng", 120, 35),
+        ("carol", "sales", 90, 28), ("dan", "sales", 95, 40),
+        ("erin", "hr", 80, 50),
+    ]
+    for i, (name, dept, salary, age) in enumerate(rows):
+        client.req("PUT", f"/emp/_doc/{i}",
+                   {"name": name, "dept": dept, "salary": salary, "age": age})
+    client.req("POST", "/emp/_refresh")
+
+
+# ------------------------------------------------------------------ parsing
+
+def test_parse_basic_select():
+    q = parse_sql("SELECT name, salary FROM emp WHERE dept = 'eng' "
+                  "ORDER BY salary DESC LIMIT 5")
+    assert [it.name for it in q.select] == ["name", "salary"]
+    assert q.table == "emp"
+    assert q.limit == 5
+    assert q.order_by[0][1] == "desc"
+
+
+def test_where_translation():
+    q = parse_sql("SELECT * FROM t WHERE a = 1 AND b > 2 OR NOT c = 'x'")
+    dsl = where_to_dsl(q.where)
+    assert "bool" in dsl
+
+
+def test_translate_group_by_to_composite():
+    q = parse_sql("SELECT dept, AVG(salary) FROM emp GROUP BY dept")
+    body = translate(q)
+    assert body["size"] == 0
+    assert "composite" in body["aggs"]["groupby"]
+
+
+# ---------------------------------------------------------------- execution
+
+def test_sql_filter_query(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT name, salary FROM emp WHERE dept = 'eng' "
+                 "ORDER BY salary DESC"})
+    assert st == 200
+    assert [c["name"] for c in body["columns"]] == ["name", "salary"]
+    assert body["rows"] == [["bob", 120], ["alice", 100]]
+
+
+def test_sql_like_and_between(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT name FROM emp WHERE name LIKE 'a%'"})
+    assert body["rows"] == [["alice"]]
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT name FROM emp WHERE salary BETWEEN 90 AND 100 "
+                 "ORDER BY name ASC"})
+    assert [r[0] for r in body["rows"]] == ["alice", "carol", "dan"]
+
+
+def test_sql_select_star_columns_typed(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql",
+                          {"query": "SELECT * FROM emp LIMIT 1"})
+    names = [c["name"] for c in body["columns"]]
+    assert "salary" in names and "name" in names
+    types = {c["name"]: c["type"] for c in body["columns"]}
+    assert types["salary"] == "long"
+
+
+def test_sql_group_by_having(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT dept, AVG(salary) AS avg_sal, COUNT(*) AS n FROM emp "
+                 "GROUP BY dept HAVING avg_sal > 85 ORDER BY avg_sal DESC"})
+    assert st == 200
+    assert [c["name"] for c in body["columns"]] == ["dept", "avg_sal", "n"]
+    assert body["rows"][0][0] == "eng"
+    assert body["rows"][0][1] == 110.0
+    depts = [r[0] for r in body["rows"]]
+    assert "hr" not in depts   # avg 80 filtered by HAVING
+
+
+def test_sql_global_aggs(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT COUNT(*), MAX(salary), MIN(age) FROM emp"})
+    assert body["rows"] == [[5, 120.0, 28.0]]
+
+
+def test_sql_cursor_pagination(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT name FROM emp ORDER BY name ASC", "fetch_size": 2})
+    assert len(body["rows"]) == 2 and "cursor" in body
+    seen = [r[0] for r in body["rows"]]
+    while "cursor" in body:
+        st, body = client.req("POST", "/_sql", {"cursor": body["cursor"]})
+        seen.extend(r[0] for r in body["rows"])
+    assert seen == ["alice", "bob", "carol", "dan", "erin"]
+
+
+def test_sql_translate_endpoint(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql/translate", {
+        "query": "SELECT name FROM emp WHERE salary >= 100"})
+    assert body["query"] == {"range": {"salary": {"gte": 100}}}
+
+
+def test_sql_txt_format(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql",
+                          {"query": "SELECT name FROM emp WHERE dept = 'hr'"},
+                          format="txt")
+    assert "name" in body and "erin" in body
+
+
+def test_sql_distinct(client):
+    _seed_emp(client)
+    st, body = client.req("POST", "/_sql", {
+        "query": "SELECT DISTINCT dept FROM emp ORDER BY dept ASC"})
+    assert [r[0] for r in body["rows"]] == ["eng", "hr", "sales"]
+
+
+# --------------------------------------------------------------------- EQL
+
+def _seed_events(client):
+    events = [
+        (1, "process", "cmd.exe", "host1"),
+        (2, "process", "powershell.exe", "host2"),
+        (3, "network", "cmd.exe", "host1"),
+        (4, "file", "cmd.exe", "host1"),
+        (5, "network", "powershell.exe", "host2"),
+        (6, "process", "bash", "host3"),
+    ]
+    for ts, cat, proc, host in events:
+        client.req("POST", "/logs/_doc", {
+            "@timestamp": ts * 1000,
+            "event": {"category": cat},
+            "process": {"name": proc},
+            "host": {"name": host}})
+    client.req("POST", "/logs/_refresh")
+
+
+def test_eql_event_query(client):
+    _seed_events(client)
+    st, body = client.req("POST", "/logs/_eql/search", {
+        "query": 'process where process.name == "cmd.exe"'})
+    assert st == 200
+    events = body["hits"]["events"]
+    assert len(events) == 1
+    assert events[0]["_source"]["process"]["name"] == "cmd.exe"
+
+
+def test_eql_any_with_wildcard(client):
+    _seed_events(client)
+    st, body = client.req("POST", "/logs/_eql/search", {
+        "query": 'any where wildcard(process.name, "*.exe")'})
+    assert len(body["hits"]["events"]) == 5
+
+
+def test_eql_sequence_by_host(client):
+    _seed_events(client)
+    st, body = client.req("POST", "/logs/_eql/search", {
+        "query": 'sequence by host.name '
+                 '[process where true] [network where true]'})
+    assert st == 200
+    seqs = body["hits"]["sequences"]
+    assert len(seqs) == 2
+    joins = sorted(s["join_keys"][0] for s in seqs)
+    assert joins == ["host1", "host2"]
+    for s in seqs:
+        cats = [e["_source"]["event"]["category"] for e in s["events"]]
+        assert cats == ["process", "network"]
+
+
+def test_eql_sequence_maxspan_excludes(client):
+    _seed_events(client)
+    # host2: process at t=2, network at t=5 → span 3s, excluded by maxspan=2s
+    st, body = client.req("POST", "/logs/_eql/search", {
+        "query": 'sequence by host.name with maxspan=2s '
+                 '[process where true] [network where true]'})
+    seqs = body["hits"]["sequences"]
+    assert len(seqs) == 1
+    assert seqs[0]["join_keys"] == ["host1"]
