@@ -82,6 +82,20 @@ class IndexService:
                 translog_sync=sync, vector_dtype=vec_dtype))
         self.aliases: Dict[str, dict] = {}
 
+    def settings_update(self, updates: Dict[str, Any]) -> None:
+        """Apply dynamic index-setting updates (reference:
+        MetaDataUpdateSettingsService — dynamic settings only; static ones
+        like number_of_shards are rejected)."""
+        for key in updates:
+            if key in ("index.number_of_shards", "index.uuid"):
+                raise IllegalArgumentError(
+                    f"setting [{key}] is not dynamically updateable")
+        merged = dict(self.settings.as_flat_dict())
+        merged.update(updates)
+        self.settings = Settings.of(merged)
+        if "index.number_of_replicas" in updates:
+            self.num_replicas = int(updates["index.number_of_replicas"])
+
     def route(self, doc_id: str, routing: Optional[str] = None) -> IndexShardHandle:
         sid = shard_id_for(routing if routing is not None else doc_id, self.num_shards)
         return self.shards[sid]

@@ -99,6 +99,9 @@ class Node:
         import os as _os
         self.scripts.attach_storage(_os.path.join(data_path, "_state",
                                                   "stored_scripts.json"))
+        from elasticsearch_tpu.xpack.ilm import IlmService, SlmService
+        self.ilm = IlmService(self)
+        self.slm = SlmService(self)
         self.settings = settings or {}
         from elasticsearch_tpu.security import SecurityService, SecurityStore
         self.security = SecurityService(

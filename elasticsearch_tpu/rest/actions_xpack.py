@@ -43,3 +43,114 @@ def register_xpack(rc: RestController, node: Node) -> None:
 
     rc.register("POST", "/{index}/_eql/search", eql_search)
     rc.register("GET", "/{index}/_eql/search", eql_search)
+
+    # ------------------------------------------------------------------ ILM
+    from elasticsearch_tpu.xpack.ilm import resize_index, rollover
+
+    def ilm_put_policy(req):
+        node.ilm.put_policy(req.params["name"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def ilm_get_policy(req):
+        return 200, node.ilm.get_policy(req.params.get("name"))
+
+    def ilm_delete_policy(req):
+        node.ilm.delete_policy(req.params["name"])
+        return 200, {"acknowledged": True}
+
+    def ilm_explain(req):
+        return 200, node.ilm.explain(req.params["index"])
+
+    def ilm_status(req):
+        return 200, {"operation_mode":
+                     "RUNNING" if node.ilm.running else "STOPPED"}
+
+    def ilm_start(req):
+        node.ilm.running = True
+        return 200, {"acknowledged": True}
+
+    def ilm_stop(req):
+        node.ilm.running = False
+        return 200, {"acknowledged": True}
+
+    def ilm_run(req):
+        # explicit tick (tests/ops; the reference triggers via SchedulerEngine)
+        return 200, {"actions": node.ilm.run_once()}
+
+    rc.register("PUT", "/_ilm/policy/{name}", ilm_put_policy)
+    rc.register("GET", "/_ilm/policy/{name}", ilm_get_policy)
+    rc.register("GET", "/_ilm/policy", ilm_get_policy)
+    rc.register("DELETE", "/_ilm/policy/{name}", ilm_delete_policy)
+    rc.register("GET", "/{index}/_ilm/explain", ilm_explain)
+    rc.register("GET", "/_ilm/status", ilm_status)
+    rc.register("POST", "/_ilm/start", ilm_start)
+    rc.register("POST", "/_ilm/stop", ilm_stop)
+    rc.register("POST", "/_ilm/_run", ilm_run)
+
+    # ------------------------------------------------- rollover + resize
+    def do_rollover(req):
+        # the path param slot is named by whichever route registered the
+        # first {param} at this trie position — accept either
+        alias = req.params.get("alias") or req.params.get("index")
+        return 200, rollover(node, alias, req.json() or {},
+                             dry_run=req.bool_param("dry_run"))
+
+    def do_resize(kind):
+        def handler(req):
+            return 200, resize_index(node, req.params["index"],
+                                     req.params["target"], kind,
+                                     req.json() or {})
+        return handler
+
+    rc.register("POST", "/{alias}/_rollover", do_rollover)
+    rc.register("POST", "/{index}/_shrink/{target}", do_resize("shrink"))
+    rc.register("PUT", "/{index}/_shrink/{target}", do_resize("shrink"))
+    rc.register("POST", "/{index}/_split/{target}", do_resize("split"))
+    rc.register("PUT", "/{index}/_split/{target}", do_resize("split"))
+    rc.register("POST", "/{index}/_clone/{target}", do_resize("clone"))
+    rc.register("PUT", "/{index}/_clone/{target}", do_resize("clone"))
+
+    # ------------------------------------------------------------------ SLM
+    def slm_put(req):
+        node.slm.put_policy(req.params["id"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def slm_get(req):
+        return 200, node.slm.get_policy(req.params.get("id"))
+
+    def slm_delete(req):
+        node.slm.delete_policy(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    def slm_execute(req):
+        return 200, node.slm.execute(req.params["id"])
+
+    rc.register("PUT", "/_slm/policy/{id}", slm_put)
+    rc.register("GET", "/_slm/policy/{id}", slm_get)
+    rc.register("GET", "/_slm/policy", slm_get)
+    rc.register("DELETE", "/_slm/policy/{id}", slm_delete)
+    rc.register("POST", "/_slm/policy/{id}/_execute", slm_execute)
+
+    # ------------------------------------------ dynamic index settings
+    def put_settings(req):
+        body = req.json() or {}
+        flat = _flatten_settings(body.get("settings", body))
+        for svc in node.indices.resolve(req.params.get("index")):
+            svc.settings_update(flat)
+        return 200, {"acknowledged": True}
+
+    rc.register("PUT", "/{index}/_settings", put_settings)
+    rc.register("PUT", "/_settings", put_settings)
+
+
+def _flatten_settings(obj: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in obj.items():
+        key = prefix + k
+        if isinstance(v, dict):
+            out.update(_flatten_settings(v, key + "."))
+        else:
+            out[key] = v
+    # accept both "index.x" and bare "x" forms like the reference
+    return {k if k.startswith("index.") else "index." + k: v
+            for k, v in out.items()}

@@ -1,0 +1,378 @@
+"""Index lifecycle management (ILM) + snapshot lifecycle (SLM) + resize ops.
+
+Reference: `x-pack/plugin/ilm` (7.3k LoC) — a policy is a phase→actions map;
+`IndexLifecycleRunner` advances each managed index through the steps that
+`PolicyStepsRegistry` resolves; state lives in index metadata; SLM schedules
+snapshots. Rollover/shrink/clone/split are core APIs
+(`action/admin/indices/rollover/`, `admin/indices/shrink/ResizeRequest`).
+
+Here the runner is tick-driven (`IlmService.run_once(now_ms)`) — the
+single-process analog of the reference's periodic `SchedulerEngine` trigger —
+so tests drive the clock deterministically.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    ResourceNotFoundError,
+    ValidationError,
+)
+from elasticsearch_tpu.common.settings import parse_time_value
+
+# phases in execution order (TimeseriesLifecycleType.VALID_PHASES)
+PHASES = ["hot", "warm", "cold", "delete"]
+
+_ROLLOVER_SUFFIX = re.compile(r"^(.*?)-(\d+)$")
+
+
+# ---------------------------------------------------------------------------
+# resize: shrink / clone / split (core API, used by ILM's shrink action)
+# ---------------------------------------------------------------------------
+
+def resize_index(node, source: str, target: str, kind: str,
+                 body: Optional[dict] = None) -> dict:
+    """Copy `source` into a new `target` index (reference:
+    TransportResizeAction — here a doc-level copy since segments are
+    re-encoded into the device-friendly layout anyway)."""
+    body = body or {}
+    svc = node.indices.get(source)
+    if node.indices.exists(target):
+        raise IllegalArgumentError(f"index [{target}] already exists")
+    settings = dict(body.get("settings", {}))
+    if kind == "shrink":
+        settings.setdefault("index.number_of_shards", 1)
+    elif kind == "split":
+        if "index.number_of_shards" not in settings:
+            raise IllegalArgumentError("split requires index.number_of_shards")
+    elif kind == "clone":
+        settings.setdefault("index.number_of_shards",
+                            svc.settings.get("index.number_of_shards", 1))
+    mappings = svc.mapper_service.to_dict()
+    node.indices.create_index(target, settings=settings,
+                              mappings=mappings,
+                              aliases=body.get("aliases"))
+    reader = svc.combined_reader()
+    copied = 0
+    for view in reader.views:
+        seg = view.segment
+        for local in range(seg.num_docs):
+            if not view.live[local]:
+                continue
+            node.index_doc(target, seg.ids[local], seg.sources[local])
+            copied += 1
+    node.indices.get(target).refresh()
+    return {"acknowledged": True, "shards_acknowledged": True,
+            "index": target, "copied_docs": copied}
+
+
+# ---------------------------------------------------------------------------
+# rollover
+# ---------------------------------------------------------------------------
+
+def _next_rollover_name(index_name: str) -> str:
+    m = _ROLLOVER_SUFFIX.match(index_name)
+    if m is None:
+        raise IllegalArgumentError(
+            f"index name [{index_name}] does not match pattern '^.*-\\d+$'")
+    return f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+
+
+def rollover(node, alias: str, body: Optional[dict] = None,
+             now_ms: Optional[int] = None, dry_run: bool = False) -> dict:
+    """POST /{alias}/_rollover — evaluate conditions on the current write
+    index; when met, create the next index and atomically swap the alias
+    (reference: TransportRolloverAction / MetaDataRolloverService)."""
+    body = body or {}
+    now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+    backing = [svc for svc in node.indices.indices.values()
+               if alias in svc.aliases]
+    if not backing:
+        # the alias may actually be a concrete index (datastream-less use)
+        raise ResourceNotFoundError(
+            f"rollover target [{alias}] does not exist as an alias")
+    writers = [svc for svc in backing
+               if svc.aliases[alias].get("is_write_index", True)]
+    if len(writers) != 1:
+        raise IllegalArgumentError(
+            f"rollover target [{alias}] must resolve to exactly one write "
+            f"index, got {len(writers)}")
+    old = writers[0]
+    conditions = body.get("conditions", {})
+    results: Dict[str, bool] = {}
+    age_ms = now_ms - old.creation_date
+    if "max_age" in conditions:
+        results[f"[max_age: {conditions['max_age']}]"] = (
+            age_ms >= parse_time_value(conditions["max_age"], "max_age") * 1000)
+    if "max_docs" in conditions:
+        results[f"[max_docs: {conditions['max_docs']}]"] = (
+            old.doc_count() >= int(conditions["max_docs"]))
+    if "max_size" in conditions:
+        # doc-source byte estimate; the reference uses on-disk segment size
+        import json as _json
+        reader = old.combined_reader()
+        nbytes = sum(len(_json.dumps(view.segment.sources[i]))
+                     for view in reader.views
+                     for i in range(view.segment.num_docs))
+        from elasticsearch_tpu.common.settings import parse_byte_size
+        results[f"[max_size: {conditions['max_size']}]"] = (
+            nbytes >= parse_byte_size(conditions["max_size"], "max_size"))
+    met = (not conditions) or any(results.values())
+    new_index = body.get("new_index") or _next_rollover_name(old.name)
+    out = {"acknowledged": False, "shards_acknowledged": False,
+           "old_index": old.name, "new_index": new_index,
+           "rolled_over": False, "dry_run": dry_run, "conditions": results}
+    if dry_run or not met:
+        return out
+    node.indices.create_index(new_index,
+                              settings=body.get("settings"),
+                              mappings=body.get("mappings"),
+                              aliases={alias: {"is_write_index": True}})
+    old.aliases[alias] = {**old.aliases[alias], "is_write_index": False}
+    out.update({"acknowledged": True, "shards_acknowledged": True,
+                "rolled_over": True})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ILM
+# ---------------------------------------------------------------------------
+
+class IlmService:
+    def __init__(self, node):
+        self.node = node
+        self.policies: Dict[str, dict] = {}
+        self.running = True
+        # per-index lifecycle execution state (reference keeps this in
+        # IndexMetaData custom `index.lifecycle`)
+        self.index_state: Dict[str, dict] = {}
+
+    # -- policy CRUD ----------------------------------------------------------
+    def put_policy(self, name: str, body: dict) -> None:
+        policy = body.get("policy")
+        if not isinstance(policy, dict) or "phases" not in policy:
+            raise ValidationError("policy must define [phases]")
+        for phase in policy["phases"]:
+            if phase not in PHASES:
+                raise ValidationError(f"unknown phase [{phase}]")
+        self.policies[name] = {"policy": policy, "version":
+                               self.policies.get(name, {}).get("version", 0) + 1,
+                               "modified_date": int(time.time() * 1000)}
+
+    def get_policy(self, name: Optional[str] = None) -> dict:
+        if name is None:
+            return dict(self.policies)
+        if name not in self.policies:
+            raise ResourceNotFoundError(f"lifecycle policy [{name}] not found")
+        return {name: self.policies[name]}
+
+    def delete_policy(self, name: str) -> None:
+        if name not in self.policies:
+            raise ResourceNotFoundError(f"lifecycle policy [{name}] not found")
+        used_by = [idx for idx, st in self.index_state.items()
+                   if st.get("policy") == name]
+        if used_by:
+            raise IllegalArgumentError(
+                f"cannot delete policy [{name}]: in use by {used_by}")
+        del self.policies[name]
+
+    # -- runner ---------------------------------------------------------------
+    def _managed_indices(self) -> List[Any]:
+        out = []
+        for svc in list(self.node.indices.indices.values()):
+            policy = svc.settings.get("index.lifecycle.name")
+            if policy:
+                out.append((svc, policy))
+        return out
+
+    def run_once(self, now_ms: Optional[int] = None) -> List[dict]:
+        """One scheduler tick: advance every managed index. Returns the
+        actions taken (for tests/observability)."""
+        if not self.running:
+            return []
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        took: List[dict] = []
+        for svc, policy_name in self._managed_indices():
+            pol = self.policies.get(policy_name)
+            if pol is None:
+                continue
+            state = self.index_state.setdefault(
+                svc.name, {"policy": policy_name, "phase": None,
+                           "action": "complete", "step": "complete",
+                           "phase_time": svc.creation_date})
+            actions = self._advance(svc, pol["policy"], state, now_ms)
+            took.extend(actions)
+        return took
+
+    def _phase_age_ms(self, phase_def: dict) -> float:
+        return parse_time_value(phase_def.get("min_age", "0ms"), "min_age") * 1000
+
+    def _advance(self, svc, policy: dict, state: dict,
+                 now_ms: int) -> List[dict]:
+        phases = policy.get("phases", {})
+        age_ms = now_ms - svc.creation_date
+        # find the latest phase whose min_age has elapsed
+        target_phase = None
+        for phase in PHASES:
+            if phase not in phases:
+                continue
+            if age_ms >= self._phase_age_ms(phases[phase]):
+                target_phase = phase
+        if target_phase is None or target_phase == state.get("phase"):
+            # still run in-phase repeatable actions (hot rollover)
+            if state.get("phase") == "hot":
+                return self._run_phase_actions(svc, "hot",
+                                               phases.get("hot", {}), state,
+                                               now_ms, repeat=True)
+            return []
+        state["phase"] = target_phase
+        state["phase_time"] = now_ms
+        return self._run_phase_actions(svc, target_phase,
+                                       phases.get(target_phase, {}), state,
+                                       now_ms)
+
+    def _run_phase_actions(self, svc, phase: str, phase_def: dict,
+                           state: dict, now_ms: int,
+                           repeat: bool = False) -> List[dict]:
+        took: List[dict] = []
+        actions = phase_def.get("actions", {})
+        name = svc.name
+        for action, spec in actions.items():
+            if action == "rollover":
+                alias = svc.settings.get("index.lifecycle.rollover_alias")
+                if not alias or alias not in svc.aliases:
+                    continue
+                if not svc.aliases[alias].get("is_write_index", True):
+                    continue   # already rolled
+                result = rollover(self.node, alias,
+                                  {"conditions": _rollover_conditions(spec)},
+                                  now_ms=now_ms)
+                if result["rolled_over"]:
+                    # the new index inherits the policy via settings the
+                    # caller set in the template; record the event
+                    new_svc = self.node.indices.get(result["new_index"])
+                    new_svc.settings_update({
+                        "index.lifecycle.name": state["policy"],
+                        "index.lifecycle.rollover_alias": alias})
+                    took.append({"index": name, "action": "rollover",
+                                 "new_index": result["new_index"]})
+            elif repeat:
+                continue       # only rollover repeats within a phase
+            elif action == "forcemerge":
+                svc.force_merge()
+                took.append({"index": name, "action": "forcemerge"})
+            elif action == "shrink":
+                target = f"shrink-{name}"
+                if not self.node.indices.exists(target):
+                    resize_index(self.node, name, target, "shrink",
+                                 {"settings": {"index.number_of_shards":
+                                               spec.get("number_of_shards", 1)}})
+                    took.append({"index": name, "action": "shrink",
+                                 "target": target})
+            elif action == "readonly":
+                svc.settings_update({"index.blocks.write": True})
+                took.append({"index": name, "action": "readonly"})
+            elif action == "freeze":
+                svc.settings_update({"index.frozen": True})
+                took.append({"index": name, "action": "freeze"})
+            elif action == "delete":
+                self.node.indices.delete_index(name)
+                self.index_state.pop(name, None)
+                took.append({"index": name, "action": "delete"})
+                return took   # index is gone; stop processing actions
+            elif action in ("allocate", "set_priority", "migrate",
+                            "searchable_snapshot", "wait_for_snapshot",
+                            "unfollow"):
+                took.append({"index": name, "action": action, "noop": True})
+        state["action"] = "complete"
+        state["step"] = "complete"
+        return took
+
+    # -- explain --------------------------------------------------------------
+    def explain(self, index_expr: str) -> dict:
+        out = {}
+        for svc in self.node.indices.resolve(index_expr):
+            policy = svc.settings.get("index.lifecycle.name")
+            if not policy:
+                out[svc.name] = {"index": svc.name, "managed": False}
+                continue
+            st = self.index_state.get(svc.name, {})
+            out[svc.name] = {
+                "index": svc.name, "managed": True, "policy": policy,
+                "phase": st.get("phase"), "action": st.get("action"),
+                "step": st.get("step"),
+                "age": f"{max(0, int(time.time()*1000) - svc.creation_date)//1000}s",
+            }
+        return {"indices": out}
+
+
+def _rollover_conditions(spec: dict) -> dict:
+    out = {}
+    for k in ("max_age", "max_docs", "max_size", "max_primary_shard_size"):
+        if k in spec:
+            out["max_size" if k == "max_primary_shard_size" else k] = spec[k]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SLM
+# ---------------------------------------------------------------------------
+
+class SlmService:
+    """Snapshot lifecycle: named policies that snapshot on schedule.
+
+    Reference: `x-pack/.../slm/SnapshotLifecycleService` — cron-scheduled;
+    here interval-scheduled via `run_once(now)` ticks plus manual
+    `_execute`.
+    """
+
+    def __init__(self, node):
+        self.node = node
+        self.policies: Dict[str, dict] = {}
+        self.history: List[dict] = []
+
+    def put_policy(self, policy_id: str, body: dict) -> None:
+        for req in ("repository", "name"):
+            if req not in body:
+                raise ValidationError(f"snapshot lifecycle policy requires [{req}]")
+        self.policies[policy_id] = {
+            **body,
+            "version": self.policies.get(policy_id, {}).get("version", 0) + 1,
+            "modified_date_millis": int(time.time() * 1000),
+            "last_success": None, "next_execution_millis": None,
+        }
+
+    def get_policy(self, policy_id: Optional[str] = None) -> dict:
+        if policy_id is None:
+            return dict(self.policies)
+        if policy_id not in self.policies:
+            raise ResourceNotFoundError(f"snapshot lifecycle policy "
+                                        f"[{policy_id}] not found")
+        return {policy_id: self.policies[policy_id]}
+
+    def delete_policy(self, policy_id: str) -> None:
+        if policy_id not in self.policies:
+            raise ResourceNotFoundError(f"snapshot lifecycle policy "
+                                        f"[{policy_id}] not found")
+        del self.policies[policy_id]
+
+    def execute(self, policy_id: str) -> dict:
+        pol = self.policies.get(policy_id)
+        if pol is None:
+            raise ResourceNotFoundError(f"snapshot lifecycle policy "
+                                        f"[{policy_id}] not found")
+        snap_name = pol["name"].replace("<", "").replace(">", "").replace(
+            "{now/d}", time.strftime("%Y.%m.%d")) + "-" + str(int(time.time()))
+        config = pol.get("config", {})
+        result = self.node.snapshots.create_snapshot(
+            pol["repository"], snap_name,
+            {"indices": config.get("indices", "*")})
+        pol["last_success"] = {"snapshot_name": snap_name,
+                               "time": int(time.time() * 1000)}
+        self.history.append({"policy": policy_id, "snapshot": snap_name,
+                             "status": "success"})
+        return {"snapshot_name": snap_name}

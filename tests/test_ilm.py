@@ -1,0 +1,215 @@
+"""ILM, SLM, rollover, and resize (shrink/clone/split).
+
+Reference behaviors: x-pack/plugin/ilm (phase/action step machine),
+TransportRolloverAction (condition evaluation + alias swap),
+TransportResizeAction.
+"""
+
+import json
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+# ----------------------------------------------------------------- rollover
+
+def test_rollover_max_docs(client, node):
+    client.req("PUT", "/logs-000001",
+               {"aliases": {"logs": {"is_write_index": True}}})
+    for i in range(5):
+        client.req("POST", "/logs-000001/_doc", {"n": i})
+    client.req("POST", "/logs-000001/_refresh")
+    # condition not met
+    st, body = client.req("POST", "/logs/_rollover",
+                          {"conditions": {"max_docs": 10}})
+    assert st == 200 and body["rolled_over"] is False
+    # met
+    st, body = client.req("POST", "/logs/_rollover",
+                          {"conditions": {"max_docs": 5}})
+    assert body["rolled_over"] is True
+    assert body["new_index"] == "logs-000002"
+    assert node.indices.exists("logs-000002")
+    # write index moved: indexing through the alias lands in the new index
+    client.req("POST", "/logs-000002/_doc", {"n": 99})
+    client.req("POST", "/logs-000002/_refresh")
+    assert node.indices.get("logs-000002").doc_count() == 1
+
+
+def test_rollover_dry_run_and_unconditioned(client):
+    client.req("PUT", "/audit-000001", {"aliases": {"audit": {}}})
+    st, body = client.req("POST", "/audit/_rollover", {}, dry_run="true")
+    assert body["dry_run"] is True and body["rolled_over"] is False
+    st, body = client.req("POST", "/audit/_rollover", {})
+    assert body["rolled_over"] is True   # no conditions == unconditional
+
+
+# ------------------------------------------------------------------- resize
+
+def test_shrink_copies_docs(client, node):
+    client.req("PUT", "/big", {"settings": {"index.number_of_shards": 4}})
+    for i in range(20):
+        client.req("PUT", f"/big/_doc/{i}", {"v": i})
+    client.req("POST", "/big/_refresh")
+    st, body = client.req("POST", "/big/_shrink/small")
+    assert st == 200 and body["copied_docs"] == 20
+    assert node.indices.get("small").num_shards == 1
+    st, body = client.req("GET", "/small/_count")
+    assert body["count"] == 20
+
+
+def test_clone_preserves_mapping(client, node):
+    client.req("PUT", "/src", {"mappings": {"properties": {
+        "v": {"type": "dense_vector", "dims": 4}}}})
+    client.req("PUT", "/src/_doc/1", {"v": [1, 2, 3, 4]})
+    client.req("POST", "/src/_refresh")
+    st, body = client.req("POST", "/src/_clone/dst")
+    assert st == 200
+    props = node.indices.get("dst").mapper_service.to_dict()["properties"]
+    assert props["v"]["type"] == "dense_vector"
+
+
+def test_split_requires_shard_count(client):
+    client.req("PUT", "/s1")
+    st, body = client.req("POST", "/s1/_split/s2")
+    assert st == 400
+
+
+# --------------------------------------------------------------------- ILM
+
+def test_ilm_policy_crud(client):
+    st, _ = client.req("PUT", "/_ilm/policy/p1", {"policy": {"phases": {
+        "hot": {"actions": {"rollover": {"max_docs": 3}}},
+        "delete": {"min_age": "30d", "actions": {"delete": {}}}}}})
+    assert st == 200
+    st, body = client.req("GET", "/_ilm/policy/p1")
+    assert "hot" in body["p1"]["policy"]["phases"]
+    st, _ = client.req("DELETE", "/_ilm/policy/p1")
+    assert st == 200
+    st, _ = client.req("GET", "/_ilm/policy/p1")
+    assert st == 404
+
+
+def test_ilm_hot_rollover_then_delete(client, node):
+    client.req("PUT", "/_ilm/policy/cycle", {"policy": {"phases": {
+        "hot": {"actions": {"rollover": {"max_docs": 2}}},
+        "delete": {"min_age": "1h", "actions": {"delete": {}}}}}})
+    client.req("PUT", "/d-000001", {
+        "settings": {"index.lifecycle.name": "cycle",
+                     "index.lifecycle.rollover_alias": "d"},
+        "aliases": {"d": {"is_write_index": True}}})
+    for i in range(3):
+        client.req("POST", "/d-000001/_doc", {"i": i})
+    client.req("POST", "/d-000001/_refresh")
+    now = int(time.time() * 1000)
+    actions = node.ilm.run_once(now_ms=now)
+    assert {"index": "d-000001", "action": "rollover",
+            "new_index": "d-000002"} in actions
+    assert node.indices.exists("d-000002")
+    # new index inherits the policy
+    assert node.indices.get("d-000002").settings.get(
+        "index.lifecycle.name") == "cycle"
+    # advance time past delete min_age → both indices deleted
+    later = now + 2 * 3600 * 1000
+    actions = node.ilm.run_once(now_ms=later)
+    deleted = {a["index"] for a in actions if a["action"] == "delete"}
+    assert "d-000001" in deleted
+    assert not node.indices.exists("d-000001")
+
+
+def test_ilm_warm_forcemerge_readonly(client, node):
+    client.req("PUT", "/_ilm/policy/warmup", {"policy": {"phases": {
+        "warm": {"min_age": "10m",
+                 "actions": {"forcemerge": {"max_num_segments": 1},
+                             "readonly": {}}}}}})
+    client.req("PUT", "/w1", {
+        "settings": {"index.lifecycle.name": "warmup"}})
+    client.req("PUT", "/w1/_doc/1", {"x": 1})
+    client.req("POST", "/w1/_refresh")
+    now = int(time.time() * 1000)
+    assert node.ilm.run_once(now_ms=now) == []    # min_age not reached
+    actions = node.ilm.run_once(now_ms=now + 11 * 60 * 1000)
+    kinds = {a["action"] for a in actions}
+    assert kinds == {"forcemerge", "readonly"}
+    assert node.indices.get("w1").settings.get("index.blocks.write") is True
+
+
+def test_ilm_explain(client, node):
+    client.req("PUT", "/_ilm/policy/px", {"policy": {"phases": {
+        "hot": {"actions": {}}}}})
+    client.req("PUT", "/managed", {"settings": {"index.lifecycle.name": "px"}})
+    client.req("PUT", "/unmanaged")
+    node.ilm.run_once()
+    st, body = client.req("GET", "/managed/_ilm/explain")
+    assert body["indices"]["managed"]["managed"] is True
+    assert body["indices"]["managed"]["phase"] == "hot"
+    st, body = client.req("GET", "/unmanaged/_ilm/explain")
+    assert body["indices"]["unmanaged"]["managed"] is False
+
+
+def test_ilm_start_stop(client, node):
+    client.req("POST", "/_ilm/stop")
+    st, body = client.req("GET", "/_ilm/status")
+    assert body["operation_mode"] == "STOPPED"
+    assert node.ilm.run_once() == []
+    client.req("POST", "/_ilm/start")
+    st, body = client.req("GET", "/_ilm/status")
+    assert body["operation_mode"] == "RUNNING"
+
+
+# --------------------------------------------------------------------- SLM
+
+def test_slm_policy_and_execute(client, node, tmp_path):
+    client.req("PUT", "/_snapshot/repo1",
+               {"type": "fs", "settings": {"location": str(tmp_path / "snaps")}})
+    client.req("PUT", "/data1/_doc/1", {"x": 1})
+    client.req("POST", "/data1/_refresh")
+    st, _ = client.req("PUT", "/_slm/policy/nightly", {
+        "schedule": "0 30 1 * * ?", "name": "<nightly-{now/d}>",
+        "repository": "repo1", "config": {"indices": "data1"}})
+    assert st == 200
+    st, body = client.req("POST", "/_slm/policy/nightly/_execute")
+    assert st == 200 and body["snapshot_name"].startswith("nightly-")
+    st, body = client.req("GET", "/_slm/policy/nightly")
+    assert body["nightly"]["last_success"]["snapshot_name"] == body["nightly"]["last_success"]["snapshot_name"]
+    # snapshot actually exists in the repo
+    st, body = client.req("GET", "/_snapshot/repo1/_all")
+    names = [s["snapshot"] for s in body["snapshots"]]
+    assert any(n.startswith("nightly-") for n in names)
+
+
+def test_dynamic_settings_update(client, node):
+    client.req("PUT", "/cfg")
+    st, _ = client.req("PUT", "/cfg/_settings",
+                       {"index": {"number_of_replicas": 3}})
+    assert st == 200
+    assert node.indices.get("cfg").num_replicas == 3
+    st, body = client.req("PUT", "/cfg/_settings",
+                          {"index.number_of_shards": 9})
+    assert st == 400
