@@ -102,6 +102,11 @@ class Node:
         from elasticsearch_tpu.xpack.ilm import IlmService, SlmService
         self.ilm = IlmService(self)
         self.slm = SlmService(self)
+        from elasticsearch_tpu.xpack.transform import RollupService, TransformService
+        from elasticsearch_tpu.xpack.watcher import WatcherService
+        self.watcher = WatcherService(self)
+        self.transform = TransformService(self)
+        self.rollup = RollupService(self)
         self.settings = settings or {}
         from elasticsearch_tpu.security import SecurityService, SecurityStore
         self.security = SecurityService(

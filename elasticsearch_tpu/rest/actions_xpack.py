@@ -131,6 +131,141 @@ def register_xpack(rc: RestController, node: Node) -> None:
     rc.register("DELETE", "/_slm/policy/{id}", slm_delete)
     rc.register("POST", "/_slm/policy/{id}/_execute", slm_execute)
 
+    # -------------------------------------------------------------- watcher
+    def watch_put(req):
+        active = req.bool_param("active", True)
+        return 200, node.watcher.put_watch(req.params["id"], req.json() or {},
+                                           active=active)
+
+    def watch_get(req):
+        return 200, node.watcher.get_watch(req.params["id"])
+
+    def watch_delete(req):
+        node.watcher.delete_watch(req.params["id"])
+        return 200, {"found": True, "_id": req.params["id"]}
+
+    def watch_execute(req):
+        body = req.json() or {}
+        record = node.watcher.execute(
+            req.params["id"],
+            trigger_data=body.get("trigger_data"),
+            record_execution=body.get("record_execution", False),
+            alternative_input=body.get("alternative_input"))
+        return 200, {"_id": req.params["id"], "watch_record": record}
+
+    rc.register("PUT", "/_watcher/watch/{id}", watch_put)
+    rc.register("POST", "/_watcher/watch/{id}", watch_put)
+    rc.register("GET", "/_watcher/watch/{id}", watch_get)
+    rc.register("DELETE", "/_watcher/watch/{id}", watch_delete)
+    rc.register("POST", "/_watcher/watch/{id}/_execute", watch_execute)
+    rc.register("PUT", "/_watcher/watch/{id}/_execute", watch_execute)
+
+    def watch_ack_handler(req):
+        action_id = req.params.get("action_id")
+        node.watcher.ack(req.params["id"], [action_id] if action_id else None)
+        return 200, {"status": {"state": {"active": True}}}
+
+    rc.register("POST", "/_watcher/watch/{id}/_ack", watch_ack_handler)
+    rc.register("PUT", "/_watcher/watch/{id}/_ack", watch_ack_handler)
+    rc.register("POST", "/_watcher/watch/{id}/_ack/{action_id}", watch_ack_handler)
+
+    def watch_activate(req):
+        node.watcher.set_active(req.params["id"], True)
+        return 200, {"status": {"state": {"active": True}}}
+
+    def watch_deactivate(req):
+        node.watcher.set_active(req.params["id"], False)
+        return 200, {"status": {"state": {"active": False}}}
+
+    rc.register("POST", "/_watcher/watch/{id}/_activate", watch_activate)
+    rc.register("PUT", "/_watcher/watch/{id}/_activate", watch_activate)
+    rc.register("POST", "/_watcher/watch/{id}/_deactivate", watch_deactivate)
+    rc.register("PUT", "/_watcher/watch/{id}/_deactivate", watch_deactivate)
+
+    def watcher_stats(req):
+        return 200, node.watcher.stats()
+
+    def watcher_start(req):
+        node.watcher.running = True
+        return 200, {"acknowledged": True}
+
+    def watcher_stop(req):
+        node.watcher.running = False
+        return 200, {"acknowledged": True}
+
+    def watcher_tick(req):
+        return 200, {"records": node.watcher.run_once()}
+
+    rc.register("GET", "/_watcher/stats", watcher_stats)
+    rc.register("POST", "/_watcher/_start", watcher_start)
+    rc.register("POST", "/_watcher/_stop", watcher_stop)
+    rc.register("POST", "/_watcher/_tick", watcher_tick)
+
+    # ------------------------------------------------------------ transform
+    def transform_put(req):
+        node.transform.put(req.params["id"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def transform_get(req):
+        return 200, node.transform.get(req.params.get("id"))
+
+    def transform_delete(req):
+        node.transform.delete(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    def transform_start(req):
+        node.transform.start(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    def transform_stop(req):
+        node.transform.stop(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    def transform_stats(req):
+        return 200, node.transform.stats(req.params["id"])
+
+    def transform_preview(req):
+        return 200, node.transform.preview(req.json() or {})
+
+    rc.register("PUT", "/_transform/{id}", transform_put)
+    rc.register("GET", "/_transform/{id}", transform_get)
+    rc.register("GET", "/_transform", transform_get)
+    rc.register("DELETE", "/_transform/{id}", transform_delete)
+    rc.register("POST", "/_transform/{id}/_start", transform_start)
+    rc.register("POST", "/_transform/{id}/_stop", transform_stop)
+    rc.register("GET", "/_transform/{id}/_stats", transform_stats)
+    rc.register("POST", "/_transform/_preview", transform_preview)
+
+    # --------------------------------------------------------------- rollup
+    def rollup_put(req):
+        node.rollup.put_job(req.params["id"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def rollup_get(req):
+        return 200, node.rollup.get_job(req.params.get("id"))
+
+    def rollup_delete(req):
+        node.rollup.delete_job(req.params["id"])
+        return 200, {"acknowledged": True}
+
+    def rollup_start(req):
+        return 200, node.rollup.start_job(req.params["id"])
+
+    def rollup_stop(req):
+        return 200, node.rollup.stop_job(req.params["id"])
+
+    def rollup_caps(req):
+        return 200, node.rollup.caps(req.params.get("index", "_all"))
+
+    rc.register("PUT", "/_rollup/job/{id}", rollup_put)
+    rc.register("GET", "/_rollup/job/{id}", rollup_get)
+    rc.register("GET", "/_rollup/job", rollup_get)
+    rc.register("DELETE", "/_rollup/job/{id}", rollup_delete)
+    rc.register("POST", "/_rollup/job/{id}/_start", rollup_start)
+    rc.register("POST", "/_rollup/job/{id}/_stop", rollup_stop)
+    rc.register("GET", "/_rollup/data/{index}", rollup_caps)
+    rc.register("GET", "/_rollup/data", rollup_caps)
+
     # ------------------------------------------ dynamic index settings
     def put_settings(req):
         body = req.json() or {}

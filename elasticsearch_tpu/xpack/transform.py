@@ -1,0 +1,322 @@
+"""Transform (continuous pivot/latest materialization) + rollup jobs.
+
+Reference: `x-pack/plugin/transform` (11k LoC) — a transform pivots a source
+index through composite aggregations into a dest index, checkpointed on a
+sync field for continuous mode (`TransformIndexer`); `x-pack/plugin/rollup`
+(4.8k) downsamples into rollup docs keyed by date-histogram buckets. Both
+are tick-driven here (`run_once`/`trigger`) like their SchedulerEngine
+scheduling in the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+    ValidationError,
+)
+
+
+def _exact_resolver(node, indices: str):
+    """Field → exact/aggregatable field (.keyword subfield for text), the
+    same resolution the reference's transform does via field_caps."""
+    defs: Dict[str, dict] = {}
+    try:
+        services = node.indices.resolve(indices)
+    except Exception:
+        services = []
+    for svc in services:
+        def walk(props, prefix=""):
+            for fname, fdef in props.items():
+                full = prefix + fname
+                if "properties" in fdef:
+                    walk(fdef["properties"], full + ".")
+                else:
+                    defs[full] = fdef
+        walk(svc.mapper_service.to_dict().get("properties", {}))
+
+    def resolve(field: str) -> str:
+        d = defs.get(field)
+        if d is not None and d.get("type") == "text" and \
+                "keyword" in d.get("fields", {}):
+            return field + ".keyword"
+        return field
+    return resolve
+
+
+def _doc_id_for(keys: Dict[str, Any]) -> str:
+    """Stable dest doc id from group-by values (reference:
+    TransformIndexer creates ids by hashing the composite key)."""
+    blob = json.dumps(keys, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:20]
+
+
+class TransformService:
+    def __init__(self, node):
+        self.node = node
+        self.transforms: Dict[str, dict] = {}
+        self.state: Dict[str, dict] = {}
+
+    # -- CRUD -----------------------------------------------------------------
+    def put(self, transform_id: str, body: dict) -> None:
+        if transform_id in self.transforms:
+            raise ResourceAlreadyExistsError(
+                f"transform [{transform_id}] already exists")
+        if "source" not in body or "dest" not in body:
+            raise ValidationError("transform requires [source] and [dest]")
+        if "pivot" not in body and "latest" not in body:
+            raise ValidationError("transform requires [pivot] or [latest]")
+        self.transforms[transform_id] = body
+        self.state[transform_id] = {"state": "stopped", "checkpoint": 0,
+                                    "docs_indexed": 0, "search_total": 0}
+
+    def get(self, transform_id: Optional[str] = None) -> dict:
+        if transform_id in (None, "_all", "*"):
+            return {"count": len(self.transforms),
+                    "transforms": [{"id": tid, **cfg}
+                                   for tid, cfg in self.transforms.items()]}
+        if transform_id not in self.transforms:
+            raise ResourceNotFoundError(f"transform [{transform_id}] not found")
+        return {"count": 1, "transforms": [{"id": transform_id,
+                                            **self.transforms[transform_id]}]}
+
+    def delete(self, transform_id: str) -> None:
+        if transform_id not in self.transforms:
+            raise ResourceNotFoundError(f"transform [{transform_id}] not found")
+        del self.transforms[transform_id]
+        self.state.pop(transform_id, None)
+
+    def stats(self, transform_id: str) -> dict:
+        if transform_id not in self.transforms:
+            raise ResourceNotFoundError(f"transform [{transform_id}] not found")
+        st = self.state[transform_id]
+        return {"count": 1, "transforms": [{"id": transform_id,
+                                            "state": st["state"],
+                                            "checkpointing": {"last": {
+                                                "checkpoint": st["checkpoint"]}},
+                                            "stats": {
+                                                "documents_indexed":
+                                                st["docs_indexed"]}}]}
+
+    # -- execution ------------------------------------------------------------
+    def start(self, transform_id: str) -> None:
+        if transform_id not in self.transforms:
+            raise ResourceNotFoundError(f"transform [{transform_id}] not found")
+        self.state[transform_id]["state"] = "started"
+        self.trigger(transform_id)
+
+    def stop(self, transform_id: str) -> None:
+        if transform_id not in self.transforms:
+            raise ResourceNotFoundError(f"transform [{transform_id}] not found")
+        self.state[transform_id]["state"] = "stopped"
+
+    def run_once(self) -> None:
+        """Scheduler tick: re-index every started continuous transform."""
+        for tid, cfg in self.transforms.items():
+            if self.state[tid]["state"] == "started" and "sync" in cfg:
+                self.trigger(tid)
+
+    def preview(self, body: dict) -> dict:
+        docs = self._compute(body)
+        return {"preview": docs[:100]}
+
+    def trigger(self, transform_id: str) -> dict:
+        """Run one checkpoint: recompute the pivot and upsert into dest.
+        (The reference advances bucket-by-bucket off change detection; a full
+        recompute reaches the same dest state.)"""
+        cfg = self.transforms[transform_id]
+        st = self.state[transform_id]
+        docs = self._compute(cfg)
+        dest = cfg["dest"]["index"]
+        for doc in docs:
+            self.node.index_doc(dest, doc.pop("_id"), doc)
+        if self.node.indices.exists(dest):
+            self.node.indices.get(dest).refresh()
+        st["checkpoint"] += 1
+        st["docs_indexed"] += len(docs)
+        if "sync" not in cfg:     # batch transform: done after one pass
+            st["state"] = "stopped"
+        return {"documents_indexed": len(docs)}
+
+    def _compute(self, cfg: dict) -> List[dict]:
+        source = cfg["source"]
+        indices = source.get("index")
+        if isinstance(indices, list):
+            indices = ",".join(indices)
+        query = source.get("query", {"match_all": {}})
+        if "pivot" in cfg:
+            return self._compute_pivot(indices, query, cfg["pivot"])
+        return self._compute_latest(indices, query, cfg["latest"])
+
+    def _compute_pivot(self, indices: str, query: dict, pivot: dict) -> List[dict]:
+        group_by = pivot.get("group_by", {})
+        aggs_def = pivot.get("aggregations", pivot.get("aggs", {}))
+        exact = _exact_resolver(self.node, indices)
+        sources = []
+        for name, g in group_by.items():
+            kind, spec = next(iter(g.items()))
+            if "field" in spec:
+                spec = {**spec, "field": exact(spec["field"])}
+            sources.append({name: {kind: spec}})
+        body = {"size": 0, "query": query,
+                "aggs": {"_pivot": {"composite": {"sources": sources,
+                                                  "size": 10000},
+                                    "aggs": aggs_def}}}
+        result = self.node.search(indices, body)
+        docs = []
+        for bucket in result["aggregations"]["_pivot"]["buckets"]:
+            doc = dict(bucket["key"])
+            for agg_name in aggs_def:
+                val = bucket.get(agg_name, {})
+                doc[agg_name] = val.get("value", val)
+            doc["_id"] = _doc_id_for(bucket["key"])
+            docs.append(doc)
+        return docs
+
+    def _compute_latest(self, indices: str, query: dict, latest: dict) -> List[dict]:
+        unique_key = latest["unique_key"]
+        if isinstance(unique_key, str):
+            unique_key = [unique_key]
+        sort_field = latest["sort"]
+        result = self.node.search(indices, {
+            "size": 10000, "query": query,
+            "sort": [{sort_field: {"order": "desc"}}]})
+        seen = set()
+        docs = []
+        for h in result["hits"]["hits"]:
+            src = h["_source"]
+            key = tuple(str(_dot(src, k)) for k in unique_key)
+            if key in seen:
+                continue
+            seen.add(key)
+            docs.append({**src, "_id": _doc_id_for(dict(zip(unique_key, key)))})
+        return docs
+
+
+class RollupService:
+    def __init__(self, node):
+        self.node = node
+        self.jobs: Dict[str, dict] = {}
+        self.state: Dict[str, dict] = {}
+
+    def put_job(self, job_id: str, body: dict) -> None:
+        if job_id in self.jobs:
+            raise ResourceAlreadyExistsError(f"job [{job_id}] already exists")
+        for req in ("index_pattern", "rollup_index", "groups"):
+            if req not in body:
+                raise ValidationError(f"rollup job requires [{req}]")
+        if "date_histogram" not in body["groups"]:
+            raise ValidationError("rollup requires groups.date_histogram")
+        self.jobs[job_id] = body
+        self.state[job_id] = {"job_state": "stopped", "documents_processed": 0,
+                              "rollups_indexed": 0}
+
+    def get_job(self, job_id: Optional[str] = None) -> dict:
+        if job_id in (None, "_all"):
+            jobs = list(self.jobs)
+        else:
+            if job_id not in self.jobs:
+                raise ResourceNotFoundError(f"job [{job_id}] not found")
+            jobs = [job_id]
+        return {"jobs": [{"config": {**self.jobs[j], "id": j},
+                          "status": {"job_state":
+                                     self.state[j]["job_state"]},
+                          "stats": {"rollups_indexed":
+                                    self.state[j]["rollups_indexed"]}}
+                         for j in jobs]}
+
+    def delete_job(self, job_id: str) -> None:
+        if job_id not in self.jobs:
+            raise ResourceNotFoundError(f"job [{job_id}] not found")
+        del self.jobs[job_id]
+        self.state.pop(job_id, None)
+
+    def start_job(self, job_id: str) -> dict:
+        if job_id not in self.jobs:
+            raise ResourceNotFoundError(f"job [{job_id}] not found")
+        self.state[job_id]["job_state"] = "started"
+        self.trigger(job_id)
+        return {"started": True}
+
+    def stop_job(self, job_id: str) -> dict:
+        if job_id not in self.jobs:
+            raise ResourceNotFoundError(f"job [{job_id}] not found")
+        self.state[job_id]["job_state"] = "stopped"
+        return {"stopped": True}
+
+    def trigger(self, job_id: str) -> dict:
+        """Run one rollup pass: composite over (date_histogram [+ terms])
+        with the configured metric sub-aggs, one rollup doc per bucket."""
+        cfg = self.jobs[job_id]
+        groups = cfg["groups"]
+        exact = _exact_resolver(self.node, cfg["index_pattern"])
+        dh = dict(groups["date_histogram"])
+        date_field = dh.pop("field")
+        sources: List[dict] = [
+            {f"{date_field}.date_histogram":
+             {"date_histogram": {"field": date_field, **dh}}}]
+        term_fields = groups.get("terms", {}).get("fields", [])
+        for tf in term_fields:
+            sources.append({f"{tf}.terms": {"terms": {"field": exact(tf)}}})
+        aggs = {}
+        for m in cfg.get("metrics", []):
+            for metric in m.get("metrics", []):
+                agg_kind = "value_count" if metric == "value_count" else metric
+                aggs[f"{m['field']}.{metric}"] = {agg_kind: {"field": m["field"]}}
+        body = {"size": 0,
+                "aggs": {"_rollup": {"composite": {"sources": sources,
+                                                   "size": 10000},
+                                     **({"aggs": aggs} if aggs else {})}}}
+        result = self.node.search(cfg["index_pattern"], body)
+        n = 0
+        for bucket in result["aggregations"]["_rollup"]["buckets"]:
+            doc = {"_rollup.id": job_id, "_rollup.version": 2}
+            for k, v in bucket["key"].items():
+                doc[k] = v
+            doc[f"{date_field}.date_histogram._count"] = bucket["doc_count"]
+            for agg_name in aggs:
+                doc[agg_name] = bucket.get(agg_name, {}).get("value")
+            self.node.index_doc(cfg["rollup_index"],
+                                _doc_id_for(bucket["key"]), doc)
+            n += 1
+        if self.node.indices.exists(cfg["rollup_index"]):
+            self.node.indices.get(cfg["rollup_index"]).refresh()
+        self.state[job_id]["rollups_indexed"] += n
+        return {"rollups_indexed": n}
+
+    def caps(self, index_pattern: str) -> dict:
+        out: Dict[str, Any] = {}
+        for jid, cfg in self.jobs.items():
+            if cfg["index_pattern"] == index_pattern or index_pattern == "_all":
+                out.setdefault(cfg["index_pattern"], {"rollup_jobs": []})
+                out[cfg["index_pattern"]]["rollup_jobs"].append(
+                    {"job_id": jid, "rollup_index": cfg["rollup_index"],
+                     "index_pattern": cfg["index_pattern"],
+                     "fields": self._field_caps(cfg)})
+        return out
+
+    def _field_caps(self, cfg: dict) -> Dict[str, list]:
+        fields: Dict[str, list] = {}
+        dh = cfg["groups"]["date_histogram"]
+        fields[dh["field"]] = [{"agg": "date_histogram",
+                                **{k: v for k, v in dh.items() if k != "field"}}]
+        for tf in cfg["groups"].get("terms", {}).get("fields", []):
+            fields.setdefault(tf, []).append({"agg": "terms"})
+        for m in cfg.get("metrics", []):
+            for metric in m.get("metrics", []):
+                fields.setdefault(m["field"], []).append({"agg": metric})
+        return fields
+
+
+def _dot(src: dict, path: str):
+    cur: Any = src
+    for p in path.split("."):
+        if not isinstance(cur, dict) or p not in cur:
+            return None
+        cur = cur[p]
+    return cur

@@ -1,0 +1,327 @@
+"""Watcher: scheduled alerting — triggers → input → condition → actions.
+
+Reference: `x-pack/plugin/watcher` (25k LoC) — a watch is
+trigger/input/condition/actions (`Watch.java`); `ExecutionService` runs due
+watches, records history, honors acks + throttle periods. Tick-driven here
+(`run_once(now_ms)`) like ILM — the reference's `TickerScheduleTriggerEngine`
+fires the same way off a periodic ticker thread.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    ResourceNotFoundError,
+    ValidationError,
+)
+from elasticsearch_tpu.common.settings import parse_time_value
+
+
+def _get_path(obj: Any, dotted: str) -> Any:
+    cur = obj
+    for part in dotted.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        elif isinstance(cur, list) and part.isdigit() and int(part) < len(cur):
+            cur = cur[int(part)]
+        else:
+            return None
+    return cur
+
+
+def _render_templates(obj: Any, ctx: dict) -> Any:
+    """Render {{ctx.*}} mustache placeholders anywhere in an action/input
+    definition (reference: TextTemplateEngine applied across watch parts)."""
+    from elasticsearch_tpu.script import mustache
+    if isinstance(obj, str):
+        if "{{" in obj:
+            return mustache.render(obj, ctx)
+        return obj
+    if isinstance(obj, dict):
+        return {k: _render_templates(v, ctx) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_render_templates(v, ctx) for v in obj]
+    return obj
+
+
+class WatcherService:
+    def __init__(self, node):
+        self.node = node
+        self.watches: Dict[str, dict] = {}
+        self.state: Dict[str, dict] = {}      # id -> runtime state
+        self.history: List[dict] = []
+        self.running = True
+
+    # -- CRUD -----------------------------------------------------------------
+    def put_watch(self, watch_id: str, body: dict, active: bool = True) -> dict:
+        for part in ("trigger", "actions"):
+            if part not in body:
+                raise ValidationError(f"watch must define [{part}]")
+        created = watch_id not in self.watches
+        self.watches[watch_id] = body
+        self.state[watch_id] = {
+            "active": active, "last_checked": None, "last_met": None,
+            "acked": {}, "last_executed": {},
+            "version": self.state.get(watch_id, {}).get("version", 0) + 1,
+        }
+        return {"_id": watch_id, "created": created,
+                "_version": self.state[watch_id]["version"]}
+
+    def get_watch(self, watch_id: str) -> dict:
+        if watch_id not in self.watches:
+            raise ResourceNotFoundError(f"watch [{watch_id}] not found")
+        st = self.state[watch_id]
+        return {"found": True, "_id": watch_id, "watch": self.watches[watch_id],
+                "status": {"state": {"active": st["active"]},
+                           "actions": {a: {"ack": {"state":
+                                           "acked" if a in st["acked"] else "awaits_successful_execution"}}
+                                       for a in self.watches[watch_id].get("actions", {})},
+                           "version": st["version"]}}
+
+    def delete_watch(self, watch_id: str) -> None:
+        if watch_id not in self.watches:
+            raise ResourceNotFoundError(f"watch [{watch_id}] not found")
+        del self.watches[watch_id]
+        self.state.pop(watch_id, None)
+
+    def set_active(self, watch_id: str, active: bool) -> None:
+        if watch_id not in self.watches:
+            raise ResourceNotFoundError(f"watch [{watch_id}] not found")
+        self.state[watch_id]["active"] = active
+
+    def ack(self, watch_id: str, action_ids: Optional[List[str]] = None) -> None:
+        if watch_id not in self.watches:
+            raise ResourceNotFoundError(f"watch [{watch_id}] not found")
+        actions = self.watches[watch_id].get("actions", {})
+        for a in (action_ids or list(actions)):
+            self.state[watch_id]["acked"][a] = time.time()
+
+    # -- execution ------------------------------------------------------------
+    def _interval_s(self, watch: dict) -> Optional[float]:
+        sched = watch.get("trigger", {}).get("schedule", {})
+        if "interval" in sched:
+            return parse_time_value(sched["interval"], "interval")
+        # cron/hourly/daily schedules fire whenever ticked (tests drive ticks)
+        return None
+
+    def run_once(self, now_ms: Optional[int] = None) -> List[dict]:
+        """One scheduler tick: execute every due active watch."""
+        if not self.running:
+            return []
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        results = []
+        for wid in list(self.watches):
+            st = self.state[wid]
+            if not st["active"]:
+                continue
+            interval = self._interval_s(self.watches[wid])
+            if interval is not None and st["last_checked"] is not None and \
+                    now_ms - st["last_checked"] < interval * 1000:
+                continue
+            results.append(self.execute(wid, now_ms=now_ms))
+        return results
+
+    def execute(self, watch_id: str, now_ms: Optional[int] = None,
+                trigger_data: Optional[dict] = None,
+                record_execution: bool = True,
+                alternative_input: Optional[dict] = None) -> dict:
+        if watch_id not in self.watches:
+            raise ResourceNotFoundError(f"watch [{watch_id}] not found")
+        watch = self.watches[watch_id]
+        st = self.state[watch_id]
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+        st["last_checked"] = now_ms
+        payload = (alternative_input if alternative_input is not None
+                   else self._run_input(watch.get("input", {"none": {}})))
+        ctx = {"ctx": {"watch_id": watch_id, "payload": payload,
+                       "execution_time": now_ms,
+                       "trigger": trigger_data or {}}}
+        met = self._check_condition(watch.get("condition", {"always": {}}), ctx)
+        record = {"watch_id": watch_id, "state": "executed" if met else
+                  "execution_not_needed", "condition_met": met,
+                  "timestamp": now_ms, "actions": []}
+        if met:
+            st["last_met"] = now_ms
+            throttle_s = parse_time_value(
+                watch.get("throttle_period", "0s"), "throttle_period")
+            for name, action in watch.get("actions", {}).items():
+                if name in st["acked"]:
+                    record["actions"].append({"id": name, "status": "acked"})
+                    continue
+                last = st["last_executed"].get(name)
+                if throttle_s and last is not None and \
+                        now_ms - last < throttle_s * 1000:
+                    record["actions"].append({"id": name, "status": "throttled"})
+                    continue
+                status = self._run_action(name, action, ctx)
+                st["last_executed"][name] = now_ms
+                record["actions"].append(status)
+        else:
+            # condition went false → acks reset (reference ack semantics)
+            st["acked"].clear()
+        if record_execution:
+            self.history.append(record)
+            if len(self.history) > 10_000:
+                del self.history[:5_000]
+        return record
+
+    def _run_input(self, input_def: dict) -> dict:
+        if "search" in input_def:
+            request = input_def["search"].get("request", {})
+            indices = request.get("indices", ["*"])
+            if isinstance(indices, str):
+                indices = [indices]
+            body = request.get("body", {})
+            result = self.node.search(",".join(indices), body)
+            return result
+        if "simple" in input_def:
+            return dict(input_def["simple"])
+        if "http" in input_def:
+            # no egress in this environment; record the intent
+            return {"_http_input_skipped": True}
+        return {}
+
+    def _check_condition(self, cond: dict, ctx: dict) -> bool:
+        if "always" in cond:
+            return True
+        if "never" in cond:
+            return False
+        if "compare" in cond:
+            for path, check in cond["compare"].items():
+                value = _get_path(ctx, path)
+                for op, expected in check.items():
+                    if not _compare(op, value, expected):
+                        return False
+            return True
+        if "array_compare" in cond:
+            for path, spec in cond["array_compare"].items():
+                arr = _get_path(ctx, path) or []
+                sub = spec.get("path", "")
+                for op, rule in ((k, v) for k, v in spec.items() if k != "path"):
+                    quantifier = rule.get("quantifier", "some")
+                    expected = rule.get("value")
+                    hits = [
+                        _compare(op, _get_path(item, sub) if sub else item,
+                                 expected) for item in arr]
+                    ok = all(hits) if quantifier == "all" else any(hits)
+                    if not ok:
+                        return False
+            return True
+        if "script" in cond:
+            return self._script_condition(cond["script"], ctx)
+        raise IllegalArgumentError(f"unknown condition type {list(cond)}")
+
+    def _script_condition(self, spec, ctx: dict) -> bool:
+        import ast
+        resolved = self.node.scripts.resolve(spec)
+        source = resolved["source"]
+        params = resolved["params"]
+        tree = ast.parse(source, mode="eval")
+        env = {"ctx": ctx["ctx"], "params": params}
+
+        def ev(node):
+            if isinstance(node, ast.Expression):
+                return ev(node.body)
+            if isinstance(node, ast.Constant):
+                return node.value
+            if isinstance(node, ast.Name):
+                if node.id in env:
+                    return env[node.id]
+                raise IllegalArgumentError(f"unknown variable [{node.id}]")
+            if isinstance(node, ast.Attribute):
+                base = ev(node.value)
+                if isinstance(base, dict) and node.attr in base:
+                    return base[node.attr]
+                return None
+            if isinstance(node, ast.Subscript):
+                base = ev(node.value)
+                key = ev(node.slice)
+                try:
+                    return base[key]
+                except Exception:
+                    return None
+            if isinstance(node, ast.Compare):
+                left = ev(node.left)
+                ok = True
+                for op, comp in zip(node.ops, node.comparators):
+                    right = ev(comp)
+                    ops = {ast.Eq: lambda a, b: a == b,
+                           ast.NotEq: lambda a, b: a != b,
+                           ast.Lt: lambda a, b: a < b,
+                           ast.LtE: lambda a, b: a <= b,
+                           ast.Gt: lambda a, b: a > b,
+                           ast.GtE: lambda a, b: a >= b}
+                    try:
+                        ok = ok and ops[type(op)](left, right)
+                    except TypeError:
+                        return False
+                    left = right
+                return ok
+            if isinstance(node, ast.BoolOp):
+                vals = [ev(v) for v in node.values]
+                return all(vals) if isinstance(node.op, ast.And) else any(vals)
+            if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+                return not ev(node.operand)
+            if isinstance(node, ast.BinOp):
+                import operator as _op
+                ops = {ast.Add: _op.add, ast.Sub: _op.sub, ast.Mult: _op.mul,
+                       ast.Div: _op.truediv, ast.Mod: _op.mod}
+                return ops[type(node.op)](ev(node.left), ev(node.right))
+            raise IllegalArgumentError(
+                f"script condition construct [{type(node).__name__}] not allowed")
+
+        return bool(ev(tree))
+
+    def _run_action(self, name: str, action: dict, ctx: dict) -> dict:
+        rendered = _render_templates(action, ctx)
+        if "logging" in rendered:
+            text = rendered["logging"].get("text", "")
+            return {"id": name, "type": "logging", "status": "success",
+                    "logging": {"logged_text": text}}
+        if "index" in rendered:
+            spec = rendered["index"]
+            doc = ctx["ctx"]["payload"]
+            if "_doc" in spec:
+                doc = spec["_doc"]
+            result = self.node.index_doc(spec["index"], spec.get("doc_id"), doc)
+            return {"id": name, "type": "index", "status": "success",
+                    "index": {"response": {"index": spec["index"],
+                                           "result": result.get("result",
+                                                                "created")}}}
+        if "webhook" in rendered:
+            # zero-egress environment: record, don't send
+            return {"id": name, "type": "webhook", "status": "simulated",
+                    "webhook": {"request": rendered["webhook"]}}
+        if "email" in rendered:
+            return {"id": name, "type": "email", "status": "simulated"}
+        return {"id": name, "type": "unknown", "status": "failure",
+                "reason": f"unsupported action {list(action)}"}
+
+    def stats(self) -> dict:
+        return {"watcher_state": "started" if self.running else "stopped",
+                "watch_count": len(self.watches),
+                "execution_history_count": len(self.history)}
+
+
+def _compare(op: str, value, expected) -> bool:
+    try:
+        if op == "eq":
+            return value == expected
+        if op == "not_eq":
+            return value != expected
+        if value is None:
+            return False
+        if op == "gt":
+            return value > expected
+        if op == "gte":
+            return value >= expected
+        if op == "lt":
+            return value < expected
+        if op == "lte":
+            return value <= expected
+    except TypeError:
+        return False
+    raise IllegalArgumentError(f"unknown compare operator [{op}]")

@@ -1,0 +1,223 @@
+"""Watcher, transform, and rollup.
+
+Reference behaviors: x-pack/plugin/watcher (trigger/input/condition/actions,
+ack + throttle), x-pack/plugin/transform (pivot + latest into dest index),
+x-pack/plugin/rollup (date-histogram downsampling).
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+# ------------------------------------------------------------------ watcher
+
+def _error_watch():
+    return {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"search": {"request": {
+            "indices": ["logs"],
+            "body": {"query": {"term": {"level": "error"}}}}}},
+        "condition": {"compare": {"ctx.payload.hits.total.value": {"gt": 0}}},
+        "actions": {"store": {"index": {"index": "alerts"}}},
+    }
+
+
+def test_watch_crud(client):
+    st, body = client.req("PUT", "/_watcher/watch/w1", _error_watch())
+    assert st == 200 and body["created"]
+    st, body = client.req("GET", "/_watcher/watch/w1")
+    assert body["found"] and "trigger" in body["watch"]
+    st, _ = client.req("DELETE", "/_watcher/watch/w1")
+    assert st == 200
+    st, _ = client.req("GET", "/_watcher/watch/w1")
+    assert st == 404
+
+
+def test_watch_condition_and_index_action(client, node):
+    client.req("PUT", "/logs/_doc/1", {"level": "info", "msg": "ok"})
+    client.req("POST", "/logs/_refresh")
+    client.req("PUT", "/_watcher/watch/errs", _error_watch())
+    # no errors yet → condition false
+    record = node.watcher.execute("errs")
+    assert record["condition_met"] is False
+    # add an error → condition true, index action fires
+    client.req("PUT", "/logs/_doc/2", {"level": "error", "msg": "boom"})
+    client.req("POST", "/logs/_refresh")
+    record = node.watcher.execute("errs")
+    assert record["condition_met"] is True
+    assert record["actions"][0]["status"] == "success"
+    client.req("POST", "/alerts/_refresh")
+    st, body = client.req("GET", "/_alerts/_count") if False else client.req("GET", "/alerts/_count")
+    assert body["count"] == 1
+
+
+def test_watch_interval_scheduling(client, node):
+    client.req("PUT", "/_watcher/watch/tick", {
+        "trigger": {"schedule": {"interval": "10s"}},
+        "input": {"simple": {"n": 1}},
+        "condition": {"always": {}},
+        "actions": {"log": {"logging": {"text": "fired"}}}})
+    t0 = 1_000_000_000_000
+    assert len(node.watcher.run_once(now_ms=t0)) == 1
+    # 5s later: not due
+    assert len(node.watcher.run_once(now_ms=t0 + 5_000)) == 0
+    # 11s later: due again
+    assert len(node.watcher.run_once(now_ms=t0 + 11_000)) == 1
+
+
+def test_watch_throttle_and_ack(client, node):
+    client.req("PUT", "/_watcher/watch/tw", {
+        "trigger": {"schedule": {"interval": "1s"}},
+        "input": {"simple": {}},
+        "condition": {"always": {}},
+        "throttle_period": "60s",
+        "actions": {"log": {"logging": {"text": "x"}}}})
+    t0 = 1_000_000_000_000
+    r1 = node.watcher.execute("tw", now_ms=t0)
+    assert r1["actions"][0]["status"] == "success"
+    r2 = node.watcher.execute("tw", now_ms=t0 + 10_000)
+    assert r2["actions"][0]["status"] == "throttled"
+    # ack suppresses even past throttle
+    client.req("POST", "/_watcher/watch/tw/_ack")
+    r3 = node.watcher.execute("tw", now_ms=t0 + 120_000)
+    assert r3["actions"][0]["status"] == "acked"
+
+
+def test_watch_mustache_in_action(client, node):
+    client.req("PUT", "/_watcher/watch/tpl", {
+        "trigger": {"schedule": {"interval": "1s"}},
+        "input": {"simple": {"who": "world"}},
+        "condition": {"always": {}},
+        "actions": {"log": {"logging": {"text": "hello {{ctx.payload.who}}"}}}})
+    record = node.watcher.execute("tpl")
+    assert record["actions"][0]["logging"]["logged_text"] == "hello world"
+
+
+def test_watch_script_condition(client, node):
+    client.req("PUT", "/_watcher/watch/sc", {
+        "trigger": {"schedule": {"interval": "1s"}},
+        "input": {"simple": {"value": 42}},
+        "condition": {"script": {"source": "ctx.payload.value > params.lim",
+                                 "params": {"lim": 40}}},
+        "actions": {"log": {"logging": {"text": "big"}}}})
+    assert node.watcher.execute("sc")["condition_met"] is True
+
+
+def test_watch_activate_deactivate(client, node):
+    client.req("PUT", "/_watcher/watch/onoff", {
+        "trigger": {"schedule": {"interval": "1s"}},
+        "input": {"simple": {}}, "condition": {"always": {}},
+        "actions": {"log": {"logging": {"text": "x"}}}})
+    client.req("POST", "/_watcher/watch/onoff/_deactivate")
+    assert node.watcher.run_once(now_ms=123456789) == []
+    client.req("POST", "/_watcher/watch/onoff/_activate")
+    assert len(node.watcher.run_once(now_ms=123456789)) == 1
+
+
+# ---------------------------------------------------------------- transform
+
+def _seed_sales(client):
+    sales = [("a", "2024-01-01T10:00:00Z", 10), ("a", "2024-01-01T11:00:00Z", 20),
+             ("b", "2024-01-01T10:30:00Z", 5), ("b", "2024-01-02T09:00:00Z", 7),
+             ("a", "2024-01-02T12:00:00Z", 30)]
+    for i, (cust, ts, amt) in enumerate(sales):
+        client.req("PUT", f"/sales/_doc/{i}",
+                   {"customer": cust, "ts": ts, "amount": amt})
+    client.req("POST", "/sales/_refresh")
+
+
+def test_transform_pivot(client, node):
+    _seed_sales(client)
+    st, _ = client.req("PUT", "/_transform/by-customer", {
+        "source": {"index": "sales"},
+        "dest": {"index": "customer-totals"},
+        "pivot": {
+            "group_by": {"customer": {"terms": {"field": "customer"}}},
+            "aggregations": {"total": {"sum": {"field": "amount"}},
+                             "avg_amount": {"avg": {"field": "amount"}}}}})
+    assert st == 200
+    client.req("POST", "/_transform/by-customer/_start")
+    st, body = client.req("GET", "/customer-totals/_search",
+                          {"query": {"term": {"customer": "a"}}})
+    hit = body["hits"]["hits"][0]["_source"]
+    assert hit["total"] == 60.0
+    assert hit["avg_amount"] == 20.0
+    st, body = client.req("GET", "/_transform/by-customer/_stats")
+    assert body["transforms"][0]["stats"]["documents_indexed"] == 2
+
+
+def test_transform_preview(client):
+    _seed_sales(client)
+    st, body = client.req("POST", "/_transform/_preview", {
+        "source": {"index": "sales"}, "dest": {"index": "x"},
+        "pivot": {"group_by": {"customer": {"terms": {"field": "customer"}}},
+                  "aggregations": {"n": {"value_count": {"field": "amount"}}}}})
+    assert st == 200
+    assert len(body["preview"]) == 2
+
+
+def test_transform_latest(client, node):
+    _seed_sales(client)
+    client.req("PUT", "/_transform/latest-sale", {
+        "source": {"index": "sales"},
+        "dest": {"index": "latest-sales"},
+        "latest": {"unique_key": ["customer"], "sort": "ts"}})
+    client.req("POST", "/_transform/latest-sale/_start")
+    st, body = client.req("GET", "/latest-sales/_search",
+                          {"query": {"term": {"customer": "a"}}})
+    assert body["hits"]["hits"][0]["_source"]["amount"] == 30
+
+
+# ------------------------------------------------------------------- rollup
+
+def test_rollup_job(client, node):
+    _seed_sales(client)
+    st, _ = client.req("PUT", "/_rollup/job/daily", {
+        "index_pattern": "sales",
+        "rollup_index": "sales-rollup",
+        "cron": "0 0 * * * ?",
+        "groups": {
+            "date_histogram": {"field": "ts", "calendar_interval": "1d"},
+            "terms": {"fields": ["customer"]}},
+        "metrics": [{"field": "amount", "metrics": ["sum", "max"]}]})
+    assert st == 200
+    st, _ = client.req("POST", "/_rollup/job/daily/_start")
+    client.req("POST", "/sales-rollup/_refresh")
+    st, body = client.req("POST", "/sales-rollup/_search",
+                          {"size": 10, "query": {"match_all": {}}})
+    docs = [h["_source"] for h in body["hits"]["hits"]]
+    assert len(docs) == 4   # 2 days x 2 customers (a has both days, b both)
+    day1_a = [d for d in docs
+              if d["customer.terms"] == "a" and "amount.sum" in d]
+    assert any(d["amount.sum"] == 30.0 for d in day1_a)
+    st, body = client.req("GET", "/_rollup/data/sales")
+    assert "sales" in body
+    assert body["sales"]["rollup_jobs"][0]["job_id"] == "daily"
