@@ -107,6 +107,9 @@ class Node:
         self.watcher = WatcherService(self)
         self.transform = TransformService(self)
         self.rollup = RollupService(self)
+        from elasticsearch_tpu.xpack.ccr import CcrService, RemoteClusterService
+        self.remotes = RemoteClusterService(self)
+        self.ccr = CcrService(self)
         self.settings = settings or {}
         from elasticsearch_tpu.security import SecurityService, SecurityStore
         self.security = SecurityService(
@@ -314,6 +317,14 @@ class Node:
     # ---------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
         body = body or {}
+        # cross-cluster search: split `alias:index` parts, fan out, merge
+        # (reference: TransportSearchAction + SearchResponseMerger)
+        if index_expr and ":" in index_expr:
+            from elasticsearch_tpu.xpack.ccr import merge_ccs_responses
+            local_expr, remote_exprs = self.remotes.split_indices(index_expr)
+            remote_resps = self.remotes.search_remotes(remote_exprs, body)
+            local_resp = self.search(local_expr, body) if local_expr else None
+            return merge_ccs_responses(local_resp, remote_resps, body)
         start = time.perf_counter()
         services = self.indices.resolve(index_expr)
         readers = []

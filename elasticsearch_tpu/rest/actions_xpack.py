@@ -266,6 +266,56 @@ def register_xpack(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_rollup/data/{index}", rollup_caps)
     rc.register("GET", "/_rollup/data", rollup_caps)
 
+    # ----------------------------------------------------------- CCR / CCS
+    def remote_info(req):
+        return 200, node.remotes.info()
+
+    rc.register("GET", "/_remote/info", remote_info)
+
+    def ccr_follow(req):
+        return 200, node.ccr.follow(req.params["index"], req.json() or {})
+
+    def ccr_pause(req):
+        node.ccr.pause(req.params["index"])
+        return 200, {"acknowledged": True}
+
+    def ccr_resume(req):
+        node.ccr.resume(req.params["index"])
+        return 200, {"acknowledged": True}
+
+    def ccr_unfollow(req):
+        node.ccr.unfollow(req.params["index"])
+        return 200, {"acknowledged": True}
+
+    def ccr_stats(req):
+        return 200, node.ccr.stats()
+
+    def ccr_follow_info(req):
+        return 200, node.ccr.follow_info(req.params.get("index", "_all"))
+
+    rc.register("PUT", "/{index}/_ccr/follow", ccr_follow)
+    rc.register("POST", "/{index}/_ccr/pause_follow", ccr_pause)
+    rc.register("POST", "/{index}/_ccr/resume_follow", ccr_resume)
+    rc.register("POST", "/{index}/_ccr/unfollow", ccr_unfollow)
+    rc.register("GET", "/{index}/_ccr/info", ccr_follow_info)
+    rc.register("GET", "/_ccr/stats", ccr_stats)
+
+    def auto_follow_put(req):
+        node.ccr.put_auto_follow(req.params["name"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def auto_follow_get(req):
+        return 200, node.ccr.get_auto_follow(req.params.get("name"))
+
+    def auto_follow_delete(req):
+        node.ccr.delete_auto_follow(req.params["name"])
+        return 200, {"acknowledged": True}
+
+    rc.register("PUT", "/_ccr/auto_follow/{name}", auto_follow_put)
+    rc.register("GET", "/_ccr/auto_follow/{name}", auto_follow_get)
+    rc.register("GET", "/_ccr/auto_follow", auto_follow_get)
+    rc.register("DELETE", "/_ccr/auto_follow/{name}", auto_follow_delete)
+
     # ------------------------------------------ dynamic index settings
     def put_settings(req):
         body = req.json() or {}

@@ -1,0 +1,318 @@
+"""Cross-cluster search (CCS) + cross-cluster replication (CCR).
+
+Reference:
+- CCS: `transport/RemoteClusterService.java` — remote clusters registered
+  under `cluster.remote.{alias}` settings; `TransportSearchAction` splits
+  `remote:index` expressions, fans out, and merges shard results.
+- CCR: `x-pack/plugin/ccr` (9.4k LoC) — follower shards long-poll the
+  leader's operation history (`ShardChangesAction.java:59`) above a
+  checkpoint, guarded by retention leases; auto-follow patterns create
+  followers for new leader indices (`AutoFollowCoordinator`).
+
+Here a "remote cluster" is another Node reachable in-process (the analog of
+the reference's in-JVM `InternalTestCluster` wiring — production would dial
+the HTTP/RPC layer; the merge/checkpoint logic is identical either way).
+Change-tailing reads docs above the follower's seq_no checkpoint from the
+leader's readers, plus an id-level anti-join for deletes.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    ResourceNotFoundError,
+)
+
+
+class RemoteClusterService:
+    """alias → remote node registry (reference: RemoteClusterService)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.remotes: Dict[str, Any] = {}
+        self.seeds: Dict[str, List[str]] = {}
+
+    def register(self, alias: str, remote_node) -> None:
+        self.remotes[alias] = remote_node
+        self.seeds.setdefault(alias, [f"in-process:{id(remote_node):x}"])
+
+    def unregister(self, alias: str) -> None:
+        self.remotes.pop(alias, None)
+        self.seeds.pop(alias, None)
+
+    def get(self, alias: str):
+        if alias not in self.remotes:
+            raise ResourceNotFoundError(f"no such remote cluster: [{alias}]")
+        return self.remotes[alias]
+
+    def info(self) -> dict:
+        return {alias: {"connected": alias in self.remotes,
+                        "mode": "sniff",
+                        "seeds": self.seeds.get(alias, []),
+                        "num_nodes_connected": 1 if alias in self.remotes else 0}
+                for alias in set(self.remotes) | set(self.seeds)}
+
+    # -- CCS ------------------------------------------------------------------
+    @staticmethod
+    def split_indices(index_expr: Optional[str]) -> Tuple[Optional[str],
+                                                          Dict[str, str]]:
+        """'l1,r:idx,r:idx2' → ('l1', {'r': 'idx,idx2'}). A lone '*:*'-style
+        remote part groups by alias like GroupShardsIterator building."""
+        if not index_expr:
+            return index_expr, {}
+        local_parts: List[str] = []
+        remote_parts: Dict[str, List[str]] = {}
+        for part in index_expr.split(","):
+            if ":" in part:
+                alias, _, idx = part.partition(":")
+                remote_parts.setdefault(alias, []).append(idx)
+            else:
+                local_parts.append(part)
+        return (",".join(local_parts) if local_parts else None,
+                {a: ",".join(ps) for a, ps in remote_parts.items()})
+
+    def search_remotes(self, remote_exprs: Dict[str, str],
+                       body: dict) -> List[dict]:
+        """Run the query on each remote; return per-cluster responses with
+        hits re-labelled `alias:index` like the reference's CCS merge."""
+        responses = []
+        for alias, expr in remote_exprs.items():
+            remote = self.get(alias)
+            resp = remote.search(expr, body)
+            for h in resp.get("hits", {}).get("hits", []):
+                h["_index"] = f"{alias}:{h['_index']}"
+            responses.append(resp)
+        return responses
+
+
+def merge_ccs_responses(local: Optional[dict], remotes: List[dict],
+                        body: dict) -> dict:
+    """Merge coordinator-side: concatenate hit lists, re-sort by score (or
+    sort values), recompute totals (reference: SearchResponseMerger)."""
+    responses = ([local] if local else []) + remotes
+    if not responses:
+        return {"hits": {"total": {"value": 0, "relation": "eq"},
+                         "hits": [], "max_score": None}}
+    if len(responses) == 1:
+        return responses[0]
+    size = int((body or {}).get("size", 10))
+    all_hits = []
+    total = 0
+    relation = "eq"
+    took = 0
+    for r in responses:
+        h = r.get("hits", {})
+        all_hits.extend(h.get("hits", []))
+        total += h.get("total", {}).get("value", 0)
+        if h.get("total", {}).get("relation") == "gte":
+            relation = "gte"
+        took = max(took, r.get("took", 0))
+    if (body or {}).get("sort"):
+        # trust per-response sort ordering; merge by sort values
+        def key(h):
+            sv = h.get("sort", [])
+            return tuple(sv)
+        try:
+            all_hits.sort(key=key)
+        except TypeError:
+            pass
+    else:
+        all_hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+    all_hits = all_hits[:size]
+    max_score = max((h.get("_score") or 0.0 for h in all_hits), default=None)
+    merged = {
+        "took": took, "timed_out": False,
+        "_shards": {"total": sum(r.get("_shards", {}).get("total", 0)
+                                 for r in responses),
+                    "successful": sum(r.get("_shards", {}).get("successful", 0)
+                                      for r in responses),
+                    "skipped": 0, "failed": 0},
+        "_clusters": {"total": len(responses), "successful": len(responses),
+                      "skipped": 0},
+        "hits": {"total": {"value": total, "relation": relation},
+                 "max_score": max_score, "hits": all_hits},
+    }
+    # aggregations merge across clusters needs the full reduce tree; only
+    # single-source agg responses pass through (reference merges via
+    # InternalAggregation.reduce — multi-cluster agg reduce is future work)
+    agg_sources = [r for r in responses if r.get("aggregations")]
+    if len(agg_sources) == 1:
+        merged["aggregations"] = agg_sources[0]["aggregations"]
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# CCR
+# ---------------------------------------------------------------------------
+
+class CcrService:
+    def __init__(self, node):
+        self.node = node
+        # follower index -> config + replication state
+        self.followers: Dict[str, dict] = {}
+        self.auto_follow: Dict[str, dict] = {}
+
+    # -- follow lifecycle -----------------------------------------------------
+    def follow(self, follower_index: str, body: dict) -> dict:
+        remote = body.get("remote_cluster")
+        leader = body.get("leader_index")
+        if not remote or not leader:
+            raise IllegalArgumentError(
+                "follow requires [remote_cluster] and [leader_index]")
+        leader_node = self.node.remotes.get(remote)
+        leader_svc = leader_node.indices.get(leader)
+        if not self.node.indices.exists(follower_index):
+            self.node.indices.create_index(
+                follower_index,
+                settings=body.get("settings"),
+                mappings=leader_svc.mapper_service.to_dict())
+        self.followers[follower_index] = {
+            "remote_cluster": remote, "leader_index": leader,
+            "status": "active", "checkpoint": -1,
+            "operations_written": 0, "last_poll": None,
+        }
+        self.poll(follower_index)
+        return {"follow_index_created": True,
+                "follow_index_shards_acked": True, "index_following_started": True}
+
+    def pause(self, follower_index: str) -> None:
+        self._follower(follower_index)["status"] = "paused"
+
+    def resume(self, follower_index: str) -> None:
+        self._follower(follower_index)["status"] = "active"
+        self.poll(follower_index)
+
+    def unfollow(self, follower_index: str) -> None:
+        if self._follower(follower_index)["status"] != "paused":
+            raise IllegalArgumentError(
+                f"cannot convert follower [{follower_index}] to a normal "
+                "index: pause following first")
+        del self.followers[follower_index]
+
+    def _follower(self, follower_index: str) -> dict:
+        if follower_index not in self.followers:
+            raise ResourceNotFoundError(
+                f"follower index [{follower_index}] does not exist")
+        return self.followers[follower_index]
+
+    # -- replication ----------------------------------------------------------
+    def poll(self, follower_index: str) -> dict:
+        """One change-tailing round (reference: ShardChangesAction request
+        above the follower checkpoint + applying ops via the follow task)."""
+        cfg = self._follower(follower_index)
+        if cfg["status"] != "active":
+            return {"operations": 0}
+        leader_node = self.node.remotes.get(cfg["remote_cluster"])
+        leader_svc = leader_node.indices.get(cfg["leader_index"])
+        leader_svc.refresh()
+        reader = leader_svc.combined_reader()
+        ops = 0
+        leader_live_ids = set()
+        max_seq = cfg["checkpoint"]
+        for view in reader.views:
+            seg = view.segment
+            for local in range(seg.num_docs):
+                if not view.live[local]:
+                    continue
+                leader_live_ids.add(seg.ids[local])
+                seq = int(seg.seq_nos[local])
+                if seq <= cfg["checkpoint"]:
+                    continue
+                self.node.index_doc(follower_index, seg.ids[local],
+                                    seg.sources[local])
+                ops += 1
+                max_seq = max(max_seq, seq)
+        # deletes: anti-join follower ids against leader live set
+        follower_svc = self.node.indices.get(follower_index)
+        follower_svc.refresh()
+        freader = follower_svc.combined_reader()
+        for view in freader.views:
+            seg = view.segment
+            for local in range(seg.num_docs):
+                if not view.live[local]:
+                    continue
+                if seg.ids[local] not in leader_live_ids:
+                    self.node.delete_doc(follower_index, seg.ids[local])
+                    ops += 1
+        follower_svc.refresh()
+        cfg["checkpoint"] = max_seq
+        cfg["operations_written"] += ops
+        cfg["last_poll"] = time.time()
+        return {"operations": ops}
+
+    def run_once(self) -> dict:
+        """Scheduler tick: poll all active followers + evaluate auto-follow."""
+        results = {}
+        for name in list(self.followers):
+            if self.followers[name]["status"] == "active":
+                results[name] = self.poll(name)["operations"]
+        self._auto_follow_tick()
+        return results
+
+    # -- auto-follow ----------------------------------------------------------
+    def put_auto_follow(self, name: str, body: dict) -> None:
+        if not body.get("remote_cluster") or not body.get("leader_index_patterns"):
+            raise IllegalArgumentError(
+                "auto-follow requires [remote_cluster] and [leader_index_patterns]")
+        self.auto_follow[name] = body
+
+    def get_auto_follow(self, name: Optional[str] = None) -> dict:
+        if name is None:
+            return {"patterns": [{"name": n, "pattern": p}
+                                 for n, p in self.auto_follow.items()]}
+        if name not in self.auto_follow:
+            raise ResourceNotFoundError(f"auto-follow pattern [{name}] missing")
+        return {"patterns": [{"name": name, "pattern": self.auto_follow[name]}]}
+
+    def delete_auto_follow(self, name: str) -> None:
+        if name not in self.auto_follow:
+            raise ResourceNotFoundError(f"auto-follow pattern [{name}] missing")
+        del self.auto_follow[name]
+
+    def _auto_follow_tick(self) -> None:
+        for pat_name, pat in self.auto_follow.items():
+            remote = pat["remote_cluster"]
+            try:
+                leader_node = self.node.remotes.get(remote)
+            except ResourceNotFoundError:
+                continue
+            suffix = pat.get("follow_index_pattern", "{{leader_index}}")
+            for leader_name in list(leader_node.indices.indices):
+                if not any(fnmatch.fnmatchcase(leader_name, p)
+                           for p in pat["leader_index_patterns"]):
+                    continue
+                follower_name = suffix.replace("{{leader_index}}", leader_name)
+                if follower_name in self.followers or \
+                        self.node.indices.exists(follower_name):
+                    continue
+                self.follow(follower_name, {"remote_cluster": remote,
+                                            "leader_index": leader_name})
+
+    # -- stats ----------------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "auto_follow_stats": {
+                "number_of_successful_follow_indices": len(self.followers)},
+            "follow_stats": {"indices": [
+                {"index": name,
+                 "shards": [{"remote_cluster": cfg["remote_cluster"],
+                             "leader_index": cfg["leader_index"],
+                             "follower_index": name,
+                             "follower_global_checkpoint": cfg["checkpoint"],
+                             "operations_written": cfg["operations_written"]}]}
+                for name, cfg in self.followers.items()]},
+        }
+
+    def follow_info(self, index_expr: str) -> dict:
+        out = []
+        for name, cfg in self.followers.items():
+            if index_expr in ("_all", "*", name):
+                out.append({"follower_index": name,
+                            "remote_cluster": cfg["remote_cluster"],
+                            "leader_index": cfg["leader_index"],
+                            "status": cfg["status"]})
+        return {"follower_indices": out}

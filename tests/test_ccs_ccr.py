@@ -1,0 +1,142 @@
+"""Cross-cluster search + cross-cluster replication.
+
+Reference behaviors: RemoteClusterService + SearchResponseMerger (CCS),
+x-pack/plugin/ccr follower change-tailing, auto-follow patterns.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def clusters(tmp_path):
+    local = Node(str(tmp_path / "local"), cluster_name="local")
+    remote = Node(str(tmp_path / "remote"), cluster_name="east")
+    local.remotes.register("east", remote)
+    yield local, remote
+    local.close()
+    remote.close()
+
+
+# --------------------------------------------------------------------- CCS
+
+def test_ccs_pure_remote_search(clusters):
+    local, remote = clusters
+    remote.index_doc("logs", "1", {"msg": "remote hello"})
+    remote.indices.get("logs").refresh()
+    result = local.search("east:logs", {"query": {"match": {"msg": "hello"}}})
+    assert result["hits"]["total"]["value"] == 1
+    assert result["hits"]["hits"][0]["_index"] == "east:logs"
+
+
+def test_ccs_mixed_local_remote_merge(clusters):
+    local, remote = clusters
+    local.index_doc("logs", "L", {"msg": "hello local"})
+    local.indices.get("logs").refresh()
+    remote.index_doc("logs", "R", {"msg": "hello remote"})
+    remote.indices.get("logs").refresh()
+    result = local.search("logs,east:logs",
+                          {"query": {"match": {"msg": "hello"}}})
+    assert result["hits"]["total"]["value"] == 2
+    indices = {h["_index"] for h in result["hits"]["hits"]}
+    assert indices == {"logs", "east:logs"}
+    assert result["_clusters"]["total"] == 2
+
+
+def test_ccs_remote_info_endpoint(clusters):
+    local, _ = clusters
+    c = Client(local)
+    st, body = c.req("GET", "/_remote/info")
+    assert body["east"]["connected"] is True
+
+
+def test_ccs_unknown_remote_404(clusters):
+    local, _ = clusters
+    with pytest.raises(Exception):
+        local.search("west:idx", {"query": {"match_all": {}}})
+
+
+# --------------------------------------------------------------------- CCR
+
+def test_ccr_follow_replicates_and_tails(clusters):
+    local, remote = clusters
+    remote.index_doc("leader", "1", {"v": "one"})
+    remote.index_doc("leader", "2", {"v": "two"})
+    remote.indices.get("leader").refresh()
+    c = Client(local)
+    st, body = c.req("PUT", "/follower/_ccr/follow",
+                     {"remote_cluster": "east", "leader_index": "leader"})
+    assert st == 200 and body["index_following_started"]
+    # initial copy
+    local.indices.get("follower").refresh()
+    assert local.indices.get("follower").doc_count() == 2
+    # new leader writes arrive on next poll
+    remote.index_doc("leader", "3", {"v": "three"})
+    remote.indices.get("leader").refresh()
+    local.ccr.run_once()
+    assert local.indices.get("follower").doc_count() == 3
+    # deletes propagate
+    remote.delete_doc("leader", "1")
+    remote.indices.get("leader").refresh()
+    local.ccr.run_once()
+    assert local.indices.get("follower").doc_count() == 2
+    st, body = c.req("GET", "/_ccr/stats")
+    shard = body["follow_stats"]["indices"][0]["shards"][0]
+    assert shard["leader_index"] == "leader"
+    assert shard["operations_written"] >= 3
+
+
+def test_ccr_pause_resume_unfollow(clusters):
+    local, remote = clusters
+    remote.index_doc("leader", "1", {"v": 1})
+    remote.indices.get("leader").refresh()
+    c = Client(local)
+    c.req("PUT", "/f2/_ccr/follow",
+          {"remote_cluster": "east", "leader_index": "leader"})
+    c.req("POST", "/f2/_ccr/pause_follow")
+    remote.index_doc("leader", "2", {"v": 2})
+    remote.indices.get("leader").refresh()
+    local.ccr.run_once()
+    local.indices.get("f2").refresh()
+    assert local.indices.get("f2").doc_count() == 1   # paused: no tailing
+    c.req("POST", "/f2/_ccr/resume_follow")
+    assert local.indices.get("f2").doc_count() == 2
+    # unfollow requires pause first
+    st, _ = c.req("POST", "/f2/_ccr/unfollow")
+    assert st == 400
+    c.req("POST", "/f2/_ccr/pause_follow")
+    st, _ = c.req("POST", "/f2/_ccr/unfollow")
+    assert st == 200
+
+
+def test_ccr_auto_follow(clusters):
+    local, remote = clusters
+    c = Client(local)
+    c.req("PUT", "/_ccr/auto_follow/metrics", {
+        "remote_cluster": "east",
+        "leader_index_patterns": ["metrics-*"],
+        "follow_index_pattern": "{{leader_index}}-copy"})
+    remote.index_doc("metrics-2024", "1", {"m": 1})
+    remote.indices.get("metrics-2024").refresh()
+    local.ccr.run_once()
+    assert local.indices.exists("metrics-2024-copy")
+    local.indices.get("metrics-2024-copy").refresh()
+    assert local.indices.get("metrics-2024-copy").doc_count() == 1
+    st, body = c.req("GET", "/_ccr/auto_follow/metrics")
+    assert body["patterns"][0]["name"] == "metrics"
