@@ -323,13 +323,106 @@ class NestedMapper(FieldMapper):
     type_name = "nested"
 
 
+class RankFeatureFieldMapper(FieldMapper):
+    """`rank_feature` (reference: modules/mapper-extras
+    RankFeatureFieldMapper) — positive float consumed by rank_feature
+    queries."""
+
+    type_name = "rank_feature"
+
+    def coerce(self, value) -> float:
+        v = float(value)
+        if v <= 0 and not self.params.get("positive_score_impact", True) is False:
+            if v < 0:
+                raise MapperParsingError(
+                    f"[{self.name}] rank_feature fields only support positive "
+                    f"values, got [{value}]")
+        return v
+
+    def index_terms(self, value):
+        return []
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class RankFeaturesFieldMapper(FieldMapper):
+    """`rank_features`: a sparse map feature→weight."""
+
+    type_name = "rank_features"
+
+    def coerce(self, value) -> dict:
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"[{self.name}] rank_features value must be an object")
+        return {str(k): float(v) for k, v in value.items()}
+
+    def index_terms(self, value):
+        return []
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class JoinFieldMapper(FieldMapper):
+    """`join` (reference: modules/parent-join ParentJoinFieldMapper):
+    relations define parent→children; doc value keeps {name, parent}."""
+
+    type_name = "join"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.relations: Dict[str, List[str]] = {}
+        for parent, children in (self.params.get("relations") or {}).items():
+            self.relations[parent] = (children if isinstance(children, list)
+                                      else [children])
+
+    def coerce(self, value):
+        if isinstance(value, str):
+            return {"name": value}
+        if isinstance(value, dict) and "name" in value:
+            return value
+        raise MapperParsingError(f"[{self.name}] join value must be a "
+                                 f"relation name or {{name, parent}}")
+
+    def index_terms(self, value):
+        return [self.coerce(value)["name"]]
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class PercolatorFieldMapper(FieldMapper):
+    """`percolator` (reference: modules/percolator PercolatorFieldMapper):
+    stores a query to run in reverse against candidate documents."""
+
+    type_name = "percolator"
+
+    def coerce(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"[{self.name}] percolator field must hold a query object")
+        # validate eagerly like the reference (parse at index time)
+        from elasticsearch_tpu.search.queries import parse_query
+        parse_query(value)
+        return value
+
+    def index_terms(self, value):
+        return []
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
 FIELD_TYPES = {
     m.type_name: m
     for m in (KeywordFieldMapper, TextFieldMapper, LongFieldMapper, IntegerFieldMapper,
               ShortFieldMapper, ByteFieldMapper, DoubleFieldMapper, FloatFieldMapper,
               HalfFloatFieldMapper, ScaledFloatFieldMapper, BooleanFieldMapper,
               DateFieldMapper, IpFieldMapper, GeoPointFieldMapper,
-              DenseVectorFieldMapper, ObjectMapper, NestedMapper)
+              DenseVectorFieldMapper, ObjectMapper, NestedMapper,
+              RankFeatureFieldMapper, RankFeaturesFieldMapper,
+              JoinFieldMapper, PercolatorFieldMapper)
 }
 
 
@@ -407,6 +500,9 @@ class MapperService:
 
     def get(self, path: str) -> Optional[FieldMapper]:
         return self._mappers.get(path)
+
+    def all_mappers(self):
+        return list(self._mappers.items())
 
     def field_names(self) -> List[str]:
         return sorted(self._mappers)

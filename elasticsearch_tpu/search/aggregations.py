@@ -128,6 +128,22 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
             return {"value": len(rows)}
         return {"value": len(all_values(ctx, rows, field))}
 
+    if kind in ("geo_bounds", "geo_centroid"):
+        pts = _gather_geo_points(ctx, rows, field)
+        if not pts:
+            return ({"bounds": None} if kind == "geo_bounds"
+                    else {"count": 0})
+        lats = np.asarray([p[1] for p in pts])
+        lons = np.asarray([p[2] for p in pts])
+        if kind == "geo_bounds":
+            return {"bounds": {
+                "top_left": {"lat": float(lats.max()), "lon": float(lons.min())},
+                "bottom_right": {"lat": float(lats.min()),
+                                 "lon": float(lons.max())}}}
+        return {"location": {"lat": float(lats.mean()),
+                             "lon": float(lons.mean())},
+                "count": len(pts)}
+
     if kind == "cardinality":
         values = all_values(ctx, rows, field)
         return {"value": len({_hashable(v) for _, v in values})}
@@ -200,10 +216,12 @@ def _hashable(v):
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
                "filters", "filter", "missing", "global", "composite",
                "significant_terms", "rare_terms", "sampler", "ip_range",
-               "auto_date_histogram", "adjacency_matrix"}
+               "auto_date_histogram", "adjacency_matrix", "geohash_grid",
+               "geotile_grid"}
 METRIC_AGGS = {"avg", "sum", "min", "max", "stats", "extended_stats", "value_count",
                "cardinality", "percentiles", "percentile_ranks", "top_hits",
-               "weighted_avg", "median_absolute_deviation"}
+               "weighted_avg", "median_absolute_deviation", "geo_bounds",
+               "geo_centroid"}
 PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
                  "stats_bucket", "derivative", "cumulative_sum", "bucket_script",
                  "bucket_selector", "bucket_sort", "serial_diff", "moving_fn"}
@@ -270,9 +288,81 @@ def _bucketize(ctx, rows, sub_aggs, buckets: List[Tuple[Any, np.ndarray]],
     return out
 
 
+def _geohash_encode(lat: float, lon: float, precision: int) -> str:
+    """Classic base-32 geohash (reference: Lucene Geohash/`geogrid` aggs)."""
+    base32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    bits = []
+    even = True
+    while len(bits) < precision * 5:
+        if even:
+            mid = (lon_lo + lon_hi) / 2
+            if lon >= mid:
+                bits.append(1)
+                lon_lo = mid
+            else:
+                bits.append(0)
+                lon_hi = mid
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            if lat >= mid:
+                bits.append(1)
+                lat_lo = mid
+            else:
+                bits.append(0)
+                lat_hi = mid
+        even = not even
+    out = []
+    for i in range(0, len(bits), 5):
+        out.append(base32[int("".join(map(str, bits[i:i + 5])), 2)])
+    return "".join(out)
+
+
+def _geotile_encode(lat: float, lon: float, precision: int) -> str:
+    """z/x/y map-tile key (reference: GeoTileUtils.longEncode)."""
+    import math as _m
+    n = 2 ** precision
+    x = int((lon + 180.0) / 360.0 * n)
+    lat_r = _m.radians(max(min(lat, 85.05112878), -85.05112878))
+    y = int((1.0 - _m.log(_m.tan(lat_r) + 1 / _m.cos(lat_r)) / _m.pi) / 2.0 * n)
+    return f"{precision}/{min(max(x, 0), n - 1)}/{min(max(y, 0), n - 1)}"
+
+
+def _gather_geo_points(ctx: SearchContext, rows: np.ndarray, field: str):
+    pts = []
+    for row in rows:
+        v = ctx.reader.get_doc_value(field, int(row))
+        if v is None:
+            continue
+        if isinstance(v, list) and v and isinstance(v[0], (list, tuple)):
+            for p in v:
+                pts.append((int(row), float(p[0]), float(p[1])))
+        elif isinstance(v, (list, tuple)) and len(v) == 2:
+            pts.append((int(row), float(v[0]), float(v[1])))
+    return pts
+
+
 def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                     spec: dict, sub_aggs: dict) -> dict:
     field = spec.get("field")
+
+    if kind in ("geohash_grid", "geotile_grid"):
+        default_prec = 5 if kind == "geohash_grid" else 7
+        precision = int(spec.get("precision", default_prec))
+        encode = _geohash_encode if kind == "geohash_grid" else _geotile_encode
+        cells: Dict[str, List[int]] = {}
+        for row, lat, lon in _gather_geo_points(ctx, rows, field):
+            cells.setdefault(encode(lat, lon, precision), []).append(row)
+        size = int(spec.get("size", 10000))
+        buckets = []
+        for key in sorted(cells, key=lambda k: (-len(cells[k]), k))[:size]:
+            brows = np.asarray(sorted(set(cells[key])), dtype=np.int64)
+            b = {"key": key, "doc_count": int(len(brows))}
+            if sub_aggs:
+                b.update(compute_aggs(ctx, brows, sub_aggs))
+            buckets.append(b)
+        return {"buckets": buckets}
 
     if kind == "filter" or (kind == "filters" and False):
         q = parse_query(spec) if kind == "filter" else None

@@ -1115,6 +1115,13 @@ def parse_query(body: Optional[dict]) -> Query:
                         num_candidates=int(spec.get("num_candidates", spec.get("k", 10))),
                         filter_query=parse_query(spec["filter"]) if "filter" in spec else None,
                         boost=float(spec.get("boost", 1.0)))
+    # extended query types (geo, nested, join, percolate, span, …) register
+    # in queries_ext — the analog of plugin-contributed query parsers
+    # (reference: SearchPlugin.getQueries)
+    from elasticsearch_tpu.search.queries_ext import parse_extended
+    q = parse_extended(kind, spec)
+    if q is not None:
+        return q
     raise ParsingError(f"unknown query [{kind}]")
 
 

@@ -1,0 +1,1048 @@
+"""Extended query types: geo, rank features, MLT, terms_set, nested,
+parent-join, percolate, span, intervals, wrapper, pinned, distance_feature.
+
+Reference directories: `index/query/` (geo_*, more_like_this, terms_set,
+distance_feature, span_*, intervals, wrapper), `modules/percolator`,
+`modules/parent-join`, `modules/mapper-extras` (rank_feature),
+`x-pack/plugin/search-business-rules` (pinned).
+
+Geo distance math runs batched in numpy over the doc-value columns — the
+device analog of the per-doc Lucene loop, and the shape a Pallas kernel
+takes over when candidate sets are large.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from elasticsearch_tpu.search.queries import (
+    BoolQuery,
+    DocSet,
+    Query,
+    SearchContext,
+    parse_query,
+)
+
+EARTH_RADIUS_M = 6371008.8
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _gather_geo(ctx: SearchContext, rows: np.ndarray,
+                field: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(lat[], lon[], present[]) for the rows."""
+    lat = np.zeros(len(rows))
+    lon = np.zeros(len(rows))
+    present = np.zeros(len(rows), dtype=bool)
+    for i, row in enumerate(rows):
+        v = ctx.reader.get_doc_value(field, int(row))
+        if v is None:
+            continue
+        if isinstance(v, list) and v and isinstance(v[0], (list, tuple)):
+            v = v[0]   # multi-valued: first point (reference: MultiGeoPointValues min)
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            lat[i], lon[i] = float(v[0]), float(v[1])
+            present[i] = True
+    return lat, lon, present
+
+
+def haversine_m(lat1, lon1, lat2, lon2):
+    """Great-circle distance in meters, vectorized (reference: Lucene
+    SloppyMath.haversinMeters — exact form here; batch-friendly for MXU)."""
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dp = p2 - p1
+    dl = np.radians(lon2) - np.radians(lon1)
+    a = np.sin(dp / 2) ** 2 + np.cos(p1) * np.cos(p2) * np.sin(dl / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+_DIST_UNITS = {"m": 1.0, "meters": 1.0, "km": 1000.0, "kilometers": 1000.0,
+               "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "ft": 0.3048,
+               "in": 0.0254, "cm": 0.01, "mm": 0.001, "nmi": 1852.0,
+               "nauticalmiles": 1852.0}
+
+
+def parse_distance(v: Any) -> float:
+    """'12km' → meters (reference: DistanceUnit.parse)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip().lower()
+    for unit in sorted(_DIST_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            return float(s[: -len(unit)]) * _DIST_UNITS[unit]
+    return float(s)
+
+
+def parse_geo_point(v: Any) -> Tuple[float, float]:
+    """Accepts {lat, lon}, [lon, lat], 'lat,lon' — returns (lat, lon)."""
+    if isinstance(v, dict):
+        return float(v["lat"]), float(v["lon"])
+    if isinstance(v, (list, tuple)) and len(v) == 2:
+        return float(v[1]), float(v[0])
+    if isinstance(v, str):
+        a, b = v.split(",")
+        return float(a), float(b)
+    raise ParsingError(f"failed to parse geo point [{v}]")
+
+
+def _id_to_row(ctx: SearchContext) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for view in ctx.reader.views:
+        seg = view.segment
+        for local in range(seg.num_docs):
+            if view.live[local]:
+                out[seg.ids[local]] = seg.base + local
+    return out
+
+
+# ---------------------------------------------------------------------------
+# geo queries
+# ---------------------------------------------------------------------------
+
+class GeoDistanceQuery(Query):
+    def __init__(self, field: str, lat: float, lon: float, distance_m: float):
+        self.field = field
+        self.lat = lat
+        self.lon = lon
+        self.distance_m = distance_m
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows = ctx.all_rows()
+        lat, lon, present = _gather_geo(ctx, rows, self.field)
+        d = haversine_m(lat, lon, self.lat, self.lon)
+        mask = present & (d <= self.distance_m)
+        return DocSet(rows[mask], np.ones(int(mask.sum()), dtype=np.float32))
+
+    def to_dict(self):
+        return {"geo_distance": {"distance": f"{self.distance_m}m",
+                                 self.field: {"lat": self.lat, "lon": self.lon}}}
+
+
+class GeoBoundingBoxQuery(Query):
+    def __init__(self, field: str, top: float, left: float,
+                 bottom: float, right: float):
+        self.field = field
+        self.top, self.left, self.bottom, self.right = top, left, bottom, right
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows = ctx.all_rows()
+        lat, lon, present = _gather_geo(ctx, rows, self.field)
+        in_lat = (lat <= self.top) & (lat >= self.bottom)
+        if self.left <= self.right:
+            in_lon = (lon >= self.left) & (lon <= self.right)
+        else:   # crossing the dateline
+            in_lon = (lon >= self.left) | (lon <= self.right)
+        mask = present & in_lat & in_lon
+        return DocSet(rows[mask], np.ones(int(mask.sum()), dtype=np.float32))
+
+    def to_dict(self):
+        return {"geo_bounding_box": {self.field: {
+            "top_left": {"lat": self.top, "lon": self.left},
+            "bottom_right": {"lat": self.bottom, "lon": self.right}}}}
+
+
+class GeoPolygonQuery(Query):
+    def __init__(self, field: str, points: List[Tuple[float, float]]):
+        self.field = field
+        self.points = points    # [(lat, lon)]
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows = ctx.all_rows()
+        lat, lon, present = _gather_geo(ctx, rows, self.field)
+        # vectorized ray casting over the polygon edges
+        inside = np.zeros(len(rows), dtype=bool)
+        pts = self.points
+        n = len(pts)
+        for i in range(n):
+            y1, x1 = pts[i]
+            y2, x2 = pts[(i + 1) % n]
+            cond = ((y1 > lat) != (y2 > lat))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xint = (x2 - x1) * (lat - y1) / (y2 - y1 + 1e-300) + x1
+            inside ^= cond & (lon < xint)
+        mask = present & inside
+        return DocSet(rows[mask], np.ones(int(mask.sum()), dtype=np.float32))
+
+    def to_dict(self):
+        return {"geo_polygon": {self.field: {
+            "points": [{"lat": a, "lon": b} for a, b in self.points]}}}
+
+
+class DistanceFeatureQuery(Query):
+    """Boosts by closeness to an origin: score = boost * pivot/(pivot+dist).
+    Works on geo_point and date fields (reference:
+    DistanceFeatureQueryBuilder)."""
+
+    def __init__(self, field: str, origin: Any, pivot: Any, boost: float = 1.0):
+        self.field = field
+        self.origin = origin
+        self.pivot = pivot
+        self.boost = boost
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows = ctx.all_rows()
+        mapper = ctx.mapper_service.get(self.field)
+        type_name = getattr(mapper, "type_name", None)
+        if type_name == "geo_point":
+            lat0, lon0 = parse_geo_point(self.origin)
+            pivot_m = parse_distance(self.pivot)
+            lat, lon, present = _gather_geo(ctx, rows, self.field)
+            dist = haversine_m(lat, lon, lat0, lon0)
+            score = self.boost * pivot_m / (pivot_m + dist)
+        else:
+            from elasticsearch_tpu.common.settings import parse_time_value
+            from elasticsearch_tpu.index.mapping import parse_date_millis
+            origin_ms = parse_date_millis(self.origin)
+            pivot_ms = parse_time_value(self.pivot, "pivot") * 1000.0
+            vals = np.zeros(len(rows))
+            present = np.zeros(len(rows), dtype=bool)
+            for i, row in enumerate(rows):
+                v = ctx.reader.get_doc_value(self.field, int(row))
+                if v is None:
+                    continue
+                if isinstance(v, list):
+                    v = v[0] if v else None
+                    if v is None:
+                        continue
+                vals[i] = float(v)
+                present[i] = True
+            dist = np.abs(vals - origin_ms)
+            score = self.boost * pivot_ms / (pivot_ms + dist)
+        mask = present
+        return DocSet(rows[mask], score[mask].astype(np.float32))
+
+    def to_dict(self):
+        return {"distance_feature": {"field": self.field,
+                                     "origin": self.origin, "pivot": self.pivot}}
+
+
+# ---------------------------------------------------------------------------
+# rank features
+# ---------------------------------------------------------------------------
+
+class RankFeatureQuery(Query):
+    def __init__(self, field: str, saturation: Optional[dict] = None,
+                 log: Optional[dict] = None, sigmoid: Optional[dict] = None,
+                 linear: Optional[dict] = None, boost: float = 1.0):
+        self.field = field
+        self.saturation = saturation
+        self.log = log
+        self.sigmoid = sigmoid
+        self.linear = linear
+        self.boost = boost
+
+    def _feature_values(self, ctx: SearchContext,
+                        rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        vals = np.zeros(len(rows))
+        present = np.zeros(len(rows), dtype=bool)
+        root, _, feature = self.field.partition(".")
+        mapper = ctx.mapper_service.get(root)
+        use_features_map = (feature and mapper is not None and
+                            getattr(mapper, "type_name", "") == "rank_features")
+        lookup_field = root if use_features_map else self.field
+        for i, row in enumerate(rows):
+            v = ctx.reader.get_doc_value(lookup_field, int(row))
+            if use_features_map and isinstance(v, dict):
+                v = v.get(feature)
+            if isinstance(v, list):
+                v = v[0] if v else None
+            if v is None:
+                continue
+            vals[i] = float(v)
+            present[i] = True
+        return vals, present
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows = ctx.all_rows()
+        vals, present = self._feature_values(ctx, rows)
+        rows = rows[present]
+        v = vals[present]
+        if self.log is not None:
+            score = np.log(float(self.log.get("scaling_factor", 1.0)) + v)
+        elif self.sigmoid is not None:
+            k = float(self.sigmoid["pivot"])
+            a = float(self.sigmoid["exponent"])
+            score = v ** a / (k ** a + v ** a)
+        elif self.linear is not None:
+            score = v
+        else:
+            pivot = float((self.saturation or {}).get(
+                "pivot", max(float(np.mean(v)) if len(v) else 1.0, 1e-9)))
+            score = v / (v + pivot)
+        return DocSet(rows, (self.boost * score).astype(np.float32))
+
+    def to_dict(self):
+        return {"rank_feature": {"field": self.field}}
+
+
+# ---------------------------------------------------------------------------
+# more_like_this
+# ---------------------------------------------------------------------------
+
+class MoreLikeThisQuery(Query):
+    def __init__(self, fields: List[str], like: List[Any],
+                 min_term_freq: int = 2, min_doc_freq: int = 5,
+                 max_query_terms: int = 25,
+                 minimum_should_match: Any = "30%",
+                 include: bool = False):
+        self.fields = fields
+        self.like = like
+        self.min_term_freq = min_term_freq
+        self.min_doc_freq = min_doc_freq
+        self.max_query_terms = max_query_terms
+        self.minimum_should_match = minimum_should_match
+        self.include = include
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        from elasticsearch_tpu.search.queries import MatchNoneQuery, TermQuery
+        id_rows = _id_to_row(ctx)
+        liked_rows: List[int] = []
+        term_freqs: Dict[Tuple[str, str], int] = {}
+        for like in self.like:
+            if isinstance(like, str):
+                texts = {f: like for f in self.fields}
+            elif isinstance(like, dict) and "_id" in like:
+                row = id_rows.get(like["_id"])
+                if row is None:
+                    continue
+                liked_rows.append(row)
+                texts = {}
+                for f in self.fields:
+                    src = self._source_of(ctx, row)
+                    v = src.get(f) if src else None
+                    if isinstance(v, str):
+                        texts[f] = v
+            elif isinstance(like, dict) and "doc" in like:
+                texts = {f: like["doc"].get(f) for f in self.fields
+                         if isinstance(like["doc"].get(f), str)}
+            else:
+                continue
+            for f, text in texts.items():
+                if not text:
+                    continue
+                mapper = ctx.mapper_service.get(f)
+                tokens = (mapper.analyze(text)
+                          if hasattr(mapper, "analyze") else text.lower().split())
+                for t in tokens:
+                    term_freqs[(f, t)] = term_freqs.get((f, t), 0) + 1
+        # select interesting terms by tf·idf (reference: MoreLikeThis.java)
+        n_docs = max(ctx.reader.num_docs, 1)
+        scored_terms = []
+        for (f, t), tf in term_freqs.items():
+            if tf < self.min_term_freq:
+                continue
+            df = ctx.reader.doc_freq(f, t)
+            if df < self.min_doc_freq:
+                continue
+            idf = math.log(1 + (n_docs - df + 0.5) / (df + 0.5))
+            scored_terms.append((tf * idf, f, t))
+        scored_terms.sort(reverse=True)
+        scored_terms = scored_terms[: self.max_query_terms]
+        if not scored_terms:
+            return DocSet.empty()
+        should = [TermQuery(f, t) for _, f, t in scored_terms]
+        inner = BoolQuery(must=[], filter=[], should=should, must_not=[],
+                          minimum_should_match=self.minimum_should_match)
+        result = inner.execute(ctx)
+        if not self.include and liked_rows:
+            mask = ~np.isin(result.rows, np.asarray(liked_rows, dtype=np.int64))
+            result = DocSet(result.rows[mask],
+                            None if result.scores is None
+                            else result.scores[mask])
+        return result
+
+    @staticmethod
+    def _source_of(ctx: SearchContext, row: int) -> Optional[dict]:
+        for view in ctx.reader.views:
+            seg = view.segment
+            if seg.base <= row < seg.base + seg.num_docs:
+                return seg.sources[row - seg.base]
+        return None
+
+    def to_dict(self):
+        return {"more_like_this": {"fields": self.fields, "like": self.like}}
+
+
+# ---------------------------------------------------------------------------
+# terms_set
+# ---------------------------------------------------------------------------
+
+class TermsSetQuery(Query):
+    def __init__(self, field: str, terms: List[Any],
+                 minimum_should_match_field: Optional[str] = None,
+                 minimum_should_match_script: Optional[dict] = None):
+        self.field = field
+        self.terms = terms
+        self.msm_field = minimum_should_match_field
+        self.msm_script = minimum_should_match_script
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        from elasticsearch_tpu.search.queries import TermQuery
+        match_counts: Dict[int, int] = {}
+        score_sum: Dict[int, float] = {}
+        for term in self.terms:
+            ds = TermQuery(self.field, term).execute(ctx).with_scores()
+            for row, sc in zip(ds.rows, ds.scores):
+                match_counts[int(row)] = match_counts.get(int(row), 0) + 1
+                score_sum[int(row)] = score_sum.get(int(row), 0.0) + float(sc)
+        if not match_counts:
+            return DocSet.empty()
+        rows = np.asarray(sorted(match_counts), dtype=np.int64)
+        required = np.ones(len(rows))
+        if self.msm_field:
+            for i, row in enumerate(rows):
+                v = ctx.reader.get_doc_value(self.msm_field, int(row))
+                if isinstance(v, list):
+                    v = v[0] if v else None
+                required[i] = float(v) if v is not None else len(self.terms) + 1
+        elif self.msm_script:
+            src = self.msm_script.get("source", "")
+            env = {"num_terms": len(self.terms)}
+            try:
+                required[:] = eval(compile(src.replace("params.num_terms",
+                                                       "num_terms"),
+                                           "<msm>", "eval"),
+                                   {"__builtins__": {}},
+                                   {"num_terms": len(self.terms),
+                                    "Math": math, "min": min, "max": max})
+            except Exception as e:
+                raise IllegalArgumentError(
+                    f"failed to evaluate minimum_should_match_script: {e}")
+        counts = np.asarray([match_counts[int(r)] for r in rows])
+        mask = counts >= required
+        rows = rows[mask]
+        scores = np.asarray([score_sum[int(r)] for r in rows], dtype=np.float32)
+        return DocSet(rows, scores)
+
+    def to_dict(self):
+        return {"terms_set": {self.field: {"terms": self.terms}}}
+
+
+# ---------------------------------------------------------------------------
+# source-level matcher (shared by nested + percolate)
+# ---------------------------------------------------------------------------
+
+def _values_at(obj: Any, path: str) -> List[Any]:
+    """All values at a dotted path inside a plain source object."""
+    parts = path.split(".")
+    current = [obj]
+    for p in parts:
+        nxt: List[Any] = []
+        for c in current:
+            if isinstance(c, dict) and p in c:
+                v = c[p]
+                if isinstance(v, list):
+                    nxt.extend(v)
+                else:
+                    nxt.append(v)
+        current = nxt
+    return current
+
+
+def source_matches(query: dict, source: dict, mapper_service=None) -> bool:
+    """Evaluate a query DSL dict directly against one source document.
+
+    The percolator's `MemoryIndex` analog (reference:
+    percolator/PercolateQuery.java builds a one-doc in-memory index); nested
+    queries reuse it per nested object.
+    """
+    if not isinstance(query, dict) or len(query) != 1:
+        raise ParsingError("query must have exactly one key")
+    kind, spec = next(iter(query.items()))
+    if kind == "match_all":
+        return True
+    if kind == "match_none":
+        return False
+    if kind == "bool":
+        for q in _as_list(spec.get("must")) + _as_list(spec.get("filter")):
+            if not source_matches(q, source, mapper_service):
+                return False
+        for q in _as_list(spec.get("must_not")):
+            if source_matches(q, source, mapper_service):
+                return False
+        should = _as_list(spec.get("should"))
+        if should:
+            msm = spec.get("minimum_should_match")
+            need = int(msm) if msm is not None else (
+                1 if not (spec.get("must") or spec.get("filter")) else 0)
+            got = sum(1 for q in should
+                      if source_matches(q, source, mapper_service))
+            return got >= need
+        return True
+    if kind == "term":
+        field, v = _single(spec)
+        target = v.get("value") if isinstance(v, dict) else v
+        return any(_term_eq(val, target, field, mapper_service)
+                   for val in _values_at(source, field))
+    if kind == "terms":
+        field, targets = _single(spec)
+        return any(_term_eq(val, t, field, mapper_service)
+                   for val in _values_at(source, field) for t in targets)
+    if kind == "match":
+        field, v = _single(spec)
+        text = v.get("query") if isinstance(v, dict) else v
+        operator = (v.get("operator", "or") if isinstance(v, dict) else "or")
+        tokens = _analyze(field, text, mapper_service)
+        doc_tokens: set = set()
+        for val in _values_at(source, field):
+            if isinstance(val, str):
+                doc_tokens.update(_analyze(field, val, mapper_service))
+            else:
+                doc_tokens.add(str(val).lower())
+        hits = [t in doc_tokens for t in tokens]
+        return all(hits) if operator == "and" else any(hits)
+    if kind == "range":
+        field, v = _single(spec)
+        from elasticsearch_tpu.index.mapping import parse_date_millis
+        for val in _values_at(source, field):
+            try:
+                x = float(val) if not isinstance(val, str) else (
+                    float(val) if val.replace(".", "").replace("-", "").isdigit()
+                    else parse_date_millis(val))
+            except Exception:
+                continue
+
+            def conv(bound):
+                if isinstance(bound, str) and not bound.replace(
+                        ".", "").replace("-", "").isdigit():
+                    return parse_date_millis(bound)
+                return float(bound)
+            ok = True
+            if v.get("gte") is not None and not x >= conv(v["gte"]):
+                ok = False
+            if v.get("gt") is not None and not x > conv(v["gt"]):
+                ok = False
+            if v.get("lte") is not None and not x <= conv(v["lte"]):
+                ok = False
+            if v.get("lt") is not None and not x < conv(v["lt"]):
+                ok = False
+            if ok:
+                return True
+        return False
+    if kind == "exists":
+        return len(_values_at(source, spec["field"])) > 0
+    if kind == "prefix":
+        field, v = _single(spec)
+        p = (v.get("value") if isinstance(v, dict) else v) or ""
+        return any(isinstance(val, str) and val.lower().startswith(p.lower())
+                   for val in _values_at(source, field))
+    if kind == "wildcard":
+        import fnmatch
+        field, v = _single(spec)
+        pat = (v.get("value") or v.get("wildcard")) if isinstance(v, dict) else v
+        return any(isinstance(val, str) and
+                   fnmatch.fnmatchcase(val.lower(), pat.lower())
+                   for val in _values_at(source, field))
+    if kind == "ids":
+        return False   # no _id inside a bare source document
+    raise ParsingError(
+        f"[{kind}] query is not supported in this context (percolate/nested)")
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _single(spec):
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingError("expected a single-field query object")
+    return next(iter(spec.items()))
+
+
+def _analyze(field: str, text: str, mapper_service) -> List[str]:
+    mapper = mapper_service.get(field) if mapper_service else None
+    if mapper is not None and hasattr(mapper, "analyze"):
+        return mapper.analyze(text)
+    return str(text).lower().split()
+
+
+def _term_eq(doc_val, target, field, mapper_service) -> bool:
+    if isinstance(doc_val, str) and isinstance(target, str):
+        mapper = mapper_service.get(field) if mapper_service else None
+        if mapper is not None and getattr(mapper, "type_name", "") == "text":
+            return target in _analyze(field, doc_val, mapper_service)
+        return doc_val == target
+    if isinstance(doc_val, bool) or isinstance(target, bool):
+        return doc_val == target
+    try:
+        return float(doc_val) == float(target)
+    except (TypeError, ValueError):
+        return doc_val == target
+
+
+# ---------------------------------------------------------------------------
+# nested
+# ---------------------------------------------------------------------------
+
+class NestedQuery(Query):
+    """Matches docs where at least one nested object at `path` satisfies the
+    whole inner query (reference: nested docs are hidden sub-documents with
+    a BitSet join — here objects evaluate in place, same semantics)."""
+
+    def __init__(self, path: str, query_dict: dict, score_mode: str = "avg"):
+        self.path = path
+        self.query_dict = query_dict
+        self.score_mode = score_mode
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows_out: List[int] = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            for local in range(seg.num_docs):
+                if not view.live[local]:
+                    continue
+                objs = seg.sources[local].get(self.path)
+                if objs is None and "." in self.path:
+                    vals = _values_at(seg.sources[local], self.path)
+                    objs = [v for v in vals if isinstance(v, dict)]
+                if not isinstance(objs, list):
+                    objs = [objs] if isinstance(objs, dict) else []
+                inner = _strip_path_prefix(self.query_dict, self.path)
+                if any(source_matches(inner, obj, ctx.mapper_service)
+                       for obj in objs if isinstance(obj, dict)):
+                    rows_out.append(seg.base + local)
+        rows = np.asarray(sorted(rows_out), dtype=np.int64)
+        return DocSet(rows, np.ones(len(rows), dtype=np.float32))
+
+    def to_dict(self):
+        return {"nested": {"path": self.path, "query": self.query_dict}}
+
+
+def _strip_path_prefix(query: dict, path: str) -> dict:
+    """Rewrite `path.field` references to `field` for per-object matching."""
+    out: Any = json.loads(json.dumps(query))
+    prefix = path + "."
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k in list(node):
+                v = node.pop(k)
+                nk = k[len(prefix):] if k.startswith(prefix) else k
+                node[nk] = walk(v)
+            return node
+        if isinstance(node, list):
+            return [walk(x) for x in node]
+        return node
+    return walk(out)
+
+
+# ---------------------------------------------------------------------------
+# parent-join
+# ---------------------------------------------------------------------------
+
+def _join_mapper(ctx: SearchContext):
+    for name, mapper in ctx.mapper_service.all_mappers():
+        if getattr(mapper, "type_name", "") == "join":
+            return name, mapper
+    raise IllegalArgumentError("no [join] field defined in the mapping")
+
+
+class HasChildQuery(Query):
+    def __init__(self, child_type: str, query: Query, score_mode: str = "none"):
+        self.child_type = child_type
+        self.query = query
+        self.score_mode = score_mode
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        join_field, _ = _join_mapper(ctx)
+        child_hits = self.query.execute(ctx)
+        id_rows = _id_to_row(ctx)
+        parent_rows = set()
+        for row in child_hits.rows:
+            jv = ctx.reader.get_doc_value(join_field, int(row))
+            if isinstance(jv, list):
+                jv = jv[0] if jv else None
+            if not isinstance(jv, dict) or jv.get("name") != self.child_type:
+                continue
+            parent_id = jv.get("parent")
+            if parent_id is not None and parent_id in id_rows:
+                parent_rows.add(id_rows[parent_id])
+        rows = np.asarray(sorted(parent_rows), dtype=np.int64)
+        return DocSet(rows, np.ones(len(rows), dtype=np.float32))
+
+    def to_dict(self):
+        return {"has_child": {"type": self.child_type,
+                              "query": self.query.to_dict()}}
+
+
+class HasParentQuery(Query):
+    def __init__(self, parent_type: str, query: Query, score: bool = False):
+        self.parent_type = parent_type
+        self.query = query
+        self.score = score
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        join_field, _ = _join_mapper(ctx)
+        parent_hits = self.query.execute(ctx)
+        # restrict to parents of the right relation name
+        parent_ids = set()
+        for row in parent_hits.rows:
+            jv = ctx.reader.get_doc_value(join_field, int(row))
+            if isinstance(jv, list):
+                jv = jv[0] if jv else None
+            if isinstance(jv, dict) and jv.get("name") == self.parent_type:
+                for view in ctx.reader.views:
+                    seg = view.segment
+                    if seg.base <= row < seg.base + seg.num_docs:
+                        parent_ids.add(seg.ids[row - seg.base])
+        rows_out = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            for local in range(seg.num_docs):
+                if not view.live[local]:
+                    continue
+                jv = ctx.reader.get_doc_value(join_field, seg.base + local)
+                if isinstance(jv, list):
+                    jv = jv[0] if jv else None
+                if isinstance(jv, dict) and jv.get("parent") in parent_ids:
+                    rows_out.append(seg.base + local)
+        rows = np.asarray(sorted(rows_out), dtype=np.int64)
+        return DocSet(rows, np.ones(len(rows), dtype=np.float32))
+
+    def to_dict(self):
+        return {"has_parent": {"parent_type": self.parent_type,
+                               "query": self.query.to_dict()}}
+
+
+class ParentIdQuery(Query):
+    def __init__(self, child_type: str, parent_id: str):
+        self.child_type = child_type
+        self.parent_id = parent_id
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        join_field, _ = _join_mapper(ctx)
+        rows_out = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            for local in range(seg.num_docs):
+                if not view.live[local]:
+                    continue
+                jv = ctx.reader.get_doc_value(join_field, seg.base + local)
+                if isinstance(jv, list):
+                    jv = jv[0] if jv else None
+                if isinstance(jv, dict) and jv.get("name") == self.child_type \
+                        and jv.get("parent") == self.parent_id:
+                    rows_out.append(seg.base + local)
+        rows = np.asarray(sorted(rows_out), dtype=np.int64)
+        return DocSet(rows, np.ones(len(rows), dtype=np.float32))
+
+    def to_dict(self):
+        return {"parent_id": {"type": self.child_type, "id": self.parent_id}}
+
+
+# ---------------------------------------------------------------------------
+# percolate
+# ---------------------------------------------------------------------------
+
+class PercolateQuery(Query):
+    def __init__(self, field: str, documents: List[dict]):
+        self.field = field
+        self.documents = documents
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows_out = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            for local in range(seg.num_docs):
+                if not view.live[local]:
+                    continue
+                stored = ctx.reader.get_doc_value(self.field, seg.base + local)
+                if isinstance(stored, list):
+                    stored = stored[0] if stored else None
+                if not isinstance(stored, dict):
+                    continue
+                try:
+                    if any(source_matches(stored, doc, ctx.mapper_service)
+                           for doc in self.documents):
+                        rows_out.append(seg.base + local)
+                except ParsingError:
+                    continue   # stored query uses unsupported constructs
+        rows = np.asarray(sorted(rows_out), dtype=np.int64)
+        return DocSet(rows, np.ones(len(rows), dtype=np.float32))
+
+    def to_dict(self):
+        return {"percolate": {"field": self.field, "documents": self.documents}}
+
+
+# ---------------------------------------------------------------------------
+# span + intervals (position machinery)
+# ---------------------------------------------------------------------------
+
+def _term_spans(ctx: SearchContext, field: str,
+                term: str) -> Dict[int, List[Tuple[int, int]]]:
+    """global row → [(start, end)) spans] for one term."""
+    out: Dict[int, List[Tuple[int, int]]] = {}
+    for view in ctx.reader.views:
+        seg = view.segment
+        postings = seg.get_postings(field, term)
+        if postings is None or postings.positions is None:
+            continue
+        for i, local in enumerate(postings.doc_ids):
+            if not view.live[local]:
+                continue
+            poss = postings.positions[i]
+            if poss:
+                out[seg.base + int(local)] = [(p, p + 1) for p in poss]
+    return out
+
+
+def _combine_near(a: Dict[int, List[Tuple[int, int]]],
+                  b: Dict[int, List[Tuple[int, int]]],
+                  slop: int, in_order: bool) -> Dict[int, List[Tuple[int, int]]]:
+    out: Dict[int, List[Tuple[int, int]]] = {}
+    for row in set(a) & set(b):
+        spans = []
+        for s1, e1 in a[row]:
+            for s2, e2 in b[row]:
+                if in_order:
+                    if s2 >= e1 and s2 - e1 <= slop:
+                        spans.append((s1, e2))
+                else:
+                    lo, hi = min(s1, s2), max(e1, e2)
+                    gap = hi - lo - (e1 - s1) - (e2 - s2)
+                    if gap <= slop and not (s1 < e2 and s2 < e1):
+                        spans.append((lo, hi))
+                    elif (s1 < e2 and s2 < e1):
+                        pass   # overlapping spans don't pair (Lucene semantics)
+        if spans:
+            out[row] = sorted(set(spans))
+    return out
+
+
+class SpanQuery(Query):
+    """Evaluates the span tree to row→spans, then matches docs with ≥1 span."""
+
+    def __init__(self, spec_kind: str, spec: dict):
+        self.kind = spec_kind
+        self.spec = spec
+
+    def _spans(self, ctx: SearchContext, kind: str,
+               spec: dict) -> Dict[int, List[Tuple[int, int]]]:
+        if kind == "span_term":
+            field, v = _single(spec)
+            term = v.get("value") if isinstance(v, dict) else v
+            mapper = ctx.mapper_service.get(field)
+            if mapper is not None and hasattr(mapper, "analyze"):
+                toks = mapper.analyze(str(term))
+                term = toks[0] if toks else str(term)
+            return _term_spans(ctx, field, str(term))
+        if kind == "span_near":
+            clauses = spec.get("clauses", [])
+            slop = int(spec.get("slop", 0))
+            in_order = bool(spec.get("in_order", True))
+            if not clauses:
+                return {}
+            acc = self._spans_of(ctx, clauses[0])
+            for c in clauses[1:]:
+                acc = _combine_near(acc, self._spans_of(ctx, c), slop, in_order)
+            return acc
+        if kind == "span_or":
+            out: Dict[int, List[Tuple[int, int]]] = {}
+            for c in spec.get("clauses", []):
+                for row, spans in self._spans_of(ctx, c).items():
+                    out.setdefault(row, []).extend(spans)
+            return {r: sorted(set(s)) for r, s in out.items()}
+        if kind == "span_first":
+            inner = self._spans_of(ctx, spec["match"])
+            end = int(spec.get("end", 1))
+            return {r: [sp for sp in spans if sp[1] <= end]
+                    for r, spans in inner.items()
+                    if any(sp[1] <= end for sp in spans)}
+        if kind == "span_not":
+            include = self._spans_of(ctx, spec["include"])
+            exclude = self._spans_of(ctx, spec["exclude"])
+            out = {}
+            for row, spans in include.items():
+                ex = exclude.get(row, [])
+                keep = [sp for sp in spans
+                        if not any(sp[0] < e and s < sp[1] for s, e in ex)]
+                if keep:
+                    out[row] = keep
+            return out
+        raise ParsingError(f"unknown span query [{kind}]")
+
+    def _spans_of(self, ctx, clause: dict):
+        k, s = next(iter(clause.items()))
+        return self._spans(ctx, k, s)
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        span_map = self._spans(ctx, self.kind, self.spec)
+        rows = np.asarray(sorted(span_map), dtype=np.int64)
+        scores = np.asarray([float(len(span_map[int(r)])) for r in rows],
+                            dtype=np.float32)
+        return DocSet(rows, scores)
+
+    def to_dict(self):
+        return {self.kind: self.spec}
+
+
+class IntervalsQuery(Query):
+    """`intervals` query — lowered onto the span machinery (match with
+    ordered/max_gaps ≈ span_near; all_of/any_of ≈ span_near/span_or)."""
+
+    def __init__(self, field: str, rule: dict):
+        self.field = field
+        self.rule = rule
+
+    def _to_span(self, rule: dict) -> dict:
+        kind, spec = next(iter(rule.items()))
+        if kind == "match":
+            text = spec.get("query", "")
+            ordered = bool(spec.get("ordered", False))
+            max_gaps = int(spec.get("max_gaps", -1))
+            terms = str(text).lower().split()
+            clauses = [{"span_term": {self.field: t}} for t in terms]
+            if len(clauses) == 1:
+                return clauses[0]
+            slop = max_gaps if max_gaps >= 0 else 10 ** 6
+            return {"span_near": {"clauses": clauses, "slop": slop,
+                                  "in_order": ordered}}
+        if kind == "all_of":
+            clauses = [self._to_span(r) for r in spec.get("intervals", [])]
+            max_gaps = int(spec.get("max_gaps", -1))
+            return {"span_near": {"clauses": clauses,
+                                  "slop": max_gaps if max_gaps >= 0 else 10 ** 6,
+                                  "in_order": bool(spec.get("ordered", False))}}
+        if kind == "any_of":
+            return {"span_or": {"clauses": [self._to_span(r)
+                                            for r in spec.get("intervals", [])]}}
+        raise ParsingError(f"unsupported intervals rule [{kind}]")
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        span = self._to_span(self.rule)
+        kind, spec = next(iter(span.items()))
+        return SpanQuery(kind, spec).execute(ctx)
+
+    def to_dict(self):
+        return {"intervals": {self.field: self.rule}}
+
+
+# ---------------------------------------------------------------------------
+# wrapper + pinned
+# ---------------------------------------------------------------------------
+
+class PinnedQuery(Query):
+    """Promoted ids rank first, in order, above organic results
+    (reference: x-pack search-business-rules PinnedQueryBuilder)."""
+
+    def __init__(self, ids: List[str], organic: Query):
+        self.ids = ids
+        self.organic = organic
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        organic = self.organic.execute(ctx).with_scores()
+        id_rows = _id_to_row(ctx)
+        pinned_rows = [id_rows[i] for i in self.ids if i in id_rows]
+        max_organic = float(organic.scores.max()) if len(organic.scores) else 0.0
+        rows: List[int] = []
+        scores: List[float] = []
+        for rank, row in enumerate(pinned_rows):
+            rows.append(row)
+            scores.append(max_organic + len(pinned_rows) - rank + 1.0)
+        pinned_set = set(pinned_rows)
+        for row, sc in zip(organic.rows, organic.scores):
+            if int(row) not in pinned_set:
+                rows.append(int(row))
+                scores.append(float(sc))
+        order = np.argsort(np.asarray(rows, dtype=np.int64), kind="stable")
+        rows_arr = np.asarray(rows, dtype=np.int64)[order]
+        scores_arr = np.asarray(scores, dtype=np.float32)[order]
+        return DocSet(rows_arr, scores_arr)
+
+    def to_dict(self):
+        return {"pinned": {"ids": self.ids, "organic": self.organic.to_dict()}}
+
+
+# ---------------------------------------------------------------------------
+# dispatch (called from queries.parse_query on unknown kinds)
+# ---------------------------------------------------------------------------
+
+def parse_extended(kind: str, spec: Any) -> Optional[Query]:
+    if kind == "geo_distance":
+        spec = dict(spec)
+        distance = parse_distance(spec.pop("distance"))
+        spec.pop("distance_type", None)
+        spec.pop("validation_method", None)
+        field, point = next(iter(spec.items()))
+        lat, lon = parse_geo_point(point)
+        return GeoDistanceQuery(field, lat, lon, distance)
+    if kind == "geo_bounding_box":
+        spec = dict(spec)
+        spec.pop("validation_method", None)
+        field, box = next(iter(spec.items()))
+        tl = parse_geo_point(box["top_left"])
+        br = parse_geo_point(box["bottom_right"])
+        return GeoBoundingBoxQuery(field, tl[0], tl[1], br[0], br[1])
+    if kind == "geo_polygon":
+        spec = dict(spec)
+        spec.pop("validation_method", None)
+        field, poly = next(iter(spec.items()))
+        points = [parse_geo_point(p) for p in poly["points"]]
+        return GeoPolygonQuery(field, points)
+    if kind == "distance_feature":
+        return DistanceFeatureQuery(spec["field"], spec["origin"],
+                                    spec["pivot"],
+                                    float(spec.get("boost", 1.0)))
+    if kind == "rank_feature":
+        return RankFeatureQuery(spec["field"],
+                                saturation=spec.get("saturation"),
+                                log=spec.get("log"),
+                                sigmoid=spec.get("sigmoid"),
+                                linear=spec.get("linear"),
+                                boost=float(spec.get("boost", 1.0)))
+    if kind == "more_like_this":
+        like = spec.get("like", [])
+        if not isinstance(like, list):
+            like = [like]
+        return MoreLikeThisQuery(
+            fields=spec.get("fields", []), like=like,
+            min_term_freq=int(spec.get("min_term_freq", 2)),
+            min_doc_freq=int(spec.get("min_doc_freq", 5)),
+            max_query_terms=int(spec.get("max_query_terms", 25)),
+            minimum_should_match=spec.get("minimum_should_match", "30%"),
+            include=bool(spec.get("include", False)))
+    if kind == "terms_set":
+        field, v = _single(spec)
+        return TermsSetQuery(field, v.get("terms", []),
+                             v.get("minimum_should_match_field"),
+                             v.get("minimum_should_match_script"))
+    if kind == "nested":
+        return NestedQuery(spec["path"], spec.get("query", {"match_all": {}}),
+                           spec.get("score_mode", "avg"))
+    if kind == "has_child":
+        return HasChildQuery(spec["type"],
+                             parse_query(spec.get("query", {"match_all": {}})),
+                             spec.get("score_mode", "none"))
+    if kind == "has_parent":
+        return HasParentQuery(spec["parent_type"],
+                              parse_query(spec.get("query", {"match_all": {}})),
+                              bool(spec.get("score", False)))
+    if kind == "parent_id":
+        return ParentIdQuery(spec["type"], str(spec["id"]))
+    if kind == "percolate":
+        docs = spec.get("documents")
+        if docs is None:
+            docs = [spec["document"]] if "document" in spec else []
+        return PercolateQuery(spec["field"], docs)
+    if kind in ("span_term", "span_near", "span_or", "span_first", "span_not"):
+        return SpanQuery(kind, spec)
+    if kind == "intervals":
+        field, rule = _single(spec)
+        return IntervalsQuery(field, rule)
+    if kind == "wrapper":
+        decoded = base64.b64decode(spec["query"])
+        return parse_query(json.loads(decoded))
+    if kind == "pinned":
+        return PinnedQuery([str(i) for i in spec.get("ids", [])],
+                           parse_query(spec.get("organic", {"match_all": {}})))
+    return None

@@ -1,0 +1,312 @@
+"""Extended query types: geo, rank features, MLT, terms_set, nested,
+parent-join, percolate, span/intervals, wrapper, pinned + geo aggs.
+
+Reference behaviors: index/query/* builders, modules/percolator,
+modules/parent-join, modules/mapper-extras, x-pack search-business-rules.
+"""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+def ids(body):
+    return {h["_id"] for h in body["hits"]["hits"]}
+
+
+# --------------------------------------------------------------------- geo
+
+def _seed_geo(client):
+    client.req("PUT", "/places", {"mappings": {"properties": {
+        "location": {"type": "geo_point"}, "name": {"type": "keyword"}}}})
+    pts = {"brandenburg": (52.5163, 13.3777),
+           "eiffel": (48.8584, 2.2945),
+           "colosseum": (41.8902, 12.4922),
+           "big_ben": (51.5007, -0.1246)}
+    for name, (lat, lon) in pts.items():
+        client.req("PUT", f"/places/_doc/{name}",
+                   {"name": name, "location": {"lat": lat, "lon": lon}})
+    client.req("POST", "/places/_refresh")
+
+
+def test_geo_distance(client):
+    _seed_geo(client)
+    st, body = client.req("POST", "/places/_search", {"query": {
+        "geo_distance": {"distance": "400km",
+                         "location": {"lat": 48.85, "lon": 2.35}}}})
+    assert ids(body) == {"eiffel", "big_ben"}
+
+
+def test_geo_bounding_box(client):
+    _seed_geo(client)
+    st, body = client.req("POST", "/places/_search", {"query": {
+        "geo_bounding_box": {"location": {
+            "top_left": {"lat": 53.0, "lon": 0.0},
+            "bottom_right": {"lat": 48.0, "lon": 14.0}}}}})
+    assert ids(body) == {"brandenburg", "eiffel"}
+
+
+def test_geo_polygon(client):
+    _seed_geo(client)
+    # triangle around Rome
+    st, body = client.req("POST", "/places/_search", {"query": {
+        "geo_polygon": {"location": {"points": [
+            {"lat": 43.0, "lon": 11.0}, {"lat": 43.0, "lon": 14.0},
+            {"lat": 40.0, "lon": 12.5}]}}}})
+    assert ids(body) == {"colosseum"}
+
+
+def test_geo_aggs(client):
+    _seed_geo(client)
+    st, body = client.req("POST", "/places/_search", {"size": 0, "aggs": {
+        "grid": {"geohash_grid": {"field": "location", "precision": 2}},
+        "box": {"geo_bounds": {"field": "location"}},
+        "center": {"geo_centroid": {"field": "location"}}}})
+    aggs = body["aggregations"]
+    assert len(aggs["grid"]["buckets"]) >= 2
+    assert aggs["box"]["bounds"]["top_left"]["lat"] == pytest.approx(52.5163)
+    assert aggs["center"]["count"] == 4
+
+
+def test_geotile_grid(client):
+    _seed_geo(client)
+    st, body = client.req("POST", "/places/_search", {"size": 0, "aggs": {
+        "tiles": {"geotile_grid": {"field": "location", "precision": 4}}}})
+    keys = [b["key"] for b in body["aggregations"]["tiles"]["buckets"]]
+    assert all(k.startswith("4/") for k in keys)
+
+
+def test_distance_feature_geo(client):
+    _seed_geo(client)
+    st, body = client.req("POST", "/places/_search", {"query": {
+        "distance_feature": {"field": "location",
+                             "origin": {"lat": 48.85, "lon": 2.35},
+                             "pivot": "100km"}}})
+    hits = body["hits"]["hits"]
+    assert hits[0]["_id"] == "eiffel"      # closest scores highest
+
+
+# ----------------------------------------------------------- rank features
+
+def test_rank_feature_query(client):
+    client.req("PUT", "/pages", {"mappings": {"properties": {
+        "pagerank": {"type": "rank_feature"},
+        "topics": {"type": "rank_features"}}}})
+    client.req("PUT", "/pages/_doc/1", {"pagerank": 10.0,
+                                        "topics": {"sports": 20.0}})
+    client.req("PUT", "/pages/_doc/2", {"pagerank": 1.0,
+                                        "topics": {"sports": 1.0}})
+    client.req("POST", "/pages/_refresh")
+    st, body = client.req("POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "pagerank", "saturation": {"pivot": 5}}}})
+    hits = body["hits"]["hits"]
+    assert hits[0]["_id"] == "1" and hits[0]["_score"] > hits[1]["_score"]
+    # rank_features sub-feature
+    st, body = client.req("POST", "/pages/_search", {"query": {
+        "rank_feature": {"field": "topics.sports", "log": {"scaling_factor": 1}}}})
+    assert body["hits"]["hits"][0]["_id"] == "1"
+
+
+# ----------------------------------------------------------- more_like_this
+
+def test_more_like_this(client):
+    docs = {
+        "1": "machine learning on tensor processing units",
+        "2": "deep machine learning with tensor hardware accelerators",
+        "3": "cooking pasta with tomato sauce",
+        "4": "machine learning tensor compilers",
+    }
+    for i, text in docs.items():
+        client.req("PUT", f"/articles/_doc/{i}", {"body": text})
+    client.req("POST", "/articles/_refresh")
+    st, body = client.req("POST", "/articles/_search", {"query": {
+        "more_like_this": {"fields": ["body"], "like": [{"_id": "1"}],
+                           "min_term_freq": 1, "min_doc_freq": 2,
+                           "minimum_should_match": 1}}})
+    assert st == 200
+    result = ids(body)
+    assert "1" not in result          # liked doc excluded by default
+    assert "2" in result and "4" in result
+    assert "3" not in result
+
+
+# --------------------------------------------------------------- terms_set
+
+def test_terms_set(client):
+    client.req("PUT", "/skills", {"mappings": {"properties": {
+        "langs": {"type": "keyword"}, "required": {"type": "long"}}}})
+    client.req("PUT", "/skills/_doc/1",
+               {"langs": ["java", "python", "go"], "required": 2})
+    client.req("PUT", "/skills/_doc/2", {"langs": ["java"], "required": 2})
+    client.req("POST", "/skills/_refresh")
+    st, body = client.req("POST", "/skills/_search", {"query": {
+        "terms_set": {"langs": {"terms": ["java", "python"],
+                                "minimum_should_match_field": "required"}}}})
+    assert ids(body) == {"1"}
+
+
+# ------------------------------------------------------------------ nested
+
+def test_nested_query_object_pairing(client):
+    client.req("PUT", "/drivers", {"mappings": {"properties": {
+        "vehicles": {"type": "nested", "properties": {
+            "make": {"type": "keyword"}, "year": {"type": "long"}}}}}})
+    client.req("PUT", "/drivers/_doc/1", {"vehicles": [
+        {"make": "honda", "year": 2000}, {"make": "ford", "year": 2020}]})
+    client.req("PUT", "/drivers/_doc/2", {"vehicles": [
+        {"make": "honda", "year": 2020}]})
+    client.req("POST", "/drivers/_refresh")
+    # only doc 2 has ONE object with both honda AND 2020 — the flat-field
+    # cross-object match that nested exists to prevent would return both
+    st, body = client.req("POST", "/drivers/_search", {"query": {
+        "nested": {"path": "vehicles", "query": {"bool": {"must": [
+            {"term": {"vehicles.make": "honda"}},
+            {"range": {"vehicles.year": {"gte": 2015}}}]}}}}})
+    assert ids(body) == {"2"}
+
+
+# ------------------------------------------------------------- parent-join
+
+def test_has_child_has_parent(client):
+    client.req("PUT", "/qa", {"mappings": {"properties": {
+        "relation": {"type": "join",
+                     "relations": {"question": "answer"}},
+        "body": {"type": "text"}}}})
+    client.req("PUT", "/qa/_doc/q1", {"body": "how to jit", "relation": "question"})
+    client.req("PUT", "/qa/_doc/q2", {"body": "how to grad", "relation": "question"})
+    client.req("PUT", "/qa/_doc/a1", {"body": "use jax.jit decorator",
+                                      "relation": {"name": "answer", "parent": "q1"}})
+    client.req("PUT", "/qa/_doc/a2", {"body": "use jax.grad",
+                                      "relation": {"name": "answer", "parent": "q2"}})
+    client.req("POST", "/qa/_refresh")
+    st, body = client.req("POST", "/qa/_search", {"query": {
+        "has_child": {"type": "answer",
+                      "query": {"match": {"body": "jit"}}}}})
+    assert ids(body) == {"q1"}
+    st, body = client.req("POST", "/qa/_search", {"query": {
+        "has_parent": {"parent_type": "question",
+                       "query": {"match": {"body": "grad"}}}}})
+    assert ids(body) == {"a2"}
+    st, body = client.req("POST", "/qa/_search", {"query": {
+        "parent_id": {"type": "answer", "id": "q1"}}})
+    assert ids(body) == {"a1"}
+
+
+# --------------------------------------------------------------- percolate
+
+def test_percolator(client):
+    client.req("PUT", "/watches", {"mappings": {"properties": {
+        "query": {"type": "percolator"}, "msg": {"type": "text"}}}})
+    client.req("PUT", "/watches/_doc/w1",
+               {"query": {"match": {"msg": "error"}}})
+    client.req("PUT", "/watches/_doc/w2",
+               {"query": {"bool": {"must": [
+                   {"match": {"msg": "disk"}},
+                   {"range": {"pct": {"gte": 90}}}]}}})
+    client.req("POST", "/watches/_refresh")
+    st, body = client.req("POST", "/watches/_search", {"query": {
+        "percolate": {"field": "query",
+                      "document": {"msg": "disk full error", "pct": 95}}}})
+    assert ids(body) == {"w1", "w2"}
+    st, body = client.req("POST", "/watches/_search", {"query": {
+        "percolate": {"field": "query",
+                      "document": {"msg": "disk warning", "pct": 50}}}})
+    assert ids(body) == set()
+
+
+# ---------------------------------------------------------- span/intervals
+
+def _seed_text(client):
+    client.req("PUT", "/texts/_doc/1",
+               {"line": "the quick brown fox jumps over the lazy dog"})
+    client.req("PUT", "/texts/_doc/2",
+               {"line": "the dog was quick and brown was the fox"})
+    client.req("POST", "/texts/_refresh")
+
+
+def test_span_near_in_order(client):
+    _seed_text(client)
+    st, body = client.req("POST", "/texts/_search", {"query": {
+        "span_near": {"clauses": [
+            {"span_term": {"line": "quick"}},
+            {"span_term": {"line": "fox"}}],
+            "slop": 1, "in_order": True}}})
+    assert ids(body) == {"1"}     # doc2 has them 7 apart / out of order
+
+
+def test_span_first(client):
+    _seed_text(client)
+    st, body = client.req("POST", "/texts/_search", {"query": {
+        "span_first": {"match": {"span_term": {"line": "dog"}}, "end": 3}}})
+    assert ids(body) == {"2"}     # 'dog' at position 1 in doc2, 8 in doc1
+
+
+def test_span_not(client):
+    _seed_text(client)
+    st, body = client.req("POST", "/texts/_search", {"query": {
+        "span_not": {
+            "include": {"span_term": {"line": "fox"}},
+            "exclude": {"span_near": {"clauses": [
+                {"span_term": {"line": "brown"}},
+                {"span_term": {"line": "fox"}}],
+                "slop": 0, "in_order": True}}}}})
+    assert ids(body) == {"2"}     # doc1's fox immediately follows brown
+
+
+def test_intervals_ordered(client):
+    _seed_text(client)
+    st, body = client.req("POST", "/texts/_search", {"query": {
+        "intervals": {"line": {"match": {
+            "query": "quick fox", "ordered": True, "max_gaps": 2}}}}})
+    assert ids(body) == {"1"}
+
+
+# ------------------------------------------------------- wrapper + pinned
+
+def test_wrapper_query(client):
+    client.req("PUT", "/w/_doc/1", {"k": "v"})
+    client.req("POST", "/w/_refresh")
+    inner = base64.b64encode(json.dumps({"term": {"k": "v"}}).encode()).decode()
+    st, body = client.req("POST", "/w/_search",
+                          {"query": {"wrapper": {"query": inner}}})
+    assert ids(body) == {"1"}
+
+
+def test_pinned_query(client):
+    for i in range(5):
+        client.req("PUT", f"/prods/_doc/{i}", {"t": "widget widget" if i < 3
+                                               else "widget"})
+    client.req("POST", "/prods/_refresh")
+    st, body = client.req("POST", "/prods/_search", {"query": {
+        "pinned": {"ids": ["4", "3"],
+                   "organic": {"match": {"t": "widget"}}}}})
+    top2 = [h["_id"] for h in body["hits"]["hits"][:2]]
+    assert top2 == ["4", "3"]     # pinned order wins over organic score
