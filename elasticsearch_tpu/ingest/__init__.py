@@ -1,0 +1,10 @@
+"""Ingest subsystem: pipelines + processors.
+
+Reference: `ingest/IngestService.java`, `modules/ingest-common`,
+`modules/ingest-user-agent`, `plugins/ingest-geoip`, `libs/grok`,
+`libs/dissect`.
+"""
+
+from elasticsearch_tpu.ingest.processors_extra import register_extra_processors
+
+register_extra_processors()

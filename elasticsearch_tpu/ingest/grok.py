@@ -1,0 +1,144 @@
+"""Grok: named-pattern text extraction.
+
+Reference: `libs/grok` (joni-based) + the pattern bank shipped in
+`libs/grok/src/main/resources/patterns/` — `%{NAME:field}` /
+`%{NAME:field:type}` syntax compiling recursively into one regex. This is a
+pure-`re` implementation with the commonly-exercised subset of the bank.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+# the slice of the reference pattern bank that covers the standard suites
+BUILTIN_PATTERNS: Dict[str, str] = {
+    "WORD": r"\b\w+\b",
+    "NOTSPACE": r"\S+",
+    "SPACE": r"\s*",
+    "DATA": r".*?",
+    "GREEDYDATA": r".*",
+    "INT": r"[+-]?(?:[0-9]+)",
+    "NUMBER": r"[+-]?(?:[0-9]+(?:\.[0-9]+)?)",
+    "BASE10NUM": r"[+-]?(?:[0-9]+(?:\.[0-9]+)?)",
+    "BASE16NUM": r"(?:0[xX])?[0-9a-fA-F]+",
+    "POSINT": r"\b[1-9][0-9]*\b",
+    "NONNEGINT": r"\b[0-9]+\b",
+    "BOOLEAN": r"(?:true|false|TRUE|FALSE|True|False)",
+    "QUOTEDSTRING": r'(?:"(?:[^"\\]|\\.)*"|\'(?:[^\'\\]|\\.)*\')',
+    "UUID": r"[A-Fa-f0-9]{8}-(?:[A-Fa-f0-9]{4}-){3}[A-Fa-f0-9]{12}",
+    "IPV4": r"(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"
+            r"(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)",
+    "IPV6": r"[0-9A-Fa-f:.]{2,45}",
+    "IP": r"(?:%{IPV6}|%{IPV4})",
+    "HOSTNAME": r"\b(?:[0-9A-Za-z][0-9A-Za-z-]{0,62})"
+                r"(?:\.(?:[0-9A-Za-z][0-9A-Za-z-]{0,62}))*\.?\b",
+    "IPORHOST": r"(?:%{IP}|%{HOSTNAME})",
+    "HOSTPORT": r"%{IPORHOST}:%{POSINT}",
+    "USERNAME": r"[a-zA-Z0-9._-]+",
+    "USER": r"%{USERNAME}",
+    "EMAILLOCALPART": r"[a-zA-Z][a-zA-Z0-9_.+-=:]+",
+    "EMAILADDRESS": r"%{EMAILLOCALPART}@%{HOSTNAME}",
+    "PATH": r"(?:%{UNIXPATH}|%{WINPATH})",
+    "UNIXPATH": r"(?:/[\w_%!$@:.,+~-]*)+",
+    "WINPATH": r"(?:[A-Za-z]+:|\\)(?:\\[^\\?*]*)+",
+    "URIPROTO": r"[A-Za-z]+(?:\+[A-Za-z+]+)?",
+    "URIHOST": r"%{IPORHOST}(?::%{POSINT})?",
+    "URIPATH": r"(?:/[A-Za-z0-9$.+!*'(){},~:;=@#%&_\-]*)+",
+    "URIPARAM": r"\?[A-Za-z0-9$.+!*'|(){},~@#%&/=:;_?\-\[\]<>]*",
+    "URIPATHPARAM": r"%{URIPATH}(?:%{URIPARAM})?",
+    "URI": r"%{URIPROTO}://(?:%{USER}(?::[^@]*)?@)?(?:%{URIHOST})?"
+           r"(?:%{URIPATHPARAM})?",
+    "MONTH": r"\b(?:Jan(?:uary)?|Feb(?:ruary)?|Mar(?:ch)?|Apr(?:il)?|May|"
+             r"Jun(?:e)?|Jul(?:y)?|Aug(?:ust)?|Sep(?:tember)?|Oct(?:ober)?|"
+             r"Nov(?:ember)?|Dec(?:ember)?)\b",
+    "MONTHNUM": r"(?:0?[1-9]|1[0-2])",
+    "MONTHDAY": r"(?:(?:0[1-9])|(?:[12][0-9])|(?:3[01])|[1-9])",
+    "DAY": r"(?:Mon(?:day)?|Tue(?:sday)?|Wed(?:nesday)?|Thu(?:rsday)?|"
+           r"Fri(?:day)?|Sat(?:urday)?|Sun(?:day)?)",
+    "YEAR": r"(?:\d\d){1,2}",
+    "HOUR": r"(?:2[0123]|[01]?[0-9])",
+    "MINUTE": r"(?:[0-5][0-9])",
+    "SECOND": r"(?:(?:[0-5]?[0-9]|60)(?:[:.,][0-9]+)?)",
+    "TIME": r"%{HOUR}:%{MINUTE}(?::%{SECOND})?",
+    "DATE_US": r"%{MONTHNUM}[/-]%{MONTHDAY}[/-]%{YEAR}",
+    "DATE_EU": r"%{MONTHDAY}[./-]%{MONTHNUM}[./-]%{YEAR}",
+    "ISO8601_TIMEZONE": r"(?:Z|[+-]%{HOUR}(?::?%{MINUTE}))",
+    "TIMESTAMP_ISO8601": r"%{YEAR}-%{MONTHNUM}-%{MONTHDAY}[T ]%{HOUR}:?"
+                         r"%{MINUTE}(?::?%{SECOND})?%{ISO8601_TIMEZONE}?",
+    "HTTPDATE": r"%{MONTHDAY}/%{MONTH}/%{YEAR}:%{TIME} %{INT}",
+    "LOGLEVEL": r"(?:[Aa]lert|ALERT|[Tt]race|TRACE|[Dd]ebug|DEBUG|[Nn]otice|"
+                r"NOTICE|[Ii]nfo(?:rmation)?|INFO(?:RMATION)?|[Ww]arn(?:ing)?|"
+                r"WARN(?:ING)?|[Ee]rr(?:or)?|ERR(?:OR)?|[Cc]rit(?:ical)?|"
+                r"CRIT(?:ICAL)?|[Ff]atal|FATAL|[Ss]evere|SEVERE|EMERG(?:ENCY)?|"
+                r"[Ee]merg(?:ency)?)",
+    "SYSLOGTIMESTAMP": r"%{MONTH} +%{MONTHDAY} %{TIME}",
+    "PROG": r"[\x21-\x5a\x5c\x5e-\x7e]+",
+    "SYSLOGPROG": r"%{PROG:process.name}(?:\[%{POSINT:process.pid:int}\])?",
+    "COMMONAPACHELOG": r'%{IPORHOST:source.address} %{USER:apache.access.user.identity} '
+                       r'%{USER:user.name} \[%{HTTPDATE:timestamp}\] '
+                       r'"(?:%{WORD:http.request.method} %{NOTSPACE:url.original}'
+                       r'(?: HTTP/%{NUMBER:http.version})?|%{DATA})" '
+                       r'%{INT:http.response.status_code:int} '
+                       r'(?:%{INT:http.response.body.bytes:int}|-)',
+    "COMBINEDAPACHELOG": r'%{COMMONAPACHELOG} "%{DATA:http.request.referrer}" '
+                         r'"%{DATA:user_agent.original}"',
+}
+
+_GROK_REF = re.compile(r"%\{(\w+)(?::([\w.\[\]@-]+))?(?::(\w+))?\}")
+
+
+class Grok:
+    def __init__(self, pattern: str,
+                 pattern_definitions: Optional[Dict[str, str]] = None):
+        self.bank = dict(BUILTIN_PATTERNS)
+        if pattern_definitions:
+            self.bank.update(pattern_definitions)
+        self.types: Dict[str, str] = {}
+        self._group_to_field: Dict[str, str] = {}
+        regex = self._compile(pattern, depth=0)
+        try:
+            self.regex = re.compile(regex)
+        except re.error as e:
+            raise IllegalArgumentError(f"invalid grok pattern [{pattern}]: {e}")
+
+    def _compile(self, pattern: str, depth: int) -> str:
+        if depth > 20:
+            raise IllegalArgumentError("circular grok pattern reference")
+
+        def repl(m: "re.Match") -> str:
+            name, field, typ = m.group(1), m.group(2), m.group(3)
+            sub = self.bank.get(name)
+            if sub is None:
+                raise IllegalArgumentError(f"Unable to find pattern [{name}]")
+            inner = self._compile(sub, depth + 1)
+            if field:
+                group = f"g{len(self._group_to_field)}"
+                self._group_to_field[group] = field
+                if typ:
+                    self.types[field] = typ
+                return f"(?P<{group}>{inner})"
+            return f"(?:{inner})"
+
+        return _GROK_REF.sub(repl, pattern)
+
+    def match(self, text: str) -> Optional[Dict[str, Any]]:
+        m = self.regex.search(text)
+        if m is None:
+            return None
+        out: Dict[str, Any] = {}
+        for group, field in self._group_to_field.items():
+            v: Any = m.group(group)
+            if v is None:
+                continue
+            typ = self.types.get(field)
+            if typ == "int":
+                v = int(v)
+            elif typ in ("float", "double"):
+                v = float(v)
+            elif typ == "boolean":
+                v = v.lower() == "true"
+            out[field] = v
+        return out

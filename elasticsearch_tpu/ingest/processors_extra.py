@@ -1,0 +1,380 @@
+"""Additional ingest processors: grok, csv, kv, json, urldecode, html_strip,
+bytes, fingerprint, sort, uri_parts, dot_expander, foreach, user_agent,
+geoip.
+
+Reference: `modules/ingest-common` (3.9k LoC), `modules/ingest-user-agent`,
+`plugins/ingest-geoip` (MaxMind-backed there; here an inline-database
+variant since the GeoLite2 db doesn't ship in this build).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json as _json
+import re
+import urllib.parse
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.common.settings import parse_byte_size
+from elasticsearch_tpu.ingest.grok import Grok
+from elasticsearch_tpu.ingest.service import (
+    IngestProcessorError,
+    Processor,
+    _del_path,
+    _get_path,
+    _set_path,
+)
+
+
+class GrokProcessor(Processor):
+    kind = "grok"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        patterns = spec.get("patterns")
+        if not patterns:
+            raise IllegalArgumentError("[grok] requires [patterns]")
+        defs = spec.get("pattern_definitions")
+        self.groks = [Grok(p, defs) for p in patterns]
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        for grok in self.groks:
+            m = grok.match(str(v))
+            if m is not None:
+                for field, value in m.items():
+                    _set_path(ctx, field, value)
+                return
+        raise IngestProcessorError(
+            f"Provided Grok expressions do not match field value: [{v}]")
+
+
+class CsvProcessor(Processor):
+    kind = "csv"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        import csv as _csv
+        import io
+        sep = self.spec.get("separator", ",")
+        quote = self.spec.get("quote", '"')
+        row = next(_csv.reader(io.StringIO(str(v)), delimiter=sep,
+                               quotechar=quote))
+        targets = self.spec.get("target_fields", [])
+        for name, value in zip(targets, row):
+            if value == "" and not self.spec.get("empty_value"):
+                continue
+            _set_path(ctx, name, value if value != "" else
+                      self.spec.get("empty_value"))
+
+
+class KvProcessor(Processor):
+    kind = "kv"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        field_split = self.spec.get("field_split", " ")
+        value_split = self.spec.get("value_split", "=")
+        prefix = self.spec.get("prefix", "")
+        target = self.spec.get("target_field")
+        include = set(self.spec.get("include_keys", []) or [])
+        exclude = set(self.spec.get("exclude_keys", []) or [])
+        out: Dict[str, Any] = {}
+        for pair in re.split(field_split, str(v)):
+            if not pair:
+                continue
+            key, sep, val = pair.partition(value_split)
+            if not sep:
+                continue
+            if include and key not in include:
+                continue
+            if key in exclude:
+                continue
+            out[prefix + key] = val.strip('"') if self.spec.get(
+                "strip_brackets") else val
+        if target:
+            _set_path(ctx, target, out)
+        else:
+            for k, val in out.items():
+                _set_path(ctx, k, val)
+
+
+class JsonProcessor(Processor):
+    kind = "json"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        try:
+            parsed = _json.loads(v)
+        except (TypeError, ValueError) as e:
+            raise IngestProcessorError(f"cannot parse JSON in [{self.field}]: {e}")
+        target = self.spec.get("target_field")
+        if self.spec.get("add_to_root") and isinstance(parsed, dict):
+            for k, val in parsed.items():
+                ctx[k] = val
+        else:
+            _set_path(ctx, target or self.field, parsed)
+
+
+class UrlDecodeProcessor(Processor):
+    kind = "urldecode"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        _set_path(ctx, self.spec.get("target_field", self.field),
+                  urllib.parse.unquote_plus(str(v)))
+
+
+class HtmlStripProcessor(Processor):
+    kind = "html_strip"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        _set_path(ctx, self.spec.get("target_field", self.field),
+                  re.sub(r"<[^>]*>", "", str(v)))
+
+
+class BytesProcessor(Processor):
+    kind = "bytes"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        _set_path(ctx, self.spec.get("target_field", self.field),
+                  parse_byte_size(str(v), self.field))
+
+
+class FingerprintProcessor(Processor):
+    kind = "fingerprint"
+
+    def run(self, ctx):
+        fields = self.spec.get("fields", [])
+        method = self.spec.get("method", "SHA-1").lower().replace("-", "")
+        h = hashlib.new({"sha1": "sha1", "sha256": "sha256", "md5": "md5",
+                         "sha512": "sha512"}.get(method, "sha1"))
+        for f in sorted(fields):
+            v = _get_path(ctx, f)
+            if v is None:
+                if self.ignore_missing:
+                    continue
+                raise IngestProcessorError(f"field [{f}] is missing")
+            h.update(f.encode())
+            h.update(str(v).encode())
+        _set_path(ctx, self.spec.get("target_field", "fingerprint"),
+                  h.hexdigest())
+
+
+class SortProcessor(Processor):
+    kind = "sort"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        if not isinstance(v, list):
+            raise IngestProcessorError(f"field [{self.field}] is not a list")
+        out = sorted(v, reverse=self.spec.get("order", "asc") == "desc")
+        _set_path(ctx, self.spec.get("target_field", self.field), out)
+
+
+class UriPartsProcessor(Processor):
+    kind = "uri_parts"
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        u = urllib.parse.urlsplit(str(v))
+        parts: Dict[str, Any] = {"original": str(v), "scheme": u.scheme,
+                                 "domain": u.hostname, "path": u.path}
+        if u.port:
+            parts["port"] = u.port
+        if u.query:
+            parts["query"] = u.query
+        if u.fragment:
+            parts["fragment"] = u.fragment
+        if u.username:
+            parts["user_info"] = u.username + (":" + u.password if u.password else "")
+        if "." in u.path.rsplit("/", 1)[-1]:
+            parts["extension"] = u.path.rsplit(".", 1)[-1]
+        _set_path(ctx, self.spec.get("target_field", "url"), parts)
+        if not self.spec.get("keep_original", True):
+            _del_path(ctx, self.field)
+
+
+class DotExpanderProcessor(Processor):
+    kind = "dot_expander"
+
+    def run(self, ctx):
+        field = self.field
+        if field == "*":
+            for k in [k for k in list(ctx) if "." in k and not k.startswith("_")]:
+                self._expand(ctx, k)
+            return
+        self._expand(ctx, field)
+
+    @staticmethod
+    def _expand(ctx, key):
+        if key not in ctx:
+            return
+        v = ctx.pop(key)
+        _set_path(ctx, key, v)
+
+
+class ForeachProcessor(Processor):
+    kind = "foreach"
+
+    def run(self, ctx):
+        from elasticsearch_tpu.ingest.service import build_processor
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{self.field}] is missing")
+        if not isinstance(v, list):
+            raise IngestProcessorError(f"field [{self.field}] is not a list")
+        inner_spec = self.spec.get("processor")
+        if not inner_spec:
+            raise IllegalArgumentError("[foreach] requires [processor]")
+        out = []
+        for item in v:
+            ctx["_ingest"] = ctx.get("_ingest", {})
+            ctx["_ingest"]["_value"] = item
+            build_processor(inner_spec).process(ctx, getattr(self, "_registry", None))
+            out.append(ctx["_ingest"].pop("_value"))
+        _set_path(ctx, self.field, out)
+
+
+_UA_PATTERNS = [
+    # (regex, name) — ordered, first match wins (reference bundles the
+    # uap-core database; this is the high-traffic subset)
+    (re.compile(r"Edg(?:e|A|iOS)?/(\d+)[.\d]*"), "Edge"),
+    (re.compile(r"OPR/(\d+)[.\d]*"), "Opera"),
+    (re.compile(r"Chrome/(\d+)[.\d]*"), "Chrome"),
+    (re.compile(r"CriOS/(\d+)[.\d]*"), "Chrome Mobile iOS"),
+    (re.compile(r"Firefox/(\d+)[.\d]*"), "Firefox"),
+    (re.compile(r"Version/(\d+)[.\d]* .*Safari/"), "Safari"),
+    (re.compile(r"MSIE (\d+)[.\d]*"), "IE"),
+    (re.compile(r"Trident/.*rv:(\d+)"), "IE"),
+    (re.compile(r"curl/(\d+)[.\d]*"), "curl"),
+    (re.compile(r"python-requests/(\d+)[.\d]*"), "Python Requests"),
+]
+
+_OS_PATTERNS = [
+    (re.compile(r"Windows NT 10"), "Windows", "10"),
+    (re.compile(r"Windows NT 6\.3"), "Windows", "8.1"),
+    (re.compile(r"Windows NT 6\.1"), "Windows", "7"),
+    (re.compile(r"Mac OS X (\d+)[_.](\d+)"), "Mac OS X", None),
+    (re.compile(r"Android (\d+)"), "Android", None),
+    (re.compile(r"iPhone OS (\d+)"), "iOS", None),
+    (re.compile(r"Linux"), "Linux", None),
+]
+
+
+class UserAgentProcessor(Processor):
+    kind = "user_agent"
+
+    def run(self, ctx):
+        field = self.field or "user_agent"
+        v = _get_path(ctx, field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{field}] is missing")
+        ua = str(v)
+        out: Dict[str, Any] = {"original": ua, "name": "Other"}
+        for pat, name in _UA_PATTERNS:
+            m = pat.search(ua)
+            if m:
+                out["name"] = name
+                out["version"] = m.group(1)
+                break
+        for pat, os_name, os_version in _OS_PATTERNS:
+            m = pat.search(ua)
+            if m:
+                os_out = {"name": os_name}
+                if os_version:
+                    os_out["version"] = os_version
+                elif m.groups():
+                    os_out["version"] = ".".join(g for g in m.groups() if g)
+                out["os"] = os_out
+                break
+        device = "Other"
+        if "iPhone" in ua:
+            device = "iPhone"
+        elif "Android" in ua and "Mobile" in ua:
+            device = "Generic Smartphone"
+        out["device"] = {"name": device}
+        _set_path(ctx, self.spec.get("target_field", "user_agent"), out)
+
+
+class GeoIpProcessor(Processor):
+    """`geoip` — the reference bundles GeoLite2 (`plugins/ingest-geoip`);
+    that database can't ship here, so lookups resolve against an inline
+    `database` param: a list of {cidr, ...geo fields} entries."""
+
+    kind = "geoip"
+
+    def run(self, ctx):
+        import ipaddress
+        field = self.field or "ip"
+        v = _get_path(ctx, field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{field}] is missing")
+        database = self.spec.get("database", [])
+        try:
+            addr = ipaddress.ip_address(str(v))
+        except ValueError:
+            raise IngestProcessorError(f"[{v}] is not a valid ip address")
+        for entry in database:
+            net = ipaddress.ip_network(entry.get("cidr", "0.0.0.0/0"))
+            if addr in net:
+                geo = {k: val for k, val in entry.items() if k != "cidr"}
+                _set_path(ctx, self.spec.get("target_field", "geoip"), geo)
+                return
+        if not self.ignore_missing and database:
+            return   # address not in database: no-op like the reference
+
+
+def register_extra_processors() -> None:
+    from elasticsearch_tpu.ingest.service import PROCESSORS
+    for cls in (GrokProcessor, CsvProcessor, KvProcessor, JsonProcessor,
+                UrlDecodeProcessor, HtmlStripProcessor, BytesProcessor,
+                FingerprintProcessor, SortProcessor, UriPartsProcessor,
+                DotExpanderProcessor, ForeachProcessor, UserAgentProcessor,
+                GeoIpProcessor):
+        PROCESSORS[cls.kind] = cls

@@ -1,0 +1,170 @@
+"""Grok library + extended ingest processors.
+
+Reference behaviors: libs/grok pattern bank, modules/ingest-common
+processors (csv/kv/json/urldecode/html_strip/bytes/fingerprint/foreach),
+ingest-user-agent, ingest-geoip (inline database variant).
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.ingest.grok import Grok
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+def simulate(client, processors, doc):
+    st, body = client.req("POST", "/_ingest/pipeline/_simulate", {
+        "pipeline": {"processors": processors},
+        "docs": [{"_source": doc}]})
+    assert st == 200, body
+    return body["docs"][0]["doc"]["_source"]
+
+
+# -------------------------------------------------------------------- grok
+
+def test_grok_basic_extraction():
+    g = Grok("%{IPV4:client} %{WORD:method} %{NUMBER:bytes:int}")
+    out = g.match("10.2.3.4 GET 1234")
+    assert out == {"client": "10.2.3.4", "method": "GET", "bytes": 1234}
+
+
+def test_grok_apache_log():
+    g = Grok("%{COMMONAPACHELOG}")
+    line = ('127.0.0.1 - frank [10/Oct/2000:13:55:36 -0700] '
+            '"GET /apache_pb.gif HTTP/1.0" 200 2326')
+    out = g.match(line)
+    assert out["source.address"] == "127.0.0.1"
+    assert out["http.request.method"] == "GET"
+    assert out["http.response.status_code"] == 200
+    assert out["http.response.body.bytes"] == 2326
+
+
+def test_grok_custom_definition():
+    g = Grok("%{ORDER:order_id}", {"ORDER": r"ORD-\d{6}"})
+    assert g.match("ref ORD-123456 ok") == {"order_id": "ORD-123456"}
+
+
+def test_grok_no_match_raises_in_pipeline(client):
+    st, body = client.req("POST", "/_ingest/pipeline/_simulate", {
+        "pipeline": {"processors": [
+            {"grok": {"field": "msg", "patterns": ["%{IPV4:ip}"]}}]},
+        "docs": [{"_source": {"msg": "no ip here"}}]})
+    assert "error" in body["docs"][0]
+
+
+def test_grok_processor_multiple_patterns(client):
+    out = simulate(client, [
+        {"grok": {"field": "msg",
+                  "patterns": ["level=%{LOGLEVEL:level}",
+                               "%{TIMESTAMP_ISO8601:ts}"]}}],
+        {"msg": "2024-03-05T10:00:00Z startup"})
+    assert out["ts"] == "2024-03-05T10:00:00Z"
+
+
+# -------------------------------------------------------- misc processors
+
+def test_csv_processor(client):
+    out = simulate(client, [
+        {"csv": {"field": "row", "target_fields": ["a", "b", "c"]}}],
+        {"row": 'x,"y,z",3'})
+    assert out["a"] == "x" and out["b"] == "y,z" and out["c"] == "3"
+
+
+def test_kv_processor(client):
+    out = simulate(client, [
+        {"kv": {"field": "msg", "field_split": " ", "value_split": "="}}],
+        {"msg": "ip=1.2.3.4 error=NONE"})
+    assert out["ip"] == "1.2.3.4" and out["error"] == "NONE"
+
+
+def test_json_processor(client):
+    out = simulate(client, [
+        {"json": {"field": "raw", "target_field": "parsed"}}],
+        {"raw": '{"a": 1}'})
+    assert out["parsed"] == {"a": 1}
+
+
+def test_urldecode_htmlstrip_bytes(client):
+    out = simulate(client, [
+        {"urldecode": {"field": "u"}},
+        {"html_strip": {"field": "h"}},
+        {"bytes": {"field": "sz"}}],
+        {"u": "a%20b%2Fc", "h": "<b>bold</b> text", "sz": "2kb"})
+    assert out["u"] == "a b/c"
+    assert out["h"] == "bold text"
+    assert out["sz"] == 2048
+
+
+def test_fingerprint_deterministic(client):
+    doc = {"user": "alice", "n": 7}
+    out1 = simulate(client, [{"fingerprint": {"fields": ["user", "n"]}}], dict(doc))
+    out2 = simulate(client, [{"fingerprint": {"fields": ["n", "user"]}}], dict(doc))
+    assert out1["fingerprint"] == out2["fingerprint"]   # field order canonical
+
+
+def test_sort_and_foreach(client):
+    out = simulate(client, [
+        {"sort": {"field": "tags", "order": "desc"}},
+        {"foreach": {"field": "vals",
+                     "processor": {"uppercase": {"field": "_ingest._value"}}}}],
+        {"tags": [3, 1, 2], "vals": ["a", "b"]})
+    assert out["tags"] == [3, 2, 1]
+    assert out["vals"] == ["A", "B"]
+
+
+def test_uri_parts(client):
+    out = simulate(client, [{"uri_parts": {"field": "link"}}],
+                   {"link": "https://user:pw@example.com:8443/p/f.txt?q=1#top"})
+    u = out["url"]
+    assert u["domain"] == "example.com" and u["port"] == 8443
+    assert u["extension"] == "txt" and u["query"] == "q=1"
+
+
+def test_dot_expander(client):
+    out = simulate(client, [{"dot_expander": {"field": "a.b"}}],
+                   {"a.b": 5})
+    assert out["a"] == {"b": 5}
+
+
+def test_user_agent(client):
+    ua = ("Mozilla/5.0 (Windows NT 10.0; Win64; x64) AppleWebKit/537.36 "
+          "(KHTML, like Gecko) Chrome/120.0.0.0 Safari/537.36")
+    out = simulate(client, [{"user_agent": {"field": "agent"}}],
+                   {"agent": ua})
+    assert out["user_agent"]["name"] == "Chrome"
+    assert out["user_agent"]["version"] == "120"
+    assert out["user_agent"]["os"]["name"] == "Windows"
+
+
+def test_geoip_inline_database(client):
+    db = [{"cidr": "10.0.0.0/8", "country_iso_code": "ZZ",
+           "city_name": "Intranet"}]
+    out = simulate(client, [{"geoip": {"field": "ip", "database": db}}],
+                   {"ip": "10.1.2.3"})
+    assert out["geoip"]["city_name"] == "Intranet"
