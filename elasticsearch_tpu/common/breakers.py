@@ -1,0 +1,93 @@
+"""Hierarchical circuit breakers.
+
+Reference: `indices/breaker/HierarchyCircuitBreakerService.java:47` — child
+breakers (request, fielddata, in_flight_requests, accounting) account
+estimated memory against per-breaker limits, and every child addition also
+checks the parent's total. Tripping raises a 429 CircuitBreakingException.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+
+
+class CircuitBreakingError(SearchEngineError):
+    status = 429
+
+    @property
+    def error_type(self) -> str:
+        return "circuit_breaking_exception"
+
+
+class ChildBreaker:
+    def __init__(self, name: str, limit_bytes: int, overhead: float = 1.0):
+        self.name = name
+        self.limit = limit_bytes
+        self.overhead = overhead
+        self.used = 0
+        self.trip_count = 0
+
+    def stats(self) -> dict:
+        return {"limit_size_in_bytes": self.limit,
+                "estimated_size_in_bytes": self.used,
+                "overhead": self.overhead,
+                "tripped": self.trip_count}
+
+
+class HierarchyCircuitBreakerService:
+    """Parent limit defaults to 95% of a nominal heap; children as in
+    `HierarchyCircuitBreakerService` defaults (request 60%, fielddata 40%,
+    in_flight 100%, accounting 100%)."""
+
+    def __init__(self, total_limit: int = 1 << 31):  # nominal 2 GB "heap"
+        self.parent_limit = int(total_limit * 0.95)
+        self.parent_trip_count = 0
+        self._lock = threading.Lock()
+        self.breakers: Dict[str, ChildBreaker] = {
+            "request": ChildBreaker("request", int(total_limit * 0.6)),
+            "fielddata": ChildBreaker("fielddata", int(total_limit * 0.4),
+                                      overhead=1.03),
+            "in_flight_requests": ChildBreaker("in_flight_requests",
+                                               total_limit, overhead=2.0),
+            "accounting": ChildBreaker("accounting", total_limit),
+        }
+
+    def add_estimate(self, breaker: str, bytes_: int, label: str = "") -> None:
+        with self._lock:
+            child = self.breakers[breaker]
+            new_used = child.used + int(bytes_ * child.overhead)
+            if bytes_ > 0 and new_used > child.limit:
+                child.trip_count += 1
+                raise CircuitBreakingError(
+                    f"[{breaker}] Data too large, data for [{label}] would be "
+                    f"[{new_used}/{new_used}b], which is larger than the limit "
+                    f"of [{child.limit}/{child.limit}b]",
+                    bytes_wanted=new_used, bytes_limit=child.limit,
+                    durability="TRANSIENT")
+            total = sum(b.used for b in self.breakers.values()) + \
+                int(bytes_ * child.overhead)
+            if bytes_ > 0 and total > self.parent_limit:
+                self.parent_trip_count += 1
+                raise CircuitBreakingError(
+                    f"[parent] Data too large, data for [{label}] would be "
+                    f"[{total}b], which is larger than the limit of "
+                    f"[{self.parent_limit}b]",
+                    bytes_wanted=total, bytes_limit=self.parent_limit,
+                    durability="TRANSIENT")
+            child.used = max(0, new_used)
+
+    def release(self, breaker: str, bytes_: int) -> None:
+        with self._lock:
+            child = self.breakers[breaker]
+            child.used = max(0, child.used - int(bytes_ * child.overhead))
+
+    def stats(self) -> dict:
+        out = {name: b.stats() for name, b in self.breakers.items()}
+        out["parent"] = {"limit_size_in_bytes": self.parent_limit,
+                         "estimated_size_in_bytes":
+                         sum(b.used for b in self.breakers.values()),
+                         "overhead": 1.0, "tripped": self.parent_trip_count}
+        return out

@@ -1,0 +1,10 @@
+"""Monitoring: hot threads, process/OS probes, slow logs, deprecations.
+
+Reference: `monitor/` (JvmGcMonitorService, HotThreads, probes), per-index
+slow logs (`index/SearchSlowLog.java`), `DeprecationLogger`.
+"""
+
+from elasticsearch_tpu.monitor.hot_threads import hot_threads_report
+from elasticsearch_tpu.monitor.slow_log import SlowLog
+
+__all__ = ["hot_threads_report", "SlowLog"]

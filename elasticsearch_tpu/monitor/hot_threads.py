@@ -1,0 +1,45 @@
+"""Hot threads: stack dumps of the busiest threads.
+
+Reference: `monitor/jvm/HotThreads.java:41` — samples thread CPU over an
+interval and prints the top-N stacks. Python analog: sample
+`sys._current_frames` twice and report threads whose top frame advanced
+(busy) with their current stacks.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Dict
+
+
+def hot_threads_report(interval_s: float = 0.05, top_n: int = 3,
+                       node_name: str = "node") -> str:
+    first: Dict[int, str] = {
+        tid: _top_frame_key(frame)
+        for tid, frame in sys._current_frames().items()
+    }
+    time.sleep(max(0.0, interval_s))
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = [f"::: {{{node_name}}}",
+             f"   Hot threads at {time.strftime('%Y-%m-%dT%H:%M:%S')}, "
+             f"interval={interval_s}s, busiestThreads={top_n}:"]
+    busy_first = sorted(
+        frames.items(),
+        key=lambda kv: (first.get(kv[0]) == _top_frame_key(kv[1])),  # moved first
+    )
+    for tid, frame in busy_first[:top_n]:
+        name = names.get(tid, str(tid))
+        state = "runnable" if first.get(tid) != _top_frame_key(frame) else "waiting"
+        lines.append(f"   0.0% cpu usage by thread '{name}' ({state})")
+        for entry in traceback.format_stack(frame)[-10:]:
+            for ln in entry.rstrip().splitlines():
+                lines.append("     " + ln.strip())
+    return "\n".join(lines) + "\n"
+
+
+def _top_frame_key(frame) -> str:
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"

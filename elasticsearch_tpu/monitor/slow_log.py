@@ -1,0 +1,47 @@
+"""Per-index search/indexing slow logs.
+
+Reference: `index/SearchSlowLog.java` / `IndexingSlowLog.java` — threshold
+settings per level (warn/info/debug/trace); breaches emit a structured log
+line. Here breaches append to an in-memory ring consumable from stats/tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.settings import parse_time_value
+
+LEVELS = ("warn", "info", "debug", "trace")
+
+
+class SlowLog:
+    def __init__(self, kind: str = "search"):
+        self.kind = kind
+        self.entries: List[dict] = []
+
+    def thresholds(self, settings) -> Dict[str, float]:
+        out = {}
+        for level in LEVELS:
+            key = (f"index.{self.kind}.slowlog.threshold."
+                   f"{'query' if self.kind == 'search' else 'index'}.{level}")
+            v = settings.get(key)
+            if v is not None:
+                out[level] = parse_time_value(v, key)
+        return out
+
+    def maybe_log(self, settings, index: str, took_s: float,
+                  source: Optional[Any] = None) -> Optional[str]:
+        level_hit = None
+        for level in LEVELS:   # warn is the highest threshold; first hit wins
+            th = self.thresholds(settings).get(level)
+            if th is not None and th >= 0 and took_s >= th:
+                level_hit = level
+                break
+        if level_hit is None:
+            return None
+        self.entries.append({"index": index, "level": level_hit,
+                             "took_ms": took_s * 1000.0,
+                             "source": source})
+        if len(self.entries) > 1000:
+            del self.entries[:500]
+        return level_hit

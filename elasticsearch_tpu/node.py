@@ -110,6 +110,16 @@ class Node:
         from elasticsearch_tpu.xpack.ccr import CcrService, RemoteClusterService
         self.remotes = RemoteClusterService(self)
         self.ccr = CcrService(self)
+        from elasticsearch_tpu.common.breakers import HierarchyCircuitBreakerService
+        from elasticsearch_tpu.monitor import SlowLog
+        self.breakers = HierarchyCircuitBreakerService()
+        self.search_slow_log = SlowLog("search")
+        self.indexing_slow_log = SlowLog("indexing")
+        self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
+                                         "bulk": 0, "delete": 0}
+        # cluster-level persistent/transient settings (_cluster/settings API)
+        self.cluster_settings: Dict[str, dict] = {"persistent": {},
+                                                  "transient": {}}
         self.settings = settings or {}
         from elasticsearch_tpu.security import SecurityService, SecurityStore
         self.security = SecurityService(
@@ -333,30 +343,51 @@ class Node:
             store = _MultiShardVectorStore(svc)
             readers.append((svc, reader, store))
 
+        # request breaker accounts the candidate working set (reference:
+        # QueryPhase checks the request breaker while collecting)
+        breaker_bytes = sum(r.num_docs for _, r, _ in readers) * 16
+        self.breakers.add_estimate("request", breaker_bytes, "<search>")
+
+        profile_enabled = bool(body.get("profile"))
+        profile_shards = []
         # execute per index, merge across indices by score/sort
         all_hits = []
         total = 0
         relation = "eq"
         max_score = None
         merged_aggs = None
-        for svc, reader, store in readers:
-            result = execute_query_phase(reader, svc.mapper_service, body,
-                                         vector_store=store)
-            total += result.total_hits
-            if result.total_relation == "gte":
-                relation = "gte"
-            if result.max_score is not None:
-                max_score = max(max_score or -1e30, result.max_score)
-            hits = execute_fetch_phase(reader, svc.mapper_service, body, result,
-                                       index_name=svc.name)
-            for h, score, sv in zip(hits, result.scores,
-                                    result.sort_values or [None] * len(hits)):
-                all_hits.append((h, float(score), sv))
-            if result.aggregations is not None:
-                if merged_aggs is None:
-                    merged_aggs = result.aggregations
-                else:
-                    merged_aggs = _merge_agg_trees(merged_aggs, result.aggregations)
+        try:
+            for svc, reader, store in readers:
+                q_start = time.perf_counter_ns()
+                result = execute_query_phase(reader, svc.mapper_service, body,
+                                             vector_store=store)
+                q_nanos = time.perf_counter_ns() - q_start
+                total += result.total_hits
+                if result.total_relation == "gte":
+                    relation = "gte"
+                if result.max_score is not None:
+                    max_score = max(max_score or -1e30, result.max_score)
+                f_start = time.perf_counter_ns()
+                hits = execute_fetch_phase(reader, svc.mapper_service, body,
+                                           result, index_name=svc.name)
+                f_nanos = time.perf_counter_ns() - f_start
+                for h, score, sv in zip(hits, result.scores,
+                                        result.sort_values or [None] * len(hits)):
+                    all_hits.append((h, float(score), sv))
+                if result.aggregations is not None:
+                    if merged_aggs is None:
+                        merged_aggs = result.aggregations
+                    else:
+                        merged_aggs = _merge_agg_trees(merged_aggs,
+                                                       result.aggregations)
+                if profile_enabled:
+                    from elasticsearch_tpu.search.profile import shard_profile
+                    profile_shards.append(shard_profile(
+                        svc.name, body, q_nanos, f_nanos,
+                        result.total_hits))
+        finally:
+            self.breakers.release("request", breaker_bytes)
+        self.counters["search"] += 1
 
         sort_spec = body.get("sort")
         if sort_spec:
@@ -381,6 +412,13 @@ class Node:
         }
         if merged_aggs is not None:
             resp["aggregations"] = merged_aggs
+        if profile_enabled:
+            resp["profile"] = {"shards": profile_shards}
+        # slow log (reference: SearchSlowLog thresholds per index)
+        took_s = time.perf_counter() - start
+        for svc, _, _ in readers:
+            self.search_slow_log.maybe_log(svc.settings, svc.name, took_s,
+                                           source=body.get("query"))
 
         suggest_spec = body.get("suggest")
         if suggest_spec:

@@ -28,6 +28,8 @@ def register_all(rc: RestController, node: Node) -> None:
     register_script(rc, node)
     from elasticsearch_tpu.rest.actions_xpack import register_xpack
     register_xpack(rc, node)
+    from elasticsearch_tpu.rest.actions_admin import register_admin
+    register_admin(rc, node)
     from elasticsearch_tpu.security.rest_filter import (
         make_security_filter, register_security,
     )
@@ -343,7 +345,18 @@ def register_all(rc: RestController, node: Node) -> None:
                          "process": {"cpu": {"total_in_millis": int(
                              (usage.ru_utime + usage.ru_stime) * 1000)}},
                          "indices": {"docs": {"count": sum(
-                             s.doc_count() for s in node.indices.indices.values())}}}}}
+                             s.doc_count() for s in node.indices.indices.values())},
+                                     "search": {"query_total":
+                                                node.counters.get("search", 0)},
+                                     "indexing": {"index_total":
+                                                  node.counters.get("index", 0)}},
+                         "breakers": node.breakers.stats(),
+                         "thread_pool": {name: {"threads": 0, "queue": 0,
+                                                "active": 0, "rejected": 0,
+                                                "completed":
+                                                node.counters.get(name, 0)}
+                                         for name in ("search", "write", "get",
+                                                      "generic")}}}}
 
     rc.register("GET", "/_cluster/health", cluster_health)
     rc.register("GET", "/_cluster/stats", cluster_stats)

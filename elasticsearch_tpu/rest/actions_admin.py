@@ -1,0 +1,382 @@
+"""Admin/observability REST surface: cluster settings, reroute, allocation
+explain, hot threads, breakers, slow logs, deprecations, point-in-time,
+termvectors, segments/recovery/shard_stores, resolve, extra _cat APIs.
+
+Reference handlers: `rest/action/admin/cluster/*` (RestClusterUpdateSettings,
+RestClusterRerouteAction, RestClusterAllocationExplainAction,
+RestNodesHotThreadsAction), `rest/action/admin/indices/*` (segments,
+recovery, shard stores, resolve), `rest/action/cat/*`, `action/termvectors`,
+point-in-time (`RestOpenPointInTimeAction`), x-pack deprecation checks.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, List, Tuple
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.monitor import hot_threads_report
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.version import __version__
+
+
+def register_admin(rc: RestController, node: Node) -> None:
+    # ------------------------------------------------------ cluster settings
+    def get_cluster_settings(req):
+        out = dict(node.cluster_settings)
+        if req.bool_param("include_defaults"):
+            out["defaults"] = {"cluster.name": node.cluster_name}
+        return 200, out
+
+    def put_cluster_settings(req):
+        body = req.json() or {}
+        applied = {"acknowledged": True, "persistent": {}, "transient": {}}
+        for scope in ("persistent", "transient"):
+            for key, value in _flatten(body.get(scope, {})).items():
+                if value is None:
+                    node.cluster_settings[scope].pop(key, None)
+                else:
+                    node.cluster_settings[scope][key] = value
+                applied[scope][key] = value
+        return 200, applied
+
+    rc.register("GET", "/_cluster/settings", get_cluster_settings)
+    rc.register("PUT", "/_cluster/settings", put_cluster_settings)
+
+    # ------------------------------------------------- reroute + allocation
+    def reroute(req):
+        body = req.json() or {}
+        # single-node facade: commands validate + ack (real moves happen in
+        # the multi-node cluster layer, cluster/allocation.py)
+        for cmd in body.get("commands", []):
+            kind = next(iter(cmd))
+            if kind not in ("move", "cancel", "allocate_replica",
+                            "allocate_stale_primary", "allocate_empty_primary"):
+                raise IllegalArgumentError(f"unknown reroute command [{kind}]")
+        return 200, {"acknowledged": True, "state": {
+            "cluster_uuid": node.node_id,
+            "nodes": {node.node_id: {"name": node.node_name}}}}
+
+    def allocation_explain(req):
+        body = req.json() or {}
+        index = body.get("index")
+        services = node.indices.resolve(index) if index else \
+            list(node.indices.indices.values())
+        if not services:
+            return 200, {"note": "no shards to explain"}
+        svc = services[0]
+        unassigned = svc.num_replicas > 0
+        out = {
+            "index": svc.name,
+            "shard": int(body.get("shard", 0)),
+            "primary": bool(body.get("primary", True)),
+            "current_state": "started",
+        }
+        if not out["primary"] and unassigned:
+            out.update({
+                "current_state": "unassigned",
+                "unassigned_info": {"reason": "REPLICA_ADDED",
+                                    "last_allocation_status": "no_attempt"},
+                "can_allocate": "no",
+                "allocate_explanation":
+                    "cannot allocate because allocation is not permitted to "
+                    "any of the nodes",
+                "node_allocation_decisions": [{
+                    "node_name": node.node_name, "node_decision": "no",
+                    "deciders": [{
+                        "decider": "same_shard",
+                        "decision": "NO",
+                        "explanation":
+                            "a copy of this shard is already allocated to "
+                            "this node"}]}],
+            })
+        else:
+            out.update({"can_remain_on_current_node": "yes",
+                        "current_node": {"name": node.node_name,
+                                         "id": node.node_id}})
+        return 200, out
+
+    rc.register("POST", "/_cluster/reroute", reroute)
+    rc.register("GET", "/_cluster/allocation/explain", allocation_explain)
+    rc.register("POST", "/_cluster/allocation/explain", allocation_explain)
+
+    # ------------------------------------------------------------ monitoring
+    def hot_threads(req):
+        interval = float(req.param("interval", "50ms").rstrip("ms")) / 1000 \
+            if str(req.param("interval", "50ms")).endswith("ms") else 0.05
+        return 200, hot_threads_report(interval_s=min(interval, 0.5),
+                                       node_name=node.node_name)
+
+    rc.register("GET", "/_nodes/hot_threads", hot_threads)
+    rc.register("GET", "/_nodes/{node_id}/hot_threads", hot_threads)
+
+    def slowlog(req):
+        return 200, {"search": node.search_slow_log.entries,
+                     "indexing": node.indexing_slow_log.entries}
+
+    rc.register("GET", "/_slowlog", slowlog)
+
+    def deprecations(req):
+        # reference: x-pack deprecation plugin runs checks over settings
+        issues = []
+        for svc in node.indices.indices.values():
+            if svc.settings.get("index.frozen"):
+                issues.append({
+                    "level": "warning",
+                    "message": f"index [{svc.name}] is frozen",
+                    "details": "frozen indices are deprecated in favor of "
+                               "searchable snapshots"})
+        return 200, {"cluster_settings": [], "ml_settings": [],
+                     "node_settings": [],
+                     "index_settings": {svc.name: [] for svc in
+                                        node.indices.indices.values()},
+                     "deprecations": issues}
+
+    rc.register("GET", "/_migration/deprecations", deprecations)
+
+    # -------------------------------------------------------- point in time
+    pits = {}
+
+    def open_pit(req):
+        index = req.params["index"]
+        keep_alive = req.param("keep_alive", "1m")
+        pit_id = uuid.uuid4().hex
+        readers = [(svc, svc.combined_reader())
+                   for svc in node.indices.resolve(index)]
+        pits[pit_id] = {"index": index, "readers": readers,
+                        "expires": time.time() + 300}
+        return 200, {"id": pit_id}
+
+    def close_pit(req):
+        body = req.json() or {}
+        pit_id = body.get("id")
+        found = pits.pop(pit_id, None)
+        return 200, {"succeeded": found is not None,
+                     "num_freed": 1 if found else 0}
+
+    rc.register("POST", "/{index}/_pit", open_pit)
+    rc.register("DELETE", "/_pit", close_pit)
+
+    # ----------------------------------------------------------- termvectors
+    def termvectors(req):
+        index = req.params["index"]
+        doc_id = req.params.get("id")
+        body = req.json() or {}
+        svc = node.indices.get(index)
+        source = None
+        if doc_id is not None:
+            got = node.get_doc(index, doc_id)
+            if not got.get("found"):
+                return 404, {"_index": index, "_id": doc_id, "found": False}
+            source = got["_source"]
+        else:
+            source = (body.get("doc") or {})
+        fields = body.get("fields")
+        reader = svc.combined_reader()
+        out_fields = {}
+        for fname, value in source.items():
+            if fields and fname not in fields:
+                continue
+            mapper = svc.mapper_service.get(fname)
+            if mapper is None or not hasattr(mapper, "analyze"):
+                continue
+            tokens = mapper.analyze(str(value))
+            terms: dict = {}
+            for pos, t in enumerate(tokens):
+                entry = terms.setdefault(t, {"term_freq": 0, "tokens": []})
+                entry["term_freq"] += 1
+                entry["tokens"].append({"position": pos})
+            if body.get("term_statistics"):
+                for t, entry in terms.items():
+                    entry["doc_freq"] = reader.doc_freq(fname, t)
+            out_fields[fname] = {
+                "field_statistics": {
+                    "sum_doc_freq": sum(e["term_freq"] for e in terms.values()),
+                    "doc_count": reader.num_docs,
+                    "sum_ttf": sum(e["term_freq"] for e in terms.values())},
+                "terms": terms}
+        return 200, {"_index": index, "_id": doc_id, "found": True,
+                     "took": 0, "term_vectors": out_fields}
+
+    rc.register("GET", "/{index}/_termvectors/{id}", termvectors)
+    rc.register("POST", "/{index}/_termvectors/{id}", termvectors)
+    rc.register("GET", "/{index}/_termvectors", termvectors)
+    rc.register("POST", "/{index}/_termvectors", termvectors)
+
+    # ------------------------------------------- segments/recovery/stores
+    def segments(req):
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            shards = {}
+            for shard in svc.shards:
+                reader = shard.engine.acquire_searcher()
+                segs = []
+                if reader is not None:
+                    for i, view in enumerate(reader.views):
+                        segs.append({
+                            "segment": f"_{i}",
+                            "num_docs": int(view.live_count),
+                            "deleted_docs": int(view.segment.num_docs -
+                                                view.live_count),
+                            "committed": True, "search": True,
+                            "compound": False})
+                shards[str(shard.shard_id)] = [{"segments":
+                                                {s["segment"]: s for s in segs}}]
+            out[svc.name] = {"shards": shards}
+        return 200, {"indices": out}
+
+    def recovery(req):
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            out[svc.name] = {"shards": [{
+                "id": sh.shard_id, "type": "EMPTY_STORE", "stage": "DONE",
+                "primary": True,
+                "source": {}, "target": {"name": node.node_name},
+                "index": {"size": {"total_in_bytes": 0},
+                          "files": {"total": 0}},
+            } for sh in svc.shards]}
+        return 200, out
+
+    def shard_stores(req):
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            out[svc.name] = {"shards": {
+                str(sh.shard_id): {"stores": [{
+                    "allocation_id": uuid.uuid4().hex[:20],
+                    "allocation": "primary",
+                    node.node_id: {"name": node.node_name}}]}
+                for sh in svc.shards}}
+        return 200, {"indices": out}
+
+    rc.register("GET", "/_segments", segments)
+    rc.register("GET", "/{index}/_segments", segments)
+    rc.register("GET", "/_recovery", recovery)
+    rc.register("GET", "/{index}/_recovery", recovery)
+    rc.register("GET", "/_shard_stores", shard_stores)
+    rc.register("GET", "/{index}/_shard_stores", shard_stores)
+
+    # --------------------------------------------------------- resolve index
+    def resolve_index(req):
+        import fnmatch
+        expr = req.params["name"]
+        indices = []
+        aliases = {}
+        for svc in node.indices.indices.values():
+            if any(fnmatch.fnmatchcase(svc.name, p)
+                   for p in expr.split(",")):
+                indices.append({"name": svc.name,
+                                "attributes": ["open"]})
+            for alias in svc.aliases:
+                if any(fnmatch.fnmatchcase(alias, p) for p in expr.split(",")):
+                    aliases.setdefault(alias, []).append(svc.name)
+        return 200, {"indices": indices,
+                     "aliases": [{"name": a, "indices": sorted(ix)}
+                                 for a, ix in sorted(aliases.items())],
+                     "data_streams": []}
+
+    rc.register("GET", "/_resolve/index/{name}", resolve_index)
+
+    # ------------------------------------------------------------- _cat more
+    def _table(req, headers: List[str], rows: List[List[Any]]):
+        if req.param("format") == "json":
+            return 200, [dict(zip(headers, r)) for r in rows]
+        if req.bool_param("v"):
+            rows = [headers] + rows
+        widths = [max((len(str(r[i])) for r in rows), default=0)
+                  for i in range(len(headers))]
+        lines = [" ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+                 for r in rows]
+        return 200, "\n".join(lines) + "\n"
+
+    def cat_allocation(req):
+        n_shards = sum(s.num_shards for s in node.indices.indices.values())
+        return _table(req, ["shards", "disk.indices", "host", "ip", "node"],
+                      [[n_shards, "0b", "127.0.0.1", "127.0.0.1",
+                        node.node_name]])
+
+    def cat_templates(req):
+        rows = [[name, str(t.get("index_patterns", [])), t.get("order", 0), ""]
+                for name, t in node.templates.templates.items()]
+        rows += [[name, str(t.get("index_patterns", [])),
+                  t.get("priority", 0), "composable"]
+                 for name, t in node.templates.index_templates.items()]
+        return _table(req, ["name", "index_patterns", "order", "version"], rows)
+
+    def cat_thread_pool(req):
+        rows = [[node.node_name, name, 0, 0, 0]
+                for name in ("search", "write", "get", "generic", "management",
+                             "flush", "refresh", "snapshot", "force_merge")]
+        return _table(req, ["node_name", "name", "active", "queue", "rejected"],
+                      rows)
+
+    def cat_plugins(req):
+        rows = [[node.node_name, comp, __version__]
+                for comp in ("sql", "eql", "ilm", "watcher", "transform",
+                             "rollup", "ccr", "security", "ml")]
+        return _table(req, ["name", "component", "version"], rows)
+
+    def cat_master(req):
+        return _table(req, ["id", "host", "ip", "node"],
+                      [[node.node_id, "127.0.0.1", "127.0.0.1",
+                        node.node_name]])
+
+    def cat_segments(req):
+        rows = []
+        for svc in node.indices.resolve(req.params.get("index")):
+            for shard in svc.shards:
+                reader = shard.engine.acquire_searcher()
+                for i, view in enumerate(reader.views):
+                    rows.append([svc.name, shard.shard_id, "p", f"_{i}",
+                                 int(view.live_count),
+                                 int(view.segment.num_docs - view.live_count)])
+        return _table(req, ["index", "shard", "prirep", "segment",
+                            "docs.count", "docs.deleted"], rows)
+
+    def cat_recovery(req):
+        rows = [[svc.name, sh.shard_id, "done", "empty_store", "100%"]
+                for svc in node.indices.resolve(req.params.get("index"))
+                for sh in svc.shards]
+        return _table(req, ["index", "shard", "stage", "type", "files_percent"],
+                      rows)
+
+    def cat_pending_tasks(req):
+        return _table(req, ["insertOrder", "timeInQueue", "priority", "source"],
+                      [])
+
+    def cat_repositories(req):
+        rows = [[name, "fs"] for name in node.snapshots.repositories]
+        return _table(req, ["id", "type"], rows)
+
+    def cat_snapshots(req):
+        repo = req.params.get("repository")
+        rows = []
+        for name, r in node.snapshots.repositories.items():
+            if repo and name != repo:
+                continue
+            for snap in r.list_snapshots():
+                rows.append([snap, "SUCCESS", name])
+        return _table(req, ["id", "status", "repository"], rows)
+
+    rc.register("GET", "/_cat/allocation", cat_allocation)
+    rc.register("GET", "/_cat/templates", cat_templates)
+    rc.register("GET", "/_cat/thread_pool", cat_thread_pool)
+    rc.register("GET", "/_cat/plugins", cat_plugins)
+    rc.register("GET", "/_cat/master", cat_master)
+    rc.register("GET", "/_cat/segments", cat_segments)
+    rc.register("GET", "/_cat/recovery", cat_recovery)
+    rc.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
+    rc.register("GET", "/_cat/repositories", cat_repositories)
+    rc.register("GET", "/_cat/snapshots", cat_snapshots)
+    rc.register("GET", "/_cat/snapshots/{repository}", cat_snapshots)
+
+
+def _flatten(obj: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in (obj or {}).items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix + k + "."))
+        else:
+            out[prefix + k] = v
+    return out

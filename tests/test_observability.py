@@ -1,0 +1,213 @@
+"""Observability: breakers, profiler, slow logs, hot threads, cluster
+settings, allocation explain, termvectors, PIT, segments, resolve, cat.
+
+Reference behaviors: HierarchyCircuitBreakerService, search/profile,
+SearchSlowLog, HotThreads, admin cluster/indices REST handlers.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.breakers import (
+    CircuitBreakingError,
+    HierarchyCircuitBreakerService,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return self.rc.dispatch(method, path, {k: str(v) for k, v in query.items()},
+                                raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+# ---------------------------------------------------------------- breakers
+
+def test_breaker_trips_and_releases():
+    svc = HierarchyCircuitBreakerService(total_limit=1000)
+    svc.add_estimate("request", 500, "q1")
+    with pytest.raises(CircuitBreakingError):
+        svc.add_estimate("request", 200, "q2")   # 500+200 > 600 limit
+    assert svc.breakers["request"].trip_count == 1
+    svc.release("request", 500)
+    svc.add_estimate("request", 200, "q3")       # fits now
+    stats = svc.stats()
+    assert stats["request"]["estimated_size_in_bytes"] == 200
+    assert stats["parent"]["limit_size_in_bytes"] == 950
+
+
+def test_breaker_stats_in_nodes_stats(client):
+    st, body = client.req("GET", "/_nodes/stats")
+    node_stats = next(iter(body["nodes"].values()))
+    assert "request" in node_stats["breakers"]
+    assert "parent" in node_stats["breakers"]
+
+
+# ---------------------------------------------------------------- profiler
+
+def test_search_profile(client):
+    client.req("PUT", "/p/_doc/1", {"t": "hello world"})
+    client.req("POST", "/p/_refresh")
+    st, body = client.req("POST", "/p/_search", {
+        "profile": True, "query": {"match": {"t": "hello"}},
+        "aggs": {"n": {"value_count": {"field": "t"}}}})
+    assert st == 200
+    shards = body["profile"]["shards"]
+    assert len(shards) == 1
+    q = shards[0]["searches"][0]["query"][0]
+    assert q["type"] == "match"
+    assert q["time_in_nanos"] > 0
+    assert "breakdown" in q
+    assert shards[0]["aggregations"][0]["description"] == "n"
+
+
+# ---------------------------------------------------------------- slow log
+
+def test_search_slow_log(client, node):
+    client.req("PUT", "/slow", {"settings": {
+        "index.search.slowlog.threshold.query.warn": "0ms"}})
+    client.req("PUT", "/slow/_doc/1", {"x": 1})
+    client.req("POST", "/slow/_refresh")
+    client.req("POST", "/slow/_search", {"query": {"match_all": {}}})
+    st, body = client.req("GET", "/_slowlog")
+    assert any(e["index"] == "slow" and e["level"] == "warn"
+               for e in body["search"])
+
+
+# ------------------------------------------------------------- hot threads
+
+def test_hot_threads(client):
+    st, body = client.req("GET", "/_nodes/hot_threads")
+    assert st == 200
+    assert "Hot threads at" in body
+
+
+# --------------------------------------------------------- cluster settings
+
+def test_cluster_settings_roundtrip(client):
+    st, body = client.req("PUT", "/_cluster/settings", {
+        "persistent": {"search": {"default_timeout": "10s"}},
+        "transient": {"logger.level": "DEBUG"}})
+    assert body["persistent"]["search.default_timeout"] == "10s"
+    st, body = client.req("GET", "/_cluster/settings")
+    assert body["persistent"]["search.default_timeout"] == "10s"
+    assert body["transient"]["logger.level"] == "DEBUG"
+    # null deletes
+    client.req("PUT", "/_cluster/settings",
+               {"transient": {"logger.level": None}})
+    st, body = client.req("GET", "/_cluster/settings")
+    assert "logger.level" not in body["transient"]
+
+
+# --------------------------------------------- reroute/allocation explain
+
+def test_allocation_explain_unassigned_replica(client):
+    client.req("PUT", "/r1", {"settings": {"index.number_of_replicas": 1}})
+    st, body = client.req("POST", "/_cluster/allocation/explain",
+                          {"index": "r1", "shard": 0, "primary": False})
+    assert body["current_state"] == "unassigned"
+    assert body["can_allocate"] == "no"
+    assert body["node_allocation_decisions"][0]["deciders"][0]["decider"] == \
+        "same_shard"
+
+
+def test_reroute_validates_commands(client):
+    st, _ = client.req("POST", "/_cluster/reroute",
+                       {"commands": [{"move": {"index": "x", "shard": 0}}]})
+    assert st == 200
+    st, _ = client.req("POST", "/_cluster/reroute",
+                       {"commands": [{"bogus": {}}]})
+    assert st == 400
+
+
+# ------------------------------------------------------------- termvectors
+
+def test_termvectors(client):
+    client.req("PUT", "/tv/_doc/1", {"body": "the quick quick fox"})
+    client.req("POST", "/tv/_refresh")
+    st, body = client.req("GET", "/tv/_termvectors/1",
+                          {"fields": ["body"], "term_statistics": True})
+    terms = body["term_vectors"]["body"]["terms"]
+    assert terms["quick"]["term_freq"] == 2
+    assert terms["quick"]["doc_freq"] == 1
+    assert [t["position"] for t in terms["fox"]["tokens"]] == [3]
+
+
+# -------------------------------------------------------------------- PIT
+
+def test_point_in_time(client):
+    client.req("PUT", "/pit1/_doc/1", {"x": 1})
+    client.req("POST", "/pit1/_refresh")
+    st, body = client.req("POST", "/pit1/_pit", keep_alive="1m")
+    assert st == 200 and body["id"]
+    st, closed = client.req("DELETE", "/_pit", {"id": body["id"]})
+    assert closed["succeeded"] is True
+    st, closed = client.req("DELETE", "/_pit", {"id": body["id"]})
+    assert closed["succeeded"] is False
+
+
+# ----------------------------------------------------- segments + resolve
+
+def test_segments_and_cat_segments(client):
+    client.req("PUT", "/seg/_doc/1", {"x": 1})
+    client.req("POST", "/seg/_refresh")
+    st, body = client.req("GET", "/seg/_segments")
+    shards = body["indices"]["seg"]["shards"]
+    total_docs = sum(s["num_docs"]
+                     for shard in shards.values()
+                     for entry in shard
+                     for s in entry["segments"].values())
+    assert total_docs == 1
+    st, text = client.req("GET", "/_cat/segments", v="true")
+    assert "seg" in text
+
+
+def test_resolve_index(client):
+    client.req("PUT", "/logs-1", {"aliases": {"logs": {}}})
+    client.req("PUT", "/logs-2")
+    st, body = client.req("GET", "/_resolve/index/logs-*")
+    names = [i["name"] for i in body["indices"]]
+    assert names == ["logs-1", "logs-2"] or set(names) == {"logs-1", "logs-2"}
+    st, body = client.req("GET", "/_resolve/index/logs")
+    assert body["aliases"][0]["name"] == "logs"
+
+
+# ------------------------------------------------------------------- _cat
+
+def test_cat_extras(client, node):
+    client.req("PUT", "/_snapshot/r1", {"type": "fs", "settings": {
+        "location": str(node.indices.data_path) + "/snaps"
+        if hasattr(node.indices, "data_path") else "/tmp/snaps"}})
+    for path in ("/_cat/allocation", "/_cat/thread_pool", "/_cat/plugins",
+                 "/_cat/master", "/_cat/pending_tasks", "/_cat/repositories",
+                 "/_cat/templates", "/_cat/recovery"):
+        st, body = client.req("GET", path, v="true")
+        assert st == 200, path
+    st, body = client.req("GET", "/_cat/plugins", format="json")
+    assert any(row["component"] == "sql" for row in body)
+
+
+def test_deprecations(client):
+    client.req("PUT", "/frozen1", {"settings": {"index.frozen": True}})
+    st, body = client.req("GET", "/_migration/deprecations")
+    assert any("frozen" in d["message"] for d in body["deprecations"])
