@@ -1,0 +1,13 @@
+from elasticsearch_tpu.transport.tcp import (
+    AsyncioScheduler, ConnectTransportError, RemoteTransportError,
+    TcpTransportService, channel_type_for,
+)
+from elasticsearch_tpu.transport.wire import (
+    WIRE_VERSION, WireFormatError, decode_frames, encode_frame, encode_ping,
+)
+
+__all__ = [
+    "AsyncioScheduler", "ConnectTransportError", "RemoteTransportError",
+    "TcpTransportService", "channel_type_for", "WIRE_VERSION",
+    "WireFormatError", "decode_frames", "encode_frame", "encode_ping",
+]

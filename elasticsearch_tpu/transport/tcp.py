@@ -1,0 +1,434 @@
+"""Real TCP transport: asyncio sockets speaking the framed binary protocol.
+
+The production counterpart of `testing.deterministic.DisruptableTransport`,
+exposing the exact same `register`/`send` surface so `ClusterNode` and
+`Coordinator` run unchanged over real sockets. Redesign of the reference's
+transport stack (SURVEY.md §2.2):
+
+- `TransportService` façade — handler registry, request/response
+  correlation, timeouts, local direct dispatch when the target is this node
+  (reference `TransportService.java:119-121`).
+- `TcpTransport` — connection lifecycle, server bind, version handshake on
+  connect (reference `TcpTransport.java:796`), inbound dispatch.
+- Connection profile — per-purpose channels (recovery / bulk / state / reg,
+  reference `ConnectionProfile.java`) so a long recovery file copy cannot
+  head-of-line-block cluster-state publications.
+- Keep-alive pings (reference `TransportKeepAlive.java`).
+
+Design departure: the reference multiplexes blocking Java threads over
+Netty; here each node is a single-threaded asyncio actor — all handler
+callbacks run on the owning event loop, which is the same no-shared-memory
+discipline the deterministic simulator enforces, so code validated under
+simulation runs identically in production.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+from elasticsearch_tpu.transport.wire import (
+    STATUS_ERROR, STATUS_HANDSHAKE, STATUS_REQUEST, WIRE_VERSION,
+    decode_frames, encode_frame, encode_ping,
+)
+
+HANDSHAKE_ACTION = "internal:tcp/handshake"
+
+# channel classes by action prefix (reference: ConnectionProfile channel
+# types — recovery, bulk, reg, state, ping)
+_CHANNEL_RULES = (
+    ("internal:index/shard/recovery", "recovery"),
+    ("indices:data/write", "bulk"),
+    ("internal:cluster", "state"),
+    ("cluster:", "state"),
+)
+
+
+def channel_type_for(action: str) -> str:
+    for prefix, channel in _CHANNEL_RULES:
+        if action.startswith(prefix):
+            return channel
+    return "reg"
+
+
+class RemoteTransportError(SearchEngineError):
+    """An exception raised on the remote node, rethrown locally."""
+
+
+class ConnectTransportError(SearchEngineError):
+    """Could not establish/keep a connection to the target node."""
+
+
+class AsyncioScheduler:
+    """Adapter giving asyncio the deterministic-queue scheduling surface
+    (`schedule` / `schedule_in` / `now_ms` / `rng`) that Coordinator and
+    ClusterNode are written against."""
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None,
+                 seed: Optional[int] = None):
+        self.loop = loop or asyncio.get_event_loop()
+        self.rng = random.Random(seed)
+
+    @property
+    def now_ms(self) -> int:
+        return int(self.loop.time() * 1000)
+
+    def schedule(self, fn: Callable[[], None], label: str = "") -> None:
+        self.loop.call_soon(fn)
+
+    def schedule_in(self, delay_ms: int, fn: Callable[[], None],
+                    label: str = "") -> None:
+        self.loop.call_later(delay_ms / 1000.0, fn)
+
+    def schedule_at(self, time_ms: int, fn: Callable[[], None],
+                    label: str = "") -> None:
+        self.schedule_in(max(0, time_ms - self.now_ms), fn, label)
+
+
+class _Channel:
+    """One TCP connection to a peer, with its read pump and write half."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.buf = bytearray()
+        self.closed = False
+        self.pending_rids: set = set()  # requests in flight on this channel
+
+    def write_frame(self, frame: bytes) -> None:
+        if not self.closed:
+            self.writer.write(frame)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+
+class TcpTransportService:
+    """Bound TCP endpoint + RPC façade for one node.
+
+    API-compatible with DisruptableTransport: `register(node_id, action,
+    handler)` (node_id must be this node's), and `send(sender, target,
+    action, request, on_response, on_failure)`.
+    """
+
+    def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0,
+                 *, loop: Optional[asyncio.AbstractEventLoop] = None,
+                 keepalive_interval_ms: int = 15_000,
+                 default_timeout_ms: Optional[int] = 30_000):
+        self.node_id = node_id
+        self.host = host
+        self.port = port  # 0 = ephemeral; real port known after bind()
+        self.loop = loop or asyncio.get_event_loop()
+        self.keepalive_interval_ms = keepalive_interval_ms
+        self.default_timeout_ms = default_timeout_ms
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._handlers: Dict[str, Callable] = {}
+        self._request_id = 0
+        # request_id -> (on_response, on_failure, timeout_handle, action)
+        self._pending: Dict[int, Tuple] = {}
+        # peer node_id -> {channel_type: _Channel}
+        self._channels: Dict[str, Dict[str, _Channel]] = {}
+        self._addresses: Dict[str, Tuple[str, int]] = {}
+        self._connecting: Dict[Tuple[str, str], asyncio.Future] = {}
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._pumps: List[asyncio.Task] = []
+        self._inbound: List[_Channel] = []
+        self.stats = {"tx_count": 0, "rx_count": 0, "tx_bytes": 0,
+                      "rx_bytes": 0, "connections_opened": 0}
+        self.closed = False
+
+    # ------------------------------------------------------------- lifecycle
+    async def bind(self) -> Tuple[str, int]:
+        """Bind the server socket (reference `TcpTransport.java:376,648`)."""
+        self._server = await asyncio.start_server(
+            self._accept, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._keepalive_task = self.loop.create_task(self._keepalive_pump())
+        return self.host, self.port
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        for pump in self._pumps:
+            pump.cancel()
+        self._pumps.clear()
+        for chans in list(self._channels.values()):
+            for ch in list(chans.values()):
+                ch.close()
+        self._channels.clear()
+        for ch in self._inbound:
+            ch.close()
+        self._inbound.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for rid in list(self._pending):
+            self._fail_pending(rid, ConnectTransportError("transport closed"))
+
+    def add_peer_address(self, node_id: str, host: str, port: int) -> None:
+        self._addresses[node_id] = (host, port)
+
+    @property
+    def bound_address(self) -> Tuple[str, int]:
+        return self.host, self.port
+
+    # ------------------------------------------------------------- handlers
+    def register(self, node_id: str, action: str, handler: Callable) -> None:
+        """handler(sender, request, respond) — same shape as the simulator's."""
+        if node_id != self.node_id:
+            raise SearchEngineError(
+                f"cannot register handler for foreign node [{node_id}] "
+                f"on transport of [{self.node_id}]")
+        self._handlers[action] = handler
+
+    # ---------------------------------------------------------------- send
+    def send(self, sender: str, target: str, action: str, request: Any,
+             on_response: Optional[Callable[[Any], None]] = None,
+             on_failure: Optional[Callable[[Exception], None]] = None,
+             timeout_ms: Optional[int] = None) -> None:
+        if target == self.node_id:
+            # local optimization: direct dispatch, no serialization
+            # (reference TransportService.java:119-121)
+            self._dispatch_local(sender, action, request, on_response,
+                                 on_failure)
+            return
+        self.loop.create_task(self._send_remote(
+            target, action, request, on_response, on_failure,
+            self.default_timeout_ms if timeout_ms is None else timeout_ms))
+
+    def _dispatch_local(self, sender, action, request, on_response,
+                        on_failure) -> None:
+        handler = self._handlers.get(action)
+        if handler is None:
+            if on_failure:
+                self.loop.call_soon(on_failure, SearchEngineError(
+                    f"no handler for [{action}] on [{self.node_id}]"))
+            return
+
+        def respond(response: Any) -> None:
+            if on_response is not None:
+                self.loop.call_soon(on_response, response)
+
+        def run():
+            try:
+                handler(sender, request, respond)
+            except Exception as e:
+                if on_failure:
+                    on_failure(e)
+
+        self.loop.call_soon(run)
+
+    async def _send_remote(self, target, action, request, on_response,
+                           on_failure, timeout_ms) -> None:
+        try:
+            channel = await self._get_channel(target, channel_type_for(action))
+        except Exception as e:
+            if on_failure:
+                on_failure(ConnectTransportError(
+                    f"[{target}][{action}] connect failed: {e}"))
+            return
+        self._request_id += 1
+        rid = self._request_id
+        timeout_handle = None
+        if timeout_ms is not None:
+            timeout_handle = self.loop.call_later(
+                timeout_ms / 1000.0, self._on_request_timeout, rid, target)
+        self._pending[rid] = (on_response, on_failure, timeout_handle, action)
+        channel.pending_rids.add(rid)
+        frame = encode_frame(rid, STATUS_REQUEST, WIRE_VERSION, action,
+                             {"sender": self.node_id, "request": request})
+        self.stats["tx_count"] += 1
+        self.stats["tx_bytes"] += len(frame)
+        channel.write_frame(frame)
+
+    def _on_request_timeout(self, rid: int, target: str) -> None:
+        self._fail_pending(rid, ConnectTransportError(
+            f"request [{rid}] to [{target}] timed out"))
+
+    def _fail_pending(self, rid: int, error: Exception) -> None:
+        entry = self._pending.pop(rid, None)
+        if entry is None:
+            return
+        _, on_failure, timeout_handle, _ = entry
+        if timeout_handle:
+            timeout_handle.cancel()
+        if on_failure:
+            on_failure(error)
+
+    # --------------------------------------------------------- connections
+    async def _get_channel(self, target: str, channel_type: str) -> _Channel:
+        existing = self._channels.get(target, {}).get(channel_type)
+        if existing is not None and not existing.closed:
+            return existing
+        key = (target, channel_type)
+        fut = self._connecting.get(key)
+        if fut is None:
+            fut = self.loop.create_future()
+            self._connecting[key] = fut
+            try:
+                channel = await self._open_channel(target)
+                self._channels.setdefault(target, {})[channel_type] = channel
+                fut.set_result(channel)
+            except Exception as e:
+                fut.set_exception(e)
+                raise
+            finally:
+                del self._connecting[key]
+            return channel
+        return await asyncio.shield(fut)
+
+    async def _open_channel(self, target: str) -> _Channel:
+        addr = self._addresses.get(target)
+        if addr is None:
+            raise ConnectTransportError(f"no known address for [{target}]")
+        reader, writer = await asyncio.open_connection(*addr)
+        channel = _Channel(reader, writer)
+        self.stats["connections_opened"] += 1
+        self._pumps.append(
+            self.loop.create_task(self._read_pump(channel, outbound_to=target)))
+        # version + identity handshake before any traffic
+        # (reference TcpTransport.java:796 executeHandshake)
+        try:
+            ok = self.loop.create_future()
+            self._request_id += 1
+            rid = self._request_id
+            self._pending[rid] = (
+                lambda resp: ok.set_result(resp) if not ok.done() else None,
+                lambda err: ok.set_exception(err) if not ok.done() else None,
+                self.loop.call_later(10.0, self._on_request_timeout, rid, target),
+                HANDSHAKE_ACTION)
+            channel.pending_rids.add(rid)
+            channel.write_frame(encode_frame(
+                rid, STATUS_REQUEST | STATUS_HANDSHAKE, WIRE_VERSION,
+                HANDSHAKE_ACTION,
+                {"sender": self.node_id, "request": {
+                    "node_id": self.node_id, "version": WIRE_VERSION}}))
+            resp = await ok
+            remote_id = resp.get("node_id")
+            if remote_id != target:
+                raise ConnectTransportError(
+                    f"handshake with {addr} expected node [{target}] "
+                    f"but found [{remote_id}]")
+            return channel
+        except BaseException:
+            # don't leak the socket/read pump on handshake timeout or error
+            channel.close()
+            raise
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        channel = _Channel(reader, writer)
+        self._inbound.append(channel)
+        try:
+            await self._read_pump(channel)
+        finally:
+            if channel in self._inbound:
+                self._inbound.remove(channel)
+
+    async def _read_pump(self, channel: _Channel,
+                         outbound_to: Optional[str] = None) -> None:
+        try:
+            while not channel.closed:
+                data = await channel.reader.read(64 * 1024)
+                if not data:
+                    break
+                self.stats["rx_bytes"] += len(data)
+                channel.buf.extend(data)
+                for (rid, status, version, action,
+                     payload) in decode_frames(channel.buf):
+                    self._on_frame(channel, rid, status, action, payload)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        finally:
+            channel.close()
+            if outbound_to is not None:
+                chans = self._channels.get(outbound_to, {})
+                for ctype, ch in list(chans.items()):
+                    if ch is channel:
+                        del chans[ctype]
+            # fail every request still in flight on this channel
+            # (reference: TcpTransport notifies pending handlers on close)
+            for rid in list(channel.pending_rids):
+                self._fail_pending(rid, ConnectTransportError(
+                    f"channel to [{outbound_to or 'peer'}] closed with "
+                    f"request [{rid}] in flight"))
+            channel.pending_rids.clear()
+
+    # ------------------------------------------------------------- inbound
+    def _on_frame(self, channel: _Channel, rid: int, status: int,
+                  action: Optional[str], payload: Any) -> None:
+        from elasticsearch_tpu.transport.wire import STATUS_PING
+        if status & STATUS_PING:
+            return
+        self.stats["rx_count"] += 1
+        if status & STATUS_REQUEST:
+            self._handle_request(channel, rid, action, payload)
+        else:
+            entry = self._pending.pop(rid, None)
+            channel.pending_rids.discard(rid)
+            if entry is None:
+                return  # late response after timeout
+            on_response, on_failure, timeout_handle, req_action = entry
+            if timeout_handle:
+                timeout_handle.cancel()
+            if status & STATUS_ERROR:
+                if on_failure:
+                    on_failure(RemoteTransportError(
+                        f"[{req_action}] {payload.get('type', 'error')}: "
+                        f"{payload.get('message', '')}"))
+            elif on_response:
+                on_response(payload)
+
+    def _handle_request(self, channel: _Channel, rid: int, action: str,
+                        envelope: Any) -> None:
+        sender = envelope.get("sender", "?")
+        request = envelope.get("request")
+        if action == HANDSHAKE_ACTION:
+            channel.write_frame(encode_frame(
+                rid, STATUS_HANDSHAKE, WIRE_VERSION, None,
+                {"node_id": self.node_id, "version": WIRE_VERSION}))
+            return
+        handler = self._handlers.get(action)
+        if handler is None:
+            channel.write_frame(encode_frame(
+                rid, STATUS_ERROR, WIRE_VERSION, None,
+                {"type": "action_not_found",
+                 "message": f"no handler for [{action}]"}))
+            return
+
+        def respond(response: Any) -> None:
+            frame = encode_frame(rid, 0, WIRE_VERSION, None, response)
+            self.stats["tx_count"] += 1
+            self.stats["tx_bytes"] += len(frame)
+            channel.write_frame(frame)
+
+        try:
+            handler(sender, request, respond)
+        except Exception as e:
+            channel.write_frame(encode_frame(
+                rid, STATUS_ERROR, WIRE_VERSION, None,
+                {"type": type(e).__name__, "message": str(e)}))
+
+    # ----------------------------------------------------------- keepalive
+    async def _keepalive_pump(self) -> None:
+        try:
+            while not self.closed:
+                await asyncio.sleep(self.keepalive_interval_ms / 1000.0)
+                ping = encode_ping()
+                for chans in self._channels.values():
+                    for ch in chans.values():
+                        ch.write_frame(ping)
+        except asyncio.CancelledError:
+            pass

@@ -1,0 +1,101 @@
+"""Framed binary wire protocol for inter-node RPC.
+
+Redesign of the reference's TCP wire format (SURVEY.md §2.2;
+`transport/TcpHeader.java:29-45`, `OutboundMessage`, `InboundDecoder`):
+a 2-byte marker, frame length, 8-byte request id, a status byte whose bits
+distinguish request/response/error/compressed/handshake/ping, and a wire
+version — followed by the action name (requests only) and a
+generic-serialized payload (`common/serialization.py`, the StreamOutput
+analog). Compression is zlib (the reference uses Deflate,
+`transport/CompressibleBytesOutputStream`), applied to the variable section
+only when it crosses a threshold.
+
+Unlike the reference there is no separate variable-header section: request
+headers (task ids, security context) travel inside the payload envelope,
+which keeps the frame layout static-shaped and trivially incremental to
+decode.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Any, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+from elasticsearch_tpu.common.serialization import StreamInput, StreamOutput
+
+MARKER = b"ET"
+HEADER_LEN = 2 + 4 + 8 + 1 + 4  # marker, length, request id, status, version
+
+# status bits (reference: TransportStatus)
+STATUS_REQUEST = 1 << 0      # set = request, clear = response
+STATUS_ERROR = 1 << 1
+STATUS_COMPRESS = 1 << 2
+STATUS_HANDSHAKE = 1 << 3
+STATUS_PING = 1 << 4
+
+WIRE_VERSION = 1
+MIN_COMPATIBLE_VERSION = 1
+COMPRESS_THRESHOLD = 4 * 1024
+
+
+class WireFormatError(SearchEngineError):
+    """Malformed frame: bad marker, truncated header, unknown version."""
+
+
+def encode_frame(request_id: int, status: int, version: int,
+                 action: Optional[str], payload: Any,
+                 compress: bool = True) -> bytes:
+    """Serialize one frame. `action` is required iff STATUS_REQUEST is set."""
+    body = StreamOutput(version)
+    if status & STATUS_REQUEST:
+        body.write_string(action or "")
+    body.write_generic(payload)
+    variable = body.bytes()
+    if compress and len(variable) >= COMPRESS_THRESHOLD:
+        status |= STATUS_COMPRESS
+        variable = zlib.compress(variable)
+    header = MARKER + struct.pack(
+        ">iqBi", len(variable) + HEADER_LEN - 6, request_id, status, version)
+    return header + variable
+
+
+def encode_ping() -> bytes:
+    """Zero-payload keep-alive frame (reference: TransportKeepAlive's -1
+    length ping; here a status bit keeps the decoder uniform)."""
+    return MARKER + struct.pack(">iqBi", HEADER_LEN - 6, 0, STATUS_PING,
+                                WIRE_VERSION)
+
+
+def decode_frames(buf: bytearray):
+    """Incremental decoder: yield (request_id, status, version, action,
+    payload) tuples for every complete frame in `buf`, consuming them.
+    Leaves any trailing partial frame in place."""
+    out = []
+    while True:
+        if len(buf) < 6:
+            break
+        if bytes(buf[:2]) != MARKER:
+            raise WireFormatError(f"invalid frame marker {bytes(buf[:2])!r}")
+        (length,) = struct.unpack(">i", bytes(buf[2:6]))
+        if len(buf) < 6 + length:
+            break
+        frame = bytes(buf[6:6 + length])
+        del buf[:6 + length]
+        request_id, status, version = struct.unpack(">qBi", frame[:13])
+        if status & STATUS_PING:
+            out.append((0, status, version, None, None))
+            continue
+        if version < MIN_COMPATIBLE_VERSION:
+            raise WireFormatError(
+                f"remote wire version [{version}] below minimum compatible "
+                f"[{MIN_COMPATIBLE_VERSION}]")
+        variable = frame[13:]
+        if status & STATUS_COMPRESS:
+            variable = zlib.decompress(variable)
+        stream = StreamInput(variable, version)
+        action = stream.read_string() if status & STATUS_REQUEST else None
+        payload = stream.read_generic()
+        out.append((request_id, status, version, action, payload))
+    return out

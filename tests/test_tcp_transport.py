@@ -1,0 +1,300 @@
+"""TCP transport: wire codec, RPC semantics, and a real multi-node cluster
+over loopback sockets (the production analog of test_multi_node.py, which
+runs the same ClusterNode stack under the deterministic simulator)."""
+
+import asyncio
+
+import pytest
+
+from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+from elasticsearch_tpu.cluster.coordination import bootstrap_state
+from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+from elasticsearch_tpu.transport import (
+    AsyncioScheduler, ConnectTransportError, RemoteTransportError,
+    TcpTransportService, WireFormatError, channel_type_for, decode_frames,
+    encode_frame, encode_ping,
+)
+from elasticsearch_tpu.transport.wire import (
+    STATUS_COMPRESS, STATUS_ERROR, STATUS_REQUEST, WIRE_VERSION,
+)
+
+
+# --------------------------------------------------------------- wire codec
+
+def test_frame_roundtrip_request():
+    payload = {"sender": "n1", "request": {"doc": {"title": "hello", "n": 3},
+                                           "vals": [1.5, None, True, b"\x00\x01"]}}
+    buf = bytearray(encode_frame(42, STATUS_REQUEST, WIRE_VERSION,
+                                 "indices:data/write/primary", payload))
+    frames = decode_frames(buf)
+    assert len(frames) == 1 and not buf
+    rid, status, version, action, decoded = frames[0]
+    assert rid == 42 and status & STATUS_REQUEST
+    assert action == "indices:data/write/primary"
+    assert decoded == payload
+
+
+def test_frame_compression_kicks_in_above_threshold():
+    big = {"sender": "n1", "request": {"blob": "x" * 100_000}}
+    raw = encode_frame(1, STATUS_REQUEST, WIRE_VERSION, "a", big)
+    assert len(raw) < 10_000  # zlib crushed the repeated payload
+    buf = bytearray(raw)
+    (_, status, _, _, decoded), = decode_frames(buf)
+    assert status & STATUS_COMPRESS
+    assert decoded == big
+
+
+def test_frame_incremental_decode_and_ping():
+    f1 = encode_frame(7, STATUS_REQUEST, WIRE_VERSION, "act", {"a": 1})
+    f2 = encode_ping()
+    f3 = encode_frame(8, 0, WIRE_VERSION, None, {"ok": True})
+    stream = f1 + f2 + f3
+    buf = bytearray()
+    seen = []
+    for i in range(0, len(stream), 5):  # drip-feed 5 bytes at a time
+        buf.extend(stream[i:i + 5])
+        seen.extend(decode_frames(buf))
+    assert [s[0] for s in seen] == [7, 0, 8]
+    assert not buf
+
+
+def test_frame_bad_marker_rejected():
+    with pytest.raises(WireFormatError):
+        decode_frames(bytearray(b"XXjunkjunkjunk"))
+
+
+def test_channel_type_routing():
+    assert channel_type_for("internal:index/shard/recovery/start_recovery") == "recovery"
+    assert channel_type_for("indices:data/write/primary") == "bulk"
+    assert channel_type_for("internal:cluster/coordination/publish") == "state"
+    assert channel_type_for("indices:data/read/query") == "reg"
+
+
+# ------------------------------------------------------------ RPC semantics
+
+def run(coro, timeout=30):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+async def make_pair():
+    a = TcpTransportService("a", keepalive_interval_ms=200)
+    b = TcpTransportService("b", keepalive_interval_ms=200)
+    await a.bind()
+    await b.bind()
+    a.add_peer_address("b", *b.bound_address)
+    b.add_peer_address("a", *a.bound_address)
+    return a, b
+
+
+async def wait_for(box, key, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while key not in box:
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"no [{key}] within {timeout}s: {box}")
+        await asyncio.sleep(0.005)
+    return box[key]
+
+
+def test_request_response_over_sockets():
+    async def body():
+        a, b = await make_pair()
+        b.register("b", "echo", lambda sender, req, respond: respond(
+            {"echoed": req, "from": sender}))
+        box = {}
+        a.send("a", "b", "echo", {"msg": "hi", "n": 1},
+               on_response=lambda r: box.update(r=r))
+        r = await wait_for(box, "r")
+        assert r == {"echoed": {"msg": "hi", "n": 1}, "from": "a"}
+        # second request reuses the channel
+        a.send("a", "b", "echo", {"msg": "again"},
+               on_response=lambda r2: box.update(r2=r2))
+        r2 = await wait_for(box, "r2")
+        assert r2["echoed"]["msg"] == "again"
+        assert a.stats["connections_opened"] == 1
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_remote_exception_propagates_as_failure():
+    async def body():
+        a, b = await make_pair()
+        def boom(sender, req, respond):
+            raise ValueError("shard is closed")
+        b.register("b", "boom", boom)
+        box = {}
+        a.send("a", "b", "boom", {}, on_failure=lambda e: box.update(e=e))
+        e = await wait_for(box, "e")
+        assert isinstance(e, RemoteTransportError)
+        assert "shard is closed" in str(e)
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_unknown_action_and_unknown_node():
+    async def body():
+        a, b = await make_pair()
+        box = {}
+        a.send("a", "b", "no/such/action", {},
+               on_failure=lambda e: box.update(e1=e))
+        e1 = await wait_for(box, "e1")
+        assert "no handler" in str(e1)
+        a.send("a", "ghost", "echo", {}, on_failure=lambda e: box.update(e2=e))
+        e2 = await wait_for(box, "e2")
+        assert isinstance(e2, ConnectTransportError)
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_request_timeout_fires():
+    async def body():
+        a, b = await make_pair()
+        b.register("b", "slow", lambda s, r, respond: None)  # never responds
+        box = {}
+        a.send("a", "b", "slow", {}, on_failure=lambda e: box.update(e=e),
+               timeout_ms=100)
+        e = await wait_for(box, "e")
+        assert "timed out" in str(e)
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_local_send_skips_sockets():
+    async def body():
+        a = TcpTransportService("a")
+        await a.bind()
+        a.register("a", "echo", lambda s, r, respond: respond({"ok": True}))
+        box = {}
+        a.send("a", "a", "echo", {}, on_response=lambda r: box.update(r=r))
+        r = await wait_for(box, "r")
+        assert r == {"ok": True}
+        assert a.stats["tx_count"] == 0  # never hit the wire
+        await a.close()
+    run(body())
+
+
+def test_handshake_rejects_wrong_node_identity():
+    async def body():
+        a = TcpTransportService("a")
+        imposter = TcpTransportService("not-b")
+        await a.bind(); await imposter.bind()
+        a.add_peer_address("b", *imposter.bound_address)
+        box = {}
+        a.send("a", "b", "echo", {}, on_failure=lambda e: box.update(e=e))
+        e = await wait_for(box, "e")
+        assert "expected node" in str(e) or "handshake" in str(e).lower()
+        await a.close(); await imposter.close()
+    run(body())
+
+
+def test_channel_close_fails_inflight_requests():
+    """A dropped connection must fail pending requests immediately, not wait
+    for (or never hit) the timeout."""
+    async def body():
+        a, b = await make_pair()
+        b.register("b", "slow", lambda s, r, respond: None)  # never responds
+        box = {}
+        a.send("a", "b", "slow", {}, on_failure=lambda e: box.update(e=e),
+               timeout_ms=None)  # no timeout: only channel death can fail it
+        await asyncio.sleep(0.1)
+        await b.close()  # peer dies with the request in flight
+        e = await wait_for(box, "e")
+        assert isinstance(e, ConnectTransportError)
+        assert "in flight" in str(e)
+        await a.close()
+    run(body())
+
+
+# ----------------------------------------------- full cluster over real TCP
+
+class TcpCluster:
+    def __init__(self, tmp_path, loop, n_nodes=3):
+        self.loop = loop
+        ids = [f"n{i}" for i in range(n_nodes)]
+        self.transports = {}
+        for nid in ids:
+            self.transports[nid] = TcpTransportService(nid, loop=loop)
+        loop.run_until_complete(asyncio.gather(
+            *[t.bind() for t in self.transports.values()]))
+        for nid, t in self.transports.items():
+            for other, ot in self.transports.items():
+                if other != nid:
+                    t.add_peer_address(other, *ot.bound_address)
+        initial = bootstrap_state(ids)
+        self.nodes = {}
+        for i, nid in enumerate(ids):
+            sched = AsyncioScheduler(loop, seed=i)
+            self.nodes[nid] = ClusterNode(
+                nid, str(tmp_path / nid), self.transports[nid], sched,
+                seed_peers=[p for p in ids if p != nid], initial_state=initial)
+        for n in self.nodes.values():
+            n.start()
+
+    def run_until(self, cond, max_s=30.0):
+        deadline = self.loop.time() + max_s
+        while self.loop.time() < deadline:
+            self.loop.run_until_complete(asyncio.sleep(0.02))
+            if cond():
+                return True
+        return cond()
+
+    def master(self):
+        for n in self.nodes.values():
+            if n.is_master and not n.coordinator.stopped:
+                return n
+        return None
+
+    def call(self, fn, *args, **kw):
+        box = {}
+        fn(*args, **kw, on_done=lambda r: box.update(r=r))
+        assert self.run_until(lambda: "r" in box), f"no response from {fn.__name__}"
+        return box["r"]
+
+    def close(self):
+        for n in self.nodes.values():
+            if not n.coordinator.stopped:
+                n.stop()
+        self.loop.run_until_complete(asyncio.gather(
+            *[t.close() for t in self.transports.values()]))
+
+
+def test_full_cluster_over_tcp(tmp_path):
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    try:
+        c = TcpCluster(tmp_path, loop, n_nodes=3)
+        assert c.run_until(lambda: c.master() is not None), "no master over TCP"
+
+        any_node = next(iter(c.nodes.values()))
+        any_node.client_create_index(
+            "docs", settings={"index.number_of_shards": 2,
+                              "index.number_of_replicas": 1},
+            mappings={"properties": {"title": {"type": "text"},
+                                     "n": {"type": "long"}}})
+
+        def all_started():
+            shards = any_node.cluster_state.shards_of("docs")
+            return bool(shards) and all(
+                s.state == ShardRoutingEntry.STARTED for s in shards)
+        assert c.run_until(all_started), "shards did not start over TCP"
+
+        for i in range(12):
+            r = c.call(any_node.client_write, "docs",
+                       {"type": "index", "id": str(i),
+                        "source": {"title": f"doc number {i}", "n": i}})
+            assert r.get("result") in ("created", "updated"), r
+
+        for node in c.nodes.values():
+            node.refresh_all()
+        resp = c.call(any_node.client_search, "docs",
+                      {"query": {"match_all": {}}, "size": 20})
+        assert resp["hits"]["total"]["value"] == 12
+
+        # the data actually crossed sockets: some node sent bytes
+        assert any(t.stats["tx_bytes"] > 0 for t in c.transports.values())
+        c.close()
+    finally:
+        loop.close()
