@@ -32,8 +32,9 @@ class SlowLog:
     def maybe_log(self, settings, index: str, took_s: float,
                   source: Optional[Any] = None) -> Optional[str]:
         level_hit = None
+        ths = self.thresholds(settings)
         for level in LEVELS:   # warn is the highest threshold; first hit wins
-            th = self.thresholds(settings).get(level)
+            th = ths.get(level)
             if th is not None and th >= 0 and took_s >= th:
                 level_hit = level
                 break

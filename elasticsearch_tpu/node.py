@@ -153,10 +153,14 @@ class Node:
             doc_id = _uuid.uuid4().hex[:20]
             op_type = "create"
         shard = svc.route(doc_id, routing)
+        t0 = time.monotonic()
         result = shard.engine.index(
             doc_id, body, op_type=op_type, if_seq_no=if_seq_no,
             if_primary_term=if_primary_term, version=version,
             version_type=version_type)
+        self.counters["index"] += 1
+        self.indexing_slow_log.maybe_log(
+            svc.settings, svc.name, time.monotonic() - t0, source=body)
         self._maybe_refresh(svc, refresh)
         if svc.mapper_service.dirty:
             # persist only on real dynamic-mapping changes, not per document
@@ -173,6 +177,7 @@ class Node:
                 source_includes=None) -> dict:
         svc = self.indices.get(index)
         shard = svc.route(doc_id, routing)
+        self.counters["get"] += 1
         doc = shard.engine.get(doc_id)
         if doc is None:
             return {"_index": svc.name, "_id": doc_id, "found": False}
@@ -187,6 +192,7 @@ class Node:
                    if_primary_term: Optional[int] = None) -> dict:
         svc = self.indices.get(index)
         shard = svc.route(doc_id, routing)
+        self.counters["delete"] += 1
         result = shard.engine.delete(doc_id, if_seq_no=if_seq_no,
                                      if_primary_term=if_primary_term)
         self._maybe_refresh(svc, refresh)
@@ -248,6 +254,7 @@ class Node:
         Reference: `TransportBulkAction` §3.3 — here single-node, grouped by
         shard implicitly by the engine's per-shard lock.
         """
+        self.counters["bulk"] += 1
         items = []
         errors = False
         touched = set()

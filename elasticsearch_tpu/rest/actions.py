@@ -21,6 +21,22 @@ from elasticsearch_tpu.rest.controller import RestController, RestRequest
 from elasticsearch_tpu.version import __version__
 
 
+def _cat_table(req, headers, rows) -> Tuple[int, Any]:
+    """Shared _cat formatter: text columns padded to width, `v` header row,
+    `format=json` list-of-objects (reference `rest/action/cat/RestTable`)."""
+    if req.param("format") == "json":
+        return 200, [dict(zip(headers, r)) for r in rows]
+    verbose = req.bool_param("v")
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+              for i, h in enumerate(headers)]
+    lines = []
+    if verbose:
+        lines.append(" ".join(str(h).ljust(w) for h, w in zip(headers, widths)))
+    for r in rows:
+        lines.append(" ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return 200, "\n".join(lines) + "\n"
+
+
 def register_all(rc: RestController, node: Node) -> None:
     from elasticsearch_tpu.rest.actions_extra import register_extra
     register_extra(rc, node)
@@ -365,19 +381,6 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_nodes/stats", nodes_stats)
 
     # -------------------------------------------------------------------- cat
-    def _cat_table(req, headers, rows) -> Tuple[int, Any]:
-        if req.param("format") == "json":
-            return 200, [dict(zip(headers, r)) for r in rows]
-        verbose = req.bool_param("v")
-        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
-                  for i, h in enumerate(headers)]
-        lines = []
-        if verbose:
-            lines.append(" ".join(str(h).ljust(w) for h, w in zip(headers, widths)))
-        for r in rows:
-            lines.append(" ".join(str(c).ljust(w) for c, w in zip(r, widths)))
-        return 200, "\n".join(lines) + "\n"
-
     def cat_indices(req):
         rows = []
         for name, svc in sorted(node.indices.indices.items()):

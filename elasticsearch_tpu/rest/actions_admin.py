@@ -16,6 +16,7 @@ import uuid
 from typing import Any, List, Tuple
 
 from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.monitor import hot_threads_report
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.rest.controller import RestController
@@ -139,17 +140,28 @@ def register_admin(rc: RestController, node: Node) -> None:
     # -------------------------------------------------------- point in time
     pits = {}
 
+    def _reap_expired_pits() -> None:
+        """Drop PITs past their keep_alive so abandoned readers are freed
+        (reference: SearchService keepalive reaper thread)."""
+        now = time.time()
+        for pid in [p for p, e in pits.items() if e["expires"] <= now]:
+            del pits[pid]
+
     def open_pit(req):
+        _reap_expired_pits()
         index = req.params["index"]
-        keep_alive = req.param("keep_alive", "1m")
+        keep_alive = parse_time_value(req.param("keep_alive", "5m"),
+                                      "keep_alive")
         pit_id = uuid.uuid4().hex
         readers = [(svc, svc.combined_reader())
                    for svc in node.indices.resolve(index)]
         pits[pit_id] = {"index": index, "readers": readers,
-                        "expires": time.time() + 300}
+                        "keep_alive": keep_alive,
+                        "expires": time.time() + keep_alive}
         return 200, {"id": pit_id}
 
     def close_pit(req):
+        _reap_expired_pits()
         body = req.json() or {}
         pit_id = body.get("id")
         found = pits.pop(pit_id, None)
@@ -279,16 +291,7 @@ def register_admin(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_resolve/index/{name}", resolve_index)
 
     # ------------------------------------------------------------- _cat more
-    def _table(req, headers: List[str], rows: List[List[Any]]):
-        if req.param("format") == "json":
-            return 200, [dict(zip(headers, r)) for r in rows]
-        if req.bool_param("v"):
-            rows = [headers] + rows
-        widths = [max((len(str(r[i])) for r in rows), default=0)
-                  for i in range(len(headers))]
-        lines = [" ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
-                 for r in rows]
-        return 200, "\n".join(lines) + "\n"
+    from elasticsearch_tpu.rest.actions import _cat_table as _table
 
     def cat_allocation(req):
         n_shards = sum(s.num_shards for s in node.indices.indices.values())
