@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import time
 import uuid
-from typing import Any, List, Tuple
-
 from elasticsearch_tpu.common.errors import IllegalArgumentError
 from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.monitor import hot_threads_report

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu import native
 from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
 from elasticsearch_tpu.index.mapping import (
     BooleanFieldMapper, DateFieldMapper, DenseVectorFieldMapper, IpFieldMapper,
@@ -141,9 +142,8 @@ def bm25_scores(ctx: SearchContext, field: str, rows: np.ndarray,
     idf = math.log(1.0 + (n - df + 0.5) / (df + 0.5))
     avg_len = ctx.reader.avg_field_length(field) or 1.0
     lengths = _field_lengths_for(ctx, field, rows)
-    f = freqs.astype(np.float32)
-    tf = f / (f + BM25_K1 * (1.0 - BM25_B + BM25_B * lengths / avg_len))
-    return (boost * idf * (BM25_K1 + 1.0) * tf).astype(np.float32)
+    return native.bm25_score(freqs, lengths, idf, avg_len,
+                             BM25_K1, BM25_B, boost)
 
 
 def _index_term_for(mapper, value: Any) -> Optional[str]:
@@ -811,6 +811,13 @@ def _combine_should(sets: List[DocSet], minimum_match: int) -> DocSet:
     sets = [s for s in sets]
     if not sets:
         return DocSet.empty()
+    if minimum_match <= 1:
+        # pure union-sum: fold through the native streaming merge
+        rows, scores = sets[0].rows, sets[0].scores
+        for s in sets[1:]:
+            rows, scores = native.union_sum(rows, scores, s.rows, s.scores)
+        return DocSet(rows, scores if scores is not None
+                      else np.zeros(len(rows), dtype=np.float32))
     rows = np.unique(np.concatenate([s.rows for s in sets]))
     scores = np.zeros(len(rows), dtype=np.float32)
     counts = np.zeros(len(rows), dtype=np.int32)
@@ -860,8 +867,8 @@ class BoolQuery(Query):
             if rows is None:
                 rows, scores = s.rows, s.scores.copy()
             else:
-                rows, i1, i2 = np.intersect1d(rows, s.rows, assume_unique=True,
-                                              return_indices=True)
+                i1, i2 = native.intersect_sorted(rows, s.rows)
+                rows = rows[i1]
                 scores = scores[i1] + s.scores[i2]
 
         for q in self.filter:
@@ -870,8 +877,8 @@ class BoolQuery(Query):
                 rows = s.rows
                 scores = np.zeros(len(rows), dtype=np.float32)
             else:
-                rows, i1, _ = np.intersect1d(rows, s.rows, assume_unique=True,
-                                             return_indices=True)
+                i1, _ = native.intersect_sorted(rows, s.rows)
+                rows = rows[i1]
                 scores = scores[i1]
 
         msm = self.minimum_should_match
@@ -891,8 +898,8 @@ class BoolQuery(Query):
                         hit = should_set.rows[idx] == rows
                         scores[hit] += should_set.scores[idx][hit]
                 else:
-                    rows, i1, i2 = np.intersect1d(rows, should_set.rows,
-                                                  assume_unique=True, return_indices=True)
+                    i1, i2 = native.intersect_sorted(rows, should_set.rows)
+                    rows = rows[i1]
                     scores = scores[i1] + should_set.scores[i2]
 
         if rows is None:

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu import native
 from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
 from elasticsearch_tpu.index.mapping import MapperService, TextFieldMapper
 from elasticsearch_tpu.index.segment import ShardReader
@@ -115,13 +116,31 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
 
     # sorting
     sort_spec = _normalize_sort(body.get("sort"))
-    order, sort_values = _sort_docs(ctx, rows, scores, sort_spec)
-    rows, scores = rows[order], scores[order]
-    if sort_values is not None:
-        sort_values = [sort_values[i] for i in order]
+    search_after = body.get("search_after")
+    frm_ = int(body.get("from", 0) or 0)
+    size_ = int(body.get("size", DEFAULT_SIZE)
+                if body.get("size") is not None else DEFAULT_SIZE)
+    if sort_spec is None and search_after is None:
+        # score ranking: partial top-(from+size) selection via the native
+        # heap (the Lucene TopScoreDocCollector analog) instead of a full
+        # argsort; ties break by row asc, identical to the lexsort below
+        # because candidate rows are already ascending
+        max_score_early = float(scores.max()) if len(scores) else None
+        k = min(frm_ + size_, len(rows))
+        idx = native.topk(scores, k)
+        order = idx
+        sort_values = None
+        rows, scores = rows[order], scores[order]
+        # note: `rows` is now the ranked top window only; total_hits and
+        # aggs were computed from the full sets above
+    else:
+        max_score_early = None
+        order, sort_values = _sort_docs(ctx, rows, scores, sort_spec)
+        rows, scores = rows[order], scores[order]
+        if sort_values is not None:
+            sort_values = [sort_values[i] for i in order]
 
     # search_after
-    search_after = body.get("search_after")
     if search_after is not None:
         if sort_spec is None:
             raise IllegalArgumentError("search_after requires a sort")
@@ -130,8 +149,7 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
         if sort_values is not None:
             sort_values = sort_values[start:]
 
-    frm = int(body.get("from", 0) or 0)
-    size = int(body.get("size", DEFAULT_SIZE) if body.get("size") is not None else DEFAULT_SIZE)
+    frm, size = frm_, size_
     # scroll snapshots page past the window by design (internal flag); normal
     # searches enforce the reference's index.max_result_window guard
     if frm + size > MAX_RESULT_WINDOW and not body.get("__unbounded_window__"):
@@ -147,7 +165,10 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     if aggs_spec:
         aggs = compute_aggs(ctx, agg_rows, aggs_spec)
 
-    max_score = float(scores.max()) if len(scores) and sort_spec is None else None
+    if max_score_early is not None:
+        max_score = max_score_early
+    else:
+        max_score = float(scores.max()) if len(scores) and sort_spec is None else None
     return ShardSearchResult(shard_id, w_rows, w_scores, w_sort, total_hits,
                              relation, aggs, max_score)
 
