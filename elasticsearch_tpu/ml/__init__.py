@@ -1,0 +1,13 @@
+"""Machine learning: anomaly-detection jobs backed by a native C++ sidecar.
+
+Reference: `x-pack/plugin/ml` (56k LoC) + external `elastic/ml-cpp` processes
+spawned by `bootstrap/Spawner.java:42` and driven through named pipes
+(`x-pack/plugin/ml/.../process/NativeController.java:26-37`, `ProcessPipes.java`,
+`AbstractNativeProcess.java`). Here the analytics engine is
+`native/ml_autodetect.cc`, a standalone C++ process speaking length-prefixed
+JSON over stdin/stdout, managed by :mod:`elasticsearch_tpu.ml.process`.
+"""
+
+from elasticsearch_tpu.ml.service import DatafeedService, MlService
+
+__all__ = ["MlService", "DatafeedService"]

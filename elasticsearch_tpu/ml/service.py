@@ -1,0 +1,513 @@
+"""ML job + datafeed services.
+
+Reference: `x-pack/plugin/ml` — `MlConfigIndex`/`JobManager` (job configs in
+an internal index), `AutodetectProcessManager` (one native process per open
+job), `JobResultsPersister` (buckets/records into `.ml-anomalies-shared`),
+`JobResultsProvider` (results queries), `DatafeedManager`/`DatafeedJob`
+(search-driven extraction feeding the process), `JobDataCountsPersister`.
+
+Here configs live in a JSON state file beside the node's other stores,
+results are indexed into `.ml-anomalies-shared` through the normal document
+path (so they're searchable with the full query DSL, like the reference),
+and the analytics engine is the native sidecar in ml/process.py.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError,
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+    ValidationError,
+)
+from elasticsearch_tpu.ml.process import AutodetectProcess
+
+RESULTS_INDEX = ".ml-anomalies-shared"
+
+_ALLOWED_FUNCTIONS = {
+    "count", "low_count", "high_count", "mean", "low_mean", "high_mean",
+    "min", "max", "sum", "low_sum", "high_sum", "metric", "rare",
+    "distinct_count", "low_distinct_count", "high_distinct_count",
+}
+
+
+def _parse_time(value, time_format: Optional[str]) -> Optional[float]:
+    """Record timestamp → epoch seconds. Supports epoch, epoch_ms, ISO8601."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        v = float(value)
+        if time_format == "epoch_ms" or v > 1e11:  # heuristics like the date mapper
+            return v / 1000.0
+        return v
+    s = str(value)
+    try:
+        v = float(s)
+        return v / 1000.0 if (time_format == "epoch_ms" or v > 1e11) else v
+    except ValueError:
+        pass
+    try:
+        if s.endswith("Z"):
+            s = s[:-1] + "+00:00"
+        dt = _dt.datetime.fromisoformat(s)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=_dt.timezone.utc)
+        return dt.timestamp()
+    except ValueError:
+        return None
+
+
+class _OpenJob:
+    def __init__(self, process: AutodetectProcess):
+        self.process = process
+        self.results: List[dict] = []      # drained into the results index
+        self.lock = threading.Lock()
+        self.open_time = time.time()
+
+    def on_result(self, msg: dict) -> None:
+        with self.lock:
+            self.results.append(msg)
+
+    def take_results(self) -> List[dict]:
+        with self.lock:
+            out, self.results = self.results, []
+        return out
+
+
+class MlService:
+    def __init__(self, node):
+        self.node = node
+        self._state_path = os.path.join(node.indices.data_path, "_state",
+                                        "ml_jobs.json")
+        self._model_state_dir = os.path.join(node.indices.data_path, "_state",
+                                             "ml_model_state")
+        self.jobs: Dict[str, dict] = {}
+        self.data_counts: Dict[str, dict] = {}
+        self._open: Dict[str, _OpenJob] = {}
+        self._load()
+
+    # -------------------------------------------------------------- storage
+    def _load(self) -> None:
+        try:
+            with open(self._state_path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            self.jobs = data.get("jobs", {})
+            self.data_counts = data.get("data_counts", {})
+        except (OSError, ValueError):
+            pass
+
+    def _save(self) -> None:
+        os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"jobs": self.jobs, "data_counts": self.data_counts}, f)
+        os.replace(tmp, self._state_path)
+
+    def _model_state_path(self, job_id: str) -> str:
+        return os.path.join(self._model_state_dir, f"{job_id}.json")
+
+    # ------------------------------------------------------------ job CRUD
+    def put_job(self, job_id: str, body: dict) -> dict:
+        if job_id in self.jobs:
+            raise ResourceAlreadyExistsError(
+                f"The job cannot be created with the Id '{job_id}'. "
+                f"The Id is already used.")
+        ac = body.get("analysis_config")
+        if not isinstance(ac, dict) or not ac.get("detectors"):
+            raise ValidationError(
+                "An analysis_config with at least one detector is required")
+        for det in ac["detectors"]:
+            fn = det.get("function", "count")
+            if fn not in _ALLOWED_FUNCTIONS:
+                raise ValidationError(f"Unknown function '{fn}'")
+            if fn not in ("count", "low_count", "high_count", "rare") \
+                    and not fn.endswith("distinct_count") \
+                    and not det.get("field_name"):
+                raise ValidationError(
+                    f"Unless the function is 'count' one of field_name, "
+                    f"by_field_name or over_field_name must be set: [{fn}]")
+            if (fn == "rare" or fn.endswith("distinct_count")) \
+                    and not det.get("by_field_name"):
+                raise ValidationError(f"by_field_name must be set when the "
+                                      f"'{fn}' function is used")
+        job = dict(body)
+        job["job_id"] = job_id
+        job.setdefault("data_description", {"time_field": "time"})
+        job["create_time"] = int(time.time() * 1000)
+        job["job_type"] = "anomaly_detector"
+        job["state"] = "closed"
+        self.jobs[job_id] = job
+        self.data_counts[job_id] = {
+            "job_id": job_id, "processed_record_count": 0,
+            "invalid_date_count": 0, "out_of_order_timestamp_count": 0,
+            "earliest_record_timestamp": None, "latest_record_timestamp": None,
+        }
+        self._save()
+        return job
+
+    def get_jobs(self, job_id: Optional[str] = None) -> dict:
+        if job_id and job_id not in ("_all", "*"):
+            if job_id not in self.jobs:
+                raise ResourceNotFoundError(
+                    f"No known job with id '{job_id}'")
+            jobs = [self.jobs[job_id]]
+        else:
+            jobs = [self.jobs[k] for k in sorted(self.jobs)]
+        return {"count": len(jobs), "jobs": jobs}
+
+    def delete_job(self, job_id: str, force: bool = False) -> None:
+        if job_id not in self.jobs:
+            raise ResourceNotFoundError(f"No known job with id '{job_id}'")
+        if job_id in self._open:
+            if not force:
+                raise IllegalArgumentError(
+                    f"Cannot delete job [{job_id}] because the job is opened")
+            self._open.pop(job_id).process.kill()
+        del self.jobs[job_id]
+        self.data_counts.pop(job_id, None)
+        try:
+            os.remove(self._model_state_path(job_id))
+        except OSError:
+            pass
+        self._save()
+
+    # ------------------------------------------------------- open/close/data
+    def open_job(self, job_id: str) -> dict:
+        job = self._require(job_id)
+        if job_id in self._open:
+            return {"opened": True, "node": self.node.node_id}
+        state = None
+        try:
+            with open(self._model_state_path(job_id), "r", encoding="utf-8") as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            pass
+        open_job: _OpenJob = None  # type: ignore[assignment]
+
+        def handler(msg: dict) -> None:
+            open_job.on_result(msg)
+
+        open_job = _OpenJob(AutodetectProcess(job, handler, state=state))
+        self._open[job_id] = open_job
+        job["state"] = "opened"
+        return {"opened": True, "node": self.node.node_id}
+
+    def close_job(self, job_id: str, force: bool = False) -> dict:
+        job = self._require(job_id)
+        open_job = self._open.get(job_id)
+        if open_job is None:
+            return {"closed": True}
+        if force:
+            open_job.process.kill()
+        else:
+            try:
+                state = open_job.process.persist_state()
+                os.makedirs(self._model_state_dir, exist_ok=True)
+                with open(self._model_state_path(job_id), "w",
+                          encoding="utf-8") as f:
+                    json.dump(state, f)
+                open_job.process.close()
+            except Exception:
+                # dead/hung process: don't leave the job wedged in _open
+                open_job.process.kill()
+        del self._open[job_id]
+        self._persist_results(job_id, open_job.take_results())
+        job["state"] = "closed"
+        self._save()
+        return {"closed": True}
+
+    def post_data(self, job_id: str, records: List[dict]) -> dict:
+        self._require(job_id)
+        open_job = self._open.get(job_id)
+        if open_job is None:
+            raise IllegalArgumentError(
+                f"Cannot post data to job [{job_id}] because the job is "
+                f"not open")
+        dd = self.jobs[job_id].get("data_description", {}) or {}
+        time_field = dd.get("time_field", "time")
+        time_format = dd.get("time_format")
+        counts = self.data_counts[job_id]
+        for rec in records:
+            t = _parse_time(rec.get(time_field), time_format)
+            if t is None:
+                counts["invalid_date_count"] += 1
+                continue
+            latest = counts["latest_record_timestamp"]
+            if latest is not None and t * 1000 < latest:
+                counts["out_of_order_timestamp_count"] += 1
+                continue
+            open_job.process.write_record(t, rec)
+            counts["processed_record_count"] += 1
+            ms = int(t * 1000)
+            if counts["earliest_record_timestamp"] is None \
+                    or ms < counts["earliest_record_timestamp"]:
+                counts["earliest_record_timestamp"] = ms
+            if latest is None or ms > latest:
+                counts["latest_record_timestamp"] = ms
+        self._save()
+        return dict(counts)
+
+    def flush_job(self, job_id: str, calc_interim: bool = False) -> dict:
+        self._require(job_id)
+        open_job = self._open.get(job_id)
+        if open_job is None:
+            raise IllegalArgumentError(
+                f"Cannot flush because job [{job_id}] is not open")
+        ack = open_job.process.flush()
+        self._persist_results(job_id, open_job.take_results())
+        return {"flushed": True,
+                "last_finalized_bucket_end":
+                    int(ack.get("last_finalized_bucket_end", 0))}
+
+    def job_stats(self, job_id: Optional[str] = None) -> dict:
+        out = []
+        resp = self.get_jobs(job_id)
+        for job in resp["jobs"]:
+            jid = job["job_id"]
+            out.append({
+                "job_id": jid,
+                "state": "opened" if jid in self._open else "closed",
+                "data_counts": dict(self.data_counts.get(jid, {})),
+                "model_size_stats": {"job_id": jid, "result_type":
+                                     "model_size_stats"},
+                "node": {"id": self.node.node_id} if jid in self._open else None,
+            })
+        return {"count": len(out), "jobs": out}
+
+    # -------------------------------------------------------------- results
+    def _ensure_results_index(self) -> None:
+        """Reference: the ML results index template (keyword identity fields
+        so term filters on hyphenated job ids match exactly)."""
+        if self.node.indices.exists(RESULTS_INDEX):
+            return
+        self.node.create_index_with_templates(RESULTS_INDEX, mappings={
+            "properties": {
+                "job_id": {"type": "keyword"},
+                "result_type": {"type": "keyword"},
+                "function": {"type": "keyword"},
+                "field_name": {"type": "keyword"},
+                "partition_field_name": {"type": "keyword"},
+                "partition_field_value": {"type": "keyword"},
+                "by_field_name": {"type": "keyword"},
+                "by_field_value": {"type": "keyword"},
+                "timestamp": {"type": "date"},
+                "anomaly_score": {"type": "double"},
+                "record_score": {"type": "double"},
+                "probability": {"type": "double"},
+            }})
+
+    def _persist_results(self, job_id: str, results: List[dict]) -> None:
+        if not results:
+            return
+        self._ensure_results_index()
+        for msg in results:
+            doc = {k: v for k, v in msg.items() if k != "type"}
+            self.node.index_doc(RESULTS_INDEX, None, doc)
+        self.node.indices.get(RESULTS_INDEX).refresh()
+
+    def get_buckets(self, job_id: str, body: Optional[dict] = None) -> dict:
+        return self._results(job_id, "bucket", body or {},
+                             "anomaly_score", "buckets")
+
+    def get_records(self, job_id: str, body: Optional[dict] = None) -> dict:
+        return self._results(job_id, "record", body or {},
+                             "record_score", "records")
+
+    def get_overall_buckets(self, job_id: str, body: Optional[dict] = None) -> dict:
+        res = self._results(job_id, "bucket", body or {}, "anomaly_score",
+                            "buckets")
+        buckets = [{"timestamp": b["timestamp"], "bucket_span": b["bucket_span"],
+                    "overall_score": b["anomaly_score"],
+                    "jobs": [{"job_id": job_id,
+                              "max_anomaly_score": b["anomaly_score"]}],
+                    "is_interim": False, "result_type": "overall_bucket"}
+                   for b in res["buckets"]]
+        return {"count": len(buckets), "overall_buckets": buckets}
+
+    def _results(self, job_id: str, result_type: str, body: dict,
+                 score_field: str, key: str) -> dict:
+        self._require(job_id)
+        # drain anything pending so results are live without an explicit flush
+        open_job = self._open.get(job_id)
+        if open_job is not None:
+            self._persist_results(job_id, open_job.take_results())
+        must = [{"term": {"job_id": job_id}},
+                {"term": {"result_type": result_type}}]
+        threshold = body.get("anomaly_score" if result_type == "bucket"
+                             else "record_score")
+        if threshold is not None:
+            must.append({"range": {score_field: {"gte": float(threshold)}}})
+        if body.get("start") is not None:
+            must.append({"range": {"timestamp": {"gte": body["start"]}}})
+        if body.get("end") is not None:
+            must.append({"range": {"timestamp": {"lt": body["end"]}}})
+        desc = bool(body.get("desc", False))
+        sort_field = body.get("sort", "timestamp")
+        try:
+            resp = self.node.search(RESULTS_INDEX, {
+                "query": {"bool": {"filter": must}},
+                "size": int(body.get("size", body.get("page", {})
+                                     .get("size", 100) if isinstance(
+                                         body.get("page"), dict) else 100)),
+                "from": int(body.get("from", 0)),
+                "sort": [{sort_field: {"order": "desc" if desc else "asc"}}],
+            })
+        except ResourceNotFoundError:
+            return {"count": 0, key: []}
+        hits = [h["_source"] for h in resp["hits"]["hits"]]
+        return {"count": resp["hits"]["total"]["value"], key: hits}
+
+    def _require(self, job_id: str) -> dict:
+        if job_id not in self.jobs:
+            raise ResourceNotFoundError(f"No known job with id '{job_id}'")
+        return self.jobs[job_id]
+
+    def usage(self) -> dict:
+        from elasticsearch_tpu.ml.process import autodetect_binary
+        return {"available": True, "enabled": True,
+                "jobs": {"count": len(self.jobs), "opened": len(self._open)},
+                "datafeeds": {"count": len(self.node.datafeeds.datafeeds)},
+                "native": autodetect_binary() is not None}
+
+    def close_all(self) -> None:
+        for job_id in list(self._open):
+            try:
+                self.close_job(job_id)
+            except Exception:
+                self._open.pop(job_id, None)
+
+
+class DatafeedService:
+    """Search-driven extraction feeding an anomaly job.
+
+    Reference: `x-pack/plugin/ml/.../datafeed/DatafeedManager.java`,
+    `DatafeedJob.java` — pages over the source indices ordered by time and
+    posts to the job, flushing at the end of each search window.
+    """
+
+    def __init__(self, node):
+        self.node = node
+        self.datafeeds: Dict[str, dict] = {}
+        self.states: Dict[str, str] = {}
+
+    def put(self, datafeed_id: str, body: dict) -> dict:
+        if datafeed_id in self.datafeeds:
+            raise ResourceAlreadyExistsError(
+                f"A datafeed with id [{datafeed_id}] already exists")
+        job_id = body.get("job_id")
+        if not job_id or job_id not in self.node.ml.jobs:
+            raise ResourceNotFoundError(
+                f"No known job with id '{job_id}'")
+        if not body.get("indices"):
+            raise ValidationError("A datafeed must specify indices")
+        df = dict(body)
+        df["datafeed_id"] = datafeed_id
+        self.datafeeds[datafeed_id] = df
+        self.states[datafeed_id] = "stopped"
+        return df
+
+    def get(self, datafeed_id: Optional[str] = None) -> dict:
+        if datafeed_id and datafeed_id not in ("_all", "*"):
+            if datafeed_id not in self.datafeeds:
+                raise ResourceNotFoundError(
+                    f"No datafeed with id [{datafeed_id}] exists")
+            feeds = [self.datafeeds[datafeed_id]]
+        else:
+            feeds = [self.datafeeds[k] for k in sorted(self.datafeeds)]
+        return {"count": len(feeds), "datafeeds": feeds}
+
+    def delete(self, datafeed_id: str) -> None:
+        if datafeed_id not in self.datafeeds:
+            raise ResourceNotFoundError(
+                f"No datafeed with id [{datafeed_id}] exists")
+        del self.datafeeds[datafeed_id]
+        self.states.pop(datafeed_id, None)
+
+    def preview(self, datafeed_id: str, size: int = 10) -> List[dict]:
+        df = self._require(datafeed_id)
+        resp = self._search(df, size=size)
+        return [h["_source"] for h in resp["hits"]["hits"]]
+
+    def start(self, datafeed_id: str, start=None, end=None) -> dict:
+        """Run the extraction synchronously over [start, end) and stop.
+
+        The reference runs datafeeds as persistent tasks on a real-time
+        schedule; batch (bounded) datafeeds run to `end` and auto-stop,
+        which is the mode implemented here.
+        """
+        df = self._require(datafeed_id)
+        job_id = df["job_id"]
+        if job_id not in self.node.ml._open:
+            raise IllegalArgumentError(
+                f"cannot start datafeed [{datafeed_id}] because job "
+                f"[{job_id}] is not open")
+        self.states[datafeed_id] = "started"
+        job = self.node.ml.jobs[job_id]
+        time_field = (job.get("data_description") or {}).get("time_field",
+                                                             "time")
+        search_after = None
+        total = 0
+        try:
+            while True:
+                resp = self._search(df, size=1000, time_field=time_field,
+                                    start=start, end=end,
+                                    search_after=search_after)
+                hits = resp["hits"]["hits"]
+                if not hits:
+                    break
+                self.node.ml.post_data(job_id,
+                                       [h["_source"] for h in hits])
+                total += len(hits)
+                search_after = hits[-1]["sort"]
+            self.node.ml.flush_job(job_id)
+        finally:
+            self.states[datafeed_id] = "stopped"
+        return {"started": True, "processed": total}
+
+    def stop(self, datafeed_id: str) -> dict:
+        self._require(datafeed_id)
+        self.states[datafeed_id] = "stopped"
+        return {"stopped": True}
+
+    def stats(self, datafeed_id: Optional[str] = None) -> dict:
+        resp = self.get(datafeed_id)
+        return {"count": resp["count"],
+                "datafeeds": [{"datafeed_id": d["datafeed_id"],
+                               "state": self.states.get(d["datafeed_id"],
+                                                        "stopped")}
+                              for d in resp["datafeeds"]]}
+
+    def _search(self, df: dict, size: int, time_field: str = "time",
+                start=None, end=None, search_after=None) -> dict:
+        query = df.get("query", {"match_all": {}})
+        if start is not None or end is not None:
+            rng = {}
+            if start is not None:
+                rng["gte"] = start
+            if end is not None:
+                rng["lt"] = end
+            query = {"bool": {"filter": [query,
+                                         {"range": {time_field: rng}}]}}
+        # _doc tiebreak: without it, search_after drops the remainder of a
+        # run of documents sharing one timestamp at a page boundary
+        body = {"query": query, "size": size,
+                "sort": [{time_field: {"order": "asc"}},
+                         {"_doc": {"order": "asc"}}]}
+        if search_after is not None:
+            body["search_after"] = search_after
+        index_expr = ",".join(df["indices"]) if isinstance(df["indices"], list) \
+            else df["indices"]
+        return self.node.search(index_expr, body)
+
+    def _require(self, datafeed_id: str) -> dict:
+        if datafeed_id not in self.datafeeds:
+            raise ResourceNotFoundError(
+                f"No datafeed with id [{datafeed_id}] exists")
+        return self.datafeeds[datafeed_id]

@@ -39,7 +39,7 @@ def _try_build() -> bool:
             and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src)):
         return True
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+        subprocess.run(["make", "-C", _NATIVE_DIR, "libes_native.so"], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_SO_PATH)
     except Exception:

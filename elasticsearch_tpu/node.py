@@ -129,6 +129,9 @@ class Node:
                 self.settings.get("bootstrap.password", "changeme")))
         from elasticsearch_tpu.snapshots.service import SnapshotService
         self.snapshots = SnapshotService(self)
+        from elasticsearch_tpu.ml import DatafeedService, MlService
+        self.ml = MlService(self)
+        self.datafeeds = DatafeedService(self)
         self.start_time = time.time()
 
     # ------------------------------------------------------------- documents
@@ -577,6 +580,7 @@ class Node:
                 "indices": {svc.name: {"primaries": {"docs": {"count": docs}}}}}
 
     def close(self):
+        self.ml.close_all()
         self.indices.close()
 
 

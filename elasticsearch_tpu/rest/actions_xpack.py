@@ -327,6 +327,114 @@ def register_xpack(rc: RestController, node: Node) -> None:
     rc.register("PUT", "/{index}/_settings", put_settings)
     rc.register("PUT", "/_settings", put_settings)
 
+    _register_ml(rc, node)
+
+
+def _register_ml(rc: RestController, node: Node) -> None:
+    """REST surface of `x-pack/plugin/ml/.../rest/` (job/, datafeeds/,
+    results/ subpackages)."""
+
+    # ----------------------------------------------------- anomaly detectors
+    def ml_put_job(req):
+        return 200, node.ml.put_job(req.params["job_id"], req.json() or {})
+
+    def ml_get_jobs(req):
+        return 200, node.ml.get_jobs(req.params.get("job_id"))
+
+    def ml_delete_job(req):
+        node.ml.delete_job(req.params["job_id"],
+                           force=req.bool_param("force"))
+        return 200, {"acknowledged": True}
+
+    def ml_open(req):
+        return 200, node.ml.open_job(req.params["job_id"])
+
+    def ml_close(req):
+        return 200, node.ml.close_job(req.params["job_id"],
+                                      force=req.bool_param("force"))
+
+    def ml_post_data(req):
+        try:
+            body = req.json()
+        except Exception:
+            body = None
+        records = body if isinstance(body, list) else req.ndjson()
+        return 202, node.ml.post_data(req.params["job_id"], records)
+
+    def ml_flush(req):
+        return 200, node.ml.flush_job(
+            req.params["job_id"], calc_interim=req.bool_param("calc_interim"))
+
+    def ml_job_stats(req):
+        return 200, node.ml.job_stats(req.params.get("job_id"))
+
+    def ml_buckets(req):
+        return 200, node.ml.get_buckets(req.params["job_id"], req.json() or {})
+
+    def ml_records(req):
+        return 200, node.ml.get_records(req.params["job_id"], req.json() or {})
+
+    def ml_overall(req):
+        return 200, node.ml.get_overall_buckets(req.params["job_id"],
+                                                req.json() or {})
+
+    base = "/_ml/anomaly_detectors"
+    rc.register("PUT", base + "/{job_id}", ml_put_job)
+    rc.register("GET", base, ml_get_jobs)
+    rc.register("GET", base + "/{job_id}", ml_get_jobs)
+    rc.register("DELETE", base + "/{job_id}", ml_delete_job)
+    rc.register("POST", base + "/{job_id}/_open", ml_open)
+    rc.register("POST", base + "/{job_id}/_close", ml_close)
+    rc.register("POST", base + "/{job_id}/_data", ml_post_data)
+    rc.register("POST", base + "/{job_id}/_flush", ml_flush)
+    rc.register("GET", base + "/_stats", ml_job_stats)
+    rc.register("GET", base + "/{job_id}/_stats", ml_job_stats)
+    for method in ("GET", "POST"):
+        rc.register(method, base + "/{job_id}/results/buckets", ml_buckets)
+        rc.register(method, base + "/{job_id}/results/records", ml_records)
+        rc.register(method, base + "/{job_id}/results/overall_buckets",
+                    ml_overall)
+
+    # -------------------------------------------------------------- datafeeds
+    def df_put(req):
+        return 200, node.datafeeds.put(req.params["datafeed_id"],
+                                       req.json() or {})
+
+    def df_get(req):
+        return 200, node.datafeeds.get(req.params.get("datafeed_id"))
+
+    def df_delete(req):
+        node.datafeeds.delete(req.params["datafeed_id"])
+        return 200, {"acknowledged": True}
+
+    def df_start(req):
+        body = req.json() or {}
+        return 200, node.datafeeds.start(
+            req.params["datafeed_id"],
+            start=body.get("start", req.param("start")),
+            end=body.get("end", req.param("end")))
+
+    def df_stop(req):
+        return 200, node.datafeeds.stop(req.params["datafeed_id"])
+
+    def df_stats(req):
+        return 200, node.datafeeds.stats(req.params.get("datafeed_id"))
+
+    def df_preview(req):
+        return 200, node.datafeeds.preview(req.params["datafeed_id"])
+
+    base = "/_ml/datafeeds"
+    rc.register("PUT", base + "/{datafeed_id}", df_put)
+    rc.register("GET", base, df_get)
+    rc.register("GET", base + "/{datafeed_id}", df_get)
+    rc.register("DELETE", base + "/{datafeed_id}", df_delete)
+    rc.register("POST", base + "/{datafeed_id}/_start", df_start)
+    rc.register("POST", base + "/{datafeed_id}/_stop", df_stop)
+    rc.register("GET", base + "/_stats", df_stats)
+    rc.register("GET", base + "/{datafeed_id}/_stats", df_stats)
+    rc.register("GET", base + "/{datafeed_id}/_preview", df_preview)
+    rc.register("POST", base + "/{datafeed_id}/_preview", df_preview)
+
 
 def _flatten_settings(obj: dict, prefix: str = "") -> dict:
     out = {}

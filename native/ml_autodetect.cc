@@ -1,0 +1,704 @@
+// ml_autodetect — native anomaly-detection sidecar process.
+//
+// TPU-native re-design of the reference's ML C++ processes (external repo
+// elastic/ml-cpp, spawned by bootstrap/Spawner.java:42 and managed via
+// x-pack/plugin/ml/.../process/NativeController.java + ProcessPipes.java,
+// results parsed from JSON in IndexingStateProcessor.java).  Same role:
+// a per-job native process that receives a stream of time-ordered records
+// and emits bucketed anomaly results — but the protocol here is a simple
+// length-prefixed JSON framing over stdin/stdout (SURVEY.md §2.9: "a C++
+// sidecar speaking length-prefixed JSON over pipes/UDS").
+//
+// Frame format (both directions): 4-byte big-endian payload length + UTF-8
+// JSON payload.
+//
+// Inbound frame types:
+//   {"type":"config", "job": {...job config...}, "state": {...optional...}}
+//   {"type":"record", "time": <epoch seconds>, "fields": {name: value, ...}}
+//   {"type":"flush", "id": "<flush id>"}           — close current bucket, ack
+//   {"type":"persist"}                             — emit model state frame
+//   {"type":"quit"}                                — finalize + exit
+//
+// Outbound frame types:
+//   {"type":"bucket", ...}   {"type":"record", ...}   {"type":"flush_ack", ...}
+//   {"type":"state", "state": {...}}   {"type":"error", "message": "..."}
+//
+// Analysis semantics (re-designed, not ported): each detector keeps an
+// online Gaussian baseline (Welford mean/M2) over per-bucket values, split
+// by the detector's partition/by field values.  On bucket close the actual
+// value's two-sided (or one-sided for low_/high_ variants) normal tail
+// probability becomes record_score = min(100, -10*log10(p)).  `rare`
+// detectors model the categorical frequency of the by_field instead.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON value + parser + writer (no external deps).
+// ---------------------------------------------------------------------------
+
+struct JValue;
+using JObject = std::map<std::string, JValue>;
+using JArray = std::vector<JValue>;
+
+struct JValue {
+  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::shared_ptr<JArray> arr;
+  std::shared_ptr<JObject> obj;
+
+  JValue() = default;
+  static JValue of(double d) { JValue v; v.kind = NUM; v.num = d; return v; }
+  static JValue of(bool x) { JValue v; v.kind = BOOL; v.b = x; return v; }
+  static JValue of(const std::string& s) { JValue v; v.kind = STR; v.str = s; return v; }
+  static JValue of(const char* s) { return of(std::string(s)); }
+  static JValue object() { JValue v; v.kind = OBJ; v.obj = std::make_shared<JObject>(); return v; }
+  static JValue array() { JValue v; v.kind = ARR; v.arr = std::make_shared<JArray>(); return v; }
+
+  bool is_num() const { return kind == NUM; }
+  bool is_str() const { return kind == STR; }
+  bool is_obj() const { return kind == OBJ; }
+  const JValue* get(const std::string& k) const {
+    if (kind != OBJ) return nullptr;
+    auto it = obj->find(k);
+    return it == obj->end() ? nullptr : &it->second;
+  }
+  double num_or(double d) const { return kind == NUM ? num : d; }
+  std::string str_or(const std::string& d) const { return kind == STR ? str : d; }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p; }
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if (size_t(end - p) < n || strncmp(p, s, n) != 0) { ok = false; return false; }
+    p += n;
+    return true;
+  }
+
+  JValue parse() { ws(); JValue v = value(); ws(); return v; }
+
+  JValue value() {
+    ws();
+    if (p >= end) { ok = false; return JValue(); }
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { JValue v; v.kind = JValue::STR; v.str = string(); return v; }
+      case 't': lit("true"); return JValue::of(true);
+      case 'f': lit("false"); return JValue::of(false);
+      case 'n': lit("null"); return JValue();
+      default: return number();
+    }
+  }
+
+  JValue object() {
+    JValue v = JValue::object();
+    ++p;  // {
+    ws();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (ok && p < end) {
+      ws();
+      if (*p != '"') { ok = false; break; }
+      std::string key = string();
+      ws();
+      if (p >= end || *p != ':') { ok = false; break; }
+      ++p;
+      (*v.obj)[key] = value();
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; break; }
+      ok = false;
+      break;
+    }
+    return v;
+  }
+
+  JValue array() {
+    JValue v = JValue::array();
+    ++p;  // [
+    ws();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (ok && p < end) {
+      v.arr->push_back(value());
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; break; }
+      ok = false;
+      break;
+    }
+    return v;
+  }
+
+  std::string string() {
+    std::string out;
+    ++p;  // opening quote
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            if (end - p >= 5) {
+              unsigned cp = 0;
+              sscanf(p + 1, "%4x", &cp);
+              p += 4;
+              // encode UTF-8 (BMP only; surrogate pairs pass through raw)
+              if (cp < 0x80) out += char(cp);
+              else if (cp < 0x800) {
+                out += char(0xC0 | (cp >> 6));
+                out += char(0x80 | (cp & 0x3F));
+              } else {
+                out += char(0xE0 | (cp >> 12));
+                out += char(0x80 | ((cp >> 6) & 0x3F));
+                out += char(0x80 | (cp & 0x3F));
+              }
+            }
+            break;
+          }
+          default: out += *p;
+        }
+      } else {
+        out += *p;
+      }
+      ++p;
+    }
+    if (p < end) ++p;  // closing quote
+    else ok = false;
+    return out;
+  }
+
+  JValue number() {
+    char* np = nullptr;
+    double d = strtod(p, &np);
+    if (np == p) { ok = false; return JValue(); }
+    p = np;
+    return JValue::of(d);
+  }
+};
+
+static void write_json(const JValue& v, std::string& out) {
+  char buf[32];
+  switch (v.kind) {
+    case JValue::NUL: out += "null"; break;
+    case JValue::BOOL: out += v.b ? "true" : "false"; break;
+    case JValue::NUM: {
+      if (std::isfinite(v.num) && v.num == (int64_t)v.num &&
+          std::fabs(v.num) < 9e15) {
+        snprintf(buf, sizeof buf, "%lld", (long long)v.num);
+      } else if (std::isfinite(v.num)) {
+        snprintf(buf, sizeof buf, "%.12g", v.num);
+      } else {
+        snprintf(buf, sizeof buf, "null");
+      }
+      out += buf;
+      break;
+    }
+    case JValue::STR: {
+      out += '"';
+      for (char c : v.str) {
+        switch (c) {
+          case '"': out += "\\\""; break;
+          case '\\': out += "\\\\"; break;
+          case '\n': out += "\\n"; break;
+          case '\t': out += "\\t"; break;
+          case '\r': out += "\\r"; break;
+          default:
+            if ((unsigned char)c < 0x20) {
+              snprintf(buf, sizeof buf, "\\u%04x", c);
+              out += buf;
+            } else {
+              out += c;
+            }
+        }
+      }
+      out += '"';
+      break;
+    }
+    case JValue::ARR: {
+      out += '[';
+      bool first = true;
+      for (const auto& e : *v.arr) {
+        if (!first) out += ',';
+        first = false;
+        write_json(e, out);
+      }
+      out += ']';
+      break;
+    }
+    case JValue::OBJ: {
+      out += '{';
+      bool first = true;
+      for (const auto& kv : *v.obj) {
+        if (!first) out += ',';
+        first = false;
+        write_json(JValue::of(kv.first), out);
+        out += ':';
+        write_json(kv.second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Framing
+// ---------------------------------------------------------------------------
+
+static bool read_frame(std::string& payload) {
+  unsigned char hdr[4];
+  if (fread(hdr, 1, 4, stdin) != 4) return false;
+  uint32_t len = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+                 (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+  if (len > (64u << 20)) return false;  // 64 MB sanity cap
+  payload.resize(len);
+  return len == 0 || fread(&payload[0], 1, len, stdin) == len;
+}
+
+static void write_frame(const JValue& v) {
+  std::string payload;
+  write_json(v, payload);
+  unsigned char hdr[4] = {
+      (unsigned char)(payload.size() >> 24), (unsigned char)(payload.size() >> 16),
+      (unsigned char)(payload.size() >> 8), (unsigned char)payload.size()};
+  fwrite(hdr, 1, 4, stdout);
+  fwrite(payload.data(), 1, payload.size(), stdout);
+  fflush(stdout);
+}
+
+// ---------------------------------------------------------------------------
+// Detector models
+// ---------------------------------------------------------------------------
+
+// Online Gaussian baseline over per-bucket metric values (Welford).
+struct MetricModel {
+  double n = 0, mean = 0, m2 = 0;
+
+  void add(double x) {
+    n += 1;
+    double d = x - mean;
+    mean += d / n;
+    m2 += d * (x - mean);
+  }
+  double variance() const { return n > 1 ? m2 / (n - 1) : 0; }
+  // Two-sided normal tail probability of seeing a value this far from mean.
+  double probability(double x, int side) const {
+    if (n < 3) return 1.0;  // not enough history to call anything anomalous
+    double sd = std::sqrt(variance());
+    if (sd < 1e-9) sd = std::fabs(mean) * 0.01 + 1e-9;
+    double z = (x - mean) / sd;
+    if (side < 0 && z > 0) return 1.0;   // low_* detector: high values normal
+    if (side > 0 && z < 0) return 1.0;   // high_* detector
+    double p = std::erfc(std::fabs(z) / std::sqrt(2.0));
+    return side == 0 ? p : p / 2;
+  }
+};
+
+// Categorical frequency model for `rare`: how unusual is this by-value?
+struct RareModel {
+  std::map<std::string, double> counts;
+  double total = 0;
+
+  void add(const std::string& v, double c) { counts[v] += c; total += c; }
+  double probability(const std::string& v) const {
+    if (total < 10) return 1.0;
+    auto it = counts.find(v);
+    double c = it == counts.end() ? 0 : it->second;
+    return (c + 1) / (total + 1);
+  }
+};
+
+struct Detector {
+  std::string function;     // count/low_count/high_count/mean/min/max/sum/metric/rare/distinct_count
+  std::string field_name;
+  std::string by_field;
+  std::string partition_field;
+  int side = 0;  // -1 low_*, +1 high_*, 0 two-sided
+
+  // entity key ("partition\x1eby") -> model
+  std::map<std::string, MetricModel> models;
+  std::map<std::string, RareModel> rare_models;
+};
+
+// Per-bucket accumulator for one (detector, entity).
+struct BucketAgg {
+  double count = 0, sum = 0;
+  double min = 1e300, max = -1e300;
+  std::map<std::string, double> by_counts;  // for rare/distinct_count
+};
+
+struct Autodetect {
+  std::string job_id;
+  double bucket_span = 300;
+  std::string time_field = "time";
+  std::vector<Detector> detectors;
+
+  double bucket_start = -1;          // current open bucket start, -1 = none
+  double latest_time = -1;
+  // (detector idx, entity key) -> accumulator
+  std::map<std::pair<int, std::string>, BucketAgg> accum;
+
+  void configure(const JValue& job) {
+    if (const JValue* id = job.get("job_id")) job_id = id->str_or(job_id);
+    if (const JValue* dd = job.get("data_description")) {
+      if (const JValue* tf = dd->get("time_field")) time_field = tf->str_or(time_field);
+    }
+    const JValue* ac = job.get("analysis_config");
+    if (!ac || !ac->is_obj()) return;
+    if (const JValue* bs = ac->get("bucket_span")) {
+      if (bs->is_num()) bucket_span = bs->num;
+      else if (bs->is_str()) bucket_span = parse_span(bs->str);
+    }
+    if (const JValue* dets = ac->get("detectors")) {
+      if (dets->kind == JValue::ARR) {
+        for (const auto& d : *dets->arr) {
+          Detector det;
+          if (const JValue* f = d.get("function")) det.function = f->str_or("count");
+          if (const JValue* f = d.get("field_name")) det.field_name = f->str_or("");
+          if (const JValue* f = d.get("by_field_name")) det.by_field = f->str_or("");
+          if (const JValue* f = d.get("partition_field_name"))
+            det.partition_field = f->str_or("");
+          if (det.function.rfind("low_", 0) == 0) {
+            det.side = -1;
+            det.function = det.function.substr(4);
+          } else if (det.function.rfind("high_", 0) == 0) {
+            det.side = 1;
+            det.function = det.function.substr(5);
+          }
+          detectors.push_back(std::move(det));
+        }
+      }
+    }
+    if (detectors.empty()) detectors.push_back(Detector{"count"});
+  }
+
+  static double parse_span(const std::string& s) {
+    char* endp = nullptr;
+    double v = strtod(s.c_str(), &endp);
+    if (endp && *endp) {
+      switch (*endp) {
+        case 's': return v;
+        case 'm': return v * 60;
+        case 'h': return v * 3600;
+        case 'd': return v * 86400;
+      }
+    }
+    return v > 0 ? v : 300;
+  }
+
+  // --- state persist / restore --------------------------------------------
+
+  JValue state_json() const {
+    JValue st = JValue::object();
+    JValue dets = JValue::array();
+    for (const auto& det : detectors) {
+      JValue d = JValue::object();
+      JValue ms = JValue::object();
+      for (const auto& kv : det.models) {
+        JValue m = JValue::array();
+        m.arr->push_back(JValue::of(kv.second.n));
+        m.arr->push_back(JValue::of(kv.second.mean));
+        m.arr->push_back(JValue::of(kv.second.m2));
+        (*ms.obj)[kv.first] = m;
+      }
+      (*d.obj)["models"] = ms;
+      JValue rs = JValue::object();
+      for (const auto& kv : det.rare_models) {
+        JValue r = JValue::object();
+        for (const auto& ckv : kv.second.counts)
+          (*r.obj)[ckv.first] = JValue::of(ckv.second);
+        (*rs.obj)[kv.first] = r;
+      }
+      (*d.obj)["rare"] = rs;
+      dets.arr->push_back(d);
+    }
+    (*st.obj)["detectors"] = dets;
+    (*st.obj)["latest_time"] = JValue::of(latest_time);
+    return st;
+  }
+
+  void restore_state(const JValue& st) {
+    const JValue* dets = st.get("detectors");
+    if (!dets || dets->kind != JValue::ARR) return;
+    for (size_t i = 0; i < dets->arr->size() && i < detectors.size(); ++i) {
+      const JValue& d = (*dets->arr)[i];
+      if (const JValue* ms = d.get("models")) {
+        if (ms->is_obj()) {
+          for (const auto& kv : *ms->obj) {
+            if (kv.second.kind == JValue::ARR && kv.second.arr->size() == 3) {
+              MetricModel m;
+              m.n = (*kv.second.arr)[0].num_or(0);
+              m.mean = (*kv.second.arr)[1].num_or(0);
+              m.m2 = (*kv.second.arr)[2].num_or(0);
+              detectors[i].models[kv.first] = m;
+            }
+          }
+        }
+      }
+      if (const JValue* rs = d.get("rare")) {
+        if (rs->is_obj()) {
+          for (const auto& kv : *rs->obj) {
+            RareModel r;
+            if (kv.second.is_obj()) {
+              for (const auto& ckv : *kv.second.obj) {
+                r.counts[ckv.first] = ckv.second.num_or(0);
+                r.total += ckv.second.num_or(0);
+              }
+            }
+            detectors[i].rare_models[kv.first] = r;
+          }
+        }
+      }
+    }
+    if (const JValue* lt = st.get("latest_time")) latest_time = lt->num_or(-1);
+  }
+
+  // --- record ingestion ----------------------------------------------------
+
+  static std::string field_str(const JValue& fields, const std::string& name) {
+    const JValue* v = fields.get(name);
+    if (!v) return "";
+    if (v->is_str()) return v->str;
+    if (v->is_num()) {
+      std::string out;
+      write_json(*v, out);
+      return out;
+    }
+    return "";
+  }
+
+  void add_record(double t, const JValue& fields) {
+    if (t < latest_time) return;  // out-of-order: dropped (host counts these)
+    // records for a bucket already finalized by flush are too old to score
+    if (bucket_start >= 0 && t < bucket_start) return;
+    latest_time = t;
+    double bstart = std::floor(t / bucket_span) * bucket_span;
+    if (bucket_start < 0) bucket_start = bstart;
+    while (bstart >= bucket_start + bucket_span) close_bucket();
+
+    for (size_t i = 0; i < detectors.size(); ++i) {
+      Detector& det = detectors[i];
+      std::string entity = entity_key(det, fields);
+      BucketAgg& agg = accum[{int(i), entity}];
+      agg.count += 1;
+      if (!det.field_name.empty()) {
+        const JValue* v = fields.get(det.field_name);
+        if (v && v->is_num()) {
+          agg.sum += v->num;
+          if (v->num < agg.min) agg.min = v->num;
+          if (v->num > agg.max) agg.max = v->num;
+        } else {
+          agg.count -= 1;  // missing metric field: record doesn't count
+        }
+      }
+      if (!det.by_field.empty() &&
+          (det.function == "rare" || det.function == "distinct_count")) {
+        std::string bv = field_str(fields, det.by_field);
+        if (!bv.empty()) agg.by_counts[bv] += 1;
+      }
+    }
+  }
+
+  static std::string entity_key(const Detector& det, const JValue& fields) {
+    std::string key;
+    if (!det.partition_field.empty()) key += field_str(fields, det.partition_field);
+    key += '\x1e';
+    // rare/distinct_count model the by-distribution itself, so the by value
+    // is data, not identity
+    if (!det.by_field.empty() && det.function != "rare" &&
+        det.function != "distinct_count")
+      key += field_str(fields, det.by_field);
+    return key;
+  }
+
+  static double score_from_probability(double p) {
+    if (p >= 1) return 0;
+    if (p < 1e-308) p = 1e-308;
+    double s = -10 * std::log10(p) - 13;  // ~p<0.05 before any score
+    if (s < 0) s = 0;
+    if (s > 100) s = 100;
+    return s;
+  }
+
+  void close_bucket() {
+    if (bucket_start < 0) return;
+    double max_record_score = 0;
+    double total_anomaly = 0;
+    JArray records;
+
+    for (size_t i = 0; i < detectors.size(); ++i) {
+      Detector& det = detectors[i];
+      // collect entities seen this bucket for this detector
+      for (auto it = accum.begin(); it != accum.end(); ++it) {
+        if (it->first.first != int(i)) continue;
+        const std::string& entity = it->first.second;
+        BucketAgg& agg = it->second;
+
+        if (det.function == "rare") {
+          RareModel& rm = det.rare_models[entity];
+          for (const auto& bv : agg.by_counts) {
+            double p = rm.probability(bv.first);
+            double score = score_from_probability(p);
+            if (score > 0.1)
+              emit_record(records, det, entity, bv.first, score, p, bv.second, 0);
+            if (score > max_record_score) max_record_score = score;
+            total_anomaly += score;
+          }
+          for (const auto& bv : agg.by_counts) rm.add(bv.first, bv.second);
+          continue;
+        }
+
+        double actual;
+        if (det.function == "count") actual = agg.count;
+        else if (det.function == "sum") actual = agg.sum;
+        else if (det.function == "min") actual = agg.count > 0 ? agg.min : 0;
+        else if (det.function == "max") actual = agg.count > 0 ? agg.max : 0;
+        else if (det.function == "distinct_count") actual = double(agg.by_counts.size());
+        else actual = agg.count > 0 ? agg.sum / agg.count : 0;  // mean/metric
+
+        MetricModel& m = det.models[entity];
+        double p = m.probability(actual, det.side);
+        double score = score_from_probability(p);
+        if (score > 0.1)
+          emit_record(records, det, entity, "", score, p, actual, m.mean);
+        if (score > max_record_score) max_record_score = score;
+        total_anomaly += score;
+        m.add(actual);
+      }
+    }
+
+    // bucket result
+    JValue b = JValue::object();
+    (*b.obj)["type"] = JValue::of("bucket");
+    (*b.obj)["job_id"] = JValue::of(job_id);
+    (*b.obj)["timestamp"] = JValue::of(bucket_start * 1000);
+    (*b.obj)["bucket_span"] = JValue::of(bucket_span);
+    (*b.obj)["anomaly_score"] = JValue::of(max_record_score);
+    (*b.obj)["initial_anomaly_score"] = JValue::of(max_record_score);
+    (*b.obj)["event_count"] = JValue::of(total_event_count());
+    (*b.obj)["is_interim"] = JValue::of(false);
+    (*b.obj)["result_type"] = JValue::of("bucket");
+    write_frame(b);
+    for (auto& r : records) write_frame(r);
+
+    accum.clear();
+    bucket_start += bucket_span;
+  }
+
+  double total_event_count() const {
+    double n = 0;
+    for (const auto& kv : accum)
+      if (kv.first.first == 0) n += kv.second.count;
+    return n;
+  }
+
+  void emit_record(JArray& records, const Detector& det, const std::string& entity,
+                   const std::string& by_value, double score, double prob,
+                   double actual, double typical) {
+    JValue r = JValue::object();
+    (*r.obj)["type"] = JValue::of("record");
+    (*r.obj)["job_id"] = JValue::of(job_id);
+    (*r.obj)["result_type"] = JValue::of("record");
+    (*r.obj)["timestamp"] = JValue::of(bucket_start * 1000);
+    (*r.obj)["bucket_span"] = JValue::of(bucket_span);
+    (*r.obj)["record_score"] = JValue::of(score);
+    (*r.obj)["initial_record_score"] = JValue::of(score);
+    (*r.obj)["probability"] = JValue::of(prob);
+    std::string fname = (det.side < 0 ? "low_" : det.side > 0 ? "high_" : "");
+    (*r.obj)["function"] = JValue::of(fname + det.function);
+    if (!det.field_name.empty())
+      (*r.obj)["field_name"] = JValue::of(det.field_name);
+    size_t sep = entity.find('\x1e');
+    std::string part = sep == std::string::npos ? "" : entity.substr(0, sep);
+    std::string byv = by_value.empty()
+                          ? (sep == std::string::npos ? "" : entity.substr(sep + 1))
+                          : by_value;
+    if (!det.partition_field.empty()) {
+      (*r.obj)["partition_field_name"] = JValue::of(det.partition_field);
+      (*r.obj)["partition_field_value"] = JValue::of(part);
+    }
+    if (!det.by_field.empty()) {
+      (*r.obj)["by_field_name"] = JValue::of(det.by_field);
+      (*r.obj)["by_field_value"] = JValue::of(byv);
+    }
+    JValue act = JValue::array();
+    act.arr->push_back(JValue::of(actual));
+    (*r.obj)["actual"] = act;
+    if (det.function != "rare") {
+      JValue typ = JValue::array();
+      typ.arr->push_back(JValue::of(typical));
+      (*r.obj)["typical"] = typ;
+    }
+    (*r.obj)["is_interim"] = JValue::of(false);
+    records.push_back(r);
+  }
+};
+
+// ---------------------------------------------------------------------------
+
+int main() {
+  Autodetect ad;
+  std::string payload;
+  bool configured = false;
+
+  while (read_frame(payload)) {
+    JParser parser(payload);
+    JValue msg = parser.parse();
+    if (!parser.ok || !msg.is_obj()) {
+      JValue err = JValue::object();
+      (*err.obj)["type"] = JValue::of("error");
+      (*err.obj)["message"] = JValue::of("malformed frame");
+      write_frame(err);
+      continue;
+    }
+    std::string type = msg.get("type") ? msg.get("type")->str_or("") : "";
+
+    if (type == "config") {
+      if (const JValue* job = msg.get("job")) ad.configure(*job);
+      if (const JValue* st = msg.get("state")) ad.restore_state(*st);
+      configured = true;
+    } else if (type == "record") {
+      if (!configured) continue;
+      const JValue* t = msg.get("time");
+      const JValue* fields = msg.get("fields");
+      if (t && t->is_num() && fields && fields->is_obj())
+        ad.add_record(t->num, *fields);
+    } else if (type == "flush") {
+      if (!ad.accum.empty()) ad.close_bucket();
+      JValue ack = JValue::object();
+      (*ack.obj)["type"] = JValue::of("flush_ack");
+      (*ack.obj)["id"] = msg.get("id") ? *msg.get("id") : JValue::of("");
+      (*ack.obj)["last_finalized_bucket_end"] =
+          JValue::of(ad.bucket_start > 0 ? ad.bucket_start * 1000 : 0);
+      write_frame(ack);
+    } else if (type == "persist") {
+      JValue st = JValue::object();
+      (*st.obj)["type"] = JValue::of("state");
+      (*st.obj)["state"] = ad.state_json();
+      write_frame(st);
+    } else if (type == "quit") {
+      if (!ad.accum.empty()) ad.close_bucket();
+      break;
+    }
+  }
+  return 0;
+}

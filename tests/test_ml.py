@@ -1,0 +1,294 @@
+"""ML anomaly-detection jobs: native sidecar process, job lifecycle,
+datafeeds, results (reference: x-pack/plugin/ml + elastic/ml-cpp processes
+managed via NativeController/ProcessPipes — SURVEY.md §2.9, §2.11)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.ml.process import (
+    AutodetectProcess,
+    PyAutodetect,
+    autodetect_binary,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    def __init__(self, node):
+        self.rc = RestController()
+        register_all(self.rc, node)
+
+    def req(self, method, path, body=None, **query):
+        raw = b""
+        if body is not None:
+            if isinstance(body, (list, tuple)):
+                raw = b"\n".join(json.dumps(l).encode() for l in body) + b"\n"
+            else:
+                raw = json.dumps(body).encode()
+        q = {k: str(v) for k, v in query.items()}
+        return self.rc.dispatch(method, path, q, raw, "application/json")
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    return Client(node)
+
+
+JOB = {
+    "analysis_config": {
+        "bucket_span": "60s",
+        "detectors": [{"function": "mean", "field_name": "responsetime",
+                       "partition_field_name": "airline"}],
+    },
+    "data_description": {"time_field": "time"},
+}
+
+
+def _records(n_buckets=30, anomaly_bucket=None, value=10.0, anomaly_value=500.0):
+    recs = []
+    for b in range(n_buckets):
+        for i in range(10):
+            v = anomaly_value if b == anomaly_bucket else value + (i % 3) * 0.5
+            recs.append({"time": b * 60 + i * 5, "responsetime": v,
+                         "airline": "AAL"})
+    return recs
+
+
+def test_native_binary_builds():
+    # the C++ toolchain is in the image; the sidecar must actually build
+    assert autodetect_binary() is not None
+
+
+def test_process_detects_injected_anomaly():
+    results = []
+    proc = AutodetectProcess({"job_id": "j", **JOB}, results.append)
+    assert proc.is_native
+    for r in _records(30, anomaly_bucket=25):
+        proc.write_record(r["time"], r)
+    ack = proc.flush("f1")
+    assert ack["id"] == "f1"
+    proc.close()
+    buckets = [m for m in results if m["type"] == "bucket"]
+    assert len(buckets) == 30
+    anomalous = [b for b in buckets if b["anomaly_score"] > 50]
+    assert [b["timestamp"] for b in anomalous] == [25 * 60 * 1000]
+    recs = [m for m in results if m["type"] == "record"]
+    big = [r for r in recs if r["record_score"] > 50]
+    assert big and big[0]["partition_field_value"] == "AAL"
+    assert big[0]["actual"][0] == 500.0
+    assert abs(big[0]["typical"][0] - 10.5) < 1.0
+
+
+def test_python_fallback_matches_native_semantics():
+    """PyAutodetect is the no-compiler fallback; its scores must agree with
+    the native process on the same stream."""
+    native_out, py_out = [], []
+    proc = AutodetectProcess({"job_id": "j", **JOB}, native_out.append)
+    py = PyAutodetect({"job_id": "j", **JOB}, py_out.append)
+    for r in _records(20, anomaly_bucket=15):
+        proc.write_record(r["time"], r)
+        py.handle({"type": "record", "time": r["time"], "fields": r})
+    proc.flush()
+    py.handle({"type": "flush", "id": "f"})
+    proc.close()
+    nb = {m["timestamp"]: m["anomaly_score"] for m in native_out
+          if m["type"] == "bucket"}
+    pb = {m["timestamp"]: m["anomaly_score"] for m in py_out
+          if m["type"] == "bucket"}
+    assert set(nb) == set(pb)
+    for ts in nb:
+        assert abs(nb[ts] - pb[ts]) < 1e-6, ts
+
+
+def test_job_lifecycle_and_results(client):
+    status, job = client.req("PUT", "/_ml/anomaly_detectors/latency", JOB)
+    assert status == 200 and job["job_id"] == "latency"
+    assert job["state"] == "closed"
+
+    status, _ = client.req("POST", "/_ml/anomaly_detectors/latency/_open")
+    assert status == 200
+
+    status, counts = client.req("POST", "/_ml/anomaly_detectors/latency/_data",
+                                _records(30, anomaly_bucket=25))
+    assert status == 202
+    assert counts["processed_record_count"] == 300
+
+    status, flush = client.req("POST",
+                               "/_ml/anomaly_detectors/latency/_flush")
+    assert status == 200 and flush["flushed"]
+
+    status, res = client.req(
+        "GET", "/_ml/anomaly_detectors/latency/results/buckets",
+        {"anomaly_score": 50})
+    assert status == 200 and res["count"] == 1
+    assert res["buckets"][0]["timestamp"] == 25 * 60 * 1000
+
+    status, res = client.req(
+        "GET", "/_ml/anomaly_detectors/latency/results/records",
+        {"record_score": 50})
+    assert res["count"] >= 1
+    rec = res["records"][0]
+    assert rec["function"] == "mean" and rec["field_name"] == "responsetime"
+
+    status, stats = client.req("GET",
+                               "/_ml/anomaly_detectors/latency/_stats")
+    assert stats["jobs"][0]["state"] == "opened"
+    assert stats["jobs"][0]["data_counts"]["processed_record_count"] == 300
+
+    status, _ = client.req("POST", "/_ml/anomaly_detectors/latency/_close")
+    assert status == 200
+    status, stats = client.req("GET",
+                               "/_ml/anomaly_detectors/latency/_stats")
+    assert stats["jobs"][0]["state"] == "closed"
+
+    # results survive close; queryable via the plain search API too
+    status, res = client.req("POST", "/.ml-anomalies-shared/_search",
+                             {"query": {"term": {"result_type": "bucket"}},
+                              "size": 0})
+    assert res["hits"]["total"]["value"] == 30
+
+
+def test_model_state_persists_across_close_open(node):
+    """Closing persists model state; reopening restores it (the baseline
+    learned before close still flags anomalies after reopen)."""
+    node.ml.put_job("j1", JOB)
+    node.ml.open_job("j1")
+    node.ml.post_data("j1", _records(20))
+    node.ml.close_job("j1")
+
+    node.ml.open_job("j1")
+    # continue the stream where it left off, with an anomaly right away
+    recs = [{"time": 20 * 60 + i * 5, "responsetime": 500.0, "airline": "AAL"}
+            for i in range(10)]
+    recs += [{"time": 21 * 60 + i * 5, "responsetime": 10.0, "airline": "AAL"}
+             for i in range(10)]
+    node.ml.post_data("j1", recs)
+    node.ml.flush_job("j1")
+    res = node.ml.get_buckets("j1", {"anomaly_score": 50})
+    assert res["count"] == 1
+    assert res["buckets"][0]["timestamp"] == 20 * 60 * 1000
+    node.ml.close_job("j1")
+
+
+def test_count_detector_and_validation(node):
+    node.ml.put_job("c1", {"analysis_config": {
+        "bucket_span": 60, "detectors": [{"function": "count"}]},
+        "data_description": {"time_field": "t"}})
+    node.ml.open_job("c1")
+    recs = []
+    for b in range(20):
+        n = 100 if b == 15 else 5  # count spike
+        recs += [{"t": b * 60 + (i % 60)} for i in range(n)]
+    node.ml.post_data("c1", recs)
+    node.ml.flush_job("c1")
+    res = node.ml.get_buckets("c1", {"anomaly_score": 50})
+    assert [b["timestamp"] for b in res["buckets"]] == [15 * 60 * 1000]
+    node.ml.close_job("c1")
+
+    from elasticsearch_tpu.common.errors import ValidationError
+    with pytest.raises(ValidationError):
+        node.ml.put_job("bad", {"analysis_config": {
+            "detectors": [{"function": "mean"}]}})  # mean needs field_name
+    with pytest.raises(ValidationError):
+        node.ml.put_job("bad", {"analysis_config": {
+            "detectors": [{"function": "rare"}]}})  # rare needs by_field
+
+
+def test_rare_detector(node):
+    node.ml.put_job("r1", {"analysis_config": {
+        "bucket_span": 60,
+        "detectors": [{"function": "rare", "by_field_name": "status"}]},
+        "data_description": {"time_field": "t"}})
+    node.ml.open_job("r1")
+    recs = []
+    for b in range(30):
+        for i in range(10):
+            recs.append({"t": b * 60 + i, "status": "200"})
+        if b == 25:
+            recs.append({"t": b * 60 + 30, "status": "500"})  # rare value
+    node.ml.post_data("r1", recs)
+    node.ml.flush_job("r1")
+    res = node.ml.get_records("r1", {"record_score": 10})
+    assert res["count"] >= 1
+    assert res["records"][0]["by_field_value"] == "500"
+    node.ml.close_job("r1")
+
+
+def test_datafeed_from_index(client, node):
+    # index source data with an ISO time field
+    ops = []
+    for b in range(25):
+        for i in range(5):
+            v = 400.0 if b == 20 else 10.0
+            ops.append({"index": {"_index": "metrics"}})
+            ops.append({"time": (b * 60 + i * 10) * 1000, "cpu": v})
+    client.req("POST", "/_bulk", ops, refresh="true")
+
+    status, _ = client.req("PUT", "/_ml/anomaly_detectors/cpu-job", {
+        "analysis_config": {"bucket_span": "60s",
+                            "detectors": [{"function": "mean",
+                                           "field_name": "cpu"}]},
+        "data_description": {"time_field": "time", "time_format": "epoch_ms"},
+    })
+    assert status == 200
+    status, df = client.req("PUT", "/_ml/datafeeds/cpu-feed",
+                            {"job_id": "cpu-job", "indices": ["metrics"]})
+    assert status == 200 and df["datafeed_id"] == "cpu-feed"
+
+    status, preview = client.req("GET", "/_ml/datafeeds/cpu-feed/_preview")
+    assert status == 200 and len(preview) > 0
+
+    client.req("POST", "/_ml/anomaly_detectors/cpu-job/_open")
+    status, started = client.req("POST", "/_ml/datafeeds/cpu-feed/_start")
+    assert status == 200 and started["processed"] == 125
+
+    status, res = client.req(
+        "GET", "/_ml/anomaly_detectors/cpu-job/results/buckets",
+        {"anomaly_score": 50})
+    assert res["count"] == 1
+    assert res["buckets"][0]["timestamp"] == 20 * 60 * 1000
+    client.req("POST", "/_ml/anomaly_detectors/cpu-job/_close")
+
+    status, stats = client.req("GET", "/_ml/datafeeds/cpu-feed/_stats")
+    assert stats["datafeeds"][0]["state"] == "stopped"
+
+
+def test_record_for_finalized_bucket_dropped_not_misattributed():
+    """After a flush finalizes bucket [0,60), a late record at t=50 must not
+    land in the next bucket's results."""
+    results = []
+    proc = AutodetectProcess(
+        {"job_id": "j", "analysis_config": {
+            "bucket_span": 60, "detectors": [{"function": "count"}]},
+         "data_description": {"time_field": "t"}}, results.append)
+    proc.write_record(10, {"t": 10})
+    proc.flush()                    # finalizes [0, 60)
+    proc.write_record(50, {"t": 50})  # stale: bucket already closed
+    proc.write_record(70, {"t": 70})
+    proc.flush()
+    proc.close()
+    buckets = {m["timestamp"]: m["event_count"] for m in results
+               if m["type"] == "bucket"}
+    assert buckets == {0: 1, 60000: 1}  # t=50 dropped, not counted at 60000
+
+
+def test_out_of_order_records_counted(node):
+    node.ml.put_job("o1", {"analysis_config": {
+        "bucket_span": 60, "detectors": [{"function": "count"}]},
+        "data_description": {"time_field": "t"}})
+    node.ml.open_job("o1")
+    node.ml.post_data("o1", [{"t": 100}, {"t": 200}, {"t": 50}, {"t": 300}])
+    counts = node.ml.data_counts["o1"]
+    assert counts["processed_record_count"] == 3
+    assert counts["out_of_order_timestamp_count"] == 1
+    node.ml.close_job("o1")
