@@ -414,6 +414,508 @@ class PercolatorFieldMapper(FieldMapper):
         return self.coerce(value)
 
 
+class BinaryFieldMapper(FieldMapper):
+    """`binary` (reference: index/mapper/BinaryFieldMapper.java): base64
+    value, stored but not searchable."""
+
+    type_name = "binary"
+
+    def coerce(self, value) -> str:
+        import base64
+        s = str(value)
+        try:
+            base64.b64decode(s, validate=True)
+        except Exception:
+            raise MapperParsingError(
+                f"[{self.name}] failed to parse base64 binary value")
+        return s
+
+    def doc_value(self, value):
+        return self.coerce(value) if self.params.get("doc_values", True) else None
+
+
+class RangeFieldMapperBase(FieldMapper):
+    """Range family (reference: index/mapper/RangeFieldMapper.java —
+    integer/long/float/double/date/ip ranges). A value is an object of
+    gt/gte/lt/lte bounds; stored normalized to inclusive numeric [lo, hi]
+    so membership (term) and overlap (range query relations) are interval
+    arithmetic over doc values."""
+
+    discrete = True  # exclusive bounds shift by 1; floats use nextafter
+
+    def _bound(self, value) -> float:
+        return float(value)
+
+    def coerce(self, value) -> dict:
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"[{self.name}] range field value must be an object of bounds")
+        lo, hi = -math.inf, math.inf
+        for k, v in value.items():
+            if k == "gte":
+                lo = self._bound(v)
+            elif k == "gt":
+                b = self._bound(v)
+                lo = b + 1 if self.discrete else float(np.nextafter(b, math.inf))
+            elif k == "lte":
+                hi = self._bound(v)
+            elif k == "lt":
+                b = self._bound(v)
+                hi = b - 1 if self.discrete else float(np.nextafter(b, -math.inf))
+            else:
+                raise MapperParsingError(
+                    f"[{self.name}] unknown range bound [{k}]")
+        if lo > hi:
+            raise MapperParsingError(
+                f"[{self.name}] range lower bound greater than upper bound")
+        return {"gte": lo, "lte": hi}
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+    def query_bound(self, value) -> float:
+        """Bound coercion for query-side values (same units as storage)."""
+        return self._bound(value)
+
+
+class IntegerRangeFieldMapper(RangeFieldMapperBase):
+    type_name = "integer_range"
+
+    def _bound(self, value):
+        return float(int(value))
+
+
+class LongRangeFieldMapper(IntegerRangeFieldMapper):
+    type_name = "long_range"
+
+
+class FloatRangeFieldMapper(RangeFieldMapperBase):
+    type_name = "float_range"
+    discrete = False
+
+
+class DoubleRangeFieldMapper(FloatRangeFieldMapper):
+    type_name = "double_range"
+
+
+class DateRangeFieldMapper(RangeFieldMapperBase):
+    type_name = "date_range"
+
+    def _bound(self, value):
+        return float(parse_date_millis(value))
+
+
+class IpRangeFieldMapper(RangeFieldMapperBase):
+    type_name = "ip_range"
+
+    def _bound(self, value):
+        return float(int(ipaddress.ip_address(str(value))))
+
+    def coerce(self, value):
+        if isinstance(value, str):  # CIDR form "10.0.0.0/8"
+            try:
+                net = ipaddress.ip_network(value, strict=False)
+            except ValueError:
+                raise MapperParsingError(
+                    f"[{self.name}] failed to parse ip range [{value}]")
+            return {"gte": float(int(net.network_address)),
+                    "lte": float(int(net.broadcast_address))}
+        return super().coerce(value)
+
+
+class CompletionFieldMapper(FieldMapper):
+    """`completion` (reference: index/mapper/CompletionFieldMapper.java —
+    FST-backed suggester field). Inputs index as exact terms; the completion
+    suggester prefix-scans them (search/extras.py)."""
+
+    type_name = "completion"
+
+    def _inputs(self, value) -> Tuple[List[str], int]:
+        if isinstance(value, str):
+            return [value], 1
+        if isinstance(value, list):
+            return [str(v) for v in value], 1
+        if isinstance(value, dict):
+            inp = value.get("input", [])
+            inputs = [inp] if isinstance(inp, str) else [str(v) for v in inp]
+            return inputs, int(value.get("weight", 1))
+        raise MapperParsingError(
+            f"[{self.name}] completion value must be string, array or object")
+
+    def index_terms(self, value):
+        return self._inputs(value)[0]
+
+    def doc_value(self, value):
+        inputs, weight = self._inputs(value)
+        return {"input": inputs, "weight": weight}
+
+
+class _ShingleAnalyzer:
+    """Analyzer adapter producing word shingles of size N over a base
+    analyzer (both index- and search-side for the SAYT subfields)."""
+
+    def __init__(self, base, n: int):
+        self.base = base
+        self.n = n
+
+    def terms(self, text: str) -> List[str]:
+        base = self.base.terms(text)
+        return [" ".join(base[i:i + self.n])
+                for i in range(len(base) - self.n + 1)]
+
+    def analyze(self, text: str):
+        from elasticsearch_tpu.index.analysis import Token
+        return [Token(t, i, 0, 0) for i, t in enumerate(self.terms(text))]
+
+
+class _ShingleTextMapper(TextFieldMapper):
+    """Auto subfield of search_as_you_type: word shingles of size N."""
+
+    type_name = "text"
+
+    def __init__(self, name, params=None, shingle_size=2):
+        super().__init__(name, params)
+        self.shingle_size = shingle_size
+        self.analyzer = _ShingleAnalyzer(self.analyzer, shingle_size)
+        self.search_analyzer = _ShingleAnalyzer(self.search_analyzer,
+                                                shingle_size)
+
+
+class _PrefixTextMapper(TextFieldMapper):
+    """Auto subfield of search_as_you_type: edge n-grams over 1..3-shingles
+    (reference's `._index_prefix`)."""
+
+    type_name = "text"
+
+    def analyze(self, value):
+        base = super().analyze(value)
+        out = []
+        for n in (1, 2, 3):
+            for i in range(max(0, len(base) - n + 1)):
+                shingle = " ".join(base[i:i + n])
+                out.extend(shingle[:j] for j in range(1, min(len(shingle), 19) + 1))
+        return sorted(set(out))
+
+    def analyze_positions(self, value):
+        from elasticsearch_tpu.index.analysis import Token
+        return [Token(t, i, 0, 0) for i, t in enumerate(self.analyze(value))]
+
+
+class SearchAsYouTypeFieldMapper(TextFieldMapper):
+    """`search_as_you_type` (reference: modules/mapper-extras
+    SearchAsYouTypeFieldMapper.java): a text field with auto `._2gram`,
+    `._3gram` shingle subfields and an `._index_prefix` edge-ngram subfield,
+    targeted by multi_match bool_prefix queries."""
+
+    type_name = "search_as_you_type"
+
+
+class TokenCountFieldMapper(FieldMapper):
+    """`token_count` (reference: modules/mapper-extras
+    TokenCountFieldMapper.java): indexes the number of analyzed tokens."""
+
+    type_name = "token_count"
+
+    def __init__(self, name, params=None,
+                 registry: AnalysisRegistry = DEFAULT_REGISTRY):
+        super().__init__(name, params)
+        self.analyzer = registry.get(self.params.get("analyzer", "standard"))
+
+    def count(self, value) -> int:
+        # numeric input IS the count (query-side values, pre-counted docs);
+        # strings get analyzed (index-side text values)
+        if isinstance(value, int) and not isinstance(value, bool):
+            return value
+        return len(self.analyzer.terms(str(value)))
+
+    def index_terms(self, value):
+        return [repr(self.count(value))]
+
+    def doc_value(self, value):
+        return self.count(value)
+
+
+class WildcardFieldMapper(KeywordFieldMapper):
+    """`wildcard` (reference: x-pack/plugin/wildcard): keyword-like field
+    optimized for leading-wildcard matching. The term-scan execution here
+    already handles arbitrary patterns, so this shares keyword indexing."""
+
+    type_name = "wildcard"
+
+
+class ConstantKeywordFieldMapper(FieldMapper):
+    """`constant_keyword` (reference: x-pack/plugin/mapper-constant-keyword):
+    one value for every document in the index; documents may omit it, and a
+    conflicting value is a parse error. First seen value fixes it if the
+    mapping didn't."""
+
+    type_name = "constant_keyword"
+
+    def coerce(self, value, fix: bool = False) -> str:
+        s = str(value)
+        const = self.params.get("value")
+        if const is None:
+            if fix:  # only the write path fixes the constant
+                self.params["value"] = s
+            return s
+        if s != const:
+            raise MapperParsingError(
+                f"[{self.name}] constant_keyword field is already set to "
+                f"[{const}], cannot index [{s}]")
+        return s
+
+    def index_terms(self, value):
+        # query-side coercion must not mutate the mapping
+        return [self.coerce(value)]
+
+    def doc_value(self, value):
+        return self.coerce(value, fix=True)
+
+
+class Murmur3FieldMapper(FieldMapper):
+    """`murmur3` (reference: plugins/mapper-murmur3): stores the murmur3
+    hash of the value for cheap cardinality estimation."""
+
+    type_name = "murmur3"
+
+    def doc_value(self, value):
+        from elasticsearch_tpu.cluster.routing import murmur3_x86_32
+        h = murmur3_x86_32(str(value).encode("utf-8"))
+        return h - (1 << 32) if h >= (1 << 31) else h  # signed like the ref
+
+
+class HistogramFieldMapper(FieldMapper):
+    """`histogram` (reference: x-pack/plugin/analytics histogram field):
+    pre-aggregated {values[], counts[]} consumed by percentile aggs."""
+
+    type_name = "histogram"
+
+    def coerce(self, value) -> dict:
+        if not isinstance(value, dict) or "values" not in value \
+                or "counts" not in value:
+            raise MapperParsingError(
+                f"[{self.name}] histogram must be {{values, counts}}")
+        values = [float(v) for v in value["values"]]
+        counts = [int(c) for c in value["counts"]]
+        if len(values) != len(counts):
+            raise MapperParsingError(
+                f"[{self.name}] expected same length for values and counts")
+        if any(c < 0 for c in counts):
+            raise MapperParsingError(f"[{self.name}] counts must be >= 0")
+        if values != sorted(values):
+            raise MapperParsingError(
+                f"[{self.name}] values must be in increasing order")
+        return {"values": values, "counts": counts}
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class FlattenedFieldMapper(FieldMapper):
+    """`flattened` (reference: x-pack/plugin/mapper-flattened
+    FlatObjectFieldMapper.java): an entire JSON object indexed as keywords —
+    root-field queries match any leaf value, `field.key` queries match that
+    key's value. Keyed terms are materialized in MapperService._index_one."""
+
+    type_name = "flattened"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.depth_limit = int(self.params.get("depth_limit", 20))
+
+    def coerce(self, value) -> dict:
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"[{self.name}] flattened field value must be an object")
+        return value
+
+    def index_terms(self, value):
+        # query-side coercion: scalar query values look up leaf terms
+        # (document dicts never reach here — _index_one intercepts them)
+        if isinstance(value, dict):
+            return []
+        return [_flat_str(value)]
+
+    def leaves(self, value, prefix: str = "", depth: int = 0):
+        """Yields (key_path, leaf_string)."""
+        if depth > self.depth_limit:
+            raise MapperParsingError(
+                f"[{self.name}] object depth exceeds depth_limit "
+                f"[{self.depth_limit}]")
+        for k, v in value.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                yield from self.leaves(v, path + ".", depth + 1)
+            elif isinstance(v, list):
+                for item in v:
+                    if isinstance(item, dict):
+                        yield from self.leaves(item, path + ".", depth + 1)
+                    elif item is not None:
+                        yield path, _flat_str(item)
+            elif v is not None:
+                yield path, _flat_str(v)
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+def _flat_str(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+class AnnotatedTextFieldMapper(TextFieldMapper):
+    """`annotated_text` (reference: plugins/mapper-annotated-text):
+    markdown-style `[text](annotation)` spans index both the visible text
+    and the annotation value as terms."""
+
+    type_name = "annotated_text"
+
+    _ANNOTATION = re.compile(r"\[([^\]]+)\]\(([^)]+)\)")
+
+    def _expand(self, value: str) -> str:
+        annotations = []
+
+        def sub(m):
+            for part in m.group(2).split("&"):
+                import urllib.parse
+                annotations.append(urllib.parse.unquote(part))
+            return m.group(1)
+
+        text = self._ANNOTATION.sub(sub, str(value))
+        return text + ("\n" + "\n".join(annotations) if annotations else "")
+
+    def analyze(self, value):
+        return super().analyze(self._expand(str(value)))
+
+    def analyze_positions(self, value):
+        return super().analyze_positions(self._expand(str(value)))
+
+
+class SparseVectorFieldMapper(FieldMapper):
+    """`sparse_vector` (reference: x-pack/plugin/vectors
+    SparseVectorFieldMapper.java, deprecated in the snapshot): map of
+    dimension→weight, stored for script access."""
+
+    type_name = "sparse_vector"
+
+    def coerce(self, value) -> dict:
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"[{self.name}] sparse_vector value must be an object")
+        return {str(k): float(v) for k, v in value.items()}
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+class GeoShapeFieldMapper(FieldMapper):
+    """`geo_shape` (reference: index/mapper/GeoShapeFieldMapper.java +
+    libs/geo): GeoJSON (or WKT envelope/point) geometries. Indexed as the
+    shape's bounding envelope; geo_shape queries run envelope relations —
+    a documented approximation of the reference's triangulated BKD index."""
+
+    type_name = "geo_shape"
+
+    def coerce(self, value) -> dict:
+        shape = self._parse_shape(value)
+        env = shape_envelope(shape)
+        return {"shape": shape, "envelope": env}
+
+    def _parse_shape(self, value) -> dict:
+        if isinstance(value, dict) and "type" in value:
+            t = str(value["type"]).lower()
+            if t == "geometrycollection":
+                geoms = [self._parse_shape(g)
+                         for g in value.get("geometries", [])]
+                return {"type": "geometrycollection", "geometries": geoms}
+            if "coordinates" not in value:
+                raise MapperParsingError(
+                    f"[{self.name}] geo_shape requires [coordinates]")
+            return {"type": t, "coordinates": value["coordinates"]}
+        if isinstance(value, str):
+            return parse_wkt(value, self.name)
+        raise MapperParsingError(
+            f"[{self.name}] failed to parse geo_shape value [{value}]")
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
+def shape_envelope(shape: dict) -> Tuple[float, float, float, float]:
+    """(min_lon, min_lat, max_lon, max_lat) of a normalized shape dict."""
+    if shape["type"] == "geometrycollection":
+        envs = [shape_envelope(g) for g in shape["geometries"]]
+        return (min(e[0] for e in envs), min(e[1] for e in envs),
+                max(e[2] for e in envs), max(e[3] for e in envs))
+    coords = shape["coordinates"]
+    if shape["type"] == "envelope":
+        # [[min_lon, max_lat], [max_lon, min_lat]] — ES envelope order
+        (min_lon, max_lat), (max_lon, min_lat) = coords
+        return (float(min_lon), float(min_lat), float(max_lon), float(max_lat))
+    pts = list(_iter_positions(coords))
+    if not pts:
+        raise MapperParsingError("geo_shape has no coordinates")
+    lons = [p[0] for p in pts]
+    lats = [p[1] for p in pts]
+    return (min(lons), min(lats), max(lons), max(lats))
+
+
+def _iter_positions(coords):
+    if isinstance(coords, (list, tuple)):
+        if len(coords) >= 2 and all(
+                isinstance(c, (int, float)) for c in coords[:2]):
+            yield float(coords[0]), float(coords[1])
+        else:
+            for c in coords:
+                yield from _iter_positions(c)
+
+
+def parse_wkt(s: str, field: str = "") -> dict:
+    """Minimal WKT: POINT, ENVELOPE (ES extension), POLYGON, LINESTRING."""
+    m = re.match(r"\s*(\w+)\s*\((.*)\)\s*$", s, re.DOTALL)
+    if not m:
+        raise MapperParsingError(f"[{field}] failed to parse WKT [{s}]")
+    kind = m.group(1).upper()
+    body = m.group(2)
+
+    def pts(text):
+        out = []
+        for pair in text.split(","):
+            xy = pair.split()
+            out.append([float(xy[0]), float(xy[1])])
+        return out
+
+    if kind == "POINT":
+        return {"type": "point", "coordinates": pts(body)[0]}
+    if kind == "ENVELOPE":
+        # ENVELOPE(min_lon, max_lon, max_lat, min_lat) — WKT/ES order
+        v = [float(x) for x in body.split(",")]
+        return {"type": "envelope",
+                "coordinates": [[v[0], v[2]], [v[1], v[3]]]}
+    if kind == "LINESTRING":
+        return {"type": "linestring", "coordinates": pts(body)}
+    if kind == "POLYGON":
+        rings = re.findall(r"\(([^()]*)\)", body)
+        return {"type": "polygon", "coordinates": [pts(r) for r in rings]}
+    raise MapperParsingError(f"[{field}] unsupported WKT type [{kind}]")
+
+
+class AliasFieldMapper(FieldMapper):
+    """`alias` (reference: index/mapper/FieldAliasMapper.java): query-time
+    alternate name for a concrete field. Resolved in MapperService.get /
+    resolve_field; writes through an alias are rejected."""
+
+    type_name = "alias"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        if not self.params.get("path"):
+            raise MapperParsingError(f"[{name}] alias requires [path]")
+        self.path = self.params["path"]
+
+
 FIELD_TYPES = {
     m.type_name: m
     for m in (KeywordFieldMapper, TextFieldMapper, LongFieldMapper, IntegerFieldMapper,
@@ -422,7 +924,16 @@ FIELD_TYPES = {
               DateFieldMapper, IpFieldMapper, GeoPointFieldMapper,
               DenseVectorFieldMapper, ObjectMapper, NestedMapper,
               RankFeatureFieldMapper, RankFeaturesFieldMapper,
-              JoinFieldMapper, PercolatorFieldMapper)
+              JoinFieldMapper, PercolatorFieldMapper,
+              BinaryFieldMapper, IntegerRangeFieldMapper, LongRangeFieldMapper,
+              FloatRangeFieldMapper, DoubleRangeFieldMapper,
+              DateRangeFieldMapper, IpRangeFieldMapper,
+              CompletionFieldMapper, SearchAsYouTypeFieldMapper,
+              TokenCountFieldMapper, WildcardFieldMapper,
+              ConstantKeywordFieldMapper, Murmur3FieldMapper,
+              HistogramFieldMapper, FlattenedFieldMapper,
+              AnnotatedTextFieldMapper, SparseVectorFieldMapper,
+              GeoShapeFieldMapper, AliasFieldMapper)
 }
 
 
@@ -487,6 +998,22 @@ class MapperService:
                 sub = build_mapper(sub_path, sub_def)
                 self._multi_fields.setdefault(path, {})[sub_name] = sub
                 self._put(sub_path, sub)
+            if isinstance(mapper, SearchAsYouTypeFieldMapper):
+                # auto shingle/prefix subfields (reference:
+                # SearchAsYouTypeFieldMapper.java builds them in the builder)
+                analyzer_params = {k: v for k, v in mapper.params.items()
+                                   if k in ("analyzer", "search_analyzer")}
+                subs = {
+                    "_2gram": _ShingleTextMapper(f"{path}._2gram",
+                                                 analyzer_params, 2),
+                    "_3gram": _ShingleTextMapper(f"{path}._3gram",
+                                                 analyzer_params, 3),
+                    "_index_prefix": _PrefixTextMapper(f"{path}._index_prefix",
+                                                       analyzer_params),
+                }
+                for sub_name, sub in subs.items():
+                    self._multi_fields.setdefault(path, {})[sub_name] = sub
+                    self._mappers[f"{path}.{sub_name}"] = sub
 
     def _put(self, path: str, mapper: FieldMapper) -> None:
         existing = self._mappers.get(path)
@@ -499,7 +1026,23 @@ class MapperService:
         self._mappers[path] = mapper
 
     def get(self, path: str) -> Optional[FieldMapper]:
+        """Mapper for a path; `alias` fields resolve to their target
+        (reference: FieldAliasMapper — aliases are query-time only)."""
+        mapper = self._mappers.get(path)
+        if isinstance(mapper, AliasFieldMapper):
+            target = self._mappers.get(mapper.path)
+            return target if not isinstance(target, AliasFieldMapper) else None
+        return mapper
+
+    def get_raw(self, path: str) -> Optional[FieldMapper]:
         return self._mappers.get(path)
+
+    def resolve_field(self, path: str) -> str:
+        """Follow an alias to its concrete field name (one hop)."""
+        mapper = self._mappers.get(path)
+        if isinstance(mapper, AliasFieldMapper):
+            return mapper.path
+        return path
 
     def all_mappers(self):
         return list(self._mappers.items())
@@ -552,7 +1095,22 @@ class MapperService:
                     isinstance(value, dict) and isinstance(self.get(path), (ObjectMapper, NestedMapper))):
                 self._parse_object(value, path + ".", parsed)
                 continue
-            if isinstance(value, dict) and isinstance(self.get(path), GeoPointFieldMapper):
+            if isinstance(value, dict) and isinstance(self.get(path), (
+                    GeoPointFieldMapper, FlattenedFieldMapper,
+                    HistogramFieldMapper, GeoShapeFieldMapper,
+                    SparseVectorFieldMapper, RangeFieldMapperBase,
+                    CompletionFieldMapper, JoinFieldMapper,
+                    PercolatorFieldMapper, RankFeaturesFieldMapper)):
+                self._parse_field(path, value, parsed)
+                continue
+            if isinstance(value, list) and value and isinstance(value[0], dict) \
+                    and isinstance(self.get(path), (
+                        GeoPointFieldMapper, FlattenedFieldMapper,
+                        HistogramFieldMapper, GeoShapeFieldMapper,
+                        SparseVectorFieldMapper, RangeFieldMapperBase,
+                        CompletionFieldMapper, RankFeaturesFieldMapper)):
+                # arrays of dict-valued field values (multi-valued ranges,
+                # shapes, …) — each element is one field value, not an object
                 self._parse_field(path, value, parsed)
                 continue
             if isinstance(value, list) and value and isinstance(value[0], dict):
@@ -564,6 +1122,8 @@ class MapperService:
             self._parse_field(path, value, parsed)
 
     def _parse_field(self, path: str, value: Any, parsed: ParsedDocument) -> None:
+        if isinstance(self.get_raw(path), AliasFieldMapper):
+            raise MapperParsingError(f"Cannot write to a field alias [{path}].")
         mapper = self.get(path)
         if mapper is None:
             if value is None:
@@ -594,6 +1154,17 @@ class MapperService:
                 self._index_one(f"{path}.{sub_name}", sub, v, parsed)
 
     def _index_one(self, path: str, mapper: FieldMapper, v: Any, parsed: ParsedDocument) -> None:
+        if isinstance(mapper, AliasFieldMapper):
+            raise MapperParsingError(
+                f"Cannot write to a field alias [{path}].")
+        if isinstance(mapper, FlattenedFieldMapper):
+            obj = mapper.coerce(v)
+            root_terms = parsed.terms.setdefault(path, [])
+            for key_path, leaf in mapper.leaves(obj):
+                root_terms.append(leaf)
+                parsed.terms.setdefault(f"{path}.{key_path}", []).append(leaf)
+            parsed.doc_values[path] = obj
+            return
         if isinstance(mapper, DenseVectorFieldMapper):
             if path in parsed.vectors:
                 raise MapperParsingError(f"[{path}] only one vector per document")

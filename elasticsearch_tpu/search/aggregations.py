@@ -35,6 +35,7 @@ def numeric_values(ctx: SearchContext, rows: np.ndarray, field: str,
     Multi-valued docs contribute their first value here; use all_values for
     per-value expansion (terms/cardinality need it).
     """
+    field = ctx.mapper_service.resolve_field(field)
     vals = np.full(len(rows), np.nan, dtype=np.float64)
     present = np.zeros(len(rows), dtype=bool)
     for i, row in enumerate(rows):
@@ -58,6 +59,7 @@ def numeric_values(ctx: SearchContext, rows: np.ndarray, field: str,
 
 def all_values(ctx: SearchContext, rows: np.ndarray, field: str) -> List[Tuple[int, Any]]:
     """[(row_index, value)] expanded over multi-valued fields."""
+    field = ctx.mapper_service.resolve_field(field)
     out = []
     for i, row in enumerate(rows):
         v = ctx.reader.get_doc_value(field, int(row))

@@ -30,8 +30,8 @@ from elasticsearch_tpu import native
 from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
 from elasticsearch_tpu.index.mapping import (
     BooleanFieldMapper, DateFieldMapper, DenseVectorFieldMapper, IpFieldMapper,
-    KeywordFieldMapper, MapperService, TextFieldMapper, _NumericMapper,
-    parse_date_millis,
+    KeywordFieldMapper, MapperService, RangeFieldMapperBase, TextFieldMapper,
+    _NumericMapper, parse_date_millis,
 )
 from elasticsearch_tpu.index.segment import ShardReader
 
@@ -109,6 +109,7 @@ class MatchNoneQuery(Query):
 
 def _term_postings(ctx: SearchContext, field: str, term: str):
     """Collect (rows, freqs) for a term across segments, live docs only."""
+    field = ctx.mapper_service.resolve_field(field)
     rows_parts, freq_parts = [], []
     for view in ctx.reader.views:
         p = view.segment.get_postings(field, term)
@@ -137,6 +138,7 @@ def _field_lengths_for(ctx: SearchContext, field: str, rows: np.ndarray) -> np.n
 
 def bm25_scores(ctx: SearchContext, field: str, rows: np.ndarray,
                 freqs: np.ndarray, boost: float = 1.0) -> np.ndarray:
+    field = ctx.mapper_service.resolve_field(field)
     n = max(ctx.reader.docs_with_field_count(field), 1)
     df = len(rows)
     idf = math.log(1.0 + (n - df + 0.5) / (df + 0.5))
@@ -165,6 +167,12 @@ class TermQuery(Query):
 
     def execute(self, ctx: SearchContext) -> DocSet:
         mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, RangeFieldMapperBase):
+            # membership: the queried point lies inside the stored interval
+            v = mapper.query_bound(self.value)
+            return _scan_range_docs(
+                ctx, ctx.mapper_service.resolve_field(self.field),
+                lambda lo, hi: lo <= v <= hi, self.boost)
         if isinstance(mapper, TextFieldMapper):
             # term query on text matches the single analyzed-or-raw token as-is
             term = str(self.value)
@@ -332,12 +340,50 @@ def _phrase_from(pos_sets, i, prev, slop) -> bool:
     return False
 
 
+def scan_doc_values(ctx: SearchContext, field: str, value_match,
+                    boost: float = 1.0) -> DocSet:
+    """Docs whose (possibly multi-valued) doc value satisfies value_match —
+    the shared scan for fields matched by value inspection rather than
+    postings (range fields, geo shapes)."""
+    rows_parts = []
+    for view in ctx.reader.views:
+        seg = view.segment
+        col = seg.doc_values.get(field)
+        if col is None:
+            continue
+        locs = []
+        for i, v in enumerate(col.values):
+            if v is None or not view.live[i]:
+                continue
+            if any(value_match(item) for item in
+                   (v if isinstance(v, list) else [v])):
+                locs.append(i)
+        if locs:
+            rows_parts.append(np.asarray(locs, dtype=np.int64) + seg.base)
+    if not rows_parts:
+        return DocSet.empty()
+    rows = np.sort(np.concatenate(rows_parts))
+    return DocSet(rows, np.full(len(rows), boost, dtype=np.float32))
+
+
+def _scan_range_docs(ctx: SearchContext, field: str, predicate,
+                     boost: float) -> DocSet:
+    """Range-field scan: predicate over the stored inclusive interval."""
+    return scan_doc_values(
+        ctx, field,
+        lambda v: isinstance(v, dict) and predicate(v.get("gte", -np.inf),
+                                                    v.get("lte", np.inf)),
+        boost)
+
+
 class RangeQuery(Query):
     def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
-                 boost: float = 1.0, fmt: Optional[str] = None):
+                 boost: float = 1.0, fmt: Optional[str] = None,
+                 relation: str = "intersects"):
         self.field = field
         self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
         self.boost = boost
+        self.relation = relation
 
     def _coerce_bound(self, ctx, value):
         mapper = ctx.mapper_service.get(self.field)
@@ -345,6 +391,8 @@ class RangeQuery(Query):
             return float(parse_date_millis(value))
         if isinstance(mapper, IpFieldMapper):
             return float(mapper.coerce(value))
+        if isinstance(mapper, RangeFieldMapperBase):
+            return mapper.query_bound(value)
         return float(value)
 
     def execute(self, ctx: SearchContext) -> DocSet:
@@ -360,10 +408,29 @@ class RangeQuery(Query):
         if self.lt is not None:
             hi, hi_inc = self._coerce_bound(ctx, self.lt), False
 
+        mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, RangeFieldMapperBase):
+            # interval-vs-interval with the requested relation
+            # (reference: RangeFieldMapper query relations)
+            qlo = lo if lo_inc else (lo + 1 if mapper.discrete
+                                     else float(np.nextafter(lo, np.inf)))
+            qhi = hi if hi_inc else (hi - 1 if mapper.discrete
+                                     else float(np.nextafter(hi, -np.inf)))
+            if self.relation == "within":     # stored ⊆ query
+                pred = lambda slo, shi: slo >= qlo and shi <= qhi
+            elif self.relation == "contains":  # stored ⊇ query
+                pred = lambda slo, shi: slo <= qlo and shi >= qhi
+            else:                              # intersects
+                pred = lambda slo, shi: slo <= qhi and shi >= qlo
+            return _scan_range_docs(
+                ctx, ctx.mapper_service.resolve_field(self.field),
+                pred, self.boost)
+
+        field = ctx.mapper_service.resolve_field(self.field)
         rows_parts = []
         for view in ctx.reader.views:
             seg = view.segment
-            col = seg.doc_values.get(self.field)
+            col = seg.doc_values.get(field)
             if col is None or col.numeric is None:
                 # fall back to string doc values (keyword ranges)
                 if col is not None:
@@ -413,18 +480,19 @@ class ExistsQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        field = ctx.mapper_service.resolve_field(self.field)
         rows_parts = []
         for view in ctx.reader.views:
             seg = view.segment
             mask = None
-            col = seg.doc_values.get(self.field)
+            col = seg.doc_values.get(field)
             if col is not None:
                 mask = col.present.copy()
-            fl = seg.field_lengths.get(self.field)
+            fl = seg.field_lengths.get(field)
             if fl is not None:
                 m = fl > 0
                 mask = m if mask is None else (mask | m)
-            vec = seg.vectors.get(self.field)
+            vec = seg.vectors.get(field)
             if vec is not None:
                 mask = vec[1] if mask is None else (mask | vec[1])
             if mask is None:
@@ -462,6 +530,7 @@ class IdsQuery(Query):
 
 
 def _pattern_terms(ctx: SearchContext, field: str, predicate) -> List[str]:
+    field = ctx.mapper_service.resolve_field(field)
     seen = set()
     for view in ctx.reader.views:
         for term in view.segment.terms_of(field):
@@ -590,6 +659,37 @@ class MatchPhrasePrefixQuery(Query):
         return {"match_phrase_prefix": {self.field: {"query": self.text}}}
 
 
+class MatchBoolPrefixQuery(Query):
+    """`match_bool_prefix` (reference: MatchBoolPrefixQueryBuilder): analyze
+    the text; every term is a SHOULD term clause except the last, which
+    matches as a prefix. The canonical companion of search_as_you_type."""
+
+    def __init__(self, field: str, text: str, boost: float = 1.0,
+                 operator: str = "or"):
+        self.field = field
+        self.text = str(text)
+        self.boost = boost
+        self.operator = operator
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, TextFieldMapper):
+            terms = mapper.search_analyzer.terms(self.text)
+        else:
+            terms = [self.text]
+        if not terms:
+            return DocSet.empty()
+        *head, last = terms
+        sets = [TermQuery(self.field, t, self.boost).execute(ctx)
+                for t in head]
+        sets.append(PrefixQuery(self.field, last, self.boost).execute(ctx))
+        required = len(sets) if self.operator == "and" else 1
+        return _combine_should(sets, required)
+
+    def to_dict(self):
+        return {"match_bool_prefix": {self.field: {"query": self.text}}}
+
+
 class QueryStringQuery(Query):
     """Lucene-lite query_string (reference: `index/query/QueryStringQueryBuilder`
     via Lucene's classic QueryParser): supports `field:value`, quoted phrases,
@@ -706,13 +806,20 @@ class MultiMatchQuery(Query):
         sets = []
         for f in self.fields:
             name, fboost = split_boost(f)
-            sets.append(MatchQuery(name, self.query, operator=self.operator,
-                                   boost=self.boost * fboost).execute(ctx))
+            if self.mm_type == "bool_prefix":
+                # search_as_you_type target: all terms match, last as prefix
+                # (reference: MatchBoolPrefixQueryBuilder)
+                sets.append(MatchBoolPrefixQuery(
+                    name, self.query, boost=self.boost * fboost,
+                    operator=self.operator).execute(ctx))
+            else:
+                sets.append(MatchQuery(name, self.query, operator=self.operator,
+                                       boost=self.boost * fboost).execute(ctx))
         if not sets:
             return DocSet.empty()
         if self.mm_type == "best_fields":
             return _combine_max(sets)
-        return _combine_should(sets, 1)  # most_fields: sum
+        return _combine_should(sets, 1)  # most_fields / bool_prefix: sum
 
     def to_dict(self):
         return {"multi_match": {"query": self.query, "fields": self.fields,
@@ -1048,6 +1155,13 @@ def parse_query(body: Optional[dict]) -> Query:
         field, v = _single(spec, "match_phrase_prefix")
         text = v.get("query") if isinstance(v, dict) else v
         return MatchPhrasePrefixQuery(field, text)
+    if kind == "match_bool_prefix":
+        field, v = _single(spec, "match_bool_prefix")
+        if isinstance(v, dict):
+            return MatchBoolPrefixQuery(field, v.get("query"),
+                                        float(v.get("boost", 1.0)),
+                                        v.get("operator", "or"))
+        return MatchBoolPrefixQuery(field, v)
     if kind in ("query_string", "simple_query_string"):
         fields = spec.get("fields") or (
             [spec["default_field"]] if spec.get("default_field") else [])
@@ -1062,7 +1176,8 @@ def parse_query(body: Optional[dict]) -> Query:
         field, v = _single(spec, "range")
         return RangeQuery(field, gte=v.get("gte", v.get("from")), gt=v.get("gt"),
                           lte=v.get("lte", v.get("to")), lt=v.get("lt"),
-                          boost=float(v.get("boost", 1.0)))
+                          boost=float(v.get("boost", 1.0)),
+                          relation=v.get("relation", "intersects").lower())
     if kind == "exists":
         return ExistsQuery(spec["field"])
     if kind == "ids":

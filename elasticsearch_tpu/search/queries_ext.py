@@ -149,6 +149,53 @@ class GeoBoundingBoxQuery(Query):
             "bottom_right": {"lat": self.bottom, "lon": self.right}}}}
 
 
+class GeoShapeQuery(Query):
+    """`geo_shape` (reference: index/query/GeoShapeQueryBuilder.java).
+
+    Executes envelope relations against the indexed shapes' bounding boxes
+    (geo_shape fields store {shape, envelope} doc values — see
+    GeoShapeFieldMapper). Point-typed fields also match via their position.
+    """
+
+    def __init__(self, field: str, shape: dict, relation: str = "intersects"):
+        from elasticsearch_tpu.index.mapping import GeoShapeFieldMapper
+        self.field = field
+        self.relation = relation
+        self.query_env = GeoShapeFieldMapper("_query").coerce(shape)["envelope"]
+
+    def _relates(self, env) -> bool:
+        qmin_lon, qmin_lat, qmax_lon, qmax_lat = self.query_env
+        smin_lon, smin_lat, smax_lon, smax_lat = env
+        if self.relation == "within":
+            return (smin_lon >= qmin_lon and smax_lon <= qmax_lon
+                    and smin_lat >= qmin_lat and smax_lat <= qmax_lat)
+        if self.relation == "contains":
+            return (smin_lon <= qmin_lon and smax_lon >= qmax_lon
+                    and smin_lat <= qmin_lat and smax_lat >= qmax_lat)
+        intersects = (smin_lon <= qmax_lon and smax_lon >= qmin_lon
+                      and smin_lat <= qmax_lat and smax_lat >= qmin_lat)
+        if self.relation == "disjoint":
+            return not intersects
+        return intersects
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        from elasticsearch_tpu.search.queries import scan_doc_values
+
+        def match(shape) -> bool:
+            if isinstance(shape, dict) and "envelope" in shape:
+                return self._relates(tuple(shape["envelope"]))
+            if isinstance(shape, tuple) and len(shape) == 2:
+                lat, lon = shape  # geo_point doc value
+                return self._relates((lon, lat, lon, lat))
+            return False
+
+        return scan_doc_values(
+            ctx, ctx.mapper_service.resolve_field(self.field), match)
+
+    def to_dict(self):
+        return {"geo_shape": {self.field: {"relation": self.relation}}}
+
+
 class GeoPolygonQuery(Query):
     def __init__(self, field: str, points: List[Tuple[float, float]]):
         self.field = field
@@ -983,6 +1030,16 @@ def parse_extended(kind: str, spec: Any) -> Optional[Query]:
         tl = parse_geo_point(box["top_left"])
         br = parse_geo_point(box["bottom_right"])
         return GeoBoundingBoxQuery(field, tl[0], tl[1], br[0], br[1])
+    if kind == "geo_shape":
+        spec = dict(spec)
+        spec.pop("ignore_unmapped", None)
+        field, body = next(iter(spec.items()))
+        shape = body.get("shape")
+        if shape is None and "indexed_shape" in body:
+            raise ParsingError("[geo_shape] indexed_shape is not supported; "
+                               "inline the shape")
+        return GeoShapeQuery(field, shape,
+                             str(body.get("relation", "intersects")).lower())
     if kind == "geo_polygon":
         spec = dict(spec)
         spec.pop("validation_method", None)

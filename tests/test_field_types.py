@@ -1,0 +1,323 @@
+"""Extended field types (reference SURVEY.md §2.4 mapper inventory):
+binary, range family, completion, search_as_you_type, token_count, wildcard,
+flattened, constant_keyword, murmur3, histogram, annotated_text, geo_shape,
+sparse_vector, alias."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import MapperParsingError
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService, parse_wkt
+from elasticsearch_tpu.search.queries import SearchContext, parse_query
+
+MAPPING = {
+    "properties": {
+        "blob": {"type": "binary"},
+        "age_range": {"type": "integer_range"},
+        "temp_range": {"type": "float_range"},
+        "when": {"type": "date_range"},
+        "net": {"type": "ip_range"},
+        "suggest": {"type": "completion"},
+        "title": {"type": "search_as_you_type"},
+        "body_words": {"type": "token_count", "analyzer": "standard"},
+        "path": {"type": "wildcard"},
+        "attrs": {"type": "flattened"},
+        "env": {"type": "constant_keyword"},
+        "h": {"type": "murmur3"},
+        "latency": {"type": "histogram"},
+        "note": {"type": "annotated_text"},
+        "area": {"type": "geo_shape"},
+        "sparse": {"type": "sparse_vector"},
+        "byline": {"type": "alias", "path": "author"},
+        "author": {"type": "keyword"},
+        "views": {"type": "long"},
+    }
+}
+
+DOCS = {
+    "1": {"blob": "aGVsbG8=", "age_range": {"gte": 10, "lte": 20},
+          "temp_range": {"gt": 0.5, "lt": 1.5},
+          "when": {"gte": "2020-01-01", "lt": "2020-02-01"},
+          "net": "10.0.0.0/8",
+          "suggest": {"input": ["nevermind", "never say never"], "weight": 5},
+          "title": "quick brown fox", "body_words": "one two three",
+          "path": "/var/log/syslog", "attrs": {"color": "red",
+                                               "spec": {"ram": "16gb"}},
+          "env": "prod", "h": "abc",
+          "latency": {"values": [1.0, 5.0, 10.0], "counts": [3, 2, 1]},
+          "note": "visited [Berlin](Capital&City) today",
+          "area": {"type": "polygon", "coordinates":
+                   [[[0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [0.0, 10.0],
+                     [0.0, 0.0]]]},
+          "sparse": {"f1": 0.5, "f2": 2.0},
+          "author": "amy", "views": 10},
+    "2": {"age_range": {"gte": 15, "lte": 30}, "suggest": "nevada",
+          "title": "quiet black cat", "body_words": "one two",
+          "path": "/usr/bin/python", "attrs": {"color": "blue"},
+          "env": "prod", "net": {"gte": "192.168.0.1", "lte": "192.168.0.10"},
+          "area": {"type": "point", "coordinates": [50.0, 50.0]},
+          "author": "bob", "views": 20},
+}
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    e = Engine(str(tmp_path_factory.mktemp("ft") / "shard"),
+               MapperService(MAPPING))
+    for doc_id, d in DOCS.items():
+        e.index(doc_id, d)
+    e.refresh()
+    yield e
+    e.close()
+
+
+@pytest.fixture(scope="module")
+def ctx(engine):
+    return SearchContext(engine.acquire_searcher(), engine.mapper_service)
+
+
+def run(ctx, q):
+    ds = parse_query(q).execute(ctx)
+    return sorted(ctx.reader.get_id(int(r)) for r in ds.rows)
+
+
+# ------------------------------------------------------------------- binary
+
+def test_binary_stored_and_invalid_rejected(ctx):
+    assert ctx.reader.get_doc_value("blob", 0) == "aGVsbG8="
+    ms = MapperService({"properties": {"b": {"type": "binary"}}})
+    with pytest.raises(MapperParsingError):
+        ms.parse_document("x", {"b": "not base64!!!"})
+
+
+# -------------------------------------------------------------------- ranges
+
+def test_integer_range_term_membership(ctx):
+    assert run(ctx, {"term": {"age_range": 12}}) == ["1"]
+    assert run(ctx, {"term": {"age_range": 18}}) == ["1", "2"]
+    assert run(ctx, {"term": {"age_range": 25}}) == ["2"]
+    assert run(ctx, {"term": {"age_range": 99}}) == []
+
+
+def test_range_query_relations(ctx):
+    assert run(ctx, {"range": {"age_range": {"gte": 18, "lte": 40}}}) \
+        == ["1", "2"]  # intersects by default
+    assert run(ctx, {"range": {"age_range": {"gte": 5, "lte": 40,
+                                             "relation": "within"}}}) \
+        == ["1", "2"]
+    assert run(ctx, {"range": {"age_range": {"gte": 12, "lte": 18,
+                                             "relation": "contains"}}}) \
+        == ["1"]
+
+
+def test_float_range_exclusive_bounds(ctx):
+    assert run(ctx, {"term": {"temp_range": 0.5}}) == []  # gt excluded
+    assert run(ctx, {"term": {"temp_range": 1.0}}) == ["1"]
+
+
+def test_date_range(ctx):
+    assert run(ctx, {"term": {"when": "2020-01-15"}}) == ["1"]
+    assert run(ctx, {"term": {"when": "2020-02-01"}}) == []  # lt bound
+
+
+def test_ip_range_cidr(ctx):
+    assert run(ctx, {"term": {"net": "10.1.2.3"}}) == ["1"]
+    assert run(ctx, {"term": {"net": "192.168.0.5"}}) == ["2"]
+    assert run(ctx, {"term": {"net": "172.16.0.1"}}) == []
+
+
+# --------------------------------------------------------------- completion
+
+def test_completion_suggester(ctx):
+    from elasticsearch_tpu.search.extras import execute_suggest
+    out = execute_suggest(ctx, {"s": {"prefix": "nev",
+                                      "completion": {"field": "suggest"}}})
+    texts = [o["text"] for o in out["s"][0]["options"]]
+    assert "nevermind" in texts and "nevada" in texts
+    out = execute_suggest(ctx, {"s": {"prefix": "never s",
+                                      "completion": {"field": "suggest"}}})
+    assert [o["text"] for o in out["s"][0]["options"]] == ["never say never"]
+
+
+# ------------------------------------------------------- search_as_you_type
+
+def test_search_as_you_type_subfields_and_bool_prefix(ctx):
+    # shingle subfields exist and index shingles
+    assert run(ctx, {"match": {"title._2gram": "quick brown"}}) == ["1"]
+    assert run(ctx, {"match": {"title._3gram": "quick brown fox"}}) == ["1"]
+    # as-you-type: last token is a prefix
+    assert run(ctx, {"multi_match": {
+        "query": "quick bro", "type": "bool_prefix",
+        "fields": ["title", "title._2gram", "title._3gram"]}}) == ["1"]
+    assert run(ctx, {"match_bool_prefix": {"title": "qui"}}) == ["1", "2"]
+
+
+# ------------------------------------------------------------- token_count
+
+def test_token_count(ctx):
+    assert run(ctx, {"range": {"body_words": {"gte": 3}}}) == ["1"]
+    assert run(ctx, {"term": {"body_words": 2}}) == ["2"]
+
+
+# ----------------------------------------------------------------- wildcard
+
+def test_wildcard_field(ctx):
+    assert run(ctx, {"wildcard": {"path": "*syslog"}}) == ["1"]
+    assert run(ctx, {"wildcard": {"path": "/usr/*"}}) == ["2"]
+
+
+# ---------------------------------------------------------------- flattened
+
+def test_flattened_root_and_keyed(ctx):
+    assert run(ctx, {"term": {"attrs": "red"}}) == ["1"]       # any leaf
+    assert run(ctx, {"term": {"attrs.color": "blue"}}) == ["2"]
+    assert run(ctx, {"term": {"attrs.spec.ram": "16gb"}}) == ["1"]
+    assert run(ctx, {"term": {"attrs.color": "green"}}) == []
+
+
+def test_flattened_depth_limit():
+    ms = MapperService({"properties": {
+        "f": {"type": "flattened", "depth_limit": 1}}})
+    with pytest.raises(MapperParsingError):
+        ms.parse_document("x", {"f": {"a": {"b": {"c": "deep"}}}})
+
+
+# --------------------------------------------------------- constant_keyword
+
+def test_constant_keyword(ctx):
+    assert run(ctx, {"term": {"env": "prod"}}) == ["1", "2"]
+    ms = MapperService({"properties": {
+        "e": {"type": "constant_keyword", "value": "prod"}}})
+    with pytest.raises(MapperParsingError):
+        ms.parse_document("x", {"e": "staging"})
+
+
+# ------------------------------------------------------------------ murmur3
+
+def test_murmur3_hash_stored(ctx):
+    v = ctx.reader.get_doc_value("h", 0)
+    assert isinstance(v, int) and -(1 << 31) <= v < (1 << 31)
+
+
+# ---------------------------------------------------------------- histogram
+
+def test_histogram_validation(ctx):
+    assert ctx.reader.get_doc_value("latency", 0) == {
+        "values": [1.0, 5.0, 10.0], "counts": [3, 2, 1]}
+    ms = MapperService({"properties": {"l": {"type": "histogram"}}})
+    with pytest.raises(MapperParsingError):
+        ms.parse_document("x", {"l": {"values": [2.0, 1.0], "counts": [1, 1]}})
+    with pytest.raises(MapperParsingError):
+        ms.parse_document("x", {"l": {"values": [1.0], "counts": [1, 2]}})
+
+
+# ----------------------------------------------------------- annotated_text
+
+def test_annotated_text_indexes_annotations(ctx):
+    assert run(ctx, {"match": {"note": "berlin"}}) == ["1"]   # visible text
+    assert run(ctx, {"match": {"note": "capital"}}) == ["1"]  # annotation
+
+
+# ---------------------------------------------------------------- geo_shape
+
+def test_geo_shape_relations(ctx):
+    q = {"geo_shape": {"area": {"shape": {
+        "type": "envelope", "coordinates": [[5.0, 8.0], [8.0, 5.0]]},
+        "relation": "intersects"}}}
+    assert run(ctx, q) == ["1"]
+    q = {"geo_shape": {"area": {"shape": {
+        "type": "envelope", "coordinates": [[40.0, 60.0], [60.0, 40.0]]}}}}
+    assert run(ctx, q) == ["2"]  # point inside envelope
+    q = {"geo_shape": {"area": {"shape": {
+        "type": "envelope", "coordinates": [[-20.0, 30.0], [30.0, -20.0]]},
+        "relation": "within"}}}
+    assert run(ctx, q) == ["1"]
+    q = {"geo_shape": {"area": {"shape": {
+        "type": "envelope", "coordinates": [[80.0, 90.0], [90.0, 80.0]]},
+        "relation": "disjoint"}}}
+    assert run(ctx, q) == ["1", "2"]
+
+
+def test_wkt_parsing():
+    assert parse_wkt("POINT (30 10)") == {"type": "point",
+                                          "coordinates": [30.0, 10.0]}
+    env = parse_wkt("ENVELOPE(-10, 10, 20, -20)")
+    assert env == {"type": "envelope",
+                   "coordinates": [[-10.0, 20.0], [10.0, -20.0]]}
+    poly = parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 0))")
+    assert poly["type"] == "polygon" and len(poly["coordinates"][0]) == 4
+
+
+# ------------------------------------------------------------ sparse_vector
+
+def test_sparse_vector_stored(ctx):
+    assert ctx.reader.get_doc_value("sparse", 0) == {"f1": 0.5, "f2": 2.0}
+
+
+# -------------------------------------------------------------------- alias
+
+def test_alias_resolves_in_queries_and_aggs(ctx):
+    assert run(ctx, {"term": {"byline": "amy"}}) == ["1"]
+    assert run(ctx, {"exists": {"field": "byline"}}) == ["1", "2"]
+    from elasticsearch_tpu.search.aggregations import numeric_values
+    import numpy as np
+    # alias to a numeric field flows through aggregations
+    ms = ctx.mapper_service
+    assert ms.get("byline").type_name == "keyword"
+    assert ms.resolve_field("byline") == "author"
+
+
+def test_multivalued_range_array(tmp_path):
+    """Arrays of dict field values must index as multiple values, not be
+    misrouted to object parsing."""
+    e = Engine(str(tmp_path / "s"), MapperService({"properties": {
+        "r": {"type": "integer_range"}}}))
+    e.index("1", {"r": [{"gte": 1, "lte": 2}, {"gte": 5, "lte": 6}]})
+    e.refresh()
+    c = SearchContext(e.acquire_searcher(), e.mapper_service)
+    assert [c.reader.get_id(int(x)) for x in
+            parse_query({"term": {"r": 5}}).execute(c).rows] == ["1"]
+    assert parse_query({"term": {"r": 3}}).execute(c).rows.size == 0
+    # no bogus dynamic fields from the dict bounds
+    assert e.mapper_service.get("r.gte") is None
+    e.close()
+
+
+def test_constant_keyword_query_does_not_fix_value():
+    ms = MapperService({"properties": {"e": {"type": "constant_keyword"}}})
+    mapper = ms.get("e")
+    assert mapper.index_terms("staging") == ["staging"]  # query coercion
+    assert mapper.params.get("value") is None            # mapping unchanged
+    ms.parse_document("1", {"e": "prod"})                # first doc fixes it
+    assert mapper.params["value"] == "prod"
+
+
+def test_prefix_and_match_through_alias(tmp_path):
+    e = Engine(str(tmp_path / "s"), MapperService({"properties": {
+        "name": {"type": "keyword"},
+        "desc": {"type": "text"},
+        "name_alias": {"type": "alias", "path": "name"},
+        "desc_alias": {"type": "alias", "path": "desc"}}}))
+    e.index("1", {"name": "falcon", "desc": "a fast bird"})
+    e.refresh()
+    c = SearchContext(e.acquire_searcher(), e.mapper_service)
+
+    def ids(q):
+        return [c.reader.get_id(int(x))
+                for x in parse_query(q).execute(c).rows]
+
+    assert ids({"prefix": {"name_alias": "fal"}}) == ["1"]
+    assert ids({"wildcard": {"name_alias": "*con"}}) == ["1"]
+    ds = parse_query({"match": {"desc_alias": "fast"}}).execute(c)
+    ds2 = parse_query({"match": {"desc": "fast"}}).execute(c)
+    assert ds.rows.tolist() == ds2.rows.tolist()
+    assert ds.scores.tolist() == ds2.scores.tolist()  # same BM25 stats
+    e.close()
+
+
+def test_alias_write_rejected():
+    ms = MapperService({"properties": {
+        "a": {"type": "keyword"},
+        "al": {"type": "alias", "path": "a"}}})
+    with pytest.raises(MapperParsingError):
+        ms.parse_document("x", {"al": "boom"})
