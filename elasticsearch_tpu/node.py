@@ -132,6 +132,10 @@ class Node:
         from elasticsearch_tpu.ml import DatafeedService, MlService
         self.ml = MlService(self)
         self.datafeeds = DatafeedService(self)
+        from elasticsearch_tpu.xpack.enrich import attach_enrich
+        from elasticsearch_tpu.xpack.graph import GraphService
+        self.enrich = attach_enrich(self)
+        self.graph = GraphService(self)
         self.start_time = time.time()
 
     # ------------------------------------------------------------- documents

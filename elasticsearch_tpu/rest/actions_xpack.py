@@ -329,6 +329,40 @@ def register_xpack(rc: RestController, node: Node) -> None:
 
     _register_ml(rc, node)
 
+    # --------------------------------------------------------------- enrich
+    def enrich_put(req):
+        node.enrich.put_policy(req.params["name"], req.json() or {})
+        return 200, {"acknowledged": True}
+
+    def enrich_get(req):
+        return 200, node.enrich.get_policy(req.params.get("name"))
+
+    def enrich_delete(req):
+        node.enrich.delete_policy(req.params["name"])
+        return 200, {"acknowledged": True}
+
+    def enrich_execute(req):
+        return 200, node.enrich.execute_policy(req.params["name"])
+
+    def enrich_stats(req):
+        return 200, {"executing_policies": [],
+                     "coordinator_stats": [],
+                     "executed_count": node.enrich.stats["executed"]}
+
+    rc.register("PUT", "/_enrich/policy/{name}", enrich_put)
+    rc.register("GET", "/_enrich/policy/{name}", enrich_get)
+    rc.register("GET", "/_enrich/policy", enrich_get)
+    rc.register("DELETE", "/_enrich/policy/{name}", enrich_delete)
+    rc.register("POST", "/_enrich/policy/{name}/_execute", enrich_execute)
+    rc.register("GET", "/_enrich/_stats", enrich_stats)
+
+    # ---------------------------------------------------------------- graph
+    def graph_explore(req):
+        return 200, node.graph.explore(req.params["index"], req.json() or {})
+
+    rc.register("POST", "/{index}/_graph/explore", graph_explore)
+    rc.register("GET", "/{index}/_graph/explore", graph_explore)
+
 
 def _register_ml(rc: RestController, node: Node) -> None:
     """REST surface of `x-pack/plugin/ml/.../rest/` (job/, datafeeds/,

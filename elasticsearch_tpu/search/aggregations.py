@@ -113,6 +113,13 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
     missing = spec.get("missing")
     script = spec.get("script")
 
+    if kind == "string_stats":
+        return compute_string_stats(ctx, rows, spec)
+    if kind == "top_metrics":
+        return compute_top_metrics(ctx, rows, spec)
+    if kind == "matrix_stats":
+        return compute_matrix_stats(ctx, rows, spec)
+
     if kind == "top_hits":
         size = int(spec.get("size", 3))
         hits = []
@@ -204,7 +211,141 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
         both = vp & wp
         den = wv[both].sum()
         return {"value": float((vv[both] * wv[both]).sum() / den) if den else None}
+    if kind == "boxplot":
+        # reference: x-pack/plugin/analytics BoxplotAggregator
+        v = vals[present]
+        if len(v) == 0:
+            return {"min": None, "max": None, "q1": None, "q2": None,
+                    "q3": None, "lower": None, "upper": None}
+        q1, q2, q3 = (float(np.percentile(v, p)) for p in (25, 50, 75))
+        iqr = q3 - q1
+        inside = v[(v >= q1 - 1.5 * iqr) & (v <= q3 + 1.5 * iqr)]
+        return {"min": float(v.min()), "max": float(v.max()),
+                "q1": q1, "q2": q2, "q3": q3,
+                "lower": float(inside.min()) if len(inside) else q1,
+                "upper": float(inside.max()) if len(inside) else q3}
     raise ParsingError(f"unknown metric aggregation [{kind}]")
+
+
+def compute_string_stats(ctx: SearchContext, rows: np.ndarray,
+                         spec: dict) -> dict:
+    """reference: x-pack/plugin/analytics StringStatsAggregator."""
+    values = [str(v) for _, v in all_values(ctx, rows, spec.get("field"))]
+    if not values:
+        return {"count": 0, "min_length": None, "max_length": None,
+                "avg_length": None, "entropy": 0.0}
+    lengths = [len(v) for v in values]
+    freq: Dict[str, int] = {}
+    total_chars = 0
+    for v in values:
+        for ch in v:
+            freq[ch] = freq.get(ch, 0) + 1
+            total_chars += 1
+    entropy = 0.0
+    for c in freq.values():
+        p = c / total_chars
+        entropy -= p * math.log2(p)
+    out = {"count": len(values), "min_length": min(lengths),
+           "max_length": max(lengths),
+           "avg_length": sum(lengths) / len(lengths),
+           "entropy": round(entropy, 10)}
+    if spec.get("show_distribution"):
+        out["distribution"] = {ch: c / total_chars
+                               for ch, c in sorted(freq.items())}
+    return out
+
+
+def compute_top_metrics(ctx: SearchContext, rows: np.ndarray,
+                        spec: dict) -> dict:
+    """reference: x-pack/plugin/analytics TopMetricsAggregator — the metric
+    values of the top-N docs by a sort key."""
+    metrics = spec.get("metrics", [])
+    if isinstance(metrics, dict):
+        metrics = [metrics]
+    sort_spec = spec.get("sort", [{"_doc": "asc"}])
+    if isinstance(sort_spec, (str, dict)):
+        sort_spec = [sort_spec]
+    size = int(spec.get("size", 1))
+    entry = sort_spec[0]
+    if isinstance(entry, str):
+        sort_field, order = entry, "asc"
+    else:
+        sort_field, order = next(iter(entry.items()))
+        if isinstance(order, dict):
+            order = order.get("order", "asc")
+    if sort_field == "_doc":
+        keys = rows.astype(np.float64)
+        kp = np.ones(len(rows), dtype=bool)
+    else:
+        keys, kp = numeric_values(ctx, rows, sort_field)
+    idx = np.nonzero(kp)[0]
+    idx = idx[np.argsort(keys[idx], kind="stable")]
+    if order == "desc":
+        idx = idx[::-1]
+    top = []
+    for i in idx[:size]:
+        row = int(rows[i])
+        mvals = {}
+        for m in metrics:
+            mf = m.get("field")
+            v = ctx.reader.get_doc_value(ctx.mapper_service.resolve_field(mf),
+                                         row)
+            if isinstance(v, list):
+                v = v[0] if v else None
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                mvals[mf] = float(v)
+            else:
+                mvals[mf] = v
+        top.append({"sort": [float(keys[i])], "metrics": mvals})
+    return {"top": top}
+
+
+def compute_matrix_stats(ctx: SearchContext, rows: np.ndarray,
+                         spec: dict) -> dict:
+    """reference: modules/aggs-matrix-stats MatrixStatsAggregator —
+    per-field moments + pairwise covariance/correlation."""
+    fields = spec.get("fields", [])
+    cols = {}
+    presents = {}
+    for f in fields:
+        cols[f], presents[f] = numeric_values(ctx, rows, f)
+    # rows where every field is present (reference: listwise deletion)
+    if fields:
+        mask = np.logical_and.reduce([presents[f] for f in fields])
+    else:
+        mask = np.zeros(0, dtype=bool)
+    n = int(mask.sum())
+    if n == 0:
+        return {"doc_count": 0, "fields": []}
+    # one pass of per-field moments, then symmetric pairwise products
+    stats = {}
+    for f in fields:
+        v = cols[f][mask]
+        mean = float(v.mean())
+        centered = v - mean
+        var = float((centered ** 2).sum() / (n - 1)) if n > 1 else 0.0
+        stats[f] = (mean, centered, var, math.sqrt(var))
+    cov: Dict[str, Dict[str, float]] = {f: {} for f in fields}
+    for i, f in enumerate(fields):
+        for g in fields[i:]:
+            c = float((stats[f][1] * stats[g][1]).sum() / (n - 1)) \
+                if n > 1 else 0.0
+            cov[f][g] = cov[g][f] = c
+    out_fields = []
+    for f in fields:
+        mean, centered, var, sd = stats[f]
+        skew = float(((centered / sd) ** 3).mean()) if sd else 0.0
+        kurt = float(((centered / sd) ** 4).mean()) if sd else 0.0
+        corr = {}
+        for g in fields:
+            sd_g = stats[g][3]
+            corr[g] = (cov[f][g] / (sd * sd_g)) if sd and sd_g else (
+                1.0 if f == g else 0.0)
+        out_fields.append({"name": f, "count": n, "mean": mean,
+                           "variance": var, "skewness": skew,
+                           "kurtosis": kurt, "covariance": cov[f],
+                           "correlation": corr})
+    return {"doc_count": n, "fields": out_fields}
 
 
 def _hashable(v):
@@ -223,9 +364,11 @@ BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
 METRIC_AGGS = {"avg", "sum", "min", "max", "stats", "extended_stats", "value_count",
                "cardinality", "percentiles", "percentile_ranks", "top_hits",
                "weighted_avg", "median_absolute_deviation", "geo_bounds",
-               "geo_centroid"}
+               "geo_centroid", "boxplot", "string_stats", "top_metrics",
+               "matrix_stats"}
 PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
-                 "stats_bucket", "derivative", "cumulative_sum", "bucket_script",
+                 "stats_bucket", "extended_stats_bucket", "percentiles_bucket",
+                 "derivative", "cumulative_sum", "bucket_script",
                  "bucket_selector", "bucket_sort", "serial_diff", "moving_fn"}
 
 
@@ -762,6 +905,16 @@ def _compute_pipeline(outputs: dict, kind: str, spec: dict, name: str = "") -> A
             return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
         return {"count": len(present), "min": min(present), "max": max(present),
                 "avg": sum(present) / len(present), "sum": sum(present)}
+    if kind == "extended_stats_bucket":
+        arr = np.asarray(present, dtype=np.float64)
+        return _extended_stats(arr, np.ones(len(arr), dtype=bool),
+                               float(spec.get("sigma", 2.0)))
+    if kind == "percentiles_bucket":
+        pcts = spec.get("percents", [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0])
+        arr = np.asarray(present, dtype=np.float64)
+        return {"values": {f"{float(p)}":
+                           (float(np.percentile(arr, p)) if len(arr) else None)
+                           for p in pcts}}
     if kind == "cumulative_sum":
         total = 0.0
         for b, v in zip(buckets, values):

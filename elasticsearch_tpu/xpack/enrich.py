@@ -1,0 +1,213 @@
+"""Enrich: lookup policies + the `enrich` ingest processor.
+
+Reference: `x-pack/plugin/enrich` (4.1k LoC) — `EnrichPolicy` (match /
+geo_match types), `EnrichPolicyRunner` (executes a policy by reindexing the
+source into a hidden `.enrich-*` lookup index), `EnrichProcessorFactory` /
+`MatchProcessor` (ingest-time joins against the lookup index).
+
+Here the policy execution materializes the lookup both as a hidden
+`.enrich-{policy}` index (inspectable, like the reference) and as an
+in-memory exact-match table the processor reads; geo_match policies match
+by envelope containment against geo_shape values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    ResourceAlreadyExistsError,
+    ResourceNotFoundError,
+    ValidationError,
+)
+
+
+class EnrichService:
+    def __init__(self, node):
+        self.node = node
+        self.policies: Dict[str, dict] = {}
+        # policy -> match_value(str) -> enrich doc
+        self.lookups: Dict[str, Dict[str, dict]] = {}
+        # policy -> [(envelope, enrich doc)] for geo_match
+        self.geo_lookups: Dict[str, List[tuple]] = {}
+        self.stats = {"executed": 0}
+
+    def put_policy(self, name: str, body: dict) -> None:
+        if name in self.policies:
+            raise ResourceAlreadyExistsError(
+                f"policy [{name}] already exists")
+        ptype = "match" if "match" in body else (
+            "geo_match" if "geo_match" in body else None)
+        if ptype is None:
+            raise ValidationError(
+                "policy must define [match] or [geo_match]")
+        spec = body[ptype]
+        for req in ("indices", "match_field", "enrich_fields"):
+            if not spec.get(req):
+                raise ValidationError(f"policy requires [{req}]")
+        self.policies[name] = {"name": name, "type": ptype, **spec}
+
+    def get_policy(self, name: Optional[str] = None) -> dict:
+        if name and name not in ("*", "_all"):
+            if name not in self.policies:
+                raise ResourceNotFoundError(f"policy [{name}] not found")
+            items = [self.policies[name]]
+        else:
+            items = [self.policies[k] for k in sorted(self.policies)]
+        return {"policies": [{"config": {p["type"]: {
+            "name": p["name"], "indices": p["indices"],
+            "match_field": p["match_field"],
+            "enrich_fields": p["enrich_fields"]}}} for p in items]}
+
+    def delete_policy(self, name: str) -> None:
+        if name not in self.policies:
+            raise ResourceNotFoundError(f"policy [{name}] not found")
+        del self.policies[name]
+        self.lookups.pop(name, None)
+        self.geo_lookups.pop(name, None)
+
+    def execute_policy(self, name: str) -> dict:
+        """Materialize the lookup (reference: EnrichPolicyRunner.run)."""
+        policy = self.policies.get(name)
+        if policy is None:
+            raise ResourceNotFoundError(f"policy [{name}] not found")
+        indices = policy["indices"]
+        index_expr = ",".join(indices) if isinstance(indices, list) else indices
+        match_field = policy["match_field"]
+        keep = set(policy["enrich_fields"]) | {match_field}
+        table: Dict[str, dict] = {}
+        geo_table: List[tuple] = []
+        count = 0
+        sources: List[dict] = []
+        # page the full source per index (reference: EnrichPolicyRunner
+        # reindexes everything); _doc paging is only stable within one index
+        for svc in self.node.indices.resolve(index_expr):
+            search_after = None
+            while True:
+                b = {"query": {"match_all": {}}, "size": 1000,
+                     "sort": [{"_doc": {"order": "asc"}}]}
+                if search_after is not None:
+                    b["search_after"] = search_after
+                resp = self.node.search(svc.name, b)
+                hits = resp["hits"]["hits"]
+                if not hits:
+                    break
+                sources.extend(h["_source"] for h in hits)
+                search_after = hits[-1]["sort"]
+        for src in sources:
+            enrich_doc = {k: v for k, v in src.items() if k in keep}
+            mv = src.get(match_field)
+            if mv is None:
+                continue
+            if policy["type"] == "geo_match":
+                from elasticsearch_tpu.index.mapping import (
+                    GeoShapeFieldMapper)
+                try:
+                    env = GeoShapeFieldMapper(match_field).coerce(mv)["envelope"]
+                except Exception:
+                    continue
+                geo_table.append((env, enrich_doc))
+            else:
+                for v in (mv if isinstance(mv, list) else [mv]):
+                    table[str(v)] = enrich_doc
+            count += 1
+        self.lookups[name] = table
+        self.geo_lookups[name] = geo_table
+        # hidden lookup index, recreated per execution like the reference
+        lookup_index = f".enrich-{name}"
+        if self.node.indices.exists(lookup_index):
+            self.node.indices.delete_index(lookup_index)
+        for key, doc in table.items():
+            self.node.index_doc(lookup_index, None,
+                                {"_match": key, **doc})
+        if self.node.indices.exists(lookup_index):
+            self.node.indices.get(lookup_index).refresh()
+        self.stats["executed"] += 1
+        return {"status": {"phase": "COMPLETE"},
+                "task": None, "documents": count}
+
+    def lookup(self, name: str, value) -> List[dict]:
+        policy = self.policies.get(name)
+        if policy is None:
+            raise ResourceNotFoundError(f"policy [{name}] not found")
+        if policy["type"] == "geo_match":
+            try:
+                lat, lon = _as_point(value)
+            except Exception:
+                return []
+            out = []
+            for (min_lon, min_lat, max_lon, max_lat), doc in \
+                    self.geo_lookups.get(name, []):
+                if min_lon <= lon <= max_lon and min_lat <= lat <= max_lat:
+                    out.append(doc)
+            return out
+        doc = self.lookups.get(name, {}).get(str(value))
+        return [doc] if doc is not None else []
+
+
+def _as_point(value):
+    if isinstance(value, dict):
+        return float(value["lat"]), float(value["lon"])
+    if isinstance(value, (list, tuple)) and len(value) == 2:
+        return float(value[1]), float(value[0])
+    parts = str(value).split(",")
+    return float(parts[0]), float(parts[1])
+
+
+# ---------------------------------------------------------------------------
+# ingest processor
+# ---------------------------------------------------------------------------
+
+class EnrichProcessorImpl:
+    """Registered once; resolves the owning node's EnrichService at run time
+    through the per-node IngestService (passed to processors as the pipeline
+    registry), so multiple Nodes in one process each enrich against their
+    own policies."""
+
+    @staticmethod
+    def install() -> None:
+        from elasticsearch_tpu.ingest.service import (
+            IngestProcessorError, PROCESSORS, Processor, _get_path,
+            _set_path,
+        )
+        if "enrich" in PROCESSORS:
+            return
+
+        import copy
+
+        class EnrichProcessor(Processor):
+            kind = "enrich"
+
+            def run(self, ctx):
+                svc = getattr(getattr(self, "_registry", None),
+                              "enrich_service", None)
+                if svc is None:
+                    raise IngestProcessorError(
+                        "no enrich service attached to this node")
+                value = _get_path(ctx, self.field)
+                if value is None:
+                    if self.ignore_missing:
+                        return
+                    raise IngestProcessorError(
+                        f"field [{self.field}] not present")
+                matches = svc.lookup(self.spec["policy_name"], value)
+                if not matches:
+                    return
+                max_matches = int(self.spec.get("max_matches", 1))
+                target = self.spec["target_field"]
+                # deep-copy: the lookup table entries are shared across docs
+                if max_matches == 1:
+                    _set_path(ctx, target, copy.deepcopy(matches[0]))
+                else:
+                    _set_path(ctx, target,
+                              copy.deepcopy(matches[:max_matches]))
+
+        PROCESSORS[EnrichProcessor.kind] = EnrichProcessor
+
+
+def attach_enrich(node) -> EnrichService:
+    """Create the node's EnrichService and expose it to ingest pipelines."""
+    svc = EnrichService(node)
+    node.ingest.enrich_service = svc
+    EnrichProcessorImpl.install()
+    return svc

@@ -1,0 +1,106 @@
+"""Graph exploration API.
+
+Reference: `x-pack/plugin/graph` (1.3k LoC) — `TransportGraphExploreAction`
+runs an iterative crawl: seed query → significant terms per requested
+vertex field → follow-up queries on found terms to discover connected
+vertices, returned as a vertices[] + connections[] graph keyed by array
+index. Built here on the public search surface (terms aggregations), one
+hop per `connections` nesting level like the reference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ValidationError
+
+
+class GraphService:
+    def __init__(self, node):
+        self.node = node
+
+    def explore(self, index: str, body: dict) -> dict:
+        started = time.time()
+        query = body.get("query", {"match_all": {}})
+        vertex_specs = body.get("vertices", [])
+        if not vertex_specs:
+            raise ValidationError("graph explore requires [vertices]")
+        use_sig = bool(body.get("use_significance", True))
+
+        vertices: List[dict] = []          # {field, term, weight, depth}
+        vertex_index: Dict[Tuple[str, str], int] = {}
+        connections: List[dict] = []
+
+        def add_vertex(field: str, term: str, weight: float,
+                       depth: int) -> int:
+            key = (field, term)
+            if key in vertex_index:
+                return vertex_index[key]
+            vertex_index[key] = len(vertices)
+            vertices.append({"field": field, "term": term,
+                             "weight": weight, "depth": depth})
+            return vertex_index[key]
+
+        # depth 0: seed terms from the query
+        seeds: List[int] = []
+        for spec in vertex_specs:
+            for term, count, weight in self._top_terms(
+                    index, query, spec, use_sig):
+                seeds.append(add_vertex(spec["field"], term, weight, 0))
+
+        # one hop per connections level (reference: Hop chaining)
+        frontier = list(dict.fromkeys(seeds))
+        depth = 1
+        conn_body = body.get("connections")
+        while conn_body and frontier:
+            conn_specs = conn_body.get("vertices", [])
+            next_frontier: List[int] = []
+            frontier_seen: set = set()
+            for src_idx in frontier:
+                src = vertices[src_idx]
+                hop_query = {"bool": {"filter": [
+                    {"term": {src["field"]: src["term"]}}]}}
+                for spec in conn_specs:
+                    for term, count, weight in self._top_terms(
+                            index, hop_query, spec, use_sig):
+                        if (spec["field"], term) == (src["field"],
+                                                     src["term"]):
+                            continue
+                        tgt_idx = add_vertex(spec["field"], term, weight,
+                                             depth)
+                        connections.append({"source": src_idx,
+                                            "target": tgt_idx,
+                                            "weight": weight,
+                                            "doc_count": count})
+                        if vertices[tgt_idx]["depth"] == depth \
+                                and tgt_idx not in frontier_seen:
+                            frontier_seen.add(tgt_idx)
+                            next_frontier.append(tgt_idx)
+            frontier = next_frontier
+            conn_body = conn_body.get("connections")
+            depth += 1
+
+        return {"took": int((time.time() - started) * 1000),
+                "timed_out": False,
+                "failures": [],
+                "vertices": vertices,
+                "connections": connections}
+
+    def _top_terms(self, index: str, query: dict, spec: dict,
+                   use_sig: bool) -> List[Tuple[str, int, float]]:
+        field = spec["field"]
+        size = int(spec.get("size", 5))
+        min_doc_count = int(spec.get("min_doc_count", 1))
+        agg_kind = "significant_terms" if use_sig else "terms"
+        resp = self.node.search(index, {
+            "query": query, "size": 0,
+            "aggs": {"v": {agg_kind: {"field": field,
+                                      "size": size,
+                                      "min_doc_count": min_doc_count}}}})
+        out = []
+        for b in resp["aggregations"]["v"]["buckets"]:
+            count = int(b["doc_count"])
+            weight = float(b.get("score", count))
+            out.append((str(b["key"]), count, weight))
+        return out

@@ -1,0 +1,219 @@
+"""Analytics aggregations (boxplot/string_stats/top_metrics/matrix_stats),
+extended pipeline aggs, enrich policies + processor, graph explore.
+Reference: x-pack/plugin/analytics, modules/aggs-matrix-stats,
+x-pack/plugin/enrich, x-pack/plugin/graph."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def sales(node):
+    data = [
+        {"price": 10.0, "qty": 1, "name": "alpha", "cat": "a"},
+        {"price": 20.0, "qty": 2, "name": "beta", "cat": "a"},
+        {"price": 30.0, "qty": 3, "name": "gamma", "cat": "b"},
+        {"price": 40.0, "qty": 4, "name": "delta", "cat": "b"},
+        {"price": 1000.0, "qty": 5, "name": "epsilon", "cat": "b"},
+    ]
+    for i, d in enumerate(data):
+        node.index_doc("sales", str(i), d)
+    node.indices.get("sales").refresh()
+    return node
+
+
+def agg(node, body):
+    return node.search("sales", {"size": 0, "aggs": body})["aggregations"]
+
+
+def test_boxplot(sales):
+    out = agg(sales, {"b": {"boxplot": {"field": "price"}}})["b"]
+    assert out["min"] == 10.0 and out["max"] == 1000.0
+    assert out["q1"] == 20.0 and out["q2"] == 30.0 and out["q3"] == 40.0
+    assert out["upper"] == 40.0  # 1000 is an outlier beyond 1.5*IQR
+
+
+def test_string_stats(sales):
+    out = agg(sales, {"s": {"string_stats": {"field": "cat.keyword"}}})["s"]
+    assert out["count"] == 5
+    assert out["min_length"] == 1 and out["max_length"] == 1
+    assert out["entropy"] > 0.9  # 2/5 vs 3/5 split
+
+
+def test_top_metrics(sales):
+    out = agg(sales, {"t": {"top_metrics": {
+        "metrics": {"field": "qty"},
+        "sort": {"price": "desc"}, "size": 2}}})["t"]
+    assert [t["metrics"]["qty"] for t in out["top"]] == [5.0, 4.0]
+    assert out["top"][0]["sort"] == [1000.0]
+
+
+def test_matrix_stats(sales):
+    out = agg(sales, {"m": {"matrix_stats": {
+        "fields": ["price", "qty"]}}})["m"]
+    assert out["doc_count"] == 5
+    by_name = {f["name"]: f for f in out["fields"]}
+    assert by_name["qty"]["mean"] == 3.0
+    # price and qty are positively correlated
+    assert by_name["price"]["correlation"]["qty"] > 0.5
+    assert by_name["price"]["correlation"]["price"] == pytest.approx(1.0)
+
+
+def test_extended_stats_and_percentiles_bucket(sales):
+    out = agg(sales, {
+        "cats": {"terms": {"field": "cat.keyword"},
+                 "aggs": {"avg_p": {"avg": {"field": "price"}}}},
+        "es": {"extended_stats_bucket": {"buckets_path": "cats>avg_p"}},
+        "pb": {"percentiles_bucket": {"buckets_path": "cats>avg_p",
+                                      "percents": [50.0]}},
+    })
+    assert out["es"]["count"] == 2
+    assert out["es"]["avg"] == pytest.approx((15.0 + 1070.0 / 3) / 2)
+    assert out["pb"]["values"]["50.0"] is not None
+
+
+# ------------------------------------------------------------------- enrich
+
+def test_enrich_policy_and_processor(node):
+    for i, d in enumerate([
+            {"email": "amy@x.io", "name": "Amy", "title": "CTO"},
+            {"email": "bob@x.io", "name": "Bob", "title": "Dev"}]):
+        node.index_doc("users", str(i), d)
+    node.indices.get("users").refresh()
+
+    node.enrich.put_policy("users-policy", {"match": {
+        "indices": ["users"], "match_field": "email",
+        "enrich_fields": ["name", "title"]}})
+    result = node.enrich.execute_policy("users-policy")
+    assert result["documents"] == 2
+    assert node.indices.exists(".enrich-users-policy")
+
+    node.ingest.put_pipeline("add-user", {"processors": [
+        {"enrich": {"policy_name": "users-policy", "field": "author",
+                    "target_field": "user"}}]})
+    resp = node.index_doc("posts", "1", {"author": "amy@x.io", "t": "hi"},
+                          pipeline="add-user", refresh="true")
+    doc = node.get_doc("posts", "1")
+    assert doc["_source"]["user"] == {"email": "amy@x.io", "name": "Amy",
+                                      "title": "CTO"}
+    # no match → field untouched
+    node.index_doc("posts", "2", {"author": "zed@x.io"},
+                   pipeline="add-user", refresh="true")
+    assert "user" not in node.get_doc("posts", "2")["_source"]
+
+    pol = node.enrich.get_policy("users-policy")
+    assert pol["policies"][0]["config"]["match"]["match_field"] == "email"
+    node.enrich.delete_policy("users-policy")
+    from elasticsearch_tpu.common.errors import ResourceNotFoundError
+    with pytest.raises(ResourceNotFoundError):
+        node.enrich.get_policy("users-policy")
+
+
+def test_enrich_target_mutation_does_not_corrupt_lookup(node):
+    """Mutating the enriched target of one doc must not leak into the shared
+    lookup table or other docs."""
+    node.index_doc("users", "1", {"email": "a@x.io", "name": "Amy"},
+                   refresh="true")
+    node.enrich.put_policy("p", {"match": {
+        "indices": ["users"], "match_field": "email",
+        "enrich_fields": ["name"]}})
+    node.enrich.execute_policy("p")
+    node.ingest.put_pipeline("pl", {"processors": [
+        {"enrich": {"policy_name": "p", "field": "who",
+                    "target_field": "u"}},
+        {"set": {"field": "u.injected", "value": "x"}}]})
+    node.index_doc("d", "1", {"who": "a@x.io"}, pipeline="pl",
+                   refresh="true")
+    node.index_doc("d", "2", {"who": "a@x.io"}, pipeline="pl",
+                   refresh="true")
+    # second doc got a clean copy, and the lookup entry is untouched beyond
+    # its own injected set
+    assert node.get_doc("d", "2")["_source"]["u"] == {
+        "email": "a@x.io", "name": "Amy", "injected": "x"}
+    assert "injected" not in node.enrich.lookups["p"]["a@x.io"]
+
+
+def test_enrich_policy_pages_beyond_search_window(node):
+    """Policy execution must cover the whole source index, not one page."""
+    for i in range(1500):
+        node.index_doc("big", str(i), {"k": f"key{i}", "v": i})
+    node.indices.get("big").refresh()
+    node.enrich.put_policy("bigp", {"match": {
+        "indices": ["big"], "match_field": "k", "enrich_fields": ["v"]}})
+    out = node.enrich.execute_policy("bigp")
+    assert out["documents"] == 1500
+    assert node.enrich.lookup("bigp", "key1400")[0]["v"] == 1400
+
+
+def test_enrich_geo_match(node):
+    node.index_doc("zones", "1", {
+        "area": {"type": "envelope", "coordinates": [[0.0, 10.0], [10.0, 0.0]]},
+        "zone_name": "alpha-zone"})
+    node.indices.get("zones").refresh()
+    node.enrich.put_policy("geo-policy", {"geo_match": {
+        "indices": ["zones"], "match_field": "area",
+        "enrich_fields": ["zone_name"]}})
+    node.enrich.execute_policy("geo-policy")
+    hits = node.enrich.lookup("geo-policy", {"lat": 5.0, "lon": 5.0})
+    assert len(hits) == 1 and hits[0]["zone_name"] == "alpha-zone"
+    assert node.enrich.lookup("geo-policy", {"lat": 50.0, "lon": 50.0}) == []
+
+
+# -------------------------------------------------------------------- graph
+
+def test_graph_explore(node):
+    # people buy items; explore item→person→item co-purchase structure
+    purchases = [
+        ("p1", "guitar"), ("p1", "amp"), ("p2", "guitar"), ("p2", "amp"),
+        ("p3", "guitar"), ("p3", "drums"), ("p4", "piano"),
+    ]
+    for i, (person, item) in enumerate(purchases):
+        node.index_doc("orders", str(i), {"person": person, "item": item})
+    node.indices.get("orders").refresh()
+
+    resp = node.graph.explore("orders", {
+        "query": {"term": {"item.keyword": "guitar"}},
+        "vertices": [{"field": "person.keyword", "size": 5}],
+        "connections": {"vertices": [{"field": "item.keyword", "size": 5}]},
+        "use_significance": False,
+    })
+    assert not resp["timed_out"]
+    by_term = {(v["field"], v["term"]): v for v in resp["vertices"]}
+    # depth 0: guitar buyers
+    assert by_term[("person.keyword", "p1")]["depth"] == 0
+    assert by_term[("person.keyword", "p3")]["depth"] == 0
+    # depth 1: their other purchases
+    assert by_term[("item.keyword", "amp")]["depth"] == 1
+    assert by_term[("item.keyword", "drums")]["depth"] == 1
+    assert ("item.keyword", "piano") not in by_term  # unconnected
+    # connections reference vertex array indices
+    for c in resp["connections"]:
+        assert 0 <= c["source"] < len(resp["vertices"])
+        assert 0 <= c["target"] < len(resp["vertices"])
+    srcs = {resp["vertices"][c["source"]]["term"] for c in resp["connections"]}
+    assert {"p1", "p2", "p3"} <= srcs
+
+
+def test_graph_rest(node):
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    import json as _json
+    rc = RestController()
+    register_all(rc, node)
+    node.index_doc("g", "1", {"a": "x", "b": "y"}, refresh="true")
+    status, body = rc.dispatch(
+        "POST", "/g/_graph/explore", {},
+        _json.dumps({"query": {"match_all": {}},
+                     "vertices": [{"field": "a.keyword"}],
+                     "use_significance": False}).encode(),
+        "application/json")
+    assert status == 200 and body["vertices"][0]["term"] == "x"
