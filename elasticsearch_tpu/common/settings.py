@@ -25,6 +25,15 @@ _TIME_FACTORS = {"nanos": 1e-9, "micros": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0,
 _BYTE_FACTORS = {"b": 1, "kb": 1024, "mb": 1024**2, "gb": 1024**3, "tb": 1024**4, "pb": 1024**5}
 
 
+def setting_bool(value: Any, default: bool = False) -> bool:
+    """Boolean coercion with yml-style strings ("false" is False)."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return value
+    return str(value).strip().lower() in ("true", "1", "yes", "on")
+
+
 def parse_time_value(value: Any, setting_name: str = "") -> float:
     """Parse '30s' / '500ms' / '-1' into seconds (reference: TimeValue.java)."""
     if isinstance(value, (int, float)):

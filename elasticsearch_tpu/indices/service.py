@@ -161,6 +161,12 @@ class IndicesService:
             if os.path.exists(self._meta_path(name)):
                 self.open_index(name)
 
+    def update_settings(self, svc: IndexService, updates: Dict[str, Any]) -> None:
+        """Dynamic settings update + durable metadata write — in-memory-only
+        updates would silently lose state (e.g. index.frozen) on restart."""
+        svc.settings_update(updates)
+        self._persist_meta(svc)
+
     def _persist_meta(self, svc: IndexService) -> None:
         import json
         os.makedirs(os.path.dirname(self._meta_path(svc.name)), exist_ok=True)

@@ -136,6 +136,8 @@ class Node:
         from elasticsearch_tpu.xpack.graph import GraphService
         self.enrich = attach_enrich(self)
         self.graph = GraphService(self)
+        from elasticsearch_tpu.xpack.monitoring import MonitoringService
+        self.monitoring = MonitoringService(self)
         self.start_time = time.time()
 
     # ------------------------------------------------------------- documents
@@ -339,7 +341,8 @@ class Node:
             svc.refresh()
 
     # ---------------------------------------------------------------- search
-    def search(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
+    def search(self, index_expr: Optional[str], body: Optional[dict],
+               ignore_throttled: bool = True) -> dict:
         body = body or {}
         # cross-cluster search: split `alias:index` parts, fan out, merge
         # (reference: TransportSearchAction + SearchResponseMerger)
@@ -351,6 +354,13 @@ class Node:
             return merge_ccs_responses(local_resp, remote_resps, body)
         start = time.perf_counter()
         services = self.indices.resolve(index_expr)
+        if ignore_throttled:
+            # frozen indices sit out of normal searches unless the caller
+            # passes ignore_throttled=false (reference:
+            # x-pack/plugin/frozen-indices + search_throttled pool)
+            from elasticsearch_tpu.common.settings import setting_bool
+            services = [s for s in services
+                        if not setting_bool(s.settings.get("index.frozen"))]
         readers = []
         for svc in services:
             reader = svc.combined_reader()
@@ -454,14 +464,20 @@ class Node:
 
     # ----------------------------------------------------------------- scroll
     def search_scroll_start(self, index_expr: Optional[str], body: Optional[dict],
-                            keep_alive: str = "1m") -> dict:
+                            keep_alive: str = "1m",
+                            ignore_throttled: bool = True) -> dict:
         """Initial search with ?scroll=: snapshot all matching docs in order,
         return the first page + a scroll id."""
         body = dict(body or {})
         size = int(body.get("size", 10) if body.get("size") is not None else 10)
         entries = []  # (svc, reader, row, score, sort_values)
         total = 0
-        for svc in self.indices.resolve(index_expr):
+        from elasticsearch_tpu.common.settings import setting_bool
+        services = self.indices.resolve(index_expr)
+        if ignore_throttled:
+            services = [s for s in services
+                        if not setting_bool(s.settings.get("index.frozen"))]
+        for svc in services:
             reader = svc.combined_reader()
             store = _MultiShardVectorStore(svc)
             # scroll snapshots EVERY matching doc — deep pagination past the

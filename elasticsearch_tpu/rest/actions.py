@@ -164,9 +164,12 @@ def register_all(rc: RestController, node: Node) -> None:
                 for s in sort.split(",")]
         scroll = req.param("scroll")
         if scroll:
-            return 200, node.search_scroll_start(req.params.get("index"), body,
-                                                 keep_alive=scroll)
-        return 200, node.search(req.params.get("index"), body)
+            return 200, node.search_scroll_start(
+                req.params.get("index"), body, keep_alive=scroll,
+                ignore_throttled=req.bool_param("ignore_throttled", True))
+        return 200, node.search(req.params.get("index"), body,
+                                ignore_throttled=req.bool_param(
+                                    "ignore_throttled", True))
 
     rc.register("GET", "/_search", search)
     rc.register("POST", "/_search", search)

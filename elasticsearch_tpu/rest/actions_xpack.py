@@ -321,7 +321,7 @@ def register_xpack(rc: RestController, node: Node) -> None:
         body = req.json() or {}
         flat = _flatten_settings(body.get("settings", body))
         for svc in node.indices.resolve(req.params.get("index")):
-            svc.settings_update(flat)
+            node.indices.update_settings(svc, flat)
         return 200, {"acknowledged": True}
 
     rc.register("PUT", "/{index}/_settings", put_settings)
@@ -362,6 +362,37 @@ def register_xpack(rc: RestController, node: Node) -> None:
 
     rc.register("POST", "/{index}/_graph/explore", graph_explore)
     rc.register("GET", "/{index}/_graph/explore", graph_explore)
+
+    # ------------------------------------------------------- frozen indices
+    def freeze(req):
+        # reference: x-pack/plugin/frozen-indices TransportFreezeIndexAction
+        for svc in node.indices.resolve(req.params["index"]):
+            node.indices.update_settings(svc, {
+                "index.frozen": True, "index.search.throttled": True})
+        return 200, {"acknowledged": True}
+
+    def unfreeze(req):
+        for svc in node.indices.resolve(req.params["index"]):
+            node.indices.update_settings(svc, {
+                "index.frozen": False, "index.search.throttled": False})
+        return 200, {"acknowledged": True}
+
+    rc.register("POST", "/{index}/_freeze", freeze)
+    rc.register("POST", "/{index}/_unfreeze", unfreeze)
+
+    # ------------------------------------------------------------ monitoring
+    def monitoring_bulk(req):
+        return 200, node.monitoring.bulk(req.param("system_id"),
+                                         req.ndjson())
+
+    def monitoring_collect(req):
+        # explicit collection tick (the scheduler analog; see
+        # xpack/monitoring.py)
+        return 200, node.monitoring.collect()
+
+    rc.register("POST", "/_monitoring/bulk", monitoring_bulk)
+    rc.register("PUT", "/_monitoring/bulk", monitoring_bulk)
+    rc.register("POST", "/_monitoring/_collect", monitoring_collect)
 
 
 def _register_ml(rc: RestController, node: Node) -> None:

@@ -274,10 +274,12 @@ class IlmService:
                     took.append({"index": name, "action": "shrink",
                                  "target": target})
             elif action == "readonly":
-                svc.settings_update({"index.blocks.write": True})
+                self.node.indices.update_settings(
+                    svc, {"index.blocks.write": True})
                 took.append({"index": name, "action": "readonly"})
             elif action == "freeze":
-                svc.settings_update({"index.frozen": True})
+                self.node.indices.update_settings(
+                    svc, {"index.frozen": True})
                 took.append({"index": name, "action": "freeze"})
             elif action == "delete":
                 self.node.indices.delete_index(name)

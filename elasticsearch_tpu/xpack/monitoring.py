@@ -1,0 +1,135 @@
+"""Monitoring: ship cluster/node/index metrics into monitoring indices.
+
+Reference: `x-pack/plugin/monitoring` (8.2k LoC) — `MonitoringService`
+schedules `Collector`s (ClusterStatsCollector, NodeStatsCollector,
+IndexStatsCollector, …) on `xpack.monitoring.collection.interval`; the
+resulting `MonitoringDoc`s are written by the local exporter into
+`.monitoring-es-7-{date}` daily indices; external agents POST documents
+through `/_monitoring/bulk`.
+
+Here collection is an explicit `collect()` tick (the scheduler analog —
+tests/ops call it; a production deployment would timer-drive it), writing
+the same doc shapes into the same daily-index naming.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import resource
+from typing import List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+
+def _today_index() -> str:
+    return ".monitoring-es-7-" + _dt.datetime.now(
+        _dt.timezone.utc).strftime("%Y.%m.%d")
+
+
+class MonitoringService:
+    def __init__(self, node):
+        from elasticsearch_tpu.common.settings import setting_bool
+        self.node = node
+        self.collection_enabled = setting_bool(
+            node.settings.get("xpack.monitoring.collection.enabled"), True)
+        self.collected = 0
+
+    # ------------------------------------------------------------ collectors
+    def _cluster_stats_doc(self) -> dict:
+        n = self.node
+        total_docs = sum(s.doc_count() for s in n.indices.indices.values())
+        return {"type": "cluster_stats",
+                "cluster_stats": {
+                    "indices": {"count": len(n.indices.indices),
+                                "docs": {"count": total_docs}},
+                    "nodes": {"count": {"total": 1}}},
+                "license": {"status": "active", "type": "basic"},
+                "version": 1}
+
+    def _node_stats_doc(self) -> dict:
+        n = self.node
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        return {"type": "node_stats",
+                "node_stats": {
+                    "node_id": n.node_id,
+                    "indices": {
+                        "docs": {"count": sum(
+                            s.doc_count()
+                            for s in n.indices.indices.values())},
+                        "search": {"query_total":
+                                   n.counters.get("search", 0)},
+                        "indexing": {"index_total":
+                                     n.counters.get("index", 0)}},
+                    "jvm": {"mem": {"heap_used_in_bytes":
+                                    usage.ru_maxrss * 1024}},
+                    "process": {"cpu": {"percent": 0}}}}
+
+    def _index_stats_docs(self) -> List[dict]:
+        out = []
+        for name, svc in self.node.indices.indices.items():
+            if name.startswith(".monitoring-"):
+                continue
+            out.append({"type": "index_stats",
+                        "index_stats": {
+                            "index": name,
+                            "docs": {"count": svc.doc_count()},
+                            "primaries": {"docs": {"count":
+                                                   svc.doc_count()}}}})
+        return out
+
+    # ----------------------------------------------------------------- tick
+    def collect(self) -> dict:
+        """One collection interval (reference: MonitoringService.execute)."""
+        if not self.collection_enabled:
+            return {"collected": 0, "enabled": False}
+        docs = [self._cluster_stats_doc(), self._node_stats_doc()]
+        docs.extend(self._index_stats_docs())
+        index = _today_index()
+        ts = _dt.datetime.now(_dt.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.000Z")
+        for doc in docs:
+            doc.update({"cluster_uuid": self.node.node_id,
+                        "timestamp": ts,
+                        "interval_ms": 10000,
+                        "source_node": {"uuid": self.node.node_id,
+                                        "name": self.node.node_name}})
+            self.node.index_doc(index, None, doc)
+        if self.node.indices.exists(index):
+            self.node.indices.get(index).refresh()
+        self.collected += len(docs)
+        return {"collected": len(docs), "enabled": True, "index": index}
+
+    # ------------------------------------------------------- /_monitoring/bulk
+    def bulk(self, system_id: Optional[str], lines: List[dict]) -> dict:
+        """External agents ship docs (reference: RestMonitoringBulkAction —
+        alternating metadata/doc lines like _bulk)."""
+        if not system_id:
+            raise IllegalArgumentError(
+                "no [system_id] for monitoring bulk request")
+        index = _today_index()
+        ts = _dt.datetime.now(_dt.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.000Z")
+        ignored = 0
+        count = 0
+        # strict meta/doc pairing: a bad metadata line drops its doc too,
+        # never shifting the pairing frame (reference:
+        # RestMonitoringBulkAction skips the pair)
+        for j in range(0, len(lines) - len(lines) % 2, 2):
+            meta, payload = lines[j], lines[j + 1]
+            if not isinstance(meta, dict) \
+                    or not isinstance(meta.get("index"), dict) \
+                    or not isinstance(payload, dict):
+                ignored += 1
+                continue
+            doc = dict(payload)
+            doc.setdefault("timestamp", ts)
+            doc["cluster_uuid"] = self.node.node_id
+            doc["type"] = meta["index"].get("_type", system_id)
+            self.node.index_doc(index, None, doc)
+            count += 1
+        if len(lines) % 2:
+            ignored += 1  # trailing unpaired line
+        if count and self.node.indices.exists(index):
+            self.node.indices.get(index).refresh()
+        return {"took": 0, "ignored": ignored > 0, "errors": False,
+                "indexed": count}

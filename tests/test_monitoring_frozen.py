@@ -1,0 +1,180 @@
+"""Monitoring collection/shipping, frozen indices, deprecation API.
+Reference: x-pack/plugin/monitoring, x-pack/plugin/frozen-indices,
+x-pack deprecation checks."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.actions import register_all
+from elasticsearch_tpu.rest.controller import RestController
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+@pytest.fixture
+def client(node):
+    class Client:
+        def __init__(self):
+            self.rc = RestController()
+            register_all(self.rc, node)
+
+        def req(self, method, path, body=None, **query):
+            raw = b""
+            if body is not None:
+                if isinstance(body, (list, tuple)):
+                    raw = b"\n".join(json.dumps(l).encode()
+                                     for l in body) + b"\n"
+                else:
+                    raw = json.dumps(body).encode()
+            q = {k: str(v) for k, v in query.items()}
+            return self.rc.dispatch(method, path, q, raw, "application/json")
+    return Client()
+
+
+def test_monitoring_collect(node):
+    node.index_doc("logs", "1", {"m": "x"}, refresh="true")
+    out = node.monitoring.collect()
+    assert out["enabled"] and out["collected"] == 3  # cluster+node+index
+    resp = node.search(out["index"], {
+        "query": {"term": {"type.keyword": "index_stats"}}, "size": 10})
+    hits = resp["hits"]["hits"]
+    assert len(hits) == 1
+    assert hits[0]["_source"]["index_stats"]["index"] == "logs"
+    assert hits[0]["_source"]["index_stats"]["docs"]["count"] == 1
+    # node_stats doc carries counters
+    resp = node.search(out["index"], {
+        "query": {"term": {"type.keyword": "node_stats"}}})
+    assert resp["hits"]["hits"][0]["_source"]["node_stats"]["node_id"] \
+        == node.node_id
+
+
+def test_monitoring_collect_disabled(tmp_path):
+    n = Node(str(tmp_path / "d"),
+             settings={"xpack.monitoring.collection.enabled": False})
+    try:
+        assert n.monitoring.collect() == {"collected": 0, "enabled": False}
+    finally:
+        n.close()
+
+
+def test_monitoring_bulk_rest(client, node):
+    status, out = client.req(
+        "POST", "/_monitoring/bulk",
+        [{"index": {"_type": "kibana_stats"}},
+         {"kibana": {"uuid": "k1", "status": "green"}}],
+        system_id="kibana")
+    assert status == 200 and out["indexed"] == 1 and not out["errors"]
+    status, out = client.req("POST", "/_monitoring/bulk",
+                             [{"index": {}}, {"x": 1}])
+    assert status == 400  # system_id required
+
+
+def test_freeze_unfreeze_search_semantics(client, node):
+    node.index_doc("hot", "1", {"v": 1}, refresh="true")
+    node.index_doc("cold", "1", {"v": 2}, refresh="true")
+
+    status, _ = client.req("POST", "/cold/_freeze")
+    assert status == 200
+    assert node.indices.get("cold").settings.get("index.frozen") is True
+
+    # frozen index sits out of normal searches...
+    status, resp = client.req("POST", "/hot,cold/_search",
+                              {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 1
+    # ...but participates with ignore_throttled=false
+    status, resp = client.req("POST", "/hot,cold/_search",
+                              {"query": {"match_all": {}}},
+                              ignore_throttled="false")
+    assert resp["hits"]["total"]["value"] == 2
+
+    # explicit search of the frozen index alone is also skipped by default
+    status, resp = client.req("POST", "/cold/_search",
+                              {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 0
+
+    status, _ = client.req("POST", "/cold/_unfreeze")
+    assert status == 200
+    status, resp = client.req("POST", "/hot,cold/_search",
+                              {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 2
+
+
+def test_frozen_state_survives_restart(tmp_path):
+    data = str(tmp_path / "d")
+    n = Node(data)
+    n.index_doc("cold", "1", {"v": 1}, refresh="true")
+    svc = n.indices.get("cold")
+    n.indices.update_settings(svc, {"index.frozen": True})
+    n.close()
+
+    n2 = Node(data)
+    try:
+        svc2 = n2.indices.open_index("cold") \
+            if not n2.indices.exists("cold") else n2.indices.get("cold")
+        assert svc2.settings.get("index.frozen") in (True, "true")
+        resp = n2.search("cold", {"query": {"match_all": {}}})
+        assert resp["hits"]["total"]["value"] == 0  # still frozen
+    finally:
+        n2.close()
+
+
+def test_scroll_respects_frozen(client, node):
+    node.index_doc("hot", "1", {"v": 1}, refresh="true")
+    node.index_doc("cold", "1", {"v": 2}, refresh="true")
+    client.req("POST", "/cold/_freeze")
+    status, resp = client.req("POST", "/hot,cold/_search",
+                              {"query": {"match_all": {}}}, scroll="1m")
+    assert resp["hits"]["total"]["value"] == 1
+    status, resp = client.req("POST", "/hot,cold/_search",
+                              {"query": {"match_all": {}}}, scroll="1m",
+                              ignore_throttled="false")
+    assert resp["hits"]["total"]["value"] == 2
+
+
+def test_string_false_settings_not_truthy(tmp_path):
+    from elasticsearch_tpu.common.settings import setting_bool
+    assert setting_bool("false") is False
+    assert setting_bool("true") is True
+    assert setting_bool(None, True) is True
+    n = Node(str(tmp_path / "d"),
+             settings={"xpack.monitoring.collection.enabled": "false"})
+    try:
+        assert n.monitoring.collect()["enabled"] is False
+        # an index whose frozen setting is the string "false" is searchable
+        n.index_doc("i", "1", {"v": 1}, refresh="true")
+        n.indices.update_settings(n.indices.get("i"),
+                                  {"index.frozen": "false"})
+        assert n.search("i", {})["hits"]["total"]["value"] == 1
+    finally:
+        n.close()
+
+
+def test_monitoring_bulk_bad_meta_does_not_shift_pairing(node):
+    out = node.monitoring.bulk("beats", [
+        None,                                   # bad meta
+        {"index": {"_type": "x"}},              # its doc (dropped with it)
+        {"index": {"_type": "beats_stats"}},    # valid pair
+        {"beat": {"name": "b1"}},
+    ])
+    assert out["indexed"] == 1 and out["ignored"]
+    # the indexed doc carries the right type from ITS meta line
+    import elasticsearch_tpu.xpack.monitoring as mon
+    r = node.search(mon._today_index(), {
+        "query": {"term": {"type.keyword": "beats_stats"}}})
+    assert r["hits"]["total"]["value"] == 1
+    assert r["hits"]["hits"][0]["_source"]["beat"]["name"] == "b1"
+
+
+def test_deprecations_reports_frozen(client, node):
+    node.index_doc("old", "1", {"v": 1}, refresh="true")
+    client.req("POST", "/old/_freeze")
+    status, body = client.req("GET", "/_migration/deprecations")
+    assert status == 200
+    assert any("frozen" in d["message"] for d in body["deprecations"])
