@@ -5,9 +5,11 @@ json/smile/yaml/cbor subformats, SURVEY.md §2.1): a small registry of codecs
 keyed by content type, plus an ObjectParser-style declarative mapper used by
 request parsing (reference `ObjectParser.java` / `ConstructingObjectParser.java`).
 
-JSON and CBOR are implemented natively (CBOR hand-rolled — no external dep);
-YAML/SMILE are registered as unavailable and produce a clear error, gated the
-way optional modules are.
+All four reference formats are full codecs: JSON (stdlib), CBOR and SMILE
+hand-rolled (SMILE emits header flags 0 — no shared-name/value
+back-references — which every SMILE parser accepts; inputs using
+back-references are rejected upfront), YAML via PyYAML when present
+(a clear unsupported-content-type error otherwise).
 """
 
 from __future__ import annotations
@@ -170,12 +172,300 @@ def _json_loads(data: bytes) -> Any:
         raise ParsingError(f"failed to parse JSON: {e}") from None
 
 
+# ---------------------------------------------------------------------------
+# SMILE (Jackson's binary JSON; reference: libs/x-content smile/ package).
+# Hand-rolled subset: no shared-name/value back-references (header flags 0),
+# which every SMILE parser must accept.
+# ---------------------------------------------------------------------------
+
+_SMILE_HEADER = b":)\n\x00"
+
+
+def _smile_vint(n: int, out: bytearray) -> None:
+    """SMILE unsigned vint: 7 bits/byte, LAST byte carries 6 bits + 0x80."""
+    last = n & 0x3F
+    n >>= 6
+    rest = []
+    while n:
+        rest.append(n & 0x7F)
+        n >>= 7
+    out.extend(reversed(rest))
+    out.append(0x80 | last)
+
+
+def _smile_read_vint(data: bytes, pos: int):
+    n = 0
+    while True:
+        if pos >= len(data):
+            raise ParsingError("truncated SMILE vint")
+        b = data[pos]
+        pos += 1
+        if b & 0x80:
+            return (n << 6) | (b & 0x3F), pos
+        n = (n << 7) | b
+
+
+def _zigzag(n: int) -> int:
+    # arbitrary-precision form (a fixed 63-bit shift corrupts ints < -2^63)
+    return -2 * n - 1 if n < 0 else 2 * n
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _smile_7bit(raw: bytes, out: bytearray) -> None:
+    """Big-endian 7-bits-per-byte packing (floats in SMILE)."""
+    bits = int.from_bytes(raw, "big")
+    total = len(raw) * 8
+    n_out = (total + 6) // 7
+    for i in range(n_out - 1, -1, -1):
+        out.append((bits >> (7 * i)) & 0x7F)
+
+
+def _smile_un7bit(data: bytes, pos: int, raw_len: int):
+    n_in = (raw_len * 8 + 6) // 7
+    if pos + n_in > len(data):
+        raise ParsingError("truncated SMILE float")
+    bits = 0
+    for i in range(n_in):
+        bits = (bits << 7) | (data[pos + i] & 0x7F)
+    return bits.to_bytes((n_in * 7 + 7) // 8, "big")[-raw_len:], pos + n_in
+
+
+def _smile_encode(obj: Any, out: bytearray) -> None:
+    import struct as _struct
+    if obj is None:
+        out.append(0x21)
+    elif obj is True:
+        out.append(0x23)
+    elif obj is False:
+        out.append(0x22)
+    elif isinstance(obj, int):
+        if -16 <= obj <= 15:
+            out.append(0xC0 + _zigzag(obj))
+        elif -(1 << 31) <= obj < (1 << 31):
+            out.append(0x24)
+            _smile_vint(_zigzag(obj), out)
+        else:
+            out.append(0x25)
+            _smile_vint(_zigzag(obj), out)
+    elif isinstance(obj, float):
+        out.append(0x29)
+        _smile_7bit(_struct.pack(">d", obj), out)
+    elif isinstance(obj, str):
+        if obj == "":
+            out.append(0x20)
+            return
+        raw = obj.encode("utf-8")
+        if len(raw) == len(obj):  # pure ASCII
+            if 1 <= len(raw) <= 32:
+                out.append(0x40 + len(raw) - 1)
+                out.extend(raw)
+            elif len(raw) <= 64:
+                out.append(0x60 + len(raw) - 33)
+                out.extend(raw)
+            else:
+                out.append(0xE0)
+                out.extend(raw)
+                out.append(0xFC)
+        else:
+            if 2 <= len(raw) <= 33:
+                out.append(0x80 + len(raw) - 2)
+                out.extend(raw)
+            elif len(raw) <= 65:
+                out.append(0xA0 + len(raw) - 34)
+                out.extend(raw)
+            else:
+                out.append(0xE4)
+                out.extend(raw)
+                out.append(0xFC)
+    elif isinstance(obj, (list, tuple)):
+        out.append(0xF8)
+        for item in obj:
+            _smile_encode(item, out)
+        out.append(0xF9)
+    elif isinstance(obj, dict):
+        out.append(0xFA)
+        for k, v in obj.items():
+            _smile_encode_key(str(k), out)
+            _smile_encode(v, out)
+        out.append(0xFB)
+    else:
+        raise ParsingError(
+            f"cannot SMILE-encode object of type {type(obj).__name__}")
+
+
+def _smile_encode_key(key: str, out: bytearray) -> None:
+    if key == "":
+        out.append(0x20)
+        return
+    raw = key.encode("utf-8")
+    if len(raw) == len(key) and 1 <= len(raw) <= 64:  # short ASCII name
+        out.append(0x80 + len(raw) - 1)
+        out.extend(raw)
+    elif len(raw) != len(key) and 2 <= len(raw) <= 57:  # short Unicode name
+        out.append(0xC0 + len(raw) - 2)
+        out.extend(raw)
+    else:
+        out.append(0x34)  # long name
+        out.extend(raw)
+        out.append(0xFC)
+
+
+def _smile_take(data: bytes, pos: int, n: int) -> bytes:
+    if pos + n > len(data):
+        raise ParsingError("truncated SMILE document")
+    return data[pos:pos + n]
+
+
+def _smile_str_end(data: bytes, pos: int) -> int:
+    end = data.find(0xFC, pos)
+    if end < 0:
+        raise ParsingError("unterminated SMILE long string")
+    return end
+
+
+def _smile_decode_value(data: bytes, pos: int):
+    import struct as _struct
+    if pos >= len(data):
+        raise ParsingError("truncated SMILE document")
+    t = data[pos]
+    pos += 1
+    if t == 0x20:
+        return "", pos
+    if t == 0x21:
+        return None, pos
+    if t == 0x22:
+        return False, pos
+    if t == 0x23:
+        return True, pos
+    if t in (0x24, 0x25):
+        n, pos = _smile_read_vint(data, pos)
+        return _unzigzag(n), pos
+    if t == 0x28:
+        raw, pos = _smile_un7bit(data, pos, 4)
+        return float(_struct.unpack(">f", raw)[0]), pos
+    if t == 0x29:
+        raw, pos = _smile_un7bit(data, pos, 8)
+        return _struct.unpack(">d", raw)[0], pos
+    if 0x40 <= t <= 0x5F:
+        n = t - 0x40 + 1
+        return _smile_take(data, pos, n).decode("utf-8"), pos + n
+    if 0x60 <= t <= 0x7F:
+        n = t - 0x60 + 33
+        return _smile_take(data, pos, n).decode("utf-8"), pos + n
+    if 0x80 <= t <= 0x9F:
+        n = t - 0x80 + 2
+        return _smile_take(data, pos, n).decode("utf-8"), pos + n
+    if 0xA0 <= t <= 0xBF:
+        n = t - 0xA0 + 34
+        return _smile_take(data, pos, n).decode("utf-8"), pos + n
+    if 0xC0 <= t <= 0xDF:
+        return _unzigzag(t - 0xC0), pos
+    if t in (0xE0, 0xE4):
+        end = _smile_str_end(data, pos)
+        return data[pos:end].decode("utf-8"), end + 1
+    if t == 0xF8:
+        arr = []
+        while True:
+            if pos >= len(data):
+                raise ParsingError("unterminated SMILE array")
+            if data[pos] == 0xF9:
+                return arr, pos + 1
+            v, pos = _smile_decode_value(data, pos)
+            arr.append(v)
+    if t == 0xFA:
+        obj = {}
+        while True:
+            if pos >= len(data):
+                raise ParsingError("unterminated SMILE object")
+            if data[pos] == 0xFB:
+                return obj, pos + 1
+            k, pos = _smile_decode_key(data, pos)
+            v, pos = _smile_decode_value(data, pos)
+            obj[k] = v
+    raise ParsingError(f"unsupported SMILE value token 0x{t:02x}")
+
+
+def _smile_decode_key(data: bytes, pos: int):
+    t = data[pos]
+    pos += 1
+    if t == 0x20:
+        return "", pos
+    if t == 0x34:
+        end = _smile_str_end(data, pos)
+        return data[pos:end].decode("utf-8"), end + 1
+    if 0x80 <= t <= 0xBF:
+        n = t - 0x80 + 1
+        return _smile_take(data, pos, n).decode("utf-8"), pos + n
+    if 0xC0 <= t <= 0xF7:
+        n = t - 0xC0 + 2
+        return _smile_take(data, pos, n).decode("utf-8"), pos + n
+    raise ParsingError(f"unsupported SMILE key token 0x{t:02x}")
+
+
+def _smile_dumps(obj: Any) -> bytes:
+    out = bytearray(_SMILE_HEADER)
+    _smile_encode(obj, out)
+    return bytes(out)
+
+
+def _smile_loads(data: bytes) -> Any:
+    if not data.startswith(b":)\n") or len(data) < 4:
+        raise ParsingError("not a SMILE document (missing :)\\n header)")
+    if data[3] & 0x03:
+        raise ParsingError(
+            "SMILE shared-name/value back-references are not supported; "
+            "encode with shared references disabled (header flags 0)")
+    try:
+        value, pos = _smile_decode_value(data, 4)
+    except ParsingError:
+        raise
+    except (UnicodeDecodeError, IndexError, ValueError) as e:
+        raise ParsingError(f"malformed SMILE document: {e}") from None
+    if pos != len(data) and not (pos == len(data) - 1 and data[pos] == 0xFF):
+        raise ParsingError(
+            f"trailing bytes after SMILE value ({len(data) - pos} extra)")
+    return value
+
+
+# ---------------------------------------------------------------------------
+# YAML (PyYAML; reference: libs/x-content yaml/ package via SnakeYAML)
+# ---------------------------------------------------------------------------
+
+def _yaml_module():
+    try:
+        import yaml
+        return yaml
+    except ImportError:
+        raise IllegalArgumentError(
+            "content type [application/yaml] is not supported in this "
+            "build (PyYAML not installed)") from None
+
+
+def _yaml_dumps(obj: Any) -> bytes:
+    yaml = _yaml_module()
+    return yaml.safe_dump(obj, sort_keys=False,
+                          default_flow_style=False).encode("utf-8")
+
+
+def _yaml_loads(data: bytes) -> Any:
+    yaml = _yaml_module()
+    try:
+        return yaml.safe_load(data.decode("utf-8"))
+    except (yaml.YAMLError, UnicodeDecodeError) as e:
+        raise ParsingError(f"failed to parse YAML: {e}") from None
+
+
 _CODECS: Dict[str, _Codec] = {
     XContentType.JSON: _Codec(lambda o: json.dumps(o, separators=(",", ":")).encode("utf-8"), _json_loads),
     XContentType.CBOR: _Codec(
         lambda o: bytes(memoryview(_encode_cbor_root(o))),
         lambda d: _cbor_decode_root(d),
     ),
+    XContentType.SMILE: _Codec(_smile_dumps, _smile_loads),
+    XContentType.YAML: _Codec(_yaml_dumps, _yaml_loads),
 }
 
 
@@ -216,6 +506,10 @@ def loads_auto(data: bytes) -> Any:
     explicit content type — the same ambiguity the reference resolves via the
     Content-Type header.
     """
+    if data.startswith(b":)\n"):  # SMILE magic (XContentFactory checks it)
+        return loads(data, XContentType.SMILE)
+    if data.startswith(b"---"):   # YAML document marker
+        return loads(data, XContentType.YAML)
     first = data[:1]
     if first and (first in b'{["-tfn' or first.isdigit() or first.isspace()):
         return loads(data, XContentType.JSON)

@@ -54,7 +54,8 @@ class HttpServer:
                     self._pool, self.controller.dispatch, method, path, query,
                     body, headers.get("content-type"), headers)
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
-                await self._write_response(writer, status, payload, keep_alive)
+                await self._write_response(writer, status, payload, keep_alive,
+                                           accept=headers.get("accept"))
                 if not keep_alive:
                     break
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -110,10 +111,26 @@ class HttpServer:
         return method.upper(), path, query, headers, body
 
     async def _write_response(self, writer: asyncio.StreamWriter, status: int,
-                              payload, keep_alive: bool) -> None:
+                              payload, keep_alive: bool,
+                              accept: str = None) -> None:
         reasons = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
                    405: "Method Not Allowed", 409: "Conflict", 429: "Too Many Requests",
                    500: "Internal Server Error", 503: "Service Unavailable"}
+        # content negotiation via Accept (reference: x-content media types
+        # negotiated in AbstractHttpServerTransport/RestController); accepts
+        # a multi-valued header, first supported type wins
+        out_type = None
+        if accept:
+            from elasticsearch_tpu.common import xcontent as _xc
+            for part in accept.split(","):
+                part = part.strip()
+                if part.split(";")[0].strip() in ("*/*", "application/json"):
+                    break
+                try:
+                    out_type = _xc.XContentType.from_media_type(part)
+                    break
+                except Exception:
+                    continue
         if payload is None:
             data = b""
             ctype = "application/json"
@@ -121,8 +138,17 @@ class HttpServer:
             data = payload.encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
         else:
-            data = json.dumps(payload).encode("utf-8")
-            ctype = "application/json"
+            data = None
+            if out_type and out_type != "application/json":
+                from elasticsearch_tpu.common import xcontent as _xc
+                try:
+                    data = _xc.dumps(payload, out_type)
+                    ctype = out_type
+                except Exception:
+                    data = None  # unencodable in that format: JSON fallback
+            if data is None:
+                data = json.dumps(payload).encode("utf-8")
+                ctype = "application/json"
         head = (f"HTTP/1.1 {status} {reasons.get(status, 'Unknown')}\r\n"
                 f"content-type: {ctype}\r\n"
                 f"content-length: {len(data)}\r\n"

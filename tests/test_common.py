@@ -94,8 +94,9 @@ def test_xcontent_json_and_cbor():
         data = xcontent.dumps(doc, ct)
         assert xcontent.loads(data, ct) == doc
     assert xcontent.loads_auto(xcontent.dumps(doc, XContentType.CBOR)) == doc
-    with pytest.raises(IllegalArgumentError):
-        xcontent.dumps(doc, XContentType.YAML)
+    # YAML and SMILE are full codecs too (see test_xcontent_formats.py)
+    for ct in (XContentType.YAML, XContentType.SMILE):
+        assert xcontent.loads(xcontent.dumps(doc, ct), ct) == doc
 
 
 def test_object_parser():

@@ -1,0 +1,189 @@
+"""x-content format coverage: SMILE + YAML codecs, auto-sniffing, REST
+content negotiation (reference: libs/x-content json/smile/yaml/cbor
+packages + XContentFactory.xContentType)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common import xcontent
+from elasticsearch_tpu.common.errors import ParsingError
+from elasticsearch_tpu.common.xcontent import XContentType
+
+
+SAMPLES = [
+    None,
+    True,
+    False,
+    0,
+    7,
+    -16,
+    15,
+    123456,
+    -987654321,
+    1 << 40,
+    -(1 << 40),
+    3.14159,
+    -2.5e10,
+    "",
+    "a",
+    "hello world",
+    "x" * 32,
+    "y" * 33,
+    "z" * 64,
+    "w" * 200,
+    "ünïcode",
+    "é" * 40,
+    "日本語のテキスト",
+    [],
+    [1, 2, 3],
+    ["a", None, True, 2.5],
+    {},
+    {"k": "v"},
+    {"nested": {"a": [1, {"b": "c"}]}, "n": 42},
+    {"": "empty key", "long" * 30: "long key"},
+    {"ünïcode-kéy": 1},
+]
+
+
+@pytest.mark.parametrize("content_type", [XContentType.SMILE,
+                                          XContentType.YAML,
+                                          XContentType.CBOR])
+def test_roundtrip_all_samples(content_type):
+    for sample in SAMPLES:
+        encoded = xcontent.dumps(sample, content_type)
+        decoded = xcontent.loads(encoded, content_type)
+        if isinstance(sample, float):
+            assert decoded == pytest.approx(sample), (content_type, sample)
+        else:
+            assert decoded == sample, (content_type, sample)
+
+
+def test_smile_header_and_tokens():
+    data = xcontent.dumps({"a": 1}, XContentType.SMILE)
+    assert data.startswith(b":)\n")            # magic
+    assert data[3] == 0x00                      # no shared names/values
+    assert data[4] == 0xFA and data[-1] == 0xFB  # object frame
+    # small int 1 → 0xC0 + zigzag(1)=2
+    assert data[4:].count(bytes([0xC2])) == 1
+
+    assert xcontent.dumps(True, XContentType.SMILE)[4] == 0x23
+    assert xcontent.dumps(None, XContentType.SMILE)[4] == 0x21
+    assert xcontent.dumps("", XContentType.SMILE)[4] == 0x20
+
+
+def test_smile_rejects_garbage():
+    with pytest.raises(ParsingError):
+        xcontent.loads(b"\xff\xff\xff", XContentType.SMILE)
+    with pytest.raises(ParsingError):
+        xcontent.loads(b"not smile", XContentType.SMILE)
+
+
+def test_smile_malformed_inputs_raise_parsing_error():
+    bad_docs = [
+        b":)\n\x00\x41\xff",       # invalid UTF-8 in tiny string
+        b":)\n\x00\x29\x01",       # truncated double
+        b":)\n\x00\x42ab",          # length-3 string token, 2 bytes present
+        b":)\n\x00\x21XYZ",         # trailing garbage after value
+        b":)\n\x00\xfa",            # unterminated object
+        b":)\n\x00\xf8\x21",        # unterminated array
+        b":)\n\x00\xe0abc",         # unterminated long string
+        b":)\n\x01\xfa\xfb",        # shared-names flag set
+    ]
+    for doc in bad_docs:
+        with pytest.raises(ParsingError):
+            xcontent.loads(doc, XContentType.SMILE)
+
+
+def test_smile_huge_negative_int_roundtrip():
+    for n in (-(1 << 63) - 1, (1 << 70), -(1 << 70)):
+        enc = xcontent.dumps(n, XContentType.SMILE)
+        assert xcontent.loads(enc, XContentType.SMILE) == n
+
+
+def test_yaml_parses_yml_style_document():
+    doc = b"""---
+settings:
+  number_of_shards: 2
+mappings:
+  properties:
+    title: {type: text}
+list:
+  - a
+  - b
+"""
+    out = xcontent.loads(doc, XContentType.YAML)
+    assert out["settings"]["number_of_shards"] == 2
+    assert out["mappings"]["properties"]["title"]["type"] == "text"
+    assert out["list"] == ["a", "b"]
+
+
+def test_loads_auto_sniffs_all_formats():
+    obj = {"k": [1, 2], "s": "v"}
+    assert xcontent.loads_auto(xcontent.dumps(obj, XContentType.JSON)) == obj
+    assert xcontent.loads_auto(xcontent.dumps(obj, XContentType.SMILE)) == obj
+    assert xcontent.loads_auto(b"---\nk: 1\n") == {"k": 1}
+
+
+def test_rest_accepts_smile_and_yaml_bodies(tmp_path):
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    node = Node(str(tmp_path / "d"))
+    try:
+        rc = RestController()
+        register_all(rc, node)
+        body = xcontent.dumps({"doc_field": "from smile"}, XContentType.SMILE)
+        status, resp = rc.dispatch("PUT", "/i/_doc/1", {"refresh": "true"},
+                                   body, "application/smile")
+        assert status == 201
+        body = xcontent.dumps({"query": {"term": {"doc_field.keyword":
+                                                  "from smile"}}},
+                              XContentType.YAML)
+        status, resp = rc.dispatch("POST", "/i/_search", {}, body,
+                                   "application/yaml")
+        assert status == 200 and resp["hits"]["total"]["value"] == 1
+    finally:
+        node.close()
+
+
+def test_http_response_negotiation(tmp_path):
+    """End-to-end: Accept: application/smile gets a SMILE response body."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    port = 19341
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu.server", "--port",
+         str(port), "--data", str(tmp_path / "srv")],
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": "."},
+        cwd=".", stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        for _ in range(60):
+            try:
+                s = socket.create_connection(("127.0.0.1", port), timeout=1)
+                break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            pytest.fail("server did not start")
+        req = (f"GET / HTTP/1.1\r\nHost: localhost\r\n"
+               f"Accept: application/smile\r\nConnection: close\r\n\r\n")
+        s.sendall(req.encode())
+        raw = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            raw += chunk
+        s.close()
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        assert b"content-type: application/smile" in head
+        out = xcontent.loads(payload, XContentType.SMILE)
+        assert out["tagline"] == "You Know, for (TPU) Search"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
