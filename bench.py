@@ -43,7 +43,10 @@ def main():
     d = 128
     k = 10
     batch = 128
-    n_batches = 4 if small else 20
+    # enough batches per dispatch that the tunnel round-trip (~40-70 ms in
+    # this environment; ~µs on a TPU-attached host) amortizes below the
+    # per-batch kernel time
+    n_batches = 16 if small else 100
     n_queries = batch * n_batches
 
     rng = np.random.default_rng(1234)

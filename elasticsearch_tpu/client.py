@@ -1,0 +1,496 @@
+"""Python client library for the tpu-search REST API.
+
+Re-design of the reference's client stack (`client/rest` — the low-level
+`RestClient` with host round-robin, dead-host marking and retries — and
+`client/rest-high-level`'s typed request/response mirror, plus
+`client/sniffer`). The high-level surface follows the namespaced layout
+users of the reference's clients know: `client.search(...)`,
+`client.indices.create(...)`, `client.cluster.health()`, `client.ml.*`.
+
+Zero external dependencies: http.client over the framework's x-content
+layer, so any of the four content types can be used on the wire.
+"""
+
+from __future__ import annotations
+
+import http.client
+import random
+import time
+import urllib.parse
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from elasticsearch_tpu.common import xcontent
+from elasticsearch_tpu.common.xcontent import XContentType
+
+
+class TransportError(Exception):
+    """Non-2xx response or no host reachable."""
+
+    def __init__(self, status: int, message: str, body: Any = None):
+        super().__init__(f"[{status}] {message}")
+        self.status = status
+        self.body = body
+
+
+class ConnectionError_(TransportError):
+    def __init__(self, message: str):
+        super().__init__(0, message)
+
+
+class Transport:
+    """Low-level client (reference: client/rest RestClient.java —
+    round-robin over hosts, dead-host cooldown, retry on connect failure)."""
+
+    def __init__(self, hosts: Sequence[Union[str, Tuple[str, int]]],
+                 timeout: float = 30.0, max_retries: int = 3,
+                 content_type: str = XContentType.JSON,
+                 dead_host_cooldown: float = 60.0):
+        self.hosts: List[Tuple[str, int]] = []
+        for h in hosts:
+            if isinstance(h, str):
+                if "//" in h:
+                    parsed = urllib.parse.urlsplit(h)
+                    self.hosts.append((parsed.hostname or "localhost",
+                                       parsed.port or 9200))
+                elif ":" in h:
+                    name, _, port = h.partition(":")
+                    self.hosts.append((name, int(port)))
+                else:
+                    self.hosts.append((h, 9200))
+            else:
+                self.hosts.append(tuple(h))  # type: ignore[arg-type]
+        if not self.hosts:
+            raise ValueError("at least one host is required")
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.content_type = content_type
+        self.dead_host_cooldown = dead_host_cooldown
+        self._dead: Dict[Tuple[str, int], float] = {}
+        self._rr = random.randrange(len(self.hosts))
+
+    def _alive_hosts(self) -> List[Tuple[str, int]]:
+        now = time.time()
+        alive = [h for h in self.hosts
+                 if self._dead.get(h, 0) <= now]
+        return alive or list(self.hosts)  # all dead: try everything again
+
+    def perform_request(self, method: str, path: str,
+                        params: Optional[dict] = None,
+                        body: Any = None,
+                        raw_body: Optional[bytes] = None,
+                        headers: Optional[dict] = None) -> Any:
+        query = ""
+        if params:
+            query = "?" + urllib.parse.urlencode(
+                {k: _param_str(v) for k, v in params.items() if v is not None})
+        payload = raw_body
+        hdrs = {"Accept": self.content_type}
+        if payload is None and body is not None:
+            payload = xcontent.dumps(body, self.content_type)
+            hdrs["Content-Type"] = self.content_type
+        elif raw_body is not None:
+            hdrs["Content-Type"] = "application/x-ndjson"
+        hdrs.update(headers or {})
+
+        last_error: Optional[Exception] = None
+        hosts = self._alive_hosts()
+        for attempt in range(self.max_retries + 1):
+            host, port = hosts[(self._rr + attempt) % len(hosts)]
+            conn = http.client.HTTPConnection(host, port,
+                                             timeout=self.timeout)
+            try:
+                # connect separately: only connect-phase failures are safe
+                # to retry — once the request is sent, a timeout may mean
+                # the server is still executing it, and re-sending would
+                # double-apply writes (reference clients default
+                # retry_on_timeout=false for the same reason)
+                conn.connect()
+            except OSError as e:
+                conn.close()
+                self._dead[(host, port)] = time.time() + self.dead_host_cooldown
+                last_error = e
+                continue
+            try:
+                conn.request(method, path + query, body=payload, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+            except OSError as e:
+                self._dead[(host, port)] = time.time() + self.dead_host_cooldown
+                raise ConnectionError_(
+                    f"request to {host}:{port} failed after send "
+                    f"(not retried): {e}") from e
+            finally:
+                conn.close()
+            self._rr = (self._rr + 1) % len(hosts)
+            out = self._decode(resp.getheader("content-type"), data)
+            if resp.status >= 300:
+                reason = out
+                if isinstance(out, dict):
+                    err = out.get("error")
+                    if isinstance(err, dict):
+                        reason = err.get("reason", str(err))
+                    elif err is not None:
+                        reason = str(err)
+                raise TransportError(resp.status, str(reason), out)
+            return out
+        raise ConnectionError_(
+            f"no host reachable after {self.max_retries + 1} attempts: "
+            f"{last_error}")
+
+    @staticmethod
+    def _decode(content_type: Optional[str], data: bytes) -> Any:
+        if not data:
+            return None
+        ct = (content_type or "application/json").split(";")[0].strip()
+        if ct.startswith("text/"):
+            return data.decode("utf-8", "replace")
+        try:
+            return xcontent.loads(data, xcontent.XContentType.from_media_type(ct))
+        except Exception:
+            return data.decode("utf-8", "replace")
+
+
+def _param_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _idx(index: str) -> str:
+    """Percent-quote an index expression for the request path (commas and
+    wildcards stay literal — multi-index expressions)."""
+    return urllib.parse.quote(index, safe="*,")
+
+
+def _doc_path(index: str, doc_id: Optional[str]) -> str:
+    base = f"/{_idx(index)}/_doc"
+    return base + (f"/{urllib.parse.quote(str(doc_id))}" if doc_id is not None
+                   else "")
+
+
+class _Namespace:
+    def __init__(self, transport: Transport):
+        self._t = transport
+
+
+class IndicesClient(_Namespace):
+    def create(self, index: str, body: Optional[dict] = None, **params):
+        return self._t.perform_request("PUT", f"/{_idx(index)}", params, body)
+
+    def delete(self, index: str, **params):
+        return self._t.perform_request("DELETE", f"/{_idx(index)}", params)
+
+    def exists(self, index: str) -> bool:
+        try:
+            self._t.perform_request("HEAD", f"/{_idx(index)}")
+            return True
+        except TransportError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def refresh(self, index: str = "_all", **params):
+        return self._t.perform_request("POST", f"/{_idx(index)}/_refresh", params)
+
+    def get(self, index: str, **params):
+        return self._t.perform_request("GET", f"/{_idx(index)}", params)
+
+    def get_mapping(self, index: str, **params):
+        return self._t.perform_request("GET", f"/{_idx(index)}/_mapping", params)
+
+    def put_mapping(self, index: str, body: dict, **params):
+        return self._t.perform_request("PUT", f"/{_idx(index)}/_mapping", params,
+                                       body)
+
+    def get_settings(self, index: str, **params):
+        return self._t.perform_request("GET", f"/{_idx(index)}/_settings", params)
+
+    def put_settings(self, body: dict, index: str = "_all", **params):
+        return self._t.perform_request("PUT", f"/{_idx(index)}/_settings", params,
+                                       body)
+
+    def stats(self, index: str = "_all", **params):
+        return self._t.perform_request("GET", f"/{_idx(index)}/_stats", params)
+
+    def analyze(self, body: dict, index: Optional[str] = None, **params):
+        path = f"/{_idx(index)}/_analyze" if index else "/_analyze"
+        return self._t.perform_request("POST", path, params, body)
+
+    def put_alias(self, index: str, name: str, **params):
+        return self._t.perform_request("PUT", f"/{_idx(index)}/_alias/{name}",
+                                       params)
+
+    def put_template(self, name: str, body: dict, **params):
+        return self._t.perform_request("PUT", f"/_template/{name}", params,
+                                       body)
+
+    def rollover(self, alias: str, body: Optional[dict] = None, **params):
+        return self._t.perform_request("POST", f"/{_idx(alias)}/_rollover", params,
+                                       body)
+
+    def freeze(self, index: str, **params):
+        return self._t.perform_request("POST", f"/{_idx(index)}/_freeze", params)
+
+    def unfreeze(self, index: str, **params):
+        return self._t.perform_request("POST", f"/{_idx(index)}/_unfreeze", params)
+
+    def forcemerge(self, index: str = "_all", **params):
+        return self._t.perform_request("POST", f"/{_idx(index)}/_forcemerge",
+                                       params)
+
+
+class ClusterClient(_Namespace):
+    def health(self, **params):
+        return self._t.perform_request("GET", "/_cluster/health", params)
+
+    def stats(self, **params):
+        return self._t.perform_request("GET", "/_cluster/stats", params)
+
+    def state(self, **params):
+        return self._t.perform_request("GET", "/_cluster/state", params)
+
+    def put_settings(self, body: dict, **params):
+        return self._t.perform_request("PUT", "/_cluster/settings", params,
+                                       body)
+
+    def get_settings(self, **params):
+        return self._t.perform_request("GET", "/_cluster/settings", params)
+
+
+class CatClient(_Namespace):
+    def _cat(self, what: str, **params):
+        params.setdefault("format", "json")
+        return self._t.perform_request("GET", f"/_cat/{what}", params)
+
+    def indices(self, **params):
+        return self._cat("indices", **params)
+
+    def shards(self, **params):
+        return self._cat("shards", **params)
+
+    def health(self, **params):
+        return self._cat("health", **params)
+
+    def nodes(self, **params):
+        return self._cat("nodes", **params)
+
+    def count(self, **params):
+        return self._cat("count", **params)
+
+
+class IngestClient(_Namespace):
+    def put_pipeline(self, pipeline_id: str, body: dict, **params):
+        return self._t.perform_request("PUT",
+                                       f"/_ingest/pipeline/{pipeline_id}",
+                                       params, body)
+
+    def get_pipeline(self, pipeline_id: str = "*", **params):
+        return self._t.perform_request("GET",
+                                       f"/_ingest/pipeline/{pipeline_id}",
+                                       params)
+
+    def delete_pipeline(self, pipeline_id: str, **params):
+        return self._t.perform_request("DELETE",
+                                       f"/_ingest/pipeline/{pipeline_id}",
+                                       params)
+
+    def simulate(self, body: dict, **params):
+        return self._t.perform_request("POST", "/_ingest/pipeline/_simulate",
+                                       params, body)
+
+
+class MlClient(_Namespace):
+    def put_job(self, job_id: str, body: dict, **params):
+        return self._t.perform_request(
+            "PUT", f"/_ml/anomaly_detectors/{job_id}", params, body)
+
+    def open_job(self, job_id: str, **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/anomaly_detectors/{job_id}/_open", params)
+
+    def close_job(self, job_id: str, **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/anomaly_detectors/{job_id}/_close", params)
+
+    def post_data(self, job_id: str, records: List[dict], **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/anomaly_detectors/{job_id}/_data", params, records)
+
+    def flush_job(self, job_id: str, **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/anomaly_detectors/{job_id}/_flush", params)
+
+    def get_buckets(self, job_id: str, body: Optional[dict] = None, **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/anomaly_detectors/{job_id}/results/buckets",
+            params, body or {})
+
+    def get_records(self, job_id: str, body: Optional[dict] = None, **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/anomaly_detectors/{job_id}/results/records",
+            params, body or {})
+
+    def put_datafeed(self, datafeed_id: str, body: dict, **params):
+        return self._t.perform_request(
+            "PUT", f"/_ml/datafeeds/{datafeed_id}", params, body)
+
+    def start_datafeed(self, datafeed_id: str, **params):
+        return self._t.perform_request(
+            "POST", f"/_ml/datafeeds/{datafeed_id}/_start", params)
+
+
+class SqlClient(_Namespace):
+    def query(self, body: dict, **params):
+        return self._t.perform_request("POST", "/_sql", params, body)
+
+    def translate(self, body: dict, **params):
+        return self._t.perform_request("POST", "/_sql/translate", params,
+                                       body)
+
+
+class SnapshotClient(_Namespace):
+    def create_repository(self, repository: str, body: dict, **params):
+        return self._t.perform_request("PUT", f"/_snapshot/{repository}",
+                                       params, body)
+
+    def create(self, repository: str, snapshot: str,
+               body: Optional[dict] = None, **params):
+        return self._t.perform_request(
+            "PUT", f"/_snapshot/{repository}/{snapshot}", params, body)
+
+    def restore(self, repository: str, snapshot: str,
+                body: Optional[dict] = None, **params):
+        return self._t.perform_request(
+            "POST", f"/_snapshot/{repository}/{snapshot}/_restore", params,
+            body)
+
+    def get(self, repository: str, snapshot: str = "_all", **params):
+        return self._t.perform_request(
+            "GET", f"/_snapshot/{repository}/{snapshot}", params)
+
+
+class TasksClient(_Namespace):
+    def list(self, **params):
+        return self._t.perform_request("GET", "/_tasks", params)
+
+
+class EnrichClient(_Namespace):
+    def put_policy(self, name: str, body: dict, **params):
+        return self._t.perform_request("PUT", f"/_enrich/policy/{name}",
+                                       params, body)
+
+    def execute_policy(self, name: str, **params):
+        return self._t.perform_request(
+            "POST", f"/_enrich/policy/{name}/_execute", params)
+
+
+class GraphClient(_Namespace):
+    def explore(self, index: str, body: dict, **params):
+        return self._t.perform_request("POST", f"/{_idx(index)}/_graph/explore",
+                                       params, body)
+
+
+class TpuSearchClient:
+    """High-level client (reference: RestHighLevelClient.java layout)."""
+
+    def __init__(self, hosts: Sequence[Union[str, Tuple[str, int]]] =
+                 ("localhost:9200",), **transport_kwargs):
+        self.transport = Transport(hosts, **transport_kwargs)
+        self.indices = IndicesClient(self.transport)
+        self.cluster = ClusterClient(self.transport)
+        self.cat = CatClient(self.transport)
+        self.ingest = IngestClient(self.transport)
+        self.ml = MlClient(self.transport)
+        self.sql = SqlClient(self.transport)
+        self.snapshot = SnapshotClient(self.transport)
+        self.tasks = TasksClient(self.transport)
+        self.enrich = EnrichClient(self.transport)
+        self.graph = GraphClient(self.transport)
+
+    # ------------------------------------------------------------ documents
+    def index(self, index: str, body: dict, id: Optional[str] = None,
+              **params):
+        if id is None:
+            return self.transport.perform_request(
+                "POST", f"/{_idx(index)}/_doc", params, body)
+        return self.transport.perform_request(
+            "PUT", _doc_path(index, id), params, body)
+
+    def get(self, index: str, id: str, **params):
+        return self.transport.perform_request("GET", _doc_path(index, id),
+                                              params)
+
+    def exists(self, index: str, id: str) -> bool:
+        try:
+            self.transport.perform_request("HEAD", _doc_path(index, id))
+            return True
+        except TransportError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def delete(self, index: str, id: str, **params):
+        return self.transport.perform_request("DELETE", _doc_path(index, id),
+                                              params)
+
+    def update(self, index: str, id: str, body: dict, **params):
+        return self.transport.perform_request(
+            "POST", f"/{_idx(index)}/_update/{id}", params, body)
+
+    def mget(self, body: dict, index: Optional[str] = None, **params):
+        path = f"/{_idx(index)}/_mget" if index else "/_mget"
+        return self.transport.perform_request("POST", path, params, body)
+
+    def bulk(self, operations: List[dict], index: Optional[str] = None,
+             **params):
+        path = f"/{_idx(index)}/_bulk" if index else "/_bulk"
+        raw = b"\n".join(xcontent.dumps(op, XContentType.JSON)
+                         for op in operations) + b"\n"
+        return self.transport.perform_request("POST", path, params,
+                                              raw_body=raw)
+
+    # --------------------------------------------------------------- search
+    def search(self, index: Optional[str] = None,
+               body: Optional[dict] = None, **params):
+        path = f"/{_idx(index)}/_search" if index else "/_search"
+        return self.transport.perform_request("POST", path, params,
+                                              body or {})
+
+    def msearch(self, searches: List[dict], **params):
+        raw = b"\n".join(xcontent.dumps(line, XContentType.JSON)
+                         for line in searches) + b"\n"
+        return self.transport.perform_request("POST", "/_msearch", params,
+                                              raw_body=raw)
+
+    def count(self, index: Optional[str] = None,
+              body: Optional[dict] = None, **params):
+        path = f"/{_idx(index)}/_count" if index else "/_count"
+        return self.transport.perform_request("POST", path, params, body)
+
+    def scroll(self, scroll_id: str, scroll: str = "1m", **params):
+        return self.transport.perform_request(
+            "POST", "/_search/scroll", params,
+            {"scroll_id": scroll_id, "scroll": scroll})
+
+    def clear_scroll(self, scroll_id: str, **params):
+        return self.transport.perform_request(
+            "DELETE", "/_search/scroll", params, {"scroll_id": [scroll_id]})
+
+    def rank_eval(self, index: str, body: dict, **params):
+        return self.transport.perform_request(
+            "POST", f"/{_idx(index)}/_rank_eval", params, body)
+
+    # ----------------------------------------------------------------- misc
+    def info(self):
+        return self.transport.perform_request("GET", "/")
+
+    def ping(self) -> bool:
+        try:
+            self.transport.perform_request("GET", "/")
+            return True
+        except (TransportError, OSError):
+            return False
+
+
+# the familiar import alias
+Client = TpuSearchClient

@@ -247,9 +247,18 @@ def _smile_encode(obj: Any, out: bytearray) -> None:
         elif -(1 << 31) <= obj < (1 << 31):
             out.append(0x24)
             _smile_vint(_zigzag(obj), out)
-        else:
+        elif -(1 << 63) <= obj < (1 << 63):
             out.append(0x25)
             _smile_vint(_zigzag(obj), out)
+        else:
+            # BigInteger (0x26): vint byte-length + 7-bit packed big-endian
+            # two's complement, Jackson's safe-binary layout — a 64-bit
+            # token here would overflow conformant parsers
+            raw_len = (obj.bit_length() + 8) // 8  # +1 bit for sign
+            raw = obj.to_bytes(raw_len, "big", signed=True)
+            out.append(0x26)
+            _smile_vint(len(raw), out)
+            _smile_7bit(raw, out)
     elif isinstance(obj, float):
         out.append(0x29)
         _smile_7bit(_struct.pack(">d", obj), out)
@@ -343,6 +352,10 @@ def _smile_decode_value(data: bytes, pos: int):
     if t in (0x24, 0x25):
         n, pos = _smile_read_vint(data, pos)
         return _unzigzag(n), pos
+    if t == 0x26:
+        raw_len, pos = _smile_read_vint(data, pos)
+        raw, pos = _smile_un7bit(data, pos, raw_len)
+        return int.from_bytes(raw, "big", signed=True), pos
     if t == 0x28:
         raw, pos = _smile_un7bit(data, pos, 4)
         return float(_struct.unpack(">f", raw)[0]), pos

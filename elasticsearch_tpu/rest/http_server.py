@@ -15,7 +15,25 @@ import json
 import urllib.parse
 from typing import Optional, Tuple
 
+from elasticsearch_tpu.common import xcontent
 from elasticsearch_tpu.rest.controller import RestController
+
+
+def _negotiate_accept(accept: Optional[str]) -> Optional[str]:
+    """Multi-valued Accept header → first supported x-content type, or None
+    for the JSON default (reference: media-type negotiation in
+    AbstractHttpServerTransport/RestController)."""
+    if not accept:
+        return None
+    for part in accept.split(","):
+        media = part.split(";")[0].strip()
+        if media in ("*/*", "application/json"):
+            return None
+        try:
+            return xcontent.XContentType.from_media_type(part.strip())
+        except Exception:
+            continue
+    return None
 
 MAX_BODY = 100 * 1024 * 1024  # reference http.max_content_length default 100mb
 
@@ -116,21 +134,6 @@ class HttpServer:
         reasons = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
                    405: "Method Not Allowed", 409: "Conflict", 429: "Too Many Requests",
                    500: "Internal Server Error", 503: "Service Unavailable"}
-        # content negotiation via Accept (reference: x-content media types
-        # negotiated in AbstractHttpServerTransport/RestController); accepts
-        # a multi-valued header, first supported type wins
-        out_type = None
-        if accept:
-            from elasticsearch_tpu.common import xcontent as _xc
-            for part in accept.split(","):
-                part = part.strip()
-                if part.split(";")[0].strip() in ("*/*", "application/json"):
-                    break
-                try:
-                    out_type = _xc.XContentType.from_media_type(part)
-                    break
-                except Exception:
-                    continue
         if payload is None:
             data = b""
             ctype = "application/json"
@@ -139,10 +142,10 @@ class HttpServer:
             ctype = "text/plain; charset=UTF-8"
         else:
             data = None
+            out_type = _negotiate_accept(accept)
             if out_type and out_type != "application/json":
-                from elasticsearch_tpu.common import xcontent as _xc
                 try:
-                    data = _xc.dumps(payload, out_type)
+                    data = xcontent.dumps(payload, out_type)
                     ctype = out_type
                 except Exception:
                     data = None  # unencodable in that format: JSON fallback

@@ -21,6 +21,42 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest
 
 
+import contextlib
+import socket
+import subprocess
+
+
+@contextlib.contextmanager
+def http_server_subprocess(port: int, data_dir: str, startup_timeout=60.0):
+    """Spawn a real `python -m elasticsearch_tpu.server` and wait until it
+    accepts connections (shared by end-to-end client/wire tests)."""
+    import time as _time
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu.server", "--port",
+         str(port), "--data", str(data_dir)],
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": "."},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = _time.time() + startup_timeout
+    try:
+        while True:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=1).close()
+                break
+            except OSError:
+                if _time.time() > deadline or proc.poll() is not None:
+                    proc.terminate()
+                    raise RuntimeError("server did not start")
+                _time.sleep(0.5)
+        yield proc
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 @pytest.fixture(autouse=True)
 def _isolate_stored_scripts():
     """GLOBAL_SCRIPTS is the process-wide cluster-state analog; clear it

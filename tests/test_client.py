@@ -1,0 +1,128 @@
+"""Client library against a real HTTP server subprocess (reference:
+client/rest RestClient + client/rest-high-level typed surface)."""
+
+import pytest
+
+from tests.conftest import http_server_subprocess
+
+from elasticsearch_tpu.client import (
+    ConnectionError_,
+    Transport,
+    TpuSearchClient,
+    TransportError,
+)
+
+PORT = 19351
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    data = tmp_path_factory.mktemp("clientsrv")
+    with http_server_subprocess(PORT, str(data)) as proc:
+        yield proc
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return TpuSearchClient([f"localhost:{PORT}"])
+
+
+def test_info_and_ping(client):
+    assert client.ping()
+    info = client.info()
+    assert info["tagline"] == "You Know, for (TPU) Search"
+
+
+def test_document_lifecycle(client):
+    r = client.index("books", {"title": "Dune", "pages": 412}, id="1",
+                     refresh=True)
+    assert r["result"] == "created"
+    assert client.exists("books", "1")
+    assert not client.exists("books", "zzz")
+    doc = client.get("books", "1")
+    assert doc["_source"]["title"] == "Dune"
+    client.update("books", "1", {"doc": {"pages": 500}}, refresh=True)
+    assert client.get("books", "1")["_source"]["pages"] == 500
+    r = client.delete("books", "1", refresh=True)
+    assert r["result"] == "deleted"
+
+
+def test_bulk_and_search(client):
+    ops = []
+    for i in range(5):
+        ops.append({"index": {"_index": "logs", "_id": str(i)}})
+        ops.append({"level": "error" if i % 2 else "info", "n": i})
+    r = client.bulk(ops, refresh=True)
+    assert not r["errors"]
+    resp = client.search("logs", {"query": {"term": {"level.keyword":
+                                                     "error"}}})
+    assert resp["hits"]["total"]["value"] == 2
+    assert client.count("logs")["count"] == 5
+    resp = client.search("logs", {"size": 2,
+                                  "sort": [{"n": {"order": "asc"}}]},
+                         scroll="1m")
+    sid = resp["_scroll_id"]
+    page2 = client.scroll(sid)
+    assert [h["_source"]["n"] for h in page2["hits"]["hits"]] == [2, 3]
+
+
+def test_indices_namespace(client):
+    client.indices.create("typed", {"mappings": {"properties": {
+        "v": {"type": "dense_vector", "dims": 4}}}})
+    assert client.indices.exists("typed")
+    mapping = client.indices.get_mapping("typed")
+    assert mapping["typed"]["mappings"]["properties"]["v"]["dims"] == 4
+    client.indices.put_settings({"index": {"refresh_interval": "5s"}},
+                                index="typed")
+    client.indices.delete("typed")
+    assert not client.indices.exists("typed")
+
+
+def test_cluster_and_cat(client):
+    health = client.cluster.health()
+    assert health["status"] in ("green", "yellow")
+    cats = client.cat.indices()
+    assert isinstance(cats, (list, str))
+
+
+def test_knn_search_through_client(client):
+    client.indices.create("vecs", {"mappings": {"properties": {
+        "v": {"type": "dense_vector", "dims": 3,
+              "similarity": "l2_norm"}}}})
+    for i, vec in enumerate([[1, 0, 0], [0, 1, 0], [0, 0, 1]]):
+        client.index("vecs", {"v": vec}, id=str(i))
+    client.indices.refresh("vecs")
+    resp = client.search("vecs", {"size": 1, "query": {
+        "knn": {"field": "v", "query_vector": [0.9, 0.1, 0], "k": 1}}})
+    assert resp["hits"]["hits"][0]["_id"] == "0"
+
+
+def test_error_surfaces_as_transport_error(client):
+    with pytest.raises(TransportError) as ei:
+        client.get("missing-index", "1")
+    assert ei.value.status == 404
+    with pytest.raises(TransportError) as ei:
+        client.search("logs", {"query": {"bogus_query": {}}})
+    assert ei.value.status == 400
+
+
+def test_sql_through_client(client):
+    r = client.sql.query({"query": "SELECT n FROM logs ORDER BY n DESC "
+                                   "LIMIT 2"})
+    assert [row[0] for row in r["rows"]] == [4, 3]
+
+
+def test_dead_host_failover():
+    t = Transport([("localhost", 1), ("localhost", 2)], max_retries=1,
+                  timeout=0.2)
+    with pytest.raises(ConnectionError_):
+        t.perform_request("GET", "/")
+    assert len(t._dead) >= 1
+
+
+def test_multi_host_round_robin(client):
+    # one dead host + one live: requests succeed via failover
+    t = Transport([("localhost", 9), f"localhost:{PORT}"], timeout=2.0)
+    for _ in range(4):
+        assert t.perform_request("GET", "/_cluster/health")["status"] \
+            in ("green", "yellow")

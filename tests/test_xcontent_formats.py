@@ -150,26 +150,12 @@ def test_rest_accepts_smile_and_yaml_bodies(tmp_path):
 def test_http_response_negotiation(tmp_path):
     """End-to-end: Accept: application/smile gets a SMILE response body."""
     import socket
-    import subprocess
-    import sys
-    import time
+
+    from tests.conftest import http_server_subprocess
 
     port = 19341
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "elasticsearch_tpu.server", "--port",
-         str(port), "--data", str(tmp_path / "srv")],
-        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
-             "PYTHONPATH": "."},
-        cwd=".", stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        for _ in range(60):
-            try:
-                s = socket.create_connection(("127.0.0.1", port), timeout=1)
-                break
-            except OSError:
-                time.sleep(0.5)
-        else:
-            pytest.fail("server did not start")
+    with http_server_subprocess(port, str(tmp_path / "srv")):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
         req = (f"GET / HTTP/1.1\r\nHost: localhost\r\n"
                f"Accept: application/smile\r\nConnection: close\r\n\r\n")
         s.sendall(req.encode())
@@ -184,6 +170,3 @@ def test_http_response_negotiation(tmp_path):
         assert b"content-type: application/smile" in head
         out = xcontent.loads(payload, XContentType.SMILE)
         assert out["tagline"] == "You Know, for (TPU) Search"
-    finally:
-        proc.terminate()
-        proc.wait(timeout=10)
