@@ -218,8 +218,14 @@ class ClusterNode:
                 continue
             local = self.local_shards.get(key)
             if local is None:
-                mapper = self.mappers.setdefault(index, MapperService(
-                    meta.get("mappings") or {"properties": {}}))
+                if index not in self.mappers:
+                    from elasticsearch_tpu.index.analysis import (
+                        AnalysisRegistry)
+                    self.mappers[index] = MapperService(
+                        meta.get("mappings") or {"properties": {}},
+                        registry=AnalysisRegistry.from_index_settings(
+                            meta.get("settings") or {}))
+                mapper = self.mappers[index]
                 path = os.path.join(self.data_path, index, str(shard_id),
                                     entry.allocation_id.replace("/", "_").replace("#", "_"))
                 engine = Engine(path, mapper, translog_sync="async")

@@ -256,6 +256,9 @@ class AnalysisRegistry:
         self._analyzers: Dict[str, Analyzer] = {}
         for a in built_in_analyzers():
             self._analyzers[a.name] = a
+        from elasticsearch_tpu.index.analysis_lang import language_analyzers
+        for a in language_analyzers():
+            self._analyzers.setdefault(a.name, a)
 
     def register(self, analyzer: Analyzer) -> None:
         self._analyzers[analyzer.name] = analyzer
@@ -268,6 +271,212 @@ class AnalysisRegistry:
 
     def names(self):
         return sorted(self._analyzers)
+
+    @classmethod
+    def from_index_settings(cls, flat_settings: Dict) -> "AnalysisRegistry":
+        """Per-index registry with custom analyzers/tokenizers/filters from
+        `index.analysis.*` settings (reference: AnalysisRegistry builds
+        per-index components from IndexSettings)."""
+        reg = cls()
+        analysis = _nest_analysis_settings(flat_settings)
+        if not analysis:
+            return reg
+
+        custom_tokenizers = {}
+        for name, spec in (analysis.get("tokenizer") or {}).items():
+            custom_tokenizers[name] = _build_tokenizer(spec)
+        custom_filters = {}
+        for name, spec in (analysis.get("filter") or {}).items():
+            custom_filters[name] = _build_filter(spec)
+
+        for name, spec in (analysis.get("analyzer") or {}).items():
+            atype = spec.get("type", "custom")
+            if atype != "custom":
+                # e.g. {"type": "standard", "stopwords": [...]}: start from
+                # the named built-in, override stopwords when given
+                base = reg.get(atype)
+                filters = list(base.filters)
+                if "stopwords" in spec:
+                    filters = list(filters) + [
+                        stop_filter(_resolve_stopwords(spec["stopwords"]))]
+                reg.register(Analyzer(name, base.tokenizer, filters))
+                continue
+            tok_name = spec.get("tokenizer", "standard")
+            tokenizer = custom_tokenizers.get(tok_name) \
+                or _builtin_tokenizer(tok_name)
+            filters = []
+            for f in _as_list(spec.get("filter", [])):
+                if f in custom_filters:
+                    filters.append(custom_filters[f])
+                else:
+                    filters.append(_builtin_filter(f))
+            reg.register(Analyzer(name, tokenizer, filters))
+        return reg
+
+
+def _as_list(v):
+    if isinstance(v, str):
+        return [p.strip() for p in v.split(",") if p.strip()]
+    return list(v or [])
+
+
+def _resolve_stopwords(value) -> frozenset:
+    """Stopword spec → set; "_lang_" macros resolve to the language list,
+    "_none_" disables, unknown macros error (a typo silently becoming the
+    English list is invisible data corruption)."""
+    if isinstance(value, str) and value.startswith("_") and value.endswith("_"):
+        name = value.strip("_")
+        if name == "none":
+            return frozenset()
+        if name == "english":
+            return ENGLISH_STOPWORDS
+        from elasticsearch_tpu.index.analysis_lang import STOPWORDS
+        if name in STOPWORDS:
+            return STOPWORDS[name]
+        raise IllegalArgumentError(f"failed to find stopwords set [{value}]")
+    return frozenset(_as_list(value))
+
+
+def _nest_analysis_settings(flat: Dict) -> Dict:
+    """{"index.analysis.analyzer.my.type": "custom", ...} →
+    {"analyzer": {"my": {"type": "custom", ...}}}; list-valued leaves pass
+    through (filter: [...])."""
+    out: Dict = {}
+    for key, value in (flat or {}).items():
+        if not key.startswith("index.analysis."):
+            continue
+        parts = key[len("index.analysis."):].split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def _builtin_tokenizer(name: str):
+    table = {
+        "standard": standard_tokenizer,
+        "whitespace": whitespace_tokenizer,
+        "letter": letter_tokenizer,
+        "keyword": keyword_tokenizer,
+        "lowercase": lambda text: lowercase_filter(letter_tokenizer(text)),
+    }
+    if name in table:
+        return table[name]
+    if name == "ngram":
+        return ngram_tokenizer()
+    if name == "edge_ngram":
+        return edge_ngram_tokenizer()
+    from elasticsearch_tpu.index.analysis_lang import cjk_tokenizer
+    if name in ("cjk", "kuromoji_tokenizer", "nori_tokenizer", "smartcn_tokenizer"):
+        return cjk_tokenizer
+    raise IllegalArgumentError(f"failed to find tokenizer [{name}]")
+
+
+def _build_tokenizer(spec: Dict):
+    ttype = spec.get("type", "standard")
+    if ttype == "ngram":
+        return ngram_tokenizer(int(spec.get("min_gram", 1)),
+                               int(spec.get("max_gram", 2)))
+    if ttype == "edge_ngram":
+        return edge_ngram_tokenizer(int(spec.get("min_gram", 1)),
+                                    int(spec.get("max_gram", 10)))
+    if ttype == "pattern":
+        pat = re.compile(spec.get("pattern", r"\W+"))
+
+        def tokenize(text: str, pat=pat):
+            # tokens are the gaps between separator matches, with real
+            # offsets (highlighting depends on them)
+            out = []
+            pos = 0
+            start = 0
+            for m in pat.finditer(text):
+                if m.start() > start:
+                    out.append(Token(text[start:m.start()], pos, start,
+                                     m.start()))
+                    pos += 1
+                start = max(m.end(), start + 1 if m.end() == m.start()
+                            else m.end())
+            if start < len(text):
+                out.append(Token(text[start:], pos, start, len(text)))
+            return out
+
+        return tokenize
+    return _builtin_tokenizer(ttype)
+
+
+def _builtin_filter(name: str):
+    from elasticsearch_tpu.index import analysis_lang as lang
+    table = {
+        "lowercase": lowercase_filter,
+        "asciifolding": asciifolding_filter,
+        "stop": stop_filter(),
+        "porter_stem": porter_stem_filter,
+        "stemmer": porter_stem_filter,
+        "kstem": porter_stem_filter,
+        "snowball": porter_stem_filter,
+        "elision": lang.elision_filter,
+        "icu_folding": lang.icu_folding_filter,
+        "icu_normalizer": lang.icu_folding_filter,
+        "trim": lang.trim_filter,
+        "unique": lang.unique_filter,
+        "reverse": lang.reverse_filter,
+        "shingle": lang.shingle_filter(),
+        "edge_ngram": lang.edge_ngram_filter(),
+        "ngram": lang.ngram_filter(),
+        "phonetic": lang.phonetic_filter(),
+        "truncate": lang.truncate_filter(),
+        "length": lang.length_filter(),
+        "classic": lowercase_filter,
+        "uppercase": lambda toks: [t._replace(term=t.term.upper())
+                                   for t in toks],
+        "decimal_digit": lambda toks: [
+            t._replace(term="".join(
+                str(unicodedata.digit(c)) if c.isdigit() else c
+                for c in t.term)) for t in toks],
+    }
+    if name in table:
+        return table[name]
+    raise IllegalArgumentError(f"failed to find token filter [{name}]")
+
+
+def _build_filter(spec: Dict):
+    from elasticsearch_tpu.index import analysis_lang as lang
+    ftype = spec.get("type")
+    if ftype == "stop":
+        return stop_filter(_resolve_stopwords(spec.get("stopwords",
+                                                       "_english_")))
+    if ftype == "stemmer":
+        return lang.stemmer_filter(spec.get("language", "english"))
+    if ftype == "synonym" or ftype == "synonym_graph":
+        return lang.synonym_filter(_as_list(spec.get("synonyms", [])))
+    if ftype == "shingle":
+        return lang.shingle_filter(
+            int(spec.get("min_shingle_size", 2)),
+            int(spec.get("max_shingle_size", 2)),
+            bool(spec.get("output_unigrams", True)))
+    if ftype == "edge_ngram":
+        return lang.edge_ngram_filter(int(spec.get("min_gram", 1)),
+                                      int(spec.get("max_gram", 10)))
+    if ftype == "ngram":
+        return lang.ngram_filter(int(spec.get("min_gram", 1)),
+                                 int(spec.get("max_gram", 2)))
+    if ftype == "phonetic":
+        return lang.phonetic_filter(spec.get("encoder", "metaphone"),
+                                    bool(spec.get("replace", True)))
+    if ftype == "truncate":
+        return lang.truncate_filter(int(spec.get("length", 10)))
+    if ftype == "length":
+        return lang.length_filter(int(spec.get("min", 0)),
+                                  int(spec.get("max", 255)))
+    if ftype == "pattern_replace":
+        pat = re.compile(spec.get("pattern", ""))
+        repl = spec.get("replacement", "")
+        return lambda toks: [t._replace(term=pat.sub(repl, t.term))
+                             for t in toks]
+    if ftype:
+        return _builtin_filter(ftype)
+    raise IllegalArgumentError("token filter definition requires [type]")
 
 
 def built_in_analyzers() -> List[Analyzer]:
@@ -282,4 +491,16 @@ def built_in_analyzers() -> List[Analyzer]:
     ]
 
 
-DEFAULT_REGISTRY = AnalysisRegistry()
+# DEFAULT_REGISTRY is constructed lazily (PEP 562 module __getattr__):
+# building it at import time would re-enter analysis_lang while that module
+# is still initializing whenever analysis_lang is imported first.
+_default_registry: Optional[AnalysisRegistry] = None
+
+
+def __getattr__(name: str):
+    if name == "DEFAULT_REGISTRY":
+        global _default_registry
+        if _default_registry is None:
+            _default_registry = AnalysisRegistry()
+        return _default_registry
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

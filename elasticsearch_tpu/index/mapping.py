@@ -937,7 +937,8 @@ FIELD_TYPES = {
 }
 
 
-def build_mapper(name: str, definition: dict) -> FieldMapper:
+def build_mapper(name: str, definition: dict,
+                 registry: Optional[AnalysisRegistry] = None) -> FieldMapper:
     t = definition.get("type", "object" if "properties" in definition else None)
     if t is None:
         raise MapperParsingError(f"no type specified for field [{name}]")
@@ -945,6 +946,9 @@ def build_mapper(name: str, definition: dict) -> FieldMapper:
     if cls is None:
         raise MapperParsingError(f"No handler for type [{t}] declared on field [{name}]")
     params = {k: v for k, v in definition.items() if k not in ("type", "properties", "fields")}
+    if registry is not None and issubclass(cls, (TextFieldMapper,
+                                                 TokenCountFieldMapper)):
+        return cls(name, params, registry=registry)
     return cls(name, params)
 
 
@@ -959,11 +963,13 @@ class MapperService:
     existing field's type is rejected.
     """
 
-    def __init__(self, mapping: Optional[dict] = None, dynamic: bool = True):
+    def __init__(self, mapping: Optional[dict] = None, dynamic: bool = True,
+                 registry: Optional[AnalysisRegistry] = None):
         # flat map "a.b.c" -> FieldMapper
         self._mappers: Dict[str, FieldMapper] = {}
         # fields with subfields (multi-fields), e.g. text with .keyword
         self._multi_fields: Dict[str, Dict[str, FieldMapper]] = {}
+        self.registry = registry or DEFAULT_REGISTRY
         self.dynamic = dynamic
         self._meta: dict = {}
         # set on any mapping mutation; cleared by whoever persists the mapping
@@ -991,11 +997,11 @@ class MapperService:
                 if definition.get("type") == "nested":
                     self._put(path, NestedMapper(path, {}))
                 continue
-            mapper = build_mapper(path, definition)
+            mapper = build_mapper(path, definition, self.registry)
             self._put(path, mapper)
             for sub_name, sub_def in (definition.get("fields") or {}).items():
                 sub_path = f"{path}.{sub_name}"
-                sub = build_mapper(sub_path, sub_def)
+                sub = build_mapper(sub_path, sub_def, self.registry)
                 self._multi_fields.setdefault(path, {})[sub_name] = sub
                 self._put(sub_path, sub)
             if isinstance(mapper, SearchAsYouTypeFieldMapper):
@@ -1209,5 +1215,5 @@ class MapperService:
             else:
                 if re.match(r"\d{4}-\d{2}-\d{2}", probe):
                     return DateFieldMapper(path, {})
-            return TextFieldMapper(path, {})
+            return TextFieldMapper(path, {}, registry=self.registry)
         return None

@@ -65,7 +65,12 @@ class IndexService:
         self.uuid = uuid
         self.settings = settings
         self.creation_date = int(time.time() * 1000)
-        self.mapper_service = MapperService(mapping or {"properties": {}})
+        from elasticsearch_tpu.index.analysis import AnalysisRegistry
+        registry = AnalysisRegistry.from_index_settings(
+            settings.as_flat_dict())
+        self.analysis_registry = registry
+        self.mapper_service = MapperService(mapping or {"properties": {}},
+                                            registry=registry)
         self.num_shards = int(settings.get("index.number_of_shards", 1))
         self.num_replicas = int(settings.get("index.number_of_replicas", 1))
         if self.num_shards < 1 or self.num_shards > 1024:

@@ -560,10 +560,14 @@ class Node:
                 responses.append({"error": e.to_dict(), "status": e.status})
         return {"took": 0, "responses": responses}
 
-    def analyze(self, body: dict) -> dict:
+    def analyze(self, body: dict, index: Optional[str] = None) -> dict:
         text = body.get("text", "")
         texts = text if isinstance(text, list) else [text]
-        analyzer = DEFAULT_REGISTRY.get(body.get("analyzer", "standard"))
+        registry = DEFAULT_REGISTRY
+        if index and self.indices.exists(index):
+            # index-scoped: custom analyzers from index.analysis.* settings
+            registry = self.indices.get(index).analysis_registry
+        analyzer = registry.get(body.get("analyzer", "standard"))
         tokens = []
         pos = 0
         for t in texts:

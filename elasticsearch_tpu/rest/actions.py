@@ -192,7 +192,8 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_msearch", msearch)
 
     def analyze(req):
-        return 200, node.analyze(req.json() or {})
+        return 200, node.analyze(req.json() or {},
+                                 index=req.params.get("index"))
 
     rc.register("GET", "/_analyze", analyze)
     rc.register("POST", "/_analyze", analyze)

@@ -1,0 +1,212 @@
+"""Language analysis + custom analyzers from index settings (reference:
+modules/analysis-common, plugins/analysis-{icu,phonetic,kuromoji,nori,
+smartcn,...}, AnalysisRegistry building per-index components)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY, AnalysisRegistry
+from elasticsearch_tpu.index.analysis_lang import (
+    cjk_tokenizer,
+    metaphone,
+    soundex,
+)
+from elasticsearch_tpu.node import Node
+
+
+def test_language_analyzers_registered():
+    for lang in ("french", "german", "spanish", "italian", "portuguese",
+                 "dutch", "russian", "swedish", "norwegian", "danish",
+                 "finnish", "cjk", "kuromoji", "nori", "smartcn",
+                 "icu_analyzer"):
+        assert DEFAULT_REGISTRY.get(lang) is not None
+
+
+def test_french_stemming_and_elision():
+    a = DEFAULT_REGISTRY.get("french")
+    # stopwords removed, elision stripped, suffixes conflated
+    assert a.terms("l'avion et les avions") == ["avion", "avion"]
+    # same stem for inflections
+    assert a.terms("nationale")[0] == a.terms("nationales")[0]
+
+
+def test_german_stemming():
+    a = DEFAULT_REGISTRY.get("german")
+    assert a.terms("der Hund und die Hunde") == ["hund", "hund"]
+
+
+def test_russian_analyzer():
+    a = DEFAULT_REGISTRY.get("russian")
+    t1 = a.terms("книга")
+    t2 = a.terms("книги")
+    assert t1 and t1 == t2  # inflections conflate
+
+
+def test_cjk_bigrams():
+    toks = [t.term for t in cjk_tokenizer("日本語テキスト")]
+    assert "日本" in toks and "本語" in toks
+    # mixed latin + cjk
+    toks = [t.term for t in cjk_tokenizer("Hello 世界")]
+    assert "hello" in toks and "世界" in toks
+    # hangul
+    toks = [t.term for t in cjk_tokenizer("한국어")]
+    assert "한국" in toks and "국어" in toks
+
+
+def test_icu_folding():
+    a = DEFAULT_REGISTRY.get("icu_analyzer")
+    assert a.terms("Ｈéllo ＷÖRLD") == ["hello", "world"]
+
+
+def test_phonetic_encoders():
+    assert soundex("robert") == soundex("rupert")
+    assert soundex("smith") == soundex("smyth")
+    assert metaphone("phone") == metaphone("fone")
+    assert metaphone("night") != ""
+
+
+def test_custom_analyzer_from_index_settings():
+    reg = AnalysisRegistry.from_index_settings({
+        "index.analysis.filter.my_syns.type": "synonym",
+        "index.analysis.filter.my_syns.synonyms": ["car, automobile",
+                                                   "tv => television"],
+        "index.analysis.analyzer.my_an.type": "custom",
+        "index.analysis.analyzer.my_an.tokenizer": "standard",
+        "index.analysis.analyzer.my_an.filter": ["lowercase", "my_syns"],
+    })
+    a = reg.get("my_an")
+    assert sorted(a.terms("Car")) == ["automobile", "car"]
+    assert a.terms("TV") == ["television"]
+
+
+def test_custom_edge_ngram_tokenizer():
+    reg = AnalysisRegistry.from_index_settings({
+        "index.analysis.tokenizer.auto.type": "edge_ngram",
+        "index.analysis.tokenizer.auto.min_gram": 2,
+        "index.analysis.tokenizer.auto.max_gram": 4,
+        "index.analysis.analyzer.ac.type": "custom",
+        "index.analysis.analyzer.ac.tokenizer": "auto",
+        "index.analysis.analyzer.ac.filter": ["lowercase"],
+    })
+    assert reg.get("ac").terms("Quick") == ["qu", "qui", "quic"]
+
+
+def test_custom_stop_filter_language_set():
+    reg = AnalysisRegistry.from_index_settings({
+        "index.analysis.filter.fr_stop.type": "stop",
+        "index.analysis.filter.fr_stop.stopwords": "_french_",
+        "index.analysis.analyzer.fr.type": "custom",
+        "index.analysis.analyzer.fr.tokenizer": "standard",
+        "index.analysis.analyzer.fr.filter": ["lowercase", "fr_stop"],
+    })
+    assert reg.get("fr").terms("le chat") == ["chat"]
+
+
+def test_unknown_filter_rejected():
+    with pytest.raises(IllegalArgumentError):
+        AnalysisRegistry.from_index_settings({
+            "index.analysis.analyzer.x.type": "custom",
+            "index.analysis.analyzer.x.tokenizer": "standard",
+            "index.analysis.analyzer.x.filter": ["definitely_not_a_filter"],
+        })
+
+
+def test_import_order_independent():
+    """Importing analysis_lang before analysis must not crash (lazy
+    DEFAULT_REGISTRY)."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import elasticsearch_tpu.index.analysis_lang; "
+         "from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY; "
+         "assert DEFAULT_REGISTRY.get('french')"],
+        capture_output=True, cwd=".", env={"PYTHONPATH": ".",
+                                           "PATH": "/usr/bin:/bin"})
+    assert r.returncode == 0, r.stderr.decode()
+
+
+def test_stopword_macros():
+    reg = AnalysisRegistry.from_index_settings({
+        "index.analysis.analyzer.s.type": "standard",
+        "index.analysis.analyzer.s.stopwords": "_french_"})
+    assert reg.get("s").terms("le chat et le chien") == ["chat", "chien"]
+    reg = AnalysisRegistry.from_index_settings({
+        "index.analysis.filter.ns.type": "stop",
+        "index.analysis.filter.ns.stopwords": "_none_",
+        "index.analysis.analyzer.a.type": "custom",
+        "index.analysis.analyzer.a.tokenizer": "standard",
+        "index.analysis.analyzer.a.filter": ["lowercase", "ns"]})
+    assert reg.get("a").terms("to be or not") == ["to", "be", "or", "not"]
+    with pytest.raises(IllegalArgumentError):
+        AnalysisRegistry.from_index_settings({
+            "index.analysis.filter.x.type": "stop",
+            "index.analysis.filter.x.stopwords": "_klingon_",
+            "index.analysis.analyzer.a.type": "custom",
+            "index.analysis.analyzer.a.tokenizer": "standard",
+            "index.analysis.analyzer.a.filter": ["x"]})
+    with pytest.raises(IllegalArgumentError):
+        AnalysisRegistry.from_index_settings({
+            "index.analysis.filter.st.type": "stemmer",
+            "index.analysis.filter.st.language": "klingon",
+            "index.analysis.analyzer.a.type": "custom",
+            "index.analysis.analyzer.a.tokenizer": "standard",
+            "index.analysis.analyzer.a.filter": ["st"]})
+
+
+def test_pattern_tokenizer_offsets():
+    reg = AnalysisRegistry.from_index_settings({
+        "index.analysis.tokenizer.p.type": "pattern",
+        "index.analysis.tokenizer.p.pattern": ",",
+        "index.analysis.analyzer.pa.type": "custom",
+        "index.analysis.analyzer.pa.tokenizer": "p"})
+    toks = reg.get("pa").analyze("foo,bar,baz")
+    assert [(t.term, t.start_offset, t.end_offset) for t in toks] == \
+        [("foo", 0, 3), ("bar", 4, 7), ("baz", 8, 11)]
+
+
+def test_end_to_end_custom_analyzer_search(tmp_path):
+    """Index created with a custom analyzer; text field uses it; search
+    matches through synonyms."""
+    node = Node(str(tmp_path / "d"))
+    try:
+        node.create_index_with_templates("products", settings={
+            "index.analysis.filter.syn.type": "synonym",
+            "index.analysis.filter.syn.synonyms": ["laptop, notebook"],
+            "index.analysis.analyzer.product_an.type": "custom",
+            "index.analysis.analyzer.product_an.tokenizer": "standard",
+            "index.analysis.analyzer.product_an.filter": ["lowercase",
+                                                          "syn"],
+        }, mappings={"properties": {
+            "name": {"type": "text", "analyzer": "product_an"}}})
+        node.index_doc("products", "1", {"name": "Gaming Laptop"},
+                       refresh="true")
+        resp = node.search("products", {"query": {"match": {"name":
+                                                            "notebook"}}})
+        assert resp["hits"]["total"]["value"] == 1
+        # _analyze with index-scoped analyzer
+        out = node.analyze({"analyzer": "product_an",
+                            "text": "notebook"}, index="products")
+        assert sorted(t["token"] for t in out["tokens"]) == ["laptop",
+                                                             "notebook"]
+    finally:
+        node.close()
+
+
+def test_phonetic_search_end_to_end(tmp_path):
+    node = Node(str(tmp_path / "d"))
+    try:
+        node.create_index_with_templates("people", settings={
+            "index.analysis.filter.ph.type": "phonetic",
+            "index.analysis.filter.ph.encoder": "soundex",
+            "index.analysis.analyzer.name_ph.type": "custom",
+            "index.analysis.analyzer.name_ph.tokenizer": "standard",
+            "index.analysis.analyzer.name_ph.filter": ["lowercase", "ph"],
+        }, mappings={"properties": {
+            "name": {"type": "text", "analyzer": "name_ph"}}})
+        node.index_doc("people", "1", {"name": "Robert"}, refresh="true")
+        resp = node.search("people", {"query": {"match": {"name":
+                                                          "Rupert"}}})
+        assert resp["hits"]["total"]["value"] == 1  # phonetic match
+    finally:
+        node.close()
