@@ -1,0 +1,147 @@
+"""Secure settings keystore.
+
+Re-design of `common/settings/KeyStoreWrapper.java` + the `keystore-cli`
+tool (SURVEY.md §5.6): an on-disk store of secret settings (passwords,
+repository credentials) kept out of yml/env config, optionally protected
+by a passphrase, loaded into the node's settings at boot under their
+setting names.
+
+Cipher construction (stdlib-only — no AES in the standard library):
+PBKDF2-HMAC-SHA256 key derivation (200k iterations, random 16-byte salt),
+a counter-mode keystream of HMAC-SHA256(key, nonce || counter) blocks
+XORed over the JSON payload, and an encrypt-then-MAC HMAC-SHA256 integrity
+tag over header+ciphertext. Like the reference's default, an empty
+passphrase still encrypts (obfuscation + tamper detection) so secrets
+never sit in plaintext on disk.
+
+File layout: magic "TPKS" | version u8 | salt 16 | nonce 16 | mac 32 |
+ciphertext.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import secrets
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+_MAGIC = b"TPKS"
+_VERSION = 1
+_ITERATIONS = 200_000
+
+
+def _derive_key(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"), salt,
+                               _ITERATIONS, dklen=32)
+
+
+def _keystream_xor(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    out = bytearray()
+    counter = 0
+    for i in range(0, len(data), 32):
+        block = hmac.new(key, nonce + counter.to_bytes(8, "big"),
+                         hashlib.sha256).digest()
+        chunk = data[i:i + 32]
+        out.extend(b ^ k for b, k in zip(chunk, block))
+        counter += 1
+    return bytes(out)
+
+
+class KeyStore:
+    """In-memory secrets map with encrypted load/save."""
+
+    def __init__(self, path: str, password: str = ""):
+        self.path = path
+        self._password = password
+        self._secrets: Dict[str, str] = {}
+
+    # --------------------------------------------------------------- file IO
+    @classmethod
+    def create(cls, path: str, password: str = "") -> "KeyStore":
+        ks = cls(path, password)
+        ks.save()
+        return ks
+
+    @classmethod
+    def load(cls, path: str, password: str = "") -> "KeyStore":
+        ks = cls(path, password)
+        with open(path, "rb") as f:
+            blob = f.read()
+        if len(blob) < 4 + 1 + 16 + 16 + 32 or blob[:4] != _MAGIC:
+            raise IllegalArgumentError(f"[{path}] is not a keystore file")
+        version = blob[4]
+        if version != _VERSION:
+            raise IllegalArgumentError(
+                f"unsupported keystore version [{version}]")
+        salt = blob[5:21]
+        nonce = blob[21:37]
+        mac = blob[37:69]
+        ciphertext = blob[69:]
+        key = _derive_key(password, salt)
+        expect = hmac.new(key, blob[:37] + ciphertext,
+                          hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, expect):
+            raise IllegalArgumentError(
+                "keystore password is incorrect or the file is corrupted")
+        payload = _keystream_xor(key, nonce, ciphertext)
+        ks._secrets = json.loads(payload.decode("utf-8"))
+        return ks
+
+    @classmethod
+    def load_or_create(cls, path: str, password: str = "") -> "KeyStore":
+        if os.path.exists(path):
+            return cls.load(path, password)
+        return cls.create(path, password)
+
+    def save(self) -> None:
+        salt = secrets.token_bytes(16)
+        nonce = secrets.token_bytes(16)
+        key = _derive_key(self._password, salt)
+        payload = json.dumps(self._secrets).encode("utf-8")
+        ciphertext = _keystream_xor(key, nonce, payload)
+        header = _MAGIC + bytes([_VERSION]) + salt + nonce
+        mac = hmac.new(key, header + ciphertext, hashlib.sha256).digest()
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(header + mac + ciphertext)
+        os.replace(tmp, self.path)
+        try:
+            os.chmod(self.path, 0o600)
+        except OSError:
+            pass
+
+    # --------------------------------------------------------------- secrets
+    def set(self, name: str, value: str) -> None:
+        _validate_setting_name(name)
+        self._secrets[name] = str(value)
+
+    def get(self, name: str) -> Optional[str]:
+        return self._secrets.get(name)
+
+    def remove(self, name: str) -> None:
+        if name not in self._secrets:
+            raise IllegalArgumentError(
+                f"setting [{name}] does not exist in the keystore")
+        del self._secrets[name]
+
+    def list(self) -> List[str]:
+        return sorted(self._secrets)
+
+    def as_settings(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    def change_password(self, new_password: str) -> None:
+        self._password = new_password
+
+
+def _validate_setting_name(name: str) -> None:
+    if not name or not all(c.isalnum() or c in "._-" for c in name):
+        raise IllegalArgumentError(
+            f"invalid setting name [{name}]: only alphanumerics, '.', '_' "
+            f"and '-' are allowed")

@@ -1,0 +1,81 @@
+"""Keystore CLI (reference: `distribution/tools/keystore-cli` —
+create/list/add/remove subcommands).
+
+Usage:
+    python -m elasticsearch_tpu.keystore_cli create [--path P] [--password]
+    python -m elasticsearch_tpu.keystore_cli list   [--path P] [--password]
+    python -m elasticsearch_tpu.keystore_cli add NAME [--path P] [--stdin]
+    python -m elasticsearch_tpu.keystore_cli remove NAME [--path P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import os
+import sys
+
+from elasticsearch_tpu.common.keystore import KeyStore
+
+DEFAULT_PATH = os.environ.get("TPU_SEARCH_KEYSTORE",
+                              "config/tpu_search.keystore")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="keystore_cli")
+    parser.add_argument("command",
+                        choices=["create", "list", "add", "remove"])
+    parser.add_argument("name", nargs="?")
+    parser.add_argument("--data", default=None,
+                        help="node data path — the keystore lives at "
+                             "<data>/config/tpu_search.keystore, where the "
+                             "node looks for it at boot")
+    parser.add_argument("--path", default=None,
+                        help="explicit keystore file path (overrides --data)")
+    parser.add_argument("--password", action="store_true",
+                        help="prompt for a keystore passphrase")
+    parser.add_argument("--stdin", action="store_true",
+                        help="read the secret value from stdin")
+    args = parser.parse_args(argv)
+    if args.path is None:
+        args.path = (os.path.join(args.data, "config", "tpu_search.keystore")
+                     if args.data else DEFAULT_PATH)
+
+    password = ""
+    if args.password:
+        password = getpass.getpass("Keystore password: ")
+
+    if args.command == "create":
+        if os.path.exists(args.path):
+            print(f"keystore already exists at [{args.path}]",
+                  file=sys.stderr)
+            return 1
+        KeyStore.create(args.path, password)
+        print(f"Created keystore [{args.path}]")
+        return 0
+
+    ks = KeyStore.load(args.path, password)
+    if args.command == "list":
+        for name in ks.list():
+            print(name)
+        return 0
+    if not args.name:
+        print("setting name required", file=sys.stderr)
+        return 1
+    if args.command == "add":
+        if args.stdin:
+            value = sys.stdin.readline().rstrip("\n")
+        else:
+            value = getpass.getpass(f"Value for [{args.name}]: ")
+        ks.set(args.name, value)
+        ks.save()
+        return 0
+    if args.command == "remove":
+        ks.remove(args.name)
+        ks.save()
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,7 +120,25 @@ class Node:
         # cluster-level persistent/transient settings (_cluster/settings API)
         self.cluster_settings: Dict[str, dict] = {"persistent": {},
                                                   "transient": {}}
-        self.settings = settings or {}
+        # copy: merging keystore secrets into a caller-shared dict would
+        # leak plaintext secrets into the caller's object
+        self.settings = dict(settings or {})
+        # secure settings FIRST: keystore secrets merge under their names
+        # without overriding explicit settings, before any service reads
+        # them (reference: KeyStoreWrapper loaded in Bootstrap, exposed via
+        # Settings#getSecureSettings)
+        self.keystore = None
+        ks_path = self.settings.get(
+            "path.keystore", _os.path.join(data_path, "config",
+                                           "tpu_search.keystore"))
+        if _os.path.exists(ks_path):
+            from elasticsearch_tpu.common.keystore import KeyStore
+            self.keystore = KeyStore.load(
+                ks_path, str(self.settings.get("keystore.password",
+                                               _os.environ.get(
+                                                   "KEYSTORE_PASSWORD", ""))))
+            for name, value in self.keystore.as_settings().items():
+                self.settings.setdefault(name, value)
         from elasticsearch_tpu.security import SecurityService, SecurityStore
         self.security = SecurityService(
             SecurityStore(_os.path.join(data_path, "_state", "security.json")),
@@ -138,6 +156,13 @@ class Node:
         self.graph = GraphService(self)
         from elasticsearch_tpu.xpack.monitoring import MonitoringService
         self.monitoring = MonitoringService(self)
+        from elasticsearch_tpu.plugins import PluginsService
+        self.plugins = PluginsService(
+            self.settings.get("path.plugins",
+                              _os.path.join(data_path, "plugins")))
+        self.plugins.load_all()
+        self.plugins.apply_extensions()
+        self.plugins.start_node(self)
         self.start_time = time.time()
 
     # ------------------------------------------------------------- documents
@@ -605,6 +630,7 @@ class Node:
 
     def close(self):
         self.ml.close_all()
+        self.plugins.remove_extensions()
         self.indices.close()
 
 

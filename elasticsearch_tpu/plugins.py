@@ -1,0 +1,224 @@
+"""Plugin system: discovery, loading, and the SPI extension points.
+
+Re-design of the reference's plugin architecture (`server/src/main/java/org/
+elasticsearch/plugins/` — `PluginsService.java`, `Plugin` + the per-layer
+interfaces `SearchPlugin`/`MapperPlugin`/`AnalysisPlugin`/`IngestPlugin`/
+`ActionPlugin`/`ScriptPlugin`, SURVEY.md §2.1 "Plugin system" and the
+`plugins/examples/` SPI documentation).
+
+A plugin is a directory containing `plugin.py` (defining one `Plugin`
+subclass) plus `plugin-descriptor.properties`-style metadata in
+`plugin.json`. Loading uses importlib with a unique module name per plugin
+(the Python analog of the reference's per-plugin classloader isolation —
+two plugins can both ship a `util` module without clashing).
+
+Extension points mirror the reference interfaces:
+- get_analyzers()      -> AnalysisPlugin#getAnalyzers
+- get_field_mappers()  -> MapperPlugin#getMappers
+- get_queries()        -> SearchPlugin#getQueries
+- get_processors()     -> IngestPlugin#getProcessors
+- get_rest_handlers()  -> ActionPlugin#getRestHandlers
+- get_settings()       -> Plugin#getSettings
+- on_node_start()      -> lifecycle component hook
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+from typing import Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+# SearchPlugin-contributed query parsers, consulted by parse_extended after
+# the built-in table misses (reference: SearchModule collects
+# SearchPlugin.getQueries into the named-parser registry)
+EXTRA_QUERY_PARSERS: Dict[str, Callable] = {}
+
+
+class Plugin:
+    """Base class for plugins (reference: plugins/Plugin.java)."""
+
+    name = "unnamed"
+    description = ""
+    version = "0.0.0"
+
+    def get_settings(self) -> dict:
+        """Default settings this plugin contributes."""
+        return {}
+
+    def get_analyzers(self) -> list:
+        """[Analyzer] to register globally."""
+        return []
+
+    def get_field_mappers(self) -> list:
+        """[FieldMapper subclass] — each registered by its type_name."""
+        return []
+
+    def get_queries(self) -> Dict[str, Callable]:
+        """{query_name: parser(spec) -> Query}."""
+        return {}
+
+    def get_processors(self) -> list:
+        """[Processor subclass] — each registered by its kind."""
+        return []
+
+    def get_rest_handlers(self, rest_controller, node) -> None:
+        """Register REST routes (called during node wiring)."""
+
+    def on_node_start(self, node) -> None:
+        """Lifecycle hook after the node's services exist."""
+
+
+class PluginInfo:
+    def __init__(self, name: str, description: str, version: str, path: str):
+        self.name = name
+        self.description = description
+        self.version = version
+        self.path = path
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "description": self.description,
+                "version": self.version}
+
+
+class PluginsService:
+    """Discovers and applies plugins (reference: PluginsService.java)."""
+
+    def __init__(self, plugin_dir: Optional[str] = None):
+        self.plugin_dir = plugin_dir
+        self.plugins: List[Plugin] = []
+        self.infos: List[PluginInfo] = []
+        self._applied = False
+        self._node_started = False
+        self._installed: Dict[str, list] = {
+            "analyzers": [], "mappers": [], "queries": [], "processors": []}
+
+    # ------------------------------------------------------------ discovery
+    def load_all(self) -> None:
+        if not self.plugin_dir or not os.path.isdir(self.plugin_dir):
+            return
+        for entry in sorted(os.listdir(self.plugin_dir)):
+            path = os.path.join(self.plugin_dir, entry)
+            if os.path.isdir(path) and os.path.exists(
+                    os.path.join(path, "plugin.py")):
+                self.load_plugin(path)
+
+    def load_plugin(self, path: str) -> Plugin:
+        """Load one plugin directory under an isolated module name."""
+        meta = {}
+        meta_path = os.path.join(path, "plugin.json")
+        if os.path.exists(meta_path):
+            with open(meta_path, "r", encoding="utf-8") as f:
+                meta = json.load(f)
+        # unique module name = classloader isolation analog
+        mod_name = f"tpu_search_plugin_{os.path.basename(path)}_{len(self.plugins)}"
+        spec = importlib.util.spec_from_file_location(
+            mod_name, os.path.join(path, "plugin.py"))
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = module
+        try:
+            spec.loader.exec_module(module)
+        except Exception as e:
+            del sys.modules[mod_name]
+            raise IllegalArgumentError(
+                f"failed to load plugin [{path}]: {e}") from e
+        # the plugin's OWN class: defined in this module (imported Plugin
+        # subclasses, e.g. a shared base, must not be instantiated), most
+        # derived wins if several are defined
+        candidates = [obj for obj in vars(module).values()
+                      if isinstance(obj, type) and issubclass(obj, Plugin)
+                      and obj is not Plugin
+                      and obj.__module__ == mod_name]
+        plugin_cls = None
+        for cls in candidates:
+            if not any(cls is not other and issubclass(other, cls)
+                       for other in candidates):
+                plugin_cls = cls
+                break
+        if plugin_cls is None:
+            del sys.modules[mod_name]
+            raise IllegalArgumentError(
+                f"plugin [{path}] defines no Plugin subclass")
+        plugin = plugin_cls()
+        plugin.name = meta.get("name", plugin.name if plugin.name != "unnamed"
+                               else os.path.basename(path))
+        plugin.description = meta.get("description", plugin.description)
+        plugin.version = meta.get("version", plugin.version)
+        self.plugins.append(plugin)
+        self.infos.append(PluginInfo(plugin.name, plugin.description,
+                                     plugin.version, path))
+        return plugin
+
+    def register(self, plugin: Plugin) -> None:
+        """Programmatic registration (tests, embedded use)."""
+        self.plugins.append(plugin)
+        self.infos.append(PluginInfo(plugin.name, plugin.description,
+                                     plugin.version, "<embedded>"))
+
+    # ------------------------------------------------------------- applying
+    def apply_extensions(self) -> None:
+        """Install every plugin's contributions into the shared registries,
+        remembering what was installed so remove_extensions() can undo it
+        when the owning node closes."""
+        if self._applied:
+            return
+        self._applied = True
+        from elasticsearch_tpu.index import analysis as _analysis
+        from elasticsearch_tpu.index.mapping import FIELD_TYPES
+        from elasticsearch_tpu.ingest.service import PROCESSORS
+
+        self._installed = {"analyzers": [], "mappers": [], "queries": [],
+                           "processors": []}
+        for plugin in self.plugins:
+            for analyzer in plugin.get_analyzers():
+                _analysis.DEFAULT_REGISTRY.register(analyzer)
+                self._installed["analyzers"].append(analyzer.name)
+            for mapper_cls in plugin.get_field_mappers():
+                FIELD_TYPES[mapper_cls.type_name] = mapper_cls
+                self._installed["mappers"].append(mapper_cls.type_name)
+            for name, parser in plugin.get_queries().items():
+                EXTRA_QUERY_PARSERS[name] = parser
+                self._installed["queries"].append(name)
+            for proc_cls in plugin.get_processors():
+                PROCESSORS[proc_cls.kind] = proc_cls
+                self._installed["processors"].append(proc_cls.kind)
+
+    def remove_extensions(self) -> None:
+        """Uninstall this node's plugin contributions from the global
+        registries (a closed node's query kinds must stop parsing)."""
+        if not self._applied:
+            return
+        self._applied = False
+        from elasticsearch_tpu.index import analysis as _analysis
+        from elasticsearch_tpu.index.mapping import FIELD_TYPES
+        from elasticsearch_tpu.ingest.service import PROCESSORS
+        for name in self._installed["analyzers"]:
+            _analysis.DEFAULT_REGISTRY._analyzers.pop(name, None)
+        for name in self._installed["mappers"]:
+            FIELD_TYPES.pop(name, None)
+        for name in self._installed["queries"]:
+            EXTRA_QUERY_PARSERS.pop(name, None)
+        for name in self._installed["processors"]:
+            PROCESSORS.pop(name, None)
+        self._installed = {"analyzers": [], "mappers": [], "queries": [],
+                           "processors": []}
+
+    def start_node(self, node) -> None:
+        """Fire on_node_start once per node, REST or not."""
+        if getattr(self, "_node_started", False):
+            return
+        self._node_started = True
+        for plugin in self.plugins:
+            plugin.on_node_start(node)
+
+    def register_rest(self, rest_controller, node) -> None:
+        """Register plugin REST routes on a controller (idempotent per
+        controller since each register_all builds a fresh table)."""
+        for plugin in self.plugins:
+            plugin.get_rest_handlers(rest_controller, node)
+
+    def info(self) -> List[dict]:
+        return [i.to_dict() for i in self.infos]

@@ -51,6 +51,9 @@ def register_all(rc: RestController, node: Node) -> None:
     )
     register_security(rc, node)
     rc.add_filter(make_security_filter(node.security))
+    # plugin-contributed REST handlers (reference:
+    # ActionPlugin.getRestHandlers); on_node_start fires in Node.__init__
+    node.plugins.register_rest(rc, node)
     # ------------------------------------------------------------------ root
     def root(req):
         return 200, {

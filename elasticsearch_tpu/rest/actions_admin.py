@@ -316,6 +316,8 @@ def register_admin(rc: RestController, node: Node) -> None:
         rows = [[node.node_name, comp, __version__]
                 for comp in ("sql", "eql", "ilm", "watcher", "transform",
                              "rollup", "ccr", "security", "ml")]
+        rows += [[node.node_name, info["name"], info["version"]]
+                 for info in node.plugins.info()]
         return _table(req, ["name", "component", "version"], rows)
 
     def cat_master(req):

@@ -1102,4 +1102,9 @@ def parse_extended(kind: str, spec: Any) -> Optional[Query]:
     if kind == "pinned":
         return PinnedQuery([str(i) for i in spec.get("ids", [])],
                            parse_query(spec.get("organic", {"match_all": {}})))
+    # plugin-contributed parsers (reference: SearchPlugin.getQueries)
+    from elasticsearch_tpu.plugins import EXTRA_QUERY_PARSERS
+    parser = EXTRA_QUERY_PARSERS.get(kind)
+    if parser is not None:
+        return parser(spec)
     return None

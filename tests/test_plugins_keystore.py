@@ -1,0 +1,322 @@
+"""Plugin system (SPI extension points, isolated loading) and the secure
+settings keystore + CLI. Reference: server plugins/ + PluginsService.java,
+common/settings/KeyStoreWrapper.java, distribution/tools/keystore-cli."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.common.keystore import KeyStore
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import EXTRA_QUERY_PARSERS, Plugin, PluginsService
+
+PLUGIN_SRC = '''
+from elasticsearch_tpu.plugins import Plugin
+from elasticsearch_tpu.index.analysis import Analyzer, keyword_tokenizer
+from elasticsearch_tpu.index.mapping import KeywordFieldMapper
+from elasticsearch_tpu.ingest.service import Processor, _set_path
+from elasticsearch_tpu.search.queries import TermQuery
+
+
+class ShoutMapper(KeywordFieldMapper):
+    type_name = "shout"
+
+    def index_terms(self, value):
+        return [str(value).upper()]
+
+    def doc_value(self, value):
+        return str(value).upper()
+
+
+class StampProcessor(Processor):
+    kind = "stamp"
+
+    def run(self, ctx):
+        _set_path(ctx, self.spec.get("target_field", "stamped"), True)
+
+
+class MyPlugin(Plugin):
+    name = "my-plugin"
+    version = "1.2.3"
+
+    def get_analyzers(self):
+        return [Analyzer("verbatim", keyword_tokenizer)]
+
+    def get_field_mappers(self):
+        return [ShoutMapper]
+
+    def get_processors(self):
+        return [StampProcessor]
+
+    def get_queries(self):
+        # exact_upper: term match on the uppercased value
+        return {"exact_upper": lambda spec: TermQuery(
+            spec["field"], str(spec["value"]).upper())}
+
+    def get_rest_handlers(self, rc, node):
+        rc.register("GET", "/_my_plugin/ping",
+                    lambda req: (200, {"pong": True}))
+'''
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_registries():
+    """Plugin extensions install into process-global registries; snapshot
+    and restore them so contributions don't leak across tests."""
+    from elasticsearch_tpu.index import analysis as _an
+    from elasticsearch_tpu.index.mapping import FIELD_TYPES
+    from elasticsearch_tpu.ingest.service import PROCESSORS
+    field_types = dict(FIELD_TYPES)
+    processors = dict(PROCESSORS)
+    analyzers = dict(_an.DEFAULT_REGISTRY._analyzers)
+    parsers = dict(EXTRA_QUERY_PARSERS)
+    yield
+    FIELD_TYPES.clear(); FIELD_TYPES.update(field_types)
+    PROCESSORS.clear(); PROCESSORS.update(processors)
+    _an.DEFAULT_REGISTRY._analyzers = analyzers
+    EXTRA_QUERY_PARSERS.clear(); EXTRA_QUERY_PARSERS.update(parsers)
+
+
+@pytest.fixture
+def plugin_dir(tmp_path):
+    pdir = tmp_path / "plugins" / "my-plugin"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.py").write_text(PLUGIN_SRC)
+    (pdir / "plugin.json").write_text(json.dumps(
+        {"name": "my-plugin", "description": "test plugin",
+         "version": "1.2.3"}))
+    return tmp_path / "plugins"
+
+
+def test_plugin_loading_and_extensions(tmp_path, plugin_dir):
+    node = Node(str(tmp_path / "data"),
+                settings={"path.plugins": str(plugin_dir)})
+    try:
+        assert [p["name"] for p in node.plugins.info()] == ["my-plugin"]
+
+        # field mapper extension
+        node.create_index_with_templates("t", mappings={"properties": {
+            "code": {"type": "shout"}}})
+        node.index_doc("t", "1", {"code": "abc"}, refresh="true")
+        resp = node.search("t", {"query": {"term": {"code": "abc"}}})
+        assert resp["hits"]["total"]["value"] == 1  # coerced to ABC both ways
+
+        # plugin query parser
+        resp = node.search("t", {"query": {"exact_upper": {
+            "field": "code", "value": "abc"}}})
+        assert resp["hits"]["total"]["value"] == 1
+
+        # ingest processor extension
+        node.ingest.put_pipeline("pl", {"processors": [{"stamp": {}}]})
+        node.index_doc("t", "2", {"code": "x"}, pipeline="pl",
+                       refresh="true")
+        assert node.get_doc("t", "2")["_source"]["stamped"] is True
+
+        # analyzer extension
+        from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY
+        assert DEFAULT_REGISTRY.get("verbatim").terms("One Two") == \
+            ["One Two"]
+
+        # REST handler + _cat/plugins
+        from elasticsearch_tpu.rest.actions import register_all
+        from elasticsearch_tpu.rest.controller import RestController
+        rc = RestController()
+        register_all(rc, node)
+        status, body = rc.dispatch("GET", "/_my_plugin/ping", {}, b"",
+                                   "application/json")
+        assert status == 200 and body == {"pong": True}
+        status, body = rc.dispatch("GET", "/_cat/plugins",
+                                   {"format": "json"}, b"",
+                                   "application/json")
+        assert any(row.get("component") == "my-plugin" for row in body)
+    finally:
+        node.close()
+
+
+def test_plugin_module_isolation(tmp_path):
+    """Two plugins both shipping a `helper` import don't clash."""
+    for i, marker in enumerate(("alpha", "beta")):
+        pdir = tmp_path / "plugins" / f"p{i}"
+        pdir.mkdir(parents=True)
+        (pdir / "plugin.py").write_text(f'''
+from elasticsearch_tpu.plugins import Plugin
+
+MARKER = "{marker}"
+
+class P{i}(Plugin):
+    name = "p{i}"
+    def get_queries(self):
+        return {{"q_{marker}": lambda spec: None}}
+''')
+    svc = PluginsService(str(tmp_path / "plugins"))
+    svc.load_all()
+    assert len(svc.plugins) == 2
+    mods = [type(p).__module__ for p in svc.plugins]
+    assert mods[0] != mods[1]  # isolated module names
+
+
+def test_broken_plugin_rejected(tmp_path):
+    pdir = tmp_path / "plugins" / "bad"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.py").write_text("this is not python ][")
+    svc = PluginsService(str(tmp_path / "plugins"))
+    with pytest.raises(IllegalArgumentError):
+        svc.load_plugin(str(pdir))
+
+
+def test_plugin_picks_defined_class_not_imported_base(tmp_path):
+    """An imported Plugin subclass (shared base) must not shadow the
+    plugin's own class."""
+    shared = tmp_path / "shared_base"
+    shared.mkdir()
+    (shared / "base_mod.py").write_text('''
+from elasticsearch_tpu.plugins import Plugin
+
+class SharedBase(Plugin):
+    name = "WRONG-base"
+''')
+    pdir = tmp_path / "plugins" / "derived"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.py").write_text(f'''
+import sys
+sys.path.insert(0, {str(shared)!r})
+from base_mod import SharedBase
+
+class Derived(SharedBase):
+    name = "derived-plugin"
+''')
+    svc = PluginsService(str(tmp_path / "plugins"))
+    svc.load_all()
+    assert svc.plugins[0].name == "derived-plugin"
+
+
+def test_plugin_extensions_removed_on_close(tmp_path, plugin_dir):
+    node = Node(str(tmp_path / "data"),
+                settings={"path.plugins": str(plugin_dir)})
+    from elasticsearch_tpu.plugins import EXTRA_QUERY_PARSERS as EQ
+    assert "exact_upper" in EQ
+    node.close()
+    assert "exact_upper" not in EQ
+    from elasticsearch_tpu.index.mapping import FIELD_TYPES
+    assert "shout" not in FIELD_TYPES
+
+
+def test_on_node_start_fires_once_without_rest(tmp_path):
+    calls = []
+
+    class P(Plugin):
+        name = "p"
+
+        def on_node_start(self, node):
+            calls.append(node.node_id)
+
+    node = Node(str(tmp_path / "data"))
+    try:
+        node.plugins.register(P())
+        node.plugins._node_started = False
+        node.plugins.start_node(node)
+        node.plugins.start_node(node)  # idempotent
+        from elasticsearch_tpu.rest.actions import register_all
+        from elasticsearch_tpu.rest.controller import RestController
+        register_all(RestController(), node)  # must not re-fire
+        register_all(RestController(), node)
+        assert calls == [node.node_id]
+    finally:
+        node.close()
+
+
+def test_keystore_merge_does_not_mutate_caller_settings(tmp_path):
+    ks_path = str(tmp_path / "d" / "config" / "tpu_search.keystore")
+    ks = KeyStore.create(ks_path)
+    ks.set("secret.token", "sssh")
+    ks.save()
+    caller_settings = {"some.flag": True}
+    node = Node(str(tmp_path / "d"), settings=caller_settings)
+    try:
+        assert node.settings["secret.token"] == "sssh"
+        assert "secret.token" not in caller_settings  # caller dict untouched
+    finally:
+        node.close()
+
+
+# ------------------------------------------------------------------ keystore
+
+def test_keystore_roundtrip_and_tamper_detection(tmp_path):
+    path = str(tmp_path / "ks")
+    ks = KeyStore.create(path, password="s3cret")
+    ks.set("s3.client.default.secret_key", "AKIA...")
+    ks.set("bootstrap.password", "hunter2")
+    ks.save()
+
+    ks2 = KeyStore.load(path, password="s3cret")
+    assert ks2.list() == ["bootstrap.password",
+                          "s3.client.default.secret_key"]
+    assert ks2.get("bootstrap.password") == "hunter2"
+
+    with pytest.raises(IllegalArgumentError):
+        KeyStore.load(path, password="wrong")
+
+    # bit-flip in ciphertext → integrity failure, not silent corruption
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(IllegalArgumentError):
+        KeyStore.load(path, password="s3cret")
+
+    # secrets are not plaintext on disk even with empty password
+    ks3 = KeyStore.create(str(tmp_path / "ks2"))
+    ks3.set("token", "super-secret-value")
+    ks3.save()
+    raw = open(str(tmp_path / "ks2"), "rb").read()
+    assert b"super-secret-value" not in raw
+
+
+def test_keystore_feeds_node_settings(tmp_path):
+    ks_path = str(tmp_path / "data" / "config" / "tpu_search.keystore")
+    ks = KeyStore.create(ks_path)
+    ks.set("bootstrap.password", "from-keystore")
+    ks.save()
+    node = Node(str(tmp_path / "data"))
+    try:
+        assert node.settings["bootstrap.password"] == "from-keystore"
+        assert node.keystore is not None
+        # explicit settings win over keystore values
+    finally:
+        node.close()
+    node = Node(str(tmp_path / "data"),
+                settings={"bootstrap.password": "explicit"})
+    try:
+        assert node.settings["bootstrap.password"] == "explicit"
+    finally:
+        node.close()
+
+
+def test_keystore_cli(tmp_path):
+    path = str(tmp_path / "cli.keystore")
+    env = {"PYTHONPATH": ".", "PATH": "/usr/bin:/bin"}
+
+    def cli(*args, stdin=None):
+        return subprocess.run(
+            [sys.executable, "-m", "elasticsearch_tpu.keystore_cli", *args,
+             "--path", path],
+            input=stdin, capture_output=True, text=True, cwd=".", env=env)
+
+    assert cli("create").returncode == 0
+    assert cli("add", "xpack.secret", "--stdin",
+               stdin="value1\n").returncode == 0
+    out = cli("list")
+    assert out.returncode == 0 and out.stdout.strip() == "xpack.secret"
+    assert cli("remove", "xpack.secret").returncode == 0
+    assert cli("list").stdout.strip() == ""
+    # invalid setting name rejected
+    bad = cli("add", "bad name!", "--stdin", stdin="v\n")
+    assert bad.returncode != 0
+
+
+def test_invalid_setting_name():
+    ks = KeyStore("unused")
+    with pytest.raises(IllegalArgumentError):
+        ks.set("spaces not allowed", "v")
