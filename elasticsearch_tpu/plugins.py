@@ -37,6 +37,8 @@ from elasticsearch_tpu.common.errors import IllegalArgumentError
 # SearchPlugin.getQueries into the named-parser registry)
 EXTRA_QUERY_PARSERS: Dict[str, Callable] = {}
 
+_ABSENT = object()  # sentinel: key did not exist before a plugin installed it
+
 
 class Plugin:
     """Base class for plugins (reference: plugins/Plugin.java)."""
@@ -93,8 +95,7 @@ class PluginsService:
         self.infos: List[PluginInfo] = []
         self._applied = False
         self._node_started = False
-        self._installed: Dict[str, list] = {
-            "analyzers": [], "mappers": [], "queries": [], "processors": []}
+        self._installed: list = []
 
     # ------------------------------------------------------------ discovery
     def load_all(self) -> None:
@@ -170,41 +171,40 @@ class PluginsService:
         from elasticsearch_tpu.index.mapping import FIELD_TYPES
         from elasticsearch_tpu.ingest.service import PROCESSORS
 
-        self._installed = {"analyzers": [], "mappers": [], "queries": [],
-                           "processors": []}
+        # (registry, key, previous value or _ABSENT) per installed entry so
+        # removal restores what a contribution shadowed — popping outright
+        # would destroy shadowed built-ins and other nodes' registrations
+        self._installed = []
+
+        def install(registry: dict, key: str, value) -> None:
+            self._installed.append(
+                (registry, key, registry.get(key, _ABSENT)))
+            registry[key] = value
+
         for plugin in self.plugins:
             for analyzer in plugin.get_analyzers():
-                _analysis.DEFAULT_REGISTRY.register(analyzer)
-                self._installed["analyzers"].append(analyzer.name)
+                install(_analysis.DEFAULT_REGISTRY._analyzers,
+                        analyzer.name, analyzer)
             for mapper_cls in plugin.get_field_mappers():
-                FIELD_TYPES[mapper_cls.type_name] = mapper_cls
-                self._installed["mappers"].append(mapper_cls.type_name)
+                install(FIELD_TYPES, mapper_cls.type_name, mapper_cls)
             for name, parser in plugin.get_queries().items():
-                EXTRA_QUERY_PARSERS[name] = parser
-                self._installed["queries"].append(name)
+                install(EXTRA_QUERY_PARSERS, name, parser)
             for proc_cls in plugin.get_processors():
-                PROCESSORS[proc_cls.kind] = proc_cls
-                self._installed["processors"].append(proc_cls.kind)
+                install(PROCESSORS, proc_cls.kind, proc_cls)
 
     def remove_extensions(self) -> None:
-        """Uninstall this node's plugin contributions from the global
-        registries (a closed node's query kinds must stop parsing)."""
+        """Uninstall this node's plugin contributions, restoring whatever
+        each one shadowed (a closed node's query kinds must stop parsing,
+        but built-ins it overrode must come back)."""
         if not self._applied:
             return
         self._applied = False
-        from elasticsearch_tpu.index import analysis as _analysis
-        from elasticsearch_tpu.index.mapping import FIELD_TYPES
-        from elasticsearch_tpu.ingest.service import PROCESSORS
-        for name in self._installed["analyzers"]:
-            _analysis.DEFAULT_REGISTRY._analyzers.pop(name, None)
-        for name in self._installed["mappers"]:
-            FIELD_TYPES.pop(name, None)
-        for name in self._installed["queries"]:
-            EXTRA_QUERY_PARSERS.pop(name, None)
-        for name in self._installed["processors"]:
-            PROCESSORS.pop(name, None)
-        self._installed = {"analyzers": [], "mappers": [], "queries": [],
-                           "processors": []}
+        for registry, key, previous in reversed(self._installed):
+            if previous is _ABSENT:
+                registry.pop(key, None)
+            else:
+                registry[key] = previous
+        self._installed = []
 
     def start_node(self, node) -> None:
         """Fire on_node_start once per node, REST or not."""

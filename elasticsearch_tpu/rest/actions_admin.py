@@ -349,7 +349,8 @@ def register_admin(rc: RestController, node: Node) -> None:
                       [])
 
     def cat_repositories(req):
-        rows = [[name, "fs"] for name in node.snapshots.repositories]
+        rows = [[name, repo.type]
+                for name, repo in node.snapshots.repositories.items()]
         return _table(req, ["id", "type"], rows)
 
     def cat_snapshots(req):

@@ -209,8 +209,8 @@ def register_extra(rc: RestController, node: Node) -> None:
         name = req.params.get("repo")
         if name:
             repo = node.snapshots.get_repository(name)
-            return 200, {name: {"type": "fs", "settings": repo.settings}}
-        return 200, {name: {"type": "fs", "settings": r.settings}
+            return 200, {name: {"type": repo.type, "settings": repo.settings}}
+        return 200, {name: {"type": r.type, "settings": r.settings}
                      for name, r in node.snapshots.repositories.items()}
 
     def delete_repo(req):
@@ -242,3 +242,8 @@ def register_extra(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_snapshot/{repo}/{snapshot}", get_snapshot)
     rc.register("DELETE", "/_snapshot/{repo}/{snapshot}", delete_snapshot)
     rc.register("POST", "/_snapshot/{repo}/{snapshot}/_restore", restore_snapshot)
+
+    def verify_repo(req):
+        return 200, node.snapshots.verify_repository(req.params["repo"])
+
+    rc.register("POST", "/_snapshot/{repo}/_verify", verify_repo)

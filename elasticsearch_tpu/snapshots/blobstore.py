@@ -1,0 +1,350 @@
+"""Blob store backends for snapshot repositories.
+
+Re-design of `common/blobstore/` + the repository plugins
+(`repositories/blobstore/BlobStoreRepository.java`, `modules/repository-url`,
+`plugins/repository-{s3,gcs,azure}` — SURVEY.md §2.10): a small byte-keyed
+store interface with four backends:
+
+- fs      — directory tree (the always-available default)
+- memory  — process-global named stores (test fixture + CI parity)
+- url     — read-only http(s)/file base URL (reference: repository-url)
+- s3      — S3-compatible REST dialect (GET/PUT/DELETE/HEAD on
+            /{bucket}/{key}, ?prefix= listing) against a configurable
+            endpoint — the shape MinIO and the reference's s3-fixture
+            (test/fixtures/s3-fixture) speak. Credentials, when given, go
+            out as basic auth; SigV4 is out of scope for this build.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError, SearchEngineError
+
+
+class BlobStoreError(SearchEngineError):
+    status = 500
+
+
+class BlobStoreUnavailableError(BlobStoreError):
+    """The backing service is unreachable (distinct from a missing blob)."""
+
+
+class BlobStore:
+    """Byte-keyed blob container; keys use '/' separators."""
+
+    read_only = False
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def write_blob_from_file(self, key: str, path: str) -> None:
+        """Streaming upload; default buffers (remote dialects need the
+        whole body), FsBlobStore overrides with a chunked copy."""
+        with open(path, "rb") as f:
+            self.write_blob(key, f.read())
+
+    def read_blob(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_blob(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+
+class FsBlobStore(BlobStore):
+    def __init__(self, location: str):
+        self.root = location
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        root = os.path.normpath(self.root)
+        path = os.path.normpath(os.path.join(root, key))
+        # trailing-separator check: a bare prefix match would let
+        # "../repo-evil" escape into siblings sharing the root's prefix
+        if path != root and not path.startswith(root + os.sep):
+            raise IllegalArgumentError(f"invalid blob key [{key}]")
+        return path
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+
+    def write_blob_from_file(self, key: str, src_path: str) -> None:
+        import shutil
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        shutil.copyfile(src_path, path + ".tmp")  # chunked, not in-memory
+        os.replace(path + ".tmp", path)
+
+    def read_blob(self, key: str) -> bytes:
+        path = self._path(key)
+        if not os.path.exists(path):
+            raise BlobStoreError(f"missing blob [{key}]")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def delete_blob(self, key: str) -> None:
+        path = self._path(key)
+        if os.path.exists(path):
+            os.remove(path)
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        # scope the walk to the prefix's directory so listing a handful of
+        # manifests doesn't traverse every content-addressed blob
+        if prefix and "/" in prefix:
+            walk_root = self._path(prefix.rsplit("/", 1)[0])
+        else:
+            walk_root = os.path.normpath(self.root)
+        if not os.path.isdir(walk_root):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(walk_root):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+
+_MEMORY_STORES: Dict[str, Dict[str, bytes]] = {}
+
+
+class MemoryBlobStore(BlobStore):
+    """Named in-process stores — shared by name so two repositories
+    pointing at the same location see the same blobs."""
+
+    def __init__(self, location: str):
+        self.blobs = _MEMORY_STORES.setdefault(location, {})
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        self.blobs[key] = bytes(data)
+
+    def read_blob(self, key: str) -> bytes:
+        if key not in self.blobs:
+            raise BlobStoreError(f"missing blob [{key}]")
+        return self.blobs[key]
+
+    def exists(self, key: str) -> bool:
+        return key in self.blobs
+
+    def delete_blob(self, key: str) -> None:
+        self.blobs.pop(key, None)
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        return sorted(k for k in self.blobs if k.startswith(prefix))
+
+
+class UrlBlobStore(BlobStore):
+    """Read-only store over a base URL (reference: modules/repository-url —
+    for serving snapshots from a static file server)."""
+
+    read_only = True
+
+    def __init__(self, url: str):
+        if not url.endswith("/"):
+            url += "/"
+        scheme = urllib.parse.urlsplit(url).scheme
+        if scheme not in ("http", "https", "file"):
+            raise IllegalArgumentError(
+                f"unsupported url repository scheme [{scheme}]")
+        self.base = url
+
+    def _url(self, key: str) -> str:
+        if ".." in key.split("/"):
+            raise IllegalArgumentError(f"invalid blob key [{key}]")
+        return self.base + urllib.parse.quote(key)
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        raise IllegalArgumentError("url repository is read-only")
+
+    def delete_blob(self, key: str) -> None:
+        raise IllegalArgumentError("url repository is read-only")
+
+    def read_blob(self, key: str) -> bytes:
+        try:
+            with urllib.request.urlopen(self._url(key), timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise BlobStoreError(f"missing blob [{key}]") from None
+            raise BlobStoreError(
+                f"url repository error for [{key}]: HTTP {e.code}") from None
+        except urllib.error.URLError as e:
+            # file:// wraps FileNotFoundError in URLError — that's a missing
+            # blob; anything else (refused connection, DNS) means the
+            # endpoint is unreachable and verification must fail loudly
+            if isinstance(getattr(e, "reason", None),
+                          (FileNotFoundError, IsADirectoryError,
+                           NotADirectoryError, PermissionError)):
+                raise BlobStoreError(f"missing blob [{key}]") from None
+            raise BlobStoreUnavailableError(
+                f"url repository unreachable: {e}") from None
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.read_blob(key)
+            return True
+        except BlobStoreError:
+            return False
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        # static file servers have no listing; repositories fall back to a
+        # manifest index blob (index.json) when present. A missing index is
+        # an empty repo; an unreachable endpoint propagates.
+        try:
+            import json
+            names = json.loads(self.read_blob("index.json"))
+            return sorted(k for k in names if k.startswith(prefix))
+        except BlobStoreUnavailableError:
+            raise
+        except BlobStoreError:
+            return []
+
+
+class S3BlobStore(BlobStore):
+    """S3-compatible dialect: path-style object API over HTTP.
+
+    Works against MinIO-style endpoints and the in-process fixture in
+    tests/s3_fixture.py (the analog of the reference's dockerized
+    s3-fixture)."""
+
+    def __init__(self, endpoint: str, bucket: str, base_path: str = "",
+                 access_key: str = "", secret_key: str = ""):
+        if not endpoint:
+            raise IllegalArgumentError(
+                "[endpoint] is required for s3 repositories in this build "
+                "(an S3-compatible service such as MinIO or a fixture)")
+        if not bucket:
+            raise IllegalArgumentError("[bucket] is required for s3 "
+                                       "repositories")
+        if not endpoint.startswith(("http://", "https://")):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.base_path = base_path.strip("/")
+        self._auth = None
+        if access_key:
+            import base64
+            token = base64.b64encode(
+                f"{access_key}:{secret_key}".encode()).decode()
+            self._auth = f"Basic {token}"
+
+    def _key(self, key: str) -> str:
+        return f"{self.base_path}/{key}" if self.base_path else key
+
+    def _url(self, key: str) -> str:
+        return (f"{self.endpoint}/{self.bucket}/"
+                f"{urllib.parse.quote(self._key(key))}")
+
+    def _request(self, method: str, url: str, data: Optional[bytes] = None):
+        req = urllib.request.Request(url, data=data, method=method)
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        return urllib.request.urlopen(req, timeout=30)
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        try:
+            with self._request("PUT", self._url(key), data):
+                pass
+        except urllib.error.URLError as e:
+            raise BlobStoreError(f"s3 put failed for [{key}]: {e}") from None
+
+    def read_blob(self, key: str) -> bytes:
+        try:
+            with self._request("GET", self._url(key)) as resp:
+                return resp.read()
+        except urllib.error.URLError as e:
+            raise BlobStoreError(f"missing blob [{key}]: {e}") from None
+
+    def exists(self, key: str) -> bool:
+        try:
+            with self._request("HEAD", self._url(key)):
+                return True
+        except urllib.error.URLError:
+            return False
+
+    def delete_blob(self, key: str) -> None:
+        try:
+            with self._request("DELETE", self._url(key)):
+                pass
+        except urllib.error.URLError:
+            pass
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        full_prefix = self._key(prefix)
+        keys: List[str] = []
+        token: Optional[str] = None
+        while True:  # follow ListObjectsV2 pagination
+            url = (f"{self.endpoint}/{self.bucket}/?list-type=2&prefix="
+                   f"{urllib.parse.quote(full_prefix)}")
+            if token:
+                url += f"&continuation-token={urllib.parse.quote(token)}"
+            try:
+                with self._request("GET", url) as resp:
+                    xml = resp.read().decode("utf-8")
+            except urllib.error.URLError as e:
+                raise BlobStoreError(f"s3 list failed: {e}") from None
+            keys.extend(re.findall(r"<Key>([^<]+)</Key>", xml))
+            m = re.search(r"<NextContinuationToken>([^<]+)"
+                          r"</NextContinuationToken>", xml)
+            truncated = re.search(r"<IsTruncated>true</IsTruncated>", xml)
+            if m and truncated:
+                token = m.group(1)
+            elif truncated and not m:
+                raise BlobStoreError(
+                    "s3 listing truncated without a continuation token")
+            else:
+                break
+        strip = len(self.base_path) + 1 if self.base_path else 0
+        return sorted(k[strip:] for k in keys)
+
+
+def build_blob_store(rtype: str, settings: dict) -> BlobStore:
+    if rtype == "fs":
+        location = settings.get("location")
+        if not location:
+            raise IllegalArgumentError(
+                "[location] is required for fs repositories")
+        return FsBlobStore(location)
+    if rtype == "memory":
+        return MemoryBlobStore(settings.get("location", "default"))
+    if rtype == "url":
+        url = settings.get("url")
+        if not url:
+            raise IllegalArgumentError("[url] is required for url "
+                                       "repositories")
+        return UrlBlobStore(url)
+    if rtype == "s3":
+        client = settings.get("client", {})
+        return S3BlobStore(
+            endpoint=settings.get("endpoint", client.get("endpoint", "")),
+            bucket=settings.get("bucket", ""),
+            base_path=settings.get("base_path", ""),
+            access_key=settings.get("access_key", ""),
+            secret_key=settings.get("secret_key", ""))
+    if rtype in ("gcs", "azure", "hdfs"):
+        raise IllegalArgumentError(
+            f"repository type [{rtype}] requires an external service SDK "
+            f"and is not available in this build; use [fs], [url], or an "
+            f"S3-compatible [s3] endpoint")
+    raise IllegalArgumentError(f"unknown repository type [{rtype}]")

@@ -17,7 +17,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import shutil
 import time
 from typing import Dict, List, Optional
 
@@ -31,91 +30,105 @@ class RepositoryError(SearchEngineError):
     status = 500
 
 
-class FsRepository:
-    def __init__(self, name: str, settings: dict):
+class Repository:
+    """Content-addressed snapshot repository over any BlobStore backend
+    (reference: BlobStoreRepository — one implementation, pluggable
+    container underneath)."""
+
+    def __init__(self, name: str, rtype: str, settings: dict):
+        from elasticsearch_tpu.snapshots.blobstore import build_blob_store
         self.name = name
+        self.type = rtype
         self.settings = settings
-        location = settings.get("location")
-        if not location:
-            raise IllegalArgumentError("[location] is required for fs repositories")
-        self.root = location
-        os.makedirs(os.path.join(self.root, "blobs"), exist_ok=True)
-        os.makedirs(os.path.join(self.root, "snapshots"), exist_ok=True)
+        self.store = build_blob_store(rtype, settings)
 
     # -- content-addressed blobs ---------------------------------------------
     def put_blob(self, path: str) -> str:
         h = hashlib.sha256()
-        with open(path, "rb") as f:
+        with open(path, "rb") as f:  # chunked hash: segment files can be GBs
             for chunk in iter(lambda: f.read(1 << 20), b""):
                 h.update(chunk)
         digest = h.hexdigest()
-        target = os.path.join(self.root, "blobs", digest)
-        if not os.path.exists(target):          # incremental dedup
-            shutil.copyfile(path, target + ".tmp")
-            os.replace(target + ".tmp", target)
+        key = f"blobs/{digest}"
+        if not self.store.exists(key):          # incremental dedup
+            self.store.write_blob_from_file(key, path)
         return digest
 
     def get_blob(self, digest: str, dest_path: str) -> None:
-        src = os.path.join(self.root, "blobs", digest)
-        if not os.path.exists(src):
-            raise RepositoryError(f"missing blob [{digest}] in repository [{self.name}]")
+        from elasticsearch_tpu.snapshots.blobstore import BlobStoreError
+        try:
+            data = self.store.read_blob(f"blobs/{digest}")
+        except BlobStoreError:
+            raise RepositoryError(
+                f"missing blob [{digest}] in repository [{self.name}]")
         os.makedirs(os.path.dirname(dest_path), exist_ok=True)
-        shutil.copyfile(src, dest_path)
+        with open(dest_path, "wb") as f:
+            f.write(data)
 
     # -- manifests ------------------------------------------------------------
-    def _manifest_path(self, snapshot: str) -> str:
-        return os.path.join(self.root, "snapshots", f"{snapshot}.json")
-
     def put_manifest(self, snapshot: str, manifest: dict) -> None:
-        path = self._manifest_path(snapshot)
-        with open(path + ".tmp", "w") as f:
-            json.dump(manifest, f)
-        os.replace(path + ".tmp", path)
+        self.store.write_blob(f"snapshots/{snapshot}.json",
+                              json.dumps(manifest).encode("utf-8"))
 
     def get_manifest(self, snapshot: str) -> dict:
-        path = self._manifest_path(snapshot)
-        if not os.path.exists(path):
+        from elasticsearch_tpu.snapshots.blobstore import BlobStoreError
+        try:
+            return json.loads(self.store.read_blob(
+                f"snapshots/{snapshot}.json"))
+        except BlobStoreError:
             raise ResourceNotFoundError(
                 f"snapshot [{self.name}:{snapshot}] is missing")
-        with open(path) as f:
-            return json.load(f)
 
     def list_snapshots(self) -> List[str]:
-        out = []
-        for fn in sorted(os.listdir(os.path.join(self.root, "snapshots"))):
-            if fn.endswith(".json"):
-                out.append(fn[:-5])
-        return out
+        return [k[len("snapshots/"):-len(".json")]
+                for k in self.store.list_blobs("snapshots/")
+                if k.endswith(".json")]
 
     def delete_manifest(self, snapshot: str) -> None:
-        path = self._manifest_path(snapshot)
-        if not os.path.exists(path):
-            raise ResourceNotFoundError(f"snapshot [{self.name}:{snapshot}] is missing")
-        os.remove(path)
+        key = f"snapshots/{snapshot}.json"
+        if not self.store.exists(key):
+            raise ResourceNotFoundError(
+                f"snapshot [{self.name}:{snapshot}] is missing")
+        self.store.delete_blob(key)
+
+    def verify(self) -> None:
+        """Round-trip a marker blob (reference: VerifyRepositoryAction)."""
+        if self.store.read_only:
+            # read-only stores verify by listing
+            self.store.list_blobs("snapshots/")
+            return
+        key = "tests-verify/marker"
+        self.store.write_blob(key, b"ok")
+        if self.store.read_blob(key) != b"ok":
+            raise RepositoryError(
+                f"repository [{self.name}] failed verification")
+        self.store.delete_blob(key)
 
 
-REPOSITORY_TYPES = {"fs": FsRepository}
-UNAVAILABLE_TYPES = {"s3", "gcs", "azure", "hdfs", "url"}
+# back-compat alias (pre-BlobStore callers)
+FsRepository = Repository
+SUPPORTED_TYPES = {"fs", "memory", "url", "s3"}
 
 
 class SnapshotService:
     def __init__(self, node):
         self.node = node
-        self.repositories: Dict[str, FsRepository] = {}
+        self.repositories: Dict[str, Repository] = {}
 
     # -- repositories ---------------------------------------------------------
-    def put_repository(self, name: str, body: dict) -> None:
+    def put_repository(self, name: str, body: dict,
+                       verify: bool = True) -> None:
         rtype = body.get("type")
-        if rtype in UNAVAILABLE_TYPES:
-            raise IllegalArgumentError(
-                f"repository type [{rtype}] requires an external service and is "
-                f"not available in this build; use [fs]")
-        cls = REPOSITORY_TYPES.get(rtype)
-        if cls is None:
-            raise IllegalArgumentError(f"unknown repository type [{rtype}]")
-        self.repositories[name] = cls(name, body.get("settings", {}))
+        repo = Repository(name, rtype, body.get("settings", {}))
+        if verify:
+            repo.verify()
+        self.repositories[name] = repo
 
-    def get_repository(self, name: str) -> FsRepository:
+    def verify_repository(self, name: str) -> dict:
+        self.get_repository(name).verify()
+        return {"nodes": {self.node.node_id: {"name": self.node.node_name}}}
+
+    def get_repository(self, name: str) -> Repository:
         repo = self.repositories.get(name)
         if repo is None:
             raise ResourceNotFoundError(f"[{name}] missing", repository=name)

@@ -1,0 +1,91 @@
+"""In-process S3-compatible fixture (analog of the reference's dockerized
+test/fixtures/s3-fixture): path-style GET/PUT/DELETE/HEAD on
+/{bucket}/{key} plus list-objects-v2 ?prefix= returning minimal XML."""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: Dict[Tuple[str, str], bytes] = {}
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _parse(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        query = {k: v[-1] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+        return bucket, key, query
+
+    def do_PUT(self):
+        bucket, key, _ = self._parse()
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length)
+        self.store[(bucket, key)] = data
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        bucket, key, query = self._parse()
+        if not key:  # list-objects
+            prefix = query.get("prefix", "")
+            keys = sorted(k for (b, k) in self.store
+                          if b == bucket and k.startswith(prefix))
+            body = ("<?xml version=\"1.0\"?><ListBucketResult>"
+                    + "".join(f"<Contents><Key>{k}</Key></Contents>"
+                              for k in keys)
+                    + "</ListBucketResult>").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/xml")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        data = self.store.get((bucket, key))
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_HEAD(self):
+        bucket, key, _ = self._parse()
+        self.send_response(200 if (bucket, key) in self.store else 404)
+        self.end_headers()
+
+    def do_DELETE(self):
+        bucket, key, _ = self._parse()
+        self.store.pop((bucket, key), None)
+        self.send_response(204)
+        self.end_headers()
+
+
+class S3Fixture:
+    def __init__(self):
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()

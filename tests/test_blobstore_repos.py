@@ -1,0 +1,201 @@
+"""Blob store backends + snapshot repositories over them (reference:
+common/blobstore, repository-url module, repository-s3 plugin tested
+against the s3-fixture — SURVEY.md §2.10, §4.7)."""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.snapshots.blobstore import (
+    BlobStoreError,
+    FsBlobStore,
+    MemoryBlobStore,
+    S3BlobStore,
+    UrlBlobStore,
+    build_blob_store,
+)
+from tests.s3_fixture import S3Fixture
+
+
+def _exercise(store):
+    store.write_blob("blobs/abc", b"hello")
+    store.write_blob("snapshots/s1.json", b"{}")
+    assert store.read_blob("blobs/abc") == b"hello"
+    assert store.exists("blobs/abc")
+    assert not store.exists("blobs/zzz")
+    assert store.list_blobs("snapshots/") == ["snapshots/s1.json"]
+    store.delete_blob("blobs/abc")
+    assert not store.exists("blobs/abc")
+    with pytest.raises(BlobStoreError):
+        store.read_blob("blobs/abc")
+
+
+def test_fs_blob_store(tmp_path):
+    _exercise(FsBlobStore(str(tmp_path / "repo")))
+
+
+def test_fs_blob_store_rejects_traversal(tmp_path):
+    store = FsBlobStore(str(tmp_path / "repo"))
+    with pytest.raises(IllegalArgumentError):
+        store.write_blob("../outside", b"x")
+    # sibling dir sharing the root's name prefix must be rejected too
+    with pytest.raises(IllegalArgumentError):
+        store.write_blob("../repo-evil/x", b"x")
+
+
+def test_url_repo_verify_fails_when_unreachable(tmp_path):
+    from elasticsearch_tpu.snapshots.service import Repository
+    from elasticsearch_tpu.snapshots.blobstore import (
+        BlobStoreUnavailableError)
+    repo = Repository("bad", "url",
+                      {"url": "http://127.0.0.1:1/nope/"})
+    with pytest.raises(BlobStoreUnavailableError):
+        repo.verify()
+
+
+def test_plugin_shadowed_builtin_restored_on_close(tmp_path):
+    """A plugin overriding a built-in name must restore it on close, not
+    destroy it process-wide."""
+    import json as _json
+    pdir = tmp_path / "plugins" / "shadow"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.py").write_text('''
+from elasticsearch_tpu.plugins import Plugin
+from elasticsearch_tpu.index.analysis import Analyzer, keyword_tokenizer
+
+class Shadow(Plugin):
+    name = "shadow"
+    def get_analyzers(self):
+        return [Analyzer("standard", keyword_tokenizer)]  # overrides builtin
+''')
+    from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY
+    node = Node(str(tmp_path / "data"),
+                settings={"path.plugins": str(tmp_path / "plugins")})
+    assert DEFAULT_REGISTRY.get("standard").terms("A B") == ["A B"]  # shadowed
+    node.close()
+    assert DEFAULT_REGISTRY.get("standard").terms("A B") == ["a", "b"]  # back
+
+
+def test_memory_blob_store_shared_by_name():
+    a = MemoryBlobStore("shared-loc-test")
+    b = MemoryBlobStore("shared-loc-test")
+    a.write_blob("k", b"v")
+    assert b.read_blob("k") == b"v"
+    _exercise(MemoryBlobStore("other-loc-test"))
+
+
+def test_s3_blob_store_against_fixture():
+    with S3Fixture() as fx:
+        store = S3BlobStore(fx.endpoint, "mybucket", base_path="backups")
+        _exercise(store)
+        # base_path prefixes keys on the wire
+        store.write_blob("blobs/x", b"1")
+        from tests.s3_fixture import _Handler
+        assert ("mybucket", "backups/blobs/x") in _Handler.store
+
+
+def test_url_blob_store_readonly(tmp_path):
+    # file:// url over an fs repo written separately
+    src = FsBlobStore(str(tmp_path / "served"))
+    src.write_blob("snapshots/s1.json", b"{\"snapshot\": \"s1\"}")
+    url = "file://" + str(tmp_path / "served") + "/"
+    store = UrlBlobStore(url)
+    assert store.read_blob("snapshots/s1.json") == b"{\"snapshot\": \"s1\"}"
+    with pytest.raises(IllegalArgumentError):
+        store.write_blob("x", b"y")
+    with pytest.raises(IllegalArgumentError):
+        store.delete_blob("x")
+
+
+def test_build_blob_store_gating():
+    with pytest.raises(IllegalArgumentError):
+        build_blob_store("gcs", {})
+    with pytest.raises(IllegalArgumentError):
+        build_blob_store("s3", {"bucket": "b"})  # endpoint required
+    with pytest.raises(IllegalArgumentError):
+        build_blob_store("bogus", {})
+    with pytest.raises(IllegalArgumentError):
+        build_blob_store("fs", {})  # location required
+
+
+# ------------------------------------------------------- end-to-end snapshot
+
+def test_snapshot_restore_via_s3_repository(tmp_path):
+    with S3Fixture() as fx:
+        node = Node(str(tmp_path / "data"))
+        try:
+            node.index_doc("src", "1", {"v": "original"}, refresh="true")
+            node.snapshots.put_repository("s3repo", {
+                "type": "s3", "settings": {"endpoint": fx.endpoint,
+                                           "bucket": "snaps",
+                                           "base_path": "es"}})
+            node.snapshots.create_snapshot("s3repo", "snap1",
+                                           {"indices": "src"})
+            assert node.snapshots.get_repository(
+                "s3repo").list_snapshots() == ["snap1"]
+            out = node.snapshots.restore_snapshot(
+                "s3repo", "snap1", {"indices": "src",
+                                    "rename_pattern": "src",
+                                    "rename_replacement": "restored"})
+            assert out["snapshot"]["indices"] == ["restored"]
+            doc = node.get_doc("restored", "1")
+            assert doc["_source"]["v"] == "original"
+        finally:
+            node.close()
+
+
+def test_snapshot_restore_via_memory_repository(tmp_path):
+    node = Node(str(tmp_path / "data"))
+    try:
+        node.index_doc("m", "1", {"v": 42}, refresh="true")
+        node.snapshots.put_repository("mem", {
+            "type": "memory", "settings": {"location": "snap-test-mem"}})
+        node.snapshots.create_snapshot("mem", "s1", {"indices": "m"})
+        node.snapshots.restore_snapshot("mem", "s1", {
+            "indices": "m", "rename_pattern": "m",
+            "rename_replacement": "m2"})
+        assert node.get_doc("m2", "1")["_source"]["v"] == 42
+    finally:
+        node.close()
+
+
+def test_restore_from_url_repository(tmp_path):
+    """Write via fs, serve the same tree read-only via file:// url."""
+    node = Node(str(tmp_path / "data"))
+    try:
+        node.index_doc("u", "1", {"v": "url"}, refresh="true")
+        loc = str(tmp_path / "repo")
+        node.snapshots.put_repository("w", {"type": "fs",
+                                            "settings": {"location": loc}})
+        node.snapshots.create_snapshot("w", "s1", {"indices": "u"})
+        node.snapshots.put_repository("r", {
+            "type": "url", "settings": {"url": "file://" + loc + "/"}})
+        node.snapshots.restore_snapshot("r", "s1", {
+            "indices": "u", "rename_pattern": "u",
+            "rename_replacement": "u2"})
+        assert node.get_doc("u2", "1")["_source"]["v"] == "url"
+    finally:
+        node.close()
+
+
+def test_verify_repository_rest(tmp_path):
+    import json
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    node = Node(str(tmp_path / "data"))
+    try:
+        rc = RestController()
+        register_all(rc, node)
+        status, _ = rc.dispatch(
+            "PUT", "/_snapshot/vr", {},
+            json.dumps({"type": "fs", "settings": {
+                "location": str(tmp_path / "repo")}}).encode(),
+            "application/json")
+        assert status == 200
+        status, body = rc.dispatch("POST", "/_snapshot/vr/_verify", {},
+                                   b"", "application/json")
+        assert status == 200 and node.node_id in body["nodes"]
+    finally:
+        node.close()

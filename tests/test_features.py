@@ -337,9 +337,12 @@ def test_snapshot_and_restore(client, tmp_path):
     _, listing = client.req("GET", "/_snapshot/backup/_all")
     assert [s["snapshot"] for s in listing["snapshots"]] == ["snap1"]
 
-    # unavailable repository types are gated with a clear error
+    # s3 without an endpoint, and SDK-dependent types, are gated clearly
     status, body = client.req("PUT", "/_snapshot/cloud",
                               {"type": "s3", "settings": {"bucket": "b"}})
+    assert status == 400 and "endpoint" in body["error"]["reason"]
+    status, body = client.req("PUT", "/_snapshot/cloud",
+                              {"type": "gcs", "settings": {"bucket": "b"}})
     assert status == 400 and "not available" in body["error"]["reason"]
 
 
