@@ -1,0 +1,227 @@
+"""Bootstrap: process hardening + startup checks.
+
+Re-design of the reference's bootstrap layer (SURVEY.md §2.1):
+- `Bootstrap.initializeNatives` (`Bootstrap.java:104`) / `JNANatives` /
+  `JNACLibrary` — mlockall, rlimit probes — here via ctypes on libc
+  (the "thin C++/ctypes shim" SURVEY.md §2.9 prescribes).
+- `SystemCallFilter.java` — a seccomp-BPF program built in userspace and
+  installed with prctl; here the same construction in Python: BPF
+  bytecode blocking process-spawning syscalls, installed via
+  PR_SET_NO_NEW_PRIVS + PR_SET_SECCOMP. Off by default in this build
+  because the ML sidecar spawns per-job processes lazily (the reference
+  spawns its native controller *before* installing the filter, then the
+  controller does all spawning — see Spawner.java); enable with
+  `bootstrap.system_call_filter: true` on nodes without ML jobs.
+- `BootstrapChecks.java` — fail-fast startup checks (file descriptors,
+  memory lock sanity) that harden production nodes.
+- `modules/systemd` — sd_notify readiness over the NOTIFY_SOCKET
+  datagram socket.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import resource
+import socket
+import struct
+import sys
+from typing import List, Optional
+
+from elasticsearch_tpu.common.settings import setting_bool
+
+# ---------------------------------------------------------------------------
+# libc natives (reference: JNACLibrary / JNANatives)
+# ---------------------------------------------------------------------------
+
+_MCL_CURRENT = 1
+_MCL_FUTURE = 2
+
+_PR_SET_NO_NEW_PRIVS = 38
+_PR_SET_SECCOMP = 22
+_SECCOMP_MODE_FILTER = 2
+
+
+def _libc() -> Optional[ctypes.CDLL]:
+    try:
+        return ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                           use_errno=True)
+    except OSError:
+        return None
+
+
+class Natives:
+    """Results of native hardening attempts (queryable via _nodes info,
+    like the reference's JNANatives.LOCAL_MLOCKALL flag)."""
+
+    def __init__(self):
+        self.memory_locked = False
+        self.seccomp_installed = False
+        self.errors: List[str] = []
+
+    def try_mlockall(self) -> None:
+        libc = _libc()
+        if libc is None:
+            self.errors.append("libc unavailable; cannot mlockall")
+            return
+        if libc.mlockall(_MCL_CURRENT | _MCL_FUTURE) == 0:
+            self.memory_locked = True
+        else:
+            err = ctypes.get_errno()
+            self.errors.append(
+                f"mlockall failed (errno {err}): memory is not locked; "
+                f"raise RLIMIT_MEMLOCK (ulimit -l) to enable")
+
+    def try_seccomp_filter(self) -> None:
+        """Install a BPF filter denying process-spawning syscalls
+        (reference: SystemCallFilter.java builds the same program)."""
+        libc = _libc()
+        if libc is None:
+            self.errors.append("libc unavailable; cannot install seccomp")
+            return
+        if libc.prctl(_PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0:
+            self.errors.append("prctl(PR_SET_NO_NEW_PRIVS) failed")
+            return
+        prog = _build_bpf_program()
+        filt = ctypes.create_string_buffer(prog)
+        # struct sock_fprog { unsigned short len; struct sock_filter *filter; }
+        class SockFprog(ctypes.Structure):
+            _fields_ = [("len", ctypes.c_ushort),
+                        ("filter", ctypes.c_void_p)]
+
+        fprog = SockFprog(len(prog) // 8, ctypes.cast(filt, ctypes.c_void_p))
+        if libc.prctl(_PR_SET_SECCOMP, _SECCOMP_MODE_FILTER,
+                      ctypes.byref(fprog), 0, 0) == 0:
+            self.seccomp_installed = True
+        else:
+            err = ctypes.get_errno()
+            self.errors.append(f"seccomp install failed (errno {err})")
+
+
+def _bpf_stmt(code: int, k: int) -> bytes:
+    return struct.pack("<HBBI", code, 0, 0, k)
+
+
+def _bpf_jump(code: int, k: int, jt: int, jf: int) -> bytes:
+    return struct.pack("<HBBI", code, jt, jf, k)
+
+
+# BPF opcodes
+_BPF_LD_W_ABS = 0x20
+_BPF_JMP_JEQ_K = 0x15
+_BPF_RET_K = 0x06
+_SECCOMP_RET_ALLOW = 0x7FFF0000
+_SECCOMP_RET_ERRNO = 0x00050000  # | errno
+_EACCES = 13
+
+# syscall numbers (x86_64) the reference's filter denies: spawning
+_X86_64_BLOCKED = {
+    "fork": 57, "vfork": 58, "execve": 59, "execveat": 322,
+}
+_AUDIT_ARCH_X86_64 = 0xC000003E
+
+
+def _build_bpf_program() -> bytes:
+    """Allow-all except blocked syscalls → EACCES (matching the reference's
+    'deny process execution' policy, SystemCallFilter.java)."""
+    blocked = sorted(_X86_64_BLOCKED.values())
+    prog = bytearray()
+    # load arch; bail out (allow) on non-x86_64 so we never misinterpret
+    # syscall numbers of another ABI
+    prog += _bpf_stmt(_BPF_LD_W_ABS, 4)  # seccomp_data.arch
+    # jf skips LD nr + every blocked-JEQ, landing exactly on RET ALLOW
+    prog += _bpf_jump(_BPF_JMP_JEQ_K, _AUDIT_ARCH_X86_64, 0,
+                      len(blocked) + 1)
+    prog += _bpf_stmt(_BPF_LD_W_ABS, 0)  # seccomp_data.nr
+    for i, nr in enumerate(blocked):
+        remaining = len(blocked) - 1 - i
+        prog += _bpf_jump(_BPF_JMP_JEQ_K, nr, remaining + 1, 0)
+    prog += _bpf_stmt(_BPF_RET_K, _SECCOMP_RET_ALLOW)
+    prog += _bpf_stmt(_BPF_RET_K, _SECCOMP_RET_ERRNO | _EACCES)
+    return bytes(prog)
+
+
+# ---------------------------------------------------------------------------
+# bootstrap checks (reference: BootstrapChecks.java)
+# ---------------------------------------------------------------------------
+
+class BootstrapCheckFailure(Exception):
+    pass
+
+
+def run_bootstrap_checks(settings: dict, enforce: bool = False) -> List[str]:
+    """Run startup checks; in enforce mode (production: a non-loopback
+    publish address, reference BootstrapChecks.enforceLimits) failures
+    abort startup, otherwise they are warnings."""
+    failures: List[str] = []
+
+    # file descriptor check (reference: FileDescriptorCheck, 65535 floor)
+    try:
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft != resource.RLIM_INFINITY and soft < 4096:
+            failures.append(
+                f"max file descriptors [{soft}] is too low, increase to at "
+                f"least [4096] (ulimit -n)")
+    except (OSError, ValueError):
+        pass
+
+    # memory lock requested but not grantable (reference: MlockallCheck)
+    if setting_bool(settings.get("bootstrap.memory_lock")):
+        try:
+            soft, _ = resource.getrlimit(resource.RLIMIT_MEMLOCK)
+            if soft != resource.RLIM_INFINITY and soft < (1 << 24):
+                failures.append(
+                    "bootstrap.memory_lock is set but RLIMIT_MEMLOCK is "
+                    "too low; memory locking will fail (ulimit -l)")
+        except (OSError, ValueError):
+            pass
+
+    # data path must be writable (reference: NodeEnvironment startup) —
+    # check the directory itself when it exists; only when it must be
+    # created does the parent's writability matter
+    data_path = settings.get("path.data")
+    if data_path:
+        if os.path.isdir(data_path):
+            writable = os.access(data_path, os.W_OK)
+        else:
+            parent = os.path.dirname(os.path.abspath(data_path)) or "."
+            writable = os.path.isdir(parent) and os.access(parent, os.W_OK)
+        if not writable:
+            failures.append(f"data path [{data_path}] is not writable")
+
+    if enforce and failures:
+        raise BootstrapCheckFailure("; ".join(failures))
+    return failures
+
+
+def initialize_natives(settings: dict) -> Natives:
+    """reference: Bootstrap.initializeNatives (Bootstrap.java:104)."""
+    natives = Natives()
+    if setting_bool(settings.get("bootstrap.memory_lock")):
+        natives.try_mlockall()
+    if setting_bool(settings.get("bootstrap.system_call_filter")):
+        natives.try_seccomp_filter()
+    return natives
+
+
+# ---------------------------------------------------------------------------
+# systemd notify (reference: modules/systemd — sd_notify)
+# ---------------------------------------------------------------------------
+
+def sd_notify(state: str = "READY=1") -> bool:
+    """Send a readiness datagram to the NOTIFY_SOCKET if systemd set one."""
+    addr = os.environ.get("NOTIFY_SOCKET")
+    if not addr:
+        return False
+    if addr.startswith("@"):  # abstract namespace
+        addr = "\0" + addr[1:]
+    try:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        try:
+            sock.sendto(state.encode("utf-8"), addr)
+        finally:
+            sock.close()
+        return True
+    except OSError:
+        return False

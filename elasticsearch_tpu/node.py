@@ -163,6 +163,9 @@ class Node:
         self.plugins.load_all()
         self.plugins.apply_extensions()
         self.plugins.start_node(self)
+        # set by the server bootstrap after native hardening runs; embedded
+        # nodes have no hardening (reference: JNANatives.LOCAL_MLOCKALL)
+        self.natives = None
         self.start_time = time.time()
 
     # ------------------------------------------------------------- documents

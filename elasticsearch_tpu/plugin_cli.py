@@ -1,0 +1,98 @@
+"""Plugin CLI (reference: `distribution/tools/plugin-cli` —
+install/list/remove subcommands).
+
+Usage:
+    python -m elasticsearch_tpu.plugin_cli install SRC --data DATA
+    python -m elasticsearch_tpu.plugin_cli list --data DATA
+    python -m elasticsearch_tpu.plugin_cli remove NAME --data DATA
+
+SRC is a plugin directory (containing plugin.py) or a .zip of one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import zipfile
+
+
+def _plugins_dir(data: str) -> str:
+    return os.path.join(data, "plugins")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="plugin_cli")
+    parser.add_argument("command", choices=["install", "list", "remove"])
+    parser.add_argument("target", nargs="?")
+    parser.add_argument("--data", default="./data",
+                        help="node data path (plugins live in "
+                             "<data>/plugins)")
+    args = parser.parse_args(argv)
+    pdir = _plugins_dir(args.data)
+
+    if args.command == "list":
+        if not os.path.isdir(pdir):
+            return 0
+        for entry in sorted(os.listdir(pdir)):
+            meta_path = os.path.join(pdir, entry, "plugin.json")
+            version = ""
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    version = json.load(f).get("version", "")
+            print(f"{entry} {version}".strip())
+        return 0
+
+    if not args.target:
+        print("plugin name/path required", file=sys.stderr)
+        return 1
+
+    if args.command == "install":
+        src = args.target
+        if src.endswith(".zip"):
+            name = os.path.basename(src)[:-4]
+            dest = os.path.join(pdir, name)
+            if os.path.exists(dest):
+                print(f"plugin [{name}] already installed", file=sys.stderr)
+                return 1
+            os.makedirs(dest, exist_ok=True)
+            with zipfile.ZipFile(src) as zf:
+                root = os.path.normpath(dest)
+                for member in zf.namelist():
+                    # zip-slip guard: trailing separator so a sibling dir
+                    # sharing the prefix ("foo-evil") can't pass
+                    target = os.path.normpath(os.path.join(root, member))
+                    if target != root and not target.startswith(root + os.sep):
+                        print(f"refusing path [{member}]", file=sys.stderr)
+                        shutil.rmtree(dest, ignore_errors=True)
+                        return 1
+                zf.extractall(dest)
+        else:
+            if not os.path.exists(os.path.join(src, "plugin.py")):
+                print(f"[{src}] is not a plugin directory (no plugin.py)",
+                      file=sys.stderr)
+                return 1
+            name = os.path.basename(os.path.normpath(src))
+            dest = os.path.join(pdir, name)
+            if os.path.exists(dest):
+                print(f"plugin [{name}] already installed", file=sys.stderr)
+                return 1
+            shutil.copytree(src, dest)
+        print(f"installed [{name}]")
+        return 0
+
+    if args.command == "remove":
+        dest = os.path.join(pdir, args.target)
+        if not os.path.isdir(dest):
+            print(f"plugin [{args.target}] not found", file=sys.stderr)
+            return 1
+        shutil.rmtree(dest)
+        print(f"removed [{args.target}]")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -351,11 +351,18 @@ def register_all(rc: RestController, node: Node) -> None:
                      "metadata": {"indices": meta}}
 
     def nodes_info(req):
+        natives = node.natives
         return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},
                      "cluster_name": node.cluster_name,
                      "nodes": {node.node_id: {
                          "name": node.node_name, "version": __version__,
-                         "roles": ["master", "data", "ingest"]}}}
+                         "roles": ["master", "data", "ingest"],
+                         "process": {
+                             "mlockall": bool(natives
+                                              and natives.memory_locked),
+                             "seccomp": bool(natives
+                                             and natives.seccomp_installed)},
+                         "plugins": node.plugins.info()}}}
 
     def nodes_stats(req):
         import resource

@@ -28,13 +28,32 @@ def main(argv=None) -> int:
         key, _, value = kv.partition("=")
         settings[key] = {"true": True, "false": False}.get(value.lower(), value)
 
+    from elasticsearch_tpu import bootstrap
     from elasticsearch_tpu.node import Node
     from elasticsearch_tpu.rest.actions import register_all
     from elasticsearch_tpu.rest.controller import RestController
     from elasticsearch_tpu.rest.http_server import HttpServer
 
+    # bootstrap checks + native hardening BEFORE the node exists
+    # (reference: Bootstrap.init → initializeNatives → BootstrapChecks)
+    check_settings = dict(settings)
+    check_settings.setdefault("path.data", args.data)
+    enforce = args.host not in ("127.0.0.1", "localhost", "::1")
+    try:
+        warnings = bootstrap.run_bootstrap_checks(check_settings,
+                                                  enforce=enforce)
+    except bootstrap.BootstrapCheckFailure as e:
+        print(f"bootstrap checks failed: {e}", file=sys.stderr)
+        return 78  # EX_CONFIG
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    natives = bootstrap.initialize_natives(check_settings)
+    for err in natives.errors:
+        print(f"warning: {err}", file=sys.stderr)
+
     node = Node(args.data, node_name=args.name, cluster_name=args.cluster_name,
                 settings=settings)
+    node.natives = natives
     controller = RestController()
     register_all(controller, node)
     server = HttpServer(controller, host=args.host, port=args.port)
@@ -43,6 +62,7 @@ def main(argv=None) -> int:
         await server.start()
         print(f"[{args.name}] listening on http://{args.host}:{server.port} "
               f"(data: {args.data})", flush=True)
+        bootstrap.sd_notify("READY=1")  # systemd readiness, if supervised
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):

@@ -1,0 +1,113 @@
+"""Bootstrap hardening + checks, systemd notify, plugin CLI (reference:
+bootstrap/Bootstrap.java natives + BootstrapChecks.java, JNANatives /
+SystemCallFilter, modules/systemd, distribution/tools/plugin-cli)."""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from elasticsearch_tpu import bootstrap
+
+
+def test_bpf_program_shape():
+    prog = bootstrap._build_bpf_program()
+    assert len(prog) % 8 == 0
+    n = len(prog) // 8
+    # arch load + arch jump + nr load + one jump per blocked + 2 returns
+    assert n == 3 + len(bootstrap._X86_64_BLOCKED) + 2
+    # last two instructions: RET ALLOW then RET ERRNO|EACCES
+    code, jt, jf, k = struct.unpack("<HBBI", prog[-16:-8])
+    assert code == bootstrap._BPF_RET_K and k == bootstrap._SECCOMP_RET_ALLOW
+    code, jt, jf, k = struct.unpack("<HBBI", prog[-8:])
+    assert k == (bootstrap._SECCOMP_RET_ERRNO | bootstrap._EACCES)
+    # arch-mismatch bailout must land on RET ALLOW (idx n-2), not RET ERRNO:
+    # from idx 1, target = 1 + 1 + jf  →  jf = n - 4
+    code, jt, jf, k = struct.unpack("<HBBI", prog[8:16])
+    assert k == bootstrap._AUDIT_ARCH_X86_64
+    assert 1 + 1 + jf == n - 2, "non-x86_64 ABIs must be allowed through"
+
+
+def test_seccomp_filter_blocks_exec_in_subprocess():
+    """Install the filter in a throwaway subprocess and verify exec is
+    denied with EACCES while normal syscalls keep working."""
+    code = r"""
+import os, sys
+sys.path.insert(0, ".")
+from elasticsearch_tpu.bootstrap import Natives
+n = Natives()
+n.try_seccomp_filter()
+if not n.seccomp_installed:
+    print("SKIP:" + ";".join(n.errors)); sys.exit(0)
+open("/dev/null").close()  # ordinary syscalls still allowed
+try:
+    os.execv("/bin/true", ["/bin/true"])
+    print("EXEC-SUCCEEDED")
+except PermissionError:
+    print("EXEC-BLOCKED")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=".",
+                       env={"PYTHONPATH": ".", "PATH": "/usr/bin:/bin"})
+    out = r.stdout.strip()
+    if out.startswith("SKIP:"):
+        pytest.skip(out)
+    assert out == "EXEC-BLOCKED", (r.stdout, r.stderr)
+
+
+def test_mlockall_attempt_reports():
+    n = bootstrap.Natives()
+    n.try_mlockall()
+    # either it locked, or it reported a clear rlimit error
+    assert n.memory_locked or any("mlockall" in e for e in n.errors)
+
+
+def test_bootstrap_checks_warn_and_enforce(tmp_path):
+    warnings = bootstrap.run_bootstrap_checks(
+        {"bootstrap.memory_lock": "false", "path.data": str(tmp_path / "d")})
+    assert isinstance(warnings, list)
+    # unwritable data path fails in enforce mode
+    with pytest.raises(bootstrap.BootstrapCheckFailure):
+        bootstrap.run_bootstrap_checks(
+            {"path.data": "/proc/definitely/not/writable"}, enforce=True)
+
+
+def test_sd_notify(tmp_path, monkeypatch):
+    sock_path = str(tmp_path / "notify.sock")
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    server.bind(sock_path)
+    server.settimeout(5)
+    monkeypatch.setenv("NOTIFY_SOCKET", sock_path)
+    assert bootstrap.sd_notify("READY=1")
+    assert server.recv(64) == b"READY=1"
+    server.close()
+    monkeypatch.delenv("NOTIFY_SOCKET")
+    assert not bootstrap.sd_notify()  # no socket: no-op
+
+
+def test_plugin_cli(tmp_path):
+    src = tmp_path / "src" / "myplug"
+    src.mkdir(parents=True)
+    (src / "plugin.py").write_text(
+        "from elasticsearch_tpu.plugins import Plugin\n"
+        "class P(Plugin):\n    name = 'myplug'\n")
+    (src / "plugin.json").write_text('{"name": "myplug", "version": "2.0"}')
+    data = str(tmp_path / "data")
+    env = {"PYTHONPATH": ".", "PATH": "/usr/bin:/bin"}
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "elasticsearch_tpu.plugin_cli", *args,
+             "--data", data], capture_output=True, text=True, cwd=".",
+            env=env)
+
+    assert cli("install", str(src)).returncode == 0
+    out = cli("list")
+    assert "myplug 2.0" in out.stdout
+    assert cli("install", str(src)).returncode == 1  # already installed
+    assert cli("remove", "myplug").returncode == 0
+    assert cli("list").stdout.strip() == ""
+    assert cli("remove", "myplug").returncode == 1
