@@ -28,6 +28,7 @@ Safety invariants the simulation suite asserts:
 from __future__ import annotations
 
 import random
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster.state import (
@@ -241,7 +242,10 @@ class Coordinator:
         self.election_max_ms = election_max_ms
         self.heartbeat_interval_ms = heartbeat_interval_ms
         self.fault_timeout_ms = fault_timeout_ms
-        self.rng = rng or random.Random(hash(node.node_id) & 0xFFFF)
+        # stable seed: builtin hash() varies per process (PYTHONHASHSEED),
+        # which made election timing nondeterministic across test runs
+        self.rng = rng or random.Random(
+            zlib.crc32(node.node_id.encode("utf-8")))
         self.committed_state: ClusterState = persisted.last_accepted
         self.stopped = False
         self._election_round = 0
