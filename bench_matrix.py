@@ -1,0 +1,161 @@
+"""Benchmark matrix: the five BASELINE.md configs at single-chip scale.
+
+`bench.py` remains the driver contract (ONE JSON line, config 1). This
+script reports every config as its own JSON line so the full matrix is
+measurable on one chip:
+
+  1 cosine kNN, SIFT-like 1M x 128        (binned Pallas kernel)
+  2 l2_norm kNN, GIST-like 256k x 960     (exact XLA path — no HNSW in
+                                           the reference either; recall 1.0)
+  3 hybrid BM25 + kNN with RRF fusion     (end-to-end through Node.search)
+  4 int8 scalar-quantized, 1M x 768       (int8 corpus, recall vs f32)
+  5 filtered kNN, 1M x 128, 10% filter    (host bitmap -> masked top-k)
+
+Batches are scanned on-device inside one dispatch (see bench.py for why:
+this environment adds a tunnel round-trip per dispatch).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def _device_qps(search_all, qstack, corpus, k, n_queries, runs=3):
+    import jax
+    out = search_all(qstack, corpus, k)
+    ids = np.asarray(out[1])
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = search_all(qstack, corpus, k)
+        ids = np.asarray(out[1])
+        times.append(time.perf_counter() - t0)
+    return n_queries / float(np.median(times)), ids
+
+
+def _recall(ids, ids_ref, k):
+    n = ids_ref.shape[0]
+    hits = sum(len(set(ids[r][:k]) & set(ids_ref[r][:k])) for r in range(n))
+    return hits / (n * k)
+
+
+def _scan_searcher(fn):
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("kk",))
+    def search_all(qs, c, kk):
+        def body(carry, qb):
+            return carry, fn(qb, c, kk)
+        _, out = jax.lax.scan(body, None, qs)
+        return out
+
+    return search_all
+
+
+def run_config(name, n, d, metric, dtype, k, batches, batch, filter_frac=None):
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import knn as knn_ops
+    from elasticsearch_tpu.ops import similarity as sim
+
+    rng = np.random.default_rng(7)
+    centers = rng.standard_normal((128, d)).astype(np.float32) * 2.0
+    vectors = (centers[rng.integers(0, 128, size=n)]
+               + rng.standard_normal((n, d)).astype(np.float32))
+    nq = batch * batches
+    queries = vectors[rng.integers(0, n, size=nq)] \
+        + 0.3 * rng.standard_normal((nq, d)).astype(np.float32)
+    corpus = knn_ops.build_corpus(vectors, metric=metric, dtype=dtype)
+    qstack = jnp.asarray(queries.reshape(batches, batch, d))
+    jax.block_until_ready(corpus)
+
+    mask = None
+    if filter_frac is not None:
+        keep = rng.random(corpus.matrix.shape[0]) < filter_frac
+        keep[n:] = False
+        mask = jnp.asarray(keep)
+
+    if mask is not None:
+        def fn(qb, c, kk, m=mask):
+            return knn_ops.knn_search(qb, c, kk, metric=metric, filter_mask=m)
+    else:
+        def fn(qb, c, kk):
+            return knn_ops.knn_search_auto(qb, c, kk, metric=metric)
+
+    qps, ids = _device_qps(_scan_searcher(fn), qstack, corpus, k, nq)
+
+    # recall vs exact f32 on the first batch
+    f32_corpus = knn_ops.build_corpus(vectors, metric=metric, dtype="f32") \
+        if dtype != "f32" else corpus
+    _, ids_ref = knn_ops.knn_search(qstack[0], f32_corpus, k=k, metric=metric,
+                                    precision="f32",
+                                    filter_mask=mask)
+    recall = _recall(ids[0], np.asarray(ids_ref), k)
+    print(json.dumps({"config": name, "qps": round(qps, 1),
+                      "recall_at_10": round(recall, 4), "n_docs": n,
+                      "dims": d, "metric": metric, "dtype": dtype,
+                      **({"filter_frac": filter_frac}
+                         if filter_frac is not None else {})}), flush=True)
+
+
+def run_hybrid_rrf():
+    """Config 3: BM25 + kNN fused with RRF, end-to-end through Node."""
+    import tempfile
+
+    from elasticsearch_tpu.node import Node
+
+    rng = np.random.default_rng(3)
+    words = ["alpha", "beta", "gamma", "delta", "tpu", "search", "vector",
+             "index", "shard", "query"]
+    node = Node(tempfile.mkdtemp())
+    node.create_index_with_templates("hybrid", mappings={"properties": {
+        "body": {"type": "text"},
+        "v": {"type": "dense_vector", "dims": 64}}})
+    n_docs = 2000
+    ops = []
+    for i in range(n_docs):
+        text = " ".join(rng.choice(words, size=8))
+        ops.append({"index": {"_index": "hybrid", "_id": str(i)}})
+        ops.append({"body": text,
+                    "v": rng.standard_normal(64).astype(np.float32).tolist()})
+    node.bulk(ops)
+    node.indices.get("hybrid").refresh()
+
+    qv = rng.standard_normal(64).astype(np.float32).tolist()
+    body = {"rank": {"rrf": {"rank_constant": 60, "rank_window_size": 100}},
+            "query": {"match": {"body": "tpu vector"}},
+            "knn": {"field": "v", "query_vector": qv, "k": 100},
+            "size": 10}
+    node.search("hybrid", body)  # warm
+    t0 = time.perf_counter()
+    n_runs = 30
+    for _ in range(n_runs):
+        resp = node.search("hybrid", body)
+    dt = time.perf_counter() - t0
+    assert resp["hits"]["hits"], "rrf returned no hits"
+    print(json.dumps({"config": "3_hybrid_bm25_knn_rrf",
+                      "qps": round(n_runs / dt, 1),
+                      "p50_ms": round(dt / n_runs * 1000, 2),
+                      "n_docs": n_docs, "fused_lists": 2}), flush=True)
+    node.close()
+
+
+def main():
+    run_config("1_cosine_sift1m", 1_000_000, 128, "cosine", "bf16",
+               k=10, batches=50, batch=128)
+    run_config("2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16",
+               k=10, batches=10, batch=128)
+    run_hybrid_rrf()
+    run_config("4_int8_768d", 1_000_000, 768, "cosine", "int8",
+               k=10, batches=10, batch=128)
+    run_config("5_filtered_10pct", 1_000_000, 128, "cosine", "bf16",
+               k=10, batches=10, batch=128, filter_frac=0.10)
+
+
+if __name__ == "__main__":
+    main()

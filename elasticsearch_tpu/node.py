@@ -363,6 +363,62 @@ class Node:
             mappings=merged_mappings if merged_mappings["properties"] or mappings else mappings,
             aliases=merged_aliases or None)
 
+    def _search_rrf(self, index_expr: Optional[str], body: dict,
+                    rrf: dict, ignore_throttled: bool) -> dict:
+        """Reciprocal-rank fusion at the coordinator (BASELINE config 3:
+        hybrid BM25 + kNN; the reference's designated fusion point is the
+        rescore boundary — RRF composes the ranked lists instead:
+        score(d) = Σ_lists 1 / (rank_constant + rank_list(d))).
+
+        Sub-searches come from `sub_searches: [{query}, ...]` or, in the
+        common hybrid shape, the top-level `query` plus `knn` clauses.
+        """
+        rank_constant = int(rrf.get("rank_constant", 60))
+        window = int(rrf.get("rank_window_size", rrf.get("window_size", 100)))
+        size = int(body.get("size", 10))
+
+        sub_queries: List[dict] = []
+        if body.get("sub_searches"):
+            sub_queries = [s.get("query", {"match_all": {}})
+                           for s in body["sub_searches"]]
+        else:
+            if body.get("query") is not None:
+                sub_queries.append(body["query"])
+            if body.get("knn") is not None:
+                knn = body["knn"]
+                sub_queries.append({"knn": knn})
+        if len(sub_queries) < 2:
+            raise IllegalArgumentError(
+                "[rrf] requires at least 2 ranked lists (sub_searches, or "
+                "query + knn)")
+
+        passthrough = {k: v for k, v in body.items()
+                       if k in ("_source", "docvalue_fields", "highlight")}
+        fused: Dict[tuple, float] = {}
+        hit_by_key: Dict[tuple, dict] = {}
+        start = time.perf_counter()
+        for q in sub_queries:
+            sub_body = {"query": q, "size": window, **passthrough}
+            resp = self.search(index_expr, sub_body,
+                               ignore_throttled=ignore_throttled)
+            for rank_pos, hit in enumerate(resp["hits"]["hits"]):
+                key = (hit["_index"], hit["_id"])
+                fused[key] = fused.get(key, 0.0) + 1.0 / (
+                    rank_constant + rank_pos + 1)
+                hit_by_key.setdefault(key, hit)
+        ordered = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
+        hits = []
+        for key, score in ordered[:size]:
+            hit = dict(hit_by_key[key])
+            hit["_score"] = score
+            hit.pop("sort", None)
+            hits.append(hit)
+        return {"took": int((time.perf_counter() - start) * 1000),
+                "timed_out": False,
+                "hits": {"total": {"value": len(fused), "relation": "eq"},
+                         "max_score": hits[0]["_score"] if hits else None,
+                         "hits": hits}}
+
     @staticmethod
     def _maybe_refresh(svc: IndexService, refresh) -> None:
         if refresh in ("true", "wait_for", True, ""):
@@ -372,6 +428,10 @@ class Node:
     def search(self, index_expr: Optional[str], body: Optional[dict],
                ignore_throttled: bool = True) -> dict:
         body = body or {}
+        rank = body.get("rank")
+        if isinstance(rank, dict) and "rrf" in rank:
+            return self._search_rrf(index_expr, body, rank["rrf"] or {},
+                                    ignore_throttled)
         # cross-cluster search: split `alias:index` parts, fan out, merge
         # (reference: TransportSearchAction + SearchResponseMerger)
         if index_expr and ":" in index_expr:
