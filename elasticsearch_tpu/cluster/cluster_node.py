@@ -470,9 +470,13 @@ class ClusterNode:
             raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
         body = request["body"]
         reader = local.engine.acquire_searcher()
+        # aggs leave the shard as mergeable partial states (HLL/t-digest/
+        # sum-count pairs) — the coordinator reduce in _merge_shard_results
+        # finalizes them (InternalAggregation.reduce analog)
         result = execute_query_phase(reader, local.mapper_service, body,
                                      shard_id=request["shard"],
-                                     vector_store=local.vector_store)
+                                     vector_store=local.vector_store,
+                                     partial_aggs=True)
         hits = execute_fetch_phase(reader, local.mapper_service, body, result,
                                    index_name=request["index"])
         respond({
@@ -490,7 +494,11 @@ class ClusterNode:
     def _merge_shard_results(self, results: List[Optional[dict]], body: dict,
                              num_shards: int) -> dict:
         """Coordinator reduce (`SearchPhaseController.merge:293` analog)."""
-        from elasticsearch_tpu.node import _merge_agg_trees, _sort_key_tuple
+        from elasticsearch_tpu.node import _sort_key_tuple
+        from elasticsearch_tpu.search.agg_partials import (
+            finalize_aggs, merge_partial_aggs,
+        )
+        aggs_spec = body.get("aggs") or body.get("aggregations")
 
         all_hits = []
         total = 0
@@ -512,7 +520,7 @@ class ClusterNode:
                 all_hits.append((h, score, sv, res["shard"]))
             if res.get("aggregations") is not None:
                 aggs = res["aggregations"] if aggs is None else \
-                    _merge_agg_trees(aggs, res["aggregations"])
+                    merge_partial_aggs(aggs, res["aggregations"], aggs_spec)
 
         if body.get("sort"):
             all_hits.sort(key=lambda t: (_sort_key_tuple(t[2], body), t[3]))
@@ -531,7 +539,7 @@ class ClusterNode:
                      "hits": [h for h, _, _, _ in window]},
         }
         if aggs is not None:
-            out["aggregations"] = aggs
+            out["aggregations"] = finalize_aggs(aggs, aggs_spec)
         return out
 
     def client_get(self, index: str, doc_id: str,

@@ -462,7 +462,12 @@ class Node:
 
         profile_enabled = bool(body.get("profile"))
         profile_shards = []
-        # execute per index, merge across indices by score/sort
+        # execute per index, merge across indices by score/sort; with >1
+        # index the aggs travel as mergeable partial states and are
+        # finalized once after the reduce (agg_partials, the
+        # InternalAggregation.reduce analog)
+        aggs_spec = body.get("aggs") or body.get("aggregations")
+        use_partial_aggs = bool(aggs_spec) and len(readers) > 1
         all_hits = []
         total = 0
         relation = "eq"
@@ -472,7 +477,8 @@ class Node:
             for svc, reader, store in readers:
                 q_start = time.perf_counter_ns()
                 result = execute_query_phase(reader, svc.mapper_service, body,
-                                             vector_store=store)
+                                             vector_store=store,
+                                             partial_aggs=use_partial_aggs)
                 q_nanos = time.perf_counter_ns() - q_start
                 total += result.total_hits
                 if result.total_relation == "gte":
@@ -490,8 +496,11 @@ class Node:
                     if merged_aggs is None:
                         merged_aggs = result.aggregations
                     else:
-                        merged_aggs = _merge_agg_trees(merged_aggs,
-                                                       result.aggregations)
+                        from elasticsearch_tpu.search.agg_partials import (
+                            merge_partial_aggs,
+                        )
+                        merged_aggs = merge_partial_aggs(
+                            merged_aggs, result.aggregations, aggs_spec)
                 if profile_enabled:
                     from elasticsearch_tpu.search.profile import shard_profile
                     profile_shards.append(shard_profile(
@@ -523,6 +532,9 @@ class Node:
             },
         }
         if merged_aggs is not None:
+            if use_partial_aggs:
+                from elasticsearch_tpu.search.agg_partials import finalize_aggs
+                merged_aggs = finalize_aggs(merged_aggs, aggs_spec)
             resp["aggregations"] = merged_aggs
         if profile_enabled:
             resp["profile"] = {"shards": profile_shards}
@@ -870,26 +882,6 @@ class _MissingLast:
 _MISSING_SENTINEL = _MissingLast()
 
 
-def _merge_agg_trees(a: dict, b: dict) -> dict:
-    """Best-effort cross-index agg merge (single-node scope: same-shaped trees)."""
-    out = dict(a)
-    for k, v in b.items():
-        if k not in out:
-            out[k] = v
-        elif isinstance(v, dict) and isinstance(out[k], dict):
-            if "buckets" in v and "buckets" in out[k]:
-                merged: Dict[Any, dict] = {}
-                for bucket in (out[k]["buckets"] if isinstance(out[k]["buckets"], list) else []):
-                    merged[bucket.get("key")] = dict(bucket)
-                for bucket in (v["buckets"] if isinstance(v["buckets"], list) else []):
-                    key = bucket.get("key")
-                    if key in merged:
-                        merged[key]["doc_count"] += bucket.get("doc_count", 0)
-                    else:
-                        merged[key] = dict(bucket)
-                out[k] = {**out[k], "buckets": sorted(
-                    merged.values(), key=lambda x: -x.get("doc_count", 0))}
-            elif "value" in v and "value" in out[k]:
-                # sums merge; others take max sensibly? keep first (documented limit)
-                out[k] = out[k]
-    return out
+# cross-index / cross-shard agg merging lives in search/agg_partials.py:
+# shards emit mergeable partial states, the coordinator reduces + finalizes
+# (InternalAggregation.reduce analog)

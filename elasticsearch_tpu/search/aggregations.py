@@ -423,12 +423,13 @@ def compute_aggs(ctx: SearchContext, rows: np.ndarray, aggs_spec: dict) -> dict:
 
 
 def _bucketize(ctx, rows, sub_aggs, buckets: List[Tuple[Any, np.ndarray]],
-               key_name: str = "key") -> List[dict]:
+               key_name: str = "key", recurse=None) -> List[dict]:
+    recurse = recurse or compute_aggs
     out = []
     for key, brows in buckets:
         b = {key_name: key, "doc_count": int(len(brows))}
         if sub_aggs:
-            b.update(compute_aggs(ctx, brows, sub_aggs))
+            b.update(recurse(ctx, brows, sub_aggs))
         out.append(b)
     return out
 
@@ -489,7 +490,11 @@ def _gather_geo_points(ctx: SearchContext, rows: np.ndarray, field: str):
 
 
 def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
-                    spec: dict, sub_aggs: dict) -> dict:
+                    spec: dict, sub_aggs: dict, recurse=None) -> dict:
+    """One bucket agg. `recurse` computes sub-agg trees over bucket rows —
+    `compute_aggs` for final output, or the partial-mode walker
+    (`agg_partials.compute_partial_aggs`) for the distributed reduce."""
+    recurse = recurse or compute_aggs
     field = spec.get("field")
 
     if kind in ("geohash_grid", "geotile_grid"):
@@ -505,7 +510,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
             brows = np.asarray(sorted(set(cells[key])), dtype=np.int64)
             b = {"key": key, "doc_count": int(len(brows))}
             if sub_aggs:
-                b.update(compute_aggs(ctx, brows, sub_aggs))
+                b.update(recurse(ctx, brows, sub_aggs))
             buckets.append(b)
         return {"buckets": buckets}
 
@@ -515,7 +520,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         brows = rows[np.isin(rows, match)]
         b = {"doc_count": int(len(brows))}
         if sub_aggs:
-            b.update(compute_aggs(ctx, brows, sub_aggs))
+            b.update(recurse(ctx, brows, sub_aggs))
         return b
 
     if kind == "filters":
@@ -528,7 +533,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
             brows = rows[np.isin(rows, match)]
             b = {"doc_count": int(len(brows))}
             if sub_aggs:
-                b.update(compute_aggs(ctx, brows, sub_aggs))
+                b.update(recurse(ctx, brows, sub_aggs))
             if named:
                 buckets[key] = b
             else:
@@ -539,7 +544,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         grows = ctx.all_rows()
         b = {"doc_count": int(len(grows))}
         if sub_aggs:
-            b.update(compute_aggs(ctx, grows, sub_aggs))
+            b.update(recurse(ctx, grows, sub_aggs))
         return b
 
     if kind == "missing":
@@ -547,7 +552,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         brows = rows[[v is None for v in vals]]
         b = {"doc_count": int(len(brows))}
         if sub_aggs:
-            b.update(compute_aggs(ctx, brows, sub_aggs))
+            b.update(recurse(ctx, brows, sub_aggs))
         return b
 
     if kind in ("terms", "significant_terms", "rare_terms"):
@@ -573,7 +578,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 items.sort(key=lambda kv: (len(kv[1]),), reverse=reverse)
             else:
                 def metric_val(kv):
-                    sub_out = compute_aggs(ctx, rows[kv[1]], sub_aggs)
+                    sub_out = recurse(ctx, rows[kv[1]], sub_aggs)
                     node = sub_out
                     for part in okey.split("."):
                         node = node[part] if isinstance(node, dict) else None
@@ -583,7 +588,8 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
             items.sort(key=lambda kv: (-len(kv[1]), _sort_key(kv[0])))
         total_other = sum(len(i) for _, i in items[size:])
         buckets = _bucketize(ctx, rows, sub_aggs,
-                             [(k, rows[i]) for k, i in items[:size]])
+                             [(k, rows[i]) for k, i in items[:size]],
+                             recurse=recurse)
         return {"doc_count_error_upper_bound": 0,
                 "sum_other_doc_count": int(total_other), "buckets": buckets}
 
@@ -594,7 +600,8 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         vals, present = numeric_values(ctx, rows, field, spec.get("missing"))
         keys = np.floor((vals - offset) / interval) * interval + offset
         return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
-                              spec.get("extended_bounds"), interval)
+                              spec.get("extended_bounds"), interval,
+                              recurse=recurse)
 
     if kind == "date_histogram":
         interval_ms, calendar = _date_interval(spec)
@@ -606,7 +613,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         else:
             keys = np.floor(vals / interval_ms) * interval_ms
         return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
-                              None, interval_ms, date=True)
+                              None, interval_ms, date=True, recurse=recurse)
 
     if kind == "auto_date_histogram":
         target = int(spec.get("buckets", 10))
@@ -623,7 +630,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 break
         keys = np.floor(vals / interval_ms) * interval_ms
         out = _histo_buckets(ctx, rows, sub_aggs, keys, present, 0, None,
-                             interval_ms, date=True)
+                             interval_ms, date=True, recurse=recurse)
         out["interval"] = f"{int(interval_ms)}ms"
         return out
 
@@ -655,7 +662,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
             if to is not None:
                 b["to"] = to
             if sub_aggs:
-                b.update(compute_aggs(ctx, brows, sub_aggs))
+                b.update(recurse(ctx, brows, sub_aggs))
             buckets.append(b)
         return {"buckets": buckets}
 
@@ -664,7 +671,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         brows = rows[:shard_size]
         b = {"doc_count": int(len(brows))}
         if sub_aggs:
-            b.update(compute_aggs(ctx, brows, sub_aggs))
+            b.update(recurse(ctx, brows, sub_aggs))
         return b
 
     if kind == "composite":
@@ -710,7 +717,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         for key, idxs in items:
             b = {"key": dict(zip(names, key)), "doc_count": len(idxs)}
             if sub_aggs:
-                b.update(compute_aggs(ctx, rows[np.asarray(idxs, dtype=np.int64)], sub_aggs))
+                b.update(recurse(ctx, rows[np.asarray(idxs, dtype=np.int64)], sub_aggs))
             buckets.append(b)
         out = {"buckets": buckets}
         if buckets:
@@ -727,14 +734,14 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
             if len(ra):
                 b = {"key": a, "doc_count": int(len(ra))}
                 if sub_aggs:
-                    b.update(compute_aggs(ctx, ra, sub_aggs))
+                    b.update(recurse(ctx, ra, sub_aggs))
                 buckets.append(b)
             for bname in names[i + 1:]:
                 rb = ra[np.isin(ra, matches[bname])]
                 if len(rb):
                     b = {"key": f"{a}&{bname}", "doc_count": int(len(rb))}
                     if sub_aggs:
-                        b.update(compute_aggs(ctx, rb, sub_aggs))
+                        b.update(recurse(ctx, rb, sub_aggs))
                     buckets.append(b)
         return {"buckets": buckets}
 
@@ -742,7 +749,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         # nested docs are stored flattened; nested agg scopes to docs having the path
         b = {"doc_count": int(len(rows))}
         if sub_aggs:
-            b.update(compute_aggs(ctx, rows, sub_aggs))
+            b.update(recurse(ctx, rows, sub_aggs))
         return b
 
     raise ParsingError(f"unknown bucket aggregation [{kind}]")
@@ -758,17 +765,30 @@ def _sort_key(v):
     return (1, str(v))
 
 
+MAX_BUCKETS = 65536  # reference: search.max_buckets default
+
+
 def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
-                   extended_bounds, interval, date=False) -> dict:
+                   extended_bounds, interval, date=False, recurse=None) -> dict:
+    recurse = recurse or compute_aggs
     groups: Dict[float, np.ndarray] = {}
     valid = present & ~np.isnan(keys)
     for key in np.unique(keys[valid]):
         groups[float(key)] = rows[valid & (keys == key)]
     all_keys = sorted(groups)
+
+    def _guard_span(lo_key, hi_key):
+        # reference: search.max_buckets / MultiBucketConsumer
+        if interval and (hi_key - lo_key) / interval > MAX_BUCKETS:
+            raise IllegalArgumentError(
+                f"Trying to create too many buckets. Must be less than or "
+                f"equal to: [{MAX_BUCKETS}].")
+
     if extended_bounds and interval:
         lo, hi = float(extended_bounds.get("min", np.inf)), float(extended_bounds.get("max", -np.inf))
         k = min([lo] + all_keys) if all_keys or lo != np.inf else lo
         top = max([hi] + all_keys) if all_keys or hi != -np.inf else hi
+        _guard_span(k, top)
         cur = k
         full = []
         while cur <= top + 1e-9:
@@ -776,6 +796,7 @@ def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
             cur += interval
         all_keys = full
     elif min_count == 0 and all_keys and interval:
+        _guard_span(all_keys[0], all_keys[-1])
         full = []
         cur = all_keys[0]
         while cur <= all_keys[-1] + 1e-9:
@@ -791,7 +812,7 @@ def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
         if date:
             b["key_as_string"] = _millis_to_iso(int(key))
         if sub_aggs:
-            b.update(compute_aggs(ctx, brows, sub_aggs))
+            b.update(recurse(ctx, brows, sub_aggs))
         buckets.append(b)
     return {"buckets": buckets}
 

@@ -57,7 +57,8 @@ class ShardSearchResult:
 
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         body: dict, shard_id: int = 0,
-                        vector_store=None) -> ShardSearchResult:
+                        vector_store=None,
+                        partial_aggs: bool = False) -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service)
     ctx.vector_store = vector_store
 
@@ -163,7 +164,13 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     aggs = None
     aggs_spec = body.get("aggs") or body.get("aggregations")
     if aggs_spec:
-        aggs = compute_aggs(ctx, agg_rows, aggs_spec)
+        if partial_aggs:
+            # distributed search: ship mergeable partial states, the
+            # coordinator reduces + finalizes (InternalAggregation.reduce)
+            from elasticsearch_tpu.search.agg_partials import compute_partial_aggs
+            aggs = compute_partial_aggs(ctx, agg_rows, aggs_spec)
+        else:
+            aggs = compute_aggs(ctx, agg_rows, aggs_spec)
 
     if max_score_early is not None:
         max_score = max_score_early

@@ -238,3 +238,56 @@ def test_total_copy_loss_goes_red_not_empty(tmp_path):
     for n in c.nodes.values():
         if not n.coordinator.stopped:
             n.stop()
+
+
+def test_cross_shard_metric_aggs_correct(cluster):
+    """Round-1 regression: metric aggs across shards with divergent data
+    must equal single-shard ground truth (the old merge kept shard 0's
+    value). Docs are routed so the two shards hold disjoint value ranges."""
+    c = cluster
+    c.any_node().client_create_index(
+        "skew", settings={"index.number_of_shards": 2,
+                          "index.number_of_replicas": 0},
+        mappings={"properties": {"cat": {"type": "keyword"},
+                                 "name": {"type": "keyword"},
+                                 "v": {"type": "double"}}})
+    assert c.run_until(lambda: c.all_started("skew"))
+
+    writer = c.any_node()
+    vals = [float(i) for i in range(60)]
+    for i, v in enumerate(vals):
+        r = c.call(writer.client_write, "skew",
+                   {"type": "index", "id": str(i),
+                    "source": {"cat": ["a", "b"][i % 2],
+                               "name": f"n{i % 11}", "v": v}})
+        assert r["result"] == "created", r
+    for node in c.nodes.values():
+        node.refresh_all()
+
+    # sanity: data actually spans both shards
+    per_shard = {}
+    for node in c.nodes.values():
+        for (idx, sid), shard in node.local_shards.items():
+            if idx == "skew" and shard.routing.primary:
+                per_shard[sid] = shard.engine.doc_count()
+    assert len(per_shard) == 2 and all(n > 0 for n in per_shard.values()), per_shard
+
+    resp = c.call(c.any_node().client_search, "skew", {
+        "size": 0,
+        "aggs": {
+            "mean": {"avg": {"field": "v"}},
+            "card": {"cardinality": {"field": "name"}},
+            "pct": {"percentiles": {"field": "v", "percents": [50]}},
+            "cats": {"terms": {"field": "cat"},
+                     "aggs": {"m": {"avg": {"field": "v"}}}},
+        }})
+    aggs = resp["aggregations"]
+    assert abs(aggs["mean"]["value"] - sum(vals) / len(vals)) < 1e-9
+    assert aggs["card"]["value"] == 11
+    assert abs(aggs["pct"]["values"]["50.0"] - 29.5) < 1.5
+    buckets = {b["key"]: b for b in aggs["cats"]["buckets"]}
+    evens = [v for i, v in enumerate(vals) if i % 2 == 0]
+    odds = [v for i, v in enumerate(vals) if i % 2 == 1]
+    assert buckets["a"]["doc_count"] == 30
+    assert abs(buckets["a"]["m"]["value"] - sum(evens) / 30) < 1e-9
+    assert abs(buckets["b"]["m"]["value"] - sum(odds) / 30) < 1e-9
