@@ -32,9 +32,8 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster import allocation
-from elasticsearch_tpu.cluster.coordination import (
-    LEADER, Coordinator, PersistedState,
-)
+from elasticsearch_tpu.cluster.coordination import LEADER, Coordinator
+from elasticsearch_tpu.cluster.gateway import FilePersistedState
 from elasticsearch_tpu.cluster.routing import shard_id_for
 from elasticsearch_tpu.cluster.state import (
     ClusterState, DiscoveryNode, ShardRoutingEntry,
@@ -89,8 +88,12 @@ class ClusterNode:
         self.local_shards: Dict[Tuple[str, int], LocalShard] = {}
         self.mappers: Dict[str, MapperService] = {}
         node = DiscoveryNode(node_id)
+        # durable gateway: term + last-accepted state survive full-cluster
+        # restarts (PersistedClusterStateService/GatewayMetaState analog);
+        # initial_state seeds only a never-booted node
+        persisted = FilePersistedState(data_path, initial_state=initial_state)
         self.coordinator = Coordinator(
-            node, PersistedState(0, initial_state), transport, scheduler,
+            node, persisted, transport, scheduler,
             seed_peers=seed_peers, on_committed=self.apply_cluster_state, rng=rng)
         self.coordinator.membership_listener = self._on_membership_change
         self._register_handlers()

@@ -1,0 +1,114 @@
+"""Durable coordination state (the gateway).
+
+Re-design of `gateway/PersistedClusterStateService.java:117` +
+`GatewayMetaState.java:79`: every node persists (currentTerm,
+lastAcceptedClusterState) to its data path *before* acknowledging joins
+or publications, so a full-cluster restart recovers committed metadata
+(indices, mappings, voting configs) with terms monotonic — the safety
+argument of the consensus layer depends on this durability.
+
+The reference stores state docs in a dedicated Lucene index with
+generation files; here each write is a CRC-tagged JSON generation file
+committed via write-to-temp → fsync → atomic rename → fsync(dir), with
+the previous generation retained for torn-write recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional, Tuple
+
+from elasticsearch_tpu.cluster.coordination import PersistedState
+from elasticsearch_tpu.cluster.state import ClusterState
+
+_STATE_DIR = "_state"
+_PREFIX = "coord-"
+_SUFFIX = ".json"
+_KEEP_GENERATIONS = 2
+
+
+def _canonical(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+class FilePersistedState(PersistedState):
+    """File-backed (term, lastAcceptedState); drop-in for the in-memory
+    PersistedState the deterministic tests use."""
+
+    def __init__(self, data_path: str,
+                 initial_state: Optional[ClusterState] = None):
+        self.dir = os.path.join(data_path, _STATE_DIR)
+        os.makedirs(self.dir, exist_ok=True)
+        loaded = self._load_latest()
+        # resume from the HIGHEST generation present (readable or not):
+        # new writes must supersede unreadable high-numbered files, or the
+        # retention sweep would keep the corrupt ones and delete fresh state
+        gens = self._generations()
+        self.generation = gens[0][0] if gens else 0
+        if loaded is not None:
+            _, term, state = loaded
+        else:
+            term, state = 0, initial_state or ClusterState()
+        super().__init__(term, state)
+
+    # -- recovery -------------------------------------------------------------
+    def _generations(self):
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(_PREFIX) and name.endswith(_SUFFIX):
+                try:
+                    out.append((int(name[len(_PREFIX):-len(_SUFFIX)]), name))
+                except ValueError:
+                    continue
+        return sorted(out, reverse=True)
+
+    def _load_latest(self) -> Optional[Tuple[int, int, ClusterState]]:
+        """Newest readable generation, or None."""
+        for gen, name in self._generations():
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path, "rb") as f:
+                    wrapper = json.loads(f.read())
+                doc = wrapper["doc"]
+                if zlib.crc32(_canonical(doc)) != wrapper["crc"]:
+                    continue  # torn write: fall back to previous generation
+                return gen, int(doc["term"]), ClusterState.from_dict(doc["state"])
+            except (OSError, ValueError, KeyError):
+                continue
+        return None
+
+    # -- durable mutations ----------------------------------------------------
+    def set_term(self, term: int) -> None:
+        if term != self.current_term:
+            super().set_term(term)
+            self._persist()
+
+    def set_last_accepted(self, state: ClusterState) -> None:
+        super().set_last_accepted(state)
+        self._persist()
+
+    def _persist(self) -> None:
+        doc = {"term": self.current_term,
+               "state": self.last_accepted.to_dict()}
+        payload = json.dumps(
+            {"crc": zlib.crc32(_canonical(doc)), "doc": doc}).encode()
+        self.generation += 1
+        final = os.path.join(self.dir, f"{_PREFIX}{self.generation}{_SUFFIX}")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, final)
+        dir_fd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+        for gen, name in self._generations()[_KEEP_GENERATIONS:]:
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
