@@ -80,14 +80,14 @@ class LocalShard:
 class ClusterNode:
     def __init__(self, node_id: str, data_path: str, transport, scheduler,
                  seed_peers: List[str], initial_state: ClusterState,
-                 rng=None):
+                 rng=None, address: str = ""):
         self.node_id = node_id
         self.data_path = data_path
         self.transport = transport
         self.scheduler = scheduler
         self.local_shards: Dict[Tuple[str, int], LocalShard] = {}
         self.mappers: Dict[str, MapperService] = {}
-        node = DiscoveryNode(node_id)
+        node = DiscoveryNode(node_id, address=address)
         # durable gateway: term + last-accepted state survive full-cluster
         # restarts (PersistedClusterStateService/GatewayMetaState analog);
         # initial_state seeds only a never-booted node
@@ -201,6 +201,17 @@ class ClusterNode:
     # --------------------------------------------------- cluster state applier
     def apply_cluster_state(self, state: ClusterState) -> None:
         """IndicesClusterStateService.applyClusterState analog."""
+        # learn peer transport addresses from the published node set, so
+        # every node can dial every other (NodeConnectionsService analog);
+        # the deterministic test transport routes by id and has no addresses
+        add_addr = getattr(self.transport, "add_peer_address", None)
+        if add_addr is not None:
+            for n in state.nodes.values():
+                if n.address and n.node_id != self.node_id:
+                    host, _, port = n.address.rpartition(":")
+                    if host and port.isdigit():
+                        add_addr(n.node_id, host, int(port))
+
         my_entries = {(r.index, r.shard): r for r in state.routing
                       if r.node_id == self.node_id}
 
@@ -592,6 +603,7 @@ class ClusterNode:
         t.register(me, WRITE_REPLICA, self._on_write_replica)
         t.register(me, QUERY_SHARD, self._on_query_shard)
         t.register(me, "indices:data/read/get", self._on_get)
+        t.register(me, "indices:admin/refresh", self._on_refresh)
         t.register(me, RECOVERY_START, self._on_recovery_start)
         t.register(me, MASTER_CREATE_INDEX, self._master_create_index)
         t.register(me, MASTER_DELETE_INDEX, self._master_delete_index)
@@ -610,3 +622,48 @@ class ClusterNode:
     def client_delete_index(self, name: str, on_done: Optional[Callable] = None) -> None:
         self._send_to_master(MASTER_DELETE_INDEX, {"index": name},
                              on_response=on_done or (lambda r: None))
+
+    def _on_refresh(self, sender, request, respond):
+        index = (request or {}).get("index")
+        for (idx, _sid), shard in self.local_shards.items():
+            if index is None or idx == index:
+                shard.engine.refresh()
+        respond({"ack": True})
+
+    def client_refresh(self, index: Optional[str],
+                       on_done: Callable[[dict], None]) -> None:
+        """Cluster-wide refresh: broadcast to every node holding shards
+        (RefreshAction broadcast-by-node analog)."""
+        state = self.cluster_state
+        targets = sorted({n for n in state.nodes})
+        if not targets:
+            targets = [self.node_id]
+        pending = {"count": len(targets), "ok": 0, "failed": 0}
+
+        def finish():
+            on_done({"_shards": {"total": len(targets),
+                                 "successful": pending["ok"],
+                                 "failed": pending["failed"]}})
+
+        def one_ok(_resp=None):
+            pending["ok"] += 1
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                finish()
+
+        def one_fail(_err=None):
+            # an unreachable node means its shards were NOT refreshed — the
+            # response must say so, not claim success (RefreshAction reports
+            # per-shard failures)
+            pending["failed"] += 1
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                finish()
+
+        for t in targets:
+            if t == self.node_id:
+                self._on_refresh(self.node_id, {"index": index}, one_ok)
+            else:
+                self.transport.send(self.node_id, t, "indices:admin/refresh",
+                                    {"index": index},
+                                    on_response=one_ok, on_failure=one_fail)

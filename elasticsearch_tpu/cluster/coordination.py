@@ -249,6 +249,10 @@ class Coordinator:
         self.committed_state: ClusterState = persisted.last_accepted
         self.stopped = False
         self._election_round = 0
+        # joiner transport addresses learned from join requests, published
+        # in DiscoveryNode.address so every node can dial every other
+        # (reference: JoinRequest carries the joining DiscoveryNode)
+        self._join_addresses: Dict[str, str] = {}
         # optional hook: (state, added_ids, removed_ids) -> state, applied by
         # the leader after membership changes so shard allocation follows
         # node join/leave (reference: AllocationService wired into
@@ -325,10 +329,13 @@ class Coordinator:
         # a higher term always knocks a leader/follower back to candidate
         if self.mode != CANDIDATE:
             self._become_candidate("received start-join for a newer term")
+        join["address"] = self.node.address  # so the leader can publish it
         self.transport.send(self.node.node_id, request["source"], JOIN_ACTION, join)
         respond({"ack": True})
 
     def _on_join(self, sender: str, join: dict, respond) -> None:
+        if join.get("address"):
+            self._join_addresses[join["source"]] = join["address"]
         try:
             won_now = self.state.handle_join(join)
         except CoordinationError:
@@ -368,7 +375,8 @@ class Coordinator:
         nodes = dict(base.nodes)
         nodes[self.node.node_id] = self.node
         for voter in self.state.join_votes:
-            nodes.setdefault(voter, DiscoveryNode(voter))
+            nodes.setdefault(voter, DiscoveryNode(
+                voter, address=self._join_addresses.get(voter, "")))
         config = self._choose_voting_config(nodes)
         state = base.with_(
             term=self.state.current_term,
@@ -493,10 +501,13 @@ class Coordinator:
     # ---------------------------------------------------------- reconfiguration
     def _leader_add_node(self, node_id: str) -> None:
         def add(base: ClusterState) -> ClusterState:
-            if node_id in base.nodes:
+            addr = self._join_addresses.get(node_id, "")
+            existing = base.nodes.get(node_id)
+            if existing is not None and (not addr or existing.address == addr):
                 return base
             nodes = dict(base.nodes)
-            nodes[node_id] = DiscoveryNode(node_id)
+            nodes[node_id] = DiscoveryNode(
+                node_id, address=addr or (existing.address if existing else ""))
             state = base.with_(nodes=nodes,
                                last_accepted_config=self._choose_voting_config(nodes))
             if self.membership_listener is not None:

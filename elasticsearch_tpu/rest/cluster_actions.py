@@ -1,0 +1,276 @@
+"""REST handlers for a clustered node: HTTP → ClusterNode transport actions.
+
+The production wiring the reference does in `node/Node.java:502` (REST →
+NodeClient → TransportAction → TransportService): REST handlers run on the
+HTTP worker pool, bridge onto the node's event loop, and wait on the
+callback-style ClusterNode client methods. Any node serves any request —
+writes reroute to the primary, admin updates reroute to the elected
+master, searches scatter-gather over the shard copies.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+import uuid
+from typing import Any, Callable, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.version import __version__
+
+
+class ClusterRestAdapter:
+    """Bridges HTTP worker threads onto the node's asyncio event loop and
+    back: ClusterNode callbacks always fire on the loop thread."""
+
+    def __init__(self, cluster_node, loop):
+        self.node = cluster_node
+        self.loop = loop
+
+    def call(self, fn: Callable, *args, timeout: float = 30.0,
+             has_failure_cb: bool = False, **kw) -> Any:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def on_done(result):
+            if not fut.done():
+                fut.set_result(result)
+
+        def on_failure(err):
+            if not fut.done():
+                fut.set_exception(err if isinstance(err, Exception)
+                                  else SearchEngineError(str(err)))
+
+        def invoke():
+            try:
+                if has_failure_cb:
+                    fn(*args, on_done=on_done, on_failure=on_failure, **kw)
+                else:
+                    fn(*args, on_done=on_done, **kw)
+            except Exception as e:
+                on_failure(e)
+
+        self.loop.call_soon_threadsafe(invoke)
+        return fut.result(timeout=timeout)
+
+    # -- cluster health -------------------------------------------------------
+    def health(self) -> dict:
+        state = self.node.cluster_state
+        status = "green"
+        unassigned = 0
+        for r in state.routing:
+            started = r.state == "STARTED"
+            if not started:
+                unassigned += 1
+                if r.primary:
+                    status = "red"
+                elif status == "green":
+                    status = "yellow"
+        # an index created but with no routing yet is not green
+        shards_expected = 0
+        for name, meta in state.metadata.items():
+            shards_expected += int(meta["settings"].get("index.number_of_shards", 1))
+        primaries = sum(1 for r in state.routing if r.primary)
+        if primaries < shards_expected:
+            status = "red"
+        if state.master_node_id is None:
+            status = "red"
+        return {
+            "cluster_name": state.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": len(state.nodes),
+            "number_of_data_nodes": len(state.nodes),
+            "active_primary_shards": primaries,
+            "active_shards": sum(1 for r in state.routing if r.state == "STARTED"),
+            "unassigned_shards": unassigned,
+            "master_node": state.master_node_id,
+        }
+
+    def wait_for_health(self, want: str, timeout_s: float) -> Tuple[dict, bool]:
+        rank = {"red": 0, "yellow": 1, "green": 2}
+        deadline = time.monotonic() + timeout_s
+        while True:
+            h = self.health()
+            if rank[h["status"]] >= rank.get(want, 2):
+                return h, False
+            if time.monotonic() >= deadline:
+                return h, True
+            time.sleep(0.1)
+
+
+def _parse_time_s(value) -> float:
+    """ES time units → seconds ("30s", "1m", "500ms", bare number)."""
+    s = str(value or "30s")
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    if s.endswith("m"):
+        return float(s[:-1]) * 60.0
+    return float(s)
+
+
+def _doc_url_params(req: RestRequest) -> Tuple[str, Optional[str]]:
+    return req.params["index"], req.params.get("id")
+
+
+def register_cluster(rc: RestController, adapter: ClusterRestAdapter) -> None:
+    node = adapter.node
+
+    def root(req):
+        return 200, {
+            "name": node.node_id,
+            "cluster_name": node.cluster_state.cluster_name,
+            "version": {"number": __version__, "build_flavor": "tpu",
+                        "distributed": True},
+            "tagline": "You Know, for (TPU) Search",
+        }
+
+    def cluster_health(req):
+        want = req.param("wait_for_status")
+        if want:
+            h, timed_out = adapter.wait_for_health(
+                want, _parse_time_s(req.param("timeout", "30s")))
+            h["timed_out"] = timed_out
+            return 200, h
+        return 200, adapter.health()
+
+    def cluster_state_(req):
+        return 200, node.cluster_state.to_dict()
+
+    def cat_nodes(req):
+        state = node.cluster_state
+        lines = []
+        for n in sorted(state.nodes.values(), key=lambda x: x.node_id):
+            marker = "*" if n.node_id == state.master_node_id else "-"
+            lines.append(f"{n.node_id} {marker} {n.address or '-'}")
+        return 200, "\n".join(lines) + "\n"
+
+    def create_index(req):
+        body = req.json() or {}
+        index = req.params["index"]
+        adapter.call(node.client_create_index, index,
+                     settings=body.get("settings"),
+                     mappings=body.get("mappings"))
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "index": index}
+
+    def delete_index(req):
+        adapter.call(node.client_delete_index, req.params["index"])
+        return 200, {"acknowledged": True}
+
+    def write_doc(req, op_type="index"):
+        index, doc_id = _doc_url_params(req)
+        if doc_id is None:
+            doc_id = uuid.uuid4().hex[:20]
+        op = {"type": "index", "id": doc_id, "source": req.json() or {},
+              "op_type": op_type}
+        routing = req.param("routing")
+        if routing:
+            op["routing"] = routing
+        r = adapter.call(node.client_write, index, op, has_failure_cb=True)
+        if "error" in r:
+            return 400, r
+        status = 201 if r.get("result") == "created" else 200
+        return status, {"_index": index, "_id": doc_id,
+                        "_version": r.get("_version", 1),
+                        "_seq_no": r.get("_seq_no"),
+                        "_primary_term": r.get("_primary_term"),
+                        "result": r.get("result", "created"),
+                        "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def delete_doc(req):
+        index, doc_id = _doc_url_params(req)
+        op = {"type": "delete", "id": doc_id}
+        r = adapter.call(node.client_write, index, op, has_failure_cb=True)
+        return 200, {"_index": index, "_id": doc_id,
+                     "result": r.get("result", "deleted")}
+
+    def get_doc(req):
+        index, doc_id = _doc_url_params(req)
+        r = adapter.call(node.client_get, index, doc_id)
+        status = 200 if r.get("found") else 404
+        return status, {"_index": index, "_id": doc_id, **r}
+
+    def refresh(req):
+        index = req.params.get("index")
+        r = adapter.call(node.client_refresh, index)
+        return 200, r
+
+    def search(req):
+        index = req.params.get("index", "*")
+        body = req.json() or {}
+        if req.param("q"):
+            body.setdefault("query", {"query_string": {"query": req.param("q")}})
+        if req.param("size") is not None:
+            body.setdefault("size", int(req.param("size")))
+        r = adapter.call(node.client_search, index, body)
+        if isinstance(r, dict) and r.get("status") == 404:
+            return 404, r
+        return 200, r
+
+    def bulk(req):
+        """NDJSON _bulk: sequential primary-routed writes."""
+        lines = req.ndjson()
+        items = []
+        errors = False
+        i = 0
+        default_index = req.params.get("index")
+        while i < len(lines):
+            action_line = lines[i]
+            ((action, meta),) = action_line.items()
+            i += 1
+            index = meta.get("_index", default_index)
+            doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
+            if action in ("index", "create"):
+                source = lines[i]
+                i += 1
+                op = {"type": "index", "id": doc_id, "source": source,
+                      "op_type": "create" if action == "create" else "index"}
+            elif action == "delete":
+                op = {"type": "delete", "id": doc_id}
+            else:  # update not supported on the cluster path yet
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": 400,
+                                       "error": {"type": "illegal_argument_exception",
+                                                 "reason": f"unsupported bulk action [{action}]"}}})
+                errors = True
+                continue
+            try:
+                r = adapter.call(node.client_write, index, op,
+                                 has_failure_cb=True)
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "_version": r.get("_version", 1),
+                                       "result": r.get("result"),
+                                       "status": 201 if r.get("result") == "created" else 200}})
+            except Exception as e:
+                errors = True
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": 500,
+                                       "error": {"type": type(e).__name__,
+                                                 "reason": str(e)}}})
+        return 200, {"took": 0, "errors": errors, "items": items}
+
+    rc.register("GET", "/", root)
+    rc.register("GET", "/_cluster/health", cluster_health)
+    rc.register("GET", "/_cluster/state", cluster_state_)
+    rc.register("GET", "/_cat/nodes", cat_nodes)
+    rc.register("PUT", "/{index}", create_index)
+    rc.register("DELETE", "/{index}", delete_index)
+    rc.register("PUT", "/{index}/_doc/{id}", write_doc)
+    rc.register("POST", "/{index}/_doc/{id}", write_doc)
+    rc.register("POST", "/{index}/_doc", write_doc)
+    rc.register("PUT", "/{index}/_create/{id}",
+                lambda req: write_doc(req, op_type="create"))
+    rc.register("POST", "/{index}/_create/{id}",
+                lambda req: write_doc(req, op_type="create"))
+    rc.register("DELETE", "/{index}/_doc/{id}", delete_doc)
+    rc.register("GET", "/{index}/_doc/{id}", get_doc)
+    rc.register("POST", "/{index}/_refresh", refresh)
+    rc.register("GET", "/{index}/_refresh", refresh)
+    rc.register("POST", "/_refresh", refresh)
+    rc.register("GET", "/{index}/_search", search)
+    rc.register("POST", "/{index}/_search", search)
+    rc.register("POST", "/_bulk", bulk)
+    rc.register("POST", "/{index}/_bulk", bulk)

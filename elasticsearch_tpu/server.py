@@ -29,13 +29,12 @@ def main(argv=None) -> int:
         settings[key] = {"true": True, "false": False}.get(value.lower(), value)
 
     from elasticsearch_tpu import bootstrap
-    from elasticsearch_tpu.node import Node
-    from elasticsearch_tpu.rest.actions import register_all
     from elasticsearch_tpu.rest.controller import RestController
     from elasticsearch_tpu.rest.http_server import HttpServer
 
     # bootstrap checks + native hardening BEFORE the node exists
-    # (reference: Bootstrap.init → initializeNatives → BootstrapChecks)
+    # (reference: Bootstrap.init → initializeNatives → BootstrapChecks) —
+    # both the single-node and the clustered deployment path run them
     check_settings = dict(settings)
     check_settings.setdefault("path.data", args.data)
     enforce = args.host not in ("127.0.0.1", "localhost", "::1")
@@ -50,6 +49,24 @@ def main(argv=None) -> int:
     natives = bootstrap.initialize_natives(check_settings)
     for err in natives.errors:
         print(f"warning: {err}", file=sys.stderr)
+
+    def _csv(value):
+        if value is None:
+            return []
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        return [v.strip() for v in str(value).split(",") if v.strip()]
+
+    seed_hosts = _csv(settings.get("discovery.seed_hosts"))
+    initial_masters = _csv(settings.get("cluster.initial_master_nodes"))
+    cluster_mode = bool(seed_hosts or initial_masters)
+
+    if cluster_mode:
+        return _run_clustered(args, settings, seed_hosts, initial_masters,
+                              bootstrap)
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
 
     node = Node(args.data, node_name=args.name, cluster_name=args.cluster_name,
                 settings=settings)
@@ -73,6 +90,87 @@ def main(argv=None) -> int:
         await stop.wait()
         await server.stop()
         node.close()
+
+    asyncio.run(run())
+    return 0
+
+
+def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> int:
+    """Boot a clustered node: transport bind → coordinator initial join →
+    HTTP last (reference start order: `node/Node.java:682`)."""
+    from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+    from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.rest.cluster_actions import (
+        ClusterRestAdapter, register_cluster,
+    )
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.http_server import HttpServer
+    from elasticsearch_tpu.transport.tcp import (
+        AsyncioScheduler, TcpTransportService,
+    )
+
+    node_id = args.name
+    transport_port = int(settings.get("transport.port", 9300))
+    if not initial_masters:
+        print("cluster.initial_master_nodes is required with "
+              "discovery.seed_hosts", file=sys.stderr)
+        return 78
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        scheduler = AsyncioScheduler(loop)
+        transport = TcpTransportService(node_id, host=args.host,
+                                        port=transport_port)
+        host, port = await transport.bind()
+        address = f"{host}:{port}"
+        print(f"[{node_id}] transport bound on {address}", flush=True)
+
+        initial = bootstrap_state(initial_masters,
+                                  cluster_name=args.cluster_name)
+        cluster_node = ClusterNode(
+            node_id, args.data, transport, scheduler,
+            seed_peers=[m for m in initial_masters if m != node_id],
+            initial_state=initial, address=address)
+        cluster_node.start()
+
+        # seed-host discovery loop (PeerFinder analog): keep probing the
+        # configured addresses until every one resolves to a node id, and
+        # keep re-probing slowly afterwards so restarted peers re-resolve
+        async def discover():
+            while True:
+                all_known = True
+                for hp in seed_hosts:
+                    h, _, p = hp.rpartition(":")
+                    if not h or not p.isdigit():
+                        continue
+                    try:
+                        await transport.probe_address(h, int(p))
+                    except Exception:
+                        all_known = False
+                await asyncio.sleep(1.0 if not all_known else 5.0)
+
+        discovery_task = loop.create_task(discover())
+
+        controller = RestController()
+        adapter = ClusterRestAdapter(cluster_node, loop)
+        register_cluster(controller, adapter)
+        server = HttpServer(controller, host=args.host, port=args.port)
+        await server.start()
+        print(f"[{node_id}] listening on http://{args.host}:{server.port} "
+              f"(data: {args.data}, cluster: {args.cluster_name})", flush=True)
+        bootstrap.sd_notify("READY=1")
+
+        stop = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        discovery_task.cancel()
+        await server.stop()
+        cluster_node.stop()
+        await transport.close()
 
     asyncio.run(run())
     return 0

@@ -175,6 +175,44 @@ class TcpTransportService:
         for rid in list(self._pending):
             self._fail_pending(rid, ConnectTransportError("transport closed"))
 
+    async def probe_address(self, host: str, port: int) -> str:
+        """Seed-host discovery (PeerFinder/SeedHostsResolver analog): dial a
+        bare host:port, handshake to learn the peer's node id, record the
+        address mapping, close the probe channel. Returns the node id."""
+        reader, writer = await asyncio.open_connection(host, port)
+        channel = _Channel(reader, writer)
+        pump = self.loop.create_task(self._read_pump(channel))
+        self._pumps.append(pump)
+        # probes are short-lived and periodic: drop the finished pump task
+        # or the forever-running discovery loop grows _pumps without bound
+        pump.add_done_callback(
+            lambda t: self._pumps.remove(t) if t in self._pumps else None)
+        ok = self.loop.create_future()
+        self._request_id += 1
+        rid = self._request_id
+        self._pending[rid] = (
+            lambda resp: ok.set_result(resp) if not ok.done() else None,
+            lambda err: ok.set_exception(err) if not ok.done() else None,
+            self.loop.call_later(10.0, self._on_request_timeout, rid,
+                                 f"{host}:{port}"),
+            HANDSHAKE_ACTION)
+        channel.pending_rids.add(rid)
+        channel.write_frame(encode_frame(
+            rid, STATUS_REQUEST | STATUS_HANDSHAKE, WIRE_VERSION,
+            HANDSHAKE_ACTION,
+            {"sender": self.node_id, "request": {
+                "node_id": self.node_id, "version": WIRE_VERSION}}))
+        try:
+            resp = await ok
+        finally:
+            channel.close()
+        node_id = resp.get("node_id")
+        if not node_id:
+            raise ConnectTransportError(f"no node id from {host}:{port}")
+        if node_id != self.node_id:
+            self.add_peer_address(node_id, host, port)
+        return node_id
+
     def add_peer_address(self, node_id: str, host: str, port: int) -> None:
         self._addresses[node_id] = (host, port)
 
@@ -281,6 +319,9 @@ class TcpTransportService:
                 fut.set_result(channel)
             except Exception as e:
                 fut.set_exception(e)
+                # mark retrieved: with no concurrent waiter the future would
+                # log "exception was never retrieved" at GC
+                fut.exception()
                 raise
             finally:
                 del self._connecting[key]

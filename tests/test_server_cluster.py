@@ -1,0 +1,225 @@
+"""Multi-process cluster boot: three OS processes started from the CLI form
+a cluster, elect a master, replicate an index, and serve _search and
+_cluster/health from any node's HTTP port (reference: `node/Node.java:502,682`
+production wiring of TransportService + Coordinator + REST)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = []
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def cluster_procs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("proc_cluster")
+    http_ports = _free_ports(3)
+    tp_ports = _free_ports(3)
+    seeds = ",".join(f"127.0.0.1:{p}" for p in tp_ports)
+    masters = "n0,n1,n2"
+    procs = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for i in range(3):
+        cmd = [sys.executable, "-m", "elasticsearch_tpu.server",
+               "--port", str(http_ports[i]), "--name", f"n{i}",
+               "--data", str(tmp / f"n{i}"),
+               "-E", f"transport.port={tp_ports[i]}",
+               "-E", f"discovery.seed_hosts={seeds}",
+               "-E", f"cluster.initial_master_nodes={masters}"]
+        procs.append(subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=open(tmp / f"n{i}.log", "w"), stderr=subprocess.STDOUT))
+    yield http_ports, tp_ports, procs, tmp
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _wait_health(port, want="green", deadline_s=90, nodes=None):
+    """Poll across slow interpreter startup (jax import dominates)."""
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            h = _req("GET", f"http://127.0.0.1:{port}/_cluster/health"
+                            f"?wait_for_status={want}&timeout=5s", timeout=15)
+            last = h
+            ok = h["status"] == want or (
+                want == "yellow" and h["status"] == "green")
+            if ok and (nodes is None or h["number_of_nodes"] >= nodes):
+                return h
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(1.0)
+    raise AssertionError(f"cluster never reached {want}: {last}")
+
+
+def test_three_process_cluster_forms_and_replicates(cluster_procs):
+    http_ports, _tp, procs, tmp = cluster_procs
+    h = _wait_health(http_ports[0], "green", nodes=3)
+    assert h["number_of_nodes"] == 3, h
+    assert h["master_node"] in ("n0", "n1", "n2")
+
+    # create a replicated index through node 1
+    r = _req("PUT", f"http://127.0.0.1:{http_ports[1]}/events", {
+        "settings": {"index.number_of_shards": 2,
+                     "index.number_of_replicas": 1},
+        "mappings": {"properties": {"msg": {"type": "text"},
+                                    "n": {"type": "long"}}}})
+    assert r["acknowledged"]
+    deadline = time.monotonic() + 60
+    h = None
+    while time.monotonic() < deadline:
+        h = _req("GET", f"http://127.0.0.1:{http_ports[1]}/_cluster/health")
+        if h["status"] == "green" and h["active_shards"] == 4:
+            break
+        time.sleep(0.5)
+    assert h["active_shards"] == 4, h  # 2 primaries + 2 replicas
+
+    # write through node 2 (reroutes to primaries wherever they live)
+    for i in range(12):
+        r = _req("PUT",
+                 f"http://127.0.0.1:{http_ports[2]}/events/_doc/{i}",
+                 {"msg": f"event number {i}", "n": i})
+        assert r["result"] == "created", r
+
+    _req("POST", f"http://127.0.0.1:{http_ports[0]}/events/_refresh")
+
+    # search via every node: same distributed result
+    for port in http_ports:
+        resp = _req("POST", f"http://127.0.0.1:{port}/events/_search",
+                    {"query": {"match": {"msg": "event"}}, "size": 20,
+                     "sort": [{"n": "asc"}]})
+        assert resp["hits"]["total"]["value"] == 12, (port, resp["hits"])
+        assert [hit["_source"]["n"] for hit in resp["hits"]["hits"]] == list(range(12))
+        assert resp["_shards"]["failed"] == 0
+
+    # distributed aggs over HTTP from a non-master node
+    resp = _req("POST", f"http://127.0.0.1:{http_ports[2]}/events/_search",
+                {"size": 0, "aggs": {"m": {"avg": {"field": "n"}}}})
+    assert abs(resp["aggregations"]["m"]["value"] - 5.5) < 1e-9
+
+    # realtime get via any node
+    got = _req("GET", f"http://127.0.0.1:{http_ports[0]}/events/_doc/7")
+    assert got["found"] and got["_source"]["n"] == 7
+
+    # _cat/nodes shows all three with the master marked
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http_ports[0]}/_cat/nodes",
+        headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        text = resp.read().decode()
+    assert text.count("\n") >= 3 and "*" in text
+
+
+def test_master_failover_across_processes(cluster_procs):
+    http_ports, _tp, procs, tmp = cluster_procs
+    h = _wait_health(http_ports[0], "green")
+    master = h["master_node"]
+    master_idx = int(master[1])
+    # kill the master process outright
+    procs[master_idx].kill()
+    procs[master_idx].wait(timeout=10)
+    survivors = [p for i, p in enumerate(http_ports) if i != master_idx]
+    deadline = time.monotonic() + 60
+    new_master = None
+    while time.monotonic() < deadline:
+        try:
+            h = _req("GET", f"http://127.0.0.1:{survivors[0]}/_cluster/health",
+                     timeout=5)
+            if h["master_node"] and h["master_node"] != master \
+                    and h["number_of_nodes"] == 2:
+                new_master = h["master_node"]
+                break
+        except Exception:
+            pass
+        time.sleep(1.0)
+    assert new_master, "no re-election after master death"
+    # the surviving cluster still serves reads and writes
+    r = _req("PUT", f"http://127.0.0.1:{survivors[1]}/events/_doc/100",
+             {"msg": "after failover", "n": 100})
+    assert r["result"] == "created"
+    _req("POST", f"http://127.0.0.1:{survivors[0]}/events/_refresh")
+    resp = _req("POST", f"http://127.0.0.1:{survivors[0]}/events/_search",
+                {"query": {"term": {"n": 100}}})
+    assert resp["hits"]["total"]["value"] == 1
+
+
+def test_parse_time_units():
+    from elasticsearch_tpu.rest.cluster_actions import _parse_time_s
+    assert _parse_time_s("30s") == 30.0
+    assert _parse_time_s("1m") == 60.0
+    assert _parse_time_s("500ms") == 0.5
+    assert _parse_time_s("2") == 2.0
+
+
+def test_create_semantics_and_refresh_shape(cluster_procs):
+    http_ports, _tp, procs, tmp = cluster_procs
+    # runs after the failover test: one process may be dead — pick a live one
+    port = None
+    for i, p in enumerate(procs):
+        if p.poll() is None:
+            port = http_ports[i]
+            break
+    assert port is not None
+    _wait_health(port, "yellow", nodes=2)
+    try:
+        _req("PUT", f"http://127.0.0.1:{port}/events2",
+             {"settings": {"index.number_of_shards": 1,
+                           "index.number_of_replicas": 0}})
+    except urllib.error.HTTPError:
+        pass
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = _req("GET", f"http://127.0.0.1:{port}/_cluster/health")
+        if h["status"] in ("green", "yellow") and h["active_primary_shards"] >= 1:
+            break
+        time.sleep(0.5)
+    r = _req("PUT", f"http://127.0.0.1:{port}/events2/_create/c1", {"v": 1})
+    assert r["result"] == "created"
+    # second _create of the same id must NOT silently overwrite
+    try:
+        r2 = _req("PUT", f"http://127.0.0.1:{port}/events2/_create/c1", {"v": 2})
+        raise AssertionError(f"_create overwrote existing doc: {r2}")
+    except urllib.error.HTTPError as e:
+        assert e.code in (409, 400, 500), e.code
+    # refresh response reports real per-node counts
+    rr = _req("POST", f"http://127.0.0.1:{port}/events2/_refresh")
+    assert rr["_shards"]["successful"] >= 1
+    assert rr["_shards"]["failed"] == 0
+    got = _req("GET", f"http://127.0.0.1:{port}/events2/_doc/c1")
+    assert got["_source"]["v"] == 1  # first write won
