@@ -41,6 +41,9 @@ _MCL_FUTURE = 2
 _PR_SET_NO_NEW_PRIVS = 38
 _PR_SET_SECCOMP = 22
 _SECCOMP_MODE_FILTER = 2
+_NR_SECCOMP = 317  # x86_64 seccomp(2); filter is arch-gated to x86_64 anyway
+_SECCOMP_SET_MODE_FILTER = 1
+_SECCOMP_FILTER_FLAG_TSYNC = 1
 
 
 def _libc() -> Optional[ctypes.CDLL]:
@@ -91,9 +94,22 @@ class Natives:
                         ("filter", ctypes.c_void_p)]
 
         fprog = SockFprog(len(prog) // 8, ctypes.cast(filt, ctypes.c_void_p))
+        # prefer seccomp(2) with TSYNC so the filter applies to EVERY
+        # thread, not just the caller — prctl(PR_SET_SECCOMP) is
+        # per-thread and leaves already-running threads unfiltered
+        # (reference: SystemCallFilter uses SECCOMP_FILTER_FLAG_TSYNC)
+        if libc.syscall(_NR_SECCOMP, _SECCOMP_SET_MODE_FILTER,
+                        _SECCOMP_FILTER_FLAG_TSYNC, ctypes.byref(fprog)) == 0:
+            self.seccomp_installed = True
+            return
+        # fallback for kernels without seccomp(2): per-thread prctl —
+        # only safe because bootstrap runs before worker threads spawn
         if libc.prctl(_PR_SET_SECCOMP, _SECCOMP_MODE_FILTER,
                       ctypes.byref(fprog), 0, 0) == 0:
             self.seccomp_installed = True
+            self.errors.append(
+                "seccomp installed via prctl (no TSYNC): filter is "
+                "per-thread; install happened before thread spawn")
         else:
             err = ctypes.get_errno()
             self.errors.append(f"seccomp install failed (errno {err})")

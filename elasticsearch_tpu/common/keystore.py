@@ -30,13 +30,18 @@ from typing import Dict, List, Optional
 from elasticsearch_tpu.common.errors import IllegalArgumentError
 
 _MAGIC = b"TPKS"
-_VERSION = 1
+_VERSION = 2  # v2: separate encryption / MAC subkeys (encrypt-then-MAC)
 _ITERATIONS = 200_000
 
 
-def _derive_key(password: str, salt: bytes) -> bytes:
-    return hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"), salt,
-                               _ITERATIONS, dklen=32)
+def _derive_keys(password: str, salt: bytes) -> tuple:
+    """(enc_key, mac_key): one PBKDF2 pass, then domain-separated subkeys —
+    the keystream and the integrity tag must never share a key."""
+    master = hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"), salt,
+                                 _ITERATIONS, dklen=32)
+    enc = hmac.new(master, b"enc", hashlib.sha256).digest()
+    mac = hmac.new(master, b"mac", hashlib.sha256).digest()
+    return enc, mac
 
 
 def _keystream_xor(key: bytes, nonce: bytes, data: bytes) -> bytes:
@@ -74,20 +79,27 @@ class KeyStore:
         if len(blob) < 4 + 1 + 16 + 16 + 32 or blob[:4] != _MAGIC:
             raise IllegalArgumentError(f"[{path}] is not a keystore file")
         version = blob[4]
-        if version != _VERSION:
+        if version not in (1, _VERSION):
             raise IllegalArgumentError(
                 f"unsupported keystore version [{version}]")
         salt = blob[5:21]
         nonce = blob[21:37]
         mac = blob[37:69]
         ciphertext = blob[69:]
-        key = _derive_key(password, salt)
-        expect = hmac.new(key, blob[:37] + ciphertext,
+        if version == 1:
+            # legacy format: one PBKDF2 key for both keystream and MAC;
+            # readable for migration — the next save() rewrites as v2
+            master = hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"),
+                                         salt, _ITERATIONS, dklen=32)
+            enc_key = mac_key = master
+        else:
+            enc_key, mac_key = _derive_keys(password, salt)
+        expect = hmac.new(mac_key, blob[:37] + ciphertext,
                           hashlib.sha256).digest()
         if not hmac.compare_digest(mac, expect):
             raise IllegalArgumentError(
                 "keystore password is incorrect or the file is corrupted")
-        payload = _keystream_xor(key, nonce, ciphertext)
+        payload = _keystream_xor(enc_key, nonce, ciphertext)
         ks._secrets = json.loads(payload.decode("utf-8"))
         return ks
 
@@ -100,11 +112,11 @@ class KeyStore:
     def save(self) -> None:
         salt = secrets.token_bytes(16)
         nonce = secrets.token_bytes(16)
-        key = _derive_key(self._password, salt)
+        enc_key, mac_key = _derive_keys(self._password, salt)
         payload = json.dumps(self._secrets).encode("utf-8")
-        ciphertext = _keystream_xor(key, nonce, payload)
+        ciphertext = _keystream_xor(enc_key, nonce, payload)
         header = _MAGIC + bytes([_VERSION]) + salt + nonce
-        mac = hmac.new(key, header + ciphertext, hashlib.sha256).digest()
+        mac = hmac.new(mac_key, header + ciphertext, hashlib.sha256).digest()
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True)
         tmp = self.path + ".tmp"

@@ -205,12 +205,23 @@ def register_extra(rc: RestController, node: Node) -> None:
         node.snapshots.put_repository(req.params["repo"], req.json() or {})
         return 200, {"acknowledged": True}
 
+    def _redact_repo_settings(settings: dict) -> dict:
+        # credentials never leave via the API (reference: Setting.Property
+        # .Filtered hides secure-setting-adjacent values from GETs)
+        secret_markers = ("access_key", "secret_key", "password", "token",
+                          "credential", "sas_token", "client_secret")
+        return {k: ("<redacted>" if any(m in k.lower() for m in secret_markers)
+                    else v)
+                for k, v in settings.items()}
+
     def get_repo(req):
         name = req.params.get("repo")
         if name:
             repo = node.snapshots.get_repository(name)
-            return 200, {name: {"type": repo.type, "settings": repo.settings}}
-        return 200, {name: {"type": r.type, "settings": r.settings}
+            return 200, {name: {"type": repo.type,
+                                "settings": _redact_repo_settings(repo.settings)}}
+        return 200, {name: {"type": r.type,
+                            "settings": _redact_repo_settings(r.settings)}
                      for name, r in node.snapshots.repositories.items()}
 
     def delete_repo(req):

@@ -222,14 +222,19 @@ class UrlBlobStore(BlobStore):
 
 
 class S3BlobStore(BlobStore):
-    """S3-compatible dialect: path-style object API over HTTP.
+    """S3-compatible dialect: path-style object API over HTTP with AWS
+    Signature Version 4 request signing when credentials are configured
+    (reference: repository-s3 signs via the AWS SDK; MinIO and real S3
+    reject anything but SigV4).
 
-    Works against MinIO-style endpoints and the in-process fixture in
-    tests/s3_fixture.py (the analog of the reference's dockerized
-    s3-fixture)."""
+    Error taxonomy mirrors UrlBlobStore: only HTTP 404 means "missing
+    blob" — connection refusals, DNS failures, and non-404 statuses raise
+    BlobStoreUnavailableError so a transient endpoint outage during
+    restore surfaces as unavailability, never as missing data."""
 
     def __init__(self, endpoint: str, bucket: str, base_path: str = "",
-                 access_key: str = "", secret_key: str = ""):
+                 access_key: str = "", secret_key: str = "",
+                 region: str = "us-east-1"):
         if not endpoint:
             raise IllegalArgumentError(
                 "[endpoint] is required for s3 repositories in this build "
@@ -242,12 +247,9 @@ class S3BlobStore(BlobStore):
         self.endpoint = endpoint.rstrip("/")
         self.bucket = bucket
         self.base_path = base_path.strip("/")
-        self._auth = None
-        if access_key:
-            import base64
-            token = base64.b64encode(
-                f"{access_key}:{secret_key}".encode()).decode()
-            self._auth = f"Basic {token}"
+        self.region = region
+        self.access_key = access_key
+        self.secret_key = secret_key
 
     def _key(self, key: str) -> str:
         return f"{self.base_path}/{key}" if self.base_path else key
@@ -256,39 +258,104 @@ class S3BlobStore(BlobStore):
         return (f"{self.endpoint}/{self.bucket}/"
                 f"{urllib.parse.quote(self._key(key))}")
 
+    # -- SigV4 ----------------------------------------------------------------
+    def _sign(self, req: "urllib.request.Request",
+              payload: Optional[bytes]) -> None:
+        """AWS Signature Version 4 (service "s3", single-chunk payload)."""
+        import datetime
+        import hashlib
+        import hmac as hmac_mod
+
+        parsed = urllib.parse.urlsplit(req.full_url)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        payload_hash = hashlib.sha256(payload or b"").hexdigest()
+        host = parsed.netloc
+
+        canonical_query = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in sorted(urllib.parse.parse_qsl(
+                parsed.query, keep_blank_values=True)))
+        headers = {"host": host, "x-amz-content-sha256": payload_hash,
+                   "x-amz-date": amz_date}
+        signed_headers = ";".join(sorted(headers))
+        canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+        canonical_request = "\n".join([
+            req.get_method(), parsed.path or "/", canonical_query,
+            canonical_headers, signed_headers, payload_hash])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+        def hm(key: bytes, msg: str) -> bytes:
+            return hmac_mod.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k_date = hm(("AWS4" + self.secret_key).encode(), datestamp)
+        k_region = hm(k_date, self.region)
+        k_service = hm(k_region, "s3")
+        k_signing = hm(k_service, "aws4_request")
+        signature = hmac_mod.new(k_signing, string_to_sign.encode(),
+                                 hashlib.sha256).hexdigest()
+        req.add_header("x-amz-date", amz_date)
+        req.add_header("x-amz-content-sha256", payload_hash)
+        req.add_header(
+            "Authorization",
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}")
+
     def _request(self, method: str, url: str, data: Optional[bytes] = None):
         req = urllib.request.Request(url, data=data, method=method)
-        if self._auth:
-            req.add_header("Authorization", self._auth)
+        if self.access_key:
+            self._sign(req, data)
         return urllib.request.urlopen(req, timeout=30)
+
+    @staticmethod
+    def _unavailable(op: str, key: str, e: Exception) -> BlobStoreError:
+        return BlobStoreUnavailableError(
+            f"s3 endpoint unavailable during {op} of [{key}]: {e}")
 
     def write_blob(self, key: str, data: bytes) -> None:
         try:
             with self._request("PUT", self._url(key), data):
                 pass
+        except urllib.error.HTTPError as e:
+            raise self._unavailable("put", key, e) from None
         except urllib.error.URLError as e:
-            raise BlobStoreError(f"s3 put failed for [{key}]: {e}") from None
+            raise self._unavailable("put", key, e) from None
 
     def read_blob(self, key: str) -> bytes:
         try:
             with self._request("GET", self._url(key)) as resp:
                 return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise BlobStoreError(f"missing blob [{key}]") from None
+            raise self._unavailable("get", key, e) from None
         except urllib.error.URLError as e:
-            raise BlobStoreError(f"missing blob [{key}]: {e}") from None
+            raise self._unavailable("get", key, e) from None
 
     def exists(self, key: str) -> bool:
         try:
             with self._request("HEAD", self._url(key)):
                 return True
-        except urllib.error.URLError:
-            return False
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise self._unavailable("head", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("head", key, e) from None
 
     def delete_blob(self, key: str) -> None:
         try:
             with self._request("DELETE", self._url(key)):
                 pass
-        except urllib.error.URLError:
-            pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:  # deleting a missing blob is fine; outages are not
+                raise self._unavailable("delete", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("delete", key, e) from None
 
     def list_blobs(self, prefix: str = "") -> List[str]:
         full_prefix = self._key(prefix)
@@ -319,7 +386,12 @@ class S3BlobStore(BlobStore):
         return sorted(k[strip:] for k in keys)
 
 
-def build_blob_store(rtype: str, settings: dict) -> BlobStore:
+def build_blob_store(rtype: str, settings: dict,
+                     node_settings: Optional[dict] = None) -> BlobStore:
+    """node_settings: the node's merged settings INCLUDING keystore secure
+    settings — S3 credentials resolve from `s3.client.<name>.access_key` /
+    `.secret_key` there when not inlined in the repository settings
+    (reference: S3 creds come from the secure keystore, never the API)."""
     if rtype == "fs":
         location = settings.get("location")
         if not location:
@@ -335,13 +407,26 @@ def build_blob_store(rtype: str, settings: dict) -> BlobStore:
                                        "repositories")
         return UrlBlobStore(url)
     if rtype == "s3":
-        client = settings.get("client", {})
+        client = settings.get("client", "default")
+        client_cfg = client if isinstance(client, dict) else {}
+        client_name = client if isinstance(client, str) else "default"
+        ns = node_settings or {}
+
+        def secure(key_name, inline):
+            return inline or str(
+                ns.get(f"s3.client.{client_name}.{key_name}", ""))
+
         return S3BlobStore(
-            endpoint=settings.get("endpoint", client.get("endpoint", "")),
+            endpoint=secure("endpoint",
+                            settings.get("endpoint",
+                                         client_cfg.get("endpoint", ""))),
             bucket=settings.get("bucket", ""),
             base_path=settings.get("base_path", ""),
-            access_key=settings.get("access_key", ""),
-            secret_key=settings.get("secret_key", ""))
+            access_key=secure("access_key", settings.get("access_key", "")),
+            secret_key=secure("secret_key", settings.get("secret_key", "")),
+            region=str(settings.get(
+                "region", ns.get(f"s3.client.{client_name}.region",
+                                 "us-east-1"))))
     if rtype in ("gcs", "azure", "hdfs"):
         raise IllegalArgumentError(
             f"repository type [{rtype}] requires an external service SDK "

@@ -35,12 +35,14 @@ class Repository:
     (reference: BlobStoreRepository — one implementation, pluggable
     container underneath)."""
 
-    def __init__(self, name: str, rtype: str, settings: dict):
+    def __init__(self, name: str, rtype: str, settings: dict,
+                 node_settings: dict = None):
         from elasticsearch_tpu.snapshots.blobstore import build_blob_store
         self.name = name
         self.type = rtype
         self.settings = settings
-        self.store = build_blob_store(rtype, settings)
+        self.store = build_blob_store(rtype, settings,
+                                      node_settings=node_settings)
 
     # -- content-addressed blobs ---------------------------------------------
     def put_blob(self, path: str) -> str:
@@ -119,7 +121,8 @@ class SnapshotService:
     def put_repository(self, name: str, body: dict,
                        verify: bool = True) -> None:
         rtype = body.get("type")
-        repo = Repository(name, rtype, body.get("settings", {}))
+        repo = Repository(name, rtype, body.get("settings", {}),
+                          node_settings=getattr(self.node, "settings", None))
         if verify:
             repo.verify()
         self.repositories[name] = repo

@@ -199,3 +199,89 @@ def test_verify_repository_rest(tmp_path):
         assert status == 200 and node.node_id in body["nodes"]
     finally:
         node.close()
+
+
+def test_s3_unavailable_is_not_missing():
+    """Connection-level failures must surface as unavailability, never as
+    a missing blob (ADVICE: restore during an outage must not claim data
+    loss)."""
+    from elasticsearch_tpu.snapshots.blobstore import (
+        BlobStoreError, BlobStoreUnavailableError, S3BlobStore,
+    )
+    store = S3BlobStore(endpoint="http://127.0.0.1:1", bucket="b")
+    with pytest.raises(BlobStoreUnavailableError):
+        store.read_blob("any")
+    with pytest.raises(BlobStoreUnavailableError):
+        store.exists("any")
+    with pytest.raises(BlobStoreUnavailableError):
+        store.delete_blob("any")
+
+
+def test_s3_sigv4_headers():
+    """Credentialed requests carry a SigV4 Authorization header."""
+    import http.server
+    import threading
+
+    from elasticsearch_tpu.snapshots.blobstore import S3BlobStore
+
+    captured = {}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_PUT(self):
+            captured.update(self.headers)
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        store = S3BlobStore(endpoint=f"http://127.0.0.1:{srv.server_port}",
+                            bucket="b", access_key="AKIDEXAMPLE",
+                            secret_key="secret", region="eu-west-1")
+        store.write_blob("k/x", b"data")
+    finally:
+        srv.shutdown()
+    auth = captured.get("Authorization", "")
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/")
+    assert "/eu-west-1/s3/aws4_request" in auth
+    assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in auth
+    lower = {k.lower() for k in captured}
+    assert "x-amz-date" in lower and "x-amz-content-sha256" in lower
+
+
+def test_repo_get_redacts_credentials(tmp_path):
+    node = Node(str(tmp_path / "redact_node"))
+    try:
+        node.snapshots.put_repository(
+            "sec", {"type": "memory",
+                    "settings": {"location": "redact-me",
+                                 "access_key": "AKID", "secret_key": "sss"}},
+            verify=False)
+        from elasticsearch_tpu.rest.actions import register_all
+        from elasticsearch_tpu.rest.controller import RestController
+        rc = RestController()
+        register_all(rc, node)
+        status, body = rc.dispatch("GET", "/_snapshot/sec", {}, b"")
+        assert status == 200
+        s = body["sec"]["settings"]
+        assert s["access_key"] == "<redacted>"
+        assert s["secret_key"] == "<redacted>"
+        assert s["location"] == "redact-me"
+    finally:
+        node.close()
+
+
+def test_s3_creds_resolve_from_node_keystore_settings():
+    from elasticsearch_tpu.snapshots.blobstore import build_blob_store
+    store = build_blob_store(
+        "s3", {"endpoint": "http://127.0.0.1:1", "bucket": "b",
+               "client": "prod"},
+        node_settings={"s3.client.prod.access_key": "FROMKS",
+                       "s3.client.prod.secret_key": "KSSECRET"})
+    assert store.access_key == "FROMKS" and store.secret_key == "KSSECRET"

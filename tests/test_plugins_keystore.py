@@ -320,3 +320,35 @@ def test_invalid_setting_name():
     ks = KeyStore("unused")
     with pytest.raises(IllegalArgumentError):
         ks.set("spaces not allowed", "v")
+
+
+def test_keystore_v1_migration(tmp_path):
+    """v1 files (single shared key) stay readable; saving rewrites as v2
+    with separated enc/mac subkeys."""
+    import hashlib
+    import hmac as hmac_mod
+    import json as json_mod
+    import secrets as secrets_mod
+
+    from elasticsearch_tpu.common import keystore as ks_mod
+
+    path = str(tmp_path / "old.keystore")
+    # hand-craft a v1 file with the legacy single-key scheme
+    salt = secrets_mod.token_bytes(16)
+    nonce = secrets_mod.token_bytes(16)
+    key = hashlib.pbkdf2_hmac("sha256", b"pw", salt, ks_mod._ITERATIONS,
+                              dklen=32)
+    payload = json_mod.dumps({"s3.client.default.secret_key": "old"}).encode()
+    ciphertext = ks_mod._keystream_xor(key, nonce, payload)
+    header = ks_mod._MAGIC + bytes([1]) + salt + nonce
+    mac = hmac_mod.new(key, header + ciphertext, hashlib.sha256).digest()
+    with open(path, "wb") as f:
+        f.write(header + mac + ciphertext)
+
+    ks = ks_mod.KeyStore.load(path, "pw")
+    assert ks.get("s3.client.default.secret_key") == "old"
+    ks.save()
+    with open(path, "rb") as f:
+        assert f.read()[4] == ks_mod._VERSION  # upgraded on save
+    ks2 = ks_mod.KeyStore.load(path, "pw")
+    assert ks2.get("s3.client.default.secret_key") == "old"
