@@ -39,7 +39,7 @@ from elasticsearch_tpu.cluster.state import (
     ClusterState, DiscoveryNode, ShardRoutingEntry,
 )
 from elasticsearch_tpu.common.errors import (
-    IndexNotFoundError, SearchEngineError,
+    IllegalArgumentError, IndexNotFoundError, SearchEngineError,
 )
 from elasticsearch_tpu.index.engine import Engine
 from elasticsearch_tpu.index.mapping import MapperService
@@ -54,6 +54,7 @@ WRITE_PRIMARY = "indices:data/write/primary"
 WRITE_REPLICA = "indices:data/write/replica"
 QUERY_SHARD = "indices:data/read/query"
 RECOVERY_START = "internal:index/shard/recovery/start_recovery"
+RECOVERY_FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
 MASTER_CREATE_INDEX = "cluster:admin/indices/create"
 MASTER_DELETE_INDEX = "cluster:admin/indices/delete"
 MASTER_SHARD_STARTED = "internal:cluster/shard/started"
@@ -64,12 +65,26 @@ class LocalShard:
     def __init__(self, routing: ShardRoutingEntry, engine: Engine,
                  mapper_service: MapperService):
         self.routing = routing
-        self.engine = engine
         self.mapper_service = mapper_service
         self.tracker = ReplicationTracker(routing.allocation_id)
         self.vector_store = VectorStoreShard()
+        self._attach_engine(engine)
+
+    def _attach_engine(self, engine: Engine) -> None:
+        self.engine = engine
+        engine.retained_seq_no_provider = self._min_retained_seq_no
         engine.add_refresh_listener(self._sync_vectors)
         self._sync_vectors(engine.acquire_searcher())
+
+    def _min_retained_seq_no(self) -> int:
+        try:
+            return self.tracker.min_retained_seq_no()
+        except Exception:
+            return self.engine.local_checkpoint + 1
+
+    def replace_engine(self, engine: Engine) -> None:
+        """Swap in a recovered engine (post phase-1 file copy)."""
+        self._attach_engine(engine)
 
     def _sync_vectors(self, reader):
         vf = self.mapper_service.vector_fields()
@@ -118,7 +133,7 @@ class ClusterNode:
     # ------------------------------------------------- master-side state tasks
     def _on_membership_change(self, state: ClusterState, added: Set[str],
                               removed: Set[str]) -> ClusterState:
-        for nid in removed:
+        for nid in sorted(removed):  # deterministic under any hash seed
             state = allocation.node_left(state, nid)
         if added:
             state = allocation.reroute(state)
@@ -148,30 +163,38 @@ class ClusterNode:
                 state, name, int(settings["index.number_of_shards"]),
                 int(settings["index.number_of_replicas"]))
 
-        ok = self.coordinator.publish_state_update(update)
-        respond({"acknowledged": ok})
+        self._publish_then_respond(update, respond, {"acknowledged": True})
+
+    def _publish_then_respond(self, update, respond, result: dict) -> None:
+        """Ack only after COMMIT (MasterService publish listener): a stale
+        leader's rejected publish must surface as a retryable non-ack, not
+        a false acknowledged=true."""
+        def on_committed(ok: bool):
+            respond(result if ok else {"__not_committed__": True})
+
+        self.coordinator.publish_state_update(update, on_committed)
 
     def _master_delete_index(self, sender, request, respond):
         self._require_master()
         name = request["index"]
-        ok = self.coordinator.publish_state_update(
+        self._publish_then_respond(
             lambda base: allocation.remove_index(base, name)
-            if name in base.metadata else base)
-        respond({"acknowledged": ok})
+            if name in base.metadata else base,
+            respond, {"acknowledged": True})
 
     def _master_shard_started(self, sender, request, respond):
         self._require_master()
         aid = request["allocation_id"]
-        self.coordinator.publish_state_update(
-            lambda base: allocation.shard_started(base, aid))
-        respond({"ack": True})
+        self._publish_then_respond(
+            lambda base: allocation.shard_started(base, aid),
+            respond, {"ack": True})
 
     def _master_shard_failed(self, sender, request, respond):
         self._require_master()
         aid = request["allocation_id"]
-        self.coordinator.publish_state_update(
-            lambda base: allocation.shard_failed(base, aid))
-        respond({"ack": True})
+        self._publish_then_respond(
+            lambda base: allocation.shard_failed(base, aid),
+            respond, {"ack": True})
 
     def _send_to_master(self, action: str, request: dict,
                         on_response=None, on_failure=None, retries: int = 60):
@@ -195,8 +218,17 @@ class ClusterNode:
         if master is None:
             retry()
             return
+
+        def on_resp(resp):
+            # the master acked receipt but its publication failed to commit
+            # (stepped down mid-publish): retry against the next master
+            if isinstance(resp, dict) and resp.get("__not_committed__"):
+                retry()
+            elif on_response is not None:
+                on_response(resp)
+
         self.transport.send(self.node_id, master, action, request,
-                            on_response=on_response, on_failure=retry)
+                            on_response=on_resp, on_failure=retry)
 
     # --------------------------------------------------- cluster state applier
     def apply_cluster_state(self, state: ClusterState) -> None:
@@ -269,6 +301,12 @@ class ClusterNode:
             return
 
         def on_ops(response):
+            if "phase1" in response:
+                # translog can't cover the gap: copy the primary's commit
+                # files first (RecoverySourceHandler.java:262), then re-enter
+                # ops recovery from the snapshot's checkpoint
+                self._run_phase1(local, primary.node_id, response["phase1"])
+                return
             for op in response["ops"]:
                 self._apply_replica_op(local, op)
             self._send_to_master(MASTER_SHARD_STARTED,
@@ -291,6 +329,90 @@ class ClusterNode:
         self.scheduler.schedule_in(5000, lambda: self._retry_recovery(entry),
                                    f"recovery_timeout:{entry.allocation_id}")
 
+    def _run_phase1(self, local: LocalShard, primary_node: str,
+                    phase1: dict) -> None:
+        """Target side of the segment-file copy: pull every manifest file in
+        CRC-checked chunks into a temp dir, atomically swap the local engine
+        to the copied commit, then resume ops recovery (phase 2) from the
+        snapshot's checkpoint (PeerRecoveryTargetService analog)."""
+        import base64
+        import shutil
+        import zlib as _zlib
+
+        entry = local.routing
+        files = list(phase1.get("files", []))
+        tmp_dir = local.engine.path + ".phase1_tmp"
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        os.makedirs(tmp_dir, exist_ok=True)
+        state = {"file_idx": 0, "offset": 0,
+                 "handle": None, "crc": 0}
+
+        def fail(reason):
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            self.scheduler.schedule_in(
+                1000, lambda: self._retry_recovery(entry),
+                f"recovery_retry:{entry.allocation_id}")
+
+        def next_chunk():
+            if local.routing.allocation_id != entry.allocation_id:
+                return fail("reassigned")
+            spec = files[state["file_idx"]]
+            if state["handle"] is None:
+                state["handle"] = open(
+                    os.path.join(tmp_dir, os.path.basename(spec["name"])),
+                    "wb")
+                state["crc"] = 0
+            self.transport.send(
+                self.node_id, primary_node, RECOVERY_FILE_CHUNK,
+                {"index": entry.index, "shard": entry.shard,
+                 "allocation_id": entry.allocation_id,
+                 "name": spec["name"], "offset": state["offset"]},
+                on_response=on_chunk, on_failure=lambda e: fail(str(e)))
+
+        def on_chunk(resp):
+            spec = files[state["file_idx"]]
+            data = base64.b64decode(resp["data"])
+            if (_zlib.crc32(data) & 0xFFFFFFFF) != resp["crc32"]:
+                return fail("chunk crc mismatch")
+            state["handle"].write(data)
+            state["crc"] = _zlib.crc32(data, state["crc"]) & 0xFFFFFFFF
+            state["offset"] += len(data)
+            if resp.get("last") or state["offset"] >= spec["size"]:
+                state["handle"].close()
+                state["handle"] = None
+                if state["offset"] != spec["size"] or \
+                        state["crc"] != spec["crc32"]:
+                    return fail(f"file {spec['name']} failed verification")
+                state["file_idx"] += 1
+                state["offset"] = 0
+                if state["file_idx"] >= len(files):
+                    return finish()
+            next_chunk()
+
+        def finish():
+            # swap: close the stale engine, replace the shard dir contents
+            # with the verified commit files, reopen, resume with phase 2
+            path = local.engine.path
+            local.engine.close()
+            for name in os.listdir(path):
+                full = os.path.join(path, name)
+                if os.path.isdir(full):
+                    shutil.rmtree(full, ignore_errors=True)
+                else:
+                    os.unlink(full)
+            for name in os.listdir(tmp_dir):
+                os.replace(os.path.join(tmp_dir, name),
+                           os.path.join(path, name))
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            engine = Engine(path, local.mapper_service,
+                            translog_sync="async")
+            local.replace_engine(engine)
+            self._start_replica_recovery(local, self.cluster_state)
+
+        if not files:
+            return fail("empty phase1 manifest")
+        next_chunk()
+
     def _retry_recovery(self, entry: ShardRoutingEntry) -> None:
         local = self.local_shards.get((entry.index, entry.shard))
         if local is not None and local.routing.allocation_id == entry.allocation_id \
@@ -298,16 +420,95 @@ class ClusterNode:
             self._start_replica_recovery(local, self.cluster_state)
 
     def _on_recovery_start(self, sender, request, respond):
-        """Primary side: hand over history + mark the copy in-sync."""
+        """Primary side (RecoverySourceHandler.recoverToTarget analog):
+        ops-only replay when the translog still covers the gap, else a
+        phase-1 manifest — commit files snapshotted under a per-recovery
+        dir so concurrent flushes can't mutate what the target is copying,
+        with a retention lease pinning post-commit history until phase 2."""
         key = (request["index"], request["shard"])
         local = self.local_shards.get(key)
         if local is None or not local.routing.primary:
             raise SearchEngineError(f"not primary for {key}")
-        ops = local.engine.translog.read_ops(int(request.get("from_seq_no", 0)))
-        local.tracker.init_tracking(request["allocation_id"])
-        local.tracker.mark_in_sync(request["allocation_id"],
-                                   local.engine.local_checkpoint)
+        alloc = request["allocation_id"]
+        from_seq = int(request.get("from_seq_no", 0))
+
+        if not local.engine.can_replay_from(from_seq):
+            respond({"phase1": self._prepare_phase1(local, alloc)})
+            return
+
+        ops = local.engine.translog.read_ops(from_seq)
+        local.tracker.init_tracking(alloc)
+        local.tracker.mark_in_sync(alloc, local.engine.local_checkpoint)
+        self._cleanup_phase1(local, alloc)
         respond({"ops": ops, "global_checkpoint": local.tracker.global_checkpoint})
+
+    _RECOVERY_CHUNK = 1 << 20
+
+    def _phase1_dir(self, local: LocalShard, alloc: str) -> str:
+        safe = alloc.replace("/", "_").replace("#", "_")
+        return os.path.join(local.engine.path, f"_recovery_{safe}")
+
+    def _prepare_phase1(self, local: LocalShard, alloc: str) -> dict:
+        """Flush, snapshot the commit files, lease the history above the
+        commit (RecoverySourceHandler.java:262 phase1 + CcrRetentionLeases
+        -style lease so a concurrent flush cannot trim phase-2 ops)."""
+        import shutil
+        import zlib as _zlib
+
+        engine = local.engine
+        engine.flush()
+        lease_id = f"peer_recovery/{alloc}"
+        retaining = (engine.last_commit_checkpoint or -1) + 1
+        try:
+            local.tracker.add_retention_lease(lease_id, retaining,
+                                              "peer_recovery")
+        except IllegalArgumentError:
+            local.tracker.renew_retention_lease(lease_id, retaining)
+        snap_dir = self._phase1_dir(local, alloc)
+        shutil.rmtree(snap_dir, ignore_errors=True)
+        os.makedirs(snap_dir, exist_ok=True)
+        files = []
+        for name in ("commit.bin", "commit.json"):
+            src = os.path.join(engine.path, name)
+            if not os.path.exists(src):
+                continue
+            dst = os.path.join(snap_dir, name)
+            shutil.copyfile(src, dst)
+            with open(dst, "rb") as f:
+                data = f.read()
+            files.append({"name": name, "size": len(data),
+                          "crc32": _zlib.crc32(data) & 0xFFFFFFFF})
+        return {"files": files,
+                "from_seq_no": (engine.last_commit_checkpoint or -1) + 1}
+
+    def _cleanup_phase1(self, local: LocalShard, alloc: str) -> None:
+        import shutil
+        shutil.rmtree(self._phase1_dir(local, alloc), ignore_errors=True)
+        try:
+            local.tracker.remove_retention_lease(f"peer_recovery/{alloc}")
+        except Exception:
+            pass
+
+    def _on_recovery_file_chunk(self, sender, request, respond):
+        """Primary side: serve one CRC-framed chunk of a snapshotted file
+        (MultiFileTransfer / RecoverySourceHandler.sendFiles analog)."""
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None or not local.routing.primary:
+            raise SearchEngineError(f"not primary for {key}")
+        name = os.path.basename(request["name"])  # no traversal
+        path = os.path.join(self._phase1_dir(local, request["allocation_id"]),
+                            name)
+        offset = int(request["offset"])
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(self._RECOVERY_CHUNK)
+        import base64
+        import zlib as _zlib
+        respond({"name": name, "offset": offset,
+                 "data": base64.b64encode(data).decode("ascii"),
+                 "crc32": _zlib.crc32(data) & 0xFFFFFFFF,
+                 "last": offset + len(data) >= os.path.getsize(path)})
 
     # ------------------------------------------------------------- write path
     def client_write(self, index: str, op: dict,
@@ -367,7 +568,18 @@ class ClusterNode:
 
         pending = {"count": len(replicas)}
 
-        def one_ack(_resp, rep=None):
+        def one_ack(resp, rep=None):
+            # replica acks carry their local checkpoint: feed the primary's
+            # tracker so the global checkpoint advances (ReplicationTracker
+            # .java:996 updateLocalCheckpoint) — flush-time translog trimming
+            # keys off it via min_retained_seq_no
+            if rep is not None and isinstance(resp, dict) \
+                    and "local_checkpoint" in resp:
+                try:
+                    local.tracker.update_local_checkpoint(
+                        rep.allocation_id, int(resp["local_checkpoint"]))
+                except Exception:
+                    pass
             pending["count"] -= 1
             if pending["count"] == 0:
                 respond(response)
@@ -387,7 +599,7 @@ class ClusterNode:
         for rep in replicas:
             self.transport.send(self.node_id, rep.node_id, WRITE_REPLICA,
                                 replica_req,
-                                on_response=one_ack,
+                                on_response=lambda r, rep=rep: one_ack(r, rep),
                                 on_failure=lambda e, rep=rep: one_fail(e, rep))
 
     def _on_write_replica(self, sender, request, respond):
@@ -605,6 +817,7 @@ class ClusterNode:
         t.register(me, "indices:data/read/get", self._on_get)
         t.register(me, "indices:admin/refresh", self._on_refresh)
         t.register(me, RECOVERY_START, self._on_recovery_start)
+        t.register(me, RECOVERY_FILE_CHUNK, self._on_recovery_file_chunk)
         t.register(me, MASTER_CREATE_INDEX, self._master_create_index)
         t.register(me, MASTER_DELETE_INDEX, self._master_delete_index)
         t.register(me, MASTER_SHARD_STARTED, self._master_shard_started)

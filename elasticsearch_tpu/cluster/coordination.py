@@ -253,6 +253,10 @@ class Coordinator:
         # in DiscoveryNode.address so every node can dial every other
         # (reference: JoinRequest carries the joining DiscoveryNode)
         self._join_addresses: Dict[str, str] = {}
+        # client acks gated on COMMIT, not publish-start: (term, version,
+        # callback(bool)) fired from _apply_committed, failed on demotion
+        # (reference: MasterService ack listeners / publish listener)
+        self._commit_waiters: List[Tuple[int, int, Callable[[bool], None]]] = []
         # optional hook: (state, added_ids, removed_ids) -> state, applied by
         # the leader after membership changes so shard allocation follows
         # node join/leave (reference: AllocationService wired into
@@ -317,7 +321,7 @@ class Coordinator:
 
     def _start_election(self) -> None:
         term = self.state.current_term + 1
-        for target in self._broadcast_targets():
+        for target in sorted(self._broadcast_targets()):
             self.transport.send(self.node.node_id, target, START_JOIN_ACTION,
                                 {"source": self.node.node_id, "term": term})
 
@@ -359,12 +363,26 @@ class Coordinator:
         self.mode = CANDIDATE
         self.known_leader = None
         self._election_round = 0
+        self._fail_commit_waiters()
         self._schedule_election()
 
     def _become_follower(self, leader_id: str) -> None:
+        was_leader = self.mode == LEADER
         self.mode = FOLLOWER
         self.known_leader = leader_id
         self.last_leader_ping_ms = self.scheduler.now_ms
+        if was_leader:
+            self._fail_commit_waiters()
+
+    def _fail_commit_waiters(self) -> None:
+        """Uncommitted client updates die with the leadership: fail their
+        waiters so callers retry against the next master."""
+        waiters, self._commit_waiters = self._commit_waiters, []
+        for _, _, cb in waiters:
+            try:
+                cb(False)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ publication
     def _next_state_base(self) -> ClusterState:
@@ -374,7 +392,7 @@ class Coordinator:
         base = self._next_state_base()
         nodes = dict(base.nodes)
         nodes[self.node.node_id] = self.node
-        for voter in self.state.join_votes:
+        for voter in sorted(self.state.join_votes):
             nodes.setdefault(voter, DiscoveryNode(
                 voter, address=self._join_addresses.get(voter, "")))
         config = self._choose_voting_config(nodes)
@@ -392,18 +410,32 @@ class Coordinator:
             state = self.membership_listener(state, added, removed)
         self._publish(state)
 
-    def publish_state_update(self, updater: Callable[[ClusterState], ClusterState]) -> bool:
-        """MasterService entry: compute and publish the next state."""
+    def publish_state_update(self, updater: Callable[[ClusterState], ClusterState],
+                             on_committed_result: Optional[Callable[[bool], None]] = None) -> bool:
+        """MasterService entry: compute and publish the next state.
+
+        on_committed_result(ok): fired True once the state COMMITS (never on
+        mere publish-start — a stale leader's publish can be rejected by a
+        newer term, and acking early loses the change silently), False if
+        this leader steps down before commit. A no-op update fires True
+        immediately."""
         if self.mode != LEADER:
+            if on_committed_result:
+                on_committed_result(False)
             return False
         base = self._next_state_base()
         new_state = updater(base)
         if new_state is base:
+            if on_committed_result:
+                on_committed_result(True)
             return False
         new_state = new_state.with_(
             term=self.state.current_term,
             version=max(base.version, self.state.last_published_version) + 1,
             master_node_id=self.node.node_id)
+        if on_committed_result:
+            self._commit_waiters.append(
+                (new_state.term, new_state.version, on_committed_result))
         self._publish(new_state)
         return True
 
@@ -443,7 +475,7 @@ class Coordinator:
             self._count_publish_response(response, state)
         except CoordinationError:
             pass
-        for target in set(state.nodes) - {self.node.node_id}:
+        for target in sorted(set(state.nodes) - {self.node.node_id}):
             self.transport.send(
                 self.node.node_id, target, PUBLISH_ACTION, request,
                 on_response=lambda resp, s=state: self._count_publish_response(resp, s))
@@ -460,7 +492,7 @@ class Coordinator:
                 self._apply_committed(committed)
             except CoordinationError:
                 pass
-            for target in set(state.nodes) - {self.node.node_id}:
+            for target in sorted(set(state.nodes) - {self.node.node_id}):
                 self.transport.send(self.node.node_id, target, COMMIT_ACTION, commit)
 
     def _on_publish(self, sender: str, request: dict, respond) -> None:
@@ -496,6 +528,30 @@ class Coordinator:
                 return
         self.committed_state = state
         self.last_leader_ping_ms = self.scheduler.now_ms
+        if self._commit_waiters:
+            # success only for SAME-term publications at or below the
+            # committed version; a commit from a NEWER term supersedes this
+            # leader's uncommitted updates — those must fail (retry), never
+            # false-ack on another leader's unrelated commit
+            done, failed, keep = [], [], []
+            for t, v, cb in self._commit_waiters:
+                if t == state.term and v <= state.version:
+                    done.append(cb)
+                elif t < state.term:
+                    failed.append(cb)
+                else:
+                    keep.append((t, v, cb))
+            self._commit_waiters = keep
+            for cb in done:
+                try:
+                    cb(True)
+                except Exception:
+                    pass
+            for cb in failed:
+                try:
+                    cb(False)
+                except Exception:
+                    pass
         self.on_committed(state)
 
     # ---------------------------------------------------------- reconfiguration
@@ -538,7 +594,7 @@ class Coordinator:
         def beat():
             if self.stopped or self.mode != LEADER:
                 return
-            for target in set(self.committed_state.nodes) - {self.node.node_id}:
+            for target in sorted(set(self.committed_state.nodes) - {self.node.node_id}):
                 self.transport.send(
                     self.node.node_id, target, FOLLOWER_CHECK_ACTION,
                     {"term": self.state.current_term, "leader": self.node.node_id},
@@ -558,7 +614,7 @@ class Coordinator:
         (`FollowersChecker` removal)."""
         last_ok = getattr(self, "_follower_last_ok", {})
         now = self.scheduler.now_ms
-        for target in set(self.committed_state.nodes) - {self.node.node_id}:
+        for target in sorted(set(self.committed_state.nodes) - {self.node.node_id}):
             seen = last_ok.get(target)
             if seen is None:
                 last_ok[target] = now  # grace period starts now

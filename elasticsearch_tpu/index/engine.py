@@ -74,6 +74,14 @@ class Engine:
         self._builder: Optional[SegmentBuilder] = None
         self._refresh_listeners: List[Callable[[ShardReader], None]] = []
         self._reader: Optional[ShardReader] = None
+        # checkpoint of the newest durable commit: ops at or below this are
+        # only guaranteed in the commit, not the translog (flush trims)
+        self.last_commit_checkpoint: Optional[int] = None
+        # shard layer installs a provider returning the minimum seq_no that
+        # retention leases require kept (ReplicationTracker
+        # .min_retained_seq_no); flush skips translog trimming while any
+        # lease still needs history the commit would discard
+        self.retained_seq_no_provider: Optional[Callable[[], int]] = None
 
         self._load_commit()
         self.translog = Translog(os.path.join(path, "translog"), sync_policy=translog_sync)
@@ -303,8 +311,17 @@ class Engine:
             os.replace(tmp, os.path.join(self.path, "commit.bin"))
             with open(os.path.join(self.path, "commit.json"), "w") as f:
                 json.dump(commit, f)
+            self.last_commit_checkpoint = commit["local_checkpoint"]
             self.translog.roll_generation()
-            self.translog.trim_below(self.translog.generation)
+            # retention-lease-aware trimming (ReplicationTracker.java:308):
+            # a recovering copy's lease pins history the commit would drop
+            retained = (self.retained_seq_no_provider()
+                        if self.retained_seq_no_provider else
+                        commit["local_checkpoint"] + 1)
+            if retained > commit["local_checkpoint"]:
+                self.translog.trim_below(
+                    self.translog.generation,
+                    min_retained_seq_no=commit["local_checkpoint"] + 1)
 
     def _load_commit(self) -> None:
         path = os.path.join(self.path, "commit.bin")
@@ -319,6 +336,7 @@ class Engine:
         self._next_row = meta["next_row"]
         self._next_seg_id = meta["next_seg_id"]
         self.tracker = LocalCheckpointTracker(meta["max_seq_no"], meta["local_checkpoint"])
+        self.last_commit_checkpoint = meta["local_checkpoint"]
 
     def _recover_from_translog(self) -> None:
         """Replay translog ops above the last commit's checkpoint."""
@@ -340,6 +358,13 @@ class Engine:
                 self.tracker.mark_processed(op["seq_no"])
 
     # ---------------------------------------------------------------- merging
+    def can_replay_from(self, from_seq_no: int) -> bool:
+        """True when the translog still holds every op >= from_seq_no, so an
+        ops-only peer recovery is safe. Once a flush has trimmed history,
+        ops below the trim point live only in the commit files and the
+        recovering copy needs phase 1 (file copy) first."""
+        return from_seq_no >= self.translog.min_retained_seq_no
+
     def merge(self) -> None:
         """Compact all sealed segments into one, dropping tombstoned docs.
 

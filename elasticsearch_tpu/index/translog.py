@@ -46,6 +46,9 @@ class Translog:
         self.min_generation = ckp.get("min_translog_generation", self.generation)
         self.global_checkpoint = ckp.get("global_checkpoint", -1)
         self.max_seq_no = ckp.get("max_seq_no", -1)
+        # lowest seq_no this translog still guarantees to hold; raised (and
+        # persisted) only when a trim actually discards history
+        self.min_retained_seq_no = ckp.get("min_retained_seq_no", 0)
         self._file = open(self._gen_path(self.generation), "ab")
 
     # -- paths / checkpoint ---------------------------------------------------
@@ -68,6 +71,7 @@ class Translog:
                 "min_translog_generation": self.min_generation,
                 "global_checkpoint": self.global_checkpoint,
                 "max_seq_no": self.max_seq_no,
+                "min_retained_seq_no": self.min_retained_seq_no,
             }, f)
             f.flush()
             os.fsync(f.fileno())
@@ -105,13 +109,20 @@ class Translog:
         self._file = open(self._gen_path(self.generation), "ab")
         self._write_checkpoint()
 
-    def trim_below(self, generation: int) -> None:
-        """Delete generations below `generation` (after a commit persists them)."""
+    def trim_below(self, generation: int,
+                   min_retained_seq_no: Optional[int] = None) -> None:
+        """Delete generations below `generation` (after a commit persists them).
+
+        min_retained_seq_no: the lowest seq_no still guaranteed retained
+        after the trim (the committing caller's checkpoint + 1)."""
         for gen in range(self.min_generation, generation):
             path = self._gen_path(gen)
             if os.path.exists(path):
                 os.remove(path)
         self.min_generation = max(self.min_generation, generation)
+        if min_retained_seq_no is not None:
+            self.min_retained_seq_no = max(self.min_retained_seq_no,
+                                           min_retained_seq_no)
         self._write_checkpoint()
 
     # -- read path ------------------------------------------------------------

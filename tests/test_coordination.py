@@ -201,3 +201,45 @@ def test_random_disruption_storm_safety(seed):
     sim.run(120_000)
     assert sim.leader() is not None
     assert sim.converged()
+
+
+def test_stale_leader_never_false_acks(make_cluster=None):
+    """A deposed leader's uncommitted update must fail its waiter — never
+    ack on a NEWER term's unrelated commit (commit-gated acks)."""
+    from elasticsearch_tpu.cluster.coordination import (
+        CoordinationState, PersistedState, bootstrap_state,
+    )
+    from elasticsearch_tpu.cluster.state import ClusterState
+
+    initial = bootstrap_state(["a", "b", "c"])
+    st = CoordinationState("a", PersistedState(0, initial))
+    # win term 1
+    st.handle_start_join("a", 1)
+    for voter in ("a", "b"):
+        st.handle_join({"source": voter, "target": "a", "term": 1,
+                        "last_accepted_term": 0, "last_accepted_version": 0})
+    assert st.election_won
+    # a Coordinator-level check: waiters keyed (term=1, v) must not match
+    # a commit at term 2 under the exact-term rule
+    from elasticsearch_tpu.cluster import coordination as coord
+    fired = []
+    class FakeSched:
+        now_ms = 0
+        def schedule_in(self, *a, **k):
+            pass
+    class FakeTransport:
+        def register(self, *a):
+            pass
+        def send(self, *a, **k):
+            pass
+    c = coord.Coordinator(
+        coord.DiscoveryNode("a"), PersistedState(0, initial),
+        FakeTransport(), FakeSched(), seed_peers=["b", "c"])
+    c._commit_waiters.append((1, 5, lambda ok: fired.append(("old", ok))))
+    c._commit_waiters.append((2, 3, lambda ok: fired.append(("new", ok))))
+    committed = ClusterState(term=2, version=3, master_node_id="b",
+                             last_committed_config=initial.last_committed_config,
+                             last_accepted_config=initial.last_accepted_config)
+    c._apply_committed(committed)
+    assert ("old", False) in fired, f"stale-term waiter not failed: {fired}"
+    assert ("new", True) in fired, f"same-term waiter not acked: {fired}"

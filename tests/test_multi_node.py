@@ -65,7 +65,12 @@ class TestCluster:
 @pytest.fixture
 def cluster(tmp_path):
     c = TestCluster(tmp_path, n_nodes=3, seed=17)
-    assert c.run_until(lambda: c.master() is not None), "no master elected"
+    # ensureStableCluster analog: master elected AND every node joined —
+    # otherwise index creation races the joins and allocation is lopsided
+    def stable():
+        m = c.master()
+        return m is not None and len(m.cluster_state.nodes) == 3
+    assert c.run_until(stable), "cluster did not stabilize"
     yield c
     for n in c.nodes.values():
         if not n.coordinator.stopped:
@@ -291,3 +296,88 @@ def test_cross_shard_metric_aggs_correct(cluster):
     assert buckets["a"]["doc_count"] == 30
     assert abs(buckets["a"]["m"]["value"] - sum(evens) / 30) < 1e-9
     assert abs(buckets["b"]["m"]["value"] - sum(odds) / 30) < 1e-9
+
+
+def test_peer_recovery_phase1_after_translog_trim(tmp_path):
+    """A new replica whose gap the trimmed translog cannot cover must
+    bootstrap via phase-1 file copy (RecoverySourceHandler.java:262), not
+    silently lose the flushed history."""
+    c = TestCluster(tmp_path, n_nodes=3, seed=29)
+    assert c.run_until(lambda: c.master() is not None
+                       and len(c.master().cluster_state.nodes) == 3)
+    c.any_node().client_create_index(
+        "keepr", settings={"index.number_of_shards": 1,
+                           "index.number_of_replicas": 1},
+        mappings={"properties": {"n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("keepr"))
+
+    w = c.any_node()
+    for i in range(25):
+        r = c.call(w.client_write, "keepr",
+                   {"type": "index", "id": str(i), "source": {"n": i}})
+        assert r["result"] == "created"
+
+    primary_node = replica_node = None
+    for nid, node in c.nodes.items():
+        sh = node.local_shards.get(("keepr", 0))
+        if sh is not None:
+            if sh.routing.primary:
+                primary_node = nid
+            else:
+                replica_node = nid
+    spare = next(n for n in c.nodes if n not in (primary_node, replica_node))
+
+    # flush the primary: commit + translog trim — ops-only recovery of a
+    # fresh copy is now impossible
+    pshard = c.nodes[primary_node].local_shards[("keepr", 0)]
+    pshard.engine.flush()
+    assert not pshard.engine.can_replay_from(0)
+
+    # kill the replica's node; the master reroutes the copy to the spare
+    c.transport.blackhole(replica_node)
+    c.nodes[replica_node].stop()
+
+    def replica_started_on_spare():
+        state = c.nodes[primary_node].cluster_state
+        return any(r.node_id == spare and not r.primary
+                   and r.state == ShardRoutingEntry.STARTED
+                   for r in state.shards_of("keepr"))
+
+    assert c.run_until(replica_started_on_spare, max_ms=240_000), \
+        "replica never recovered on the spare node"
+
+    new_shard = c.nodes[spare].local_shards[("keepr", 0)]
+    assert new_shard.engine.doc_count() == 25, \
+        f"phase-1 recovery lost docs: {new_shard.engine.doc_count()}"
+
+    # the recovered copy keeps receiving live writes
+    r = c.call(c.nodes[primary_node].client_write, "keepr",
+               {"type": "index", "id": "99", "source": {"n": 99}})
+    assert r["result"] == "created"
+    assert c.run_until(
+        lambda: new_shard.engine.doc_count() == 26, max_ms=30_000)
+    for n in c.nodes.values():
+        if not n.coordinator.stopped:
+            n.stop()
+
+
+def test_flush_respects_retention_lease(tmp_path):
+    """The translog keeps history a peer-recovery retention lease pins."""
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.index.mapping import MapperService
+
+    e = Engine(str(tmp_path / "lease_shard"),
+               MapperService({"properties": {"n": {"type": "long"}}}))
+    for i in range(10):
+        e.index(str(i), {"n": i})
+    retained = {"seq": 0}
+    e.retained_seq_no_provider = lambda: retained["seq"]
+    e.flush()
+    # lease pins seq 0: nothing may be trimmed
+    assert e.can_replay_from(0)
+    assert len(e.translog.read_ops(0)) == 10
+    # lease released: next flush trims
+    retained["seq"] = e.local_checkpoint + 1
+    e.flush()
+    assert not e.can_replay_from(0)
+    e.close()
