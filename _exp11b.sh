@@ -1,0 +1,2 @@
+cd /root/repo
+python _exp11.py doc none 2>/dev/null

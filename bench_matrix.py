@@ -163,21 +163,24 @@ def run_north_star_10m_int8():
     centers = jax.random.normal(kc, (16384, d), dtype=jnp.float32) * 2.0
 
     @jax.jit
-    def gen_queries(k):
-        ka, kb = jax.random.split(k)
-        idx = jax.random.randint(ka, (BATCH * 16,), 0, 16384)
-        q = centers[idx] + 0.5 * jax.random.normal(kb, (BATCH * 16, d))
-        return q / jnp.linalg.norm(q, axis=-1, keepdims=True)
-
-    queries = gen_queries(kq)
-
-    @jax.jit
     def gen_chunk(k):
         ka, kb = jax.random.split(k)
         idx = jax.random.randint(ka, (chunk,), 0, 16384)
         x = centers[idx] + 0.7 * jax.random.normal(kb, (chunk, d))
         x = x / jnp.linalg.norm(x, axis=-1, keepdims=True)  # cosine prep
         return x
+
+    @jax.jit
+    def gen_queries(k):
+        # held-out-query style (SIFT/Cohere query splits): perturbations of
+        # actual corpus documents, not of cluster centers
+        ka, kb = jax.random.split(k)
+        x0 = gen_chunk(chunk_keys[0])
+        qi = jax.random.randint(ka, (BATCH * 16,), 0, chunk)
+        q = x0[qi] + 0.3 * jax.random.normal(kb, (BATCH * 16, d))
+        return q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+
+    queries = gen_queries(kq)
 
     truth_queries = queries[:BATCH]
 

@@ -12,14 +12,16 @@ This composes the layers the reference wires in `node/Node.java`:
   to the primary, execute under the primary term, fan out to in-sync replica
   copies, and acknowledge when all copies respond; a failed copy is reported
   to the master (`shard_failed`) which reroutes.
-- Peer recovery (§3.5): ops-based — the replica pulls all operations above
-  its local checkpoint from the primary's translog, replays them, and the
-  primary marks it in-sync (retention-lease-free simplification of
-  `RecoverySourceHandler` phase2).
-- Scatter-gather search (§3.2): the coordinating node fans per-shard
-  query(+fetch) requests to one STARTED copy per shard and merges hits by
-  score/sort with shard-order tie-break — the host-RPC analog of the
-  compiled ICI merge in `parallel/sharded_knn.py`.
+- Peer recovery (§3.5): ops-based phase 2 from the primary's translog when
+  retention covers the gap; otherwise phase 1 copies the primary's commit
+  files in CRC-framed chunks under a retention lease
+  (`RecoverySourceHandler.java:262,274,290`).
+- Two-phase scatter-gather search (§3.2): the coordinating node fans
+  QUERY-phase requests (rows+scores+sort+partial aggs only) to the
+  latency-ranked copy of each shard, folds responses through a streaming
+  bounded reduce, then FETCH round-trips for the global window's documents
+  — the host-RPC analog of the compiled ICI merge in
+  `parallel/sharded_knn.py`.
 
 Transport/scheduler are injected (same API as testing.deterministic), so the
 whole stack runs under the deterministic simulator or a real asyncio TCP
@@ -53,6 +55,7 @@ from elasticsearch_tpu.vectors.store import VectorStoreShard
 WRITE_PRIMARY = "indices:data/write/primary"
 WRITE_REPLICA = "indices:data/write/replica"
 QUERY_SHARD = "indices:data/read/query"
+FETCH_SHARD = "indices:data/read/fetch"
 RECOVERY_START = "internal:index/shard/recovery/start_recovery"
 RECOVERY_FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
 MASTER_CREATE_INDEX = "cluster:admin/indices/create"
@@ -630,8 +633,41 @@ class ClusterNode:
                 pass
 
     # ------------------------------------------------------------ search path
+    def _select_copy(self, copies: List[ShardRoutingEntry],
+                     sid: int) -> ShardRoutingEntry:
+        """Adaptive replica selection: rank copies by the node's query-
+        latency EWMA (SearchExecutionStatsCollector analog); unmeasured
+        nodes rank first so every copy gets probed, ties rotate by shard."""
+        ewma = getattr(self, "_ars_ewma", {})
+
+        def rank(i_copy):
+            i, copy = i_copy
+            stat = ewma.get(copy.node_id)
+            return (0 if stat is None else 1, stat or 0.0, (i + sid) % len(copies))
+
+        return min(enumerate(copies), key=rank)[1]
+
+    def _ars_observe(self, node_id: str, took_ms: float) -> None:
+        ewma = getattr(self, "_ars_ewma", None)
+        if ewma is None:
+            ewma = self._ars_ewma = {}
+        prev = ewma.get(node_id)
+        ewma[node_id] = took_ms if prev is None else 0.7 * prev + 0.3 * took_ms
+
     def client_search(self, index: str, body: dict,
                       on_done: Callable[[dict], None]) -> None:
+        """Two-phase query-then-fetch scatter-gather with a STREAMING
+        incremental reduce (AbstractSearchAsyncAction + QueryPhaseResult
+        Consumer:619): the query phase returns (row, score, sort) tuples
+        only; per-shard responses fold into a bounded top-(from+size)
+        accumulator and batched agg reduce as they arrive, so coordinator
+        memory is independent of size x shards; the fetch phase then
+        round-trips only for the global window's rows."""
+        from elasticsearch_tpu.node import _sort_key_tuple
+        from elasticsearch_tpu.search.agg_partials import (
+            finalize_aggs, merge_partial_aggs,
+        )
+
         state = self.cluster_state
         if index not in state.metadata:
             on_done({"error": {"type": "index_not_found_exception",
@@ -648,48 +684,156 @@ class ClusterNode:
             if not copies:
                 unsearchable += 1
                 continue
-            # adaptive-replica-selection-lite: spread by shard id
-            chosen = copies[sid % len(copies)]
-            targets.append(chosen)
+            targets.append(self._select_copy(copies, sid))
         if not targets:
             on_done({"hits": {"total": {"value": 0, "relation": "eq"}, "hits": []},
                      "_shards": {"total": num_shards, "successful": 0,
                                  "failed": unsearchable}})
             return
 
-        results: List[Optional[dict]] = [None] * len(targets)
-        pending = {"count": len(targets)}
+        frm = int(body.get("from", 0) or 0)
+        size = int(body.get("size", 10) if body.get("size") is not None else 10)
+        window = frm + size
+        aggs_spec = body.get("aggs") or body.get("aggregations")
+        batched_reduce = max(int(body.get("batched_reduce_size", 512)), 2)
+        sort_key = ((lambda e: (_sort_key_tuple(e[1], body), e[2]))
+                    if body.get("sort")
+                    else (lambda e: (-e[0], e[2])))
 
-        def finish():
-            merged = self._merge_shard_results(results, body, num_shards)
-            merged["_shards"]["failed"] += unsearchable
-            on_done(merged)
+        # streaming accumulator: top-`window` (score, sort, shard, row,
+        # node_id) entries + batched partial-agg buffer
+        acc = {"top": [], "agg_buffer": [], "aggs": None, "total": 0,
+               "relation": "eq", "max_score": None, "failed": 0,
+               "pending": len(targets), "successful": 0}
 
-        for i, entry in enumerate(targets):
+        def fold_aggs(force=False):
+            buf = acc["agg_buffer"]
+            if not buf or (len(buf) < batched_reduce and not force):
+                return
+            merged = acc["aggs"]
+            for tree in buf:
+                merged = tree if merged is None else \
+                    merge_partial_aggs(merged, tree, aggs_spec)
+            acc["aggs"] = merged
+            acc["agg_buffer"] = []
+
+        def on_query_resp(resp, entry, started_ms):
+            self._ars_observe(entry.node_id,
+                              max(self.scheduler.now_ms - started_ms, 0))
+            acc["successful"] += 1
+            acc["total"] += resp["total"]
+            if resp.get("relation") == "gte":
+                acc["relation"] = "gte"
+            if resp.get("max_score") is not None:
+                acc["max_score"] = max(acc["max_score"] or -1e30,
+                                       resp["max_score"])
+            svs = resp["sort_values"] or [None] * len(resp["rows"])
+            entries = [(s, sv, resp["shard"], row, entry.node_id)
+                       for row, s, sv in zip(resp["rows"], resp["scores"], svs)]
+            # bounded merge: never hold more than 2*window entries
+            acc["top"] = sorted(acc["top"] + entries, key=sort_key)[:window]
+            if resp.get("aggregations") is not None:
+                acc["agg_buffer"].append(resp["aggregations"])
+                fold_aggs()
+            step()
+
+        def on_query_fail(_err, entry):
+            acc["failed"] += 1
+            step()
+
+        def step():
+            acc["pending"] -= 1
+            if acc["pending"] == 0:
+                fold_aggs(force=True)
+                self._fetch_phase(index, body, acc, targets, num_shards,
+                                  unsearchable, frm, on_done,
+                                  finalize_aggs, aggs_spec)
+
+        for entry in targets:
             req = {"index": index, "shard": entry.shard, "body": body}
-
-            def on_resp(resp, i=i):
-                results[i] = resp
-                pending["count"] -= 1
-                if pending["count"] == 0:
-                    finish()
-
-            def on_fail(err, i=i):
-                results[i] = {"failed": str(err)}
-                pending["count"] -= 1
-                if pending["count"] == 0:
-                    finish()
-
+            started = self.scheduler.now_ms
             if entry.node_id == self.node_id:
                 try:
-                    self._on_query_shard(self.node_id, req, lambda r, i=i: on_resp(r, i))
+                    self._on_query_shard(
+                        self.node_id, req,
+                        lambda r, e=entry, t=started: on_query_resp(r, e, t))
                 except Exception as e:
-                    on_fail(e, i)
+                    on_query_fail(e, entry)
             else:
-                self.transport.send(self.node_id, entry.node_id, QUERY_SHARD, req,
+                self.transport.send(
+                    self.node_id, entry.node_id, QUERY_SHARD, req,
+                    on_response=lambda r, e=entry, t=started: on_query_resp(r, e, t),
+                    on_failure=lambda err, e=entry: on_query_fail(err, e))
+
+    def _fetch_phase(self, index, body, acc, targets, num_shards,
+                     unsearchable, frm, on_done, finalize_aggs, aggs_spec):
+        """Second round-trip: materialize _source/highlight for the global
+        window only (FetchSearchPhase.java:47)."""
+        window_entries = acc["top"][frm:]
+        out = {
+            "took": 0, "timed_out": False,
+            "_shards": {"total": num_shards,
+                        "successful": acc["successful"],
+                        "skipped": 0,
+                        "failed": acc["failed"] + unsearchable},
+            "hits": {"total": {"value": acc["total"],
+                               "relation": acc["relation"]},
+                     "max_score": acc["max_score"], "hits": []},
+        }
+        if acc["aggs"] is not None:
+            out["aggregations"] = finalize_aggs(acc["aggs"], aggs_spec)
+        if not window_entries:
+            on_done(out)
+            return
+
+        # group window rows by (shard, node)
+        by_shard: Dict[Tuple[int, str], List[int]] = {}
+        for pos, (score, sv, shard, row, node_id) in enumerate(window_entries):
+            by_shard.setdefault((shard, node_id), []).append(pos)
+        hits: List[Optional[dict]] = [None] * len(window_entries)
+        pending = {"count": len(by_shard)}
+
+        def finish():
+            out["hits"]["hits"] = [h for h in hits if h is not None]
+            on_done(out)
+
+        def one_fetch(key, positions):
+            shard, node_id = key
+            req = {"index": index, "shard": shard,
+                   "rows": [window_entries[p][3] for p in positions],
+                   "scores": [window_entries[p][0] for p in positions],
+                   "sort_values": [window_entries[p][1] for p in positions],
+                   "body": body}
+
+            def on_resp(resp, positions=positions):
+                for p, hit in zip(positions, resp["hits"]):
+                    hits[p] = hit
+                pending["count"] -= 1
+                if pending["count"] == 0:
+                    finish()
+
+            def on_fail(_err):
+                out["_shards"]["failed"] += 1
+                pending["count"] -= 1
+                if pending["count"] == 0:
+                    finish()
+
+            if node_id == self.node_id:
+                try:
+                    self._on_fetch_shard(self.node_id, req, on_resp)
+                except Exception as e:
+                    on_fail(e)
+            else:
+                self.transport.send(self.node_id, node_id, FETCH_SHARD, req,
                                     on_response=on_resp, on_failure=on_fail)
 
+        for key, positions in by_shard.items():
+            one_fetch(key, positions)
+
     def _on_query_shard(self, sender, request, respond):
+        """QUERY phase only: (row, score, sort) tuples + partial aggs —
+        per-shard network payload independent of the fetch weight
+        (QuerySearchResult analog); _source travels in the fetch phase."""
         key = (request["index"], request["shard"])
         local = self.local_shards.get(key)
         if local is None:
@@ -697,76 +841,49 @@ class ClusterNode:
         body = request["body"]
         reader = local.engine.acquire_searcher()
         # aggs leave the shard as mergeable partial states (HLL/t-digest/
-        # sum-count pairs) — the coordinator reduce in _merge_shard_results
-        # finalizes them (InternalAggregation.reduce analog)
+        # sum-count pairs); the coordinator reduce finalizes them
+        # (InternalAggregation.reduce analog)
         result = execute_query_phase(reader, local.mapper_service, body,
                                      shard_id=request["shard"],
                                      vector_store=local.vector_store,
                                      partial_aggs=True)
-        hits = execute_fetch_phase(reader, local.mapper_service, body, result,
-                                   index_name=request["index"])
         respond({
             "shard": request["shard"],
             "total": result.total_hits,
             "relation": result.total_relation,
             "max_score": result.max_score,
-            "hits": hits,
+            "rows": [int(r) for r in result.rows],
             "scores": [float(s) for s in result.scores],
             "sort_values": [list(sv) for sv in result.sort_values]
             if result.sort_values is not None else None,
             "aggregations": result.aggregations,
         })
 
-    def _merge_shard_results(self, results: List[Optional[dict]], body: dict,
-                             num_shards: int) -> dict:
-        """Coordinator reduce (`SearchPhaseController.merge:293` analog)."""
-        from elasticsearch_tpu.node import _sort_key_tuple
-        from elasticsearch_tpu.search.agg_partials import (
-            finalize_aggs, merge_partial_aggs,
-        )
-        aggs_spec = body.get("aggs") or body.get("aggregations")
+    def _on_fetch_shard(self, sender, request, respond):
+        """FETCH phase: materialize hits for the coordinator's global
+        window rows (FetchSearchPhase / SearchService.executeFetchPhase)."""
+        import numpy as np
 
-        all_hits = []
-        total = 0
-        relation = "eq"
-        max_score = None
-        aggs = None
-        failed = 0
-        for res in results:
-            if res is None or "failed" in res:
-                failed += 1
-                continue
-            total += res["total"]
-            if res.get("relation") == "gte":
-                relation = "gte"
-            if res.get("max_score") is not None:
-                max_score = max(max_score or -1e30, res["max_score"])
-            for h, score, sv in zip(res["hits"], res["scores"],
-                                    res["sort_values"] or [None] * len(res["hits"])):
-                all_hits.append((h, score, sv, res["shard"]))
-            if res.get("aggregations") is not None:
-                aggs = res["aggregations"] if aggs is None else \
-                    merge_partial_aggs(aggs, res["aggregations"], aggs_spec)
+        from elasticsearch_tpu.search.service import ShardSearchResult
 
-        if body.get("sort"):
-            all_hits.sort(key=lambda t: (_sort_key_tuple(t[2], body), t[3]))
-        else:
-            all_hits.sort(key=lambda t: (-t[1], t[3]))
-        frm = int(body.get("from", 0) or 0)
-        size = int(body.get("size", 10) if body.get("size") is not None else 10)
-        window = all_hits[frm:frm + size]
-        out = {
-            "took": 0, "timed_out": False,
-            "_shards": {"total": num_shards,
-                        "successful": len(results) - failed,
-                        "skipped": 0, "failed": failed},
-            "hits": {"total": {"value": total, "relation": relation},
-                     "max_score": max_score,
-                     "hits": [h for h, _, _, _ in window]},
-        }
-        if aggs is not None:
-            out["aggregations"] = finalize_aggs(aggs, aggs_spec)
-        return out
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None:
+            raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        body = request["body"]
+        reader = local.engine.acquire_searcher()
+        svs = request.get("sort_values")
+        result = ShardSearchResult(
+            shard_id=request["shard"],
+            rows=np.asarray(request["rows"], dtype=np.int64),
+            scores=np.asarray(request["scores"], dtype=np.float32),
+            sort_values=[tuple(sv) if sv is not None else None for sv in svs]
+            if svs is not None and any(sv is not None for sv in svs) else None,
+            total_hits=len(request["rows"]), total_relation="eq",
+            aggregations=None, max_score=None)
+        hits = execute_fetch_phase(reader, local.mapper_service, body, result,
+                                   index_name=request["index"])
+        respond({"hits": hits})
 
     def client_get(self, index: str, doc_id: str,
                    on_done: Callable[[dict], None]) -> None:
@@ -814,6 +931,7 @@ class ClusterNode:
         t.register(me, WRITE_PRIMARY, self._on_write_primary)
         t.register(me, WRITE_REPLICA, self._on_write_replica)
         t.register(me, QUERY_SHARD, self._on_query_shard)
+        t.register(me, FETCH_SHARD, self._on_fetch_shard)
         t.register(me, "indices:data/read/get", self._on_get)
         t.register(me, "indices:admin/refresh", self._on_refresh)
         t.register(me, RECOVERY_START, self._on_recovery_start)

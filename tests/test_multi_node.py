@@ -381,3 +381,66 @@ def test_flush_respects_retention_lease(tmp_path):
     e.flush()
     assert not e.can_replay_from(0)
     e.close()
+
+
+def test_two_phase_search_payload_shape(cluster):
+    """Query phase ships (row, score, sort) only — no _source — and the
+    fetch phase round-trips just the global window (FetchSearchPhase)."""
+    c = cluster
+    c.any_node().client_create_index(
+        "tp", settings={"index.number_of_shards": 2,
+                        "index.number_of_replicas": 0},
+        mappings={"properties": {"n": {"type": "long"},
+                                 "blob": {"type": "keyword"}}})
+    assert c.run_until(lambda: c.all_started("tp"))
+    w = c.any_node()
+    for i in range(40):
+        c.call(w.client_write, "tp",
+               {"type": "index", "id": str(i),
+                "source": {"n": i, "blob": "x" * 500}})
+    for n in c.nodes.values():
+        n.refresh_all()
+
+    coordinator = c.any_node()
+    captured = []
+    orig_send = c.transport.send
+
+    def capture_send(sender, target, action, request, **kw):
+        captured.append((action, request, kw))
+        return orig_send(sender, target, action, request, **kw)
+
+    c.transport.send = capture_send
+    try:
+        resp = c.call(coordinator.client_search, "tp",
+                      {"query": {"match_all": {}}, "size": 5,
+                       "sort": [{"n": "asc"}]})
+    finally:
+        c.transport.send = orig_send
+    assert resp["hits"]["total"]["value"] == 40
+    assert [h["_source"]["n"] for h in resp["hits"]["hits"]] == [0, 1, 2, 3, 4]
+
+    query_reqs = [r for a, r, k in captured
+                  if a == "indices:data/read/query"]
+    fetch_reqs = [r for a, r, k in captured
+                  if a == "indices:data/read/fetch"]
+    assert query_reqs, "query phase never went over the wire"
+    # fetch requests cover at most the global window (5 docs total)
+    if fetch_reqs:  # remote shards only; local shard fetches in-process
+        assert sum(len(r["rows"]) for r in fetch_reqs) <= 5
+    # ARS recorded latencies for the queried nodes
+    assert getattr(coordinator, "_ars_ewma", {}), "no ARS observations"
+
+
+def test_ars_prefers_faster_node(cluster):
+    c = cluster
+    node = c.any_node()
+    node._ars_observe("slow", 100.0)
+    node._ars_observe("fast", 5.0)
+    node._ars_observe("slow", 120.0)
+    from elasticsearch_tpu.cluster.state import ShardRoutingEntry as SRE
+    copies = [SRE("i", 0, True, "slow", SRE.STARTED, "a1"),
+              SRE("i", 0, False, "fast", SRE.STARTED, "a2")]
+    assert node._select_copy(copies, 0).node_id == "fast"
+    # unknown nodes get probed before measured ones
+    copies.append(SRE("i", 0, False, "unknown", SRE.STARTED, "a3"))
+    assert node._select_copy(copies, 0).node_id == "unknown"
