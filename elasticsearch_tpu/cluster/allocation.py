@@ -1,14 +1,36 @@
 """Shard allocation: assigning shard copies to nodes.
 
-Re-design of `routing/allocation/AllocationService.java` + the balanced
-allocator + deciders (SURVEY.md §2.3): pure functions from (cluster state,
-event) to a new routing table. Deciders enforced here:
-  - same-shard: never two copies of one shard on a node
-    (`SameShardAllocationDecider`)
-  - balance: new copies go to data nodes with the fewest shards
-    (`BalancedShardsAllocator`, weight = shard count)
-Events: index created, node joined (allocate unassigned), node left
-(promote replicas / reallocate), shard started, shard failed.
+Re-design of `routing/allocation/AllocationService.java` + the weighted
+balancer (`BalancedShardsAllocator.java`, 1,231 LoC) + the pluggable decider
+chain (`routing/allocation/decider/`): pure functions from (cluster state,
+event) to a new routing table.
+
+Deciders (each answers can_allocate / can_remain / can_rebalance with
+YES | NO | THROTTLE, reference `Decision.java`):
+  - same-shard  (`SameShardAllocationDecider`)
+  - enable      (`EnableAllocationDecider`: cluster.routing.allocation.enable
+                 and cluster.routing.rebalance.enable)
+  - filter      (`FilterAllocationDecider`: cluster- and index-level
+                 include/exclude/require on _name/_id/custom node.attr.*)
+  - disk threshold (`DiskThresholdDecider`: low watermark gates new
+                 allocations, high watermark evicts via can_remain)
+  - throttling  (`ThrottlingAllocationDecider`:
+                 cluster.routing.allocation.node_concurrent_recoveries)
+  - awareness   (`AwarenessAllocationDecider`: spread copies across
+                 cluster.routing.allocation.awareness.attributes values)
+  - shards-per-node (`ShardsLimitAllocationDecider`:
+                 index.routing.allocation.total_shards_per_node)
+
+The balancer weighs nodes with the reference's two-term formula
+(`BalancedShardsAllocator.WeightFunction`): theta0 * (nodeShards - avg)
++ theta1 * (nodeIndexShards - avgIndex); `rebalance()` moves STARTED shards
+from the heaviest to the lightest eligible node while the weight delta
+exceeds cluster.routing.allocation.balance.threshold. Moves are modelled as
+RELOCATING source + INITIALIZING target entries (see ShardRoutingEntry).
+
+Events: index created, node joined (allocate unassigned + rebalance), node
+left (promote replicas / reallocate), shard started (completes relocations),
+shard failed.
 """
 
 from __future__ import annotations
@@ -20,6 +42,10 @@ from elasticsearch_tpu.cluster.state import ClusterState, ShardRoutingEntry
 
 _alloc_counter = itertools.count()
 
+YES = "YES"
+NO = "NO"
+THROTTLE = "THROTTLE"
+
 
 def _new_allocation_id(index: str, shard: int) -> str:
     return f"{index}[{shard}]#{next(_alloc_counter)}"
@@ -29,49 +55,328 @@ def _data_nodes(state: ClusterState) -> List[str]:
     return sorted(nid for nid, n in state.nodes.items() if "data" in n.roles)
 
 
-def _shard_counts(routing: List[ShardRoutingEntry]) -> Dict[str, int]:
-    counts: Dict[str, int] = {}
-    for r in routing:
-        if r.node_id:
-            counts[r.node_id] = counts.get(r.node_id, 0) + 1
-    return counts
+class AllocationContext:
+    """Carries the inputs deciders read: the state, merged settings, and the
+    per-node disk usage map (`ClusterInfo` analog: node_id -> {"total_bytes",
+    "free_bytes"}, fed by the master's stats collection or tests)."""
+
+    def __init__(self, state: ClusterState,
+                 cluster_info: Optional[Dict[str, dict]] = None):
+        self.state = state
+        self.settings = state.settings
+        self.cluster_info = cluster_info or {}
+
+    def setting(self, key: str, default=None):
+        return self.settings.get(key, default)
+
+    def index_setting(self, index: str, key: str, default=None):
+        meta = self.state.metadata.get(index) or {}
+        return (meta.get("settings") or {}).get(key, default)
+
+    def copies_of(self, index: str, shard: int) -> List[ShardRoutingEntry]:
+        return [r for r in self.state.routing
+                if r.index == index and r.shard == shard]
 
 
-def _pick_node(routing: List[ShardRoutingEntry], candidates: List[str],
-               exclude: Set[str]) -> Optional[str]:
-    counts = _shard_counts(routing)
-    usable = [n for n in candidates if n not in exclude]
-    if not usable:
+class AllocationDecider:
+    name = "base"
+
+    def can_allocate(self, entry: ShardRoutingEntry, node_id: str,
+                     ctx: AllocationContext) -> str:
+        return YES
+
+    def can_remain(self, entry: ShardRoutingEntry, node_id: str,
+                   ctx: AllocationContext) -> str:
+        return YES
+
+    def can_rebalance(self, ctx: AllocationContext) -> str:
+        return YES
+
+
+class SameShardDecider(AllocationDecider):
+    """Never two copies of one shard on a node (`SameShardAllocationDecider`)."""
+    name = "same_shard"
+
+    def can_allocate(self, entry, node_id, ctx):
+        for r in ctx.copies_of(entry.index, entry.shard):
+            if r.node_id == node_id and r.allocation_id != entry.allocation_id:
+                return NO
+        return YES
+
+
+class EnableDecider(AllocationDecider):
+    """cluster.routing.allocation.enable = all|primaries|new_primaries|none;
+    cluster.routing.rebalance.enable = all|none (`EnableAllocationDecider`)."""
+    name = "enable"
+
+    def can_allocate(self, entry, node_id, ctx):
+        mode = str(ctx.setting("cluster.routing.allocation.enable", "all"))
+        if mode == "all":
+            return YES
+        if mode == "none":
+            return NO
+        if mode in ("primaries", "new_primaries"):
+            return YES if entry.primary else NO
+        return YES
+
+    def can_rebalance(self, ctx):
+        mode = str(ctx.setting("cluster.routing.rebalance.enable", "all"))
+        return YES if mode == "all" else NO
+
+
+def _node_attr(ctx: AllocationContext, node_id: str, attr: str) -> Optional[str]:
+    node = ctx.state.nodes.get(node_id)
+    if node is None:
         return None
-    return min(usable, key=lambda n: (counts.get(n, 0), n))
+    if attr == "_name":
+        return node.name
+    if attr == "_id":
+        return node.node_id
+    return node.attributes.get(attr)
+
+
+def _matches(value: Optional[str], patterns: str) -> bool:
+    if value is None:
+        return False
+    for pat in str(patterns).split(","):
+        pat = pat.strip()
+        if not pat:
+            continue
+        if pat == value or (pat.endswith("*") and value.startswith(pat[:-1])):
+            return True
+    return False
+
+
+class FilterDecider(AllocationDecider):
+    """include/exclude/require filters at cluster and index scope
+    (`FilterAllocationDecider`). can_remain enforces exclusions so changing
+    a filter drains shards off the excluded nodes."""
+    name = "filter"
+
+    _SCOPES = ("include", "exclude", "require")
+
+    def _filters(self, ctx, index):
+        out = []  # (scope, attr, patterns)
+        for key, val in ctx.settings.items():
+            for scope in self._SCOPES:
+                prefix = f"cluster.routing.allocation.{scope}."
+                if key.startswith(prefix):
+                    out.append((scope, key[len(prefix):], val))
+        meta = (ctx.state.metadata.get(index) or {}).get("settings") or {}
+        for key, val in meta.items():
+            for scope in self._SCOPES:
+                prefix = f"index.routing.allocation.{scope}."
+                if key.startswith(prefix):
+                    out.append((scope, key[len(prefix):], val))
+        return out
+
+    def _decide(self, entry, node_id, ctx):
+        for scope, attr, patterns in self._filters(ctx, entry.index):
+            value = _node_attr(ctx, node_id, attr)
+            hit = _matches(value, patterns)
+            if scope == "exclude" and hit:
+                return NO
+            if scope == "require" and not hit:
+                return NO
+            if scope == "include" and not hit:
+                return NO
+        return YES
+
+    can_allocate = _decide
+    can_remain = _decide
+
+
+class DiskThresholdDecider(AllocationDecider):
+    """Low watermark gates new shards; high watermark forces shards off the
+    node (`DiskThresholdDecider`). Watermarks accept "85%" or byte counts."""
+    name = "disk_threshold"
+
+    def _used_fraction(self, ctx, node_id) -> Optional[float]:
+        info = ctx.cluster_info.get(node_id)
+        if not info or not info.get("total_bytes"):
+            return None
+        return 1.0 - info.get("free_bytes", 0) / info["total_bytes"]
+
+    def _exceeds(self, ctx, node_id, watermark: str, default: str) -> bool:
+        raw = str(ctx.setting(watermark, default))
+        info = ctx.cluster_info.get(node_id)
+        if info is None:
+            return False
+        if raw.endswith("%"):
+            frac = self._used_fraction(ctx, node_id)
+            return frac is not None and frac * 100.0 >= float(raw[:-1])
+        try:
+            min_free = int(raw)
+        except ValueError:
+            return False
+        return info.get("free_bytes", 0) <= min_free
+
+    def can_allocate(self, entry, node_id, ctx):
+        if self._exceeds(ctx, node_id,
+                         "cluster.routing.allocation.disk.watermark.low", "85%"):
+            return NO
+        return YES
+
+    def can_remain(self, entry, node_id, ctx):
+        if self._exceeds(ctx, node_id,
+                         "cluster.routing.allocation.disk.watermark.high", "90%"):
+            return NO
+        return YES
+
+
+class ThrottlingDecider(AllocationDecider):
+    """Caps concurrent incoming recoveries per node
+    (`ThrottlingAllocationDecider`, node_concurrent_recoveries default 2)."""
+    name = "throttling"
+
+    def can_allocate(self, entry, node_id, ctx):
+        limit = int(ctx.setting(
+            "cluster.routing.allocation.node_concurrent_recoveries", 2))
+        initializing = sum(
+            1 for r in ctx.state.routing
+            if r.node_id == node_id and r.state == ShardRoutingEntry.INITIALIZING
+            and r.allocation_id != entry.allocation_id)
+        return THROTTLE if initializing >= limit else YES
+
+
+class AwarenessDecider(AllocationDecider):
+    """Spread copies of a shard across values of the awareness attributes
+    (`AwarenessAllocationDecider`): a node may hold at most
+    ceil(copies / distinct_values) copies for each attribute."""
+    name = "awareness"
+
+    def can_allocate(self, entry, node_id, ctx):
+        attrs = ctx.setting("cluster.routing.allocation.awareness.attributes")
+        if not attrs:
+            return YES
+        if isinstance(attrs, str):
+            attrs = [a.strip() for a in attrs.split(",") if a.strip()]
+        copies = ctx.copies_of(entry.index, entry.shard)
+        n_copies = len(copies)
+        for attr in attrs:
+            values = {_node_attr(ctx, nid, attr)
+                      for nid in _data_nodes(ctx.state)}
+            values.discard(None)
+            if not values:
+                continue
+            my_value = _node_attr(ctx, node_id, attr)
+            per_value_cap = -(-n_copies // len(values))  # ceil
+            same = sum(1 for r in copies
+                       if r.node_id and r.allocation_id != entry.allocation_id
+                       and _node_attr(ctx, r.node_id, attr) == my_value)
+            if same + 1 > per_value_cap:
+                return NO
+        return YES
+
+
+class ShardsLimitDecider(AllocationDecider):
+    """index.routing.allocation.total_shards_per_node
+    (`ShardsLimitAllocationDecider`)."""
+    name = "shards_limit"
+
+    def can_allocate(self, entry, node_id, ctx):
+        limit = ctx.index_setting(entry.index,
+                                  "index.routing.allocation.total_shards_per_node")
+        if limit in (None, -1, "-1"):
+            return YES
+        count = sum(1 for r in ctx.state.routing
+                    if r.index == entry.index and r.node_id == node_id
+                    and r.allocation_id != entry.allocation_id)
+        return NO if count >= int(limit) else YES
+
+
+DEFAULT_DECIDERS: List[AllocationDecider] = [
+    SameShardDecider(), EnableDecider(), FilterDecider(),
+    DiskThresholdDecider(), ThrottlingDecider(), AwarenessDecider(),
+    ShardsLimitDecider(),
+]
+
+
+def decide_allocate(entry: ShardRoutingEntry, node_id: str,
+                    ctx: AllocationContext,
+                    deciders: Optional[List[AllocationDecider]] = None) -> str:
+    """Chain verdict: NO wins over THROTTLE wins over YES (`Decision.java`)."""
+    verdict = YES
+    for d in (deciders or DEFAULT_DECIDERS):
+        v = d.can_allocate(entry, node_id, ctx)
+        if v == NO:
+            return NO
+        if v == THROTTLE:
+            verdict = THROTTLE
+    return verdict
+
+
+def decide_remain(entry: ShardRoutingEntry, node_id: str,
+                  ctx: AllocationContext,
+                  deciders: Optional[List[AllocationDecider]] = None) -> str:
+    for d in (deciders or DEFAULT_DECIDERS):
+        if d.can_remain(entry, node_id, ctx) == NO:
+            return NO
+    return YES
+
+
+# --------------------------------------------------------------------------
+# balancer weight (BalancedShardsAllocator.WeightFunction)
+# --------------------------------------------------------------------------
+
+def _weights(state: ClusterState, index: str) -> Dict[str, float]:
+    """weight(node) for placing a copy of `index`: lower = preferred."""
+    theta_shard = float(state.settings.get(
+        "cluster.routing.allocation.balance.shard", 0.45))
+    theta_index = float(state.settings.get(
+        "cluster.routing.allocation.balance.index", 0.55))
+    nodes = _data_nodes(state)
+    if not nodes:
+        return {}
+    totals = {n: 0 for n in nodes}
+    per_index = {n: 0 for n in nodes}
+    for r in state.routing:
+        # weigh shards by where they will END UP: a RELOCATING source is
+        # leaving its node (its target copy is already counted), so counting
+        # it would double-weigh in-flight moves and stall convergence
+        if r.node_id in totals and r.state not in (
+                ShardRoutingEntry.UNASSIGNED, ShardRoutingEntry.RELOCATING):
+            totals[r.node_id] += 1
+            if r.index == index:
+                per_index[r.node_id] += 1
+    avg_total = sum(totals.values()) / len(nodes)
+    avg_index = sum(per_index.values()) / len(nodes)
+    return {n: theta_shard * (totals[n] - avg_total)
+            + theta_index * (per_index[n] - avg_index)
+            for n in nodes}
+
+
+def _pick_node(entry: ShardRoutingEntry, ctx: AllocationContext,
+               exclude: Set[str]) -> Optional[str]:
+    """Lowest-weight node the decider chain allows (THROTTLE defers:
+    reroute() runs again on the next state change)."""
+    weights = _weights(ctx.state, entry.index)
+    candidates = sorted((w, n) for n, w in weights.items() if n not in exclude)
+    for _, node in candidates:
+        if decide_allocate(entry, node, ctx) == YES:
+            return node
+    return None
 
 
 def allocate_new_index(state: ClusterState, index: str, num_shards: int,
                        num_replicas: int) -> ClusterState:
-    """Create INITIALIZING entries for a new index's shards."""
+    """Create UNASSIGNED entries for a new index's shards; reroute assigns
+    them through the decider chain. A brand-new shard's in-sync set is empty,
+    which is exactly what licenses allocating its primary to any node (no
+    data exists yet to lose)."""
     routing = list(state.routing)
-    nodes = _data_nodes(state)
     isa = dict(state.in_sync_allocations)
     for shard in range(num_shards):
-        occupied: Set[str] = set()
-        primary_node = _pick_node(routing, nodes, occupied)
-        primary = ShardRoutingEntry(index, shard, True, primary_node,
-                                    ShardRoutingEntry.INITIALIZING if primary_node
-                                    else ShardRoutingEntry.UNASSIGNED,
-                                    _new_allocation_id(index, shard))
-        routing.append(primary)
-        if primary_node:
-            occupied.add(primary_node)
+        routing.append(ShardRoutingEntry(
+            index, shard, True, None, ShardRoutingEntry.UNASSIGNED,
+            _new_allocation_id(index, shard)))
         for _ in range(num_replicas):
-            rnode = _pick_node(routing, nodes, occupied)
             routing.append(ShardRoutingEntry(
-                index, shard, False, rnode,
-                ShardRoutingEntry.INITIALIZING if rnode else ShardRoutingEntry.UNASSIGNED,
+                index, shard, False, None, ShardRoutingEntry.UNASSIGNED,
                 _new_allocation_id(index, shard)))
-            if rnode:
-                occupied.add(rnode)
         isa[(index, shard)] = set()
-    return state.with_(routing=routing, in_sync_allocations=isa)
+    state = state.with_(routing=routing, in_sync_allocations=isa)
+    return reroute(state)
 
 
 def remove_index(state: ClusterState, index: str) -> ClusterState:
@@ -85,13 +390,39 @@ def remove_index(state: ClusterState, index: str) -> ClusterState:
 def shard_started(state: ClusterState, allocation_id: str) -> ClusterState:
     routing = []
     isa = dict(state.in_sync_allocations)
+    started: Optional[ShardRoutingEntry] = None
     for r in state.routing:
         if r.allocation_id == allocation_id and r.state == ShardRoutingEntry.INITIALIZING:
             r = r.copy(state=ShardRoutingEntry.STARTED)
+            started = r
             key = (r.index, r.shard)
             isa[key] = set(isa.get(key, set())) | {allocation_id}
         routing.append(r)
-    return state.with_(routing=routing, in_sync_allocations=isa)
+
+    if started is not None and started.relocation_source is not None:
+        # relocation handoff: drop the RELOCATING source; the target takes
+        # over the source's primary flag (ShardRouting.moveToStarted +
+        # RoutingNodes.relocationCompleted analog)
+        source = next((r for r in routing
+                       if r.allocation_id == started.relocation_source), None)
+        if source is not None:
+            routing = [r for r in routing
+                       if r.allocation_id != source.allocation_id]
+            key = (source.index, source.shard)
+            isa[key] = set(isa.get(key, set())) - {source.allocation_id}
+            for i, r in enumerate(routing):
+                if r.allocation_id == allocation_id:
+                    routing[i] = r.copy(primary=source.primary,
+                                        relocation_source=None)
+    # a completed recovery frees a throttling slot: reroute drains any
+    # copies the ThrottlingDecider deferred (reference: every shard-started
+    # task runs AllocationService.reroute)
+    state = reroute(state.with_(routing=routing, in_sync_allocations=isa))
+    if started is not None and started.relocation_source is not None:
+        # a finished relocation may unblock the next balancing move
+        # (throttling limits how many run concurrently)
+        state = rebalance(state)
+    return state
 
 
 def shard_failed(state: ClusterState, allocation_id: str) -> ClusterState:
@@ -118,8 +449,9 @@ def _handle_copy_loss(state: ClusterState, lost: List[ShardRoutingEntry]) -> Clu
 
     for r in lost:
         key = (r.index, r.shard)
-        isa.get(key, set()).discard(r.allocation_id)
-        if r.primary:
+        if not r.primary:
+            isa.get(key, set()).discard(r.allocation_id)
+        else:
             # promote an in-sync STARTED replica (reference: primary failover
             # only from the in-sync set — data-loss safety)
             promoted = False
@@ -130,71 +462,176 @@ def _handle_copy_loss(state: ClusterState, lost: List[ShardRoutingEntry]) -> Clu
                     routing[i] = cand.copy(primary=True)
                     promoted = True
                     break
-            if not promoted:
-                # no safe copy: shard red/unassigned primary
+            if promoted:
+                isa.get(key, set()).discard(r.allocation_id)
+            else:
+                # no safe copy: shard red. KEEP the lost primary's id in the
+                # in-sync set — a non-empty in-sync set is what stops
+                # reroute() from fabricating an empty primary on another
+                # node (silent data loss); the shard stays red until the
+                # holder returns or an operator forces allocation
                 routing.append(ShardRoutingEntry(
                     r.index, r.shard, True, None, ShardRoutingEntry.UNASSIGNED,
                     _new_allocation_id(r.index, r.shard)))
+
+    # cancelled relocations: a RELOCATING source whose target copy died
+    # reverts to STARTED; a target whose source died becomes a plain
+    # initializing copy (RoutingNodes.cancelRelocation analog)
+    alive_targets = {r.relocation_source for r in routing if r.relocation_source}
+    alive_ids = {r.allocation_id for r in routing}
+    for i, r in enumerate(routing):
+        if r.state == ShardRoutingEntry.RELOCATING \
+                and r.allocation_id not in alive_targets:
+            routing[i] = r.copy(state=ShardRoutingEntry.STARTED)
+        elif r.relocation_source and r.relocation_source not in alive_ids:
+            routing[i] = r.copy(relocation_source=None)
 
     state = state.with_(routing=routing, in_sync_allocations=isa)
     return reroute(state)
 
 
-def reroute(state: ClusterState) -> ClusterState:
+def reroute(state: ClusterState,
+            cluster_info: Optional[Dict[str, dict]] = None) -> ClusterState:
     """Allocate unassigned copies and top up missing replicas
-    (`AllocationService.reroute`). Balance via an incrementally-updated
-    shard-count map (no double counting)."""
-    nodes = _data_nodes(state)
-    counts = _shard_counts(state.routing)
+    (`AllocationService.reroute`), through the decider chain. THROTTLEd
+    copies stay UNASSIGNED; reroute runs again on every shard-started /
+    membership state change, so they allocate as recoveries drain.
 
-    def pick(exclude: Set[str]) -> Optional[str]:
-        usable = [n for n in nodes if n not in exclude]
-        if not usable:
-            return None
-        chosen = min(usable, key=lambda n: (counts.get(n, 0), n))
-        counts[chosen] = counts.get(chosen, 0) + 1
-        return chosen
+    An unassigned primary allocates ONLY when its in-sync set is empty
+    (never-started shard: no data exists anywhere). Assigning a primary
+    whose in-sync copies are all lost would fabricate an empty shard —
+    silent data loss — so such shards stay red until an operator forces
+    allocation (reference: primaries allocate only to in-sync copy holders;
+    allocate_empty_primary is an explicit dangerous command)."""
+    work = list(state.routing)
 
-    by_shard: Dict[Tuple[str, int], List[ShardRoutingEntry]] = {}
-    for r in state.routing:
-        by_shard.setdefault((r.index, r.shard), []).append(r)
+    def ctx_now() -> AllocationContext:
+        return AllocationContext(state.with_(routing=work), cluster_info)
 
-    new_routing: List[ShardRoutingEntry] = []
-    for key, copies in sorted(by_shard.items()):
+    by_shard: Dict[Tuple[str, int], List[int]] = {}
+    for i, r in enumerate(work):
+        by_shard.setdefault((r.index, r.shard), []).append(i)
+
+    for key in sorted(by_shard):
         index, shard = key
         desired_replicas = int(state.metadata.get(index, {}).get(
             "settings", {}).get("index.number_of_replicas", 1))
-        occupied = {r.node_id for r in copies if r.node_id}
-        out = []
-        for r in copies:
-            if r.state == ShardRoutingEntry.UNASSIGNED and r.node_id is None:
-                if r.primary:
-                    # NEVER auto-allocate an unassigned primary: no node holds
-                    # in-sync data for it, so assigning would fabricate an
-                    # empty shard — silent data loss. The shard stays red
-                    # until an operator forces allocation (reference:
-                    # primaries allocate only to in-sync copy holders;
-                    # allocate_empty_primary is an explicit dangerous command)
-                    out.append(r)
-                    continue
-                node = pick(occupied)
-                if node is not None:
-                    r = r.copy(node=node, state=ShardRoutingEntry.INITIALIZING)
-                    occupied.add(node)
-            out.append(r)
+        idxs = by_shard[key]
+        occupied = {work[i].node_id for i in idxs if work[i].node_id}
+        for i in idxs:
+            r = work[i]
+            if r.state != ShardRoutingEntry.UNASSIGNED or r.node_id is not None:
+                continue
+            if r.primary and state.in_sync_allocations.get(key):
+                continue
+            node = _pick_node(r, ctx_now(), occupied)
+            if node is not None:
+                work[i] = r.copy(node=node,
+                                 state=ShardRoutingEntry.INITIALIZING)
+                occupied.add(node)
         # top up replicas only when a live primary exists to recover from
+        group = [work[i] for i in idxs]
         has_active_primary = any(
             r.primary and r.node_id and r.state != ShardRoutingEntry.UNASSIGNED
-            for r in out)
-        replica_count = sum(1 for r in out if not r.primary)
+            for r in group)
+        replica_count = sum(1 for r in group if not r.primary)
         while has_active_primary and replica_count < desired_replicas:
-            node = pick(occupied)
+            probe = ShardRoutingEntry(index, shard, False, None,
+                                      ShardRoutingEntry.UNASSIGNED,
+                                      _new_allocation_id(index, shard))
+            node = _pick_node(probe, ctx_now(), occupied)
             if node is None:
                 break
-            out.append(ShardRoutingEntry(index, shard, False, node,
-                                         ShardRoutingEntry.INITIALIZING,
-                                         _new_allocation_id(index, shard)))
+            work.append(probe.copy(node=node,
+                                   state=ShardRoutingEntry.INITIALIZING))
             occupied.add(node)
             replica_count += 1
-        new_routing.extend(out)
-    return state.with_(routing=new_routing)
+
+    # deterministic grouped order
+    work.sort(key=lambda r: (r.index, r.shard, not r.primary, r.allocation_id))
+    return state.with_(routing=work)
+
+
+def rebalance(state: ClusterState,
+              cluster_info: Optional[Dict[str, dict]] = None) -> ClusterState:
+    """Weight-driven shard movement (`BalancedShardsAllocator.balance()`):
+    while the heaviest/lightest weight delta exceeds the threshold, relocate
+    one STARTED shard from the heaviest node to the lightest node the
+    deciders allow. Also drains shards whose can_remain is NO (disk high
+    watermark, filter exclusions) regardless of balance
+    (`AllocationService.shardsWithState` move pass)."""
+    ctx = AllocationContext(state, cluster_info)
+    if any(d.can_rebalance(ctx) == NO for d in DEFAULT_DECIDERS):
+        return _move_shards_that_cannot_remain(state, cluster_info)
+
+    threshold = float(state.settings.get(
+        "cluster.routing.allocation.balance.threshold", 1.0))
+    moved = True
+    while moved:
+        moved = False
+        ctx = AllocationContext(state, cluster_info)
+        # consider each index's weight surface independently (reference
+        # balances index-by-index)
+        for index in sorted({r.index for r in state.routing}):
+            weights = _weights(state, index)
+            if len(weights) < 2:
+                continue
+            heavy = max(weights, key=lambda n: (weights[n], n))
+            light = min(weights, key=lambda n: (weights[n], n))
+            if weights[heavy] - weights[light] <= threshold:
+                continue
+            movable = [r for r in state.routing
+                       if r.node_id == heavy and r.index == index
+                       and r.state == ShardRoutingEntry.STARTED]
+            for r in movable:
+                target = ShardRoutingEntry(
+                    r.index, r.shard, False, light,
+                    ShardRoutingEntry.UNASSIGNED,
+                    _new_allocation_id(r.index, r.shard),
+                    relocation_source=r.allocation_id)
+                if decide_allocate(target, light, ctx) != YES:
+                    continue
+                state = _start_relocation(state, r, light, target.allocation_id)
+                moved = True
+                break
+            if moved:
+                break
+    return _move_shards_that_cannot_remain(state, cluster_info)
+
+
+def _start_relocation(state: ClusterState, source: ShardRoutingEntry,
+                      target_node: str, target_alloc: str) -> ClusterState:
+    routing = []
+    for r in state.routing:
+        if r.allocation_id == source.allocation_id:
+            routing.append(r.copy(state=ShardRoutingEntry.RELOCATING))
+        else:
+            routing.append(r)
+    routing.append(ShardRoutingEntry(
+        source.index, source.shard, False, target_node,
+        ShardRoutingEntry.INITIALIZING, target_alloc,
+        relocation_source=source.allocation_id))
+    return state.with_(routing=routing)
+
+
+def _move_shards_that_cannot_remain(
+        state: ClusterState,
+        cluster_info: Optional[Dict[str, dict]] = None) -> ClusterState:
+    ctx = AllocationContext(state, cluster_info)
+    for r in list(state.routing):
+        if r.state != ShardRoutingEntry.STARTED or r.node_id is None:
+            continue
+        if decide_remain(r, r.node_id, ctx) == YES:
+            continue
+        occupied = {c.node_id for c in ctx.copies_of(r.index, r.shard)
+                    if c.node_id}
+        probe = ShardRoutingEntry(r.index, r.shard, False, None,
+                                  ShardRoutingEntry.UNASSIGNED,
+                                  _new_allocation_id(r.index, r.shard),
+                                  relocation_source=r.allocation_id)
+        target = _pick_node(probe, ctx, occupied)
+        if target is None:
+            continue
+        state = _start_relocation(state, r, target, probe.allocation_id)
+        ctx = AllocationContext(state, cluster_info)
+    return state

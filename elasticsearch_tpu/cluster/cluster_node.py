@@ -62,6 +62,7 @@ MASTER_CREATE_INDEX = "cluster:admin/indices/create"
 MASTER_DELETE_INDEX = "cluster:admin/indices/delete"
 MASTER_SHARD_STARTED = "internal:cluster/shard/started"
 MASTER_SHARD_FAILED = "internal:cluster/shard/failure"
+MASTER_UPDATE_SETTINGS = "cluster:admin/settings/update"
 
 
 class LocalShard:
@@ -98,14 +99,15 @@ class LocalShard:
 class ClusterNode:
     def __init__(self, node_id: str, data_path: str, transport, scheduler,
                  seed_peers: List[str], initial_state: ClusterState,
-                 rng=None, address: str = ""):
+                 rng=None, address: str = "",
+                 attributes: Optional[Dict[str, str]] = None):
         self.node_id = node_id
         self.data_path = data_path
         self.transport = transport
         self.scheduler = scheduler
         self.local_shards: Dict[Tuple[str, int], LocalShard] = {}
         self.mappers: Dict[str, MapperService] = {}
-        node = DiscoveryNode(node_id, address=address)
+        node = DiscoveryNode(node_id, address=address, attributes=attributes)
         # durable gateway: term + last-accepted state survive full-cluster
         # restarts (PersistedClusterStateService/GatewayMetaState analog);
         # initial_state seeds only a never-booted node
@@ -140,6 +142,9 @@ class ClusterNode:
             state = allocation.node_left(state, nid)
         if added:
             state = allocation.reroute(state)
+            # a fresh node is empty: move shards onto it until node weights
+            # converge (BalancedShardsAllocator.balance on reroute)
+            state = allocation.rebalance(state)
         return state
 
     def _require_master(self):
@@ -184,6 +189,35 @@ class ClusterNode:
             lambda base: allocation.remove_index(base, name)
             if name in base.metadata else base,
             respond, {"acknowledged": True})
+
+    def _master_update_settings(self, sender, request, respond):
+        """`PUT /_cluster/settings` persistent settings: merged into the
+        state, then reroute+rebalance so allocation filters / watermarks /
+        enable flags take effect immediately (TransportClusterUpdateSettings
+        Action reroutes after applying)."""
+        self._require_master()
+        updates = dict(request.get("persistent") or {})
+
+        def update(base: ClusterState) -> ClusterState:
+            merged = dict(base.settings)
+            for k, v in updates.items():
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+            state = base.with_(settings=merged)
+            state = allocation.reroute(state)
+            return allocation.rebalance(state)
+
+        self._publish_then_respond(update, respond,
+                                   {"acknowledged": True,
+                                    "persistent": updates})
+
+    def client_update_settings(self, persistent: dict,
+                               on_done: Optional[Callable] = None) -> None:
+        self._send_to_master(MASTER_UPDATE_SETTINGS,
+                             {"persistent": persistent},
+                             on_response=on_done or (lambda r: None))
 
     def _master_shard_started(self, sender, request, respond):
         self._require_master()
@@ -940,6 +974,7 @@ class ClusterNode:
         t.register(me, MASTER_DELETE_INDEX, self._master_delete_index)
         t.register(me, MASTER_SHARD_STARTED, self._master_shard_started)
         t.register(me, MASTER_SHARD_FAILED, self._master_shard_failed)
+        t.register(me, MASTER_UPDATE_SETTINGS, self._master_update_settings)
 
     # client admin helpers ----------------------------------------------------
     def client_create_index(self, name: str, settings: Optional[dict] = None,

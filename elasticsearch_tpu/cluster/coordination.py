@@ -253,6 +253,8 @@ class Coordinator:
         # in DiscoveryNode.address so every node can dial every other
         # (reference: JoinRequest carries the joining DiscoveryNode)
         self._join_addresses: Dict[str, str] = {}
+        # full DiscoveryNode dicts from join requests (roles/attributes)
+        self._join_nodes: Dict[str, dict] = {}
         # client acks gated on COMMIT, not publish-start: (term, version,
         # callback(bool)) fired from _apply_committed, failed on demotion
         # (reference: MasterService ack listeners / publish listener)
@@ -334,12 +336,17 @@ class Coordinator:
         if self.mode != CANDIDATE:
             self._become_candidate("received start-join for a newer term")
         join["address"] = self.node.address  # so the leader can publish it
+        # full node identity (roles, awareness attributes) travels with the
+        # join (reference: JoinRequest carries the joining DiscoveryNode)
+        join["node"] = self.node.to_dict()
         self.transport.send(self.node.node_id, request["source"], JOIN_ACTION, join)
         respond({"ack": True})
 
     def _on_join(self, sender: str, join: dict, respond) -> None:
         if join.get("address"):
             self._join_addresses[join["source"]] = join["address"]
+        if join.get("node"):
+            self._join_nodes[join["source"]] = join["node"]
         try:
             won_now = self.state.handle_join(join)
         except CoordinationError:
@@ -393,8 +400,12 @@ class Coordinator:
         nodes = dict(base.nodes)
         nodes[self.node.node_id] = self.node
         for voter in sorted(self.state.join_votes):
-            nodes.setdefault(voter, DiscoveryNode(
-                voter, address=self._join_addresses.get(voter, "")))
+            if voter in self._join_nodes:
+                nodes.setdefault(voter,
+                                 DiscoveryNode.from_dict(self._join_nodes[voter]))
+            else:
+                nodes.setdefault(voter, DiscoveryNode(
+                    voter, address=self._join_addresses.get(voter, "")))
         config = self._choose_voting_config(nodes)
         state = base.with_(
             term=self.state.current_term,
@@ -562,8 +573,11 @@ class Coordinator:
             if existing is not None and (not addr or existing.address == addr):
                 return base
             nodes = dict(base.nodes)
-            nodes[node_id] = DiscoveryNode(
-                node_id, address=addr or (existing.address if existing else ""))
+            if node_id in self._join_nodes:
+                nodes[node_id] = DiscoveryNode.from_dict(self._join_nodes[node_id])
+            else:
+                nodes[node_id] = DiscoveryNode(
+                    node_id, address=addr or (existing.address if existing else ""))
             state = base.with_(nodes=nodes,
                                last_accepted_config=self._choose_voting_config(nodes))
             if self.membership_listener is not None:

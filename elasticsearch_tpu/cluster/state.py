@@ -15,14 +15,19 @@ from typing import Any, Dict, FrozenSet, List, Optional, Set
 
 
 class DiscoveryNode:
-    __slots__ = ("node_id", "name", "address", "roles")
+    __slots__ = ("node_id", "name", "address", "roles", "attributes")
 
     def __init__(self, node_id: str, name: str = "", address: str = "",
-                 roles: Optional[Set[str]] = None):
+                 roles: Optional[Set[str]] = None,
+                 attributes: Optional[Dict[str, str]] = None):
         self.node_id = node_id
         self.name = name or node_id
         self.address = address
         self.roles = frozenset(roles or {"master", "data"})
+        # awareness/filter attributes (`DiscoveryNode.getAttributes()`:
+        # node.attr.* settings, e.g. zone/rack), used by the allocation
+        # deciders
+        self.attributes = dict(attributes or {})
 
     @property
     def is_master_eligible(self) -> bool:
@@ -30,12 +35,12 @@ class DiscoveryNode:
 
     def to_dict(self) -> dict:
         return {"id": self.node_id, "name": self.name, "address": self.address,
-                "roles": sorted(self.roles)}
+                "roles": sorted(self.roles), "attributes": self.attributes}
 
     @staticmethod
     def from_dict(d: dict) -> "DiscoveryNode":
         return DiscoveryNode(d["id"], d.get("name", ""), d.get("address", ""),
-                             set(d.get("roles", [])))
+                             set(d.get("roles", [])), d.get("attributes"))
 
     def __eq__(self, other):
         return isinstance(other, DiscoveryNode) and self.node_id == other.node_id
@@ -74,9 +79,15 @@ VotingConfiguration.EMPTY = VotingConfiguration(())
 
 
 class ShardRoutingEntry:
-    """One shard copy's assignment (`cluster/routing/ShardRouting.java`)."""
+    """One shard copy's assignment (`cluster/routing/ShardRouting.java`).
 
-    __slots__ = ("index", "shard", "primary", "node_id", "state", "allocation_id")
+    A rebalance move is modelled as the source entry entering RELOCATING
+    while a fresh INITIALIZING entry (with `relocation_source` = the source's
+    allocation id) recovers on the target node; when the target starts, the
+    source entry is dropped (`ShardRouting.relocatingNodeId` analog)."""
+
+    __slots__ = ("index", "shard", "primary", "node_id", "state",
+                 "allocation_id", "relocation_source")
 
     UNASSIGNED = "UNASSIGNED"
     INITIALIZING = "INITIALIZING"
@@ -84,23 +95,29 @@ class ShardRoutingEntry:
     RELOCATING = "RELOCATING"
 
     def __init__(self, index: str, shard: int, primary: bool,
-                 node_id: Optional[str], state: str, allocation_id: str):
+                 node_id: Optional[str], state: str, allocation_id: str,
+                 relocation_source: Optional[str] = None):
         self.index = index
         self.shard = shard
         self.primary = primary
         self.node_id = node_id
         self.state = state
         self.allocation_id = allocation_id
+        self.relocation_source = relocation_source
 
     def to_dict(self) -> dict:
-        return {"index": self.index, "shard": self.shard, "primary": self.primary,
-                "node": self.node_id, "state": self.state,
-                "allocation_id": self.allocation_id}
+        d = {"index": self.index, "shard": self.shard, "primary": self.primary,
+             "node": self.node_id, "state": self.state,
+             "allocation_id": self.allocation_id}
+        if self.relocation_source is not None:
+            d["relocation_source"] = self.relocation_source
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "ShardRoutingEntry":
         return ShardRoutingEntry(d["index"], d["shard"], d["primary"],
-                                 d.get("node"), d["state"], d["allocation_id"])
+                                 d.get("node"), d["state"], d["allocation_id"],
+                                 d.get("relocation_source"))
 
     def copy(self, **kw) -> "ShardRoutingEntry":
         d = self.to_dict()
@@ -113,7 +130,7 @@ class ClusterState:
 
     __slots__ = ("term", "version", "cluster_name", "master_node_id", "nodes",
                  "metadata", "routing", "last_committed_config",
-                 "last_accepted_config", "in_sync_allocations")
+                 "last_accepted_config", "in_sync_allocations", "settings")
 
     def __init__(self, term: int = 0, version: int = 0,
                  cluster_name: str = "tpu-search",
@@ -123,7 +140,8 @@ class ClusterState:
                  routing: Optional[List[ShardRoutingEntry]] = None,
                  last_committed_config: VotingConfiguration = VotingConfiguration.EMPTY,
                  last_accepted_config: VotingConfiguration = VotingConfiguration.EMPTY,
-                 in_sync_allocations: Optional[Dict[tuple, Set[str]]] = None):
+                 in_sync_allocations: Optional[Dict[tuple, Set[str]]] = None,
+                 settings: Optional[Dict[str, Any]] = None):
         self.term = term
         self.version = version
         self.cluster_name = cluster_name
@@ -134,6 +152,9 @@ class ClusterState:
         self.last_committed_config = last_committed_config
         self.last_accepted_config = last_accepted_config
         self.in_sync_allocations = dict(in_sync_allocations or {})
+        # persistent cluster-wide settings (`MetaData.persistentSettings()`):
+        # cluster.routing.* allocation controls live here
+        self.settings = dict(settings or {})
 
     def with_(self, **kw) -> "ClusterState":
         fields = dict(
@@ -142,7 +163,8 @@ class ClusterState:
             metadata=self.metadata, routing=self.routing,
             last_committed_config=self.last_committed_config,
             last_accepted_config=self.last_accepted_config,
-            in_sync_allocations=self.in_sync_allocations)
+            in_sync_allocations=self.in_sync_allocations,
+            settings=self.settings)
         fields.update(kw)
         return ClusterState(**fields)
 
@@ -177,6 +199,7 @@ class ClusterState:
             "last_accepted_config": sorted(self.last_accepted_config.node_ids),
             "in_sync_allocations": {f"{i}:{s}": sorted(a) for (i, s), a
                                     in self.in_sync_allocations.items()},
+            "settings": self.settings,
         }
 
     @staticmethod
@@ -195,7 +218,8 @@ class ClusterState:
             routing=[ShardRoutingEntry.from_dict(r) for r in d.get("routing", [])],
             last_committed_config=VotingConfiguration(d.get("last_committed_config", [])),
             last_accepted_config=VotingConfiguration(d.get("last_accepted_config", [])),
-            in_sync_allocations=isa)
+            in_sync_allocations=isa,
+            settings=d.get("settings"))
 
     def diff_from(self, previous: "ClusterState") -> dict:
         """Publication diff: full state only where sections changed
@@ -205,7 +229,8 @@ class ClusterState:
         full = self.to_dict()
         prev = previous.to_dict()
         for section in ("nodes", "metadata", "routing", "last_committed_config",
-                        "last_accepted_config", "in_sync_allocations", "cluster_name"):
+                        "last_accepted_config", "in_sync_allocations",
+                        "cluster_name", "settings"):
             if full[section] != prev[section]:
                 d[section] = full[section]
         return d

@@ -1,0 +1,254 @@
+"""Allocation decider chain + weighted balancer unit tests
+(`routing/allocation/decider/*Tests`, `BalancedShardsAllocatorTests` analog)."""
+
+from elasticsearch_tpu.cluster import allocation
+from elasticsearch_tpu.cluster.allocation import (
+    NO, THROTTLE, YES, AllocationContext, AwarenessDecider,
+    DiskThresholdDecider, EnableDecider, FilterDecider, SameShardDecider,
+    ShardsLimitDecider, ThrottlingDecider, decide_allocate, decide_remain,
+)
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, DiscoveryNode, ShardRoutingEntry,
+)
+
+INIT = ShardRoutingEntry.INITIALIZING
+STARTED = ShardRoutingEntry.STARTED
+UNASSIGNED = ShardRoutingEntry.UNASSIGNED
+RELOCATING = ShardRoutingEntry.RELOCATING
+
+
+def mk_state(n_nodes=3, routing=(), settings=None, metadata=None, attrs=None,
+             isa=None):
+    nodes = {}
+    for i in range(n_nodes):
+        nid = f"n{i}"
+        nodes[nid] = DiscoveryNode(nid, attributes=(attrs or {}).get(nid))
+    return ClusterState(nodes=nodes, routing=list(routing),
+                        settings=settings or {},
+                        metadata=metadata or {"idx": {"settings": {}}},
+                        in_sync_allocations=isa or {})
+
+
+def entry(shard=0, primary=False, node=None, state=UNASSIGNED, aid="a1",
+          index="idx", reloc=None):
+    return ShardRoutingEntry(index, shard, primary, node, state, aid, reloc)
+
+
+# ---------------------------------------------------------------- deciders
+
+def test_same_shard_decider():
+    e = entry(aid="new")
+    st = mk_state(routing=[entry(node="n0", state=STARTED, aid="old")])
+    ctx = AllocationContext(st)
+    d = SameShardDecider()
+    assert d.can_allocate(e, "n0", ctx) == NO
+    assert d.can_allocate(e, "n1", ctx) == YES
+
+
+def test_enable_decider():
+    d = EnableDecider()
+    p, r = entry(primary=True), entry(primary=False)
+    ctx = AllocationContext(mk_state(settings={
+        "cluster.routing.allocation.enable": "primaries"}))
+    assert d.can_allocate(p, "n0", ctx) == YES
+    assert d.can_allocate(r, "n0", ctx) == NO
+    ctx = AllocationContext(mk_state(settings={
+        "cluster.routing.allocation.enable": "none"}))
+    assert d.can_allocate(p, "n0", ctx) == NO
+    ctx = AllocationContext(mk_state(settings={
+        "cluster.routing.rebalance.enable": "none"}))
+    assert d.can_rebalance(ctx) == NO
+
+
+def test_filter_decider_cluster_exclude_and_require():
+    d = FilterDecider()
+    attrs = {"n0": {"zone": "a"}, "n1": {"zone": "b"}, "n2": {"zone": "a"}}
+    ctx = AllocationContext(mk_state(
+        attrs=attrs,
+        settings={"cluster.routing.allocation.exclude.zone": "b"}))
+    assert d.can_allocate(entry(), "n1", ctx) == NO
+    assert d.can_allocate(entry(), "n0", ctx) == YES
+    # exclusions drain running shards too
+    assert d.can_remain(entry(node="n1", state=STARTED), "n1", ctx) == NO
+
+    ctx = AllocationContext(mk_state(
+        attrs=attrs,
+        settings={"cluster.routing.allocation.require.zone": "b"}))
+    assert d.can_allocate(entry(), "n1", ctx) == YES
+    assert d.can_allocate(entry(), "n2", ctx) == NO
+
+
+def test_filter_decider_index_level_and_name_wildcard():
+    d = FilterDecider()
+    meta = {"idx": {"settings":
+                    {"index.routing.allocation.exclude._name": "n1*"}}}
+    ctx = AllocationContext(mk_state(metadata=meta))
+    assert d.can_allocate(entry(), "n1", ctx) == NO
+    assert d.can_allocate(entry(), "n0", ctx) == YES
+
+
+def test_disk_threshold_decider():
+    d = DiskThresholdDecider()
+    info = {"n0": {"total_bytes": 100, "free_bytes": 10},   # 90% used
+            "n1": {"total_bytes": 100, "free_bytes": 50}}   # 50% used
+    ctx = AllocationContext(mk_state(), cluster_info=info)
+    assert d.can_allocate(entry(), "n0", ctx) == NO     # above low (85%)
+    assert d.can_allocate(entry(), "n1", ctx) == YES
+    assert d.can_remain(entry(node="n0"), "n0", ctx) == NO   # above high (90%)
+    assert d.can_remain(entry(node="n1"), "n1", ctx) == YES
+    # nodes without disk info are not penalized
+    assert d.can_allocate(entry(), "n2", ctx) == YES
+
+
+def test_throttling_decider():
+    d = ThrottlingDecider()
+    routing = [entry(shard=i, node="n0", state=INIT, aid=f"a{i}")
+               for i in range(2)]
+    ctx = AllocationContext(mk_state(routing=routing))
+    assert d.can_allocate(entry(shard=7, aid="new"), "n0", ctx) == THROTTLE
+    assert d.can_allocate(entry(shard=7, aid="new"), "n1", ctx) == YES
+    # raising the limit unthrottles
+    ctx = AllocationContext(mk_state(routing=routing, settings={
+        "cluster.routing.allocation.node_concurrent_recoveries": 4}))
+    assert d.can_allocate(entry(shard=7, aid="new"), "n0", ctx) == YES
+
+
+def test_awareness_decider_spreads_across_zones():
+    d = AwarenessDecider()
+    attrs = {"n0": {"zone": "a"}, "n1": {"zone": "a"}, "n2": {"zone": "b"}}
+    # primary already in zone a; 2 copies over 2 zones -> cap 1 per zone
+    routing = [entry(primary=True, node="n0", state=STARTED, aid="p")]
+    st = mk_state(attrs=attrs, routing=routing + [entry(aid="rep")],
+                  settings={
+                      "cluster.routing.allocation.awareness.attributes": "zone"})
+    ctx = AllocationContext(st)
+    assert d.can_allocate(entry(aid="rep"), "n1", ctx) == NO   # zone a again
+    assert d.can_allocate(entry(aid="rep"), "n2", ctx) == YES  # zone b
+
+
+def test_shards_limit_decider():
+    d = ShardsLimitDecider()
+    meta = {"idx": {"settings":
+                    {"index.routing.allocation.total_shards_per_node": 1}}}
+    routing = [entry(shard=0, node="n0", state=STARTED, aid="a0")]
+    ctx = AllocationContext(mk_state(routing=routing, metadata=meta))
+    assert d.can_allocate(entry(shard=1, aid="new"), "n0", ctx) == NO
+    assert d.can_allocate(entry(shard=1, aid="new"), "n1", ctx) == YES
+
+
+def test_chain_no_beats_throttle():
+    routing = [entry(shard=i, node="n0", state=INIT, aid=f"a{i}")
+               for i in range(2)] + [entry(shard=7, node="n0", state=STARTED,
+                                           aid="held")]
+    ctx = AllocationContext(mk_state(routing=routing))
+    # same-shard NO wins over throttling THROTTLE on n0
+    assert decide_allocate(entry(shard=7, aid="new"), "n0", ctx) == NO
+
+
+# ---------------------------------------------------------------- reroute
+
+def test_reroute_assigns_new_index_and_balances():
+    st = mk_state(n_nodes=3)
+    st = st.with_(metadata={"idx": {"settings": {
+        "index.number_of_shards": 3, "index.number_of_replicas": 1}}})
+    st = allocation.allocate_new_index(st, "idx", 3, 1)
+    assigned = [r for r in st.routing if r.node_id]
+    per_node = {}
+    for r in assigned:
+        per_node[r.node_id] = per_node.get(r.node_id, 0) + 1
+    assert len(assigned) == 6
+    assert max(per_node.values()) == 2  # perfectly balanced 6 over 3
+
+
+def test_reroute_never_fabricates_lost_primary():
+    # primary was started (in-sync id recorded), then its node died
+    st = mk_state(n_nodes=2, routing=[
+        entry(primary=True, node="n0", state=STARTED, aid="p0")],
+        isa={("idx", 0): {"p0"}})
+    st = allocation.node_left(st, "n0")
+    prim = [r for r in st.routing if r.primary]
+    assert len(prim) == 1 and prim[0].state == UNASSIGNED
+    # repeated reroutes keep it red: the in-sync holder is gone
+    st = allocation.reroute(st)
+    assert [r for r in st.routing if r.primary][0].node_id is None
+    assert st.in_sync_allocations[("idx", 0)] == {"p0"}
+
+
+def test_throttled_allocation_drains_on_shard_started():
+    # 1 node, 4 replicas of distinct shards to allocate, limit 2 at a time
+    st = mk_state(n_nodes=1, metadata={"idx": {"settings": {
+        "index.number_of_shards": 4, "index.number_of_replicas": 0}}})
+    st = allocation.allocate_new_index(st, "idx", 4, 0)
+    init = [r for r in st.routing if r.state == INIT]
+    unassigned = [r for r in st.routing if r.state == UNASSIGNED]
+    assert len(init) == 2 and len(unassigned) == 2  # throttled at 2
+    # completing one recovery frees a slot and reroute picks up the next
+    st = allocation.shard_started(st, init[0].allocation_id)
+    assert sum(1 for r in st.routing if r.state == INIT) == 2
+    assert sum(1 for r in st.routing if r.state == UNASSIGNED) == 1
+
+
+# ---------------------------------------------------------------- rebalance
+
+def test_rebalance_moves_shards_to_new_node():
+    routing = [entry(shard=i, primary=True, node=f"n{i % 2}", state=STARTED,
+                     aid=f"p{i}") for i in range(6)]
+    st = mk_state(n_nodes=3, routing=routing,
+                  metadata={"idx": {"settings":
+                                    {"index.number_of_replicas": 0}}},
+                  isa={("idx", i): {f"p{i}"} for i in range(6)})
+    st = allocation.rebalance(st)
+    moves = [r for r in st.routing if r.relocation_source]
+    assert moves, "no relocation started toward the empty node"
+    assert all(m.node_id == "n2" for m in moves)
+    sources = [r for r in st.routing if r.state == RELOCATING]
+    assert len(sources) == len(moves)
+
+    # completing the move drops the source and hands over the primary flag
+    st2 = allocation.shard_started(st, moves[0].allocation_id)
+    done = next(r for r in st2.routing
+                if r.allocation_id == moves[0].allocation_id)
+    assert done.state == STARTED and done.primary
+    assert all(r.allocation_id != moves[0].relocation_source
+               for r in st2.routing)
+
+
+def test_rebalance_respects_enable_none():
+    routing = [entry(shard=i, primary=True, node="n0", state=STARTED,
+                     aid=f"p{i}") for i in range(4)]
+    st = mk_state(n_nodes=2, routing=routing,
+                  settings={"cluster.routing.rebalance.enable": "none"},
+                  metadata={"idx": {"settings":
+                                    {"index.number_of_replicas": 0}}})
+    st = allocation.rebalance(st)
+    assert not [r for r in st.routing if r.relocation_source]
+
+
+def test_rebalance_canceled_when_target_node_dies():
+    routing = [entry(shard=i, primary=True, node="n0", state=STARTED,
+                     aid=f"p{i}") for i in range(4)]
+    st = mk_state(n_nodes=2, routing=routing,
+                  metadata={"idx": {"settings":
+                                    {"index.number_of_replicas": 0}}},
+                  isa={("idx", i): {f"p{i}"} for i in range(4)})
+    st = allocation.rebalance(st)
+    moves = [r for r in st.routing if r.relocation_source]
+    assert moves and moves[0].node_id == "n1"
+    st = allocation.node_left(st, "n1")
+    # sources revert to STARTED; no RELOCATING orphans remain
+    assert not [r for r in st.routing if r.state == RELOCATING]
+    assert not [r for r in st.routing if r.relocation_source]
+    assert all(r.state == STARTED for r in st.routing if r.primary)
+
+
+def test_high_watermark_drains_node():
+    routing = [entry(shard=0, primary=True, node="n0", state=STARTED, aid="p0")]
+    st = mk_state(n_nodes=2, routing=routing,
+                  metadata={"idx": {"settings":
+                                    {"index.number_of_replicas": 0}}},
+                  isa={("idx", 0): {"p0"}})
+    info = {"n0": {"total_bytes": 100, "free_bytes": 5},
+            "n1": {"total_bytes": 100, "free_bytes": 90}}
+    st = allocation.rebalance(st, cluster_info=info)
+    moves = [r for r in st.routing if r.relocation_source]
+    assert moves and moves[0].node_id == "n1"

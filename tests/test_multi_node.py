@@ -26,6 +26,17 @@ class TestCluster:
         for n in self.nodes.values():
             n.start()
 
+    def add_node(self, nid, tmp_path, attributes=None):
+        """Join a fresh node to the running cluster (node-join event)."""
+        peers = [p for p in self.nodes if p != nid]
+        node = ClusterNode(nid, str(tmp_path / nid), self.transport,
+                           self.queue, seed_peers=peers,
+                           initial_state=self.nodes[peers[0]].cluster_state,
+                           attributes=attributes)
+        self.nodes[nid] = node
+        node.start()
+        return node
+
     def run_until(self, cond, max_ms=120_000, step=200):
         waited = 0
         while waited < max_ms:
@@ -444,3 +455,100 @@ def test_ars_prefers_faster_node(cluster):
     # unknown nodes get probed before measured ones
     copies.append(SRE("i", 0, False, "unknown", SRE.STARTED, "a3"))
     assert node._select_copy(copies, 0).node_id == "unknown"
+
+
+def test_rebalance_on_node_join_moves_shards_and_keeps_data(tmp_path):
+    """A node joining an established cluster attracts shards via the
+    weighted balancer (BalancedShardsAllocator.balance): relocations run
+    real recoveries, hand off, and drop the source copies — with zero data
+    loss and searches green throughout."""
+    c = TestCluster(tmp_path, n_nodes=2, seed=43)
+    assert c.run_until(lambda: c.master() is not None
+                       and len(c.master().cluster_state.nodes) == 2)
+    c.any_node().client_create_index(
+        "reb", settings={"index.number_of_shards": 6,
+                         "index.number_of_replicas": 0},
+        mappings={"properties": {"n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("reb"))
+
+    w = c.any_node()
+    for i in range(30):
+        r = c.call(w.client_write, "reb",
+                   {"type": "index", "id": str(i), "source": {"n": i}})
+        assert r["result"] == "created"
+
+    spare = c.add_node("n9", tmp_path)
+
+    def rebalanced():
+        state = c.any_node().cluster_state
+        shards = state.shards_of("reb")
+        if any(s.state != ShardRoutingEntry.STARTED for s in shards):
+            return False
+        on_spare = sum(1 for s in shards if s.node_id == "n9")
+        return on_spare >= 1 and len(shards) == 6
+
+    assert c.run_until(rebalanced, max_ms=240_000), \
+        f"no shards moved to the new node: " \
+        f"{[s.to_dict() for s in c.any_node().cluster_state.shards_of('reb')]}"
+
+    # per-node shard counts converged (6 over 3 nodes -> 2 each)
+    counts = {}
+    for s in c.any_node().cluster_state.shards_of("reb"):
+        counts[s.node_id] = counts.get(s.node_id, 0) + 1
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    for n in c.nodes.values():
+        n.refresh_all()
+    resp = c.call(c.any_node().client_search, "reb",
+                  {"query": {"match_all": {}}, "size": 50})
+    assert resp["hits"]["total"]["value"] == 30
+    assert resp["_shards"]["failed"] == 0
+
+    for n in c.nodes.values():
+        if not n.coordinator.stopped:
+            n.stop()
+
+
+def test_filter_exclude_drains_node(tmp_path):
+    """cluster.routing.allocation.exclude._name drains a node's shards
+    (FilterAllocationDecider can_remain + the move pass)."""
+    c = TestCluster(tmp_path, n_nodes=3, seed=47)
+    assert c.run_until(lambda: c.master() is not None
+                       and len(c.master().cluster_state.nodes) == 3)
+    c.any_node().client_create_index(
+        "drain", settings={"index.number_of_shards": 3,
+                           "index.number_of_replicas": 0},
+        mappings={"properties": {"n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("drain"))
+
+    w = c.any_node()
+    for i in range(12):
+        c.call(w.client_write, "drain",
+               {"type": "index", "id": str(i), "source": {"n": i}})
+
+    victim = next(nid for nid, n in c.nodes.items()
+                  if any(s.index == "drain"
+                         for s in n.cluster_state.shards_on_node(nid)))
+    r = c.call(c.any_node().client_update_settings,
+               {"cluster.routing.allocation.exclude._name": victim})
+    assert r.get("acknowledged"), r
+
+    def drained():
+        state = c.any_node().cluster_state
+        shards = state.shards_of("drain")
+        return all(s.state == ShardRoutingEntry.STARTED for s in shards) \
+            and not any(s.node_id == victim for s in shards) \
+            and len(shards) == 3
+
+    assert c.run_until(drained, max_ms=240_000), \
+        [s.to_dict() for s in c.any_node().cluster_state.shards_of("drain")]
+
+    for n in c.nodes.values():
+        n.refresh_all()
+    resp = c.call(c.any_node().client_search, "drain",
+                  {"query": {"match_all": {}}, "size": 20})
+    assert resp["hits"]["total"]["value"] == 12
+
+    for n in c.nodes.values():
+        if not n.coordinator.stopped:
+            n.stop()
