@@ -56,6 +56,7 @@ WRITE_PRIMARY = "indices:data/write/primary"
 WRITE_REPLICA = "indices:data/write/replica"
 QUERY_SHARD = "indices:data/read/query"
 FETCH_SHARD = "indices:data/read/fetch"
+CAN_MATCH_SHARD = "indices:data/read/search[can_match]"
 RECOVERY_START = "internal:index/shard/recovery/start_recovery"
 RECOVERY_FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
 MASTER_CREATE_INDEX = "cluster:admin/indices/create"
@@ -107,6 +108,8 @@ class ClusterNode:
         self.scheduler = scheduler
         self.local_shards: Dict[Tuple[str, int], LocalShard] = {}
         self.mappers: Dict[str, MapperService] = {}
+        from elasticsearch_tpu.search.caches import NodeCaches
+        self.caches = NodeCaches()
         node = DiscoveryNode(node_id, address=address, attributes=attributes)
         # durable gateway: term + last-accepted state survive full-cluster
         # restarts (PersistedClusterStateService/GatewayMetaState analog);
@@ -697,11 +700,6 @@ class ClusterNode:
         accumulator and batched agg reduce as they arrive, so coordinator
         memory is independent of size x shards; the fetch phase then
         round-trips only for the global window's rows."""
-        from elasticsearch_tpu.node import _sort_key_tuple
-        from elasticsearch_tpu.search.agg_partials import (
-            finalize_aggs, merge_partial_aggs,
-        )
-
         state = self.cluster_state
         if index not in state.metadata:
             on_done({"error": {"type": "index_not_found_exception",
@@ -725,6 +723,61 @@ class ClusterNode:
                                  "failed": unsearchable}})
             return
 
+        # can_match pre-filter round (CanMatchPreFilterSearchPhase.java:57):
+        # above the threshold, a lightweight range-vs-field-stats RPC prunes
+        # shards that provably cannot match before the query phase fans out
+        prefilter_size = int(body.get("pre_filter_shard_size", 128))
+        if len(targets) > prefilter_size and body.get("query") is not None:
+            self._can_match_phase(
+                index, body, targets,
+                lambda kept, skipped: self._query_phase(
+                    index, body, kept, skipped, num_shards, unsearchable,
+                    on_done))
+        else:
+            self._query_phase(index, body, targets, 0, num_shards,
+                              unsearchable, on_done)
+
+    def _can_match_phase(self, index, body, targets, proceed):
+        flags = {}
+        pending = {"count": len(targets)}
+
+        def finish():
+            kept = [e for e in targets if flags.get(e.shard, True)]
+            skipped = len(targets) - len(kept)
+            if not kept:
+                # keep one shard so the response still carries proper
+                # formatting (reference keeps the first skipped shard)
+                kept, skipped = targets[:1], len(targets) - 1
+            proceed(kept, skipped)
+
+        def one(resp, entry):
+            if isinstance(resp, dict) and "can_match" in resp:
+                flags[entry.shard] = bool(resp["can_match"])
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                finish()
+
+        for entry in targets:
+            req = {"index": index, "shard": entry.shard, "body": body}
+            if entry.node_id == self.node_id:
+                try:
+                    self._on_can_match_shard(
+                        self.node_id, req, lambda r, e=entry: one(r, e))
+                except Exception:
+                    one(None, entry)
+            else:
+                self.transport.send(
+                    self.node_id, entry.node_id, CAN_MATCH_SHARD, req,
+                    on_response=lambda r, e=entry: one(r, e),
+                    on_failure=lambda _err, e=entry: one(None, e))
+
+    def _query_phase(self, index, body, targets, skipped, num_shards,
+                     unsearchable, on_done):
+        from elasticsearch_tpu.node import _sort_key_tuple
+        from elasticsearch_tpu.search.agg_partials import (
+            finalize_aggs, merge_partial_aggs,
+        )
+
         frm = int(body.get("from", 0) or 0)
         size = int(body.get("size", 10) if body.get("size") is not None else 10)
         window = frm + size
@@ -738,7 +791,7 @@ class ClusterNode:
         # node_id) entries + batched partial-agg buffer
         acc = {"top": [], "agg_buffer": [], "aggs": None, "total": 0,
                "relation": "eq", "max_score": None, "failed": 0,
-               "pending": len(targets), "successful": 0}
+               "pending": len(targets), "successful": 0, "skipped": skipped}
 
         def fold_aggs(force=False):
             buf = acc["agg_buffer"]
@@ -806,9 +859,11 @@ class ClusterNode:
         window_entries = acc["top"][frm:]
         out = {
             "took": 0, "timed_out": False,
+            # skipped shards count as successful (SearchResponse: skipped
+            # is a subset of successful)
             "_shards": {"total": num_shards,
-                        "successful": acc["successful"],
-                        "skipped": 0,
+                        "successful": acc["successful"] + acc.get("skipped", 0),
+                        "skipped": acc.get("skipped", 0),
                         "failed": acc["failed"] + unsearchable},
             "hits": {"total": {"value": acc["total"],
                                "relation": acc["relation"]},
@@ -868,20 +923,32 @@ class ClusterNode:
         """QUERY phase only: (row, score, sort) tuples + partial aggs —
         per-shard network payload independent of the fetch weight
         (QuerySearchResult analog); _source travels in the fetch phase."""
+        from elasticsearch_tpu.search.caches import RequestCache
+
         key = (request["index"], request["shard"])
         local = self.local_shards.get(key)
         if local is None:
             raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
         body = request["body"]
         reader = local.engine.acquire_searcher()
+        # shard request cache: whole serialized query-phase responses for
+        # size=0 requests, keyed on reader generation (IndicesRequestCache)
+        cache_key = None
+        if RequestCache.cacheable(body):
+            cache_key = self.caches.request.key(key, reader.gen, body)
+            cached = self.caches.request.get(cache_key)
+            if cached is not None:
+                respond(cached)
+                return
         # aggs leave the shard as mergeable partial states (HLL/t-digest/
         # sum-count pairs); the coordinator reduce finalizes them
         # (InternalAggregation.reduce analog)
         result = execute_query_phase(reader, local.mapper_service, body,
                                      shard_id=request["shard"],
                                      vector_store=local.vector_store,
-                                     partial_aggs=True)
-        respond({
+                                     partial_aggs=True,
+                                     query_cache=self.caches.query)
+        response = {
             "shard": request["shard"],
             "total": result.total_hits,
             "relation": result.total_relation,
@@ -891,7 +958,24 @@ class ClusterNode:
             "sort_values": [list(sv) for sv in result.sort_values]
             if result.sort_values is not None else None,
             "aggregations": result.aggregations,
-        })
+        }
+        if cache_key is not None:
+            self.caches.request.put(cache_key, response)
+        respond(response)
+
+    def _on_can_match_shard(self, sender, request, respond):
+        """Lightweight pre-filter: range-vs-field-stats only, no query
+        execution (SearchService#canMatch)."""
+        from elasticsearch_tpu.search.caches import can_match
+
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None:
+            raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        reader = local.engine.acquire_searcher()
+        respond({"shard": request["shard"],
+                 "can_match": can_match(reader, local.mapper_service,
+                                        request["body"])})
 
     def _on_fetch_shard(self, sender, request, respond):
         """FETCH phase: materialize hits for the coordinator's global
@@ -966,6 +1050,7 @@ class ClusterNode:
         t.register(me, WRITE_REPLICA, self._on_write_replica)
         t.register(me, QUERY_SHARD, self._on_query_shard)
         t.register(me, FETCH_SHARD, self._on_fetch_shard)
+        t.register(me, CAN_MATCH_SHARD, self._on_can_match_shard)
         t.register(me, "indices:data/read/get", self._on_get)
         t.register(me, "indices:admin/refresh", self._on_refresh)
         t.register(me, RECOVERY_START, self._on_recovery_start)

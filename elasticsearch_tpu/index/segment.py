@@ -20,6 +20,7 @@ segments + tombstone bitmaps (the analog of acquiring an IndexSearcher).
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -213,16 +214,22 @@ class SegmentView:
         return int(self.live.sum())
 
 
+_reader_gen = itertools.count(1)
+
+
 class ShardReader:
     """Point-in-time searcher view over sealed segments.
 
     The analog of the reference engine's `acquireSearcher`
     (`InternalEngine.java` / `ContextIndexSearcher.java:73`): immutable
     snapshot; concurrent writes/deletes after acquisition are invisible.
+    `gen` identifies the view for cache keys (request/query caches key on
+    it, so a refresh that produced a new reader invalidates implicitly).
     """
 
     def __init__(self, views: List[SegmentView]):
         self.views = views
+        self.gen = next(_reader_gen)
 
     @property
     def num_docs(self) -> int:

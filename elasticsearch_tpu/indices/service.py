@@ -126,7 +126,15 @@ class IndexService:
         Single-node aggregation scope: cross-shard aggs run over this merged
         view (the distributed layer replaces this with per-shard partials +
         coordinator reduce, `SearchPhaseController.reduceAggs`).
+
+        Memoized on the underlying per-shard reader generations: repeated
+        searches between refreshes see the SAME reader object (and gen),
+        which is what keys the request/query caches and the per-reader
+        field-stats cache.
         """
+        gens = tuple(s.engine.acquire_searcher().gen for s in self.shards)
+        if getattr(self, "_combined_gens", None) == gens:
+            return self._combined_reader
         views = []
         for s in self.shards:
             offset = s.shard_id * SHARD_ROW_SPACE
@@ -137,7 +145,10 @@ class IndexService:
                 v2.segment = seg
                 v2.live = view.live
                 views.append(v2)
-        return ShardReader(views)
+        reader = ShardReader(views)
+        self._combined_reader = reader
+        self._combined_gens = gens
+        return reader
 
     def shard_of_row(self, row: int) -> IndexShardHandle:
         return self.shards[row // SHARD_ROW_SPACE]

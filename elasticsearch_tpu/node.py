@@ -112,7 +112,11 @@ class Node:
         self.ccr = CcrService(self)
         from elasticsearch_tpu.common.breakers import HierarchyCircuitBreakerService
         from elasticsearch_tpu.monitor import SlowLog
+        from elasticsearch_tpu.search.caches import NodeCaches
         self.breakers = HierarchyCircuitBreakerService()
+        # shard request cache + node query cache (IndicesRequestCache /
+        # IndicesQueryCache analogs), shared across this node's shards
+        self.caches = NodeCaches()
         self.search_slow_log = SlowLog("search")
         self.indexing_slow_log = SlowLog("indexing")
         self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
@@ -476,9 +480,24 @@ class Node:
         try:
             for svc, reader, store in readers:
                 q_start = time.perf_counter_ns()
-                result = execute_query_phase(reader, svc.mapper_service, body,
-                                             vector_store=store,
-                                             partial_aggs=use_partial_aggs)
+                # shard request cache: size=0 (aggs/count) responses keyed on
+                # the reader generation — a refresh invalidates implicitly
+                from elasticsearch_tpu.search.caches import RequestCache
+                cache_key = None
+                result = None
+                if RequestCache.cacheable(body):
+                    # partial vs finalized agg trees differ per request shape
+                    # (multi-index searches ship partials): key on it
+                    cache_key = self.caches.request.key(
+                        (svc.name, use_partial_aggs), reader.gen, body)
+                    result = self.caches.request.get(cache_key)
+                if result is None:
+                    result = execute_query_phase(
+                        reader, svc.mapper_service, body, vector_store=store,
+                        partial_aggs=use_partial_aggs,
+                        query_cache=self.caches.query)
+                    if cache_key is not None:
+                        self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
                 total += result.total_hits
                 if result.total_relation == "gte":

@@ -379,7 +379,15 @@ def register_all(rc: RestController, node: Node) -> None:
                                      "search": {"query_total":
                                                 node.counters.get("search", 0)},
                                      "indexing": {"index_total":
-                                                  node.counters.get("index", 0)}},
+                                                  node.counters.get("index", 0)},
+                                     "request_cache": {
+                                         "hit_count": node.caches.request.hits,
+                                         "miss_count": node.caches.request.misses,
+                                         "evictions": node.caches.request.evictions},
+                                     "query_cache": {
+                                         "hit_count": node.caches.query.hits,
+                                         "miss_count": node.caches.query.misses,
+                                         "evictions": node.caches.query.evictions}},
                          "breakers": node.breakers.stats(),
                          "thread_pool": {name: {"threads": 0, "queue": 0,
                                                 "active": 0, "rejected": 0,

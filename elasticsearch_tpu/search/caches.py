@@ -1,0 +1,237 @@
+"""Search-path caches + can_match shard pre-filtering.
+
+Re-design of the reference's three search accelerators (SURVEY.md §2.5):
+
+- **Shard request cache** (`indices/IndicesRequestCache.java`): caches whole
+  shard-level query-phase results, keyed on the request body. Like the
+  reference, only hits-free requests (size=0: aggs/counts) are cacheable by
+  default — full hit payloads are cheap to recompute and expensive to hold —
+  and an explicit `request_cache=true` opts in. Entries key on the reader
+  generation, so a refresh that actually changed the shard naturally
+  invalidates (the reference invalidates by reader identity the same way).
+- **Node query cache** (`indices/IndicesQueryCache.java`): caches filter-
+  context DocSet row arrays keyed (reader generation, filter source).
+  Filters are score-free, so a cached row array is exact; scoring clauses
+  are never cached (same as Lucene's UsageTrackingQueryCachingPolicy caching
+  only filters).
+- **can_match** (`CanMatchPreFilterSearchPhase.java:57`): a lightweight
+  per-shard test — do the query's range constraints overlap the shard's
+  field min/max? — that lets the coordinator skip shards before the query
+  phase fans out.
+
+Caches are node-level singletons shared by all shards (the reference sizes
+them as a fraction of heap; here entry-count LRU bounds them).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class LruCache:
+    """Entry-count-bounded LRU with hit/miss/eviction stats."""
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = max_entries
+        self._map: "OrderedDict[Any, Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        try:
+            value = self._map[key]
+        except KeyError:
+            self.misses += 1
+            return None
+        self._map.move_to_end(key)
+        self.hits += 1
+        return value
+
+    def put(self, key, value) -> None:
+        self._map[key] = value
+        self._map.move_to_end(key)
+        while len(self._map) > self.max_entries:
+            self._map.popitem(last=False)
+            self.evictions += 1
+
+    def clear(self) -> None:
+        self._map.clear()
+
+    def __len__(self):
+        return len(self._map)
+
+    def stats(self) -> dict:
+        return {"entries": len(self._map), "hits": self.hits,
+                "misses": self.misses, "evictions": self.evictions}
+
+
+def _canonical(body: Any) -> str:
+    return json.dumps(body, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+class RequestCache(LruCache):
+    """Shard request cache: (shard key, reader gen, body) -> query result.
+
+    `cacheable(body)` mirrors `IndicesRequestCache` policy: size==0 requests
+    cache by default; `request_cache` in the body forces either way; requests
+    with non-deterministic parts (scripts, "now"-relative ranges) never cache.
+    """
+
+    @staticmethod
+    def cacheable(body: dict) -> bool:
+        flag = body.get("request_cache")
+        if flag is False:
+            return False
+        src = _canonical(body)
+        if '"script' in src or '"now' in src.lower():
+            return False
+        if flag is True:
+            return True
+        size = body.get("size", None)
+        return size == 0
+
+    def key(self, shard_key: Any, reader_gen: int, body: dict) -> tuple:
+        return (shard_key, reader_gen, _canonical(
+            {k: v for k, v in body.items() if k != "request_cache"}))
+
+
+class QueryCache(LruCache):
+    """Node query cache: (reader gen, filter source) -> matching row array."""
+
+    def get_rows(self, reader_gen: int, filter_source: str) -> Optional[np.ndarray]:
+        return self.get((reader_gen, filter_source))
+
+    def put_rows(self, reader_gen: int, filter_source: str,
+                 rows: np.ndarray) -> None:
+        self.put((reader_gen, filter_source), rows)
+
+
+# ---------------------------------------------------------------------------
+# can_match
+# ---------------------------------------------------------------------------
+
+def _iter_range_clauses(query: Optional[dict]):
+    """Yield (field, spec) for every range clause that constrains the whole
+    query (top-level range, or range inside bool.must / bool.filter — a
+    `should` range does not constrain, matching the conservative skipping in
+    the reference's coordinator rewrite)."""
+    if not isinstance(query, dict):
+        return
+    for kind, spec in query.items():
+        if kind == "range" and isinstance(spec, dict):
+            for field, bounds in spec.items():
+                if isinstance(bounds, dict):
+                    yield field, bounds
+        elif kind == "bool" and isinstance(spec, dict):
+            for clause in ("must", "filter"):
+                items = spec.get(clause, [])
+                if isinstance(items, dict):
+                    items = [items]
+                for sub in items:
+                    yield from _iter_range_clauses(sub)
+        elif kind == "constant_score" and isinstance(spec, dict):
+            yield from _iter_range_clauses(spec.get("filter"))
+
+
+def _to_number(value, mapper_service, field) -> Optional[float]:
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, str):
+        mapper = mapper_service.get(field) if mapper_service else None
+        type_name = getattr(mapper, "type_name", None)
+        if type_name == "date":
+            try:
+                from elasticsearch_tpu.index.mapping import parse_date_millis
+                return float(parse_date_millis(value))
+            except Exception:
+                return None
+        try:
+            return float(value)
+        except ValueError:
+            return None
+    return None
+
+
+def can_match(reader, mapper_service, body: dict) -> bool:
+    """True unless a must/filter range clause provably excludes every live
+    doc in this shard (field max < gte, or field min > lte). Conservative:
+    anything unparseable means "might match"."""
+    query = body.get("query")
+    for field, bounds in _iter_range_clauses(query):
+        stats = field_stats(reader, field)
+        if stats is None:
+            # field absent from the shard entirely: a required range on it
+            # cannot match any doc
+            if reader.num_docs > 0 and not _shard_has_field(reader, field):
+                return False
+            continue
+        fmin, fmax = stats
+        gte = _to_number(bounds.get("gte", bounds.get("gt")), mapper_service, field)
+        lte = _to_number(bounds.get("lte", bounds.get("lt")), mapper_service, field)
+        if gte is not None:
+            if "gt" in bounds and "gte" not in bounds:
+                if fmax <= gte:
+                    return False
+            elif fmax < gte:
+                return False
+        if lte is not None:
+            if "lt" in bounds and "lte" not in bounds:
+                if fmin >= lte:
+                    return False
+            elif fmin > lte:
+                return False
+    return True
+
+
+def _shard_has_field(reader, field: str) -> bool:
+    for v in reader.views:
+        if field in v.segment.doc_values or field in v.segment.postings:
+            return True
+    return False
+
+
+def field_stats(reader, field: str) -> Optional[Tuple[float, float]]:
+    """(min, max) of a numeric/date field over live docs, cached per reader
+    (the per-shard PointValues min/max the reference's can_match reads)."""
+    cache: Dict[str, Optional[Tuple[float, float]]] = getattr(
+        reader, "_field_stats_cache", None)
+    if cache is None:
+        cache = reader._field_stats_cache = {}
+    if field in cache:
+        return cache[field]
+    fmin = fmax = None
+    for v in reader.views:
+        col = v.segment.doc_values.get(field)
+        if col is None or col.numeric is None:
+            continue
+        mask = v.live & col.present
+        if not mask.any():
+            continue
+        vals = col.numeric[mask]
+        lo, hi = float(vals.min()), float(vals.max())
+        fmin = lo if fmin is None else min(fmin, lo)
+        fmax = hi if fmax is None else max(fmax, hi)
+    result = None if fmin is None else (fmin, fmax)
+    cache[field] = result
+    return result
+
+
+class NodeCaches:
+    """Node-level cache singleton pair (the reference wires both caches into
+    IndicesService and shares them across shards)."""
+
+    def __init__(self, request_entries: int = 1024, query_entries: int = 2048):
+        self.request = RequestCache(request_entries)
+        self.query = QueryCache(query_entries)
+
+    def stats(self) -> dict:
+        return {"request_cache": self.request.stats(),
+                "query_cache": self.query.stats()}

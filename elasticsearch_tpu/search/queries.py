@@ -64,10 +64,14 @@ class DocSet:
 class SearchContext:
     """Per-shard execution context (reference: SearchContext/QueryShardContext)."""
 
-    def __init__(self, reader: ShardReader, mapper_service: MapperService):
+    def __init__(self, reader: ShardReader, mapper_service: MapperService,
+                 query_cache=None):
         self.reader = reader
         self.mapper_service = mapper_service
         self._all_rows: Optional[np.ndarray] = None
+        # node query cache (search/caches.py): filter-context row arrays
+        # keyed on (reader gen, filter source); None disables caching
+        self.query_cache = query_cache
 
     def all_rows(self) -> np.ndarray:
         if self._all_rows is None:
@@ -949,6 +953,28 @@ def _combine_max(sets: List[DocSet]) -> DocSet:
     return DocSet(rows, scores)
 
 
+def _cached_filter_rows(ctx: SearchContext, q: Query) -> np.ndarray:
+    """Filter-context execution through the node query cache: filters never
+    score, so the row array alone is the full result (Lucene caches filter
+    bitsets the same way; scoring clauses are never cached)."""
+    cache = ctx.query_cache
+    if cache is None:
+        return q.execute(ctx).rows
+    try:
+        import json
+        source = json.dumps(q.to_dict(), sort_keys=True, default=str)
+    except Exception:
+        return q.execute(ctx).rows
+    gen = getattr(ctx.reader, "gen", None)
+    if gen is None:
+        return q.execute(ctx).rows
+    rows = cache.get_rows(gen, source)
+    if rows is None:
+        rows = q.execute(ctx).rows
+        cache.put_rows(gen, source, rows)
+    return rows
+
+
 class BoolQuery(Query):
     """must/filter/should/must_not with reference semantics
     (`index/query/BoolQueryBuilder.java`): filter and must_not never score;
@@ -979,12 +1005,12 @@ class BoolQuery(Query):
                 scores = scores[i1] + s.scores[i2]
 
         for q in self.filter:
-            s = q.execute(ctx)
+            f_rows = _cached_filter_rows(ctx, q)
             if rows is None:
-                rows = s.rows
+                rows = f_rows
                 scores = np.zeros(len(rows), dtype=np.float32)
             else:
-                i1, _ = native.intersect_sorted(rows, s.rows)
+                i1, _ = native.intersect_sorted(rows, f_rows)
                 rows = rows[i1]
                 scores = scores[i1]
 

@@ -58,8 +58,9 @@ class ShardSearchResult:
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         body: dict, shard_id: int = 0,
                         vector_store=None,
-                        partial_aggs: bool = False) -> ShardSearchResult:
-    ctx = SearchContext(reader, mapper_service)
+                        partial_aggs: bool = False,
+                        query_cache=None) -> ShardSearchResult:
+    ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()

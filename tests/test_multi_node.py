@@ -552,3 +552,83 @@ def test_filter_exclude_drains_node(tmp_path):
     for n in c.nodes.values():
         if not n.coordinator.stopped:
             n.stop()
+
+
+def test_can_match_prefilter_skips_shards(cluster):
+    """Range searches skip shards whose field stats cannot match
+    (CanMatchPreFilterSearchPhase.java:57): docs are laid out so each
+    shard holds a disjoint n-range, then a narrow range query with
+    pre_filter_shard_size=1 must skip the other shards."""
+    from elasticsearch_tpu.cluster.routing import shard_id_for
+
+    c = cluster
+    c.any_node().client_create_index(
+        "pref", settings={"index.number_of_shards": 3,
+                          "index.number_of_replicas": 0},
+        mappings={"properties": {"n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("pref"))
+
+    # give each shard a disjoint value range: n = shard*1000 + i
+    w = c.any_node()
+    per_shard = {0: 0, 1: 0, 2: 0}
+    for i in range(60):
+        sid = shard_id_for(str(i), 3)
+        n = sid * 1000 + per_shard[sid]
+        per_shard[sid] += 1
+        r = c.call(w.client_write, "pref",
+                   {"type": "index", "id": str(i), "source": {"n": n}})
+        assert r["result"] == "created"
+    for node in c.nodes.values():
+        node.refresh_all()
+
+    # range hits only shard 1's [1000,2000) band
+    resp = c.call(c.any_node().client_search, "pref",
+                  {"query": {"range": {"n": {"gte": 1000, "lt": 2000}}},
+                   "size": 30, "pre_filter_shard_size": 1})
+    assert resp["_shards"]["skipped"] == 2, resp["_shards"]
+    assert resp["_shards"]["failed"] == 0
+    assert resp["hits"]["total"]["value"] == per_shard[1]
+    assert all(1000 <= h["_source"]["n"] < 2000
+               for h in resp["hits"]["hits"])
+
+    # without the pre-filter param the same search returns the same hits,
+    # no skipping (threshold defaults to 128)
+    resp2 = c.call(c.any_node().client_search, "pref",
+                   {"query": {"range": {"n": {"gte": 1000, "lt": 2000}}},
+                    "size": 30})
+    assert resp2["_shards"]["skipped"] == 0
+    assert resp2["hits"]["total"]["value"] == per_shard[1]
+
+
+def test_request_cache_serves_agg_search(cluster):
+    """size=0 agg searches are served from the shard request cache on
+    repeat, and a refresh after new writes invalidates (reader gen key)."""
+    c = cluster
+    c.any_node().client_create_index(
+        "rc", settings={"index.number_of_shards": 2,
+                        "index.number_of_replicas": 0},
+        mappings={"properties": {"n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("rc"))
+    w = c.any_node()
+    for i in range(10):
+        c.call(w.client_write, "rc",
+               {"type": "index", "id": str(i), "source": {"n": i}})
+    for node in c.nodes.values():
+        node.refresh_all()
+
+    body = {"size": 0, "aggs": {"s": {"sum": {"field": "n"}}}}
+    r1 = c.call(c.any_node().client_search, "rc", dict(body))
+    assert r1["aggregations"]["s"]["value"] == sum(range(10))
+    hits_before = sum(n.caches.request.hits for n in c.nodes.values())
+    r2 = c.call(c.any_node().client_search, "rc", dict(body))
+    assert r2["aggregations"]["s"]["value"] == sum(range(10))
+    hits_after = sum(n.caches.request.hits for n in c.nodes.values())
+    assert hits_after > hits_before, "second agg search did not hit the cache"
+
+    # new data + refresh -> fresh result, not the stale cached one
+    c.call(w.client_write, "rc", {"type": "index", "id": "x",
+                                  "source": {"n": 100}})
+    for node in c.nodes.values():
+        node.refresh_all()
+    r3 = c.call(c.any_node().client_search, "rc", dict(body))
+    assert r3["aggregations"]["s"]["value"] == sum(range(10)) + 100

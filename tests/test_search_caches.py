@@ -1,0 +1,135 @@
+"""Shard request cache, node query cache, can_match pre-filter
+(IndicesRequestCache / IndicesQueryCache / CanMatchPreFilterSearchPhase
+analogs)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.analysis import AnalysisRegistry
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.search.caches import (
+    NodeCaches, QueryCache, RequestCache, can_match, field_stats,
+)
+from elasticsearch_tpu.search.service import execute_query_phase
+
+MAPPINGS = {"properties": {"n": {"type": "long"},
+                           "title": {"type": "text"},
+                           "tag": {"type": "keyword"}}}
+
+
+@pytest.fixture
+def engine(tmp_path):
+    mapper = MapperService(MAPPINGS, registry=AnalysisRegistry())
+    eng = Engine(str(tmp_path / "s0"), mapper, translog_sync="async")
+    for i in range(20):
+        eng.index(str(i), {"n": i, "title": f"doc {i}",
+                           "tag": "even" if i % 2 == 0 else "odd"})
+    eng.refresh()
+    yield eng, mapper
+    eng.close()
+
+
+def test_request_cache_policy():
+    assert RequestCache.cacheable({"size": 0, "aggs": {"a": {"avg": {"field": "n"}}}})
+    assert not RequestCache.cacheable({"size": 10})
+    assert not RequestCache.cacheable({})  # default size=10
+    assert RequestCache.cacheable({"size": 10, "request_cache": True})
+    assert not RequestCache.cacheable({"size": 0, "request_cache": False})
+    # non-deterministic requests never cache
+    assert not RequestCache.cacheable(
+        {"size": 0, "query": {"range": {"d": {"gte": "now-1d"}}}})
+    assert not RequestCache.cacheable(
+        {"size": 0, "query": {"script_score": {"script": "x"}}})
+
+
+def test_request_cache_hit_and_reader_gen_invalidation(engine):
+    eng, mapper = engine
+    caches = NodeCaches()
+    body = {"size": 0, "aggs": {"s": {"sum": {"field": "n"}}}}
+    reader = eng.acquire_searcher()
+    key = caches.request.key("idx", reader.gen, body)
+    assert caches.request.get(key) is None
+    result = execute_query_phase(reader, mapper, body)
+    caches.request.put(key, result)
+    assert caches.request.get(key) is result
+    assert caches.request.hits == 1
+
+    # a refresh that changed the shard produces a new reader gen -> miss
+    eng.index("new", {"n": 100, "title": "doc new", "tag": "odd"})
+    reader2 = eng.refresh()
+    assert reader2.gen != reader.gen
+    assert caches.request.get(caches.request.key("idx", reader2.gen, body)) is None
+
+
+def test_request_cache_key_order_insensitive():
+    rc = RequestCache()
+    k1 = rc.key("idx", 1, {"aggs": {"a": 1}, "size": 0})
+    k2 = rc.key("idx", 1, {"size": 0, "aggs": {"a": 1}})
+    assert k1 == k2
+    # request_cache flag itself is not part of the key
+    k3 = rc.key("idx", 1, {"size": 0, "aggs": {"a": 1}, "request_cache": True})
+    assert k1 == k3
+
+
+def test_query_cache_caches_filter_rows(engine):
+    eng, mapper = engine
+    cache = QueryCache()
+    reader = eng.acquire_searcher()
+    body = {"query": {"bool": {"filter": [{"term": {"tag": "even"}}],
+                               "must": [{"match": {"title": "doc"}}]}},
+            "size": 20}
+    r1 = execute_query_phase(reader, mapper, body, query_cache=cache)
+    assert cache.misses >= 1 and cache.hits == 0
+    r2 = execute_query_phase(reader, mapper, body, query_cache=cache)
+    assert cache.hits >= 1
+    assert np.array_equal(r1.rows, r2.rows)
+    assert r1.total_hits == r2.total_hits == 10
+
+
+def test_query_cache_lru_eviction():
+    c = QueryCache(max_entries=2)
+    c.put_rows(1, "a", np.array([1]))
+    c.put_rows(1, "b", np.array([2]))
+    c.put_rows(1, "c", np.array([3]))
+    assert c.evictions == 1
+    assert c.get_rows(1, "a") is None  # oldest evicted
+
+
+# ---------------------------------------------------------------- can_match
+
+def test_field_stats(engine):
+    eng, mapper = engine
+    reader = eng.acquire_searcher()
+    assert field_stats(reader, "n") == (0.0, 19.0)
+    assert field_stats(reader, "absent") is None
+    # deletes narrow the live range
+    eng.delete("19")
+    reader2 = eng.refresh()
+    assert field_stats(reader2, "n") == (0.0, 18.0)
+
+
+def test_can_match_range_pruning(engine):
+    eng, mapper = engine
+    reader = eng.acquire_searcher()
+    hit = {"query": {"range": {"n": {"gte": 5, "lte": 10}}}}
+    miss_above = {"query": {"range": {"n": {"gte": 100}}}}
+    miss_below = {"query": {"range": {"n": {"lt": 0}}}}
+    boundary = {"query": {"range": {"n": {"gte": 19}}}}
+    gt_boundary = {"query": {"range": {"n": {"gt": 19}}}}
+    assert can_match(reader, mapper, hit)
+    assert not can_match(reader, mapper, miss_above)
+    assert not can_match(reader, mapper, miss_below)
+    assert can_match(reader, mapper, boundary)
+    assert not can_match(reader, mapper, gt_boundary)
+    # ranges under bool.filter constrain too
+    assert not can_match(reader, mapper, {"query": {"bool": {"filter": [
+        {"range": {"n": {"gte": 100}}}]}}})
+    # should-clause ranges do NOT constrain (conservative)
+    assert can_match(reader, mapper, {"query": {"bool": {"should": [
+        {"range": {"n": {"gte": 100}}}]}}})
+    # no range at all -> always might match
+    assert can_match(reader, mapper, {"query": {"match_all": {}}})
+    # a required range on a field this shard has never seen cannot match
+    assert not can_match(reader, mapper,
+                         {"query": {"range": {"absent": {"gte": 1}}}})
