@@ -157,3 +157,23 @@ def _validate_setting_name(name: str) -> None:
         raise IllegalArgumentError(
             f"invalid setting name [{name}]: only alphanumerics, '.', '_' "
             f"and '-' are allowed")
+
+
+def load_node_keystore(settings: dict, data_path: str):
+    """Resolve and load the node keystore by the standard conventions
+    (path.keystore setting, else <data>/config/tpu_search.keystore;
+    password from keystore.password setting or $KEYSTORE_PASSWORD).
+
+    Returns None when no keystore file exists. Raises on load failure
+    (wrong password, corrupt file): security configuration must fail
+    CLOSED — booting without the secrets the operator stored would
+    silently disable whatever they protect.
+    """
+    import os
+    path = settings.get("path.keystore",
+                        os.path.join(data_path, "config",
+                                     "tpu_search.keystore"))
+    if not os.path.exists(path):
+        return None
+    return KeyStore.load(path, str(settings.get(
+        "keystore.password", os.environ.get("KEYSTORE_PASSWORD", ""))))

@@ -131,16 +131,9 @@ class Node:
         # without overriding explicit settings, before any service reads
         # them (reference: KeyStoreWrapper loaded in Bootstrap, exposed via
         # Settings#getSecureSettings)
-        self.keystore = None
-        ks_path = self.settings.get(
-            "path.keystore", _os.path.join(data_path, "config",
-                                           "tpu_search.keystore"))
-        if _os.path.exists(ks_path):
-            from elasticsearch_tpu.common.keystore import KeyStore
-            self.keystore = KeyStore.load(
-                ks_path, str(self.settings.get("keystore.password",
-                                               _os.environ.get(
-                                                   "KEYSTORE_PASSWORD", ""))))
+        from elasticsearch_tpu.common.keystore import load_node_keystore
+        self.keystore = load_node_keystore(self.settings, data_path)
+        if self.keystore is not None:
             for name, value in self.keystore.as_settings().items():
                 self.settings.setdefault(name, value)
         from elasticsearch_tpu.security import SecurityService, SecurityStore

@@ -13,7 +13,27 @@ import signal
 import sys
 
 
+def _honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS from the environment actually stick.
+
+    The experimental axon TPU plugin force-sets `jax_platforms="axon,cpu"`
+    at import, overriding the environment variable; a CPU-only deployment
+    (or CI) would then block on TPU tunnel initialization at the first
+    device query. Apply the operator's env choice through jax.config BEFORE
+    any backend touch — harmless when unset (TPU stays the default)."""
+    import os
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # backend already initialized or jax absent: leave as-is
+
+
 def main(argv=None) -> int:
+    _honor_jax_platforms_env()
     parser = argparse.ArgumentParser(prog="elasticsearch-tpu")
     parser.add_argument("--port", type=int, default=9200)
     parser.add_argument("--host", default="127.0.0.1")
@@ -116,11 +136,36 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
               "discovery.seed_hosts", file=sys.stderr)
         return 78
 
+    # transport TLS + inter-node auth from settings/keystore
+    # (xpack.security.transport.ssl analog; key material is secure settings)
+    from elasticsearch_tpu.transport.tls import TlsConfig, TransportAuth
+    try:
+        tls = TlsConfig.from_settings(settings)
+    except Exception as e:
+        print(f"transport TLS misconfigured: {e}", file=sys.stderr)
+        return 78
+    auth = None
+    auth_key = settings.get("cluster.auth.key")
+    if not auth_key:
+        # fail CLOSED on keystore errors: a wrong password must not boot
+        # the node with transport auth silently disabled
+        from elasticsearch_tpu.common.keystore import load_node_keystore
+        try:
+            ks = load_node_keystore(settings, args.data)
+        except Exception as e:
+            print(f"keystore load failed: {e}", file=sys.stderr)
+            return 78
+        if ks is not None:
+            auth_key = ks.get("cluster.auth.key")
+    if auth_key:
+        auth = TransportAuth(str(auth_key).encode("utf-8"))
+
     async def run():
         loop = asyncio.get_running_loop()
         scheduler = AsyncioScheduler(loop)
         transport = TcpTransportService(node_id, host=args.host,
-                                        port=transport_port)
+                                        port=transport_port,
+                                        tls=tls, auth=auth)
         host, port = await transport.bind()
         address = f"{host}:{port}"
         print(f"[{node_id}] transport bound on {address}", flush=True)

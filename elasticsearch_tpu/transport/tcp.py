@@ -123,10 +123,15 @@ class TcpTransportService:
     def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0,
                  *, loop: Optional[asyncio.AbstractEventLoop] = None,
                  keepalive_interval_ms: int = 15_000,
-                 default_timeout_ms: Optional[int] = 30_000):
+                 default_timeout_ms: Optional[int] = 30_000,
+                 tls=None, auth=None):
         self.node_id = node_id
         self.host = host
         self.port = port  # 0 = ephemeral; real port known after bind()
+        # TLS on the inter-node socket + per-envelope signed authn context
+        # (transport/tls.py; SecurityServerTransportInterceptor.java:50)
+        self.tls = tls
+        self.auth = auth
         self.loop = loop or asyncio.get_event_loop()
         self.keepalive_interval_ms = keepalive_interval_ms
         self.default_timeout_ms = default_timeout_ms
@@ -150,7 +155,8 @@ class TcpTransportService:
     async def bind(self) -> Tuple[str, int]:
         """Bind the server socket (reference `TcpTransport.java:376,648`)."""
         self._server = await asyncio.start_server(
-            self._accept, self.host, self.port)
+            self._accept, self.host, self.port,
+            ssl=self.tls.server_context() if self.tls else None)
         self.port = self._server.sockets[0].getsockname()[1]
         self._keepalive_task = self.loop.create_task(self._keepalive_pump())
         return self.host, self.port
@@ -175,11 +181,19 @@ class TcpTransportService:
         for rid in list(self._pending):
             self._fail_pending(rid, ConnectTransportError("transport closed"))
 
+    def _client_connect(self, host: str, port: int):
+        if self.tls is None:
+            return asyncio.open_connection(host, port)
+        return asyncio.open_connection(
+            host, port, ssl=self.tls.client_context(),
+            server_hostname=host if self.tls.verification_mode == "full"
+            else None)
+
     async def probe_address(self, host: str, port: int) -> str:
         """Seed-host discovery (PeerFinder/SeedHostsResolver analog): dial a
         bare host:port, handshake to learn the peer's node id, record the
         address mapping, close the probe channel. Returns the node id."""
-        reader, writer = await asyncio.open_connection(host, port)
+        reader, writer = await self._client_connect(host, port)
         channel = _Channel(reader, writer)
         pump = self.loop.create_task(self._read_pump(channel))
         self._pumps.append(pump)
@@ -283,8 +297,16 @@ class TcpTransportService:
                 timeout_ms / 1000.0, self._on_request_timeout, rid, target)
         self._pending[rid] = (on_response, on_failure, timeout_handle, action)
         channel.pending_rids.add(rid)
+        envelope = {"sender": self.node_id, "request": request}
+        if self.auth is not None:
+            # authn context propagates with the RPC and is validated before
+            # dispatch on the receiver (SecurityServerTransportInterceptor);
+            # the MAC binds rid + payload so a captured envelope cannot be
+            # replayed onto a different request
+            envelope["auth"] = self.auth.outbound_context(
+                self.node_id, action, rid=rid, request=request)
         frame = encode_frame(rid, STATUS_REQUEST, WIRE_VERSION, action,
-                             {"sender": self.node_id, "request": request})
+                             envelope)
         self.stats["tx_count"] += 1
         self.stats["tx_bytes"] += len(frame)
         channel.write_frame(frame)
@@ -332,7 +354,7 @@ class TcpTransportService:
         addr = self._addresses.get(target)
         if addr is None:
             raise ConnectTransportError(f"no known address for [{target}]")
-        reader, writer = await asyncio.open_connection(*addr)
+        reader, writer = await self._client_connect(*addr)
         channel = _Channel(reader, writer)
         self.stats["connections_opened"] += 1
         self._pumps.append(
@@ -417,6 +439,11 @@ class TcpTransportService:
         if status & STATUS_REQUEST:
             self._handle_request(channel, rid, action, payload)
         else:
+            # a response is only valid on the channel that carried the
+            # request: without this, any connected peer could forge
+            # responses to other channels' in-flight rids
+            if rid not in channel.pending_rids:
+                return
             entry = self._pending.pop(rid, None)
             channel.pending_rids.discard(rid)
             if entry is None:
@@ -441,6 +468,21 @@ class TcpTransportService:
                 rid, STATUS_HANDSHAKE, WIRE_VERSION, None,
                 {"node_id": self.node_id, "version": WIRE_VERSION}))
             return
+        # authenticate BEFORE even the handler lookup: a peer that completed
+        # the socket handshake may not invoke actions — nor enumerate which
+        # exist — without a valid cluster-key MAC binding (sender, action,
+        # rid, payload, identity)
+        auth_ctx = None
+        if self.auth is not None:
+            try:
+                auth_ctx = self.auth.validate(sender, action,
+                                              envelope.get("auth"),
+                                              rid=rid, request=request)
+            except Exception as e:
+                channel.write_frame(encode_frame(
+                    rid, STATUS_ERROR, WIRE_VERSION, None,
+                    {"type": "security_exception", "message": str(e)}))
+                return
         handler = self._handlers.get(action)
         if handler is None:
             channel.write_frame(encode_frame(
@@ -455,12 +497,17 @@ class TcpTransportService:
             self.stats["tx_bytes"] += len(frame)
             channel.write_frame(frame)
 
+        from elasticsearch_tpu.transport.tls import current_auth
+        token = current_auth.set(auth_ctx) if auth_ctx is not None else None
         try:
             handler(sender, request, respond)
         except Exception as e:
             channel.write_frame(encode_frame(
                 rid, STATUS_ERROR, WIRE_VERSION, None,
                 {"type": type(e).__name__, "message": str(e)}))
+        finally:
+            if token is not None:
+                current_auth.reset(token)
 
     # ----------------------------------------------------------- keepalive
     async def _keepalive_pump(self) -> None:

@@ -1,0 +1,326 @@
+"""Transport TLS + inter-node authentication context.
+
+Re-design of the reference's transport security composition
+(`libs/ssl-config` ~3.5k LoC PEM/JKS loading + `x-pack/.../transport/
+SecurityServerTransportInterceptor.java:50`): the inter-node socket runs
+TLS (mutual by default, like xpack.security.transport.ssl), and every RPC
+envelope carries a signed authentication context that the receiving node
+validates BEFORE dispatching to the handler — a peer that completed the
+TCP/TLS handshake still cannot invoke actions without proving cluster
+membership.
+
+Settings (the `transport.ssl.*` family mirrors `xpack.security.transport.
+ssl.*`):
+
+  transport.ssl.enabled                  bool
+  transport.ssl.certificate             PEM cert (this node)
+  transport.ssl.key                     PEM private key
+  transport.ssl.certificate_authorities PEM CA bundle (peer verification)
+  transport.ssl.verification_mode       full | certificate | none
+  transport.ssl.client_authentication   required | optional | none
+
+The auth context is HMAC-SHA256 over (sender, action, user, roles) with a
+shared cluster key (sourced from the keystore as `cluster.auth.key`, like
+the reference sources TLS material from secure settings). The reference
+derives trust purely from mTLS identity + its realm chain; the explicit
+per-message MAC here additionally covers deployments that terminate TLS
+at a sidecar.
+
+`python -m elasticsearch_tpu.transport.tls certutil --out DIR` generates a
+CA + node certificate the way `elasticsearch-certutil` does.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import datetime
+import hashlib
+import hmac
+import os
+import ssl
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+
+VERIFICATION_MODES = ("full", "certificate", "none")
+
+
+class TlsConfigError(SearchEngineError):
+    pass
+
+
+class TransportAuthError(SearchEngineError):
+    """Envelope failed authentication: wrong/missing MAC or tampered ctx."""
+
+
+class TlsConfig:
+    """Builds the server/client SSLContext pair from PEM material."""
+
+    def __init__(self, certificate: str, key: str,
+                 certificate_authorities: Optional[str] = None,
+                 verification_mode: str = "full",
+                 client_authentication: str = "required",
+                 key_password: Optional[str] = None):
+        if verification_mode not in VERIFICATION_MODES:
+            raise TlsConfigError(
+                f"transport.ssl.verification_mode must be one of "
+                f"{VERIFICATION_MODES}, got [{verification_mode}]")
+        for label, path in (("certificate", certificate), ("key", key)):
+            if not os.path.exists(path):
+                raise TlsConfigError(f"transport.ssl.{label} not found: {path}")
+        self.certificate = certificate
+        self.key = key
+        self.certificate_authorities = certificate_authorities
+        self.verification_mode = verification_mode
+        self.client_authentication = client_authentication
+        self.key_password = key_password
+
+    @staticmethod
+    def from_settings(settings: Dict[str, Any]) -> Optional["TlsConfig"]:
+        enabled = str(settings.get("transport.ssl.enabled", "false")).lower()
+        if enabled not in ("true", "1", "yes"):
+            return None
+        cert = settings.get("transport.ssl.certificate")
+        key = settings.get("transport.ssl.key")
+        if not cert or not key:
+            raise TlsConfigError(
+                "transport.ssl.enabled requires transport.ssl.certificate "
+                "and transport.ssl.key")
+        return TlsConfig(
+            cert, key,
+            certificate_authorities=settings.get(
+                "transport.ssl.certificate_authorities"),
+            verification_mode=str(settings.get(
+                "transport.ssl.verification_mode", "full")),
+            client_authentication=str(settings.get(
+                "transport.ssl.client_authentication", "required")),
+            key_password=settings.get("transport.ssl.key_password"))
+
+    def _load_identity(self, ctx: ssl.SSLContext) -> None:
+        ctx.load_cert_chain(self.certificate, self.key,
+                            password=self.key_password)
+        if self.certificate_authorities:
+            ctx.load_verify_locations(self.certificate_authorities)
+
+    def server_context(self) -> ssl.SSLContext:
+        # PEM material is immutable for the process lifetime: build each
+        # context once instead of re-reading cert files per connection
+        cached = getattr(self, "_server_ctx", None)
+        if cached is not None:
+            return cached
+        self._server_ctx = self._build_server_context()
+        return self._server_ctx
+
+    def _build_server_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        self._load_identity(ctx)
+        if self.client_authentication == "required":
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        elif self.client_authentication == "optional":
+            ctx.verify_mode = ssl.CERT_OPTIONAL
+        else:
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        cached = getattr(self, "_client_ctx", None)
+        if cached is not None:
+            return cached
+        self._client_ctx = self._build_client_context()
+        return self._client_ctx
+
+    def _build_client_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        self._load_identity(ctx)
+        if self.verification_mode == "none":
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.verification_mode == "certificate":
+            # trust chain verified, hostname not (the common mode for
+            # inter-node certs without per-host SANs)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        else:
+            ctx.check_hostname = True
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+
+# ---------------------------------------------------------------------------
+# per-message authentication context
+# ---------------------------------------------------------------------------
+
+# the authenticated context of the RPC currently being handled on this task
+# (ThreadContext analog: SecurityServerTransportInterceptor stashes the
+# authentication in the thread context before the handler runs)
+current_auth: contextvars.ContextVar[Optional[dict]] = \
+    contextvars.ContextVar("transport_auth", default=None)
+
+
+def _payload_digest(request: Any) -> str:
+    """Canonical digest of the request payload via the wire serializer —
+    the same deterministic encoding both ends already share."""
+    from elasticsearch_tpu.common.serialization import StreamOutput
+    out = StreamOutput(1)
+    out.write_generic(request)
+    return hashlib.sha256(out.bytes()).hexdigest()
+
+
+def _mac(key: bytes, sender: str, action: str, user: str,
+         roles: List[str], rid: int, payload_digest: str) -> str:
+    msg = "\x00".join([sender, action, user, ",".join(sorted(roles)),
+                       str(rid), payload_digest])
+    return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).hexdigest()
+
+
+class TransportAuth:
+    """Signs outgoing envelopes and validates inbound ones with the shared
+    cluster key. The MAC binds (sender, action, request id, payload digest,
+    user, roles): a captured envelope cannot be replayed onto a different
+    action, request id, or body. The default outbound identity is the node's
+    system context (`_system`, the reference's SystemUser for internal
+    actions); REST-layer code can push the authenticated end-user instead."""
+
+    def __init__(self, key: bytes, node_user: str = "_system",
+                 node_roles: Optional[List[str]] = None):
+        if not key:
+            raise TlsConfigError("transport auth key must be non-empty")
+        self.key = key
+        self.node_user = node_user
+        self.node_roles = list(node_roles or ["_internal"])
+
+    def outbound_context(self, sender: str, action: str, rid: int = 0,
+                         request: Any = None) -> dict:
+        auth = current_auth.get()
+        user = (auth or {}).get("user", self.node_user)
+        roles = (auth or {}).get("roles", self.node_roles)
+        return {"user": user, "roles": list(roles),
+                "mac": _mac(self.key, sender, action, user, list(roles),
+                            rid, _payload_digest(request))}
+
+    def validate(self, sender: str, action: str, ctx: Any, rid: int = 0,
+                 request: Any = None) -> dict:
+        if not isinstance(ctx, dict):
+            raise TransportAuthError(
+                f"[{action}] from [{sender}] carried no authentication "
+                f"context")
+        user = str(ctx.get("user", ""))
+        roles = [str(r) for r in ctx.get("roles", [])]
+        expected = _mac(self.key, sender, action, user, roles, rid,
+                        _payload_digest(request))
+        if not hmac.compare_digest(expected, str(ctx.get("mac", ""))):
+            raise TransportAuthError(
+                f"[{action}] from [{sender}] failed authentication")
+        return {"user": user, "roles": roles}
+
+
+# ---------------------------------------------------------------------------
+# certutil
+# ---------------------------------------------------------------------------
+
+def generate_ca(out_dir: str, name: str = "tpu-search-ca",
+                days: int = 3650) -> Dict[str, str]:
+    """Self-signed CA (elasticsearch-certutil ca analog)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    os.makedirs(out_dir, exist_ok=True)
+    ca_cert = os.path.join(out_dir, "ca.crt")
+    ca_key = os.path.join(out_dir, "ca.key")
+    with open(ca_cert, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(ca_key, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    os.chmod(ca_key, 0o600)
+    return {"cert": ca_cert, "key": ca_key}
+
+
+def generate_node_cert(out_dir: str, ca_cert_path: str, ca_key_path: str,
+                       name: str = "node",
+                       hosts: Optional[List[str]] = None,
+                       days: int = 1095) -> Dict[str, str]:
+    """CA-signed node certificate with IP/DNS SANs
+    (elasticsearch-certutil cert analog)."""
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    with open(ca_cert_path, "rb") as f:
+        ca_cert = x509.load_pem_x509_certificate(f.read())
+    with open(ca_key_path, "rb") as f:
+        ca_key = serialization.load_pem_private_key(f.read(), password=None)
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    sans: List[x509.GeneralName] = []
+    for h in (hosts or ["127.0.0.1", "localhost"]):
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, name)]))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .sign(ca_key, hashes.SHA256()))
+    os.makedirs(out_dir, exist_ok=True)
+    cert_path = os.path.join(out_dir, f"{name}.crt")
+    key_path = os.path.join(out_dir, f"{name}.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    os.chmod(key_path, 0o600)
+    return {"cert": cert_path, "key": key_path}
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(prog="certutil")
+    parser.add_argument("command", choices=["certutil"])
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--name", default="node")
+    parser.add_argument("--hosts", default="127.0.0.1,localhost")
+    args = parser.parse_args(argv)
+    ca = generate_ca(args.out)
+    node = generate_node_cert(args.out, ca["cert"], ca["key"],
+                              name=args.name,
+                              hosts=args.hosts.split(","))
+    print(f"wrote {ca['cert']}, {ca['key']}, {node['cert']}, {node['key']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -223,3 +223,84 @@ def test_create_semantics_and_refresh_shape(cluster_procs):
     assert rr["_shards"]["failed"] == 0
     got = _req("GET", f"http://127.0.0.1:{port}/events2/_doc/c1")
     assert got["_source"]["v"] == 1  # first write won
+
+
+def test_tls_cluster_forms_and_rejects_plaintext(tmp_path):
+    """Two CLI-booted processes form a cluster over mutual-TLS transport
+    with signed auth contexts; a plaintext socket poking the transport port
+    gets no cluster access (transport/tls.py)."""
+    from elasticsearch_tpu.transport.tls import generate_ca, generate_node_cert
+
+    certs_dir = str(tmp_path / "certs")
+    ca = generate_ca(certs_dir)
+    node_cert = generate_node_cert(certs_dir, ca["cert"], ca["key"],
+                                   name="node", hosts=["127.0.0.1"])
+
+    http_ports = _free_ports(2)
+    tp_ports = _free_ports(2)
+    seeds = ",".join(f"127.0.0.1:{p}" for p in tp_ports)
+    procs = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        for i in range(2):
+            cmd = [sys.executable, "-m", "elasticsearch_tpu.server",
+                   "--port", str(http_ports[i]), "--name", f"t{i}",
+                   "--data", str(tmp_path / f"t{i}"),
+                   "-E", f"transport.port={tp_ports[i]}",
+                   "-E", f"discovery.seed_hosts={seeds}",
+                   "-E", "cluster.initial_master_nodes=t0,t1",
+                   "-E", "transport.ssl.enabled=true",
+                   "-E", f"transport.ssl.certificate={node_cert['cert']}",
+                   "-E", f"transport.ssl.key={node_cert['key']}",
+                   "-E", f"transport.ssl.certificate_authorities={ca['cert']}",
+                   "-E", "transport.ssl.verification_mode=certificate",
+                   "-E", "cluster.auth.key=test-cluster-secret"]
+            procs.append(subprocess.Popen(
+                cmd, cwd=REPO, env=env,
+                stdout=open(tmp_path / f"t{i}.log", "w"),
+                stderr=subprocess.STDOUT))
+
+        h = _wait_health(http_ports[0], "green", nodes=2)
+        assert h["number_of_nodes"] == 2, h
+
+        # index + search across the TLS transport
+        r = _req("PUT", f"http://127.0.0.1:{http_ports[0]}/sec",
+                 {"settings": {"index.number_of_shards": 1,
+                               "index.number_of_replicas": 1},
+                  "mappings": {"properties": {"n": {"type": "long"}}}})
+        assert r["acknowledged"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            h = _req("GET", f"http://127.0.0.1:{http_ports[0]}/_cluster/health")
+            if h["status"] == "green" and h["active_shards"] == 2:
+                break
+            time.sleep(0.5)
+        assert h["active_shards"] == 2, h
+        _req("PUT", f"http://127.0.0.1:{http_ports[1]}/sec/_doc/1", {"n": 1})
+        _req("POST", f"http://127.0.0.1:{http_ports[0]}/sec/_refresh")
+        resp = _req("POST", f"http://127.0.0.1:{http_ports[1]}/sec/_search",
+                    {"query": {"match_all": {}}})
+        assert resp["hits"]["total"]["value"] == 1
+
+        # a plaintext TCP client cannot speak to the TLS transport port
+        s = socket.create_connection(("127.0.0.1", tp_ports[0]), timeout=5)
+        try:
+            s.sendall(b"ET\x00\x00\x00\x0bplaintext!!")
+            s.settimeout(5)
+            data = s.recv(1024)
+            # TLS server either drops the connection or answers with a TLS
+            # alert (0x15) — never a framed 'ET' protocol response
+            assert not data.startswith(b"ET")
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+        finally:
+            s.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -1,0 +1,236 @@
+"""Transport TLS + inter-node authentication
+(libs/ssl-config + SecurityServerTransportInterceptor.java:50 analogs)."""
+
+import asyncio
+
+import pytest
+
+from elasticsearch_tpu.transport import TcpTransportService
+from elasticsearch_tpu.transport.tls import (
+    TlsConfig, TlsConfigError, TransportAuth, TransportAuthError, current_auth,
+    generate_ca, generate_node_cert,
+)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("certs"))
+    ca = generate_ca(out)
+    node = generate_node_cert(out, ca["cert"], ca["key"], name="node",
+                              hosts=["127.0.0.1", "localhost"])
+    rogue_ca = generate_ca(out + "/rogue")
+    rogue = generate_node_cert(out + "/rogue", rogue_ca["cert"],
+                               rogue_ca["key"], name="rogue")
+    return {"ca": ca, "node": node, "rogue_ca": rogue_ca, "rogue": rogue}
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def tls_for(certs, mode="certificate"):
+    return TlsConfig(certs["node"]["cert"], certs["node"]["key"],
+                     certificate_authorities=certs["ca"]["cert"],
+                     verification_mode=mode)
+
+
+async def make_pair(certs, tls_a=None, tls_b=None, auth_a=None, auth_b=None):
+    a = TcpTransportService("a", tls=tls_a, auth=auth_a)
+    b = TcpTransportService("b", tls=tls_b, auth=auth_b)
+    await a.bind()
+    await b.bind()
+    a.add_peer_address("b", *b.bound_address)
+    b.add_peer_address("a", *a.bound_address)
+    return a, b
+
+
+async def wait_for(box, key, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while key not in box:
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"no [{key}] within {timeout}s: {box}")
+        await asyncio.sleep(0.005)
+    return box[key]
+
+
+def test_rpc_over_mutual_tls(certs):
+    async def body():
+        tls = tls_for(certs)
+        a, b = await make_pair(certs, tls_a=tls, tls_b=tls)
+        b.register("b", "echo",
+                   lambda sender, req, respond: respond({"ok": req["n"]}))
+        box = {}
+        a.send("a", "b", "echo", {"n": 7},
+               on_response=lambda r: box.update(r=r))
+        assert (await wait_for(box, "r")) == {"ok": 7}
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_full_verification_checks_hostname(certs):
+    async def body():
+        tls = tls_for(certs, mode="full")  # cert has 127.0.0.1 + localhost SANs
+        a, b = await make_pair(certs, tls_a=tls, tls_b=tls)
+        b.register("b", "echo",
+                   lambda sender, req, respond: respond({"ok": True}))
+        box = {}
+        a.send("a", "b", "echo", {},
+               on_response=lambda r: box.update(r=r))
+        assert (await wait_for(box, "r"))["ok"]
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_plaintext_client_rejected_by_tls_server(certs):
+    async def body():
+        tls = tls_for(certs)
+        b = TcpTransportService("b", tls=tls)
+        await b.bind()
+        b.register("b", "echo",
+                   lambda sender, req, respond: respond({"ok": True}))
+        a = TcpTransportService("a")  # no TLS
+        await a.bind()
+        a.add_peer_address("b", *b.bound_address)
+        box = {}
+        a.send("a", "b", "echo", {}, on_failure=lambda e: box.update(e=e),
+               timeout_ms=3000)
+        e = await wait_for(box, "e")
+        assert e is not None
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_untrusted_cert_rejected(certs):
+    async def body():
+        good = tls_for(certs)
+        rogue = TlsConfig(certs["rogue"]["cert"], certs["rogue"]["key"],
+                          certificate_authorities=certs["ca"]["cert"],
+                          verification_mode="certificate")
+        a, b = await make_pair(certs, tls_a=rogue, tls_b=good)
+        b.register("b", "echo",
+                   lambda sender, req, respond: respond({"ok": True}))
+        box = {}
+        a.send("a", "b", "echo", {}, on_failure=lambda e: box.update(e=e),
+               timeout_ms=3000)
+        e = await wait_for(box, "e")
+        assert e is not None
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_verification_mode_validated(certs):
+    with pytest.raises(TlsConfigError):
+        TlsConfig(certs["node"]["cert"], certs["node"]["key"],
+                  verification_mode="bogus")
+    with pytest.raises(TlsConfigError):
+        TlsConfig("/does/not/exist.crt", certs["node"]["key"])
+
+
+def test_from_settings(certs):
+    assert TlsConfig.from_settings({}) is None
+    cfg = TlsConfig.from_settings({
+        "transport.ssl.enabled": True,
+        "transport.ssl.certificate": certs["node"]["cert"],
+        "transport.ssl.key": certs["node"]["key"],
+        "transport.ssl.certificate_authorities": certs["ca"]["cert"],
+        "transport.ssl.verification_mode": "certificate"})
+    assert cfg is not None and cfg.verification_mode == "certificate"
+    with pytest.raises(TlsConfigError):
+        TlsConfig.from_settings({"transport.ssl.enabled": "true"})
+
+
+# ------------------------------------------------------------- transport auth
+
+def test_auth_context_propagates_and_validates():
+    async def body():
+        auth = TransportAuth(b"cluster-shared-key")
+        a, b = await make_pair(None, auth_a=auth, auth_b=auth)
+        seen = {}
+
+        def handler(sender, req, respond):
+            seen["auth"] = current_auth.get()
+            respond({"ok": True})
+
+        b.register("b", "guarded", handler)
+        box = {}
+        a.send("a", "b", "guarded", {},
+               on_response=lambda r: box.update(r=r))
+        await wait_for(box, "r")
+        assert seen["auth"]["user"] == "_system"
+        assert seen["auth"]["roles"] == ["_internal"]
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_unauthenticated_peer_rejected_before_dispatch():
+    async def body():
+        auth = TransportAuth(b"cluster-shared-key")
+        a, b = await make_pair(None, auth_a=None, auth_b=auth)  # sender unsigned
+        called = {}
+        b.register("b", "guarded",
+                   lambda sender, req, respond: called.update(hit=True)
+                   or respond({"ok": True}))
+        box = {}
+        a.send("a", "b", "guarded", {},
+               on_failure=lambda e: box.update(e=e))
+        e = await wait_for(box, "e")
+        assert "security_exception" in str(e) or "authentication" in str(e)
+        assert "hit" not in called, "handler ran despite failed authn"
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_wrong_key_rejected():
+    async def body():
+        a, b = await make_pair(None,
+                               auth_a=TransportAuth(b"key-one"),
+                               auth_b=TransportAuth(b"key-two"))
+        b.register("b", "guarded",
+                   lambda sender, req, respond: respond({"ok": True}))
+        box = {}
+        a.send("a", "b", "guarded", {},
+               on_failure=lambda e: box.update(e=e))
+        e = await wait_for(box, "e")
+        assert "authentication" in str(e) or "security" in str(e)
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_rest_user_context_rides_rpc():
+    """A REST-authenticated end user pushed into current_auth travels with
+    the RPC and is what the remote handler sees (run-as propagation)."""
+    async def body():
+        auth = TransportAuth(b"cluster-shared-key")
+        a, b = await make_pair(None, auth_a=auth, auth_b=auth)
+        seen = {}
+        b.register("b", "guarded", lambda sender, req, respond:
+                   seen.update(auth=current_auth.get()) or respond({}))
+        box = {}
+        token = current_auth.set({"user": "alice", "roles": ["admin"]})
+        try:
+            a.send("a", "b", "guarded", {},
+                   on_response=lambda r: box.update(r=r))
+        finally:
+            current_auth.reset(token)
+        await wait_for(box, "r")
+        assert seen["auth"] == {"user": "alice", "roles": ["admin"]}
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_mac_tamper_detected():
+    auth = TransportAuth(b"k")
+    ctx = auth.outbound_context("a", "act")
+    assert auth.validate("a", "act", dict(ctx))["user"] == "_system"
+    with pytest.raises(TransportAuthError):
+        auth.validate("a", "other-action", dict(ctx))  # action substitution
+    bad = dict(ctx)
+    bad["roles"] = ["superuser"]
+    with pytest.raises(TransportAuthError):
+        auth.validate("a", "act", bad)
+    with pytest.raises(TransportAuthError):
+        auth.validate("a", "act", None)
