@@ -307,10 +307,73 @@ class Coordinator:
                 return  # orphaned chain: a newer chain owns elections now
             if self.mode != CANDIDATE:
                 return  # chain ends; _become_candidate starts a fresh one
-            self._start_election()
+            # pre-vote round (PreVoteCollector + JoinHelper): probe peers
+            # first — if a live leader exists, JOIN it at its term instead
+            # of starting a term-bumping election that would destabilize
+            # the whole cluster just to admit one node
+            self._pre_vote_then_elect(generation)
             self._chain_election(generation)
 
         self.scheduler.schedule_in(delay, maybe_elect, f"election:{self.node.node_id}")
+
+    def _pre_vote_then_elect(self, generation: int) -> None:
+        targets = sorted(self._broadcast_targets() - {self.node.node_id})
+        if not targets:
+            self._start_election()
+            return
+        poll = {"pending": len(targets), "leader": None, "term": 0,
+                "done": False}
+
+        def finish():
+            if poll["done"]:
+                return
+            poll["done"] = True
+            if self.stopped or self.mode != CANDIDATE \
+                    or generation != self._election_generation:
+                return
+            leader, term = poll["leader"], poll["term"]
+            if leader and leader != self.node.node_id \
+                    and term >= self.state.current_term:
+                self._send_join_to_leader(leader, term)
+            else:
+                self._start_election()
+
+        def one(resp):
+            if isinstance(resp, dict) and resp.get("leader") \
+                    and resp.get("term", 0) >= self.state.current_term:
+                if resp["term"] >= poll["term"]:
+                    poll["leader"], poll["term"] = resp["leader"], resp["term"]
+            poll["pending"] -= 1
+            if poll["pending"] == 0:
+                finish()
+
+        for target in targets:
+            self.transport.send(self.node.node_id, target, PEER_FIND_ACTION,
+                                {"source": self.node.node_id},
+                                on_response=one,
+                                on_failure=lambda _e: one(None))
+        # lost responses must not stall the chain: close the poll after a
+        # beat either way (the chain's next tick re-probes)
+        self.scheduler.schedule_in(1000, finish,
+                                   f"pre_vote_close:{self.node.node_id}")
+
+    def _send_join_to_leader(self, leader: str, term: int) -> None:
+        """JoinHelper.sendJoinRequest analog: adopt the live leader's term
+        and hand it our join; the leader adds us and the publication makes
+        us a follower — no election, no disruption."""
+        if term > self.state.current_term:
+            try:
+                join = self.state.handle_start_join(leader, term)
+            except CoordinationError:
+                return
+        else:
+            join = {"source": self.node.node_id, "target": leader,
+                    "term": self.state.current_term,
+                    "last_accepted_term": self.state.last_accepted_term,
+                    "last_accepted_version": self.state.last_accepted_version}
+        join["address"] = self.node.address
+        join["node"] = self.node.to_dict()
+        self.transport.send(self.node.node_id, leader, JOIN_ACTION, join)
 
     def _voting_nodes(self) -> Set[str]:
         config = (self.state.last_accepted.last_accepted_config.node_ids
@@ -361,6 +424,9 @@ class Coordinator:
     def _become_leader(self) -> None:
         self.mode = LEADER
         self.known_leader = self.node.node_id
+        # fresh grace for every follower: stale pre-election timestamps must
+        # not count against nodes under the new reign
+        self._follower_last_ok = {}
         self._publish_first_state()
         self._schedule_heartbeat()
 
@@ -567,6 +633,12 @@ class Coordinator:
 
     # ---------------------------------------------------------- reconfiguration
     def _leader_add_node(self, node_id: str) -> None:
+        # a (re)joining node gets a fresh fault-detection grace period: a
+        # stale last-ok stamp from before it left must not instantly
+        # re-remove it (the bug class: rejoin-then-removed loops)
+        self._follower_last_ok = getattr(self, "_follower_last_ok", {})
+        self._follower_last_ok[node_id] = self.scheduler.now_ms
+
         def add(base: ClusterState) -> ClusterState:
             addr = self._join_addresses.get(node_id, "")
             existing = base.nodes.get(node_id)
@@ -612,7 +684,8 @@ class Coordinator:
                 self.transport.send(
                     self.node.node_id, target, FOLLOWER_CHECK_ACTION,
                     {"term": self.state.current_term, "leader": self.node.node_id},
-                    on_response=lambda resp, t=target: self._note_follower_ok(t))
+                    on_response=lambda resp, t=target:
+                    self._on_follower_check_response(t, resp))
             self._check_followers()
             self._schedule_heartbeat()
 
@@ -622,6 +695,15 @@ class Coordinator:
     def _note_follower_ok(self, node_id: str) -> None:
         self._follower_last_ok = getattr(self, "_follower_last_ok", {})
         self._follower_last_ok[node_id] = self.scheduler.now_ms
+
+    def _on_follower_check_response(self, node_id: str, resp) -> None:
+        if isinstance(resp, dict) and resp.get("ack") is False:
+            # the follower is at a newer term: we are a stale leader; step
+            # down and rejoin rather than removing healthy nodes one by one
+            if self.mode == LEADER and resp.get("term", 0) > self.state.current_term:
+                self._become_candidate("follower reports a newer term")
+            return
+        self._note_follower_ok(node_id)
 
     def _check_followers(self) -> None:
         """Remove followers that missed fault_timeout of acks
@@ -638,7 +720,12 @@ class Coordinator:
 
     def _on_follower_check(self, sender: str, request: dict, respond) -> None:
         if request["term"] < self.state.current_term:
-            return  # stale leader
+            # NACK with our term so the stale leader steps down and rejoins
+            # the current term promptly, instead of silently timing us out
+            # of the cluster (FollowersChecker responds with an exception
+            # carrying the follower's term for the same reason)
+            respond({"ack": False, "term": self.state.current_term})
+            return
         if request["term"] > self.state.current_term:
             try:
                 self.state.handle_start_join(sender, request["term"])
