@@ -583,7 +583,8 @@ class ClusterNode:
         op = request["op"]
         if op["type"] == "index":
             result = local.engine.index(op["id"], op["source"],
-                                        op_type=op.get("op_type", "index"))
+                                        op_type=op.get("op_type", "index"),
+                                        routing=op.get("routing"))
         else:
             result = local.engine.delete(op["id"])
         local.tracker.update_local_checkpoint(local.routing.allocation_id,
@@ -660,7 +661,8 @@ class ClusterNode:
             local.engine.index(op["id"], op.get("source") or {},
                                seq_no=op["seq_no"],
                                primary_term=op.get("primary_term"),
-                               version=op.get("version"), origin="replica")
+                               version=op.get("version"), origin="replica",
+                               routing=op.get("routing"))
         else:
             try:
                 local.engine.delete(op["id"], seq_no=op["seq_no"],

@@ -3,9 +3,11 @@
 Re-design of `cluster/routing/OperationRouting.java`: shard = murmur3_32(
 routing_key) mod num_shards, where routing key defaults to the document id.
 The murmur3 implementation matches the x86 32-bit variant the reference uses
-(`common/hash/MurmurHash3`/Lucene StringHelper.murmurhash3_x86_32 over the
-UTF-8 bytes, seed 0), so routing is wire-compatible with the reference's
-placement for the same ids.
+(Lucene StringHelper.murmurhash3_x86_32, seed 0) over the SAME byte
+encoding — each Java char as two little-endian bytes (UTF-16LE,
+`Murmur3HashFunction.java:34-41`) — so document placement is bit-compatible
+with the reference for the same ids (validated against the known values in
+`Murmur3HashFunctionTests.java`).
 """
 
 from __future__ import annotations
@@ -46,9 +48,13 @@ def murmur3_x86_32(data: bytes, seed: int = 0) -> int:
     return h
 
 
+def hash_routing(routing: str) -> int:
+    """Murmur3HashFunction.hash(String): murmur3 over UTF-16LE char bytes,
+    returned as a Java signed 32-bit int."""
+    h = murmur3_x86_32(routing.encode("utf-16-le"))
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
 def shard_id_for(routing: str, num_shards: int, routing_partition_size: int = 1) -> int:
     """OperationRouting.generateShardId: murmur3(routing) floorMod num_shards."""
-    h = murmur3_x86_32(routing.encode("utf-8"))
-    # to Java signed int then floorMod
-    signed = h - (1 << 32) if h >= (1 << 31) else h
-    return signed % num_shards
+    return hash_routing(routing) % num_shards

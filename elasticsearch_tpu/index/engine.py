@@ -97,7 +97,8 @@ class Engine:
               if_seq_no: Optional[int] = None,
               if_primary_term: Optional[int] = None,
               op_type: str = "index",
-              origin: str = "primary") -> EngineResult:
+              origin: str = "primary",
+              routing: Optional[str] = None) -> EngineResult:
         """Index one document (primary assigns seq_no; replica replays it).
 
         Reference: `InternalEngine.index:843` → plan (`:996`) → Lucene add
@@ -127,6 +128,13 @@ class Engine:
                                         existing.version, "noop", existing.row)
 
             parsed = self.mapper_service.parse_document(doc_id, source)
+            if routing is not None:
+                # _routing metadata field: a doc value, so it survives
+                # refresh/commit and returns on GET (RoutingFieldMapper)
+                parsed.doc_values["_routing"] = routing
+            # _primary_term as a doc value so search hits can return it
+            # (seq_no_primary_term=true; seq_no itself lives in the segment)
+            parsed.doc_values["_primary_term"] = int(primary_term or 1)
             builder = self._get_builder()
             local = builder.add(parsed, seq_no)
             row = builder.base + local
@@ -137,9 +145,12 @@ class Engine:
                 self._tombstone(existing.row)
 
             self.version_map[doc_id] = VersionValue(seq_no, primary_term, new_version, row, False)
-            self.translog.add({"op": OP_INDEX, "id": doc_id, "source": source,
-                               "seq_no": seq_no, "primary_term": primary_term,
-                               "version": new_version})
+            op_entry = {"op": OP_INDEX, "id": doc_id, "source": source,
+                        "seq_no": seq_no, "primary_term": primary_term,
+                        "version": new_version}
+            if routing is not None:
+                op_entry["routing"] = routing
+            self.translog.add(op_entry)
             self.tracker.mark_processed(seq_no)
             return EngineResult(doc_id, seq_no, primary_term, new_version,
                                 "created" if created else "updated", row)
@@ -246,14 +257,30 @@ class Engine:
             if not realtime:
                 reader = self.acquire_searcher()
                 src = reader.get_source(vv.row)
-                return None if src is None else {
+                out = None if src is None else {
                     "_id": doc_id, "_version": vv.version, "_seq_no": vv.seq_no,
                     "_primary_term": vv.primary_term, "_source": src, "_row": vv.row}
-            src = self._source_of_row(vv.row)
-            if src is None:
-                return None
-            return {"_id": doc_id, "_version": vv.version, "_seq_no": vv.seq_no,
-                    "_primary_term": vv.primary_term, "_source": src, "_row": vv.row}
+            else:
+                src = self._source_of_row(vv.row)
+                out = None if src is None else {
+                    "_id": doc_id, "_version": vv.version, "_seq_no": vv.seq_no,
+                    "_primary_term": vv.primary_term, "_source": src,
+                    "_row": vv.row}
+            if out is not None:
+                routing = self._routing_of_row(vv.row)
+                if routing is not None:
+                    out["_routing"] = routing
+            return out
+
+    def _routing_of_row(self, row: int) -> Optional[str]:
+        for seg in self.segments:
+            if seg.base <= row < seg.base + seg.num_docs:
+                col = seg.doc_values.get("_routing")
+                return col.get(row - seg.base) if col else None
+        b = self._builder
+        if b is not None and b.base <= row < b.base + b.num_docs:
+            return b._doc_values.get("_routing", {}).get(row - b.base)
+        return None
 
     def _source_of_row(self, row: int) -> Optional[dict]:
         for seg in self.segments:
@@ -346,7 +373,8 @@ class Engine:
             if kind == OP_INDEX:
                 self.index(op["id"], op["source"], seq_no=op["seq_no"],
                            primary_term=op.get("primary_term"),
-                           version=op.get("version"), origin="replica")
+                           version=op.get("version"), origin="replica",
+                           routing=op.get("routing"))
             elif kind == OP_DELETE:
                 try:
                     self.delete(op["id"], seq_no=op["seq_no"],

@@ -247,11 +247,28 @@ class DateFieldMapper(FieldMapper):
 class IpFieldMapper(FieldMapper):
     type_name = "ip"
 
+    @staticmethod
+    def parse_ip(value) -> int:
+        """IPs order/store in the 16-byte IPv6 space; IPv4 maps to
+        ::ffff:a.b.c.d (the reference stores InetAddressPoint's 16-byte
+        form), so '::1' and '0.0.0.1' remain distinct values. Every
+        consumer (doc values, query bounds, agg ranges) MUST use this one
+        transform or comparisons cross number spaces."""
+        ip = ipaddress.ip_address(str(value))
+        if isinstance(ip, ipaddress.IPv4Address):
+            ip = ipaddress.IPv6Address(b"\x00" * 10 + b"\xff\xff" + ip.packed)
+        return int(ip)
+
     def coerce(self, value) -> int:
         try:
-            return int(ipaddress.ip_address(str(value)))
+            return self.parse_ip(value)
         except ValueError:
             raise MapperParsingError(f"failed to parse IP [{value}] for field [{self.name}]")
+
+    @staticmethod
+    def format_value(stored: int) -> str:
+        addr = ipaddress.IPv6Address(int(stored))
+        return str(addr.ipv4_mapped or addr)
 
     def index_terms(self, value):
         return [str(self.coerce(value))]

@@ -284,6 +284,10 @@ class ShardReader:
         hit = self.resolve(global_row)
         return hit[0].segment.sources[hit[1]] if hit else None
 
+    def get_seq_no(self, global_row: int) -> Optional[int]:
+        hit = self.resolve(global_row)
+        return int(hit[0].segment.seq_nos[hit[1]]) if hit else None
+
     def get_doc_value(self, field: str, global_row: int) -> Any:
         hit = self.resolve(global_row)
         if hit is None:

@@ -191,7 +191,7 @@ class Node:
         result = shard.engine.index(
             doc_id, body, op_type=op_type, if_seq_no=if_seq_no,
             if_primary_term=if_primary_term, version=version,
-            version_type=version_type)
+            version_type=version_type, routing=routing)
         self.counters["index"] += 1
         self.indexing_slow_log.maybe_log(
             svc.settings, svc.name, time.monotonic() - t0, source=body)
@@ -200,24 +200,31 @@ class Node:
             # persist only on real dynamic-mapping changes, not per document
             self.indices._persist_meta(svc)
             svc.mapper_service.dirty = False
-        return {
+        out = {
             "_index": svc.name, "_id": doc_id, "_version": result.version,
             "result": result.result, "_seq_no": result.seq_no,
             "_primary_term": result.primary_term,
             "_shards": {"total": 1, "successful": 1, "failed": 0},
         }
+        if refresh in ("true", "", True):
+            # the write itself made changes visible (RestActions
+            # forced_refresh flag; wait_for is not "forced")
+            out["forced_refresh"] = True
+        return out
 
     def get_doc(self, index: str, doc_id: str, routing: Optional[str] = None,
-                source_includes=None) -> dict:
+                source_includes=None, realtime: bool = True) -> dict:
         svc = self.indices.get(index)
         shard = svc.route(doc_id, routing)
         self.counters["get"] += 1
-        doc = shard.engine.get(doc_id)
+        doc = shard.engine.get(doc_id, realtime=realtime)
         if doc is None:
             return {"_index": svc.name, "_id": doc_id, "found": False}
         out = {"_index": svc.name, "_id": doc_id, "_version": doc["_version"],
                "_seq_no": doc["_seq_no"], "_primary_term": doc["_primary_term"],
                "found": True, "_source": doc["_source"]}
+        if doc.get("_routing") is not None:
+            out["_routing"] = doc["_routing"]
         return out
 
     def delete_doc(self, index: str, doc_id: str, refresh: Optional[str] = None,
@@ -230,10 +237,13 @@ class Node:
         result = shard.engine.delete(doc_id, if_seq_no=if_seq_no,
                                      if_primary_term=if_primary_term)
         self._maybe_refresh(svc, refresh)
-        return {"_index": svc.name, "_id": doc_id, "_version": result.version,
-                "result": "deleted", "_seq_no": result.seq_no,
-                "_primary_term": result.primary_term,
-                "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        out = {"_index": svc.name, "_id": doc_id, "_version": result.version,
+               "result": "deleted", "_seq_no": result.seq_no,
+               "_primary_term": result.primary_term,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if refresh in ("true", "", True):
+            out["forced_refresh"] = True
+        return out
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    refresh: Optional[str] = None) -> dict:
@@ -299,6 +309,8 @@ class Node:
             ((action, meta),) = action_line.items()
             index = meta.get("_index", default_index)
             doc_id = meta.get("_id")
+            if doc_id is not None:
+                doc_id = str(doc_id)  # numeric ids arrive as JSON numbers
             try:
                 if action in ("index", "create"):
                     source = operations[i]
@@ -325,9 +337,14 @@ class Node:
                     pass
                 items.append({action: {"_index": index, "_id": doc_id,
                                        "status": e.status, "error": e.to_dict()}})
-        if refresh in ("true", "wait_for", True):
+        if refresh in ("true", "wait_for", True, ""):
             for name in touched:
                 self.indices.get(name).refresh()
+        if refresh in ("true", "", True):
+            for item in items:
+                for inner in item.values():
+                    if "error" not in inner:
+                        inner["forced_refresh"] = True
         return {"took": 0, "errors": errors, "items": items}
 
     def _index_or_autocreate(self, index: str) -> IndexService:
@@ -527,6 +544,21 @@ class Node:
             all_hits.sort(key=lambda t: _sort_key_tuple(t[2], body))
         else:
             all_hits.sort(key=lambda t: -t[1])
+        collapse_spec = body.get("collapse")
+        if collapse_spec and len(readers) > 1:
+            # cross-index collapse: per-index phases deduped their own
+            # groups; the merged ranking dedupes across indices by the
+            # group value each hit carries in `fields`
+            seen_groups = set()
+            deduped = []
+            for t in all_hits:
+                vals = (t[0].get("fields") or {}).get(collapse_spec["field"])
+                key = vals[0] if vals else None
+                if key in seen_groups:
+                    continue
+                seen_groups.add(key)
+                deduped.append(t)
+            all_hits = deduped
         frm = int(body.get("from", 0) or 0)
         size = int(body.get("size", 10) if body.get("size") is not None else 10)
         window = all_hits[frm:frm + size]
@@ -543,6 +575,16 @@ class Node:
                 "hits": [h for h, _, _ in window],
             },
         }
+        if body.get("track_total_hits") is False:
+            # hit counting disabled: no total in the response (RestSearchAction)
+            del resp["hits"]["total"]
+        else:
+            track = body.get("track_total_hits")
+            if isinstance(track, int) and not isinstance(track, bool) \
+                    and total > track:
+                # coordinator-level cap: per-index phases may each be under
+                # the limit while the summed total crosses it
+                resp["hits"]["total"] = {"value": track, "relation": "gte"}
         if merged_aggs is not None:
             if use_partial_aggs:
                 from elasticsearch_tpu.search.agg_partials import finalize_aggs
@@ -581,6 +623,9 @@ class Node:
         """Initial search with ?scroll=: snapshot all matching docs in order,
         return the first page + a scroll id."""
         body = dict(body or {})
+        if body.get("collapse") is not None:
+            raise IllegalArgumentError(
+                "cannot use `collapse` in a scroll context")
         size = int(body.get("size", 10) if body.get("size") is not None else 10)
         entries = []  # (svc, reader, row, score, sort_values)
         total = 0
@@ -667,7 +712,9 @@ class Node:
             body = lines[i] if i < len(lines) else {}
             i += 1
             try:
-                responses.append(self.search(header.get("index"), body))
+                resp = self.search(header.get("index"), body)
+                resp["status"] = 200
+                responses.append(resp)
             except SearchEngineError as e:
                 responses.append({"error": e.to_dict(), "status": e.status})
         return {"took": 0, "responses": responses}

@@ -82,22 +82,27 @@ def register_all(rc: RestController, node: Node) -> None:
 
     def post_doc_auto_id(req):
         resp = node.index_doc(req.params["index"], None, req.json() or {},
-                              refresh=req.param("refresh"))
+                              refresh=req.param("refresh"),
+                              routing=req.param("routing"))
         return 201, resp
 
     def create_doc(req):
         resp = node.index_doc(req.params["index"], req.params["id"],
                               req.json() or {}, op_type="create",
-                              refresh=req.param("refresh"))
+                              refresh=req.param("refresh"),
+                              routing=req.param("routing"))
         return 201, resp
 
     def get_doc(req):
         resp = node.get_doc(req.params["index"], req.params["id"],
-                            routing=req.param("routing"))
+                            routing=req.param("routing"),
+                            realtime=req.bool_param("realtime", True))
         return (200 if resp.get("found") else 404), resp
 
     def get_source(req):
-        resp = node.get_doc(req.params["index"], req.params["id"])
+        resp = node.get_doc(req.params["index"], req.params["id"],
+                            routing=req.param("routing"),
+                            realtime=req.bool_param("realtime", True))
         if not resp.get("found"):
             return 404, {"error": f"document [{req.params['id']}] not found"}
         return 200, resp["_source"]
@@ -106,6 +111,7 @@ def register_all(rc: RestController, node: Node) -> None:
         try:
             resp = node.delete_doc(req.params["index"], req.params["id"],
                                    refresh=req.param("refresh"),
+                                   routing=req.param("routing"),
                                    if_seq_no=req.int_param("if_seq_no"),
                                    if_primary_term=req.int_param("if_primary_term"))
             return 200, resp
@@ -128,6 +134,22 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("GET", "/{index}/_source/{id}", get_source)
     rc.register("DELETE", "/{index}/_doc/{id}", delete_doc)
     rc.register("POST", "/{index}/_update/{id}", update_doc)
+
+    def _total_hits_as_int(resp):
+        """?rest_total_hits_as_int=true renders hits.total as the pre-7.0
+        plain number (RestSearchAction.TOTAL_HITS_AS_INT_PARAM); with hit
+        counting disabled the legacy rendering is -1."""
+        hits = resp.get("hits") if isinstance(resp, dict) else None
+        if hits is None:
+            return
+        total = hits.get("total")
+        if isinstance(total, dict):
+            hits["total"] = total.get("value")
+        elif total is None:
+            hits["total"] = -1
+        for h in hits.get("hits", []):
+            for ih in (h.get("inner_hits") or {}).values():
+                _total_hits_as_int(ih)
 
     def bulk(req):
         return 200, node.bulk(req.ndjson(),
@@ -160,6 +182,11 @@ def register_all(rc: RestController, node: Node) -> None:
             v = req.int_param(p)
             if v is not None:
                 body[key] = v
+        tth = req.param("track_total_hits")
+        if tth is not None:
+            body["track_total_hits"] = (
+                True if tth in ("true", "") else
+                False if tth == "false" else int(tth))
         sort = req.param("sort")
         if sort:
             body["sort"] = [
@@ -167,12 +194,16 @@ def register_all(rc: RestController, node: Node) -> None:
                 for s in sort.split(",")]
         scroll = req.param("scroll")
         if scroll:
-            return 200, node.search_scroll_start(
+            resp = node.search_scroll_start(
                 req.params.get("index"), body, keep_alive=scroll,
                 ignore_throttled=req.bool_param("ignore_throttled", True))
-        return 200, node.search(req.params.get("index"), body,
-                                ignore_throttled=req.bool_param(
-                                    "ignore_throttled", True))
+        else:
+            resp = node.search(req.params.get("index"), body,
+                               ignore_throttled=req.bool_param(
+                                   "ignore_throttled", True))
+        if req.bool_param("rest_total_hits_as_int", False):
+            _total_hits_as_int(resp)
+        return 200, resp
 
     rc.register("GET", "/_search", search)
     rc.register("POST", "/_search", search)
@@ -188,7 +219,11 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_count", count)
 
     def msearch(req):
-        return 200, node.msearch(req.ndjson())
+        resp = node.msearch(req.ndjson())
+        if req.bool_param("rest_total_hits_as_int", False):
+            for r in resp.get("responses", []):
+                _total_hits_as_int(r)
+        return 200, resp
 
     rc.register("GET", "/_msearch", msearch)
     rc.register("POST", "/_msearch", msearch)
@@ -252,7 +287,15 @@ def register_all(rc: RestController, node: Node) -> None:
         return 200, out
 
     def put_mapping(req):
-        node.indices.update_mapping(req.params["index"], req.json() or {})
+        # wildcard/_all expressions update every matching index
+        # (MetaDataMappingService applies to all resolved concretes);
+        # matching nothing is an error, not a silent ack
+        body = req.json() or {}
+        resolved = node.indices.resolve(req.params["index"])
+        if not resolved:
+            raise IndexNotFoundError(req.params["index"])
+        for svc in resolved:
+            node.indices.update_mapping(svc.name, body)
         return 200, {"acknowledged": True}
 
     rc.register("GET", "/_mapping", get_mapping)

@@ -27,7 +27,12 @@ def register_extra(rc: RestController, node: Node) -> None:
         scroll_id = body.get("scroll_id") or req.param("scroll_id")
         if not scroll_id:
             raise IllegalArgumentError("scroll_id is required")
-        return 200, node.search_scroll_next(scroll_id, body.get("scroll"))
+        resp = node.search_scroll_next(scroll_id, body.get("scroll"))
+        if req.bool_param("rest_total_hits_as_int", False):
+            total = resp.get("hits", {}).get("total")
+            if isinstance(total, dict):
+                resp["hits"]["total"] = total.get("value")
+        return 200, resp
 
     def scroll_delete(req):
         body = req.json() or {}

@@ -78,6 +78,19 @@ def all_values(ctx: SearchContext, rows: np.ndarray, field: str) -> List[Tuple[i
 # metric aggregations
 # ---------------------------------------------------------------------------
 
+def _es_percentile(v_sorted: np.ndarray, p: float):
+    """TDigest singleton-centroid quantile (TDigestState): centroid i sits at
+    cumulative position i+0.5, extremes clamp to min/max — NOT numpy's
+    linear-between-order-statistics interpolation."""
+    n = len(v_sorted)
+    if n == 0:
+        return None
+    if n == 1:
+        return float(v_sorted[0])
+    idx = p / 100.0 * n
+    return float(np.interp(idx, np.arange(n) + 0.5, v_sorted))
+
+
 def _metric_stats(vals: np.ndarray, present: np.ndarray) -> dict:
     v = vals[present]
     n = len(v)
@@ -108,7 +121,21 @@ def _extended_stats(vals: np.ndarray, present: np.ndarray, sigma: float = 2.0) -
     return base
 
 
+_NUMERIC_ONLY_METRICS = {
+    "sum", "avg", "min", "max", "stats", "extended_stats", "percentiles",
+    "percentile_ranks", "median_absolute_deviation", "weighted_avg",
+}
+
+
 def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) -> Any:
+    if kind in _NUMERIC_ONLY_METRICS:
+        mapper = ctx.mapper_service.get(spec.get("field", "")) \
+            if spec.get("field") else None
+        tname = getattr(mapper, "type_name", None)
+        if tname in ("keyword", "text"):
+            raise IllegalArgumentError(
+                f"Field [{spec.get('field')}] of type [{tname}] is not "
+                f"supported for aggregation [{kind}]")
     field = spec.get("field")
     missing = spec.get("missing")
     script = spec.get("script")
@@ -188,10 +215,10 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
         return {"value": float(np.median(np.abs(v - med)))}
     if kind == "percentiles":
         pcts = spec.get("percents", [1, 5, 25, 50, 75, 95, 99])
-        v = vals[present]
+        v = np.sort(vals[present])
         out = {}
         for p in pcts:
-            out[f"{float(p)}"] = float(np.percentile(v, p)) if len(v) else None
+            out[f"{float(p)}"] = _es_percentile(v, float(p))
         return {"values": out}
     if kind == "percentile_ranks":
         targets = spec.get("values", [])
@@ -413,6 +440,8 @@ def compute_aggs(ctx: SearchContext, rows: np.ndarray, aggs_spec: dict) -> dict:
                     out[name].setdefault("__pipeline_results__", {})[pname] = res
         else:
             raise ParsingError(f"unknown aggregation type [{kind}]")
+        if isinstance(spec.get("meta"), dict) and isinstance(out.get(name), dict):
+            out[name]["meta"] = spec["meta"]
     for name, kind, spec in pipelines:
         res = _compute_pipeline(out, kind, spec, name)
         # in-place pipelines (derivative, cumulative_sum, bucket_script/
@@ -557,10 +586,38 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
 
     if kind in ("terms", "significant_terms", "rare_terms"):
         size = int(spec.get("size", 10))
+        tname = getattr(ctx.mapper_service.get(field), "type_name", None) \
+            if field else None
+
+        def fmt_key(k):
+            if tname == "ip":
+                from elasticsearch_tpu.index.mapping import IpFieldMapper
+                try:
+                    return IpFieldMapper.format_value(int(k))
+                except (ValueError, TypeError):
+                    return k
+            return k
+
         values = all_values(ctx, rows, field)
         groups: Dict[Any, List[int]] = {}
         for idx, v in values:
             groups.setdefault(_hashable(v), []).append(idx)
+        # include/exclude term filtering (IncludeExclude): exact-value lists
+        # or a regex, matched against the formatted key
+        inc, exc = spec.get("include"), spec.get("exclude")
+        if inc is not None or exc is not None:
+            def _passes(k):
+                ks = str(fmt_key(k))
+                if isinstance(inc, list) and ks not in {str(x) for x in inc}:
+                    return False
+                if isinstance(inc, str) and not re.fullmatch(inc, ks):
+                    return False
+                if isinstance(exc, list) and ks in {str(x) for x in exc}:
+                    return False
+                if isinstance(exc, str) and re.fullmatch(exc, ks):
+                    return False
+                return True
+            groups = {k: i for k, i in groups.items() if _passes(k)}
         # sort: doc_count desc then key asc (reference terms agg default)
         order_spec = spec.get("order")
         items = [(k, np.asarray(sorted(set(i_list)), dtype=np.int64))
@@ -590,6 +647,21 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         buckets = _bucketize(ctx, rows, sub_aggs,
                              [(k, rows[i]) for k, i in items[:size]],
                              recurse=recurse)
+        # mapper-typed key rendering (DocValueFormat): ip ints back to
+        # addresses, booleans to 1/0 + key_as_string, dates to ISO strings
+        # (fmt_key is the same transform include/exclude matched against)
+        if tname == "ip":
+            for b in buckets:
+                b["key"] = fmt_key(b["key"])
+        elif tname == "boolean":
+            for b in buckets:
+                truthy = bool(b["key"])
+                b["key"] = 1 if truthy else 0
+                b["key_as_string"] = "true" if truthy else "false"
+        elif tname == "date":
+            for b in buckets:
+                if isinstance(b["key"], (int, float)):
+                    b["key_as_string"] = _millis_to_iso(int(b["key"]))
         return {"doc_count_error_upper_bound": 0,
                 "sum_other_doc_count": int(total_other), "buckets": buckets}
 
@@ -640,6 +712,10 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         if kind == "date_range":
             def conv(x):
                 return float(parse_date_millis(x)) if x is not None else None
+        elif kind == "ip_range":
+            def conv(x):
+                from elasticsearch_tpu.index.mapping import IpFieldMapper
+                return float(IpFieldMapper.parse_ip(x)) if x is not None else None
         else:
             def conv(x):
                 return float(x) if x is not None else None

@@ -111,6 +111,22 @@ class MatchNoneQuery(Query):
         return {"match_none": {}}
 
 
+def _id_rows(ctx: SearchContext, ids) -> np.ndarray:
+    """Rows for _id metadata-field lookups (term/terms/ids queries on _id).
+    The id→row map is built once per reader (the Lucene _id terms dict)."""
+    cache = getattr(ctx.reader, "_id_row_cache", None)
+    if cache is None:
+        cache = {}
+        for v in ctx.reader.views:
+            seg = v.segment
+            for local, did in enumerate(seg.ids):
+                if v.live[local]:
+                    cache[did] = seg.base + local
+        ctx.reader._id_row_cache = cache
+    rows = sorted(r for r in (cache.get(str(i)) for i in ids) if r is not None)
+    return np.asarray(rows, dtype=np.int64)
+
+
 def _term_postings(ctx: SearchContext, field: str, term: str):
     """Collect (rows, freqs) for a term across segments, live docs only."""
     field = ctx.mapper_service.resolve_field(field)
@@ -170,6 +186,9 @@ class TermQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        if self.field == "_id":
+            rows = _id_rows(ctx, [self.value])
+            return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
         mapper = ctx.mapper_service.get(self.field)
         if isinstance(mapper, RangeFieldMapperBase):
             # membership: the queried point lies inside the stored interval
@@ -204,6 +223,9 @@ class TermsQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        if self.field == "_id":
+            rows = _id_rows(ctx, self.values)
+            return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
         mapper = ctx.mapper_service.get(self.field)
         all_rows = []
         for v in self.values:

@@ -122,7 +122,11 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     frm_ = int(body.get("from", 0) or 0)
     size_ = int(body.get("size", DEFAULT_SIZE)
                 if body.get("size") is not None else DEFAULT_SIZE)
-    if sort_spec is None and search_after is None:
+    collapse_spec = body.get("collapse")
+    if collapse_spec is not None and search_after is not None:
+        raise IllegalArgumentError(
+            "cannot use `collapse` in conjunction with `search_after`")
+    if sort_spec is None and search_after is None and collapse_spec is None:
         # score ranking: partial top-(from+size) selection via the native
         # heap (the Lucene TopScoreDocCollector analog) instead of a full
         # argsort; ties break by row asc, identical to the lexsort below
@@ -150,6 +154,28 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
         rows, scores = rows[start:], scores[start:]
         if sort_values is not None:
             sort_values = sort_values[start:]
+
+    # field collapsing: keep only the best-ranked hit per group value; the
+    # total stays uncollapsed (CollapseBuilder / CollapsingTopDocsCollector)
+    if collapse_spec is not None:
+        cfield = collapse_spec["field"]
+        seen_groups = set()
+        keep = []
+        for i, r in enumerate(rows):
+            v = ctx.reader.get_doc_value(cfield, int(r))
+            if isinstance(v, list):
+                v = v[0] if v else None
+            if v in seen_groups:
+                continue
+            seen_groups.add(v)
+            keep.append(i)
+            # the window below only keeps from+size entries: once that many
+            # distinct groups are ranked, later rows cannot surface
+            if len(keep) >= frm_ + size_:
+                break
+        rows, scores = rows[keep], scores[keep]
+        if sort_values is not None:
+            sort_values = [sort_values[i] for i in keep]
 
     frm, size = frm_, size_
     # scroll snapshots page past the window by design (internal flag); normal
@@ -407,6 +433,13 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
         }
         if sort_spec is not None and result.sort_values is not None:
             hit["sort"] = list(result.sort_values[i])
+        if body.get("seq_no_primary_term"):
+            hit["_seq_no"] = reader.get_seq_no(row)
+            pt = reader.get_doc_value("_primary_term", row)
+            hit["_primary_term"] = int(pt) if pt is not None else 1
+        routing = reader.get_doc_value("_routing", row)
+        if routing is not None:
+            hit["_routing"] = routing
         if want_source:
             src = reader.get_source(row) or {}
             hit["_source"] = _filter_source(src, includes, excludes)
@@ -430,11 +463,49 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
             hl = _highlight(ctx, mapper_service, body, highlight_spec, row)
             if hl:
                 hit["highlight"] = hl
+        collapse_spec = body.get("collapse")
+        if collapse_spec:
+            _decorate_collapsed_hit(ctx, reader, mapper_service, body,
+                                    collapse_spec, row, hit, index_name)
         if explain:
             hit["_explanation"] = {"value": hit["_score"] or 0.0,
                                    "description": "vectorized score", "details": []}
         hits.append(hit)
     return hits
+
+
+def _decorate_collapsed_hit(ctx, reader, mapper_service, body, collapse_spec,
+                            row, hit, index_name) -> None:
+    """Collapsed hits carry the group value under `fields` and, when asked,
+    the group's own ranked window under `inner_hits`
+    (ExpandSearchPhase.java:42 runs one sub-search per collapsed hit)."""
+    cfield = collapse_spec["field"]
+    v = reader.get_doc_value(cfield, row)
+    if isinstance(v, list):
+        v = v[0] if v else None
+    hit.setdefault("fields", {})[cfield] = [v]
+    inner = collapse_spec.get("inner_hits")
+    if not inner:
+        return
+    specs = inner if isinstance(inner, list) else [inner]
+    for spec in specs:
+        name = spec.get("name", cfield)
+        sub_body = {"query": {"bool": {
+            "must": [body["query"]] if body.get("query") else [],
+            "filter": [{"term": {cfield: v}}]}},
+            "size": int(spec.get("size", 3)),
+            "from": int(spec.get("from", 0))}
+        if spec.get("sort") is not None:
+            sub_body["sort"] = spec["sort"]
+        sub_result = execute_query_phase(reader, mapper_service, sub_body)
+        sub_hits = execute_fetch_phase(reader, mapper_service, sub_body,
+                                       sub_result, index_name=index_name,
+                                       from_offset=int(spec.get("from", 0)))
+        hit.setdefault("inner_hits", {})[name] = {"hits": {
+            "total": {"value": sub_result.total_hits,
+                      "relation": sub_result.total_relation},
+            "max_score": sub_result.max_score,
+            "hits": sub_hits}}
 
 
 _TAG_DEFAULT = ("<em>", "</em>")

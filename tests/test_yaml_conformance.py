@@ -1,0 +1,121 @@
+"""Spec-driven REST conformance: executes the reference's YAML behavior
+suites (rest-api-spec test DSL) against this framework's controller
+(§4.5 ESClientYamlSuiteTestCase analog; runner in yaml_runner.py).
+
+The suites in MUST_PASS are fully green and pinned — a regression in any of
+them fails CI. The wider sweep (and its triaged failures) is recorded by
+`python conformance.py` into CONFORMANCE.md.
+"""
+
+import json
+import shutil
+import tempfile
+
+import pytest
+
+from yaml_runner import REF_SPEC, YamlTestRunner, specs_available
+
+pytestmark = pytest.mark.skipif(
+    not specs_available(), reason="reference rest-api-spec not present")
+
+
+class ConformanceClient:
+    def __init__(self, root):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.actions import register_all
+        from elasticsearch_tpu.rest.controller import RestController
+        self.dir = tempfile.mkdtemp(dir=root)
+        self.node = Node(self.dir)
+        self.rc = RestController()
+        register_all(self.rc, self.node)
+
+    def req(self, method, path, body=None, **query):
+        raw = b""
+        if body is not None:
+            if isinstance(body, (list, tuple)):   # ndjson: dict or raw lines
+                raw = b"\n".join(
+                    (line.strip().encode() if isinstance(line, str)
+                     else json.dumps(line).encode())
+                    for line in body) + b"\n"
+            elif isinstance(body, str):
+                raw = body.encode()
+            else:
+                raw = json.dumps(body).encode()
+        q = {k: str(v) for k, v in query.items()}
+        return self.rc.dispatch(method, path, q, raw, "application/json")
+
+    def close(self):
+        self.node.close()
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+# suites that are fully green: every test PASSes (or SKIPs on unsupported
+# DSL features) — pinned against regression
+MUST_PASS = [
+    "bulk/20_list_of_strings.yml",
+    "bulk/30_big_string.yml",
+    "bulk/50_refresh.yml",
+    "create/10_with_id.yml",
+    "create/40_routing.yml",
+    "create/60_refresh.yml",
+    "delete/10_basic.yml",
+    "delete/11_shard_header.yml",
+    "delete/12_result.yml",
+    "delete/20_cas.yml",
+    "delete/30_routing.yml",
+    "exists/70_defaults.yml",
+    "get/10_basic.yml",
+    "get/15_default_values.yml",
+    "get/40_routing.yml",
+    "get_source/10_basic.yml",
+    "get_source/15_default_values.yml",
+    "get_source/40_routing.yml",
+    "index/12_result.yml",
+    "index/15_without_id.yml",
+    "index/20_optype.yml",
+    "index/30_cas.yml",
+    "index/40_routing.yml",
+    "index/60_refresh.yml",
+    "indices.exists/20_read_only_index.yml",
+    "indices.get_mapping/10_basic.yml",
+    "indices.get_mapping/40_aliases.yml",
+    "indices.get_mapping/60_empty.yml",
+    "info/10_info.yml",
+    "info/20_lucene_version.yml",
+    "msearch/11_status.yml",
+    "ping/10_ping.yml",
+    "search/200_index_phrase_search.yml",
+    "search/90_search_after.yml",
+    "search/issue4895.yml",
+    "search.aggregation/100_avg_metric.yml",
+    "search.aggregation/110_max_metric.yml",
+    "search.aggregation/120_min_metric.yml",
+    "search.aggregation/130_sum_metric.yml",
+    "search.aggregation/150_stats_metric.yml",
+    "search.aggregation/260_weighted_avg.yml",
+    "search.aggregation/280_geohash_grid.yml",
+    "search.aggregation/290_geotile_grid.yml",
+    "search.aggregation/70_adjacency_matrix.yml",
+    "suggest/10_basic.yml",
+    "update/10_doc.yml",
+    "update/11_shard_header.yml",
+    "update/13_legacy_doc.yml",
+    "update/60_refresh.yml",
+]
+
+
+@pytest.fixture(scope="module")
+def runner(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("yaml_conf"))
+    yield YamlTestRunner(lambda: ConformanceClient(root))
+
+
+@pytest.mark.parametrize("suite", MUST_PASS)
+def test_reference_yaml_suite(runner, suite):
+    import os
+    results = runner.run_suite(os.path.join(REF_SPEC, "test", suite))
+    failures = [r for r in results if r["status"] == "FAIL"]
+    assert not failures, "\n".join(
+        f"{r['test']}: {r['reason']}" for r in failures)
+    assert any(r["status"] == "PASS" for r in results) or all(
+        r["status"] == "SKIP" for r in results)

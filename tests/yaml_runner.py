@@ -1,0 +1,357 @@
+"""Spec-driven YAML conformance runner.
+
+Executes the reference's REST behavior suites — the do/match DSL under
+`rest-api-spec/src/main/resources/rest-api-spec/test/` — against this
+framework's REST controller, resolving each `do:` call through the
+machine-readable API specs in `rest-api-spec/api/*.json` exactly the way
+`ESClientYamlSuiteTestCase` (§4.5) does.
+
+The reference material is read from /root/reference at RUN time (it is the
+API contract, not code) — nothing is copied into this repo.
+
+Supported DSL: setup/teardown docs, do (with catch/warnings ignored-but-
+tolerated), match ($stash refs, /regex/ values, subset match on objects),
+length, is_true/is_false, gt/gte/lt/lte, contains, set; `skip` blocks for
+versions/features. Unsupported features mark the test SKIPPED, never
+PASSED.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+REF_SPEC = "/root/reference/rest-api-spec/src/main/resources/rest-api-spec"
+
+# DSL features (test/README.asciidoc "features") this runner implements;
+# a skip block naming anything else skips the test
+SUPPORTED_FEATURES = {"contains", "allowed_warnings"}
+
+_MISSING = object()
+
+
+def specs_available() -> bool:
+    return os.path.isdir(os.path.join(REF_SPEC, "api"))
+
+
+_SPECS: Optional[Dict[str, dict]] = None
+
+
+def load_specs() -> Dict[str, dict]:
+    global _SPECS
+    if _SPECS is None:
+        out = {}
+        api_dir = os.path.join(REF_SPEC, "api")
+        for name in os.listdir(api_dir):
+            if not name.endswith(".json") or name.startswith("_"):
+                continue
+            with open(os.path.join(api_dir, name)) as f:
+                spec = json.load(f)
+            for api_name, body in spec.items():
+                out[api_name] = body
+        _SPECS = out
+    return _SPECS
+
+
+class StepFailure(AssertionError):
+    pass
+
+
+class StepSkip(Exception):
+    pass
+
+
+def _fmt_param(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (list, tuple)):
+        return ",".join(str(x) for x in v)
+    return str(v)
+
+
+def resolve_call(api_name: str, args: Dict[str, Any]) -> Tuple[str, str, Dict[str, str], Any]:
+    """(method, path, query, body) for a `do:` call, via the JSON spec."""
+    specs = load_specs()
+    spec = specs.get(api_name)
+    if spec is None:
+        raise StepSkip(f"no API spec for [{api_name}]")
+    body = args.get("body")
+    arg_keys = {k for k in args if k not in ("body",)}
+    # choose the path with the most parts all satisfied by the args
+    best = None
+    for p in spec["url"]["paths"]:
+        parts = set(p.get("parts", {}))
+        if parts <= arg_keys:
+            if best is None or len(parts) > len(best[0]):
+                best = (parts, p)
+    if best is None:
+        raise StepSkip(f"[{api_name}] no path matches args {sorted(arg_keys)}")
+    parts, pathspec = best
+    path = pathspec["path"]
+    for part in parts:
+        path = path.replace("{%s}" % part, _fmt_param(args[part]))
+    methods = pathspec.get("methods", ["GET"])
+    if body is not None and "POST" in methods and "PUT" not in methods:
+        method = "POST"
+    elif body is not None and "PUT" in methods and api_name not in (
+            "index",):
+        method = "PUT" if "POST" not in methods else (
+            "PUT" if args.get("id") is not None or "{id}" in pathspec["path"]
+            else "POST")
+    else:
+        method = methods[0]
+    query = {k: _fmt_param(v) for k, v in args.items()
+             if k not in parts and k != "body"}
+    return method, path, query, body
+
+
+def _split_path(path: str) -> List[str]:
+    # dots split keys; `\.` escapes a literal dot inside a key
+    parts = re.split(r"(?<!\\)\.", path)
+    return [p.replace("\\.", ".") for p in parts]
+
+
+def get_path(resp: Any, path: str, stash: Dict[str, Any]) -> Any:
+    if path in ("$body", ""):
+        return resp
+    node = resp
+    for raw in _split_path(path):
+        key = stash.get(raw[1:], raw) if raw.startswith("$") else raw
+        if isinstance(node, list):
+            try:
+                node = node[int(key)]
+            except (ValueError, IndexError):
+                return _MISSING
+        elif isinstance(node, dict):
+            if key in node:
+                node = node[key]
+            elif str(key) in node:
+                node = node[str(key)]
+            else:
+                return _MISSING
+        else:
+            return _MISSING
+    return node
+
+
+def _stash_sub(value: Any, stash: Dict[str, Any]) -> Any:
+    if isinstance(value, str):
+        if value.startswith("$"):
+            name = value[1:]
+            if name in stash:
+                return stash[name]
+        # ${name} interpolation inside strings
+        def repl(m):
+            return str(stash.get(m.group(1), m.group(0)))
+        return re.sub(r"\$\{(\w+)\}", repl, value)
+    if isinstance(value, dict):
+        return {k: _stash_sub(v, stash) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_stash_sub(v, stash) for v in value]
+    return value
+
+
+def _values_match(actual: Any, expected: Any, stash: Dict[str, Any]) -> bool:
+    expected = _stash_sub(expected, stash)
+    if isinstance(expected, str) and len(expected) > 2 and \
+            expected.startswith("/") and expected.rstrip().endswith("/"):
+        pattern = expected.strip()[1:-1]
+        flags = re.VERBOSE if "\n" in pattern else 0
+        return actual is not _MISSING and \
+            re.search(pattern, str(actual), flags) is not None
+    if isinstance(expected, dict):
+        if not isinstance(actual, dict):
+            return False
+        # subset semantics (MatchAssertion on objects)
+        return all(_values_match(actual.get(k, _MISSING), v, stash)
+                   for k, v in expected.items())
+    if isinstance(expected, (int, float)) and not isinstance(expected, bool) \
+            and isinstance(actual, (int, float)) and not isinstance(actual, bool):
+        return float(actual) == float(expected)
+    return actual == expected
+
+
+# catch keyword -> expected HTTP status predicate
+_CATCHES = {
+    "missing": lambda s: s == 404,
+    "conflict": lambda s: s == 409,
+    "forbidden": lambda s: s == 403,
+    "unauthorized": lambda s: s == 401,
+    "bad_request": lambda s: s == 400,
+    "request_timeout": lambda s: s == 408,
+    "unavailable": lambda s: s == 503,
+    "request": lambda s: 400 <= s < 600,
+    "param": lambda s: 400 <= s < 600,
+}
+
+
+class YamlTestRunner:
+    """Runs one suite file's tests against a fresh client per test."""
+
+    def __init__(self, client_factory):
+        self.client_factory = client_factory
+
+    def run_suite(self, path: str) -> List[dict]:
+        import yaml as _yaml
+        with open(path) as f:
+            docs = [d for d in _yaml.safe_load_all(f) if d]
+        setup = []
+        teardown = []
+        tests = []
+        for doc in docs:
+            if "setup" in doc and len(doc) == 1:
+                setup = doc["setup"] or []
+            elif "teardown" in doc and len(doc) == 1:
+                teardown = doc["teardown"] or []
+            else:
+                for name, steps in doc.items():
+                    tests.append((name, steps or []))
+        results = []
+        for name, steps in tests:
+            results.append(self._run_one(path, name, setup, steps, teardown))
+        return results
+
+    def _run_one(self, suite, name, setup, steps, teardown) -> dict:
+        client = self.client_factory()
+        stash: Dict[str, Any] = {}
+        result = {"suite": suite, "test": name, "status": "PASS", "reason": ""}
+        try:
+            try:
+                for step in setup:
+                    self._step(client, step, stash)
+                for step in steps:
+                    self._step(client, step, stash)
+            finally:
+                for step in teardown:
+                    try:
+                        self._step(client, step, stash)
+                    except Exception:
+                        pass
+        except StepSkip as e:
+            result.update(status="SKIP", reason=str(e))
+        except StepFailure as e:
+            result.update(status="FAIL", reason=str(e))
+        except Exception as e:  # runner/transport error = failure, not crash
+            result.update(status="FAIL",
+                          reason=f"{type(e).__name__}: {e}")
+        finally:
+            closer = getattr(client, "close", None)
+            if closer:
+                closer()
+        return result
+
+    # ------------------------------------------------------------- steps
+    def _step(self, client, step: dict, stash: Dict[str, Any]) -> None:
+        ((kind, spec),) = step.items()
+        if kind == "do":
+            self._do(client, spec, stash)
+        elif kind == "skip":
+            self._skip(spec)
+        elif kind == "match":
+            ((path, expected),) = spec.items()
+            actual = get_path(stash["__last__"], path, stash)
+            if not _values_match(actual, expected, stash):
+                raise StepFailure(
+                    f"match {path}: expected {expected!r}, got "
+                    f"{_short(actual)}")
+        elif kind == "length":
+            ((path, expected),) = spec.items()
+            actual = get_path(stash["__last__"], path, stash)
+            if actual is _MISSING or not hasattr(actual, "__len__") \
+                    or len(actual) != int(_stash_sub(expected, stash)):
+                raise StepFailure(
+                    f"length {path}: expected {expected}, got "
+                    f"{_short(actual)}")
+        elif kind in ("is_true", "is_false"):
+            actual = get_path(stash["__last__"], spec, stash)
+            truthy = actual is not _MISSING and actual not in (
+                False, None, "", "false", 0)
+            if truthy != (kind == "is_true"):
+                raise StepFailure(f"{kind} {spec}: got {_short(actual)}")
+        elif kind in ("gt", "gte", "lt", "lte"):
+            ((path, expected),) = spec.items()
+            actual = get_path(stash["__last__"], path, stash)
+            expected = float(_stash_sub(expected, stash))
+            ops = {"gt": lambda a: a > expected,
+                   "gte": lambda a: a >= expected,
+                   "lt": lambda a: a < expected,
+                   "lte": lambda a: a <= expected}
+            if actual is _MISSING or not ops[kind](float(actual)):
+                raise StepFailure(
+                    f"{kind} {path}: expected {kind} {expected}, got "
+                    f"{_short(actual)}")
+        elif kind == "contains":
+            ((path, expected),) = spec.items()
+            actual = get_path(stash["__last__"], path, stash)
+            expected = _stash_sub(expected, stash)
+            ok = False
+            if isinstance(actual, list):
+                ok = any(_values_match(item, expected, stash)
+                         for item in actual)
+            if not ok:
+                raise StepFailure(
+                    f"contains {path}: {expected!r} not in {_short(actual)}")
+        elif kind == "set":
+            ((path, var),) = spec.items()
+            value = get_path(stash["__last__"], path, stash)
+            if value is _MISSING:
+                raise StepFailure(f"set: no value at {path}")
+            stash[var] = value
+        elif kind == "transform_and_set":
+            raise StepSkip("transform_and_set not supported")
+        else:
+            raise StepSkip(f"unsupported step [{kind}]")
+
+    def _skip(self, spec: dict) -> None:
+        version = str(spec.get("version", "")).strip()
+        if version == "all":
+            raise StepSkip(spec.get("reason", "skipped for all versions"))
+        features = spec.get("features") or []
+        if isinstance(features, str):
+            features = [features]
+        unsupported = [f for f in features if f not in SUPPORTED_FEATURES]
+        if unsupported:
+            raise StepSkip(f"requires features {unsupported}")
+
+    def _do(self, client, spec: dict, stash: Dict[str, Any]) -> None:
+        spec = dict(spec)
+        catch = spec.pop("catch", None)
+        spec.pop("warnings", None)
+        spec.pop("allowed_warnings", None)
+        spec.pop("headers", None)
+        if "node_selector" in spec:
+            raise StepSkip("node_selector not supported")
+        ((api_name, raw_args),) = spec.items()
+        args = _stash_sub(raw_args or {}, stash)
+        method, path, query, body = resolve_call(api_name, args)
+        status, resp = client.req(method, path, body=body, **query)
+        if method == "HEAD":
+            # HEAD APIs (exists/ping) have no body: the runner exposes the
+            # existence boolean, as the reference runner does
+            resp = status < 300
+        stash["__last__"] = resp
+        if catch is not None:
+            if catch.startswith("/") and catch.endswith("/"):
+                if status < 400 or not re.search(
+                        catch[1:-1], json.dumps(resp)):
+                    raise StepFailure(
+                        f"{api_name}: expected error {catch}, got "
+                        f"[{status}] {_short(resp)}")
+            else:
+                pred = _CATCHES.get(catch)
+                if pred is None:
+                    raise StepSkip(f"unsupported catch [{catch}]")
+                if not pred(status):
+                    raise StepFailure(
+                        f"{api_name}: expected catch {catch}, got "
+                        f"[{status}] {_short(resp)}")
+        elif status >= 400:
+            raise StepFailure(
+                f"{api_name} {method} {path}: [{status}] {_short(resp)}")
+
+
+def _short(v: Any, n: int = 200) -> str:
+    s = repr(v) if v is not _MISSING else "<missing>"
+    return s if len(s) <= n else s[:n] + "..."
