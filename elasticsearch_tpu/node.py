@@ -264,7 +264,22 @@ class Node:
         if "doc" in body:
             _deep_merge(source, body["doc"])
         elif "script" in body:
-            source = _apply_update_script(source, body["script"])
+            verdict: Dict[str, Any] = {}
+            source = _apply_update_script(source, body["script"],
+                                          ctx_extra=verdict)
+            op = verdict.get("op", "index")
+            if op == "none":
+                # script vetoed the update (UpdateHelper: ctx.op = 'none')
+                return {"_index": index, "_id": doc_id,
+                        "_version": existing["_version"],
+                        "result": "noop",
+                        "_seq_no": existing["_seq_no"],
+                        "_primary_term": existing["_primary_term"],
+                        "_shards": {"total": 0, "successful": 0, "failed": 0}}
+            if op == "delete":
+                out = self.delete_doc(index, doc_id, refresh=refresh)
+                out["result"] = "deleted"
+                return out
         else:
             raise IllegalArgumentError("update requires [doc] or [script]")
         out = self.index_doc(index, doc_id, source, refresh=refresh,
@@ -778,9 +793,13 @@ def _deep_merge(dst: dict, src: dict) -> None:
             dst[k] = v
 
 
-def _apply_update_script(source: dict, script_spec) -> dict:
-    """Update scripts: support `ctx._source.field = expr` statements."""
-    import ast
+def _apply_update_script(source: dict, script_spec, ctx_extra=None) -> dict:
+    """Update scripts run through the sandboxed Painless interpreter
+    (script/painless.py): `ctx._source.*` mutation, loops, conditionals,
+    list/map methods, user functions. Returns the mutated source; the
+    script's operation verdict lands in ctx['op'] (UpdateHelper honors
+    'none'/'delete'). Raises on compile/sandbox violations."""
+    from elasticsearch_tpu.script.painless import compile_painless, execute
 
     if isinstance(script_spec, str):
         script_spec = {"source": script_spec}
@@ -795,93 +814,17 @@ def _apply_update_script(source: dict, script_spec) -> dict:
                        "params": script_spec.get("params", {})}
     src = script_spec.get("source", "")
     params = script_spec.get("params", {})
-    ctx_obj = {"_source": source}
-
-    class Ctx:
-        pass
-
-    for stmt in src.split(";"):
-        stmt = stmt.strip()
-        if not stmt:
-            continue
-        try:
-            tree = ast.parse(stmt, mode="exec")
-        except SyntaxError as e:
-            raise IllegalArgumentError(f"compile error in update script: {e}")
-        for node in tree.body:
-            if isinstance(node, ast.Assign) and len(node.targets) == 1:
-                target = node.targets[0]
-                path = _attr_path(target)
-                if not path or path[0] != "ctx" or path[1] != "_source":
-                    raise IllegalArgumentError("update scripts may only assign ctx._source.*")
-                value = _eval_simple(node.value, source, params)
-                obj = source
-                for p in path[2:-1]:
-                    obj = obj.setdefault(p, {})
-                obj[path[-1]] = value
-            elif isinstance(node, ast.AugAssign):
-                path = _attr_path(node.target)
-                if not path or path[0] != "ctx" or path[1] != "_source":
-                    raise IllegalArgumentError("update scripts may only assign ctx._source.*")
-                obj = source
-                for p in path[2:-1]:
-                    obj = obj.setdefault(p, {})
-                cur = obj.get(path[-1], 0)
-                delta = _eval_simple(node.value, source, params)
-                if isinstance(node.op, ast.Add):
-                    obj[path[-1]] = cur + delta
-                elif isinstance(node.op, ast.Sub):
-                    obj[path[-1]] = cur - delta
-                elif isinstance(node.op, ast.Mult):
-                    obj[path[-1]] = cur * delta
-                else:
-                    raise IllegalArgumentError("unsupported update operator")
-            else:
-                raise IllegalArgumentError("update scripts support only assignments")
+    ctx_obj = {"_source": source, "op": "index"}
+    if ctx_extra:
+        ctx_obj.update(ctx_extra)
+    try:
+        program = compile_painless(src)
+    except Exception as e:
+        raise IllegalArgumentError(f"compile error in update script: {e}")
+    execute(program, {"ctx": ctx_obj, "params": params})
+    if ctx_extra is not None:
+        ctx_extra["op"] = ctx_obj.get("op", "index")
     return source
-
-
-def _attr_path(node) -> Optional[List[str]]:
-    import ast
-    parts: List[str] = []
-    while isinstance(node, (ast.Attribute, ast.Subscript)):
-        if isinstance(node, ast.Attribute):
-            parts.append(node.attr)
-            node = node.value
-        else:
-            if isinstance(node.slice, ast.Constant):
-                parts.append(str(node.slice.value))
-            node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    return list(reversed(parts))
-
-
-def _eval_simple(node, source: dict, params: dict):
-    import ast
-    if isinstance(node, ast.Constant):
-        return node.value
-    if isinstance(node, ast.List):
-        return [_eval_simple(e, source, params) for e in node.elts]
-    if isinstance(node, (ast.Attribute, ast.Subscript, ast.Name)):
-        path = _attr_path(node)
-        if path and path[0] == "params":
-            obj: Any = params
-            for p in path[1:]:
-                obj = obj[p]
-            return obj
-        if path and path[0] == "ctx" and len(path) > 1 and path[1] == "_source":
-            obj = source
-            for p in path[2:]:
-                obj = obj.get(p) if isinstance(obj, dict) else None
-            return obj
-    if isinstance(node, ast.BinOp):
-        left = _eval_simple(node.left, source, params)
-        right = _eval_simple(node.right, source, params)
-        ops = {ast.Add: lambda a, b: a + b, ast.Sub: lambda a, b: a - b,
-               ast.Mult: lambda a, b: a * b, ast.Div: lambda a, b: a / b}
-        return ops[type(node.op)](left, right)
-    raise IllegalArgumentError("unsupported expression in update script")
 
 
 def _sort_key_tuple(sort_values, body):

@@ -324,27 +324,45 @@ def update_by_query(node, index: str, body: dict) -> dict:
     task = node.tasks.register("indices:data/write/update/byquery",
                                f"update-by-query [{index}]")
     updated = 0
+    deleted = 0
+    noops = 0
     failures = []
     try:
         for doc in _scan_all(node, index, query):
             if task.cancelled:
                 break
             source = doc["_source"]
+            op = "index"
             if script is not None:
                 from elasticsearch_tpu.node import _apply_update_script
-                source = _apply_update_script(dict(source), script)
+                verdict = {}
+                try:
+                    source = _apply_update_script(dict(source), script,
+                                                  ctx_extra=verdict)
+                except SearchEngineError as e:
+                    failures.append({"id": doc["_id"], "cause": e.to_dict()})
+                    continue
+                op = verdict.get("op", "index")
             try:
-                node.index_doc(doc["_index"], doc["_id"], source,
-                               if_seq_no=doc.get("_seq_no"),
-                               if_primary_term=doc.get("_primary_term"))
-                updated += 1
+                if op == "none":
+                    noops += 1
+                elif op == "delete":
+                    node.delete_doc(doc["_index"], doc["_id"])
+                    deleted += 1
+                else:
+                    node.index_doc(doc["_index"], doc["_id"], source,
+                                   if_seq_no=doc.get("_seq_no"),
+                                   if_primary_term=doc.get("_primary_term"))
+                    updated += 1
             except SearchEngineError as e:
                 failures.append({"id": doc["_id"], "cause": e.to_dict()})
         node.indices.get(index).refresh()
     finally:
         node.tasks.unregister(task)
-    return {"took": 0, "total": updated, "updated": updated, "deleted": 0,
-            "version_conflicts": len(failures), "noops": 0, "failures": failures}
+    return {"took": 0, "total": updated + deleted + noops,
+            "updated": updated, "deleted": deleted,
+            "version_conflicts": len(failures), "noops": noops,
+            "failures": failures}
 
 
 def delete_by_query(node, index: str, body: dict) -> dict:

@@ -283,17 +283,124 @@ class Script:
             raise ParsingError("script must define [source]")
         self.source = spec["source"]
         self.params = spec.get("params", {})
+        # pure expressions run BATCHED over the candidate set (numpy /
+        # device); statement scripts (loops, if/else, defs) compile to the
+        # sandboxed Painless interpreter and run per document — scripts
+        # steer control flow, the hot loops stay vectorized
+        self.tree = None
+        self.program = None
         try:
             self.tree = ast.parse(self.source, mode="eval")
-        except SyntaxError as e:
-            raise ParsingError(f"compile error in script [{self.source}]: {e}")
+        except SyntaxError:
+            from elasticsearch_tpu.script.painless import compile_painless
+            try:
+                self.program = compile_painless(self.source)
+            except ParsingError as e:
+                raise ParsingError(
+                    f"compile error in script [{self.source}]: {e}")
 
     def evaluate(self, ctx: SearchContext, rows: np.ndarray,
                  base_scores: np.ndarray) -> np.ndarray:
-        ev = _Evaluator(ctx, rows, self.params, base_scores)
-        out = ev.eval(self.tree)
-        return np.broadcast_to(np.asarray(out, dtype=np.float64),
-                               (len(rows),)).astype(np.float32)
+        if self.tree is not None:
+            # expression fast path: one batched numpy evaluation; genuine
+            # script errors (unknown names/attrs) propagate as 400s
+            ev = _Evaluator(ctx, rows, self.params, base_scores)
+            out = ev.eval(self.tree)
+            return np.broadcast_to(np.asarray(out, dtype=np.float64),
+                                   (len(rows),)).astype(np.float32)
+        return self._evaluate_painless(ctx, rows, base_scores)
+
+    def _evaluate_painless(self, ctx: SearchContext, rows: np.ndarray,
+                           base_scores: np.ndarray) -> np.ndarray:
+        from elasticsearch_tpu.script.painless import execute
+
+        out = np.zeros(len(rows), dtype=np.float32)
+        batch_ev = _Evaluator(ctx, rows, self.params, base_scores)
+        cur = {"i": 0}
+        # vector kernels are computed ONCE for the whole candidate batch and
+        # indexed per document — a per-row call would redo the full matmul
+        kernel_cache: Dict[tuple, np.ndarray] = {}
+
+        def batched(kernel_name):
+            fn = getattr(batch_ev, kernel_name)
+
+            def call(q, field):
+                key = (kernel_name, field, tuple(np.ravel(q)))
+                if key not in kernel_cache:
+                    kernel_cache[key] = fn(q, field)
+                return float(kernel_cache[key][cur["i"]])
+            return call
+
+        bindings = {
+            "doc": None, "params": self.params, "_score": 0.0,
+            "cosineSimilarity": batched("cosine_similarity"),
+            "dotProduct": batched("dot_product"),
+            "l1norm": batched("l1norm"),
+            "l2norm": batched("l2norm"),
+            "saturation": lambda v, k: v / (v + k),
+            "sigmoid": lambda v, k, a: v ** a / (k ** a + v ** a),
+        }
+        for i, row in enumerate(rows):
+            cur["i"] = i
+            bindings["doc"] = _ScalarDoc(ctx, int(row))
+            bindings["_score"] = float(base_scores[i])
+            value = execute(self.program, bindings)
+            out[i] = float(value) if value is not None else 0.0
+        return out
+
+
+class _ScalarDocField:
+    """doc['field'] for one document in the per-doc interpreter."""
+
+    _painless_fields = ("value", "empty", "values", "length")
+
+    def __init__(self, raw):
+        if raw is None:
+            self._values = []
+        elif isinstance(raw, list):
+            self._values = raw
+        else:
+            self._values = [raw]
+
+    @property
+    def value(self):
+        if not self._values:
+            raise IllegalArgumentError(
+                "A document doesn't have a value for a field! "
+                "Use doc[<field>].size()==0 to check if a document is "
+                "missing a field!")
+        return self._values[0]
+
+    @property
+    def values(self):
+        return list(self._values)
+
+    @property
+    def empty(self):
+        return not self._values
+
+    @property
+    def length(self):
+        return len(self._values)
+
+    def _painless_methods(self):
+        return {"size": lambda: len(self._values),
+                "isEmpty": lambda: not self._values,
+                "get": lambda i: self._values[int(i)],
+                "contains": lambda x: x in self._values}
+
+
+class _ScalarDoc:
+    def __init__(self, ctx: SearchContext, row: int):
+        self._ctx = ctx
+        self._row = row
+
+    def __getitem__(self, field: str) -> _ScalarDocField:
+        return _ScalarDocField(self._ctx.reader.get_doc_value(field, self._row))
+
+    def _painless_methods(self):
+        return {"containsKey": lambda f:
+                self._ctx.reader.get_doc_value(f, self._row) is not None}
 
 
 class ScriptScoreQuery(Query):

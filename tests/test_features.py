@@ -218,7 +218,7 @@ def test_update_and_delete_by_query(client):
     seed(client, 10, index="ud")
     status, body = client.req("POST", "/ud/_update_by_query", {
         "query": {"term": {"level": "error"}},
-        "script": {"source": "ctx._source.flagged = True"}})
+        "script": {"source": "ctx._source.flagged = true"}})
     assert body["updated"] == 2  # i=0,5
     client.req("POST", "/ud/_refresh")
     _, cnt = client.req("POST", "/ud/_count", {"query": {"term": {"flagged": True}}})

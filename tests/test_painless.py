@@ -1,0 +1,253 @@
+"""Painless interpreter: language surface, sandbox, and script contexts
+(modules/lang-painless analog; elasticsearch_tpu/script/painless.py)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.script.painless import (
+    PainlessError, compile_painless, execute,
+)
+
+
+def run(src, **bindings):
+    return execute(compile_painless(src), bindings)
+
+
+# ------------------------------------------------------------------ language
+
+def test_arithmetic_and_implicit_return():
+    assert run("1 + 2 * 3") == 7
+    assert run("(1 + 2) * 3.0") == 9.0
+    assert run("7 % 3") == 1
+    assert run("'a' + 'b' + 1") == "ab1"
+
+
+def test_java_integer_division_truncates_toward_zero():
+    assert run("7 / 2") == 3
+    assert run("-7 / 2") == -3
+    assert run("7.0 / 2") == 3.5
+
+
+def test_variables_and_compound_assignment():
+    assert run("def x = 4; x += 3; x *= 2; return x;") == 14
+    assert run("int a = 1; int b = 2; def c = a + b; c") == 3
+
+
+def test_if_else_chain():
+    src = """
+    def grade(int n) {
+      if (n >= 90) { return 'A'; }
+      else if (n >= 80) { return 'B'; }
+      else { return 'C'; }
+    }
+    return grade(params.n);
+    """
+    assert run(src, params={"n": 95}) == "A"
+    assert run(src, params={"n": 85}) == "B"
+    assert run(src, params={"n": 10}) == "C"
+
+
+def test_for_loop_and_while():
+    assert run("def s = 0; for (int i = 0; i < 10; i++) { s += i; } return s;") == 45
+    assert run("def s = 0; def i = 0; while (i < 5) { s += i; i++; } s") == 10
+    assert run("def i = 0; do { i++; } while (i < 3); i") == 3
+
+
+def test_foreach_over_list_and_map():
+    assert run("def s = 0; for (def x : params.xs) { s += x; } s",
+               params={"xs": [1, 2, 3]}) == 6
+    assert run("def n = 0; for (k in params.m) { n += params.m[k]; } n",
+               params={"m": {"a": 1, "b": 2}}) == 3
+
+
+def test_break_continue():
+    src = """
+    def s = 0;
+    for (int i = 0; i < 100; i++) {
+      if (i % 2 == 0) { continue; }
+      if (i > 7) { break; }
+      s += i;
+    }
+    return s;
+    """
+    assert run(src) == 1 + 3 + 5 + 7
+
+
+def test_user_functions_and_recursion():
+    src = """
+    int fib(int n) { if (n < 2) { return n; } return fib(n-1) + fib(n-2); }
+    return fib(10);
+    """
+    assert run(src) == 55
+
+
+def test_list_and_map_literals_and_methods():
+    assert run("def l = [1, 2, 3]; l.add(4); return l.size();") == 4
+    assert run("def m = ['a': 1]; m.put('b', 2); return m.get('b');") == 2
+    assert run("def m = [:]; m.x = 5; return m.x;") == 5
+    assert run("def l = new ArrayList(); l.add('q'); l.contains('q')") is True
+    assert run("def m = new HashMap(); m.containsKey('nope')") is False
+
+
+def test_string_methods():
+    assert run("'hello'.substring(1, 3)") == "el"
+    assert run("'Hello'.toLowerCase()") == "hello"
+    assert run("'a,b,c'.split(',').length") == 3
+    assert run("'abc'.length()") == 3
+
+
+def test_ternary_and_elvis():
+    assert run("params.x > 3 ? 'big' : 'small'", params={"x": 5}) == "big"
+    assert run("params.missing ?: 'default'", params={}) == "default"
+
+
+def test_math_and_statics():
+    assert run("Math.max(3, Math.abs(-7))") == 7
+    assert run("Integer.parseInt('42') + 1") == 43
+    assert run("(int) 3.9") == 3
+
+
+def test_instanceof():
+    assert run("params.x instanceof String", params={"x": "s"}) is True
+    assert run("params.x instanceof List", params={"x": [1]}) is True
+    assert run("params.x instanceof Map", params={"x": 3}) is False
+
+
+# ------------------------------------------------------------------- sandbox
+
+def test_unknown_variable_rejected():
+    with pytest.raises(IllegalArgumentError):
+        run("__import__('os')")
+
+
+def test_unknown_method_rejected():
+    with pytest.raises(IllegalArgumentError):
+        run("'s'.__class__()")
+    with pytest.raises(IllegalArgumentError):
+        run("params.getClass()", params={})
+
+
+def test_unknown_constructor_rejected():
+    with pytest.raises(IllegalArgumentError):
+        run("new File('/etc/passwd')")
+
+
+def test_infinite_loop_budget():
+    with pytest.raises(IllegalArgumentError, match="loop iteration budget"):
+        run("def i = 0; while (true) { i += 1; } i")
+
+
+def test_recursion_depth_capped():
+    with pytest.raises(IllegalArgumentError, match="call depth"):
+        run("int f(int n) { return f(n + 1); } return f(0);")
+
+
+def test_syntax_error_reported():
+    with pytest.raises(PainlessError):
+        compile_painless("def x = ;")
+
+
+# ----------------------------------------------------------- script contexts
+
+@pytest.fixture
+def scoring_ctx(tmp_path):
+    from elasticsearch_tpu.index.analysis import AnalysisRegistry
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.search.queries import SearchContext
+
+    mapper = MapperService({"properties": {"n": {"type": "long"},
+                                           "tags": {"type": "keyword"}}},
+                           registry=AnalysisRegistry())
+    eng = Engine(str(tmp_path / "s"), mapper, translog_sync="async")
+    for i in range(6):
+        eng.index(str(i), {"n": i, "tags": ["even" if i % 2 == 0 else "odd"]})
+    reader = eng.refresh()
+    yield SearchContext(reader, mapper), reader
+    eng.close()
+
+
+def test_statement_script_score(scoring_ctx):
+    from elasticsearch_tpu.search.script_score import Script
+    ctx, reader = scoring_ctx
+    rows = np.arange(6, dtype=np.int64)
+    base = np.ones(6, dtype=np.float32)
+    script = Script({"source": """
+        def v = doc['n'].value;
+        if (v % 2 == 0) { return v * 10; }
+        return v;
+    """})
+    out = script.evaluate(ctx, rows, base)
+    assert list(out) == [0.0, 1.0, 20.0, 3.0, 40.0, 5.0]
+
+
+def test_statement_script_with_loop_over_doc_values(scoring_ctx):
+    from elasticsearch_tpu.search.script_score import Script
+    ctx, reader = scoring_ctx
+    rows = np.arange(6, dtype=np.int64)
+    script = Script({"source": """
+        def total = 0;
+        for (def t : doc['tags'].values) {
+          if (t == 'even') { total += 100; }
+        }
+        return total + doc['n'].value;
+    """})
+    out = script.evaluate(ctx, rows, np.zeros(6, dtype=np.float32))
+    assert list(out) == [100.0, 1.0, 102.0, 3.0, 104.0, 5.0]
+
+
+def test_expression_fast_path_still_vectorized(scoring_ctx):
+    from elasticsearch_tpu.search.script_score import Script
+    ctx, reader = scoring_ctx
+    script = Script({"source": "doc['n'].value * 2 + _score"})
+    assert script.tree is not None  # batched numpy path
+    out = script.evaluate(ctx, np.arange(6, dtype=np.int64),
+                          np.ones(6, dtype=np.float32))
+    assert list(out) == [1.0, 3.0, 5.0, 7.0, 9.0, 11.0]
+
+
+def test_update_script_with_loops_and_ctx(tmp_path):
+    from elasticsearch_tpu.node import Node
+    node = Node(str(tmp_path / "d"))
+    node.index_doc("t", "1", {"counts": [1, 2, 3], "total": 0})
+    node.update_doc("t", "1", {"script": {"source": """
+        ctx._source.total = 0;
+        for (def c : ctx._source.counts) { ctx._source.total += c; }
+        ctx._source.tag = params.tag;
+    """, "params": {"tag": "summed"}}})
+    doc = node.get_doc("t", "1")
+    assert doc["_source"]["total"] == 6
+    assert doc["_source"]["tag"] == "summed"
+    node.close()
+
+
+def test_update_script_ctx_op_none_and_delete(tmp_path):
+    from elasticsearch_tpu.node import Node
+    node = Node(str(tmp_path / "d2"))
+    node.index_doc("t", "1", {"stale": False, "n": 1})
+    node.index_doc("t", "2", {"stale": True, "n": 2})
+
+    # ctx.op = 'none' -> noop, document untouched
+    r = node.update_doc("t", "1", {"script": {"source":
+        "if (ctx._source.stale == false) { ctx.op = 'none' } "
+        "else { ctx._source.n += 1 }"}})
+    assert r["result"] == "noop"
+    assert node.get_doc("t", "1")["_source"]["n"] == 1
+
+    # ctx.op = 'delete' -> document removed
+    r = node.update_doc("t", "2", {"script": {"source":
+        "if (ctx._source.stale) { ctx.op = 'delete' }"}})
+    assert r["result"] == "deleted"
+    assert not node.get_doc("t", "2")["found"]
+    node.close()
+
+
+def test_null_arithmetic_is_client_error(tmp_path):
+    from elasticsearch_tpu.node import Node
+    node = Node(str(tmp_path / "d3"))
+    node.index_doc("t", "1", {"a": 1})
+    with pytest.raises(IllegalArgumentError):
+        node.update_doc("t", "1", {"script": {"source":
+            "ctx._source.missing += 1"}})
+    node.close()
