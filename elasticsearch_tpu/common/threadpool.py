@@ -1,0 +1,224 @@
+"""Named per-workload thread pools with bounded queues and rejection.
+
+Re-design of `threadpool/ThreadPool.java:115-180` + `EsThreadPoolExecutor`:
+every workload class gets its own executor so a flood of one request type
+cannot starve the others — searches queue behind searches, never behind
+bulk indexing. Fixed pools have a hard queue bound and REJECT above it
+(the request-level backpressure that keeps an overloaded node answering
+429s instead of melting); scaling pools grow to a cap and queue unbounded
+(management work must never be dropped).
+
+The compute hot path runs on the accelerator regardless — these pools
+schedule the host-side request work (engine writes, postings scoring,
+fetches), exactly the role the reference's executors play around Lucene.
+
+| pool             | type    | size                | queue |
+|------------------|---------|---------------------|-------|
+| search           | fixed   | 1.5*cores + 1       | 1000  |
+| write            | fixed   | cores               | 10000 |
+| get              | fixed   | cores               | 1000  |
+| analyze          | fixed   | 1                   | 16    |
+| search_throttled | fixed   | 1                   | 100   |
+| force_merge      | fixed   | 1                   | unbounded |
+| generic          | scaling | 4..max(128, cores*4)| -     |
+| management       | scaling | 1..5                | -     |
+| flush/refresh    | scaling | 1..cores/2          | -     |
+| snapshot         | scaling | 1..cores/2          | -     |
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+
+
+class EsRejectedExecutionError(SearchEngineError):
+    """Queue full: the caller gets backpressure (HTTP 429)."""
+
+    status = 429
+
+    def to_dict(self):
+        return {"type": "es_rejected_execution_exception",
+                "reason": str(self)}
+
+
+def _cores() -> int:
+    return os.cpu_count() or 4
+
+
+_UNBOUNDED = -1
+
+
+class NamedExecutor:
+    """One workload's executor with explicit queue accounting: the backing
+    stdlib executor queues unboundedly, so the bound is enforced by counting
+    submitted-but-unfinished tasks (EsThreadPoolExecutor + SizeBlockingQueue
+    semantics)."""
+
+    def __init__(self, name: str, threads: int, queue_size: int,
+                 pool_type: str = "fixed"):
+        self.name = name
+        self.threads = threads
+        self.queue_size = queue_size
+        self.pool_type = pool_type
+        self._lock = threading.Lock()
+        self.active = 0
+        self.queued = 0
+        self.completed = 0
+        self.rejected = 0
+        self.largest = 0
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=threads, thread_name_prefix=f"es[{name}]")
+
+    def submit(self, fn: Callable, *args, **kwargs) -> concurrent.futures.Future:
+        with self._lock:
+            if self.queue_size != _UNBOUNDED and self.queued >= self.queue_size:
+                self.rejected += 1
+                raise EsRejectedExecutionError(
+                    f"rejected execution on [{self.name}]: queue capacity "
+                    f"[{self.queue_size}] is full")
+            self.queued += 1
+
+        def run():
+            with self._lock:
+                self.queued -= 1
+                self.active += 1
+                self.largest = max(self.largest, self.active)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                with self._lock:
+                    self.active -= 1
+                    self.completed += 1
+
+        try:
+            return self._executor.submit(run)
+        except RuntimeError:
+            with self._lock:
+                self.queued -= 1
+                self.rejected += 1
+            raise EsRejectedExecutionError(
+                f"[{self.name}] executor is shut down")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"threads": self.threads,
+                    "queue": self.queued,
+                    "active": self.active,
+                    "rejected": self.rejected,
+                    "largest": self.largest,
+                    "completed": self.completed}
+
+    def shutdown(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+
+def _default_pools() -> Dict[str, tuple]:
+    c = _cores()
+    half = max(1, c // 2)
+    return {
+        # name: (threads, queue_size, type) — ThreadPool.java:164-180 sizes
+        "search": (int(c * 1.5) + 1, 1000, "fixed"),
+        "write": (c, 10000, "fixed"),
+        "get": (c, 1000, "fixed"),
+        "analyze": (1, 16, "fixed"),
+        "search_throttled": (1, 100, "fixed"),
+        "force_merge": (1, _UNBOUNDED, "fixed"),
+        "generic": (max(128, c * 4), _UNBOUNDED, "scaling"),
+        "management": (5, _UNBOUNDED, "scaling"),
+        "flush": (half, _UNBOUNDED, "scaling"),
+        "refresh": (half, _UNBOUNDED, "scaling"),
+        "snapshot": (half, _UNBOUNDED, "scaling"),
+    }
+
+
+class ThreadPool:
+    """The node's executor registry (`threadpool/ThreadPool.java`).
+
+    Executors spin up lazily — an idle node holds no worker threads for
+    pools it never uses. `settings` may override sizes via
+    `thread_pool.<name>.{size,queue_size}`.
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._specs = _default_pools()
+        settings = settings or {}
+        for name in list(self._specs):
+            threads, queue, ptype = self._specs[name]
+            threads = int(settings.get(f"thread_pool.{name}.size", threads))
+            queue = int(settings.get(f"thread_pool.{name}.queue_size", queue))
+            self._specs[name] = (threads, queue, ptype)
+        self._pools: Dict[str, NamedExecutor] = {}
+        self._lock = threading.Lock()
+
+    def executor(self, name: str) -> NamedExecutor:
+        pool = self._pools.get(name)
+        if pool is not None:
+            return pool
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                spec = self._specs.get(name)
+                if spec is None:
+                    raise SearchEngineError(f"no thread pool named [{name}]")
+                pool = NamedExecutor(name, spec[0], spec[1], spec[2])
+                self._pools[name] = pool
+            return pool
+
+    def submit(self, name: str, fn: Callable, *args, **kwargs):
+        return self.executor(name).submit(fn, *args, **kwargs)
+
+    def stats(self) -> Dict[str, dict]:
+        out = {}
+        for name in sorted(self._specs):
+            pool = self._pools.get(name)
+            if pool is not None:
+                out[name] = pool.stats()
+            else:
+                threads, queue, _ = self._specs[name]
+                out[name] = {"threads": 0, "queue": 0, "active": 0,
+                             "rejected": 0, "largest": 0, "completed": 0}
+        return out
+
+    def info(self) -> Dict[str, dict]:
+        return {name: {"type": ptype, "size": threads,
+                       "queue_size": queue if queue != _UNBOUNDED else -1}
+                for name, (threads, queue, ptype) in sorted(self._specs.items())}
+
+    def shutdown(self) -> None:
+        for pool in self._pools.values():
+            pool.shutdown()
+
+
+# route → workload classification (the reference maps each TransportAction
+# to its executor; here the REST route prefix decides)
+def pool_for_route(method: str, path: str) -> str:
+    p = path.split("?")[0]
+    if "/_search" in p or "/_count" in p or "/_msearch" in p \
+            or "/_knn_search" in p or "/_async_search" in p \
+            or "/_field_caps" in p or "/_validate" in p or "/_explain" in p:
+        return "search"
+    if "/_analyze" in p:
+        return "analyze"
+    if "/_bulk" in p or "/_update" in p or "/_delete_by_query" in p \
+            or "/_update_by_query" in p or "/_reindex" in p:
+        return "write"
+    if "/_doc" in p or "/_create" in p or "/_source" in p:
+        return "write" if method in ("PUT", "POST", "DELETE") else "get"
+    if "/_mget" in p or "/_termvectors" in p:
+        return "get"
+    if "/_cat" in p or "/_cluster" in p or "/_nodes" in p or "/_tasks" in p:
+        return "management"
+    if "/_snapshot" in p:
+        return "snapshot"
+    if "/_flush" in p:
+        return "flush"
+    if "/_refresh" in p:
+        return "refresh"
+    if "/_forcemerge" in p:
+        return "force_merge"
+    return "generic"

@@ -1,0 +1,145 @@
+"""OS / process / filesystem / runtime probes for the stats APIs.
+
+Re-design of `monitor/os/OsProbe.java`, `monitor/process/ProcessProbe.java`,
+`monitor/fs/FsProbe.java`, and the JVM probes (SURVEY.md §2.1/§5.5): the
+reference reads MXBeans and /proc; here the probes read /proc and the
+stdlib directly (no psutil dependency). Each probe returns the exact stats
+sections `_nodes/stats` publishes.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import resource
+import shutil
+import threading
+import time
+
+_START_TIME = time.time()
+
+
+def _meminfo() -> dict:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0].rstrip(":")] = int(parts[1]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def os_probe() -> dict:
+    """OsProbe.osStats(): load averages + memory + swap."""
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = -1.0
+    mem = _meminfo()
+    total = mem.get("MemTotal", 0)
+    available = mem.get("MemAvailable", mem.get("MemFree", 0))
+    used = max(total - available, 0)
+    return {
+        "timestamp": int(time.time() * 1000),
+        "cpu": {"load_average": {"1m": round(load1, 2), "5m": round(load5, 2),
+                                 "15m": round(load15, 2)},
+                "percent": _cpu_percent()},
+        "mem": {"total_in_bytes": total, "free_in_bytes": available,
+                "used_in_bytes": used,
+                "used_percent": round(100.0 * used / total, 1) if total else 0,
+                "free_percent": round(100.0 * available / total, 1) if total else 0},
+        "swap": {"total_in_bytes": mem.get("SwapTotal", 0),
+                 "free_in_bytes": mem.get("SwapFree", 0),
+                 "used_in_bytes": max(mem.get("SwapTotal", 0)
+                                      - mem.get("SwapFree", 0), 0)},
+        "allocated_processors": os.cpu_count() or 1,
+    }
+
+
+_last_cpu: dict = {}
+
+
+def _cpu_percent() -> int:
+    """Whole-system CPU busy %% since the previous probe (OsProbe reads
+    /proc/stat the same way; first call returns -1: no interval yet)."""
+    try:
+        with open("/proc/stat") as f:
+            fields = [int(x) for x in f.readline().split()[1:]]
+    except (OSError, ValueError):
+        return -1
+    idle = fields[3] + (fields[4] if len(fields) > 4 else 0)
+    total = sum(fields)
+    prev = _last_cpu.get("v")
+    _last_cpu["v"] = (idle, total)
+    if prev is None or total == prev[1]:
+        return -1
+    didle, dtotal = idle - prev[0], total - prev[1]
+    return int(round(100.0 * (dtotal - didle) / dtotal))
+
+
+def process_probe() -> dict:
+    """ProcessProbe.processStats(): fds, cpu, virtual/resident memory."""
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    try:
+        open_fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        open_fds = -1
+    try:
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except (ValueError, OSError):
+        soft = -1
+    vm_bytes = 0
+    try:
+        with open("/proc/self/statm") as f:
+            vm_bytes = int(f.read().split()[0]) * resource.getpagesize()
+    except (OSError, ValueError, IndexError):
+        pass
+    return {
+        "timestamp": int(time.time() * 1000),
+        "open_file_descriptors": open_fds,
+        "max_file_descriptors": soft,
+        "cpu": {"total_in_millis": int((usage.ru_utime + usage.ru_stime) * 1000),
+                "percent": -1},
+        "mem": {"resident_in_bytes": usage.ru_maxrss * 1024,
+                "total_virtual_in_bytes": vm_bytes},
+    }
+
+
+def fs_probe(data_path: str) -> dict:
+    """FsProbe.stats(): per-data-path totals."""
+    try:
+        du = shutil.disk_usage(data_path or ".")
+        total, free, available = du.total, du.free, du.free
+    except OSError:
+        total = free = available = 0
+    return {
+        "timestamp": int(time.time() * 1000),
+        "total": {"total_in_bytes": total, "free_in_bytes": free,
+                  "available_in_bytes": available},
+        "data": [{"path": data_path, "total_in_bytes": total,
+                  "free_in_bytes": free, "available_in_bytes": available}],
+    }
+
+
+def runtime_probe() -> dict:
+    """The JVM-probe analog for the Python runtime: heap-ish RSS, GC
+    collection counts per generation, thread count, uptime."""
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    gc_stats = gc.get_stats()
+    collectors = {}
+    for gen, s in enumerate(gc_stats):
+        collectors[f"gen{gen}"] = {
+            "collection_count": s.get("collections", 0),
+            "collected": s.get("collected", 0)}
+    return {
+        "timestamp": int(time.time() * 1000),
+        "uptime_in_millis": int((time.time() - _START_TIME) * 1000),
+        "mem": {"heap_used_in_bytes": usage.ru_maxrss * 1024,
+                "heap_max_in_bytes": _meminfo().get("MemTotal", 0)},
+        "gc": {"collectors": collectors},
+        "threads": {"count": threading.active_count(),
+                    "peak_count": threading.active_count()},
+    }

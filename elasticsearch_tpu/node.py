@@ -117,6 +117,8 @@ class Node:
         # shard request cache + node query cache (IndicesRequestCache /
         # IndicesQueryCache analogs), shared across this node's shards
         self.caches = NodeCaches()
+        from elasticsearch_tpu.common.threadpool import ThreadPool
+        self.thread_pool = ThreadPool(settings or {})
         self.search_slow_log = SlowLog("search")
         self.indexing_slow_log = SlowLog("indexing")
         self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
@@ -517,10 +519,24 @@ class Node:
                         (svc.name, use_partial_aggs), reader.gen, body)
                     result = self.caches.request.get(cache_key)
                 if result is None:
-                    result = execute_query_phase(
-                        reader, svc.mapper_service, body, vector_store=store,
-                        partial_aggs=use_partial_aggs,
-                        query_cache=self.caches.query)
+                    from elasticsearch_tpu.common.settings import setting_bool
+                    if setting_bool(svc.settings.get("index.frozen")):
+                        # frozen shards execute on the single-threaded
+                        # search_throttled pool (queue 100): cold data may
+                        # be searched, never at the expense of hot traffic
+                        # (x-pack frozen-indices + ThreadPool.java:129)
+                        result = self.thread_pool.submit(
+                            "search_throttled", execute_query_phase,
+                            reader, svc.mapper_service, body,
+                            vector_store=store,
+                            partial_aggs=use_partial_aggs,
+                            query_cache=self.caches.query).result()
+                    else:
+                        result = execute_query_phase(
+                            reader, svc.mapper_service, body,
+                            vector_store=store,
+                            partial_aggs=use_partial_aggs,
+                            query_cache=self.caches.query)
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
@@ -649,6 +665,22 @@ class Node:
         if ignore_throttled:
             services = [s for s in services
                         if not setting_bool(s.settings.get("index.frozen"))]
+        # scroll slicing (search/slice/SliceBuilder): slice {id, max}
+        # partitions the scan by a hash of _id, so `max` independent
+        # consumers can drain one logical scroll in parallel
+        slice_spec = body.pop("slice", None)
+        if slice_spec is not None:
+            try:
+                slice_id = int(slice_spec["id"])
+                slice_max = int(slice_spec["max"])
+            except (TypeError, KeyError, ValueError):
+                raise IllegalArgumentError(
+                    f"malformed slice [{slice_spec!r}]: expected "
+                    "{id, max}")
+            if not 0 <= slice_id < slice_max:
+                raise IllegalArgumentError(
+                    f"slice id [{slice_id}] must be in [0, {slice_max})")
+
         for svc in services:
             reader = svc.combined_reader()
             store = _MultiShardVectorStore(svc)
@@ -661,8 +693,16 @@ class Node:
             big.pop("from", None)
             result = execute_query_phase(reader, svc.mapper_service, big,
                                          vector_store=store)
-            total += result.total_hits
-            for i, row in enumerate(result.rows):
+            kept_rows = list(range(len(result.rows)))
+            if slice_spec is not None:
+                from elasticsearch_tpu.cluster.routing import hash_routing
+                kept_rows = [
+                    i for i in kept_rows
+                    if hash_routing(reader.get_id(int(result.rows[i])) or "")
+                    % slice_max == slice_id]
+            total += len(kept_rows) if slice_spec is not None else result.total_hits
+            for i in kept_rows:
+                row = result.rows[i]
                 sv = result.sort_values[i] if result.sort_values is not None else None
                 entries.append((svc, reader, int(row), float(result.scores[i]), sv))
         if body.get("sort"):
@@ -781,6 +821,7 @@ class Node:
         self.ml.close_all()
         self.plugins.remove_extensions()
         self.indices.close()
+        self.thread_pool.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -799,7 +840,9 @@ def _apply_update_script(source: dict, script_spec, ctx_extra=None) -> dict:
     list/map methods, user functions. Returns the mutated source; the
     script's operation verdict lands in ctx['op'] (UpdateHelper honors
     'none'/'delete'). Raises on compile/sandbox violations."""
-    from elasticsearch_tpu.script.painless import compile_painless, execute
+    from elasticsearch_tpu.script.painless import (
+        FrozenParams, compile_painless, execute,
+    )
 
     if isinstance(script_spec, str):
         script_spec = {"source": script_spec}
@@ -821,7 +864,7 @@ def _apply_update_script(source: dict, script_spec, ctx_extra=None) -> dict:
         program = compile_painless(src)
     except Exception as e:
         raise IllegalArgumentError(f"compile error in update script: {e}")
-    execute(program, {"ctx": ctx_obj, "params": params})
+    execute(program, {"ctx": ctx_obj, "params": FrozenParams(params)})
     if ctx_extra is not None:
         ctx_extra["op"] = ctx_obj.get("op", "index")
     return source

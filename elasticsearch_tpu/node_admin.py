@@ -359,7 +359,8 @@ def update_by_query(node, index: str, body: dict) -> dict:
         node.indices.get(index).refresh()
     finally:
         node.tasks.unregister(task)
-    return {"took": 0, "total": updated + deleted + noops,
+    # total = every processed doc, failures included (the ES contract)
+    return {"took": 0, "total": updated + deleted + noops + len(failures),
             "updated": updated, "deleted": deleted,
             "version_conflicts": len(failures), "noops": noops,
             "failures": failures}

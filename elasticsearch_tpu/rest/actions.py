@@ -408,15 +408,17 @@ def register_all(rc: RestController, node: Node) -> None:
                          "plugins": node.plugins.info()}}}
 
     def nodes_stats(req):
-        import resource
-        usage = resource.getrusage(resource.RUSAGE_SELF)
+        from elasticsearch_tpu.monitor.probes import (
+            fs_probe, os_probe, process_probe, runtime_probe,
+        )
         return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},
                      "cluster_name": node.cluster_name,
                      "nodes": {node.node_id: {
                          "name": node.node_name,
-                         "jvm": {"mem": {"heap_used_in_bytes": usage.ru_maxrss * 1024}},
-                         "process": {"cpu": {"total_in_millis": int(
-                             (usage.ru_utime + usage.ru_stime) * 1000)}},
+                         "jvm": runtime_probe(),
+                         "os": os_probe(),
+                         "fs": fs_probe(node.indices.data_path),
+                         "process": process_probe(),
                          "indices": {"docs": {"count": sum(
                              s.doc_count() for s in node.indices.indices.values())},
                                      "search": {"query_total":
@@ -432,12 +434,7 @@ def register_all(rc: RestController, node: Node) -> None:
                                          "miss_count": node.caches.query.misses,
                                          "evictions": node.caches.query.evictions}},
                          "breakers": node.breakers.stats(),
-                         "thread_pool": {name: {"threads": 0, "queue": 0,
-                                                "active": 0, "rejected": 0,
-                                                "completed":
-                                                node.counters.get(name, 0)}
-                                         for name in ("search", "write", "get",
-                                                      "generic")}}}}
+                         "thread_pool": node.thread_pool.stats()}}}
 
     rc.register("GET", "/_cluster/health", cluster_health)
     rc.register("GET", "/_cluster/stats", cluster_stats)

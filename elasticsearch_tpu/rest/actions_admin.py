@@ -306,9 +306,8 @@ def register_admin(rc: RestController, node: Node) -> None:
         return _table(req, ["name", "index_patterns", "order", "version"], rows)
 
     def cat_thread_pool(req):
-        rows = [[node.node_name, name, 0, 0, 0]
-                for name in ("search", "write", "get", "generic", "management",
-                             "flush", "refresh", "snapshot", "force_merge")]
+        rows = [[node.node_name, name, s["active"], s["queue"], s["rejected"]]
+                for name, s in node.thread_pool.stats().items()]
         return _table(req, ["node_name", "name", "active", "queue", "rejected"],
                       rows)
 

@@ -10,7 +10,6 @@ external dependencies — stdlib asyncio only.
 from __future__ import annotations
 
 import asyncio
-import concurrent.futures
 import json
 import urllib.parse
 from typing import Optional, Tuple
@@ -40,13 +39,17 @@ MAX_BODY = 100 * 1024 * 1024  # reference http.max_content_length default 100mb
 
 class HttpServer:
     def __init__(self, controller: RestController, host: str = "127.0.0.1",
-                 port: int = 9200, max_workers: int = 8):
+                 port: int = 9200, max_workers: int = 8, thread_pool=None):
+        from elasticsearch_tpu.common.threadpool import ThreadPool
         self.controller = controller
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
-        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers,
-                                                           thread_name_prefix="http_worker")
+        # per-workload named executors (ThreadPool.java): requests route to
+        # the pool their workload class owns, so e.g. a bulk flood queues in
+        # `write` while `search` keeps draining; full queues answer 429
+        self.thread_pool = thread_pool or ThreadPool()
+        self._owns_pool = thread_pool is None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -57,7 +60,8 @@ class HttpServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
-        self._pool.shutdown(wait=False)
+        if self._owns_pool:
+            self.thread_pool.shutdown()
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -67,10 +71,18 @@ class HttpServer:
                 if request is None:
                     break
                 method, path, query, headers, body = request
-                loop = asyncio.get_running_loop()
-                status, payload = await loop.run_in_executor(
-                    self._pool, self.controller.dispatch, method, path, query,
-                    body, headers.get("content-type"), headers)
+                from elasticsearch_tpu.common.threadpool import (
+                    EsRejectedExecutionError, pool_for_route,
+                )
+                try:
+                    future = self.thread_pool.submit(
+                        pool_for_route(method, path),
+                        self.controller.dispatch, method, path, query,
+                        body, headers.get("content-type"), headers)
+                    status, payload = await asyncio.wrap_future(future)
+                except EsRejectedExecutionError as e:
+                    status, payload = 429, {"error": e.to_dict(),
+                                            "status": 429}
                 keep_alive = headers.get("connection", "keep-alive").lower() != "close"
                 await self._write_response(writer, status, payload, keep_alive,
                                            accept=headers.get("accept"))

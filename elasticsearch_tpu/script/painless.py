@@ -87,6 +87,8 @@ class Parser:
         return self.toks[min(self.i + k, len(self.toks) - 1)]
 
     def next(self) -> Tuple[str, str]:
+        if self.i >= len(self.toks) - 1:
+            raise PainlessError("unexpected end of script")
         t = self.toks[self.i]
         self.i += 1
         return t
@@ -211,7 +213,9 @@ class Parser:
 
     def _try_declaration(self):
         kind, val = self.peek()
-        if kind == "id" and val in _TYPE_WORDS and self.peek(1)[0] == "id":
+        if kind == "id" and val in _TYPE_WORDS and \
+                (self.peek(1)[0] == "id" or self.peek(1)[1] == "<"):
+            save = self.i
             self.next()
             # generic parameters of the type are not modelled: skip <...>
             if self.peek()[1] == "<":
@@ -221,6 +225,9 @@ class Parser:
                     depth += t.count("<") - t.count(">")
                     if depth <= 0:
                         break
+            if self.peek()[0] != "id":
+                self.i = save
+                return None
             entries = []
             while True:
                 name = self.next()[1]
@@ -391,10 +398,13 @@ class Parser:
             return ("const", float(text) if ("." in text or "e" in text
                                              or "E" in text) else int(text))
         if kind == "str":
-            body = val[1:-1]
-            return ("const", body.replace("\\'", "'").replace('\\"', '"')
-                    .replace("\\\\", "\\").replace("\\n", "\n")
-                    .replace("\\t", "\t"))
+            # single-pass escape decode: chained str.replace would re-consume
+            # the backslash an earlier replacement produced
+            escapes = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                       "'": "'", '"': '"'}
+            return ("const", re.sub(
+                r"\\(.)", lambda m: escapes.get(m.group(1), m.group(0)),
+                val[1:-1]))
         if val == "null":
             return ("const", None)
         if val == "true":
@@ -550,7 +560,27 @@ def _list_methods(lst: list) -> Dict[str, Callable]:
     }
 
 
+class FrozenParams(dict):
+    """Script `params` are read-only in the reference (mutation throws an
+    UnsupportedOperationException); a mutable params dict shared across
+    per-document executions would leak state between documents."""
+
+
 def _map_methods(mp: dict) -> Dict[str, Callable]:
+    if isinstance(mp, FrozenParams):
+        return {
+            "get": lambda k: mp.get(k),
+            "getOrDefault": lambda k, d: mp.get(k, d),
+            "containsKey": lambda k: k in mp,
+            "containsValue": lambda v: v in mp.values(),
+            "size": lambda: len(mp),
+            "isEmpty": lambda: len(mp) == 0,
+            "keySet": lambda: list(mp.keys()),
+            "values": lambda: list(mp.values()),
+            "entrySet": lambda: [{"key": k, "value": v}
+                                 for k, v in mp.items()],
+            "toString": lambda: _to_string(mp),
+        }
     return {
         "put": lambda k, v: mp.__setitem__(k, v),
         "get": lambda k: mp.get(k),
@@ -834,6 +864,8 @@ class Interpreter:
         if kind == "index":
             base = self.eval(target[1], scope)
             key = self.eval(target[2], scope)
+            if isinstance(base, FrozenParams):
+                raise IllegalArgumentError("params are read-only")
             if isinstance(base, list):
                 base[int(key)] = value
             elif isinstance(base, dict):
@@ -843,6 +875,8 @@ class Interpreter:
             return
         if kind == "field":
             base = self.eval(target[1], scope)
+            if isinstance(base, FrozenParams):
+                raise IllegalArgumentError("params are read-only")
             if isinstance(base, dict):
                 base[target[2]] = value
                 return

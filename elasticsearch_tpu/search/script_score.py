@@ -331,8 +331,9 @@ class Script:
                 return float(kernel_cache[key][cur["i"]])
             return call
 
+        from elasticsearch_tpu.script.painless import FrozenParams
         bindings = {
-            "doc": None, "params": self.params, "_score": 0.0,
+            "doc": None, "params": FrozenParams(self.params), "_score": 0.0,
             "cosineSimilarity": batched("cosine_similarity"),
             "dotProduct": batched("dot_product"),
             "l1norm": batched("l1norm"),
@@ -345,7 +346,12 @@ class Script:
             bindings["doc"] = _ScalarDoc(ctx, int(row))
             bindings["_score"] = float(base_scores[i])
             value = execute(self.program, bindings)
-            out[i] = float(value) if value is not None else 0.0
+            try:
+                out[i] = float(value) if value is not None else 0.0
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"script_score script returned a non-numeric value "
+                    f"[{value!r}]")
         return out
 
 

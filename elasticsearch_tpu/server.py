@@ -93,7 +93,8 @@ def main(argv=None) -> int:
     node.natives = natives
     controller = RestController()
     register_all(controller, node)
-    server = HttpServer(controller, host=args.host, port=args.port)
+    server = HttpServer(controller, host=args.host, port=args.port,
+                        thread_pool=node.thread_pool)
 
     async def run():
         await server.start()

@@ -211,3 +211,51 @@ def test_deprecations(client):
     client.req("PUT", "/frozen1", {"settings": {"index.frozen": True}})
     st, body = client.req("GET", "/_migration/deprecations")
     assert any("frozen" in d["message"] for d in body["deprecations"])
+
+
+def test_monitor_probes_shapes():
+    """OsProbe/ProcessProbe/FsProbe/runtime probe stats sections."""
+    from elasticsearch_tpu.monitor.probes import (
+        fs_probe, os_probe, process_probe, runtime_probe,
+    )
+    o = os_probe()
+    assert o["mem"]["total_in_bytes"] > 0
+    assert o["allocated_processors"] >= 1
+    assert "load_average" in o["cpu"]
+    p = process_probe()
+    assert p["open_file_descriptors"] > 0
+    assert p["mem"]["resident_in_bytes"] > 0
+    f = fs_probe(".")
+    assert f["total"]["total_in_bytes"] > 0
+    assert f["data"][0]["free_in_bytes"] >= 0
+    j = runtime_probe()
+    assert j["threads"]["count"] >= 1
+    assert "collectors" in j["gc"]
+
+
+def test_scroll_slicing_partitions_disjointly(tmp_path):
+    """slice {id,max} splits one logical scroll into disjoint, complete
+    partitions (search/slice/SliceBuilder)."""
+    from elasticsearch_tpu.node import Node
+    node = Node(str(tmp_path / "sl"))
+    for i in range(40):
+        node.index_doc("logs", str(i), {"n": i})
+    node.indices.get("logs").refresh()
+
+    seen = []
+    for sid in range(3):
+        resp = node.search_scroll_start(
+            "logs", {"query": {"match_all": {}}, "size": 100,
+                     "slice": {"id": sid, "max": 3}})
+        ids = [h["_id"] for h in resp["hits"]["hits"]]
+        assert resp["hits"]["total"]["value"] == len(ids)
+        seen.append(set(ids))
+    # disjoint and complete
+    assert seen[0] | seen[1] | seen[2] == {str(i) for i in range(40)}
+    assert not (seen[0] & seen[1]) and not (seen[1] & seen[2]) \
+        and not (seen[0] & seen[2])
+    # every slice got SOMETHING (hash distributes)
+    assert all(s for s in seen)
+    with __import__("pytest").raises(Exception):
+        node.search_scroll_start("logs", {"slice": {"id": 5, "max": 3}})
+    node.close()

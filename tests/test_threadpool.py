@@ -1,0 +1,126 @@
+"""Named thread pools: sizing, queue bounds, rejection, stats, routing
+(ThreadPool.java / EsThreadPoolExecutor analogs)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.threadpool import (
+    EsRejectedExecutionError, ThreadPool, pool_for_route,
+)
+
+
+def test_default_pools_and_info():
+    tp = ThreadPool()
+    info = tp.info()
+    assert info["search"]["type"] == "fixed"
+    assert info["search"]["queue_size"] == 1000
+    assert info["write"]["queue_size"] == 10000
+    assert info["generic"]["type"] == "scaling"
+    assert info["force_merge"]["size"] == 1
+    # lazily allocated: no executors yet
+    assert all(s["completed"] == 0 for s in tp.stats().values())
+    tp.shutdown()
+
+
+def test_settings_overrides():
+    tp = ThreadPool({"thread_pool.search.size": 2,
+                     "thread_pool.search.queue_size": 7})
+    assert tp.info()["search"] == {"type": "fixed", "size": 2,
+                                   "queue_size": 7}
+    tp.shutdown()
+
+
+def test_submit_runs_and_counts():
+    tp = ThreadPool()
+    futures = [tp.submit("search", lambda i=i: i * 2) for i in range(10)]
+    assert sorted(f.result(timeout=5) for f in futures) == list(range(0, 20, 2))
+    s = tp.stats()["search"]
+    assert s["completed"] == 10 and s["rejected"] == 0
+    tp.shutdown()
+
+
+def test_queue_full_rejects_with_429_semantics():
+    tp = ThreadPool({"thread_pool.search.size": 1,
+                     "thread_pool.search.queue_size": 2})
+    gate = threading.Event()
+    blocker = tp.submit("search", gate.wait, 10)
+    # the single worker is blocked; fill the 2-slot queue (accounting counts
+    # queued+running, so the blocker occupies one slot until it RUNS)
+    time.sleep(0.05)
+    fillers = [tp.submit("search", lambda: None) for _ in range(2)]
+    with pytest.raises(EsRejectedExecutionError) as e:
+        tp.submit("search", lambda: None)
+    assert e.value.status == 429
+    assert tp.stats()["search"]["rejected"] == 1
+    gate.set()
+    for f in fillers:
+        f.result(timeout=5)
+    tp.shutdown()
+
+
+def test_pools_are_isolated():
+    """A saturated write pool must not impede search (per-workload pools)."""
+    tp = ThreadPool({"thread_pool.write.size": 1,
+                     "thread_pool.write.queue_size": 1})
+    gate = threading.Event()
+    tp.submit("write", gate.wait, 10)
+    time.sleep(0.05)
+    tp.submit("write", lambda: None)
+    with pytest.raises(EsRejectedExecutionError):
+        tp.submit("write", lambda: None)
+    # search still runs immediately
+    assert tp.submit("search", lambda: 42).result(timeout=5) == 42
+    gate.set()
+    tp.shutdown()
+
+
+def test_route_classification():
+    assert pool_for_route("POST", "/idx/_search") == "search"
+    assert pool_for_route("GET", "/_msearch") == "search"
+    assert pool_for_route("POST", "/_bulk") == "write"
+    assert pool_for_route("PUT", "/idx/_doc/1") == "write"
+    assert pool_for_route("GET", "/idx/_doc/1") == "get"
+    assert pool_for_route("GET", "/_mget") == "get"
+    assert pool_for_route("GET", "/_cat/indices") == "management"
+    assert pool_for_route("GET", "/_cluster/health") == "management"
+    assert pool_for_route("PUT", "/_snapshot/repo/snap") == "snapshot"
+    assert pool_for_route("POST", "/idx/_refresh") == "refresh"
+    assert pool_for_route("POST", "/idx/_forcemerge") == "force_merge"
+    assert pool_for_route("PUT", "/idx") == "generic"
+
+
+def test_node_stats_exposes_thread_pools(tmp_path):
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    node = Node(str(tmp_path / "d"))
+    rc = RestController()
+    register_all(rc, node)
+    node.thread_pool.submit("search", lambda: 1).result(timeout=5)
+    status, body = rc.dispatch("GET", "/_nodes/stats", {}, b"", None)
+    tp = body["nodes"][node.node_id]["thread_pool"]
+    assert tp["search"]["completed"] == 1
+    assert set(tp) >= {"search", "write", "get", "generic", "management"}
+    node.close()
+
+
+def test_frozen_index_searches_on_search_throttled_pool(tmp_path):
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    node = Node(str(tmp_path / "fz"))
+    rc = RestController()
+    register_all(rc, node)
+    node.index_doc("cold", "1", {"n": 1}, refresh="true")
+    status, _ = rc.dispatch("POST", "/cold/_freeze", {}, b"", None)
+    assert status == 200
+    resp = node.search("cold", {"query": {"match_all": {}}},
+                       ignore_throttled=False)
+    assert resp["hits"]["total"]["value"] == 1
+    assert node.thread_pool.stats()["search_throttled"]["completed"] == 1
+    # default searches skip frozen indices entirely
+    resp = node.search("cold", {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 0
+    node.close()
