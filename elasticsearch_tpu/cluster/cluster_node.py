@@ -582,11 +582,18 @@ class ClusterNode:
             raise SearchEngineError(f"[{key}] not primary on [{self.node_id}]")
         op = request["op"]
         if op["type"] == "index":
-            result = local.engine.index(op["id"], op["source"],
-                                        op_type=op.get("op_type", "index"),
-                                        routing=op.get("routing"))
+            result = local.engine.index(
+                op["id"], op["source"],
+                op_type=op.get("op_type", "index"),
+                routing=op.get("routing"),
+                if_seq_no=op.get("if_seq_no"),
+                if_primary_term=op.get("if_primary_term"),
+                version=op.get("version"),
+                version_type=op.get("version_type", "internal"))
         else:
-            result = local.engine.delete(op["id"])
+            result = local.engine.delete(
+                op["id"], if_seq_no=op.get("if_seq_no"),
+                if_primary_term=op.get("if_primary_term"))
         local.tracker.update_local_checkpoint(local.routing.allocation_id,
                                               local.engine.local_checkpoint)
 
@@ -693,7 +700,33 @@ class ClusterNode:
         prev = ewma.get(node_id)
         ewma[node_id] = took_ms if prev is None else 0.7 * prev + 0.3 * took_ms
 
-    def client_search(self, index: str, body: dict,
+    def resolve_indices(self, expression: Optional[str]) -> List[str]:
+        """Index-name expression → concrete index names from the cluster
+        metadata (IndexNameExpressionResolver analog: csv, wildcards,
+        _all)."""
+        import fnmatch
+        meta = self.cluster_state.metadata
+        if expression in (None, "", "_all", "*"):
+            return sorted(meta)
+        out: List[str] = []
+        for part in str(expression).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "*" in part:
+                out.extend(n for n in sorted(meta)
+                           if fnmatch.fnmatch(n, part) and n not in out)
+            elif part in meta:
+                if part not in out:
+                    out.append(part)
+            else:
+                # a missing CONCRETE name is an error, not a silent skip
+                # (IndexNameExpressionResolver: only wildcards may match
+                # nothing)
+                raise IndexNotFoundError(part)
+        return out
+
+    def client_search(self, index: Optional[str], body: dict,
                       on_done: Callable[[dict], None]) -> None:
         """Two-phase query-then-fetch scatter-gather with a STREAMING
         incremental reduce (AbstractSearchAsyncAction + QueryPhaseResult
@@ -701,27 +734,45 @@ class ClusterNode:
         only; per-shard responses fold into a bounded top-(from+size)
         accumulator and batched agg reduce as they arrive, so coordinator
         memory is independent of size x shards; the fetch phase then
-        round-trips only for the global window's rows."""
+        round-trips only for the global window's rows. `index` may be a
+        multi-index expression; targets span every resolved index."""
         state = self.cluster_state
-        if index not in state.metadata:
+        try:
+            names = self.resolve_indices(index)
+        except IndexNotFoundError as e:
             on_done({"error": {"type": "index_not_found_exception",
-                               "reason": f"no such index [{index}]"},
-                     "status": 404})
+                               "reason": str(e)}, "status": 404})
             return
-        num_shards = int(state.metadata[index]["settings"].get("index.number_of_shards", 1))
-        targets = []
+        if not names:
+            if index in (None, "", "_all", "*") or "*" in str(index):
+                on_done({"took": 0, "timed_out": False,
+                         "_shards": {"total": 0, "successful": 0,
+                                     "skipped": 0, "failed": 0},
+                         "hits": {"total": {"value": 0, "relation": "eq"},
+                                  "max_score": None, "hits": []}})
+            else:
+                on_done({"error": {"type": "index_not_found_exception",
+                                   "reason": f"no such index [{index}]"},
+                         "status": 404})
+            return
+        targets: List[Tuple[str, ShardRoutingEntry]] = []
         unsearchable = 0  # red shards: no STARTED copy anywhere
-        for sid in range(num_shards):
-            copies = [r for r in state.routing
-                      if r.index == index and r.shard == sid
-                      and r.state == ShardRoutingEntry.STARTED and r.node_id]
-            if not copies:
-                unsearchable += 1
-                continue
-            targets.append(self._select_copy(copies, sid))
+        total_shards = 0
+        for name in names:
+            num_shards = int(state.metadata[name]["settings"].get(
+                "index.number_of_shards", 1))
+            total_shards += num_shards
+            for sid in range(num_shards):
+                copies = [r for r in state.routing
+                          if r.index == name and r.shard == sid
+                          and r.state == ShardRoutingEntry.STARTED and r.node_id]
+                if not copies:
+                    unsearchable += 1
+                    continue
+                targets.append((name, self._select_copy(copies, sid)))
         if not targets:
             on_done({"hits": {"total": {"value": 0, "relation": "eq"}, "hits": []},
-                     "_shards": {"total": num_shards, "successful": 0,
+                     "_shards": {"total": total_shards, "successful": 0,
                                  "failed": unsearchable}})
             return
 
@@ -731,20 +782,21 @@ class ClusterNode:
         prefilter_size = int(body.get("pre_filter_shard_size", 128))
         if len(targets) > prefilter_size and body.get("query") is not None:
             self._can_match_phase(
-                index, body, targets,
+                body, targets,
                 lambda kept, skipped: self._query_phase(
-                    index, body, kept, skipped, num_shards, unsearchable,
+                    body, kept, skipped, total_shards, unsearchable,
                     on_done))
         else:
-            self._query_phase(index, body, targets, 0, num_shards,
+            self._query_phase(body, targets, 0, total_shards,
                               unsearchable, on_done)
 
-    def _can_match_phase(self, index, body, targets, proceed):
+    def _can_match_phase(self, body, targets, proceed):
         flags = {}
         pending = {"count": len(targets)}
 
         def finish():
-            kept = [e for e in targets if flags.get(e.shard, True)]
+            kept = [(n, e) for n, e in targets
+                    if flags.get((n, e.shard), True)]
             skipped = len(targets) - len(kept)
             if not kept:
                 # keep one shard so the response still carries proper
@@ -752,28 +804,29 @@ class ClusterNode:
                 kept, skipped = targets[:1], len(targets) - 1
             proceed(kept, skipped)
 
-        def one(resp, entry):
+        def one(resp, name, entry):
             if isinstance(resp, dict) and "can_match" in resp:
-                flags[entry.shard] = bool(resp["can_match"])
+                flags[(name, entry.shard)] = bool(resp["can_match"])
             pending["count"] -= 1
             if pending["count"] == 0:
                 finish()
 
-        for entry in targets:
-            req = {"index": index, "shard": entry.shard, "body": body}
+        for name, entry in targets:
+            req = {"index": name, "shard": entry.shard, "body": body}
             if entry.node_id == self.node_id:
                 try:
                     self._on_can_match_shard(
-                        self.node_id, req, lambda r, e=entry: one(r, e))
+                        self.node_id, req,
+                        lambda r, n=name, e=entry: one(r, n, e))
                 except Exception:
-                    one(None, entry)
+                    one(None, name, entry)
             else:
                 self.transport.send(
                     self.node_id, entry.node_id, CAN_MATCH_SHARD, req,
-                    on_response=lambda r, e=entry: one(r, e),
-                    on_failure=lambda _err, e=entry: one(None, e))
+                    on_response=lambda r, n=name, e=entry: one(r, n, e),
+                    on_failure=lambda _err, n=name, e=entry: one(None, n, e))
 
-    def _query_phase(self, index, body, targets, skipped, num_shards,
+    def _query_phase(self, body, targets, skipped, num_shards,
                      unsearchable, on_done):
         from elasticsearch_tpu.node import _sort_key_tuple
         from elasticsearch_tpu.search.agg_partials import (
@@ -789,8 +842,8 @@ class ClusterNode:
                     if body.get("sort")
                     else (lambda e: (-e[0], e[2])))
 
-        # streaming accumulator: top-`window` (score, sort, shard, row,
-        # node_id) entries + batched partial-agg buffer
+        # streaming accumulator: top-`window` (score, sort, (index, shard),
+        # row, node_id) entries + batched partial-agg buffer
         acc = {"top": [], "agg_buffer": [], "aggs": None, "total": 0,
                "relation": "eq", "max_score": None, "failed": 0,
                "pending": len(targets), "successful": 0, "skipped": skipped}
@@ -806,7 +859,7 @@ class ClusterNode:
             acc["aggs"] = merged
             acc["agg_buffer"] = []
 
-        def on_query_resp(resp, entry, started_ms):
+        def on_query_resp(resp, name, entry, started_ms):
             self._ars_observe(entry.node_id,
                               max(self.scheduler.now_ms - started_ms, 0))
             acc["successful"] += 1
@@ -817,7 +870,7 @@ class ClusterNode:
                 acc["max_score"] = max(acc["max_score"] or -1e30,
                                        resp["max_score"])
             svs = resp["sort_values"] or [None] * len(resp["rows"])
-            entries = [(s, sv, resp["shard"], row, entry.node_id)
+            entries = [(s, sv, (name, resp["shard"]), row, entry.node_id)
                        for row, s, sv in zip(resp["rows"], resp["scores"], svs)]
             # bounded merge: never hold more than 2*window entries
             acc["top"] = sorted(acc["top"] + entries, key=sort_key)[:window]
@@ -834,27 +887,29 @@ class ClusterNode:
             acc["pending"] -= 1
             if acc["pending"] == 0:
                 fold_aggs(force=True)
-                self._fetch_phase(index, body, acc, targets, num_shards,
+                self._fetch_phase(body, acc, num_shards,
                                   unsearchable, frm, on_done,
                                   finalize_aggs, aggs_spec)
 
-        for entry in targets:
-            req = {"index": index, "shard": entry.shard, "body": body}
+        for name, entry in targets:
+            req = {"index": name, "shard": entry.shard, "body": body}
             started = self.scheduler.now_ms
             if entry.node_id == self.node_id:
                 try:
                     self._on_query_shard(
                         self.node_id, req,
-                        lambda r, e=entry, t=started: on_query_resp(r, e, t))
+                        lambda r, n=name, e=entry, t=started:
+                        on_query_resp(r, n, e, t))
                 except Exception as e:
                     on_query_fail(e, entry)
             else:
                 self.transport.send(
                     self.node_id, entry.node_id, QUERY_SHARD, req,
-                    on_response=lambda r, e=entry, t=started: on_query_resp(r, e, t),
+                    on_response=lambda r, n=name, e=entry, t=started:
+                    on_query_resp(r, n, e, t),
                     on_failure=lambda err, e=entry: on_query_fail(err, e))
 
-    def _fetch_phase(self, index, body, acc, targets, num_shards,
+    def _fetch_phase(self, body, acc, num_shards,
                      unsearchable, frm, on_done, finalize_aggs, aggs_spec):
         """Second round-trip: materialize _source/highlight for the global
         window only (FetchSearchPhase.java:47)."""
@@ -877,10 +932,10 @@ class ClusterNode:
             on_done(out)
             return
 
-        # group window rows by (shard, node)
-        by_shard: Dict[Tuple[int, str], List[int]] = {}
-        for pos, (score, sv, shard, row, node_id) in enumerate(window_entries):
-            by_shard.setdefault((shard, node_id), []).append(pos)
+        # group window rows by (index, shard, node)
+        by_shard: Dict[Tuple[str, int, str], List[int]] = {}
+        for pos, (score, sv, ishard, row, node_id) in enumerate(window_entries):
+            by_shard.setdefault((ishard[0], ishard[1], node_id), []).append(pos)
         hits: List[Optional[dict]] = [None] * len(window_entries)
         pending = {"count": len(by_shard)}
 
@@ -889,8 +944,8 @@ class ClusterNode:
             on_done(out)
 
         def one_fetch(key, positions):
-            shard, node_id = key
-            req = {"index": index, "shard": shard,
+            name, shard, node_id = key
+            req = {"index": name, "shard": shard,
                    "rows": [window_entries[p][3] for p in positions],
                    "scores": [window_entries[p][0] for p in positions],
                    "sort_values": [window_entries[p][1] for p in positions],
@@ -1006,14 +1061,16 @@ class ClusterNode:
         respond({"hits": hits})
 
     def client_get(self, index: str, doc_id: str,
-                   on_done: Callable[[dict], None]) -> None:
+                   on_done: Callable[[dict], None],
+                   routing: Optional[str] = None) -> None:
         state = self.cluster_state
         meta = state.metadata.get(index)
         if meta is None:
             on_done({"found": False, "error": "index_not_found"})
             return
         num_shards = int(meta["settings"].get("index.number_of_shards", 1))
-        sid = shard_id_for(doc_id, num_shards)
+        sid = shard_id_for(routing if routing is not None else doc_id,
+                           num_shards)
         primary = state.primary_of(index, sid)
         if primary is None:
             on_done({"found": False, "error": "no_primary"})
@@ -1036,9 +1093,13 @@ class ClusterNode:
         if doc is None:
             respond({"_index": request["index"], "_id": request["id"], "found": False})
         else:
-            respond({"_index": request["index"], "_id": request["id"],
-                     "found": True, "_source": doc["_source"],
-                     "_seq_no": doc["_seq_no"], "_version": doc["_version"]})
+            out = {"_index": request["index"], "_id": request["id"],
+                   "found": True, "_source": doc["_source"],
+                   "_seq_no": doc["_seq_no"], "_version": doc["_version"],
+                   "_primary_term": doc.get("_primary_term", 1)}
+            if doc.get("_routing") is not None:
+                out["_routing"] = doc["_routing"]
+            respond(out)
 
     def refresh_all(self) -> None:
         for shard in self.local_shards.values():

@@ -355,8 +355,7 @@ class Node:
                 items.append({action: {"_index": index, "_id": doc_id,
                                        "status": e.status, "error": e.to_dict()}})
         if refresh in ("true", "wait_for", True, ""):
-            for name in touched:
-                self.indices.get(name).refresh()
+            self._refresh_indices(touched)
         if refresh in ("true", "", True):
             for item in items:
                 for inner in item.values():
@@ -454,6 +453,12 @@ class Node:
     def _maybe_refresh(svc: IndexService, refresh) -> None:
         if refresh in ("true", "wait_for", True, ""):
             svc.refresh()
+
+    def _refresh_indices(self, names) -> None:
+        """Refresh hook for bulk epilogues — overridden by the clustered
+        deployment to broadcast instead of touching local services."""
+        for name in names:
+            self.indices.get(name).refresh()
 
     # ---------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict],

@@ -115,7 +115,13 @@ def _doc_url_params(req: RestRequest) -> Tuple[str, Optional[str]]:
     return req.params["index"], req.params.get("id")
 
 
-def register_cluster(rc: RestController, adapter: ClusterRestAdapter) -> None:
+def register_cluster_overrides(rc: RestController,
+                               adapter: ClusterRestAdapter) -> None:
+    """Cluster-authoritative routes layered OVER the full single-node
+    surface (`register_all`): a ClusterAwareNode serves every feature
+    through its overridden data path, while these endpoints — the ones
+    whose truth lives in the cluster state — dispatch to the master/
+    coordination layer directly. Registration order matters: last wins."""
     node = adapter.node
 
     def root(req):
@@ -150,127 +156,87 @@ def register_cluster(rc: RestController, adapter: ClusterRestAdapter) -> None:
     def create_index(req):
         body = req.json() or {}
         index = req.params["index"]
-        adapter.call(node.client_create_index, index,
-                     settings=body.get("settings"),
-                     mappings=body.get("mappings"))
-        return 200, {"acknowledged": True, "shards_acknowledged": True,
-                     "index": index}
+        result = adapter.call(node.client_create_index, index,
+                              settings=body.get("settings"),
+                              mappings=body.get("mappings"))
+        ack = bool(isinstance(result, dict) and result.get("acknowledged"))
+        return (200 if ack else 503), {
+            "acknowledged": ack, "shards_acknowledged": ack, "index": index}
 
     def delete_index(req):
+        from elasticsearch_tpu.common.errors import IndexNotFoundError
+        if req.params["index"] not in node.cluster_state.metadata:
+            raise IndexNotFoundError(req.params["index"])
         adapter.call(node.client_delete_index, req.params["index"])
         return 200, {"acknowledged": True}
 
-    def write_doc(req, op_type="index"):
-        index, doc_id = _doc_url_params(req)
-        if doc_id is None:
-            doc_id = uuid.uuid4().hex[:20]
-        op = {"type": "index", "id": doc_id, "source": req.json() or {},
-              "op_type": op_type}
-        routing = req.param("routing")
-        if routing:
-            op["routing"] = routing
-        r = adapter.call(node.client_write, index, op, has_failure_cb=True)
-        if "error" in r:
-            return 400, r
-        status = 201 if r.get("result") == "created" else 200
-        return status, {"_index": index, "_id": doc_id,
-                        "_version": r.get("_version", 1),
-                        "_seq_no": r.get("_seq_no"),
-                        "_primary_term": r.get("_primary_term"),
-                        "result": r.get("result", "created"),
-                        "_shards": {"total": 1, "successful": 1, "failed": 0}}
-
-    def delete_doc(req):
-        index, doc_id = _doc_url_params(req)
-        op = {"type": "delete", "id": doc_id}
-        r = adapter.call(node.client_write, index, op, has_failure_cb=True)
-        return 200, {"_index": index, "_id": doc_id,
-                     "result": r.get("result", "deleted")}
-
-    def get_doc(req):
-        index, doc_id = _doc_url_params(req)
-        r = adapter.call(node.client_get, index, doc_id)
-        status = 200 if r.get("found") else 404
-        return status, {"_index": index, "_id": doc_id, **r}
-
     def refresh(req):
-        index = req.params.get("index")
-        r = adapter.call(node.client_refresh, index)
-        return 200, r
+        result = adapter.call(node.client_refresh, req.params.get("index"))
+        return 200, result
 
-    def search(req):
-        index = req.params.get("index", "*")
+    def update_settings(req):
         body = req.json() or {}
-        if req.param("q"):
-            body.setdefault("query", {"query_string": {"query": req.param("q")}})
-        if req.param("size") is not None:
-            body.setdefault("size", int(req.param("size")))
-        r = adapter.call(node.client_search, index, body)
-        if isinstance(r, dict) and r.get("status") == 404:
-            return 404, r
-        return 200, r
+        result = adapter.call(node.client_update_settings,
+                              dict(body.get("persistent") or {},
+                                   **(body.get("transient") or {})))
+        return 200, {"acknowledged": bool(result.get("acknowledged")),
+                     "persistent": result.get("persistent", {}),
+                     "transient": {}}
 
-    def bulk(req):
-        """NDJSON _bulk: sequential primary-routed writes."""
-        lines = req.ndjson()
-        items = []
-        errors = False
-        i = 0
-        default_index = req.params.get("index")
-        while i < len(lines):
-            action_line = lines[i]
-            ((action, meta),) = action_line.items()
-            i += 1
-            index = meta.get("_index", default_index)
-            doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
-            if action in ("index", "create"):
-                source = lines[i]
-                i += 1
-                op = {"type": "index", "id": doc_id, "source": source,
-                      "op_type": "create" if action == "create" else "index"}
-            elif action == "delete":
-                op = {"type": "delete", "id": doc_id}
-            else:  # update not supported on the cluster path yet
-                items.append({action: {"_index": index, "_id": doc_id,
-                                       "status": 400,
-                                       "error": {"type": "illegal_argument_exception",
-                                                 "reason": f"unsupported bulk action [{action}]"}}})
-                errors = True
-                continue
-            try:
-                r = adapter.call(node.client_write, index, op,
-                                 has_failure_cb=True)
-                items.append({action: {"_index": index, "_id": doc_id,
-                                       "_version": r.get("_version", 1),
-                                       "result": r.get("result"),
-                                       "status": 201 if r.get("result") == "created" else 200}})
-            except Exception as e:
-                errors = True
-                items.append({action: {"_index": index, "_id": doc_id,
-                                       "status": 500,
-                                       "error": {"type": type(e).__name__,
-                                                 "reason": str(e)}}})
-        return 200, {"took": 0, "errors": errors, "items": items}
+    def get_index(req):
+        from elasticsearch_tpu.common.errors import IndexNotFoundError
+        name = req.params["index"]
+        meta = node.cluster_state.metadata.get(name)
+        if meta is None:
+            raise IndexNotFoundError(name)
+        return 200, {name: {"settings": meta.get("settings", {}),
+                            "mappings": meta.get("mappings", {}),
+                            "aliases": {}}}
+
+    def get_mapping(req):
+        from elasticsearch_tpu.common.errors import IndexNotFoundError
+        name = req.params.get("index")
+        meta_all = node.cluster_state.metadata
+        names = [name] if name and name not in ("_all", "*") else sorted(meta_all)
+        out = {}
+        for n in names:
+            meta = meta_all.get(n)
+            if meta is None:
+                raise IndexNotFoundError(n)
+            out[n] = {"mappings": meta.get("mappings", {})}
+        return 200, out
+
+    def index_exists(req):
+        ok = req.params["index"] in node.cluster_state.metadata
+        return (200 if ok else 404), ({} if ok else None)
+
+    def cat_indices(req):
+        state = node.cluster_state
+        lines = []
+        for name in sorted(state.metadata):
+            shards = state.shards_of(name)
+            started = sum(1 for s in shards
+                          if s.state == "STARTED")
+            health = "green" if started == len(shards) else (
+                "yellow" if any(s.primary and s.state == "STARTED"
+                                for s in shards) else "red")
+            lines.append(f"{health} open {name} "
+                         f"{sum(1 for s in shards if s.primary)} "
+                         f"{sum(1 for s in shards if not s.primary)}")
+        return 200, "\n".join(lines) + ("\n" if lines else "")
 
     rc.register("GET", "/", root)
     rc.register("GET", "/_cluster/health", cluster_health)
     rc.register("GET", "/_cluster/state", cluster_state_)
+    rc.register("PUT", "/_cluster/settings", update_settings)
     rc.register("GET", "/_cat/nodes", cat_nodes)
+    rc.register("GET", "/_cat/indices", cat_indices)
     rc.register("PUT", "/{index}", create_index)
     rc.register("DELETE", "/{index}", delete_index)
-    rc.register("PUT", "/{index}/_doc/{id}", write_doc)
-    rc.register("POST", "/{index}/_doc/{id}", write_doc)
-    rc.register("POST", "/{index}/_doc", write_doc)
-    rc.register("PUT", "/{index}/_create/{id}",
-                lambda req: write_doc(req, op_type="create"))
-    rc.register("POST", "/{index}/_create/{id}",
-                lambda req: write_doc(req, op_type="create"))
-    rc.register("DELETE", "/{index}/_doc/{id}", delete_doc)
-    rc.register("GET", "/{index}/_doc/{id}", get_doc)
+    rc.register("GET", "/{index}", get_index)
+    rc.register("HEAD", "/{index}", index_exists)
+    rc.register("GET", "/{index}/_mapping", get_mapping)
+    rc.register("GET", "/_mapping", get_mapping)
     rc.register("POST", "/{index}/_refresh", refresh)
     rc.register("GET", "/{index}/_refresh", refresh)
     rc.register("POST", "/_refresh", refresh)
-    rc.register("GET", "/{index}/_search", search)
-    rc.register("POST", "/{index}/_search", search)
-    rc.register("POST", "/_bulk", bulk)
-    rc.register("POST", "/{index}/_bulk", bulk)

@@ -121,8 +121,10 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
     HTTP last (reference start order: `node/Node.java:682`)."""
     from elasticsearch_tpu.cluster.cluster_node import ClusterNode
     from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.cluster.rest_node import ClusterAwareNode
+    from elasticsearch_tpu.rest.actions import register_all
     from elasticsearch_tpu.rest.cluster_actions import (
-        ClusterRestAdapter, register_cluster,
+        ClusterRestAdapter, register_cluster_overrides,
     )
     from elasticsearch_tpu.rest.controller import RestController
     from elasticsearch_tpu.rest.http_server import HttpServer
@@ -198,9 +200,20 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
         discovery_task = loop.create_task(discover())
 
         controller = RestController()
+        # ONE feature surface for both deployment shapes: the full Node
+        # route set backed by distributed data-path overrides, with the
+        # cluster-authoritative routes (health/state/index admin) layered
+        # on top (last registration wins)
+        import os as _os
+        aware = ClusterAwareNode(
+            _os.path.join(args.data, "_node_local"), cluster_node, loop,
+            node_name=node_id, cluster_name=args.cluster_name,
+            settings=settings)
+        register_all(controller, aware)
         adapter = ClusterRestAdapter(cluster_node, loop)
-        register_cluster(controller, adapter)
-        server = HttpServer(controller, host=args.host, port=args.port)
+        register_cluster_overrides(controller, adapter)
+        server = HttpServer(controller, host=args.host, port=args.port,
+                            thread_pool=aware.thread_pool)
         await server.start()
         print(f"[{node_id}] listening on http://{args.host}:{server.port} "
               f"(data: {args.data}, cluster: {args.cluster_name})", flush=True)

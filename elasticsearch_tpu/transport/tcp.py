@@ -453,9 +453,16 @@ class TcpTransportService:
                 timeout_handle.cancel()
             if status & STATUS_ERROR:
                 if on_failure:
-                    on_failure(RemoteTransportError(
+                    err = RemoteTransportError(
                         f"[{req_action}] {payload.get('type', 'error')}: "
-                        f"{payload.get('message', '')}"))
+                        f"{payload.get('message', '')}")
+                    # carry the remote exception's HTTP status so a 404/409
+                    # raised on the primary's node does not degrade to a 500
+                    # at the coordinating node (reference: the wire format
+                    # serializes the full exception)
+                    err.status = int(payload.get("status", 500))
+                    err.remote_type = payload.get("type")
+                    on_failure(err)
             elif on_response:
                 on_response(payload)
 
@@ -504,7 +511,8 @@ class TcpTransportService:
         except Exception as e:
             channel.write_frame(encode_frame(
                 rid, STATUS_ERROR, WIRE_VERSION, None,
-                {"type": type(e).__name__, "message": str(e)}))
+                {"type": type(e).__name__, "message": str(e),
+                 "status": int(getattr(e, "status", 500))}))
         finally:
             if token is not None:
                 current_auth.reset(token)

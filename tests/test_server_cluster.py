@@ -304,3 +304,112 @@ def test_tls_cluster_forms_and_rejects_plaintext(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_unified_feature_surface_in_cluster_mode(cluster_procs):
+    """The full single-node REST surface works against a clustered
+    deployment (ClusterAwareNode): update-by-script, mget, msearch, count,
+    analyze, ingest pipelines — with the data path distributed."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    # the failover test may have killed a process: use live nodes only
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    assert len(live) >= 2, "not enough live nodes"
+    _wait_health(live[0], "green", nodes=len(live))
+    base = f"http://127.0.0.1:{live[0]}"
+
+    r = _req("PUT", f"{base}/uni", {
+        "settings": {"index.number_of_shards": 2,
+                     "index.number_of_replicas": 1},
+        "mappings": {"properties": {"n": {"type": "long"}}}})
+    assert r["acknowledged"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        h = _req("GET", f"{base}/_cluster/health")
+        if h["status"] == "green" and h.get("active_shards", 0) >= 4:
+            break
+        time.sleep(0.5)
+    for i in range(8):
+        _req("PUT", f"{base}/uni/_doc/{i}?refresh=true", {"n": i})
+
+    # update by painless script, read back through another node
+    r = _req("POST", f"{base}/uni/_update/3",
+             {"script": {"source": "ctx._source.n += 100"}})
+    assert r["result"] == "updated"
+    got = _req("GET", f"http://127.0.0.1:{live[-1]}/uni/_doc/3")
+    assert got["_source"]["n"] == 103
+
+    # mget across shards
+    r = _req("POST", f"{base}/uni/_mget", {"ids": ["1", "5", "7"]})
+    assert [d["_source"]["n"] for d in r["docs"]] == [1, 5, 7]
+
+    # count + msearch + aggs through the distributed search path
+    _req("POST", f"{base}/uni/_refresh")
+    r = _req("POST", f"{base}/uni/_count",
+             {"query": {"range": {"n": {"lt": 5}}}})
+    assert r["count"] == 4  # 0,1,2,4 (3 became 103)
+    nd = b"".join(json.dumps(line).encode() + b"\n" for line in
+                  [{"index": "uni"}, {"query": {"match_all": {}}, "size": 0},
+                   {"index": "uni"},
+                   {"size": 0, "aggs": {"s": {"sum": {"field": "n"}}}}])
+    mreq = urllib.request.Request(
+        f"{base}/_msearch", data=nd, method="POST",
+        headers={"Content-Type": "application/x-ndjson"})
+    with urllib.request.urlopen(mreq, timeout=10) as resp:
+        r = json.loads(resp.read())
+    assert r["responses"][0]["hits"]["total"]["value"] == 8
+    assert r["responses"][1]["aggregations"]["s"]["value"] == \
+        sum(range(8)) - 3 + 103
+
+    # analyze (node-local service, same surface)
+    r = _req("POST", f"{base}/_analyze",
+             {"analyzer": "standard", "text": "Quick Brown Foxes"})
+    assert [t["token"] for t in r["tokens"]] == ["quick", "brown", "foxes"]
+
+    # ingest pipeline applied on write
+    _req("PUT", f"{base}/_ingest/pipeline/addtag",
+         {"processors": [{"set": {"field": "tag", "value": "p"}}]})
+    _req("PUT", f"{base}/uni/_doc/99?pipeline=addtag&refresh=true", {"n": 99})
+    got = _req("GET", f"{base}/uni/_doc/99")
+    assert got["_source"]["tag"] == "p"
+
+    # wildcard search spans the cluster metadata
+    r = _req("POST", f"{base}/un*/_search",
+             {"size": 0, "track_total_hits": True})
+    assert r["hits"]["total"]["value"] == 9
+
+
+def test_cluster_scroll_and_bulk_refresh(cluster_procs):
+    """Scroll works on clustered deployments (coordinator page snapshot)
+    and bulk?refresh=true refreshes through the cluster, not the empty
+    node-local indices service."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    base = f"http://127.0.0.1:{live[0]}"
+    _wait_health(live[0], "green", nodes=len(live))
+
+    # bulk with refresh=true: previously 404'd on the local refresh epilogue
+    nd = b""
+    for i in range(15):
+        nd += json.dumps({"index": {"_index": "scr", "_id": str(i)}}).encode() + b"\n"
+        nd += json.dumps({"n": i}).encode() + b"\n"
+    breq = urllib.request.Request(
+        f"{base}/_bulk?refresh=true", data=nd, method="POST",
+        headers={"Content-Type": "application/x-ndjson"})
+    with urllib.request.urlopen(breq, timeout=20) as resp:
+        r = json.loads(resp.read())
+    assert not r["errors"], r
+
+    # scroll through the distributed result in pages of 6
+    r = _req("POST", f"{base}/scr/_search?scroll=1m",
+             {"query": {"match_all": {}}, "size": 6,
+              "sort": [{"n": "asc"}]})
+    sid = r["_scroll_id"]
+    got = [h["_source"]["n"] for h in r["hits"]["hits"]]
+    assert r["hits"]["total"]["value"] == 15
+    while True:
+        r = _req("POST", f"{base}/_search/scroll",
+                 {"scroll": "1m", "scroll_id": sid})
+        if not r["hits"]["hits"]:
+            break
+        got.extend(h["_source"]["n"] for h in r["hits"]["hits"])
+    assert got == list(range(15))
