@@ -38,6 +38,13 @@ class SearchEngineError(Exception):
         d.update(self.metadata)
         return d
 
+    def to_wrapped_dict(self) -> dict:
+        """Top-level error shape with the root_cause chain (the REST layer
+        and per-response msearch errors use this; per-ITEM bulk/mget errors
+        stay bare, matching the reference)."""
+        inner = self.to_dict()
+        return {**inner, "root_cause": [dict(inner)]}
+
 
 class IllegalArgumentError(SearchEngineError):
     status = 400
@@ -98,6 +105,12 @@ class MasterNotDiscoveredError(SearchEngineError):
 
 class ClusterBlockError(SearchEngineError):
     status = 503
+
+
+class IndexClosedError(SearchEngineError):
+    """Operation against a closed index (IndexClosedException)."""
+
+    status = 400
 
 
 class TaskCancelledError(SearchEngineError):

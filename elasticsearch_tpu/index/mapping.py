@@ -244,6 +244,35 @@ class DateFieldMapper(FieldMapper):
         return parse_date_millis(value)
 
 
+def parse_date_nanos(value: Any) -> int:
+    """Epoch NANOS (DateFieldMapper.Resolution.NANOSECONDS): numbers are
+    epoch millis; strings keep up to 9 fractional-second digits."""
+    if isinstance(value, bool):
+        raise MapperParsingError(f"cannot parse date from boolean [{value}]")
+    if isinstance(value, (int, float)):
+        return int(value) * 1_000_000
+    s = str(value).strip()
+    if re.fullmatch(r"-?\d{10,}", s):
+        return int(s) * 1_000_000
+    m = re.match(r"^(.*?)(?:\.(\d{1,9}))?((?:Z|[+-]\d{2}:?\d{2})?)$", s)
+    base, frac, tz = m.groups()
+    millis = parse_date_millis(base + (tz or ""))
+    return millis * 1_000_000 + int((frac or "0").ljust(9, "0")[:9])
+
+
+class DateNanosFieldMapper(DateFieldMapper):
+    """date_nanos: nanosecond-resolution dates (the reference stores nanos
+    since epoch; `DateFieldMapper` with Resolution.NANOSECONDS)."""
+
+    type_name = "date_nanos"
+
+    def index_terms(self, value):
+        return [str(parse_date_nanos(value))]
+
+    def doc_value(self, value):
+        return parse_date_nanos(value)
+
+
 class IpFieldMapper(FieldMapper):
     type_name = "ip"
 
@@ -938,7 +967,8 @@ FIELD_TYPES = {
     for m in (KeywordFieldMapper, TextFieldMapper, LongFieldMapper, IntegerFieldMapper,
               ShortFieldMapper, ByteFieldMapper, DoubleFieldMapper, FloatFieldMapper,
               HalfFloatFieldMapper, ScaledFloatFieldMapper, BooleanFieldMapper,
-              DateFieldMapper, IpFieldMapper, GeoPointFieldMapper,
+              DateFieldMapper, DateNanosFieldMapper, IpFieldMapper,
+              GeoPointFieldMapper,
               DenseVectorFieldMapper, ObjectMapper, NestedMapper,
               RankFeatureFieldMapper, RankFeaturesFieldMapper,
               JoinFieldMapper, PercolatorFieldMapper,
@@ -1172,7 +1202,17 @@ class MapperService:
         for v in values:
             if v is None:
                 continue
-            self._index_one(path, mapper, v, parsed)
+            try:
+                self._index_one(path, mapper, v, parsed)
+            except MapperParsingError:
+                # ignore_malformed: drop the unparseable VALUE, keep the doc
+                # (IgnoreMalformedStoredValues; the field lands in _ignored)
+                if not mapper.params.get("ignore_malformed"):
+                    raise
+                parsed.doc_values.setdefault("_ignored", [])
+                if path not in parsed.doc_values["_ignored"]:
+                    parsed.doc_values["_ignored"].append(path)
+                continue
             for sub_name, sub in self._multi_fields.get(path, {}).items():
                 self._index_one(f"{path}.{sub_name}", sub, v, parsed)
 

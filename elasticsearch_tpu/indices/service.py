@@ -65,6 +65,9 @@ class IndexService:
         self.uuid = uuid
         self.settings = settings
         self.creation_date = int(time.time() * 1000)
+        # closed indices reject reads/writes but keep metadata visible
+        # (MetaDataIndexStateService open/close)
+        self.closed = False
         from elasticsearch_tpu.index.analysis import AnalysisRegistry
         registry = AnalysisRegistry.from_index_settings(
             settings.as_flat_dict())
@@ -190,7 +193,8 @@ class IndicesService:
             json.dump({"settings": svc.settings.as_flat_dict(),
                        "mappings": svc.mapper_service.to_dict(),
                        "aliases": svc.aliases,
-                       "uuid": svc.uuid}, f)
+                       "uuid": svc.uuid,
+                       "state": "close" if svc.closed else "open"}, f)
 
     # -- CRUD -----------------------------------------------------------------
     def open_index(self, name: str) -> IndexService:
@@ -207,7 +211,27 @@ class IndicesService:
                            Settings(meta.get("settings", {})),
                            meta.get("mappings", {}), meta.get("uuid", name))
         svc.aliases = meta.get("aliases", {})
+        svc.closed = meta.get("state") == "close"
         self.indices[name] = svc
+        return svc
+
+    # -- open / close state ---------------------------------------------------
+    def close_index_state(self, name: str) -> None:
+        """POST /{index}/_close: reads/writes rejected until reopened
+        (MetaDataIndexStateService.closeIndices)."""
+        svc = self.get(name)
+        svc.closed = True
+        self._persist_meta(svc)
+
+    def open_index_state(self, name: str) -> None:
+        svc = self.get(name)
+        svc.closed = False
+        self._persist_meta(svc)
+
+    def check_open(self, svc: IndexService) -> IndexService:
+        from elasticsearch_tpu.common.errors import IndexClosedError
+        if svc.closed:
+            raise IndexClosedError(f"closed index [{svc.name}]")
         return svc
 
     def create_index(self, name: str, settings: Optional[dict] = None,
@@ -259,16 +283,19 @@ class IndicesService:
         """Resolve a comma/wildcard index expression (reference:
         IndexNameExpressionResolver)."""
         if expression in (None, "", "_all", "*"):
-            return list(self.indices.values())
+            # wildcard/_all expansion targets OPEN indices
+            # (IndicesOptions.expandWildcardsOpen default)
+            return [s for s in self.indices.values() if not s.closed]
         out = []
         seen = set()
         for part in expression.split(","):
             part = part.strip()
             if "*" in part:
                 pat = re.compile("^" + part.replace(".", r"\.").replace("*", ".*") + "$")
-                matched = [s for n, s in self.indices.items() if pat.match(n)]
+                matched = [s for n, s in self.indices.items()
+                           if pat.match(n) and not s.closed]
                 for s in self.indices.values():
-                    if any(pat.match(a) for a in s.aliases):
+                    if not s.closed and any(pat.match(a) for a in s.aliases):
                         matched.append(s)
                 for m in matched:
                     if m.name not in seen:
@@ -279,6 +306,14 @@ class IndicesService:
                 if svc.name not in seen:
                     seen.add(svc.name)
                     out.append(svc)
+        return out
+
+    def resolve_open(self, expression: Optional[str]) -> List[IndexService]:
+        """Resolve for DATA operations: a concretely-named closed index is
+        an error (IndexClosedException); wildcards already skipped them."""
+        out = self.resolve(expression)
+        for svc in out:
+            self.check_open(svc)
         return out
 
     @staticmethod

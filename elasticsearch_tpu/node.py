@@ -176,7 +176,7 @@ class Node:
                   version: Optional[int] = None,
                   version_type: str = "internal",
                   pipeline: Optional[str] = None) -> dict:
-        svc = self._index_or_autocreate(index)
+        svc = self.indices.check_open(self._index_or_autocreate(index))
         if pipeline is None:
             pipeline = svc.settings.get("index.default_pipeline")
         if pipeline and pipeline != "_none":
@@ -216,7 +216,7 @@ class Node:
 
     def get_doc(self, index: str, doc_id: str, routing: Optional[str] = None,
                 source_includes=None, realtime: bool = True) -> dict:
-        svc = self.indices.get(index)
+        svc = self.indices.check_open(self.indices.get(index))
         shard = svc.route(doc_id, routing)
         self.counters["get"] += 1
         doc = shard.engine.get(doc_id, realtime=realtime)
@@ -233,7 +233,7 @@ class Node:
                    routing: Optional[str] = None,
                    if_seq_no: Optional[int] = None,
                    if_primary_term: Optional[int] = None) -> dict:
-        svc = self.indices.get(index)
+        svc = self.indices.check_open(self.indices.get(index))
         shard = svc.route(doc_id, routing)
         self.counters["delete"] += 1
         result = shard.engine.delete(doc_id, if_seq_no=if_seq_no,
@@ -253,7 +253,7 @@ class Node:
 
         Reference: `action/update/UpdateHelper.java`.
         """
-        svc = self.indices.get(index)
+        svc = self.indices.check_open(self.indices.get(index))
         shard = svc.route(doc_id, None)
         existing = shard.engine.get(doc_id)
         if existing is None:
@@ -291,11 +291,29 @@ class Node:
         return out
 
     def mget(self, body: dict, default_index: Optional[str] = None) -> dict:
+        from elasticsearch_tpu.search.service import _filter_source
         docs = []
         for spec in body.get("docs", []):
             index = spec.get("_index", default_index)
             try:
-                docs.append(self.get_doc(index, spec["_id"]))
+                doc = self.get_doc(index, spec["_id"],
+                                   routing=spec.get("routing"))
+                # per-doc _source filtering (MultiGetRequest.Item)
+                src_spec = spec.get("_source")
+                if src_spec is False:
+                    doc.pop("_source", None)
+                elif isinstance(src_spec, (list, str)):
+                    inc = [src_spec] if isinstance(src_spec, str) else src_spec
+                    if doc.get("_source") is not None:
+                        doc["_source"] = _filter_source(doc["_source"], inc, [])
+                elif isinstance(src_spec, dict):
+                    inc = src_spec.get("include", src_spec.get("includes", [])) or []
+                    exc = src_spec.get("exclude", src_spec.get("excludes", [])) or []
+                    inc = [inc] if isinstance(inc, str) else inc
+                    exc = [exc] if isinstance(exc, str) else exc
+                    if doc.get("_source") is not None:
+                        doc["_source"] = _filter_source(doc["_source"], inc, exc)
+                docs.append(doc)
             except SearchEngineError as e:
                 docs.append({"_index": index, "_id": spec.get("_id"),
                              "error": e.to_dict()})
@@ -477,7 +495,7 @@ class Node:
             local_resp = self.search(local_expr, body) if local_expr else None
             return merge_ccs_responses(local_resp, remote_resps, body)
         start = time.perf_counter()
-        services = self.indices.resolve(index_expr)
+        services = self.indices.resolve_open(index_expr)
         if ignore_throttled:
             # frozen indices sit out of normal searches unless the caller
             # passes ignore_throttled=false (reference:
@@ -535,13 +553,15 @@ class Node:
                             reader, svc.mapper_service, body,
                             vector_store=store,
                             partial_aggs=use_partial_aggs,
-                            query_cache=self.caches.query).result()
+                            query_cache=self.caches.query,
+                            index_settings=svc.settings.as_flat_dict()).result()
                     else:
                         result = execute_query_phase(
                             reader, svc.mapper_service, body,
                             vector_store=store,
                             partial_aggs=use_partial_aggs,
-                            query_cache=self.caches.query)
+                            query_cache=self.caches.query,
+                            index_settings=svc.settings.as_flat_dict())
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
@@ -666,7 +686,16 @@ class Node:
         entries = []  # (svc, reader, row, score, sort_values)
         total = 0
         from elasticsearch_tpu.common.settings import setting_bool
-        services = self.indices.resolve(index_expr)
+        services = self.indices.resolve_open(index_expr)
+        for svc in services:
+            mrw = int(svc.settings.get("index.max_result_window", 10_000))
+            if size > mrw:
+                raise IllegalArgumentError(
+                    f"Batch size is too large, size must be less than or "
+                    f"equal to: [{mrw}] but was [{size}]. Scroll batch "
+                    f"sizes cost as much memory as result windows so they "
+                    f"are controlled by the [index.max_result_window] index "
+                    f"level setting.")
         if ignore_throttled:
             services = [s for s in services
                         if not setting_bool(s.settings.get("index.frozen"))]
@@ -754,7 +783,7 @@ class Node:
         body["size"] = 0
         body.pop("sort", None)
         total = 0
-        for svc in self.indices.resolve(index_expr):
+        for svc in self.indices.resolve_open(index_expr):
             reader = svc.combined_reader()
             result = execute_query_phase(reader, svc.mapper_service,
                                          {**body, "track_total_hits": True},
@@ -776,7 +805,8 @@ class Node:
                 resp["status"] = 200
                 responses.append(resp)
             except SearchEngineError as e:
-                responses.append({"error": e.to_dict(), "status": e.status})
+                responses.append({"error": e.to_wrapped_dict(),
+                                  "status": e.status})
         return {"took": 0, "responses": responses}
 
     def analyze(self, body: dict, index: Optional[str] = None) -> dict:

@@ -151,6 +151,63 @@ def register_all(rc: RestController, node: Node) -> None:
             for ih in (h.get("inner_hits") or {}).values():
                 _total_hits_as_int(ih)
 
+    def _apply_typed_keys(resp, body):
+        """?typed_keys=true prefixes agg names with their internal type
+        (RestSearchAction TYPED_KEYS_PARAM; e.g. `avg#name`, `sterms#name`)
+        so clients can re-parse responses type-safely."""
+        _NUMERIC_TYPES = {"long", "integer", "short", "byte", "double",
+                          "float", "half_float", "scaled_float", "date",
+                          "boolean"}
+
+        def type_prefix(kind, spec, result):
+            if kind == "terms":
+                # prefix comes from the FIELD type, not the matched buckets
+                # (an empty result must keep the same typed key)
+                field = spec.get("field") if isinstance(spec, dict) else None
+                for svc in node.indices.indices.values():
+                    mapper = svc.mapper_service.get(field) if field else None
+                    if mapper is not None:
+                        return ("lterms" if mapper.type_name in _NUMERIC_TYPES
+                                else "sterms")
+                buckets = result.get("buckets") or []
+                numeric = buckets and all(
+                    isinstance(b.get("key"), (int, float))
+                    and not isinstance(b.get("key"), bool) for b in buckets)
+                return "lterms" if numeric else "sterms"
+            if kind == "percentiles":
+                return "tdigest_percentiles"
+            if kind == "percentile_ranks":
+                return "tdigest_percentile_ranks"
+            if kind == "max_bucket" or kind == "min_bucket":
+                return "bucket_metric_value"
+            return kind
+
+        def walk(aggs_out, aggs_spec):
+            if not isinstance(aggs_out, dict) or not aggs_spec:
+                return
+            for name, spec in list(aggs_spec.items()):
+                if name not in aggs_out or not isinstance(spec, dict):
+                    continue
+                kinds = [k for k in spec
+                         if k not in ("aggs", "aggregations", "meta")]
+                if len(kinds) != 1:
+                    continue
+                result = aggs_out.pop(name)
+                aggs_out[f"{type_prefix(kinds[0], spec[kinds[0]], result)}"
+                         f"#{name}"] = result
+                sub = spec.get("aggs") or spec.get("aggregations")
+                if sub and isinstance(result, dict):
+                    buckets = result.get("buckets")
+                    if isinstance(buckets, dict):  # named filters buckets
+                        buckets = buckets.values()
+                    for bucket in buckets or []:
+                        walk(bucket, sub)
+                    walk(result, sub)
+
+        if isinstance(resp.get("aggregations"), dict):
+            walk(resp["aggregations"],
+                 body.get("aggs") or body.get("aggregations") or {})
+
     def bulk(req):
         return 200, node.bulk(req.ndjson(),
                               default_index=req.params.get("index"),
@@ -192,8 +249,30 @@ def register_all(rc: RestController, node: Node) -> None:
             body["sort"] = [
                 {s.split(":")[0]: s.split(":")[1]} if ":" in s else s
                 for s in sort.split(",")]
+        # URL-level _source / docvalue_fields filtering (RestSearchAction
+        # parses these into the SearchSourceBuilder)
+        src_inc = req.param("_source_includes")
+        src_exc = req.param("_source_excludes")
+        if src_inc is not None or src_exc is not None:
+            body["_source"] = {
+                "includes": src_inc.split(",") if src_inc else [],
+                "excludes": src_exc.split(",") if src_exc else []}
+        elif req.param("_source") is not None:
+            raw = req.param("_source")
+            body["_source"] = ({"true": True, "false": False}.get(raw, None)
+                               if raw in ("true", "false")
+                               else raw.split(","))
+        dvf = req.param("docvalue_fields")
+        if dvf:
+            body["docvalue_fields"] = dvf.split(",")
         scroll = req.param("scroll")
         if scroll:
+            if req.param("request_cache") is not None:
+                raise IllegalArgumentError(
+                    "[request_cache] cannot be used in a scroll context")
+            if body.get("size") == 0:
+                raise IllegalArgumentError(
+                    "[size] cannot be [0] in a scroll context")
             resp = node.search_scroll_start(
                 req.params.get("index"), body, keep_alive=scroll,
                 ignore_throttled=req.bool_param("ignore_throttled", True))
@@ -203,6 +282,8 @@ def register_all(rc: RestController, node: Node) -> None:
                                    "ignore_throttled", True))
         if req.bool_param("rest_total_hits_as_int", False):
             _total_hits_as_int(resp)
+        if req.bool_param("typed_keys", False):
+            _apply_typed_keys(resp, body)
         return 200, resp
 
     rc.register("GET", "/_search", search)
@@ -219,10 +300,14 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_count", count)
 
     def msearch(req):
-        resp = node.msearch(req.ndjson())
-        if req.bool_param("rest_total_hits_as_int", False):
-            for r in resp.get("responses", []):
+        lines = req.ndjson()
+        resp = node.msearch(lines)
+        bodies = [lines[i] for i in range(1, len(lines), 2)]
+        for i, r in enumerate(resp.get("responses", [])):
+            if req.bool_param("rest_total_hits_as_int", False):
                 _total_hits_as_int(r)
+            if req.bool_param("typed_keys", False) and i < len(bodies):
+                _apply_typed_keys(r, bodies[i])
         return 200, resp
 
     rc.register("GET", "/_msearch", msearch)
@@ -248,8 +333,38 @@ def register_all(rc: RestController, node: Node) -> None:
                      "index": svc.name}
 
     def delete_index(req):
-        for svc in node.indices.resolve(req.params["index"]):
-            node.indices.delete_index(svc.name)
+        expr = req.params["index"]
+        ignore_unavailable = req.bool_param("ignore_unavailable", False)
+        allow_no = req.bool_param("allow_no_indices", True)
+        to_delete = []
+        for part in expr.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "*" not in part and part != "_all":
+                if part not in node.indices.indices:
+                    # aliases may not be delete targets (the reference
+                    # rejects the expression outright)
+                    if any(part in s.aliases
+                           for s in node.indices.indices.values()):
+                        raise IllegalArgumentError(
+                            f"The provided expression [{part}] matches an "
+                            f"alias, specify the corresponding concrete "
+                            f"indices instead.")
+                    if ignore_unavailable:
+                        continue
+                    raise IndexNotFoundError(part)
+                to_delete.append(part)
+            else:
+                import fnmatch as _fn
+                pat = "*" if part == "_all" else part
+                matched = [n for n in node.indices.indices
+                           if _fn.fnmatch(n, pat)]
+                if not matched and not allow_no:
+                    raise IndexNotFoundError(part)
+                to_delete.extend(matched)
+        for name in dict.fromkeys(to_delete):
+            node.indices.delete_index(name)
         return 200, {"acknowledged": True}
 
     def get_index(req):
@@ -446,7 +561,8 @@ def register_all(rc: RestController, node: Node) -> None:
     def cat_indices(req):
         rows = []
         for name, svc in sorted(node.indices.indices.items()):
-            rows.append(["green", "open", name, svc.uuid, svc.num_shards,
+            rows.append(["green", "close" if svc.closed else "open", name,
+                         svc.uuid, svc.num_shards,
                          svc.num_replicas, svc.doc_count(), 0, "0b", "0b"])
         return _cat_table(req, ["health", "status", "index", "uuid", "pri",
                                 "rep", "docs.count", "docs.deleted",
@@ -483,6 +599,42 @@ def register_all(rc: RestController, node: Node) -> None:
                 rows.append([alias, name, "-", "-", "-"])
         return _cat_table(req, ["alias", "index", "filter", "routing.index",
                                 "routing.search"], rows)
+
+    # -------------------------------------------------------- open / close
+    def close_index_h(req):
+        names = [s.name for s in node.indices.resolve(req.params["index"])]
+        if not names and "*" not in req.params["index"]:
+            raise IndexNotFoundError(req.params["index"])
+        for name in names:
+            node.indices.close_index_state(name)
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "indices": {n: {"closed": True} for n in names}}
+
+    def open_index_h(req):
+        # match closed indices too: resolve() skips them for wildcards;
+        # each comma part resolves independently (mixed lists work)
+        import fnmatch as _fn
+        names = []
+        for part in req.params["index"].split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "*" in part or part == "_all":
+                pat = "*" if part == "_all" else part
+                names.extend(n for n in node.indices.indices
+                             if _fn.fnmatch(n, pat))
+            elif part in node.indices.indices:
+                names.append(part)
+            else:
+                raise IndexNotFoundError(part)
+        if not names:
+            raise IndexNotFoundError(req.params["index"])
+        for name in dict.fromkeys(names):
+            node.indices.open_index_state(name)
+        return 200, {"acknowledged": True, "shards_acknowledged": True}
+
+    rc.register("POST", "/{index}/_close", close_index_h)
+    rc.register("POST", "/{index}/_open", open_index_h)
 
     rc.register("GET", "/_cat/indices", cat_indices)
     rc.register("GET", "/_cat/health", cat_health)

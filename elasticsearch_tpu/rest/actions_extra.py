@@ -24,6 +24,7 @@ def register_extra(rc: RestController, node: Node) -> None:
     # rest/actions.py; only continuation/cleanup routes live here)
     def scroll_next(req):
         body = req.json() or {}
+        # body wins; req.param covers both the path segment and query param
         scroll_id = body.get("scroll_id") or req.param("scroll_id")
         if not scroll_id:
             raise IllegalArgumentError("scroll_id is required")
@@ -39,18 +40,27 @@ def register_extra(rc: RestController, node: Node) -> None:
         ids = body.get("scroll_id", [])
         if isinstance(ids, str):
             ids = [ids]
+        if req.params.get("scroll_id"):  # DELETE /_search/scroll/{id}
+            ids = req.params["scroll_id"].split(",")
         freed = 0
-        if body.get("scroll_id") == "_all" or req.path.endswith("/_all"):
+        if body.get("scroll_id") == "_all" or req.path.endswith("/_all") \
+                or "_all" in ids:
             freed = node.scrolls.delete_all()
         else:
             for sid in ids:
                 freed += 1 if node.scrolls.delete(sid) else 0
+        if not freed and ids and "_all" not in ids:
+            # nothing matched: the ids were unknown/expired (404 in the
+            # reference's ClearScrollResponse when nothing freed)
+            return 404, {"succeeded": True, "num_freed": 0}
         return 200, {"succeeded": True, "num_freed": freed}
 
     rc.register("POST", "/_search/scroll", scroll_next)
     rc.register("GET", "/_search/scroll", scroll_next)
+    rc.register("GET", "/_search/scroll/{scroll_id}", scroll_next)
+    rc.register("POST", "/_search/scroll/{scroll_id}", scroll_next)
     rc.register("DELETE", "/_search/scroll", scroll_delete)
-    rc.register("DELETE", "/_search/scroll/_all", scroll_delete)
+    rc.register("DELETE", "/_search/scroll/{scroll_id}", scroll_delete)
 
     # ------------------------------------------------------------ async search
     def async_submit(req):

@@ -161,8 +161,7 @@ class RestController:
                     return short
             return handler(req)
         except SearchEngineError as e:
-            return e.status, {"error": {**e.to_dict(),
-                                        "root_cause": [e.to_dict()]},
+            return e.status, {"error": e.to_wrapped_dict(),
                               "status": e.status}
         except Exception as e:  # unexpected: 500 with reason, never a raw traceback
             tb = traceback.format_exc(limit=5)

@@ -216,10 +216,27 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
     if kind == "percentiles":
         pcts = spec.get("percents", [1, 5, 25, 50, 75, 95, 99])
         v = np.sort(vals[present])
-        out = {}
-        for p in pcts:
-            out[f"{float(p)}"] = _es_percentile(v, float(p))
-        return {"values": out}
+        hdr = spec.get("hdr")
+        if hdr is not None:
+            digits = int(hdr.get("number_of_significant_value_digits", 3))
+            if not 0 <= digits <= 5:
+                raise IllegalArgumentError(
+                    "[numberOfSignificantValueDigits] must be between 0 and 5")
+
+        def one(p):
+            if len(v) == 0:
+                return None
+            if hdr is not None:
+                # HDRHistogram.getValueAtPercentile: the lowest recorded
+                # value at or above the rank (no interpolation)
+                rank = max(int(math.ceil(p / 100.0 * len(v))), 1)
+                return float(v[rank - 1])
+            return _es_percentile(v, float(p))
+
+        if spec.get("keyed", True) is False:
+            return {"values": [{"key": float(p), "value": one(float(p))}
+                               for p in pcts]}
+        return {"values": {f"{float(p)}": one(float(p)) for p in pcts}}
     if kind == "percentile_ranks":
         targets = spec.get("values", [])
         v = np.sort(vals[present])
@@ -679,11 +696,18 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         interval_ms, calendar = _date_interval(spec)
         min_count = int(spec.get("min_doc_count", 0))
         vals, present = numeric_values(ctx, rows, field)
+        if getattr(ctx.mapper_service.get(field), "type_name", None) \
+                == "date_nanos":
+            vals = vals / 1e6  # stored nanos; histogram buckets in millis
+        offset_ms = _date_offset_ms(spec.get("offset"))
         if calendar:
-            keys = np.asarray([_calendar_floor(int(v), calendar) if p else np.nan
-                               for v, p in zip(vals, present)], dtype=np.float64)
+            keys = np.asarray(
+                [_calendar_floor(int(v - offset_ms), calendar) + offset_ms
+                 if p else np.nan
+                 for v, p in zip(vals, present)], dtype=np.float64)
         else:
-            keys = np.floor(vals / interval_ms) * interval_ms
+            keys = np.floor((vals - offset_ms) / interval_ms) * interval_ms \
+                + offset_ms
         return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
                               None, interval_ms, date=True, recurse=recurse)
 
@@ -921,6 +945,24 @@ def _date_interval(spec: dict) -> Tuple[float, Optional[str]]:
     raise ParsingError(f"unknown interval [{fixed}]")
 
 
+def _date_offset_ms(offset) -> float:
+    """date_histogram `offset` like "+6h"/"-1d" → millis."""
+    if not offset:
+        return 0.0
+    s = str(offset)
+    sign = -1.0 if s.startswith("-") else 1.0
+    s = s.lstrip("+-")
+    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000}
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            return sign * float(s[:-len(suffix)]) * units[suffix]
+    try:
+        return sign * float(s)
+    except ValueError:
+        return 0.0
+
+
 def _calendar_floor(millis: int, unit: str) -> float:
     import datetime as dt
     d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
@@ -944,7 +986,12 @@ def _calendar_floor(millis: int, unit: str) -> float:
 
 def _millis_to_iso(millis: int) -> str:
     import datetime as dt
-    d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+    try:
+        d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+    except (OverflowError, OSError, ValueError):
+        # out-of-range epoch (e.g. nanos mistakenly fed as millis): render
+        # the raw number instead of 500ing the whole response
+        return str(millis)
     return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{d.microsecond // 1000:03d}Z"
 
 

@@ -414,7 +414,9 @@ class RangeQuery(Query):
     def _coerce_bound(self, ctx, value):
         mapper = ctx.mapper_service.get(self.field)
         if isinstance(mapper, DateFieldMapper):
-            return float(parse_date_millis(value))
+            # doc_value() keeps bound and stored value in the SAME unit
+            # (millis for date, nanos for date_nanos)
+            return float(mapper.doc_value(value))
         if isinstance(mapper, IpFieldMapper):
             return float(mapper.coerce(value))
         if isinstance(mapper, RangeFieldMapperBase):
@@ -603,6 +605,13 @@ class RegexpQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        max_len = int(getattr(ctx, "index_settings", {}).get(
+            "index.max_regex_length", 1000))
+        if len(self.value) > max_len:
+            raise IllegalArgumentError(
+                f"The length of regex [{len(self.value)}] used in the "
+                f"Regexp Query request has exceeded the allowed maximum "
+                f"of [{max_len}]")
         try:
             pattern = re.compile("^" + self.value + "$")
         except re.error as e:
@@ -691,15 +700,21 @@ class MatchBoolPrefixQuery(Query):
     matches as a prefix. The canonical companion of search_as_you_type."""
 
     def __init__(self, field: str, text: str, boost: float = 1.0,
-                 operator: str = "or"):
+                 operator: str = "or",
+                 minimum_should_match=None, analyzer: Optional[str] = None):
         self.field = field
         self.text = str(text)
         self.boost = boost
-        self.operator = operator
+        self.operator = str(operator).lower()
+        self.minimum_should_match = minimum_should_match
+        self.analyzer = analyzer
 
     def execute(self, ctx: SearchContext) -> DocSet:
         mapper = ctx.mapper_service.get(self.field)
-        if isinstance(mapper, TextFieldMapper):
+        if self.analyzer is not None:
+            terms = ctx.mapper_service.registry.get(self.analyzer).terms(
+                self.text)
+        elif isinstance(mapper, TextFieldMapper):
             terms = mapper.search_analyzer.terms(self.text)
         else:
             terms = [self.text]
@@ -709,7 +724,10 @@ class MatchBoolPrefixQuery(Query):
         sets = [TermQuery(self.field, t, self.boost).execute(ctx)
                 for t in head]
         sets.append(PrefixQuery(self.field, last, self.boost).execute(ctx))
-        required = len(sets) if self.operator == "and" else 1
+        if self.minimum_should_match is not None:
+            required = resolve_msm(self.minimum_should_match, len(sets))
+        else:
+            required = len(sets) if self.operator == "and" else 1
         return _combine_should(sets, required)
 
     def to_dict(self):
@@ -1206,9 +1224,11 @@ def parse_query(body: Optional[dict]) -> Query:
     if kind == "match_bool_prefix":
         field, v = _single(spec, "match_bool_prefix")
         if isinstance(v, dict):
-            return MatchBoolPrefixQuery(field, v.get("query"),
-                                        float(v.get("boost", 1.0)),
-                                        v.get("operator", "or"))
+            return MatchBoolPrefixQuery(
+                field, v.get("query"), float(v.get("boost", 1.0)),
+                v.get("operator", "or"),
+                minimum_should_match=v.get("minimum_should_match"),
+                analyzer=v.get("analyzer"))
         return MatchBoolPrefixQuery(field, v)
     if kind in ("query_string", "simple_query_string"):
         fields = spec.get("fields") or (

@@ -37,6 +37,46 @@ MAX_RESULT_WINDOW = 10_000
 TRACK_TOTAL_HITS_DEFAULT = 10_000
 
 
+def _check_request_limits(body: dict, settings: dict) -> None:
+    """Per-index request guardrails (IndexSettings MAX_* settings +
+    SearchService validation): reject before any work happens."""
+    frm = body.get("from")
+    if frm is not None and int(frm) < 0:
+        raise IllegalArgumentError("[from] parameter cannot be negative")
+    size = body.get("size")
+    if size is not None and int(size) < 0:
+        raise IllegalArgumentError(f"[size] parameter cannot be negative, "
+                                   f"found [{size}]")
+    max_dvf = int(settings.get("index.max_docvalue_fields_search", 100))
+    if len(body.get("docvalue_fields") or []) > max_dvf:
+        raise IllegalArgumentError(
+            f"Trying to retrieve too many docvalue_fields. Must be less "
+            f"than or equal to: [{max_dvf}] but was "
+            f"[{len(body['docvalue_fields'])}]. This limit can be set by "
+            f"changing the [index.max_docvalue_fields_search] index level "
+            f"setting.")
+    max_sf = int(settings.get("index.max_script_fields", 32))
+    if len(body.get("script_fields") or {}) > max_sf:
+        raise IllegalArgumentError(
+            f"Trying to retrieve too many script_fields. Must be less than "
+            f"or equal to: [{max_sf}] but was "
+            f"[{len(body['script_fields'])}]. This limit can be set by "
+            f"changing the [index.max_script_fields] index level setting.")
+    rescore_spec = body.get("rescore")
+    if rescore_spec is not None:
+        max_rw = int(settings.get("index.max_rescore_window", 10_000))
+        specs = rescore_spec if isinstance(rescore_spec, list) else [rescore_spec]
+        for spec in specs:
+            window = int(spec.get("window_size", 10))
+            if window > max_rw:
+                raise IllegalArgumentError(
+                    f"Rescore window [{window}] is too large. It must be "
+                    f"less than [{max_rw}]. This prevents allocating "
+                    f"massive heaps for storing the results to be "
+                    f"rescored. This limit can be set by changing the "
+                    f"[index.max_rescore_window] index level setting.")
+
+
 class ShardSearchResult:
     """Per-shard query-phase output (QuerySearchResult analog)."""
 
@@ -59,9 +99,12 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         body: dict, shard_id: int = 0,
                         vector_store=None,
                         partial_aggs: bool = False,
-                        query_cache=None) -> ShardSearchResult:
+                        query_cache=None,
+                        index_settings: Optional[dict] = None) -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
+    ctx.index_settings = index_settings or {}
+    _check_request_limits(body, ctx.index_settings)
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
 
@@ -180,10 +223,15 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     frm, size = frm_, size_
     # scroll snapshots page past the window by design (internal flag); normal
     # searches enforce the reference's index.max_result_window guard
-    if frm + size > MAX_RESULT_WINDOW and not body.get("__unbounded_window__"):
+    mrw = int(ctx.index_settings.get("index.max_result_window",
+                                     MAX_RESULT_WINDOW))
+    if frm + size > mrw and not body.get("__unbounded_window__"):
         raise IllegalArgumentError(
             f"Result window is too large, from + size must be less than or equal "
-            f"to: [{MAX_RESULT_WINDOW}] but was [{frm + size}]")
+            f"to: [{mrw}] but was [{frm + size}]. See the scroll api for a "
+            f"more efficient way to request large data sets. This limit can "
+            f"be set by changing the [index.max_result_window] index level "
+            f"setting.")
     window = slice(0, frm + size)  # shard returns from+size, coordinator skips
     w_rows, w_scores = rows[window], scores[window]
     w_sort = sort_values[window.start:window.stop] if sort_values is not None else None
@@ -299,7 +347,8 @@ def _sort_docs(ctx: SearchContext, rows, scores, sort_spec):
             if present.any() or ctx.mapper_service.get(field) is None or \
                ctx.mapper_service.get(field).type_name in (
                    "long", "integer", "short", "byte", "double", "float",
-                   "half_float", "date", "boolean", "ip", "scaled_float"):
+                   "half_float", "date", "date_nanos", "boolean", "ip",
+                   "scaled_float"):
                 missing = spec.get("missing", "_last")
                 fill = _MISSING_LAST if (missing == "_last") == (direction == "asc") else -_MISSING_LAST
                 if isinstance(missing, (int, float)) and not isinstance(missing, bool):

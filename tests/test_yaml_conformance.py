@@ -84,9 +84,6 @@ MUST_PASS = [
     "info/20_lucene_version.yml",
     "msearch/11_status.yml",
     "ping/10_ping.yml",
-    "search/200_index_phrase_search.yml",
-    "search/90_search_after.yml",
-    "search/issue4895.yml",
     "search.aggregation/100_avg_metric.yml",
     "search.aggregation/110_max_metric.yml",
     "search.aggregation/120_min_metric.yml",
@@ -96,6 +93,10 @@ MUST_PASS = [
     "search.aggregation/280_geohash_grid.yml",
     "search.aggregation/290_geotile_grid.yml",
     "search.aggregation/70_adjacency_matrix.yml",
+    "search.aggregation/80_typed_keys.yml",
+    "search/200_index_phrase_search.yml",
+    "search/90_search_after.yml",
+    "search/issue4895.yml",
     "suggest/10_basic.yml",
     "update/10_doc.yml",
     "update/11_shard_header.yml",
