@@ -64,6 +64,13 @@ MASTER_DELETE_INDEX = "cluster:admin/indices/delete"
 MASTER_SHARD_STARTED = "internal:cluster/shard/started"
 MASTER_SHARD_FAILED = "internal:cluster/shard/failure"
 MASTER_UPDATE_SETTINGS = "cluster:admin/settings/update"
+MASTER_PUT_REGISTRY = "cluster:admin/registry/update"
+
+# cluster-state metadata key for replicated registries (ingest pipelines,
+# templates, stored scripts — the reference stores these in MetaData customs:
+# IngestMetadata / IndexTemplateMetaData / ScriptMetaData). Index names may
+# not start with "_", so the key cannot collide.
+REGISTRIES_KEY = "_registries"
 
 
 class LocalShard:
@@ -110,6 +117,8 @@ class ClusterNode:
         self.mappers: Dict[str, MapperService] = {}
         from elasticsearch_tpu.search.caches import NodeCaches
         self.caches = NodeCaches()
+        # observers of every applied cluster state (registry sync, etc.)
+        self.state_listeners: List[Callable[[ClusterState], None]] = []
         node = DiscoveryNode(node_id, address=address, attributes=attributes)
         # durable gateway: term + last-accepted state survive full-cluster
         # restarts (PersistedClusterStateService/GatewayMetaState analog);
@@ -159,6 +168,11 @@ class ClusterNode:
     def _master_create_index(self, sender, request, respond):
         self._require_master()
         name = request["index"]
+        # same name rules as the single-node path — in particular no "_"
+        # prefix, which is what keeps reserved metadata sections
+        # (REGISTRIES_KEY) unreachable as indices
+        from elasticsearch_tpu.indices.service import IndicesService
+        IndicesService.validate_index_name(name)
 
         def update(base: ClusterState) -> ClusterState:
             if name in base.metadata:
@@ -220,6 +234,34 @@ class ClusterNode:
                                on_done: Optional[Callable] = None) -> None:
         self._send_to_master(MASTER_UPDATE_SETTINGS,
                              {"persistent": persistent},
+                             on_response=on_done or (lambda r: None))
+
+    def _master_put_registry(self, sender, request, respond):
+        """Replicated registries (pipelines/templates/scripts): every
+        mutation is a cluster-state update, so every node sees the same
+        registry (IngestMetadata/ScriptMetaData analogs)."""
+        self._require_master()
+        section, key = request["section"], request["key"]
+        value = request.get("value")
+
+        def update(base: ClusterState) -> ClusterState:
+            meta = dict(base.metadata)
+            regs = {k: dict(v) for k, v in
+                    (meta.get(REGISTRIES_KEY) or {}).items()}
+            sec = regs.setdefault(section, {})
+            if value is None:
+                sec.pop(key, None)
+            else:
+                sec[key] = value
+            meta[REGISTRIES_KEY] = regs
+            return base.with_(metadata=meta)
+
+        self._publish_then_respond(update, respond, {"acknowledged": True})
+
+    def client_put_registry(self, section: str, key: str, value,
+                            on_done: Optional[Callable] = None) -> None:
+        self._send_to_master(MASTER_PUT_REGISTRY,
+                             {"section": section, "key": key, "value": value},
                              on_response=on_done or (lambda r: None))
 
     def _master_shard_started(self, sender, request, respond):
@@ -330,6 +372,12 @@ class ClusterNode:
                     # failover promotion (reference: IndexShard#activateWithPrimaryContext)
                     local.tracker = ReplicationTracker(entry.allocation_id)
                     local.tracker.activate_primary_mode(local.engine.local_checkpoint)
+
+        for listener in self.state_listeners:
+            try:
+                listener(state)
+            except Exception:
+                pass  # a listener bug must not break shard application
 
     def _start_replica_recovery(self, local: LocalShard, state: ClusterState) -> None:
         entry = local.routing
@@ -705,7 +753,9 @@ class ClusterNode:
         metadata (IndexNameExpressionResolver analog: csv, wildcards,
         _all)."""
         import fnmatch
-        meta = self.cluster_state.metadata
+        # "_"-prefixed keys are reserved metadata sections, not indices
+        meta = {n: m for n, m in self.cluster_state.metadata.items()
+                if not n.startswith("_")}
         if expression in (None, "", "_all", "*"):
             return sorted(meta)
         out: List[str] = []
@@ -1123,6 +1173,7 @@ class ClusterNode:
         t.register(me, MASTER_SHARD_STARTED, self._master_shard_started)
         t.register(me, MASTER_SHARD_FAILED, self._master_shard_failed)
         t.register(me, MASTER_UPDATE_SETTINGS, self._master_update_settings)
+        t.register(me, MASTER_PUT_REGISTRY, self._master_put_registry)
 
     # client admin helpers ----------------------------------------------------
     def client_create_index(self, name: str, settings: Optional[dict] = None,

@@ -46,6 +46,108 @@ class ClusterAwareNode(Node):
                          cluster_name=cluster_name, settings=settings)
         self.cluster = cluster_node
         self.loop = loop
+        self._wire_replicated_registries()
+
+    # --------------------------------------------------- replicated registries
+    def _wire_replicated_registries(self) -> None:
+        """Ingest pipelines, index templates, and stored scripts live in the
+        cluster state (IngestMetadata / IndexTemplateMetaData / ScriptMetaData
+        analogs): every mutation publishes through the master, every applied
+        state syncs the local registries — a pipeline PUT on one node is
+        immediately usable on every node."""
+        node = self
+
+        def replicate(section, key, value):
+            node._call(node.cluster.client_put_registry, section, key, value)
+
+        ingest, templates, scripts = self.ingest, self.templates, self.scripts
+        orig_put_pipeline = ingest.put_pipeline
+        orig_del_pipeline = ingest.delete_pipeline
+        orig_put_template = templates.put
+        orig_del_template = templates.delete
+        orig_put_script = scripts.put_stored
+        orig_del_script = scripts.delete_stored
+
+        def put_pipeline(pid, definition):
+            orig_put_pipeline(pid, definition)  # validates first
+            replicate("pipelines", pid, definition)
+
+        def delete_pipeline(pid):
+            orig_del_pipeline(pid)
+            replicate("pipelines", pid, None)
+
+        def put_template(name, body, composable=False):
+            orig_put_template(name, body, composable=composable)
+            replicate("templates",
+                      f"{'c' if composable else 'l'}:{name}", body)
+
+        def delete_template(name, composable=False):
+            orig_del_template(name, composable=composable)
+            replicate("templates",
+                      f"{'c' if composable else 'l'}:{name}", None)
+
+        def put_stored(sid, body):
+            orig_put_script(sid, body)
+            replicate("scripts", sid, body)
+
+        def delete_stored(sid):
+            orig_del_script(sid)
+            replicate("scripts", sid, None)
+
+        ingest.put_pipeline = put_pipeline
+        ingest.delete_pipeline = delete_pipeline
+        templates.put = put_template
+        templates.delete = delete_template
+        scripts.put_stored = put_stored
+        scripts.delete_stored = delete_stored
+        self._registry_originals = {
+            "pipeline": orig_put_pipeline, "template": orig_put_template,
+            "script": orig_put_script, "del_pipeline": orig_del_pipeline,
+            "del_template": orig_del_template, "del_script": orig_del_script}
+        self.cluster.state_listeners.append(self._sync_registries)
+
+    def _sync_registries(self, state) -> None:
+        """Reconcile local registries to the cluster-state truth: apply
+        adds AND updates (compared against what this node last applied),
+        remove entries gone from the state."""
+        from elasticsearch_tpu.cluster.cluster_node import REGISTRIES_KEY
+        regs = state.metadata.get(REGISTRIES_KEY) or {}
+        applied = getattr(self, "_applied_registries", None)
+        if applied is None:
+            applied = self._applied_registries = {}
+
+        def put_template(key, body):
+            self._registry_originals["template"](
+                key[2:], body, composable=key.startswith("c:"))
+
+        def del_template(key):
+            self._registry_originals["del_template"](
+                key[2:], composable=key.startswith("c:"))
+
+        sections = (
+            ("pipelines", self._registry_originals["pipeline"],
+             self._registry_originals["del_pipeline"]),
+            ("templates", put_template, del_template),
+            ("scripts", self._registry_originals["script"],
+             self._registry_originals["del_script"]),
+        )
+        for section, put_fn, del_fn in sections:
+            want = regs.get(section) or {}
+            have = applied.setdefault(section, {})
+            for key, value in want.items():
+                if have.get(key) != value:  # new OR changed definition
+                    try:
+                        put_fn(key, value)
+                        have[key] = value
+                    except Exception:
+                        pass  # a bad remote definition must not kill apply
+            for key in list(have):
+                if key not in want:
+                    try:
+                        del_fn(key)
+                    except Exception:
+                        pass
+                    have.pop(key, None)
 
     # ------------------------------------------------------------- plumbing
     def _call(self, fn, *args, timeout: float = 30.0, **kwargs) -> Any:
@@ -88,16 +190,25 @@ class ClusterAwareNode(Node):
         return result
 
     def _write_with_retry(self, index: str, op: dict,
-                          timeout_s: float = 30.0) -> dict:
+                          timeout_s: float = 30.0,
+                          retry_not_found: bool = False) -> dict:
         """Writes wait for an active primary (TransportReplicationAction's
         wait_for_active_shards / cluster-state observer retry): right after
         auto-create or failover the routing may not show a started primary
-        yet."""
+        yet. IndexNotFound retries ONLY when the caller just auto-created
+        (this node's applier may lag the master's commit); a genuinely
+        missing index stays a fast 404."""
         import time as _time
         deadline = _time.monotonic() + timeout_s
+        nf_deadline = _time.monotonic() + min(timeout_s, 10.0)
         while True:
             try:
                 return self._call(self.cluster.client_write, index, op)
+            except IndexNotFoundError:
+                if retry_not_found and _time.monotonic() < nf_deadline:
+                    _time.sleep(0.2)
+                    continue
+                raise
             except SearchEngineError as e:
                 if "no active primary" in str(e) \
                         and _time.monotonic() < deadline:
@@ -121,12 +232,24 @@ class ClusterAwareNode(Node):
                   version_type: str = "internal",
                   pipeline: Optional[str] = None) -> dict:
         import uuid as _uuid
-        if pipeline is None:
+        auto_created = False
+        if index not in self.cluster.cluster_state.metadata:
+            # auto-create FIRST (with matching templates), so a template-
+            # provided index.default_pipeline applies to the first doc too
+            resolved = self.templates.resolve(index)
+            self._call(self.cluster.client_create_index, index,
+                       resolved["settings"] or None,
+                       resolved["mappings"]
+                       if resolved["mappings"].get("properties") else None)
+            auto_created = True
+            if pipeline is None:
+                pipeline = (resolved["settings"] or {}).get(
+                    "index.default_pipeline")
+        elif pipeline is None:
             # index.default_pipeline lives in the cluster metadata here
             meta = self.cluster.cluster_state.metadata.get(index)
-            if meta is not None:
-                pipeline = (meta.get("settings") or {}).get(
-                    "index.default_pipeline")
+            pipeline = (meta.get("settings") or {}).get(
+                "index.default_pipeline")
         if pipeline and pipeline != "_none":
             body = self.ingest.execute(pipeline, index, doc_id, body)
             if body is None:
@@ -136,13 +259,12 @@ class ClusterAwareNode(Node):
         if doc_id is None:
             doc_id = _uuid.uuid4().hex[:20]
             op_type = "create"
-        if index not in self.cluster.cluster_state.metadata:
-            self._call(self.cluster.client_create_index, index, None, None)
         op = {"type": "index", "id": str(doc_id), "source": body,
               "op_type": op_type, "routing": routing,
               "if_seq_no": if_seq_no, "if_primary_term": if_primary_term,
               "version": version, "version_type": version_type}
-        resp = self._write_with_retry(index, op)
+        resp = self._write_with_retry(index, op,
+                                      retry_not_found=auto_created)
         out = {"_index": index, "_id": resp.get("_id", doc_id),
                "_version": resp.get("_version"),
                "result": resp.get("result", "created"),

@@ -69,6 +69,8 @@ class ClusterRestAdapter:
         # an index created but with no routing yet is not green
         shards_expected = 0
         for name, meta in state.metadata.items():
+            if name.startswith("_"):  # reserved sections (registries)
+                continue
             shards_expected += int(meta["settings"].get("index.number_of_shards", 1))
         primaries = sum(1 for r in state.routing if r.primary)
         if primaries < shards_expected:
@@ -196,7 +198,8 @@ def register_cluster_overrides(rc: RestController,
     def get_mapping(req):
         from elasticsearch_tpu.common.errors import IndexNotFoundError
         name = req.params.get("index")
-        meta_all = node.cluster_state.metadata
+        meta_all = {n: m for n, m in node.cluster_state.metadata.items()
+                    if not n.startswith("_")}
         names = [name] if name and name not in ("_all", "*") else sorted(meta_all)
         out = {}
         for n in names:
@@ -213,7 +216,8 @@ def register_cluster_overrides(rc: RestController,
     def cat_indices(req):
         state = node.cluster_state
         lines = []
-        for name in sorted(state.metadata):
+        for name in sorted(n for n in state.metadata
+                           if not n.startswith("_")):
             shards = state.shards_of(name)
             started = sum(1 for s in shards
                           if s.state == "STARTED")

@@ -413,3 +413,55 @@ def test_cluster_scroll_and_bulk_refresh(cluster_procs):
             break
         got.extend(h["_source"]["n"] for h in r["hits"]["hits"])
     assert got == list(range(15))
+
+
+def test_registries_replicate_through_cluster_state(cluster_procs):
+    """A pipeline/template/stored-script PUT on one node is usable on EVERY
+    node (IngestMetadata/IndexTemplateMetaData/ScriptMetaData analogs)."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    assert len(live) >= 2
+    a, b = f"http://127.0.0.1:{live[0]}", f"http://127.0.0.1:{live[-1]}"
+    _wait_health(live[0], "green", nodes=len(live))
+
+    # pipeline PUT on node a, used via ?pipeline= on node b
+    _req("PUT", f"{a}/_ingest/pipeline/repl",
+         {"processors": [{"set": {"field": "via", "value": "repl"}}]})
+    deadline = time.monotonic() + 30
+    applied = False
+    while time.monotonic() < deadline:
+        try:
+            r = _req("GET", f"{b}/_ingest/pipeline/repl")
+            if "repl" in r:
+                applied = True
+                break
+        except urllib.error.HTTPError:
+            time.sleep(0.3)
+    assert applied, "pipeline did not replicate"
+    _req("PUT", f"{b}/rrr/_doc/1?pipeline=repl&refresh=true", {"n": 1})
+    got = _req("GET", f"{b}/rrr/_doc/1")
+    assert got["_source"]["via"] == "repl"
+
+    # stored script PUT on b, executed in a search on a
+    _req("PUT", f"{b}/_scripts/replscore",
+         {"script": {"lang": "painless", "source": "doc['n'].value * 10"}})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            _req("GET", f"{a}/_scripts/replscore")
+            break
+        except urllib.error.HTTPError:
+            time.sleep(0.3)
+    r = _req("POST", f"{a}/rrr/_search",
+             {"query": {"script_score": {"query": {"match_all": {}},
+                                         "script": {"id": "replscore"}}}})
+    assert r["hits"]["hits"][0]["_score"] == 10.0
+
+    # template PUT on a governs auto-created index written through b
+    _req("PUT", f"{a}/_template/repltpl",
+         {"index_patterns": ["tpl-*"],
+          "mappings": {"properties": {"z": {"type": "keyword"}}}})
+    time.sleep(1.0)
+    _req("PUT", f"{b}/tpl-one/_doc/1?refresh=true", {"z": "x"})
+    m = _req("GET", f"{b}/tpl-one/_mapping")
+    assert m["tpl-one"]["mappings"]["properties"]["z"]["type"] == "keyword"
