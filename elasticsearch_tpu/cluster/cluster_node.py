@@ -231,10 +231,12 @@ class ClusterNode:
                                     "persistent": updates})
 
     def client_update_settings(self, persistent: dict,
-                               on_done: Optional[Callable] = None) -> None:
+                               on_done: Optional[Callable] = None,
+                               on_failure: Optional[Callable] = None) -> None:
         self._send_to_master(MASTER_UPDATE_SETTINGS,
                              {"persistent": persistent},
-                             on_response=on_done or (lambda r: None))
+                             on_response=on_done or (lambda r: None),
+                             on_failure=on_failure)
 
     def _master_put_registry(self, sender, request, respond):
         """Replicated registries (pipelines/templates/scripts): every
@@ -259,10 +261,12 @@ class ClusterNode:
         self._publish_then_respond(update, respond, {"acknowledged": True})
 
     def client_put_registry(self, section: str, key: str, value,
-                            on_done: Optional[Callable] = None) -> None:
+                            on_done: Optional[Callable] = None,
+                            on_failure: Optional[Callable] = None) -> None:
         self._send_to_master(MASTER_PUT_REGISTRY,
                              {"section": section, "key": key, "value": value},
-                             on_response=on_done or (lambda r: None))
+                             on_response=on_done or (lambda r: None),
+                             on_failure=on_failure)
 
     def _master_shard_started(self, sender, request, respond):
         self._require_master()
@@ -282,12 +286,21 @@ class ClusterNode:
                         on_response=None, on_failure=None, retries: int = 60):
         """Master-node action with retry-until-master-known semantics
         (reference: TransportMasterNodeAction observes cluster state and
-        retries on NotMasterException / no-master)."""
+        retries on NotMasterException / no-master). APPLICATION errors
+        (validation etc.) propagate immediately — only master-unavailable
+        conditions retry."""
         master = self.cluster_state.master_node_id
         if self.is_master:
             master = self.node_id
 
-        def retry(_err=None):
+        def retry(err=None):
+            # a 4xx from the master is the answer, not a reason to re-ask
+            status = int(getattr(err, "status", 500)) if err is not None else 500
+            if err is not None and 400 <= status < 500 \
+                    and "not the elected master" not in str(err):
+                if on_failure:
+                    on_failure(err)
+                return
             if retries <= 0:
                 if on_failure:
                     on_failure(SearchEngineError("no elected master"))
@@ -1178,15 +1191,19 @@ class ClusterNode:
     # client admin helpers ----------------------------------------------------
     def client_create_index(self, name: str, settings: Optional[dict] = None,
                             mappings: Optional[dict] = None,
-                            on_done: Optional[Callable] = None) -> None:
+                            on_done: Optional[Callable] = None,
+                            on_failure: Optional[Callable] = None) -> None:
         self._send_to_master(MASTER_CREATE_INDEX,
                              {"index": name, "settings": settings,
                               "mappings": mappings},
-                             on_response=on_done or (lambda r: None))
+                             on_response=on_done or (lambda r: None),
+                             on_failure=on_failure)
 
-    def client_delete_index(self, name: str, on_done: Optional[Callable] = None) -> None:
+    def client_delete_index(self, name: str, on_done: Optional[Callable] = None,
+                            on_failure: Optional[Callable] = None) -> None:
         self._send_to_master(MASTER_DELETE_INDEX, {"index": name},
-                             on_response=on_done or (lambda r: None))
+                             on_response=on_done or (lambda r: None),
+                             on_failure=on_failure)
 
     def _on_refresh(self, sender, request, respond):
         index = (request or {}).get("index")

@@ -55,44 +55,80 @@ class ClusterAwareNode(Node):
         analogs): every mutation publishes through the master, every applied
         state syncs the local registries — a pipeline PUT on one node is
         immediately usable on every node."""
+        from elasticsearch_tpu.ingest.service import IngestService
+        from elasticsearch_tpu.node_admin import TemplateService
+        from elasticsearch_tpu.script.service import ScriptService
+
         node = self
 
         def replicate(section, key, value):
             node._call(node.cluster.client_put_registry, section, key, value)
 
         ingest, templates, scripts = self.ingest, self.templates, self.scripts
-        orig_put_pipeline = ingest.put_pipeline
-        orig_del_pipeline = ingest.delete_pipeline
-        orig_put_template = templates.put
-        orig_del_template = templates.delete
-        orig_put_script = scripts.put_stored
-        orig_del_script = scripts.delete_stored
+        # originals come from the CLASS, never from the instance: the script
+        # registry is a process-wide singleton, so instance attributes may
+        # hold a previous node's wrappers — rebinding from the class keeps
+        # wiring idempotent (latest node wins) with no wrapper chains
+        orig_put_pipeline = IngestService.put_pipeline.__get__(ingest)
+        orig_del_pipeline = IngestService.delete_pipeline.__get__(ingest)
+        orig_put_template = TemplateService.put.__get__(templates)
+        orig_del_template = TemplateService.delete.__get__(templates)
+        orig_put_script = ScriptService.put_stored.__get__(scripts)
+        orig_del_script = ScriptService.delete_stored.__get__(scripts)
 
+        def record(section, key, value):
+            regs = node._applied_registries.setdefault(section, {})
+            if value is None:
+                regs.pop(key, None)
+            else:
+                regs[key] = value
+
+        # order: VALIDATE locally, REPLICATE (raises on failure — nothing
+        # applied anywhere), then apply locally and record ownership; a
+        # failed publish can therefore never leave this node diverged
         def put_pipeline(pid, definition):
-            orig_put_pipeline(pid, definition)  # validates first
+            from elasticsearch_tpu.ingest.service import Pipeline
+            Pipeline(pid, definition)  # validation only
             replicate("pipelines", pid, definition)
+            orig_put_pipeline(pid, definition)
+            record("pipelines", pid, definition)
 
         def delete_pipeline(pid):
-            orig_del_pipeline(pid)
+            self.ingest.get_pipeline(pid)  # 404 before any cluster traffic
             replicate("pipelines", pid, None)
+            orig_del_pipeline(pid)
+            record("pipelines", pid, None)
 
         def put_template(name, body, composable=False):
+            if not body.get("index_patterns"):
+                raise IllegalArgumentError(
+                    "index template must define index_patterns")
+            key = f"{'c' if composable else 'l'}:{name}"
+            replicate("templates", key, body)
             orig_put_template(name, body, composable=composable)
-            replicate("templates",
-                      f"{'c' if composable else 'l'}:{name}", body)
+            record("templates", key, body)
 
         def delete_template(name, composable=False):
+            self.templates.get(name, composable=composable)
+            key = f"{'c' if composable else 'l'}:{name}"
+            replicate("templates", key, None)
             orig_del_template(name, composable=composable)
-            replicate("templates",
-                      f"{'c' if composable else 'l'}:{name}", None)
+            record("templates", key, None)
 
         def put_stored(sid, body):
-            orig_put_script(sid, body)
+            from elasticsearch_tpu.common.errors import ParsingError
+            spec = body.get("script")
+            if not isinstance(spec, dict) or "source" not in spec:
+                raise ParsingError("stored script must define [script.source]")
             replicate("scripts", sid, body)
+            orig_put_script(sid, body)
+            record("scripts", sid, body)
 
         def delete_stored(sid):
-            orig_del_script(sid)
+            self.scripts.get_stored(sid)
             replicate("scripts", sid, None)
+            orig_del_script(sid)
+            record("scripts", sid, None)
 
         ingest.put_pipeline = put_pipeline
         ingest.delete_pipeline = delete_pipeline
@@ -100,6 +136,7 @@ class ClusterAwareNode(Node):
         templates.delete = delete_template
         scripts.put_stored = put_stored
         scripts.delete_stored = delete_stored
+        self._applied_registries = {}
         self._registry_originals = {
             "pipeline": orig_put_pipeline, "template": orig_put_template,
             "script": orig_put_script, "del_pipeline": orig_del_pipeline,
@@ -233,7 +270,8 @@ class ClusterAwareNode(Node):
                   pipeline: Optional[str] = None) -> dict:
         import uuid as _uuid
         auto_created = False
-        if index not in self.cluster.cluster_state.metadata:
+        state = self.cluster.cluster_state  # ONE snapshot for this request
+        if index not in state.metadata:
             # auto-create FIRST (with matching templates), so a template-
             # provided index.default_pipeline applies to the first doc too
             resolved = self.templates.resolve(index)
@@ -247,9 +285,10 @@ class ClusterAwareNode(Node):
                     "index.default_pipeline")
         elif pipeline is None:
             # index.default_pipeline lives in the cluster metadata here
-            meta = self.cluster.cluster_state.metadata.get(index)
-            pipeline = (meta.get("settings") or {}).get(
-                "index.default_pipeline")
+            meta = state.metadata.get(index)
+            if meta is not None:
+                pipeline = (meta.get("settings") or {}).get(
+                    "index.default_pipeline")
         if pipeline and pipeline != "_none":
             body = self.ingest.execute(pipeline, index, doc_id, body)
             if body is None:

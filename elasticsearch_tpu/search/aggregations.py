@@ -543,6 +543,15 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
     recurse = recurse or compute_aggs
     field = spec.get("field")
 
+    # composite may only nest under `nested` (CompositeAggregationBuilder
+    # rejects every other parent)
+    if kind != "nested":
+        for sname, sspec in (sub_aggs or {}).items():
+            if isinstance(sspec, dict) and "composite" in sspec:
+                raise IllegalArgumentError(
+                    f"[composite] aggregation cannot be used with a parent "
+                    f"aggregation of type: [{kind}]")
+
     if kind in ("geohash_grid", "geotile_grid"):
         default_prec = 5 if kind == "geohash_grid" else 7
         precision = int(spec.get("precision", default_prec))
@@ -775,49 +784,134 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         return b
 
     if kind == "composite":
+        import itertools as _it
         sources = spec.get("sources", [])
+        if not sources:
+            raise IllegalArgumentError(
+                "Required [sources]: Composite [sources] cannot be null "
+                "or empty")
         size = int(spec.get("size", 10))
         after = spec.get("after")
-        # build per-row composite keys
-        keyed: Dict[tuple, List[int]] = {}
         names = []
-        per_source_vals = []
+        formats = []
+        per_source_vals: List[Dict[int, list]] = []
         for src in sources:
             ((sname, sdef),) = src.items()
+            if sname in names:
+                raise IllegalArgumentError(
+                    f"Composite source names must be unique, found "
+                    f"duplicates: [{sname}]")
             names.append(sname)
             ((stype, sspec),) = sdef.items()
-            col = {}
+            # a multi-valued doc contributes ONE composite key per value
+            # (CompositeValuesSourceBuilder cartesian semantics)
+            col: Dict[int, list] = {}
+            fmt = None
             if stype == "terms":
+                is_ip = getattr(ctx.mapper_service.get(sspec["field"]),
+                                "type_name", None) == "ip"
                 for idx, v in all_values(ctx, rows, sspec["field"]):
-                    col.setdefault(idx, v)
+                    if is_ip and isinstance(v, (int, float)):
+                        from elasticsearch_tpu.index.mapping import (
+                            IpFieldMapper)
+                        v = IpFieldMapper.format_value(int(v))
+                    col.setdefault(idx, []).append(v)
             elif stype == "histogram":
                 vals, present = numeric_values(ctx, rows, sspec["field"])
                 interval = float(sspec["interval"])
                 for idx in np.nonzero(present)[0]:
-                    col[int(idx)] = float(np.floor(vals[idx] / interval) * interval)
+                    col[int(idx)] = [float(np.floor(vals[idx] / interval)
+                                           * interval)]
             elif stype == "date_histogram":
                 vals, present = numeric_values(ctx, rows, sspec["field"])
+                if getattr(ctx.mapper_service.get(sspec["field"]),
+                           "type_name", None) == "date_nanos":
+                    vals = vals / 1e6
                 ims, cal = _date_interval(sspec)
+                off = _date_offset_ms(sspec.get("offset"))
+                fmt = sspec.get("format")
                 for idx in np.nonzero(present)[0]:
                     v = int(vals[idx])
-                    col[int(idx)] = _calendar_floor(v, cal) if cal else float(np.floor(v / ims) * ims)
+                    key = (_calendar_floor(v - off, cal) + off if cal
+                           else float(np.floor((v - off) / ims) * ims + off))
+                    col[int(idx)] = [key]
+            elif stype == "geotile_grid":
+                precision = int(sspec.get("precision", 7))
+                row_pos = {int(r): i for i, r in enumerate(rows)}
+                for row, lat, lon in _gather_geo_points(
+                        ctx, rows, sspec["field"]):
+                    i = row_pos.get(int(row))
+                    if i is not None:
+                        col.setdefault(i, []).append(
+                            _geotile_encode(lat, lon, precision))
+            else:
+                raise IllegalArgumentError(
+                    f"unknown composite source type [{stype}]")
+            if sspec.get("missing_bucket"):
+                for i in range(len(rows)):
+                    col.setdefault(i, [None])
             per_source_vals.append(col)
+            formats.append(fmt)
+        source_types = [next(iter(next(iter(s.values())))) for s in sources]
+
+        def src_sort_key(value, pos):
+            # geotile "z/x/y" orders by tile coordinates, not string order
+            if source_types[pos] == "geotile_grid" and isinstance(value, str):
+                try:
+                    return (0,) + tuple(int(p) for p in value.split("/"))
+                except ValueError:
+                    pass
+            return _sort_key(value)
+
+        keyed: Dict[tuple, List[int]] = {}
         for i in range(len(rows)):
-            key = tuple(col.get(i) for col in per_source_vals)
-            if any(k is None for k in key):
+            value_lists = [col.get(i) for col in per_source_vals]
+            if any(not vl for vl in value_lists):
                 continue
-            keyed.setdefault(key, []).append(i)
-        items = sorted(keyed.items(), key=lambda kv: tuple(_sort_key(k) for k in kv[0]))
+            for key in _it.product(*value_lists):
+                keyed.setdefault(key, []).append(i)
+        items = sorted(keyed.items(),
+                       key=lambda kv: tuple(src_sort_key(k, p)
+                                            for p, k in enumerate(kv[0])))
         if after is not None:
-            after_key = tuple(after.get(n) for n in names)
+            after_vals = []
+            for p, n in enumerate(names):
+                v = after.get(n)
+                if formats[p] and isinstance(v, str):
+                    # a formatted after_key round-trips: parse it back into
+                    # the internal millis domain before comparing
+                    try:
+                        from elasticsearch_tpu.index.mapping import (
+                            parse_date_millis)
+                        v = float(parse_date_millis(v))
+                    except Exception:
+                        pass
+                after_vals.append(v)
+            after_rank = tuple(src_sort_key(v, p)
+                               for p, v in enumerate(after_vals))
             items = [it for it in items
-                     if tuple(_sort_key(k) for k in it[0]) > tuple(_sort_key(k) for k in after_key)]
+                     if tuple(src_sort_key(k, p)
+                              for p, k in enumerate(it[0])) > after_rank]
         items = items[:size]
+
+        def render(key):
+            out_key = {}
+            for n, k, fmt in zip(names, key, formats):
+                if fmt and isinstance(k, (int, float)):
+                    out_key[n] = _format_date_key(int(k), fmt)
+                elif isinstance(k, float) and k.is_integer():
+                    out_key[n] = int(k)
+                else:
+                    out_key[n] = k
+            return out_key
+
         buckets = []
         for key, idxs in items:
-            b = {"key": dict(zip(names, key)), "doc_count": len(idxs)}
+            b = {"key": render(key), "doc_count": len(set(idxs))}
             if sub_aggs:
-                b.update(recurse(ctx, rows[np.asarray(idxs, dtype=np.int64)], sub_aggs))
+                b.update(recurse(ctx, rows[np.asarray(sorted(set(idxs)),
+                                                      dtype=np.int64)],
+                                 sub_aggs))
             buckets.append(b)
         out = {"buckets": buckets}
         if buckets:
@@ -943,6 +1037,27 @@ def _date_interval(spec: dict) -> Tuple[float, Optional[str]]:
     if unit:
         return 0.0, unit
     raise ParsingError(f"unknown interval [{fixed}]")
+
+
+def _format_date_key(millis: int, fmt: str) -> str:
+    """Joda-pattern-lite date rendering for agg keys ("yyyy-MM-dd",
+    "iso8601", "strict_date_time", epoch_millis)."""
+    if fmt in ("iso8601", "strict_date_time", "date_time"):
+        return _millis_to_iso(millis)
+    if fmt == "epoch_millis":
+        return str(millis)
+    import datetime as dt
+    try:
+        d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+    except (OverflowError, OSError, ValueError):
+        return str(millis)
+    strf = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
+            .replace("dd", "%d").replace("HH", "%H").replace("mm", "%M")
+            .replace("ss", "%S"))
+    out = d.strftime(strf)
+    if "SSS" in out:
+        out = out.replace("SSS", f"{d.microsecond // 1000:03d}")
+    return out
 
 
 def _date_offset_ms(offset) -> float:
