@@ -16,6 +16,7 @@ from __future__ import annotations
 import base64
 import json
 import math
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -934,41 +935,165 @@ class SpanQuery(Query):
 
 
 class IntervalsQuery(Query):
-    """`intervals` query — lowered onto the span machinery (match with
-    ordered/max_gaps ≈ span_near; all_of/any_of ≈ span_near/span_or)."""
+    """`intervals` query over the span machinery: match (ordered/max_gaps),
+    all_of/any_of combinators, prefix/wildcard/fuzzy term expansion, and
+    IntervalFilter rules (containing / not_containing / contained_by /
+    not_contained_by / overlapping / not_overlapping / before / after) at
+    any nesting level (reference: `index/query/IntervalsSourceProvider`)."""
 
     def __init__(self, field: str, rule: dict):
         self.field = field
         self.rule = rule
 
-    def _to_span(self, rule: dict) -> dict:
+    # ------------------------------------------------------------- evaluation
+    # internal spans carry (start, end, covered) — `covered` is the token
+    # mass inside the span, so total-gaps = (end-start) - covered can bound
+    # the WHOLE combination the way Lucene's Intervals.maxgaps does, not
+    # each adjacent pair
+    def _analyzed_terms(self, ctx, text: str) -> List[str]:
+        mapper = ctx.mapper_service.get(self.field)
+        if mapper is not None and hasattr(mapper, "search_analyzer"):
+            return mapper.search_analyzer.terms(str(text))
+        if mapper is not None and hasattr(mapper, "analyze"):
+            return mapper.analyze(str(text))
+        return str(text).lower().split()
+
+    def _term_spans3(self, ctx, term: str):
+        return {row: [(s, e, e - s) for s, e in spans]
+                for row, spans in _term_spans(ctx, self.field, term).items()}
+
+    def _union_terms(self, ctx, terms):
+        out: Dict[int, list] = {}
+        for t in terms:
+            for row, spans in self._term_spans3(ctx, t).items():
+                out.setdefault(row, []).extend(spans)
+        return {r: sorted(set(s)) for r, s in out.items()}
+
+    @staticmethod
+    def _near3(a, b, max_gaps: int, ordered: bool):
+        """Pair spans with a TOTAL internal-gap budget."""
+        out: Dict[int, list] = {}
+        bound = max_gaps if max_gaps >= 0 else 10 ** 9
+        for row in set(a) & set(b):
+            spans = []
+            for s1, e1, c1 in a[row]:
+                for s2, e2, c2 in b[row]:
+                    if s1 < e2 and s2 < e1:
+                        continue  # overlapping spans don't pair
+                    if ordered and s2 < e1:
+                        continue
+                    lo, hi = min(s1, s2), max(e1, e2)
+                    covered = c1 + c2
+                    if (hi - lo) - covered <= bound:
+                        spans.append((lo, hi, covered))
+            if spans:
+                out[row] = sorted(set(spans))
+        return out
+
+    def _spans_for(self, ctx, rule: dict) -> Dict[int, list]:
+        from elasticsearch_tpu.search.queries import (
+            _edit_distance_le as _ed_le, _pattern_terms,
+        )
         kind, spec = next(iter(rule.items()))
+        spec = spec if isinstance(spec, dict) else {"query": spec}
+        filt = spec.get("filter")
         if kind == "match":
-            text = spec.get("query", "")
+            terms = self._analyzed_terms(ctx, spec.get("query", ""))
             ordered = bool(spec.get("ordered", False))
             max_gaps = int(spec.get("max_gaps", -1))
-            terms = str(text).lower().split()
-            clauses = [{"span_term": {self.field: t}} for t in terms]
-            if len(clauses) == 1:
-                return clauses[0]
-            slop = max_gaps if max_gaps >= 0 else 10 ** 6
-            return {"span_near": {"clauses": clauses, "slop": slop,
-                                  "in_order": ordered}}
-        if kind == "all_of":
-            clauses = [self._to_span(r) for r in spec.get("intervals", [])]
+            spans = self._term_spans3(ctx, terms[0]) if terms else {}
+            for t in terms[1:]:
+                spans = self._near3(spans, self._term_spans3(ctx, t),
+                                    max_gaps, ordered)
+        elif kind == "all_of":
+            children = [self._spans_for(ctx, r)
+                        for r in spec.get("intervals", [])]
             max_gaps = int(spec.get("max_gaps", -1))
-            return {"span_near": {"clauses": clauses,
-                                  "slop": max_gaps if max_gaps >= 0 else 10 ** 6,
-                                  "in_order": bool(spec.get("ordered", False))}}
-        if kind == "any_of":
-            return {"span_or": {"clauses": [self._to_span(r)
-                                            for r in spec.get("intervals", [])]}}
-        raise ParsingError(f"unsupported intervals rule [{kind}]")
+            ordered = bool(spec.get("ordered", False))
+            spans = children[0] if children else {}
+            for child in children[1:]:
+                spans = self._near3(spans, child, max_gaps, ordered)
+        elif kind == "any_of":
+            spans = {}
+            for r in spec.get("intervals", []):
+                for row, ss in self._spans_for(ctx, r).items():
+                    spans.setdefault(row, []).extend(ss)
+            spans = {r: sorted(set(s)) for r, s in spans.items()}
+        elif kind == "prefix":
+            p = str(spec.get("prefix", spec.get("query", ""))).lower()
+            spans = self._union_terms(
+                ctx, _pattern_terms(ctx, self.field,
+                                    lambda t: t.startswith(p)))
+        elif kind == "wildcard":
+            # ES wildcard: only * and ? are special — NOT fnmatch classes
+            pat = str(spec.get("pattern", spec.get("query", ""))).lower()
+            rx = re.compile("^" + re.escape(pat).replace(r"\*", ".*")
+                            .replace(r"\?", ".") + "$")
+            spans = self._union_terms(
+                ctx, _pattern_terms(ctx, self.field,
+                                    lambda t: rx.match(t) is not None))
+        elif kind == "fuzzy":
+            term = str(spec.get("term", spec.get("query", ""))).lower()
+            fuzz = spec.get("fuzziness", "auto")
+            if str(fuzz).lower() == "auto":
+                max_ed = 0 if len(term) < 3 else (1 if len(term) < 6 else 2)
+            else:
+                max_ed = int(fuzz)
+            spans = self._union_terms(
+                ctx, _pattern_terms(ctx, self.field,
+                                    lambda t: _ed_le(term, t, max_ed)))
+        else:
+            raise ParsingError(f"unsupported intervals rule [{kind}]")
+        if filt:
+            spans = self._apply_filter(ctx, spans, filt)
+        return spans
+
+    def _apply_filter(self, ctx, spans, filt: dict):
+        if not isinstance(filt, dict) or len(filt) != 1:
+            raise ParsingError(
+                "intervals [filter] must define exactly one rule")
+        ((mode, inner_rule),) = filt.items()
+        fspans = self._spans_for(ctx, inner_rule)
+        out = {}
+        for row, ss in spans.items():
+            fs = fspans.get(row, [])
+
+            def containing(sp):
+                return any(sp[0] <= s and e <= sp[1] for s, e, _ in fs)
+
+            def contained_by(sp):
+                return any(s <= sp[0] and sp[1] <= e for s, e, _ in fs)
+
+            def overlapping(sp):
+                return any(sp[0] < e and s < sp[1] for s, e, _ in fs)
+
+            def before(sp):
+                return any(sp[1] <= s for s, e, _ in fs)
+
+            def after(sp):
+                return any(sp[0] >= e for s, e, _ in fs)
+
+            preds = {"containing": containing,
+                     "not_containing": lambda sp: not containing(sp),
+                     "contained_by": contained_by,
+                     "not_contained_by": lambda sp: not contained_by(sp),
+                     "overlapping": overlapping,
+                     "not_overlapping": lambda sp: not overlapping(sp),
+                     "before": before, "after": after}
+            pred = preds.get(mode)
+            if pred is None:
+                raise ParsingError(f"unknown intervals filter [{mode}]")
+            keep = [sp for sp in ss if pred(sp)]
+            if keep:
+                out[row] = keep
+        return out
 
     def execute(self, ctx: SearchContext) -> DocSet:
-        span = self._to_span(self.rule)
-        kind, spec = next(iter(span.items()))
-        return SpanQuery(kind, spec).execute(ctx)
+        span_map = self._spans_for(ctx, self.rule)
+        rows = np.asarray(sorted(span_map), dtype=np.int64)
+        scores = np.asarray([float(len(span_map[int(r)])) for r in rows],
+                            dtype=np.float32)
+        return DocSet(rows, scores)
 
     def to_dict(self):
         return {"intervals": {self.field: self.rule}}

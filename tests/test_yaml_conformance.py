@@ -95,6 +95,7 @@ MUST_PASS = [
     "search.aggregation/70_adjacency_matrix.yml",
     "search.aggregation/80_typed_keys.yml",
     "search/200_index_phrase_search.yml",
+    "search/230_interval_query.yml",
     "search/90_search_after.yml",
     "search/issue4895.yml",
     "suggest/10_basic.yml",
