@@ -333,8 +333,14 @@ class IndicesService:
             if "add" in action:
                 spec = action["add"]
                 svc = self.get(spec["index"])
-                svc.aliases[spec["alias"]] = {
-                    k: v for k, v in spec.items() if k not in ("index", "alias")}
+                opts = {k: v for k, v in spec.items()
+                        if k not in ("index", "alias")}
+                # plain `routing` expands to both sides (AliasMetaData)
+                if "routing" in opts:
+                    routing = opts.pop("routing")
+                    opts.setdefault("index_routing", routing)
+                    opts.setdefault("search_routing", routing)
+                svc.aliases[spec["alias"]] = opts
                 self._persist_meta(svc)
             elif "remove" in action:
                 spec = action["remove"]

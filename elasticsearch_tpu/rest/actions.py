@@ -461,27 +461,113 @@ def register_all(rc: RestController, node: Node) -> None:
         node.indices.update_aliases((req.json() or {}).get("actions", []))
         return 200, {"acknowledged": True}
 
+    def _split_alias_patterns(patterns):
+        """`-pat` is an exclusion only once a WILDCARD pattern has appeared
+        earlier in the list; before that it is a literal name
+        (IndexNameExpressionResolver: exclusions subtract from wildcard
+        expansions)."""
+        includes, excludes = [], []
+        seen_wildcard = False
+        for p in patterns:
+            if p.startswith("-") and seen_wildcard:
+                excludes.append(p[1:])
+                continue
+            includes.append(p)
+            if "*" in p or p == "_all":
+                seen_wildcard = True
+        return includes, excludes
+
+    def _alias_matches(alias: str, patterns) -> bool:
+        import fnmatch as _fn
+        includes, excludes = _split_alias_patterns(patterns)
+        if not any(p in ("_all", "*") or _fn.fnmatch(alias, p)
+                   for p in includes):
+            return False
+        return not any(p in ("_all", "*") or _fn.fnmatch(alias, p)
+                       for p in excludes)
+
+    def _missing_aliases(patterns, found) -> list:
+        includes, _ = _split_alias_patterns(patterns)
+        return [p for p in includes
+                if "*" not in p and p != "_all" and p not in found]
+
+    def _alias_missing_response(missing, extra=None):
+        label = "alias" if len(missing) == 1 else "aliases"
+        return 404, {"error": f"{label} [{','.join(sorted(missing))}] missing",
+                     "status": 404, **(extra or {})}
+
     def get_aliases(req):
+        """GET [/{index}]/_alias[/{name}] (TransportGetAliasesAction):
+        name filters (csv, wildcards, _all, `-` exclusions); concrete
+        names matching nothing anywhere are a 404 `alias(es) [x] missing`."""
+        name = req.params.get("alias")
+        patterns = [p.strip() for p in name.split(",")] if name else None
         out = {}
-        for svc in node.indices.resolve(req.params.get("index")):
-            out[svc.name] = {"aliases": svc.aliases}
+        resolved = node.indices.resolve(req.params.get("index"))
+        for svc in resolved:
+            if patterns is None:
+                out[svc.name] = {"aliases": dict(svc.aliases)}
+                continue
+            matched = {a: spec for a, spec in svc.aliases.items()
+                       if _alias_matches(a, patterns)}
+            if matched:
+                out[svc.name] = {"aliases": matched}
+        if patterns:
+            # missing is judged WITHIN the requested index scope
+            # (RestGetAliasesAction checks the response, not the cluster)
+            scope_aliases = {a for svc in resolved for a in svc.aliases}
+            missing = _missing_aliases(patterns, scope_aliases)
+            if missing:
+                return _alias_missing_response(missing, out)
         return 200, out
 
+    def alias_exists(req):
+        status, _body = get_aliases(req)
+        return (200 if status == 200 else 404), None
+
     def put_alias(req):
-        node.indices.update_aliases([{"add": {
-            "index": req.params["index"], "alias": req.params["alias"]}}])
+        body = req.json() or {}
+        spec = {k: v for k, v in body.items()
+                if k in ("filter", "routing", "index_routing",
+                         "search_routing", "is_write_index")}
+        targets = node.indices.resolve(req.params["index"])
+        if not targets:
+            raise IndexNotFoundError(req.params["index"])
+        for svc in targets:
+            node.indices.update_aliases([{"add": {
+                "index": svc.name, "alias": req.params["alias"], **spec}}])
         return 200, {"acknowledged": True}
 
     def delete_alias(req):
-        node.indices.update_aliases([{"remove": {
-            "index": req.params["index"], "alias": req.params["alias"]}}])
+        """DELETE /{index}/_alias/{name}: names/indices take csv +
+        wildcards. Validation-first and ATOMIC: a missing concrete name
+        404s with NOTHING removed (the reference validates all alias
+        actions before mutating)."""
+        patterns = [p.strip() for p in req.params["alias"].split(",")]
+        targets = node.indices.resolve(req.params["index"])
+        if not targets:
+            raise IndexNotFoundError(req.params["index"])
+        removals = [(svc.name, a) for svc in targets
+                    for a in list(svc.aliases)
+                    if _alias_matches(a, patterns)]
+        scope_aliases = {a for _, a in removals}
+        missing = _missing_aliases(patterns, scope_aliases)
+        if missing:
+            return _alias_missing_response(missing)
+        for index_name, alias in removals:
+            node.indices.update_aliases([{"remove": {
+                "index": index_name, "alias": alias}}])
         return 200, {"acknowledged": True}
 
     rc.register("POST", "/_aliases", aliases_post)
-    rc.register("GET", "/_alias", get_aliases)
-    rc.register("GET", "/{index}/_alias", get_aliases)
-    rc.register("PUT", "/{index}/_alias/{alias}", put_alias)
-    rc.register("DELETE", "/{index}/_alias/{alias}", delete_alias)
+    for path in ("/_alias", "/{index}/_alias", "/_alias/{alias}",
+                 "/{index}/_alias/{alias}"):
+        rc.register("GET", path, get_aliases)
+        rc.register("HEAD", path, alias_exists)
+    for path in ("/{index}/_alias/{alias}", "/{index}/_aliases/{alias}"):
+        rc.register("PUT", path, put_alias)
+        rc.register("POST", path, put_alias)
+        rc.register("DELETE", path, delete_alias)
 
     # ---------------------------------------------------------------- cluster
     def cluster_health(req):

@@ -55,6 +55,10 @@ MUST_PASS = [
     "bulk/20_list_of_strings.yml",
     "bulk/30_big_string.yml",
     "bulk/50_refresh.yml",
+    "cat.aliases/30_json.yml",
+    "cluster.remote_info/10_info.yml",
+    "cluster.reroute/10_basic.yml",
+    "cluster.state/10_basic.yml",
     "create/10_with_id.yml",
     "create/40_routing.yml",
     "create/60_refresh.yml",
@@ -63,6 +67,8 @@ MUST_PASS = [
     "delete/12_result.yml",
     "delete/20_cas.yml",
     "delete/30_routing.yml",
+    "exists/10_basic.yml",
+    "exists/40_routing.yml",
     "exists/70_defaults.yml",
     "get/10_basic.yml",
     "get/15_default_values.yml",
@@ -76,12 +82,26 @@ MUST_PASS = [
     "index/30_cas.yml",
     "index/40_routing.yml",
     "index/60_refresh.yml",
+    "indices.delete_alias/10_basic.yml",
+    "indices.delete_alias/all_path_options.yml",
+    "indices.exists/10_basic.yml",
     "indices.exists/20_read_only_index.yml",
+    "indices.exists_alias/10_basic.yml",
+    "indices.get_alias/20_empty.yml",
     "indices.get_mapping/10_basic.yml",
     "indices.get_mapping/40_aliases.yml",
     "indices.get_mapping/60_empty.yml",
+    "indices.open/10_basic.yml",
+    "indices.open/20_multiple_indices.yml",
+    "indices.put_alias/all_path_options.yml",
+    "indices.put_settings/all_path_options.yml",
+    "indices.rollover/20_max_doc_condition.yml",
+    "indices.rollover/30_max_size_condition.yml",
+    "indices.rollover/40_mapping.yml",
+    "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
+    "mlt/10_basic.yml",
     "msearch/11_status.yml",
     "ping/10_ping.yml",
     "search.aggregation/100_avg_metric.yml",

@@ -329,9 +329,12 @@ class YamlTestRunner:
         status, resp = client.req(method, path, body=body, **query)
         if method == "HEAD":
             # HEAD APIs (exists/ping) have no body: the runner exposes the
-            # existence boolean, as the reference runner does
+            # existence boolean, and a 404 is the valid `false` answer —
+            # other 4xx/5xx still fail the step (ClientYamlTestClient)
             resp = status < 300
         stash["__last__"] = resp
+        if method == "HEAD" and status == 404 and catch is None:
+            return
         if catch is not None:
             if catch.startswith("/") and catch.endswith("/"):
                 if status < 400 or not re.search(
