@@ -844,13 +844,100 @@ class Node:
             "active_shards_percent_as_number": 100.0,
         }
 
-    def index_stats(self, name: str) -> dict:
-        svc = self.indices.get(name)
-        docs = svc.doc_count()
-        segs = sum(len(s.engine.segments) for s in svc.shards)
-        return {"_all": {"primaries": {"docs": {"count": docs, "deleted": 0},
-                                       "segments": {"count": segs}}},
-                "indices": {svc.name: {"primaries": {"docs": {"count": docs}}}}}
+    _STATS_METRICS = ("docs", "store", "indexing", "get", "search", "merge",
+                      "refresh", "flush", "segments", "translog",
+                      "query_cache", "request_cache", "fielddata",
+                      "completion", "warmer", "recovery")
+
+    def index_stats(self, name: Optional[str] = None,
+                    metrics: Optional[List[str]] = None) -> dict:
+        """`GET [/{index}]/_stats[/{metric}]` (IndicesStatsAction):
+        per-index stat sections with metric filtering; `_shards.total`
+        counts primaries + configured replicas, `successful` the shards
+        actually running here."""
+        services = self.indices.resolve(name)
+        if metrics and not any(m in ("_all", "*") for m in metrics):
+            keep = set(metrics)
+        else:
+            keep = set(self._STATS_METRICS)
+
+        import os as _os
+
+        def shard_sections(svc) -> dict:
+            docs = svc.doc_count()
+            segs = sum(len(s.engine.segments) for s in svc.shards)
+            tlog_ops = sum(
+                s.engine.translog.operation_count()
+                if hasattr(s.engine.translog, "operation_count") else 0
+                for s in svc.shards)
+            tlog_bytes = sum(
+                _dir_size(_os.path.join(s.engine.path, "translog"))
+                for s in svc.shards)
+            # cumulative ops (seq_nos are monotonic; doc_count would shrink
+            # on delete); store = segment/commit bytes WITHOUT the translog
+            ops_total = sum(s.engine.local_checkpoint + 1
+                            for s in svc.shards)
+            full = {
+                "docs": {"count": docs, "deleted": 0},
+                "store": {"size_in_bytes": max(
+                    sum(_dir_size(s.engine.path) for s in svc.shards)
+                    - tlog_bytes, 0),
+                    "reserved_in_bytes": 0},
+                "indexing": {"index_total": ops_total, "index_failed": 0,
+                             "delete_total": 0},
+                "get": {"total": 0, "missing_total": 0},
+                # node-global counters (search, caches) land in _all ONCE
+                # below — per-index attribution is not tracked
+                "search": {"query_total": 0, "fetch_total": 0,
+                           "open_contexts": 0},
+                "merge": {"total": 0, "total_docs": 0},
+                "refresh": {"total": 0, "external_total": 0},
+                "flush": {"total": 0, "periodic": 0},
+                "segments": {"count": segs,
+                             "memory_in_bytes": 0},
+                "translog": {"operations": tlog_ops,
+                             "size_in_bytes": tlog_bytes,
+                             "uncommitted_operations": 0},
+                "query_cache": {"memory_size_in_bytes": 0, "hit_count": 0,
+                                "miss_count": 0, "evictions": 0},
+                "request_cache": {"memory_size_in_bytes": 0, "hit_count": 0,
+                                  "miss_count": 0, "evictions": 0},
+                "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
+                "completion": {"size_in_bytes": 0},
+                "warmer": {"current": 0, "total": 0},
+                "recovery": {"current_as_source": 0, "current_as_target": 0},
+            }
+            return {k: v for k, v in full.items() if k in keep}
+
+        indices_out = {}
+        total_shards = 0
+        successful = 0
+        agg: dict = {}
+        for svc in services:
+            total_shards += svc.num_shards * (1 + svc.num_replicas)
+            successful += svc.num_shards
+            sections = shard_sections(svc)
+            indices_out[svc.name] = {"uuid": svc.uuid,
+                                     "primaries": sections,
+                                     "total": sections}
+            _deep_merge_add(agg, sections)
+        # node-global counters attributed once at the _all level
+        if "search" in keep and "search" in agg:
+            agg["search"]["query_total"] = self.counters.get("search", 0)
+        if "query_cache" in keep and "query_cache" in agg:
+            agg["query_cache"].update(
+                hit_count=self.caches.query.hits,
+                miss_count=self.caches.query.misses,
+                evictions=self.caches.query.evictions)
+        if "request_cache" in keep and "request_cache" in agg:
+            agg["request_cache"].update(
+                hit_count=self.caches.request.hits,
+                miss_count=self.caches.request.misses,
+                evictions=self.caches.request.evictions)
+        return {"_shards": {"total": total_shards, "successful": successful,
+                            "failed": 0},
+                "_all": {"primaries": agg, "total": agg},
+                "indices": indices_out}
 
     def close(self):
         self.ml.close_all()
@@ -860,6 +947,29 @@ class Node:
 
 
 # ---------------------------------------------------------------------------
+
+def _dir_size(path: str) -> int:
+    import os as _os
+    total = 0
+    for root, _dirs, files in _os.walk(path):
+        for f in files:
+            try:
+                total += _os.path.getsize(_os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def _deep_merge_add(dst: dict, src: dict) -> None:
+    """Numeric stat sections sum; nested dicts merge recursively."""
+    for k, v in src.items():
+        if isinstance(v, dict):
+            _deep_merge_add(dst.setdefault(k, {}), v)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            dst[k] = dst.get(k, 0) + v
+        else:
+            dst.setdefault(k, v)
+
 
 def _deep_merge(dst: dict, src: dict) -> None:
     for k, v in src.items():

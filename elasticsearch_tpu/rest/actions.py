@@ -418,16 +418,41 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("PUT", "/{index}/_mapping", put_mapping)
     rc.register("POST", "/{index}/_mapping", put_mapping)
 
+    def _settings_str(v):
+        # the reference renders every setting value as a string
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (list, tuple)):
+            return [_settings_str(x) for x in v]
+        return str(v)
+
     def get_settings(req):
+        import fnmatch as _fn
+        name_filter = req.params.get("name")
+        patterns = ([p.strip() for p in name_filter.split(",")]
+                    if name_filter and name_filter not in ("_all", "*")
+                    else None)
         out = {}
         for svc in node.indices.resolve(req.params.get("index")):
-            out[svc.name] = {"settings": {"index": {
-                **{k.replace("index.", "", 1): v
-                   for k, v in svc.settings.as_flat_dict().items()}}}}
+            flat = {"index.uuid": svc.uuid,
+                    "index.provided_name": svc.name,
+                    "index.creation_date": str(svc.creation_date),
+                    **svc.settings.as_flat_dict()}
+            if patterns is not None:
+                flat = {k: v for k, v in flat.items()
+                        if any(_fn.fnmatch(k, p) for p in patterns)}
+            index_section: dict = {}
+            for k, v in flat.items():
+                if v is None:
+                    continue  # null = reset-to-default, never the string "None"
+                index_section[k.replace("index.", "", 1)] = _settings_str(v)
+            out[svc.name] = {"settings": {"index": index_section}}
         return 200, out
 
     rc.register("GET", "/_settings", get_settings)
     rc.register("GET", "/{index}/_settings", get_settings)
+    rc.register("GET", "/_settings/{name}", get_settings)
+    rc.register("GET", "/{index}/_settings/{name}", get_settings)
 
     def refresh(req):
         for svc in node.indices.resolve(req.params.get("index")):
@@ -453,9 +478,14 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_forcemerge", forcemerge)
 
     def index_stats(req):
-        return 200, node.index_stats(req.params["index"])
+        metric = req.params.get("metric")
+        metrics = [m.strip() for m in metric.split(",")] if metric else None
+        return 200, node.index_stats(req.params.get("index"), metrics)
 
+    rc.register("GET", "/_stats", index_stats)
+    rc.register("GET", "/_stats/{metric}", index_stats)
     rc.register("GET", "/{index}/_stats", index_stats)
+    rc.register("GET", "/{index}/_stats/{metric}", index_stats)
 
     def aliases_post(req):
         node.indices.update_aliases((req.json() or {}).get("actions", []))

@@ -91,6 +91,8 @@ MUST_PASS = [
     "indices.get_mapping/10_basic.yml",
     "indices.get_mapping/40_aliases.yml",
     "indices.get_mapping/60_empty.yml",
+    "indices.get_settings/10_basic.yml",
+    "indices.get_settings/20_aliases.yml",
     "indices.open/10_basic.yml",
     "indices.open/20_multiple_indices.yml",
     "indices.put_alias/all_path_options.yml",
