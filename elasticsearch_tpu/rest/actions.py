@@ -613,16 +613,70 @@ def register_all(rc: RestController, node: Node) -> None:
         }
 
     def cluster_state(req):
+        """GET /_cluster/state[/{metric}[/{index}]] — metric filtering
+        (ClusterStateRequest: version, master_node, nodes, metadata,
+        routing_table, routing_nodes, blocks; cluster_name + cluster_uuid
+        always present)."""
+        from elasticsearch_tpu.common.settings import setting_bool
+        _VALID_METRICS = {"_all", "version", "master_node", "nodes",
+                          "metadata", "routing_table", "routing_nodes",
+                          "blocks"}
+        metric = req.params.get("metric")
+        metrics = ({m.strip() for m in metric.split(",")} if metric else None)
+        if metrics is not None:
+            unknown = metrics - _VALID_METRICS
+            if unknown:
+                raise IllegalArgumentError(
+                    f"request [/_cluster/state/{metric}] contains "
+                    f"unrecognized metric: [{sorted(unknown)[0]}]")
+            if "_all" in metrics:
+                metrics = None  # _all anywhere in the list = everything
+        index_filter = req.params.get("index")
+        svcs = (node.indices.resolve(index_filter) if index_filter
+                else list(node.indices.indices.values()))
         meta = {}
-        for name, svc in node.indices.indices.items():
-            meta[name] = {"settings": svc.settings.as_flat_dict(),
-                          "mappings": svc.mapper_service.to_dict(),
-                          "aliases": list(svc.aliases)}
-        return 200, {"cluster_name": node.cluster_name,
-                     "cluster_uuid": node.node_id, "version": 1,
-                     "master_node": node.node_id,
-                     "nodes": {node.node_id: {"name": node.node_name}},
-                     "metadata": {"indices": meta}}
+        routing = {}
+        index_blocks = {}
+        for svc in svcs:
+            meta[svc.name] = {"settings": svc.settings.as_flat_dict(),
+                              "mappings": svc.mapper_service.to_dict(),
+                              "aliases": list(svc.aliases)}
+            routing[svc.name] = {"shards": {
+                str(s.shard_id): [{"state": "STARTED", "primary": True,
+                                   "node": node.node_id,
+                                   "shard": s.shard_id, "index": svc.name}]
+                for s in svc.shards}}
+            b = {}
+            if setting_bool(svc.settings.get("index.blocks.read_only")):
+                b["5"] = {"description": "index read-only (api)",
+                          "retryable": False,
+                          "levels": ["write", "metadata_write"]}
+            if setting_bool(svc.settings.get("index.blocks.write")):
+                b["8"] = {"description": "index write (api)",
+                          "retryable": False, "levels": ["write"]}
+            if b:
+                index_blocks[svc.name] = b
+        sections = {
+            "version": 1,
+            "master_node": node.node_id,
+            "blocks": {"indices": index_blocks} if index_blocks else {},
+            "nodes": {node.node_id: {"name": node.node_name}},
+            "metadata": {"indices": meta,
+                         "cluster_uuid": node.node_id},
+            "routing_table": {"indices": routing},
+            "routing_nodes": {"unassigned": [],
+                              "nodes": {node.node_id: [
+                                  e for r in routing.values()
+                                  for shards in r["shards"].values()
+                                  for e in shards]}},
+        }
+        out = {"cluster_name": node.cluster_name,
+               "cluster_uuid": node.node_id,
+               "state_uuid": node.node_id}
+        for key, value in sections.items():
+            if metrics is None or key in metrics:
+                out[key] = value
+        return 200, out
 
     def nodes_info(req):
         natives = node.natives
@@ -670,6 +724,8 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_cluster/health", cluster_health)
     rc.register("GET", "/_cluster/stats", cluster_stats)
     rc.register("GET", "/_cluster/state", cluster_state)
+    rc.register("GET", "/_cluster/state/{metric}", cluster_state)
+    rc.register("GET", "/_cluster/state/{metric}/{index}", cluster_state)
     rc.register("GET", "/_nodes", nodes_info)
     rc.register("GET", "/_nodes/stats", nodes_stats)
 

@@ -59,6 +59,7 @@ MUST_PASS = [
     "cluster.remote_info/10_info.yml",
     "cluster.reroute/10_basic.yml",
     "cluster.state/10_basic.yml",
+    "cluster.state/20_filtering.yml",
     "create/10_with_id.yml",
     "create/40_routing.yml",
     "create/60_refresh.yml",
