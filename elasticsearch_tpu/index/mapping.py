@@ -70,13 +70,92 @@ _DATE_PATTERNS = (
 )
 
 
-def parse_date_millis(value: Any) -> int:
-    """Parse a date into epoch millis (reference: DateFieldMapper, strict_date_optional_time||epoch_millis)."""
+_DATE_MATH_TOKEN = re.compile(r"([+\-/])(\d*)([yMwdhHms])")
+_ROUND_SPAN_MS = {"s": 1000, "m": 60_000, "h": 3_600_000, "H": 3_600_000,
+                  "d": 86_400_000, "w": 7 * 86_400_000}
+
+
+def _apply_date_math(millis: int, expr: str, round_up: bool = False) -> int:
+    """Date-math suffix (`||+1d/d`, `now-1h`): +/- offsets and /unit
+    rounding (reference: JavaDateMathParser). `round_up` rounds to the END
+    of the unit (the reference rounds up for gt/lte bounds). Malformed
+    expressions raise — a typo must fail loudly, not query the wrong
+    window."""
+    tokens = _DATE_MATH_TOKEN.findall(expr)
+    if "".join(op + num + unit for op, num, unit in tokens) \
+            != expr.replace(" ", ""):
+        raise MapperParsingError(
+            f"failed to parse date math expression [{expr}]")
+    for op, num, unit in tokens:
+        if op == "/":
+            if num:
+                raise MapperParsingError(
+                    f"rounding does not take a number [{op}{num}{unit}]")
+            d = _dt.datetime.fromtimestamp(millis / 1000.0,
+                                           tz=_dt.timezone.utc)
+            if unit == "d":
+                d = d.replace(hour=0, minute=0, second=0, microsecond=0)
+            elif unit in ("h", "H"):
+                d = d.replace(minute=0, second=0, microsecond=0)
+            elif unit == "m":
+                d = d.replace(second=0, microsecond=0)
+            elif unit == "s":
+                d = d.replace(microsecond=0)
+            elif unit == "M":
+                d = d.replace(day=1, hour=0, minute=0, second=0,
+                              microsecond=0)
+            elif unit == "y":
+                d = d.replace(month=1, day=1, hour=0, minute=0, second=0,
+                              microsecond=0)
+            elif unit == "w":
+                d = (d - _dt.timedelta(days=d.weekday())).replace(
+                    hour=0, minute=0, second=0, microsecond=0)
+            millis = int(d.timestamp() * 1000)
+            if round_up:
+                if unit in _ROUND_SPAN_MS:
+                    millis += _ROUND_SPAN_MS[unit] - 1
+                else:  # month/year: start of NEXT unit minus 1ms
+                    months = 12 if unit == "y" else 1
+                    millis = _shift_months(millis, months) - 1
+            continue
+        n = int(num or 1)
+        if unit in _ROUND_SPAN_MS and unit != "w":
+            delta = n * _ROUND_SPAN_MS[unit]
+            millis += delta if op == "+" else -delta
+        elif unit == "w":
+            delta = n * _ROUND_SPAN_MS["w"]
+            millis += delta if op == "+" else -delta
+        else:  # calendar months/years, day-clamped like the reference
+            months = n * (12 if unit == "y" else 1)
+            millis = _shift_months(millis, months if op == "+" else -months)
+    return millis
+
+
+def _shift_months(millis: int, months: int) -> int:
+    import calendar
+    d = _dt.datetime.fromtimestamp(millis / 1000.0, tz=_dt.timezone.utc)
+    total = d.month - 1 + months
+    year = d.year + total // 12
+    month = total % 12 + 1
+    day = min(d.day, calendar.monthrange(year, month)[1])
+    return int(d.replace(year=year, month=month, day=day).timestamp() * 1000)
+
+
+def parse_date_millis(value: Any, round_up: bool = False) -> int:
+    """Parse a date into epoch millis (reference: DateFieldMapper,
+    strict_date_optional_time||epoch_millis + date math). `round_up`
+    applies to /unit rounding (gt/lte query bounds round to unit end)."""
     if isinstance(value, bool):
         raise MapperParsingError(f"cannot parse date from boolean [{value}]")
     if isinstance(value, (int, float)):
         return int(value)
     s = str(value).strip()
+    if s.startswith("now"):
+        import time as _time
+        return _apply_date_math(int(_time.time() * 1000), s[3:], round_up)
+    if "||" in s:
+        base, _, math_expr = s.partition("||")
+        return _apply_date_math(parse_date_millis(base), math_expr, round_up)
     if re.fullmatch(r"-?\d{10,}", s):
         return int(s)
     norm = s.replace("Z", "+0000")
@@ -519,8 +598,9 @@ class RangeFieldMapperBase(FieldMapper):
     def doc_value(self, value):
         return self.coerce(value)
 
-    def query_bound(self, value) -> float:
-        """Bound coercion for query-side values (same units as storage)."""
+    def query_bound(self, value, round_up: bool = False) -> float:
+        """Bound coercion for query-side values (same units as storage);
+        `round_up` only matters for date ranges (date-math rounding)."""
         return self._bound(value)
 
 
@@ -549,6 +629,9 @@ class DateRangeFieldMapper(RangeFieldMapperBase):
 
     def _bound(self, value):
         return float(parse_date_millis(value))
+
+    def query_bound(self, value, round_up: bool = False) -> float:
+        return float(parse_date_millis(value, round_up=round_up))
 
 
 class IpRangeFieldMapper(RangeFieldMapperBase):

@@ -29,7 +29,8 @@ import numpy as np
 from elasticsearch_tpu import native
 from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
 from elasticsearch_tpu.index.mapping import (
-    BooleanFieldMapper, DateFieldMapper, DenseVectorFieldMapper, IpFieldMapper,
+    BooleanFieldMapper, DateFieldMapper, DateNanosFieldMapper,
+    DenseVectorFieldMapper, IpFieldMapper,
     KeywordFieldMapper, MapperService, RangeFieldMapperBase, TextFieldMapper,
     _NumericMapper, parse_date_millis,
 )
@@ -411,30 +412,39 @@ class RangeQuery(Query):
         self.boost = boost
         self.relation = relation
 
-    def _coerce_bound(self, ctx, value):
+    def _coerce_bound(self, ctx, value, round_up: bool = False):
         mapper = ctx.mapper_service.get(self.field)
-        if isinstance(mapper, DateFieldMapper):
-            # doc_value() keeps bound and stored value in the SAME unit
-            # (millis for date, nanos for date_nanos)
+        if isinstance(mapper, DateNanosFieldMapper):
             return float(mapper.doc_value(value))
+        if isinstance(mapper, DateFieldMapper):
+            # same unit as storage; gt/lte round date math UP to unit end
+            # (JavaDateMathParser roundUp semantics)
+            return float(parse_date_millis(value, round_up=round_up))
         if isinstance(mapper, IpFieldMapper):
             return float(mapper.coerce(value))
         if isinstance(mapper, RangeFieldMapperBase):
-            return mapper.query_bound(value)
+            return mapper.query_bound(value, round_up=round_up)
         return float(value)
 
     def execute(self, ctx: SearchContext) -> DocSet:
         lo = -np.inf
         hi = np.inf
         lo_inc = hi_inc = True
-        if self.gte is not None:
-            lo = self._coerce_bound(ctx, self.gte)
-        if self.gt is not None:
-            lo, lo_inc = self._coerce_bound(ctx, self.gt), False
-        if self.lte is not None:
-            hi = self._coerce_bound(ctx, self.lte)
-        if self.lt is not None:
-            hi, hi_inc = self._coerce_bound(ctx, self.lt), False
+        numeric_bounds = True
+        try:
+            if self.gte is not None:
+                lo = self._coerce_bound(ctx, self.gte)
+            if self.gt is not None:
+                lo, lo_inc = self._coerce_bound(ctx, self.gt,
+                                                round_up=True), False
+            if self.lte is not None:
+                hi = self._coerce_bound(ctx, self.lte, round_up=True)
+            if self.lt is not None:
+                hi, hi_inc = self._coerce_bound(ctx, self.lt), False
+        except (ValueError, TypeError):
+            # non-numeric bounds (e.g. [alice TO bob] on a keyword field):
+            # only the string-doc-values path below applies
+            numeric_bounds = False
 
         mapper = ctx.mapper_service.get(self.field)
         if isinstance(mapper, RangeFieldMapperBase):
@@ -459,7 +469,7 @@ class RangeQuery(Query):
         for view in ctx.reader.views:
             seg = view.segment
             col = seg.doc_values.get(field)
-            if col is None or col.numeric is None:
+            if col is None or col.numeric is None or not numeric_bounds:
                 # fall back to string doc values (keyword ranges)
                 if col is not None:
                     locs = [i for i, v in enumerate(col.values)
@@ -752,7 +762,12 @@ class QueryStringQuery(Query):
         self.default_operator = op
         self.boost = boost
 
-    _TOKEN_RE = re.compile(r'([+-]?)(?:(\w[\w.]*):)?("(?:[^"]*)"|\S+)')
+    _TOKEN_RE = re.compile(
+        r'([+-]?)(?:(\w[\w.]*):)?'
+        r'("(?:[^"]*)"|[\[{][^\]}]*[\]}]|\S+)')
+
+    _RANGE_RE = re.compile(
+        r'^([\[{])\s*(\S+)\s+TO\s+(\S+)\s*([\]}])$')
 
     def _default_fields(self, ctx: SearchContext) -> List[str]:
         fields = [f for f in self.default_fields_param if f != "*"]
@@ -806,8 +821,21 @@ class QueryStringQuery(Query):
             # sub-queries carry boost 1.0 — the wrapping BoolQuery applies
             # self.boost exactly once
             if c["field"]:
-                sub: Query = (MatchPhraseQuery(c["field"], c["text"]) if c["phrase"]
-                              else MatchQuery(c["field"], c["text"]))
+                range_m = self._RANGE_RE.match(c["text"])
+                if range_m and not c["phrase"]:
+                    # Lucene range syntax: [a TO b] inclusive, {a TO b}
+                    # exclusive, * = open bound
+                    open_b, lo, hi, close_b = range_m.groups()
+                    kw = {}
+                    if lo != "*":
+                        kw["gte" if open_b == "[" else "gt"] = lo
+                    if hi != "*":
+                        kw["lte" if close_b == "]" else "lt"] = hi
+                    sub: Query = RangeQuery(c["field"], **kw)
+                else:
+                    sub = (MatchPhraseQuery(c["field"], c["text"])
+                           if c["phrase"]
+                           else MatchQuery(c["field"], c["text"]))
             else:
                 fields = self._default_fields(ctx)
                 subs: List[Query] = [

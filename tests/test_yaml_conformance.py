@@ -107,6 +107,7 @@ MUST_PASS = [
     "mlt/10_basic.yml",
     "msearch/11_status.yml",
     "ping/10_ping.yml",
+    "range/10_basic.yml",
     "search.aggregation/100_avg_metric.yml",
     "search.aggregation/110_max_metric.yml",
     "search.aggregation/120_min_metric.yml",
