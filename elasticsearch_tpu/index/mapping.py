@@ -119,11 +119,8 @@ def _apply_date_math(millis: int, expr: str, round_up: bool = False) -> int:
                     millis = _shift_months(millis, months) - 1
             continue
         n = int(num or 1)
-        if unit in _ROUND_SPAN_MS and unit != "w":
+        if unit in _ROUND_SPAN_MS:
             delta = n * _ROUND_SPAN_MS[unit]
-            millis += delta if op == "+" else -delta
-        elif unit == "w":
-            delta = n * _ROUND_SPAN_MS["w"]
             millis += delta if op == "+" else -delta
         else:  # calendar months/years, day-clamped like the reference
             months = n * (12 if unit == "y" else 1)
@@ -156,6 +153,10 @@ def parse_date_millis(value: Any, round_up: bool = False) -> int:
     if "||" in s:
         base, _, math_expr = s.partition("||")
         return _apply_date_math(parse_date_millis(base), math_expr, round_up)
+    if round_up and re.fullmatch(r"\d{4}-\d{2}-\d{2}", s):
+        # partial date on a gt/lte bound fills missing fields to unit END
+        # (DateMathParser roundUpProperty): "2014-11-18" -> 23:59:59.999
+        return parse_date_millis(s) + 86_400_000 - 1
     if re.fullmatch(r"-?\d{10,}", s):
         return int(s)
     norm = s.replace("Z", "+0000")
@@ -659,15 +660,16 @@ class CompletionFieldMapper(FieldMapper):
 
     type_name = "completion"
 
-    def _inputs(self, value) -> Tuple[List[str], int]:
+    def _inputs(self, value) -> Tuple[List[str], int, dict]:
         if isinstance(value, str):
-            return [value], 1
+            return [value], 1, {}
         if isinstance(value, list):
-            return [str(v) for v in value], 1
+            return [str(v) for v in value], 1, {}
         if isinstance(value, dict):
             inp = value.get("input", [])
             inputs = [inp] if isinstance(inp, str) else [str(v) for v in inp]
-            return inputs, int(value.get("weight", 1))
+            return (inputs, int(value.get("weight", 1)),
+                    value.get("contexts") or {})
         raise MapperParsingError(
             f"[{self.name}] completion value must be string, array or object")
 
@@ -675,8 +677,16 @@ class CompletionFieldMapper(FieldMapper):
         return self._inputs(value)[0]
 
     def doc_value(self, value):
-        inputs, weight = self._inputs(value)
-        return {"input": inputs, "weight": weight}
+        inputs, weight, contexts = self._inputs(value)
+        # context-enabled fields REQUIRE contexts at index time unless the
+        # context resolves from a document path (ContextMappings.addField)
+        defs = self.params.get("contexts") or []
+        needs = [d for d in defs if not d.get("path")]
+        if needs and not contexts:
+            raise MapperParsingError(
+                f"Contexts are mandatory in context enabled completion "
+                f"field [{self.name}]")
+        return {"input": inputs, "weight": weight, "contexts": contexts}
 
 
 class _ShingleAnalyzer:

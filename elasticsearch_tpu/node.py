@@ -661,7 +661,8 @@ class Node:
             merged_suggest: Dict[str, list] = {}
             for svc, reader, _ in readers:
                 ctx = SearchContext(reader, svc.mapper_service)
-                for name, entries in execute_suggest(ctx, suggest_spec).items():
+                for name, entries in execute_suggest(
+                        ctx, suggest_spec, index_name=svc.name).items():
                     if name not in merged_suggest:
                         merged_suggest[name] = entries
                     else:

@@ -15,7 +15,9 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Tuple
 
-from elasticsearch_tpu.common.errors import ParsingError
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError,
+)
 from elasticsearch_tpu.index.mapping import TextFieldMapper
 from elasticsearch_tpu.search.queries import (
     SearchContext, _edit_distance_le, _pattern_terms, _term_postings,
@@ -81,16 +83,142 @@ def phrase_suggest(ctx: SearchContext, text: str, field: str,
     return [{"text": text, "offset": 0, "length": len(text), "options": options}]
 
 
+# geohash level -> approx cell size in meters (GeoUtils.geoHashCellSize)
+_GEOHASH_LEVEL_M = {1: 5_009_400.0, 2: 1_252_300.0, 3: 156_500.0,
+                    4: 39_100.0, 5: 4_890.0, 6: 1_220.0, 7: 153.0,
+                    8: 38.2, 9: 4.77, 10: 1.19, 11: 0.149, 12: 0.037}
+
+
+def _parse_precision_m(precision) -> float:
+    """Geo-context precision: a bare int is a GEOHASH LEVEL (default 6),
+    a string is a distance (GeoContextMapping)."""
+    from elasticsearch_tpu.search.queries_ext import parse_distance
+    if isinstance(precision, int) and not isinstance(precision, bool):
+        return _GEOHASH_LEVEL_M.get(min(max(precision, 1), 12), 1_220.0)
+    try:
+        return parse_distance(precision)
+    except Exception:
+        raise IllegalArgumentError(
+            f"invalid geo context precision [{precision}]")
+
+
+def _contexts_match(ctx, row, entry, ctx_defs, query_contexts) -> bool:
+    """Category: any queried value among the doc's values; geo: within the
+    context's precision radius (CategoryContextMapping/GeoContextMapping)."""
+    from elasticsearch_tpu.search.queries_ext import haversine_m
+    for name, want in query_contexts.items():
+        cdef = next((d for d in ctx_defs if d.get("name") == name), None)
+        if cdef is None:
+            return False
+        have = (entry.get("contexts") or {}).get(name)
+        if have is None and cdef.get("path"):
+            have = ctx.reader.get_doc_value(cdef["path"], int(row))
+            if have is None:  # dynamic text fields store under .keyword
+                have = ctx.reader.get_doc_value(
+                    f"{cdef['path']}.keyword", int(row))
+        if have is None:
+            return False
+        if cdef.get("type") == "geo":
+            specs = want if isinstance(want, list) else [want]
+            point = have
+            if isinstance(point, list) and point and \
+                    isinstance(point[0], (list, tuple)):
+                point = point[0]
+            if isinstance(point, dict):
+                plat, plon = float(point["lat"]), float(point["lon"])
+            elif isinstance(point, (list, tuple)) and len(point) == 2:
+                plat, plon = float(point[0]), float(point[1])
+            else:
+                return False
+            radius = _parse_precision_m(cdef.get("precision", "5km"))
+            ok = False
+            for spec in specs:
+                g = spec.get("context", spec) if isinstance(spec, dict) else {}
+                if not isinstance(g, dict):
+                    continue
+                try:
+                    if haversine_m(plat, plon, float(g["lat"]),
+                                   float(g["lon"])) <= radius:
+                        ok = True
+                        break
+                except (KeyError, TypeError, ValueError):
+                    continue
+            if not ok:
+                return False
+        else:  # category
+            have_vals = have if isinstance(have, list) else [have]
+            want_specs = want if isinstance(want, list) else [want]
+            want_vals = [w.get("context") if isinstance(w, dict) else w
+                         for w in want_specs]
+            if not {str(v) for v in have_vals} & {str(v) for v in want_vals}:
+                return False
+    return True
+
+
 def completion_suggest(ctx: SearchContext, prefix: str, field: str,
-                       size: int = 5) -> List[dict]:
-    terms = _pattern_terms(ctx, field, lambda t: t.startswith(prefix))
-    scored = [(t, _term_freq(ctx, field, t)) for t in terms]
-    scored.sort(key=lambda kv: (-kv[1], kv[0]))
+                       size: int = 5, contexts=None,
+                       index_name: str = "index",
+                       skip_duplicates: bool = False) -> List[dict]:
+    """Doc-based completion: weight-ordered prefix matches over the stored
+    inputs, with category/geo context filtering and full option payloads
+    (CompletionSuggester + TopSuggestDocsCollector)."""
+    from elasticsearch_tpu.index.mapping import CompletionFieldMapper
+    mapper = ctx.mapper_service.get(field)
+    if not isinstance(mapper, CompletionFieldMapper):
+        # prefix scan over any keyword-ish field's terms (the pre-FST
+        # convenience path; real completion fields get weights/contexts)
+        terms = _pattern_terms(ctx, field, lambda t: t.startswith(prefix))
+        scored = [(t, _term_freq(ctx, field, t)) for t in terms]
+        scored.sort(key=lambda kv: (-kv[1], kv[0]))
+        return [{"text": prefix, "offset": 0, "length": len(prefix),
+                 "options": [{"text": t, "_score": float(f)}
+                             for t, f in scored[:size]]}]
+    ctx_defs = list(mapper.params.get("contexts") or [])
+    if ctx_defs and not contexts:
+        raise IllegalArgumentError(
+            "Missing mandatory contexts in context query")
+    plc = str(prefix or "").lower()
+    best_per_doc: Dict[int, Tuple[str, float]] = {}
+    for row in ctx.all_rows():
+        dv = ctx.reader.get_doc_value(field, int(row))
+        if dv is None:
+            continue
+        for entry in (dv if isinstance(dv, list) else [dv]):
+            if not isinstance(entry, dict):
+                continue
+            matched = [i for i in entry.get("input", [])
+                       if str(i).lower().startswith(plc)]
+            if not matched:
+                continue
+            if ctx_defs and contexts and not _contexts_match(
+                    ctx, row, entry, ctx_defs, contexts):
+                continue
+            weight = float(entry.get("weight", 1))
+            prev = best_per_doc.get(int(row))
+            # ONE option per document — the best-weighted suggestion wins
+            # (TopSuggestDocsCollector dedupes by doc)
+            if prev is None or weight > prev[1]:
+                best_per_doc[int(row)] = (str(matched[0]), weight)
+    ranked = sorted(best_per_doc.items(),
+                    key=lambda kv: (-kv[1][1], kv[1][0]))
+    if skip_duplicates:
+        seen, deduped = set(), []
+        for row, (text, weight) in ranked:
+            if text not in seen:
+                seen.add(text)
+                deduped.append((row, (text, weight)))
+        ranked = deduped
+    # materialize _id/_source only for the survivors
+    options = [{"text": text, "_index": index_name,
+                "_id": ctx.reader.get_id(row), "_score": weight,
+                "_source": ctx.reader.get_source(row)}
+               for row, (text, weight) in ranked[:size]]
     return [{"text": prefix, "offset": 0, "length": len(prefix),
-             "options": [{"text": t, "_score": float(f)} for t, f in scored[:size]]}]
+             "options": options}]
 
 
-def execute_suggest(ctx: SearchContext, spec: dict) -> Dict[str, list]:
+def execute_suggest(ctx: SearchContext, spec: dict,
+                    index_name: str = "index") -> Dict[str, list]:
     out = {}
     global_text = spec.get("text")
     for name, body in spec.items():
@@ -108,8 +236,12 @@ def execute_suggest(ctx: SearchContext, spec: dict) -> Dict[str, list]:
                                        size=int(t.get("size", 3)))
         elif "completion" in body:
             t = body["completion"]
-            out[name] = completion_suggest(ctx, body.get("prefix", text),
-                                           t["field"], size=int(t.get("size", 5)))
+            out[name] = completion_suggest(
+                ctx, body.get("prefix", t.get("prefix", text)),
+                t["field"], size=int(t.get("size", 5)),
+                contexts=t.get("contexts"),
+                index_name=index_name,
+                skip_duplicates=bool(t.get("skip_duplicates", False)))
         else:
             raise ParsingError(f"unknown suggester in [{name}]")
     return out

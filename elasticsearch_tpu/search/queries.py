@@ -413,9 +413,15 @@ class RangeQuery(Query):
         self.relation = relation
 
     def _coerce_bound(self, ctx, value, round_up: bool = False):
+        from elasticsearch_tpu.index.mapping import parse_date_nanos
         mapper = ctx.mapper_service.get(self.field)
         if isinstance(mapper, DateNanosFieldMapper):
-            return float(mapper.doc_value(value))
+            if isinstance(value, str) and ("||" in value
+                                           or value.startswith("now")
+                                           or round_up):
+                return float(parse_date_millis(value, round_up=round_up)
+                             * 1_000_000)
+            return float(parse_date_nanos(value))
         if isinstance(mapper, DateFieldMapper):
             # same unit as storage; gt/lte round date math UP to unit end
             # (JavaDateMathParser roundUp semantics)
@@ -441,9 +447,17 @@ class RangeQuery(Query):
                 hi = self._coerce_bound(ctx, self.lte, round_up=True)
             if self.lt is not None:
                 hi, hi_inc = self._coerce_bound(ctx, self.lt), False
-        except (ValueError, TypeError):
-            # non-numeric bounds (e.g. [alice TO bob] on a keyword field):
-            # only the string-doc-values path below applies
+        except (ValueError, TypeError) as e:
+            # on a NUMERIC/date/ip field an unparseable bound is the
+            # caller's error — never silently degrade to string compare
+            mapper = ctx.mapper_service.get(self.field)
+            if isinstance(mapper, (_NumericMapper, DateFieldMapper,
+                                   IpFieldMapper, RangeFieldMapperBase,
+                                   BooleanFieldMapper)):
+                raise IllegalArgumentError(
+                    f"failed to parse range bound on field "
+                    f"[{self.field}]: {e}")
+            # keyword/text/unmapped: the string-doc-values path applies
             numeric_bounds = False
 
         mapper = ctx.mapper_service.get(self.field)

@@ -123,6 +123,7 @@ MUST_PASS = [
     "search/90_search_after.yml",
     "search/issue4895.yml",
     "suggest/10_basic.yml",
+    "suggest/20_completion.yml",
     "update/10_doc.yml",
     "update/11_shard_header.yml",
     "update/13_legacy_doc.yml",
