@@ -205,10 +205,13 @@ class DiskThresholdDecider(AllocationDecider):
         if raw.endswith("%"):
             frac = self._used_fraction(ctx, node_id)
             return frac is not None and frac * 100.0 >= float(raw[:-1])
+        from elasticsearch_tpu.common.settings import parse_byte_size
         try:
-            min_free = int(raw)
-        except ValueError:
-            return False
+            min_free = parse_byte_size(raw, watermark)
+        except Exception:
+            # unparseable watermark must fail safe: treat as exceeded so
+            # the operator notices, rather than silently disabling the gate
+            return True
         return info.get("free_bytes", 0) <= min_free
 
     def can_allocate(self, entry, node_id, ctx):

@@ -835,8 +835,12 @@ class Interpreter:
                 return q
             return left / right
         if op == "%":
-            if isinstance(left, int) and isinstance(right, int):
-                return int(math.fmod(left, right))
+            if isinstance(left, int) and isinstance(right, int) \
+                    and not isinstance(left, bool) and not isinstance(right, bool):
+                # Java long remainder truncates toward zero; keep it in
+                # exact integer arithmetic (fmod loses exactness > 2^53)
+                r = abs(left) % abs(right)
+                return -r if left < 0 else r
             return math.fmod(left, right)
         if op == "==":
             return left == right

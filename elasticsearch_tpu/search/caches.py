@@ -139,7 +139,7 @@ def _iter_range_clauses(query: Optional[dict]):
             yield from _iter_range_clauses(spec.get("filter"))
 
 
-def _to_number(value, mapper_service, field) -> Optional[float]:
+def _to_number(value, mapper_service, field, round_up: bool = False) -> Optional[float]:
     if isinstance(value, bool):
         return None
     if isinstance(value, (int, float)):
@@ -150,7 +150,10 @@ def _to_number(value, mapper_service, field) -> Optional[float]:
         if type_name == "date":
             try:
                 from elasticsearch_tpu.index.mapping import parse_date_millis
-                return float(parse_date_millis(value))
+                # mirror RangeQuery's rounding (queries.py _coerce_bound):
+                # upper bounds round UP to unit end so can_match never
+                # skips a shard the real query would hit
+                return float(parse_date_millis(value, round_up=round_up))
             except Exception:
                 return None
         try:
@@ -174,8 +177,10 @@ def can_match(reader, mapper_service, body: dict) -> bool:
                 return False
             continue
         fmin, fmax = stats
-        gte = _to_number(bounds.get("gte", bounds.get("gt")), mapper_service, field)
-        lte = _to_number(bounds.get("lte", bounds.get("lt")), mapper_service, field)
+        gte = _to_number(bounds.get("gte", bounds.get("gt")), mapper_service, field,
+                         round_up="gte" not in bounds and "gt" in bounds)
+        lte = _to_number(bounds.get("lte", bounds.get("lt")), mapper_service, field,
+                         round_up="lte" in bounds)
         if gte is not None:
             if "gt" in bounds and "gte" not in bounds:
                 if fmax <= gte:

@@ -38,6 +38,8 @@ import hashlib
 import hmac
 import os
 import ssl
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import SearchEngineError
@@ -169,9 +171,10 @@ def _payload_digest(request: Any) -> str:
 
 
 def _mac(key: bytes, sender: str, action: str, user: str,
-         roles: List[str], rid: int, payload_digest: str) -> str:
+         roles: List[str], rid: int, payload_digest: str,
+         ts_ms: int) -> str:
     msg = "\x00".join([sender, action, user, ",".join(sorted(roles)),
-                       str(rid), payload_digest])
+                       str(rid), payload_digest, str(ts_ms)])
     return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).hexdigest()
 
 
@@ -183,6 +186,12 @@ class TransportAuth:
     system context (`_system`, the reference's SystemUser for internal
     actions); REST-layer code can push the authenticated end-user instead."""
 
+    # envelopes older than this are rejected even with a valid MAC; the
+    # replay window below keeps every (sender, rid, mac) seen within it,
+    # so a captured envelope cannot be re-executed in the TLS-at-sidecar
+    # (auth-only) deployment
+    MAX_SKEW_MS = 120_000
+
     def __init__(self, key: bytes, node_user: str = "_system",
                  node_roles: Optional[List[str]] = None):
         if not key:
@@ -190,15 +199,18 @@ class TransportAuth:
         self.key = key
         self.node_user = node_user
         self.node_roles = list(node_roles or ["_internal"])
+        self._seen: Dict[str, int] = {}  # mac -> ts_ms within the window
+        self._seen_lock = threading.Lock()
 
     def outbound_context(self, sender: str, action: str, rid: int = 0,
                          request: Any = None) -> dict:
         auth = current_auth.get()
         user = (auth or {}).get("user", self.node_user)
         roles = (auth or {}).get("roles", self.node_roles)
-        return {"user": user, "roles": list(roles),
+        ts_ms = int(time.time() * 1000)
+        return {"user": user, "roles": list(roles), "ts": ts_ms,
                 "mac": _mac(self.key, sender, action, user, list(roles),
-                            rid, _payload_digest(request))}
+                            rid, _payload_digest(request), ts_ms)}
 
     def validate(self, sender: str, action: str, ctx: Any, rid: int = 0,
                  request: Any = None) -> dict:
@@ -208,11 +220,27 @@ class TransportAuth:
                 f"context")
         user = str(ctx.get("user", ""))
         roles = [str(r) for r in ctx.get("roles", [])]
+        ts_ms = int(ctx.get("ts", 0))
         expected = _mac(self.key, sender, action, user, roles, rid,
-                        _payload_digest(request))
+                        _payload_digest(request), ts_ms)
         if not hmac.compare_digest(expected, str(ctx.get("mac", ""))):
             raise TransportAuthError(
                 f"[{action}] from [{sender}] failed authentication")
+        now_ms = int(time.time() * 1000)
+        if abs(now_ms - ts_ms) > self.MAX_SKEW_MS:
+            raise TransportAuthError(
+                f"[{action}] from [{sender}] rejected: stale envelope "
+                f"(ts skew {abs(now_ms - ts_ms)}ms)")
+        with self._seen_lock:
+            if expected in self._seen:
+                raise TransportAuthError(
+                    f"[{action}] from [{sender}] rejected: replayed "
+                    f"envelope")
+            self._seen[expected] = ts_ms
+            if len(self._seen) > 8192:
+                cutoff = now_ms - self.MAX_SKEW_MS
+                self._seen = {m: t for m, t in self._seen.items()
+                              if t >= cutoff}
         return {"user": user, "roles": roles}
 
 
