@@ -39,6 +39,7 @@ import itertools
 from typing import Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster.state import ClusterState, ShardRoutingEntry
+from elasticsearch_tpu.common.errors import IllegalArgumentError
 
 _alloc_counter = itertools.count()
 
@@ -197,22 +198,41 @@ class DiskThresholdDecider(AllocationDecider):
             return None
         return 1.0 - info.get("free_bytes", 0) / info["total_bytes"]
 
+    @staticmethod
+    def parse_watermark(raw: str, setting: str = ""):
+        """("ratio", used_fraction) for "85%" / "0.85", ("bytes", min_free)
+        for "10gb" (reference: DiskThresholdSettings / RatioValue)."""
+        s = str(raw).strip()
+        if s.endswith("%"):
+            return ("ratio", float(s[:-1]) / 100.0)
+        try:
+            f = float(s)
+        except ValueError:
+            f = None
+        if f is not None:
+            if 0.0 <= f <= 1.0:
+                return ("ratio", f)
+            raise IllegalArgumentError(
+                f"unable to parse [{setting}={raw}]: ratio must be in "
+                f"[0.0, 1.0] or a percentage/byte size")
+        from elasticsearch_tpu.common.settings import parse_byte_size
+        return ("bytes", parse_byte_size(s, setting))
+
     def _exceeds(self, ctx, node_id, watermark: str, default: str) -> bool:
         raw = str(ctx.setting(watermark, default))
         info = ctx.cluster_info.get(node_id)
         if info is None:
             return False
-        if raw.endswith("%"):
-            frac = self._used_fraction(ctx, node_id)
-            return frac is not None and frac * 100.0 >= float(raw[:-1])
-        from elasticsearch_tpu.common.settings import parse_byte_size
         try:
-            min_free = parse_byte_size(raw, watermark)
+            kind, value = self.parse_watermark(raw, watermark)
         except Exception:
-            # unparseable watermark must fail safe: treat as exceeded so
-            # the operator notices, rather than silently disabling the gate
-            return True
-        return info.get("free_bytes", 0) <= min_free
+            # an unparseable operator value must not melt the cluster or
+            # silently disable protection: fall back to the default gate
+            kind, value = self.parse_watermark(default, watermark)
+        if kind == "ratio":
+            frac = self._used_fraction(ctx, node_id)
+            return frac is not None and frac >= value
+        return info.get("free_bytes", 0) <= value
 
     def can_allocate(self, entry, node_id, ctx):
         if self._exceeds(ctx, node_id,

@@ -18,9 +18,9 @@ delegate to the cluster layer:
   with streaming reduce and partial-agg merging (`client_search`)
 - index create/delete/refresh and cluster settings go through the master
 
-Node-local registries (ingest pipelines, templates, stored scripts) apply
-on the node that serves the request — distributing those registries
-through cluster state is the remaining gap, tracked in COMPONENTS.md.
+Registries (ingest pipelines, templates, stored scripts) replicate
+through cluster state (`_wire_replicated_registries`), so a PUT on any
+node is visible cluster-wide after publication.
 """
 
 from __future__ import annotations

@@ -40,6 +40,7 @@ import os
 import ssl
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import SearchEngineError
@@ -200,6 +201,7 @@ class TransportAuth:
         self.node_user = node_user
         self.node_roles = list(node_roles or ["_internal"])
         self._seen: Dict[str, int] = {}  # mac -> ts_ms within the window
+        self._seen_order: deque = deque()  # (ts_ms, mac) FIFO for pruning
         self._seen_lock = threading.Lock()
 
     def outbound_context(self, sender: str, action: str, rid: int = 0,
@@ -237,10 +239,12 @@ class TransportAuth:
                     f"[{action}] from [{sender}] rejected: replayed "
                     f"envelope")
             self._seen[expected] = ts_ms
-            if len(self._seen) > 8192:
-                cutoff = now_ms - self.MAX_SKEW_MS
-                self._seen = {m: t for m, t in self._seen.items()
-                              if t >= cutoff}
+            self._seen_order.append((ts_ms, expected))
+            # amortized O(1): only expired entries pop off the front
+            cutoff = now_ms - self.MAX_SKEW_MS
+            while self._seen_order and self._seen_order[0][0] < cutoff:
+                _, old_mac = self._seen_order.popleft()
+                self._seen.pop(old_mac, None)
         return {"user": user, "roles": roles}
 
 
