@@ -209,12 +209,10 @@ class DiskThresholdDecider(AllocationDecider):
             f = float(s)
         except ValueError:
             f = None
-        if f is not None:
-            if 0.0 <= f <= 1.0:
-                return ("ratio", f)
-            raise IllegalArgumentError(
-                f"unable to parse [{setting}={raw}]: ratio must be in "
-                f"[0.0, 1.0] or a percentage/byte size")
+        if f is not None and 0.0 <= f <= 1.0 and not s.isdigit():
+            # a bare fraction like "0.85"; bare integers ("0", "1",
+            # "10737418240") keep their historical byte-count meaning
+            return ("ratio", f)
         from elasticsearch_tpu.common.settings import parse_byte_size
         return ("bytes", parse_byte_size(s, setting))
 

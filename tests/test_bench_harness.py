@@ -1,0 +1,108 @@
+"""The bench.py watchdog: a hanging or unavailable backend must produce a
+bounded-time diagnostic JSON line, never a stack trace or an indefinite hang
+(round 2's official capture was lost to exactly that failure mode)."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_probe_kills_hung_backend_within_timeout():
+    bench = _load_bench()
+    bench._PROBE_CODE = "import time; time.sleep(60)"
+    ok, info = bench._probe_backend(timeout_s=2)
+    assert not ok
+    assert "hung" in info
+
+
+def test_probe_reports_backend_error_tail():
+    bench = _load_bench()
+    bench._PROBE_CODE = (
+        "raise RuntimeError(\"Unable to initialize backend 'axon': "
+        "UNAVAILABLE\")")
+    ok, info = bench._probe_backend(timeout_s=30)
+    assert not ok
+    assert "UNAVAILABLE" in info
+
+
+def test_unavailable_backend_emits_diagnostic_json(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (False, "UNAVAILABLE"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    import pytest
+    with pytest.raises(SystemExit) as e, _capture_stdout() as buf:
+        bench.main()
+    assert e.value.code == 1
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["error"] == "tpu_backend_unavailable"
+    assert out["metric"] == bench.METRIC
+    assert "last_known_good" in out
+
+
+def test_child_crash_emits_diagnostic_json(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (True, "tpu"))
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda timeout_s: (1, "", "boom"))
+    import pytest
+    with pytest.raises(SystemExit) as e, _capture_stdout() as buf:
+        bench.main()
+    assert e.value.code == 1
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["error"] == "bench_child_failed"
+
+
+def test_timed_out_child_with_valid_result_counts_as_success(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (True, "tpu"))
+    payload = json.dumps({"metric": bench.METRIC, "value": 12345.0})
+    # rc=-1 models the watchdog killing a child that hung in teardown
+    # after printing its measurement
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda timeout_s: (-1, payload + "\n", "hung"))
+    import pytest
+    with pytest.raises(SystemExit) as e, _capture_stdout() as buf:
+        bench.main()
+    assert e.value.code == 0
+    assert json.loads(buf.getvalue().strip().splitlines()[-1])["value"] == 12345.0
+
+
+def test_child_json_line_is_forwarded(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (True, "tpu"))
+    payload = json.dumps({"metric": bench.METRIC, "value": 99.0})
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda timeout_s: (0, f"warning noise\n{payload}\n", ""))
+    import pytest
+    with pytest.raises(SystemExit) as e, _capture_stdout() as buf:
+        bench.main()
+    assert e.value.code == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["value"] == 99.0
+
+
+class _capture_stdout:
+    def __enter__(self):
+        import io
+        self._old = sys.stdout
+        sys.stdout = buf = io.StringIO()
+        return buf
+
+    def __exit__(self, *exc):
+        sys.stdout = self._old
+        return False
