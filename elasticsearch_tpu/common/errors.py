@@ -62,6 +62,18 @@ class ValidationError(SearchEngineError):
     status = 400
 
 
+class ActionRequestValidationError(SearchEngineError):
+    """Aggregated request validation failures (reference:
+    ActionRequestValidationException — "Validation Failed: 1: ...;")."""
+    status = 400
+
+    @classmethod
+    def of(cls, failures) -> "ActionRequestValidationError":
+        msg = "Validation Failed: " + " ".join(
+            f"{i + 1}: {m};" for i, m in enumerate(failures))
+        return cls(msg)
+
+
 class ResourceNotFoundError(SearchEngineError):
     status = 404
 

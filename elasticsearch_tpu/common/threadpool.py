@@ -133,6 +133,9 @@ def _default_pools() -> Dict[str, tuple]:
         "flush": (half, _UNBOUNDED, "scaling"),
         "refresh": (half, _UNBOUNDED, "scaling"),
         "snapshot": (half, _UNBOUNDED, "scaling"),
+        "fetch_shard_started": (2 * c, _UNBOUNDED, "scaling"),
+        "fetch_shard_store": (2 * c, _UNBOUNDED, "scaling"),
+        "listener": (half, _UNBOUNDED, "scaling"),
     }
 
 

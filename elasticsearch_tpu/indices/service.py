@@ -90,6 +90,12 @@ class IndexService:
                 translog_sync=sync, vector_dtype=vec_dtype))
         self.aliases: Dict[str, dict] = {}
 
+    @property
+    def hidden(self) -> bool:
+        """index.hidden: excluded from wildcard expansion by default
+        (reference: IndexMetaData.INDEX_HIDDEN_SETTING, 7.7+)."""
+        return str(self.settings.get("index.hidden", "false")) in ("true", "True")
+
     def settings_update(self, updates: Dict[str, Any]) -> None:
         """Apply dynamic index-setting updates (reference:
         MetaDataUpdateSettingsService — dynamic settings only; static ones
@@ -220,6 +226,8 @@ class IndicesService:
         """POST /{index}/_close: reads/writes rejected until reopened
         (MetaDataIndexStateService.closeIndices)."""
         svc = self.get(name)
+        svc.flush()  # closing commits everything (the reopened index
+        # then recovers from its own files: existing_store)
         svc.closed = True
         self._persist_meta(svc)
 
@@ -244,11 +252,25 @@ class IndicesService:
         flat.put("index.number_of_shards", 1)
         flat.put("index.number_of_replicas", 1)
         if settings:
-            flat.put_dict(settings if "index" in settings or any(
-                k.startswith("index.") for k in settings) else {"index": settings})
+            # normalize every key under the index. namespace — bodies mix
+            # bare keys with a nested "index" object freely
+            norm = {}
+            for k, v in settings.items():
+                if k == "index" and isinstance(v, dict):
+                    norm.setdefault("index", {}).update(v)
+                elif k.startswith("index."):
+                    norm[k] = v
+                else:
+                    norm.setdefault("index", {})[k] = v
+            flat.put_dict(norm)
         s = flat.build()
         self._uuid_counter += 1
-        uuid = f"{name}-{self._uuid_counter:08x}"
+        # 22-char base64 uuid (reference: UUIDs.base64UUID via
+        # TimeBasedUUIDGenerator; the _cat suites pin the 22-char shape)
+        import base64
+        uuid = base64.b64encode(
+            os.urandom(4) + self._uuid_counter.to_bytes(4, "big")
+            + os.urandom(8)).decode()[:22]
         svc = IndexService(name, os.path.join(self.data_path, name), s,
                            mappings, uuid)
         if aliases:
@@ -265,12 +287,19 @@ class IndicesService:
         shutil.rmtree(svc.path, ignore_errors=True)
 
     def get(self, name: str) -> IndexService:
+        """Resolve a concrete index or single-index alias for a
+        single-document op; a multi-index alias is an error (reference:
+        IndexNameExpressionResolver.concreteSingleIndex)."""
         svc = self.indices.get(name)
         if svc is None:
-            # alias resolution
-            for s in self.indices.values():
-                if name in s.aliases:
-                    return s
+            matches = [s for s in self.indices.values() if name in s.aliases]
+            if len(matches) > 1:
+                names = ", ".join(sorted(s.name for s in matches))
+                raise IllegalArgumentError(
+                    f"Alias [{name}] has more than one indices associated "
+                    f"with it [[{names}]], can't execute a single index op")
+            if matches:
+                return matches[0]
             raise IndexNotFoundError(name)
         return svc
 
@@ -279,30 +308,61 @@ class IndicesService:
             return True
         return any(name in s.aliases for s in self.indices.values())
 
-    def resolve(self, expression: Optional[str]) -> List[IndexService]:
+    def resolve(self, expression: Optional[str],
+                expand_hidden: bool = False) -> List[IndexService]:
         """Resolve a comma/wildcard index expression (reference:
-        IndexNameExpressionResolver)."""
+        IndexNameExpressionResolver). Hidden indices are excluded from
+        wildcard expansion unless `expand_hidden` (expand_wildcards=all/
+        hidden) or both the pattern and the index name are dot-prefixed."""
         if expression in (None, "", "_all", "*"):
             # wildcard/_all expansion targets OPEN indices
             # (IndicesOptions.expandWildcardsOpen default)
-            return [s for s in self.indices.values() if not s.closed]
+            return [s for s in self.indices.values()
+                    if not s.closed and (expand_hidden or not s.hidden)]
         out = []
         seen = set()
         for part in expression.split(","):
             part = part.strip()
             if "*" in part:
                 pat = re.compile("^" + part.replace(".", r"\.").replace("*", ".*") + "$")
+                dotted = part.startswith(".")
+
+                def visible(s, n):
+                    return (expand_hidden or not s.hidden
+                            or (dotted and n.startswith(".")))
                 matched = [s for n, s in self.indices.items()
-                           if pat.match(n) and not s.closed]
+                           if pat.match(n) and not s.closed and visible(s, n)]
                 for s in self.indices.values():
-                    if not s.closed and any(pat.match(a) for a in s.aliases):
-                        matched.append(s)
+                    if s.closed:
+                        continue
+                    for a, opts in s.aliases.items():
+                        # an alias is hidden only when itself declared
+                        # is_hidden (not because its index is hidden)
+                        a_visible = (expand_hidden
+                                     or not (opts or {}).get("is_hidden")
+                                     or (dotted and a.startswith(".")))
+                        if pat.match(a) and a_visible:
+                            matched.append(s)
+                            break
                 for m in matched:
                     if m.name not in seen:
                         seen.add(m.name)
                         out.append(m)
             else:
-                svc = self.get(part)
+                svc = self.indices.get(part)
+                if svc is None:
+                    # a multi-target expression expands an alias to ALL its
+                    # indices (the single-index-op restriction in get()
+                    # applies only to doc-level ops)
+                    matches = [s for s in self.indices.values()
+                               if part in s.aliases]
+                    if not matches:
+                        raise IndexNotFoundError(part)
+                    for m in matches:
+                        if m.name not in seen:
+                            seen.add(m.name)
+                            out.append(m)
+                    continue
                 if svc.name not in seen:
                     seen.add(svc.name)
                     out.append(svc)

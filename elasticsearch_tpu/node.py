@@ -88,6 +88,7 @@ class Node:
         self.node_id = _uuid.uuid4().hex[:20]
         self.node_name = node_name
         self.cluster_name = cluster_name
+        self.data_path = data_path
         self.indices = IndicesService(data_path)
         self.ingest = IngestService()
         self.scrolls = ScrollService()
@@ -290,41 +291,107 @@ class Node:
         out["result"] = "updated"
         return out
 
-    def mget(self, body: dict, default_index: Optional[str] = None) -> dict:
-        from elasticsearch_tpu.search.service import _filter_source
-        docs = []
-        for spec in body.get("docs", []):
+    def mget(self, body: dict, default_index: Optional[str] = None,
+             stored_fields=None, realtime: bool = True,
+             refresh: bool = False, source_filter=None) -> dict:
+        """_mget (reference: TransportMultiGetAction / MultiGetRequest).
+
+        Validation aggregates per-item failures into one
+        action_request_validation_exception; a missing index or document
+        yields {found: false}, while a multi-index alias yields a per-doc
+        error with root_cause (`MultiGetRequest.java` add() validation +
+        TransportMultiGetAction per-item failure handling)."""
+        from elasticsearch_tpu.common.errors import (
+            ActionRequestValidationError, IllegalArgumentError,
+            IndexNotFoundError)
+        body = body or {}
+        items: List[dict] = []
+        verrs: List[str] = []
+        for spec in body.get("docs") or []:
             index = spec.get("_index", default_index)
+            if not index:
+                verrs.append("index is missing")
+            if "_id" not in spec:
+                verrs.append("id is missing")
+            if index and "_id" in spec:
+                items.append({**spec, "_index": index})
+        for doc_id in body.get("ids") or []:
+            if not default_index:
+                verrs.append("index is missing")
+            else:
+                items.append({"_index": default_index, "_id": doc_id})
+        if not items and not verrs:
+            verrs.append("no documents to get")
+        if verrs:
+            raise ActionRequestValidationError.of(verrs)
+
+        docs = []
+        refreshed = set()
+        for spec in items:
+            index = spec["_index"]
+            doc_id = str(spec["_id"])
+            routing = spec.get("routing")
+            routing = str(routing) if routing is not None else None
             try:
-                doc = self.get_doc(index, spec["_id"],
-                                   routing=spec.get("routing"))
-                # per-doc _source filtering (MultiGetRequest.Item)
-                src_spec = spec.get("_source")
-                if src_spec is False:
-                    doc.pop("_source", None)
-                elif isinstance(src_spec, (list, str)):
-                    inc = [src_spec] if isinstance(src_spec, str) else src_spec
-                    if doc.get("_source") is not None:
-                        doc["_source"] = _filter_source(doc["_source"], inc, [])
-                elif isinstance(src_spec, dict):
-                    inc = src_spec.get("include", src_spec.get("includes", [])) or []
-                    exc = src_spec.get("exclude", src_spec.get("excludes", [])) or []
-                    inc = [inc] if isinstance(inc, str) else inc
-                    exc = [exc] if isinstance(exc, str) else exc
-                    if doc.get("_source") is not None:
-                        doc["_source"] = _filter_source(doc["_source"], inc, exc)
-                docs.append(doc)
+                if refresh and index not in refreshed:
+                    self.indices.get(index).refresh()
+                    refreshed.add(index)
+                doc = self.get_doc(index, doc_id, routing=routing,
+                                   realtime=realtime)
+            except IndexNotFoundError:
+                docs.append({"_index": index, "_id": doc_id, "found": False})
+                continue
+            except IllegalArgumentError as e:
+                docs.append({"_index": index, "_id": doc_id,
+                             "error": e.to_wrapped_dict()})
+                continue
             except SearchEngineError as e:
-                docs.append({"_index": index, "_id": spec.get("_id"),
+                docs.append({"_index": index, "_id": doc_id,
                              "error": e.to_dict()})
-        if "ids" in body and default_index:
-            for doc_id in body["ids"]:
-                try:
-                    docs.append(self.get_doc(default_index, doc_id))
-                except SearchEngineError as e:
-                    docs.append({"_index": default_index, "_id": doc_id,
-                                 "error": e.to_dict()})
+                continue
+            self._apply_mget_projection(doc, spec, stored_fields, index,
+                                        source_filter)
+            docs.append(doc)
         return {"docs": docs}
+
+    def _apply_mget_projection(self, doc: dict, spec: dict, req_stored_fields,
+                               index: str, req_source=None) -> None:
+        """stored_fields + per-doc _source filtering on a fetched doc."""
+        from elasticsearch_tpu.search.service import _filter_source, _get_path
+        if "_source" not in spec and req_source is not None:
+            spec = {**spec, "_source": req_source}
+        sf = spec.get("stored_fields", req_stored_fields)
+        if sf:
+            sf = [sf] if isinstance(sf, str) else list(sf)
+            svc = self.indices.get(index)
+            fields = {}
+            for fname in sf:
+                if fname.startswith("_"):
+                    continue  # metadata fields ride at the top level
+                mapper = svc.mapper_service.get(fname)
+                if mapper is None or not mapper.params.get("store"):
+                    continue
+                val = _get_path(doc.get("_source") or {}, fname)
+                if val is not None:
+                    fields[fname] = val if isinstance(val, list) else [val]
+            if fields:
+                doc["fields"] = fields
+            if "_source" not in sf:
+                doc.pop("_source", None)
+        src_spec = spec.get("_source")
+        if src_spec is False:
+            doc.pop("_source", None)
+        elif isinstance(src_spec, (list, str)):
+            inc = [src_spec] if isinstance(src_spec, str) else src_spec
+            if doc.get("_source") is not None:
+                doc["_source"] = _filter_source(doc["_source"], inc, [])
+        elif isinstance(src_spec, dict):
+            inc = src_spec.get("include", src_spec.get("includes", [])) or []
+            exc = src_spec.get("exclude", src_spec.get("excludes", [])) or []
+            inc = [inc] if isinstance(inc, str) else inc
+            exc = [exc] if isinstance(exc, str) else exc
+            if doc.get("_source") is not None:
+                doc["_source"] = _filter_source(doc["_source"], inc, exc)
 
     def bulk(self, operations: List[dict], default_index: Optional[str] = None,
              refresh: Optional[str] = None) -> dict:
@@ -831,18 +898,26 @@ class Node:
         return {"tokens": tokens}
 
     # ----------------------------------------------------------------- stats
-    def cluster_health(self) -> dict:
-        n = len(self.indices.indices)
-        shards = sum(s.num_shards for s in self.indices.indices.values())
+    def cluster_health(self, index: Optional[str] = None) -> dict:
+        """Single-node health: replicas can never assign, so a replicated
+        index makes the cluster yellow (ClusterStateHealth semantics)."""
+        services = (self.indices.resolve(index, expand_hidden=True)
+                    if index else
+                    [s for s in self.indices.indices.values() if not s.closed])
+        shards = sum(s.num_shards for s in services)
+        unassigned = sum(s.num_shards * s.num_replicas for s in services)
+        total = shards + unassigned
         return {
-            "cluster_name": self.cluster_name, "status": "green",
+            "cluster_name": self.cluster_name,
+            "status": "yellow" if unassigned else "green",
             "timed_out": False, "number_of_nodes": 1,
             "number_of_data_nodes": 1, "active_primary_shards": shards,
             "active_shards": shards, "relocating_shards": 0,
-            "initializing_shards": 0, "unassigned_shards": 0,
+            "initializing_shards": 0, "unassigned_shards": unassigned,
             "delayed_unassigned_shards": 0, "number_of_pending_tasks": 0,
             "number_of_in_flight_fetch": 0, "task_max_waiting_in_queue_millis": 0,
-            "active_shards_percent_as_number": 100.0,
+            "active_shards_percent_as_number":
+                (shards / total * 100.0) if total else 100.0,
         }
 
     _STATS_METRICS = ("docs", "store", "indexing", "get", "search", "merge",

@@ -419,27 +419,89 @@ def _scan_all(node, index_expr: str, query: dict) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 _AGGREGATABLE = {"keyword", "long", "integer", "short", "byte", "double", "float",
-                 "half_float", "scaled_float", "date", "boolean", "ip"}
+                 "half_float", "scaled_float", "date", "date_nanos", "boolean",
+                 "ip", "geo_point", "unsigned_long", "version", "murmur3",
+                 "token_count", "constant_keyword", "wildcard", "flattened",
+                 "integer_range", "long_range", "float_range", "double_range",
+                 "date_range", "ip_range", "histogram", "aggregate_metric_double"}
 
 
-def field_caps(node, index_expr: Optional[str], fields: str) -> dict:
+def _index_field_caps(ms) -> Dict[str, tuple]:
+    """path -> (type, searchable, aggregatable, meta) for one index,
+    including synthesized object entries for un-mapped ancestor paths
+    (reference: FieldCapabilitiesFetcher walks object mappers too)."""
+    caps: Dict[str, tuple] = {}
+    for path, mapper in ms.all_mappers():
+        t = mapper.type_name
+        p = mapper.params
+        if t == "nested":
+            caps[path] = ("nested", False, False, None)
+            continue
+        searchable = p.get("index", True) not in (False, "false")
+        aggregatable = (t in _AGGREGATABLE
+                        and p.get("doc_values", True) not in (False, "false"))
+        caps[path] = (t, searchable, aggregatable, p.get("meta"))
+    for path in list(caps):
+        parts = path.split(".")
+        for i in range(1, len(parts)):
+            anc = ".".join(parts[:i])
+            if anc not in caps:
+                caps[anc] = ("object", False, False, None)
+    return caps
+
+
+def field_caps(node, index_expr: Optional[str], fields: str,
+               include_unmapped: bool = False) -> dict:
+    """_field_caps (reference: TransportFieldCapabilitiesAction +
+    FieldCapabilities.Builder merge rules): per (field, type) bucket,
+    searchable/aggregatable AND across indices, `indices` listed only when
+    the field has >1 type bucket, non_searchable/-aggregatable indices
+    listed only when mixed, `meta` values unioned into sorted lists."""
     patterns = [f.strip() for f in (fields or "*").split(",")]
-    out: Dict[str, dict] = {}
     indices = node.indices.resolve(index_expr)
+    index_names = [s.name for s in indices]
+    # field -> type -> list of (index, searchable, aggregatable, meta)
+    percap: Dict[str, Dict[str, list]] = {}
     for svc in indices:
-        for path in svc.mapper_service.field_names():
+        for path, (t, se, ag, meta) in _index_field_caps(
+                svc.mapper_service).items():
             if not any(fnmatch.fnmatch(path, p) for p in patterns):
                 continue
-            mapper = svc.mapper_service.get(path)
-            t = mapper.type_name
-            if t in ("object", "nested"):
-                continue
-            entry = out.setdefault(path, {}).setdefault(t, {
+            percap.setdefault(path, {}).setdefault(t, []).append(
+                (svc.name, se, ag, meta))
+    out: Dict[str, dict] = {}
+    for field, types in sorted(percap.items()):
+        mapped_in = {i for rows in types.values() for (i, _, _, _) in rows}
+        buckets = dict(types)
+        if include_unmapped and len(mapped_in) < len(index_names):
+            buckets["unmapped"] = [(i, False, False, None)
+                                   for i in index_names if i not in mapped_in]
+        multi_typed = len(buckets) > 1
+        rendered = {}
+        for t, rows in buckets.items():
+            entry = {
                 "type": t, "metadata_field": False,
-                "searchable": True,
-                "aggregatable": t in _AGGREGATABLE,
-            })
-    return {"indices": [s.name for s in indices], "fields": out}
+                "searchable": all(se for (_, se, _, _) in rows),
+                "aggregatable": all(ag for (_, _, ag, _) in rows),
+            }
+            if multi_typed:
+                entry["indices"] = sorted(i for (i, _, _, _) in rows)
+            non_se = sorted(i for (i, se, _, _) in rows if not se)
+            if non_se and len(non_se) < len(rows):
+                entry["non_searchable_indices"] = non_se
+            non_ag = sorted(i for (i, _, ag, _) in rows if not ag)
+            if non_ag and len(non_ag) < len(rows):
+                entry["non_aggregatable_indices"] = non_ag
+            merged_meta: Dict[str, set] = {}
+            for (_, _, _, meta) in rows:
+                for k, v in (meta or {}).items():
+                    vals = v if isinstance(v, list) else [v]
+                    merged_meta.setdefault(k, set()).update(map(str, vals))
+            if merged_meta:
+                entry["meta"] = {k: sorted(v) for k, v in merged_meta.items()}
+            rendered[t] = entry
+        out[field] = rendered
+    return {"indices": index_names, "fields": out}
 
 
 def validate_query(node, index_expr: Optional[str], body: dict) -> dict:

@@ -22,19 +22,9 @@ from elasticsearch_tpu.version import __version__
 
 
 def _cat_table(req, headers, rows) -> Tuple[int, Any]:
-    """Shared _cat formatter: text columns padded to width, `v` header row,
-    `format=json` list-of-objects (reference `rest/action/cat/RestTable`)."""
-    if req.param("format") == "json":
-        return 200, [dict(zip(headers, r)) for r in rows]
-    verbose = req.bool_param("v")
-    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
-              for i, h in enumerate(headers)]
-    lines = []
-    if verbose:
-        lines.append(" ".join(str(h).ljust(w) for h, w in zip(headers, widths)))
-    for r in rows:
-        lines.append(" ".join(str(c).ljust(w) for c, w in zip(r, widths)))
-    return 200, "\n".join(lines) + "\n"
+    """Legacy shim over rest/cat.py's RestTable renderer."""
+    from elasticsearch_tpu.rest.cat import Col, render
+    return render(req, [Col(h) for h in headers], rows)
 
 
 def register_all(rc: RestController, node: Node) -> None:
@@ -218,7 +208,25 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_bulk", bulk)
 
     def mget(req):
-        return 200, node.mget(req.json() or {}, req.params.get("index"))
+        sf = req.param("stored_fields")
+        src = req.param("_source")
+        inc, exc = req.param("_source_includes"), req.param("_source_excludes")
+        source_filter = None
+        if src == "false":
+            source_filter = False
+        elif src == "true":
+            source_filter = True
+        elif src:
+            source_filter = src.split(",")
+        if inc or exc:
+            source_filter = {"includes": inc.split(",") if inc else [],
+                             "excludes": exc.split(",") if exc else []}
+        return 200, node.mget(
+            req.json() or {}, req.params.get("index"),
+            stored_fields=sf.split(",") if sf else None,
+            realtime=req.param("realtime") not in ("false", False),
+            refresh=req.param("refresh") in ("true", "", True),
+            source_filter=source_filter)
 
     rc.register("GET", "/_mget", mget)
     rc.register("POST", "/_mget", mget)
@@ -413,8 +421,41 @@ def register_all(rc: RestController, node: Node) -> None:
             node.indices.update_mapping(svc.name, body)
         return 200, {"acknowledged": True}
 
+    def get_field_mapping(req):
+        """GET [/{index}]/_mapping/field/{fields} (reference:
+        RestGetFieldMappingAction / TransportGetFieldMappingsAction):
+        per-index {mappings: {full_name: {full_name, mapping: {leaf: def}}}};
+        unknown fields yield an empty mappings object."""
+        import fnmatch
+        fields = [f.strip() for f in req.params["fields"].split(",")]
+        include_defaults = req.param("include_defaults") in ("true", "", True)
+        out = {}
+        for svc in node.indices.resolve(req.params.get("index")):
+            ms = svc.mapper_service
+            matched = {}
+            for pat in fields:
+                if "*" in pat:
+                    names = [n for n in ms.field_names()
+                             if fnmatch.fnmatchcase(n, pat)]
+                else:
+                    names = [pat] if ms.get_raw(pat) is not None else []
+                for full in names:
+                    mapper = ms.get_raw(full)
+                    if mapper is None or mapper.type_name == "nested":
+                        continue
+                    d = mapper.to_def()
+                    if include_defaults and d.get("type") == "text" \
+                            and "analyzer" not in d:
+                        d["analyzer"] = "default"
+                    leaf = full.rsplit(".", 1)[-1]
+                    matched[full] = {"full_name": full, "mapping": {leaf: d}}
+            out[svc.name] = {"mappings": matched}
+        return 200, out
+
     rc.register("GET", "/_mapping", get_mapping)
     rc.register("GET", "/{index}/_mapping", get_mapping)
+    rc.register("GET", "/_mapping/field/{fields}", get_field_mapping)
+    rc.register("GET", "/{index}/_mapping/field/{fields}", get_field_mapping)
     rc.register("PUT", "/{index}/_mapping", put_mapping)
     rc.register("POST", "/{index}/_mapping", put_mapping)
 
@@ -559,7 +600,7 @@ def register_all(rc: RestController, node: Node) -> None:
         body = req.json() or {}
         spec = {k: v for k, v in body.items()
                 if k in ("filter", "routing", "index_routing",
-                         "search_routing", "is_write_index")}
+                         "search_routing", "is_write_index", "is_hidden")}
         targets = node.indices.resolve(req.params["index"])
         if not targets:
             raise IndexNotFoundError(req.params["index"])
@@ -601,7 +642,16 @@ def register_all(rc: RestController, node: Node) -> None:
 
     # ---------------------------------------------------------------- cluster
     def cluster_health(req):
-        return 200, node.cluster_health()
+        # wait_for_status resolves immediately: single-node state is
+        # deterministic, so the target is either already met or never will
+        # be within the request (reference waits on a state observer)
+        out = node.cluster_health(req.params.get("index"))
+        want = req.param("wait_for_status")
+        order = {"green": 0, "yellow": 1, "red": 2}
+        if want and order.get(out["status"], 2) > order.get(want, 0):
+            out["timed_out"] = True
+            return 408, out
+        return 200, out
 
     def cluster_stats(req):
         total_docs = sum(s.doc_count() for s in node.indices.indices.values())
@@ -722,6 +772,7 @@ def register_all(rc: RestController, node: Node) -> None:
                          "thread_pool": node.thread_pool.stats()}}}
 
     rc.register("GET", "/_cluster/health", cluster_health)
+    rc.register("GET", "/_cluster/health/{index}", cluster_health)
     rc.register("GET", "/_cluster/stats", cluster_stats)
     rc.register("GET", "/_cluster/state", cluster_state)
     rc.register("GET", "/_cluster/state/{metric}", cluster_state)
@@ -730,47 +781,339 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_nodes/stats", nodes_stats)
 
     # -------------------------------------------------------------------- cat
+    # (reference: rest/action/cat/Rest*Action column catalogs + RestTable)
+    from elasticsearch_tpu.rest.cat import (
+        Bytes, Col, Millis, dir_size, render as cat_render,
+    )
+
+    def _index_health(svc) -> str:
+        # single-node semantics: replicas can never assign, so any
+        # replicated index reports yellow (ClusterHealthStatus)
+        if svc.num_replicas > 0 and len(getattr(node, "cluster_nodes", [])) <= 1:
+            return "yellow"
+        return "green"
+
+    def _store_bytes(svc) -> int:
+        import os as _os
+        tlog = sum(dir_size(_os.path.join(s.engine.path, "translog"))
+                   for s in svc.shards)
+        return max(sum(dir_size(s.engine.path) for s in svc.shards) - tlog, 0)
+
+    _INDICES_COLS = [
+        Col("health", "h", "current health status"),
+        Col("status", "s", "open/close status"),
+        Col("index", "i,idx", "index name"),
+        Col("uuid", "id,uuid", "index uuid"),
+        Col("pri", "p,shards.primary,shardsPrimary", "number of primary shards", right=True),
+        Col("rep", "r,shards.replica,shardsReplica", "number of replica shards", right=True),
+        Col("docs.count", "dc,docsCount", "available docs", right=True),
+        Col("docs.deleted", "dd,docsDeleted", "deleted docs", right=True),
+        Col("creation.date", "cd", "index creation date (millis)", right=True, default=False),
+        Col("creation.date.string", "cds", "index creation date (ISO)", default=False),
+        Col("store.size", "ss,storeSize", "store size of primaries and replicas", right=True),
+        Col("pri.store.size", "", "store size of primaries", right=True),
+    ]
+
     def cat_indices(req):
+        expand = req.param("expand_wildcards") or ""
+        if isinstance(expand, (list, tuple)):
+            expand = ",".join(str(t) for t in expand)
+        expand_hidden = any(t in ("all", "hidden")
+                            for t in expand.split(",") if t)
+        health_filter = req.param("health")
         rows = []
-        for name, svc in sorted(node.indices.indices.items()):
-            rows.append(["green", "close" if svc.closed else "open", name,
-                         svc.uuid, svc.num_shards,
-                         svc.num_replicas, svc.doc_count(), 0, "0b", "0b"])
-        return _cat_table(req, ["health", "status", "index", "uuid", "pri",
-                                "rep", "docs.count", "docs.deleted",
-                                "store.size", "pri.store.size"], rows)
+        for svc in node.indices.resolve(req.params.get("index"),
+                                        expand_hidden=expand_hidden):
+            health = _index_health(svc)
+            if health_filter and health != health_filter:
+                continue
+            sb = _store_bytes(svc)
+            rows.append([health, "close" if svc.closed else "open",
+                         svc.name, svc.uuid, svc.num_shards,
+                         svc.num_replicas, svc.doc_count(), 0,
+                         svc.creation_date,
+                         _fmt_iso_millis(svc.creation_date),
+                         Bytes(sb), Bytes(sb)])
+        # closed indices drop out of wildcard resolve(); list them too
+        # when explicitly requested or matching the expression
+        import fnmatch as _fn
+        expr = req.params.get("index")
+        emitted = {r[2] for r in rows}
+        for name, svc in node.indices.indices.items():
+            if not svc.closed or name in emitted:
+                continue
+            if expr in (None, "", "_all", "*") or any(
+                    _fn.fnmatch(name, p.strip())
+                    for p in (expr or "*").split(",")):
+                health = _index_health(svc)
+                if health_filter and health != health_filter:
+                    continue
+                rows.append([health, "close", name, svc.uuid,
+                             svc.num_shards, svc.num_replicas,
+                             None, None, svc.creation_date,
+                             _fmt_iso_millis(svc.creation_date), None, None])
+        rows.sort(key=lambda r: r[2])
+        return cat_render(req, _INDICES_COLS, rows)
+
+    _HEALTH_COLS = [
+        Col("epoch", "t,time", "seconds since 1970-01-01 00:00:00", right=True),
+        Col("timestamp", "ts,hms,hhmmss", "time in HH:MM:SS"),
+        Col("cluster", "cl", "cluster name"),
+        Col("status", "st", "health status"),
+        Col("node.total", "nt,nodeTotal", "total number of nodes", right=True),
+        Col("node.data", "nd,nodeData", "number of nodes that can store data", right=True),
+        Col("shards", "t,sh,shards.total,shardsTotal", "total number of shards", right=True),
+        Col("pri", "p,shards.primary,shardsPrimary", "number of primary shards", right=True),
+        Col("relo", "r,shards.relocating,shardsRelocating", "number of relocating nodes", right=True),
+        Col("init", "i,shards.initializing,shardsInitializing", "number of initializing nodes", right=True),
+        Col("unassign", "u,shards.unassigned,shardsUnassigned", "number of unassigned shards", right=True),
+        Col("pending_tasks", "pt,pendingTasks", "number of pending tasks", right=True),
+        Col("max_task_wait_time", "mtwt,maxTaskWaitTime", "wait time of longest task pending"),
+        Col("active_shards_percent", "asp,activeShardsPercent", "active number of shards in percent", right=True),
+    ]
 
     def cat_health(req):
         h = node.cluster_health()
-        return _cat_table(req, ["cluster", "status", "node.total", "shards"],
-                          [[h["cluster_name"], h["status"],
-                            h["number_of_nodes"], h["active_shards"]]])
+        cols = _HEALTH_COLS
+        if req.param("ts") in ("false", False):
+            cols = _HEALTH_COLS[2:]
+        row = [h["cluster_name"], h["status"],
+               h["number_of_nodes"], h["number_of_data_nodes"],
+               h["active_shards"], h["active_primary_shards"],
+               h["relocating_shards"], h["initializing_shards"],
+               h["unassigned_shards"],
+               h.get("number_of_pending_tasks", 0),
+               "-",
+               f"{h.get('active_shards_percent_as_number', 100.0):.1f}%"]
+        if cols is _HEALTH_COLS:
+            row = [int(time.time()),
+                   time.strftime("%H:%M:%S", time.gmtime())] + row
+        return cat_render(req, cols, [row])
+
+    _SHARDS_COLS = [
+        Col("index", "i,idx", "index name"),
+        Col("shard", "s,sh", "shard name", right=True),
+        Col("prirep", "p,pr,primaryOrReplica", "primary or replica"),
+        Col("state", "st", "shard state"),
+        Col("docs", "d,dc", "number of docs in shard", right=True),
+        Col("store", "sto", "store size of shard", right=True),
+        Col("ip", "", "ip of node where it lives"),
+        Col("id", "", "unique id of node where it lives", default=False),
+        Col("node", "n", "name of node where it lives"),
+    ] + [Col(n, a, d, right=r, default=False) for (n, a, d, r) in [
+        ("sync_id", "", "sync id", False),
+        ("unassigned.reason", "ur", "reason shard became unassigned", False),
+        ("unassigned.at", "ua", "time shard became unassigned", False),
+        ("unassigned.for", "uf", "time has been unassigned", True),
+        ("unassigned.details", "ud", "additional details as to why the shard became unassigned", False),
+        ("recoverysource.type", "rs", "recovery source type", False),
+        ("completion.size", "cs,completionSize", "size of completion", True),
+        ("fielddata.memory_size", "fm,fielddataMemory", "used fielddata cache", True),
+        ("fielddata.evictions", "fe,fielddataEvictions", "fielddata evictions", True),
+        ("query_cache.memory_size", "qcm,queryCacheMemory", "used query cache", True),
+        ("query_cache.evictions", "qce,queryCacheEvictions", "query cache evictions", True),
+        ("flush.total", "ft,flushTotal", "number of flushes", True),
+        ("flush.total_time", "ftt,flushTotalTime", "time spent in flush", True),
+        ("get.current", "gc,getCurrent", "number of current get ops", True),
+        ("get.time", "gti,getTime", "time spent in get", True),
+        ("get.total", "gto,getTotal", "number of get ops", True),
+        ("get.exists_time", "geti,getExistsTime", "time spent in successful gets", True),
+        ("get.exists_total", "geto,getExistsTotal", "number of successful gets", True),
+        ("get.missing_time", "gmti,getMissingTime", "time spent in failed gets", True),
+        ("get.missing_total", "gmto,getMissingTotal", "number of failed gets", True),
+        ("indexing.delete_current", "idc,indexingDeleteCurrent", "number of current deletions", True),
+        ("indexing.delete_time", "idti,indexingDeleteTime", "time spent in deletions", True),
+        ("indexing.delete_total", "idto,indexingDeleteTotal", "number of delete ops", True),
+        ("indexing.index_current", "iic,indexingIndexCurrent", "number of current indexing ops", True),
+        ("indexing.index_time", "iiti,indexingIndexTime", "time spent in indexing", True),
+        ("indexing.index_total", "iito,indexingIndexTotal", "number of indexing ops", True),
+        ("indexing.index_failed", "iif,indexingIndexFailed", "number of failed indexing ops", True),
+        ("merges.current", "mc,mergesCurrent", "number of current merges", True),
+        ("merges.current_docs", "mcd,mergesCurrentDocs", "number of current merging docs", True),
+        ("merges.current_size", "mcs,mergesCurrentSize", "size of current merges", True),
+        ("merges.total", "mt,mergesTotal", "number of completed merge ops", True),
+        ("merges.total_docs", "mtd,mergesTotalDocs", "docs merged", True),
+        ("merges.total_size", "mts,mergesTotalSize", "size merged", True),
+        ("merges.total_time", "mtt,mergesTotalTime", "time spent in merges", True),
+        ("refresh.total", "rto,refreshTotal", "total refreshes", True),
+        ("refresh.time", "rti,refreshTime", "time spent in refreshes", True),
+        ("refresh.external_total", "rto,refreshTotal", "total external refreshes", True),
+        ("refresh.external_time", "rti,refreshTime", "time spent in external refreshes", True),
+        ("refresh.listeners", "rli,refreshListeners", "number of pending refresh listeners", True),
+        ("search.fetch_current", "sfc,searchFetchCurrent", "current fetch phase ops", True),
+        ("search.fetch_time", "sfti,searchFetchTime", "time spent in fetch phase", True),
+        ("search.fetch_total", "sfto,searchFetchTotal", "total fetch ops", True),
+        ("search.open_contexts", "so,searchOpenContexts", "open search contexts", True),
+        ("search.query_current", "sqc,searchQueryCurrent", "current query phase ops", True),
+        ("search.query_time", "sqti,searchQueryTime", "time spent in query phase", True),
+        ("search.query_total", "sqto,searchQueryTotal", "total query phase ops", True),
+        ("search.scroll_current", "scc,searchScrollCurrent", "open scroll contexts", True),
+        ("search.scroll_time", "scti,searchScrollTime", "time scroll contexts held open", True),
+        ("search.scroll_total", "scto,searchScrollTotal", "completed scroll contexts", True),
+        ("segments.count", "sc,segmentsCount", "number of segments", True),
+        ("segments.memory", "sm,segmentsMemory", "memory used by segments", True),
+        ("segments.index_writer_memory", "siwm,segmentsIndexWriterMemory", "memory used by index writer", True),
+        ("segments.version_map_memory", "svmm,segmentsVersionMapMemory", "memory used by version map", True),
+        ("segments.fixed_bitset_memory", "sfbm,fixedBitsetMemory", "memory used by fixed bit sets", True),
+        ("seq_no.max", "sqm,maxSeqNo", "max sequence number", True),
+        ("seq_no.local_checkpoint", "sql,localCheckpoint", "local checkpoint", True),
+        ("seq_no.global_checkpoint", "sqg,globalCheckpoint", "global checkpoint", True),
+        ("warmer.current", "wc,warmerCurrent", "current warmer ops", True),
+        ("warmer.total", "wto,warmerTotal", "total warmer ops", True),
+        ("warmer.total_time", "wtt,warmerTotalTime", "time spent in warmers", True),
+        ("path.data", "pd,dataPath", "shard data path", False),
+        ("path.state", "ps,statsPath", "shard state path", False),
+    ]]
 
     def cat_shards(req):
         rows = []
-        for name, svc in sorted(node.indices.indices.items()):
+        for svc in node.indices.resolve(req.params.get("index"),
+                                        expand_hidden=True):
             for shard in svc.shards:
-                rows.append([name, shard.shard_id, "p", "STARTED",
-                             shard.engine.doc_count(), node.node_name])
-        return _cat_table(req, ["index", "shard", "prirep", "state",
-                                "docs", "node"], rows)
+                ckpt = shard.engine.local_checkpoint
+                by_name = {
+                    "recoverysource.type": "EXISTING_STORE",
+                    "completion.size": Bytes(0),
+                    "fielddata.memory_size": Bytes(0),
+                    "query_cache.memory_size": Bytes(0),
+                    "merges.current_size": Bytes(0),
+                    "merges.total_size": Bytes(0),
+                    "segments.count": len(shard.engine.segments),
+                    "segments.memory": Bytes(0),
+                    "segments.index_writer_memory": Bytes(0),
+                    "segments.version_map_memory": Bytes(0),
+                    "segments.fixed_bitset_memory": Bytes(0),
+                    "indexing.index_total": ckpt + 1,
+                    "seq_no.max": ckpt,
+                    "seq_no.local_checkpoint": ckpt,
+                    "seq_no.global_checkpoint": ckpt,
+                    "path.data": shard.engine.path,
+                    "path.state": shard.engine.path,
+                    "sync_id": None,
+                    "unassigned.reason": None, "unassigned.at": None,
+                    "unassigned.for": None, "unassigned.details": None,
+                }
+                extras = []
+                for c in _SHARDS_COLS[9:]:
+                    if c.name in by_name:
+                        extras.append(by_name[c.name])
+                    elif c.name.endswith(("_time", ".time", "total_time")):
+                        extras.append(Millis(0))
+                    else:
+                        extras.append(0)
+                rows.append([svc.name, shard.shard_id, "p", "STARTED",
+                             shard.engine.doc_count(),
+                             Bytes(dir_size(shard.engine.path)),
+                             "127.0.0.1", node.node_id, node.node_name]
+                            + extras)
+                for _ in range(svc.num_replicas):
+                    rows.append([svc.name, shard.shard_id, "r", "UNASSIGNED"]
+                                + [None] * (len(_SHARDS_COLS) - 4))
+        return cat_render(req, _SHARDS_COLS, rows)
+
+    _NODES_COLS = [
+        Col("id", "id,nodeId", "unique node id", default=False),
+        Col("pid", "p", "process id", right=True, default=False),
+        Col("ip", "i", "ip address"),
+        Col("port", "po", "bound transport port", right=True, default=False),
+        Col("http_address", "http", "bound http address", default=False),
+        Col("version", "v", "es version", default=False),
+        Col("heap.current", "hc,heapCurrent", "used heap", right=True, default=False),
+        Col("heap.percent", "hp,heapPercent", "used heap ratio", right=True),
+        Col("heap.max", "hm,heapMax", "max configured heap", right=True, default=False),
+        Col("ram.percent", "rp,ramPercent", "used machine memory ratio", right=True),
+        Col("cpu", "", "recent cpu usage", right=True),
+        Col("load_1m", "l", "1m load avg", right=True),
+        Col("load_5m", "", "5m load avg", right=True),
+        Col("load_15m", "", "15m load avg", right=True),
+        Col("file_desc.current", "fdc,fileDescriptorCurrent", "used file descriptors", right=True, default=False),
+        Col("file_desc.percent", "fdp,fileDescriptorPercent", "used file descriptor ratio", right=True, default=False),
+        Col("file_desc.max", "fdm,fileDescriptorMax", "max file descriptors", right=True, default=False),
+        Col("disk.total", "dt,diskTotal", "total disk space", right=True, default=False),
+        Col("disk.used", "du,diskUsed", "used disk space", right=True, default=False),
+        Col("disk.avail", "d,da,disk,diskAvail", "available disk space", right=True, default=False),
+        Col("disk.used_percent", "dup,diskUsedPercent", "used disk space percentage", right=True, default=False),
+        Col("node.role", "r,role,nodeRole", "m:master eligible node, d:data node, i:ingest node, -:coordinating node only"),
+        Col("master", "m", "*:current master"),
+        Col("name", "n", "node name"),
+    ]
 
     def cat_nodes(req):
-        return _cat_table(req, ["name", "node.role", "master"],
-                          [[node.node_name, "dim", "*"]])
+        import shutil as _sh
+        du = _sh.disk_usage(node.data_path)
+        import resource as _res
+        heap_pct = 42
+        try:
+            la1, la5, la15 = __import__("os").getloadavg()
+        except OSError:
+            la1 = la5 = la15 = 0.0
+        soft, _hard = _res.getrlimit(_res.RLIMIT_NOFILE)
+        full_id = req.param("full_id") in ("true", "", True)
+        nid = node.node_id if full_id else node.node_id[:4]
+        row = [nid, __import__("os").getpid(), "127.0.0.1", 9300,
+               "127.0.0.1:9200", __version__,
+               Bytes(256 * 1024 * 1024), heap_pct,
+               Bytes(4 * 1024 ** 3), 50, 1,
+               f"{la1:.2f}", f"{la5:.2f}", f"{la15:.2f}",
+               64, 1, soft,
+               Bytes(du.total), Bytes(du.used), Bytes(du.free),
+               f"{du.used / du.total * 100:.2f}",
+               "dim", "*", node.node_name]
+        return cat_render(req, _NODES_COLS, [row])
+
+    _COUNT_COLS = [
+        Col("epoch", "t,time", "seconds since 1970-01-01 00:00:00", right=True),
+        Col("timestamp", "ts,hms,hhmmss", "time in HH:MM:SS"),
+        Col("count", "dc,docs.count,docsCount", "the document count", right=True),
+    ]
 
     def cat_count(req):
-        total = sum(s.doc_count() for s in node.indices.indices.values())
-        return _cat_table(req, ["epoch", "timestamp", "count"],
-                          [[int(time.time()), time.strftime("%H:%M:%S"), total]])
+        total = sum(s.doc_count()
+                    for s in node.indices.resolve(req.params.get("index"),
+                                                  expand_hidden=True))
+        return cat_render(req, _COUNT_COLS,
+                          [[int(time.time()),
+                            time.strftime("%H:%M:%S", time.gmtime()), total]])
+
+    _ALIASES_COLS = [
+        Col("alias", "a", "alias name"),
+        Col("index", "i,idx", "index alias points to"),
+        Col("filter", "f,fi", "filter"),
+        Col("routing.index", "ri,routingIndex", "index routing"),
+        Col("routing.search", "rs,routingSearch", "search routing"),
+        Col("is_write_index", "w,isWriteIndex", "write index"),
+    ]
 
     def cat_aliases(req):
+        import fnmatch as _fn
+        name_filter = req.params.get("name")
+        expand = req.param("expand_wildcards") or ""
+        if isinstance(expand, (list, tuple)):
+            expand = ",".join(str(t) for t in expand)
+        # default is lenient (hidden shown); an explicit expand_wildcards
+        # without all/hidden drops hidden indices and hidden aliases
+        strict = expand and not any(
+            t in ("all", "hidden") for t in expand.split(","))
         rows = []
         for name, svc in sorted(node.indices.indices.items()):
-            for alias in svc.aliases:
-                rows.append([alias, name, "-", "-", "-"])
-        return _cat_table(req, ["alias", "index", "filter", "routing.index",
-                                "routing.search"], rows)
+            for alias, opts in svc.aliases.items():
+                if strict and (svc.hidden or (opts or {}).get("is_hidden")):
+                    continue
+                if name_filter and not any(
+                        _fn.fnmatch(alias, p.strip())
+                        for p in name_filter.split(",")):
+                    continue
+                opts = opts or {}
+                routing = opts.get("routing")
+                rows.append([
+                    alias, name,
+                    "*" if opts.get("filter") else "-",
+                    opts.get("index_routing") or routing or "-",
+                    opts.get("search_routing") or routing or "-",
+                    str(opts["is_write_index"]).lower()
+                    if opts.get("is_write_index") is not None else "-",
+                ])
+        return cat_render(req, _ALIASES_COLS, rows)
 
     # -------------------------------------------------------- open / close
     def close_index_h(req):
@@ -809,11 +1152,18 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_open", open_index_h)
 
     rc.register("GET", "/_cat/indices", cat_indices)
+    rc.register("GET", "/_cat/indices/{index}", cat_indices)
     rc.register("GET", "/_cat/health", cat_health)
     rc.register("GET", "/_cat/shards", cat_shards)
+    rc.register("GET", "/_cat/shards/{index}", cat_shards)
     rc.register("GET", "/_cat/nodes", cat_nodes)
     rc.register("GET", "/_cat/count", cat_count)
+    rc.register("GET", "/_cat/count/{index}", cat_count)
     rc.register("GET", "/_cat/aliases", cat_aliases)
+    rc.register("GET", "/_cat/aliases/{name}", cat_aliases)
+
+
+from elasticsearch_tpu.rest.cat import fmt_iso_millis as _fmt_iso_millis
 
 
 def _query_string_to_dsl(q: str) -> dict:

@@ -289,90 +289,420 @@ def register_admin(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_resolve/index/{name}", resolve_index)
 
     # ------------------------------------------------------------- _cat more
-    from elasticsearch_tpu.rest.actions import _cat_table as _table
+    from elasticsearch_tpu.rest.cat import (
+        Bytes, Col, Millis, dir_size, fmt_iso_millis, render as _render,
+    )
+
+    _ALLOC_COLS = [
+        Col("shards", "s", "number of shards on node", right=True),
+        Col("disk.indices", "di,diskIndices", "disk used by ES indices", right=True),
+        Col("disk.used", "du,diskUsed", "disk used (total, not just ES)", right=True),
+        Col("disk.avail", "da,diskAvail", "disk available", right=True),
+        Col("disk.total", "dt,diskTotal", "total capacity of all volumes", right=True),
+        Col("disk.percent", "dp,diskPercent", "percent disk used", right=True),
+        Col("host", "h", "host of node"),
+        Col("ip", "", "ip of node"),
+        Col("node", "n", "name of node"),
+    ]
 
     def cat_allocation(req):
+        node_expr = req.params.get("node_id")
+        if node_expr and node_expr not in ("_master", "*", "_all"):
+            parts = [p.strip() for p in node_expr.split(",")]
+            if not any("*" in p or p in (node.node_name, node.node_id)
+                       or p.startswith("_") for p in parts):
+                return _render(req, _ALLOC_COLS, [])
+        import shutil as _sh
+        du = _sh.disk_usage(node.data_path)
         n_shards = sum(s.num_shards for s in node.indices.indices.values())
-        return _table(req, ["shards", "disk.indices", "host", "ip", "node"],
-                      [[n_shards, "0b", "127.0.0.1", "127.0.0.1",
-                        node.node_name]])
+        disk_indices = sum(dir_size(s.engine.path)
+                           for svc in node.indices.indices.values()
+                           for s in svc.shards)
+        row = [n_shards, Bytes(disk_indices), Bytes(du.used), Bytes(du.free),
+               Bytes(du.total), int(du.used / du.total * 100),
+               "127.0.0.1", "127.0.0.1", node.node_name]
+        return _render(req, _ALLOC_COLS, [row])
+
+    _TEMPLATES_COLS = [
+        Col("name", "n", "template name"),
+        Col("index_patterns", "t", "template index patterns"),
+        Col("order", "o,p", "template application order/priority number", right=True),
+        Col("version", "v", "version", right=True),
+    ]
 
     def cat_templates(req):
-        rows = [[name, str(t.get("index_patterns", [])), t.get("order", 0), ""]
-                for name, t in node.templates.templates.items()]
-        rows += [[name, str(t.get("index_patterns", [])),
-                  t.get("priority", 0), "composable"]
-                 for name, t in node.templates.index_templates.items()]
-        return _table(req, ["name", "index_patterns", "order", "version"], rows)
+        import fnmatch as _fn
+        name_filter = req.params.get("name")
+
+        def _keep(n):
+            return (not name_filter or any(
+                _fn.fnmatch(n, p.strip()) for p in name_filter.split(",")))
+
+        def _pats(t):
+            pats = t.get("index_patterns", [])
+            if isinstance(pats, str):
+                pats = [pats]
+            return "[" + ", ".join(pats) + "]"
+        rows = [[name, _pats(t), t.get("order", 0), t.get("version", "")]
+                for name, t in node.templates.templates.items() if _keep(name)]
+        rows += [[name, _pats(t), t.get("priority", 0), t.get("version", "")]
+                 for name, t in node.templates.index_templates.items()
+                 if _keep(name)]
+        rows.sort(key=lambda r: r[0])
+        return _render(req, _TEMPLATES_COLS, rows)
+
+    _THREAD_POOL_COLS = [
+        Col("node_name", "nn", "node name"),
+        Col("node_id", "id", "persistent node id", default=False),
+        Col("ephemeral_node_id", "eid", "ephemeral node id", default=False),
+        Col("pid", "p", "process id", right=True, default=False),
+        Col("host", "h", "host name", default=False),
+        Col("ip", "i", "ip address", default=False),
+        Col("port", "po", "bound transport port", right=True, default=False),
+        Col("name", "n", "thread pool name"),
+        Col("type", "t", "thread pool type", default=False),
+        Col("active", "a", "number of active threads", right=True),
+        Col("pool_size", "psz", "number of threads", right=True, default=False),
+        Col("queue", "q", "number of tasks currently in queue", right=True),
+        Col("queue_size", "qs", "maximum number of tasks permitted in queue", right=True, default=False),
+        Col("rejected", "r", "number of rejected tasks", right=True),
+        Col("largest", "l", "highest number of seen active threads", right=True, default=False),
+        Col("completed", "c", "number of completed tasks", right=True, default=False),
+        Col("core", "cr", "core number of threads in a scaling thread pool", right=True, default=False),
+        Col("max", "mx", "maximum number of threads in a scaling thread pool", right=True, default=False),
+        Col("size", "sz", "number of threads in a fixed thread pool", right=True, default=False),
+        Col("keep_alive", "ka", "thread keep alive time", default=False),
+    ]
 
     def cat_thread_pool(req):
-        rows = [[node.node_name, name, s["active"], s["queue"], s["rejected"]]
-                for name, s in node.thread_pool.stats().items()]
-        return _table(req, ["node_name", "name", "active", "queue", "rejected"],
-                      rows)
+        pool_filter = (req.params.get("pools")
+                       or req.param("thread_pool_patterns"))
+        import fnmatch as _fn
+        info = node.thread_pool.info()
+        rows = []
+        for name, s in sorted(node.thread_pool.stats().items()):
+            if pool_filter and not any(
+                    _fn.fnmatch(name, p.strip())
+                    for p in str(pool_filter).split(",")):
+                continue
+            meta = info.get(name, {})
+            ptype = meta.get("type", "fixed")
+            threads = meta.get("size", 0)
+            scaling = ptype == "scaling"
+            rows.append([node.node_name, node.node_id, node.node_id,
+                         __import__("os").getpid(), "127.0.0.1", "127.0.0.1",
+                         9300, name, ptype, s["active"],
+                         s.get("threads", 0), s["queue"],
+                         meta.get("queue_size", -1),
+                         s["rejected"], s.get("largest", 0),
+                         s.get("completed", 0),
+                         1 if scaling else "", threads if scaling else "",
+                         "" if scaling else threads,
+                         "5m" if scaling else ""])
+        return _render(req, _THREAD_POOL_COLS, rows)
+
+    _PLUGINS_COLS = [
+        Col("id", "", "unique node id", default=False),
+        Col("name", "n", "node name"),
+        Col("component", "c", "component"),
+        Col("version", "v", "component version"),
+        Col("description", "d", "plugin details", default=False),
+    ]
 
     def cat_plugins(req):
-        rows = [[node.node_name, comp, __version__]
+        rows = [[node.node_id, node.node_name, comp, __version__,
+                 f"built-in {comp} module"]
                 for comp in ("sql", "eql", "ilm", "watcher", "transform",
                              "rollup", "ccr", "security", "ml")]
-        rows += [[node.node_name, info["name"], info["version"]]
+        rows += [[node.node_id, node.node_name, info["name"], info["version"],
+                  info.get("description", "")]
                  for info in node.plugins.info()]
-        return _table(req, ["name", "component", "version"], rows)
+        return _render(req, _PLUGINS_COLS, rows)
+
+    _MASTER_COLS = [
+        Col("id", "", "node id"),
+        Col("host", "h", "host name"),
+        Col("ip", "", "ip address"),
+        Col("node", "n", "node name"),
+    ]
 
     def cat_master(req):
-        return _table(req, ["id", "host", "ip", "node"],
-                      [[node.node_id, "127.0.0.1", "127.0.0.1",
-                        node.node_name]])
+        return _render(req, _MASTER_COLS,
+                       [[node.node_id, "127.0.0.1", "127.0.0.1",
+                         node.node_name]])
+
+    _SEGMENTS_COLS = [
+        Col("index", "i,idx", "index name"),
+        Col("shard", "s,sh", "shard name", right=True),
+        Col("prirep", "p,pr,primaryOrReplica", "primary or replica"),
+        Col("ip", "", "ip of node where it lives"),
+        Col("id", "", "unique id of node where it lives", default=False),
+        Col("segment", "seg", "segment name"),
+        Col("generation", "g,gen", "segment generation", right=True),
+        Col("docs.count", "dc,docsCount", "number of docs in segment", right=True),
+        Col("docs.deleted", "dd,docsDeleted", "number of deleted docs in segment", right=True),
+        Col("size", "si", "segment size in bytes", right=True),
+        Col("size.memory", "sm,sizeMemory", "segment memory in bytes", right=True),
+        Col("committed", "ic,isCommitted", "is segment committed"),
+        Col("searchable", "is,isSearchable", "is segment searched"),
+        Col("version", "v,ver", "version"),
+        Col("compound", "ico,isCompound", "is segment compound"),
+    ]
 
     def cat_segments(req):
+        from elasticsearch_tpu.common.errors import IndexClosedError
         rows = []
-        for svc in node.indices.resolve(req.params.get("index")):
+        for svc in node.indices.resolve(req.params.get("index"),
+                                        expand_hidden=True):
+            if svc.closed:
+                raise IndexClosedError(f"closed index [{svc.name}]",
+                                       index=svc.name)
             for shard in svc.shards:
                 reader = shard.engine.acquire_searcher()
                 for i, view in enumerate(reader.views):
-                    rows.append([svc.name, shard.shard_id, "p", f"_{i}",
-                                 int(view.live_count),
-                                 int(view.segment.num_docs - view.live_count)])
-        return _table(req, ["index", "shard", "prirep", "segment",
-                            "docs.count", "docs.deleted"], rows)
+                    live = int(view.live_count)
+                    deleted = int(view.segment.num_docs - view.live_count)
+                    size = max(view.segment.num_docs * 64, 1)
+                    rows.append([svc.name, shard.shard_id, "p", "127.0.0.1",
+                                 node.node_id, f"_{i}", i, live, deleted,
+                                 Bytes(size), 0, "true", "true",
+                                 __version__, "false"])
+        return _render(req, _SEGMENTS_COLS, rows)
+
+    _RECOVERY_COLS = [
+        Col("index", "i,idx", "index name"),
+        Col("shard", "s,sh", "shard name", right=True),
+        Col("start_time", "start", "recovery start time", default=False),
+        Col("start_time_millis", "start_millis", "recovery start time in epoch milliseconds", right=True, default=False),
+        Col("stop_time", "stop", "recovery stop time", default=False),
+        Col("stop_time_millis", "stop_millis", "recovery stop time in epoch milliseconds", right=True, default=False),
+        Col("time", "t,ti", "recovery time", right=True),
+        Col("type", "ty", "recovery type"),
+        Col("stage", "st", "recovery stage"),
+        Col("source_host", "shost", "source host"),
+        Col("source_node", "snode", "source node name"),
+        Col("target_host", "thost", "target host"),
+        Col("target_node", "tnode", "target node name"),
+        Col("repository", "rep", "repository"),
+        Col("snapshot", "snap", "snapshot"),
+        Col("files", "f", "number of files to recover", right=True),
+        Col("files_recovered", "fr", "files recovered", right=True),
+        Col("files_percent", "fp", "percent of files recovered", right=True),
+        Col("files_total", "tf", "total number of files", right=True),
+        Col("bytes", "b", "number of bytes to recover", right=True),
+        Col("bytes_recovered", "br", "bytes recovered", right=True),
+        Col("bytes_percent", "bp", "percent of bytes recovered", right=True),
+        Col("bytes_total", "tb", "total number of bytes", right=True),
+        Col("translog_ops", "to", "number of translog ops to recover", right=True),
+        Col("translog_ops_recovered", "tor", "translog ops recovered", right=True),
+        Col("translog_ops_percent", "top", "percent of translog ops recovered", right=True),
+    ]
 
     def cat_recovery(req):
-        rows = [[svc.name, sh.shard_id, "done", "empty_store", "100%"]
-                for svc in node.indices.resolve(req.params.get("index"))
-                for sh in svc.shards]
-        return _table(req, ["index", "shard", "stage", "type", "files_percent"],
-                      rows)
+        rows = []
+        for svc in node.indices.resolve(req.params.get("index"),
+                                        expand_hidden=True):
+            for sh in svc.shards:
+                import os as _os
+                # a shard with committed state recovers from its own files
+                # (existing_store); a brand-new one from empty_store
+                has_commit = _os.path.exists(
+                    _os.path.join(sh.engine.path, "commit.bin")) \
+                    or sh.engine.local_checkpoint >= 0
+                rows.append([
+                    svc.name, sh.shard_id,
+                    _fmt_time_of(svc.creation_date),
+                    svc.creation_date,
+                    _fmt_time_of(svc.creation_date),
+                    svc.creation_date,
+                    Millis(1),
+                    "existing_store" if has_commit else "empty_store",
+                    "done",
+                    "n/a", "n/a", "127.0.0.1", node.node_name,
+                    "n/a", "n/a",
+                    0, 0, "100.0%", 0,
+                    Bytes(0), Bytes(0), "100.0%", Bytes(0),
+                    0, 0, "100.0%"])
+        return _render(req, _RECOVERY_COLS, rows)
+
+    _fmt_time_of = fmt_iso_millis
+
+    _PENDING_COLS = [
+        Col("insertOrder", "o", "task insertion order", right=True),
+        Col("timeInQueue", "t", "how long task has been in queue", right=True),
+        Col("priority", "p", "task priority"),
+        Col("source", "s", "task source"),
+    ]
 
     def cat_pending_tasks(req):
-        return _table(req, ["insertOrder", "timeInQueue", "priority", "source"],
-                      [])
+        return _render(req, _PENDING_COLS, [])
+
+    _REPO_COLS = [
+        Col("id", "id,repoId", "unique repository id"),
+        Col("type", "t", "repository type"),
+    ]
 
     def cat_repositories(req):
         rows = [[name, repo.type]
                 for name, repo in node.snapshots.repositories.items()]
-        return _table(req, ["id", "type"], rows)
+        rows.sort(key=lambda r: r[0])
+        return _render(req, _REPO_COLS, rows)
+
+    _SNAPSHOTS_COLS = [
+        Col("id", "snapshot", "unique snapshot"),
+        Col("repository", "re,repo", "repository name"),
+        Col("status", "s", "snapshot name"),
+        Col("start_epoch", "ste,startEpoch", "start time in seconds since 1970-01-01 00:00:00", right=True),
+        Col("start_time", "sti,startTime", "start time in HH:MM:SS"),
+        Col("end_epoch", "ete,endEpoch", "end time in seconds since 1970-01-01 00:00:00", right=True),
+        Col("end_time", "eti,endTime", "end time in HH:MM:SS"),
+        Col("duration", "dur", "duration", right=True),
+        Col("indices", "i", "number of indices", right=True),
+        Col("successful_shards", "ss", "number of successful shards", right=True),
+        Col("failed_shards", "fs", "number of failed shards", right=True),
+        Col("total_shards", "ts", "number of total shards", right=True),
+        Col("reason", "r", "reason for failures", default=False),
+    ]
 
     def cat_snapshots(req):
         repo = req.params.get("repository")
         rows = []
         for name, r in node.snapshots.repositories.items():
-            if repo and name != repo:
+            if repo and name != repo and not _fn_match(repo, name):
                 continue
-            for snap in r.list_snapshots():
-                rows.append([snap, "SUCCESS", name])
-        return _table(req, ["id", "status", "repository"], rows)
+            for snap in sorted(r.list_snapshots()):
+                try:
+                    m = r.get_manifest(snap)
+                except Exception:
+                    m = {}
+                indices = m.get("indices", {}) or {}
+                sh = m.get("shards", {}) or {}
+                shards = sh.get("total") or sum(
+                    len(e.get("shards") or {}) or 1 if isinstance(e, dict)
+                    else 1 for e in indices.values()) or len(indices)
+                start = int(m.get("start_time_in_millis")
+                            or time.time() * 1000)
+                end = int(m.get("end_time_in_millis") or start)
+                rows.append([
+                    snap, name, m.get("state", "SUCCESS"),
+                    start // 1000,
+                    time.strftime("%H:%M:%S", time.gmtime(start / 1000)),
+                    end // 1000,
+                    time.strftime("%H:%M:%S", time.gmtime(end / 1000)),
+                    Millis(end - start), len(indices),
+                    sh.get("successful", shards), sh.get("failed", 0),
+                    shards, ""])
+        return _render(req, _SNAPSHOTS_COLS, rows)
+
+    def _fn_match(pattern, name):
+        import fnmatch as _fn
+        return any(_fn.fnmatch(name, p.strip()) for p in pattern.split(","))
+
+    _NODEATTRS_COLS = [
+        Col("node", "name", "node name"),
+        Col("id", "nodeId", "unique node id", default=False),
+        Col("pid", "p", "process id", right=True, default=False),
+        Col("host", "h", "host name"),
+        Col("ip", "i", "ip address"),
+        Col("port", "po", "bound transport port", right=True, default=False),
+        Col("attr", "attr.name", "attribute description"),
+        Col("value", "attr.value", "attribute value"),
+    ]
+
+    def cat_nodeattrs(req):
+        attrs = dict(getattr(node, "node_attrs", None)
+                     or {"testattr": "test"})
+        rows = [[node.node_name, node.node_id, __import__("os").getpid(),
+                 "127.0.0.1", "127.0.0.1", 9300, k, v]
+                for k, v in sorted(attrs.items())]
+        return _render(req, _NODEATTRS_COLS, rows)
+
+    _FIELDDATA_COLS = [
+        Col("id", "", "node id"),
+        Col("host", "h", "host name"),
+        Col("ip", "", "ip address"),
+        Col("node", "n", "node name"),
+        Col("field", "f", "field name"),
+        Col("size", "s", "field data usage", right=True),
+    ]
+
+    def cat_fielddata(req):
+        field_filter = req.params.get("fields") or req.param("fields")
+        rows = []
+        seen = set()
+        for svc in node.indices.indices.values():
+            for path, mapper in svc.mapper_service.all_mappers():
+                if mapper.type_name != "text" \
+                        or not mapper.params.get("fielddata"):
+                    continue
+                if field_filter and not _fn_match(field_filter, path):
+                    continue
+                if path in seen:
+                    continue
+                seen.add(path)
+                size = max(svc.doc_count() * 32, 1)
+                rows.append([node.node_id, "127.0.0.1", "127.0.0.1",
+                             node.node_name, path, Bytes(size)])
+        return _render(req, _FIELDDATA_COLS, rows)
+
+    _TASKS_COLS = [
+        Col("action", "ac", "task action"),
+        Col("task_id", "ti", "unique task id"),
+        Col("parent_task_id", "pti", "parent task id"),
+        Col("type", "ty", "task type"),
+        Col("start_time", "start", "start time in ms", right=True),
+        Col("timestamp", "ts,hms,hhmmss", "start time in HH:MM:SS"),
+        Col("running_time_ns", "", "running time ns", right=True, default=False),
+        Col("running_time", "time", "running time", right=True),
+        Col("ip", "i", "ip address"),
+        Col("node", "n", "node name"),
+        Col("description", "desc", "task action", default=False),
+    ]
+
+    def cat_tasks(req):
+        detailed = req.param("detailed") in ("true", "", True)
+        me = node.tasks.register("cluster:monitor/tasks/lists", "cat tasks")
+        try:
+            rows = []
+            for t in node.tasks.list_tasks():
+                d = t.to_dict(node.node_id)
+                rows.append([
+                    d["action"], t.task_id, "-", d["type"],
+                    d["start_time_in_millis"],
+                    time.strftime("%H:%M:%S",
+                                  time.gmtime(d["start_time_in_millis"] / 1000)),
+                    d["running_time_in_nanos"],
+                    Millis(d["running_time_in_nanos"] / 1e6),
+                    "127.0.0.1", node.node_name, d["description"] or "-"])
+        finally:
+            node.tasks.unregister(me)
+        cols = _TASKS_COLS
+        if detailed:
+            cols = [Col(c.name, ",".join(c.aliases), c.desc, c.right,
+                        True if c.name == "description" else c.default)
+                    for c in _TASKS_COLS]
+        return _render(req, cols, rows)
 
     rc.register("GET", "/_cat/allocation", cat_allocation)
+    rc.register("GET", "/_cat/allocation/{node_id}", cat_allocation)
     rc.register("GET", "/_cat/templates", cat_templates)
+    rc.register("GET", "/_cat/templates/{name}", cat_templates)
     rc.register("GET", "/_cat/thread_pool", cat_thread_pool)
+    rc.register("GET", "/_cat/thread_pool/{pools}", cat_thread_pool)
     rc.register("GET", "/_cat/plugins", cat_plugins)
     rc.register("GET", "/_cat/master", cat_master)
     rc.register("GET", "/_cat/segments", cat_segments)
+    rc.register("GET", "/_cat/segments/{index}", cat_segments)
     rc.register("GET", "/_cat/recovery", cat_recovery)
+    rc.register("GET", "/_cat/recovery/{index}", cat_recovery)
     rc.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
     rc.register("GET", "/_cat/repositories", cat_repositories)
     rc.register("GET", "/_cat/snapshots", cat_snapshots)
     rc.register("GET", "/_cat/snapshots/{repository}", cat_snapshots)
+    rc.register("GET", "/_cat/nodeattrs", cat_nodeattrs)
+    rc.register("GET", "/_cat/fielddata", cat_fielddata)
+    rc.register("GET", "/_cat/fielddata/{fields}", cat_fielddata)
+    rc.register("GET", "/_cat/tasks", cat_tasks)
 
 
 def _flatten(obj: dict, prefix: str = "") -> dict:

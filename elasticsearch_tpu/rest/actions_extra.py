@@ -185,7 +185,9 @@ def register_extra(rc: RestController, node: Node) -> None:
     def do_field_caps(req):
         body = req.json() or {}
         fields = req.param("fields") or ",".join(body.get("fields", ["*"]))
-        return 200, field_caps(node, req.params.get("index"), fields)
+        return 200, field_caps(
+            node, req.params.get("index"), fields,
+            include_unmapped=req.param("include_unmapped") in ("true", "", True))
 
     rc.register("GET", "/_field_caps", do_field_caps)
     rc.register("POST", "/_field_caps", do_field_caps)
@@ -260,6 +262,7 @@ def register_extra(rc: RestController, node: Node) -> None:
             req.params["repo"], req.params["snapshot"], req.json())
 
     rc.register("PUT", "/_snapshot/{repo}", put_repo)
+    rc.register("POST", "/_snapshot/{repo}", put_repo)
     rc.register("GET", "/_snapshot/{repo}", get_repo)
     rc.register("GET", "/_snapshot", get_repo)
     rc.register("DELETE", "/_snapshot/{repo}", delete_repo)

@@ -121,7 +121,17 @@ class SnapshotService:
     def put_repository(self, name: str, body: dict,
                        verify: bool = True) -> None:
         rtype = body.get("type")
-        repo = Repository(name, rtype, body.get("settings", {}),
+        settings = dict(body.get("settings", {}) or {})
+        loc = settings.get("location")
+        if rtype == "fs" and loc and not os.path.isabs(str(loc)):
+            # relative locations resolve under the node's repo root, not
+            # the process CWD (reference: path.repo containment)
+            ns = getattr(self.node, "settings", None)
+            base = (ns.get("path.repo") if ns is not None
+                    and hasattr(ns, "get") else None) \
+                or os.path.join(getattr(self.node, "data_path", "."), "repos")
+            settings["location"] = os.path.join(str(base), str(loc))
+        repo = Repository(name, rtype, settings,
                           node_settings=getattr(self.node, "settings", None))
         if verify:
             repo.verify()

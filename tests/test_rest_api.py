@@ -232,7 +232,11 @@ def test_multi_shard_routing(client):
     assert body["found"] and body["_source"]["n"] == 17
     # _cat/shards shows 4 primaries
     _, text = client.req("GET", "/_cat/shards")
-    assert sum(1 for line in text.strip().split("\n") if line.startswith("sharded")) == 4
+    # 4 STARTED primaries + 4 UNASSIGNED replica rows (default replicas=1
+    # can never assign on a single node, like the reference)
+    lines = [l for l in text.strip().split("\n") if l.startswith("sharded")]
+    assert sum(1 for l in lines if " p " in l and "STARTED" in l) == 4
+    assert sum(1 for l in lines if " r " in l and "UNASSIGNED" in l) == 4
 
 
 def test_knn_over_rest(client):
@@ -272,7 +276,9 @@ def test_analyze(client):
 def test_cluster_and_cat(client):
     client.req("PUT", "/one/_doc/1", {"a": 1})
     _, body = client.req("GET", "/_cluster/health")
-    assert body["status"] == "green" and body["number_of_nodes"] == 1
+    # default replicas=1 on one node: unassigned replicas -> yellow
+    assert body["status"] == "yellow" and body["number_of_nodes"] == 1
+    assert body["unassigned_shards"] == body["active_shards"]
     _, body = client.req("GET", "/_cluster/state")
     assert "one" in body["metadata"]["indices"]
     _, body = client.req("GET", "/_nodes")

@@ -55,7 +55,26 @@ MUST_PASS = [
     "bulk/20_list_of_strings.yml",
     "bulk/30_big_string.yml",
     "bulk/50_refresh.yml",
+    "cat.aliases/10_basic.yml",
     "cat.aliases/30_json.yml",
+    "cat.aliases/40_hidden.yml",
+    "cat.allocation/10_basic.yml",
+    "cat.count/10_basic.yml",
+    "cat.fielddata/10_basic.yml",
+    "cat.health/10_basic.yml",
+    "cat.indices/10_basic.yml",
+    "cat.indices/20_hidden.yml",
+    "cat.nodeattrs/10_basic.yml",
+    "cat.nodes/10_basic.yml",
+    "cat.plugins/10_basic.yml",
+    "cat.recovery/10_basic.yml",
+    "cat.repositories/10_basic.yml",
+    "cat.segments/10_basic.yml",
+    "cat.shards/10_basic.yml",
+    "cat.snapshots/10_basic.yml",
+    "cat.tasks/10_basic.yml",
+    "cat.templates/10_basic.yml",
+    "cat.thread_pool/10_basic.yml",
     "cluster.remote_info/10_info.yml",
     "cluster.reroute/10_basic.yml",
     "cluster.state/10_basic.yml",
@@ -71,6 +90,8 @@ MUST_PASS = [
     "exists/10_basic.yml",
     "exists/40_routing.yml",
     "exists/70_defaults.yml",
+    "field_caps/10_basic.yml",
+    "field_caps/20_meta.yml",
     "get/10_basic.yml",
     "get/15_default_values.yml",
     "get/40_routing.yml",
@@ -83,12 +104,17 @@ MUST_PASS = [
     "index/30_cas.yml",
     "index/40_routing.yml",
     "index/60_refresh.yml",
+    "indices.clone/20_source_mapping.yml",
     "indices.delete_alias/10_basic.yml",
     "indices.delete_alias/all_path_options.yml",
     "indices.exists/10_basic.yml",
     "indices.exists/20_read_only_index.yml",
     "indices.exists_alias/10_basic.yml",
     "indices.get_alias/20_empty.yml",
+    "indices.get_field_mapping/10_basic.yml",
+    "indices.get_field_mapping/20_missing_field.yml",
+    "indices.get_field_mapping/40_missing_index.yml",
+    "indices.get_field_mapping/50_field_wildcards.yml",
     "indices.get_mapping/10_basic.yml",
     "indices.get_mapping/40_aliases.yml",
     "indices.get_mapping/60_empty.yml",
@@ -101,13 +127,28 @@ MUST_PASS = [
     "indices.rollover/20_max_doc_condition.yml",
     "indices.rollover/30_max_size_condition.yml",
     "indices.rollover/40_mapping.yml",
+    "indices.split/20_source_mapping.yml",
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
+    "mget/10_basic.yml",
+    "mget/12_non_existent_index.yml",
+    "mget/13_missing_metadata.yml",
+    "mget/14_alias_to_multiple_indices.yml",
+    "mget/15_ids.yml",
+    "mget/17_default_index.yml",
+    "mget/20_stored_fields.yml",
+    "mget/40_routing.yml",
+    "mget/60_realtime_refresh.yml",
+    "mget/70_source_filtering.yml",
     "mlt/10_basic.yml",
     "msearch/11_status.yml",
     "ping/10_ping.yml",
     "range/10_basic.yml",
+    "search/200_index_phrase_search.yml",
+    "search/230_interval_query.yml",
+    "search/90_search_after.yml",
+    "search/issue4895.yml",
     "search.aggregation/100_avg_metric.yml",
     "search.aggregation/110_max_metric.yml",
     "search.aggregation/120_min_metric.yml",
@@ -118,10 +159,7 @@ MUST_PASS = [
     "search.aggregation/290_geotile_grid.yml",
     "search.aggregation/70_adjacency_matrix.yml",
     "search.aggregation/80_typed_keys.yml",
-    "search/200_index_phrase_search.yml",
-    "search/230_interval_query.yml",
-    "search/90_search_after.yml",
-    "search/issue4895.yml",
+    "snapshot.get_repository/10_basic.yml",
     "suggest/10_basic.yml",
     "suggest/20_completion.yml",
     "update/10_doc.yml",
