@@ -103,6 +103,11 @@ class VersionConflictError(SearchEngineError):
     status = 409
 
 
+class TooManyBucketsError(SearchEngineError):
+    """search.max_buckets exceeded (MultiBucketConsumerService)."""
+    status = 503
+
+
 class CircuitBreakingError(SearchEngineError):
     status = 429
 

@@ -157,7 +157,9 @@ def parse_date_millis(value: Any, round_up: bool = False) -> int:
         # partial date on a gt/lte bound fills missing fields to unit END
         # (DateMathParser roundUpProperty): "2014-11-18" -> 23:59:59.999
         return parse_date_millis(s) + 86_400_000 - 1
-    if re.fullmatch(r"-?\d{10,}", s):
+    if re.fullmatch(r"-?\d{5,}", s):
+        # epoch_millis claims any numeric string except bare 4-digit
+        # years, which strict_date_optional_time parses as yyyy
         return int(s)
     norm = s.replace("Z", "+0000")
     if re.search(r"[+-]\d{2}:\d{2}$", norm):
@@ -317,11 +319,23 @@ class BooleanFieldMapper(FieldMapper):
 class DateFieldMapper(FieldMapper):
     type_name = "date"
 
+    def _parse(self, value):
+        # an explicit epoch_second format scales numeric inputs
+        # (DateFormatters EpochSecond); everything else rides the default
+        # strict_date_optional_time||epoch_millis chain
+        fmt = str(self.params.get("format", ""))
+        if "epoch_second" in fmt:
+            try:
+                return int(float(value) * 1000)
+            except (TypeError, ValueError):
+                pass
+        return parse_date_millis(value)
+
     def index_terms(self, value):
-        return [str(parse_date_millis(value))]
+        return [str(self._parse(value))]
 
     def doc_value(self, value):
-        return parse_date_millis(value)
+        return self._parse(value)
 
 
 def parse_date_nanos(value: Any) -> int:

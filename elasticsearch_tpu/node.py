@@ -588,6 +588,18 @@ class Node:
         # finalized once after the reduce (agg_partials, the
         # InternalAggregation.reduce analog)
         aggs_spec = body.get("aggs") or body.get("aggregations")
+        if aggs_spec:
+            # builder-time validation (the reference rejects bad agg params
+            # at request parse, even when zero shards participate)
+            from elasticsearch_tpu.search.aggregations import validate_aggs
+
+            def _field_type(f):
+                for svc in services:
+                    m = svc.mapper_service.get(f)
+                    if m is not None:
+                        return m.type_name
+                return None
+            validate_aggs(aggs_spec, _field_type)
         use_partial_aggs = bool(aggs_spec) and len(readers) > 1
         all_hits = []
         total = 0
@@ -604,9 +616,11 @@ class Node:
                 result = None
                 if RequestCache.cacheable(body):
                     # partial vs finalized agg trees differ per request shape
-                    # (multi-index searches ship partials): key on it
+                    # (multi-index searches ship partials); max_buckets is
+                    # dynamic, so a changed limit must miss the cache
                     cache_key = self.caches.request.key(
-                        (svc.name, use_partial_aggs), reader.gen, body)
+                        (svc.name, use_partial_aggs, self._max_buckets()),
+                        reader.gen, body)
                     result = self.caches.request.get(cache_key)
                 if result is None:
                     from elasticsearch_tpu.common.settings import setting_bool
@@ -621,14 +635,16 @@ class Node:
                             vector_store=store,
                             partial_aggs=use_partial_aggs,
                             query_cache=self.caches.query,
-                            index_settings=svc.settings.as_flat_dict()).result()
+                            index_settings=svc.settings.as_flat_dict(),
+                            max_buckets=self._max_buckets()).result()
                     else:
                         result = execute_query_phase(
                             reader, svc.mapper_service, body,
                             vector_store=store,
                             partial_aggs=use_partial_aggs,
                             query_cache=self.caches.query,
-                            index_settings=svc.settings.as_flat_dict())
+                            index_settings=svc.settings.as_flat_dict(),
+                            max_buckets=self._max_buckets())
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
@@ -898,6 +914,18 @@ class Node:
         return {"tokens": tokens}
 
     # ----------------------------------------------------------------- stats
+    def _max_buckets(self) -> Optional[int]:
+        """search.max_buckets from cluster settings (transient wins over
+        persistent, like ClusterSettings precedence)."""
+        for scope in ("transient", "persistent"):
+            s = self.cluster_settings.get(scope, {})
+            v = s.get("search.max_buckets")
+            if v is None and isinstance(s.get("search"), dict):
+                v = s["search"].get("max_buckets")
+            if v is not None:
+                return int(v)
+        return None
+
     def cluster_health(self, index: Optional[str] = None) -> dict:
         """Single-node health: replicas can never assign, so a replicated
         index makes the cluster yellow (ClusterStateHealth semantics)."""

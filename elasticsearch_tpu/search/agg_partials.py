@@ -244,11 +244,17 @@ def _partial_spec(kind: str, spec: dict) -> dict:
     `shard_size` exactly like the reference (TermsAggregatorFactory:
     shard_size defaults to size*1.5+10) so a high-cardinality field does
     not ship its full term dictionary."""
-    if kind in ("terms", "significant_terms"):
+    if kind == "terms":
         s = {k: v for k, v in spec.items() if k != "order"}
         size = int(spec.get("size", 10))
         s["size"] = int(spec.get("shard_size") or (size * 3 // 2 + 10))
         return s
+    if kind in ("significant_terms", "significant_text"):
+        # ship unpruned candidates: the min_doc_count threshold and JLH
+        # ranking re-apply at the coordinator over merged fg/bg counts
+        size = int(spec.get("size", 10))
+        return {**spec, "min_doc_count": 1,
+                "size": int(spec.get("shard_size") or (size * 3 // 2 + 10))}
     if kind == "rare_terms":
         # unpruned counts (max_doc_count filter applies post-merge); the
         # shard_size cap bounds the rarest-candidates set per shard, the
@@ -497,6 +503,10 @@ def _merge_buckets(kind: str, a_bucket: dict, b_bucket: dict,
                    sub_spec: dict) -> dict:
     m = dict(a_bucket)
     m["doc_count"] = a_bucket.get("doc_count", 0) + b_bucket.get("doc_count", 0)
+    if "bg_count" in a_bucket or "bg_count" in b_bucket:
+        # significant buckets: background freqs sum; the score recomputes
+        # at finalize from the merged counts (SignificanceHeuristic)
+        m["bg_count"] = a_bucket.get("bg_count", 0) + b_bucket.get("bg_count", 0)
     a_subs = {n: a_bucket[n] for n in (sub_spec or {}) if n in a_bucket}
     b_subs = {n: b_bucket[n] for n in (sub_spec or {}) if n in b_bucket}
     m.update(merge_partial_aggs(a_subs, b_subs, sub_spec))
@@ -539,6 +549,9 @@ def _merge_bucket_agg(kind: str, spec: dict, a, b, sub_spec: dict):
     if "sum_other_doc_count" in out:
         out["sum_other_doc_count"] = (a.get("sum_other_doc_count", 0)
                                       + b.get("sum_other_doc_count", 0))
+    for k in ("doc_count", "bg_count"):  # significant_* totals
+        if k in a or k in b:
+            out[k] = a.get(k, 0) + b.get(k, 0)
     return out
 
 
@@ -845,7 +858,27 @@ def _finalize_bucket_agg(kind: str, spec: dict, node, sub_spec: dict):
     buckets = [_finalize_one_bucket(b, sub_spec)
                for b in node.get("buckets", [])]
 
-    if kind in ("terms", "significant_terms"):
+    if kind in ("significant_terms", "significant_text"):
+        size = int(spec.get("size", 10))
+        min_count = int(spec.get("min_doc_count", 3))
+        fg_total = int(node.get("doc_count", 0))
+        bg_total = int(node.get("bg_count", 0)) or fg_total
+        rescored = []
+        for b in buckets:
+            fg, bg = b.get("doc_count", 0), b.get("bg_count", 0)
+            if fg < min_count or bg == 0:
+                continue
+            fg_freq = fg / fg_total if fg_total else 0.0
+            bg_freq = bg / bg_total if bg_total else 0.0
+            if fg_freq <= bg_freq or bg_freq == 0:
+                continue
+            rescored.append({**b, "score":
+                             (fg_freq - bg_freq) * (fg_freq / bg_freq)})
+        rescored.sort(key=lambda b: (-b["score"], _sort_key(b["key"])))
+        return {"doc_count": fg_total, "bg_count": bg_total,
+                "buckets": rescored[:size]}
+
+    if kind == "terms":
         size = int(spec.get("size", 10))
         order_spec = spec.get("order")
         if order_spec and isinstance(order_spec, dict):

@@ -127,7 +127,8 @@ _NUMERIC_ONLY_METRICS = {
 }
 
 
-def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) -> Any:
+def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
+                   name: str = "") -> Any:
     if kind in _NUMERIC_ONLY_METRICS:
         mapper = ctx.mapper_service.get(spec.get("field", "")) \
             if spec.get("field") else None
@@ -162,7 +163,11 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
     if kind == "value_count":
         if field is None:
             return {"value": len(rows)}
-        return {"value": len(all_values(ctx, rows, field))}
+        values = all_values(ctx, rows, field)
+        count = len(values)
+        if missing is not None:
+            count += len(rows) - len({i for i, _ in values})
+        return {"value": count}
 
     if kind in ("geo_bounds", "geo_centroid"):
         pts = _gather_geo_points(ctx, rows, field)
@@ -181,8 +186,16 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
                 "count": len(pts)}
 
     if kind == "cardinality":
+        pt = spec.get("precision_threshold")
+        if pt is not None and int(pt) < 0:
+            raise IllegalArgumentError(
+                f"[precisionThreshold] must be greater than or equal to 0. "
+                f"Found [{int(pt)}] in [{name}]")
         values = all_values(ctx, rows, field)
-        return {"value": len({_hashable(v) for _, v in values})}
+        distinct = {_hashable(v) for _, v in values}
+        if missing is not None and len({i for i, _ in values}) < len(rows):
+            distinct.add(_hashable(missing))
+        return {"value": len(distinct)}
 
     if script is not None and field is None:
         from elasticsearch_tpu.search.script_score import Script
@@ -206,7 +219,12 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
     if kind == "stats":
         return _metric_stats(vals, present)
     if kind == "extended_stats":
-        return _extended_stats(vals, present, float(spec.get("sigma", 2.0)))
+        sigma = float(spec.get("sigma", 2.0))
+        if sigma < 0:
+            raise IllegalArgumentError(
+                f"[sigma] must be greater than or equal to 0. "
+                f"Found [{sigma}] in [{name}]")
+        return _extended_stats(vals, present, sigma)
     if kind == "median_absolute_deviation":
         v = vals[present]
         if len(v) == 0:
@@ -215,10 +233,22 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict) 
         return {"value": float(np.median(np.abs(v - med)))}
     if kind == "percentiles":
         pcts = spec.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        tdigest = spec.get("tdigest")
+        if tdigest is not None and "compression" in tdigest:
+            comp = float(tdigest["compression"] or 0)
+            if comp < 0:
+                raise IllegalArgumentError(
+                    f"[compression] must be greater than or equal to 0. "
+                    f"Found [{comp}] in [{name}]")
         v = np.sort(vals[present])
         hdr = spec.get("hdr")
         if hdr is not None:
-            digits = int(hdr.get("number_of_significant_value_digits", 3))
+            raw_digits = hdr.get("number_of_significant_value_digits", 3)
+            try:
+                digits = int(raw_digits)
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    "[numberOfSignificantValueDigits] must be between 0 and 5")
             if not 0 <= digits <= 5:
                 raise IllegalArgumentError(
                     "[numberOfSignificantValueDigits] must be between 0 and 5")
@@ -392,6 +422,54 @@ def compute_matrix_stats(ctx: SearchContext, rows: np.ndarray,
     return {"doc_count": n, "fields": out_fields}
 
 
+def _mix64(k: int) -> int:
+    """hppc BitMixer.mix64 (David Stafford mix13 variant) — the
+    reference's PartitionedLongFilter hash; returns a SIGNED 64-bit value
+    so that Python's % matches Java's Math.floorMod."""
+    m = 0xFFFFFFFFFFFFFFFF
+    k &= m
+    k = ((k ^ (k >> 32)) * 0x4CD6944C5CC20B6D) & m
+    k = ((k ^ (k >> 29)) * 0xFC12C5B19D3259E9) & m
+    k = k ^ (k >> 32)
+    return k - (1 << 64) if k >= (1 << 63) else k
+
+
+def _murmur3_x86_32(data: bytes, seed: int) -> int:
+    """Lucene StringHelper.murmurhash3_x86_32 (signed int32 result) — the
+    reference's PartitionedStringFilter hash (IncludeExclude seed 31)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h1 = seed & 0xFFFFFFFF
+    rounded = len(data) & ~3
+    for i in range(0, rounded, 4):
+        k1 = (data[i] | (data[i + 1] << 8) | (data[i + 2] << 16)
+              | (data[i + 3] << 24))
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+        h1 = ((h1 << 13) | (h1 >> 19)) & 0xFFFFFFFF
+        h1 = (h1 * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k1 = 0
+    tail = len(data) & 3
+    if tail == 3:
+        k1 ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k1 ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k1 ^= data[rounded]
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+    h1 ^= len(data)
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1 - (1 << 32) if h1 >= (1 << 31) else h1
+
+
 def _hashable(v):
     return tuple(v) if isinstance(v, (list, tuple)) else v
 
@@ -402,7 +480,8 @@ def _hashable(v):
 
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "date_range",
                "filters", "filter", "missing", "global", "composite",
-               "significant_terms", "rare_terms", "sampler", "ip_range",
+               "significant_terms", "significant_text", "rare_terms",
+               "sampler", "ip_range",
                "auto_date_histogram", "adjacency_matrix", "geohash_grid",
                "geotile_grid"}
 METRIC_AGGS = {"avg", "sum", "min", "max", "stats", "extended_stats", "value_count",
@@ -414,6 +493,135 @@ PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
                  "stats_bucket", "extended_stats_bucket", "percentiles_bucket",
                  "derivative", "cumulative_sum", "bucket_script",
                  "bucket_selector", "bucket_sort", "serial_diff", "moving_fn"}
+
+
+def _parse_float_param(spec: dict, key: str, default: float,
+                       agg_name: str) -> float:
+    raw = spec.get(key, default)
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise ParsingError(
+            f"x_content_parse_exception: [{key}] failed to parse value "
+            f"[{raw}] in [{agg_name}]")
+
+
+def _parse_int_param(spec: dict, key: str, default: int,
+                     agg_name: str) -> int:
+    raw = spec.get(key, default)
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise ParsingError(
+            f"x_content_parse_exception: [{key}] failed to parse value "
+            f"[{raw}] in [{agg_name}]")
+
+
+def validate_aggs(aggs_spec: dict, field_type=None) -> None:
+    """Builder-time parameter validation, applied before any shard work
+    (reference: each AggregationBuilder validates in its constructor /
+    parse, so errors surface even for zero-shard searches).
+    `field_type(field) -> type_name or None` enables mapper-aware checks."""
+    for name, spec in (aggs_spec or {}).items():
+        if not isinstance(spec, dict):
+            raise ParsingError(f"aggregation [{name}] must be an object")
+        sub = spec.get("aggs") or spec.get("aggregations") or {}
+        for kind, body in spec.items():
+            if kind in ("aggs", "aggregations", "meta") \
+                    or not isinstance(body, dict):
+                continue
+            if kind == "extended_stats":
+                sigma = _parse_float_param(body, "sigma", 2.0, name)
+                if sigma < 0:
+                    raise IllegalArgumentError(
+                        f"[sigma] must be greater than or equal to 0. "
+                        f"Found [{sigma}] in [{name}]")
+            if kind == "cardinality" and "precision_threshold" in body:
+                pt = _parse_int_param(body, "precision_threshold", 0, name)
+                if pt < 0:
+                    raise IllegalArgumentError(
+                        f"[precisionThreshold] must be greater than or "
+                        f"equal to 0. Found [{pt}] in [{name}]")
+            if kind == "percentiles":
+                td = body.get("tdigest")
+                if isinstance(td, dict) and "compression" in td:
+                    comp = _parse_float_param(td, "compression", 100.0, name)
+                    if comp < 0:
+                        raise IllegalArgumentError(
+                            f"[compression] must be greater than or equal "
+                            f"to 0. Found [{comp}] in [{name}]")
+                if "percents" in body:
+                    pc = body["percents"]
+                    if not isinstance(pc, list) or not pc:
+                        raise IllegalArgumentError(
+                            "[percents] must not be empty")
+                    for p in pc:
+                        try:
+                            fp = float(p)
+                        except (TypeError, ValueError):
+                            raise ParsingError(
+                                f"x_content_parse_exception: [percents] "
+                                f"failed to parse [{p}]")
+                        if not 0.0 <= fp <= 100.0:
+                            raise IllegalArgumentError(
+                                f"percent must be in [0,100], got [{fp}]")
+                hdr = body.get("hdr")
+                if isinstance(hdr, dict):
+                    raw = hdr.get("number_of_significant_value_digits", 3)
+                    try:
+                        digits = int(raw)
+                    except (TypeError, ValueError):
+                        raise IllegalArgumentError(
+                            "[numberOfSignificantValueDigits] must be "
+                            "between 0 and 5")
+                    if not 0 <= digits <= 5:
+                        raise IllegalArgumentError(
+                            "[numberOfSignificantValueDigits] must be "
+                            "between 0 and 5")
+            if kind == "median_absolute_deviation" \
+                    and "compression" in body:
+                comp = _parse_float_param(body, "compression", 1000.0, name)
+                if comp <= 0:
+                    raise IllegalArgumentError(
+                        f"[compression] must be greater than 0. "
+                        f"Found [{comp}] in [{name}]")
+            if kind == "moving_fn":
+                window = _parse_int_param(body, "window", 5, name) \
+                    if body.get("window") is not None else 5
+                if window <= 0:
+                    raise IllegalArgumentError(
+                        "[window] must be a positive, non-zero integer.")
+            if kind == "filters" and not body.get("filters"):
+                raise IllegalArgumentError("[filters] cannot be empty")
+            if kind in ("significant_terms", "significant_text"):
+                import difflib
+                for k in body:
+                    if k not in _SIG_KNOWN_FIELDS:
+                        close = difflib.get_close_matches(
+                            k, _SIG_KNOWN_FIELDS, n=1)
+                        hint = f" did you mean [{close[0]}]?" if close else ""
+                        raise ParsingError(
+                            f"[{kind}] unknown field [{k}]{hint}")
+            if kind in ("terms", "significant_terms", "significant_text",
+                        "rare_terms"):
+                inc, exc = body.get("include"), body.get("exclude")
+                field = body.get("field", "")
+                # regex include/exclude only applies to string fields; the
+                # non-string check here mirrors ValuesSourceType guards for
+                # the obvious field-name cases (ip/date/numeric suites)
+                if isinstance(inc, str) or isinstance(exc, str):
+                    tname = field_type(field) if field_type else None
+                    if tname is not None and tname not in (
+                            "keyword", "text", "wildcard",
+                            "constant_keyword"):
+                        raise IllegalArgumentError(
+                            f"Aggregation [{name}] cannot support regular "
+                            f"expression style include/exclude settings as "
+                            f"they can only be applied to string fields. "
+                            f"Use an array of values for include/exclude "
+                            f"clauses")
+        if sub:
+            validate_aggs(sub, field_type)
 
 
 def compute_aggs(ctx: SearchContext, rows: np.ndarray, aggs_spec: dict) -> dict:
@@ -432,7 +640,7 @@ def compute_aggs(ctx: SearchContext, rows: np.ndarray, aggs_spec: dict) -> dict:
             pipelines.append((name, kind, spec[kind]))
             continue
         if kind in METRIC_AGGS:
-            out[name] = compute_metric(ctx, rows, kind, spec[kind])
+            out[name] = compute_metric(ctx, rows, kind, spec[kind], name=name)
         elif kind in BUCKET_AGGS or kind == "nested":
             # parent pipelines (cumulative_sum/derivative/... declared as
             # sub-aggs) run over the parent's bucket list after it's built
@@ -580,6 +788,8 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
 
     if kind == "filters":
         filters = spec.get("filters", {})
+        if not filters:
+            raise IllegalArgumentError("[filters] cannot be empty")
         named = isinstance(filters, dict)
         items = filters.items() if named else enumerate(filters)
         buckets = {} if named else []
@@ -603,17 +813,28 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         return b
 
     if kind == "missing":
-        vals = [ctx.reader.get_doc_value(field, int(r)) for r in rows]
-        brows = rows[[v is None for v in vals]]
+        if spec.get("missing") is not None:
+            # a missing-value substitute means no doc is ever missing
+            brows = rows[:0]
+        else:
+            vals = [ctx.reader.get_doc_value(field, int(r)) for r in rows]
+            brows = rows[[v is None for v in vals]]
         b = {"doc_count": int(len(brows))}
         if sub_aggs:
             b.update(recurse(ctx, brows, sub_aggs))
         return b
 
-    if kind in ("terms", "significant_terms", "rare_terms"):
+    if kind in ("significant_terms", "significant_text"):
+        return _compute_significant(ctx, rows, kind, spec, sub_aggs,
+                                    recurse)
+
+    if kind in ("terms", "rare_terms"):
         size = int(spec.get("size", 10))
         tname = getattr(ctx.mapper_service.get(field), "type_name", None) \
             if field else None
+        # an unmapped field aggregates under the caller-declared value_type
+        # (ValuesSourceConfig.resolve with a user value type)
+        tname = tname or spec.get("value_type")
 
         def fmt_key(k):
             if tname == "ip":
@@ -625,20 +846,81 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
             return k
 
         values = all_values(ctx, rows, field)
+        missing_val = spec.get("missing")
+        if missing_val is not None:
+            # docs without the field bucket under the missing key, coerced
+            # per the effective type (terms `missing` param)
+            mv = missing_val
+            if tname in ("date", "date_nanos") and isinstance(mv, str):
+                try:
+                    mv = parse_date_millis(mv)
+                except Exception:
+                    pass
+            elif tname in ("long", "integer", "short", "byte"):
+                try:
+                    mv = int(mv)
+                except (TypeError, ValueError):
+                    raise ParsingError(
+                        f"failed to parse [missing] value [{mv}] as a long")
+            elif tname in ("double", "float", "half_float"):
+                try:
+                    mv = float(mv)
+                except (TypeError, ValueError):
+                    raise ParsingError(
+                        f"failed to parse [missing] value [{mv}] as a double")
+            have = {i for i, _ in values}
+            values = values + [(i, mv) for i in range(len(rows))
+                               if i not in have]
         groups: Dict[Any, List[int]] = {}
         for idx, v in values:
             groups.setdefault(_hashable(v), []).append(idx)
-        # include/exclude term filtering (IncludeExclude): exact-value lists
-        # or a regex, matched against the formatted key
+        # include/exclude term filtering (IncludeExclude): exact-value lists,
+        # a regex, or a {partition, num_partitions} hash partition
         inc, exc = spec.get("include"), spec.get("exclude")
+        if isinstance(inc, dict):
+            if exc is not None:
+                raise IllegalArgumentError(
+                    "Cannot specify any excludes when using a "
+                    "partition-based include")
+            part = int(inc.get("partition", 0))
+            n_part = int(inc.get("num_partitions", 1))
+
+            def _in_partition(k):
+                if isinstance(k, bool):
+                    h = _mix64(1 if k else 0)
+                elif isinstance(k, (int, float)) and not isinstance(k, bool):
+                    h = _mix64(int(k))
+                else:
+                    h = _murmur3_x86_32(str(k).encode("utf-8"), 31)
+                return h % n_part == part  # Math.floorMod semantics
+            groups = {k: i for k, i in groups.items() if _in_partition(k)}
+            inc = None
         if inc is not None or exc is not None:
+            def _coerce_list(entries):
+                # list entries compare in the field's keyspace: date
+                # strings parse to millis (DocValueFormat round-trip)
+                out = set()
+                for x in entries:
+                    if tname in ("date", "date_nanos"):
+                        try:
+                            out.add(str(parse_date_millis(x)))
+                            continue
+                        except Exception:
+                            pass
+                    out.add(str(x))
+                return out
+            inc_set = _coerce_list(inc) if isinstance(inc, list) else None
+            exc_set = _coerce_list(exc) if isinstance(exc, list) else None
+
             def _passes(k):
                 ks = str(fmt_key(k))
-                if isinstance(inc, list) and ks not in {str(x) for x in inc}:
+                if isinstance(k, float) and k == int(k):
+                    ks = str(int(k))
+                if inc_set is not None and ks not in inc_set:
                     return False
                 if isinstance(inc, str) and not re.fullmatch(inc, ks):
                     return False
-                if isinstance(exc, list) and ks in {str(x) for x in exc}:
+                if exc_set is not None and ks in exc_set:
                     return False
                 if isinstance(exc, str) and re.fullmatch(exc, ks):
                     return False
@@ -670,6 +952,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         else:
             items.sort(key=lambda kv: (-len(kv[1]), _sort_key(kv[0])))
         total_other = sum(len(i) for _, i in items[size:])
+        _check_max_buckets(ctx, min(len(items), size))
         buckets = _bucketize(ctx, rows, sub_aggs,
                              [(k, rows[i]) for k, i in items[:size]],
                              recurse=recurse)
@@ -697,28 +980,39 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         min_count = int(spec.get("min_doc_count", 0))
         vals, present = numeric_values(ctx, rows, field, spec.get("missing"))
         keys = np.floor((vals - offset) / interval) * interval + offset
-        return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
-                              spec.get("extended_bounds"), interval,
-                              recurse=recurse)
+        out = _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
+                             spec.get("extended_bounds"), interval,
+                             recurse=recurse)
+        fmt = spec.get("format")
+        if fmt:
+            for b in out["buckets"]:
+                b["key_as_string"] = _decimal_format(b["key"], fmt)
+        return out
 
     if kind == "date_histogram":
         interval_ms, calendar = _date_interval(spec)
         min_count = int(spec.get("min_doc_count", 0))
+        mapper = ctx.mapper_service.get(field)
+        from elasticsearch_tpu.index.mapping import RangeFieldMapperBase
+        if isinstance(mapper, RangeFieldMapperBase):
+            return _range_field_histo(ctx, rows, sub_aggs, spec, field,
+                                      recurse=recurse)
         vals, present = numeric_values(ctx, rows, field)
-        if getattr(ctx.mapper_service.get(field), "type_name", None) \
-                == "date_nanos":
+        if getattr(mapper, "type_name", None) == "date_nanos":
             vals = vals / 1e6  # stored nanos; histogram buckets in millis
         offset_ms = _date_offset_ms(spec.get("offset"))
+        tz = _resolve_tz(spec.get("time_zone"))
         if calendar:
             keys = np.asarray(
-                [_calendar_floor(int(v - offset_ms), calendar) + offset_ms
+                [_calendar_floor(int(v - offset_ms), calendar, tz) + offset_ms
                  if p else np.nan
                  for v, p in zip(vals, present)], dtype=np.float64)
         else:
             keys = np.floor((vals - offset_ms) / interval_ms) * interval_ms \
                 + offset_ms
         return _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
-                              None, interval_ms, date=True, recurse=recurse)
+                              None, interval_ms, date=True, recurse=recurse,
+                              fmt=spec.get("format"), tz=tz)
 
     if kind == "auto_date_histogram":
         target = int(spec.get("buckets", 10))
@@ -742,9 +1036,21 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
     if kind in ("range", "date_range", "ip_range"):
         ranges = spec.get("ranges", [])
         vals, present = numeric_values(ctx, rows, field, spec.get("missing"))
+        mapper = ctx.mapper_service.get(field) if field else None
+        date_fmt = (mapper.params.get("format", "")
+                    if mapper is not None else "")
         if kind == "date_range":
             def conv(x):
-                return float(parse_date_millis(x)) if x is not None else None
+                if x is None:
+                    return None
+                if "epoch_second" in str(date_fmt):
+                    # bounds parse with the field's format: numbers (and
+                    # numeric strings) are seconds
+                    try:
+                        return float(x) * 1000.0
+                    except (TypeError, ValueError):
+                        pass
+                return float(parse_date_millis(x))
         elif kind == "ip_range":
             def conv(x):
                 from elasticsearch_tpu.index.mapping import IpFieldMapper
@@ -752,10 +1058,32 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         else:
             def conv(x):
                 return float(x) if x is not None else None
+
+        def render_bound(x, numeric):
+            # key/from/to rendering per value source (RangeAggregator's
+            # DocValueFormat): doubles as "50.0", ips as addresses, dates
+            # keep the caller's raw input in the key
+            if kind == "ip_range":
+                from elasticsearch_tpu.index.mapping import IpFieldMapper
+                return IpFieldMapper.format_value(int(numeric))
+            if kind == "date_range":
+                return numeric
+            return float(numeric)
+
         buckets = []
         for r in ranges:
-            frm = conv(r.get("from"))
-            to = conv(r.get("to"))
+            cidr = r.get("mask")
+            if cidr is not None and kind == "ip_range":
+                import ipaddress
+                net = ipaddress.ip_network(cidr, strict=False)
+                lo = net.network_address
+                if lo.version == 4:
+                    lo = ipaddress.IPv6Address("::ffff:" + str(lo))
+                frm = float(int(lo))
+                to = frm + float(net.num_addresses)
+            else:
+                frm = conv(r.get("from"))
+                to = conv(r.get("to"))
             mask = present.copy()
             if frm is not None:
                 mask &= vals >= frm
@@ -763,16 +1091,29 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 mask &= vals < to
             brows = rows[mask]
             key = r.get("key")
+            if key is None and cidr is not None:
+                key = cidr
             if key is None:
-                key = f"{r.get('from', '*')}-{r.get('to', '*')}"
+                lo_s = "*" if frm is None else \
+                    (str(r.get("from")) if kind == "date_range"
+                     else render_bound(r.get("from"), frm))
+                hi_s = "*" if to is None else \
+                    (str(r.get("to")) if kind == "date_range"
+                     else render_bound(r.get("to"), to))
+                key = f"{lo_s}-{hi_s}"
             b = {"key": key, "doc_count": int(len(brows))}
             if frm is not None:
-                b["from"] = frm
+                b["from"] = render_bound(r.get("from"), frm)
             if to is not None:
-                b["to"] = to
+                b["to"] = render_bound(r.get("to"), to)
             if sub_aggs:
                 b.update(recurse(ctx, brows, sub_aggs))
+            b["_sort"] = (frm if frm is not None else -np.inf,
+                          to if to is not None else np.inf)
             buckets.append(b)
+        # RangeAggregator emits buckets ordered by (from, to), not in the
+        # order the caller listed them
+        buckets.sort(key=lambda b: b.pop("_sort"))
         return {"buckets": buckets}
 
     if kind == "sampler":
@@ -881,8 +1222,6 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                     # a formatted after_key round-trips: parse it back into
                     # the internal millis domain before comparing
                     try:
-                        from elasticsearch_tpu.index.mapping import (
-                            parse_date_millis)
                         v = float(parse_date_millis(v))
                     except Exception:
                         pass
@@ -949,6 +1288,205 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
     raise ParsingError(f"unknown bucket aggregation [{kind}]")
 
 
+_SIG_KNOWN_FIELDS = ["field", "size", "shard_size", "min_doc_count",
+                     "shard_min_doc_count", "background_filter", "include",
+                     "exclude", "execution_hint", "jlh", "gnd", "chi_square",
+                     "mutual_information", "percentage", "script_heuristic",
+                     "filter_duplicate_text", "source_fields", "missing"]
+
+
+def _compute_significant(ctx, rows, kind, spec, sub_aggs, recurse) -> dict:
+    """significant_terms / significant_text (reference:
+    SignificantTermsAggregatorFactory + SignificantTextAggregator): JLH
+    scoring of foreground vs background term frequencies; significant_text
+    re-analyzes _source with optional duplicate-sequence filtering
+    (DeDuplicatingTokenFilter)."""
+    field = spec.get("field")
+    size = int(spec.get("size", 10))
+    min_count = int(spec.get("min_doc_count", 3))
+    mapper = ctx.mapper_service.get(field)
+    analyzed = kind == "significant_text" \
+        or getattr(mapper, "type_name", None) == "text"
+    dedup = bool(spec.get("filter_duplicate_text"))
+
+    def _terms_per_doc(doc_rows, use_dedup=False):
+        """row -> set(terms), with cross-doc 6-gram dedup when asked."""
+        seen_shingles: set = set()
+        out = {}
+        for row in doc_rows:
+            if analyzed:
+                src = ctx.reader.get_source(int(row)) or {}
+                node = src
+                for part in str(field).split("."):
+                    node = node.get(part) if isinstance(node, dict) else None
+                vals = node if isinstance(node, list) else [node]
+                tokens: List[str] = []
+                for v in vals:
+                    if v is None:
+                        continue
+                    if mapper is not None and hasattr(mapper, "analyze"):
+                        tokens.extend(mapper.analyze(str(v)))
+                    else:
+                        tokens.extend(str(v).lower().split())
+                if use_dedup and len(tokens) >= 6:
+                    dup = [False] * len(tokens)
+                    for p in range(len(tokens) - 5):
+                        if tuple(tokens[p:p + 6]) in seen_shingles:
+                            for q in range(p, p + 6):
+                                dup[q] = True
+                    for p in range(len(tokens) - 5):
+                        seen_shingles.add(tuple(tokens[p:p + 6]))
+                    tokens = [t for t, d in zip(tokens, dup) if not d]
+                out[int(row)] = set(tokens)
+            else:
+                v = ctx.reader.get_doc_value(field, int(row))
+                vals = v if isinstance(v, list) else ([v] if v is not None else [])
+                out[int(row)] = {_hashable(x) for x in vals}
+        return out
+
+    fg_terms = _terms_per_doc([int(r) for r in rows], use_dedup=dedup)
+    fg_total = len(rows)
+    fg_count: Dict[Any, int] = {}
+    fg_rows_by_term: Dict[Any, List[int]] = {}
+    for row, terms in fg_terms.items():
+        for t in terms:
+            fg_count[t] = fg_count.get(t, 0) + 1
+            fg_rows_by_term.setdefault(t, []).append(row)
+    # background frequencies depend only on the index, not the bucket:
+    # memoize per (field, analyzed) so nesting under a terms agg doesn't
+    # re-analyze the whole index once per parent bucket
+    bg_cache = ctx.__dict__.setdefault("_sig_bg_cache", {})
+    bg_key = (str(field), analyzed)
+    if bg_key in bg_cache:
+        bg_count, bg_total = bg_cache[bg_key]
+    else:
+        bg_rows = ctx.all_rows()
+        bg_total = len(bg_rows)
+        bg_count = {}
+        for terms in _terms_per_doc([int(r) for r in bg_rows]).values():
+            for t in terms:
+                bg_count[t] = bg_count.get(t, 0) + 1
+        bg_cache[bg_key] = (bg_count, bg_total)
+    scored = []
+    for t, fg in fg_count.items():
+        if fg < min_count:
+            continue
+        bg = bg_count.get(t, fg)
+        fg_freq = fg / fg_total if fg_total else 0.0
+        bg_freq = bg / bg_total if bg_total else 0.0
+        if fg_freq <= bg_freq or bg_freq == 0:
+            continue
+        score = (fg_freq - bg_freq) * (fg_freq / bg_freq)  # JLH
+        scored.append((score, t, fg, bg))
+    scored.sort(key=lambda x: (-x[0], _sort_key(x[1])))
+    tname = getattr(mapper, "type_name", None)
+    buckets = []
+    for score, t, fg, bg in scored[:size]:
+        key = t
+        if tname == "ip" and isinstance(t, (int, float)):
+            from elasticsearch_tpu.index.mapping import IpFieldMapper
+            key = IpFieldMapper.format_value(int(t))
+        b = {"key": key, "doc_count": fg, "score": score, "bg_count": bg}
+        if tname == "date" and isinstance(t, (int, float)):
+            b["key_as_string"] = _millis_to_iso(int(t))
+        if sub_aggs:
+            brows = np.asarray(sorted(set(fg_rows_by_term[t])),
+                               dtype=np.int64)
+            b.update(recurse(ctx, brows, sub_aggs))
+        buckets.append(b)
+    return {"doc_count": fg_total, "bg_count": bg_total, "buckets": buckets}
+
+
+def _range_field_histo(ctx, rows, sub_aggs, spec, field, recurse=None) -> dict:
+    """date_histogram over a date_range field: every doc counts in EVERY
+    bucket its range overlaps (reference: RangeHistogramAggregator)."""
+    recurse = recurse or compute_aggs
+    interval_ms, calendar = _date_interval(spec)
+    offset_ms = _date_offset_ms(spec.get("offset"))
+    tz = _resolve_tz(spec.get("time_zone"))
+    fmt = spec.get("format")
+    groups: Dict[float, List[int]] = {}
+    for i, row in enumerate(rows):
+        v = ctx.reader.get_doc_value(field, int(row))
+        if isinstance(v, list):
+            v = v[0] if v else None
+        if not isinstance(v, dict):
+            continue
+        lo = float(v.get("gte", np.nan))
+        hi = float(v.get("lte", np.nan))
+        if not (np.isfinite(lo) and np.isfinite(hi)):
+            continue
+
+        def floor_of(ms):
+            if calendar:
+                return _calendar_floor(int(ms - offset_ms), calendar) \
+                    + offset_ms
+            return float(np.floor((ms - offset_ms) / interval_ms)
+                         * interval_ms + offset_ms)
+        cur = floor_of(lo)
+        end = floor_of(hi)
+        guard = 0
+        while cur <= end and guard < 100_000:
+            groups.setdefault(float(cur), []).append(int(row))
+            guard += 1
+            if calendar:
+                # advance to the next calendar bucket: probe forward until
+                # the floor moves (calendar units are variable-length)
+                step = cur + interval_ms / 2
+                while floor_of(step) <= cur and guard < 100_000:
+                    step += 86_400_000
+                    guard += 1
+                cur = floor_of(step)
+            else:
+                cur += interval_ms
+    buckets = []
+    _check_max_buckets(ctx, len(groups))
+    for key in sorted(groups):
+        brows = np.asarray(sorted(set(groups[key])), dtype=np.int64)
+        b = {"key": int(key), "doc_count": int(len(brows)),
+             "key_as_string": _format_date_key(int(key), fmt, tz) if fmt
+             else _millis_to_iso_tz(int(key), tz)}
+        if sub_aggs:
+            b.update(recurse(ctx, brows, sub_aggs))
+        buckets.append(b)
+    return {"buckets": buckets}
+
+
+def _decimal_format(value, pattern: str) -> str:
+    """Minimal Java DecimalFormat: literal prefix/suffix around a #/0 run
+    with optional fraction digits ("Value is ##0.0" -> "Value is 51.0")."""
+    m = re.search(r"[#0][#0,.]*", pattern)
+    if not m:
+        return pattern
+    num = m.group(0)
+    prefix, suffix = pattern[:m.start()], pattern[m.end():]
+    if "." in num:
+        frac = num.split(".", 1)[1]
+        min_frac, max_frac = frac.count("0"), len(frac)
+    else:
+        min_frac = max_frac = 0
+    v = float(value)
+    if max_frac == 0:
+        s = str(int(round(v)))
+    else:
+        s = f"{v:.{max_frac}f}"
+        int_part, frac_part = s.split(".")
+        frac_part = frac_part.rstrip("0").ljust(min_frac, "0")
+        s = int_part + ("." + frac_part if frac_part else "")
+    return prefix + s + suffix
+
+
+def _check_max_buckets(ctx, n: int) -> None:
+    """search.max_buckets guard (MultiBucketConsumerService)."""
+    mx = getattr(ctx, "max_buckets", None)
+    if mx is not None and n > mx:
+        from elasticsearch_tpu.common.errors import TooManyBucketsError
+        raise TooManyBucketsError(
+            f"Trying to create too many buckets. Must be less than or "
+            f"equal to: [{mx}] but was [{n}]. This limit can be set by "
+            f"changing the [search.max_buckets] cluster level setting.")
+
+
 def _sort_key(v):
     if v is None:
         return (2, "")
@@ -963,7 +1501,8 @@ MAX_BUCKETS = 65536  # reference: search.max_buckets default
 
 
 def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
-                   extended_bounds, interval, date=False, recurse=None) -> dict:
+                   extended_bounds, interval, date=False, recurse=None,
+                   fmt=None, tz=None) -> dict:
     recurse = recurse or compute_aggs
     groups: Dict[float, np.ndarray] = {}
     valid = present & ~np.isnan(keys)
@@ -997,6 +1536,7 @@ def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
             full.append(round(cur, 10))
             cur += interval
         all_keys = full
+    _check_max_buckets(ctx, len(all_keys))
     buckets = []
     for key in all_keys:
         brows = groups.get(key, np.zeros(0, dtype=np.int64))
@@ -1004,7 +1544,8 @@ def _histo_buckets(ctx, rows, sub_aggs, keys, present, min_count,
             continue
         b = {"key": int(key) if date else key, "doc_count": int(len(brows))}
         if date:
-            b["key_as_string"] = _millis_to_iso(int(key))
+            b["key_as_string"] = _format_date_key(int(key), fmt, tz) if fmt \
+                else _millis_to_iso_tz(int(key), tz)
         if sub_aggs:
             b.update(recurse(ctx, brows, sub_aggs))
         buckets.append(b)
@@ -1039,18 +1580,58 @@ def _date_interval(spec: dict) -> Tuple[float, Optional[str]]:
     raise ParsingError(f"unknown interval [{fixed}]")
 
 
-def _format_date_key(millis: int, fmt: str) -> str:
-    """Joda-pattern-lite date rendering for agg keys ("yyyy-MM-dd",
-    "iso8601", "strict_date_time", epoch_millis)."""
-    if fmt in ("iso8601", "strict_date_time", "date_time"):
+def _resolve_tz(tz_spec):
+    """time_zone param -> tzinfo: fixed offsets ("-07:00") or IANA names
+    (America/Phoenix) via zoneinfo."""
+    import datetime as dt
+    if not tz_spec:
+        return None
+    s = str(tz_spec)
+    m = re.fullmatch(r"([+-])(\d{2}):?(\d{2})", s)
+    if m:
+        sign = 1 if m.group(1) == "+" else -1
+        return dt.timezone(sign * dt.timedelta(hours=int(m.group(2)),
+                                               minutes=int(m.group(3))))
+    try:
+        import zoneinfo
+        return zoneinfo.ZoneInfo(s)
+    except Exception:
+        return None
+
+
+def _millis_to_iso_tz(millis: int, tz) -> str:
+    """ISO rendering in a zone with its offset suffix
+    ("2015-12-31T17:00:00.000-07:00"); UTC renders with Z."""
+    import datetime as dt
+    if tz is None:
         return _millis_to_iso(millis)
+    d = dt.datetime.fromtimestamp(millis / 1000.0, tz=tz)
+    base = d.strftime("%Y-%m-%dT%H:%M:%S") + f".{d.microsecond // 1000:03d}"
+    off = d.utcoffset() or dt.timedelta(0)
+    if off == dt.timedelta(0):
+        return base + "Z"
+    total = int(off.total_seconds())
+    sign = "+" if total >= 0 else "-"
+    total = abs(total)
+    return base + f"{sign}{total // 3600:02d}:{(total % 3600) // 60:02d}"
+
+
+def _format_date_key(millis: int, fmt: str, tz=None) -> str:
+    """Joda-pattern-lite date rendering for agg keys ("yyyy-MM-dd",
+    "iso8601", "strict_date_time", epoch_millis, "e" day-of-week)."""
+    if fmt in ("iso8601", "strict_date_time", "date_time"):
+        return _millis_to_iso_tz(millis, tz) if tz else _millis_to_iso(millis)
     if fmt == "epoch_millis":
         return str(millis)
     import datetime as dt
     try:
-        d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+        d = dt.datetime.fromtimestamp(millis / 1000.0,
+                                      tz=tz or dt.timezone.utc)
     except (OverflowError, OSError, ValueError):
         return str(millis)
+    if fmt == "e":
+        # Joda dayOfWeek number (ISO: Monday=1 .. Sunday=7)
+        return str(d.isoweekday())
     strf = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
             .replace("dd", "%d").replace("HH", "%H").replace("mm", "%M")
             .replace("ss", "%S"))
@@ -1078,9 +1659,12 @@ def _date_offset_ms(offset) -> float:
         return 0.0
 
 
-def _calendar_floor(millis: int, unit: str) -> float:
+def _calendar_floor(millis: int, unit: str, tz=None) -> float:
+    """Floor to a calendar unit, in `tz`'s local wall time when given
+    (Rounding.Builder timeZone semantics — buckets align to local
+    midnight/month starts, not UTC)."""
     import datetime as dt
-    d = dt.datetime.fromtimestamp(millis / 1000.0, tz=dt.timezone.utc)
+    d = dt.datetime.fromtimestamp(millis / 1000.0, tz=tz or dt.timezone.utc)
     if unit == "T":
         d = d.replace(second=0, microsecond=0)
     elif unit == "H":
@@ -1195,6 +1779,9 @@ def _compute_pipeline(outputs: dict, kind: str, spec: dict, name: str = "") -> A
         return {"_applied": True}
     if kind == "moving_fn":
         window = int(spec.get("window", 5))
+        if window <= 0:
+            raise IllegalArgumentError(
+                "[window] must be a positive, non-zero integer.")
         for i, b in enumerate(buckets):
             win = [v for v in values[max(0, i - window):i] if v is not None]
             b.setdefault(name, {})["value"] = (sum(win) / len(win)) if win else None

@@ -73,6 +73,9 @@ class SearchContext:
         # node query cache (search/caches.py): filter-context row arrays
         # keyed on (reader gen, filter source); None disables caching
         self.query_cache = query_cache
+        # search.max_buckets cluster setting (MultiBucketConsumerService);
+        # None = unlimited, set by the search entry from cluster settings
+        self.max_buckets: Optional[int] = None
 
     def all_rows(self) -> np.ndarray:
         if self._all_rows is None:

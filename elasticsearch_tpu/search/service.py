@@ -100,10 +100,12 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         vector_store=None,
                         partial_aggs: bool = False,
                         query_cache=None,
-                        index_settings: Optional[dict] = None) -> ShardSearchResult:
+                        index_settings: Optional[dict] = None,
+                        max_buckets: Optional[int] = None) -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
     ctx.index_settings = index_settings or {}
+    ctx.max_buckets = max_buckets
     _check_request_limits(body, ctx.index_settings)
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
