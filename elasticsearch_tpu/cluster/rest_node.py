@@ -34,6 +34,14 @@ from elasticsearch_tpu.common.errors import (
 from elasticsearch_tpu.node import Node
 
 
+def _empty_search_response() -> dict:
+    return {"took": 0, "timed_out": False,
+            "_shards": {"total": 0, "successful": 0, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": 0, "relation": "eq"},
+                     "max_score": None, "hits": []}}
+
+
 class ClusterCallError(SearchEngineError):
     status = 503
 
@@ -382,7 +390,17 @@ class ClusterAwareNode(Node):
 
     # --------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict],
-               ignore_throttled: bool = True) -> dict:
+               ignore_throttled: bool = True,
+               ignore_unavailable: bool = False) -> dict:
+        if ignore_unavailable and index_expr:
+            # lenientExpandOpen: drop concrete names absent from cluster
+            # metadata before the scatter
+            meta = self.cluster.cluster_state.metadata
+            kept = [p.strip() for p in index_expr.split(",")
+                    if "*" in p or p.strip() in meta]
+            if not kept:
+                return _empty_search_response()
+            index_expr = ",".join(kept)
         resp = self._call(self.cluster.client_search, index_expr,
                           dict(body or {}))
         self.counters["search"] += 1

@@ -1319,6 +1319,9 @@ class MapperService:
                 parsed.doc_values.setdefault("_ignored", [])
                 if path not in parsed.doc_values["_ignored"]:
                     parsed.doc_values["_ignored"].append(path)
+                    # _ignored is searchable (term/terms/exists) like the
+                    # reference's IgnoredFieldMapper metadata field
+                    parsed.terms.setdefault("_ignored", []).append(path)
                 continue
             for sub_name, sub in self._multi_fields.get(path, {}).items():
                 self._index_one(f"{path}.{sub_name}", sub, v, parsed)

@@ -17,8 +17,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from elasticsearch_tpu.common.errors import (
-    DocumentMissingError, IllegalArgumentError, SearchEngineError,
-    VersionConflictError,
+    DocumentMissingError, IllegalArgumentError, IndexNotFoundError,
+    SearchEngineError, VersionConflictError,
 )
 from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY
 from elasticsearch_tpu.indices.service import (
@@ -547,7 +547,8 @@ class Node:
 
     # ---------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict],
-               ignore_throttled: bool = True) -> dict:
+               ignore_throttled: bool = True,
+               ignore_unavailable: bool = False) -> dict:
         body = body or {}
         rank = body.get("rank")
         if isinstance(rank, dict) and "rrf" in rank:
@@ -562,7 +563,23 @@ class Node:
             local_resp = self.search(local_expr, body) if local_expr else None
             return merge_ccs_responses(local_resp, remote_resps, body)
         start = time.perf_counter()
-        services = self.indices.resolve_open(index_expr)
+        body = self._rewrite_terms_lookup(body)
+        if ignore_unavailable and index_expr:
+            # IndicesOptions.lenientExpandOpen: missing/closed concrete
+            # names silently drop from the target set
+            kept = []
+            for part in index_expr.split(","):
+                part = part.strip()
+                try:
+                    for svc in self.indices.resolve(part):
+                        if not svc.closed:
+                            kept.append(svc.name)
+                except SearchEngineError:
+                    continue
+            services = self.indices.resolve_open(",".join(kept)) \
+                if kept else []
+        else:
+            services = self.indices.resolve_open(index_expr)
         if ignore_throttled:
             # frozen indices sit out of normal searches unless the caller
             # passes ignore_throttled=false (reference:
@@ -587,6 +604,21 @@ class Node:
         # index the aggs travel as mergeable partial states and are
         # finalized once after the reduce (agg_partials, the
         # InternalAggregation.reduce analog)
+        # indices_boost: per-index score multipliers, resolved up front so
+        # unknown names fail the request (SearchRequest#indicesBoost)
+        boosts: Dict[str, float] = {}
+        ib = body.get("indices_boost")
+        if ib:
+            entries = ib.items() if isinstance(ib, dict) else \
+                [e for d in ib for e in d.items()]
+            for expr, boost in entries:
+                matched = self.indices.resolve(expr, expand_hidden=True) \
+                    if ("*" in expr or self.indices.exists(expr)) else []
+                if not matched:
+                    raise IndexNotFoundError(expr)
+                for svc in matched:
+                    boosts.setdefault(svc.name, float(boost))
+
         aggs_spec = body.get("aggs") or body.get("aggregations")
         if aggs_spec:
             # builder-time validation (the reference rejects bad agg params
@@ -619,7 +651,8 @@ class Node:
                     # (multi-index searches ship partials); max_buckets is
                     # dynamic, so a changed limit must miss the cache
                     cache_key = self.caches.request.key(
-                        (svc.name, use_partial_aggs, self._max_buckets()),
+                        (svc.name, use_partial_aggs, self._max_buckets(),
+                         self._allow_expensive()),
                         reader.gen, body)
                     result = self.caches.request.get(cache_key)
                 if result is None:
@@ -636,7 +669,8 @@ class Node:
                             partial_aggs=use_partial_aggs,
                             query_cache=self.caches.query,
                             index_settings=svc.settings.as_flat_dict(),
-                            max_buckets=self._max_buckets()).result()
+                            max_buckets=self._max_buckets(),
+                            allow_expensive=self._allow_expensive()).result()
                     else:
                         result = execute_query_phase(
                             reader, svc.mapper_service, body,
@@ -644,22 +678,27 @@ class Node:
                             partial_aggs=use_partial_aggs,
                             query_cache=self.caches.query,
                             index_settings=svc.settings.as_flat_dict(),
-                            max_buckets=self._max_buckets())
+                            max_buckets=self._max_buckets(),
+                            allow_expensive=self._allow_expensive())
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
                 total += result.total_hits
                 if result.total_relation == "gte":
                     relation = "gte"
+                factor = boosts.get(svc.name, 1.0)
                 if result.max_score is not None:
-                    max_score = max(max_score or -1e30, result.max_score)
+                    max_score = max(max_score or -1e30,
+                                    result.max_score * factor)
                 f_start = time.perf_counter_ns()
                 hits = execute_fetch_phase(reader, svc.mapper_service, body,
                                            result, index_name=svc.name)
                 f_nanos = time.perf_counter_ns() - f_start
                 for h, score, sv in zip(hits, result.scores,
                                         result.sort_values or [None] * len(hits)):
-                    all_hits.append((h, float(score), sv))
+                    if factor != 1.0 and h.get("_score") is not None:
+                        h["_score"] = float(h["_score"]) * factor
+                    all_hits.append((h, float(score) * factor, sv))
                 if result.aggregations is not None:
                     if merged_aggs is None:
                         merged_aggs = result.aggregations
@@ -714,6 +753,12 @@ class Node:
                 "hits": [h for h, _, _ in window],
             },
         }
+        brs = body.get("batched_reduce_size")
+        n_sh = resp["_shards"]["total"]
+        if brs and int(brs) < n_sh:
+            # phases: one partial reduce per filled buffer + the final
+            # reduce (QueryPhaseResultConsumer counting)
+            resp["num_reduce_phases"] = -(-n_sh // int(brs)) + 1
         if body.get("track_total_hits") is False:
             # hit counting disabled: no total in the response (RestSearchAction)
             del resp["hits"]["total"]
@@ -762,7 +807,7 @@ class Node:
                             ignore_throttled: bool = True) -> dict:
         """Initial search with ?scroll=: snapshot all matching docs in order,
         return the first page + a scroll id."""
-        body = dict(body or {})
+        body = self._rewrite_terms_lookup(dict(body or {}))
         if body.get("collapse") is not None:
             raise IllegalArgumentError(
                 "cannot use `collapse` in a scroll context")
@@ -863,7 +908,7 @@ class Node:
                          "max_score": None, "hits": hits}}
 
     def count(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
-        body = dict(body or {})
+        body = self._rewrite_terms_lookup(dict(body or {}))
         body["size"] = 0
         body.pop("sort", None)
         total = 0
@@ -914,17 +959,63 @@ class Node:
         return {"tokens": tokens}
 
     # ----------------------------------------------------------------- stats
-    def _max_buckets(self) -> Optional[int]:
-        """search.max_buckets from cluster settings (transient wins over
-        persistent, like ClusterSettings precedence)."""
+    def _rewrite_terms_lookup(self, body: dict) -> dict:
+        """Coordinator rewrite of terms-lookup clauses: fetch the source
+        doc ONCE and inline its values (reference:
+        TermsQueryBuilder.doRewrite + GetRequest on the coordinator)."""
+        q = (body or {}).get("query")
+        if not q or "terms" not in str(q):
+            return body
+        import copy as _copy
+        from elasticsearch_tpu.search.service import _get_path
+        body = dict(body)
+        body["query"] = _copy.deepcopy(q)
+
+        def walk(node):
+            if isinstance(node, dict):
+                t = node.get("terms")
+                if isinstance(t, dict):
+                    for f, v in list(t.items()):
+                        if f in ("boost", "_name") or not isinstance(v, dict):
+                            continue
+                        if "index" not in v:
+                            continue
+                        doc = self.get_doc(v["index"], str(v.get("id")),
+                                           routing=v.get("routing"))
+                        vals = _get_path(doc.get("_source") or {},
+                                         str(v.get("path", "")))
+                        t[f] = (vals if isinstance(vals, list)
+                                else [vals] if vals is not None else [])
+                for val in node.values():
+                    walk(val)
+            elif isinstance(node, list):
+                for item in node:
+                    walk(item)
+        walk(body["query"])
+        return body
+
+    def _cluster_setting(self, key: str):
+        """Dynamic cluster setting lookup, transient before persistent
+        (ClusterSettings precedence); accepts flat or nested storage."""
         for scope in ("transient", "persistent"):
             s = self.cluster_settings.get(scope, {})
-            v = s.get("search.max_buckets")
-            if v is None and isinstance(s.get("search"), dict):
-                v = s["search"].get("max_buckets")
+            v = s.get(key)
+            if v is None:
+                node = s
+                for part in key.split("."):
+                    node = node.get(part) if isinstance(node, dict) else None
+                v = node
             if v is not None:
-                return int(v)
+                return v
         return None
+
+    def _allow_expensive(self) -> bool:
+        v = self._cluster_setting("search.allow_expensive_queries")
+        return v is None or str(v).lower() != "false"
+
+    def _max_buckets(self) -> Optional[int]:
+        v = self._cluster_setting("search.max_buckets")
+        return int(v) if v is not None else None
 
     def cluster_health(self, index: Optional[str] = None) -> dict:
         """Single-node health: replicas can never assign, so a replicated

@@ -273,6 +273,30 @@ def register_all(rc: RestController, node: Node) -> None:
         dvf = req.param("docvalue_fields")
         if dvf:
             body["docvalue_fields"] = dvf.split(",")
+        if req.param("seq_no_primary_term") in ("true", "", True):
+            body["seq_no_primary_term"] = True
+        if req.param("version") in ("true", "", True):
+            body["version"] = True
+        st = req.param("search_type")
+        if st in ("query_and_fetch", "dfs_query_and_fetch"):
+            raise IllegalArgumentError(
+                f"Unsupported search type [{st}]")
+        brs = req.int_param("batched_reduce_size")
+        if brs is None and body.get("batched_reduce_size") is not None:
+            brs = int(body["batched_reduce_size"])
+        if brs is not None:
+            if brs < 2:
+                raise IllegalArgumentError("batchedReduceSize must be >= 2")
+            body["batched_reduce_size"] = brs
+        pfss = req.int_param("pre_filter_shard_size")
+        if pfss is not None and pfss < 1:
+            raise IllegalArgumentError("preFilterShardSize must be >= 1")
+        if req.bool_param("rest_total_hits_as_int", False):
+            tt = body.get("track_total_hits")
+            if isinstance(tt, int) and not isinstance(tt, bool):
+                raise IllegalArgumentError(
+                    f"[rest_total_hits_as_int] cannot be used if the "
+                    f"tracking of total hits is not accurate, got {tt}")
         scroll = req.param("scroll")
         if scroll:
             if req.param("request_cache") is not None:
@@ -287,7 +311,9 @@ def register_all(rc: RestController, node: Node) -> None:
         else:
             resp = node.search(req.params.get("index"), body,
                                ignore_throttled=req.bool_param(
-                                   "ignore_throttled", True))
+                                   "ignore_throttled", True),
+                               ignore_unavailable=req.bool_param(
+                                   "ignore_unavailable", False))
         if req.bool_param("rest_total_hits_as_int", False):
             _total_hits_as_int(resp)
         if req.bool_param("typed_keys", False):

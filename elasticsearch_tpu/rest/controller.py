@@ -159,7 +159,11 @@ class RestController:
                 short = f(req)
                 if short is not None:
                     return short
-            return handler(req)
+            status, resp = handler(req)
+            fp = query.get("filter_path")
+            if fp and isinstance(resp, (dict, list)):
+                resp = filter_path_apply(resp, str(fp))
+            return status, resp
         except SearchEngineError as e:
             return e.status, {"error": e.to_wrapped_dict(),
                               "status": e.status}
@@ -167,6 +171,100 @@ class RestController:
             tb = traceback.format_exc(limit=5)
             return 500, _error_body("internal_server_error",
                                     f"{type(e).__name__}: {e}", 500, stack_trace=tb)
+
+
+def filter_path_apply(resp, spec: str):
+    """Response filtering (reference: common/xcontent/support/filtering/
+    FilterPath): comma-separated dotted patterns with * and ** wildcards;
+    leading '-' patterns exclude instead."""
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    includes = [p for p in parts if not p.startswith("-")]
+    excludes = [p[1:] for p in parts if p.startswith("-")]
+
+    def match_steps(steps, obj, build):
+        # returns filtered copy of obj containing only matching paths
+        if not steps:
+            return obj
+        step, rest = steps[0], steps[1:]
+        if isinstance(obj, list):
+            out = []
+            for item in obj:
+                r = match_steps(steps, item, build)
+                if r is not _SKIP:
+                    out.append(r)
+            return out if out else _SKIP
+        if not isinstance(obj, dict):
+            return _SKIP
+        out = {}
+        for k, v in obj.items():
+            import fnmatch
+            if step == "**":
+                # '**' matches any number of segments: try consuming it
+                # here or matching the rest at this level
+                r = match_steps(rest, {k: v}, build)
+                if isinstance(r, dict):
+                    out.update(r)
+                    continue
+                r = match_steps(steps, v, build)
+                if r is not _SKIP:
+                    out[k] = r
+            elif fnmatch.fnmatchcase(str(k), step):
+                r = match_steps(rest, v, build) if rest else v
+                if r is not _SKIP:
+                    out[k] = r
+            # non-matching keys drop
+        return out if out else _SKIP
+
+    def exclude_steps(steps, obj):
+        if not steps or not isinstance(obj, (dict, list)):
+            return obj
+        if isinstance(obj, list):
+            return [exclude_steps(steps, item) for item in obj]
+        step, rest = steps[0], steps[1:]
+        import fnmatch
+        out = {}
+        for k, v in obj.items():
+            if fnmatch.fnmatchcase(str(k), step):
+                if not rest:
+                    continue  # excluded leaf
+                out[k] = exclude_steps(rest, v)
+            else:
+                out[k] = v
+        return out
+
+    out = resp
+    if includes:
+        merged = _SKIP
+        for p in includes:
+            r = match_steps(p.split("."), resp, None)
+            if r is _SKIP:
+                continue
+            merged = r if merged is _SKIP else _deep_merge(merged, r)
+        out = merged if merged is not _SKIP else ({} if isinstance(resp, dict) else [])
+    for p in excludes:
+        out = exclude_steps(p.split("."), out)
+    return out
+
+
+_SKIP = object()
+
+
+def _deep_merge(a, b):
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = _deep_merge(out[k], v) if k in out else v
+        return out
+    if isinstance(a, list) and isinstance(b, list):
+        # element-wise merge keeps hit objects aligned across patterns
+        out = []
+        for i in range(max(len(a), len(b))):
+            if i < len(a) and i < len(b):
+                out.append(_deep_merge(a[i], b[i]))
+            else:
+                out.append(a[i] if i < len(a) else b[i])
+        return out
+    return a
 
 
 def _error_body(err_type: str, reason: str, status: int, **extra) -> dict:

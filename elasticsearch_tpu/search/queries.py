@@ -221,12 +221,25 @@ class TermQuery(Query):
 
 
 class TermsQuery(Query):
-    def __init__(self, field: str, values: List[Any], boost: float = 1.0):
+    def __init__(self, field: str, values: List[Any], boost: float = 1.0,
+                 user_supplied: bool = False):
         self.field = field
         self.values = values
         self.boost = boost
+        # index.max_terms_count bounds only caller-provided term arrays;
+        # internal multi-term rewrites (prefix/wildcard/regexp expansion)
+        # are governed by max_clause_count in the reference
+        self.user_supplied = user_supplied
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        max_terms = int(getattr(ctx, "index_settings", {})
+                        .get("index.max_terms_count", 65536))
+        if self.user_supplied and len(self.values) > max_terms:
+            raise IllegalArgumentError(
+                f"The number of terms [{len(self.values)}] used in the "
+                f"Terms Query request has exceeded the allowed maximum "
+                f"of [{max_terms}]. This maximum can be set by changing "
+                f"the [index.max_terms_count] index level setting.")
         if self.field == "_id":
             rows = _id_rows(ctx, self.values)
             return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
@@ -594,6 +607,15 @@ def _pattern_terms(ctx: SearchContext, field: str, predicate) -> List[str]:
     return sorted(seen)
 
 
+def _check_expensive(ctx: SearchContext, qtype: str, extra: str = "") -> None:
+    """search.allow_expensive_queries gate (QueryShardContext
+    allowExpensiveQueries)."""
+    if getattr(ctx, "allow_expensive", True) is False:
+        raise IllegalArgumentError(
+            f"[{qtype}] queries cannot be executed when "
+            f"'search.allow_expensive_queries' is set to false.{extra}")
+
+
 class PrefixQuery(Query):
     def __init__(self, field: str, value: str, boost: float = 1.0):
         self.field = field
@@ -601,6 +623,9 @@ class PrefixQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "prefix",
+                         " For optimised prefix queries on text fields "
+                         "please enable [index_prefixes].")
         terms = _pattern_terms(ctx, self.field, lambda t: t.startswith(self.value))
         return TermsQuery(self.field, terms, self.boost).execute(ctx) if terms else DocSet.empty()
 
@@ -615,6 +640,7 @@ class WildcardQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "wildcard")
         pattern = re.compile(
             "^" + "".join(".*" if c == "*" else "." if c == "?" else re.escape(c)
                           for c in self.value) + "$")
@@ -632,13 +658,15 @@ class RegexpQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "regexp")
         max_len = int(getattr(ctx, "index_settings", {}).get(
             "index.max_regex_length", 1000))
         if len(self.value) > max_len:
             raise IllegalArgumentError(
                 f"The length of regex [{len(self.value)}] used in the "
                 f"Regexp Query request has exceeded the allowed maximum "
-                f"of [{max_len}]")
+                f"of [{max_len}]. This maximum can be set by changing the "
+                f"[index.max_regex_length] index level setting.")
         try:
             pattern = re.compile("^" + self.value + "$")
         except re.error as e:
@@ -684,6 +712,7 @@ class FuzzyQuery(Query):
         self.boost = boost
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "fuzzy")
         terms = _fuzzy_expand(ctx, self.field, self.value, self.fuzziness)
         if not terms:
             return DocSet.empty()
@@ -1248,7 +1277,7 @@ def parse_query(body: Optional[dict]) -> Query:
         field, values = _single(spec, "terms")
         if not isinstance(values, list):
             raise ParsingError("[terms] query requires an array of values")
-        return TermsQuery(field, values, boost)
+        return TermsQuery(field, values, boost, user_supplied=True)
     if kind == "match":
         field, v = _single(spec, "match")
         if isinstance(v, dict):

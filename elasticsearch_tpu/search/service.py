@@ -37,9 +37,51 @@ MAX_RESULT_WINDOW = 10_000
 TRACK_TOTAL_HITS_DEFAULT = 10_000
 
 
+_SEARCH_BODY_KEYS = {
+    "query", "from", "size", "sort", "track_total_hits", "track_scores",
+    "aggs", "aggregations", "post_filter", "highlight", "_source", "fields",
+    "docvalue_fields", "stored_fields", "script_fields", "suggest",
+    "rescore", "explain", "version", "seq_no_primary_term", "min_score",
+    "search_after", "collapse", "profile", "timeout", "terminate_after",
+    "indices_boost", "knn", "rank", "pit", "runtime_mappings", "slice",
+    "ext", "stats", "point_in_time", "batched_reduce_size",
+    "pre_filter_shard_size", "scroll", "max_concurrent_shard_requests",
+}
+
+
 def _check_request_limits(body: dict, settings: dict) -> None:
     """Per-index request guardrails (IndexSettings MAX_* settings +
     SearchService validation): reject before any work happens."""
+    for key in body:
+        if key not in _SEARCH_BODY_KEYS and not key.startswith("__"):
+            # dunder keys are internal coordinator annotations
+            raise ParsingError(
+                f"unknown key [{key}] for a search request body "
+                f"(SearchSourceBuilder)")
+    tth = body.get("track_total_hits")
+    if isinstance(tth, int) and not isinstance(tth, bool) \
+            and tth < 0 and tth != -1:
+        raise IllegalArgumentError(
+            f"[track_total_hits] parameter must be positive or equals "
+            f"to -1, got {tth}")
+    if body.get("collapse") is not None:
+        if body.get("rescore") is not None:
+            raise IllegalArgumentError(
+                "cannot use `collapse` in conjunction with `rescore`")
+        inner = (body["collapse"] or {}).get("inner_hits")
+        inner_list = inner if isinstance(inner, list) else \
+            [inner] if inner else []
+        for ih in inner_list:
+            # a second-level collapse inside inner_hits is legal; IT may
+            # not define inner_hits or a third collapse (CollapseBuilder)
+            nested = (ih or {}).get("collapse") if isinstance(ih, dict) \
+                else None
+            if isinstance(nested, dict) and (
+                    nested.get("inner_hits") is not None
+                    or nested.get("collapse") is not None):
+                raise ParsingError(
+                    "parse_exception: [collapse] inner collapse cannot "
+                    "define inner_hits or another collapse")
     frm = body.get("from")
     if frm is not None and int(frm) < 0:
         raise IllegalArgumentError("[from] parameter cannot be negative")
@@ -101,11 +143,13 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         partial_aggs: bool = False,
                         query_cache=None,
                         index_settings: Optional[dict] = None,
-                        max_buckets: Optional[int] = None) -> ShardSearchResult:
+                        max_buckets: Optional[int] = None,
+                        allow_expensive: bool = True) -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
     ctx.index_settings = index_settings or {}
     ctx.max_buckets = max_buckets
+    ctx.allow_expensive = allow_expensive
     _check_request_limits(body, ctx.index_settings)
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
@@ -474,23 +518,61 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
     sort_spec = _normalize_sort(body.get("sort"))
     explain = bool(body.get("explain", False))
 
+    # stored_fields (FetchPhase/StoredFieldsContext): [] keeps metadata but
+    # drops _source; "_none_" drops metadata too; a field list loads
+    # store:true fields and suppresses _source unless asked for
+    stored_spec = body.get("stored_fields")
+    want_id = True
+    stored_list: List[str] = []
+    if stored_spec is not None:
+        if stored_spec == "_none_":
+            want_id = False
+            want_source = False
+        else:
+            stored_list = ([stored_spec] if isinstance(stored_spec, str)
+                           else list(stored_spec))
+            if "_source" not in stored_list:
+                want_source = False
+
     hits = []
     for i in range(from_offset, len(result.rows)):
         row = int(result.rows[i])
         hit: Dict[str, Any] = {
             "_index": index_name,
-            "_id": reader.get_id(row),
             "_score": None if sort_spec is not None else float(result.scores[i]),
         }
+        if want_id:
+            hit["_id"] = reader.get_id(row)
         if sort_spec is not None and result.sort_values is not None:
             hit["sort"] = list(result.sort_values[i])
         if body.get("seq_no_primary_term"):
             hit["_seq_no"] = reader.get_seq_no(row)
             pt = reader.get_doc_value("_primary_term", row)
             hit["_primary_term"] = int(pt) if pt is not None else 1
+        if body.get("version"):
+            v = reader.get_doc_value("_version", row)
+            hit["_version"] = int(v) if v is not None else 1
+        if stored_list:
+            sf = {}
+            src_for_fields = reader.get_source(row) or {}
+            for fname in stored_list:
+                if fname.startswith("_"):
+                    continue
+                mapper = mapper_service.get(fname)
+                if mapper is None or not mapper.params.get("store"):
+                    continue
+                val = _get_path(src_for_fields, fname)
+                if val is not None:
+                    sf[fname] = val if isinstance(val, list) else [val]
+            if sf:
+                hit["fields"] = sf
         routing = reader.get_doc_value("_routing", row)
         if routing is not None:
             hit["_routing"] = routing
+        ignored = reader.get_doc_value("_ignored", row)
+        if ignored:
+            hit["_ignored"] = sorted(ignored) \
+                if isinstance(ignored, list) else [ignored]
         if want_source:
             src = reader.get_source(row) or {}
             hit["_source"] = _filter_source(src, includes, excludes)
@@ -498,11 +580,14 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
             fields = {}
             for f in docvalue_fields:
                 fname = f["field"] if isinstance(f, dict) else f
+                fmt = f.get("format") if isinstance(f, dict) else None
                 v = reader.get_doc_value(fname, row)
                 if v is not None:
-                    fields[fname] = v if isinstance(v, list) else [v]
+                    vals = v if isinstance(v, list) else [v]
+                    fields[fname] = [_format_doc_value(
+                        x, mapper_service.get(fname), fmt) for x in vals]
             if fields:
-                hit["fields"] = fields
+                hit.setdefault("fields", {}).update(fields)
         if script_fields:
             from elasticsearch_tpu.search.script_score import Script
             sf = hit.setdefault("fields", {})
@@ -623,6 +708,38 @@ def _highlight(ctx, mapper_service, body, spec, row) -> Dict[str, List[str]]:
             frag = frag[:start] + pre + frag[start:end] + post + frag[end:]
         out[field] = [frag]
     return out
+
+
+def _format_doc_value(v, mapper, fmt):
+    """DocValueFormat rendering for docvalue_fields: dates render as ISO
+    strings (or per the requested joda pattern), numerics honor
+    DecimalFormat patterns like '#.0', everything else passes through."""
+    tname = getattr(mapper, "type_name", None)
+    if tname in ("date", "date_nanos") and isinstance(v, (int, float)):
+        from elasticsearch_tpu.search.aggregations import (
+            _format_date_key, _millis_to_iso)
+        millis = int(v) // 1_000_000 if tname == "date_nanos" else int(v)
+        if fmt == "epoch_millis":
+            if tname == "date_nanos":
+                nanos = int(v)
+                return f"{nanos // 1_000_000}.{nanos % 1_000_000:06d}"
+            return str(int(v))
+        if fmt and fmt not in ("strict_date_optional_time",):
+            return _format_date_key(millis, fmt)
+        if tname == "date_nanos":
+            nanos = int(v)
+            frac = nanos % 1_000_000_000
+            import datetime as _dt
+            base = _dt.datetime.fromtimestamp(
+                nanos // 1_000_000_000, _dt.timezone.utc)
+            return base.strftime("%Y-%m-%dT%H:%M:%S") \
+                + f".{frac:09d}".rstrip("0").ljust(2, "0") + "Z"
+        return _millis_to_iso(millis)
+    if fmt and isinstance(v, (int, float)) and not isinstance(v, bool) \
+            and any(c in fmt for c in "#0"):
+        from elasticsearch_tpu.search.aggregations import _decimal_format
+        return _decimal_format(v, fmt)
+    return v
 
 
 def _get_path(obj: dict, path: str):
