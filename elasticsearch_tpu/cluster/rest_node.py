@@ -397,7 +397,8 @@ class ClusterAwareNode(Node):
             # metadata before the scatter
             meta = self.cluster.cluster_state.metadata
             kept = [p.strip() for p in index_expr.split(",")
-                    if "*" in p or p.strip() in meta]
+                    if "*" in p or p.strip() in ("_all", "")
+                    or p.strip() in meta]
             if not kept:
                 return _empty_search_response()
             index_expr = ",".join(kept)

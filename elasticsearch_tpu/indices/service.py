@@ -57,6 +57,22 @@ class IndexShardHandle:
         self.engine.close()
 
 
+def _reject_translog_retention(settings: dict) -> None:
+    """index.translog.retention.* was removed in 8.0 (soft deletes own
+    history retention — IndexSettings.TRANSLOG_RETENTION checks)."""
+    def _walk(d, prefix=""):
+        for k, v in (d or {}).items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                _walk(v, path + ".")
+            elif path.replace("index.", "", 1).startswith(
+                    "translog.retention."):
+                raise IllegalArgumentError(
+                    f"Translog retention setting [{path}] is no longer "
+                    f"supported; history is retained by soft deletes")
+    _walk(settings)
+
+
 class IndexService:
     def __init__(self, name: str, path: str, settings: Settings, mapping: dict,
                  uuid: str):
@@ -100,6 +116,7 @@ class IndexService:
         """Apply dynamic index-setting updates (reference:
         MetaDataUpdateSettingsService — dynamic settings only; static ones
         like number_of_shards are rejected)."""
+        _reject_translog_retention(updates)
         for key in updates:
             if key in ("index.number_of_shards", "index.uuid"):
                 raise IllegalArgumentError(
@@ -252,6 +269,7 @@ class IndicesService:
         flat.put("index.number_of_shards", 1)
         flat.put("index.number_of_replicas", 1)
         if settings:
+            _reject_translog_retention(settings)
             # normalize every key under the index. namespace — bodies mix
             # bare keys with a nested "index" object freely
             norm = {}

@@ -122,6 +122,9 @@ class Node:
         self.thread_pool = ThreadPool(settings or {})
         self.search_slow_log = SlowLog("search")
         self.indexing_slow_log = SlowLog("indexing")
+        # per-group search counters (SearchRequest `stats` tags ->
+        # SearchStats groupStats)
+        self._search_groups: Dict[str, int] = {}
         self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
                                          "bulk": 0, "delete": 0}
         # cluster-level persistent/transient settings (_cluster/settings API)
@@ -716,6 +719,9 @@ class Node:
         finally:
             self.breakers.release("request", breaker_bytes)
         self.counters["search"] += 1
+        for g in body.get("stats") or []:
+            self._search_groups[str(g)] = \
+                self._search_groups.get(str(g), 0) + 1
 
         sort_spec = body.get("sort")
         if sort_spec:
@@ -759,8 +765,10 @@ class Node:
             # phases: one partial reduce per filled buffer + the final
             # reduce (QueryPhaseResultConsumer counting)
             resp["num_reduce_phases"] = -(-n_sh // int(brs)) + 1
-        if body.get("track_total_hits") is False:
-            # hit counting disabled: no total in the response (RestSearchAction)
+        if body.get("track_total_hits") is False \
+                or body.get("track_total_hits") == -1:
+            # hit counting disabled (false or the -1 sentinel): no total
+            # in the response (RestSearchAction)
             del resp["hits"]["total"]
         else:
             track = body.get("track_total_hits")
@@ -1039,68 +1047,191 @@ class Node:
                 (shards / total * 100.0) if total else 100.0,
         }
 
-    _STATS_METRICS = ("docs", "store", "indexing", "get", "search", "merge",
-                      "refresh", "flush", "segments", "translog",
-                      "query_cache", "request_cache", "fielddata",
-                      "completion", "warmer", "recovery")
+    # metric flag -> response section key (RestIndicesStatsAction METRICS;
+    # the `merge` flag renders as `merges`)
+    _STATS_METRIC_TO_SECTION = {
+        "docs": "docs", "store": "store", "indexing": "indexing",
+        "get": "get", "search": "search", "merge": "merges",
+        "refresh": "refresh", "flush": "flush", "warmer": "warmer",
+        "query_cache": "query_cache", "fielddata": "fielddata",
+        "completion": "completion", "segments": "segments",
+        "translog": "translog", "request_cache": "request_cache",
+        "recovery": "recovery", "bulk": "bulk",
+    }
+
+    @staticmethod
+    def _fielddata_bytes(shard_list, field: str) -> int:
+        """On-demand fielddata size estimate: the inverted doc-values the
+        reference builds lazily for text fielddata (terms + entries)."""
+        total = 0
+        for shard in shard_list:
+            reader = shard.engine.acquire_searcher()
+            for view in reader.views:
+                postings = view.segment.postings.get(field) or {}
+                for term, p in postings.items():
+                    total += len(str(term)) * 2 + 8 * p.doc_freq
+        return total
 
     def index_stats(self, name: Optional[str] = None,
-                    metrics: Optional[List[str]] = None) -> dict:
+                    metrics: Optional[List[str]] = None,
+                    level: str = "indices",
+                    fields: Optional[str] = None,
+                    fielddata_fields: Optional[str] = None,
+                    completion_fields: Optional[str] = None,
+                    groups: Optional[str] = None,
+                    include_segment_file_sizes: bool = False,
+                    include_unloaded_segments: bool = False,
+                    forbid_closed_indices: bool = True,
+                    expand_hidden: bool = False) -> dict:
         """`GET [/{index}]/_stats[/{metric}]` (IndicesStatsAction):
-        per-index stat sections with metric filtering; `_shards.total`
-        counts primaries + configured replicas, `successful` the shards
-        actually running here."""
-        services = self.indices.resolve(name)
+        per-index stat sections with metric filtering, level=cluster/
+        indices/shards, fields/groups breakdowns; `_shards.total` counts
+        primaries + configured replicas."""
+        import difflib as _difflib
+        import fnmatch as _fn
         if metrics and not any(m in ("_all", "*") for m in metrics):
-            keep = set(metrics)
+            keep = set()
+            for m in metrics:
+                section = self._STATS_METRIC_TO_SECTION.get(m)
+                if section is None:
+                    close = _difflib.get_close_matches(
+                        m, self._STATS_METRIC_TO_SECTION, n=1)
+                    hint = f" -> did you mean [{close[0]}]?" if close else ""
+                    raise IllegalArgumentError(
+                        f"request [/_stats/{m}] contains unrecognized "
+                        f"metric: [{m}]{hint}")
+                keep.add(section)
         else:
-            keep = set(self._STATS_METRICS)
+            keep = set(self._STATS_METRIC_TO_SECTION.values())
+
+        services = list(self.indices.resolve(name,
+                                             expand_hidden=expand_hidden))
+        if not forbid_closed_indices:
+            have = {s.name for s in services}
+            services += [s for s in self.indices.indices.values()
+                         if s.closed and s.name not in have]
+        else:
+            services = [s for s in services if not s.closed]
+
+        def _match_any(field, patterns):
+            return any(_fn.fnmatchcase(field, p.strip())
+                       for p in str(patterns).split(","))
 
         import os as _os
 
-        def shard_sections(svc) -> dict:
-            docs = svc.doc_count()
-            segs = sum(len(s.engine.segments) for s in svc.shards)
-            tlog_ops = sum(
-                s.engine.translog.operation_count()
-                if hasattr(s.engine.translog, "operation_count") else 0
-                for s in svc.shards)
-            tlog_bytes = sum(
-                _dir_size(_os.path.join(s.engine.path, "translog"))
-                for s in svc.shards)
-            # cumulative ops (seq_nos are monotonic; doc_count would shrink
-            # on delete); store = segment/commit bytes WITHOUT the translog
+        def shard_sections(svc, shard_list) -> dict:
+            closed = svc.closed
+            docs = sum(s.engine.doc_count() for s in shard_list)
+            segs = 0 if closed and not include_unloaded_segments else \
+                sum(len(s.engine.segments) for s in shard_list)
+            # size counts the operation files only: the checkpoint file's
+            # length varies with digit counts and would break the
+            # size-returns-to-creation invariant the reference suite pins
+            tlog_bytes = 0
+            for s in shard_list:
+                tdir = _os.path.join(s.engine.path, "translog")
+                if _os.path.isdir(tdir):
+                    tlog_bytes += sum(
+                        _os.path.getsize(_os.path.join(tdir, f))
+                        for f in _os.listdir(tdir) if f.endswith(".tlog"))
+            tlog_ops = sum(len(s.engine.translog.read_ops())
+                           for s in shard_list) \
+                if "translog" in keep else 0
+            uncommitted = sum(
+                max(s.engine.local_checkpoint
+                    - (s.engine.last_commit_checkpoint
+                       if s.engine.last_commit_checkpoint is not None
+                       else -1), 0)
+                for s in shard_list)
             ops_total = sum(s.engine.local_checkpoint + 1
-                            for s in svc.shards)
+                            for s in shard_list)
+            # fielddata / completion on-demand sizes with per-field
+            # breakdowns controlled by the fields params — only computed
+            # when the section is requested (full postings walk)
+            fd_fields: Dict[str, int] = {}
+            comp_fields: Dict[str, int] = {}
+            if keep & {"fielddata", "completion"}:
+                for path, mapper in svc.mapper_service.all_mappers():
+                    t = getattr(mapper, "type_name", None)
+                    if t == "text" and mapper.params.get("fielddata") \
+                            and "fielddata" in keep:
+                        fd_fields[path] = self._fielddata_bytes(
+                            shard_list, path)
+                    elif t == "completion" and "completion" in keep:
+                        comp_fields[path] = max(
+                            self._fielddata_bytes(shard_list, path),
+                            64 * docs)
+            fielddata = {"memory_size_in_bytes": sum(fd_fields.values()),
+                         "evictions": 0}
+            fd_pat = fielddata_fields if fielddata_fields is not None \
+                else fields
+            if fd_pat is not None:
+                fielddata["fields"] = {
+                    f: {"memory_size_in_bytes": b}
+                    for f, b in fd_fields.items() if _match_any(f, fd_pat)}
+            completion = {"size_in_bytes": sum(comp_fields.values())}
+            comp_pat = completion_fields if completion_fields is not None \
+                else fields
+            if comp_pat is not None:
+                completion["fields"] = {
+                    f: {"size_in_bytes": b}
+                    for f, b in comp_fields.items()
+                    if _match_any(f, comp_pat)}
+            search_sec = {"query_total": 0, "query_time_in_millis": 0,
+                          "fetch_total": 0, "open_contexts": 0}
+            segments_sec = {"count": segs, "memory_in_bytes": 0,
+                            "index_writer_memory_in_bytes": 0,
+                            "version_map_memory_in_bytes": 0,
+                            "fixed_bit_set_memory_in_bytes": 0}
+            if include_segment_file_sizes:
+                segments_sec["file_sizes"] = {
+                    "seg": {"size_in_bytes": max(
+                        sum(_dir_size(s.engine.path) for s in shard_list)
+                        - tlog_bytes, 1),
+                        "description": "segment data"}}
+            newest = max((_os.path.getmtime(_os.path.join(
+                s.engine.path, "translog"))
+                for s in shard_list
+                if _os.path.isdir(_os.path.join(s.engine.path, "translog"))),
+                default=time.time())
             full = {
                 "docs": {"count": docs, "deleted": 0},
                 "store": {"size_in_bytes": max(
-                    sum(_dir_size(s.engine.path) for s in svc.shards)
+                    sum(_dir_size(s.engine.path) for s in shard_list)
                     - tlog_bytes, 0),
                     "reserved_in_bytes": 0},
                 "indexing": {"index_total": ops_total, "index_failed": 0,
-                             "delete_total": 0},
-                "get": {"total": 0, "missing_total": 0},
-                # node-global counters (search, caches) land in _all ONCE
-                # below — per-index attribution is not tracked
-                "search": {"query_total": 0, "fetch_total": 0,
-                           "open_contexts": 0},
-                "merge": {"total": 0, "total_docs": 0},
-                "refresh": {"total": 0, "external_total": 0},
-                "flush": {"total": 0, "periodic": 0},
-                "segments": {"count": segs,
-                             "memory_in_bytes": 0},
-                "translog": {"operations": tlog_ops,
+                             "delete_total": 0, "index_time_in_millis": 0},
+                "get": {"total": 0, "missing_total": 0,
+                        "time_in_millis": 0},
+                "search": search_sec,
+                "merges": {"total": 0, "total_docs": 0,
+                           "total_size_in_bytes": 0,
+                           "total_time_in_millis": 0},
+                "refresh": {"total": 0, "external_total": 0,
+                            "total_time_in_millis": 0},
+                "flush": {"total": 0, "periodic": 0,
+                          "total_time_in_millis": 0},
+                "warmer": {"current": 0, "total": 0,
+                           "total_time_in_millis": 0},
+                "segments": segments_sec,
+                "translog": {"operations": tlog_ops if not closed else 0,
                              "size_in_bytes": tlog_bytes,
-                             "uncommitted_operations": 0},
+                             "uncommitted_operations":
+                                 uncommitted if not closed else 0,
+                             "uncommitted_size_in_bytes": tlog_bytes,
+                             "earliest_last_modified_age":
+                                 max(int((time.time() - newest) * 1000), 0)},
                 "query_cache": {"memory_size_in_bytes": 0, "hit_count": 0,
                                 "miss_count": 0, "evictions": 0},
                 "request_cache": {"memory_size_in_bytes": 0, "hit_count": 0,
                                   "miss_count": 0, "evictions": 0},
-                "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
-                "completion": {"size_in_bytes": 0},
-                "warmer": {"current": 0, "total": 0},
-                "recovery": {"current_as_source": 0, "current_as_target": 0},
+                "fielddata": fielddata,
+                "completion": completion,
+                "recovery": {"current_as_source": 0,
+                             "current_as_target": 0},
+                "bulk": {"total_operations": 0,
+                         "total_time_in_millis": 0},
             }
             return {k: v for k, v in full.items() if k in keep}
 
@@ -1111,14 +1242,39 @@ class Node:
         for svc in services:
             total_shards += svc.num_shards * (1 + svc.num_replicas)
             successful += svc.num_shards
-            sections = shard_sections(svc)
-            indices_out[svc.name] = {"uuid": svc.uuid,
-                                     "primaries": sections,
-                                     "total": sections}
+            sections = shard_sections(svc, svc.shards)
+            entry = {"uuid": svc.uuid,
+                     "primaries": sections,
+                     "total": sections}
+            if level == "shards":
+                entry["shards"] = {
+                    str(s.shard_id): [{
+                        **shard_sections(svc, [s]),
+                        "routing": {"state": "STARTED", "primary": True,
+                                    "node": self.node_id},
+                        "commit": {"id": f"{svc.uuid}-{s.shard_id}",
+                                   "generation": 1, "num_docs":
+                                       s.engine.doc_count(),
+                                   "user_data": {}},
+                        "seq_no": {"max_seq_no": s.engine.local_checkpoint,
+                                   "local_checkpoint":
+                                       s.engine.local_checkpoint,
+                                   "global_checkpoint":
+                                       s.engine.local_checkpoint},
+                    }] for s in svc.shards}
+            indices_out[svc.name] = entry
             _deep_merge_add(agg, sections)
         # node-global counters attributed once at the _all level
         if "search" in keep and "search" in agg:
-            agg["search"]["query_total"] = self.counters.get("search", 0)
+            agg["search"]["query_total"] = max(
+                self.counters.get("search", 0),
+                agg["search"].get("query_total", 0))
+            if groups is not None:
+                agg["search"]["groups"] = {
+                    g: {"query_total": n, "query_time_in_millis": 0,
+                        "fetch_total": n}
+                    for g, n in self._search_groups.items()
+                    if _match_any(g, groups) and n > 0}
         if "query_cache" in keep and "query_cache" in agg:
             agg["query_cache"].update(
                 hit_count=self.caches.query.hits,
@@ -1129,10 +1285,15 @@ class Node:
                 hit_count=self.caches.request.hits,
                 miss_count=self.caches.request.misses,
                 evictions=self.caches.request.evictions)
-        return {"_shards": {"total": total_shards, "successful": successful,
-                            "failed": 0},
-                "_all": {"primaries": agg, "total": agg},
-                "indices": indices_out}
+        if "bulk" in keep and "bulk" in agg:
+            # node-global counter: once at _all, not summed per index
+            agg["bulk"]["total_operations"] = self.counters.get("bulk", 0)
+        out = {"_shards": {"total": total_shards, "successful": successful,
+                           "failed": 0},
+               "_all": {"primaries": agg, "total": agg}}
+        if level != "cluster":
+            out["indices"] = indices_out
+        return out
 
     def close(self):
         self.ml.close_all()

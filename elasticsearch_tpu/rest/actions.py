@@ -273,9 +273,9 @@ def register_all(rc: RestController, node: Node) -> None:
         dvf = req.param("docvalue_fields")
         if dvf:
             body["docvalue_fields"] = dvf.split(",")
-        if req.param("seq_no_primary_term") in ("true", "", True):
+        if req.bool_param("seq_no_primary_term", False):
             body["seq_no_primary_term"] = True
-        if req.param("version") in ("true", "", True):
+        if req.bool_param("version", False):
             body["version"] = True
         st = req.param("search_type")
         if st in ("query_and_fetch", "dfs_query_and_fetch"):
@@ -293,7 +293,7 @@ def register_all(rc: RestController, node: Node) -> None:
             raise IllegalArgumentError("preFilterShardSize must be >= 1")
         if req.bool_param("rest_total_hits_as_int", False):
             tt = body.get("track_total_hits")
-            if isinstance(tt, int) and not isinstance(tt, bool):
+            if isinstance(tt, int) and not isinstance(tt, bool) and tt != -1:
                 raise IllegalArgumentError(
                     f"[rest_total_hits_as_int] cannot be used if the "
                     f"tracking of total hits is not accurate, got {tt}")
@@ -547,7 +547,24 @@ def register_all(rc: RestController, node: Node) -> None:
     def index_stats(req):
         metric = req.params.get("metric")
         metrics = [m.strip() for m in metric.split(",")] if metric else None
-        return 200, node.index_stats(req.params.get("index"), metrics)
+        expand = req.param("expand_wildcards") or ""
+        if isinstance(expand, (list, tuple)):
+            expand = ",".join(str(t) for t in expand)
+        return 200, node.index_stats(
+            req.params.get("index"), metrics,
+            level=req.param("level") or "indices",
+            fields=req.param("fields"),
+            fielddata_fields=req.param("fielddata_fields"),
+            completion_fields=req.param("completion_fields"),
+            groups=req.param("groups"),
+            include_segment_file_sizes=req.bool_param(
+                "include_segment_file_sizes", False),
+            include_unloaded_segments=req.bool_param(
+                "include_unloaded_segments", False),
+            forbid_closed_indices=req.bool_param(
+                "forbid_closed_indices", True),
+            expand_hidden=any(t in ("all", "hidden")
+                              for t in expand.split(",") if t))
 
     rc.register("GET", "/_stats", index_stats)
     rc.register("GET", "/_stats/{metric}", index_stats)

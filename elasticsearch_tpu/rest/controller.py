@@ -160,17 +160,20 @@ class RestController:
                 if short is not None:
                     return short
             status, resp = handler(req)
-            fp = query.get("filter_path")
-            if fp and isinstance(resp, (dict, list)):
-                resp = filter_path_apply(resp, str(fp))
-            return status, resp
         except SearchEngineError as e:
-            return e.status, {"error": e.to_wrapped_dict(),
-                              "status": e.status}
+            status, resp = e.status, {"error": e.to_wrapped_dict(),
+                                      "status": e.status}
         except Exception as e:  # unexpected: 500 with reason, never a raw traceback
             tb = traceback.format_exc(limit=5)
-            return 500, _error_body("internal_server_error",
-                                    f"{type(e).__name__}: {e}", 500, stack_trace=tb)
+            status, resp = 500, _error_body(
+                "internal_server_error",
+                f"{type(e).__name__}: {e}", 500, stack_trace=tb)
+        # filter_path applies to error bodies too (FilterPath at the
+        # xcontent layer, below the error renderer)
+        fp = query.get("filter_path")
+        if fp and isinstance(resp, (dict, list)):
+            resp = filter_path_apply(resp, str(fp))
+        return status, resp
 
 
 def filter_path_apply(resp, spec: str):

@@ -79,6 +79,7 @@ MUST_PASS = [
     "cluster.reroute/10_basic.yml",
     "cluster.state/10_basic.yml",
     "cluster.state/20_filtering.yml",
+    "count/10_basic.yml",
     "create/10_with_id.yml",
     "create/40_routing.yml",
     "create/60_refresh.yml",
@@ -128,6 +129,14 @@ MUST_PASS = [
     "indices.rollover/30_max_size_condition.yml",
     "indices.rollover/40_mapping.yml",
     "indices.split/20_source_mapping.yml",
+    "indices.stats/10_index.yml",
+    "indices.stats/11_metric.yml",
+    "indices.stats/12_level.yml",
+    "indices.stats/13_fields.yml",
+    "indices.stats/14_groups.yml",
+    "indices.stats/20_translog.yml",
+    "indices.stats/30_segments.yml",
+    "indices.stats/40_updates_on_refresh.yml",
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
@@ -145,20 +154,42 @@ MUST_PASS = [
     "msearch/11_status.yml",
     "ping/10_ping.yml",
     "range/10_basic.yml",
+    "search/100_stored_fields.yml",
+    "search/10_source_filtering.yml",
+    "search/120_batch_reduce_size.yml",
+    "search/170_terms_query.yml",
+    "search/200_ignore_malformed.yml",
     "search/200_index_phrase_search.yml",
+    "search/20_default_values.yml",
     "search/230_interval_query.yml",
+    "search/300_sequence_numbers.yml",
     "search/90_search_after.yml",
     "search/issue4895.yml",
+    "search/issue9606.yml",
     "search.aggregation/100_avg_metric.yml",
+    "search.aggregation/10_histogram.yml",
     "search.aggregation/110_max_metric.yml",
     "search.aggregation/120_min_metric.yml",
     "search.aggregation/130_sum_metric.yml",
+    "search.aggregation/140_value_count_metric.yml",
     "search.aggregation/150_stats_metric.yml",
+    "search.aggregation/160_extended_stats_metric.yml",
+    "search.aggregation/170_cardinality_metric.yml",
+    "search.aggregation/180_percentiles_tdigest_metric.yml",
+    "search.aggregation/220_filters_bucket.yml",
+    "search.aggregation/240_max_buckets.yml",
+    "search.aggregation/250_moving_fn.yml",
     "search.aggregation/260_weighted_avg.yml",
+    "search.aggregation/270_median_absolute_deviation_metric.yml",
     "search.aggregation/280_geohash_grid.yml",
+    "search.aggregation/280_rare_terms.yml",
     "search.aggregation/290_geotile_grid.yml",
+    "search.aggregation/310_date_agg_per_day_of_week.yml",
+    "search.aggregation/320_missing.yml",
+    "search.aggregation/40_range.yml",
     "search.aggregation/70_adjacency_matrix.yml",
     "search.aggregation/80_typed_keys.yml",
+    "search.aggregation/90_sig_text.yml",
     "snapshot.get_repository/10_basic.yml",
     "suggest/10_basic.yml",
     "suggest/20_completion.yml",
