@@ -31,6 +31,7 @@ transport unchanged.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster import allocation
@@ -41,7 +42,8 @@ from elasticsearch_tpu.cluster.state import (
     ClusterState, DiscoveryNode, ShardRoutingEntry,
 )
 from elasticsearch_tpu.common.errors import (
-    IllegalArgumentError, IndexNotFoundError, SearchEngineError,
+    IllegalArgumentError, IndexNotFoundError, SearchContextMissingError,
+    SearchEngineError,
 )
 from elasticsearch_tpu.index.engine import Engine
 from elasticsearch_tpu.index.mapping import MapperService
@@ -57,6 +59,11 @@ WRITE_REPLICA = "indices:data/write/replica"
 QUERY_SHARD = "indices:data/read/query"
 FETCH_SHARD = "indices:data/read/fetch"
 CAN_MATCH_SHARD = "indices:data/read/search[can_match]"
+SCROLL_CREATE = "indices:data/read/scroll[create]"
+SCROLL_FETCH = "indices:data/read/scroll[fetch]"
+SCROLL_FREE = "indices:data/read/scroll[free]"
+SCROLL_NEXT = "indices:data/read/scroll[next]"
+SCROLL_CLEAR = "indices:data/read/scroll[clear]"
 RECOVERY_START = "internal:index/shard/recovery/start_recovery"
 RECOVERY_FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
 MASTER_CREATE_INDEX = "cluster:admin/indices/create"
@@ -114,6 +121,10 @@ class ClusterNode:
         self.transport = transport
         self.scheduler = scheduler
         self.local_shards: Dict[Tuple[str, int], LocalShard] = {}
+        # pinned per-shard scroll reader contexts (data side) and merged
+        # scroll cursors (coordinator side)
+        self._shard_scrolls: Dict[str, dict] = {}
+        self._client_scrolls: Dict[str, dict] = {}
         self.mappers: Dict[str, MapperService] = {}
         from elasticsearch_tpu.search.caches import NodeCaches
         self.caches = NodeCaches()
@@ -133,6 +144,22 @@ class ClusterNode:
     # ------------------------------------------------------------------ admin
     def start(self):
         self.coordinator.start()
+        self._schedule_scroll_reaper()
+
+    def _schedule_scroll_reaper(self):
+        """Periodic keepalive reaper for abandoned scroll contexts
+        (reference: SearchService's KEEPALIVE_INTERVAL Reaper job)."""
+        def tick():
+            self._reap_shard_scrolls()
+            now = time.time()
+            for sid in [s for s, st in self._client_scrolls.items()
+                        if st["expiry"] < now]:
+                self._client_scrolls.pop(sid, None)
+            self._schedule_scroll_reaper()
+        try:
+            self.scheduler.schedule_in(60_000, tick, "scroll_reaper")
+        except Exception:
+            pass  # deterministic test schedulers may be closed
 
     def stop(self):
         self.coordinator.stop()
@@ -1097,6 +1124,346 @@ class ClusterNode:
                  "can_match": can_match(reader, local.mapper_service,
                                         request["body"])})
 
+    # ------------------------------------------------------------ scroll
+    # Per-shard pinned reader contexts with keepalives (reference:
+    # SearchService.createContext + LegacyReaderContext for scrolls,
+    # SearchScrollAsyncAction on the coordinator). The shard holds the
+    # full sorted row snapshot; the coordinator pulls windows per page,
+    # so deep pagination never materializes the corpus anywhere.
+
+    def _on_scroll_create(self, sender, request, respond):
+        import uuid as _uuid
+
+        key = (request["index"], request["shard"])
+        local = self.local_shards.get(key)
+        if local is None:
+            raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        body = dict(request["body"])
+        reader = local.engine.acquire_searcher()
+        body["size"] = reader.num_docs  # snapshot the full shard ordering
+        body["from"] = 0
+        body["__unbounded_window__"] = True  # scroll bypasses
+        # index.max_result_window: depth is bounded per page, not in total
+        body["track_total_hits"] = True  # scrolls always count accurately
+        body.pop("aggs", None)
+        body.pop("aggregations", None)
+        result = execute_query_phase(reader, local.mapper_service, body,
+                                     shard_id=request["shard"],
+                                     vector_store=local.vector_store,
+                                     query_cache=self.caches.query)
+        ctx_id = _uuid.uuid4().hex
+        keep_s = float(request.get("keep_alive_s", 300))
+        self._shard_scrolls[ctx_id] = {
+            "index": request["index"], "shard": request["shard"],
+            "reader": reader, "body": request["body"],
+            "rows": result.rows, "scores": result.scores,
+            "sort_values": result.sort_values,
+            "expiry": time.time() + keep_s, "keep_s": keep_s,
+        }
+        respond({"ctx_id": ctx_id, "total": result.total_hits,
+                 "relation": result.total_relation,
+                 "max_score": result.max_score})
+
+    def _reap_shard_scrolls(self) -> None:
+        now = time.time()
+        for cid in [c for c, s in self._shard_scrolls.items()
+                    if s["expiry"] < now]:
+            self._shard_scrolls.pop(cid, None)
+
+    def _on_scroll_fetch(self, sender, request, respond):
+        import numpy as np
+
+        from elasticsearch_tpu.search.service import ShardSearchResult
+
+        self._reap_shard_scrolls()
+        ctx = self._shard_scrolls.get(request["ctx_id"])
+        if ctx is None:
+            raise SearchContextMissingError(
+                f"No search context found for id [{request['ctx_id']}]")
+        if request.get("keep_alive_s"):
+            ctx["keep_s"] = float(request["keep_alive_s"])
+        ctx["expiry"] = time.time() + ctx["keep_s"]
+        pos = int(request["pos"])
+        count = int(request["count"])
+        rows = ctx["rows"][pos:pos + count]
+        scores = ctx["scores"][pos:pos + count]
+        svs = ctx["sort_values"][pos:pos + count] \
+            if ctx["sort_values"] is not None else None
+        result = ShardSearchResult(
+            shard_id=ctx["shard"],
+            rows=np.asarray(rows, dtype=np.int64),
+            scores=np.asarray(scores, dtype=np.float32),
+            sort_values=svs, total_hits=len(rows), total_relation="eq",
+            aggregations=None, max_score=None)
+        hits = execute_fetch_phase(ctx["reader"], self.local_shards[
+            (ctx["index"], ctx["shard"])].mapper_service,
+            ctx["body"], result, index_name=ctx["index"])
+        respond({"hits": hits,
+                 "scores": [float(s) for s in scores],
+                 "sort_values": [list(sv) if sv is not None else None
+                                 for sv in svs] if svs is not None else None,
+                 "exhausted": pos + count >= len(ctx["rows"])})
+
+    def _on_scroll_free(self, sender, request, respond):
+        freed = self._shard_scrolls.pop(request["ctx_id"], None) is not None
+        respond({"freed": freed})
+
+    def client_scroll_start(self, index: Optional[str], body: dict,
+                            keep_alive_s: float,
+                            on_done: Callable[[dict], None]) -> None:
+        """Open per-shard scroll contexts on every target shard, then
+        serve the first page through the merged cursor."""
+        import uuid as _uuid
+
+        state = self.cluster_state
+        try:
+            names = self.resolve_indices(index)
+        except IndexNotFoundError as e:
+            on_done({"error": {"type": "index_not_found_exception",
+                               "reason": str(e)}, "status": 404})
+            return
+        targets: List[Tuple[str, ShardRoutingEntry]] = []
+        for name in names:
+            num_shards = int(state.metadata[name]["settings"].get(
+                "index.number_of_shards", 1))
+            for sid in range(num_shards):
+                copies = [r for r in state.routing
+                          if r.index == name and r.shard == sid
+                          and r.state == ShardRoutingEntry.STARTED
+                          and r.node_id]
+                if copies:
+                    targets.append((name, self._select_copy(copies, sid)))
+        if not targets:
+            on_done({"_scroll_id": _uuid.uuid4().hex, "took": 0,
+                     "timed_out": False,
+                     "_shards": {"total": 0, "successful": 0, "skipped": 0,
+                                 "failed": 0},
+                     "hits": {"total": {"value": 0, "relation": "eq"},
+                              "max_score": None, "hits": []}})
+            return
+        size = int(body.get("size", 10) if body.get("size") is not None
+                   else 10)
+        # the id carries the coordinating node so ANY node can serve or
+        # clear it (the reference encodes context locations in the id)
+        scroll_id = f"{self.node_id}~{_uuid.uuid4().hex}"
+        sstate = {
+            "body": body, "size": size, "keep_s": keep_alive_s,
+            "expiry": time.time() + keep_alive_s,
+            "total": 0, "relation": "eq", "max_score": None,
+            "shards": [],  # {node, ctx, pos, buffer, exhausted, failed}
+        }
+        pending = {"count": len(targets), "failed": 0}
+
+        def created(resp, name, entry):
+            if isinstance(resp, dict) and "ctx_id" in resp:
+                sstate["total"] += int(resp.get("total", 0))
+                if resp.get("relation") == "gte":
+                    sstate["relation"] = "gte"
+                ms = resp.get("max_score")
+                if ms is not None:
+                    sstate["max_score"] = max(sstate["max_score"] or -1e30,
+                                              ms)
+                sstate["shards"].append({
+                    "node": entry.node_id, "ctx": resp["ctx_id"],
+                    "pos": 0, "buffer": [], "exhausted": False,
+                    "failed": False})
+            else:
+                pending["failed"] += 1
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                self._client_scrolls[scroll_id] = sstate
+                self._scroll_page(scroll_id, sstate, pending["failed"],
+                                  on_done)
+
+        for name, entry in targets:
+            req = {"index": name, "shard": entry.shard, "body": body,
+                   "keep_alive_s": keep_alive_s}
+            if entry.node_id == self.node_id:
+                try:
+                    self._on_scroll_create(
+                        self.node_id, req,
+                        lambda r, n=name, e=entry: created(r, n, e))
+                except Exception:
+                    created(None, name, entry)
+            else:
+                self.transport.send(
+                    self.node_id, entry.node_id, SCROLL_CREATE, req,
+                    on_response=lambda r, n=name, e=entry: created(r, n, e),
+                    on_failure=lambda _e, n=name, e=entry: created(None, n, e))
+
+    def _scroll_page(self, scroll_id: str, sstate: dict, failed: int,
+                     on_done: Callable[[dict], None]) -> None:
+        """Fill per-shard buffers to >= size (or exhaustion), then emit the
+        globally-ordered next page (SearchScrollQueryThenFetchAsyncAction's
+        lastEmittedDoc accounting, done with per-shard cursors)."""
+        from elasticsearch_tpu.node import _sort_key_tuple
+
+        size = sstate["size"]
+        body = sstate["body"]
+        need = [sh for sh in sstate["shards"]
+                if not sh["exhausted"] and not sh["failed"]
+                and len(sh["buffer"]) < size]
+        if not need:
+            # keep untouched-but-live shard contexts alive: a shard whose
+            # buffer stays full would otherwise never see a fetch and
+            # could expire mid-scroll (keepalive piggyback, count=0)
+            for sh in sstate["shards"]:
+                if sh["exhausted"] or sh["failed"]:
+                    continue
+                if len(sh["buffer"]) >= size:
+                    req = {"ctx_id": sh["ctx"], "pos": sh["pos"],
+                           "count": 0, "keep_alive_s": sstate["keep_s"]}
+                    if sh["node"] == self.node_id:
+                        try:
+                            self._on_scroll_fetch(self.node_id, req,
+                                                  lambda _r: None)
+                        except Exception:
+                            pass
+                    else:
+                        self.transport.send(
+                            self.node_id, sh["node"], SCROLL_FETCH, req,
+                            on_response=lambda _r: None,
+                            on_failure=lambda _e: None)
+            # merge: pick the top `size` across buffers
+            sort_spec = body.get("sort")
+
+            def rank(item):
+                _hit, score, sv = item
+                if sort_spec:
+                    return _sort_key_tuple(sv, body)
+                return (-(score if score is not None else -1e30),)
+            candidates = []
+            for sh in sstate["shards"]:
+                for item in sh["buffer"]:
+                    candidates.append((rank(item), sh, item))
+            candidates.sort(key=lambda t: t[0])
+            page = candidates[:size]
+            for _, sh, item in page:
+                sh["buffer"].remove(item)
+            hits = [item[0] for _, _, item in page]
+            runtime_failed = sum(1 for sh in sstate["shards"]
+                                 if sh["failed"])
+            shards_total = len(sstate["shards"]) + failed
+            on_done({"_scroll_id": scroll_id, "took": 0,
+                     "timed_out": False,
+                     "_shards": {"total": shards_total,
+                                 "successful": len(sstate["shards"])
+                                 - runtime_failed,
+                                 "skipped": 0,
+                                 "failed": failed + runtime_failed},
+                     "hits": {"total": {"value": sstate["total"],
+                                        "relation": sstate["relation"]},
+                              "max_score": sstate["max_score"],
+                              "hits": hits}})
+            return
+        pending = {"count": len(need)}
+
+        def fetched(resp, sh):
+            if isinstance(resp, dict) and "hits" in resp:
+                svs = resp.get("sort_values")
+                for i, h in enumerate(resp["hits"]):
+                    sh["buffer"].append(
+                        (h, resp["scores"][i] if resp.get("scores") else None,
+                         tuple(svs[i]) if svs is not None
+                         and svs[i] is not None else None))
+                sh["pos"] += len(resp["hits"])
+                if resp.get("exhausted"):
+                    sh["exhausted"] = True
+            else:
+                sh["failed"] = True
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                self._scroll_page(scroll_id, sstate, failed, on_done)
+
+        for sh in need:
+            req = {"ctx_id": sh["ctx"], "pos": sh["pos"],
+                   "count": max(size, 1),
+                   "keep_alive_s": sstate["keep_s"]}
+            if sh["node"] == self.node_id:
+                try:
+                    self._on_scroll_fetch(
+                        self.node_id, req, lambda r, s=sh: fetched(r, s))
+                except Exception:
+                    fetched(None, sh)
+            else:
+                self.transport.send(
+                    self.node_id, sh["node"], SCROLL_FETCH, req,
+                    on_response=lambda r, s=sh: fetched(r, s),
+                    on_failure=lambda _e, s=sh: fetched(None, s))
+
+    def _scroll_owner(self, scroll_id: str) -> Optional[str]:
+        owner = scroll_id.split("~", 1)[0] if "~" in scroll_id else None
+        if owner and owner != self.node_id \
+                and owner in self.cluster_state.nodes:
+            return owner
+        return None
+
+    def client_scroll_next(self, scroll_id: str,
+                           keep_alive_s: Optional[float],
+                           on_done: Callable[[dict], None]) -> None:
+        owner = self._scroll_owner(scroll_id)
+        if owner:
+            self.transport.send(
+                self.node_id, owner, SCROLL_NEXT,
+                {"scroll_id": scroll_id, "keep_alive_s": keep_alive_s},
+                on_response=on_done,
+                on_failure=lambda e: on_done({"error": {
+                    "type": "search_context_missing_exception",
+                    "reason": str(e)}, "status": 404}))
+            return
+        sstate = self._client_scrolls.get(scroll_id)
+        if sstate is None or sstate["expiry"] < time.time():
+            self._client_scrolls.pop(scroll_id, None)
+            on_done({"error": {
+                "type": "search_context_missing_exception",
+                "reason": f"No search context found for id [{scroll_id}]"},
+                "status": 404})
+            return
+        if keep_alive_s:
+            sstate["keep_s"] = keep_alive_s
+        sstate["expiry"] = time.time() + sstate["keep_s"]
+        self._scroll_page(scroll_id, sstate, 0, on_done)
+
+    def client_scroll_clear(self, scroll_id: str,
+                            on_done: Callable[[dict], None]) -> None:
+        owner = self._scroll_owner(scroll_id)
+        if owner:
+            self.transport.send(
+                self.node_id, owner, SCROLL_CLEAR,
+                {"scroll_id": scroll_id},
+                on_response=on_done,
+                on_failure=lambda e: on_done({"succeeded": True,
+                                              "num_freed": 0}))
+            return
+        sstate = self._client_scrolls.pop(scroll_id, None)
+        if sstate is None:
+            on_done({"succeeded": True, "num_freed": 0})
+            return
+        shards = [sh for sh in sstate["shards"] if not sh["failed"]]
+        pending = {"count": len(shards), "freed": 0}
+        if not shards:
+            on_done({"succeeded": True, "num_freed": 0})
+            return
+
+        def freed(resp):
+            if isinstance(resp, dict) and resp.get("freed"):
+                pending["freed"] += 1
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                on_done({"succeeded": True, "num_freed": pending["freed"]})
+
+        for sh in shards:
+            req = {"ctx_id": sh["ctx"]}
+            if sh["node"] == self.node_id:
+                try:
+                    self._on_scroll_free(self.node_id, req, freed)
+                except Exception:
+                    freed(None)
+            else:
+                self.transport.send(
+                    self.node_id, sh["node"], SCROLL_FREE, req,
+                    on_response=freed, on_failure=lambda _e: freed(None))
+
     def _on_fetch_shard(self, sender, request, respond):
         """FETCH phase: materialize hits for the coordinator's global
         window rows (FetchSearchPhase / SearchService.executeFetchPhase)."""
@@ -1177,6 +1544,15 @@ class ClusterNode:
         t.register(me, QUERY_SHARD, self._on_query_shard)
         t.register(me, FETCH_SHARD, self._on_fetch_shard)
         t.register(me, CAN_MATCH_SHARD, self._on_can_match_shard)
+        t.register(me, SCROLL_CREATE, self._on_scroll_create)
+        t.register(me, SCROLL_FETCH, self._on_scroll_fetch)
+        t.register(me, SCROLL_FREE, self._on_scroll_free)
+        t.register(me, SCROLL_NEXT,
+                   lambda s, req, respond: self.client_scroll_next(
+                       req["scroll_id"], req.get("keep_alive_s"), respond))
+        t.register(me, SCROLL_CLEAR,
+                   lambda s, req, respond: self.client_scroll_clear(
+                       req["scroll_id"], respond))
         t.register(me, "indices:data/read/get", self._on_get)
         t.register(me, "indices:admin/refresh", self._on_refresh)
         t.register(me, RECOVERY_START, self._on_recovery_start)

@@ -34,6 +34,14 @@ from elasticsearch_tpu.common.errors import (
 from elasticsearch_tpu.node import Node
 
 
+def _parse_keepalive_s(value: Optional[str]) -> float:
+    """'1m' / '30s' -> seconds (TimeValue parsing)."""
+    if not value:
+        return 300.0
+    from elasticsearch_tpu.common.settings import parse_time_value
+    return float(parse_time_value(str(value), "scroll"))
+
+
 def _empty_search_response() -> dict:
     return {"took": 0, "timed_out": False,
             "_shards": {"total": 0, "successful": 0, "skipped": 0,
@@ -231,6 +239,11 @@ class ClusterAwareNode(Node):
             reason = err.get("reason", str(err)) if isinstance(err, dict) else str(err)
             if isinstance(err, dict) and err.get("type") == "index_not_found_exception":
                 raise IndexNotFoundError(reason)
+            if isinstance(err, dict) \
+                    and err.get("type") == "search_context_missing_exception":
+                from elasticsearch_tpu.common.errors import (
+                    SearchContextMissingError)
+                raise SearchContextMissingError(reason)
             raise SearchEngineError(reason)
         return result
 
@@ -419,58 +432,38 @@ class ClusterAwareNode(Node):
                                      "skipped": 0, "failed": 0})}
 
     # ----------------------------------------------------------------- scroll
-    _CLUSTER_SCROLL_CAP = 10_000
 
     def search_scroll_start(self, index_expr: Optional[str],
                             body: Optional[dict], keep_alive: str = "1m",
                             ignore_throttled: bool = True) -> dict:
-        """Cluster scroll: snapshot the distributed result ONCE (capped at
-        10k docs) into coordinator-held pages. The reference instead pins
-        per-shard readers; that refinement is tracked in COMPONENTS.md."""
-        import time as _time
-        import uuid as _uuid
+        """Cluster scroll with REAL per-shard pinned reader contexts
+        (reference: SearchService scroll contexts +
+        SearchScrollAsyncAction): each shard holds its own sorted
+        snapshot under a keepalive; the coordinator keeps per-shard
+        cursors and merge-sorts windows per page, so a scroll over
+        millions of docs never materializes more than a page per shard."""
         body = dict(body or {})
         if body.get("collapse") is not None:
             raise IllegalArgumentError(
                 "cannot use `collapse` in a scroll context")
-        size = int(body.get("size", 10) if body.get("size") is not None else 10)
-        big = dict(body)
-        big["size"] = self._CLUSTER_SCROLL_CAP
-        big["track_total_hits"] = True
-        big.pop("from", None)
-        resp = self.search(index_expr, big)
-        hits = resp["hits"]["hits"]
-        scroll_id = _uuid.uuid4().hex
-        self._cluster_scrolls = getattr(self, "_cluster_scrolls", {})
-        self._cluster_scrolls[scroll_id] = {
-            "hits": hits, "pos": size, "size": size,
-            "total": resp["hits"]["total"],
-            "expiry": _time.time() + 300}
-        return {"_scroll_id": scroll_id, "took": resp.get("took", 0),
-                "timed_out": False, "_shards": resp.get("_shards", {}),
-                "hits": {"total": resp["hits"]["total"],
-                         "max_score": resp["hits"].get("max_score"),
-                         "hits": hits[:size]}}
+        return self._call(self.cluster.client_scroll_start, index_expr,
+                          body, _parse_keepalive_s(keep_alive))
 
     def search_scroll_next(self, scroll_id: str,
                            keep_alive: Optional[str] = None) -> dict:
-        import time as _time
-        from elasticsearch_tpu.common.errors import ResourceNotFoundError
-        scrolls = getattr(self, "_cluster_scrolls", {})
-        sc = scrolls.get(scroll_id)
-        if sc is None or sc["expiry"] < _time.time():
-            scrolls.pop(scroll_id, None)
-            raise ResourceNotFoundError(
-                f"No search context found for id [{scroll_id}]",
-                scroll_id=scroll_id)
-        page = sc["hits"][sc["pos"]:sc["pos"] + sc["size"]]
-        sc["pos"] += sc["size"]
-        sc["expiry"] = _time.time() + 300
-        return {"_scroll_id": scroll_id, "took": 0, "timed_out": False,
-                "_shards": {"total": 1, "successful": 1, "skipped": 0,
-                            "failed": 0},
-                "hits": {"total": sc["total"], "max_score": None,
-                         "hits": page}}
+        return self._call(self.cluster.client_scroll_next, scroll_id,
+                          _parse_keepalive_s(keep_alive)
+                          if keep_alive else None)
+
+    def clear_scroll(self, scroll_id: str) -> dict:
+        return self._call(self.cluster.client_scroll_clear, scroll_id)
+
+    def clear_all_scrolls(self) -> dict:
+        freed = 0
+        for sid in list(self.cluster._client_scrolls):
+            r = self._call(self.cluster.client_scroll_clear, sid)
+            freed += int(r.get("num_freed", 0))
+        return {"succeeded": True, "num_freed": freed}
 
     # ------------------------------------------------------- index admin
     def _maybe_cluster_refresh(self, index: str, refresh) -> None:

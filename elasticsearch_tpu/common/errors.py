@@ -78,6 +78,12 @@ class ResourceNotFoundError(SearchEngineError):
     status = 404
 
 
+class SearchContextMissingError(SearchEngineError):
+    """Expired/unknown scroll or PIT context
+    (SearchContextMissingException)."""
+    status = 404
+
+
 class IndexNotFoundError(ResourceNotFoundError):
     status = 404
 

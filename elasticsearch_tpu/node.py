@@ -836,22 +836,9 @@ class Node:
         if ignore_throttled:
             services = [s for s in services
                         if not setting_bool(s.settings.get("index.frozen"))]
-        # scroll slicing (search/slice/SliceBuilder): slice {id, max}
-        # partitions the scan by a hash of _id, so `max` independent
-        # consumers can drain one logical scroll in parallel
-        slice_spec = body.pop("slice", None)
-        if slice_spec is not None:
-            try:
-                slice_id = int(slice_spec["id"])
-                slice_max = int(slice_spec["max"])
-            except (TypeError, KeyError, ValueError):
-                raise IllegalArgumentError(
-                    f"malformed slice [{slice_spec!r}]: expected "
-                    "{id, max}")
-            if not 0 <= slice_id < slice_max:
-                raise IllegalArgumentError(
-                    f"slice id [{slice_id}] must be in [0, {slice_max})")
-
+        # scroll slicing (search/slice/SliceBuilder) is applied inside
+        # execute_query_phase: shard-level when max <= shards, hashed
+        # _id terms otherwise
         for svc in services:
             reader = svc.combined_reader()
             store = _MultiShardVectorStore(svc)
@@ -862,16 +849,11 @@ class Node:
             big["__unbounded_window__"] = True
             big["track_total_hits"] = True
             big.pop("from", None)
-            result = execute_query_phase(reader, svc.mapper_service, big,
-                                         vector_store=store)
+            result = execute_query_phase(
+                reader, svc.mapper_service, big, vector_store=store,
+                index_settings=svc.settings.as_flat_dict())
             kept_rows = list(range(len(result.rows)))
-            if slice_spec is not None:
-                from elasticsearch_tpu.cluster.routing import hash_routing
-                kept_rows = [
-                    i for i in kept_rows
-                    if hash_routing(reader.get_id(int(result.rows[i])) or "")
-                    % slice_max == slice_id]
-            total += len(kept_rows) if slice_spec is not None else result.total_hits
+            total += result.total_hits
             for i in kept_rows:
                 row = result.rows[i]
                 sv = result.sort_values[i] if result.sort_values is not None else None
@@ -914,6 +896,13 @@ class Node:
                 "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
                 "hits": {"total": {"value": total, "relation": "eq"},
                          "max_score": None, "hits": hits}}
+
+    def clear_scroll(self, scroll_id: str) -> dict:
+        freed = 1 if self.scrolls.delete(scroll_id) else 0
+        return {"succeeded": True, "num_freed": freed}
+
+    def clear_all_scrolls(self) -> dict:
+        return {"succeeded": True, "num_freed": self.scrolls.delete_all()}
 
     def count(self, index_expr: Optional[str], body: Optional[dict]) -> dict:
         body = self._rewrite_terms_lookup(dict(body or {}))

@@ -299,12 +299,13 @@ def register_all(rc: RestController, node: Node) -> None:
                     f"tracking of total hits is not accurate, got {tt}")
         scroll = req.param("scroll")
         if scroll:
-            if req.param("request_cache") is not None:
-                raise IllegalArgumentError(
-                    "[request_cache] cannot be used in a scroll context")
             if body.get("size") == 0:
                 raise IllegalArgumentError(
                     "[size] cannot be [0] in a scroll context")
+            if req.param("request_cache") is not None:
+                raise IllegalArgumentError(
+                    "[request_cache] cannot be used in a scroll context")
+            check_scroll_keep_alive(node, scroll)
             resp = node.search_scroll_start(
                 req.params.get("index"), body, keep_alive=scroll,
                 ignore_throttled=req.bool_param("ignore_throttled", True))
@@ -1207,6 +1208,22 @@ def register_all(rc: RestController, node: Node) -> None:
 
 
 from elasticsearch_tpu.rest.cat import fmt_iso_millis as _fmt_iso_millis
+
+
+def check_scroll_keep_alive(node, value) -> None:
+    """search.max_keep_alive gate for scroll keepalives (SearchService
+    validateKeepAlives)."""
+    mka = node._cluster_setting("search.max_keep_alive") \
+        if hasattr(node, "_cluster_setting") else None
+    if not value or mka is None:
+        return
+    from elasticsearch_tpu.common.settings import parse_time_value
+    if parse_time_value(str(value), "scroll") > \
+            parse_time_value(str(mka), "max_keep_alive"):
+        raise IllegalArgumentError(
+            f"Keep alive for scroll ({value}) is too large. It must be "
+            f"less than ({mka}). This limit can be set by changing the "
+            f"[search.max_keep_alive] cluster level setting.")
 
 
 def _query_string_to_dsl(q: str) -> dict:

@@ -28,7 +28,10 @@ def register_extra(rc: RestController, node: Node) -> None:
         scroll_id = body.get("scroll_id") or req.param("scroll_id")
         if not scroll_id:
             raise IllegalArgumentError("scroll_id is required")
-        resp = node.search_scroll_next(scroll_id, body.get("scroll"))
+        keep = body.get("scroll") or req.param("scroll")
+        from elasticsearch_tpu.rest.actions import check_scroll_keep_alive
+        check_scroll_keep_alive(node, keep)
+        resp = node.search_scroll_next(scroll_id, keep)
         if req.bool_param("rest_total_hits_as_int", False):
             total = resp.get("hits", {}).get("total")
             if isinstance(total, dict):
@@ -40,15 +43,17 @@ def register_extra(rc: RestController, node: Node) -> None:
         ids = body.get("scroll_id", [])
         if isinstance(ids, str):
             ids = [ids]
-        if req.params.get("scroll_id"):  # DELETE /_search/scroll/{id}
+        if not ids and req.params.get("scroll_id"):
+            # DELETE /_search/scroll/{id}: body params override the path
+            # segment (RestClearScrollAction)
             ids = req.params["scroll_id"].split(",")
         freed = 0
         if body.get("scroll_id") == "_all" or req.path.endswith("/_all") \
                 or "_all" in ids:
-            freed = node.scrolls.delete_all()
+            freed = node.clear_all_scrolls().get("num_freed", 0)
         else:
             for sid in ids:
-                freed += 1 if node.scrolls.delete(sid) else 0
+                freed += int(node.clear_scroll(sid).get("num_freed", 0))
         if not freed and ids and "_all" not in ids:
             # nothing matched: the ids were unknown/expired (404 in the
             # reference's ClearScrollResponse when nothing freed)

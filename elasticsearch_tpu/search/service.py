@@ -175,6 +175,51 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     result = query.execute(ctx).with_scores()
     rows, scores = result.rows, result.scores
 
+    # sliced scroll (reference: SliceBuilder -> TermsSliceQuery on _id:
+    # floorMod(murmur3(id, seed 7919), max) == id selects this slice)
+    slice_spec = body.get("slice")
+    if slice_spec is not None:
+        try:
+            sid = int(slice_spec.get("id", 0))
+            smax = int(slice_spec.get("max", 1))
+        except (TypeError, ValueError, AttributeError):
+            raise IllegalArgumentError(
+                f"malformed slice [{slice_spec!r}]: expected {{id, max}}")
+        if smax <= 1:
+            raise IllegalArgumentError("max must be greater than 1")
+        max_slices = int(ctx.index_settings.get(
+            "index.max_slices_per_scroll", 1024))
+        if smax > max_slices:
+            raise IllegalArgumentError(
+                f"The number of slices [{smax}] is too large. It must be "
+                f"less than [{max_slices}]. This limit can be set by "
+                f"changing the [index.max_slices_per_scroll] index level "
+                f"setting.")
+        if sid < 0 or sid >= smax:
+            raise IllegalArgumentError(
+                f"id must be greater than or equal to 0 and less than "
+                f"max ({smax})")
+        num_shards = int(ctx.index_settings.get(
+            "index.number_of_shards", 1))
+        if smax <= num_shards:
+            # fewer slices than shards: a slice owns whole shards
+            # (SliceBuilder.toFilter shard-level short circuit); shard
+            # membership recomputes from the routing hash, which holds
+            # for combined readers too
+            from elasticsearch_tpu.cluster.routing import shard_id_for
+            keep = np.asarray([
+                shard_id_for(str(reader.get_id(int(r))),
+                             num_shards) % smax == sid
+                for r in rows], dtype=bool)
+        else:
+            from elasticsearch_tpu.search.aggregations import (
+                _murmur3_x86_32)
+            keep = np.asarray([
+                _murmur3_x86_32(_encode_uid(str(reader.get_id(int(r)))),
+                                7919) % smax == sid
+                for r in rows], dtype=bool)
+        rows, scores = rows[keep], scores[keep]
+
     # post_filter: applied after aggs scope (reference: POST_FILTER applies to
     # hits only, not aggs)
     agg_rows = rows
@@ -708,6 +753,33 @@ def _highlight(ctx, mapper_service, body, spec, row) -> Dict[str, List[str]]:
             frag = frag[:start] + pre + frag[start:end] + post + frag[end:]
         out[field] = [frag]
     return out
+
+
+def _encode_uid(doc_id: str) -> bytes:
+    """The _id term encoding (reference: index/mapper/Uid.encodeId):
+    numeric ids pack as nibble pairs, base64-able ids as raw bytes,
+    everything else utf8 — slicing hashes the ENCODED term."""
+    if doc_id and doc_id.isdigit() \
+            and (len(doc_id) == 1 or doc_id[0] != "0"):
+        out = bytearray([0xFE])
+        for i in range(0, len(doc_id), 2):
+            b1 = ord(doc_id[i]) - ord("0")
+            b2 = (ord(doc_id[i + 1]) - ord("0")
+                  if i + 1 < len(doc_id) else 0x0F)
+            out.append((b1 << 4) | b2)
+        return bytes(out)
+    import re as _re
+    if doc_id and len(doc_id) % 4 != 1 \
+            and _re.fullmatch(r"[A-Za-z0-9_-]+", doc_id):
+        import base64 as _b64
+        try:
+            raw = _b64.urlsafe_b64decode(doc_id + "=" * (-len(doc_id) % 4))
+            if raw and raw[0] >= 0xFD:
+                return bytes([0xFD]) + raw
+            return raw
+        except Exception:
+            pass
+    return bytes([0xFF]) + doc_id.encode("utf-8")
 
 
 def _format_doc_value(v, mapper, fmt):

@@ -415,6 +415,64 @@ def test_cluster_scroll_and_bulk_refresh(cluster_procs):
     assert got == list(range(15))
 
 
+def test_cluster_scroll_beyond_10k_docs(cluster_procs):
+    """Deep distributed pagination: per-shard pinned scroll contexts mean
+    a scroll over >10k docs returns EVERY doc exactly once (the round-2
+    coordinator snapshot silently truncated at 10k). Also: clearing the
+    scroll frees the shard contexts, and an expired/cleared id 404s."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    base = f"http://127.0.0.1:{live[0]}"
+    _wait_health(live[0], "green", nodes=len(live))
+
+    _req("PUT", f"{base}/deep",
+         {"settings": {"number_of_shards": 3, "number_of_replicas": 0}})
+    n_docs = 12_000
+    for lo in range(0, n_docs, 2000):
+        nd = b""
+        for i in range(lo, lo + 2000):
+            nd += json.dumps(
+                {"index": {"_index": "deep", "_id": str(i)}}).encode() + b"\n"
+            nd += json.dumps({"n": i}).encode() + b"\n"
+        breq = urllib.request.Request(
+            f"{base}/_bulk", data=nd, method="POST",
+            headers={"Content-Type": "application/x-ndjson"})
+        with urllib.request.urlopen(breq, timeout=60) as resp:
+            r = json.loads(resp.read())
+        assert not r["errors"]
+    _req("POST", f"{base}/deep/_refresh", {})
+
+    r = _req("POST", f"{base}/deep/_search?scroll=1m",
+             {"query": {"match_all": {}}, "size": 500,
+              "sort": [{"n": "asc"}]})
+    assert r["hits"]["total"]["value"] == n_docs
+    sid = r["_scroll_id"]
+    got = [h["_source"]["n"] for h in r["hits"]["hits"]]
+    while True:
+        r = _req("POST", f"{base}/_search/scroll",
+                 {"scroll": "1m", "scroll_id": sid})
+        if not r["hits"]["hits"]:
+            break
+        got.extend(h["_source"]["n"] for h in r["hits"]["hits"])
+    assert len(got) == n_docs, f"scroll returned {len(got)} of {n_docs}"
+    assert got == list(range(n_docs))
+
+    # clear frees the per-shard contexts; a further page 404s
+    dreq = urllib.request.Request(
+        f"{base}/_search/scroll", method="DELETE",
+        data=json.dumps({"scroll_id": sid}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(dreq, timeout=20) as resp:
+        r = json.loads(resp.read())
+    assert r["succeeded"]
+    try:
+        _req("POST", f"{base}/_search/scroll",
+             {"scroll": "1m", "scroll_id": sid})
+        raise AssertionError("expected 404 after clear_scroll")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
 def test_registries_replicate_through_cluster_state(cluster_procs):
     """A pipeline/template/stored-script PUT on one node is usable on EVERY
     node (IngestMetadata/IndexTemplateMetaData/ScriptMetaData analogs)."""
