@@ -291,6 +291,50 @@ def run_hybrid_rrf():
     node.close()
 
 
+def run_sharded_fused():
+    """Config 6: the serving-path SPMD fused merge on a >=2-way sharded
+    corpus — one compiled program per search, ICI all-gather merge
+    (parallel/sharded_knn.py in the serving path). On a single-chip host
+    this measures nothing distributed, so it reports skipped instead of a
+    misleading number."""
+    import jax
+    import jax.numpy as jnp
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print(json.dumps({"config": "6_sharded_fused_spmd",
+                          "skipped": f"needs >=2 devices, have {n_dev}"}),
+              flush=True)
+        return
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    from elasticsearch_tpu.parallel.sharded_knn import (
+        build_sharded_corpus, distributed_knn_search)
+
+    n, d = 1_000_000, 128
+    shards = min(n_dev, 8)
+    rng = np.random.default_rng(11)
+    centers = rng.standard_normal((128, d)).astype(np.float32) * 2.0
+    vectors = (centers[rng.integers(0, 128, size=n)]
+               + rng.standard_normal((n, d)).astype(np.float32))
+    mesh = mesh_lib.make_mesh(num_shards=shards, dp=1)
+    corpus, layout = build_sharded_corpus(vectors, mesh, metric="cosine",
+                                          dtype="bf16")
+    nq = BATCH * 16
+    queries = (vectors[rng.integers(0, n, size=nq)]
+               + 0.3 * rng.standard_normal((nq, d)).astype(np.float32))
+
+    def fn(qb, c, kk):
+        return distributed_knn_search(qb, c, kk, mesh, metric="cosine")
+
+    qps, marginal, p50, p99, ids = _measure(
+        _scan_searcher(fn), corpus, queries, d, n_small=4, n_large=16)
+    print(json.dumps({"config": "6_sharded_fused_spmd", "qps": round(qps, 1),
+                      "batch_ms": round(marginal * 1000, 3),
+                      "p50_ms": round(p50, 1), "p99_ms": round(p99, 1),
+                      "n_docs": n, "dims": d, "shards": shards,
+                      "merge": "ici_all_gather_one_program"}), flush=True)
+
+
 def main():
     run_config("1_cosine_sift1m", 1_000_000, 128, "cosine", "bf16")
     run_config("2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16")
@@ -298,6 +342,7 @@ def main():
     run_north_star_10m_int8()
     run_config("5_filtered_10pct", 1_000_000, 128, "cosine", "bf16",
                filter_frac=0.10)
+    run_sharded_fused()
 
 
 if __name__ == "__main__":

@@ -64,6 +64,7 @@ SCROLL_FETCH = "indices:data/read/scroll[fetch]"
 SCROLL_FREE = "indices:data/read/scroll[free]"
 SCROLL_NEXT = "indices:data/read/scroll[next]"
 SCROLL_CLEAR = "indices:data/read/scroll[clear]"
+SCROLL_CLEAR_ALL = "indices:data/read/scroll[clear_all]"
 RECOVERY_START = "internal:index/shard/recovery/start_recovery"
 RECOVERY_FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
 MASTER_CREATE_INDEX = "cluster:admin/indices/create"
@@ -1424,6 +1425,44 @@ class ClusterNode:
         sstate["expiry"] = time.time() + sstate["keep_s"]
         self._scroll_page(scroll_id, sstate, 0, on_done)
 
+    def _on_scroll_clear_all(self, sender, request, respond):
+        """Free every scroll THIS node coordinates (one leg of the
+        cluster-wide _all broadcast)."""
+        ids = list(self._client_scrolls)
+        pending = {"count": len(ids), "freed": 0}
+        if not ids:
+            respond({"num_freed": 0})
+            return
+
+        def one(resp):
+            pending["freed"] += int((resp or {}).get("num_freed", 0))
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                respond({"num_freed": pending["freed"]})
+
+        for sid in ids:
+            self.client_scroll_clear(sid, one)
+
+    def client_scroll_clear_all(self, on_done: Callable[[dict], None]) -> None:
+        """Broadcast _all scroll clearing to every node (any node may be
+        coordinating scrolls the client started elsewhere)."""
+        nodes = sorted(self.cluster_state.nodes) or [self.node_id]
+        pending = {"count": len(nodes), "freed": 0}
+
+        def one(resp):
+            pending["freed"] += int((resp or {}).get("num_freed", 0))
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                on_done({"succeeded": True, "num_freed": pending["freed"]})
+
+        for nid in nodes:
+            if nid == self.node_id:
+                self._on_scroll_clear_all(self.node_id, {}, one)
+            else:
+                self.transport.send(
+                    self.node_id, nid, SCROLL_CLEAR_ALL, {},
+                    on_response=one, on_failure=lambda _e: one(None))
+
     def client_scroll_clear(self, scroll_id: str,
                             on_done: Callable[[dict], None]) -> None:
         owner = self._scroll_owner(scroll_id)
@@ -1553,6 +1592,7 @@ class ClusterNode:
         t.register(me, SCROLL_CLEAR,
                    lambda s, req, respond: self.client_scroll_clear(
                        req["scroll_id"], respond))
+        t.register(me, SCROLL_CLEAR_ALL, self._on_scroll_clear_all)
         t.register(me, "indices:data/read/get", self._on_get)
         t.register(me, "indices:admin/refresh", self._on_refresh)
         t.register(me, RECOVERY_START, self._on_recovery_start)

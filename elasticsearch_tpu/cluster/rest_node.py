@@ -459,11 +459,7 @@ class ClusterAwareNode(Node):
         return self._call(self.cluster.client_scroll_clear, scroll_id)
 
     def clear_all_scrolls(self) -> dict:
-        freed = 0
-        for sid in list(self.cluster._client_scrolls):
-            r = self._call(self.cluster.client_scroll_clear, sid)
-            freed += int(r.get("num_freed", 0))
-        return {"succeeded": True, "num_freed": freed}
+        return self._call(self.cluster.client_scroll_clear_all)
 
     # ------------------------------------------------------- index admin
     def _maybe_cluster_refresh(self, index: str, refresh) -> None:

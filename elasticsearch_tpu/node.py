@@ -34,13 +34,14 @@ MAX_RESULT_WINDOW_SCROLL = 10_000
 
 
 class _MultiShardVectorStore:
-    """Scatter-gather adapter: per-shard device kNN + host merge, with rows
-    rebased into the combined reader's row space.
+    """Scatter-gather adapter for multi-shard kNN.
 
-    This is the host-coordinated analog of the compiled ICI all-gather merge
-    (`parallel/sharded_knn.py`); on one node the per-shard corpora may live on
-    one or several devices.
-    """
+    When the local device mesh can host one column per shard (device
+    count >= shard count > 1), searches run as ONE compiled SPMD program:
+    each mesh column scores its shard slice and the global top-k merges
+    over ICI all_gather (`parallel/sharded_knn.py`) — the compiled
+    collapse of `SearchPhaseController.mergeTopDocs:221`. Otherwise the
+    host-coordinated fallback runs per-shard device kNN + host merge."""
 
     def __init__(self, svc: IndexService):
         self.svc = svc
@@ -52,8 +53,139 @@ class _MultiShardVectorStore:
                 return fc
         return None
 
+    # -- mesh fast path -----------------------------------------------------
+    def _mesh_state(self, field: str):
+        """Build (and cache by segment fingerprints) the mesh-sharded
+        corpus + row maps for one vector field; None when the mesh path
+        does not apply."""
+        import jax
+
+        n_shards = len(self.svc.shards)
+        if n_shards < 2 or len(jax.devices()) < n_shards:
+            return None
+        field_cs = [s.vector_store.field(field) for s in self.svc.shards]
+        if all(fc is None or fc.corpus is None for fc in field_cs):
+            return None
+        version = tuple(fc.version if fc is not None else None
+                        for fc in field_cs)
+        cache = self.svc.__dict__.setdefault("_mesh_knn_cache", {})
+        cached = cache.get(field)
+        if cached is not None and cached["version"] == version:
+            return cached
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
+        from elasticsearch_tpu.ops import similarity as sim
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel.sharded_knn import ShardedCorpus
+        from elasticsearch_tpu.vectors.store import _METRIC_MAP
+
+        mapper = self.svc.mapper_service.get(field)
+        if not isinstance(mapper, DenseVectorFieldMapper):
+            return None
+        metric = _METRIC_MAP[mapper.similarity]
+        mesh = mesh_lib.make_mesh(num_shards=n_shards, dp=1)
+
+        # host-side extraction per shard, laid out one shard per mesh
+        # column; row maps reuse the per-shard store's (identical segment
+        # walk order). NOTE: the per-shard device corpora stay resident as
+        # the fallback path — on a multi-chip host they all sit on device
+        # 0 while the mesh copy spreads across chips, so the overlap on
+        # any one chip is 1/n_shards of the corpus, not a full double.
+        blocks, row_maps = [], []
+        for shard, fc in zip(self.svc.shards, field_cs):
+            reader = shard.engine.acquire_searcher()
+            mats = []
+            for view in reader.views:
+                seg = view.segment
+                if field not in seg.vectors:
+                    continue
+                mat, present = seg.vectors[field]
+                keep = present & view.live
+                locs = np.nonzero(keep)[0]
+                if len(locs):
+                    mats.append(np.asarray(mat[locs], dtype=np.float32))
+            blocks.append(np.concatenate(mats, axis=0) if mats
+                          else np.zeros((0, mapper.dims), dtype=np.float32))
+            row_maps.append(
+                (fc.row_map + shard.shard_id * SHARD_ROW_SPACE)
+                if fc is not None and len(fc.row_map)
+                else np.zeros(0, dtype=np.int64))
+        from elasticsearch_tpu.ops import knn as knn_ops
+        per = knn_ops.pad_rows(max(max(len(b) for b in blocks), 1))
+        d = mapper.dims
+        matrix_host = np.zeros((n_shards * per, d), dtype=np.float32)
+        sq_host = np.zeros(n_shards * per, dtype=np.float32)
+        num_valid = np.zeros(n_shards, dtype=np.int32)
+        for s, block in enumerate(blocks):
+            if metric == sim.COSINE and len(block):
+                norms = np.linalg.norm(block, axis=-1, keepdims=True)
+                block = block / np.maximum(norms, 1e-30)
+            matrix_host[s * per: s * per + len(block)] = block
+            sq_host[s * per: s * per + len(block)] = \
+                (block * block).sum(axis=-1)
+            num_valid[s] = len(block)
+        import ml_dtypes
+        matrix = jax.device_put(matrix_host.astype(ml_dtypes.bfloat16),
+                                mesh_lib.corpus_sharding(mesh))
+        corpus = ShardedCorpus(
+            matrix=matrix,
+            sq_norms=jax.device_put(sq_host,
+                                    mesh_lib.per_shard_sharding(mesh)),
+            scales=jax.device_put(
+                np.ones(n_shards * per, dtype=np.float32),
+                mesh_lib.per_shard_sharding(mesh)),
+            num_valid=jax.device_put(num_valid,
+                                     mesh_lib.per_shard_sharding(mesh)))
+        state = {"version": version, "mesh": mesh, "corpus": corpus,
+                 "row_maps": row_maps, "per": per, "metric": metric,
+                 "n_rows": n_shards * per}
+        cache[field] = state
+        return state
+
+    def _mesh_search(self, state, query_vector, k: int, filter_rows,
+                     precision: str):
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.parallel.sharded_knn import (
+            distributed_knn_search)
+
+        per = state["per"]
+        row_maps = state["row_maps"]
+        mask = None
+        if filter_rows is not None:
+            m = np.zeros(state["n_rows"], dtype=bool)
+            for s, rm in enumerate(row_maps):
+                allowed = np.isin(rm, filter_rows)
+                m[s * per: s * per + len(rm)] = allowed
+            mask = jnp.asarray(m)
+        q = jnp.asarray(
+            np.asarray(query_vector, dtype=np.float32)[None, :])
+        scores, gids = distributed_knn_search(
+            q, state["corpus"], k, state["mesh"],
+            metric=state["metric"], filter_mask=mask, precision=precision)
+        scores = np.asarray(scores[0])
+        gids = np.asarray(gids[0])
+        valid = scores > -1e37
+        scores, gids = scores[valid], gids[valid]
+        out_rows = np.empty(len(gids), dtype=np.int64)
+        keep = np.ones(len(gids), dtype=bool)
+        for i, g in enumerate(gids):
+            s, local = int(g) // per, int(g) % per
+            if local < len(row_maps[s]):
+                out_rows[i] = row_maps[s][local]
+            else:
+                keep[i] = False
+        return out_rows[keep], scores[keep]
+
     def search(self, field: str, query_vector, k: int, filter_rows=None,
                precision: str = "bf16"):
+        state = self._mesh_state(field)
+        # k beyond the per-shard padded row count cannot merge losslessly
+        # in the fused program; such deep k falls back to the host merge
+        if state is not None and k <= state["per"]:
+            return self._mesh_search(state, query_vector, k, filter_rows,
+                                     precision)
         all_rows, all_scores = [], []
         for shard in self.svc.shards:
             offset = shard.shard_id * SHARD_ROW_SPACE

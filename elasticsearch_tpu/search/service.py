@@ -180,9 +180,9 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     slice_spec = body.get("slice")
     if slice_spec is not None:
         try:
-            sid = int(slice_spec.get("id", 0))
-            smax = int(slice_spec.get("max", 1))
-        except (TypeError, ValueError, AttributeError):
+            sid = int(slice_spec["id"])
+            smax = int(slice_spec["max"])
+        except (TypeError, ValueError, AttributeError, KeyError):
             raise IllegalArgumentError(
                 f"malformed slice [{slice_spec!r}]: expected {{id, max}}")
         if smax <= 1:
@@ -759,7 +759,7 @@ def _encode_uid(doc_id: str) -> bytes:
     """The _id term encoding (reference: index/mapper/Uid.encodeId):
     numeric ids pack as nibble pairs, base64-able ids as raw bytes,
     everything else utf8 — slicing hashes the ENCODED term."""
-    if doc_id and doc_id.isdigit() \
+    if doc_id and all(c in "0123456789" for c in doc_id) \
             and (len(doc_id) == 1 or doc_id[0] != "0"):
         out = bytearray([0xFE])
         for i in range(0, len(doc_id), 2):
