@@ -44,12 +44,30 @@ class Transport:
     def __init__(self, hosts: Sequence[Union[str, Tuple[str, int]]],
                  timeout: float = 30.0, max_retries: int = 3,
                  content_type: str = XContentType.JSON,
-                 dead_host_cooldown: float = 60.0):
+                 dead_host_cooldown: float = 60.0,
+                 use_ssl: bool = False, ssl_context=None,
+                 ca_certs: Optional[str] = None,
+                 ssl_assert_hostname: bool = True):
+        # https scheme (or use_ssl=True) switches to TLS connections;
+        # ca_certs verifies the server against a CA bundle. Hostname
+        # verification stays ON unless explicitly opted out (certs
+        # without the right SANs must not silently weaken TLS).
+        self.use_ssl = use_ssl
+        self.ssl_context = ssl_context
+        if ssl_context is None:
+            import ssl as _ssl
+            self.ssl_context = _ssl.create_default_context(
+                cafile=ca_certs) if ca_certs \
+                else _ssl.create_default_context()
+            if not ssl_assert_hostname:
+                self.ssl_context.check_hostname = False
         self.hosts: List[Tuple[str, int]] = []
         for h in hosts:
             if isinstance(h, str):
                 if "//" in h:
                     parsed = urllib.parse.urlsplit(h)
+                    if parsed.scheme == "https":
+                        self.use_ssl = True
                     self.hosts.append((parsed.hostname or "localhost",
                                        parsed.port or 9200))
                 elif ":" in h:
@@ -96,8 +114,13 @@ class Transport:
         hosts = self._alive_hosts()
         for attempt in range(self.max_retries + 1):
             host, port = hosts[(self._rr + attempt) % len(hosts)]
-            conn = http.client.HTTPConnection(host, port,
-                                             timeout=self.timeout)
+            if self.use_ssl:
+                conn = http.client.HTTPSConnection(
+                    host, port, timeout=self.timeout,
+                    context=self.ssl_context)
+            else:
+                conn = http.client.HTTPConnection(host, port,
+                                                  timeout=self.timeout)
             try:
                 # connect separately: only connect-phase failures are safe
                 # to retry — once the request is sent, a timeout may mean

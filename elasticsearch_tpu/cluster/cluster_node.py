@@ -216,16 +216,19 @@ class ClusterNode:
                 state, name, int(settings["index.number_of_shards"]),
                 int(settings["index.number_of_replicas"]))
 
-        self._publish_then_respond(update, respond, {"acknowledged": True})
+        self._publish_then_respond(update, respond, {"acknowledged": True},
+                                   source=f"create-index [{name}]")
 
-    def _publish_then_respond(self, update, respond, result: dict) -> None:
+    def _publish_then_respond(self, update, respond, result: dict,
+                              source: str = "cluster-state-update") -> None:
         """Ack only after COMMIT (MasterService publish listener): a stale
         leader's rejected publish must surface as a retryable non-ack, not
-        a false acknowledged=true."""
+        a false acknowledged=true. Updates route through the batching task
+        queue, so concurrent submissions coalesce into one publication."""
         def on_committed(ok: bool):
             respond(result if ok else {"__not_committed__": True})
 
-        self.coordinator.publish_state_update(update, on_committed)
+        self.coordinator.submit_state_update(source, update, on_committed)
 
     def _master_delete_index(self, sender, request, respond):
         self._require_master()

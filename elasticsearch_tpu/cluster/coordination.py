@@ -259,6 +259,14 @@ class Coordinator:
         # callback(bool)) fired from _apply_committed, failed on demotion
         # (reference: MasterService ack listeners / publish listener)
         self._commit_waiters: List[Tuple[int, int, Callable[[bool], None]]] = []
+        # MasterService task batching (MasterService.submitStateUpdateTask
+        # + TaskBatcher): queued updaters coalesce into one publication per
+        # drain; `cluster.pending_tasks` introspects this queue
+        self._pending_tasks: List[dict] = []
+        self._executing_tasks: List[dict] = []
+        self._task_insert_order = 0
+        self._drain_scheduled = False
+        self._publication_inflight = False
         # optional hook: (state, added_ids, removed_ids) -> state, applied by
         # the leader after membership changes so shard allocation follows
         # node join/leave (reference: AllocationService wired into
@@ -486,6 +494,81 @@ class Coordinator:
             removed = set(base.nodes) - set(nodes)
             state = self.membership_listener(state, added, removed)
         self._publish(state)
+
+    def submit_state_update(self, source: str,
+                            updater: Callable[[ClusterState], ClusterState],
+                            on_committed_result: Optional[
+                                Callable[[bool], None]] = None) -> None:
+        """Batched MasterService entry (MasterService.submitStateUpdateTask
+        :133,197): tasks queue and coalesce — all tasks queued while a
+        publication is in flight apply over ONE base state and publish
+        once, so e.g. a dynamic-mapping storm from concurrent bulks costs
+        O(1) publications, not O(requests)."""
+        import time as _time
+        self._task_insert_order += 1
+        self._pending_tasks.append({
+            "insert_order": self._task_insert_order, "source": source,
+            "updater": updater, "cb": on_committed_result,
+            "queued_at": _time.time(), "executing": False})
+        self._maybe_drain_tasks()
+
+    def pending_tasks(self) -> List[dict]:
+        """`_cluster/pending_tasks` view: queued AND currently-executing
+        tasks (the reference shows in-flight tasks too)."""
+        import time as _time
+        now = _time.time()
+        out = []
+        for t in self._executing_tasks + self._pending_tasks:
+            ms = max(int((now - t["queued_at"]) * 1000), 0)
+            out.append({"insert_order": t["insert_order"],
+                        "priority": "NORMAL", "source": t["source"],
+                        "executing": t["executing"],
+                        "time_in_queue_millis": ms,
+                        "time_in_queue": f"{ms}ms"})
+        return out
+
+    def _maybe_drain_tasks(self) -> None:
+        if self._drain_scheduled:
+            return
+        self._drain_scheduled = True
+        self.scheduler.schedule(self._drain_tasks,
+                                f"master_task_drain:{self.node.node_id}")
+
+    def _drain_tasks(self) -> None:
+        self._drain_scheduled = False
+        if not self._pending_tasks:
+            return
+        if self._publication_inflight:
+            # keep queueing behind the in-flight publication; drain again
+            # when it commits (the commit callback re-arms us)
+            return
+        batch, self._pending_tasks = self._pending_tasks, []
+        for t in batch:
+            t["executing"] = True
+        self._executing_tasks = batch
+
+        def composite(base: ClusterState) -> ClusterState:
+            st = base
+            for t in batch:
+                try:
+                    st = t["updater"](st)
+                except Exception as e:  # one bad task must not sink the batch
+                    t["error"] = e
+            return st
+
+        self._publication_inflight = True
+
+        def done(ok: bool) -> None:
+            self._publication_inflight = False
+            self._executing_tasks = []
+            for t in batch:
+                cb = t["cb"]
+                if cb is not None:
+                    cb(False if "error" in t else ok)
+            if self._pending_tasks:
+                self._maybe_drain_tasks()
+
+        self.publish_state_update(composite, done)
 
     def publish_state_update(self, updater: Callable[[ClusterState], ClusterState],
                              on_committed_result: Optional[Callable[[bool], None]] = None) -> bool:

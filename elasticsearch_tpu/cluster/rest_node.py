@@ -461,6 +461,9 @@ class ClusterAwareNode(Node):
     def clear_all_scrolls(self) -> dict:
         return self._call(self.cluster.client_scroll_clear_all)
 
+    def pending_cluster_tasks(self) -> list:
+        return self.cluster.coordinator.pending_tasks()
+
     # ------------------------------------------------------- index admin
     def _maybe_cluster_refresh(self, index: str, refresh) -> None:
         if refresh in ("true", "wait_for", True, ""):

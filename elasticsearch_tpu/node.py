@@ -63,11 +63,14 @@ class _MultiShardVectorStore:
         n_shards = len(self.svc.shards)
         if n_shards < 2 or len(jax.devices()) < n_shards:
             return None
-        field_cs = [s.vector_store.field(field) for s in self.svc.shards]
-        if all(fc is None or fc.corpus is None for fc in field_cs):
-            return None
-        version = tuple(fc.version if fc is not None else None
-                        for fc in field_cs)
+        from elasticsearch_tpu.vectors.store import (
+            VectorStoreShard, extract_field_rows)
+        # one reader snapshot per shard: fingerprints (for cache
+        # invalidation), matrices, and row maps all come from the SAME
+        # snapshot, so rows can never misalign with doc ids
+        readers = [s.engine.acquire_searcher() for s in self.svc.shards]
+        version = tuple(VectorStoreShard._fingerprint(r, field)
+                        for r in readers)
         cache = self.svc.__dict__.setdefault("_mesh_knn_cache", {})
         cached = cache.get(field)
         if cached is not None and cached["version"] == version:
@@ -84,33 +87,22 @@ class _MultiShardVectorStore:
         if not isinstance(mapper, DenseVectorFieldMapper):
             return None
         metric = _METRIC_MAP[mapper.similarity]
-        mesh = mesh_lib.make_mesh(num_shards=n_shards, dp=1)
 
         # host-side extraction per shard, laid out one shard per mesh
-        # column; row maps reuse the per-shard store's (identical segment
-        # walk order). NOTE: the per-shard device corpora stay resident as
-        # the fallback path — on a multi-chip host they all sit on device
-        # 0 while the mesh copy spreads across chips, so the overlap on
-        # any one chip is 1/n_shards of the corpus, not a full double.
+        # column. NOTE: the per-shard device corpora stay resident as the
+        # fallback path — on a multi-chip host they all sit on device 0
+        # while the mesh copy spreads across chips, so the overlap on any
+        # one chip is 1/n_shards of the corpus, not a full double.
         blocks, row_maps = [], []
-        for shard, fc in zip(self.svc.shards, field_cs):
-            reader = shard.engine.acquire_searcher()
-            mats = []
-            for view in reader.views:
-                seg = view.segment
-                if field not in seg.vectors:
-                    continue
-                mat, present = seg.vectors[field]
-                keep = present & view.live
-                locs = np.nonzero(keep)[0]
-                if len(locs):
-                    mats.append(np.asarray(mat[locs], dtype=np.float32))
-            blocks.append(np.concatenate(mats, axis=0) if mats
-                          else np.zeros((0, mapper.dims), dtype=np.float32))
-            row_maps.append(
-                (fc.row_map + shard.shard_id * SHARD_ROW_SPACE)
-                if fc is not None and len(fc.row_map)
-                else np.zeros(0, dtype=np.int64))
+        for shard, reader in zip(self.svc.shards, readers):
+            block, rows = extract_field_rows(reader, field)
+            if len(rows) == 0:
+                block = np.zeros((0, mapper.dims), dtype=np.float32)
+            blocks.append(block)
+            row_maps.append(rows + shard.shard_id * SHARD_ROW_SPACE)
+        if all(len(b) == 0 for b in blocks):
+            return None
+        mesh = mesh_lib.make_mesh(num_shards=n_shards, dp=1)
         from elasticsearch_tpu.ops import knn as knn_ops
         per = knn_ops.pad_rows(max(max(len(b) for b in blocks), 1))
         d = mapper.dims
@@ -1028,6 +1020,9 @@ class Node:
                 "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
                 "hits": {"total": {"value": total, "relation": "eq"},
                          "max_score": None, "hits": hits}}
+
+    def pending_cluster_tasks(self) -> list:
+        return []
 
     def clear_scroll(self, scroll_id: str) -> dict:
         freed = 1 if self.scrolls.delete(scroll_id) else 0

@@ -136,6 +136,70 @@ def binned_knn_search(
     bf16/f32 or int8 storage; callers route l2 / filtered / tiny corpora
     to the exact XLA path. Returns (raw_scores [Q, k], ids [Q, k]).
     """
+    packed, _q = _binned_packed(queries, corpus, metric, interpret)
+    return _decode(packed, k)
+
+
+def binned_knn_search_rescored(
+    queries: jax.Array,
+    corpus: Corpus,
+    k: int,
+    metric: str = sim.COSINE,
+    rescore_bins: int = 16,
+    interpret: bool = False,
+):
+    """Binned pass + re-scoring of the top bins' member rows with the
+    UNQUANTIZED query.
+
+    The binned kernel keeps one candidate per 64-row bin and (for int8
+    corpora) quantizes the query; both cost recall. The top
+    `rescore_bins` bins per query re-score all their member rows with
+    the full-precision query (bin gather + bf16 einsum). Measured on
+    v5e: +0.007 recall@10 on clustered 1M x 768 int8 at ~6 ms/batch-256
+    (corpus-size independent, gather-bound) — worthwhile headroom when
+    the recall gate is tight, a real tax on small corpora."""
+    packed, q = _binned_packed(queries, corpus, metric, interpret)
+    nq, ncols = packed.shape
+    cols = jnp.arange(ncols, dtype=jnp.int32)[None, :]
+    bin_base = (cols // BINS_PER_TILE) * BLOCK_N + cols % BINS_PER_TILE
+    cand_s = jax.lax.bitcast_convert_type(
+        packed & jnp.int32(MASK), jnp.float32) - SHIFT
+    r = min(rescore_bins, ncols)
+    _, bin_pos = jax.lax.top_k(cand_s, r)                       # [Q, R]
+    base = jnp.take_along_axis(
+        jnp.broadcast_to(bin_base, (nq, ncols)), bin_pos, axis=1)
+    # a bin's rows stride by BINS_PER_TILE within its tile; gather whole
+    # [BIN_SIZE, D] bins from a reshaped view instead of element-level
+    # row gathers (coarse block transfers, far cheaper on HBM)
+    n_pad, d = corpus.matrix.shape
+    n_tiles = n_pad // BLOCK_N
+    tile_idx = base // BLOCK_N                                  # [Q, R]
+    lane_idx = base % BLOCK_N                                   # bin lane
+    mat_r = corpus.matrix.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE, d)
+    sc_r = corpus.scales.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE)
+    cand = mat_r[tile_idx, :, lane_idx, :]                      # [Q,R,64,D]
+    scales = sc_r[tile_idx, :, lane_idx]                        # [Q,R,64]
+    row_ids = base[:, :, None] + (
+        jnp.arange(BIN_SIZE, dtype=jnp.int32)
+        * BINS_PER_TILE)[None, None, :]
+    flat_ids = row_ids.reshape(nq, r * BIN_SIZE)                # [Q, C]
+    cand = cand.reshape(nq, r * BIN_SIZE, d)
+    scales = scales.reshape(nq, r * BIN_SIZE)
+    # the query stays UNQUANTIZED here (the kernel's main pass quantizes
+    # it to int8): removing the query-side quantization error is where
+    # the recall headroom comes from; the int8 rows dequantize via their
+    # per-row scale inside the einsum fusion
+    scores = jnp.einsum(
+        "qd,qcd->qc", q.astype(jnp.bfloat16),
+        cand.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32) * scales
+    valid = flat_ids < corpus.num_valid
+    scores = jnp.where(valid, scores, -jnp.inf)
+    vals, pos = jax.lax.top_k(scores, k)
+    return vals, jnp.take_along_axis(flat_ids, pos, axis=1)
+
+
+def _binned_packed(queries, corpus, metric, interpret):
     n_pad, d = corpus.matrix.shape
     if n_pad % BLOCK_N != 0:
         raise ValueError(f"corpus rows {n_pad} not divisible by {BLOCK_N}")
@@ -166,7 +230,7 @@ def binned_knn_search(
             interpret=interpret,
         )(q8, corpus.matrix, qscale.astype(jnp.float32),
           row_scale_valid, tpat)
-        return _decode(packed, k)
+        return packed, q
 
     qb = q.astype(jnp.bfloat16)
     mb = corpus.matrix.astype(jnp.bfloat16)
@@ -184,4 +248,4 @@ def binned_knn_search(
         out_shape=jax.ShapeDtypeStruct((nq, n_tiles * BINS_PER_TILE), jnp.int32),
         interpret=interpret,
     )(qb, mb, valid, tpat)
-    return _decode(packed, k)
+    return packed, q

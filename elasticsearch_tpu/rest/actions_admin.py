@@ -695,6 +695,14 @@ def register_admin(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_cat/segments/{index}", cat_segments)
     rc.register("GET", "/_cat/recovery", cat_recovery)
     rc.register("GET", "/_cat/recovery/{index}", cat_recovery)
+    def cluster_pending_tasks(req):
+        """GET /_cluster/pending_tasks (MasterService.pendingTasks): the
+        batching queue's snapshot; single-node updates apply inline so
+        the queue is empty here, the cluster adapter overrides with the
+        coordinator's live queue."""
+        return 200, {"tasks": node.pending_cluster_tasks()}
+
+    rc.register("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
     rc.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
     rc.register("GET", "/_cat/repositories", cat_repositories)
     rc.register("GET", "/_cat/snapshots", cat_snapshots)

@@ -39,12 +39,18 @@ MAX_BODY = 100 * 1024 * 1024  # reference http.max_content_length default 100mb
 
 class HttpServer:
     def __init__(self, controller: RestController, host: str = "127.0.0.1",
-                 port: int = 9200, max_workers: int = 8, thread_pool=None):
+                 port: int = 9200, max_workers: int = 8, thread_pool=None,
+                 ssl_context=None):
         from elasticsearch_tpu.common.threadpool import ThreadPool
         self.controller = controller
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
+        # http.ssl.*: TLS terminates in-process (reference:
+        # SecurityRestFilter + Netty4HttpServerTransport with
+        # xpack.security.http.ssl); plaintext bytes on a TLS port fail
+        # the handshake and never reach the REST layer
+        self.ssl_context = ssl_context
         # per-workload named executors (ThreadPool.java): requests route to
         # the pool their workload class owns, so e.g. a bulk flood queues in
         # `write` while `search` keeps draining; full queues answer 429
@@ -52,7 +58,8 @@ class HttpServer:
         self._owns_pool = thread_pool is None
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, ssl=self.ssl_context)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
 

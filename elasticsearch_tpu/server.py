@@ -32,6 +32,16 @@ def _honor_jax_platforms_env() -> None:
         pass  # backend already initialized or jax absent: leave as-is
 
 
+def _http_ssl_context(settings):
+    """http.ssl.* -> server SSLContext (xpack.security.http.ssl analog):
+    client certificates optional by default; plaintext on a TLS port
+    fails the handshake."""
+    from elasticsearch_tpu.transport.tls import TlsConfig
+    cfg = TlsConfig.from_settings(settings or {}, prefix="http.ssl",
+                                  default_client_auth="none")
+    return cfg.server_context() if cfg is not None else None
+
+
 def main(argv=None) -> int:
     _honor_jax_platforms_env()
     parser = argparse.ArgumentParser(prog="elasticsearch-tpu")
@@ -94,7 +104,8 @@ def main(argv=None) -> int:
     controller = RestController()
     register_all(controller, node)
     server = HttpServer(controller, host=args.host, port=args.port,
-                        thread_pool=node.thread_pool)
+                        thread_pool=node.thread_pool,
+                        ssl_context=_http_ssl_context(settings))
 
     async def run():
         await server.start()
@@ -213,7 +224,8 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
         adapter = ClusterRestAdapter(cluster_node, loop)
         register_cluster_overrides(controller, adapter)
         server = HttpServer(controller, host=args.host, port=args.port,
-                            thread_pool=aware.thread_pool)
+                            thread_pool=aware.thread_pool,
+                            ssl_context=_http_ssl_context(settings))
         await server.start()
         print(f"[{node_id}] listening on http://{args.host}:{server.port} "
               f"(data: {args.data}, cluster: {args.cluster_name})", flush=True)

@@ -79,25 +79,31 @@ class TlsConfig:
         self.key_password = key_password
 
     @staticmethod
-    def from_settings(settings: Dict[str, Any]) -> Optional["TlsConfig"]:
-        enabled = str(settings.get("transport.ssl.enabled", "false")).lower()
+    def from_settings(settings: Dict[str, Any],
+                      prefix: str = "transport.ssl",
+                      default_client_auth: str = "required",
+                      ) -> Optional["TlsConfig"]:
+        """Build from `<prefix>.*` settings; `http.ssl` mirrors
+        xpack.security.http.ssl (client auth defaults to none there —
+        browsers don't present certificates)."""
+        enabled = str(settings.get(f"{prefix}.enabled", "false")).lower()
         if enabled not in ("true", "1", "yes"):
             return None
-        cert = settings.get("transport.ssl.certificate")
-        key = settings.get("transport.ssl.key")
+        cert = settings.get(f"{prefix}.certificate")
+        key = settings.get(f"{prefix}.key")
         if not cert or not key:
             raise TlsConfigError(
-                "transport.ssl.enabled requires transport.ssl.certificate "
-                "and transport.ssl.key")
+                f"{prefix}.enabled requires {prefix}.certificate "
+                f"and {prefix}.key")
         return TlsConfig(
             cert, key,
             certificate_authorities=settings.get(
-                "transport.ssl.certificate_authorities"),
+                f"{prefix}.certificate_authorities"),
             verification_mode=str(settings.get(
-                "transport.ssl.verification_mode", "full")),
+                f"{prefix}.verification_mode", "full")),
             client_authentication=str(settings.get(
-                "transport.ssl.client_authentication", "required")),
-            key_password=settings.get("transport.ssl.key_password"))
+                f"{prefix}.client_authentication", default_client_auth)),
+            key_password=settings.get(f"{prefix}.key_password"))
 
     def _load_identity(self, ctx: ssl.SSLContext) -> None:
         ctx.load_cert_chain(self.certificate, self.key,

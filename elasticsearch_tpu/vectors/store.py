@@ -46,6 +46,30 @@ class FieldCorpus:
         self.version = version        # cache key: segment/tombstone fingerprint
 
 
+def extract_field_rows(reader: ShardReader, field: str
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """(matrix [m, d] f32, row_map [m] engine global rows) for one vector
+    field from ONE reader snapshot — the single source of truth for both
+    the per-shard store sync and the mesh-sharded layout (keeping the two
+    row spaces aligned by construction)."""
+    mats: List[np.ndarray] = []
+    rows: List[np.ndarray] = []
+    for view in reader.views:
+        seg = view.segment
+        if field not in seg.vectors:
+            continue
+        mat, present = seg.vectors[field]
+        keep = present & view.live
+        locs = np.nonzero(keep)[0]
+        if len(locs):
+            mats.append(np.asarray(mat[locs], dtype=np.float32))
+            rows.append(locs.astype(np.int64) + seg.base)
+    if not mats:
+        return (np.zeros((0, 0), dtype=np.float32),
+                np.zeros(0, dtype=np.int64))
+    return np.concatenate(mats, axis=0), np.concatenate(rows)
+
+
 class VectorStoreShard:
     def __init__(self, dtype: str = "bf16"):
         self.dtype = dtype
@@ -68,26 +92,12 @@ class VectorStoreShard:
             cached = self._fields.get(field)
             if cached is not None and cached.version == version:
                 continue
-            mats: List[np.ndarray] = []
-            rows: List[np.ndarray] = []
-            for view in reader.views:
-                seg = view.segment
-                if field not in seg.vectors:
-                    continue
-                mat, present = seg.vectors[field]
-                keep = present & view.live
-                locs = np.nonzero(keep)[0]
-                if len(locs) == 0:
-                    continue
-                mats.append(mat[locs])
-                rows.append(locs.astype(np.int64) + seg.base)
+            full, row_map = extract_field_rows(reader, field)
             metric = _METRIC_MAP[mapper.similarity]
-            if not mats:
+            if len(row_map) == 0:
                 self._fields[field] = FieldCorpus(None, np.zeros(0, dtype=np.int64),
                                                   metric, mapper.dims, version)
                 continue
-            full = np.concatenate(mats, axis=0)
-            row_map = np.concatenate(rows)
             dtype = self.dtype
             if mapper.params.get("index_options", {}).get("type") == "int8_flat":
                 dtype = "int8"

@@ -632,3 +632,28 @@ def test_request_cache_serves_agg_search(cluster):
         node.refresh_all()
     r3 = c.call(c.any_node().client_search, "rc", dict(body))
     assert r3["aggregations"]["s"]["value"] == sum(range(10)) + 100
+
+
+def test_master_task_batching_coalesces_publications(cluster):
+    """N concurrent state-update tasks drain as O(1) publications
+    (MasterService.submitStateUpdateTask batching): submit 10 registry
+    updates back-to-back; the committed state version advances by far
+    fewer than 10, and every task still acks after commit."""
+    master = cluster.master()
+    v0 = master.cluster_state.version
+    acks = []
+    for i in range(10):
+        master.coordinator.submit_state_update(
+            f"put-registry [k{i}]",
+            (lambda i: lambda base: base.with_(metadata={
+                **base.metadata,
+                "__batch_test__": {**(base.metadata.get("__batch_test__")
+                                      or {}), f"k{i}": i}}))(i),
+            lambda ok: acks.append(ok))
+    # a queue snapshot taken before the drain runs shows pending tasks
+    assert cluster.run_until(lambda: len(acks) == 10)
+    assert all(acks)
+    v1 = master.cluster_state.version
+    assert v1 - v0 <= 3, f"{v1 - v0} publications for 10 tasks"
+    merged = master.cluster_state.metadata["__batch_test__"]
+    assert merged == {f"k{i}": i for i in range(10)}

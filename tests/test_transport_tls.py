@@ -234,3 +234,73 @@ def test_mac_tamper_detected():
         auth.validate("a", "act", bad)
     with pytest.raises(TransportAuthError):
         auth.validate("a", "act", None)
+
+
+def test_https_rest_server(certs, tmp_path):
+    """The REST port terminates TLS in-process when http.ssl.* is set
+    (SecurityRestFilter / xpack.security.http.ssl analog): https with the
+    CA verifies and serves; plaintext HTTP on the same port fails the
+    handshake and never reaches a handler."""
+    import json
+    import ssl as _ssl
+    import threading
+    import urllib.request
+    import urllib.error
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.http_server import HttpServer
+    from elasticsearch_tpu.server import _http_ssl_context
+
+    settings = {"http.ssl.enabled": "true",
+                "http.ssl.certificate": certs["node"]["cert"],
+                "http.ssl.key": certs["node"]["key"]}
+    node = Node(str(tmp_path))
+    rc = RestController()
+    register_all(rc, node)
+    server = HttpServer(rc, host="127.0.0.1", port=0,
+                        ssl_context=_http_ssl_context(settings))
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(server.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert started.wait(15)
+    port = server.port
+    try:
+        client_ctx = _ssl.create_default_context(
+            cafile=certs["ca"]["cert"])
+        client_ctx.check_hostname = False  # cert carries 127.0.0.1 SAN,
+        # but default hostname checks vary by python build
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/_cluster/health",
+                context=client_ctx, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body["status"] in ("green", "yellow")
+
+        # plaintext on the TLS port fails before any handler runs
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/_cluster/health", timeout=5)
+            raise AssertionError("plaintext request must not succeed")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+
+        # the shipped client speaks https with CA verification
+        from elasticsearch_tpu.client import TpuSearchClient
+        es = TpuSearchClient([f"https://127.0.0.1:{port}"],
+                             ca_certs=certs["ca"]["cert"])
+        assert es.cluster.health()["status"] in ("green", "yellow")
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(10)
+        node.close()
