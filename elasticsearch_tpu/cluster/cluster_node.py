@@ -35,7 +35,9 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster import allocation
-from elasticsearch_tpu.cluster.coordination import LEADER, Coordinator
+from elasticsearch_tpu.cluster.coordination import (
+    FOLLOWER, LEADER, Coordinator,
+)
 from elasticsearch_tpu.cluster.gateway import FilePersistedState
 from elasticsearch_tpu.cluster.routing import shard_id_for
 from elasticsearch_tpu.cluster.state import (
@@ -73,6 +75,13 @@ MASTER_SHARD_STARTED = "internal:cluster/shard/started"
 MASTER_SHARD_FAILED = "internal:cluster/shard/failure"
 MASTER_UPDATE_SETTINGS = "cluster:admin/settings/update"
 MASTER_PUT_REGISTRY = "cluster:admin/registry/update"
+MASTER_PUT_PERSISTENT_TASK = "cluster:admin/persistent/update"
+
+# cluster-state metadata key for persistent background tasks (the
+# reference's PersistentTasksCustomMetaData): task_id -> {params,
+# interval_ms, assigned_node} — the master assigns each task to exactly
+# one live node and reassigns on node-leave
+PERSISTENT_TASKS_KEY = "__persistent_tasks__"
 
 # cluster-state metadata key for replicated registries (ingest pipelines,
 # templates, stored scripts — the reference stores these in MetaData customs:
@@ -126,6 +135,10 @@ class ClusterNode:
         # scroll cursors (coordinator side)
         self._shard_scrolls: Dict[str, dict] = {}
         self._client_scrolls: Dict[str, dict] = {}
+        # persistent-task execution (PersistentTasksExecutor registry):
+        # task_id -> tick callable, supplied by the composition root
+        self.persistent_task_executors: Dict[str, Callable[[], None]] = {}
+        self._running_ptasks: Set[str] = set()
         self.mappers: Dict[str, MapperService] = {}
         from elasticsearch_tpu.search.caches import NodeCaches
         self.caches = NodeCaches()
@@ -185,7 +198,127 @@ class ClusterNode:
             # a fresh node is empty: move shards onto it until node weights
             # converge (BalancedShardsAllocator.balance on reroute)
             state = allocation.rebalance(state)
+        # persistent tasks on departed nodes reassign immediately
+        # (PersistentTasksClusterService.shouldReassignPersistentTasks)
+        state = self._reassign_persistent_tasks(state)
         return state
+
+    @staticmethod
+    def _reassign_persistent_tasks(state: ClusterState) -> ClusterState:
+        tasks = state.metadata.get(PERSISTENT_TASKS_KEY)
+        if not tasks:
+            return state
+        live = sorted(state.nodes)
+        if not live:
+            return state
+        loads = {n: 0 for n in live}
+        for t in tasks.values():
+            if t.get("assigned_node") in loads:
+                loads[t["assigned_node"]] += 1
+        changed = False
+        new_tasks = {}
+        for tid in sorted(tasks):
+            t = dict(tasks[tid])
+            if t.get("assigned_node") not in loads:
+                target = min(live, key=lambda n: (loads[n], n))
+                t["assigned_node"] = target
+                loads[target] += 1
+                changed = True
+            new_tasks[tid] = t
+        if not changed:
+            return state
+        return state.with_(metadata={**state.metadata,
+                                     PERSISTENT_TASKS_KEY: new_tasks})
+
+    def _master_put_persistent_task(self, sender, request, respond):
+        self._require_master()
+        tid = request["task_id"]
+
+        def update(base: ClusterState) -> ClusterState:
+            tasks = {k: dict(v) for k, v in
+                     (base.metadata.get(PERSISTENT_TASKS_KEY) or {}).items()}
+            if request.get("remove"):
+                if tid not in tasks:
+                    return base
+                tasks.pop(tid)
+            else:
+                if tid in tasks:
+                    return base  # idempotent registration
+                tasks[tid] = {"params": request.get("params") or {},
+                              "interval_ms": int(request.get(
+                                  "interval_ms", 1000)),
+                              "assigned_node": None}
+            state = base.with_(metadata={**base.metadata,
+                                         PERSISTENT_TASKS_KEY: tasks})
+            return self._reassign_persistent_tasks(state)
+
+        self._publish_then_respond(update, respond, {"acknowledged": True},
+                                   source=f"persistent-task [{tid}]")
+
+    def client_register_persistent_task(self, task_id: str,
+                                        params: Optional[dict] = None,
+                                        interval_ms: int = 1000,
+                                        on_done: Optional[Callable] = None,
+                                        on_failure: Optional[Callable] = None
+                                        ) -> None:
+        self._send_to_master(MASTER_PUT_PERSISTENT_TASK,
+                             {"task_id": task_id, "params": params,
+                              "interval_ms": interval_ms},
+                             on_response=on_done or (lambda r: None),
+                             on_failure=on_failure)
+
+    def client_remove_persistent_task(self, task_id: str,
+                                      on_done: Optional[Callable] = None,
+                                      on_failure: Optional[Callable] = None
+                                      ) -> None:
+        self._send_to_master(MASTER_PUT_PERSISTENT_TASK,
+                             {"task_id": task_id, "remove": True},
+                             on_response=on_done or (lambda r: None),
+                             on_failure=on_failure)
+
+    # node-side execution: a ticker per task assigned to THIS node,
+    # started/stopped as committed states change ownership
+    def _sync_persistent_tasks(self, state: ClusterState) -> None:
+        tasks = state.metadata.get(PERSISTENT_TASKS_KEY) or {}
+        mine = {tid for tid, t in tasks.items()
+                if t.get("assigned_node") == self.node_id
+                and tid in self.persistent_task_executors}
+        for tid in mine - self._running_ptasks:
+            self._running_ptasks.add(tid)
+            interval = int(tasks[tid].get("interval_ms", 1000))
+            self._schedule_ptask_tick(tid, interval)
+        # tasks no longer mine stop at their next tick check (the loop
+        # discards itself from _running_ptasks there — removing here
+        # could double-schedule on a fast unassign/reassign cycle)
+
+    def _schedule_ptask_tick(self, tid: str, interval: int) -> None:
+        def tick():
+            tasks = self.cluster_state.metadata.get(
+                PERSISTENT_TASKS_KEY) or {}
+            t = tasks.get(tid)
+            if t is None or t.get("assigned_node") != self.node_id \
+                    or self.coordinator.stopped \
+                    or self.node_id not in self.cluster_state.nodes:
+                self._running_ptasks.discard(tid)
+                return
+            # partition guard: a node cut off from the master may hold a
+            # stale assignment while a new owner starts; once fault
+            # detection demotes this node to CANDIDATE it pauses execution
+            # (keeps the loop) until it rejoins — bounding dual execution
+            # to the detection window, like the reference's reassignment
+            has_cluster = self.coordinator.mode in (LEADER, FOLLOWER)
+            fn = self.persistent_task_executors.get(tid)
+            if fn is not None and has_cluster:
+                try:
+                    fn()
+                except Exception:
+                    pass  # a failing feature tick must not kill the loop
+            # interval is re-read so a remove + re-register with a new
+            # cadence takes effect at the next tick
+            self._schedule_ptask_tick(
+                tid, int(t.get("interval_ms", interval)))
+        self.scheduler.schedule_in(interval, tick,
+                                   f"persistent_task:{tid}:{self.node_id}")
 
     def _require_master(self):
         if self.coordinator.mode != LEADER:
@@ -417,6 +550,7 @@ class ClusterNode:
                     local.tracker = ReplicationTracker(entry.allocation_id)
                     local.tracker.activate_primary_mode(local.engine.local_checkpoint)
 
+        self._sync_persistent_tasks(state)
         for listener in self.state_listeners:
             try:
                 listener(state)
@@ -1606,6 +1740,8 @@ class ClusterNode:
         t.register(me, MASTER_SHARD_FAILED, self._master_shard_failed)
         t.register(me, MASTER_UPDATE_SETTINGS, self._master_update_settings)
         t.register(me, MASTER_PUT_REGISTRY, self._master_put_registry)
+        t.register(me, MASTER_PUT_PERSISTENT_TASK,
+                   self._master_put_persistent_task)
 
     # client admin helpers ----------------------------------------------------
     def client_create_index(self, name: str, settings: Optional[dict] = None,

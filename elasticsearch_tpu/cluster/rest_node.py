@@ -25,6 +25,7 @@ node is visible cluster-wide after publication.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -63,6 +64,82 @@ class ClusterAwareNode(Node):
         self.cluster = cluster_node
         self.loop = loop
         self._wire_replicated_registries()
+        self._wire_persistent_features()
+
+    def _wire_persistent_features(self) -> None:
+        """Background features run as cluster-assigned persistent tasks
+        (PersistentTasksClusterService): the master picks exactly ONE node
+        to tick ILM / SLM / watcher, with reassignment on node-leave —
+        instead of every node ticking its own copy."""
+        from elasticsearch_tpu.xpack.watcher import WatcherService
+
+        def _bg(fn):
+            # ticks fire on the event loop; the feature work itself (which
+            # may write through the cluster and block on the loop) runs on
+            # the generic pool — running it inline would deadlock
+            def tick():
+                try:
+                    self.thread_pool.submit("generic", fn)
+                except Exception:
+                    pass
+            return tick
+
+        self.cluster.persistent_task_executors.update({
+            "watcher": _bg(lambda: self.watcher.run_once()),
+            "ilm": _bg(lambda: self.ilm.run_once()),
+            "slm": _bg(lambda: self.slm.run_once()),
+        })
+
+        # watches replicate through cluster state like the other
+        # registries, so the assigned executor node sees every watch
+        watcher = self.watcher
+        orig_put_watch = WatcherService.put_watch.__get__(watcher)
+        orig_del_watch = WatcherService.delete_watch.__get__(watcher)
+        node = self
+
+        record = functools.partial(self._record_registry, "watches")
+
+        def put_watch(watch_id, body, active=True):
+            WatcherService.validate_watch(body)
+            created = watch_id not in watcher.watches
+            value = {"body": body, "active": active}
+            node._call(node.cluster.client_put_registry,
+                       "watches", watch_id, value)
+            out = orig_put_watch(watch_id, body, active=active)
+            record(watch_id, value)
+            # the registry sync may have applied the watch an instant
+            # before the local call: report created from the pre-call view
+            out["created"] = created
+            return out
+
+        def delete_watch(watch_id):
+            watcher.get_watch(watch_id)  # 404 before cluster traffic
+            node._call(node.cluster.client_put_registry,
+                       "watches", watch_id, None)
+            try:
+                orig_del_watch(watch_id)
+            except Exception:
+                pass  # the registry sync may have removed it already
+            record(watch_id, None)
+
+        watcher.put_watch = put_watch
+        watcher.delete_watch = delete_watch
+        self._registry_originals["watch"] = \
+            lambda key, value: orig_put_watch(
+                key, value["body"], active=value.get("active", True))
+        self._registry_originals["del_watch"] = orig_del_watch
+        self._registry_sections = getattr(self, "_registry_sections", ()) + (
+            ("watches", self._registry_originals["watch"],
+             self._registry_originals["del_watch"]),)
+
+    def register_builtin_persistent_tasks(self) -> None:
+        """Called once post-boot: idempotent registrations (the master's
+        task-update no-ops when the id exists)."""
+        for tid, interval in (("watcher", 1000), ("ilm", 30_000),
+                              ("slm", 60_000)):
+            self.cluster.client_register_persistent_task(
+                tid, interval_ms=interval, on_done=lambda r: None,
+                on_failure=lambda e: None)
 
     # --------------------------------------------------- replicated registries
     def _wire_replicated_registries(self) -> None:
@@ -92,12 +169,7 @@ class ClusterAwareNode(Node):
         orig_put_script = ScriptService.put_stored.__get__(scripts)
         orig_del_script = ScriptService.delete_stored.__get__(scripts)
 
-        def record(section, key, value):
-            regs = node._applied_registries.setdefault(section, {})
-            if value is None:
-                regs.pop(key, None)
-            else:
-                regs[key] = value
+        record = self._record_registry
 
         # order: VALIDATE locally, REPLICATE (raises on failure — nothing
         # applied anywhere), then apply locally and record ownership; a
@@ -159,6 +231,14 @@ class ClusterAwareNode(Node):
             "del_template": orig_del_template, "del_script": orig_del_script}
         self.cluster.state_listeners.append(self._sync_registries)
 
+    def _record_registry(self, section, key, value) -> None:
+        """Track what this node applied locally (the sync's diff base)."""
+        regs = self._applied_registries.setdefault(section, {})
+        if value is None:
+            regs.pop(key, None)
+        else:
+            regs[key] = value
+
     def _sync_registries(self, state) -> None:
         """Reconcile local registries to the cluster-state truth: apply
         adds AND updates (compared against what this node last applied),
@@ -183,7 +263,7 @@ class ClusterAwareNode(Node):
             ("templates", put_template, del_template),
             ("scripts", self._registry_originals["script"],
              self._registry_originals["del_script"]),
-        )
+        ) + tuple(getattr(self, "_registry_sections", ()))
         for section, put_fn, del_fn in sections:
             want = regs.get(section) or {}
             have = applied.setdefault(section, {})

@@ -227,6 +227,7 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
                             thread_pool=aware.thread_pool,
                             ssl_context=_http_ssl_context(settings))
         await server.start()
+        aware.register_builtin_persistent_tasks()
         print(f"[{node_id}] listening on http://{args.host}:{server.port} "
               f"(data: {args.data}, cluster: {args.cluster_name})", flush=True)
         bootstrap.sd_notify("READY=1")

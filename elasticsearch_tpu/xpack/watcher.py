@@ -56,10 +56,14 @@ class WatcherService:
         self.running = True
 
     # -- CRUD -----------------------------------------------------------------
-    def put_watch(self, watch_id: str, body: dict, active: bool = True) -> dict:
+    @staticmethod
+    def validate_watch(body: dict) -> None:
         for part in ("trigger", "actions"):
             if part not in body:
                 raise ValidationError(f"watch must define [{part}]")
+
+    def put_watch(self, watch_id: str, body: dict, active: bool = True) -> dict:
+        self.validate_watch(body)
         created = watch_id not in self.watches
         self.watches[watch_id] = body
         self.state[watch_id] = {
