@@ -419,10 +419,13 @@ class ClusterAwareNode(Node):
     def delete_doc(self, index: str, doc_id: str, refresh: Optional[str] = None,
                    routing: Optional[str] = None,
                    if_seq_no: Optional[int] = None,
-                   if_primary_term: Optional[int] = None) -> dict:
+                   if_primary_term: Optional[int] = None,
+                   version: Optional[int] = None,
+                   version_type: str = "internal") -> dict:
         self._meta(index)
         op = {"type": "delete", "id": str(doc_id), "routing": routing,
-              "if_seq_no": if_seq_no, "if_primary_term": if_primary_term}
+              "if_seq_no": if_seq_no, "if_primary_term": if_primary_term,
+              "version": version, "version_type": version_type}
         resp = self._write_with_retry(index, op)
         self._maybe_cluster_refresh(index, refresh)
         out = {"_index": index, "_id": doc_id,
@@ -441,45 +444,86 @@ class ClusterAwareNode(Node):
                           routing=routing)
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   refresh: Optional[str] = None) -> dict:
+                   refresh: Optional[str] = None,
+                   routing: Optional[str] = None,
+                   if_seq_no: Optional[int] = None,
+                   if_primary_term: Optional[int] = None,
+                   source_filter=None) -> dict:
         import copy as _copy
 
         from elasticsearch_tpu.common.errors import DocumentMissingError
         from elasticsearch_tpu.node import _apply_update_script, _deep_merge
-        existing = self.get_doc(index, doc_id)
+        self._validate_update_body(body)
+        if source_filter is None and body and "_source" in body:
+            source_filter = body["_source"]
+
+        def _with_get(out, src):
+            if source_filter is not None and source_filter is not False:
+                doc = {"_source": _copy.deepcopy(src)}
+                self._apply_mget_projection(doc, {}, None, index,
+                                            source_filter)
+                out["get"] = {"_source": doc.get("_source", {}),
+                              "found": True}
+            return out
+
+        existing = self.get_doc(index, doc_id, routing=routing)
         if not existing.get("found"):
             if "upsert" in body:
-                return self.index_doc(index, doc_id, body["upsert"],
-                                      refresh=refresh)
+                return _with_get(
+                    self.index_doc(index, doc_id, body["upsert"],
+                                   refresh=refresh, routing=routing),
+                    body["upsert"])
             if body.get("doc_as_upsert") and "doc" in body:
-                return self.index_doc(index, doc_id, body["doc"],
-                                      refresh=refresh)
+                return _with_get(
+                    self.index_doc(index, doc_id, body["doc"],
+                                   refresh=refresh, routing=routing),
+                    body["doc"])
             raise DocumentMissingError(f"[{doc_id}]: document missing")
+        if if_seq_no is not None and existing["_seq_no"] != if_seq_no or \
+                if_primary_term is not None \
+                and existing.get("_primary_term") != if_primary_term:
+            from elasticsearch_tpu.common.errors import VersionConflictError
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, required seqNo "
+                f"[{if_seq_no}], primary term [{if_primary_term}]")
         source = _copy.deepcopy(existing["_source"])
         if "doc" in body:
             _deep_merge(source, body["doc"])
+            if body.get("detect_noop", True) \
+                    and source == existing["_source"]:
+                return _with_get({
+                    "_index": index, "_id": doc_id,
+                    "_version": existing["_version"], "result": "noop",
+                    "_seq_no": existing["_seq_no"],
+                    "_primary_term": existing.get("_primary_term", 1),
+                    "_shards": {"total": 0, "successful": 0,
+                                "failed": 0}}, source)
         elif "script" in body:
             verdict: Dict[str, Any] = {}
             source = _apply_update_script(source, body["script"],
                                           ctx_extra=verdict)
             op = verdict.get("op", "index")
             if op == "none":
-                return {"_index": index, "_id": doc_id,
-                        "_version": existing["_version"], "result": "noop",
-                        "_seq_no": existing["_seq_no"],
-                        "_primary_term": existing.get("_primary_term", 1),
-                        "_shards": {"total": 0, "successful": 0, "failed": 0}}
+                return _with_get({
+                    "_index": index, "_id": doc_id,
+                    "_version": existing["_version"], "result": "noop",
+                    "_seq_no": existing["_seq_no"],
+                    "_primary_term": existing.get("_primary_term", 1),
+                    "_shards": {"total": 0, "successful": 0,
+                                "failed": 0}}, source)
             if op == "delete":
-                out = self.delete_doc(index, doc_id, refresh=refresh)
+                out = self.delete_doc(index, doc_id, refresh=refresh,
+                                      routing=routing)
                 out["result"] = "deleted"
                 return out
         else:
             raise IllegalArgumentError("update requires [doc] or [script]")
         out = self.index_doc(index, doc_id, source, refresh=refresh,
+                             routing=routing,
                              if_seq_no=existing["_seq_no"],
                              if_primary_term=existing.get("_primary_term"))
         out["result"] = "updated"
-        return out
+        return _with_get(out, source)
 
     # --------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict],

@@ -106,6 +106,11 @@ class VersionConflictError(SearchEngineError):
     Reference: `index/engine/VersionConflictEngineException.java`.
     """
 
+    @property
+    def error_type(self) -> str:
+        # the engine-layer name the REST layer exposes
+        return "version_conflict_engine_exception"
+
     status = 409
 
 

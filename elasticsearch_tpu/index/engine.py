@@ -112,7 +112,8 @@ class Engine:
                                       if_seq_no, if_primary_term, op_type)
                 seq_no = self.tracker.generate_seq_no()
                 primary_term = self.primary_term
-                if version_type == "external":
+                if version_type in ("external", "external_gt",
+                                    "external_gte"):
                     new_version = version
                 else:
                     new_version = 1 if existing is None or existing.deleted else existing.version + 1
@@ -217,9 +218,15 @@ class Engine:
                     f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
                     f"primary term [{if_primary_term}], current document has "
                     f"seqNo [{existing.seq_no}] and primary term [{existing.primary_term}]")
-        if version_type == "external" and version is not None:
-            current = 0 if existing is None or existing.deleted else existing.version
-            if version <= current:
+        if version_type in ("external", "external_gt", "external_gte") \
+                and version is not None:
+            # a missing doc compares as NOT_FOUND (-1), so external
+            # version 0 is creatable (VersionType.EXTERNAL)
+            current = -1 if existing is None or existing.deleted \
+                else existing.version
+            # external requires strictly greater; external_gte allows equal
+            if (version < current) if version_type == "external_gte" \
+                    else (version <= current):
                 raise VersionConflictError(
                     f"[{doc_id}]: version conflict, current version [{current}] is higher "
                     f"or equal to the one provided [{version}]")

@@ -1128,17 +1128,29 @@ class MapperService:
         self._meta: dict = {}
         # set on any mapping mutation; cleared by whoever persists the mapping
         self.dirty = False
+        self.source_enabled = True
         if mapping:
             self.merge(mapping)
 
     # -- mapping CRUD --------------------------------------------------------
     def merge(self, mapping: dict) -> None:
-        props = mapping.get("properties", mapping if "properties" not in mapping else {})
+        if "properties" in mapping:
+            props = mapping["properties"]
+        else:
+            # a bare props dict; strip metadata sections (_source/_meta/
+            # dynamic/_routing) which are NOT field definitions
+            props = {k: v for k, v in mapping.items()
+                     if not k.startswith("_") and k != "dynamic"}
         if "dynamic" in mapping:
             dyn = mapping["dynamic"]
             self.dynamic = dyn if isinstance(dyn, bool) else dyn == "true"
         if "_meta" in mapping:
             self._meta = mapping["_meta"]
+        if isinstance(mapping.get("_source"), dict) \
+                and mapping["_source"].get("enabled") is False:
+            # _source disabled: stored internally (the engine needs it),
+            # but never rendered and GET /_source 404s
+            self.source_enabled = False
         self._merge_props(props, prefix="")
 
     def _merge_props(self, props: dict, prefix: str) -> None:

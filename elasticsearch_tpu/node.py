@@ -18,7 +18,7 @@ import numpy as np
 
 from elasticsearch_tpu.common.errors import (
     DocumentMissingError, IllegalArgumentError, IndexNotFoundError,
-    SearchEngineError, VersionConflictError,
+    ParsingError, SearchEngineError, VersionConflictError,
 )
 from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY
 from elasticsearch_tpu.indices.service import (
@@ -316,6 +316,14 @@ class Node:
         if doc_id is None:
             doc_id = _uuid.uuid4().hex[:20]
             op_type = "create"
+        if len(str(doc_id).encode("utf-8")) > 512:
+            raise IllegalArgumentError(
+                f"id [{doc_id}] is too long, must be no longer than 512 "
+                f"bytes but was: {len(str(doc_id).encode('utf-8'))}")
+        if op_type == "create" and version_type != "internal":
+            raise IllegalArgumentError(
+                "create operations only support internal versioning. use "
+                "index instead")
         shard = svc.route(doc_id, routing)
         t0 = time.monotonic()
         result = shard.engine.index(
@@ -325,7 +333,7 @@ class Node:
         self.counters["index"] += 1
         self.indexing_slow_log.maybe_log(
             svc.settings, svc.name, time.monotonic() - t0, source=body)
-        self._maybe_refresh(svc, refresh)
+        self._maybe_refresh(svc, refresh, shard=shard)
         if svc.mapper_service.dirty:
             # persist only on real dynamic-mapping changes, not per document
             self.indices._persist_meta(svc)
@@ -352,7 +360,9 @@ class Node:
             return {"_index": svc.name, "_id": doc_id, "found": False}
         out = {"_index": svc.name, "_id": doc_id, "_version": doc["_version"],
                "_seq_no": doc["_seq_no"], "_primary_term": doc["_primary_term"],
-               "found": True, "_source": doc["_source"]}
+               "found": True}
+        if svc.mapper_service.source_enabled:
+            out["_source"] = doc["_source"]
         if doc.get("_routing") is not None:
             out["_routing"] = doc["_routing"]
         return out
@@ -360,13 +370,17 @@ class Node:
     def delete_doc(self, index: str, doc_id: str, refresh: Optional[str] = None,
                    routing: Optional[str] = None,
                    if_seq_no: Optional[int] = None,
-                   if_primary_term: Optional[int] = None) -> dict:
+                   if_primary_term: Optional[int] = None,
+                   version: Optional[int] = None,
+                   version_type: str = "internal") -> dict:
         svc = self.indices.check_open(self.indices.get(index))
         shard = svc.route(doc_id, routing)
         self.counters["delete"] += 1
         result = shard.engine.delete(doc_id, if_seq_no=if_seq_no,
-                                     if_primary_term=if_primary_term)
-        self._maybe_refresh(svc, refresh)
+                                     if_primary_term=if_primary_term,
+                                     version=version,
+                                     version_type=version_type)
+        self._maybe_refresh(svc, refresh, shard=shard)
         out = {"_index": svc.name, "_id": doc_id, "_version": result.version,
                "result": "deleted", "_seq_no": result.seq_no,
                "_primary_term": result.primary_term,
@@ -375,24 +389,81 @@ class Node:
             out["forced_refresh"] = True
         return out
 
+    _UPDATE_FIELDS = ["doc", "script", "upsert", "doc_as_upsert",
+                      "scripted_upsert", "detect_noop", "_source",
+                      "if_seq_no", "if_primary_term", "lang"]
+
+    @classmethod
+    def _validate_update_body(cls, body: Optional[dict]) -> None:
+        import difflib as _difflib
+        for k in body or {}:
+            if k not in cls._UPDATE_FIELDS:
+                close = _difflib.get_close_matches(k, cls._UPDATE_FIELDS,
+                                                   n=1)
+                hint = f" did you mean [{close[0]}]?" if close else ""
+                raise ParsingError(
+                    f"[UpdateRequest] unknown field [{k}]{hint}")
+
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   refresh: Optional[str] = None) -> dict:
+                   refresh: Optional[str] = None,
+                   routing: Optional[str] = None,
+                   if_seq_no: Optional[int] = None,
+                   if_primary_term: Optional[int] = None,
+                   source_filter=None) -> dict:
         """_update API: partial doc merge, script update, upsert.
 
         Reference: `action/update/UpdateHelper.java`.
         """
-        svc = self.indices.check_open(self.indices.get(index))
-        shard = svc.route(doc_id, None)
+        self._validate_update_body(body)
+        if source_filter is None and body and "_source" in body:
+            # body-level _source is the documented alternative to the
+            # query param (UpdateRequest fetchSource)
+            source_filter = body["_source"]
+        # update auto-creates its index like the index API
+        # (TransportUpdateAction routes through auto-create)
+        svc = self.indices.check_open(self._index_or_autocreate(index))
+        shard = svc.route(doc_id, routing)
         existing = shard.engine.get(doc_id)
+
+        def _with_get(out, src):
+            if source_filter is not None and source_filter is not False:
+                doc = {"_source": copy.deepcopy(src)}
+                self._apply_mget_projection(doc, {}, None, svc.name,
+                                            source_filter)
+                out["get"] = {"_source": doc.get("_source", {}),
+                              "found": True}
+            return out
+
         if existing is None:
             if "upsert" in body:
-                return self.index_doc(index, doc_id, body["upsert"], refresh=refresh)
+                out = self.index_doc(svc.name, doc_id, body["upsert"],
+                                     refresh=refresh, routing=routing)
+                return _with_get(out, body["upsert"])
             if body.get("doc_as_upsert") and "doc" in body:
-                return self.index_doc(index, doc_id, body["doc"], refresh=refresh)
+                out = self.index_doc(svc.name, doc_id, body["doc"],
+                                     refresh=refresh, routing=routing)
+                return _with_get(out, body["doc"])
             raise DocumentMissingError(f"[{doc_id}]: document missing")
+        if if_seq_no is not None and existing["_seq_no"] != if_seq_no or \
+                if_primary_term is not None \
+                and existing["_primary_term"] != if_primary_term:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, required seqNo "
+                f"[{if_seq_no}], primary term [{if_primary_term}], "
+                f"current document has seqNo [{existing['_seq_no']}] and "
+                f"primary term [{existing['_primary_term']}]")
         source = copy.deepcopy(existing["_source"])
         if "doc" in body:
             _deep_merge(source, body["doc"])
+            if body.get("detect_noop", True) \
+                    and source == existing["_source"]:
+                return _with_get({
+                    "_index": svc.name, "_id": doc_id,
+                    "_version": existing["_version"], "result": "noop",
+                    "_seq_no": existing["_seq_no"],
+                    "_primary_term": existing["_primary_term"],
+                    "_shards": {"total": 0, "successful": 0,
+                                "failed": 0}}, source)
         elif "script" in body:
             verdict: Dict[str, Any] = {}
             source = _apply_update_script(source, body["script"],
@@ -412,11 +483,12 @@ class Node:
                 return out
         else:
             raise IllegalArgumentError("update requires [doc] or [script]")
-        out = self.index_doc(index, doc_id, source, refresh=refresh,
+        out = self.index_doc(svc.name, doc_id, source, refresh=refresh,
+                             routing=routing,
                              if_seq_no=existing["_seq_no"],
                              if_primary_term=existing["_primary_term"])
         out["result"] = "updated"
-        return out
+        return _with_get(out, source)
 
     def mget(self, body: dict, default_index: Optional[str] = None,
              stored_fields=None, realtime: bool = True,
@@ -503,7 +575,9 @@ class Node:
                     fields[fname] = val if isinstance(val, list) else [val]
             if fields:
                 doc["fields"] = fields
-            if "_source" not in sf:
+            # stored_fields suppress _source unless the caller asked for
+            # it explicitly (via the list or a truthy _source param)
+            if "_source" not in sf and spec.get("_source") in (None, False):
                 doc.pop("_source", None)
         src_spec = spec.get("_source")
         if src_spec is False:
@@ -521,13 +595,28 @@ class Node:
                 doc["_source"] = _filter_source(doc["_source"], inc, exc)
 
     def bulk(self, operations: List[dict], default_index: Optional[str] = None,
-             refresh: Optional[str] = None) -> dict:
+             refresh: Optional[str] = None, source_filter=None) -> dict:
         """_bulk: list of {action: meta} / source pairs already decoded.
 
         Reference: `TransportBulkAction` §3.3 — here single-node, grouped by
         shard implicitly by the engine's per-shard lock.
         """
         self.counters["bulk"] += 1
+        # parse-time validation of every action line BEFORE any item
+        # executes: a rejected request must not be partially applied
+        # (BulkRequestParser rejects during parsing)
+        ln = 0
+        for j, line in enumerate(operations):
+            if j != ln:
+                continue
+            ((act, m),) = line.items()
+            for dep in ("_version", "_routing", "_parent", "fields",
+                        "_version_type", "_retry_on_conflict"):
+                if dep in m:
+                    raise IllegalArgumentError(
+                        f"Action/metadata line [{j + 1}] contains an "
+                        f"unknown parameter [{dep}]")
+            ln += 1 if act == "delete" else 2
         items = []
         errors = False
         touched = set()
@@ -540,20 +629,51 @@ class Node:
             doc_id = meta.get("_id")
             if doc_id is not None:
                 doc_id = str(doc_id)  # numeric ids arrive as JSON numbers
+            routing = meta.get("routing")
+            if_seq_no = meta.get("if_seq_no")
+            if_primary_term = meta.get("if_primary_term")
             try:
                 if action in ("index", "create"):
                     source = operations[i]
                     i += 1
-                    resp = self.index_doc(index, doc_id, source,
-                                          op_type="create" if action == "create" else "index")
+                    if doc_id == "":
+                        raise IllegalArgumentError(
+                            "if _id is specified it must not be empty")
+                    op_type = "create" if action == "create" \
+                        else meta.get("op_type", "index")
+                    resp = self.index_doc(
+                        index, doc_id, source, op_type=op_type,
+                        routing=routing, if_seq_no=if_seq_no,
+                        if_primary_term=if_primary_term,
+                        version=meta.get("version"),
+                        version_type=meta.get("version_type", "internal"))
                     status = 201 if resp["result"] == "created" else 200
+                    # `index` + op_type create reports under `create`
+                    # (BulkItemResponse opType rendering)
+                    action = "create" if op_type == "create" else action
                 elif action == "update":
                     body = operations[i]
                     i += 1
-                    resp = self.update_doc(index, doc_id, body)
+                    if doc_id == "":
+                        raise IllegalArgumentError(
+                            "if _id is specified it must not be empty")
+                    src_spec = (body.pop("_source", None)
+                                if isinstance(body, dict) else None)
+                    if src_spec is None:
+                        src_spec = meta.get("_source", source_filter)
+                    resp = self.update_doc(index, doc_id, body,
+                                           routing=routing,
+                                           if_seq_no=if_seq_no,
+                                           if_primary_term=if_primary_term,
+                                           source_filter=src_spec)
                     status = 200
                 elif action == "delete":
-                    resp = self.delete_doc(index, doc_id)
+                    resp = self.delete_doc(
+                        index, doc_id, routing=routing,
+                        if_seq_no=if_seq_no,
+                        if_primary_term=if_primary_term,
+                        version=meta.get("version"),
+                        version_type=meta.get("version_type", "internal"))
                     status = 200
                 else:
                     raise IllegalArgumentError(
@@ -596,8 +716,9 @@ class Node:
         merged_mappings = {"properties": dict(resolved["mappings"]["properties"])}
         for k, v in ((mappings or {}).get("properties") or {}).items():
             merged_mappings["properties"][k] = v
-        if mappings and "dynamic" in mappings:
-            merged_mappings["dynamic"] = mappings["dynamic"]
+        for meta_key in ("dynamic", "_source", "_meta", "_routing"):
+            if mappings and meta_key in mappings:
+                merged_mappings[meta_key] = mappings[meta_key]
         merged_aliases = dict(resolved["aliases"])
         merged_aliases.update(aliases or {})
         return self.indices.create_index(
@@ -662,9 +783,15 @@ class Node:
                          "hits": hits}}
 
     @staticmethod
-    def _maybe_refresh(svc: IndexService, refresh) -> None:
+    def _maybe_refresh(svc: IndexService, refresh, shard=None) -> None:
+        # a doc-level ?refresh=true refreshes only the TARGET shard
+        # (TransportShardBulkAction) — other shards' unrefreshed
+        # tombstones/docs must stay invisible
         if refresh in ("true", "wait_for", True, ""):
-            svc.refresh()
+            if shard is not None:
+                shard.engine.refresh()
+            else:
+                svc.refresh()
 
     def _refresh_indices(self, names) -> None:
         """Refresh hook for bulk epilogues — overridden by the clustered

@@ -83,19 +83,56 @@ def register_all(rc: RestController, node: Node) -> None:
                               routing=req.param("routing"))
         return 201, resp
 
+    def _get_source_filter(req):
+        src = req.param("_source")
+        inc, exc = req.param("_source_includes"), req.param("_source_excludes")
+        source_filter = None
+        if isinstance(src, str) and src.lower() == "false" or src is False:
+            source_filter = False
+        elif isinstance(src, str) and src.lower() == "true" or src is True:
+            source_filter = True
+        elif src:
+            source_filter = src.split(",") if isinstance(src, str) else src
+        if inc or exc:
+            source_filter = {"includes": inc.split(",") if inc else [],
+                             "excludes": exc.split(",") if exc else []}
+        return source_filter
+
     def get_doc(req):
+        from elasticsearch_tpu.common.errors import VersionConflictError
+        if req.bool_param("refresh", False):
+            # overridable: clustered nodes broadcast, local ones refresh
+            # the service directly
+            node._refresh_indices([req.params["index"]])
         resp = node.get_doc(req.params["index"], req.params["id"],
                             routing=req.param("routing"),
                             realtime=req.bool_param("realtime", True))
+        v = req.int_param("version")
+        if v is not None and resp.get("found") \
+                and resp.get("_version") != v:
+            raise VersionConflictError(
+                f"[{req.params['id']}]: version conflict, current version "
+                f"[{resp.get('_version')}] is different than the one "
+                f"provided [{v}]")
+        sf = req.param("stored_fields")
+        node._apply_mget_projection(
+            resp, {}, sf.split(",") if sf else None,
+            req.params["index"], _get_source_filter(req))
         return (200 if resp.get("found") else 404), resp
 
     def get_source(req):
+        if req.bool_param("refresh", False):
+            node._refresh_indices([req.params["index"]])
         resp = node.get_doc(req.params["index"], req.params["id"],
                             routing=req.param("routing"),
                             realtime=req.bool_param("realtime", True))
-        if not resp.get("found"):
-            return 404, {"error": f"document [{req.params['id']}] not found"}
-        return 200, resp["_source"]
+        if not resp.get("found") or "_source" not in resp:
+            # missing doc OR _source disabled in the mapping: both 404
+            # (RestGetSourceAction)
+            return 404, {"error": f"source [{req.params['id']}] not found"}
+        node._apply_mget_projection(resp, {}, None, req.params["index"],
+                                    _get_source_filter(req))
+        return 200, resp.get("_source")
 
     def delete_doc(req):
         try:
@@ -103,15 +140,23 @@ def register_all(rc: RestController, node: Node) -> None:
                                    refresh=req.param("refresh"),
                                    routing=req.param("routing"),
                                    if_seq_no=req.int_param("if_seq_no"),
-                                   if_primary_term=req.int_param("if_primary_term"))
+                                   if_primary_term=req.int_param("if_primary_term"),
+                                   version=req.int_param("version"),
+                                   version_type=req.param("version_type",
+                                                          "internal"))
             return 200, resp
         except DocumentMissingError:
             return 404, {"_index": req.params["index"], "_id": req.params["id"],
                          "result": "not_found"}
 
     def update_doc(req):
-        return 200, node.update_doc(req.params["index"], req.params["id"],
-                                    req.json() or {}, refresh=req.param("refresh"))
+        return 200, node.update_doc(
+            req.params["index"], req.params["id"], req.json() or {},
+            refresh=req.param("refresh"),
+            routing=req.param("routing"),
+            if_seq_no=req.int_param("if_seq_no"),
+            if_primary_term=req.int_param("if_primary_term"),
+            source_filter=_get_source_filter(req))
 
     rc.register("PUT", "/{index}/_doc/{id}", put_doc)
     rc.register("POST", "/{index}/_doc/{id}", put_doc)
@@ -201,7 +246,8 @@ def register_all(rc: RestController, node: Node) -> None:
     def bulk(req):
         return 200, node.bulk(req.ndjson(),
                               default_index=req.params.get("index"),
-                              refresh=req.param("refresh"))
+                              refresh=req.param("refresh"),
+                              source_filter=_get_source_filter(req))
 
     rc.register("POST", "/_bulk", bulk)
     rc.register("PUT", "/_bulk", bulk)
@@ -209,24 +255,12 @@ def register_all(rc: RestController, node: Node) -> None:
 
     def mget(req):
         sf = req.param("stored_fields")
-        src = req.param("_source")
-        inc, exc = req.param("_source_includes"), req.param("_source_excludes")
-        source_filter = None
-        if src == "false":
-            source_filter = False
-        elif src == "true":
-            source_filter = True
-        elif src:
-            source_filter = src.split(",")
-        if inc or exc:
-            source_filter = {"includes": inc.split(",") if inc else [],
-                             "excludes": exc.split(",") if exc else []}
         return 200, node.mget(
             req.json() or {}, req.params.get("index"),
             stored_fields=sf.split(",") if sf else None,
             realtime=req.param("realtime") not in ("false", False),
             refresh=req.param("refresh") in ("true", "", True),
-            source_filter=source_filter)
+            source_filter=_get_source_filter(req))
 
     rc.register("GET", "/_mget", mget)
     rc.register("POST", "/_mget", mget)

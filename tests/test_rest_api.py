@@ -70,7 +70,7 @@ def test_create_conflict_and_optimistic_concurrency(client):
     client.req("PUT", "/idx/_doc/1", {"a": 1})
     status, body = client.req("PUT", "/idx/_create/1", {"a": 2})
     assert status == 409
-    assert body["error"]["type"] == "version_conflict_exception"
+    assert body["error"]["type"] == "version_conflict_engine_exception"
 
     status, ok = client.req("GET", "/idx/_doc/1")
     status, body = client.req("PUT", "/idx/_doc/1", {"a": 3},

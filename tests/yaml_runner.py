@@ -363,8 +363,14 @@ class YamlTestRunner:
             raise StepSkip("node_selector not supported")
         ((api_name, raw_args),) = spec.items()
         args = _stash_sub(raw_args or {}, stash)
+        ignore = args.pop("ignore", None) if isinstance(args, dict) else None
+        ignored = ([int(s) for s in ignore] if isinstance(ignore, list)
+                   else [int(ignore)] if ignore is not None else [])
         method, path, query, body = resolve_call(api_name, args)
         status, resp = client.req(method, path, body=body, **query)
+        if status in ignored:
+            stash["__last__"] = resp
+            return
         if method == "HEAD":
             # HEAD APIs (exists/ping) have no body: the runner exposes the
             # existence boolean, and a 404 is the valid `false` answer —
