@@ -29,7 +29,8 @@ import threading
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 from elasticsearch_tpu.common.errors import (
-    DocumentMissingError, SearchEngineError, VersionConflictError,
+    DocumentMissingError, IllegalArgumentError, SearchEngineError,
+    VersionConflictError,
 )
 from elasticsearch_tpu.index.mapping import MapperService
 from elasticsearch_tpu.index.segment import (
@@ -218,6 +219,11 @@ class Engine:
                     f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
                     f"primary term [{if_primary_term}], current document has "
                     f"seqNo [{existing.seq_no}] and primary term [{existing.primary_term}]")
+        if version_type in ("external", "external_gt", "external_gte") \
+                and version is None:
+            raise IllegalArgumentError(
+                f"[{doc_id}]: external version type requires an explicit "
+                f"version")
         if version_type in ("external", "external_gt", "external_gte") \
                 and version is not None:
             # a missing doc compares as NOT_FOUND (-1), so external

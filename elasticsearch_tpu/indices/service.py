@@ -122,10 +122,15 @@ class IndexService:
                 raise IllegalArgumentError(
                     f"setting [{key}] is not dynamically updateable")
         merged = dict(self.settings.as_flat_dict())
-        merged.update(updates)
+        for k, v in updates.items():
+            if v is None:
+                merged.pop(k, None)  # null resets to the default
+            else:
+                merged[k] = v
         self.settings = Settings.of(merged)
         if "index.number_of_replicas" in updates:
-            self.num_replicas = int(updates["index.number_of_replicas"])
+            v = updates["index.number_of_replicas"]
+            self.num_replicas = 1 if v is None else int(v)  # null = default
 
     def route(self, doc_id: str, routing: Optional[str] = None) -> IndexShardHandle:
         sid = shard_id_for(routing if routing is not None else doc_id, self.num_shards)
@@ -407,26 +412,61 @@ class IndicesService:
         self._persist_meta(svc)
 
     def update_aliases(self, actions: List[dict]) -> None:
+        def _targets(spec, key, plural):
+            # `index`/`indices` (and `alias`/`aliases`) are interchangeable
+            # singular/plural forms (IndicesAliasesRequest.AliasActions)
+            vals = spec.get(plural)
+            if vals is None:
+                one = spec.get(key)
+                if one is None:
+                    raise IllegalArgumentError(f"[{key}] is required")
+                vals = [one]
+            elif isinstance(vals, str):
+                vals = [vals]
+            return [str(v) for v in vals]
+
         for action in actions:
             if "add" in action:
                 spec = action["add"]
-                svc = self.get(spec["index"])
                 opts = {k: v for k, v in spec.items()
-                        if k not in ("index", "alias")}
-                # plain `routing` expands to both sides (AliasMetaData)
+                        if k not in ("index", "indices", "alias", "aliases")}
+                # plain `routing` expands to both sides (AliasMetaData);
+                # routing values are strings
                 if "routing" in opts:
                     routing = opts.pop("routing")
-                    opts.setdefault("index_routing", routing)
-                    opts.setdefault("search_routing", routing)
-                svc.aliases[spec["alias"]] = opts
-                self._persist_meta(svc)
+                    opts.setdefault("index_routing", str(routing))
+                    opts.setdefault("search_routing", str(routing))
+                for rk in ("index_routing", "search_routing"):
+                    if rk in opts:
+                        opts[rk] = str(opts[rk])
+                for iname in _targets(spec, "index", "indices"):
+                    for svc in (self.resolve(iname) if "*" in iname
+                                else [self.get(iname)]):
+                        for alias in _targets(spec, "alias", "aliases"):
+                            svc.aliases[alias] = dict(opts)
+                        self._persist_meta(svc)
             elif "remove" in action:
                 spec = action["remove"]
-                svc = self.get(spec["index"])
-                svc.aliases.pop(spec["alias"], None)
-                self._persist_meta(svc)
+                for iname in _targets(spec, "index", "indices"):
+                    for svc in (self.resolve(iname) if "*" in iname
+                                else [self.get(iname)]):
+                        import fnmatch as _fn
+                        for alias in _targets(spec, "alias", "aliases"):
+                            if "*" in alias:
+                                for a in [a for a in svc.aliases
+                                          if _fn.fnmatch(a, alias)]:
+                                    svc.aliases.pop(a, None)
+                            else:
+                                svc.aliases.pop(alias, None)
+                        self._persist_meta(svc)
+            elif "remove_index" in action:
+                # atomic swap support (IndicesAliasesRequest removeIndex)
+                spec = action["remove_index"]
+                for iname in _targets(spec, "index", "indices"):
+                    self.delete_index(iname)
             else:
-                raise IllegalArgumentError("alias action must be add or remove")
+                raise IllegalArgumentError(
+                    "alias action must be add, remove, or remove_index")
 
     def close(self):
         for svc in self.indices.values():

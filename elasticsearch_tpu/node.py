@@ -478,7 +478,8 @@ class Node:
                         "_primary_term": existing["_primary_term"],
                         "_shards": {"total": 0, "successful": 0, "failed": 0}}
             if op == "delete":
-                out = self.delete_doc(index, doc_id, refresh=refresh)
+                out = self.delete_doc(index, doc_id, refresh=refresh,
+                                      routing=routing)
                 out["result"] = "deleted"
                 return out
         else:
@@ -609,7 +610,15 @@ class Node:
         for j, line in enumerate(operations):
             if j != ln:
                 continue
+            if not isinstance(line, dict) or len(line) != 1:
+                raise IllegalArgumentError(
+                    f"Malformed action/metadata line [{j + 1}]")
             ((act, m),) = line.items()
+            if act not in ("index", "create", "update", "delete") \
+                    or not isinstance(m, dict):
+                raise IllegalArgumentError(
+                    f"Malformed action/metadata line [{j + 1}], found "
+                    f"[{act}]")
             for dep in ("_version", "_routing", "_parent", "fields",
                         "_version_type", "_retry_on_conflict"):
                 if dep in m:
@@ -1190,23 +1199,83 @@ class Node:
         return {"took": 0, "responses": responses}
 
     def analyze(self, body: dict, index: Optional[str] = None) -> dict:
+        from elasticsearch_tpu.index.analysis import (
+            Analyzer, _as_list, _builtin_filter, _builtin_tokenizer,
+            _build_filter, _build_tokenizer,
+        )
         text = body.get("text", "")
         texts = text if isinstance(text, list) else [text]
         registry = DEFAULT_REGISTRY
+        max_tokens = 10_000
         if index and self.indices.exists(index):
             # index-scoped: custom analyzers from index.analysis.* settings
-            registry = self.indices.get(index).analysis_registry
-        analyzer = registry.get(body.get("analyzer", "standard"))
+            svc = self.indices.get(index)
+            registry = svc.analysis_registry
+            max_tokens = int(svc.settings.get(
+                "index.analyze.max_token_count", 10_000))
+
+        custom = "tokenizer" in body or "filter" in body \
+            or "char_filter" in body
+        filters = []
+        filter_names = []
+        if custom:
+            tok_spec = body.get("tokenizer", "keyword")
+            if isinstance(tok_spec, dict):
+                tokenizer = _build_tokenizer(tok_spec)
+                tok_name = tok_spec.get("type", "custom")
+            else:
+                tokenizer = _builtin_tokenizer(str(tok_spec))
+                tok_name = str(tok_spec)
+            for f in _as_list(body.get("filter", [])) \
+                    if not isinstance(body.get("filter"), dict) \
+                    else [body["filter"]]:
+                if isinstance(f, dict):
+                    filters.append(_build_filter(f))
+                    filter_names.append(f.get("type", "custom"))
+                else:
+                    filters.append(_builtin_filter(str(f)))
+                    filter_names.append(str(f))
+            analyzer = Analyzer("__custom__", tokenizer, filters)
+            analyzer_name = None
+        else:
+            analyzer_name = body.get("analyzer", "standard")
+            analyzer = registry.get(analyzer_name)
+
+        def _render(toks, pos_base=0):
+            return [{"token": t.term, "start_offset": t.start_offset,
+                     "end_offset": t.end_offset, "type": "<ALPHANUM>",
+                     "position": pos_base + t.position} for t in toks]
+
         tokens = []
+        tokenizer_tokens = []
         pos = 0
         for t in texts:
             text_tokens = analyzer.analyze(str(t))
-            for tok in text_tokens:
-                tokens.append({"token": tok.term, "start_offset": tok.start_offset,
-                               "end_offset": tok.end_offset, "type": "<ALPHANUM>",
-                               "position": pos + tok.position})
+            if len(tokens) + len(text_tokens) > max_tokens:
+                raise IllegalArgumentError(
+                    f"The number of tokens produced by calling _analyze "
+                    f"has exceeded the allowed maximum of [{max_tokens}]. "
+                    f"This limit can be set by changing the "
+                    f"[index.analyze.max_token_count] index level setting.")
+            tokens.extend(_render(text_tokens, pos))
+            if custom:
+                tokenizer_tokens.extend(_render(analyzer.tokenizer(str(t)),
+                                                pos))
             # position gap of 1 between texts, like multi-valued fields
             pos += len(text_tokens) + 1
+        if body.get("explain"):
+            if custom:
+                detail = {"custom_analyzer": True,
+                          "tokenizer": {"name": tok_name,
+                                        "tokens": tokenizer_tokens}}
+                if filter_names:
+                    detail["tokenfilters"] = [
+                        {"name": n, "tokens": tokens}
+                        for n in filter_names]
+                return {"detail": detail}
+            return {"detail": {"custom_analyzer": False,
+                               "analyzer": {"name": analyzer_name,
+                                            "tokens": tokens}}}
         return {"tokens": tokens}
 
     # ----------------------------------------------------------------- stats
@@ -1268,16 +1337,49 @@ class Node:
         v = self._cluster_setting("search.max_buckets")
         return int(v) if v is not None else None
 
-    def cluster_health(self, index: Optional[str] = None) -> dict:
+    def cluster_health(self, index: Optional[str] = None,
+                       level: str = "cluster",
+                       expand_wildcards: str = "all") -> dict:
         """Single-node health: replicas can never assign, so a replicated
-        index makes the cluster yellow (ClusterStateHealth semantics)."""
-        services = (self.indices.resolve(index, expand_hidden=True)
-                    if index else
-                    [s for s in self.indices.indices.values() if not s.closed])
+        index makes the cluster yellow (ClusterStateHealth semantics).
+        Closed indices count too (replicated in 8.0); health defaults to
+        expanding BOTH open and closed wildcards."""
+        tokens = {t for t in str(expand_wildcards).split(",") if t}
+        want_open = bool(tokens & {"open", "all"})
+        want_closed = bool(tokens & {"closed", "all"})
+        missing_concrete = False
+        if index:
+            import fnmatch as _fn
+            services = []
+            for part in index.split(","):
+                part = part.strip()
+                matched = False
+                for name, svc in self.indices.indices.items():
+                    if not (_fn.fnmatch(name, part) if "*" in part
+                            else name == part):
+                        continue
+                    if svc.closed and not want_closed and "*" in part:
+                        continue
+                    if not svc.closed and not want_open and "*" in part:
+                        continue
+                    services.append(svc)
+                    matched = True
+                # a concrete index that doesn't exist makes health RED and
+                # the request time out (ClusterStateHealth: nonexistent
+                # index -> red, TransportClusterHealthAction waits -> 408)
+                if not matched and "*" not in part:
+                    missing_concrete = True
+        else:
+            services = [s for s in self.indices.indices.values()
+                        if (s.closed and want_closed)
+                        or (not s.closed and want_open)]
+        seen = set()
+        services = [s for s in services
+                    if s.name not in seen and not seen.add(s.name)]
         shards = sum(s.num_shards for s in services)
         unassigned = sum(s.num_shards * s.num_replicas for s in services)
         total = shards + unassigned
-        return {
+        out = {
             "cluster_name": self.cluster_name,
             "status": "yellow" if unassigned else "green",
             "timed_out": False, "number_of_nodes": 1,
@@ -1289,6 +1391,36 @@ class Node:
             "active_shards_percent_as_number":
                 (shards / total * 100.0) if total else 100.0,
         }
+        if missing_concrete:
+            out["status"] = "red"
+            out["timed_out"] = True
+        if level in ("indices", "shards"):
+            indices_out = {}
+            for svc in services:
+                un = svc.num_shards * svc.num_replicas
+                entry = {
+                    "status": "yellow" if un else "green",
+                    "number_of_shards": svc.num_shards,
+                    "number_of_replicas": svc.num_replicas,
+                    "active_primary_shards": svc.num_shards,
+                    "active_shards": svc.num_shards,
+                    "relocating_shards": 0, "initializing_shards": 0,
+                    "unassigned_shards": un,
+                }
+                if level == "shards":
+                    entry["shards"] = {
+                        str(s.shard_id): {
+                            "status": "yellow" if svc.num_replicas
+                            else "green",
+                            "primary_active": True,
+                            "active_shards": 1,
+                            "relocating_shards": 0,
+                            "initializing_shards": 0,
+                            "unassigned_shards": svc.num_replicas,
+                        } for s in svc.shards}
+                indices_out[svc.name] = entry
+            out["indices"] = indices_out
+        return out
 
     # metric flag -> response section key (RestIndicesStatsAction METRICS;
     # the `merge` flag renders as `merges`)

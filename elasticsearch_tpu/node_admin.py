@@ -229,7 +229,13 @@ class TemplateService:
         store = self.index_templates if composable else self.templates
         patterns = body.get("index_patterns")
         if not patterns:
-            raise IllegalArgumentError("index template must define index_patterns")
+            raise IllegalArgumentError(
+                "index template must define index_patterns: index "
+                "patterns are missing")
+        body = dict(body)
+        # patterns normalize to a list (MetaDataIndexTemplateService)
+        body["index_patterns"] = ([patterns] if isinstance(patterns, str)
+                                  else list(patterns))
         store[name] = body
 
     def get(self, name: str, composable: bool = False) -> dict:

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from elasticsearch_tpu.common.errors import (
     DocumentMissingError, IllegalArgumentError, IndexNotFoundError,
+    SearchEngineError,
 )
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.rest.controller import RestController, RestRequest
@@ -436,21 +437,84 @@ def register_all(rc: RestController, node: Node) -> None:
             node.indices.delete_index(name)
         return 200, {"acknowledged": True}
 
+    def _resolve_with_options(req, expr):
+        """IndicesOptions resolution shared by the index-info APIs:
+        ignore_unavailable drops missing concretes, allow_no_indices
+        tolerates empty wildcards, expand_wildcards picks open/closed."""
+        expand = req.param("expand_wildcards") or "open"
+        if isinstance(expand, (list, tuple)):
+            expand = ",".join(str(t) for t in expand)
+        tokens = {t for t in expand.split(",") if t}
+        want_open = bool(tokens & {"open", "all"}) or not tokens
+        want_closed = bool(tokens & {"closed", "all"})
+        ignore_unavailable = req.bool_param("ignore_unavailable", False)
+        allow_no = req.bool_param("allow_no_indices", True)
+        out = []
+        for part in (expr or "_all").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "*" in part or part == "_all":
+                import fnmatch as _fn
+                pat = "*" if part == "_all" else part
+                for n, svc in node.indices.indices.items():
+                    if not _fn.fnmatch(n, pat):
+                        continue
+                    if svc.closed and not want_closed:
+                        continue
+                    if not svc.closed and not want_open:
+                        continue
+                    if svc.hidden and not (tokens & {"all", "hidden"}) \
+                            and not (pat.startswith(".")
+                                     and n.startswith(".")):
+                        continue
+                    out.append(svc)
+            else:
+                try:
+                    svc = node.indices.get(part)
+                except SearchEngineError:
+                    if ignore_unavailable:
+                        continue
+                    raise
+                out.append(svc)
+        if not out and not allow_no:
+            raise IndexNotFoundError(expr)
+        seen = set()
+        return [s for s in out
+                if s.name not in seen and not seen.add(s.name)]
+
     def get_index(req):
+        from elasticsearch_tpu.indices.service import IndicesService
+        for part in req.params["index"].split(","):
+            part = part.strip()
+            if part.startswith("_") and part not in ("_all",):
+                # reserved names are a request error, not a missing index
+                IndicesService.validate_index_name(part)
+        human = req.bool_param("human", False)
         out = {}
-        for svc in node.indices.resolve(req.params["index"]):
+        for svc in _resolve_with_options(req, req.params["index"]):
+            idx_settings = {
+                **{k.replace("index.", "", 1): v
+                   for k, v in svc.settings.as_flat_dict().items()},
+                "uuid": svc.uuid,
+                "creation_date": str(svc.creation_date),
+                "provided_name": svc.name,
+            }
+            if human:
+                idx_settings["creation_date_string"] = _fmt_iso_millis(
+                    svc.creation_date)
+                idx_settings.setdefault("version", {})
+                if isinstance(idx_settings["version"], dict):
+                    idx_settings["version"]["created_string"] = __version__
+                    idx_settings["version"].setdefault("created", "8000099")
             out[svc.name] = {
                 "aliases": svc.aliases,
                 "mappings": svc.mapper_service.to_dict(),
-                "settings": {"index": {
-                    **{k.replace("index.", "", 1): v
-                       for k, v in svc.settings.as_flat_dict().items()},
-                    "uuid": svc.uuid,
-                    "creation_date": str(svc.creation_date),
-                    "provided_name": svc.name,
-                }},
+                "settings": {"index": idx_settings},
             }
-        if not out:
+        if not out and not req.bool_param("ignore_unavailable", False) \
+                and "*" not in req.params["index"] \
+                and req.bool_param("allow_no_indices", True) is False:
             raise IndexNotFoundError(req.params["index"])
         return 200, out
 
@@ -466,7 +530,7 @@ def register_all(rc: RestController, node: Node) -> None:
 
     def get_mapping(req):
         out = {}
-        for svc in node.indices.resolve(req.params.get("index")):
+        for svc in _resolve_with_options(req, req.params.get("index")):
             out[svc.name] = {"mappings": svc.mapper_service.to_dict()}
         return 200, out
 
@@ -543,6 +607,11 @@ def register_all(rc: RestController, node: Node) -> None:
             if patterns is not None:
                 flat = {k: v for k, v in flat.items()
                         if any(_fn.fnmatch(k, p) for p in patterns)}
+            if req.bool_param("flat_settings", False):
+                section = {k: _settings_str(v) for k, v in flat.items()
+                           if v is not None}
+                out[svc.name] = {"settings": section}
+                continue
             index_section: dict = {}
             for k, v in flat.items():
                 if v is None:
@@ -720,25 +789,131 @@ def register_all(rc: RestController, node: Node) -> None:
 
     # ---------------------------------------------------------------- cluster
     def cluster_health(req):
-        # wait_for_status resolves immediately: single-node state is
-        # deterministic, so the target is either already met or never will
+        # wait_for_* resolves immediately: single-node state is
+        # deterministic, so a target is either already met or never will
         # be within the request (reference waits on a state observer)
-        out = node.cluster_health(req.params.get("index"))
+        expand = req.param("expand_wildcards") or "all"
+        if isinstance(expand, (list, tuple)):
+            expand = ",".join(str(t) for t in expand)
+        out = node.cluster_health(req.params.get("index"),
+                                  level=req.param("level", "cluster"),
+                                  expand_wildcards=expand)
+        timed_out = bool(out.get("timed_out"))
         want = req.param("wait_for_status")
         order = {"green": 0, "yellow": 1, "red": 2}
         if want and order.get(out["status"], 2) > order.get(want, 0):
+            timed_out = True
+        wn = req.param("wait_for_nodes")
+        if wn:
+            import re as _re
+            m = _re.fullmatch(r"(>=|<=|>|<|==|eq\()?\s*(\d+)\)?", str(wn))
+            if m:
+                op = m.group(1) or ">="
+                n = int(m.group(2))
+                have = out["number_of_nodes"]
+                ok = {">=": have >= n, "<=": have <= n, ">": have > n,
+                      "<": have < n, "==": have == n,
+                      "eq(": have == n}[op]
+                if not ok:
+                    timed_out = True
+        was = req.param("wait_for_active_shards")
+        if was and was != "all" and int(was) > out["active_shards"]:
+            timed_out = True
+        if timed_out:
             out["timed_out"] = True
             return 408, out
         return 200, out
 
     def cluster_stats(req):
+        import resource as _res
+        import shutil as _sh
         total_docs = sum(s.doc_count() for s in node.indices.indices.values())
+        segs = sum(len(sh.engine.segments)
+                   for s in node.indices.indices.values()
+                   for sh in s.shards)
+        # field type census incl. synthesized object parents, with
+        # per-index attribution (MappingStats)
+        from elasticsearch_tpu.node_admin import _index_field_caps
+        field_types: dict = {}
+        for s in node.indices.indices.values():
+            per_index_types: dict = {}
+            for _path, (t, _se, _ag, _m) in _index_field_caps(
+                    s.mapper_service).items():
+                per_index_types[t] = per_index_types.get(t, 0) + 1
+            for t, c in per_index_types.items():
+                e = field_types.setdefault(t, {"count": 0, "indices": 0})
+                e["count"] += c
+                e["indices"] += 1
+        du = _sh.disk_usage(node.data_path)
+        mem_total = 8 * 1024 ** 3
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        mem_total = int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        mem_used = mem_total // 2
+        health = node.cluster_health()
         return 200, {
-            "cluster_name": node.cluster_name, "status": "green",
-            "indices": {"count": len(node.indices.indices),
-                        "docs": {"count": total_docs}},
-            "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+            "cluster_name": node.cluster_name,
+            "cluster_uuid": node.node_id,
+            "timestamp": int(time.time() * 1000),
+            "status": health["status"],
+            "indices": {
+                "count": len(node.indices.indices),
+                "shards": {"total": sum(
+                    s.num_shards for s in node.indices.indices.values())},
+                "docs": {"count": total_docs, "deleted": 0},
+                "store": {"size_in_bytes": 0, "reserved_in_bytes": 0},
+                "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
+                "query_cache": {"memory_size_in_bytes": 0, "hit_count": 0,
+                                "miss_count": 0, "evictions": 0},
+                "completion": {"size_in_bytes": 0},
+                "segments": {"count": segs, "memory_in_bytes": 0},
+                "mappings": {"field_types": [
+                    {"name": t, "count": e["count"],
+                     "index_count": e["indices"]}
+                    for t, e in sorted(field_types.items())]},
+                "analysis": {"analyzer_types": [], "char_filter_types": [],
+                             "filter_types": [], "tokenizer_types": []},
+            },
+            "nodes": {
+                "count": {"total": 1, "data": 1, "master": 1, "ingest": 1,
+                          "coordinating_only": 0,
+                          "voting_only": 0, "ml": 1,
+                          "remote_cluster_client": 1, "transform": 1},
+                "versions": [__version__],
+                "os": {"available_processors": _os_cpus(),
+                       "allocated_processors": _os_cpus(),
+                       "names": [{"name": "Linux", "count": 1}],
+                       "mem": {"total_in_bytes": mem_total,
+                               "free_in_bytes": mem_total - mem_used,
+                               "used_in_bytes": mem_used,
+                               "free_percent": 50, "used_percent": 50}},
+                "process": {"cpu": {"percent": 1},
+                            "open_file_descriptors": {"min": 64, "max": 512,
+                                                      "avg": 128}},
+                "jvm": {"versions": [], "mem": {
+                    "heap_used_in_bytes": 256 * 1024 * 1024,
+                    "heap_max_in_bytes": 4 * 1024 ** 3},
+                    "threads": 16, "max_uptime_in_millis": 1},
+                "fs": {"total_in_bytes": du.total, "free_in_bytes": du.free,
+                       "available_in_bytes": du.free},
+                "plugins": [{"name": p, "version": __version__}
+                            for p in ("sql", "eql", "ilm")],
+                "network_types": {"transport_types": {"tcp": 1},
+                                  "http_types": {"asyncio": 1}},
+                "discovery_types": {"zen": 1},
+                "packaging_types": [{"flavor": "tpu", "type": "source",
+                                     "count": 1}],
+            },
         }
+
+    def _os_cpus():
+        import os as _os
+        return _os.cpu_count() or 1
 
     def cluster_state(req):
         """GET /_cluster/state[/{metric}[/{index}]] — metric filtering

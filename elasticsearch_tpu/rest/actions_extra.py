@@ -141,13 +141,57 @@ def register_extra(rc: RestController, node: Node) -> None:
 
     # --------------------------------------------------------------- templates
     def put_template(req):
-        node.templates.put(req.params["name"], req.json() or {},
-                           composable="_index_template" in req.path)
+        name = req.params["name"]
+        composable = "_index_template" in req.path
+        if req.bool_param("create", False):
+            store = (node.templates.index_templates if composable
+                     else node.templates.templates)
+            if name in store:
+                raise IllegalArgumentError(
+                    f"index_template [{name}] already exists")
+        node.templates.put(name, req.json() or {}, composable=composable)
         return 200, {"acknowledged": True}
 
     def get_template(req):
         composable = "_index_template" in req.path
         name = req.params.get("name")
+        flat = req.bool_param("flat_settings", False)
+
+        def render(t):
+            # legacy template rendering: order always present, settings
+            # under the index. namespace with STRING values, nested by
+            # default or flat with ?flat_settings
+            aliases = {}
+            for a, opts in (t.get("aliases") or {}).items():
+                opts = dict(opts or {})
+                routing = opts.pop("routing", None)
+                if routing is not None:
+                    opts.setdefault("index_routing", str(routing))
+                    opts.setdefault("search_routing", str(routing))
+                aliases[a] = opts
+            out = {"order": t.get("order", 0),
+                   "index_patterns": t.get("index_patterns", []),
+                   "settings": {}, "mappings": t.get("mappings", {}),
+                   "aliases": aliases}
+            if "version" in t:
+                out["version"] = t["version"]
+            flat_settings = {}
+            for k, v in (t.get("settings") or {}).items():
+                key = k if k.startswith("index.") else f"index.{k}"
+                flat_settings[key] = str(v)
+            if flat:
+                out["settings"] = flat_settings
+            else:
+                nested = {}
+                for k, v in flat_settings.items():
+                    nodep = nested
+                    parts = k.split(".")
+                    for p in parts[:-1]:
+                        nodep = nodep.setdefault(p, {})
+                    nodep[parts[-1]] = v
+                out["settings"] = nested
+            return out
+
         if composable:
             if name:
                 return 200, {"index_templates": [
@@ -156,8 +200,14 @@ def register_extra(rc: RestController, node: Node) -> None:
                 {"name": n, "index_template": t}
                 for n, t in node.templates.index_templates.items()]}
         if name:
-            return 200, {name: node.templates.get(name)}
-        return 200, dict(node.templates.templates)
+            import fnmatch as _fn
+            if "*" in name:
+                return 200, {n: render(t)
+                             for n, t in node.templates.templates.items()
+                             if _fn.fnmatch(n, name)}
+            return 200, {name: render(node.templates.get(name))}
+        return 200, {n: render(t)
+                     for n, t in node.templates.templates.items()}
 
     def delete_template(req):
         node.templates.delete(req.params["name"],

@@ -6,6 +6,7 @@ Reference: each x-pack plugin registers its own Rest*Action handlers
 
 from __future__ import annotations
 
+from elasticsearch_tpu.common.errors import SearchEngineError
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.rest.controller import RestController
 
@@ -320,8 +321,30 @@ def register_xpack(rc: RestController, node: Node) -> None:
     def put_settings(req):
         body = req.json() or {}
         flat = _flatten_settings(body.get("settings", body))
-        for svc in node.indices.resolve(req.params.get("index")):
-            node.indices.update_settings(svc, flat)
+        # bare keys normalize under index. (PUT bodies mix forms freely)
+        flat = {k if k.startswith("index.") else f"index.{k}": v
+                for k, v in flat.items()}
+        preserve = req.bool_param("preserve_existing", False)
+        ignore_unavailable = req.bool_param("ignore_unavailable", False)
+        expr = req.params.get("index")
+        targets = []
+        for part in (expr or "_all").split(","):
+            part = part.strip()
+            if "*" in part or part in ("_all", ""):
+                targets.extend(node.indices.resolve(part or "_all"))
+            else:
+                try:
+                    targets.append(node.indices.get(part))
+                except SearchEngineError:
+                    if not ignore_unavailable:
+                        raise
+        for svc in targets:
+            updates = dict(flat)
+            if preserve:
+                existing = svc.settings.as_flat_dict()
+                updates = {k: v for k, v in updates.items()
+                           if k not in existing}
+            node.indices.update_settings(svc, updates)
         return 200, {"acknowledged": True}
 
     rc.register("PUT", "/{index}/_settings", put_settings)

@@ -52,9 +52,13 @@ class ConformanceClient:
 # suites that are fully green: every test PASSes (or SKIPs on unsupported
 # DSL features) — pinned against regression
 MUST_PASS = [
+    "bulk/10_basic.yml",
     "bulk/20_list_of_strings.yml",
     "bulk/30_big_string.yml",
+    "bulk/40_source.yml",
     "bulk/50_refresh.yml",
+    "bulk/60_deprecated.yml",
+    "bulk/80_cas.yml",
     "cat.aliases/10_basic.yml",
     "cat.aliases/30_json.yml",
     "cat.aliases/40_hidden.yml",
@@ -75,10 +79,15 @@ MUST_PASS = [
     "cat.tasks/10_basic.yml",
     "cat.templates/10_basic.yml",
     "cat.thread_pool/10_basic.yml",
+    "cluster.health/10_basic.yml",
+    "cluster.health/20_request_timeout.yml",
+    "cluster.health/30_indices_options.yml",
+    "cluster.pending_tasks/10_basic.yml",
     "cluster.remote_info/10_info.yml",
     "cluster.reroute/10_basic.yml",
     "cluster.state/10_basic.yml",
     "cluster.state/20_filtering.yml",
+    "cluster.stats/10_basic.yml",
     "count/10_basic.yml",
     "create/10_with_id.yml",
     "create/40_routing.yml",
@@ -87,44 +96,71 @@ MUST_PASS = [
     "delete/11_shard_header.yml",
     "delete/12_result.yml",
     "delete/20_cas.yml",
+    "delete/25_external_version.yml",
+    "delete/26_external_gte_version.yml",
     "delete/30_routing.yml",
+    "delete/50_refresh.yml",
+    "delete/60_missing.yml",
     "exists/10_basic.yml",
     "exists/40_routing.yml",
+    "exists/60_realtime_refresh.yml",
     "exists/70_defaults.yml",
     "field_caps/10_basic.yml",
     "field_caps/20_meta.yml",
     "get/10_basic.yml",
     "get/15_default_values.yml",
+    "get/20_stored_fields.yml",
     "get/40_routing.yml",
+    "get/60_realtime_refresh.yml",
+    "get/70_source_filtering.yml",
+    "get/80_missing.yml",
+    "get/90_versions.yml",
     "get_source/10_basic.yml",
     "get_source/15_default_values.yml",
     "get_source/40_routing.yml",
+    "get_source/60_realtime_refresh.yml",
+    "get_source/70_source_filtering.yml",
+    "get_source/85_source_missing.yml",
+    "index/10_with_id.yml",
     "index/12_result.yml",
     "index/15_without_id.yml",
     "index/20_optype.yml",
     "index/30_cas.yml",
+    "index/35_external_version.yml",
+    "index/36_external_gte_version.yml",
     "index/40_routing.yml",
     "index/60_refresh.yml",
+    "indices.analyze/10_analyze.yml",
+    "indices.analyze/20_analyze_limit.yml",
     "indices.clone/20_source_mapping.yml",
     "indices.delete_alias/10_basic.yml",
     "indices.delete_alias/all_path_options.yml",
     "indices.exists/10_basic.yml",
     "indices.exists/20_read_only_index.yml",
     "indices.exists_alias/10_basic.yml",
+    "indices.exists_template/10_basic.yml",
+    "indices.get/10_basic.yml",
     "indices.get_alias/20_empty.yml",
     "indices.get_field_mapping/10_basic.yml",
     "indices.get_field_mapping/20_missing_field.yml",
     "indices.get_field_mapping/40_missing_index.yml",
     "indices.get_field_mapping/50_field_wildcards.yml",
+    "indices.get_index_template/20_get_missing.yml",
     "indices.get_mapping/10_basic.yml",
+    "indices.get_mapping/30_missing_index.yml",
     "indices.get_mapping/40_aliases.yml",
+    "indices.get_mapping/50_wildcard_expansion.yml",
     "indices.get_mapping/60_empty.yml",
     "indices.get_settings/10_basic.yml",
     "indices.get_settings/20_aliases.yml",
+    "indices.get_template/10_basic.yml",
+    "indices.get_template/20_get_missing.yml",
     "indices.open/10_basic.yml",
     "indices.open/20_multiple_indices.yml",
     "indices.put_alias/all_path_options.yml",
+    "indices.put_settings/11_reset.yml",
     "indices.put_settings/all_path_options.yml",
+    "indices.put_template/10_basic.yml",
     "indices.rollover/20_max_doc_condition.yml",
     "indices.rollover/30_max_size_condition.yml",
     "indices.rollover/40_mapping.yml",
@@ -137,6 +173,9 @@ MUST_PASS = [
     "indices.stats/20_translog.yml",
     "indices.stats/30_segments.yml",
     "indices.stats/40_updates_on_refresh.yml",
+    "indices.update_aliases/10_basic.yml",
+    "indices.update_aliases/20_routing.yml",
+    "indices.update_aliases/30_remove_index_and_replace_with_alias.yml",
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
@@ -154,6 +193,10 @@ MUST_PASS = [
     "msearch/11_status.yml",
     "ping/10_ping.yml",
     "range/10_basic.yml",
+    "scroll/10_basic.yml",
+    "scroll/11_clear.yml",
+    "scroll/12_slices.yml",
+    "scroll/20_keep_alive.yml",
     "search/100_stored_fields.yml",
     "search/10_source_filtering.yml",
     "search/120_batch_reduce_size.yml",
@@ -195,8 +238,16 @@ MUST_PASS = [
     "suggest/20_completion.yml",
     "update/10_doc.yml",
     "update/11_shard_header.yml",
+    "update/12_result.yml",
     "update/13_legacy_doc.yml",
+    "update/16_noop.yml",
+    "update/20_doc_upsert.yml",
+    "update/22_doc_as_upsert.yml",
+    "update/35_if_seq_no.yml",
+    "update/40_routing.yml",
     "update/60_refresh.yml",
+    "update/80_source_filtering.yml",
+    "update/90_error.yml",
 ]
 
 
