@@ -528,7 +528,20 @@ class ClusterAwareNode(Node):
     # --------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict],
                ignore_throttled: bool = True,
-               ignore_unavailable: bool = False) -> dict:
+               ignore_unavailable: bool = False,
+               allow_no_indices: bool = True,
+               expand_wildcards: Optional[str] = None) -> dict:
+        if not allow_no_indices and index_expr and "*" in index_expr:
+            # IndicesOptions.allowNoIndices=false: an unmatched wildcard is
+            # an error at the coordinator, before the scatter
+            if not self.cluster.resolve_indices(index_expr):
+                raise IndexNotFoundError(index_expr)
+        if expand_wildcards and {"closed", "all"} & set(
+                str(expand_wildcards).split(",")):
+            # closed indices surface through the LOCAL view (cluster
+            # metadata doesn't carry index state; closing is node-local)
+            for svc in self.indices.resolve(index_expr, expand_closed=True):
+                self.indices.check_open(svc)
         if ignore_unavailable and index_expr:
             # lenientExpandOpen: drop concrete names absent from cluster
             # metadata before the scatter

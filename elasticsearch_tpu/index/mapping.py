@@ -1313,8 +1313,15 @@ class MapperService:
                 self._multi_fields.setdefault(path, {})["keyword"] = kw
                 self._put(f"{path}.keyword", kw)
 
-        # dense_vector: the array IS the single value, not multi-values
+        # dense_vector: the array IS the single value, not multi-values;
+        # geo_point [lon, lat] numeric pairs too (GeoJSON order —
+        # GeoPointFieldMapper parse() array form)
         if isinstance(mapper, DenseVectorFieldMapper):
+            values = [value]
+        elif (isinstance(mapper, GeoPointFieldMapper)
+              and isinstance(value, (list, tuple)) and len(value) == 2
+              and all(isinstance(v, (int, float)) and
+                      not isinstance(v, bool) for v in value)):
             values = [value]
         else:
             values = value if isinstance(value, list) else [value]

@@ -29,6 +29,76 @@ from elasticsearch_tpu.vectors.store import VectorStoreShard
 
 _INDEX_NAME_RE = re.compile(r"^[^A-Z\\/*?\"<>| ,#:][^A-Z\\/*?\"<>| ,#]*$")
 
+
+def resolve_date_math_name(part: str) -> str:
+    """`<static{date_math{format|tz}}>` index-name resolution (reference:
+    IndexNameExpressionResolver.DateMathExpressionResolver). Non-date-math
+    expressions pass through unchanged."""
+    if not (part.startswith("<") and part.endswith(">")):
+        return part
+    inner, out, i = part[1:-1], [], 0
+    while i < len(inner):
+        if inner[i] == "{":
+            depth, j = 1, i + 1
+            while j < len(inner) and depth:
+                depth += {"{": 1, "}": -1}.get(inner[j], 0)
+                j += 1
+            out.append(_eval_date_math(inner[i + 1:j - 1]))
+            i = j
+        else:
+            out.append(inner[i])
+            i += 1
+    return "".join(out)
+
+
+def _eval_date_math(expr: str) -> str:
+    import datetime as _dt
+    fmt = "yyyy.MM.dd"
+    if "{" in expr:
+        expr, _, rest = expr.partition("{")
+        fmt = rest.rstrip("}").split("|", 1)[0]
+    t = _dt.datetime.now(_dt.timezone.utc)
+    if not expr.startswith("now"):
+        raise IllegalArgumentError(
+            f"invalid date math expression [{expr}]")
+    for op, num, unit in re.findall(r"([+\-/])(\d*)([yMwdhHms])", expr[3:]):
+        if op == "/":
+            if unit == "y":
+                t = t.replace(month=1, day=1, hour=0, minute=0, second=0,
+                              microsecond=0)
+            elif unit == "M":
+                t = t.replace(day=1, hour=0, minute=0, second=0,
+                              microsecond=0)
+            elif unit == "w":
+                t = (t - _dt.timedelta(days=t.weekday())).replace(
+                    hour=0, minute=0, second=0, microsecond=0)
+            elif unit == "d":
+                t = t.replace(hour=0, minute=0, second=0, microsecond=0)
+            elif unit in ("h", "H"):
+                t = t.replace(minute=0, second=0, microsecond=0)
+            elif unit == "m":
+                t = t.replace(second=0, microsecond=0)
+            else:
+                t = t.replace(microsecond=0)
+        else:
+            n = int(num or 1) * (1 if op == "+" else -1)
+            if unit == "y":
+                t = t.replace(year=t.year + n)
+            elif unit == "M":
+                mo = t.month - 1 + n
+                t = t.replace(year=t.year + mo // 12, month=mo % 12 + 1)
+            else:
+                t += _dt.timedelta(**{
+                    {"w": "weeks", "d": "days", "h": "hours", "H": "hours",
+                     "m": "minutes", "s": "seconds"}[unit]: n})
+    return (fmt.replace("yyyy", f"{t.year:04d}")
+               .replace("uuuu", f"{t.year:04d}")
+               .replace("MM", f"{t.month:02d}")
+               .replace("dd", f"{t.day:02d}")
+               .replace("HH", f"{t.hour:02d}")
+               .replace("mm", f"{t.minute:02d}")
+               .replace("ss", f"{t.second:02d}"))
+
 # Rebased multi-shard row space: shard s contributes rows in
 # [s * SHARD_ROW_SPACE, (s+1) * SHARD_ROW_SPACE).
 SHARD_ROW_SPACE = 1 << 40
@@ -313,6 +383,7 @@ class IndicesService:
         """Resolve a concrete index or single-index alias for a
         single-document op; a multi-index alias is an error (reference:
         IndexNameExpressionResolver.concreteSingleIndex)."""
+        name = resolve_date_math_name(name)
         svc = self.indices.get(name)
         if svc is None:
             matches = [s for s in self.indices.values() if name in s.aliases]
@@ -332,7 +403,8 @@ class IndicesService:
         return any(name in s.aliases for s in self.indices.values())
 
     def resolve(self, expression: Optional[str],
-                expand_hidden: bool = False) -> List[IndexService]:
+                expand_hidden: bool = False,
+                expand_closed: bool = False) -> List[IndexService]:
         """Resolve a comma/wildcard index expression (reference:
         IndexNameExpressionResolver). Hidden indices are excluded from
         wildcard expansion unless `expand_hidden` (expand_wildcards=all/
@@ -341,11 +413,12 @@ class IndicesService:
             # wildcard/_all expansion targets OPEN indices
             # (IndicesOptions.expandWildcardsOpen default)
             return [s for s in self.indices.values()
-                    if not s.closed and (expand_hidden or not s.hidden)]
+                    if (expand_closed or not s.closed)
+                    and (expand_hidden or not s.hidden)]
         out = []
         seen = set()
         for part in expression.split(","):
-            part = part.strip()
+            part = resolve_date_math_name(part.strip())
             if "*" in part:
                 pat = re.compile("^" + part.replace(".", r"\.").replace("*", ".*") + "$")
                 dotted = part.startswith(".")
@@ -354,9 +427,11 @@ class IndicesService:
                     return (expand_hidden or not s.hidden
                             or (dotted and n.startswith(".")))
                 matched = [s for n, s in self.indices.items()
-                           if pat.match(n) and not s.closed and visible(s, n)]
+                           if pat.match(n)
+                           and (expand_closed or not s.closed)
+                           and visible(s, n)]
                 for s in self.indices.values():
-                    if s.closed:
+                    if s.closed and not expand_closed:
                         continue
                     for a, opts in s.aliases.items():
                         # an alias is hidden only when itself declared

@@ -251,6 +251,8 @@ class Node:
         self._search_groups: Dict[str, int] = {}
         self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
                                          "bulk": 0, "delete": 0}
+        # per-index get counts for indices-stats `get` section (GetStats)
+        self._index_get_counts: Dict[str, int] = {}
         # cluster-level persistent/transient settings (_cluster/settings API)
         self.cluster_settings: Dict[str, dict] = {"persistent": {},
                                                   "transient": {}}
@@ -355,6 +357,8 @@ class Node:
         svc = self.indices.check_open(self.indices.get(index))
         shard = svc.route(doc_id, routing)
         self.counters["get"] += 1
+        self._index_get_counts[svc.name] = \
+            self._index_get_counts.get(svc.name, 0) + 1
         doc = shard.engine.get(doc_id, realtime=realtime)
         if doc is None:
             return {"_index": svc.name, "_id": doc_id, "found": False}
@@ -811,7 +815,9 @@ class Node:
     # ---------------------------------------------------------------- search
     def search(self, index_expr: Optional[str], body: Optional[dict],
                ignore_throttled: bool = True,
-               ignore_unavailable: bool = False) -> dict:
+               ignore_unavailable: bool = False,
+               allow_no_indices: bool = True,
+               expand_wildcards: Optional[str] = None) -> dict:
         body = body or {}
         rank = body.get("rank")
         if isinstance(rank, dict) and "rrf" in rank:
@@ -842,7 +848,21 @@ class Node:
             services = self.indices.resolve_open(",".join(kept)) \
                 if kept else []
         else:
-            services = self.indices.resolve_open(index_expr)
+            ew = {t.strip() for t in str(expand_wildcards or "open").split(",")
+                  if t.strip()}
+            if ew & {"closed", "all"}:
+                # expand_wildcards=closed surfaces closed matches, and a
+                # closed index in the target set is an error
+                # (IndicesOptions.forbidClosedIndices for search)
+                services = self.indices.resolve(index_expr,
+                                                expand_closed=True)
+                for svc in services:
+                    self.indices.check_open(svc)
+            else:
+                services = self.indices.resolve_open(index_expr)
+        if not allow_no_indices and not services and index_expr \
+                and "*" in index_expr:
+            raise IndexNotFoundError(index_expr)
         if ignore_throttled:
             # frozen indices sit out of normal searches unless the caller
             # passes ignore_throttled=false (reference:
@@ -878,6 +898,8 @@ class Node:
                 matched = self.indices.resolve(expr, expand_hidden=True) \
                     if ("*" in expr or self.indices.exists(expr)) else []
                 if not matched:
+                    if ignore_unavailable:
+                        continue
                     raise IndexNotFoundError(expr)
                 for svc in matched:
                     boosts.setdefault(svc.name, float(boost))
@@ -1577,8 +1599,8 @@ class Node:
                     "reserved_in_bytes": 0},
                 "indexing": {"index_total": ops_total, "index_failed": 0,
                              "delete_total": 0, "index_time_in_millis": 0},
-                "get": {"total": 0, "missing_total": 0,
-                        "time_in_millis": 0},
+                "get": {"total": self._index_get_counts.get(svc.name, 0),
+                        "missing_total": 0, "time_in_millis": 0},
                 "search": search_sec,
                 "merges": {"total": 0, "total_docs": 0,
                            "total_size_in_bytes": 0,

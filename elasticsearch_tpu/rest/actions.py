@@ -277,7 +277,19 @@ def register_all(rc: RestController, node: Node) -> None:
             if "query" in body:
                 raise IllegalArgumentError(
                     "cannot specify both [q] parameter and a request body query")
-            body["query"] = _query_string_to_dsl(q)
+            qs = {"query": q}
+            if req.param("df"):
+                qs["default_field"] = req.param("df")
+            if req.param("default_operator"):
+                qs["default_operator"] = req.param("default_operator")
+            if req.param("lenient") is not None:
+                qs["lenient"] = req.bool_param("lenient", False)
+            if req.param("analyzer"):
+                qs["analyzer"] = req.param("analyzer")
+            if req.param("analyze_wildcard") is not None:
+                qs["analyze_wildcard"] = req.bool_param(
+                    "analyze_wildcard", False)
+            body["query"] = {"query_string": qs}
         for p, key in (("size", "size"), ("from", "from")):
             v = req.int_param(p)
             if v is not None:
@@ -326,8 +338,12 @@ def register_all(rc: RestController, node: Node) -> None:
         pfss = req.int_param("pre_filter_shard_size")
         if pfss is not None and pfss < 1:
             raise IllegalArgumentError("preFilterShardSize must be >= 1")
+        tt = body.get("track_total_hits")
+        if isinstance(tt, int) and not isinstance(tt, bool) and tt < -1:
+            raise IllegalArgumentError(
+                f"[track_total_hits] parameter must be positive or "
+                f"equals to -1, got {tt}")
         if req.bool_param("rest_total_hits_as_int", False):
-            tt = body.get("track_total_hits")
             if isinstance(tt, int) and not isinstance(tt, bool) and tt != -1:
                 raise IllegalArgumentError(
                     f"[rest_total_hits_as_int] cannot be used if the "
@@ -349,7 +365,11 @@ def register_all(rc: RestController, node: Node) -> None:
                                ignore_throttled=req.bool_param(
                                    "ignore_throttled", True),
                                ignore_unavailable=req.bool_param(
-                                   "ignore_unavailable", False))
+                                   "ignore_unavailable", False),
+                               allow_no_indices=req.bool_param(
+                                   "allow_no_indices", True),
+                               expand_wildcards=req.param(
+                                   "expand_wildcards"))
         if req.bool_param("rest_total_hits_as_int", False):
             _total_hits_as_int(resp)
         if req.bool_param("typed_keys", False):

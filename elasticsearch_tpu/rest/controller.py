@@ -100,7 +100,8 @@ class RestController:
         node.handlers[method.upper()] = handler
 
     def _resolve(self, path: str) -> Tuple[Optional[_TrieNode], Dict[str, str]]:
-        segments = [s for s in path.split("/") if s]
+        from urllib.parse import unquote
+        segments = [unquote(s) for s in path.split("/") if s]
 
         def walk(node: _TrieNode, i: int, params: Dict[str, str]):
             if i == len(segments):
@@ -219,18 +220,41 @@ def filter_path_apply(resp, spec: str):
         return out if out else _SKIP
 
     def exclude_steps(steps, obj):
-        if not steps or not isinstance(obj, (dict, list)):
-            return obj
+        """Filtered copy of obj with paths matching steps removed; _SKIP
+        when obj itself is fully excluded. '**' spans any number of
+        segments (FilterPath double-wildcard)."""
+        if not steps:
+            return _SKIP
         if isinstance(obj, list):
-            return [exclude_steps(steps, item) for item in obj]
+            return [r for r in (exclude_steps(steps, item) for item in obj)
+                    if r is not _SKIP]
+        if not isinstance(obj, dict):
+            return obj
         step, rest = steps[0], steps[1:]
         import fnmatch
         out = {}
         for k, v in obj.items():
-            if fnmatch.fnmatchcase(str(k), step):
+            if step == "**":
+                keep = v
+                # '**' already satisfied: the rest matches starting at k
+                if rest and fnmatch.fnmatchcase(str(k), rest[0]):
+                    if len(rest) == 1:
+                        continue  # excluded leaf
+                    keep = exclude_steps(rest[1:], keep)
+                    if keep is _SKIP:
+                        continue
+                # '**' still spanning: keep consuming segments below
+                keep = exclude_steps(steps, keep)
+                if keep is _SKIP:
+                    continue
+                out[k] = keep
+            elif fnmatch.fnmatchcase(str(k), step):
                 if not rest:
                     continue  # excluded leaf
-                out[k] = exclude_steps(rest, v)
+                keep = exclude_steps(rest, v)
+                if keep is _SKIP:
+                    continue
+                out[k] = keep
             else:
                 out[k] = v
         return out

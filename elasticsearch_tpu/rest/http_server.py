@@ -116,7 +116,9 @@ class HttpServer:
         except ValueError:
             return None
         parsed = urllib.parse.urlsplit(target)
-        path = urllib.parse.unquote(parsed.path)
+        # keep the RAW path: the controller decodes per-SEGMENT, so an
+        # encoded slash inside a segment (date-math index names) survives
+        path = parsed.path
         query = {k: v[-1] for k, v in urllib.parse.parse_qs(
             parsed.query, keep_blank_values=True).items()}
 

@@ -449,6 +449,15 @@ class RangeQuery(Query):
         return float(value)
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        if isinstance(mapper, (TextFieldMapper, KeywordFieldMapper)) \
+                and getattr(ctx, "allow_expensive", True) is False:
+            # term-scan ranges over strings are the expensive path
+            # (TermBasedFieldType rangeQuery gate)
+            raise IllegalArgumentError(
+                "[range] queries on [text] or [keyword] fields cannot be "
+                "executed when 'search.allow_expensive_queries' is set to "
+                "false.")
         lo = -np.inf
         hi = np.inf
         lo_inc = hi_inc = True
@@ -679,18 +688,24 @@ class RegexpQuery(Query):
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Restricted Damerau-Levenshtein (OSA) — Lucene's fuzzy automata count
+    an adjacent transposition as ONE edit (transpositions=true default)."""
     if abs(len(a) - len(b)) > k:
         return False
+    prev2: Optional[List[int]] = None
     prev = list(range(len(b) + 1))
     for i, ca in enumerate(a, 1):
         cur = [i] + [0] * len(b)
         best = cur[0]
         for j, cb in enumerate(b, 1):
             cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            if (prev2 is not None and i > 1 and j > 1
+                    and ca == b[j - 2] and a[i - 2] == cb):
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
             best = min(best, cur[j])
         if best > k:
             return False
-        prev = cur
+        prev2, prev = prev, cur
     return prev[-1] <= k
 
 
@@ -757,13 +772,15 @@ class MatchBoolPrefixQuery(Query):
 
     def __init__(self, field: str, text: str, boost: float = 1.0,
                  operator: str = "or",
-                 minimum_should_match=None, analyzer: Optional[str] = None):
+                 minimum_should_match=None, analyzer: Optional[str] = None,
+                 fuzziness=None):
         self.field = field
         self.text = str(text)
         self.boost = boost
         self.operator = str(operator).lower()
         self.minimum_should_match = minimum_should_match
         self.analyzer = analyzer
+        self.fuzziness = fuzziness
 
     def execute(self, ctx: SearchContext) -> DocSet:
         mapper = ctx.mapper_service.get(self.field)
@@ -777,8 +794,14 @@ class MatchBoolPrefixQuery(Query):
         if not terms:
             return DocSet.empty()
         *head, last = terms
-        sets = [TermQuery(self.field, t, self.boost).execute(ctx)
-                for t in head]
+        if self.fuzziness is not None:
+            # fuzziness applies to the complete (non-prefix) terms only
+            # (MatchBoolPrefixQueryBuilder setFuzziness)
+            sets = [FuzzyQuery(self.field, t, self.fuzziness,
+                               self.boost).execute(ctx) for t in head]
+        else:
+            sets = [TermQuery(self.field, t, self.boost).execute(ctx)
+                    for t in head]
         sets.append(PrefixQuery(self.field, last, self.boost).execute(ctx))
         if self.minimum_should_match is not None:
             required = resolve_msm(self.minimum_should_match, len(sets))
@@ -825,6 +848,16 @@ class QueryStringQuery(Query):
     def execute(self, ctx: SearchContext) -> DocSet:
         if self.query.strip() == "*":
             return MatchAllQuery(self.boost).execute(ctx)
+
+        # Lucene regex syntax: a whole-query /re/ compiles to a RegexpQuery
+        # per default field (QueryParserBase.getRegexpQuery) — length limits
+        # apply before matching
+        q = self.query.strip()
+        if len(q) > 2 and q.startswith("/") and q.endswith("/"):
+            fields = self._default_fields(ctx) or ["_all"]
+            subs = [RegexpQuery(f, q[1:-1]) for f in fields]
+            sub = subs[0] if len(subs) == 1 else DisMaxQuery(subs)
+            return sub.execute(ctx)
 
         # pass 1: tokenize into clauses and the connectors between them
         clauses: List[dict] = []       # {sign, field, text, phrase, negated}
@@ -878,6 +911,14 @@ class QueryStringQuery(Query):
                     if hi != "*":
                         kw["lte" if close_b == "]" else "lt"] = hi
                     sub: Query = RangeQuery(c["field"], **kw)
+                elif (len(c["text"]) > 2 and c["text"].startswith("/")
+                      and c["text"].endswith("/") and not c["phrase"]):
+                    sub = RegexpQuery(c["field"], c["text"][1:-1])
+                elif not c["phrase"] and ("*" in c["text"]
+                                          or "?" in c["text"]):
+                    # wildcard terms normalize through the analyzer chain
+                    # (QueryParserBase.getWildcardQuery + normalization)
+                    sub = WildcardQuery(c["field"], c["text"].lower())
                 else:
                     sub = (MatchPhraseQuery(c["field"], c["text"])
                            if c["phrase"]
@@ -907,12 +948,17 @@ class QueryStringQuery(Query):
 
 class MultiMatchQuery(Query):
     def __init__(self, query: str, fields: List[str], mm_type: str = "best_fields",
-                 operator: str = "or", boost: float = 1.0):
+                 operator: str = "or", boost: float = 1.0,
+                 analyzer: Optional[str] = None, minimum_should_match=None,
+                 fuzziness=None):
         self.query = query
         self.fields = fields
         self.mm_type = mm_type
         self.operator = operator
         self.boost = boost
+        self.analyzer = analyzer
+        self.minimum_should_match = minimum_should_match
+        self.fuzziness = fuzziness
 
     def execute(self, ctx: SearchContext) -> DocSet:
         def split_boost(f):
@@ -929,7 +975,10 @@ class MultiMatchQuery(Query):
                 # (reference: MatchBoolPrefixQueryBuilder)
                 sets.append(MatchBoolPrefixQuery(
                     name, self.query, boost=self.boost * fboost,
-                    operator=self.operator).execute(ctx))
+                    operator=self.operator,
+                    minimum_should_match=self.minimum_should_match,
+                    analyzer=self.analyzer,
+                    fuzziness=self.fuzziness).execute(ctx))
             else:
                 sets.append(MatchQuery(name, self.query, operator=self.operator,
                                        boost=self.boost * fboost).execute(ctx))
@@ -1302,7 +1351,8 @@ def parse_query(body: Optional[dict]) -> Query:
                 field, v.get("query"), float(v.get("boost", 1.0)),
                 v.get("operator", "or"),
                 minimum_should_match=v.get("minimum_should_match"),
-                analyzer=v.get("analyzer"))
+                analyzer=v.get("analyzer"),
+                fuzziness=v.get("fuzziness"))
         return MatchBoolPrefixQuery(field, v)
     if kind in ("query_string", "simple_query_string"):
         fields = spec.get("fields") or (
@@ -1311,9 +1361,16 @@ def parse_query(body: Optional[dict]) -> Query:
                                 spec.get("default_operator", "or"),
                                 float(spec.get("boost", 1.0)))
     if kind == "multi_match":
+        mmt = spec.get("type", "best_fields")
+        if spec.get("slop") is not None and mmt in ("bool_prefix",
+                                                    "cross_fields"):
+            raise ParsingError(f"[slop] not allowed for type [{mmt}]")
         return MultiMatchQuery(spec.get("query"), spec.get("fields", []),
-                               spec.get("type", "best_fields"),
-                               spec.get("operator", "or"))
+                               mmt, spec.get("operator", "or"),
+                               analyzer=spec.get("analyzer"),
+                               minimum_should_match=spec.get(
+                                   "minimum_should_match"),
+                               fuzziness=spec.get("fuzziness"))
     if kind == "range":
         field, v = _single(spec, "range")
         return RangeQuery(field, gte=v.get("gte", v.get("from")), gt=v.get("gt"),

@@ -27,6 +27,7 @@ from elasticsearch_tpu.search.queries import (
     DocSet,
     Query,
     SearchContext,
+    _check_expensive,
     parse_query,
 )
 
@@ -247,9 +248,15 @@ class DistanceFeatureQuery(Query):
             score = self.boost * pivot_m / (pivot_m + dist)
         else:
             from elasticsearch_tpu.common.settings import parse_time_value
-            from elasticsearch_tpu.index.mapping import parse_date_millis
-            origin_ms = parse_date_millis(self.origin)
-            pivot_ms = parse_time_value(self.pivot, "pivot") * 1000.0
+            from elasticsearch_tpu.index.mapping import (
+                parse_date_millis, parse_date_nanos)
+            if type_name == "date_nanos":
+                # nanosecond storage: keep origin/pivot in the field's unit
+                origin_ms = float(parse_date_nanos(self.origin))
+                pivot_ms = parse_time_value(self.pivot, "pivot") * 1e9
+            else:
+                origin_ms = parse_date_millis(self.origin)
+                pivot_ms = parse_time_value(self.pivot, "pivot") * 1000.0
             vals = np.zeros(len(rows))
             present = np.zeros(len(rows), dtype=bool)
             for i, row in enumerate(rows):
@@ -643,6 +650,7 @@ class NestedQuery(Query):
         self.score_mode = score_mode
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "joining")
         rows_out: List[int] = []
         for view in ctx.reader.views:
             seg = view.segment
@@ -702,6 +710,7 @@ class HasChildQuery(Query):
         self.score_mode = score_mode
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "joining")
         join_field, _ = _join_mapper(ctx)
         child_hits = self.query.execute(ctx)
         id_rows = _id_to_row(ctx)
@@ -730,6 +739,7 @@ class HasParentQuery(Query):
         self.score = score
 
     def execute(self, ctx: SearchContext) -> DocSet:
+        _check_expensive(ctx, "joining")
         join_field, _ = _join_mapper(ctx)
         parent_hits = self.query.execute(ctx)
         # restrict to parents of the right relation name

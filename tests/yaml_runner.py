@@ -126,7 +126,11 @@ def resolve_call(api_name: str, args: Dict[str, Any]) -> Tuple[str, str, Dict[st
     parts, pathspec = best
     path = pathspec["path"]
     for part in parts:
-        path = path.replace("{%s}" % part, _fmt_param(args[part]))
+        # URL-encode path parts like the real low-level client does —
+        # date-math names (<logstash-{now/M}>) carry slashes
+        from urllib.parse import quote
+        path = path.replace("{%s}" % part,
+                            quote(_fmt_param(args[part]), safe=",*"))
     methods = pathspec.get("methods", ["GET"])
     if body is not None and "POST" in methods and "PUT" not in methods:
         method = "POST"
@@ -231,8 +235,20 @@ class YamlTestRunner:
 
     def run_suite(self, path: str) -> List[dict]:
         import yaml as _yaml
+
+        # keep YAML timestamps as raw strings: the reference runner sends
+        # them over the wire verbatim; PyYAML's datetime objects aren't
+        # JSON-serializable and would alter date-format semantics
+        class _StrTimestampLoader(_yaml.SafeLoader):
+            pass
+
+        _StrTimestampLoader.add_constructor(
+            "tag:yaml.org,2002:timestamp",
+            lambda loader, node: loader.construct_scalar(node))
+
         with open(path) as f:
-            docs = [d for d in _yaml.safe_load_all(f) if d]
+            docs = [d for d in _yaml.load_all(f, Loader=_StrTimestampLoader)
+                    if d]
         setup = []
         teardown = []
         tests = []
