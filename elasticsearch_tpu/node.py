@@ -170,12 +170,30 @@ class _MultiShardVectorStore:
                 keep[i] = False
         return out_rows[keep], scores[keep]
 
+    def _prefer_host(self, field: str) -> bool:
+        """True when every shard has a host VNNI mirror and the cost model
+        says a host pass beats a device round-trip for this corpus size
+        (serving/batcher.py) — then the per-shard path (whose shard stores
+        route host-side) wins over the fused mesh program."""
+        from elasticsearch_tpu.serving.batcher import CostModel
+
+        total, dims = 0, 0
+        for shard in self.svc.shards:
+            fc = shard.vector_store.field(field) \
+                if hasattr(shard.vector_store, "field") else None
+            if fc is None or fc.host is None:
+                return False
+            total += len(fc.row_map)
+            dims = fc.dims
+        return total > 0 and CostModel.prefer_host(1, total, dims)
+
     def search(self, field: str, query_vector, k: int, filter_rows=None,
                precision: str = "bf16"):
         state = self._mesh_state(field)
         # k beyond the per-shard padded row count cannot merge losslessly
         # in the fused program; such deep k falls back to the host merge
-        if state is not None and k <= state["per"]:
+        if state is not None and k <= state["per"] \
+                and not self._prefer_host(field):
             return self._mesh_search(state, query_vector, k, filter_rows,
                                      precision)
         all_rows, all_scores = [], []

@@ -99,26 +99,36 @@ def similarity_scores(
     raise ValueError(f"unknown similarity metric [{metric}]")
 
 
-def to_es_score(raw: jax.Array, metric: str) -> jax.Array:
+def _np_for(x):
+    """jnp for device arrays, numpy for host arrays — score conversions on
+    host results must NOT ship the array through a device round-trip (the
+    serving path's host results stay host-side end to end)."""
+    import numpy as _np
+    return jnp if isinstance(x, jax.Array) else _np
+
+
+def to_es_score(raw, metric: str):
     """Convert raw similarity to the `_search` knn `_score` convention."""
+    xp = _np_for(raw)
     if metric == COSINE:
         return (1.0 + raw) / 2.0
     if metric == DOT_PRODUCT:
         return (1.0 + raw) / 2.0
     if metric == MAX_INNER_PRODUCT:
-        return jnp.where(raw < 0, 1.0 / (1.0 - raw), raw + 1.0)
+        return xp.where(raw < 0, 1.0 / (1.0 - raw), raw + 1.0)
     if metric == L2_NORM:
         # raw = -d^2  →  score = 1 / (1 + d^2)
         return 1.0 / (1.0 - raw)
     raise ValueError(f"unknown similarity metric [{metric}]")
 
 
-def from_es_score(score: jax.Array, metric: str) -> jax.Array:
+def from_es_score(score, metric: str):
     """Inverse of to_es_score (used when merging with externally-scored hits)."""
+    xp = _np_for(score)
     if metric in (COSINE, DOT_PRODUCT):
         return 2.0 * score - 1.0
     if metric == L2_NORM:
         return 1.0 - 1.0 / score
     if metric == MAX_INNER_PRODUCT:
-        return jnp.where(score < 1.0, 1.0 - 1.0 / score, score - 1.0)
+        return xp.where(score < 1.0, 1.0 - 1.0 / score, score - 1.0)
     raise ValueError(f"unknown similarity metric [{metric}]")

@@ -1,0 +1,5 @@
+"""Query serving layer: micro-batching dispatch + host/device cost routing."""
+
+from elasticsearch_tpu.serving.batcher import CombiningBatcher, CostModel
+
+__all__ = ["CombiningBatcher", "CostModel"]

@@ -16,14 +16,22 @@ cheap; a full device upload happens only for new/changed segments
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu import native
 from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
 from elasticsearch_tpu.index.segment import ShardReader
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.serving.batcher import CombiningBatcher, CostModel
+from elasticsearch_tpu.vectors.host_corpus import HostFieldCorpus, packed_nbytes
+
+# host int8 mirrors are built for corpora whose packed+rescore footprint is
+# below this (3 bytes/element); larger corpora serve from the device only
+HOST_MIRROR_MAX_BYTES = 512_000_000
 
 _METRIC_MAP = {
     "cosine": sim.COSINE,
@@ -36,14 +44,16 @@ _METRIC_MAP = {
 class FieldCorpus:
     """Device corpus for one vector field + host-side row maps."""
 
-    __slots__ = ("corpus", "row_map", "metric", "dims", "version")
+    __slots__ = ("corpus", "row_map", "metric", "dims", "version", "host")
 
-    def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int, version: tuple):
+    def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int,
+                 version: tuple, host=None):
         self.corpus = corpus          # knn_ops.Corpus (device pytree)
         self.row_map = row_map        # device row -> engine global row
         self.metric = metric
         self.dims = dims
         self.version = version        # cache key: segment/tombstone fingerprint
+        self.host = host              # HostFieldCorpus latency mirror (or None)
 
 
 def extract_field_rows(reader: ShardReader, field: str
@@ -71,9 +81,13 @@ def extract_field_rows(reader: ShardReader, field: str
 
 
 class VectorStoreShard:
-    def __init__(self, dtype: str = "bf16"):
+    def __init__(self, dtype: str = "bf16",
+                 host_mirror_max_bytes: int = HOST_MIRROR_MAX_BYTES):
         self.dtype = dtype
+        self.host_mirror_max_bytes = host_mirror_max_bytes
         self._fields: Dict[str, FieldCorpus] = {}
+        self._batchers: Dict[tuple, CombiningBatcher] = {}
+        self._batchers_lock = threading.Lock()
 
     @staticmethod
     def _fingerprint(reader: ShardReader, field: str) -> tuple:
@@ -102,8 +116,19 @@ class VectorStoreShard:
             if mapper.params.get("index_options", {}).get("type") == "int8_flat":
                 dtype = "int8"
             corpus = knn_ops.build_corpus(full, metric=metric, dtype=dtype)
+            host = None
+            # int8_flat fields score int8 on the device; a bf16-rescored host
+            # mirror would make result quality depend on routing — skip it so
+            # the route stays invisible to callers
+            if (native.AVAILABLE and dtype != "int8"
+                    and packed_nbytes(len(row_map), mapper.dims)
+                    <= self.host_mirror_max_bytes):
+                host = HostFieldCorpus(full, metric)
             self._fields[field] = FieldCorpus(corpus, row_map, metric,
-                                              mapper.dims, version)
+                                              mapper.dims, version, host=host)
+            with self._batchers_lock:
+                for key in [k for k in self._batchers if k[0] == field]:
+                    del self._batchers[key]
 
     def field(self, name: str) -> Optional[FieldCorpus]:
         return self._fields.get(name)
@@ -111,34 +136,80 @@ class VectorStoreShard:
     def search(self, field: str, query_vector: np.ndarray, k: int,
                filter_rows: Optional[np.ndarray] = None,
                precision: str = "bf16") -> Tuple[np.ndarray, np.ndarray]:
-        """Top-k device search. Returns (global_rows [m], raw_scores [m]),
-        m <= k (padding/filtered slots removed).
+        """Top-k search. Returns (global_rows [m], raw_scores [m]), m <= k
+        (padding/filtered slots removed).
 
         filter_rows: sorted engine global rows allowed to match (pre-filter
         bitset from a boolean query; host → device additive mask).
-        """
-        import jax.numpy as jnp
 
+        Concurrent callers coalesce through a per-(field, k) combining
+        batcher into ONE dispatch, which a cost model routes to either the
+        host VNNI mirror or the device matmul program (serving/batcher.py) —
+        the round-3 path paid a full device round-trip per query.
+        """
         fc = self._fields.get(field)
         if fc is None or fc.corpus is None or len(fc.row_map) == 0:
             return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float32)
 
-        mask = None
-        if filter_rows is not None:
-            allowed = np.isin(fc.row_map, filter_rows)
-            n_pad = fc.corpus.matrix.shape[0]
-            m = np.zeros(n_pad, dtype=bool)
-            m[: len(allowed)] = allowed
-            mask = jnp.asarray(m)
+        key = (field, fc.version, k, precision)
+        with self._batchers_lock:
+            batcher = self._batchers.get(key)
+            if batcher is None:
+                def execute(reqs, fc=fc, k=k, precision=precision):
+                    return self._execute_batch(fc, k, precision, reqs)
 
+                batcher = CombiningBatcher(execute)
+                if len(self._batchers) > 64:  # stale (field, k) variants
+                    self._batchers.clear()
+                self._batchers[key] = batcher
+        return batcher.submit(
+            (np.asarray(query_vector, dtype=np.float32), filter_rows))
+
+    def _execute_batch(self, fc: FieldCorpus, k: int, precision: str,
+                       requests) -> list:
+        """Serve one coalesced batch of (query_vector, filter_rows)."""
+        import jax.numpy as jnp
+
+        n_valid = len(fc.row_map)
         k_eff = min(k, fc.corpus.matrix.shape[0])
-        q = jnp.asarray(np.asarray(query_vector, dtype=np.float32)[None, :])
-        scores, ids = knn_ops.knn_search_auto(q, fc.corpus, k=k_eff, metric=fc.metric,
-                                              filter_mask=mask, precision=precision)
-        scores = np.asarray(scores[0])
-        ids = np.asarray(ids[0])
-        valid = scores > -1e37
-        ids, scores = ids[valid], scores[valid]
-        in_range = ids < len(fc.row_map)
-        ids, scores = ids[in_range], scores[in_range]
-        return fc.row_map[ids], scores
+        queries = np.stack([q for q, _ in requests])
+        any_filter = any(fr is not None for _, fr in requests)
+
+        use_host = (fc.host is not None and precision != "f32"
+                    and CostModel.prefer_host(len(requests), fc.host.n,
+                                              fc.host.dims))
+        if use_host:
+            mask = None
+            if any_filter:
+                mask = np.ones((len(requests), n_valid), dtype=bool)
+                for i, (_, fr) in enumerate(requests):
+                    if fr is not None:
+                        mask[i] = np.isin(fc.row_map, fr)
+            scores, ids = fc.host.search(queries, k_eff, mask=mask)
+            scores = np.asarray(scores)
+            ids = np.asarray(ids)
+            floor = -np.inf
+        else:
+            mask = None
+            if any_filter:
+                n_pad = fc.corpus.matrix.shape[0]
+                m = np.zeros((len(requests), n_pad), dtype=bool)
+                for i, (_, fr) in enumerate(requests):
+                    if fr is None:
+                        m[i, :n_valid] = True
+                    else:
+                        m[i, :n_valid] = np.isin(fc.row_map, fr)
+                mask = jnp.asarray(m)
+            s, i = knn_ops.knn_search_auto(
+                jnp.asarray(queries), fc.corpus, k=k_eff, metric=fc.metric,
+                filter_mask=mask, precision=precision)
+            scores, ids = np.asarray(s), np.asarray(i)
+            floor = -1e37
+
+        out = []
+        for qi in range(len(requests)):
+            sc, rid = scores[qi], ids[qi]
+            valid = (sc > floor) & (rid >= 0) & (rid < n_valid)
+            sc, rid = sc[valid], rid[valid]
+            out.append((fc.row_map[rid], sc.astype(np.float32)))
+        return out
