@@ -770,6 +770,7 @@ class Node:
         rank_constant = int(rrf.get("rank_constant", 60))
         window = int(rrf.get("rank_window_size", rrf.get("window_size", 100)))
         size = int(body.get("size", 10))
+        body = self._rewrite_terms_lookup(body)
 
         sub_queries: List[dict] = []
         if body.get("sub_searches"):
@@ -788,9 +789,68 @@ class Node:
 
         passthrough = {k: v for k, v in body.items()
                        if k in ("_source", "docvalue_fields", "highlight")}
+        start = time.perf_counter()
+
+        # Fast path (single index): run the sub-searches as QUERY PHASES
+        # only, fuse ranks on row ids, and fetch just the final `size` docs
+        # — the query-then-fetch shape (SearchPhaseController), vs. the
+        # general path below that materializes `window` full hits per list.
+        try:
+            services = self.indices.resolve_open(index_expr) \
+                if index_expr and ":" not in index_expr else []
+        except SearchEngineError:
+            services = []
+        from elasticsearch_tpu.common.settings import setting_bool
+        if len(services) == 1 \
+                and not setting_bool(services[0].settings.get("index.frozen")) \
+                and "highlight" not in body:  # highlighting needs the
+            # per-sub-search query context — the general path keeps it
+            from elasticsearch_tpu.search.service import (
+                ShardSearchResult, execute_fetch_phase, execute_query_phase)
+
+            svc = services[0]
+            reader = svc.combined_reader()
+            store = _MultiShardVectorStore(svc)
+            breaker_bytes = reader.num_docs * 16
+            self.breakers.add_estimate("request", breaker_bytes, "<rrf>")
+            try:
+                fused_rows: Dict[int, float] = {}
+                for q in sub_queries:
+                    result = execute_query_phase(
+                        reader, svc.mapper_service,
+                        {"query": q, "size": window},
+                        vector_store=store, query_cache=self.caches.query,
+                        index_settings=svc.settings.as_flat_dict(),
+                        max_buckets=self._max_buckets(),
+                        allow_expensive=self._allow_expensive())
+                    for rank_pos, row in enumerate(result.rows):
+                        row = int(row)
+                        fused_rows[row] = fused_rows.get(row, 0.0) + 1.0 / (
+                            rank_constant + rank_pos + 1)
+                ordered = sorted(fused_rows.items(),
+                                 key=lambda kv: (-kv[1], kv[0]))
+                top = ordered[:size]
+                final = ShardSearchResult(
+                    0, np.asarray([r for r, _ in top], dtype=np.int64),
+                    np.asarray([s for _, s in top], dtype=np.float32),
+                    None, len(fused_rows), "eq", None,
+                    top[0][1] if top else None)
+                hits = execute_fetch_phase(reader, svc.mapper_service,
+                                           {**passthrough, "size": size},
+                                           final, index_name=svc.name)
+            finally:
+                self.breakers.release("request", breaker_bytes)
+            for h, (_, score) in zip(hits, top):
+                h["_score"] = score
+            return {"took": int((time.perf_counter() - start) * 1000),
+                    "timed_out": False,
+                    "hits": {"total": {"value": len(fused_rows),
+                                       "relation": "eq"},
+                             "max_score": hits[0]["_score"] if hits else None,
+                             "hits": hits}}
+
         fused: Dict[tuple, float] = {}
         hit_by_key: Dict[tuple, dict] = {}
-        start = time.perf_counter()
         for q in sub_queries:
             sub_body = {"query": q, "size": window, **passthrough}
             resp = self.search(index_expr, sub_body,
@@ -1323,8 +1383,20 @@ class Node:
         """Coordinator rewrite of terms-lookup clauses: fetch the source
         doc ONCE and inline its values (reference:
         TermsQueryBuilder.doRewrite + GetRequest on the coordinator)."""
+        def has_terms(node):
+            # cheap key scan — str()/dumps of a body holding a dense query
+            # vector costs more than the whole rewrite
+            if isinstance(node, dict):
+                if "terms" in node:
+                    return True
+                return any(has_terms(v) for v in node.values())
+            if isinstance(node, list) and node \
+                    and isinstance(node[0], (dict, list)):
+                return any(has_terms(i) for i in node)
+            return False
+
         q = (body or {}).get("query")
-        if not q or "terms" not in str(q):
+        if not q or not has_terms(q):
             return body
         import copy as _copy
         from elasticsearch_tpu.search.service import _get_path

@@ -88,13 +88,12 @@ class RequestCache(LruCache):
         flag = body.get("request_cache")
         if flag is False:
             return False
+        if flag is not True and body.get("size", None) != 0:
+            return False  # before _canonical: don't serialize large bodies
         src = _canonical(body)
         if '"script' in src or '"now' in src.lower():
             return False
-        if flag is True:
-            return True
-        size = body.get("size", None)
-        return size == 0
+        return True
 
     def key(self, shard_key: Any, reader_gen: int, body: dict) -> tuple:
         return (shard_key, reader_gen, _canonical(

@@ -190,10 +190,21 @@ class VectorStoreShard:
             ids = np.asarray(ids)
             floor = -np.inf
         else:
+            # pad the batch to a power-of-2 bucket: jit specializes on the
+            # query-count dimension, and a fresh compile per distinct batch
+            # size would stall serving (pad results are sliced away below)
+            b_real = len(requests)
+            b_pad = 1
+            while b_pad < b_real:
+                b_pad *= 2
+            if b_pad != b_real:
+                queries = np.concatenate(
+                    [queries, np.zeros((b_pad - b_real, queries.shape[1]),
+                                       dtype=np.float32)])
             mask = None
             if any_filter:
                 n_pad = fc.corpus.matrix.shape[0]
-                m = np.zeros((len(requests), n_pad), dtype=bool)
+                m = np.zeros((b_pad, n_pad), dtype=bool)
                 for i, (_, fr) in enumerate(requests):
                     if fr is None:
                         m[i, :n_valid] = True

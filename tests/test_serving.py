@@ -234,3 +234,67 @@ class TestStoreRouting:
             exact = vn @ qn
             ref = set(_exact_topk(exact, 5).tolist())
             assert len(ref & set(rows.tolist())) >= 4
+
+
+class TestRrfFastPath:
+    """RRF fuses query-phase ranked lists and fetches only `size` docs
+    (node.py _search_rrf fast path); results must match the definition
+    score(d) = sum_lists 1/(rank_constant + rank)."""
+
+    def _node(self, tmp_path):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        from elasticsearch_tpu.node import Node
+
+        rng = np.random.default_rng(9)
+        node = Node(str(tmp_path))
+        node.create_index_with_templates("h", mappings={"properties": {
+            "body": {"type": "text"},
+            "v": {"type": "dense_vector", "dims": 8}}})
+        ops = []
+        for i in range(300):
+            ops.append({"index": {"_index": "h", "_id": str(i)}})
+            ops.append({"body": " ".join(rng.choice(list("abcde"), 4)),
+                        "v": rng.standard_normal(8).tolist()})
+        node.bulk(ops)
+        node.indices.get("h").refresh()
+        return node, rng
+
+    def test_matches_manual_fusion(self, tmp_path):
+        node, rng = self._node(tmp_path)
+        qv = rng.standard_normal(8).tolist()
+        body = {"rank": {"rrf": {"rank_constant": 60,
+                                 "rank_window_size": 50}},
+                "query": {"match": {"body": "a b"}},
+                "knn": {"field": "v", "query_vector": qv, "k": 50},
+                "size": 10}
+        resp = node.search("h", body)
+        fused = {}
+        for q in (body["query"], {"knn": body["knn"]}):
+            sub = node.search("h", {"query": q, "size": 50})
+            for rp, hit in enumerate(sub["hits"]["hits"]):
+                fused[hit["_id"]] = fused.get(hit["_id"], 0.0) \
+                    + 1.0 / (60 + rp + 1)
+        expect = sorted(fused.values(), reverse=True)[:10]
+        got = [h["_score"] for h in resp["hits"]["hits"]]
+        np.testing.assert_allclose(got, expect, rtol=1e-9)
+        assert resp["hits"]["total"]["value"] == len(fused)
+        assert "_source" in resp["hits"]["hits"][0]
+        node.close()
+
+    def test_source_false_and_window_clamp(self, tmp_path):
+        node, rng = self._node(tmp_path)
+        body = {"rank": {"rrf": {"rank_window_size": 20}},
+                "query": {"match": {"body": "a"}},
+                "knn": {"field": "v",
+                        "query_vector": rng.standard_normal(8).tolist(),
+                        "k": 20},
+                "size": 5, "_source": False}
+        resp = node.search("h", body)
+        assert len(resp["hits"]["hits"]) == 5
+        assert "_source" not in resp["hits"]["hits"][0]
+        node.close()
