@@ -69,6 +69,7 @@ SCROLL_CLEAR = "indices:data/read/scroll[clear]"
 SCROLL_CLEAR_ALL = "indices:data/read/scroll[clear_all]"
 RECOVERY_START = "internal:index/shard/recovery/start_recovery"
 RECOVERY_FILE_CHUNK = "internal:index/shard/recovery/file_chunk"
+NODES_DISPATCH = "cluster:monitor/nodes/dispatch"
 MASTER_CREATE_INDEX = "cluster:admin/indices/create"
 MASTER_DELETE_INDEX = "cluster:admin/indices/delete"
 MASTER_SHARD_STARTED = "internal:cluster/shard/started"
@@ -138,6 +139,11 @@ class ClusterNode:
         # persistent-task execution (PersistentTasksExecutor registry):
         # task_id -> tick callable, supplied by the composition root
         self.persistent_task_executors: Dict[str, Callable[[], None]] = {}
+        # generic routed-action layer (TransportNodesAction analog): named
+        # local collectors the REST layer registers; NODES_DISPATCH fans a
+        # named op out to every node and merges per-node sections
+        self.node_collectors: Dict[str, Callable[[dict], Any]] = {}
+        self.dispatch_executor: Optional[Callable[[Callable], Any]] = None
         self._running_ptasks: Set[str] = set()
         self.mappers: Dict[str, MapperService] = {}
         from elasticsearch_tpu.search.caches import NodeCaches
@@ -1742,6 +1748,110 @@ class ClusterNode:
         t.register(me, MASTER_PUT_REGISTRY, self._master_put_registry)
         t.register(me, MASTER_PUT_PERSISTENT_TASK,
                    self._master_put_persistent_task)
+        t.register(me, NODES_DISPATCH, self._on_nodes_dispatch)
+
+    # routed actions ----------------------------------------------------------
+    def _on_nodes_dispatch(self, sender, request, respond):
+        """Run a named registered collector locally and respond with its
+        section — the nodeOperation half of TransportNodesAction."""
+        op = (request or {}).get("op")
+        fn = self.node_collectors.get(op)
+        if fn is None:
+            respond({"error": {"type": "unknown_dispatch_op",
+                               "reason": f"no collector [{op}]"}})
+            return
+        params = (request or {}).get("params") or {}
+
+        def work():
+            try:
+                out = {"result": fn(params)}
+            except Exception as e:  # surface to the caller, never hang
+                out = {"error": {"type": type(e).__name__, "reason": str(e),
+                                 "status": int(getattr(e, "status", 500))}}
+            loop = getattr(self.transport, "loop", None)
+            if loop is not None:
+                loop.call_soon_threadsafe(respond, out)
+            else:  # simulator transport: synchronous respond
+                respond(out)
+
+        if self.dispatch_executor is not None:
+            # collectors may block (hot-threads sampling, fs probes): run on
+            # the generic pool, never on the event loop
+            self.dispatch_executor(work)
+        else:
+            work()
+
+    def fanout_nodes(self, op: str, params: Optional[dict] = None,
+                     on_done: Optional[Callable] = None,
+                     timeout_ms: int = 10000) -> None:
+        """Broadcast a named collector op to every cluster node and merge:
+        on_done({"results": {node_id: section}, "failures": {node_id: err}}).
+        Unreachable nodes become failures, not errors — the merged response
+        reports partial coverage the way TransportNodesAction does."""
+        targets = list(self.cluster_state.nodes.keys()) or [self.node_id]
+        results: Dict[str, Any] = {}
+        failures: Dict[str, Any] = {}
+        remaining = {"n": len(targets)}
+
+        def finish_one():
+            remaining["n"] -= 1
+            if remaining["n"] == 0 and on_done is not None:
+                on_done({"results": results, "failures": failures})
+
+        def callbacks(nid):
+            def on_resp(resp):
+                if isinstance(resp, dict) and resp.get("error") is not None:
+                    failures[nid] = resp["error"]
+                else:
+                    results[nid] = (resp or {}).get("result")
+                finish_one()
+
+            def on_fail(err):
+                failures[nid] = {"type": "node_unreachable",
+                                 "reason": str(err)}
+                finish_one()
+
+            return on_resp, on_fail
+
+        del timeout_ms  # transport default applies (the sim transport's
+        # send() has no timeout kwarg; callers bound waits via _call)
+        for nid in targets:
+            on_resp, on_fail = callbacks(nid)
+            self.transport.send(self.node_id, nid, NODES_DISPATCH,
+                                {"op": op, "params": params or {}},
+                                on_response=on_resp, on_failure=on_fail)
+
+    def dispatch_to_node(self, node_id: str, op: str,
+                         params: Optional[dict] = None,
+                         on_done: Optional[Callable] = None,
+                         on_failure: Optional[Callable] = None,
+                         timeout_ms: int = 10000) -> None:
+        """Run a named collector op on ONE node (task get/cancel routing)."""
+        del timeout_ms  # see fanout_nodes
+
+        def on_resp(resp):
+            if isinstance(resp, dict) and resp.get("error") is not None:
+                err = resp["error"]
+                # rebuild the remote's error class so error.type/status
+                # round-trip (clustered /_tasks/{id} must 404 with
+                # resource_not_found_exception, as single-node does)
+                from elasticsearch_tpu.common import errors as _errors
+                cls = getattr(_errors, str(err.get("type", "")),
+                              SearchEngineError)
+                if not (isinstance(cls, type)
+                        and issubclass(cls, SearchEngineError)):
+                    cls = SearchEngineError
+                exc = cls(err.get("reason", str(err)))
+                exc.status = int(err.get("status", getattr(cls, "status", 500)))
+                if on_failure:
+                    on_failure(exc)
+                return
+            if on_done:
+                on_done((resp or {}).get("result"))
+
+        self.transport.send(self.node_id, node_id, NODES_DISPATCH,
+                            {"op": op, "params": params or {}},
+                            on_response=on_resp, on_failure=on_failure)
 
     # client admin helpers ----------------------------------------------------
     def client_create_index(self, name: str, settings: Optional[dict] = None,

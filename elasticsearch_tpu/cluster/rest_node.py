@@ -63,8 +63,14 @@ class ClusterAwareNode(Node):
                          cluster_name=cluster_name, settings=settings)
         self.cluster = cluster_node
         self.loop = loop
+        # one identity: the REST layer, task manager, and cluster layer must
+        # agree on this node's id (task ids embed it; fan-out responses key
+        # on it)
+        self.node_id = cluster_node.node_id
+        self.tasks.node_id = cluster_node.node_id
         self._wire_replicated_registries()
         self._wire_persistent_features()
+        self._wire_node_dispatch()
 
     def _wire_persistent_features(self) -> None:
         """Background features run as cluster-assigned persistent tasks
@@ -142,6 +148,103 @@ class ClusterAwareNode(Node):
                 on_failure=lambda e: None)
 
     # --------------------------------------------------- replicated registries
+    def _wire_node_dispatch(self) -> None:
+        """Register this node's local collectors for the generic routed
+        action layer (TransportNodesAction analog): every node serves the
+        same named ops; the *_api overrides below fan them out and merge,
+        so `_nodes/stats` on node B reflects node A."""
+        c = self.cluster
+
+        def _cancel(p):
+            t = self.tasks.cancel(p["task_id"])
+            return {self.cluster.node_id: {
+                "tasks": {t.task_id: t.to_dict(self.cluster.node_id)}}}
+
+        c.node_collectors.update({
+            "info": lambda p: self.local_node_info(),
+            "stats": lambda p: self.local_node_stats(),
+            "hot_threads": lambda p: self.local_hot_threads(
+                float(p.get("interval_s", 0.05))),
+            "tasks": lambda p: self.local_tasks_section(p.get("actions")),
+            "task_get": lambda p: {
+                "completed": False,
+                "task": self.tasks.get(p["task_id"]).to_dict(
+                    self.cluster.node_id)},
+            "task_cancel": _cancel,
+            "cat_thread_pool": lambda p: self.local_cat_threadpool_rows(
+                p.get("pool_filter")),
+            "cat_nodeattrs": lambda p: self.local_cat_nodeattrs_rows(),
+            "cat_fielddata": lambda p: self.local_cat_fielddata_rows(
+                p.get("field_filter")),
+            "cat_tasks": lambda p: self.local_cat_tasks_rows(),
+        })
+        c.dispatch_executor = functools.partial(
+            self.thread_pool.submit, "generic")
+
+    def _fanout(self, op: str, params: Optional[dict] = None,
+                timeout: float = 20.0) -> dict:
+        return self._call(self.cluster.fanout_nodes, op, params,
+                          timeout=timeout)
+
+    def nodes_info_api(self) -> dict:
+        out = self._fanout("info")
+        return self._nodes_envelope(out["results"],
+                                    failed=len(out["failures"]))
+
+    def nodes_stats_api(self) -> dict:
+        out = self._fanout("stats")
+        return self._nodes_envelope(out["results"],
+                                    failed=len(out["failures"]))
+
+    def hot_threads_api(self, interval_s: float = 0.05) -> str:
+        out = self._fanout("hot_threads", {"interval_s": interval_s})
+        return "\n".join(out["results"][nid]
+                          for nid in sorted(out["results"]))
+
+    def tasks_list_api(self, actions=None) -> dict:
+        out = self._fanout("tasks", {"actions": actions})
+        return {"nodes": out["results"]}
+
+    def _task_owner(self, task_id: str) -> str:
+        owner = str(task_id).rsplit(":", 1)[0]
+        if owner not in self.cluster.cluster_state.nodes:
+            from elasticsearch_tpu.common.errors import ResourceNotFoundError
+            raise ResourceNotFoundError(f"task [{task_id}] isn't running and "
+                                        "hasn't stored its results")
+        return owner
+
+    def task_get_api(self, task_id: str) -> dict:
+        return self._call(self.cluster.dispatch_to_node,
+                          self._task_owner(task_id), "task_get",
+                          {"task_id": task_id}, timeout=20.0)
+
+    def task_cancel_api(self, task_id: str) -> dict:
+        nodes = self._call(self.cluster.dispatch_to_node,
+                           self._task_owner(task_id), "task_cancel",
+                           {"task_id": task_id}, timeout=20.0)
+        return {"nodes": nodes}
+
+    def _cat_fanout(self, op: str, params: Optional[dict] = None) -> list:
+        out = self._fanout(op, params)
+        rows: List[Any] = []
+        for nid in sorted(out["results"]):
+            rows.extend(out["results"][nid] or [])
+        return rows
+
+    def cat_threadpool_rows_api(self, pool_filter=None) -> list:
+        return self._cat_fanout("cat_thread_pool",
+                                {"pool_filter": pool_filter})
+
+    def cat_nodeattrs_rows_api(self) -> list:
+        return self._cat_fanout("cat_nodeattrs")
+
+    def cat_fielddata_rows_api(self, field_filter=None) -> list:
+        return self._cat_fanout("cat_fielddata",
+                                {"field_filter": field_filter})
+
+    def cat_tasks_rows_api(self) -> list:
+        return self._cat_fanout("cat_tasks")
+
     def _wire_replicated_registries(self) -> None:
         """Ingest pipelines, index templates, and stored scripts live in the
         cluster state (IngestMetadata / IndexTemplateMetaData / ScriptMetaData

@@ -1782,6 +1782,173 @@ class Node:
             out["indices"] = indices_out
         return out
 
+    # -------------------------------------------------- node-level admin APIs
+    # The per-node sections below are the "nodeOperation" halves of the
+    # reference's TransportNodesAction pattern: REST handlers call the
+    # *_api envelope methods, which the clustered deployment overrides with
+    # a transport fan-out + merge (cluster/rest_node.py) while these local
+    # collectors run unchanged on every node.
+
+    def local_node_info(self) -> dict:
+        natives = getattr(self, "natives", None)
+        return {"name": self.node_name, "version": __version__,
+                "roles": ["master", "data", "ingest"],
+                "process": {
+                    "mlockall": bool(natives and natives.memory_locked),
+                    "seccomp": bool(natives and natives.seccomp_installed)},
+                "plugins": self.plugins.info()}
+
+    def local_node_stats(self) -> dict:
+        from elasticsearch_tpu.monitor.probes import (
+            fs_probe, os_probe, process_probe, runtime_probe,
+        )
+        return {"name": self.node_name,
+                "jvm": runtime_probe(),
+                "os": os_probe(),
+                "fs": fs_probe(self.indices.data_path),
+                "process": process_probe(),
+                "indices": {
+                    "docs": {"count": sum(
+                        s.doc_count()
+                        for s in self.indices.indices.values())},
+                    "search": {"query_total": self.counters.get("search", 0)},
+                    "indexing": {"index_total":
+                                 self.counters.get("index", 0)},
+                    "request_cache": {
+                        "hit_count": self.caches.request.hits,
+                        "miss_count": self.caches.request.misses,
+                        "evictions": self.caches.request.evictions},
+                    "query_cache": {
+                        "hit_count": self.caches.query.hits,
+                        "miss_count": self.caches.query.misses,
+                        "evictions": self.caches.query.evictions}},
+                "breakers": self.breakers.stats(),
+                "thread_pool": self.thread_pool.stats()}
+
+    def local_hot_threads(self, interval_s: float = 0.05) -> str:
+        from elasticsearch_tpu.monitor import hot_threads_report
+        return hot_threads_report(interval_s=min(interval_s, 0.5),
+                                  node_name=self.node_name)
+
+    def local_tasks_section(self, actions: Optional[str] = None) -> dict:
+        return {"name": self.node_name,
+                "tasks": {t.task_id: t.to_dict(self.node_id)
+                          for t in self.tasks.list_tasks(actions)}}
+
+    @staticmethod
+    def _matches_csv_patterns(name: str, patterns_csv) -> bool:
+        """True when `name` matches any comma-separated wildcard pattern
+        (None/empty = match everything)."""
+        import fnmatch as _fn
+        if not patterns_csv:
+            return True
+        return any(_fn.fnmatch(name, p.strip())
+                   for p in str(patterns_csv).split(","))
+
+    def local_cat_threadpool_rows(self, pool_filter=None) -> list:
+        import os as _os
+        info = self.thread_pool.info()
+        rows = []
+        for name, s in sorted(self.thread_pool.stats().items()):
+            if not self._matches_csv_patterns(name, pool_filter):
+                continue
+            meta = info.get(name, {})
+            ptype = meta.get("type", "fixed")
+            threads = meta.get("size", 0)
+            scaling = ptype == "scaling"
+            rows.append([self.node_name, self.node_id, self.node_id,
+                         _os.getpid(), "127.0.0.1", "127.0.0.1",
+                         9300, name, ptype, s["active"],
+                         s.get("threads", 0), s["queue"],
+                         meta.get("queue_size", -1),
+                         s["rejected"], s.get("largest", 0),
+                         s.get("completed", 0),
+                         1 if scaling else "", threads if scaling else "",
+                         "" if scaling else threads,
+                         "5m" if scaling else ""])
+        return rows
+
+    def cat_threadpool_rows_api(self, pool_filter=None) -> list:
+        return self.local_cat_threadpool_rows(pool_filter)
+
+    def local_cat_nodeattrs_rows(self) -> list:
+        import os as _os
+        attrs = dict(getattr(self, "node_attrs", None) or {"testattr": "test"})
+        return [[self.node_name, self.node_id, _os.getpid(),
+                 "127.0.0.1", "127.0.0.1", 9300, k, v]
+                for k, v in sorted(attrs.items())]
+
+    def cat_nodeattrs_rows_api(self) -> list:
+        return self.local_cat_nodeattrs_rows()
+
+    def local_cat_fielddata_rows(self, field_filter=None) -> list:
+        """Plain-value rows (size as int — the REST handler applies the cat
+        Bytes wrapper; wrappers don't survive the transport)."""
+        rows = []
+        seen = set()
+        for svc in self.indices.indices.values():
+            for path, mapper in svc.mapper_service.all_mappers():
+                if mapper.type_name != "text" \
+                        or not mapper.params.get("fielddata"):
+                    continue
+                if not self._matches_csv_patterns(path, field_filter):
+                    continue
+                if path in seen:
+                    continue
+                seen.add(path)
+                size = max(svc.doc_count() * 32, 1)
+                rows.append([self.node_id, "127.0.0.1", "127.0.0.1",
+                             self.node_name, path, size])
+        return rows
+
+    def cat_fielddata_rows_api(self, field_filter=None) -> list:
+        return self.local_cat_fielddata_rows(field_filter)
+
+    def local_cat_tasks_rows(self) -> list:
+        """Plain-value rows (running time in ns — handler applies Millis)."""
+        me = self.tasks.register("cluster:monitor/tasks/lists", "cat tasks")
+        try:
+            rows = []
+            for t in self.tasks.list_tasks():
+                d = t.to_dict(self.node_id)
+                rows.append([d["action"], t.task_id, "-", d["type"],
+                             d["start_time_in_millis"],
+                             d["running_time_in_nanos"],
+                             "127.0.0.1", self.node_name,
+                             d["description"] or "-"])
+        finally:
+            self.tasks.unregister(me)
+        return rows
+
+    def cat_tasks_rows_api(self) -> list:
+        return self.local_cat_tasks_rows()
+
+    def _nodes_envelope(self, nodes: dict, failed: int = 0) -> dict:
+        return {"_nodes": {"total": len(nodes) + failed,
+                           "successful": len(nodes), "failed": failed},
+                "cluster_name": self.cluster_name, "nodes": nodes}
+
+    def nodes_info_api(self) -> dict:
+        return self._nodes_envelope({self.node_id: self.local_node_info()})
+
+    def nodes_stats_api(self) -> dict:
+        return self._nodes_envelope({self.node_id: self.local_node_stats()})
+
+    def hot_threads_api(self, interval_s: float = 0.05) -> str:
+        return self.local_hot_threads(interval_s)
+
+    def tasks_list_api(self, actions: Optional[str] = None) -> dict:
+        return {"nodes": {self.node_id: self.local_tasks_section(actions)}}
+
+    def task_get_api(self, task_id: str) -> dict:
+        t = self.tasks.get(task_id)
+        return {"completed": False, "task": t.to_dict(self.node_id)}
+
+    def task_cancel_api(self, task_id: str) -> dict:
+        t = self.tasks.cancel(task_id)
+        return {"nodes": {self.node_id: {
+            "tasks": {t.task_id: t.to_dict(self.node_id)}}}}
+
     def close(self):
         self.ml.close_all()
         self.plugins.remove_extensions()

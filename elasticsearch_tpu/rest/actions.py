@@ -1002,47 +1002,10 @@ def register_all(rc: RestController, node: Node) -> None:
         return 200, out
 
     def nodes_info(req):
-        natives = node.natives
-        return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},
-                     "cluster_name": node.cluster_name,
-                     "nodes": {node.node_id: {
-                         "name": node.node_name, "version": __version__,
-                         "roles": ["master", "data", "ingest"],
-                         "process": {
-                             "mlockall": bool(natives
-                                              and natives.memory_locked),
-                             "seccomp": bool(natives
-                                             and natives.seccomp_installed)},
-                         "plugins": node.plugins.info()}}}
+        return 200, node.nodes_info_api()
 
     def nodes_stats(req):
-        from elasticsearch_tpu.monitor.probes import (
-            fs_probe, os_probe, process_probe, runtime_probe,
-        )
-        return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},
-                     "cluster_name": node.cluster_name,
-                     "nodes": {node.node_id: {
-                         "name": node.node_name,
-                         "jvm": runtime_probe(),
-                         "os": os_probe(),
-                         "fs": fs_probe(node.indices.data_path),
-                         "process": process_probe(),
-                         "indices": {"docs": {"count": sum(
-                             s.doc_count() for s in node.indices.indices.values())},
-                                     "search": {"query_total":
-                                                node.counters.get("search", 0)},
-                                     "indexing": {"index_total":
-                                                  node.counters.get("index", 0)},
-                                     "request_cache": {
-                                         "hit_count": node.caches.request.hits,
-                                         "miss_count": node.caches.request.misses,
-                                         "evictions": node.caches.request.evictions},
-                                     "query_cache": {
-                                         "hit_count": node.caches.query.hits,
-                                         "miss_count": node.caches.query.misses,
-                                         "evictions": node.caches.query.evictions}},
-                         "breakers": node.breakers.stats(),
-                         "thread_pool": node.thread_pool.stats()}}}
+        return 200, node.nodes_stats_api()
 
     rc.register("GET", "/_cluster/health", cluster_health)
     rc.register("GET", "/_cluster/health/{index}", cluster_health)

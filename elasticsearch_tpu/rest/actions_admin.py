@@ -15,7 +15,6 @@ import time
 import uuid
 from elasticsearch_tpu.common.errors import IllegalArgumentError
 from elasticsearch_tpu.common.settings import parse_time_value
-from elasticsearch_tpu.monitor import hot_threads_report
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.rest.controller import RestController
 from elasticsearch_tpu.version import __version__
@@ -105,8 +104,7 @@ def register_admin(rc: RestController, node: Node) -> None:
     def hot_threads(req):
         interval = float(req.param("interval", "50ms").rstrip("ms")) / 1000 \
             if str(req.param("interval", "50ms")).endswith("ms") else 0.05
-        return 200, hot_threads_report(interval_s=min(interval, 0.5),
-                                       node_name=node.node_name)
+        return 200, node.hot_threads_api(interval)
 
     rc.register("GET", "/_nodes/hot_threads", hot_threads)
     rc.register("GET", "/_nodes/{node_id}/hot_threads", hot_threads)
@@ -377,28 +375,7 @@ def register_admin(rc: RestController, node: Node) -> None:
     def cat_thread_pool(req):
         pool_filter = (req.params.get("pools")
                        or req.param("thread_pool_patterns"))
-        import fnmatch as _fn
-        info = node.thread_pool.info()
-        rows = []
-        for name, s in sorted(node.thread_pool.stats().items()):
-            if pool_filter and not any(
-                    _fn.fnmatch(name, p.strip())
-                    for p in str(pool_filter).split(",")):
-                continue
-            meta = info.get(name, {})
-            ptype = meta.get("type", "fixed")
-            threads = meta.get("size", 0)
-            scaling = ptype == "scaling"
-            rows.append([node.node_name, node.node_id, node.node_id,
-                         __import__("os").getpid(), "127.0.0.1", "127.0.0.1",
-                         9300, name, ptype, s["active"],
-                         s.get("threads", 0), s["queue"],
-                         meta.get("queue_size", -1),
-                         s["rejected"], s.get("largest", 0),
-                         s.get("completed", 0),
-                         1 if scaling else "", threads if scaling else "",
-                         "" if scaling else threads,
-                         "5m" if scaling else ""])
+        rows = node.cat_threadpool_rows_api(pool_filter)
         return _render(req, _THREAD_POOL_COLS, rows)
 
     _PLUGINS_COLS = [
@@ -610,12 +587,7 @@ def register_admin(rc: RestController, node: Node) -> None:
     ]
 
     def cat_nodeattrs(req):
-        attrs = dict(getattr(node, "node_attrs", None)
-                     or {"testattr": "test"})
-        rows = [[node.node_name, node.node_id, __import__("os").getpid(),
-                 "127.0.0.1", "127.0.0.1", 9300, k, v]
-                for k, v in sorted(attrs.items())]
-        return _render(req, _NODEATTRS_COLS, rows)
+        return _render(req, _NODEATTRS_COLS, node.cat_nodeattrs_rows_api())
 
     _FIELDDATA_COLS = [
         Col("id", "", "node id"),
@@ -628,21 +600,8 @@ def register_admin(rc: RestController, node: Node) -> None:
 
     def cat_fielddata(req):
         field_filter = req.params.get("fields") or req.param("fields")
-        rows = []
-        seen = set()
-        for svc in node.indices.indices.values():
-            for path, mapper in svc.mapper_service.all_mappers():
-                if mapper.type_name != "text" \
-                        or not mapper.params.get("fielddata"):
-                    continue
-                if field_filter and not _fn_match(field_filter, path):
-                    continue
-                if path in seen:
-                    continue
-                seen.add(path)
-                size = max(svc.doc_count() * 32, 1)
-                rows.append([node.node_id, "127.0.0.1", "127.0.0.1",
-                             node.node_name, path, Bytes(size)])
+        rows = [r[:5] + [Bytes(r[5])]
+                for r in node.cat_fielddata_rows_api(field_filter)]
         return _render(req, _FIELDDATA_COLS, rows)
 
     _TASKS_COLS = [
@@ -661,21 +620,12 @@ def register_admin(rc: RestController, node: Node) -> None:
 
     def cat_tasks(req):
         detailed = req.param("detailed") in ("true", "", True)
-        me = node.tasks.register("cluster:monitor/tasks/lists", "cat tasks")
-        try:
-            rows = []
-            for t in node.tasks.list_tasks():
-                d = t.to_dict(node.node_id)
-                rows.append([
-                    d["action"], t.task_id, "-", d["type"],
-                    d["start_time_in_millis"],
-                    time.strftime("%H:%M:%S",
-                                  time.gmtime(d["start_time_in_millis"] / 1000)),
-                    d["running_time_in_nanos"],
-                    Millis(d["running_time_in_nanos"] / 1e6),
-                    "127.0.0.1", node.node_name, d["description"] or "-"])
-        finally:
-            node.tasks.unregister(me)
+        rows = []
+        for r in node.cat_tasks_rows_api():
+            action, task_id, parent, ttype, start_ms, run_ns, ip, name, desc = r
+            rows.append([action, task_id, parent, ttype, start_ms,
+                         time.strftime("%H:%M:%S", time.gmtime(start_ms / 1000)),
+                         run_ns, Millis(run_ns / 1e6), ip, name, desc])
         cols = _TASKS_COLS
         if detailed:
             cols = [Col(c.name, ",".join(c.aliases), c.desc, c.right,

@@ -91,19 +91,13 @@ def register_extra(rc: RestController, node: Node) -> None:
 
     # ------------------------------------------------------------------- tasks
     def list_tasks(req):
-        tasks = node.tasks.list_tasks(req.param("actions"))
-        return 200, {"nodes": {node.node_id: {
-            "name": node.node_name,
-            "tasks": {t.task_id: t.to_dict(node.node_id) for t in tasks}}}}
+        return 200, node.tasks_list_api(req.param("actions"))
 
     def get_task(req):
-        t = node.tasks.get(req.params["task_id"])
-        return 200, {"completed": False, "task": t.to_dict(node.node_id)}
+        return 200, node.task_get_api(req.params["task_id"])
 
     def cancel_task(req):
-        t = node.tasks.cancel(req.params["task_id"])
-        return 200, {"nodes": {node.node_id: {
-            "tasks": {t.task_id: t.to_dict(node.node_id)}}}}
+        return 200, node.task_cancel_api(req.params["task_id"])
 
     rc.register("GET", "/_tasks", list_tasks)
     rc.register("GET", "/_tasks/{task_id}", get_task)

@@ -587,3 +587,39 @@ def test_watcher_runs_as_persistent_task(cluster_procs):
     while time.monotonic() < deadline and count_fires(base_s) <= c3 + 1:
         time.sleep(1.0)
     assert count_fires(base_s) > c3 + 1, "watch did not survive owner death"
+
+
+def test_nodes_fanout_actions(cluster_procs):
+    """The generic routed-action layer (cluster/cluster_node.py
+    NODES_DISPATCH + fanout_nodes): `_nodes/stats`, `_nodes`, `_tasks` and
+    hot-threads asked of ANY node reflect EVERY node — round 3 answered
+    these with node-local state."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    assert len(live) >= 2, "not enough live nodes"
+    _wait_health(live[0], "green", nodes=len(live))
+
+    for port in (live[0], live[-1]):  # same answer regardless of target
+        base = f"http://127.0.0.1:{port}"
+        stats = _req("GET", f"{base}/_nodes/stats")
+        assert stats["_nodes"]["successful"] == len(live)
+        assert len(stats["nodes"]) == len(live)
+        names = {n["name"] for n in stats["nodes"].values()}
+        assert len(names) == len(live)  # distinct per-node sections
+        for section in stats["nodes"].values():
+            assert "jvm" in section and "thread_pool" in section
+
+        info = _req("GET", f"{base}/_nodes")
+        assert info["_nodes"]["successful"] == len(live)
+        assert all("version" in n for n in info["nodes"].values())
+
+        tasks = _req("GET", f"{base}/_tasks")
+        assert len(tasks["nodes"]) == len(live)
+
+    # hot threads: one ::: {node} section per node
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{live[0]}/_nodes/hot_threads",
+            timeout=30) as resp:
+        text = resp.read().decode()
+    assert text.count(":::") == len(live)
