@@ -160,6 +160,13 @@ class ClusterNode:
             seed_peers=seed_peers, on_committed=self.apply_cluster_state, rng=rng)
         self.coordinator.membership_listener = self._on_membership_change
         self._register_handlers()
+        # cluster-state-driven snapshot/restore lifecycle (SnapshotsService/
+        # SnapshotShardsService/RestoreService analogs); data-plane hooks
+        # are installed by the REST layer
+        from elasticsearch_tpu.cluster.snapshots import (
+            ClusterSnapshotLifecycle)
+        self.snapshot_lifecycle = ClusterSnapshotLifecycle(self)
+        self.shard_restore_hook: Optional[Callable] = None
 
     # ------------------------------------------------------------------ admin
     def start(self):
@@ -539,6 +546,25 @@ class ClusterNode:
                 mapper = self.mappers[index]
                 path = os.path.join(self.data_path, index, str(shard_id),
                                     entry.allocation_id.replace("/", "_").replace("#", "_"))
+                if entry.primary:
+                    # snapshot restore: materialize the shard's files from
+                    # the repository BEFORE the engine opens, so the new
+                    # primary boots from the snapshotted commit
+                    # (RestoreService: restore is a recovery source)
+                    from elasticsearch_tpu.cluster.snapshots import (
+                        RESTORE_IN_PROGRESS)
+                    restore = (state.metadata.get(RESTORE_IN_PROGRESS)
+                               or {}).get(index)
+                    if restore is not None and self.shard_restore_hook:
+                        try:
+                            self.shard_restore_hook(restore, index, shard_id,
+                                                    path)
+                        except Exception as e:
+                            self._send_to_master(
+                                MASTER_SHARD_FAILED,
+                                {"allocation_id": entry.allocation_id,
+                                 "reason": f"restore failed: {e}"})
+                            continue
                 engine = Engine(path, mapper, translog_sync="async")
                 local = LocalShard(entry, engine, mapper)
                 self.local_shards[key] = local
@@ -1781,6 +1807,23 @@ class ClusterNode:
         else:
             work()
 
+    def _transport_send(self, target: str, action: str, request: dict,
+                        on_response, on_failure,
+                        timeout_ms: Optional[int]) -> None:
+        """send() with timeout when the transport supports it (the
+        deterministic sim transport's send has no timeout kwarg)."""
+        if not hasattr(self, "_send_takes_timeout"):
+            import inspect
+            self._send_takes_timeout = "timeout_ms" in                 inspect.signature(self.transport.send).parameters
+        if self._send_takes_timeout:
+            self.transport.send(self.node_id, target, action, request,
+                                on_response=on_response,
+                                on_failure=on_failure, timeout_ms=timeout_ms)
+        else:
+            self.transport.send(self.node_id, target, action, request,
+                                on_response=on_response,
+                                on_failure=on_failure)
+
     def fanout_nodes(self, op: str, params: Optional[dict] = None,
                      on_done: Optional[Callable] = None,
                      timeout_ms: int = 10000) -> None:
@@ -1813,13 +1856,11 @@ class ClusterNode:
 
             return on_resp, on_fail
 
-        del timeout_ms  # transport default applies (the sim transport's
-        # send() has no timeout kwarg; callers bound waits via _call)
         for nid in targets:
             on_resp, on_fail = callbacks(nid)
-            self.transport.send(self.node_id, nid, NODES_DISPATCH,
-                                {"op": op, "params": params or {}},
-                                on_response=on_resp, on_failure=on_fail)
+            self._transport_send(nid, NODES_DISPATCH,
+                                 {"op": op, "params": params or {}},
+                                 on_resp, on_fail, timeout_ms)
 
     def dispatch_to_node(self, node_id: str, op: str,
                          params: Optional[dict] = None,
@@ -1827,8 +1868,6 @@ class ClusterNode:
                          on_failure: Optional[Callable] = None,
                          timeout_ms: int = 10000) -> None:
         """Run a named collector op on ONE node (task get/cancel routing)."""
-        del timeout_ms  # see fanout_nodes
-
         def on_resp(resp):
             if isinstance(resp, dict) and resp.get("error") is not None:
                 err = resp["error"]
@@ -1849,9 +1888,9 @@ class ClusterNode:
             if on_done:
                 on_done((resp or {}).get("result"))
 
-        self.transport.send(self.node_id, node_id, NODES_DISPATCH,
-                            {"op": op, "params": params or {}},
-                            on_response=on_resp, on_failure=on_failure)
+        self._transport_send(node_id, NODES_DISPATCH,
+                             {"op": op, "params": params or {}},
+                             on_resp, on_failure, timeout_ms)
 
     # client admin helpers ----------------------------------------------------
     def client_create_index(self, name: str, settings: Optional[dict] = None,

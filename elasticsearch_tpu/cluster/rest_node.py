@@ -71,6 +71,7 @@ class ClusterAwareNode(Node):
         self._wire_replicated_registries()
         self._wire_persistent_features()
         self._wire_node_dispatch()
+        self._wire_cluster_snapshots()
 
     def _wire_persistent_features(self) -> None:
         """Background features run as cluster-assigned persistent tasks
@@ -203,7 +204,13 @@ class ClusterAwareNode(Node):
 
     def tasks_list_api(self, actions=None) -> dict:
         out = self._fanout("tasks", {"actions": actions})
-        return {"nodes": out["results"]}
+        resp = {"nodes": out["results"]}
+        if out["failures"]:
+            resp["node_failures"] = [
+                {"type": f.get("type", "failed_node_exception"),
+                 "reason": f.get("reason", str(f)), "node_id": nid}
+                for nid, f in sorted(out["failures"].items())]
+        return resp
 
     def _task_owner(self, task_id: str) -> str:
         owner = str(task_id).rsplit(":", 1)[0]
@@ -223,6 +230,192 @@ class ClusterAwareNode(Node):
                            self._task_owner(task_id), "task_cancel",
                            {"task_id": task_id}, timeout=20.0)
         return {"nodes": nodes}
+
+    def _wire_cluster_snapshots(self) -> None:
+        """Route snapshot/restore through the cluster-state lifecycle
+        (cluster/snapshots.py): repositories replicate like the other
+        registries; create/restore become master state updates; this node
+        contributes the data-plane hooks (blob IO, shard access)."""
+        import os
+        import time as _time
+
+        from elasticsearch_tpu.cluster.snapshots import (
+            RESTORE_IN_PROGRESS, SNAPSHOTS_IN_PROGRESS)
+        from elasticsearch_tpu.common.errors import (
+            ResourceAlreadyExistsError, ResourceNotFoundError)
+
+        svc = self.snapshots
+        lifecycle = self.cluster.snapshot_lifecycle
+        orig_put_repo = svc.put_repository
+        orig_del_repo = svc.delete_repository
+        orig_get = svc.get_snapshots
+
+        # ---- data-plane hooks -------------------------------------------
+        lifecycle.repo_factory = svc.get_repository
+        # generic pool, NOT the snapshot pool: the REST create handler
+        # blocks a snapshot-pool thread polling for completion, and
+        # upload jobs queued behind it would deadlock the lifecycle
+        lifecycle.executor = functools.partial(
+            self.thread_pool.submit, "generic")
+
+        def shard_uploader(repo_name, index, shard_id):
+            repo = svc.get_repository(repo_name)
+            shard = self.cluster.local_shards.get((index, shard_id))
+            if shard is None:
+                raise ResourceNotFoundError(
+                    f"shard [{index}][{shard_id}] is not allocated here")
+            shard.engine.flush()
+            files = {}
+            commit = os.path.join(shard.engine.path, "commit.bin")
+            if os.path.exists(commit):
+                files["commit.bin"] = repo.put_blob(commit)
+            return files
+
+        lifecycle.shard_uploader = shard_uploader
+
+        def shard_restore_hook(restore, index, shard_id, path):
+            repo = svc.get_repository(restore["repo"])
+            entry = restore["shards"].get(str(shard_id)) or {}
+            for fname, digest in (entry.get("files") or {}).items():
+                repo.get_blob(digest, os.path.join(path, fname))
+
+        self.cluster.shard_restore_hook = shard_restore_hook
+
+        # ---- repositories replicate through cluster state ---------------
+        def put_repository(name, body, verify=True):
+            had = name in svc.repositories
+            orig_put_repo(name, body, verify=verify)  # validate locally first
+            try:
+                self._call(self.cluster.client_put_registry,
+                           "repositories", name, body)
+            except Exception:
+                # failed publish must not leave this node diverged: undo the
+                # local registration before surfacing the error
+                if not had:
+                    svc.repositories.pop(name, None)
+                raise
+            self._record_registry("repositories", name, body)
+
+        def delete_repository(name):
+            svc.get_repository(name)  # 404 before cluster traffic
+            self._call(self.cluster.client_put_registry,
+                       "repositories", name, None)
+            try:
+                orig_del_repo(name)
+            except Exception:
+                pass
+            self._record_registry("repositories", name, None)
+
+        svc.put_repository = put_repository
+        svc.delete_repository = delete_repository
+        self._registry_originals["repository"] =             lambda key, value: orig_put_repo(key, value, verify=False)
+        self._registry_originals["del_repository"] = orig_del_repo
+        self._registry_sections = getattr(self, "_registry_sections", ()) + (
+            ("repositories", self._registry_originals["repository"],
+             self._registry_originals["del_repository"]),)
+
+        # ---- snapshot create / get / restore through the lifecycle ------
+        def create_snapshot(repo_name, snapshot, body=None):
+            repo = svc.get_repository(repo_name)
+            if snapshot in repo.list_snapshots():
+                raise ResourceAlreadyExistsError(
+                    f"snapshot with the same name [{snapshot}] "
+                    "already exists")
+            body = body or {}
+            expr = body.get("indices", "_all")
+            if isinstance(expr, list):
+                expr = ",".join(expr)
+            self._call(lifecycle.client_create, repo_name, snapshot, expr)
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                try:
+                    m = repo.get_manifest(snapshot)
+                    return {"snapshot": {
+                        "snapshot": snapshot, "state": m["state"],
+                        "indices": sorted(m.get("indices", {})),
+                        "shards": m.get("shards", {})}}
+                except ResourceNotFoundError:
+                    _time.sleep(0.1)
+            raise ClusterCallError(
+                f"snapshot [{snapshot}] did not complete in time")
+
+        def get_snapshots(repo_name, expr="_all"):
+            out = orig_get(repo_name, expr)
+            from elasticsearch_tpu.common.patterns import (
+                matches_csv_patterns)
+            sips = self.cluster.cluster_state.metadata.get(
+                SNAPSHOTS_IN_PROGRESS) or {}
+            listed = {s["snapshot"] for s in out["snapshots"]}
+            for entry in sips.values():
+                name = entry["snapshot"]
+                if entry["repo"] != repo_name or name in listed:
+                    continue
+                if not matches_csv_patterns(name, expr):
+                    continue
+                out["snapshots"].append({
+                    "snapshot": name, "state": "IN_PROGRESS",
+                    "indices": sorted(entry.get("indices", {})),
+                    "start_time_in_millis": entry["start_ms"],
+                    "end_time_in_millis": None})
+            return out
+
+        def restore_snapshot(repo_name, snapshot, body=None):
+            import re as _re
+            repo = svc.get_repository(repo_name)
+            manifest = repo.get_manifest(snapshot)
+            body = body or {}
+            indices_expr = body.get("indices", "_all")
+            rename_pattern = body.get("rename_pattern")
+            rename_replacement = body.get("rename_replacement", "")
+            targets = {}
+            from elasticsearch_tpu.common.patterns import (
+                matches_csv_patterns)
+            for index_name, entry in manifest["indices"].items():
+                if not matches_csv_patterns(index_name, indices_expr):
+                    continue
+                target = index_name
+                if rename_pattern:
+                    target = _re.sub(rename_pattern, rename_replacement,
+                                     index_name)
+                # existence is validated by the MASTER against its current
+                # state — this node's applied state may lag a just-committed
+                # delete, and a stale local check would reject a valid
+                # restore
+                targets[target] = entry
+            if not targets:
+                raise ResourceNotFoundError(
+                    f"no indices in snapshot [{snapshot}] match the restore "
+                    f"expression [{indices_expr}]")
+            self._call(lifecycle.client_restore, repo_name, snapshot,
+                       targets)
+            # wait for every restored primary to come up (the shaped
+            # response reports shard counts, like the single-node path)
+            deadline = _time.monotonic() + 60
+            done = False
+            prim = []
+            while _time.monotonic() < deadline:
+                state = self.cluster.cluster_state
+                prim = [r for r in state.routing
+                        if r.index in targets and r.primary]
+                if prim and all(r.state == "STARTED" for r in prim) \
+                        and not (state.metadata.get(RESTORE_IN_PROGRESS)
+                                 or {}).keys() & targets.keys():
+                    done = True
+                    break
+                _time.sleep(0.1)
+            if not done:
+                started = sum(1 for r in prim if r.state == "STARTED")
+                raise ClusterCallError(
+                    f"restore of [{snapshot}] did not complete in time "
+                    f"({started}/{len(prim)} primaries started)")
+            return {"snapshot": {"snapshot": snapshot,
+                                 "indices": sorted(targets),
+                                 "shards": {"total": len(prim), "failed": 0,
+                                            "successful": len(prim)}}}
+
+        svc.create_snapshot = create_snapshot
+        svc.get_snapshots = get_snapshots
+        svc.restore_snapshot = restore_snapshot
 
     def _cat_fanout(self, op: str, params: Optional[dict] = None) -> list:
         out = self._fanout(op, params)

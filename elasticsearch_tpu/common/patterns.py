@@ -1,0 +1,16 @@
+"""Comma-separated wildcard matching (the `a*,b?,c` request-parameter idiom
+used by cat filters, snapshot expressions, and index selectors)."""
+
+from __future__ import annotations
+
+import fnmatch
+
+
+def matches_csv_patterns(name: str, patterns) -> bool:
+    """True when `name` matches any pattern. `patterns` may be None/empty
+    (match everything), a comma-separated string, or a list of patterns."""
+    if patterns in (None, "", "_all", "*"):
+        return True
+    if isinstance(patterns, str):
+        patterns = patterns.split(",")
+    return any(fnmatch.fnmatch(name, str(p).strip()) for p in patterns)

@@ -1837,13 +1837,8 @@ class Node:
 
     @staticmethod
     def _matches_csv_patterns(name: str, patterns_csv) -> bool:
-        """True when `name` matches any comma-separated wildcard pattern
-        (None/empty = match everything)."""
-        import fnmatch as _fn
-        if not patterns_csv:
-            return True
-        return any(_fn.fnmatch(name, p.strip())
-                   for p in str(patterns_csv).split(","))
+        from elasticsearch_tpu.common.patterns import matches_csv_patterns
+        return matches_csv_patterns(name, patterns_csv)
 
     def local_cat_threadpool_rows(self, pool_filter=None) -> list:
         import os as _os

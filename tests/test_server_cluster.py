@@ -623,3 +623,63 @@ def test_nodes_fanout_actions(cluster_procs):
             timeout=30) as resp:
         text = resp.read().decode()
     assert text.count(":::") == len(live)
+
+
+def test_cluster_state_driven_snapshots(cluster_procs, tmp_path):
+    """Snapshot lifecycle through cluster state (cluster/snapshots.py):
+    the master assigns per-shard upload tasks to the nodes HOLDING the
+    shards, so a snapshot captures ALL shards — round 3's node-local path
+    silently captured only the receiving node's. Restore re-enters
+    allocation with the repository as recovery source."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    assert len(live) >= 2, "not enough live nodes"
+    _wait_health(live[0], "green", nodes=len(live))
+    base = f"http://127.0.0.1:{live[0]}"
+    other = f"http://127.0.0.1:{live[-1]}"
+
+    repo_loc = str(tmp / "shared_repo")  # shared fs: all procs on this host
+    r = _req("PUT", f"{base}/_snapshot/csrepo",
+             {"type": "fs", "settings": {"location": repo_loc}})
+    assert r["acknowledged"]
+
+    r = _req("PUT", f"{base}/snapidx", {
+        "settings": {"index.number_of_shards": 2,
+                     "index.number_of_replicas": 0}})
+    assert r["acknowledged"]
+    for i in range(20):
+        _req("PUT", f"{base}/snapidx/_doc/{i}?refresh=true", {"n": i})
+
+    # the repo definition replicated: the OTHER node can snapshot
+    r = _req("PUT", f"{other}/_snapshot/csrepo/snap1",
+             {"indices": "snapidx"}, timeout=90)
+    snap = r["snapshot"]
+    assert snap["state"] == "SUCCESS", snap
+    assert snap["shards"]["total"] == 2, snap     # BOTH primaries captured
+    assert snap["shards"]["successful"] == 2, snap
+
+    got = _req("GET", f"{base}/_snapshot/csrepo/snap1")
+    assert got["snapshots"][0]["state"] == "SUCCESS"
+    assert got["snapshots"][0]["indices"] == ["snapidx"]
+
+    # wipe the index cluster-wide, restore from the snapshot on any node
+    _req("DELETE", f"{base}/snapidx")
+    r = _req("POST", f"{other}/_snapshot/csrepo/snap1/_restore",
+             {"indices": "snapidx"}, timeout=90)
+    assert r["snapshot"]["indices"] == ["snapidx"]
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            _req("POST", f"{base}/snapidx/_refresh")
+            c = _req("POST", f"{base}/snapidx/_count",
+                     {"query": {"match_all": {}}})
+            if c["count"] == 20:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert c["count"] == 20
+    # every doc is readable through the cluster read path
+    got = _req("GET", f"{other}/snapidx/_doc/7")
+    assert got["_source"]["n"] == 7
