@@ -50,6 +50,14 @@ class IllegalArgumentError(SearchEngineError):
     status = 400
 
 
+class SnapshotMissingError(SearchEngineError):
+    status = 404
+
+
+class ActionRequestValidationError(SearchEngineError):
+    status = 400
+
+
 class ParsingError(SearchEngineError):
     status = 400
 

@@ -154,6 +154,10 @@ class IndexService:
         # closed indices reject reads/writes but keep metadata visible
         # (MetaDataIndexStateService open/close)
         self.closed = False
+        # recovery provenance for the _recovery API (RecoverySource):
+        # EMPTY_STORE fresh, EXISTING_STORE reopened from disk, SNAPSHOT
+        # restored (set by snapshots/service.py with the source coords)
+        self.recovery_source = {"type": "EMPTY_STORE"}
         from elasticsearch_tpu.index.analysis import AnalysisRegistry
         registry = AnalysisRegistry.from_index_settings(
             settings.as_flat_dict())
@@ -213,6 +217,7 @@ class IndexService:
     def flush(self):
         for s in self.shards:
             s.engine.flush()
+        self.flush_count = getattr(self, "flush_count", 0) + 1
 
     def force_merge(self):
         for s in self.shards:
@@ -310,6 +315,7 @@ class IndicesService:
                            meta.get("mappings", {}), meta.get("uuid", name))
         svc.aliases = meta.get("aliases", {})
         svc.closed = meta.get("state") == "close"
+        svc.recovery_source = {"type": "EXISTING_STORE"}
         self.indices[name] = svc
         return svc
 

@@ -235,6 +235,8 @@ class Node:
         self.ingest = IngestService()
         self.scrolls = ScrollService()
         self.async_search = AsyncSearchService()
+        self.component_templates: Dict[str, dict] = {}
+        self.data_streams: Dict[str, dict] = {}
         self.tasks = TaskManager(self.node_id)
         self.templates = TemplateService()
         from elasticsearch_tpu.script.service import GLOBAL_SCRIPTS
@@ -1697,7 +1699,8 @@ class Node:
                            "total_time_in_millis": 0},
                 "refresh": {"total": 0, "external_total": 0,
                             "total_time_in_millis": 0},
-                "flush": {"total": 0, "periodic": 0,
+                "flush": {"total": getattr(svc, "flush_count", 0),
+                          "periodic": 0,
                           "total_time_in_millis": 0},
                 "warmer": {"current": 0, "total": 0,
                            "total_time_in_millis": 0},
@@ -1917,6 +1920,83 @@ class Node:
 
     def cat_tasks_rows_api(self) -> list:
         return self.local_cat_tasks_rows()
+
+    def termvectors_api(self, index: str, doc_id, spec: dict) -> dict:
+        """TermVectorsService analog: per-field term/position/offset stats.
+
+        Field statistics come from the READER (sum_doc_freq = Σ doc_freq of
+        the field's distinct indexed terms), not from the one document.
+        realtime=false reads only refreshed segments (found: false for docs
+        sitting in the unrefreshed buffer)."""
+        spec = spec or {}
+        svc = self.indices.get(index)
+        reader = svc.combined_reader()
+        realtime = spec.get("realtime", True)
+        if isinstance(realtime, str):
+            realtime = realtime not in ("false", "0")
+        source = None
+        if doc_id is not None:
+            if not realtime:
+                visible = any(reader.get_id(int(r)) == str(doc_id)
+                              for r in reader.live_global_rows())
+                if not visible:
+                    return {"_index": index, "_id": doc_id, "_version": 1,
+                            "found": False, "took": 0}
+            got = self.get_doc(index, str(doc_id))
+            if not got.get("found"):
+                return {"_index": index, "_id": doc_id, "found": False,
+                        "took": 0}
+            source = got["_source"]
+        else:
+            source = spec.get("doc") or {}
+        fields = spec.get("fields")
+        want_stats = spec.get("term_statistics") in (True, "true", "")
+        out_fields = {}
+        for fname, value in (source or {}).items():
+            if fields and fname not in fields:
+                continue
+            mapper = svc.mapper_service.get(fname)
+            if mapper is None or not hasattr(mapper, "analyze") \
+                    or getattr(mapper, "type_name", "") not in ("text",):
+                continue
+            tokens = mapper.analyze(str(value))
+            text_lower = str(value).lower()
+            terms: Dict[str, dict] = {}
+            cursor = 0
+            for pos, t in enumerate(tokens):
+                start = text_lower.find(str(t).lower(), cursor)
+                end = start + len(str(t)) if start >= 0 else -1
+                if start >= 0:
+                    cursor = end
+                entry = terms.setdefault(t, {"term_freq": 0, "tokens": []})
+                entry["term_freq"] += 1
+                tok = {"position": pos}
+                if start >= 0:
+                    tok["start_offset"] = start
+                    tok["end_offset"] = end
+                entry["tokens"].append(tok)
+            if want_stats:
+                for t, entry in terms.items():
+                    entry["doc_freq"] = reader.doc_freq(fname, t)
+                    ttf = 0
+                    for view in reader.views:
+                        p = view.segment.postings.get(fname, {}).get(t)
+                        if p is not None:
+                            ttf += int(p.freqs.sum())
+                    entry["ttf"] = ttf
+            # field statistics describe the INDEX, not this document
+            distinct = set()
+            for view in reader.views:
+                distinct.update(view.segment.postings.get(fname, {}).keys())
+            sum_doc_freq = sum(reader.doc_freq(fname, t) for t in distinct)
+            out_fields[fname] = {
+                "field_statistics": {
+                    "sum_doc_freq": sum_doc_freq,
+                    "doc_count": reader.docs_with_field_count(fname),
+                    "sum_ttf": reader.total_term_count(fname)},
+                "terms": terms}
+        return {"_index": index, "_id": doc_id, "_version": 1, "found": True,
+                "took": 0, "term_vectors": out_fields}
 
     def _nodes_envelope(self, nodes: dict, failed: int = 0) -> dict:
         return {"_nodes": {"total": len(nodes) + failed,

@@ -510,19 +510,36 @@ def field_caps(node, index_expr: Optional[str], fields: str,
     return {"indices": index_names, "fields": out}
 
 
-def validate_query(node, index_expr: Optional[str], body: dict) -> dict:
+def validate_query(node, index_expr: Optional[str], body: dict,
+                   explain: bool = False) -> dict:
     from elasticsearch_tpu.search.queries import parse_query
+    shards = {"total": 1, "successful": 1, "failed": 0}
     try:
-        q = parse_query((body or {}).get("query"))
-        explanation = str(q.to_dict())
-        return {"valid": True, "_shards": {"total": 1, "successful": 1, "failed": 0},
-                "explanations": [{"index": s.name, "valid": True,
-                                  "explanation": explanation}
-                                 for s in node.indices.resolve(index_expr)]}
+        body = body or {}
+        bad = [k for k in body if k not in ("query", "rewrite",
+                                            "all_shards", "explain")]
+        if bad:
+            raise ParsingError(f"request does not support [{bad[0]}]")
+        q = parse_query(body.get("query"))
+        explanation = "*:*" if (body.get("query") is None
+                                or "match_all" in (body.get("query") or {}))             else str(q.to_dict())
+        out = {"valid": True, "_shards": shards}
+        if explain:
+            out["explanations"] = [{"index": s.name, "valid": True,
+                                    "explanation": explanation}
+                                   for s in node.indices.resolve(index_expr)]
+        return out
     except (ParsingError, IllegalArgumentError) as e:
-        return {"valid": False,
-                "_shards": {"total": 1, "successful": 1, "failed": 0},
-                "error": str(e)}
+        out = {"valid": False, "_shards": shards}
+        if explain:
+            # rendered like the wrapped Java exception string the tests
+            # match; parse errors carry the nested-chain suffix the real
+            # toString has, "request does not support" stays bare
+            msg = f"org.elasticsearch.common.ParsingException: {e}"
+            if "request does not support" not in str(e):
+                msg += f"; nested: ParsingException[{e}];"
+            out["error"] = msg
+        return out
 
 
 def explain_doc(node, index: str, doc_id: str, body: dict) -> dict:

@@ -37,6 +37,8 @@ def register_all(rc: RestController, node: Node) -> None:
     register_xpack(rc, node)
     from elasticsearch_tpu.rest.actions_admin import register_admin
     register_admin(rc, node)
+    from elasticsearch_tpu.rest.actions_conf import register_conf
+    register_conf(rc, node)
     from elasticsearch_tpu.security.rest_filter import (
         make_security_filter, register_security,
     )
@@ -645,20 +647,43 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_settings/{name}", get_settings)
     rc.register("GET", "/{index}/_settings/{name}", get_settings)
 
+    def _shards_of(services) -> dict:
+        n = sum(len(svc.shards) for svc in services)
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
     def refresh(req):
-        for svc in node.indices.resolve(req.params.get("index")):
+        services = node.indices.resolve_open(req.params.get("index"))
+        for svc in services:
             svc.refresh()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, _shards_of(services)
 
     def flush(req):
-        for svc in node.indices.resolve(req.params.get("index")):
+        force = req.param("force") in ("true", "", True)
+        wait = req.param("wait_if_ongoing")
+        if force and wait in ("false", False):
+            from elasticsearch_tpu.common.errors import (
+                ActionRequestValidationError)
+            raise ActionRequestValidationError(
+                "Validation Failed: 1: wait_if_ongoing must be true for a "
+                "force flush;")
+        services = node.indices.resolve_open(req.params.get("index"))
+        for svc in services:
             svc.flush()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, _shards_of(services)
 
     def forcemerge(req):
-        for svc in node.indices.resolve(req.params.get("index")):
+        if req.param("only_expunge_deletes") in ("true", "", True) \
+                and req.param("max_num_segments") is not None:
+            from elasticsearch_tpu.common.errors import (
+                ActionRequestValidationError)
+            raise ActionRequestValidationError(
+                "Validation Failed: 1: cannot set only_expunge_deletes and "
+                "max_num_segments at the same time, those two parameters "
+                "are mutually exclusive;")
+        services = node.indices.resolve_open(req.params.get("index"))
+        for svc in services:
             svc.force_merge()
-        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+        return 200, _shards_of(services)
 
     rc.register("POST", "/_refresh", refresh)
     rc.register("POST", "/{index}/_refresh", refresh)

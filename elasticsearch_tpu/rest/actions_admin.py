@@ -169,44 +169,14 @@ def register_admin(rc: RestController, node: Node) -> None:
 
     # ----------------------------------------------------------- termvectors
     def termvectors(req):
-        index = req.params["index"]
-        doc_id = req.params.get("id")
         body = req.json() or {}
-        svc = node.indices.get(index)
-        source = None
-        if doc_id is not None:
-            got = node.get_doc(index, doc_id)
-            if not got.get("found"):
-                return 404, {"_index": index, "_id": doc_id, "found": False}
-            source = got["_source"]
-        else:
-            source = (body.get("doc") or {})
-        fields = body.get("fields")
-        reader = svc.combined_reader()
-        out_fields = {}
-        for fname, value in source.items():
-            if fields and fname not in fields:
-                continue
-            mapper = svc.mapper_service.get(fname)
-            if mapper is None or not hasattr(mapper, "analyze"):
-                continue
-            tokens = mapper.analyze(str(value))
-            terms: dict = {}
-            for pos, t in enumerate(tokens):
-                entry = terms.setdefault(t, {"term_freq": 0, "tokens": []})
-                entry["term_freq"] += 1
-                entry["tokens"].append({"position": pos})
-            if body.get("term_statistics"):
-                for t, entry in terms.items():
-                    entry["doc_freq"] = reader.doc_freq(fname, t)
-            out_fields[fname] = {
-                "field_statistics": {
-                    "sum_doc_freq": sum(e["term_freq"] for e in terms.values()),
-                    "doc_count": reader.num_docs,
-                    "sum_ttf": sum(e["term_freq"] for e in terms.values())},
-                "terms": terms}
-        return 200, {"_index": index, "_id": doc_id, "found": True,
-                     "took": 0, "term_vectors": out_fields}
+        if req.param("realtime") is not None:
+            body.setdefault("realtime", req.param("realtime"))
+        if req.param("term_statistics") is not None:
+            body.setdefault("term_statistics", req.param("term_statistics"))
+        out = node.termvectors_api(req.params["index"],
+                                   req.params.get("id"), body)
+        return 200, out
 
     rc.register("GET", "/{index}/_termvectors/{id}", termvectors)
     rc.register("POST", "/{index}/_termvectors/{id}", termvectors)
@@ -215,10 +185,25 @@ def register_admin(rc: RestController, node: Node) -> None:
 
     # ------------------------------------------- segments/recovery/stores
     def segments(req):
+        from elasticsearch_tpu.common.errors import IndexNotFoundError
+        expr = req.params.get("index")
+        ignore = req.param("ignore_unavailable") in ("true", "", True)
+        allow_no = req.param("allow_no_indices") not in ("false", False)
+        services = node.indices.resolve(expr)
+        if not services and not allow_no:
+            raise IndexNotFoundError(f"no such index [{expr or '_all'}]")
         out = {}
-        for svc in node.indices.resolve(req.params.get("index")):
+        n = 0
+        for svc in services:
+            if svc.closed:
+                if ignore:
+                    continue
+                raise IllegalArgumentError(
+                    f"Trying to query 1 indices with 0 maximum shards: "
+                    f"index [{svc.name}] is closed")
             shards = {}
             for shard in svc.shards:
+                n += 1
                 reader = shard.engine.acquire_searcher()
                 segs = []
                 if reader is not None:
@@ -230,26 +215,101 @@ def register_admin(rc: RestController, node: Node) -> None:
                                                 view.live_count),
                             "committed": True, "search": True,
                             "compound": False})
-                shards[str(shard.shard_id)] = [{"segments":
-                                                {s["segment"]: s for s in segs}}]
+                shards[str(shard.shard_id)] = [{
+                    "routing": {"state": "STARTED", "primary": True,
+                                "node": node.node_id},
+                    "num_committed_segments": len(segs),
+                    "num_search_segments": len(segs),
+                    "segments": {s["segment"]: s for s in segs}}]
             out[svc.name] = {"shards": shards}
-        return 200, {"indices": out}
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0},
+                     "indices": out}
 
     def recovery(req):
+        """RecoveryResponse: per-shard provenance + file/translog progress
+        (all recoveries here are DONE; type tracks IndexService
+        .recovery_source, EXISTING_STORE for closed/reopened indices)."""
+        import os as _os
+        import time as _time
+
+        detailed = req.param("detailed") in ("true", "", True)
+        me = {"id": node.node_id, "host": "127.0.0.1", "ip": "127.0.0.1",
+              "transport_address": "127.0.0.1:9300", "name": node.node_name}
         out = {}
         for svc in node.indices.resolve(req.params.get("index")):
-            out[svc.name] = {"shards": [{
-                "id": sh.shard_id, "type": "EMPTY_STORE", "stage": "DONE",
-                "primary": True,
-                "source": {}, "target": {"name": node.node_name},
-                "index": {"size": {"total_in_bytes": 0},
-                          "files": {"total": 0}},
-            } for sh in svc.shards]}
+            rsrc = getattr(svc, "recovery_source",
+                           {"type": "EMPTY_STORE"})
+            rtype = "EXISTING_STORE" if svc.closed else rsrc["type"]
+            started = svc.creation_date
+            iso = _time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
+                                 _time.gmtime(started / 1000))
+            shards_out = []
+            for sh in svc.shards:
+                files = []
+                size = 0
+                base = sh.engine.path
+                for root, dirs, fnames in _os.walk(base):
+                    if "translog" in dirs:
+                        dirs.remove("translog")
+                    for f in fnames:
+                        fp = _os.path.join(root, f)
+                        try:
+                            sz = _os.path.getsize(fp)
+                        except OSError:
+                            continue
+                        files.append({"name": _os.path.relpath(fp, base),
+                                      "length": sz, "recovered": sz})
+                        size += sz
+                from_snapshot = rtype == "SNAPSHOT"
+                recovered_files = len(files) if from_snapshot else 0
+                recovered_bytes = size if from_snapshot else 0
+                source = dict(me)
+                if from_snapshot:
+                    source = {"repository": rsrc.get("repository"),
+                              "snapshot": rsrc.get("snapshot"),
+                              "version": rsrc.get("version"),
+                              "index": rsrc.get("index")}
+                elif rtype == "EMPTY_STORE":
+                    source = {}
+                findex = {"total": len(files),
+                          "reused": len(files) - recovered_files,
+                          "recovered": recovered_files,
+                          "percent": "100.0%"}
+                if detailed:
+                    findex["details"] = files if from_snapshot else []
+                shards_out.append({
+                    "id": sh.shard_id, "type": rtype, "stage": "DONE",
+                    "primary": True,
+                    "start_time": iso, "start_time_in_millis": started,
+                    "stop_time": iso, "stop_time_in_millis": started,
+                    "total_time": "0ms", "total_time_in_millis": 0,
+                    "source": source, "target": dict(me),
+                    "index": {
+                        "files": findex,
+                        "size": {"total_in_bytes": size,
+                                 "reused_in_bytes": size - recovered_bytes,
+                                 "recovered_in_bytes": recovered_bytes,
+                                 "percent": "100.0%"},
+                        "source_throttle_time_in_millis": 0,
+                        "target_throttle_time_in_millis": 0,
+                        "total_time_in_millis": 0},
+                    "translog": {"recovered": 0, "total": 0,
+                                 "percent": "100.0%", "total_on_start": 0,
+                                 "total_time_in_millis": 0},
+                    "verify_index": {"check_index_time_in_millis": 0,
+                                     "total_time_in_millis": 0},
+                })
+            out[svc.name] = {"shards": shards_out}
         return 200, out
 
     def shard_stores(req):
+        from elasticsearch_tpu.common.errors import IndexNotFoundError
+        expr = req.params.get("index")
+        services = node.indices.resolve(expr)
+        if not services and req.param("allow_no_indices") in ("false", False):
+            raise IndexNotFoundError(f"no such index [{expr or '_all'}]")
         out = {}
-        for svc in node.indices.resolve(req.params.get("index")):
+        for svc in services:
             out[svc.name] = {"shards": {
                 str(sh.shard_id): {"stores": [{
                     "allocation_id": uuid.uuid4().hex[:20],

@@ -244,7 +244,12 @@ def register_extra(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_field_caps", do_field_caps)
 
     def do_validate(req):
-        return 200, validate_query(node, req.params.get("index"), req.json())
+        explain = str(req.param("explain", "false")) in ("true", "")
+        body = req.json()
+        if body is None and req.param("q") is not None:
+            body = {"query": {"query_string": {"query": req.param("q")}}}
+        return 200, validate_query(node, req.params.get("index"), body,
+                                   explain=explain)
 
     rc.register("GET", "/{index}/_validate/query", do_validate)
     rc.register("POST", "/{index}/_validate/query", do_validate)
@@ -299,8 +304,57 @@ def register_extra(rc: RestController, node: Node) -> None:
             req.params["repo"], req.params["snapshot"], req.json())
 
     def get_snapshot(req):
-        return 200, node.snapshots.get_snapshots(
-            req.params["repo"], req.params.get("snapshot", "_all"))
+        """GetSnapshotsAction, 8.0 response format: a `responses` array of
+        per-repository results; missing snapshots surface as an error entry
+        unless ignore_unavailable."""
+        repo_name = req.params["repo"]
+        expr = req.params.get("snapshot", "_all")
+        verbose = str(req.param("verbose", "true")) != "false"
+        ignore = str(req.param("ignore_unavailable", "false")) in ("true", "")
+        listing = node.snapshots.get_snapshots(repo_name, expr)
+        found = {s["snapshot"] for s in listing["snapshots"]}
+        missing = [p for p in str(expr).split(",")
+                   if p not in ("_all", "*") and "*" not in p
+                   and p not in found]
+        if missing and not ignore:
+            err = {"type": "snapshot_missing_exception",
+                   "reason": f"[{repo_name}:{missing[0]}] is missing"}
+            return 200, {"responses": [{"repository": repo_name,
+                                        "error": err}]}
+        repo = node.snapshots.get_repository(repo_name)
+        snaps = []
+        for s in listing["snapshots"]:
+            name = s["snapshot"]
+            try:
+                m = repo.get_manifest(name)
+            except Exception:
+                m = dict(s)
+            if not verbose:
+                snaps.append({"snapshot": name, "uuid": name,
+                              "state": s.get("state", "SUCCESS"),
+                              "indices": sorted(m.get("indices") or [])})
+                continue
+            entry = {"snapshot": name, "uuid": name,
+                     "version": m.get("version", "8.0.0"),
+                     "version_id": m.get("version_id", 8000099),
+                     "indices": sorted(m.get("indices") or []),
+                     "include_global_state": m.get("include_global_state",
+                                                   True),
+                     "state": s.get("state", "SUCCESS"),
+                     "start_time_in_millis": m.get("start_time_in_millis"),
+                     "end_time_in_millis": m.get("end_time_in_millis"),
+                     "duration_in_millis": max(
+                         (m.get("end_time_in_millis") or 0)
+                         - (m.get("start_time_in_millis") or 0), 0),
+                     "failures": [],
+                     "shards": m.get("shards", {"total": 0, "failed": 0,
+                                                "successful": 0})}
+            if m.get("metadata"):
+                entry["metadata"] = m["metadata"]
+            snaps.append(entry)
+        return 200, {"responses": [{"repository": repo_name,
+                                    "snapshots": snaps}],
+                     "snapshots": snaps}
 
     def delete_snapshot(req):
         node.snapshots.delete_snapshot(req.params["repo"], req.params["snapshot"])

@@ -1443,7 +1443,18 @@ def parse_query(body: Optional[dict]) -> Query:
     q = parse_extended(kind, spec)
     if q is not None:
         return q
-    raise ParsingError(f"unknown query [{kind}]")
+    import difflib
+    known = ("match", "match_all", "match_none", "match_phrase",
+             "match_phrase_prefix", "multi_match", "term", "terms", "range",
+             "bool", "exists", "prefix", "wildcard", "regexp", "fuzzy", "ids",
+             "query_string", "simple_query_string", "nested", "knn",
+             "constant_score", "function_score", "script_score", "dis_max",
+             "boosting", "more_like_this", "terms_set", "span_term",
+             "span_near", "intervals", "percolate", "rank_feature", "shape",
+             "geo_shape", "geo_distance", "geo_bounding_box")
+    hint = difflib.get_close_matches(str(kind), known, n=1)
+    suffix = f" did you mean [{hint[0]}]?" if hint else ""
+    raise ParsingError(f"unknown query [{kind}]{suffix}")
 
 
 def _single(spec: Any, kind: str) -> Tuple[str, Any]:

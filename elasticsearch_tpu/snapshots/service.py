@@ -161,10 +161,27 @@ class SnapshotService:
                 f"snapshot with the same name [{snapshot}] already exists")
         body = body or {}
         index_expr = body.get("indices", "_all")
-        services = self.node.indices.resolve(
-            index_expr if isinstance(index_expr, str) else ",".join(index_expr))
+        expr = index_expr if isinstance(index_expr, str) \
+            else ",".join(index_expr)
+        if body.get("ignore_unavailable"):
+            parts = []
+            for part in expr.split(","):
+                try:
+                    self.node.indices.resolve(part)
+                    parts.append(part)
+                except ResourceNotFoundError:
+                    continue
+            services = self.node.indices.resolve(",".join(parts)) \
+                if parts else []
+        else:
+            services = self.node.indices.resolve(expr)
+        from elasticsearch_tpu.version import __version__
         manifest = {"snapshot": snapshot, "state": "SUCCESS",
                     "start_time_in_millis": int(time.time() * 1000),
+                    "include_global_state": bool(
+                        body.get("include_global_state", True)),
+                    "metadata": body.get("metadata"),
+                    "version": __version__, "version_id": 8000099,
                     "indices": {}, "shards": {"total": 0, "failed": 0,
                                               "successful": 0}}
         for svc in services:
@@ -185,6 +202,8 @@ class SnapshotService:
         manifest["end_time_in_millis"] = int(time.time() * 1000)
         repo.put_manifest(snapshot, manifest)
         return {"snapshot": {"snapshot": snapshot, "state": "SUCCESS",
+                             "version": manifest["version"],
+                             "version_id": manifest["version_id"],
                              "indices": sorted(manifest["indices"]),
                              "shards": manifest["shards"]}}
 
@@ -230,9 +249,14 @@ class SnapshotService:
             if rename_pattern:
                 target = _re.sub(rename_pattern, rename_replacement, index_name)
             if self.node.indices.exists(target):
-                raise IllegalArgumentError(
-                    f"cannot restore index [{target}] because an open index with "
-                    f"same name already exists")
+                svc = self.node.indices.get(target)
+                if not svc.closed:
+                    raise IllegalArgumentError(
+                        f"cannot restore index [{target}] because an open "
+                        f"index with same name already exists")
+                # restoring over a CLOSED index replaces it
+                # (RestoreService#validateExistingIndex)
+                self.node.indices.delete_index(target)
             # materialize the data directory, then open the index from disk
             index_path = os.path.join(self.node.indices.data_path, target)
             num_shards = int(entry["settings"].get("index.number_of_shards", 1))
@@ -245,7 +269,11 @@ class SnapshotService:
             os.makedirs(index_path, exist_ok=True)
             with open(os.path.join(index_path, "index_meta.json"), "w") as f:
                 json.dump(meta, f)
-            self.node.indices.open_index(target)
+            svc_r = self.node.indices.open_index(target)
+            svc_r.recovery_source = {
+                "type": "SNAPSHOT", "repository": repo_name,
+                "snapshot": snapshot, "index": index_name,
+                "version": manifest.get("version", "8.0.0")}
             restored.append(target)
         return {"snapshot": {"snapshot": snapshot, "indices": restored,
                              "shards": {"total": len(restored), "failed": 0,
