@@ -58,6 +58,14 @@ class ActionRequestValidationError(SearchEngineError):
     status = 400
 
 
+class InvalidIndexNameError(SearchEngineError):
+    status = 400
+
+
+class IllegalStateError(SearchEngineError):
+    status = 500
+
+
 class ParsingError(SearchEngineError):
     status = 400
 

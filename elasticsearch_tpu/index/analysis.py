@@ -264,6 +264,8 @@ class AnalysisRegistry:
         self._analyzers[analyzer.name] = analyzer
 
     def get(self, name: str) -> Analyzer:
+        if name == "default" and "default" not in self._analyzers:
+            name = "standard"  # index.analysis.analyzer.default fallback
         a = self._analyzers.get(name)
         if a is None:
             raise IllegalArgumentError(f"failed to find analyzer [{name}]")

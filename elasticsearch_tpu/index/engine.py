@@ -59,10 +59,14 @@ class EngineResult(NamedTuple):
 
 class Engine:
     def __init__(self, path: str, mapper_service: MapperService,
-                 primary_term: int = 1, translog_sync: str = "request"):
+                 primary_term: int = 1, translog_sync: str = "request",
+                 index_sort=None):
         self.path = path
         self.mapper_service = mapper_service
         self.primary_term = primary_term
+        # (field, "asc"|"desc") — physical segment ordering at seal; must be
+        # set BEFORE recovery so translog-replayed segments sort too
+        self.index_sort = index_sort
         os.makedirs(path, exist_ok=True)
         self._lock = threading.RLock()
 
@@ -304,11 +308,40 @@ class Engine:
             return b._sources[row - b.base]
         return None
 
+    def _seal_builder(self):
+        """Seal the current buffer, applying the index sort when configured
+        (index.sort.field: docs reorder physically; row-keyed bookkeeping —
+        version map, tombstones — is remapped to the new locals)."""
+        builder = self._builder
+        sort = getattr(self, "index_sort", None)
+        order = None
+        if sort:
+            field, direction = sort
+            vals = builder._doc_values.get(field, {})
+            present = [l for l in range(builder.num_docs) if l in vals]
+            absent = [l for l in range(builder.num_docs) if l not in vals]
+            present.sort(key=lambda l: vals[l],
+                         reverse=(direction == "desc"))
+            # index.sort.missing defaults to _last for either direction
+            order = present + absent
+        seg = builder.seal(order=order)
+        if order is not None:
+            inv = {old: new for new, old in enumerate(order)}
+            base = builder.base
+            for doc_id, vv in list(self.version_map.items()):
+                if base <= vv.row < base + seg.num_docs:
+                    self.version_map[doc_id] = vv._replace(
+                        row=base + inv[vv.row - base])
+            dels = self.deleted_rows.get(builder.seg_id)
+            if dels:
+                self.deleted_rows[builder.seg_id] = {inv[l] for l in dels}
+        return seg
+
     def refresh(self) -> ShardReader:
         """Seal the indexing buffer; make recent ops searchable (NRT refresh)."""
         with self._lock:
             if self._builder is not None and self._builder.num_docs > 0:
-                self.segments.append(self._builder.seal())
+                self.segments.append(self._seal_builder())
                 self._builder = None
             views = [SegmentView(seg, self.deleted_rows.get(seg.seg_id))
                      for seg in self.segments]
@@ -434,7 +467,22 @@ class Engine:
                     new_local = builder.add(parsed, int(seg.seq_nos[local]))
                     new_map[doc_id] = vv._replace(row=builder.base + new_local)
             self._next_row = builder.base + builder.num_docs
-            self.segments = [builder.seal()] if builder.num_docs else []
+            if builder.num_docs:
+                saved = self._builder
+                self._builder = builder
+                try:
+                    merged = self._seal_builder()
+                finally:
+                    self._builder = saved
+                self.segments = [merged]
+                # the seal may have physically re-sorted: rows come from the
+                # sealed segment's id order, not the pre-sort builder locals
+                for local, doc_id in enumerate(merged.ids):
+                    if doc_id in new_map:
+                        new_map[doc_id] = new_map[doc_id]._replace(
+                            row=merged.base + local)
+            else:
+                self.segments = []
             self.deleted_rows = {}
             for doc_id, vv in self.version_map.items():
                 if vv.deleted:

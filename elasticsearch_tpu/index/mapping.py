@@ -1093,6 +1093,10 @@ FIELD_TYPES = {
 
 def build_mapper(name: str, definition: dict,
                  registry: Optional[AnalysisRegistry] = None) -> FieldMapper:
+    leaf = name.rpartition(".")[2]
+    if leaf == "":
+        # ObjectMapper.Builder rejects empty field names
+        raise IllegalArgumentError("field name cannot be an empty string")
     t = definition.get("type", "object" if "properties" in definition else None)
     if t is None:
         raise MapperParsingError(f"no type specified for field [{name}]")

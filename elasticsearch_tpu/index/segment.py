@@ -155,7 +155,29 @@ class SegmentBuilder:
 
         return local
 
-    def seal(self) -> Segment:
+    def seal(self, order: Optional[List[int]] = None) -> Segment:
+        """Seal the buffer into an immutable Segment. `order` (index sort,
+        IndexWriterConfig#setIndexSort analog): order[new_local] =
+        old_local — documents are physically reordered so `_doc` iteration
+        follows the index sort."""
+        if order is not None:
+            inv = {old: new for new, old in enumerate(order)}
+            self._ids = [self._ids[o] for o in order]
+            self._sources = [self._sources[o] for o in order]
+            self._seq_nos = [self._seq_nos[o] for o in order]
+            self._postings = {
+                f: {t: [(inv[l], fr, pos) for (l, fr, pos) in entries]
+                    for t, entries in terms.items()}
+                for f, terms in self._postings.items()}
+            self._field_lengths = {
+                f: {inv[l]: v for l, v in m.items()}
+                for f, m in self._field_lengths.items()}
+            self._doc_values = {
+                f: {inv[l]: v for l, v in m.items()}
+                for f, m in self._doc_values.items()}
+            self._vectors = {
+                f: {inv[l]: v for l, v in m.items()}
+                for f, m in self._vectors.items()}
         n = self.num_docs
         postings: Dict[str, Dict[str, Postings]] = {}
         for field, terms in self._postings.items():

@@ -109,10 +109,12 @@ class IndexShardHandle:
 
     def __init__(self, index_name: str, shard_id: int, path: str,
                  mapper_service: MapperService, translog_sync: str = "request",
-                 vector_dtype: str = "bf16"):
+                 vector_dtype: str = "bf16", index_sort=None):
         self.index_name = index_name
         self.shard_id = shard_id
-        self.engine = Engine(path, mapper_service, translog_sync=translog_sync)
+        self.engine = Engine(path, mapper_service,
+                             translog_sync=translog_sync,
+                             index_sort=index_sort)
         self.vector_store = VectorStoreShard(dtype=vector_dtype)
         self.mapper_service = mapper_service
         self._sync_vectors(self.engine.acquire_searcher())
@@ -164,6 +166,13 @@ class IndexService:
         self.analysis_registry = registry
         self.mapper_service = MapperService(mapping or {"properties": {}},
                                             registry=registry)
+        soft = settings.get("index.soft_deletes.enabled",
+                            settings.get("soft_deletes.enabled", True))
+        if str(soft).lower() == "false":
+            raise IllegalArgumentError(
+                "Creating indices with soft-deletes disabled is no longer "
+                "supported. The setting [index.soft_deletes.enabled] can "
+                "only be set to true.")
         self.num_shards = int(settings.get("index.number_of_shards", 1))
         self.num_replicas = int(settings.get("index.number_of_replicas", 1))
         if self.num_shards < 1 or self.num_shards > 1024:
@@ -173,11 +182,17 @@ class IndexService:
         sync = settings.get("index.translog.durability", "request")
         sync = "request" if sync == "request" else "async"
         vec_dtype = settings.get("index.knn.vector_dtype", "bf16")
+        sort_field = settings.get("index.sort.field")
+        index_sort = None
+        if sort_field:
+            index_sort = (str(sort_field),
+                          str(settings.get("index.sort.order", "asc")))
         self.shards: List[IndexShardHandle] = []
         for s in range(self.num_shards):
             self.shards.append(IndexShardHandle(
                 name, s, os.path.join(path, str(s)), self.mapper_service,
-                translog_sync=sync, vector_dtype=vec_dtype))
+                translog_sync=sync, vector_dtype=vec_dtype,
+                index_sort=index_sort))
         self.aliases: Dict[str, dict] = {}
 
     @property
@@ -484,7 +499,8 @@ class IndicesService:
     def validate_index_name(name: str) -> None:
         if not name or name in (".", "..") or name.startswith(("-", "_", "+")) \
                 or not _INDEX_NAME_RE.match(name) or len(name.encode()) > 255:
-            raise ValidationError(
+            from elasticsearch_tpu.common.errors import InvalidIndexNameError
+            raise InvalidIndexNameError(
                 f"Invalid index name [{name}]", index=name)
 
     def update_mapping(self, name: str, mapping: dict) -> None:

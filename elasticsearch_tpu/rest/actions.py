@@ -358,6 +358,10 @@ def register_all(rc: RestController, node: Node) -> None:
             if req.param("request_cache") is not None:
                 raise IllegalArgumentError(
                     "[request_cache] cannot be used in a scroll context")
+            if body.get("track_total_hits") is False:
+                raise IllegalArgumentError(
+                    "disabling [track_total_hits] is not allowed in a "
+                    "scroll context")
             check_scroll_keep_alive(node, scroll)
             resp = node.search_scroll_start(
                 req.params.get("index"), body, keep_alive=scroll,
@@ -435,6 +439,8 @@ def register_all(rc: RestController, node: Node) -> None:
                 continue
             if "*" not in part and part != "_all":
                 if part not in node.indices.indices:
+                    if ignore_unavailable:
+                        continue  # skips aliases and missing names alike
                     # aliases may not be delete targets (the reference
                     # rejects the expression outright)
                     if any(part in s.aliases
@@ -443,8 +449,6 @@ def register_all(rc: RestController, node: Node) -> None:
                             f"The provided expression [{part}] matches an "
                             f"alias, specify the corresponding concrete "
                             f"indices instead.")
-                    if ignore_unavailable:
-                        continue
                     raise IndexNotFoundError(part)
                 to_delete.append(part)
             else:
@@ -561,6 +565,10 @@ def register_all(rc: RestController, node: Node) -> None:
         # (MetaDataMappingService applies to all resolved concretes);
         # matching nothing is an error, not a silent ack
         body = req.json() or {}
+        if "_doc" in body and isinstance(body["_doc"], dict) \
+                and "properties" in body["_doc"]:
+            raise IllegalArgumentError(
+                "Types cannot be provided in put mapping requests")
         resolved = node.indices.resolve(req.params["index"])
         if not resolved:
             raise IndexNotFoundError(req.params["index"])
@@ -725,15 +733,16 @@ def register_all(rc: RestController, node: Node) -> None:
         return 200, {"acknowledged": True}
 
     def _split_alias_patterns(patterns):
-        """`-pat` is an exclusion only once a WILDCARD pattern has appeared
-        earlier in the list; before that it is a literal name
-        (IndexNameExpressionResolver: exclusions subtract from wildcard
-        expansions)."""
+        """`-pat` subtracts when a wildcard include appeared earlier OR the
+        exclusion itself is a wildcard pattern; otherwise `-name` is a
+        literal name (IndexNameExpressionResolver wildcard resolution)."""
         includes, excludes = [], []
         seen_wildcard = False
         for p in patterns:
-            if p.startswith("-") and seen_wildcard:
+            if p.startswith("-") and (seen_wildcard or "*" in p):
                 excludes.append(p[1:])
+                if "*" in p:  # a wildcard EXCLUSION also arms later `-name`s
+                    seen_wildcard = True
                 continue
             includes.append(p)
             if "*" in p or p == "_all":
@@ -766,12 +775,32 @@ def register_all(rc: RestController, node: Node) -> None:
         name = req.params.get("alias")
         patterns = [p.strip() for p in name.split(",")] if name else None
         out = {}
-        resolved = node.indices.resolve(req.params.get("index"))
+        tokens = {t.strip() for t in
+                  str(req.param("expand_wildcards") or "all").split(",") if t}
+        want_open = bool(tokens & {"open", "all"})
+        want_closed = bool(tokens & {"closed", "all"})
+        resolved = node.indices.resolve(req.params.get("index"),
+                                        expand_closed=want_closed)
+        resolved = [s for s in resolved
+                    if (want_open and not s.closed)
+                    or (want_closed and s.closed)]
+
+        def render(spec):
+            # alias "routing" renders split into index_/search_routing
+            # (AliasMetadata#toXContent)
+            spec = dict(spec or {})
+            routing = spec.pop("routing", None)
+            if routing is not None:
+                spec.setdefault("index_routing", routing)
+                spec.setdefault("search_routing", routing)
+            return spec
+
         for svc in resolved:
             if patterns is None:
-                out[svc.name] = {"aliases": dict(svc.aliases)}
+                out[svc.name] = {"aliases": {a: render(s)
+                                             for a, s in svc.aliases.items()}}
                 continue
-            matched = {a: spec for a, spec in svc.aliases.items()
+            matched = {a: render(spec) for a, spec in svc.aliases.items()
                        if _alias_matches(a, patterns)}
             if matched:
                 out[svc.name] = {"aliases": matched}
@@ -789,6 +818,18 @@ def register_all(rc: RestController, node: Node) -> None:
         return (200 if status == 200 else 404), None
 
     def put_alias(req):
+        alias = req.params.get("alias")
+        if alias:
+            bad = set('#\\/*?"<>| ,:')
+            if any(c in bad for c in alias) \
+                    or alias.startswith(("-", "_", "+")):
+                raise IllegalArgumentError(
+                    f"Invalid alias name [{alias}]: must be lowercase and "
+                    "must not contain spaces, commas, or special characters")
+            if alias in node.indices.indices:
+                raise IllegalArgumentError(
+                    f"Invalid alias name [{alias}]: an index or data stream "
+                    "exists with the same name as the alias")
         body = req.json() or {}
         spec = {k: v for k, v in body.items()
                 if k in ("filter", "routing", "index_routing",

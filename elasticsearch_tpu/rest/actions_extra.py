@@ -186,12 +186,36 @@ def register_extra(rc: RestController, node: Node) -> None:
                 out["settings"] = nested
             return out
 
+        def render_composable(t):
+            from elasticsearch_tpu.rest.actions_conf import (
+                normalize_template_settings)
+            t = dict(t)
+            if "template" not in t:
+                return t
+            tpl = dict(t.get("template") or {})
+            if "settings" in tpl:
+                tpl["settings"] = normalize_template_settings(tpl["settings"])
+            if "aliases" in tpl:
+                aliases = {}
+                for a, opts in (tpl["aliases"] or {}).items():
+                    opts = dict(opts or {})
+                    routing = opts.pop("routing", None)
+                    if routing is not None:
+                        opts.setdefault("index_routing", str(routing))
+                        opts.setdefault("search_routing", str(routing))
+                    aliases[a] = opts
+                tpl["aliases"] = aliases
+            t["template"] = tpl
+            return t
+
         if composable:
             if name:
                 return 200, {"index_templates": [
-                    {"name": name, "index_template": node.templates.get(name, True)}]}
+                    {"name": name,
+                     "index_template": render_composable(
+                         node.templates.get(name, True))}]}
             return 200, {"index_templates": [
-                {"name": n, "index_template": t}
+                {"name": n, "index_template": render_composable(t)}
                 for n, t in node.templates.index_templates.items()]}
         if name:
             import fnmatch as _fn

@@ -93,7 +93,10 @@ def register_xpack(rc: RestController, node: Node) -> None:
         # the path param slot is named by whichever route registered the
         # first {param} at this trie position — accept either
         alias = req.params.get("alias") or req.params.get("index")
-        return 200, rollover(node, alias, req.json() or {},
+        body = req.json() or {}
+        if req.params.get("new_index"):
+            body = {**body, "new_index": req.params["new_index"]}
+        return 200, rollover(node, alias, body,
                              dry_run=req.bool_param("dry_run"))
 
     def do_resize(kind):
@@ -104,6 +107,7 @@ def register_xpack(rc: RestController, node: Node) -> None:
         return handler
 
     rc.register("POST", "/{alias}/_rollover", do_rollover)
+    rc.register("POST", "/{alias}/_rollover/{new_index}", do_rollover)
     rc.register("POST", "/{index}/_shrink/{target}", do_resize("shrink"))
     rc.register("PUT", "/{index}/_shrink/{target}", do_resize("shrink"))
     rc.register("POST", "/{index}/_split/{target}", do_resize("split"))

@@ -44,18 +44,49 @@ def resize_index(node, source: str, target: str, kind: str,
     if node.indices.exists(target):
         raise IllegalArgumentError(f"index [{target}] already exists")
     settings = dict(body.get("settings", {}))
+    src_shards = int(svc.settings.get("index.number_of_shards", 1))
+    if "number_of_shards" in settings:  # un-prefixed form normalizes
+        settings.setdefault("index.number_of_shards",
+                            settings.pop("number_of_shards"))
     if kind == "shrink":
         settings.setdefault("index.number_of_shards", 1)
+        tgt = int(settings["index.number_of_shards"])
+        if src_shards % tgt != 0:
+            raise IllegalArgumentError(
+                f"the number of target shards [{tgt}] must be a factor of "
+                f"the number of source shards [{src_shards}]")
     elif kind == "split":
+        if "index.number_of_routing_shards" in settings \
+                or "number_of_routing_shards" in settings:
+            raise IllegalArgumentError(
+                "cannot provide index.number_of_routing_shards on resize")
         if "index.number_of_shards" not in settings:
             raise IllegalArgumentError("split requires index.number_of_shards")
+        tgt = int(settings["index.number_of_shards"])
+        from elasticsearch_tpu.common.errors import IllegalStateError
+        if tgt < src_shards or tgt % src_shards != 0:
+            raise IllegalStateError(
+                f"the number of source shards [{src_shards}] must be a "
+                f"factor of the number of target shards [{tgt}]")
+        routing = svc.settings.get("index.number_of_routing_shards")
+        if routing is not None and int(routing) % tgt != 0:
+            # targets must divide the fixed routing-shard count
+            # (IndexMetaData#getRoutingFactor)
+            raise IllegalStateError(
+                f"the number of routing shards [{routing}] must be a "
+                f"multiple of the target shards [{tgt}]")
     elif kind == "clone":
-        settings.setdefault("index.number_of_shards",
-                            svc.settings.get("index.number_of_shards", 1))
+        settings.setdefault("index.number_of_shards", src_shards)
+        if int(settings["index.number_of_shards"]) != src_shards:
+            raise IllegalArgumentError(
+                f"cannot clone from [{src_shards}] shards to "
+                f"[{settings['index.number_of_shards']}] shards: the number "
+                "of shards must stay the same")
     mappings = svc.mapper_service.to_dict()
     node.indices.create_index(target, settings=settings,
                               mappings=mappings,
                               aliases=body.get("aliases"))
+    svc.refresh()  # the resize source copies its CURRENT docs, buffered too
     reader = svc.combined_reader()
     copied = 0
     for view in reader.views:
@@ -123,16 +154,33 @@ def rollover(node, alias: str, body: Optional[dict] = None,
             nbytes >= parse_byte_size(conditions["max_size"], "max_size"))
     met = (not conditions) or any(results.values())
     new_index = body.get("new_index") or _next_rollover_name(old.name)
+    if body.get("new_index"):
+        from elasticsearch_tpu.indices.service import IndicesService
+        IndicesService.validate_index_name(str(new_index))
+    if node.indices.exists(new_index):
+        # checked even for dry runs (MetaDataCreateIndexService validation)
+        from elasticsearch_tpu.common.errors import (
+            ResourceAlreadyExistsError)
+        raise ResourceAlreadyExistsError(
+            f"index [{new_index}] already exists", index=new_index)
     out = {"acknowledged": False, "shards_acknowledged": False,
            "old_index": old.name, "new_index": new_index,
            "rolled_over": False, "dry_run": dry_run, "conditions": results}
     if dry_run or not met:
         return out
+    explicit_write = "is_write_index" in old.aliases[alias]
     node.indices.create_index(new_index,
                               settings=body.get("settings"),
                               mappings=body.get("mappings"),
-                              aliases={alias: {"is_write_index": True}})
-    old.aliases[alias] = {**old.aliases[alias], "is_write_index": False}
+                              aliases={alias: ({"is_write_index": True}
+                                               if explicit_write else {})})
+    if explicit_write:
+        # write-alias rollover keeps the alias on both, flipping the flag
+        old.aliases[alias] = {**old.aliases[alias], "is_write_index": False}
+    else:
+        # plain alias swings entirely to the new index
+        # (MetaDataRolloverService removes it from the old one)
+        old.aliases.pop(alias, None)
     out.update({"acknowledged": True, "shards_acknowledged": True,
                 "rolled_over": True})
     return out
