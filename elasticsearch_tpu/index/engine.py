@@ -318,20 +318,30 @@ class Engine:
         if sort:
             field, direction = sort
             vals = builder._doc_values.get(field, {})
+
+            def sort_key(local):
+                v = vals[local]
+                if isinstance(v, list):  # multi-valued: min (asc) / max
+                    v = (max(v) if direction == "desc" else min(v))                         if v else None
+                # type-ranked tuple: mixed numeric/str values must not
+                # TypeError the seal (the docs were already accepted)
+                return (v is None, isinstance(v, str), v if v is not None
+                        else 0)
+
             present = [l for l in range(builder.num_docs) if l in vals]
             absent = [l for l in range(builder.num_docs) if l not in vals]
-            present.sort(key=lambda l: vals[l],
-                         reverse=(direction == "desc"))
+            present.sort(key=sort_key, reverse=(direction == "desc"))
             # index.sort.missing defaults to _last for either direction
             order = present + absent
         seg = builder.seal(order=order)
         if order is not None:
-            inv = {old: new for new, old in enumerate(order)}
             base = builder.base
-            for doc_id, vv in list(self.version_map.items()):
-                if base <= vv.row < base + seg.num_docs:
-                    self.version_map[doc_id] = vv._replace(
-                        row=base + inv[vv.row - base])
+            # O(buffered docs): rows come from the sealed segment's id order
+            for local, doc_id in enumerate(seg.ids):
+                vv = self.version_map.get(doc_id)
+                if vv is not None and base <= vv.row < base + seg.num_docs:
+                    self.version_map[doc_id] = vv._replace(row=base + local)
+            inv = {old: new for new, old in enumerate(order)}
             dels = self.deleted_rows.get(builder.seg_id)
             if dels:
                 self.deleted_rows[builder.seg_id] = {inv[l] for l in dels}

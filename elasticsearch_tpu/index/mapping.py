@@ -1260,9 +1260,39 @@ class MapperService:
         """Parse a source document (reference: DocumentParser.parseDocument)."""
         if not isinstance(source, dict):
             raise MapperParsingError("document source must be an object")
+        limit = getattr(self, "nested_objects_limit", None)
+        if limit is not None:
+            n_nested = self._count_nested_docs(source, "")
+            if n_nested > limit:
+                raise IllegalArgumentError(
+                    f"The number of nested documents has exceeded the "
+                    f"allowed limit of [{limit}]. This limit can be set by "
+                    f"changing the [index.mapping.nested_objects.limit] "
+                    f"index level setting.")
         parsed = ParsedDocument(doc_id, source)
         self._parse_object(source, "", parsed)
         return parsed
+
+    def _count_nested_docs(self, obj: dict, prefix: str) -> int:
+        """Count the Lucene sub-documents nested arrays expand into
+        (DocumentParser nested-doc accounting)."""
+        total = 0
+        for k, v in obj.items():
+            path = prefix + k
+            mapper = self.get(path)
+            is_nested = mapper is not None and \
+                getattr(mapper, "type_name", "") == "nested"
+            if isinstance(v, list):
+                dict_items = [i for i in v if isinstance(i, dict)]
+                if is_nested:
+                    total += len(dict_items)
+                for item in dict_items:
+                    total += self._count_nested_docs(item, path + ".")
+            elif isinstance(v, dict):
+                if is_nested:
+                    total += 1
+                total += self._count_nested_docs(v, path + ".")
+        return total
 
     def _parse_object(self, obj: dict, prefix: str, parsed: ParsedDocument) -> None:
         for key, value in obj.items():

@@ -166,6 +166,11 @@ class IndexService:
         self.analysis_registry = registry
         self.mapper_service = MapperService(mapping or {"properties": {}},
                                             registry=registry)
+        nested_limit = settings.get("index.mapping.nested_objects.limit",
+                                    settings.get(
+                                        "mapping.nested_objects.limit"))
+        if nested_limit is not None:
+            self.mapper_service.nested_objects_limit = int(nested_limit)
         soft = settings.get("index.soft_deletes.enabled",
                             settings.get("soft_deletes.enabled", True))
         if str(soft).lower() == "false":
@@ -185,8 +190,15 @@ class IndexService:
         sort_field = settings.get("index.sort.field")
         index_sort = None
         if sort_field:
-            index_sort = (str(sort_field),
-                          str(settings.get("index.sort.order", "asc")))
+            if isinstance(sort_field, list):
+                # list syntax accepted; physical sorting uses the primary
+                # (first) sort field
+                sort_field = sort_field[0] if sort_field else None
+            order_s = settings.get("index.sort.order", "asc")
+            if isinstance(order_s, list):
+                order_s = order_s[0] if order_s else "asc"
+            if sort_field:
+                index_sort = (str(sort_field), str(order_s))
         self.shards: List[IndexShardHandle] = []
         for s in range(self.num_shards):
             self.shards.append(IndexShardHandle(

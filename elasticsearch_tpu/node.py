@@ -1871,7 +1871,7 @@ class Node:
 
     def local_cat_nodeattrs_rows(self) -> list:
         import os as _os
-        attrs = dict(getattr(self, "node_attrs", None) or {"testattr": "test"})
+        attrs = dict(getattr(self, "node_attrs", {}) or {})
         return [[self.node_name, self.node_id, _os.getpid(),
                  "127.0.0.1", "127.0.0.1", 9300, k, v]
                 for k, v in sorted(attrs.items())]

@@ -542,8 +542,14 @@ def validate_query(node, index_expr: Optional[str], body: dict,
         return out
 
 
-def explain_doc(node, index: str, doc_id: str, body: dict) -> dict:
+def explain_doc(node, index: str, doc_id: str, body: dict,
+                source_spec=None) -> dict:
     from elasticsearch_tpu.search.queries import SearchContext, parse_query
+    if not body or "query" not in body:
+        from elasticsearch_tpu.common.errors import (
+            ActionRequestValidationError)
+        raise ActionRequestValidationError(
+            "Validation Failed: 1: query is missing;")
     svc = node.indices.get(index)
     svc.refresh()
     reader = svc.combined_reader()
@@ -560,7 +566,14 @@ def explain_doc(node, index: str, doc_id: str, body: dict) -> dict:
                                 else "document not found", "details": []}}
     idx = list(ds.rows).index(target_rows[0])
     score = float(ds.scores[idx]) if ds.scores is not None else 1.0
-    return {"_index": svc.name, "_id": doc_id, "matched": True,
-            "explanation": {"value": score,
-                            "description": f"score from query {q.to_dict()}",
-                            "details": []}}
+    out = {"_index": svc.name, "_id": doc_id, "matched": True,
+           "explanation": {"value": score,
+                           "description": f"score from query {q.to_dict()}",
+                           "details": []}}
+    if source_spec is not None and source_spec is not False:
+        from elasticsearch_tpu.search.service import _filter_source
+        src_doc = reader.get_source(target_rows[0]) or {}
+        includes, excludes = source_spec
+        out["get"] = {"found": True,
+                      "_source": _filter_source(src_doc, includes, excludes)}
+    return out

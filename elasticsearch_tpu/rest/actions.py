@@ -28,6 +28,30 @@ def _cat_table(req, headers, rows) -> Tuple[int, Any]:
     return render(req, [Col(h) for h in headers], rows)
 
 
+def apply_uri_query(req, body):
+    """URI q= parameter -> query_string clause (RestSearchAction
+    parseSearchRequest; shared by search/count/explain)."""
+    q = req.param("q")
+    if not q:
+        return body
+    if "query" in body:
+        raise IllegalArgumentError(
+            "cannot specify both [q] parameter and a request body query")
+    qs = {"query": q}
+    if req.param("df"):
+        qs["default_field"] = req.param("df")
+    if req.param("default_operator"):
+        qs["default_operator"] = req.param("default_operator")
+    if req.param("lenient") is not None:
+        qs["lenient"] = req.bool_param("lenient", False)
+    if req.param("analyzer"):
+        qs["analyzer"] = req.param("analyzer")
+    if req.param("analyze_wildcard") is not None:
+        qs["analyze_wildcard"] = req.bool_param("analyze_wildcard", False)
+    body["query"] = {"query_string": qs}
+    return body
+
+
 def register_all(rc: RestController, node: Node) -> None:
     from elasticsearch_tpu.rest.actions_extra import register_extra
     register_extra(rc, node)
@@ -80,6 +104,12 @@ def register_all(rc: RestController, node: Node) -> None:
         return 201, resp
 
     def create_doc(req):
+        if req.param("version_type") in ("external", "external_gte"):
+            from elasticsearch_tpu.common.errors import (
+                ActionRequestValidationError)
+            raise ActionRequestValidationError(
+                "Validation Failed: 1: create operations only support "
+                "internal versioning. use index instead;")
         resp = node.index_doc(req.params["index"], req.params["id"],
                               req.json() or {}, op_type="create",
                               refresh=req.param("refresh"),
@@ -274,24 +304,7 @@ def register_all(rc: RestController, node: Node) -> None:
     def search(req):
         body = req.json() or {}
         # URI-search params (q=, size=, from=, sort=)
-        q = req.param("q")
-        if q:
-            if "query" in body:
-                raise IllegalArgumentError(
-                    "cannot specify both [q] parameter and a request body query")
-            qs = {"query": q}
-            if req.param("df"):
-                qs["default_field"] = req.param("df")
-            if req.param("default_operator"):
-                qs["default_operator"] = req.param("default_operator")
-            if req.param("lenient") is not None:
-                qs["lenient"] = req.bool_param("lenient", False)
-            if req.param("analyzer"):
-                qs["analyzer"] = req.param("analyzer")
-            if req.param("analyze_wildcard") is not None:
-                qs["analyze_wildcard"] = req.bool_param(
-                    "analyze_wildcard", False)
-            body["query"] = {"query_string": qs}
+        body = apply_uri_query(req, body)
         for p, key in (("size", "size"), ("from", "from")):
             v = req.int_param(p)
             if v is not None:
@@ -388,7 +401,8 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_search", search)
 
     def count(req):
-        return 200, node.count(req.params.get("index"), req.json())
+        body = apply_uri_query(req, req.json() or {})
+        return 200, node.count(req.params.get("index"), body)
 
     rc.register("GET", "/_count", count)
     rc.register("POST", "/_count", count)
@@ -440,9 +454,10 @@ def register_all(rc: RestController, node: Node) -> None:
             if "*" not in part and part != "_all":
                 if part not in node.indices.indices:
                     if ignore_unavailable:
-                        continue  # skips aliases and missing names alike
-                    # aliases may not be delete targets (the reference
-                    # rejects the expression outright)
+                        # lenient options skip alias and missing names alike
+                        # (indices.delete/10_basic "ignore unavailable")
+                        continue
+                    # aliases may not be delete targets
                     if any(part in s.aliases
                            for s in node.indices.indices.values()):
                         raise IllegalArgumentError(
@@ -622,12 +637,36 @@ def register_all(rc: RestController, node: Node) -> None:
             return [_settings_str(x) for x in v]
         return str(v)
 
+    _SETTINGS_DEFAULTS = {
+        "index.refresh_interval": "1s",
+        "index.max_result_window": "10000",
+        "index.max_inner_result_window": "100",
+        "index.max_rescore_window": "10000",
+        "index.flush_after_merge": "512mb",
+        "index.translog.durability": "request",
+        "index.translog.flush_threshold_size": "512mb",
+        "index.write.wait_for_active_shards": "1",
+        "index.highlight.max_analyzed_offset": "1000000",
+    }
+
+    def _nest(flat: dict) -> dict:
+        nested: dict = {}
+        for k, v in flat.items():
+            parts = k.split(".")
+            cur = nested
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[parts[-1]] = v
+        return nested
+
     def get_settings(req):
         import fnmatch as _fn
         name_filter = req.params.get("name")
         patterns = ([p.strip() for p in name_filter.split(",")]
                     if name_filter and name_filter not in ("_all", "*")
                     else None)
+        flat_mode = req.bool_param("flat_settings", False)
+        include_defaults = req.bool_param("include_defaults", False)
         out = {}
         for svc in node.indices.resolve(req.params.get("index")):
             flat = {"index.uuid": svc.uuid,
@@ -637,17 +676,16 @@ def register_all(rc: RestController, node: Node) -> None:
             if patterns is not None:
                 flat = {k: v for k, v in flat.items()
                         if any(_fn.fnmatch(k, p) for p in patterns)}
-            if req.bool_param("flat_settings", False):
-                section = {k: _settings_str(v) for k, v in flat.items()
-                           if v is not None}
-                out[svc.name] = {"settings": section}
-                continue
-            index_section: dict = {}
-            for k, v in flat.items():
-                if v is None:
-                    continue  # null = reset-to-default, never the string "None"
-                index_section[k.replace("index.", "", 1)] = _settings_str(v)
-            out[svc.name] = {"settings": {"index": index_section}}
+            flat = {k: _settings_str(v) for k, v in flat.items()
+                    if v is not None}
+            entry = {"settings": flat if flat_mode
+                     else {"index": _nest({k.replace("index.", "", 1): v
+                                           for k, v in flat.items()})}}
+            if include_defaults:
+                defaults = {k: v for k, v in _SETTINGS_DEFAULTS.items()
+                            if k not in flat}
+                entry["defaults"] = defaults if flat_mode else _nest(defaults)
+            out[svc.name] = entry
         return 200, out
 
     rc.register("GET", "/_settings", get_settings)
@@ -1021,15 +1059,40 @@ def register_all(rc: RestController, node: Node) -> None:
             if "_all" in metrics:
                 metrics = None  # _all anywhere in the list = everything
         index_filter = req.params.get("index")
-        svcs = (node.indices.resolve(index_filter) if index_filter
-                else list(node.indices.indices.values()))
+        tokens = {t.strip() for t in
+                  str(req.param("expand_wildcards") or "open,closed")
+                  .split(",") if t.strip()}
+        want_open = bool(tokens & {"open", "all"})
+        want_closed = bool(tokens & {"closed", "all"})
+        ignore_unavailable = req.bool_param("ignore_unavailable", False)
+        allow_no = req.bool_param("allow_no_indices", True)
+        if index_filter:
+            if ignore_unavailable:
+                svcs = []
+                for part in index_filter.split(","):
+                    try:
+                        svcs.extend(node.indices.resolve(
+                            part.strip(), expand_closed=True))
+                    except SearchEngineError:
+                        continue
+            else:
+                svcs = node.indices.resolve(index_filter,
+                                            expand_closed=True)
+            if not svcs and not allow_no:
+                raise IndexNotFoundError(index_filter)
+        else:
+            svcs = list(node.indices.indices.values())
+        svcs = [s for s in svcs
+                if (want_open and not s.closed)
+                or (want_closed and s.closed)]
         meta = {}
         routing = {}
         index_blocks = {}
         for svc in svcs:
             meta[svc.name] = {"settings": svc.settings.as_flat_dict(),
                               "mappings": svc.mapper_service.to_dict(),
-                              "aliases": list(svc.aliases)}
+                              "aliases": list(svc.aliases),
+                              "state": "close" if svc.closed else "open"}
             routing[svc.name] = {"shards": {
                 str(s.shard_id): [{"state": "STARTED", "primary": True,
                                    "node": node.node_id,

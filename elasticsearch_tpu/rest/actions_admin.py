@@ -25,7 +25,10 @@ def register_admin(rc: RestController, node: Node) -> None:
     def get_cluster_settings(req):
         out = dict(node.cluster_settings)
         if req.bool_param("include_defaults"):
-            out["defaults"] = {"cluster.name": node.cluster_name}
+            out["defaults"] = {
+                "cluster": {"name": node.cluster_name},
+                "node": {"attr": dict(getattr(node, "node_attrs", {}) or {})},
+            }
         return 200, out
 
     def put_cluster_settings(req):
@@ -53,28 +56,82 @@ def register_admin(rc: RestController, node: Node) -> None:
             if kind not in ("move", "cancel", "allocate_replica",
                             "allocate_stale_primary", "allocate_empty_primary"):
                 raise IllegalArgumentError(f"unknown reroute command [{kind}]")
-        return 200, {"acknowledged": True, "state": {
-            "cluster_uuid": node.node_id,
-            "nodes": {node.node_id: {"name": node.node_name}}}}
+        metrics = {m.strip() for m in
+                   str(req.param("metric") or "").split(",") if m.strip()}
+        state: dict = {"cluster_uuid": node.node_id}
+        if not metrics or "nodes" in metrics or "_all" in metrics:
+            state["nodes"] = {node.node_id: {"name": node.node_name}}
+        if metrics and ("metadata" in metrics or "_all" in metrics):
+            state["metadata"] = {
+                "cluster_uuid": node.node_id,
+                "indices": {svc.name: {"state": "close" if svc.closed
+                                       else "open"}
+                            for svc in node.indices.indices.values()}}
+        out = {"acknowledged": True, "state": state}
+        if req.bool_param("explain", False):
+            out["explanations"] = []
+        return 200, out
 
     def allocation_explain(req):
+        """ClusterAllocationExplainAction: explain one shard's allocation.
+        Explicit index/shard/primary explains that copy; an empty request
+        picks the first UNASSIGNED shard or errors when none exist."""
+        import time as _time
+
         body = req.json() or {}
-        index = body.get("index")
-        services = node.indices.resolve(index) if index else \
-            list(node.indices.indices.values())
-        if not services:
-            return 200, {"note": "no shards to explain"}
-        svc = services[0]
-        unassigned = svc.num_replicas > 0
-        out = {
-            "index": svc.name,
-            "shard": int(body.get("shard", 0)),
-            "primary": bool(body.get("primary", True)),
-            "current_state": "started",
-        }
-        if not out["primary"] and unassigned:
-            out.update({
+        explicit = "index" in body
+
+        def unassigned_entries():
+            for svc in node.indices.indices.values():
+                for sid in range(svc.num_shards):
+                    for _ in range(svc.num_replicas):
+                        yield svc, sid  # replicas can't assign single-node
+
+        if not explicit:
+            first = next(iter(unassigned_entries()), None)
+            if first is None:
+                raise IllegalArgumentError(
+                    "unable to find any unassigned shards to explain "
+                    "[ClusterAllocationExplainRequest] — specify the target "
+                    "shard in the request")
+            svc, sid = first
+            out = {
+                "index": svc.name, "shard": sid, "primary": False,
                 "current_state": "unassigned",
+                "unassigned_info": {
+                    "reason": "INDEX_CREATED",
+                    "at": _time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
+                                         _time.gmtime(svc.creation_date
+                                                      / 1000)),
+                    "last_allocation_status": "no_attempt"},
+                "can_allocate": "no",
+                "allocate_explanation":
+                    "cannot allocate because allocation is not permitted "
+                    "to any of the nodes",
+                "node_allocation_decisions": [{
+                    "node_id": node.node_id,
+                    "node_name": node.node_name, "node_decision": "no",
+                    "deciders": [{
+                        "decider": "same_shard", "decision": "NO",
+                        "explanation": "a copy of this shard is already "
+                                       "allocated to this node"}]}],
+            }
+            if req.bool_param("include_disk_info", False):
+                from elasticsearch_tpu.monitor.probes import fs_probe
+                out["cluster_info"] = {
+                    "nodes": {node.node_id: {
+                        "node_name": node.node_name,
+                        "least_available": fs_probe(node.indices.data_path),
+                    }}}
+            return 200, out
+
+        svc = node.indices.get(str(body["index"]))
+        primary = bool(body.get("primary", True))
+        if not primary and svc.num_replicas > 0:
+            # single-node: replica copies can never assign
+            return 200, {
+                "index": svc.name, "shard": int(body.get("shard", 0)),
+                "primary": False, "current_state": "unassigned",
                 "unassigned_info": {"reason": "REPLICA_ADDED",
                                     "last_allocation_status": "no_attempt"},
                 "can_allocate": "no",
@@ -82,18 +139,27 @@ def register_admin(rc: RestController, node: Node) -> None:
                     "cannot allocate because allocation is not permitted to "
                     "any of the nodes",
                 "node_allocation_decisions": [{
-                    "node_name": node.node_name, "node_decision": "no",
+                    "node_id": node.node_id, "node_name": node.node_name,
+                    "node_decision": "no",
                     "deciders": [{
-                        "decider": "same_shard",
-                        "decision": "NO",
-                        "explanation":
-                            "a copy of this shard is already allocated to "
-                            "this node"}]}],
-            })
-        else:
-            out.update({"can_remain_on_current_node": "yes",
-                        "current_node": {"name": node.node_name,
-                                         "id": node.node_id}})
+                        "decider": "same_shard", "decision": "NO",
+                        "explanation": "a copy of this shard is already "
+                                       "allocated to this node"}]}],
+            }
+        out = {
+            "index": svc.name,
+            "shard": int(body.get("shard", 0)),
+            "primary": primary,
+            "current_state": "started",
+            "current_node": {"name": node.node_name, "id": node.node_id,
+                             "transport_address": "127.0.0.1:9300"},
+            "can_remain_on_current_node": "yes",
+            "can_rebalance_cluster": "yes",
+            "can_rebalance_to_other_node": "no",
+            "rebalance_explanation":
+                "cannot rebalance as no target node exists that can both "
+                "allocate this shard and improve the cluster balance",
+        }
         return 200, out
 
     rc.register("POST", "/_cluster/reroute", reroute)

@@ -279,8 +279,21 @@ def register_extra(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_validate/query", do_validate)
 
     def do_explain(req):
+        from elasticsearch_tpu.rest.actions import apply_uri_query
+        body = apply_uri_query(req, req.json() or {})
+        src_param = req.param("_source")
+        inc = req.param("_source_includes") or req.param("_source_include")
+        exc = req.param("_source_excludes") or req.param("_source_exclude")
+        source_spec = None
+        if str(src_param) == "false":
+            source_spec = None  # explicit opt-out beats include/exclude
+        elif src_param is not None and str(src_param) != "true":
+            source_spec = (str(src_param).split(","), [])
+        elif str(src_param) == "true" or inc or exc:
+            source_spec = (str(inc).split(",") if inc else [],
+                           str(exc).split(",") if exc else [])
         return 200, explain_doc(node, req.params["index"], req.params["id"],
-                                req.json())
+                                body, source_spec=source_spec)
 
     rc.register("GET", "/{index}/_explain/{id}", do_explain)
     rc.register("POST", "/{index}/_explain/{id}", do_explain)

@@ -26,6 +26,8 @@ class ConformanceClient:
         from elasticsearch_tpu.rest.controller import RestController
         self.dir = tempfile.mkdtemp(dir=root)
         self.node = Node(self.dir)
+        # the reference's YAML test cluster boots with `node.attr.testattr`
+        self.node.node_attrs = {"testattr": "test"}
         self.rc = RestController()
         register_all(self.rc, self.node)
 
