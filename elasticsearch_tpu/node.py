@@ -824,7 +824,8 @@ class Node:
                         vector_store=store, query_cache=self.caches.query,
                         index_settings=svc.settings.as_flat_dict(),
                         max_buckets=self._max_buckets(),
-                        allow_expensive=self._allow_expensive())
+                        allow_expensive=self._allow_expensive(),
+                        index_name=svc.name)
                     for rank_pos, row in enumerate(result.rows):
                         row = int(row)
                         fused_rows[row] = fused_rows.get(row, 0.0) + 1.0 / (
@@ -1003,6 +1004,7 @@ class Node:
         relation = "eq"
         max_score = None
         merged_aggs = None
+        shard_failures: List[dict] = []
         try:
             for svc, reader, store in readers:
                 q_start = time.perf_counter_ns()
@@ -1035,7 +1037,8 @@ class Node:
                             query_cache=self.caches.query,
                             index_settings=svc.settings.as_flat_dict(),
                             max_buckets=self._max_buckets(),
-                            allow_expensive=self._allow_expensive()).result()
+                            allow_expensive=self._allow_expensive(),
+                            index_name=svc.name).result()
                     else:
                         result = execute_query_phase(
                             reader, svc.mapper_service, body,
@@ -1044,10 +1047,16 @@ class Node:
                             query_cache=self.caches.query,
                             index_settings=svc.settings.as_flat_dict(),
                             max_buckets=self._max_buckets(),
-                            allow_expensive=self._allow_expensive())
+                            allow_expensive=self._allow_expensive(),
+                            index_name=svc.name)
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
+                for f in getattr(result, "failures", None) or []:
+                    f = dict(f)
+                    f["index"] = svc.name
+                    f["node"] = self.node_id
+                    shard_failures.append(f)
                 total += result.total_hits
                 if result.total_relation == "gte":
                     relation = "gte"
@@ -1113,8 +1122,11 @@ class Node:
             "took": int((time.perf_counter() - start) * 1000),
             "timed_out": False,
             "_shards": {"total": sum(s.num_shards for s, _, _ in readers),
-                        "successful": sum(s.num_shards for s, _, _ in readers),
-                        "skipped": 0, "failed": 0},
+                        "successful": sum(s.num_shards for s, _, _ in readers)
+                        - len(shard_failures),
+                        "skipped": 0, "failed": len(shard_failures),
+                        **({"failures": shard_failures}
+                           if shard_failures else {})},
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max_score,
@@ -1213,7 +1225,8 @@ class Node:
             big.pop("from", None)
             result = execute_query_phase(
                 reader, svc.mapper_service, big, vector_store=store,
-                index_settings=svc.settings.as_flat_dict())
+                index_settings=svc.settings.as_flat_dict(),
+                index_name=svc.name)
             kept_rows = list(range(len(result.rows)))
             total += result.total_hits
             for i in kept_rows:
@@ -1276,9 +1289,11 @@ class Node:
         total = 0
         for svc in self.indices.resolve_open(index_expr):
             reader = svc.combined_reader()
-            result = execute_query_phase(reader, svc.mapper_service,
-                                         {**body, "track_total_hits": True},
-                                         vector_store=_MultiShardVectorStore(svc))
+            result = execute_query_phase(
+                reader, svc.mapper_service,
+                {**body, "track_total_hits": True},
+                vector_store=_MultiShardVectorStore(svc),
+                index_name=svc.name)
             total += result.total_hits
         return {"count": total, "_shards": {"total": 1, "successful": 1,
                                             "skipped": 0, "failed": 0}}
@@ -1397,13 +1412,16 @@ class Node:
                 return any(has_terms(i) for i in node)
             return False
 
-        q = (body or {}).get("query")
-        if not q or not has_terms(q):
+        scope = {k: (body or {}).get(k)
+                 for k in ("query", "aggs", "aggregations")
+                 if (body or {}).get(k) is not None}
+        if not scope or not has_terms(scope):
             return body
         import copy as _copy
         from elasticsearch_tpu.search.service import _get_path
         body = dict(body)
-        body["query"] = _copy.deepcopy(q)
+        for k in scope:
+            body[k] = _copy.deepcopy(body[k])
 
         def walk(node):
             if isinstance(node, dict):
@@ -1425,7 +1443,8 @@ class Node:
             elif isinstance(node, list):
                 for item in node:
                     walk(item)
-        walk(body["query"])
+        for k in scope:
+            walk(body[k])
         return body
 
     def _cluster_setting(self, key: str):
@@ -1640,12 +1659,19 @@ class Node:
             fd_fields: Dict[str, int] = {}
             comp_fields: Dict[str, int] = {}
             if keep & {"fielddata", "completion"}:
+                loaded = getattr(svc.mapper_service,
+                                 "loaded_fielddata", set())
                 for path, mapper in svc.mapper_service.all_mappers():
                     t = getattr(mapper, "type_name", None)
-                    if t == "text" and mapper.params.get("fielddata") \
-                            and "fielddata" in keep:
+                    fd_capable = (t == "keyword"
+                                  or (t == "text"
+                                      and mapper.params.get("fielddata")))
+                    if fd_capable and "fielddata" in keep:
+                        # fielddata/global-ordinals are built LAZILY: bytes
+                        # appear only once an aggregation actually loaded
+                        # the field (map execution hint never does)
                         fd_fields[path] = self._fielddata_bytes(
-                            shard_list, path)
+                            shard_list, path) if path in loaded else 0
                     elif t == "completion" and "completion" in keep:
                         comp_fields[path] = max(
                             self._fielddata_bytes(shard_list, path),

@@ -149,16 +149,7 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
         return compute_matrix_stats(ctx, rows, spec)
 
     if kind == "top_hits":
-        size = int(spec.get("size", 3))
-        hits = []
-        for row in rows[:size]:
-            hits.append({
-                "_id": ctx.reader.get_id(int(row)),
-                "_source": ctx.reader.get_source(int(row)),
-                "_score": None,
-            })
-        return {"hits": {"total": {"value": len(rows), "relation": "eq"},
-                         "hits": hits}}
+        return _top_hits(ctx, rows, spec)
 
     if kind == "value_count":
         if field is None:
@@ -207,7 +198,14 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
 
     if kind == "avg":
         v = vals[present]
-        return {"value": float(v.mean()) if len(v) else None}
+        out = {"value": float(v.mean()) if len(v) else None}
+        tname = getattr(ctx.mapper_service.get(field), "type_name", None) \
+            if field else None
+        if out["value"] is not None and tname in ("date", "date_nanos"):
+            ms = out["value"] / 1e6 if tname == "date_nanos" \
+                else out["value"]
+            out["value_as_string"] = _millis_to_iso(int(round(ms)))
+        return out
     if kind == "sum":
         return {"value": float(vals[present].sum())}
     if kind == "min":
@@ -253,14 +251,30 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
                 raise IllegalArgumentError(
                     "[numberOfSignificantValueDigits] must be between 0 and 5")
 
+        def _hdr_quantize(x: float) -> float:
+            """DoubleHistogram highestEquivalentValue: the reported value is
+            the top of x's equivalent bucket at the configured precision
+            (sub-bucket count 2^ceil(log2(10^digits)); base unit auto-ranged
+            from the smallest recorded magnitude)."""
+            if x <= 0 or len(v) == 0:
+                return float(x)
+            sub = 1 << math.ceil(math.log2(10 ** max(digits, 1)))
+            vmin = float(v[v > 0][0]) if (v > 0).any() else 1.0
+            unit = 2.0 ** math.floor(math.log2(vmin)) / sub
+            erange = max(2.0 ** math.floor(math.log2(x)) / sub, unit)
+            lowest = math.floor(x / erange) * erange
+            return lowest + erange - unit
+
         def one(p):
             if len(v) == 0:
                 return None
             if hdr is not None:
-                # HDRHistogram.getValueAtPercentile: the lowest recorded
-                # value at or above the rank (no interpolation)
-                rank = max(int(math.ceil(p / 100.0 * len(v))), 1)
-                return float(v[rank - 1])
+                # HDRHistogram.getValueAtPercentile: highest equivalent
+                # value of the bucket at the rank (round-half-up, no
+                # interpolation)
+                rank = max(int(math.floor(p / 100.0 * len(v) + 0.5)), 1)
+                rank = min(rank, len(v))
+                return _hdr_quantize(float(v[rank - 1]))
             return _es_percentile(v, float(p))
 
         if spec.get("keyed", True) is False:
@@ -874,6 +888,16 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         groups: Dict[Any, List[int]] = {}
         for idx, v in values:
             groups.setdefault(_hashable(v), []).append(idx)
+        mapper_t = ctx.mapper_service.get(field) if field else None
+        _tn = getattr(mapper_t, "type_name", None)
+        if (_tn == "keyword" or (_tn == "text"
+                                 and (mapper_t.params or {})
+                                 .get("fielddata"))) \
+                and spec.get("execution_hint") != "map":
+            # loading global ordinals materializes fielddata (the map hint
+            # iterates values without building it)
+            ctx.mapper_service.__dict__.setdefault(
+                "loaded_fielddata", set()).add(field)
         # include/exclude term filtering (IncludeExclude): exact-value lists,
         # a regex, or a {partition, num_partitions} hash partition
         inc, exc = spec.get("include"), spec.get("exclude")
@@ -1132,9 +1156,18 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 "Required [sources]: Composite [sources] cannot be null "
                 "or empty")
         size = int(spec.get("size", 10))
+        max_b = getattr(ctx, "max_buckets", None) or 65536
+        if size > max_b:
+            from elasticsearch_tpu.common.errors import TooManyBucketsError
+            raise TooManyBucketsError(
+                f"Trying to create too many buckets. Must be less than or "
+                f"equal to: [{max_b}] but was [{size}]. This limit can be "
+                f"set by changing the [search.max_buckets] cluster level "
+                f"setting.")
         after = spec.get("after")
         names = []
         formats = []
+        source_tzs: Dict[int, Any] = {}
         per_source_vals: List[Dict[int, list]] = []
         for src in sources:
             ((sname, sdef),) = src.items()
@@ -1171,9 +1204,12 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 ims, cal = _date_interval(sspec)
                 off = _date_offset_ms(sspec.get("offset"))
                 fmt = sspec.get("format")
+                tz = _resolve_tz(sspec.get("time_zone"))
+                if tz is not None:
+                    source_tzs[len(names) - 1] = tz
                 for idx in np.nonzero(present)[0]:
                     v = int(vals[idx])
-                    key = (_calendar_floor(v - off, cal) + off if cal
+                    key = (_calendar_floor(v - off, cal, tz) + off if cal
                            else float(np.floor((v - off) / ims) * ims + off))
                     col[int(idx)] = [key]
             elif stype == "geotile_grid":
@@ -1220,9 +1256,20 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 v = after.get(n)
                 if formats[p] and isinstance(v, str):
                     # a formatted after_key round-trips: parse it back into
-                    # the internal millis domain before comparing
+                    # the internal millis domain before comparing; bare
+                    # local datetimes read in the source's time_zone
                     try:
+                        raw = v
                         v = float(parse_date_millis(v))
+                        tz = source_tzs.get(p)
+                        has_offset = raw.endswith("Z") or bool(
+                            __import__("re").search(
+                                r"[+-]\d\d:?\d\d$", raw))
+                        if tz is not None and not has_offset:
+                            import datetime as _dt
+                            off = tz.utcoffset(_dt.datetime.now(
+                                _dt.timezone.utc))
+                            v -= off.total_seconds() * 1000.0
                     except Exception:
                         pass
                 after_vals.append(v)
@@ -1235,9 +1282,10 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
 
         def render(key):
             out_key = {}
-            for n, k, fmt in zip(names, key, formats):
+            for p, (n, k, fmt) in enumerate(zip(names, key, formats)):
                 if fmt and isinstance(k, (int, float)):
-                    out_key[n] = _format_date_key(int(k), fmt)
+                    out_key[n] = _format_date_key(int(k), fmt,
+                                                  tz=source_tzs.get(p))
                 elif isinstance(k, float) and k.is_integer():
                     out_key[n] = int(k)
                 else:
@@ -1279,10 +1327,16 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
         return {"buckets": buckets}
 
     if kind == "nested":
-        # nested docs are stored flattened; nested agg scopes to docs having the path
+        # nested docs are stored flattened; nested agg scopes to docs having
+        # the path, and descendants (top_hits) may expand per nested doc
         b = {"doc_count": int(len(rows))}
         if sub_aggs:
-            b.update(recurse(ctx, rows, sub_aggs))
+            prev = getattr(ctx, "nested_path", None)
+            ctx.nested_path = spec.get("path")
+            try:
+                b.update(recurse(ctx, rows, sub_aggs))
+            finally:
+                ctx.nested_path = prev
         return b
 
     raise ParsingError(f"unknown bucket aggregation [{kind}]")
@@ -1380,12 +1434,25 @@ def _compute_significant(ctx, rows, kind, spec, sub_aggs, recurse) -> dict:
         scored.append((score, t, fg, bg))
     scored.sort(key=lambda x: (-x[0], _sort_key(x[1])))
     tname = getattr(mapper, "type_name", None)
+    inc, exc = spec.get("include"), spec.get("exclude")
+    import re as _re
     buckets = []
-    for score, t, fg, bg in scored[:size]:
+    for score, t, fg, bg in scored:
+        if len(buckets) >= size:
+            break
         key = t
         if tname == "ip" and isinstance(t, (int, float)):
             from elasticsearch_tpu.index.mapping import IpFieldMapper
             key = IpFieldMapper.format_value(int(t))
+        ks = str(key)
+        if isinstance(inc, list) and ks not in {str(x) for x in inc}:
+            continue
+        if isinstance(exc, list) and ks in {str(x) for x in exc}:
+            continue
+        if isinstance(inc, str) and not _re.fullmatch(inc, ks):
+            continue
+        if isinstance(exc, str) and _re.fullmatch(exc, ks):
+            continue
         b = {"key": key, "doc_count": fg, "score": score, "bg_count": bg}
         if tname == "date" and isinstance(t, (int, float)):
             b["key_as_string"] = _millis_to_iso(int(t))
@@ -1698,8 +1765,106 @@ def _millis_to_iso(millis: int) -> str:
 # pipeline aggregations
 # ---------------------------------------------------------------------------
 
+def _top_hits(ctx, rows, spec) -> dict:
+    """top_hits metric (TopHitsAggregator): source hits per bucket with
+    optional sort (incl. nested sort paths) and seq_no/primary_term.
+    Directly under a `nested` agg the hits are the NESTED documents, each
+    carrying its parent _id and a _nested {field, offset} locator."""
+    size = int(spec.get("size", 3))
+    want_seq = bool(spec.get("seq_no_primary_term"))
+    index_name = getattr(ctx, "index_name", "index")
+    nested_ctx = getattr(ctx, "nested_path", None)
+
+    def parse_sort(ss):
+        if isinstance(ss, str):
+            return ss, "asc", None
+        if isinstance(ss, list) and ss:
+            return parse_sort(ss[0])
+        if isinstance(ss, dict) and ss:
+            ((f, o),) = list(ss.items())[:1]
+            if isinstance(o, dict):
+                return f, o.get("order", "asc"), \
+                    (o.get("nested") or {}).get("path")
+            return f, str(o), None
+        return None, "asc", None
+
+    sfield, sorder, sort_nested = parse_sort(spec.get("sort"))
+    if sfield and sfield.endswith(".keyword"):
+        sfield = sfield[: -len(".keyword")]
+
+    def walk(obj, path):
+        cur = obj
+        for p in path.split("."):
+            cur = cur.get(p) if isinstance(cur, dict) else None
+        return cur
+
+    def rank(v, reverse):
+        return (v is None, v)
+
+    reverse = sorder == "desc"
+    if nested_ctx and sfield and sfield.startswith(nested_ctx + "."):
+        rel = sfield[len(nested_ctx) + 1:]
+        entries = []
+        for row in rows:
+            src = ctx.reader.get_source(int(row)) or {}
+            items = walk(src, nested_ctx)
+            if isinstance(items, dict):
+                items = [items]
+            for off, item in enumerate(items or []):
+                if isinstance(item, dict):
+                    entries.append((walk(item, rel), int(row), off, item))
+        entries.sort(key=lambda e: rank(e[0], reverse), reverse=reverse)
+        hits = []
+        for val, row, off, item in entries[:size]:
+            hits.append({"_index": index_name,
+                         "_id": ctx.reader.get_id(row),
+                         "_nested": {"field": nested_ctx, "offset": off},
+                         "_source": item, "_score": None, "sort": [val]})
+        return {"hits": {"total": {"value": len(entries), "relation": "eq"},
+                         "max_score": None, "hits": hits}}
+
+    entries = []
+    for row in rows:
+        key = None
+        if sfield:
+            src = ctx.reader.get_source(int(row)) or {}
+            npath = sort_nested
+            if npath and sfield.startswith(npath + "."):
+                items = walk(src, npath)
+                if isinstance(items, dict):
+                    items = [items]
+                vals = [walk(it, sfield[len(npath) + 1:])
+                        for it in items or [] if isinstance(it, dict)]
+                vals = [v for v in vals if v is not None]
+                key = (max(vals) if reverse else min(vals)) if vals else None
+            else:
+                key = walk(src, sfield)
+                if isinstance(key, list):
+                    key = key[0] if key else None
+        entries.append((key, int(row)))
+    if sfield:
+        entries.sort(key=lambda e: rank(e[0], reverse), reverse=reverse)
+    hits = []
+    for key, row in entries[:size]:
+        h = {"_index": index_name, "_id": ctx.reader.get_id(row),
+             "_source": ctx.reader.get_source(row), "_score": None}
+        if sfield:
+            h["sort"] = [key]
+        if want_seq:
+            sq = ctx.reader.get_seq_no(row)
+            h["_seq_no"] = int(sq) if sq is not None else 0
+            h["_primary_term"] = 1
+        hits.append(h)
+    return {"hits": {"total": {"value": len(rows), "relation": "eq"},
+                     "max_score": None, "hits": hits}}
+
+
 def _resolve_buckets_path(sibling_outputs: dict, path: str):
-    """Resolve 'agg>metric' / 'agg.value' buckets_path over computed outputs."""
+    """Resolve 'agg>metric' / 'agg.value' buckets_path over computed outputs.
+
+    Sibling pipelines may only step INTO one multi-bucket aggregation; a
+    second multi-bucket agg mid-path (or as the terminal element) is the
+    reference's AggregationPath validation error."""
     agg_path, _, metric = path.partition(">")
     node = sibling_outputs.get(agg_path)
     if node is None:
@@ -1707,6 +1872,29 @@ def _resolve_buckets_path(sibling_outputs: dict, path: str):
     buckets = node.get("buckets")
     if buckets is None:
         raise ParsingError(f"buckets_path [{path}] target has no buckets")
+    head = metric.split(">", 1)[0].split(".")[0] if metric else ""
+    sample = next(iter(buckets.values() if isinstance(buckets, dict)
+                       else buckets), None)
+    if head and isinstance(sample, dict):
+        inner = sample.get(head)
+        if isinstance(inner, dict) and "buckets" in inner:
+            if ">" in metric:
+                # a multi-bucket agg mid-path: the reference renders the
+                # owning agg's Java bucket type in the message
+                raise IllegalArgumentError(
+                    f"buckets_path must reference either a number value or "
+                    f"a single value numeric metric aggregation, got: "
+                    f"[Object[]] at aggregation [{head}]")
+            raise IllegalArgumentError(
+                f"buckets_path must reference either a number value or a "
+                f"single value numeric metric aggregation, got: "
+                f"[LongTerms] at aggregation [{head}]")
+        if isinstance(inner, dict) and "values" in inner \
+                and "." not in metric:
+            raise IllegalArgumentError(
+                f"buckets_path must reference either a number value or a "
+                f"single value numeric metric aggregation, but [{head}] "
+                f"contains multiple values. Please specify which to use.")
     values = []
     for b in (buckets.values() if isinstance(buckets, dict) else buckets):
         if not metric or metric == "_count":

@@ -123,10 +123,10 @@ class ShardSearchResult:
     """Per-shard query-phase output (QuerySearchResult analog)."""
 
     __slots__ = ("shard_id", "rows", "scores", "sort_values", "total_hits",
-                 "total_relation", "aggregations", "max_score")
+                 "total_relation", "aggregations", "max_score", "failures")
 
     def __init__(self, shard_id, rows, scores, sort_values, total_hits,
-                 total_relation, aggregations, max_score):
+                 total_relation, aggregations, max_score, failures=None):
         self.shard_id = shard_id
         self.rows = rows
         self.scores = scores
@@ -135,6 +135,37 @@ class ShardSearchResult:
         self.total_relation = total_relation
         self.aggregations = aggregations
         self.max_score = max_score
+        self.failures = failures or []  # partial per-shard failures
+
+
+def _hdr_exclude_negatives(reader, mapper_service, body, ctx) -> None:
+    def hdr_fields(aggs):
+        for spec in (aggs or {}).values():
+            if not isinstance(spec, dict):
+                continue
+            p = spec.get("percentiles")
+            if isinstance(p, dict) and p.get("hdr") is not None \
+                    and p.get("field"):
+                yield p["field"]
+            yield from hdr_fields(spec.get("aggs")
+                                  or spec.get("aggregations"))
+
+    fields = list(hdr_fields(body.get("aggs") or body.get("aggregations")))
+    if not fields:
+        return
+    bad = set()
+    for field in fields:
+        for row in reader.live_global_rows():
+            v = reader.get_doc_value(field, int(row))
+            vv = v if isinstance(v, list) else [v]
+            if any(isinstance(x, (int, float)) and x < 0 for x in vv):
+                bad.add(int(row))
+    if bad:
+        ctx.excluded_rows = bad
+        ctx.shard_failures.append({
+            "shard": 0, "index": None, "node": None,
+            "reason": {"type": "array_index_out_of_bounds_exception",
+                       "reason": "out of covered value range"}})
 
 
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
@@ -144,12 +175,19 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         query_cache=None,
                         index_settings: Optional[dict] = None,
                         max_buckets: Optional[int] = None,
-                        allow_expensive: bool = True) -> ShardSearchResult:
+                        allow_expensive: bool = True,
+                        index_name: str = "index") -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
     ctx.index_settings = index_settings or {}
     ctx.max_buckets = max_buckets
     ctx.allow_expensive = allow_expensive
+    ctx.index_name = index_name
+    # HDR percentiles cannot record negative values: the reference's shard
+    # throws ArrayIndexOutOfBounds and the response turns partial. Emulate
+    # by failing the offending docs out of this shard's view.
+    ctx.shard_failures = []
+    _hdr_exclude_negatives(reader, mapper_service, body, ctx)
     _check_request_limits(body, ctx.index_settings)
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
@@ -174,6 +212,11 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
 
     result = query.execute(ctx).with_scores()
     rows, scores = result.rows, result.scores
+    excluded = getattr(ctx, "excluded_rows", None)
+    if excluded:
+        import numpy as _np
+        keep = ~_np.isin(rows, list(excluded))
+        rows, scores = rows[keep], scores[keep]
 
     # sliced scroll (reference: SliceBuilder -> TermsSliceQuery on _id:
     # floorMod(murmur3(id, seed 7919), max) == id selects this slice)
@@ -252,6 +295,15 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
 
     # sorting
     sort_spec = _normalize_sort(body.get("sort"))
+    if sort_spec:
+        for sfield, _o, _m in sort_spec:
+            m = mapper_service.get(sfield)
+            if getattr(m, "type_name", None) == "text" \
+                    and (m.params or {}).get("fielddata"):
+                # sorting on text fielddata materializes it (stats report
+                # bytes only for actually-loaded fields)
+                mapper_service.__dict__.setdefault(
+                    "loaded_fielddata", set()).add(sfield)
     search_after = body.get("search_after")
     frm_ = int(body.get("from", 0) or 0)
     size_ = int(body.get("size", DEFAULT_SIZE)
@@ -343,7 +395,8 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     else:
         max_score = float(scores.max()) if len(scores) and sort_spec is None else None
     return ShardSearchResult(shard_id, w_rows, w_scores, w_sort, total_hits,
-                             relation, aggs, max_score)
+                             relation, aggs, max_score,
+                             failures=getattr(ctx, "shard_failures", None))
 
 
 def _apply_rescore(ctx, rows, scores, rescore_spec):
