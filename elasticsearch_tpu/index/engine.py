@@ -138,9 +138,10 @@ class Engine:
                 # _routing metadata field: a doc value, so it survives
                 # refresh/commit and returns on GET (RoutingFieldMapper)
                 parsed.doc_values["_routing"] = routing
-            # _primary_term as a doc value so search hits can return it
-            # (seq_no_primary_term=true; seq_no itself lives in the segment)
+            # _primary_term/_version as doc values so search hits can
+            # return them (seq_no itself lives in the segment)
             parsed.doc_values["_primary_term"] = int(primary_term or 1)
+            parsed.doc_values["_version"] = int(new_version)
             builder = self._get_builder()
             local = builder.add(parsed, seq_no)
             row = builder.base + local

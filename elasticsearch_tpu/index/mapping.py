@@ -364,7 +364,20 @@ class DateNanosFieldMapper(DateFieldMapper):
         return [str(parse_date_nanos(value))]
 
     def doc_value(self, value):
-        return parse_date_nanos(value)
+        nanos = parse_date_nanos(value)
+        if nanos < 0:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}]",
+                caused_by={"reason": f"date[{value}] is before the epoch in "
+                           "1970 and cannot be stored in nanosecond "
+                           "resolution"})
+        if nanos > 9223372036854775807:  # int64 max = 2262-04-11
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}]",
+                caused_by={"reason": f"date[{value}] is after "
+                           "2262-04-11T23:47:16.854775807 and cannot be "
+                           "stored in nanosecond resolution"})
+        return nanos
 
 
 class IpFieldMapper(FieldMapper):
@@ -1128,6 +1141,9 @@ class MapperService:
         # fields with subfields (multi-fields), e.g. text with .keyword
         self._multi_fields: Dict[str, Dict[str, FieldMapper]] = {}
         self.registry = registry or DEFAULT_REGISTRY
+        # fields whose fielddata/global-ordinals were materialized by a
+        # search (stats report bytes only for loaded fields)
+        self.loaded_fielddata: set = set()
         self.dynamic = dynamic
         self._meta: dict = {}
         # set on any mapping mutation; cleared by whoever persists the mapping
@@ -1212,6 +1228,9 @@ class MapperService:
 
     def get_raw(self, path: str) -> Optional[FieldMapper]:
         return self._mappers.get(path)
+
+    def mark_fielddata_loaded(self, field: str) -> None:
+        self.loaded_fielddata.add(field)
 
     def resolve_field(self, path: str) -> str:
         """Follow an alias to its concrete field name (one hop)."""

@@ -1065,8 +1065,10 @@ class Node:
                     max_score = max(max_score or -1e30,
                                     result.max_score * factor)
                 f_start = time.perf_counter_ns()
-                hits = execute_fetch_phase(reader, svc.mapper_service, body,
-                                           result, index_name=svc.name)
+                hits = execute_fetch_phase(
+                    reader, svc.mapper_service, body, result,
+                    index_name=svc.name,
+                    index_settings=svc.settings.as_flat_dict())
                 f_nanos = time.perf_counter_ns() - f_start
                 for h, score, sv in zip(hits, result.scores,
                                         result.sort_values or [None] * len(hits)):

@@ -896,8 +896,7 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                 and spec.get("execution_hint") != "map":
             # loading global ordinals materializes fielddata (the map hint
             # iterates values without building it)
-            ctx.mapper_service.__dict__.setdefault(
-                "loaded_fielddata", set()).add(field)
+            ctx.mapper_service.mark_fielddata_loaded(field)
         # include/exclude term filtering (IncludeExclude): exact-value lists,
         # a regex, or a {partition, num_partitions} hash partition
         inc, exc = spec.get("include"), spec.get("exclude")
@@ -1267,8 +1266,10 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                                 r"[+-]\d\d:?\d\d$", raw))
                         if tz is not None and not has_offset:
                             import datetime as _dt
-                            off = tz.utcoffset(_dt.datetime.now(
-                                _dt.timezone.utc))
+                            # offset AT the parsed instant (DST-correct)
+                            at = _dt.datetime.fromtimestamp(
+                                v / 1000.0, _dt.timezone.utc)
+                            off = tz.utcoffset(at)
                             v -= off.total_seconds() * 1000.0
                     except Exception:
                         pass
@@ -1798,9 +1799,6 @@ def _top_hits(ctx, rows, spec) -> dict:
             cur = cur.get(p) if isinstance(cur, dict) else None
         return cur
 
-    def rank(v, reverse):
-        return (v is None, v)
-
     reverse = sorder == "desc"
     if nested_ctx and sfield and sfield.startswith(nested_ctx + "."):
         rel = sfield[len(nested_ctx) + 1:]
@@ -1813,7 +1811,11 @@ def _top_hits(ctx, rows, spec) -> dict:
             for off, item in enumerate(items or []):
                 if isinstance(item, dict):
                     entries.append((walk(item, rel), int(row), off, item))
-        entries.sort(key=lambda e: rank(e[0], reverse), reverse=reverse)
+        present_e = [e for e in entries if e[0] is not None]
+        absent_e = [e for e in entries if e[0] is None]
+        present_e.sort(key=lambda e: (isinstance(e[0], str), e[0]),
+                       reverse=reverse)
+        entries = present_e + absent_e
         hits = []
         for val, row, off, item in entries[:size]:
             hits.append({"_index": index_name,
@@ -1827,8 +1829,14 @@ def _top_hits(ctx, rows, spec) -> dict:
     for row in rows:
         key = None
         if sfield:
-            src = ctx.reader.get_source(int(row)) or {}
             npath = sort_nested
+            if not npath:
+                dv = ctx.reader.get_doc_value(sfield, int(row))
+                if dv is not None:
+                    key = dv[0] if isinstance(dv, list) and dv else dv
+                    entries.append((key, int(row)))
+                    continue
+            src = ctx.reader.get_source(int(row)) or {}
             if npath and sfield.startswith(npath + "."):
                 items = walk(src, npath)
                 if isinstance(items, dict):
@@ -1843,7 +1851,11 @@ def _top_hits(ctx, rows, spec) -> dict:
                     key = key[0] if key else None
         entries.append((key, int(row)))
     if sfield:
-        entries.sort(key=lambda e: rank(e[0], reverse), reverse=reverse)
+        present_e = [e for e in entries if e[0] is not None]
+        absent_e = [e for e in entries if e[0] is None]
+        present_e.sort(key=lambda e: (isinstance(e[0], str), e[0]),
+                       reverse=reverse)
+        entries = present_e + absent_e
     hits = []
     for key, row in entries[:size]:
         h = {"_index": index_name, "_id": ctx.reader.get_id(row),

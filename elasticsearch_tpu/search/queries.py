@@ -967,8 +967,24 @@ class MultiMatchQuery(Query):
                 return name, float(b)
             return f, 1.0
 
-        sets = []
+        # wildcard field patterns expand against the mapping
+        # (QueryParserHelper.resolveMappingFields)
+        import fnmatch as _fn
+        resolved: List[str] = []
         for f in self.fields:
+            name, _b = split_boost(f)
+            if "*" in name:
+                suffix = f[len(name):]
+                for path, m in ctx.mapper_service.all_mappers():
+                    if getattr(m, "type_name", None) in ("text", "keyword",
+                                                         "search_as_you_type") \
+                            and _fn.fnmatch(path, name):
+                        resolved.append(path + suffix)
+            else:
+                resolved.append(f)
+
+        sets = []
+        for f in resolved:
             name, fboost = split_boost(f)
             if self.mm_type == "bool_prefix":
                 # search_as_you_type target: all terms match, last as prefix

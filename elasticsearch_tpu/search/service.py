@@ -138,8 +138,9 @@ class ShardSearchResult:
         self.failures = failures or []  # partial per-shard failures
 
 
-def _hdr_exclude_negatives(reader, mapper_service, body, ctx) -> None:
-    def hdr_fields(aggs):
+def _hdr_percentile_fields(body) -> list:
+    """Fields any hdr-percentiles agg in the request records."""
+    def walk(aggs):
         for spec in (aggs or {}).values():
             if not isinstance(spec, dict):
                 continue
@@ -147,25 +148,32 @@ def _hdr_exclude_negatives(reader, mapper_service, body, ctx) -> None:
             if isinstance(p, dict) and p.get("hdr") is not None \
                     and p.get("field"):
                 yield p["field"]
-            yield from hdr_fields(spec.get("aggs")
-                                  or spec.get("aggregations"))
+            yield from walk(spec.get("aggs") or spec.get("aggregations"))
 
-    fields = list(hdr_fields(body.get("aggs") or body.get("aggregations")))
+    return list(walk(body.get("aggs") or body.get("aggregations")))
+
+
+def _hdr_exclude_negatives(reader, ctx, rows):
+    """HDR histograms cannot record negatives: the reference's shard throws
+    ArrayIndexOutOfBounds when the aggregator collects one. Checked against
+    the MATCHED rows only; offending docs fail out of this shard's view."""
+    fields = getattr(ctx, "hdr_fields", None)
     if not fields:
-        return
+        return None
     bad = set()
     for field in fields:
-        for row in reader.live_global_rows():
+        for row in rows:
             v = reader.get_doc_value(field, int(row))
             vv = v if isinstance(v, list) else [v]
             if any(isinstance(x, (int, float)) and x < 0 for x in vv):
                 bad.add(int(row))
-    if bad:
-        ctx.excluded_rows = bad
-        ctx.shard_failures.append({
-            "shard": 0, "index": None, "node": None,
-            "reason": {"type": "array_index_out_of_bounds_exception",
-                       "reason": "out of covered value range"}})
+    if not bad:
+        return None
+    ctx.shard_failures.append({
+        "shard": 0, "index": None, "node": None,
+        "reason": {"type": "array_index_out_of_bounds_exception",
+                   "reason": "out of covered value range"}})
+    return bad
 
 
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
@@ -187,7 +195,7 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     # throws ArrayIndexOutOfBounds and the response turns partial. Emulate
     # by failing the offending docs out of this shard's view.
     ctx.shard_failures = []
-    _hdr_exclude_negatives(reader, mapper_service, body, ctx)
+    ctx.hdr_fields = _hdr_percentile_fields(body)
     _check_request_limits(body, ctx.index_settings)
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
@@ -212,7 +220,7 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
 
     result = query.execute(ctx).with_scores()
     rows, scores = result.rows, result.scores
-    excluded = getattr(ctx, "excluded_rows", None)
+    excluded = _hdr_exclude_negatives(reader, ctx, rows)
     if excluded:
         import numpy as _np
         keep = ~_np.isin(rows, list(excluded))
@@ -302,8 +310,7 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                     and (m.params or {}).get("fielddata"):
                 # sorting on text fielddata materializes it (stats report
                 # bytes only for actually-loaded fields)
-                mapper_service.__dict__.setdefault(
-                    "loaded_fielddata", set()).add(sfield)
+                mapper_service.mark_fielddata_loaded(sfield)
     search_after = body.get("search_after")
     frm_ = int(body.get("from", 0) or 0)
     size_ = int(body.get("size", DEFAULT_SIZE)
@@ -498,8 +505,24 @@ def _sort_docs(ctx: SearchContext, rows, scores, sort_spec):
                 if isinstance(missing, (int, float)) and not isinstance(missing, bool):
                     fill = float(missing)
                 vals = np.where(present, nums, fill)
+                integral = ctx.mapper_service.get(field) is not None and \
+                    ctx.mapper_service.get(field).type_name in (
+                        "long", "integer", "short", "byte", "date",
+                        "date_nanos")
                 for i in range(len(rows)):
-                    sort_values[i].append(float(nums[i]) if present[i] else None)
+                    if not present[i]:
+                        sort_values[i].append(None)
+                    elif integral:
+                        # int64-domain sort values keep full precision:
+                        # nanosecond timestamps don't survive float64
+                        raw = ctx.reader.get_doc_value(field, int(rows[i]))
+                        if isinstance(raw, list):
+                            raw = raw[0] if raw else None
+                        sort_values[i].append(
+                            int(raw) if isinstance(raw, (int, float))
+                            else float(nums[i]))
+                    else:
+                        sort_values[i].append(float(nums[i]))
             else:
                 # string sort via object dtype
                 svals = []
@@ -589,7 +612,8 @@ def _filter_source(source: dict, includes, excludes) -> dict:
 def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
                         body: dict, result: ShardSearchResult,
                         index_name: str = "index",
-                        from_offset: int = 0) -> List[dict]:
+                        from_offset: int = 0,
+                        index_settings: Optional[dict] = None) -> List[dict]:
     """Materialize hits for the (already coordinator-trimmed) doc window."""
     ctx = SearchContext(reader, mapper_service)
     source_spec = body.get("_source", True)
@@ -632,6 +656,7 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
             if "_source" not in stored_list:
                 want_source = False
 
+    nested_ih_specs = _nested_inner_hits_specs(body.get("query"))
     hits = []
     for i in range(from_offset, len(result.rows)):
         row = int(result.rows[i])
@@ -679,11 +704,19 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
             for f in docvalue_fields:
                 fname = f["field"] if isinstance(f, dict) else f
                 fmt = f.get("format") if isinstance(f, dict) else None
-                v = reader.get_doc_value(fname, row)
+                if fname == "_seq_no":
+                    v = reader.get_seq_no(row)
+                elif fname == "_primary_term":
+                    v = 1
+                else:
+                    v = reader.get_doc_value(fname, row)
                 if v is not None:
                     vals = v if isinstance(v, list) else [v]
-                    fields[fname] = [_format_doc_value(
-                        x, mapper_service.get(fname), fmt) for x in vals]
+                    # the same field may repeat with different formats:
+                    # values append in request order (FieldAndFormat list)
+                    fields.setdefault(fname, []).extend(
+                        _format_doc_value(x, mapper_service.get(fname), fmt)
+                        for x in vals)
             if fields:
                 hit.setdefault("fields", {}).update(fields)
         if script_fields:
@@ -694,13 +727,17 @@ def execute_fetch_phase(reader: ShardReader, mapper_service: MapperService,
                 val = s.evaluate(ctx, np.asarray([row]), np.zeros(1, dtype=np.float32))
                 sf[name] = [float(val[0])]
         if highlight_spec:
-            hl = _highlight(ctx, mapper_service, body, highlight_spec, row)
+            hl = _highlight(ctx, mapper_service, body, highlight_spec, row,
+                            index_settings=index_settings)
             if hl:
                 hit["highlight"] = hl
         collapse_spec = body.get("collapse")
         if collapse_spec:
             _decorate_collapsed_hit(ctx, reader, mapper_service, body,
                                     collapse_spec, row, hit, index_name)
+        for path, ih_spec, ih_query in nested_ih_specs:
+            _decorate_nested_inner_hits(reader, row, hit, path, ih_spec,
+                                        ih_query, index_name)
         if explain:
             hit["_explanation"] = {"value": hit["_score"] or 0.0,
                                    "description": "vectorized score", "details": []}
@@ -714,7 +751,15 @@ def _decorate_collapsed_hit(ctx, reader, mapper_service, body, collapse_spec,
     the group's own ranked window under `inner_hits`
     (ExpandSearchPhase.java:42 runs one sub-search per collapsed hit)."""
     cfield = collapse_spec["field"]
-    v = reader.get_doc_value(cfield, row)
+    # field aliases resolve to their concrete path per index
+    # (FieldAliasMapper: collapse on an alias collapses the target)
+    from elasticsearch_tpu.index.mapping import AliasFieldMapper
+    read_field = cfield
+    raw_mapper = mapper_service.get_raw(cfield) \
+        if hasattr(mapper_service, "get_raw") else mapper_service.get(cfield)
+    if isinstance(raw_mapper, AliasFieldMapper):
+        read_field = (raw_mapper.params or {}).get("path", cfield)
+    v = reader.get_doc_value(read_field, row)
     if isinstance(v, list):
         v = v[0] if v else None
     hit.setdefault("fields", {})[cfield] = [v]
@@ -724,17 +769,38 @@ def _decorate_collapsed_hit(ctx, reader, mapper_service, body, collapse_spec,
     specs = inner if isinstance(inner, list) else [inner]
     for spec in specs:
         name = spec.get("name", cfield)
+        sub_collapse = spec.get("collapse")
+        want = int(spec.get("size", 3))
         sub_body = {"query": {"bool": {
             "must": [body["query"]] if body.get("query") else [],
-            "filter": [{"term": {cfield: v}}]}},
-            "size": int(spec.get("size", 3)),
+            "filter": [{"term": {read_field: v}}]}},
+            "size": want * 10 if sub_collapse else want,
             "from": int(spec.get("from", 0))}
-        if spec.get("sort") is not None:
-            sub_body["sort"] = spec["sort"]
+        for key in ("sort", "version", "seq_no_primary_term",
+                    "docvalue_fields", "_source"):
+            if spec.get(key) is not None:
+                sub_body[key] = spec[key]
         sub_result = execute_query_phase(reader, mapper_service, sub_body)
         sub_hits = execute_fetch_phase(reader, mapper_service, sub_body,
                                        sub_result, index_name=index_name,
                                        from_offset=int(spec.get("from", 0)))
+        if sub_collapse:
+            # a second-level collapse inside inner_hits dedups the window
+            # by the inner group value (ExpandSearchPhase nested collapse);
+            # fetch skipped `from` rows, so pair hits with the same slice
+            seen = set()
+            deduped = []
+            for h, r2 in zip(sub_hits,
+                             sub_result.rows[int(spec.get("from", 0)):]):
+                gv = reader.get_doc_value(sub_collapse["field"], int(r2))
+                if isinstance(gv, list):
+                    gv = gv[0] if gv else None
+                h.setdefault("fields", {})[sub_collapse["field"]] = [gv]
+                if gv in seen:
+                    continue
+                seen.add(gv)
+                deduped.append(h)
+            sub_hits = deduped[:want]
         hit.setdefault("inner_hits", {})[name] = {"hits": {
             "total": {"value": sub_result.total_hits,
                       "relation": sub_result.total_relation},
@@ -745,67 +811,275 @@ def _decorate_collapsed_hit(ctx, reader, mapper_service, body, collapse_spec,
 _TAG_DEFAULT = ("<em>", "</em>")
 
 
-def _highlight(ctx, mapper_service, body, spec, row) -> Dict[str, List[str]]:
-    """Plain highlighter: re-analyze the stored field, wrap matched terms.
+def _highlight(ctx, mapper_service, body, spec, row,
+               index_settings=None) -> Dict[str, List[str]]:
+    """Unified/plain/fvh highlighting: wrap query-matched terms in the
+    stored text (reference: `search/fetch/subphase/highlight/`).
 
-    Reference: `search/fetch/subphase/highlight/` plain highlighter.
-    """
+    Term predicates (exact terms + prefixes) come from the search query or
+    a per-field highlight_query; `require_field_match: false` lets any
+    field's predicates light up any highlighted field. Keyword fields wrap
+    whole matching values (ignored-above values never highlight); analyzed
+    fields re-tokenize, so index.highlight.max_analyzed_offset guards the
+    plain/unified-without-offsets paths."""
+    from elasticsearch_tpu.index.mapping import KeywordFieldMapper
+
     source = ctx.reader.get_source(row) or {}
-    query_terms: Dict[str, set] = {}
+    index_settings = index_settings or getattr(ctx, "index_settings", {}) \
+        or {}
 
-    def collect_terms(q: dict, default_fields: List[str]):
+    # field -> (exact terms, prefixes); terms analyzed per target field
+    query_terms: Dict[str, set] = {}
+    query_prefixes: Dict[str, set] = {}
+
+    def field_names():
+        return [p for p, _m in mapper_service.all_mappers()]
+
+    def add_terms(field, text):
+        mapper = mapper_service.get(field)
+        if isinstance(mapper, TextFieldMapper):
+            terms = mapper.search_analyzer.terms(str(text))
+        else:
+            terms = [str(text)]
+        query_terms.setdefault(field, set()).update(terms)
+
+    def collect_terms(q: dict):
         if not isinstance(q, dict):
             return
         for kind, qspec in q.items():
-            if kind in ("match", "match_phrase", "term", "match_phrase_prefix"):
-                ((field, v),) = qspec.items() if isinstance(qspec, dict) else []
-                text = v.get("query", v.get("value")) if isinstance(v, dict) else v
-                mapper = mapper_service.get(field)
-                if isinstance(mapper, TextFieldMapper):
-                    terms = mapper.search_analyzer.terms(str(text))
-                else:
-                    terms = [str(text)]
-                query_terms.setdefault(field, set()).update(terms)
+            if kind in ("match", "match_phrase", "term",
+                        "match_phrase_prefix"):
+                if not isinstance(qspec, dict) or not qspec:
+                    continue
+                ((field, v),) = list(qspec.items())[:1]
+                text = v.get("query", v.get("value")) \
+                    if isinstance(v, dict) else v
+                add_terms(field, text)
+            elif kind == "prefix":
+                if not isinstance(qspec, dict) or not qspec:
+                    continue
+                ((field, v),) = list(qspec.items())[:1]
+                text = v.get("value", v.get("prefix")) \
+                    if isinstance(v, dict) else v
+                query_prefixes.setdefault(field, set()).add(
+                    str(text).lower())
             elif kind == "multi_match":
+                import fnmatch as _fn
+                text = qspec.get("query", "")
                 for f in qspec.get("fields", []):
-                    fname = f.split("^")[0]
-                    mapper = mapper_service.get(fname)
-                    text = qspec.get("query", "")
-                    if isinstance(mapper, TextFieldMapper):
-                        query_terms.setdefault(fname, set()).update(
-                            mapper.search_analyzer.terms(str(text)))
+                    pat = f.split("^")[0]
+                    targets = ([pat] if "*" not in pat else
+                               [n for n in field_names()
+                                if _fn.fnmatch(n, pat)])
+                    for fname in targets:
+                        add_terms(fname, text)
+            elif kind == "query_string":
+                text = qspec.get("query", "")
+                f = qspec.get("default_field")
+                if f and "*" not in str(f):
+                    add_terms(f, text)
             elif kind == "bool":
                 for clause in ("must", "should", "filter"):
                     items = qspec.get(clause, [])
                     if isinstance(items, dict):
                         items = [items]
                     for sub in items:
-                        collect_terms(sub, default_fields)
+                        collect_terms(sub)
 
-    collect_terms(body.get("query", {}), [])
+    collect_terms(body.get("query", {}))
     pre = spec.get("pre_tags", [_TAG_DEFAULT[0]])[0]
     post = spec.get("post_tags", [_TAG_DEFAULT[1]])[0]
+    require_match = spec.get("require_field_match", True)
+    if isinstance(require_match, str):
+        require_match = require_match != "false"
+    default_type = spec.get("type")
+    max_offset = int(index_settings.get(
+        "index.highlight.max_analyzed_offset", 1_000_000))
+
+    import fnmatch as _fn
+    fields_spec = spec.get("fields", {})
+    if isinstance(fields_spec, list):
+        merged = {}
+        for entry in fields_spec:
+            merged.update(entry or {})
+        fields_spec = merged
+    expanded: Dict[str, dict] = {}
+    for pattern, fspec in fields_spec.items():
+        if "*" in pattern:
+            for name in field_names():
+                m = mapper_service.get(name)
+                if isinstance(m, (TextFieldMapper, KeywordFieldMapper)) \
+                        and _fn.fnmatch(name, pattern):
+                    expanded.setdefault(name, fspec or {})
+        else:
+            expanded[pattern] = fspec or {}
+
     out = {}
-    for field in spec.get("fields", {}):
-        terms = query_terms.get(field)
-        if not terms:
+    for field, fspec in expanded.items():
+        mapper = mapper_service.get(field)
+        if mapper is None:
             continue
+        terms = set()
+        prefixes = set()
+        hq = (fspec or {}).get("highlight_query")
+        if hq:
+            saved_t, saved_p = query_terms, query_prefixes
+            query_terms, query_prefixes = {}, {}
+            collect_terms(hq)
+            terms = query_terms.get(field, set())
+            prefixes = query_prefixes.get(field, set())
+            query_terms, query_prefixes = saved_t, saved_p
+        elif require_match:
+            terms = query_terms.get(field, set())
+            prefixes = query_prefixes.get(field, set())
+        else:
+            for s in query_terms.values():
+                terms |= s
+            for s in query_prefixes.values():
+                prefixes |= s
+        if not terms and not prefixes:
+            continue
+        # multi-fields highlight the PARENT's stored value
         raw = _get_path(source, field)
+        if raw is None and "." in field:
+            raw = _get_path(source, field.rsplit(".", 1)[0])
         if raw is None:
             continue
-        mapper = mapper_service.get(field)
+
+        def matches(term: str) -> bool:
+            return term in terms or any(str(term).lower().startswith(p)
+                                        for p in prefixes)
+
+        if isinstance(mapper, KeywordFieldMapper):
+            vals = raw if isinstance(raw, list) else [raw]
+            frags = []
+            ignore_above = (mapper.params or {}).get("ignore_above")
+            for v in vals:
+                v = str(v)
+                if ignore_above is not None and len(v) > int(ignore_above):
+                    continue  # the value was never indexed: nothing matched
+                if matches(v):
+                    frags.append(pre + v + post)
+            if frags:
+                out[field] = frags
+            continue
         if not isinstance(mapper, TextFieldMapper):
             continue
         text = str(raw)
+        htype = (fspec or {}).get("type") or default_type or "unified"
+        tv = str((mapper.params or {}).get("term_vector", ""))
+        has_offsets = "offsets" in tv or \
+            (mapper.params or {}).get("index_options") == "offsets"
+        if len(text) > max_offset and (htype == "plain" or not has_offsets):
+            raise IllegalArgumentError(
+                f"The length [{len(text)}] of field [{field}] in doc/index "
+                f"has exceeded [{max_offset}] - maximum allowed to be "
+                f"analyzed for highlighting. This maximum can be set by "
+                f"changing the [index.highlight.max_analyzed_offset] index "
+                f"level setting. For large texts, indexing with offsets or "
+                f"term vectors is recommended!")
         tokens = mapper.analyzer.analyze(text)
-        matched = [(t.start_offset, t.end_offset) for t in tokens if t.term in terms]
+        matched = [(t.start_offset, t.end_offset) for t in tokens
+                   if matches(t.term)]
         if not matched:
             continue
         frag = text
-        for start, end in sorted(matched, reverse=True):
-            frag = frag[:start] + pre + frag[start:end] + post + frag[end:]
+        for s0, e0 in sorted(set(matched), reverse=True):
+            frag = frag[:s0] + pre + frag[s0:e0] + post + frag[e0:]
         out[field] = [frag]
     return out
+
+
+def _nested_inner_hits_specs(q):
+    """(path, inner_hits spec, inner query) for nested queries asking."""
+    out = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            nested = node.get("nested")
+            if isinstance(nested, dict) and "inner_hits" in nested:
+                out.append((nested.get("path"), nested["inner_hits"] or {},
+                            nested.get("query")))
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for item in node:
+                walk(item)
+
+    walk(q)
+    return out
+
+
+def _nested_item_matches(item: dict, path: str, q) -> bool:
+    """Evaluate the nested query against ONE nested object (enough for the
+    simple term/match shapes inner_hits are asked with; unknown query
+    kinds match everything rather than dropping hits)."""
+    if not isinstance(q, dict) or not q:
+        return True
+    for kind, qspec in q.items():
+        if kind == "match_all":
+            return True
+        if kind in ("match", "term") and isinstance(qspec, dict) and qspec:
+            ((f, v),) = list(qspec.items())[:1]
+            want = v.get("query", v.get("value")) if isinstance(v, dict) \
+                else v
+            rel = f[len(path) + 1:] if f.startswith(path + ".") else f
+            cur = item
+            for part in rel.split("."):
+                cur = cur.get(part) if isinstance(cur, dict) else None
+            if cur is None:
+                return False
+            if kind == "term":
+                return str(cur) == str(want)
+            return str(want).lower() in str(cur).lower()
+        if kind == "bool" and isinstance(qspec, dict):
+            for clause in ("must", "filter"):
+                items = qspec.get(clause, [])
+                if isinstance(items, dict):
+                    items = [items]
+                if not all(_nested_item_matches(item, path, sub)
+                           for sub in items):
+                    return False
+            return True
+    return True
+
+
+def _decorate_nested_inner_hits(reader, row, hit, path, spec, query,
+                                index_name) -> None:
+    """Per-hit nested inner_hits (InnerHitsPhase): the matching nested
+    documents with their _nested locators."""
+    src = reader.get_source(row) or {}
+    items = src
+    for part in str(path or "").split("."):
+        items = items.get(part) if isinstance(items, dict) else None
+    if isinstance(items, dict):
+        items = [items]
+    if not isinstance(items, list):
+        return
+    name = spec.get("name", path)
+    size = int(spec.get("size", 3))
+    matching = [(off, item) for off, item in enumerate(items)
+                if isinstance(item, dict)
+                and _nested_item_matches(item, str(path), query)]
+    inner_hits = []
+    for off, item in matching[:size]:
+        ih = {"_index": index_name, "_id": hit.get("_id"),
+              "_nested": {"field": path, "offset": off},
+              "_score": 1.0, "_source": item}
+        if spec.get("version"):
+            ih["_version"] = hit.get("_version", 1)
+        for df in spec.get("docvalue_fields") or []:
+            fname = df["field"] if isinstance(df, dict) else df
+            if fname == "_seq_no":
+                sq = reader.get_seq_no(row)
+                ih.setdefault("fields", {})[fname] = [
+                    int(sq) if sq is not None else 0]
+            elif fname == "_primary_term":
+                ih.setdefault("fields", {})[fname] = [1]
+        inner_hits.append(ih)
+    hit.setdefault("inner_hits", {})[name] = {
+        "hits": {"total": {"value": len(matching), "relation": "eq"},
+                 "max_score": 1.0, "hits": inner_hits}}
 
 
 def _encode_uid(doc_id: str) -> bytes:
@@ -849,16 +1123,31 @@ def _format_doc_value(v, mapper, fmt):
                 nanos = int(v)
                 return f"{nanos // 1_000_000}.{nanos % 1_000_000:06d}"
             return str(int(v))
-        if fmt and fmt not in ("strict_date_optional_time",):
-            return _format_date_key(millis, fmt)
-        if tname == "date_nanos":
+        def nanos_iso(digits=9, strip=False):
             nanos = int(v)
             frac = nanos % 1_000_000_000
             import datetime as _dt
             base = _dt.datetime.fromtimestamp(
                 nanos // 1_000_000_000, _dt.timezone.utc)
-            return base.strftime("%Y-%m-%dT%H:%M:%S") \
-                + f".{frac:09d}".rstrip("0").ljust(2, "0") + "Z"
+            fs = f".{frac:09d}"[: digits + 1]
+            if strip:
+                fs = fs.rstrip("0").ljust(2, "0")
+            return base.strftime("%Y-%m-%dT%H:%M:%S") + fs + "Z"
+
+        if fmt and "SSSSSSSSS" in fmt:
+            # nanosecond joda/java patterns (uuuu-MM-dd'T'HH:mm:ss.SSSSSSSSSX)
+            if tname == "date_nanos":
+                return nanos_iso(9)
+            return _millis_to_iso(millis)[:-1] + "000000Z" \
+                if _millis_to_iso(millis).endswith("Z") \
+                else _millis_to_iso(millis)
+        if fmt == "strict_date_optional_time":
+            # millisecond-resolution rendering even for nanos fields
+            return _millis_to_iso(millis)
+        if fmt:
+            return _format_date_key(millis, fmt)
+        if tname == "date_nanos":
+            return nanos_iso(9, strip=True)
         return _millis_to_iso(millis)
     if fmt and isinstance(v, (int, float)) and not isinstance(v, bool) \
             and any(c in fmt for c in "#0"):
