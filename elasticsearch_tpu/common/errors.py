@@ -66,6 +66,10 @@ class IllegalStateError(SearchEngineError):
     status = 500
 
 
+class ParseError(SearchEngineError):
+    status = 400
+
+
 class ParsingError(SearchEngineError):
     status = 400
 

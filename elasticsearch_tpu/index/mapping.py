@@ -413,6 +413,31 @@ class IpFieldMapper(FieldMapper):
         return self.coerce(value)
 
 
+def _geohash_decode(gh: str):
+    """Geohash -> (lat, lon) cell center (Lucene GeoHashUtils)."""
+    bits = "0123456789bcdefghjkmnpqrstuvwxyz"
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    even = True
+    for ch in gh:
+        cd = bits.index(ch)
+        for mask in (16, 8, 4, 2, 1):
+            if even:
+                mid = (lon_lo + lon_hi) / 2
+                if cd & mask:
+                    lon_lo = mid
+                else:
+                    lon_hi = mid
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                if cd & mask:
+                    lat_lo = mid
+                else:
+                    lat_hi = mid
+            even = not even
+    return ((lat_lo + lat_hi) / 2, (lon_lo + lon_hi) / 2)
+
+
 class GeoPointFieldMapper(FieldMapper):
     type_name = "geo_point"
 
@@ -429,6 +454,10 @@ class GeoPointFieldMapper(FieldMapper):
             parts = value.split(",")
             if len(parts) == 2:
                 return float(parts[0]), float(parts[1])
+            import re as _re
+            if _re.fullmatch(r"[0123456789bcdefghjkmnpqrstuvwxyz]{1,12}",
+                             value.lower()):
+                return _geohash_decode(value.lower())
         raise MapperParsingError(f"failed to parse geo_point [{value}]")
 
     def doc_value(self, value):

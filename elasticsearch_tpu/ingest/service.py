@@ -450,6 +450,14 @@ class IngestService:
         self._running = threading.local()
 
     def put_pipeline(self, pipeline_id: str, definition: dict) -> None:
+        bad = [k for k in (definition or {})
+               if k not in ("description", "processors", "on_failure",
+                            "version", "_meta")]
+        if bad:
+            from elasticsearch_tpu.common.errors import ParseError
+            raise ParseError(
+                f"processor [{bad[0]}] doesn't support one or more provided "
+                f"configuration parameters [{bad[0]}]")
         self.pipelines[pipeline_id] = Pipeline(pipeline_id, definition)
 
     def get_pipeline(self, pipeline_id: str) -> Pipeline:

@@ -206,7 +206,15 @@ class TaskManager:
         with self._lock:
             t = self._tasks.get(task_id)
         if t is None:
-            raise ResourceNotFoundError(f"task [{task_id}] isn't running and hasn't stored its results")
+            node = str(task_id).rsplit(":", 1)[0]
+            if node != self.node_id:
+                raise ResourceNotFoundError(
+                    f"task [{task_id}] belongs to the node [{node}] which "
+                    f"isn't part of the cluster and there is no record of "
+                    f"the task")
+            raise ResourceNotFoundError(
+                f"task [{task_id}] isn't running and hasn't stored its "
+                f"results")
         return t
 
     def cancel(self, task_id: str) -> Task:

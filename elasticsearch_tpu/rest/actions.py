@@ -243,7 +243,22 @@ def register_all(rc: RestController, node: Node) -> None:
                     and not isinstance(b.get("key"), bool) for b in buckets)
                 return "lterms" if numeric else "sterms"
             if kind == "percentiles":
+                if isinstance(spec, dict) and spec.get("hdr") is not None:
+                    return "hdr_percentiles"
                 return "tdigest_percentiles"
+            if kind == "significant_terms":
+                field = spec.get("field") if isinstance(spec, dict) else None
+                for svc in node.indices.indices.values():
+                    mapper = svc.mapper_service.get(field) if field else None
+                    if mapper is not None:
+                        return ("siglterms"
+                                if mapper.type_name in _NUMERIC_TYPES
+                                else "sigsterms")
+                return "sigsterms"
+            if kind == "significant_text":
+                return "sigsterms"
+            if kind == "sampler":
+                return "sampler"
             if kind == "percentile_ranks":
                 return "tdigest_percentile_ranks"
             if kind == "max_bucket" or kind == "min_bucket":
@@ -275,6 +290,16 @@ def register_all(rc: RestController, node: Node) -> None:
         if isinstance(resp.get("aggregations"), dict):
             walk(resp["aggregations"],
                  body.get("aggs") or body.get("aggregations") or {})
+        # suggesters prefix too: suggest.{kind}#{name}
+        if isinstance(resp.get("suggest"), dict):
+            for name, sspec in (body.get("suggest") or {}).items():
+                if name not in resp["suggest"] or not isinstance(sspec, dict):
+                    continue
+                kind = next((k for k in ("term", "phrase", "completion")
+                             if k in sspec), None)
+                if kind:
+                    resp["suggest"][f"{kind}#{name}"] = \
+                        resp["suggest"].pop(name)
 
     def bulk(req):
         return 200, node.bulk(req.ndjson(),
@@ -411,6 +436,13 @@ def register_all(rc: RestController, node: Node) -> None:
 
     def msearch(req):
         lines = req.ndjson()
+        if req.bool_param("rest_total_hits_as_int", False):
+            for i in range(1, len(lines), 2):
+                tth = (lines[i] or {}).get("track_total_hits")
+                if isinstance(tth, int) and not isinstance(tth, bool):
+                    raise IllegalArgumentError(
+                        "[rest_total_hits_as_int] cannot be used if the "
+                        f"tracking of total hits is not accurate, got {tth}")
         resp = node.msearch(lines)
         bodies = [lines[i] for i in range(1, len(lines), 2)]
         for i, r in enumerate(resp.get("responses", [])):

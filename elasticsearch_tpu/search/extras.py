@@ -174,9 +174,14 @@ def completion_suggest(ctx: SearchContext, prefix: str, field: str,
                  "options": [{"text": t, "_score": float(f)}
                              for t, f in scored[:size]]}]
     ctx_defs = list(mapper.params.get("contexts") or [])
-    if ctx_defs and not contexts:
-        raise IllegalArgumentError(
-            "Missing mandatory contexts in context query")
+    if ctx_defs:
+        # the query must resolve to at least one concrete context value
+        # ({name: []} is as missing as no contexts at all)
+        provided = {k: (v if isinstance(v, list) else [v])
+                    for k, v in (contexts or {}).items()}
+        if not any(vals for vals in provided.values()):
+            raise IllegalArgumentError(
+                "Missing mandatory contexts in context query")
     plc = str(prefix or "").lower()
     best_per_doc: Dict[int, Tuple[str, float]] = {}
     for row in ctx.all_rows():

@@ -925,9 +925,16 @@ class QueryStringQuery(Query):
                            else MatchQuery(c["field"], c["text"]))
             else:
                 fields = self._default_fields(ctx)
-                subs: List[Query] = [
-                    MatchPhraseQuery(f, c["text"]) if c["phrase"] else MatchQuery(f, c["text"])
-                    for f in fields]
+                if not c["phrase"] and ("*" in c["text"]
+                                        or "?" in c["text"]):
+                    # default-field wildcards behave like the fielded form
+                    subs: List[Query] = [
+                        WildcardQuery(f, c["text"].lower()) for f in fields]
+                else:
+                    subs = [
+                        MatchPhraseQuery(f, c["text"]) if c["phrase"]
+                        else MatchQuery(f, c["text"])
+                        for f in fields]
                 if not subs:
                     continue
                 sub = subs[0] if len(subs) == 1 else DisMaxQuery(subs)
@@ -1400,6 +1407,11 @@ def parse_query(body: Optional[dict]) -> Query:
     if kind == "prefix":
         field, v = _single(spec, "prefix")
         return PrefixQuery(field, v.get("value") if isinstance(v, dict) else v)
+    if kind == "span_multi":
+        # SpanMultiTermQueryWrapper: a multi-term query (prefix/wildcard/
+        # fuzzy/regexp) used in span position; standalone it matches the
+        # wrapped query's documents
+        return parse_query(spec.get("match") or {"match_all": {}})
     if kind == "wildcard":
         field, v = _single(spec, "wildcard")
         return WildcardQuery(field, (v.get("value") or v.get("wildcard")) if isinstance(v, dict) else v)

@@ -347,9 +347,10 @@ class MoreLikeThisQuery(Query):
                  min_term_freq: int = 2, min_doc_freq: int = 5,
                  max_query_terms: int = 25,
                  minimum_should_match: Any = "30%",
-                 include: bool = False):
+                 include: bool = False, unlike: Optional[List[Any]] = None):
         self.fields = fields
         self.like = like
+        self.unlike = unlike or []
         self.min_term_freq = min_term_freq
         self.min_doc_freq = min_doc_freq
         self.max_query_terms = max_query_terms
@@ -359,24 +360,29 @@ class MoreLikeThisQuery(Query):
     def execute(self, ctx: SearchContext) -> DocSet:
         from elasticsearch_tpu.search.queries import MatchNoneQuery, TermQuery
         id_rows = _id_to_row(ctx)
+        # unspecified fields default to every analyzed text field
+        # (MoreLikeThisQueryBuilder: "all fields" when none given)
+        fields = self.fields or [
+            p for p, m in ctx.mapper_service.all_mappers()
+            if getattr(m, "type_name", None) == "text"]
         liked_rows: List[int] = []
         term_freqs: Dict[Tuple[str, str], int] = {}
         for like in self.like:
             if isinstance(like, str):
-                texts = {f: like for f in self.fields}
+                texts = {f: like for f in fields}
             elif isinstance(like, dict) and "_id" in like:
-                row = id_rows.get(like["_id"])
+                row = id_rows.get(str(like["_id"]))
                 if row is None:
                     continue
                 liked_rows.append(row)
                 texts = {}
-                for f in self.fields:
+                for f in fields:
                     src = self._source_of(ctx, row)
                     v = src.get(f) if src else None
                     if isinstance(v, str):
                         texts[f] = v
             elif isinstance(like, dict) and "doc" in like:
-                texts = {f: like["doc"].get(f) for f in self.fields
+                texts = {f: like["doc"].get(f) for f in fields
                          if isinstance(like["doc"].get(f), str)}
             else:
                 continue
@@ -388,6 +394,33 @@ class MoreLikeThisQuery(Query):
                           if hasattr(mapper, "analyze") else text.lower().split())
                 for t in tokens:
                     term_freqs[(f, t)] = term_freqs.get((f, t), 0) + 1
+        # unlike docs/texts REMOVE their terms from the candidate set
+        # (MoreLikeThisQueryBuilder#unlike)
+        unlike_terms: set = set()
+        for unl in self.unlike:
+            texts = {}
+            if isinstance(unl, str):
+                texts = {f: unl for f in fields}
+            elif isinstance(unl, dict) and "_id" in unl:
+                row = id_rows.get(str(unl["_id"]))
+                if row is not None:
+                    src_doc = self._source_of(ctx, row)
+                    texts = {f: src_doc.get(f) for f in fields
+                             if src_doc
+                             and isinstance(src_doc.get(f), str)}
+            elif isinstance(unl, dict) and "doc" in unl:
+                texts = {f: unl["doc"].get(f) for f in fields
+                         if isinstance(unl["doc"].get(f), str)}
+            for f, text in texts.items():
+                if not text:
+                    continue
+                mapper = ctx.mapper_service.get(f)
+                tokens = (mapper.analyze(text) if hasattr(mapper, "analyze")
+                          else text.lower().split())
+                unlike_terms.update((f, t) for t in tokens)
+        for key in unlike_terms:
+            term_freqs.pop(key, None)
+
         # select interesting terms by tf·idf (reference: MoreLikeThis.java)
         n_docs = max(ctx.reader.num_docs, 1)
         scored_terms = []
@@ -927,6 +960,33 @@ class SpanQuery(Query):
                 if keep:
                     out[row] = keep
             return out
+        if kind == "span_multi":
+            # SpanMultiTermQueryWrapper: expand the multi-term query into
+            # matching terms, union their spans
+            inner = spec.get("match") or {}
+            ((ikind, ispec),) = list(inner.items())[:1] if inner else ((
+                "match_all", {}),)
+            if ikind in ("prefix", "wildcard"):
+                field, v = _single(ispec)
+                pat = v.get("value", v.get("prefix", v.get("wildcard"))) \
+                    if isinstance(v, dict) else v
+                pat = str(pat).lower()
+                import fnmatch as _fn
+                if ikind == "prefix":
+                    pred = lambda t: str(t).startswith(pat)  # noqa: E731
+                else:
+                    pred = lambda t: _fn.fnmatch(str(t), pat)  # noqa: E731
+                out: Dict[int, List[Tuple[int, int]]] = {}
+                terms = set()
+                for view in ctx.reader.views:
+                    terms.update(t for t in view.segment.terms_of(field)
+                                 if pred(t))
+                for t in terms:
+                    for row, spans in _term_spans(ctx, field, t).items():
+                        out.setdefault(row, []).extend(spans)
+                return {r: sorted(set(s)) for r, s in out.items()}
+            raise ParsingError(
+                f"unsupported span_multi inner query [{ikind}]")
         raise ParsingError(f"unknown span query [{kind}]")
 
     def _spans_of(self, ctx, clause: dict):
@@ -1196,13 +1256,17 @@ def parse_extended(kind: str, spec: Any) -> Optional[Query]:
         like = spec.get("like", [])
         if not isinstance(like, list):
             like = [like]
+        unlike = spec.get("unlike", [])
+        if not isinstance(unlike, list):
+            unlike = [unlike]
         return MoreLikeThisQuery(
             fields=spec.get("fields", []), like=like,
             min_term_freq=int(spec.get("min_term_freq", 2)),
             min_doc_freq=int(spec.get("min_doc_freq", 5)),
             max_query_terms=int(spec.get("max_query_terms", 25)),
             minimum_should_match=spec.get("minimum_should_match", "30%"),
-            include=bool(spec.get("include", False)))
+            include=bool(spec.get("include", False)),
+            unlike=unlike)
     if kind == "terms_set":
         field, v = _single(spec)
         return TermsSetQuery(field, v.get("terms", []),

@@ -226,6 +226,7 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
         keep = ~_np.isin(rows, list(excluded))
         rows, scores = rows[keep], scores[keep]
 
+
     # sliced scroll (reference: SliceBuilder -> TermsSliceQuery on _id:
     # floorMod(murmur3(id, seed 7919), max) == id selects this slice)
     slice_spec = body.get("slice")
@@ -423,7 +424,9 @@ def _apply_rescore(ctx, rows, scores, rescore_spec):
         idx = np.searchsorted(rs.rows, rows[top])
         idx = np.clip(idx, 0, max(len(rs.rows) - 1, 0))
         matched = len(rs.rows) > 0
-        new_scores = scores.copy()
+        # candidates OUTSIDE the window keep the weighted query score
+        # (210_rescore_explain: explanation must match the final score)
+        new_scores = scores * qw
         if matched:
             hit = rs.rows[idx] == rows[top]
             second = np.where(hit, rs.scores[idx], 0.0)
