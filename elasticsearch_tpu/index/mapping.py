@@ -316,8 +316,46 @@ class BooleanFieldMapper(FieldMapper):
         return self.coerce(value)
 
 
+_LOCALE_NAMES = {
+    # localized day/month tokens normalize to English before strptime
+    # (the reference delegates to java.time locale-aware formatters)
+    "de": {"Mo": "Mon", "Di": "Tue", "Mi": "Wed", "Do": "Thu", "Fr": "Fri",
+           "Sa": "Sat", "So": "Sun",
+           "Jan": "Jan", "Feb": "Feb", "Mär": "Mar", "Mrz": "Mar",
+           "Apr": "Apr", "Mai": "May", "Jun": "Jun", "Jul": "Jul",
+           "Aug": "Aug", "Sep": "Sep", "Okt": "Oct", "Nov": "Nov",
+           "Dez": "Dec"},
+}
+
+
+def parse_custom_date(value: str, fmt: str, locale: str = "") -> int:
+    """Parse with a joda-style custom pattern (E, d MMM yyyy HH:mm:ss Z)
+    honoring the mapping's locale for day/month names."""
+    import datetime as _dt
+
+    s = str(value).strip()
+    names = _LOCALE_NAMES.get(str(locale or "").split("_")[0].lower())
+    if names:
+        for loc, eng in names.items():
+            s = re.sub(rf"\b{loc}\b", eng, s)
+    py = fmt
+    for joda, strp in (("yyyy", "%Y"), ("yy", "%y"), ("MMMM", "%B"),
+                       ("MMM", "%b"), ("MM", "%m"), ("dd", "%d"),
+                       ("EEEE", "%A"), ("E", "%a"), ("HH", "%H"),
+                       ("mm", "%M"), ("ss", "%S"), ("Z", "%z")):
+        py = py.replace(joda, strp)
+    py = re.sub(r"(?<!%)\bd\b", "%d", py)
+    py = re.sub(r"(?<!%)\bM\b", "%m", py)
+    d = _dt.datetime.strptime(s, py)
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=_dt.timezone.utc)
+    return int(d.timestamp() * 1000)
+
+
 class DateFieldMapper(FieldMapper):
     type_name = "date"
+
+    _CUSTOM_PATTERN_RE = re.compile(r"[EM]{1,4}|,")
 
     def _parse(self, value):
         # an explicit epoch_second format scales numeric inputs
@@ -328,6 +366,12 @@ class DateFieldMapper(FieldMapper):
             try:
                 return int(float(value) * 1000)
             except (TypeError, ValueError):
+                pass
+        if isinstance(value, str) and fmt and ("E" in fmt or "MMM" in fmt):
+            try:
+                return parse_custom_date(value, fmt,
+                                         self.params.get("locale", ""))
+            except (ValueError, MapperParsingError):
                 pass
         return parse_date_millis(value)
 

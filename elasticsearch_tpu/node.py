@@ -759,6 +759,57 @@ class Node:
             mappings=merged_mappings if merged_mappings["properties"] or mappings else mappings,
             aliases=merged_aliases or None)
 
+    def _expand_collapse_inner_hits(self, readers, body, collapse_spec,
+                                    hits) -> None:
+        from elasticsearch_tpu.index.mapping import AliasFieldMapper
+        from elasticsearch_tpu.search.service import (
+            execute_fetch_phase, execute_query_phase)
+
+        inner = collapse_spec.get("inner_hits")
+        specs = inner if isinstance(inner, list) else [inner]
+        cfield = collapse_spec["field"]
+        for hit in hits:
+            vals = (hit.get("fields") or {}).get(cfield)
+            gv = vals[0] if vals else None
+            for spec in specs:
+                name = spec.get("name", cfield)
+                want = int(spec.get("size", 3))
+                merged = []
+                total = 0
+                for svc, reader, store in readers:
+                    read_field = cfield
+                    raw_m = svc.mapper_service.get_raw(cfield) \
+                        if hasattr(svc.mapper_service, "get_raw") \
+                        else svc.mapper_service.get(cfield)
+                    if isinstance(raw_m, AliasFieldMapper):
+                        read_field = (raw_m.params or {}).get("path", cfield)
+                    sub_body = {"query": {"bool": {
+                        "must": [body["query"]] if body.get("query") else [],
+                        "filter": [{"term": {read_field: gv}}]}},
+                        "size": want}
+                    for key in ("sort", "version", "seq_no_primary_term",
+                                "docvalue_fields", "_source"):
+                        if spec.get(key) is not None:
+                            sub_body[key] = spec[key]
+                    sub_result = execute_query_phase(
+                        reader, svc.mapper_service, sub_body,
+                        vector_store=store, index_name=svc.name)
+                    total += sub_result.total_hits
+                    sub_hits = execute_fetch_phase(
+                        reader, svc.mapper_service, sub_body, sub_result,
+                        index_name=svc.name,
+                        index_settings=svc.settings.as_flat_dict())
+                    merged.extend(sub_hits)
+                if spec.get("sort") is None:
+                    merged.sort(key=lambda h: -(h.get("_score") or 0.0))
+                else:
+                    merged.sort(key=lambda h: tuple(h.get("sort") or []))
+                hit.setdefault("inner_hits", {})[name] = {"hits": {
+                    "total": {"value": total, "relation": "eq"},
+                    "max_score": (merged[0].get("_score")
+                                  if merged else None),
+                    "hits": merged[:want]}}
+
     def _search_rrf(self, index_expr: Optional[str], body: dict,
                     rrf: dict, ignore_throttled: bool) -> dict:
         """Reciprocal-rank fusion at the coordinator (BASELINE config 3:
@@ -1005,8 +1056,19 @@ class Node:
         max_score = None
         merged_aggs = None
         shard_failures: List[dict] = []
+        pre_filter = body.pop("__pre_filter_shard_size__", None)
+        skipped_shards = 0
         try:
             for svc, reader, store in readers:
+                if pre_filter is not None and body.get("query") is not None \
+                        and not _has_global_agg(body.get("aggs")
+                                                or body.get("aggregations")):
+                    from elasticsearch_tpu.search.caches import can_match
+                    if not can_match(reader, svc.mapper_service, body):
+                        # can_match pre-filter: provably-empty shards are
+                        # SKIPPED, not executed (CanMatchPreFilterSearchPhase)
+                        skipped_shards += svc.num_shards
+                        continue
                 q_start = time.perf_counter_ns()
                 # shard request cache: size=0 (aggs/count) responses keyed on
                 # the reader generation — a refresh invalidates implicitly
@@ -1119,6 +1181,13 @@ class Node:
         frm = int(body.get("from", 0) or 0)
         size = int(body.get("size", 10) if body.get("size") is not None else 10)
         window = all_hits[frm:frm + size]
+        if collapse_spec and collapse_spec.get("inner_hits") \
+                and len(readers) > 1:
+            # inner_hits expand across EVERY index (ExpandSearchPhase runs
+            # one multi-index sub-search per collapsed hit); the per-index
+            # fetch saw only its own shard
+            self._expand_collapse_inner_hits(readers, body, collapse_spec,
+                                             [t[0] for t in window])
 
         resp = {
             "took": int((time.perf_counter() - start) * 1000),
@@ -1126,7 +1195,8 @@ class Node:
             "_shards": {"total": sum(s.num_shards for s, _, _ in readers),
                         "successful": sum(s.num_shards for s, _, _ in readers)
                         - len(shard_failures),
-                        "skipped": 0, "failed": len(shard_failures),
+                        "skipped": skipped_shards,
+                        "failed": len(shard_failures),
                         **({"failures": shard_failures}
                            if shard_failures else {})},
             "hits": {
@@ -2060,6 +2130,26 @@ class Node:
 
 
 # ---------------------------------------------------------------------------
+
+def _has_global_agg(aggs) -> bool:
+    """Aggregations that need EVERY shard disable can_match skipping:
+    `global` aggs and min_doc_count:0 bucket aggs (the reference's
+    SearchSourceBuilder#aggregations rewrite check)."""
+    for spec in (aggs or {}).values():
+        if not isinstance(spec, dict):
+            continue
+        if "global" in spec:
+            return True
+        for kind, body in spec.items():
+            if kind in ("aggs", "aggregations", "meta"):
+                continue
+            if isinstance(body, dict) \
+                    and str(body.get("min_doc_count")) == "0":
+                return True
+        if _has_global_agg(spec.get("aggs") or spec.get("aggregations")):
+            return True
+    return False
+
 
 def _dir_size(path: str) -> int:
     import os as _os

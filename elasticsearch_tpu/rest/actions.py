@@ -334,6 +334,11 @@ def register_all(rc: RestController, node: Node) -> None:
             v = req.int_param(p)
             if v is not None:
                 body[key] = v
+        pfs = req.param("pre_filter_shard_size")
+        if pfs is not None:
+            if int(pfs) < 1:
+                raise IllegalArgumentError("preFilterShardSize must be >= 1")
+            body["__pre_filter_shard_size__"] = int(pfs)
         tth = req.param("track_total_hits")
         if tth is not None:
             body["track_total_hits"] = (

@@ -59,6 +59,9 @@ def numeric_values(ctx: SearchContext, rows: np.ndarray, field: str,
 
 def all_values(ctx: SearchContext, rows: np.ndarray, field: str) -> List[Tuple[int, Any]]:
     """[(row_index, value)] expanded over multi-valued fields."""
+    if field == "_index":
+        name = getattr(ctx, "index_name", "index")
+        return [(i, name) for i in range(len(rows))]
     field = ctx.mapper_service.resolve_field(field)
     out = []
     for i, row in enumerate(rows):
@@ -949,6 +952,16 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                     return False
                 return True
             groups = {k: i for k, i in groups.items() if _passes(k)}
+        # min_doc_count: 0 surfaces zero-count terms from the whole index
+        # (TermsAggregator#buildEmptyAggregation path)
+        if kind == "terms" and int(spec.get("min_doc_count", 1)) == 0:
+            if field == "_index":
+                universe = {getattr(ctx, "index_name", "index")}
+            else:
+                universe = {_hashable(v2) for _i2, v2 in
+                            all_values(ctx, ctx.all_rows(), field)}
+            for t in universe:
+                groups.setdefault(t, [])
         # sort: doc_count desc then key asc (reference terms agg default)
         order_spec = spec.get("order")
         items = [(k, np.asarray(sorted(set(i_list)), dtype=np.int64))

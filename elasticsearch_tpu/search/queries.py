@@ -440,7 +440,15 @@ class RangeQuery(Query):
             return float(parse_date_nanos(value))
         if isinstance(mapper, DateFieldMapper):
             # same unit as storage; gt/lte round date math UP to unit end
-            # (JavaDateMathParser roundUp semantics)
+            # (JavaDateMathParser roundUp semantics); custom locale-aware
+            # formats parse through the mapper's formatter
+            fmt = str(mapper.params.get("format", ""))
+            if isinstance(value, str) and fmt \
+                    and ("E" in fmt or "MMM" in fmt):
+                try:
+                    return float(mapper._parse(value))
+                except Exception:
+                    pass
             return float(parse_date_millis(value, round_up=round_up))
         if isinstance(mapper, IpFieldMapper):
             return float(mapper.coerce(value))

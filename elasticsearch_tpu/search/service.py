@@ -498,6 +498,14 @@ def _sort_docs(ctx: SearchContext, rows, scores, sort_spec):
         else:
             from elasticsearch_tpu.search.aggregations import numeric_values
             nums, present = numeric_values(ctx, rows, field)
+            # numeric_type coercion: cross-index sorts over date/date_nanos
+            # compare in one domain (FieldSortBuilder#setNumericType)
+            ntype = spec.get("numeric_type")
+            ftype = getattr(ctx.mapper_service.get(field), "type_name", None)
+            if ntype == "date" and ftype == "date_nanos":
+                nums = nums / 1e6
+            elif ntype == "date_nanos" and ftype == "date":
+                nums = nums * 1e6
             if present.any() or ctx.mapper_service.get(field) is None or \
                ctx.mapper_service.get(field).type_name in (
                    "long", "integer", "short", "byte", "double", "float",
@@ -521,9 +529,15 @@ def _sort_docs(ctx: SearchContext, rows, scores, sort_spec):
                         raw = ctx.reader.get_doc_value(field, int(rows[i]))
                         if isinstance(raw, list):
                             raw = raw[0] if raw else None
-                        sort_values[i].append(
-                            int(raw) if isinstance(raw, (int, float))
-                            else float(nums[i]))
+                        if isinstance(raw, (int, float)):
+                            rv = int(raw)
+                            if ntype == "date" and ftype == "date_nanos":
+                                rv = rv // 1_000_000
+                            elif ntype == "date_nanos" and ftype == "date":
+                                rv = rv * 1_000_000
+                            sort_values[i].append(rv)
+                        else:
+                            sort_values[i].append(float(nums[i]))
                     else:
                         sort_values[i].append(float(nums[i]))
             else:
