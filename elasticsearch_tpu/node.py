@@ -289,11 +289,22 @@ class Node:
             for name, value in self.keystore.as_settings().items():
                 self.settings.setdefault(name, value)
         from elasticsearch_tpu.security import SecurityService, SecurityStore
+        from elasticsearch_tpu.security.realms import build_realm_chain
+        _sec_store = SecurityStore(
+            _os.path.join(data_path, "_state", "security.json"))
+        _anon = self.settings.get("xpack.security.authc.anonymous.roles")
+        if isinstance(_anon, str):
+            _anon = [r.strip() for r in _anon.split(",") if r.strip()]
         self.security = SecurityService(
-            SecurityStore(_os.path.join(data_path, "_state", "security.json")),
+            _sec_store,
             enabled=bool(self.settings.get("xpack.security.enabled", False)),
             bootstrap_password=str(
-                self.settings.get("bootstrap.password", "changeme")))
+                self.settings.get("bootstrap.password", "changeme")),
+            realms=build_realm_chain(self.settings, _sec_store, data_path),
+            anonymous_roles=_anon)
+        from elasticsearch_tpu.xpack.license import LicenseService
+        self.license = LicenseService(str(self.settings.get(
+            "xpack.license.self_generated.type", "trial")))
         from elasticsearch_tpu.snapshots.service import SnapshotService
         self.snapshots = SnapshotService(self)
         from elasticsearch_tpu.ml import DatafeedService, MlService

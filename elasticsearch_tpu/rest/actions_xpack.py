@@ -355,6 +355,7 @@ def register_xpack(rc: RestController, node: Node) -> None:
     rc.register("PUT", "/_settings", put_settings)
 
     _register_ml(rc, node)
+    register_license(rc, node)
 
     # --------------------------------------------------------------- enrich
     def enrich_put(req):
@@ -422,12 +423,45 @@ def register_xpack(rc: RestController, node: Node) -> None:
     rc.register("POST", "/_monitoring/_collect", monitoring_collect)
 
 
+def register_license(rc: RestController, node: Node) -> None:
+    """GET/PUT/DELETE /_license + trial/basic upgrades
+    (RestGetLicenseAction and friends)."""
+    def get_license(req):
+        return 200, {"license": node.license.license}
+
+    def put_license(req):
+        return 200, node.license.put_license(req.json() or {})
+
+    def delete_license(req):
+        return 200, node.license.delete_license()
+
+    def start_trial(req):
+        return 200, node.license.start_trial(
+            req.param("acknowledge") in ("true", "", True))
+
+    def start_basic(req):
+        return 200, node.license.start_basic(
+            req.param("acknowledge") in ("true", "", True))
+
+    rc.register("GET", "/_license", get_license)
+    rc.register("PUT", "/_license", put_license)
+    rc.register("POST", "/_license", put_license)
+    rc.register("DELETE", "/_license", delete_license)
+    rc.register("POST", "/_license/start_trial", start_trial)
+    rc.register("POST", "/_license/start_basic", start_basic)
+
+
 def _register_ml(rc: RestController, node: Node) -> None:
     """REST surface of `x-pack/plugin/ml/.../rest/` (job/, datafeeds/,
     results/ subpackages)."""
 
     # ----------------------------------------------------- anomaly detectors
+    def _gate(req):
+        # machine learning is a platinum feature (XPackLicenseState)
+        node.license.gate("ml")
+
     def ml_put_job(req):
+        _gate(req)
         return 200, node.ml.put_job(req.params["job_id"], req.json() or {})
 
     def ml_get_jobs(req):

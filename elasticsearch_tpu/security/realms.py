@@ -1,0 +1,173 @@
+"""Authentication realms: ordered chain of credential sources.
+
+Reference: `x-pack/plugin/security/.../authc/InternalRealms.java` registers
+realm types (reserved, native, file, ldap, pki, saml, ...) and
+`AuthenticationService` walks them in order until one authenticates. Here:
+
+* `FileRealm` — users from the classic file-realm format: a `users` file of
+  `username:password_hash` lines (also accepts plaintext for test
+  fixtures) and a `users_roles` file of `role:user1,user2` lines
+  (reference: `FileUserPasswdStore` / `FileUserRolesStore`).
+* `NativeRealm` — the security index (SecurityStore) this stack already
+  persists.
+
+The chain resolves per `xpack.security.authc.realms.<type>.<name>.order`
+settings; without explicit config the default chain is file (when the
+files exist) then native, matching the reference's implicit realms.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.security.store import verify_password
+
+
+class Realm:
+    type_name = "realm"
+
+    def __init__(self, name: str, order: int = 0):
+        self.name = name
+        self.order = order
+
+    def authenticate(self, username: str, password: str) -> Optional[dict]:
+        """User dict {"roles": [...]} on success, None to try the next
+        realm (unknown user OR wrong password both fall through, like the
+        reference's realm chain)."""
+        raise NotImplementedError
+
+    def lookup(self, username: str) -> Optional[dict]:
+        return None
+
+
+class FileRealm(Realm):
+    type_name = "file"
+
+    def __init__(self, name: str, users_path: str, roles_path: str,
+                 order: int = 0):
+        super().__init__(name, order)
+        self.users_path = users_path
+        self.roles_path = roles_path
+        self._mtimes = (None, None)
+        self._users: Dict[str, str] = {}
+        self._roles: Dict[str, List[str]] = {}
+        self._load()
+
+    def _load(self) -> None:
+        users: Dict[str, str] = {}
+        roles: Dict[str, List[str]] = {}
+        if os.path.exists(self.users_path):
+            with open(self.users_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    user, _, secret = line.partition(":")
+                    users[user.strip()] = secret.strip()
+        if os.path.exists(self.roles_path):
+            with open(self.roles_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    role, _, members = line.partition(":")
+                    for user in members.split(","):
+                        user = user.strip()
+                        if user:
+                            roles.setdefault(user, []).append(role.strip())
+        self._users, self._roles = users, roles
+        self._mtimes = tuple(
+            os.path.getmtime(p) if os.path.exists(p) else None
+            for p in (self.users_path, self.roles_path))
+
+    def _maybe_reload(self) -> None:
+        current = tuple(os.path.getmtime(p) if os.path.exists(p) else None
+                        for p in (self.users_path, self.roles_path))
+        if current != self._mtimes:  # hot reload (FileWatcher analog)
+            self._load()
+
+    def authenticate(self, username: str, password: str) -> Optional[dict]:
+        self._maybe_reload()
+        stored = self._users.get(username)
+        if stored is None:
+            return None
+        # hashed entries verify; plaintext entries (test fixtures /
+        # `elasticsearch-users useradd -p`) compare directly
+        if not verify_password(password, stored) and password != stored:
+            return None
+        return {"roles": self._roles.get(username, []), "enabled": True}
+
+    def lookup(self, username: str) -> Optional[dict]:
+        self._maybe_reload()
+        if username in self._users:
+            return {"roles": self._roles.get(username, []), "enabled": True}
+        return None
+
+
+class NativeRealm(Realm):
+    type_name = "native"
+
+    def __init__(self, name: str, store, order: int = 0):
+        super().__init__(name, order)
+        self.store = store
+
+    def authenticate(self, username: str, password: str) -> Optional[dict]:
+        return self.store.authenticate(username, password)
+
+    def lookup(self, username: str) -> Optional[dict]:
+        return self.store.users.get(username)
+
+
+def build_realm_chain(settings, store, data_path: str) -> List[Realm]:
+    """Resolve the ordered realm chain from node settings.
+
+    `xpack.security.authc.realms.file.<name>.order` (+ optional
+    `.files.users` / `.files.users_roles` paths) configures file realms;
+    the native realm joins unless explicitly disabled. With no explicit
+    realm settings, a file realm is added implicitly when
+    `<data>/config/users` exists — the reference's default behavior."""
+    get = settings.get if hasattr(settings, "get") else \
+        (lambda k, d=None: (settings or {}).get(k, d))
+    realms: List[Realm] = []
+    flat = {}
+    as_flat = getattr(settings, "as_flat_dict", None)
+    if callable(as_flat):
+        flat = as_flat()
+    elif isinstance(settings, dict):
+        flat = settings
+    prefix = "xpack.security.authc.realms."
+    configured: Dict[tuple, dict] = {}
+    for key, value in flat.items():
+        if not key.startswith(prefix):
+            continue
+        rest = key[len(prefix):].split(".")
+        if len(rest) < 3:
+            continue
+        rtype, rname = rest[0], rest[1]
+        configured.setdefault((rtype, rname), {})[".".join(rest[2:])] = value
+
+    default_users = os.path.join(data_path, "config", "users")
+    default_roles = os.path.join(data_path, "config", "users_roles")
+    for (rtype, rname), conf in configured.items():
+        order = int(conf.get("order", 0))
+        if str(conf.get("enabled", "true")).lower() == "false":
+            continue
+        if rtype == "file":
+            realms.append(FileRealm(
+                rname,
+                str(conf.get("files.users", default_users)),
+                str(conf.get("files.users_roles", default_roles)),
+                order=order))
+        elif rtype == "native":
+            realms.append(NativeRealm(rname, store, order=order))
+        # ldap/pki/saml/oidc configs are accepted but unsupported in this
+        # environment (no egress); they simply never authenticate
+    if not any(r.type_name == "file" for r in realms) \
+            and os.path.exists(default_users):
+        realms.append(FileRealm("default_file", default_users,
+                                default_roles, order=-1))
+    if not any(r.type_name == "native" for r in realms):
+        realms.append(NativeRealm("default_native", store, order=100))
+    realms.sort(key=lambda r: r.order)
+    return realms

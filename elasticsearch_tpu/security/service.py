@@ -59,10 +59,18 @@ class Authentication:
 
 class SecurityService:
     def __init__(self, store: SecurityStore, enabled: bool = True,
-                 bootstrap_password: str = "changeme"):
+                 bootstrap_password: str = "changeme",
+                 realms: Optional[list] = None,
+                 anonymous_roles: Optional[List[str]] = None):
         self.store = store
         self.enabled = enabled
         self.audit: List[dict] = []
+        # ordered realm chain (InternalRealms); default: native only
+        from elasticsearch_tpu.security.realms import NativeRealm
+        self.realms = realms if realms is not None \
+            else [NativeRealm("default_native", store)]
+        # xpack.security.authc.anonymous.roles (AnonymousUser)
+        self.anonymous_roles = anonymous_roles or []
         # reserved superuser, like the `elastic` user bootstrapped from the
         # keystore (`ReservedRealm.java`)
         if "elastic" not in store.users:
@@ -152,13 +160,20 @@ class SecurityService:
                 username, _, password = userpass.partition(":")
             except Exception:
                 raise AuthenticationError("failed to decode basic authentication header")
-            user = self.store.authenticate(username, password)
+            user = None
+            realm_name = None
+            for realm in self.realms:
+                user = realm.authenticate(username, password)
+                if user is not None:
+                    realm_name = realm.name
+                    break
             if user is None:
                 self._audit("authentication_failed", user=username)
                 raise AuthenticationError(
                     f"unable to authenticate user [{username}] for REST request")
             roles = self.store.resolve_roles(user["roles"])
-            self._audit("authentication_success", user=username)
+            self._audit("authentication_success", user=username,
+                        realm=realm_name)
             return Authentication(username, roles, user["roles"])
         if header.startswith("ApiKey "):
             try:
@@ -186,6 +201,12 @@ class SecurityService:
             self._audit("authentication_success", api_key_id=key_id)
             return Authentication(rec["owner"], roles, role_names,
                                   auth_type="api_key", api_key_id=key_id)
+        if self.anonymous_roles:
+            roles = self.store.resolve_roles(self.anonymous_roles)
+            self._audit("authentication_success", user="_anonymous_")
+            return Authentication("_anonymous_", roles,
+                                  list(self.anonymous_roles),
+                                  auth_type="anonymous")
         self._audit("anonymous_access_denied")
         raise AuthenticationError(
             "missing authentication credentials for REST request")

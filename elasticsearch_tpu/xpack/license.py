@@ -1,0 +1,128 @@
+"""License service: feature gating by license tier.
+
+Reference: `x-pack/plugin/core/.../license/LicenseService.java` +
+`XPackLicenseState` — the cluster carries one license (basic by default);
+features check the license state before executing and fail with a
+security_exception when the tier is insufficient.
+
+Tier ladder: basic < standard < gold < platinum < enterprise; `trial`
+grants platinum-level features for 30 days.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+from elasticsearch_tpu.common.errors import SearchEngineError
+
+_TIERS = ("basic", "standard", "gold", "platinum", "enterprise", "trial")
+
+# platinum-tier features (XPackLicenseState checks)
+_FEATURE_TIER = {
+    "ml": "platinum",
+    "ccr": "platinum",
+    "dls_fls": "platinum",
+    "graph": "platinum",
+    "watcher": "gold",
+    "security_custom_realms": "platinum",
+}
+
+
+class LicenseExpiredError(SearchEngineError):
+    status = 403
+
+    @property
+    def error_type(self) -> str:
+        return "security_exception"
+
+
+def _rank(tier: str) -> int:
+    tier = "platinum" if tier == "trial" else tier
+    try:
+        return _TIERS.index(tier)
+    except ValueError:
+        return 0
+
+
+class LicenseService:
+    def __init__(self, self_generated: str = "trial"):
+        # xpack.license.self_generated.type: dev distributions boot with a
+        # 30-day trial; "basic" boots feature-gated
+        days = 30 if self_generated == "trial" else None
+        self._license = self._make(self_generated, days=days)
+        self._trial_used = self_generated == "trial"
+
+    @staticmethod
+    def _make(ltype: str, days: Optional[int]) -> dict:
+        now_ms = int(time.time() * 1000)
+        lic = {"status": "active", "uid": uuid.uuid4().hex, "type": ltype,
+               "issue_date_in_millis": now_ms,
+               "issued_to": "tpu-search cluster", "issuer": "elasticsearch",
+               "start_date_in_millis": now_ms, "max_nodes": 1000}
+        if days is not None:
+            lic["expiry_date_in_millis"] = now_ms + days * 86_400_000
+        return lic
+
+    # ------------------------------------------------------------ state
+    @property
+    def license(self) -> dict:
+        lic = dict(self._license)
+        exp = lic.get("expiry_date_in_millis")
+        if exp is not None and time.time() * 1000 > exp:
+            lic["status"] = "expired"
+        return lic
+
+    @property
+    def tier(self) -> str:
+        lic = self.license
+        return lic["type"] if lic["status"] == "active" else "basic"
+
+    def allows(self, feature: str) -> bool:
+        need = _FEATURE_TIER.get(feature)
+        if need is None:
+            return True
+        return _rank(self.tier) >= _rank(need)
+
+    def gate(self, feature: str) -> None:
+        """Raise when the current license doesn't cover `feature`
+        (XPackLicenseState.checkFeature -> security_exception 403)."""
+        if not self.allows(feature):
+            need = _FEATURE_TIER.get(feature, "platinum")
+            raise LicenseExpiredError(
+                f"current license is non-compliant for [{feature}]; "
+                f"a [{need}] license is required")
+
+    # ------------------------------------------------------------ admin
+    def put_license(self, body: dict) -> dict:
+        licenses = (body or {}).get("licenses") or []
+        lic = licenses[0] if licenses else (body or {}).get("license")
+        if not isinstance(lic, dict) or not lic.get("type"):
+            raise SearchEngineError("malformed license body")
+        self._license = {**self._make(str(lic["type"]), days=None), **lic}
+        return {"acknowledged": True, "license_status": "valid"}
+
+    def start_trial(self, acknowledge: bool = False) -> dict:
+        if not acknowledge:
+            return {"acknowledged": False, "trial_was_started": False,
+                    "error_message": "Operation failed: Needs acknowledgement."}
+        if self._trial_used:
+            return {"acknowledged": True, "trial_was_started": False,
+                    "error_message": "Operation failed: Trial was already "
+                                     "activated."}
+        self._trial_used = True
+        self._license = self._make("trial", days=30)
+        return {"acknowledged": True, "trial_was_started": True,
+                "type": "trial"}
+
+    def start_basic(self, acknowledge: bool = False) -> dict:
+        if not acknowledge:
+            return {"acknowledged": False, "basic_was_started": False,
+                    "error_message": "Operation failed: Needs acknowledgement."}
+        self._license = self._make("basic", days=None)
+        return {"acknowledged": True, "basic_was_started": True}
+
+    def delete_license(self) -> dict:
+        self._license = self._make("basic", days=None)
+        return {"acknowledged": True}

@@ -224,3 +224,134 @@ def test_security_disabled_passthrough(tmp_path):
     st, _ = c.req("GET", "/_cluster/health")
     assert st == 200
     n.close()
+
+
+class TestRealmChain:
+    """File realm + ordered realm chain (InternalRealms analog)."""
+
+    def _node_with_file_realm(self, tmp_path):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        import os
+
+        from elasticsearch_tpu.node import Node
+
+        cfg = tmp_path / "config"
+        cfg.mkdir()
+        (cfg / "users").write_text("filer:secret123\nshared:filepw\n")
+        (cfg / "users_roles").write_text("superuser:filer\nwatcher:shared\n")
+        node = Node(str(tmp_path), settings={"xpack.security.enabled": True})
+        return node
+
+    def test_file_realm_authenticates(self, tmp_path):
+        import base64
+
+        node = self._node_with_file_realm(tmp_path)
+        hdr = {"authorization": "Basic "
+               + base64.b64encode(b"filer:secret123").decode()}
+        auth = node.security.authenticate(hdr)
+        assert auth.username == "filer"
+        assert "superuser" in auth.role_names
+        node.close()
+
+    def test_chain_falls_through_to_native(self, tmp_path):
+        import base64
+
+        node = self._node_with_file_realm(tmp_path)
+        # the reserved native user still authenticates (file realm misses,
+        # chain continues)
+        hdr = {"authorization": "Basic "
+               + base64.b64encode(b"elastic:changeme").decode()}
+        auth = node.security.authenticate(hdr)
+        assert auth.username == "elastic"
+        node.close()
+
+    def test_wrong_password_tries_next_realm(self, tmp_path):
+        import base64
+
+        import pytest as _pytest
+
+        from elasticsearch_tpu.security.service import AuthenticationError
+
+        node = self._node_with_file_realm(tmp_path)
+        # file user with a wrong password: no realm authenticates
+        hdr = {"authorization": "Basic "
+               + base64.b64encode(b"filer:wrong").decode()}
+        with _pytest.raises(AuthenticationError):
+            node.security.authenticate(hdr)
+        node.close()
+
+    def test_anonymous_roles(self, tmp_path):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        from elasticsearch_tpu.node import Node
+
+        node = Node(str(tmp_path), settings={
+            "xpack.security.enabled": True,
+            "xpack.security.authc.anonymous.roles": "viewer"})
+        auth = node.security.authenticate({})
+        assert auth.username == "_anonymous_"
+        assert auth.auth_type == "anonymous"
+        node.close()
+
+
+class TestLicenseGating:
+    """License tiers gate platinum features (XPackLicenseState analog)."""
+
+    def _node(self, tmp_path, license_type="basic"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        from elasticsearch_tpu.node import Node
+
+        return Node(str(tmp_path), settings={
+            "xpack.license.self_generated.type": license_type})
+
+    def test_basic_license_refuses_ml(self, tmp_path):
+        import pytest as _pytest
+
+        from elasticsearch_tpu.common.errors import SearchEngineError
+
+        node = self._node(tmp_path, "basic")
+        assert node.license.license["type"] == "basic"
+        with _pytest.raises(SearchEngineError, match="non-compliant"):
+            node.license.gate("ml")
+        node.close()
+
+    def test_trial_allows_ml_and_expires_to_basic_gate(self, tmp_path):
+        node = self._node(tmp_path, "trial")
+        node.license.gate("ml")  # no raise
+        assert node.license.allows("ccr")
+        node.close()
+
+    def test_start_trial_upgrades_basic(self, tmp_path):
+        node = self._node(tmp_path, "basic")
+        out = node.license.start_trial(acknowledge=True)
+        assert out["trial_was_started"]
+        node.license.gate("ml")  # now allowed
+        # a second trial is refused
+        again = node.license.start_trial(acknowledge=True)
+        assert not again["trial_was_started"]
+        node.close()
+
+    def test_rest_license_roundtrip(self, tmp_path):
+        node = self._node(tmp_path, "basic")
+        from elasticsearch_tpu.rest.actions import register_all
+        from elasticsearch_tpu.rest.controller import RestController
+
+        rc = RestController()
+        register_all(rc, node)
+        status, body = rc.dispatch("GET", "/_license", {}, b"")[:2]
+        assert body["license"]["type"] == "basic"
+        node.close()
