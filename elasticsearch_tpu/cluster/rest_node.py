@@ -72,6 +72,7 @@ class ClusterAwareNode(Node):
         self._wire_persistent_features()
         self._wire_node_dispatch()
         self._wire_cluster_snapshots()
+        self._wire_replicated_jobs()
 
     def _wire_persistent_features(self) -> None:
         """Background features run as cluster-assigned persistent tasks
@@ -95,6 +96,8 @@ class ClusterAwareNode(Node):
             "watcher": _bg(lambda: self.watcher.run_once()),
             "ilm": _bg(lambda: self.ilm.run_once()),
             "slm": _bg(lambda: self.slm.run_once()),
+            "rollup": _bg(lambda: self.rollup.run_once()),
+            "transform": _bg(lambda: self.transform.run_once()),
         })
 
         # watches replicate through cluster state like the other
@@ -139,11 +142,105 @@ class ClusterAwareNode(Node):
             ("watches", self._registry_originals["watch"],
              self._registry_originals["del_watch"]),)
 
+    def _wire_replicated_jobs(self) -> None:
+        """Rollup jobs and transforms replicate like watches: the config
+        AND run-state travel through cluster state, so whichever node holds
+        the persistent task (incl. after an owner dies) ticks them
+        (RollupJobTask / TransformTask as persistent tasks)."""
+        node = self
+
+        def _wrap(service, section, put_name, start_name, stop_name,
+                  del_name, state_key, jobs_attr):
+            orig_put = getattr(service, put_name)
+            orig_start = getattr(service, start_name)
+            orig_stop = getattr(service, stop_name)
+            orig_del = getattr(service, del_name)
+            configs = getattr(service, jobs_attr)
+
+            def current_value(job_id):
+                run = service.state.get(job_id, {}).get(state_key, "stopped")
+                return {"config": configs.get(job_id), "run_state": run}
+
+            def replicate(job_id, value):
+                node._call(node.cluster.client_put_registry,
+                           section, job_id, value)
+                node._record_registry(section, job_id, value)
+
+            def rput(job_id, body):
+                had = job_id in configs
+                orig_put(job_id, body)  # validate + apply locally
+                try:
+                    replicate(job_id, current_value(job_id))
+                except Exception:
+                    # failed publish must not leave this node diverged
+                    if not had:
+                        configs.pop(job_id, None)
+                        service.state.pop(job_id, None)
+                    raise
+
+            def rstart(job_id):
+                out = orig_start(job_id)
+                # replicate the POST-call state (a batch transform may have
+                # already completed and flipped back to stopped)
+                replicate(job_id, current_value(job_id))
+                return out
+
+            def rstop(job_id):
+                out = orig_stop(job_id)
+                replicate(job_id, current_value(job_id))
+                return out
+
+            def rdel(job_id):
+                if job_id not in configs:
+                    orig_del(job_id)  # surface the native 404
+                    return
+                saved_cfg = configs.get(job_id)
+                saved_state = dict(service.state.get(job_id) or {})
+                orig_del(job_id)
+                try:
+                    replicate(job_id, None)
+                except Exception:
+                    configs[job_id] = saved_cfg
+                    service.state[job_id] = saved_state
+                    raise
+
+            def sync_put(key, value):
+                cfg = (value or {}).get("config")
+                if cfg is None:
+                    return
+                try:
+                    orig_put(key, cfg)
+                except Exception:
+                    pass  # already known locally: just apply run state
+                if key in service.state:
+                    service.state[key][state_key] = \
+                        (value or {}).get("run_state", "stopped")
+
+            def sync_del(key):
+                try:
+                    orig_del(key)
+                except Exception:
+                    pass
+
+            setattr(service, put_name, rput)
+            setattr(service, start_name, rstart)
+            setattr(service, stop_name, rstop)
+            setattr(service, del_name, rdel)
+            self._registry_sections = getattr(
+                self, "_registry_sections", ()) + (
+                (section, sync_put, sync_del),)
+
+        _wrap(self.rollup, "rollup_jobs", "put_job", "start_job",
+              "stop_job", "delete_job", "job_state", "jobs")
+        _wrap(self.transform, "transforms", "put", "start", "stop",
+              "delete", "state", "transforms")
+
     def register_builtin_persistent_tasks(self) -> None:
         """Called once post-boot: idempotent registrations (the master's
         task-update no-ops when the id exists)."""
         for tid, interval in (("watcher", 1000), ("ilm", 30_000),
-                              ("slm", 60_000)):
+                              ("slm", 60_000), ("rollup", 2000),
+                              ("transform", 2000)):
             self.cluster.client_register_persistent_task(
                 tid, interval_ms=interval, on_done=lambda r: None,
                 on_failure=lambda e: None)

@@ -115,11 +115,40 @@ class TransformService:
             raise ResourceNotFoundError(f"transform [{transform_id}] not found")
         self.state[transform_id]["state"] = "stopped"
 
+    def _source_fingerprint(self, indices) -> tuple:
+        """Cheap change detector: (doc_count, max_seq_no) over the source —
+        ticks skip when nothing advanced (TransformIndexer change
+        detection; re-running on an unchanged source would spin
+        checkpoints forever)."""
+        if isinstance(indices, list):
+            indices = ",".join(indices)
+        total, max_seq = 0, -1
+        try:
+            for svc in self.node.indices.resolve(indices):
+                for shard in svc.shards:
+                    total += shard.engine.doc_count()
+                    max_seq = max(max_seq, shard.engine.max_seq_no)
+        except Exception:
+            return ("unresolvable",)
+        return (total, max_seq)
+
     def run_once(self) -> None:
-        """Scheduler tick: re-index every started continuous transform."""
-        for tid, cfg in self.transforms.items():
-            if self.state[tid]["state"] == "started" and "sync" in cfg:
+        """Scheduler tick: re-index started continuous transforms whose
+        source advanced since the last checkpoint."""
+        for tid in list(self.transforms):
+            cfg = self.transforms.get(tid)
+            st = self.state.get(tid)
+            if cfg is None or st is None or st.get("state") != "started" \
+                    or "sync" not in cfg:
+                continue
+            fp = self._source_fingerprint(cfg["source"].get("index"))
+            if st.get("last_source_fp") == fp:
+                continue
+            try:
                 self.trigger(tid)
+                st["last_source_fp"] = fp
+            except Exception:
+                pass  # a tick failure must not kill the scheduler
 
     def preview(self, body: dict) -> dict:
         docs = self._compute(body)
@@ -248,6 +277,26 @@ class RollupService:
             raise ResourceNotFoundError(f"job [{job_id}] not found")
         self.state[job_id]["job_state"] = "stopped"
         return {"stopped": True}
+
+    def run_once(self) -> None:
+        """Scheduler tick (RollupJobTask's scheduled indexer): started jobs
+        whose source advanced run one pass; bucket doc-ids make re-runs
+        idempotent upserts, so each tick checkpoints the dest."""
+        for jid in list(self.jobs):
+            cfg = self.jobs.get(jid)
+            st = self.state.get(jid)
+            if cfg is None or st is None \
+                    or st.get("job_state") != "started":
+                continue
+            fp = TransformService._source_fingerprint(
+                self, cfg["index_pattern"])
+            if st.get("last_source_fp") == fp:
+                continue
+            try:
+                self.trigger(jid)
+                st["last_source_fp"] = fp
+            except Exception:
+                pass  # a tick failure must not kill the scheduler
 
     def trigger(self, job_id: str) -> dict:
         """Run one rollup pass: composite over (date_histogram [+ terms])

@@ -683,3 +683,95 @@ def test_cluster_state_driven_snapshots(cluster_procs, tmp_path):
     # every doc is readable through the cluster read path
     got = _req("GET", f"{other}/snapidx/_doc/7")
     assert got["_source"]["n"] == 7
+
+
+def test_rollup_job_as_persistent_task(cluster_procs):
+    """Rollup jobs replicate through cluster state and tick on ONE
+    cluster-assigned node (RollupJobTask as a persistent task): the rolled
+    index materializes, survives the owner's death, and rollup-search
+    keeps answering (VERDICT r3 item 9)."""
+    http_ports, _tp, procs, tmp = cluster_procs
+    live = [http_ports[i] for i, p in enumerate(procs) if p.poll() is None]
+    assert len(live) >= 2
+    a, b = f"http://127.0.0.1:{live[0]}", f"http://127.0.0.1:{live[-1]}"
+    _wait_health(live[0], "green", nodes=len(live))
+
+    _req("PUT", f"{a}/sensor", {"mappings": {"properties": {
+        "ts": {"type": "date"}, "node": {"type": "keyword"},
+        "temp": {"type": "double"}}}})
+    for i, (n, t) in enumerate([("n1", 10.0), ("n1", 20.0), ("n2", 30.0)]):
+        _req("PUT", f"{a}/sensor/_doc/{i}?refresh=true",
+             {"ts": f"2020-01-01T0{i}:00:00Z", "node": n, "temp": t})
+
+    _req("PUT", f"{a}/_rollup/job/sj", {
+        "index_pattern": "sensor", "rollup_index": "sensor_rollup",
+        "cron": "* * * * *", "page_size": 100,
+        "groups": {"date_histogram": {"field": "ts",
+                                      "calendar_interval": "1h"},
+                   "terms": {"fields": ["node"]}},
+        "metrics": [{"field": "temp", "metrics": ["max", "min", "avg"]}]})
+    # config replicated: the job is visible from the OTHER node
+    deadline = time.monotonic() + 30
+    seen = False
+    while time.monotonic() < deadline:
+        try:
+            r = _req("GET", f"{b}/_rollup/job/sj")
+            seen = bool(r["jobs"])
+            if seen:
+                break
+        except urllib.error.HTTPError:
+            pass
+        time.sleep(0.5)
+    assert seen, "rollup job did not replicate"
+
+    _req("POST", f"{b}/_rollup/job/sj/_start", {})
+
+    def rolled_count(base):
+        try:
+            _req("POST", f"{base}/sensor_rollup/_refresh", {})
+            return _req("GET", f"{base}/sensor_rollup/_count")["count"]
+        except urllib.error.HTTPError:
+            return 0
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and rolled_count(a) < 3:
+        time.sleep(1.0)
+    assert rolled_count(a) == 3, "rollup docs did not materialize"
+
+    # new source data keeps flowing into the rolled index via the ticking
+    # persistent task
+    _req("PUT", f"{a}/sensor/_doc/9?refresh=true",
+         {"ts": "2020-01-01T09:00:00Z", "node": "n3", "temp": 40.0})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and rolled_count(a) < 4:
+        time.sleep(1.0)
+    assert rolled_count(a) == 4, "rollup task is not ticking"
+
+    # kill the assigned owner; a survivor takes over the task
+    still_live = [i for i, p in enumerate(procs) if p.poll() is None]
+    if len(still_live) < 3:
+        return  # not enough quorum to survive another kill
+    state = _req("GET", f"{a}/_cluster/state")
+    tasks = state["metadata"].get("__persistent_tasks__") or {}
+    owner = tasks.get("rollup", {}).get("assigned_node")
+    assert owner, f"no rollup assignment in {list(tasks)}"
+    idx = int(owner[1:])
+    procs[idx].send_signal(signal.SIGKILL)
+    survivors = [p for i, p in enumerate(http_ports)
+                 if i != idx and procs[i].poll() is None]
+    base_s = f"http://127.0.0.1:{survivors[0]}"
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            if _req("GET", f"{base_s}/_cluster/health")["number_of_nodes"] \
+                    == len(survivors):
+                break
+        except Exception:
+            pass
+        time.sleep(1.0)
+    _req("PUT", f"{base_s}/sensor/_doc/10?refresh=true",
+         {"ts": "2020-01-01T10:00:00Z", "node": "n4", "temp": 50.0})
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline and rolled_count(base_s) < 5:
+        time.sleep(1.0)
+    assert rolled_count(base_s) == 5, "rollup task did not fail over"

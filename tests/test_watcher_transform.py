@@ -221,3 +221,48 @@ def test_rollup_job(client, node):
     st, body = client.req("GET", "/_rollup/data/sales")
     assert "sales" in body
     assert body["sales"]["rollup_jobs"][0]["job_id"] == "daily"
+
+
+def test_transform_continuous_checkpoints(tmp_path):
+    """Continuous (sync'd) transforms checkpoint on every tick: new source
+    docs flow into dest and the checkpoint counter advances
+    (TransformTask + TransformCheckpointService analog)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    from elasticsearch_tpu.node import Node
+
+    node = Node(str(tmp_path))
+    node.create_index_with_templates("src", mappings={"properties": {
+        "user": {"type": "keyword"}, "n": {"type": "long"},
+        "ts": {"type": "date"}}})
+    node.index_doc("src", "1", {"user": "a", "n": 1,
+                                "ts": "2020-01-01T00:00:00Z"})
+    node.indices.get("src").refresh()
+    node.transform.put("t1", {
+        "source": {"index": "src"},
+        "dest": {"index": "dst"},
+        "sync": {"time": {"field": "ts"}},
+        "pivot": {"group_by": {"user": {"terms": {"field": "user"}}},
+                  "aggregations": {"total": {"sum": {"field": "n"}}}}})
+    node.transform.start("t1")
+    node.transform.run_once()
+    cp1 = node.transform.state["t1"]["checkpoint"]
+    assert cp1 >= 1
+    r = node.search("dst", {"query": {"term": {"user": "a"}}})
+    assert r["hits"]["hits"][0]["_source"]["total"] == 1.0
+
+    # new source data: the next tick advances the checkpoint and upserts
+    node.index_doc("src", "2", {"user": "a", "n": 4,
+                                "ts": "2020-01-01T01:00:00Z"})
+    node.indices.get("src").refresh()
+    node.transform.run_once()
+    assert node.transform.state["t1"]["checkpoint"] > cp1
+    r = node.search("dst", {"query": {"term": {"user": "a"}}})
+    assert r["hits"]["hits"][0]["_source"]["total"] == 5.0
+    stats = node.transform.stats("t1")
+    assert stats["transforms"][0]["checkpointing"]["last"]["checkpoint"] >= 2
+    node.close()
