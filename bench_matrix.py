@@ -162,14 +162,16 @@ def run_config(name, n, d, metric, dtype, filter_frac=None):
         _small_batch_rows(name, fn, corpus, queries, d)
 
 
-def run_north_star_10m_int8():
+def run_north_star_10m_int8(n: int = 10_000_000, emit: bool = True,
+                            extra: bool = True):
     """Config 4 at true scale: 10M x 768 int8, one chip.
 
     Data is generated ON DEVICE in 1M-row chunks (the full f32 corpus is
     30 GB — it never exists anywhere). Each chunk, while still f32, feeds
     an exact-ground-truth running top-k for the query set; it is then
     row-normalized, int8-quantized, and written into the resident corpus.
-    """
+    Returns the headline row dict (bench.py embeds it in the official
+    record; `emit`/`extra` control the matrix's own JSON lines)."""
     import jax
     import jax.numpy as jnp
 
@@ -177,8 +179,8 @@ def run_north_star_10m_int8():
     from elasticsearch_tpu.ops.knn import Corpus
     from elasticsearch_tpu.ops import pallas_knn_binned as binned
 
-    n, d = 10_000_000, 768
-    chunk = 1_000_000
+    d = 768
+    chunk = min(1_000_000, n)
     n_pad = ((n + binned.BLOCK_N - 1) // binned.BLOCK_N) * binned.BLOCK_N
     nchunks = n // chunk
     key = jax.random.PRNGKey(42)
@@ -264,12 +266,24 @@ def run_north_star_10m_int8():
         _scan_searcher(fn), corpus, queries_np, d, n_small=4, n_large=16)
     recall = _recall(ids[0], ids_ref)
     eff_tops = 2 * BATCH * n * d / marginal / 1e12
-    _emit("4_north_star_int8_10Mx768", qps, marginal, p50, p99, recall,
-          n, d, "int8",
-          {"hbm_corpus_gb": round(n_pad * d / 1e9, 2),
-           "effective_int8_tops": round(eff_tops, 1),
-           "ground_truth": "exact_f32_full_corpus",
-           "build_s": round(build_s, 1)})
+    headline = {
+        "config": "4_north_star_int8_10Mx768", "qps": round(qps, 1),
+        "batch_ms": round(marginal * 1000, 3),
+        "recall_at_10": round(recall, 4), "n_docs": n, "dims": d,
+        "dtype": "int8", "batch": BATCH,
+        "hbm_corpus_gb": round(n_pad * d / 1e9, 2),
+        "effective_int8_tops": round(eff_tops, 1),
+        "ground_truth": "exact_f32_full_corpus",
+        "build_s": round(build_s, 1)}
+    if emit:
+        _emit("4_north_star_int8_10Mx768", qps, marginal, p50, p99, recall,
+              n, d, "int8",
+              {"hbm_corpus_gb": round(n_pad * d / 1e9, 2),
+               "effective_int8_tops": round(eff_tops, 1),
+               "ground_truth": "exact_f32_full_corpus",
+               "build_s": round(build_s, 1)})
+    if not extra:
+        return headline
 
     # recall-headroom variant: the binned pass + an unquantized-query
     # re-score of the top bins' member rows (removes query quantization +
@@ -287,6 +301,7 @@ def run_north_star_10m_int8():
           {"rescore": "top16bins_bf16_query",
            "ground_truth": "exact_f32_full_corpus"})
     _small_batch_rows("4_north_star", fn, corpus, queries_np, d, n_iter=16)
+    return headline
 
 
 def run_hybrid_rrf():
