@@ -300,6 +300,33 @@ def run_north_star_10m_int8(n: int = 10_000_000, emit: bool = True,
           _recall(ids_r[0], ids_ref), n, d, "int8",
           {"rescore": "top16bins_bf16_query",
            "ground_truth": "exact_f32_full_corpus"})
+
+    # cheaper headroom variants (VERDICT r3 item 5): packed-winner rescore
+    # reuses the rows the kernel already identified (~25 MB/batch of
+    # gathers vs ~200), and the hybrid adds a few whole bins for
+    # same-bin-collision recovery
+    def fn_p(qb, c, kk):
+        return binned.binned_knn_search_rescored_packed(
+            qb, c, kk, metric="cosine", rescore_candidates=128)
+
+    qps_p, marg_p, p50_p, p99_p, ids_p = _measure(
+        _scan_searcher(fn_p), corpus, queries_np, d, n_small=4, n_large=16)
+    _emit("4p_north_star_int8_packed_rescore", qps_p, marg_p, p50_p, p99_p,
+          _recall(ids_p[0], ids_ref), n, d, "int8",
+          {"rescore": "top128packed_bf16_query",
+           "ground_truth": "exact_f32_full_corpus"})
+
+    def fn_h(qb, c, kk):
+        return binned.binned_knn_search_rescored_hybrid(
+            qb, c, kk, metric="cosine", rescore_bins=8,
+            rescore_candidates=128)
+
+    qps_h, marg_h, p50_h, p99_h, ids_h = _measure(
+        _scan_searcher(fn_h), corpus, queries_np, d, n_small=4, n_large=16)
+    _emit("4h_north_star_int8_hybrid_rescore", qps_h, marg_h, p50_h, p99_h,
+          _recall(ids_h[0], ids_ref), n, d, "int8",
+          {"rescore": "top8bins+top128packed_bf16_query",
+           "ground_truth": "exact_f32_full_corpus"})
     _small_batch_rows("4_north_star", fn, corpus, queries_np, d, n_iter=16)
     return headline
 
