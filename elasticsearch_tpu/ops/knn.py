@@ -30,7 +30,7 @@ import numpy as np
 
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops import topk as topk_ops
-from elasticsearch_tpu.ops.quantization import quantize_int8
+from elasticsearch_tpu.ops.quantization import quantize_int8_np
 from elasticsearch_tpu.ops.similarity import NEG_INF
 
 LANE = 128  # TPU lane width; corpus rows are padded to a multiple of this.
@@ -43,12 +43,20 @@ class Corpus(NamedTuple):
     sq_norms:  [N_pad] f32 — ||row||^2 (post-normalization for cosine)
     scales:    [N_pad] f32 — int8 per-row scales (all-ones when unquantized)
     num_valid: int32 scalar — rows beyond this are padding and never match
+    residual / residual_scales: optional second int8 quantization level
+      (row ≈ matrix*scales + residual*residual_scales, error ~1/127² of
+      max|row|). The main scan never reads it; rescore variants gather it
+      to reconstruct near-exact rows (the ScaNN scan-int8/rescore-float
+      recipe, re-shaped so total storage equals bf16 while the scan still
+      moves only int8 bytes through HBM).
     """
 
     matrix: jax.Array
     sq_norms: jax.Array
     scales: jax.Array
     num_valid: jax.Array
+    residual: Optional[jax.Array] = None
+    residual_scales: Optional[jax.Array] = None
 
 
 def pad_rows(n: int, multiple: int = LANE) -> int:
@@ -74,6 +82,7 @@ def build_corpus(
     metric: str = sim.COSINE,
     dtype: str = "bf16",
     pad_to: Optional[int] = None,
+    residual: bool = True,
 ) -> Corpus:
     """Build the device corpus from raw host vectors.
 
@@ -81,6 +90,12 @@ def build_corpus(
     For cosine, rows are L2-normalized here, once — so query-time work is a
     pure dot product (the reference instead stores the magnitude beside each
     vector and divides per doc per query, `ScoreScriptUtils.java:161`).
+
+    residual: for int8 storage, also keep the second-level int8 residual
+    used by the rescore variants (doubles storage to bf16-parity; pass
+    False when HBM capacity matters more than rescore headroom).
+    int8 quantization happens host-side in numpy — for a 10M x 768 corpus
+    the f32 intermediate is ~30 GB and must never be materialized on device.
     """
     vectors = np.asarray(vectors, dtype=np.float32)
     n, d = vectors.shape
@@ -94,16 +109,36 @@ def build_corpus(
 
     padded = np.zeros((n_pad, d), dtype=np.float32)
     padded[:n] = vectors
-    sq_norms = jnp.asarray((padded * padded).sum(axis=-1), dtype=jnp.float32)
+    # einsum keeps sq_norms temp-free (padded*padded would materialize a
+    # second full-size f32 array — ~30 GB at the 10M x 768 scale)
+    sq_norms = jnp.asarray(np.einsum("nd,nd->n", padded, padded),
+                           dtype=jnp.float32)
 
+    res = res_scales = None
     if dtype == "int8":
-        matrix, scales = quantize_int8(jnp.asarray(padded))
+        q8, scales_np = quantize_int8_np(padded)
+        matrix = jnp.asarray(q8)
+        scales = jnp.asarray(scales_np)
+        if residual:
+            # second level, chunked so the f32 residual temp stays bounded
+            r8 = np.empty_like(q8)
+            rscales_np = np.empty((n_pad,), dtype=np.float32)
+            chunk = max(1, (64 << 20) // max(d * 4, 1))
+            for lo in range(0, n_pad, chunk):
+                hi = lo + chunk
+                res_f = (padded[lo:hi]
+                         - q8[lo:hi].astype(np.float32)
+                         * scales_np[lo:hi, None])
+                r8[lo:hi], rscales_np[lo:hi] = quantize_int8_np(res_f)
+            res = jnp.asarray(r8)
+            res_scales = jnp.asarray(rscales_np)
     else:
         matrix = jnp.asarray(padded, dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32)
         scales = jnp.ones((n_pad,), dtype=jnp.float32)
 
     return Corpus(matrix=matrix, sq_norms=sq_norms, scales=scales,
-                  num_valid=jnp.int32(n))
+                  num_valid=jnp.int32(n), residual=res,
+                  residual_scales=res_scales)
 
 
 def _block_scores(queries, matrix, sq_norms, scales, metric: str, precision: str):

@@ -94,6 +94,76 @@ _KERNEL_CLAMPED = _make_kernel(clamp=True)
 _KERNEL_COSINE = _make_kernel(clamp=False)
 
 
+def _bf16x2(x):
+    """Split f32 into (hi, lo) bf16 parts with hi + lo ≈ x to ~2^-16
+    relative — two full-rate bf16 MXU passes recover near-f32 dot
+    precision (the classic bf16x2 trick) without the ~6-pass cost of a
+    Precision.HIGHEST f32 matmul on TPU."""
+    xf = x.astype(jnp.float32)
+    hi = xf.astype(jnp.bfloat16)
+    lo = (xf - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _rescore_scores(q, corpus: Corpus, gather):
+    """Near-exact candidate scores [Q, C] for a gathered candidate set.
+
+    `gather(arr)` maps a corpus-aligned array ([N_pad, D] or [N_pad]) to
+    its candidate gather ([Q, C, D] / [Q, C]).
+
+    Precision story — this is what makes the "rescoring may only help"
+    invariant hold (base picks ⊆ candidate set, and a near-exact
+    re-ranking of a superset can only match or beat the base): the query
+    is bf16x2-split (error ~2^-16, vs the kernel's int8/bf16-rounded
+    query), int8 candidate values in [-127, 127] are EXACT in bf16 so the
+    MXU passes introduce no candidate-side error, per-row scales are
+    applied to the [Q, C] scores in f32, and the optional residual level
+    cuts the remaining int8 quantization error to ~1/127² of max|row|.
+    f32-stored corpora split candidates bf16x2 as well (4 passes).
+    Candidates stay bf16 end-to-end, so gather bytes are half an f32
+    reconstruction.
+    """
+    q_hi, q_lo = _bf16x2(q)
+
+    def dot(c):
+        kw = dict(preferred_element_type=jnp.float32)
+        return (jnp.einsum("qd,qcd->qc", q_hi, c, **kw)
+                + jnp.einsum("qd,qcd->qc", q_lo, c, **kw))
+
+    if corpus.matrix.dtype == jnp.int8:
+        s = dot(gather(corpus.matrix).astype(jnp.bfloat16)) \
+            * gather(corpus.scales)
+        if corpus.residual is not None:
+            s = s + dot(gather(corpus.residual).astype(jnp.bfloat16)) \
+                * gather(corpus.residual_scales)
+        return s
+    cand = gather(corpus.matrix)
+    if cand.dtype == jnp.bfloat16:
+        return dot(cand)
+    c_hi, c_lo = _bf16x2(cand)
+    return dot(c_hi) + dot(c_lo)
+
+
+def _row_gather(rows):
+    """gather() over explicit row ids [Q, C]."""
+    return lambda arr: arr[rows]
+
+
+def _bin_gather(tile_idx, lane_idx, nq, b, d):
+    """gather() over whole [BIN_SIZE]-row bins (coarse block transfers,
+    far cheaper on HBM than row-level gathers). tile_idx/lane_idx: [Q, B]
+    bin coordinates; gathered shapes flatten to [Q, B*BIN_SIZE(, D)]."""
+    def g(arr):
+        n_pad = arr.shape[0]
+        n_tiles = n_pad // BLOCK_N
+        if arr.ndim == 2:
+            r = arr.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE, d)
+            return r[tile_idx, :, lane_idx, :].reshape(nq, b * BIN_SIZE, d)
+        r = arr.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE)
+        return r[tile_idx, :, lane_idx].reshape(nq, b * BIN_SIZE)
+    return g
+
+
 def _decode(packed, k):
     """Packed [Q, n_tiles*BPT] int32 -> (scores [Q,k], global ids [Q,k]).
 
@@ -168,31 +238,19 @@ def binned_knn_search_rescored(
     _, bin_pos = jax.lax.top_k(cand_s, r)                       # [Q, R]
     base = jnp.take_along_axis(
         jnp.broadcast_to(bin_base, (nq, ncols)), bin_pos, axis=1)
-    # a bin's rows stride by BINS_PER_TILE within its tile; gather whole
-    # [BIN_SIZE, D] bins from a reshaped view instead of element-level
-    # row gathers (coarse block transfers, far cheaper on HBM)
-    n_pad, d = corpus.matrix.shape
-    n_tiles = n_pad // BLOCK_N
+    # a bin's rows stride by BINS_PER_TILE within its tile
+    d = corpus.matrix.shape[1]
     tile_idx = base // BLOCK_N                                  # [Q, R]
     lane_idx = base % BLOCK_N                                   # bin lane
-    mat_r = corpus.matrix.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE, d)
-    sc_r = corpus.scales.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE)
-    cand = mat_r[tile_idx, :, lane_idx, :]                      # [Q,R,64,D]
-    scales = sc_r[tile_idx, :, lane_idx]                        # [Q,R,64]
     row_ids = base[:, :, None] + (
         jnp.arange(BIN_SIZE, dtype=jnp.int32)
         * BINS_PER_TILE)[None, None, :]
     flat_ids = row_ids.reshape(nq, r * BIN_SIZE)                # [Q, C]
-    cand = cand.reshape(nq, r * BIN_SIZE, d)
-    scales = scales.reshape(nq, r * BIN_SIZE)
     # the query stays UNQUANTIZED here (the kernel's main pass quantizes
     # it to int8): removing the query-side quantization error is where
-    # the recall headroom comes from; the int8 rows dequantize via their
-    # per-row scale inside the einsum fusion
-    scores = jnp.einsum(
-        "qd,qcd->qc", q.astype(jnp.bfloat16),
-        cand.astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32) * scales
+    # the recall headroom comes from (see _rescore_scores)
+    scores = _rescore_scores(
+        q, corpus, _bin_gather(tile_idx, lane_idx, nq, r, d))
     valid = flat_ids < corpus.num_valid
     scores = jnp.where(valid, scores, -jnp.inf)
     vals, pos = jax.lax.top_k(scores, k)
@@ -229,12 +287,7 @@ def binned_knn_search_rescored_packed(
     lane = pos % BINS_PER_TILE
     t = sel & ((1 << IDX_BITS) - 1)
     rows = tile_base + t * BINS_PER_TILE + lane              # [Q, C]
-    cand = corpus.matrix[rows]                               # [Q, C, D]
-    scales = corpus.scales[rows]
-    scores = jnp.einsum(
-        "qd,qcd->qc", q.astype(jnp.bfloat16),
-        cand.astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32) * scales
+    scores = _rescore_scores(q, corpus, _row_gather(rows))
     valid = rows < corpus.num_valid
     scores = jnp.where(valid, scores, -jnp.inf)
     vals, p2 = jax.lax.top_k(scores, k)
@@ -259,8 +312,7 @@ def binned_knn_search_rescored_hybrid(
     cand_s = jax.lax.bitcast_convert_type(
         packed & jnp.int32(MASK), jnp.float32) - SHIFT
 
-    n_pad, d = corpus.matrix.shape
-    n_tiles = n_pad // BLOCK_N
+    d = corpus.matrix.shape[1]
     cols_all = jnp.arange(ncols, dtype=jnp.int32)[None, :]
     bin_base_all = (cols_all // BINS_PER_TILE) * BLOCK_N \
         + cols_all % BINS_PER_TILE
@@ -272,13 +324,11 @@ def binned_knn_search_rescored_hybrid(
         jnp.broadcast_to(bin_base_all, (nq, ncols)), bin_pos, axis=1)
     tile_idx = base // BLOCK_N
     lane_idx = base % BLOCK_N
-    mat_r = corpus.matrix.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE, d)
-    sc_r = corpus.scales.reshape(n_tiles, BIN_SIZE, BINS_PER_TILE)
     bin_rows = (base[:, :, None]
                 + (jnp.arange(BIN_SIZE, dtype=jnp.int32)
                    * BINS_PER_TILE)[None, None, :]).reshape(nq, b * BIN_SIZE)
-    bin_cand = mat_r[tile_idx, :, lane_idx, :].reshape(nq, b * BIN_SIZE, d)
-    bin_scales = sc_r[tile_idx, :, lane_idx].reshape(nq, b * BIN_SIZE)
+    bin_scores = _rescore_scores(
+        q, corpus, _bin_gather(tile_idx, lane_idx, nq, b, d))
 
     # packed winner rows beyond those bins
     c = min(rescore_candidates, ncols)
@@ -288,15 +338,10 @@ def binned_knn_search_rescored_hybrid(
     lane = pos % BINS_PER_TILE
     t = sel & ((1 << IDX_BITS) - 1)
     pk_rows = tb + t * BINS_PER_TILE + lane
-    pk_cand = corpus.matrix[pk_rows]
-    pk_scales = corpus.scales[pk_rows]
+    pk_scores = _rescore_scores(q, corpus, _row_gather(pk_rows))
 
     rows = jnp.concatenate([bin_rows, pk_rows], axis=1)
-    cand = jnp.concatenate([bin_cand, pk_cand], axis=1)
-    scales = jnp.concatenate([bin_scales, pk_scales], axis=1)
-    scores = jnp.einsum(
-        "qd,qcd->qc", q.astype(jnp.bfloat16), cand.astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32) * scales
+    scores = jnp.concatenate([bin_scores, pk_scores], axis=1)
     valid = rows < corpus.num_valid
     # duplicate rows (a packed winner inside a rescored bin) must not fill
     # two top-k slots: keep the FIRST occurrence

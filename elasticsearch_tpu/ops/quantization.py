@@ -30,3 +30,31 @@ def quantize_int8(matrix: jax.Array):
 
 def dequantize_int8(q: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
     return q.astype(dtype) * scales[:, None].astype(dtype)
+
+
+def quantize_int8_np(matrix):
+    """Host-side per-row symmetric int8 quantization (same policy as
+    `quantize_int8`: max-abs/127 scale with a 1e-30 floor).
+
+    The ONE owner of the quantization recipe for host build paths — both
+    levels of `knn.build_corpus` and `parallel.sharded_knn` route through
+    here so a policy change lands everywhere at once. Works in row chunks
+    so a 10M x 768 corpus never materializes a second full-size f32 temp.
+
+    Returns (q8 [N, D] int8, scales [N] f32).
+    """
+    import numpy as np
+
+    matrix = np.asarray(matrix, dtype=np.float32)
+    n = matrix.shape[0]
+    q8 = np.empty(matrix.shape, dtype=np.int8)
+    scales = np.empty((n,), dtype=np.float32)
+    chunk = max(1, (64 << 20) // max(matrix.shape[1] * 4, 1))
+    for lo in range(0, n, chunk):
+        hi = lo + chunk
+        block = matrix[lo:hi]
+        s = np.maximum(np.abs(block).max(axis=-1), 1e-30) / 127.0
+        scales[lo:hi] = s
+        q8[lo:hi] = np.clip(np.round(block / s[:, None]),
+                            -127, 127).astype(np.int8)
+    return q8, scales

@@ -112,9 +112,8 @@ def build_sharded_corpus(
         num_valid[s] = hi - lo
 
     if dtype == "int8":
-        max_abs = np.max(np.abs(matrix_host), axis=-1)
-        scales_host = np.maximum(max_abs, 1e-30).astype(np.float32) / 127.0
-        q = np.clip(np.round(matrix_host / scales_host[:, None]), -127, 127).astype(np.int8)
+        from elasticsearch_tpu.ops.quantization import quantize_int8_np
+        q, scales_host = quantize_int8_np(matrix_host)
         matrix = jax.device_put(q, mesh_lib.corpus_sharding(mesh))
     else:
         if dtype == "bf16":

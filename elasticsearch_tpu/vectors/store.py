@@ -113,9 +113,18 @@ class VectorStoreShard:
                                                   metric, mapper.dims, version)
                 continue
             dtype = self.dtype
-            if mapper.params.get("index_options", {}).get("type") == "int8_flat":
+            opts = mapper.params.get("index_options", {})
+            if opts.get("type") == "int8_flat":
                 dtype = "int8"
-            corpus = knn_ops.build_corpus(full, metric=metric, dtype=dtype)
+            # `"rescore": true` in index_options additionally keeps the
+            # residual rescore level — the analog of Lucene retaining raw
+            # f32 vectors beside the quantized copy (reference
+            # DenseVectorFieldMapper int8 path), at 2 B/dim total instead
+            # of 5. Off by default: int8_flat deployments size HBM against
+            # 1 B/dim, and the main scan never reads the residual.
+            corpus = knn_ops.build_corpus(
+                full, metric=metric, dtype=dtype,
+                residual=bool(opts.get("rescore", False)))
             host = None
             # int8_flat fields score int8 on the device; a bf16-rescored host
             # mirror would make result quality depend on routing — skip it so
