@@ -165,6 +165,16 @@ class TaskCancelledError(SearchEngineError):
     status = 400
 
 
+class ArrayIndexOutOfBoundsError(SearchEngineError):
+    """Shard-level execution failure inside an aggregator — notably HDR
+    percentiles collecting a negative value (the reference's DoubleHistogram
+    throws ArrayIndexOutOfBoundsException and fails the shard, Ref
+    `HDRPercentilesAggregator`). Execution-class: coordinators record it as
+    a per-shard failure instead of failing the whole request."""
+
+    status = 500
+
+
 class SearchPhaseExecutionError(SearchEngineError):
     status = 503
 

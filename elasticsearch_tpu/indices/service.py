@@ -253,7 +253,7 @@ class IndexService:
     def doc_count(self) -> int:
         return sum(s.engine.doc_count() for s in self.shards)
 
-    def combined_reader(self) -> ShardReader:
+    def combined_reader(self, exclude_shards=frozenset()) -> ShardReader:
         """A reader spanning all local shards with rebased global rows.
 
         Single-node aggregation scope: cross-shard aggs run over this merged
@@ -264,12 +264,19 @@ class IndexService:
         searches between refreshes see the SAME reader object (and gen),
         which is what keys the request/query caches and the per-reader
         field-stats cache.
+
+        exclude_shards: internal shard ids to omit entirely — the
+        shard-failure retry path (a failed shard contributes nothing, as
+        if it didn't exist). Not memoized; error paths only.
         """
         gens = tuple(s.engine.acquire_searcher().gen for s in self.shards)
-        if getattr(self, "_combined_gens", None) == gens:
+        if not exclude_shards \
+                and getattr(self, "_combined_gens", None) == gens:
             return self._combined_reader
         views = []
         for s in self.shards:
+            if s.shard_id in exclude_shards:
+                continue
             offset = s.shard_id * SHARD_ROW_SPACE
             for view in s.engine.acquire_searcher().views:
                 seg = copy.copy(view.segment)
@@ -279,8 +286,9 @@ class IndexService:
                 v2.live = view.live
                 views.append(v2)
         reader = ShardReader(views)
-        self._combined_reader = reader
-        self._combined_gens = gens
+        if not exclude_shards:
+            self._combined_reader = reader
+            self._combined_gens = gens
         return reader
 
     def shard_of_row(self, row: int) -> IndexShardHandle:

@@ -17,8 +17,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from elasticsearch_tpu.common.errors import (
-    DocumentMissingError, IllegalArgumentError, IndexNotFoundError,
-    ParsingError, SearchEngineError, VersionConflictError,
+    ArrayIndexOutOfBoundsError, DocumentMissingError, IllegalArgumentError,
+    IndexNotFoundError, ParsingError, SearchEngineError, VersionConflictError,
 )
 from elasticsearch_tpu.index.analysis import DEFAULT_REGISTRY
 from elasticsearch_tpu.indices.service import (
@@ -31,6 +31,32 @@ from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.version import __version__
 
 MAX_RESULT_WINDOW_SCROLL = 10_000
+
+
+class _ShardScopedStore:
+    """Vector-store wrapper that drops result rows outside `allowed`
+    internal shards — the shard-failure retry path, where the reader omits
+    failed shards and a knn clause must not hand back rows the reader
+    cannot resolve (a failed shard's hits are simply gone, per the
+    reference's partial-results contract)."""
+
+    def __init__(self, inner, allowed: frozenset):
+        self._inner = inner
+        self._allowed = np.asarray(sorted(allowed), dtype=np.int64)
+
+    def field(self, name):
+        return self._inner.field(name)
+
+    def search(self, field, query_vector, k, filter_rows=None,
+               precision: str = "bf16"):
+        rows, scores = self._inner.search(field, query_vector, k,
+                                          filter_rows=filter_rows,
+                                          precision=precision)
+        keep = np.isin(rows // SHARD_ROW_SPACE, self._allowed)
+        return rows[keep], scores[keep]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 class _MultiShardVectorStore:
@@ -177,15 +203,20 @@ class _MultiShardVectorStore:
         route host-side) wins over the fused mesh program."""
         from elasticsearch_tpu.serving.batcher import CostModel
 
-        total, dims = 0, 0
+        total, dims, pending = 0, 0, 0
         for shard in self.svc.shards:
-            fc = shard.vector_store.field(field) \
-                if hasattr(shard.vector_store, "field") else None
+            store = shard.vector_store
+            fc = store.field(field) if hasattr(store, "field") else None
             if fc is None or fc.host is None:
                 return False
             total += len(fc.row_map)
             dims = fc.dims
-        return total > 0 and CostModel.prefer_host(1, total, dims)
+            if hasattr(store, "pending_requests"):
+                pending += store.pending_requests(field)
+        # this request plus whatever is already queued behind the shard
+        # batchers: under concurrent load the coalesced batch amortizes the
+        # device dispatch, so the fused mesh program wins earlier
+        return total > 0 and CostModel.prefer_host(1 + pending, total, dims)
 
     def search(self, field: str, query_vector, k: int, filter_rows=None,
                precision: str = "bf16"):
@@ -938,6 +969,25 @@ class Node:
                          "max_score": hits[0]["_score"] if hits else None,
                          "hits": hits}}
 
+    def _run_query_phase(self, svc, reader, store, body, use_partial_aggs,
+                         frozen):
+        """One index's query phase. Frozen indices run on the
+        single-threaded search_throttled pool (queue 100): cold data may
+        be searched, never at the expense of hot traffic (x-pack
+        frozen-indices + ThreadPool.java:129)."""
+        kwargs = dict(vector_store=store, partial_aggs=use_partial_aggs,
+                      query_cache=self.caches.query,
+                      index_settings=svc.settings.as_flat_dict(),
+                      max_buckets=self._max_buckets(),
+                      allow_expensive=self._allow_expensive(),
+                      index_name=svc.name)
+        from elasticsearch_tpu.search.service import execute_query_phase
+        if frozen:
+            return self.thread_pool.submit(
+                "search_throttled", execute_query_phase,
+                reader, svc.mapper_service, body, **kwargs).result()
+        return execute_query_phase(reader, svc.mapper_service, body, **kwargs)
+
     @staticmethod
     def _maybe_refresh(svc: IndexService, refresh, shard=None) -> None:
         # a doc-level ?refresh=true refreshes only the TARGET shard
@@ -1097,31 +1147,51 @@ class Node:
                     result = self.caches.request.get(cache_key)
                 if result is None:
                     from elasticsearch_tpu.common.settings import setting_bool
-                    if setting_bool(svc.settings.get("index.frozen")):
-                        # frozen shards execute on the single-threaded
-                        # search_throttled pool (queue 100): cold data may
-                        # be searched, never at the expense of hot traffic
-                        # (x-pack frozen-indices + ThreadPool.java:129)
-                        result = self.thread_pool.submit(
-                            "search_throttled", execute_query_phase,
-                            reader, svc.mapper_service, body,
-                            vector_store=store,
-                            partial_aggs=use_partial_aggs,
-                            query_cache=self.caches.query,
-                            index_settings=svc.settings.as_flat_dict(),
-                            max_buckets=self._max_buckets(),
-                            allow_expensive=self._allow_expensive(),
-                            index_name=svc.name).result()
-                    else:
-                        result = execute_query_phase(
-                            reader, svc.mapper_service, body,
-                            vector_store=store,
-                            partial_aggs=use_partial_aggs,
-                            query_cache=self.caches.query,
-                            index_settings=svc.settings.as_flat_dict(),
-                            max_buckets=self._max_buckets(),
-                            allow_expensive=self._allow_expensive(),
-                            index_name=svc.name)
+                    frozen = setting_bool(svc.settings.get("index.frozen"))
+                    try:
+                        result = self._run_query_phase(
+                            svc, reader, store, body, use_partial_aggs,
+                            frozen)
+                    except ArrayIndexOutOfBoundsError as e:
+                        # execution-class failure inside an aggregator
+                        # (HDR percentiles fed a negative). The fused
+                        # single-node pass spans every internal shard, but
+                        # the reference fails at SHARD granularity: probe
+                        # each shard alone — only shards whose MATCHED
+                        # docs trip the aggregator fail — then retry the
+                        # fused pass without them (partial response).
+                        all_ids = frozenset(
+                            s.shard_id for s in svc.shards)
+                        failed = set()
+                        for s in svc.shards:
+                            probe_reader = svc.combined_reader(
+                                exclude_shards=all_ids - {s.shard_id})
+                            probe_store = _ShardScopedStore(
+                                store, frozenset({s.shard_id}))
+                            try:
+                                self._run_query_phase(
+                                    svc, probe_reader, probe_store, body,
+                                    use_partial_aggs, frozen)
+                            except ArrayIndexOutOfBoundsError:
+                                failed.add(s.shard_id)
+                        if not failed:
+                            # combined raised but no single shard does —
+                            # cannot attribute; fail them all
+                            failed = set(all_ids)
+                        for sid in sorted(failed):
+                            shard_failures.append({
+                                "shard": sid, "index": svc.name,
+                                "node": self.node_id,
+                                "reason": e.to_dict()})
+                        if len(failed) >= svc.num_shards:
+                            continue
+                        reader = svc.combined_reader(
+                            exclude_shards=frozenset(failed))
+                        result = self._run_query_phase(
+                            svc, reader,
+                            _ShardScopedStore(store, all_ids - failed),
+                            body, use_partial_aggs, frozen)
+                        cache_key = None  # partial result: never cache
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
@@ -1164,6 +1234,16 @@ class Node:
                         result.total_hits))
         finally:
             self.breakers.release("request", breaker_bytes)
+        n_shards_total = sum(s.num_shards for s, _, _ in readers)
+        if shard_failures and n_shards_total \
+                and len(shard_failures) >= n_shards_total - skipped_shards:
+            # every executed shard failed: the whole phase fails
+            # (SearchPhaseExecutionException "all shards failed")
+            from elasticsearch_tpu.common.errors import (
+                SearchPhaseExecutionError,
+            )
+            raise SearchPhaseExecutionError("query", "all shards failed",
+                                            shard_failures)
         self.counters["search"] += 1
         for g in body.get("stats") or []:
             self._search_groups[str(g)] = \

@@ -19,7 +19,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from elasticsearch_tpu.common.errors import (
+    ArrayIndexOutOfBoundsError, IllegalArgumentError, ParsingError,
+)
 from elasticsearch_tpu.index.mapping import parse_date_millis
 from elasticsearch_tpu.search.queries import SearchContext, parse_query
 
@@ -244,6 +246,12 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
         v = np.sort(vals[present])
         hdr = spec.get("hdr")
         if hdr is not None:
+            if v.size and v[0] < 0:
+                # DoubleHistogram cannot record negatives: the reference
+                # fails the whole shard (AIOOBE out of the aggregator), so
+                # the same query returns the same hits with or without the
+                # hdr agg attached — never a silently filtered result set
+                raise ArrayIndexOutOfBoundsError("out of covered value range")
             raw_digits = hdr.get("number_of_significant_value_digits", 3)
             try:
                 digits = int(raw_digits)
@@ -2083,3 +2091,4 @@ def _compute_bucket_pipeline(outputs: dict, kind: str, spec: dict, name: str = "
         bl[:] = bl[frm:end]
         return {"_applied": True}
     return {"_applied": False}
+

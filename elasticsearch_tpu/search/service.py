@@ -138,44 +138,6 @@ class ShardSearchResult:
         self.failures = failures or []  # partial per-shard failures
 
 
-def _hdr_percentile_fields(body) -> list:
-    """Fields any hdr-percentiles agg in the request records."""
-    def walk(aggs):
-        for spec in (aggs or {}).values():
-            if not isinstance(spec, dict):
-                continue
-            p = spec.get("percentiles")
-            if isinstance(p, dict) and p.get("hdr") is not None \
-                    and p.get("field"):
-                yield p["field"]
-            yield from walk(spec.get("aggs") or spec.get("aggregations"))
-
-    return list(walk(body.get("aggs") or body.get("aggregations")))
-
-
-def _hdr_exclude_negatives(reader, ctx, rows):
-    """HDR histograms cannot record negatives: the reference's shard throws
-    ArrayIndexOutOfBounds when the aggregator collects one. Checked against
-    the MATCHED rows only; offending docs fail out of this shard's view."""
-    fields = getattr(ctx, "hdr_fields", None)
-    if not fields:
-        return None
-    bad = set()
-    for field in fields:
-        for row in rows:
-            v = reader.get_doc_value(field, int(row))
-            vv = v if isinstance(v, list) else [v]
-            if any(isinstance(x, (int, float)) and x < 0 for x in vv):
-                bad.add(int(row))
-    if not bad:
-        return None
-    ctx.shard_failures.append({
-        "shard": 0, "index": None, "node": None,
-        "reason": {"type": "array_index_out_of_bounds_exception",
-                   "reason": "out of covered value range"}})
-    return bad
-
-
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         body: dict, shard_id: int = 0,
                         vector_store=None,
@@ -191,11 +153,7 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     ctx.max_buckets = max_buckets
     ctx.allow_expensive = allow_expensive
     ctx.index_name = index_name
-    # HDR percentiles cannot record negative values: the reference's shard
-    # throws ArrayIndexOutOfBounds and the response turns partial. Emulate
-    # by failing the offending docs out of this shard's view.
     ctx.shard_failures = []
-    ctx.hdr_fields = _hdr_percentile_fields(body)
     _check_request_limits(body, ctx.index_settings)
 
     query = parse_query(body.get("query")) if body.get("query") is not None else MatchAllQuery()
@@ -220,12 +178,6 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
 
     result = query.execute(ctx).with_scores()
     rows, scores = result.rows, result.scores
-    excluded = _hdr_exclude_negatives(reader, ctx, rows)
-    if excluded:
-        import numpy as _np
-        keep = ~_np.isin(rows, list(excluded))
-        rows, scores = rows[keep], scores[keep]
-
 
     # sliced scroll (reference: SliceBuilder -> TermsSliceQuery on _id:
     # floorMod(murmur3(id, seed 7919), max) == id selects this slice)

@@ -18,6 +18,7 @@ files exist) then native, matching the reference's implicit realms.
 
 from __future__ import annotations
 
+import hmac
 import os
 from typing import Dict, List, Optional
 
@@ -92,9 +93,15 @@ class FileRealm(Realm):
         stored = self._users.get(username)
         if stored is None:
             return None
-        # hashed entries verify; plaintext entries (test fixtures /
-        # `elasticsearch-users useradd -p`) compare directly
-        if not verify_password(password, stored) and password != stored:
+        # hashed entries ONLY verify as hashes — never as a literal string,
+        # or a leaked users file becomes credential-equivalent (pass-the-
+        # hash). Plaintext entries (test fixtures / `elasticsearch-users
+        # useradd -p`) compare constant-time.
+        if stored.startswith("{PBKDF2}"):
+            ok = verify_password(password, stored)
+        else:
+            ok = hmac.compare_digest(password.encode(), stored.encode())
+        if not ok:
             return None
         return {"roles": self._roles.get(username, []), "enabled": True}
 

@@ -30,9 +30,12 @@ _overhead_lock = threading.Lock()
 _overhead_ms: Optional[float] = None
 
 def _host_gops() -> float:
-    """Measured ~200 GOPS with AVX512-VNNI; the scalar fallback the kernel
-    dispatches to on older hosts is ~100x slower — price it honestly so the
-    router doesn't send scans to a path that can't serve them."""
+    """Measured ~200 GOPS peak with AVX512-VNNI; priced at 150 GOPS — a
+    25% derate for sustained serving (frequency throttle + co-running
+    work), so the router only sends the host scans it can actually absorb.
+    The scalar fallback the kernel dispatches to on older hosts is ~100x
+    slower — price it honestly so the router doesn't send scans to a path
+    that can't serve them."""
     try:
         from elasticsearch_tpu import native
         if native.knn_has_vnni():
@@ -124,6 +127,12 @@ class CombiningBatcher:
         self._q_lock = threading.Lock()
         self._queue: List = []
 
+    def pending(self) -> int:
+        """Requests queued but not yet executed — the coalescing signal
+        cost routers use to estimate the NEXT batch's size."""
+        with self._q_lock:
+            return len(self._queue)
+
     def submit(self, request):
         fut: Future = Future()
         with self._q_lock:
@@ -147,8 +156,25 @@ class CombiningBatcher:
                             f"for {len(batch)} requests")
                     for (_, f), res in zip(batch, results):
                         f.set_result(res)
-                except BaseException as exc:  # noqa: BLE001 — propagate to waiters
-                    for _, f in batch:
+                except Exception as exc:
+                    if len(batch) == 1:
+                        if not batch[0][1].done():
+                            batch[0][1].set_exception(exc)
+                    else:
+                        # one poisoned request (bad filter, malformed
+                        # vector) must not fail unrelated searches that
+                        # happened to coalesce with it: retry each request
+                        # alone so only the offender surfaces its error
+                        for r, f in batch:
+                            if f.done():
+                                continue
+                            try:
+                                f.set_result(self._execute([r])[0])
+                            except Exception as one_exc:
+                                f.set_exception(one_exc)
+                except BaseException as exc:  # KeyboardInterrupt/SystemExit:
+                    for _, f in batch:       # fail fast, no serial retries
                         if not f.done():
                             f.set_exception(exc)
+                    raise
         return fut.result()

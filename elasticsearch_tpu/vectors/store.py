@@ -142,6 +142,14 @@ class VectorStoreShard:
     def field(self, name: str) -> Optional[FieldCorpus]:
         return self._fields.get(name)
 
+    def pending_requests(self, field: str) -> int:
+        """Queued-but-unexecuted searches across this field's batchers —
+        the coalescing signal the mesh-vs-host cost router folds into its
+        batch-size estimate."""
+        with self._batchers_lock:
+            return sum(b.pending() for key, b in self._batchers.items()
+                       if key[0] == field)
+
     def search(self, field: str, query_vector: np.ndarray, k: int,
                filter_rows: Optional[np.ndarray] = None,
                precision: str = "bf16") -> Tuple[np.ndarray, np.ndarray]:

@@ -22,6 +22,26 @@ from elasticsearch_tpu.common.errors import (
 )
 
 
+# consecutive tick failures before a continuous transform/rollup task flips
+# to failed instead of retrying forever (reference TransformTask keeps a
+# failure count and fails the task, recording the reason in _stats)
+MAX_CONSECUTIVE_FAILURES = 10
+
+
+def _record_indexer_failure(st: dict, exc: Exception,
+                            state_key: str = "state") -> None:
+    """state_key: 'state' for transforms, 'job_state' for rollup jobs —
+    the two services track their lifecycle under different keys."""
+    st["failure_count"] = st.get("failure_count", 0) + 1
+    st["last_failure"] = f"{type(exc).__name__}: {exc}"
+    if st["failure_count"] >= MAX_CONSECUTIVE_FAILURES \
+            and st.get(state_key) == "started":
+        st[state_key] = "failed"
+        st["reason"] = (
+            f"task has failed {st['failure_count']} consecutive times: "
+            f"{st['last_failure']}")
+
+
 def _exact_resolver(node, indices: str):
     """Field → exact/aggregatable field (.keyword subfield for text), the
     same resolution the reference's transform does via field_caps."""
@@ -95,13 +115,15 @@ class TransformService:
         if transform_id not in self.transforms:
             raise ResourceNotFoundError(f"transform [{transform_id}] not found")
         st = self.state[transform_id]
-        return {"count": 1, "transforms": [{"id": transform_id,
-                                            "state": st["state"],
-                                            "checkpointing": {"last": {
-                                                "checkpoint": st["checkpoint"]}},
-                                            "stats": {
-                                                "documents_indexed":
-                                                st["docs_indexed"]}}]}
+        entry = {"id": transform_id,
+                 "state": st["state"],
+                 "checkpointing": {"last": {"checkpoint": st["checkpoint"]}},
+                 "stats": {"documents_indexed": st["docs_indexed"]}}
+        if st.get("reason"):
+            entry["reason"] = st["reason"]
+        if st.get("failure_count"):
+            entry["stats"]["index_failures"] = st["failure_count"]
+        return {"count": 1, "transforms": [entry]}
 
     # -- execution ------------------------------------------------------------
     def start(self, transform_id: str) -> None:
@@ -147,8 +169,12 @@ class TransformService:
             try:
                 self.trigger(tid)
                 st["last_source_fp"] = fp
-            except Exception:
-                pass  # a tick failure must not kill the scheduler
+                st.pop("failure_count", None)
+            except Exception as e:  # a tick failure must not kill the
+                _record_indexer_failure(st, e)  # scheduler — but it must
+                # surface in state/_stats, and a permanently broken
+                # transform flips to failed instead of retrying forever
+                # (reference TransformTask.fail + _stats reason)
 
     def preview(self, body: dict) -> dict:
         docs = self._compute(body)
@@ -295,8 +321,10 @@ class RollupService:
             try:
                 self.trigger(jid)
                 st["last_source_fp"] = fp
-            except Exception:
-                pass  # a tick failure must not kill the scheduler
+                st.pop("failure_count", None)
+            except Exception as e:  # a tick failure must not kill the
+                # scheduler (see transform)
+                _record_indexer_failure(st, e, state_key="job_state")
 
     def trigger(self, job_id: str) -> dict:
         """Run one rollup pass: composite over (date_histogram [+ terms])

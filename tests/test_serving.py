@@ -155,6 +155,54 @@ class TestCombiningBatcher:
         with pytest.raises(RuntimeError, match="boom"):
             b.submit(1)
 
+    def test_poisoned_request_does_not_fail_coalesced_peers(self):
+        """A batch failure retries each request alone: only the offender
+        errors, healthy requests that coalesced with it still succeed."""
+        import threading
+
+        calls = []
+
+        def execute(reqs):
+            calls.append(list(reqs))
+            if any(r == "bad" for r in reqs):
+                raise ValueError("poisoned")
+            return [f"ok:{r}" for r in reqs]
+
+        b = CombiningBatcher(execute)
+        release = threading.Event()
+        slow_started = threading.Event()
+
+        def slow_execute(reqs):
+            slow_started.set()
+            release.wait(5)
+            return execute(reqs)
+
+        b._execute = slow_execute
+        results: dict = {}
+
+        def run(r):
+            try:
+                results[r] = b.submit(r)
+            except Exception as e:  # noqa: BLE001
+                results[r] = e
+
+        # occupy the runner so the next two coalesce into one batch
+        t0 = threading.Thread(target=run, args=("warm",))
+        t0.start()
+        slow_started.wait(5)
+        b._execute = execute
+        t1 = threading.Thread(target=run, args=("good",))
+        t2 = threading.Thread(target=run, args=("bad",))
+        t1.start(); t2.start()
+        import time
+        time.sleep(0.05)  # let both enqueue behind the held lock
+        release.set()
+        for t in (t0, t1, t2):
+            t.join(5)
+        assert results["warm"] == "ok:warm"
+        assert results["good"] == "ok:good"
+        assert isinstance(results["bad"], ValueError)
+
 
 class TestStoreRouting:
     def _store(self, n=400, dims=32, seed=5):

@@ -266,3 +266,100 @@ def test_transform_continuous_checkpoints(tmp_path):
     stats = node.transform.stats("t1")
     assert stats["transforms"][0]["checkpointing"]["last"]["checkpoint"] >= 2
     node.close()
+
+
+def test_transform_repeated_failures_flip_to_failed(tmp_path):
+    """A permanently failing continuous transform records its failures in
+    state/_stats and flips to `failed` after MAX_CONSECUTIVE_FAILURES
+    instead of silently retrying forever (TransformTask.fail analog)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.xpack import transform as transform_mod
+
+    node = Node(str(tmp_path))
+    node.create_index_with_templates("src", mappings={"properties": {
+        "user": {"type": "keyword"}, "n": {"type": "long"},
+        "ts": {"type": "date"}}})
+    node.index_doc("src", "1", {"user": "a", "n": 1,
+                                "ts": "2020-01-01T00:00:00Z"})
+    node.indices.get("src").refresh()
+    node.transform.put("t1", {
+        "source": {"index": "src"},
+        "dest": {"index": "dst"},
+        "sync": {"time": {"field": "ts"}},
+        "pivot": {"group_by": {"user": {"terms": {"field": "user"}}},
+                  "aggregations": {"total": {"sum": {"field": "n"}}}}})
+    node.transform.start("t1")
+
+    # break the trigger permanently; each tick must see "new" source data
+    def boom(tid):
+        raise RuntimeError("dest exploded")
+
+    node.transform.trigger = boom
+    st = node.transform.state["t1"]
+    for i in range(transform_mod.MAX_CONSECUTIVE_FAILURES):
+        st["last_source_fp"] = f"force-dirty-{i}"
+        node.transform.run_once()
+    assert st["state"] == "failed"
+    assert "dest exploded" in st["reason"]
+    stats = node.transform.stats("t1")["transforms"][0]
+    assert stats["state"] == "failed"
+    assert "dest exploded" in stats["reason"]
+    assert stats["stats"]["index_failures"] \
+        == transform_mod.MAX_CONSECUTIVE_FAILURES
+    # a failed task no longer ticks
+    before = st["failure_count"]
+    st["last_source_fp"] = "force-dirty-again"
+    node.transform.run_once()
+    assert st["failure_count"] == before
+    node.close()
+
+
+def test_rollup_repeated_failures_flip_to_failed(tmp_path):
+    """Rollup jobs share the transform failure contract: repeated tick
+    failures surface in state and flip job_state to failed."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.xpack import transform as transform_mod
+
+    node = Node(str(tmp_path))
+    node.create_index_with_templates("sales", mappings={"properties": {
+        "ts": {"type": "date"}, "amount": {"type": "double"}}})
+    node.index_doc("sales", "1", {"ts": "2020-01-01T00:00:00Z",
+                                  "amount": 10.0})
+    node.indices.get("sales").refresh()
+    node.rollup.put_job("daily", {
+        "index_pattern": "sales",
+        "rollup_index": "sales-rollup",
+        "cron": "0 0 * * * ?",
+        "groups": {"date_histogram": {"field": "ts",
+                                      "calendar_interval": "1d"}},
+        "metrics": [{"field": "amount", "metrics": ["sum"]}]})
+    node.rollup.start_job("daily")
+
+    def boom(jid):
+        raise RuntimeError("rollup dest exploded")
+
+    node.rollup.trigger = boom
+    st = node.rollup.state["daily"]
+    for i in range(transform_mod.MAX_CONSECUTIVE_FAILURES):
+        st["last_source_fp"] = f"force-dirty-{i}"
+        node.rollup.run_once()
+    assert st["job_state"] == "failed"
+    assert "rollup dest exploded" in st["reason"]
+    # a failed job no longer ticks
+    before = st["failure_count"]
+    st["last_source_fp"] = "force-dirty-again"
+    node.rollup.run_once()
+    assert st["failure_count"] == before
+    node.close()
