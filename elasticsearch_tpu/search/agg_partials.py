@@ -36,7 +36,8 @@ from elasticsearch_tpu.search.aggregations import (
 )
 
 # single-bucket aggs: one {doc_count, subs...} object, no bucket list
-SINGLE_BUCKET = {"filter", "global", "missing", "sampler", "nested"}
+SINGLE_BUCKET = {"filter", "global", "missing", "sampler", "nested",
+                 "reverse_nested"}
 
 # ---------------------------------------------------------------------------
 # HyperLogLog (cardinality) — reference: HyperLogLogPlusPlus in
@@ -220,7 +221,7 @@ def compute_partial_aggs(ctx: SearchContext, rows: np.ndarray,
             continue
         if kind in METRIC_AGGS:
             out[name] = _compute_metric_partial(ctx, rows, kind, spec[kind])
-        elif kind in BUCKET_AGGS or kind == "nested":
+        elif kind in BUCKET_AGGS or kind in ("nested", "reverse_nested"):
             sub_normal = {
                 sname: sspec for sname, sspec in sub.items()
                 if not _is_pipeline(sspec)
@@ -288,6 +289,14 @@ def _metric_numeric(ctx, rows, spec):
 def _compute_metric_partial(ctx: SearchContext, rows: np.ndarray, kind: str,
                             spec: dict) -> dict:
     field = spec.get("field")
+
+    if kind == "scripted_metric":
+        # the shard ships its COMBINED state (init+map+combine run here);
+        # reduce_script runs once at the coordinator over all states —
+        # exactly the reference's wire contract (ScriptedMetricAggregator
+        # ships InternalScriptedMetric with the combine result)
+        return {"$p": "scripted_metric",
+                "states": [A.scripted_metric_map_combine(ctx, rows, spec)]}
 
     if kind == "value_count":
         n = len(rows) if field is None else len(all_values(ctx, rows, field))
@@ -431,6 +440,8 @@ def _merge_metric(a: dict, b: dict) -> dict:
         return _td_merge(a, b)
     if tag == "value_count":
         return {"$p": tag, "n": a["n"] + b["n"]}
+    if tag == "scripted_metric":
+        return {"$p": tag, "states": a["states"] + b["states"]}
     if tag == "avg":
         return {"$p": tag, "sum": a["sum"] + b["sum"], "n": a["n"] + b["n"]}
     if tag == "sum":
@@ -637,6 +648,8 @@ def finalize_aggs(partial: dict, aggs_spec: dict) -> dict:
 def _finalize_metric(kind: str, spec: dict, state: dict):
     if kind == "value_count":
         return {"value": state["n"]}
+    if kind == "scripted_metric":
+        return {"value": A.scripted_metric_reduce(spec, state["states"])}
     if kind == "cardinality":
         return {"value": _hll_estimate(state)}
     if kind == "avg":

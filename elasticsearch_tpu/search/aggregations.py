@@ -152,6 +152,9 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
         return compute_top_metrics(ctx, rows, spec)
     if kind == "matrix_stats":
         return compute_matrix_stats(ctx, rows, spec)
+    if kind == "scripted_metric":
+        state = scripted_metric_map_combine(ctx, rows, spec)
+        return {"value": scripted_metric_reduce(spec, [state])}
 
     if kind == "top_hits":
         return _top_hits(ctx, rows, spec)
@@ -324,6 +327,70 @@ def compute_metric(ctx: SearchContext, rows: np.ndarray, kind: str, spec: dict,
                 "lower": float(inside.min()) if len(inside) else q1,
                 "upper": float(inside.max()) if len(inside) else q3}
     raise ParsingError(f"unknown metric aggregation [{kind}]")
+
+
+def _script_source(s) -> str:
+    if isinstance(s, dict):
+        return s.get("source") or s.get("inline") or ""
+    return s or ""
+
+
+def scripted_metric_map_combine(ctx: SearchContext, rows: np.ndarray,
+                                spec: dict):
+    """One shard's init → map → combine, returning the shippable state
+    (reference ScriptedMetricAggregator.java:38: init_script seeds
+    `state`, map_script runs per matched doc with `doc` values, and
+    combine_script folds the shard state into whatever crosses the wire
+    to the coordinator). Scripts run on the sandboxed Painless
+    interpreter (script/painless.py) with the same `doc[...]` bindings as
+    script_score."""
+    from elasticsearch_tpu.script.painless import (
+        FrozenParams, compile_painless, execute,
+    )
+    from elasticsearch_tpu.search.script_score import _ScalarDoc
+
+    params = FrozenParams(spec.get("params") or {})
+    state: Dict[str, Any] = {}
+    bindings = {"state": state, "params": params}
+    init = _script_source(spec.get("init_script"))
+    if init:
+        execute(compile_painless(init), dict(bindings))
+    map_src = _script_source(spec.get("map_script"))
+    if not map_src:
+        raise IllegalArgumentError(
+            "[map_script] must be provided in [scripted_metric]")
+    prog = compile_painless(map_src)
+    score_of = None
+    if "_score" in map_src:
+        # the reference's map_script sees each doc's real score; the query
+        # phase stashes agg-scope scores on the context (service.py)
+        srows = getattr(ctx, "agg_score_rows", None)
+        if srows is not None:
+            score_of = {int(r): float(s)
+                        for r, s in zip(srows, ctx.agg_scores)}.get
+    for row in rows:
+        execute(prog, {**bindings, "doc": _ScalarDoc(ctx, int(row)),
+                       "_score": score_of(int(row), 0.0)
+                       if score_of else 0.0})
+    combine = _script_source(spec.get("combine_script"))
+    if combine:
+        return execute(compile_painless(combine), dict(bindings))
+    return state
+
+
+def scripted_metric_reduce(spec: dict, states: list):
+    """Coordinator reduce over every shard's combined state. Without a
+    reduce_script the reference returns the raw states list."""
+    from elasticsearch_tpu.script.painless import (
+        FrozenParams, compile_painless, execute,
+    )
+
+    reduce_src = _script_source(spec.get("reduce_script"))
+    if not reduce_src:
+        return list(states)
+    return execute(compile_painless(reduce_src),
+                   {"states": list(states),
+                    "params": FrozenParams(spec.get("params") or {})})
 
 
 def compute_string_stats(ctx: SearchContext, rows: np.ndarray,
@@ -513,7 +580,7 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "stats", "extended_stats", "value_cou
                "cardinality", "percentiles", "percentile_ranks", "top_hits",
                "weighted_avg", "median_absolute_deviation", "geo_bounds",
                "geo_centroid", "boxplot", "string_stats", "top_metrics",
-               "matrix_stats"}
+               "matrix_stats", "scripted_metric"}
 PIPELINE_AGGS = {"avg_bucket", "max_bucket", "min_bucket", "sum_bucket",
                  "stats_bucket", "extended_stats_bucket", "percentiles_bucket",
                  "derivative", "cumulative_sum", "bucket_script",
@@ -666,7 +733,7 @@ def compute_aggs(ctx: SearchContext, rows: np.ndarray, aggs_spec: dict) -> dict:
             continue
         if kind in METRIC_AGGS:
             out[name] = compute_metric(ctx, rows, kind, spec[kind], name=name)
-        elif kind in BUCKET_AGGS or kind == "nested":
+        elif kind in BUCKET_AGGS or kind in ("nested", "reverse_nested"):
             # parent pipelines (cumulative_sum/derivative/... declared as
             # sub-aggs) run over the parent's bucket list after it's built
             sub_normal, sub_pipes = {}, []
@@ -970,21 +1037,35 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                             all_values(ctx, ctx.all_rows(), field)}
             for t in universe:
                 groups.setdefault(t, [])
+        # under a nested scope each VALUE OCCURRENCE is one nested doc:
+        # bucket doc_count counts nested docs (NestedAggregator semantics,
+        # consistent with the enclosing nested agg's doc_count) while
+        # sub-aggs still aggregate over the unique parent rows the
+        # flattened store addresses — which is exactly what makes a
+        # reverse_nested sub-agg meaningful (nested-doc count above,
+        # parent-doc count inside)
+        nested_scope = getattr(ctx, "nested_path", None)
+        occ = None
+        if nested_scope and isinstance(field, str) \
+                and field.startswith(nested_scope + "."):
+            occ = {k: len(i_list) for k, i_list in groups.items()}
         # sort: doc_count desc then key asc (reference terms agg default)
         order_spec = spec.get("order")
         items = [(k, np.asarray(sorted(set(i_list)), dtype=np.int64))
                  for k, i_list in groups.items()]
+        cnt = (lambda k, i: occ[k]) if occ is not None \
+            else (lambda k, i: len(i))
         if kind == "rare_terms":
             max_count = int(spec.get("max_doc_count", 1))
-            items = [(k, i) for k, i in items if len(i) <= max_count]
-            items.sort(key=lambda kv: (len(kv[1]), _sort_key(kv[0])))
+            items = [(k, i) for k, i in items if cnt(k, i) <= max_count]
+            items.sort(key=lambda kv: (cnt(*kv), _sort_key(kv[0])))
         elif order_spec and isinstance(order_spec, dict):
             ((okey, odir),) = order_spec.items()
             reverse = odir == "desc"
             if okey == "_key":
                 items.sort(key=lambda kv: _sort_key(kv[0]), reverse=reverse)
             elif okey == "_count":
-                items.sort(key=lambda kv: (len(kv[1]),), reverse=reverse)
+                items.sort(key=lambda kv: (cnt(*kv),), reverse=reverse)
             else:
                 def metric_val(kv):
                     sub_out = recurse(ctx, rows[kv[1]], sub_aggs)
@@ -994,12 +1075,15 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
                     return node if isinstance(node, (int, float)) else (node or {}).get("value", 0)
                 items.sort(key=metric_val, reverse=reverse)
         else:
-            items.sort(key=lambda kv: (-len(kv[1]), _sort_key(kv[0])))
-        total_other = sum(len(i) for _, i in items[size:])
+            items.sort(key=lambda kv: (-cnt(*kv), _sort_key(kv[0])))
+        total_other = sum(cnt(k, i) for k, i in items[size:])
         _check_max_buckets(ctx, min(len(items), size))
         buckets = _bucketize(ctx, rows, sub_aggs,
                              [(k, rows[i]) for k, i in items[:size]],
                              recurse=recurse)
+        if occ is not None:
+            for b, (k, _i) in zip(buckets, items[:size]):
+                b["doc_count"] = int(occ[k])
         # mapper-typed key rendering (DocValueFormat): ip ints back to
         # addresses, booleans to 1/0 + key_as_string, dates to ISO strings
         # (fmt_key is the same transform include/exclude matched against)
@@ -1350,18 +1434,73 @@ def _compute_bucket(ctx: SearchContext, rows: np.ndarray, kind: str,
 
     if kind == "nested":
         # nested docs are stored flattened; nested agg scopes to docs having
-        # the path, and descendants (top_hits) may expand per nested doc
-        b = {"doc_count": int(len(rows))}
+        # the path, and descendants (top_hits) may expand per nested doc.
+        # doc_count counts NESTED documents, not parents (NestedAggregator
+        # collects one bucket entry per child doc under each matched root)
+        path = spec.get("path")
+        b = {"doc_count": _count_nested_docs(ctx, rows, path)}
         if sub_aggs:
             prev = getattr(ctx, "nested_path", None)
-            ctx.nested_path = spec.get("path")
+            ctx.nested_path = path
             try:
                 b.update(recurse(ctx, rows, sub_aggs))
             finally:
                 ctx.nested_path = prev
         return b
 
+    if kind == "reverse_nested":
+        # ReverseNestedAggregator.java:48 — joins from the nested context
+        # back to the parent docs (or an outer nested level via `path`).
+        # Rows are already parent rows in the flattened design, so the
+        # bucket is the parent-doc count and sub-aggs recurse with the
+        # nested scope popped to the target level.
+        cur = getattr(ctx, "nested_path", None)
+        if cur is None:
+            raise ParsingError(
+                "Reverse nested aggregation must be used inside a [nested] "
+                "aggregation")
+        target = spec.get("path")
+        if target is not None and not cur.startswith(target + "."):
+            # equality is invalid too: reverse_nested must step OUT of the
+            # current scope, to a strict ancestor level
+            raise ParsingError(
+                f"Invalid path [{target}] for reverse_nested aggregation: "
+                f"not an ancestor of the current nested scope [{cur}]")
+        b = {"doc_count": int(len(rows))} if target is None else \
+            {"doc_count": _count_nested_docs(ctx, rows, target)}
+        if sub_aggs:
+            ctx.nested_path = target
+            try:
+                b.update(recurse(ctx, rows, sub_aggs))
+            finally:
+                ctx.nested_path = cur
+        return b
+
     raise ParsingError(f"unknown bucket aggregation [{kind}]")
+
+
+def _count_nested_docs(ctx, rows, path: Optional[str]) -> int:
+    """Number of nested documents at `path` across `rows` (source walk —
+    the flattened store keeps nested objects inside the parent doc).
+    List-aware at every level, so multi-level paths like
+    `comments.replies` count the leaves. Memoized per (reader gen, path)
+    row count so repeated buckets in one request don't re-parse sources."""
+    if not path:
+        return int(len(rows))
+    from elasticsearch_tpu.search.queries_ext import _values_at
+    cache = getattr(ctx, "_nested_count_cache", None)
+    if cache is None:
+        cache = ctx._nested_count_cache = {}
+    total = 0
+    for row in rows:
+        key = (path, int(row))
+        n = cache.get(key)
+        if n is None:
+            src = ctx.reader.get_source(int(row)) or {}
+            n = sum(1 for it in _values_at(src, path) if it is not None)
+            cache[key] = n
+        total += n
+    return total
 
 
 _SIG_KNOWN_FIELDS = ["field", "size", "shard_size", "min_doc_count",

@@ -227,6 +227,9 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     # post_filter: applied after aggs scope (reference: POST_FILTER applies to
     # hits only, not aggs)
     agg_rows = rows
+    # scripted_metric map_scripts may read _score: expose the agg-scope
+    # scores (aligned with agg_rows) on the context
+    ctx.agg_score_rows, ctx.agg_scores = rows, scores
     post_filter = body.get("post_filter")
     if post_filter is not None:
         pf_rows = parse_query(post_filter).execute(ctx).rows

@@ -337,3 +337,48 @@ def test_histogram_too_many_buckets_guard(ctx):
         compute_aggs(ctx, ctx.all_rows(),
                      {"h": {"histogram": {"field": "v", "interval": 0.00001,
                                           "min_doc_count": 0}}})
+
+
+def test_scripted_metric_cross_shard_reduce(ctx):
+    """scripted_metric: init/map/combine per shard (Painless), reduce at
+    the coordinator over all shard states — the distributed result equals
+    the single-pass ground truth (ScriptedMetricAggregator.java:38)."""
+    spec = {"profit": {"scripted_metric": {
+        "init_script": "state.vals = []",
+        "map_script": "state.vals.add(doc['v'].value)",
+        "combine_script":
+            "double s = 0; for (t in state.vals) { s += t } return s",
+        "reduce_script":
+            "double s = 0; for (a in states) { s += a } return s"}}}
+    single = compute_aggs(ctx, ctx.all_rows(), spec)
+    distributed = _reduce(ctx, _skewed_split(ctx), spec)
+    assert single["profit"]["value"] == sum(float(i) for i in range(240))
+    _assert_close(distributed, single)
+
+
+def test_scripted_metric_states_without_reduce(ctx):
+    """No reduce_script: the states list itself comes back (one combined
+    state per shard), matching InternalScriptedMetric's default."""
+    spec = {"m": {"scripted_metric": {
+        "init_script": "state.n = 0",
+        "map_script": "state.n += 1",
+        "combine_script": "return state.n"}}}
+    distributed = _reduce(ctx, _skewed_split(ctx), spec)
+    assert sorted(distributed["m"]["value"]) == sorted([40, 80, 120])
+
+
+def test_scripted_metric_params_and_missing_map_script(ctx):
+    spec = {"m": {"scripted_metric": {
+        "init_script": "state.n = 0",
+        "map_script": "state.n += params.step",
+        "combine_script": "return state.n",
+        "reduce_script":
+            "double s = 0; for (a in states) { s += a } return s",
+        "params": {"step": 2}}}}
+    out = compute_aggs(ctx, ctx.all_rows(), spec)
+    assert out["m"]["value"] == 480
+    import pytest as _pytest
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    with _pytest.raises(IllegalArgumentError, match="map_script"):
+        compute_aggs(ctx, ctx.all_rows(),
+                     {"m": {"scripted_metric": {"combine_script": "return 1"}}})

@@ -314,3 +314,114 @@ def test_flush_persists_and_stats(client, tmp_path):
     assert body["_all"]["primaries"]["docs"]["count"] == 1
     _, body = client.req("POST", "/p/_forcemerge")
     assert body["_shards"]["failed"] == 0
+
+
+def test_nested_and_reverse_nested_aggs(client):
+    """nested doc_count counts NESTED docs; reverse_nested joins back to
+    parents (ReverseNestedAggregator.java:48): per-author comment buckets
+    report how many PARENT issues they commented on."""
+    client.req("PUT", "/issues", {"mappings": {"properties": {
+        "title": {"type": "keyword"},
+        "comments": {"type": "nested", "properties": {
+            "author": {"type": "keyword"},
+            "likes": {"type": "long"}}}}}})
+    docs = [
+        {"title": "a", "comments": [
+            {"author": "kim", "likes": 10}, {"author": "lee", "likes": 1}]},
+        {"title": "b", "comments": [
+            {"author": "kim", "likes": 3}]},
+        {"title": "c", "comments": [
+            {"author": "lee", "likes": 7}, {"author": "kim", "likes": 2},
+            {"author": "kim", "likes": 4}]},
+    ]
+    for i, d in enumerate(docs):
+        client.req("PUT", f"/issues/_doc/{i}", d)
+    client.req("POST", "/issues/_refresh")
+    st, body = client.req("POST", "/issues/_search", {"size": 0, "aggs": {
+        "to_comments": {"nested": {"path": "comments"}, "aggs": {
+            "authors": {"terms": {"field": "comments.author"}, "aggs": {
+                "issues": {"reverse_nested": {}}}}}}}})
+    assert st == 200
+    nested = body["aggregations"]["to_comments"]
+    assert nested["doc_count"] == 6  # six nested comments total
+    buckets = {b["key"]: b for b in nested["authors"]["buckets"]}
+    # terms under nested count NESTED docs: kim commented 4 times, lee 2 —
+    # consistent with the enclosing nested doc_count (4 + 2 == 6)
+    assert buckets["kim"]["doc_count"] == 4
+    assert buckets["lee"]["doc_count"] == 2
+    # reverse_nested joins back to parents: kim across 3 issues, lee 2
+    assert buckets["kim"]["issues"]["doc_count"] == 3
+    assert buckets["lee"]["issues"]["doc_count"] == 2
+
+    # reverse_nested outside a nested context is a 400
+    st, body = client.req("POST", "/issues/_search", {"size": 0, "aggs": {
+        "bad": {"reverse_nested": {}}}})
+    assert st == 400
+    # a path equal to the current scope must step OUT, not sideways: 400
+    st, body = client.req("POST", "/issues/_search", {"size": 0, "aggs": {
+        "c": {"nested": {"path": "comments"}, "aggs": {
+            "bad": {"reverse_nested": {"path": "comments"}}}}}})
+    assert st == 400
+
+
+def test_nested_agg_multi_level_path(client):
+    """Multi-level nested paths count leaf nested docs list-aware at every
+    level (comments.replies through a list of comments)."""
+    client.req("PUT", "/threads", {"mappings": {"properties": {
+        "comments": {"type": "nested", "properties": {
+            "replies": {"type": "nested", "properties": {
+                "who": {"type": "keyword"}}}}}}}})
+    client.req("PUT", "/threads/_doc/1", {"comments": [
+        {"replies": [{"who": "x"}, {"who": "y"}]},
+        {"replies": [{"who": "x"}]}]})
+    client.req("PUT", "/threads/_doc/2", {"comments": [
+        {"replies": [{"who": "z"}]}]})
+    client.req("POST", "/threads/_refresh")
+    st, body = client.req("POST", "/threads/_search", {"size": 0, "aggs": {
+        "r": {"nested": {"path": "comments.replies"}}}})
+    assert st == 200
+    assert body["aggregations"]["r"]["doc_count"] == 4
+
+
+def test_scripted_metric_agg_rest(client):
+    client.req("PUT", "/sales", {"mappings": {"properties": {
+        "type": {"type": "keyword"}, "amount": {"type": "double"}}}})
+    for i, (t, a) in enumerate([("sale", 80.0), ("cost", 10.0),
+                                ("sale", 130.0), ("cost", 30.0)]):
+        client.req("PUT", f"/sales/_doc/{i}", {"type": t, "amount": a})
+    client.req("POST", "/sales/_refresh")
+    st, body = client.req("POST", "/sales/_search", {"size": 0, "aggs": {
+        "profit": {"scripted_metric": {
+            "init_script": "state.transactions = []",
+            "map_script":
+                "state.transactions.add(doc['type'].value == 'sale' ? "
+                "doc['amount'].value : -1 * doc['amount'].value)",
+            "combine_script":
+                "double profit = 0; for (t in state.transactions) "
+                "{ profit += t } return profit",
+            "reduce_script":
+                "double profit = 0; for (a in states) "
+                "{ profit += a } return profit"}}}})
+    assert st == 200
+    assert body["aggregations"]["profit"]["value"] == 170.0
+
+
+def test_scripted_metric_sees_real_scores(client):
+    """map_script reads each doc's real _score (reference binds the score
+    in ScriptedMetricAggregator's map context)."""
+    client.req("PUT", "/scored", {"mappings": {"properties": {
+        "t": {"type": "text"}}}})
+    for i in range(3):
+        client.req("PUT", f"/scored/_doc/{i}", {"t": "alpha beta"})
+    client.req("POST", "/scored/_refresh")
+    st, body = client.req("POST", "/scored/_search", {
+        "size": 0,
+        "query": {"match": {"t": "alpha"}},
+        "aggs": {"s": {"scripted_metric": {
+            "init_script": "state.s = 0.0",
+            "map_script": "state.s += _score",
+            "combine_script": "return state.s",
+            "reduce_script":
+                "double s = 0; for (a in states) { s += a } return s"}}}})
+    assert st == 200
+    assert body["aggregations"]["s"]["value"] > 0.0
