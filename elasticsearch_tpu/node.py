@@ -319,6 +319,9 @@ class Node:
         if self.keystore is not None:
             for name, value in self.keystore.as_settings().items():
                 self.settings.setdefault(name, value)
+        # wire remotes from boot settings (cluster.remote.<alias>.seeds);
+        # apply_settings isolates + logs per-alias failures itself
+        self.remotes.apply_settings(self.settings)
         from elasticsearch_tpu.security import SecurityService, SecurityStore
         from elasticsearch_tpu.security.realms import build_realm_chain
         _sec_store = SecurityStore(
@@ -1021,9 +1024,11 @@ class Node:
         if index_expr and ":" in index_expr:
             from elasticsearch_tpu.xpack.ccr import merge_ccs_responses
             local_expr, remote_exprs = self.remotes.split_indices(index_expr)
-            remote_resps = self.remotes.search_remotes(remote_exprs, body)
+            remote_resps, clusters = self.remotes.search_remotes(
+                remote_exprs, body)
             local_resp = self.search(local_expr, body) if local_expr else None
-            return merge_ccs_responses(local_resp, remote_resps, body)
+            return merge_ccs_responses(local_resp, remote_resps, body,
+                                       clusters)
         start = time.perf_counter()
         body = self._rewrite_terms_lookup(body)
         if ignore_unavailable and index_expr:
@@ -2216,6 +2221,8 @@ class Node:
     def close(self):
         self.ml.close_all()
         self.plugins.remove_extensions()
+        for alias in list(self.remotes.remotes):
+            self.remotes.unregister(alias)
         self.indices.close()
         self.thread_pool.shutdown()
 

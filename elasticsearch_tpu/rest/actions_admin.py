@@ -34,6 +34,7 @@ def register_admin(rc: RestController, node: Node) -> None:
     def put_cluster_settings(req):
         body = req.json() or {}
         applied = {"acknowledged": True, "persistent": {}, "transient": {}}
+        changed = {}
         for scope in ("persistent", "transient"):
             for key, value in _flatten(body.get(scope, {})).items():
                 if value is None:
@@ -41,6 +42,11 @@ def register_admin(rc: RestController, node: Node) -> None:
                 else:
                     node.cluster_settings[scope][key] = value
                 applied[scope][key] = value
+                changed[key] = value
+        # dynamic remote-cluster reconfiguration
+        # (RemoteClusterService.listenForUpdates)
+        if any(k.startswith("cluster.remote.") for k in changed):
+            node.remotes.apply_settings(changed)
         return 200, applied
 
     rc.register("GET", "/_cluster/settings", get_cluster_settings)

@@ -302,8 +302,14 @@ def register_xpack(rc: RestController, node: Node) -> None:
     rc.register("POST", "/{index}/_ccr/pause_follow", ccr_pause)
     rc.register("POST", "/{index}/_ccr/resume_follow", ccr_resume)
     rc.register("POST", "/{index}/_ccr/unfollow", ccr_unfollow)
+    def ccr_tick(req):
+        # explicit replication tick (the ShardFollowNodeTask scheduler
+        # analog, same convention as /_watcher/_tick)
+        return 200, {"operations": node.ccr.run_once()}
+
     rc.register("GET", "/{index}/_ccr/info", ccr_follow_info)
     rc.register("GET", "/_ccr/stats", ccr_stats)
+    rc.register("POST", "/_ccr/_tick", ccr_tick)
 
     def auto_follow_put(req):
         node.ccr.put_auto_follow(req.params["name"], req.json() or {})

@@ -108,6 +108,23 @@ def main(argv=None) -> int:
                         ssl_context=_http_ssl_context(settings))
 
     async def run():
+        # even a single-node deployment binds the binary transport when
+        # transport.port is set: that's the endpoint OTHER clusters dial
+        # for CCS/CCR (reference: every node binds 9300)
+        transport = None
+        if settings.get("transport.port") is not None:
+            from elasticsearch_tpu.transport.tcp import TcpTransportService
+            from elasticsearch_tpu.xpack.remote_cluster import (
+                register_remote_handlers,
+            )
+            transport = TcpTransportService(
+                args.name, host=args.host,
+                port=int(settings["transport.port"]),
+                loop=asyncio.get_running_loop())
+            host, port = await transport.bind()
+            register_remote_handlers(transport, node)
+            print(f"[{args.name}] transport bound on {host}:{port}",
+                  flush=True)
         await server.start()
         print(f"[{args.name}] listening on http://{args.host}:{server.port} "
               f"(data: {args.data})", flush=True)
@@ -120,6 +137,8 @@ def main(argv=None) -> int:
             except NotImplementedError:
                 pass
         await stop.wait()
+        if transport is not None:
+            await transport.close()
         await server.stop()
         node.close()
 
@@ -223,6 +242,12 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
         register_all(controller, aware)
         adapter = ClusterRestAdapter(cluster_node, loop)
         register_cluster_overrides(controller, adapter)
+        # remote-cluster (CCS/CCR) server actions ride the same transport
+        # the cluster uses internally (reference: one 9300 endpoint)
+        from elasticsearch_tpu.xpack.remote_cluster import (
+            register_remote_handlers,
+        )
+        register_remote_handlers(transport, aware)
         server = HttpServer(controller, host=args.host, port=args.port,
                             thread_pool=aware.thread_pool,
                             ssl_context=_http_ssl_context(settings))

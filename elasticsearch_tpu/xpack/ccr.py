@@ -9,11 +9,12 @@ Reference:
   checkpoint, guarded by retention leases; auto-follow patterns create
   followers for new leader indices (`AutoFollowCoordinator`).
 
-Here a "remote cluster" is another Node reachable in-process (the analog of
-the reference's in-JVM `InternalTestCluster` wiring — production would dial
-the HTTP/RPC layer; the merge/checkpoint logic is identical either way).
-Change-tailing reads docs above the follower's seq_no checkpoint from the
-leader's readers, plus an id-level anti-join for deletes.
+Remote clusters are reached through the adapter interface in
+`xpack/remote_cluster.py`: `WireRemote` holds sniff-mode pooled
+connections over the real binary transport (production; configured via
+`cluster.remote.<alias>.seeds`), `InProcessRemote` wraps another Node in
+this process (test clusters). CCR change-tailing and CCS merging are
+identical over either.
 """
 
 from __future__ import annotations
@@ -29,20 +30,61 @@ from elasticsearch_tpu.common.errors import (
 
 
 class RemoteClusterService:
-    """alias → remote node registry (reference: RemoteClusterService)."""
+    """alias → remote cluster registry (reference: RemoteClusterService)."""
 
     def __init__(self, node):
         self.node = node
         self.remotes: Dict[str, Any] = {}
-        self.seeds: Dict[str, List[str]] = {}
 
     def register(self, alias: str, remote_node) -> None:
-        self.remotes[alias] = remote_node
-        self.seeds.setdefault(alias, [f"in-process:{id(remote_node):x}"])
+        """In-process registration (test clusters)."""
+        from elasticsearch_tpu.xpack.remote_cluster import InProcessRemote
+        self.remotes[alias] = InProcessRemote(alias, remote_node)
+
+    def configure(self, alias: str, seeds: List[str],
+                  skip_unavailable: bool = False) -> None:
+        """Wire registration from `cluster.remote.<alias>.*` settings:
+        sniff-mode pooled connections over the binary transport."""
+        from elasticsearch_tpu.xpack.remote_cluster import WireRemote
+        old = self.remotes.pop(alias, None)
+        if old is not None:
+            old.close()
+        self.remotes[alias] = WireRemote(
+            alias, seeds, skip_unavailable=skip_unavailable)
+
+    def apply_settings(self, flat: Dict[str, Any]) -> None:
+        """Apply `cluster.remote.*` keys (boot settings or a
+        _cluster/settings update). `seeds: null` removes the alias.
+        Per-alias isolation: one malformed remote entry must not keep the
+        others from registering."""
+        import logging
+
+        from elasticsearch_tpu.xpack.remote_cluster import (
+            parse_remote_settings,
+        )
+        for alias, cfg in parse_remote_settings(flat).items():
+            try:
+                if "seeds" in cfg and cfg["seeds"] is None:
+                    self.unregister(alias)
+                    continue
+                existing = self.remotes.get(alias)
+                if "seeds" in cfg:
+                    self.configure(alias, cfg["seeds"],
+                                   skip_unavailable=cfg.get(
+                                       "skip_unavailable",
+                                       getattr(existing, "skip_unavailable",
+                                               False)))
+                elif existing is not None and "skip_unavailable" in cfg:
+                    existing.skip_unavailable = cfg["skip_unavailable"]
+            except Exception:  # noqa: BLE001
+                logging.getLogger("elasticsearch_tpu.remote_cluster").warning(
+                    "failed to configure remote cluster [%s]", alias,
+                    exc_info=True)
 
     def unregister(self, alias: str) -> None:
-        self.remotes.pop(alias, None)
-        self.seeds.pop(alias, None)
+        old = self.remotes.pop(alias, None)
+        if old is not None:
+            old.close()
 
     def get(self, alias: str):
         if alias not in self.remotes:
@@ -50,11 +92,8 @@ class RemoteClusterService:
         return self.remotes[alias]
 
     def info(self) -> dict:
-        return {alias: {"connected": alias in self.remotes,
-                        "mode": "sniff",
-                        "seeds": self.seeds.get(alias, []),
-                        "num_nodes_connected": 1 if alias in self.remotes else 0}
-                for alias in set(self.remotes) | set(self.seeds)}
+        return {alias: remote.info_entry()
+                for alias, remote in self.remotes.items()}
 
     # -- CCS ------------------------------------------------------------------
     @staticmethod
@@ -76,29 +115,62 @@ class RemoteClusterService:
                 {a: ",".join(ps) for a, ps in remote_parts.items()})
 
     def search_remotes(self, remote_exprs: Dict[str, str],
-                       body: dict) -> List[dict]:
-        """Run the query on each remote; return per-cluster responses with
-        hits re-labelled `alias:index` like the reference's CCS merge."""
+                       body: dict) -> Tuple[List[dict], dict]:
+        """Run the query on each remote (ccs_minimize_roundtrips shape:
+        one request per cluster); returns (responses, clusters_meta) with
+        hits re-labelled `alias:index` like the reference's CCS merge.
+
+        `skip_unavailable: true` clusters that fail are SKIPPED (counted
+        in `_clusters.skipped`); others fail the whole search
+        (RemoteClusterService.java `skip_unavailable` contract)."""
         responses = []
+        clusters = {"total": len(remote_exprs), "successful": 0,
+                    "skipped": 0}
         for alias, expr in remote_exprs.items():
             remote = self.get(alias)
-            resp = remote.search(expr, body)
+            try:
+                resp = remote.search(expr, body)
+            except Exception:  # noqa: BLE001 — connectivity or remote error
+                if getattr(remote, "skip_unavailable", False):
+                    clusters["skipped"] += 1
+                    continue
+                raise
+            clusters["successful"] += 1
             for h in resp.get("hits", {}).get("hits", []):
                 h["_index"] = f"{alias}:{h['_index']}"
             responses.append(resp)
-        return responses
+        return responses, clusters
 
 
 def merge_ccs_responses(local: Optional[dict], remotes: List[dict],
-                        body: dict) -> dict:
+                        body: dict,
+                        clusters: Optional[dict] = None) -> dict:
     """Merge coordinator-side: concatenate hit lists, re-sort by score (or
-    sort values), recompute totals (reference: SearchResponseMerger)."""
+    sort values), recompute totals (reference: SearchResponseMerger).
+    `clusters`: remote-cluster accounting from `search_remotes` — the
+    local cluster is added here when it contributed."""
+    n_local = 1 if local else 0
+    cl = {"total": (clusters or {}).get("total", len(remotes)) + n_local,
+          "successful": (clusters or {}).get("successful",
+                                             len(remotes)) + n_local,
+          "skipped": (clusters or {}).get("skipped", 0)}
     responses = ([local] if local else []) + remotes
     if not responses:
-        return {"hits": {"total": {"value": 0, "relation": "eq"},
+        return {"took": 0, "timed_out": False,
+                "_shards": {"total": 0, "successful": 0, "skipped": 0,
+                            "failed": 0},
+                "_clusters": cl,
+                "hits": {"total": {"value": 0, "relation": "eq"},
                          "hits": [], "max_score": None}}
     if len(responses) == 1:
-        return responses[0]
+        if not remotes and clusters is None:
+            return responses[0]  # pure local: not a CCS response at all
+        # a lone response passes through VERBATIM (suggest, profile,
+        # _scroll_id, real timed_out all survive) with the cluster
+        # accounting attached
+        out = dict(responses[0])
+        out["_clusters"] = cl
+        return out
     size = int((body or {}).get("size", 10))
     all_hits = []
     total = 0
@@ -131,8 +203,7 @@ def merge_ccs_responses(local: Optional[dict], remotes: List[dict],
                     "successful": sum(r.get("_shards", {}).get("successful", 0)
                                       for r in responses),
                     "skipped": 0, "failed": 0},
-        "_clusters": {"total": len(responses), "successful": len(responses),
-                      "skipped": 0},
+        "_clusters": cl,
         "hits": {"total": {"value": total, "relation": relation},
                  "max_score": max_score, "hits": all_hits},
     }
@@ -163,13 +234,13 @@ class CcrService:
         if not remote or not leader:
             raise IllegalArgumentError(
                 "follow requires [remote_cluster] and [leader_index]")
-        leader_node = self.node.remotes.get(remote)
-        leader_svc = leader_node.indices.get(leader)
+        remote_cluster = self.node.remotes.get(remote)
+        leader_mappings = remote_cluster.get_mappings(leader)
         if not self.node.indices.exists(follower_index):
             self.node.indices.create_index(
                 follower_index,
                 settings=body.get("settings"),
-                mappings=leader_svc.mapper_service.to_dict())
+                mappings=leader_mappings)
         self.followers[follower_index] = {
             "remote_cluster": remote, "leader_index": leader,
             "status": "active", "checkpoint": -1,
@@ -201,32 +272,23 @@ class CcrService:
 
     # -- replication ----------------------------------------------------------
     def poll(self, follower_index: str) -> dict:
-        """One change-tailing round (reference: ShardChangesAction request
-        above the follower checkpoint + applying ops via the follow task)."""
+        """One change-tailing round: a ShardChanges request above the
+        follower checkpoint over the remote adapter (the wire RPC in
+        production, an in-process scan for test clusters), then ops
+        applied locally via the follow task (`ShardChangesAction.java:59`
+        request/response + ShardFollowNodeTask apply)."""
         cfg = self._follower(follower_index)
         if cfg["status"] != "active":
             return {"operations": 0}
-        leader_node = self.node.remotes.get(cfg["remote_cluster"])
-        leader_svc = leader_node.indices.get(cfg["leader_index"])
-        leader_svc.refresh()
-        reader = leader_svc.combined_reader()
+        remote_cluster = self.node.remotes.get(cfg["remote_cluster"])
+        changes = remote_cluster.shard_changes(cfg["leader_index"],
+                                               cfg["checkpoint"])
         ops = 0
-        leader_live_ids = set()
-        max_seq = cfg["checkpoint"]
-        for view in reader.views:
-            seg = view.segment
-            for local in range(seg.num_docs):
-                if not view.live[local]:
-                    continue
-                leader_live_ids.add(seg.ids[local])
-                seq = int(seg.seq_nos[local])
-                if seq <= cfg["checkpoint"]:
-                    continue
-                self.node.index_doc(follower_index, seg.ids[local],
-                                    seg.sources[local])
-                ops += 1
-                max_seq = max(max_seq, seq)
-        # deletes: anti-join follower ids against leader live set
+        for op in changes["operations"]:
+            self.node.index_doc(follower_index, op["id"], op["source"])
+            ops += 1
+        # deletes: anti-join follower ids against the leader live set
+        leader_live_ids = set(changes["live_ids"])
         follower_svc = self.node.indices.get(follower_index)
         follower_svc.refresh()
         freader = follower_svc.combined_reader()
@@ -239,17 +301,28 @@ class CcrService:
                     self.node.delete_doc(follower_index, seg.ids[local])
                     ops += 1
         follower_svc.refresh()
-        cfg["checkpoint"] = max_seq
+        cfg["checkpoint"] = max(cfg["checkpoint"],
+                                int(changes["max_seq_no"]))
         cfg["operations_written"] += ops
         cfg["last_poll"] = time.time()
         return {"operations": ops}
 
     def run_once(self) -> dict:
-        """Scheduler tick: poll all active followers + evaluate auto-follow."""
+        """Scheduler tick: poll all active followers + evaluate auto-follow.
+        Per-follower isolation: one unreachable leader cluster must not
+        starve the other followers (each ShardFollowNodeTask retries
+        independently in the reference)."""
         results = {}
         for name in list(self.followers):
-            if self.followers[name]["status"] == "active":
+            cfg = self.followers[name]
+            if cfg["status"] != "active":
+                continue
+            try:
                 results[name] = self.poll(name)["operations"]
+                cfg.pop("last_failure", None)
+            except Exception as e:  # noqa: BLE001 — retry next tick
+                cfg["last_failure"] = f"{type(e).__name__}: {e}"
+                results[name] = 0
         self._auto_follow_tick()
         return results
 
@@ -277,14 +350,13 @@ class CcrService:
         for pat_name, pat in self.auto_follow.items():
             remote = pat["remote_cluster"]
             try:
-                leader_node = self.node.remotes.get(remote)
-            except ResourceNotFoundError:
+                remote_cluster = self.node.remotes.get(remote)
+                leader_names = remote_cluster.list_indices(
+                    ",".join(pat["leader_index_patterns"]))
+            except Exception:  # noqa: BLE001 — unreachable remote: next tick
                 continue
             suffix = pat.get("follow_index_pattern", "{{leader_index}}")
-            for leader_name in list(leader_node.indices.indices):
-                if not any(fnmatch.fnmatchcase(leader_name, p)
-                           for p in pat["leader_index_patterns"]):
-                    continue
+            for leader_name in leader_names:
                 follower_name = suffix.replace("{{leader_index}}", leader_name)
                 if follower_name in self.followers or \
                         self.node.indices.exists(follower_name):

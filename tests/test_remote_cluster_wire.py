@@ -1,0 +1,170 @@
+"""Cross-cluster search + replication over the REAL binary transport.
+
+Two separately-booted server processes (distinct clusters, each binding
+HTTP + transport sockets); the local cluster connects sniff-mode via
+`cluster.remote.<alias>.seeds` and everything crosses actual TCP:
+
+- CCS merges local and remote hits (`RemoteClusterService.java`,
+  `SniffConnectionStrategy.java`, one-request-per-cluster like
+  `ccs_minimize_roundtrips`)
+- CCR followers converge by polling ShardChanges RPCs
+  (`ShardChangesAction.java:59`)
+- killing the remote degrades per `skip_unavailable`
+  (RemoteClusterService contract)
+- `_remote/info` reports the truth (mode sniff, seeds, connectivity)
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(method, url, body=None, timeout=15):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_up(port, deadline_s=90):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            _req("GET", f"http://127.0.0.1:{port}/")
+            return
+        except Exception:
+            time.sleep(0.5)
+    raise AssertionError(f"server on {port} never came up")
+
+
+@pytest.fixture(scope="module")
+def two_clusters(tmp_path_factory):
+    """local + east: one server process each, transports bound."""
+    tmp = tmp_path_factory.mktemp("wire_ccs")
+    http_ports = _free_ports(2)
+    tp_ports = _free_ports(2)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    for i, name in enumerate(["local", "east"]):
+        cmd = [sys.executable, "-m", "elasticsearch_tpu.server",
+               "--port", str(http_ports[i]), "--name", f"{name}-0",
+               "--cluster-name", name,
+               "--data", str(tmp / name),
+               "-E", f"transport.port={tp_ports[i]}"]
+        procs.append(subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=open(tmp / f"{name}.log", "w"), stderr=subprocess.STDOUT))
+    for p in http_ports:
+        _wait_up(p)
+    yield http_ports, tp_ports, procs, tmp
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_ccs_ccr_over_the_wire(two_clusters):
+    http_ports, tp_ports, procs, tmp = two_clusters
+    local, east = (f"http://127.0.0.1:{p}" for p in http_ports)
+
+    # --- seed data on both clusters -------------------------------------
+    _req("PUT", f"{east}/logs/_doc/r1",
+         {"msg": "hello from east", "n": 1})
+    _req("PUT", f"{east}/logs/_doc/r2", {"msg": "east only", "n": 2})
+    _req("POST", f"{east}/logs/_refresh")
+    _req("PUT", f"{local}/logs/_doc/l1",
+         {"msg": "hello from local", "n": 3})
+    _req("POST", f"{local}/logs/_refresh")
+
+    # --- register the remote via cluster settings (sniff seeds) ---------
+    _req("PUT", f"{local}/_cluster/settings", {"persistent": {
+        "cluster.remote.east.seeds": [f"127.0.0.1:{tp_ports[1]}"],
+        "cluster.remote.east.skip_unavailable": "true"}})
+
+    # --- CCS: pure-remote then mixed merge ------------------------------
+    r = _req("POST", f"{local}/east:logs/_search",
+             {"query": {"match": {"msg": "east"}}})
+    assert r["hits"]["total"]["value"] == 2
+    assert all(h["_index"] == "east:logs" for h in r["hits"]["hits"])
+
+    r = _req("POST", f"{local}/logs,east:logs/_search",
+             {"query": {"match": {"msg": "hello"}}})
+    assert r["hits"]["total"]["value"] == 2
+    assert {h["_index"] for h in r["hits"]["hits"]} == {"logs", "east:logs"}
+    assert r["_clusters"] == {"total": 2, "successful": 2, "skipped": 0}
+
+    # --- _remote/info reports the truth ---------------------------------
+    info = _req("GET", f"{local}/_remote/info")
+    assert info["east"]["connected"] is True
+    assert info["east"]["mode"] == "sniff"
+    assert info["east"]["seeds"] == [f"127.0.0.1:{tp_ports[1]}"]
+    assert info["east"]["num_nodes_connected"] == 1
+    assert info["east"]["skip_unavailable"] is True
+
+    # --- CCR: follow, converge, tail new ops, deletes -------------------
+    r = _req("PUT", f"{local}/logs_copy/_ccr/follow",
+             {"remote_cluster": "east", "leader_index": "logs"})
+    assert r["follow_index_created"] is True
+    _req("POST", f"{local}/logs_copy/_refresh")
+    r = _req("POST", f"{local}/logs_copy/_search", {})
+    assert r["hits"]["total"]["value"] == 2
+
+    _req("PUT", f"{east}/logs/_doc/r3", {"msg": "late arrival", "n": 9})
+    _req("DELETE", f"{east}/logs/_doc/r2")
+    _req("POST", f"{east}/logs/_refresh")
+    _req("POST", f"{local}/_ccr/_tick")  # scheduler tick
+    _req("POST", f"{local}/logs_copy/_refresh")
+    r = _req("POST", f"{local}/logs_copy/_search", {"size": 10})
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert ids == {"r1", "r3"}
+
+    stats = _req("GET", f"{local}/_ccr/stats")
+    shard = stats["follow_stats"]["indices"][0]["shards"][0]
+    assert shard["remote_cluster"] == "east"
+    assert shard["follower_global_checkpoint"] >= 2
+
+    # --- kill the remote: skip_unavailable degrades gracefully ----------
+    procs[1].send_signal(signal.SIGTERM)
+    procs[1].wait(timeout=10)
+    r = _req("POST", f"{local}/logs,east:logs/_search",
+             {"query": {"match": {"msg": "hello"}}})
+    assert r["hits"]["total"]["value"] == 1  # local hit only
+    assert r["_clusters"]["skipped"] == 1
+    assert r["_clusters"]["successful"] == 1
+
+    info = _req("GET", f"{local}/_remote/info")
+    assert info["east"]["connected"] is False
+
+    # --- without skip_unavailable the search fails ----------------------
+    _req("PUT", f"{local}/_cluster/settings", {"persistent": {
+        "cluster.remote.east.skip_unavailable": "false"}})
+    with pytest.raises(urllib.error.HTTPError):
+        _req("POST", f"{local}/logs,east:logs/_search",
+             {"query": {"match": {"msg": "hello"}}})
