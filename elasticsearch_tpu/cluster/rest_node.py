@@ -924,6 +924,22 @@ class ClusterAwareNode(Node):
                ignore_unavailable: bool = False,
                allow_no_indices: bool = True,
                expand_wildcards: Optional[str] = None) -> dict:
+        if index_expr and ":" in index_expr:
+            # cross-cluster search from a clustered coordinator: split
+            # `alias:index` parts, one wire request per remote cluster,
+            # local part through the distributed scatter below
+            # (TransportSearchAction + SearchResponseMerger)
+            from elasticsearch_tpu.xpack.ccr import merge_ccs_responses
+            local_expr, remote_exprs = self.remotes.split_indices(index_expr)
+            remote_resps, clusters = self.remotes.search_remotes(
+                remote_exprs, dict(body or {}))
+            local_resp = self.search(
+                local_expr, body, ignore_throttled=ignore_throttled,
+                ignore_unavailable=ignore_unavailable,
+                allow_no_indices=allow_no_indices,
+                expand_wildcards=expand_wildcards) if local_expr else None
+            return merge_ccs_responses(local_resp, remote_resps, body,
+                                       clusters)
         if not allow_no_indices and index_expr and "*" in index_expr:
             # IndicesOptions.allowNoIndices=false: an unmatched wildcard is
             # an error at the coordinator, before the scatter

@@ -118,12 +118,16 @@ def _doc_url_params(req: RestRequest) -> Tuple[str, Optional[str]]:
 
 
 def register_cluster_overrides(rc: RestController,
-                               adapter: ClusterRestAdapter) -> None:
+                               adapter: ClusterRestAdapter,
+                               aware=None) -> None:
     """Cluster-authoritative routes layered OVER the full single-node
     surface (`register_all`): a ClusterAwareNode serves every feature
     through its overridden data path, while these endpoints — the ones
     whose truth lives in the cluster state — dispatch to the master/
-    coordination layer directly. Registration order matters: last wins."""
+    coordination layer directly. Registration order matters: last wins.
+
+    `aware`: the ClusterAwareNode whose node-local services (remote
+    clusters) react to dynamic settings."""
     node = adapter.node
 
     def root(req):
@@ -178,9 +182,17 @@ def register_cluster_overrides(rc: RestController,
 
     def update_settings(req):
         body = req.json() or {}
-        result = adapter.call(node.client_update_settings,
-                              dict(body.get("persistent") or {},
-                                   **(body.get("transient") or {})))
+        merged = dict(body.get("persistent") or {},
+                      **(body.get("transient") or {}))
+        result = adapter.call(node.client_update_settings, merged)
+        # dynamic remote-cluster reconfiguration on the serving node
+        # (RemoteClusterService.listenForUpdates) — same hook as the
+        # single-node handler in actions_admin.py
+        if aware is not None:
+            from elasticsearch_tpu.rest.actions_admin import _flatten
+            flat = _flatten(merged)
+            if any(k.startswith("cluster.remote.") for k in flat):
+                aware.remotes.apply_settings(flat)
         return 200, {"acknowledged": bool(result.get("acknowledged")),
                      "persistent": result.get("persistent", {}),
                      "transient": {}}

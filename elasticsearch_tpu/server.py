@@ -241,7 +241,7 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
             settings=settings)
         register_all(controller, aware)
         adapter = ClusterRestAdapter(cluster_node, loop)
-        register_cluster_overrides(controller, adapter)
+        register_cluster_overrides(controller, adapter, aware=aware)
         # remote-cluster (CCS/CCR) server actions ride the same transport
         # the cluster uses internally (reference: one 9300 endpoint)
         from elasticsearch_tpu.xpack.remote_cluster import (

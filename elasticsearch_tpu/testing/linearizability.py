@@ -1,0 +1,255 @@
+"""History-based linearizability checking (Wing & Gong graph search).
+
+Redesign of the reference's crown-jewel harness
+(`test/framework/src/main/java/org/elasticsearch/cluster/coordination/
+LinearizabilityChecker.java:63`), following the same sources: Gavin Lowe,
+"Testing for linearizability" (CCPE 29(4), 2017) and Horn & Kroening,
+"Faster linearizability checking via P-compositionality" (FORTE 2015).
+
+A `History` records client-visible INVOCATION/RESPONSE event pairs from a
+concurrent run; `is_linearizable` searches for a total order of the
+operations that (a) respects real-time precedence (an op that responded
+before another was invoked must linearize first) and (b) steps a
+`SequentialSpec` through valid transitions. Unlike invariant checks over
+internal state, this catches client-observable anomalies — e.g. a stale
+read served during a partition — which is exactly what S1/S2-style
+assertions cannot see.
+
+The linearized prefix travels as an int bitmask and the memoization cache
+is a set of (state, mask) pairs — the P-compositionality partitioning
+(KeyedSpec) keeps each sub-history's search space small.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+INVOCATION = "invocation"
+RESPONSE = "response"
+
+
+class TimedOut:
+    """Sentinel response for operations that never responded (the history
+    completion marker; specs decide what a timed-out op may have done)."""
+
+    _instance: Optional["TimedOut"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<timed-out>"
+
+
+TIMED_OUT = TimedOut()
+
+
+class SequentialSpec:
+    """Sequential datatype specification. States must be hashable."""
+
+    def initial_state(self) -> Any:
+        raise NotImplementedError
+
+    def next_state(self, state: Any, inp: Any, out: Any) -> Optional[Any]:
+        """The successor state if (state, inp, out) is a valid transition,
+        else None."""
+        raise NotImplementedError
+
+    def partition(self, events: List[tuple]) -> List[List[tuple]]:
+        return [events]
+
+
+class KeyedSpec(SequentialSpec):
+    """Spec with keyed access: the history partitions per key
+    (P-compositionality), and `next_state` sees the key-less value."""
+
+    def get_key(self, inp: Any) -> Any:
+        raise NotImplementedError
+
+    def get_value(self, inp: Any) -> Any:
+        raise NotImplementedError
+
+    def partition(self, events: List[tuple]) -> List[List[tuple]]:
+        keyed: Dict[Any, List[tuple]] = {}
+        matches: Dict[int, Any] = {}
+        for etype, value, eid in events:
+            if etype == INVOCATION:
+                key = self.get_key(value)
+                keyed.setdefault(key, []).append(
+                    (etype, self.get_value(value), eid))
+                matches[eid] = key
+            else:
+                keyed[matches[eid]].append((etype, value, eid))
+        return list(keyed.values())
+
+
+class History:
+    """Recorded sequence of invocation/response events."""
+
+    def __init__(self, events: Optional[List[tuple]] = None):
+        self.events: List[tuple] = list(events or [])
+        self._next_id = max((e[2] for e in self.events), default=-1) + 1
+
+    def invoke(self, inp: Any) -> int:
+        eid = self._next_id
+        self._next_id += 1
+        self.events.append((INVOCATION, inp, eid))
+        return eid
+
+    def respond(self, eid: int, out: Any) -> None:
+        self.events.append((RESPONSE, out, eid))
+
+    def remove(self, eid: int) -> None:
+        """Drop an operation that provably never reached the system."""
+        self.events = [e for e in self.events if e[2] != eid]
+
+    def complete(self, generator: Callable[[Any], Any]) -> None:
+        """Append responses for every uncompleted invocation (at the END of
+        the history: a timed-out op may linearize at any point up to it)."""
+        open_invocations: Dict[int, Any] = {}
+        for etype, value, eid in self.events:
+            if etype == INVOCATION:
+                open_invocations[eid] = value
+            else:
+                if eid not in open_invocations:
+                    raise ValueError(f"response without invocation: {eid}")
+                del open_invocations[eid]
+        for eid, inp in open_invocations.items():
+            self.events.append((RESPONSE, generator(inp), eid))
+
+    def clone(self) -> "History":
+        return History(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+    def __repr__(self):
+        return f"History({self.events!r})"
+
+
+class _Entry:
+    __slots__ = ("value", "match", "bit", "prev", "next")
+
+    def __init__(self, value, match, bit):
+        self.value = value
+        self.match = match  # the response entry (None for responses)
+        self.bit = bit      # contiguous internal id for the bitmask
+        self.prev: Optional[_Entry] = None
+        self.next: Optional[_Entry] = None
+
+    def lift(self) -> None:
+        """Unlink this invocation AND its response from the list."""
+        self.prev.next = self.next
+        if self.next is not None:
+            self.next.prev = self.prev
+        m = self.match
+        m.prev.next = m.next
+        if m.next is not None:
+            m.next.prev = m.prev
+
+    def unlift(self) -> None:
+        m = self.match
+        m.prev.next = m
+        if m.next is not None:
+            m.next.prev = m
+        self.prev.next = self
+        if self.next is not None:
+            self.next.prev = self
+
+
+def _linked_entries(events: List[tuple]) -> _Entry:
+    """history order -> doubly linked entries with a head sentinel;
+    invocations carry a pointer to their response and a contiguous bit."""
+    if len(events) % 2 != 0:
+        raise ValueError("mismatched invocations and responses")
+    matches: Dict[int, _Entry] = {}
+    entries: List[_Entry] = [None] * len(events)  # type: ignore[list-item]
+    next_bit = len(events) // 2 - 1
+    for i in range(len(events) - 1, -1, -1):
+        etype, value, eid = events[i]
+        if etype == RESPONSE:
+            if eid in matches:
+                raise ValueError(f"duplicate response id {eid}")
+            entries[i] = matches[eid] = _Entry(value, None, next_bit)
+            next_bit -= 1
+        else:
+            resp = matches.get(eid)
+            if resp is None:
+                raise ValueError(f"no response for invocation {eid}")
+            entries[i] = _Entry(value, resp, resp.bit)
+    head = _Entry(None, None, -1)
+    last = head
+    for e in entries:
+        last.next = e
+        e.prev = last
+        last = e
+    return head
+
+
+def _partition_linearizable(spec: SequentialSpec,
+                            events: List[tuple]) -> bool:
+    state = spec.initial_state()
+    linearized = 0                       # bitmask of linearized ops
+    cache = {(state, 0)}                 # explored (state, prefix) pairs
+    stack: List[Tuple[_Entry, Any]] = []
+    head = _linked_entries(events)
+    entry = head.next
+    while head.next is not None:
+        if entry.match is not None:
+            # an invocation whose response is still pending: try to
+            # linearize it here
+            next_state = spec.next_state(state, entry.value,
+                                         entry.match.value)
+            explore = False
+            if next_state is not None:
+                key = (next_state, linearized | (1 << entry.bit))
+                if key not in cache:
+                    cache.add(key)
+                    explore = True
+            if explore:
+                stack.append((entry, state))
+                state = next_state
+                linearized |= 1 << entry.bit
+                entry.lift()
+                entry = head.next
+            else:
+                entry = entry.next
+        else:
+            # hit a response barrier: every pending op before it failed to
+            # linearize — backtrack
+            if not stack:
+                return False
+            entry, state = stack.pop()
+            linearized &= ~(1 << entry.bit)
+            entry.unlift()
+            entry = entry.next
+    return True
+
+
+def is_linearizable(spec: SequentialSpec, history: History,
+                    missing_response_generator: Callable[[Any], Any]
+                    = lambda inp: TIMED_OUT) -> bool:
+    """True iff `history` is linearizable w.r.t. `spec`."""
+    h = history.clone()
+    h.complete(missing_response_generator)
+    return all(_partition_linearizable(spec, part)
+               for part in spec.partition(h.events))
+
+
+def visualize(history: History) -> str:
+    """Concurrency diagram of a (complete) history for failure messages."""
+    pos = {(etype, eid): i
+           for i, (etype, _v, eid) in enumerate(history.events)}
+    lines = []
+    for etype, value, eid in history.events:
+        if etype != INVOCATION:
+            continue
+        begin = pos[(INVOCATION, eid)]
+        end = pos.get((RESPONSE, eid), len(history.events))
+        resp = next((v for t, v, i in history.events
+                     if t == RESPONSE and i == eid), TIMED_OUT)
+        lines.append(" " * begin + "X" * max(end - begin, 1)
+                     + f"  {value!r} -> {resp!r}  ({eid})")
+    return "\n".join(lines)

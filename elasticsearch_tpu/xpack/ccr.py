@@ -19,7 +19,6 @@ identical over either.
 
 from __future__ import annotations
 
-import fnmatch
 import time
 from typing import Any, Dict, List, Optional, Tuple
 

@@ -326,7 +326,20 @@ class WireRemote:
             raise e from err
 
     def _call(self, action: str, request: Any):
-        return self._run(self._call_async(action, request))
+        # bound the WHOLE retry ladder (gateway failover + re-sniff) by one
+        # deadline: wait_for cancels the coroutine on expiry, so nothing
+        # keeps running on the shared loop, and the caller always sees a
+        # typed connect error instead of a bare concurrent TimeoutError
+        async def bounded():
+            try:
+                return await asyncio.wait_for(
+                    self._call_async(action, request), self.rpc_timeout_s)
+            except asyncio.TimeoutError:
+                self.connected = False
+                raise _ConnErr(
+                    f"remote cluster [{self.alias}] did not answer "
+                    f"[{action}] within {self.rpc_timeout_s}s") from None
+        return self._run(bounded())
 
     # ------------------------------------------------------------ interface
     def ping(self) -> bool:

@@ -1,0 +1,127 @@
+"""The Wing & Gong linearizability checker itself (reference:
+LinearizabilityCheckerTests.java): known-linearizable histories accepted,
+known-violations rejected — most importantly a stale read served during a
+partition, the anomaly invariant-based checks cannot see."""
+
+import pytest
+
+from elasticsearch_tpu.testing.linearizability import (
+    TIMED_OUT, History, KeyedSpec, SequentialSpec, is_linearizable,
+    visualize,
+)
+
+
+class RegisterSpec(SequentialSpec):
+    """Integer register with write-returns-previous-value semantics (the
+    reference harness's spec shape, AbstractCoordinatorTestCase:1459):
+    a timed-out write is assumed applied; a timed-out read is a no-op."""
+
+    def initial_state(self):
+        return 0
+
+    def next_state(self, state, inp, out):
+        kind, val = inp
+        if kind == "w":
+            if out is TIMED_OUT or out == state:
+                return val
+            return None
+        if out is TIMED_OUT or out == state:
+            return state
+        return None
+
+
+class KeyedRegisterSpec(KeyedSpec, RegisterSpec):
+    def get_key(self, inp):
+        return inp[0]
+
+    def get_value(self, inp):
+        return inp[1]
+
+
+def test_sequential_history_linearizable():
+    h = History()
+    w = h.invoke(("w", 7))
+    h.respond(w, 0)
+    r = h.invoke(("r", None))
+    h.respond(r, 7)
+    assert is_linearizable(RegisterSpec(), h)
+
+
+def test_concurrent_overlap_linearizable():
+    """read overlapping a write may see either old or new value."""
+    for seen in (0, 42):
+        h = History()
+        w = h.invoke(("w", 42))
+        r = h.invoke(("r", None))
+        h.respond(r, seen)
+        h.respond(w, 0)
+        assert is_linearizable(RegisterSpec(), h), f"seen={seen}"
+
+
+def test_stale_read_rejected():
+    """THE target anomaly: a client writes 42 and gets the ack; a later,
+    non-overlapping read returns the old value 0 (e.g. served by a deposed
+    leader during a partition). No linearization order explains it."""
+    h = History()
+    w = h.invoke(("w", 42))
+    h.respond(w, 0)           # write fully acknowledged...
+    r = h.invoke(("r", None))
+    h.respond(r, 0)           # ...yet a LATER read misses it
+    assert not is_linearizable(RegisterSpec(), h), visualize(h)
+
+
+def test_write_cycle_rejected():
+    """Two acked writes each claiming the other's value as previous state
+    form a cycle — impossible sequentially."""
+    h = History()
+    a = h.invoke(("w", 1))
+    b = h.invoke(("w", 2))
+    h.respond(a, 2)
+    h.respond(b, 1)
+    assert not is_linearizable(RegisterSpec(), h)
+
+
+def test_timed_out_write_may_or_may_not_apply():
+    """An unacked write completes as TIMED_OUT and may linearize last —
+    a read seeing the OLD value afterwards is still linearizable."""
+    h = History()
+    h.invoke(("w", 9))        # never responds
+    r = h.invoke(("r", None))
+    h.respond(r, 0)
+    assert is_linearizable(RegisterSpec(), h)
+
+
+def test_keyed_partitioning():
+    """Per-key sub-histories check independently: a violation on one key
+    is found even when another key's history is fine."""
+    h = History()
+    w1 = h.invoke(("a", ("w", 1)))
+    h.respond(w1, 0)
+    r1 = h.invoke(("a", ("r", None)))
+    h.respond(r1, 1)
+    w2 = h.invoke(("b", ("w", 5)))
+    h.respond(w2, 0)
+    r2 = h.invoke(("b", ("r", None)))
+    h.respond(r2, 0)          # stale read on key b
+    spec = KeyedRegisterSpec()
+    assert not is_linearizable(spec, h)
+    h2 = History([e for e in h.events if e[2] != r2])
+    r3 = h2.invoke(("b", ("r", None)))
+    h2.respond(r3, 5)
+    assert is_linearizable(spec, h2)
+
+
+def test_remove_drops_definite_failures():
+    h = History()
+    w = h.invoke(("w", 3))
+    h.remove(w)               # op provably never reached the system
+    r = h.invoke(("r", None))
+    h.respond(r, 0)
+    assert is_linearizable(RegisterSpec(), h)
+
+
+def test_malformed_history_raises():
+    h = History()
+    h.events.append(("response", 1, 99))
+    with pytest.raises(ValueError):
+        is_linearizable(RegisterSpec(), h)

@@ -168,3 +168,80 @@ def test_ccs_ccr_over_the_wire(two_clusters):
     with pytest.raises(urllib.error.HTTPError):
         _req("POST", f"{local}/logs,east:logs/_search",
              {"query": {"match": {"msg": "hello"}}})
+
+
+def test_ccs_from_clustered_deployment(tmp_path_factory=None, tmp_path=None):
+    """CCS from a CLUSTERED local deployment (2 coordinated processes) to
+    a remote single-node cluster: remote settings applied dynamically via
+    the cluster-authoritative PUT /_cluster/settings override, searches
+    merged over the wire."""
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="wire_ccs_clustered")
+    http_ports = _free_ports(3)
+    tp_ports = _free_ports(3)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    seeds = ",".join(f"127.0.0.1:{p}" for p in tp_ports[:2])
+    procs = []
+    # 2-node clustered "local"
+    for i in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "elasticsearch_tpu.server",
+             "--port", str(http_ports[i]), "--name", f"n{i}",
+             "--cluster-name", "local",
+             "--data", os.path.join(tmp, f"n{i}"),
+             "-E", f"transport.port={tp_ports[i]}",
+             "-E", f"discovery.seed_hosts={seeds}",
+             "-E", "cluster.initial_master_nodes=n0,n1"],
+            cwd=REPO, env=env,
+            stdout=open(os.path.join(tmp, f"n{i}.log"), "w"),
+            stderr=subprocess.STDOUT))
+    # single-node "east"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu.server",
+         "--port", str(http_ports[2]), "--name", "east-0",
+         "--cluster-name", "east",
+         "--data", os.path.join(tmp, "east"),
+         "-E", f"transport.port={tp_ports[2]}"],
+        cwd=REPO, env=env,
+        stdout=open(os.path.join(tmp, "east.log"), "w"),
+        stderr=subprocess.STDOUT))
+    try:
+        for p in http_ports:
+            _wait_up(p)
+        local = f"http://127.0.0.1:{http_ports[0]}"
+        east = f"http://127.0.0.1:{http_ports[2]}"
+        # wait for the 2-node cluster to form
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                h = _req("GET", f"{local}/_cluster/health")
+                if h.get("number_of_nodes") == 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+        _req("PUT", f"{east}/logs/_doc/1", {"msg": "east doc"})
+        _req("POST", f"{east}/logs/_refresh")
+        _req("PUT", f"{local}/logs/_doc/1", {"msg": "local doc"})
+        _req("POST", f"{local}/logs/_refresh")
+
+        _req("PUT", f"{local}/_cluster/settings", {"persistent": {
+            "cluster.remote.east.seeds": [f"127.0.0.1:{tp_ports[2]}"]}})
+        info = _req("GET", f"{local}/_remote/info")
+        assert "east" in info and info["east"]["mode"] == "sniff"
+
+        r = _req("POST", f"{local}/logs,east:logs/_search",
+                 {"query": {"match": {"msg": "doc"}}})
+        assert r["hits"]["total"]["value"] == 2
+        assert {h["_index"] for h in r["hits"]["hits"]} \
+            == {"logs", "east:logs"}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
