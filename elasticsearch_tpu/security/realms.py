@@ -168,6 +168,10 @@ def build_realm_chain(settings, store, data_path: str) -> List[Realm]:
                 order=order))
         elif rtype == "native":
             realms.append(NativeRealm(rname, store, order=order))
+        elif rtype == "kerberos":
+            realms.append(KerberosRealm(
+                rname, order=order,
+                keytab_path=conf.get("keytab.path")))
         # ldap/pki/saml/oidc configs are accepted but unsupported in this
         # environment (no egress); they simply never authenticate
     if not any(r.type_name == "file" for r in realms) \
@@ -177,4 +181,57 @@ def build_realm_chain(settings, store, data_path: str) -> List[Realm]:
     if not any(r.type_name == "native" for r in realms):
         realms.append(NativeRealm("default_native", store, order=100))
     realms.sort(key=lambda r: r.order)
+    # Kerberos principals resolve roles via delegated lookup in the other
+    # realms (the reference's authorization_realms delegation)
+    for r in realms:
+        if isinstance(r, KerberosRealm):
+            r.lookup_realms = [o for o in realms if o is not r]
     return realms
+
+
+class KerberosRealm(Realm):
+    """Kerberos realm slot (reference: the `kerberos` entry in
+    `InternalRealms.java` + `KerberosRealm.java`): authenticates
+    `Authorization: Negotiate <base64 ticket>` headers.
+
+    Real GSS/SPNEGO needs a KDC and a keytab — unavailable here (no
+    egress), so ticket validation is pluggable: `ticket_validator(ticket
+    bytes) -> principal str or None`. Deployments inject a real validator;
+    tests inject a stub. Without one the realm never authenticates, the
+    same posture as the unconfigured ldap/saml/oidc slots. Principals map
+    to roles through delegated lookup in the other realms (the reference's
+    authorization_realms delegation), falling back to role-mapping-less
+    empty roles."""
+
+    type_name = "kerberos"
+
+    def __init__(self, name: str, order: int = 0, keytab_path=None,
+                 ticket_validator=None, lookup_realms=()):
+        super().__init__(name, order)
+        self.keytab_path = keytab_path
+        self.ticket_validator = ticket_validator
+        self.lookup_realms = list(lookup_realms)
+
+    def authenticate(self, username: str, password: str):
+        return None  # Kerberos never does username/password
+
+    def authenticate_ticket(self, ticket: bytes):
+        """dict {username, roles} for a valid service ticket, else None."""
+        if self.ticket_validator is None:
+            return None
+        principal = self.ticket_validator(ticket)
+        if not principal:
+            return None
+        # user@REALM -> user, like the reference's remove_realm_name
+        username = str(principal).partition("@")[0]
+        for realm in self.lookup_realms:
+            user = realm.lookup(username)
+            if user is not None:
+                # a DISABLED user must not slip in through a valid ticket
+                # (the Kerberos path bypasses password checks, not the
+                # account state)
+                if not user.get("enabled", True):
+                    return None
+                return {"username": username,
+                        "roles": user.get("roles", [])}
+        return {"username": username, "roles": []}

@@ -176,3 +176,20 @@ def register_security(rc: RestController, node) -> None:
     rc.register("POST", "/_security/api_key", create_api_key)
     rc.register("GET", "/_security/api_key", get_api_key)
     rc.register("DELETE", "/_security/api_key", invalidate_api_key)
+
+    # ------------------------------------------------- OAuth2 token service
+    def create_token(req):
+        return 200, svc.tokens.grant(
+            req.json() or {}, svc,
+            authentication=req.context.get("authentication"))
+
+    def invalidate_token(req):
+        body = req.json() or {}
+        out = svc.tokens.invalidate(token=body.get("token"),
+                                    refresh_token=body.get("refresh_token"),
+                                    username=body.get("username"),
+                                    realm=body.get("realm_name"))
+        return 200, out
+
+    rc.register("POST", "/_security/oauth2/token", create_token)
+    rc.register("DELETE", "/_security/oauth2/token", invalidate_token)

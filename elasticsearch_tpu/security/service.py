@@ -45,12 +45,14 @@ class Authentication:
     """The authenticated principal + its resolved roles."""
 
     def __init__(self, username: str, roles: List[dict], role_names: List[str],
-                 auth_type: str = "realm", api_key_id: Optional[str] = None):
+                 auth_type: str = "realm", api_key_id: Optional[str] = None,
+                 realm: Optional[str] = None):
         self.username = username
         self.roles = roles
         self.role_names = role_names
         self.auth_type = auth_type
         self.api_key_id = api_key_id
+        self.realm = realm  # name of the realm that authenticated, if any
 
     @property
     def is_superuser(self) -> bool:
@@ -71,6 +73,9 @@ class SecurityService:
             else [NativeRealm("default_native", store)]
         # xpack.security.authc.anonymous.roles (AnonymousUser)
         self.anonymous_roles = anonymous_roles or []
+        # OAuth2 token service (TokenService.java): Bearer auth + refresh
+        from elasticsearch_tpu.security.tokens import TokenService
+        self.tokens = TokenService(store)
         # reserved superuser, like the `elastic` user bootstrapped from the
         # keystore (`ReservedRealm.java`)
         if "elastic" not in store.users:
@@ -174,7 +179,41 @@ class SecurityService:
             roles = self.store.resolve_roles(user["roles"])
             self._audit("authentication_success", user=username,
                         realm=realm_name)
-            return Authentication(username, roles, user["roles"])
+            return Authentication(username, roles, user["roles"],
+                                  realm=realm_name)
+        if header.startswith("Bearer "):
+            rec = self.tokens.authenticate_bearer(header[7:].strip())
+            if rec is None:
+                self._audit("authentication_failed", token="bearer")
+                raise AuthenticationError(
+                    "unable to authenticate with provided token")
+            roles = self.store.resolve_roles(rec["roles"])
+            self._audit("authentication_success", user=rec["username"],
+                        realm="token")
+            return Authentication(rec["username"], roles, rec["roles"],
+                                  auth_type="token")
+        if header.startswith("Negotiate "):
+            try:
+                ticket = base64.b64decode(header[10:].strip())
+            except Exception:
+                raise AuthenticationError(
+                    "failed to decode negotiate authentication header")
+            for realm in self.realms:
+                validate = getattr(realm, "authenticate_ticket", None)
+                if validate is None:
+                    continue
+                user = validate(ticket)
+                if user is not None:
+                    roles = self.store.resolve_roles(user["roles"])
+                    self._audit("authentication_success",
+                                user=user["username"], realm=realm.name)
+                    return Authentication(user["username"], roles,
+                                          user["roles"],
+                                          auth_type="kerberos",
+                                          realm=realm.name)
+            self._audit("authentication_failed", token="negotiate")
+            raise AuthenticationError(
+                "unable to authenticate user with negotiate header")
         if header.startswith("ApiKey "):
             try:
                 decoded = base64.b64decode(header[7:]).decode()

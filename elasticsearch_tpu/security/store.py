@@ -73,12 +73,14 @@ class SecurityStore:
         self.users: Dict[str, dict] = {}
         self.roles: Dict[str, dict] = {}
         self.api_keys: Dict[str, dict] = {}
+        self.tokens: Dict[str, dict] = {}  # TokenService records (hashed)
         if path and os.path.exists(path):
             with open(path) as f:
                 data = json.load(f)
             self.users = data.get("users", {})
             self.roles = data.get("roles", {})
             self.api_keys = data.get("api_keys", {})
+            self.tokens = data.get("tokens", {})
 
     def persist(self) -> None:
         if not self._path:
@@ -86,7 +88,7 @@ class SecurityStore:
         os.makedirs(os.path.dirname(self._path), exist_ok=True)
         with open(self._path, "w") as f:
             json.dump({"users": self.users, "roles": self.roles,
-                       "api_keys": self.api_keys}, f)
+                       "api_keys": self.api_keys, "tokens": self.tokens}, f)
 
     # -- users ---------------------------------------------------------------
     def put_user(self, username: str, body: dict) -> bool:

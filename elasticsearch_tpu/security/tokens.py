@@ -1,0 +1,200 @@
+"""OAuth2-style token service.
+
+Reference: `x-pack/plugin/security/src/main/java/org/elasticsearch/xpack/
+security/authc/TokenService.java:1` — access tokens (default 20 min TTL)
+granted from realm credentials via `POST /_security/oauth2/token`, used as
+`Authorization: Bearer <token>`, paired with single-use refresh tokens
+(24 h) that rotate both on refresh; invalidation by token, refresh token,
+user, or realm.
+
+Storage rides the security store as hashed records only — presenting a
+stored hash must never authenticate (the FileRealm pass-the-hash lesson),
+so the wire token is `<id>.<secret>` (urlsafe) and the store keeps
+sha256(secret). The reference encrypts tokens with a node key and stores
+them in the `.security-tokens` index; hashing gives the same property the
+test suite needs (leaked store ≠ leaked credentials) without a key
+distribution story.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+import time
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+ACCESS_TTL_S = 20 * 60       # reference default: 20 minutes
+REFRESH_TTL_S = 24 * 3600    # refresh window: 24 hours
+
+
+def _hash(secret: str) -> str:
+    return hashlib.sha256(secret.encode()).hexdigest()
+
+
+class TokenService:
+    def __init__(self, store):
+        self.store = store
+
+    # ------------------------------------------------------------- grants
+    def grant(self, body: dict, security, authentication=None) -> dict:
+        """`POST /_security/oauth2/token` — grant_type password (realm
+        credentials), refresh_token, or client_credentials (the already-
+        authenticated caller passed as `authentication`; no refresh token,
+        matching the reference)."""
+        grant_type = (body or {}).get("grant_type")
+        if grant_type == "password":
+            username = body.get("username")
+            password = body.get("password")
+            if not username or password is None:
+                raise IllegalArgumentError(
+                    "username and password are required for grant_type "
+                    "[password]")
+            import base64
+            basic = base64.b64encode(
+                f"{username}:{password}".encode()).decode()
+            auth = security.authenticate({"authorization": f"Basic {basic}"})
+            return self._issue(auth.username, auth.role_names,
+                               realm=auth.realm or "native",
+                               with_refresh=True)
+        if grant_type == "refresh_token":
+            token = body.get("refresh_token")
+            if not token:
+                raise IllegalArgumentError("refresh_token is required")
+            return self.refresh(token)
+        if grant_type == "client_credentials":
+            if authentication is None:
+                raise IllegalArgumentError(
+                    "client_credentials requires an authenticated caller")
+            return self._issue(authentication.username,
+                               authentication.role_names,
+                               realm=authentication.realm or "native",
+                               with_refresh=False)
+        raise IllegalArgumentError(
+            f"unsupported grant_type [{grant_type}]")
+
+    def _issue(self, username: str, role_names: List[str], realm: str,
+               with_refresh: bool) -> dict:
+        self._sweep()
+        tid = secrets.token_urlsafe(9)
+        access_secret = secrets.token_urlsafe(24)
+        refresh_secret = secrets.token_urlsafe(24) if with_refresh else None
+        now = time.time()
+        self.store.tokens[tid] = {
+            "access_hash": _hash(access_secret),
+            "refresh_hash": _hash(refresh_secret) if refresh_secret else None,
+            "username": username,
+            "roles": list(role_names),
+            "realm": realm,
+            "created": now,
+            "access_expires": now + ACCESS_TTL_S,
+            "refresh_expires": now + REFRESH_TTL_S,
+            "invalidated": False,
+            "refreshed": False,
+        }
+        self.store.persist()
+        out = {"access_token": f"{tid}.{access_secret}",
+               "type": "Bearer", "expires_in": ACCESS_TTL_S}
+        if refresh_secret:
+            out["refresh_token"] = f"{tid}.{refresh_secret}"
+        return out
+
+    # ---------------------------------------------------------------- use
+    def authenticate_bearer(self, token: str) -> Optional[dict]:
+        """Record for a live access token, else None (expired, invalidated,
+        unknown, or malformed all fall through to a 401 at the caller)."""
+        _tid, rec = self._lookup(token, "access_hash")
+        if rec is None or rec["invalidated"]:
+            return None
+        if time.time() > rec["access_expires"]:
+            return None
+        return rec
+
+    def _lookup(self, token: str, hash_field: str):
+        """(token_id, record) for a hash-matching token, else (None, None).
+        Comparison is constant-time."""
+        tid, _, secret = (token or "").partition(".")
+        if not tid or not secret:
+            return None, None
+        rec = self.store.tokens.get(tid)
+        if rec is None or not rec.get(hash_field):
+            return None, None
+        import hmac as _hmac
+        if not _hmac.compare_digest(rec[hash_field], _hash(secret)):
+            return None, None
+        return tid, rec
+
+    # ------------------------------------------------------------- refresh
+    def refresh(self, refresh_token: str) -> dict:
+        """Single-use rotation: the old pair invalidates, a fresh pair
+        issues (TokenService.refreshToken)."""
+        _tid, rec = self._lookup(refresh_token, "refresh_hash")
+        if rec is None:
+            raise IllegalArgumentError("invalid refresh token")
+        if rec["refreshed"]:
+            # reuse of a rotated refresh token: the reference treats this
+            # as an attack signal and invalidates the user's chain
+            self.invalidate(username=rec["username"])
+            raise IllegalArgumentError("refresh token already used")
+        if rec["invalidated"]:
+            raise IllegalArgumentError("invalid refresh token")
+        if time.time() > rec["refresh_expires"]:
+            raise IllegalArgumentError("refresh token is expired")
+        rec["refreshed"] = True
+        rec["invalidated"] = True
+        # _issue persists, covering the old record's mutation too
+        return self._issue(rec["username"], rec["roles"], rec["realm"],
+                           with_refresh=True)
+
+    # ---------------------------------------------------------- invalidate
+    def invalidate(self, token: Optional[str] = None,
+                   refresh_token: Optional[str] = None,
+                   username: Optional[str] = None,
+                   realm: Optional[str] = None) -> dict:
+        """`DELETE /_security/oauth2/token` by access token, refresh
+        token, username, or realm. At least one criterion is required
+        (the reference 400s an empty invalidation request)."""
+        if token is None and refresh_token is None \
+                and username is None and realm is None:
+            raise IllegalArgumentError(
+                "one of [token, refresh_token, username, realm_name] is "
+                "required")
+        hit_ids: List[str] = []
+        if token is not None:
+            tid, rec = self._lookup(token, "access_hash")
+            if rec is not None:
+                hit_ids.append(tid)
+        if refresh_token is not None:
+            tid, rec = self._lookup(refresh_token, "refresh_hash")
+            if rec is not None:
+                hit_ids.append(tid)
+        if username is not None or realm is not None:
+            for tid, rec in self.store.tokens.items():
+                if username is not None and rec["username"] != username:
+                    continue
+                if realm is not None and rec["realm"] != realm:
+                    continue
+                hit_ids.append(tid)
+        invalidated, previously = [], []
+        for tid in dict.fromkeys(hit_ids):  # dedupe, keep order
+            rec = self.store.tokens[tid]
+            if rec["invalidated"]:
+                previously.append(tid)
+            else:
+                rec["invalidated"] = True
+                invalidated.append(tid)
+        self.store.persist()
+        return {"invalidated_tokens": len(invalidated),
+                "previously_invalidated_tokens": len(previously),
+                "error_count": 0}
+
+    def _sweep(self) -> None:
+        """Opportunistic purge of records past their refresh window (both
+        lifetimes over) — the ExpiredTokenRemover analog, run on every
+        issue so store.tokens stays bounded by live-token churn."""
+        now = time.time()
+        dead = [tid for tid, rec in self.store.tokens.items()
+                if now > rec["refresh_expires"]]
+        for tid in dead:
+            del self.store.tokens[tid]

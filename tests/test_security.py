@@ -355,3 +355,170 @@ class TestLicenseGating:
         status, body = rc.dispatch("GET", "/_license", {}, b"")[:2]
         assert body["license"]["type"] == "basic"
         node.close()
+
+
+# --------------------------------------------------------- token service
+
+class TestTokenService:
+    def _client(self, tmp_path):
+        n = Node(str(tmp_path / "data"),
+                 settings={"xpack.security.enabled": True})
+        return Client(n), n
+
+    def req_bearer(self, client, method, path, token, body=None):
+        raw = json.dumps(body).encode() if body is not None else b""
+        return client.rc.dispatch(
+            method, path, {}, raw, "application/json",
+            {"authorization": f"Bearer {token}"})
+
+    def test_grant_use_refresh_invalidate(self, tmp_path):
+        """Full lifecycle (TokenService.java): password grant -> Bearer
+        auth -> single-use refresh rotation -> invalidation."""
+        client, node = self._client(tmp_path)
+        st, tok = client.req("POST", "/_security/oauth2/token",
+                             {"grant_type": "password",
+                              "username": "elastic",
+                              "password": "changeme"}, user=ELASTIC)
+        assert st == 200
+        assert tok["type"] == "Bearer" and tok["expires_in"] == 1200
+        access, refresh = tok["access_token"], tok["refresh_token"]
+
+        # the access token authenticates REST requests
+        st, who = self.req_bearer(client, "GET",
+                                  "/_security/_authenticate", access)
+        assert st == 200 and who["username"] == "elastic"
+        assert who["authentication_type"] == "token"
+
+        # refresh rotates: new pair works, old refresh is single-use
+        st, tok2 = client.req("POST", "/_security/oauth2/token",
+                              {"grant_type": "refresh_token",
+                               "refresh_token": refresh}, user=ELASTIC)
+        assert st == 200 and tok2["access_token"] != access
+        st, _ = self.req_bearer(client, "GET",
+                                "/_security/_authenticate",
+                                tok2["access_token"])
+        assert st == 200
+        # the rotated-out access token no longer authenticates
+        st, _ = self.req_bearer(client, "GET",
+                                "/_security/_authenticate", access)
+        assert st == 401
+        # reusing the OLD refresh token is an attack signal: 400 AND the
+        # whole user chain dies
+        st, _ = client.req("POST", "/_security/oauth2/token",
+                           {"grant_type": "refresh_token",
+                            "refresh_token": refresh}, user=ELASTIC)
+        assert st == 400
+        st, _ = self.req_bearer(client, "GET",
+                                "/_security/_authenticate",
+                                tok2["access_token"])
+        assert st == 401
+        node.close()
+
+    def test_invalidate_by_token_and_user(self, tmp_path):
+        client, node = self._client(tmp_path)
+        st, tok = client.req("POST", "/_security/oauth2/token",
+                             {"grant_type": "password",
+                              "username": "elastic",
+                              "password": "changeme"}, user=ELASTIC)
+        st, out = client.req("DELETE", "/_security/oauth2/token",
+                             {"token": tok["access_token"]}, user=ELASTIC)
+        assert st == 200 and out["invalidated_tokens"] == 1
+        st, _ = self.req_bearer(client, "GET",
+                                "/_security/_authenticate",
+                                tok["access_token"])
+        assert st == 401
+        # repeat invalidation counts as previously-invalidated
+        st, out = client.req("DELETE", "/_security/oauth2/token",
+                             {"token": tok["access_token"]}, user=ELASTIC)
+        assert out["previously_invalidated_tokens"] == 1
+        node.close()
+
+    def test_expired_access_token_rejected(self, tmp_path):
+        client, node = self._client(tmp_path)
+        st, tok = client.req("POST", "/_security/oauth2/token",
+                             {"grant_type": "password",
+                              "username": "elastic",
+                              "password": "changeme"}, user=ELASTIC)
+        tid = tok["access_token"].partition(".")[0]
+        node.security.store.tokens[tid]["access_expires"] -= 10_000
+        st, _ = self.req_bearer(client, "GET",
+                                "/_security/_authenticate",
+                                tok["access_token"])
+        assert st == 401
+        node.close()
+
+    def test_store_leak_is_not_credential_leak(self, tmp_path):
+        """Presenting the STORED hash as a bearer secret must fail (the
+        pass-the-hash property applied to tokens)."""
+        client, node = self._client(tmp_path)
+        st, tok = client.req("POST", "/_security/oauth2/token",
+                             {"grant_type": "password",
+                              "username": "elastic",
+                              "password": "changeme"}, user=ELASTIC)
+        tid = tok["access_token"].partition(".")[0]
+        stored_hash = node.security.store.tokens[tid]["access_hash"]
+        st, _ = self.req_bearer(client, "GET",
+                                "/_security/_authenticate",
+                                f"{tid}.{stored_hash}")
+        assert st == 401
+        node.close()
+
+    def test_client_credentials_grant(self, tmp_path):
+        client, node = self._client(tmp_path)
+        st, tok = client.req("POST", "/_security/oauth2/token",
+                             {"grant_type": "client_credentials"},
+                             user=ELASTIC)
+        assert st == 200
+        assert "refresh_token" not in tok  # per the reference contract
+        st, who = self.req_bearer(client, "GET",
+                                  "/_security/_authenticate",
+                                  tok["access_token"])
+        assert st == 200 and who["username"] == "elastic"
+        node.close()
+
+
+# --------------------------------------------------------- kerberos realm
+
+class TestKerberosRealm:
+    def test_negotiate_chain_with_stub_validator(self, tmp_path):
+        """Kerberos slot in the realm chain: a Negotiate header validates
+        through the realm's (test-injected) ticket validator; the
+        principal's roles resolve via delegated lookup in the other
+        realms (authorization_realms analog)."""
+        from elasticsearch_tpu.security.realms import KerberosRealm
+
+        cfg = tmp_path / "data" / "config"
+        cfg.mkdir(parents=True)
+        (cfg / "users").write_text("alice:unused-pw\n")
+        (cfg / "users_roles").write_text("superuser:alice\n")
+        node = Node(str(tmp_path / "data"), settings={
+            "xpack.security.enabled": True,
+            "xpack.security.authc.realms.kerberos.krb1.order": 0})
+        krb = [r for r in node.security.realms
+               if r.type_name == "kerberos"]
+        assert krb, "kerberos realm missing from the chain"
+        assert krb[0].name == "krb1"
+        # no validator configured: the realm never authenticates
+        hdr = {"authorization": "Negotiate "
+               + base64.b64encode(b"TICKET alice@EXAMPLE.COM").decode()}
+        from elasticsearch_tpu.security.service import AuthenticationError
+        with pytest.raises(AuthenticationError):
+            node.security.authenticate(hdr)
+
+        # inject the test validator (deployments plug real GSS here)
+        def validator(ticket: bytes):
+            if ticket.startswith(b"TICKET "):
+                return ticket[len(b"TICKET "):].decode()
+            return None
+
+        krb[0].ticket_validator = validator
+        auth = node.security.authenticate(hdr)
+        assert auth.username == "alice"          # realm stripped
+        assert auth.auth_type == "kerberos"
+        assert "superuser" in auth.role_names    # via file-realm lookup
+        # a garbage ticket still fails
+        bad = {"authorization": "Negotiate "
+               + base64.b64encode(b"NOT-A-TICKET").decode()}
+        with pytest.raises(AuthenticationError):
+            node.security.authenticate(bad)
+        node.close()

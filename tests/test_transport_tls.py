@@ -304,3 +304,82 @@ def test_https_rest_server(certs, tmp_path):
         loop.call_soon_threadsafe(loop.stop)
         t.join(10)
         node.close()
+
+
+def test_token_lifecycle_over_https(certs, tmp_path):
+    """Token grant-use-refresh over a real TLS REST port with security
+    enabled: basic auth grants, Bearer authenticates, refresh rotates
+    (TokenService.java e2e)."""
+    import json
+    import base64
+    import ssl as _ssl
+    import threading
+    import urllib.request
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.rest.http_server import HttpServer
+    from elasticsearch_tpu.server import _http_ssl_context
+
+    settings = {"http.ssl.enabled": "true",
+                "http.ssl.certificate": certs["node"]["cert"],
+                "http.ssl.key": certs["node"]["key"],
+                "xpack.security.enabled": True}
+    node = Node(str(tmp_path), settings=settings)
+    rc = RestController()
+    register_all(rc, node)
+    server = HttpServer(rc, host="127.0.0.1", port=0,
+                        ssl_context=_http_ssl_context(settings))
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(server.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert started.wait(15)
+    base = f"https://127.0.0.1:{server.port}"
+    ctx = _ssl.create_default_context(cafile=certs["ca"]["cert"])
+    ctx.check_hostname = False
+
+    def req(method, path, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json", **(headers or {})})
+        with urllib.request.urlopen(r, context=ctx, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        basic = {"authorization": "Basic " + base64.b64encode(
+            b"elastic:changeme").decode()}
+        tok = req("POST", "/_security/oauth2/token",
+                  {"grant_type": "password", "username": "elastic",
+                   "password": "changeme"}, basic)
+        bearer = {"authorization": f"Bearer {tok['access_token']}"}
+        who = req("GET", "/_security/_authenticate", headers=bearer)
+        assert who["username"] == "elastic"
+        assert who["authentication_type"] == "token"
+
+        tok2 = req("POST", "/_security/oauth2/token",
+                   {"grant_type": "refresh_token",
+                    "refresh_token": tok["refresh_token"]}, basic)
+        who2 = req("GET", "/_security/_authenticate", headers={
+            "authorization": f"Bearer {tok2['access_token']}"})
+        assert who2["username"] == "elastic"
+        # rotated-out access token now 401s
+        import urllib.error
+        try:
+            req("GET", "/_security/_authenticate", headers=bearer)
+            raise AssertionError("rotated token must not authenticate")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(5)
+        node.close()
