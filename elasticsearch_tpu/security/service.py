@@ -190,8 +190,10 @@ class SecurityService:
             roles = self.store.resolve_roles(rec["roles"])
             self._audit("authentication_success", user=rec["username"],
                         realm="token")
+            # the token record remembers the ORIGINATING realm, so tokens
+            # minted by a Bearer-authenticated caller stay attributed to it
             return Authentication(rec["username"], roles, rec["roles"],
-                                  auth_type="token")
+                                  auth_type="token", realm=rec["realm"])
         if header.startswith("Negotiate "):
             try:
                 ticket = base64.b64decode(header[10:].strip())

@@ -89,7 +89,10 @@ class TokenService:
             "realm": realm,
             "created": now,
             "access_expires": now + ACCESS_TTL_S,
-            "refresh_expires": now + REFRESH_TTL_S,
+            # without a refresh token the record is dead once the access
+            # token expires — sweep it then, not 24h later
+            "refresh_expires": now + (REFRESH_TTL_S if with_refresh
+                                      else ACCESS_TTL_S),
             "invalidated": False,
             "refreshed": False,
         }

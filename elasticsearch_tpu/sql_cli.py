@@ -1,0 +1,143 @@
+"""SQL wire client + CLI.
+
+Reference: `x-pack/plugin/sql/jdbc/` and `x-pack/plugin/sql/sql-cli/`.
+The reference's JDBC driver is NOT a custom socket protocol — it speaks
+HTTP `POST /_sql` with a BINARY content type (CBOR) and pages results
+through opaque cursors (`JdbcHttpClient` → `RestSqlQueryAction`); sql-cli
+is a terminal REPL over the same wire. This module is that pair:
+
+* `SqlWireClient` — binary CBOR request/response bodies (the xcontent
+  layer this framework already negotiates), cursor paging, cursor close
+  on early exit. A packet capture of this client shows no JSON on the
+  wire — the JDBC-lite property.
+* `main()` — `python -m elasticsearch_tpu.sql_cli --url http://... "
+  SELECT ..."`: one-shot or stdin REPL, text-table output like sql-cli.
+"""
+
+from __future__ import annotations
+
+import sys
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+from elasticsearch_tpu.common import xcontent
+
+CBOR = "application/cbor"
+
+
+class SqlWireClient:
+    """JDBC-lite: `/_sql` over binary CBOR with cursor paging."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 ssl_context=None, headers: Optional[Dict[str, str]] = None):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.ssl_context = ssl_context
+        self.headers = dict(headers or {})
+
+    def _post(self, path: str, body: dict) -> dict:
+        raw = xcontent.dumps(body, xcontent.XContentType.CBOR)
+        req = urllib.request.Request(
+            self.base_url + path, data=raw, method="POST",
+            headers={"Content-Type": CBOR, "Accept": CBOR, **self.headers})
+        kw = {"timeout": self.timeout}
+        if self.ssl_context is not None:
+            kw["context"] = self.ssl_context
+        with urllib.request.urlopen(req, **kw) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            data = resp.read()
+        return xcontent.loads(data, xcontent.XContentType.from_media_type(ct))
+
+    def query(self, sql: str, fetch_size: int = 1000,
+              params: Optional[List[Any]] = None) -> "SqlResultSet":
+        body: Dict[str, Any] = {"query": sql, "fetch_size": fetch_size}
+        if params:
+            body["params"] = params
+        return SqlResultSet(self, self._post("/_sql", body))
+
+    def close_cursor(self, cursor: str) -> bool:
+        out = self._post("/_sql/close", {"cursor": cursor})
+        return bool(out.get("succeeded"))
+
+
+class SqlResultSet:
+    """Streaming rows across cursor pages (the JDBC ResultSet analog)."""
+
+    def __init__(self, client: SqlWireClient, first_page: dict):
+        self.client = client
+        self.columns = first_page.get("columns", [])
+        self._rows: List[list] = list(first_page.get("rows", []))
+        self._cursor = first_page.get("cursor")
+        self.closed = False
+
+    def __iter__(self) -> Iterator[list]:
+        """Forward-only, like a JDBC ResultSet: rows are consumed from the
+        buffer as they are yielded, so a second (or resumed) iteration
+        continues where the previous one stopped instead of replaying the
+        buffered page."""
+        while True:
+            while self._rows:
+                yield self._rows.pop(0)
+            if not self._cursor:
+                return
+            page = self.client._post("/_sql", {"cursor": self._cursor})
+            self._rows = list(page.get("rows", []))
+            self._cursor = page.get("cursor")
+
+    def close(self) -> None:
+        """Release the server-side cursor without draining (JDBC
+        ResultSet.close on early exit)."""
+        if self._cursor and not self.closed:
+            self.client.close_cursor(self._cursor)
+            self._cursor = None
+        self.closed = True
+
+
+def _text_table(columns: List[dict], rows: List[list]) -> str:
+    names = [c.get("name", "?") for c in columns]
+    widths = [len(n) for n in names]
+    rendered = [[("" if v is None else str(v)) for v in r] for r in rows]
+    for r in rendered:
+        for i, v in enumerate(r):
+            widths[i] = max(widths[i], len(v))
+    def fmt(vals):
+        return " | ".join(v.ljust(w) for v, w in zip(vals, widths))
+    lines = [fmt(names), "-+-".join("-" * w for w in widths)]
+    lines += [fmt(r) for r in rendered]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="elasticsearch-tpu-sql",
+        description="SQL CLI over the binary /_sql wire (sql-cli analog)")
+    parser.add_argument("--url", default="http://127.0.0.1:9200")
+    parser.add_argument("--fetch-size", type=int, default=1000)
+    parser.add_argument("sql", nargs="?", help="one-shot statement; "
+                        "omit for a stdin REPL")
+    args = parser.parse_args(argv)
+    client = SqlWireClient(args.url)
+
+    def run(stmt: str) -> None:
+        rs = client.query(stmt, fetch_size=args.fetch_size)
+        print(_text_table(rs.columns, list(rs)))
+
+    if args.sql:
+        run(args.sql)
+        return 0
+    for line in sys.stdin:
+        stmt = line.strip().rstrip(";")
+        if not stmt:
+            continue
+        if stmt.lower() in ("exit", "quit"):
+            break
+        try:
+            run(stmt)
+        except Exception as e:  # noqa: BLE001 — REPL keeps going
+            print(f"error: {e}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,32 +1,51 @@
 """Graph exploration API.
 
-Reference: `x-pack/plugin/graph` (1.3k LoC) — `TransportGraphExploreAction`
-runs an iterative crawl: seed query → significant terms per requested
-vertex field → follow-up queries on found terms to discover connected
-vertices, returned as a vertices[] + connections[] graph keyed by array
-index. Built here on the public search surface (terms aggregations), one
-hop per `connections` nesting level like the reference.
+Reference: `x-pack/plugin/graph` (1.3k LoC),
+`TransportGraphExploreAction.java`: an iterative crawl where EACH HOP is
+one search — the frontier becomes a boosted bool query (term clauses
+weighted by vertex weight), a `sampler` agg caps the docs considered per
+hop (`controls.sample_size`, default 100 — the "best matching" sample),
+and per source-field terms buckets (include-filtered to the frontier)
+nest significant-terms aggs per target vertex spec. Vertex weights are
+the significance scores normalized per wave; `use_significance: false`
+falls back to popular terms. Per-vertex `include`/`exclude` filter the
+crawl, and `controls.timeout` bounds wall time with `timed_out` reported,
+matching the reference's deadline checks between waves.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from elasticsearch_tpu.common.errors import ValidationError
+
+DEFAULT_SAMPLE_SIZE = 100   # GraphExploreRequest.DEFAULT_SAMPLE_SIZE
+DEFAULT_VERTEX_SIZE = 5
 
 
 class GraphService:
     def __init__(self, node):
         self.node = node
 
+    # ---------------------------------------------------------------- api
     def explore(self, index: str, body: dict) -> dict:
         started = time.time()
+        body = body or {}
+        controls = body.get("controls") or {}
         query = body.get("query", {"match_all": {}})
         vertex_specs = body.get("vertices", [])
         if not vertex_specs:
             raise ValidationError("graph explore requires [vertices]")
-        use_sig = bool(body.get("use_significance", True))
+        use_sig = bool(controls.get(
+            "use_significance", body.get("use_significance", True)))
+        sample_size = int(controls.get("sample_size", DEFAULT_SAMPLE_SIZE))
+        timeout_ms = controls.get("timeout")
+        if timeout_ms is None:
+            timeout_ms = body.get("timeout")
+        deadline = (started + float(timeout_ms) / 1000.0) \
+            if timeout_ms is not None else None
+        timed_out = False
 
         vertices: List[dict] = []          # {field, term, weight, depth}
         vertex_index: Dict[Tuple[str, str], int] = {}
@@ -36,71 +55,164 @@ class GraphService:
                        depth: int) -> int:
             key = (field, term)
             if key in vertex_index:
-                return vertex_index[key]
+                idx = vertex_index[key]
+                # revisits keep the strongest evidence (reference folds
+                # repeat sightings into the existing vertex)
+                vertices[idx]["weight"] = max(vertices[idx]["weight"],
+                                              weight)
+                return idx
             vertex_index[key] = len(vertices)
             vertices.append({"field": field, "term": term,
                              "weight": weight, "depth": depth})
             return vertex_index[key]
 
-        # depth 0: seed terms from the query
-        seeds: List[int] = []
-        for spec in vertex_specs:
-            for term, count, weight in self._top_terms(
-                    index, query, spec, use_sig):
-                seeds.append(add_vertex(spec["field"], term, weight, 0))
+        # ---- depth 0: seed wave — one search, sampler + per-spec aggs
+        seed_aggs = {f"v{i}": self._vertex_agg(spec, use_sig)
+                     for i, spec in enumerate(vertex_specs)}
+        resp = self.node.search(index, {
+            "query": query, "size": 0,
+            "aggs": {"sample": {"sampler": {"shard_size": sample_size},
+                                "aggs": seed_aggs}}})
+        # normalize ONCE per wave (across every spec's buckets), so a
+        # marginal term in a sparse field cannot masquerade as weight 1.0
+        wave = []
+        for i, spec in enumerate(vertex_specs):
+            buckets = resp["aggregations"]["sample"][f"v{i}"]["buckets"]
+            wave.extend((spec["field"], t, c, s)
+                        for t, c, s in self._raw(buckets, use_sig))
+        frontier: List[int] = []
+        for field, term, _count, weight in self._wave_normalize(wave):
+            frontier.append(add_vertex(field, term, weight, 0))
+        frontier = list(dict.fromkeys(frontier))
 
-        # one hop per connections level (reference: Hop chaining)
-        frontier = list(dict.fromkeys(seeds))
-        depth = 1
+        # ---- hops: ONE search per connections level (Hop chaining)
         conn_body = body.get("connections")
+        depth = 1
         while conn_body and frontier:
+            if deadline is not None and time.time() > deadline:
+                timed_out = True
+                break
             conn_specs = conn_body.get("vertices", [])
-            next_frontier: List[int] = []
-            frontier_seen: set = set()
-            for src_idx in frontier:
-                src = vertices[src_idx]
-                hop_query = {"bool": {"filter": [
-                    {"term": {src["field"]: src["term"]}}]}}
-                for spec in conn_specs:
-                    for term, count, weight in self._top_terms(
-                            index, hop_query, spec, use_sig):
-                        if (spec["field"], term) == (src["field"],
-                                                     src["term"]):
-                            continue
-                        tgt_idx = add_vertex(spec["field"], term, weight,
-                                             depth)
-                        connections.append({"source": src_idx,
-                                            "target": tgt_idx,
-                                            "weight": weight,
-                                            "doc_count": count})
-                        if vertices[tgt_idx]["depth"] == depth \
-                                and tgt_idx not in frontier_seen:
-                            frontier_seen.add(tgt_idx)
-                            next_frontier.append(tgt_idx)
-            frontier = next_frontier
+            if not conn_specs:
+                break
+            frontier, new_conns = self._one_hop(
+                index, vertices, frontier, conn_specs, use_sig,
+                sample_size, depth, add_vertex, conn_body.get("query"))
+            connections.extend(new_conns)
             conn_body = conn_body.get("connections")
             depth += 1
 
         return {"took": int((time.time() - started) * 1000),
-                "timed_out": False,
+                "timed_out": timed_out,
                 "failures": [],
                 "vertices": vertices,
                 "connections": connections}
 
-    def _top_terms(self, index: str, query: dict, spec: dict,
-                   use_sig: bool) -> List[Tuple[str, int, float]]:
-        field = spec["field"]
-        size = int(spec.get("size", 5))
-        min_doc_count = int(spec.get("min_doc_count", 1))
-        agg_kind = "significant_terms" if use_sig else "terms"
+    # ------------------------------------------------------------ one hop
+    def _one_hop(self, index, vertices, frontier, conn_specs, use_sig,
+                 sample_size, depth, add_vertex, hop_query):
+        """Expand the whole frontier with ONE search: boosted bool query
+        over the frontier terms; terms agg per source field (include:
+        frontier terms) nesting the target vertex aggs — bucket paths
+        give source→target connections directly."""
+        by_field: Dict[str, List[int]] = {}
+        for idx in frontier:
+            by_field.setdefault(vertices[idx]["field"], []).append(idx)
+
+        should = [{"term": {vertices[i]["field"]: {
+                       "value": vertices[i]["term"],
+                       "boost": max(float(vertices[i]["weight"]), 1e-9)}}}
+                  for i in frontier]
+        query = {"bool": {"should": should, "minimum_should_match": 1}}
+        if hop_query:
+            # guiding query for this hop (the reference ANDs the hop's
+            # optional query with the frontier expansion)
+            query = {"bool": {"must": [query, hop_query]}}
+
+        src_aggs = {}
+        for f_i, (field, idxs) in enumerate(by_field.items()):
+            tgt_aggs = {f"t{j}": self._vertex_agg(spec, use_sig)
+                        for j, spec in enumerate(conn_specs)}
+            src_aggs[f"s{f_i}"] = {
+                "terms": {"field": field,
+                          "include": [vertices[i]["term"] for i in idxs],
+                          "size": len(idxs)},
+                "aggs": tgt_aggs}
         resp = self.node.search(index, {
             "query": query, "size": 0,
-            "aggs": {"v": {agg_kind: {"field": field,
-                                      "size": size,
-                                      "min_doc_count": min_doc_count}}}})
+            "aggs": {"sample": {"sampler": {"shard_size": sample_size},
+                                "aggs": src_aggs}}})
+
+        # collect the WHOLE wave's raw scores first, normalize once, then
+        # materialize vertices/connections — per-bucket normalization
+        # would hand weak evidence the same 1.0 as the wave's best
+        raw_edges = []   # (src_idx, field, term, count, score)
+        sample = resp["aggregations"]["sample"]
+        for f_i, (field, idxs) in enumerate(by_field.items()):
+            for src_bucket in sample[f"s{f_i}"]["buckets"]:
+                src_term = str(src_bucket["key"])
+                src_idx = next((i for i in idxs
+                                if vertices[i]["term"] == src_term), None)
+                if src_idx is None:
+                    continue
+                for j, spec in enumerate(conn_specs):
+                    for term, count, score in self._raw(
+                            src_bucket[f"t{j}"]["buckets"], use_sig):
+                        if (spec["field"], term) == (field, src_term):
+                            continue
+                        raw_edges.append((src_idx, spec["field"], term,
+                                          count, score))
+        best = max((s for *_rest, s in raw_edges), default=0.0)
+        next_frontier: List[int] = []
+        connections: List[dict] = []
+        for src_idx, field, term, count, score in raw_edges:
+            weight = (score / best) if best > 0 else 1.0
+            tgt_idx = add_vertex(field, term, weight, depth)
+            connections.append({"source": src_idx, "target": tgt_idx,
+                                "weight": weight, "doc_count": count})
+            if vertices[tgt_idx]["depth"] == depth:
+                next_frontier.append(tgt_idx)
+        return list(dict.fromkeys(next_frontier)), connections
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _vertex_agg(spec: dict, use_sig: bool) -> dict:
+        """One vertex request -> its terms / significant_terms agg with
+        the reference's include/exclude + size + min_doc_count controls."""
+        field = spec["field"]
+        agg: dict = {"field": field,
+                     "size": int(spec.get("size", DEFAULT_VERTEX_SIZE)),
+                     "min_doc_count": int(spec.get("min_doc_count",
+                                                   3 if use_sig else 1))}
+        include = spec.get("include")
+        if include:
+            # include entries may be bare terms or {term, boost}
+            agg["include"] = [e["term"] if isinstance(e, dict) else e
+                              for e in include]
+        if spec.get("exclude"):
+            agg["exclude"] = list(spec["exclude"])
+        kind = "significant_terms" if use_sig else "terms"
+        return {kind: agg}
+
+    @staticmethod
+    def _raw(buckets: List[dict],
+             use_sig: bool) -> List[Tuple[str, int, float]]:
+        """(term, doc_count, raw_score) per bucket — significance score
+        when available, popularity (doc_count) otherwise."""
         out = []
-        for b in resp["aggregations"]["v"]["buckets"]:
+        for b in buckets:
             count = int(b["doc_count"])
-            weight = float(b.get("score", count))
-            out.append((str(b["key"]), count, weight))
+            score = float(b.get("score", count)) if use_sig \
+                else float(count)
+            out.append((str(b["key"]), count, score))
         return out
+
+    @staticmethod
+    def _wave_normalize(wave):
+        """[(field, term, count, score)] -> same with scores divided by
+        the wave's best (the reference normalizes per wave so weights
+        compose across hops)."""
+        best = max((s for *_rest, s in wave), default=0.0)
+        if best <= 0:
+            return [(f, t, c, 1.0) for f, t, c, _s in wave]
+        return [(f, t, c, s / best) for f, t, c, s in wave]

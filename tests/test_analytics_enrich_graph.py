@@ -217,3 +217,73 @@ def test_graph_rest(node):
                      "use_significance": False}).encode(),
         "application/json")
     assert status == 200 and body["vertices"][0]["term"] == "x"
+
+
+def test_graph_multi_hop_with_controls(node):
+    """Three-hop crawl with per-vertex include/exclude, sample controls,
+    and normalized wave weights (TransportGraphExploreAction contract):
+    guitar → buyers → their items → other buyers of those items."""
+    purchases = [
+        ("p1", "guitar"), ("p1", "amp"), ("p2", "guitar"), ("p2", "amp"),
+        ("p3", "guitar"), ("p3", "drums"), ("p4", "amp"), ("p4", "mic"),
+        ("p5", "piano"),
+    ]
+    for i, (person, item) in enumerate(purchases):
+        node.index_doc("orders3", str(i), {"person": person, "item": item})
+    node.indices.get("orders3").refresh()
+
+    resp = node.graph.explore("orders3", {
+        "query": {"term": {"item.keyword": "guitar"}},
+        "controls": {"use_significance": False, "sample_size": 50},
+        "vertices": [{"field": "person.keyword", "size": 10}],
+        "connections": {
+            "vertices": [{"field": "item.keyword", "size": 10,
+                          "exclude": ["guitar"]}],
+            "connections": {
+                "vertices": [{"field": "person.keyword", "size": 10}]}},
+    })
+    assert not resp["timed_out"]
+    by_term = {(v["field"], v["term"]): v for v in resp["vertices"]}
+    # wave structure: buyers(0) -> items(1) -> people(2)
+    assert by_term[("person.keyword", "p1")]["depth"] == 0
+    assert by_term[("item.keyword", "amp")]["depth"] == 1
+    assert ("item.keyword", "guitar") not in by_term  # excluded
+    # p4 never bought a guitar but shares the amp: reachable only at hop 2
+    assert by_term[("person.keyword", "p4")]["depth"] == 2
+    assert ("person.keyword", "p5") not in by_term     # disconnected
+    # weights normalize per wave: every weight in (0, 1]
+    assert all(0 < v["weight"] <= 1.0 for v in resp["vertices"])
+    # every connection joins adjacent depths, keyed by array index
+    for c in resp["connections"]:
+        s, t = resp["vertices"][c["source"]], resp["vertices"][c["target"]]
+        assert t["depth"] <= s["depth"] + 1
+
+
+def test_graph_timeout_reports_timed_out(node):
+    node.index_doc("gt", "1", {"a": "x", "b": "y"}, refresh="true")
+    resp = node.graph.explore("gt", {
+        "query": {"match_all": {}},
+        "controls": {"use_significance": False, "timeout": 0},
+        "vertices": [{"field": "a.keyword"}],
+        "connections": {"vertices": [{"field": "b.keyword"}]},
+    })
+    # deadline already passed before the first hop: partial result,
+    # honestly flagged (the reference's timedOut contract)
+    assert resp["timed_out"] is True
+    assert all(v["depth"] == 0 for v in resp["vertices"])
+
+
+def test_graph_include_restricts_crawl(node):
+    node.index_doc("gi", "1", {"person": "p1", "item": "amp"})
+    node.index_doc("gi", "2", {"person": "p1", "item": "drums"})
+    node.indices.get("gi").refresh()
+    resp = node.graph.explore("gi", {
+        "query": {"term": {"person.keyword": "p1"}},
+        "controls": {"use_significance": False},
+        "vertices": [{"field": "person.keyword"}],
+        "connections": {"vertices": [{"field": "item.keyword",
+                                      "include": ["amp"]}]},
+    })
+    items = {v["term"] for v in resp["vertices"]
+             if v["field"] == "item.keyword"}
+    assert items == {"amp"}
