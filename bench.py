@@ -39,6 +39,40 @@ import time
 
 METRIC = "knn_qps_sift1m_cosine_recall_gated"
 
+
+def _last_known_good() -> dict:
+    """Freshest committed config-1 capture, so a tunnel outage at snapshot
+    time reports THIS round's numbers when a mid-round capture landed
+    (VERDICT r4 weak #7: the official record should never regress to an
+    old round's figures just because the final probe lost the race)."""
+    import glob
+    import re
+    best = {"qps": 126472.3, "recall_at_10": 0.9925,
+            "source": "BENCH_MATRIX_r02.json config 1"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(here, "BENCH_MATRIX_r*.json")):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    for _rnum, path in sorted(rounds, reverse=True):
+        newest = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    row = json.loads(line)
+                    if str(row.get("config", "")).startswith("1") \
+                            and row.get("qps"):
+                        newest = row  # LAST matching line = freshest capture
+        except (OSError, ValueError):
+            continue
+        if newest is not None:
+            return {"qps": newest["qps"],
+                    "recall_at_10": newest.get("recall_at_10"),
+                    "source": f"{os.path.basename(path)} "
+                              f"config {newest['config']}"}
+    return best
+
 _PROBE_CODE = r"""
 import jax, jax.numpy as jnp
 d = jax.devices()[0]
@@ -77,29 +111,43 @@ def _run_child(timeout_s: float) -> tuple[int, str, str]:
 
 def main():
     small = os.environ.get("BENCH_SMALL") == "1"
-    deadline = time.monotonic() + 1140  # global cap: 19 min wall clock
+    # global wall-clock cap; BENCH_ACQUIRE_S widens it (e.g. a driver that
+    # can afford to wait out a tunnel outage sets 3600). Malformed values
+    # must not crash before the JSON line: default to 0
+    try:
+        acquire_s = int(float(os.environ.get("BENCH_ACQUIRE_S", "0") or 0))
+    except ValueError:
+        acquire_s = 0
+    budget_s = 1140 + acquire_s
+    deadline = time.monotonic() + budget_s
 
     def remaining():
         return deadline - time.monotonic()
 
-    # --- phase 1: bounded backend acquisition with retries ----------------
+    # --- phase 1: bounded backend acquisition, exponential backoff --------
+    # retries ride whatever window the caller gave us: with the default
+    # budget ~4 probes; with BENCH_ACQUIRE_S=3600 the probe loop spans the
+    # whole hour before giving up (VERDICT r4: retry across the round, not
+    # two probes at snapshot time)
     platform = None
     errors = []
-    for attempt in range(4):
+    max_attempts = 4 + acquire_s // 120
+    for attempt in range(1, max_attempts + 1):
+        if remaining() < 150:
+            break
         ok, info = _probe_backend(timeout_s=min(120, max(30, remaining())))
         if ok:
             platform = info
             break
-        errors.append(f"attempt {attempt + 1}: {info}")
-        if attempt < 3:
-            time.sleep(10 * (attempt + 1))
+        errors.append(f"attempt {attempt}: {info}")
+        if attempt < max_attempts and remaining() > 300:
+            time.sleep(min(120, 10 * 2 ** min(attempt - 1, 4)))
     if platform is None:
         print(json.dumps({
             "metric": METRIC, "value": 0, "unit": "qps", "vs_baseline": 0,
             "error": "tpu_backend_unavailable",
             "probe_errors": errors[-2:],
-            "last_known_good": {"qps": 126472.3, "recall_at_10": 0.9925,
-                                "source": "BENCH_MATRIX_r02.json config 1"},
+            "last_known_good": _last_known_good(),
         }))
         sys.exit(1)
 
@@ -133,8 +181,7 @@ def main():
         "metric": METRIC, "value": 0, "unit": "qps", "vs_baseline": 0,
         "error": "bench_child_failed", "detail": last_err,
         "platform": platform,
-        "last_known_good": {"qps": 126472.3, "recall_at_10": 0.9925,
-                            "source": "BENCH_MATRIX_r02.json config 1"},
+        "last_known_good": _last_known_good(),
     }))
     sys.exit(1)
 
@@ -254,6 +301,21 @@ def child_main():
             out["north_star"] = ns
         except Exception as e:  # noqa: BLE001 — diagnostic, not fatal
             out["north_star"] = {"error": str(e)[:200]}
+        try:
+            # recall-headroom row: residual level doubles corpus HBM, so
+            # it runs at 5M (16 GB chip) — the packed rescore's recall
+            # target is >=0.97 at <=20% QPS cost (VERDICT r5 item 2). Its
+            # OWN try: an OOM here must never lose the 10M headline above
+            nsr = bench_matrix.run_north_star_10m_int8(
+                n=1_000_000 if small else 5_000_000, emit=False,
+                extra=False, residual=True)
+            out["north_star_residual"] = {
+                "n_docs": nsr["n_docs"],
+                "base_qps": nsr["qps"],
+                "base_recall": nsr["recall_at_10"],
+                **nsr.get("packed_residual_rescore", {})}
+        except Exception as e:  # noqa: BLE001 — diagnostic, not fatal
+            out["north_star_residual"] = {"error": str(e)[:200]}
 
     print(json.dumps(out))
     if recall < 0.95:
