@@ -1,10 +1,12 @@
 """Ingest subsystem: pipelines + processors.
 
 Reference: `ingest/IngestService.java`, `modules/ingest-common`,
-`modules/ingest-user-agent`, `plugins/ingest-geoip`, `libs/grok`,
-`libs/dissect`.
+`modules/ingest-user-agent`, `plugins/ingest-geoip`,
+`plugins/ingest-attachment`, `libs/grok`, `libs/dissect`.
 """
 
+from elasticsearch_tpu.ingest.attachment import register_attachment_processor
 from elasticsearch_tpu.ingest.processors_extra import register_extra_processors
 
 register_extra_processors()
+register_attachment_processor()

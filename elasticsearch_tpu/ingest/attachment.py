@@ -1,0 +1,308 @@
+"""Attachment ingest processor (Tika-lite).
+
+Reference: `plugins/ingest-attachment` — the `attachment` processor runs
+Apache Tika over a base64-encoded binary field and indexes the extracted
+`content`, `content_type`, `content_length`, `language`, plus format
+metadata (title/author/...). Tika is a JVM dependency; this environment
+has no egress, so extraction is re-implemented for the formats that
+matter in practice, pure-stdlib:
+
+  * content-type sniffing by magic bytes (%PDF, PK zip/OOXML, {\\rtf,
+    HTML markers, UTF BOMs)
+  * text/plain (+ charset fallback utf-8 → latin-1)
+  * text/html — tag strip with script/style suppression
+  * DOCX (OOXML): `word/document.xml` <w:t> runs + `docProps/core.xml`
+    title/author/dates
+  * PDF — best-effort: FlateDecode stream inflation + Tj/TJ text-showing
+    operators (covers simple generated PDFs; scanned/encrypted ones
+    yield empty content, never an error)
+  * RTF — control-word strip
+  * language — trivial stopword vote over a handful of languages (the
+    reference ships Tika's detector; same field, cruder signal)
+
+Same spec surface: `field`, `target_field` (default "attachment"),
+`indexed_chars` (default 100_000, -1 = unlimited), `indexed_chars_field`,
+`properties` subset, `ignore_missing`, `remove_binary`.
+"""
+
+from __future__ import annotations
+
+import base64
+import html.parser
+import io
+import re
+import zipfile
+import zlib
+from typing import List, Optional
+
+from elasticsearch_tpu.ingest.service import (
+    IngestProcessorError, Processor, _del_path, _get_path, _set_path,
+)
+
+DEFAULT_INDEXED_CHARS = 100_000
+
+_STOPWORDS = {
+    "en": {"the", "and", "of", "to", "in", "is", "that", "it", "for"},
+    "de": {"der", "die", "das", "und", "ist", "nicht", "ein", "mit"},
+    "fr": {"le", "la", "les", "et", "est", "une", "pour", "dans"},
+    "es": {"el", "la", "los", "que", "es", "una", "por", "con"},
+    "nl": {"de", "het", "een", "en", "van", "dat", "niet", "met"},
+}
+
+
+class _HtmlText(html.parser.HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.parts: List[str] = []
+        self._suppress = 0
+        self.title: Optional[str] = None
+        self._in_title = False
+
+    def handle_starttag(self, tag, attrs):
+        if tag in ("script", "style"):
+            self._suppress += 1
+        if tag == "title":
+            self._in_title = True
+
+    def handle_endtag(self, tag):
+        if tag in ("script", "style") and self._suppress:
+            self._suppress -= 1
+        if tag == "title":
+            self._in_title = False
+
+    def handle_data(self, data):
+        if self._in_title:
+            self.title = (self.title or "") + data
+            return
+        if not self._suppress and data.strip():
+            self.parts.append(data.strip())
+
+
+def _decode_text(raw: bytes) -> str:
+    # BOM-carrying UTF-16/UTF-8 first, then plain utf-8, then latin-1 —
+    # a UTF-16 document must never be indexed as NUL-ridden mojibake
+    if raw.startswith((b"\xff\xfe", b"\xfe\xff")):
+        try:
+            return raw.decode("utf-16")
+        except UnicodeDecodeError:
+            pass
+    if raw.startswith(b"\xef\xbb\xbf"):
+        raw = raw[3:]
+    for enc in ("utf-8", "latin-1"):
+        try:
+            return raw.decode(enc)
+        except UnicodeDecodeError:
+            continue
+    return raw.decode("utf-8", errors="replace")
+
+
+def sniff_content_type(raw: bytes) -> str:
+    head = raw[:512]
+    if head.startswith(b"%PDF"):
+        return "application/pdf"
+    if head.startswith(b"{\\rtf"):
+        return "application/rtf"
+    if head.startswith(b"PK\x03\x04"):
+        try:
+            with zipfile.ZipFile(io.BytesIO(raw)) as z:
+                names = set(z.namelist())
+            if "word/document.xml" in names:
+                return ("application/vnd.openxmlformats-officedocument"
+                        ".wordprocessingml.document")
+            return "application/zip"
+        except zipfile.BadZipFile:
+            return "application/zip"
+    lowered = head.lstrip()[:64].lower()
+    if lowered.startswith((b"<!doctype html", b"<html")) \
+            or b"<html" in head.lower():
+        return "text/html"
+    if head.startswith((b"\xef\xbb\xbf", b"\xff\xfe", b"\xfe\xff")):
+        return "text/plain"
+    try:
+        head.decode("utf-8")
+        return "text/plain"
+    except UnicodeDecodeError:
+        return "application/octet-stream"
+
+
+def _extract_html(raw: bytes):
+    p = _HtmlText()
+    p.feed(_decode_text(raw))
+    meta = {}
+    if p.title:
+        meta["title"] = p.title.strip()
+    return " ".join(p.parts), meta
+
+
+_W_T = re.compile(r"<w:t(?:\s[^>]*)?>(.*?)</w:t>", re.S)
+_W_P_END = re.compile(r"</w:p>")
+_CORE = {
+    "title": re.compile(r"<dc:title>(.*?)</dc:title>", re.S),
+    "author": re.compile(r"<dc:creator>(.*?)</dc:creator>", re.S),
+    "date": re.compile(
+        r"<dcterms:created[^>]*>(.*?)</dcterms:created>", re.S),
+    "keywords": re.compile(r"<cp:keywords>(.*?)</cp:keywords>", re.S),
+}
+
+
+def _extract_docx(raw: bytes):
+    import xml.sax.saxutils as su
+    with zipfile.ZipFile(io.BytesIO(raw)) as z:
+        doc = z.read("word/document.xml").decode("utf-8", errors="replace")
+        core = ""
+        if "docProps/core.xml" in z.namelist():
+            core = z.read("docProps/core.xml").decode("utf-8",
+                                                      errors="replace")
+    paragraphs = []
+    for para in _W_P_END.split(doc):
+        runs = [su.unescape(m) for m in _W_T.findall(para)]
+        if runs:
+            paragraphs.append("".join(runs))
+    meta = {}
+    for key, rx in _CORE.items():
+        m = rx.search(core)
+        if m and m.group(1).strip():
+            meta[key] = su.unescape(m.group(1).strip())
+    return "\n".join(paragraphs), meta
+
+
+_PDF_STREAM = re.compile(rb"stream\r?\n(.*?)endstream", re.S)
+_PDF_TEXT_OP = re.compile(rb"\(((?:[^()\\]|\\.)*)\)\s*Tj"
+                          rb"|\[((?:[^\[\]\\]|\\.)*)\]\s*TJ", re.S)
+_PDF_STR = re.compile(rb"\(((?:[^()\\]|\\.)*)\)")
+_PDF_ESC = {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"(": b"(",
+            b")": b")", b"\\": b"\\"}
+
+
+def _pdf_unescape(s: bytes) -> bytes:
+    out = bytearray()
+    i = 0
+    while i < len(s):
+        c = s[i:i + 1]
+        if c == b"\\" and i + 1 < len(s):
+            nxt = s[i + 1:i + 2]
+            out += _PDF_ESC.get(nxt, nxt)
+            i += 2
+        else:
+            out += c
+            i += 1
+    return bytes(out)
+
+
+def _extract_pdf(raw: bytes):
+    chunks: List[bytes] = []
+    for m in _PDF_STREAM.finditer(raw):
+        data = m.group(1)
+        try:
+            data = zlib.decompress(data)
+        except zlib.error:
+            pass  # uncompressed content stream
+        chunks.append(data)
+    texts: List[str] = []
+    for data in chunks:
+        for tj, arr in _PDF_TEXT_OP.findall(data):
+            if tj:
+                texts.append(_pdf_unescape(tj).decode("latin-1"))
+            elif arr:
+                texts.append("".join(
+                    _pdf_unescape(s).decode("latin-1")
+                    for s in _PDF_STR.findall(arr)))
+    return " ".join(t for t in texts if t.strip()), {}
+
+
+_RTF_CTRL = re.compile(r"\\[a-zA-Z]+-?\d* ?|[{}]|\\'[0-9a-fA-F]{2}")
+
+
+def _extract_rtf(raw: bytes):
+    return _RTF_CTRL.sub("", _decode_text(raw)).strip(), {}
+
+
+def detect_language(text: str) -> Optional[str]:
+    words = set(re.findall(r"[a-zà-ÿ]+", text.lower())[:400])
+    best, best_hits = None, 1  # require >= 2 stopword hits
+    for lang, stops in _STOPWORDS.items():
+        hits = len(words & stops)
+        if hits > best_hits:
+            best, best_hits = lang, hits
+    return best
+
+
+def extract(raw: bytes) -> dict:
+    """bytes -> {content, content_type, content_length, language?, meta...}"""
+    ctype = sniff_content_type(raw)
+    meta: dict = {}
+    if ctype == "application/pdf":
+        content, meta = _extract_pdf(raw)
+    elif ctype.endswith("wordprocessingml.document"):
+        content, meta = _extract_docx(raw)
+    elif ctype == "text/html":
+        content, meta = _extract_html(raw)
+    elif ctype == "application/rtf":
+        content, meta = _extract_rtf(raw)
+    elif ctype == "text/plain":
+        content = _decode_text(raw)
+    else:
+        content = ""
+    content = content.strip()
+    out = {"content": content, "content_type": ctype,
+           "content_length": len(content), **meta}
+    lang = detect_language(content) if content else None
+    if lang:
+        out["language"] = lang
+    return out
+
+
+class AttachmentProcessor(Processor):
+    kind = "attachment"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.target_field = spec.get("target_field", "attachment")
+        self.indexed_chars = int(spec.get("indexed_chars",
+                                          DEFAULT_INDEXED_CHARS))
+        self.indexed_chars_field = spec.get("indexed_chars_field")
+        self.properties = spec.get("properties")
+        self.remove_binary = bool(spec.get("remove_binary", False))
+
+    def run(self, ctx):
+        v = _get_path(ctx, self.field)
+        if v is None:
+            if self.ignore_missing:
+                return
+            raise IngestProcessorError(
+                f"field [{self.field}] is missing")
+        if isinstance(v, (bytes, bytearray)):
+            raw = bytes(v)
+        else:
+            try:
+                # whitespace is legal in transferred base64; anything else
+                # outside the alphabet is a client error, not content
+                cleaned = re.sub(r"\s+", "", str(v))
+                raw = base64.b64decode(cleaned, validate=True)
+            except Exception:
+                raise IngestProcessorError(
+                    f"field [{self.field}] is not valid base64")
+        att = extract(raw)
+        limit = self.indexed_chars
+        if self.indexed_chars_field:
+            per_doc = _get_path(ctx, self.indexed_chars_field)
+            if per_doc is not None:
+                try:
+                    limit = int(per_doc)
+                except (TypeError, ValueError):
+                    raise IngestProcessorError(
+                        f"field [{self.indexed_chars_field}] is not an "
+                        f"integer: [{per_doc!r}]")
+        if limit >= 0 and len(att.get("content", "")) > limit:
+            att["content"] = att["content"][:limit]
+            att["content_length"] = limit
+        if self.properties:
+            att = {k: v2 for k, v2 in att.items() if k in self.properties}
+        _set_path(ctx, self.target_field, att)
+        if self.remove_binary:
+            _del_path(ctx, self.field)
+
+
+def register_attachment_processor() -> None:
+    from elasticsearch_tpu.ingest.service import PROCESSORS
+    PROCESSORS[AttachmentProcessor.kind] = AttachmentProcessor

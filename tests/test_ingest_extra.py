@@ -168,3 +168,147 @@ def test_geoip_inline_database(client):
     out = simulate(client, [{"geoip": {"field": "ip", "database": db}}],
                    {"ip": "10.1.2.3"})
     assert out["geoip"]["city_name"] == "Intranet"
+
+
+# --------------------------------------------------------- attachment
+
+class TestAttachmentProcessor:
+    """Tika-lite `attachment` processor (plugins/ingest-attachment):
+    sniff + extract per format, indexed_chars, properties subset,
+    remove_binary."""
+
+    def _run(self, spec, doc):
+        from elasticsearch_tpu.ingest.attachment import AttachmentProcessor
+        p = AttachmentProcessor(spec)
+        p.run(doc)
+        return doc
+
+    @staticmethod
+    def _b64(raw: bytes) -> str:
+        import base64
+        return base64.b64encode(raw).decode()
+
+    def test_plain_text_and_language(self):
+        raw = b"the quick brown fox is in the woods and it runs for fun"
+        doc = self._run({"field": "data"}, {"data": self._b64(raw)})
+        att = doc["attachment"]
+        assert att["content_type"] == "text/plain"
+        assert "quick brown fox" in att["content"]
+        assert att["content_length"] == len(att["content"])
+        assert att["language"] == "en"
+
+    def test_html_extraction_with_title(self):
+        raw = (b"<html><head><title>My Page</title>"
+               b"<script>var x = 1;</script></head>"
+               b"<body><h1>Hello</h1><p>World of text</p></body></html>")
+        doc = self._run({"field": "data"}, {"data": self._b64(raw)})
+        att = doc["attachment"]
+        assert att["content_type"] == "text/html"
+        assert "Hello" in att["content"] and "World of text" in att["content"]
+        assert "var x" not in att["content"]       # scripts suppressed
+        assert att["title"] == "My Page"
+
+    def test_docx_extraction(self):
+        import io
+        import zipfile
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            z.writestr("[Content_Types].xml", "<Types/>")
+            z.writestr("word/document.xml",
+                       '<w:document xmlns:w="x"><w:body>'
+                       "<w:p><w:r><w:t>First paragraph.</w:t></w:r></w:p>"
+                       "<w:p><w:r><w:t>Second </w:t></w:r>"
+                       "<w:r><w:t>part&amp;more.</w:t></w:r></w:p>"
+                       "</w:body></w:document>")
+            z.writestr("docProps/core.xml",
+                       '<cp:coreProperties xmlns:cp="c" xmlns:dc="d">'
+                       "<dc:title>Quarterly Report</dc:title>"
+                       "<dc:creator>Alex Writer</dc:creator>"
+                       "</cp:coreProperties>")
+        doc = self._run({"field": "data"},
+                        {"data": self._b64(buf.getvalue())})
+        att = doc["attachment"]
+        assert att["content_type"].endswith("wordprocessingml.document")
+        assert "First paragraph." in att["content"]
+        assert "Second part&more." in att["content"]   # runs joined, unescaped
+        assert att["title"] == "Quarterly Report"
+        assert att["author"] == "Alex Writer"
+
+    def test_pdf_extraction_best_effort(self):
+        import zlib
+        stream = zlib.compress(
+            b"BT /F1 12 Tf (Hello from a PDF) Tj "
+            b"[(glued) (words)] TJ ET")
+        raw = (b"%PDF-1.4\n1 0 obj\n<< /Length " +
+               str(len(stream)).encode() +
+               b" /Filter /FlateDecode >>\nstream\n" + stream +
+               b"endstream\nendobj\n%%EOF")
+        doc = self._run({"field": "data"}, {"data": self._b64(raw)})
+        att = doc["attachment"]
+        assert att["content_type"] == "application/pdf"
+        assert "Hello from a PDF" in att["content"]
+        assert "gluedwords" in att["content"].replace(" ", "")
+
+    def test_rtf_extraction(self):
+        raw = rb"{\rtf1\ansi{\fonttbl\f0 Arial;}\f0 Salut mon ami, c'est le texte pour toi.}"
+        doc = self._run({"field": "data"}, {"data": self._b64(raw)})
+        att = doc["attachment"]
+        assert att["content_type"] == "application/rtf"
+        assert "Salut mon ami" in att["content"]
+
+    def test_indexed_chars_and_properties_and_remove_binary(self):
+        raw = b"the fox " * 100
+        doc = self._run(
+            {"field": "data", "target_field": "att", "indexed_chars": 10,
+             "properties": ["content", "content_type"],
+             "remove_binary": True},
+            {"data": self._b64(raw)})
+        assert doc["att"]["content"] == "the fox th"
+        assert set(doc["att"]) == {"content", "content_type"}
+        assert "data" not in doc     # binary removed
+
+    def test_per_doc_indexed_chars_field(self):
+        doc = self._run(
+            {"field": "data", "indexed_chars_field": "max_chars"},
+            {"data": self._b64(b"abcdefghij"), "max_chars": 4})
+        assert doc["attachment"]["content"] == "abcd"
+
+    def test_missing_and_invalid(self):
+        import pytest as _pytest
+        from elasticsearch_tpu.ingest.service import IngestProcessorError
+        self._run({"field": "data", "ignore_missing": True}, {})
+        with _pytest.raises(IngestProcessorError):
+            self._run({"field": "data"}, {})
+        with _pytest.raises(IngestProcessorError, match="base64"):
+            self._run({"field": "data"}, {"data": "!!!not-base64!!!"})
+        with _pytest.raises(IngestProcessorError, match="integer"):
+            self._run({"field": "data", "indexed_chars_field": "mc"},
+                      {"data": self._b64(b"abc"), "mc": "ten"})
+
+    def test_utf16_text_decodes(self):
+        raw = "unicode text body".encode("utf-16")  # BOM-prefixed
+        doc = self._run({"field": "data"}, {"data": self._b64(raw)})
+        assert doc["attachment"]["content"] == "unicode text body"
+        assert "\x00" not in doc["attachment"]["content"]
+
+    def test_pipeline_end_to_end(self, tmp_path):
+        """attachment through a real pipeline + index + search."""
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        from elasticsearch_tpu.node import Node
+        node = Node(str(tmp_path))
+        node.ingest.put_pipeline("att", {"processors": [
+            {"attachment": {"field": "data", "remove_binary": True}}]})
+        node.index_doc("docs", "1",
+                       {"data": self._b64(b"findable attachment text")},
+                       pipeline="att")
+        node.indices.get("docs").refresh()
+        r = node.search("docs", {"query": {
+            "match": {"attachment.content": "findable"}}})
+        assert r["hits"]["total"]["value"] == 1
+        src = r["hits"]["hits"][0]["_source"]
+        assert "data" not in src
+        node.close()
