@@ -3,16 +3,19 @@
 Re-design of `common/blobstore/` + the repository plugins
 (`repositories/blobstore/BlobStoreRepository.java`, `modules/repository-url`,
 `plugins/repository-{s3,gcs,azure}` — SURVEY.md §2.10): a small byte-keyed
-store interface with four backends:
+store interface with six backends:
 
 - fs      — directory tree (the always-available default)
 - memory  — process-global named stores (test fixture + CI parity)
 - url     — read-only http(s)/file base URL (reference: repository-url)
 - s3      — S3-compatible REST dialect (GET/PUT/DELETE/HEAD on
-            /{bucket}/{key}, ?prefix= listing) against a configurable
-            endpoint — the shape MinIO and the reference's s3-fixture
-            (test/fixtures/s3-fixture) speak. Credentials, when given, go
-            out as basic auth; SigV4 is out of scope for this build.
+            /{bucket}/{key}, ?prefix= listing) with AWS SigV4 signing when
+            credentials are configured — the shape MinIO and the
+            reference's s3-fixture speak
+- gcs     — Google Cloud Storage JSON/media API dialect with bearer-token
+            auth (fake-gcs-server / the real service)
+- azure   — Azure Block Blob dialect with SharedKey request signing
+            (Azurite / the real service)
 """
 
 from __future__ import annotations
@@ -386,6 +389,274 @@ class S3BlobStore(BlobStore):
         return sorted(k[strip:] for k in keys)
 
 
+class GcsBlobStore(BlobStore):
+    """Google Cloud Storage dialect (reference: `plugins/repository-gcs`):
+    the JSON/media API — media upload via
+    `POST /upload/storage/v1/b/{bucket}/o?uploadType=media&name=`, download
+    via `GET /storage/v1/b/{bucket}/o/{object}?alt=media`, paged listing
+    via `GET /storage/v1/b/{bucket}/o?prefix=` — against a configurable
+    `endpoint` (fake-gcs-server / an in-process fixture; the real service
+    with a bearer `token`). Same error taxonomy as S3BlobStore: only 404
+    means missing; everything else is unavailability, never data loss."""
+
+    def __init__(self, endpoint: str, bucket: str, base_path: str = "",
+                 token: str = ""):
+        if not endpoint:
+            raise IllegalArgumentError(
+                "[endpoint] is required for gcs repositories in this build "
+                "(a GCS-compatible service such as fake-gcs-server)")
+        if not bucket:
+            raise IllegalArgumentError(
+                "[bucket] is required for gcs repositories")
+        if not endpoint.startswith(("http://", "https://")):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.base_path = base_path.strip("/")
+        self.token = token
+
+    def _key(self, key: str) -> str:
+        return f"{self.base_path}/{key}" if self.base_path else key
+
+    def _object_url(self, key: str) -> str:
+        return (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+                f"{urllib.parse.quote(self._key(key), safe='')}")
+
+    def _request(self, method: str, url: str, data: Optional[bytes] = None):
+        req = urllib.request.Request(url, data=data, method=method)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        return urllib.request.urlopen(req, timeout=30)
+
+    @staticmethod
+    def _unavailable(op: str, key: str, e: Exception) -> BlobStoreError:
+        return BlobStoreUnavailableError(
+            f"gcs endpoint unavailable during {op} of [{key}]: {e}")
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        url = (f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
+               f"?uploadType=media&name="
+               f"{urllib.parse.quote(self._key(key), safe='')}")
+        try:
+            with self._request("POST", url, data):
+                pass
+        except (urllib.error.HTTPError, urllib.error.URLError) as e:
+            raise self._unavailable("upload", key, e) from None
+
+    def read_blob(self, key: str) -> bytes:
+        try:
+            with self._request("GET",
+                               self._object_url(key) + "?alt=media") as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise BlobStoreError(f"missing blob [{key}]") from None
+            raise self._unavailable("get", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("get", key, e) from None
+
+    def exists(self, key: str) -> bool:
+        try:
+            with self._request("GET", self._object_url(key)):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise self._unavailable("stat", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("stat", key, e) from None
+
+    def delete_blob(self, key: str) -> None:
+        try:
+            with self._request("DELETE", self._object_url(key)):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise self._unavailable("delete", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("delete", key, e) from None
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        import json as _json
+        full_prefix = self._key(prefix)
+        keys: List[str] = []
+        token: Optional[str] = None
+        while True:  # follow nextPageToken pagination
+            url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o?prefix="
+                   f"{urllib.parse.quote(full_prefix, safe='')}")
+            if token:
+                url += f"&pageToken={urllib.parse.quote(token)}"
+            try:
+                with self._request("GET", url) as resp:
+                    page = _json.loads(resp.read())
+            except (urllib.error.HTTPError, urllib.error.URLError) as e:
+                raise BlobStoreError(f"gcs list failed: {e}") from None
+            keys.extend(item["name"] for item in page.get("items", []))
+            token = page.get("nextPageToken")
+            if not token:
+                break
+        strip = len(self.base_path) + 1 if self.base_path else 0
+        return sorted(k[strip:] for k in keys)
+
+
+class AzureBlobStore(BlobStore):
+    """Azure Blob Storage dialect (reference: `plugins/repository-azure`):
+    Block Blob PUT/GET/DELETE on `{endpoint}/{container}/{blob}` with
+    SharedKey request signing when an `account`/`key` pair is configured
+    (Azurite and the real service reject unsigned requests; an unsigned
+    mode remains for bare fixtures), and container listing via
+    `?restype=container&comp=list&prefix=` XML with marker pagination."""
+
+    API_VERSION = "2019-12-12"
+
+    def __init__(self, endpoint: str, container: str, base_path: str = "",
+                 account: str = "", key: str = ""):
+        if not endpoint:
+            raise IllegalArgumentError(
+                "[endpoint] is required for azure repositories in this "
+                "build (Azurite or an in-process fixture)")
+        if not container:
+            raise IllegalArgumentError(
+                "[container] is required for azure repositories")
+        if not endpoint.startswith(("http://", "https://")):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+        self.container = container
+        self.base_path = base_path.strip("/")
+        self.account = account
+        self.key = key
+
+    def _key(self, key: str) -> str:
+        return f"{self.base_path}/{key}" if self.base_path else key
+
+    def _url(self, key: str) -> str:
+        return (f"{self.endpoint}/{self.container}/"
+                f"{urllib.parse.quote(self._key(key))}")
+
+    def _sign(self, req: "urllib.request.Request",
+              payload: Optional[bytes]) -> None:
+        """Azure SharedKey authorization (Blob service)."""
+        import base64
+        import datetime
+        import hmac as hmac_mod
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        date = now.strftime("%a, %d %b %Y %H:%M:%S GMT")
+        req.add_header("x-ms-date", date)
+        req.add_header("x-ms-version", self.API_VERSION)
+        length = str(len(payload)) if payload else ""
+        # urllib would otherwise add its own Content-Type to data-bearing
+        # requests AFTER signing — pin it explicitly so the signed value
+        # and the wire value agree (a signature-checking endpoint rejects
+        # any mismatch)
+        ctype = ""
+        if payload is not None:
+            ctype = "application/octet-stream"
+            req.add_header("Content-Type", ctype)
+        parsed = urllib.parse.urlsplit(req.full_url)
+        canon_headers = "".join(
+            f"{k}:{v}\n" for k, v in sorted(
+                (h.lower(), req.get_header(h.capitalize()) or
+                 req.headers.get(h))
+                for h in req.headers if h.lower().startswith("x-ms-"))
+        )
+        canon_resource = f"/{self.account}{parsed.path}"
+        for qk, qv in sorted(urllib.parse.parse_qsl(
+                parsed.query, keep_blank_values=True)):
+            canon_resource += f"\n{qk}:{qv}"
+        # VERB, Content-Encoding, Content-Language, Content-Length,
+        # Content-MD5, Content-Type, Date, If-Modified-Since, If-Match,
+        # If-None-Match, If-Unmodified-Since, Range
+        string_to_sign = "\n".join([
+            req.get_method(), "", "", length, "", ctype, "", "", "", "",
+            "", "",
+        ]) + canon_headers + canon_resource
+        import hashlib as _hashlib
+        sig = base64.b64encode(hmac_mod.new(
+            base64.b64decode(self.key), string_to_sign.encode(),
+            _hashlib.sha256).digest()).decode()
+        req.add_header("Authorization",
+                       f"SharedKey {self.account}:{sig}")
+
+    def _request(self, method: str, url: str, data: Optional[bytes] = None,
+                 headers: Optional[dict] = None):
+        req = urllib.request.Request(url, data=data, method=method)
+        for hk, hv in (headers or {}).items():
+            req.add_header(hk, hv)
+        if self.account and self.key:
+            self._sign(req, data)
+        return urllib.request.urlopen(req, timeout=30)
+
+    @staticmethod
+    def _unavailable(op: str, key: str, e: Exception) -> BlobStoreError:
+        return BlobStoreUnavailableError(
+            f"azure endpoint unavailable during {op} of [{key}]: {e}")
+
+    def write_blob(self, key: str, data: bytes) -> None:
+        try:
+            with self._request("PUT", self._url(key), data,
+                               {"x-ms-blob-type": "BlockBlob"}):
+                pass
+        except (urllib.error.HTTPError, urllib.error.URLError) as e:
+            raise self._unavailable("put", key, e) from None
+
+    def read_blob(self, key: str) -> bytes:
+        try:
+            with self._request("GET", self._url(key)) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise BlobStoreError(f"missing blob [{key}]") from None
+            raise self._unavailable("get", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("get", key, e) from None
+
+    def exists(self, key: str) -> bool:
+        try:
+            with self._request("HEAD", self._url(key)):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise self._unavailable("head", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("head", key, e) from None
+
+    def delete_blob(self, key: str) -> None:
+        try:
+            with self._request("DELETE", self._url(key)):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise self._unavailable("delete", key, e) from None
+        except urllib.error.URLError as e:
+            raise self._unavailable("delete", key, e) from None
+
+    def list_blobs(self, prefix: str = "") -> List[str]:
+        full_prefix = self._key(prefix)
+        keys: List[str] = []
+        marker: Optional[str] = None
+        while True:  # NextMarker pagination
+            url = (f"{self.endpoint}/{self.container}"
+                   f"?restype=container&comp=list&prefix="
+                   f"{urllib.parse.quote(full_prefix, safe='')}")
+            if marker:
+                url += f"&marker={urllib.parse.quote(marker)}"
+            try:
+                with self._request("GET", url) as resp:
+                    xml = resp.read().decode("utf-8")
+            except (urllib.error.HTTPError, urllib.error.URLError) as e:
+                raise BlobStoreError(f"azure list failed: {e}") from None
+            keys.extend(re.findall(r"<Name>([^<]+)</Name>", xml))
+            m = re.search(r"<NextMarker>([^<]+)</NextMarker>", xml)
+            if m:
+                marker = m.group(1)
+            else:
+                break
+        strip = len(self.base_path) + 1 if self.base_path else 0
+        return sorted(k[strip:] for k in keys)
+
+
 def build_blob_store(rtype: str, settings: dict,
                      node_settings: Optional[dict] = None) -> BlobStore:
     """node_settings: the node's merged settings INCLUDING keystore secure
@@ -427,9 +698,34 @@ def build_blob_store(rtype: str, settings: dict,
             region=str(settings.get(
                 "region", ns.get(f"s3.client.{client_name}.region",
                                  "us-east-1"))))
-    if rtype in ("gcs", "azure", "hdfs"):
+    if rtype == "gcs":
+        client_name = str(settings.get("client", "default"))
+        ns = node_settings or {}
+        return GcsBlobStore(
+            endpoint=str(settings.get(
+                "endpoint",
+                ns.get(f"gcs.client.{client_name}.endpoint", ""))),
+            bucket=settings.get("bucket", ""),
+            base_path=settings.get("base_path", ""),
+            token=str(settings.get(
+                "token", ns.get(f"gcs.client.{client_name}.token", ""))))
+    if rtype == "azure":
+        client_name = str(settings.get("client", "default"))
+        ns = node_settings or {}
+
+        def secure(key_name, inline):
+            return inline or str(
+                ns.get(f"azure.client.{client_name}.{key_name}", ""))
+
+        return AzureBlobStore(
+            endpoint=secure("endpoint", settings.get("endpoint", "")),
+            container=settings.get("container", ""),
+            base_path=settings.get("base_path", ""),
+            account=secure("account", settings.get("account", "")),
+            key=secure("key", settings.get("key", "")))
+    if rtype == "hdfs":
         raise IllegalArgumentError(
-            f"repository type [{rtype}] requires an external service SDK "
-            f"and is not available in this build; use [fs], [url], or an "
-            f"S3-compatible [s3] endpoint")
+            "repository type [hdfs] requires a Hadoop client and is not "
+            "available in this build; use [fs], [url], [s3], [gcs], or "
+            "[azure]")
     raise IllegalArgumentError(f"unknown repository type [{rtype}]")

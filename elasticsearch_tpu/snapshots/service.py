@@ -109,7 +109,7 @@ class Repository:
 
 # back-compat alias (pre-BlobStore callers)
 FsRepository = Repository
-SUPPORTED_TYPES = {"fs", "memory", "url", "s3"}
+SUPPORTED_TYPES = {"fs", "memory", "url", "s3", "gcs", "azure"}
 
 
 class SnapshotService:

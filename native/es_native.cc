@@ -15,8 +15,11 @@
 // Every function is allocation-free: callers pass numpy-owned buffers.
 
 #include <algorithm>
+#include <thread>
+#include <vector>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 #if defined(__x86_64__)
@@ -89,8 +92,12 @@ struct TopK {
             s[size] = score; r[size] = row;
             ++size;
             sift_up(size - 1);
-        } else if (score > s[0]) {
-            // scan is row-ascending: on a tie the incumbent (smaller row) wins
+        } else if (better(score, row, s[0], r[0])) {
+            // full (score desc, row asc) comparison — for the row-ascending
+            // scan this equals `score > s[0]`, but the cross-thread merge
+            // pushes candidates in heap-array order, where a score tie must
+            // still prefer the smaller row or the merged result would
+            // depend on the partition
             s[0] = score; r[0] = row;
             sift_down();
         }
@@ -203,28 +210,20 @@ void knn_i8p_scalar(const KnnPArgs& a) {
 }
 
 #if defined(__x86_64__)
+// One 16-query x [g_lo, g_hi) row-group scan with private heaps — the unit
+// a worker thread executes. Scores are identical however the range is
+// partitioned, and TopK's (score desc, row asc) tie-break makes the merged
+// result bit-identical to the single-threaded scan.
 __attribute__((target("avx512f,avx512bw,avx512vl,avx512vnni")))
-void knn_i8p_vnni(const KnnPArgs& a) {
-    const int64_t ng = (a.n + 15) / 16;
-    int8_t* qi8 = static_cast<int8_t*>(
-        ::operator new(16 * a.d4 * 4, std::align_val_t(64)));
-    float* hs = new float[16 * a.k];
-    int32_t* hr = new int32_t[16 * a.k];
-    for (int64_t q0 = 0; q0 < a.b; q0 += 16) {
-        const int64_t nb = std::min<int64_t>(16, a.b - q0);
-        float qscales[16];
-        int32_t qsums[16];
-        quantize_queries_i8(a.queries + q0 * a.d, nb, a.d, a.d4,
-                            qi8, qscales, qsums);
-        TopK heaps[16];
-        float heapmin[16];
-        for (int64_t qi = 0; qi < nb; ++qi) {
-            heaps[qi] = TopK{hs + qi * a.k, hr + qi * a.k, a.k, 0};
-            heapmin[qi] = -INFINITY;
-        }
+void knn_i8p_vnni_range(const KnnPArgs& a, const int8_t* qi8,
+                        const float* qscales, const int32_t* qsums,
+                        int64_t q0, int64_t nb,
+                        int64_t g_lo, int64_t g_hi, int64_t ng,
+                        TopK* heaps, float* heapmin) {
+    {
         const bool shared_mask = a.mask && a.mask_stride == 0;
         const int64_t qstride = a.d4 * 4;
-        for (int64_t g = 0; g < ng; ++g) {
+        for (int64_t g = g_lo; g < g_hi; ++g) {
             uint16_t gmask = 0xFFFF;
             if (g == ng - 1 && (a.n & 15))
                 gmask = static_cast<uint16_t>((1u << (a.n & 15)) - 1);
@@ -297,13 +296,84 @@ void knn_i8p_vnni(const KnnPArgs& a) {
                 if (h.size == a.k) heapmin[qi] = h.s[0];
             }
         }
+    }
+}
+
+__attribute__((target("avx512f,avx512bw,avx512vl,avx512vnni")))
+void knn_i8p_vnni(const KnnPArgs& a) {
+    const int64_t ng = (a.n + 15) / 16;
+    int8_t* qi8 = static_cast<int8_t*>(
+        ::operator new(16 * a.d4 * 4, std::align_val_t(64)));
+    // thread count: scale with the scan volume (dpbusd steps) so tiny
+    // corpora never pay thread spawn; ES_NATIVE_THREADS pins it
+    int64_t nthreads = 1;
+    const int64_t work = ng * a.d4;
+    if (work >= (64 << 10)) {
+        unsigned hc = std::thread::hardware_concurrency();
+        nthreads = std::min<int64_t>(hc ? hc : 1, 8);
+        nthreads = std::min<int64_t>(nthreads, work / (32 << 10) + 1);
+    }
+    if (const char* env = std::getenv("ES_NATIVE_THREADS")) {
+        const long v = std::strtol(env, nullptr, 10);
+        if (v > 0) nthreads = std::min<long>(v, 64);
+    }
+    nthreads = std::min<int64_t>(nthreads, std::max<int64_t>(ng, 1));
+
+    std::vector<float> hs(static_cast<size_t>(nthreads) * 16 * a.k);
+    std::vector<int32_t> hr(static_cast<size_t>(nthreads) * 16 * a.k);
+    // hoisted out of the block loop; re-initialized per 16-query block.
+    // Threads are (re)spawned per block: the per-block scan is >= ~0.3 ms
+    // per worker at the engagement threshold, so spawn cost stays a few
+    // percent — a pool would only matter for very large query batches
+    std::vector<TopK> heaps(static_cast<size_t>(nthreads) * 16);
+    std::vector<float> heapmin(static_cast<size_t>(nthreads) * 16);
+    for (int64_t q0 = 0; q0 < a.b; q0 += 16) {
+        const int64_t nb = std::min<int64_t>(16, a.b - q0);
+        float qscales[16];
+        int32_t qsums[16];
+        quantize_queries_i8(a.queries + q0 * a.d, nb, a.d, a.d4,
+                            qi8, qscales, qsums);
+        std::fill(heapmin.begin(), heapmin.end(), -INFINITY);
+        for (int64_t t = 0; t < nthreads; ++t)
+            for (int64_t qi = 0; qi < nb; ++qi)
+                heaps[t * 16 + qi] = TopK{
+                    hs.data() + (t * 16 + qi) * a.k,
+                    hr.data() + (t * 16 + qi) * a.k, a.k, 0};
+        if (nthreads == 1) {
+            knn_i8p_vnni_range(a, qi8, qscales, qsums, q0, nb, 0, ng, ng,
+                               heaps.data(), heapmin.data());
+        } else {
+            const int64_t per = (ng + nthreads - 1) / nthreads;
+            std::vector<std::thread> workers;
+            workers.reserve(static_cast<size_t>(nthreads));
+            for (int64_t t = 0; t < nthreads; ++t) {
+                const int64_t lo = t * per;
+                const int64_t hi = std::min(ng, lo + per);
+                if (lo >= hi) break;
+                workers.emplace_back([&, t, lo, hi]() {
+                    knn_i8p_vnni_range(a, qi8, qscales, qsums, q0, nb,
+                                       lo, hi, ng,
+                                       heaps.data() + t * 16,
+                                       heapmin.data() + t * 16);
+                });
+            }
+            for (auto& w : workers) w.join();
+            // ordered merge into thread 0's heaps: TopK's total order on
+            // (score, row) makes the result partition-independent
+            for (int64_t qi = 0; qi < nb; ++qi) {
+                TopK& dst = heaps[qi];
+                for (int64_t t = 1; t < nthreads; ++t) {
+                    TopK& src = heaps[t * 16 + qi];
+                    for (int64_t x = 0; x < src.size; ++x)
+                        dst.push(src.s[x], src.r[x]);
+                }
+            }
+        }
         for (int64_t qi = 0; qi < nb; ++qi)
             emit_topk(heaps[qi], a.k,
                       a.out_scores + (q0 + qi) * a.k,
                       a.out_rows + (q0 + qi) * a.k);
     }
-    delete[] hs;
-    delete[] hr;
     ::operator delete(qi8, std::align_val_t(64));
 }
 #endif

@@ -1,0 +1,236 @@
+"""In-process GCS- and Azure-compatible fixtures (analogs of the
+reference's fake-gcs-server / Azurite test fixtures), for
+`GcsBlobStore` / `AzureBlobStore`:
+
+- GcsFixture: JSON/media API — media upload, `alt=media` download,
+  object stat, delete, and paged listing with `nextPageToken`.
+- AzureFixture: Block Blob PUT/GET/HEAD/DELETE +
+  `?restype=container&comp=list` XML with `NextMarker` pagination.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+
+GCS_PAGE = 2    # tiny pages force the pagination path in tests
+AZURE_PAGE = 2
+
+
+class _GcsHandler(BaseHTTPRequestHandler):
+    store: Dict[Tuple[str, str], bytes] = {}
+
+    def log_message(self, *args):
+        pass
+
+    def _reply(self, code: int, body: bytes = b"",
+               ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        query = {k: v[-1] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+        # /upload/storage/v1/b/{bucket}/o?uploadType=media&name=...
+        parts = parsed.path.strip("/").split("/")
+        if len(parts) >= 6 and parts[0] == "upload" and parts[5] == "o":
+            bucket = parts[4]
+            name = query.get("name", "")
+            length = int(self.headers.get("Content-Length", 0))
+            self.store[(bucket, name)] = self.rfile.read(length)
+            self._reply(200, json.dumps({"name": name}).encode())
+            return
+        self._reply(400)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        query = {k: v[-1] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+        parts = parsed.path.strip("/").split("/")
+        # /storage/v1/b/{bucket}/o[/{object}]
+        if len(parts) >= 5 and parts[0] == "storage" and parts[4] == "o":
+            bucket = parts[3]
+            if len(parts) == 5:  # listing
+                prefix = query.get("prefix", "")
+                names = sorted(k for (b, k) in self.store
+                               if b == bucket and k.startswith(prefix))
+                start = int(query.get("pageToken", 0) or 0)
+                page = names[start:start + GCS_PAGE]
+                out = {"items": [{"name": n} for n in page]}
+                if start + GCS_PAGE < len(names):
+                    out["nextPageToken"] = str(start + GCS_PAGE)
+                self._reply(200, json.dumps(out).encode())
+                return
+            name = urllib.parse.unquote(parts[5])
+            blob = self.store.get((bucket, name))
+            if blob is None:
+                self._reply(404)
+                return
+            if query.get("alt") == "media":
+                self._reply(200, blob, "application/octet-stream")
+            else:  # stat
+                self._reply(200, json.dumps(
+                    {"name": name, "size": str(len(blob))}).encode())
+            return
+        self._reply(400)
+
+    def do_DELETE(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = parsed.path.strip("/").split("/")
+        if len(parts) >= 6 and parts[0] == "storage" and parts[4] == "o":
+            key = (parts[3], urllib.parse.unquote(parts[5]))
+            if key in self.store:
+                del self.store[key]
+                self._reply(204)
+            else:
+                self._reply(404)
+            return
+        self._reply(400)
+
+
+class _AzureHandler(BaseHTTPRequestHandler):
+    store: Dict[Tuple[str, str], bytes] = {}
+    # when set to (account, base64_key), every request must carry a valid
+    # SharedKey Authorization header — the Azurite-grade check that keeps
+    # the client's signing code honest
+    require_auth: Tuple[str, str] = ()
+
+    def _check_auth(self, payload_len: int) -> bool:
+        if not self.require_auth:
+            return True
+        import base64
+        import hashlib
+        import hmac
+        account, key_b64 = self.require_auth
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith(f"SharedKey {account}:"):
+            return False
+        presented = auth.rsplit(":", 1)[1]
+        parsed = urllib.parse.urlsplit(self.path)
+        canon_headers = "".join(
+            f"{k.lower()}:{v}\n" for k, v in sorted(
+                (h, self.headers[h]) for h in self.headers
+                if h.lower().startswith("x-ms-")))
+        canon_resource = f"/{account}{parsed.path}"
+        for qk, qv in sorted(urllib.parse.parse_qsl(
+                parsed.query, keep_blank_values=True)):
+            canon_resource += f"\n{qk}:{qv}"
+        length = str(payload_len) if payload_len else ""
+        ctype = self.headers.get("Content-Type", "") if payload_len else ""
+        string_to_sign = "\n".join([
+            self.command, "", "", length, "", ctype, "", "", "", "", "",
+            "",
+        ]) + canon_headers + canon_resource
+        expect = base64.b64encode(hmac.new(
+            base64.b64decode(key_b64), string_to_sign.encode(),
+            hashlib.sha256).digest()).decode()
+        return hmac.compare_digest(presented, expect)
+
+    def log_message(self, *args):
+        pass
+
+    def _parse(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        container = parts[0]
+        blob = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        query = {k: v[-1] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+        return container, blob, query
+
+    def _reply(self, code: int, body: bytes = b"",
+               ctype: str = "application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        container, blob, _q = self._parse()
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length)
+        if not self._check_auth(length):
+            self._reply(403)
+            return
+        self.store[(container, blob)] = data
+        self._reply(201)
+
+    def do_GET(self):
+        container, blob, query = self._parse()
+        if not self._check_auth(0):
+            self._reply(403)
+            return
+        if query.get("comp") == "list":
+            prefix = query.get("prefix", "")
+            names = sorted(k for (c, k) in self.store
+                           if c == container and k.startswith(prefix))
+            start = int(query.get("marker", 0) or 0)
+            page = names[start:start + AZURE_PAGE]
+            marker = (f"<NextMarker>{start + AZURE_PAGE}</NextMarker>"
+                      if start + AZURE_PAGE < len(names) else "")
+            xml = ("<?xml version=\"1.0\"?><EnumerationResults><Blobs>"
+                   + "".join(f"<Blob><Name>{n}</Name></Blob>" for n in page)
+                   + f"</Blobs>{marker}</EnumerationResults>").encode()
+            self._reply(200, xml, "application/xml")
+            return
+        data = self.store.get((container, blob))
+        if data is None:
+            self._reply(404)
+        else:
+            self._reply(200, data)
+
+    def do_HEAD(self):
+        container, blob, _q = self._parse()
+        if (container, blob) in self.store:
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def do_DELETE(self):
+        container, blob, _q = self._parse()
+        if (container, blob) in self.store:
+            del self.store[(container, blob)]
+            self._reply(202)
+        else:
+            self._reply(404)
+
+
+class _Fixture:
+    handler = None
+
+    def __init__(self):
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), self.handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class GcsFixture(_Fixture):
+    handler = _GcsHandler
+
+
+class AzureFixture(_Fixture):
+    handler = _AzureHandler

@@ -337,12 +337,15 @@ def test_snapshot_and_restore(client, tmp_path):
     _, listing = client.req("GET", "/_snapshot/backup/_all")
     assert [s["snapshot"] for s in listing["snapshots"]] == ["snap1"]
 
-    # s3 without an endpoint, and SDK-dependent types, are gated clearly
+    # endpoint-less cloud repos, and SDK-dependent types, are gated clearly
     status, body = client.req("PUT", "/_snapshot/cloud",
                               {"type": "s3", "settings": {"bucket": "b"}})
     assert status == 400 and "endpoint" in body["error"]["reason"]
     status, body = client.req("PUT", "/_snapshot/cloud",
                               {"type": "gcs", "settings": {"bucket": "b"}})
+    assert status == 400 and "endpoint" in body["error"]["reason"]
+    status, body = client.req("PUT", "/_snapshot/cloud",
+                              {"type": "hdfs", "settings": {}})
     assert status == 400 and "not available" in body["error"]["reason"]
 
 

@@ -114,3 +114,31 @@ def test_topk_k_exceeds_n_and_randomized():
         assert len(idx) == kk
         want = np.argsort(-scores, kind="stable")[:kk]
         np.testing.assert_array_equal(idx, want)
+
+
+def test_knn_i8p_threaded_matches_single_thread(monkeypatch):
+    """The row-range-parallel VNNI scan is bit-identical to the
+    single-threaded scan: scores don't depend on the partition and TopK's
+    (score desc, row asc) total order makes the merge deterministic."""
+    import numpy as np
+
+    from elasticsearch_tpu import native
+    from elasticsearch_tpu.vectors.host_corpus import HostFieldCorpus
+
+    if not native.AVAILABLE or not native.knn_has_vnni():
+        import pytest
+        pytest.skip("native VNNI kernel unavailable")
+
+    rng = np.random.default_rng(17)
+    n, d, b, k = 50_000, 96, 5, 12
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    corpus = HostFieldCorpus(vecs, "cosine")
+    queries = rng.standard_normal((b, d)).astype(np.float32)
+
+    for nt in ("7", "4"):  # odd split exercises uneven tail ranges
+        monkeypatch.setenv("ES_NATIVE_THREADS", "1")
+        s1, r1 = corpus.search(queries, k)
+        monkeypatch.setenv("ES_NATIVE_THREADS", nt)
+        sn, rn = corpus.search(queries, k)
+        np.testing.assert_array_equal(r1, rn)
+        np.testing.assert_array_equal(s1, sn)
