@@ -409,7 +409,9 @@ def run_hybrid_rrf():
                 "v": rng.standard_normal(dims).astype(np.float32).tolist()})
             pos += 12
         node.bulk(ops)
-    node.indices.get("hybrid").refresh()
+    # one segment, like every reference benchmark setup (merge() ends
+    # with its own refresh + vector re-sync)
+    node.indices.get("hybrid").force_merge()
     build_s = time.perf_counter() - t_build0
 
     def body_for(qv, terms):

@@ -180,7 +180,10 @@ def knn_search_auto(
     Preference order:
       1. binned Pallas kernel (TPU, dot-like metric, no filter, tiled
          corpus, k within candidate budget) — ~7x the exact path at
-         recall ≈ 1.0 for 1M-doc corpora (pallas_knn_binned.py);
+         recall ≈ 1.0 for 1M-doc corpora (pallas_knn_binned.py). A corpus
+         carrying the residual rescore level (index_options.rescore)
+         additionally re-ranks the kernel's own top candidates at
+         near-exact precision — a few % QPS for the recall headroom;
       2. exact XLA matmul + lax.top_k (all metrics, filters, any backend).
     """
     from elasticsearch_tpu.ops import pallas_knn_binned as binned
@@ -193,6 +196,9 @@ def knn_search_auto(
             and precision == "bf16"):
         try:
             if jax.devices()[0].platform in ("tpu", "axon"):
+                if corpus.residual is not None:
+                    return binned.binned_knn_search_rescored_packed(
+                        queries, corpus, k, metric=metric)
                 return binned.binned_knn_search(queries, corpus, k, metric=metric)
         except Exception:
             pass

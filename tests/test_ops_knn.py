@@ -244,3 +244,35 @@ def test_int8_residual_reconstruction():
     c2 = knn_ops.build_corpus(vecs, metric=sim.COSINE, dtype="int8",
                               residual=False)
     assert c2.residual is None
+
+
+def test_auto_router_uses_residual_rescore(monkeypatch):
+    """A corpus carrying the residual level routes knn_search_auto through
+    the packed rescore on TPU backends (the production effect of
+    index_options.rescore: true)."""
+    from elasticsearch_tpu.ops import knn as knn_ops
+    from elasticsearch_tpu.ops import pallas_knn_binned as binned
+    from elasticsearch_tpu.ops import similarity as sim
+
+    rng = np.random.default_rng(5)
+    n = binned.BLOCK_N
+    vecs = rng.standard_normal((n, 32)).astype(np.float32)
+    c_res = knn_ops.build_corpus(vecs, metric=sim.COSINE, dtype="int8",
+                                 pad_to=n)
+    c_plain = knn_ops.build_corpus(vecs, metric=sim.COSINE, dtype="int8",
+                                   pad_to=n, residual=False)
+    calls = []
+    monkeypatch.setattr(
+        binned, "binned_knn_search_rescored_packed",
+        lambda *a, **k: calls.append("rescored") or (None, None))
+    monkeypatch.setattr(
+        binned, "binned_knn_search",
+        lambda *a, **k: calls.append("base") or (None, None))
+
+    class FakeDev:
+        platform = "tpu"
+    monkeypatch.setattr(knn_ops.jax, "devices", lambda: [FakeDev()])
+    q = jnp.asarray(rng.standard_normal((2, 32)).astype(np.float32))
+    knn_ops.knn_search_auto(q, c_res, k=5, metric=sim.COSINE)
+    knn_ops.knn_search_auto(q, c_plain, k=5, metric=sim.COSINE)
+    assert calls == ["rescored", "base"]
