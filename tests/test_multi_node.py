@@ -696,3 +696,35 @@ def test_persistent_task_runs_on_exactly_one_node_and_fails_over(cluster):
     t2 = cluster.nodes[new_owners[0]].cluster_state.metadata[
         PERSISTENT_TASKS_KEY]["bg"]
     assert t2["assigned_node"] == new_owners[0]
+
+
+def test_scripted_metric_across_shards(cluster):
+    """scripted_metric through the REAL distributed path: each shard runs
+    init/map/combine and ships only its combined state over the wire;
+    reduce_script folds the states at the coordinator — the distributed
+    result equals the arithmetic ground truth."""
+    c = cluster
+    c.any_node().client_create_index(
+        "sm", settings={"index.number_of_shards": 2,
+                        "index.number_of_replicas": 0},
+        mappings={"properties": {"v": {"type": "double"}}})
+    assert c.run_until(lambda: c.all_started("sm"))
+    writer = c.any_node()
+    for i in range(40):
+        r = c.call(writer.client_write, "sm",
+                   {"type": "index", "id": str(i),
+                    "source": {"v": float(i)}})
+        assert r["result"] == "created", r
+    for node in c.nodes.values():
+        node.refresh_all()
+    resp = c.call(c.any_node().client_search, "sm", {
+        "size": 0,
+        "aggs": {"total": {"scripted_metric": {
+            "init_script": "state.s = 0.0",
+            "map_script": "state.s += doc['v'].value",
+            "combine_script": "return state.s",
+            "reduce_script":
+                "double t = 0; for (a in states) { t += a } return t"}}}})
+    assert resp["aggregations"]["total"]["value"] == float(sum(range(40)))
+    # two shards -> two combined states folded in the reduce
+    assert resp["_shards"]["successful"] == 2
