@@ -1,0 +1,146 @@
+"""Seed-hosts providers: dynamic discovery of transport addresses.
+
+Reference: `discovery.seed_providers` — `plugins/discovery-ec2`
+(DescribeInstances over the EC2 Query API), `plugins/discovery-gce`
+(instances list over the compute JSON API), and the built-in `file`
+provider (`config/unicast_hosts.txt`, one host:port per line, reloaded
+every resolution). Providers APPEND to any statically configured
+`discovery.seed_hosts`; failures return an empty list and log — a cloud
+API outage must never crash node boot (SeedHostsResolver swallows
+per-provider errors the same way).
+
+Settings:
+  discovery.seed_providers: comma list of file | ec2 | gce
+  discovery.ec2.endpoint:   EC2-compatible Query API endpoint
+  discovery.ec2.tag.<k>:    instance tag filters (value may be a list)
+  discovery.ec2.host_type:  private_ip (default) | public_ip
+  discovery.gce.endpoint:   GCE-compatible API endpoint
+  discovery.gce.project / discovery.gce.zone
+  transport.default_port:   port appended to bare discovered IPs (9300)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List
+
+log = logging.getLogger("elasticsearch_tpu.discovery")
+
+DEFAULT_TRANSPORT_PORT = 9300
+
+
+def _with_port(host: str, settings: Dict[str, Any]) -> str:
+    port = int(settings.get("transport.default_port",
+                            DEFAULT_TRANSPORT_PORT))
+    if host.startswith("["):
+        # bracketed IPv6, with or without an explicit port
+        return host if re.match(r"^\[.*\]:\d+$", host) else f"{host}:{port}"
+    if host.count(":") >= 2:
+        # bare IPv6: ':' membership would misread its separators as a port
+        return f"[{host}]:{port}"
+    if ":" in host:
+        return host
+    return f"{host}:{port}"
+
+
+def _file_hosts(settings: Dict[str, Any], data_path: str) -> List[str]:
+    """The built-in file provider: config/unicast_hosts.txt, re-read on
+    every resolution so operators can edit it live (FileBasedSeedHostsProvider)."""
+    path = str(settings.get(
+        "discovery.file.path",
+        os.path.join(data_path, "config", "unicast_hosts.txt")))
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(_with_port(line, settings))
+    return out
+
+
+def _ec2_hosts(settings: Dict[str, Any]) -> List[str]:
+    """EC2 Query API DescribeInstances against a configurable endpoint
+    (localstack / an in-process fixture / real EC2). Tag filters via
+    `discovery.ec2.tag.<name>`; running instances only, like the
+    reference's AwsEc2SeedHostsProvider."""
+    endpoint = str(settings.get("discovery.ec2.endpoint", ""))
+    if not endpoint:
+        return []
+    if not endpoint.startswith(("http://", "https://")):
+        endpoint = "http://" + endpoint
+    params = [("Action", "DescribeInstances"), ("Version", "2013-10-15"),
+              ("Filter.1.Name", "instance-state-name"),
+              ("Filter.1.Value.1", "running")]
+    fidx = 2
+    for key, value in sorted(settings.items()):
+        if not str(key).startswith("discovery.ec2.tag."):
+            continue
+        tag = str(key)[len("discovery.ec2.tag."):]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        params.append((f"Filter.{fidx}.Name", f"tag:{tag}"))
+        for vi, v in enumerate(values, 1):
+            params.append((f"Filter.{fidx}.Value.{vi}", str(v)))
+        fidx += 1
+    url = endpoint + "/?" + urllib.parse.urlencode(params)
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        xml = resp.read().decode("utf-8", errors="replace")
+    field = ("ipAddress"
+             if settings.get("discovery.ec2.host_type") == "public_ip"
+             else "privateIpAddress")
+    hosts = re.findall(rf"<{field}>([^<]+)</{field}>", xml)
+    return [_with_port(h, settings) for h in hosts]
+
+
+def _gce_hosts(settings: Dict[str, Any]) -> List[str]:
+    """GCE compute instances list (JSON) against a configurable endpoint
+    (the reference's GceSeedHostsProvider reads networkInterfaces[0]
+    .networkIP of RUNNING instances)."""
+    import json
+    endpoint = str(settings.get("discovery.gce.endpoint", ""))
+    if not endpoint:
+        return []
+    if not endpoint.startswith(("http://", "https://")):
+        endpoint = "http://" + endpoint
+    project = str(settings.get("discovery.gce.project", "default"))
+    zone = str(settings.get("discovery.gce.zone", "default"))
+    url = (f"{endpoint}/compute/v1/projects/{project}/zones/{zone}"
+           f"/instances")
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = json.loads(resp.read())
+    hosts = []
+    for item in body.get("items", []):
+        if item.get("status") not in (None, "RUNNING"):
+            continue
+        nics = item.get("networkInterfaces") or []
+        if nics and nics[0].get("networkIP"):
+            hosts.append(nics[0]["networkIP"])
+    return [_with_port(h, settings) for h in hosts]
+
+
+def resolve_seed_hosts(settings: Dict[str, Any],
+                       data_path: str = ".") -> List[str]:
+    """All provider-discovered seed addresses for this node, deduplicated,
+    order-preserving. Per-provider failures log and contribute nothing."""
+    providers = settings.get("discovery.seed_providers", "")
+    if isinstance(providers, str):
+        providers = [p.strip() for p in providers.split(",") if p.strip()]
+    out: List[str] = []
+    for name in providers:
+        try:
+            if name == "file":
+                out.extend(_file_hosts(settings, data_path))
+            elif name == "ec2":
+                out.extend(_ec2_hosts(settings))
+            elif name == "gce":
+                out.extend(_gce_hosts(settings))
+            else:
+                log.warning("unknown seed provider [%s]", name)
+        except Exception:  # noqa: BLE001 — discovery outage ≠ boot failure
+            log.warning("seed provider [%s] failed", name, exc_info=True)
+    return list(dict.fromkeys(out))

@@ -88,8 +88,23 @@ def main(argv=None) -> int:
         return [v.strip() for v in str(value).split(",") if v.strip()]
 
     seed_hosts = _csv(settings.get("discovery.seed_hosts"))
+    seed_providers_configured = bool(settings.get("discovery.seed_providers"))
+    if seed_providers_configured:
+        # dynamic seed discovery (discovery-ec2/gce + the file provider)
+        # appends to any static list; provider outages log, never block
+        # boot — the discovery loop re-resolves, so peers that were
+        # unreachable at boot are found later
+        from elasticsearch_tpu.cluster.seed_providers import (
+            resolve_seed_hosts,
+        )
+        seed_hosts = list(dict.fromkeys(
+            seed_hosts + resolve_seed_hosts(settings, args.data)))
     initial_masters = _csv(settings.get("cluster.initial_master_nodes"))
-    cluster_mode = bool(seed_hosts or initial_masters)
+    # a configured provider makes this a CLUSTER node even when its first
+    # resolution came back empty (a cloud-API blip must not silently boot
+    # an independent single-node cluster on the shared data dir)
+    cluster_mode = bool(seed_hosts or initial_masters
+                        or seed_providers_configured)
 
     if cluster_mode:
         return _run_clustered(args, settings, seed_hosts, initial_masters,
@@ -213,12 +228,31 @@ def _run_clustered(args, settings, seed_hosts, initial_masters, bootstrap) -> in
 
         # seed-host discovery loop (PeerFinder analog): keep probing the
         # configured addresses until every one resolves to a node id, and
-        # keep re-probing slowly afterwards so restarted peers re-resolve
+        # keep re-probing slowly afterwards so restarted peers re-resolve.
+        # Configured providers re-resolve every pass (the reference's
+        # FileBasedSeedHostsProvider / cloud providers are live lists:
+        # autoscaling additions and unicast_hosts.txt edits take effect
+        # without a restart).
         async def discover():
+            use_providers = bool(settings.get("discovery.seed_providers"))
+            targets = list(seed_hosts)
             while True:
+                if use_providers:
+                    from elasticsearch_tpu.cluster.seed_providers import (
+                        resolve_seed_hosts,
+                    )
+                    dynamic = await asyncio.to_thread(
+                        resolve_seed_hosts, settings, args.data)
+                    static = settings.get("discovery.seed_hosts") or ""
+                    static_list = ([s.strip() for s in str(static).split(",")
+                                    if s.strip()]
+                                   if not isinstance(static, (list, tuple))
+                                   else list(static))
+                    targets = list(dict.fromkeys(static_list + dynamic))
                 all_known = True
-                for hp in seed_hosts:
+                for hp in targets:
                     h, _, p = hp.rpartition(":")
+                    h = h.strip("[]")  # bracketed IPv6
                     if not h or not p.isdigit():
                         continue
                     try:

@@ -1,0 +1,143 @@
+"""Seed-hosts providers (discovery-ec2 / discovery-gce / file):
+dynamic transport-address discovery against API-shaped fixtures, with
+per-provider failure isolation (a cloud outage never blocks boot)."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from elasticsearch_tpu.cluster.seed_providers import resolve_seed_hosts
+
+
+class _Ec2Handler(BaseHTTPRequestHandler):
+    instances = []  # (private_ip, public_ip, state, tags)
+    last_query = {}
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlsplit(self.path).query))
+        type(self).last_query = q
+        # honor instance-state + tag filters like DescribeInstances
+        wanted = []
+        for ip, pub, state, tags in self.instances:
+            ok = state == "running"
+            i = 2
+            while f"Filter.{i}.Name" in q:
+                name = q[f"Filter.{i}.Name"]
+                vals = [v for k, v in q.items()
+                        if k.startswith(f"Filter.{i}.Value.")]
+                if name.startswith("tag:"):
+                    ok = ok and tags.get(name[4:]) in vals
+                i += 1
+            if ok:
+                wanted.append((ip, pub))
+        body = ("<DescribeInstancesResponse>" + "".join(
+            f"<item><privateIpAddress>{ip}</privateIpAddress>"
+            f"<ipAddress>{pub}</ipAddress>"
+            f"<instanceState><name>running</name></instanceState></item>"
+            for ip, pub in wanted) + "</DescribeInstancesResponse>").encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class _GceHandler(BaseHTTPRequestHandler):
+    items = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({"items": self.items}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class _Svc:
+    def __init__(self, handler):
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.endpoint = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.t = threading.Thread(target=self.server.serve_forever,
+                                  daemon=True)
+
+    def __enter__(self):
+        self.t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_ec2_provider_with_tag_filter():
+    _Ec2Handler.instances = [
+        ("10.0.0.1", "54.0.0.1", "running", {"es": "yes"}),
+        ("10.0.0.2", "54.0.0.2", "running", {"es": "no"}),
+        ("10.0.0.3", "54.0.0.3", "stopped", {"es": "yes"}),
+    ]
+    with _Svc(_Ec2Handler) as svc:
+        hosts = resolve_seed_hosts({
+            "discovery.seed_providers": "ec2",
+            "discovery.ec2.endpoint": svc.endpoint,
+            "discovery.ec2.tag.es": "yes"})
+        assert hosts == ["10.0.0.1:9300"]
+        # public-ip host_type + custom default port
+        hosts = resolve_seed_hosts({
+            "discovery.seed_providers": "ec2",
+            "discovery.ec2.endpoint": svc.endpoint,
+            "discovery.ec2.host_type": "public_ip",
+            "discovery.ec2.tag.es": "yes",
+            "transport.default_port": 9377})
+        assert hosts == ["54.0.0.1:9377"]
+
+
+def test_gce_provider_running_only():
+    _GceHandler.items = [
+        {"status": "RUNNING",
+         "networkInterfaces": [{"networkIP": "10.1.0.1"}]},
+        {"status": "TERMINATED",
+         "networkInterfaces": [{"networkIP": "10.1.0.2"}]},
+        {"status": "RUNNING", "networkInterfaces": []},
+    ]
+    with _Svc(_GceHandler) as svc:
+        hosts = resolve_seed_hosts({
+            "discovery.seed_providers": "gce",
+            "discovery.gce.endpoint": svc.endpoint,
+            "discovery.gce.project": "p", "discovery.gce.zone": "z"})
+        assert hosts == ["10.1.0.1:9300"]
+
+
+def test_file_provider_and_failure_isolation(tmp_path):
+    cfg = tmp_path / "config"
+    cfg.mkdir()
+    (cfg / "unicast_hosts.txt").write_text(
+        "# comment\n10.2.0.1\n10.2.0.2:9301\n\n")
+    # ec2 endpoint refused (no server) must not poison the file provider
+    hosts = resolve_seed_hosts({
+        "discovery.seed_providers": "ec2,file",
+        "discovery.ec2.endpoint": "http://127.0.0.1:9"},
+        data_path=str(tmp_path))
+    assert hosts == ["10.2.0.1:9300", "10.2.0.2:9301"]
+
+
+def test_dedup_and_unknown_provider():
+    hosts = resolve_seed_hosts({
+        "discovery.seed_providers": "bogus"})
+    assert hosts == []
+
+
+def test_ipv6_hosts_bracket_correctly():
+    from elasticsearch_tpu.cluster.seed_providers import _with_port
+    assert _with_port("fd00::1", {}) == "[fd00::1]:9300"
+    assert _with_port("[fd00::1]", {}) == "[fd00::1]:9300"
+    assert _with_port("[fd00::1]:9301", {}) == "[fd00::1]:9301"
+    assert _with_port("10.0.0.1:9301", {}) == "10.0.0.1:9301"
+    assert _with_port("10.0.0.1", {"transport.default_port": 9400}) \
+        == "10.0.0.1:9400"
