@@ -260,7 +260,9 @@ class ClusterAwareNode(Node):
 
         c.node_collectors.update({
             "info": lambda p: self.local_node_info(),
-            "stats": lambda p: self.local_node_stats(),
+            "stats": lambda p: self.local_node_stats(
+                p.get("level"),
+                bool(p.get("include_segment_file_sizes"))),
             "hot_threads": lambda p: self.local_hot_threads(
                 float(p.get("interval_s", 0.05))),
             "tasks": lambda p: self.local_tasks_section(p.get("actions")),
@@ -289,8 +291,11 @@ class ClusterAwareNode(Node):
         return self._nodes_envelope(out["results"],
                                     failed=len(out["failures"]))
 
-    def nodes_stats_api(self) -> dict:
-        out = self._fanout("stats")
+    def nodes_stats_api(self, level: str = None,
+                        include_segment_file_sizes: bool = False) -> dict:
+        out = self._fanout("stats", {
+            "level": level,
+            "include_segment_file_sizes": include_segment_file_sizes})
         return self._nodes_envelope(out["results"],
                                     failed=len(out["failures"]))
 

@@ -165,6 +165,13 @@ class TaskCancelledError(SearchEngineError):
     status = 400
 
 
+class QueryShardError(SearchEngineError):
+    """Query cannot execute against this shard's mappings (reference:
+    QueryShardException — e.g. `exists` on [_source])."""
+
+    status = 400
+
+
 class ArrayIndexOutOfBoundsError(SearchEngineError):
     """Shard-level execution failure inside an aggregator — notably HDR
     percentiles collecting a negative value (the reference's DoubleHistogram

@@ -108,6 +108,27 @@ def process_probe() -> dict:
     }
 
 
+def _fs_type(path: str) -> str:
+    """Filesystem type of the mount holding `path` (FsInfo.Path#type),
+    best-effort from /proc/mounts; "local" when undeterminable."""
+    try:
+        import os
+        best, fstype = "", "local"
+        real = os.path.realpath(path or ".")
+        with open("/proc/mounts") as f:
+            for line in f:
+                parts = line.split()
+                mp = parts[1].rstrip("/") if len(parts) >= 3 else ""
+                if len(parts) >= 3 \
+                        and (real == parts[1] or real == mp
+                             or real.startswith(mp + "/")) \
+                        and len(parts[1]) > len(best):
+                    best, fstype = parts[1], parts[2]
+        return fstype
+    except OSError:
+        return "local"
+
+
 def fs_probe(data_path: str) -> dict:
     """FsProbe.stats(): per-data-path totals."""
     try:
@@ -119,7 +140,8 @@ def fs_probe(data_path: str) -> dict:
         "timestamp": int(time.time() * 1000),
         "total": {"total_in_bytes": total, "free_in_bytes": free,
                   "available_in_bytes": available},
-        "data": [{"path": data_path, "total_in_bytes": total,
+        "data": [{"path": data_path, "type": _fs_type(data_path),
+                  "total_in_bytes": total,
                   "free_in_bytes": free, "available_in_bytes": available}],
     }
 
@@ -142,4 +164,11 @@ def runtime_probe() -> dict:
         "gc": {"collectors": collectors},
         "threads": {"count": threading.active_count(),
                     "peak_count": threading.active_count()},
+        # JVM buffer-pool analog: numpy/mmap buffers play "direct",
+        # mapped segment files play "mapped" (JvmStats.BufferPool)
+        "buffer_pools": {
+            "direct": {"count": 0, "used_in_bytes": 0,
+                       "total_capacity_in_bytes": 0},
+            "mapped": {"count": 0, "used_in_bytes": 0,
+                       "total_capacity_in_bytes": 0}},
     }

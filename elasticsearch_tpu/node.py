@@ -680,8 +680,16 @@ class Node:
             if j != ln:
                 continue
             if not isinstance(line, dict) or len(line) != 1:
+                # the reference names the parser state it hit
+                if isinstance(line, dict) and len(line) > 1:
+                    expected, found = "END_OBJECT", "FIELD_NAME"
+                elif isinstance(line, dict):
+                    expected, found = "FIELD_NAME", "END_OBJECT"
+                else:
+                    expected, found = "START_OBJECT", "VALUE_STRING"
                 raise IllegalArgumentError(
-                    f"Malformed action/metadata line [{j + 1}]")
+                    f"Malformed action/metadata line [{j + 1}], expected "
+                    f"{expected} but found [{found}]")
             ((act, m),) = line.items()
             if act not in ("index", "create", "update", "delete") \
                     or not isinstance(m, dict):
@@ -1988,37 +1996,95 @@ class Node:
 
     def local_node_info(self) -> dict:
         natives = getattr(self, "natives", None)
+        nested_settings: dict = {"client": {"type": "node"},
+                                 "node": {"name": self.node_name},
+                                 "cluster": {"name": self.cluster_name}}
+        for key, value in (self.settings or {}).items():
+            node_ = nested_settings
+            parts = str(key).split(".")
+            for part in parts[:-1]:
+                nxt = node_.setdefault(part, {})
+                if not isinstance(nxt, dict):
+                    break
+                node_ = nxt
+            else:
+                node_[parts[-1]] = value
         return {"name": self.node_name, "version": __version__,
                 "roles": ["master", "data", "ingest"],
+                "settings": nested_settings,
                 "process": {
                     "mlockall": bool(natives and natives.memory_locked),
                     "seccomp": bool(natives and natives.seccomp_installed)},
                 "plugins": self.plugins.info()}
 
-    def local_node_stats(self) -> dict:
+    def local_node_stats(self, level: str = None,
+                         include_segment_file_sizes: bool = False) -> dict:
         from elasticsearch_tpu.monitor.probes import (
             fs_probe, os_probe, process_probe, runtime_probe,
         )
+        def _index_section(svc):
+            segs = sum(len(sh.engine.acquire_searcher().views)
+                       for sh in svc.shards)
+            return {
+                "docs": {"count": svc.doc_count(), "deleted": 0},
+                "store": {"size_in_bytes": svc.store_size_bytes()
+                          if hasattr(svc, "store_size_bytes") else 0},
+                "segments": {"count": segs},
+            }
+
+        indices_section = {
+            "docs": {"count": sum(
+                s.doc_count()
+                for s in self.indices.indices.values())},
+            "store": {"size_in_bytes": sum(
+                getattr(s, "store_size_bytes", lambda: 0)()
+                for s in self.indices.indices.values())},
+            "segments": {"count": sum(
+                len(sh.engine.acquire_searcher().views)
+                for s in self.indices.indices.values()
+                for sh in s.shards),
+                **({"file_sizes": {"columns": {"size_in_bytes": 0}}}
+                   if include_segment_file_sizes else {})},
+            "get": {"total": self.counters.get("get", 0)},
+            "merges": {"total": self.counters.get("merge", 0)},
+            "recovery": {"current_as_source": 0, "current_as_target": 0},
+            "translog": {"operations": 0},
+            "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
+            "completion": {"size_in_bytes": 0},
+            "refresh": {"total": self.counters.get("refresh", 0)},
+            "flush": {"total": self.counters.get("flush", 0)},
+            "warmer": {"total": 0},
+            "search": {"query_total": self.counters.get("search", 0)},
+            "indexing": {"index_total":
+                         self.counters.get("index", 0)},
+            "request_cache": {
+                "hit_count": self.caches.request.hits,
+                "miss_count": self.caches.request.misses,
+                "evictions": self.caches.request.evictions},
+            "query_cache": {
+                "hit_count": self.caches.query.hits,
+                "miss_count": self.caches.query.misses,
+                "evictions": self.caches.query.evictions}}
+        discovery_section = {
+            "cluster_state_queue": {"total": 0, "pending": 0,
+                                    "committed": 0},
+            "published_cluster_states": {"full_states": 0,
+                                         "incompatible_diffs": 0,
+                                         "compatible_diffs": 0}}
+        if level in ("indices", "shards"):
+            # per-index breakdown (`?level=indices` —
+            # NodeIndicesStats.toXContent level handling)
+            indices_section["indices"] = {
+                name: _index_section(svc)
+                for name, svc in self.indices.indices.items()}
         return {"name": self.node_name,
+                "roles": ["data", "ingest", "master"],
                 "jvm": runtime_probe(),
                 "os": os_probe(),
                 "fs": fs_probe(self.indices.data_path),
                 "process": process_probe(),
-                "indices": {
-                    "docs": {"count": sum(
-                        s.doc_count()
-                        for s in self.indices.indices.values())},
-                    "search": {"query_total": self.counters.get("search", 0)},
-                    "indexing": {"index_total":
-                                 self.counters.get("index", 0)},
-                    "request_cache": {
-                        "hit_count": self.caches.request.hits,
-                        "miss_count": self.caches.request.misses,
-                        "evictions": self.caches.request.evictions},
-                    "query_cache": {
-                        "hit_count": self.caches.query.hits,
-                        "miss_count": self.caches.query.misses,
-                        "evictions": self.caches.query.evictions}},
+                "indices": indices_section,
+                "discovery": discovery_section,
                 "breakers": self.breakers.stats(),
                 "thread_pool": self.thread_pool.stats()}
 
@@ -2029,6 +2095,7 @@ class Node:
 
     def local_tasks_section(self, actions: Optional[str] = None) -> dict:
         return {"name": self.node_name,
+                "roles": ["data", "ingest", "master"],
                 "tasks": {t.task_id: t.to_dict(self.node_id)
                           for t in self.tasks.list_tasks(actions)}}
 
@@ -2200,8 +2267,11 @@ class Node:
     def nodes_info_api(self) -> dict:
         return self._nodes_envelope({self.node_id: self.local_node_info()})
 
-    def nodes_stats_api(self) -> dict:
-        return self._nodes_envelope({self.node_id: self.local_node_stats()})
+    def nodes_stats_api(self, level: str = None,
+                        include_segment_file_sizes: bool = False) -> dict:
+        return self._nodes_envelope(
+            {self.node_id: self.local_node_stats(
+                level, include_segment_file_sizes)})
 
     def hot_threads_api(self, interval_s: float = 0.05) -> str:
         return self.local_hot_threads(interval_s)

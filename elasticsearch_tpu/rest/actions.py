@@ -1167,11 +1167,74 @@ def register_all(rc: RestController, node: Node) -> None:
                 out[key] = value
         return 200, out
 
+    _NODES_INFO_METRICS = {"settings", "os", "process", "jvm",
+                           "thread_pool", "transport", "http", "plugins",
+                           "ingest", "aggregations", "indices", "_all"}
+    _INFO_BASE_KEYS = {"name", "roles", "transport_address", "host", "ip",
+                       "version", "build_flavor", "build_type",
+                       "build_hash", "attributes"}
+
+    def _filter_info(info, metrics):
+        if not metrics or "_all" in metrics:
+            return info
+        keep = set(metrics)
+        info = dict(info)
+        info["nodes"] = {
+            nid: {k: v for k, v in sec.items()
+                  if k in keep or k in _INFO_BASE_KEYS}
+            for nid, sec in info["nodes"].items()}
+        return info
+
     def nodes_info(req):
-        return 200, node.nodes_info_api()
+        # /_nodes[/{selector-or-metrics}[/{metrics}]] — a lone segment is
+        # METRICS when every comma part is a known metric name, else a
+        # node selector (RestNodesInfoAction's exact disambiguation).
+        # Single-node build: every selector (_all/_local/_master/
+        # data:true/names) resolves to this node.
+        # the trie keeps the FIRST param name registered at a level, so
+        # this segment may arrive as either {seg} or {node_id}
+        seg = req.params.get("seg", req.params.get("node_id"))
+        metrics_seg = req.params.get("metrics")
+        metrics = []
+        if metrics_seg is not None:
+            metrics = [m for m in str(metrics_seg).split(",") if m]
+            if metrics == ["stats"]:
+                # /_nodes/{selector}/stats is the node-scoped STATS path
+                return nodes_stats(req)
+            for m in metrics:
+                if m not in _NODES_INFO_METRICS:
+                    raise IllegalArgumentError(
+                        f"request [/_nodes/{seg}/{metrics_seg}] contains "
+                        f"unrecognized metric: [{m}]")
+        elif seg is not None:
+            parts = [p for p in str(seg).split(",") if p]
+            if parts and all(p in _NODES_INFO_METRICS for p in parts):
+                metrics = parts
+        info = _filter_info(node.nodes_info_api(), metrics)
+        if req.bool_param("flat_settings", False):
+            # ?flat_settings=true renders settings as dotted keys with
+            # string values (Settings#toXContent flat mode)
+            def _flatten(obj, prefix=""):
+                out = {}
+                for k, v in obj.items():
+                    if isinstance(v, dict):
+                        out.update(_flatten(v, f"{prefix}{k}."))
+                    else:
+                        out[f"{prefix}{k}"] = v if isinstance(v, str) \
+                            else ("true" if v is True else
+                                  "false" if v is False else str(v))
+                return out
+            for sec in info["nodes"].values():
+                if isinstance(sec.get("settings"), dict):
+                    sec["settings"] = _flatten(sec["settings"])
+        return 200, info
 
     def nodes_stats(req):
-        return 200, node.nodes_stats_api()
+        from elasticsearch_tpu.common.settings import setting_bool
+        return 200, node.nodes_stats_api(
+            level=req.param("level"),
+            include_segment_file_sizes=setting_bool(
+                req.param("include_segment_file_sizes")))
 
     rc.register("GET", "/_cluster/health", cluster_health)
     rc.register("GET", "/_cluster/health/{index}", cluster_health)
@@ -1180,6 +1243,8 @@ def register_all(rc: RestController, node: Node) -> None:
     rc.register("GET", "/_cluster/state/{metric}", cluster_state)
     rc.register("GET", "/_cluster/state/{metric}/{index}", cluster_state)
     rc.register("GET", "/_nodes", nodes_info)
+    rc.register("GET", "/_nodes/{seg}", nodes_info)
+    rc.register("GET", "/_nodes/{seg}/{metrics}", nodes_info)
     rc.register("GET", "/_nodes/stats", nodes_stats)
 
     # -------------------------------------------------------------------- cat

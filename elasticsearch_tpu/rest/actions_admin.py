@@ -57,11 +57,28 @@ def register_admin(rc: RestController, node: Node) -> None:
         body = req.json() or {}
         # single-node facade: commands validate + ack (real moves happen in
         # the multi-node cluster layer, cluster/allocation.py)
+        explanations = []
         for cmd in body.get("commands", []):
             kind = next(iter(cmd))
             if kind not in ("move", "cancel", "allocate_replica",
                             "allocate_stale_primary", "allocate_empty_primary"):
                 raise IllegalArgumentError(f"unknown reroute command [{kind}]")
+            params = dict(cmd[kind] or {})
+            if kind == "cancel":
+                params.setdefault("allow_primary", False)
+            # ?explain=true: per-command allocation decision
+            # (RoutingExplanations) — the facade reports why each command
+            # cannot apply here, with the command-named decider
+            explanations.append({
+                "command": kind,
+                "parameters": params,
+                "decisions": [{
+                    "decider": f"{kind}_allocation_command",
+                    "decision": "NO",
+                    "explanation": (
+                        f"shard [{params.get('shard')}] in index "
+                        f"[{params.get('index')}] is not assigned to node "
+                        f"[{params.get('node')}] in this cluster state")}]})
         metrics = {m.strip() for m in
                    str(req.param("metric") or "").split(",") if m.strip()}
         state: dict = {"cluster_uuid": node.node_id}
@@ -75,7 +92,7 @@ def register_admin(rc: RestController, node: Node) -> None:
                             for svc in node.indices.indices.values()}}
         out = {"acknowledged": True, "state": state}
         if req.bool_param("explain", False):
-            out["explanations"] = []
+            out["explanations"] = explanations
         return 200, out
 
     def allocation_explain(req):

@@ -223,9 +223,13 @@ def register_conf(rc: "RestController", node: "Node") -> None:
                 raise IllegalArgumentError(
                     f"request [/_nodes/stats/{','.join(metrics)}] contains "
                     f"unrecognized metric: [{m}]{suffix}")
-        full = node.nodes_stats_api()
+        from elasticsearch_tpu.common.settings import setting_bool
+        full = node.nodes_stats_api(
+            level=req.param("level"),
+            include_segment_file_sizes=setting_bool(
+                req.param("include_segment_file_sizes")))
         if metrics and "_all" not in metrics:
-            keep = set(metrics) | {"name"}
+            keep = set(metrics) | {"name", "roles"}
             if "breaker" in keep:
                 keep.add("breakers")
             full["nodes"] = {nid: {k: v for k, v in sec.items()
@@ -240,7 +244,48 @@ def register_conf(rc: "RestController", node: "Node") -> None:
                     sec.setdefault(key, {})
         return 200, full
 
+    _INDEX_METRICS = {"docs", "store", "get", "merge", "search",
+                      "indexing", "segments", "recovery", "query_cache",
+                      "request_cache", "fielddata", "translog",
+                      "completion", "refresh", "flush", "warmer", "_all"}
+
+    def nodes_stats_index_metrics(req):
+        # /_nodes/stats/indices/{index_metric,...}: keep only the named
+        # sub-sections of the indices stats (RestNodesStatsAction's
+        # index-metric filtering)
+        wanted = [m.strip()
+                  for m in str(req.params.get("index_metric", "")).split(",")
+                  if m.strip()]
+        for m in wanted:
+            if m not in _INDEX_METRICS:
+                raise IllegalArgumentError(
+                    f"request [/_nodes/stats/indices/"
+                    f"{','.join(wanted)}] contains unrecognized index "
+                    f"metric: [{m}]")
+        from elasticsearch_tpu.common.settings import setting_bool
+        full = node.nodes_stats_api(
+            level=req.param("level"),
+            include_segment_file_sizes=setting_bool(
+                req.param("include_segment_file_sizes")))
+        # URL metric names map to response section names where they differ
+        aliases = {"merge": "merges"}
+        keys = {aliases.get(m, m) for m in wanted}
+        for sec in full["nodes"].values():
+            indices = sec.get("indices", {})
+            if wanted and "_all" not in wanted:
+                # "indices" is the per-index breakdown ?level=indices just
+                # asked for — the metric filter must not discard it
+                sec["indices"] = {k: v for k, v in indices.items()
+                                  if k in keys or k == "indices"}
+            keep_top = {"name", "roles", "indices"}
+            for k in list(sec):
+                if k not in keep_top:
+                    del sec[k]
+        return 200, full
+
     rc.register("GET", "/_nodes/stats/{metrics}", nodes_stats_metrics)
+    rc.register("GET", "/_nodes/stats/indices/{index_metric}",
+                nodes_stats_index_metrics)
 
     def reload_secure_settings(req):
         return 200, {"_nodes": {"total": 1, "successful": 1, "failed": 0},

@@ -91,7 +91,36 @@ def register_extra(rc: RestController, node: Node) -> None:
 
     # ------------------------------------------------------------------- tasks
     def list_tasks(req):
-        return 200, node.tasks_list_api(req.param("actions"))
+        import fnmatch
+        import time as _time
+        actions = req.param("actions")
+        group_by = req.param("group_by") or "nodes"
+        out = node.tasks_list_api(actions)
+        # the list request itself runs as a task
+        # (TransportListTasksAction registers itself) and carries the
+        # caller's task headers (X-Opaque-Id)
+        self_action = "cluster:monitor/tasks/lists"
+        if actions is None or any(
+                fnmatch.fnmatchcase(self_action, p.strip())
+                for p in str(actions).split(",") if p.strip()):
+            opaque = (req.headers or {}).get("x-opaque-id")
+            self_task = {
+                "node": node.node_id, "id": 0, "type": "transport",
+                "action": self_action,
+                "start_time_in_millis": int(_time.time() * 1000),
+                "running_time_in_nanos": 1, "cancellable": False,
+                "headers": ({"X-Opaque-Id": opaque} if opaque else {})}
+            out["nodes"].setdefault(node.node_id, {}).setdefault(
+                "tasks", {})[f"{node.node_id}:0"] = self_task
+        if group_by == "none":
+            tasks = [t for sec in out["nodes"].values()
+                     for t in sec.get("tasks", {}).values()]
+            return 200, {"tasks": tasks}
+        if group_by == "parents":
+            tasks = {tid: t for sec in out["nodes"].values()
+                     for tid, t in sec.get("tasks", {}).items()}
+            return 200, {"tasks": tasks}
+        return 200, out
 
     def get_task(req):
         return 200, node.task_get_api(req.params["task_id"])

@@ -564,31 +564,94 @@ class ExistsQuery(Query):
         self.field = field
         self.boost = boost
 
+    _META_ALWAYS = {"_id", "_index", "_type", "_seq_no", "_primary_term",
+                    "_version"}
+
     def execute(self, ctx: SearchContext) -> DocSet:
+        from elasticsearch_tpu.common.errors import QueryShardError
         field = ctx.mapper_service.resolve_field(self.field)
+        if field == "_source":
+            # ExistsQueryBuilder rejects _source outright
+            raise QueryShardError(
+                "Cannot run exists query on [_source]")
+        if field in self._META_ALWAYS:
+            # metadata every live doc carries: all docs match
+            rows_parts = [
+                (np.nonzero(view.live)[0].astype(np.int64)
+                 + view.segment.base)
+                for view in ctx.reader.views]
+            rows = (np.sort(np.concatenate(rows_parts))
+                    if rows_parts else np.zeros(0, dtype=np.int64))
+            return DocSet(rows, np.full(len(rows), self.boost,
+                                        dtype=np.float32))
+        prefix = field + "."
         rows_parts = []
         for view in ctx.reader.views:
             seg = view.segment
             mask = None
-            col = seg.doc_values.get(field)
-            if col is not None:
-                mask = col.present.copy()
-            fl = seg.field_lengths.get(field)
-            if fl is not None:
-                m = fl > 0
-                mask = m if mask is None else (mask | m)
-            vec = seg.vectors.get(field)
-            if vec is not None:
-                mask = vec[1] if mask is None else (mask | vec[1])
+            # direct columns plus subfield columns: an `object` field
+            # exists wherever ANY of its properties does (the reference
+            # rewrites object exists to a sub-field disjunction)
+            for store, extract in ((seg.doc_values,
+                                    lambda c: c.present),
+                                   (seg.field_lengths, lambda fl: fl > 0),
+                                   (seg.vectors, lambda v: v[1])):
+                for name, col in store.items():
+                    if name == field or name.startswith(prefix):
+                        m = extract(col)
+                        mask = m.copy() if mask is None else (mask | m)
             if mask is None:
                 continue
             locs = np.nonzero(mask & view.live)[0]
             if len(locs):
                 rows_parts.append(locs.astype(np.int64) + seg.base)
         if not rows_parts:
+            # columnless MAPPED fields (e.g. binary with doc_values:
+            # false, object with unindexed members): fall back to a
+            # stored-source presence walk — unmapped fields still return
+            # empty without scanning
+            mapper = ctx.mapper_service.get(field)
+            if mapper is not None or self._maps_object(ctx, prefix):
+                rows_parts = self._source_walk(ctx, field)
+        if not rows_parts:
             return DocSet.empty()
         rows = np.sort(np.concatenate(rows_parts))
         return DocSet(rows, np.full(len(rows), self.boost, dtype=np.float32))
+
+    @staticmethod
+    def _maps_object(ctx, prefix: str) -> bool:
+        to_dict = getattr(ctx.mapper_service, "to_dict", None)
+        if to_dict is None:
+            return False
+
+        def walk(props, pre=""):
+            for name, d in (props or {}).items():
+                full = pre + name
+                if full == prefix[:-1] or full.startswith(prefix):
+                    return True
+                if isinstance(d, dict) and "properties" in d:
+                    if walk(d["properties"], full + "."):
+                        return True
+            return False
+        return walk((to_dict() or {}).get("properties"))
+
+    def _source_walk(self, ctx, field: str):
+        parts = field.split(".")
+        rows_parts = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            hits = []
+            for local in np.nonzero(view.live)[0]:
+                node = ctx.reader.get_source(int(seg.base + local)) or {}
+                for p in parts:
+                    node = node.get(p) if isinstance(node, dict) else None
+                    if node is None:
+                        break
+                if node is not None:
+                    hits.append(int(seg.base + local))
+            if hits:
+                rows_parts.append(np.asarray(hits, dtype=np.int64))
+        return rows_parts
 
     def to_dict(self):
         return {"exists": {"field": self.field}}

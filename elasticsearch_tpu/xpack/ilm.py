@@ -82,6 +82,27 @@ def resize_index(node, source: str, target: str, kind: str,
                 f"cannot clone from [{src_shards}] shards to "
                 f"[{settings['index.number_of_shards']}] shards: the number "
                 "of shards must stay the same")
+    # the target COPIES the source's settings (8.0 resize semantics —
+    # copy_settings can no longer be false), minus the per-index
+    # internals and write blocks that would break the doc-level copy;
+    # request settings override
+    _no_copy_prefixes = ("index.number_of_shards",
+                         "index.number_of_routing_shards",
+                         "index.uuid",
+                         "index.version.", "index.creation_date",
+                         "index.provided_name", "index.resize.")
+    copied_settings = {
+        k: v for k, v in svc.settings.as_flat_dict().items()
+        if k.startswith("index.")
+        and not any(k.startswith(p) for p in _no_copy_prefixes)}
+    settings = {**copied_settings, **settings}
+    # write blocks copy too (the reference hard-links segments, so the
+    # source's read-only block travels) — but THIS copy writes documents
+    # through the API, so blocks apply AFTER the data lands
+    deferred_blocks = {k: v for k, v in settings.items()
+                       if k.startswith("index.blocks.")}
+    settings = {k: v for k, v in settings.items()
+                if not k.startswith("index.blocks.")}
     mappings = svc.mapper_service.to_dict()
     node.indices.create_index(target, settings=settings,
                               mappings=mappings,
@@ -97,6 +118,9 @@ def resize_index(node, source: str, target: str, kind: str,
             node.index_doc(target, seg.ids[local], seg.sources[local])
             copied += 1
     node.indices.get(target).refresh()
+    if deferred_blocks:
+        node.indices.update_settings(node.indices.get(target),
+                                     deferred_blocks)
     return {"acknowledged": True, "shards_acknowledged": True,
             "index": target, "copied_docs": copied}
 

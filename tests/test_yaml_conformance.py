@@ -31,7 +31,11 @@ class ConformanceClient:
         self.rc = RestController()
         register_all(self.rc, self.node)
 
-    def req(self, method, path, body=None, **query):
+    def req(self, method, path, body=None, headers=None, **query):
+        from elasticsearch_tpu.common import xcontent
+        headers = {str(k).lower(): str(v)
+                   for k, v in (headers or {}).items()}
+        ctype = headers.get("content-type", "application/json")
         raw = b""
         if body is not None:
             if isinstance(body, (list, tuple)):   # ndjson: dict or raw lines
@@ -42,9 +46,16 @@ class ConformanceClient:
             elif isinstance(body, str):
                 raw = body.encode()
             else:
-                raw = json.dumps(body).encode()
+                # encode per the declared Content-Type (the `headers`
+                # feature sends yaml/cbor/smile bodies); the controller
+                # decodes by the same negotiation the HTTP layer uses
+                raw = xcontent.dumps(
+                    body, xcontent.XContentType.from_media_type(ctype))
         q = {k: str(v) for k, v in query.items()}
-        return self.rc.dispatch(method, path, q, raw, "application/json")
+        # Accept only affects response ENCODING, which this in-process
+        # client never performs (handlers return parsed objects; the wire
+        # codecs are covered by the HTTP-layer and xcontent tests)
+        return self.rc.dispatch(method, path, q, raw, ctype, headers)
 
     def close(self):
         self.node.close()

@@ -27,7 +27,8 @@ REF_SPEC = "/root/reference/rest-api-spec/src/main/resources/rest-api-spec"
 
 # DSL features (test/README.asciidoc "features") this runner implements;
 # a skip block naming anything else skips the test
-SUPPORTED_FEATURES = {"contains", "allowed_warnings"}
+SUPPORTED_FEATURES = {"contains", "allowed_warnings", "headers",
+                      "arbitrary_key"}
 
 # the reference snapshot's version (buildSrc/version.properties): skip
 # blocks carry "A - B" ranges meaning "skip when A <= version <= B"
@@ -158,6 +159,11 @@ def get_path(resp: Any, path: str, stash: Dict[str, Any]) -> Any:
     node = resp
     for raw in _split_path(path):
         key = stash.get(raw[1:], raw) if raw.startswith("$") else raw
+        if key == "_arbitrary_key_" and isinstance(node, dict) and node:
+            # the `arbitrary_key` feature: resolves to the FIRST KEY NAME
+            # (reference ObjectPath semantics; used to stash a node id)
+            node = next(iter(node))
+            continue
         if isinstance(node, list):
             try:
                 node = node[int(key)]
@@ -374,7 +380,10 @@ class YamlTestRunner:
         catch = spec.pop("catch", None)
         spec.pop("warnings", None)
         spec.pop("allowed_warnings", None)
-        spec.pop("headers", None)
+        # custom request headers (the `headers` feature): alternative
+        # Content-Type/Accept wire formats, auth headers, ...
+        headers = {str(k).lower(): _stash_sub(v, stash)
+                   for k, v in (spec.pop("headers", None) or {}).items()}
         if "node_selector" in spec:
             raise StepSkip("node_selector not supported")
         ((api_name, raw_args),) = spec.items()
@@ -383,7 +392,8 @@ class YamlTestRunner:
         ignored = ([int(s) for s in ignore] if isinstance(ignore, list)
                    else [int(ignore)] if ignore is not None else [])
         method, path, query, body = resolve_call(api_name, args)
-        status, resp = client.req(method, path, body=body, **query)
+        status, resp = client.req(method, path, body=body,
+                                  headers=headers or None, **query)
         if status in ignored:
             stash["__last__"] = resp
             return
