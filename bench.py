@@ -95,8 +95,9 @@ def _probe_backend(timeout_s: float) -> tuple[bool, str]:
     return True, r.stdout.split("PROBE_OK", 1)[1].strip()
 
 
-def _run_child(timeout_s: float) -> tuple[int, str, str]:
-    env = dict(os.environ, _BENCH_CHILD="1")
+def _run_child(timeout_s: float, extra_env: dict | None = None
+               ) -> tuple[int, str, str]:
+    env = dict(os.environ, _BENCH_CHILD="1", **(extra_env or {}))
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            capture_output=True, text=True, timeout=timeout_s,
@@ -107,6 +108,41 @@ def _run_child(timeout_s: float) -> tuple[int, str, str]:
             return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
         return -1, _txt(e.stdout), _txt(e.stderr) or \
             f"bench child hung > {timeout_s:.0f}s (killed)"
+
+
+def _cpu_floor_line(reason: str, errors: list, remaining_s: float) -> bool:
+    """TPU acquisition failed: measure the same program on the CPU backend
+    and emit it clearly labeled `"backend": "cpu_floor"` — a lower bound on
+    the metric instead of an evidence-free `value: 0` (three of five past
+    rounds went evidence-free exactly here). Returns True if a line was
+    printed."""
+    budget = min(420, remaining_s - 10)
+    if budget < 120:  # not enough wall clock left for a meaningful floor
+        return False
+    try:
+        _rc, out, _err = _run_child(
+            budget, extra_env={"JAX_PLATFORMS": "cpu", "BENCH_SMALL": "1"})
+    except Exception:  # the floor is best-effort: never mask the
+        return False   # diagnostic line below
+    line = next((l for l in reversed(out.splitlines())
+                 if l.startswith("{")), None)
+    if line is None:
+        return False
+    try:
+        parsed = json.loads(line)
+    except ValueError:
+        return False
+    if not parsed.get("value"):
+        return False
+    parsed["backend"] = "cpu_floor"
+    parsed["cpu_floor_note"] = (
+        "TPU backend unavailable; CPU-backend lower bound on a "
+        f"{parsed.get('n_docs')}-doc subsample — NOT the device number")
+    parsed["error"] = reason
+    parsed["probe_errors"] = errors[-2:]
+    parsed["last_known_good"] = _last_known_good()
+    print(json.dumps(parsed))
+    return True
 
 
 def main():
@@ -143,9 +179,12 @@ def main():
         if attempt < max_attempts and remaining() > 300:
             time.sleep(min(120, 10 * 2 ** min(attempt - 1, 4)))
     if platform is None:
+        if _cpu_floor_line("tpu_backend_unavailable", errors, remaining()):
+            sys.exit(1)  # still a failed capture — but with evidence
         print(json.dumps({
             "metric": METRIC, "value": 0, "unit": "qps", "vs_baseline": 0,
             "error": "tpu_backend_unavailable",
+            "backend": "none",
             "probe_errors": errors[-2:],
             "last_known_good": _last_known_good(),
         }))
@@ -177,6 +216,8 @@ def main():
         last_err = " | ".join(last_err) if isinstance(last_err, list) else last_err
         if remaining() < 150:
             break
+    if _cpu_floor_line("bench_child_failed", [last_err], remaining()):
+        sys.exit(1)
     print(json.dumps({
         "metric": METRIC, "value": 0, "unit": "qps", "vs_baseline": 0,
         "error": "bench_child_failed", "detail": last_err,

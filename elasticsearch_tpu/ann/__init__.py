@@ -1,0 +1,33 @@
+"""Partitioned ANN engine (`index.knn.engine: tpu_ivf`).
+
+IVF in the style of Faiss IVF-Flat (Johnson et al., 2019) and ScaNN's
+partitioned search (Guo et al., 2020), re-shaped for the MXU: a tiny
+centroid matmul routes each query to `nprobe` of `nlist` k-means
+partitions, then a dense per-partition matmul + `lax.top_k` scores only
+~`nprobe/nlist` of the corpus — trading a small, *measured* recall budget
+for an order-of-magnitude FLOP/HBM reduction over the exhaustive scan in
+`ops/knn.py`.
+
+Layout is gather-free at the row level: partitions are stored bucketed and
+padded to a common tile-aligned capacity (`[nlist, cap, D]`), so pruned
+scoring is block `take` + batched matmul — no per-row gathers ever touch
+HBM.
+
+  kmeans.py     on-device mini-batch k-means (k-means++ seeding, soft
+                balance penalty) that trains the `nlist` centroids
+  ivf_index.py  partition layout over the stored corpus: capped bucketed
+                build, incremental add with displacement/spill accounting
+                and a retrain threshold, int8 via ops/quantization
+  router.py     query-time engine: centroid routing, nprobe selection
+                (`"auto"` tunes against a held-out sample to a recall
+                target), per-phase route/score/merge timings, and the
+                exhaustive-fallback escape hatch
+
+The device kernel itself lives in `ops/knn_ivf.py` beside its exhaustive
+sibling `ops/knn.py`.
+"""
+
+from elasticsearch_tpu.ann.ivf_index import IVFIndex, build_ivf_index
+from elasticsearch_tpu.ann.router import IVFRouter
+
+__all__ = ["IVFIndex", "IVFRouter", "build_ivf_index"]

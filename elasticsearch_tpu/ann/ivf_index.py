@@ -1,0 +1,283 @@
+"""IVF partition layout over the stored corpus.
+
+Builds the bucketed, padded-to-tile partition matrices the pruned kernel
+(`ops/knn_ivf.py`) scores, from the same host vectors `vectors/store.py`
+feeds `ops/knn.build_corpus`:
+
+  * centroids train on device (`ann/kmeans.py`), then rows place into
+    capacity-capped buckets: first-choice partition when it has room,
+    else the nearest partition that does (displacement). The cap bounds
+    the padded tile size — one oversized partition would tax every probe
+    of every query — and total capacity (`nlist * cap >= slack * n`)
+    guarantees placement;
+  * incremental `add` appends into the host mirror of the bucket layout
+    and re-uploads lazily at the next search; adds that miss their
+    first-choice partition count as displaced, and once displaced + spill
+    exceed `retrain_threshold` of the corpus (or the corpus outgrows the
+    trained layout) `needs_retrain` flips — the store then rebuilds from
+    scratch like any refresh re-sync;
+  * int8 storage reuses `ops/quantization.quantize_int8_np` per row;
+    sq-norms ride along for l2.
+
+Row ids stored in the layout are *device-corpus rows* (indices into the
+flat `Corpus` matrix), so IVF results join the engine's row maps exactly
+like exhaustive results do.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from elasticsearch_tpu.ann import kmeans as kmeans_lib
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.ops.quantization import quantize_int8_np
+
+# partition capacity is padded to this many rows (f32 sublane tile)
+CAP_PAD = 8
+# capacity slack over the perfectly-balanced size: bounds both padding
+# waste and the displacement rate
+DEFAULT_SLACK = 1.5
+# corpora below nlist * this never benefit from pruning: stay exhaustive
+MIN_ROWS_PER_LIST = 8
+
+
+def _routing_matrix(centroids: np.ndarray, metric: str) -> np.ndarray:
+    """Centroids as the query-time router sees them: unit-normalized for
+    cosine (spherical routing: max-dot == nearest-angle), raw otherwise."""
+    if metric == sim.COSINE:
+        norms = np.linalg.norm(centroids, axis=-1, keepdims=True)
+        return centroids / np.maximum(norms, 1e-30)
+    return centroids
+
+
+def _routing_scores(x: np.ndarray, centroids: np.ndarray,
+                    metric: str) -> np.ndarray:
+    """[n, nlist] bigger-is-better routing scores, same convention as
+    `ops/knn_ivf.route` so build-time placement and query-time probing
+    agree by construction."""
+    dots = x @ centroids.T
+    if metric == sim.L2_NORM:
+        c_sq = np.einsum("kd,kd->k", centroids, centroids)
+        x_sq = np.einsum("nd,nd->n", x, x)
+        return 2.0 * dots - x_sq[:, None] - c_sq[None, :]
+    return dots
+
+
+class IVFIndex:
+    """Host mirror + device pytree of one field's partition layout."""
+
+    def __init__(self, centroids: np.ndarray, cap: int, metric: str,
+                 dtype: str, retrain_threshold: float = 0.2):
+        nlist, dims = centroids.shape
+        self.metric = metric
+        self.dtype = dtype
+        self.dims = dims
+        self.nlist = nlist
+        self.cap = cap
+        self.retrain_threshold = float(retrain_threshold)
+        self.centroids = _routing_matrix(
+            np.asarray(centroids, dtype=np.float32), metric)
+        # host mirrors of the bucket layout
+        self.part_vecs = np.zeros((nlist, cap, dims), dtype=np.float32)
+        self.part_rows = np.full((nlist, cap), -1, dtype=np.int32)
+        self.counts = np.zeros(nlist, dtype=np.int64)
+        self.trained_on = 0   # corpus size the centroids were trained on
+        self.displaced = 0    # rows not in their first-choice partition
+        self.spilled = 0      # rows that found no capacity at all
+        self._device = None   # lazy IVFPartitions pytree
+
+    # ------------------------------------------------------------- build
+
+    @property
+    def total(self) -> int:
+        return int(self.counts.sum())
+
+    @property
+    def scored_rows_per_probe(self) -> int:
+        """Padded rows the kernel scores per probed partition."""
+        return self.cap
+
+    def scored_fraction(self, nprobe: int) -> float:
+        """Upper bound on the corpus fraction scored per query."""
+        if self.total == 0:
+            return 1.0
+        return min(1.0, nprobe * self.cap / self.total)
+
+    @property
+    def needs_retrain(self) -> bool:
+        total = self.total
+        if total == 0:
+            return False
+        if self.spilled > 0:
+            return True
+        if (self.displaced + self.spilled) / total > self.retrain_threshold:
+            return True
+        # the layout was sized for trained_on rows; growth past the
+        # capacity headroom degrades routing even without displacement
+        return self.trained_on > 0 and total > 2 * self.trained_on
+
+    def _place(self, vecs: np.ndarray, rows: np.ndarray,
+               count_displaced: bool = True) -> None:
+        """Greedy capacity-capped placement: first-choice when it has
+        room, else nearest-with-room among the top candidates.
+
+        The first-choice pass is vectorized per partition (one slice
+        write per bucket); only capacity overflow walks the per-row
+        fallback loop — a few % of rows at the default slack."""
+        if len(rows) == 0:
+            return
+        rows = np.asarray(rows, dtype=np.int32)
+        scores = _routing_scores(vecs, self.centroids, self.metric)
+        first = np.argmin(-scores, axis=1)  # argmax, ties to lowest pid
+        order = np.argsort(first, kind="stable")
+        bounds = np.searchsorted(first[order], np.arange(self.nlist + 1))
+        leftover = []
+        for pid in range(self.nlist):
+            grp = order[bounds[pid]:bounds[pid + 1]]
+            if len(grp) == 0:
+                continue
+            c = int(self.counts[pid])
+            take = grp[: max(0, self.cap - c)]
+            if len(take):
+                self.part_vecs[pid, c:c + len(take)] = vecs[take]
+                self.part_rows[pid, c:c + len(take)] = rows[take]
+                self.counts[pid] = c + len(take)
+            leftover.extend(grp[len(take):])
+
+        if leftover:
+            leftover = np.asarray(leftover)
+            n_choices = min(self.nlist, 8)
+            sub = scores[leftover]
+            choice = np.argpartition(-sub, n_choices - 1,
+                                     axis=1)[:, :n_choices] \
+                if n_choices < self.nlist else \
+                np.tile(np.arange(self.nlist), (len(leftover), 1))
+            ordc = np.take_along_axis(sub, choice, axis=1).argsort(axis=1)
+            choice = np.take_along_axis(choice, ordc[:, ::-1], axis=1)
+            for i, ri in enumerate(leftover):
+                placed = False
+                for pid in choice[i][1:]:  # [0] is the full first choice
+                    c = int(self.counts[pid])
+                    if c < self.cap:
+                        self.part_vecs[pid, c] = vecs[ri]
+                        self.part_rows[pid, c] = rows[ri]
+                        self.counts[pid] = c + 1
+                        if count_displaced:
+                            self.displaced += 1
+                        placed = True
+                        break
+                if not placed:
+                    # every candidate bucket is full: fall back to the
+                    # emptiest partition anywhere, else record a spill
+                    pid = int(np.argmin(self.counts))
+                    c = int(self.counts[pid])
+                    if c < self.cap:
+                        self.part_vecs[pid, c] = vecs[ri]
+                        self.part_rows[pid, c] = rows[ri]
+                        self.counts[pid] = c + 1
+                        if count_displaced:
+                            self.displaced += 1
+                    else:
+                        self.spilled += 1
+        self._device = None
+
+    def add(self, vecs: np.ndarray, rows: np.ndarray) -> None:
+        """Incremental add (post-build refresh delta): place into the host
+        mirror; the device pytree refreshes lazily at the next search."""
+        vecs = np.asarray(vecs, dtype=np.float32)
+        if self.metric == sim.COSINE:
+            norms = np.linalg.norm(vecs, axis=-1, keepdims=True)
+            vecs = vecs / np.maximum(norms, 1e-30)
+        self._place(vecs, np.asarray(rows, dtype=np.int32))
+
+    # ------------------------------------------------------------ device
+
+    def device_partitions(self):
+        """The IVFPartitions pytree, uploading the host mirror on first
+        use and after any add()."""
+        if self._device is not None:
+            return self._device
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops.knn_ivf import IVFPartitions
+
+        valid = self.part_rows >= 0
+        part_sq = np.einsum("kcd,kcd->kc", self.part_vecs, self.part_vecs)
+        if self.dtype == "int8":
+            flat = self.part_vecs.reshape(-1, self.dims)
+            q8, scales = quantize_int8_np(flat)
+            parts = jnp.asarray(q8.reshape(self.nlist, self.cap, self.dims))
+            part_scales = jnp.asarray(
+                np.where(valid, scales.reshape(self.nlist, self.cap), 0.0)
+                .astype(np.float32))
+        else:
+            mm = jnp.bfloat16 if self.dtype == "bf16" else jnp.float32
+            parts = jnp.asarray(self.part_vecs, dtype=mm)
+            part_scales = jnp.asarray(valid.astype(np.float32))
+        self._device = IVFPartitions(
+            centroids=jnp.asarray(self.centroids),
+            centroid_sq=jnp.asarray(
+                np.einsum("kd,kd->k", self.centroids, self.centroids)
+                .astype(np.float32)),
+            parts=parts,
+            part_scales=part_scales,
+            part_sq=jnp.asarray(part_sq.astype(np.float32)),
+            part_rows=jnp.asarray(self.part_rows))
+        return self._device
+
+
+def pick_nlist(n: int, dims: int) -> int:
+    """Default partition count: ~sqrt(n) rounded to a power of two, the
+    Faiss guidance that balances route cost (nlist·D) against scored rows
+    (n/nlist·nprobe·D) — equal at nlist ≈ sqrt(n·nprobe)."""
+    if n <= 0:
+        return 1
+    target = max(1, int(np.sqrt(n)))
+    return 1 << max(0, int(round(np.log2(target))))
+
+
+def build_ivf_index(vectors: np.ndarray, rows: Optional[np.ndarray] = None,
+                    *, metric: str = sim.COSINE, nlist: Optional[int] = None,
+                    dtype: str = "bf16", seed: int = 0,
+                    slack: float = DEFAULT_SLACK,
+                    retrain_threshold: float = 0.2,
+                    train_iters: int = 8) -> IVFIndex:
+    """Train + build the partition layout for one corpus snapshot.
+
+    vectors: [n, d] raw host vectors (cosine normalization happens here,
+    matching `ops/knn.build_corpus`).
+    rows:    [n] device-corpus row ids these vectors occupy (defaults to
+    arange — the store always builds IVF over the same extraction that
+    built the flat corpus, so row i of one is row i of the other).
+    """
+    vectors = np.asarray(vectors, dtype=np.float32)
+    n, dims = vectors.shape
+    if rows is None:
+        rows = np.arange(n, dtype=np.int32)
+    if nlist is None:
+        nlist = pick_nlist(n, dims)
+    nlist = max(1, min(int(nlist), max(1, n // MIN_ROWS_PER_LIST)))
+
+    if metric == sim.COSINE:
+        norms = np.linalg.norm(vectors, axis=-1, keepdims=True)
+        vectors = vectors / np.maximum(norms, 1e-30)
+
+    centroids = kmeans_lib.train_kmeans(vectors, nlist, seed=seed,
+                                        iters=train_iters)
+    cap = int(np.ceil(n / nlist * slack))
+    cap = max(CAP_PAD, ((cap + CAP_PAD - 1) // CAP_PAD) * CAP_PAD)
+    index = IVFIndex(centroids, cap, metric, dtype,
+                     retrain_threshold=retrain_threshold)
+    # initial build places into freshly-trained buckets: overflow into a
+    # neighbor partition here is layout slack, not drift — don't let it
+    # trip the retrain gate the layout was just built with. Chunked so the
+    # [chunk, nlist] routing-score matrix stays bounded at corpus scale.
+    rows = np.asarray(rows, dtype=np.int32)
+    chunk = 131_072
+    for lo in range(0, n, chunk):
+        index._place(vectors[lo:lo + chunk], rows[lo:lo + chunk],
+                     count_displaced=False)
+    index.trained_on = n
+    return index

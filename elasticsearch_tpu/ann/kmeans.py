@@ -1,0 +1,150 @@
+"""On-device mini-batch k-means for IVF partition training.
+
+Trains the `nlist` routing centroids of the `tpu_ivf` engine directly from
+the stored corpus, entirely as jit-compiled device programs:
+
+  * k-means++ seeding (Arthur & Vassilvitskii, 2007) over a bounded
+    training sample — each next seed is drawn proportional to its squared
+    distance from the chosen set, the spread that makes Lloyd converge in
+    the handful of iterations we give it;
+  * mini-batch Lloyd updates (Sculley, 2010): per-batch assignment is one
+    [B, nlist] matmul + argmax, the centroid update a segment-sum with
+    per-center decaying learning rates — O(B·nlist·D) per step regardless
+    of corpus size;
+  * a soft balance penalty: assignment cost adds
+    `alpha * mean_d2 * (count_c / expected - 1)` so persistently
+    over-full centers repel new members. IVF wants *bounded* partition
+    sizes (the padded bucket layout pays for the largest partition), not
+    perfectly equal ones, so the hard cap lives in the index build
+    (`ivf_index.py`) and this only keeps the tail short.
+
+Everything is deterministic given `seed` — tests and the recall gate rely
+on that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kmeans_pp_init(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """k-means++ seeding: [n, d] sample → [k, d] initial centroids."""
+    n = x.shape[0]
+    x_sq = jnp.sum(x * x, axis=-1)
+
+    k0, kloop = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centroids = jnp.zeros((k, x.shape[1]), dtype=x.dtype).at[0].set(x[first])
+
+    def d2_to(c):
+        # squared distance via the dot expansion (keeps the MXU in play)
+        return jnp.maximum(
+            x_sq - 2.0 * (x @ c) + jnp.sum(c * c), 0.0)
+
+    def body(i, carry):
+        cents, min_d2, kk = carry
+        kk, ksel = jax.random.split(kk)
+        # sample ∝ D²(x); log-space categorical avoids a normalize pass
+        logits = jnp.log(jnp.maximum(min_d2, 1e-30))
+        nxt = jax.random.categorical(ksel, logits)
+        cents = cents.at[i].set(x[nxt])
+        min_d2 = jnp.minimum(min_d2, d2_to(x[nxt]))
+        return cents, min_d2, kk
+
+    centroids, _, _ = jax.lax.fori_loop(
+        1, k, body, (centroids, d2_to(x[first]), kloop))
+    return centroids
+
+
+@functools.partial(jax.jit, static_argnames=())
+def assign_blocks(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """Nearest-centroid ids [n] for rows [n, d] (plain L2 assignment —
+    for unit-normalized cosine data this equals max-dot routing)."""
+    c_sq = jnp.sum(centroids * centroids, axis=-1)
+    dots = jax.lax.dot_general(
+        x, centroids, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # argmin ||x-c||² = argmax (x·c - ||c||²/2); ||x||² is constant per row
+    return jnp.argmax(dots - 0.5 * c_sq[None, :], axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("nlist", "balance_alpha"))
+def _minibatch_epoch(carry, batches, nlist: int, balance_alpha: float):
+    """One scan over the stacked mini-batches [S, B, d]."""
+
+    def step(carry, batch):
+        cents, counts = carry
+        c_sq = jnp.sum(cents * cents, axis=-1)
+        dots = jax.lax.dot_general(
+            batch, cents, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        score = dots - 0.5 * c_sq[None, :]
+        if balance_alpha > 0.0:
+            # soft balance: persistently crowded centers cost extra,
+            # scaled by the current mean intra-cluster spread so the
+            # penalty tracks the data's own distance scale
+            expected = jnp.maximum(jnp.sum(counts) / nlist, 1.0)
+            mean_d2 = jnp.mean(jnp.maximum(
+                jnp.sum(batch * batch, axis=-1)[:, None] - 2.0 * score,
+                0.0))
+            score = score - (balance_alpha * mean_d2
+                             * (counts / expected - 1.0))[None, :]
+        assign = jnp.argmax(score, axis=-1)
+        one_hot = jax.nn.one_hot(assign, nlist, dtype=jnp.float32)
+        batch_counts = jnp.sum(one_hot, axis=0)
+        batch_sums = jax.lax.dot_general(
+            one_hot, batch, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        new_counts = counts + batch_counts
+        # per-center learning rate 1/total_count (Sculley eq. 1): the
+        # center is the running mean of every row ever assigned to it
+        lr = batch_counts / jnp.maximum(new_counts, 1.0)
+        target = batch_sums / jnp.maximum(batch_counts[:, None], 1.0)
+        cents = cents + lr[:, None] * (target - cents)
+        return (cents, new_counts), None
+
+    return jax.lax.scan(step, carry, batches)[0]
+
+
+def train_kmeans(vectors: np.ndarray, nlist: int, *, iters: int = 8,
+                 batch_size: int = 4096, sample: int = 262_144,
+                 seed: int = 0, balance_alpha: float = 0.25) -> np.ndarray:
+    """Train `nlist` centroids from host vectors; returns [nlist, d] f32.
+
+    The training sample is bounded (`sample` rows) so training cost is
+    independent of corpus size; `iters` epochs of mini-batch Lloyd over a
+    reshuffled sample each epoch.
+    """
+    vectors = np.asarray(vectors, dtype=np.float32)
+    n, d = vectors.shape
+    if nlist < 1:
+        raise ValueError(f"nlist must be >= 1, got {nlist}")
+    if n < nlist:
+        raise ValueError(f"cannot train {nlist} centroids from {n} rows")
+
+    rng = np.random.default_rng(seed)
+    n_sample = min(n, max(sample, nlist * 4))
+    idx = rng.choice(n, size=n_sample, replace=False) if n_sample < n \
+        else np.arange(n)
+    x = jnp.asarray(vectors[idx])
+
+    key = jax.random.PRNGKey(seed)
+    k_init, k_shuf = jax.random.split(key)
+    seed_rows = min(n_sample, max(nlist * 32, 4096))
+    centroids = kmeans_pp_init(k_init, x[:seed_rows], nlist)
+
+    batch_size = min(batch_size, n_sample)
+    steps = n_sample // batch_size
+    counts = jnp.zeros((nlist,), dtype=jnp.float32)
+    for _ in range(max(iters, 1)):
+        k_shuf, k_epoch = jax.random.split(k_shuf)
+        perm = jax.random.permutation(k_epoch, n_sample)[: steps * batch_size]
+        batches = x[perm].reshape(steps, batch_size, d)
+        centroids, counts = _minibatch_epoch(
+            (centroids, counts), batches, nlist, balance_alpha)
+    return np.asarray(centroids, dtype=np.float32)

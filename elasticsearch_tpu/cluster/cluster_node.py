@@ -93,11 +93,24 @@ REGISTRIES_KEY = "_registries"
 
 class LocalShard:
     def __init__(self, routing: ShardRoutingEntry, engine: Engine,
-                 mapper_service: MapperService):
+                 mapper_service: MapperService, index_settings=None):
         self.routing = routing
         self.mapper_service = mapper_service
         self.tracker = ReplicationTracker(routing.allocation_id)
-        self.vector_store = VectorStoreShard()
+        s = index_settings or {}
+        try:
+            from elasticsearch_tpu.indices.service import (
+                validate_knn_settings)
+            knn_engine, knn_nlist, knn_nprobe = validate_knn_settings(s)
+        except Exception:
+            # settings are validated at create-index; a bad value that
+            # slipped into replicated state (older master) must degrade
+            # to the exhaustive default, never crash the state applier
+            knn_engine, knn_nlist, knn_nprobe = "tpu", None, "auto"
+        self.vector_store = VectorStoreShard(
+            dtype=s.get("index.knn.vector_dtype", "bf16"),
+            knn_engine=knn_engine, knn_nlist=knn_nlist,
+            knn_nprobe=knn_nprobe)
         self._attach_engine(engine)
 
     def _attach_engine(self, engine: Engine) -> None:
@@ -345,8 +358,10 @@ class ClusterNode:
         # same name rules as the single-node path — in particular no "_"
         # prefix, which is what keeps reserved metadata sections
         # (REGISTRIES_KEY) unreachable as indices
-        from elasticsearch_tpu.indices.service import IndicesService
+        from elasticsearch_tpu.indices.service import (
+            IndicesService, validate_knn_settings)
         IndicesService.validate_index_name(name)
+        validate_knn_settings(dict(request.get("settings") or {}))
 
         def update(base: ClusterState) -> ClusterState:
             if name in base.metadata:
@@ -566,7 +581,8 @@ class ClusterNode:
                                  "reason": f"restore failed: {e}"})
                             continue
                 engine = Engine(path, mapper, translog_sync="async")
-                local = LocalShard(entry, engine, mapper)
+                local = LocalShard(entry, engine, mapper,
+                                   index_settings=meta.get("settings"))
                 self.local_shards[key] = local
                 if entry.primary:
                     local.tracker.activate_primary_mode(engine.local_checkpoint)

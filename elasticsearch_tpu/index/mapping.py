@@ -513,10 +513,14 @@ class DenseVectorFieldMapper(FieldMapper):
 
     params: dims (required), similarity (cosine|dot_product|l2_norm,
     default cosine), index_options.type (flat|int8_flat — storage dtype of
-    the device matrix).
+    the device matrix; ivf|int8_ivf — per-field opt-in to the partitioned
+    `tpu_ivf` engine, overriding `index.knn.engine`), index_options.nlist /
+    nprobe (per-field IVF overrides of the index-level settings).
     """
 
     type_name = "dense_vector"
+
+    INDEX_OPTIONS_TYPES = ("flat", "int8_flat", "ivf", "int8_ivf")
 
     def __init__(self, name, params=None):
         super().__init__(name, params)
@@ -527,6 +531,25 @@ class DenseVectorFieldMapper(FieldMapper):
         self.similarity = self.params.get("similarity", "cosine")
         if self.similarity not in ("cosine", "dot_product", "l2_norm", "max_inner_product"):
             raise MapperParsingError(f"[{name}] unknown similarity [{self.similarity}]")
+        opts = self.params.get("index_options") or {}
+        otype = opts.get("type")
+        if otype is not None and otype not in self.INDEX_OPTIONS_TYPES:
+            raise MapperParsingError(
+                f"[{name}] unknown index_options type [{otype}]; expected "
+                f"one of {list(self.INDEX_OPTIONS_TYPES)}")
+        self.index_options_type = otype
+        for opt_key in ("nlist", "nprobe"):
+            v = opts.get(opt_key)
+            if v is None or (opt_key == "nprobe" and v == "auto"):
+                continue  # "auto" is meaningful only for nprobe
+            try:
+                ok = int(v) >= 1
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise MapperParsingError(
+                    f"[{name}] index_options [{opt_key}] must be an "
+                    f"integer >= 1, got [{v}]")
 
     def coerce(self, value) -> np.ndarray:
         if not isinstance(value, (list, tuple)):

@@ -109,13 +109,18 @@ class IndexShardHandle:
 
     def __init__(self, index_name: str, shard_id: int, path: str,
                  mapper_service: MapperService, translog_sync: str = "request",
-                 vector_dtype: str = "bf16", index_sort=None):
+                 vector_dtype: str = "bf16", index_sort=None,
+                 knn_engine: str = "tpu", knn_nlist=None,
+                 knn_nprobe="auto"):
         self.index_name = index_name
         self.shard_id = shard_id
         self.engine = Engine(path, mapper_service,
                              translog_sync=translog_sync,
                              index_sort=index_sort)
-        self.vector_store = VectorStoreShard(dtype=vector_dtype)
+        self.vector_store = VectorStoreShard(dtype=vector_dtype,
+                                             knn_engine=knn_engine,
+                                             knn_nlist=knn_nlist,
+                                             knn_nprobe=knn_nprobe)
         self.mapper_service = mapper_service
         self._sync_vectors(self.engine.acquire_searcher())
         self.engine.add_refresh_listener(self._sync_vectors)
@@ -127,6 +132,39 @@ class IndexShardHandle:
 
     def close(self):
         self.engine.close()
+
+
+def validate_knn_settings(settings: dict):
+    """Validate + normalize the `index.knn.*` engine settings; returns
+    (engine, nlist, nprobe). ONE owner for both the single-node create
+    path and the cluster master's create-index handler — a bad value must
+    400 at creation, never crash a state applier later."""
+    engine = str(settings.get("index.knn.engine", "tpu"))
+    if engine not in ("tpu", "tpu_ivf"):
+        raise IllegalArgumentError(
+            f"unknown [index.knn.engine] value [{engine}]; "
+            f"expected one of [tpu, tpu_ivf]")
+    nlist = settings.get("index.knn.nlist")
+    if nlist is not None:
+        try:
+            nlist = int(nlist)
+        except (TypeError, ValueError):
+            nlist = 0
+        if nlist < 1:
+            raise IllegalArgumentError(
+                f"[index.knn.nlist] must be an integer >= 1, got "
+                f"[{settings.get('index.knn.nlist')}]")
+    nprobe = settings.get("index.knn.nprobe", "auto")
+    if nprobe != "auto":
+        try:
+            nprobe = int(nprobe)
+        except (TypeError, ValueError):
+            nprobe = 0
+        if nprobe < 1:
+            raise IllegalArgumentError(
+                f"[index.knn.nprobe] must be an integer >= 1 or "
+                f"\"auto\", got [{settings.get('index.knn.nprobe')}]")
+    return engine, nlist, nprobe
 
 
 def _reject_translog_retention(settings: dict) -> None:
@@ -187,6 +225,8 @@ class IndexService:
         sync = settings.get("index.translog.durability", "request")
         sync = "request" if sync == "request" else "async"
         vec_dtype = settings.get("index.knn.vector_dtype", "bf16")
+        knn_engine, knn_nlist, knn_nprobe = validate_knn_settings(
+            settings.as_flat_dict())
         sort_field = settings.get("index.sort.field")
         index_sort = None
         if sort_field:
@@ -204,7 +244,8 @@ class IndexService:
             self.shards.append(IndexShardHandle(
                 name, s, os.path.join(path, str(s)), self.mapper_service,
                 translog_sync=sync, vector_dtype=vec_dtype,
-                index_sort=index_sort))
+                index_sort=index_sort, knn_engine=knn_engine,
+                knn_nlist=knn_nlist, knn_nprobe=knn_nprobe))
         self.aliases: Dict[str, dict] = {}
 
     @property

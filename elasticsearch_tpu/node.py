@@ -71,6 +71,7 @@ class _MultiShardVectorStore:
 
     def __init__(self, svc: IndexService):
         self.svc = svc
+        self._phases: dict = {}
 
     def field(self, name: str):
         for shard in self.svc.shards:
@@ -219,12 +220,14 @@ class _MultiShardVectorStore:
         return total > 0 and CostModel.prefer_host(1 + pending, total, dims)
 
     def search(self, field: str, query_vector, k: int, filter_rows=None,
-               precision: str = "bf16"):
+               precision: str = "bf16", num_candidates=None):
         state = self._mesh_state(field)
+        self._phases = {}
         # k beyond the per-shard padded row count cannot merge losslessly
         # in the fused program; such deep k falls back to the host merge
         if state is not None and k <= state["per"] \
                 and not self._prefer_host(field):
+            # the fused mesh program has no per-phase split to report
             return self._mesh_search(state, query_vector, k, filter_rows,
                                      precision)
         all_rows, all_scores = [], []
@@ -235,9 +238,14 @@ class _MultiShardVectorStore:
                 local = filter_rows[(filter_rows >= offset)
                                     & (filter_rows < offset + SHARD_ROW_SPACE)] - offset
                 frows = local
-            rows, scores = shard.vector_store.search(field, query_vector, k,
-                                                     filter_rows=frows,
-                                                     precision=precision)
+            rows, scores = shard.vector_store.search(
+                field, query_vector, k, filter_rows=frows,
+                precision=precision, num_candidates=num_candidates)
+            if not self._phases:
+                # captured per dispatch, NOT scanned lazily later — a
+                # later mesh-path query must not inherit these timings
+                self._phases = dict(getattr(
+                    shard.vector_store, "last_knn_phases", None) or {})
             all_rows.append(rows + offset)
             all_scores.append(scores)
         if not all_rows:
@@ -247,6 +255,13 @@ class _MultiShardVectorStore:
         # global top-k with shard-order tie-break (stable sort over concat)
         order = np.argsort(-scores, kind="stable")[:k]
         return rows[order], scores[order]
+
+    @property
+    def last_knn_phases(self) -> dict:
+        """Engine phase timings captured by this wrapper's most recent
+        dispatch (empty for mesh fast-path searches, which have no
+        per-phase split)."""
+        return self._phases
 
 
 class Node:
@@ -1244,7 +1259,8 @@ class Node:
                     from elasticsearch_tpu.search.profile import shard_profile
                     profile_shards.append(shard_profile(
                         svc.name, body, q_nanos, f_nanos,
-                        result.total_hits))
+                        result.total_hits,
+                        knn_phases=result.knn_phases))
         finally:
             self.breakers.release("request", breaker_bytes)
         n_shards_total = sum(s.num_shards for s, _, _ in readers)
@@ -2064,7 +2080,8 @@ class Node:
             "query_cache": {
                 "hit_count": self.caches.query.hits,
                 "miss_count": self.caches.query.misses,
-                "evictions": self.caches.query.evictions}}
+                "evictions": self.caches.query.evictions},
+            "knn": self._knn_stats_section()}
         discovery_section = {
             "cluster_state_queue": {"total": 0, "pending": 0,
                                     "committed": 0},
@@ -2087,6 +2104,20 @@ class Node:
                 "discovery": discovery_section,
                 "breakers": self.breakers.stats(),
                 "thread_pool": self.thread_pool.stats()}
+
+    def _knn_stats_section(self) -> dict:
+        """Vector-search engine counters summed over local shards: total
+        searches, how many took the pruned tpu_ivf path vs fell back to
+        exhaustive, and cumulative per-phase device time."""
+        out = {"searches": 0, "ivf_searches": 0, "fallback_searches": 0,
+               "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
+        for svc in self.indices.indices.values():
+            for shard in svc.shards:
+                stats = getattr(shard.vector_store, "knn_stats", None)
+                if stats:
+                    for key in out:
+                        out[key] += stats.get(key, 0)
+        return out
 
     def local_hot_threads(self, interval_s: float = 0.05) -> str:
         from elasticsearch_tpu.monitor import hot_threads_report

@@ -1,0 +1,134 @@
+"""Centroid-pruned IVF search kernel: route → pruned matmul → top-k.
+
+The device half of the `tpu_ivf` engine (`elasticsearch_tpu/ann/`). Where
+`ops/knn.py` scores all N rows per query, this scores only the `nprobe`
+partitions a tiny centroid matmul routes each query to:
+
+    route:  c[Q, nlist] = q @ centroids^T          (~nlist·D FLOPs/query)
+    probe:  top-nprobe partition ids per query
+    score:  for each probe slot, a block `take` of [Q, cap, D] partition
+            tiles + one batched matmul → [Q, cap] scores
+    merge:  running top-k across probe slots (the knn.py blocked-scan
+            merge, over probed partitions instead of corpus tiles)
+
+The layout is gather-free at the row level: partitions live bucketed and
+padded to one common capacity (`parts[nlist, cap, D]`, rows padded with
+`row_ids == -1`), so the score stage moves whole lane-aligned tiles
+through HBM — `jnp.take` of contiguous blocks, never per-row gathers.
+Total bytes read per query ≈ nprobe·cap·D — the ~nprobe/nlist corpus
+fraction that buys IVF its speedup.
+
+int8 storage reuses the per-row symmetric scheme of `ops/quantization`:
+rows upcast in-register during the matmul read, scores de-scaled after.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.ops.similarity import NEG_INF
+
+
+class IVFPartitions(NamedTuple):
+    """Device-resident partitioned corpus (a pytree).
+
+    centroids:    [nlist, D] f32 routing centroids (unit vectors when the
+                  corpus is cosine-normalized)
+    centroid_sq:  [nlist] f32 ||c||² (l2 routing)
+    parts:        [nlist, cap, D] f32 / bf16 / int8 partition tiles
+    part_scales:  [nlist, cap] f32 int8 per-row scales (ones otherwise)
+    part_sq:      [nlist, cap] f32 ||row||² (l2 scoring)
+    part_rows:    [nlist, cap] int32 device-corpus row ids; -1 = padding
+    """
+
+    centroids: jax.Array
+    centroid_sq: jax.Array
+    parts: jax.Array
+    part_scales: jax.Array
+    part_sq: jax.Array
+    part_rows: jax.Array
+
+
+def _prep_queries(queries: jax.Array, metric: str) -> jax.Array:
+    queries = queries.astype(jnp.float32)
+    if metric == sim.COSINE:
+        qn = jnp.linalg.norm(queries, axis=-1, keepdims=True)
+        queries = queries / jnp.maximum(qn, 1e-30)
+    return queries
+
+
+@functools.partial(jax.jit, static_argnames=("nprobe", "metric"))
+def route(queries: jax.Array, ivf: IVFPartitions, nprobe: int,
+          metric: str = sim.COSINE):
+    """Centroid routing: [Q, D] queries → ([Q, nprobe] partition ids,
+    [Q, nprobe] centroid scores). Queries must be metric-prepped."""
+    dots = jax.lax.dot_general(
+        queries, ivf.centroids.astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if metric == sim.L2_NORM:
+        scores = sim.l2_raw_from_dots(dots, queries, ivf.centroid_sq)
+    else:
+        scores = dots
+    vals, ids = jax.lax.top_k(scores, nprobe)
+    return ids.astype(jnp.int32), vals
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "precision"))
+def score_probes(queries: jax.Array, ivf: IVFPartitions,
+                 probe_ids: jax.Array, k: int, metric: str = sim.COSINE,
+                 precision: str = "bf16"):
+    """Score the probed partitions and merge a global top-k.
+
+    queries:   [Q, D] metric-prepped
+    probe_ids: [Q, nprobe] int32 partition ids from `route`
+    Returns (scores [Q, k] raw similarity, rows [Q, k] int32 device-corpus
+    row ids). Empty slots come back as NEG_INF / row -1 — same contract as
+    `ops/knn.knn_search` padding.
+    """
+    q = queries.astype(jnp.float32)
+    nq = q.shape[0]
+    mm_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+    init = (jnp.full((nq, k), NEG_INF, dtype=jnp.float32),
+            jnp.full((nq, k), -1, dtype=jnp.int32))
+
+    def body(carry, pid):
+        best_s, best_i = carry
+        # block take: whole [cap, D] tiles per query, no row gathers
+        block = jnp.take(ivf.parts, pid, axis=0)        # [Q, cap, D]
+        rows = jnp.take(ivf.part_rows, pid, axis=0)     # [Q, cap]
+        dots = jnp.einsum(
+            "qd,qcd->qc", q.astype(mm_dtype), block.astype(mm_dtype),
+            preferred_element_type=jnp.float32)
+        if ivf.parts.dtype == jnp.int8:
+            dots = dots * jnp.take(ivf.part_scales, pid, axis=0)
+        if metric == sim.L2_NORM:
+            part_sq = jnp.take(ivf.part_sq, pid, axis=0)
+            q_sq = jnp.sum(q * q, axis=-1, keepdims=True)
+            s = 2.0 * dots - q_sq - part_sq
+        else:
+            s = dots
+        s = jnp.where(rows >= 0, s, NEG_INF)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate([best_i, rows], axis=1)
+        vals, pos = jax.lax.top_k(cat_s, k)
+        return (vals, jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+    (best_s, best_i), _ = jax.lax.scan(body, init, probe_ids.T)
+    return best_s, best_i
+
+
+def ivf_search(queries: jax.Array, ivf: IVFPartitions, k: int,
+               nprobe: int, metric: str = sim.COSINE,
+               precision: str = "bf16"):
+    """Fused route + score convenience entry (two device dispatches; the
+    serving router calls the stages itself to time them separately)."""
+    nprobe = min(nprobe, ivf.centroids.shape[0])
+    q = _prep_queries(queries, metric)
+    probe_ids, _ = route(q, ivf, nprobe, metric=metric)
+    return score_probes(q, ivf, probe_ids, k, metric=metric,
+                        precision=precision)

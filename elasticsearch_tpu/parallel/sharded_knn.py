@@ -27,12 +27,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pre-0.6 jax keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.topk import merge_top_k
 from elasticsearch_tpu.parallel import mesh as mesh_lib
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """Version-portable shard_map with replication checking off (the
+    knob was renamed check_rep → check_vma across jax releases)."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
 
 
 class ShardedCorpus(NamedTuple):
@@ -171,11 +186,10 @@ def distributed_knn_search(
     if filter_mask is None:
         def step_nf(q, mat, sqn, scl, nvalid):
             return step(q, mat, sqn, scl, nvalid, None)
-        fn = shard_map(step_nf, mesh=mesh, in_specs=in_specs[:-1], out_specs=out_specs,
-                       check_vma=False)
+        fn = shard_map(step_nf, mesh=mesh, in_specs=in_specs[:-1],
+                       out_specs=out_specs)
         return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales, corpus.num_valid)
 
-    fn = shard_map(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_vma=False)
+    fn = shard_map(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales,
               corpus.num_valid, filter_mask)

@@ -55,7 +55,13 @@ class KnnQuery(Query):
         store = getattr(ctx, "vector_store", None)
         if store is not None and store.field(self.field) is not None:
             rows, raw = store.search(self.field, self.query_vector, self.k,
-                                     filter_rows=filter_rows)
+                                     filter_rows=filter_rows,
+                                     num_candidates=self.num_candidates)
+            # per-phase engine timings (route/score/merge for tpu_ivf) for
+            # the profiler and shard result
+            phases = getattr(store, "last_knn_phases", None)
+            if phases:
+                ctx.knn_phases = phases
         else:
             rows, raw = self._host_fallback(ctx, metric, filter_rows)
 

@@ -23,7 +23,8 @@ def _describe_query(body: dict) -> tuple:
 
 
 def shard_profile(index_name: str, body: dict, query_nanos: int,
-                  fetch_nanos: int, total_hits: int) -> dict:
+                  fetch_nanos: int, total_hits: int,
+                  knn_phases: Optional[dict] = None) -> dict:
     kind, description = _describe_query(body)
     breakdown = {
         "score": query_nanos * 7 // 10,
@@ -62,6 +63,21 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
         },
         "aggregations": [],
     }
+    if knn_phases:
+        # per-phase kNN engine breakdown (tpu_ivf: route = centroid
+        # matmul + probe selection, score = pruned partition matmuls +
+        # device top-k, merge = row-map join / result shaping; exhaustive
+        # fallbacks report engine + reason only)
+        profile["knn"] = {
+            "engine": knn_phases.get("engine"),
+            **{key: knn_phases[key]
+               for key in ("nprobe", "nlist", "scored_rows",
+                           "fallback_reason") if key in knn_phases},
+            "breakdown": {
+                key: knn_phases[key]
+                for key in ("route_nanos", "score_nanos", "merge_nanos")
+                if key in knn_phases},
+        }
     if (body or {}).get("aggs") or (body or {}).get("aggregations"):
         aggs = body.get("aggs") or body.get("aggregations")
         profile["aggregations"] = [

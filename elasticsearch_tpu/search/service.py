@@ -123,10 +123,12 @@ class ShardSearchResult:
     """Per-shard query-phase output (QuerySearchResult analog)."""
 
     __slots__ = ("shard_id", "rows", "scores", "sort_values", "total_hits",
-                 "total_relation", "aggregations", "max_score", "failures")
+                 "total_relation", "aggregations", "max_score", "failures",
+                 "knn_phases")
 
     def __init__(self, shard_id, rows, scores, sort_values, total_hits,
-                 total_relation, aggregations, max_score, failures=None):
+                 total_relation, aggregations, max_score, failures=None,
+                 knn_phases=None):
         self.shard_id = shard_id
         self.rows = rows
         self.scores = scores
@@ -136,6 +138,7 @@ class ShardSearchResult:
         self.aggregations = aggregations
         self.max_score = max_score
         self.failures = failures or []  # partial per-shard failures
+        self.knn_phases = knn_phases    # tpu_ivf route/score/merge timings
 
 
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
@@ -359,7 +362,8 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
         max_score = float(scores.max()) if len(scores) and sort_spec is None else None
     return ShardSearchResult(shard_id, w_rows, w_scores, w_sort, total_hits,
                              relation, aggs, max_score,
-                             failures=getattr(ctx, "shard_failures", None))
+                             failures=getattr(ctx, "shard_failures", None),
+                             knn_phases=getattr(ctx, "knn_phases", None))
 
 
 def _apply_rescore(ctx, rows, scores, rescore_spec):

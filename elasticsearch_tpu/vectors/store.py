@@ -33,6 +33,10 @@ from elasticsearch_tpu.vectors.host_corpus import HostFieldCorpus, packed_nbytes
 # below this (3 bytes/element); larger corpora serve from the device only
 HOST_MIRROR_MAX_BYTES = 512_000_000
 
+# below this many rows the exhaustive matmul beats IVF routing overhead;
+# tpu_ivf fields smaller than this quietly serve exhaustive
+IVF_MIN_ROWS = 512
+
 _METRIC_MAP = {
     "cosine": sim.COSINE,
     "dot_product": sim.DOT_PRODUCT,
@@ -44,16 +48,33 @@ _METRIC_MAP = {
 class FieldCorpus:
     """Device corpus for one vector field + host-side row maps."""
 
-    __slots__ = ("corpus", "row_map", "metric", "dims", "version", "host")
+    __slots__ = ("corpus", "row_map", "metric", "dims", "version", "host",
+                 "router")
 
     def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int,
-                 version: tuple, host=None):
+                 version: tuple, host=None, router=None):
         self.corpus = corpus          # knn_ops.Corpus (device pytree)
         self.row_map = row_map        # device row -> engine global row
         self.metric = metric
         self.dims = dims
         self.version = version        # cache key: segment/tombstone fingerprint
         self.host = host              # HostFieldCorpus latency mirror (or None)
+        self.router = router          # ann.IVFRouter (tpu_ivf engine) or None
+
+
+def _pad_batch(queries: np.ndarray, n_real: int) -> np.ndarray:
+    """Pad a coalesced query batch to a power-of-2 bucket: the device jits
+    (exhaustive and IVF alike) specialize on the query-count dimension,
+    and a fresh compile per distinct batch size would stall serving. Pad
+    results are sliced away by the caller."""
+    b_pad = 1
+    while b_pad < n_real:
+        b_pad *= 2
+    if b_pad != n_real:
+        queries = np.concatenate(
+            [queries, np.zeros((b_pad - n_real, queries.shape[1]),
+                               dtype=np.float32)])
+    return queries
 
 
 def extract_field_rows(reader: ShardReader, field: str
@@ -82,12 +103,33 @@ def extract_field_rows(reader: ShardReader, field: str
 
 class VectorStoreShard:
     def __init__(self, dtype: str = "bf16",
-                 host_mirror_max_bytes: int = HOST_MIRROR_MAX_BYTES):
+                 host_mirror_max_bytes: int = HOST_MIRROR_MAX_BYTES,
+                 knn_engine: str = "tpu", knn_nlist=None,
+                 knn_nprobe="auto", knn_recall_target: float = 0.95):
         self.dtype = dtype
         self.host_mirror_max_bytes = host_mirror_max_bytes
+        self.knn_engine = knn_engine        # "tpu" (exhaustive) | "tpu_ivf"
+        self.knn_nlist = knn_nlist          # None = pick_nlist(n)
+        self.knn_nprobe = knn_nprobe        # "auto" | int
+        self.knn_recall_target = knn_recall_target
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
+        # per-phase serving telemetry (profile "knn" section, _nodes/stats)
+        self.knn_stats: Dict[str, int] = {
+            "searches": 0, "ivf_searches": 0, "fallback_searches": 0,
+            "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
+        self.last_knn_phases: dict = {}
+
+    def _field_engine(self, mapper: DenseVectorFieldMapper) -> str:
+        """Effective engine for one field: explicit index_options beat the
+        index-level `index.knn.engine` setting."""
+        otype = (mapper.params.get("index_options") or {}).get("type")
+        if otype in ("ivf", "int8_ivf"):
+            return "tpu_ivf"
+        if otype in ("flat", "int8_flat"):
+            return "tpu"
+        return self.knn_engine
 
     @staticmethod
     def _fingerprint(reader: ShardReader, field: str) -> tuple:
@@ -114,7 +156,7 @@ class VectorStoreShard:
                 continue
             dtype = self.dtype
             opts = mapper.params.get("index_options", {})
-            if opts.get("type") == "int8_flat":
+            if opts.get("type") in ("int8_flat", "int8_ivf"):
                 dtype = "int8"
             # `"rescore": true` in index_options additionally keeps the
             # residual rescore level — the analog of Lucene retaining raw
@@ -133,8 +175,48 @@ class VectorStoreShard:
                     and packed_nbytes(len(row_map), mapper.dims)
                     <= self.host_mirror_max_bytes):
                 host = HostFieldCorpus(full, metric)
+            router = None
+            if (self._field_engine(mapper) == "tpu_ivf"
+                    and len(row_map) >= IVF_MIN_ROWS):
+                # partition layout built from the SAME extraction as the
+                # flat corpus, so IVF row ids index the corpus matrix (and
+                # row_map) directly; the flat corpus stays resident as the
+                # router's exhaustive escape hatch
+                from elasticsearch_tpu.ann import (
+                    IVFRouter, build_ivf_index)
+                old = cached.router if cached is not None else None
+                old_n = len(cached.row_map) if cached is not None else 0
+                if (old is not None and not old.index.needs_retrain
+                        and old.index.dtype == dtype
+                        and old.index.metric == metric
+                        and 0 < old_n <= len(row_map)
+                        and np.array_equal(row_map[:old_n],
+                                           cached.row_map)):
+                    # append-only refresh (new sealed segments, no
+                    # deletes): place only the delta rows into the
+                    # existing layout — keeps the trained centroids and
+                    # the tuned nprobe instead of retraining k-means on
+                    # every refresh. Drift accumulates in the
+                    # displacement/spill counters until the retrain
+                    # threshold forces the full rebuild below.
+                    old.index.add(full[old_n:],
+                                  np.arange(old_n, len(row_map),
+                                            dtype=np.int32))
+                    if not old.index.needs_retrain:
+                        router = old
+                if router is None:
+                    nlist = opts.get("nlist", self.knn_nlist)
+                    nprobe = opts.get("nprobe", self.knn_nprobe)
+                    ivf = build_ivf_index(
+                        full, metric=metric,
+                        nlist=int(nlist) if nlist is not None else None,
+                        dtype=dtype, seed=0)
+                    router = IVFRouter(
+                        ivf, nprobe=nprobe,
+                        recall_target=self.knn_recall_target)
             self._fields[field] = FieldCorpus(corpus, row_map, metric,
-                                              mapper.dims, version, host=host)
+                                              mapper.dims, version,
+                                              host=host, router=router)
             with self._batchers_lock:
                 for key in [k for k in self._batchers if k[0] == field]:
                     del self._batchers[key]
@@ -152,7 +234,9 @@ class VectorStoreShard:
 
     def search(self, field: str, query_vector: np.ndarray, k: int,
                filter_rows: Optional[np.ndarray] = None,
-               precision: str = "bf16") -> Tuple[np.ndarray, np.ndarray]:
+               precision: str = "bf16",
+               num_candidates: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
         """Top-k search. Returns (global_rows [m], raw_scores [m]), m <= k
         (padding/filtered slots removed).
 
@@ -168,12 +252,14 @@ class VectorStoreShard:
         if fc is None or fc.corpus is None or len(fc.row_map) == 0:
             return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float32)
 
-        key = (field, fc.version, k, precision)
+        key = (field, fc.version, k, precision, num_candidates)
         with self._batchers_lock:
             batcher = self._batchers.get(key)
             if batcher is None:
-                def execute(reqs, fc=fc, k=k, precision=precision):
-                    return self._execute_batch(fc, k, precision, reqs)
+                def execute(reqs, fc=fc, k=k, precision=precision,
+                            num_candidates=num_candidates):
+                    return self._execute_batch(fc, k, precision, reqs,
+                                               num_candidates=num_candidates)
 
                 batcher = CombiningBatcher(execute)
                 if len(self._batchers) > 64:  # stale (field, k) variants
@@ -183,7 +269,8 @@ class VectorStoreShard:
             (np.asarray(query_vector, dtype=np.float32), filter_rows))
 
     def _execute_batch(self, fc: FieldCorpus, k: int, precision: str,
-                       requests) -> list:
+                       requests, num_candidates: Optional[int] = None
+                       ) -> list:
         """Serve one coalesced batch of (query_vector, filter_rows)."""
         import jax.numpy as jnp
 
@@ -191,6 +278,19 @@ class VectorStoreShard:
         k_eff = min(k, fc.corpus.matrix.shape[0])
         queries = np.stack([q for q, _ in requests])
         any_filter = any(fr is not None for _, fr in requests)
+
+        self.knn_stats["searches"] += 1
+        # cleared up front so a router-less dispatch can never leave a
+        # previous query's phase timings behind for the profiler to read
+        self.last_knn_phases = {}
+        if fc.router is not None:
+            reason = fc.router.should_fallback(k_eff, any_filter, precision)
+            if reason is None:
+                return self._execute_ivf(fc, k_eff, n_valid, queries,
+                                         len(requests), num_candidates)
+            self.knn_stats["fallback_searches"] += 1
+            self.last_knn_phases = {"engine": "tpu_exhaustive",
+                                    "fallback_reason": reason}
 
         use_host = (fc.host is not None and precision != "f32"
                     and CostModel.prefer_host(len(requests), fc.host.n,
@@ -207,17 +307,8 @@ class VectorStoreShard:
             ids = np.asarray(ids)
             floor = -np.inf
         else:
-            # pad the batch to a power-of-2 bucket: jit specializes on the
-            # query-count dimension, and a fresh compile per distinct batch
-            # size would stall serving (pad results are sliced away below)
-            b_real = len(requests)
-            b_pad = 1
-            while b_pad < b_real:
-                b_pad *= 2
-            if b_pad != b_real:
-                queries = np.concatenate(
-                    [queries, np.zeros((b_pad - b_real, queries.shape[1]),
-                                       dtype=np.float32)])
+            queries = _pad_batch(queries, len(requests))
+            b_pad = len(queries)
             mask = None
             if any_filter:
                 n_pad = fc.corpus.matrix.shape[0]
@@ -240,4 +331,28 @@ class VectorStoreShard:
             valid = (sc > floor) & (rid >= 0) & (rid < n_valid)
             sc, rid = sc[valid], rid[valid]
             out.append((fc.row_map[rid], sc.astype(np.float32)))
+        return out
+
+    def _execute_ivf(self, fc: FieldCorpus, k_eff: int, n_valid: int,
+                     queries: np.ndarray, n_real: int,
+                     num_candidates: Optional[int]) -> list:
+        """Serve one coalesced batch through the tpu_ivf router."""
+        import time as _time
+
+        queries = _pad_batch(queries, n_real)
+        scores, rows, phases = fc.router.search(
+            queries, k_eff, num_candidates=num_candidates)
+        t0 = _time.perf_counter_ns()
+        out = []
+        for qi in range(n_real):
+            sc, rid = scores[qi], rows[qi]
+            valid = (sc > -1e37) & (rid >= 0) & (rid < n_valid)
+            sc, rid = sc[valid], rid[valid]
+            out.append((fc.row_map[rid], sc.astype(np.float32)))
+        phases = dict(phases)
+        phases["merge_nanos"] += _time.perf_counter_ns() - t0
+        self.knn_stats["ivf_searches"] += 1
+        for ph in ("route_nanos", "score_nanos", "merge_nanos"):
+            self.knn_stats[ph] += phases[ph]
+        self.last_knn_phases = phases
         return out

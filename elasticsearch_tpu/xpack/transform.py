@@ -27,6 +27,13 @@ from elasticsearch_tpu.common.errors import (
 # failure count and fails the task, recording the reason in _stats)
 MAX_CONSECUTIVE_FAILURES = 10
 
+# consecutive unchanged-fingerprint ticks an indexer may skip before it
+# must run one pass anyway. The fingerprint only sees THIS node's
+# searchable state while the indexer's search is cluster-wide, so change
+# detection is an optimization that must never gate liveness — bucket
+# doc-ids make the periodic re-run an idempotent no-op on the dest.
+MAX_FP_SKIPS = 15
+
 
 def _record_indexer_failure(st: dict, exc: Exception,
                             state_key: str = "state") -> None:
@@ -141,15 +148,43 @@ class TransformService:
         """Cheap change detector: (doc_count, max_seq_no) over the source —
         ticks skip when nothing advanced (TransformIndexer change
         detection; re-running on an unchanged source would spin
-        checkpoints forever)."""
+        checkpoints forever).
+
+        Measured on the SEARCHABLE reader snapshot, not the live engine
+        counters: engine doc_count/max_seq_no advance at index time, but
+        the indexer's search only sees refreshed segments. A fingerprint
+        recorded ahead of searchable state would mark docs as processed
+        that the pass never saw — the tick then skips forever and the
+        delta is lost (the wall-clock race the rollup cluster test used
+        to lose).
+
+        The fingerprint is still only LOCAL visibility, while the
+        indexer's search is cluster-wide (a remote primary may hold
+        refreshed docs this node's replica never shows) — so skipping is
+        bounded by MAX_FP_SKIPS rather than trusted outright."""
         if isinstance(indices, list):
             indices = ",".join(indices)
         total, max_seq = 0, -1
         try:
             for svc in self.node.indices.resolve(indices):
                 for shard in svc.shards:
-                    total += shard.engine.doc_count()
-                    max_seq = max(max_seq, shard.engine.max_seq_no)
+                    reader = shard.engine.acquire_searcher()
+                    total += reader.num_docs
+                    # the seq_no scan is O(live docs); readers are
+                    # immutable point-in-time snapshots keyed by gen, so
+                    # cache per reader generation — ticks against an
+                    # unchanged reader stay O(1)
+                    cached = getattr(shard, "_fp_seq_cache", None)
+                    if cached is not None and cached[0] == reader.gen:
+                        shard_max = cached[1]
+                    else:
+                        shard_max = -1
+                        for view in reader.views:
+                            if view.live.any():
+                                shard_max = max(shard_max, int(
+                                    view.segment.seq_nos[view.live].max()))
+                        shard._fp_seq_cache = (reader.gen, shard_max)
+                    max_seq = max(max_seq, shard_max)
         except Exception:
             return ("unresolvable",)
         return (total, max_seq)
@@ -164,11 +199,14 @@ class TransformService:
                     or "sync" not in cfg:
                 continue
             fp = self._source_fingerprint(cfg["source"].get("index"))
-            if st.get("last_source_fp") == fp:
+            if st.get("last_source_fp") == fp \
+                    and st.get("fp_skips", 0) < MAX_FP_SKIPS:
+                st["fp_skips"] = st.get("fp_skips", 0) + 1
                 continue
             try:
                 self.trigger(tid)
                 st["last_source_fp"] = fp
+                st["fp_skips"] = 0
                 st.pop("failure_count", None)
             except Exception as e:  # a tick failure must not kill the
                 _record_indexer_failure(st, e)  # scheduler — but it must
@@ -316,11 +354,14 @@ class RollupService:
                 continue
             fp = TransformService._source_fingerprint(
                 self, cfg["index_pattern"])
-            if st.get("last_source_fp") == fp:
+            if st.get("last_source_fp") == fp \
+                    and st.get("fp_skips", 0) < MAX_FP_SKIPS:
+                st["fp_skips"] = st.get("fp_skips", 0) + 1
                 continue
             try:
                 self.trigger(jid)
                 st["last_source_fp"] = fp
+                st["fp_skips"] = 0
                 st.pop("failure_count", None)
             except Exception as e:  # a tick failure must not kill the
                 # scheduler (see transform)

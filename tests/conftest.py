@@ -15,7 +15,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.5 jax: the config knob doesn't exist; the XLA flag does the
+    # same as long as it lands before first backend initialization
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running sweeps excluded from tier-1 "
+                   "(`-m 'not slow'`)")
 
 
 import pytest

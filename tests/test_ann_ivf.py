@@ -1,0 +1,398 @@
+"""IVF partitioned ANN engine (`elasticsearch_tpu/ann/` + `ops/knn_ivf.py`).
+
+Fast fixed-seed smoke tests (small synthetic corpus, nlist=16) keep tier-1
+within budget; the full 100k-doc recall-gate sweep is `@pytest.mark.slow`.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.ann import IVFRouter, build_ivf_index
+from elasticsearch_tpu.ann import kmeans as kmeans_lib
+from elasticsearch_tpu.ops import knn as knn_ops
+from elasticsearch_tpu.ops import similarity as sim
+
+SEED = 1234
+
+
+def _clustered(n, d, n_centers=16, seed=SEED, spread=1.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 3.0
+    assign = rng.integers(0, n_centers, size=n)
+    vecs = centers[assign] + spread * rng.standard_normal(
+        (n, d)).astype(np.float32)
+    return vecs.astype(np.float32), centers
+
+
+def _exact_topk(vecs, queries, k, metric):
+    import jax.numpy as jnp
+
+    corpus = knn_ops.build_corpus(vecs, metric=metric, dtype="f32")
+    _, ids = knn_ops.knn_search(jnp.asarray(queries), corpus, k,
+                                metric=metric, precision="f32")
+    return np.asarray(ids)
+
+
+def _recall(rows, ids_ref):
+    k = ids_ref.shape[1]
+    hits = sum(len(set(rows[i]) & set(ids_ref[i]))
+               for i in range(len(ids_ref)))
+    return hits / (len(ids_ref) * k)
+
+
+# ---------------------------------------------------------------- kmeans
+
+def test_kmeans_trains_deterministic_centroids():
+    vecs, _ = _clustered(4000, 24)
+    c1 = kmeans_lib.train_kmeans(vecs, 16, seed=7)
+    c2 = kmeans_lib.train_kmeans(vecs, 16, seed=7)
+    assert c1.shape == (16, 24)
+    assert np.isfinite(c1).all()
+    np.testing.assert_array_equal(c1, c2)
+    # centroids actually spread over the data: every centroid has members,
+    # and assignment distortion beats a degenerate single-center layout
+    assign = np.asarray(kmeans_lib.assign_blocks(vecs, c1))
+    assert len(np.unique(assign)) >= 12
+    d_km = np.linalg.norm(vecs - c1[assign], axis=1).mean()
+    d_one = np.linalg.norm(vecs - vecs.mean(0), axis=1).mean()
+    assert d_km < 0.7 * d_one
+
+
+def test_kmeans_rejects_bad_args():
+    vecs, _ = _clustered(64, 8)
+    with pytest.raises(ValueError):
+        kmeans_lib.train_kmeans(vecs, 128)  # more centroids than rows
+    with pytest.raises(ValueError):
+        kmeans_lib.train_kmeans(vecs, 0)
+
+
+# ----------------------------------------------------------- index build
+
+def test_build_respects_capacity_and_keeps_every_row():
+    vecs, _ = _clustered(5000, 16)
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=16, seed=SEED)
+    assert index.total == 5000
+    assert (index.counts <= index.cap).all()
+    assert index.cap % 8 == 0  # tile-padded
+    assert index.spilled == 0
+    # every input row id appears exactly once across the buckets
+    rows = index.part_rows[index.part_rows >= 0]
+    assert sorted(rows.tolist()) == list(range(5000))
+
+
+def test_smoke_recall_nlist16():
+    """Fixed-seed smoke: small corpus, nlist=16 — the tier-1 stand-in for
+    the slow 100k sweep."""
+    vecs, centers = _clustered(4096, 32)
+    rng = np.random.default_rng(SEED + 1)
+    queries = vecs[rng.integers(0, len(vecs), 64)] \
+        + 0.1 * rng.standard_normal((64, 32)).astype(np.float32)
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=16, seed=SEED)
+    router = IVFRouter(index, nprobe="auto", recall_target=0.95)
+    nprobe = router.effective_nprobe(10)
+    _, rows, phases = router.search(queries, 10)
+    recall = _recall(rows, _exact_topk(vecs, queries, 10, sim.COSINE))
+    assert recall >= 0.9, f"recall {recall} at nprobe {nprobe}"
+    assert phases["engine"] == "tpu_ivf"
+    assert phases["scored_rows"] < 4096  # actually pruned
+
+
+@pytest.mark.parametrize("metric", [sim.L2_NORM, sim.DOT_PRODUCT])
+def test_other_metrics(metric):
+    vecs, _ = _clustered(3000, 16)
+    rng = np.random.default_rng(SEED + 2)
+    queries = vecs[rng.integers(0, len(vecs), 32)] \
+        + 0.05 * rng.standard_normal((32, 16)).astype(np.float32)
+    index = build_ivf_index(vecs, metric=metric, nlist=16, seed=SEED)
+    router = IVFRouter(index, nprobe=8)
+    _, rows, _ = router.search(queries, 10)
+    recall = _recall(rows, _exact_topk(vecs, queries, 10, metric))
+    assert recall >= 0.9, f"{metric} recall {recall}"
+
+
+def test_int8_partitions_match_fp32_partitions():
+    vecs, _ = _clustered(3000, 16)
+    rng = np.random.default_rng(SEED + 3)
+    queries = vecs[rng.integers(0, len(vecs), 32)]
+    i_f = build_ivf_index(vecs, metric=sim.COSINE, nlist=16, seed=SEED,
+                          dtype="f32")
+    i_q = build_ivf_index(vecs, metric=sim.COSINE, nlist=16, seed=SEED,
+                          dtype="int8")
+    r_f = IVFRouter(i_f, nprobe=8)
+    r_q = IVFRouter(i_q, nprobe=8)
+    s_f, rows_f, _ = r_f.search(queries, 10)
+    s_q, rows_q, _ = r_q.search(queries, 10)
+    # int8 quantization may swap near-ties but the candidate sets overlap
+    overlap = sum(len(set(rows_f[i]) & set(rows_q[i]))
+                  for i in range(32)) / 320
+    assert overlap >= 0.9
+    # scores agree within int8 tolerance where rows agree
+    for i in range(32):
+        common = set(rows_f[i]) & set(rows_q[i])
+        for r in common:
+            sf = s_f[i][list(rows_f[i]).index(r)]
+            sq = s_q[i][list(rows_q[i]).index(r)]
+            assert abs(sf - sq) < 0.05
+
+
+def test_incremental_add_and_retrain_threshold():
+    vecs, centers = _clustered(2000, 16)
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=16, seed=SEED,
+                            retrain_threshold=0.2)
+    assert not index.needs_retrain
+    # adds land in buckets and become searchable
+    rng = np.random.default_rng(SEED + 4)
+    extra = (centers[3] + 0.05 * rng.standard_normal(
+        (50, 16))).astype(np.float32)
+    index.add(extra, np.arange(2000, 2050, dtype=np.int32))
+    assert index.total == 2050
+    router = IVFRouter(index, nprobe=4)
+    _, rows, _ = router.search(extra[:8], 5)
+    assert (rows.flatten() >= 2000).any(), "added rows never surfaced"
+    # a drifted flood displaces adds past the threshold → retrain flag
+    flood = (centers[5] + 0.02 * rng.standard_normal(
+        (index.cap * 5, 16))).astype(np.float32)
+    index.add(flood, np.arange(3000, 3000 + len(flood), dtype=np.int32))
+    assert index.displaced > 0
+    assert index.needs_retrain
+    assert IVFRouter(index, nprobe=4).should_fallback(
+        10, False, "bf16") == "needs_retrain"
+
+
+def test_auto_nprobe_meets_target_on_sample():
+    vecs, _ = _clustered(6000, 24, spread=2.0)  # blurrier clusters
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=32, seed=SEED)
+    router = IVFRouter(index, nprobe="auto", recall_target=0.95,
+                       tune_sample=64)
+    nprobe = router.effective_nprobe(10)
+    assert 1 <= nprobe <= 32
+    # the tuned setting really meets the gate on the held-out sample
+    rng = np.random.default_rng(router.tune_seed)
+    # recall on corpus rows as queries (self-recall) must clear the gate
+    pick = rng.integers(0, len(vecs), 64)
+    _, rows, _ = router.search(vecs[pick], 10, nprobe=nprobe)
+    recall = _recall(rows, _exact_topk(vecs, vecs[pick], 10, sim.COSINE))
+    assert recall >= 0.93, f"tuned nprobe {nprobe} gives recall {recall}"
+
+
+def test_fallback_reasons():
+    vecs, _ = _clustered(2000, 16)
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=16, seed=SEED)
+    router = IVFRouter(index, nprobe=4)
+    assert router.should_fallback(10, True, "bf16") == "filtered"
+    assert router.should_fallback(10, False, "f32") == "f32_precision"
+    assert router.should_fallback(index.cap + 1, False, "bf16") \
+        == "k_exceeds_partition"
+    assert router.should_fallback(10, False, "bf16") is None
+
+
+# ------------------------------------------------------- store dispatch
+
+def _make_store_with_field(vecs, engine="tpu_ivf", nlist=16):
+    """VectorStoreShard over a synthetic sealed segment."""
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import Segment, SegmentView, ShardReader
+    from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+    n, d = vecs.shape
+    seg = Segment(seg_id=0, base=0, num_docs=n, postings={},
+                  field_lengths={}, total_terms={}, doc_values={},
+                  vectors={"v": (vecs, np.ones(n, dtype=bool))},
+                  ids=[str(i) for i in range(n)], sources=[None] * n,
+                  seq_nos=np.arange(n, dtype=np.int64))
+    reader = ShardReader([SegmentView(seg)])
+    ms = MapperService({"properties": {
+        "v": {"type": "dense_vector", "dims": d}}})
+    store = VectorStoreShard(knn_engine=engine, knn_nlist=nlist,
+                             knn_nprobe=4)
+    store.sync(reader, ms.vector_fields())
+    return store
+
+
+def test_store_routes_through_ivf_and_falls_back_on_filter():
+    vecs, _ = _clustered(2000, 16)
+    store = _make_store_with_field(vecs)
+    fc = store.field("v")
+    assert fc.router is not None
+    rows, scores = store.search("v", vecs[7], 5)
+    assert 7 in rows
+    assert store.knn_stats["ivf_searches"] == 1
+    assert store.last_knn_phases["engine"] == "tpu_ivf"
+    assert store.last_knn_phases["score_nanos"] > 0
+    # filtered search takes the exhaustive escape hatch
+    rows_f, _ = store.search("v", vecs[7], 5,
+                             filter_rows=np.arange(100, dtype=np.int64))
+    assert store.knn_stats["fallback_searches"] == 1
+    assert store.last_knn_phases["fallback_reason"] == "filtered"
+    assert (rows_f < 100).all()
+
+
+def test_store_append_only_refresh_reuses_layout():
+    """A refresh that only appends segments places the delta into the
+    existing partition layout (no k-means retrain, tuned nprobe kept);
+    the full rebuild happens only on non-append changes or drift."""
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import Segment, SegmentView, ShardReader
+    from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+    vecs, centers = _clustered(2000, 16)
+    n = len(vecs)
+
+    def seg_of(mat, base, seg_id):
+        m = len(mat)
+        return Segment(seg_id=seg_id, base=base, num_docs=m, postings={},
+                       field_lengths={}, total_terms={}, doc_values={},
+                       vectors={"v": (mat, np.ones(m, dtype=bool))},
+                       ids=[str(base + i) for i in range(m)],
+                       sources=[None] * m,
+                       seq_nos=np.arange(base, base + m, dtype=np.int64))
+
+    ms = MapperService({"properties": {
+        "v": {"type": "dense_vector", "dims": 16}}})
+    store = VectorStoreShard(knn_engine="tpu_ivf", knn_nlist=16,
+                             knn_nprobe=4)
+    seg0 = seg_of(vecs, 0, 0)
+    store.sync(ShardReader([SegmentView(seg0)]), ms.vector_fields())
+    router0 = store.field("v").router
+    assert router0 is not None
+
+    # append-only refresh: same first segment + a new sealed one
+    rng = np.random.default_rng(SEED + 5)
+    extra = (centers[2] + 0.1 * rng.standard_normal(
+        (64, 16))).astype(np.float32)
+    reader2 = ShardReader([SegmentView(seg0),
+                           SegmentView(seg_of(extra, n, 1))])
+    store.sync(reader2, ms.vector_fields())
+    fc = store.field("v")
+    assert fc.router is router0, "append-only sync retrained k-means"
+    assert fc.router.index.total == n + 64
+    rows, _ = store.search("v", extra[0], 5)
+    assert (rows >= n).any(), "appended rows not searchable via IVF"
+
+    # a delete (changed live set) breaks the append-only prefix → rebuild
+    reader3 = ShardReader([SegmentView(seg0, deleted_locals={0}),
+                           SegmentView(seg_of(extra, n, 1))])
+    store.sync(reader3, ms.vector_fields())
+    assert store.field("v").router is not router0
+
+
+def test_store_default_engine_stays_exhaustive():
+    vecs, _ = _clustered(1500, 16)
+    store = _make_store_with_field(vecs, engine="tpu")
+    assert store.field("v").router is None
+    rows, _ = store.search("v", vecs[3], 5)
+    assert 3 in rows
+    assert store.knn_stats["ivf_searches"] == 0
+
+
+def test_field_level_index_options_override():
+    """index_options.type: ivf opts a field in even when the index-level
+    engine is the default exhaustive one."""
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+    ms = MapperService({"properties": {
+        "v": {"type": "dense_vector", "dims": 16,
+              "index_options": {"type": "ivf", "nlist": 16}}}})
+    store = VectorStoreShard(knn_engine="tpu")
+    vecs, _ = _clustered(2000, 16)
+    from elasticsearch_tpu.index.segment import Segment, SegmentView, ShardReader
+    n = len(vecs)
+    seg = Segment(seg_id=0, base=0, num_docs=n, postings={},
+                  field_lengths={}, total_terms={}, doc_values={},
+                  vectors={"v": (vecs, np.ones(n, dtype=bool))},
+                  ids=[str(i) for i in range(n)], sources=[None] * n,
+                  seq_nos=np.arange(n, dtype=np.int64))
+    reader = ShardReader([SegmentView(seg)])
+    store.sync(reader, ms.vector_fields())
+    fc = store.field("v")
+    assert fc.router is not None
+    assert fc.router.index.nlist == 16
+
+
+def test_index_settings_validation():
+    import tempfile
+
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    from elasticsearch_tpu.index.mapping import MapperParsingError, MapperService
+    from elasticsearch_tpu.indices.service import IndicesService
+
+    indices = IndicesService(tempfile.mkdtemp())
+    with pytest.raises(IllegalArgumentError):
+        indices.create_index("bad", settings={"index.knn.engine": "hnsw"})
+    with pytest.raises(IllegalArgumentError):
+        indices.create_index("bad2", settings={
+            "index.knn.engine": "tpu_ivf", "index.knn.nlist": 0})
+    with pytest.raises(IllegalArgumentError):
+        indices.create_index("bad3", settings={
+            "index.knn.engine": "tpu_ivf", "index.knn.nprobe": "lots"})
+    with pytest.raises(MapperParsingError):
+        MapperService({"properties": {"v": {
+            "type": "dense_vector", "dims": 4,
+            "index_options": {"type": "hnsw"}}}})
+    with pytest.raises(MapperParsingError):
+        # "auto" is an nprobe concept; nlist must be a real integer
+        MapperService({"properties": {"v": {
+            "type": "dense_vector", "dims": 4,
+            "index_options": {"type": "ivf", "nlist": "auto"}}}})
+    indices.close()
+
+
+def test_small_corpus_stays_exhaustive_under_ivf_engine():
+    """Below IVF_MIN_ROWS the engine quietly serves exhaustive."""
+    vecs, _ = _clustered(100, 8)
+    store = _make_store_with_field(vecs)
+    assert store.field("v").router is None
+    rows, _ = store.search("v", vecs[0], 5)
+    assert 0 in rows
+
+
+# ------------------------------------------------------------ slow sweep
+
+@pytest.mark.slow
+def test_recall_gate_100k_corpus():
+    """Acceptance: >=100k-doc corpus, tuned nprobe reaches recall@10 >=
+    0.95 vs exhaustive ground truth while scoring <= 25% of the corpus."""
+    vecs, _ = _clustered(100_000, 64, n_centers=256, seed=SEED)
+    rng = np.random.default_rng(SEED + 9)
+    queries = vecs[rng.integers(0, len(vecs), 128)] \
+        + 0.1 * rng.standard_normal((128, 64)).astype(np.float32)
+
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=256, seed=SEED)
+    router = IVFRouter(index, nprobe="auto", recall_target=0.95)
+    nprobe = router.effective_nprobe(10)
+
+    frac = index.scored_fraction(nprobe)
+    assert frac <= 0.25, f"tuned nprobe {nprobe} scores {frac:.1%}"
+
+    _, rows, phases = router.search(queries, 10)
+    recall = _recall(rows, _exact_topk(vecs, queries, 10, sim.COSINE))
+    assert recall >= 0.95, \
+        f"recall {recall:.4f} at nprobe {nprobe} (scored {frac:.1%})"
+    assert phases["scored_rows"] <= 0.25 * len(vecs)
+
+
+@pytest.mark.slow
+def test_recall_gate_100k_int8():
+    """int8 partitions: the tuner gates ROUTING recall (vs the engine's
+    own full probe — extra probes can't undo quantization), and the
+    end-to-end recall vs exact f32 stays within the int8 envelope."""
+    vecs, _ = _clustered(100_000, 64, n_centers=256, seed=SEED)
+    rng = np.random.default_rng(SEED + 10)
+    queries = vecs[rng.integers(0, len(vecs), 64)] \
+        + 0.1 * rng.standard_normal((64, 64)).astype(np.float32)
+    index = build_ivf_index(vecs, metric=sim.COSINE, nlist=256, seed=SEED,
+                            dtype="int8")
+    router = IVFRouter(index, nprobe="auto", recall_target=0.95)
+    nprobe = router.effective_nprobe(10)
+    assert index.scored_fraction(nprobe) <= 0.25, \
+        f"tuned nprobe {nprobe} defeats pruning"
+    _, rows, _ = router.search(queries, 10)
+    # routing recall: tuned probing finds what full probing would
+    _, rows_full, _ = router.search(queries, 10, nprobe=index.nlist)
+    routing_recall = _recall(rows, rows_full)
+    assert routing_recall >= 0.95, \
+        f"routing recall {routing_recall:.4f} at nprobe {nprobe}"
+    # end-to-end vs exact f32: quantization envelope on top of the gate
+    recall = _recall(rows, _exact_topk(vecs, queries, 10, sim.COSINE))
+    assert recall >= 0.90, f"int8 recall {recall:.4f} at nprobe {nprobe}"

@@ -41,6 +41,9 @@ def test_unavailable_backend_emits_diagnostic_json(monkeypatch):
     monkeypatch.setattr(bench, "_probe_backend",
                         lambda timeout_s: (False, "UNAVAILABLE"))
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # no CPU floor available either: the child fails too
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda timeout_s, extra_env=None: (1, "", "down"))
     import pytest
     with pytest.raises(SystemExit) as e, _capture_stdout() as buf:
         bench.main()
@@ -48,6 +51,32 @@ def test_unavailable_backend_emits_diagnostic_json(monkeypatch):
     out = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert out["error"] == "tpu_backend_unavailable"
     assert out["metric"] == bench.METRIC
+    assert "last_known_good" in out
+
+
+def test_unavailable_backend_falls_back_to_labeled_cpu_floor(monkeypatch):
+    """Probe failure must produce a labeled CPU-floor measurement, not an
+    evidence-free value: 0 (three of five past rounds went evidence-free)."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout_s: (False, "UNAVAILABLE"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    floor = json.dumps({"metric": bench.METRIC, "value": 432.1,
+                        "unit": "qps", "n_docs": 131072})
+
+    def fake_child(timeout_s, extra_env=None):
+        assert extra_env and extra_env["JAX_PLATFORMS"] == "cpu"
+        return 0, floor + "\n", ""
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    import pytest
+    with pytest.raises(SystemExit) as e, _capture_stdout() as buf:
+        bench.main()
+    assert e.value.code == 1  # still not an official device capture
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["backend"] == "cpu_floor"
+    assert out["value"] == 432.1
+    assert out["error"] == "tpu_backend_unavailable"
     assert "last_known_good" in out
 
 

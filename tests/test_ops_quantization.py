@@ -1,0 +1,109 @@
+"""`ops/quantization.py`: round-trip bounds, scale edge cases, scoring
+parity — the int8 recipe every storage path (flat corpus, IVF partitions,
+sharded layout) routes through."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.ops.quantization import (
+    dequantize_int8, quantize_int8, quantize_int8_np,
+)
+
+
+def _roundtrip_err(mat, q8, scales):
+    recon = q8.astype(np.float32) * scales[:, None]
+    return np.abs(recon - mat)
+
+
+def test_roundtrip_error_bound():
+    """Symmetric max-abs/127 quantization bounds per-element error by half
+    a quantization step: |x - q*s| <= s/2 = max|row|/254."""
+    rng = np.random.default_rng(0)
+    mat = rng.standard_normal((256, 64)).astype(np.float32) * 5.0
+    q8, scales = quantize_int8_np(mat)
+    assert q8.dtype == np.int8
+    assert scales.dtype == np.float32
+    err = _roundtrip_err(mat, q8, scales)
+    bound = (np.abs(mat).max(axis=1) / 127.0 / 2.0)[:, None] + 1e-6
+    assert (err <= bound).all()
+
+
+def test_device_and_host_paths_agree():
+    """quantize_int8 (device) and quantize_int8_np (host) implement ONE
+    policy — both levels of build_corpus depend on that."""
+    rng = np.random.default_rng(1)
+    mat = rng.standard_normal((64, 32)).astype(np.float32)
+    q_np, s_np = quantize_int8_np(mat)
+    q_dev, s_dev = quantize_int8(mat)
+    np.testing.assert_array_equal(q_np, np.asarray(q_dev))
+    np.testing.assert_allclose(s_np, np.asarray(s_dev), rtol=1e-6)
+
+
+def test_scale_edge_cases():
+    # all-zero row: the 1e-30 scale floor prevents divide-by-zero and
+    # round-trips to exact zeros
+    mat = np.zeros((4, 8), dtype=np.float32)
+    mat[1] = 1e-38  # denormal-ish magnitudes stay finite too
+    mat[2] = -3.0   # pure negative row is symmetric around zero
+    mat[3, 0] = 1e30  # huge magnitude: scale grows, no overflow/clip bias
+    q8, scales = quantize_int8_np(mat)
+    assert np.isfinite(scales).all()
+    assert (scales > 0).all()
+    assert (q8[0] == 0).all()
+    assert q8[2].min() == -127  # symmetric: full range reachable, no -128
+    assert q8.min() >= -127 and q8.max() <= 127
+    recon = q8.astype(np.float32) * scales[:, None]
+    assert recon[3, 0] == pytest.approx(1e30, rel=0.01)
+    assert (recon[0] == 0).all()
+
+
+def test_zero_point_symmetry():
+    """Symmetric scheme: zero always maps to code 0 exactly (no zero-point
+    offset), so padding rows stay exactly zero post-dequant."""
+    rng = np.random.default_rng(2)
+    mat = rng.standard_normal((16, 16)).astype(np.float32)
+    mat[:, 3] = 0.0
+    q8, scales = quantize_int8_np(mat)
+    assert (q8[:, 3] == 0).all()
+    deq = np.asarray(dequantize_int8(q8, scales))
+    assert (deq[:, 3] == 0).all()
+
+
+def test_int8_scoring_parity_vs_fp32():
+    """End-to-end: int8-stored corpus scores match fp32 within tolerance
+    and preserve the top-k set on separated data."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import knn as knn_ops
+    from elasticsearch_tpu.ops import similarity as sim
+
+    rng = np.random.default_rng(3)
+    centers = rng.standard_normal((8, 32)).astype(np.float32) * 3.0
+    vecs = (centers[rng.integers(0, 8, 500)]
+            + 0.3 * rng.standard_normal((500, 32)).astype(np.float32))
+    queries = vecs[rng.integers(0, 500, 16)]
+
+    c_f32 = knn_ops.build_corpus(vecs, metric=sim.COSINE, dtype="f32")
+    c_int8 = knn_ops.build_corpus(vecs, metric=sim.COSINE, dtype="int8",
+                                  residual=False)
+    s_ref, i_ref = knn_ops.knn_search(jnp.asarray(queries), c_f32, 10,
+                                      metric=sim.COSINE, precision="f32")
+    s_q, i_q = knn_ops.knn_search(jnp.asarray(queries), c_int8, 10,
+                                  metric=sim.COSINE, precision="f32")
+    s_ref, i_ref = np.asarray(s_ref), np.asarray(i_ref)
+    s_q, i_q = np.asarray(s_q), np.asarray(i_q)
+    # dense clusters have near-ties below the quantization step, so the
+    # top-10 *sets* may legitimately differ; parity means the int8 picks
+    # are near-optimal under exact f32 scoring
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    exact = qn @ vn.T
+    for qi in range(16):
+        kth_best = np.sort(exact[qi])[-10]
+        picked = exact[qi][i_q[qi]]
+        # every int8-selected neighbor scores within the int8 error
+        # envelope of the true 10th-best
+        assert (picked >= kth_best - 0.01).all(), \
+            f"query {qi}: int8 picked a non-near-optimal neighbor"
+        # and the reported int8 scores match exact f32 scores elementwise
+        np.testing.assert_allclose(s_q[qi], picked, atol=0.01)
