@@ -1,0 +1,173 @@
+"""Round-long TPU backend acquisition daemon → BENCH_MATRIX_r{N}.json.
+
+Three of five past rounds ended evidence-free because the benchmark ran
+once, at snapshot time, against a tunnel that happened to be dark
+(VERDICT r5 Next #1). This daemon inverts that: started at round open
+(`python bench_daemon.py --round 6 &`), it
+
+  1. polls for the TPU backend with the same killable-subprocess probe +
+     exponential backoff as `bench.py`, for up to `--max-wait-s` seconds;
+  2. the MOMENT acquisition succeeds, captures the full matrix
+     (`bench_matrix.py`) and writes `BENCH_MATRIX_r{N}.json` immediately —
+     not at snapshot time, so a mid-round window of tunnel health is
+     enough to put device rows on the record;
+  3. if the tunnel stays dark past the deadline, runs the SAME configs on
+     the CPU backend (BENCH_SMALL shapes) and writes them clearly labeled
+     `"backend": "cpu"` — relative claims (batcher p99 fix, fused hybrid
+     row, admission control) get demonstrated on one backend instead of
+     staying unproven for another round.
+
+Every emitted row is augmented with `backend`, and a `_meta` header line
+records which path produced the file. Partial captures are kept: each
+bench_matrix row prints (flushed) as it completes, so a mid-run hang
+still leaves every finished config on the record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(HERE, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def acquire_backend(max_wait_s: float, poll_s: float = 120.0,
+                    probe=None, sleep=time.sleep) -> tuple:
+    """Poll for a live TPU backend until the deadline. Returns
+    (platform_info | None, [probe error strings])."""
+    bench = _load_bench()
+    probe = probe or bench._probe_backend
+    deadline = time.monotonic() + max_wait_s
+    errors = []
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        ok, info = probe(timeout_s=max(30.0, min(120.0, remaining)))
+        if ok and not any(p in str(info).lower()
+                          for p in ("tpu", "axon")):
+            # jax booted but only found the host CPU: that is NOT an
+            # acquisition — a mislabeled full-size "tpu" capture on the
+            # CPU backend is worse than the honest labeled floor
+            ok, info = False, f"probe found non-accelerator [{info}]"
+        if ok:
+            return info, errors
+        errors.append(f"attempt {attempt}: {info}")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None, errors
+        # existing bench.py backoff discipline: exponential, capped, and
+        # never sleeping past the deadline
+        sleep(min(poll_s, 10 * 2 ** min(attempt - 1, 4), remaining))
+
+
+def run_matrix(extra_env: dict, timeout_s: float) -> list:
+    """Run bench_matrix.py in a watchdogged child; return every JSON row
+    it managed to print (rows flush as they complete, so a hang after
+    config N still yields configs 1..N)."""
+    env = dict(os.environ, **extra_env)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench_matrix.py")],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=HERE)
+        out = r.stdout
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+    rows = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            continue
+    return rows
+
+
+def label_rows(rows: list, backend: str, note: str = "") -> list:
+    """Stamp every row with its backend; rows must never be mistaken for
+    device numbers they are not."""
+    out = []
+    for row in rows:
+        row = dict(row)
+        row["backend"] = backend
+        if note:
+            row["backend_note"] = note
+        out.append(row)
+    return out
+
+
+def write_matrix(path: str, meta: dict, rows: list) -> None:
+    with open(path, "w") as f:
+        f.write(json.dumps({"_meta": meta}) + "\n")
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--round", type=int, required=True,
+                    help="round number N for BENCH_MATRIX_r{N:02d}.json")
+    ap.add_argument("--max-wait-s", type=float, default=3600.0,
+                    help="how long to poll for the TPU backend")
+    ap.add_argument("--poll-s", type=float, default=120.0)
+    ap.add_argument("--matrix-timeout-s", type=float, default=3600.0)
+    ap.add_argument("--once", action="store_true",
+                    help="probe once; no polling loop")
+    ap.add_argument("--cpu-only", action="store_true",
+                    help="skip probing, emit the labeled CPU matrix now")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    out_path = args.out or os.path.join(
+        HERE, f"BENCH_MATRIX_r{args.round:02d}.json")
+
+    platform, errors = (None, ["cpu-only requested"]) if args.cpu_only \
+        else acquire_backend(0 if args.once else args.max_wait_s,
+                             poll_s=args.poll_s)
+    started = time.time()
+    if platform is not None:
+        rows = run_matrix({}, args.matrix_timeout_s)
+        meta = {"round": args.round, "backend": "tpu",
+                "platform": platform, "captured_unix": int(started),
+                "wall_s": round(time.time() - started, 1)}
+        write_matrix(out_path, meta, label_rows(rows, "tpu"))
+        print(json.dumps({"daemon": "captured", "backend": "tpu",
+                          "rows": len(rows), "path": out_path}))
+        return 0
+
+    # tunnel stayed dark: same configs, CPU backend, honestly labeled
+    note = ("TPU tunnel dark for the whole acquisition window; "
+            "CPU-backend row on BENCH_SMALL shapes — relative claims "
+            "only, NOT a device number")
+    rows = run_matrix({"JAX_PLATFORMS": "cpu", "BENCH_SMALL": "1"},
+                      args.matrix_timeout_s)
+    meta = {"round": args.round, "backend": "cpu",
+            "probe_errors": errors[-3:], "captured_unix": int(started),
+            "wall_s": round(time.time() - started, 1),
+            "note": note}
+    write_matrix(out_path, meta, label_rows(rows, "cpu", note))
+    print(json.dumps({"daemon": "captured", "backend": "cpu",
+                      "rows": len(rows), "path": out_path,
+                      "probe_errors": errors[-2:]}))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

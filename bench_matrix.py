@@ -121,10 +121,15 @@ def _emit(name, qps, marginal, p50, p99, recall, n, d, dtype, extra=None):
 
 
 def run_config(name, n, d, metric, dtype, filter_frac=None):
+    import os
+
     import jax
     import jax.numpy as jnp
 
     from elasticsearch_tpu.ops import knn as knn_ops
+
+    if os.environ.get("BENCH_SMALL") == "1":
+        n = min(n, 131_072)
 
     rng = np.random.default_rng(7)
     centers = rng.standard_normal((128, d)).astype(np.float32) * 2.0
@@ -235,8 +240,13 @@ def run_north_star_10m_int8(n: int = 10_000_000, emit: bool = True,
     measure the packed rescore against it — the recall-headroom recipe
     (ops/pallas_knn_binned._rescore_scores). Doubles corpus HBM, so run
     it at n <= 5M on a 16 GB chip."""
+    import os
+
     import jax
     import jax.numpy as jnp
+
+    if os.environ.get("BENCH_SMALL") == "1":
+        n = min(n, 1_000_000)
 
     from elasticsearch_tpu.ops import knn as knn_ops
     from elasticsearch_tpu.ops.knn import Corpus
@@ -447,7 +457,12 @@ def run_hybrid_rrf():
     import os
 
     rng = np.random.default_rng(3)
-    n_docs = 10_000 if os.environ.get("BENCH_SMALL") == "1" else 100_000
+    # BENCH_HYBRID_FULL=1 forces the full 100k corpus even in small mode:
+    # the acceptance gate for config 3 is stated against 100k docs, and a
+    # CPU-floor capture should still measure that corpus when given time
+    n_docs = 10_000 if (os.environ.get("BENCH_SMALL") == "1"
+                        and os.environ.get("BENCH_HYBRID_FULL") != "1") \
+        else 100_000
     dims = 768
     vocab = np.array([f"tok{i}" for i in range(20_000)])
     zipf = (rng.zipf(1.25, size=n_docs * 12) - 1) % 20_000
@@ -502,13 +517,20 @@ def run_hybrid_rrf():
                       "n_docs": n_docs, "dims": dims,
                       "build_s": round(build_s, 1)}), flush=True)
 
-    # concurrent clients: the combining batcher coalesces the kNN phases
-    # into shared host-kernel dispatches
+    # concurrent clients: whole hybrid queries coalesce through the
+    # fused-plan batcher into shared lexical + kNN dispatches
     n_clients, per_client = 8, 40
     client_bodies = [[rand_query() for _ in range(per_client)]
                      for _ in range(n_clients)]
-    for b in client_bodies[0][:2]:
-        node.search("hybrid", b)  # warm any new code paths
+    # concurrent warmup: the batched lexical/kNN jits specialize on
+    # power-of-2 batch buckets — compile them OUTSIDE the timed loop
+    warm = [threading.Thread(
+        target=lambda: [node.search("hybrid", rand_query())
+                        for _ in range(6)]) for _ in range(n_clients)]
+    for t in warm:
+        t.start()
+    for t in warm:
+        t.join()
     all_lats = [[] for _ in range(n_clients)]
 
     def client(ci):
@@ -526,13 +548,24 @@ def run_hybrid_rrf():
         t.join()
     wall = time.perf_counter() - t0
     lats = np.concatenate(all_lats)
+    p50 = float(np.percentile(lats, 50))
+    p99 = float(np.percentile(lats, 99))
+    hybrid_stats = node._hybrid_stats_section()
+    qps = n_clients * per_client / wall
     print(json.dumps({"config": "3_hybrid_bm25_knn_rrf",
-                      "qps": round(n_clients * per_client / wall, 1),
-                      "p50_ms": round(float(np.percentile(lats, 50)), 2),
-                      "p99_ms": round(float(np.percentile(lats, 99)), 2),
+                      "qps": round(qps, 1),
+                      "p50_ms": round(p50, 2),
+                      "p99_ms": round(p99, 2),
+                      "p99_over_p50": round(p99 / max(p50, 1e-9), 2),
+                      "gate_500qps": bool(qps >= 500),
                       "n_docs": n_docs, "dims": dims,
                       "concurrent_clients": n_clients,
-                      "fused_lists": 2}), flush=True)
+                      "fused_lists": 2,
+                      "execution": "fused_hybrid_plan",
+                      "plan_cache_hits": hybrid_stats["plan_cache_hits"],
+                      "hybrid_batches": hybrid_stats["batches"],
+                      "rejected_429": hybrid_stats["rejected_depth"]
+                      + hybrid_stats["shed_deadline"]}), flush=True)
     node.close()
 
 
@@ -555,6 +588,92 @@ def _inject_vector_segment(shard, field, mat):
     engine.segments.append(seg)
     engine._next_seg_id += 1
     engine._next_row += n
+
+
+def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
+                    n_clients: int = 8, per_client: int = 40):
+    """8-client closed-loop latency through the full serving path
+    (Node.search → CombiningBatcher → device/host kernel) for the
+    config-1 and config-4 corpus shapes.
+
+    The row exists to prove the p99 tail fix: the r03 record showed
+    1,086 ms (config 1) and 2,508 ms (config 4) p99 against ~70 ms p50 —
+    unbounded queueing at batch 256. With the combining batcher + bounded
+    admission, the recorded gate is p99 <= 3x p50 (VERDICT r5 Next #2);
+    the row prints the measured ratio and the boolean so the record
+    itself says whether the gate held."""
+    import tempfile
+    import threading
+
+    from elasticsearch_tpu.node import Node
+
+    rng = np.random.default_rng(17)
+    node = Node(tempfile.mkdtemp())
+    mapping = {"properties": {"v": {"type": "dense_vector", "dims": d}}}
+    if dtype == "int8":
+        mapping["properties"]["v"]["index_options"] = {"type": "int8_flat"}
+    node.create_index_with_templates(name, mappings=mapping)
+    t0 = time.perf_counter()
+    mat = rng.standard_normal((n, d)).astype(np.float32)
+    _inject_vector_segment(node.indices.get(name).shards[0], "v", mat)
+    del mat
+    node.indices.get(name).refresh()
+    build_s = time.perf_counter() - t0
+
+    def body():
+        return {"knn": {"field": "v",
+                        "query_vector":
+                            rng.standard_normal(d).astype(
+                                np.float32).tolist(),
+                        "k": 10, "num_candidates": 10},
+                "size": 10, "_source": False}
+
+    # warmup must cover the CONCURRENT path: the combining batcher pads
+    # coalesced batches to power-of-2 buckets and the device jit
+    # specializes per bucket — an unwarmed bucket compiling inside the
+    # timed loop reads as a multi-second p99 outlier that has nothing to
+    # do with steady-state serving
+    def warm_client():
+        for _ in range(6):
+            node.search(name, body())
+
+    warm = [threading.Thread(target=warm_client)
+            for _ in range(n_clients)]
+    for t in warm:
+        t.start()
+    for t in warm:
+        t.join()
+    client_bodies = [[body() for _ in range(per_client)]
+                     for _ in range(n_clients)]
+    all_lats = [[] for _ in range(n_clients)]
+
+    def client(ci):
+        for b in client_bodies[ci]:
+            t0 = time.perf_counter()
+            node.search(name, b)
+            all_lats[ci].append((time.perf_counter() - t0) * 1000)
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lats = np.concatenate(all_lats)
+    p50 = float(np.percentile(lats, 50))
+    p99 = float(np.percentile(lats, 99))
+    print(json.dumps({
+        "config": f"{name}_closed_loop_8c",
+        "qps": round(n_clients * per_client / wall, 1),
+        "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+        "p99_over_p50": round(p99 / max(p50, 1e-9), 2),
+        "gate_p99_le_3x_p50": bool(p99 <= 3 * p50),
+        "n_docs": n, "dims": d, "dtype": dtype,
+        "concurrent_clients": n_clients,
+        "build_s": round(build_s, 1)}), flush=True)
+    node.close()
 
 
 def run_e2e_single():
@@ -679,15 +798,44 @@ def run_sharded_fused():
 
 
 def main():
-    run_config("1_cosine_sift1m", 1_000_000, 128, "cosine", "bf16")
-    run_config("2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16")
-    run_hybrid_rrf()
-    run_e2e_single()
-    run_north_star_10m_int8()
-    run_config("5_filtered_10pct", 1_000_000, 128, "cosine", "bf16",
-               filter_frac=0.10)
-    run_ivf_config()
-    run_sharded_fused()
+    import os
+    import traceback
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+
+    def guarded(fn, *args, **kwargs):
+        """One config must never lose the rest of the matrix: rows flush
+        as they complete, and a config that can't run on this backend
+        (e.g. the Pallas binned kernel on the CPU floor) reports itself
+        as a labeled failure line instead of killing the process."""
+        try:
+            fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — diagnostic row, not fatal
+            print(json.dumps({
+                "config": f"{getattr(fn, '__name__', str(fn))}",
+                "error": f"{type(e).__name__}: {e}"[:300],
+                "trace_tail": traceback.format_exc().strip()
+                .splitlines()[-1][:200]}), flush=True)
+
+    # serving-path rows first: the hybrid fused plan and the 8-client
+    # closed-loop tail rows are the record's open questions (VERDICT r5
+    # Next #1/#2); raw-kernel configs follow
+    guarded(run_hybrid_rrf)
+    guarded(run_closed_loop, "1cl", 100_000 if small else 1_000_000, 128,
+            dtype="bf16")
+    # the 10Mx768 corpus can't stage an f32 host copy here (30 GB);
+    # the config-4 SHAPE runs at 1M rows like the e2e row, and says so
+    guarded(run_closed_loop, "4cl", 100_000 if small else 1_000_000, 768,
+            dtype="int8")
+    guarded(run_config, "1_cosine_sift1m", 1_000_000, 128, "cosine",
+            "bf16")
+    guarded(run_config, "2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16")
+    guarded(run_e2e_single)
+    guarded(run_north_star_10m_int8)
+    guarded(run_config, "5_filtered_10pct", 1_000_000, 128, "cosine",
+            "bf16", filter_frac=0.10)
+    guarded(run_ivf_config)
+    guarded(run_sharded_fused)
 
 
 if __name__ == "__main__":

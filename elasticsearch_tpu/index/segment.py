@@ -235,6 +235,34 @@ class SegmentView:
     def live_count(self) -> int:
         return int(self.live.sum())
 
+    def live_postings(self, field: str
+                      ) -> Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]],
+                                 np.ndarray, int]:
+        """Tombstone-filtered postings of one field in dense live-slot
+        space: ({term: (live slots ascending, freqs)}, field lengths per
+        live slot, live count).
+
+        Live docs renumber 0..n_live-1 in ascending local order — the
+        columnar extraction the device lexical engine (`ops/bm25.py`)
+        ingests at refresh, owned here because the slot/tombstone layout
+        is this layer's contract (the vector twin is
+        `vectors/store.extract_field_rows`)."""
+        seg = self.segment
+        n_live = self.live_count
+        slot_of = np.cumsum(self.live) - 1  # local doc -> dense live slot
+        fl = seg.field_lengths.get(field)
+        lengths = np.zeros(n_live, dtype=np.float32)
+        if fl is not None and n_live:
+            lengths[:] = fl[self.live].astype(np.float32)
+        terms: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for term, p in seg.postings.get(field, {}).items():
+            keep = self.live[p.doc_ids]
+            ids = p.doc_ids[keep]
+            if len(ids):
+                terms[term] = (slot_of[ids].astype(np.int32),
+                               p.freqs[keep])
+        return terms, lengths, n_live
+
 
 _reader_gen = itertools.count(1)
 

@@ -256,6 +256,47 @@ class _MultiShardVectorStore:
         order = np.argsort(-scores, kind="stable")[:k]
         return rows[order], scores[order]
 
+    def search_many(self, field: str, requests, k: int,
+                    precision: str = "bf16", num_candidates=None) -> list:
+        """Batched kNN for the hybrid executor: the whole request batch
+        crosses to the device in ONE dispatch per shard (single-shard
+        indices — the common case — pay exactly one round-trip for N
+        queries). The mesh fast path stays per-query; it is already one
+        compiled program per search."""
+        shards = self.svc.shards
+        if len(shards) == 1:
+            shard = shards[0]
+            offset = shard.shard_id * SHARD_ROW_SPACE
+            out = shard.vector_store.search_many(
+                field, requests, k, precision=precision,
+                num_candidates=num_candidates)
+            self._phases = dict(getattr(
+                shard.vector_store, "last_knn_phases", None) or {})
+            return [(rows + offset, scores) for rows, scores in out]
+        per_shard = []
+        for shard in shards:
+            offset = shard.shard_id * SHARD_ROW_SPACE
+            reqs = []
+            for q, filter_rows in requests:
+                frows = None
+                if filter_rows is not None:
+                    frows = filter_rows[
+                        (filter_rows >= offset)
+                        & (filter_rows < offset + SHARD_ROW_SPACE)] - offset
+                reqs.append((q, frows))
+            out = shard.vector_store.search_many(
+                field, reqs, k, precision=precision,
+                num_candidates=num_candidates)
+            per_shard.append([(rows + offset, scores)
+                              for rows, scores in out])
+        merged = []
+        for qi in range(len(requests)):
+            rows = np.concatenate([ps[qi][0] for ps in per_shard])
+            scores = np.concatenate([ps[qi][1] for ps in per_shard])
+            order = np.argsort(-scores, kind="stable")[:k]
+            merged.append((rows[order], scores[order]))
+        return merged
+
     @property
     def last_knn_phases(self) -> dict:
         """Engine phase timings captured by this wrapper's most recent
@@ -315,6 +356,8 @@ class Node:
         # per-group search counters (SearchRequest `stats` tags ->
         # SearchStats groupStats)
         self._search_groups: Dict[str, int] = {}
+        # per-index fused hybrid executors (search/hybrid_plan.py)
+        self._hybrid: Dict[str, Any] = {}
         self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
                                          "bulk": 0, "delete": 0}
         # per-index get counts for indices-stats `get` section (GetStats)
@@ -891,6 +934,7 @@ class Node:
         rank_constant = int(rrf.get("rank_constant", 60))
         window = int(rrf.get("rank_window_size", rrf.get("window_size", 100)))
         size = int(body.get("size", 10))
+        frm = int(body.get("from", 0) or 0)
         body = self._rewrite_terms_lookup(body)
 
         sub_queries: List[dict] = []
@@ -902,7 +946,12 @@ class Node:
                 sub_queries.append(body["query"])
             if body.get("knn") is not None:
                 knn = body["knn"]
-                sub_queries.append({"knn": knn})
+                # a knn LIST is one ranked list per clause (matching the
+                # fused plan's leg expansion — hybrid_plan._sub_queries_of)
+                if isinstance(knn, list):
+                    sub_queries.extend({"knn": spec} for spec in knn)
+                else:
+                    sub_queries.append({"knn": knn})
         if len(sub_queries) < 2:
             raise IllegalArgumentError(
                 "[rrf] requires at least 2 ranked lists (sub_searches, or "
@@ -930,6 +979,14 @@ class Node:
                 ShardSearchResult, execute_fetch_phase, execute_query_phase)
 
             svc = services[0]
+            if not body.get("__rrf_two_phase__"):
+                # fused hybrid plan: whole queries coalesce through the
+                # bounded per-index batcher, legs score in one device
+                # dispatch each, RRF fuses vectorized. The inline
+                # two-phase path below stays as the parity oracle
+                # (tests/test_hybrid_plan.py proves byte-identical
+                # results) and the escape hatch.
+                return self._hybrid_executor(svc).submit(body)
             reader = svc.combined_reader()
             store = _MultiShardVectorStore(svc)
             breaker_bytes = reader.num_docs * 16
@@ -951,7 +1008,7 @@ class Node:
                             rank_constant + rank_pos + 1)
                 ordered = sorted(fused_rows.items(),
                                  key=lambda kv: (-kv[1], kv[0]))
-                top = ordered[:size]
+                top = ordered[frm:frm + size]
                 final = ShardSearchResult(
                     0, np.asarray([r for r, _ in top], dtype=np.int64),
                     np.asarray([s for _, s in top], dtype=np.float32),
@@ -984,7 +1041,7 @@ class Node:
                 hit_by_key.setdefault(key, hit)
         ordered = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
         hits = []
-        for key, score in ordered[:size]:
+        for key, score in ordered[frm:frm + size]:
             hit = dict(hit_by_key[key])
             hit["_score"] = score
             hit.pop("sort", None)
@@ -994,6 +1051,57 @@ class Node:
                 "hits": {"total": {"value": len(fused), "relation": "eq"},
                          "max_score": hits[0]["_score"] if hits else None,
                          "hits": hits}}
+
+    def _evict_stale_hybrid(self) -> None:
+        """Drop executors whose IndexService is no longer live (index
+        deleted or recreated): they pin the closed service's engines and
+        the lexical store's tile/device arrays, and their counters must
+        not keep flowing into _nodes/stats. Swept from every hybrid
+        entry point because deletion has several paths (REST, cascades,
+        ILM) and none of them knows about this cache."""
+        for name, ex in list(self._hybrid.items()):
+            if self.indices.indices.get(name) is not ex.svc:
+                del self._hybrid[name]
+
+    def _hybrid_executor(self, svc):
+        """Per-index fused hybrid serving path (plan cache + bounded
+        combining queue), created lazily; replaced when the index is
+        recreated under the same name."""
+        from elasticsearch_tpu.search.hybrid_plan import HybridExecutor
+        self._evict_stale_hybrid()
+        ex = self._hybrid.get(svc.name)
+        if ex is None or ex.svc is not svc:
+            s = self.settings
+            ex = HybridExecutor(
+                self, svc,
+                max_batch=int(s.get("search.hybrid.max_batch", 64)),
+                max_queue_depth=int(
+                    s.get("search.hybrid.max_queue_depth", 256)),
+                deadline_ms=float(
+                    s.get("search.hybrid.queue_deadline_ms", 10_000)))
+            self._hybrid[svc.name] = ex
+        return ex
+
+    def _hybrid_stats_section(self) -> dict:
+        """Fused-hybrid serving counters summed over local indices:
+        searches/batches through the plan executor, plan-cache hit rate,
+        admission-control shedding, and cumulative per-phase time."""
+        out = {"searches": 0, "batches": 0, "plan_cache_hits": 0,
+               "plan_cache_misses": 0, "plan_nanos": 0, "score_nanos": 0,
+               "fuse_nanos": 0, "hydrate_nanos": 0, "rejected_depth": 0,
+               "shed_deadline": 0, "max_queue_depth_seen": 0}
+        self._evict_stale_hybrid()
+        for ex in self._hybrid.values():
+            for key in ("searches", "batches", "plan_cache_hits",
+                        "plan_cache_misses", "plan_nanos", "score_nanos",
+                        "fuse_nanos", "hydrate_nanos"):
+                out[key] += ex.stats.get(key, 0)
+            bs = ex.batcher.stats
+            out["rejected_depth"] += bs.get("rejected_depth", 0)
+            out["shed_deadline"] += bs.get("shed_deadline", 0)
+            out["max_queue_depth_seen"] = max(
+                out["max_queue_depth_seen"], bs.get("max_depth_seen", 0))
+        return out
 
     def _run_query_phase(self, svc, reader, store, body, use_partial_aggs,
                          frozen):
@@ -2081,7 +2189,8 @@ class Node:
                 "hit_count": self.caches.query.hits,
                 "miss_count": self.caches.query.misses,
                 "evictions": self.caches.query.evictions},
-            "knn": self._knn_stats_section()}
+            "knn": self._knn_stats_section(),
+            "hybrid": self._hybrid_stats_section()}
         discovery_section = {
             "cluster_state_queue": {"total": 0, "pending": 0,
                                     "committed": 0},

@@ -22,6 +22,30 @@ def _describe_query(body: dict) -> tuple:
     return kind, json.dumps(q.get(kind), default=str)[:200]
 
 
+def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
+                   fuse_nanos: int, hydrate_nanos: int, plan_cache_hit: bool,
+                   batch_size: int, legs: list) -> dict:
+    """`profile` section for a fused hybrid (rank.rrf) search
+    (search/hybrid_plan.py): the four plan phases — plan (parse/compile or
+    cache hit), score (the batched leg dispatches), fuse (vectorized RRF),
+    hydrate (fetch of the final window) — plus per-leg engine detail.
+
+    score/fuse/hydrate are BATCH times: whole hybrid queries coalesce
+    through the serving batcher, so the device work the timing describes
+    was shared by `batch_size` queries (the per-query marginal cost is
+    time/batch_size; reporting the honest batch figure keeps the profile
+    additive with wall clock)."""
+    return {"hybrid": {
+        "id": f"[{index_name}][0]",
+        "plan_cache": "hit" if plan_cache_hit else "miss",
+        "batch_size": batch_size,
+        "breakdown": {"plan_nanos": plan_nanos,
+                      "score_nanos": score_nanos,
+                      "fuse_nanos": fuse_nanos,
+                      "hydrate_nanos": hydrate_nanos},
+        "legs": legs}}
+
+
 def shard_profile(index_name: str, body: dict, query_nanos: int,
                   fetch_nanos: int, total_hits: int,
                   knn_phases: Optional[dict] = None) -> dict:

@@ -1,5 +1,7 @@
 """Query serving layer: micro-batching dispatch + host/device cost routing."""
 
-from elasticsearch_tpu.serving.batcher import CombiningBatcher, CostModel
+from elasticsearch_tpu.serving.batcher import (
+    BoundedBatcher, CombiningBatcher, CostModel,
+)
 
-__all__ = ["CombiningBatcher", "CostModel"]
+__all__ = ["BoundedBatcher", "CombiningBatcher", "CostModel"]

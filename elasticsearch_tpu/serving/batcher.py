@@ -23,8 +23,11 @@ fixes live here:
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
+
+from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
 
 _overhead_lock = threading.Lock()
 _overhead_ms: Optional[float] = None
@@ -133,19 +136,31 @@ class CombiningBatcher:
         with self._q_lock:
             return len(self._queue)
 
-    def submit(self, request):
-        fut: Future = Future()
+    def _enqueue(self, request, fut: Future) -> None:
+        """Admission hook: subclasses may refuse (raise) instead of
+        queueing without bound."""
         with self._q_lock:
             self._queue.append((request, fut))
+
+    def _drain(self) -> List:
+        """Take the next batch off the queue (under the run lock).
+        Subclasses may shed entries here (deadline-expired requests get
+        their exception set and are excluded from the batch)."""
+        with self._q_lock:
+            batch = self._queue[: self._max_batch]
+            del self._queue[: self._max_batch]
+        return batch
+
+    def submit(self, request):
+        fut: Future = Future()
+        self._enqueue(request, fut)
         while not fut.done():
             # block until the current runner finishes, then take over if our
             # request still isn't served
             with self._run_lock:
                 if fut.done():
                     break
-                with self._q_lock:
-                    batch = self._queue[: self._max_batch]
-                    del self._queue[: self._max_batch]
+                batch = self._drain()
                 if not batch:
                     continue
                 try:
@@ -178,3 +193,68 @@ class CombiningBatcher:
                             f.set_exception(exc)
                     raise
         return fut.result()
+
+
+class BoundedBatcher(CombiningBatcher):
+    """CombiningBatcher + admission control: the p99-tail fix.
+
+    The r03 record's 1.1–2.5 s p99 tails (15–30× p50) came from exactly
+    this queue growing without bound under closed-loop overload — every
+    request eventually served, each behind an ever-longer convoy. A
+    production serving path sheds instead (the reference's
+    EsRejectedExecutionHandler / `ThreadPool.java:129` bounded queues →
+    HTTP 429):
+
+    * depth limit — a submit that finds `max_queue_depth` requests already
+      waiting is rejected immediately with `EsRejectedExecutionError`
+      (HTTP 429 through the existing error mapping); the client retries
+      against a queue that can still absorb it.
+    * deadline — a request that waited longer than `deadline_ms` before
+      its batch started is dead on arrival (the caller has usually timed
+      out); the runner sheds it at drain time rather than spending device
+      time on an answer nobody reads.
+
+    `stats` counts shed requests and tracks the high-water queue depth so
+    saturation tests can assert the bound actually held.
+    """
+
+    def __init__(self, execute: Callable[[Sequence], List],
+                 max_batch: int = 256, max_queue_depth: int = 256,
+                 deadline_ms: Optional[float] = None):
+        super().__init__(execute, max_batch=max_batch)
+        self.max_queue_depth = max_queue_depth
+        self.deadline_ms = deadline_ms
+        self.stats = {"accepted": 0, "rejected_depth": 0,
+                      "shed_deadline": 0, "max_depth_seen": 0}
+
+    def _enqueue(self, request, fut: Future) -> None:
+        with self._q_lock:
+            depth = len(self._queue)
+            if depth >= self.max_queue_depth:
+                self.stats["rejected_depth"] += 1
+                raise EsRejectedExecutionError(
+                    f"rejected execution: hybrid search queue is full "
+                    f"[{depth} >= {self.max_queue_depth}] (queue capacity "
+                    f"{self.max_queue_depth})")
+            self.stats["accepted"] += 1
+            if depth + 1 > self.stats["max_depth_seen"]:
+                self.stats["max_depth_seen"] = depth + 1
+            self._queue.append(((request, time.monotonic()), fut))
+
+    def _drain(self) -> List:
+        batch = super()._drain()
+        if self.deadline_ms is None:
+            return [((req), fut) for (req, _t0), fut in batch]
+        now = time.monotonic()
+        kept = []
+        for (req, t0), fut in batch:
+            if (now - t0) * 1000.0 > self.deadline_ms:
+                self.stats["shed_deadline"] += 1
+                if not fut.done():
+                    fut.set_exception(EsRejectedExecutionError(
+                        f"rejected execution: request spent "
+                        f"{(now - t0) * 1000.0:.0f}ms queued, over the "
+                        f"{self.deadline_ms:.0f}ms admission deadline"))
+                continue
+            kept.append((req, fut))
+        return kept

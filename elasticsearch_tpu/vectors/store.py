@@ -268,6 +268,23 @@ class VectorStoreShard:
         return batcher.submit(
             (np.asarray(query_vector, dtype=np.float32), filter_rows))
 
+    def search_many(self, field: str, requests, k: int,
+                    precision: str = "bf16",
+                    num_candidates: Optional[int] = None) -> list:
+        """Score a whole batch of (query_vector, filter_rows) requests in
+        ONE dispatch — the hybrid plan's kNN leg. Where `search` relies on
+        concurrent callers colliding in the combining batcher, this entry
+        is for a caller that already holds a batch (the hybrid executor's
+        runner thread) and wants exactly one device/host round-trip."""
+        fc = self._fields.get(field)
+        if fc is None or fc.corpus is None or len(fc.row_map) == 0:
+            return [(np.zeros(0, dtype=np.int64),
+                     np.zeros(0, dtype=np.float32)) for _ in requests]
+        reqs = [(np.asarray(q, dtype=np.float32), fr)
+                for q, fr in requests]
+        return self._execute_batch(fc, k, precision, reqs,
+                                   num_candidates=num_candidates)
+
     def _execute_batch(self, fc: FieldCorpus, k: int, precision: str,
                        requests, num_candidates: Optional[int] = None
                        ) -> list:

@@ -116,9 +116,18 @@ def register_remote_handlers(transport, node) -> None:
         return handler
 
     def info(sender, request, respond):
+        # report the seed node AND every cluster peer whose transport
+        # address this node learned from published cluster state — the
+        # sniff strategy pools them as gateways, so a remote alias
+        # survives the death of the node it first connected through
+        # (SniffConnectionStrategy: ask the seed for the cluster's nodes)
         host, port = transport.bound_address
+        nodes = {nid: [host, port]}
+        for peer, (phost, pport) in dict(
+                getattr(transport, "_addresses", {})).items():
+            nodes.setdefault(peer, [phost, pport])
         respond({"cluster_name": getattr(node, "cluster_name", "cluster"),
-                 "nodes": {nid: [host, port]}})
+                 "nodes": nodes})
 
     def search(request):
         return {"response": node.search(request.get("expr"),

@@ -125,6 +125,104 @@ def test_child_json_line_is_forwarded(monkeypatch):
     assert out["value"] == 99.0
 
 
+def _load_daemon():
+    spec = importlib.util.spec_from_file_location(
+        "bench_daemon", REPO / "bench_daemon.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_daemon_acquires_then_captures_labeled_tpu_rows(
+        monkeypatch, tmp_path):
+    """Probe flaps twice then succeeds: the daemon must keep polling and
+    write the matrix the moment acquisition succeeds, every row labeled
+    with its backend."""
+    daemon = _load_daemon()
+    attempts = iter([(False, "UNAVAILABLE"), (False, "probe hung"),
+                     (True, "tpu v5e")])
+    platform, errors = daemon.acquire_backend(
+        max_wait_s=3600, probe=lambda timeout_s: next(attempts),
+        sleep=lambda s: None)
+    assert platform == "tpu v5e"
+    assert len(errors) == 2
+
+    rows = [{"config": "1_cosine_sift1m", "qps": 100.0},
+            {"config": "3_hybrid_bm25_knn_rrf", "qps": 700.0}]
+    monkeypatch.setattr(daemon, "run_matrix",
+                        lambda extra_env, timeout_s: list(rows))
+    out = tmp_path / "BENCH_MATRIX_r99.json"
+    monkeypatch.setattr(daemon, "acquire_backend",
+                        lambda *a, **k: ("tpu v5e", []))
+    rc = daemon.main(["--round", "99", "--once", "--out", str(out)])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert lines[0]["_meta"]["backend"] == "tpu"
+    assert all(r["backend"] == "tpu" for r in lines[1:])
+    assert {r["config"] for r in lines[1:]} \
+        == {"1_cosine_sift1m", "3_hybrid_bm25_knn_rrf"}
+
+
+def test_daemon_dark_tunnel_emits_labeled_cpu_rows(monkeypatch, tmp_path):
+    """No backend all round → the same configs land as clearly-labeled
+    backend: cpu rows (never evidence-free, never mistakable for device
+    numbers)."""
+    daemon = _load_daemon()
+    seen_env = {}
+
+    def fake_run_matrix(extra_env, timeout_s):
+        seen_env.update(extra_env)
+        return [{"config": "3_hybrid_bm25_knn_rrf", "qps": 42.0,
+                 "gate_500qps": False}]
+
+    monkeypatch.setattr(daemon, "run_matrix", fake_run_matrix)
+    monkeypatch.setattr(daemon, "acquire_backend",
+                        lambda *a, **k: (None, ["attempt 1: UNAVAILABLE"]))
+    out = tmp_path / "BENCH_MATRIX_r98.json"
+    rc = daemon.main(["--round", "98", "--once", "--out", str(out)])
+    assert rc == 0
+    assert seen_env == {"JAX_PLATFORMS": "cpu", "BENCH_SMALL": "1"}
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert lines[0]["_meta"]["backend"] == "cpu"
+    assert lines[0]["_meta"]["probe_errors"]
+    assert lines[1]["backend"] == "cpu"
+    assert "NOT a device number" in lines[1]["backend_note"]
+
+
+def test_daemon_acquire_deadline_returns_none():
+    daemon = _load_daemon()
+    clock = {"t": 0.0}
+
+    def sleep(s):
+        clock["t"] += s
+
+    platform, errors = daemon.acquire_backend(
+        max_wait_s=0, probe=lambda timeout_s: (False, "dark"),
+        sleep=sleep)
+    assert platform is None
+    assert errors
+
+
+def test_daemon_keeps_partial_rows_on_matrix_hang(monkeypatch, tmp_path):
+    """A hang after config N must still record configs 1..N (rows flush
+    as they complete; the watchdog kills the child, not the evidence)."""
+    daemon = _load_daemon()
+
+    class FakeTimeout(Exception):
+        pass
+
+    import subprocess as sp
+
+    def fake_run(*a, **k):
+        e = sp.TimeoutExpired(cmd="bench_matrix", timeout=1)
+        e.stdout = b'{"config": "1_cosine_sift1m", "qps": 5.0}\nhang'
+        raise e
+
+    monkeypatch.setattr(daemon.subprocess, "run", fake_run)
+    rows = daemon.run_matrix({}, timeout_s=1)
+    assert rows == [{"config": "1_cosine_sift1m", "qps": 5.0}]
+
+
 class _capture_stdout:
     def __enter__(self):
         import io

@@ -1,0 +1,263 @@
+"""CCS failure-mode depth (VERDICT r5 Next #9), alongside
+tests/test_remote_cluster_wire.py:
+
+- gateway-node failover WITHIN an alias: the remote cluster has two
+  nodes, the local WireRemote sniffs both as gateways; killing one node
+  mid-alias must not break the alias — the next RPC fails over to the
+  surviving gateway (SniffConnectionStrategy round-robin + one re-sniff,
+  `xpack/remote_cluster.py:_call_async`).
+- mid-stream remote disconnect during a long CCS search: the remote dies
+  while a search is in flight; with skip_unavailable=true the caller gets
+  a degraded (skipped) response or a typed error within the RPC timeout —
+  never a hang, never an unhandled socket error.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_up(port, deadline_s=90):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            _req("GET", f"http://127.0.0.1:{port}/")
+            return
+        except Exception:
+            time.sleep(0.5)
+    raise AssertionError(f"server on {port} never came up")
+
+
+N_EAST = 3  # quorum survives one node death (a 2-node remote would not)
+
+
+@pytest.fixture(scope="module")
+def clusters(tmp_path_factory):
+    """local (1 node) + east (3 nodes, all transport-bound gateways)."""
+    tmp = tmp_path_factory.mktemp("ccs_failover")
+    http_ports = _free_ports(1 + N_EAST)
+    tp_ports = _free_ports(1 + N_EAST)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    east_seeds = ",".join(f"127.0.0.1:{p}" for p in tp_ports[1:])
+    procs = []
+    # local single node
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu.server",
+         "--port", str(http_ports[0]), "--name", "local-0",
+         "--cluster-name", "local", "--data", str(tmp / "local"),
+         "-E", f"transport.port={tp_ports[0]}"],
+        cwd=REPO, env=env,
+        stdout=open(tmp / "local.log", "w"), stderr=subprocess.STDOUT))
+    # 3-node east cluster
+    masters = ",".join(f"east-{i}" for i in range(N_EAST))
+    for i in range(N_EAST):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "elasticsearch_tpu.server",
+             "--port", str(http_ports[1 + i]), "--name", f"east-{i}",
+             "--cluster-name", "east", "--data", str(tmp / f"east{i}"),
+             "-E", f"transport.port={tp_ports[1 + i]}",
+             "-E", f"discovery.seed_hosts={east_seeds}",
+             "-E", f"cluster.initial_master_nodes={masters}"],
+            cwd=REPO, env=env,
+            stdout=open(tmp / f"east{i}.log", "w"),
+            stderr=subprocess.STDOUT))
+    for p in http_ports:
+        _wait_up(p)
+    local = f"http://127.0.0.1:{http_ports[0]}"
+    east = f"http://127.0.0.1:{http_ports[1]}"
+    # wait for east to form its full cluster so every node is sniffable
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            h = _req("GET", f"{east}/_cluster/health")
+            if h.get("number_of_nodes") == N_EAST:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    else:
+        raise AssertionError("east cluster never formed")
+    yield local, east, http_ports, tp_ports, procs, tmp
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_gateway_failover_within_alias(clusters):
+    local, east, http_ports, tp_ports, procs, tmp = clusters
+
+    # seed docs on east (through node 0; replication makes them visible
+    # cluster-wide)
+    for i in range(20):
+        _req("PUT", f"{east}/logs/_doc/e{i}",
+             {"msg": f"east doc {i}", "n": i})
+    _req("POST", f"{east}/logs/_refresh")
+
+    # register the alias with every east transport as a seed
+    _req("PUT", f"{local}/_cluster/settings", {"persistent": {
+        "cluster.remote.east.seeds":
+            [f"127.0.0.1:{p}" for p in tp_ports[1:]],
+        "cluster.remote.east.skip_unavailable": "false"}})
+
+    r = _req("POST", f"{local}/east:logs/_search",
+             {"query": {"match": {"msg": "east"}}, "size": 5})
+    assert r["hits"]["total"]["value"] == 20
+
+    info = _req("GET", f"{local}/_remote/info")
+    assert info["east"]["connected"] is True
+    # the sniff pooled the CLUSTER's gateways, not just the seed it
+    # happened to dial (MAX_GATEWAY_NODES caps at 3)
+    assert info["east"]["num_nodes_connected"] >= 2
+
+    # kill the gateway holding NO copy of `logs` (1 shard + 1 replica on
+    # 3 nodes leaves exactly one data-free node): the alias must keep
+    # serving through the survivors while its round-robin keeps landing
+    # on the dead gateway. (Killing a copy-holding node entangles this
+    # test with replica promotion — a separate subsystem with a known
+    # empty-store promotion bug, tracked in ROADMAP.md open items.)
+    state = _req("GET", f"{east}/_cluster/state")
+    holders = {r["node"] for r in state["routing"]
+               if r["index"] == "logs"}
+    victim = next(i for i in range(N_EAST)
+                  if f"east-{i}" not in holders)
+    procs[1 + victim].send_signal(signal.SIGKILL)
+    procs[1 + victim].wait(timeout=10)
+    # converged = a full rotation of the surviving gateways answers with
+    # the complete result set (mid-recovery a survivor can briefly serve
+    # partial results while the replica promotes)
+    deadline = time.monotonic() + 120
+    streak = 0
+    while time.monotonic() < deadline and streak < 4:
+        try:
+            r = _req("POST", f"{local}/east:logs/_search",
+                     {"query": {"match": {"msg": "east"}}, "size": 5},
+                     timeout=60)
+        except urllib.error.HTTPError:
+            streak = 0     # dead-gateway RPC surfaced typed; round-robin
+            time.sleep(1)  # + re-sniff finds the survivors next call
+            continue
+        if r["hits"]["total"]["value"] == 20:
+            streak += 1
+        else:
+            streak = 0
+            time.sleep(1)
+    assert streak >= 4, "alias never failed over to surviving gateways"
+
+    info = _req("GET", f"{local}/_remote/info")
+    assert info["east"]["connected"] is True
+
+
+def test_midstream_disconnect_degrades_not_hangs(clusters):
+    """Kill the whole remote while a long CCS search is in flight: with
+    skip_unavailable=true every in-flight and subsequent search must
+    complete (degraded) or fail typed — bounded by the RPC timeout, no
+    hang, and the local side stays healthy."""
+    local, east, http_ports, tp_ports, procs, tmp = clusters
+
+    # local data so the degraded responses still carry hits
+    for i in range(5):
+        _req("PUT", f"{local}/logs/_doc/l{i}", {"msg": f"local doc {i}"})
+    _req("POST", f"{local}/logs/_refresh")
+
+    # make the remote leg slow enough to reliably catch mid-stream: a
+    # painless script_score over east's docs
+    slow_body = {
+        "query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source":
+                       "double s = 0; for (int i = 0; i < 2000; ++i) "
+                       "{ s += i * 0.5; } s"}}},
+        "size": 5}
+    _req("PUT", f"{local}/_cluster/settings", {"persistent": {
+        "cluster.remote.east.skip_unavailable": "true"}})
+
+    results = []
+    errors = []
+
+    def searcher():
+        t0 = time.monotonic()
+        try:
+            r = _req("POST", f"{local}/logs,east:logs/_search",
+                     dict(slow_body), timeout=90)
+            results.append((time.monotonic() - t0, r))
+        except urllib.error.HTTPError as e:
+            errors.append((time.monotonic() - t0, e.code))
+        except Exception as e:  # noqa: BLE001 — the test asserts on type
+            errors.append((time.monotonic() - t0, type(e).__name__))
+
+    threads = [threading.Thread(target=searcher) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # let the searches reach the remote leg
+    # kill the surviving east node mid-flight (east-0 died in the
+    # failover test when run as a module; kill whichever still runs)
+    for p in procs[1:]:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+    for p in procs[1:]:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+    for t in threads:
+        t.join(120)
+        assert not t.is_alive(), "CCS search hung after remote death"
+
+    # every search resolved; none waited unboundedly (RPC timeout is 30s)
+    assert len(results) + len(errors) == 4
+    for elapsed, _ in results + errors:
+        assert elapsed < 90
+    # degraded responses (if the kill landed before/during the remote
+    # call) carry the local hits and mark the remote skipped/failed
+    for _, r in results:
+        assert r["hits"] is not None
+        if r.get("_clusters"):
+            assert r["_clusters"]["successful"] >= 1
+
+    # the alias reports disconnected afterwards, local cluster healthy
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        info = _req("GET", f"{local}/_remote/info")
+        if info["east"]["connected"] is False:
+            break
+        time.sleep(1)
+    r = _req("POST", f"{local}/logs,east:logs/_search",
+             {"query": {"match": {"msg": "local"}}}, timeout=60)
+    assert r["hits"]["total"]["value"] == 5
+    assert r["_clusters"]["skipped"] == 1

@@ -1,0 +1,193 @@
+"""Device-resident BM25 lexical engine (ops/bm25.py).
+
+The engine's contract is strict: precomputed tile-padded impacts scored
+through the batched device kernel (or its numpy host twin) must return
+BYTE-IDENTICAL rows and scores to the live host path
+(`search/queries.py` MatchQuery → bm25_scores → native.topk) — that
+exactness is what lets the fused hybrid plan replace the two-phase
+execution without a behavioural flag day.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import native
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.ops.bm25 import TILE, LexicalField, LexicalShard
+from elasticsearch_tpu.search.queries import MatchQuery, SearchContext
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    ms = MapperService({"properties": {"body": {"type": "text"}}})
+    eng = Engine(tempfile.mkdtemp(), ms)
+    rng = np.random.default_rng(42)
+    vocab = [f"tok{i}" for i in range(80)]
+    for i in range(400):
+        words = " ".join(rng.choice(vocab, size=rng.integers(2, 14)))
+        eng.index(str(i), {"body": words})
+    eng.refresh()
+    return ms, eng, rng
+
+
+def _reference(reader, ms, text, operator="or", window=100):
+    """The live host path the engine must reproduce bit-for-bit."""
+    ctx = SearchContext(reader, ms)
+    ds = MatchQuery("body", text, operator=operator).execute(ctx) \
+        .with_scores()
+    idx = native.topk(ds.scores, min(window, len(ds.rows)))
+    return ds.rows[idx], ds.scores[idx]
+
+
+class TestParity:
+    @pytest.mark.parametrize("route", ["host", "device"])
+    def test_byte_identical_to_match_query(self, corpus, route):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lex = LexicalShard()
+        for text in ("tok1 tok2", "tok5", "tok10 tok11 tok12 tok13"):
+            ref_rows, ref_scores = _reference(reader, ms, text)
+            (rows, scores), = lex.search_batch(
+                reader, "body", [(text.split(), 1.0)], 100, route=route)
+            assert np.array_equal(rows, ref_rows)
+            # byte-identical, not approx: same impacts, same fold order
+            assert scores.tobytes() == ref_scores.tobytes()
+
+    @pytest.mark.parametrize("route", ["host", "device"])
+    def test_operator_and_and_msm(self, corpus, route):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lex = LexicalShard()
+        terms = ["tok1", "tok2", "tok3"]
+        ref_rows, ref_scores = _reference(reader, ms, " ".join(terms),
+                                          operator="and")
+        (rows, scores), = lex.search_batch(
+            reader, "body", [(terms, 1.0)], 100,
+            required=[len(terms)], route=route)
+        assert np.array_equal(rows, ref_rows)
+        assert scores.tobytes() == ref_scores.tobytes()
+
+    def test_batch_matches_single_dispatch(self, corpus):
+        """One batched device dispatch == N single dispatches: the scatter
+        board is per-query, so coalescing must not change results."""
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lex = LexicalShard()
+        queries = [(["tok1", "tok2"], 1.0), (["tok7"], 1.0),
+                   (["tok3", "tok4", "tok5"], 1.0)]
+        batched = lex.search_batch(reader, "body", queries, 50,
+                                   route="device")
+        for q, (rows, scores) in zip(queries, batched):
+            (r1, s1), = lex.search_batch(reader, "body", [q], 50,
+                                         route="device")
+            assert np.array_equal(rows, r1)
+            assert scores.tobytes() == s1.tobytes()
+
+    def test_oov_terms_count_toward_required(self, corpus):
+        """operator=and with an out-of-vocabulary term matches nothing —
+        the host path's empty-clause semantics."""
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lex = LexicalShard()
+        (rows, _), = lex.search_batch(
+            reader, "body", [(["tok1", "zzz_never_indexed"], 1.0)], 100,
+            required=[2], route="host")
+        assert len(rows) == 0
+
+    def test_window_cuts_ranked_prefix(self, corpus):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lex = LexicalShard()
+        (full, fs), = lex.search_batch(reader, "body",
+                                       [(["tok1", "tok2"], 1.0)], 1000)
+        (cut, cs), = lex.search_batch(reader, "body",
+                                      [(["tok1", "tok2"], 1.0)], 10)
+        assert np.array_equal(cut, full[:10])
+        assert cs.tobytes() == fs[:10].tobytes()
+
+
+class TestRefresh:
+    def test_append_only_refresh_and_delete_rebuild(self):
+        ms = MapperService({"properties": {"body": {"type": "text"}}})
+        eng = Engine(tempfile.mkdtemp(), ms)
+        for i in range(50):
+            eng.index(str(i), {"body": f"alpha tok{i % 7}"})
+        eng.refresh()
+        lex = LexicalShard()
+        reader = eng.acquire_searcher()
+        lex.search_batch(reader, "body", [(["alpha"], 1.0)], 100)
+        assert lex.stats["rebuilds"] == 1
+
+        # same reader: no rebuild
+        lex.search_batch(reader, "body", [(["alpha"], 1.0)], 100)
+        assert lex.stats["rebuilds"] == 1
+
+        # appended segment: rebuild picks up new docs + fresh global stats
+        for i in range(50, 80):
+            eng.index(str(i), {"body": f"alpha beta tok{i % 7}"})
+        eng.refresh()
+        reader2 = eng.acquire_searcher()
+        ref_rows, ref_scores = _reference(reader2, ms, "alpha", window=100)
+        (rows, scores), = lex.search_batch(reader2, "body",
+                                           [(["alpha"], 1.0)], 100)
+        assert lex.stats["rebuilds"] == 2
+        assert np.array_equal(rows, ref_rows)
+        assert scores.tobytes() == ref_scores.tobytes()
+
+        # delete: tombstoned doc disappears and scores re-match live stats
+        eng.delete("3")
+        eng.refresh()
+        reader3 = eng.acquire_searcher()
+        ref_rows, ref_scores = _reference(reader3, ms, "alpha", window=100)
+        (rows, scores), = lex.search_batch(reader3, "body",
+                                           [(["alpha"], 1.0)], 100)
+        assert np.array_equal(rows, ref_rows)
+        assert scores.tobytes() == ref_scores.tobytes()
+        assert not any(reader3.get_id(int(r)) == "3" for r in rows)
+
+
+class TestLayout:
+    def test_tiles_are_lane_padded(self, corpus):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lf = LexicalField("body")
+        lf.sync(reader)
+        assert lf.tile_slots.shape[1] == TILE
+        assert lf.tile_impacts.shape == lf.tile_slots.shape
+        # padding is -1 slots with zero impact
+        pad = lf.tile_slots < 0
+        assert np.all(lf.tile_impacts[pad] == 0.0)
+        # every real slot is in range and the row map is ascending
+        real = lf.tile_slots[~pad]
+        assert real.min() >= 0 and real.max() < lf.n_slots
+        assert np.all(np.diff(lf.row_map) > 0)
+
+    def test_quantized_bf16_preserves_ranking(self, corpus):
+        """bf16 impacts trade exactness for HBM; ranking of well-separated
+        scores must hold (the parity contract applies to f32 only)."""
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        exact = LexicalShard(dtype="f32")
+        quant = LexicalShard(dtype="bf16")
+        (er, _), = exact.search_batch(reader, "body",
+                                      [(["tok1", "tok2"], 1.0)], 10,
+                                      route="device")
+        (qr, _), = quant.search_batch(reader, "body",
+                                      [(["tok1", "tok2"], 1.0)], 10,
+                                      route="device")
+        assert len(set(er.tolist()) & set(qr.tolist())) >= 8
+
+    def test_int8_tile_scales_bound_error(self, corpus):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        lf = LexicalField("body", dtype="int8")
+        lf.sync(reader)
+        slots, impacts, scales = lf._device_arrays()
+        deq = np.asarray(impacts, dtype=np.float32) \
+            * np.asarray(scales)[:, None]
+        err = np.abs(deq - lf.tile_impacts)
+        # symmetric per-tile int8: error bounded by scale/2 per entry
+        assert np.all(err <= np.asarray(scales)[:, None] * 0.5 + 1e-7)
