@@ -6,7 +6,10 @@ as separate dispatches so the per-phase wall times the profiler and
 `_nodes/stats` report (route / score / merge) are measured, not modeled.
 
 nprobe selection:
-  * an integer setting is used as-is (clamped to nlist);
+  * an integer setting is clamped to nlist and snapped up to the
+    dispatch grid's pow-2 ladder (nprobe is a compiled-shape parameter;
+    see ops/dispatch.py — snapping up never probes fewer partitions
+    than configured);
   * `"auto"` tunes once per layout generation: a held-out sample of the
     indexed vectors becomes the query set, the engine's own full-probe
     (nprobe = nlist) result the ground truth, and nprobe doubles until
@@ -54,7 +57,14 @@ class IVFRouter:
 
     def effective_nprobe(self, k: int) -> int:
         if self.nprobe_setting != "auto":
-            return max(1, min(int(self.nprobe_setting), self.index.nlist))
+            n = max(1, min(int(self.nprobe_setting), self.index.nlist))
+            if n != self.index.nlist and n & (n - 1):
+                # nprobe is a static arg of the dispatched kernels and
+                # the closed grid only admits pow-2 rungs (or full
+                # nlist): snap an off-ladder setting UP — never fewer
+                # probes than configured, recall only improves
+                n = min(1 << (n - 1).bit_length(), self.index.nlist)
+            return n
         if self._tuned_nprobe is None:
             self._tuned_nprobe = self.tune_nprobe(k=max(k, 10))
         return self._tuned_nprobe
@@ -166,7 +176,16 @@ class IVFRouter:
             nprobe = self.effective_nprobe(k)
         if num_candidates is not None and num_candidates > 0:
             want = -(-int(num_candidates) // max(self.index.cap, 1))
-            nprobe = max(nprobe, want)
+            if want > nprobe:
+                # num_candidates is a PER-REQUEST knob and nprobe is a
+                # static arg of the routed kernels (a distinct value is a
+                # fresh compiled shape): snap the widening to the next
+                # pow-2 rung, clamped to nlist, so a client sweeping
+                # num_candidates stays inside the closed dispatch grid.
+                # Probing more partitions than asked only helps recall —
+                # "at least num_candidates rows" still holds.
+                nprobe = min(1 << (want - 1).bit_length(),
+                             self.index.nlist)
         scores, rows, phases = self._device_search(
             np.asarray(queries, dtype=np.float32), k, nprobe)
         self.last_phases = phases

@@ -623,6 +623,13 @@ class ClusterNode:
                 return
             for op in response["ops"]:
                 self._apply_replica_op(local, op)
+            # make the replayed history searchable BEFORE reporting started:
+            # without this, a post-failover copy serves 0 docs until the next
+            # user-triggered refresh broadcast — in a read-mostly workload,
+            # forever (the ROADMAP "green but empty copy" data-loss repro;
+            # reference: IndexShard#finalizeRecovery refreshes before the
+            # shard moves to POST_RECOVERY)
+            local.engine.refresh()
             self._send_to_master(MASTER_SHARD_STARTED,
                                  {"allocation_id": entry.allocation_id})
 

@@ -415,6 +415,28 @@ class Node:
         self.plugins.load_all()
         self.plugins.apply_extensions()
         self.plugins.start_node(self)
+        # shape-bucketed kernel dispatch (ops/dispatch.py): wire JAX's
+        # persistent compilation cache so a node restart re-loads compiled
+        # executables from disk instead of re-paying XLA compiles
+        # (settings: search.dispatch.persistent_cache_dir, default
+        # <data>/_state/xla_cache when search.dispatch.persistent_cache
+        # is truthy; search.dispatch.warmup overrides the warmup policy)
+        from elasticsearch_tpu.common.settings import setting_bool
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        cache_dir = self.settings.get("search.dispatch.persistent_cache_dir")
+        if not cache_dir and setting_bool(
+                self.settings.get("search.dispatch.persistent_cache")):
+            cache_dir = _os.path.join(data_path, "_state", "xla_cache")
+        if cache_dir:
+            _dispatch.configure_persistent_cache(str(cache_dir))
+        warm = self.settings.get("search.dispatch.warmup")
+        self._dispatch_warmup = setting_bool(warm) if warm is not None \
+            else None
+        if self._dispatch_warmup is not None:
+            # the dispatcher (and its warmup policy) is process-wide; a
+            # node with no explicit setting must not clobber a policy an
+            # earlier in-process node configured
+            _dispatch.set_default_warmup(self._dispatch_warmup)
         # set by the server bootstrap after native hardening runs; embedded
         # nodes have no hardening (reference: JNANatives.LOCAL_MLOCKALL)
         self.natives = None
@@ -1267,6 +1289,12 @@ class Node:
                         skipped_shards += svc.num_shards
                         continue
                 q_start = time.perf_counter_ns()
+                if profile_enabled:
+                    # per-shard dispatch trace: which shape bucket every
+                    # device kernel hit and what compiling cost (empty in
+                    # steady state; `profile.dispatch` renders it)
+                    from elasticsearch_tpu.ops import dispatch as _dispatch
+                    _dispatch.DISPATCH.record_events(True)
                 # shard request cache: size=0 (aggs/count) responses keyed on
                 # the reader generation — a refresh invalidates implicitly
                 from elasticsearch_tpu.search.caches import RequestCache
@@ -1364,13 +1392,22 @@ class Node:
                         merged_aggs = merge_partial_aggs(
                             merged_aggs, result.aggregations, aggs_spec)
                 if profile_enabled:
+                    from elasticsearch_tpu.ops import dispatch as _dispatch
                     from elasticsearch_tpu.search.profile import shard_profile
+                    events = _dispatch.DISPATCH.drain_events()
+                    _dispatch.DISPATCH.record_events(False)
                     profile_shards.append(shard_profile(
                         svc.name, body, q_nanos, f_nanos,
                         result.total_hits,
-                        knn_phases=result.knn_phases))
+                        knn_phases=result.knn_phases,
+                        dispatch_events=events))
         finally:
             self.breakers.release("request", breaker_bytes)
+            if profile_enabled:
+                # a query-phase error must not leave the thread-local
+                # dispatch trace recording into later requests
+                from elasticsearch_tpu.ops import dispatch as _dispatch
+                _dispatch.DISPATCH.record_events(False)
         n_shards_total = sum(s.num_shards for s, _, _ in readers)
         if shard_failures and n_shards_total \
                 and len(shard_failures) >= n_shards_total - skipped_shards:
@@ -2190,7 +2227,8 @@ class Node:
                 "miss_count": self.caches.query.misses,
                 "evictions": self.caches.query.evictions},
             "knn": self._knn_stats_section(),
-            "hybrid": self._hybrid_stats_section()}
+            "hybrid": self._hybrid_stats_section(),
+            "dispatch": self._dispatch_stats_section()}
         discovery_section = {
             "cluster_state_queue": {"total": 0, "pending": 0,
                                     "committed": 0},
@@ -2213,6 +2251,17 @@ class Node:
                 "discovery": discovery_section,
                 "breakers": self.breakers.stats(),
                 "thread_pool": self.thread_pool.stats()}
+
+    @staticmethod
+    def _dispatch_stats_section() -> dict:
+        """Shape-bucketed kernel dispatch counters (`ops/dispatch.py`):
+        executable-cache hits/misses, compiles and cumulative compile
+        time, warmup/out-of-grid compiles, plus the per-bucket breakdown.
+        The process-wide dispatcher serves every index on this node, so
+        this section is node-level by construction (like the query
+        cache)."""
+        from elasticsearch_tpu.ops import dispatch
+        return dispatch.stats(per_bucket=True)
 
     def _knn_stats_section(self) -> dict:
         """Vector-search engine counters summed over local shards: total

@@ -40,7 +40,6 @@ store's host VNNI mirror keeps.
 
 from __future__ import annotations
 
-import functools
 import threading
 from typing import Dict, List, Sequence, Tuple
 
@@ -304,8 +303,10 @@ class LexicalField:
         return out
 
     def _score_device(self, tile_ids, boosts, required, k):
+        from elasticsearch_tpu.ops import dispatch
+
         n_real = tile_ids.shape[0]
-        n_pad = _pow2(n_real)
+        n_pad = dispatch.bucket_queries(n_real)
         if n_pad != n_real:
             # query-count padding, same motive as vectors/store._pad_batch:
             # the jit specializes on Q, and a compile per distinct batch
@@ -326,12 +327,21 @@ class LexicalField:
         # score 0 with match-count 0, so the required-mask turns them to
         # -inf and they can never surface
         n_slots_pad = _pow2(max(self.n_slots, 1))
-        vals, slot_idx = _bm25_topk(
-            jnp.asarray(tile_ids), jnp.asarray(boosts),
-            jnp.asarray(required.astype(np.int32)), slots_d, impacts_d,
-            scales_d, n_slots_pad, min(k, max(self.n_slots, 1)))
-        vals = np.asarray(vals)
-        slot_idx = np.asarray(slot_idx)
+        # window k rounds up the dispatch bucket ladder (one compile per
+        # rung, results sliced back down — lax.top_k prefixes are exact)
+        k_req = min(k, max(self.n_slots, 1))
+        k_b = dispatch.bucket_k(k_req, limit=n_slots_pad)
+        # score/count boards are allocated here and DONATED: XLA reuses
+        # their HBM for the scan carry instead of holding board + carry
+        # live at once — the largest transient of the lexical path
+        scores0 = jnp.zeros((n_pad, n_slots_pad + 1), dtype=jnp.float32)
+        counts0 = jnp.zeros((n_pad, n_slots_pad + 1), dtype=jnp.int32)
+        vals, slot_idx = dispatch.call(
+            "bm25.topk", scores0, counts0, jnp.asarray(tile_ids),
+            jnp.asarray(boosts), jnp.asarray(required.astype(np.int32)),
+            slots_d, impacts_d, scales_d, k=k_b)
+        vals = np.asarray(vals)[:, :k_req]
+        slot_idx = np.asarray(slot_idx)[:, :k_req]
         out = []
         for qi in range(n_real):
             v, si = vals[qi], slot_idx[qi]
@@ -372,25 +382,26 @@ class LexicalField:
         return host_ms > device_overhead_ms()
 
 
-@functools.partial(jax.jit, static_argnames=("n_slots_pad", "k"))
-def _bm25_topk(tile_ids, boosts, required, tile_slots, tile_impacts,
-               tile_scales, n_slots_pad: int, k: int):
+def _bm25_topk(scores0, counts0, tile_ids, boosts, required, tile_slots,
+               tile_impacts, tile_scales, k: int):
     """One-dispatch batched BM25 window: scan each query's term tiles,
     scatter-add impacts into a [Q, n_slots_pad(+1)] score board (slot
     n_slots_pad is the padding trash lane), mask by match count,
     lax.top_k.
 
-    n_slots_pad is the caller's pow2 bucket over the live-doc count, so
-    refreshes don't re-specialize this jit; pad slots keep count 0 and
+    scores0/counts0 are caller-allocated zero boards, DONATED through the
+    dispatch layer (`ops/dispatch.py` registers this kernel with
+    donate_argnums=(0, 1)): the caller must treat them as consumed. Their
+    width is the caller's pow2 bucket over the live-doc count, so
+    refreshes don't re-specialize the program; pad slots keep count 0 and
     mask to -inf. Accumulation is term-major in query order — each
     (term, doc) posting lands in exactly one tile, so per-doc adds happen
     in query-term order and the f32 sums are bit-identical to the host
     union-sum fold.
     """
     nq = tile_ids.shape[0]
+    n_slots_pad = scores0.shape[1] - 1
     qi = jnp.arange(nq)
-    scores0 = jnp.zeros((nq, n_slots_pad + 1), dtype=jnp.float32)
-    counts0 = jnp.zeros((nq, n_slots_pad + 1), dtype=jnp.int32)
 
     def body(carry, inp):
         scores, counts = carry
@@ -416,6 +427,29 @@ def _bm25_topk(tile_ids, boosts, required, tile_slots, tile_impacts,
     masked = jnp.where(ct >= jnp.maximum(required, 1)[:, None],
                        sc, -jnp.inf)
     return jax.lax.top_k(masked, k)
+
+
+def _grid_bm25(statics, sigs) -> bool:
+    """Bucketed query count, pow-2 board width (the _pow2(n_slots) pad —
+    NOT the query-bucket ladder: tiny corpora legitimately produce 2/4
+    wide boards), k on the ladder (or clamped to the board)."""
+    from elasticsearch_tpu.ops import dispatch
+    nq, width = sigs[0][0]           # scores0 [Q, n_slots_pad + 1]
+    w = width - 1
+    return (dispatch.is_query_bucket(nq)
+            and w >= 1 and (w & (w - 1)) == 0
+            and dispatch.in_k_grid(int(statics["k"]), limit=w))
+
+
+def _register_bm25():
+    from elasticsearch_tpu.ops import dispatch
+    dispatch.DISPATCH.register("bm25.topk", _bm25_topk,
+                               static_argnames=("k",),
+                               donate_argnums=(0, 1),
+                               grid_check=_grid_bm25)
+
+
+_register_bm25()
 
 
 class LexicalShard:

@@ -21,13 +21,13 @@ reference's encode-at-parse-time design (`DenseVectorFieldMapper.parse`).
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.ops.quantization import quantize_int8_np
@@ -195,7 +195,7 @@ def knn_search_auto(
             and k <= 64
             and precision == "bf16"):
         try:
-            if jax.devices()[0].platform in ("tpu", "axon"):
+            if dispatch.is_accelerator_backend():
                 if corpus.residual is not None:
                     return binned.binned_knn_search_rescored_packed(
                         queries, corpus, k, metric=metric)
@@ -206,28 +206,15 @@ def knn_search_auto(
                       precision=precision)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "metric", "precision", "block_size"),
-)
-def knn_search(
+def _knn_search_impl(
     queries: jax.Array,
     corpus: Corpus,
+    filter_mask: Optional[jax.Array],
     k: int,
     metric: str = sim.COSINE,
-    filter_mask: Optional[jax.Array] = None,
     precision: str = "bf16",
     block_size: Optional[int] = None,
 ):
-    """Exact top-k search of `queries` [Q, D] against `corpus`.
-
-    filter_mask: optional [N_pad] or [Q, N_pad] bool — True = searchable
-    (filtered kNN; host-computed bitset from the boolean pre-filter).
-
-    Returns (scores [Q, k] raw similarity, ids [Q, k] int32 row indices).
-    Padded / filtered-out rows return score NEG_INF (callers treat those as
-    "fewer than k hits").
-    """
     n_pad = corpus.matrix.shape[0]
     q = _prep_queries(queries, metric)
     # cosine corpus rows are already normalized; its sq_norms are 1 for valid
@@ -272,3 +259,46 @@ def knn_search(
     xs = (mat, sqn, scl, vmask, jnp.arange(nblocks, dtype=jnp.int32))
     (best_s, best_i), _ = jax.lax.scan(body, init, xs)
     return best_s, best_i
+
+
+def _grid_knn(statics, sigs) -> bool:
+    """Closed grid: bucketed query count, k on the ladder (or clamped to
+    the corpus), corpus rows lane-padded (they are, by build_corpus)."""
+    q_shape = sigs[0][0]          # queries [Q, D]
+    n_rows = sigs[1][0][0]        # corpus.matrix [N_pad, D]
+    return (dispatch.is_query_bucket(q_shape[0])
+            and dispatch.in_k_grid(int(statics["k"]), limit=n_rows)
+            and n_rows % LANE == 0)
+
+
+dispatch.DISPATCH.register(
+    "knn.exact", _knn_search_impl,
+    static_argnames=("k", "metric", "precision", "block_size"),
+    grid_check=_grid_knn)
+
+
+def knn_search(
+    queries: jax.Array,
+    corpus: Corpus,
+    k: int,
+    metric: str = sim.COSINE,
+    filter_mask: Optional[jax.Array] = None,
+    precision: str = "bf16",
+    block_size: Optional[int] = None,
+):
+    """Exact top-k search of `queries` [Q, D] against `corpus`.
+
+    filter_mask: optional [N_pad] or [Q, N_pad] bool — True = searchable
+    (filtered kNN; host-computed bitset from the boolean pre-filter).
+
+    Returns (scores [Q, k] raw similarity, ids [Q, k] int32 row indices).
+    Padded / filtered-out rows return score NEG_INF (callers treat those as
+    "fewer than k hits").
+
+    Executes through the shape-bucketed dispatch cache (`ops/dispatch.py`):
+    serving callers pad queries to pow-2 buckets and round k up the bucket
+    ladder, so steady-state traffic never compiles.
+    """
+    return dispatch.call("knn.exact", queries, corpus, filter_mask,
+                         k=k, metric=metric, precision=precision,
+                         block_size=block_size)

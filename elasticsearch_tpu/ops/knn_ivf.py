@@ -24,12 +24,12 @@ rows upcast in-register during the matmul read, scores de-scaled after.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.similarity import NEG_INF
 
@@ -62,11 +62,8 @@ def _prep_queries(queries: jax.Array, metric: str) -> jax.Array:
     return queries
 
 
-@functools.partial(jax.jit, static_argnames=("nprobe", "metric"))
-def route(queries: jax.Array, ivf: IVFPartitions, nprobe: int,
-          metric: str = sim.COSINE):
-    """Centroid routing: [Q, D] queries → ([Q, nprobe] partition ids,
-    [Q, nprobe] centroid scores). Queries must be metric-prepped."""
+def _route_impl(queries: jax.Array, ivf: IVFPartitions, nprobe: int,
+                metric: str = sim.COSINE):
     dots = jax.lax.dot_general(
         queries, ivf.centroids.astype(jnp.float32),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -78,18 +75,38 @@ def route(queries: jax.Array, ivf: IVFPartitions, nprobe: int,
     return ids.astype(jnp.int32), vals
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "precision"))
-def score_probes(queries: jax.Array, ivf: IVFPartitions,
-                 probe_ids: jax.Array, k: int, metric: str = sim.COSINE,
-                 precision: str = "bf16"):
-    """Score the probed partitions and merge a global top-k.
+def _grid_ivf(statics, sigs) -> bool:
+    """Bucketed query count, and nprobe on the pow-2 ladder (or the full
+    partition count): k is a corpus-tuned constant, but nprobe is widened
+    per request by the `num_candidates` knob — the router snaps that
+    widening to pow-2 rungs so the request stream can't churn the grid,
+    and this predicate is what catches a caller that forgets to."""
+    if not dispatch.is_query_bucket(sigs[0][0][0]):
+        return False
+    nlist = sigs[1][0][0]                   # centroids: [nlist, D]
+    npro = statics.get("nprobe")
+    if npro is None:                        # score_probes: [Q, nprobe] ids
+        npro = sigs[-1][0][1]
+    npro = int(npro)
+    return npro == int(nlist) or (npro >= 1 and npro & (npro - 1) == 0)
 
-    queries:   [Q, D] metric-prepped
-    probe_ids: [Q, nprobe] int32 partition ids from `route`
-    Returns (scores [Q, k] raw similarity, rows [Q, k] int32 device-corpus
-    row ids). Empty slots come back as NEG_INF / row -1 — same contract as
-    `ops/knn.knn_search` padding.
-    """
+
+dispatch.DISPATCH.register("ivf.route", _route_impl,
+                           static_argnames=("nprobe", "metric"),
+                           grid_check=_grid_ivf)
+
+
+def route(queries: jax.Array, ivf: IVFPartitions, nprobe: int,
+          metric: str = sim.COSINE):
+    """Centroid routing: [Q, D] queries → ([Q, nprobe] partition ids,
+    [Q, nprobe] centroid scores). Queries must be metric-prepped."""
+    return dispatch.call("ivf.route", queries, ivf, nprobe=nprobe,
+                         metric=metric)
+
+
+def _score_probes_impl(queries: jax.Array, ivf: IVFPartitions,
+                       probe_ids: jax.Array, k: int,
+                       metric: str = sim.COSINE, precision: str = "bf16"):
     q = queries.astype(jnp.float32)
     nq = q.shape[0]
     mm_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
@@ -120,6 +137,26 @@ def score_probes(queries: jax.Array, ivf: IVFPartitions,
 
     (best_s, best_i), _ = jax.lax.scan(body, init, probe_ids.T)
     return best_s, best_i
+
+
+dispatch.DISPATCH.register("ivf.score_probes", _score_probes_impl,
+                           static_argnames=("k", "metric", "precision"),
+                           grid_check=_grid_ivf)
+
+
+def score_probes(queries: jax.Array, ivf: IVFPartitions,
+                 probe_ids: jax.Array, k: int, metric: str = sim.COSINE,
+                 precision: str = "bf16"):
+    """Score the probed partitions and merge a global top-k.
+
+    queries:   [Q, D] metric-prepped
+    probe_ids: [Q, nprobe] int32 partition ids from `route`
+    Returns (scores [Q, k] raw similarity, rows [Q, k] int32 device-corpus
+    row ids). Empty slots come back as NEG_INF / row -1 — same contract as
+    `ops/knn.knn_search` padding.
+    """
+    return dispatch.call("ivf.score_probes", queries, ivf, probe_ids,
+                         k=k, metric=metric, precision=precision)
 
 
 def ivf_search(queries: jax.Array, ivf: IVFPartitions, k: int,

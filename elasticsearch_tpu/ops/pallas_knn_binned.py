@@ -28,7 +28,6 @@ no cross-step carry at all.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -36,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.knn import Corpus, _prep_queries
 
@@ -47,6 +47,20 @@ MASK = ~((1 << IDX_BITS) - 1)
 # cosine scores live in [-1, 1]; dot products are clamped into this window
 SHIFT = 4.0
 CLAMP = 3.0
+
+
+def default_interpret() -> bool:
+    """Mosaic compiles only on TPU-class backends; everywhere else the
+    kernel must run in interpret mode or `pallas_call` raises "Only
+    interpret mode is supported on CPU backend" (the r06
+    run_north_star_10m_int8 CPU-capture failure). Every public entry
+    resolves `interpret=None` through this probe."""
+    from elasticsearch_tpu.ops import dispatch
+    return not dispatch.is_accelerator_backend()
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    return default_interpret() if interpret is None else bool(interpret)
 
 
 def _reduce_packed(p, out_ref):
@@ -192,42 +206,42 @@ def _tile_patterns(n_pad: int, num_valid) -> tuple:
     return valid, tpat
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "interpret"))
+def _binned_impl(queries, corpus, k: int, metric: str, interpret: bool):
+    packed, _q = _binned_packed(queries, corpus, metric, interpret)
+    return _decode(packed, k)
+
+
+def _grid_binned(statics, sigs) -> bool:
+    return (dispatch.is_query_bucket(sigs[0][0][0])
+            and dispatch.in_k_grid(int(statics["k"]),
+                                   limit=sigs[1][0][0]))
+
+
+dispatch.DISPATCH.register("knn.binned", _binned_impl,
+                           static_argnames=("k", "metric", "interpret"),
+                           grid_check=_grid_binned)
+
+
 def binned_knn_search(
     queries: jax.Array,
     corpus: Corpus,
     k: int,
     metric: str = sim.COSINE,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ):
     """Approximate (recall ≈ 1 - C(k,2)·BIN_SIZE/N) top-k.
 
     Supports dot-metric corpora (cosine pre-normalized / dot_product) in
     bf16/f32 or int8 storage; callers route l2 / filtered / tiny corpora
     to the exact XLA path. Returns (raw_scores [Q, k], ids [Q, k]).
+    interpret=None auto-detects (interpret mode off TPU backends).
     """
-    packed, _q = _binned_packed(queries, corpus, metric, interpret)
-    return _decode(packed, k)
+    return dispatch.call("knn.binned", queries, corpus, k=k, metric=metric,
+                         interpret=_resolve_interpret(interpret))
 
 
-def binned_knn_search_rescored(
-    queries: jax.Array,
-    corpus: Corpus,
-    k: int,
-    metric: str = sim.COSINE,
-    rescore_bins: int = 16,
-    interpret: bool = False,
-):
-    """Binned pass + re-scoring of the top bins' member rows with the
-    UNQUANTIZED query.
-
-    The binned kernel keeps one candidate per 64-row bin and (for int8
-    corpora) quantizes the query; both cost recall. The top
-    `rescore_bins` bins per query re-score all their member rows with
-    the full-precision query (bin gather + bf16 einsum). Measured on
-    v5e: +0.007 recall@10 on clustered 1M x 768 int8 at ~6 ms/batch-256
-    (corpus-size independent, gather-bound) — worthwhile headroom when
-    the recall gate is tight, a real tax on small corpora."""
+def _rescored_impl(queries, corpus, k: int, metric: str,
+                   rescore_bins: int, interpret: bool):
     packed, q = _binned_packed(queries, corpus, metric, interpret)
     nq, ncols = packed.shape
     cols = jnp.arange(ncols, dtype=jnp.int32)[None, :]
@@ -257,25 +271,37 @@ def binned_knn_search_rescored(
     return vals, jnp.take_along_axis(flat_ids, pos, axis=1)
 
 
-def binned_knn_search_rescored_packed(
+dispatch.DISPATCH.register(
+    "knn.binned_rescored", _rescored_impl,
+    static_argnames=("k", "metric", "rescore_bins", "interpret"),
+    grid_check=_grid_binned)
+
+
+def binned_knn_search_rescored(
     queries: jax.Array,
     corpus: Corpus,
     k: int,
     metric: str = sim.COSINE,
-    rescore_candidates: int = 128,
-    interpret: bool = False,
+    rescore_bins: int = 16,
+    interpret: Optional[bool] = None,
 ):
-    """Binned pass + re-scoring of the top PACKED candidates with the
-    unquantized query.
+    """Binned pass + re-scoring of the top bins' member rows with the
+    UNQUANTIZED query.
 
-    Unlike `binned_knn_search_rescored` (which re-reads whole 64-row bins,
-    ~200 MB/batch of gathers), this reuses the exact winner row each packed
-    column already identifies: the top `rescore_candidates` columns decode
-    to row ids, and only those rows ([Q, C, D], ~25 MB/batch at C=128) are
-    re-scored in bf16. Removes the query-side int8 quantization error at a
-    few percent of the bin-rescore's bandwidth; bin-collision loss (second
-    winner inside one bin) stays, so the ceiling is between the base and
-    bin-rescored variants."""
+    The binned kernel keeps one candidate per 64-row bin and (for int8
+    corpora) quantizes the query; both cost recall. The top
+    `rescore_bins` bins per query re-score all their member rows with
+    the full-precision query (bin gather + bf16 einsum). Measured on
+    v5e: +0.007 recall@10 on clustered 1M x 768 int8 at ~6 ms/batch-256
+    (corpus-size independent, gather-bound) — worthwhile headroom when
+    the recall gate is tight, a real tax on small corpora."""
+    return dispatch.call("knn.binned_rescored", queries, corpus, k=k,
+                         metric=metric, rescore_bins=rescore_bins,
+                         interpret=_resolve_interpret(interpret))
+
+
+def _rescored_packed_impl(queries, corpus, k: int, metric: str,
+                          rescore_candidates: int, interpret: bool):
     packed, q = _binned_packed(queries, corpus, metric, interpret)
     nq, ncols = packed.shape
     cand_s = jax.lax.bitcast_convert_type(
@@ -294,19 +320,40 @@ def binned_knn_search_rescored_packed(
     return vals, jnp.take_along_axis(rows, p2, axis=1)
 
 
-def binned_knn_search_rescored_hybrid(
+dispatch.DISPATCH.register(
+    "knn.binned_rescored_packed", _rescored_packed_impl,
+    static_argnames=("k", "metric", "rescore_candidates", "interpret"),
+    grid_check=_grid_binned)
+
+
+def binned_knn_search_rescored_packed(
     queries: jax.Array,
     corpus: Corpus,
     k: int,
     metric: str = sim.COSINE,
-    rescore_bins: int = 4,
     rescore_candidates: int = 128,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ):
-    """Binned pass + hybrid re-score: the top few WHOLE bins (recovers
-    same-bin collision losses where true neighbors concentrate) plus the
-    top packed candidate rows (removes query-quantization error broadly).
-    ~1/4 of the 16-bin rescore's gather traffic for most of its recall."""
+    """Binned pass + re-scoring of the top PACKED candidates with the
+    unquantized query.
+
+    Unlike `binned_knn_search_rescored` (which re-reads whole 64-row bins,
+    ~200 MB/batch of gathers), this reuses the exact winner row each packed
+    column already identifies: the top `rescore_candidates` columns decode
+    to row ids, and only those rows ([Q, C, D], ~25 MB/batch at C=128) are
+    re-scored in bf16. Removes the query-side int8 quantization error at a
+    few percent of the bin-rescore's bandwidth; bin-collision loss (second
+    winner inside one bin) stays, so the ceiling is between the base and
+    bin-rescored variants."""
+    return dispatch.call("knn.binned_rescored_packed", queries, corpus,
+                         k=k, metric=metric,
+                         rescore_candidates=rescore_candidates,
+                         interpret=_resolve_interpret(interpret))
+
+
+def _rescored_hybrid_impl(queries, corpus, k: int, metric: str,
+                          rescore_bins: int, rescore_candidates: int,
+                          interpret: bool):
     packed, q = _binned_packed(queries, corpus, metric, interpret)
     nq, ncols = packed.shape
     cand_s = jax.lax.bitcast_convert_type(
@@ -351,6 +398,32 @@ def binned_knn_search_rescored_hybrid(
     scores = jnp.where(valid & ~dup, scores, -jnp.inf)
     vals, p2 = jax.lax.top_k(scores, k)
     return vals, jnp.take_along_axis(rows, p2, axis=1)
+
+
+dispatch.DISPATCH.register(
+    "knn.binned_rescored_hybrid", _rescored_hybrid_impl,
+    static_argnames=("k", "metric", "rescore_bins", "rescore_candidates",
+                     "interpret"),
+    grid_check=_grid_binned)
+
+
+def binned_knn_search_rescored_hybrid(
+    queries: jax.Array,
+    corpus: Corpus,
+    k: int,
+    metric: str = sim.COSINE,
+    rescore_bins: int = 4,
+    rescore_candidates: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Binned pass + hybrid re-score: the top few WHOLE bins (recovers
+    same-bin collision losses where true neighbors concentrate) plus the
+    top packed candidate rows (removes query-quantization error broadly).
+    ~1/4 of the 16-bin rescore's gather traffic for most of its recall."""
+    return dispatch.call("knn.binned_rescored_hybrid", queries, corpus,
+                         k=k, metric=metric, rescore_bins=rescore_bins,
+                         rescore_candidates=rescore_candidates,
+                         interpret=_resolve_interpret(interpret))
 
 
 def _binned_packed(queries, corpus, metric, interpret):

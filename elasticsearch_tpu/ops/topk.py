@@ -5,25 +5,63 @@ Replaces the reference's per-shard Lucene top-k heaps and the coordinator's
 with `lax.top_k` plus a concat-and-reselect merge. `lax.top_k` is stable
 (ties resolve to the lower index), so ordering the concatenation by shard
 index reproduces the reference's tie-break-by-shard-index semantics.
+
+Outermost calls route through `ops/dispatch.py`'s AOT executable cache
+(shape-bucketed, counted); calls from inside an enclosing jit inline.
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops.similarity import NEG_INF
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def top_k(scores: jax.Array, k: int):
-    """scores [..., N] → (values [..., k], indices [..., k]) descending."""
+def _top_k_impl(scores: jax.Array, k: int):
     return jax.lax.top_k(scores, k)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+def _masked_top_k_impl(scores: jax.Array, mask: jax.Array, k: int):
+    masked = jnp.where(mask, scores, NEG_INF)
+    return jax.lax.top_k(masked, k)
+
+
+def _merge_top_k_impl(scores_blocks: jax.Array, index_blocks: jax.Array,
+                      k: int):
+    b, q, kb = scores_blocks.shape
+    flat_scores = jnp.transpose(scores_blocks, (1, 0, 2)).reshape(q, b * kb)
+    flat_ids = jnp.transpose(index_blocks, (1, 0, 2)).reshape(q, b * kb)
+    vals, pos = jax.lax.top_k(flat_scores, k)
+    return vals, jnp.take_along_axis(flat_ids, pos, axis=1)
+
+
+def _grid_topk(statics, sigs) -> bool:
+    """k on the ladder (or clamped to the scored width); 2-D score boards
+    additionally require a bucketed query count."""
+    shape = sigs[0][0]
+    n = shape[-1]
+    if not dispatch.in_k_grid(int(statics["k"]), limit=n):
+        return False
+    if len(shape) == 2:
+        return dispatch.is_query_bucket(shape[0])
+    return True
+
+
+dispatch.DISPATCH.register("topk.top_k", _top_k_impl,
+                           static_argnames=("k",), grid_check=_grid_topk)
+dispatch.DISPATCH.register("topk.masked_top_k", _masked_top_k_impl,
+                           static_argnames=("k",), grid_check=_grid_topk)
+dispatch.DISPATCH.register("topk.merge_top_k", _merge_top_k_impl,
+                           static_argnames=("k",))
+
+
+def top_k(scores: jax.Array, k: int):
+    """scores [..., N] → (values [..., k], indices [..., k]) descending."""
+    return dispatch.call("topk.top_k", scores, k=k)
+
+
 def masked_top_k(scores: jax.Array, mask: jax.Array, k: int):
     """Top-k over scores where mask==True; masked-out slots score -inf.
 
@@ -33,11 +71,9 @@ def masked_top_k(scores: jax.Array, mask: jax.Array, k: int):
     reference's collector-level filter composition
     (`BoolQueryBuilder` + `script_score`) doesn't translate to XLA.
     """
-    masked = jnp.where(mask, scores, NEG_INF)
-    return jax.lax.top_k(masked, k)
+    return dispatch.call("topk.masked_top_k", scores, mask, k=k)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
 def merge_top_k(scores_blocks: jax.Array, index_blocks: jax.Array, k: int):
     """Merge per-block top-k results into a global top-k.
 
@@ -49,8 +85,4 @@ def merge_top_k(scores_blocks: jax.Array, index_blocks: jax.Array, k: int):
     gives the reference's tie-break (`mergeTopDocs:221` breaks equal scores by
     shard index).
     """
-    b, q, kb = scores_blocks.shape
-    flat_scores = jnp.transpose(scores_blocks, (1, 0, 2)).reshape(q, b * kb)
-    flat_ids = jnp.transpose(index_blocks, (1, 0, 2)).reshape(q, b * kb)
-    vals, pos = jax.lax.top_k(flat_scores, k)
-    return vals, jnp.take_along_axis(flat_ids, pos, axis=1)
+    return dispatch.call("topk.merge_top_k", scores_blocks, index_blocks, k=k)

@@ -69,6 +69,87 @@ class LexicalLeg:
         self.boost = boost
 
 
+class EmptyLeg:
+    """A leg whose analysis produced nothing searchable (match text that
+    analyzes to zero terms): contributes an empty ranked list — the same
+    empty-DocSet semantics the host query phase returns for it."""
+
+    __slots__ = ()
+
+
+class LexicalTemplate:
+    """Compile-time half of a lexical leg: everything except the query
+    TEXT, which is normalized out of the plan-cache key and bound per
+    query (`bind`). operator/msm/boost are structural (part of the key)."""
+
+    __slots__ = ("field", "kind", "operator", "msm", "boost")
+
+    def __init__(self, field: str, kind: str, operator: str, msm,
+                 boost: float):
+        self.field = field
+        self.kind = kind          # "match" | "term"
+        self.operator = operator
+        self.msm = msm
+        self.boost = boost
+
+    def bind(self, qspec, mapper_service):
+        if self.kind == "term":
+            text = qspec.get("value") if isinstance(qspec, dict) else qspec
+            return LexicalLeg(self.field, [str(text)], 1, self.boost)
+        text = qspec.get("query") if isinstance(qspec, dict) else qspec
+        mapper = mapper_service.get(self.field)
+        terms = mapper.search_analyzer.terms(str(text))
+        if not terms:
+            return EmptyLeg()
+        required = len(terms) if self.operator == "and" \
+            else resolve_msm(self.msm, len(terms))
+        return LexicalLeg(self.field, terms, required, self.boost)
+
+
+class KnnTemplate:
+    """Compile-time half of a kNN leg: the query VECTOR is normalized out
+    of the plan-cache key (only its dimensionality is structural) and
+    bound per query; k/num_candidates/filter/boost/metric live in the key
+    and are resolved once at compile."""
+
+    __slots__ = ("field", "dims", "k", "num_candidates", "filter_spec",
+                 "boost", "metric")
+
+    def __init__(self, field, dims, k, num_candidates, filter_spec, boost,
+                 metric):
+        self.field = field
+        self.dims = dims
+        self.k = k
+        self.num_candidates = num_candidates
+        self.filter_spec = filter_spec
+        self.boost = boost
+        self.metric = metric
+
+    def bind(self, spec):
+        qv = np.asarray(spec["query_vector"], dtype=np.float32)
+        if qv.shape[0] != self.dims:
+            # same 400 KnnQuery._metric raises on the oracle — validated
+            # per QUERY (the cached plan only pins the field's dims)
+            raise IllegalArgumentError(
+                f"[knn] query vector has {qv.shape[0]} dims, field "
+                f"[{self.field}] expects {self.dims}")
+        return KnnLeg(self.field, qv, self.k, self.num_candidates,
+                      self.filter_spec, self.boost, self.metric)
+
+
+class GenericTemplate:
+    """Anything the specialized engines don't cover: bound to the BODY's
+    own sub-query at execution (never the compile-time body's — generic
+    values may legitimately be normalized out of the key by the
+    match/term scrubbing)."""
+
+    __slots__ = ()
+
+    @staticmethod
+    def bind(qspec):
+        return GenericLeg(qspec)
+
+
 class KnnLeg:
     __slots__ = ("field", "query_vector", "k", "num_candidates",
                  "filter_spec", "boost", "metric")
@@ -97,16 +178,85 @@ class GenericLeg:
 
 
 class HybridPlan:
+    """Compiled structure of a hybrid body: leg templates + fusion
+    parameters. Per-query VALUES (query vectors, match text) are NOT part
+    of the plan — `bind` extracts them from each body, so one cached plan
+    serves every query with the same shape (the r06 bench showed
+    `plan_cache_hits: 0` across 108 structurally identical bodies because
+    the old key hashed the values too)."""
+
     __slots__ = ("legs", "rank_constant", "window", "size", "frm",
                  "fetch_body")
 
     def __init__(self, legs, rank_constant, window, size, frm, fetch_body):
-        self.legs = legs
+        self.legs = legs          # templates (Lexical/Knn/Generic)
         self.rank_constant = rank_constant
         self.window = window
         self.size = size
         self.frm = frm
         self.fetch_body = fetch_body
+
+    def bind(self, body: dict, mapper_service) -> List[Any]:
+        """Resolve the per-query values of `body` against the templates →
+        executable legs. O(legs), no DSL parse, no classification."""
+        subs = _sub_queries_of(body)
+        bound: List[Any] = []
+        for template, q in zip(self.legs, subs):
+            if isinstance(template, LexicalTemplate):
+                bound.append(template.bind(q[template.kind][template.field],
+                                           mapper_service))
+            elif isinstance(template, KnnTemplate):
+                bound.append(template.bind(q["knn"]))
+            else:
+                bound.append(GenericTemplate.bind(q))
+        return bound
+
+
+def plan_cache_key(body: dict) -> str:
+    """Normalized plan-cache key: the body with per-query VALUE slots
+    scrubbed — `knn.query_vector` → its length (shape is structural,
+    content is not), match/term text → a placeholder. Everything else
+    (fields, k, num_candidates, filters, boosts, rank params, size/from,
+    fuzziness) stays: those change the compiled plan."""
+    def scrub_query(q):
+        if not isinstance(q, dict) or len(q) != 1:
+            return q
+        ((kind, spec),) = q.items()
+        if kind == "knn" and isinstance(spec, dict) \
+                and "query_vector" in spec:
+            qv = spec["query_vector"]
+            spec = {**spec,
+                    "query_vector": {"__dims__": len(qv)
+                                     if hasattr(qv, "__len__") else 0}}
+            return {kind: spec}
+        if kind in ("match", "term") and isinstance(spec, dict) \
+                and len(spec) == 1:
+            ((field, v),) = spec.items()
+            if kind == "term":
+                v = {**v, "value": "__text__"} if isinstance(v, dict) \
+                    else "__text__"
+            else:
+                v = {**v, "query": "__text__"} if isinstance(v, dict) \
+                    else "__text__"
+            return {kind: {field: v}}
+        return q
+
+    norm = dict(body)
+    if norm.get("sub_searches"):
+        norm["sub_searches"] = [
+            {**s, "query": scrub_query(s.get("query", {"match_all": {}}))}
+            for s in norm["sub_searches"]]
+    else:
+        if norm.get("query") is not None:
+            norm["query"] = scrub_query(norm["query"])
+        if norm.get("knn") is not None:
+            knn = norm["knn"]
+            if isinstance(knn, list):
+                norm["knn"] = [scrub_query({"knn": s})["knn"] for s in knn]
+            else:
+                norm["knn"] = scrub_query({"knn": knn})["knn"]
+    from elasticsearch_tpu.search.caches import _canonical
+    return _canonical(norm)
 
 
 def _sub_queries_of(body: dict) -> List[dict]:
@@ -127,9 +277,12 @@ def _sub_queries_of(body: dict) -> List[dict]:
 
 
 def _compile_lexical(spec_kind: str, qspec: dict,
-                     mapper_service) -> Optional[LexicalLeg]:
-    """Lower a match/term sub-search to the lexical engine when it scores
-    exactly like the host path would (text field, no fuzziness)."""
+                     mapper_service) -> Optional[LexicalTemplate]:
+    """Lower a match/term sub-search to a lexical-engine template when it
+    scores exactly like the host path would (text field, no fuzziness).
+    Classification is purely STRUCTURAL (field type + spec shape), never
+    value-dependent — the plan-cache key scrubs values out, so two bodies
+    with one key must classify identically."""
     if not isinstance(qspec, dict) or len(qspec) != 1:
         return None
     ((field, v),) = qspec.items()
@@ -137,25 +290,18 @@ def _compile_lexical(spec_kind: str, qspec: dict,
     if not isinstance(mapper, TextFieldMapper):
         return None
     if spec_kind == "term":
-        text = v.get("value") if isinstance(v, dict) else v
         boost = float(v.get("boost", 1.0)) if isinstance(v, dict) else 1.0
-        return LexicalLeg(field, [str(text)], 1, boost)
+        return LexicalTemplate(field, "term", "or", None, boost)
     # match
     if isinstance(v, dict):
         if v.get("fuzziness") is not None:
             return None
-        text = v.get("query")
         operator = str(v.get("operator", "or")).lower()
         msm = v.get("minimum_should_match")
         boost = float(v.get("boost", 1.0))
     else:
-        text, operator, msm, boost = v, "or", None, 1.0
-    terms = mapper.search_analyzer.terms(str(text))
-    if not terms:
-        return None  # empty analysis → host path (empty DocSet) semantics
-    required = len(terms) if operator == "and" \
-        else resolve_msm(msm, len(terms))
-    return LexicalLeg(field, terms, required, boost)
+        operator, msm, boost = "or", None, 1.0
+    return LexicalTemplate(field, "match", operator, msm, boost)
 
 
 def compile_plan(body: dict, mapper_service) -> HybridPlan:
@@ -183,28 +329,20 @@ def compile_plan(body: dict, mapper_service) -> HybridPlan:
                 from elasticsearch_tpu.vectors.store import _METRIC_MAP
                 mapper = mapper_service.get(spec["field"])
                 if isinstance(mapper, DenseVectorFieldMapper):
-                    qv = np.asarray(spec["query_vector"],
-                                    dtype=np.float32)
-                    if qv.shape[0] != mapper.dims:
-                        # same 400 KnnQuery._metric raises on the oracle
-                        raise IllegalArgumentError(
-                            f"[knn] query vector has {qv.shape[0]} dims, "
-                            f"field [{spec['field']}] expects "
-                            f"{mapper.dims}")
                     # EXACT parse_query("knn") semantics — the oracle's:
                     # k defaults to 10 (not num_candidates), and
                     # num_candidates clamps up to k (KnnQuery.__init__)
                     k = int(spec.get("k", 10))
                     nc = max(int(spec.get("num_candidates",
                                           spec.get("k", 10))), k)
-                    leg = KnnLeg(
-                        spec["field"], qv, k, nc, spec.get("filter"),
-                        float(spec.get("boost", 1.0)),
+                    leg = KnnTemplate(
+                        spec["field"], mapper.dims, k, nc,
+                        spec.get("filter"), float(spec.get("boost", 1.0)),
                         _METRIC_MAP[mapper.similarity])
             elif kind in ("match", "term"):
                 leg = _compile_lexical(kind, spec, mapper_service)
         if leg is None:
-            leg = GenericLeg(q)
+            leg = GenericTemplate()
         legs.append(leg)
     fetch_body = {k: v for k, v in body.items()
                   if k in ("_source", "docvalue_fields")}
@@ -249,6 +387,7 @@ class HybridExecutor:
                  max_queue_depth: int = 256,
                  deadline_ms: Optional[float] = 10_000.0,
                  plan_cache_entries: int = 256):
+        from elasticsearch_tpu.ops import dispatch as _dispatch
         from elasticsearch_tpu.search.caches import LruCache
         self.node = node
         self.svc = svc
@@ -258,7 +397,10 @@ class HybridExecutor:
         self.plan_cache = LruCache(max_entries=plan_cache_entries)
         self.batcher = BoundedBatcher(self._run_batch, max_batch=max_batch,
                                       max_queue_depth=max_queue_depth,
-                                      deadline_ms=deadline_ms)
+                                      deadline_ms=deadline_ms,
+                                      warmup=self._warmup
+                                      if _dispatch.warmup_enabled()
+                                      else None)
         self.stats = {"searches": 0, "batches": 0, "max_batch_seen": 0,
                       "plan_cache_hits": 0, "plan_cache_misses": 0,
                       "plan_nanos": 0, "score_nanos": 0, "fuse_nanos": 0,
@@ -268,11 +410,57 @@ class HybridExecutor:
     def submit(self, body: dict) -> dict:
         return self.batcher.submit(body)
 
+    def _warmup(self) -> None:
+        """Batcher-start warmup (runs on the batcher's daemon thread):
+        build the lexical impact layout for every text field NOW instead
+        of inside the first hybrid query, and pre-compile the BM25
+        scatter-add kernel for the interactive bucket grid against that
+        layout's board width. Vector-field grids warm separately at
+        corpus sync (`vectors/store._schedule_warmup`)."""
+        import jax
+        import jax.numpy as _jnp
+
+        from elasticsearch_tpu.index.mapping import TextFieldMapper
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        from elasticsearch_tpu.ops.bm25 import _pow2
+        reader = self.svc.combined_reader()
+        entries = []
+        for field, mapper in self.svc.mapper_service.all_mappers():
+            if not isinstance(mapper, TextFieldMapper):
+                continue
+            lf = self.lexical.field(reader, field)
+            if lf.n_slots == 0:
+                continue
+            width = _pow2(max(lf.n_slots, 1)) + 1
+            imp_dtype = {"f32": _jnp.float32, "bf16": _jnp.bfloat16,
+                         "int8": _jnp.int8}[lf.dtype]
+            n_tiles = max(int(lf.tile_slots.shape[0]), 1)
+            scales = (jax.ShapeDtypeStruct((n_tiles,), _jnp.float32)
+                      if lf.dtype == "int8" else None)
+            for q in (1, 8, 16):
+                for m in (1, 2, 4):
+                    entries.append((
+                        "bm25.topk",
+                        (jax.ShapeDtypeStruct((q, width), _jnp.float32),
+                         jax.ShapeDtypeStruct((q, width), _jnp.int32),
+                         jax.ShapeDtypeStruct((q, m), _jnp.int32),
+                         jax.ShapeDtypeStruct((q, m), _jnp.float32),
+                         jax.ShapeDtypeStruct((q,), _jnp.int32),
+                         jax.ShapeDtypeStruct((n_tiles, 128), _jnp.int32),
+                         jax.ShapeDtypeStruct((n_tiles, 128), imp_dtype),
+                         scales),
+                        {"k": _dispatch.bucket_k(
+                            min(DEFAULT_WINDOW, lf.n_slots),
+                            limit=width - 1)}))
+        if entries:
+            _dispatch.DISPATCH.warmup(entries, background=False)
+
     def plan_for(self, body: dict) -> Tuple[HybridPlan, bool]:
         """Plan-cache lookup (hit) or compile (miss), keyed on the
-        normalized body."""
-        from elasticsearch_tpu.search.caches import _canonical
-        key = _canonical(body)
+        normalized body — per-query values (query vectors, match text)
+        are scrubbed from the key, so repeated SHAPES hit regardless of
+        what they search for."""
+        key = plan_cache_key(body)
         plan = self.plan_cache.get(key)
         if plan is not None:
             self.stats["plan_cache_hits"] += 1
@@ -296,10 +484,12 @@ class HybridExecutor:
 
         t0 = time.perf_counter_ns()
         plans: List[HybridPlan] = []
+        bound: List[List[Any]] = []
         cache_state: List[bool] = []
         for body in bodies:
             plan, hit = self.plan_for(body)
             plans.append(plan)
+            bound.append(plan.bind(body, self.svc.mapper_service))
             cache_state.append(hit)
         plan_nanos = time.perf_counter_ns() - t0
         self.stats["plan_nanos"] += plan_nanos
@@ -314,8 +504,20 @@ class HybridExecutor:
             ctx.vector_store = store
 
             t0 = time.perf_counter_ns()
-            leg_results, leg_info = self._score_legs(
-                reader, store, ctx, plans)
+            # the per-dispatch event trace costs a dict per kernel call;
+            # only pay it when some query in the batch asked to profile
+            trace = any(body.get("profile") for body in bodies)
+            dispatch_events = []
+            from elasticsearch_tpu.ops import dispatch as _dispatch
+            if trace:
+                _dispatch.DISPATCH.record_events(True)
+            try:
+                leg_results, leg_info = self._score_legs(
+                    reader, store, ctx, plans, bound)
+            finally:
+                if trace:
+                    dispatch_events = _dispatch.DISPATCH.drain_events()
+                    _dispatch.DISPATCH.record_events(False)
             score_nanos = time.perf_counter_ns() - t0
             self.stats["score_nanos"] += score_nanos
 
@@ -363,7 +565,8 @@ class HybridExecutor:
                         svc.name, plan_nanos, score_nanos, fuse_nanos,
                         0, cache_state[bi], len(bodies),
                         [leg_info[(bi, li)]
-                         for li in range(len(plan.legs))])
+                         for li in range(len(plan.legs))],
+                        dispatch_events=dispatch_events)
                 out.append(resp)
             hydrate_nanos = time.perf_counter_ns() - t0
             self.stats["hydrate_nanos"] += hydrate_nanos
@@ -377,20 +580,23 @@ class HybridExecutor:
             self.node.breakers.release("request", breaker_bytes)
 
     # -------------------------------------------------------------- legs
-    def _score_legs(self, reader, store, ctx, plans):
-        """Execute every plan's legs, grouped so each engine sees ONE
-        batched dispatch: lexical legs group per text field, kNN legs per
-        (field, k, num_candidates). Returns {(body_idx, leg_idx): ranked
-        row array} + per-leg profile info."""
+    def _score_legs(self, reader, store, ctx, plans, bound):
+        """Execute every body's BOUND legs, grouped so each engine sees
+        ONE batched dispatch: lexical legs group per text field, kNN legs
+        per (field, k, num_candidates). Returns {(body_idx, leg_idx):
+        ranked row array} + per-leg profile info."""
         leg_results: Dict[Tuple[int, int], np.ndarray] = {}
         leg_info: Dict[Tuple[int, int], dict] = {}
 
         lex_groups: Dict[str, List[Tuple[int, int, LexicalLeg]]] = {}
         knn_groups: Dict[Tuple[str, int, Optional[int]],
                          List[Tuple[int, int, KnnLeg]]] = {}
-        for bi, plan in enumerate(plans):
-            for li, leg in enumerate(plan.legs):
-                if isinstance(leg, LexicalLeg):
+        for bi, legs in enumerate(bound):
+            for li, leg in enumerate(legs):
+                if isinstance(leg, EmptyLeg):
+                    leg_results[(bi, li)] = np.zeros(0, dtype=np.int64)
+                    leg_info[(bi, li)] = {"type": "empty"}
+                elif isinstance(leg, LexicalLeg):
                     lex_groups.setdefault(leg.field, []).append(
                         (bi, li, leg))
                 elif isinstance(leg, KnnLeg):

@@ -24,7 +24,8 @@ def _describe_query(body: dict) -> tuple:
 
 def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
                    fuse_nanos: int, hydrate_nanos: int, plan_cache_hit: bool,
-                   batch_size: int, legs: list) -> dict:
+                   batch_size: int, legs: list,
+                   dispatch_events: Optional[list] = None) -> dict:
     """`profile` section for a fused hybrid (rank.rrf) search
     (search/hybrid_plan.py): the four plan phases — plan (parse/compile or
     cache hit), score (the batched leg dispatches), fuse (vectorized RRF),
@@ -34,8 +35,13 @@ def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
     through the serving batcher, so the device work the timing describes
     was shared by `batch_size` queries (the per-query marginal cost is
     time/batch_size; reporting the honest batch figure keeps the profile
-    additive with wall clock)."""
-    return {"hybrid": {
+    additive with wall clock).
+
+    dispatch_events: the per-kernel dispatch trace of this batch's score
+    phase (`ops/dispatch.py` record_events) — which shape bucket each
+    device dispatch hit, whether its executable was cached, and what any
+    compile cost. A steady-state batch shows every event as a hit."""
+    out = {"hybrid": {
         "id": f"[{index_name}][0]",
         "plan_cache": "hit" if plan_cache_hit else "miss",
         "batch_size": batch_size,
@@ -44,11 +50,15 @@ def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
                       "fuse_nanos": fuse_nanos,
                       "hydrate_nanos": hydrate_nanos},
         "legs": legs}}
+    if dispatch_events is not None:
+        out["hybrid"]["dispatch"] = dispatch_events
+    return out
 
 
 def shard_profile(index_name: str, body: dict, query_nanos: int,
                   fetch_nanos: int, total_hits: int,
-                  knn_phases: Optional[dict] = None) -> dict:
+                  knn_phases: Optional[dict] = None,
+                  dispatch_events: Optional[list] = None) -> dict:
     kind, description = _describe_query(body)
     breakdown = {
         "score": query_nanos * 7 // 10,
@@ -102,6 +112,16 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
                 for key in ("route_nanos", "score_nanos", "merge_nanos")
                 if key in knn_phases},
         }
+    if dispatch_events:
+        # shape-bucket trace of this shard's device dispatches (see
+        # ops/dispatch.py): bucket key, executable-cache hit/miss, compile
+        # cost. Steady-state searches report hits only. The trace is
+        # thread-local: a query coalesced into ANOTHER request's device
+        # batch reports its dispatches in that batch leader's trace, so a
+        # profiled search under concurrency may show an empty list even
+        # though kernels ran — `_nodes/stats indices.dispatch` is the
+        # authoritative counter.
+        profile["dispatch"] = dispatch_events
     if (body or {}).get("aggs") or (body or {}).get("aggregations"):
         aggs = body.get("aggs") or body.get("aggregations")
         profile["aggregations"] = [

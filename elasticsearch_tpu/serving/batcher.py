@@ -124,8 +124,14 @@ class CombiningBatcher:
 
     def __init__(self, execute: Callable[[Sequence], List],
                  max_batch: int = 256):
+        from elasticsearch_tpu.ops import dispatch
         self._execute = execute
-        self._max_batch = max_batch
+        # the batch ceiling snaps to a dispatch query bucket: a saturated
+        # drain then hands the executor an exactly-bucket-sized batch (no
+        # padding waste at peak), and light-load drains pad up to the
+        # nearest bucket inside the executor — either way the compiled
+        # shape set stays closed
+        self._max_batch = dispatch.bucket_queries(max_batch)
         self._run_lock = threading.Lock()
         self._q_lock = threading.Lock()
         self._queue: List = []
@@ -220,12 +226,33 @@ class BoundedBatcher(CombiningBatcher):
 
     def __init__(self, execute: Callable[[Sequence], List],
                  max_batch: int = 256, max_queue_depth: int = 256,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 warmup: Optional[Callable[[], None]] = None):
         super().__init__(execute, max_batch=max_batch)
         self.max_queue_depth = max_queue_depth
         self.deadline_ms = deadline_ms
         self.stats = {"accepted": 0, "rejected_depth": 0,
                       "shed_deadline": 0, "max_depth_seen": 0}
+        if warmup is not None:
+            # warmup-at-start: pre-compile the dispatch bucket grid off
+            # the critical path, so the queue's first drained batch finds
+            # its program compiled instead of stalling behind XLA
+            threading.Thread(target=self._run_warmup, args=(warmup,),
+                             daemon=True, name="batcher-warmup").start()
+
+    @staticmethod
+    def _run_warmup(warmup: Callable[[], None]) -> None:
+        try:
+            warmup()
+        except Exception as exc:
+            # a warmup failure must never take down admission — but a
+            # silent one is indistinguishable from warmup-disabled while
+            # first batches stall behind the compiles warmup exists to
+            # absorb, so leave a trace
+            import logging
+            logging.getLogger("elasticsearch_tpu.serving").warning(
+                "hybrid batcher warmup failed (first batches will pay "
+                "compiles): %s", exc)
 
     def _enqueue(self, request, fut: Future) -> None:
         with self._q_lock:

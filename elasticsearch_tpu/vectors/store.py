@@ -24,6 +24,7 @@ import numpy as np
 from elasticsearch_tpu import native
 from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
 from elasticsearch_tpu.index.segment import ShardReader
+from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.serving.batcher import CombiningBatcher, CostModel
@@ -63,13 +64,11 @@ class FieldCorpus:
 
 
 def _pad_batch(queries: np.ndarray, n_real: int) -> np.ndarray:
-    """Pad a coalesced query batch to a power-of-2 bucket: the device jits
-    (exhaustive and IVF alike) specialize on the query-count dimension,
-    and a fresh compile per distinct batch size would stall serving. Pad
-    results are sliced away by the caller."""
-    b_pad = 1
-    while b_pad < n_real:
-        b_pad *= 2
+    """Pad a coalesced query batch to the dispatch layer's query bucket
+    (pow-2): the device jits (exhaustive and IVF alike) specialize on the
+    query-count dimension, and a fresh compile per distinct batch size
+    would stall serving. Pad results are sliced away by the caller."""
+    b_pad = dispatch.bucket_queries(n_real)
     if b_pad != n_real:
         queries = np.concatenate(
             [queries, np.zeros((b_pad - n_real, queries.shape[1]),
@@ -105,13 +104,18 @@ class VectorStoreShard:
     def __init__(self, dtype: str = "bf16",
                  host_mirror_max_bytes: int = HOST_MIRROR_MAX_BYTES,
                  knn_engine: str = "tpu", knn_nlist=None,
-                 knn_nprobe="auto", knn_recall_target: float = 0.95):
+                 knn_nprobe="auto", knn_recall_target: float = 0.95,
+                 warmup: Optional[bool] = None):
         self.dtype = dtype
         self.host_mirror_max_bytes = host_mirror_max_bytes
         self.knn_engine = knn_engine        # "tpu" (exhaustive) | "tpu_ivf"
         self.knn_nlist = knn_nlist          # None = pick_nlist(n)
         self.knn_nprobe = knn_nprobe        # "auto" | int
         self.knn_recall_target = knn_recall_target
+        # None = auto: warm the dispatch grid only where compiles are the
+        # serving bottleneck (real accelerator backends) or when forced
+        # via ES_TPU_DISPATCH_WARMUP=1 / the node's search.dispatch.warmup
+        self.warmup = warmup
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -220,6 +224,51 @@ class VectorStoreShard:
             with self._batchers_lock:
                 for key in [k for k in self._batchers if k[0] == field]:
                     del self._batchers[key]
+            self._schedule_warmup(self._fields[field])
+
+    def warmup_enabled(self) -> bool:
+        return dispatch.warmup_enabled(self.warmup)
+
+    def _schedule_warmup(self, fc: FieldCorpus) -> None:
+        """Pre-compile the bucket grid for a freshly-synced corpus on a
+        background thread (warmup-at-open): the first real query of any
+        interactive bucket then finds its executable cached instead of
+        stalling the serving queue behind an XLA compile. Entries mirror
+        `knn_search_auto`'s routing so the warmed program IS the one the
+        serving path executes."""
+        if fc.corpus is None or not self.warmup_enabled():
+            return
+        from elasticsearch_tpu.ops import pallas_knn_binned as binned
+        corpus_spec = dispatch.specs_like(fc.corpus)
+        n_pad = fc.corpus.matrix.shape[0]
+        binned_ok = (fc.metric in (sim.COSINE, sim.DOT_PRODUCT,
+                                   sim.MAX_INNER_PRODUCT)
+                     and n_pad % binned.BLOCK_N == 0
+                     and not binned.default_interpret())
+        entries = []
+        for q in dispatch.WARMUP_QUERY_BUCKETS:
+            qspec = dispatch.query_spec(q, fc.dims)
+            for k in dispatch.WARMUP_K_BUCKETS:
+                k_b = dispatch.bucket_k(min(k, n_pad), limit=n_pad)
+                if binned_ok and k_b <= 64:
+                    if fc.corpus.residual is not None:
+                        entries.append((
+                            "knn.binned_rescored_packed",
+                            (qspec, corpus_spec),
+                            {"k": k_b, "metric": fc.metric,
+                             "rescore_candidates": 128,
+                             "interpret": False}))
+                    else:
+                        entries.append((
+                            "knn.binned", (qspec, corpus_spec),
+                            {"k": k_b, "metric": fc.metric,
+                             "interpret": False}))
+                else:
+                    entries.append((
+                        "knn.exact", (qspec, corpus_spec, None),
+                        {"k": k_b, "metric": fc.metric,
+                         "precision": "bf16", "block_size": None}))
+        dispatch.DISPATCH.warmup(entries, background=True)
 
     def field(self, name: str) -> Optional[FieldCorpus]:
         return self._fields.get(name)
@@ -336,10 +385,17 @@ class VectorStoreShard:
                     else:
                         m[i, :n_valid] = np.isin(fc.row_map, fr)
                 mask = jnp.asarray(m)
+            # k rounds up the dispatch bucket ladder so a workload that
+            # sweeps k (10, 12, 13, ...) reuses one compiled program per
+            # rung; the extra columns slice away below (top-k prefixes
+            # are exact)
+            k_b = dispatch.bucket_k(k_eff,
+                                    limit=fc.corpus.matrix.shape[0])
             s, i = knn_ops.knn_search_auto(
-                jnp.asarray(queries), fc.corpus, k=k_eff, metric=fc.metric,
+                jnp.asarray(queries), fc.corpus, k=k_b, metric=fc.metric,
                 filter_mask=mask, precision=precision)
-            scores, ids = np.asarray(s), np.asarray(i)
+            scores = np.asarray(s)[:, :k_eff]
+            ids = np.asarray(i)[:, :k_eff]
             floor = -1e37
 
         out = []
@@ -357,8 +413,10 @@ class VectorStoreShard:
         import time as _time
 
         queries = _pad_batch(queries, n_real)
+        k_b = dispatch.bucket_k(k_eff, limit=len(fc.row_map))
         scores, rows, phases = fc.router.search(
-            queries, k_eff, num_candidates=num_candidates)
+            queries, k_b, num_candidates=num_candidates)
+        scores, rows = scores[:, :k_eff], rows[:, :k_eff]
         t0 = _time.perf_counter_ns()
         out = []
         for qi in range(n_real):

@@ -234,6 +234,63 @@ class TestPlanCache:
         n.search("h", {**body, "size": 6})
         assert ex.stats["plan_cache_misses"] == misses0 + 2
 
+    def test_same_shape_different_values_hits(self, node):
+        """The r06 bench regression: 108 structurally identical rank.rrf
+        bodies recorded plan_cache_hits: 0 because the key hashed the
+        query VECTOR and match TEXT. The key now scrubs per-query values:
+        a fixed shape with varying values must miss once and hit
+        thereafter — and still return the right per-query results."""
+        n, rng = node
+        ex = n._hybrid_executor(n.indices.get("h"))
+
+        def body(text, vec):
+            return {"rank": {"rrf": {"rank_window_size": 37}},
+                    "query": {"match": {"body": text}},
+                    "knn": {"field": "v", "query_vector": vec, "k": 19},
+                    "size": 7}
+
+        probes = [("a b", rng.standard_normal(8).tolist())
+                  for _ in range(6)] + \
+                 [("c d", rng.standard_normal(8).tolist())
+                  for _ in range(6)]
+        misses0 = ex.stats["plan_cache_misses"]
+        hits0 = ex.stats["plan_cache_hits"]
+        fused = [n.search("h", body(t, v)) for t, v in probes]
+        assert ex.stats["plan_cache_misses"] == misses0 + 1, \
+            "structurally identical bodies must share ONE plan"
+        assert ex.stats["plan_cache_hits"] == hits0 + len(probes) - 1
+        # hit-rate: steady state ≥ 90% for this workload
+        hits = ex.stats["plan_cache_hits"] - hits0
+        assert hits / len(probes) > 0.9
+        # correctness: each cached-plan result == the two-phase oracle
+        # for ITS OWN values (a stale plan would leak another query's
+        # vector/text into the legs)
+        for (t, v), resp in zip(probes, fused):
+            oracle = n.search("h", {**body(t, v),
+                                    "__rrf_two_phase__": True})
+            resp = dict(resp)
+            resp.pop("took"), oracle.pop("took")
+            assert json.dumps(resp, sort_keys=True) \
+                == json.dumps(oracle, sort_keys=True)
+
+    def test_wrong_dims_still_400_on_cached_plan(self, node):
+        """Dims validation moved from plan compile to per-query bind; a
+        cached plan must still 400 a mis-sized vector."""
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+        n, rng = node
+        good = {"rank": {"rrf": {}},
+                "query": {"match": {"body": "a"}},
+                "knn": {"field": "v",
+                        "query_vector": rng.standard_normal(8).tolist(),
+                        "k": 21},
+                "size": 4}
+        n.search("h", dict(good))  # populate the plan cache
+        bad = dict(good)
+        bad["knn"] = {**good["knn"],
+                      "query_vector": rng.standard_normal(5).tolist()}
+        with pytest.raises(IllegalArgumentError):
+            n.search("h", bad)
+
     def test_profile_reports_cache_state_and_phases(self, node):
         n, rng = node
         body = {"rank": {"rrf": {}},

@@ -188,6 +188,68 @@ def test_primary_failover_preserves_data(cluster):
                     f"recovered replica has {shard.engine.doc_count()} docs"
 
 
+def test_kill_copy_holder_keeps_data_searchable(cluster):
+    """ROADMAP regression (found via the live 3-node repro): SIGKILL a
+    copy-holding node → health goes green again, but searches on the
+    survivors returned 0 docs. Root cause was NOT allocation (promotion
+    from the in-sync set worked): the re-established replica applied its
+    peer-recovery ops but never REFRESHED, so its searcher served an
+    empty view forever — green-but-empty. The fix refreshes the engine
+    before the replica reports started; this test pins search-VISIBLE
+    data on every copy, not just engine doc counts."""
+    c = cluster
+    c.any_node().client_create_index(
+        "vis", settings={"index.number_of_shards": 1,
+                         "index.number_of_replicas": 1},
+        mappings={"properties": {"v": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("vis"))
+    for i in range(20):
+        c.call(c.any_node().client_write, "vis",
+               {"type": "index", "id": str(i), "source": {"v": i}})
+    for node in c.nodes.values():
+        node.refresh_all()
+
+    # kill the PRIMARY holder (a copy holder whose loss exercises both
+    # promotion and replica re-establishment on the data-free node)
+    state = c.any_node().cluster_state
+    victim = state.primary_of("vis", 0).node_id
+    c.transport.blackhole(victim)
+    c.nodes[victim].stop()
+
+    def green_again():
+        n = c.any_node(exclude={victim})
+        shards = [s for s in n.cluster_state.shards_of("vis")
+                  if s.node_id and s.node_id != victim]
+        return len(shards) >= 2 and all(
+            s.state == ShardRoutingEntry.STARTED for s in shards)
+
+    assert c.run_until(green_again, max_ms=240_000), \
+        "cluster never re-established both copies"
+
+    # EVERY copy must serve the full doc set through its SEARCHER — the
+    # engine holding the ops is not enough (the green-but-empty bug)
+    for nid, n in c.nodes.items():
+        if nid == victim or n.coordinator.stopped:
+            continue
+        for key, shard in n.local_shards.items():
+            if key != ("vis", 0):
+                continue
+            reader = shard.engine.acquire_searcher()
+            assert reader.num_docs == 20, (
+                f"copy on {nid} (primary={shard.routing.primary}) "
+                f"searcher sees {reader.num_docs}/20 docs — "
+                f"green-but-empty regression")
+
+    # and distributed searches through EITHER survivor return everything
+    for nid, n in c.nodes.items():
+        if nid == victim or n.coordinator.stopped:
+            continue
+        resp = c.call(n.client_search, "vis",
+                      {"query": {"match_all": {}}, "size": 0})
+        assert resp["hits"]["total"]["value"] == 20, \
+            f"search via {nid} lost docs: {resp['hits']['total']}"
+
+
 def test_write_through_any_node_routes_to_primary(cluster):
     c = cluster
     c.any_node().client_create_index(
