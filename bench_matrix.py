@@ -863,33 +863,86 @@ def run_small_batch_serving(n: int = 1_000_000, d: int = 128):
 
 
 def run_sharded_fused():
-    """Config 6: the serving-path SPMD fused merge on a >=2-way sharded
-    corpus — one compiled program per search, ICI all-gather merge
-    (parallel/sharded_knn.py in the serving path). On a single-chip host
-    this measures nothing distributed, so it reports skipped instead of a
-    misleading number."""
+    """Config 6: the mesh-sharded serving path (PR 5) — exact kNN, IVF,
+    and the fused hybrid plan each executing as ONE shard_map program
+    with an ICI all-gather merge, plus parity-vs-single-device on every
+    variant. On a <2-device host the config re-execs itself in a
+    subprocess with 8 virtual XLA host devices and labels every row
+    `simulated_mesh: true` — those rows validate program structure
+    (partitioning, merge, compile-cache behavior), NOT ICI bandwidth, so
+    their qps/p50 columns are not comparable to real-mesh captures."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        _sharded_rows(
+            simulated=os.environ.get("BENCH_MESH_CHILD") == "1")
+        return
+    if os.environ.get("BENCH_MESH_CHILD") == "1":
+        # the re-exec failed to take (XLA flag landed after backend init)
+        print(json.dumps({"config": "6_sharded_fused_spmd",
+                          "error": "simulated mesh re-exec still sees "
+                                   f"{n_dev} device(s)"}), flush=True)
+        return
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_MESH_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded-only"],
+        env=env, capture_output=True, text=True, timeout=3600)
+    emitted = 0
+    for line in proc.stdout.splitlines():
+        try:
+            row = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            print(line, file=sys.stderr, flush=True)
+            continue
+        row["simulated_mesh"] = True
+        print(json.dumps(row), flush=True)
+        emitted += 1
+    if proc.returncode != 0 or emitted == 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or [""]
+        print(json.dumps({"config": "6_sharded_fused_spmd",
+                          "error": "simulated mesh subprocess failed "
+                                   f"(rc={proc.returncode})",
+                          "stderr_tail": tail[0][:200]}), flush=True)
+
+
+def _sharded_rows(simulated: bool):
+    """The config-6 measurement body; runs under a jax that sees >=2
+    devices (a real mesh, or the forced-host-device-count child)."""
+    import os
+
     import jax
     import jax.numpy as jnp
 
-    n_dev = len(jax.devices())
-    if n_dev < 2:
-        print(json.dumps({"config": "6_sharded_fused_spmd",
-                          "skipped": f"needs >=2 devices, have {n_dev}"}),
-              flush=True)
-        return
+    from elasticsearch_tpu.ops import knn as knn_ops
     from elasticsearch_tpu.parallel import mesh as mesh_lib
     from elasticsearch_tpu.parallel.sharded_knn import (
-        build_sharded_corpus, distributed_knn_search)
+        ShardedFieldState, distributed_knn_search)
 
-    n, d = 1_000_000, 128
-    shards = min(n_dev, 8)
+    small = simulated or os.environ.get("BENCH_SMALL") == "1"
+    shards = min(len(jax.devices()), 8)
+    mesh = mesh_lib.make_mesh(num_shards=shards, dp=1)
+    base = {"shards": shards, "merge": "ici_all_gather_one_program"}
+    if simulated:
+        # program-structure capture on virtual host devices: says so on
+        # the row (BENCH methodology: no ICI, don't compare throughput)
+        base["measures"] = "program_structure_not_ici"
+
+    # -- exact kNN -------------------------------------------------------
+    n, d = (131_072 if small else 1_000_000), 128
     rng = np.random.default_rng(11)
     centers = rng.standard_normal((128, d)).astype(np.float32) * 2.0
     vectors = (centers[rng.integers(0, 128, size=n)]
                + rng.standard_normal((n, d)).astype(np.float32))
-    mesh = mesh_lib.make_mesh(num_shards=shards, dp=1)
-    corpus, layout = build_sharded_corpus(vectors, mesh, metric="cosine",
-                                          dtype="bf16")
+    state = ShardedFieldState(vectors, mesh, "cosine", "bf16")
     nq = BATCH * 16
     queries = (vectors[rng.integers(0, n, size=nq)]
                + 0.3 * rng.standard_normal((nq, d)).astype(np.float32))
@@ -897,18 +950,159 @@ def run_sharded_fused():
     def fn(qb, c, kk):
         return distributed_knn_search(qb, c, kk, mesh, metric="cosine")
 
-    qps, marginal, p50, p99, ids = _measure(
-        _scan_searcher(fn), corpus, queries, d, n_small=4, n_large=16)
-    print(json.dumps({"config": "6_sharded_fused_spmd", "qps": round(qps, 1),
+    qps, marginal, p50, p99, _ = _measure(
+        _scan_searcher(fn), state.corpus, queries, d, n_small=4,
+        n_large=16)
+    # parity leg runs through the DISPATCHED path (the one serving uses)
+    q0 = jax.device_put(jnp.asarray(queries[:BATCH]),
+                        state.query_sharding())
+    s_mesh, gids = distributed_knn_search(q0, state.corpus, K, mesh,
+                                          metric="cosine")
+    rows_mesh = state.map_ids(np.asarray(gids))
+    one_corpus = knn_ops.build_corpus(vectors, metric="cosine",
+                                      dtype="bf16")
+    s_one, rows_one = knn_ops.knn_search(
+        jnp.asarray(queries[:BATCH]), one_corpus, k=K, metric="cosine")
+    parity = bool(np.array_equal(rows_mesh, np.asarray(rows_one)))
+    print(json.dumps({"config": "6_sharded_fused_spmd",
+                      "qps": round(qps, 1),
                       "batch_ms": round(marginal * 1000, 3),
                       "p50_ms": round(p50, 1), "p99_ms": round(p99, 1),
-                      "n_docs": n, "dims": d, "shards": shards,
-                      "merge": "ici_all_gather_one_program"}), flush=True)
+                      "n_docs": n, "dims": d, "dtype": "bf16",
+                      "parity_vs_single_device": parity,
+                      "recall_vs_single_device": round(
+                          _recall(rows_mesh, np.asarray(rows_one)), 4),
+                      **base}), flush=True)
+    del state, one_corpus, vectors
+
+    # -- IVF -------------------------------------------------------------
+    from elasticsearch_tpu.ann import IVFRouter, build_ivf_index
+
+    n_ivf, nlist = (32_768, 128) if small else (1_000_000, 1024)
+    vectors = (centers[rng.integers(0, 128, size=n_ivf)]
+               + rng.standard_normal((n_ivf, d)).astype(np.float32))
+    index = build_ivf_index(vectors, metric="cosine", nlist=nlist, seed=0)
+    router = IVFRouter(index, nprobe="auto")
+    nprobe = router.effective_nprobe(K)
+    qs = (vectors[rng.integers(0, n_ivf, size=BATCH)]
+          + 0.3 * rng.standard_normal((BATCH, d)).astype(np.float32))
+    s_mesh, rows_mesh, phases = router.search(qs, K, nprobe=nprobe,
+                                              mesh=mesh)
+    mark = _dispatch_mark()
+    lats = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        s_mesh, rows_mesh, phases = router.search(qs, K, nprobe=nprobe,
+                                                  mesh=mesh)
+        lats.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.percentile(lats, 50))
+    disp = _dispatch_delta(mark)  # before the single-device parity leg
+    s_one, rows_one, _ = router.search(qs, K, nprobe=nprobe)
+    print(json.dumps({"config": "6_sharded_ivf",
+                      "qps": round(BATCH / (p50 / 1000), 1),
+                      "p50_ms": round(p50, 1),
+                      "p99_ms": round(float(np.percentile(lats, 99)), 1),
+                      "n_docs": n_ivf, "dims": d, "nlist": nlist,
+                      "nprobe": nprobe, "engine": phases.get("engine"),
+                      "parity_vs_single_device": bool(
+                          np.array_equal(rows_mesh, rows_one)
+                          and s_mesh.tobytes() == s_one.tobytes()),
+                      "dispatch": disp, **base}),
+          flush=True)
+    del index, router, vectors
+
+    # -- hybrid (BM25 + kNN + RRF through Node.search) -------------------
+    import tempfile
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.parallel import policy
+
+    n_docs, dims = (4_000, 64) if small else (100_000, 768)
+    policy.reset(full=True)
+    policy.configure(enabled=True, num_shards=shards, min_rows=1)
+    node = Node(tempfile.mkdtemp())
+    try:
+        node.create_index_with_templates(
+            "hybrid", mappings={"properties": {
+                "body": {"type": "text"},
+                "v": {"type": "dense_vector", "dims": dims}}})
+        vocab = np.array([f"tok{i}" for i in range(5_000)])
+        zipf = (rng.zipf(1.25, size=n_docs * 8) - 1) % 5_000
+        pos = 0
+        for c0 in range(0, n_docs, 2000):
+            ops = []
+            for i in range(c0, min(c0 + 2000, n_docs)):
+                ops.append({"index": {"_index": "hybrid",
+                                      "_id": str(i)}})
+                ops.append({"body": " ".join(vocab[zipf[pos:pos + 8]]),
+                            "v": rng.standard_normal(dims)
+                            .astype(np.float32).tolist()})
+                pos += 8
+            node.bulk(ops)
+        node.indices.get("hybrid").force_merge()
+
+        def rand_body():
+            terms = vocab[(rng.zipf(1.25, size=2) - 1) % 5_000]
+            return {"rank": {"rrf": {"rank_constant": 60,
+                                     "rank_window_size": 50}},
+                    "query": {"match": {"body": " ".join(terms)}},
+                    "knn": {"field": "v",
+                            "query_vector": rng.standard_normal(dims)
+                            .astype(np.float32).tolist(),
+                            "k": 50, "num_candidates": 50},
+                    "size": 10, "_source": False}
+
+        bodies = [rand_body() for _ in range(30)]
+        for b in bodies[:5]:
+            node.search("hybrid", json.loads(json.dumps(b)))
+        mark = _dispatch_mark()
+        mesh_before = policy.stats()
+        lats, mesh_resps = [], []
+        for b in bodies:
+            t0 = time.perf_counter()
+            mesh_resps.append(node.search("hybrid",
+                                          json.loads(json.dumps(b))))
+            lats.append((time.perf_counter() - t0) * 1000)
+        mesh_routes = (policy.stats()["router"]["mesh"]
+                       - mesh_before["router"]["mesh"])
+        disp = _dispatch_delta(mark)  # before the single-device replay
+        # parity: identical bodies with the mesh router off must produce
+        # byte-identical responses (modulo took)
+        policy.configure(enabled=False)
+        parity = True
+        for b, mresp in zip(bodies, mesh_resps):
+            oresp = node.search("hybrid", json.loads(json.dumps(b)))
+            mresp, oresp = dict(mresp), dict(oresp)
+            mresp.pop("took", None), oresp.pop("took", None)
+            if json.dumps(mresp, sort_keys=True) != \
+                    json.dumps(oresp, sort_keys=True):
+                parity = False
+                break
+        print(json.dumps({
+            "config": "6_sharded_hybrid_rrf",
+            "qps": round(len(bodies) / (sum(lats) / 1000), 1),
+            "p50_ms": round(float(np.percentile(lats, 50)), 2),
+            "p99_ms": round(float(np.percentile(lats, 99)), 2),
+            "n_docs": n_docs, "dims": dims,
+            "mesh_routed_legs": mesh_routes,
+            "parity_vs_single_device": parity,
+            "execution": "fused_hybrid_plan_spmd",
+            "dispatch": disp, **base}), flush=True)
+    finally:
+        node.close()
+        policy.reset(full=True)
 
 
 def main():
     import os
+    import sys
     import traceback
+
+    if "--sharded-only" in sys.argv:
+        # the simulated-mesh child re-exec (run_sharded_fused): emit the
+        # config-6 rows only, on whatever device mesh this process sees
+        run_sharded_fused()
+        return
 
     small = os.environ.get("BENCH_SMALL") == "1"
 

@@ -87,6 +87,7 @@ class IVFIndex:
         self.displaced = 0    # rows not in their first-choice partition
         self.spilled = 0      # rows that found no capacity at all
         self._device = None   # lazy IVFPartitions pytree
+        self._device_sharded = None   # lazy (mesh, ShardedIVF) pair
 
     # ------------------------------------------------------------- build
 
@@ -182,6 +183,7 @@ class IVFIndex:
                     else:
                         self.spilled += 1
         self._device = None
+        self._device_sharded = None
 
     def add(self, vecs: np.ndarray, rows: np.ndarray) -> None:
         """Incremental add (post-build refresh delta): place into the host
@@ -226,6 +228,20 @@ class IVFIndex:
             part_sq=jnp.asarray(part_sq.astype(np.float32)),
             part_rows=jnp.asarray(self.part_rows))
         return self._device
+
+    def device_partitions_sharded(self, mesh):
+        """The mesh-sharded pytree (`parallel/sharded_ivf.ShardedIVF`):
+        posting lists split over the shard axis by partition id,
+        centroids replicated. Cached per layout generation like the
+        single-device pytree; invalidated by any add()."""
+        if (self._device_sharded is not None
+                and self._device_sharded[0] is mesh):
+            return self._device_sharded[1]
+        from elasticsearch_tpu.parallel.sharded_ivf import (
+            build_sharded_partitions)
+        sharded = build_sharded_partitions(self, mesh)
+        self._device_sharded = (mesh, sharded)
+        return sharded
 
 
 def pick_nlist(n: int, dims: int) -> int:

@@ -36,6 +36,22 @@ import numpy as np
 from elasticsearch_tpu.ann.ivf_index import IVFIndex
 
 
+def _pad_back_k(scores, rows, k: int, k_dev: int):
+    """Widen device results [Q, k_dev] back to the requested [Q, k]
+    with the empty-slot sentinels (-inf, -1) — the probed-row budget
+    caps what the kernels can return. Shared by the single-device and
+    mesh paths so the result contract can never diverge."""
+    scores_np = np.asarray(scores)
+    rows_np = np.asarray(rows)
+    if k_dev < k:
+        pad = k - k_dev
+        scores_np = np.pad(scores_np, ((0, 0), (0, pad)),
+                           constant_values=-np.inf)
+        rows_np = np.pad(rows_np, ((0, 0), (0, pad)),
+                         constant_values=-1)
+    return scores_np, rows_np
+
+
 class IVFRouter:
     """One field's IVF engine instance (wraps the layout + tuning state)."""
 
@@ -144,14 +160,7 @@ class IVFRouter:
                                             metric=idx.metric)
         rows.block_until_ready()
         t2 = time.perf_counter_ns()
-        scores_np = np.asarray(scores)
-        rows_np = np.asarray(rows)
-        if k_dev < k:  # pad back to the requested width
-            pad = k - k_dev
-            scores_np = np.pad(scores_np, ((0, 0), (0, pad)),
-                               constant_values=-np.inf)
-            rows_np = np.pad(rows_np, ((0, 0), (0, pad)),
-                             constant_values=-1)
+        scores_np, rows_np = _pad_back_k(scores, rows, k, k_dev)
         t3 = time.perf_counter_ns()
         phases = {"engine": "tpu_ivf", "nprobe": nprobe,
                   "nlist": idx.nlist,
@@ -160,14 +169,67 @@ class IVFRouter:
                   "merge_nanos": t3 - t2}
         return scores_np, rows_np, phases
 
+    def _mesh_search(self, queries: np.ndarray, k: int, nprobe: int,
+                     mesh):
+        """SPMD execution: one compiled program routes on replicated
+        centroids, scores each shard's owned partitions, and merges the
+        [S, Q, k] candidates over ICI (`parallel/sharded_ivf.py`). Same
+        result contract as `_device_search` — row ids are flat
+        device-corpus rows either way."""
+        import jax
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops import knn_ivf
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel import policy
+        from elasticsearch_tpu.parallel.sharded_ivf import (
+            sharded_ivf_search)
+
+        idx = self.index
+        nprobe = max(1, min(nprobe, idx.nlist))
+        t0 = time.perf_counter_ns()
+        sivf = idx.device_partitions_sharded(mesh)
+        # prep on device with the single-device recipe (bitwise-identical
+        # routing scores), then re-lay out across the mesh WITHOUT a
+        # host round-trip — np.asarray here would sync and re-upload the
+        # whole query batch per dispatch
+        q = knn_ivf._prep_queries(
+            jnp.asarray(np.asarray(queries, dtype=np.float32)),
+            idx.metric)
+        q = jax.device_put(q, mesh_lib.query_sharding(mesh))
+        k_dev = min(k, nprobe * idx.cap)
+        scores, rows = sharded_ivf_search(q, sivf, k_dev, nprobe, mesh,
+                                          metric=idx.metric)
+        rows.block_until_ready()
+        t1 = time.perf_counter_ns()
+        scores_np, rows_np = _pad_back_k(scores, rows, k, k_dev)
+        t2 = time.perf_counter_ns()
+        n_shards = int(mesh.shape[mesh_lib.SHARD_AXIS])
+        gather = policy.gather_bytes(n_shards, len(queries), k_dev)
+        policy.record_leg("ivf", t1 - t0, t2 - t1, gather)
+        phases = {"engine": "tpu_ivf_mesh", "nprobe": nprobe,
+                  "nlist": idx.nlist, "mesh_shards": n_shards,
+                  "scored_rows": nprobe * idx.cap,
+                  "collective_bytes": gather,
+                  # route + score + merge run inside ONE SPMD program;
+                  # the in-program split is not observable from the host
+                  "route_nanos": 0, "score_nanos": t1 - t0,
+                  "merge_nanos": t2 - t1}
+        return scores_np, rows_np, phases
+
     def search(self, queries: np.ndarray, k: int,
                nprobe: Optional[int] = None,
-               num_candidates: Optional[int] = None):
+               num_candidates: Optional[int] = None,
+               mesh=None):
         """Pruned top-k over the partition layout.
 
         num_candidates (the `_search` knn API knob) widens probing the way
         ef does for HNSW: enough partitions are probed that at least that
         many rows get scored.
+
+        mesh: a (dp, shard) serving mesh to execute on as one SPMD
+        program (the store's mesh router passes it); None = the
+        single-device two-dispatch path.
 
         Returns (scores [Q, k], rows [Q, k], phases). Callers decide
         fallback beforehand via `should_fallback` — this always prunes.
@@ -186,7 +248,11 @@ class IVFRouter:
                 # "at least num_candidates rows" still holds.
                 nprobe = min(1 << (want - 1).bit_length(),
                              self.index.nlist)
-        scores, rows, phases = self._device_search(
-            np.asarray(queries, dtype=np.float32), k, nprobe)
+        if mesh is not None:
+            scores, rows, phases = self._mesh_search(
+                np.asarray(queries, dtype=np.float32), k, nprobe, mesh)
+        else:
+            scores, rows, phases = self._device_search(
+                np.asarray(queries, dtype=np.float32), k, nprobe)
         self.last_phases = phases
         return scores, rows, phases

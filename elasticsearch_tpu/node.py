@@ -164,8 +164,11 @@ class _MultiShardVectorStore:
 
     def _mesh_search(self, state, query_vector, k: int, filter_rows,
                      precision: str):
+        import jax
         import jax.numpy as jnp
 
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
         from elasticsearch_tpu.parallel.sharded_knn import (
             distributed_knn_search)
 
@@ -177,15 +180,24 @@ class _MultiShardVectorStore:
             for s, rm in enumerate(row_maps):
                 allowed = np.isin(rm, filter_rows)
                 m[s * per: s * per + len(rm)] = allowed
-            mask = jnp.asarray(m)
-        q = jnp.asarray(
-            np.asarray(query_vector, dtype=np.float32)[None, :])
+            mask = jax.device_put(
+                jnp.asarray(m),
+                mesh_lib.per_shard_sharding(state["mesh"]))
+        q = jax.device_put(
+            jnp.asarray(np.asarray(query_vector,
+                                   dtype=np.float32)[None, :]),
+            mesh_lib.query_sharding(state["mesh"]))
+        # k rounds up the dispatch ladder so request streams sweeping k
+        # reuse one compiled SPMD program per rung (prefixes are exact)
+        k_b = _dispatch.bucket_k(min(k, per), limit=per)
         scores, gids = distributed_knn_search(
-            q, state["corpus"], k, state["mesh"],
+            q, state["corpus"], k_b, state["mesh"],
             metric=state["metric"], filter_mask=mask, precision=precision)
-        scores = np.asarray(scores[0])
-        gids = np.asarray(gids[0])
-        valid = scores > -1e37
+        scores = np.asarray(scores[0])[:k]
+        gids = np.asarray(gids[0])[:k]
+        # padding/filtered slots come back (-inf, -1) — masked out
+        # before the ICI gather, so no aliased ids can reach this join
+        valid = (scores > -1e37) & (gids >= 0)
         scores, gids = scores[valid], gids[valid]
         out_rows = np.empty(len(gids), dtype=np.int64)
         keep = np.ones(len(gids), dtype=bool)
@@ -437,6 +449,26 @@ class Node:
             # node with no explicit setting must not clobber a policy an
             # earlier in-process node configured
             _dispatch.set_default_warmup(self._dispatch_warmup)
+        # mesh serving policy (parallel/policy.py): search.mesh.* settings
+        # pick the SPMD shard count and the per-corpus row floor the
+        # host-side router applies. Process-wide like the dispatcher —
+        # only an explicit setting reconfigures it (same clobber rule as
+        # warmup above).
+        mesh_keys = ("search.mesh.enabled", "search.mesh.num_shards",
+                     "search.mesh.min_rows")
+        if any(self.settings.get(key) is not None for key in mesh_keys):
+            from elasticsearch_tpu.parallel import policy as _mesh_policy
+            enabled = self.settings.get("search.mesh.enabled")
+            num_shards = self.settings.get("search.mesh.num_shards")
+            min_rows = self.settings.get("search.mesh.min_rows")
+            kwargs = {}
+            if enabled is not None:
+                kwargs["enabled"] = setting_bool(enabled)
+            if num_shards is not None:
+                kwargs["num_shards"] = int(num_shards)
+            if min_rows is not None:
+                kwargs["min_rows"] = int(min_rows)
+            _mesh_policy.configure(**kwargs)
         # set by the server bootstrap after native hardening runs; embedded
         # nodes have no hardening (reference: JNANatives.LOCAL_MLOCKALL)
         self.natives = None
@@ -2228,7 +2260,8 @@ class Node:
                 "evictions": self.caches.query.evictions},
             "knn": self._knn_stats_section(),
             "hybrid": self._hybrid_stats_section(),
-            "dispatch": self._dispatch_stats_section()}
+            "dispatch": self._dispatch_stats_section(),
+            "mesh": self._mesh_stats_section()}
         discovery_section = {
             "cluster_state_queue": {"total": 0, "pending": 0,
                                     "committed": 0},
@@ -2263,11 +2296,23 @@ class Node:
         from elasticsearch_tpu.ops import dispatch
         return dispatch.stats(per_bucket=True)
 
+    @staticmethod
+    def _mesh_stats_section() -> dict:
+        """Mesh-sharded serving counters (`parallel/policy.py`): shard
+        count, the host router's mesh-vs-single-device decisions (with
+        reasons), and per-leg SPMD timings + analytic all-gather bytes.
+        Process-wide like the dispatch section — one physical mesh serves
+        every index on this node."""
+        from elasticsearch_tpu.parallel import policy
+        return policy.stats()
+
     def _knn_stats_section(self) -> dict:
         """Vector-search engine counters summed over local shards: total
         searches, how many took the pruned tpu_ivf path vs fell back to
-        exhaustive, and cumulative per-phase device time."""
+        exhaustive (or rode the SPMD mesh), and cumulative per-phase
+        device time."""
         out = {"searches": 0, "ivf_searches": 0, "fallback_searches": 0,
+               "mesh_searches": 0,
                "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
         for svc in self.indices.indices.values():
             for shard in svc.shards:

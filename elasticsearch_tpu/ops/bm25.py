@@ -55,6 +55,28 @@ BM25_K1 = 1.2
 BM25_B = 0.75
 
 
+def _pad_query_bucket(tile_ids, boosts, required):
+    """Pad a planned query batch up to the dispatch bucket (the jit
+    specializes on Q, and a compile per distinct batch size would stall
+    serving — same motive as vectors/store._pad_batch). Pad queries
+    reference no tiles and require 1 match, so the required-mask keeps
+    their whole board at -inf. Shared by the single-board and sharded
+    scoring paths so their padding semantics can never diverge.
+    Returns (tile_ids, boosts, required, n_pad)."""
+    from elasticsearch_tpu.ops import dispatch
+    n_real = tile_ids.shape[0]
+    n_pad = dispatch.bucket_queries(n_real)
+    if n_pad == n_real:
+        return tile_ids, boosts, required, n_pad
+    pad = n_pad - n_real
+    tile_ids = np.concatenate(
+        [tile_ids, np.full((pad, tile_ids.shape[1]), -1, dtype=np.int32)])
+    boosts = np.concatenate(
+        [boosts, np.zeros((pad, boosts.shape[1]), dtype=np.float32)])
+    required = np.concatenate([required, np.ones(pad, dtype=np.int32)])
+    return tile_ids, boosts, required, n_pad
+
+
 def _pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -110,6 +132,8 @@ class LexicalField:
         self._seg_cache: Dict[int, _SegmentPostings] = {}
         self._device = None             # (slots, impacts[, scales]) jnp arrays
         self._device_version: tuple = ()
+        self._device_mesh = None        # mesh-replicated tile mirrors
+        self._device_mesh_key: tuple = ()
 
     # ------------------------------------------------------------- build
     def sync(self, reader) -> bool:
@@ -248,6 +272,26 @@ class LexicalField:
         self._device_version = self.version
         return self._device
 
+    def _device_arrays_mesh(self, mesh):
+        """Tile mirrors replicated across the serving mesh (the sharded
+        kernel reads every tile but scatter-adds only its own doc range,
+        so the CSR replicates while the score board shards)."""
+        if (self._device_mesh is not None
+                and self._device_mesh_key[0] == self.version
+                and self._device_mesh_key[1] is mesh):
+            return self._device_mesh
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(mesh, P())
+        slots, impacts, scales = self._device_arrays()
+        self._device_mesh = (
+            jax.device_put(slots, repl), jax.device_put(impacts, repl),
+            None if scales is None else jax.device_put(scales, repl))
+        # hold the mesh OBJECT (identity compare), not id(mesh): a GC'd
+        # mesh's address can be reused by a differently-shaped one
+        self._device_mesh_key = (self.version, mesh)
+        return self._device_mesh
+
     def plan_queries(self, queries: Sequence[Tuple[Sequence[str], float]]
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Resolve (terms, boost) per query to padded tile id / boost
@@ -302,24 +346,68 @@ class LexicalField:
                         scores[sel].astype(np.float32)))
         return out
 
+    def _score_device_mesh(self, tile_ids, boosts, required, k, mesh):
+        """Doc-range-sharded SPMD scoring: every shard scatter-adds the
+        replicated impact CSR into ITS slot range's board, local top-k,
+        all-gather merge (`bm25.mesh_topk`). Bit-identical sums to the
+        single-board kernel (same term-major add order per slot), ties
+        preserved (merge concatenates ascending shard = ascending slot
+        ranges). Returns None when the sharded program can't hold the
+        contract (ranked window deeper than a shard's slot range) — the
+        caller then runs the single-device board."""
+        import time as _time
+
+        from elasticsearch_tpu.ops import dispatch
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel import policy
+
+        n_shards = int(mesh.shape[mesh_lib.SHARD_AXIS])
+        width = _pow2(max(-(-self.n_slots // n_shards), 1))
+        k_req = min(k, max(self.n_slots, 1))
+        k_b = dispatch.bucket_k(k_req, limit=width)
+        if k_req > width:
+            return None
+        n_real = tile_ids.shape[0]
+        tile_ids, boosts, required, n_pad = _pad_query_bucket(
+            tile_ids, boosts, required)
+        slots_d, impacts_d, scales_d = self._device_arrays_mesh(mesh)
+        t0 = _time.perf_counter_ns()
+        vals, gslots = dispatch.call(
+            "bm25.mesh_topk", jnp.asarray(tile_ids), jnp.asarray(boosts),
+            jnp.asarray(required.astype(np.int32)), slots_d, impacts_d,
+            scales_d, k=k_b, width=width, mesh=mesh)
+        vals = np.asarray(vals)[:, :k_req]
+        gslots = np.asarray(gslots)[:, :k_req]
+        t1 = _time.perf_counter_ns()
+        out = []
+        for qi in range(n_real):
+            v, si = vals[qi], gslots[qi]
+            keep = (v > -np.inf) & (si >= 0) & (si < self.n_slots)
+            v, si = v[keep], si[keep]
+            out.append((self.row_map[si], v.astype(np.float32)))
+        t2 = _time.perf_counter_ns()
+        policy.record_leg("bm25", t1 - t0, t2 - t1,
+                          policy.gather_bytes(n_shards, n_pad, k_b))
+        return out
+
     def _score_device(self, tile_ids, boosts, required, k):
         from elasticsearch_tpu.ops import dispatch
+        from elasticsearch_tpu.parallel import policy
+
+        mesh = policy.decide("bm25", self.n_slots)
+        if mesh is not None:
+            out = self._score_device_mesh(tile_ids, boosts, required, k,
+                                          mesh)
+            if out is not None:
+                return out
+            # ranked window deeper than one shard's slot range: the
+            # sharded merge would be lossy, so this dispatch ran
+            # single-device after all — keep the router stats honest
+            policy.reclassify_single("bm25_window_deeper_than_shard")
 
         n_real = tile_ids.shape[0]
-        n_pad = dispatch.bucket_queries(n_real)
-        if n_pad != n_real:
-            # query-count padding, same motive as vectors/store._pad_batch:
-            # the jit specializes on Q, and a compile per distinct batch
-            # size would stall serving
-            pad = n_pad - n_real
-            tile_ids = np.concatenate(
-                [tile_ids, np.full((pad, tile_ids.shape[1]), -1,
-                                   dtype=np.int32)])
-            boosts = np.concatenate(
-                [boosts, np.zeros((pad, boosts.shape[1]),
-                                  dtype=np.float32)])
-            required = np.concatenate(
-                [required, np.ones(pad, dtype=np.int32)])
+        tile_ids, boosts, required, n_pad = _pad_query_bucket(
+            tile_ids, boosts, required)
         slots_d, impacts_d, scales_d = self._device_arrays()
         # score-board width pads to a pow2 bucket: n_slots changes on
         # every refresh, and a jit re-specialization per refresh would
@@ -441,12 +529,105 @@ def _grid_bm25(statics, sigs) -> bool:
             and dispatch.in_k_grid(int(statics["k"]), limit=w))
 
 
+def _bm25_topk_sharded(tile_ids, boosts, required, tile_slots,
+                       tile_impacts, tile_scales, k: int, width: int,
+                       mesh):
+    """Doc-range-sharded BM25 window: shard s owns global slots
+    [s*width, (s+1)*width); each shard scans the SAME replicated tiles
+    but scatter-adds only its own range into a local [Q, width+1] board
+    (allocated in-program — no donated transient), masks by match count,
+    takes a local top-k, and the [S, Q, k] candidates merge over ICI.
+
+    Per-slot accumulation order is the single-board kernel's (term-major
+    in query order), so scores are bit-identical; the merge concatenates
+    shards in ascending slot-range order, so score ties still resolve to
+    the ascending global slot — `native.topk`'s convention.
+
+    Cost shape: the tile SCAN is replicated on every shard (only the
+    score board and its top-k shard), so this wins on board-bound
+    workloads (large n_slots) and is roughly flat on scatter-bound ones;
+    partitioning the tiles themselves by doc range is the follow-up that
+    would shard the scan too."""
+    from elasticsearch_tpu.ops.topk import merge_top_k
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    from elasticsearch_tpu.parallel.sharded_knn import shard_map
+
+    def body_shard(tids, bsts, req, t_slots, t_impacts, t_scales):
+        nq = tids.shape[0]
+        shard_id = jax.lax.axis_index(mesh_lib.SHARD_AXIS)
+        lo = shard_id * width
+        qi = jnp.arange(nq)
+        scores0 = jnp.zeros((nq, width + 1), dtype=jnp.float32)
+        counts0 = jnp.zeros((nq, width + 1), dtype=jnp.int32)
+
+        def step(carry, inp):
+            scores, counts = carry
+            tid, b = inp
+            safe = jnp.maximum(tid, 0)
+            slots = t_slots[safe]                      # [Q, TILE] global
+            imp = t_impacts[safe].astype(jnp.float32)
+            if t_scales is not None:
+                imp = imp * t_scales[safe][:, None]
+            imp = imp * b[:, None]
+            local = slots - lo
+            valid = ((tid >= 0)[:, None] & (slots >= 0)
+                     & (local >= 0) & (local < width))
+            tgt = jnp.where(valid, local, width)
+            scores = scores.at[qi[:, None], tgt].add(
+                jnp.where(valid, imp, 0.0))
+            counts = counts.at[qi[:, None], tgt].add(
+                jnp.where(valid, 1, 0))
+            return (scores, counts), None
+
+        (scores, counts), _ = jax.lax.scan(
+            step, (scores0, counts0), (tids.T, bsts.T))
+        sc = scores[:, :width]
+        ct = counts[:, :width]
+        masked = jnp.where(ct >= jnp.maximum(req, 1)[:, None],
+                           sc, -jnp.inf)
+        vals, idx = jax.lax.top_k(masked, k)
+        gslots = jnp.where(vals > -jnp.inf, idx + lo, -1)
+        all_v = jax.lax.all_gather(vals, mesh_lib.SHARD_AXIS)
+        all_s = jax.lax.all_gather(gslots, mesh_lib.SHARD_AXIS)
+        return merge_top_k(all_v, all_s, k)
+
+    repl = jax.sharding.PartitionSpec()
+    r2 = jax.sharding.PartitionSpec(None, None)
+    in_specs = (r2, r2, repl, r2, r2)
+    if tile_scales is None:
+        def run(tids, bsts, req, t_slots, t_impacts):
+            return body_shard(tids, bsts, req, t_slots, t_impacts, None)
+        fn = shard_map(run, mesh=mesh, in_specs=in_specs,
+                       out_specs=(r2, r2))
+        return fn(tile_ids, boosts, required, tile_slots, tile_impacts)
+    # tile_scales is rank-1 [T]: a rank-2 spec would be rejected by
+    # shard_map's rank check
+    fn = shard_map(body_shard, mesh=mesh,
+                   in_specs=in_specs + (repl,), out_specs=(r2, r2))
+    return fn(tile_ids, boosts, required, tile_slots, tile_impacts,
+              tile_scales)
+
+
+def _grid_bm25_mesh(statics, sigs) -> bool:
+    """Bucketed query count, pow-2 per-shard board width, k on the
+    ladder (or clamped to the shard width)."""
+    from elasticsearch_tpu.ops import dispatch
+    nq = sigs[0][0][0]                # tile_ids [Q, M]
+    w = int(statics["width"])
+    return (dispatch.is_query_bucket(nq)
+            and w >= 1 and (w & (w - 1)) == 0
+            and dispatch.in_k_grid(int(statics["k"]), limit=w))
+
+
 def _register_bm25():
     from elasticsearch_tpu.ops import dispatch
     dispatch.DISPATCH.register("bm25.topk", _bm25_topk,
                                static_argnames=("k",),
                                donate_argnums=(0, 1),
                                grid_check=_grid_bm25)
+    dispatch.DISPATCH.register("bm25.mesh_topk", _bm25_topk_sharded,
+                               static_argnames=("k", "width", "mesh"),
+                               grid_check=_grid_bm25_mesh)
 
 
 _register_bm25()

@@ -204,7 +204,20 @@ def _leaf_sig(x) -> Any:
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)
     if shape is not None and dtype is not None:
-        return (tuple(shape), str(dtype))
+        # an AOT executable bakes its input shardings at lower() time, so
+        # a mesh-sharded array and a single-device array of identical
+        # shape must key to DIFFERENT executables. Only NamedShardings
+        # (mesh layouts) join the key: host numpy, single-device arrays,
+        # and sharding-less ShapeDtypeStructs all normalize to None so
+        # warmup specs keep hitting the entries serving calls use.
+        sharding = getattr(x, "sharding", None)
+        try:
+            from jax.sharding import NamedSharding
+            if not isinstance(sharding, NamedSharding):
+                sharding = None
+        except Exception:
+            sharding = None
+        return (tuple(shape), str(dtype), sharding)
     return ("py", type(x).__name__, x if isinstance(
         x, (int, float, bool, str, bytes, type(None))) else id(x))
 

@@ -1,0 +1,237 @@
+"""Host-side mesh routing policy: single-device vs SPMD per dispatch.
+
+The reference routes every search through a coordinator that fans out to
+however many shards the index was created with — shard count is a static
+index property. Here the analogous decision is DYNAMIC and per dispatch:
+a corpus small enough that one chip's matmul beats the all-gather merge
+should stay on one device, a corpus at HBM scale must spread. This module
+owns that decision for every serving leg (exact kNN, IVF, BM25), plus the
+process-wide serving mesh the sharded kernels execute on, and the
+counters `_nodes/stats indices.mesh` / `profile.mesh` report.
+
+Settings (read once at node boot, `node.py` calls `configure`):
+
+  search.mesh.enabled      true | false | unset (auto: mesh when >1
+                           device is visible)
+  search.mesh.num_shards   mesh shard-axis size (default: all visible
+                           devices)
+  search.mesh.min_rows     corpora below this many rows stay
+                           single-device (the all-gather merge + per-leg
+                           SPMD overhead only pays for itself once the
+                           local matmul dominates; default 32768)
+
+The policy is process-wide like `ops/dispatch.DISPATCH` — one physical
+mesh serves every index on the node, so per-index state would only
+duplicate the counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# below this many corpus rows the single-device program wins: the sharded
+# program's fixed costs (S-way dispatch, [S, Q, k] all-gather, merge) are
+# corpus-size independent, while the local matmul saving scales with rows
+DEFAULT_MIN_ROWS = 32_768
+
+_lock = threading.Lock()
+_cfg = {"enabled": None, "num_shards": None, "min_rows": DEFAULT_MIN_ROWS}
+_mesh = None          # cached jax Mesh (built lazily)
+_mesh_built = False   # latch: None is a valid cache value (no mesh)
+
+_counters = {
+    "decisions_mesh": 0,
+    "decisions_single_device": 0,
+    "searches": {"knn": 0, "ivf": 0, "bm25": 0},
+    "reasons": {},            # reason -> count (single-device routes)
+    # per-leg timing: local = the SPMD program (shard-local score + ICI
+    # merge, one compiled unit), merge = host-side result shaping
+    "legs": {},               # leg -> {local_nanos, merge_nanos,
+                              #         collective_bytes, dispatches}
+}
+
+
+_UNSET = object()
+
+
+def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET) -> None:
+    """Install `search.mesh.*` settings. PARTIAL update: only the
+    keyword arguments the caller passes change — a node that sets one
+    key must not clobber the others an earlier in-process node
+    configured (same rule as the dispatcher's warmup policy). Passing
+    None explicitly resets that key to auto/default. Drops the cached
+    mesh so the next dispatch rebuilds against the new config."""
+    global _mesh, _mesh_built
+    with _lock:
+        if enabled is not _UNSET:
+            _cfg["enabled"] = enabled
+        if num_shards is not _UNSET:
+            _cfg["num_shards"] = (int(num_shards)
+                                  if num_shards is not None else None)
+        if min_rows is not _UNSET:
+            _cfg["min_rows"] = (int(min_rows) if min_rows is not None
+                                else DEFAULT_MIN_ROWS)
+        _mesh, _mesh_built = None, False
+
+
+def min_rows() -> int:
+    return _cfg["min_rows"]
+
+
+def serving_mesh():
+    """The process-wide (dp=1, shard=S) serving mesh, or None when mesh
+    execution is off (disabled, or fewer than 2 usable devices)."""
+    global _mesh, _mesh_built
+    with _lock:
+        if _mesh_built:
+            return _mesh
+    mesh = None
+    if _cfg["enabled"] is not False:
+        try:
+            import jax
+
+            from elasticsearch_tpu.parallel import mesh as mesh_lib
+            n_dev = len(jax.devices())
+            n = _cfg["num_shards"] if _cfg["num_shards"] else n_dev
+            n = min(n, n_dev)
+            if n >= 2:
+                mesh = mesh_lib.make_mesh(num_shards=n, dp=1)
+        except Exception:
+            # the latch below caches this None for the process lifetime:
+            # without a log line a multi-chip node would silently serve
+            # single-device until restart (stats only show available:
+            # false, not why)
+            logger.warning("mesh serving disabled: serving-mesh build "
+                           "failed (latched off until restart or "
+                           "reconfigure)", exc_info=True)
+            mesh = None
+    with _lock:
+        if _mesh_built:
+            # another thread won the build race: keep ITS object — the
+            # identity-compared caches (store append path, lexical
+            # mesh-CSR, sharded IVF pytree) all key on the cached mesh,
+            # and caching a second equal-but-distinct Mesh would force
+            # each of them through one redundant corpus re-upload
+            return _mesh
+        _mesh, _mesh_built = mesh, True
+        return _mesh
+
+
+def num_shards() -> int:
+    mesh = serving_mesh()
+    if mesh is None:
+        return 0
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    return mesh.shape[mesh_lib.SHARD_AXIS]
+
+
+def eligible(n_rows: int) -> bool:
+    """Build-time check (no decision counted): is this corpus one the
+    router could ever send to the mesh? Gates the sharded upload at
+    refresh so small indexes never pay the second resident copy."""
+    return (n_rows >= _cfg["min_rows"] and _cfg["enabled"] is not False
+            and serving_mesh() is not None)
+
+
+def decide(leg: str, n_rows: int, has_mesh_state: bool = True):
+    """Route one serving dispatch: returns the mesh to execute on, or
+    None for single-device. Counts the decision (the router half of
+    `_nodes/stats indices.mesh`)."""
+    mesh = serving_mesh()
+    reason = None
+    if mesh is None:
+        reason = "no_mesh"
+    elif not has_mesh_state:
+        reason = "no_sharded_corpus"
+    elif n_rows < _cfg["min_rows"]:
+        reason = "corpus_below_min_rows"
+    with _lock:
+        _counters["searches"][leg] = _counters["searches"].get(leg, 0) + 1
+        if reason is None:
+            _counters["decisions_mesh"] += 1
+            return mesh
+        _counters["decisions_single_device"] += 1
+        _counters["reasons"][reason] = \
+            _counters["reasons"].get(reason, 0) + 1
+        return None
+
+
+def reclassify_single(reason: str) -> None:
+    """A leg accepted a mesh route but discovered mid-leg that the
+    sharded program can't hold its result contract (e.g. a BM25 ranked
+    window deeper than one shard's slot range): move the already-counted
+    mesh decision over to single-device so the router stats reflect
+    where the dispatch actually ran."""
+    with _lock:
+        if _counters["decisions_mesh"] > 0:
+            _counters["decisions_mesh"] -= 1
+        _counters["decisions_single_device"] += 1
+        _counters["reasons"][reason] = \
+            _counters["reasons"].get(reason, 0) + 1
+
+
+def record_leg(leg: str, local_nanos: int, merge_nanos: int,
+               collective_bytes: int) -> None:
+    """Accumulate one sharded dispatch's timings: `local` is the SPMD
+    program (shard-local work + the in-program ICI merge), `merge` the
+    host-side result shaping, `collective_bytes` the analytic all-gather
+    payload (S * Q * k * (score + id bytes))."""
+    with _lock:
+        entry = _counters["legs"].setdefault(
+            leg, {"local_nanos": 0, "merge_nanos": 0,
+                  "collective_bytes": 0, "dispatches": 0})
+        entry["local_nanos"] += int(local_nanos)
+        entry["merge_nanos"] += int(merge_nanos)
+        entry["collective_bytes"] += int(collective_bytes)
+        entry["dispatches"] += 1
+
+
+def gather_bytes(n_shards: int, n_queries: int, k: int,
+                 bytes_per_slot: int = 8) -> int:
+    """Analytic all-gather payload of one [S, Q, k] candidate merge
+    (f32 score + int32 id = 8 bytes/slot by default)."""
+    return int(n_shards) * int(n_queries) * int(k) * int(bytes_per_slot)
+
+
+def stats() -> dict:
+    """`_nodes/stats indices.mesh` section."""
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    mesh = serving_mesh()
+    # shard-axis size, not devices.size: the two differ once dp > 1
+    n_shards = (0 if mesh is None
+                else int(mesh.shape[mesh_lib.SHARD_AXIS]))
+    with _lock:
+        return {
+            "available": mesh is not None,
+            "num_shards": n_shards,
+            "min_rows": _cfg["min_rows"],
+            "router": {
+                "mesh": _counters["decisions_mesh"],
+                "single_device": _counters["decisions_single_device"],
+                "reasons": dict(_counters["reasons"]),
+                "searches": dict(_counters["searches"]),
+            },
+            "legs": {leg: dict(v)
+                     for leg, v in sorted(_counters["legs"].items())},
+        }
+
+
+def reset(full: bool = False) -> None:
+    """Zero the counters (tests). full=True also drops the config and the
+    cached mesh back to auto defaults."""
+    global _mesh, _mesh_built
+    with _lock:
+        _counters["decisions_mesh"] = 0
+        _counters["decisions_single_device"] = 0
+        _counters["reasons"].clear()
+        _counters["legs"].clear()
+        for leg in _counters["searches"]:
+            _counters["searches"][leg] = 0
+        if full:
+            _cfg.update({"enabled": None, "num_shards": None,
+                         "min_rows": DEFAULT_MIN_ROWS})
+            _mesh, _mesh_built = None, False

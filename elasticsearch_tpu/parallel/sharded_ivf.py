@@ -1,0 +1,259 @@
+"""Mesh-sharded IVF: replicated centroids, shard-partitioned posting lists.
+
+The `tpu_ivf` engine's SPMD execution mode (PR 5). The partition layout
+(`ann/ivf_index.py`) splits over the mesh shard axis by PARTITION id —
+the IVF analog of the reference hash-sharding documents across nodes —
+while the tiny centroid matrix replicates everywhere:
+
+  route:  every shard computes the identical probe set from the
+          replicated centroids (no collective — routing is data-parallel
+          by construction),
+  score:  each shard scores only the probed partitions IT owns
+          (`pid // nlist_local == shard_id`); unowned probes mask to
+          NEG_INF exactly like empty partition slots,
+  merge:  `lax.all_gather` ships the [S, Q, k] local candidates over ICI
+          and every device computes the identical global top-k.
+
+Row ids in the layout are flat device-corpus rows (the same space the
+single-device kernel reports), so sharded results are byte-comparable to
+`ops/knn_ivf.score_probes` — the parity the tier-1 mesh suite pins.
+
+Executes through the shape-bucketed dispatch cache (kernel ``mesh.ivf``,
+executables keyed on (mesh, bucket)); steady-state sharded IVF traffic
+compiles nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticsearch_tpu.ops import dispatch
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.ops.similarity import NEG_INF
+from elasticsearch_tpu.parallel import mesh as mesh_lib
+from elasticsearch_tpu.parallel.sharded_knn import shard_map
+
+
+class ShardedIVF(NamedTuple):
+    """Device pytree of a partition layout laid out for a (dp, shard)
+    mesh. Same field semantics as `ops/knn_ivf.IVFPartitions`, except
+    `parts`/`part_*` are padded to a multiple of the shard count along
+    the partition axis (pad partitions hold row id -1 everywhere) and
+    row-sharded over it; `centroids`/`centroid_sq` stay UNPADDED and
+    replicated so routing scores are bitwise those of the single-device
+    kernel."""
+
+    centroids: jax.Array       # [nlist, D] replicated
+    centroid_sq: jax.Array     # [nlist] replicated
+    parts: jax.Array           # [nlist_pad, cap, D] sharded over "shard"
+    part_scales: jax.Array     # [nlist_pad, cap] sharded
+    part_sq: jax.Array         # [nlist_pad, cap] sharded
+    part_rows: jax.Array       # [nlist_pad, cap] int32 sharded; -1 pad
+
+
+def build_sharded_partitions(index, mesh: Mesh) -> ShardedIVF:
+    """Upload one `ann/ivf_index.IVFIndex` host mirror as a mesh-sharded
+    pytree. Quantization runs the exact `device_partitions` recipe over
+    the UNPADDED layout first, so every stored value is bitwise the
+    single-device copy's."""
+    from elasticsearch_tpu.ops.quantization import quantize_int8_np
+
+    S = mesh.shape[mesh_lib.SHARD_AXIS]
+    nlist, cap, dims = index.part_vecs.shape
+    nlist_pad = -(-nlist // S) * S
+
+    valid = index.part_rows >= 0
+    part_sq = np.einsum("kcd,kcd->kc", index.part_vecs, index.part_vecs)
+    if index.dtype == "int8":
+        flat = index.part_vecs.reshape(-1, dims)
+        q8, scales = quantize_int8_np(flat)
+        parts_host = q8.reshape(nlist, cap, dims)
+        scales_host = np.where(valid, scales.reshape(nlist, cap),
+                               0.0).astype(np.float32)
+        np_dtype = np.int8
+    else:
+        import ml_dtypes
+        np_dtype = (ml_dtypes.bfloat16 if index.dtype == "bf16"
+                    else np.float32)
+        parts_host = index.part_vecs.astype(np_dtype)
+        scales_host = valid.astype(np.float32)
+
+    def pad(a, fill=0):
+        if nlist_pad == nlist:
+            return a
+        out = np.full((nlist_pad,) + a.shape[1:], fill, dtype=a.dtype)
+        out[:nlist] = a
+        return out
+
+    repl = NamedSharding(mesh, P())
+    shard0 = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
+    return ShardedIVF(
+        centroids=jax.device_put(
+            index.centroids.astype(np.float32), repl),
+        centroid_sq=jax.device_put(
+            np.einsum("kd,kd->k", index.centroids, index.centroids)
+            .astype(np.float32), repl),
+        parts=jax.device_put(pad(parts_host), shard0),
+        part_scales=jax.device_put(pad(scales_host), shard0),
+        part_sq=jax.device_put(pad(part_sq.astype(np.float32)), shard0),
+        part_rows=jax.device_put(pad(index.part_rows, fill=-1), shard0))
+
+
+def _ivf_step(q, cents, cent_sq, parts, pscales, psq, prows, *, k, nprobe,
+              metric, precision):
+    """Per-shard body: replicated routing, owned-probe pruned scoring,
+    ICI candidate merge."""
+    from elasticsearch_tpu.ops.topk import merge_top_k
+
+    # route on the replicated centroids — identical probe ids everywhere
+    dots = jax.lax.dot_general(
+        q, cents, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if metric == sim.L2_NORM:
+        route_scores = sim.l2_raw_from_dots(dots, q, cent_sq)
+    else:
+        route_scores = dots
+    _, probe_ids = jax.lax.top_k(route_scores, nprobe)
+    probe_ids = probe_ids.astype(jnp.int32)
+
+    nq = q.shape[0]
+    nlist_local = parts.shape[0]
+    shard_id = jax.lax.axis_index(mesh_lib.SHARD_AXIS)
+    lo = shard_id * nlist_local
+    mm_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+    init = (jnp.full((nq, k), NEG_INF, dtype=jnp.float32),
+            jnp.full((nq, k), -1, dtype=jnp.int32))
+
+    def body(carry, pid):
+        best_s, best_i = carry
+        local_pid = pid - lo
+        owned = (local_pid >= 0) & (local_pid < nlist_local)
+        safe = jnp.clip(local_pid, 0, nlist_local - 1)
+        block = jnp.take(parts, safe, axis=0)          # [Q, cap, D]
+        rows = jnp.take(prows, safe, axis=0)           # [Q, cap]
+        dots = jnp.einsum(
+            "qd,qcd->qc", q.astype(mm_dtype), block.astype(mm_dtype),
+            preferred_element_type=jnp.float32)
+        if parts.dtype == jnp.int8:
+            dots = dots * jnp.take(pscales, safe, axis=0)
+        if metric == sim.L2_NORM:
+            part_sq_b = jnp.take(psq, safe, axis=0)
+            q_sq = jnp.sum(q * q, axis=-1, keepdims=True)
+            s = 2.0 * dots - q_sq - part_sq_b
+        else:
+            s = dots
+        keep = owned[:, None] & (rows >= 0)
+        s = jnp.where(keep, s, NEG_INF)
+        rows = jnp.where(keep, rows, -1)
+        cat_s = jnp.concatenate([best_s, s], axis=1)
+        cat_i = jnp.concatenate([best_i, rows], axis=1)
+        vals, pos = jax.lax.top_k(cat_s, k)
+        return (vals, jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+    (best_s, best_i), _ = jax.lax.scan(body, init, probe_ids.T)
+    all_s = jax.lax.all_gather(best_s, mesh_lib.SHARD_AXIS)  # [S, Q, k]
+    all_i = jax.lax.all_gather(best_i, mesh_lib.SHARD_AXIS)
+    return merge_top_k(all_s, all_i, k)
+
+
+def _sharded_ivf_impl(queries, sivf, k, nprobe, mesh,
+                      metric=sim.COSINE, precision="bf16"):
+    S = mesh_lib.SHARD_AXIS
+    in_specs = (
+        P(mesh_lib.DP_AXIS, None),
+        ShardedIVF(P(None, None), P(None), P(S, None, None),
+                   P(S, None), P(S, None), P(S, None)))
+    out_specs = (P(mesh_lib.DP_AXIS, None), P(mesh_lib.DP_AXIS, None))
+    step = functools.partial(_ivf_step, k=k, nprobe=nprobe, metric=metric,
+                             precision=precision)
+
+    def run(q, cents, cent_sq, parts, pscales, psq, prows):
+        return step(q, cents, cent_sq, parts, pscales, psq, prows)
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=(in_specs[0],) + tuple(in_specs[1]),
+                   out_specs=out_specs)
+    return fn(queries, sivf.centroids, sivf.centroid_sq, sivf.parts,
+              sivf.part_scales, sivf.part_sq, sivf.part_rows)
+
+
+def _grid_mesh_ivf(statics, sigs) -> bool:
+    """Bucketed query count, pow-2 nprobe (or full nlist), k on the
+    ladder or clamped to the probed-row budget — the same closed set the
+    single-device `ivf.*` kernels enforce."""
+    if not dispatch.is_query_bucket(sigs[0][0][0]):
+        return False
+    nlist = sigs[1][0][0]                   # centroids [nlist, D]
+    cap = sigs[3][0][1]                     # parts [nlist_pad, cap, D]
+    npro = int(statics["nprobe"])
+    k = int(statics["k"])
+    pow2_ok = npro == int(nlist) or (npro >= 1 and npro & (npro - 1) == 0)
+    return pow2_ok and dispatch.in_k_grid(k, limit=npro * int(cap))
+
+
+dispatch.DISPATCH.register(
+    "mesh.ivf", _sharded_ivf_impl,
+    static_argnames=("k", "nprobe", "mesh", "metric", "precision"),
+    grid_check=_grid_mesh_ivf)
+
+
+def sharded_ivf_search(queries: jax.Array, sivf: ShardedIVF, k: int,
+                       nprobe: int, mesh: Mesh, metric: str = sim.COSINE,
+                       precision: str = "bf16"):
+    """Pruned top-k over the mesh-sharded layout: ONE compiled program
+    (route + owned-probe score + all-gather merge).
+
+    queries: [Q, D] metric-prepped, Q divisible by the dp axis.
+    Returns (scores [Q, k], rows [Q, k] flat device-corpus row ids);
+    empty slots come back (NEG_INF, -1) — the single-device contract.
+    """
+    return dispatch.call("mesh.ivf", queries, sivf, k=k, nprobe=nprobe,
+                         mesh=mesh, metric=metric, precision=precision)
+
+
+def warmup_entries(index, mesh: Mesh, nprobe: int):
+    """Pre-compile the sharded IVF serving grid (the store's
+    warmup-at-sync hook). SHAPE-ONLY: the AOT specs derive from the
+    host layout via the same padding math as `build_sharded_partitions`,
+    so scheduling warmup never uploads the sharded pytree — the refresh
+    thread must not pay (and re-pay, since `IVFIndex.add` invalidates
+    the cached upload) a corpus-sized transfer per refresh. The actual
+    pytree build stays lazy on the first mesh-routed query, which then
+    finds its executable already compiled."""
+    S = mesh.shape[mesh_lib.SHARD_AXIS]
+    nlist, cap, dims = index.part_vecs.shape
+    nlist_pad = -(-nlist // S) * S
+    part_dtype = {"int8": jnp.int8, "bf16": jnp.bfloat16}.get(
+        index.dtype, jnp.float32)
+    repl = NamedSharding(mesh, P())
+    shard0 = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
+    spec = ShardedIVF(
+        jax.ShapeDtypeStruct((nlist, dims), jnp.float32, sharding=repl),
+        jax.ShapeDtypeStruct((nlist,), jnp.float32, sharding=repl),
+        jax.ShapeDtypeStruct((nlist_pad, cap, dims), part_dtype,
+                             sharding=shard0),
+        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32,
+                             sharding=shard0),
+        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32,
+                             sharding=shard0),
+        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.int32,
+                             sharding=shard0))
+    entries = []
+    for q in dispatch.WARMUP_QUERY_BUCKETS:
+        qspec = jax.ShapeDtypeStruct(
+            (q, dims), jnp.float32,
+            sharding=mesh_lib.query_sharding(mesh))
+        for kk in dispatch.WARMUP_K_BUCKETS:
+            k_b = dispatch.bucket_k(min(kk, nprobe * cap),
+                                    limit=nprobe * cap)
+            entries.append(("mesh.ivf", (qspec, spec),
+                            {"k": k_b, "nprobe": nprobe, "mesh": mesh,
+                             "metric": index.metric,
+                             "precision": "bf16"}))
+    return entries

@@ -3,16 +3,27 @@
 The reference's multi-shard search is a coordinator RPC fan-out
 (`AbstractSearchAsyncAction.performPhaseOnShard:214`) followed by a
 host-side heap merge (`SearchPhaseController.mergeTopDocs:221`). Here the
-whole scatter-gather collapses into a single pjit/shard_map program:
+whole scatter-gather collapses into a single shard_map program:
 
   1. each mesh column scores its corpus slice (local matmul + top-k),
-  2. local doc ids are rebased to global ids via the shard axis index,
+  2. local doc ids are rebased to global ids via the shard axis index
+     (padding rows are masked to -inf / id -1 BEFORE the gather, so a
+     ragged shard can never leak aliased ids into the merge),
   3. `lax.all_gather` over the "shard" axis moves the tiny [S, Q, k]
      candidate set across ICI,
   4. every device computes the identical global top-k merge.
 
 No host round-trip, no reduce thread, no `batched_reduce_size` staging — the
 merge cost is O(S·Q·k) on ICI, not O(network RPC).
+
+Serving integration (PR 5): the program executes through the shape-bucketed
+dispatch cache (`ops/dispatch.py`, kernel ``mesh.knn`` keyed on
+(mesh, bucket)), so steady-state sharded traffic never compiles; the
+``mesh.append`` kernel writes refresh deltas into each shard's padded
+headroom copy-on-write (only the delta crosses PCIe, and the old
+buffers are NOT donated — in-flight searches keep a valid snapshot);
+and `ShardedFieldState` is the host-side bookkeeping `vectors/store.py`
+keeps per mesh-resident field (slot maps, per-shard fill, filter masks).
 
 Sharding over hosts (DCN) uses the same program under multi-process JAX; the
 mesh simply spans processes.
@@ -26,16 +37,17 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
     from jax import shard_map as _shard_map
 except ImportError:  # pre-0.6 jax keeps it in experimental
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
-from elasticsearch_tpu.ops.topk import merge_top_k
+from elasticsearch_tpu.ops.similarity import NEG_INF
 from elasticsearch_tpu.parallel import mesh as mesh_lib
 
 
@@ -79,9 +91,13 @@ class ShardLayout(NamedTuple):
     rows_per_shard: int
 
     def to_original_ids(self, global_ids: np.ndarray) -> np.ndarray:
-        """Device global row id → original corpus row index."""
+        """Device global row id → original corpus row index (only valid
+        for the contiguous build layout — after device appends the
+        `ShardedFieldState.slot_map` is authoritative). id -1 (masked
+        padding) maps to -1."""
         per, chunk = self.rows_per_shard, self.docs_per_shard
-        return (global_ids // per) * chunk + (global_ids % per)
+        ids = (global_ids // per) * chunk + (global_ids % per)
+        return np.where(global_ids < 0, -1, ids)
 
     def to_global_ids(self, original_ids: np.ndarray) -> np.ndarray:
         per, chunk = self.rows_per_shard, self.docs_per_shard
@@ -142,10 +158,81 @@ def build_sharded_corpus(
     return ShardedCorpus(matrix, sq_norms, scales, nv), ShardLayout(n_shards, chunk, per)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "metric", "precision", "block_size", "mesh"),
-)
+# ---------------------------------------------------------------------------
+# Search program (dispatched: kernel "mesh.knn")
+# ---------------------------------------------------------------------------
+
+def _knn_step(q, mat, sqn, scl, nvalid, fmask, *, k, metric, precision,
+              block_size):
+    """Per-shard body: local exact kNN, padding masked OUT before the
+    gather (a ragged shard whose num_valid < k would otherwise feed
+    aliased padding ids into the merge), then the ICI candidate merge."""
+    from elasticsearch_tpu.ops.topk import merge_top_k
+
+    local = knn_ops.Corpus(mat, sqn, scl, nvalid[0])
+    rows_per_shard = mat.shape[0]
+    s, i = knn_ops.knn_search(q, local, k, metric=metric,
+                              filter_mask=fmask, precision=precision,
+                              block_size=block_size)
+    shard_id = jax.lax.axis_index(mesh_lib.SHARD_AXIS)
+    # the local top-k returns NEG_INF for padding/filtered slots but an
+    # ARBITRARY row index beside it; pin both so no consumer can alias
+    valid = s > NEG_INF
+    s = jnp.where(valid, s, -jnp.inf)
+    gids = jnp.where(valid, i + shard_id * rows_per_shard,
+                     jnp.int32(-1))
+    all_s = jax.lax.all_gather(s, mesh_lib.SHARD_AXIS)   # [S, Qdp, k] over ICI
+    all_i = jax.lax.all_gather(gids, mesh_lib.SHARD_AXIS)
+    return merge_top_k(all_s, all_i, k)
+
+
+def _distributed_knn_impl(queries, corpus, filter_mask, k, mesh,
+                          metric=sim.COSINE, precision="bf16",
+                          block_size=None):
+    corpus_specs = ShardedCorpus(
+        P(mesh_lib.SHARD_AXIS, None), P(mesh_lib.SHARD_AXIS),
+        P(mesh_lib.SHARD_AXIS), P(mesh_lib.SHARD_AXIS))
+    out_specs = (P(mesh_lib.DP_AXIS, None), P(mesh_lib.DP_AXIS, None))
+    step = functools.partial(_knn_step, k=k, metric=metric,
+                             precision=precision, block_size=block_size)
+    if filter_mask is None:
+        def step_nf(q, mat, sqn, scl, nvalid):
+            return step(q, mat, sqn, scl, nvalid, None)
+        fn = shard_map(
+            step_nf, mesh=mesh,
+            in_specs=(P(mesh_lib.DP_AXIS, None),) + tuple(corpus_specs),
+            out_specs=out_specs)
+        return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales,
+                  corpus.num_valid)
+    fspec = (P(mesh_lib.SHARD_AXIS) if filter_mask.ndim == 1
+             else P(mesh_lib.DP_AXIS, mesh_lib.SHARD_AXIS))
+    fn = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(mesh_lib.DP_AXIS, None),) + tuple(corpus_specs)
+        + (fspec,), out_specs=out_specs)
+    return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales,
+              corpus.num_valid, filter_mask)
+
+
+def _grid_mesh_knn(statics, sigs) -> bool:
+    """Closed sharded grid: bucketed query count, k on the ladder (or
+    clamped to the per-shard row count), lane-padded shard slices."""
+    q_shape = sigs[0][0]                    # queries [Q, D]
+    n_rows = sigs[1][0][0]                  # matrix [S * per, D]
+    mesh = statics["mesh"]
+    n_shards = mesh.shape[mesh_lib.SHARD_AXIS]
+    per = n_rows // max(n_shards, 1)
+    return (dispatch.is_query_bucket(q_shape[0])
+            and dispatch.in_k_grid(int(statics["k"]), limit=per)
+            and per % knn_ops.LANE == 0)
+
+
+dispatch.DISPATCH.register(
+    "mesh.knn", _distributed_knn_impl,
+    static_argnames=("k", "mesh", "metric", "precision", "block_size"),
+    grid_check=_grid_mesh_knn)
+
+
 def distributed_knn_search(
     queries: jax.Array,
     corpus: ShardedCorpus,
@@ -158,38 +245,257 @@ def distributed_knn_search(
 ):
     """Search queries [Q, D] against a mesh-sharded corpus.
 
-    Q must be divisible by the dp axis size. Returns (scores [Q, k],
-    global_ids [Q, k]) fully replicated across the mesh.
+    Q must be divisible by the dp axis size. filter_mask is [S * per] (one
+    shared searchable-set) or [Q, S * per] (per-query pre-filters).
+    Returns (scores [Q, k], global_ids [Q, k]) fully replicated across the
+    mesh; empty/padding slots come back as (-inf, -1).
+
+    Executes through the shape-bucketed dispatch cache (kernel
+    ``mesh.knn``, AOT executables keyed on (mesh, bucket)); calls from
+    inside an enclosing jit (the bench scan harness) inline.
     """
-    in_specs = (
-        P(mesh_lib.DP_AXIS, None),          # queries
-        P(mesh_lib.SHARD_AXIS, None),       # matrix
-        P(mesh_lib.SHARD_AXIS),             # sq_norms
-        P(mesh_lib.SHARD_AXIS),             # scales
-        P(mesh_lib.SHARD_AXIS),             # num_valid
-        (P(mesh_lib.SHARD_AXIS) if filter_mask is not None else None),
-    )
-    out_specs = (P(mesh_lib.DP_AXIS, None), P(mesh_lib.DP_AXIS, None))
+    return dispatch.call("mesh.knn", queries, corpus, filter_mask,
+                         k=k, mesh=mesh, metric=metric,
+                         precision=precision, block_size=block_size)
 
-    def step(q, mat, sqn, scl, nvalid, fmask):
-        local = knn_ops.Corpus(mat, sqn, scl, nvalid[0])
-        rows_per_shard = mat.shape[0]
-        s, i = knn_ops.knn_search(q, local, k, metric=metric,
-                                  filter_mask=fmask, precision=precision,
-                                  block_size=block_size)
-        shard_id = jax.lax.axis_index(mesh_lib.SHARD_AXIS)
-        gids = i + shard_id * rows_per_shard
-        all_s = jax.lax.all_gather(s, mesh_lib.SHARD_AXIS)   # [S, Qdp, k] over ICI
-        all_i = jax.lax.all_gather(gids, mesh_lib.SHARD_AXIS)
-        return merge_top_k(all_s, all_i, k)
 
-    if filter_mask is None:
-        def step_nf(q, mat, sqn, scl, nvalid):
-            return step(q, mat, sqn, scl, nvalid, None)
-        fn = shard_map(step_nf, mesh=mesh, in_specs=in_specs[:-1],
-                       out_specs=out_specs)
-        return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales, corpus.num_valid)
+# ---------------------------------------------------------------------------
+# Incremental append (dispatched: kernel "mesh.append")
+# ---------------------------------------------------------------------------
 
-    fn = shard_map(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales,
-              corpus.num_valid, filter_mask)
+def _append_impl(matrix, sq_norms, scales, num_valid, new_mat, new_sq,
+                 new_scales, new_counts, mesh):
+    """Write per-shard delta rows into the padded headroom: refresh
+    appends move only the delta across PCIe, never the resident corpus.
+    The old buffers are NOT donated (see the registration below) — the
+    program produces a fresh corpus pytree so searches in flight against
+    the pre-append state keep reading valid arrays."""
+    def step(mat, sqn, scl, nv, nmat, nsq, nscl, ncnt):
+        m = nmat.shape[0]
+        start = nv[0]
+        lane = jnp.arange(m, dtype=jnp.int32)
+        ok = lane < ncnt[0]
+        # out-of-range target rows (beyond this shard's delta count) are
+        # DROPPED by the scatter, leaving resident rows untouched
+        tgt = jnp.where(ok, start + lane, jnp.int32(mat.shape[0]))
+        mat = mat.at[tgt].set(nmat.astype(mat.dtype), mode="drop")
+        sqn = sqn.at[tgt].set(nsq, mode="drop")
+        scl = scl.at[tgt].set(nscl, mode="drop")
+        return mat, sqn, scl, nv + ncnt[0]
+
+    S, SH = mesh_lib.SHARD_AXIS, P(mesh_lib.SHARD_AXIS)
+    fn = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(S, None), SH, SH, SH, P(S, None), SH, SH, SH),
+        out_specs=(P(S, None), SH, SH, SH))
+    mat, sqn, scl, nv = fn(matrix, sq_norms, scales, num_valid,
+                           new_mat, new_sq, new_scales, new_counts)
+    return ShardedCorpus(mat, sqn, scl, nv)
+
+
+def _grid_mesh_append(statics, sigs) -> bool:
+    """Delta row count per shard padded to a query-style bucket — refresh
+    deltas of any size reuse a small closed set of append programs."""
+    n_rows = sigs[4][0][0]                  # new_mat [S * m, D]
+    mesh = statics["mesh"]
+    n_shards = mesh.shape[mesh_lib.SHARD_AXIS]
+    m = n_rows // max(n_shards, 1)
+    return dispatch.is_query_bucket(m)
+
+
+# NO donation: `ShardedFieldState.append` is copy-on-write — searches
+# dispatched against the pre-append state mid-refresh still read the old
+# buffers, so donating them would hand deleted arrays to a live dispatch
+dispatch.DISPATCH.register(
+    "mesh.append", _append_impl, static_argnames=("mesh",),
+    grid_check=_grid_mesh_append)
+
+
+# ---------------------------------------------------------------------------
+# Host-side field state (the vectors/store.py mesh bookkeeping)
+# ---------------------------------------------------------------------------
+
+class ShardedFieldState:
+    """One vector field's mesh-resident corpus + host bookkeeping.
+
+    Owns the slot map (device global row -> flat corpus row index), the
+    per-shard fill counts the append planner balances against, and the
+    filter-mask builder. `append` places refresh deltas into the shards
+    with the most headroom and ships ONLY the delta (kernel
+    ``mesh.append``); when headroom runs out the caller rebuilds."""
+
+    __slots__ = ("corpus", "layout", "mesh", "metric", "dtype",
+                 "slot_map", "shard_counts", "n_rows")
+
+    def __init__(self, vectors: np.ndarray, mesh: Mesh, metric: str,
+                 dtype: str, min_headroom: Optional[int] = None):
+        n = len(vectors)
+        n_shards = mesh.shape[mesh_lib.SHARD_AXIS]
+        chunk = (n + n_shards - 1) // n_shards
+        if min_headroom is None:
+            # append headroom: an eighth of the shard (>= one lane tile) —
+            # refreshes append in place until the corpus grows 12.5%,
+            # then one rebuild re-balances
+            min_headroom = max(knn_ops.LANE, chunk // 8)
+        self.corpus, self.layout = build_sharded_corpus(
+            vectors, mesh, metric=metric, dtype=dtype,
+            min_headroom=min_headroom)
+        self.mesh = mesh
+        self.metric = metric
+        self.dtype = dtype
+        self.n_rows = n
+        per = self.layout.rows_per_shard
+        self.slot_map = np.full(n_shards * per, -1, dtype=np.int64)
+        self.shard_counts = np.zeros(n_shards, dtype=np.int64)
+        for s in range(n_shards):
+            lo, hi = min(s * chunk, n), min((s + 1) * chunk, n)
+            self.slot_map[s * per: s * per + (hi - lo)] = np.arange(lo, hi)
+            self.shard_counts[s] = hi - lo
+
+    @property
+    def n_shards(self) -> int:
+        return self.layout.n_shards
+
+    def headroom(self) -> int:
+        return int((self.layout.rows_per_shard
+                    - self.shard_counts).sum())
+
+    def can_append(self, n_new: int) -> bool:
+        return n_new <= self.headroom()
+
+    def append(self, new_vectors: np.ndarray) -> "ShardedFieldState":
+        """Place `new_vectors` (flat corpus rows n_rows..n_rows+m) into
+        per-shard headroom, most-free shards first, and ship ONLY the
+        delta with one ``mesh.append`` dispatch.
+
+        Copy-on-write: returns a NEW state and leaves `self` (corpus
+        buffers AND slot_map/shard_counts bookkeeping) untouched, so a
+        search dispatched against the previously-installed FieldCorpus
+        mid-refresh keeps a consistent snapshot. The delta program
+        therefore must NOT donate the old buffers — append pays a
+        transient second matrix allocation on device, but the host->
+        device transfer (the cost that scales with the corpus) stays
+        delta-sized."""
+        m_total = len(new_vectors)
+        if m_total == 0:
+            return self
+        per = self.layout.rows_per_shard
+        S = self.n_shards
+        free = per - self.shard_counts
+        order = np.argsort(-free, kind="stable")
+        counts = np.zeros(S, dtype=np.int64)
+        remaining = m_total
+        # water-fill: level the most-free shards first so the layout
+        # stays balanced under repeated appends
+        while remaining > 0:
+            target = [s for s in order if free[s] - counts[s] > 0]
+            if not target:
+                raise ValueError("sharded corpus append exceeds headroom")
+            share = max(1, remaining // len(target))
+            for s in target:
+                take = min(share, int(free[s] - counts[s]), remaining)
+                counts[s] += take
+                remaining -= take
+                if remaining == 0:
+                    break
+
+        m_pad = dispatch.bucket_queries(int(counts.max()))
+        d = new_vectors.shape[1]
+        blocks = np.zeros((S * m_pad, d), dtype=np.float32)
+        new_sq = np.zeros(S * m_pad, dtype=np.float32)
+        new_scales = np.ones(S * m_pad, dtype=np.float32)
+        slot_map = self.slot_map.copy()
+        pos = 0
+        for s in range(S):
+            c = int(counts[s])
+            if c == 0:
+                continue
+            block = np.asarray(new_vectors[pos:pos + c], dtype=np.float32)
+            if self.metric == sim.COSINE:
+                norms = np.linalg.norm(block, axis=-1, keepdims=True)
+                block = block / np.maximum(norms, 1e-30)
+            blocks[s * m_pad: s * m_pad + c] = block
+            new_sq[s * m_pad: s * m_pad + c] = (block * block).sum(axis=-1)
+            start = int(self.shard_counts[s])
+            slot_map[s * per + start: s * per + start + c] = \
+                np.arange(self.n_rows + pos, self.n_rows + pos + c)
+            pos += c
+        if self.dtype == "int8":
+            from elasticsearch_tpu.ops.quantization import quantize_int8_np
+            q8, sc = quantize_int8_np(blocks)
+            blocks, new_scales = q8, sc
+        elif self.dtype == "bf16":
+            import ml_dtypes
+            blocks = blocks.astype(ml_dtypes.bfloat16)
+        nm = jax.device_put(blocks, mesh_lib.corpus_sharding(self.mesh))
+        nsq = jax.device_put(new_sq, mesh_lib.per_shard_sharding(self.mesh))
+        nsc = jax.device_put(new_scales,
+                             mesh_lib.per_shard_sharding(self.mesh))
+        ncnt = jax.device_put(counts.astype(np.int32),
+                              mesh_lib.per_shard_sharding(self.mesh))
+        corpus = dispatch.call(
+            "mesh.append", self.corpus.matrix, self.corpus.sq_norms,
+            self.corpus.scales, self.corpus.num_valid, nm, nsq, nsc, ncnt,
+            mesh=self.mesh)
+        new = ShardedFieldState.__new__(ShardedFieldState)
+        new.corpus = corpus
+        new.layout = self.layout
+        new.mesh = self.mesh
+        new.metric = self.metric
+        new.dtype = self.dtype
+        new.slot_map = slot_map
+        new.shard_counts = self.shard_counts + counts
+        new.n_rows = self.n_rows + m_total
+        return new
+
+    # ---------------------------------------------------------- serving
+    def filter_mask(self, allowed_flat: np.ndarray) -> np.ndarray:
+        """Map a flat-corpus-row bool mask [n_rows] to the device global
+        row space [S * per] via the slot map."""
+        m = np.zeros(len(self.slot_map), dtype=bool)
+        vs = self.slot_map >= 0
+        m[vs] = allowed_flat[self.slot_map[vs]]
+        return m
+
+    def map_ids(self, global_ids: np.ndarray) -> np.ndarray:
+        """Device global ids -> flat corpus row indices (-1 invalid)."""
+        out = np.full(global_ids.shape, -1, dtype=np.int64)
+        ok = global_ids >= 0
+        out[ok] = self.slot_map[global_ids[ok]]
+        return out
+
+    def query_sharding(self) -> NamedSharding:
+        return mesh_lib.query_sharding(self.mesh)
+
+    def mask_sharding(self, ndim: int) -> NamedSharding:
+        if ndim == 1:
+            return mesh_lib.per_shard_sharding(self.mesh)
+        return NamedSharding(self.mesh,
+                             P(mesh_lib.DP_AXIS, mesh_lib.SHARD_AXIS))
+
+    def warmup_entries(self, dims: int):
+        """(kernel, arg specs, statics) entries pre-compiling the sharded
+        serving grid — mirrors `vectors/store._schedule_warmup` but with
+        mesh-sharded input layouts baked into the AOT specs."""
+        per = self.layout.rows_per_shard
+        corpus_spec = ShardedCorpus(
+            jax.ShapeDtypeStruct(self.corpus.matrix.shape,
+                                 self.corpus.matrix.dtype,
+                                 sharding=mesh_lib.corpus_sharding(self.mesh)),
+            jax.ShapeDtypeStruct(self.corpus.sq_norms.shape, jnp.float32,
+                                 sharding=mesh_lib.per_shard_sharding(self.mesh)),
+            jax.ShapeDtypeStruct(self.corpus.scales.shape, jnp.float32,
+                                 sharding=mesh_lib.per_shard_sharding(self.mesh)),
+            jax.ShapeDtypeStruct(self.corpus.num_valid.shape, jnp.int32,
+                                 sharding=mesh_lib.per_shard_sharding(self.mesh)))
+        entries = []
+        for q in dispatch.WARMUP_QUERY_BUCKETS:
+            qspec = jax.ShapeDtypeStruct(
+                (q, dims), jnp.float32, sharding=self.query_sharding())
+            for k in dispatch.WARMUP_K_BUCKETS:
+                k_b = dispatch.bucket_k(min(k, per), limit=per)
+                entries.append((
+                    "mesh.knn", (qspec, corpus_spec, None),
+                    {"k": k_b, "mesh": self.mesh, "metric": self.metric,
+                     "precision": "bf16", "block_size": None}))
+        return entries

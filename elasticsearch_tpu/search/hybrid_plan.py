@@ -508,7 +508,10 @@ class HybridExecutor:
             # only pay it when some query in the batch asked to profile
             trace = any(body.get("profile") for body in bodies)
             dispatch_events = []
+            mesh_delta = None
             from elasticsearch_tpu.ops import dispatch as _dispatch
+            from elasticsearch_tpu.parallel import policy as _mesh_policy
+            mesh_before = _mesh_policy.stats() if trace else None
             if trace:
                 _dispatch.DISPATCH.record_events(True)
             try:
@@ -518,6 +521,15 @@ class HybridExecutor:
                 if trace:
                     dispatch_events = _dispatch.DISPATCH.drain_events()
                     _dispatch.DISPATCH.record_events(False)
+            if trace:
+                # which legs of this batch rode the serving mesh
+                # (process-wide counters, so concurrent batches can bleed
+                # into the delta — `_nodes/stats indices.mesh` stays the
+                # authoritative total, same caveat as the dispatch trace)
+                from elasticsearch_tpu.search.profile import (
+                    mesh_stats_delta)
+                mesh_delta = mesh_stats_delta(mesh_before,
+                                              _mesh_policy.stats())
             score_nanos = time.perf_counter_ns() - t0
             self.stats["score_nanos"] += score_nanos
 
@@ -566,7 +578,8 @@ class HybridExecutor:
                         0, cache_state[bi], len(bodies),
                         [leg_info[(bi, li)]
                          for li in range(len(plan.legs))],
-                        dispatch_events=dispatch_events)
+                        dispatch_events=dispatch_events,
+                        mesh=mesh_delta)
                 out.append(resp)
             hydrate_nanos = time.perf_counter_ns() - t0
             self.stats["hydrate_nanos"] += hydrate_nanos

@@ -25,7 +25,8 @@ def _describe_query(body: dict) -> tuple:
 def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
                    fuse_nanos: int, hydrate_nanos: int, plan_cache_hit: bool,
                    batch_size: int, legs: list,
-                   dispatch_events: Optional[list] = None) -> dict:
+                   dispatch_events: Optional[list] = None,
+                   mesh: Optional[dict] = None) -> dict:
     """`profile` section for a fused hybrid (rank.rrf) search
     (search/hybrid_plan.py): the four plan phases — plan (parse/compile or
     cache hit), score (the batched leg dispatches), fuse (vectorized RRF),
@@ -52,7 +53,41 @@ def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
         "legs": legs}}
     if dispatch_events is not None:
         out["hybrid"]["dispatch"] = dispatch_events
+    if mesh is not None:
+        # this batch's SPMD execution (parallel/policy.py counter deltas
+        # captured around the score phase): which legs rode the mesh,
+        # shard-local vs host-merge time, analytic all-gather bytes, and
+        # the router's mesh-vs-single-device decisions. Batch-scoped like
+        # score_nanos above — the device work was shared by batch_size
+        # queries.
+        out["hybrid"]["mesh"] = mesh
     return out
+
+
+def mesh_stats_delta(before: dict, after: dict) -> Optional[dict]:
+    """What one batch did on the serving mesh: the difference between two
+    `parallel/policy.stats()` snapshots taken around the batch's score
+    phase. Returns None when nothing routed to the mesh in between (the
+    hybrid profile omits its `mesh` key for single-device batches)."""
+    legs = {}
+    for leg, a in (after.get("legs") or {}).items():
+        b = (before.get("legs") or {}).get(leg, {})
+        d = {key: a[key] - b.get(key, 0) for key in a}
+        if d.get("dispatches", 0) > 0:
+            legs[leg] = d
+    ra, rb = after.get("router", {}), before.get("router", {})
+    router = {
+        "mesh": ra.get("mesh", 0) - rb.get("mesh", 0),
+        "single_device": (ra.get("single_device", 0)
+                          - rb.get("single_device", 0)),
+        "reasons": {r: n - rb.get("reasons", {}).get(r, 0)
+                    for r, n in ra.get("reasons", {}).items()
+                    if n - rb.get("reasons", {}).get(r, 0)},
+    }
+    if not legs and not router["mesh"]:
+        return None
+    return {"shards": after.get("num_shards", 0), "legs": legs,
+            "router": router}
 
 
 def shard_profile(index_name: str, body: dict, query_nanos: int,
@@ -111,6 +146,18 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
                 key: knn_phases[key]
                 for key in ("route_nanos", "score_nanos", "merge_nanos")
                 if key in knn_phases},
+        }
+    if knn_phases and "mesh_shards" in knn_phases:
+        # SPMD execution detail (`profile.mesh`): the kNN leg ran as one
+        # shard_map program over the serving mesh — shard count, the
+        # in-program local work vs host-side merge split, and the
+        # analytic ICI all-gather payload of the candidate merge
+        profile["mesh"] = {
+            "shards": knn_phases["mesh_shards"],
+            "collective_bytes": knn_phases.get("collective_bytes", 0),
+            "breakdown": {
+                "local_nanos": knn_phases.get("score_nanos", 0),
+                "merge_nanos": knn_phases.get("merge_nanos", 0)},
         }
     if dispatch_events:
         # shape-bucket trace of this shard's device dispatches (see

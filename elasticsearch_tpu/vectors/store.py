@@ -50,10 +50,10 @@ class FieldCorpus:
     """Device corpus for one vector field + host-side row maps."""
 
     __slots__ = ("corpus", "row_map", "metric", "dims", "version", "host",
-                 "router")
+                 "router", "mesh_state")
 
     def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int,
-                 version: tuple, host=None, router=None):
+                 version: tuple, host=None, router=None, mesh_state=None):
         self.corpus = corpus          # knn_ops.Corpus (device pytree)
         self.row_map = row_map        # device row -> engine global row
         self.metric = metric
@@ -61,6 +61,10 @@ class FieldCorpus:
         self.version = version        # cache key: segment/tombstone fingerprint
         self.host = host              # HostFieldCorpus latency mirror (or None)
         self.router = router          # ann.IVFRouter (tpu_ivf engine) or None
+        # parallel.sharded_knn.ShardedFieldState: the mesh-resident
+        # row-sharded copy + slot maps (None when the mesh router would
+        # never pick this corpus)
+        self.mesh_state = mesh_state
 
 
 def _pad_batch(queries: np.ndarray, n_real: int) -> np.ndarray:
@@ -122,6 +126,7 @@ class VectorStoreShard:
         # per-phase serving telemetry (profile "knn" section, _nodes/stats)
         self.knn_stats: Dict[str, int] = {
             "searches": 0, "ivf_searches": 0, "fallback_searches": 0,
+            "mesh_searches": 0,
             "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
         self.last_knn_phases: dict = {}
 
@@ -218,9 +223,38 @@ class VectorStoreShard:
                     router = IVFRouter(
                         ivf, nprobe=nprobe,
                         recall_target=self.knn_recall_target)
+            mesh_state = None
+            from elasticsearch_tpu.parallel import policy as mesh_policy
+            if mesh_policy.eligible(len(row_map)):
+                from elasticsearch_tpu.parallel.sharded_knn import (
+                    ShardedFieldState)
+                mesh = mesh_policy.serving_mesh()
+                old_ms = cached.mesh_state if cached is not None else None
+                old_n = len(cached.row_map) if cached is not None else 0
+                if (old_ms is not None and old_ms.mesh is mesh
+                        and old_ms.dtype == dtype
+                        and old_ms.metric == metric
+                        and old_ms.n_rows == old_n
+                        and 0 < old_n <= len(row_map)
+                        and old_ms.can_append(len(row_map) - old_n)
+                        and np.array_equal(row_map[:old_n],
+                                           cached.row_map)):
+                    # append-only refresh (new sealed segments, no
+                    # deletes): ship ONLY the delta rows into the
+                    # per-shard padded headroom (`mesh.append`,
+                    # copy-on-write — in-flight searches keep the old
+                    # state's buffers) — the resident sharded corpus is
+                    # never re-uploaded. Deletes or a mesh/dtype change
+                    # fall through to the full rebuild.
+                    mesh_state = (old_ms.append(full[old_n:])
+                                  if len(row_map) > old_n else old_ms)
+                else:
+                    mesh_state = ShardedFieldState(full, mesh, metric,
+                                                   dtype)
             self._fields[field] = FieldCorpus(corpus, row_map, metric,
                                               mapper.dims, version,
-                                              host=host, router=router)
+                                              host=host, router=router,
+                                              mesh_state=mesh_state)
             with self._batchers_lock:
                 for key in [k for k in self._batchers if k[0] == field]:
                     del self._batchers[key]
@@ -268,6 +302,28 @@ class VectorStoreShard:
                         "knn.exact", (qspec, corpus_spec, None),
                         {"k": k_b, "metric": fc.metric,
                          "precision": "bf16", "block_size": None}))
+        if fc.mesh_state is not None:
+            # the sharded serving grid pre-compiles alongside the
+            # single-device one, so the first mesh-routed query of any
+            # interactive bucket finds its SPMD program ready
+            entries.extend(fc.mesh_state.warmup_entries(fc.dims))
+        if fc.router is not None:
+            from elasticsearch_tpu.parallel import policy as mesh_policy
+            from elasticsearch_tpu.parallel import sharded_ivf
+            idx = fc.router.index
+            mesh = (mesh_policy.serving_mesh()
+                    if mesh_policy.eligible(len(fc.row_map)) else None)
+            nprobe_known = (fc.router.nprobe_setting != "auto"
+                            or fc.router._tuned_nprobe is not None)
+            if mesh is not None and idx.total > 0 and nprobe_known:
+                # shape-only: the specs derive from the host layout, so
+                # refresh never pays the sharded posting-list upload
+                # here (IVFIndex.add invalidates the cached upload, so
+                # an eager build would re-transfer the corpus every
+                # refresh); an untuned "auto" nprobe is skipped — the
+                # tuner runs real searches, far too heavy for warmup
+                entries.extend(sharded_ivf.warmup_entries(
+                    idx, mesh, fc.router.effective_nprobe(10)))
         dispatch.DISPATCH.warmup(entries, background=True)
 
     def field(self, name: str) -> Optional[FieldCorpus]:
@@ -358,6 +414,20 @@ class VectorStoreShard:
             self.last_knn_phases = {"engine": "tpu_exhaustive",
                                     "fallback_reason": reason}
 
+        # mesh router: a corpus past the policy's row floor with a
+        # sharded resident copy serves as ONE SPMD program (shard-local
+        # matmul + ICI all-gather merge); everything else takes the
+        # single-device / host paths below. k deeper than a shard slice
+        # can't merge losslessly — those requests stay single-device.
+        from elasticsearch_tpu.parallel import policy as mesh_policy
+        mesh = mesh_policy.decide(
+            "knn", n_valid, has_mesh_state=fc.mesh_state is not None)
+        if mesh is not None:
+            if k_eff <= fc.mesh_state.layout.rows_per_shard:
+                return self._execute_mesh(fc, k_eff, n_valid, queries,
+                                          requests, any_filter, precision)
+            mesh_policy.reclassify_single("knn_k_deeper_than_shard")
+
         use_host = (fc.host is not None and precision != "f32"
                     and CostModel.prefer_host(len(requests), fc.host.n,
                                               fc.host.dims))
@@ -406,16 +476,82 @@ class VectorStoreShard:
             out.append((fc.row_map[rid], sc.astype(np.float32)))
         return out
 
+    def _execute_mesh(self, fc: FieldCorpus, k_eff: int, n_valid: int,
+                      queries: np.ndarray, requests, any_filter: bool,
+                      precision: str) -> list:
+        """Serve one coalesced exact-kNN batch as ONE SPMD program over
+        the mesh-resident sharded corpus (`parallel/sharded_knn.py`):
+        shard-local matmul + top-k, all-gather candidate merge, k-ladder
+        slice-back. Result-identical to the single-device path (the
+        tier-1 mesh suite pins byte parity)."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel import policy as mesh_policy
+        from elasticsearch_tpu.parallel.sharded_knn import (
+            distributed_knn_search)
+
+        ms = fc.mesh_state
+        queries = _pad_batch(queries, len(requests))
+        b_pad = len(queries)
+        per = ms.layout.rows_per_shard
+        k_b = dispatch.bucket_k(k_eff, limit=per)
+        t0 = _time.perf_counter_ns()
+        mask = None
+        if any_filter:
+            m = np.zeros((b_pad, len(ms.slot_map)), dtype=bool)
+            valid_slots = ms.slot_map >= 0  # == filter_mask(all-ones)
+            for i, (_, fr) in enumerate(requests):
+                if fr is None:
+                    m[i] = valid_slots
+                else:
+                    m[i] = ms.filter_mask(np.isin(fc.row_map, fr))
+            mask = jax.device_put(jnp.asarray(m), ms.mask_sharding(2))
+        q = jax.device_put(jnp.asarray(queries), ms.query_sharding())
+        scores, gids = distributed_knn_search(
+            q, ms.corpus, k_b, ms.mesh, metric=fc.metric,
+            filter_mask=mask, precision=precision)
+        gids.block_until_ready()
+        t1 = _time.perf_counter_ns()
+        scores = np.asarray(scores)[:, :k_eff]
+        gids = np.asarray(gids)[:, :k_eff]
+        flat = ms.map_ids(gids)
+        out = []
+        for qi in range(len(requests)):
+            sc, rid = scores[qi], flat[qi]
+            valid = (sc > -1e37) & (rid >= 0) & (rid < n_valid)
+            sc, rid = sc[valid], rid[valid]
+            out.append((fc.row_map[rid], sc.astype(np.float32)))
+        t2 = _time.perf_counter_ns()
+        gather = mesh_policy.gather_bytes(ms.n_shards, b_pad, k_b)
+        mesh_policy.record_leg("knn", t1 - t0, t2 - t1, gather)
+        self.knn_stats["mesh_searches"] += 1
+        self.knn_stats["score_nanos"] += t1 - t0
+        self.knn_stats["merge_nanos"] += t2 - t1
+        self.last_knn_phases = {
+            "engine": "tpu_mesh", "mesh_shards": ms.n_shards,
+            "rows_per_shard": per, "collective_bytes": gather,
+            "route_nanos": 0, "score_nanos": t1 - t0,
+            "merge_nanos": t2 - t1}
+        return out
+
     def _execute_ivf(self, fc: FieldCorpus, k_eff: int, n_valid: int,
                      queries: np.ndarray, n_real: int,
                      num_candidates: Optional[int]) -> list:
-        """Serve one coalesced batch through the tpu_ivf router."""
+        """Serve one coalesced batch through the tpu_ivf router (the
+        mesh policy decides single-device vs SPMD execution)."""
         import time as _time
+
+        from elasticsearch_tpu.parallel import policy as mesh_policy
 
         queries = _pad_batch(queries, n_real)
         k_b = dispatch.bucket_k(k_eff, limit=len(fc.row_map))
+        mesh = mesh_policy.decide("ivf", len(fc.row_map))
         scores, rows, phases = fc.router.search(
-            queries, k_b, num_candidates=num_candidates)
+            queries, k_b, num_candidates=num_candidates, mesh=mesh)
         scores, rows = scores[:, :k_eff], rows[:, :k_eff]
         t0 = _time.perf_counter_ns()
         out = []
@@ -427,6 +563,8 @@ class VectorStoreShard:
         phases = dict(phases)
         phases["merge_nanos"] += _time.perf_counter_ns() - t0
         self.knn_stats["ivf_searches"] += 1
+        if phases.get("engine") == "tpu_ivf_mesh":
+            self.knn_stats["mesh_searches"] += 1
         for ph in ("route_nanos", "score_nanos", "merge_nanos"):
             self.knn_stats[ph] += phases[ph]
         self.last_knn_phases = phases

@@ -28,9 +28,31 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running sweeps excluded from tier-1 "
                    "(`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers", "multidevice: exercises the SPMD mesh serving path on "
+                   "the 8 virtual CPU devices this conftest forces; runs "
+                   "in tier-1, and `-m multidevice` under "
+                   "ES_TPU_DISPATCH_STRICT=1 is the sharded-grid "
+                   "recompile-regression gate (see ROADMAP)")
 
 
 import pytest
+
+
+@pytest.fixture
+def mesh_serving():
+    """Force the mesh serving policy ON over the 8 virtual devices (row
+    floor 1 so tiny test corpora route to the mesh), restore the
+    process-wide auto policy afterwards. Yields the policy module so
+    tests can read `stats()` / flip config mid-test."""
+    from elasticsearch_tpu.parallel import policy
+    policy.reset(full=True)
+    policy.configure(enabled=True, num_shards=8, min_rows=1)
+    if policy.serving_mesh() is None:
+        policy.reset(full=True)
+        pytest.skip("needs >= 2 jax devices (forced-host-device-count)")
+    yield policy
+    policy.reset(full=True)
 
 
 import contextlib
