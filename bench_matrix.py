@@ -862,6 +862,109 @@ def run_small_batch_serving(n: int = 1_000_000, d: int = 128):
         "dispatch": _dispatch_delta(mark)}), flush=True)
 
 
+def run_device_aggs(n_docs: int = 100_000):
+    """Config 8: device-resident aggregations (ops/aggs.py +
+    search/agg_plan.py) — dashboard-shaped bodies (terms+stats,
+    date_histogram+stats over a range-filtered match set) served by the
+    fused filter→aggregate device plan vs the host numpy walkers, with
+    byte-parity asserted between the two. `dispatch` records the aggs.*
+    executable-cache behavior of the measured (post-warm) window — a
+    steady-state dashboard must show zero compiles."""
+    import os
+    import tempfile
+
+    from elasticsearch_tpu.node import Node
+
+    if os.environ.get("BENCH_SMALL") == "1":
+        n_docs = min(n_docs, 4_000)
+    rng = np.random.default_rng(23)
+    node = Node(tempfile.mkdtemp())
+    try:
+        node.create_index_with_templates("dash", mappings={"properties": {
+            "cat": {"type": "keyword"}, "status": {"type": "keyword"},
+            "bytes": {"type": "long"}, "ts": {"type": "date"}}})
+        cats = [f"service-{i}" for i in range(24)]
+        t0 = time.perf_counter()
+        base_ts = 1_600_000_000_000
+        for c0 in range(0, n_docs, 5000):
+            ops = []
+            for i in range(c0, min(c0 + 5000, n_docs)):
+                ops.append({"index": {"_index": "dash", "_id": str(i)}})
+                ops.append({"cat": cats[int(rng.integers(24))],
+                            "status": ["ok", "warn", "err"][i % 3],
+                            "bytes": int(rng.integers(0, 1 << 20)),
+                            "ts": base_ts + (i % 720) * 60_000})
+            node.bulk(ops)
+        node.indices.get("dash").force_merge()
+        node.indices.get("dash").refresh()
+        build_s = time.perf_counter() - t0
+
+        def body(lo):
+            # size 1 (not 0): size-0 agg responses are shard-request-cache
+            # eligible, and the host-comparison pass re-issues these exact
+            # bodies — a cached device response would make host_p50 and
+            # parity_vs_host measure the LRU, not the host walkers
+            return {"query": {"range": {"bytes": {"gte": int(lo)}}},
+                    "size": 1,
+                    "aggs": {
+                        "by_cat": {"terms": {"field": "cat", "size": 10},
+                                   "aggs": {"b": {"stats":
+                                                  {"field": "bytes"}}}},
+                        "over_time": {"date_histogram": {
+                            "field": "ts", "fixed_interval": "1h"},
+                            "aggs": {"b": {"sum": {"field": "bytes"}}}},
+                        "tiers": {"range": {"field": "bytes", "ranges": [
+                            {"to": 1 << 14}, {"from": 1 << 14,
+                                              "to": 1 << 18},
+                            {"from": 1 << 18}]}}}}
+
+        # distinct range bounds per query defeat the shard request cache
+        # while the agg-plan cache (scrubbed bounds) still hits
+        los = rng.integers(0, 1 << 10, size=40)
+        for lo in los[:5]:
+            node.search("dash", body(lo))  # warm: columns + aggs.* grid
+        mark = _dispatch_mark()
+        dev_lats = []
+        dev_resps = []
+        for lo in los:
+            t0 = time.perf_counter()
+            dev_resps.append(node.search("dash", body(lo)))
+            dev_lats.append((time.perf_counter() - t0) * 1000)
+        disp = _dispatch_delta(mark)
+        eng = node._aggs["dash"][1]
+        agg_stats = {k: eng.stats[k] for k in
+                     ("device_nodes", "host_nodes", "plan_cache_hits",
+                      "plan_cache_misses", "mesh_dispatches")}
+
+        node.settings["search.aggs.device_enabled"] = "false"
+        host_lats = []
+        parity = True
+        for lo, dresp in zip(los, dev_resps):
+            t0 = time.perf_counter()
+            hresp = node.search("dash", body(lo))
+            host_lats.append((time.perf_counter() - t0) * 1000)
+            d, h = dict(dresp), dict(hresp)
+            d.pop("took", None), h.pop("took", None)
+            if json.dumps(d, sort_keys=True) != json.dumps(h,
+                                                           sort_keys=True):
+                parity = False
+        dev_p50 = float(np.percentile(dev_lats, 50))
+        host_p50 = float(np.percentile(host_lats, 50))
+        print(json.dumps({
+            "config": "8_device_aggs_dashboard",
+            "p50_ms": round(dev_p50, 2),
+            "p99_ms": round(float(np.percentile(dev_lats, 99)), 2),
+            "host_p50_ms": round(host_p50, 2),
+            "speedup_vs_host": round(host_p50 / max(dev_p50, 1e-9), 2),
+            "parity_vs_host": parity,
+            "n_docs": n_docs,
+            "aggs": agg_stats,
+            "build_s": round(build_s, 1),
+            "dispatch": disp}), flush=True)
+    finally:
+        node.close()
+
+
 def run_sharded_fused():
     """Config 6: the mesh-sharded serving path (PR 5) — exact kNN, IVF,
     and the fused hybrid plan each executing as ONE shard_map program
@@ -1139,6 +1242,7 @@ def main():
             "bf16", filter_frac=0.10)
     guarded(run_small_batch_serving)
     guarded(run_ivf_config)
+    guarded(run_device_aggs)
     guarded(run_sharded_fused)
 
 

@@ -10,6 +10,7 @@ TransportActions call into the node's services.
 from __future__ import annotations
 
 import copy
+import threading as _threading
 import time
 import uuid as _uuid
 from typing import Any, Dict, List, Optional
@@ -370,6 +371,12 @@ class Node:
         self._search_groups: Dict[str, int] = {}
         # per-index fused hybrid executors (search/hybrid_plan.py)
         self._hybrid: Dict[str, Any] = {}
+        # per-index device aggregation engines (search/agg_plan.py); the
+        # lock serializes creation — engines register per-shard refresh
+        # listeners, so a lost create-race would leak a permanently
+        # resyncing duplicate engine
+        self._aggs: Dict[str, Any] = {}
+        self._aggs_lock = _threading.Lock()
         self.counters: Dict[str, int] = {"search": 0, "index": 0, "get": 0,
                                          "bulk": 0, "delete": 0}
         # per-index get counts for indices-stats `get` section (GetStats)
@@ -1117,6 +1124,80 @@ class Node:
             if self.indices.indices.get(name) is not ex.svc:
                 del self._hybrid[name]
 
+    def _evict_stale_aggs(self) -> None:
+        """Same sweep for device-agg engines: a deleted/recreated index's
+        engine pins its columnar store and pollutes _nodes/stats."""
+        for name, (svc, _eng) in list(self._aggs.items()):
+            if self.indices.indices.get(name) is not svc:
+                del self._aggs[name]
+
+    def _agg_engine(self, svc):
+        """Per-index device aggregation engine (search/agg_plan.py),
+        created lazily like the hybrid executor; None when device aggs
+        are disabled (`search.aggs.device_enabled: false`). A refresh
+        listener resyncs warm columns in the background so a dashboard's
+        first post-refresh query doesn't pay the column rebuild inline —
+        the agg-store analog of `vectors/store.sync` at refresh."""
+        from elasticsearch_tpu.common.settings import setting_bool
+        enabled = self.settings.get("search.aggs.device_enabled")
+        if enabled is not None and not setting_bool(enabled):
+            return None
+        with self._aggs_lock:
+            self._evict_stale_aggs()
+            cached = self._aggs.get(svc.name)
+            if cached is not None and cached[0] is svc:
+                return cached[1]
+            from elasticsearch_tpu.search.agg_plan import AggEngine
+            engine = AggEngine(svc.mapper_service,
+                               warmup=self._dispatch_warmup)
+
+            def _resync(_reader, svc=svc, engine=engine):
+                def run():
+                    try:
+                        reader = svc.combined_reader()
+                        for field in engine.store.fields():
+                            col = engine.store.column(reader, field)
+                            engine.store.schedule_warmup(col)
+                    except Exception:  # pragma: no cover - background
+                        pass
+                if engine.store.fields():
+                    _threading.Thread(target=run, daemon=True,
+                                      name="agg-column-resync").start()
+
+            for shard in svc.shards:
+                shard.engine.add_refresh_listener(_resync)
+            self._aggs[svc.name] = (svc, engine)
+            return engine
+
+    def _aggs_stats_section(self) -> dict:
+        """Device-aggregation counters summed over local indices
+        (`_nodes/stats indices.aggs`): per-node device vs host-fallback
+        routing (with reasons), agg-plan cache hit rate, cumulative
+        device/assembly time, mesh dispatches, and columnar-store
+        footprint."""
+        out = {"searches": 0, "device_nodes": 0, "host_nodes": 0,
+               "plan_cache_hits": 0, "plan_cache_misses": 0,
+               "device_nanos": 0, "assemble_nanos": 0,
+               "mesh_dispatches": 0, "fallback_reasons": {},
+               "columns": 0, "column_bytes": 0, "column_rebuilds": 0}
+        with self._aggs_lock:
+            self._evict_stale_aggs()
+            engines = [eng for _svc, eng in self._aggs.values()]
+        for eng in engines:
+            for key in ("searches", "device_nodes", "host_nodes",
+                        "plan_cache_hits", "plan_cache_misses",
+                        "device_nanos", "assemble_nanos",
+                        "mesh_dispatches"):
+                out[key] += eng.stats.get(key, 0)
+            for reason, n in eng.stats.get("fallback_reasons",
+                                           {}).items():
+                out["fallback_reasons"][reason] = \
+                    out["fallback_reasons"].get(reason, 0) + n
+            out["columns"] += eng.store.stats.get("columns", 0)
+            out["column_bytes"] += eng.store.stats.get("bytes", 0)
+            out["column_rebuilds"] += eng.store.stats.get("rebuilds", 0)
+        return out
+
     def _hybrid_executor(self, svc):
         """Per-index fused hybrid serving path (plan cache + bounded
         combining queue), created lazily; replaced when the index is
@@ -1168,7 +1249,8 @@ class Node:
                       index_settings=svc.settings.as_flat_dict(),
                       max_buckets=self._max_buckets(),
                       allow_expensive=self._allow_expensive(),
-                      index_name=svc.name)
+                      index_name=svc.name,
+                      agg_engine=self._agg_engine(svc))
         from elasticsearch_tpu.search.service import execute_query_phase
         if frozen:
             return self.thread_pool.submit(
@@ -1432,7 +1514,8 @@ class Node:
                         svc.name, body, q_nanos, f_nanos,
                         result.total_hits,
                         knn_phases=result.knn_phases,
-                        dispatch_events=events))
+                        dispatch_events=events,
+                        aggs_profile=result.aggs_profile))
         finally:
             self.breakers.release("request", breaker_bytes)
             if profile_enabled:
@@ -2260,6 +2343,7 @@ class Node:
                 "evictions": self.caches.query.evictions},
             "knn": self._knn_stats_section(),
             "hybrid": self._hybrid_stats_section(),
+            "aggs": self._aggs_stats_section(),
             "dispatch": self._dispatch_stats_section(),
             "mesh": self._mesh_stats_section()}
         discovery_section = {

@@ -1,0 +1,731 @@
+"""Device-resident aggregations: columnar field store + segment-reduce kernels.
+
+The analytics half of `_search` (`search/aggregations` is one of the
+reference's largest subsystems) served entirely host-side until this
+module: `search/aggregations.py` reduced in numpy after a per-doc Python
+`get_doc_value` loop, so a terms agg over 100k matching rows cost 100k
+interpreter round-trips while the TPU idled. Terms/histogram/range/stats
+aggs are segment-reduce shapes — scatter-add over bucket ids — the exact
+kernel family `ops/bm25.py` already proves out for impact scoring, so this
+module gives doc-value fields the treatment `vectors/store.py` gives
+`dense_vector` and `ops/bm25.py` gives text:
+
+* build (at refresh, lazily on first agg use like `LexicalShard`): each
+  aggregated field becomes an `AggColumn` — an f64 value column + presence
+  mask over the reader's live rows (padded to a pow-2 row bucket so the
+  compiled shapes survive refreshes), plus, for terms aggs, a global
+  ordinal column (int32 ord per row over the sorted-unique value set).
+  Per-segment extractions cache by segment fingerprint, so append-only
+  refreshes re-extract only delta segments (copy-on-write rebuild — an
+  in-flight search keeps the previous column's arrays).
+
+* search: ONE dispatch per (bucket-source, metric) pair computes the fused
+  filter→aggregate: the query's matched rows arrive as a boolean mask over
+  the row bucket, bucket ids derive in-kernel from the resident key column
+  (ordinals for terms, affine floor for histogram/date_histogram, bound
+  comparisons for range), and a scatter-add reduces counts / sums / mins /
+  maxs per bucket into a board of `n_buckets + 1` lanes (the trash lane
+  collects pad rows and, for terms, the `missing` bucket).
+
+* exactness: every kernel traces and executes under the dispatcher's
+  scoped x64 flag — counts accumulate in int64 (order-free, exact), sums
+  in f64. Host parity for sums is guaranteed only for *integral* columns
+  (every value integer-valued, sum of |values| < 2^53 — dates, longs,
+  counts), where any accumulation order reproduces numpy's pairwise sum
+  bit-for-bit; `search/agg_plan.py` routes sum-bearing aggs on other
+  columns to the host path. min/max/counts are order-insensitive and run
+  on device for any numeric column.
+
+* mesh: columns past the `parallel/policy.py` row floor keep a row-sharded
+  device copy; the `aggs.mesh_*` twins reduce each shard's row range
+  locally inside one shard_map program and merge boards with
+  psum/pmin/pmax — exact for the integral-sum contract above, so the
+  per-shard device partials merge like every other mesh kernel.
+
+Kernel keys (`ops/dispatch.py`, strict closed grid): rows pad to the
+pow-2 row bucket fixed at column build; `n_buckets` rounds up
+AGG_B_LADDER; warmup pre-compiles the interactive rungs at column build.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.ops import dispatch
+
+logger = logging.getLogger("elasticsearch_tpu.aggs")
+
+# bucket-count ladder: terms cardinality / histogram span rounds UP so one
+# compiled program serves a band of bucket counts; beyond the last rung the
+# plan falls back to the host path (search.max_buckets territory anyway)
+AGG_B_LADDER = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                16384, 32768, 65536)
+
+# sums of integer-valued f64 are exact (== numpy's pairwise sum in any
+# accumulation order) while |sum| stays under 2^53
+_EXACT_INT = float(1 << 53)
+
+# warmup rungs: small terms/histogram dashboards; the persistent cache and
+# steady traffic fill the tail
+WARMUP_AGG_BUCKETS = (8, 64)
+
+
+def bucket_count(n: int) -> Optional[int]:
+    """Round a bucket count up the AGG_B_LADDER; None = off the grid
+    (the caller must fall back to the host path)."""
+    n = max(int(n), 1)
+    for b in AGG_B_LADDER:
+        if b >= n:
+            return b
+    return None
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def in_b_grid(b: int) -> bool:
+    return b in AGG_B_LADDER
+
+
+# ---------------------------------------------------------------------------
+# kernels (traced under scoped x64 — see ops/dispatch.py _Kernel.x64)
+# ---------------------------------------------------------------------------
+
+
+def _ord_targets(ords, n_buckets: int):
+    import jax.numpy as jnp
+    in_range = (ords >= 0) & (ords < n_buckets)
+    return jnp.where(in_range, ords, n_buckets)
+
+
+def _agg_ord_counts(ords, mask, n_buckets: int):
+    """Doc counts per ordinal: [B+1] int64; lane B collects matched rows
+    whose key is missing (the terms `missing` bucket) — pad rows have
+    mask False and never land anywhere."""
+    import jax.numpy as jnp
+    tgt = _ord_targets(ords, n_buckets)
+    return jnp.zeros(n_buckets + 1, dtype=jnp.int64).at[tgt].add(
+        jnp.where(mask, jnp.int64(1), jnp.int64(0)))
+
+
+def _metric_boards(tgt, ok, v_eff, n_buckets: int):
+    import jax.numpy as jnp
+    one = jnp.where(ok, jnp.int64(1), jnp.int64(0))
+    cnt = jnp.zeros(n_buckets + 1, dtype=jnp.int64).at[tgt].add(one)
+    s = jnp.zeros(n_buckets + 1, dtype=jnp.float64).at[tgt].add(
+        jnp.where(ok, v_eff, 0.0))
+    mn = jnp.full(n_buckets + 1, jnp.inf, dtype=jnp.float64).at[tgt].min(
+        jnp.where(ok, v_eff, jnp.inf))
+    mx = jnp.full(n_buckets + 1, -jnp.inf, dtype=jnp.float64).at[tgt].max(
+        jnp.where(ok, v_eff, -jnp.inf))
+    return cnt, s, mn, mx
+
+
+def _metric_eff(vals, present, mparams):
+    """Apply the metric field's `missing` substitute: mparams f64[2] =
+    (flag, value)."""
+    import jax.numpy as jnp
+    use_missing = mparams[0] > 0.0
+    p_eff = present | use_missing
+    v_eff = jnp.where(present, vals, mparams[1])
+    return v_eff, p_eff
+
+
+def _agg_ord_metric(ords, mask, mparams, vals, present, n_buckets: int):
+    """Per-ordinal numeric metric boards (count/sum/min/max); lane B is
+    the missing-key bucket's metrics."""
+    v_eff, p_eff = _metric_eff(vals, present, mparams)
+    tgt = _ord_targets(ords, n_buckets)
+    return _metric_boards(tgt, mask & p_eff, v_eff, n_buckets)
+
+
+def _hist_ids(keys, kpresent, hparams, n_buckets: int):
+    """Bucket ids from the resident key column: hparams f64[6] =
+    (interval, offset, base, div, kflag, kmissing). `div` pre-divides
+    (date_nanos → millis); `base` rebases floor((v-off)/interval) so ids
+    land in [0, B). All f64 — bitwise-identical to the host's numpy key
+    math."""
+    import jax.numpy as jnp
+    interval, offset, base, div = (hparams[0], hparams[1], hparams[2],
+                                   hparams[3])
+    p_eff = kpresent | (hparams[4] > 0.0)
+    v = jnp.where(kpresent, keys / div, hparams[5])
+    m = jnp.floor((v - offset) / interval)
+    ids = (m - base).astype(jnp.int32)
+    ok = p_eff & (ids >= 0) & (ids < n_buckets)
+    return jnp.where(ok, ids, n_buckets), ok
+
+
+def _agg_hist_counts(keys, kpresent, mask, hparams, n_buckets: int):
+    import jax.numpy as jnp
+    tgt, ok = _hist_ids(keys, kpresent, hparams, n_buckets)
+    return jnp.zeros(n_buckets + 1, dtype=jnp.int64).at[tgt].add(
+        jnp.where(mask & ok, jnp.int64(1), jnp.int64(0)))
+
+
+def _agg_hist_metric(keys, kpresent, mask, hparams, mparams, vals, present,
+                     n_buckets: int):
+    tgt, ok = _hist_ids(keys, kpresent, hparams, n_buckets)
+    v_eff, p_eff = _metric_eff(vals, present, mparams)
+    return _metric_boards(tgt, mask & ok & p_eff, v_eff, n_buckets)
+
+
+def _range_members(keys, kpresent, mask, bounds, rparams):
+    """[B, R] membership: bounds f64[B, 2] (lo, hi) with -inf/+inf for
+    open ends and (+inf, +inf) pad rows; rparams f64[2] applies the key
+    field's `missing` substitute. A row may belong to several overlapping
+    ranges — exactly the host semantics."""
+    import jax.numpy as jnp
+    p_eff = kpresent | (rparams[0] > 0.0)
+    v = jnp.where(kpresent, keys, rparams[1])
+    ok = mask & p_eff
+    return ((v[None, :] >= bounds[:, 0:1]) & (v[None, :] < bounds[:, 1:2])
+            & ok[None, :])
+
+
+def _agg_range_counts(keys, kpresent, mask, bounds, rparams):
+    import jax.numpy as jnp
+    m = _range_members(keys, kpresent, mask, bounds, rparams)
+    return m.astype(jnp.int64).sum(axis=1)
+
+
+def _agg_range_metric(keys, kpresent, mask, bounds, rparams, mparams, vals,
+                      present):
+    import jax.numpy as jnp
+    m = _range_members(keys, kpresent, mask, bounds, rparams)
+    v_eff, p_eff = _metric_eff(vals, present, mparams)
+    mm = m & p_eff[None, :]
+    cnt = mm.astype(jnp.int64).sum(axis=1)
+    s = jnp.where(mm, v_eff[None, :], 0.0).sum(axis=1)
+    mn = jnp.where(mm, v_eff[None, :], jnp.inf).min(axis=1)
+    mx = jnp.where(mm, v_eff[None, :], -jnp.inf).max(axis=1)
+    return cnt, s, mn, mx
+
+
+# ----------------------------------------------------------------- mesh ----
+
+def _mesh_reduce(local_fn, mesh, row_args, repl_args, n_boards):
+    """Run a board-producing local reduce per shard over row-sharded
+    columns and merge boards with psum/pmin/pmax (exact under the
+    integral-sum contract). Boards are (cnt int64[, sum f64, min f64,
+    max f64]): index 0 and 1 merge by sum, 2 by min, 3 by max."""
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    from elasticsearch_tpu.parallel.sharded_knn import shard_map
+
+    axis = mesh_lib.SHARD_AXIS
+    row_spec = jax.sharding.PartitionSpec(axis)
+    repl = jax.sharding.PartitionSpec()
+
+    def body(*args):
+        boards = local_fn(*args)
+        if not isinstance(boards, tuple):
+            boards = (boards,)
+        merged = []
+        for i, b in enumerate(boards):
+            if i == 2:
+                merged.append(jax.lax.pmin(b, axis))
+            elif i == 3:
+                merged.append(jax.lax.pmax(b, axis))
+            else:
+                merged.append(jax.lax.psum(b, axis))
+        return merged[0] if n_boards == 1 else tuple(merged)
+
+    in_specs = tuple([row_spec] * len(row_args) + [repl] * len(repl_args))
+    out_specs = repl if n_boards == 1 else tuple([repl] * n_boards)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return fn(*row_args, *repl_args)
+
+
+# Every row-shaped array (key column, presence, mask, metric columns)
+# shards over the row axis; small per-query params/bounds replicate. Each
+# shard reduces its own row range into a full [B+1] board, then the boards
+# merge in-program (psum for counts/sums, pmin/pmax for extrema).
+
+def _agg_mesh_ord_counts(ords, mask, n_buckets: int, mesh=None):
+    return _mesh_reduce(
+        lambda o, m: _agg_ord_counts(o, m, n_buckets), mesh,
+        (ords, mask), (), 1)
+
+
+def _agg_mesh_ord_metric(ords, mask, vals, present, mparams,
+                         n_buckets: int, mesh=None):
+    return _mesh_reduce(
+        lambda o, m, v, p, mp: _agg_ord_metric(o, m, mp, v, p, n_buckets),
+        mesh, (ords, mask, vals, present), (mparams,), 4)
+
+
+def _agg_mesh_hist_counts(keys, kpresent, mask, hparams, n_buckets: int,
+                          mesh=None):
+    return _mesh_reduce(
+        lambda k, kp, m, hp: _agg_hist_counts(k, kp, m, hp, n_buckets),
+        mesh, (keys, kpresent, mask), (hparams,), 1)
+
+
+def _agg_mesh_hist_metric(keys, kpresent, mask, vals, present, hparams,
+                          mparams, n_buckets: int, mesh=None):
+    return _mesh_reduce(
+        lambda k, kp, m, v, p, hp, mp: _agg_hist_metric(
+            k, kp, m, hp, mp, v, p, n_buckets),
+        mesh, (keys, kpresent, mask, vals, present), (hparams, mparams), 4)
+
+
+def _agg_mesh_range_counts(keys, kpresent, mask, bounds, rparams, mesh=None):
+    return _mesh_reduce(
+        _agg_range_counts, mesh, (keys, kpresent, mask), (bounds, rparams),
+        1)
+
+
+def _agg_mesh_range_metric(keys, kpresent, mask, vals, present, bounds,
+                           rparams, mparams, mesh=None):
+    return _mesh_reduce(
+        lambda k, kp, m, v, p, b, rp, mp: _agg_range_metric(
+            k, kp, m, b, rp, mp, v, p),
+        mesh, (keys, kpresent, mask, vals, present),
+        (bounds, rparams, mparams), 4)
+
+
+# ------------------------------------------------------------ grid checks --
+
+def _row_bucket_ok(r: int) -> bool:
+    return r >= 1 and (r & (r - 1)) == 0
+
+
+def _grid_ord(statics, sigs) -> bool:
+    r = sigs[0][0][0]
+    return _row_bucket_ok(int(r)) and in_b_grid(int(statics["n_buckets"]))
+
+
+def _grid_hist(statics, sigs) -> bool:
+    r = sigs[0][0][0]
+    return _row_bucket_ok(int(r)) and in_b_grid(int(statics["n_buckets"]))
+
+
+def _grid_range(statics, sigs) -> bool:
+    r = sigs[0][0][0]
+    # bounds [B, 2] rides the 4th positional array arg
+    b = None
+    for s in sigs:
+        if s and s[0] != "py" and len(s[0]) == 2 and s[0][1] == 2:
+            b = s[0][0]
+            break
+    return _row_bucket_ok(int(r)) and (b is None or in_b_grid(int(b)))
+
+
+def _register():
+    reg = dispatch.DISPATCH.register
+    reg("aggs.ord_counts", _agg_ord_counts,
+        static_argnames=("n_buckets",), grid_check=_grid_ord, x64=True)
+    reg("aggs.ord_metric", _agg_ord_metric,
+        static_argnames=("n_buckets",), grid_check=_grid_ord, x64=True)
+    reg("aggs.hist_counts", _agg_hist_counts,
+        static_argnames=("n_buckets",), grid_check=_grid_hist, x64=True)
+    reg("aggs.hist_metric", _agg_hist_metric,
+        static_argnames=("n_buckets",), grid_check=_grid_hist, x64=True)
+    reg("aggs.range_counts", _agg_range_counts,
+        grid_check=_grid_range, x64=True)
+    reg("aggs.range_metric", _agg_range_metric,
+        grid_check=_grid_range, x64=True)
+    reg("aggs.mesh_ord_counts", _agg_mesh_ord_counts,
+        static_argnames=("n_buckets", "mesh"), grid_check=_grid_ord,
+        x64=True)
+    reg("aggs.mesh_ord_metric", _agg_mesh_ord_metric,
+        static_argnames=("n_buckets", "mesh"), grid_check=_grid_ord,
+        x64=True)
+    reg("aggs.mesh_hist_counts", _agg_mesh_hist_counts,
+        static_argnames=("n_buckets", "mesh"), grid_check=_grid_hist,
+        x64=True)
+    reg("aggs.mesh_hist_metric", _agg_mesh_hist_metric,
+        static_argnames=("n_buckets", "mesh"), grid_check=_grid_hist,
+        x64=True)
+    reg("aggs.mesh_range_counts", _agg_mesh_range_counts,
+        static_argnames=("mesh",), grid_check=_grid_range, x64=True)
+    reg("aggs.mesh_range_metric", _agg_mesh_range_metric,
+        static_argnames=("mesh",), grid_check=_grid_range, x64=True)
+
+
+_register()
+
+
+# ---------------------------------------------------------------------------
+# columnar field store
+# ---------------------------------------------------------------------------
+
+
+class _SegmentColumn:
+    """One segment's live-row extraction for one field, cached by the
+    segment fingerprint (append-only refreshes re-extract only deltas)."""
+
+    __slots__ = ("fingerprint", "vals", "present", "objs", "multi_valued")
+
+    def __init__(self, fingerprint, vals, present, objs, multi_valued):
+        self.fingerprint = fingerprint
+        self.vals = vals            # f64[n_live] (nan where absent)
+        self.present = present      # bool[n_live]
+        self.objs = objs            # object[n_live] raw doc values (or None)
+        self.multi_valued = multi_valued
+
+
+def _extract_segment_column(view, field: str, want_objs: bool
+                            ) -> _SegmentColumn:
+    seg = view.segment
+    n_live = int(view.live.sum())
+    fp = (seg.seg_id, seg.num_docs, n_live, want_objs)
+    col = seg.doc_values.get(field)
+    vals = np.full(n_live, np.nan, dtype=np.float64)
+    present = np.zeros(n_live, dtype=bool)
+    objs = np.empty(n_live, dtype=object) if want_objs else None
+    multi = False
+    if col is not None and n_live:
+        live_idx = np.nonzero(view.live)[0]
+        raw = None
+        if want_objs or col.numeric is None:
+            raw = np.empty(n_live, dtype=object)
+            for i, loc in enumerate(live_idx):
+                v = col.values[int(loc)]
+                raw[i] = v
+                if isinstance(v, list):
+                    multi = True
+            if want_objs:
+                objs = raw
+        else:
+            # multi-valuedness must be known even for pure-numeric
+            # columns: the f64 view keeps only a doc's FIRST value, which
+            # matches numeric_values but NOT all_values — value_count
+            # (and terms) bind-checks depend on this flag being real
+            multi = any(isinstance(col.values[int(loc)], list)
+                        for loc in live_idx)
+        if col.numeric is not None:
+            vals[:] = col.numeric[live_idx]
+            present[:] = col.present[live_idx]
+            vals[~present] = np.nan
+        else:
+            # numeric view of a non-numeric-first column, with EXACTLY the
+            # aggregations.numeric_values coercion: bools -> 1/0, numerics
+            # -> float, first element of lists, strings/geo absent
+            for i in range(n_live):
+                v = raw[i]
+                if isinstance(v, list):
+                    v = v[0] if v else None
+                if v is None:
+                    continue
+                if isinstance(v, bool):
+                    vals[i] = 1.0 if v else 0.0
+                    present[i] = True
+                elif isinstance(v, (int, float)):
+                    vals[i] = float(v)
+                    present[i] = True
+    return _SegmentColumn(fp, vals, present, objs, multi)
+
+
+class AggColumn:
+    """One field's columnar agg data over a reader snapshot, padded to the
+    store's pow-2 row bucket. Device mirrors upload lazily (under the
+    scoped x64 flag so f64 survives) and a mesh-sharded copy is kept when
+    the serving policy would route this corpus to the mesh."""
+
+    __slots__ = ("field", "version", "n_rows", "r_pad", "vals", "present",
+                 "numeric", "integral_exact", "multi_valued", "ords_built",
+                 "ords", "ord_keys", "vmin", "vmax",
+                 "_device", "_device_mesh", "_device_mesh_key")
+
+    def __init__(self, field: str):
+        self.field = field
+        self.version: tuple = None
+        self.n_rows = 0
+        self.r_pad = 1
+        self.vals = np.full(1, np.nan, dtype=np.float64)
+        self.present = np.zeros(1, dtype=bool)
+        self.numeric = False
+        self.integral_exact = False
+        self.multi_valued = False
+        self.ords_built = False
+        self.ords: Optional[np.ndarray] = None    # int32[r_pad], -1 absent
+        self.ord_keys: List[Any] = []             # ord -> raw key value
+        self.vmin = None
+        self.vmax = None
+        self._device = None
+        self._device_mesh = None
+        self._device_mesh_key = None
+
+    # ------------------------------------------------------------- device
+    def device_arrays(self):
+        """(vals f64, present, ords int32|None) resident jax arrays."""
+        if self._device is not None:
+            return self._device
+        import jax.numpy as jnp
+        from elasticsearch_tpu.ops.dispatch import _x64_scope
+        with _x64_scope(True):
+            vals = jnp.asarray(self.vals)
+            present = jnp.asarray(self.present)
+            ords = None if self.ords is None else jnp.asarray(self.ords)
+        self._device = (vals, present, ords)
+        return self._device
+
+    def device_arrays_mesh(self, mesh):
+        """Row-sharded device copies for the mesh kernels (r_pad must
+        divide by the shard count; the caller checks)."""
+        if (self._device_mesh is not None
+                and self._device_mesh_key is mesh):
+            return self._device_mesh
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elasticsearch_tpu.ops.dispatch import _x64_scope
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        row = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
+        with _x64_scope(True):
+            vals = jax.device_put(jnp.asarray(self.vals), row)
+            present = jax.device_put(jnp.asarray(self.present), row)
+            ords = None if self.ords is None else \
+                jax.device_put(jnp.asarray(self.ords), row)
+        self._device_mesh = (vals, present, ords)
+        self._device_mesh_key = mesh
+        return self._device_mesh
+
+
+class StoreSnapshot:
+    """Immutable per-reader row-space description: built once per segment
+    composition and handed to the whole compute pass, so a concurrent
+    refresh-resync (which advances the store to a NEWER reader) can never
+    swap the row map out from under an in-flight search's mask."""
+
+    __slots__ = ("version", "row_map", "n_rows", "r_pad")
+
+    def __init__(self, version, row_map):
+        self.version = version
+        self.row_map = row_map
+        self.n_rows = len(row_map)
+        self.r_pad = _pow2(max(self.n_rows, 1))
+
+    def filter_mask(self, rows: np.ndarray) -> np.ndarray:
+        """Matched-row mask over the padded row bucket — the `filter` half
+        of the fused plan (vectorized; rows are engine global rows)."""
+        mask = np.zeros(self.r_pad, dtype=bool)
+        if len(rows):
+            mask[: self.n_rows] = np.isin(self.row_map, rows)
+        return mask
+
+
+class AggFieldStore:
+    """Per-index columnar agg store over the combined reader: one
+    AggColumn per touched field, rebuilt copy-on-write when the segment
+    composition changes. Mirrors `ops/bm25.LexicalShard`'s lazy-sync
+    contract — most refreshes never serve an agg, so columns build on
+    first agg use and re-extract only delta segments after that."""
+
+    def __init__(self, warmup: Optional[bool] = None):
+        self._columns: Dict[str, AggColumn] = {}
+        self._seg_cache: Dict[Tuple[str, int], _SegmentColumn] = {}
+        self._lock = threading.Lock()
+        self._snap: Optional[StoreSnapshot] = None
+        self.warmup = warmup
+        self.stats = {"rebuilds": 0, "columns": 0, "bytes": 0}
+        self._zero_ords: Dict[Any, Any] = {}
+
+    @staticmethod
+    def _fingerprint(reader) -> tuple:
+        return tuple((v.segment.seg_id, v.segment.num_docs,
+                      int(v.live.sum())) for v in reader.views)
+
+    def snapshot(self, reader) -> StoreSnapshot:
+        """The (cached) immutable row-space snapshot for this reader."""
+        version = self._fingerprint(reader)
+        with self._lock:
+            if self._snap is not None and self._snap.version == version:
+                return self._snap
+        snap = StoreSnapshot(version, reader.live_global_rows())
+        with self._lock:
+            cur = self._snap
+            if cur is not None and cur.version == version:
+                return cur  # raced with an identical build: share it
+            self._snap = snap
+        return snap
+
+    def fields(self) -> List[str]:
+        with self._lock:
+            return sorted(self._columns)
+
+    def column(self, reader, field: str, want_ords: bool = False,
+               snap: Optional[StoreSnapshot] = None) -> AggColumn:
+        """The field's column for this reader snapshot, building or
+        delta-rebuilding as needed. The returned column is consistent
+        with `snap` (same version/row bucket) by construction."""
+        if snap is None:
+            snap = self.snapshot(reader)
+        with self._lock:
+            col = self._columns.get(field)
+            if col is not None and col.version == snap.version \
+                    and (not want_ords or col.ords_built):
+                return col
+            col = self._build(reader, snap, field, want_ords
+                              or (col is not None and col.ords_built))
+            self._columns[field] = col
+            self.stats["rebuilds"] += 1
+            self.stats["columns"] = len(self._columns)
+            self.stats["bytes"] = sum(
+                c.vals.nbytes + c.present.nbytes
+                + (c.ords.nbytes if c.ords is not None else 0)
+                for c in self._columns.values())
+            return col
+
+    def _build(self, reader, snap: StoreSnapshot, field: str,
+               want_ords: bool) -> AggColumn:
+        col = AggColumn(field)
+        col.version = snap.version
+        col.n_rows = snap.n_rows
+        col.r_pad = snap.r_pad
+        vals = np.full(snap.r_pad, np.nan, dtype=np.float64)
+        present = np.zeros(snap.r_pad, dtype=bool)
+        obj_parts: List[np.ndarray] = []
+        off = 0
+        multi = False
+        fresh: Dict[Tuple[str, int], _SegmentColumn] = {
+            k: v for k, v in self._seg_cache.items() if k[0] != field}
+        for view in reader.views:
+            key = (field, view.segment.seg_id)
+            n_live = int(view.live.sum())
+            fp = (view.segment.seg_id, view.segment.num_docs, n_live,
+                  want_ords)
+            sc = self._seg_cache.get(key)
+            if sc is None or sc.fingerprint != fp:
+                sc = _extract_segment_column(view, field, want_ords)
+            fresh[key] = sc
+            vals[off:off + n_live] = sc.vals
+            present[off:off + n_live] = sc.present
+            if sc.objs is not None:
+                obj_parts.append(sc.objs)
+            elif want_ords:
+                obj_parts.append(np.empty(n_live, dtype=object))
+            multi = multi or sc.multi_valued
+            off += n_live
+        self._seg_cache = fresh
+        col.vals = vals
+        col.present = present
+        col.multi_valued = multi
+        col.ords_built = bool(want_ords)
+        # the f64 column IS the numeric_values view: string/geo values are
+        # simply absent from it, which matches the host loop's skip
+        col.numeric = True
+        pv = vals[present]
+        if len(pv):
+            col.vmin = float(pv.min())
+            col.vmax = float(pv.max())
+            finite = np.isfinite(pv)
+            col.integral_exact = bool(
+                finite.all() and np.all(pv == np.floor(pv))
+                and float(np.abs(pv).sum()) < _EXACT_INT)
+        else:
+            col.integral_exact = True  # empty sums are trivially exact
+        if want_ords and not multi:
+            # global ordinals over the raw doc values (raw objects, not the
+            # f64 view — terms keys keep int/str/bool identity)
+            ords = np.full(snap.r_pad, -1, dtype=np.int32)
+            keys: List[Any] = []
+            index: Dict[Any, int] = {}
+            if obj_parts:
+                objs = np.concatenate(obj_parts)
+                for i in range(off):
+                    v = objs[i]
+                    if v is None:
+                        continue
+                    k = tuple(v) if isinstance(v, (list, tuple)) else v
+                    o = index.get(k)
+                    if o is None:
+                        o = index[k] = len(keys)
+                        keys.append(v)
+                    ords[i] = o
+            col.ords = ords
+            col.ord_keys = keys
+        return col
+
+    # ------------------------------------------------------------- warmup
+    def warmup_entries(self, col: AggColumn, mesh=None) -> list:
+        """Dispatch warmup grid for one freshly-built column (shape-only
+        specs — no data materialized)."""
+        import jax
+        import jax.numpy as jnp
+        r = col.r_pad
+        f64 = jax.ShapeDtypeStruct((r,), np.dtype(np.float64))
+        b1 = jax.ShapeDtypeStruct((r,), np.dtype(bool))
+        i32 = jax.ShapeDtypeStruct((r,), np.dtype(np.int32))
+        hp = jax.ShapeDtypeStruct((6,), np.dtype(np.float64))
+        mp = jax.ShapeDtypeStruct((2,), np.dtype(np.float64))
+        entries = []
+        rungs = set(WARMUP_AGG_BUCKETS)
+        if col.ords is not None and col.ord_keys:
+            b_ord = bucket_count(len(col.ord_keys))
+            if b_ord is not None:
+                rungs.add(b_ord)
+        for b in sorted(rungs):
+            if col.ords is not None:
+                entries.append(("aggs.ord_counts", (i32, b1),
+                                {"n_buckets": b}))
+                entries.append(("aggs.ord_metric", (i32, b1, mp, f64, b1),
+                                {"n_buckets": b}))
+            if col.numeric:
+                entries.append(("aggs.hist_counts", (f64, b1, b1, hp),
+                                {"n_buckets": b}))
+                entries.append(("aggs.hist_metric",
+                                (f64, b1, b1, hp, mp, f64, b1),
+                                {"n_buckets": b}))
+        if col.numeric:
+            bounds = jax.ShapeDtypeStruct((AGG_B_LADDER[0], 2),
+                                          np.dtype(np.float64))
+            entries.append(("aggs.range_counts", (f64, b1, b1, bounds, mp),
+                            {}))
+            entries.append(("aggs.range_metric",
+                            (f64, b1, b1, bounds, mp, mp, f64, b1), {}))
+        return entries
+
+    def schedule_warmup(self, col: AggColumn) -> None:
+        if not dispatch.warmup_enabled(self.warmup):
+            return
+        entries = self.warmup_entries(col)
+        if entries:
+            dispatch.DISPATCH.warmup(entries, background=True)
+
+    def zero_ords(self, r_pad: int, mesh=None):
+        """Cached all-zero int32 ordinal column over the row bucket — the
+        bucket-id source for whole-match metric reduces (every row lands
+        in lane 0)."""
+        key = (r_pad, mesh)
+        with self._lock:
+            z = self._zero_ords.get(key)
+            if z is not None:
+                return z
+        import jax
+        import jax.numpy as jnp
+        zeros = jnp.zeros(r_pad, dtype=jnp.int32)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from elasticsearch_tpu.parallel import mesh as mesh_lib
+            zeros = jax.device_put(
+                zeros, NamedSharding(mesh, P(mesh_lib.SHARD_AXIS)))
+        with self._lock:
+            if len(self._zero_ords) > 8:
+                self._zero_ords.clear()
+            self._zero_ords[key] = zeros
+        return zeros
+
+    @staticmethod
+    def mesh_ready(snap: StoreSnapshot, mesh) -> bool:
+        """The aggs mesh kernels shard the row bucket evenly; a row bucket
+        smaller than the shard axis can't."""
+        if mesh is None:
+            return False
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        s = int(mesh.shape[mesh_lib.SHARD_AXIS])
+        return snap.r_pad % s == 0 and snap.r_pad >= s

@@ -179,15 +179,32 @@ class DispatchGridEscape(RuntimeError):
 
 class _Kernel:
     __slots__ = ("name", "fn", "static_argnames", "donate_argnums",
-                 "grid_check", "jitted")
+                 "grid_check", "jitted", "x64")
 
-    def __init__(self, name, fn, static_argnames, donate_argnums, grid_check):
+    def __init__(self, name, fn, static_argnames, donate_argnums, grid_check,
+                 x64=False):
         self.name = name
         self.fn = fn
         self.static_argnames = tuple(static_argnames)
         self.donate_argnums = tuple(donate_argnums)
         self.grid_check = grid_check
         self.jitted = None  # built lazily (jax import cost)
+        # x64 kernels trace AND execute under jax.experimental.enable_x64:
+        # the process default stays 32-bit (the serving kernels are f32 by
+        # design), but 64-bit accumulator kernels (aggs.*: int64 counts,
+        # f64 sums — date millis don't fit int32/f32) need the scoped flag
+        # both at lower() time (canonicalization runs during tracing) and
+        # at call time (the AOT executable's arg-aval check canonicalizes
+        # host numpy inputs against the active config).
+        self.x64 = bool(x64)
+
+
+def _x64_scope(enabled: bool):
+    if not enabled:
+        import contextlib
+        return contextlib.nullcontext()
+    from jax.experimental import enable_x64
+    return enable_x64()
 
 
 class _Entry:
@@ -244,14 +261,17 @@ class Dispatcher:
     def register(self, name: str, fn: Callable, *,
                  static_argnames: Sequence[str] = (),
                  donate_argnums: Sequence[int] = (),
-                 grid_check: Optional[Callable[..., bool]] = None) -> None:
+                 grid_check: Optional[Callable[..., bool]] = None,
+                 x64: bool = False) -> None:
         """Register a raw (un-jitted) kernel. `grid_check(statics, sigs)`
         receives the static kwargs dict and the flat arg signature list
         [(shape, dtype) | py-leaf ...]; return False to flag the compile
-        as outside the declared grid."""
+        as outside the declared grid. `x64` kernels trace and execute
+        under the scoped jax enable_x64 flag (64-bit accumulators)."""
         with self._lock:
             self._kernels[name] = _Kernel(name, fn, static_argnames,
-                                          donate_argnums, grid_check)
+                                          donate_argnums, grid_check,
+                                          x64=x64)
 
     def kernels(self) -> List[str]:
         return sorted(self._kernels)
@@ -298,7 +318,8 @@ class Dispatcher:
         entry, key_str, compiled_now, compile_nanos = self._get_entry(
             kernel, args, static_kwargs, warmup=False, sig=sig)
         self._event(name, key_str, not compiled_now, compile_nanos)
-        return entry.compiled(*args)
+        with _x64_scope(kernel.x64):
+            return entry.compiled(*args)
 
     def _signature(self, args) -> Tuple[Any, Tuple]:
         import jax
@@ -377,7 +398,8 @@ class Dispatcher:
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable")
         t0 = time.perf_counter_ns()
-        compiled = kernel.jitted.lower(*args, **static_kwargs).compile()
+        with _x64_scope(kernel.x64):
+            compiled = kernel.jitted.lower(*args, **static_kwargs).compile()
         nanos = time.perf_counter_ns() - t0
         entry = _Entry(compiled, key_str, nanos)
         with self._lock:

@@ -1,0 +1,1065 @@
+"""Fused filter→aggregate device plans for the core aggregation family.
+
+The execution layer over `ops/aggs.py`: an agg body compiles ONCE into an
+`AggPlan` (cached per index on the normalized body — the hybrid plan-cache
+template trick generalized to agg bodies, so a dashboard's repeated shape
+plans once and only the per-query numeric slots re-bind), and each search
+executes the plan as a handful of pre-compiled dispatches: the matched row
+set becomes a boolean mask over the columnar store's row bucket, bucket
+ids derive in-kernel from resident key columns, and scatter-add boards
+come back as `n_buckets + 1` lanes of counts / sums / mins / maxs.
+
+Supported on device — numerically IDENTICAL to `compute_aggs` (final
+mode) and `compute_partial_aggs` (distributed partial mode), pinned by
+tests/test_device_aggs.py:
+
+  terms            keyword / numeric / boolean / date / ip fields
+                   (size, shard_size, missing, min_doc_count incl. 0,
+                   order by _key/_count)
+  histogram        interval, offset, missing, min_doc_count,
+                   extended_bounds, format
+  date_histogram   fixed intervals (+ offset, format, time_zone
+                   rendering); calendar intervals fall back
+  range            numeric from/to/key ranges (overlaps allowed)
+  metrics          avg, sum, min, max, stats, value_count — top-level and
+                   as one-level sub-aggs of any bucket agg above
+
+Everything else — geo, cardinality/HLL, percentiles, pipelines as
+sub-aggs, scripted, include/exclude, nested, composite, multi-valued
+fields — falls through PER NODE to the host path (`compute_aggs` /
+`compute_partial_aggs`), and sum-bearing metrics (sum/avg/stats) ride the
+device only for integral columns where f64 scatter-adds are provably
+order-free (see ops/aggs.py): exactness is a contract, not a tolerance.
+
+Partial mode emits the SAME `$p`-tagged partial-reduction states
+`search/agg_partials.py` merges today, so mesh/multi-index serving gets
+per-shard device partials merged through the existing
+`merge_partial_aggs` with zero coordinator changes. The SPMD row-sharded
+twins route through `parallel/policy.py` like every other kernel.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError, SearchEngineError,
+)
+from elasticsearch_tpu.ops import aggs as aggs_ops
+from elasticsearch_tpu.ops import dispatch
+from elasticsearch_tpu.search import aggregations as A
+
+logger = logging.getLogger("elasticsearch_tpu.agg_plan")
+
+SUPPORTED_METRICS = ("avg", "sum", "min", "max", "stats", "value_count")
+SUM_KINDS = ("avg", "sum", "stats")
+
+# mapper types whose doc values live faithfully in the f64 column
+_NUMERIC_TNAMES = ("long", "integer", "short", "byte", "double", "float",
+                   "half_float", "scaled_float", "date", "date_nanos",
+                   "boolean", "ip")
+
+_TERMS_ALLOWED_KEYS = {"field", "size", "shard_size", "missing",
+                       "min_doc_count", "order", "value_type"}
+_HISTO_ALLOWED_KEYS = {"field", "interval", "offset", "min_doc_count",
+                       "missing", "extended_bounds", "format"}
+_DATE_HISTO_ALLOWED_KEYS = {"field", "interval", "fixed_interval",
+                            "calendar_interval", "offset", "min_doc_count",
+                            "format", "time_zone"}
+_RANGE_ALLOWED_KEYS = {"field", "ranges", "keyed"}
+
+
+class _Fallback(Exception):
+    """Bind-time device rejection: run this node on the host instead."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class _SubMetric:
+    __slots__ = ("name", "kind", "field")
+
+    def __init__(self, name, kind, field):
+        self.name = name
+        self.kind = kind
+        self.field = field
+
+
+class _Node:
+    """One top-level agg's compiled classification. mode: 'host' |
+    'metric' | 'terms' | 'histogram' | 'date_histogram' | 'range'."""
+
+    __slots__ = ("name", "mode", "kind", "field", "subs", "host_reason")
+
+    def __init__(self, name, mode, kind=None, field=None, subs=(),
+                 host_reason=None):
+        self.name = name
+        self.mode = mode
+        self.kind = kind
+        self.field = field
+        self.subs = list(subs)
+        self.host_reason = host_reason
+
+
+class AggPlan:
+    __slots__ = ("nodes", "device_count")
+
+    def __init__(self, nodes: Dict[str, _Node]):
+        self.nodes = nodes
+        self.device_count = sum(1 for n in nodes.values()
+                                if n.mode != "host")
+
+
+# ---------------------------------------------------------------------------
+# plan cache key: the hybrid `plan_cache_key` trick for agg bodies — the
+# per-query numeric slots (interval/offset/bounds/missing) scrub to
+# placeholders so a dashboard sweeping a slider re-uses one plan; kinds,
+# fields, sizes and everything classification reads stay structural.
+# ---------------------------------------------------------------------------
+
+
+def plan_cache_key(aggs_spec: dict) -> str:
+    def scrub_node(spec):
+        if not isinstance(spec, dict):
+            return spec
+        out = {}
+        for kind, body in spec.items():
+            if kind in ("aggs", "aggregations"):
+                out[kind] = {n: scrub_node(s)
+                             for n, s in (body or {}).items()}
+                continue
+            if not isinstance(body, dict):
+                out[kind] = body
+                continue
+            b = dict(body)
+            if kind == "histogram":
+                for key in ("interval", "offset", "missing",
+                            "extended_bounds"):
+                    if key in b:
+                        b[key] = "__v__"
+            elif kind == "date_histogram":
+                # interval strings stay: "month" vs "1h" changes the
+                # calendar-vs-fixed classification itself
+                for key in ("offset", "missing"):
+                    if key in b:
+                        b[key] = "__v__"
+            elif kind == "range":
+                if isinstance(b.get("ranges"), list):
+                    b["ranges"] = [
+                        {k: ("__v__" if k in ("from", "to") else v)
+                         for k, v in r.items()} if isinstance(r, dict)
+                        else r
+                        for r in b["ranges"]]
+            elif kind in SUPPORTED_METRICS:
+                if "missing" in b:
+                    b["missing"] = "__v__"
+            out[kind] = b
+        return out
+
+    from elasticsearch_tpu.search.caches import _canonical
+    return _canonical({n: scrub_node(s)
+                       for n, s in (aggs_spec or {}).items()})
+
+
+# ---------------------------------------------------------------------------
+# plan compile (structural classification only — column-dependent checks
+# happen at bind time, because columns change with every refresh)
+# ---------------------------------------------------------------------------
+
+
+def _classify_metric(kind: str, body, mapper_service) -> Optional[str]:
+    """None = device-eligible; otherwise the host-fallback reason."""
+    if not isinstance(body, dict):
+        return "malformed"
+    if body.get("script") is not None:
+        return "script"
+    field = body.get("field")
+    if not isinstance(field, str):
+        return "no_field"
+    mapper = mapper_service.get(field)
+    tname = getattr(mapper, "type_name", None)
+    if tname is None:
+        return "unmapped_field"
+    if tname not in _NUMERIC_TNAMES:
+        # keyword/text raise host-side for numeric-only metrics, and
+        # value_count over keyword counts string values the f64 column
+        # can't see — both are host business
+        return "non_numeric_field"
+    return None
+
+
+def _classify_subs(sub_spec: dict, mapper_service) -> Tuple[list, str]:
+    subs: List[_SubMetric] = []
+    for sname, sspec in (sub_spec or {}).items():
+        if not isinstance(sspec, dict):
+            return [], "malformed_sub"
+        skinds = [k for k in sspec
+                  if k not in ("aggs", "aggregations", "meta")]
+        if len(skinds) != 1 or skinds[0] not in SUPPORTED_METRICS:
+            return [], "unsupported_sub_agg"
+        if sspec.get("aggs") or sspec.get("aggregations"):
+            return [], "sub_sub_aggs"
+        reason = _classify_metric(skinds[0], sspec[skinds[0]],
+                                  mapper_service)
+        if reason is not None:
+            return [], f"sub_{reason}"
+        subs.append(_SubMetric(sname, skinds[0],
+                               sspec[skinds[0]]["field"]))
+    return subs, ""
+
+
+def compile_plan(aggs_spec: dict, mapper_service) -> AggPlan:
+    nodes: Dict[str, _Node] = {}
+    for name, spec in (aggs_spec or {}).items():
+        if not isinstance(spec, dict):
+            nodes[name] = _Node(name, "host", host_reason="malformed")
+            continue
+        kinds = [k for k in spec
+                 if k not in ("aggs", "aggregations", "meta")]
+        if len(kinds) != 1:
+            nodes[name] = _Node(name, "host", host_reason="malformed")
+            continue
+        kind = kinds[0]
+        body = spec[kind]
+        sub_spec = spec.get("aggs") or spec.get("aggregations") or {}
+        if kind in A.PIPELINE_AGGS:
+            nodes[name] = _Node(name, "host", kind=kind,
+                                host_reason="pipeline")
+            continue
+        if kind in SUPPORTED_METRICS and not sub_spec:
+            reason = _classify_metric(kind, body, mapper_service)
+            if reason is None:
+                nodes[name] = _Node(name, "metric", kind=kind,
+                                    field=body["field"])
+            else:
+                nodes[name] = _Node(name, "host", kind=kind,
+                                    host_reason=reason)
+            continue
+        if kind in ("terms", "histogram", "date_histogram", "range") \
+                and isinstance(body, dict):
+            reason = _classify_bucket(kind, body, mapper_service)
+            subs, sub_reason = (([], "") if reason else
+                                _classify_subs(sub_spec, mapper_service))
+            reason = reason or sub_reason
+            if not reason:
+                nodes[name] = _Node(name, kind, kind=kind,
+                                    field=body.get("field"), subs=subs)
+                continue
+            nodes[name] = _Node(name, "host", kind=kind,
+                                host_reason=reason)
+            continue
+        nodes[name] = _Node(name, "host", kind=kind,
+                            host_reason="unsupported_agg")
+    return AggPlan(nodes)
+
+
+def _classify_bucket(kind: str, body: dict, mapper_service) -> str:
+    field = body.get("field")
+    if not isinstance(field, str) or field == "_index":
+        return "no_field"
+    if body.get("script") is not None:
+        return "script"
+    if kind == "terms":
+        if not set(body) <= _TERMS_ALLOWED_KEYS:
+            return "unsupported_param"
+        order = body.get("order")
+        if order is not None:
+            if not (isinstance(order, dict) and len(order) == 1
+                    and next(iter(order)) in ("_key", "_count")):
+                return "order_by_metric"
+            if next(iter(order)) == "_count" \
+                    and int(body.get("min_doc_count", 1)) == 0:
+                # zero-count buckets tie at 0 and the host breaks that tie
+                # by its term-universe SET iteration order — not a
+                # contract the device path can reproduce
+                return "order_count_zero_buckets"
+        return ""
+    mapper = mapper_service.get(field)
+    tname = getattr(mapper, "type_name", None)
+    if kind == "histogram":
+        if not set(body) <= _HISTO_ALLOWED_KEYS:
+            return "unsupported_param"
+        return ""
+    if kind == "date_histogram":
+        if not set(body) <= _DATE_HISTO_ALLOWED_KEYS:
+            return "unsupported_param"
+        from elasticsearch_tpu.index.mapping import RangeFieldMapperBase
+        if isinstance(mapper, RangeFieldMapperBase):
+            return "range_field"
+        return ""
+    if kind == "range":
+        if not set(body) <= _RANGE_ALLOWED_KEYS:
+            return "unsupported_param"
+        ranges = body.get("ranges")
+        if not isinstance(ranges, list) or not ranges or any(
+                not isinstance(r, dict) or "mask" in r for r in ranges):
+            return "unsupported_ranges"
+        return ""
+    return "unsupported_agg"
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class AggEngine:
+    """Per-index device aggregation engine: columnar store + plan cache +
+    per-node device/host routing. `compute` returns (aggregations tree,
+    profile info) — final JSON in single-pass mode, `$p` partial states in
+    distributed-partial mode — or None when no node is device-eligible
+    (the caller then runs the unchanged host path)."""
+
+    def __init__(self, mapper_service, plan_cache_entries: int = 128,
+                 warmup: Optional[bool] = None):
+        from elasticsearch_tpu.search.caches import LruCache
+        self.mapper_service = mapper_service
+        self.store = aggs_ops.AggFieldStore(warmup=warmup)
+        self.plan_cache = LruCache(max_entries=plan_cache_entries)
+        self._lock = threading.Lock()
+        self.stats = {
+            "searches": 0, "device_nodes": 0, "host_nodes": 0,
+            "plan_cache_hits": 0, "plan_cache_misses": 0,
+            "device_nanos": 0, "assemble_nanos": 0,
+            "mesh_dispatches": 0, "fallback_reasons": {},
+        }
+
+    # ---------------------------------------------------------------- plan
+    def plan_for(self, aggs_spec: dict) -> AggPlan:
+        key = plan_cache_key(aggs_spec)
+        plan = self.plan_cache.get(key)
+        if plan is not None:
+            with self._lock:
+                self.stats["plan_cache_hits"] += 1
+            return plan
+        plan = compile_plan(aggs_spec, self.mapper_service)
+        self.plan_cache.put(key, plan)
+        with self._lock:
+            self.stats["plan_cache_misses"] += 1
+        return plan
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[key] += n
+
+    def _reason(self, reason: str) -> None:
+        with self._lock:
+            r = self.stats["fallback_reasons"]
+            r[reason] = r.get(reason, 0) + 1
+
+    # ------------------------------------------------------------- compute
+    def compute(self, ctx, rows: np.ndarray, aggs_spec: dict,
+                partial: bool = False) -> Optional[Tuple[dict, dict]]:
+        if getattr(ctx, "nested_path", None):
+            return None
+        plan = self.plan_for(aggs_spec)
+        if plan.device_count == 0:
+            return None
+        self._count("searches")
+        # one immutable row-space snapshot for the whole pass: a refresh
+        # resync advancing the store mid-request can't skew the mask
+        mask_box: Dict[str, Any] = {"snap": self.store.snapshot(ctx.reader)}
+        out: Dict[str, Any] = {}
+        pipelines: List[Tuple[str, str, dict]] = []
+        prof_nodes: List[dict] = []
+        device_nanos = 0
+        assemble_nanos = 0
+        for name, spec in aggs_spec.items():
+            if not isinstance(spec, dict):
+                raise ParsingError(f"aggregation [{name}] must be an object")
+            kinds = [k for k in spec
+                     if k not in ("aggs", "aggregations", "meta")]
+            if len(kinds) != 1:
+                raise ParsingError(
+                    f"aggregation [{name}] must define exactly one type")
+            kind = kinds[0]
+            if kind in A.PIPELINE_AGGS:
+                if not partial:
+                    pipelines.append((name, kind, spec[kind]))
+                continue
+            node = plan.nodes.get(name)
+            res = None
+            engine = "host"
+            reason = node.host_reason if node is not None else None
+            if node is not None and node.mode != "host":
+                try:
+                    t0 = time.perf_counter_ns()
+                    boards, mesh_used = self._run_device_node(
+                        ctx, node, spec, rows, mask_box)
+                    t1 = time.perf_counter_ns()
+                    res = self._assemble_node(
+                        ctx, node, spec, rows, boards, partial)
+                    t2 = time.perf_counter_ns()
+                    device_nanos += t1 - t0
+                    assemble_nanos += t2 - t1
+                    engine = "device_mesh" if mesh_used else "device"
+                    self._count("device_nodes")
+                except _Fallback as fb:
+                    reason = fb.reason
+                    self._reason(fb.reason)
+                except SearchEngineError:
+                    raise  # parity errors (max_buckets, bad params)
+                except Exception as exc:  # pragma: no cover - safety net
+                    reason = "device_error"
+                    self._reason("device_error")
+                    logger.warning(
+                        "device agg [%s] failed; serving from host: %s",
+                        name, exc)
+            if res is None:
+                if node is not None and node.mode == "host" \
+                        and node.host_reason:
+                    self._reason(node.host_reason)
+                sub = {name: spec}
+                if partial:
+                    from elasticsearch_tpu.search.agg_partials import (
+                        compute_partial_aggs)
+                    res = compute_partial_aggs(ctx, rows, sub).get(name)
+                else:
+                    res = A.compute_aggs(ctx, rows, sub).get(name)
+                self._count("host_nodes")
+            elif not partial and isinstance(spec.get("meta"), dict) \
+                    and isinstance(res, dict):
+                res["meta"] = spec["meta"]
+            out[name] = res
+            prof_nodes.append({"name": name, "engine": engine,
+                               **({"fallback_reason": reason}
+                                  if engine == "host" and reason else {})})
+        # top-level pipelines run over the combined outputs, exactly as
+        # compute_aggs does (partial mode defers them to the coordinator's
+        # finalize, like agg_partials)
+        for name, kind, spec in pipelines:
+            res = A._compute_pipeline(out, kind, spec, name)
+            if not (isinstance(res, dict) and "_applied" in res):
+                out[name] = res
+        with self._lock:
+            self.stats["device_nanos"] += device_nanos
+            self.stats["assemble_nanos"] += assemble_nanos
+        profile = {"nodes": prof_nodes, "device_nanos": device_nanos,
+                   "assemble_nanos": assemble_nanos}
+        return out, profile
+
+    # ----------------------------------------------------------- dispatch
+    def _mask_for(self, rows, mask_box) -> np.ndarray:
+        mask = mask_box.get("mask")
+        if mask is None:
+            mask = mask_box["snap"].filter_mask(rows)
+            mask_box["mask"] = mask
+        return mask
+
+    def _mesh_for(self, mask_box):
+        """Route this node's reduce: mesh or single-device (counted by
+        parallel/policy like every other kernel leg)."""
+        from elasticsearch_tpu.parallel import policy
+        snap = mask_box["snap"]
+        mesh = policy.decide("aggs", snap.n_rows,
+                             has_mesh_state=self.store.mesh_ready(
+                                 snap, policy.serving_mesh()))
+        return mesh
+
+    @staticmethod
+    def _check_metric_col(kind: str, col) -> None:
+        if kind in SUM_KINDS and not col.integral_exact:
+            raise _Fallback("non_integral_sum")
+        if kind == "value_count" and col.multi_valued:
+            # value_count counts every VALUE (all_values) while the f64
+            # column keeps only a doc's first — host business
+            raise _Fallback("multi_valued_field")
+
+    def _metric_cols(self, ctx, node, snap):
+        cols = {}
+        for m in node.subs:
+            col = self.store.column(ctx.reader, m.field, snap=snap)
+            self._check_metric_col(m.kind, col)
+            cols[m.name] = (m, col)
+        return cols
+
+    @staticmethod
+    def _mparams(mspec: dict) -> np.ndarray:
+        missing = mspec.get("missing")
+        if missing is None:
+            return np.zeros(2, dtype=np.float64)
+        try:
+            return np.asarray([1.0, float(missing)], dtype=np.float64)
+        except (TypeError, ValueError):
+            raise _Fallback("bad_missing_value")
+
+    def _sharded(self, mesh, arrays):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elasticsearch_tpu.ops.dispatch import _x64_scope
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        row = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
+        with _x64_scope(True):
+            return [jax.device_put(jnp.asarray(a), row) for a in arrays]
+
+    def _run_device_node(self, ctx, node, spec, rows, mask_box):
+        store = self.store
+        reader = ctx.reader
+        snap = mask_box["snap"]
+        body = spec[node.kind]
+        mask = self._mask_for(rows, mask_box)
+        mesh = self._mesh_for(mask_box)
+        boards: Dict[str, Any] = {"n_matched": int(len(rows))}
+        mesh_used = False
+
+        if node.mode == "terms":
+            col = store.column(reader, node.field, want_ords=True,
+                               snap=snap)
+            if col.multi_valued:
+                raise _Fallback("multi_valued_field")
+            b = aggs_ops.bucket_count(max(len(col.ord_keys), 1))
+            if b is None:
+                raise _Fallback("cardinality_off_grid")
+            mcols = self._metric_cols(ctx, node, snap)
+            if mesh is not None:
+                vals_d, pres_d, ords_d = col.device_arrays_mesh(mesh)
+                (mask_d,) = self._sharded(mesh, [mask])
+                counts = dispatch.call("aggs.mesh_ord_counts", ords_d,
+                                       mask_d, n_buckets=b, mesh=mesh)
+                mboards = {}
+                for mname, (m, mc) in mcols.items():
+                    mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
+                    mboards[mname] = dispatch.call(
+                        "aggs.mesh_ord_metric", ords_d, mask_d, mv_d,
+                        mp_d, self._mparams(_sub_body(spec, mname)),
+                        n_buckets=b, mesh=mesh)
+                mesh_used = True
+            else:
+                _v, _p, ords_d = col.device_arrays()
+                counts = dispatch.call("aggs.ord_counts", ords_d, mask,
+                                       n_buckets=b)
+                mboards = {}
+                for mname, (m, mc) in mcols.items():
+                    mv_d, mp_d, _ = mc.device_arrays()
+                    mboards[mname] = dispatch.call(
+                        "aggs.ord_metric", ords_d, mask,
+                        self._mparams(_sub_body(spec, mname)), mv_d,
+                        mp_d, n_buckets=b)
+            boards.update(counts=np.asarray(counts),
+                          metrics=_np_boards(mboards), col=col, mask=mask)
+
+        elif node.mode in ("histogram", "date_histogram"):
+            col = store.column(reader, node.field, snap=snap)
+            hparams, meta = self._hist_params(node, body, col)
+            boards["hist_meta"] = meta
+            b = meta["n_buckets"]
+            mcols = self._metric_cols(ctx, node, snap)
+            if b == 0:
+                # nothing present and no missing substitute: zero boards
+                boards.update(
+                    counts=np.zeros(1, dtype=np.int64),
+                    metrics={n: (np.zeros(1, np.int64),
+                                 np.zeros(1, np.float64),
+                                 np.full(1, np.inf), np.full(1, -np.inf))
+                             for n in mcols},
+                    col=col)
+                return boards, False
+            if mesh is not None:
+                keys_d, kp_d, _ = col.device_arrays_mesh(mesh)
+                (mask_d,) = self._sharded(mesh, [mask])
+                counts = dispatch.call("aggs.mesh_hist_counts", keys_d,
+                                       kp_d, mask_d, hparams,
+                                       n_buckets=b, mesh=mesh)
+                mboards = {}
+                for mname, (m, mc) in mcols.items():
+                    mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
+                    mboards[mname] = dispatch.call(
+                        "aggs.mesh_hist_metric", keys_d, kp_d, mask_d,
+                        mv_d, mp_d, hparams,
+                        self._mparams(_sub_body(spec, mname)),
+                        n_buckets=b, mesh=mesh)
+                mesh_used = True
+            else:
+                keys_d, kp_d, _ = col.device_arrays()
+                counts = dispatch.call("aggs.hist_counts", keys_d, kp_d,
+                                       mask, hparams, n_buckets=b)
+                mboards = {}
+                for mname, (m, mc) in mcols.items():
+                    mv_d, mp_d, _ = mc.device_arrays()
+                    mboards[mname] = dispatch.call(
+                        "aggs.hist_metric", keys_d, kp_d, mask, hparams,
+                        self._mparams(_sub_body(spec, mname)), mv_d,
+                        mp_d, n_buckets=b)
+            boards.update(counts=np.asarray(counts),
+                          metrics=_np_boards(mboards), col=col)
+
+        elif node.mode == "range":
+            col = store.column(reader, node.field, snap=snap)
+            bounds, frm_to = self._range_bounds(body)
+            boards["frm_to"] = frm_to
+            rparams = self._mparams(body)
+            mcols = self._metric_cols(ctx, node, snap)
+            if mesh is not None:
+                keys_d, kp_d, _ = col.device_arrays_mesh(mesh)
+                (mask_d,) = self._sharded(mesh, [mask])
+                counts = dispatch.call("aggs.mesh_range_counts", keys_d,
+                                       kp_d, mask_d, bounds, rparams,
+                                       mesh=mesh)
+                mboards = {}
+                for mname, (m, mc) in mcols.items():
+                    mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
+                    mboards[mname] = dispatch.call(
+                        "aggs.mesh_range_metric", keys_d, kp_d, mask_d,
+                        mv_d, mp_d, bounds, rparams,
+                        self._mparams(_sub_body(spec, mname)), mesh=mesh)
+                mesh_used = True
+            else:
+                keys_d, kp_d, _ = col.device_arrays()
+                counts = dispatch.call("aggs.range_counts", keys_d, kp_d,
+                                       mask, bounds, rparams)
+                mboards = {}
+                for mname, (m, mc) in mcols.items():
+                    mv_d, mp_d, _ = mc.device_arrays()
+                    mboards[mname] = dispatch.call(
+                        "aggs.range_metric", keys_d, kp_d, mask, bounds,
+                        rparams, self._mparams(_sub_body(spec, mname)),
+                        mv_d, mp_d)
+            boards.update(counts=np.asarray(counts),
+                          metrics=_np_boards(mboards), col=col)
+
+        elif node.mode == "metric":
+            col = store.column(reader, node.field, snap=snap)
+            self._check_metric_col(node.kind, col)
+            zeros = store.zero_ords(snap.r_pad, mesh)
+            mparams = self._mparams(body)
+            mv_d, mp_d, _ = (col.device_arrays_mesh(mesh)
+                             if mesh is not None else col.device_arrays())
+            if mesh is not None:
+                (mask_d,) = self._sharded(mesh, [mask])
+                board = dispatch.call("aggs.mesh_ord_metric", zeros,
+                                      mask_d, mv_d, mp_d, mparams,
+                                      n_buckets=aggs_ops.AGG_B_LADDER[0],
+                                      mesh=mesh)
+                mesh_used = True
+            else:
+                board = dispatch.call("aggs.ord_metric", zeros, mask,
+                                      mparams, mv_d, mp_d,
+                                      n_buckets=aggs_ops.AGG_B_LADDER[0])
+            boards.update(metric=_np_board(board), col=col)
+
+        if mesh_used:
+            from elasticsearch_tpu.parallel import mesh as mesh_lib
+            from elasticsearch_tpu.parallel import policy
+            s = int(mesh.shape[mesh_lib.SHARD_AXIS])
+            n_boards = 1 + 4 * len(node.subs)
+            b_len = len(boards.get("counts",
+                                   boards.get("metric", (np.zeros(1),))[0]))
+            policy.record_leg("aggs", 0, 0,
+                              policy.gather_bytes(s, n_boards, b_len))
+            self._count("mesh_dispatches")
+        return boards, mesh_used
+
+    def _hist_params(self, node, body, col):
+        date = node.mode == "date_histogram"
+        if date:
+            interval, calendar = A._date_interval(body)
+            if calendar:
+                raise _Fallback("calendar_interval")
+            offset = A._date_offset_ms(body.get("offset"))
+            mapper = self.mapper_service.get(node.field)
+            div = 1e6 if getattr(mapper, "type_name", None) == "date_nanos" \
+                else 1.0
+            missing = None
+        else:
+            try:
+                interval = float(body["interval"])
+            except (KeyError, TypeError, ValueError):
+                raise _Fallback("bad_interval")
+            offset = float(body.get("offset", 0.0))
+            div = 1.0
+            missing = body.get("missing")
+        if not (interval > 0) or not math.isfinite(interval):
+            raise _Fallback("bad_interval")
+        vmin, vmax = col.vmin, col.vmax
+        if div != 1.0:
+            vmin = None if vmin is None else vmin / div
+            vmax = None if vmax is None else vmax / div
+        kflag, kmiss = 0.0, 0.0
+        if missing is not None:
+            try:
+                kmiss = float(missing)
+            except (TypeError, ValueError):
+                raise _Fallback("bad_missing_value")
+            kflag = 1.0
+            has_absent = not bool(col.present[: col.n_rows].all())
+            if vmin is None:
+                vmin = vmax = kmiss
+            elif has_absent:
+                vmin, vmax = min(vmin, kmiss), max(vmax, kmiss)
+        if vmin is None or not (math.isfinite(vmin) and math.isfinite(vmax)):
+            base = 0.0
+            n_buckets = 0 if vmin is None else None
+            if n_buckets is None:
+                raise _Fallback("non_finite_keys")
+        else:
+            base = math.floor((vmin - offset) / interval)
+            top = math.floor((vmax - offset) / interval)
+            span = int(top - base) + 1
+            bb = aggs_ops.bucket_count(span)
+            if bb is None:
+                raise _Fallback("span_off_grid")
+            n_buckets = bb
+        hparams = np.asarray([interval, offset, base, div, kflag, kmiss],
+                             dtype=np.float64)
+        meta = {"interval": interval, "offset": offset, "base": base,
+                "date": date, "n_buckets": n_buckets,
+                "fmt": body.get("format"),
+                "tz": A._resolve_tz(body.get("time_zone")) if date
+                else None}
+        return hparams, meta
+
+    @staticmethod
+    def _range_bounds(body):
+        ranges = body.get("ranges", [])
+        b = aggs_ops.bucket_count(len(ranges))
+        if b is None:
+            raise _Fallback("ranges_off_grid")
+        bounds = np.full((b, 2), np.inf, dtype=np.float64)
+        frm_to = []
+        for i, r in enumerate(ranges):
+            try:
+                frm = float(r["from"]) if r.get("from") is not None else None
+                to = float(r["to"]) if r.get("to") is not None else None
+            except (TypeError, ValueError):
+                raise _Fallback("bad_range_bound")
+            bounds[i, 0] = -np.inf if frm is None else frm
+            bounds[i, 1] = np.inf if to is None else to
+            frm_to.append((frm, to))
+        return bounds, frm_to
+
+    # ----------------------------------------------------------- assembly
+    def _assemble_node(self, ctx, node, spec, rows, boards, partial):
+        body = spec[node.kind]
+        sub_bodies = {m.name: _sub_body(spec, m.name) for m in node.subs}
+        sub_kinds = {m.name: m.kind for m in node.subs}
+        if node.mode == "metric":
+            cnt, s, mn, mx = boards["metric"]
+            return self._metric_out(node.kind, body, int(cnt[0]),
+                                    float(s[0]), float(mn[0]),
+                                    float(mx[0]), node.field, partial)
+        if node.mode == "terms":
+            return self._assemble_terms(ctx, node, body, boards,
+                                        sub_kinds, sub_bodies, partial)
+        if node.mode in ("histogram", "date_histogram"):
+            return self._assemble_histo(ctx, node, body, boards,
+                                        sub_kinds, sub_bodies, partial)
+        if node.mode == "range":
+            return self._assemble_range(ctx, node, body, boards,
+                                        sub_kinds, sub_bodies, partial)
+        raise _Fallback("unsupported_agg")
+
+    def _metric_out(self, kind, mspec, cnt, s, mn, mx, field, partial):
+        if partial:
+            if kind == "value_count":
+                return {"$p": "value_count", "n": int(cnt)}
+            if kind == "avg":
+                return {"$p": "avg", "sum": float(s), "n": int(cnt)}
+            if kind == "sum":
+                return {"$p": "sum", "sum": float(s)}
+            if kind == "min":
+                return {"$p": "min", "v": float(mn) if cnt else None}
+            if kind == "max":
+                return {"$p": "max", "v": float(mx) if cnt else None}
+            if kind == "stats":
+                return {"$p": "stats", "n": int(cnt), "sum": float(s),
+                        "min": float(mn) if cnt else None,
+                        "max": float(mx) if cnt else None}
+            raise _Fallback("unsupported_metric")
+        if kind == "value_count":
+            return {"value": int(cnt)}
+        if kind == "avg":
+            out = {"value": s / cnt if cnt else None}
+            tname = getattr(self.mapper_service.get(field), "type_name",
+                            None) if field else None
+            if out["value"] is not None and tname in ("date", "date_nanos"):
+                ms = out["value"] / 1e6 if tname == "date_nanos" \
+                    else out["value"]
+                out["value_as_string"] = A._millis_to_iso(int(round(ms)))
+            return out
+        if kind == "sum":
+            return {"value": float(s)}
+        if kind == "min":
+            return {"value": float(mn) if cnt else None}
+        if kind == "max":
+            return {"value": float(mx) if cnt else None}
+        if kind == "stats":
+            if cnt == 0:
+                return {"count": 0, "min": None, "max": None, "avg": None,
+                        "sum": 0.0}
+            return {"count": int(cnt), "min": float(mn), "max": float(mx),
+                    "avg": s / cnt, "sum": float(s)}
+        raise _Fallback("unsupported_metric")
+
+    def _sub_outputs(self, b, lane, metrics, sub_kinds, sub_bodies,
+                     partial, merge_lane=None):
+        for mname, (cnt, s, mn, mx) in metrics.items():
+            c, ss, m1, m2 = (int(cnt[lane]), float(s[lane]),
+                             float(mn[lane]), float(mx[lane]))
+            if merge_lane is not None:
+                c += int(cnt[merge_lane])
+                ss += float(s[merge_lane])
+                m1 = min(m1, float(mn[merge_lane]))
+                m2 = max(m2, float(mx[merge_lane]))
+            mbody = sub_bodies[mname]
+            field = mbody.get("field")
+            b[mname] = self._metric_out(sub_kinds[mname], mbody, c, ss,
+                                        m1, m2, field, partial)
+
+    def _empty_sub_outputs(self, b, metrics, sub_kinds, sub_bodies,
+                           partial):
+        # a zero-count (gap-filled) bucket has no rows, so its metrics are
+        # the empty-set outputs regardless of any `missing` substitute
+        for mname in metrics:
+            mbody = sub_bodies[mname]
+            b[mname] = self._metric_out(sub_kinds[mname], mbody, 0, 0.0,
+                                        float("inf"), float("-inf"),
+                                        mbody.get("field"), partial)
+
+    # ------------------------------------------------------------- terms
+    def _assemble_terms(self, ctx, node, body, boards, sub_kinds,
+                        sub_bodies, partial):
+        from elasticsearch_tpu.index.mapping import parse_date_millis
+        col = boards["col"]
+        counts = boards["counts"]
+        metrics = boards["metrics"]
+        trash = len(counts) - 1
+        field = node.field
+        mapper = self.mapper_service.get(field) if field else None
+        tname = getattr(mapper, "type_name", None) or body.get("value_type")
+
+        size = int(body.get("size", 10))
+        if partial:
+            size = int(body.get("shard_size") or (size * 3 // 2 + 10))
+
+        def fmt_key(k):
+            if tname == "ip":
+                from elasticsearch_tpu.index.mapping import IpFieldMapper
+                try:
+                    return IpFieldMapper.format_value(int(k))
+                except (ValueError, TypeError):
+                    return k
+            return k
+
+        key_index = {A._hashable(k): i for i, k in enumerate(col.ord_keys)}
+        items: List[Tuple[Any, int, Any]] = []  # (key, count, lane)
+        for i, k in enumerate(col.ord_keys):
+            items.append([A._hashable(k), int(counts[i]), i, None])
+
+        missing_val = body.get("missing")
+        if missing_val is not None:
+            mv = missing_val
+            if tname in ("date", "date_nanos") and isinstance(mv, str):
+                try:
+                    mv = parse_date_millis(mv)
+                except Exception:
+                    pass
+            elif tname in ("long", "integer", "short", "byte"):
+                try:
+                    mv = int(mv)
+                except (TypeError, ValueError):
+                    raise ParsingError(
+                        f"failed to parse [missing] value [{mv}] as a long")
+            elif tname in ("double", "float", "half_float"):
+                try:
+                    mv = float(mv)
+                except (TypeError, ValueError):
+                    raise ParsingError(
+                        f"failed to parse [missing] value [{mv}] as a "
+                        f"double")
+            miss_cnt = int(counts[trash])
+            ki = key_index.get(A._hashable(mv))
+            if ki is not None:
+                items[ki][1] += miss_cnt
+                items[ki][3] = trash
+            elif miss_cnt > 0:
+                items.append([A._hashable(mv), miss_cnt, trash, None])
+
+        mdc = int(body.get("min_doc_count", 1))
+        if mdc != 0:
+            items = [it for it in items if it[1] > 0]
+
+        if mapper is not None:
+            _tn = getattr(mapper, "type_name", None)
+            if (_tn == "keyword" or (_tn == "text"
+                                     and (mapper.params or {})
+                                     .get("fielddata"))):
+                self.mapper_service.mark_fielddata_loaded(field)
+
+        order_spec = body.get("order")
+        if not partial and order_spec and isinstance(order_spec, dict):
+            ((okey, odir),) = order_spec.items()
+            reverse = odir == "desc"
+            if okey == "_key":
+                items.sort(key=lambda it: A._sort_key(it[0]),
+                           reverse=reverse)
+            else:  # "_count" (order-by-metric never compiles to device)
+                # host ties break by groups-dict insertion order = first
+                # occurrence among the MATCHED rows; reproduce it from the
+                # mask, then stable-sort by count so ties keep that order
+                # under both directions (python's reverse=True keeps the
+                # pre-sort order for equal keys, like the host's)
+                mask = boards["mask"]
+                marr = col.ords[: col.n_rows][mask[: col.n_rows]]
+                marr = marr[marr >= 0]
+                uniq, first = np.unique(marr, return_index=True)
+                pos = {int(o): int(f) for o, f in zip(uniq, first)}
+                items.sort(key=lambda it: pos.get(it[2], float("inf")))
+                items.sort(key=lambda it: (it[1],), reverse=reverse)
+        else:
+            items.sort(key=lambda it: (-it[1], A._sort_key(it[0])))
+
+        total_other = sum(it[1] for it in items[size:])
+        A._check_max_buckets(ctx, min(len(items), size))
+        buckets = []
+        for key, c, lane, merge_lane in items[:size]:
+            b = {"key": key, "doc_count": int(c)}
+            if metrics:
+                self._sub_outputs(b, lane, metrics, sub_kinds, sub_bodies,
+                                  partial, merge_lane=merge_lane)
+            buckets.append(b)
+        if tname == "ip":
+            for b in buckets:
+                b["key"] = fmt_key(b["key"])
+        elif tname == "boolean":
+            for b in buckets:
+                truthy = bool(b["key"])
+                b["key"] = 1 if truthy else 0
+                b["key_as_string"] = "true" if truthy else "false"
+        elif tname == "date":
+            for b in buckets:
+                if isinstance(b["key"], (int, float)):
+                    b["key_as_string"] = A._millis_to_iso(int(b["key"]))
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": int(total_other),
+                "buckets": buckets}
+
+    # ---------------------------------------------------------- histogram
+    def _assemble_histo(self, ctx, node, body, boards, sub_kinds,
+                        sub_bodies, partial):
+        meta = boards["hist_meta"]
+        counts = boards["counts"]
+        metrics = boards["metrics"]
+        interval = meta["interval"]
+        offset = meta["offset"]
+        base = meta["base"]
+        date = meta["date"]
+        fmt = meta["fmt"]
+        tz = meta["tz"]
+        n_b = meta["n_buckets"]
+        min_count = -1 if partial else int(body.get("min_doc_count", 0))
+        extended_bounds = body.get("extended_bounds")
+
+        groups: Dict[float, int] = {}  # float key -> board lane
+        for i in range(n_b):
+            if int(counts[i]) > 0:
+                key = float((base + i) * interval + offset)
+                groups[key] = i
+        all_keys = sorted(groups)
+
+        def _guard_span(lo_key, hi_key):
+            if interval and (hi_key - lo_key) / interval > A.MAX_BUCKETS:
+                raise IllegalArgumentError(
+                    f"Trying to create too many buckets. Must be less "
+                    f"than or equal to: [{A.MAX_BUCKETS}].")
+
+        if extended_bounds and interval:
+            lo = float(extended_bounds.get("min", np.inf))
+            hi = float(extended_bounds.get("max", -np.inf))
+            k = min([lo] + all_keys) if all_keys or lo != np.inf else lo
+            top = max([hi] + all_keys) if all_keys or hi != -np.inf else hi
+            _guard_span(k, top)
+            cur = k
+            full = []
+            while cur <= top + 1e-9:
+                full.append(round(cur, 10))
+                cur += interval
+            all_keys = full
+        elif min_count == 0 and all_keys and interval:
+            _guard_span(all_keys[0], all_keys[-1])
+            full = []
+            cur = all_keys[0]
+            while cur <= all_keys[-1] + 1e-9:
+                full.append(round(cur, 10))
+                cur += interval
+            all_keys = full
+        A._check_max_buckets(ctx, len(all_keys))
+        buckets = []
+        for key in all_keys:
+            lane = groups.get(key)
+            c = int(counts[lane]) if lane is not None else 0
+            if c < min_count and min_count > 0:
+                continue
+            b = {"key": int(key) if date else key, "doc_count": c}
+            if date:
+                b["key_as_string"] = A._format_date_key(int(key), fmt, tz) \
+                    if fmt else A._millis_to_iso_tz(int(key), tz)
+            if metrics:
+                if lane is not None:
+                    self._sub_outputs(b, lane, metrics, sub_kinds,
+                                      sub_bodies, partial)
+                else:
+                    self._empty_sub_outputs(b, metrics, sub_kinds,
+                                            sub_bodies, partial)
+            buckets.append(b)
+        out = {"buckets": buckets}
+        if not date:
+            f = body.get("format")
+            if f:
+                for b in out["buckets"]:
+                    b["key_as_string"] = A._decimal_format(b["key"], f)
+        return out
+
+    # -------------------------------------------------------------- range
+    def _assemble_range(self, ctx, node, body, boards, sub_kinds,
+                        sub_bodies, partial):
+        counts = boards["counts"]
+        metrics = boards["metrics"]
+        frm_to = boards["frm_to"]
+        ranges = body.get("ranges", [])
+        buckets = []
+        for i, r in enumerate(ranges):
+            frm, to = frm_to[i]
+            key = r.get("key")
+            if key is None:
+                lo_s = "*" if frm is None else float(frm)
+                hi_s = "*" if to is None else float(to)
+                key = f"{lo_s}-{hi_s}"
+            b = {"key": key, "doc_count": int(counts[i])}
+            if frm is not None:
+                b["from"] = float(frm)
+            if to is not None:
+                b["to"] = float(to)
+            if metrics:
+                self._sub_outputs(b, i, metrics, sub_kinds, sub_bodies,
+                                  partial)
+            b["_sort"] = (frm if frm is not None else -np.inf,
+                          to if to is not None else np.inf)
+            buckets.append(b)
+        buckets.sort(key=lambda b: b.pop("_sort"))
+        return {"buckets": buckets}
+
+
+def _sub_body(spec: dict, sub_name: str) -> dict:
+    sub = spec.get("aggs") or spec.get("aggregations") or {}
+    sspec = sub.get(sub_name) or {}
+    for k, v in sspec.items():
+        if k not in ("aggs", "aggregations", "meta"):
+            return v if isinstance(v, dict) else {}
+    return {}
+
+
+def _np_board(board) -> tuple:
+    return tuple(np.asarray(x) for x in board)
+
+
+def _np_boards(mboards: dict) -> dict:
+    return {n: _np_board(b) for n, b in mboards.items()}

@@ -93,7 +93,8 @@ def mesh_stats_delta(before: dict, after: dict) -> Optional[dict]:
 def shard_profile(index_name: str, body: dict, query_nanos: int,
                   fetch_nanos: int, total_hits: int,
                   knn_phases: Optional[dict] = None,
-                  dispatch_events: Optional[list] = None) -> dict:
+                  dispatch_events: Optional[list] = None,
+                  aggs_profile: Optional[dict] = None) -> dict:
     kind, description = _describe_query(body)
     breakdown = {
         "score": query_nanos * 7 // 10,
@@ -171,15 +172,37 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
         profile["dispatch"] = dispatch_events
     if (body or {}).get("aggs") or (body or {}).get("aggregations"):
         aggs = body.get("aggs") or body.get("aggregations")
-        profile["aggregations"] = [
-            {"type": next(iter(spec.keys() - {"aggs", "aggregations", "meta"}),
-                          "unknown"),
-             "description": name,
-             "time_in_nanos": 0,
-             "breakdown": {"collect": 0, "collect_count": total_hits,
-                           "build_aggregation": 0,
-                           "build_aggregation_count": 1,
-                           "initialize": 0, "initialize_count": 1,
-                           "reduce": 0, "reduce_count": 0}}
-            for name, spec in aggs.items()]
+        # the device-agg engine (search/agg_plan.py) reports which nodes
+        # reduced on device vs fell through to the host walkers; `collect`
+        # carries the device-dispatch time, `build_aggregation` the host
+        # assembly time (both whole-request figures attributed to each
+        # device node — the engine times the fused pass, not per node)
+        engines = {n["name"]: n
+                   for n in (aggs_profile or {}).get("nodes", [])}
+        entries = []
+        for name, spec in aggs.items():
+            info = engines.get(name, {})
+            on_device = str(info.get("engine", "")).startswith("device")
+            device_ns = (aggs_profile or {}).get("device_nanos", 0) \
+                if on_device else 0
+            assemble_ns = (aggs_profile or {}).get("assemble_nanos", 0) \
+                if on_device else 0
+            entry = {
+                "type": next(iter(spec.keys()
+                                  - {"aggs", "aggregations", "meta"}),
+                             "unknown"),
+                "description": name,
+                "time_in_nanos": device_ns + assemble_ns,
+                "breakdown": {"collect": device_ns,
+                              "collect_count": total_hits,
+                              "build_aggregation": assemble_ns,
+                              "build_aggregation_count": 1,
+                              "initialize": 0, "initialize_count": 1,
+                              "reduce": 0, "reduce_count": 0}}
+            if info:
+                entry["engine"] = info["engine"]
+                if "fallback_reason" in info:
+                    entry["fallback_reason"] = info["fallback_reason"]
+            entries.append(entry)
+        profile["aggregations"] = entries
     return profile

@@ -124,11 +124,11 @@ class ShardSearchResult:
 
     __slots__ = ("shard_id", "rows", "scores", "sort_values", "total_hits",
                  "total_relation", "aggregations", "max_score", "failures",
-                 "knn_phases")
+                 "knn_phases", "aggs_profile")
 
     def __init__(self, shard_id, rows, scores, sort_values, total_hits,
                  total_relation, aggregations, max_score, failures=None,
-                 knn_phases=None):
+                 knn_phases=None, aggs_profile=None):
         self.shard_id = shard_id
         self.rows = rows
         self.scores = scores
@@ -139,6 +139,7 @@ class ShardSearchResult:
         self.max_score = max_score
         self.failures = failures or []  # partial per-shard failures
         self.knn_phases = knn_phases    # tpu_ivf route/score/merge timings
+        self.aggs_profile = aggs_profile  # device-agg engine breakdown
 
 
 def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
@@ -149,7 +150,8 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         index_settings: Optional[dict] = None,
                         max_buckets: Optional[int] = None,
                         allow_expensive: bool = True,
-                        index_name: str = "index") -> ShardSearchResult:
+                        index_name: str = "index",
+                        agg_engine=None) -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
     ctx.index_settings = index_settings or {}
@@ -346,15 +348,28 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     w_sort = sort_values[window.start:window.stop] if sort_values is not None else None
 
     aggs = None
+    aggs_profile = None
     aggs_spec = body.get("aggs") or body.get("aggregations")
     if aggs_spec:
-        if partial_aggs:
-            # distributed search: ship mergeable partial states, the
-            # coordinator reduces + finalizes (InternalAggregation.reduce)
-            from elasticsearch_tpu.search.agg_partials import compute_partial_aggs
-            aggs = compute_partial_aggs(ctx, agg_rows, aggs_spec)
-        else:
-            aggs = compute_aggs(ctx, agg_rows, aggs_spec)
+        if agg_engine is not None:
+            # device-resident aggregations (search/agg_plan.py): supported
+            # nodes reduce on device as fused filter→aggregate dispatches,
+            # everything else falls through per node to the host walkers;
+            # None means no node was device-eligible — unchanged host path
+            device_out = agg_engine.compute(ctx, agg_rows, aggs_spec,
+                                            partial=partial_aggs)
+            if device_out is not None:
+                aggs, aggs_profile = device_out
+        if aggs is None:
+            if partial_aggs:
+                # distributed search: ship mergeable partial states, the
+                # coordinator reduces + finalizes
+                # (InternalAggregation.reduce)
+                from elasticsearch_tpu.search.agg_partials import (
+                    compute_partial_aggs)
+                aggs = compute_partial_aggs(ctx, agg_rows, aggs_spec)
+            else:
+                aggs = compute_aggs(ctx, agg_rows, aggs_spec)
 
     if max_score_early is not None:
         max_score = max_score_early
@@ -363,7 +378,8 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
     return ShardSearchResult(shard_id, w_rows, w_scores, w_sort, total_hits,
                              relation, aggs, max_score,
                              failures=getattr(ctx, "shard_failures", None),
-                             knn_phases=getattr(ctx, "knn_phases", None))
+                             knn_phases=getattr(ctx, "knn_phases", None),
+                             aggs_profile=aggs_profile)
 
 
 def _apply_rescore(ctx, rows, scores, rescore_spec):

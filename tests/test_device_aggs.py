@@ -1,0 +1,485 @@
+"""Device-resident aggregations (ops/aggs.py + search/agg_plan.py).
+
+Two contracts, mirroring the mesh-serving suite's shape:
+
+1. PARITY — the device path is numerically IDENTICAL (json-equal) to the
+   host walkers for every supported agg, in both final mode
+   (`compute_aggs`) and distributed-partial mode
+   (`compute_partial_aggs` → `merge_partial_aggs` → `finalize_aggs`),
+   including one-level sub-aggs, `missing`, empty match sets, host
+   fallbacks, and the SPMD mesh path on ragged shards.
+
+2. CLOSED GRID — steady-state device aggs compile nothing: warmed second
+   passes run under strict dispatch with a zero compile delta (the
+   `aggs.*` grid rides the standalone ES_TPU_DISPATCH_STRICT=1
+   recompile-regression gate through the multidevice-marked tests).
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.ops import dispatch
+from elasticsearch_tpu.search.agg_partials import (
+    compute_partial_aggs, finalize_aggs, merge_partial_aggs,
+)
+from elasticsearch_tpu.search.agg_plan import AggEngine
+from elasticsearch_tpu.search.aggregations import compute_aggs
+from elasticsearch_tpu.search.queries import SearchContext
+
+MAPPING = {"properties": {
+    "cat": {"type": "keyword"},
+    "tags": {"type": "keyword"},
+    "v": {"type": "long"},
+    "nums": {"type": "long"},
+    "price": {"type": "double"},
+    "flag": {"type": "boolean"},
+    "ts": {"type": "date"},
+}}
+
+
+def _index_docs(e, n=240):
+    for i in range(n):
+        doc = {"cat": ["red", "green", "blue", "teal"][i % 4],
+               "tags": ["a", "b"] if i % 5 == 0 else "c",
+               "v": i,
+               "nums": [i, i + 1000] if i % 4 == 0 else i,
+               "flag": i % 2 == 0,
+               "ts": 1_600_000_000_000 + (i % 6) * 3_600_000}
+        if i % 7 != 0:
+            doc["price"] = i * 0.5
+        if i % 11 == 0:
+            del doc["cat"]
+        e.index(str(i), doc)
+    e.refresh()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    e = Engine(tempfile.mkdtemp() + "/shard", MapperService(MAPPING))
+    _index_docs(e)
+    yield SearchContext(e.acquire_searcher(), e.mapper_service)
+    e.close()
+
+
+@pytest.fixture()
+def engine(ctx):
+    return AggEngine(ctx.mapper_service)
+
+
+def _rows(ctx, frac=3):
+    rows = ctx.all_rows()
+    return rows[rows % frac != 0]
+
+
+def _json(x):
+    return json.dumps(x, sort_keys=True, default=str)
+
+
+DEVICE_SPECS = [
+    # terms: keyword / numeric / boolean / missing / mdc 0 / order / size
+    {"t": {"terms": {"field": "cat"}}},
+    {"t": {"terms": {"field": "cat", "size": 2}}},
+    {"t": {"terms": {"field": "cat", "missing": "none"}}},
+    {"t": {"terms": {"field": "cat", "min_doc_count": 0,
+                     "order": {"_key": "desc"}}}},
+    {"t": {"terms": {"field": "cat", "order": {"_count": "asc"}}}},
+    {"t": {"terms": {"field": "v", "size": 5}}},
+    {"t": {"terms": {"field": "flag"}}},
+    {"t": {"terms": {"field": "ts", "size": 3}}},
+    # terms + one-level sub metrics (incl. missing bucket sub-aggs)
+    {"t": {"terms": {"field": "cat", "missing": "other"},
+           "aggs": {"s": {"stats": {"field": "v"}},
+                    "c": {"value_count": {"field": "v"}},
+                    "mx": {"max": {"field": "price"}}}}},
+    # histogram: offset / missing / min_doc_count 0 / extended_bounds /
+    # format / sub-aggs
+    {"h": {"histogram": {"field": "v", "interval": 25, "offset": 3}}},
+    {"h": {"histogram": {"field": "v", "interval": 25, "missing": 7,
+                         "min_doc_count": 0}}},
+    {"h": {"histogram": {"field": "v", "interval": 10,
+                         "extended_bounds": {"min": -50, "max": 300}},
+           "aggs": {"a": {"avg": {"field": "v"}}}}},
+    {"h": {"histogram": {"field": "v", "interval": 50,
+                         "format": "0.0"}}},
+    # date_histogram: fixed intervals, format, offset, sub-aggs
+    {"d": {"date_histogram": {"field": "ts", "fixed_interval": "1h"}}},
+    {"d": {"date_histogram": {"field": "ts", "fixed_interval": "2h",
+                              "offset": "+30m",
+                              "format": "yyyy-MM-dd HH:mm"},
+           "aggs": {"mn": {"min": {"field": "v"}}}}},
+    # range: open ends / keys / overlaps / sub-aggs
+    {"r": {"range": {"field": "v",
+                     "ranges": [{"to": 50}, {"from": 50, "to": 150,
+                                             "key": "mid"},
+                                {"from": 100}]},
+           "aggs": {"s": {"sum": {"field": "v"}}}}},
+    # top-level metrics (integral sums; min/max on floats; date avg)
+    {"m": {"avg": {"field": "v"}}},
+    {"m": {"sum": {"field": "v"}}, "m2": {"stats": {"field": "v",
+                                                    "missing": 7}}},
+    {"m": {"min": {"field": "price"}}, "m2": {"max": {"field": "price"}}},
+    {"m": {"value_count": {"field": "v"}}},
+    {"m": {"avg": {"field": "ts"}}},
+    # meta + pipeline over a device sibling
+    {"t": {"terms": {"field": "cat"}, "meta": {"who": "dash"}},
+     "p": {"max_bucket": {"buckets_path": "t>_count"}}},
+]
+
+FALLBACK_SPECS = [
+    # every node host-side, but responses must still be identical
+    {"m": {"sum": {"field": "price"}}},                    # non-integral
+    {"m": {"value_count": {"field": "cat"}}},              # keyword count
+    # value_count counts every VALUE of a multi-valued field while the
+    # f64 column holds only the first — must route host (other metrics
+    # use first-value semantics on both paths and stay device-eligible)
+    {"m": {"value_count": {"field": "nums"}}},
+    {"t": {"terms": {"field": "cat"},
+           "aggs": {"c": {"value_count": {"field": "nums"}}}}},
+    {"t": {"terms": {"field": "tags"}}},                   # multi-valued
+    {"d": {"date_histogram": {"field": "ts",
+                              "calendar_interval": "hour"}}},
+    {"c": {"cardinality": {"field": "cat"}}},              # HLL family
+    {"t": {"terms": {"field": "cat", "include": ["red", "blue"]}}},
+]
+
+
+@pytest.mark.parametrize("spec", DEVICE_SPECS)
+def test_device_final_parity(ctx, engine, spec):
+    rows = _rows(ctx)
+    host = compute_aggs(ctx, rows, spec)
+    got = engine.compute(ctx, rows, spec, partial=False)
+    assert got is not None, "expected a device-eligible plan"
+    dev, prof = got
+    assert _json(dev) == _json(host)
+    assert any(n["engine"].startswith("device") for n in prof["nodes"])
+
+
+@pytest.mark.parametrize("spec", FALLBACK_SPECS)
+def test_host_fallback_parity(ctx, engine, spec):
+    rows = _rows(ctx)
+    host = compute_aggs(ctx, rows, spec)
+    got = engine.compute(ctx, rows, spec, partial=False)
+    if got is None:
+        return  # no device-eligible node: caller keeps the host path
+    dev, prof = got
+    assert _json(dev) == _json(host)
+
+
+def test_empty_match_set_parity(ctx, engine):
+    rows = np.zeros(0, dtype=np.int64)
+    for spec in DEVICE_SPECS[:8]:
+        host = compute_aggs(ctx, rows, spec)
+        got = engine.compute(ctx, rows, spec, partial=False)
+        assert got is not None
+        assert _json(got[0]) == _json(host)
+
+
+def test_partial_mode_skewed_reduce_parity(ctx, engine):
+    rows = ctx.all_rows()
+    n = len(rows)
+    splits = [rows[: n // 6], rows[n // 6: n // 2], rows[n // 2:]]
+    for spec in DEVICE_SPECS:
+        if any("meta" in s or any(k in ("max_bucket",) for k in s)
+               for s in spec.values() if isinstance(s, dict)):
+            continue  # pipelines defer to finalize in partial mode
+        hp = [compute_partial_aggs(ctx, r, spec) for r in splits]
+        hm = hp[0]
+        for p in hp[1:]:
+            hm = merge_partial_aggs(hm, p, spec)
+        host = finalize_aggs(hm, spec)
+        dp = []
+        for r in splits:
+            got = engine.compute(ctx, r, spec, partial=True)
+            assert got is not None
+            dp.append(got[0])
+        dm = dp[0]
+        for p in dp[1:]:
+            dm = merge_partial_aggs(dm, p, spec)
+        assert _json(finalize_aggs(dm, spec)) == _json(host)
+
+
+def test_plan_cache_hits_on_repeated_dashboard_body(ctx, engine):
+    rows = _rows(ctx)
+    body = {"h": {"histogram": {"field": "v", "interval": 25}},
+            "t": {"terms": {"field": "cat"},
+                  "aggs": {"s": {"stats": {"field": "v"}}}}}
+    engine.compute(ctx, rows, body, partial=False)
+    # a dashboard slider: interval changes are scrubbed from the plan key
+    body2 = json.loads(json.dumps(body))
+    body2["h"]["histogram"]["interval"] = 50
+    engine.compute(ctx, rows, body2, partial=False)
+    assert engine.stats["plan_cache_hits"] >= 1
+    # parity still holds for the re-bound plan
+    host = compute_aggs(ctx, rows, body2)
+    assert _json(engine.compute(ctx, rows, body2)[0]) == _json(host)
+
+
+def test_strict_zero_recompile_second_pass(ctx, engine):
+    rows = _rows(ctx)
+    spec = {"t": {"terms": {"field": "cat"},
+                  "aggs": {"s": {"stats": {"field": "v"}}}},
+            "h": {"histogram": {"field": "v", "interval": 25}},
+            "r": {"range": {"field": "v", "ranges": [{"to": 100},
+                                                     {"from": 100}]}},
+            "m": {"avg": {"field": "v"}}}
+    engine.compute(ctx, rows, spec, partial=False)  # warm pass
+    before = dispatch.DISPATCH.compile_count()
+    strict_before = dispatch.DISPATCH.strict
+    dispatch.DISPATCH.strict = True
+    try:
+        got = engine.compute(ctx, rows, spec, partial=False)
+    finally:
+        dispatch.DISPATCH.strict = strict_before
+    assert got is not None
+    assert dispatch.DISPATCH.compile_count() == before
+
+
+def test_warmup_entries_precompile_column_grid(ctx, engine):
+    rows = _rows(ctx)
+    col = engine.store.column(ctx.reader, "v")
+    entries = engine.store.warmup_entries(col)
+    assert entries
+    dispatch.DISPATCH.warmup(entries, background=False)
+    # the warmed shapes are the ones real dispatches hit: a fresh metric
+    # agg with B on the warmup ladder must not compile
+    before = dispatch.DISPATCH.compile_count()
+    got = engine.compute(ctx, rows, {"m": {"sum": {"field": "v"}}})
+    assert got is not None
+    assert dispatch.DISPATCH.compile_count() == before
+
+
+def test_columnar_host_fast_path_matches_loop(ctx):
+    """Satellite: the vectorized numeric_values/all_values fast path is
+    value-identical to the per-row get_doc_value loop it replaced."""
+    from elasticsearch_tpu.search import aggregations as A
+    rows = _rows(ctx)
+
+    def legacy_numeric(field, missing=None):
+        f = ctx.mapper_service.resolve_field(field)
+        vals = np.full(len(rows), np.nan, dtype=np.float64)
+        present = np.zeros(len(rows), dtype=bool)
+        for i, row in enumerate(rows):
+            v = ctx.reader.get_doc_value(f, int(row))
+            if isinstance(v, list):
+                v = v[0] if v else None
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                v = 1.0 if v else 0.0
+            if isinstance(v, (int, float)):
+                vals[i] = float(v)
+                present[i] = True
+        if missing is not None:
+            vals[~present] = missing
+            present[:] = True
+        return vals, present
+
+    for field in ("v", "price", "ts"):
+        fast_v, fast_p = A.numeric_values(ctx, rows, field)
+        ref_v, ref_p = legacy_numeric(field)
+        assert np.array_equal(fast_p, ref_p)
+        assert np.array_equal(fast_v[fast_p], ref_v[ref_p])
+    fv, fp = A.numeric_values(ctx, rows, "price", missing=-1.0)
+    rv, rp = legacy_numeric("price", missing=-1.0)
+    assert np.array_equal(fv, rv) and fp.all()
+
+    def legacy_all(field):
+        f = ctx.mapper_service.resolve_field(field)
+        out = []
+        for i, row in enumerate(rows):
+            v = ctx.reader.get_doc_value(f, int(row))
+            if v is None:
+                continue
+            if isinstance(v, list):
+                out.extend((i, item) for item in v if item is not None)
+            else:
+                out.append((i, v))
+        return out
+
+    for field in ("cat", "tags", "v"):
+        assert A.all_values(ctx, rows, field) == legacy_all(field)
+
+
+# ---------------------------------------------------------------------------
+# SPMD mesh path (the 8 virtual CPU devices conftest forces)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multidevice
+class TestMeshAggs:
+    def _mk(self, n=900):
+        e = Engine(tempfile.mkdtemp() + "/shard", MapperService(MAPPING))
+        _index_docs(e, n=n)  # 900 live rows -> 1024 row bucket: ragged
+        ctx = SearchContext(e.acquire_searcher(), e.mapper_service)
+        return e, ctx
+
+    def test_mesh_parity_ragged_shards(self, mesh_serving):
+        e, ctx = self._mk()
+        try:
+            engine = AggEngine(ctx.mapper_service)
+            rows = _rows(ctx)
+            for spec in (
+                    {"t": {"terms": {"field": "cat"},
+                           "aggs": {"s": {"stats": {"field": "v"}}}}},
+                    {"h": {"histogram": {"field": "v", "interval": 100,
+                                         "min_doc_count": 0}}},
+                    {"d": {"date_histogram": {"field": "ts",
+                                              "fixed_interval": "2h"}}},
+                    {"r": {"range": {"field": "v",
+                                     "ranges": [{"to": 400},
+                                                {"from": 400}]},
+                           "aggs": {"m": {"min": {"field": "v"}}}}},
+                    {"m": {"avg": {"field": "v"}}}):
+                host = compute_aggs(ctx, rows, spec)
+                got = engine.compute(ctx, rows, spec, partial=False)
+                assert got is not None
+                assert _json(got[0]) == _json(host)
+            st = mesh_serving.stats()
+            assert st["legs"].get("aggs", {}).get("dispatches", 0) > 0
+            assert engine.stats["mesh_dispatches"] > 0
+        finally:
+            e.close()
+
+    def test_mesh_empty_and_full_masks(self, mesh_serving):
+        e, ctx = self._mk()
+        try:
+            engine = AggEngine(ctx.mapper_service)
+            for rows in (np.zeros(0, dtype=np.int64), ctx.all_rows()):
+                spec = {"t": {"terms": {"field": "cat"}},
+                        "m": {"stats": {"field": "v"}}}
+                host = compute_aggs(ctx, rows, spec)
+                got = engine.compute(ctx, rows, spec, partial=False)
+                assert got is not None
+                assert _json(got[0]) == _json(host)
+        finally:
+            e.close()
+
+    def test_mesh_strict_zero_recompile_second_pass(self, mesh_serving):
+        e, ctx = self._mk()
+        try:
+            engine = AggEngine(ctx.mapper_service)
+            rows = _rows(ctx)
+            spec = {"t": {"terms": {"field": "cat"},
+                          "aggs": {"s": {"stats": {"field": "v"}}}},
+                    "h": {"histogram": {"field": "v", "interval": 100}}}
+            engine.compute(ctx, rows, spec, partial=False)  # warm
+            before = dispatch.DISPATCH.compile_count()
+            strict_before = dispatch.DISPATCH.strict
+            dispatch.DISPATCH.strict = True
+            try:
+                got = engine.compute(ctx, rows, spec, partial=False)
+            finally:
+                dispatch.DISPATCH.strict = strict_before
+            assert got is not None
+            assert dispatch.DISPATCH.compile_count() == before
+        finally:
+            e.close()
+
+    def test_mesh_partial_states_merge_like_host(self, mesh_serving):
+        e, ctx = self._mk()
+        try:
+            engine = AggEngine(ctx.mapper_service)
+            rows = ctx.all_rows()
+            splits = [rows[:100], rows[100:600], rows[600:]]
+            spec = {"t": {"terms": {"field": "cat"},
+                          "aggs": {"a": {"avg": {"field": "v"}}}}}
+            hp = [compute_partial_aggs(ctx, r, spec) for r in splits]
+            hm = hp[0]
+            for p in hp[1:]:
+                hm = merge_partial_aggs(hm, p, spec)
+            dp = [engine.compute(ctx, r, spec, partial=True)[0]
+                  for r in splits]
+            dm = dp[0]
+            for p in dp[1:]:
+                dm = merge_partial_aggs(dm, p, spec)
+            assert _json(finalize_aggs(dm, spec)) == \
+                _json(finalize_aggs(hm, spec))
+        finally:
+            e.close()
+
+
+# ---------------------------------------------------------------------------
+# node-level wiring: REST-shaped search, settings gate, stats, profile
+# ---------------------------------------------------------------------------
+
+
+def _mk_node(tmp):
+    from elasticsearch_tpu.node import Node
+    node = Node(tmp)
+    node.create_index_with_templates("logs", mappings={"properties": {
+        "cat": {"type": "keyword"}, "v": {"type": "long"},
+        "ts": {"type": "date"}}})
+    ops = []
+    for i in range(400):
+        ops.append({"index": {"_index": "logs", "_id": str(i)}})
+        ops.append({"cat": ["a", "b", "c"][i % 3], "v": i,
+                    "ts": 1_600_000_000_000 + (i % 12) * 3_600_000})
+    node.bulk(ops)
+    node.indices.get("logs").refresh()
+    return node
+
+
+DASH_BODY = {"query": {"range": {"v": {"gte": 100}}}, "size": 5,
+             "aggs": {"by_cat": {"terms": {"field": "cat"},
+                                 "aggs": {"s": {"stats": {"field": "v"}}}},
+                      "over_time": {"date_histogram": {
+                          "field": "ts", "fixed_interval": "2h"}}}}
+
+
+def test_node_search_device_vs_disabled_parity(tmp_path):
+    node = _mk_node(str(tmp_path))
+    try:
+        body = json.loads(json.dumps(DASH_BODY))
+        r1 = node.search("logs", json.loads(json.dumps(body)))
+        eng = node._aggs["logs"][1]
+        assert eng.stats["device_nodes"] >= 2
+        node.settings["search.aggs.device_enabled"] = "false"
+        r2 = node.search("logs", json.loads(json.dumps(body)))
+        r1.pop("took"), r2.pop("took")
+        assert _json(r1) == _json(r2)
+        # stats + profile sections
+        node.settings.pop("search.aggs.device_enabled")
+        st = node.local_node_stats()["indices"]["aggs"]
+        assert st["device_nodes"] >= 2 and st["columns"] >= 2
+        body["profile"] = True
+        rp = node.search("logs", json.loads(json.dumps(body)))
+        entries = rp["profile"]["shards"][0]["aggregations"]
+        assert {a["description"]: a.get("engine") for a in entries} == {
+            "by_cat": "device", "over_time": "device"}
+    finally:
+        node.close()
+
+
+def test_node_multi_index_partial_aggs_parity(tmp_path):
+    """Multi-index searches ship partial states; device partials must
+    reduce to the same response as host partials."""
+    from elasticsearch_tpu.node import Node
+    node = Node(str(tmp_path))
+    try:
+        for idx in ("logs1", "logs2"):
+            node.create_index_with_templates(idx, mappings={"properties": {
+                "cat": {"type": "keyword"}, "v": {"type": "long"}}})
+        ops = []
+        for i in range(300):
+            ops.append({"index": {"_index": "logs1" if i % 2 else "logs2",
+                                  "_id": str(i)}})
+            ops.append({"cat": ["a", "b", "c"][i % 3], "v": i})
+        node.bulk(ops)
+        for idx in ("logs1", "logs2"):
+            node.indices.get(idx).refresh()
+        body = {"size": 3, "aggs": {
+            "by_cat": {"terms": {"field": "cat"},
+                       "aggs": {"a": {"avg": {"field": "v"}}}},
+            "vs": {"stats": {"field": "v"}}}}
+        r1 = node.search("logs1,logs2", json.loads(json.dumps(body)))
+        node.settings["search.aggs.device_enabled"] = "false"
+        r2 = node.search("logs1,logs2", json.loads(json.dumps(body)))
+        r1.pop("took"), r2.pop("took")
+        assert _json(r1) == _json(r2)
+    finally:
+        node.close()
