@@ -24,15 +24,14 @@ on that.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticsearch_tpu.ops import dispatch
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def kmeans_pp_init(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+
+def _kmeans_pp_init_impl(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
     """k-means++ seeding: [n, d] sample → [k, d] initial centroids."""
     n = x.shape[0]
     x_sq = jnp.sum(x * x, axis=-1)
@@ -61,8 +60,7 @@ def kmeans_pp_init(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
     return centroids
 
 
-@functools.partial(jax.jit, static_argnames=())
-def assign_blocks(x: jax.Array, centroids: jax.Array) -> jax.Array:
+def _assign_blocks_impl(x: jax.Array, centroids: jax.Array) -> jax.Array:
     """Nearest-centroid ids [n] for rows [n, d] (plain L2 assignment —
     for unit-normalized cosine data this equals max-dot routing)."""
     c_sq = jnp.sum(centroids * centroids, axis=-1)
@@ -73,8 +71,7 @@ def assign_blocks(x: jax.Array, centroids: jax.Array) -> jax.Array:
     return jnp.argmax(dots - 0.5 * c_sq[None, :], axis=-1).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("nlist", "balance_alpha"))
-def _minibatch_epoch(carry, batches, nlist: int, balance_alpha: float):
+def _minibatch_epoch_impl(carry, batches, nlist: int, balance_alpha: float):
     """One scan over the stacked mini-batches [S, B, d]."""
 
     def step(carry, batch):
@@ -111,6 +108,30 @@ def _minibatch_epoch(carry, batches, nlist: int, balance_alpha: float):
     return jax.lax.scan(step, carry, batches)[0]
 
 
+# Training kernels route through the shape-bucketed dispatcher like every
+# other device program (tpulint TPU001): training shapes are bounded by
+# construction (`sample`/`batch_size` caps), so the AOT cache stays small,
+# and the dispatcher's counters make a runaway-retrace regression visible
+# in `_nodes/stats indices.dispatch` instead of silent. No grid_check:
+# training is an index-build path, not a serving shape — it must never
+# trip the strict closed-grid gate.
+dispatch.DISPATCH.register("kmeans.pp_init", _kmeans_pp_init_impl,
+                           static_argnames=("k",))
+dispatch.DISPATCH.register("kmeans.assign", _assign_blocks_impl)
+dispatch.DISPATCH.register("kmeans.epoch", _minibatch_epoch_impl,
+                           static_argnames=("nlist", "balance_alpha"))
+
+
+def kmeans_pp_init(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """k-means++ seeding: [n, d] sample → [k, d] initial centroids."""
+    return dispatch.call("kmeans.pp_init", key, x, k=k)
+
+
+def assign_blocks(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """Nearest-centroid ids [n] for rows [n, d]."""
+    return dispatch.call("kmeans.assign", x, centroids)
+
+
 def train_kmeans(vectors: np.ndarray, nlist: int, *, iters: int = 8,
                  batch_size: int = 4096, sample: int = 262_144,
                  seed: int = 0, balance_alpha: float = 0.25) -> np.ndarray:
@@ -145,6 +166,7 @@ def train_kmeans(vectors: np.ndarray, nlist: int, *, iters: int = 8,
         k_shuf, k_epoch = jax.random.split(k_shuf)
         perm = jax.random.permutation(k_epoch, n_sample)[: steps * batch_size]
         batches = x[perm].reshape(steps, batch_size, d)
-        centroids, counts = _minibatch_epoch(
-            (centroids, counts), batches, nlist, balance_alpha)
+        centroids, counts = dispatch.call(
+            "kmeans.epoch", (centroids, counts), batches,
+            nlist=nlist, balance_alpha=balance_alpha)
     return np.asarray(centroids, dtype=np.float32)

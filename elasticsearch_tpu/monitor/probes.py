@@ -60,6 +60,7 @@ def os_probe() -> dict:
 
 
 _last_cpu: dict = {}
+_last_cpu_lock = threading.Lock()
 
 
 def _cpu_percent() -> int:
@@ -72,8 +73,12 @@ def _cpu_percent() -> int:
         return -1
     idle = fields[3] + (fields[4] if len(fields) > 4 else 0)
     total = sum(fields)
-    prev = _last_cpu.get("v")
-    _last_cpu["v"] = (idle, total)
+    # read-modify-write under the lock: concurrent _nodes/stats requests
+    # interleaving here would compute percentages over torn intervals
+    # (tpulint TPU008)
+    with _last_cpu_lock:
+        prev = _last_cpu.get("v")
+        _last_cpu["v"] = (idle, total)
     if prev is None or total == prev[1]:
         return -1
     didle, dtotal = idle - prev[0], total - prev[1]

@@ -217,6 +217,32 @@ class _Entry:
         self.compile_nanos = compile_nanos
 
 
+class _PinnedLeaf:
+    """Identity key for a non-primitive python leaf in a cache signature.
+
+    Keying on bare `id(x)` is the PR 5 mesh-cache bug class (tpulint
+    TPU003): addresses recycle after GC, so a dead object's cache entries
+    alias a new object at the same address. The wrapper compares by
+    identity but HOLDS the referent — while the cache entry lives, the
+    address cannot be reused, so aliasing is impossible by construction.
+    (Identity, not value, semantics on purpose: an executable compiled
+    against one leaf object must not serve a merely-equal other.)
+    """
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __hash__(self):
+        # id() is safe HERE precisely because self.obj is a strong
+        # reference: the address is pinned for this wrapper's lifetime
+        return id(self.obj)
+
+    def __eq__(self, other):
+        return isinstance(other, _PinnedLeaf) and self.obj is other.obj
+
+
 def _leaf_sig(x) -> Any:
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)
@@ -236,7 +262,7 @@ def _leaf_sig(x) -> Any:
             sharding = None
         return (tuple(shape), str(dtype), sharding)
     return ("py", type(x).__name__, x if isinstance(
-        x, (int, float, bool, str, bytes, type(None))) else id(x))
+        x, (int, float, bool, str, bytes, type(None))) else _PinnedLeaf(x))
 
 
 class Dispatcher:
@@ -288,6 +314,27 @@ class Dispatcher:
             return []
         self._trace.events = []
         return events
+
+    def events_enabled(self) -> bool:
+        """Is THIS thread currently recording a dispatch trace?"""
+        return getattr(self._trace, "events", None) is not None
+
+    def event_count(self) -> int:
+        events = getattr(self._trace, "events", None)
+        return 0 if events is None else len(events)
+
+    def annotate_events(self, since: int, **fields) -> None:
+        """Tag events appended after index `since` on THIS thread's
+        trace. The combining batcher uses this to label a coalesced
+        batch's dispatches (`coalesced_batch: N`): the runner thread
+        executes device work on behalf of N requests, and without the
+        tag a profiled leader's trace silently claims the followers'
+        dispatches as its own."""
+        events = getattr(self._trace, "events", None)
+        if events is None:
+            return
+        for e in events[since:]:
+            e.update(fields)
 
     def _event(self, kernel: str, key_str: str, hit: bool,
                compile_nanos: int) -> None:

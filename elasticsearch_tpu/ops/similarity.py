@@ -18,8 +18,6 @@ native mode — with an f32 path for exactness testing.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -66,7 +64,12 @@ def l2_raw_from_dots(dots: jax.Array, queries: jax.Array, corpus_sq_norms: jax.A
     return 2.0 * dots - q_sq - corpus_sq_norms[None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("metric", "precision", "normalize_queries"))
+# NOT jitted here: in the serving path this only ever runs inside the
+# trace of a dispatcher-registered kernel (`knn.exact` and friends call
+# it via _block_scores), where a nested jit would just inline. A raw
+# decorator-level jax.jit was a second, unbucketed compile path the
+# strict closed-grid gate couldn't see (tpulint TPU001); eager execution
+# remains for direct/test callers.
 def similarity_scores(
     queries: jax.Array,
     corpus: jax.Array,

@@ -168,7 +168,11 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
         # batch reports its dispatches in that batch leader's trace, so a
         # profiled search under concurrency may show an empty list even
         # though kernels ran — `_nodes/stats indices.dispatch` is the
-        # authoritative counter.
+        # authoritative counter. Events a profiled LEADER executed on
+        # behalf of a coalesced batch carry `coalesced_batch: N`
+        # (serving/batcher.py annotates them), so a leader's trace is
+        # explicit about which device work was shared with N-1 followers
+        # rather than silently claiming it as its own.
         profile["dispatch"] = dispatch_events
     if (body or {}).get("aggs") or (body or {}).get("aggregations"):
         aggs = body.get("aggs") or body.get("aggregations")

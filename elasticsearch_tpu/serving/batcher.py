@@ -32,6 +32,13 @@ from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
 _overhead_lock = threading.Lock()
 _overhead_ms: Optional[float] = None
 
+
+def _probe_kernel(x):
+    """Tiny round-trip kernel for `device_overhead_ms` (registered
+    lazily — jax import cost stays off module import)."""
+    return x + 1.0
+
+
 def _host_gops() -> float:
     """Measured ~200 GOPS peak with AVX512-VNNI; priced at 150 GOPS — a
     25% derate for sustained serving (frequency throttle + co-running
@@ -67,20 +74,29 @@ def device_overhead_ms() -> float:
         try:
             import time
 
-            import jax
             import jax.numpy as jnp
 
             import numpy as _np
 
-            f = jax.jit(lambda x: x + 1.0)
+            from elasticsearch_tpu.ops import dispatch
+
+            # the probe rides the same dispatcher every serving kernel
+            # uses (a raw jax.jit here was a second compile path outside
+            # the AOT cache — tpulint TPU001), so the measured round trip
+            # includes the dispatch layer a real serving call pays
+            dispatch.DISPATCH.register("serving.overhead_probe",
+                                       _probe_kernel)
             x = _np.zeros((8,), _np.float32)
-            _np.asarray(f(jnp.asarray(x)))
+            _np.asarray(dispatch.call("serving.overhead_probe",
+                                      jnp.asarray(x)))
             samples = []
             for _ in range(3):
                 # a serving dispatch pays h2d (queries/mask), execute, AND
                 # d2h (results) — measure the full round trip
                 t0 = time.perf_counter()
-                _np.asarray(f(jnp.asarray(x)))
+                # tpulint: disable=TPU002(the probe MEASURES the per-dispatch d2h round trip on purpose; 3 iterations, once per process, not a serving loop)
+                _np.asarray(dispatch.call("serving.overhead_probe",
+                                          jnp.asarray(x)))
                 samples.append((time.perf_counter() - t0) * 1000.0)
             _overhead_ms = max(0.05, min(samples))
         except Exception:
@@ -169,6 +185,19 @@ class CombiningBatcher:
                 batch = self._drain()
                 if not batch:
                     continue
+                # dispatch-trace attribution (profile.dispatch): the
+                # runner thread executes device work for EVERY request in
+                # the batch. If this thread is recording a profile trace,
+                # label the batch's events with the coalesced size so the
+                # leader's trace doesn't silently claim follower
+                # dispatches as its own; followers still report an empty
+                # trace (documented — `_nodes/stats indices.dispatch` is
+                # the authoritative counter).
+                from elasticsearch_tpu.ops import dispatch as _dispatch
+                trace_since = (_dispatch.DISPATCH.event_count()
+                               if len(batch) > 1
+                               and _dispatch.DISPATCH.events_enabled()
+                               else None)
                 try:
                     results = self._execute([r for r, _ in batch])
                     if len(results) != len(batch):
@@ -198,6 +227,14 @@ class CombiningBatcher:
                         if not f.done():
                             f.set_exception(exc)
                     raise
+                finally:
+                    # annotate on EVERY exit: the serial per-request
+                    # retries of a poisoned batch run on this same
+                    # runner thread, and their dispatches are just as
+                    # much coalesced-batch work as the happy path's
+                    if trace_since is not None:
+                        _dispatch.DISPATCH.annotate_events(
+                            trace_since, coalesced_batch=len(batch))
         return fut.result()
 
 

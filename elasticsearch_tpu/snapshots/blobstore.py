@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -130,6 +131,7 @@ class FsBlobStore(BlobStore):
 
 
 _MEMORY_STORES: Dict[str, Dict[str, bytes]] = {}
+_MEMORY_STORES_LOCK = threading.Lock()
 
 
 class MemoryBlobStore(BlobStore):
@@ -137,7 +139,10 @@ class MemoryBlobStore(BlobStore):
     pointing at the same location see the same blobs."""
 
     def __init__(self, location: str):
-        self.blobs = _MEMORY_STORES.setdefault(location, {})
+        # two repositories registering the same location concurrently
+        # must end up sharing ONE store dict (tpulint TPU008)
+        with _MEMORY_STORES_LOCK:
+            self.blobs = _MEMORY_STORES.setdefault(location, {})
 
     def write_blob(self, key: str, data: bytes) -> None:
         self.blobs[key] = bytes(data)

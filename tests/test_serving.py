@@ -147,6 +147,65 @@ class TestCombiningBatcher:
         assert sum(batch_sizes) == 12
         assert len(batch_sizes) <= 3  # coalescing actually happened
 
+    def test_coalesced_batch_events_are_labeled(self):
+        """Dispatch-trace attribution: a profiled runner executing a
+        coalesced batch labels those events `coalesced_batch: N` instead
+        of silently claiming follower dispatches as its own; a solo
+        dispatch stays unlabeled."""
+        from concurrent.futures import Future
+
+        from elasticsearch_tpu.ops import dispatch
+
+        dispatch.DISPATCH.register("test.batcher_trace", lambda x: x + 1.0)
+
+        def execute(reqs):
+            import jax.numpy as jnp
+            return [float(np.asarray(dispatch.call(
+                "test.batcher_trace", jnp.float32(r)))) for r in reqs]
+
+        b = CombiningBatcher(execute)
+        dispatch.DISPATCH.record_events(True)
+        try:
+            # a queued follower makes the submitting thread a runner
+            # executing a 2-request batch deterministically
+            follower = Future()
+            b._enqueue(1.0, follower)
+            assert b.submit(2.0) == 3.0
+            assert follower.result(timeout=5) == 2.0
+            events = dispatch.DISPATCH.drain_events()
+            batch_events = [e for e in events
+                            if e["kernel"] == "test.batcher_trace"]
+            assert len(batch_events) == 2
+            assert all(e.get("coalesced_batch") == 2
+                       for e in batch_events)
+            # solo dispatch: no coalescing marker
+            dispatch.DISPATCH.record_events(True)
+            assert b.submit(5.0) == 6.0
+            (solo,) = [e for e in dispatch.DISPATCH.drain_events()
+                       if e["kernel"] == "test.batcher_trace"]
+            assert "coalesced_batch" not in solo
+
+            # poisoned batch: the serial per-request retries run on the
+            # same runner thread — their dispatches must be labeled too
+            def poisoned_execute(reqs):
+                if len(reqs) > 1:
+                    raise RuntimeError("poisoned batch")
+                return execute(reqs)
+
+            b2 = CombiningBatcher(poisoned_execute)
+            dispatch.DISPATCH.record_events(True)
+            follower2 = Future()
+            b2._enqueue(1.0, follower2)
+            assert b2.submit(2.0) == 3.0
+            assert follower2.result(timeout=5) == 2.0
+            retry_events = [e for e in dispatch.DISPATCH.drain_events()
+                            if e["kernel"] == "test.batcher_trace"]
+            assert len(retry_events) == 2
+            assert all(e.get("coalesced_batch") == 2
+                       for e in retry_events)
+        finally:
+            dispatch.DISPATCH.record_events(False)
+
     def test_error_propagates_to_all_waiters(self):
         def execute(reqs):
             raise RuntimeError("boom")

@@ -1,0 +1,370 @@
+"""tpulint: the static JAX-discipline gate (tools/tpulint).
+
+Three layers:
+
+* `test_repo_is_lint_clean` — the tier-1 gate: the analyzer runs over
+  `elasticsearch_tpu/` exactly as the CLI does and must report zero
+  unsuppressed findings (pragmas need written reasons; baseline entries
+  may not carry TODO reasons).
+* golden fixtures — one fires/clean pair per rule under
+  `tests/tpulint_fixtures/`, linted with only that rule selected; the
+  `# [expect]` markers in the fires files pin WHERE each finding lands.
+* machinery — pragma syntax (reason mandatory, standalone-comment
+  placement), baseline round-trip (suppress, reason preservation,
+  key stability against line shifts), CLI exit codes and JSON shape.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "tpulint_fixtures")
+PACKAGE = os.path.join(REPO, "elasticsearch_tpu")
+
+from tools.tpulint.engine import (  # noqa: E402
+    Config,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+RULE_IDS = tuple(f"TPU00{i}" for i in range(1, 9))
+
+
+def lint_fixture(name: str, rule: str):
+    path = os.path.join(FIXTURES, name)
+    return lint_paths([path], config=Config(select=(rule,)), root=REPO)
+
+
+def expected_lines(name: str):
+    """Line numbers carrying an `# [expect]` marker in a fires fixture."""
+    with open(os.path.join(FIXTURES, name)) as f:
+        return {i for i, text in enumerate(f.read().splitlines(), 1)
+                if "[expect]" in text}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """Zero unsuppressed findings over elasticsearch_tpu/ — the build-
+    time analog of the ES_TPU_DISPATCH_STRICT=1 runtime gate. A new
+    finding means: fix it, or suppress it with a WRITTEN reason
+    (pragma or baseline entry) that review can judge."""
+    baseline_file = os.path.join(REPO, "tools", "tpulint",
+                                 "baseline.json")
+    unsuppressed, by_pragma, by_baseline = lint_paths(
+        [PACKAGE], baseline_path=baseline_file, root=REPO)
+    assert not unsuppressed, \
+        "tpulint findings (fix, or suppress with a written reason):\n" \
+        + "\n".join(f.render() for f in unsuppressed)
+    for f, reason in by_baseline:
+        assert "TODO" not in reason, \
+            f"baseline entry for {f.render()} still carries a TODO " \
+            "reason — write the justification"
+
+
+def test_baseline_file_entries_all_have_reasons():
+    baseline = load_baseline(
+        os.path.join(REPO, "tools", "tpulint", "baseline.json"))
+    for key, (reason, _count) in baseline.items():
+        assert reason and "TODO" not in reason, \
+            f"baseline entry {key} has no written reason"
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures: one fires/clean pair per rule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_fires_on_fixture(rule):
+    name = f"tpu{rule[3:]}_fires.py"
+    findings, _, _ = lint_fixture(name, rule)
+    assert findings, f"{name} produced no {rule} findings"
+    assert all(f.rule == rule for f in findings)
+    marked = expected_lines(name)
+    assert marked, f"{name} has no [expect] markers"
+    assert {f.line for f in findings} == marked, \
+        f"{rule} fired at {sorted(f.line for f in findings)}, " \
+        f"expected {sorted(marked)}:\n" \
+        + "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_quiet_on_clean_fixture(rule):
+    name = f"tpu{rule[3:]}_clean.py"
+    findings, _, _ = lint_fixture(name, rule)
+    assert not findings, \
+        f"{name} should be clean but fired:\n" \
+        + "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# pragma behavior
+# ---------------------------------------------------------------------------
+
+def _lint_source(tmp_path, source, rule, baseline_path=None):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(source))
+    return lint_paths([str(p)], config=Config(select=(rule,)),
+                      baseline_path=baseline_path, root=str(tmp_path))
+
+
+def test_pragma_with_reason_suppresses(tmp_path):
+    un, by_pragma, _ = _lint_source(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x)  # tpulint: disable=TPU001(bench-only micro probe)
+        """, "TPU001")
+    assert not un
+    assert len(by_pragma) == 1
+    assert by_pragma[0][1] == "bench-only micro probe"
+
+
+def test_pragma_on_preceding_comment_line_suppresses(tmp_path):
+    un, by_pragma, _ = _lint_source(tmp_path, """
+        import jax
+        # tpulint: disable=TPU001(decorators need the line above)
+        f = jax.jit(lambda x: x)
+        """, "TPU001")
+    assert not un
+    assert len(by_pragma) == 1
+
+
+def test_pragma_without_reason_does_not_suppress(tmp_path):
+    un, by_pragma, _ = _lint_source(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x)  # tpulint: disable=TPU001
+        """, "TPU001")
+    assert len(un) == 1
+    assert not by_pragma
+
+
+def test_pragma_for_other_rule_does_not_suppress(tmp_path):
+    un, _, _ = _lint_source(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x)  # tpulint: disable=TPU006(wrong rule)
+        """, "TPU001")
+    assert len(un) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline behavior
+# ---------------------------------------------------------------------------
+
+SOURCE_WITH_FINDING = """
+    import jax
+    f = jax.jit(lambda x: x)
+    """
+
+
+def test_baseline_suppresses_and_preserves_reason(tmp_path):
+    bl = tmp_path / "baseline.json"
+    findings, _, _ = _lint_source(tmp_path, SOURCE_WITH_FINDING, "TPU001")
+    assert len(findings) == 1
+    write_baseline(findings, str(bl))
+    data = json.loads(bl.read_text())
+    assert data["entries"][0]["reason"].startswith("TODO")
+    # a human writes the reason; rewriting the baseline preserves it
+    data["entries"][0]["reason"] = "grandfathered: legacy probe"
+    bl.write_text(json.dumps(data))
+    un, _, by_baseline = _lint_source(tmp_path, SOURCE_WITH_FINDING,
+                                      "TPU001", baseline_path=str(bl))
+    assert not un
+    assert by_baseline[0][1] == "grandfathered: legacy probe"
+    write_baseline([f for f, _ in by_baseline], str(bl))
+    data = json.loads(bl.read_text())
+    assert data["entries"][0]["reason"] == "grandfathered: legacy probe"
+
+
+def test_baseline_key_survives_line_shifts(tmp_path):
+    """Baseline keys carry no line numbers: adding code ABOVE a
+    baselined site must not un-suppress it."""
+    bl = tmp_path / "baseline.json"
+    findings, _, _ = _lint_source(tmp_path, SOURCE_WITH_FINDING, "TPU001")
+    write_baseline(findings, str(bl))
+    shifted = """
+        import jax
+
+        UNRELATED = 1
+        ALSO_UNRELATED = 2
+
+
+        f = jax.jit(lambda x: x)
+        """
+    un, _, by_baseline = _lint_source(tmp_path, shifted, "TPU001",
+                                      baseline_path=str(bl))
+    assert not un
+    assert len(by_baseline) == 1
+
+
+def test_baseline_does_not_cover_new_findings(tmp_path):
+    bl = tmp_path / "baseline.json"
+    findings, _, _ = _lint_source(tmp_path, SOURCE_WITH_FINDING, "TPU001")
+    write_baseline(findings, str(bl))
+    # SOURCE_WITH_FINDING ends with the 4-space indent of its closing
+    # quotes, so appending an unindented line keeps dedent() happy
+    grown = SOURCE_WITH_FINDING + "g = jax.jit(lambda y: y)\n"
+    un, _, by_baseline = _lint_source(tmp_path, grown, "TPU001",
+                                      baseline_path=str(bl))
+    assert len(by_baseline) == 1
+    assert len(un) == 1
+    assert "g = jax.jit" in un[0].snippet
+
+
+def test_baseline_entry_does_not_absorb_copy_pasted_duplicates(tmp_path):
+    """An entry covers `count` occurrences of its line — a NEW identical
+    copy-paste in the same scope is a new finding, not a free ride."""
+    bl = tmp_path / "baseline.json"
+    findings, _, _ = _lint_source(tmp_path, SOURCE_WITH_FINDING, "TPU001")
+    write_baseline(findings, str(bl))
+    duplicated = SOURCE_WITH_FINDING + "f = jax.jit(lambda x: x)\n"
+    un, _, by_baseline = _lint_source(tmp_path, duplicated, "TPU001",
+                                      baseline_path=str(bl))
+    assert len(by_baseline) == 1
+    assert len(un) == 1  # the second identical line fires
+
+
+def test_partial_baseline_write_preserves_out_of_scope_entries(tmp_path):
+    """`--baseline write` over a path subset must not wipe entries (and
+    written reasons) for files the run never linted."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    b.write_text("import jax\ng = jax.jit(lambda y: y)\n")
+    bl = tmp_path / "baseline.json"
+    from tools.tpulint.engine import linted_rel_paths
+    findings, _, _ = lint_paths([str(a), str(b)],
+                                config=Config(select=("TPU001",)),
+                                root=str(tmp_path))
+    write_baseline(findings, str(bl))
+    data = json.loads(bl.read_text())
+    for e in data["entries"]:
+        e["reason"] = f"justified: {e['path']}"
+    bl.write_text(json.dumps(data))
+    # partial rewrite over a.py only: b.py's entry + reason must survive
+    fa, _, ba = lint_paths([str(a)], config=Config(select=("TPU001",)),
+                           baseline_path=str(bl), root=str(tmp_path))
+    write_baseline(fa + [f for f, _ in ba], str(bl),
+                   linted_paths=linted_rel_paths([str(a)],
+                                                 str(tmp_path)),
+                   selected_rules=("TPU001",))
+    kept = {e["path"]: e["reason"]
+            for e in json.loads(bl.read_text())["entries"]}
+    assert kept == {"a.py": "justified: a.py", "b.py": "justified: b.py"}
+
+
+def test_hot_path_marker_must_be_exact(tmp_path):
+    """A disable-reason MENTIONING hot-path must not flip the module
+    into TPU002's hot-path scope at a distance."""
+    src = """
+        import numpy as np
+        # tpulint: disable=TPU003(keyed per hot-path mesh build)
+        _CACHE = {}
+
+
+        def pull(q):
+            from elasticsearch_tpu.ops import dispatch
+            s = dispatch.call("knn.exact", q)
+            return s.item()
+        """
+    un, _, _ = _lint_source(tmp_path, src, "TPU002")
+    assert not un  # not hot-path: the pragma body is not exactly hot-path
+    marked = src.replace(
+        "# tpulint: disable=TPU003(keyed per hot-path mesh build)",
+        "# tpulint: hot-path")
+    un, _, _ = _lint_source(tmp_path, marked, "TPU002")
+    assert len(un) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_repo_is_clean_exit_0():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_findings_exit_1_and_json_shape(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    proc = _run_cli(str(bad), "--json", "--no-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["counts"]["unsuppressed"] == 1
+    (finding,) = report["findings"]
+    assert finding["rule"] == "TPU001"
+    assert finding["line"] == 2
+    assert "snippet" in finding and "scope" in finding
+
+
+def test_cli_bad_path_exit_2():
+    proc = _run_cli(os.path.join(REPO, "no", "such", "path.py"))
+    assert proc.returncode == 2
+
+
+def test_cli_bad_baseline_mode_exit_2():
+    proc = _run_cli("--baseline", "frobnicate")
+    assert proc.returncode == 2
+
+
+def test_cli_unknown_select_rule_exit_2():
+    """A typoed --select must not silently select zero rules and report
+    clean with exit 0."""
+    proc = _run_cli("--select", "TPU01")
+    assert proc.returncode == 2
+    assert "unknown rule id" in proc.stderr
+
+
+def test_cli_non_python_file_exit_2(tmp_path):
+    """An existing non-.py argument walks to nothing — that must be a
+    loud usage error, not a green '0 findings' no-op."""
+    f = tmp_path / "notes.txt"
+    f.write_text("import jax\n")
+    proc = _run_cli(str(f))
+    assert proc.returncode == 2
+    assert "not a python file" in proc.stderr
+
+
+def test_cli_baseline_write_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    bl = tmp_path / "bl.json"
+    proc = _run_cli(str(bad), "--baseline", "write",
+                    "--baseline-file", str(bl))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.loads(bl.read_text())["entries"]
+    assert len(entries) == 1 and entries[0]["rule"] == "TPU001"
+    # with the fresh baseline the same lint is quiet
+    proc = _run_cli(str(bad), "--baseline-file", str(bl))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# registration-index integration (TPU004 reads real registrations)
+# ---------------------------------------------------------------------------
+
+def test_donated_kernel_index_sees_bm25_registration():
+    """The project index must pick up `bm25.topk`'s donate_argnums from
+    ops/bm25.py — TPU004 is only as good as this map."""
+    from tools.tpulint.engine import Config as C, ModuleContext, \
+        ProjectIndex
+    path = os.path.join(PACKAGE, "ops", "bm25.py")
+    with open(path) as f:
+        ctx = ModuleContext(path, "elasticsearch_tpu/ops/bm25.py",
+                            f.read(), C())
+    idx = ProjectIndex()
+    idx.scan(ctx)
+    assert idx.donated_kernels.get("bm25.topk") == (0, 1)

@@ -1,0 +1,21 @@
+"""TPU001 clean: kernels register with the dispatcher; sharded programs
+build through the version-portable wrapper."""
+from elasticsearch_tpu.ops import dispatch
+from elasticsearch_tpu.parallel.sharded_knn import shard_map
+
+
+def _my_kernel_impl(x, k):
+    return x[:k]
+
+
+dispatch.DISPATCH.register("fx.my_kernel", _my_kernel_impl,
+                           static_argnames=("k",))
+
+
+def my_kernel(x, k):
+    return dispatch.call("fx.my_kernel", x, k=k)
+
+
+def build_sharded(body, mesh, in_specs, out_specs):
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)

@@ -1,0 +1,22 @@
+"""TPU001 fires: raw compilation paths outside the dispatcher."""
+import functools
+
+import jax
+import jax as j
+from jax import jit as _jit  # [expect] raw jit import, aliased
+from jax.experimental.shard_map import shard_map  # [expect] raw import
+
+
+@functools.partial(jax.jit, static_argnames=("k",))  # [expect] raw jit
+def my_kernel(x, k):
+    return x[:k]
+
+
+def other(x):
+    f = jax.jit(lambda v: v + 1.0)  # [expect] raw jit
+    return f(x)
+
+
+def aliased(x):
+    f = j.jit(lambda v: v + 1.0)  # [expect] raw jit via module alias
+    return f(x)

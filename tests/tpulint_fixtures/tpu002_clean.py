@@ -1,0 +1,17 @@
+"""TPU002 clean: one bulk d2h at response-assembly time, host math on
+host arrays."""
+# tpulint: hot-path
+import numpy as np
+
+from elasticsearch_tpu.ops import dispatch
+
+
+def response_assembly(queries):
+    scores, ids = dispatch.call("knn.exact", queries)
+    ids.block_until_ready()
+    scores = np.asarray(scores)  # bulk transfer, outside any loop
+    ids = np.asarray(ids)
+    out = []
+    for qi in range(len(scores)):  # host-side loop over HOST arrays
+        out.append((float(scores[qi][0]), ids[qi].tolist()))
+    return out

@@ -1,0 +1,35 @@
+"""TPU002 fires: host syncs on device arrays in a hot-path module."""
+# tpulint: hot-path
+import numpy as np
+import numpy as _np
+
+from elasticsearch_tpu.ops import dispatch
+
+
+def per_row_pull(queries):
+    scores = dispatch.call("knn.exact", queries)
+    out = []
+    for i in range(8):
+        out.append(float(scores[i]))  # [expect] scalar pull in a loop
+    return out
+
+
+def scalar_pull_anywhere(queries):
+    scores = dispatch.call("knn.exact", queries)
+    return scores.sum().item()  # [expect] .item() on a device array
+
+
+def transfer_in_loop(batches):
+    results = []
+    for q in batches:
+        s = dispatch.call("knn.exact", q)
+        results.append(np.asarray(s))  # [expect] d2h inside the loop
+    return results
+
+
+def transfer_in_loop_aliased_numpy(batches):
+    results = []
+    for q in batches:
+        s = dispatch.call("knn.exact", q)
+        results.append(_np.asarray(s))  # [expect] alias, same d2h
+    return results

@@ -1,0 +1,17 @@
+"""TPU003 clean: identity caches hold the object (pinning its address);
+id() in non-key contexts is fine."""
+_CSR_CACHE = {}
+
+
+def cached_csr(mesh, build):
+    # keying on the OBJECT keeps it alive: the address cannot recycle
+    # while the entry exists
+    entry = _CSR_CACHE.get(mesh)
+    if entry is None:
+        entry = build(mesh)
+        _CSR_CACHE[mesh] = entry
+    return entry
+
+
+def debug_label(node):
+    return f"in-process:{id(node):x}"  # a label, not a cache key

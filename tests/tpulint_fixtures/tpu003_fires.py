@@ -1,0 +1,20 @@
+"""TPU003 fires: caches keyed on id() of long-lived objects."""
+_CSR_CACHE = {}
+_MISC = {}
+
+
+def cached_csr(mesh, build):
+    entry = _CSR_CACHE.get(id(mesh))  # [expect] id() in cache .get()
+    if entry is None:
+        entry = build(mesh)
+        _CSR_CACHE[id(mesh)] = entry  # [expect] id() as subscript key
+    return entry
+
+
+def make_key(reader, field):
+    key = (id(reader), field)  # [expect] id() assigned into a key tuple
+    return key
+
+
+def leaf_sig(x):
+    return ("py", type(x).__name__, id(x))  # [expect] returned from *sig*

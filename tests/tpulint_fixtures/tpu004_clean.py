@@ -1,0 +1,31 @@
+"""TPU004 clean: donated buffers are treated as consumed; fresh ones are
+allocated per call."""
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops import dispatch
+
+
+def _score_impl(board, counts, queries):
+    return board + queries, counts
+
+
+dispatch.DISPATCH.register("fx.score_board2", _score_impl,
+                           donate_argnums=(0, 1))
+
+
+def score(queries):
+    board = jnp.zeros((8, 128))
+    counts = jnp.zeros((8,))
+    out, out_counts = dispatch.call("fx.score_board2", board, counts,
+                                    queries)
+    return out, out_counts  # only the results are read
+
+
+def score_twice(queries):
+    board = jnp.zeros((8, 128))
+    counts = jnp.zeros((8,))
+    out, _ = dispatch.call("fx.score_board2", board, counts, queries)
+    board = jnp.zeros((8, 128))  # reallocated: the old buffer is gone
+    counts = jnp.zeros((8,))
+    out2, _ = dispatch.call("fx.score_board2", board, counts, queries)
+    return out, out2

@@ -1,0 +1,20 @@
+"""TPU004 fires: reading a buffer after donating it to a kernel."""
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops import dispatch
+
+
+def _score_impl(board, counts, queries):
+    return board + queries, counts
+
+
+dispatch.DISPATCH.register("fx.score_board", _score_impl,
+                           donate_argnums=(0, 1))
+
+
+def score(queries):
+    board = jnp.zeros((8, 128))
+    counts = jnp.zeros((8,))
+    out, _ = dispatch.call("fx.score_board", board, counts, queries)
+    checksum = board.sum()  # [expect] board's HBM was donated to XLA
+    return out, checksum

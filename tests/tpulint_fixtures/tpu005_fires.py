@@ -1,0 +1,13 @@
+"""TPU005 fires: cache keys built from raw request payloads."""
+import json
+
+_plan_cache = {}
+
+
+def plan_for(body, compile_plan):
+    key = None
+    plan = _plan_cache.get(json.dumps(body, sort_keys=True))  # [expect]
+    if plan is None:
+        plan = compile_plan(body)
+        _plan_cache[json.dumps(body, sort_keys=True)] = plan  # [expect]
+    return plan, key

@@ -1,0 +1,14 @@
+"""TPU006 clean: 64-bit kernels register with x64=True — the dispatcher
+scopes the flag around both lower() and execution."""
+from elasticsearch_tpu.ops import dispatch
+
+
+def _sum64_impl(values):
+    return values.sum()
+
+
+dispatch.DISPATCH.register("fx.sum64", _sum64_impl, x64=True)
+
+
+def sum64(values):
+    return dispatch.call("fx.sum64", values)

@@ -1,0 +1,19 @@
+"""TPU007 clean: spec ranks match array ranks (the PR 5 fix shape —
+a rank-1 replicated spec for the rank-1 scales array)."""
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticsearch_tpu.parallel.sharded_knn import shard_map
+
+
+def _kernel(board, scales):
+    return board * scales
+
+
+def mesh_scores(mesh):
+    board = jnp.zeros((8, 128))
+    scales = jnp.zeros((128,))
+    in_specs = (P("shard", None), P(None))
+    fn = shard_map(_kernel, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("shard", None))
+    return fn(board, scales)

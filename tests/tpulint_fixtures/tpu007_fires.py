@@ -1,0 +1,26 @@
+"""TPU007 fires: PartitionSpec rank vs array rank mismatches."""
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticsearch_tpu.parallel.sharded_knn import shard_map
+
+
+def _kernel(board, scales):
+    return board * scales
+
+
+def mesh_scores(mesh):
+    board = jnp.zeros((8, 128))
+    scales = jnp.zeros((128,))
+    fn = shard_map(_kernel, mesh=mesh,
+                   in_specs=(P("shard", None), P(None, None)),
+                   out_specs=P("shard", None))
+    return fn(board, scales)  # [expect] scales is rank 1, spec is rank 2
+
+
+def arity_mismatch(mesh):
+    board = jnp.zeros((8, 128))
+    fn = shard_map(_kernel, mesh=mesh,
+                   in_specs=(P("shard", None), P(None)),
+                   out_specs=P("shard", None))
+    return fn(board)  # [expect] 2 in_specs, 1 argument

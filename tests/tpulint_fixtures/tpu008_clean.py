@@ -1,0 +1,36 @@
+"""TPU008 clean: every mutation of module-level caches holds the lock;
+import-time population and locals are exempt."""
+import threading
+
+_lock = threading.Lock()
+_plan_cache = {}
+_REGISTRY = {}
+
+_REGISTRY["builtin"] = object()  # import-time: single-threaded by design
+
+
+def put_plan(key, plan):
+    with _lock:
+        _plan_cache[key] = plan
+
+
+def local_scratch(rows):
+    buckets = {}
+    for r in rows:
+        buckets[r % 8] = r  # a local, not the module cache
+    return buckets
+
+
+def shadowing_local_with_nested_global(rows):
+    _plan_cache = {}  # LOCAL shadow of the module cache
+
+    def reset_module_cache():
+        # a nested helper's `global` must not un-shadow the OUTER
+        # function's local (rebinding a global is not a container
+        # mutation either way)
+        global _plan_cache
+        _plan_cache = {}
+
+    for r in rows:
+        _plan_cache[r] = r  # mutating the local shadow: no lock needed
+    return _plan_cache, reset_module_cache

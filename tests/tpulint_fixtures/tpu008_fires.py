@@ -1,0 +1,16 @@
+"""TPU008 fires: module-level caches mutated outside the module lock."""
+import threading
+
+_lock = threading.Lock()
+_plan_cache = {}
+_counters = {"hits": 0}
+
+
+def put_plan(key, plan):
+    _plan_cache[key] = plan  # [expect] mutation without _lock
+
+
+def count_hit(name):
+    with _lock:
+        _counters["hits"] += 1
+    _counters.setdefault(name, 0)  # [expect] mutation outside the with

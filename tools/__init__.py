@@ -1,0 +1,2 @@
+"""Build-time tooling (static analysis, lint gates). Not shipped with the
+engine package — `elasticsearch_tpu/` must never import from here."""

@@ -1,0 +1,33 @@
+"""tpulint — AST-based JAX-discipline analyzer for elasticsearch_tpu.
+
+The reference Elasticsearch enforces correctness at BUILD time: forbidden-
+APIs checks, logger-usage checks, bootstrap checks. This engine's JAX
+discipline (everything compiles through the shape-bucketed dispatcher,
+host syncs stay out of hot loops, caches never key on recycled addresses)
+was until now enforced only dynamically — the `ES_TPU_DISPATCH_STRICT=1`
+closed-grid gate — and every serving PR shipped a review-round fix for a
+*statically detectable* bug. tpulint turns those historical bug classes
+into enforced rules (see `rules.py`; each rule's docstring cites the bug
+it encodes) and runs over `elasticsearch_tpu/` as a tier-1 test
+(`tests/test_tpulint.py::test_repo_is_lint_clean`) and a CLI:
+
+    python -m tools.tpulint [paths...] [--json] [--baseline write]
+
+Exit codes: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+
+Suppression: `# tpulint: disable=TPU00x(reason)` on the finding's line or
+the standalone comment line directly above it — the reason is mandatory;
+a bare `disable=TPU00x` suppresses nothing. Pre-existing justified sites
+live in the checked-in baseline (`tools/tpulint/baseline.json`), keyed on
+(rule, file, enclosing scope, normalized source line) so unrelated edits
+don't churn it; every entry carries a written reason.
+"""
+
+from tools.tpulint.engine import (  # noqa: F401
+    Config,
+    Finding,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from tools.tpulint.rules import ALL_RULES  # noqa: F401

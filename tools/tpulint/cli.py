@@ -1,0 +1,131 @@
+"""tpulint CLI: `python -m tools.tpulint [paths...] [--json]
+[--baseline write]`.
+
+Exit-code contract (tier-1 and CI key off it):
+
+  0  no unsuppressed findings (pragma- and baseline-suppressed sites are
+     reported in the summary / JSON but don't fail the run)
+  1  at least one unsuppressed finding
+  2  usage error (bad flag, missing path, unparseable source)
+
+`--baseline write` rewrites `tools/tpulint/baseline.json` from the
+current findings (reasons of surviving entries are preserved; new
+entries get a TODO reason the lint-clean test rejects) and exits 0 —
+baselining is an explicit, reviewed act, not a side effect of linting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from tools.tpulint.engine import (
+    BASELINE_DEFAULT,
+    Config,
+    lint_paths,
+    write_baseline,
+)
+
+
+def _repo_root() -> str:
+    """The directory holding `tools/` — baseline paths stay stable no
+    matter where the CLI is invoked from."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="AST-based JAX-discipline analyzer (rules TPU001-"
+                    "TPU008; each encodes a historical serving bug)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint "
+                        "(default: elasticsearch_tpu/)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report on stdout")
+    p.add_argument("--baseline", metavar="write", default=None,
+                   help="'write' regenerates the checked-in baseline "
+                        "from current findings and exits 0")
+    p.add_argument("--baseline-file", default=BASELINE_DEFAULT,
+                   help="baseline path (default: tools/tpulint/"
+                        "baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.baseline not in (None, "write"):
+        print(f"tpulint: unknown --baseline mode {args.baseline!r} "
+              "(only 'write' is supported)", file=sys.stderr)
+        return 2
+
+    root = _repo_root()
+    paths = args.paths or [os.path.join(root, "elasticsearch_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    select = None
+    if args.select:
+        from tools.tpulint.rules import ALL_RULES
+        known = {r.rule_id for r in ALL_RULES}
+        select = tuple(s.strip() for s in args.select.split(","))
+        unknown = [s for s in select if s not in known]
+        if unknown:
+            # a typo must not silently select zero rules and exit green
+            print(f"tpulint: unknown rule id(s) {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+    config = Config(select=select)
+    baseline_path = None if args.no_baseline else args.baseline_file
+    try:
+        unsuppressed, by_pragma, by_baseline = lint_paths(
+            paths, config=config, baseline_path=baseline_path, root=root)
+    except SystemExit as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+
+    if args.baseline == "write":
+        from tools.tpulint.engine import linted_rel_paths
+        n = write_baseline(
+            unsuppressed + [f for f, _ in by_baseline],
+            args.baseline_file,
+            # scope the rewrite to what this run actually looked at — a
+            # partial run (path subset / --select) must not wipe other
+            # files'/rules' entries and their written reasons
+            linted_paths=linted_rel_paths(paths, root),
+            selected_rules=select)
+        print(f"tpulint: wrote {n} baseline entries to "
+              f"{os.path.relpath(args.baseline_file, root)}")
+        return 0
+
+    if args.as_json:
+        report = {
+            "findings": [f.to_json() for f in unsuppressed],
+            "suppressed": {
+                "pragma": [dict(f.to_json(), reason=r)
+                           for f, r in by_pragma],
+                "baseline": [dict(f.to_json(), reason=r)
+                             for f, r in by_baseline],
+            },
+            "counts": {"unsuppressed": len(unsuppressed),
+                       "pragma": len(by_pragma),
+                       "baseline": len(by_baseline)},
+        }
+        print(json.dumps(report, indent=2))
+    else:
+        for f in unsuppressed:
+            print(f.render())
+        print(f"tpulint: {len(unsuppressed)} finding(s), "
+              f"{len(by_pragma)} pragma-suppressed, "
+              f"{len(by_baseline)} baselined")
+    return 1 if unsuppressed else 0

@@ -1,0 +1,305 @@
+"""Light name-binding dataflow for tpulint rules.
+
+This is NOT a general abstract interpreter — it is the minimum tracking
+the historical bug classes need, resolved per function in statement
+order:
+
+* device taint — which locals hold device arrays (results of
+  `dispatch.call`, `jax.device_put`, `jnp.*` constructors, calls of a
+  local bound to a `shard_map(...)` program), so TPU002 only fires host
+  syncs on arrays that actually live on the device, and TPU004 can see a
+  donated buffer through later slicing;
+* static rank — array ranks inferable from local construction
+  (`jnp.zeros((a, b))` is rank 2 whatever a and b are), so TPU007 can
+  check PartitionSpec ranks without running anything;
+* tuple-literal bindings — `in_specs = (P(None), P("shard", None))`
+  assigned one statement before the `shard_map(...)` call still counts
+  as a literal spec.
+
+Unknown stays unknown: every helper returns None/absent rather than
+guessing, so rules built on top fire only on statically certain facts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Name helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """'jax.experimental.pjit.pjit' for nested Attribute/Name chains,
+    '' when the expression isn't a plain dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted(node.func)
+
+
+def base_name(node: ast.AST) -> Optional[str]:
+    """The root Name of an expression chain: `corpus.matrix[0].T` -> the
+    name 'corpus'; None when the chain doesn't root in a Name."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def iter_functions(tree: ast.AST):
+    """Yield every (node) FunctionDef/AsyncFunctionDef in the module,
+    including nested ones (each is analyzed with its own local scope)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def assign_targets(stmt: ast.stmt) -> List[str]:
+    """Simple Name targets bound by this statement (tuple unpack
+    included); attribute/subscript targets are ignored."""
+    names: List[str] = []
+
+    def collect(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# Device taint
+# ---------------------------------------------------------------------------
+
+_DISPATCH_HINTS = ("dispatch", "DISPATCH")
+# jnp constructors / converters whose results live on device
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+
+
+def numpy_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(module aliases, bare converter names) numpy is bound to in this
+    module — `import numpy as _np` and `from numpy import asarray as aa`
+    must count as host converters exactly like the conventional `np`
+    (the serving batcher itself imports `numpy as _np`)."""
+    mods = {"np", "numpy"}
+    fns: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    mods.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom) and node.module == "numpy":
+            for a in node.names:
+                if a.name in ("asarray", "array"):
+                    fns.add(a.asname or a.name)
+    return mods, fns
+
+
+def is_dispatch_call(node: ast.Call) -> bool:
+    """`dispatch.call(...)`, `DISPATCH.call(...)`,
+    `_dispatch.DISPATCH.call(...)` — the kernel execution entrypoints."""
+    name = call_name(node)
+    return (name.endswith(".call")
+            and any(h in name for h in _DISPATCH_HINTS))
+
+
+class DeviceTaint:
+    """Statement-order device-array tracking for one function body."""
+
+    def __init__(self, np_mods: Optional[Set[str]] = None,
+                 np_fns: Optional[Set[str]] = None) -> None:
+        self.device: Set[str] = set()
+        self.shardmap_fns: Set[str] = set()
+        mods = np_mods if np_mods is not None else {"np", "numpy"}
+        # d2h converter spellings under this module's actual imports
+        self.host_converters: Set[str] = {
+            f"{m}.{fn}" for m in mods for fn in ("asarray", "array")}
+        self.np_fn_converters: Set[str] = set(np_fns or ())
+
+    # ------------------------------------------------------------ queries
+    def expr_is_device(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.device
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            b = base_name(node)
+            return b is not None and b in self.device
+        if isinstance(node, ast.Call):
+            return self.call_returns_device(node)
+        if isinstance(node, ast.BinOp):
+            return (self.expr_is_device(node.left)
+                    or self.expr_is_device(node.right))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_is_device(e) for e in node.elts)
+        return False
+
+    def call_returns_device(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        if is_dispatch_call(node):
+            return True
+        if name == "jax.device_put":
+            return True
+        if any(name.startswith(p) for p in _JNP_PREFIXES):
+            return name not in ()  # every jnp.* result is a device array
+        if isinstance(node.func, ast.Name):
+            if node.func.id in self.shardmap_fns:
+                return True
+            if node.func.id in self.np_fn_converters:
+                return False
+        # method on a device value keeps the taint (.astype, .reshape,
+        # .at[...].set, slicing chains) — EXCEPT the host converters
+        if isinstance(node.func, ast.Attribute):
+            if name in self.host_converters:
+                return False
+            b = base_name(node.func)
+            if b is not None and b in self.device \
+                    and node.func.attr not in ("item", "tolist"):
+                return True
+        return False
+
+    # ------------------------------------------------------------ updates
+    def observe(self, stmt: ast.stmt) -> None:
+        """Update bindings from one statement (call BEFORE judging reads
+        in the NEXT statement; same-statement reads use the pre-state)."""
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) >= 1:
+            value = stmt.value
+            is_dev = self.expr_is_device(value)
+            is_sm = (isinstance(value, ast.Call)
+                     and call_name(value).split(".")[-1] == "shard_map")
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.device.discard(t.id)
+                    self.shardmap_fns.discard(t.id)
+                    if is_sm:
+                        self.shardmap_fns.add(t.id)
+                    elif is_dev:
+                        self.device.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)) and is_dev:
+                    # unpacking a device-producing call taints every leaf
+                    for name in assign_targets(stmt):
+                        self.device.add(name)
+                else:
+                    for name in assign_targets(stmt):
+                        self.device.discard(name)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt.target, ast.Name):
+                value = getattr(stmt, "value", None)
+                if value is not None and self.expr_is_device(value):
+                    self.device.add(stmt.target.id)
+                elif isinstance(stmt, ast.AnnAssign):
+                    self.device.discard(stmt.target.id)
+
+
+# ---------------------------------------------------------------------------
+# Static rank inference (TPU007)
+# ---------------------------------------------------------------------------
+
+_SHAPED_CTORS = ("zeros", "ones", "full", "empty")
+
+
+def _tuple_len(node: ast.AST) -> Optional[int]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    return None
+
+
+def infer_rank(node: ast.AST, ranks: Dict[str, int]) -> Optional[int]:
+    """Array rank of an expression when statically certain, else None."""
+    if isinstance(node, ast.Name):
+        return ranks.get(node.id)
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        leaf = name.split(".")[-1]
+        if any(name.startswith(p) for p in _JNP_PREFIXES):
+            if leaf in _SHAPED_CTORS and node.args:
+                n = _tuple_len(node.args[0])
+                if n is not None:
+                    return n
+                if isinstance(node.args[0], (ast.Constant, ast.Name,
+                                             ast.BinOp)):
+                    return 1  # scalar shape arg: rank-1
+            if leaf == "arange":
+                return 1
+            if leaf == "asarray" and node.args:
+                depth = _literal_depth(node.args[0])
+                if depth is not None:
+                    return depth
+                return infer_rank(node.args[0], ranks)
+        if leaf == "reshape" and isinstance(node.func, ast.Attribute):
+            if len(node.args) == 1:
+                n = _tuple_len(node.args[0])
+                return n if n is not None else None
+            if node.args:
+                return len(node.args)
+    return None
+
+
+def _literal_depth(node: ast.AST) -> Optional[int]:
+    if isinstance(node, (ast.List, ast.Tuple)):
+        if not node.elts:
+            return 1
+        inner = _literal_depth(node.elts[0])
+        return None if inner is None else inner + 1
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)):
+        return 0
+    return None
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec extraction (TPU007)
+# ---------------------------------------------------------------------------
+
+_SPEC_NAMES = ("P", "PartitionSpec")
+
+
+def spec_rank(node: ast.AST) -> Optional[int]:
+    """Rank a literal `P(...)`/`PartitionSpec(...)` call describes —
+    one axis entry per positional argument."""
+    if isinstance(node, ast.Call) \
+            and call_name(node).split(".")[-1] in _SPEC_NAMES:
+        return len(node.args)
+    return None
+
+
+def spec_ranks(node: ast.AST,
+               tuple_bindings: Dict[str, ast.AST]) -> Optional[
+                   List[Optional[int]]]:
+    """Per-argument spec ranks of an `in_specs=` expression. Accepts a
+    literal tuple/list of P() calls, a single P() call, or a Name bound
+    to such a tuple earlier in the same function; None per-position when
+    that spec isn't a literal, None overall when nothing is literal."""
+    if isinstance(node, ast.Name) and node.id in tuple_bindings:
+        node = tuple_bindings[node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = [spec_rank(e) for e in node.elts]
+        return out if any(r is not None for r in out) else None
+    r = spec_rank(node)
+    if r is not None:
+        return [r]
+    return None

@@ -1,0 +1,367 @@
+"""tpulint engine: file walker, pragma suppression, baseline machinery.
+
+The engine is rule-agnostic: it parses each file once, hands every rule a
+`ModuleContext` (tree + source + parent links + pragma table + hot-path
+classification) and a `ProjectIndex` (cross-file facts such as which
+dispatcher kernels donate which argument positions), then filters the
+returned findings through pragmas and the checked-in baseline.
+
+Suppression model (both are deliberate, reviewed artifacts):
+
+* pragma — `# tpulint: disable=TPU00x(reason)` on the offending line, or
+  on a standalone comment line directly above it. The reason is part of
+  the syntax: a bare `disable=TPU00x` suppresses nothing, so a
+  suppression can never be quieter than the finding it hides.
+* baseline — `tools/tpulint/baseline.json` holds pre-existing justified
+  sites keyed on (rule, path, scope, normalized source line); line
+  numbers stay OUT of the key so unrelated edits don't churn the file.
+  `--baseline write` regenerates entries, preserving written reasons.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# modules whose device work sits on the serving hot path: host syncs here
+# stall a batch that other requests coalesced into (TPU002's scope)
+DEFAULT_HOT_PATH_GLOBS = (
+    "*/ops/*.py",
+    "*/parallel/*.py",
+    "*/serving/*.py",
+    "*/vectors/*.py",
+    "*/search/*_plan.py",
+)
+
+# the one module allowed to build raw executables (TPU001): every other
+# compile routes through its shape-bucketed AOT cache
+DEFAULT_RAW_JIT_ALLOWED = ("*/ops/dispatch.py",)
+# the one module allowed to import jax's raw shard_map: the version-
+# portable wrapper every sharded kernel builds through
+DEFAULT_RAW_SHARD_MAP_ALLOWED = ("*/parallel/sharded_knn.py",)
+# the one module allowed to enter enable_x64 (TPU006): the dispatcher's
+# scoped-x64 path (`register(..., x64=True)`)
+DEFAULT_X64_ALLOWED = ("*/ops/dispatch.py",)
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*(?P<body>.+)$")
+_DISABLE_ITEM_RE = re.compile(r"(TPU\d{3})\s*(?:\(([^()]*)\))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # posix-relative to the lint root
+    line: int
+    col: int
+    message: str
+    scope: str         # module-level: "<module>"; else Class.func qualname
+    snippet: str       # stripped source line the finding anchors to
+
+    def baseline_key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.scope, self.snippet)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.scope}] {self.message}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Config:
+    hot_path_globs: Sequence[str] = DEFAULT_HOT_PATH_GLOBS
+    raw_jit_allowed: Sequence[str] = DEFAULT_RAW_JIT_ALLOWED
+    raw_shard_map_allowed: Sequence[str] = DEFAULT_RAW_SHARD_MAP_ALLOWED
+    x64_allowed: Sequence[str] = DEFAULT_X64_ALLOWED
+    select: Optional[Sequence[str]] = None   # rule ids; None = all
+
+
+class ModuleContext:
+    """One parsed file plus everything rules need to judge it."""
+
+    def __init__(self, path: str, rel_path: str, source: str,
+                 config: Config):
+        self.path = path
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.config = config
+        self.tree = ast.parse(source, filename=path)
+        # parent links: rules climb from a node to its enclosing
+        # subscript/call/with to judge context
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.pragmas = _parse_pragmas(self.lines)
+        # a module opts into TPU002's hot-path scope with a pragma whose
+        # whole body is exactly `hot-path` (`# tpulint: hot-path`) — a
+        # substring match would let a disable-reason MENTIONING hot-path
+        # flip the classification at a distance
+        self.hot_path = (
+            any(fnmatch.fnmatch("/" + self.rel_path, g)
+                or fnmatch.fnmatch(self.rel_path, g)
+                for g in config.hot_path_globs)
+            or any(body.strip() == "hot-path"
+                   for _, body in self.pragmas["raw"]))
+
+    # ------------------------------------------------------------ helpers
+    def matches(self, globs: Sequence[str]) -> bool:
+        return any(fnmatch.fnmatch("/" + self.rel_path, g)
+                   or fnmatch.fnmatch(self.rel_path, g) for g in globs)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualname of the innermost enclosing function/class."""
+        parts: List[str] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.rel_path, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message, scope=self.scope_of(node),
+                       snippet=self.snippet_at(line))
+
+    def suppressed(self, finding: Finding) -> Optional[str]:
+        """Reason string when a pragma covers this finding, else None.
+        (Standalone-comment pragmas were already re-targeted to the next
+        line at parse time, so one lookup covers both placements.)"""
+        return self.pragmas["by_line"].get((finding.line, finding.rule))
+
+
+def _parse_pragmas(lines: List[str]) -> dict:
+    """Pragma table: {(line, rule): reason}. A pragma on a standalone
+    comment line covers the next source line; on a code line, that line.
+    Reasons are MANDATORY — `disable=TPU001` with no `(reason)` parses to
+    reason None and suppresses nothing."""
+    by_line: Dict[Tuple[int, str], str] = {}
+    raw: List[Tuple[int, str]] = []
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m:
+            continue
+        body = m.group("body").strip()
+        raw.append((i, body))
+        if "disable=" not in body:
+            continue
+        target = i + 1 if text.lstrip().startswith("#") else i
+        for rule, reason in _DISABLE_ITEM_RE.findall(
+                body.split("disable=", 1)[1]):
+            if reason and reason.strip():
+                by_line[(target, rule)] = reason.strip()
+    return {"by_line": by_line, "raw": raw}
+
+
+# ---------------------------------------------------------------------------
+# Project-level pre-pass
+# ---------------------------------------------------------------------------
+
+class ProjectIndex:
+    """Cross-file facts collected before rules run.
+
+    donated_kernels: kernel name -> donated positional indices, read from
+    every `*.register("name", fn, donate_argnums=(...))` call in the tree
+    set — TPU004 maps them onto `dispatch.call("name", *args)` sites
+    (arg position = donated argnum + 1; position 0 is the kernel name).
+    """
+
+    def __init__(self) -> None:
+        self.donated_kernels: Dict[str, Tuple[int, ...]] = {}
+
+    def scan(self, ctx: ModuleContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "register"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            donate: Tuple[int, ...] = ()
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    try:
+                        donate = tuple(
+                            int(e.value) for e in kw.value.elts
+                            if isinstance(e, ast.Constant))
+                    except (TypeError, ValueError):
+                        donate = ()
+            if donate:
+                self.donated_kernels[node.args[0].value] = donate
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str, str],
+                                     Tuple[str, int]]:
+    """Baseline entries as {key: (reason, count)}; missing file = empty.
+    `count` is how many identical findings the entry covers — an entry
+    must not silently absorb NEW copy-pasted occurrences of the same
+    line (entries without a count, from older files, cover one)."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str, str], Tuple[str, int]] = {}
+    for e in data.get("entries", []):
+        out[(e["rule"], e["path"], e["scope"], e["snippet"])] = \
+            (e.get("reason", ""), max(int(e.get("count", 1)), 1))
+    return out
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   linted_paths: Optional[Sequence[str]] = None,
+                   selected_rules: Optional[Sequence[str]] = None) -> int:
+    """Regenerate the baseline from current findings. Reasons of entries
+    whose key still matches are preserved; new entries get a TODO reason
+    the lint-clean test rejects until a human writes one.
+
+    A partial run must not wipe what it didn't look at: old entries for
+    files outside `linted_paths` or rules outside `selected_rules` are
+    carried over untouched (reason and count included)."""
+    old = load_baseline(path)
+    counts: Dict[Tuple[str, str, str, str], int] = {}
+    order: List[Tuple[str, str, str, str]] = []
+    meta: Dict[Tuple[str, str, str, str], Finding] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        key = f.baseline_key()
+        if key not in counts:
+            order.append(key)
+            meta[key] = f
+        counts[key] = counts.get(key, 0) + 1
+    lp = set(linted_paths) if linted_paths is not None else None
+    sr = set(selected_rules) if selected_rules is not None else None
+    for key, (reason, count) in old.items():
+        rule, kpath = key[0], key[1]
+        in_scope = ((lp is None or kpath in lp)
+                    and (sr is None or rule in sr))
+        if not in_scope and key not in counts:
+            order.append(key)
+            counts[key] = count
+    entries = []
+    for key in order:
+        rule, kpath, scope, snippet = key
+        old_reason = old.get(key, ("", 0))[0]
+        entries.append({
+            "rule": rule, "path": kpath, "scope": scope,
+            "snippet": snippet, "count": counts[key],
+            "reason": old_reason or "TODO: justify this baseline entry",
+        })
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2,
+                  sort_keys=False)
+        f.write("\n")
+    return len(entries)
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if not p.endswith(".py"):
+                # walking a regular file yields nothing — a typoed CI
+                # argument must be a loud usage error, not a green no-op
+                raise SystemExit(f"tpulint: not a python file: {p}")
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"
+                       and not d.startswith(".")]
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def linted_rel_paths(paths: Sequence[str], root: str) -> List[str]:
+    """Root-relative posix paths a lint over `paths` will cover — the
+    scope `write_baseline` needs to avoid wiping entries a partial run
+    never looked at."""
+    out = []
+    for fp in _iter_py_files(paths):
+        rel = os.path.relpath(fp, root)
+        out.append((fp if rel.startswith("..") else rel)
+                   .replace(os.sep, "/"))
+    return out
+
+
+def lint_paths(paths: Sequence[str], config: Optional[Config] = None,
+               baseline_path: Optional[str] = None,
+               root: Optional[str] = None):
+    """Lint every .py under `paths`.
+
+    Returns (unsuppressed, pragma_suppressed, baselined) finding lists —
+    pragma-suppressed and baselined findings ride along so the CLI's JSON
+    report and the baseline writer can see the full picture.
+    """
+    from tools.tpulint.rules import ALL_RULES
+
+    config = config or Config()
+    root = root or os.getcwd()
+    rules = [r for r in ALL_RULES
+             if config.select is None or r.rule_id in config.select]
+
+    contexts: List[ModuleContext] = []
+    for fp in _iter_py_files(paths):
+        rel = os.path.relpath(fp, root)
+        if rel.startswith(".."):  # outside the root: key on the abs path
+            rel = fp
+        with open(fp, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            contexts.append(ModuleContext(fp, rel, source, config))
+        except SyntaxError as exc:
+            raise SystemExit(f"tpulint: cannot parse {fp}: {exc}")
+
+    index = ProjectIndex()
+    for ctx in contexts:
+        index.scan(ctx)
+
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    used: Dict[Tuple[str, str, str, str], int] = {}
+    unsuppressed: List[Finding] = []
+    by_pragma: List[Tuple[Finding, str]] = []
+    by_baseline: List[Tuple[Finding, str]] = []
+    for ctx in contexts:
+        for rule in rules:
+            for finding in rule.run(ctx, index):
+                reason = ctx.suppressed(finding)
+                if reason is not None:
+                    by_pragma.append((finding, reason))
+                    continue
+                key = finding.baseline_key()
+                entry = baseline.get(key)
+                # an entry covers `count` occurrences — a NEW copy-paste
+                # of an already-baselined line is a new finding
+                if entry is not None and used.get(key, 0) < entry[1]:
+                    used[key] = used.get(key, 0) + 1
+                    by_baseline.append((finding, entry[0]))
+                    continue
+                unsuppressed.append(finding)
+    unsuppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return unsuppressed, by_pragma, by_baseline

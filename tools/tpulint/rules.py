@@ -1,0 +1,757 @@
+"""tpulint rules: our historical JAX bug classes as AST checks.
+
+Every rule docstring cites the concrete bug it encodes — these are not
+style opinions, each one shipped (or nearly shipped) as a serving defect
+and cost a review round to catch by hand. Rules return findings only on
+statically certain facts (the dataflow helpers answer "unknown" freely),
+so suppressions stay rare and meaningful.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.dataflow import (
+    DeviceTaint,
+    assign_targets,
+    base_name,
+    call_name,
+    dotted,
+    infer_rank,
+    is_dispatch_call,
+    iter_functions,
+    numpy_aliases,
+    spec_ranks,
+)
+from tools.tpulint.engine import Finding, ModuleContext, ProjectIndex
+
+
+def _body_statements(body, *, in_loop: bool = False):
+    """Yield (stmt, in_loop) linearly through nested blocks, NOT entering
+    nested function/class definitions (they get their own analysis)."""
+    for stmt in body:
+        yield stmt, in_loop
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            yield from _body_statements(stmt.body, in_loop=True)
+            yield from _body_statements(stmt.orelse, in_loop=in_loop)
+        elif isinstance(stmt, ast.If):
+            yield from _body_statements(stmt.body, in_loop=in_loop)
+            yield from _body_statements(stmt.orelse, in_loop=in_loop)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            yield from _body_statements(stmt.body, in_loop=in_loop)
+        elif isinstance(stmt, ast.Try):
+            yield from _body_statements(stmt.body, in_loop=in_loop)
+            for h in stmt.handlers:
+                yield from _body_statements(h.body, in_loop=in_loop)
+            yield from _body_statements(stmt.orelse, in_loop=in_loop)
+            yield from _body_statements(stmt.finalbody, in_loop=in_loop)
+
+
+def _stmt_expressions(stmt: ast.stmt):
+    """Walk one statement's OWN expression trees (nested defs excluded,
+    nested compound-statement bodies excluded — _body_statements already
+    visits those as separate statements)."""
+    blocks = ("body", "orelse", "finalbody", "handlers")
+    todo: List[ast.AST] = []
+    for field, value in ast.iter_fields(stmt):
+        if field in blocks:
+            continue
+        if isinstance(value, ast.AST):
+            todo.append(value)
+        elif isinstance(value, list):
+            todo.extend(v for v in value if isinstance(v, ast.AST))
+    while todo:
+        node = todo.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+class Rule:
+    rule_id = "TPU000"
+    summary = ""
+
+    def run(self, ctx: ModuleContext,
+            index: ProjectIndex) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — raw compilation outside the dispatcher
+# ---------------------------------------------------------------------------
+
+class RawJitRule(Rule):
+    """TPU001: no raw `jax.jit` / `pjit` / raw-JAX `shard_map` outside
+    `ops/dispatch.py` registrations.
+
+    Historical bug (BENCH_MATRIX_r06 → PR 4): every distinct (batch, k,
+    corpus) shape hit `jax.jit`'s tracing path in the serving hot loop —
+    batch=4 ran at 149 ms p50 vs batch=16 at 31.6 ms, all of it XLA
+    recompilation. The fix was the shape-bucketed dispatcher: ONE module
+    owns `jax.jit(...).lower(...).compile()`, a closed bucket grid, and
+    strict-mode enforcement. A raw `jax.jit` anywhere else is a second,
+    unbucketed compile path the strict gate cannot see. Raw-JAX
+    `shard_map` imports are confined to the version-portable wrapper in
+    `parallel/sharded_knn.py` for the same reason (plus the 0.4.37 import
+    split the seed tripped over); building programs THROUGH that wrapper
+    and registering them is the sanctioned pattern.
+    """
+
+    rule_id = "TPU001"
+    summary = "raw jit/pjit/shard_map compilation outside the dispatcher"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        jit_ok = ctx.matches(ctx.config.raw_jit_allowed)
+        sm_ok = ctx.matches(ctx.config.raw_shard_map_allowed)
+        # `import jax as j` must not evade the rule (same alias blindness
+        # TPU002 had for numpy): every name the jax module is bound to
+        jax_mods = {"jax"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        jax_mods.add(a.asname or "jax")
+        jit_names = {f"{m}.jit" for m in jax_mods}
+        sm_names = {f"{m}.shard_map" for m in jax_mods} | {
+            f"{m}.experimental.shard_map.shard_map" for m in jax_mods}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                name = dotted(node)
+                if not jit_ok and name in jit_names:
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "raw jax.jit compiles outside the shape-bucketed "
+                        "dispatcher (register the kernel in ops/dispatch "
+                        "and route through dispatch.call)"))
+                elif not jit_ok and name.endswith(".pjit"):
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "raw pjit compiles outside the dispatcher"))
+                elif not sm_ok and name in sm_names:
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "raw JAX shard_map reference — use the "
+                        "parallel/sharded_knn wrapper"))
+            elif isinstance(node, ast.Name) and node.id == "pjit" \
+                    and isinstance(node.ctx, ast.Load) and not jit_ok:
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "raw pjit compiles outside the dispatcher"))
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if not jit_ok and node.module.endswith("pjit"):
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "raw pjit import outside the dispatcher"))
+                elif not jit_ok and node.module == "jax" \
+                        and any(a.name in ("jit", "pjit")
+                                for a in node.names):
+                    # `from jax import jit` (any alias) is the most
+                    # common idiom for the same unbucketed compile path
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "raw jit import outside the dispatcher — "
+                        "register the kernel in ops/dispatch and route "
+                        "through dispatch.call"))
+                elif not sm_ok and node.module in (
+                        "jax", "jax.experimental.shard_map",
+                        "jax.experimental") \
+                        and any(a.name == "shard_map"
+                                for a in node.names):
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "raw JAX shard_map import — build sharded "
+                        "programs through the version-portable wrapper "
+                        "(parallel/sharded_knn.shard_map) and register "
+                        "them with the dispatcher"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — host syncs on device arrays in hot paths
+# ---------------------------------------------------------------------------
+
+_SCALAR_PULLS = ("item", "tolist")
+
+
+class HostSyncRule(Rule):
+    """TPU002: host-sync calls on device arrays inside hot-path modules.
+
+    Historical bug (PR 6): the host agg walkers resolved doc values
+    through a per-row `get_doc_value` loop — thousands of tiny host
+    round-trips where one columnar gather was value-identical and orders
+    of magnitude faster. On the serving path a host sync is worse: it
+    stalls a batch that OTHER requests coalesced into.
+
+    The rule is structural about what "response assembly" means: one bulk
+    device→host transfer (`np.asarray` on a whole board) or one
+    `block_until_ready` at result time, OUTSIDE any loop, is the
+    sanctioned pattern — exactly how `vectors/store.py` lands mesh
+    results. What fires is (a) any sync inside a for/while loop — the
+    per-row round-trip shape — and (b) scalar pulls (`.item()`,
+    `.tolist()`, `float()`, `int()`) on device arrays anywhere in a hot
+    module: a scalar pull per element is the loop, just written inline.
+    """
+
+    rule_id = "TPU002"
+    summary = "host sync on a device array in a hot-path module"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if not ctx.hot_path:
+            return []
+        findings: List[Finding] = []
+        np_mods, np_fns = numpy_aliases(ctx.tree)
+        for fn in iter_functions(ctx.tree):
+            taint = DeviceTaint(np_mods, np_fns)
+            for stmt, in_loop in _body_statements(fn.body):
+                for node in _stmt_expressions(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = self._judge(node, taint, in_loop)
+                    if f is not None:
+                        findings.append(ctx.finding(self.rule_id, node, f))
+                taint.observe(stmt)
+        return findings
+
+    @staticmethod
+    def _judge(node: ast.Call, taint: DeviceTaint,
+               in_loop: bool) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _SCALAR_PULLS \
+                    and taint.expr_is_device(node.func.value):
+                return (f".{attr}() pulls a device array to host "
+                        "element-by-element — keep reductions on device "
+                        "and land results with one bulk np.asarray at "
+                        "response-assembly time")
+            if attr == "block_until_ready" and in_loop \
+                    and taint.expr_is_device(node.func.value):
+                return ("block_until_ready inside a loop serializes "
+                        "device dispatches — sync once, outside the "
+                        "loop, at response-assembly time")
+            if call_name(node) in taint.host_converters \
+                    and in_loop and node.args \
+                    and taint.expr_is_device(node.args[0]):
+                return ("device→host transfer inside a loop — batch the "
+                        "work and land it with one bulk np.asarray "
+                        "outside the loop")
+        elif isinstance(node.func, ast.Name):
+            if node.func.id in ("float", "int") \
+                    and len(node.args) == 1 \
+                    and taint.expr_is_device(node.args[0]):
+                return (f"{node.func.id}() on a device array is a "
+                        "blocking scalar pull — convert whole result "
+                        "boards with np.asarray at response-assembly "
+                        "time")
+            if node.func.id in taint.np_fn_converters and in_loop \
+                    and node.args \
+                    and taint.expr_is_device(node.args[0]):
+                return ("device→host transfer inside a loop — batch the "
+                        "work and land it with one bulk np.asarray "
+                        "outside the loop")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — id()-keyed caches
+# ---------------------------------------------------------------------------
+
+_KEYISH = re.compile(r"key|sig", re.IGNORECASE)
+
+
+class IdKeyedCacheRule(Rule):
+    """TPU003: caches keyed on `id(...)` of long-lived objects.
+
+    Historical bug (PR 5 review round): the lexical mesh-CSR cache keyed
+    on `id(mesh)`. CPython recycles addresses — after the mesh was GC'd
+    and a new Mesh allocated at the same address, the cache handed back
+    arrays laid out for a DEAD mesh. The fix holds the mesh OBJECT
+    (identity compare keeps the referent alive). `id()` in a cache key is
+    only sound if the key also pins the object, which `id()` by
+    construction does not; fire on every id() that flows into a
+    subscript key, a cache `.get/.setdefault/.pop`, or a key/sig-named
+    binding, and let the one deliberate site carry its pragma.
+    """
+
+    rule_id = "TPU003"
+    summary = "cache keyed on id() of a long-lived object"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "id" and len(node.args) == 1):
+                continue
+            why = self._key_context(ctx, node)
+            if why:
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    f"id() used as a cache-key component ({why}) — "
+                    "addresses recycle after GC; key on the object "
+                    "itself (holding it alive) or a stable fingerprint"))
+        return findings
+
+    @staticmethod
+    def _key_context(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+        child = node
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Subscript) and cur.slice is child:
+                return "subscript key"
+            if isinstance(cur, ast.Call) \
+                    and isinstance(cur.func, ast.Attribute) \
+                    and cur.func.attr in ("get", "setdefault", "pop") \
+                    and child in cur.args \
+                    and "cache" in dotted(cur.func.value).lower():
+                return f"cache .{cur.func.attr}()"
+            if isinstance(cur, ast.Assign) and cur.value is child:
+                for t in cur.targets:
+                    tname = base_name(t) or ""
+                    if _KEYISH.search(tname):
+                        return f"assigned to {tname!r}"
+            if isinstance(cur, ast.Return):
+                fn = ctx.parents.get(cur)
+                while fn is not None and not isinstance(
+                        fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = ctx.parents.get(fn)
+                if fn is not None and _KEYISH.search(fn.name):
+                    return f"returned from {fn.name}()"
+            child = cur
+            cur = ctx.parents.get(cur)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPU004 — read-after-donate
+# ---------------------------------------------------------------------------
+
+class ReadAfterDonateRule(Rule):
+    """TPU004: re-reading an argument after passing it to a kernel
+    registered with `donate_argnums`.
+
+    Historical bug (PR 5 review round): `mesh.append` donated the old
+    shard buffers while a search dispatched against the previously-
+    installed FieldCorpus was still reading them — donated-then-deleted
+    arrays and torn slot_map bookkeeping, visible only under concurrent
+    refresh+search. XLA reuses a donated buffer's HBM for the outputs;
+    ANY later read of that Python name is a read of freed memory. The
+    donated positions come from the project-wide registration index
+    (`register("bm25.topk", ..., donate_argnums=(0, 1))` →
+    `dispatch.call("bm25.topk", board, count, ...)` consumes board and
+    count).
+    """
+
+    rule_id = "TPU004"
+    summary = "argument read again after donation to a kernel"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if not index.donated_kernels:
+            return []
+        findings: List[Finding] = []
+        for fn in iter_functions(ctx.tree):
+            consumed: Dict[str, Tuple[str, int]] = {}
+            for stmt, _ in _body_statements(fn.body):
+                if consumed:
+                    for node in _stmt_expressions(stmt):
+                        if isinstance(node, ast.Name) \
+                                and isinstance(node.ctx, ast.Load) \
+                                and node.id in consumed \
+                                and node.lineno > consumed[node.id][1]:
+                            kernel, line = consumed[node.id]
+                            findings.append(ctx.finding(
+                                self.rule_id, node,
+                                f"{node.id!r} was donated to kernel "
+                                f"[{kernel}] on line {line} "
+                                f"(donate_argnums) — its buffer is "
+                                "freed/reused by XLA; reading it is "
+                                "use-after-free on HBM"))
+                            del consumed[node.id]
+                new_consumed: List[Tuple[str, str, int]] = []
+                for node in _stmt_expressions(stmt):
+                    if not (isinstance(node, ast.Call)
+                            and is_dispatch_call(node) and node.args):
+                        continue
+                    head = node.args[0]
+                    if not (isinstance(head, ast.Constant)
+                            and isinstance(head.value, str)):
+                        continue
+                    donated = index.donated_kernels.get(head.value)
+                    if not donated:
+                        continue
+                    for argnum in donated:
+                        pos = argnum + 1  # args[0] is the kernel name
+                        if pos < len(node.args) and isinstance(
+                                node.args[pos], ast.Name):
+                            new_consumed.append(
+                                (node.args[pos].id, head.value,
+                                 node.lineno))
+                for name, kernel, line in new_consumed:
+                    consumed[name] = (kernel, line)
+                # rebinds clear consumption LAST: `x = call("k", x)` binds
+                # x to the fresh result, not the donated buffer
+                for name in assign_targets(stmt):
+                    consumed.pop(name, None)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TPU005 — unscrubbed request payloads in cache keys
+# ---------------------------------------------------------------------------
+
+_REQUEST_NAMES = frozenset(
+    {"body", "bodies", "request", "requests", "req", "payload",
+     "aggs_spec", "query"})
+_SANCTIONED_WRAPPER = re.compile(r"key|normali[sz]e|scrub|fingerprint",
+                                 re.IGNORECASE)
+
+
+class UnscrubbedCacheKeyRule(Rule):
+    """TPU005: cache keys built from raw request-payload values without a
+    `plan_cache_key`-style normalizer.
+
+    Historical bug (PR 4): the hybrid plan cache hashed the WHOLE request
+    body — including the query vector and match text — so 108 identical-
+    shape dashboard bodies produced `plan_cache_hits: 0` and the plan
+    compiler ran per request. The fix (`hybrid_plan.plan_cache_key`)
+    scrubs per-query values down to shapes/placeholders before hashing;
+    the agg plan cache (PR 6) reuses the same trick. Any cache access
+    whose key expression touches a request-payload name (`body`,
+    `request`, `aggs_spec`, ...) without passing it through a
+    key/normalize/scrub/fingerprint-named function rebuilds that bug.
+    """
+
+    rule_id = "TPU005"
+    summary = "cache key built from a raw request payload"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            key_expr = None
+            where = None
+            if isinstance(node, ast.Subscript) \
+                    and "cache" in (dotted(node.value) or "").lower():
+                key_expr, where = node.slice, "subscript"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "put", "setdefault") \
+                    and node.args \
+                    and "cache" in dotted(node.func.value).lower():
+                key_expr, where = node.args[0], f".{node.func.attr}()"
+            if key_expr is None:
+                continue
+            name = self._raw_payload_name(ctx, key_expr)
+            if name:
+                findings.append(ctx.finding(
+                    self.rule_id, key_expr,
+                    f"cache {where} keys on raw request payload "
+                    f"{name!r} — per-query values (vectors, match text) "
+                    "in the key defeat the cache and leak payload data "
+                    "into key storage; scrub through a plan_cache_key-"
+                    "style normalizer first"))
+        return findings
+
+    @staticmethod
+    def _raw_payload_name(ctx: ModuleContext,
+                          key_expr: ast.AST) -> Optional[str]:
+        for node in ast.walk(key_expr):
+            if not (isinstance(node, ast.Name)
+                    and node.id in _REQUEST_NAMES):
+                continue
+            cur = ctx.parents.get(node)
+            sanctioned = False
+            while cur is not None and cur is not key_expr:
+                if isinstance(cur, ast.Call) and _SANCTIONED_WRAPPER.search(
+                        call_name(cur).split(".")[-1]):
+                    sanctioned = True
+                    break
+                cur = ctx.parents.get(cur)
+            if not sanctioned:
+                return node.id
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPU006 — enable_x64 outside the dispatcher
+# ---------------------------------------------------------------------------
+
+class ScopedX64Rule(Rule):
+    """TPU006: `enable_x64` entered outside the dispatcher's scoped-x64
+    path.
+
+    Historical context (PR 6): the agg kernels need int64 counts and f64
+    sums (date millis don't fit int32/f32), but the process default must
+    stay 32-bit — the serving kernels are f32 by design, and a global
+    x64 flip silently doubles every buffer and retraces every cached
+    executable. The dispatcher's `register(..., x64=True)` scopes the
+    flag around BOTH lower() and execution (`_x64_scope`), which is the
+    only sound placement: tracing canonicalization and the AOT arg-aval
+    check both read the active config. An `enable_x64` (or
+    `jax.config.update("jax_enable_x64", ...)`) anywhere else either
+    leaks process-wide or desyncs trace-time from call-time dtypes.
+    """
+
+    rule_id = "TPU006"
+    summary = "enable_x64 outside the dispatcher's scoped path"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if ctx.matches(ctx.config.x64_allowed):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and any(a.name == "enable_x64" for a in node.names):
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "enable_x64 import outside ops/dispatch.py — x64 "
+                    "kernels must register with dispatch.register(..., "
+                    "x64=True) so the flag scopes trace AND execution"))
+            elif isinstance(node, ast.Attribute) \
+                    and dotted(node).endswith("enable_x64"):
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "enable_x64 reference outside the dispatcher's "
+                    "scoped-x64 path"))
+            elif isinstance(node, ast.Call) \
+                    and call_name(node).endswith("config.update") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == "jax_enable_x64":
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "global jax_enable_x64 flip — doubles every buffer "
+                    "and invalidates the AOT executable cache; use "
+                    "dispatch.register(..., x64=True)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TPU007 — PartitionSpec rank mismatches
+# ---------------------------------------------------------------------------
+
+class SpecRankRule(Rule):
+    """TPU007: statically inferable PartitionSpec-rank vs array-rank
+    mismatches at `shard_map` call sites.
+
+    Historical bug (PR 5 review round): the sharded BM25 kernel's int8
+    tile-scales spec was `P(None, None)` — rank 2 — for a rank-1 scales
+    array, so EVERY mesh-routed BM25 dispatch on an `impact_dtype: int8`
+    index raised inside shard_map. The mismatch was fully visible in the
+    source: the spec literal and the array construction were lines
+    apart. This rule checks exactly that: where both the spec tuple and
+    the argument's rank are statically certain, they must agree — and
+    the positional arity of the call must match the spec tuple.
+    """
+
+    rule_id = "TPU007"
+    summary = "PartitionSpec rank does not match array rank in shard_map"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in iter_functions(ctx.tree):
+            ranks: Dict[str, int] = {}
+            tuples: Dict[str, ast.AST] = {}
+            sharded: Dict[str, List[Optional[int]]] = {}
+            for stmt, _ in _body_statements(fn.body):
+                # judge calls of previously-bound shard_map programs
+                for node in _stmt_expressions(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    specs = None
+                    label = None
+                    if isinstance(node.func, ast.Name) \
+                            and node.func.id in sharded:
+                        specs, label = sharded[node.func.id], node.func.id
+                    elif isinstance(node.func, ast.Call) \
+                            and self._is_shard_map(node.func):
+                        specs = self._specs_of(node.func, tuples)
+                        label = "shard_map(...)"
+                    if specs is None:
+                        continue
+                    if not any(isinstance(a, ast.Starred)
+                               for a in node.args) \
+                            and len(node.args) != len(specs):
+                        findings.append(ctx.finding(
+                            self.rule_id, node,
+                            f"{label} declares {len(specs)} in_specs but "
+                            f"is called with {len(node.args)} arguments"))
+                        continue
+                    for i, (arg, srank) in enumerate(
+                            zip(node.args, specs)):
+                        if srank is None:
+                            continue
+                        arank = infer_rank(arg, ranks)
+                        if arank is not None and arank != srank:
+                            findings.append(ctx.finding(
+                                self.rule_id, arg,
+                                f"in_specs[{i}] of {label} is rank "
+                                f"{srank} but the argument is rank "
+                                f"{arank} — shard_map raises on rank "
+                                "mismatch at dispatch time (the PR 5 "
+                                "int8 tile-scales bug)"))
+                # then update bindings
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    tname = stmt.targets[0].id
+                    ranks.pop(tname, None)
+                    tuples.pop(tname, None)
+                    sharded.pop(tname, None)
+                    value = stmt.value
+                    if isinstance(value, (ast.Tuple, ast.List)):
+                        tuples[tname] = value
+                    elif isinstance(value, ast.Call) \
+                            and self._is_shard_map(value):
+                        specs = self._specs_of(value, tuples)
+                        if specs is not None:
+                            sharded[tname] = specs
+                    else:
+                        r = infer_rank(value, ranks)
+                        if r is not None:
+                            ranks[tname] = r
+        return findings
+
+    @staticmethod
+    def _is_shard_map(node: ast.Call) -> bool:
+        return call_name(node).split(".")[-1] == "shard_map"
+
+    @staticmethod
+    def _specs_of(node: ast.Call, tuples: Dict[str, ast.AST]):
+        for kw in node.keywords:
+            if kw.arg == "in_specs":
+                return spec_ranks(kw.value, tuples)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPU008 — unlocked module-level cache mutation
+# ---------------------------------------------------------------------------
+
+_MUTATORS = frozenset({"append", "add", "setdefault", "pop", "popitem",
+                       "clear", "update", "remove", "discard", "extend",
+                       "insert"})
+_CONTAINER_CTORS = frozenset({"dict", "list", "set", "defaultdict",
+                              "OrderedDict", "Counter", "deque"})
+
+
+class ModuleCacheLockRule(Rule):
+    """TPU008: module-level mutable caches mutated without the module's
+    declared lock.
+
+    Historical context: every process-wide cache in this engine is
+    mutated from multiple threads by construction — the serving batcher
+    coalesces requests from N REST threads, warmup runs on a background
+    thread, refresh listeners run on the flush path. The dispatcher's
+    executable cache and `parallel/policy.py`'s config/counters each
+    pair their module/instance state with one lock and take it on every
+    mutation; PR 5's review round still found the double-build race in
+    `serving_mesh()` (two first callers caching distinct equal Meshes,
+    forcing identity-keyed caches through a redundant corpus re-upload).
+    This rule makes the convention checkable at the module level: a
+    module-level mutable container mutated inside any function must hold
+    a module-level lock while doing it — and a module with such caches
+    and NO lock declared is itself a finding.
+    """
+
+    rule_id = "TPU008"
+    summary = "module-level cache mutated outside the module's lock"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        locks: Set[str] = set()
+        containers: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            for name in assign_targets(stmt):
+                if isinstance(value, ast.Call):
+                    cname = call_name(value)
+                    if cname.split(".")[-1] in ("Lock", "RLock"):
+                        locks.add(name)
+                    elif cname.split(".")[-1] in _CONTAINER_CTORS:
+                        containers.add(name)
+                elif isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                        ast.DictComp, ast.ListComp,
+                                        ast.SetComp)):
+                    containers.add(name)
+        if not containers:
+            return []
+        findings: List[Finding] = []
+        for fn in iter_functions(ctx.tree):
+            # THIS function's own `global` declarations (nested functions
+            # are analyzed separately — _body_statements stops at them,
+            # so a helper's `global` can't un-shadow our local)
+            declared_global = {
+                n for s, _ in _body_statements(fn.body)
+                if isinstance(s, ast.Global) for n in s.names}
+            local_names: set = set()
+            for stmt, _ in _body_statements(fn.body):
+                # a local shadowing the module name is not the cache —
+                # unless declared global
+                local_names |= set(assign_targets(stmt)) - declared_global
+                for node in _stmt_expressions(stmt):
+                    target = self._mutation_target(node, ctx)
+                    if target is None or target not in containers \
+                            or target in local_names:
+                        continue
+                    if self._under_lock(ctx, node, locks):
+                        continue
+                    if locks:
+                        lock_list = ", ".join(sorted(locks))
+                        msg = (f"module-level cache {target!r} mutated "
+                               f"without holding the module's lock "
+                               f"({lock_list}) — serving threads, warmup "
+                               "and refresh listeners all reach "
+                               "module state concurrently")
+                    else:
+                        msg = (f"module-level cache {target!r} is mutated "
+                               "from functions but the module declares "
+                               "no lock — add a module-level "
+                               "threading.Lock and take it on every "
+                               "mutation")
+                    findings.append(ctx.finding(self.rule_id, node, msg))
+        return findings
+
+    @staticmethod
+    def _mutation_target(node: ast.AST,
+                         ctx: ModuleContext) -> Optional[str]:
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            return base_name(node)
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            return base_name(node.func.value)
+        return None
+
+    @staticmethod
+    def _under_lock(ctx: ModuleContext, node: ast.AST,
+                    locks: Set[str]) -> bool:
+        if not locks:
+            return False
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Name) and sub.id in locks:
+                            return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            cur = ctx.parents.get(cur)
+        return False
+
+
+ALL_RULES: List[Rule] = [
+    RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
+    UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
+    ModuleCacheLockRule(),
+]
