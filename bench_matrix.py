@@ -134,6 +134,49 @@ def _dispatch_delta(mark):
             - mark["out_of_grid_compiles"]}
 
 
+def hybrid_serving_stats(node) -> dict:
+    """Serving-stats fields of the hybrid bench row, read from the SAME
+    live node instance that served the timed loop (`node.
+    _hybrid_stats_section()` sums the per-index executors the queries
+    actually went through). The r06 record carried `plan_cache_hits: 0`
+    here — root-caused to the rows having been captured by a pre-PR4
+    bench/engine snapshot (the daemon ran the code on disk at capture
+    time, before the plan-cache key fix landed), NOT to stats being read
+    from a wrong process or engine instance; tests/test_bench_harness.py
+    pins this wiring so a regression in either the key scrubbing or the
+    stats plumbing re-fires visibly in the row."""
+    hs = node._hybrid_stats_section()
+    return {
+        "plan_cache_hits": hs["plan_cache_hits"],
+        "plan_cache_misses": hs["plan_cache_misses"],
+        "hybrid_batches": hs["batches"],
+        "rejected_429": hs["rejected_depth"] + hs["shed_deadline"],
+        "sched": dict(hs["scheduler"]),
+        # closed-loop tail attribution (cumulative ms over the run):
+        # queueing vs device dispatch+sync vs host hydrate — a red
+        # p99/p50 gate is diagnosable from the row alone
+        "tail_ms": {
+            "queue_wait": round(hs["queue_wait_nanos"] / 1e6, 1),
+            "device": round(
+                (hs["dispatch_nanos"] + hs["sync_nanos"]) / 1e6, 1),
+            "hydrate": round(hs["hydrate_nanos"] / 1e6, 1)}}
+
+
+def knn_scheduler_stats(node) -> dict:
+    """Continuous-batching scheduler fields of the closed-loop (1cl/4cl)
+    rows: the per-(field, k) kNN batchers' counters summed over shards
+    (`_nodes/stats indices.knn.scheduler`)."""
+    sched = node._knn_stats_section().get("scheduler", {})
+    return {
+        "sched": {key: sched.get(key, 0)
+                  for key in ("batches", "pipelined_batches", "topups",
+                              "deadline_sheds", "overlap_hits")},
+        "tail_ms": {
+            "queue_wait": round(sched.get("queue_wait_nanos", 0) / 1e6, 1),
+            "dispatch": round(sched.get("dispatch_nanos", 0) / 1e6, 1),
+            "finalize": round(sched.get("finalize_nanos", 0) / 1e6, 1)}}
+
+
 def _emit(name, qps, marginal, p50, p99, recall, n, d, dtype, extra=None,
           dispatch=None):
     row = {
@@ -582,6 +625,15 @@ def run_hybrid_rrf():
         t.start()
     for t in warm:
         t.join()
+    # deterministic grid warmup on top of the stochastic warm queries:
+    # the lexical kernel's term-tile dimension (m) pads to the batch max
+    # and a zipf-popular term alone spans dozens of impact tiles, so a
+    # timed-loop batch can hit an m rung the warm queries never produced
+    # (measured: one such miss cost a 750 ms XLA compile mid-loop and
+    # alone blew the p99 gate). Run the executor's warmup grid
+    # synchronously — the same grid a TPU-class deployment precompiles
+    # at batcher start via warmup-at-open.
+    node._hybrid_executor(node.indices.get("hybrid"))._warmup()
     mark = _dispatch_mark()  # steady state: the timed loop must read 0 misses
     all_lats = [[] for _ in range(n_clients)]
 
@@ -602,22 +654,19 @@ def run_hybrid_rrf():
     lats = np.concatenate(all_lats)
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
-    hybrid_stats = node._hybrid_stats_section()
     qps = n_clients * per_client / wall
     print(json.dumps({"config": "3_hybrid_bm25_knn_rrf",
                       "qps": round(qps, 1),
                       "p50_ms": round(p50, 2),
                       "p99_ms": round(p99, 2),
                       "p99_over_p50": round(p99 / max(p50, 1e-9), 2),
+                      "gate_p99_le_3x_p50": bool(p99 <= 3 * p50),
                       "gate_500qps": bool(qps >= 500),
                       "n_docs": n_docs, "dims": dims,
                       "concurrent_clients": n_clients,
                       "fused_lists": 2,
                       "execution": "fused_hybrid_plan",
-                      "plan_cache_hits": hybrid_stats["plan_cache_hits"],
-                      "hybrid_batches": hybrid_stats["batches"],
-                      "rejected_429": hybrid_stats["rejected_depth"]
-                      + hybrid_stats["shed_deadline"],
+                      **hybrid_serving_stats(node),
                       "dispatch": _dispatch_delta(mark)}), flush=True)
     node.close()
 
@@ -727,6 +776,7 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
         "n_docs": n, "dims": d, "dtype": dtype,
         "concurrent_clients": n_clients,
         "build_s": round(build_s, 1),
+        **knn_scheduler_stats(node),
         "dispatch": _dispatch_delta(mark)}), flush=True)
     node.close()
 

@@ -107,10 +107,15 @@ class LocalShard:
             # slipped into replicated state (older master) must degrade
             # to the exhaustive default, never crash the state applier
             knn_engine, knn_nlist, knn_nprobe = "tpu", None, "auto"
+        from elasticsearch_tpu.common.settings import setting_bool
         self.vector_store = VectorStoreShard(
             dtype=s.get("index.knn.vector_dtype", "bf16"),
             knn_engine=knn_engine, knn_nlist=knn_nlist,
-            knn_nprobe=knn_nprobe)
+            knn_nprobe=knn_nprobe,
+            topup=setting_bool(s.get("index.knn.topup", True)),
+            target_batch_latency_ms=float(
+                s.get("index.knn.target_batch_latency_ms", 2.0)),
+            async_depth=int(s.get("index.knn.async_depth", 2)))
         self._attach_engine(engine)
 
     def _attach_engine(self, engine: Engine) -> None:

@@ -111,16 +111,20 @@ class IndexShardHandle:
                  mapper_service: MapperService, translog_sync: str = "request",
                  vector_dtype: str = "bf16", index_sort=None,
                  knn_engine: str = "tpu", knn_nlist=None,
-                 knn_nprobe="auto"):
+                 knn_nprobe="auto", knn_topup: bool = True,
+                 knn_target_batch_latency_ms: float = 2.0,
+                 knn_async_depth: int = 2):
         self.index_name = index_name
         self.shard_id = shard_id
         self.engine = Engine(path, mapper_service,
                              translog_sync=translog_sync,
                              index_sort=index_sort)
-        self.vector_store = VectorStoreShard(dtype=vector_dtype,
-                                             knn_engine=knn_engine,
-                                             knn_nlist=knn_nlist,
-                                             knn_nprobe=knn_nprobe)
+        self.vector_store = VectorStoreShard(
+            dtype=vector_dtype, knn_engine=knn_engine,
+            knn_nlist=knn_nlist, knn_nprobe=knn_nprobe,
+            topup=knn_topup,
+            target_batch_latency_ms=knn_target_batch_latency_ms,
+            async_depth=knn_async_depth)
         self.mapper_service = mapper_service
         self._sync_vectors(self.engine.acquire_searcher())
         self.engine.add_refresh_listener(self._sync_vectors)
@@ -239,13 +243,23 @@ class IndexService:
                 order_s = order_s[0] if order_s else "asc"
             if sort_field:
                 index_sort = (str(sort_field), str(order_s))
+        # continuous-batching knobs of the per-shard kNN batchers
+        # (`vectors/store.py`): bucket top-up + pipelined dispatch depth
+        from elasticsearch_tpu.common.settings import setting_bool
+        knn_topup = setting_bool(settings.get("index.knn.topup", True))
+        knn_target_ms = float(settings.get(
+            "index.knn.target_batch_latency_ms", 2.0))
+        knn_async_depth = int(settings.get("index.knn.async_depth", 2))
         self.shards: List[IndexShardHandle] = []
         for s in range(self.num_shards):
             self.shards.append(IndexShardHandle(
                 name, s, os.path.join(path, str(s)), self.mapper_service,
                 translog_sync=sync, vector_dtype=vec_dtype,
                 index_sort=index_sort, knn_engine=knn_engine,
-                knn_nlist=knn_nlist, knn_nprobe=knn_nprobe))
+                knn_nlist=knn_nlist, knn_nprobe=knn_nprobe,
+                knn_topup=knn_topup,
+                knn_target_batch_latency_ms=knn_target_ms,
+                knn_async_depth=knn_async_depth))
         self.aliases: Dict[str, dict] = {}
 
     @property

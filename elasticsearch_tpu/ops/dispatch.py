@@ -114,6 +114,19 @@ def is_query_bucket(n: int) -> bool:
     return n >= 1 and n == bucket_queries(n)
 
 
+def bucket_headroom(n: int, max_batch: Optional[int] = None) -> int:
+    """Free rows left in `n` requests' dispatch bucket — the continuous
+    batcher's top-up budget. A batch of n dispatches padded to
+    `bucket_queries(n)` rows either way, so admitting up to this many
+    late arrivals into the forming batch costs ZERO recompiles (the
+    compiled shape is the bucket) and zero extra padding work. `max_batch`
+    additionally clamps to a caller's batch ceiling."""
+    bucket = bucket_queries(n)
+    if max_batch is not None:
+        bucket = min(bucket, int(max_batch))
+    return max(bucket - n, 0)
+
+
 def is_accelerator_backend() -> bool:
     """True when the default jax backend is a real accelerator (TPU, or
     the axon plugin) — the ONE probe behind every TPU-class policy:
@@ -279,7 +292,8 @@ class Dispatcher:
                        if strict is None else strict)
         self._counters = {"hits": 0, "misses": 0, "compiles": 0,
                           "compile_nanos": 0, "out_of_grid_compiles": 0,
-                          "warmup_compiles": 0, "inline_calls": 0}
+                          "warmup_compiles": 0, "inline_calls": 0,
+                          "async_calls": 0}
         self._bucket: Dict[str, Dict[str, int]] = {}
         self._trace = threading.local()
 
@@ -367,6 +381,33 @@ class Dispatcher:
         self._event(name, key_str, not compiled_now, compile_nanos)
         with _x64_scope(kernel.x64):
             return entry.compiled(*args)
+
+    def note_async(self, n: int = 1) -> None:
+        """Count `n` dispatches whose device sync was deferred to
+        response-assembly time (the pipelined serving path). The handle
+        PRODUCER calls this when it hands back un-synced arrays —
+        `vectors/store._dispatch_many` for the exhaustive kNN path — so
+        `_nodes/stats indices.dispatch` `async_calls` honestly reports
+        how much of the serving load actually pipelines, including
+        dispatches that go through higher-level wrappers rather than
+        `call_async` itself."""
+        with self._lock:
+            self._counters["async_calls"] += n
+
+    def call_async(self, name: str, *args, **static_kwargs):
+        """`call`, with the no-sync contract made explicit (and counted).
+
+        JAX dispatch is asynchronous on every backend: the returned
+        arrays are futures whose values materialize when the host first
+        reads them (`np.asarray` / `block_until_ready`). `call` already
+        returns them un-synced — this entry exists for callers built
+        around that fact (the continuous batcher's pipelined dispatch
+        stage): it promises the caller launches work and DEFERS the sync
+        to response-assembly time, letting batch N's host hydrate overlap
+        batch N+1's device dispatch. Feeds the `async_calls` counter
+        (as does `note_async` for wrapped dispatches)."""
+        self.note_async()
+        return self.call(name, *args, **static_kwargs)
 
     def _signature(self, args) -> Tuple[Any, Tuple]:
         import jax
@@ -526,6 +567,10 @@ DISPATCH = Dispatcher()
 
 def call(name: str, *args, **static_kwargs):
     return DISPATCH.call(name, *args, **static_kwargs)
+
+
+def call_async(name: str, *args, **static_kwargs):
+    return DISPATCH.call_async(name, *args, **static_kwargs)
 
 
 def stats(per_bucket: bool = True) -> dict:

@@ -33,6 +33,7 @@ Per-phase timings thread into `profile.hybrid` and the node's
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -386,7 +387,9 @@ class HybridExecutor:
     def __init__(self, node, svc, max_batch: int = 64,
                  max_queue_depth: int = 256,
                  deadline_ms: Optional[float] = 10_000.0,
-                 plan_cache_entries: int = 256):
+                 plan_cache_entries: int = 256, topup: bool = True,
+                 target_batch_latency_ms: float = 2.0,
+                 async_depth: int = 2):
         from elasticsearch_tpu.ops import dispatch as _dispatch
         from elasticsearch_tpu.search.caches import LruCache
         self.node = node
@@ -395,16 +398,33 @@ class HybridExecutor:
             dtype=str(svc.settings.get("index.lexical.impact_dtype",
                                        "f32")))
         self.plan_cache = LruCache(max_entries=plan_cache_entries)
+        # pipelined continuous batching: the runner holds the scheduler
+        # lock only for plan-bind + the un-synced leg dispatches
+        # (_dispatch_batch); device sync, RRF fusion and hydrate run
+        # outside it (_finalize_batch), overlapping the next batch's
+        # device dispatch. `_run_batch` stays the synchronous
+        # (dispatch+finalize) path for poisoned-batch serial retries.
         self.batcher = BoundedBatcher(self._run_batch, max_batch=max_batch,
                                       max_queue_depth=max_queue_depth,
                                       deadline_ms=deadline_ms,
                                       warmup=self._warmup
                                       if _dispatch.warmup_enabled()
-                                      else None)
+                                      else None,
+                                      dispatch_fn=self._dispatch_batch,
+                                      finalize_fn=self._finalize_batch,
+                                      topup=topup,
+                                      target_batch_latency_ms=(
+                                          target_batch_latency_ms),
+                                      async_depth=async_depth)
         self.stats = {"searches": 0, "batches": 0, "max_batch_seen": 0,
                       "plan_cache_hits": 0, "plan_cache_misses": 0,
                       "plan_nanos": 0, "score_nanos": 0, "fuse_nanos": 0,
-                      "hydrate_nanos": 0}
+                      "hydrate_nanos": 0, "queue_wait_nanos": 0,
+                      "dispatch_nanos": 0, "sync_nanos": 0}
+        # finalize stages of different batches run CONCURRENTLY when
+        # async_depth > 1; their stats writes must not lose updates
+        # (dispatch-stage writes serialize under the batcher lock)
+        self._stats_lock = threading.Lock()
 
     # ------------------------------------------------------------- entry
     def submit(self, body: dict) -> dict:
@@ -437,8 +457,24 @@ class HybridExecutor:
             n_tiles = max(int(lf.tile_slots.shape[0]), 1)
             scales = (jax.ShapeDtypeStruct((n_tiles,), _jnp.float32)
                       if lf.dtype == "int8" else None)
+            # the kernel's term-tile dimension pads pow-2 to the batch's
+            # max TOTAL tile count (`plan_queries` sums a query's terms),
+            # and a zipf-popular term alone can span dozens of impact
+            # tiles — warm the m ladder up to a few-wide-term query over
+            # this field's layout (4 × widest term), not a fixed {1,2,4}.
+            # The r06-shape closed-loop bench showed exactly this gap: a
+            # timed-loop batch hit m=16 and paid a 750 ms XLA compile
+            # mid-flight. Still a floor, not a ceiling — a many-term
+            # query over several wide terms can exceed the cap and
+            # compile once; the persistent cache absorbs it across
+            # restarts.
+            max_nt = max((nt for _first, nt in lf.term_tiles.values()),
+                         default=1)
+            m_cap = _pow2(min(max(4 * max_nt, 4), 256))
+            m_rungs = [m for m in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+                       if m <= m_cap]
             for q in (1, 8, 16):
-                for m in (1, 2, 4):
+                for m in m_rungs:
                     entries.append((
                         "bm25.topk",
                         (jax.ShapeDtypeStruct((q, width), _jnp.float32),
@@ -472,6 +508,17 @@ class HybridExecutor:
 
     # ------------------------------------------------------------- batch
     def _run_batch(self, bodies: List[dict]) -> List[dict]:
+        """Synchronous serving of one batch: dispatch + finalize back to
+        back. The batcher's main path splits the two stages so finalize
+        overlaps the next dispatch; this entry is the poisoned-batch
+        serial-retry path and the parity oracle for tests."""
+        return self._finalize_batch(self._dispatch_batch(bodies))
+
+    def _dispatch_batch(self, bodies: List[dict]):
+        """Dispatch stage (runs under the batcher's scheduler lock):
+        plan-cache bind, generic/lexical leg execution, and the UN-SYNCED
+        kNN device dispatches. Returns the in-flight handle
+        `_finalize_batch` lands; no blocking device sync happens here."""
         start = time.perf_counter()
         svc = self.svc
         reader = svc.combined_reader()
@@ -481,6 +528,9 @@ class HybridExecutor:
         self.stats["batches"] += 1
         self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"],
                                            len(bodies))
+        sched_meta = self.batcher.batch_meta()
+        self.stats["queue_wait_nanos"] += sched_meta.get(
+            "queue_wait_max_nanos", 0)
 
         t0 = time.perf_counter_ns()
         plans: List[HybridPlan] = []
@@ -497,6 +547,14 @@ class HybridExecutor:
         breaker_bytes = reader.num_docs * 16 * max(len(bodies), 1)
         self.node.breakers.add_estimate("request", breaker_bytes,
                                         "<hybrid>")
+        # the per-dispatch event trace costs a dict per kernel call;
+        # only pay it when some query in the batch asked to profile
+        trace = any(body.get("profile") for body in bodies)
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        from elasticsearch_tpu.parallel import policy as _mesh_policy
+        mesh_before = _mesh_policy.stats() if trace else None
+        if trace:
+            _dispatch.DISPATCH.record_events(True)
         try:
             ctx = SearchContext(reader, svc.mapper_service,
                                 query_cache=self.node.caches.query)
@@ -504,19 +562,55 @@ class HybridExecutor:
             ctx.vector_store = store
 
             t0 = time.perf_counter_ns()
-            # the per-dispatch event trace costs a dict per kernel call;
-            # only pay it when some query in the batch asked to profile
-            trace = any(body.get("profile") for body in bodies)
+            leg_results, leg_info, pending = self._score_legs_async(
+                reader, store, ctx, plans, bound)
+            dispatch_nanos = time.perf_counter_ns() - t0
+            self.stats["dispatch_nanos"] += dispatch_nanos
+        except BaseException:
+            if trace:
+                _dispatch.DISPATCH.drain_events()
+                _dispatch.DISPATCH.record_events(False)
+            self.node.breakers.release("request", breaker_bytes)
+            raise
+        return {"start": start, "reader": reader, "store": store,
+                "bodies": bodies, "plans": plans,
+                "cache_state": cache_state, "plan_nanos": plan_nanos,
+                "dispatch_nanos": dispatch_nanos,
+                "leg_results": leg_results, "leg_info": leg_info,
+                "pending": pending, "trace": trace,
+                "mesh_before": mesh_before,
+                "breaker_bytes": breaker_bytes,
+                "sched_meta": sched_meta}
+
+    def _finalize_batch(self, handle) -> List[dict]:
+        """Finalize stage (runs OUTSIDE the scheduler lock, overlapping
+        the next batch's dispatch): land the un-synced kNN boards, fuse
+        RRF, hydrate the final windows, assemble responses. Byte-
+        identical to the pre-pipeline single-stage path — only the
+        timing moved."""
+        svc = self.svc
+        reader = handle["reader"]
+        store = handle["store"]
+        bodies = handle["bodies"]
+        plans = handle["plans"]
+        cache_state = handle["cache_state"]
+        plan_nanos = handle["plan_nanos"]
+        leg_results = handle["leg_results"]
+        leg_info = handle["leg_info"]
+        trace = handle["trace"]
+        start = handle["start"]
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        from elasticsearch_tpu.parallel import policy as _mesh_policy
+        try:
             dispatch_events = []
             mesh_delta = None
-            from elasticsearch_tpu.ops import dispatch as _dispatch
-            from elasticsearch_tpu.parallel import policy as _mesh_policy
-            mesh_before = _mesh_policy.stats() if trace else None
-            if trace:
-                _dispatch.DISPATCH.record_events(True)
             try:
-                leg_results, leg_info = self._score_legs(
-                    reader, store, ctx, plans, bound)
+                t0 = time.perf_counter_ns()
+                self._land_knn_legs(handle["pending"], plans, leg_results,
+                                    leg_info, store)
+                sync_nanos = time.perf_counter_ns() - t0
+                with self._stats_lock:
+                    self.stats["sync_nanos"] += sync_nanos
             finally:
                 if trace:
                     dispatch_events = _dispatch.DISPATCH.drain_events()
@@ -528,10 +622,13 @@ class HybridExecutor:
                 # authoritative total, same caveat as the dispatch trace)
                 from elasticsearch_tpu.search.profile import (
                     mesh_stats_delta)
-                mesh_delta = mesh_stats_delta(mesh_before,
+                mesh_delta = mesh_stats_delta(handle["mesh_before"],
                                               _mesh_policy.stats())
-            score_nanos = time.perf_counter_ns() - t0
-            self.stats["score_nanos"] += score_nanos
+            # score = launch + device wait: the pre-pipeline figure,
+            # preserved so dashboards comparing rounds stay meaningful
+            score_nanos = handle["dispatch_nanos"] + sync_nanos
+            with self._stats_lock:
+                self.stats["score_nanos"] += score_nanos
 
             t0 = time.perf_counter_ns()
             fused = []
@@ -545,7 +642,8 @@ class HybridExecutor:
                 top = order[plan.frm:plan.frm + plan.size]
                 fused.append((rows, scores, top))
             fuse_nanos = time.perf_counter_ns() - t0
-            self.stats["fuse_nanos"] += fuse_nanos
+            with self._stats_lock:
+                self.stats["fuse_nanos"] += fuse_nanos
 
             t0 = time.perf_counter_ns()
             out = []
@@ -579,10 +677,16 @@ class HybridExecutor:
                         [leg_info[(bi, li)]
                          for li in range(len(plan.legs))],
                         dispatch_events=dispatch_events,
-                        mesh=mesh_delta)
+                        mesh=mesh_delta,
+                        queue_wait_nanos=handle["sched_meta"].get(
+                            "queue_wait_max_nanos", 0),
+                        device_dispatch_nanos=handle["dispatch_nanos"],
+                        device_sync_nanos=sync_nanos,
+                        scheduler=self.scheduler_snapshot())
                 out.append(resp)
             hydrate_nanos = time.perf_counter_ns() - t0
-            self.stats["hydrate_nanos"] += hydrate_nanos
+            with self._stats_lock:
+                self.stats["hydrate_nanos"] += hydrate_nanos
             for resp in out:
                 prof = resp.get("profile")
                 if prof is not None:
@@ -590,14 +694,27 @@ class HybridExecutor:
                         hydrate_nanos
             return out
         finally:
-            self.node.breakers.release("request", breaker_bytes)
+            self.node.breakers.release("request",
+                                       handle["breaker_bytes"])
+
+    def scheduler_snapshot(self) -> dict:
+        """The continuous batcher's scheduler counters (topups, deadline
+        sheds, dispatch/finalize overlap hits) — profile + stats feed."""
+        sched = self.batcher.sched
+        return {"topups": sched["topups"],
+                "deadline_sheds": sched["deadline_sheds"],
+                "overlap_hits": sched["overlap_hits"],
+                "pipelined_batches": sched["pipelined_batches"]}
 
     # -------------------------------------------------------------- legs
-    def _score_legs(self, reader, store, ctx, plans, bound):
+    def _score_legs_async(self, reader, store, ctx, plans, bound):
         """Execute every body's BOUND legs, grouped so each engine sees
         ONE batched dispatch: lexical legs group per text field, kNN legs
-        per (field, k, num_candidates). Returns {(body_idx, leg_idx):
-        ranked row array} + per-leg profile info."""
+        per (field, k, num_candidates). Generic and lexical legs complete
+        here; kNN legs LAUNCH un-synced (`search_many_async`) and return
+        as pending handles `_land_knn_legs` finalizes. Returns
+        ({(body_idx, leg_idx): ranked row array}, per-leg profile info,
+        pending kNN groups)."""
         leg_results: Dict[Tuple[int, int], np.ndarray] = {}
         leg_info: Dict[Tuple[int, int], dict] = {}
 
@@ -644,6 +761,7 @@ class HybridExecutor:
                     "terms": len(leg.terms), "corpus_slots": lf.n_slots,
                     "impact_tiles": int(lf.tile_slots.shape[0])}
 
+        pending = []
         for (field, k, num_candidates), entries in knn_groups.items():
             reqs = []
             for _bi, _li, leg in entries:
@@ -652,12 +770,23 @@ class HybridExecutor:
                     filter_rows = parse_query(
                         leg.filter_spec).execute(ctx).rows
                 reqs.append((leg.query_vector, filter_rows))
-            batch_out = store.search_many(field, reqs, k,
-                                          num_candidates=num_candidates)
+            # launch only: the device arrays stay un-synced until the
+            # finalize stage lands them (batch N's host work overlaps
+            # batch N+1's dispatch)
+            knn_handle = store.search_many_async(
+                field, reqs, k, num_candidates=num_candidates)
             phases = dict(getattr(store, "last_knn_phases", None) or {})
+            pending.append((entries, knn_handle, field, k, phases))
+        return leg_results, leg_info, pending
+
+    def _land_knn_legs(self, pending, plans, leg_results, leg_info,
+                       store) -> None:
+        """Finalize the batch's kNN legs: one bulk device→host landing
+        per group, then post-processing identical to KnnQuery.execute +
+        the query phase's score-ranked cut."""
+        for entries, knn_handle, field, k, phases in pending:
+            batch_out = store.finalize_many(knn_handle)
             for (bi, li, leg), (rows, raw) in zip(entries, batch_out):
-                # identical post-processing to KnnQuery.execute + the
-                # query phase's score-ranked cut
                 scores = (np.asarray(sim.to_es_score(raw, leg.metric))
                           * leg.boost)
                 order = np.argsort(rows, kind="stable")
@@ -670,4 +799,3 @@ class HybridExecutor:
                     "type": "knn_device", "field": field, "k": k,
                     **({"engine": phases.get("engine")}
                        if phases.get("engine") else {})}
-        return leg_results, leg_info

@@ -26,7 +26,11 @@ def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
                    fuse_nanos: int, hydrate_nanos: int, plan_cache_hit: bool,
                    batch_size: int, legs: list,
                    dispatch_events: Optional[list] = None,
-                   mesh: Optional[dict] = None) -> dict:
+                   mesh: Optional[dict] = None,
+                   queue_wait_nanos: Optional[int] = None,
+                   device_dispatch_nanos: Optional[int] = None,
+                   device_sync_nanos: Optional[int] = None,
+                   scheduler: Optional[dict] = None) -> dict:
     """`profile` section for a fused hybrid (rank.rrf) search
     (search/hybrid_plan.py): the four plan phases — plan (parse/compile or
     cache hit), score (the batched leg dispatches), fuse (vectorized RRF),
@@ -38,19 +42,38 @@ def hybrid_profile(index_name: str, plan_nanos: int, score_nanos: int,
     time/batch_size; reporting the honest batch figure keeps the profile
     additive with wall clock).
 
+    Tail attribution (the closed-loop p99 split): `queue_wait_nanos` is
+    how long the batch's longest-waiting member sat in the admission
+    queue before being scheduled; `device_dispatch_nanos` /
+    `device_sync_nanos` split score time into the launch share (the
+    locked dispatch stage) and the deferred device wait at finalize —
+    so a red p99/p50 gate is diagnosable as queueing vs device-launch
+    vs device-wait vs hydrate directly from the profile. `scheduler`
+    carries the continuous batcher's cumulative counters (topups,
+    deadline_sheds, overlap_hits).
+
     dispatch_events: the per-kernel dispatch trace of this batch's score
     phase (`ops/dispatch.py` record_events) — which shape bucket each
     device dispatch hit, whether its executable was cached, and what any
     compile cost. A steady-state batch shows every event as a hit."""
+    breakdown = {"plan_nanos": plan_nanos,
+                 "score_nanos": score_nanos,
+                 "fuse_nanos": fuse_nanos,
+                 "hydrate_nanos": hydrate_nanos}
+    if queue_wait_nanos is not None:
+        breakdown["queue_wait_nanos"] = queue_wait_nanos
+    if device_dispatch_nanos is not None:
+        breakdown["device_dispatch_nanos"] = device_dispatch_nanos
+    if device_sync_nanos is not None:
+        breakdown["device_sync_nanos"] = device_sync_nanos
     out = {"hybrid": {
         "id": f"[{index_name}][0]",
         "plan_cache": "hit" if plan_cache_hit else "miss",
         "batch_size": batch_size,
-        "breakdown": {"plan_nanos": plan_nanos,
-                      "score_nanos": score_nanos,
-                      "fuse_nanos": fuse_nanos,
-                      "hydrate_nanos": hydrate_nanos},
+        "breakdown": breakdown,
         "legs": legs}}
+    if scheduler is not None:
+        out["hybrid"]["scheduler"] = scheduler
     if dispatch_events is not None:
         out["hybrid"]["dispatch"] = dispatch_events
     if mesh is not None:

@@ -1,16 +1,38 @@
-"""Micro-batching dispatch for vector search.
+"""Continuous-batching dispatch for vector search.
 
 The round-3 serving path dispatched ONE query per device round-trip, so
 end-to-end latency was ~100x the device time and tiny-corpus hybrid queries
-lost to the reference's host-side BulkScorer (`QueryPhase.java:171`). Two
-fixes live here:
+lost to the reference's host-side BulkScorer (`QueryPhase.java:171`). The
+r06 closed-loop rows then showed the NEXT bottleneck: both 8-client rows
+blew the p99 <= 3x p50 gate (6.18x / 5.95x) because the batcher was a
+single admit-or-429 drain loop — a request arriving just after a drain
+waited a full service cycle plus queue, and host post-processing of batch
+N serialized with the device dispatch of batch N+1. This module is the
+continuous-batching scheduler (the Orca/vLLM iteration-level shape,
+adapted to the shape-bucketed dispatcher):
 
 * `CombiningBatcher` — a combining-lock queue: the first thread in becomes
   the runner and executes whatever requests accumulated while the previous
   dispatch was in flight. Under load, batch size grows adaptively with no
-  added idle latency (an idle submit executes immediately, no timer). This
-  is the cross-request coalescing layer the reference never needed (Lucene
-  searches are per-thread CPU); a TPU serving path lives or dies by it.
+  added idle latency (an idle submit executes immediately, no timer). On
+  top of that base it now schedules:
+
+  - deadline-aware admission: queued requests order earliest-deadline-
+    first, and shedding happens at SCHEDULE time — a request is timed out
+    exactly when it can no longer meet its deadline, not only at
+    enqueue-time queue-depth admission;
+  - in-flight bucket top-up: a drained batch that lands below its
+    dispatch bucket boundary (`ops/dispatch.bucket_queries`) has free
+    padded rows anyway — late arrivals claim them (optionally waiting a
+    bounded `target_batch_latency_ms` window) so they ride THIS dispatch
+    instead of the next service cycle. Snapping to bucket boundaries
+    means a top-up costs zero recompiles;
+  - async dispatch pipelining: with a (dispatch_fn, finalize_fn) executor
+    pair, the runner holds the lock only for the device dispatch (which
+    returns un-synced arrays) and finalizes — device sync, host
+    rescore/hydrate — OUTSIDE the lock, so the next runner's dispatch
+    overlaps with this batch's host work. `async_depth` bounds how many
+    batches may be in flight un-finalized.
 
 * `CostModel` — per-dispatch host-vs-device routing. A device dispatch pays
   a fixed round-trip (measured once, lazily, against the live backend); a
@@ -25,7 +47,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
 
@@ -87,6 +109,7 @@ def device_overhead_ms() -> float:
             dispatch.DISPATCH.register("serving.overhead_probe",
                                        _probe_kernel)
             x = _np.zeros((8,), _np.float32)
+            # tpulint: disable=TPU009(one-time-per-process probe under the measurement latch, not a serving queue lock — nothing queues on it)
             _np.asarray(dispatch.call("serving.overhead_probe",
                                       jnp.asarray(x)))
             samples = []
@@ -94,7 +117,7 @@ def device_overhead_ms() -> float:
                 # a serving dispatch pays h2d (queries/mask), execute, AND
                 # d2h (results) — measure the full round trip
                 t0 = time.perf_counter()
-                # tpulint: disable=TPU002(the probe MEASURES the per-dispatch d2h round trip on purpose; 3 iterations, once per process, not a serving loop)
+                # tpulint: disable=TPU002(the probe MEASURES the per-dispatch d2h round trip on purpose; 3 iterations, once per process, not a serving loop),TPU009(same: the measurement latch is not a serving queue lock)
                 _np.asarray(dispatch.call("serving.overhead_probe",
                                           jnp.asarray(x)))
                 samples.append((time.perf_counter() - t0) * 1000.0)
@@ -128,19 +151,77 @@ class CostModel:
                 < cls.device_ms(batch, n_rows, dims))
 
 
+class _QueueEntry:
+    """One queued request: payload, future, and its schedule metadata."""
+
+    __slots__ = ("request", "fut", "enqueued", "deadline", "seq", "claimed")
+
+    def __init__(self, request, fut: Future, enqueued: float,
+                 deadline: Optional[float], seq: int):
+        self.request = request
+        self.fut = fut
+        self.enqueued = enqueued
+        self.deadline = deadline   # monotonic instant; None = never expires
+        self.seq = seq             # arrival order (EDF tie-break)
+        self.claimed = False       # a runner owns it (set under _q_lock)
+
+    def sort_key(self) -> Tuple[float, int]:
+        return (self.deadline if self.deadline is not None else float("inf"),
+                self.seq)
+
+
+def _fresh_sched_stats() -> dict:
+    return {"batches": 0, "pipelined_batches": 0, "requests": 0,
+            "topups": 0, "deadline_sheds": 0, "overlap_hits": 0,
+            "queue_wait_nanos": 0, "dispatch_nanos": 0,
+            "finalize_nanos": 0}
+
+
 class CombiningBatcher:
-    """Combining-lock request coalescer.
+    """Combining-lock request coalescer with continuous-batching
+    scheduling.
 
     submit() enqueues and then either (a) finds its result already set by a
-    concurrent runner, or (b) becomes the runner: drains the queue and
-    executes one batch. While a runner is executing, later submitters just
-    queue up — their requests form the next batch. No background thread, no
-    batching timer, zero idle latency.
+    concurrent runner, or (b) becomes the runner: drains the queue
+    earliest-deadline-first, tops the batch up to its dispatch bucket
+    boundary, and executes it. While a runner is dispatching, later
+    submitters queue up — their requests form the next batch (or top up
+    this one). No background thread, no batching timer, zero idle latency.
+
+    Two executor shapes:
+
+    * `execute(requests) -> results` — the classic synchronous path: runs
+      under the run lock, exactly one batch in flight at a time.
+    * `dispatch_fn(requests) -> handle` + `finalize_fn(handle) -> results`
+      — the pipelined path: `dispatch_fn` launches device work and returns
+      WITHOUT syncing (un-synced arrays in the handle); the runner then
+      releases the run lock and finalizes (device sync + host
+      post-processing) outside it, so the next batch's device dispatch
+      overlaps this batch's host work. `async_depth` bounds in-flight
+      un-finalized batches. `execute` stays the poisoned-batch serial-
+      retry path (synthesized from the pair when not given).
+
+    `sched` counts the scheduler's work: batches, top-ups, schedule-time
+    deadline sheds, dispatch/finalize overlap hits, and cumulative
+    queue-wait/dispatch/finalize time.
     """
 
-    def __init__(self, execute: Callable[[Sequence], List],
-                 max_batch: int = 256):
+    def __init__(self, execute: Optional[Callable[[Sequence], List]],
+                 max_batch: int = 256, *,
+                 dispatch_fn: Optional[Callable[[Sequence], Any]] = None,
+                 finalize_fn: Optional[Callable[[Any], List]] = None,
+                 topup: bool = True,
+                 target_batch_latency_ms: float = 0.0,
+                 async_depth: int = 2):
         from elasticsearch_tpu.ops import dispatch
+        if (dispatch_fn is None) != (finalize_fn is None):
+            raise ValueError("dispatch_fn and finalize_fn come as a pair")
+        self._dispatch_fn = dispatch_fn
+        self._finalize_fn = finalize_fn
+        if execute is None:
+            if dispatch_fn is None:
+                raise ValueError("need execute or dispatch_fn/finalize_fn")
+            execute = lambda reqs: finalize_fn(dispatch_fn(reqs))  # noqa: E731
         self._execute = execute
         # the batch ceiling snaps to a dispatch query bucket: a saturated
         # drain then hands the executor an exactly-bucket-sized batch (no
@@ -148,93 +229,323 @@ class CombiningBatcher:
         # nearest bucket inside the executor — either way the compiled
         # shape set stays closed
         self._max_batch = dispatch.bucket_queries(max_batch)
+        self._topup_enabled = bool(topup)
+        self._target_ms = float(target_batch_latency_ms)
         self._run_lock = threading.Lock()
         self._q_lock = threading.Lock()
-        self._queue: List = []
+        self._q_cond = threading.Condition(self._q_lock)
+        self._queue: List[_QueueEntry] = []
+        self._seq = 0
+        self._inflight = 0           # dispatched, not yet finalized
+        self._depth_sem = threading.BoundedSemaphore(max(1, int(async_depth)))
+        self._tls = threading.local()
+        self.sched = _fresh_sched_stats()
 
+    # ------------------------------------------------------------ queue
     def pending(self) -> int:
-        """Requests queued but not yet executed — the coalescing signal
-        cost routers use to estimate the NEXT batch's size."""
+        """Requests queued but not yet claimed by a runner — the
+        coalescing signal cost routers use to estimate the NEXT batch's
+        size."""
         with self._q_lock:
             return len(self._queue)
 
-    def _enqueue(self, request, fut: Future) -> None:
-        """Admission hook: subclasses may refuse (raise) instead of
-        queueing without bound."""
-        with self._q_lock:
-            self._queue.append((request, fut))
+    def _deadline_for(self, now: float) -> Optional[float]:
+        """Absolute deadline for a request enqueued at `now`; None means
+        it never expires (base batcher has no admission deadline)."""
+        return None
 
-    def _drain(self) -> List:
-        """Take the next batch off the queue (under the run lock).
-        Subclasses may shed entries here (deadline-expired requests get
-        their exception set and are excluded from the batch)."""
+    def _admit(self, depth: int, now: float) -> None:
+        """Admission hook, called under the queue lock with the current
+        queue depth: subclasses refuse (raise) instead of queueing
+        without bound."""
+
+    def _enqueue(self, request, fut: Future) -> _QueueEntry:
+        """Queue one request (admission may refuse — `_admit`). Returns
+        the queue entry."""
+        now = time.monotonic()
+        with self._q_cond:
+            self._admit(len(self._queue), now)
+            entry = _QueueEntry(request, fut, now, self._deadline_for(now),
+                                self._seq)
+            self._seq += 1
+            self._queue.append(entry)
+            self._q_cond.notify_all()
+        return entry
+
+    def _shed(self, entry: _QueueEntry, now: float) -> None:
+        """Schedule-time deadline shed: the request can no longer meet
+        its deadline, so it is timed out NOW instead of spending device
+        time on an answer nobody reads."""
+        self.sched["deadline_sheds"] += 1
+        if not entry.fut.done():
+            waited = (now - entry.enqueued) * 1000.0
+            entry.fut.set_exception(EsRejectedExecutionError(
+                f"rejected execution: request spent "
+                f"{waited:.0f}ms queued, over the admission deadline"))
+
+    def _claim_locked(self, want: int, now: float) -> List[_QueueEntry]:
+        """Take up to `want` entries off the queue, earliest deadline
+        first, shedding any whose deadline has already passed. Caller
+        holds `_q_lock`."""
+        if not self._queue:
+            return []
+        # deadline-less queues (the base batcher) are already in seq
+        # order; skip the sort on the hot path. With a uniform
+        # deadline_ms, arrival order IS deadline order, so this sort is
+        # a near-no-op there too — it only reorders genuinely mixed
+        # deadlines.
+        if any(e.deadline is not None for e in self._queue):
+            self._queue.sort(key=_QueueEntry.sort_key)
+        claimed: List[_QueueEntry] = []
+        keep: List[_QueueEntry] = []
+        for entry in self._queue:
+            if entry.deadline is not None and now > entry.deadline:
+                self._shed(entry, now)
+                continue
+            if len(claimed) < want:
+                entry.claimed = True
+                self.sched["queue_wait_nanos"] += int(
+                    (now - entry.enqueued) * 1e9)
+                claimed.append(entry)
+            else:
+                keep.append(entry)
+        self._queue[:] = keep
+        return claimed
+
+    def _drain(self) -> List[_QueueEntry]:
+        """Take the next batch off the queue (under the run lock):
+        earliest-deadline-first, schedule-time shedding of expired
+        entries."""
         with self._q_lock:
-            batch = self._queue[: self._max_batch]
-            del self._queue[: self._max_batch]
+            return self._claim_locked(self._max_batch, time.monotonic())
+
+    def _topup(self, batch: List[_QueueEntry]) -> List[_QueueEntry]:
+        """In-flight bucket top-up: the drained batch dispatches padded to
+        `bucket_queries(len(batch))` rows anyway, so any headroom up to
+        that boundary is free — late arrivals claim it (zero recompiles:
+        the compiled shape is the bucket, not the batch). With a
+        `target_batch_latency_ms` budget the runner briefly waits for
+        arrivals, but never past the oldest member's batching budget —
+        an idle single query (bucket 1) never waits at all."""
+        from elasticsearch_tpu.ops import dispatch
+        if not batch:
+            return batch
+        target = len(batch) + dispatch.bucket_headroom(len(batch),
+                                                       self._max_batch)
+        if not self._topup_enabled or len(batch) >= target:
+            return batch
+        oldest = min(e.enqueued for e in batch)
+        budget_until = oldest + self._target_ms / 1000.0
+        joined = 0
+        with self._q_cond:
+            while len(batch) < target:
+                now = time.monotonic()
+                got = self._claim_locked(target - len(batch), now)
+                if got:
+                    batch.extend(got)
+                    joined += len(got)
+                    continue
+                remaining = budget_until - now
+                if remaining <= 0:
+                    break
+                self._q_cond.wait(min(remaining, 0.0005))
+        if joined:
+            self.sched["topups"] += joined
         return batch
+
+    # ------------------------------------------------------------ serving
+    def _set_results(self, batch: List[_QueueEntry], results: List) -> None:
+        if len(results) != len(batch):
+            raise RuntimeError(
+                f"batch executor returned {len(results)} results "
+                f"for {len(batch)} requests")
+        for entry, res in zip(batch, results):
+            entry.fut.set_result(res)
+
+    def _retry_serially(self, batch: List[_QueueEntry], exc: Exception):
+        """One poisoned request (bad filter, malformed vector) must not
+        fail unrelated searches that happened to coalesce with it: retry
+        each request alone so only the offender surfaces its error."""
+        if len(batch) == 1:
+            if not batch[0].fut.done():
+                batch[0].fut.set_exception(exc)
+            return
+        for entry in batch:
+            if entry.fut.done():
+                continue
+            try:
+                entry.fut.set_result(self._execute([entry.request])[0])
+            except Exception as one_exc:
+                entry.fut.set_exception(one_exc)
+
+    def _trace_since(self, batch: List[_QueueEntry]) -> Optional[int]:
+        # dispatch-trace attribution (profile.dispatch): the runner
+        # thread executes device work for EVERY request in the batch. If
+        # this thread is recording a profile trace, label the batch's
+        # events with the coalesced size so the leader's trace doesn't
+        # silently claim follower dispatches as its own; followers still
+        # report an empty trace (documented — `_nodes/stats
+        # indices.dispatch` is the authoritative counter).
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        return (_dispatch.DISPATCH.event_count()
+                if len(batch) > 1 and _dispatch.DISPATCH.events_enabled()
+                else None)
+
+    def _annotate(self, trace_since: Optional[int], n: int) -> None:
+        # annotate on EVERY exit: the serial per-request retries of a
+        # poisoned batch run on this same runner thread, and their
+        # dispatches are just as much coalesced-batch work as the happy
+        # path's
+        if trace_since is None:
+            return
+        from elasticsearch_tpu.ops import dispatch as _dispatch
+        _dispatch.DISPATCH.annotate_events(trace_since,
+                                           coalesced_batch=n)
+
+    def _run_sync(self, batch: List[_QueueEntry]) -> None:
+        """Classic synchronous serving of one batch (under the run
+        lock)."""
+        trace_since = self._trace_since(batch)
+        t0 = time.perf_counter_ns()
+        try:
+            self._set_results(batch,
+                              self._execute([e.request for e in batch]))
+        except Exception as exc:
+            self._retry_serially(batch, exc)
+        except BaseException as exc:  # KeyboardInterrupt/SystemExit:
+            for entry in batch:      # fail fast, no serial retries
+                if not entry.fut.done():
+                    entry.fut.set_exception(exc)
+            raise
+        finally:
+            self.sched["dispatch_nanos"] += time.perf_counter_ns() - t0
+            self._annotate(trace_since, len(batch))
+
+    def _begin_pipelined(self, batch: List[_QueueEntry]):
+        """Dispatch stage (under the run lock): launch the batch's device
+        work WITHOUT syncing. Returns the finalize context."""
+        trace_since = self._trace_since(batch)
+        self._depth_sem.acquire()   # bounds in-flight un-finalized batches
+        with self._q_lock:
+            if self._inflight > 0:
+                # a previous batch is still finalizing on another thread
+                # while this dispatch starts: the overlap the pipeline
+                # exists to create
+                self.sched["overlap_hits"] += 1
+            self._inflight += 1
+        t0 = time.perf_counter_ns()
+        try:
+            handle = self._dispatch_fn([e.request for e in batch])
+            err: Optional[Exception] = None
+        except Exception as exc:
+            handle, err = None, exc
+        except BaseException as exc:
+            for entry in batch:
+                if not entry.fut.done():
+                    entry.fut.set_exception(exc)
+            self._end_pipelined()
+            self._annotate(trace_since, len(batch))
+            raise
+        finally:
+            self.sched["dispatch_nanos"] += time.perf_counter_ns() - t0
+        return batch, handle, err, trace_since
+
+    def _end_pipelined(self) -> None:
+        with self._q_lock:
+            self._inflight -= 1
+        self._depth_sem.release()
+
+    def _finish_pipelined(self, batch: List[_QueueEntry], handle,
+                          err: Optional[Exception],
+                          trace_since: Optional[int]) -> None:
+        """Finalize stage (OUTSIDE the run lock): device sync + host
+        post-processing. Runs concurrently with the next batch's
+        dispatch stage."""
+        released = False
+        t0 = time.perf_counter_ns()
+        try:
+            if err is None:
+                try:
+                    self._set_results(batch, self._finalize_fn(handle))
+                except Exception as exc:
+                    err = exc
+                except BaseException as exc:
+                    for entry in batch:
+                        if not entry.fut.done():
+                            entry.fut.set_exception(exc)
+                    raise
+            if err is not None:
+                # serial retries re-enter the FULL sync executor
+                # (dispatch + finalize) — take the scheduler lock so
+                # they serialize with other dispatch stages exactly like
+                # a sync batch (executor plan caches/stats assume
+                # dispatch stages never run concurrently). Release this
+                # batch's depth slot FIRST: a runner can block on the
+                # slot while holding the run lock, so retrying while
+                # still holding it would deadlock at async_depth=1.
+                self._end_pipelined()
+                released = True
+                with self._run_lock:
+                    self._retry_serially(batch, err)
+        finally:
+            dt = time.perf_counter_ns() - t0
+            with self._q_lock:   # concurrent finalizes both land here
+                self.sched["finalize_nanos"] += dt
+            if not released:
+                self._end_pipelined()
+            self._annotate(trace_since, len(batch))
+
+    def batch_meta(self) -> dict:
+        """Schedule metadata of the batch THIS thread is currently
+        executing (set just before the executor runs): coalesced size and
+        the longest queue wait among its members. Executors fold it into
+        per-request observability (profile.hybrid queue_wait). CONSUMED
+        on read — a poisoned batch's serial retries re-enter the
+        executor on this same thread and must not re-count the dead
+        batch's schedule metadata. Empty off a runner thread."""
+        meta = getattr(self._tls, "meta", None)
+        self._tls.meta = None
+        return dict(meta or {})
+
+    def _run_once(self, entry: Optional[_QueueEntry] = None) -> None:
+        """One scheduler turn: drain + top up + serve a batch (if any).
+        With `entry`, returns immediately once that entry is claimed or
+        done instead of competing to run someone else's batch."""
+        pending = None
+        with self._run_lock:
+            if entry is not None and (entry.fut.done() or entry.claimed):
+                return
+            batch = self._drain()
+            if batch:
+                batch = self._topup(batch)
+            if not batch:
+                return
+            self.sched["batches"] += 1
+            self.sched["requests"] += len(batch)
+            now = time.monotonic()
+            self._tls.meta = {
+                "coalesced": len(batch),
+                "queue_wait_max_nanos": int(max(
+                    (now - e.enqueued) for e in batch) * 1e9)}
+            if self._dispatch_fn is not None:
+                self.sched["pipelined_batches"] += 1
+                pending = self._begin_pipelined(batch)
+            else:
+                self._run_sync(batch)
+        if pending is not None:
+            self._finish_pipelined(*pending)
 
     def submit(self, request):
         fut: Future = Future()
-        self._enqueue(request, fut)
+        entry = self._enqueue(request, fut)
         while not fut.done():
-            # block until the current runner finishes, then take over if our
-            # request still isn't served
-            with self._run_lock:
-                if fut.done():
-                    break
-                batch = self._drain()
-                if not batch:
-                    continue
-                # dispatch-trace attribution (profile.dispatch): the
-                # runner thread executes device work for EVERY request in
-                # the batch. If this thread is recording a profile trace,
-                # label the batch's events with the coalesced size so the
-                # leader's trace doesn't silently claim follower
-                # dispatches as its own; followers still report an empty
-                # trace (documented — `_nodes/stats indices.dispatch` is
-                # the authoritative counter).
-                from elasticsearch_tpu.ops import dispatch as _dispatch
-                trace_since = (_dispatch.DISPATCH.event_count()
-                               if len(batch) > 1
-                               and _dispatch.DISPATCH.events_enabled()
-                               else None)
-                try:
-                    results = self._execute([r for r, _ in batch])
-                    if len(results) != len(batch):
-                        raise RuntimeError(
-                            f"batch executor returned {len(results)} results "
-                            f"for {len(batch)} requests")
-                    for (_, f), res in zip(batch, results):
-                        f.set_result(res)
-                except Exception as exc:
-                    if len(batch) == 1:
-                        if not batch[0][1].done():
-                            batch[0][1].set_exception(exc)
-                    else:
-                        # one poisoned request (bad filter, malformed
-                        # vector) must not fail unrelated searches that
-                        # happened to coalesce with it: retry each request
-                        # alone so only the offender surfaces its error
-                        for r, f in batch:
-                            if f.done():
-                                continue
-                            try:
-                                f.set_result(self._execute([r])[0])
-                            except Exception as one_exc:
-                                f.set_exception(one_exc)
-                except BaseException as exc:  # KeyboardInterrupt/SystemExit:
-                    for _, f in batch:       # fail fast, no serial retries
-                        if not f.done():
-                            f.set_exception(exc)
-                    raise
-                finally:
-                    # annotate on EVERY exit: the serial per-request
-                    # retries of a poisoned batch run on this same
-                    # runner thread, and their dispatches are just as
-                    # much coalesced-batch work as the happy path's
-                    if trace_since is not None:
-                        _dispatch.DISPATCH.annotate_events(
-                            trace_since, coalesced_batch=len(batch))
+            if entry.claimed:
+                # a runner owns this request; its finalize (possibly on
+                # another thread) will set the future
+                break
+            # block until the current runner releases the dispatch lock,
+            # then take over if our request still isn't scheduled
+            self._run_once(entry)
         return fut.result()
 
 
@@ -252,20 +563,21 @@ class BoundedBatcher(CombiningBatcher):
       waiting is rejected immediately with `EsRejectedExecutionError`
       (HTTP 429 through the existing error mapping); the client retries
       against a queue that can still absorb it.
-    * deadline — a request that waited longer than `deadline_ms` before
-      its batch started is dead on arrival (the caller has usually timed
-      out); the runner sheds it at drain time rather than spending device
-      time on an answer nobody reads.
+    * deadline — every request carries `enqueue + deadline_ms` as its
+      schedule deadline: the queue orders earliest-deadline-first and the
+      scheduler sheds a request the moment it can no longer be served in
+      time (at drain AND during top-up claims), rather than spending
+      device time on an answer nobody reads.
 
     `stats` counts shed requests and tracks the high-water queue depth so
     saturation tests can assert the bound actually held.
     """
 
-    def __init__(self, execute: Callable[[Sequence], List],
+    def __init__(self, execute: Optional[Callable[[Sequence], List]],
                  max_batch: int = 256, max_queue_depth: int = 256,
                  deadline_ms: Optional[float] = None,
-                 warmup: Optional[Callable[[], None]] = None):
-        super().__init__(execute, max_batch=max_batch)
+                 warmup: Optional[Callable[[], None]] = None, **kwargs):
+        super().__init__(execute, max_batch=max_batch, **kwargs)
         self.max_queue_depth = max_queue_depth
         self.deadline_ms = deadline_ms
         self.stats = {"accepted": 0, "rejected_depth": 0,
@@ -291,34 +603,28 @@ class BoundedBatcher(CombiningBatcher):
                 "hybrid batcher warmup failed (first batches will pay "
                 "compiles): %s", exc)
 
-    def _enqueue(self, request, fut: Future) -> None:
-        with self._q_lock:
-            depth = len(self._queue)
-            if depth >= self.max_queue_depth:
-                self.stats["rejected_depth"] += 1
-                raise EsRejectedExecutionError(
-                    f"rejected execution: hybrid search queue is full "
-                    f"[{depth} >= {self.max_queue_depth}] (queue capacity "
-                    f"{self.max_queue_depth})")
-            self.stats["accepted"] += 1
-            if depth + 1 > self.stats["max_depth_seen"]:
-                self.stats["max_depth_seen"] = depth + 1
-            self._queue.append(((request, time.monotonic()), fut))
-
-    def _drain(self) -> List:
-        batch = super()._drain()
+    def _deadline_for(self, now: float) -> Optional[float]:
         if self.deadline_ms is None:
-            return [((req), fut) for (req, _t0), fut in batch]
-        now = time.monotonic()
-        kept = []
-        for (req, t0), fut in batch:
-            if (now - t0) * 1000.0 > self.deadline_ms:
-                self.stats["shed_deadline"] += 1
-                if not fut.done():
-                    fut.set_exception(EsRejectedExecutionError(
-                        f"rejected execution: request spent "
-                        f"{(now - t0) * 1000.0:.0f}ms queued, over the "
-                        f"{self.deadline_ms:.0f}ms admission deadline"))
-                continue
-            kept.append((req, fut))
-        return kept
+            return None
+        return now + self.deadline_ms / 1000.0
+
+    def _shed(self, entry: _QueueEntry, now: float) -> None:
+        self.stats["shed_deadline"] += 1
+        self.sched["deadline_sheds"] += 1
+        if not entry.fut.done():
+            waited = (now - entry.enqueued) * 1000.0
+            entry.fut.set_exception(EsRejectedExecutionError(
+                f"rejected execution: request spent "
+                f"{waited:.0f}ms queued, over the "
+                f"{self.deadline_ms:.0f}ms admission deadline"))
+
+    def _admit(self, depth: int, now: float) -> None:
+        if depth >= self.max_queue_depth:
+            self.stats["rejected_depth"] += 1
+            raise EsRejectedExecutionError(
+                f"rejected execution: hybrid search queue is full "
+                f"[{depth} >= {self.max_queue_depth}] (queue capacity "
+                f"{self.max_queue_depth})")
+        self.stats["accepted"] += 1
+        if depth + 1 > self.stats["max_depth_seen"]:
+            self.stats["max_depth_seen"] = depth + 1

@@ -105,6 +105,22 @@ class TestBucketSelection:
         kb = dispatch.bucket_k(1500)
         assert kb == 2048 and dispatch.in_k_grid(kb)
 
+    def test_bucket_headroom_is_free_topup_budget(self):
+        """The continuous batcher's top-up query: free rows left in a
+        batch's dispatch bucket. A batch sitting ON a bucket boundary
+        (incl. the lone-query bucket 1) has zero headroom — so a top-up
+        can never change the compiled shape set."""
+        assert dispatch.bucket_headroom(1) == 0
+        assert dispatch.bucket_headroom(5) == 3
+        assert dispatch.bucket_headroom(8) == 0
+        assert dispatch.bucket_headroom(9) == 7
+        assert dispatch.bucket_headroom(2048) == 0
+        # a caller's max_batch ceiling clamps the budget
+        assert dispatch.bucket_headroom(5, max_batch=6) == 1
+        for n in range(1, 300):
+            b = n + dispatch.bucket_headroom(n)
+            assert dispatch.is_query_bucket(b) or b == n
+
 
 # ---------------------------------------------------------------------------
 # bucket-boundary parity

@@ -316,6 +316,40 @@ class TestPlanCache:
         assert section["plan_cache_hits"] > 0
         assert section["score_nanos"] > 0
 
+    def test_tail_attribution_in_profile_and_stats(self, node):
+        """Satellite of the continuous-batching PR: the closed-loop tail
+        must be diagnosable as queueing vs device vs hydrate from the
+        profile and `_nodes/stats indices.hybrid` alone, with the
+        scheduler counters (topups/deadline_sheds/overlap_hits) along."""
+        n, rng = node
+        body = {"rank": {"rrf": {}},
+                "query": {"match": {"body": "c"}},
+                "knn": {"field": "v",
+                        "query_vector": rng.standard_normal(8).tolist(),
+                        "k": 15},
+                "size": 5, "profile": True}
+        p = n.search("h", dict(body))["profile"]["hybrid"]
+        assert p["breakdown"]["queue_wait_nanos"] >= 0
+        assert p["breakdown"]["device_dispatch_nanos"] > 0
+        assert p["breakdown"]["device_sync_nanos"] >= 0
+        # the split sums to score time: launch share + deferred sync
+        assert p["breakdown"]["device_dispatch_nanos"] \
+            + p["breakdown"]["device_sync_nanos"] \
+            == p["breakdown"]["score_nanos"]
+        assert set(p["scheduler"]) >= {"topups", "deadline_sheds",
+                                       "overlap_hits"}
+        section = n.local_node_stats()["indices"]["hybrid"]
+        assert section["dispatch_nanos"] > 0
+        assert section["sync_nanos"] >= 0
+        assert section["queue_wait_nanos"] >= 0
+        # score preserved as the dispatch+sync sum for cross-round
+        # comparability
+        assert section["score_nanos"] == section["dispatch_nanos"] \
+            + section["sync_nanos"]
+        sched = section["scheduler"]
+        assert sched["pipelined_batches"] >= 1
+        assert sched["deadline_sheds"] >= 0
+
 
 class TestSaturation:
     def test_bounded_queue_sheds_429(self, node):
@@ -328,13 +362,16 @@ class TestSaturation:
         ex = HybridExecutor(n, svc, max_batch=2, max_queue_depth=3,
                             deadline_ms=None)
         gate = threading.Event()
-        inner = ex._run_batch
+        # stall the DISPATCH stage: the runner holds the scheduler lock
+        # inside dispatch_fn, so everything behind it must queue (and the
+        # depth bound must shed) exactly as a slow device would force
+        inner = ex.batcher._dispatch_fn
 
-        def slow_batch(bodies):
+        def slow_dispatch(bodies):
             gate.wait(10)
             return inner(bodies)
 
-        ex.batcher._execute = slow_batch
+        ex.batcher._dispatch_fn = slow_dispatch
         n._hybrid["h"] = ex
         body = {"rank": {"rrf": {}},
                 "query": {"match": {"body": "a"}},
@@ -374,16 +411,19 @@ class TestSaturation:
         n, rng = node
         svc = n.indices.get("h")
         from elasticsearch_tpu.search.hybrid_plan import HybridExecutor
+        # topup=False: the in-flight top-up window would otherwise claim
+        # the late arrivals into the first (stalled) batch — this test
+        # wants them left in the queue to age past the deadline
         ex = HybridExecutor(n, svc, max_batch=4, max_queue_depth=64,
-                            deadline_ms=50.0)
+                            deadline_ms=50.0, topup=False)
         gate = threading.Event()
-        inner = ex._run_batch
+        inner = ex.batcher._dispatch_fn
 
-        def slow_batch(bodies):
+        def slow_dispatch(bodies):
             gate.wait(10)
             return inner(bodies)
 
-        ex.batcher._execute = slow_batch
+        ex.batcher._dispatch_fn = slow_dispatch
         n._hybrid["h"] = ex
         body = {"rank": {"rrf": {}},
                 "query": {"match": {"body": "a"}},

@@ -263,34 +263,38 @@ class TestCombiningBatcher:
         assert isinstance(results["bad"], ValueError)
 
 
+def _build_store(n=400, dims=32, seed=5):
+    from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
+    from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+    class FakeSeg:
+        def __init__(self, mat):
+            self.seg_id = "s0"
+            self.num_docs = len(mat)
+            self.base = 0
+            self.vectors = {"v": (mat, np.ones(len(mat), dtype=bool))}
+
+    class FakeView:
+        def __init__(self, seg):
+            self.segment = seg
+            self.live = np.ones(seg.num_docs, dtype=bool)
+
+    class FakeReader:
+        def __init__(self, mat):
+            self.views = [FakeView(FakeSeg(mat))]
+
+    rng = np.random.default_rng(seed)
+    mat = rng.standard_normal((n, dims)).astype(np.float32)
+    mapper = DenseVectorFieldMapper("v", {"dims": dims,
+                                          "similarity": "cosine"})
+    store = VectorStoreShard()
+    store.sync(FakeReader(mat), {"v": mapper})
+    return store, mat, rng
+
+
 class TestStoreRouting:
     def _store(self, n=400, dims=32, seed=5):
-        from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
-        from elasticsearch_tpu.vectors.store import VectorStoreShard
-
-        class FakeSeg:
-            def __init__(self, mat):
-                self.seg_id = "s0"
-                self.num_docs = len(mat)
-                self.base = 0
-                self.vectors = {"v": (mat, np.ones(len(mat), dtype=bool))}
-
-        class FakeView:
-            def __init__(self, seg):
-                self.segment = seg
-                self.live = np.ones(seg.num_docs, dtype=bool)
-
-        class FakeReader:
-            def __init__(self, mat):
-                self.views = [FakeView(FakeSeg(mat))]
-
-        rng = np.random.default_rng(seed)
-        mat = rng.standard_normal((n, dims)).astype(np.float32)
-        mapper = DenseVectorFieldMapper("v", {"dims": dims,
-                                              "similarity": "cosine"})
-        store = VectorStoreShard()
-        store.sync(FakeReader(mat), {"v": mapper})
-        return store, mat, rng
+        return _build_store(n=n, dims=dims, seed=seed)
 
     def test_host_and_device_paths_agree(self, monkeypatch):
         store, mat, rng = self._store()
@@ -341,6 +345,219 @@ class TestStoreRouting:
             exact = vn @ qn
             ref = set(_exact_topk(exact, 5).tolist())
             assert len(ref & set(rows.tolist())) >= 4
+
+
+class TestContinuousScheduler:
+    """The PR-8 continuous-batching scheduler: deadline-aware EDF
+    admission with schedule-time shedding, in-flight bucket top-up
+    (byte-identical to an up-front batch, zero new compiles), and
+    dispatch/finalize pipelining."""
+
+    def test_drain_is_edf_and_sheds_expired_oldest_first(self):
+        """Queued requests schedule earliest-deadline-first; an entry
+        whose deadline passed is shed the moment the scheduler touches
+        it (429-typed), and the later-deadline entry is NOT starved —
+        it serves in the next turn."""
+        import time as _time
+
+        from concurrent.futures import Future
+
+        from elasticsearch_tpu.common.threadpool import (
+            EsRejectedExecutionError)
+        from elasticsearch_tpu.serving.batcher import BoundedBatcher
+
+        executed = []
+
+        def execute(reqs):
+            executed.append(list(reqs))
+            return list(reqs)
+
+        b = BoundedBatcher(execute, max_batch=1, deadline_ms=10_000.0)
+        now = _time.monotonic()
+        f_far, f_near, f_dead = Future(), Future(), Future()
+        e_far = b._enqueue("far", f_far)
+        e_near = b._enqueue("near", f_near)
+        e_dead = b._enqueue("dead", f_dead)
+        # forge the schedule: "dead" expired long ago, "near" is due
+        # before "far" despite arriving later
+        e_dead.deadline = now - 1.0
+        e_near.deadline = now + 1.0
+        e_far.deadline = now + 100.0
+        b._run_once()
+        with pytest.raises(EsRejectedExecutionError):
+            f_dead.result(timeout=1)
+        assert f_near.result(timeout=1) == "near"
+        assert executed == [["near"]]
+        assert b.stats["shed_deadline"] == 1
+        assert b.sched["deadline_sheds"] == 1
+        b._run_once()   # the large/old request is not starved
+        assert f_far.result(timeout=1) == "far"
+        assert executed == [["near"], ["far"]]
+
+    def test_topup_batch_byte_identical_and_zero_recompiles(self,
+                                                            monkeypatch):
+        """Late arrivals joining a forming batch at the bucket boundary
+        return byte-identical results to the same requests batched up
+        front — and the topped-up dispatch compiles NOTHING new (the
+        compiled shape is the bucket), checked under strict mode."""
+        import threading
+        import time as _time
+
+        from concurrent.futures import Future
+
+        from elasticsearch_tpu.ops import dispatch
+        from elasticsearch_tpu.serving.batcher import CombiningBatcher
+
+        store, mat, rng = _build_store(n=512)
+        monkeypatch.setattr(CostModel, "prefer_host",
+                            classmethod(lambda cls, *a: False))
+        queries = rng.standard_normal((8, 32)).astype(np.float32)
+        baseline = store.search_many("v", [(q, None) for q in queries], 10)
+
+        fc = store._fields["v"]
+
+        def dispatch_fn(reqs):
+            return store._dispatch_many(fc, 10, "bf16", reqs)
+
+        b = CombiningBatcher(None, dispatch_fn=dispatch_fn,
+                             finalize_fn=store.finalize_many,
+                             topup=True, target_batch_latency_ms=500.0)
+        futs = [Future() for _ in range(8)]
+        for q, f in zip(queries[:5], futs[:5]):
+            b._enqueue((q, None), f)
+
+        def late():
+            _time.sleep(0.02)
+            for q, f in zip(queries[5:], futs[5:]):
+                b._enqueue((q, None), f)
+
+        t = threading.Thread(target=late)
+        t.start()
+        compiles_before = dispatch.DISPATCH.compile_count()
+        old_strict = dispatch.DISPATCH.strict
+        dispatch.DISPATCH.strict = True
+        try:
+            b._run_once()
+        finally:
+            dispatch.DISPATCH.strict = old_strict
+        t.join(5)
+        # the 5 early + 3 late requests rode ONE bucket-8 dispatch
+        assert b.sched["batches"] == 1
+        assert b.sched["topups"] == 3
+        # zero new compiles: the bucket-8 program was already compiled
+        # by the up-front baseline batch
+        assert dispatch.DISPATCH.compile_count() == compiles_before
+        for f, (rows_ref, scores_ref) in zip(futs, baseline):
+            rows, scores = f.result(timeout=5)
+            np.testing.assert_array_equal(rows, rows_ref)
+            np.testing.assert_array_equal(scores, scores_ref)
+
+    def test_idle_single_query_never_waits_for_topup(self):
+        """bucket_queries(1) == 1: a lone request has zero bucket
+        headroom, so the top-up window must not add idle latency."""
+        import time as _time
+
+        from elasticsearch_tpu.serving.batcher import CombiningBatcher
+
+        b = CombiningBatcher(lambda reqs: list(reqs),
+                             topup=True, target_batch_latency_ms=500.0)
+        t0 = _time.monotonic()
+        assert b.submit("solo") == "solo"
+        assert (_time.monotonic() - t0) < 0.25  # far under the 500ms window
+        assert b.sched["topups"] == 0
+
+    def test_pipelined_finalize_overlaps_next_dispatch(self):
+        """While batch N finalizes (outside the scheduler lock), batch
+        N+1 must be able to dispatch — the overlap the tail fix is made
+        of. Results stay correct and the overlap is counted."""
+        import threading
+        import time as _time
+
+        from elasticsearch_tpu.serving.batcher import CombiningBatcher
+
+        started_finalize = threading.Event()
+        release_finalize = threading.Event()
+
+        def dispatch_fn(reqs):
+            return list(reqs)
+
+        def finalize_fn(handle):
+            started_finalize.set()
+            release_finalize.wait(5)
+            return [r * 10 for r in handle]
+
+        b = CombiningBatcher(None, dispatch_fn=dispatch_fn,
+                             finalize_fn=finalize_fn, topup=False)
+        results = {}
+
+        def worker(i):
+            results[i] = b.submit(i)
+
+        t1 = threading.Thread(target=worker, args=(1,))
+        t1.start()
+        assert started_finalize.wait(5)
+        # batch 1 is mid-finalize and holds NO lock: batch 2 dispatches
+        t2 = threading.Thread(target=worker, args=(2,))
+        t2.start()
+        deadline = _time.monotonic() + 5
+        while (b.sched["overlap_hits"] < 1
+               and _time.monotonic() < deadline):
+            _time.sleep(0.005)
+        assert b.sched["overlap_hits"] >= 1
+        release_finalize.set()
+        t1.join(5)
+        t2.join(5)
+        assert results == {1: 10, 2: 20}
+        assert b.sched["pipelined_batches"] == 2
+
+    def test_pipelined_poisoned_batch_retries_serially(self):
+        """A finalize failure on a coalesced batch retries each request
+        alone through the synchronous path — 429/error semantics are
+        identical to the pre-pipeline batcher."""
+        from concurrent.futures import Future
+
+        from elasticsearch_tpu.serving.batcher import CombiningBatcher
+
+        def dispatch_fn(reqs):
+            return list(reqs)
+
+        def finalize_fn(handle):
+            if any(r == "bad" for r in handle):
+                raise ValueError("poisoned")
+            return [f"ok:{r}" for r in handle]
+
+        b = CombiningBatcher(None, dispatch_fn=dispatch_fn,
+                             finalize_fn=finalize_fn, topup=False)
+        follower = Future()
+        b._enqueue("bad", follower)
+        assert b.submit("good") == "ok:good"
+        with pytest.raises(ValueError, match="poisoned"):
+            follower.result(timeout=5)
+
+    def test_queue_wait_and_scheduler_counters_accumulate(self):
+        from elasticsearch_tpu.serving.batcher import CombiningBatcher
+
+        b = CombiningBatcher(lambda reqs: list(reqs))
+        for i in range(4):
+            assert b.submit(i) == i
+        assert b.sched["batches"] == 4
+        assert b.sched["requests"] == 4
+        assert b.sched["queue_wait_nanos"] >= 0
+        assert b.sched["dispatch_nanos"] > 0
+
+    def test_store_scheduler_stats_survive_batcher_retirement(self):
+        """Refresh drops stale (field, k) batchers; their scheduler
+        counters must fold into the retired total, not vanish."""
+        store, mat, rng = _build_store(n=128)
+        q = rng.standard_normal(32).astype(np.float32)
+        store.search("v", q, 5)
+        before = store.scheduler_stats()
+        assert before.get("batches", 0) >= 1
+        with store._batchers_lock:
+            for key in list(store._batchers):
+                store._retire_sched(store._batchers.pop(key))
+        after = store.scheduler_stats()
+        assert after.get("batches", 0) == before.get("batches", 0)
 
 
 class TestRrfFastPath:

@@ -1,0 +1,45 @@
+"""TPU009 clean: dispatch under the lock, sync at response assembly.
+
+The sanctioned continuous-batching shape: the lock is held only for the
+un-synced device dispatch (and plain queue bookkeeping); device→host
+landing, future waits, and scalar reads happen outside the critical
+section, so batch N's host work overlaps batch N+1's dispatch.
+"""
+# tpulint: hot-path
+import threading
+
+import numpy as np
+
+from elasticsearch_tpu.ops import dispatch
+
+_run_lock = threading.Lock()
+_q_lock = threading.Lock()
+_queue = []
+
+
+def dispatch_under_lock_sync_outside(queries):
+    with _run_lock:
+        # launch only: the returned arrays stay un-synced futures
+        scores = dispatch.call_async("knn.exact", queries)
+    return np.asarray(scores)  # response-assembly landing, lock released
+
+
+def queue_bookkeeping_under_lock(request):
+    with _q_lock:
+        _queue.append(request)
+        depth = len(_queue)
+    return depth
+
+
+def wait_on_future_outside_lock(fut):
+    with _run_lock:
+        claimed = True
+    if claimed:
+        return fut.result()  # the submit tail: no lock held
+    return None
+
+
+def host_array_under_lock(rows):
+    # np.asarray of a HOST value under a lock is not a device sync
+    with _q_lock:
+        return np.asarray(rows)

@@ -1,0 +1,35 @@
+"""TPU009 fires: blocking syncs while holding the batcher/serving lock."""
+# tpulint: hot-path
+import threading
+
+import numpy as np
+
+from elasticsearch_tpu.ops import dispatch
+
+_run_lock = threading.Lock()
+_q_cond = threading.Condition()
+
+
+def sync_inside_drain_critical_section(queries):
+    with _run_lock:
+        scores = dispatch.call("knn.exact", queries)
+        out = np.asarray(scores)  # [expect] d2h transfer under the lock
+    return out
+
+
+def block_until_ready_under_lock(queries):
+    scores = dispatch.call("knn.exact", queries)
+    with _run_lock:
+        scores.block_until_ready()  # [expect] device wait under the lock
+    return scores
+
+
+def future_result_under_lock(fut):
+    with _run_lock:
+        return fut.result()  # [expect] scheduler blocks on a future
+
+
+def scalar_pull_under_condition(queries):
+    with _q_cond:
+        scores = dispatch.call("knn.exact", queries)
+        return scores.sum().item()  # [expect] scalar pull under the lock

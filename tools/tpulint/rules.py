@@ -750,8 +750,131 @@ class ModuleCacheLockRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# TPU009 — blocking sync inside a lock-held critical section
+# ---------------------------------------------------------------------------
+
+_FUTURISH = re.compile(r"fut", re.IGNORECASE)
+
+
+class LockedSyncRule(Rule):
+    """TPU009: blocking syncs while holding a serving lock (the batcher
+    lock / drain critical section).
+
+    Historical context (PR 8): the continuous-batching rewrite's whole
+    point is that the scheduler lock is held only for the UN-SYNCED
+    device dispatch — device sync, `Future.result`, and d2h transfers
+    happen at response-assembly time, outside the lock, so batch N's
+    host work overlaps batch N+1's dispatch. A blocking sync inside a
+    `with <lock>:` body silently re-serializes the pipeline: every
+    request queued on that lock stalls behind one batch's device wait,
+    which is exactly the closed-loop convoy the r06 p99/p50 = 6.2 gate
+    failure measured. Fires on `block_until_ready()`, `.item()` on a
+    device array, `.result()` on a future-named receiver, and bulk
+    device→host transfers (`np.asarray` on a device array) lexically
+    inside a with-block whose context manager is lock-named. Scoped to
+    hot-path modules like TPU002.
+    """
+
+    rule_id = "TPU009"
+    summary = "blocking sync while holding a serving lock"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if not ctx.hot_path:
+            return []
+        findings: List[Finding] = []
+        np_mods, np_fns = numpy_aliases(ctx.tree)
+        for fn in iter_functions(ctx.tree):
+            taint = DeviceTaint(np_mods, np_fns)
+            self._walk(fn.body, False, taint, ctx, findings)
+        return findings
+
+    def _walk(self, body, in_lock: bool, taint, ctx, findings) -> None:
+        """Linear statement walk carrying the lock-held flag; taint
+        observes statements in source order so device-array facts are
+        current when a sync site is judged."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if in_lock:
+                for node in _stmt_expressions(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    msg = self._judge(node, taint)
+                    if msg is not None:
+                        findings.append(
+                            ctx.finding(self.rule_id, node, msg))
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk(stmt.body,
+                           in_lock or self._locks_a_lock(stmt), taint,
+                           ctx, findings)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._walk(stmt.body, in_lock, taint, ctx, findings)
+                self._walk(stmt.orelse, in_lock, taint, ctx, findings)
+            elif isinstance(stmt, ast.If):
+                self._walk(stmt.body, in_lock, taint, ctx, findings)
+                self._walk(stmt.orelse, in_lock, taint, ctx, findings)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, in_lock, taint, ctx, findings)
+                for h in stmt.handlers:
+                    self._walk(h.body, in_lock, taint, ctx, findings)
+                self._walk(stmt.orelse, in_lock, taint, ctx, findings)
+                self._walk(stmt.finalbody, in_lock, taint, ctx, findings)
+            taint.observe(stmt)
+
+    @staticmethod
+    def _locks_a_lock(stmt) -> bool:
+        """`with self._run_lock:` / `with lock, other:` — any context
+        manager whose dotted name's last component is lock-named. A
+        Condition used as a context manager counts (it wraps its lock)."""
+        for item in stmt.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            name = dotted(expr) if isinstance(
+                expr, (ast.Name, ast.Attribute)) else ""
+            last = name.split(".")[-1].lower()
+            if last.endswith("lock") or last.endswith("cond") \
+                    or last.endswith("condition"):
+                return True
+        return False
+
+    def _judge(self, node: ast.Call, taint) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute):
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in taint.np_fn_converters \
+                    and node.args \
+                    and taint.expr_is_device(node.args[0]):
+                return ("device→host transfer while holding a lock — "
+                        "every request queued on this lock stalls behind "
+                        "the sync; dispatch under the lock, land results "
+                        "outside it at response-assembly time")
+            return None
+        attr = node.func.attr
+        if attr == "block_until_ready":
+            return ("block_until_ready while holding a lock serializes "
+                    "the dispatch pipeline — sync outside the critical "
+                    "section, at response-assembly time")
+        if attr == "item" and taint.expr_is_device(node.func.value):
+            return (".item() on a device array while holding a lock is a "
+                    "blocking scalar pull inside the drain critical "
+                    "section — land results outside the lock")
+        if attr == "result" and _FUTURISH.search(dotted(node.func.value)):
+            return ("Future.result() while holding a lock blocks the "
+                    "scheduler — wait on futures outside the critical "
+                    "section (the combining batcher's submit tail)")
+        if call_name(node) in taint.host_converters and node.args \
+                and taint.expr_is_device(node.args[0]):
+            return ("device→host transfer while holding a lock — every "
+                    "request queued on this lock stalls behind the sync; "
+                    "dispatch under the lock, land results outside it at "
+                    "response-assembly time")
+        return None
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
-    ModuleCacheLockRule(),
+    ModuleCacheLockRule(), LockedSyncRule(),
 ]
