@@ -1015,6 +1015,214 @@ def run_device_aggs(n_docs: int = 100_000):
         node.close()
 
 
+def run_ingest_while_search(n_seed: int = 200_000, d: int = 64,
+                            docs_per_sec: int = 4000,
+                            duration_s: float = 8.0,
+                            refresh_interval_s: float = 0.25,
+                            n_clients: int = 2):
+    """Config 9: sustained ingest concurrent with closed-loop search —
+    the writes-while-searching workload the generational segments
+    subsystem exists for (`elasticsearch_tpu/segments/`).
+
+    An ingest thread seals a new engine segment + refreshes every
+    `refresh_interval_s` at a sustained doc rate while closed-loop
+    clients search through the full serving path. The row records search
+    p50/p99 DURING ingest, the worst single refresh stall (the
+    pre-subsystem number here was a full corpus re-upload), seal/merge
+    counters, and two gates:
+
+      gate_no_rebuild_stall  zero full-corpus rebuilds in steady state
+      parity_ok              at sampled points (ingest paused, snapshot
+                             settled) the generational store's response
+                             is byte-identical to a monolithic store
+                             synced on the same reader — both pinned to
+                             the DEVICE route, which is what the
+                             generational fan-out replaces
+
+    Runs (labeled) on CPU-fallback hosts like the other serving rows."""
+    import os
+    import tempfile
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.serving.batcher import CostModel
+
+    if os.environ.get("BENCH_SMALL") == "1":
+        n_seed, docs_per_sec, duration_s = 30_000, 2000, 5.0
+
+    rng = np.random.default_rng(29)
+    node = Node(tempfile.mkdtemp())
+    node.create_index_with_templates(
+        "ing", mappings={"properties": {
+            "v": {"type": "dense_vector", "dims": d}}})
+    shard = node.indices.get("ing").shards[0]
+    t0 = time.perf_counter()
+    _inject_vector_segment(shard, "v",
+                           rng.standard_normal((n_seed, d))
+                           .astype(np.float32))
+    node.indices.get("ing").refresh()
+    build_s = time.perf_counter() - t0
+
+    # the parity oracle and the serving store must take the same route:
+    # pin the cost model off the host VNNI mirror for the bench's
+    # duration (the generational fan-out replaces the DEVICE path)
+    prefer_host = CostModel.prefer_host
+    CostModel.prefer_host = staticmethod(lambda *a, **kw: False)
+    try:
+        _run_ingest_while_search_body(
+            node, shard, rng, d, docs_per_sec, duration_s,
+            refresh_interval_s, n_clients, n_seed, build_s)
+    finally:
+        # the patch must never leak into later configs — their routing
+        # (and therefore their numbers) would silently change
+        CostModel.prefer_host = prefer_host
+        node.close()
+
+
+def _run_ingest_while_search_body(node, shard, rng, d, docs_per_sec,
+                                  duration_s, refresh_interval_s,
+                                  n_clients, n_seed, build_s):
+    import threading
+
+    import jax
+
+    from elasticsearch_tpu.vectors.store import VectorStoreShard
+
+    mono = VectorStoreShard(segments_enabled=False,
+                            host_mirror_max_bytes=0)
+    vf = node.indices.get("ing").mapper_service.vector_fields()
+
+    def body():
+        return {"knn": {"field": "v",
+                        "query_vector": rng.standard_normal(d)
+                        .astype(np.float32).tolist(),
+                        "k": 10, "num_candidates": 10},
+                "size": 10, "_source": False}
+
+    for _ in range(8):  # warm the serving grid before the timed window
+        node.search("ing", body())
+
+    seg0 = shard.vector_store.segment_stats()
+    mark = _dispatch_mark()
+    pause = threading.Event()      # sampler asks ingest to hold
+    idle = threading.Event()       # ingest acknowledges (snapshot settled)
+    stop = threading.Event()
+    stalls, ingested, refreshes = [], [0], [0]
+    batch = max(64, int(docs_per_sec * refresh_interval_s))
+
+    def ingest():
+        deadline = time.perf_counter() + duration_s
+        while time.perf_counter() < deadline and not stop.is_set():
+            if pause.is_set():
+                idle.set()
+                time.sleep(0.002)
+                continue
+            idle.clear()
+            mat = rng.standard_normal((batch, d)).astype(np.float32)
+            t1 = time.perf_counter()
+            _inject_vector_segment(shard, "v", mat)
+            node.indices.get("ing").refresh()   # seals the L0 delta
+            stalls.append(time.perf_counter() - t1)
+            ingested[0] += batch
+            refreshes[0] += 1
+            budget = refresh_interval_s - (time.perf_counter() - t1)
+            if budget > 0:
+                time.sleep(budget)
+        idle.set()
+
+    lats: list = []
+    lat_lock = threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            b = body()
+            t1 = time.perf_counter()
+            node.search("ing", b)
+            dt = (time.perf_counter() - t1) * 1000
+            with lat_lock:
+                lats.append(dt)
+
+    def sample_parity() -> bool:
+        """Pause ingest on a settled snapshot and compare the live
+        generational store against a monolithic sync of the SAME
+        reader, byte for byte."""
+        pause.set()
+        idle.wait(timeout=5.0)
+        try:
+            reader = shard.engine.acquire_searcher()
+            shard.vector_store.sync(reader, vf)   # settle (normally a noop)
+            mono.sync(reader, vf)
+            ok = True
+            for _ in range(3):
+                q = rng.standard_normal(d).astype(np.float32)
+                a = shard.vector_store.search("v", q, 10)
+                b2 = mono.search("v", q, 10)
+                ok = ok and np.array_equal(a[0], b2[0]) \
+                    and np.array_equal(a[1], b2[1])
+            return ok
+        finally:
+            pause.clear()
+
+    threads = [threading.Thread(target=ingest)]
+    threads += [threading.Thread(target=client, daemon=True)
+                for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    parity_samples, parity_ok = 0, True
+    sample_at = (0.35, 0.7)  # fractions of the run
+    t_start = time.perf_counter()
+    for frac in sample_at:
+        wait = t_start + frac * duration_s - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        parity_ok = sample_parity() and parity_ok
+        parity_samples += 1
+    threads[0].join()
+    stop.set()
+    # one final settled sample after ingest completes
+    parity_ok = sample_parity() and parity_ok
+    parity_samples += 1
+    for t in threads[1:]:
+        t.join(timeout=2.0)
+
+    gc = shard.vector_store._gens.get("v")
+    if gc is not None:
+        gc.drain(timeout_s=10.0)
+    seg1 = shard.vector_store.segment_stats()
+    rebuilds = seg1["full_rebuilds"] - seg0["full_rebuilds"]
+    with lat_lock:
+        arr = np.asarray(lats) if lats else np.zeros(1)
+    wall = time.perf_counter() - t_start
+    print(json.dumps({
+        "config": "9_ingest_while_search",
+        "backend": jax.devices()[0].platform,
+        "n_seed": n_seed, "dims": d,
+        "ingested_docs": ingested[0],
+        "achieved_docs_per_sec": round(ingested[0] / max(wall, 1e-9), 1),
+        "target_docs_per_sec": docs_per_sec,
+        "refreshes": refreshes[0],
+        "searches_during_ingest": len(arr),
+        "search_p50_ms": round(float(np.percentile(arr, 50)), 2),
+        "search_p99_ms": round(float(np.percentile(arr, 99)), 2),
+        "max_refresh_stall_ms": round(max(stalls) * 1000, 2)
+        if stalls else 0.0,
+        "mean_refresh_stall_ms": round(
+            float(np.mean(stalls)) * 1000, 2) if stalls else 0.0,
+        "seed_build_s": round(build_s, 2),
+        "seals": seg1["seals"] - seg0.get("seals", 0),
+        "merges": seg1.get("merges", 0) - seg0.get("merges", 0),
+        "merge_ms": round((seg1.get("merge_nanos", 0)
+                           - seg0.get("merge_nanos", 0)) / 1e6, 1),
+        "generations_final": seg1.get("generations", 0),
+        "tombstoned_rows": seg1.get("tombstoned_rows", 0),
+        "full_rebuilds": rebuilds,
+        "rebuilds_avoided": seg1["rebuilds_avoided"]
+        - seg0["rebuilds_avoided"],
+        "parity_samples": parity_samples,
+        "parity_vs_monolithic": bool(parity_ok),
+        "gate_no_rebuild_stall": bool(rebuilds == 0 and parity_ok),
+        "dispatch": _dispatch_delta(mark)}), flush=True)
+
+
 def run_sharded_fused():
     """Config 6: the mesh-sharded serving path (PR 5) — exact kNN, IVF,
     and the fused hybrid plan each executing as ONE shard_map program
@@ -1293,6 +1501,7 @@ def main():
     guarded(run_small_batch_serving)
     guarded(run_ivf_config)
     guarded(run_device_aggs)
+    guarded(run_ingest_while_search)
     guarded(run_sharded_fused)
 
 

@@ -185,6 +185,31 @@ class IVFIndex:
         self._device = None
         self._device_sharded = None
 
+    def clone(self) -> "IVFIndex":
+        """Deep copy of the layout (trained centroids + bucket mirrors,
+        counters included) with the lazy device pytrees RESET. The
+        segments merge scheduler extends a clone with the merged delta
+        while the original keeps serving — in-place `add` would mutate
+        the host mirror a concurrent search is uploading (copy-on-write,
+        like every other mid-merge install)."""
+        new = IVFIndex.__new__(IVFIndex)
+        new.metric = self.metric
+        new.dtype = self.dtype
+        new.dims = self.dims
+        new.nlist = self.nlist
+        new.cap = self.cap
+        new.retrain_threshold = self.retrain_threshold
+        new.centroids = self.centroids        # immutable post-train
+        new.part_vecs = self.part_vecs.copy()
+        new.part_rows = self.part_rows.copy()
+        new.counts = self.counts.copy()
+        new.trained_on = self.trained_on
+        new.displaced = self.displaced
+        new.spilled = self.spilled
+        new._device = None
+        new._device_sharded = None
+        return new
+
     def add(self, vecs: np.ndarray, rows: np.ndarray) -> None:
         """Incremental add (post-build refresh delta): place into the host
         mirror; the device pytree refreshes lazily at the next search."""

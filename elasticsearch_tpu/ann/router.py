@@ -69,6 +69,19 @@ class IVFRouter:
         self._tuned_nprobe: Optional[int] = None
         self.last_phases: dict = {}
 
+    def with_index(self, index: IVFIndex) -> "IVFRouter":
+        """A new router serving `index` with this router's settings AND
+        its tuned nprobe carried over — the segments merge scheduler
+        swaps extended layouts in without re-running the recall-gate
+        tuner (the layout geometry is unchanged by an append)."""
+        new = IVFRouter(index, nprobe=self.nprobe_setting,
+                        recall_target=self.recall_target,
+                        tune_sample=self.tune_sample,
+                        tune_seed=self.tune_seed,
+                        tune_margin=self.tune_margin)
+        new._tuned_nprobe = self._tuned_nprobe
+        return new
+
     # ---------------------------------------------------------- nprobe
 
     def effective_nprobe(self, k: int) -> int:

@@ -108,6 +108,14 @@ class LocalShard:
             # to the exhaustive default, never crash the state applier
             knn_engine, knn_nlist, knn_nprobe = "tpu", None, "auto"
         from elasticsearch_tpu.common.settings import setting_bool
+        try:
+            from elasticsearch_tpu.indices.service import (
+                validate_segments_settings)
+            segments_settings = validate_segments_settings(s)
+        except Exception:
+            # same degradation contract as the knn settings above: a bad
+            # replicated value must not crash the state applier
+            segments_settings = {}
         self.vector_store = VectorStoreShard(
             dtype=s.get("index.knn.vector_dtype", "bf16"),
             knn_engine=knn_engine, knn_nlist=knn_nlist,
@@ -115,7 +123,8 @@ class LocalShard:
             topup=setting_bool(s.get("index.knn.topup", True)),
             target_batch_latency_ms=float(
                 s.get("index.knn.target_batch_latency_ms", 2.0)),
-            async_depth=int(s.get("index.knn.async_depth", 2)))
+            async_depth=int(s.get("index.knn.async_depth", 2)),
+            **segments_settings)
         self._attach_engine(engine)
 
     def _attach_engine(self, engine: Engine) -> None:

@@ -113,7 +113,8 @@ class IndexShardHandle:
                  knn_engine: str = "tpu", knn_nlist=None,
                  knn_nprobe="auto", knn_topup: bool = True,
                  knn_target_batch_latency_ms: float = 2.0,
-                 knn_async_depth: int = 2):
+                 knn_async_depth: int = 2,
+                 segments_settings: Optional[dict] = None):
         self.index_name = index_name
         self.shard_id = shard_id
         self.engine = Engine(path, mapper_service,
@@ -124,7 +125,8 @@ class IndexShardHandle:
             knn_nlist=knn_nlist, knn_nprobe=knn_nprobe,
             topup=knn_topup,
             target_batch_latency_ms=knn_target_batch_latency_ms,
-            async_depth=knn_async_depth)
+            async_depth=knn_async_depth,
+            **(segments_settings or {}))
         self.mapper_service = mapper_service
         self._sync_vectors(self.engine.acquire_searcher())
         self.engine.add_refresh_listener(self._sync_vectors)
@@ -169,6 +171,43 @@ def validate_knn_settings(settings: dict):
                 f"[index.knn.nprobe] must be an integer >= 1 or "
                 f"\"auto\", got [{settings.get('index.knn.nprobe')}]")
     return engine, nlist, nprobe
+
+
+def validate_segments_settings(settings: dict) -> dict:
+    """Validate + normalize the `index.segments.*` generational-corpus
+    settings into `VectorStoreShard` constructor kwargs. ONE owner for
+    the single-node create path and the cluster master's create-index
+    handler (like `validate_knn_settings`)."""
+    from elasticsearch_tpu.common.settings import setting_bool
+    out = {"segments_enabled": setting_bool(
+        settings.get("index.segments.enabled", True), default=True)}
+    for key, attr, floor in (("index.segments.tier_size",
+                              "segments_tier_size", 2),
+                             ("index.segments.max_l0",
+                              "segments_max_l0", 1)):
+        raw = settings.get(key)
+        if raw is None:
+            continue
+        try:
+            val = int(raw)
+        except (TypeError, ValueError):
+            val = floor - 1
+        if val < floor:
+            raise IllegalArgumentError(
+                f"[{key}] must be an integer >= {floor}, got [{raw}]")
+        out[attr] = val
+    raw = settings.get("index.segments.merge_budget_ms")
+    if raw is not None:
+        try:
+            val = float(raw)
+        except (TypeError, ValueError):
+            val = -1.0
+        if val <= 0:
+            raise IllegalArgumentError(
+                f"[index.segments.merge_budget_ms] must be a number "
+                f"> 0, got [{raw}]")
+        out["segments_merge_budget_ms"] = val
+    return out
 
 
 def _reject_translog_retention(settings: dict) -> None:
@@ -250,6 +289,10 @@ class IndexService:
         knn_target_ms = float(settings.get(
             "index.knn.target_batch_latency_ms", 2.0))
         knn_async_depth = int(settings.get("index.knn.async_depth", 2))
+        # generational device segments (`elasticsearch_tpu/segments/`):
+        # seal/tombstone/merge lifecycle knobs of the vector store
+        segments_settings = validate_segments_settings(
+            settings.as_flat_dict())
         self.shards: List[IndexShardHandle] = []
         for s in range(self.num_shards):
             self.shards.append(IndexShardHandle(
@@ -259,7 +302,8 @@ class IndexService:
                 knn_nlist=knn_nlist, knn_nprobe=knn_nprobe,
                 knn_topup=knn_topup,
                 knn_target_batch_latency_ms=knn_target_ms,
-                knn_async_depth=knn_async_depth))
+                knn_async_depth=knn_async_depth,
+                segments_settings=segments_settings))
         self.aliases: Dict[str, dict] = {}
 
     @property

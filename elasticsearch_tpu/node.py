@@ -2370,6 +2370,7 @@ class Node:
                 len(sh.engine.acquire_searcher().views)
                 for s in self.indices.indices.values()
                 for sh in s.shards),
+                "device": self._device_segments_section(),
                 **({"file_sizes": {"columns": {"size_in_bytes": 0}}}
                    if include_segment_file_sizes else {})},
             "get": {"total": self.counters.get("get", 0)},
@@ -2419,6 +2420,38 @@ class Node:
                 "discovery": discovery_section,
                 "breakers": self.breakers.stats(),
                 "thread_pool": self.thread_pool.stats()}
+
+    def _device_segments_section(self) -> dict:
+        """Generational device-corpus counters summed over local shards
+        (`elasticsearch_tpu/segments/`): generation counts/bytes per
+        tier, seals, merges run + merge nanos, tombstoned rows, and the
+        full-rebuild accounting (rebuilds by reason vs rebuilds the
+        incremental path avoided) — the before/after ledger of the
+        write-while-search stall."""
+        out: dict = {"full_rebuilds": 0, "rebuilds_avoided": 0,
+                     "rebuild_reasons": {}, "tiers": {}}
+        for svc in self.indices.indices.values():
+            for shard in svc.shards:
+                stats_fn = getattr(shard.vector_store, "segment_stats",
+                                   None)
+                if stats_fn is None:
+                    continue
+                for key, val in stats_fn().items():
+                    if key in ("rebuild_reasons", "tiers"):
+                        slot = out[key]
+                        for k2, v2 in val.items():
+                            if isinstance(v2, dict):
+                                tier = slot.setdefault(
+                                    k2, {k3: 0 for k3 in v2})
+                                for k3, v3 in v2.items():
+                                    tier[k3] += v3
+                            else:
+                                slot[k2] = slot.get(k2, 0) + v2
+                    elif isinstance(val, bool):
+                        out[key] = out.get(key, False) or val
+                    elif isinstance(val, (int, float)):
+                        out[key] = out.get(key, 0) + val
+        return out
 
     @staticmethod
     def _dispatch_stats_section() -> dict:

@@ -114,6 +114,36 @@ def is_query_bucket(n: int) -> bool:
     return n >= 1 and n == bucket_queries(n)
 
 
+# generational device segments (elasticsearch_tpu/segments/): sealed
+# generations pad their row count to this pow-2 ladder so the per-
+# generation search kernel (`segments.knn`) compiles over a closed,
+# bounded shape universe — refresh deltas of any size reuse a handful
+# of programs. The ladder tops out at MAX_GEN_ROW_BUCKET (merged base
+# generations in the millions of rows would waste up to 2x HBM on pow-2
+# padding); beyond it, multiples of the cap keep the universe closed.
+GEN_ROW_BUCKET_MIN = 128          # one lane tile (ops/knn.LANE)
+MAX_GEN_ROW_BUCKET = 1 << 20
+
+
+def bucket_gen_rows(n: int) -> int:
+    """Row bucket a device generation pads to: pow-2 from
+    GEN_ROW_BUCKET_MIN up to MAX_GEN_ROW_BUCKET, then multiples of the
+    cap."""
+    n = max(int(n), 1)
+    if n > MAX_GEN_ROW_BUCKET:
+        return -(-n // MAX_GEN_ROW_BUCKET) * MAX_GEN_ROW_BUCKET
+    b = GEN_ROW_BUCKET_MIN
+    while b < n:
+        b *= 2
+    return b
+
+
+def in_gen_row_grid(n: int) -> bool:
+    """True when a generation row count sits on the sealed-generation
+    ladder (the `segments.knn` grid predicate)."""
+    return n >= GEN_ROW_BUCKET_MIN and n == bucket_gen_rows(n)
+
+
 def bucket_headroom(n: int, max_batch: Optional[int] = None) -> int:
     """Free rows left in `n` requests' dispatch bucket — the continuous
     batcher's top-up budget. A batch of n dispatches padded to

@@ -499,3 +499,26 @@ class ShardedFieldState:
                     {"k": k_b, "mesh": self.mesh, "metric": self.metric,
                      "precision": "bf16", "block_size": None}))
         return entries
+
+
+def extend_or_build(old_state: Optional[ShardedFieldState],
+                    vectors: np.ndarray, prefix_rows: int, mesh: Mesh,
+                    metric: str, dtype: str):
+    """One owner for the append-vs-rebuild decision both refresh sync
+    and the segments merge scheduler make: when `old_state` holds
+    exactly the first `prefix_rows` of `vectors` (caller-verified row
+    identity) on the same mesh/metric/dtype and its per-shard headroom
+    fits the delta, ship ONLY the delta (``mesh.append``,
+    copy-on-write); otherwise build the sharded corpus from scratch.
+    Returns (state, appended)."""
+    n = len(vectors)
+    if (old_state is not None and old_state.mesh is mesh
+            and old_state.dtype == dtype and old_state.metric == metric
+            and old_state.n_rows == prefix_rows and 0 < prefix_rows <= n
+            and old_state.can_append(n - prefix_rows)):
+        if n == prefix_rows:
+            return old_state, True
+        return old_state.append(np.asarray(vectors[prefix_rows:],
+                                           dtype=np.float32)), True
+    return ShardedFieldState(np.asarray(vectors, dtype=np.float32),
+                             mesh, metric, dtype), False

@@ -165,7 +165,13 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
             "engine": knn_phases.get("engine"),
             **{key: knn_phases[key]
                for key in ("nprobe", "nlist", "scored_rows",
-                           "fallback_reason") if key in knn_phases},
+                           "fallback_reason",
+                           # generational-corpus annotations
+                           # (segments/): how many device generations
+                           # this search fanned over and what it masked
+                           "generations", "l0_generations",
+                           "tombstoned_rows", "legs")
+               if key in knn_phases},
             "breakdown": {
                 key: knn_phases[key]
                 for key in ("route_nanos", "score_nanos", "merge_nanos")

@@ -1,0 +1,28 @@
+"""Generational device segments: writes-while-searching without rebuilds.
+
+The reference engine never rebuilds its index to absorb writes: Lucene
+writers seal small immutable segments, a background TieredMergePolicy
+amortizes consolidation, and readers hold point-in-time views that merges
+can never invalidate (PAPER.md, indices/engine layer). This package ports
+that lifecycle onto the device-resident vector corpus:
+
+* `generation.Generation` — one immutable device corpus slice padded to
+  the pow-2 row-bucket ladder (`ops/dispatch.bucket_gen_rows`), searched
+  by the `segments.knn` kernel; deletes are per-generation tombstone
+  masks, never rebuild triggers;
+* `policy.TieredMergePolicy` — the Lucene-mirroring tier math: merge
+  when a tier holds >= tier_size same-sized generations (plus L0
+  overflow and tombstone-GC selection);
+* `generational.GenerationalCorpus` — the copy-on-write generation set
+  `vectors/store.py` serves from, the O(delta) refresh classifier, the
+  fan-out search fused through `ops/topk.merge_top_k`, and the budgeted
+  background merge scheduler that owns IVF retrains and mesh graduation
+  (neither ever runs on the refresh thread).
+"""
+
+from elasticsearch_tpu.segments.generation import (  # noqa: F401
+    Generation, build_generation, generation_tier)
+from elasticsearch_tpu.segments.generational import (  # noqa: F401
+    GenerationalCorpus, GenerationSet)
+from elasticsearch_tpu.segments.policy import (  # noqa: F401
+    MergeSpec, TieredMergePolicy)

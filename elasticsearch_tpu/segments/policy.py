@@ -1,0 +1,97 @@
+"""Tiered merge selection: the Lucene TieredMergePolicy shape.
+
+The reference consolidates write amplification in the background: small
+flushed segments accumulate until a size tier holds `segmentsPerTier` of
+them, then one merge folds the tier into the next band — total merge
+work stays O(n log n) over the index's life while readers never block.
+This module is that selection math over device generations.
+
+Selection is CONTIGUOUS on purpose: the generation list is the flat
+logical row order (base first, seals appended chronologically), and the
+byte-parity contract with the monolithic corpus relies on tie-breaks
+resolving by that order (`lax.top_k` stability + `merge_top_k`'s
+stable concatenation). Merging a contiguous run and installing the
+merged generation at the run's position preserves the order invariant
+by construction. Because merged generations always land LEFT of newer
+seals, same-tier generations are adjacent in steady state and the
+contiguity restriction costs nothing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+
+class MergeSpec(NamedTuple):
+    """One selected merge: generations [start, stop) fold into one."""
+
+    start: int
+    stop: int
+    reason: str   # "tier_full" | "l0_overflow" | "tombstone_gc" | "force"
+
+
+class TieredMergePolicy:
+    """Pick the next merge from a generation snapshot (or None).
+
+    tier_size:   merge when a contiguous run holds >= this many
+                 generations of the same size tier (Lucene
+                 segmentsPerTier)
+    max_l0:      hard cap on tier-0 (freshly sealed) generations — past
+                 it the whole trailing L0 run merges even below
+                 tier_size, bounding search fan-out under a fast
+                 refresh cadence
+    gc_deleted_fraction: a generation more than this fraction dead is
+                 compacted alone (expungeDeletes analog), reclaiming
+                 HBM and shrinking its scan
+    """
+
+    def __init__(self, tier_size: int = 4, max_l0: int = 8,
+                 gc_deleted_fraction: float = 0.5):
+        self.tier_size = max(2, int(tier_size))
+        self.max_l0 = max(1, int(max_l0))
+        self.gc_deleted_fraction = float(gc_deleted_fraction)
+
+    def select(self, gens: Sequence) -> Optional[MergeSpec]:
+        """Next merge over `gens` (objects with .tier / .n_rows /
+        .dead_rows), or None when the set is steady. Priority: full
+        tiers (the amortizing path) > L0 overflow (fan-out bound) >
+        tombstone GC (space/scan reclaim)."""
+        n = len(gens)
+        if n == 0:
+            return None
+        # 1. a contiguous same-tier run at tier_size
+        run_start, run_tier = 0, gens[0].tier
+        for i in range(1, n + 1):
+            tier = gens[i].tier if i < n else None
+            if tier != run_tier:
+                if i - run_start >= self.tier_size:
+                    return MergeSpec(run_start,
+                                     run_start + self.tier_size,
+                                     "tier_full")
+                run_start, run_tier = i, tier
+        # 2. L0 overflow: merge the trailing run of tier-0 seals
+        l0 = [i for i in range(n) if gens[i].tier == 0]
+        if len(l0) > self.max_l0:
+            start = l0[0]
+            while start > 0 and gens[start - 1].tier == 0:
+                start -= 1
+            stop = start + 1
+            while stop < n and gens[stop].tier == 0:
+                stop += 1
+            if stop - start >= 2:
+                return MergeSpec(start, stop, "l0_overflow")
+        # 3. tombstone GC (single-generation compaction)
+        for i in range(n):
+            g = gens[i]
+            if g.n_rows > 0 and g.dead_rows > 0 \
+                    and g.dead_rows / g.n_rows > self.gc_deleted_fraction:
+                return MergeSpec(i, i + 1, "tombstone_gc")
+        return None
+
+    @staticmethod
+    def force(gens: Sequence) -> Optional[MergeSpec]:
+        """Force-merge everything into one generation (Lucene
+        forceMerge(1)); None when already consolidated and clean."""
+        if len(gens) > 1 or (len(gens) == 1 and gens[0].dead_rows > 0):
+            return MergeSpec(0, len(gens), "force")
+        return None

@@ -8,18 +8,24 @@ sealed segments at refresh, with a row map joining device rows back to the
 engine's global rows (and thence _id).
 
 Refresh contract: the engine's reader is the source of truth; `sync(reader)`
-re-ingests when the segment set or tombstones changed. Vectors are
-append-mostly, so unchanged segments' blocks are cached and concatenation is
-cheap; a full device upload happens only for new/changed segments
-(refresh-cycle analog of Lucene NRT reopen).
+re-ingests when the segment set or tombstones changed. With generational
+segments enabled (`index.segments.enabled`, default on — `segments/`),
+a changed field absorbs the refresh as an O(delta) L0 seal plus
+per-generation tombstones and a background merge scheduler amortizes
+consolidation; the monolithic full build below runs only for first
+builds, dtype changes, and engine-level segment rewrites (each counted
+and logged — `_nodes/stats indices.segments`).
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("elasticsearch_tpu.vectors")
 
 from elasticsearch_tpu import native
 from elasticsearch_tpu.index.mapping import DenseVectorFieldMapper
@@ -50,10 +56,11 @@ class FieldCorpus:
     """Device corpus for one vector field + host-side row maps."""
 
     __slots__ = ("corpus", "row_map", "metric", "dims", "version", "host",
-                 "router", "mesh_state")
+                 "router", "mesh_state", "gens")
 
     def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int,
-                 version: tuple, host=None, router=None, mesh_state=None):
+                 version: tuple, host=None, router=None, mesh_state=None,
+                 gens=None):
         self.corpus = corpus          # knn_ops.Corpus (device pytree)
         self.row_map = row_map        # device row -> engine global row
         self.metric = metric
@@ -65,6 +72,11 @@ class FieldCorpus:
         # row-sharded copy + slot maps (None when the mesh router would
         # never pick this corpus)
         self.mesh_state = mesh_state
+        # segments.GenerationalCorpus: the live generation lifecycle this
+        # view was derived from (None = legacy monolithic field). The
+        # serving path re-snapshots per dispatch, so a merge installing
+        # mid-flight never invalidates an in-progress search.
+        self.gens = gens
 
 
 def _pad_batch(queries: np.ndarray, n_real: int) -> np.ndarray:
@@ -111,7 +123,12 @@ class VectorStoreShard:
                  knn_nprobe="auto", knn_recall_target: float = 0.95,
                  warmup: Optional[bool] = None, topup: bool = True,
                  target_batch_latency_ms: float = 2.0,
-                 async_depth: int = 2):
+                 async_depth: int = 2,
+                 segments_enabled: bool = True,
+                 segments_tier_size: int = 4,
+                 segments_max_l0: int = 8,
+                 segments_merge_budget_ms: float = 50.0,
+                 segments_background_merge: bool = True):
         self.dtype = dtype
         self.host_mirror_max_bytes = host_mirror_max_bytes
         self.knn_engine = knn_engine        # "tpu" (exhaustive) | "tpu_ivf"
@@ -134,6 +151,28 @@ class VectorStoreShard:
         self.topup = topup
         self.target_batch_latency_ms = target_batch_latency_ms
         self.async_depth = async_depth
+        # generational device segments (elasticsearch_tpu/segments/):
+        # refresh seals O(delta) L0 generations instead of rebuilding,
+        # deletes tombstone, a background tiered merger consolidates
+        # (`index.segments.{enabled,tier_size,max_l0,merge_budget_ms}`)
+        self.segments_enabled = segments_enabled
+        self.segments_tier_size = segments_tier_size
+        self.segments_max_l0 = segments_max_l0
+        self.segments_merge_budget_ms = segments_merge_budget_ms
+        self.segments_background_merge = segments_background_merge
+        self._gens: Dict[str, "GenerationalCorpus"] = {}
+        # serializes FieldCorpus view installs between the refresh
+        # thread (sync) and the merge thread's view_cb — without it a
+        # merge install could clobber a freshly REBUILT field with a
+        # view over the superseded GenerationalCorpus (stale row maps)
+        self._views_lock = threading.Lock()
+        # full-rebuild accounting (the pre-subsystem stall made
+        # measurable): every monolithic rebuild of a previously-resident
+        # corpus counts here with its reason; incremental refreshes the
+        # generational path absorbed count as avoided
+        self.segment_counters: Dict[str, object] = {
+            "full_rebuilds": 0, "rebuilds_avoided": 0,
+            "rebuild_reasons": {}}
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -169,7 +208,15 @@ class VectorStoreShard:
 
     def sync(self, reader: ShardReader,
              vector_mappers: Dict[str, DenseVectorFieldMapper]) -> None:
-        """Re-ingest vector fields whose segment composition changed."""
+        """Re-ingest vector fields whose segment composition changed.
+
+        Generational path first: an established field absorbs the
+        refresh as tombstones + an O(delta) L0 seal
+        (`GenerationalCorpus.try_incremental`) — no corpus re-upload, no
+        IVF retrain, no mesh rebuild on this thread. Only first builds
+        and incompatible reader shapes (dtype change, engine segment
+        rewrite) fall through to the monolithic full build, which is
+        counted and logged as the rebuild stall it is."""
         for field, mapper in vector_mappers.items():
             version = self._fingerprint(reader, field)
             cached = self._fields.get(field)
@@ -180,11 +227,40 @@ class VectorStoreShard:
             if len(row_map) == 0:
                 self._fields[field] = FieldCorpus(None, np.zeros(0, dtype=np.int64),
                                                   metric, mapper.dims, version)
+                self._gens.pop(field, None)
                 continue
             dtype = self.dtype
             opts = mapper.params.get("index_options", {})
             if opts.get("type") in ("int8_flat", "int8_ivf"):
                 dtype = "int8"
+            rescore = bool(opts.get("rescore", False))
+            gc = self._gens.get(field) if self.segments_enabled else None
+            if gc is not None:
+                if cached is None or self._reader_prefix_ok(
+                        cached.version, version):
+                    outcome = gc.try_incremental(
+                        full, row_map, dtype=dtype, metric=metric,
+                        rescore=rescore)
+                else:
+                    # the engine rewrote segments (merge): row ids were
+                    # re-based, so identical ids no longer name
+                    # identical docs — only a rebuild is sound
+                    gc.last_rebuild_reason = "segment_rewrite"
+                    outcome = None
+                if outcome is not None:
+                    if outcome != "noop":
+                        self.segment_counters["rebuilds_avoided"] += 1
+                    with self._views_lock:
+                        self._fields[field] = self._generational_view(
+                            gc, metric, mapper.dims, version)
+                    with self._batchers_lock:
+                        for key in [k for k in self._batchers
+                                    if k[0] == field]:
+                            self._retire_sched(self._batchers.pop(key))
+                    continue
+            rebuild_reason = (gc.last_rebuild_reason if gc is not None
+                              else self._rebuild_reason(cached, row_map,
+                                                        dtype))
             # `"rescore": true` in index_options additionally keeps the
             # residual rescore level — the analog of Lucene retaining raw
             # f32 vectors beside the quantized copy (reference
@@ -245,38 +321,175 @@ class VectorStoreShard:
             from elasticsearch_tpu.parallel import policy as mesh_policy
             if mesh_policy.eligible(len(row_map)):
                 from elasticsearch_tpu.parallel.sharded_knn import (
-                    ShardedFieldState)
+                    extend_or_build)
                 mesh = mesh_policy.serving_mesh()
                 old_ms = cached.mesh_state if cached is not None else None
                 old_n = len(cached.row_map) if cached is not None else 0
-                if (old_ms is not None and old_ms.mesh is mesh
-                        and old_ms.dtype == dtype
-                        and old_ms.metric == metric
-                        and old_ms.n_rows == old_n
-                        and 0 < old_n <= len(row_map)
-                        and old_ms.can_append(len(row_map) - old_n)
-                        and np.array_equal(row_map[:old_n],
-                                           cached.row_map)):
-                    # append-only refresh (new sealed segments, no
-                    # deletes): ship ONLY the delta rows into the
-                    # per-shard padded headroom (`mesh.append`,
-                    # copy-on-write — in-flight searches keep the old
-                    # state's buffers) — the resident sharded corpus is
-                    # never re-uploaded. Deletes or a mesh/dtype change
-                    # fall through to the full rebuild.
-                    mesh_state = (old_ms.append(full[old_n:])
-                                  if len(row_map) > old_n else old_ms)
-                else:
-                    mesh_state = ShardedFieldState(full, mesh, metric,
-                                                   dtype)
-            self._fields[field] = FieldCorpus(corpus, row_map, metric,
-                                              mapper.dims, version,
-                                              host=host, router=router,
-                                              mesh_state=mesh_state)
+                # append-only refresh (new sealed segments, no deletes):
+                # ship ONLY the delta rows into the per-shard padded
+                # headroom (`mesh.append`, copy-on-write — in-flight
+                # searches keep the old state's buffers). Deletes or a
+                # mesh/dtype change rebuild the sharded copy.
+                prefix = old_n if (old_ms is not None
+                                   and 0 < old_n <= len(row_map)
+                                   and np.array_equal(row_map[:old_n],
+                                                      cached.row_map)) \
+                    else 0
+                mesh_state, _ = extend_or_build(
+                    old_ms if prefix else None, full, prefix, mesh,
+                    metric, dtype)
+            if (cached is not None and cached.corpus is not None
+                    and rebuild_reason is not None):
+                self.segment_counters["full_rebuilds"] += 1
+                reasons = self.segment_counters["rebuild_reasons"]
+                reasons[rebuild_reason] = \
+                    reasons.get(rebuild_reason, 0) + 1
+                logger.info(
+                    "full corpus rebuild for field [%s]: reason=%s "
+                    "rows=%d (the generational segments path avoids "
+                    "this stall for append/delete refreshes)",
+                    field, rebuild_reason, len(row_map))
+            gens = None
+            if self.segments_enabled:
+                from elasticsearch_tpu.segments import (
+                    GenerationalCorpus, TieredMergePolicy)
+                gens = GenerationalCorpus.from_monolithic(
+                    corpus, row_map, full, metric, dtype, rescore,
+                    mapper.dims, host=host, router=router,
+                    mesh_state=mesh_state,
+                    policy=TieredMergePolicy(self.segments_tier_size,
+                                             self.segments_max_l0),
+                    merge_budget_ms=self.segments_merge_budget_ms,
+                    background=self.segments_background_merge,
+                    warmup_cb=self._segments_warmup_cb,
+                    view_cb=(lambda g, _f=field:
+                             self._reinstall_view(_f, g)),
+                    knn_params={
+                        "engine": self._field_engine(mapper),
+                        "nlist": opts.get("nlist", self.knn_nlist),
+                        "nprobe": opts.get("nprobe", self.knn_nprobe),
+                        "recall_target": self.knn_recall_target,
+                        "min_rows": IVF_MIN_ROWS,
+                        "host_mirror_max_bytes":
+                            self.host_mirror_max_bytes})
+            with self._views_lock:
+                if gens is not None:
+                    self._gens[field] = gens
+                self._fields[field] = FieldCorpus(corpus, row_map, metric,
+                                                  mapper.dims, version,
+                                                  host=host, router=router,
+                                                  mesh_state=mesh_state,
+                                                  gens=gens)
             with self._batchers_lock:
                 for key in [k for k in self._batchers if k[0] == field]:
                     self._retire_sched(self._batchers.pop(key))
             self._schedule_warmup(self._fields[field])
+
+    @staticmethod
+    def _reader_prefix_ok(old_version: tuple, new_version: tuple) -> bool:
+        """Incremental refreshes require the old reader's segment set to
+        be a PREFIX of the new one (same seg ids/sizes, live counts only
+        shrinking, new segments appended) — the Lucene NRT contract. An
+        engine segment rewrite re-bases rows, so an identical row id no
+        longer names an identical doc and the row-id delta classifier
+        would silently mis-seal."""
+        if len(old_version) > len(new_version):
+            return False
+        return all(o[0] == n[0] and o[1] == n[1] and o[2] >= n[2]
+                   for o, n in zip(old_version, new_version))
+
+    @staticmethod
+    def _rebuild_reason(cached: Optional[FieldCorpus],
+                        row_map: np.ndarray,
+                        dtype: str) -> Optional[str]:
+        """Why a monolithic full build is replacing a resident corpus
+        (None = first build, not a rebuild) — the pre-subsystem stall
+        accounting the generational path is measured against."""
+        if cached is None or cached.corpus is None \
+                or len(cached.row_map) == 0:
+            return None
+        want = {"bf16": "bfloat16", "f32": "float32",
+                "int8": "int8"}.get(dtype, dtype)
+        if str(cached.corpus.matrix.dtype) != want:
+            return "dtype_change"
+        old = cached.row_map
+        if len(row_map) >= len(old) \
+                and np.array_equal(row_map[:len(old)], old):
+            # the monolithic path re-uploads the whole corpus for a pure
+            # append — the exact headroom-exhaustion stall the
+            # generational seal removes
+            return "append_headroom"
+        if np.isin(old, row_map, invert=True).any():
+            return "deletes"
+        return "segment_rewrite"
+
+    def _segments_warmup_cb(self, entries) -> None:
+        """Pre-compile a freshly sealed/merged generation's search grid
+        (policy-gated like every other warmup)."""
+        if self.warmup_enabled():
+            dispatch.DISPATCH.warmup(entries, background=True)
+
+    def _generational_view(self, gc, metric: str, dims: int,
+                           version: tuple) -> FieldCorpus:
+        """FieldCorpus snapshot-view over the current generation set:
+        base fields for the single-generation fast path, the FLAT row
+        map (concatenated generation row maps — tombstoned slots stay,
+        masked at search) for the fan-out path."""
+        snap = gc.snapshot()
+        base = snap.generations[0]
+        return FieldCorpus(base.corpus, snap.row_map, metric, dims,
+                           version, host=base.host if snap.simple else None,
+                           router=base.router,
+                           mesh_state=base.mesh_state, gens=gc)
+
+    def _reinstall_view(self, field: str, gc) -> None:
+        """Refresh the installed view after a background merge installs
+        a new generation set, and retire the field's batchers (their
+        closures captured the pre-merge view) — together these drop the
+        stale device refs so the pre-merge base corpus can be reclaimed
+        once in-flight searches land. Guarded by `_views_lock` against a
+        concurrent sync() REBUILD: the install only lands while `gc` is
+        still the field's authoritative lifecycle."""
+        with self._views_lock:
+            if self._gens.get(field) is not gc:
+                return
+            fc = self._fields.get(field)
+            if fc is None or fc.gens is not gc:
+                return
+            self._fields[field] = self._generational_view(
+                gc, fc.metric, fc.dims, fc.version)
+        with self._batchers_lock:
+            for key in [k for k in self._batchers if k[0] == field]:
+                self._retire_sched(self._batchers.pop(key))
+
+    def segment_stats(self) -> dict:
+        """Generational-segment counters for `_nodes/stats
+        indices.segments`: rebuilds (+reasons) and rebuilds avoided at
+        the store level, generation/tier/merge counters summed over this
+        shard's fields."""
+        out = {
+            "full_rebuilds": self.segment_counters["full_rebuilds"],
+            "rebuilds_avoided": self.segment_counters["rebuilds_avoided"],
+            "rebuild_reasons": dict(self.segment_counters
+                                    ["rebuild_reasons"]),
+            "enabled": self.segments_enabled,
+        }
+        agg: Dict[str, int] = {}
+        tiers: Dict[str, dict] = {}
+        for gc in list(self._gens.values()):
+            st = gc.segment_stats()
+            for key, val in st.items():
+                if key == "tiers":
+                    for t, tv in val.items():
+                        slot = tiers.setdefault(
+                            t, {k: 0 for k in tv})
+                        for k2, v2 in tv.items():
+                            slot[k2] += v2
+                elif isinstance(val, (int, float)):
+                    agg[key] = agg.get(key, 0) + val
+        out.update(agg)
+        out["tiers"] = tiers
+        return out
 
     def warmup_enabled(self) -> bool:
         return dispatch.warmup_enabled(self.warmup)
@@ -490,6 +703,24 @@ class VectorStoreShard:
         here (they are host-side or sync internally)."""
         import jax.numpy as jnp
 
+        if fc.gens is not None:
+            # generational field: serve from the CURRENT copy-on-write
+            # snapshot (a background merge may have installed since this
+            # view was built). One clean generation degenerates to the
+            # monolithic path below on its base corpus — byte-identical
+            # to the pre-generational store; anything else fans out.
+            snap = fc.gens.snapshot()
+            if not snap.simple:
+                return self._dispatch_generational(
+                    snap, fc, k, precision, requests, num_candidates)
+            base = snap.generations[0]
+            if base.corpus is not fc.corpus:
+                fc = FieldCorpus(base.corpus, base.row_map, fc.metric,
+                                 fc.dims, fc.version, host=base.host,
+                                 router=base.router,
+                                 mesh_state=base.mesh_state,
+                                 gens=fc.gens)
+
         n_valid = len(fc.row_map)
         k_eff = min(k, fc.corpus.matrix.shape[0])
         queries = np.stack([q for q, _ in requests])
@@ -567,8 +798,34 @@ class VectorStoreShard:
         dispatch.DISPATCH.note_async()
         return ("pending", (fc, s, i, k_eff, n_valid, len(requests)))
 
+    def _dispatch_generational(self, snap, fc: FieldCorpus, k: int,
+                               precision: str, requests,
+                               num_candidates: Optional[int]):
+        """Fan one dispatch per live generation and fuse through
+        `merge_top_k` (`segments/generational.py`) — the serving shape
+        between merges: L0 seals and tombstoned generations search as a
+        stable-ordered board merge, byte-identical to the monolithic
+        corpus. Returns a pending handle whose flat-space boards land in
+        `finalize_many` (the snapshot rides in the handle, so a merge
+        installing mid-flight cannot swap the row map under us)."""
+        n_valid = len(snap.row_map)
+        k_eff = min(k, snap.total_pad)
+        queries = _pad_batch(np.stack([q for q, _ in requests]),
+                             len(requests))
+        self.knn_stats["searches"] += 1
+        self.last_knn_phases = {}
+        s, i, phases = snap.search_async(
+            queries, len(requests), k_eff, [fr for _, fr in requests],
+            fc.metric, precision, num_candidates=num_candidates,
+            knn_stats=self.knn_stats)
+        self.last_knn_phases = phases
+        # un-synced boards: the device sync happens at response-assembly
+        # time in finalize_many, like the monolithic pipelined path
+        dispatch.DISPATCH.note_async()
+        return ("pending", (snap, s, i, k_eff, n_valid, len(requests)))
+
     @staticmethod
-    def _land_results(fc: FieldCorpus, scores: np.ndarray, ids: np.ndarray,
+    def _land_results(fc, scores: np.ndarray, ids: np.ndarray,
                       floor: float, n_valid: int, n_real: int) -> list:
         out = []
         for qi in range(n_real):

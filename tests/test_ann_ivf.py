@@ -228,9 +228,11 @@ def test_store_routes_through_ivf_and_falls_back_on_filter():
 
 
 def test_store_append_only_refresh_reuses_layout():
-    """A refresh that only appends segments places the delta into the
-    existing partition layout (no k-means retrain, tuned nprobe kept);
-    the full rebuild happens only on non-append changes or drift."""
+    """A refresh that only appends segments never retrains k-means on
+    the refresh thread: the delta seals into an L0 generation (searched
+    exhaustively, fused with the IVF base), and the MERGE scheduler
+    re-enters the delta into the trained layout (clone + add, tuned
+    nprobe kept) off the refresh path."""
     from elasticsearch_tpu.index.mapping import MapperService
     from elasticsearch_tpu.index.segment import Segment, SegmentView, ShardReader
     from elasticsearch_tpu.vectors.store import VectorStoreShard
@@ -250,7 +252,7 @@ def test_store_append_only_refresh_reuses_layout():
     ms = MapperService({"properties": {
         "v": {"type": "dense_vector", "dims": 16}}})
     store = VectorStoreShard(knn_engine="tpu_ivf", knn_nlist=16,
-                             knn_nprobe=4)
+                             knn_nprobe=4, segments_background_merge=False)
     seg0 = seg_of(vecs, 0, 0)
     store.sync(ShardReader([SegmentView(seg0)]), ms.vector_fields())
     router0 = store.field("v").router
@@ -263,17 +265,36 @@ def test_store_append_only_refresh_reuses_layout():
     reader2 = ShardReader([SegmentView(seg0),
                            SegmentView(seg_of(extra, n, 1))])
     store.sync(reader2, ms.vector_fields())
-    fc = store.field("v")
-    assert fc.router is router0, "append-only sync retrained k-means"
-    assert fc.router.index.total == n + 64
+    gc = store._gens["v"]
+    base = gc.snapshot().generations[0]
+    assert base.router is router0, "append-only sync retrained k-means"
+    assert base.router.index.total == n, \
+        "refresh thread touched the IVF layout"
+    rows, _ = store.search("v", extra[0], 5)
+    assert (rows >= n).any(), "appended rows not searchable pre-merge"
+
+    # the merge graduates the delta into the trained layout: no retrain
+    # (centroids shared via clone), tuned nprobe carried over
+    assert gc.force_merge()
+    merged = gc.snapshot().generations[0]
+    assert merged.router is not None
+    assert merged.router.index.total == n + 64
+    assert merged.router.index.centroids is router0.index.centroids, \
+        "append-shaped merge retrained k-means"
     rows, _ = store.search("v", extra[0], 5)
     assert (rows >= n).any(), "appended rows not searchable via IVF"
 
-    # a delete (changed live set) breaks the append-only prefix → rebuild
+    # a delete drops the base router (tombstones would leak through the
+    # partition layout); the background compaction rebuilds it
     reader3 = ShardReader([SegmentView(seg0, deleted_locals={0}),
                            SegmentView(seg_of(extra, n, 1))])
     store.sync(reader3, ms.vector_fields())
-    assert store.field("v").router is not router0
+    assert gc.snapshot().generations[0].router is None
+    rows, _ = store.search("v", vecs[3], 5)  # still correct, masked
+    assert 0 not in rows
+    assert gc.run_merges() >= 1
+    assert gc.snapshot().generations[0].router is not None
+    assert gc.snapshot().generations[0].router is not router0
 
 
 def test_store_default_engine_stays_exhaustive():
