@@ -134,6 +134,25 @@ def _dispatch_delta(mark):
             - mark["out_of_grid_compiles"]}
 
 
+def _compile_noise_label(disp: dict) -> dict:
+    """Label timed-loop compile noise in a closed-loop row (the PR 10
+    leftover: on the CPU floor a handful of steady-state shapes can
+    still compile inside the timed window — e.g. a generational seal's
+    first bucket — and one XLA compile reads as a multi-hundred-ms p99
+    outlier that has nothing to do with serving). Rows carry the label
+    so tail comparisons (the dp sweep especially) aren't silently
+    polluted: a row with compiles > 0 has a compile-inflated p99, not a
+    scheduling regression."""
+    if disp.get("compiles", 0) <= 0:
+        return {}
+    return {"p99_compile_noise": {
+        "timed_loop_compiles": disp["compiles"],
+        "compile_ms": disp["compile_ms"],
+        "note": "p99 includes CPU-floor XLA compile stalls inside the "
+                "timed loop (PR 10 leftover) — compare tails against "
+                "rows with timed_loop_compiles=0"}}
+
+
 def hybrid_serving_stats(node) -> dict:
     """Serving-stats fields of the hybrid bench row, read from the SAME
     live node instance that served the timed loop (`node.
@@ -655,6 +674,7 @@ def run_hybrid_rrf():
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
     qps = n_clients * per_client / wall
+    disp = _dispatch_delta(mark)
     print(json.dumps({"config": "3_hybrid_bm25_knn_rrf",
                       "qps": round(qps, 1),
                       "p50_ms": round(p50, 2),
@@ -667,7 +687,8 @@ def run_hybrid_rrf():
                       "fused_lists": 2,
                       "execution": "fused_hybrid_plan",
                       **hybrid_serving_stats(node),
-                      "dispatch": _dispatch_delta(mark)}), flush=True)
+                      **_compile_noise_label(disp),
+                      "dispatch": disp}), flush=True)
     node.close()
 
 
@@ -767,6 +788,7 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
     lats = np.concatenate(all_lats)
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
+    disp = _dispatch_delta(mark)
     print(json.dumps({
         "config": f"{name}_closed_loop_8c",
         "qps": round(n_clients * per_client / wall, 1),
@@ -777,7 +799,8 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
         "concurrent_clients": n_clients,
         "build_s": round(build_s, 1),
         **knn_scheduler_stats(node),
-        "dispatch": _dispatch_delta(mark)}), flush=True)
+        **_compile_noise_label(disp),
+        "dispatch": disp}), flush=True)
     node.close()
 
 
@@ -1223,15 +1246,15 @@ def _run_ingest_while_search_body(node, shard, rng, d, docs_per_sec,
         "dispatch": _dispatch_delta(mark)}), flush=True)
 
 
-def run_sharded_fused():
-    """Config 6: the mesh-sharded serving path (PR 5) — exact kNN, IVF,
-    and the fused hybrid plan each executing as ONE shard_map program
-    with an ICI all-gather merge, plus parity-vs-single-device on every
-    variant. On a <2-device host the config re-execs itself in a
-    subprocess with 8 virtual XLA host devices and labels every row
-    `simulated_mesh: true` — those rows validate program structure
-    (partitioning, merge, compile-cache behavior), NOT ICI bandwidth, so
-    their qps/p50 columns are not comparable to real-mesh captures."""
+def _run_on_simulated_mesh(config_name: str, child_flag: str, body,
+                           min_devices: int):
+    """Shared re-exec scaffold for mesh bench configs: run `body(
+    simulated)` when this process already sees `min_devices` devices,
+    otherwise re-exec this script with 8 virtual XLA host devices under
+    `child_flag` and relabel every emitted JSON row `simulated_mesh:
+    true` — those rows validate program structure (partitioning, merge,
+    compile-cache, scheduling), NOT ICI bandwidth, so their qps/p50
+    columns are not comparable to real-mesh captures."""
     import os
     import subprocess
     import sys
@@ -1239,13 +1262,12 @@ def run_sharded_fused():
     import jax
 
     n_dev = len(jax.devices())
-    if n_dev >= 2:
-        _sharded_rows(
-            simulated=os.environ.get("BENCH_MESH_CHILD") == "1")
+    if n_dev >= min_devices:
+        body(simulated=os.environ.get("BENCH_MESH_CHILD") == "1")
         return
     if os.environ.get("BENCH_MESH_CHILD") == "1":
         # the re-exec failed to take (XLA flag landed after backend init)
-        print(json.dumps({"config": "6_sharded_fused_spmd",
+        print(json.dumps({"config": config_name,
                           "error": "simulated mesh re-exec still sees "
                                    f"{n_dev} device(s)"}), flush=True)
         return
@@ -1255,7 +1277,7 @@ def run_sharded_fused():
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_MESH_CHILD"] = "1"
     proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--sharded-only"],
+        [sys.executable, os.path.abspath(__file__), child_flag],
         env=env, capture_output=True, text=True, timeout=3600)
     emitted = 0
     for line in proc.stdout.splitlines():
@@ -1269,10 +1291,19 @@ def run_sharded_fused():
         emitted += 1
     if proc.returncode != 0 or emitted == 0:
         tail = (proc.stderr or "").strip().splitlines()[-1:] or [""]
-        print(json.dumps({"config": "6_sharded_fused_spmd",
+        print(json.dumps({"config": config_name,
                           "error": "simulated mesh subprocess failed "
                                    f"(rc={proc.returncode})",
                           "stderr_tail": tail[0][:200]}), flush=True)
+
+
+def run_sharded_fused():
+    """Config 6: the mesh-sharded serving path (PR 5) — exact kNN, IVF,
+    and the fused hybrid plan each executing as ONE shard_map program
+    with an ICI all-gather merge, plus parity-vs-single-device on every
+    variant (re-exec'd onto 8 virtual devices when needed)."""
+    _run_on_simulated_mesh("6_sharded_fused_spmd", "--sharded-only",
+                           _sharded_rows, min_devices=2)
 
 
 def _sharded_rows(simulated: bool):
@@ -1454,10 +1485,150 @@ def _sharded_rows(simulated: bool):
         policy.reset(full=True)
 
 
+def run_dp_replicated():
+    """Config 6 dp row: replicated mesh serving (PR 11) — closed-loop
+    qps sweep over dp ∈ {1, 2, 4} on the 8-device mesh at EQUAL corpus,
+    `parity_vs_single_device` per row, per-row dispatch deltas (the
+    timed loop must compile nothing), and the `gate_500qps` wiring
+    (re-exec'd onto 8 virtual devices when needed — those rows measure
+    scheduling concurrency and program shape, not ICI bandwidth)."""
+    _run_on_simulated_mesh("6_dp_replicated", "--dp-only",
+                           _dp_replicated_rows, min_devices=8)
+
+
+def _dp_replicated_rows(simulated: bool, n: int = 4096, d: int = 64,
+                        batch: int = 64, k: int = 256,
+                        n_clients: int = 4, per_client: int = 30):
+    """The dp sweep body (needs >= 8 devices). Interactive merge-heavy
+    shape on purpose: the [S, Q, k] all-gather merge replicates on
+    every participating device, so the dp win on a shared-core
+    simulated mesh comes from smaller per-group boards + overlapped
+    launches — the scheduling-concurrency story the row documents.
+    `simulated` is the re-exec scaffold's body contract; the dp sweep
+    runs the same (small) shape on real and simulated meshes, and the
+    parent labels simulated rows."""
+    del simulated
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import knn as knn_ops
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    from elasticsearch_tpu.parallel import policy
+    from elasticsearch_tpu.parallel.sharded_knn import (
+        ShardedFieldState, distributed_knn_search)
+
+    rng = np.random.default_rng(31)
+    vectors = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((256, d)).astype(np.float32)
+    parity_queries = queries[:batch]
+    # single-device oracle at the serving dtype (byte-comparable)
+    one_corpus = knn_ops.build_corpus(vectors, metric="cosine",
+                                      dtype="bf16")
+    s_ref, i_ref = knn_ops.knn_search(
+        jnp.asarray(parity_queries), one_corpus, k=k, metric="cosine")
+    s_ref, i_ref = np.asarray(s_ref), np.asarray(i_ref)
+
+    base = {"shards_times_dp": 8, "n_docs": n, "dims": d, "batch": batch,
+            "k": k, "concurrent_clients": n_clients,
+            "measures": "scheduling_concurrency_not_ici"}
+    results = {}
+    try:
+        for dp in (1, 2, 4):
+            policy.reset(full=True)
+            policy.configure(enabled=True, dp=dp, num_shards=8 // dp,
+                             min_rows=1)
+            mesh = policy.serving_mesh()
+            state = ShardedFieldState(vectors, mesh, "cosine", "bf16")
+            inflight = [0]
+            lock = threading.Lock()
+
+            def one(qs, state=state, dp=dp):
+                # the live load signal a serving store would feed the
+                # router (queued + in-flight dispatches)
+                with lock:
+                    depth = inflight[0]
+                    inflight[0] += 1
+                try:
+                    route = policy.decide("knn", n, batch=batch,
+                                          queue_depth=depth)
+                    q = jax.device_put(jnp.asarray(qs),
+                                       mesh_lib.query_sharding(route))
+                    s, g = distributed_knn_search(
+                        q, state.corpus_for(route), k, route,
+                        metric="cosine")
+                    g.block_until_ready()
+                    return s, g, state
+                finally:
+                    with lock:
+                        inflight[0] -= 1
+            # deterministic route warmup: the router picks the full
+            # mesh when idle and a dp group under pressure, so warm
+            # BOTH route families explicitly (each group's view + its
+            # executable) — the timed loop must compile nothing
+            for route in [mesh] + list(policy.dp_groups()):
+                qw = jax.device_put(jnp.asarray(parity_queries),
+                                    mesh_lib.query_sharding(route))
+                _, gw = distributed_knn_search(
+                    qw, state.corpus_for(route), k, route,
+                    metric="cosine")
+                gw.block_until_ready()
+            mark = _dispatch_mark()
+            policy.reset()                # clean route counters per row
+
+            def client():
+                for i in range(per_client):
+                    lo = (i * batch) % (256 - batch)
+                    one(queries[lo: lo + batch])
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            disp = _dispatch_delta(mark)
+            s, g, st = one(parity_queries)
+            rows = st.map_ids(np.asarray(g))
+            parity = bool(np.array_equal(rows, i_ref)
+                          and np.asarray(s).tobytes() == s_ref.tobytes())
+            qps = n_clients * per_client * batch / wall
+            results[dp] = (qps, parity, disp)
+            row = {"config": "6_dp_replicated", "dp": dp,
+                   "num_shards": 8 // dp, "qps": round(qps, 1),
+                   "parity_vs_single_device": parity,
+                   "router_dp": policy.stats()["router"]["dp"],
+                   **_compile_noise_label(disp),
+                   "dispatch": disp, **base}
+            print(json.dumps(row), flush=True)
+    finally:
+        policy.reset(full=True)
+    q1, q4 = results[1][0], results[4][0]
+    print(json.dumps({
+        "config": "6_dp_replicated_summary",
+        "qps_dp1": round(q1, 1), "qps_dp2": round(results[2][0], 1),
+        "qps_dp4": round(q4, 1),
+        "speedup_dp4_vs_dp1": round(q4 / max(q1, 1e-9), 2),
+        "gate_dp4_ge_2x_dp1": bool(q4 >= 2.0 * q1),
+        "gate_500qps": bool(q4 >= 500),
+        "parity_all_rows": bool(all(p for _, p, _ in results.values())),
+        "zero_timed_loop_compiles": bool(all(
+            disp["compiles"] == 0 for _, _, disp in results.values())),
+        **base}), flush=True)
+
+
 def main():
     import os
     import sys
     import traceback
+
+    if "--dp-only" in sys.argv:
+        # the simulated-mesh child re-exec (run_dp_replicated)
+        run_dp_replicated()
+        return
 
     if "--sharded-only" in sys.argv:
         # the simulated-mesh child re-exec (run_sharded_fused): emit the
@@ -1503,6 +1674,7 @@ def main():
     guarded(run_device_aggs)
     guarded(run_ingest_while_search)
     guarded(run_sharded_fused)
+    guarded(run_dp_replicated)
 
 
 if __name__ == "__main__":

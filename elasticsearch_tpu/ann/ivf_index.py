@@ -87,7 +87,11 @@ class IVFIndex:
         self.displaced = 0    # rows not in their first-choice partition
         self.spilled = 0      # rows that found no capacity at all
         self._device = None   # lazy IVFPartitions pytree
-        self._device_sharded = None   # lazy (mesh, ShardedIVF) pair
+        # lazy mesh-resident ShardedIVF pytrees, one per mesh the
+        # router dispatches on (with dp > 1 the full serving mesh
+        # AND each dp-group submesh can carry IVF traffic); bounded
+        # by dp + 1 entries, dropped whole on any add()
+        self._device_sharded = {}
 
     # ------------------------------------------------------------- build
 
@@ -183,7 +187,7 @@ class IVFIndex:
                     else:
                         self.spilled += 1
         self._device = None
-        self._device_sharded = None
+        self._device_sharded = {}
 
     def clone(self) -> "IVFIndex":
         """Deep copy of the layout (trained centroids + bucket mirrors,
@@ -207,7 +211,7 @@ class IVFIndex:
         new.displaced = self.displaced
         new.spilled = self.spilled
         new._device = None
-        new._device_sharded = None
+        new._device_sharded = {}
         return new
 
     def add(self, vecs: np.ndarray, rows: np.ndarray) -> None:
@@ -259,14 +263,13 @@ class IVFIndex:
         posting lists split over the shard axis by partition id,
         centroids replicated. Cached per layout generation like the
         single-device pytree; invalidated by any add()."""
-        if (self._device_sharded is not None
-                and self._device_sharded[0] is mesh):
-            return self._device_sharded[1]
+        cached = self._device_sharded.get(mesh)
+        if cached is not None:
+            return cached
         from elasticsearch_tpu.parallel.sharded_ivf import (
             build_sharded_partitions)
         sharded = build_sharded_partitions(self, mesh)
-        self._device_sharded = (mesh, sharded)
-        return sharded
+        return self._device_sharded.setdefault(mesh, sharded)
 
 
 def pick_nlist(n: int, dims: int) -> int:

@@ -130,8 +130,16 @@ class _MultiShardVectorStore:
             row_maps.append(rows + shard.shard_id * SHARD_ROW_SPACE)
         if all(len(b) == 0 for b in blocks):
             return None
-        mesh = mesh_lib.make_mesh(num_shards=n_shards, dp=1)
+        # ONE policy-owned mesh build path (parallel/policy.py): the
+        # shard axis is fixed by the engine shard count, but the dp
+        # setting and device budget apply exactly as they do for the
+        # serving mesh — a `search.mesh.dp` setting can't half-apply
+        from elasticsearch_tpu.parallel import policy as mesh_policy
+        mesh = mesh_policy.mesh_for_shards(n_shards)
+        if mesh is None:
+            return None
         from elasticsearch_tpu.ops import knn as knn_ops
+        from elasticsearch_tpu.parallel import layout
         per = knn_ops.pad_rows(max(max(len(b) for b in blocks), 1))
         d = mapper.dims
         matrix_host = np.zeros((n_shards * per, d), dtype=np.float32)
@@ -146,17 +154,11 @@ class _MultiShardVectorStore:
                 (block * block).sum(axis=-1)
             num_valid[s] = len(block)
         import ml_dtypes
-        matrix = jax.device_put(matrix_host.astype(ml_dtypes.bfloat16),
-                                mesh_lib.corpus_sharding(mesh))
-        corpus = ShardedCorpus(
-            matrix=matrix,
-            sq_norms=jax.device_put(sq_host,
-                                    mesh_lib.per_shard_sharding(mesh)),
-            scales=jax.device_put(
-                np.ones(n_shards * per, dtype=np.float32),
-                mesh_lib.per_shard_sharding(mesh)),
-            num_valid=jax.device_put(num_valid,
-                                     mesh_lib.per_shard_sharding(mesh)))
+        corpus = layout.shard_put(ShardedCorpus(
+            matrix=matrix_host.astype(ml_dtypes.bfloat16),
+            sq_norms=sq_host,
+            scales=np.ones(n_shards * per, dtype=np.float32),
+            num_valid=num_valid), mesh)
         state = {"version": version, "mesh": mesh, "corpus": corpus,
                  "row_maps": row_maps, "per": per, "metric": metric,
                  "n_rows": n_shards * per}
@@ -184,9 +186,18 @@ class _MultiShardVectorStore:
             mask = jax.device_put(
                 jnp.asarray(m),
                 mesh_lib.per_shard_sharding(state["mesh"]))
+        # the full-mesh program splits queries along dp, so a single
+        # query pads up to a dp-divisible bucket (8 covers every pow-2
+        # dp on this host); pad rows slice away below
+        dp = mesh_lib.dp_size(state["mesh"])
+        q_host = np.asarray(query_vector, dtype=np.float32)[None, :]
+        if dp > 1:
+            q_pad = _dispatch.bucket_queries(max(1, dp))
+            q_host = np.concatenate(
+                [q_host, np.zeros((q_pad - 1, q_host.shape[1]),
+                                  dtype=np.float32)])
         q = jax.device_put(
-            jnp.asarray(np.asarray(query_vector,
-                                   dtype=np.float32)[None, :]),
+            jnp.asarray(q_host),
             mesh_lib.query_sharding(state["mesh"]))
         # k rounds up the dispatch ladder so request streams sweeping k
         # reuse one compiled SPMD program per rung (prefixes are exact)
@@ -490,12 +501,13 @@ class Node:
         # only an explicit setting reconfigures it (same clobber rule as
         # warmup above).
         mesh_keys = ("search.mesh.enabled", "search.mesh.num_shards",
-                     "search.mesh.min_rows")
+                     "search.mesh.min_rows", "search.mesh.dp")
         if any(self.settings.get(key) is not None for key in mesh_keys):
             from elasticsearch_tpu.parallel import policy as _mesh_policy
             enabled = self.settings.get("search.mesh.enabled")
             num_shards = self.settings.get("search.mesh.num_shards")
             min_rows = self.settings.get("search.mesh.min_rows")
+            dp = self.settings.get("search.mesh.dp")
             kwargs = {}
             if enabled is not None:
                 kwargs["enabled"] = setting_bool(enabled)
@@ -503,6 +515,8 @@ class Node:
                 kwargs["num_shards"] = int(num_shards)
             if min_rows is not None:
                 kwargs["min_rows"] = int(min_rows)
+            if dp is not None:
+                kwargs["dp"] = int(dp)
             _mesh_policy.configure(**kwargs)
         # set by the server bootstrap after native hardening runs; embedded
         # nodes have no hardening (reference: JNANatives.LOCAL_MLOCKALL)

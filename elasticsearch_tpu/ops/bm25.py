@@ -132,8 +132,11 @@ class LexicalField:
         self._seg_cache: Dict[int, _SegmentPostings] = {}
         self._device = None             # (slots, impacts[, scales]) jnp arrays
         self._device_version: tuple = ()
-        self._device_mesh = None        # mesh-replicated tile mirrors
-        self._device_mesh_key: tuple = ()
+        # mesh-replicated tile mirrors, one entry per mesh the router
+        # dispatches on (full serving mesh + dp-group submeshes when
+        # dp > 1); dropped whole on any corpus version change
+        self._device_mesh: dict = {}
+        self._device_mesh_version: tuple = ()
 
     # ------------------------------------------------------------- build
     def sync(self, reader) -> bool:
@@ -273,24 +276,29 @@ class LexicalField:
         return self._device
 
     def _device_arrays_mesh(self, mesh):
-        """Tile mirrors replicated across the serving mesh (the sharded
-        kernel reads every tile but scatter-adds only its own doc range,
-        so the CSR replicates while the score board shards)."""
-        if (self._device_mesh is not None
-                and self._device_mesh_key[0] == self.version
-                and self._device_mesh_key[1] is mesh):
-            return self._device_mesh
+        """Tile mirrors replicated across `mesh` (the sharded kernel
+        reads every tile but scatter-adds only its own doc range, so the
+        CSR replicates while the score board shards). Cached per mesh —
+        the dp-vs-shard router alternates between the full mesh and its
+        dp groups, and each must keep its mirror resident. The dict
+        holds mesh OBJECTS as keys (not id(mesh)): a GC'd mesh's address
+        can be reused by a differently-shaped one."""
+        if self._device_mesh_version != self.version:
+            self._device_mesh = {}
+            self._device_mesh_version = self.version
+        cached = self._device_mesh.get(mesh)
+        if cached is not None:
+            return cached
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        repl = NamedSharding(mesh, P())
+        from jax.sharding import NamedSharding
+
+        from elasticsearch_tpu.parallel import layout
+        repl = NamedSharding(mesh, layout.replicated_spec())
         slots, impacts, scales = self._device_arrays()
-        self._device_mesh = (
+        arrays = (
             jax.device_put(slots, repl), jax.device_put(impacts, repl),
             None if scales is None else jax.device_put(scales, repl))
-        # hold the mesh OBJECT (identity compare), not id(mesh): a GC'd
-        # mesh's address can be reused by a differently-shaped one
-        self._device_mesh_key = (self.version, mesh)
-        return self._device_mesh
+        return self._device_mesh.setdefault(mesh, arrays)
 
     def plan_queries(self, queries: Sequence[Tuple[Sequence[str], float]]
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -372,10 +380,14 @@ class LexicalField:
             tile_ids, boosts, required)
         slots_d, impacts_d, scales_d = self._device_arrays_mesh(mesh)
         t0 = _time.perf_counter_ns()
-        vals, gslots = dispatch.call(
-            "bm25.mesh_topk", jnp.asarray(tile_ids), jnp.asarray(boosts),
-            jnp.asarray(required.astype(np.int32)), slots_d, impacts_d,
-            scales_d, k=k_b, width=width, mesh=mesh)
+        # launch-guarded enqueue: collective programs sharing devices
+        # must enqueue in one order (parallel/mesh.launch_guard)
+        with mesh_lib.launch_guard(mesh):
+            vals, gslots = dispatch.call(
+                "bm25.mesh_topk", jnp.asarray(tile_ids),
+                jnp.asarray(boosts),
+                jnp.asarray(required.astype(np.int32)), slots_d,
+                impacts_d, scales_d, k=k_b, width=width, mesh=mesh)
         vals = np.asarray(vals)[:, :k_req]
         gslots = np.asarray(gslots)[:, :k_req]
         t1 = _time.perf_counter_ns()
@@ -394,7 +406,9 @@ class LexicalField:
         from elasticsearch_tpu.ops import dispatch
         from elasticsearch_tpu.parallel import policy
 
-        mesh = policy.decide("bm25", self.n_slots)
+        mesh = policy.decide(
+            "bm25", self.n_slots,
+            batch=dispatch.bucket_queries(tile_ids.shape[0]))
         if mesh is not None:
             out = self._score_device_mesh(tile_ids, boosts, required, k,
                                           mesh)
@@ -591,19 +605,25 @@ def _bm25_topk_sharded(tile_ids, boosts, required, tile_slots,
         all_s = jax.lax.all_gather(gslots, mesh_lib.SHARD_AXIS)
         return merge_top_k(all_v, all_s, k)
 
-    repl = jax.sharding.PartitionSpec()
-    r2 = jax.sharding.PartitionSpec(None, None)
-    in_specs = (r2, r2, repl, r2, r2)
+    from elasticsearch_tpu.parallel import layout
+
+    # rule-driven specs (parallel/layout.py): query-side inputs split
+    # over dp (each dp row scores its batch slice against the full
+    # replicated CSR), tiles replicate — the dp axis applies here with
+    # no hand-widened specs
+    q2, q1 = layout.query_spec(2), layout.query_spec(1)
+    repl = layout.replicated_spec()
+    in_specs = (q2, q2, q1, repl, repl)
     if tile_scales is None:
         def run(tids, bsts, req, t_slots, t_impacts):
             return body_shard(tids, bsts, req, t_slots, t_impacts, None)
         fn = shard_map(run, mesh=mesh, in_specs=in_specs,
-                       out_specs=(r2, r2))
+                       out_specs=(q2, q2))
         return fn(tile_ids, boosts, required, tile_slots, tile_impacts)
     # tile_scales is rank-1 [T]: a rank-2 spec would be rejected by
     # shard_map's rank check
     fn = shard_map(body_shard, mesh=mesh,
-                   in_specs=in_specs + (repl,), out_specs=(r2, r2))
+                   in_specs=in_specs + (repl,), out_specs=(q2, q2))
     return fn(tile_ids, boosts, required, tile_slots, tile_impacts,
               tile_scales)
 

@@ -14,11 +14,26 @@ Settings (read once at node boot, `node.py` calls `configure`):
   search.mesh.enabled      true | false | unset (auto: mesh when >1
                            device is visible)
   search.mesh.num_shards   mesh shard-axis size (default: all visible
-                           devices)
+                           devices / dp)
+  search.mesh.dp           data-parallel axis size (default 1; floored
+                           to a power of two). dp > 1 replicates the
+                           sharded corpus across dp device groups so
+                           independent query batches execute
+                           CONCURRENTLY on disjoint groups — the
+                           throughput axis, where more shards is the
+                           latency axis. Replication costs dp× HBM.
   search.mesh.min_rows     corpora below this many rows stay
                            single-device (the all-gather merge + per-leg
                            SPMD overhead only pays for itself once the
                            local matmul dominates; default 32768)
+
+With dp > 1 the router additionally chooses a dp-vs-shard SPLIT per
+dispatch: a batch under queue pressure lands on one dp group (round-
+robin — queued batches overlap on the other groups), an idle batch on a
+large corpus spreads over the full mesh (all devices cooperate, queries
+split along dp). The load signal is the continuous batcher's live
+scheduler state (queued + in-flight dispatches) × corpus size; every
+split decision is counted with its reason in `stats()["router"]["dp"]`.
 
 The policy is process-wide like `ops/dispatch.DISPATCH` — one physical
 mesh serves every index on the node, so per-index state would only
@@ -29,7 +44,6 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional
 
 logger = logging.getLogger(__name__)
 
@@ -39,15 +53,30 @@ logger = logging.getLogger(__name__)
 DEFAULT_MIN_ROWS = 32_768
 
 _lock = threading.Lock()
-_cfg = {"enabled": None, "num_shards": None, "min_rows": DEFAULT_MIN_ROWS}
+_cfg = {"enabled": None, "num_shards": None, "min_rows": DEFAULT_MIN_ROWS,
+        "dp": None}
 _mesh = None          # cached jax Mesh (built lazily)
 _mesh_built = False   # latch: None is a valid cache value (no mesh)
+# dp-group submeshes per FULL mesh, keyed by mesh equality: the dispatch
+# cache keys executables on mesh identity, so the router and the warmup
+# grid must hand out ONE set of group objects per serving mesh
+_groups: dict = {}
+# secondary meshes for consumers whose shard count is fixed by the index
+# (the node.py multi-shard adapter), built through the same path so the
+# dp setting applies everywhere or nowhere — keyed by shard count
+_shard_meshes: dict = {}
+_rr = 0               # round-robin dp-group cursor
 
 _counters = {
     "decisions_mesh": 0,
     "decisions_single_device": 0,
     "searches": {"knn": 0, "ivf": 0, "bm25": 0},
     "reasons": {},            # reason -> count (single-device routes)
+    # dp-vs-shard split of mesh-accepted dispatches (dp > 1 only):
+    # "shard" = full-mesh program, "dp" = one dp-group submesh
+    "dp_routes": {"shard": 0, "dp": 0},
+    "dp_reasons": {},         # split reason -> count
+    "dp_group_dispatches": {},  # group index -> dispatches routed to it
     # per-leg timing: local = the SPMD program (shard-local score + ICI
     # merge, one compiled unit), merge = host-side result shaping
     "legs": {},               # leg -> {local_nanos, merge_nanos,
@@ -58,13 +87,15 @@ _counters = {
 _UNSET = object()
 
 
-def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET) -> None:
+def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET,
+              dp=_UNSET) -> None:
     """Install `search.mesh.*` settings. PARTIAL update: only the
     keyword arguments the caller passes change — a node that sets one
     key must not clobber the others an earlier in-process node
     configured (same rule as the dispatcher's warmup policy). Passing
     None explicitly resets that key to auto/default. Drops the cached
-    mesh so the next dispatch rebuilds against the new config."""
+    mesh (and its dp groups / secondary shard meshes) so the next
+    dispatch rebuilds against the new config."""
     global _mesh, _mesh_built
     with _lock:
         if enabled is not _UNSET:
@@ -75,7 +106,31 @@ def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET) -> None:
         if min_rows is not _UNSET:
             _cfg["min_rows"] = (int(min_rows) if min_rows is not None
                                 else DEFAULT_MIN_ROWS)
+        if dp is not _UNSET:
+            _cfg["dp"] = int(dp) if dp is not None else None
         _mesh, _mesh_built = None, False
+        _groups.clear()
+        _shard_meshes.clear()
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def _effective_dp(n_devices: int) -> int:
+    """Configured dp clamped to the device budget and floored to a power
+    of two (query buckets are pow-2, so only a pow-2 dp divides every
+    full-mesh batch)."""
+    dp = _cfg["dp"] or 1
+    dp = max(1, min(int(dp), max(n_devices, 1)))
+    floored = _pow2_floor(dp)
+    if floored != dp:
+        logger.warning("search.mesh.dp=%d floored to %d (power of two "
+                       "required for bucket divisibility)", dp, floored)
+    return floored
 
 
 def min_rows() -> int:
@@ -83,8 +138,10 @@ def min_rows() -> int:
 
 
 def serving_mesh():
-    """The process-wide (dp=1, shard=S) serving mesh, or None when mesh
-    execution is off (disabled, or fewer than 2 usable devices)."""
+    """The process-wide (dp=R, shard=S) serving mesh, or None when mesh
+    execution is off (disabled, or fewer than 2 usable devices). R comes
+    from `search.mesh.dp` (default 1); S from `search.mesh.num_shards`
+    (default: remaining devices per dp group)."""
     global _mesh, _mesh_built
     with _lock:
         if _mesh_built:
@@ -96,10 +153,14 @@ def serving_mesh():
 
             from elasticsearch_tpu.parallel import mesh as mesh_lib
             n_dev = len(jax.devices())
-            n = _cfg["num_shards"] if _cfg["num_shards"] else n_dev
-            n = min(n, n_dev)
-            if n >= 2:
-                mesh = mesh_lib.make_mesh(num_shards=n, dp=1)
+            dp = _effective_dp(n_dev)
+            n = _cfg["num_shards"] if _cfg["num_shards"] else n_dev // dp
+            n = max(1, min(n, n_dev // dp))
+            # dp groups of a single shard are still a mesh (pure
+            # replication — the throughput-only shape); a 1x1 "mesh" is
+            # just the single device and stays off
+            if dp * n >= 2:
+                mesh = mesh_lib.make_mesh(num_shards=n, dp=dp)
         except Exception:
             # the latch below caches this None for the process lifetime:
             # without a log line a multi-chip node would silently serve
@@ -129,6 +190,68 @@ def num_shards() -> int:
     return mesh.shape[mesh_lib.SHARD_AXIS]
 
 
+def dp_size() -> int:
+    """dp-axis size of the serving mesh (0 = no mesh)."""
+    mesh = serving_mesh()
+    if mesh is None:
+        return 0
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.dp_size(mesh)
+
+
+def dp_groups(mesh=None):
+    """The dp-group submeshes of `mesh` (default: the serving mesh) —
+    ONE canonical tuple per mesh, because the dispatch cache keys
+    executables on mesh identity: the router's group pick and the warmup
+    grid must name the same objects or warmed programs would never be
+    hit. Keyed by mesh equality, so an equal-but-distinct mesh resolves
+    to the same group set."""
+    if mesh is None:
+        mesh = serving_mesh()
+    if mesh is None:
+        return ()
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    with _lock:
+        groups = _groups.get(mesh)
+        if groups is None:
+            groups = (mesh_lib.dp_submeshes(mesh)
+                      if mesh_lib.dp_size(mesh) > 1 else (mesh,))
+            _groups[mesh] = groups
+        return groups
+
+
+def mesh_for_shards(n_shards: int):
+    """One mesh build path for EVERY consumer whose shard count is fixed
+    externally (the node multi-shard adapter maps one engine shard per
+    mesh column) — previously a second hand-rolled `make_mesh(dp=1)`
+    beside the serving mesh, which is exactly how a dp setting
+    half-applies. Returns the serving mesh when its shard axis already
+    matches, else builds (and caches per shard count) a mesh with the
+    configured dp clamped to the device budget; None when `n_shards`
+    devices aren't available."""
+    n_shards = int(n_shards)
+    mesh = serving_mesh()
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    if mesh is not None and mesh_lib.shard_size(mesh) == n_shards:
+        return mesh
+    with _lock:
+        if n_shards in _shard_meshes:
+            return _shard_meshes[n_shards]
+    built = None
+    try:
+        import jax
+        n_dev = len(jax.devices())
+        if n_shards >= 1 and n_shards <= n_dev:
+            dp = min(_effective_dp(n_dev), _pow2_floor(n_dev // n_shards))
+            built = mesh_lib.make_mesh(num_shards=n_shards, dp=max(dp, 1))
+    except Exception:
+        logger.warning("mesh_for_shards(%d) build failed", n_shards,
+                       exc_info=True)
+        built = None
+    with _lock:
+        return _shard_meshes.setdefault(n_shards, built)
+
+
 def eligible(n_rows: int) -> bool:
     """Build-time check (no decision counted): is this corpus one the
     router could ever send to the mesh? Gates the sharded upload at
@@ -137,10 +260,50 @@ def eligible(n_rows: int) -> bool:
             and serving_mesh() is not None)
 
 
-def decide(leg: str, n_rows: int, has_mesh_state: bool = True):
-    """Route one serving dispatch: returns the mesh to execute on, or
-    None for single-device. Counts the decision (the router half of
-    `_nodes/stats indices.mesh`)."""
+def _choose_split(batch, n_rows: int, queue_depth: int, dp: int,
+                  n_shards: int):
+    """dp-vs-shard split for one mesh-accepted dispatch.
+
+    "dp" sends the batch to ONE dp group (S shards, 1/dp of the
+    devices), leaving the other groups free — concurrent batches overlap
+    on disjoint device groups, the throughput shape. "shard" runs the
+    full-mesh program (queries split along dp, corpus along shard) — all
+    devices cooperate on this one batch, the latency shape. Queue depth
+    × corpus size decides: queued work means the other groups will be
+    busy immediately; an idle large corpus wants every shard's slice of
+    the matmul."""
+    if batch is None:
+        # no batch signal (legacy leg — device aggs): its kernels carry
+        # shard-only specs and cache device mirrors against the full
+        # serving mesh, so the full-mesh program is the only safe route
+        return "shard", "no_batch_signal"
+    if batch < dp or batch % dp:
+        # the full-mesh program splits the query batch along dp; a batch
+        # its bucket can't split must take a group (where dp=1 admits
+        # any bucket)
+        return "dp", "batch_below_dp"
+    if queue_depth > 0:
+        return "dp", "queue_pressure"
+    if n_rows < _cfg["min_rows"] * dp:
+        # small corpus: the full-mesh program's S-way fixed costs
+        # outweigh the per-device scan saving vs a group's S/1 shards
+        return "dp", "small_corpus_group"
+    return "shard", "idle_large_corpus"
+
+
+def decide(leg: str, n_rows: int, has_mesh_state: bool = True,
+           batch=None, queue_depth: int = 0):
+    """Route one serving dispatch: returns the mesh to execute on —
+    the full serving mesh, or (dp > 1) one dp-group submesh — or None
+    for single-device. Counts the decision (the router half of
+    `_nodes/stats indices.mesh`).
+
+    `batch` is the dispatch's PADDED query bucket (full-mesh programs
+    split it along dp, so it must divide); `queue_depth` the caller's
+    live load signal — queued + in-flight dispatches beyond this one
+    (the continuous batcher's scheduler state)."""
+    global _rr
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
     mesh = serving_mesh()
     reason = None
     if mesh is None:
@@ -149,15 +312,33 @@ def decide(leg: str, n_rows: int, has_mesh_state: bool = True):
         reason = "no_sharded_corpus"
     elif n_rows < _cfg["min_rows"]:
         reason = "corpus_below_min_rows"
+    split = group_idx = None
+    if reason is None:
+        dp = mesh_lib.dp_size(mesh)
+        if dp > 1:
+            split, split_reason = _choose_split(
+                batch, n_rows, int(queue_depth), dp,
+                mesh_lib.shard_size(mesh))
     with _lock:
         _counters["searches"][leg] = _counters["searches"].get(leg, 0) + 1
-        if reason is None:
-            _counters["decisions_mesh"] += 1
-            return mesh
-        _counters["decisions_single_device"] += 1
-        _counters["reasons"][reason] = \
-            _counters["reasons"].get(reason, 0) + 1
-        return None
+        if reason is not None:
+            _counters["decisions_single_device"] += 1
+            _counters["reasons"][reason] = \
+                _counters["reasons"].get(reason, 0) + 1
+            return None
+        _counters["decisions_mesh"] += 1
+        if split is not None:
+            _counters["dp_routes"][split] += 1
+            _counters["dp_reasons"][split_reason] = \
+                _counters["dp_reasons"].get(split_reason, 0) + 1
+            if split == "dp":
+                group_idx = _rr
+                _rr = (_rr + 1) % mesh_lib.dp_size(mesh)
+                gd = _counters["dp_group_dispatches"]
+                gd[group_idx] = gd.get(group_idx, 0) + 1
+    if group_idx is not None:
+        return dp_groups(mesh)[group_idx]
+    return mesh
 
 
 def reclassify_single(reason: str) -> None:
@@ -202,18 +383,30 @@ def stats() -> dict:
     from elasticsearch_tpu.parallel import mesh as mesh_lib
     mesh = serving_mesh()
     # shard-axis size, not devices.size: the two differ once dp > 1
-    n_shards = (0 if mesh is None
-                else int(mesh.shape[mesh_lib.SHARD_AXIS]))
+    n_shards = 0 if mesh is None else mesh_lib.shard_size(mesh)
+    dp = 0 if mesh is None else mesh_lib.dp_size(mesh)
     with _lock:
         return {
             "available": mesh is not None,
             "num_shards": n_shards,
+            "dp": dp,
+            "devices": {"total": n_shards * dp, "shard_axis": n_shards,
+                        "dp_axis": dp},
             "min_rows": _cfg["min_rows"],
             "router": {
                 "mesh": _counters["decisions_mesh"],
                 "single_device": _counters["decisions_single_device"],
                 "reasons": dict(_counters["reasons"]),
                 "searches": dict(_counters["searches"]),
+                # dp-vs-shard split of mesh-accepted dispatches, with
+                # reasons and the per-group round-robin spread (dp > 1)
+                "dp": {
+                    "routes": dict(_counters["dp_routes"]),
+                    "reasons": dict(_counters["dp_reasons"]),
+                    "group_dispatches": {
+                        str(g): n for g, n in sorted(
+                            _counters["dp_group_dispatches"].items())},
+                },
             },
             "legs": {leg: dict(v)
                      for leg, v in sorted(_counters["legs"].items())},
@@ -223,15 +416,21 @@ def stats() -> dict:
 def reset(full: bool = False) -> None:
     """Zero the counters (tests). full=True also drops the config and the
     cached mesh back to auto defaults."""
-    global _mesh, _mesh_built
+    global _mesh, _mesh_built, _rr
     with _lock:
         _counters["decisions_mesh"] = 0
         _counters["decisions_single_device"] = 0
         _counters["reasons"].clear()
         _counters["legs"].clear()
+        _counters["dp_routes"] = {"shard": 0, "dp": 0}
+        _counters["dp_reasons"].clear()
+        _counters["dp_group_dispatches"].clear()
+        _rr = 0
         for leg in _counters["searches"]:
             _counters["searches"][leg] = 0
         if full:
             _cfg.update({"enabled": None, "num_shards": None,
-                         "min_rows": DEFAULT_MIN_ROWS})
+                         "min_rows": DEFAULT_MIN_ROWS, "dp": None})
             _mesh, _mesh_built = None, False
+            _groups.clear()
+            _shard_meshes.clear()

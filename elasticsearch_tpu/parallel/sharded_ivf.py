@@ -31,11 +31,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.similarity import NEG_INF
+from elasticsearch_tpu.parallel import layout
 from elasticsearch_tpu.parallel import mesh as mesh_lib
 from elasticsearch_tpu.parallel.sharded_knn import shard_map
 
@@ -91,18 +92,17 @@ def build_sharded_partitions(index, mesh: Mesh) -> ShardedIVF:
         out[:nlist] = a
         return out
 
-    repl = NamedSharding(mesh, P())
-    shard0 = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
-    return ShardedIVF(
-        centroids=jax.device_put(
-            index.centroids.astype(np.float32), repl),
-        centroid_sq=jax.device_put(
-            np.einsum("kd,kd->k", index.centroids, index.centroids)
-            .astype(np.float32), repl),
-        parts=jax.device_put(pad(parts_host), shard0),
-        part_scales=jax.device_put(pad(scales_host), shard0),
-        part_sq=jax.device_put(pad(part_sq.astype(np.float32)), shard0),
-        part_rows=jax.device_put(pad(index.part_rows, fill=-1), shard0))
+    # rule-driven upload (parallel/layout.py): centroids replicate
+    # everywhere (routing tables), part_* shard by partition id over the
+    # shard axis and replicate across dp rows
+    return layout.shard_put(ShardedIVF(
+        centroids=index.centroids.astype(np.float32),
+        centroid_sq=np.einsum("kd,kd->k", index.centroids,
+                              index.centroids).astype(np.float32),
+        parts=pad(parts_host),
+        part_scales=pad(scales_host),
+        part_sq=pad(part_sq.astype(np.float32)),
+        part_rows=pad(index.part_rows, fill=-1)), mesh)
 
 
 def _ivf_step(q, cents, cent_sq, parts, pscales, psq, prows, *, k, nprobe,
@@ -164,12 +164,9 @@ def _ivf_step(q, cents, cent_sq, parts, pscales, psq, prows, *, k, nprobe,
 
 def _sharded_ivf_impl(queries, sivf, k, nprobe, mesh,
                       metric=sim.COSINE, precision="bf16"):
-    S = mesh_lib.SHARD_AXIS
-    in_specs = (
-        P(mesh_lib.DP_AXIS, None),
-        ShardedIVF(P(None, None), P(None), P(S, None, None),
-                   P(S, None), P(S, None), P(S, None)))
-    out_specs = (P(mesh_lib.DP_AXIS, None), P(mesh_lib.DP_AXIS, None))
+    # in_specs from the same rule table that laid the pytree out
+    in_specs = (layout.query_spec(2), layout.in_specs_for(sivf))
+    out_specs = (layout.query_spec(2), layout.query_spec(2))
     step = functools.partial(_ivf_step, k=k, nprobe=nprobe, metric=metric,
                              precision=precision)
 
@@ -212,9 +209,13 @@ def sharded_ivf_search(queries: jax.Array, sivf: ShardedIVF, k: int,
     queries: [Q, D] metric-prepped, Q divisible by the dp axis.
     Returns (scores [Q, k], rows [Q, k] flat device-corpus row ids);
     empty slots come back (NEG_INF, -1) — the single-device contract.
+    Enqueue is launch-guarded per device set (collective-ordering
+    safety across concurrent dp-group dispatches).
     """
-    return dispatch.call("mesh.ivf", queries, sivf, k=k, nprobe=nprobe,
-                         mesh=mesh, metric=metric, precision=precision)
+    with mesh_lib.launch_guard(mesh):
+        return dispatch.call("mesh.ivf", queries, sivf, k=k,
+                             nprobe=nprobe, mesh=mesh, metric=metric,
+                             precision=precision)
 
 
 def warmup_entries(index, mesh: Mesh, nprobe: int):
@@ -226,34 +227,41 @@ def warmup_entries(index, mesh: Mesh, nprobe: int):
     the cached upload) a corpus-sized transfer per refresh. The actual
     pytree build stays lazy on the first mesh-routed query, which then
     finds its executable already compiled."""
+    from elasticsearch_tpu.parallel import policy
+
     S = mesh.shape[mesh_lib.SHARD_AXIS]
     nlist, cap, dims = index.part_vecs.shape
     nlist_pad = -(-nlist // S) * S
     part_dtype = {"int8": jnp.int8, "bf16": jnp.bfloat16}.get(
         index.dtype, jnp.float32)
-    repl = NamedSharding(mesh, P())
-    shard0 = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
-    spec = ShardedIVF(
-        jax.ShapeDtypeStruct((nlist, dims), jnp.float32, sharding=repl),
-        jax.ShapeDtypeStruct((nlist,), jnp.float32, sharding=repl),
-        jax.ShapeDtypeStruct((nlist_pad, cap, dims), part_dtype,
-                             sharding=shard0),
-        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32,
-                             sharding=shard0),
-        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32,
-                             sharding=shard0),
-        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.int32,
-                             sharding=shard0))
+    host_like = ShardedIVF(
+        jax.ShapeDtypeStruct((nlist, dims), jnp.float32),
+        jax.ShapeDtypeStruct((nlist,), jnp.float32),
+        jax.ShapeDtypeStruct((nlist_pad, cap, dims), part_dtype),
+        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32),
+        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32),
+        jax.ShapeDtypeStruct((nlist_pad, cap), jnp.int32))
+    # with dp > 1 the router can send an IVF dispatch to the full mesh
+    # or any dp-group submesh — warm all of them (rule-driven specs key
+    # to the executables the live pytree views dispatch with)
+    meshes = [mesh]
+    if mesh_lib.dp_size(mesh) > 1:
+        meshes.extend(policy.dp_groups(mesh))
     entries = []
-    for q in dispatch.WARMUP_QUERY_BUCKETS:
-        qspec = jax.ShapeDtypeStruct(
-            (q, dims), jnp.float32,
-            sharding=mesh_lib.query_sharding(mesh))
-        for kk in dispatch.WARMUP_K_BUCKETS:
-            k_b = dispatch.bucket_k(min(kk, nprobe * cap),
-                                    limit=nprobe * cap)
-            entries.append(("mesh.ivf", (qspec, spec),
-                            {"k": k_b, "nprobe": nprobe, "mesh": mesh,
-                             "metric": index.metric,
-                             "precision": "bf16"}))
+    for m in meshes:
+        spec = layout.shape_specs(host_like, m)
+        m_dp = mesh_lib.dp_size(m)
+        for q in dispatch.WARMUP_QUERY_BUCKETS:
+            if q % m_dp:
+                continue   # the router never full-meshes this bucket
+            qspec = jax.ShapeDtypeStruct(
+                (q, dims), jnp.float32,
+                sharding=mesh_lib.query_sharding(m))
+            for kk in dispatch.WARMUP_K_BUCKETS:
+                k_b = dispatch.bucket_k(min(kk, nprobe * cap),
+                                        limit=nprobe * cap)
+                entries.append(("mesh.ivf", (qspec, spec),
+                                {"k": k_b, "nprobe": nprobe, "mesh": m,
+                                 "metric": index.metric,
+                                 "precision": "bf16"}))
     return entries

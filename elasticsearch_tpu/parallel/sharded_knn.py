@@ -32,6 +32,7 @@ mesh simply spans processes.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple, Optional
 
 import jax
@@ -48,6 +49,7 @@ from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.similarity import NEG_INF
+from elasticsearch_tpu.parallel import layout
 from elasticsearch_tpu.parallel import mesh as mesh_lib
 
 
@@ -144,18 +146,18 @@ def build_sharded_corpus(
 
     if dtype == "int8":
         from elasticsearch_tpu.ops.quantization import quantize_int8_np
-        q, scales_host = quantize_int8_np(matrix_host)
-        matrix = jax.device_put(q, mesh_lib.corpus_sharding(mesh))
+        matrix_host, scales_host = quantize_int8_np(matrix_host)
     else:
         if dtype == "bf16":
             import ml_dtypes
             matrix_host = matrix_host.astype(ml_dtypes.bfloat16)
-        matrix = jax.device_put(matrix_host, mesh_lib.corpus_sharding(mesh))
         scales_host = np.ones(n_shards * per, dtype=np.float32)
-    sq_norms = jax.device_put(sq_host, mesh_lib.per_shard_sharding(mesh))
-    scales = jax.device_put(scales_host, mesh_lib.per_shard_sharding(mesh))
-    nv = jax.device_put(num_valid, mesh_lib.per_shard_sharding(mesh))
-    return ShardedCorpus(matrix, sq_norms, scales, nv), ShardLayout(n_shards, chunk, per)
+    # ONE rule-driven upload for the whole pytree (parallel/layout.py):
+    # rows shard over "shard" and replicate across every dp row, so each
+    # dp group holds a complete copy and group views come for free
+    corpus = layout.shard_put(
+        ShardedCorpus(matrix_host, sq_host, scales_host, num_valid), mesh)
+    return corpus, ShardLayout(n_shards, chunk, per)
 
 
 # ---------------------------------------------------------------------------
@@ -189,10 +191,11 @@ def _knn_step(q, mat, sqn, scl, nvalid, fmask, *, k, metric, precision,
 def _distributed_knn_impl(queries, corpus, filter_mask, k, mesh,
                           metric=sim.COSINE, precision="bf16",
                           block_size=None):
-    corpus_specs = ShardedCorpus(
-        P(mesh_lib.SHARD_AXIS, None), P(mesh_lib.SHARD_AXIS),
-        P(mesh_lib.SHARD_AXIS), P(mesh_lib.SHARD_AXIS))
-    out_specs = (P(mesh_lib.DP_AXIS, None), P(mesh_lib.DP_AXIS, None))
+    # in_specs from the SAME rule table that laid the corpus out
+    # (parallel/layout.py) — specs can't drift from residency, and the
+    # dp axis applies here without widening any hand-built spec
+    corpus_specs = layout.in_specs_for(corpus)
+    out_specs = (layout.query_spec(2), layout.query_spec(2))
     step = functools.partial(_knn_step, k=k, metric=metric,
                              precision=precision, block_size=block_size)
     if filter_mask is None:
@@ -200,16 +203,14 @@ def _distributed_knn_impl(queries, corpus, filter_mask, k, mesh,
             return step(q, mat, sqn, scl, nvalid, None)
         fn = shard_map(
             step_nf, mesh=mesh,
-            in_specs=(P(mesh_lib.DP_AXIS, None),) + tuple(corpus_specs),
+            in_specs=(layout.query_spec(2),) + tuple(corpus_specs),
             out_specs=out_specs)
         return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales,
                   corpus.num_valid)
-    fspec = (P(mesh_lib.SHARD_AXIS) if filter_mask.ndim == 1
-             else P(mesh_lib.DP_AXIS, mesh_lib.SHARD_AXIS))
     fn = shard_map(
         step, mesh=mesh,
-        in_specs=(P(mesh_lib.DP_AXIS, None),) + tuple(corpus_specs)
-        + (fspec,), out_specs=out_specs)
+        in_specs=(layout.query_spec(2),) + tuple(corpus_specs)
+        + (layout.mask_spec(filter_mask.ndim),), out_specs=out_specs)
     return fn(queries, corpus.matrix, corpus.sq_norms, corpus.scales,
               corpus.num_valid, filter_mask)
 
@@ -252,11 +253,15 @@ def distributed_knn_search(
 
     Executes through the shape-bucketed dispatch cache (kernel
     ``mesh.knn``, AOT executables keyed on (mesh, bucket)); calls from
-    inside an enclosing jit (the bench scan harness) inline.
+    inside an enclosing jit (the bench scan harness) inline. The launch
+    guard serializes the ENQUEUE per device set (collective programs
+    that share devices must enqueue in one order) and returns un-synced
+    arrays — dispatches on disjoint dp groups overlap end to end.
     """
-    return dispatch.call("mesh.knn", queries, corpus, filter_mask,
-                         k=k, mesh=mesh, metric=metric,
-                         precision=precision, block_size=block_size)
+    with mesh_lib.launch_guard(mesh):
+        return dispatch.call("mesh.knn", queries, corpus, filter_mask,
+                             k=k, mesh=mesh, metric=metric,
+                             precision=precision, block_size=block_size)
 
 
 # ---------------------------------------------------------------------------
@@ -283,11 +288,11 @@ def _append_impl(matrix, sq_norms, scales, num_valid, new_mat, new_sq,
         scl = scl.at[tgt].set(nscl, mode="drop")
         return mat, sqn, scl, nv + ncnt[0]
 
-    S, SH = mesh_lib.SHARD_AXIS, P(mesh_lib.SHARD_AXIS)
+    r2, r1 = layout.rows_spec(2), layout.rows_spec(1)
     fn = shard_map(
         step, mesh=mesh,
-        in_specs=(P(S, None), SH, SH, SH, P(S, None), SH, SH, SH),
-        out_specs=(P(S, None), SH, SH, SH))
+        in_specs=(r2, r1, r1, r1, r2, r1, r1, r1),
+        out_specs=(r2, r1, r1, r1))
     mat, sqn, scl, nv = fn(matrix, sq_norms, scales, num_valid,
                            new_mat, new_sq, new_scales, new_counts)
     return ShardedCorpus(mat, sqn, scl, nv)
@@ -325,7 +330,8 @@ class ShardedFieldState:
     ``mesh.append``); when headroom runs out the caller rebuilds."""
 
     __slots__ = ("corpus", "layout", "mesh", "metric", "dtype",
-                 "slot_map", "shard_counts", "n_rows")
+                 "slot_map", "shard_counts", "n_rows", "_views",
+                 "_views_lock")
 
     def __init__(self, vectors: np.ndarray, mesh: Mesh, metric: str,
                  dtype: str, min_headroom: Optional[int] = None):
@@ -344,6 +350,8 @@ class ShardedFieldState:
         self.metric = metric
         self.dtype = dtype
         self.n_rows = n
+        self._views = {}
+        self._views_lock = threading.Lock()
         per = self.layout.rows_per_shard
         self.slot_map = np.full(n_shards * per, -1, dtype=np.int64)
         self.shard_counts = np.zeros(n_shards, dtype=np.int64)
@@ -433,10 +441,14 @@ class ShardedFieldState:
                              mesh_lib.per_shard_sharding(self.mesh))
         ncnt = jax.device_put(counts.astype(np.int32),
                               mesh_lib.per_shard_sharding(self.mesh))
-        corpus = dispatch.call(
-            "mesh.append", self.corpus.matrix, self.corpus.sq_norms,
-            self.corpus.scales, self.corpus.num_valid, nm, nsq, nsc, ncnt,
-            mesh=self.mesh)
+        # launch-guarded: the append program shares devices with every
+        # in-flight search on this mesh, and interleaved collective
+        # enqueues can deadlock the device streams
+        with mesh_lib.launch_guard(self.mesh):
+            corpus = dispatch.call(
+                "mesh.append", self.corpus.matrix, self.corpus.sq_norms,
+                self.corpus.scales, self.corpus.num_valid, nm, nsq, nsc,
+                ncnt, mesh=self.mesh)
         new = ShardedFieldState.__new__(ShardedFieldState)
         new.corpus = corpus
         new.layout = self.layout
@@ -446,9 +458,32 @@ class ShardedFieldState:
         new.slot_map = slot_map
         new.shard_counts = self.shard_counts + counts
         new.n_rows = self.n_rows + m_total
+        # fresh (empty) dp-group view cache: every replica view of the
+        # NEW state derives from ITS corpus pytree, so an install can
+        # never leave one dp group serving the pre-append arrays while
+        # another serves the post-append ones
+        new._views = {}
+        new._views_lock = threading.Lock()
         return new
 
     # ---------------------------------------------------------- serving
+    def corpus_for(self, mesh: Mesh) -> ShardedCorpus:
+        """The corpus pytree to dispatch on `mesh`: the resident arrays
+        for the build mesh, a cached dp-group VIEW for one of its
+        submeshes. A view is a rule-driven re-layout (`layout.view_for`)
+        of this state's dp-replicated arrays — the group's devices
+        already hold every shard, so building one is device-side and
+        ~free, and every group reads the SAME immutable snapshot: replica
+        consistency is structural, not synchronized."""
+        if mesh is self.mesh:
+            return self.corpus
+        with self._views_lock:
+            view = self._views.get(mesh)
+            if view is None:
+                view = layout.view_for(self.corpus, mesh)
+                self._views[mesh] = view
+            return view
+
     def filter_mask(self, allowed_flat: np.ndarray) -> np.ndarray:
         """Map a flat-corpus-row bool mask [n_rows] to the device global
         row space [S * per] via the slot map."""
@@ -464,40 +499,45 @@ class ShardedFieldState:
         out[ok] = self.slot_map[global_ids[ok]]
         return out
 
-    def query_sharding(self) -> NamedSharding:
-        return mesh_lib.query_sharding(self.mesh)
+    def query_sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
+        return mesh_lib.query_sharding(mesh if mesh is not None
+                                       else self.mesh)
 
-    def mask_sharding(self, ndim: int) -> NamedSharding:
-        if ndim == 1:
-            return mesh_lib.per_shard_sharding(self.mesh)
-        return NamedSharding(self.mesh,
-                             P(mesh_lib.DP_AXIS, mesh_lib.SHARD_AXIS))
+    def mask_sharding(self, ndim: int,
+                      mesh: Optional[Mesh] = None) -> NamedSharding:
+        mesh = mesh if mesh is not None else self.mesh
+        return NamedSharding(mesh, layout.mask_spec(ndim))
 
     def warmup_entries(self, dims: int):
         """(kernel, arg specs, statics) entries pre-compiling the sharded
         serving grid — mirrors `vectors/store._schedule_warmup` but with
-        mesh-sharded input layouts baked into the AOT specs."""
+        mesh-sharded input layouts baked into the AOT specs. With dp > 1
+        the grid covers BOTH routes the dp-vs-shard router can pick: the
+        full-mesh program (query buckets the dp axis divides) and every
+        dp-group submesh (all interactive buckets), so strict mode stays
+        zero-compile whichever way a dispatch routes."""
         per = self.layout.rows_per_shard
-        corpus_spec = ShardedCorpus(
-            jax.ShapeDtypeStruct(self.corpus.matrix.shape,
-                                 self.corpus.matrix.dtype,
-                                 sharding=mesh_lib.corpus_sharding(self.mesh)),
-            jax.ShapeDtypeStruct(self.corpus.sq_norms.shape, jnp.float32,
-                                 sharding=mesh_lib.per_shard_sharding(self.mesh)),
-            jax.ShapeDtypeStruct(self.corpus.scales.shape, jnp.float32,
-                                 sharding=mesh_lib.per_shard_sharding(self.mesh)),
-            jax.ShapeDtypeStruct(self.corpus.num_valid.shape, jnp.int32,
-                                 sharding=mesh_lib.per_shard_sharding(self.mesh)))
+        from elasticsearch_tpu.parallel import policy
+        meshes = [self.mesh]
+        dp = mesh_lib.dp_size(self.mesh)
+        if dp > 1:
+            meshes.extend(policy.dp_groups(self.mesh))
         entries = []
-        for q in dispatch.WARMUP_QUERY_BUCKETS:
-            qspec = jax.ShapeDtypeStruct(
-                (q, dims), jnp.float32, sharding=self.query_sharding())
-            for k in dispatch.WARMUP_K_BUCKETS:
-                k_b = dispatch.bucket_k(min(k, per), limit=per)
-                entries.append((
-                    "mesh.knn", (qspec, corpus_spec, None),
-                    {"k": k_b, "mesh": self.mesh, "metric": self.metric,
-                     "precision": "bf16", "block_size": None}))
+        for mesh in meshes:
+            corpus_spec = layout.shape_specs(self.corpus, mesh)
+            mesh_dp = mesh_lib.dp_size(mesh)
+            for q in dispatch.WARMUP_QUERY_BUCKETS:
+                if q % mesh_dp:
+                    continue   # the router never full-meshes this bucket
+                qspec = jax.ShapeDtypeStruct(
+                    (q, dims), jnp.float32,
+                    sharding=mesh_lib.query_sharding(mesh))
+                for k in dispatch.WARMUP_K_BUCKETS:
+                    k_b = dispatch.bucket_k(min(k, per), limit=per)
+                    entries.append((
+                        "mesh.knn", (qspec, corpus_spec, None),
+                        {"k": k_b, "mesh": mesh, "metric": self.metric,
+                         "precision": "bf16", "block_size": None}))
         return entries
 
 

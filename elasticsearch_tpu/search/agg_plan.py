@@ -75,6 +75,19 @@ _DATE_HISTO_ALLOWED_KEYS = {"field", "interval", "fixed_interval",
 _RANGE_ALLOWED_KEYS = {"field", "ranges", "keyed"}
 
 
+
+def _mesh_call(name, *args, mesh, **kw):
+    """Launch-guarded mesh dispatch: collective programs that share
+    devices must ENQUEUE in one global order (`parallel/mesh.
+    launch_guard`) — an aggs reduce racing a kNN/BM25 mesh launch on
+    overlapping devices could otherwise deadlock the all-gather
+    rendezvous. Execution stays async; the guard covers only the
+    enqueue."""
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    with mesh_lib.launch_guard(mesh):
+        return dispatch.call(name, *args, mesh=mesh, **kw)
+
+
 class _Fallback(Exception):
     """Bind-time device rejection: run this node on the host instead."""
 
@@ -523,12 +536,12 @@ class AggEngine:
             if mesh is not None:
                 vals_d, pres_d, ords_d = col.device_arrays_mesh(mesh)
                 (mask_d,) = self._sharded(mesh, [mask])
-                counts = dispatch.call("aggs.mesh_ord_counts", ords_d,
+                counts = _mesh_call("aggs.mesh_ord_counts", ords_d,
                                        mask_d, n_buckets=b, mesh=mesh)
                 mboards = {}
                 for mname, (m, mc) in mcols.items():
                     mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
-                    mboards[mname] = dispatch.call(
+                    mboards[mname] = _mesh_call(
                         "aggs.mesh_ord_metric", ords_d, mask_d, mv_d,
                         mp_d, self._mparams(_sub_body(spec, mname)),
                         n_buckets=b, mesh=mesh)
@@ -566,13 +579,13 @@ class AggEngine:
             if mesh is not None:
                 keys_d, kp_d, _ = col.device_arrays_mesh(mesh)
                 (mask_d,) = self._sharded(mesh, [mask])
-                counts = dispatch.call("aggs.mesh_hist_counts", keys_d,
+                counts = _mesh_call("aggs.mesh_hist_counts", keys_d,
                                        kp_d, mask_d, hparams,
                                        n_buckets=b, mesh=mesh)
                 mboards = {}
                 for mname, (m, mc) in mcols.items():
                     mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
-                    mboards[mname] = dispatch.call(
+                    mboards[mname] = _mesh_call(
                         "aggs.mesh_hist_metric", keys_d, kp_d, mask_d,
                         mv_d, mp_d, hparams,
                         self._mparams(_sub_body(spec, mname)),
@@ -601,13 +614,13 @@ class AggEngine:
             if mesh is not None:
                 keys_d, kp_d, _ = col.device_arrays_mesh(mesh)
                 (mask_d,) = self._sharded(mesh, [mask])
-                counts = dispatch.call("aggs.mesh_range_counts", keys_d,
+                counts = _mesh_call("aggs.mesh_range_counts", keys_d,
                                        kp_d, mask_d, bounds, rparams,
                                        mesh=mesh)
                 mboards = {}
                 for mname, (m, mc) in mcols.items():
                     mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
-                    mboards[mname] = dispatch.call(
+                    mboards[mname] = _mesh_call(
                         "aggs.mesh_range_metric", keys_d, kp_d, mask_d,
                         mv_d, mp_d, bounds, rparams,
                         self._mparams(_sub_body(spec, mname)), mesh=mesh)
@@ -635,7 +648,7 @@ class AggEngine:
                              if mesh is not None else col.device_arrays())
             if mesh is not None:
                 (mask_d,) = self._sharded(mesh, [mask])
-                board = dispatch.call("aggs.mesh_ord_metric", zeros,
+                board = _mesh_call("aggs.mesh_ord_metric", zeros,
                                       mask_d, mv_d, mp_d, mparams,
                                       n_buckets=aggs_ops.AGG_B_LADDER[0],
                                       mesh=mesh)

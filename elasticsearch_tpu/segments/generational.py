@@ -164,14 +164,16 @@ class GenerationSet:
         # -------- mesh leg (graduated base; masks map via the slot map)
         if gen.mesh_state is not None:
             from elasticsearch_tpu.parallel import policy as mesh_policy
+            # batch = the already-padded query bucket: with dp > 1 the
+            # policy picks full-mesh vs one dp-group submesh per leg
             mesh = mesh_policy.decide("knn", gen.live_rows,
-                                      has_mesh_state=True)
+                                      has_mesh_state=True, batch=b_pad)
             if mesh is not None:
                 if k_t <= gen.mesh_state.layout.rows_per_shard:
                     return self._mesh_board(gen, off, queries, n_real,
                                             b_pad, k_t, any_filter,
                                             filters, metric, precision,
-                                            knn_stats)
+                                            knn_stats, mesh)
                 mesh_policy.reclassify_single("knn_k_deeper_than_shard")
         # -------- exhaustive leg (un-synced device board)
         k_g = dispatch.bucket_k(min(k_t, n_pad), limit=n_pad)
@@ -216,7 +218,8 @@ class GenerationSet:
         from elasticsearch_tpu.parallel import policy as mesh_policy
 
         k_i = dispatch.bucket_k(min(k_t, gen.n_rows), limit=gen.n_rows)
-        mesh = mesh_policy.decide("ivf", gen.live_rows)
+        mesh = mesh_policy.decide("ivf", gen.live_rows,
+                                  batch=len(queries))
         scores, rows, _phases = gen.router.search(
             queries, k_i, num_candidates=num_candidates, mesh=mesh)
         scores = np.asarray(scores, dtype=np.float32)
@@ -235,18 +238,28 @@ class GenerationSet:
     def _mesh_board(self, gen: Generation, off: int, queries: np.ndarray,
                     n_real: int, b_pad: int, k_t: int, any_filter: bool,
                     filters, metric: str, precision: str,
-                    knn_stats: Optional[dict]):
+                    knn_stats: Optional[dict], mesh):
         """Graduated base served as ONE SPMD program over its sharded
         copy; tombstones and per-query filters map through the slot map.
-        Syncs internally (like the monolithic mesh route)."""
+        `mesh` is the router's pick — the full serving mesh or a
+        dp-group submesh (the group view reads the same immutable
+        snapshot, so every replica serves one corpus version). Syncs
+        internally (like the monolithic mesh route)."""
         import jax
         import jax.numpy as jnp
 
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
         from elasticsearch_tpu.parallel import policy as mesh_policy
         from elasticsearch_tpu.parallel.sharded_knn import (
             distributed_knn_search)
 
         ms = gen.mesh_state
+        if (mesh is not ms.mesh
+                and mesh_lib.shard_size(mesh) != ms.layout.n_shards):
+            # policy reconfigured under this graduated base: its layout
+            # is baked for its own shard count — serve on the state's
+            # mesh until the next graduation rebuilds
+            mesh = ms.mesh
         per = ms.layout.rows_per_shard
         k_b = dispatch.bucket_k(min(k_t, per), limit=per)
         t0 = time.perf_counter_ns()
@@ -260,14 +273,15 @@ class GenerationSet:
                     allow = live if fr is None \
                         else live & np.isin(gen.row_map, fr)
                     m[qi] = ms.filter_mask(allow)
-                mask = jax.device_put(jnp.asarray(m), ms.mask_sharding(2))
+                mask = jax.device_put(jnp.asarray(m),
+                                      ms.mask_sharding(2, mesh))
             else:
                 mask = jax.device_put(jnp.asarray(ms.filter_mask(live)),
-                                      ms.mask_sharding(1))
-        q = jax.device_put(jnp.asarray(queries), ms.query_sharding())
+                                      ms.mask_sharding(1, mesh))
+        q = jax.device_put(jnp.asarray(queries), ms.query_sharding(mesh))
         scores, gids = distributed_knn_search(
-            q, ms.corpus, k_b, ms.mesh, metric=metric, filter_mask=mask,
-            precision=precision)
+            q, ms.corpus_for(mesh), k_b, mesh, metric=metric,
+            filter_mask=mask, precision=precision)
         gids.block_until_ready()
         t1 = time.perf_counter_ns()
         scores = np.asarray(scores, dtype=np.float32)
@@ -277,7 +291,8 @@ class GenerationSet:
             pad = ((0, 0), (0, k_t - k_b))
             scores = np.pad(scores, pad, constant_values=_NEG_INF_F32)
             ids = np.pad(ids, pad, constant_values=-1)
-        gather = mesh_policy.gather_bytes(ms.n_shards, b_pad, k_b)
+        gather = mesh_policy.gather_bytes(mesh_lib.shard_size(mesh),
+                                          b_pad, k_b)
         mesh_policy.record_leg("knn", t1 - t0,
                                time.perf_counter_ns() - t1, gather)
         if knn_stats is not None:

@@ -249,6 +249,22 @@ class CombiningBatcher:
         with self._q_lock:
             return len(self._queue)
 
+    def load(self) -> dict:
+        """Live scheduler snapshot for load-aware routing — what the
+        mesh policy's dp-vs-shard router reads (via the store's
+        `_queued_requests`): queued entries, in-flight batches, and the
+        cumulative pressure counters (`topups`, `overlap_hits`,
+        `queue_wait_nanos`) that say whether this batcher has been
+        running hot. Note the router's queue-depth signal uses
+        `pending` only — in-flight batches are already counted by the
+        store's dispatch gauge."""
+        with self._q_lock:
+            return {"pending": len(self._queue),
+                    "inflight": self._inflight,
+                    "topups": self.sched["topups"],
+                    "overlap_hits": self.sched["overlap_hits"],
+                    "queue_wait_nanos": self.sched["queue_wait_nanos"]}
+
     def _deadline_for(self, now: float) -> Optional[float]:
         """Absolute deadline for a request enqueued at `now`; None means
         it never expires (base batcher has no admission deadline)."""

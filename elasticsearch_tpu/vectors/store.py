@@ -52,6 +52,32 @@ _METRIC_MAP = {
 }
 
 
+class _InflightSlot:
+    """One dispatched-not-finalized batch's entry in the store's
+    in-flight gauge. Handles carry their slot so the gauge can never
+    leak: `finalize_many` releases it on the normal path, and an
+    ABANDONED pending handle (a caller that dispatched several legs and
+    raised before finalizing them all) releases at GC via ``__del__`` —
+    a leaked increment would otherwise bias the dp router toward group
+    routes for the process lifetime. release() is idempotent; GC can't
+    race an explicit release because ``__del__`` only runs once nothing
+    references the handle."""
+
+    __slots__ = ("_store",)
+
+    def __init__(self, store):
+        self._store = store
+
+    def release(self) -> None:
+        store = self._store
+        self._store = None
+        if store is not None:
+            store._end_dispatch()
+
+    def __del__(self):
+        self.release()
+
+
 class FieldCorpus:
     """Device corpus for one vector field + host-side row maps."""
 
@@ -176,6 +202,13 @@ class VectorStoreShard:
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
+        # live dispatch gauge: how many coalesced batches this shard has
+        # in flight (dispatched, not yet finalized). Together with the
+        # batchers' queued entries it is the load signal the mesh
+        # policy's dp-vs-shard router reads — queued work means a dp
+        # group dispatch leaves the other groups free for it
+        self._active_lock = threading.Lock()
+        self._active_dispatches = 0
         # scheduler counters of batchers retired at refresh (sync drops
         # stale (field, k) variants; their history must not vanish from
         # _nodes/stats)
@@ -568,6 +601,29 @@ class VectorStoreShard:
             return sum(b.pending() for key, b in self._batchers.items()
                        if key[0] == field)
 
+    def _begin_dispatch(self) -> int:
+        """Count this dispatch in flight; returns how many OTHERS were
+        already in flight (the dp router's concurrency half of the load
+        signal)."""
+        with self._active_lock:
+            n = self._active_dispatches
+            self._active_dispatches += 1
+            return n
+
+    def _end_dispatch(self) -> None:
+        with self._active_lock:
+            self._active_dispatches = max(0, self._active_dispatches - 1)
+
+    def _queued_requests(self) -> int:
+        """Requests waiting in this shard's batcher queues (the
+        continuous-batching scheduler's live backlog,
+        `CombiningBatcher.load()` — the other half of the dp router's
+        load signal; in-flight batches are already counted by the
+        `_active_dispatches` gauge)."""
+        with self._batchers_lock:
+            return sum(b.load()["pending"]
+                       for b in self._batchers.values())
+
     def _retire_sched(self, batcher: CombiningBatcher) -> None:
         """Fold a dropped batcher's scheduler counters into the retired
         total (caller holds `_batchers_lock`)."""
@@ -677,13 +733,22 @@ class VectorStoreShard:
         device→host transfer of the score/id boards, then the validity
         mask + row-map join. The blocking sync lives HERE, at response-
         assembly time, never inside the dispatch critical section."""
-        kind, payload = handle
+        kind, payload, *rest = handle
         if kind == "done":
             return payload
-        fc, s, i, k_eff, n_valid, n_real = payload
-        scores = np.asarray(s)[:, :k_eff]
-        ids = np.asarray(i)[:, :k_eff]
-        return self._land_results(fc, scores, ids, -1e37, n_valid, n_real)
+        try:
+            if kind == "mesh":
+                return self._finalize_mesh(payload)
+            fc, s, i, k_eff, n_valid, n_real = payload
+            scores = np.asarray(s)[:, :k_eff]
+            ids = np.asarray(i)[:, :k_eff]
+            return self._land_results(fc, scores, ids, -1e37, n_valid,
+                                      n_real)
+        finally:
+            # every pending handle was counted in flight at dispatch;
+            # its slot releases the gauge exactly once
+            for slot in rest:
+                slot.release()
 
     def _execute_batch(self, fc: FieldCorpus, k: int, precision: str,
                        requests, num_candidates: Optional[int] = None
@@ -698,9 +763,29 @@ class VectorStoreShard:
     def _dispatch_many(self, fc: FieldCorpus, k: int, precision: str,
                        requests, num_candidates: Optional[int] = None):
         """Dispatch stage of one coalesced batch: route, build masks, and
-        LAUNCH the device program. The exhaustive device path returns
-        un-synced arrays in the handle; host/IVF/mesh routes complete
-        here (they are host-side or sync internally)."""
+        LAUNCH the device program. The exhaustive device paths (single-
+        device AND mesh) return un-synced arrays in the handle;
+        host/IVF routes complete here (they are host-side or sync
+        internally). Tracks the in-flight gauge the dp router reads."""
+        others = self._begin_dispatch()
+        slot = _InflightSlot(self)
+        try:
+            handle = self._dispatch_many_routed(
+                fc, k, precision, requests, others,
+                num_candidates=num_candidates)
+        except BaseException:
+            slot.release()
+            raise
+        if handle[0] == "done":
+            slot.release()
+            return handle
+        # pending handle: the slot rides along so finalize (or GC of an
+        # abandoned handle) releases the gauge
+        return handle + (slot,)
+
+    def _dispatch_many_routed(self, fc: FieldCorpus, k: int,
+                              precision: str, requests, others: int,
+                              num_candidates: Optional[int] = None):
         import jax.numpy as jnp
 
         if fc.gens is not None:
@@ -743,16 +828,22 @@ class VectorStoreShard:
         # mesh router: a corpus past the policy's row floor with a
         # sharded resident copy serves as ONE SPMD program (shard-local
         # matmul + ICI all-gather merge); everything else takes the
-        # single-device / host paths below. k deeper than a shard slice
+        # single-device / host paths below. With dp > 1 the policy also
+        # picks the dp-vs-shard split from this batch's bucket and the
+        # live load (queued requests + other in-flight dispatches) — a
+        # loaded queue routes to one dp group so concurrent batches
+        # overlap on disjoint device groups. k deeper than a shard slice
         # can't merge losslessly — those requests stay single-device.
         from elasticsearch_tpu.parallel import policy as mesh_policy
         mesh = mesh_policy.decide(
-            "knn", n_valid, has_mesh_state=fc.mesh_state is not None)
+            "knn", n_valid, has_mesh_state=fc.mesh_state is not None,
+            batch=dispatch.bucket_queries(len(requests)),
+            queue_depth=others + self._queued_requests())
         if mesh is not None:
             if k_eff <= fc.mesh_state.layout.rows_per_shard:
-                return ("done",
-                        self._execute_mesh(fc, k_eff, n_valid, queries,
-                                           requests, any_filter, precision))
+                return self._execute_mesh(fc, k_eff, n_valid, queries,
+                                          requests, any_filter,
+                                          precision, mesh)
             mesh_policy.reclassify_single("knn_k_deeper_than_shard")
 
         use_host = (fc.host is not None and precision != "f32"
@@ -837,23 +928,37 @@ class VectorStoreShard:
 
     def _execute_mesh(self, fc: FieldCorpus, k_eff: int, n_valid: int,
                       queries: np.ndarray, requests, any_filter: bool,
-                      precision: str) -> list:
-        """Serve one coalesced exact-kNN batch as ONE SPMD program over
+                      precision: str, mesh):
+        """Launch one coalesced exact-kNN batch as ONE SPMD program over
         the mesh-resident sharded corpus (`parallel/sharded_knn.py`):
         shard-local matmul + top-k, all-gather candidate merge, k-ladder
-        slice-back. Result-identical to the single-device path (the
-        tier-1 mesh suite pins byte parity)."""
+        slice-back at finalize. `mesh` is whatever the dp-vs-shard
+        router picked — the full serving mesh or one dp-group submesh
+        (the corpus view for a group is a free re-layout of the
+        dp-replicated arrays). Returns an UN-SYNCED handle: the device
+        sync lands in `_finalize_mesh` at response-assembly time, so
+        batch N's merge overlaps batch N+1's dispatch — with dp > 1 the
+        overlapping dispatch runs on a DIFFERENT device group, which is
+        the replicated mesh's whole throughput story. Result-identical
+        to the single-device path (the tier-1 mesh suite pins byte
+        parity)."""
         import time as _time
 
         import jax
         import jax.numpy as jnp
 
-        from elasticsearch_tpu.parallel import mesh as mesh_lib
-        from elasticsearch_tpu.parallel import policy as mesh_policy
         from elasticsearch_tpu.parallel.sharded_knn import (
             distributed_knn_search)
 
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+
         ms = fc.mesh_state
+        if (mesh is not ms.mesh
+                and mesh_lib.shard_size(mesh) != ms.layout.n_shards):
+            # the policy was reconfigured under this state (its layout
+            # is baked for its own shard count): serve on the state's
+            # mesh until the next sync rebuilds against the new policy
+            mesh = ms.mesh
         queries = _pad_batch(queries, len(requests))
         b_pad = len(queries)
         per = ms.layout.rows_per_shard
@@ -868,31 +973,51 @@ class VectorStoreShard:
                     m[i] = valid_slots
                 else:
                     m[i] = ms.filter_mask(np.isin(fc.row_map, fr))
-            mask = jax.device_put(jnp.asarray(m), ms.mask_sharding(2))
-        q = jax.device_put(jnp.asarray(queries), ms.query_sharding())
+            mask = jax.device_put(jnp.asarray(m),
+                                  ms.mask_sharding(2, mesh))
+        q = jax.device_put(jnp.asarray(queries), ms.query_sharding(mesh))
         scores, gids = distributed_knn_search(
-            q, ms.corpus, k_b, ms.mesh, metric=fc.metric,
+            q, ms.corpus_for(mesh), k_b, mesh, metric=fc.metric,
             filter_mask=mask, precision=precision)
+        # un-synced boards: the device sync is deferred to finalize
+        dispatch.DISPATCH.note_async()
+        return ("mesh", (fc, ms, mesh, scores, gids, k_eff, k_b, b_pad,
+                         n_valid, len(requests), t0))
+
+    def _finalize_mesh(self, payload) -> list:
+        """Land one mesh dispatch: device sync, k slice-back, slot-map
+        join, and the router/leg accounting."""
+        import time as _time
+
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel import policy as mesh_policy
+
+        (fc, ms, mesh, scores, gids, k_eff, k_b, b_pad, n_valid, n_real,
+         t0) = payload
         gids.block_until_ready()
         t1 = _time.perf_counter_ns()
         scores = np.asarray(scores)[:, :k_eff]
         gids = np.asarray(gids)[:, :k_eff]
         flat = ms.map_ids(gids)
         out = []
-        for qi in range(len(requests)):
+        for qi in range(n_real):
             sc, rid = scores[qi], flat[qi]
             valid = (sc > -1e37) & (rid >= 0) & (rid < n_valid)
             sc, rid = sc[valid], rid[valid]
             out.append((fc.row_map[rid], sc.astype(np.float32)))
         t2 = _time.perf_counter_ns()
-        gather = mesh_policy.gather_bytes(ms.n_shards, b_pad, k_b)
+        n_shards = mesh_lib.shard_size(mesh)
+        gather = mesh_policy.gather_bytes(n_shards, b_pad, k_b)
         mesh_policy.record_leg("knn", t1 - t0, t2 - t1, gather)
         self.knn_stats["mesh_searches"] += 1
         self.knn_stats["score_nanos"] += t1 - t0
         self.knn_stats["merge_nanos"] += t2 - t1
         self.last_knn_phases = {
-            "engine": "tpu_mesh", "mesh_shards": ms.n_shards,
-            "rows_per_shard": per, "collective_bytes": gather,
+            "engine": "tpu_mesh", "mesh_shards": n_shards,
+            "mesh_dp": mesh_lib.dp_size(ms.mesh),
+            "dp_group": mesh is not ms.mesh,
+            "rows_per_shard": ms.layout.rows_per_shard,
+            "collective_bytes": gather,
             "route_nanos": 0, "score_nanos": t1 - t0,
             "merge_nanos": t2 - t1}
         return out
@@ -908,7 +1033,9 @@ class VectorStoreShard:
 
         queries = _pad_batch(queries, n_real)
         k_b = dispatch.bucket_k(k_eff, limit=len(fc.row_map))
-        mesh = mesh_policy.decide("ivf", len(fc.row_map))
+        mesh = mesh_policy.decide("ivf", len(fc.row_map),
+                                  batch=len(queries),
+                                  queue_depth=self._queued_requests())
         scores, rows, phases = fc.router.search(
             queries, k_b, num_candidates=num_candidates, mesh=mesh)
         scores, rows = scores[:, :k_eff], rows[:, :k_eff]

@@ -55,6 +55,22 @@ def mesh_serving():
     policy.reset(full=True)
 
 
+@pytest.fixture
+def mesh_serving_dp():
+    """Replicated-mesh policy: (dp=2, shard=4) over the 8 virtual
+    devices, row floor 1 — the dp > 1 serving grid (test_mesh_serving's
+    dp cases and the strict dp-grid recompile gate)."""
+    from elasticsearch_tpu.parallel import policy
+    policy.reset(full=True)
+    policy.configure(enabled=True, dp=2, num_shards=4, min_rows=1)
+    mesh = policy.serving_mesh()
+    if mesh is None or policy.dp_size() != 2:
+        policy.reset(full=True)
+        pytest.skip("needs 8 jax devices (forced-host-device-count)")
+    yield policy
+    policy.reset(full=True)
+
+
 import contextlib
 import socket
 import subprocess

@@ -439,6 +439,21 @@ class TestRestParity:
         mesh_serving.configure(min_rows=None)   # explicit None = default
         assert mesh_serving.min_rows() == mesh_serving.DEFAULT_MIN_ROWS
 
+    def test_dp_setting_partial_configure(self, mesh_serving):
+        """`search.mesh.dp` follows `policy.configure`'s partial-update
+        semantics: setting dp alone must not clobber the other keys, and
+        explicit None resets it."""
+        mesh_serving.configure(min_rows=2048)
+        mesh_serving.configure(dp=2, num_shards=4)
+        assert mesh_serving.min_rows() == 2048
+        st = mesh_serving.stats()
+        assert st["dp"] == 2 and st["num_shards"] == 4
+        assert st["devices"] == {"total": 8, "shard_axis": 4,
+                                 "dp_axis": 2}
+        mesh_serving.configure(dp=None)   # explicit None = auto (dp=1)
+        assert mesh_serving.stats()["dp"] == 1
+        assert mesh_serving.min_rows() == 2048
+
     def test_knn_k_deeper_than_shard_reclassifies_router_stats(
             self, mesh_serving):
         """A mesh-accepted kNN dispatch that the k-deeper-than-shard
@@ -461,5 +476,283 @@ class TestRestParity:
                 "knn_k_deeper_than_shard", 0) >= 1
             assert st["router"]["mesh"] == 0
             assert store.knn_stats.get("mesh_searches", 0) == 0
+        finally:
+            node.close()
+
+
+# ------------------------------------------------- dp > 1 (replicated)
+
+
+def _oracle(vectors, queries, k):
+    s, i = _single_device_knn(vectors, queries, k)
+    return np.asarray(s), np.asarray(i)
+
+
+class TestDpReplicatedServing:
+    """The (dp=2, shard=4) replicated grid: byte parity on every route,
+    concurrency on disjoint groups, replica-consistent merge
+    graduation, and the strict-mode zero-recompile dp grid."""
+
+    def test_dp_byte_parity_on_ragged_shards(self, mesh_serving_dp):
+        """37 rows over 4 ragged shards, replicated across 2 dp groups:
+        the full-mesh split route and BOTH group routes must be
+        byte-identical to single-device (padding surfaces as (-inf, -1),
+        never an aliased id, on every replica)."""
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+
+        rng = np.random.default_rng(21)
+        vectors = rng.standard_normal((37, 16)).astype(np.float32)
+        queries = rng.standard_normal((8, 16)).astype(np.float32)
+        mesh = mesh_serving_dp.serving_mesh()
+        state = ShardedFieldState(vectors, mesh, "cosine", "f32")
+        s_ref, i_ref = _oracle(vectors, queries, 16)
+        v = s_ref > -1e37
+        from elasticsearch_tpu.parallel.sharded_knn import (
+            distributed_knn_search)
+        for route in (mesh,) + tuple(mesh_serving_dp.dp_groups()):
+            q = jax.device_put(jnp.asarray(queries),
+                               mesh_lib.query_sharding(route))
+            s, g = distributed_knn_search(q, state.corpus_for(route), 16,
+                                          route, metric="cosine",
+                                          precision="f32")
+            rows = state.map_ids(np.asarray(g))
+            s = np.asarray(s)
+            valid = s > -np.inf
+            assert (rows[valid] >= 0).all() and (rows[valid] < 37).all()
+            assert (rows[~valid] == -1).all()
+            assert np.array_equal(rows[valid], i_ref[v])
+            assert s[valid].tobytes() == s_ref[v].tobytes()
+
+    def test_router_split_decisions_and_stats(self, mesh_serving_dp):
+        """queue depth × corpus size drives the dp-vs-shard split, and
+        `stats()` reports routes, reasons, and the round-robin group
+        spread — the satellite's `_nodes/stats indices.mesh` contract."""
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+
+        pol = mesh_serving_dp
+        # batch below dp -> group; queued -> group; idle large -> full
+        m1 = pol.decide("knn", 5000, batch=1)
+        m2 = pol.decide("knn", 5000, batch=8, queue_depth=2)
+        m3 = pol.decide("knn", 5000, batch=8, queue_depth=0)
+        assert mesh_lib.dp_size(m1) == 1
+        assert mesh_lib.dp_size(m2) == 1
+        assert mesh_lib.dp_size(m3) == 2
+        # round-robin: consecutive group picks alternate groups
+        assert m1 is not m2
+        st = pol.stats()
+        assert st["dp"] == 2
+        assert st["devices"]["dp_axis"] == 2
+        dp_st = st["router"]["dp"]
+        assert dp_st["routes"] == {"shard": 1, "dp": 2}
+        assert dp_st["reasons"]["batch_below_dp"] == 1
+        assert dp_st["reasons"]["queue_pressure"] == 1
+        assert dp_st["reasons"]["idle_large_corpus"] == 1
+        assert set(dp_st["group_dispatches"]) == {"0", "1"}
+        # the node stats section passes the dp fields through
+        from elasticsearch_tpu.node import Node
+        assert Node._mesh_stats_section()["dp"] == 2
+
+    def test_concurrent_batches_on_disjoint_dp_groups(
+            self, mesh_serving_dp):
+        """Concurrent dispatches under queue pressure round-robin onto
+        disjoint device groups and every one returns the single-device
+        answer — the scheduling-concurrency contract the dp bench row
+        measures."""
+        import threading
+
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel.sharded_knn import (
+            distributed_knn_search)
+
+        rng = np.random.default_rng(22)
+        vectors = rng.standard_normal((800, 16)).astype(np.float32)
+        mesh = mesh_serving_dp.serving_mesh()
+        state = ShardedFieldState(vectors, mesh, "cosine", "f32")
+        batches = [rng.standard_normal((8, 16)).astype(np.float32)
+                   for _ in range(6)]
+        oracles = [_oracle(vectors, qs, 10) for qs in batches]
+        routes = [mesh_serving_dp.decide("knn", 800, batch=8,
+                                         queue_depth=len(batches))
+                  for _ in batches]
+        assert all(mesh_lib.dp_size(r) == 1 for r in routes)
+        assert len({id(r) for r in routes}) == 2  # both groups used
+        results = [None] * len(batches)
+
+        def run(idx):
+            q = jax.device_put(jnp.asarray(batches[idx]),
+                               mesh_lib.query_sharding(routes[idx]))
+            s, g = distributed_knn_search(
+                q, state.corpus_for(routes[idx]), 10, routes[idx],
+                metric="cosine", precision="f32")
+            results[idx] = (np.asarray(s), state.map_ids(np.asarray(g)))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(batches))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for (s, rows), (s_ref, i_ref) in zip(results, oracles):
+            assert np.array_equal(rows, i_ref)
+            assert s.tobytes() == s_ref.tobytes()
+        spread = mesh_serving_dp.stats()["router"]["dp"][
+            "group_dispatches"]
+        assert len(spread) == 2  # dispatches landed on both groups
+
+    def test_replica_consistent_merge_graduation(self, mesh_serving_dp,
+                                                 monkeypatch):
+        """Generational merge graduation under dp > 1: a search
+        dispatched BEFORE the install keeps one coherent (old) snapshot;
+        after the install every dp replica serves the merged corpus
+        byte-identically — a merge can never leave two groups on
+        different corpus versions."""
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel.sharded_knn import (
+            distributed_knn_search)
+        from elasticsearch_tpu.serving.batcher import CostModel
+
+        monkeypatch.setattr(CostModel, "prefer_host",
+                            staticmethod(lambda *a, **kw: False))
+        node, rng = _make_node(tempfile.mkdtemp(), n=600, seed=23)
+        try:
+            store = node.indices.get("m").shards[0].vector_store
+            old_ms = store.field("v").mesh_state
+            assert old_ms is not None
+            assert old_ms.mesh is mesh_serving_dp.serving_mesh()
+            old_vectors = None  # oracle comes from the engine below
+
+            # ingest a delta and refresh: seals an L0 generation; the
+            # base's sharded copy graduates at MERGE time
+            ops = []
+            for i in range(600, 700):
+                ops.append({"index": {"_index": "m", "_id": str(i)}})
+                ops.append({"body": "x", "tag": "even",
+                            "v": rng.standard_normal(16).tolist()})
+            node.bulk(ops)
+            node.indices.get("m").refresh()
+            gc = store._gens["v"]
+            snap_before = gc.snapshot()       # dispatch-before-install
+            assert len(snap_before.generations) >= 2
+            assert gc.force_merge()           # graduates the new base
+            snap_after = gc.snapshot()
+            base = snap_after.generations[0]
+            assert base.n_rows == 700
+            new_ms = base.mesh_state
+            assert new_ms is not None and new_ms.n_rows == 700
+
+            queries = rng.standard_normal((8, 16)).astype(np.float32)
+            # oracle on the store's own serving dtype (bf16), so replica
+            # boards are byte-comparable to it
+            ref_corpus = knn_ops.build_corpus(
+                np.asarray(base.host_vectors, dtype=np.float32),
+                metric="cosine", dtype="bf16")
+            s_ref, i_ref = knn_ops.knn_search(
+                jnp.asarray(queries), ref_corpus, 10, metric="cosine",
+                precision="bf16")
+            s_ref, i_ref = np.asarray(s_ref), np.asarray(i_ref)
+            boards = []
+            for route in ((new_ms.mesh,)
+                          + tuple(mesh_serving_dp.dp_groups())):
+                q = jax.device_put(jnp.asarray(queries),
+                                   mesh_lib.query_sharding(route))
+                s, g = distributed_knn_search(
+                    q, new_ms.corpus_for(route), 10, route,
+                    metric="cosine", precision="bf16")
+                boards.append((np.asarray(s),
+                               new_ms.map_ids(np.asarray(g))))
+            # every replica view byte-identical to each other AND to the
+            # single-device oracle over the merged host vectors
+            for s, rows in boards:
+                assert np.array_equal(rows, i_ref)
+                assert s.tobytes() == s_ref.tobytes()
+
+            # the pre-install snapshot still serves its own coherent
+            # version: the old base's sharded copy reads valid buffers
+            # (copy-on-write install) and answers for the OLD corpus
+            old_base = snap_before.generations[0]
+            assert old_base.mesh_state is old_ms
+            group0 = mesh_serving_dp.dp_groups()[0]
+            q = jax.device_put(jnp.asarray(queries),
+                               mesh_lib.query_sharding(group0))
+            s_old, g_old = distributed_knn_search(
+                q, old_ms.corpus_for(group0), 10, group0,
+                metric="cosine", precision="bf16")
+            old_ref_corpus = knn_ops.build_corpus(
+                np.asarray(old_base.host_vectors, dtype=np.float32),
+                metric="cosine", dtype="bf16")
+            s_old_ref, i_old_ref = knn_ops.knn_search(
+                jnp.asarray(queries), old_ref_corpus, 10,
+                metric="cosine", precision="bf16")
+            assert np.array_equal(old_ms.map_ids(np.asarray(g_old)),
+                                  np.asarray(i_old_ref))
+            assert np.asarray(s_old).tobytes() == \
+                np.asarray(s_old_ref).tobytes()
+        finally:
+            node.close()
+
+    def test_strict_zero_recompile_second_pass_over_dp_grid(
+            self, mesh_serving_dp):
+        """Warmup covers the full-mesh buckets AND every dp-group
+        submesh; a strict-mode second pass over the whole dp grid (both
+        routes, interactive buckets) must compile nothing."""
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        from elasticsearch_tpu.parallel.sharded_knn import (
+            distributed_knn_search)
+
+        rng = np.random.default_rng(24)
+        vectors = rng.standard_normal((512, 16)).astype(np.float32)
+        mesh = mesh_serving_dp.serving_mesh()
+        state = ShardedFieldState(vectors, mesh, "cosine", "f32")
+        dispatch.DISPATCH.warmup(state.warmup_entries(16),
+                                 background=False)
+        before = dispatch.stats(per_bucket=False)
+        old_strict = dispatch.DISPATCH.strict
+        dispatch.DISPATCH.strict = True
+        try:
+            for route in (mesh,) + tuple(mesh_serving_dp.dp_groups()):
+                for b in (8, 16):
+                    qs = rng.standard_normal((b, 16)).astype(np.float32)
+                    q = jax.device_put(
+                        jnp.asarray(qs),
+                        __import__("elasticsearch_tpu.parallel.mesh",
+                                   fromlist=["query_sharding"])
+                        .query_sharding(route))
+                    distributed_knn_search(q, state.corpus_for(route),
+                                           10, route, metric="cosine",
+                                           precision="bf16")
+        finally:
+            dispatch.DISPATCH.strict = old_strict
+        after = dispatch.stats(per_bucket=False)
+        assert after["compiles"] == before["compiles"]
+        assert after["out_of_grid_compiles"] == \
+            before["out_of_grid_compiles"]
+        assert after["hits"] > before["hits"]
+
+    def test_dp_serving_through_store_parity(self, mesh_serving_dp,
+                                             monkeypatch):
+        """End-to-end through Node.search on the (dp=2, shard=4) mesh:
+        responses byte-identical to the mesh-off single-device path, and
+        the mesh router actually routed (the store feeds batch + live
+        queue depth into the dp split)."""
+        from elasticsearch_tpu.serving.batcher import CostModel
+
+        monkeypatch.setattr(CostModel, "prefer_host",
+                            staticmethod(lambda *a, **kw: False))
+        node, rng = _make_node(tempfile.mkdtemp(), n=800, seed=25)
+        try:
+            qv = rng.standard_normal(16).tolist()
+            body = {"knn": {"field": "v", "query_vector": qv, "k": 10,
+                            "num_candidates": 50}, "size": 10}
+            dp_resp = node.search("m", dict(body))
+            st = mesh_serving_dp.stats()
+            assert st["router"]["mesh"] >= 1
+            assert st["dp"] == 2
+            store = node.indices.get("m").shards[0].vector_store
+            assert store.knn_stats["mesh_searches"] >= 1
+            assert store.last_knn_phases["engine"] == "tpu_mesh"
+            assert store.last_knn_phases["mesh_dp"] == 2
+            mesh_serving_dp.configure(enabled=False)
+            one_resp = node.search("m", dict(body))
+            assert _strip_took(dp_resp) == _strip_took(one_resp)
         finally:
             node.close()

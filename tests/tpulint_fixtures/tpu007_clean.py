@@ -17,3 +17,26 @@ def mesh_scores(mesh):
     fn = shard_map(_kernel, mesh=mesh, in_specs=in_specs,
                    out_specs=P("shard", None))
     return fn(board, scales)
+
+
+def dp_axes_match(devices):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    board = jnp.zeros((8, 128))
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("dp", "shard"))
+    fn = shard_map(_kernel, mesh=mesh,
+                   in_specs=(P("dp", None), P("shard", None)),
+                   out_specs=P("dp", None))
+    return fn(board, board)
+
+
+def unknown_mesh_is_not_judged(mesh):
+    """Axis names can't be checked when the mesh is opaque (a param) —
+    the rule must stay silent rather than guess."""
+    board = jnp.zeros((8, 128))
+    scales = jnp.zeros((128,))
+    fn = shard_map(_kernel, mesh=mesh,
+                   in_specs=(P("anyaxis", None), P(None)),
+                   out_specs=P("anyaxis", None))
+    return fn(board, scales)

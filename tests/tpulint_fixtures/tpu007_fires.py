@@ -24,3 +24,27 @@ def arity_mismatch(mesh):
                    in_specs=(P("shard", None), P(None)),
                    out_specs=P("shard", None))
     return fn(board)  # [expect] 2 in_specs, 1 argument
+
+
+def dp_axis_typo(devices):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    board = jnp.zeros((8, 128))
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("dp", "shard"))
+    fn = shard_map(_kernel, mesh=mesh,
+                   in_specs=(P("pd", None), P("shard", None)),  # [expect]
+                   out_specs=P("dp", None))
+    return fn(board, board)
+
+
+def stale_axis_from_renamed_mesh(devices):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    board = jnp.zeros((8, 128))
+    mesh = Mesh(np.array(devices).reshape(1, 8), ("replica", "rows"))
+    fn = shard_map(_kernel, mesh=mesh,
+                   in_specs=(P("replica", None), P("rows", None)),
+                   out_specs=P("shard", None))  # [expect]
+    return fn(board, board)

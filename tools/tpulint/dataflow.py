@@ -287,6 +287,56 @@ def spec_rank(node: ast.AST) -> Optional[int]:
     return None
 
 
+_MESH_HELPERS = frozenset({"make_mesh", "serving_mesh",
+                           "mesh_for_shards"})
+_REPO_MESH_AXES = frozenset({"dp", "shard"})
+
+
+def mesh_axes_of(node: ast.AST,
+                 mesh_bindings: Dict[str, frozenset]) -> Optional[frozenset]:
+    """Statically-known axis names of a mesh expression: a Name bound to
+    a known mesh earlier in the function, a literal
+    `Mesh(grid, ("dp", "shard"))` construction (positional or
+    `axis_names=`), or one of the repo's policy-owned builders (which
+    always produce the ("dp", "shard") serving mesh). None = unknown."""
+    if isinstance(node, ast.Name):
+        return mesh_bindings.get(node.id)
+    if not isinstance(node, ast.Call):
+        return None
+    leaf = call_name(node).split(".")[-1]
+    if leaf in _MESH_HELPERS:
+        return _REPO_MESH_AXES
+    if leaf == "Mesh":
+        names = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                names = kw.value
+        if isinstance(names, (ast.Tuple, ast.List)) and names.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in names.elts):
+            return frozenset(e.value for e in names.elts)
+    return None
+
+
+def spec_axis_names(node: ast.AST, tuple_bindings: Dict[str, ast.AST]
+                    ) -> List[Tuple[str, ast.AST]]:
+    """(axis name, spec node) pairs for every string axis named inside
+    the P()/PartitionSpec() literals of an in_specs/out_specs
+    expression (axis entries may be strings or tuples of strings)."""
+    if isinstance(node, ast.Name) and node.id in tuple_bindings:
+        node = tuple_bindings[node.id]
+    out: List[Tuple[str, ast.AST]] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and call_name(sub).split(".")[-1] in _SPEC_NAMES:
+            for arg in sub.args:
+                for leaf in ast.walk(arg):
+                    if isinstance(leaf, ast.Constant) \
+                            and isinstance(leaf.value, str):
+                        out.append((leaf.value, sub))
+    return out
+
+
 def spec_ranks(node: ast.AST,
                tuple_bindings: Dict[str, ast.AST]) -> Optional[
                    List[Optional[int]]]:

@@ -22,7 +22,9 @@ from tools.tpulint.dataflow import (
     infer_rank,
     is_dispatch_call,
     iter_functions,
+    mesh_axes_of,
     numpy_aliases,
+    spec_axis_names,
     spec_ranks,
 )
 from tools.tpulint.engine import Finding, ModuleContext, ProjectIndex
@@ -548,6 +550,13 @@ class SpecRankRule(Rule):
     apart. This rule checks exactly that: where both the spec tuple and
     the argument's rank are statically certain, they must agree — and
     the positional arity of the call must match the spec tuple.
+
+    The dp-axis extension (PR 11): where the MESH being mapped over has
+    statically-known axis names (a literal `Mesh(grid, ("dp", "shard"))`
+    or one of the policy-owned builders), every string axis named in
+    in_specs/out_specs must be one of them — the dp-axis TYPO class
+    (`P("pd", None)`, or an axis left over from a renamed mesh), which
+    shard_map only rejects at dispatch time.
     """
 
     rule_id = "TPU007"
@@ -559,11 +568,15 @@ class SpecRankRule(Rule):
             ranks: Dict[str, int] = {}
             tuples: Dict[str, ast.AST] = {}
             sharded: Dict[str, List[Optional[int]]] = {}
+            meshes: Dict[str, frozenset] = {}
             for stmt, _ in _body_statements(fn.body):
                 # judge calls of previously-bound shard_map programs
                 for node in _stmt_expressions(stmt):
                     if not isinstance(node, ast.Call):
                         continue
+                    if self._is_shard_map(node):
+                        findings.extend(self._axis_findings(
+                            ctx, node, meshes, tuples))
                     specs = None
                     label = None
                     if isinstance(node.func, ast.Name) \
@@ -603,6 +616,7 @@ class SpecRankRule(Rule):
                     ranks.pop(tname, None)
                     tuples.pop(tname, None)
                     sharded.pop(tname, None)
+                    meshes.pop(tname, None)
                     value = stmt.value
                     if isinstance(value, (ast.Tuple, ast.List)):
                         tuples[tname] = value
@@ -612,10 +626,39 @@ class SpecRankRule(Rule):
                         if specs is not None:
                             sharded[tname] = specs
                     else:
+                        axes = mesh_axes_of(value, meshes)
+                        if axes is not None:
+                            meshes[tname] = axes
                         r = infer_rank(value, ranks)
                         if r is not None:
                             ranks[tname] = r
         return findings
+
+    def _axis_findings(self, ctx: ModuleContext, node: ast.Call,
+                       meshes: Dict[str, frozenset],
+                       tuples: Dict[str, ast.AST]) -> List[Finding]:
+        """dp-axis typo check at one shard_map construction: every
+        string axis named in in_specs/out_specs must be an axis of the
+        (statically known) mesh being mapped over."""
+        mesh_kw = next((kw.value for kw in node.keywords
+                        if kw.arg == "mesh"), None)
+        axes = (mesh_axes_of(mesh_kw, meshes)
+                if mesh_kw is not None else None)
+        if not axes:
+            return []
+        out: List[Finding] = []
+        for kw in node.keywords:
+            if kw.arg not in ("in_specs", "out_specs"):
+                continue
+            for name, spec_node in spec_axis_names(kw.value, tuples):
+                if name not in axes:
+                    out.append(ctx.finding(
+                        self.rule_id, spec_node,
+                        f"PartitionSpec names axis '{name}' absent from "
+                        f"the mesh being mapped over (axes "
+                        f"{sorted(axes)}) — shard_map raises at "
+                        "dispatch time (the dp-axis typo class)"))
+        return out
 
     @staticmethod
     def _is_shard_map(node: ast.Call) -> bool:
