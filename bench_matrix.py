@@ -555,13 +555,16 @@ def run_north_star_10m_int8(n: int = 10_000_000, emit: bool = True,
     return headline
 
 
-def run_hybrid_rrf():
+def run_hybrid_rrf(mesh=None):
     """Config 3: BM25 + kNN fused with RRF on an MS-MARCO-shaped corpus
     (100k docs, 768-d vectors, zipfian text), end-to-end through
     Node.search. Round 3 served one device round-trip per query (7.2 QPS on
     2k docs); the serving layer now coalesces concurrent requests and
     cost-routes small-corpus kNN to the host VNNI kernel, so this measures
-    both a single-client p50 and a concurrent-client throughput row."""
+    both a single-client p50 and a concurrent-client throughput row.
+    `mesh`: optional `search.mesh.*` node settings — the dp-mesh rerun
+    (run_rest_closed_loop_dp) points the same corpus at a replicated
+    mesh instead of dp=1 shapes."""
     import tempfile
     import threading
 
@@ -580,7 +583,7 @@ def run_hybrid_rrf():
     vocab = np.array([f"tok{i}" for i in range(20_000)])
     zipf = (rng.zipf(1.25, size=n_docs * 12) - 1) % 20_000
 
-    node = Node(tempfile.mkdtemp())
+    node = Node(tempfile.mkdtemp(), settings=mesh)
     node.create_index_with_templates("hybrid", mappings={"properties": {
         "body": {"type": "text"},
         "v": {"type": "dense_vector", "dims": dims}}})
@@ -628,6 +631,7 @@ def run_hybrid_rrf():
                       "p50_ms": round(float(np.percentile(lats, 50)), 2),
                       "p99_ms": round(float(np.percentile(lats, 99)), 2),
                       "n_docs": n_docs, "dims": dims,
+                      **({"mesh": mesh} if mesh else {}),
                       "build_s": round(build_s, 1)}), flush=True)
 
     # concurrent clients: whole hybrid queries coalesce through the
@@ -686,6 +690,7 @@ def run_hybrid_rrf():
                       "concurrent_clients": n_clients,
                       "fused_lists": 2,
                       "execution": "fused_hybrid_plan",
+                      **({"mesh": mesh} if mesh else {}),
                       **hybrid_serving_stats(node),
                       **_compile_noise_label(disp),
                       "dispatch": disp}), flush=True)
@@ -714,7 +719,7 @@ def _inject_vector_segment(shard, field, mat):
 
 
 def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
-                    n_clients: int = 8, per_client: int = 40):
+                    n_clients: int = 8, per_client: int = 40, mesh=None):
     """8-client closed-loop latency through the full serving path
     (Node.search → CombiningBatcher → device/host kernel) for the
     config-1 and config-4 corpus shapes.
@@ -731,7 +736,7 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
     from elasticsearch_tpu.node import Node
 
     rng = np.random.default_rng(17)
-    node = Node(tempfile.mkdtemp())
+    node = Node(tempfile.mkdtemp(), settings=mesh)
     mapping = {"properties": {"v": {"type": "dense_vector", "dims": d}}}
     if dtype == "int8":
         mapping["properties"]["v"]["index_options"] = {"type": "int8_flat"}
@@ -789,15 +794,23 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
     disp = _dispatch_delta(mark)
+    qps = n_clients * per_client / wall
+    extra = {}
+    if mesh:
+        from elasticsearch_tpu.parallel import policy
+        extra["mesh"] = mesh
+        extra["router"] = policy.stats().get("router", {})
     print(json.dumps({
         "config": f"{name}_closed_loop_8c",
-        "qps": round(n_clients * per_client / wall, 1),
+        "qps": round(qps, 1),
         "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
         "p99_over_p50": round(p99 / max(p50, 1e-9), 2),
         "gate_p99_le_3x_p50": bool(p99 <= 3 * p50),
+        "gate_500qps": bool(qps >= 500),
         "n_docs": n, "dims": d, "dtype": dtype,
         "concurrent_clients": n_clients,
         "build_s": round(build_s, 1),
+        **extra,
         **knn_scheduler_stats(node),
         **_compile_noise_label(disp),
         "dispatch": disp}), flush=True)
@@ -1620,10 +1633,271 @@ def _dp_replicated_rows(simulated: bool, n: int = 4096, d: int = 64,
         **base}), flush=True)
 
 
+def run_fanout_node_kill(pre_ms: int = 4_000, post_ms: int = 12_000,
+                         n_docs: int = 240, shards: int = 4,
+                         n_clients: int = 4):
+    """Config 10: kill a node mid-closed-loop during sustained ingest and
+    require p99 and result-completeness to DEGRADE GRACEFULLY rather than
+    cliff (the scenario gate from the ROADMAP's cross-node item).
+
+    Runs a 3-node cluster on the deterministic simulator with the fault-
+    injection transport (testing/faults.py): closed-loop search clients +
+    a steady write ticker, then `kill_node` on a data holder. Latencies
+    are VIRTUAL transport milliseconds (seeded 1-50ms per hop) — the row
+    measures the coordination/fan-out behavior (timers, partial results,
+    ARS rerouting, master eviction), not kernel throughput, and labels
+    itself `virtual_time: true` accordingly.
+
+    Gates:
+      gate_no_hang            every in-flight search completes; the
+                              client loops never stall
+      gate_no_error_cliff     zero error responses — degradation shows
+                              as `timed_out` partials, never exceptions
+      gate_p99_bounded        post-kill p99 <= pre-kill p99 + query
+                              budget + grace + slack (the labeled bound:
+                              a dead node costs at most one budget)
+      gate_completeness_recovers  the final post-kill window serves full
+                              `_shards` coverage again (ARS reroute +
+                              master eviction + replica promotion)
+    """
+    import os as _os
+    import shutil
+    import tempfile
+
+    from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+    from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport)
+    from elasticsearch_tpu.testing.faults import FaultInjectingTransport
+
+    query_budget_ms, grace_ms = 400, 100
+    queue = DeterministicTaskQueue(seed=23)
+    faults = FaultInjectingTransport(DisruptableTransport(queue),
+                                     scheduler=queue)
+    tmp = tempfile.mkdtemp()
+    ids = ["n0", "n1", "n2"]
+    initial = bootstrap_state(ids)
+    # replication budget down from 30s: the bench window is 16s virtual,
+    # and a write stalled on a dead replica must resolve inside it
+    saved_repl = ClusterNode._REPLICATION_BUDGET_MS
+    ClusterNode._REPLICATION_BUDGET_MS = 3_000
+    nodes = {nid: ClusterNode(nid, _os.path.join(tmp, nid), faults, queue,
+                              [p for p in ids if p != nid], initial)
+             for nid in ids}
+    try:
+        for n in nodes.values():
+            n.start()
+        for _ in range(600):
+            queue.run_for(200)
+            masters = [n for n in nodes.values() if n.is_master]
+            if masters and len(masters[0].cluster_state.nodes) == 3:
+                break
+        coord = nodes["n0"]
+
+        def call(fn, *args, **kw):
+            box = {}
+            fn(*args, **kw, on_done=lambda r: box.update(r=r))
+            for _ in range(600):
+                queue.run_for(200)
+                if "r" in box:
+                    return box["r"]
+            raise RuntimeError(f"no response from {fn.__name__}")
+
+        call(coord.client_create_index, "kill",
+             settings={"index.number_of_shards": shards,
+                       "index.number_of_replicas": 1},
+             mappings={"properties": {"title": {"type": "text"},
+                                      "n": {"type": "long"}}})
+
+        def all_started():
+            rs = coord.cluster_state.shards_of("kill")
+            return bool(rs) and all(
+                r.state == ShardRoutingEntry.STARTED for r in rs)
+
+        for _ in range(600):
+            queue.run_for(200)
+            if all_started():
+                break
+        call(coord.client_update_settings,
+             {"search.fanout.query_budget_ms": query_budget_ms,
+              "search.fanout.fetch_budget_ms": query_budget_ms,
+              "search.fanout.deadline_grace_ms": grace_ms})
+        for i in range(n_docs):
+            call(coord.client_write, "kill",
+                 {"type": "index", "id": f"d{i}",
+                  "source": {"title": f"doc {i}", "n": i}})
+        call(coord.client_refresh, "kill")
+
+        # victim: a non-master data holder that is not the coordinator
+        master_id = next(n.node_id for n in nodes.values() if n.is_master)
+        held = {}
+        for r in coord.cluster_state.shards_of("kill"):
+            if r.state == ShardRoutingEntry.STARTED and r.node_id:
+                held.setdefault(r.node_id, 0)
+                held[r.node_id] += 1
+        victim = next(nid for nid in sorted(held)
+                      if nid not in (coord.node_id, master_id))
+
+        # sustained ingest: one write every 40 virtual ms, fire-and-forget
+        ingest = {"sent": 0, "acked": 0}
+
+        def write_tick():
+            i = ingest["sent"]
+            ingest["sent"] += 1
+            coord.client_write(
+                "kill", {"type": "index", "id": f"w{i}",
+                         "source": {"title": f"live {i}", "n": i}},
+                on_done=lambda r: ingest.__setitem__(
+                    "acked", ingest["acked"] + 1),
+                on_failure=lambda e: None)
+            queue.schedule_in(40, write_tick, "bench_ingest")
+
+        # closed-loop search clients: issue, record, immediately re-issue
+        # (t_done_ms, took_ms, ok_shards, total, timed_out, err, client)
+        records = []
+        inflight = {"n": 0}
+
+        def issue(client_id):
+            t0 = queue.now_ms
+            inflight["n"] += 1
+
+            def done(resp):
+                inflight["n"] -= 1
+                err = "error" in resp
+                sh = resp.get("_shards") or {}
+                records.append((queue.now_ms, queue.now_ms - t0,
+                                sh.get("successful", 0),
+                                sh.get("total", shards),
+                                bool(resp.get("timed_out")), err,
+                                client_id))
+                queue.schedule_in(5, lambda: issue(client_id),
+                                  f"bench_client:{client_id}")
+
+            coord.client_search("kill", {"query": {"match_all": {}},
+                                         "size": 10}, done)
+
+        write_tick()
+        for ci in range(n_clients):
+            issue(ci)
+        queue.run_for(pre_ms)
+        kill_at = queue.now_ms
+        pre = [r for r in records]
+        # the kill must hit a node that is actually SERVING: drop the
+        # victim from the coordinator's ARS table so adaptive replica
+        # selection probes it first (unmeasured copies rank ahead) —
+        # otherwise a victim that happened to rank behind its peers at
+        # kill time never sees a query and the degradation gates are
+        # vacuous
+        getattr(coord, "_ars_ewma", {}).pop(victim, None)
+        faults.kill_node(victim)
+        queue.run_for(post_ms)
+        post = [r for r in records if r[0] > kill_at]
+
+        def pct(rows, q):
+            if not rows:
+                return 0.0
+            return float(np.percentile(np.asarray(
+                [r[1] for r in rows], dtype=np.float64), q))
+
+        pre_p50, pre_p99 = pct(pre, 50), pct(pre, 99)
+        post_p50, post_p99 = pct(post, 50), pct(post, 99)
+        completeness = [r[2] / max(r[3], 1) for r in post]
+        final_window = [r[2] / max(r[3], 1) for r in post
+                        if r[0] > kill_at + post_ms - 2_000]
+        errors = sum(1 for r in records if r[5])
+        partials = sum(1 for r in post if r[4])
+        bound_ms = pre_p99 + query_budget_ms + grace_ms + 200
+        row = {
+            "config": "10_fanout_node_kill",
+            "virtual_time": True,
+            "n_docs": n_docs, "shards": shards, "replicas": 1,
+            "n_clients": n_clients, "victim": victim,
+            "searches_pre": len(pre), "searches_post": len(post),
+            "pre_p50_ms": round(pre_p50, 1),
+            "pre_p99_ms": round(pre_p99, 1),
+            "post_p50_ms": round(post_p50, 1),
+            "post_p99_ms": round(post_p99, 1),
+            "p99_bound_ms": round(bound_ms, 1),
+            "timed_out_partials": partials,
+            "error_responses": errors,
+            "completeness_min": round(min(completeness), 3)
+            if completeness else 0.0,
+            "completeness_final_window": round(
+                sum(final_window) / len(final_window), 3)
+            if final_window else 0.0,
+            "ingest_sent": ingest["sent"], "ingest_acked": ingest["acked"],
+            "remote_sheds": {nid: dict(n.fanout_stats.remote)
+                             for nid, n in nodes.items()},
+            # no-hang means EVERY client's loop is still advancing in the
+            # FINAL post-kill window — a single stuck client must fail
+            # the gate even while the other loops keep populating `post`
+            "gate_no_hang": bool(post and all(
+                any(r[6] == ci and r[0] > kill_at + post_ms - 2_000
+                    for r in post)
+                for ci in range(n_clients))),
+            "gate_no_error_cliff": bool(errors == 0),
+            "gate_p99_bounded": bool(post_p99 <= bound_ms),
+            "gate_completeness_recovers": bool(
+                final_window and
+                sum(final_window) / len(final_window) >= 0.999),
+        }
+        row["gate_graceful_degradation"] = bool(
+            row["gate_no_hang"] and row["gate_no_error_cliff"]
+            and row["gate_p99_bounded"]
+            and row["gate_completeness_recovers"] and partials > 0)
+        print(json.dumps(row), flush=True)
+    finally:
+        ClusterNode._REPLICATION_BUDGET_MS = saved_repl
+        for n in nodes.values():
+            try:
+                if not n.coordinator.stopped:
+                    n.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_rest_closed_loop_dp():
+    """PR 11 leftover (b): the REST closed-loop rows (`1cl`/`4cl`,
+    hybrid) served dp=1 shapes — point their corpora at a dp mesh
+    (`search.mesh.dp=4` over 8 devices) and re-record `gate_500qps`
+    end-to-end. Re-exec'd onto 8 virtual devices when needed; those rows
+    measure scheduling concurrency + program shape, not ICI."""
+    _run_on_simulated_mesh("rest_closed_loop_dp", "--rest-dp-only",
+                           _rest_dp_rows, min_devices=8)
+
+
+def _rest_dp_rows(simulated: bool):
+    del simulated
+    import os
+
+    from elasticsearch_tpu.parallel import policy
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    mesh = {"search.mesh.enabled": True, "search.mesh.dp": 4,
+            "search.mesh.min_rows": 1}
+    try:
+        run_hybrid_rrf(mesh=mesh)
+        run_closed_loop("1cl", 100_000 if small else 1_000_000, 128,
+                        dtype="bf16", mesh=mesh)
+        run_closed_loop("4cl", 100_000 if small else 1_000_000, 768,
+                        dtype="int8", mesh=mesh)
+    finally:
+        # the mesh policy is process-wide: a dp row must never leak its
+        # routing into later configs
+        policy.reset(full=True)
+
+
 def main():
     import os
     import sys
     import traceback
+
+    if "--rest-dp-only" in sys.argv:
+        # the simulated-mesh child re-exec (run_rest_closed_loop_dp)
+        _rest_dp_rows(simulated=True)
+        return
 
     if "--dp-only" in sys.argv:
         # the simulated-mesh child re-exec (run_dp_replicated)
@@ -1654,14 +1928,14 @@ def main():
 
     # serving-path rows first: the hybrid fused plan and the 8-client
     # closed-loop tail rows are the record's open questions (VERDICT r5
-    # Next #1/#2); raw-kernel configs follow
-    guarded(run_hybrid_rrf)
-    guarded(run_closed_loop, "1cl", 100_000 if small else 1_000_000, 128,
-            dtype="bf16")
-    # the 10Mx768 corpus can't stage an f32 host copy here (30 GB);
-    # the config-4 SHAPE runs at 1M rows like the e2e row, and says so
-    guarded(run_closed_loop, "4cl", 100_000 if small else 1_000_000, 768,
-            dtype="int8")
+    # Next #1/#2); raw-kernel configs follow. Since PR 12 these rows
+    # serve a dp-mesh corpus (search.mesh.dp=4) instead of dp=1 shapes —
+    # the PR 11 leftover (b) re-measurement (re-exec'd onto 8 virtual
+    # devices when this process sees fewer). The 10Mx768 corpus can't
+    # stage an f32 host copy here (30 GB); the config-4 SHAPE runs at 1M
+    # rows like the e2e row, and says so.
+    guarded(run_rest_closed_loop_dp)
+    guarded(run_fanout_node_kill)
     guarded(run_config, "1_cosine_sift1m", 1_000_000, 128, "cosine",
             "bf16")
     guarded(run_config, "2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16")

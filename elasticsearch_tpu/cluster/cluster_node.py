@@ -47,7 +47,10 @@ from elasticsearch_tpu.common.errors import (
     IllegalArgumentError, IndexNotFoundError, SearchContextMissingError,
     SearchEngineError,
 )
+from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
 from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.serving import fanout as fanout_lib
+from elasticsearch_tpu.serving.fanout import ScatterGather
 from elasticsearch_tpu.index.mapping import MapperService
 from elasticsearch_tpu.index.seqno import ReplicationTracker
 from elasticsearch_tpu.search.service import (
@@ -177,6 +180,10 @@ class ClusterNode:
         self.caches = NodeCaches()
         # observers of every applied cluster state (registry sync, etc.)
         self.state_listeners: List[Callable[[ClusterState], None]] = []
+        # cross-node serving counters (serving/fanout.py): coordinator-side
+        # per-phase fan-out accounting + data-plane remote-shed tallies;
+        # surfaced through `_nodes/stats fanout` and `profile.fanout`
+        self.fanout_stats = fanout_lib.FanoutStats()
         node = DiscoveryNode(node_id, address=address, attributes=attributes)
         # durable gateway: term + last-accepted state survive full-cluster
         # restarts (PersistedClusterStateService/GatewayMetaState analog);
@@ -867,8 +874,8 @@ class ClusterNode:
         if primary.node_id == self.node_id:
             self._on_write_primary(self.node_id, request, on_done)
         else:
-            self.transport.send(self.node_id, primary.node_id, WRITE_PRIMARY,
-                                request, on_response=on_done, on_failure=on_failure)
+            self._send_guarded(primary.node_id, WRITE_PRIMARY, request,
+                               on_done, on_failure, phase="write_forward")
 
     def _on_write_primary(self, sender, request, respond):
         key = (request["index"], request["shard"])
@@ -909,41 +916,88 @@ class ClusterNode:
             respond(response)
             return
 
-        pending = {"count": len(replicas)}
-
-        def one_ack(resp, rep=None):
-            # replica acks carry their local checkpoint: feed the primary's
-            # tracker so the global checkpoint advances (ReplicationTracker
-            # .java:996 updateLocalCheckpoint) — flush-time translog trimming
-            # keys off it via min_retained_seq_no
-            if rep is not None and isinstance(resp, dict) \
+        def one_done(outcome, resp, _err, rep):
+            if outcome == fanout_lib.OK and isinstance(resp, dict) \
                     and "local_checkpoint" in resp:
+                # replica acks carry their local checkpoint: feed the
+                # primary's tracker so the global checkpoint advances
+                # (ReplicationTracker.java:996 updateLocalCheckpoint) —
+                # flush-time translog trimming keys off it via
+                # min_retained_seq_no
                 try:
                     local.tracker.update_local_checkpoint(
                         rep.allocation_id, int(resp["local_checkpoint"]))
                 except Exception:
                     pass
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                respond(response)
+                return
+            if outcome != fanout_lib.OK:
+                # replica failed to apply, or never answered inside the
+                # replication budget (silent partition): ask the master to
+                # fail that copy, then ack (reference: ReplicationOperation
+                # #onPrimaryOperationFailure; the timed-out case is the
+                # unbounded-wait fix — a dropped replica ack must not hang
+                # the client write forever)
+                self._send_to_master(MASTER_SHARD_FAILED,
+                                     {"allocation_id": rep.allocation_id})
 
-        def one_fail(err, rep):
-            # replica failed to apply: ask master to fail that copy, then ack
-            # (reference: ReplicationOperation#onPrimaryOperationFailure path)
-            self._send_to_master(MASTER_SHARD_FAILED,
-                                 {"allocation_id": rep.allocation_id})
-            one_ack(None)
-
+        sg = ScatterGather(self.scheduler, phase="replication",
+                           budget_ms=self._REPLICATION_BUDGET_MS,
+                           stats=self.fanout_stats,
+                           on_done=lambda _s: respond(response))
         replica_req = {"index": request["index"], "shard": request["shard"],
                        "op": op, "seq_no": result.seq_no,
                        "primary_term": result.primary_term,
                        "version": result.version,
                        "global_checkpoint": local.tracker.global_checkpoint}
         for rep in replicas:
-            self.transport.send(self.node_id, rep.node_id, WRITE_REPLICA,
-                                replica_req,
-                                on_response=lambda r, rep=rep: one_ack(r, rep),
-                                on_failure=lambda e, rep=rep: one_fail(e, rep))
+            def send(on_resp, on_fail, rep=rep):
+                self.transport.send(self.node_id, rep.node_id, WRITE_REPLICA,
+                                    replica_req, on_response=on_resp,
+                                    on_failure=on_fail)
+            sg.launch(rep.allocation_id, rep.node_id, send,
+                      on_item=lambda o, r, e, rep=rep: one_done(o, r, e, rep))
+        sg.seal()
+
+    # replication fan-out budget: the backstop for a replica that neither
+    # acks nor fails (silent partition) — the copy is reported failed and
+    # the write acks, instead of hanging the client forever
+    _REPLICATION_BUDGET_MS = 30_000
+
+    def _send_guarded(self, target: str, action: str, request: dict,
+                      on_response, on_failure,
+                      budget_ms: Optional[int] = None,
+                      phase: str = "forward") -> None:
+        """Single-RPC forward with the same no-hang guarantee as the
+        fan-outs: a silently dropped response resolves as a failure after
+        `budget_ms` (a one-item ScatterGather — the write-to-primary and
+        scroll-owner forwards hung forever on a dead target otherwise)."""
+        if budget_ms is None:
+            budget_ms = self._BROADCAST_BUDGET_MS
+
+        def item(outcome, payload, err):
+            if outcome == fanout_lib.OK:
+                on_response(payload)
+            elif on_failure is not None:
+                if err is None:
+                    err = SearchEngineError(
+                        f"[{action}] to [{target}] got no response in "
+                        f"{budget_ms}ms")
+                on_failure(err)
+
+        sg = ScatterGather(self.scheduler, phase=phase,
+                           budget_ms=budget_ms, stats=self.fanout_stats,
+                           on_done=None)
+        sg.launch(action, target,
+                  lambda ok, fail: self.transport.send(
+                      self.node_id, target, action, request,
+                      on_response=ok, on_failure=fail),
+                  on_item=item)
+        sg.seal()
+    # scroll create/fetch and broadcast admin fan-outs share one generous
+    # backstop budget: these are correctness timers (never hang on a dead
+    # node), not latency budgets
+    _SCROLL_BUDGET_MS = 30_000
+    _BROADCAST_BUDGET_MS = 30_000
 
     def _on_write_replica(self, sender, request, respond):
         key = (request["index"], request["shard"])
@@ -1068,10 +1122,18 @@ class ClusterNode:
                     continue
                 targets.append((name, self._select_copy(copies, sid)))
         if not targets:
-            on_done({"hits": {"total": {"value": 0, "relation": "eq"}, "hits": []},
+            # all-red expression: same response CONTRACT as the normal
+            # path (took/timed_out/skipped present, red shards counted
+            # failed) — the old early return omitted half the _shards
+            # object and disagreed in shape with every other response
+            on_done({"took": 0, "timed_out": False,
                      "_shards": {"total": total_shards, "successful": 0,
-                                 "failed": unsearchable}})
+                                 "skipped": 0, "failed": unsearchable},
+                     "hits": {"total": {"value": 0, "relation": "eq"},
+                              "max_score": None, "hits": []}})
             return
+
+        fan = self._fanout_context(body)
 
         # can_match pre-filter round (CanMatchPreFilterSearchPhase.java:57):
         # above the threshold, a lightweight range-vs-field-stats RPC prunes
@@ -1082,16 +1144,59 @@ class ClusterNode:
                 body, targets,
                 lambda kept, skipped: self._query_phase(
                     body, kept, skipped, total_shards, unsearchable,
-                    on_done))
+                    on_done, fan), fan)
         else:
             self._query_phase(body, targets, 0, total_shards,
-                              unsearchable, on_done)
+                              unsearchable, on_done, fan)
 
-    def _can_match_phase(self, body, targets, proceed):
+    def _fanout_context(self, body: dict) -> dict:
+        """Per-request fan-out plan: budgets from the `search.fanout.*`
+        cluster settings, the ABSOLUTE deadline from the request's
+        `timeout` (propagated into every per-shard sub-request so remote
+        admission layers shed on it), and the partial-results policy
+        (`allow_partial_search_results` overrides the cluster default)."""
+        from elasticsearch_tpu.common.settings import (
+            parse_time_value, setting_bool)
+        budgets = fanout_lib.budgets_from_settings(
+            self.cluster_state.settings)
+        started_ms = self.scheduler.now_ms
+        deadline_at_ms = None
+        timeout = body.get("timeout")
+        if timeout not in (None, "", -1, "-1"):
+            t_s = parse_time_value(timeout, "timeout")
+            if t_s > 0:
+                deadline_at_ms = started_ms + int(t_s * 1000)
+        partial = budgets["partial_results"]
+        if body.get("allow_partial_search_results") is not None:
+            partial = setting_bool(body["allow_partial_search_results"])
+        return {"budgets": budgets, "deadline_at_ms": deadline_at_ms,
+                "started_ms": started_ms, "partial": partial,
+                "profile": bool(body.get("profile")), "phases": {}}
+
+    def _phase_budget(self, fan: dict, base_budget_ms: int) -> int:
+        """Per-shard timer budget for the NEXT phase: the configured phase
+        budget, tightened by the request deadline — plus the grace window,
+        so a remote's own deadline shed (cheap, attributed) beats the
+        coordinator's backstop timer for live-but-slow nodes."""
+        if fan["deadline_at_ms"] is None:
+            return int(base_budget_ms)
+        remaining = max(fan["deadline_at_ms"] - self.scheduler.now_ms, 0)
+        return int(min(base_budget_ms,
+                       remaining + fan["budgets"]["deadline_grace_ms"]))
+
+    def _phase_deadline_ms(self, fan: dict, base_budget_ms: int) -> int:
+        """Absolute deadline stamped on this phase's sub-requests: the
+        request's own deadline when it has one, else the phase budget's
+        end — either way every sub-request carries an absolute deadline,
+        so a remote node never does work whose answer nobody will read."""
+        if fan["deadline_at_ms"] is not None:
+            return fan["deadline_at_ms"]
+        return self.scheduler.now_ms + int(base_budget_ms)
+
+    def _can_match_phase(self, body, targets, proceed, fan):
         flags = {}
-        pending = {"count": len(targets)}
 
-        def finish():
+        def finish(_summary):
             kept = [(n, e) for n, e in targets
                     if flags.get((n, e.shard), True)]
             skipped = len(targets) - len(kept)
@@ -1101,30 +1206,40 @@ class ClusterNode:
                 kept, skipped = targets[:1], len(targets) - 1
             proceed(kept, skipped)
 
-        def one(resp, name, entry):
-            if isinstance(resp, dict) and "can_match" in resp:
+        # an unresponsive shard defaults to can_match=True (never prune on
+        # missing evidence), so timeouts here only cost the pruning win
+        sg = ScatterGather(
+            self.scheduler, phase="can_match",
+            budget_ms=self._phase_budget(
+                fan, fan["budgets"]["query_budget_ms"]),
+            stats=self.fanout_stats, on_done=finish)
+
+        def fold(outcome, resp, _err, name, entry):
+            if outcome == fanout_lib.OK and isinstance(resp, dict) \
+                    and "can_match" in resp:
                 flags[(name, entry.shard)] = bool(resp["can_match"])
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                finish()
 
         for name, entry in targets:
             req = {"index": name, "shard": entry.shard, "body": body}
-            if entry.node_id == self.node_id:
-                try:
-                    self._on_can_match_shard(
-                        self.node_id, req,
-                        lambda r, n=name, e=entry: one(r, n, e))
-                except Exception:
-                    one(None, name, entry)
-            else:
-                self.transport.send(
-                    self.node_id, entry.node_id, CAN_MATCH_SHARD, req,
-                    on_response=lambda r, n=name, e=entry: one(r, n, e),
-                    on_failure=lambda _err, n=name, e=entry: one(None, n, e))
+
+            def send(on_resp, on_fail, name=name, entry=entry, req=req):
+                if entry.node_id == self.node_id:
+                    try:
+                        self._on_can_match_shard(self.node_id, req, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(
+                        self.node_id, entry.node_id, CAN_MATCH_SHARD, req,
+                        on_response=on_resp, on_failure=on_fail)
+
+            sg.launch((name, entry.shard), entry.node_id, send,
+                      on_item=lambda o, r, e, n=name, en=entry:
+                      fold(o, r, e, n, en))
+        sg.seal()
 
     def _query_phase(self, body, targets, skipped, num_shards,
-                     unsearchable, on_done):
+                     unsearchable, on_done, fan):
         from elasticsearch_tpu.node import _sort_key_tuple
         from elasticsearch_tpu.search.agg_partials import (
             finalize_aggs, merge_partial_aggs,
@@ -1143,7 +1258,7 @@ class ClusterNode:
         # row, node_id) entries + batched partial-agg buffer
         acc = {"top": [], "agg_buffer": [], "aggs": None, "total": 0,
                "relation": "eq", "max_score": None, "failed": 0,
-               "pending": len(targets), "successful": 0, "skipped": skipped}
+               "successful": 0, "skipped": skipped, "timed_out": False}
 
         def fold_aggs(force=False):
             buf = acc["agg_buffer"]
@@ -1156,9 +1271,15 @@ class ClusterNode:
             acc["aggs"] = merged
             acc["agg_buffer"] = []
 
-        def on_query_resp(resp, name, entry, started_ms):
-            self._ars_observe(entry.node_id,
-                              max(self.scheduler.now_ms - started_ms, 0))
+        def fold(outcome, resp, _err, name, entry):
+            if outcome != fanout_lib.OK:
+                # failed / per-shard timer expired / shed at the remote's
+                # admission layer: the shard contributed nothing — count
+                # it failed, and carry the timeout semantics forward
+                acc["failed"] += 1
+                if outcome in (fanout_lib.TIMED_OUT, fanout_lib.SHED):
+                    acc["timed_out"] = True
+                return
             acc["successful"] += 1
             acc["total"] += resp["total"]
             if resp.get("relation") == "gte":
@@ -1174,45 +1295,66 @@ class ClusterNode:
             if resp.get("aggregations") is not None:
                 acc["agg_buffer"].append(resp["aggregations"])
                 fold_aggs()
-            step()
 
-        def on_query_fail(_err, entry):
-            acc["failed"] += 1
-            step()
+        def query_done(summary):
+            fold_aggs(force=True)
+            fan["phases"]["query"] = summary
+            if not fan["partial"] and (summary["any_timed_out"]
+                                       or acc["failed"] > 0):
+                # allow_partial_search_results=false: a timed-out or
+                # failed shard fails the whole request (reference:
+                # SearchPhaseExecutionException)
+                on_done({"error": {
+                    "type": "search_phase_execution_exception",
+                    "reason": f"{acc['failed']} of {len(targets)} shards "
+                              "failed and partial results are disallowed",
+                    "phase": "query"}, "status": 503})
+                return
+            self._fetch_phase(body, acc, num_shards,
+                              unsearchable, frm, on_done,
+                              finalize_aggs, aggs_spec, fan)
 
-        def step():
-            acc["pending"] -= 1
-            if acc["pending"] == 0:
-                fold_aggs(force=True)
-                self._fetch_phase(body, acc, num_shards,
-                                  unsearchable, frm, on_done,
-                                  finalize_aggs, aggs_spec)
+        budgets = fan["budgets"]
+        sg = ScatterGather(
+            self.scheduler, phase="query",
+            budget_ms=self._phase_budget(fan, budgets["query_budget_ms"]),
+            stats=self.fanout_stats, observe=self._ars_observe,
+            on_done=query_done)
+        deadline_ms = self._phase_deadline_ms(fan,
+                                              budgets["query_budget_ms"])
 
         for name, entry in targets:
-            req = {"index": name, "shard": entry.shard, "body": body}
-            started = self.scheduler.now_ms
-            if entry.node_id == self.node_id:
-                try:
-                    self._on_query_shard(
-                        self.node_id, req,
-                        lambda r, n=name, e=entry, t=started:
-                        on_query_resp(r, n, e, t))
-                except Exception as e:
-                    on_query_fail(e, entry)
-            else:
-                self.transport.send(
-                    self.node_id, entry.node_id, QUERY_SHARD, req,
-                    on_response=lambda r, n=name, e=entry, t=started:
-                    on_query_resp(r, n, e, t),
-                    on_failure=lambda err, e=entry: on_query_fail(err, e))
+            req = fanout_lib.attach_deadline(
+                {"index": name, "shard": entry.shard, "body": body},
+                deadline_ms, self.scheduler.now_ms)
+
+            def send(on_resp, on_fail, entry=entry, req=req):
+                if entry.node_id == self.node_id:
+                    try:
+                        self._on_query_shard(self.node_id, req, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(
+                        self.node_id, entry.node_id, QUERY_SHARD, req,
+                        on_response=on_resp, on_failure=on_fail)
+
+            sg.launch((name, entry.shard), entry.node_id, send,
+                      on_item=lambda o, r, e, n=name, en=entry:
+                      fold(o, r, e, n, en))
+        sg.seal()
 
     def _fetch_phase(self, body, acc, num_shards,
-                     unsearchable, frm, on_done, finalize_aggs, aggs_spec):
+                     unsearchable, frm, on_done, finalize_aggs, aggs_spec,
+                     fan):
         """Second round-trip: materialize _source/highlight for the global
-        window only (FetchSearchPhase.java:47)."""
+        window only (FetchSearchPhase.java:47), under the fetch-phase
+        budget — a dead node can drop hits from the window but never hang
+        the response."""
         window_entries = acc["top"][frm:]
+        partial_fanin = acc["timed_out"] or acc["failed"] > 0
         out = {
-            "took": 0, "timed_out": False,
+            "took": 0, "timed_out": acc["timed_out"],
             # skipped shards count as successful (SearchResponse: skipped
             # is a subset of successful)
             "_shards": {"total": num_shards,
@@ -1220,13 +1362,30 @@ class ClusterNode:
                         "skipped": acc.get("skipped", 0),
                         "failed": acc["failed"] + unsearchable},
             "hits": {"total": {"value": acc["total"],
-                               "relation": acc["relation"]},
+                               # a partial fan-in's total only counts the
+                               # shards that answered: the true total is
+                               # at least this (reference: partial
+                               # responses report a lower bound)
+                               "relation": "gte" if partial_fanin
+                               and acc["successful"] > 0
+                               else acc["relation"]},
                      "max_score": acc["max_score"], "hits": []},
         }
         if acc["aggs"] is not None:
             out["aggregations"] = finalize_aggs(acc["aggs"], aggs_spec)
-        if not window_entries:
+
+        def finish_response():
+            out["took"] = max(self.scheduler.now_ms - fan["started_ms"], 0)
+            if out["timed_out"]:
+                self.fanout_stats.partial_responses += 1
+            if fan["profile"]:
+                from elasticsearch_tpu.search.profile import fanout_profile
+                out.setdefault("profile", {})["fanout"] = \
+                    fanout_profile(fan["phases"])
             on_done(out)
+
+        if not window_entries:
+            finish_response()
             return
 
         # group window rows by (index, shard, node)
@@ -1234,44 +1393,59 @@ class ClusterNode:
         for pos, (score, sv, ishard, row, node_id) in enumerate(window_entries):
             by_shard.setdefault((ishard[0], ishard[1], node_id), []).append(pos)
         hits: List[Optional[dict]] = [None] * len(window_entries)
-        pending = {"count": len(by_shard)}
 
-        def finish():
+        def fetch_done(summary):
+            fan["phases"]["fetch"] = summary
             out["hits"]["hits"] = [h for h in hits if h is not None]
-            on_done(out)
+            finish_response()
 
-        def one_fetch(key, positions):
-            name, shard, node_id = key
-            req = {"index": name, "shard": shard,
-                   "rows": [window_entries[p][3] for p in positions],
-                   "scores": [window_entries[p][0] for p in positions],
-                   "sort_values": [window_entries[p][1] for p in positions],
-                   "body": body}
+        # the request deadline governs QUERY work (the expensive scan);
+        # fetch hydrates the window those shards already won and runs
+        # under its OWN budget — tightening it by an expired request
+        # deadline would shed every hydration and turn partial results
+        # into zero hits, defeating the whole partial-results contract
+        budgets = fan["budgets"]
+        sg = ScatterGather(
+            self.scheduler, phase="fetch",
+            budget_ms=budgets["fetch_budget_ms"],
+            stats=self.fanout_stats, observe=self._ars_observe,
+            on_done=fetch_done)
+        deadline_ms = self.scheduler.now_ms + budgets["fetch_budget_ms"]
 
-            def on_resp(resp, positions=positions):
+        def fold(outcome, resp, _err, positions):
+            if outcome == fanout_lib.OK:
                 for p, hit in zip(positions, resp["hits"]):
                     hits[p] = hit
-                pending["count"] -= 1
-                if pending["count"] == 0:
-                    finish()
-
-            def on_fail(_err):
-                out["_shards"]["failed"] += 1
-                pending["count"] -= 1
-                if pending["count"] == 0:
-                    finish()
-
-            if node_id == self.node_id:
-                try:
-                    self._on_fetch_shard(self.node_id, req, on_resp)
-                except Exception as e:
-                    on_fail(e)
-            else:
-                self.transport.send(self.node_id, node_id, FETCH_SHARD, req,
-                                    on_response=on_resp, on_failure=on_fail)
+                return
+            out["_shards"]["failed"] += 1
+            if outcome in (fanout_lib.TIMED_OUT, fanout_lib.SHED):
+                out["timed_out"] = True
 
         for key, positions in by_shard.items():
-            one_fetch(key, positions)
+            name, shard, node_id = key
+            req = fanout_lib.attach_deadline(
+                {"index": name, "shard": shard,
+                 "rows": [window_entries[p][3] for p in positions],
+                 "scores": [window_entries[p][0] for p in positions],
+                 "sort_values": [window_entries[p][1] for p in positions],
+                 "body": body},
+                deadline_ms, self.scheduler.now_ms)
+
+            def send(on_resp, on_fail, node_id=node_id, req=req):
+                if node_id == self.node_id:
+                    try:
+                        self._on_fetch_shard(self.node_id, req, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(self.node_id, node_id, FETCH_SHARD,
+                                        req, on_response=on_resp,
+                                        on_failure=on_fail)
+
+            sg.launch(key, node_id, send,
+                      on_item=lambda o, r, e, positions=positions:
+                      fold(o, r, e, positions))
+        sg.seal()
 
     def _on_query_shard(self, sender, request, respond):
         """QUERY phase only: (row, score, sort) tuples + partial aggs —
@@ -1284,6 +1458,28 @@ class ClusterNode:
         if local is None:
             raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
         body = request["body"]
+
+        # propagated deadline (serving/fanout.py): the coordinator stamped
+        # this sub-request with the request's ABSOLUTE deadline. Convert
+        # the remaining budget to this process's monotonic clock and hand
+        # it to the execution path — device-work legs feed it into the
+        # continuous batcher's EDF queue, so an overloaded or late shard
+        # sheds at ITS OWN admission layer instead of making the
+        # coordinator time out. An already-expired pure-host request is
+        # shed right here (no batcher to do it).
+        deadline_at = None
+        remaining = fanout_lib.remaining_ms(request, self.scheduler.now_ms)
+        if remaining is not None:
+            has_device_leg = body.get("knn") is not None or (
+                isinstance(body.get("query"), dict)
+                and "knn" in body["query"])
+            if remaining <= 0 and not has_device_leg:
+                self.fanout_stats.remote["sheds_admission"] += 1
+                respond(fanout_lib.shed_response(request["shard"],
+                                                 "admission"))
+                return
+            deadline_at = time.monotonic() + remaining / 1000.0
+
         reader = local.engine.acquire_searcher()
         # shard request cache: whole serialized query-phase responses for
         # size=0 requests, keyed on reader generation (IndicesRequestCache)
@@ -1297,11 +1493,23 @@ class ClusterNode:
         # aggs leave the shard as mergeable partial states (HLL/t-digest/
         # sum-count pairs); the coordinator reduce finalizes them
         # (InternalAggregation.reduce analog)
-        result = execute_query_phase(reader, local.mapper_service, body,
-                                     shard_id=request["shard"],
-                                     vector_store=local.vector_store,
-                                     partial_aggs=True,
-                                     query_cache=self.caches.query)
+        try:
+            result = execute_query_phase(reader, local.mapper_service, body,
+                                         shard_id=request["shard"],
+                                         vector_store=local.vector_store,
+                                         partial_aggs=True,
+                                         query_cache=self.caches.query,
+                                         deadline_at=deadline_at)
+        except EsRejectedExecutionError:
+            # the continuous batcher's EDF queue shed the device leg on
+            # the propagated deadline — exactly the remote-admission shed
+            # the fan-out exists to produce. Answer with the structured
+            # rejection so the coordinator attributes it (deadline, not
+            # node death).
+            self.fanout_stats.remote["sheds_batcher"] += 1
+            respond(fanout_lib.shed_response(request["shard"],
+                                             "batcher_edf"))
+            return
         response = {
             "shard": request["shard"],
             "total": result.total_hits,
@@ -1459,10 +1667,11 @@ class ClusterNode:
             "total": 0, "relation": "eq", "max_score": None,
             "shards": [],  # {node, ctx, pos, buffer, exhausted, failed}
         }
-        pending = {"count": len(targets), "failed": 0}
+        failed_creates = {"n": 0}
 
-        def created(resp, name, entry):
-            if isinstance(resp, dict) and "ctx_id" in resp:
+        def created(outcome, resp, entry):
+            if outcome == fanout_lib.OK and isinstance(resp, dict) \
+                    and "ctx_id" in resp:
                 sstate["total"] += int(resp.get("total", 0))
                 if resp.get("relation") == "gte":
                     sstate["relation"] = "gte"
@@ -1475,28 +1684,34 @@ class ClusterNode:
                     "pos": 0, "buffer": [], "exhausted": False,
                     "failed": False})
             else:
-                pending["failed"] += 1
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                self._client_scrolls[scroll_id] = sstate
-                self._scroll_page(scroll_id, sstate, pending["failed"],
-                                  on_done)
+                failed_creates["n"] += 1
 
+        def creates_done(_summary):
+            self._client_scrolls[scroll_id] = sstate
+            self._scroll_page(scroll_id, sstate, failed_creates["n"],
+                              on_done)
+
+        sg = ScatterGather(self.scheduler, phase="scroll_create",
+                           budget_ms=self._SCROLL_BUDGET_MS,
+                           stats=self.fanout_stats, on_done=creates_done)
         for name, entry in targets:
             req = {"index": name, "shard": entry.shard, "body": body,
                    "keep_alive_s": keep_alive_s}
-            if entry.node_id == self.node_id:
-                try:
-                    self._on_scroll_create(
-                        self.node_id, req,
-                        lambda r, n=name, e=entry: created(r, n, e))
-                except Exception:
-                    created(None, name, entry)
-            else:
-                self.transport.send(
-                    self.node_id, entry.node_id, SCROLL_CREATE, req,
-                    on_response=lambda r, n=name, e=entry: created(r, n, e),
-                    on_failure=lambda _e, n=name, e=entry: created(None, n, e))
+
+            def send(on_resp, on_fail, entry=entry, req=req):
+                if entry.node_id == self.node_id:
+                    try:
+                        self._on_scroll_create(self.node_id, req, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(
+                        self.node_id, entry.node_id, SCROLL_CREATE, req,
+                        on_response=on_resp, on_failure=on_fail)
+
+            sg.launch((name, entry.shard), entry.node_id, send,
+                      on_item=lambda o, r, e, en=entry: created(o, r, en))
+        sg.seal()
 
     def _scroll_page(self, scroll_id: str, sstate: dict, failed: int,
                      on_done: Callable[[dict], None]) -> None:
@@ -1563,10 +1778,9 @@ class ClusterNode:
                               "max_score": sstate["max_score"],
                               "hits": hits}})
             return
-        pending = {"count": len(need)}
-
-        def fetched(resp, sh):
-            if isinstance(resp, dict) and "hits" in resp:
+        def fetched(outcome, resp, sh):
+            if outcome == fanout_lib.OK and isinstance(resp, dict) \
+                    and "hits" in resp:
                 svs = resp.get("sort_values")
                 for i, h in enumerate(resp["hits"]):
                     sh["buffer"].append(
@@ -1577,26 +1791,35 @@ class ClusterNode:
                 if resp.get("exhausted"):
                     sh["exhausted"] = True
             else:
+                # a shard that failed OR never answered inside the budget
+                # stops contributing to the scroll; remaining shards keep
+                # paging (same partial semantics as the search fan-out)
                 sh["failed"] = True
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                self._scroll_page(scroll_id, sstate, failed, on_done)
 
+        sg = ScatterGather(
+            self.scheduler, phase="scroll_fetch",
+            budget_ms=self._SCROLL_BUDGET_MS, stats=self.fanout_stats,
+            on_done=lambda _s: self._scroll_page(scroll_id, sstate,
+                                                 failed, on_done))
         for sh in need:
             req = {"ctx_id": sh["ctx"], "pos": sh["pos"],
                    "count": max(size, 1),
                    "keep_alive_s": sstate["keep_s"]}
-            if sh["node"] == self.node_id:
-                try:
-                    self._on_scroll_fetch(
-                        self.node_id, req, lambda r, s=sh: fetched(r, s))
-                except Exception:
-                    fetched(None, sh)
-            else:
-                self.transport.send(
-                    self.node_id, sh["node"], SCROLL_FETCH, req,
-                    on_response=lambda r, s=sh: fetched(r, s),
-                    on_failure=lambda _e, s=sh: fetched(None, s))
+
+            def send(on_resp, on_fail, sh=sh, req=req):
+                if sh["node"] == self.node_id:
+                    try:
+                        self._on_scroll_fetch(self.node_id, req, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(
+                        self.node_id, sh["node"], SCROLL_FETCH, req,
+                        on_response=on_resp, on_failure=on_fail)
+
+            sg.launch(sh["ctx"], sh["node"], send,
+                      on_item=lambda o, r, e, s=sh: fetched(o, r, s))
+        sg.seal()
 
     def _scroll_owner(self, scroll_id: str) -> Optional[str]:
         owner = scroll_id.split("~", 1)[0] if "~" in scroll_id else None
@@ -1610,13 +1833,14 @@ class ClusterNode:
                            on_done: Callable[[dict], None]) -> None:
         owner = self._scroll_owner(scroll_id)
         if owner:
-            self.transport.send(
-                self.node_id, owner, SCROLL_NEXT,
+            self._send_guarded(
+                owner, SCROLL_NEXT,
                 {"scroll_id": scroll_id, "keep_alive_s": keep_alive_s},
-                on_response=on_done,
-                on_failure=lambda e: on_done({"error": {
+                on_done,
+                lambda e: on_done({"error": {
                     "type": "search_context_missing_exception",
-                    "reason": str(e)}, "status": 404}))
+                    "reason": str(e)}, "status": 404}),
+                phase="scroll_forward")
             return
         sstate = self._client_scrolls.get(scroll_id)
         if sstate is None or sstate["expiry"] < time.time():
@@ -1653,61 +1877,78 @@ class ClusterNode:
         """Broadcast _all scroll clearing to every node (any node may be
         coordinating scrolls the client started elsewhere)."""
         nodes = sorted(self.cluster_state.nodes) or [self.node_id]
-        pending = {"count": len(nodes), "freed": 0}
+        freed = {"n": 0}
 
-        def one(resp):
-            pending["freed"] += int((resp or {}).get("num_freed", 0))
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                on_done({"succeeded": True, "num_freed": pending["freed"]})
+        def one(outcome, resp, _err):
+            if outcome == fanout_lib.OK:
+                freed["n"] += int((resp or {}).get("num_freed", 0))
 
+        sg = ScatterGather(
+            self.scheduler, phase="scroll_clear",
+            budget_ms=self._BROADCAST_BUDGET_MS, stats=self.fanout_stats,
+            on_done=lambda _s: on_done({"succeeded": True,
+                                        "num_freed": freed["n"]}))
         for nid in nodes:
-            if nid == self.node_id:
-                self._on_scroll_clear_all(self.node_id, {}, one)
-            else:
-                self.transport.send(
-                    self.node_id, nid, SCROLL_CLEAR_ALL, {},
-                    on_response=one, on_failure=lambda _e: one(None))
+            def send(on_resp, on_fail, nid=nid):
+                if nid == self.node_id:
+                    try:
+                        self._on_scroll_clear_all(self.node_id, {}, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(
+                        self.node_id, nid, SCROLL_CLEAR_ALL, {},
+                        on_response=on_resp, on_failure=on_fail)
+
+            sg.launch(nid, nid, send, on_item=one)
+        sg.seal()
 
     def client_scroll_clear(self, scroll_id: str,
                             on_done: Callable[[dict], None]) -> None:
         owner = self._scroll_owner(scroll_id)
         if owner:
-            self.transport.send(
-                self.node_id, owner, SCROLL_CLEAR,
-                {"scroll_id": scroll_id},
-                on_response=on_done,
-                on_failure=lambda e: on_done({"succeeded": True,
-                                              "num_freed": 0}))
+            self._send_guarded(
+                owner, SCROLL_CLEAR, {"scroll_id": scroll_id},
+                on_done,
+                lambda e: on_done({"succeeded": True, "num_freed": 0}),
+                phase="scroll_forward")
             return
         sstate = self._client_scrolls.pop(scroll_id, None)
         if sstate is None:
             on_done({"succeeded": True, "num_freed": 0})
             return
         shards = [sh for sh in sstate["shards"] if not sh["failed"]]
-        pending = {"count": len(shards), "freed": 0}
         if not shards:
             on_done({"succeeded": True, "num_freed": 0})
             return
+        freed = {"n": 0}
 
-        def freed(resp):
-            if isinstance(resp, dict) and resp.get("freed"):
-                pending["freed"] += 1
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                on_done({"succeeded": True, "num_freed": pending["freed"]})
+        def one(outcome, resp, _err):
+            if outcome == fanout_lib.OK and isinstance(resp, dict) \
+                    and resp.get("freed"):
+                freed["n"] += 1
 
+        sg = ScatterGather(
+            self.scheduler, phase="scroll_clear",
+            budget_ms=self._BROADCAST_BUDGET_MS, stats=self.fanout_stats,
+            on_done=lambda _s: on_done({"succeeded": True,
+                                        "num_freed": freed["n"]}))
         for sh in shards:
             req = {"ctx_id": sh["ctx"]}
-            if sh["node"] == self.node_id:
-                try:
-                    self._on_scroll_free(self.node_id, req, freed)
-                except Exception:
-                    freed(None)
-            else:
-                self.transport.send(
-                    self.node_id, sh["node"], SCROLL_FREE, req,
-                    on_response=freed, on_failure=lambda _e: freed(None))
+
+            def send(on_resp, on_fail, sh=sh, req=req):
+                if sh["node"] == self.node_id:
+                    try:
+                        self._on_scroll_free(self.node_id, req, on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(
+                        self.node_id, sh["node"], SCROLL_FREE, req,
+                        on_response=on_resp, on_failure=on_fail)
+
+            sg.launch(sh["ctx"], sh["node"], send, on_item=one)
+        sg.seal()
 
     def _on_fetch_shard(self, sender, request, respond):
         """FETCH phase: materialize hits for the coordinator's global
@@ -1720,6 +1961,13 @@ class ClusterNode:
         local = self.local_shards.get(key)
         if local is None:
             raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        # propagated-deadline admission: a fetch arriving past the
+        # request's deadline hydrates hits nobody will read — shed it
+        remaining = fanout_lib.remaining_ms(request, self.scheduler.now_ms)
+        if remaining is not None and remaining <= 0:
+            self.fanout_stats.remote["sheds_admission"] += 1
+            respond(fanout_lib.shed_response(request["shard"], "admission"))
+            return
         body = request["body"]
         reader = local.engine.acquire_searcher()
         svs = request.get("sort_values")
@@ -1755,9 +2003,11 @@ class ClusterNode:
         if primary.node_id == self.node_id:
             self._on_get(self.node_id, request, on_done)
         else:
-            self.transport.send(self.node_id, primary.node_id,
-                                "indices:data/read/get", request,
-                                on_response=on_done)
+            self._send_guarded(primary.node_id, "indices:data/read/get",
+                               request, on_done,
+                               lambda e: on_done({"found": False,
+                                                  "error": str(e)}),
+                               phase="get_forward")
 
     def _on_get(self, sender, request, respond):
         local = self.local_shards.get((request["index"], request["shard"]))
@@ -1961,32 +2211,35 @@ class ClusterNode:
         targets = sorted({n for n in state.nodes})
         if not targets:
             targets = [self.node_id]
-        pending = {"count": len(targets), "ok": 0, "failed": 0}
+        counts = {"ok": 0, "failed": 0}
 
-        def finish():
-            on_done({"_shards": {"total": len(targets),
-                                 "successful": pending["ok"],
-                                 "failed": pending["failed"]}})
+        def one(outcome, _resp, _err):
+            # an unreachable or unresponsive node means its shards were
+            # NOT refreshed — the response must say so, not claim success
+            # (RefreshAction reports per-shard failures)
+            counts["ok" if outcome == fanout_lib.OK else "failed"] += 1
 
-        def one_ok(_resp=None):
-            pending["ok"] += 1
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                finish()
-
-        def one_fail(_err=None):
-            # an unreachable node means its shards were NOT refreshed — the
-            # response must say so, not claim success (RefreshAction reports
-            # per-shard failures)
-            pending["failed"] += 1
-            pending["count"] -= 1
-            if pending["count"] == 0:
-                finish()
-
+        sg = ScatterGather(
+            self.scheduler, phase="refresh",
+            budget_ms=self._BROADCAST_BUDGET_MS, stats=self.fanout_stats,
+            on_done=lambda _s: on_done(
+                {"_shards": {"total": len(targets),
+                             "successful": counts["ok"],
+                             "failed": counts["failed"]}}))
         for t in targets:
-            if t == self.node_id:
-                self._on_refresh(self.node_id, {"index": index}, one_ok)
-            else:
-                self.transport.send(self.node_id, t, "indices:admin/refresh",
-                                    {"index": index},
-                                    on_response=one_ok, on_failure=one_fail)
+            def send(on_resp, on_fail, t=t):
+                if t == self.node_id:
+                    try:
+                        self._on_refresh(self.node_id, {"index": index},
+                                         on_resp)
+                    except Exception as e:
+                        on_fail(e)
+                else:
+                    self.transport.send(self.node_id, t,
+                                        "indices:admin/refresh",
+                                        {"index": index},
+                                        on_response=on_resp,
+                                        on_failure=on_fail)
+
+            sg.launch(t, t, send, on_item=one)
+        sg.seal()

@@ -381,6 +381,7 @@ class Coordinator:
                     "last_accepted_version": self.state.last_accepted_version}
         join["address"] = self.node.address
         join["node"] = self.node.to_dict()
+        # tpulint: disable=TPU010(join is fire-and-forget by protocol design: a lost join is retried by the election timeout, not a callback)
         self.transport.send(self.node.node_id, leader, JOIN_ACTION, join)
 
     def _voting_nodes(self) -> Set[str]:
@@ -395,6 +396,7 @@ class Coordinator:
     def _start_election(self) -> None:
         term = self.state.current_term + 1
         for target in sorted(self._broadcast_targets()):
+            # tpulint: disable=TPU010(election liveness comes from the randomized election timer rescheduling itself, never from per-message callbacks)
             self.transport.send(self.node.node_id, target, START_JOIN_ACTION,
                                 {"source": self.node.node_id, "term": term})
 
@@ -410,6 +412,7 @@ class Coordinator:
         # full node identity (roles, awareness attributes) travels with the
         # join (reference: JoinRequest carries the joining DiscoveryNode)
         join["node"] = self.node.to_dict()
+        # tpulint: disable=TPU010(a lost join after start-join is retried by the next election round; the protocol has no per-join failure path)
         self.transport.send(self.node.node_id, request["source"], JOIN_ACTION, join)
         respond({"ack": True})
 
@@ -636,6 +639,7 @@ class Coordinator:
         except CoordinationError:
             pass
         for target in sorted(set(state.nodes) - {self.node.node_id}):
+            # tpulint: disable=TPU010(publication is quorum-joined and bounded by the publish_timeout timer armed above; a lost ack is just a missing vote)
             self.transport.send(
                 self.node.node_id, target, PUBLISH_ACTION, request,
                 on_response=lambda resp, s=state: self._count_publish_response(resp, s))
@@ -653,6 +657,7 @@ class Coordinator:
             except CoordinationError:
                 pass
             for target in sorted(set(state.nodes) - {self.node.node_id}):
+                # tpulint: disable=TPU010(a follower that misses the commit learns the state from the next publication or leader-check; no callback can help)
                 self.transport.send(self.node.node_id, target, COMMIT_ACTION, commit)
 
     def _on_publish(self, sender: str, request: dict, respond) -> None:
@@ -764,6 +769,7 @@ class Coordinator:
             if self.stopped or self.mode != LEADER:
                 return
             for target in sorted(set(self.committed_state.nodes) - {self.node.node_id}):
+                # tpulint: disable=TPU010(heartbeats are the failure detector itself: a silent follower is detected by _check_followers aging, not by a send callback)
                 self.transport.send(
                     self.node.node_id, target, FOLLOWER_CHECK_ACTION,
                     {"term": self.state.current_term, "leader": self.node.node_id},

@@ -260,9 +260,14 @@ class ClusterAwareNode(Node):
 
         c.node_collectors.update({
             "info": lambda p: self.local_node_info(),
-            "stats": lambda p: self.local_node_stats(
-                p.get("level"),
-                bool(p.get("include_segment_file_sizes"))),
+            # the cross-node serving path's counters ride the stats
+            # section: coordinator-side per-phase fan-out tallies +
+            # data-plane remote deadline sheds (serving/fanout.py)
+            "stats": lambda p: {
+                **self.local_node_stats(
+                    p.get("level"),
+                    bool(p.get("include_segment_file_sizes"))),
+                "fanout": self.cluster.fanout_stats.snapshot()},
             "hot_threads": lambda p: self.local_hot_threads(
                 float(p.get("interval_s", 0.05))),
             "tasks": lambda p: self.local_tasks_section(p.get("actions")),

@@ -49,10 +49,13 @@ class _ShardScopedStore:
         return self._inner.field(name)
 
     def search(self, field, query_vector, k, filter_rows=None,
-               precision: str = "bf16"):
+               precision: str = "bf16", num_candidates=None,
+               deadline_at=None):
         rows, scores = self._inner.search(field, query_vector, k,
                                           filter_rows=filter_rows,
-                                          precision=precision)
+                                          precision=precision,
+                                          num_candidates=num_candidates,
+                                          deadline_at=deadline_at)
         keep = np.isin(rows // SHARD_ROW_SPACE, self._allowed)
         return rows[keep], scores[keep]
 
@@ -244,7 +247,8 @@ class _MultiShardVectorStore:
         return total > 0 and CostModel.prefer_host(1 + pending, total, dims)
 
     def search(self, field: str, query_vector, k: int, filter_rows=None,
-               precision: str = "bf16", num_candidates=None):
+               precision: str = "bf16", num_candidates=None,
+               deadline_at=None):
         state = self._mesh_state(field)
         self._phases = {}
         # k beyond the per-shard padded row count cannot merge losslessly
@@ -264,7 +268,8 @@ class _MultiShardVectorStore:
                 frows = local
             rows, scores = shard.vector_store.search(
                 field, query_vector, k, filter_rows=frows,
-                precision=precision, num_candidates=num_candidates)
+                precision=precision, num_candidates=num_candidates,
+                deadline_at=deadline_at)
             if not self._phases:
                 # captured per dispatch, NOT scanned lazily later — a
                 # later mesh-path query must not inherit these timings

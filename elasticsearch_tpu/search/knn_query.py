@@ -56,7 +56,9 @@ class KnnQuery(Query):
         if store is not None and store.field(self.field) is not None:
             rows, raw = store.search(self.field, self.query_vector, self.k,
                                      filter_rows=filter_rows,
-                                     num_candidates=self.num_candidates)
+                                     num_candidates=self.num_candidates,
+                                     deadline_at=getattr(
+                                         ctx, "deadline_at", None))
             # per-phase engine timings (route/score/merge for tpu_ivf) for
             # the profiler and shard result
             phases = getattr(store, "last_knn_phases", None)

@@ -239,3 +239,27 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
             entries.append(entry)
         profile["aggregations"] = entries
     return profile
+
+
+def fanout_profile(phases: dict) -> dict:
+    """`profile.fanout` section for a cross-node search (serving/
+    fanout.py): per-phase fan-out counts, budgets, elapsed time, and the
+    partial-result attribution — how many shards answered, failed, timed
+    out on the coordinator's per-shard timer, or were shed by the REMOTE
+    node's own admission layer on the propagated deadline. A red
+    `timed_out: true` response is diagnosable from this section alone:
+    `shed` says the deadline traveled and the remote enforced it;
+    `timed_out` says a node went silent and the backstop timer fired."""
+    out = {}
+    for phase, summary in phases.items():
+        out[phase] = {
+            "targets": summary.get("launched", 0),
+            "budget_ms": summary.get("budget_ms", 0),
+            "elapsed_ms": summary.get("elapsed_ms", 0),
+            "ok": summary.get("ok", 0),
+            "failed": summary.get("failed", 0),
+            "coordinator_timeouts": summary.get("timed_out", 0),
+            "remote_sheds": summary.get("shed", 0),
+            "timed_out": bool(summary.get("any_timed_out", False)),
+        }
+    return out

@@ -151,9 +151,15 @@ def execute_query_phase(reader: ShardReader, mapper_service: MapperService,
                         max_buckets: Optional[int] = None,
                         allow_expensive: bool = True,
                         index_name: str = "index",
-                        agg_engine=None) -> ShardSearchResult:
+                        agg_engine=None,
+                        deadline_at: Optional[float] = None
+                        ) -> ShardSearchResult:
     ctx = SearchContext(reader, mapper_service, query_cache=query_cache)
     ctx.vector_store = vector_store
+    # propagated cross-node deadline (monotonic s): device-work legs pass
+    # it into the continuous batcher so the EDF queue sheds expired
+    # sub-requests at THIS node's admission layer (serving/fanout.py)
+    ctx.deadline_at = deadline_at
     ctx.index_settings = index_settings or {}
     ctx.max_buckets = max_buckets
     ctx.allow_expensive = allow_expensive

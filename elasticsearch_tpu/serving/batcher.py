@@ -275,14 +275,22 @@ class CombiningBatcher:
         queue depth: subclasses refuse (raise) instead of queueing
         without bound."""
 
-    def _enqueue(self, request, fut: Future) -> _QueueEntry:
+    def _enqueue(self, request, fut: Future,
+                 deadline_at: Optional[float] = None) -> _QueueEntry:
         """Queue one request (admission may refuse — `_admit`). Returns
-        the queue entry."""
+        the queue entry. `deadline_at` is a per-request ABSOLUTE deadline
+        (time.monotonic seconds) — a cross-node search propagates the
+        request's end-to-end deadline here so the EDF queue sheds the
+        sub-request at THIS node's admission layer; it tightens (never
+        loosens) the batcher's own admission deadline."""
         now = time.monotonic()
         with self._q_cond:
             self._admit(len(self._queue), now)
-            entry = _QueueEntry(request, fut, now, self._deadline_for(now),
-                                self._seq)
+            deadline = self._deadline_for(now)
+            if deadline_at is not None:
+                deadline = deadline_at if deadline is None \
+                    else min(deadline, deadline_at)
+            entry = _QueueEntry(request, fut, now, deadline, self._seq)
             self._seq += 1
             self._queue.append(entry)
             self._q_cond.notify_all()
@@ -551,9 +559,9 @@ class CombiningBatcher:
         if pending is not None:
             self._finish_pipelined(*pending)
 
-    def submit(self, request):
+    def submit(self, request, deadline_at: Optional[float] = None):
         fut: Future = Future()
-        entry = self._enqueue(request, fut)
+        entry = self._enqueue(request, fut, deadline_at=deadline_at)
         while not fut.done():
             if entry.claimed:
                 # a runner owns this request; its finalize (possibly on

@@ -1,0 +1,195 @@
+"""Reusable transport fault injection: drop / delay / kill, as a wrapper.
+
+`DisruptableTransport` (testing/deterministic.py) already drops messages
+for blackholed nodes and cut links, but its faults are baked into the sim
+transport — the TCP transport has none, and neither lets a test say "delay
+only QUERY-phase requests to n2 by 500 ms" or "fail the next 3 sends".
+This module wraps ANY transport exposing the shared `register`/`send`
+surface with an injectable rule set, so the same fault scenarios drive the
+deterministic simulator, the asyncio TCP stack, and the bench harness
+(bench config `10_fanout_node_kill`).
+
+Rules match on (sender, target, action) and apply in order; the first
+matching rule's behavior wins:
+
+* ``drop``      — the send vanishes (neither response nor failure: the
+                  silent network-partition shape that exposes unbounded
+                  coordinator waits)
+* ``delay_ms``  — delivery is deferred on the scheduler; at delivery time
+                  only the KILLED set is re-checked (a node killed while
+                  the message was in flight still swallows it) — other
+                  rules are NOT re-applied to in-flight messages. A
+                  delayed request arriving after its propagated deadline
+                  is exactly the slow-node shed-at-remote scenario
+* ``error``     — on_failure fires with the given exception (a connection
+                  reset: the fast-failure shape)
+
+`kill_node(n)` installs drop rules for everything to AND from `n` — the
+process-death fault the graceful-degradation bench gates on. `revive(n)`
+heals it.
+
+The wrapper counts every injected fault per (rule, node) so tests and
+bench rows can assert the fault actually fired.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+
+class FaultRule:
+    """One match+behavior entry. All match fields are optional; a None
+    field matches anything. `action_prefix` matches on the reference-style
+    action-name prefix (e.g. "indices:data/read")."""
+
+    _ids = itertools.count()
+
+    def __init__(self, *, sender: Optional[str] = None,
+                 target: Optional[str] = None,
+                 action: Optional[str] = None,
+                 action_prefix: Optional[str] = None,
+                 drop: bool = False,
+                 delay_ms: int = 0,
+                 error: Optional[Exception] = None,
+                 times: Optional[int] = None):
+        if drop and (delay_ms or error):
+            raise ValueError("drop is exclusive of delay/error")
+        self.sender = sender
+        self.target = target
+        self.action = action
+        self.action_prefix = action_prefix
+        self.drop = drop
+        self.delay_ms = int(delay_ms)
+        self.error = error
+        self.times = times      # None = unlimited; else fires this many
+        self.fired = 0
+        self.rule_id = next(self._ids)
+
+    def matches(self, sender: str, target: str, action: str) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.sender is not None and sender != self.sender:
+            return False
+        if self.target is not None and target != self.target:
+            return False
+        if self.action is not None and action != self.action:
+            return False
+        if self.action_prefix is not None \
+                and not action.startswith(self.action_prefix):
+            return False
+        return True
+
+    def describe(self) -> str:
+        what = ("drop" if self.drop else
+                f"delay {self.delay_ms}ms" if self.delay_ms else
+                f"error {type(self.error).__name__}" if self.error else
+                "noop")
+        return (f"{what} [{self.sender or '*'} -> {self.target or '*'} "
+                f"{self.action or self.action_prefix or '*'}]")
+
+
+class FaultInjectingTransport:
+    """Wrap a transport with the injectable rule set. API-compatible with
+    DisruptableTransport / TcpTransportService: `register` passes through;
+    `send` consults the rules first."""
+
+    def __init__(self, inner, scheduler=None):
+        self.inner = inner
+        # scheduler is required only for delay rules; the sim queue and the
+        # AsyncioScheduler both expose schedule_in
+        self.scheduler = scheduler
+        self.rules: List[FaultRule] = []
+        self._killed: set = set()
+        self.stats = {"dropped": 0, "delayed": 0, "errored": 0,
+                      "by_node": {}}
+
+    # ------------------------------------------------------------ rule admin
+    def inject(self, rule: FaultRule) -> FaultRule:
+        if rule.delay_ms and self.scheduler is None:
+            raise ValueError("delay rules need a scheduler")
+        self.rules.append(rule)
+        return rule
+
+    def remove(self, rule: FaultRule) -> None:
+        self.rules = [r for r in self.rules if r is not rule]
+
+    def kill_node(self, node_id: str) -> None:
+        """Process death: everything to and from the node vanishes."""
+        self._killed.add(node_id)
+
+    def revive(self, node_id: str) -> None:
+        self._killed.discard(node_id)
+
+    def slow_node(self, node_id: str, delay_ms: int,
+                  action_prefix: Optional[str] = None) -> FaultRule:
+        return self.inject(FaultRule(target=node_id, delay_ms=delay_ms,
+                                     action_prefix=action_prefix))
+
+    def clear(self) -> None:
+        self.rules = []
+        self._killed.clear()
+
+    # ------------------------------------------------------------- passthru
+    def register(self, node_id: str, action: str,
+                 handler: Callable) -> None:
+        self.inner.register(node_id, action, handler)
+
+    def __getattr__(self, name: str):
+        # everything else (add_peer_address, blackhole, loop, ...) belongs
+        # to the wrapped transport
+        return getattr(self.inner, name)
+
+    # -------------------------------------------------------------- sending
+    def _count(self, kind: str, node_id: str) -> None:
+        self.stats[kind] += 1
+        per = self.stats["by_node"].setdefault(
+            node_id, {"dropped": 0, "delayed": 0, "errored": 0})
+        per[kind] += 1
+
+    def send(self, sender: str, target: str, action: str, request: Any,
+             on_response: Optional[Callable] = None,
+             on_failure: Optional[Callable] = None, **kwargs) -> None:
+        if sender in self._killed or target in self._killed:
+            self._count("dropped", target if target in self._killed
+                        else sender)
+            return  # silent: a dead process neither responds nor errors
+        for rule in self.rules:
+            if not rule.matches(sender, target, action):
+                continue
+            rule.fired += 1
+            if rule.drop:
+                self._count("dropped", target)
+                return
+            if rule.error is not None:
+                self._count("errored", target)
+                if on_failure is not None:
+                    err = rule.error
+                    self.scheduler.schedule(
+                        lambda: on_failure(err),
+                        f"fault_error:{action}") if self.scheduler \
+                        else on_failure(err)
+                return
+            if rule.delay_ms:
+                self._count("delayed", target)
+                delay = rule.delay_ms
+
+                def deliver() -> None:
+                    # at delivery only the killed set is re-checked (a
+                    # node killed mid-flight swallows the message);
+                    # re-entering send() would re-match this same delay
+                    # rule and defer forever
+                    if sender in self._killed or target in self._killed:
+                        self._count("dropped", target)
+                        return
+                    self.inner.send(sender, target, action, request,
+                                    on_response=on_response,
+                                    on_failure=on_failure, **kwargs)
+
+                self.scheduler.schedule_in(
+                    delay, deliver, f"fault_delay:{action}:{target}")
+                return
+            break  # a matching no-behavior rule: passthrough
+        self.inner.send(sender, target, action, request,
+                        on_response=on_response, on_failure=on_failure,
+                        **kwargs)

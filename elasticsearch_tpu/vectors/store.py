@@ -647,7 +647,8 @@ class VectorStoreShard:
     def search(self, field: str, query_vector: np.ndarray, k: int,
                filter_rows: Optional[np.ndarray] = None,
                precision: str = "bf16",
-               num_candidates: Optional[int] = None
+               num_candidates: Optional[int] = None,
+               deadline_at: Optional[float] = None
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Top-k search. Returns (global_rows [m], raw_scores [m]), m <= k
         (padding/filtered slots removed).
@@ -693,8 +694,13 @@ class VectorStoreShard:
                         self._retire_sched(stale)
                     self._batchers.clear()
                 self._batchers[key] = batcher
+        # deadline_at: the propagated cross-node deadline (monotonic s) —
+        # the EDF queue sheds this entry at schedule time if it expires
+        # before a runner claims it (EsRejectedExecutionError to the
+        # caller, counted in sched["deadline_sheds"])
         return batcher.submit(
-            (np.asarray(query_vector, dtype=np.float32), filter_rows))
+            (np.asarray(query_vector, dtype=np.float32), filter_rows),
+            deadline_at=deadline_at)
 
     def search_many(self, field: str, requests, k: int,
                     precision: str = "bf16",

@@ -1,0 +1,363 @@
+"""Cross-node serving: deadline-propagating scatter-gather under faults.
+
+The four contracts the fan-out subsystem (serving/fanout.py) must hold:
+
+* expired budget → PARTIAL results: `timed_out: true`, correct
+  `_shards.failed`, hits from the shards that answered — never a hang.
+* dead node → the per-shard timers complete the phase; the response
+  arrives within the budget with the dead node's shards counted failed.
+* slow node + propagated deadline → the REMOTE node sheds the
+  sub-request at its own admission layer (the continuous batcher's EDF
+  queue), and the coordinator attributes it as a shed — its own backstop
+  timer never fires.
+* fault harness installed but idle → byte-identical accumulator behavior
+  to a bare cluster (the wrapper must be invisible at zero faults).
+
+All scenarios run on the deterministic simulator with the
+`FaultInjectingTransport` wrapper (testing/faults.py) injecting the
+drop/delay/kill behaviors.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.cluster_node import (
+    QUERY_SHARD, ClusterNode,
+)
+from elasticsearch_tpu.cluster.coordination import bootstrap_state
+from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+from elasticsearch_tpu.testing.deterministic import (
+    DeterministicTaskQueue, DisruptableTransport,
+)
+from elasticsearch_tpu.testing.faults import (
+    FaultInjectingTransport, FaultRule,
+)
+
+DIMS = 4
+
+
+class FaultyCluster:
+    """TestCluster (test_multi_node) + the fault-injection wrapper."""
+
+    def __init__(self, tmp_path, n_nodes=3, seed=0, with_faults=True):
+        self.queue = DeterministicTaskQueue(seed=seed)
+        inner = DisruptableTransport(self.queue)
+        if with_faults:
+            self.faults = FaultInjectingTransport(inner,
+                                                  scheduler=self.queue)
+            self.transport = self.faults
+        else:
+            self.faults = None
+            self.transport = inner
+        ids = [f"n{i}" for i in range(n_nodes)]
+        initial = bootstrap_state(ids)
+        self.nodes = {}
+        for nid in ids:
+            self.nodes[nid] = ClusterNode(
+                nid, str(tmp_path / nid), self.transport, self.queue,
+                seed_peers=[p for p in ids if p != nid],
+                initial_state=initial)
+        for n in self.nodes.values():
+            n.start()
+
+    def run_until(self, cond, max_ms=120_000, step=200):
+        waited = 0
+        while waited < max_ms:
+            self.queue.run_for(step)
+            waited += step
+            if cond():
+                return True
+        return cond()
+
+    def master(self):
+        for n in self.nodes.values():
+            if n.is_master and not n.coordinator.stopped:
+                return n
+        return None
+
+    def all_started(self, index):
+        n = next(iter(self.nodes.values()))
+        shards = n.cluster_state.shards_of(index)
+        return bool(shards) and all(
+            s.state == ShardRoutingEntry.STARTED for s in shards)
+
+    def call(self, fn, *args, **kw):
+        box = {}
+        fn(*args, **kw, on_done=lambda r: box.update(r=r))
+        ok = self.run_until(lambda: "r" in box)
+        assert ok, f"no response from {fn.__name__}"
+        return box["r"]
+
+    def stop(self):
+        for n in self.nodes.values():
+            if not n.coordinator.stopped:
+                n.stop()
+
+
+def _rng(seed=7):
+    return np.random.default_rng(seed)
+
+
+def _build(c, index="docs", shards=3, docs=30, vectors=True):
+    """Create a replicas=0 index spread over the cluster and load it."""
+    mappings = {"properties": {"title": {"type": "text"},
+                               "n": {"type": "long"}}}
+    if vectors:
+        mappings["properties"]["v"] = {"type": "dense_vector",
+                                       "dims": DIMS}
+    coord = c.nodes["n0"]
+    assert c.call(coord.client_create_index, index,
+                  settings={"index.number_of_shards": shards,
+                            "index.number_of_replicas": 0},
+                  mappings=mappings).get("acknowledged")
+    assert c.run_until(lambda: c.all_started(index)), "shards not started"
+    rng = _rng()
+    for i in range(docs):
+        src = {"title": f"doc {i}", "n": i}
+        if vectors:
+            src["v"] = rng.standard_normal(DIMS).astype(float).tolist()
+        r = c.call(coord.client_write, index,
+                   {"type": "index", "id": f"d{i}", "source": src})
+        assert r.get("result") in ("created", "updated"), r
+    c.call(coord.client_refresh, index)
+    return coord
+
+
+def _victim(c, index, coordinator_id="n0"):
+    """A node other than the coordinator holding >=1 STARTED shard."""
+    state = c.nodes[coordinator_id].cluster_state
+    held = {}
+    for r in state.routing:
+        if r.index == index and r.state == ShardRoutingEntry.STARTED:
+            held.setdefault(r.node_id, []).append(r.shard)
+    for nid in sorted(held):
+        if nid != coordinator_id:
+            return nid, held[nid]
+    raise AssertionError(f"no remote shard holder: {held}")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = FaultyCluster(tmp_path, n_nodes=3, seed=17)
+
+    def stable():
+        m = c.master()
+        return m is not None and len(m.cluster_state.nodes) == 3
+
+    assert c.run_until(stable), "cluster did not stabilize"
+    yield c
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# expired budget → partial results
+# ---------------------------------------------------------------------------
+
+def test_expired_budget_returns_partial_with_shard_accounting(cluster):
+    c = cluster
+    coord = _build(c, vectors=False)
+    victim, victim_shards = _victim(c, "docs")
+    # tight phase budget so the per-shard timers fire fast
+    assert c.call(coord.client_update_settings,
+                  {"search.fanout.query_budget_ms": 400,
+                   "search.fanout.fetch_budget_ms": 400,
+                   "search.fanout.deadline_grace_ms": 50}
+                  ).get("acknowledged")
+    # the victim's query phase goes silent: requests vanish (the silent-
+    # partition shape — no response, no failure)
+    c.faults.inject(FaultRule(target=victim, action=QUERY_SHARD,
+                              drop=True))
+    t0 = c.queue.now_ms
+    resp = c.call(coord.client_search, "docs",
+                  {"query": {"match_all": {}}, "size": 30})
+    assert resp["timed_out"] is True
+    assert resp["_shards"]["total"] == 3
+    assert resp["_shards"]["failed"] == len(victim_shards)
+    assert resp["_shards"]["successful"] == 3 - len(victim_shards)
+    assert resp["_shards"]["skipped"] == 0
+    # hits from the surviving shards are served, and the partial fan-in's
+    # total is a lower bound
+    assert len(resp["hits"]["hits"]) > 0
+    assert resp["hits"]["total"]["relation"] == "gte"
+    # the response arrived via the budget timer, not a hang: bounded by
+    # budget + scheduler slack
+    assert c.queue.now_ms - t0 < 5_000
+    phase = coord.fanout_stats.phases["query"]
+    assert phase["timed_out"] == len(victim_shards)
+    assert coord.fanout_stats.partial_responses >= 1
+    # per-node slow tally feeds the ARS observer: the victim must now
+    # rank behind nodes that answered
+    assert coord.fanout_stats.per_node[victim]["slow"] >= 1
+    assert coord._ars_ewma[victim] >= max(
+        v for k, v in coord._ars_ewma.items() if k != victim)
+
+
+def test_partial_results_disallowed_is_an_error(cluster):
+    c = cluster
+    coord = _build(c, vectors=False)
+    victim, _ = _victim(c, "docs")
+    assert c.call(coord.client_update_settings,
+                  {"search.fanout.query_budget_ms": 300}
+                  ).get("acknowledged")
+    c.faults.inject(FaultRule(target=victim, action=QUERY_SHARD,
+                              drop=True))
+    resp = c.call(coord.client_search, "docs",
+                  {"query": {"match_all": {}},
+                   "allow_partial_search_results": False})
+    assert resp.get("status") == 503
+    assert resp["error"]["type"] == "search_phase_execution_exception"
+
+
+# ---------------------------------------------------------------------------
+# dead node → no hang, failure counted
+# ---------------------------------------------------------------------------
+
+def test_dead_node_fanout_completes_with_failures(cluster):
+    c = cluster
+    coord = _build(c, vectors=False)
+    victim, victim_shards = _victim(c, "docs")
+    assert c.call(coord.client_update_settings,
+                  {"search.fanout.query_budget_ms": 500}
+                  ).get("acknowledged")
+    c.faults.kill_node(victim)
+    resp = c.call(coord.client_search, "docs",
+                  {"query": {"match_all": {}}, "size": 30})
+    assert resp["timed_out"] is True
+    assert resp["_shards"]["failed"] == len(victim_shards)
+    assert len(resp["hits"]["hits"]) > 0
+    assert c.faults.stats["dropped"] > 0
+    # a second search still answers (the path stays healthy under the
+    # sustained fault; ARS now deprioritizes the dead node's copies)
+    resp2 = c.call(coord.client_search, "docs",
+                   {"query": {"match_all": {}}, "size": 5})
+    assert resp2["_shards"]["failed"] >= 1
+
+
+def test_all_copies_red_early_return_matches_response_contract(cluster):
+    c = cluster
+    _build(c, index="solo", shards=1, docs=3, vectors=False)
+    state = c.nodes["n0"].cluster_state
+    victim = next(r.node_id for r in state.shards_of("solo")
+                  if r.state == ShardRoutingEntry.STARTED)
+    coord = c.nodes[[n for n in c.nodes if n != victim][0]]
+    c.faults.kill_node(victim)
+    c.nodes[victim].stop()
+    # wait until the master evicts the dead node and the shard goes red
+    assert c.run_until(lambda: not any(
+        r.state == ShardRoutingEntry.STARTED and r.node_id
+        for r in coord.cluster_state.shards_of("solo")), max_ms=300_000)
+    resp = c.call(coord.client_search, "solo",
+                  {"query": {"match_all": {}}})
+    # the normalized contract: same shape as every other search response
+    assert resp["timed_out"] is False
+    assert resp["took"] >= 0
+    assert resp["_shards"] == {"total": 1, "successful": 0,
+                               "skipped": 0, "failed": 1}
+    assert resp["hits"] == {"total": {"value": 0, "relation": "eq"},
+                            "max_score": None, "hits": []}
+
+
+# ---------------------------------------------------------------------------
+# slow node → remote shed via the continuous batcher's EDF queue
+# ---------------------------------------------------------------------------
+
+def test_slow_node_sheds_at_remote_batcher_not_coordinator_timer(cluster):
+    c = cluster
+    coord = _build(c, vectors=True)
+    victim, victim_shards = _victim(c, "docs")
+    # deliver the victim's QUERY sub-requests 500ms late — past the
+    # request's 200ms deadline, but well inside the coordinator's
+    # (budget + grace) backstop
+    c.faults.inject(FaultRule(target=victim, action=QUERY_SHARD,
+                              delay_ms=500))
+    body = {"knn": {"field": "v",
+                    "query_vector": _rng(3).standard_normal(
+                        DIMS).astype(float).tolist(),
+                    "k": 5, "num_candidates": 5},
+            "size": 5, "timeout": "200ms"}
+    resp = c.call(coord.client_search, "docs", body)
+    assert resp["timed_out"] is True
+    assert resp["_shards"]["failed"] == len(victim_shards)
+    assert len(resp["hits"]["hits"]) > 0
+
+    # THE deadline-propagation proof: the remote's continuous batcher
+    # shed the sub-request on the propagated absolute deadline (EDF
+    # schedule-time shed), and the coordinator merely attributed it —
+    # its own backstop timer never fired for the query phase.
+    vnode = c.nodes[victim]
+    assert vnode.fanout_stats.remote["sheds_batcher"] >= 1
+    shard_sheds = sum(
+        sh.vector_store.scheduler_stats().get("deadline_sheds", 0)
+        for sh in vnode.local_shards.values())
+    assert shard_sheds >= 1, \
+        "the shed must come from the batcher's EDF queue"
+    phase = coord.fanout_stats.phases["query"]
+    assert phase["shed"] == len(victim_shards)
+    assert phase["timed_out"] == 0, \
+        "coordinator backstop must not fire when the remote sheds itself"
+
+
+def test_expired_pure_host_subrequest_sheds_at_admission(cluster):
+    c = cluster
+    coord = _build(c, vectors=False)
+    victim, victim_shards = _victim(c, "docs")
+    c.faults.inject(FaultRule(target=victim, action=QUERY_SHARD,
+                              delay_ms=500))
+    resp = c.call(coord.client_search, "docs",
+                  {"query": {"match_all": {}}, "timeout": "150ms",
+                   "size": 30})
+    assert resp["timed_out"] is True
+    assert c.nodes[victim].fanout_stats.remote["sheds_admission"] >= 1
+    assert coord.fanout_stats.phases["query"]["shed"] == \
+        len(victim_shards)
+
+
+# ---------------------------------------------------------------------------
+# parity: the harness at zero faults is invisible
+# ---------------------------------------------------------------------------
+
+def _strip_took(resp):
+    out = dict(resp)
+    out.pop("took", None)
+    return out
+
+
+def test_accumulator_parity_with_no_fault_path(tmp_path):
+    responses = []
+    for with_faults in (True, False):
+        c = FaultyCluster(tmp_path / f"w{int(with_faults)}", n_nodes=3,
+                          seed=17, with_faults=with_faults)
+        assert c.run_until(lambda: c.master() is not None
+                           and len(c.master().cluster_state.nodes) == 3)
+        coord = _build(c)
+        body = {"query": {"match": {"title": "doc"}},
+                "knn": {"field": "v",
+                        "query_vector": _rng(5).standard_normal(
+                            DIMS).astype(float).tolist(),
+                        "k": 4, "num_candidates": 4},
+                "size": 10,
+                "aggs": {"m": {"max": {"field": "n"}}}}
+        responses.append(_strip_took(c.call(coord.client_search,
+                                            "docs", body)))
+        c.stop()
+    assert responses[0] == responses[1]
+
+
+# ---------------------------------------------------------------------------
+# observability: profile.fanout + stats snapshot shape
+# ---------------------------------------------------------------------------
+
+def test_profile_fanout_section_and_stats_snapshot(cluster):
+    c = cluster
+    coord = _build(c, vectors=False)
+    resp = c.call(coord.client_search, "docs",
+                  {"query": {"match_all": {}}, "profile": True})
+    prof = resp["profile"]["fanout"]
+    assert prof["query"]["targets"] == 3
+    assert prof["query"]["ok"] == 3
+    assert prof["query"]["timed_out"] is False
+    assert "fetch" in prof
+    snap = coord.fanout_stats.snapshot()
+    assert snap["phases"]["query"]["launched"] >= 3
+    assert "per_node" in snap and "remote" in snap
+    assert set(snap["remote"]) == {"sheds_admission", "sheds_batcher"}

@@ -1,0 +1,53 @@
+"""TPU010 clean: failure handlers everywhere, joins bounded by timers.
+
+The sanctioned shapes: every `transport.send` carries `on_failure`, and a
+pending-counter join arms a scheduler backstop (`schedule_in`) so a
+silently dropped response can never hang the accumulator — the structure
+`serving.fanout.ScatterGather` provides for free.
+"""
+
+
+class GuardedCoordinator:
+    def __init__(self, transport, scheduler, node_id):
+        self.transport = transport
+        self.scheduler = scheduler
+        self.node_id = node_id
+
+    def send_with_failure_path(self, target, request, on_done):
+        self.transport.send(self.node_id, target,
+                            "indices:data/read/query", request,
+                            on_response=on_done,
+                            on_failure=lambda e: on_done(None))
+
+    def bounded_pending_counter_join(self, targets, request, on_done,
+                                     budget_ms=15_000):
+        results = {}
+        pending = {"count": len(targets)}
+
+        def one(resp, target):
+            if target not in results:
+                results[target] = resp
+                pending["count"] -= 1
+            if pending["count"] == 0:
+                on_done(results)
+
+        def expire():
+            # backstop: resolve every target that never answered
+            for target in targets:
+                if target not in results:
+                    one(None, target)
+
+        self.scheduler.schedule_in(budget_ms, expire, "fanout_backstop")
+        for target in targets:
+            self.transport.send(
+                self.node_id, target, "indices:data/read/query", request,
+                on_response=lambda r, t=target: one(r, t),
+                on_failure=lambda _e, t=target: one(None, t))
+
+    def no_transport_involved(self, items, on_done):
+        # a pending-counter over local work is not a fan-out join
+        pending = {"count": len(items)}
+        for item in items:
+            pending["count"] -= 1
+        if pending["count"] == 0:
+            on_done(items)

@@ -1,0 +1,29 @@
+"""TPU010 fires: transport fan-outs that can hang on a silent drop."""
+
+
+class BrokenCoordinator:
+    def __init__(self, transport, scheduler, node_id):
+        self.transport = transport
+        self.scheduler = scheduler
+        self.node_id = node_id
+
+    def fire_and_forget_without_failure_path(self, target, request):
+        self.transport.send(self.node_id, target,  # [expect] no on_failure
+                            "indices:data/read/query", request,
+                            on_response=lambda r: None)
+
+    def unbounded_pending_counter_join(self, targets, request, on_done):
+        results = {}
+        pending = {"count": len(targets)}  # [expect] no timer on the join
+
+        def one(resp, target):
+            results[target] = resp
+            pending["count"] -= 1
+            if pending["count"] == 0:
+                on_done(results)
+
+        for target in targets:
+            self.transport.send(
+                self.node_id, target, "indices:data/read/query", request,
+                on_response=lambda r, t=target: one(r, t),
+                on_failure=lambda _e, t=target: one(None, t))

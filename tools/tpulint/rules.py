@@ -916,8 +916,130 @@ class LockedSyncRule(Rule):
         return None
 
 
+class UnguardedFanoutRule(Rule):
+    """TPU010: transport fan-outs that can hang on a silent drop.
+
+    Historical context (PR 12): `cluster_node._query_phase` waited for
+    `pending == 0` with NO timer while fanning QUERY-phase RPCs — one
+    slow or dead data node hung the whole search accumulator forever
+    (the deterministic transport drops messages silently, exactly like
+    a real network partition; neither `on_response` nor `on_failure`
+    ever fires). The same idiom had spread to the scroll, refresh, and
+    replication fan-outs. The fix is serving/fanout.py's ScatterGather
+    (per-item timers make completion structural); this rule keeps the
+    idiom from growing back. Two patterns fire:
+
+    * a `transport.send(...)` call site with no `on_failure` handler —
+      a failed delivery is silently lost, so the caller's completion
+      accounting can never see the error;
+    * a function that fans out over `transport.send` and joins on a
+      mutable pending-counter dict (`pending = {"count": len(...)}`
+      ... `pending["count"] -= 1` ... `== 0`) without arming ANY
+      scheduler timer (`schedule_in`/`schedule_at`) — the unbounded
+      coordinator wait. Route the fan-out through
+      `serving.fanout.ScatterGather` (or arm an explicit timeout).
+    """
+
+    rule_id = "TPU010"
+    summary = "transport fan-out without failure handling or a timer"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        analyzed: Set[ast.AST] = set()
+        for fn in iter_functions(ctx.tree):
+            # analyze OUTERMOST functions whole (the pending-counter
+            # idiom spans the nested response closures), skipping
+            # functions already covered by an enclosing analysis
+            cur = ctx.parents.get(fn)
+            nested = False
+            while cur is not None:
+                if cur in analyzed:
+                    nested = True
+                    break
+                cur = ctx.parents.get(cur)
+            if nested:
+                continue
+            analyzed.add(fn)
+            findings.extend(self._judge_function(fn, ctx))
+        return findings
+
+    @staticmethod
+    def _is_transport_send(node: ast.Call) -> bool:
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "send"):
+            return False
+        return "transport" in dotted(node.func.value).lower()
+
+    def _judge_function(self, fn, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        sends: List[ast.Call] = []
+        counters: Dict[str, ast.stmt] = {}   # var -> defining Assign
+        decremented: Set[str] = set()
+        zero_tested: Set[str] = set()
+        has_timer = False
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if self._is_transport_send(node):
+                    sends.append(node)
+                    kws = {kw.arg for kw in node.keywords}
+                    # positional form carries on_failure as the 6th arg
+                    if "on_failure" not in kws and len(node.args) < 6:
+                        findings.append(ctx.finding(
+                            self.rule_id, node,
+                            "transport.send without an on_failure "
+                            "handler: a failed delivery is silently "
+                            "lost and the fan-out's completion "
+                            "accounting can never see it"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("schedule_in",
+                                               "schedule_at"):
+                    has_timer = True
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Dict) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                # `pending = {"count": len(targets)}` — a fan-out join
+                # counter seeded from the target-set size
+                if any(isinstance(c, ast.Call)
+                       and isinstance(c.func, ast.Name)
+                       and c.func.id == "len"
+                       for v in node.value.values if v is not None
+                       for c in ast.walk(v)):
+                    counters[node.targets[0].id] = node
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Sub) \
+                    and isinstance(node.target, ast.Subscript):
+                name = base_name(node.target)
+                if name:
+                    decremented.add(name)
+            elif isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Subscript) \
+                    and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], ast.Eq) \
+                    and len(node.comparators) == 1 \
+                    and isinstance(node.comparators[0], ast.Constant) \
+                    and node.comparators[0].value == 0:
+                name = base_name(node.left)
+                if name:
+                    zero_tested.add(name)
+
+        if sends and not has_timer:
+            for name, assign in counters.items():
+                if name in decremented and name in zero_tested:
+                    findings.append(ctx.finding(
+                        self.rule_id, assign,
+                        f"fan-out joins on pending counter [{name}] "
+                        "with no scheduler timer: a silently dropped "
+                        "response hangs the accumulator forever — "
+                        "route through serving.fanout.ScatterGather "
+                        "(per-item timers) or arm schedule_in as a "
+                        "backstop"))
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
-    ModuleCacheLockRule(), LockedSyncRule(),
+    ModuleCacheLockRule(), LockedSyncRule(), UnguardedFanoutRule(),
 ]
