@@ -1,0 +1,29 @@
+"""Columnar segment block store (the Lucene doc-values/codec layer,
+host-side): one per-(segment, field) immutable block cache under the
+vector store, the agg engine, and the BM25 impact layout.
+
+See `columnar/store.py` for the contract; `columnar/blocks.py` for the
+block shapes. The process-wide instance is `columnar.STORE`."""
+
+from elasticsearch_tpu.columnar.blocks import (
+    PostingsBlock,
+    ValuesBlock,
+    VectorBlock,
+    extract_postings_block,
+    extract_values_block,
+    extract_vector_block,
+    fingerprint,
+)
+from elasticsearch_tpu.columnar.store import (
+    STORE,
+    FieldRowsView,
+    RowSource,
+    SegmentBlockStore,
+)
+
+__all__ = [
+    "STORE", "SegmentBlockStore", "FieldRowsView", "RowSource",
+    "VectorBlock", "ValuesBlock", "PostingsBlock",
+    "extract_vector_block", "extract_values_block",
+    "extract_postings_block", "fingerprint",
+]

@@ -1,0 +1,178 @@
+"""Immutable per-(segment, field) column blocks.
+
+The host-side port of Lucene's doc-values/codec layer (PAPER.md §
+index/codec): every columnar consumer — the device vector store
+(`vectors/store.py`), the agg engine (`ops/aggs.py`), the BM25 impact
+layout (`ops/bm25.py`) — reads segment data through ONE block shape per
+field kind instead of a private extractor with a private cache. A block
+is extracted ONCE per (segment, field, live-set) and shared by every
+consumer and every device generation derived from it; the store
+(`columnar/store.py`) owns caching, fingerprints, and eviction.
+
+Block kinds:
+
+* ``VectorBlock``  — live f32 vector rows + engine global row ids. When
+  the segment has no tombstones and every doc carries the field, the
+  matrix is a ZERO-COPY reference to the engine segment's own
+  ``[num_docs, d]`` array — the corpus-sized host RAM exists once, in
+  the engine, and everything else holds references.
+* ``ValuesBlock``  — the agg engine's f64 value/presence columns (+
+  optional raw-object column for global ordinals), the exact
+  `aggregations.numeric_values` coercion.
+* ``PostingsBlock`` — one segment's live postings in dense live-slot
+  space (the BM25 CSR input), via `SegmentView.live_postings`.
+
+Extraction math is byte-identical to the three retired extractors (the
+parity suite in `tests/test_columnar.py` pins it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def fingerprint(view, extra: Tuple = ()) -> tuple:
+    """The block cache key half that changes when a segment's content
+    would: (seg_id, num_docs, live_count). Within one engine a segment's
+    live count only shrinks (tombstones accumulate), so the triple is
+    unique per live-set over the segment's lifetime."""
+    seg = view.segment
+    return (seg.seg_id, seg.num_docs, int(view.live.sum())) + tuple(extra)
+
+
+class VectorBlock:
+    """One segment's live rows of one dense_vector field.
+
+    ``matrix`` is [n_live, d] f32; ``rows`` the matching engine global
+    row ids. ``zero_copy`` marks the no-tombstone/all-present fast path
+    where ``matrix`` IS the engine segment's array (no second corpus
+    copy on host); ``nbytes`` counts only RAM this block ADDS beyond
+    what the engine segment already holds."""
+
+    __slots__ = ("fingerprint", "matrix", "rows", "zero_copy", "nbytes")
+
+    def __init__(self, fp: tuple, matrix: np.ndarray, rows: np.ndarray,
+                 zero_copy: bool):
+        self.fingerprint = fp
+        self.matrix = matrix
+        self.rows = rows
+        self.zero_copy = zero_copy
+        self.nbytes = rows.nbytes + (0 if zero_copy else matrix.nbytes)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.rows)
+
+
+def extract_vector_block(view, field: str) -> Optional[VectorBlock]:
+    """Live vector rows of one segment (None when the segment has no
+    such field) — the per-segment half of the retired
+    `vectors/store.extract_field_rows` loop, byte-identical."""
+    seg = view.segment
+    if field not in seg.vectors:
+        return None
+    fp = fingerprint(view)
+    mat, present = seg.vectors[field]
+    keep = present & view.live
+    if keep.all():
+        # zero-copy: the engine segment's matrix already IS the live f32
+        # block (SegmentBuilder.seal materializes f32); rows are the
+        # dense range
+        rows = np.arange(seg.num_docs, dtype=np.int64) + seg.base
+        return VectorBlock(fp, np.asarray(mat, dtype=np.float32), rows,
+                           zero_copy=True)
+    locs = np.nonzero(keep)[0]
+    rows = locs.astype(np.int64) + seg.base
+    return VectorBlock(fp, np.asarray(mat[locs], dtype=np.float32), rows,
+                       zero_copy=False)
+
+
+class ValuesBlock:
+    """One segment's live-row doc-values extraction for one field — the
+    agg engine's per-segment column (f64 numeric view + presence, raw
+    objects when global ordinals are wanted, multi-valuedness flag)."""
+
+    __slots__ = ("fingerprint", "vals", "present", "objs", "multi_valued",
+                 "nbytes")
+
+    def __init__(self, fp: tuple, vals, present, objs, multi_valued):
+        self.fingerprint = fp
+        self.vals = vals            # f64[n_live] (nan where absent)
+        self.present = present      # bool[n_live]
+        self.objs = objs            # object[n_live] raw doc values (or None)
+        self.multi_valued = multi_valued
+        self.nbytes = vals.nbytes + present.nbytes \
+            + (objs.nbytes if objs is not None else 0)
+
+
+def extract_values_block(view, field: str, want_objs: bool) -> ValuesBlock:
+    """Port of the retired `ops/aggs._extract_segment_column` — EXACTLY
+    the `aggregations.numeric_values` coercion: bools → 1/0, numerics →
+    float, first element of lists, strings/geo absent."""
+    seg = view.segment
+    n_live = int(view.live.sum())
+    fp = fingerprint(view, (want_objs,))
+    col = seg.doc_values.get(field)
+    vals = np.full(n_live, np.nan, dtype=np.float64)
+    present = np.zeros(n_live, dtype=bool)
+    objs = np.empty(n_live, dtype=object) if want_objs else None
+    multi = False
+    if col is not None and n_live:
+        live_idx = np.nonzero(view.live)[0]
+        raw = None
+        if want_objs or col.numeric is None:
+            raw = np.empty(n_live, dtype=object)
+            for i, loc in enumerate(live_idx):
+                v = col.values[int(loc)]
+                raw[i] = v
+                if isinstance(v, list):
+                    multi = True
+            if want_objs:
+                objs = raw
+        else:
+            # multi-valuedness must be known even for pure-numeric
+            # columns: the f64 view keeps only a doc's FIRST value, which
+            # matches numeric_values but NOT all_values — value_count
+            # (and terms) bind-checks depend on this flag being real
+            multi = any(isinstance(col.values[int(loc)], list)
+                        for loc in live_idx)
+        if col.numeric is not None:
+            vals[:] = col.numeric[live_idx]
+            present[:] = col.present[live_idx]
+            vals[~present] = np.nan
+        else:
+            for i in range(n_live):
+                v = raw[i]
+                if isinstance(v, list):
+                    v = v[0] if v else None
+                if v is None:
+                    continue
+                if isinstance(v, bool):
+                    vals[i] = 1.0 if v else 0.0
+                    present[i] = True
+                elif isinstance(v, (int, float)):
+                    vals[i] = float(v)
+                    present[i] = True
+    return ValuesBlock(fp, vals, present, objs, multi)
+
+
+class PostingsBlock:
+    """One segment's live postings of one text field in dense live-slot
+    space — the BM25 CSR extraction (`SegmentView.live_postings`)."""
+
+    __slots__ = ("fingerprint", "terms", "lengths", "n_live", "nbytes")
+
+    def __init__(self, fp: tuple, terms, lengths, n_live):
+        self.fingerprint = fp
+        self.terms = terms      # term -> (live slots ascending, freqs)
+        self.lengths = lengths  # f32[n_live] field length per live slot
+        self.n_live = n_live
+        self.nbytes = lengths.nbytes + sum(
+            s.nbytes + f.nbytes for s, f in terms.values())
+
+
+def extract_postings_block(view, field: str) -> PostingsBlock:
+    terms, lengths, n_live = view.live_postings(field)
+    return PostingsBlock(fingerprint(view), terms, lengths, n_live)

@@ -77,11 +77,16 @@ class Postings:
 
 
 class Segment:
-    """Immutable sealed segment."""
+    """Immutable sealed segment.
+
+    Weak-referenceable on purpose: the columnar segment block store
+    (`elasticsearch_tpu/columnar/`) caches per-(segment, field) column
+    extractions against the segment OBJECT, so dropping a segment (an
+    engine merge/rewrite) releases its blocks automatically."""
 
     __slots__ = ("seg_id", "base", "num_docs", "postings", "field_lengths",
                  "total_terms", "doc_values", "vectors", "ids", "sources",
-                 "seq_nos")
+                 "seq_nos", "__weakref__")
 
     def __init__(self, seg_id: int, base: int, num_docs: int,
                  postings: Dict[str, Dict[str, Postings]],
@@ -244,9 +249,10 @@ class SegmentView:
 
         Live docs renumber 0..n_live-1 in ascending local order — the
         columnar extraction the device lexical engine (`ops/bm25.py`)
-        ingests at refresh, owned here because the slot/tombstone layout
-        is this layer's contract (the vector twin is
-        `vectors/store.extract_field_rows`)."""
+        ingests at refresh through the segment block store
+        (`columnar/blocks.extract_postings_block`), owned here because
+        the slot/tombstone layout is this layer's contract (the vector
+        twin is `columnar/blocks.extract_vector_block`)."""
         seg = self.segment
         n_live = self.live_count
         slot_of = np.cumsum(self.live) - 1  # local doc -> dense live slot

@@ -145,6 +145,14 @@ class _MultiShardVectorStore:
         from elasticsearch_tpu.parallel import layout
         per = knn_ops.pad_rows(max(max(len(b) for b in blocks), 1))
         d = mapper.dims
+        # dp-aware HBM budget: this upload replicates across every dp
+        # group, so it must clear the same search.mesh.hbm_budget_bytes
+        # gate the per-shard serving corpus clears (the host-coordinated
+        # per-shard fallback below serves instead when it doesn't)
+        from elasticsearch_tpu.vectors.store import device_corpus_nbytes
+        if not mesh_policy.hbm_allows(
+                device_corpus_nbytes(n_shards * per, d, "bf16"), mesh):
+            return None
         matrix_host = np.zeros((n_shards * per, d), dtype=np.float32)
         sq_host = np.zeros(n_shards * per, dtype=np.float32)
         num_valid = np.zeros(n_shards, dtype=np.int32)
@@ -361,6 +369,18 @@ class _MultiShardVectorStore:
         per-phase split)."""
         return self._phases
 
+    @property
+    def columnar_refresh(self) -> dict:
+        """Per-field segment-block-store refresh ledger, first shard
+        that synced the field wins (the `columnar` annotation
+        `profile.knn` attaches — see VectorStoreShard.columnar_refresh)."""
+        out: dict = {}
+        for shard in self.svc.shards:
+            for f, info in getattr(shard.vector_store,
+                                   "columnar_refresh", {}).items():
+                out.setdefault(f, info)
+        return out
+
 
 class Node:
     def __init__(self, data_path: str, node_name: str = "node-0",
@@ -506,13 +526,15 @@ class Node:
         # only an explicit setting reconfigures it (same clobber rule as
         # warmup above).
         mesh_keys = ("search.mesh.enabled", "search.mesh.num_shards",
-                     "search.mesh.min_rows", "search.mesh.dp")
+                     "search.mesh.min_rows", "search.mesh.dp",
+                     "search.mesh.hbm_budget_bytes")
         if any(self.settings.get(key) is not None for key in mesh_keys):
             from elasticsearch_tpu.parallel import policy as _mesh_policy
             enabled = self.settings.get("search.mesh.enabled")
             num_shards = self.settings.get("search.mesh.num_shards")
             min_rows = self.settings.get("search.mesh.min_rows")
             dp = self.settings.get("search.mesh.dp")
+            hbm_budget = self.settings.get("search.mesh.hbm_budget_bytes")
             kwargs = {}
             if enabled is not None:
                 kwargs["enabled"] = setting_bool(enabled)
@@ -522,6 +544,8 @@ class Node:
                 kwargs["min_rows"] = int(min_rows)
             if dp is not None:
                 kwargs["dp"] = int(dp)
+            if hbm_budget is not None:
+                kwargs["hbm_budget_bytes"] = int(hbm_budget)
             _mesh_policy.configure(**kwargs)
         # set by the server bootstrap after native hardening runs; embedded
         # nodes have no hardening (reference: JNANatives.LOCAL_MLOCKALL)
@@ -2416,7 +2440,8 @@ class Node:
             "hybrid": self._hybrid_stats_section(),
             "aggs": self._aggs_stats_section(),
             "dispatch": self._dispatch_stats_section(),
-            "mesh": self._mesh_stats_section()}
+            "mesh": self._mesh_stats_section(),
+            "columnar": self._columnar_stats_section()}
         discovery_section = {
             "cluster_state_queue": {"total": 0, "pending": 0,
                                     "committed": 0},
@@ -2471,6 +2496,17 @@ class Node:
                     elif isinstance(val, (int, float)):
                         out[key] = out.get(key, 0) + val
         return out
+
+    @staticmethod
+    def _columnar_stats_section() -> dict:
+        """Segment block store counters (`elasticsearch_tpu/columnar/`):
+        live per-field block counts/bytes, cache hits vs extractions
+        (+ extract nanos), evictions, and the delta-vs-full composition
+        ledger — the counter form of the O(delta) refresh claim.
+        Process-wide like the dispatch section: one block per (segment,
+        field, kind) serves every consumer on this node."""
+        from elasticsearch_tpu import columnar
+        return columnar.STORE.stats()
 
     @staticmethod
     def _dispatch_stats_section() -> dict:

@@ -51,7 +51,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -362,70 +362,12 @@ _register()
 # ---------------------------------------------------------------------------
 
 
-class _SegmentColumn:
-    """One segment's live-row extraction for one field, cached by the
-    segment fingerprint (append-only refreshes re-extract only deltas)."""
-
-    __slots__ = ("fingerprint", "vals", "present", "objs", "multi_valued")
-
-    def __init__(self, fingerprint, vals, present, objs, multi_valued):
-        self.fingerprint = fingerprint
-        self.vals = vals            # f64[n_live] (nan where absent)
-        self.present = present      # bool[n_live]
-        self.objs = objs            # object[n_live] raw doc values (or None)
-        self.multi_valued = multi_valued
-
-
-def _extract_segment_column(view, field: str, want_objs: bool
-                            ) -> _SegmentColumn:
-    seg = view.segment
-    n_live = int(view.live.sum())
-    fp = (seg.seg_id, seg.num_docs, n_live, want_objs)
-    col = seg.doc_values.get(field)
-    vals = np.full(n_live, np.nan, dtype=np.float64)
-    present = np.zeros(n_live, dtype=bool)
-    objs = np.empty(n_live, dtype=object) if want_objs else None
-    multi = False
-    if col is not None and n_live:
-        live_idx = np.nonzero(view.live)[0]
-        raw = None
-        if want_objs or col.numeric is None:
-            raw = np.empty(n_live, dtype=object)
-            for i, loc in enumerate(live_idx):
-                v = col.values[int(loc)]
-                raw[i] = v
-                if isinstance(v, list):
-                    multi = True
-            if want_objs:
-                objs = raw
-        else:
-            # multi-valuedness must be known even for pure-numeric
-            # columns: the f64 view keeps only a doc's FIRST value, which
-            # matches numeric_values but NOT all_values — value_count
-            # (and terms) bind-checks depend on this flag being real
-            multi = any(isinstance(col.values[int(loc)], list)
-                        for loc in live_idx)
-        if col.numeric is not None:
-            vals[:] = col.numeric[live_idx]
-            present[:] = col.present[live_idx]
-            vals[~present] = np.nan
-        else:
-            # numeric view of a non-numeric-first column, with EXACTLY the
-            # aggregations.numeric_values coercion: bools -> 1/0, numerics
-            # -> float, first element of lists, strings/geo absent
-            for i in range(n_live):
-                v = raw[i]
-                if isinstance(v, list):
-                    v = v[0] if v else None
-                if v is None:
-                    continue
-                if isinstance(v, bool):
-                    vals[i] = 1.0 if v else 0.0
-                    present[i] = True
-                elif isinstance(v, (int, float)):
-                    vals[i] = float(v)
-                    present[i] = True
-    return _SegmentColumn(fp, vals, present, objs, multi)
+# per-segment doc-values extraction lives in the shared segment block
+# store (`elasticsearch_tpu/columnar/`): `ValuesBlock` is the exact
+# shape the retired `_SegmentColumn` held, extracted once per (segment,
+# field, live-set) and shared with every other consumer — this module's
+# private `_seg_cache` is gone (tpulint TPU011 keeps it from growing
+# back)
 
 
 class AggColumn:
@@ -527,11 +469,13 @@ class AggFieldStore:
 
     def __init__(self, warmup: Optional[bool] = None):
         self._columns: Dict[str, AggColumn] = {}
-        self._seg_cache: Dict[Tuple[str, int], _SegmentColumn] = {}
         self._lock = threading.Lock()
         self._snap: Optional[StoreSnapshot] = None
         self.warmup = warmup
         self.stats = {"rebuilds": 0, "columns": 0, "bytes": 0}
+        # per-field columnar composition summary of the LAST column
+        # (re)build — the `columnar` annotation `profile.aggs` carries
+        self.columnar_refresh: Dict[str, dict] = {}
         self._zero_ords: Dict[Any, Any] = {}
 
     @staticmethod
@@ -582,6 +526,7 @@ class AggFieldStore:
 
     def _build(self, reader, snap: StoreSnapshot, field: str,
                want_ords: bool) -> AggColumn:
+        from elasticsearch_tpu import columnar
         col = AggColumn(field)
         col.version = snap.version
         col.n_rows = snap.n_rows
@@ -591,17 +536,19 @@ class AggFieldStore:
         obj_parts: List[np.ndarray] = []
         off = 0
         multi = False
-        fresh: Dict[Tuple[str, int], _SegmentColumn] = {
-            k: v for k, v in self._seg_cache.items() if k[0] != field}
+        n_cached = n_extracted = 0
         for view in reader.views:
-            key = (field, view.segment.seg_id)
             n_live = int(view.live.sum())
-            fp = (view.segment.seg_id, view.segment.num_docs, n_live,
-                  want_ords)
-            sc = self._seg_cache.get(key)
-            if sc is None or sc.fingerprint != fp:
-                sc = _extract_segment_column(view, field, want_ords)
-            fresh[key] = sc
+            # shared block-store read: append-only refreshes find every
+            # pre-existing segment's block cached and extract only the
+            # delta segments (one block per (segment, field, live-set),
+            # shared with every consumer)
+            sc, was_cached = columnar.STORE.values_block(
+                view, field, want_ords)
+            if was_cached:
+                n_cached += 1
+            else:
+                n_extracted += 1
             vals[off:off + n_live] = sc.vals
             present[off:off + n_live] = sc.present
             if sc.objs is not None:
@@ -610,7 +557,11 @@ class AggFieldStore:
                 obj_parts.append(np.empty(n_live, dtype=object))
             multi = multi or sc.multi_valued
             off += n_live
-        self._seg_cache = fresh
+        mode = columnar.STORE.note_composition(
+            field, "values", n_cached, n_extracted)
+        self.columnar_refresh[field] = {
+            "blocks": n_cached + n_extracted, "cached": n_cached,
+            "extracted": n_extracted, "mode": mode}
         col.vals = vals
         col.present = present
         col.multi_valued = multi

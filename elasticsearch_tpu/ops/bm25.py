@@ -84,31 +84,11 @@ def _pow2(n: int) -> int:
     return p
 
 
-class _SegmentPostings:
-    """CSR extraction of one segment's live postings for one field."""
-
-    __slots__ = ("seg_id", "fingerprint", "terms", "slots", "freqs",
-                 "lengths", "n_live")
-
-    def __init__(self, seg_id, fingerprint, terms, slots, freqs, lengths,
-                 n_live):
-        self.seg_id = seg_id
-        self.fingerprint = fingerprint  # (seg_id, num_docs, live_count)
-        self.terms = terms      # term -> (slot_idx ascending, freqs) LOCAL live slots
-        self.slots = slots
-        self.freqs = freqs
-        self.lengths = lengths  # f32[n_live] field length per live slot
-        self.n_live = n_live
-
-
-def _extract_segment(view, field: str) -> _SegmentPostings:
-    """Live postings of one segment (`SegmentView.live_postings`) wrapped
-    with the fingerprint the refresh-delta cache keys on."""
-    seg = view.segment
-    terms, lengths, n_live = view.live_postings(field)
-    return _SegmentPostings(
-        seg.seg_id, (seg.seg_id, seg.num_docs, n_live), terms,
-        None, None, lengths, n_live)
+# per-segment postings extraction lives in the shared segment block
+# store (`elasticsearch_tpu/columnar/` — `PostingsBlock`): one
+# extraction per (segment, field, live-set), shared across fields'
+# consumers and evicted with the segment; the private per-instance
+# `_seg_cache` dict is gone (tpulint TPU011 keeps it from growing back)
 
 
 class LexicalField:
@@ -129,7 +109,9 @@ class LexicalField:
         self.tile_impacts = np.zeros((0, TILE), dtype=np.float32)
         self.term_tiles: Dict[str, Tuple[int, int]] = {}  # term -> (first, n)
         self.nnz = 0
-        self._seg_cache: Dict[int, _SegmentPostings] = {}
+        # columnar composition summary of the LAST rebuild (profile /
+        # stats annotation — the delta-vs-full extraction ledger)
+        self.columnar_refresh: dict = {}
         self._device = None             # (slots, impacts[, scales]) jnp arrays
         self._device_version: tuple = ()
         # mesh-replicated tile mirrors, one entry per mesh the router
@@ -141,23 +123,30 @@ class LexicalField:
     # ------------------------------------------------------------- build
     def sync(self, reader) -> bool:
         """(Re)build from a reader snapshot; returns True if rebuilt.
-        Per-segment extractions are cached by fingerprint, so append-only
-        refreshes pay extraction only for the delta segments."""
+        Per-segment extractions come from the shared segment block store
+        (`columnar.STORE.postings_block`, cached by fingerprint), so
+        append-only refreshes pay tokenized extraction only for the
+        delta segments."""
+        from elasticsearch_tpu import columnar
         version = tuple((v.segment.seg_id, v.segment.num_docs,
                          int(v.live.sum())) for v in reader.views)
         if version == self.version:
             return False
-        segs: List[_SegmentPostings] = []
-        fresh: Dict[int, _SegmentPostings] = {}
+        segs: List = []
+        n_cached = n_extracted = 0
         for view in reader.views:
-            fp = (view.segment.seg_id, view.segment.num_docs,
-                  int(view.live.sum()))
-            cached = self._seg_cache.get(view.segment.seg_id)
-            if cached is None or cached.fingerprint != fp:
-                cached = _extract_segment(view, self.field)
-            fresh[view.segment.seg_id] = cached
-            segs.append(cached)
-        self._seg_cache = fresh
+            blk, was_cached = columnar.STORE.postings_block(
+                view, self.field)
+            if was_cached:
+                n_cached += 1
+            else:
+                n_extracted += 1
+            segs.append(blk)
+        mode = columnar.STORE.note_composition(
+            self.field, "postings", n_cached, n_extracted)
+        self.columnar_refresh = {
+            "blocks": n_cached + n_extracted, "cached": n_cached,
+            "extracted": n_extracted, "mode": mode}
 
         # dense slot space: segment-major, ascending local order — the
         # row map is therefore ascending iff reader views are base-ordered

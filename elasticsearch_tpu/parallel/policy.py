@@ -26,6 +26,19 @@ Settings (read once at node boot, `node.py` calls `configure`):
                            single-device (the all-gather merge + per-leg
                            SPMD overhead only pays for itself once the
                            local matmul dominates; default 32768)
+  search.mesh.hbm_budget_bytes
+                           device-memory budget for mesh-resident corpus
+                           copies. Replication costs dp× device bytes,
+                           so with dp > 1 a corpus is mesh-eligible only
+                           while dp × its estimated device footprint
+                           (the columnar store's per-field accounting,
+                           `vectors/store.device_corpus_nbytes`) fits
+                           the budget — before this gate only
+                           `min_rows` guarded eligibility, and a large
+                           corpus under dp=4 quadrupled HBM silently.
+                           Default unset: no budget (real budgets come
+                           from deployment sizing; CPU-sim hosts have
+                           no HBM to guard).
 
 With dp > 1 the router additionally chooses a dp-vs-shard SPLIT per
 dispatch: a batch under queue pressure lands on one dp group (round-
@@ -44,6 +57,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from typing import Optional
 
 logger = logging.getLogger(__name__)
 
@@ -54,7 +68,7 @@ DEFAULT_MIN_ROWS = 32_768
 
 _lock = threading.Lock()
 _cfg = {"enabled": None, "num_shards": None, "min_rows": DEFAULT_MIN_ROWS,
-        "dp": None}
+        "dp": None, "hbm_budget_bytes": None}
 _mesh = None          # cached jax Mesh (built lazily)
 _mesh_built = False   # latch: None is a valid cache value (no mesh)
 # dp-group submeshes per FULL mesh, keyed by mesh equality: the dispatch
@@ -77,6 +91,11 @@ _counters = {
     "dp_routes": {"shard": 0, "dp": 0},
     "dp_reasons": {},         # split reason -> count
     "dp_group_dispatches": {},  # group index -> dispatches routed to it
+    # dp-aware HBM budget gate (eligible()): corpora whose dp-replicated
+    # device footprint exceeded search.mesh.hbm_budget_bytes
+    "hbm_rejections": 0,
+    "hbm_last_rejected_bytes": 0,
+    "hbm_accepted_bytes": 0,    # high-water accepted dp× footprint
     # per-leg timing: local = the SPMD program (shard-local score + ICI
     # merge, one compiled unit), merge = host-side result shaping
     "legs": {},               # leg -> {local_nanos, merge_nanos,
@@ -88,7 +107,7 @@ _UNSET = object()
 
 
 def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET,
-              dp=_UNSET) -> None:
+              dp=_UNSET, hbm_budget_bytes=_UNSET) -> None:
     """Install `search.mesh.*` settings. PARTIAL update: only the
     keyword arguments the caller passes change — a node that sets one
     key must not clobber the others an earlier in-process node
@@ -108,6 +127,10 @@ def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET,
                                 else DEFAULT_MIN_ROWS)
         if dp is not _UNSET:
             _cfg["dp"] = int(dp) if dp is not None else None
+        if hbm_budget_bytes is not _UNSET:
+            _cfg["hbm_budget_bytes"] = (int(hbm_budget_bytes)
+                                        if hbm_budget_bytes is not None
+                                        else None)
         _mesh, _mesh_built = None, False
         _groups.clear()
         _shard_meshes.clear()
@@ -252,12 +275,51 @@ def mesh_for_shards(n_shards: int):
         return _shard_meshes.setdefault(n_shards, built)
 
 
-def eligible(n_rows: int) -> bool:
-    """Build-time check (no decision counted): is this corpus one the
-    router could ever send to the mesh? Gates the sharded upload at
-    refresh so small indexes never pay the second resident copy."""
-    return (n_rows >= _cfg["min_rows"] and _cfg["enabled"] is not False
-            and serving_mesh() is not None)
+def eligible(n_rows: int, device_bytes: Optional[int] = None) -> bool:
+    """Build-time check (no routing decision counted): is this corpus
+    one the router could ever send to the mesh? Gates the sharded
+    upload at refresh so small indexes never pay the second resident
+    copy.
+
+    `device_bytes` is the field's estimated single-copy device
+    footprint (the columnar store's per-field accounting). Replication
+    multiplies it by the dp-axis size — each dp group holds the whole
+    sharded corpus — so with a `search.mesh.hbm_budget_bytes` budget
+    configured, a corpus whose dp× footprint exceeds the budget stays
+    single-device (counted under `stats()["hbm"]`)."""
+    if (n_rows < _cfg["min_rows"] or _cfg["enabled"] is False):
+        return False
+    mesh = serving_mesh()
+    if mesh is None:
+        return False
+    return hbm_allows(device_bytes, mesh)
+
+
+def hbm_allows(device_bytes: Optional[int], mesh=None) -> bool:
+    """The budget-only half of `eligible()`, for consumers whose mesh
+    participation is fixed externally (the node.py multi-shard adapter
+    maps one engine shard per mesh column regardless of `min_rows`):
+    with `search.mesh.hbm_budget_bytes` configured, a dp-replicated
+    footprint past the budget is rejected and counted."""
+    budget = _cfg["hbm_budget_bytes"]
+    if budget is None or device_bytes is None:
+        return True
+    if mesh is None:
+        mesh = serving_mesh()
+    if mesh is None:
+        return True
+    from elasticsearch_tpu.parallel import mesh as mesh_lib
+    dp = mesh_lib.dp_size(mesh)
+    need = int(device_bytes) * max(dp, 1)
+    if need > budget:
+        with _lock:
+            _counters["hbm_rejections"] += 1
+            _counters["hbm_last_rejected_bytes"] = need
+        return False
+    with _lock:
+        _counters["hbm_accepted_bytes"] = max(
+            _counters["hbm_accepted_bytes"], need)
+    return True
 
 
 def _choose_split(batch, n_rows: int, queue_depth: int, dp: int,
@@ -393,6 +455,14 @@ def stats() -> dict:
             "devices": {"total": n_shards * dp, "shard_axis": n_shards,
                         "dp_axis": dp},
             "min_rows": _cfg["min_rows"],
+            "hbm": {
+                "budget_bytes": _cfg["hbm_budget_bytes"],
+                "rejections": _counters["hbm_rejections"],
+                "last_rejected_bytes":
+                    _counters["hbm_last_rejected_bytes"],
+                "accepted_bytes_high_water":
+                    _counters["hbm_accepted_bytes"],
+            },
             "router": {
                 "mesh": _counters["decisions_mesh"],
                 "single_device": _counters["decisions_single_device"],
@@ -425,12 +495,16 @@ def reset(full: bool = False) -> None:
         _counters["dp_routes"] = {"shard": 0, "dp": 0}
         _counters["dp_reasons"].clear()
         _counters["dp_group_dispatches"].clear()
+        _counters["hbm_rejections"] = 0
+        _counters["hbm_last_rejected_bytes"] = 0
+        _counters["hbm_accepted_bytes"] = 0
         _rr = 0
         for leg in _counters["searches"]:
             _counters["searches"][leg] = 0
         if full:
             _cfg.update({"enabled": None, "num_shards": None,
-                         "min_rows": DEFAULT_MIN_ROWS, "dp": None})
+                         "min_rows": DEFAULT_MIN_ROWS, "dp": None,
+                         "hbm_budget_bytes": None})
             _mesh, _mesh_built = None, False
             _groups.clear()
             _shard_meshes.clear()

@@ -456,6 +456,14 @@ class AggEngine:
             self.stats["assemble_nanos"] += assemble_nanos
         profile = {"nodes": prof_nodes, "device_nanos": device_nanos,
                    "assemble_nanos": assemble_nanos}
+        if self.store.columnar_refresh:
+            # per-field segment-block-store composition of the last
+            # column (re)build — surfaces as profile.aggregations[].
+            # columnar so the delta-vs-full extraction story is visible
+            # per request
+            profile["columnar"] = {
+                f: dict(v)
+                for f, v in self.store.columnar_refresh.items()}
         return out, profile
 
     # ----------------------------------------------------------- dispatch

@@ -60,8 +60,16 @@ class KnnQuery(Query):
                                      deadline_at=getattr(
                                          ctx, "deadline_at", None))
             # per-phase engine timings (route/score/merge for tpu_ivf) for
-            # the profiler and shard result
+            # the profiler and shard result; plus the columnar refresh
+            # ledger for this field (segment block store): how the last
+            # sync composed — cached / delta / full extraction — so
+            # profile.knn shows the O(delta) claim per search instead of
+            # burying it in node stats
             phases = getattr(store, "last_knn_phases", None)
+            col = getattr(store, "columnar_refresh", None)
+            if col and self.field in col:
+                phases = dict(phases or {})
+                phases.setdefault("columnar", col[self.field])
             if phases:
                 ctx.knn_phases = phases
         else:

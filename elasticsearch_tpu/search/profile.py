@@ -170,7 +170,11 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
                            # (segments/): how many device generations
                            # this search fanned over and what it masked
                            "generations", "l0_generations",
-                           "tombstoned_rows", "legs")
+                           "tombstoned_rows", "legs",
+                           # columnar segment-block-store ledger: the
+                           # field's last refresh composition (cached /
+                           # delta / full extraction counts)
+                           "columnar")
                if key in knn_phases},
             "breakdown": {
                 key: knn_phases[key]
@@ -238,6 +242,12 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
                     entry["fallback_reason"] = info["fallback_reason"]
             entries.append(entry)
         profile["aggregations"] = entries
+        if (aggs_profile or {}).get("columnar"):
+            # segment-block-store ledger for the agg columns this
+            # request read (per field: blocks, cached vs extracted,
+            # composition mode) — the profile half of
+            # `_nodes/stats indices.columnar`
+            profile["columnar"] = aggs_profile["columnar"]
     return profile
 
 

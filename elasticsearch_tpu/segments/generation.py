@@ -4,8 +4,10 @@
 The device analog of a sealed Lucene segment: a `Corpus` pytree padded to
 the pow-2 row-bucket ladder (`ops/dispatch.bucket_gen_rows`) plus the host
 bookkeeping a generation carries through its life — the engine-row map,
-the raw host vectors (the merge scheduler's input), and the tombstone
-mask deletes flip instead of triggering a rebuild.
+a `columnar.RowSource` resolving the raw host rows through the SHARED
+segment block store (the merge scheduler's input — generations pin no
+private corpus-sized copy), and the tombstone mask deletes flip instead
+of triggering a rebuild.
 
 Generations are copy-on-write: tombstoning returns a NEW object sharing
 the device corpus, so a search dispatched against a previously-installed
@@ -60,19 +62,22 @@ dispatch.DISPATCH.register(
 class Generation:
     """Immutable device generation + host bookkeeping."""
 
-    __slots__ = ("gen_id", "corpus", "row_map", "host_vectors",
+    __slots__ = ("gen_id", "corpus", "row_map", "source",
                  "tombstones", "kernel", "host", "router", "mesh_state",
                  "_live_cache")
 
     def __init__(self, gen_id: int, corpus, row_map: np.ndarray,
-                 host_vectors: np.ndarray,
-                 tombstones: Optional[np.ndarray] = None,
+                 source, tombstones: Optional[np.ndarray] = None,
                  kernel: str = "segments.knn", host=None, router=None,
                  mesh_state=None):
         self.gen_id = gen_id
         self.corpus = corpus              # knn_ops.Corpus (device pytree)
         self.row_map = row_map            # [n_rows] engine global rows
-        self.host_vectors = host_vectors  # [n_rows, d] raw f32 (merge input)
+        # columnar.RowSource: the merge scheduler's host-row input,
+        # resolved through the SHARED segment block store on demand — a
+        # generation never retains a private corpus-sized f32 copy
+        # (the pre-columnar `host_vectors` pin doubled host RAM)
+        self.source = source
         self.tombstones = (np.zeros(len(row_map), dtype=bool)
                            if tombstones is None else tombstones)
         # dispatch kernel: "knn.exact" for the legacy lane-padded full
@@ -96,6 +101,19 @@ class Generation:
     @property
     def tier(self) -> int:
         return generation_tier(self.n_rows)
+
+    @property
+    def host_vectors(self) -> np.ndarray:
+        """Materialize this generation's raw f32 rows from the shared
+        block store (transient — callers must not hold the result; the
+        compat shape of the retired pinned array)."""
+        return self.source.gather()
+
+    def host_pinned_nbytes(self) -> int:
+        """Host bytes this generation PINS privately beyond the shared
+        segment blocks — 0 on every store-backed path (the
+        merge-does-not-pin invariant)."""
+        return self.source.private_nbytes()
 
     @property
     def dead_rows(self) -> int:
@@ -123,11 +141,11 @@ class Generation:
     # ----------------------------------------------------------- copies
     def with_tombstones(self, tombstones: np.ndarray) -> "Generation":
         """Copy-on-write tombstone install: shares the device corpus and
-        host vectors, drops the graduated router (its partition layout
+        the row source, drops the graduated router (its partition layout
         would keep returning dead rows — the merge scheduler rebuilds it
         at compaction); the mesh state stays (searches mask it)."""
         return Generation(self.gen_id, self.corpus, self.row_map,
-                          self.host_vectors, tombstones=tombstones,
+                          self.source, tombstones=tombstones,
                           kernel=self.kernel, host=None, router=None,
                           mesh_state=self.mesh_state)
 
@@ -156,14 +174,23 @@ class Generation:
 
 
 def build_generation(gen_id: int, vectors: np.ndarray, row_map: np.ndarray,
-                     metric: str, dtype: str,
-                     rescore: bool = False) -> Generation:
+                     metric: str, dtype: str, rescore: bool = False,
+                     source=None) -> Generation:
     """Seal host rows into a device generation padded to the pow-2
-    row-bucket ladder — the refresh path's ONLY device work, O(delta)."""
+    row-bucket ladder — the refresh path's ONLY device work, O(delta).
+
+    `source` is the columnar RowSource covering exactly these rows (the
+    store-backed, pin-free merge input). When omitted (direct test
+    construction), the materialized `vectors` array is wrapped as a
+    private source — which pins it, so production callers always pass
+    the store-backed source."""
     vectors = np.asarray(vectors, dtype=np.float32)
     n = len(vectors)
     corpus = knn_ops.build_corpus(
         vectors, metric=metric, dtype=dtype,
         pad_to=dispatch.bucket_gen_rows(n), residual=rescore)
+    if source is None:
+        from elasticsearch_tpu.columnar import RowSource
+        source = RowSource.from_array(vectors)
     return Generation(gen_id, corpus, np.asarray(row_map, dtype=np.int64),
-                      vectors, kernel="segments.knn")
+                      source, kernel="segments.knn")

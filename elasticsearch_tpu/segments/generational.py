@@ -342,18 +342,23 @@ class GenerationalCorpus:
 
     # ------------------------------------------------------------ set-up
     @classmethod
-    def from_monolithic(cls, corpus, row_map: np.ndarray,
-                        host_vectors: np.ndarray, metric: str, dtype: str,
+    def from_monolithic(cls, corpus, row_map: np.ndarray, source,
+                        metric: str, dtype: str,
                         rescore: bool, dims: int, host=None, router=None,
                         mesh_state=None, **kwargs) -> "GenerationalCorpus":
         """Wrap a legacy full build as generation 0 (kernel `knn.exact`
-        — the monolithic grid the store already warms)."""
+        — the monolithic grid the store already warms). `source` is the
+        columnar RowSource over the build's rows (store-backed on the
+        sync path, so the base generation pins nothing); a raw ndarray
+        is accepted for direct construction and wrapped (pinning)."""
+        from elasticsearch_tpu.columnar import RowSource
+        if isinstance(source, np.ndarray):
+            source = RowSource.from_array(source)
         gc = cls(metric, dtype, rescore, dims, **kwargs)
         gen = Generation(gc._next_gen_id, corpus,
                          np.asarray(row_map, dtype=np.int64),
-                         np.asarray(host_vectors, dtype=np.float32),
-                         kernel="knn.exact", host=host, router=router,
-                         mesh_state=mesh_state)
+                         source, kernel="knn.exact", host=host,
+                         router=router, mesh_state=mesh_state)
         gc._next_gen_id += 1
         gc._set = GenerationSet((gen,))
         return gc
@@ -363,13 +368,16 @@ class GenerationalCorpus:
             return self._set
 
     # ----------------------------------------------------------- refresh
-    def try_incremental(self, full: np.ndarray, row_map: np.ndarray,
+    def try_incremental(self, view, row_map: np.ndarray,
                         dtype: str, metric: str,
                         rescore: bool) -> Optional[str]:
         """Absorb one refresh as tombstones + an L0 seal. Returns the
         outcome string ("append" / "delete" / "append+delete" / "noop"),
         or None when only a full rebuild can represent the new reader
-        (`last_rebuild_reason` says why). O(delta) device work; the host
+        (`last_rebuild_reason` says why). O(delta) END TO END: `view` is
+        the columnar store's lazy `FieldRowsView` — only the DELTA rows
+        ever materialize (a pure append touches the tail blocks alone,
+        which the store extracted delta-only too); the host
         classification is one isin pass over the row maps."""
         with self._lock:
             cur = self._set
@@ -386,9 +394,10 @@ class GenerationalCorpus:
             deleted_any = False
             if len(new) >= len(old_live) \
                     and np.array_equal(new[:len(old_live)], old_live):
-                # fast path: pure append (the steady-state refresh)
+                # fast path: pure append (the steady-state refresh) —
+                # only the tail rows materialize from the block store
                 added = new[len(old_live):]
-                added_vecs = full[len(old_live):]
+                added_src = view.source_slice(len(old_live))
             else:
                 keep = np.isin(new, old_rows)
                 added = new[~keep]
@@ -405,7 +414,7 @@ class GenerationalCorpus:
                 if not np.array_equal(old_live[still], survivors):
                     self.last_rebuild_reason = "segment_rewrite"
                     return None
-                added_vecs = full[~keep]
+                added_src = view.source_select(~keep)
                 gens = []
                 for g in cur.generations:
                     gone = g.live_mask() & np.isin(g.row_map, new,
@@ -429,10 +438,12 @@ class GenerationalCorpus:
             # feed the build latency straight into search p99 during
             # ingest. Appending at the END of the CURRENT set is safe
             # against a merge installing in between (merges splice
-            # interior runs; the tail position is never theirs).
-            sealed = build_generation(gen_id, added_vecs, added,
+            # interior runs; the tail position is never theirs). The
+            # delta gather is the ONLY host materialization this refresh
+            # pays; the sealed generation keeps the store-backed source.
+            sealed = build_generation(gen_id, added_src.gather(), added,
                                       self.metric, self.dtype,
-                                      self.rescore)
+                                      self.rescore, source=added_src)
             with self._lock:
                 self.stats["seals"] += 1
                 self.stats["sealed_rows"] += len(added)
@@ -572,12 +583,22 @@ class GenerationalCorpus:
                       victims: Tuple[Generation, ...]) -> Generation:
         """Concatenate the victims' LIVE rows and seal the consolidated
         generation; a merge producing the new base (start == 0) also
-        graduates it into the IVF layout and the sharded mesh corpus."""
+        graduates it into the IVF layout and the sharded mesh corpus.
+
+        The victim-gather reads live rows THROUGH the shared segment
+        block store (each victim's `RowSource`): the f32 concatenation
+        is a merge-local transient handed to the corpus build and the
+        graduation steps, then dropped — the merged generation keeps
+        only the narrowed block references, so merge-input host RAM is
+        O(1) in corpus size beyond what the engine segments already
+        hold (the pre-columnar path pinned a full `host_vectors` copy
+        per generation for its whole lifetime)."""
+        from elasticsearch_tpu.columnar import RowSource
         d = self.dims
-        vecs = [g.host_vectors[g.live_mask()] for g in victims]
+        src = RowSource.concat(
+            [g.source.select(g.live_mask()) for g in victims])
         rows = [g.row_map[g.live_mask()] for g in victims]
-        vecs = (np.concatenate(vecs) if vecs
-                else np.zeros((0, d), dtype=np.float32))
+        vecs = src.gather()
         if vecs.size == 0:
             vecs = vecs.reshape(0, d)
         rows = (np.concatenate(rows) if rows
@@ -586,18 +607,22 @@ class GenerationalCorpus:
             gen_id = self._next_gen_id
             self._next_gen_id += 1
         merged = build_generation(gen_id, vecs, rows, self.metric,
-                                  self.dtype, self.rescore)
+                                  self.dtype, self.rescore, source=src)
         if spec.start == 0:
-            merged.router = self._graduate_ivf(victims[0], merged)
-            merged.mesh_state = self._graduate_mesh(victims[0], merged)
-            merged.host = self._graduate_host(merged)
+            merged.router = self._graduate_ivf(victims[0], merged, vecs)
+            merged.mesh_state = self._graduate_mesh(victims[0], merged,
+                                                    vecs)
+            merged.host = self._graduate_host(merged, vecs)
         if self.warmup_cb is not None:
             self.warmup_cb(merged.warmup_entries(self.dims, self.metric))
         return merged
 
-    def _graduate_ivf(self, old_base: Generation, merged: Generation):
+    def _graduate_ivf(self, old_base: Generation, merged: Generation,
+                      vecs: np.ndarray):
         """Re-enter the trained IVF layout (clone + add the delta), or
-        retrain from scratch — ALWAYS on this merge thread."""
+        retrain from scratch — ALWAYS on this merge thread. `vecs` is
+        the merge's transient store-read materialization (no
+        re-gather, no pinned copy)."""
         params = self.knn_params
         if params.get("engine") != "tpu_ivf":
             return None
@@ -615,7 +640,7 @@ class GenerationalCorpus:
             # the CLONED layout (copy-on-write — the serving router's
             # host mirror and device pytree stay untouched mid-merge)
             idx = old.index.clone()
-            idx.add(merged.host_vectors[old_base.n_rows:],
+            idx.add(vecs[old_base.n_rows:],
                     np.arange(old_base.n_rows, merged.n_rows,
                               dtype=np.int32))
             if not idx.needs_retrain:
@@ -627,14 +652,14 @@ class GenerationalCorpus:
             self.stats["ivf_background_builds"] += 1
         nlist = params.get("nlist")
         ivf = build_ivf_index(
-            merged.host_vectors, metric=self.metric,
+            vecs, metric=self.metric,
             nlist=int(nlist) if nlist is not None else None,
             dtype=self.dtype, seed=0)
         return IVFRouter(ivf, nprobe=params.get("nprobe", "auto"),
                          recall_target=float(
                              params.get("recall_target", 0.95)))
 
-    def _graduate_host(self, merged: Generation):
+    def _graduate_host(self, merged: Generation, vecs: np.ndarray):
         """Rebuild the host VNNI latency mirror for the new base — same
         eligibility policy as the monolithic sync path, built HERE so a
         consolidated corpus keeps the low-latency host route instead of
@@ -647,14 +672,21 @@ class GenerationalCorpus:
                 or merged.n_rows == 0
                 or packed_nbytes(merged.n_rows, self.dims) > max_bytes):
             return None
-        return HostFieldCorpus(merged.host_vectors, self.metric)
+        return HostFieldCorpus(vecs, self.metric)
 
-    def _graduate_mesh(self, old_base: Generation, merged: Generation):
+    def _graduate_mesh(self, old_base: Generation, merged: Generation,
+                       vecs: np.ndarray):
         """Graduate the merged base into the sharded serving corpus —
         delta append into per-shard headroom when the old base is a
-        clean prefix, full SPMD build otherwise."""
+        clean prefix, full SPMD build otherwise. Eligibility accounts
+        the dp-replicated HBM cost of the sharded copy
+        (`parallel/policy.eligible`)."""
         from elasticsearch_tpu.parallel import policy as mesh_policy
-        if not mesh_policy.eligible(merged.n_rows):
+        from elasticsearch_tpu.vectors.store import device_corpus_nbytes
+        if not mesh_policy.eligible(
+                merged.n_rows,
+                device_bytes=device_corpus_nbytes(
+                    merged.n_rows, self.dims, self.dtype)):
             return None
         mesh = mesh_policy.serving_mesh()
         if mesh is None:
@@ -663,7 +695,7 @@ class GenerationalCorpus:
         old_ms = (old_base.mesh_state
                   if not old_base.has_tombstones else None)
         state, appended = extend_or_build(
-            old_ms, merged.host_vectors, old_base.n_rows, mesh,
+            old_ms, vecs, old_base.n_rows, mesh,
             self.metric, self.dtype)
         if not appended:
             with self._lock:

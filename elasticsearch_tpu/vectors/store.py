@@ -121,25 +121,31 @@ def _pad_batch(queries: np.ndarray, n_real: int) -> np.ndarray:
 def extract_field_rows(reader: ShardReader, field: str
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """(matrix [m, d] f32, row_map [m] engine global rows) for one vector
-    field from ONE reader snapshot — the single source of truth for both
-    the per-shard store sync and the mesh-sharded layout (keeping the two
-    row spaces aligned by construction)."""
-    mats: List[np.ndarray] = []
-    rows: List[np.ndarray] = []
-    for view in reader.views:
-        seg = view.segment
-        if field not in seg.vectors:
-            continue
-        mat, present = seg.vectors[field]
-        keep = present & view.live
-        locs = np.nonzero(keep)[0]
-        if len(locs):
-            mats.append(np.asarray(mat[locs], dtype=np.float32))
-            rows.append(locs.astype(np.int64) + seg.base)
-    if not mats:
-        return (np.zeros((0, 0), dtype=np.float32),
-                np.zeros(0, dtype=np.int64))
-    return np.concatenate(mats, axis=0), np.concatenate(rows)
+    field from ONE reader snapshot — now a segment-block-store read
+    (`elasticsearch_tpu/columnar/`): per-segment blocks extract once and
+    cache by fingerprint, so only delta segments pay extraction. This
+    entry MATERIALIZES the full matrix (block concatenation) and exists
+    for consumers that genuinely need the whole corpus contiguous (the
+    multi-shard mesh layout build in `node.py`); the per-shard sync path
+    below reads the lazy `FieldRowsView` instead and stays O(delta) on
+    append-only refreshes."""
+    from elasticsearch_tpu import columnar
+    view = columnar.STORE.vector_view(reader, field)
+    return view.matrix(), view.row_map
+
+
+_DTYPE_BYTES = {"bf16": 2, "f32": 4, "int8": 1,
+                "bfloat16": 2, "float32": 4}
+
+
+def device_corpus_nbytes(n_rows: int, dims: int, dtype: str) -> int:
+    """Estimated resident device bytes of one field's corpus (matrix +
+    f32 norms + int8 scales) — the per-field accounting the mesh
+    policy's dp-aware HBM budget reads (`parallel/policy.eligible`)."""
+    per = _DTYPE_BYTES.get(dtype, 4)
+    n = max(int(n_rows), 0)
+    scales = 4 * n if dtype == "int8" else 0
+    return n * int(dims) * per + 4 * n + scales
 
 
 class VectorStoreShard:
@@ -199,6 +205,11 @@ class VectorStoreShard:
         self.segment_counters: Dict[str, object] = {
             "full_rebuilds": 0, "rebuilds_avoided": 0,
             "rebuild_reasons": {}}
+        # per-field columnar composition summary of the LAST sync
+        # ({blocks, cached, extracted, mode}) — the `columnar`
+        # annotation `profile.knn` attaches so the O(delta) refresh
+        # claim is inspectable per search
+        self.columnar_refresh: Dict[str, dict] = {}
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -250,12 +261,18 @@ class VectorStoreShard:
         and incompatible reader shapes (dtype change, engine segment
         rewrite) fall through to the monolithic full build, which is
         counted and logged as the rebuild stall it is."""
+        from elasticsearch_tpu import columnar
         for field, mapper in vector_mappers.items():
             version = self._fingerprint(reader, field)
             cached = self._fields.get(field)
             if cached is not None and cached.version == version:
                 continue
-            full, row_map = extract_field_rows(reader, field)
+            # block-store read: per-segment extraction is delta-only by
+            # construction; nothing corpus-sized materializes unless a
+            # monolithic rebuild below actually needs the full matrix
+            view = columnar.STORE.vector_view(reader, field)
+            row_map = view.row_map
+            self.columnar_refresh[field] = view.refresh
             metric = _METRIC_MAP[mapper.similarity]
             if len(row_map) == 0:
                 self._fields[field] = FieldCorpus(None, np.zeros(0, dtype=np.int64),
@@ -272,7 +289,7 @@ class VectorStoreShard:
                 if cached is None or self._reader_prefix_ok(
                         cached.version, version):
                     outcome = gc.try_incremental(
-                        full, row_map, dtype=dtype, metric=metric,
+                        view, row_map, dtype=dtype, metric=metric,
                         rescore=rescore)
                 else:
                     # the engine rewrote segments (merge): row ids were
@@ -294,6 +311,10 @@ class VectorStoreShard:
             rebuild_reason = (gc.last_rebuild_reason if gc is not None
                               else self._rebuild_reason(cached, row_map,
                                                         dtype))
+            # monolithic rebuild: the ONE sync shape that materializes
+            # the whole matrix (block concatenation — extraction itself
+            # was still delta-cached above)
+            full = view.matrix()
             # `"rescore": true` in index_options additionally keeps the
             # residual rescore level — the analog of Lucene retaining raw
             # f32 vectors beside the quantized copy (reference
@@ -352,7 +373,10 @@ class VectorStoreShard:
                         recall_target=self.knn_recall_target)
             mesh_state = None
             from elasticsearch_tpu.parallel import policy as mesh_policy
-            if mesh_policy.eligible(len(row_map)):
+            if mesh_policy.eligible(
+                    len(row_map),
+                    device_bytes=device_corpus_nbytes(
+                        len(row_map), mapper.dims, dtype)):
                 from elasticsearch_tpu.parallel.sharded_knn import (
                     extend_or_build)
                 mesh = mesh_policy.serving_mesh()
@@ -387,8 +411,8 @@ class VectorStoreShard:
                 from elasticsearch_tpu.segments import (
                     GenerationalCorpus, TieredMergePolicy)
                 gens = GenerationalCorpus.from_monolithic(
-                    corpus, row_map, full, metric, dtype, rescore,
-                    mapper.dims, host=host, router=router,
+                    corpus, row_map, view.as_source(), metric, dtype,
+                    rescore, mapper.dims, host=host, router=router,
                     mesh_state=mesh_state,
                     policy=TieredMergePolicy(self.segments_tier_size,
                                              self.segments_max_l0),
@@ -576,7 +600,12 @@ class VectorStoreShard:
             from elasticsearch_tpu.parallel import sharded_ivf
             idx = fc.router.index
             mesh = (mesh_policy.serving_mesh()
-                    if mesh_policy.eligible(len(fc.row_map)) else None)
+                    if mesh_policy.eligible(
+                        len(fc.row_map),
+                        device_bytes=device_corpus_nbytes(
+                            len(fc.row_map), fc.dims,
+                            str(fc.corpus.matrix.dtype)))
+                    else None)
             nprobe_known = (fc.router.nprobe_setting != "auto"
                             or fc.router._tuned_nprobe is not None)
             if mesh is not None and idx.total > 0 and nprobe_known:

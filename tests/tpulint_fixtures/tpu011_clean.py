@@ -1,0 +1,37 @@
+"""TPU011 clean: block-store reads and transient per-pass locals."""
+# tpulint: hot-path
+
+
+def extract(view, field):
+    return object()
+
+
+def column(view, field):
+    # the sanctioned shape: per-(segment, field) extraction through the
+    # shared segment block store
+    from elasticsearch_tpu import columnar
+    blk, _cached = columnar.STORE.values_block(view, field, False)
+    return blk
+
+
+def merge_pass(views, field):
+    # a TRANSIENT local keyed by seg_id inside one pass caches nothing
+    # across refreshes — not a private extraction cache
+    local = {}
+    for v in views:
+        local[v.segment.seg_id] = extract(v, field)
+    return local
+
+
+class PlanEngine:
+    def __init__(self):
+        self._plans = {}
+
+    def plan(self, body_key):
+        # a persistent dict keyed by something OTHER than segment
+        # identity is not this rule's business
+        cached = self._plans.get(body_key)
+        if cached is None:
+            cached = object()
+            self._plans[body_key] = cached
+        return cached

@@ -1,0 +1,42 @@
+"""TPU011 fires: private per-segment extraction caches outside columnar/."""
+# tpulint: hot-path
+
+_EXTRACTIONS = {}
+
+
+def extract(view, field):
+    return object()
+
+
+class ColumnStore:
+    def __init__(self):
+        self._seg_cache = {}
+
+    def column(self, view, field):
+        fp = (view.segment.seg_id, view.segment.num_docs)
+        col = self._seg_cache.get((field, view.segment.seg_id))  # [expect] name-matched private segment cache
+        if col is None or col.fingerprint != fp:
+            col = extract(view, field)
+            self._seg_cache[(field, view.segment.seg_id)] = col  # [expect] store into the private cache
+        return col
+
+
+class PostingsStore:
+    def __init__(self):
+        self._by_segment = {}
+
+    def postings(self, view, field):
+        fp = (view.segment.seg_id, view.segment.num_docs)
+        cached = self._by_segment.get(fp)  # [expect] fingerprint-keyed persistent dict
+        if cached is None:
+            cached = extract(view, field)
+            self._by_segment[fp] = cached  # [expect] fingerprint-keyed store
+        return cached
+
+
+def cached_block(view, field):
+    entry = _EXTRACTIONS.get(view.segment.seg_id)  # [expect] seg_id-keyed module-level cache
+    if entry is None:
+        entry = extract(view, field)
+        _EXTRACTIONS[view.segment.seg_id] = entry  # [expect] seg_id-keyed module-level store
+    return entry

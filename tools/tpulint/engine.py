@@ -47,6 +47,9 @@ DEFAULT_RAW_SHARD_MAP_ALLOWED = ("*/parallel/sharded_knn.py",)
 # the one module allowed to enter enable_x64 (TPU006): the dispatcher's
 # scoped-x64 path (`register(..., x64=True)`)
 DEFAULT_X64_ALLOWED = ("*/ops/dispatch.py",)
+# the one package allowed to hold per-segment extraction caches
+# (TPU011): the shared segment block store every consumer reads through
+DEFAULT_SEG_CACHE_ALLOWED = ("*/columnar/*.py",)
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -81,6 +84,7 @@ class Config:
     raw_jit_allowed: Sequence[str] = DEFAULT_RAW_JIT_ALLOWED
     raw_shard_map_allowed: Sequence[str] = DEFAULT_RAW_SHARD_MAP_ALLOWED
     x64_allowed: Sequence[str] = DEFAULT_X64_ALLOWED
+    seg_cache_allowed: Sequence[str] = DEFAULT_SEG_CACHE_ALLOWED
     select: Optional[Sequence[str]] = None   # rule ids; None = all
 
 

@@ -1038,8 +1038,112 @@ class UnguardedFanoutRule(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TPU011 — private per-segment extraction caches outside columnar/
+# ---------------------------------------------------------------------------
+
+_SEG_KEY_ATTRS = frozenset({"seg_id", "fingerprint"})
+_SEG_KEY_NAMES = frozenset({"seg_id", "fingerprint", "fp"})
+_DICT_READERS = frozenset({"get", "setdefault", "pop"})
+
+
+class PrivateSegmentCacheRule(Rule):
+    """TPU011: private per-segment extraction caches outside
+    `elasticsearch_tpu/columnar/`.
+
+    Historical context (PR 13): three subsystems each grew a private
+    per-segment extraction cache — the vector store's per-refresh
+    extract, `ops/aggs.py`'s `_seg_cache`, `ops/bm25.py`'s
+    `_seg_cache` — with three sets of fingerprint semantics and three
+    lifetimes. The duplication is why refresh paid an O(corpus) host
+    memcpy per vector field and why every `Generation` pinned its own
+    corpus-sized `host_vectors`. The columnar segment block store now
+    owns per-(segment, field) extraction: blocks extract once, share
+    across consumers, and evict with the segment. This rule keeps a
+    fourth private cache from growing back: in hot-path modules outside
+    `columnar/`, a PERSISTENT dict (an instance attribute on `self` or
+    a module-level container) read or written with a key derived from
+    `seg_id`/`fingerprint` — or whose very name says segment-cache — is
+    a finding; read through `columnar.STORE` instead. Transient locals
+    keyed by seg_id inside one pass are fine (they cache nothing across
+    refreshes).
+    """
+
+    rule_id = "TPU011"
+    summary = "private per-segment extraction cache outside columnar/"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if not ctx.hot_path or ctx.matches(ctx.config.seg_cache_allowed):
+            return []
+        module_containers: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                    and stmt.value is not None \
+                    and isinstance(stmt.value, (ast.Dict, ast.DictComp)):
+                module_containers |= set(assign_targets(stmt))
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            recv = key = None
+            if isinstance(node, ast.Subscript):
+                recv, key = node.value, node.slice
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DICT_READERS and node.args:
+                recv, key = node.func.value, node.args[0]
+            if recv is None or not self._persistent(recv,
+                                                    module_containers):
+                continue
+            if self._cache_named(recv):
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    f"private per-segment cache [{dotted(recv)}] — "
+                    "per-(segment, field) extraction belongs in the "
+                    "shared segment block store (columnar.STORE): one "
+                    "extraction, every consumer, evicted with the "
+                    "segment"))
+            elif self._seg_keyed(key):
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    f"persistent dict [{dotted(recv)}] keyed by "
+                    "seg_id/fingerprint is a private per-segment "
+                    "extraction cache — read through columnar.STORE "
+                    "(one extraction, every consumer, evicted with "
+                    "the segment)"))
+        return findings
+
+    @staticmethod
+    def _persistent(recv: ast.AST, module_containers: Set[str]) -> bool:
+        """Instance state (`self.X`, any depth) or a module-level
+        container — the shapes that outlive one pass. Plain locals are
+        transient and stay out of scope."""
+        if isinstance(recv, ast.Attribute):
+            base = base_name(recv)
+            return base == "self"
+        if isinstance(recv, ast.Name):
+            return recv.id in module_containers
+        return False
+
+    @staticmethod
+    def _cache_named(recv: ast.AST) -> bool:
+        name = dotted(recv).split(".")[-1].lower()
+        return "seg" in name and "cache" in name
+
+    @staticmethod
+    def _seg_keyed(key: Optional[ast.AST]) -> bool:
+        if key is None:
+            return False
+        for sub in ast.walk(key):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in _SEG_KEY_ATTRS:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in _SEG_KEY_NAMES:
+                return True
+        return False
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
     ModuleCacheLockRule(), LockedSyncRule(), UnguardedFanoutRule(),
+    PrivateSegmentCacheRule(),
 ]
