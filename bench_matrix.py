@@ -134,6 +134,42 @@ def _dispatch_delta(mark):
             - mark["out_of_grid_compiles"]}
 
 
+def _telemetry_mark():
+    """Raw snapshot of the process-wide telemetry histograms (bucket
+    counts included); pair with `_telemetry_delta` so each row records
+    the live-percentile surface for ITS OWN requests — the in-tree
+    `_nodes/stats telemetry` numbers, cross-checkable against the row's
+    closed-loop measured percentiles."""
+    from elasticsearch_tpu.telemetry import metrics
+    return metrics.snapshot(raw=True)
+
+
+def _telemetry_delta(mark, names=("search.took", "serving.queue_wait",
+                                  "serving.device_dispatch",
+                                  "serving.device_sync")):
+    """Per-histogram delta percentiles between two marks (ms)."""
+    from elasticsearch_tpu.telemetry import metrics
+    now = metrics.snapshot(raw=True)
+    out = {}
+    for name in names:
+        after = now["histograms"].get(name)
+        if after is None:
+            continue
+        before = (mark["histograms"].get(name) or {})
+        b_counts = before.get("counts") or [0] * metrics.N_BUCKETS
+        counts = [a - b for a, b in zip(after["counts"], b_counts)]
+        count = sum(counts)
+        if count <= 0:
+            continue
+        out[name] = {
+            "count": count,
+            "p50_ms": round(
+                metrics.percentile_from_counts(counts, 0.50) / 1e6, 2),
+            "p99_ms": round(
+                metrics.percentile_from_counts(counts, 0.99) / 1e6, 2)}
+    return out
+
+
 def _compile_noise_label(disp: dict) -> dict:
     """Label timed-loop compile noise in a closed-loop row (the PR 10
     leftover: on the CPU floor a handful of steady-state shapes can
@@ -658,6 +694,7 @@ def run_hybrid_rrf(mesh=None):
     # at batcher start via warmup-at-open.
     node._hybrid_executor(node.indices.get("hybrid"))._warmup()
     mark = _dispatch_mark()  # steady state: the timed loop must read 0 misses
+    tmark = _telemetry_mark()
     all_lats = [[] for _ in range(n_clients)]
 
     def client(ci):
@@ -693,7 +730,130 @@ def run_hybrid_rrf(mesh=None):
                       **({"mesh": mesh} if mesh else {}),
                       **hybrid_serving_stats(node),
                       **_compile_noise_label(disp),
+                      "telemetry": _telemetry_delta(tmark),
                       "dispatch": disp}), flush=True)
+    node.close()
+
+
+def run_telemetry_overhead(n_docs: int = 5_000, dims: int = 64,
+                           n_clients: int = 4, per_client: int = 60):
+    """Config 11: the telemetry layer's overhead + percentile fidelity.
+
+    Two closed loops over the SAME hybrid corpus, driven through the
+    REST controller (where tracing engages): sampled tracing OFF
+    (sample_rate=0) vs ON (sample_rate=1 — every request traced, the
+    worst case; production defaults to 0.01). Gates:
+
+      gate_telemetry_overhead   p50(on) <= 1.05 x p50(off) — the layer
+                                must stay invisible at the median
+      gate_histogram_p99        the `search.took` histogram-derived p99
+                                (the `_nodes/stats telemetry` surface)
+                                agrees with the closed-loop measured p99
+                                within one log2 bucket — the in-tree
+                                percentile surface is trustworthy
+    """
+    import tempfile
+    import threading
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+    from elasticsearch_tpu.telemetry import TRACER, metrics
+
+    rng = np.random.default_rng(23)
+    vocab = np.array([f"tok{i}" for i in range(2_000)])
+    zipf = (rng.zipf(1.25, size=n_docs * 8) - 1) % 2_000
+    node = Node(tempfile.mkdtemp())
+    node.create_index_with_templates("tel", mappings={"properties": {
+        "body": {"type": "text"},
+        "v": {"type": "dense_vector", "dims": dims}}})
+    pos = 0
+    for c0 in range(0, n_docs, 1000):
+        ops = []
+        for i in range(c0, min(c0 + 1000, n_docs)):
+            ops.append({"index": {"_index": "tel", "_id": str(i)}})
+            ops.append({
+                "body": " ".join(vocab[zipf[pos:pos + 8]]),
+                "v": rng.standard_normal(dims).astype(
+                    np.float32).tolist()})
+            pos += 8
+        node.bulk(ops)
+    node.indices.get("tel").force_merge()
+    rc = RestController()
+    register_all(rc, node)
+
+    def rand_body():
+        return json.dumps({
+            "rank": {"rrf": {"rank_constant": 60,
+                             "rank_window_size": 50}},
+            "query": {"match": {"body": " ".join(
+                vocab[(rng.zipf(1.25, size=2) - 1) % 2_000])}},
+            "knn": {"field": "v",
+                    "query_vector": rng.standard_normal(dims).astype(
+                        np.float32).tolist(),
+                    "k": 50, "num_candidates": 50},
+            "size": 10, "_source": False}).encode()
+
+    client_bodies = [[rand_body() for _ in range(per_client)]
+                     for _ in range(n_clients)]
+
+    def closed_loop():
+        all_lats = [[] for _ in range(n_clients)]
+
+        def client(ci):
+            for raw in client_bodies[ci]:
+                t0 = time.perf_counter()
+                st, _resp = rc.dispatch("POST", "/tel/_search", {}, raw,
+                                        "application/json")
+                assert st == 200
+                all_lats[ci].append((time.perf_counter() - t0) * 1000)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return np.concatenate(all_lats)
+
+    # warmup: compile the hybrid grid + touch every bucket the loop uses
+    node._hybrid_executor(node.indices.get("tel"))._warmup()
+    for _ in range(8):
+        rc.dispatch("POST", "/tel/_search", {}, rand_body(),
+                    "application/json")
+
+    prior_rate = TRACER.sample_rate
+    try:
+        TRACER.configure(sample_rate=0.0)
+        lats_off = closed_loop()
+        TRACER.configure(sample_rate=1.0)
+        tmark = _telemetry_mark()
+        lats_on = closed_loop()
+    finally:
+        TRACER.configure(sample_rate=prior_rate)
+
+    p50_off = float(np.percentile(lats_off, 50))
+    p50_on = float(np.percentile(lats_on, 50))
+    p99_on = float(np.percentile(lats_on, 99))
+    tel = _telemetry_delta(tmark, names=("search.took",))
+    hist_p99_ms = tel.get("search.took", {}).get("p99_ms", 0.0)
+    bucket_gap = abs(metrics.bucket_index(int(hist_p99_ms * 1e6))
+                     - metrics.bucket_index(int(p99_on * 1e6)))
+    overhead = p50_on / max(p50_off, 1e-9)
+    print(json.dumps({
+        "config": "11_telemetry_overhead",
+        "p50_off_ms": round(p50_off, 2),
+        "p50_on_ms": round(p50_on, 2),
+        "p50_overhead": round(overhead, 3),
+        "gate_telemetry_overhead": bool(overhead <= 1.05),
+        "p99_measured_ms": round(p99_on, 2),
+        "p99_histogram_ms": round(hist_p99_ms, 2),
+        "p99_bucket_gap": int(bucket_gap),
+        "gate_histogram_p99": bool(bucket_gap <= 1),
+        "traced_requests": tel.get("search.took", {}).get("count", 0),
+        "n_docs": n_docs, "dims": dims,
+        "concurrent_clients": n_clients,
+        "telemetry": tel}), flush=True)
     node.close()
 
 
@@ -772,6 +932,7 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
     for t in warm:
         t.join()
     mark = _dispatch_mark()  # steady state: the timed loop must read 0 misses
+    tmark = _telemetry_mark()
     client_bodies = [[body() for _ in range(per_client)]
                      for _ in range(n_clients)]
     all_lats = [[] for _ in range(n_clients)]
@@ -813,6 +974,7 @@ def run_closed_loop(name: str, n: int, d: int, dtype: str = "bf16",
         **extra,
         **knn_scheduler_stats(node),
         **_compile_noise_label(disp),
+        "telemetry": _telemetry_delta(tmark),
         "dispatch": disp}), flush=True)
     node.close()
 
@@ -1964,6 +2126,7 @@ def main():
     # stage an f32 host copy here (30 GB); the config-4 SHAPE runs at 1M
     # rows like the e2e row, and says so.
     guarded(run_rest_closed_loop_dp)
+    guarded(run_telemetry_overhead)
     guarded(run_fanout_node_kill)
     guarded(run_config, "1_cosine_sift1m", 1_000_000, 128, "cosine",
             "bf16")

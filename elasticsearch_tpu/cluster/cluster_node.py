@@ -51,6 +51,7 @@ from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
 from elasticsearch_tpu.index.engine import Engine
 from elasticsearch_tpu.serving import fanout as fanout_lib
 from elasticsearch_tpu.serving.fanout import ScatterGather
+from elasticsearch_tpu.telemetry import trace as telemetry_trace
 from elasticsearch_tpu.index.mapping import MapperService
 from elasticsearch_tpu.index.seqno import ReplicationTracker
 from elasticsearch_tpu.search.service import (
@@ -1078,7 +1079,8 @@ class ClusterNode:
         return out
 
     def client_search(self, index: Optional[str], body: dict,
-                      on_done: Callable[[dict], None]) -> None:
+                      on_done: Callable[[dict], None],
+                      telemetry_ctx=None) -> None:
         """Two-phase query-then-fetch scatter-gather with a STREAMING
         incremental reduce (AbstractSearchAsyncAction + QueryPhaseResult
         Consumer:619): the query phase returns (row, score, sort) tuples
@@ -1133,7 +1135,7 @@ class ClusterNode:
                               "max_score": None, "hits": []}})
             return
 
-        fan = self._fanout_context(body)
+        fan = self._fanout_context(body, telemetry_ctx=telemetry_ctx)
 
         # can_match pre-filter round (CanMatchPreFilterSearchPhase.java:57):
         # above the threshold, a lightweight range-vs-field-stats RPC prunes
@@ -1149,12 +1151,15 @@ class ClusterNode:
             self._query_phase(body, targets, 0, total_shards,
                               unsearchable, on_done, fan)
 
-    def _fanout_context(self, body: dict) -> dict:
+    def _fanout_context(self, body: dict, telemetry_ctx=None) -> dict:
         """Per-request fan-out plan: budgets from the `search.fanout.*`
         cluster settings, the ABSOLUTE deadline from the request's
         `timeout` (propagated into every per-shard sub-request so remote
-        admission layers shed on it), and the partial-results policy
-        (`allow_partial_search_results` overrides the cluster default)."""
+        admission layers shed on it), the partial-results policy
+        (`allow_partial_search_results` overrides the cluster default),
+        and the request's trace context (`telemetry.capture()` from the
+        REST thread — the coordinator runs on the scheduler thread, so
+        thread-locals cannot carry it here)."""
         from elasticsearch_tpu.common.settings import (
             parse_time_value, setting_bool)
         budgets = fanout_lib.budgets_from_settings(
@@ -1169,9 +1174,13 @@ class ClusterNode:
         partial = budgets["partial_results"]
         if body.get("allow_partial_search_results") is not None:
             partial = setting_bool(body["allow_partial_search_results"])
+        trace, trace_parent = None, None
+        if telemetry_ctx is not None:
+            trace, trace_parent = telemetry_ctx[0], telemetry_ctx[1]
         return {"budgets": budgets, "deadline_at_ms": deadline_at_ms,
                 "started_ms": started_ms, "partial": partial,
-                "profile": bool(body.get("profile")), "phases": {}}
+                "profile": bool(body.get("profile")), "phases": {},
+                "trace": trace, "trace_parent": trace_parent}
 
     def _phase_budget(self, fan: dict, base_budget_ms: int) -> int:
         """Per-shard timer budget for the NEXT phase: the configured phase
@@ -1212,7 +1221,8 @@ class ClusterNode:
             self.scheduler, phase="can_match",
             budget_ms=self._phase_budget(
                 fan, fan["budgets"]["query_budget_ms"]),
-            stats=self.fanout_stats, on_done=finish)
+            stats=self.fanout_stats, on_done=finish,
+            trace=fan.get("trace"), trace_parent=fan.get("trace_parent"))
 
         def fold(outcome, resp, _err, name, entry):
             if outcome == fanout_lib.OK and isinstance(resp, dict) \
@@ -1272,6 +1282,14 @@ class ClusterNode:
             acc["agg_buffer"] = []
 
         def fold(outcome, resp, _err, name, entry):
+            if isinstance(resp, dict) and "_spans" in resp:
+                # the remote's trace segment rode back on the response:
+                # fold its spans into the coordinator's trace (their
+                # parent ids point at this leg's span, so the merged
+                # tree needs no rewriting)
+                spans = resp.pop("_spans")
+                if fan.get("trace") is not None:
+                    fan["trace"].absorb(spans)
             if outcome != fanout_lib.OK:
                 # failed / per-shard timer expired / shed at the remote's
                 # admission layer: the shard contributed nothing — count
@@ -1296,7 +1314,21 @@ class ClusterNode:
                 acc["agg_buffer"].append(resp["aggregations"])
                 fold_aggs()
 
+        fan_trace = fan.get("trace")
+        qspan = None
+        if fan_trace is not None:
+            qspan = fan_trace.begin_span("phase.query",
+                                         parent_id=fan.get("trace_parent"),
+                                         targets=len(targets))
+            # per-leg spans parent under the phase span; ended by
+            # query_done below on EVERY completion path (ScatterGather's
+            # on_done is structural — the sweep timer guarantees it)
+
         def query_done(summary):
+            if qspan is not None:
+                fan_trace.end_span(
+                    qspan, status="timeout" if summary["any_timed_out"]
+                    else "ok")
             fold_aggs(force=True)
             fan["phases"]["query"] = summary
             if not fan["partial"] and (summary["any_timed_out"]
@@ -1319,7 +1351,9 @@ class ClusterNode:
             self.scheduler, phase="query",
             budget_ms=self._phase_budget(fan, budgets["query_budget_ms"]),
             stats=self.fanout_stats, observe=self._ars_observe,
-            on_done=query_done)
+            on_done=query_done,
+            trace=fan_trace,
+            trace_parent=qspan.span_id if qspan is not None else None)
         deadline_ms = self._phase_deadline_ms(fan,
                                               budgets["query_budget_ms"])
 
@@ -1339,9 +1373,12 @@ class ClusterNode:
                         self.node_id, entry.node_id, QUERY_SHARD, req,
                         on_response=on_resp, on_failure=on_fail)
 
+            # `request=req` rides the trace context on the deadline
+            # envelope, parenting the remote's spans under this leg
             sg.launch((name, entry.shard), entry.node_id, send,
                       on_item=lambda o, r, e, n=name, en=entry:
-                      fold(o, r, e, n, en))
+                      fold(o, r, e, n, en),
+                      request=req)
         sg.seal()
 
     def _fetch_phase(self, body, acc, num_shards,
@@ -1378,10 +1415,14 @@ class ClusterNode:
             out["took"] = max(self.scheduler.now_ms - fan["started_ms"], 0)
             if out["timed_out"]:
                 self.fanout_stats.partial_responses += 1
+            from elasticsearch_tpu.search.profile import fanout_profile
+            phases = fanout_profile(fan["phases"])
+            # private key (popped by the REST layer): the coordinator
+            # slow log needs the phase breakdown on EVERY breach, not
+            # just on profiled requests
+            out["_took_phases"] = phases
             if fan["profile"]:
-                from elasticsearch_tpu.search.profile import fanout_profile
-                out.setdefault("profile", {})["fanout"] = \
-                    fanout_profile(fan["phases"])
+                out.setdefault("profile", {})["fanout"] = phases
             on_done(out)
 
         if not window_entries:
@@ -1394,7 +1435,19 @@ class ClusterNode:
             by_shard.setdefault((ishard[0], ishard[1], node_id), []).append(pos)
         hits: List[Optional[dict]] = [None] * len(window_entries)
 
+        fan_trace = fan.get("trace")
+        fspan = None
+        if fan_trace is not None:
+            fspan = fan_trace.begin_span("phase.fetch",
+                                         parent_id=fan.get("trace_parent"),
+                                         targets=len(by_shard))
+            # ended by fetch_done on every completion path below
+
         def fetch_done(summary):
+            if fspan is not None:
+                fan_trace.end_span(
+                    fspan, status="timeout" if summary["any_timed_out"]
+                    else "ok")
             fan["phases"]["fetch"] = summary
             out["hits"]["hits"] = [h for h in hits if h is not None]
             finish_response()
@@ -1409,10 +1462,16 @@ class ClusterNode:
             self.scheduler, phase="fetch",
             budget_ms=budgets["fetch_budget_ms"],
             stats=self.fanout_stats, observe=self._ars_observe,
-            on_done=fetch_done)
+            on_done=fetch_done,
+            trace=fan_trace,
+            trace_parent=fspan.span_id if fspan is not None else None)
         deadline_ms = self.scheduler.now_ms + budgets["fetch_budget_ms"]
 
         def fold(outcome, resp, _err, positions):
+            if isinstance(resp, dict) and "_spans" in resp:
+                spans = resp.pop("_spans")
+                if fan_trace is not None:
+                    fan_trace.absorb(spans)
             if outcome == fanout_lib.OK:
                 for p, hit in zip(positions, resp["hits"]):
                     hits[p] = hit
@@ -1444,7 +1503,8 @@ class ClusterNode:
 
             sg.launch(key, node_id, send,
                       on_item=lambda o, r, e, positions=positions:
-                      fold(o, r, e, positions))
+                      fold(o, r, e, positions),
+                      request=req)
         sg.seal()
 
     def _on_query_shard(self, sender, request, respond):
@@ -1458,6 +1518,30 @@ class ClusterNode:
         if local is None:
             raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
         body = request["body"]
+
+        # trace segment (telemetry): the envelope carried the
+        # coordinator's trace context — open a segment with the SAME
+        # trace id whose spans parent under the coordinator's leg span.
+        # The segment lands in THIS node's ring (per-node attribution in
+        # `_nodes/traces`) and its spans ride back on the response for
+        # the coordinator to absorb into the one request trace.
+        tctx = fanout_lib.trace_ctx_of(request)
+        rtrace = None
+        if tctx is not None and tctx.get("trace_id"):
+            rtrace = telemetry_trace.TRACER.start_remote(
+                f"shard.query[{request['index']}][{request['shard']}]",
+                node_id=self.node_id, trace_id=tctx["trace_id"],
+                parent_span_id=tctx.get("parent_span_id"),
+                opaque_id=tctx.get("opaque_id"))
+
+        def answer(payload: dict, status: str = "ok") -> None:
+            if rtrace is not None:
+                telemetry_trace.TRACER.finish(
+                    rtrace, status=None if status == "ok" else status)
+                # never mutate a possibly-cached payload: spans go on a
+                # copy
+                payload = {**payload, "_spans": rtrace.span_dicts()}
+            respond(payload)
 
         # propagated deadline (serving/fanout.py): the coordinator stamped
         # this sub-request with the request's ABSOLUTE deadline. Convert
@@ -1475,8 +1559,9 @@ class ClusterNode:
                 and "knn" in body["query"])
             if remaining <= 0 and not has_device_leg:
                 self.fanout_stats.remote["sheds_admission"] += 1
-                respond(fanout_lib.shed_response(request["shard"],
-                                                 "admission"))
+                answer(fanout_lib.shed_response(request["shard"],
+                                                "admission"),
+                       status="shed")
                 return
             deadline_at = time.monotonic() + remaining / 1000.0
 
@@ -1488,18 +1573,27 @@ class ClusterNode:
             cache_key = self.caches.request.key(key, reader.gen, body)
             cached = self.caches.request.get(cache_key)
             if cached is not None:
-                respond(cached)
+                answer(cached)
                 return
         # aggs leave the shard as mergeable partial states (HLL/t-digest/
         # sum-count pairs); the coordinator reduce finalizes them
         # (InternalAggregation.reduce analog)
         try:
-            result = execute_query_phase(reader, local.mapper_service, body,
-                                         shard_id=request["shard"],
-                                         vector_store=local.vector_store,
-                                         partial_aggs=True,
-                                         query_cache=self.caches.query,
-                                         deadline_at=deadline_at)
+            # the segment rides the thread for the synchronous execute:
+            # the vector-store batcher's queue entries capture it here,
+            # so remote queue-wait / dispatch / device-sync spans land in
+            # this segment with zero extra plumbing
+            with telemetry_trace.use(trace=rtrace):
+                t0 = time.perf_counter_ns()
+                result = execute_query_phase(
+                    reader, local.mapper_service, body,
+                    shard_id=request["shard"],
+                    vector_store=local.vector_store,
+                    partial_aggs=True,
+                    query_cache=self.caches.query,
+                    deadline_at=deadline_at)
+                telemetry_trace.record_span(
+                    "shard.query_phase", time.perf_counter_ns() - t0)
         except EsRejectedExecutionError:
             # the continuous batcher's EDF queue shed the device leg on
             # the propagated deadline — exactly the remote-admission shed
@@ -1507,9 +1601,18 @@ class ClusterNode:
             # rejection so the coordinator attributes it (deadline, not
             # node death).
             self.fanout_stats.remote["sheds_batcher"] += 1
-            respond(fanout_lib.shed_response(request["shard"],
-                                             "batcher_edf"))
+            answer(fanout_lib.shed_response(request["shard"],
+                                            "batcher_edf"),
+                   status="shed")
             return
+        except BaseException:
+            # an erroring shard must not leak its trace segment (the
+            # leaked-span class TPU012 polices): finish it with error
+            # status so it still lands in this node's ring, then let the
+            # failure travel to the coordinator's on_failure as before
+            if rtrace is not None:
+                telemetry_trace.TRACER.finish(rtrace, status="error")
+            raise
         response = {
             "shard": request["shard"],
             "total": result.total_hits,
@@ -1523,7 +1626,7 @@ class ClusterNode:
         }
         if cache_key is not None:
             self.caches.request.put(cache_key, response)
-        respond(response)
+        answer(response)
 
     def _on_can_match_shard(self, sender, request, respond):
         """Lightweight pre-filter: range-vs-field-stats only, no query
@@ -1961,12 +2064,29 @@ class ClusterNode:
         local = self.local_shards.get(key)
         if local is None:
             raise SearchEngineError(f"no shard {key} on [{self.node_id}]")
+        tctx = fanout_lib.trace_ctx_of(request)
+        rtrace = None
+        if tctx is not None and tctx.get("trace_id"):
+            rtrace = telemetry_trace.TRACER.start_remote(
+                f"shard.fetch[{request['index']}][{request['shard']}]",
+                node_id=self.node_id, trace_id=tctx["trace_id"],
+                parent_span_id=tctx.get("parent_span_id"),
+                opaque_id=tctx.get("opaque_id"))
+
+        def answer(payload: dict, status: str = "ok") -> None:
+            if rtrace is not None:
+                telemetry_trace.TRACER.finish(
+                    rtrace, status=None if status == "ok" else status)
+                payload = {**payload, "_spans": rtrace.span_dicts()}
+            respond(payload)
+
         # propagated-deadline admission: a fetch arriving past the
         # request's deadline hydrates hits nobody will read — shed it
         remaining = fanout_lib.remaining_ms(request, self.scheduler.now_ms)
         if remaining is not None and remaining <= 0:
             self.fanout_stats.remote["sheds_admission"] += 1
-            respond(fanout_lib.shed_response(request["shard"], "admission"))
+            answer(fanout_lib.shed_response(request["shard"], "admission"),
+                   status="shed")
             return
         body = request["body"]
         reader = local.engine.acquire_searcher()
@@ -1979,9 +2099,22 @@ class ClusterNode:
             if svs is not None and any(sv is not None for sv in svs) else None,
             total_hits=len(request["rows"]), total_relation="eq",
             aggregations=None, max_score=None)
-        hits = execute_fetch_phase(reader, local.mapper_service, body, result,
-                                   index_name=request["index"])
-        respond({"hits": hits})
+        t0 = time.perf_counter_ns()
+        try:
+            hits = execute_fetch_phase(reader, local.mapper_service, body,
+                                       result,
+                                       index_name=request["index"])
+        except BaseException:
+            # same no-leak rule as the query side: an erroring fetch
+            # finishes its segment with error status before propagating
+            if rtrace is not None:
+                telemetry_trace.TRACER.finish(rtrace, status="error")
+            raise
+        if rtrace is not None:
+            rtrace.record_span("hydrate", time.perf_counter_ns() - t0,
+                               parent_id=rtrace.root.span_id,
+                               hits=len(hits))
+        answer({"hits": hits})
 
     def client_get(self, index: str, doc_id: str,
                    on_done: Callable[[dict], None],

@@ -27,12 +27,15 @@ from __future__ import annotations
 
 import functools
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (
     IllegalArgumentError, IndexNotFoundError, SearchEngineError,
 )
 from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.telemetry import metrics as _telemetrics
+from elasticsearch_tpu.telemetry import trace as _teletrace
 
 
 def _parse_keepalive_s(value: Optional[str]) -> float:
@@ -269,7 +272,10 @@ class ClusterAwareNode(Node):
                     bool(p.get("include_segment_file_sizes"))),
                 "fanout": self.cluster.fanout_stats.snapshot()},
             "hot_threads": lambda p: self.local_hot_threads(
-                float(p.get("interval_s", 0.05))),
+                float(p.get("interval_s", 0.05)),
+                top_n=int(p.get("top_n", 3))),
+            "traces": lambda p: self.local_traces_section(
+                int(p.get("limit", 50))),
             "tasks": lambda p: self.local_tasks_section(p.get("actions")),
             "task_get": lambda p: {
                 "completed": False,
@@ -304,10 +310,21 @@ class ClusterAwareNode(Node):
         return self._nodes_envelope(out["results"],
                                     failed=len(out["failures"]))
 
-    def hot_threads_api(self, interval_s: float = 0.05) -> str:
-        out = self._fanout("hot_threads", {"interval_s": interval_s})
+    def hot_threads_api(self, interval_s: float = 0.05,
+                        top_n: int = 3) -> str:
+        out = self._fanout("hot_threads", {"interval_s": interval_s,
+                                           "top_n": top_n})
         return "\n".join(out["results"][nid]
                           for nid in sorted(out["results"]))
+
+    def traces_api(self, limit: int = 50) -> dict:
+        """Cluster `GET _nodes/traces`: every node's completed-trace
+        ring, merged under the standard `_nodes` envelope — a cross-node
+        search shows its coordinator trace on the coordinating node and
+        its shard segments on each data node, joined by trace_id."""
+        out = self._fanout("traces", {"limit": limit})
+        return self._nodes_envelope(out["results"],
+                                    failed=len(out["failures"]))
 
     def tasks_list_api(self, actions=None) -> dict:
         out = self._fanout("tasks", {"actions": actions})
@@ -971,9 +988,46 @@ class ClusterAwareNode(Node):
             if not kept:
                 return _empty_search_response()
             index_expr = ",".join(kept)
+        t0 = _time.perf_counter()
+        # hand the REST thread's telemetry context (trace + task) to the
+        # coordinator explicitly: client_search runs on the event loop,
+        # where thread-locals cannot follow the request
         resp = self._call(self.cluster.client_search, index_expr,
-                          dict(body or {}))
+                          dict(body or {}),
+                          telemetry_ctx=_teletrace.capture())
         self.counters["search"] += 1
+        took_s = _time.perf_counter() - t0
+        _telemetrics.record("search.took", int(took_s * 1e9))
+        # the coordinator ships the phase summary on a private key so
+        # the slow log gets it on UNPROFILED requests too; pop it before
+        # the response reaches the client
+        phases = resp.pop("_took_phases", None) \
+            if isinstance(resp, dict) else None
+        # coordinator slow log: the fan-out path must breach per-index
+        # thresholds exactly like the single-node query path; entries
+        # carry the fan-out phase summary instead of shard-local nanos
+        if isinstance(resp, dict) and "error" not in resp:
+            meta = self.cluster.cluster_state.metadata
+            # cheap gate: the common case configures no slow-log
+            # thresholds anywhere — skip the second index resolution
+            # entirely then (the coordinator already resolved once)
+            if any(isinstance(m, dict) and any(
+                    ".slowlog.threshold." in key
+                    for key in (m.get("settings") or {}))
+                   for m in meta.values()):
+                _task = _teletrace.current_task()
+                try:
+                    names = self.cluster.resolve_indices(index_expr)
+                except Exception:
+                    names = []
+                for name in names:
+                    settings = (meta.get(name) or {}).get("settings") or {}
+                    self.search_slow_log.maybe_log(
+                        settings, name, took_s,
+                        source=(body or {}).get("query"),
+                        opaque_id=getattr(_task, "opaque_id", None),
+                        trace=_teletrace.current_trace(),
+                        phases=phases)
         return resp
 
     def count(self, index_expr: Optional[str], body: Optional[dict]) -> dict:

@@ -1,9 +1,19 @@
-"""Hot threads: stack dumps of the busiest threads.
+"""Hot threads: stack dumps of the busiest threads, by subsystem.
 
 Reference: `monitor/jvm/HotThreads.java:41` — samples thread CPU over an
 interval and prints the top-N stacks. Python analog: sample
 `sys._current_frames` twice and report threads whose top frame advanced
 (busy) with their current stacks.
+
+Serving threads carry subsystem-identifying names so a busy stack is
+attributable at a glance: the node thread pools prefix `es[<pool>]`
+(common/threadpool.py), background workers name themselves at spawn
+(`segments-merge`, `dispatch-warmup`, `batcher-warmup`,
+`agg-column-resync`), and the combining batcher — which runs on BORROWED
+submitter threads — tags the current thread for the duration of a drain
+or finalize section (`telemetry.thread_section`: `»batcher-drain`,
+`»batcher-finalize`). The report maps each thread to its subsystem from
+that name.
 """
 
 from __future__ import annotations
@@ -13,6 +23,32 @@ import threading
 import time
 import traceback
 from typing import Dict
+
+# thread-name fragment -> subsystem label, most specific first
+_SUBSYSTEMS = (
+    ("»batcher-drain", "serving/batcher dispatch"),
+    ("»batcher-finalize", "serving/batcher finalize"),
+    ("batcher-warmup", "serving/batcher warmup"),
+    ("segments-merge", "segments background merge"),
+    ("dispatch-warmup", "ops/dispatch warmup"),
+    ("agg-column-resync", "aggs column resync"),
+    ("es[search_throttled]", "search_throttled pool"),
+    ("es[search]", "search pool"),
+    ("es[write]", "write pool"),
+    ("es[get]", "get pool"),
+    ("es[generic]", "generic pool"),
+    ("es[snapshot]", "snapshot pool"),
+    ("es[force_merge]", "force_merge pool"),
+)
+
+
+def subsystem_of(thread_name: str) -> str:
+    for fragment, label in _SUBSYSTEMS:
+        if fragment in thread_name:
+            return label
+    if thread_name.startswith("es["):
+        return thread_name.split("]")[0] + "] pool"
+    return "other"
 
 
 def hot_threads_report(interval_s: float = 0.05, top_n: int = 3,
@@ -34,7 +70,8 @@ def hot_threads_report(interval_s: float = 0.05, top_n: int = 3,
     for tid, frame in busy_first[:top_n]:
         name = names.get(tid, str(tid))
         state = "runnable" if first.get(tid) != _top_frame_key(frame) else "waiting"
-        lines.append(f"   0.0% cpu usage by thread '{name}' ({state})")
+        lines.append(f"   0.0% cpu usage by thread '{name}' ({state}) "
+                     f"[{subsystem_of(name)}]")
         for entry in traceback.format_stack(frame)[-10:]:
             for ln in entry.rstrip().splitlines():
                 lines.append("     " + ln.strip())

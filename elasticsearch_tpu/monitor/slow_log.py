@@ -2,7 +2,17 @@
 
 Reference: `index/SearchSlowLog.java` / `IndexingSlowLog.java` — threshold
 settings per level (warn/info/debug/trace); breaches emit a structured log
-line. Here breaches append to an in-memory ring consumable from stats/tests.
+line. Here breaches append to an in-memory ring consumable from stats/tests
+(`_nodes/stats indices.slowlog`, `GET /_slowlog`).
+
+Telemetry coupling (ISSUE 14): a breach is exactly the moment an operator
+asks "where did THIS slow request spend its time", so entries carry the
+caller's `X-Opaque-ID`, the request's trace id plus its top-3 spans (when
+the request was sampled/forced), and the phase breakdown the serving path
+already measured — the answer travels WITH the breach instead of requiring
+a second lookup. Every serving path feeds the same ring: the host query
+path, the fused hybrid/kNN device path, and the cross-node fan-out
+coordinator.
 """
 
 from __future__ import annotations
@@ -18,6 +28,7 @@ class SlowLog:
     def __init__(self, kind: str = "search"):
         self.kind = kind
         self.entries: List[dict] = []
+        self.total = 0   # breaches ever (the ring truncates entries)
 
     def thresholds(self, settings) -> Dict[str, float]:
         out = {}
@@ -30,7 +41,10 @@ class SlowLog:
         return out
 
     def maybe_log(self, settings, index: str, took_s: float,
-                  source: Optional[Any] = None) -> Optional[str]:
+                  source: Optional[Any] = None, *,
+                  opaque_id: Optional[str] = None,
+                  trace: Optional[Any] = None,
+                  phases: Optional[dict] = None) -> Optional[str]:
         level_hit = None
         ths = self.thresholds(settings)
         for level in LEVELS:   # warn is the highest threshold; first hit wins
@@ -40,9 +54,28 @@ class SlowLog:
                 break
         if level_hit is None:
             return None
-        self.entries.append({"index": index, "level": level_hit,
-                             "took_ms": took_s * 1000.0,
-                             "source": source})
+        entry = {"index": index, "level": level_hit,
+                 "took_ms": took_s * 1000.0,
+                 "source": source}
+        if opaque_id is not None:
+            entry["opaque_id"] = opaque_id
+        if phases:
+            entry["phases"] = dict(phases)
+        if trace is not None:
+            # attach the trace id + the three longest spans so the log
+            # line alone answers where the time went; the full trace
+            # stays in the `_nodes/traces` ring under this id
+            entry["trace_id"] = trace.trace_id
+            entry["top_spans"] = trace.top_spans(3)
+        self.entries.append(entry)
+        self.total += 1
         if len(self.entries) > 1000:
             del self.entries[:500]
         return level_hit
+
+    def stats(self, recent: int = 5) -> dict:
+        """The `_nodes/stats indices.slowlog` section: breach count +
+        the most recent entries (full ring via `GET /_slowlog`)."""
+        recent = max(int(recent), 0)
+        return {"count": self.total,
+                "recent": list(self.entries[-recent:]) if recent else []}

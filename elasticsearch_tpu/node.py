@@ -29,6 +29,8 @@ from elasticsearch_tpu.search.service import (
     execute_fetch_phase, execute_query_phase,
 )
 from elasticsearch_tpu.common.settings import parse_time_value
+from elasticsearch_tpu.telemetry import metrics as _telemetrics
+from elasticsearch_tpu.telemetry import trace as _teletrace
 from elasticsearch_tpu.version import __version__
 
 MAX_RESULT_WINDOW_SCROLL = 10_000
@@ -547,6 +549,12 @@ class Node:
             if hbm_budget is not None:
                 kwargs["hbm_budget_bytes"] = int(hbm_budget)
             _mesh_policy.configure(**kwargs)
+        # end-to-end telemetry (elasticsearch_tpu/telemetry/): tracer
+        # sampling + trace-ring sizing. Process-wide like the dispatcher
+        # — only an explicit setting reconfigures (same clobber rule as
+        # warmup above).
+        from elasticsearch_tpu import telemetry as _telemetry
+        _telemetry.configure_from_settings(self.settings)
         # set by the server bootstrap after native hardening runs; embedded
         # nodes have no hardening (reference: JNANatives.LOCAL_MLOCKALL)
         self.natives = None
@@ -1118,7 +1126,24 @@ class Node:
                 # two-phase path below stays as the parity oracle
                 # (tests/test_hybrid_plan.py proves byte-identical
                 # results) and the escape hatch.
-                return self._hybrid_executor(svc).submit(body)
+                resp = self._hybrid_executor(svc).submit(body)
+                # the hybrid device path must feed the same telemetry
+                # surfaces as the host query path: e2e latency histogram
+                # + per-index slow log with phase breakdown and trace.
+                # The executor ships the breakdown on a private key so
+                # UNPROFILED breaches carry it too; pop it before the
+                # response reaches the client.
+                phases = resp.pop("_took_phases", None)
+                took_s = time.perf_counter() - start
+                _telemetrics.record("search.took", int(took_s * 1e9))
+                _task = _teletrace.current_task()
+                self.search_slow_log.maybe_log(
+                    svc.settings, svc.name, took_s,
+                    source={"rank": {"rrf": rrf}},
+                    opaque_id=getattr(_task, "opaque_id", None),
+                    trace=_teletrace.current_trace(),
+                    phases=phases)
+                return resp
             reader = svc.combined_reader()
             store = _MultiShardVectorStore(svc)
             breaker_bytes = reader.num_docs * 16
@@ -1482,6 +1507,7 @@ class Node:
         relation = "eq"
         max_score = None
         merged_aggs = None
+        phase_nanos = {"query_nanos": 0, "fetch_nanos": 0, "merge_nanos": 0}
         shard_failures: List[dict] = []
         pre_filter = body.pop("__pre_filter_shard_size__", None)
         skipped_shards = 0
@@ -1567,6 +1593,9 @@ class Node:
                     if cache_key is not None:
                         self.caches.request.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
+                phase_nanos["query_nanos"] += q_nanos
+                _teletrace.record_span(f"query[{svc.name}]", q_nanos,
+                                       index=svc.name)
                 for f in getattr(result, "failures", None) or []:
                     f = dict(f)
                     f["index"] = svc.name
@@ -1585,6 +1614,9 @@ class Node:
                     index_name=svc.name,
                     index_settings=svc.settings.as_flat_dict())
                 f_nanos = time.perf_counter_ns() - f_start
+                phase_nanos["fetch_nanos"] += f_nanos
+                _teletrace.record_span(f"fetch[{svc.name}]", f_nanos,
+                                       index=svc.name)
                 for h, score, sv in zip(hits, result.scores,
                                         result.sort_values or [None] * len(hits)):
                     if factor != 1.0 and h.get("_score") is not None:
@@ -1632,11 +1664,15 @@ class Node:
             self._search_groups[str(g)] = \
                 self._search_groups.get(str(g), 0) + 1
 
+        m_start = time.perf_counter_ns()
         sort_spec = body.get("sort")
         if sort_spec:
             all_hits.sort(key=lambda t: _sort_key_tuple(t[2], body))
         else:
             all_hits.sort(key=lambda t: -t[1])
+        phase_nanos["merge_nanos"] = time.perf_counter_ns() - m_start
+        _teletrace.record_span("merge", phase_nanos["merge_nanos"],
+                               hits=len(all_hits))
         collapse_spec = body.get("collapse")
         if collapse_spec and len(readers) > 1:
             # cross-index collapse: per-index phases deduped their own
@@ -1704,11 +1740,18 @@ class Node:
             resp["aggregations"] = merged_aggs
         if profile_enabled:
             resp["profile"] = {"shards": profile_shards}
-        # slow log (reference: SearchSlowLog thresholds per index)
+        # slow log (reference: SearchSlowLog thresholds per index) —
+        # breaches carry the phase breakdown, the caller's X-Opaque-ID,
+        # and this request's trace (id + top spans) when sampled
         took_s = time.perf_counter() - start
+        _telemetrics.record("search.took", int(took_s * 1e9))
+        _task = _teletrace.current_task()
         for svc, _, _ in readers:
-            self.search_slow_log.maybe_log(svc.settings, svc.name, took_s,
-                                           source=body.get("query"))
+            self.search_slow_log.maybe_log(
+                svc.settings, svc.name, took_s, source=body.get("query"),
+                opaque_id=getattr(_task, "opaque_id", None),
+                trace=_teletrace.current_trace(),
+                phases=dict(phase_nanos))
 
         suggest_spec = body.get("suggest")
         if suggest_spec:
@@ -2441,7 +2484,9 @@ class Node:
             "aggs": self._aggs_stats_section(),
             "dispatch": self._dispatch_stats_section(),
             "mesh": self._mesh_stats_section(),
-            "columnar": self._columnar_stats_section()}
+            "columnar": self._columnar_stats_section(),
+            "slowlog": {"search": self.search_slow_log.stats(),
+                        "indexing": self.indexing_slow_log.stats()}}
         discovery_section = {
             "cluster_state_queue": {"total": 0, "pending": 0,
                                     "committed": 0},
@@ -2463,7 +2508,8 @@ class Node:
                 "indices": indices_section,
                 "discovery": discovery_section,
                 "breakers": self.breakers.stats(),
-                "thread_pool": self.thread_pool.stats()}
+                "thread_pool": self.thread_pool.stats(),
+                "telemetry": self._telemetry_stats_section()}
 
     def _device_segments_section(self) -> dict:
         """Generational device-corpus counters summed over local shards
@@ -2554,9 +2600,32 @@ class Node:
         out["scheduler"] = sched
         return out
 
-    def local_hot_threads(self, interval_s: float = 0.05) -> str:
+    @staticmethod
+    def _telemetry_stats_section() -> dict:
+        """Live percentile surfaces (`_nodes/stats telemetry`): the
+        process-wide metrics registry's histograms (end-to-end search
+        latency, queue wait, device dispatch/sync, fan-out leg latency —
+        p50/p90/p99/p999 each, no bench harness required) plus the
+        tracer's sampling/ring counters. Process-wide like the dispatch
+        section."""
+        from elasticsearch_tpu.telemetry import REGISTRY, TRACER
+        return {**REGISTRY.snapshot(), "tracing": TRACER.snapshot()}
+
+    def local_traces_section(self, limit: int = 50) -> dict:
+        """This node's completed-trace ring (`GET _nodes/traces`): most
+        recent first, filtered to traces/segments that completed on THIS
+        node (the tracer is process-wide; a simulated multi-node process
+        shares one ring with per-node attribution)."""
+        from elasticsearch_tpu.telemetry import TRACER
+        return {"name": self.node_name,
+                "traces": TRACER.traces(node_id=self.node_id,
+                                        limit=limit)}
+
+    def local_hot_threads(self, interval_s: float = 0.05,
+                          top_n: int = 3) -> str:
         from elasticsearch_tpu.monitor import hot_threads_report
         return hot_threads_report(interval_s=min(interval_s, 0.5),
+                                  top_n=top_n,
                                   node_name=self.node_name)
 
     def local_tasks_section(self, actions: Optional[str] = None) -> dict:
@@ -2739,8 +2808,13 @@ class Node:
             {self.node_id: self.local_node_stats(
                 level, include_segment_file_sizes)})
 
-    def hot_threads_api(self, interval_s: float = 0.05) -> str:
-        return self.local_hot_threads(interval_s)
+    def hot_threads_api(self, interval_s: float = 0.05,
+                        top_n: int = 3) -> str:
+        return self.local_hot_threads(interval_s, top_n=top_n)
+
+    def traces_api(self, limit: int = 50) -> dict:
+        return self._nodes_envelope(
+            {self.node_id: self.local_traces_section(limit)})
 
     def tasks_list_api(self, actions: Optional[str] = None) -> dict:
         return {"nodes": {self.node_id: self.local_tasks_section(actions)}}

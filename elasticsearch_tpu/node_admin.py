@@ -147,28 +147,51 @@ class AsyncSearchService:
 
 
 class Task:
-    __slots__ = ("task_id", "action", "description", "start_ms", "cancellable",
-                 "cancelled", "status")
+    """One live in-flight request (TaskManager.java's Task + the
+    CancellableTask headers): carries the caller's `X-Opaque-ID` and the
+    request's trace so `GET _tasks` answers action / node / running time
+    / opaque id / trace id / current span for anything in flight. The
+    Task object itself is the cancellation token — the continuous
+    batcher's EDF queue holds a reference and sheds queued entries the
+    moment `cancelled` flips (serving/batcher.py `_claim_locked`)."""
 
-    def __init__(self, task_id, action, description, cancellable=True):
+    __slots__ = ("task_id", "action", "description", "start_ms",
+                 "start_mono_ns", "cancellable", "cancelled", "status",
+                 "opaque_id", "trace")
+
+    def __init__(self, task_id, action, description, cancellable=True,
+                 opaque_id=None, trace=None):
         self.task_id = task_id
         self.action = action
         self.description = description
-        self.start_ms = int(time.time() * 1000)
+        self.start_ms = int(time.time() * 1000)   # epoch for display only
+        # running time is a DURATION: monotonic clock (tpulint TPU012's
+        # wall-clock-duration bug class — time.time can step backwards)
+        self.start_mono_ns = time.monotonic_ns()
         self.cancellable = cancellable
         self.cancelled = False
         self.status: dict = {}
+        self.opaque_id = opaque_id
+        self.trace = trace   # telemetry.trace.Trace | None
 
     def to_dict(self, node_id: str) -> dict:
-        return {"node": node_id, "id": int(self.task_id.split(":")[1]),
-                "type": "transport", "action": self.action,
-                "description": self.description,
-                "start_time_in_millis": self.start_ms,
-                "running_time_in_nanos": int(
-                    (time.time() * 1000 - self.start_ms) * 1e6),
-                "cancellable": self.cancellable,
-                "cancelled": self.cancelled,
-                "status": self.status or None}
+        out = {"node": node_id, "id": int(self.task_id.split(":")[1]),
+               "type": "transport", "action": self.action,
+               "description": self.description,
+               "start_time_in_millis": self.start_ms,
+               "running_time_in_nanos":
+                   time.monotonic_ns() - self.start_mono_ns,
+               "cancellable": self.cancellable,
+               "cancelled": self.cancelled,
+               "headers": ({"X-Opaque-Id": self.opaque_id}
+                           if self.opaque_id else {}),
+               "status": self.status or None}
+        if self.trace is not None:
+            out["trace_id"] = self.trace.trace_id
+            current = self.trace.current_span_name()
+            if current is not None:
+                out["current_span"] = current
+        return out
 
 
 class TaskManager:
@@ -181,11 +204,12 @@ class TaskManager:
         self._lock = threading.Lock()
 
     def register(self, action: str, description: str = "",
-                 cancellable: bool = True) -> Task:
+                 cancellable: bool = True, opaque_id=None,
+                 trace=None) -> Task:
         with self._lock:
             self._counter += 1
             task = Task(f"{self.node_id}:{self._counter}", action, description,
-                        cancellable)
+                        cancellable, opaque_id=opaque_id, trace=trace)
             self._tasks[task.task_id] = task
             return task
 

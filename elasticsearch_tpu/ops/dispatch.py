@@ -519,6 +519,14 @@ class Dispatcher:
         with _x64_scope(kernel.x64):
             compiled = kernel.jitted.lower(*args, **static_kwargs).compile()
         nanos = time.perf_counter_ns() - t0
+        # telemetry-registry mirror of the compile counters: a live
+        # p99 over compile cost (and a compile-rate counter) sits next
+        # to the serving latency histograms in `_nodes/stats telemetry`
+        # — a nonzero steady-state rate there is the recompile-
+        # regression signal without waiting for the strict-mode gate
+        from elasticsearch_tpu.telemetry import metrics as _metrics
+        _metrics.counter("dispatch.compiles").inc()
+        _metrics.record("dispatch.compile", nanos)
         entry = _Entry(compiled, key_str, nanos)
         with self._lock:
             self._cache[key] = entry

@@ -13,6 +13,7 @@ import json
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from elasticsearch_tpu import telemetry
 from elasticsearch_tpu.common.errors import (
     DocumentMissingError, IllegalArgumentError, IndexNotFoundError,
     SearchEngineError,
@@ -20,6 +21,19 @@ from elasticsearch_tpu.common.errors import (
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.rest.controller import RestController, RestRequest
 from elasticsearch_tpu.version import __version__
+
+
+def _rest_telemetry(req, node, action: str, force_trace: bool = False,
+                    description: str = "", parse_nanos: int = 0):
+    """Per-request telemetry binding for an instrumented handler: live
+    task (tasks API + cancellation token), trace when sampled or forced,
+    X-Opaque-ID captured once from the header and threaded through
+    both."""
+    return telemetry.rest_request(
+        node, action,
+        opaque_id=(req.headers or {}).get("x-opaque-id"),
+        force_trace=force_trace, description=description,
+        parse_nanos=parse_nanos)
 
 
 def _cat_table(req, headers, rows) -> Tuple[int, Any]:
@@ -85,23 +99,30 @@ def register_all(rc: RestController, node: Node) -> None:
 
     # ------------------------------------------------------------- documents
     def put_doc(req):
-        resp = node.index_doc(
-            req.params["index"], req.params.get("id"), req.json() or {},
-            op_type=req.param("op_type", "index"),
-            refresh=req.param("refresh"),
-            routing=req.param("routing"),
-            if_seq_no=req.int_param("if_seq_no"),
-            if_primary_term=req.int_param("if_primary_term"),
-            version=req.int_param("version"),
-            version_type=req.param("version_type", "internal"),
-            pipeline=req.param("pipeline"))
-        return (201 if resp["result"] == "created" else 200), resp
+        with _rest_telemetry(req, node, "indices:data/write/index",
+                             force_trace=req.bool_param("trace"),
+                             description=f"[{req.params['index']}]"):
+            resp = node.index_doc(
+                req.params["index"], req.params.get("id"), req.json() or {},
+                op_type=req.param("op_type", "index"),
+                refresh=req.param("refresh"),
+                routing=req.param("routing"),
+                if_seq_no=req.int_param("if_seq_no"),
+                if_primary_term=req.int_param("if_primary_term"),
+                version=req.int_param("version"),
+                version_type=req.param("version_type", "internal"),
+                pipeline=req.param("pipeline"))
+            return (201 if resp["result"] == "created" else 200), resp
 
     def post_doc_auto_id(req):
-        resp = node.index_doc(req.params["index"], None, req.json() or {},
-                              refresh=req.param("refresh"),
-                              routing=req.param("routing"))
-        return 201, resp
+        with _rest_telemetry(req, node, "indices:data/write/index",
+                             force_trace=req.bool_param("trace"),
+                             description=f"[{req.params['index']}]"):
+            resp = node.index_doc(req.params["index"], None,
+                                  req.json() or {},
+                                  refresh=req.param("refresh"),
+                                  routing=req.param("routing"))
+            return 201, resp
 
     def create_doc(req):
         if req.param("version_type") in ("external", "external_gte"):
@@ -110,11 +131,14 @@ def register_all(rc: RestController, node: Node) -> None:
             raise ActionRequestValidationError(
                 "Validation Failed: 1: create operations only support "
                 "internal versioning. use index instead;")
-        resp = node.index_doc(req.params["index"], req.params["id"],
-                              req.json() or {}, op_type="create",
-                              refresh=req.param("refresh"),
-                              routing=req.param("routing"))
-        return 201, resp
+        with _rest_telemetry(req, node, "indices:data/write/index",
+                             force_trace=req.bool_param("trace"),
+                             description=f"[{req.params['index']}]"):
+            resp = node.index_doc(req.params["index"], req.params["id"],
+                                  req.json() or {}, op_type="create",
+                                  refresh=req.param("refresh"),
+                                  routing=req.param("routing"))
+            return 201, resp
 
     def _get_source_filter(req):
         src = req.param("_source")
@@ -168,28 +192,35 @@ def register_all(rc: RestController, node: Node) -> None:
         return 200, resp.get("_source")
 
     def delete_doc(req):
-        try:
-            resp = node.delete_doc(req.params["index"], req.params["id"],
-                                   refresh=req.param("refresh"),
-                                   routing=req.param("routing"),
-                                   if_seq_no=req.int_param("if_seq_no"),
-                                   if_primary_term=req.int_param("if_primary_term"),
-                                   version=req.int_param("version"),
-                                   version_type=req.param("version_type",
-                                                          "internal"))
-            return 200, resp
-        except DocumentMissingError:
-            return 404, {"_index": req.params["index"], "_id": req.params["id"],
-                         "result": "not_found"}
+        with _rest_telemetry(req, node, "indices:data/write/delete",
+                             force_trace=req.bool_param("trace"),
+                             description=f"[{req.params['index']}]"):
+            try:
+                resp = node.delete_doc(
+                    req.params["index"], req.params["id"],
+                    refresh=req.param("refresh"),
+                    routing=req.param("routing"),
+                    if_seq_no=req.int_param("if_seq_no"),
+                    if_primary_term=req.int_param("if_primary_term"),
+                    version=req.int_param("version"),
+                    version_type=req.param("version_type", "internal"))
+                return 200, resp
+            except DocumentMissingError:
+                return 404, {"_index": req.params["index"],
+                             "_id": req.params["id"],
+                             "result": "not_found"}
 
     def update_doc(req):
-        return 200, node.update_doc(
-            req.params["index"], req.params["id"], req.json() or {},
-            refresh=req.param("refresh"),
-            routing=req.param("routing"),
-            if_seq_no=req.int_param("if_seq_no"),
-            if_primary_term=req.int_param("if_primary_term"),
-            source_filter=_get_source_filter(req))
+        with _rest_telemetry(req, node, "indices:data/write/update",
+                             force_trace=req.bool_param("trace"),
+                             description=f"[{req.params['index']}]"):
+            return 200, node.update_doc(
+                req.params["index"], req.params["id"], req.json() or {},
+                refresh=req.param("refresh"),
+                routing=req.param("routing"),
+                if_seq_no=req.int_param("if_seq_no"),
+                if_primary_term=req.int_param("if_primary_term"),
+                source_filter=_get_source_filter(req))
 
     rc.register("PUT", "/{index}/_doc/{id}", put_doc)
     rc.register("POST", "/{index}/_doc/{id}", put_doc)
@@ -302,10 +333,22 @@ def register_all(rc: RestController, node: Node) -> None:
                         resp["suggest"].pop(name)
 
     def bulk(req):
-        return 200, node.bulk(req.ndjson(),
-                              default_index=req.params.get("index"),
-                              refresh=req.param("refresh"),
-                              source_filter=_get_source_filter(req))
+        t_parse = time.perf_counter_ns()
+        ops = req.ndjson()
+        parse_nanos = time.perf_counter_ns() - t_parse
+        with _rest_telemetry(req, node, "indices:data/write/bulk",
+                             force_trace=req.bool_param("trace"),
+                             description=f"requests[{len(ops)}]",
+                             parse_nanos=parse_nanos):
+            t0 = time.perf_counter_ns()
+            resp = node.bulk(ops,
+                             default_index=req.params.get("index"),
+                             refresh=req.param("refresh"),
+                             source_filter=_get_source_filter(req))
+            telemetry.record_span("bulk.execute",
+                                  time.perf_counter_ns() - t0,
+                                  ops=len(ops))
+            return 200, resp
 
     rc.register("POST", "/_bulk", bulk)
     rc.register("PUT", "/_bulk", bulk)
@@ -327,7 +370,27 @@ def register_all(rc: RestController, node: Node) -> None:
 
     # ---------------------------------------------------------------- search
     def search(req):
+        t_parse = time.perf_counter_ns()
         body = req.json() or {}
+        parse_nanos = time.perf_counter_ns() - t_parse
+        # every search runs as a live task under telemetry: sampled by
+        # telemetry.tracing.sample_rate, forced by ?trace=true or a
+        # profile body; X-Opaque-ID rides the task, the trace, and any
+        # slow-log breach
+        with _rest_telemetry(
+                req, node, "indices:data/read/search",
+                force_trace=(req.bool_param("trace")
+                             or bool(body.get("profile"))),
+                description=f"indices[{req.params.get('index') or '_all'}]",
+                parse_nanos=parse_nanos) as tr:
+            status, resp = _search_inner(req, body)
+            if tr is not None and isinstance(resp, dict) \
+                    and body.get("profile"):
+                from elasticsearch_tpu.search.profile import trace_profile
+                resp.setdefault("profile", {})["trace"] = trace_profile(tr)
+            return status, resp
+
+    def _search_inner(req, body):
         # URI-search params (q=, size=, from=, sort=)
         body = apply_uri_query(req, body)
         for p, key in (("size", "size"), ("from", "from")):

@@ -193,10 +193,21 @@ def register_admin(rc: RestController, node: Node) -> None:
     def hot_threads(req):
         interval = float(req.param("interval", "50ms").rstrip("ms")) / 1000 \
             if str(req.param("interval", "50ms")).endswith("ms") else 0.05
-        return 200, node.hot_threads_api(interval)
+        top_n = req.int_param("threads", 3)
+        return 200, node.hot_threads_api(interval, top_n=top_n)
 
     rc.register("GET", "/_nodes/hot_threads", hot_threads)
     rc.register("GET", "/_nodes/{node_id}/hot_threads", hot_threads)
+
+    def node_traces(req):
+        """`GET _nodes/traces` (telemetry): every node's bounded ring of
+        completed traces, most recent first — coordinator traces on the
+        coordinating node, shard segments on each data node, joined by
+        trace_id."""
+        return 200, node.traces_api(limit=req.int_param("size", 50))
+
+    rc.register("GET", "/_nodes/traces", node_traces)
+    rc.register("GET", "/_nodes/{node_id}/traces", node_traces)
 
     def slowlog(req):
         return 200, {"search": node.search_slow_log.entries,

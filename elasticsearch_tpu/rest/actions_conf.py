@@ -351,6 +351,13 @@ def register_conf(rc: "RestController", node: "Node") -> None:
         matched = node.tasks.list_tasks(req.param("actions"))
         if not matched:
             return 200, {"nodes": {}, "node_failures": []}
+        # actually cancel, not just list: the task object doubles as the
+        # cancellation token the continuous batcher's EDF queue observes
+        # — a cancelled in-flight search's queued entries shed at
+        # admission exactly like expired deadlines (serving/batcher.py)
+        for t in matched:
+            if t.cancellable:
+                t.cancelled = True
         return 200, {"nodes": {node.node_id: {
             "name": node.node_name,
             "tasks": {t.task_id: t.to_dict(node.node_id)

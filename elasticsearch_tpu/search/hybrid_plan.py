@@ -687,11 +687,43 @@ class HybridExecutor:
             hydrate_nanos = time.perf_counter_ns() - t0
             with self._stats_lock:
                 self.stats["hydrate_nanos"] += hydrate_nanos
+            # private key (popped by _search_rrf): the slow log needs
+            # the phase breakdown on EVERY breach, not just profiled
+            # requests — batch-scoped figures, same semantics as the
+            # profile breakdown
+            took_phases = {
+                "plan_nanos": plan_nanos,
+                "queue_wait_nanos": handle["sched_meta"].get(
+                    "queue_wait_max_nanos", 0),
+                "device_dispatch_nanos": handle["dispatch_nanos"],
+                "device_sync_nanos": sync_nanos,
+                "fuse_nanos": fuse_nanos,
+                "hydrate_nanos": hydrate_nanos,
+                "batch_size": len(bodies)}
             for resp in out:
+                resp["_took_phases"] = dict(took_phases)
                 prof = resp.get("profile")
                 if prof is not None:
                     prof["hybrid"]["breakdown"]["hydrate_nanos"] = \
                         hydrate_nanos
+            tr = handle["sched_meta"].get("trace")
+            if tr is not None:
+                # fine-grained stage attribution on the batch LEADER's
+                # trace (the batcher already recorded the coarse
+                # batch.dispatch/batch.finalize pair and linked
+                # followers): every duration below was measured at an
+                # existing sync point — retroactive spans, zero added
+                # host syncs
+                parent = handle["sched_meta"].get("trace_parent")
+                tr.record_span("hybrid.plan", plan_nanos, parent_id=parent)
+                tr.record_span("hybrid.device_dispatch",
+                               handle["dispatch_nanos"], parent_id=parent,
+                               coalesced=len(bodies))
+                tr.record_span("hybrid.device_sync", sync_nanos,
+                               parent_id=parent)
+                tr.record_span("hybrid.fuse", fuse_nanos, parent_id=parent)
+                tr.record_span("hybrid.hydrate", hydrate_nanos,
+                               parent_id=parent)
             return out
         finally:
             self.node.breakers.release("request",

@@ -251,6 +251,15 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
     return profile
 
 
+def trace_profile(trace) -> dict:
+    """`profile.trace` section: the request's telemetry trace id plus its
+    longest spans so far — the bridge from the opt-in per-request profile
+    to the always-on trace ring (`GET _nodes/traces` serves the full span
+    tree under this id, including remote segments a cross-node search
+    absorbed)."""
+    return {"trace_id": trace.trace_id, "top_spans": trace.top_spans(5)}
+
+
 def fanout_profile(phases: dict) -> dict:
     """`profile.fanout` section for a cross-node search (serving/
     fanout.py): per-phase fan-out counts, budgets, elapsed time, and the

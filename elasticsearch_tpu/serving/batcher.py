@@ -49,7 +49,11 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from elasticsearch_tpu.common.errors import TaskCancelledError
 from elasticsearch_tpu.common.threadpool import EsRejectedExecutionError
+from elasticsearch_tpu.telemetry import metrics as _metrics
+from elasticsearch_tpu.telemetry import thread_section as _thread_section
+from elasticsearch_tpu.telemetry import trace as _tt
 
 _overhead_lock = threading.Lock()
 _overhead_ms: Optional[float] = None
@@ -154,7 +158,8 @@ class CostModel:
 class _QueueEntry:
     """One queued request: payload, future, and its schedule metadata."""
 
-    __slots__ = ("request", "fut", "enqueued", "deadline", "seq", "claimed")
+    __slots__ = ("request", "fut", "enqueued", "deadline", "seq", "claimed",
+                 "trace", "span_parent", "token")
 
     def __init__(self, request, fut: Future, enqueued: float,
                  deadline: Optional[float], seq: int):
@@ -164,6 +169,13 @@ class _QueueEntry:
         self.deadline = deadline   # monotonic instant; None = never expires
         self.seq = seq             # arrival order (EDF tie-break)
         self.claimed = False       # a runner owns it (set under _q_lock)
+        # telemetry context, captured from the SUBMITTING thread at
+        # enqueue time: the pipelined batcher claims, dispatches, and
+        # finalizes this entry on other threads, so thread-locals alone
+        # cannot follow the request — the entry carries its own trace
+        # (None = unsampled), parent span id, and cancellation token
+        # (the live task; a truthy `.cancelled` sheds at EDF admission)
+        self.trace, self.span_parent, self.token = _tt.capture()
 
     def sort_key(self) -> Tuple[float, int]:
         return (self.deadline if self.deadline is not None else float("inf"),
@@ -172,7 +184,8 @@ class _QueueEntry:
 
 def _fresh_sched_stats() -> dict:
     return {"batches": 0, "pipelined_batches": 0, "requests": 0,
-            "topups": 0, "deadline_sheds": 0, "overlap_hits": 0,
+            "topups": 0, "deadline_sheds": 0, "cancelled_sheds": 0,
+            "overlap_hits": 0,
             "queue_wait_nanos": 0, "dispatch_nanos": 0,
             "finalize_nanos": 0}
 
@@ -307,10 +320,24 @@ class CombiningBatcher:
                 f"rejected execution: request spent "
                 f"{waited:.0f}ms queued, over the admission deadline"))
 
+    def _shed_cancelled(self, entry: _QueueEntry, now: float) -> None:
+        """Cancellation shed: the request's task was cancelled
+        (`POST _tasks/_cancel`) while it sat queued — it leaves the EDF
+        queue exactly like an expired deadline, before any device time
+        is spent on an answer nobody will read."""
+        self.sched["cancelled_sheds"] += 1
+        if entry.trace is not None:
+            entry.trace.record_span(
+                "queue.wait", int((now - entry.enqueued) * 1e9),
+                parent_id=entry.span_parent, status="cancelled")
+        if not entry.fut.done():
+            entry.fut.set_exception(TaskCancelledError(
+                "task cancelled while queued (shed at EDF admission)"))
+
     def _claim_locked(self, want: int, now: float) -> List[_QueueEntry]:
         """Take up to `want` entries off the queue, earliest deadline
-        first, shedding any whose deadline has already passed. Caller
-        holds `_q_lock`."""
+        first, shedding any whose deadline has already passed (or whose
+        task was cancelled). Caller holds `_q_lock`."""
         if not self._queue:
             return []
         # deadline-less queues (the base batcher) are already in seq
@@ -323,13 +350,24 @@ class CombiningBatcher:
         claimed: List[_QueueEntry] = []
         keep: List[_QueueEntry] = []
         for entry in self._queue:
+            if entry.token is not None \
+                    and getattr(entry.token, "cancelled", False):
+                self._shed_cancelled(entry, now)
+                continue
             if entry.deadline is not None and now > entry.deadline:
                 self._shed(entry, now)
                 continue
             if len(claimed) < want:
                 entry.claimed = True
-                self.sched["queue_wait_nanos"] += int(
-                    (now - entry.enqueued) * 1e9)
+                wait_ns = int((now - entry.enqueued) * 1e9)
+                self.sched["queue_wait_nanos"] += wait_ns
+                # live-tail surface + per-request attribution: both are
+                # plain host writes (no syncs, no allocation beyond the
+                # span) — safe under _q_lock
+                _metrics.record("serving.queue_wait", wait_ns)
+                if entry.trace is not None:
+                    entry.trace.record_span("queue.wait", wait_ns,
+                                            parent_id=entry.span_parent)
                 claimed.append(entry)
             else:
                 keep.append(entry)
@@ -378,11 +416,15 @@ class CombiningBatcher:
         return batch
 
     # ------------------------------------------------------------ serving
-    def _set_results(self, batch: List[_QueueEntry], results: List) -> None:
+    @staticmethod
+    def _check_results(batch: List[_QueueEntry], results: List) -> None:
         if len(results) != len(batch):
             raise RuntimeError(
                 f"batch executor returned {len(results)} results "
                 f"for {len(batch)} requests")
+
+    def _set_results(self, batch: List[_QueueEntry], results: List) -> None:
+        self._check_results(batch, results)
         for entry, res in zip(batch, results):
             entry.fut.set_result(res)
 
@@ -401,6 +443,35 @@ class CombiningBatcher:
                 entry.fut.set_result(self._execute([entry.request])[0])
             except Exception as one_exc:
                 entry.fut.set_exception(one_exc)
+
+    @staticmethod
+    def _trace_leader(batch: List[_QueueEntry]) -> Optional[_QueueEntry]:
+        """The batch's trace LEADER: the first member with a sampled
+        trace. The leader's trace carries the batch-level device spans;
+        other traced members (followers) link to them instead of
+        double-counting device time that was shared by the whole
+        coalesced batch."""
+        for entry in batch:
+            if entry.trace is not None:
+                return entry
+        return None
+
+    def _trace_batch(self, batch: List[_QueueEntry], name: str,
+                     dur_ns: int, status: str = "ok") -> None:
+        """Record one batch-stage span on the leader's trace and link
+        every traced follower to it. Retroactive spans only — the
+        duration was already measured at an existing sync point, so this
+        adds zero host syncs."""
+        leader = self._trace_leader(batch)
+        if leader is None:
+            return
+        span_id = leader.trace.record_span(
+            name, dur_ns, parent_id=leader.span_parent, status=status,
+            coalesced=len(batch))
+        for entry in batch:
+            if entry.trace is not None and entry is not leader:
+                entry.trace.add_link(leader.trace.trace_id, span_id,
+                                     "coalesced_follower")
 
     def _trace_since(self, batch: List[_QueueEntry]) -> Optional[int]:
         # dispatch-trace attribution (profile.dispatch): the runner
@@ -431,18 +502,44 @@ class CombiningBatcher:
         lock)."""
         trace_since = self._trace_since(batch)
         t0 = time.perf_counter_ns()
+        err: Optional[BaseException] = None
+        results = None
+
+        def land_stage() -> None:
+            # stats + stage span land BEFORE any future resolves: a
+            # submitter thread woken by set_result may immediately
+            # finish its request and ship the trace — the span must
+            # already be in it. Sync path: dispatch + device sync ran
+            # back to back, so the whole stage is one figure.
+            dt = time.perf_counter_ns() - t0
+            self.sched["dispatch_nanos"] += dt
+            _metrics.record("serving.device_dispatch", dt)
+            self._trace_batch(batch, "batch.execute", dt,
+                              status="ok" if err is None else "error")
+
         try:
-            self._set_results(batch,
-                              self._execute([e.request for e in batch]))
-        except Exception as exc:
-            self._retry_serially(batch, exc)
-        except BaseException as exc:  # KeyboardInterrupt/SystemExit:
-            for entry in batch:      # fail fast, no serial retries
-                if not entry.fut.done():
-                    entry.fut.set_exception(exc)
-            raise
+            try:
+                results = self._execute([e.request for e in batch])
+            except Exception as exc:
+                err = exc
+            except BaseException as exc:  # KeyboardInterrupt/SystemExit:
+                err = exc
+                land_stage()
+                for entry in batch:      # fail fast, no serial retries
+                    if not entry.fut.done():
+                        entry.fut.set_exception(exc)
+                raise
+            if err is None:
+                try:
+                    self._check_results(batch, results)
+                except Exception as exc:
+                    err = exc
+            land_stage()
+            if err is None:
+                self._set_results(batch, results)
+            else:
+                self._retry_serially(batch, err)
         finally:
-            self.sched["dispatch_nanos"] += time.perf_counter_ns() - t0
             self._annotate(trace_since, len(batch))
 
     def _begin_pipelined(self, batch: List[_QueueEntry]):
@@ -458,12 +555,14 @@ class CombiningBatcher:
                 self.sched["overlap_hits"] += 1
             self._inflight += 1
         t0 = time.perf_counter_ns()
+        handle: Any = None
+        err: Optional[BaseException] = None
         try:
             handle = self._dispatch_fn([e.request for e in batch])
-            err: Optional[Exception] = None
         except Exception as exc:
-            handle, err = None, exc
+            err = exc
         except BaseException as exc:
+            err = exc
             for entry in batch:
                 if not entry.fut.done():
                     entry.fut.set_exception(exc)
@@ -471,7 +570,12 @@ class CombiningBatcher:
             self._annotate(trace_since, len(batch))
             raise
         finally:
-            self.sched["dispatch_nanos"] += time.perf_counter_ns() - t0
+            dt = time.perf_counter_ns() - t0
+            self.sched["dispatch_nanos"] += dt
+            # pipelined launch: un-synced device work under the lock
+            _metrics.record("serving.device_dispatch", dt)
+            self._trace_batch(batch, "batch.dispatch", dt,
+                              status="ok" if err is None else "error")
         return batch, handle, err, trace_since
 
     def _end_pipelined(self) -> None:
@@ -487,18 +591,40 @@ class CombiningBatcher:
         dispatch stage."""
         released = False
         t0 = time.perf_counter_ns()
+        results = None
+
+        def land_stage() -> None:
+            # the deferred device-sync + host post-processing stage:
+            # histogram for the live tail, leader span + follower links
+            # for per-request attribution. Lands BEFORE any future
+            # resolves — a submitter thread woken by set_result may
+            # immediately finish its request and ship the trace, and the
+            # span must already be in it.
+            dt = time.perf_counter_ns() - t0
+            with self._q_lock:   # concurrent finalizes both land here
+                self.sched["finalize_nanos"] += dt
+            _metrics.record("serving.device_sync", dt)
+            self._trace_batch(batch, "batch.finalize", dt,
+                              status="ok" if err is None else "error")
+
         try:
             if err is None:
                 try:
-                    self._set_results(batch, self._finalize_fn(handle))
+                    results = self._finalize_fn(handle)
+                    self._check_results(batch, results)
                 except Exception as exc:
                     err = exc
                 except BaseException as exc:
+                    err = exc
+                    land_stage()
                     for entry in batch:
                         if not entry.fut.done():
                             entry.fut.set_exception(exc)
                     raise
-            if err is not None:
+            land_stage()
+            if err is None:
+                self._set_results(batch, results)
+            else:
                 # serial retries re-enter the FULL sync executor
                 # (dispatch + finalize) — take the scheduler lock so
                 # they serialize with other dispatch stages exactly like
@@ -512,9 +638,6 @@ class CombiningBatcher:
                 with self._run_lock:
                     self._retry_serially(batch, err)
         finally:
-            dt = time.perf_counter_ns() - t0
-            with self._q_lock:   # concurrent finalizes both land here
-                self.sched["finalize_nanos"] += dt
             if not released:
                 self._end_pipelined()
             self._annotate(trace_since, len(batch))
@@ -547,17 +670,30 @@ class CombiningBatcher:
             self.sched["batches"] += 1
             self.sched["requests"] += len(batch)
             now = time.monotonic()
+            leader = self._trace_leader(batch)
             self._tls.meta = {
                 "coalesced": len(batch),
                 "queue_wait_max_nanos": int(max(
-                    (now - e.enqueued) for e in batch) * 1e9)}
-            if self._dispatch_fn is not None:
-                self.sched["pipelined_batches"] += 1
-                pending = self._begin_pipelined(batch)
-            else:
-                self._run_sync(batch)
+                    (now - e.enqueued) for e in batch) * 1e9),
+                # leader trace handoff: the executor's finalize stage
+                # (possibly another thread) attaches its fine-grained
+                # spans (plan/fuse/hydrate) to the batch leader's trace
+                "trace": leader.trace if leader is not None else None,
+                "trace_parent": leader.span_parent
+                if leader is not None else None}
+            # name the drain/finalize sections on the borrowed runner
+            # thread so `_nodes/hot_threads` attributes a busy stack to
+            # the batcher instead of to whichever client thread happened
+            # to become the runner
+            with _thread_section("batcher-drain"):
+                if self._dispatch_fn is not None:
+                    self.sched["pipelined_batches"] += 1
+                    pending = self._begin_pipelined(batch)
+                else:
+                    self._run_sync(batch)
         if pending is not None:
-            self._finish_pipelined(*pending)
+            with _thread_section("batcher-finalize"):
+                self._finish_pipelined(*pending)
 
     def submit(self, request, deadline_at: Optional[float] = None):
         fut: Future = Future()
@@ -605,7 +741,8 @@ class BoundedBatcher(CombiningBatcher):
         self.max_queue_depth = max_queue_depth
         self.deadline_ms = deadline_ms
         self.stats = {"accepted": 0, "rejected_depth": 0,
-                      "shed_deadline": 0, "max_depth_seen": 0}
+                      "shed_deadline": 0, "shed_cancelled": 0,
+                      "max_depth_seen": 0}
         if warmup is not None:
             # warmup-at-start: pre-compile the dispatch bucket grid off
             # the critical path, so the queue's first drained batch finds
@@ -641,6 +778,10 @@ class BoundedBatcher(CombiningBatcher):
                 f"rejected execution: request spent "
                 f"{waited:.0f}ms queued, over the "
                 f"{self.deadline_ms:.0f}ms admission deadline"))
+
+    def _shed_cancelled(self, entry: _QueueEntry, now: float) -> None:
+        self.stats["shed_cancelled"] += 1
+        super()._shed_cancelled(entry, now)
 
     def _admit(self, depth: int, now: float) -> None:
         if depth >= self.max_queue_depth:

@@ -60,6 +60,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional
 
+from elasticsearch_tpu.telemetry import metrics as _metrics
+
 # key under which a sub-request carries its deadline envelope; "_"-prefixed
 # so it can never collide with a user-visible request field
 ENVELOPE_KEY = "_fanout"
@@ -110,6 +112,26 @@ def attach_deadline(request: dict, deadline_at_ms: Optional[int],
         request[ENVELOPE_KEY] = {"deadline_at_ms": int(deadline_at_ms),
                                  "sent_at_ms": int(now_ms)}
     return request
+
+
+def attach_trace(request: dict, trace, parent_span_id: str) -> dict:
+    """Ride the trace context on the deadline envelope: the remote node
+    opens a trace SEGMENT with the same trace id whose spans parent under
+    `parent_span_id` (the coordinator's per-leg span), so the merged
+    trace reads as one tree across the transport. No-op when the request
+    isn't traced."""
+    if trace is not None:
+        request.setdefault(ENVELOPE_KEY, {})["trace"] = {
+            "trace_id": trace.trace_id,
+            "parent_span_id": parent_span_id,
+            "opaque_id": trace.opaque_id,
+        }
+    return request
+
+
+def trace_ctx_of(request: Optional[dict]) -> Optional[dict]:
+    """The trace context an arriving sub-request carries, or None."""
+    return ((request or {}).get(ENVELOPE_KEY) or {}).get("trace")
 
 
 def remaining_ms(request: Optional[dict], now_ms: int) -> Optional[float]:
@@ -202,12 +224,19 @@ class ScatterGather:
     def __init__(self, scheduler, *, phase: str, budget_ms: int,
                  stats: Optional[FanoutStats] = None,
                  on_done: Optional[Callable[[dict], None]] = None,
-                 observe: Optional[Callable[[str, float], None]] = None):
+                 observe: Optional[Callable[[str, float], None]] = None,
+                 trace=None, trace_parent: Optional[str] = None):
         self._scheduler = scheduler
         self.phase = phase
         self.budget_ms = max(int(budget_ms), 0)
         self.stats = stats if stats is not None else FanoutStats()
         self._on_done = on_done
+        # request trace (telemetry.trace.Trace) of the search this phase
+        # serves: each launch opens a per-leg span ended at resolution —
+        # resolution is structural (response/failure/sweep timer), so a
+        # dead node produces an ERROR span, never a leaked one
+        self._trace = trace
+        self._trace_parent = trace_parent
         # latency observer (ARS EWMA feed): called with (node_id, took_ms)
         # for on-time responses AND late arrivals; timeouts feed a penalty
         self._observe = observe
@@ -226,13 +255,22 @@ class ScatterGather:
     # ------------------------------------------------------------ launching
     def launch(self, key: Any, node_id: str,
                send: Callable[[Callable, Callable], None],
-               on_item: Optional[Callable[[str, Any, Any], None]] = None
-               ) -> None:
+               on_item: Optional[Callable[[str, Any, Any], None]] = None,
+               request: Optional[dict] = None) -> None:
         pc = self.stats.phase(self.phase)
         pc["launched"] += 1
         self._launched += 1
         self._pending[key] = node_id
         sent_ms = self._scheduler.now_ms
+        leg_span = None
+        if self._trace is not None:
+            leg_span = self._trace.begin_span(
+                f"{self.phase}[{node_id}]", parent_id=self._trace_parent,
+                node=node_id, shard=str(key))
+            if request is not None:
+                # the remote's segment parents under THIS leg span, so
+                # the merged tree shows coordinator leg → remote work
+                attach_trace(request, self._trace, leg_span.span_id)
 
         def resolve(outcome: str, payload=None, err=None) -> None:
             if self._pending.pop(key, None) is None:
@@ -240,6 +278,11 @@ class ScatterGather:
             self._timeout_resolvers.pop(key, None)
             self._counts[outcome] += 1
             pc[outcome] += 1
+            if leg_span is not None:
+                # one end per leg, on every outcome: a dead node's leg is
+                # an ERROR span in the trace, not a leak
+                self._trace.end_span(
+                    leg_span, status="ok" if outcome == OK else outcome)
             try:
                 if on_item is not None:
                     on_item(outcome, payload, err)
@@ -249,6 +292,9 @@ class ScatterGather:
 
         def on_response(resp) -> None:
             took = max(self._scheduler.now_ms - sent_ms, 0)
+            # live fan-out leg tail (`_nodes/stats telemetry`): scheduler-
+            # clock ms (virtual under the simulator) as nanos
+            _metrics.record("fanout.leg", int(took * 1e6))
             if key not in self._pending:
                 # late: the timer already resolved this shard. Observe the
                 # true latency (the ARS signal that makes the next request

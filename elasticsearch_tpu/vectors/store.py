@@ -34,6 +34,7 @@ from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.serving.batcher import CombiningBatcher, CostModel
+from elasticsearch_tpu.telemetry import metrics as _telemetry_metrics
 from elasticsearch_tpu.vectors.host_corpus import HostFieldCorpus, packed_nbytes
 
 # host int8 mirrors are built for corpora whose packed+rescore footprint is
@@ -633,13 +634,18 @@ class VectorStoreShard:
     def _begin_dispatch(self) -> int:
         """Count this dispatch in flight; returns how many OTHERS were
         already in flight (the dp router's concurrency half of the load
-        signal)."""
+        signal). Mirrored onto the telemetry registry so `_nodes/stats
+        telemetry` shows the live in-flight gauge next to the latency
+        histograms (resolved per call — a cached Gauge handle would
+        detach from the registry across a test-time `reset()`)."""
+        _telemetry_metrics.gauge("serving.inflight_dispatches").inc()
         with self._active_lock:
             n = self._active_dispatches
             self._active_dispatches += 1
             return n
 
     def _end_dispatch(self) -> None:
+        _telemetry_metrics.gauge("serving.inflight_dispatches").dec()
         with self._active_lock:
             self._active_dispatches = max(0, self._active_dispatches - 1)
 

@@ -1,0 +1,20 @@
+"""TPU012 fires: wall-clock durations in a hot module + leaked spans."""
+# tpulint: hot-path
+import time
+
+
+def wall_clock_duration(fn):
+    t0 = time.time()  # [expect] wall clock read in a hot module
+    fn()
+    return time.time() - t0  # [expect] and the matching re-read
+
+
+def leaky_live_span(trace):
+    sp = trace.begin_span("score")  # [expect] opened, never closed
+    return sp
+
+
+def leaky_on_error_path(tracer, work):
+    span = tracer.start_span("drain")  # [expect] no close anywhere
+    work()
+    return span

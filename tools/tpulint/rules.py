@@ -1141,9 +1141,139 @@ class PrivateSegmentCacheRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# TPU012 — wall-clock durations in hot modules & leaked telemetry spans
+# ---------------------------------------------------------------------------
+
+class TelemetryDisciplineRule(Rule):
+    """TPU012: two telemetry bug classes from ISSUE 14's always-on
+    observability layer.
+
+    (a) `time.time()` in a HOT-PATH module. Telemetry made duration
+    measurement ubiquitous (every request records queue-wait / dispatch /
+    sync / took), and a wall-clock duration is wrong twice: NTP steps it
+    (negative or wildly long "latencies" polluting the log2 histograms
+    that now feed `_nodes/stats telemetry` p99), and it costs a VDSO
+    gettimeofday on every hot-path call for less guarantee than
+    `time.monotonic()`/`perf_counter()` give. Epoch TIMESTAMPS for
+    display belong outside hot modules (Task.start_ms lives in
+    node_admin for exactly this reason).
+
+    (b) a live telemetry span opened via `begin_span(...)`/
+    `start_span(...)` and bound to a local variable with NO structural
+    close in the enclosing function — no `end_span(x)`, no
+    `x.end()`/`x.finish()`, not a `with` item. A leaked span stays open
+    forever: the tasks API reports it as the request's `current_span`
+    after the request finished, and the trace ring shows a span with
+    `dur_ns: null` that sums into nothing. The fix is the `span()`
+    context manager, `end_span` in a `finally:`, or — for durations
+    measured at existing sync points — the retroactive
+    `record_span(name, dur_ns)`, which is born closed and cannot leak.
+    Spans stored onto objects (attributes, dict slots) are cross-thread
+    handoffs the analysis cannot follow and stay out of scope, like
+    TPU004's aliasing rules.
+    """
+
+    rule_id = "TPU012"
+    summary = "wall-clock duration in hot module / leaked telemetry span"
+
+    _SPAN_OPENERS = frozenset({"begin_span", "start_span"})
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        if ctx.hot_path:
+            self._wall_clock_findings(ctx, findings)
+        analyzed: Set[ast.AST] = set()
+        for fn in iter_functions(ctx.tree):
+            # outermost functions whole: the open and its close may live
+            # in different closures of one coordinator function (the
+            # scatter-gather launch/resolve shape)
+            cur = ctx.parents.get(fn)
+            nested = False
+            while cur is not None:
+                if cur in analyzed:
+                    nested = True
+                    break
+                cur = ctx.parents.get(cur)
+            if nested:
+                continue
+            analyzed.add(fn)
+            self._leaked_span_findings(fn, ctx, findings)
+        return findings
+
+    def _wall_clock_findings(self, ctx: ModuleContext,
+                             findings: List[Finding]) -> None:
+        time_mods: Set[str] = set()
+        time_fns: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_mods.add(alias.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_fns.add(alias.asname or "time")
+        if not time_mods and not time_fns:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or node.args or node.keywords:
+                continue
+            fn = node.func
+            hit = (isinstance(fn, ast.Attribute) and fn.attr == "time"
+                   and isinstance(fn.value, ast.Name)
+                   and fn.value.id in time_mods) \
+                or (isinstance(fn, ast.Name) and fn.id in time_fns)
+            if hit:
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "time.time() in a hot-path module: wall clocks step "
+                    "under NTP, so durations built from them poison the "
+                    "telemetry histograms — use time.monotonic() / "
+                    "time.perf_counter_ns() for durations (epoch "
+                    "timestamps belong outside hot modules)"))
+
+    def _leaked_span_findings(self, fn, ctx: ModuleContext,
+                              findings: List[Finding]) -> None:
+        opens: Dict[str, ast.Call] = {}
+        closed: Set[str] = set()
+        with_items: Set[ast.AST] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_items.add(item.context_expr)
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in self._SPAN_OPENERS:
+                opens[node.targets[0].id] = node.value
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                if node.func.attr == "end_span" and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    closed.add(node.args[0].id)
+                elif node.func.attr in ("end", "finish"):
+                    base = base_name(node.func.value)
+                    if base:
+                        closed.add(base)
+        for name, call in opens.items():
+            if call in with_items or name in closed:
+                continue
+            findings.append(ctx.finding(
+                self.rule_id, call,
+                f"span [{name}] opened with "
+                f"{call.func.attr}() but never closed in this function "
+                "(leaked-span class): the tasks API keeps reporting it "
+                "as current_span and the trace ring shows dur_ns: null "
+                "— use the span() context manager, end_span in a "
+                "finally:, or the retroactive record_span(name, dur_ns)"))
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
     ModuleCacheLockRule(), LockedSyncRule(), UnguardedFanoutRule(),
-    PrivateSegmentCacheRule(),
+    PrivateSegmentCacheRule(), TelemetryDisciplineRule(),
 ]
