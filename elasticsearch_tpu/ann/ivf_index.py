@@ -33,6 +33,7 @@ import numpy as np
 from elasticsearch_tpu.ann import kmeans as kmeans_lib
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.quantization import quantize_int8_np
+from elasticsearch_tpu.quant import codec as quant_codec
 
 # partition capacity is padded to this many rows (f32 sublane tile)
 CAP_PAD = 8
@@ -243,6 +244,17 @@ class IVFIndex:
             part_scales = jnp.asarray(
                 np.where(valid, scales.reshape(self.nlist, self.cap), 0.0)
                 .astype(np.float32))
+        elif self.dtype in quant_codec.PACKED_ENCODINGS:
+            # packed rungs (int4 nibbles / binary sign bits): encode the
+            # bucketed layout through the codec registry — padding slots
+            # zero their scale so the score kernels mask them like int8
+            codec = quant_codec.get(self.dtype)
+            enc = codec.encode_np(self.part_vecs.reshape(-1, self.dims))
+            w = codec.packed_width(self.dims)
+            parts = jnp.asarray(enc.data.reshape(self.nlist, self.cap, w))
+            part_scales = jnp.asarray(
+                np.where(valid, enc.scales.reshape(self.nlist, self.cap),
+                         0.0).astype(np.float32))
         else:
             mm = jnp.bfloat16 if self.dtype == "bf16" else jnp.float32
             parts = jnp.asarray(self.part_vecs, dtype=mm)

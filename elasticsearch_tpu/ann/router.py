@@ -158,6 +158,8 @@ class IVFRouter:
 
         from elasticsearch_tpu.ops import knn_ivf
 
+        from elasticsearch_tpu.ops import pallas_ivf_fused as fused
+
         idx = self.index
         nprobe = max(1, min(nprobe, idx.nlist))
         t0 = time.perf_counter_ns()
@@ -169,8 +171,17 @@ class IVFRouter:
         probe_ids.block_until_ready()
         t1 = time.perf_counter_ns()
         k_dev = min(k, nprobe * idx.cap)
-        scores, rows = knn_ivf.score_probes(q, parts, probe_ids, k_dev,
-                                            metric=idx.metric)
+        # fused Pallas gather+score when the layout/metric allow and the
+        # backend prefers it (accelerators; ES_TPU_IVF_FUSED forces in
+        # interpret mode) — no [Q, nprobe, cap, D] staged tile gather
+        use_fused = (fused.fused_eligible(parts.parts.dtype, idx.metric)
+                     and fused.fused_preferred())
+        if use_fused:
+            scores, rows = fused.fused_probe_scores(
+                q, parts, probe_ids, k_dev, metric=idx.metric)
+        else:
+            scores, rows = knn_ivf.score_probes(q, parts, probe_ids, k_dev,
+                                                metric=idx.metric)
         rows.block_until_ready()
         t2 = time.perf_counter_ns()
         scores_np, rows_np = _pad_back_k(scores, rows, k, k_dev)
@@ -178,6 +189,7 @@ class IVFRouter:
         phases = {"engine": "tpu_ivf", "nprobe": nprobe,
                   "nlist": idx.nlist,
                   "scored_rows": nprobe * idx.cap,
+                  "fused_probe": use_fused,
                   "route_nanos": t1 - t0, "score_nanos": t2 - t1,
                   "merge_nanos": t3 - t2}
         return scores_np, rows_np, phases

@@ -89,6 +89,53 @@ def extract_vector_block(view, field: str) -> Optional[VectorBlock]:
                        zero_copy=False)
 
 
+class EncodedVectorBlock:
+    """One segment's live rows of one dense_vector field, codec-encoded
+    (`quant/codec.py`) — the packed-ladder VARIANT of ``VectorBlock``.
+
+    Cached per (segment, field, encoding, metric) exactly like the f32
+    blocks, so a refresh re-encodes only delta segments and a dtype
+    re-encode merge reads already-encoded tails for free. ``data`` is
+    the packed rows [n_live, W], ``scales`` the per-row aux; rows encode
+    independently, so concatenating blocks is byte-identical to
+    encoding the concatenation."""
+
+    __slots__ = ("fingerprint", "data", "scales", "rows", "nbytes")
+
+    def __init__(self, fp: tuple, data: np.ndarray, scales: np.ndarray,
+                 rows: np.ndarray):
+        self.fingerprint = fp
+        self.data = data
+        self.scales = scales
+        self.rows = rows
+        self.nbytes = data.nbytes + scales.nbytes
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.rows)
+
+
+def extract_encoded_vector_block(view, field: str, encoding: str,
+                                 metric: str,
+                                 f32_block: Optional[VectorBlock]
+                                 ) -> Optional[EncodedVectorBlock]:
+    """Codec-encode one segment's live rows (metric-prepped first:
+    cosine rows normalize per row, so per-segment encoding agrees with
+    whole-corpus encoding byte for byte). `f32_block` is the segment's
+    cached ``VectorBlock`` — the store passes it so the f32 extraction
+    is never repeated here."""
+    from elasticsearch_tpu.quant import codec as quant_codec
+    if f32_block is None:
+        return None
+    fp = fingerprint(view, (encoding, metric))
+    mat = np.asarray(f32_block.matrix, dtype=np.float32)
+    if metric == "cosine":
+        norms = np.linalg.norm(mat, axis=-1, keepdims=True)
+        mat = mat / np.maximum(norms, 1e-30)
+    enc = quant_codec.get(encoding).encode_np(mat)
+    return EncodedVectorBlock(fp, enc.data, enc.scales, f32_block.rows)
+
+
 class ValuesBlock:
     """One segment's live-row doc-values extraction for one field — the
     agg engine's per-segment column (f64 numeric view + presence, raw

@@ -40,9 +40,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from elasticsearch_tpu.columnar.blocks import (
+    EncodedVectorBlock,
     PostingsBlock,
     ValuesBlock,
     VectorBlock,
+    extract_encoded_vector_block,
     extract_postings_block,
     extract_values_block,
     extract_vector_block,
@@ -183,6 +185,69 @@ class SegmentBlockStore:
         return FieldRowsView(tuple(blocks), {
             "blocks": len(blocks), "cached": n_cached,
             "extracted": n_extracted, "mode": mode})
+
+    def encoded_block(self, view, field: str, encoding: str, metric: str
+                      ) -> Tuple[Optional[EncodedVectorBlock], bool]:
+        """The codec-encoded block of one (segment, field) at one
+        encoding variant — cached exactly like the f32 vector blocks
+        (per segment fingerprint, evicted with the segment), so only
+        delta segments re-encode on refresh and a dtype re-encode merge
+        re-reads already-encoded tails for free. Feeds off the cached
+        f32 block; returns (block | None, cached)."""
+        seg = view.segment
+        fp = fingerprint(view, (encoding, metric))
+        key = ("vector_enc", field, encoding, metric)
+        with self._lock:
+            entry = self._entries.get(weakref.ref(seg))
+            blk = entry.get(key) if entry is not None else None
+            if blk is not None and blk.fingerprint == fp:
+                self._count(field, "vector_enc", "hits")
+                return (None if isinstance(blk, _Absent) else blk), True
+        f32_block, _ = self.block(view, field, "vector")
+        t0 = time.perf_counter_ns()
+        blk = extract_encoded_vector_block(view, field, encoding, metric,
+                                           f32_block)
+        nanos = time.perf_counter_ns() - t0
+        with self._lock:
+            self._count(field, "vector_enc", "extracts")
+            self._counters["extract_nanos"] += nanos
+            self._fields.setdefault(
+                (field, "vector_enc"), _field_slot())["extract_nanos"] \
+                += nanos
+            ref = weakref.ref(seg, self._evicted)
+            self._entries.setdefault(ref, {})[key] = \
+                blk if blk is not None else _Absent(fp)
+        return blk, False
+
+    def encoded_rows(self, reader, field: str, encoding: str, metric: str
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, str]:
+        """Reader-wide codec-encoded rows for one vector field:
+        (data [n, W] packed, scales [n] f32, row_map [n] engine rows,
+        mode). Per-segment encoded blocks are delta-cached; rows encode
+        independently, so this concatenation is byte-identical to
+        encoding the whole matrix at once."""
+        from elasticsearch_tpu.quant import codec as quant_codec
+        blocks: List[EncodedVectorBlock] = []
+        n_cached = n_extracted = 0
+        for view in reader.views:
+            blk, cached = self.encoded_block(view, field, encoding, metric)
+            if cached:
+                n_cached += 1
+            else:
+                n_extracted += 1
+            if blk is None or blk.n_rows == 0:
+                continue
+            blocks.append(blk)
+        mode = self.note_composition(field, "vector_enc", n_cached,
+                                     n_extracted)
+        if not blocks:
+            codec = quant_codec.get(encoding)
+            return (np.zeros((0, 0), dtype=codec.packed_np_dtype),
+                    np.zeros(0, dtype=np.float32),
+                    np.zeros(0, dtype=np.int64), mode)
+        return (np.concatenate([b.data for b in blocks]),
+                np.concatenate([b.scales for b in blocks]),
+                np.concatenate([b.rows for b in blocks]), mode)
 
     def values_block(self, view, field: str, want_objs: bool
                      ) -> Tuple[ValuesBlock, bool]:

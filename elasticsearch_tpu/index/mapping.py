@@ -512,15 +512,21 @@ class DenseVectorFieldMapper(FieldMapper):
     """`dense_vector` (reference: DenseVectorFieldMapper.java:45).
 
     params: dims (required), similarity (cosine|dot_product|l2_norm,
-    default cosine), index_options.type (flat|int8_flat — storage dtype of
-    the device matrix; ivf|int8_ivf — per-field opt-in to the partitioned
-    `tpu_ivf` engine, overriding `index.knn.engine`), index_options.nlist /
-    nprobe (per-field IVF overrides of the index-level settings).
+    default cosine), index_options.type — the quantization-ladder rung
+    (flat|int8_flat|int4_flat|binary_flat: storage encoding of the
+    device matrix, see `elasticsearch_tpu/quant/codec.py`;
+    ivf|int8_ivf|int4_ivf|binary_ivf: same rung on the partitioned
+    `tpu_ivf` engine, overriding `index.knn.engine`),
+    index_options.nlist / nprobe (per-field IVF overrides),
+    index_options.rescore / rescore_oversample (two-phase exact rescore:
+    packed rungs default rescore on; oversample sizes the coarse
+    window — k·oversample candidates re-ranked exactly).
     """
 
     type_name = "dense_vector"
 
-    INDEX_OPTIONS_TYPES = ("flat", "int8_flat", "ivf", "int8_ivf")
+    INDEX_OPTIONS_TYPES = ("flat", "int8_flat", "int4_flat", "binary_flat",
+                           "ivf", "int8_ivf", "int4_ivf", "binary_ivf")
 
     def __init__(self, name, params=None):
         super().__init__(name, params)
@@ -538,7 +544,27 @@ class DenseVectorFieldMapper(FieldMapper):
                 f"[{name}] unknown index_options type [{otype}]; expected "
                 f"one of {list(self.INDEX_OPTIONS_TYPES)}")
         self.index_options_type = otype
-        for opt_key in ("nlist", "nprobe"):
+        # packed rungs constrain dims by their bit layout; reject at
+        # mapping time, not at first refresh
+        if otype in ("int4_flat", "int4_ivf") and self.dims % 2:
+            raise MapperParsingError(
+                f"[{name}] index_options type [{otype}] requires even "
+                f"[dims], got [{self.dims}]")
+        if otype in ("binary_flat", "binary_ivf"):
+            if self.dims % 32:
+                raise MapperParsingError(
+                    f"[{name}] index_options type [{otype}] requires "
+                    f"[dims] divisible by 32, got [{self.dims}]")
+            if self.similarity in ("l2_norm", "max_inner_product"):
+                # the sign-bit coarse phase discards magnitudes, which
+                # l2 and MIP rankings depend on — the true top-k would
+                # never enter the rescore window
+                raise MapperParsingError(
+                    f"[{name}] index_options type [{otype}] scores "
+                    "sign-bit Hamming — incompatible with "
+                    f"[{self.similarity}] similarity (use cosine or "
+                    "unit-normalized dot_product)")
+        for opt_key in ("nlist", "nprobe", "rescore_oversample"):
             v = opts.get(opt_key)
             if v is None or (opt_key == "nprobe" and v == "auto"):
                 continue  # "auto" is meaningful only for nprobe

@@ -2578,14 +2578,19 @@ class Node:
     def _knn_stats_section(self) -> dict:
         """Vector-search engine counters summed over local shards: total
         searches, how many took the pruned tpu_ivf path vs fell back to
-        exhaustive (or rode the SPMD mesh), cumulative per-phase device
-        time, and the per-(field, k) continuous-batching scheduler
-        counters (queue wait / topups / overlap — the 1cl/4cl closed-loop
-        tail attribution)."""
+        exhaustive (or rode the SPMD mesh), fused-probe dispatches and
+        two-phase rescore window stats (the quant subsystem's serving
+        counters), cumulative per-phase device time, the per-field
+        encoding/bytes-per-doc ladder breakdown, and the per-(field, k)
+        continuous-batching scheduler counters (queue wait / topups /
+        overlap — the 1cl/4cl closed-loop tail attribution)."""
         out = {"searches": 0, "ivf_searches": 0, "fallback_searches": 0,
-               "mesh_searches": 0,
+               "mesh_searches": 0, "fused_probe_searches": 0,
+               "rescore_searches": 0, "rescore_window_rows": 0,
+               "rescore_promoted": 0, "rescore_nanos": 0,
                "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
         sched: dict = {}
+        fields: dict = {}
         for svc in self.indices.indices.values():
             for shard in svc.shards:
                 stats = getattr(shard.vector_store, "knn_stats", None)
@@ -2597,7 +2602,21 @@ class Node:
                 if sched_fn is not None:
                     for key, val in sched_fn().items():
                         sched[key] = sched.get(key, 0) + val
+                fields_fn = getattr(shard.vector_store, "field_stats",
+                                    None)
+                if fields_fn is not None:
+                    for field, fs in fields_fn().items():
+                        slot = fields.get(field)
+                        if slot is None:
+                            fields[field] = dict(fs)
+                        else:
+                            # shards of one field share the encoding
+                            # plan; the size halves sum
+                            for key in ("rows", "device_bytes"):
+                                slot[key] = (slot.get(key, 0)
+                                             + fs.get(key, 0))
         out["scheduler"] = sched
+        out["fields"] = fields
         return out
 
     @staticmethod

@@ -249,14 +249,13 @@ class LexicalField:
             impacts = jnp.asarray(self.tile_impacts, dtype=jnp.bfloat16)
             scales = None
         elif self.dtype == "int8":
-            # per-tile symmetric scale (the ops/quantization scheme at
+            # per-tile symmetric scale (the quant codec's int8 recipe at
             # tile granularity: impacts within a tile share one term's
             # idf, so the dynamic range per tile is narrow)
-            amax = np.abs(self.tile_impacts).max(axis=1, keepdims=True)
-            scale = np.maximum(amax, 1e-30) / 127.0
-            q = np.clip(np.rint(self.tile_impacts / scale), -127, 127)
-            impacts = jnp.asarray(q.astype(np.int8))
-            scales = jnp.asarray(scale[:, 0].astype(np.float32))
+            from elasticsearch_tpu.quant import codec as quant_codec
+            enc = quant_codec.get("int8").encode_np(self.tile_impacts)
+            impacts = jnp.asarray(enc.data)
+            scales = jnp.asarray(enc.scales)
         else:
             impacts = jnp.asarray(self.tile_impacts)
             scales = None

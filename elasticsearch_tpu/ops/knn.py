@@ -32,6 +32,7 @@ from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.ops.quantization import quantize_int8_np
 from elasticsearch_tpu.ops.similarity import NEG_INF
+from elasticsearch_tpu.quant import codec as quant_codec
 
 LANE = 128  # TPU lane width; corpus rows are padded to a multiple of this.
 
@@ -99,7 +100,11 @@ def build_corpus(
     """
     vectors = np.asarray(vectors, dtype=np.float32)
     n, d = vectors.shape
-    n_pad = pad_to if pad_to is not None else pad_rows(max(n, 1), preferred_pad_multiple(n, metric))
+    # packed encodings never ride the binned Pallas path, so they keep
+    # minimal lane padding instead of its 8192-row tiles
+    pad_mult = (LANE if dtype in quant_codec.PACKED_ENCODINGS
+                else preferred_pad_multiple(n, metric))
+    n_pad = pad_to if pad_to is not None else pad_rows(max(n, 1), pad_mult)
     if n_pad < n:
         raise ValueError(f"pad_to {n_pad} < corpus size {n}")
 
@@ -115,7 +120,19 @@ def build_corpus(
                            dtype=jnp.float32)
 
     res = res_scales = None
-    if dtype == "int8":
+    if dtype in quant_codec.PACKED_ENCODINGS:
+        # packed ladder rungs (int4 nibbles / binary sign bits): encode
+        # through the codec registry — the one owner of the bit layout
+        # (the device kernels unpack with the matching codec helpers)
+        if dtype == "binary" and metric in (sim.L2_NORM,
+                                            sim.MAX_INNER_PRODUCT):
+            raise ValueError(
+                "binary encoding scores sign-bit Hamming — incompatible "
+                f"with magnitude-dependent {metric} similarity")
+        enc = quant_codec.get(dtype).encode_np(padded)
+        matrix = jnp.asarray(enc.data)
+        scales = jnp.asarray(enc.scales)
+    elif dtype == "int8":
         q8, scales_np = quantize_int8_np(padded)
         matrix = jnp.asarray(q8)
         scales = jnp.asarray(scales_np)
@@ -141,6 +158,56 @@ def build_corpus(
                   residual_scales=res_scales)
 
 
+def corpus_from_encoded(
+    data: np.ndarray,
+    scales: np.ndarray,
+    vectors: np.ndarray,
+    metric: str = sim.COSINE,
+    dtype: str = "int4",
+    pad_to: Optional[int] = None,
+) -> Corpus:
+    """Build a packed-encoding corpus from ALREADY-ENCODED rows (the
+    columnar store's per-segment encoded blocks, `columnar.encoded_rows`)
+    — refresh re-encodes only delta segments instead of the whole
+    matrix. `vectors` is the raw f32 matrix (for sq-norms); padding rows
+    take the codec's encode-of-zeros so the result is byte-identical to
+    `build_corpus(vectors, dtype=dtype)`.
+    """
+    codec = quant_codec.get(dtype)
+    vectors = np.asarray(vectors, dtype=np.float32)
+    n, d = vectors.shape
+    n_pad = pad_to if pad_to is not None else pad_rows(max(n, 1), LANE)
+    if n_pad < n:
+        raise ValueError(f"pad_to {n_pad} < corpus size {n}")
+    # sq-norms in row chunks: the rows themselves are ALREADY encoded,
+    # so this must not re-materialize a corpus-sized f32 temp (the whole
+    # point of the per-segment encoded blocks); cosine rows are
+    # normalized before encoding, so their post-normalization sq-norm is
+    # exactly 1 for any non-zero row
+    sq_np = np.zeros((n_pad,), dtype=np.float32)
+    chunk = max(1, (64 << 20) // max(d * 4, 1))
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        block_sq = np.einsum("nd,nd->n", vectors[lo:hi], vectors[lo:hi])
+        if metric == sim.COSINE:
+            sq_np[lo:hi] = (block_sq > 0).astype(np.float32)
+        else:
+            sq_np[lo:hi] = block_sq
+    sq_norms = jnp.asarray(sq_np)
+    w = codec.packed_width(d)
+    pad_enc = codec.encode_np(np.zeros((1, d), dtype=np.float32))
+    full_data = np.empty((n_pad, w), dtype=codec.packed_np_dtype)
+    full_scales = np.empty((n_pad,), dtype=np.float32)
+    full_data[:n] = data.reshape(n, w)
+    full_scales[:n] = scales
+    full_data[n:] = pad_enc.data[0]
+    full_scales[n:] = pad_enc.scales[0]
+    return Corpus(matrix=jnp.asarray(full_data),
+                  sq_norms=sq_norms,
+                  scales=jnp.asarray(full_scales),
+                  num_valid=jnp.int32(n))
+
+
 def _block_scores(queries, matrix, sq_norms, scales, metric: str, precision: str):
     """Raw similarity for one corpus block, handling int8 dequant-after-matmul.
 
@@ -155,6 +222,24 @@ def _block_scores(queries, matrix, sq_norms, scales, metric: str, precision: str
         if metric == sim.L2_NORM:
             return sim.l2_raw_from_dots(dots, queries, sq_norms)
         return dots
+    if matrix.dtype == jnp.uint8:
+        # int4 packed nibbles: two half-width matmuls on the (even, odd)
+        # level planes — no interleave materializes, the planes unpack
+        # in-register ahead of the MXU read
+        mm = jnp.float32 if precision == "f32" else jnp.bfloat16
+        lo, hi = quant_codec.int4_planes_jnp(matrix, mm)
+        q_even, q_odd = quant_codec.split_query_planes_jnp(queries)
+        dots = (sim._matmul(q_even, lo, precision)
+                + sim._matmul(q_odd, hi, precision)) * scales[None, :]
+        if metric == sim.L2_NORM:
+            return sim.l2_raw_from_dots(dots, queries, sq_norms)
+        return dots
+    if matrix.dtype == jnp.uint32:
+        # binary sign bits: XOR + popcount pseudo-dots ((D - 2·ham)/D —
+        # the 1-bit cosine estimate; two-phase rescore restores exact
+        # ordering). l2 is rejected at encode time.
+        qbits = quant_codec.pack_sign_bits_jnp(queries)
+        return quant_codec.hamming_pseudo_dots_jnp(qbits, matrix)
     return sim.similarity_scores(queries, matrix, sq_norms, metric=metric,
                                  precision=precision, normalize_queries=False)
 
@@ -174,6 +259,7 @@ def knn_search_auto(
     metric: str = sim.COSINE,
     filter_mask: Optional[jax.Array] = None,
     precision: str = "bf16",
+    rescore_candidates: int = 128,
 ):
     """Route to the fastest eligible kernel.
 
@@ -191,14 +277,19 @@ def knn_search_auto(
     n_pad = corpus.matrix.shape[0]
     if (filter_mask is None
             and metric in (sim.COSINE, sim.DOT_PRODUCT, sim.MAX_INNER_PRODUCT)
+            and corpus.matrix.dtype not in (jnp.uint8, jnp.uint32)
             and n_pad % binned.BLOCK_N == 0
             and k <= 64
             and precision == "bf16"):
         try:
             if dispatch.is_accelerator_backend():
                 if corpus.residual is not None:
+                    # `index_options.rescore_oversample` sizes this
+                    # window (store-threaded); the old fixed 128 is the
+                    # default-oversample value
                     return binned.binned_knn_search_rescored_packed(
-                        queries, corpus, k, metric=metric)
+                        queries, corpus, k, metric=metric,
+                        rescore_candidates=rescore_candidates)
                 return binned.binned_knn_search(queries, corpus, k, metric=metric)
         except Exception:
             pass

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import similarity as sim
 from elasticsearch_tpu.ops.similarity import NEG_INF
+from elasticsearch_tpu.quant import codec as quant_codec
 
 
 class IVFPartitions(NamedTuple):
@@ -113,16 +114,29 @@ def _score_probes_impl(queries: jax.Array, ivf: IVFPartitions,
     init = (jnp.full((nq, k), NEG_INF, dtype=jnp.float32),
             jnp.full((nq, k), -1, dtype=jnp.int32))
 
+    qbits = None
+    if ivf.parts.dtype == jnp.uint32:
+        qbits = quant_codec.pack_sign_bits_jnp(q)
+
     def body(carry, pid):
         best_s, best_i = carry
         # block take: whole [cap, D] tiles per query, no row gathers
         block = jnp.take(ivf.parts, pid, axis=0)        # [Q, cap, D]
         rows = jnp.take(ivf.part_rows, pid, axis=0)     # [Q, cap]
-        dots = jnp.einsum(
-            "qd,qcd->qc", q.astype(mm_dtype), block.astype(mm_dtype),
-            preferred_element_type=jnp.float32)
-        if ivf.parts.dtype == jnp.int8:
+        if ivf.parts.dtype == jnp.uint8:
+            # int4 packed nibbles: two half-width plane einsums, then
+            # per-row de-scale (the codec's one bit layout)
+            dots = quant_codec.int4_blocked_dots_jnp(q, block, mm_dtype)
             dots = dots * jnp.take(ivf.part_scales, pid, axis=0)
+        elif ivf.parts.dtype == jnp.uint32:
+            # binary sign bits: blocked XOR+popcount pseudo-dots
+            dots = quant_codec.hamming_pseudo_dots_blocked_jnp(qbits, block)
+        else:
+            dots = jnp.einsum(
+                "qd,qcd->qc", q.astype(mm_dtype), block.astype(mm_dtype),
+                preferred_element_type=jnp.float32)
+            if ivf.parts.dtype == jnp.int8:
+                dots = dots * jnp.take(ivf.part_scales, pid, axis=0)
         if metric == sim.L2_NORM:
             part_sq = jnp.take(ivf.part_sq, pid, axis=0)
             q_sq = jnp.sum(q * q, axis=-1, keepdims=True)

@@ -436,10 +436,10 @@ def _binned_packed(queries, corpus, metric, interpret):
     valid, tpat = _tile_patterns(n_pad, corpus.num_valid)
 
     if corpus.matrix.dtype == jnp.int8:
-        # symmetric per-query quantization; dequant inside the kernel
-        qmax = jnp.max(jnp.abs(q), axis=-1, keepdims=True)
-        qscale = jnp.maximum(qmax, 1e-30) / 127.0
-        q8 = jnp.clip(jnp.round(q / qscale), -127, 127).astype(jnp.int8)
+        # symmetric per-query quantization (the codec registry's one
+        # int8 recipe, in-trace twin); dequant inside the kernel
+        from elasticsearch_tpu.quant import codec as quant_codec
+        q8, qscale = quant_codec.quantize_queries_int8_jnp(q)
         row_scale_valid = (corpus.scales.reshape(1, n_pad) * valid)
         packed = pl.pallas_call(
             _int8_kernel,

@@ -1,60 +1,49 @@
-"""Scalar quantization for the HBM-resident vector matrix.
+"""Scalar quantization entries for the HBM-resident vector matrix.
 
-Plays the role of the reference's (absent) int8_hnsw scalar quantization
-(BASELINE config 4 — the reference stores only f32 BinaryDocValues,
-`DenseVectorFieldMapper.java:184-226`). On TPU the motivation is HBM:
-Cohere-Wiki-10M x 768 f32 is ~30.7 GB, over a single v5e core's 16 GB; int8
-per-row symmetric quantization cuts storage 4x. The matmul itself runs in
-bfloat16 (int8 rows are upcast on the fly — the kernel is HBM-bandwidth
-bound, so shrinking the bytes read dominates; the upcast fuses into the
-matmul read).
+Compatibility façade: the arithmetic moved into the vector codec
+subsystem (`elasticsearch_tpu/quant/codec.py`), the ONE owner of every
+encoding recipe on the ladder (f32 / bf16 / int8 / int4 / binary) —
+tpulint TPU013 enforces that hand-rolled quantize/dequantize arithmetic
+lives nowhere else. These names stay because every storage path (flat
+corpus, IVF partitions, sharded mesh layout) historically imported the
+int8 recipe from here; they now delegate to the registry so a policy
+change in the codec lands everywhere at once.
+
+On TPU the motivation is HBM: Cohere-Wiki-10M x 768 f32 is ~30.7 GB,
+over a single v5e core's 16 GB; int8 per-row symmetric quantization cuts
+storage 4x (int4 8x, binary 32x — see the codec ladder). The matmul
+itself runs in bfloat16 (int8 rows are upcast on the fly — the kernel is
+HBM-bandwidth bound, so shrinking the bytes read dominates; the upcast
+fuses into the matmul read).
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
+
+from elasticsearch_tpu.quant import codec as _codec
 
 
 def quantize_int8(matrix: jax.Array):
-    """Per-row symmetric int8 quantization.
+    """Per-row symmetric int8 quantization (device twin).
 
     Returns (q [N, D] int8, scales [N] f32) with row_i ≈ q_i * scales_i.
     """
-    matrix = matrix.astype(jnp.float32)
-    max_abs = jnp.max(jnp.abs(matrix), axis=-1)
-    scales = jnp.maximum(max_abs, 1e-30) / 127.0
-    q = jnp.clip(jnp.round(matrix / scales[:, None]), -127, 127).astype(jnp.int8)
-    return q, scales
+    return _codec.get("int8").encode_jnp(matrix)
 
 
-def dequantize_int8(q: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+def dequantize_int8(q: jax.Array, scales: jax.Array, dtype=None) -> jax.Array:
+    import jax.numpy as jnp
+    dtype = jnp.bfloat16 if dtype is None else dtype
     return q.astype(dtype) * scales[:, None].astype(dtype)
 
 
 def quantize_int8_np(matrix):
     """Host-side per-row symmetric int8 quantization (same policy as
-    `quantize_int8`: max-abs/127 scale with a 1e-30 floor).
-
-    The ONE owner of the quantization recipe for host build paths — both
-    levels of `knn.build_corpus` and `parallel.sharded_knn` route through
-    here so a policy change lands everywhere at once. Works in row chunks
-    so a 10M x 768 corpus never materializes a second full-size f32 temp.
+    `quantize_int8`: max-abs/127 scale with a 1e-30 floor), chunked so a
+    10M x 768 corpus never materializes a second full-size f32 temp.
 
     Returns (q8 [N, D] int8, scales [N] f32).
     """
-    import numpy as np
-
-    matrix = np.asarray(matrix, dtype=np.float32)
-    n = matrix.shape[0]
-    q8 = np.empty(matrix.shape, dtype=np.int8)
-    scales = np.empty((n,), dtype=np.float32)
-    chunk = max(1, (64 << 20) // max(matrix.shape[1] * 4, 1))
-    for lo in range(0, n, chunk):
-        hi = lo + chunk
-        block = matrix[lo:hi]
-        s = np.maximum(np.abs(block).max(axis=-1), 1e-30) / 127.0
-        scales[lo:hi] = s
-        q8[lo:hi] = np.clip(np.round(block / s[:, None]),
-                            -127, 127).astype(np.int8)
-    return q8, scales
+    enc = _codec.get("int8").encode_np(matrix)
+    return enc.data, enc.scales

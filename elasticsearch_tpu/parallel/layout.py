@@ -57,7 +57,12 @@ PARTITION_RULES: Tuple[Tuple[str, str], ...] = (
     (r"tile_", REPLICATED),           # lexical impact CSR (scan is
                                       # replicated; the board shards)
     (r"quer", DP_BATCH),              # query batches
-    (r".*", SHARD_ROWS),              # corpus rows + per-row metadata
+    # corpus rows + per-row metadata — including the packed
+    # quantization-ladder leaves (int4 nibble / binary sign-bit
+    # matrices) and their per-row aux scales: the codec packs WITHIN a
+    # row (`quant/codec.py`), so packed matrices shard over rows
+    # exactly like f32 ones and need no rule of their own
+    (r".*", SHARD_ROWS),
 )
 
 

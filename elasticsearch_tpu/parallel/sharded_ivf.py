@@ -78,6 +78,17 @@ def build_sharded_partitions(index, mesh: Mesh) -> ShardedIVF:
         scales_host = np.where(valid, scales.reshape(nlist, cap),
                                0.0).astype(np.float32)
         np_dtype = np.int8
+    elif index.dtype in ("int4", "binary"):
+        # packed ladder rungs: the codec registry's recipe, bitwise the
+        # single-device `device_partitions` copy
+        from elasticsearch_tpu.quant import codec as quant_codec
+        codec = quant_codec.get(index.dtype)
+        enc = codec.encode_np(index.part_vecs.reshape(-1, dims))
+        w = codec.packed_width(dims)
+        parts_host = enc.data.reshape(nlist, cap, w)
+        scales_host = np.where(valid, enc.scales.reshape(nlist, cap),
+                               0.0).astype(np.float32)
+        np_dtype = codec.packed_np_dtype
     else:
         import ml_dtypes
         np_dtype = (ml_dtypes.bfloat16 if index.dtype == "bf16"
@@ -130,6 +141,11 @@ def _ivf_step(q, cents, cent_sq, parts, pscales, psq, prows, *, k, nprobe,
     init = (jnp.full((nq, k), NEG_INF, dtype=jnp.float32),
             jnp.full((nq, k), -1, dtype=jnp.int32))
 
+    from elasticsearch_tpu.quant import codec as quant_codec
+    qbits = None
+    if parts.dtype == jnp.uint32:
+        qbits = quant_codec.pack_sign_bits_jnp(q)
+
     def body(carry, pid):
         best_s, best_i = carry
         local_pid = pid - lo
@@ -137,11 +153,19 @@ def _ivf_step(q, cents, cent_sq, parts, pscales, psq, prows, *, k, nprobe,
         safe = jnp.clip(local_pid, 0, nlist_local - 1)
         block = jnp.take(parts, safe, axis=0)          # [Q, cap, D]
         rows = jnp.take(prows, safe, axis=0)           # [Q, cap]
-        dots = jnp.einsum(
-            "qd,qcd->qc", q.astype(mm_dtype), block.astype(mm_dtype),
-            preferred_element_type=jnp.float32)
-        if parts.dtype == jnp.int8:
+        if parts.dtype == jnp.uint8:
+            # int4 packed nibbles (the codec's one blocked-take recipe)
+            dots = quant_codec.int4_blocked_dots_jnp(q, block, mm_dtype)
             dots = dots * jnp.take(pscales, safe, axis=0)
+        elif parts.dtype == jnp.uint32:
+            dots = quant_codec.hamming_pseudo_dots_blocked_jnp(qbits,
+                                                               block)
+        else:
+            dots = jnp.einsum(
+                "qd,qcd->qc", q.astype(mm_dtype), block.astype(mm_dtype),
+                preferred_element_type=jnp.float32)
+            if parts.dtype == jnp.int8:
+                dots = dots * jnp.take(pscales, safe, axis=0)
         if metric == sim.L2_NORM:
             part_sq_b = jnp.take(psq, safe, axis=0)
             q_sq = jnp.sum(q * q, axis=-1, keepdims=True)
@@ -232,12 +256,17 @@ def warmup_entries(index, mesh: Mesh, nprobe: int):
     S = mesh.shape[mesh_lib.SHARD_AXIS]
     nlist, cap, dims = index.part_vecs.shape
     nlist_pad = -(-nlist // S) * S
-    part_dtype = {"int8": jnp.int8, "bf16": jnp.bfloat16}.get(
+    part_dtype = {"int8": jnp.int8, "bf16": jnp.bfloat16,
+                  "int4": jnp.uint8, "binary": jnp.uint32}.get(
         index.dtype, jnp.float32)
+    part_w = dims
+    if index.dtype in ("int4", "binary"):
+        from elasticsearch_tpu.quant import codec as quant_codec
+        part_w = quant_codec.get(index.dtype).packed_width(dims)
     host_like = ShardedIVF(
         jax.ShapeDtypeStruct((nlist, dims), jnp.float32),
         jax.ShapeDtypeStruct((nlist,), jnp.float32),
-        jax.ShapeDtypeStruct((nlist_pad, cap, dims), part_dtype),
+        jax.ShapeDtypeStruct((nlist_pad, cap, part_w), part_dtype),
         jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32),
         jax.ShapeDtypeStruct((nlist_pad, cap), jnp.float32),
         jax.ShapeDtypeStruct((nlist_pad, cap), jnp.int32))

@@ -130,8 +130,7 @@ def build_sharded_corpus(
     # a jnp.concatenate here would materialize the full matrix on a single
     # device before resharding, OOMing exactly at the corpus scale sharding
     # exists for (30.7 GB corpus vs 16 GB/core HBM).
-    np_dtype = {"f32": np.float32, "bf16": np.float32, "int8": np.float32}[dtype]
-    matrix_host = np.zeros((n_shards * per, d), dtype=np_dtype)
+    matrix_host = np.zeros((n_shards * per, d), dtype=np.float32)
     sq_host = np.zeros(n_shards * per, dtype=np.float32)
     num_valid = np.zeros(n_shards, dtype=np.int32)
     for s in range(n_shards):
@@ -147,6 +146,13 @@ def build_sharded_corpus(
     if dtype == "int8":
         from elasticsearch_tpu.ops.quantization import quantize_int8_np
         matrix_host, scales_host = quantize_int8_np(matrix_host)
+    elif dtype in ("int4", "binary"):
+        # packed ladder rungs shard exactly like f32 rows: the codec
+        # packs per row, so the [S·per, W] matrix and its per-row aux
+        # scales both ride the `shard_rows` layout rule unchanged
+        from elasticsearch_tpu.quant import codec as quant_codec
+        enc = quant_codec.get(dtype).encode_np(matrix_host)
+        matrix_host, scales_host = enc.data, enc.scales
     else:
         if dtype == "bf16":
             import ml_dtypes
@@ -432,6 +438,10 @@ class ShardedFieldState:
             from elasticsearch_tpu.ops.quantization import quantize_int8_np
             q8, sc = quantize_int8_np(blocks)
             blocks, new_scales = q8, sc
+        elif self.dtype in ("int4", "binary"):
+            from elasticsearch_tpu.quant import codec as quant_codec
+            enc = quant_codec.get(self.dtype).encode_np(blocks)
+            blocks, new_scales = enc.data, enc.scales
         elif self.dtype == "bf16":
             import ml_dtypes
             blocks = blocks.astype(ml_dtypes.bfloat16)

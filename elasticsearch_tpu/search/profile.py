@@ -174,7 +174,12 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
                            # columnar segment-block-store ledger: the
                            # field's last refresh composition (cached /
                            # delta / full extraction counts)
-                           "columnar")
+                           "columnar",
+                           # quant-subsystem legs: did the IVF probes
+                           # run the fused Pallas gather+score kernel,
+                           # and the two-phase exact-rescore window
+                           # (size / promotions / nanos)
+                           "fused_probe", "rescore")
                if key in knn_phases},
             "breakdown": {
                 key: knn_phases[key]

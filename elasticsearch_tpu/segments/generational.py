@@ -95,6 +95,26 @@ class GenerationSet:
             [g.row_map[g.live_mask()] for g in self.generations]) \
             if self.generations else self.row_map
 
+    def gather_rows(self, flat_ids: np.ndarray) -> np.ndarray:
+        """Exact f32 host rows for ASCENDING UNIQUE flat row ids,
+        resolved per generation through the shared block store
+        (`Generation.source`) — the two-phase rescore's candidate
+        gather (`quant/rescore.py`). O(window) rows materialize."""
+        flat_ids = np.asarray(flat_ids, dtype=np.int64)
+        if len(flat_ids) == 0 or not self.generations:
+            d = (self.generations[0].source.dims
+                 if self.generations else 0)
+            return np.zeros((0, d), dtype=np.float32)
+        out = np.zeros((len(flat_ids), self.generations[0].source.dims),
+                       dtype=np.float32)
+        for gen, off in zip(self.generations, self.offsets[:-1]):
+            lo = int(off)
+            hi = lo + gen.n_rows
+            sel = (flat_ids >= lo) & (flat_ids < hi)
+            if sel.any():
+                out[sel] = gen.source.gather(flat_ids[sel] - lo)
+        return out
+
     # ------------------------------------------------------------ search
     def search_async(self, queries: np.ndarray, n_real: int, k_eff: int,
                      filters: Sequence[Optional[np.ndarray]],
@@ -338,7 +358,8 @@ class GenerationalCorpus:
         self.stats = {
             "seals": 0, "sealed_rows": 0, "merges": 0, "merge_nanos": 0,
             "merged_rows": 0, "aborted_merges": 0, "tombstone_deletes": 0,
-            "ivf_background_builds": 0, "mesh_graduations": 0}
+            "ivf_background_builds": 0, "mesh_graduations": 0,
+            "dtype_retargets": 0, "dtype_reencodes": 0}
 
     # ------------------------------------------------------------ set-up
     @classmethod
@@ -379,15 +400,30 @@ class GenerationalCorpus:
         ever materialize (a pure append touches the tail blocks alone,
         which the store extracted delta-only too); the host
         classification is one isin pass over the row maps."""
+        retargeted = False
         with self._lock:
             cur = self._set
             if not cur.generations:
                 self.last_rebuild_reason = "first_build"
                 return None
-            if (dtype != self.dtype or metric != self.metric
-                    or bool(rescore) != self.rescore):
-                self.last_rebuild_reason = "dtype_change"
+            if metric != self.metric:
+                # a metric change re-prepares every row (cosine
+                # normalization happens at encode time) — only a
+                # rebuild is sound
+                self.last_rebuild_reason = "metric_change"
                 return None
+            if dtype != self.dtype or bool(rescore) != self.rescore:
+                # dtype change done on the MERGE thread: future seals
+                # encode at the new target immediately; the resident
+                # generations keep serving their old encoding until the
+                # background merger re-encodes them
+                # (`_select` → "dtype_reencode" merges) — the refresh
+                # and serving paths never pay a full rebuild for a
+                # mapping update
+                self.dtype = dtype
+                self.rescore = bool(rescore)
+                self.stats["dtype_retargets"] += 1
+                retargeted = True
             old_rows = cur.row_map
             old_live = cur.live_row_map()
             new = np.asarray(row_map, dtype=np.int64)
@@ -453,12 +489,35 @@ class GenerationalCorpus:
             self.warmup_cb(sealed.warmup_entries(self.dims, self.metric))
         self.notify()
         if sealed is not None and deleted_any:
-            return "append+delete"
-        if sealed is not None:
-            return "append"
-        return "delete" if deleted_any else "noop"
+            outcome = "append+delete"
+        elif sealed is not None:
+            outcome = "append"
+        elif deleted_any:
+            outcome = "delete"
+        else:
+            outcome = "noop"
+        if retargeted:
+            # the retarget IS a full rebuild avoided, even on an
+            # otherwise-noop refresh (the legacy path would have
+            # re-encoded the whole corpus on this thread)
+            outcome = ("retarget" if outcome == "noop"
+                       else outcome + "+retarget")
+        return outcome
 
     # ------------------------------------------------------------ merges
+    def _gen_encoding_stale(self, gen: Generation) -> bool:
+        """Does this generation still serve a superseded encoding after
+        a dtype retarget? (matrix dtype off the target rung, or an int8
+        residual level present/absent against the rescore flag)."""
+        from elasticsearch_tpu.quant import codec as quant_codec
+        if gen.corpus is None:
+            return False
+        if quant_codec.encoding_of(gen.corpus.matrix.dtype) != self.dtype:
+            return True
+        if self.dtype == "int8":
+            return bool(gen.corpus.residual is not None) != self.rescore
+        return False
+
     def _select(self, gens: Sequence[Generation]) -> Optional[MergeSpec]:
         spec = self.policy.select(gens)
         if spec is not None:
@@ -472,6 +531,15 @@ class GenerationalCorpus:
                 and gens[0].live_rows
                 >= int(self.knn_params.get("min_rows", 512))):
             return MergeSpec(0, 1, "tombstone_gc")
+        # dtype retarget: re-encode superseded generations one at a
+        # time on THIS thread — `_build_merged` gathers live rows
+        # through the shared block store and seals at the CURRENT
+        # target, so a mapping's int8→int4 never full-rebuilds on the
+        # refresh or serving path (`segment_counters` dtype_change
+        # stays 0)
+        for i, g in enumerate(gens):
+            if self._gen_encoding_stale(g):
+                return MergeSpec(i, i + 1, "dtype_reencode")
         return None
 
     def merge_pending(self) -> bool:
@@ -572,6 +640,8 @@ class GenerationalCorpus:
             if ok:
                 self.stats["merges"] += 1
                 self.stats["merged_rows"] += merged.n_rows
+                if spec.reason == "dtype_reencode":
+                    self.stats["dtype_reencodes"] += 1
             else:
                 self.stats["aborted_merges"] += 1
             self.stats["merge_nanos"] += nanos
@@ -668,7 +738,8 @@ class GenerationalCorpus:
         from elasticsearch_tpu.vectors.host_corpus import (
             HostFieldCorpus, packed_nbytes)
         max_bytes = int(self.knn_params.get("host_mirror_max_bytes", 0))
-        if (not native.AVAILABLE or self.dtype == "int8"
+        if (not native.AVAILABLE
+                or self.dtype in ("int8", "int4", "binary")
                 or merged.n_rows == 0
                 or packed_nbytes(merged.n_rows, self.dims) > max_bytes):
             return None

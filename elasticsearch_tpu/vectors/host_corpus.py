@@ -55,9 +55,11 @@ class HostFieldCorpus:
         self.ng = (n + 15) // 16
         self.sq_norms = (vectors * vectors).sum(axis=-1).astype(np.float32)
 
-        scales = np.abs(vectors).max(axis=-1) / 127.0
-        scales[scales == 0.0] = 1.0
-        q = np.clip(np.rint(vectors / scales[:, None]), -127, 127)
+        # the codec registry's one int8 recipe (max-abs/127 scale,
+        # 1e-30 floor — an all-zero row round-trips to zeros either way)
+        from elasticsearch_tpu.quant import codec as quant_codec
+        enc = quant_codec.get("int8").encode_np(vectors)
+        q, scales = enc.data, enc.scales
         # u8 with +128 offset: the corpus sits in vpdpbusd's unsigned operand
         rows_u8 = (q.astype(np.int16) + 128).astype(np.uint8)
         padded = np.full((self.ng * 16, self.d4 * 4), 128, dtype=np.uint8)

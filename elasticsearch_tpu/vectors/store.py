@@ -33,6 +33,7 @@ from elasticsearch_tpu.index.segment import ShardReader
 from elasticsearch_tpu.ops import dispatch
 from elasticsearch_tpu.ops import knn as knn_ops
 from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.quant import rescore as quant_rescore
 from elasticsearch_tpu.serving.batcher import CombiningBatcher, CostModel
 from elasticsearch_tpu.telemetry import metrics as _telemetry_metrics
 from elasticsearch_tpu.vectors.host_corpus import HostFieldCorpus, packed_nbytes
@@ -83,11 +84,14 @@ class FieldCorpus:
     """Device corpus for one vector field + host-side row maps."""
 
     __slots__ = ("corpus", "row_map", "metric", "dims", "version", "host",
-                 "router", "mesh_state", "gens")
+                 "router", "mesh_state", "gens", "encoding", "rescore",
+                 "rescore_oversample", "rescore_candidates", "source")
 
     def __init__(self, corpus, row_map: np.ndarray, metric: str, dims: int,
                  version: tuple, host=None, router=None, mesh_state=None,
-                 gens=None):
+                 gens=None, encoding: str = "bf16", rescore: bool = False,
+                 rescore_oversample: int = 4,
+                 rescore_candidates: int = 128, source=None):
         self.corpus = corpus          # knn_ops.Corpus (device pytree)
         self.row_map = row_map        # device row -> engine global row
         self.metric = metric
@@ -104,6 +108,16 @@ class FieldCorpus:
         # serving path re-snapshots per dispatch, so a merge installing
         # mid-flight never invalidates an in-progress search.
         self.gens = gens
+        # quantization-ladder state (`elasticsearch_tpu/quant/`): the
+        # TARGET storage encoding, whether packed serving runs two-phase
+        # (coarse packed top-(k·oversample) + exact f32 rescore of the
+        # window), the rescore window sizes, and the columnar RowSource
+        # the rescore gathers exact rows through
+        self.encoding = encoding
+        self.rescore = rescore
+        self.rescore_oversample = rescore_oversample
+        self.rescore_candidates = rescore_candidates
+        self.source = source
 
 
 def _pad_batch(queries: np.ndarray, n_real: int) -> np.ndarray:
@@ -135,18 +149,31 @@ def extract_field_rows(reader: ShardReader, field: str
     return view.matrix(), view.row_map
 
 
-_DTYPE_BYTES = {"bf16": 2, "f32": 4, "int8": 1,
-                "bfloat16": 2, "float32": 4}
+# index_options.type -> storage encoding (the quant codec ladder); the
+# engine half of the mapping lives in `_field_engine`
+_OPTION_TYPE_ENCODING = {
+    "flat": None, "ivf": None,
+    "int8_flat": "int8", "int8_ivf": "int8",
+    "int4_flat": "int4", "int4_ivf": "int4",
+    "binary_flat": "binary", "binary_ivf": "binary",
+}
+
+_DTYPE_ALIASES = {"bfloat16": "bf16", "float32": "f32"}
 
 
 def device_corpus_nbytes(n_rows: int, dims: int, dtype: str) -> int:
-    """Estimated resident device bytes of one field's corpus (matrix +
-    f32 norms + int8 scales) — the per-field accounting the mesh
-    policy's dp-aware HBM budget reads (`parallel/policy.eligible`)."""
-    per = _DTYPE_BYTES.get(dtype, 4)
+    """Estimated resident device bytes of one field's corpus (packed
+    matrix + f32 norms + per-row aux scales, `quant/codec.bytes_per_doc`)
+    — the per-field accounting the mesh policy's dp-aware HBM budget
+    reads (`parallel/policy.eligible`) and `_nodes/stats indices.knn`
+    reports as `bytes_per_doc`."""
+    from elasticsearch_tpu.quant import codec as quant_codec
     n = max(int(n_rows), 0)
-    scales = 4 * n if dtype == "int8" else 0
-    return n * int(dims) * per + 4 * n + scales
+    name = _DTYPE_ALIASES.get(dtype, dtype)
+    try:
+        return n * quant_codec.bytes_per_doc(name, int(dims))
+    except (KeyError, ValueError):
+        return n * (int(dims) * 4 + 4)
 
 
 class VectorStoreShard:
@@ -211,6 +238,9 @@ class VectorStoreShard:
         # annotation `profile.knn` attaches so the O(delta) refresh
         # claim is inspectable per search
         self.columnar_refresh: Dict[str, dict] = {}
+        # per-field quantization-ladder plan (`_encoding_plan`): target
+        # encoding + two-phase rescore windows, refreshed every sync
+        self._field_plans: Dict[str, dict] = {}
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -228,7 +258,9 @@ class VectorStoreShard:
         # per-phase serving telemetry (profile "knn" section, _nodes/stats)
         self.knn_stats: Dict[str, int] = {
             "searches": 0, "ivf_searches": 0, "fallback_searches": 0,
-            "mesh_searches": 0,
+            "mesh_searches": 0, "fused_probe_searches": 0,
+            "rescore_searches": 0, "rescore_window_rows": 0,
+            "rescore_promoted": 0, "rescore_nanos": 0,
             "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
         self.last_knn_phases: dict = {}
 
@@ -236,11 +268,47 @@ class VectorStoreShard:
         """Effective engine for one field: explicit index_options beat the
         index-level `index.knn.engine` setting."""
         otype = (mapper.params.get("index_options") or {}).get("type")
-        if otype in ("ivf", "int8_ivf"):
+        if otype is not None and otype.endswith("ivf"):
             return "tpu_ivf"
-        if otype in ("flat", "int8_flat"):
+        if otype is not None and otype.endswith("flat"):
             return "tpu"
         return self.knn_engine
+
+    def _encoding_plan(self, field: str, mapper: DenseVectorFieldMapper
+                       ) -> dict:
+        """Resolve one field's quantization-ladder plan from its
+        index_options: storage encoding, two-phase rescore enablement,
+        and the rescore window sizes. Unknown `type` values raise a
+        mapper error HERE too (defense in depth — the mapper validates
+        at parse time, but a store fed a hand-built mapper must not
+        silently fall back to f32 flat)."""
+        from elasticsearch_tpu.common.errors import MapperParsingError
+        from elasticsearch_tpu.quant import rescore as quant_rescore
+        opts = mapper.params.get("index_options") or {}
+        otype = opts.get("type")
+        if otype is not None and otype not in _OPTION_TYPE_ENCODING:
+            raise MapperParsingError(
+                f"[{field}] unknown index_options type [{otype}]; "
+                f"expected one of {sorted(_OPTION_TYPE_ENCODING)}")
+        encoding = _OPTION_TYPE_ENCODING.get(otype) or self.dtype
+        packed = encoding in ("int4", "binary")
+        # packed rungs serve two-phase by default — the recall contract
+        # (recall@10 >= 0.95 vs exact f32) is the window's, not the
+        # coarse encoding's; int8 `rescore` keeps the device residual
+        # path
+        rescore = bool(opts.get("rescore", packed))
+        oversample = int(opts.get(
+            "rescore_oversample",
+            quant_rescore.DEFAULT_OVERSAMPLE.get(encoding, 4)))
+        return {
+            "encoding": encoding,
+            "rescore": rescore,
+            "rescore_oversample": max(oversample, 1),
+            # the int8 residual path's device window (the old fixed 128
+            # == default oversample 4 x 32), now `rescore_oversample`-
+            # driven — the `"rescore": true` small fix
+            "rescore_candidates": max(oversample, 1) * 32,
+        }
 
     @staticmethod
     def _fingerprint(reader: ShardReader, field: str) -> tuple:
@@ -266,7 +334,13 @@ class VectorStoreShard:
         for field, mapper in vector_mappers.items():
             version = self._fingerprint(reader, field)
             cached = self._fields.get(field)
-            if cached is not None and cached.version == version:
+            plan = self._encoding_plan(field, mapper)
+            # a mapping update (dtype rung, rescore window) must re-sync
+            # even when the reader fingerprint is unchanged — the
+            # generational path absorbs it as a merge-thread re-encode
+            # retarget, never a serving-path rebuild
+            if (cached is not None and cached.version == version
+                    and self._field_plans.get(field) == plan):
                 continue
             # block-store read: per-segment extraction is delta-only by
             # construction; nothing corpus-sized materializes unless a
@@ -275,23 +349,28 @@ class VectorStoreShard:
             row_map = view.row_map
             self.columnar_refresh[field] = view.refresh
             metric = _METRIC_MAP[mapper.similarity]
+            # recorded BEFORE the empty-field continue too: the
+            # plan-equality short-circuit above must fire for empty
+            # fields on the next refresh, not re-sync them forever
+            self._field_plans[field] = plan
             if len(row_map) == 0:
                 self._fields[field] = FieldCorpus(None, np.zeros(0, dtype=np.int64),
                                                   metric, mapper.dims, version)
                 self._gens.pop(field, None)
                 continue
-            dtype = self.dtype
+            dtype = plan["encoding"]
             opts = mapper.params.get("index_options", {})
-            if opts.get("type") in ("int8_flat", "int8_ivf"):
-                dtype = "int8"
-            rescore = bool(opts.get("rescore", False))
+            # the residual level is the int8 rung's device-side rescore
+            # store; packed rungs rescore host-side through the columnar
+            # RowSource instead, so their corpus never carries one
+            residual = plan["rescore"] and dtype == "int8"
             gc = self._gens.get(field) if self.segments_enabled else None
             if gc is not None:
                 if cached is None or self._reader_prefix_ok(
                         cached.version, version):
                     outcome = gc.try_incremental(
                         view, row_map, dtype=dtype, metric=metric,
-                        rescore=rescore)
+                        rescore=residual)
                 else:
                     # the engine rewrote segments (merge): row ids were
                     # re-based, so identical ids no longer name
@@ -303,7 +382,7 @@ class VectorStoreShard:
                         self.segment_counters["rebuilds_avoided"] += 1
                     with self._views_lock:
                         self._fields[field] = self._generational_view(
-                            gc, metric, mapper.dims, version)
+                            gc, metric, mapper.dims, version, plan=plan)
                     with self._batchers_lock:
                         for key in [k for k in self._batchers
                                     if k[0] == field]:
@@ -316,20 +395,31 @@ class VectorStoreShard:
             # the whole matrix (block concatenation — extraction itself
             # was still delta-cached above)
             full = view.matrix()
-            # `"rescore": true` in index_options additionally keeps the
+            # `"rescore": true` on the int8 rung additionally keeps the
             # residual rescore level — the analog of Lucene retaining raw
             # f32 vectors beside the quantized copy (reference
             # DenseVectorFieldMapper int8 path), at 2 B/dim total instead
             # of 5. Off by default: int8_flat deployments size HBM against
             # 1 B/dim, and the main scan never reads the residual.
-            corpus = knn_ops.build_corpus(
-                full, metric=metric, dtype=dtype,
-                residual=bool(opts.get("rescore", False)))
+            if dtype in ("int4", "binary"):
+                # packed rungs assemble from the columnar store's
+                # per-segment ENCODED blocks (cached per fingerprint
+                # like the f32 rows — only delta segments re-encode);
+                # byte-identical to encoding `full` monolithically
+                data, enc_scales, enc_rows, _mode = \
+                    columnar.STORE.encoded_rows(reader, field, dtype,
+                                                mapper.similarity)
+                corpus = knn_ops.corpus_from_encoded(
+                    data, enc_scales, full, metric=metric, dtype=dtype)
+            else:
+                corpus = knn_ops.build_corpus(
+                    full, metric=metric, dtype=dtype, residual=residual)
             host = None
-            # int8_flat fields score int8 on the device; a bf16-rescored host
-            # mirror would make result quality depend on routing — skip it so
-            # the route stays invisible to callers
-            if (native.AVAILABLE and dtype != "int8"
+            # quantized fields score their packed encoding on the device;
+            # a bf16-rescored host mirror would make result quality depend
+            # on routing — skip it so the route stays invisible to callers
+            if (native.AVAILABLE
+                    and dtype not in ("int8", "int4", "binary")
                     and packed_nbytes(len(row_map), mapper.dims)
                     <= self.host_mirror_max_bytes):
                 host = HostFieldCorpus(full, metric)
@@ -413,7 +503,7 @@ class VectorStoreShard:
                     GenerationalCorpus, TieredMergePolicy)
                 gens = GenerationalCorpus.from_monolithic(
                     corpus, row_map, view.as_source(), metric, dtype,
-                    rescore, mapper.dims, host=host, router=router,
+                    residual, mapper.dims, host=host, router=router,
                     mesh_state=mesh_state,
                     policy=TieredMergePolicy(self.segments_tier_size,
                                              self.segments_max_l0),
@@ -433,11 +523,13 @@ class VectorStoreShard:
             with self._views_lock:
                 if gens is not None:
                     self._gens[field] = gens
-                self._fields[field] = FieldCorpus(corpus, row_map, metric,
-                                                  mapper.dims, version,
-                                                  host=host, router=router,
-                                                  mesh_state=mesh_state,
-                                                  gens=gens)
+                self._fields[field] = FieldCorpus(
+                    corpus, row_map, metric, mapper.dims, version,
+                    host=host, router=router, mesh_state=mesh_state,
+                    gens=gens, encoding=dtype, rescore=plan["rescore"],
+                    rescore_oversample=plan["rescore_oversample"],
+                    rescore_candidates=plan["rescore_candidates"],
+                    source=view.as_source())
             with self._batchers_lock:
                 for key in [k for k in self._batchers if k[0] == field]:
                     self._retire_sched(self._batchers.pop(key))
@@ -466,8 +558,8 @@ class VectorStoreShard:
         if cached is None or cached.corpus is None \
                 or len(cached.row_map) == 0:
             return None
-        want = {"bf16": "bfloat16", "f32": "float32",
-                "int8": "int8"}.get(dtype, dtype)
+        from elasticsearch_tpu.quant import codec as quant_codec
+        want = quant_codec.MATRIX_DTYPES.get(dtype, dtype)
         if str(cached.corpus.matrix.dtype) != want:
             return "dtype_change"
         old = cached.row_map
@@ -488,17 +580,27 @@ class VectorStoreShard:
             dispatch.DISPATCH.warmup(entries, background=True)
 
     def _generational_view(self, gc, metric: str, dims: int,
-                           version: tuple) -> FieldCorpus:
+                           version: tuple,
+                           plan: Optional[dict] = None) -> FieldCorpus:
         """FieldCorpus snapshot-view over the current generation set:
         base fields for the single-generation fast path, the FLAT row
         map (concatenated generation row maps — tombstoned slots stay,
         masked at search) for the fan-out path."""
         snap = gc.snapshot()
         base = snap.generations[0]
-        return FieldCorpus(base.corpus, snap.row_map, metric, dims,
-                           version, host=base.host if snap.simple else None,
-                           router=base.router,
-                           mesh_state=base.mesh_state, gens=gc)
+        plan = plan or {}
+        from elasticsearch_tpu.quant import rescore as quant_rescore
+        enc = plan.get("encoding", gc.dtype)
+        return FieldCorpus(
+            base.corpus, snap.row_map, metric, dims, version,
+            host=base.host if snap.simple else None,
+            router=base.router, mesh_state=base.mesh_state, gens=gc,
+            encoding=enc,
+            rescore=plan.get("rescore", enc in ("int4", "binary")),
+            rescore_oversample=plan.get(
+                "rescore_oversample",
+                quant_rescore.DEFAULT_OVERSAMPLE.get(enc, 4)),
+            rescore_candidates=plan.get("rescore_candidates", 128))
 
     def _reinstall_view(self, field: str, gc) -> None:
         """Refresh the installed view after a background merge installs
@@ -515,7 +617,8 @@ class VectorStoreShard:
             if fc is None or fc.gens is not gc:
                 return
             self._fields[field] = self._generational_view(
-                gc, fc.metric, fc.dims, fc.version)
+                gc, fc.metric, fc.dims, fc.version,
+                plan=self._field_plans.get(field))
         with self._batchers_lock:
             for key in [k for k in self._batchers if k[0] == field]:
                 self._retire_sched(self._batchers.pop(key))
@@ -564,14 +667,21 @@ class VectorStoreShard:
         from elasticsearch_tpu.ops import pallas_knn_binned as binned
         corpus_spec = dispatch.specs_like(fc.corpus)
         n_pad = fc.corpus.matrix.shape[0]
+        packed = str(fc.corpus.matrix.dtype) in ("uint8", "uint32")
         binned_ok = (fc.metric in (sim.COSINE, sim.DOT_PRODUCT,
                                    sim.MAX_INNER_PRODUCT)
+                     and not packed
                      and n_pad % binned.BLOCK_N == 0
                      and not binned.default_interpret())
         entries = []
         for q in dispatch.WARMUP_QUERY_BUCKETS:
             qspec = dispatch.query_spec(q, fc.dims)
             for k in dispatch.WARMUP_K_BUCKETS:
+                if packed and fc.rescore:
+                    # two-phase fields dispatch the WIDENED coarse k —
+                    # warm the programs serving traffic actually runs
+                    k = quant_rescore.coarse_window(
+                        min(k, n_pad), fc.rescore_oversample, limit=n_pad)
                 k_b = dispatch.bucket_k(min(k, n_pad), limit=n_pad)
                 if binned_ok and k_b <= 64:
                     if fc.corpus.residual is not None:
@@ -579,7 +689,7 @@ class VectorStoreShard:
                             "knn.binned_rescored_packed",
                             (qspec, corpus_spec),
                             {"k": k_b, "metric": fc.metric,
-                             "rescore_candidates": 128,
+                             "rescore_candidates": fc.rescore_candidates,
                              "interpret": False}))
                     else:
                         entries.append((
@@ -609,6 +719,21 @@ class VectorStoreShard:
                     else None)
             nprobe_known = (fc.router.nprobe_setting != "auto"
                             or fc.router._tuned_nprobe is not None)
+            from elasticsearch_tpu.ops import pallas_ivf_fused as ivf_fused
+            from elasticsearch_tpu.quant import codec as quant_codec
+            if (idx.total > 0 and nprobe_known
+                    and ivf_fused.fused_eligible(
+                        quant_codec.MATRIX_DTYPES.get(idx.dtype,
+                                                      "float32"),
+                        fc.metric)
+                    and ivf_fused.fused_preferred()):
+                # pre-compile the fused gather+score grid the router
+                # will dispatch (single-device probes) — shape-only,
+                # so sync never pays the partition-layout upload here
+                entries.extend(ivf_fused.warmup_entries_for_index(
+                    idx, fc.router.effective_nprobe(10),
+                    dispatch.WARMUP_K_BUCKETS,
+                    dispatch.WARMUP_QUERY_BUCKETS, metric=fc.metric))
             if mesh is not None and idx.total > 0 and nprobe_known:
                 # shape-only: the specs derive from the host layout, so
                 # refresh never pays the sharded posting-list upload
@@ -677,6 +802,35 @@ class VectorStoreShard:
         for b in batchers:
             for key, val in b.sched.items():
                 out[key] = out.get(key, 0) + val
+        return out
+
+    def field_stats(self) -> Dict[str, dict]:
+        """Per-field quantization-ladder stats for `_nodes/stats
+        indices.knn.fields`: the serving encoding, device bytes/doc
+        (packed row + aux + norms, `quant/codec.bytes_per_doc`), row
+        count, and the two-phase rescore window."""
+        from elasticsearch_tpu.quant import codec as quant_codec
+        out: Dict[str, dict] = {}
+        for field, fc in list(self._fields.items()):
+            if fc.corpus is None:
+                continue
+            enc = quant_codec.encoding_of(fc.corpus.matrix.dtype)
+            try:
+                bpd = quant_codec.bytes_per_doc(enc, fc.dims)
+            except (KeyError, ValueError):
+                bpd = fc.dims * 4 + 4
+            plan = self._field_plans.get(field, {})
+            out[field] = {
+                "encoding": enc,
+                "target_encoding": plan.get("encoding", enc),
+                "bytes_per_doc": bpd,
+                "rows": len(fc.row_map),
+                "device_bytes": device_corpus_nbytes(
+                    len(fc.row_map), fc.dims, enc),
+                "rescore": bool(fc.rescore),
+                "rescore_oversample": (fc.rescore_oversample
+                                       if fc.rescore else 0),
+            }
         return out
 
     def search(self, field: str, query_vector: np.ndarray, k: int,
@@ -780,9 +934,15 @@ class VectorStoreShard:
         try:
             if kind == "mesh":
                 return self._finalize_mesh(payload)
-            fc, s, i, k_eff, n_valid, n_real = payload
+            fc, s, i, k_eff, n_valid, n_real, rescore_ctx = payload
             scores = np.asarray(s)[:, :k_eff]
             ids = np.asarray(i)[:, :k_eff]
+            if rescore_ctx is not None:
+                # phase two: exact f32 re-rank of the coarse window (the
+                # blocking gather+score lives HERE, at response-assembly
+                # time, with the device sync — never in dispatch)
+                scores, ids = self._apply_rescore(rescore_ctx, scores,
+                                                  ids, n_real)
             return self._land_results(fc, scores, ids, -1e37, n_valid,
                                       n_real)
         finally:
@@ -840,17 +1000,31 @@ class VectorStoreShard:
                 return self._dispatch_generational(
                     snap, fc, k, precision, requests, num_candidates)
             base = snap.generations[0]
-            if base.corpus is not fc.corpus:
+            if base.corpus is not fc.corpus or fc.source is None:
                 fc = FieldCorpus(base.corpus, base.row_map, fc.metric,
                                  fc.dims, fc.version, host=base.host,
                                  router=base.router,
                                  mesh_state=base.mesh_state,
-                                 gens=fc.gens)
+                                 gens=fc.gens, encoding=fc.encoding,
+                                 rescore=fc.rescore,
+                                 rescore_oversample=fc.rescore_oversample,
+                                 rescore_candidates=fc.rescore_candidates,
+                                 source=base.source)
 
         n_valid = len(fc.row_map)
-        k_eff = min(k, fc.corpus.matrix.shape[0])
         queries = np.stack([q for q, _ in requests])
         any_filter = any(fr is not None for _, fr in requests)
+
+        # two-phase plan: packed encodings (int4/binary) serve coarse
+        # top-(k·oversample) on the packed matrix, then an exact f32
+        # rescore of the window at response-assembly time. k widens
+        # BEFORE the bucket ladder so the coarse phase stays in-grid.
+        k_req = min(k, fc.corpus.matrix.shape[0])
+        rescore_ctx = self._rescore_ctx(fc, queries, k_req)
+        k_eff = (k_req if rescore_ctx is None
+                 else quant_rescore.coarse_window(
+                     k_req, fc.rescore_oversample,
+                     limit=fc.corpus.matrix.shape[0]))
 
         self.knn_stats["searches"] += 1
         # cleared up front so a router-less dispatch can never leave a
@@ -861,7 +1035,8 @@ class VectorStoreShard:
             if reason is None:
                 return ("done",
                         self._execute_ivf(fc, k_eff, n_valid, queries,
-                                          len(requests), num_candidates))
+                                          len(requests), num_candidates,
+                                          rescore_ctx=rescore_ctx))
             self.knn_stats["fallback_searches"] += 1
             self.last_knn_phases = {"engine": "tpu_exhaustive",
                                     "fallback_reason": reason}
@@ -884,10 +1059,12 @@ class VectorStoreShard:
             if k_eff <= fc.mesh_state.layout.rows_per_shard:
                 return self._execute_mesh(fc, k_eff, n_valid, queries,
                                           requests, any_filter,
-                                          precision, mesh)
+                                          precision, mesh,
+                                          rescore_ctx=rescore_ctx)
             mesh_policy.reclassify_single("knn_k_deeper_than_shard")
 
         use_host = (fc.host is not None and precision != "f32"
+                    and rescore_ctx is None
                     and CostModel.prefer_host(len(requests), fc.host.n,
                                               fc.host.dims))
         if use_host:
@@ -923,12 +1100,14 @@ class VectorStoreShard:
                                 limit=fc.corpus.matrix.shape[0])
         s, i = knn_ops.knn_search_auto(
             jnp.asarray(queries), fc.corpus, k=k_b, metric=fc.metric,
-            filter_mask=mask, precision=precision)
+            filter_mask=mask, precision=precision,
+            rescore_candidates=fc.rescore_candidates)
         # un-synced: s/i are device futures until finalize_many reads
         # them — count the deferred sync so `_nodes/stats
         # indices.dispatch` shows how much serving load pipelines
         dispatch.DISPATCH.note_async()
-        return ("pending", (fc, s, i, k_eff, n_valid, len(requests)))
+        return ("pending", (fc, s, i, k_eff, n_valid, len(requests),
+                            rescore_ctx))
 
     def _dispatch_generational(self, snap, fc: FieldCorpus, k: int,
                                precision: str, requests,
@@ -941,9 +1120,25 @@ class VectorStoreShard:
         `finalize_many` (the snapshot rides in the handle, so a merge
         installing mid-flight cannot swap the row map under us)."""
         n_valid = len(snap.row_map)
-        k_eff = min(k, snap.total_pad)
-        queries = _pad_batch(np.stack([q for q, _ in requests]),
-                             len(requests))
+        queries_real = np.stack([q for q, _ in requests])
+        k_req = min(k, snap.total_pad)
+        # two-phase when the SNAPSHOT actually serves packed generations
+        # (mid-re-encode a still-int8 base stays single-phase and
+        # byte-stable; the first packed generation turns the exact
+        # rescore on, which also makes the mixed-encoding board merge
+        # exact again)
+        rescore_ctx = None
+        k_eff = k_req
+        if fc.rescore and any(
+                g.corpus is not None
+                and str(g.corpus.matrix.dtype) in ("uint8", "uint32")
+                for g in snap.generations):
+            rescore_ctx = {"queries": queries_real, "k": k_req,
+                           "metric": fc.metric,
+                           "gather": snap.gather_rows}
+            k_eff = quant_rescore.coarse_window(
+                k_req, fc.rescore_oversample, limit=snap.total_pad)
+        queries = _pad_batch(queries_real, len(requests))
         self.knn_stats["searches"] += 1
         self.last_knn_phases = {}
         s, i, phases = snap.search_async(
@@ -954,7 +1149,47 @@ class VectorStoreShard:
         # un-synced boards: the device sync happens at response-assembly
         # time in finalize_many, like the monolithic pipelined path
         dispatch.DISPATCH.note_async()
-        return ("pending", (snap, s, i, k_eff, n_valid, len(requests)))
+        return ("pending", (snap, s, i, k_eff, n_valid, len(requests),
+                            rescore_ctx))
+
+    @staticmethod
+    def _rescore_ctx(fc: FieldCorpus, queries: np.ndarray,
+                     k_final: int) -> Optional[dict]:
+        """Two-phase rescore context for one coalesced batch, or None
+        when this dispatch serves single-phase. Active exactly when the
+        SERVING corpus is a packed encoding with rescore on — a field
+        mid-re-encode (int8 base still serving after an int8→int4
+        mapping change) stays single-phase and byte-stable until the
+        merge thread installs the packed generations."""
+        if not fc.rescore or fc.source is None:
+            return None
+        if str(fc.corpus.matrix.dtype) not in ("uint8", "uint32"):
+            return None
+        return {"queries": queries, "k": k_final, "metric": fc.metric,
+                "gather": fc.source.gather}
+
+    def _apply_rescore(self, ctx: dict, scores: np.ndarray,
+                       ids: np.ndarray, n_real: int):
+        """Run the exact-rescore phase over coarse boards (flat/device
+        row ids) and fold the window stats into knn_stats /
+        profile.knn."""
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        out_s, out_i, stats = quant_rescore.rescore_boards(
+            ctx["queries"][:n_real], scores[:n_real], ids[:n_real],
+            ctx["k"], ctx["gather"], ctx["metric"])
+        nanos = _time.perf_counter_ns() - t0
+        self.knn_stats["rescore_searches"] += 1
+        self.knn_stats["rescore_window_rows"] += stats["window"] * n_real
+        self.knn_stats["rescore_promoted"] += stats["promoted"]
+        self.knn_stats["rescore_nanos"] += nanos
+        phases = dict(self.last_knn_phases or {})
+        phases["rescore"] = {"window": stats["window"],
+                             "promoted": stats["promoted"],
+                             "rescore_nanos": nanos}
+        self.last_knn_phases = phases
+        return out_s, out_i
 
     @staticmethod
     def _land_results(fc, scores: np.ndarray, ids: np.ndarray,
@@ -969,7 +1204,7 @@ class VectorStoreShard:
 
     def _execute_mesh(self, fc: FieldCorpus, k_eff: int, n_valid: int,
                       queries: np.ndarray, requests, any_filter: bool,
-                      precision: str, mesh):
+                      precision: str, mesh, rescore_ctx=None):
         """Launch one coalesced exact-kNN batch as ONE SPMD program over
         the mesh-resident sharded corpus (`parallel/sharded_knn.py`):
         shard-local matmul + top-k, all-gather candidate merge, k-ladder
@@ -1023,7 +1258,7 @@ class VectorStoreShard:
         # un-synced boards: the device sync is deferred to finalize
         dispatch.DISPATCH.note_async()
         return ("mesh", (fc, ms, mesh, scores, gids, k_eff, k_b, b_pad,
-                         n_valid, len(requests), t0))
+                         n_valid, len(requests), t0, rescore_ctx))
 
     def _finalize_mesh(self, payload) -> list:
         """Land one mesh dispatch: device sync, k slice-back, slot-map
@@ -1034,12 +1269,20 @@ class VectorStoreShard:
         from elasticsearch_tpu.parallel import policy as mesh_policy
 
         (fc, ms, mesh, scores, gids, k_eff, k_b, b_pad, n_valid, n_real,
-         t0) = payload
+         t0, rescore_ctx) = payload
         gids.block_until_ready()
         t1 = _time.perf_counter_ns()
         scores = np.asarray(scores)[:, :k_eff]
         gids = np.asarray(gids)[:, :k_eff]
         flat = ms.map_ids(gids)
+        rescore_info = None
+        if rescore_ctx is not None:
+            # exact phase over flat corpus rows (the slot-map join
+            # already happened, so the window gathers through the same
+            # RowSource as the single-device path)
+            scores, flat = self._apply_rescore(rescore_ctx, scores,
+                                               flat, n_real)
+            rescore_info = (self.last_knn_phases or {}).get("rescore")
         out = []
         for qi in range(n_real):
             sc, rid = scores[qi], flat[qi]
@@ -1061,13 +1304,17 @@ class VectorStoreShard:
             "collective_bytes": gather,
             "route_nanos": 0, "score_nanos": t1 - t0,
             "merge_nanos": t2 - t1}
+        if rescore_ctx is not None and rescore_info is not None:
+            self.last_knn_phases["rescore"] = rescore_info
         return out
 
     def _execute_ivf(self, fc: FieldCorpus, k_eff: int, n_valid: int,
                      queries: np.ndarray, n_real: int,
-                     num_candidates: Optional[int]) -> list:
+                     num_candidates: Optional[int],
+                     rescore_ctx: Optional[dict] = None) -> list:
         """Serve one coalesced batch through the tpu_ivf router (the
-        mesh policy decides single-device vs SPMD execution)."""
+        mesh policy decides single-device vs SPMD execution; packed
+        encodings rescore the coarse window exactly before landing)."""
         import time as _time
 
         from elasticsearch_tpu.parallel import policy as mesh_policy
@@ -1080,6 +1327,12 @@ class VectorStoreShard:
         scores, rows, phases = fc.router.search(
             queries, k_b, num_candidates=num_candidates, mesh=mesh)
         scores, rows = scores[:, :k_eff], rows[:, :k_eff]
+        phases = dict(phases)
+        if rescore_ctx is not None:
+            scores, rows = self._apply_rescore(rescore_ctx, scores, rows,
+                                               n_real)
+            phases["rescore"] = (self.last_knn_phases
+                                 or {}).get("rescore")
         t0 = _time.perf_counter_ns()
         out = []
         for qi in range(n_real):
@@ -1087,11 +1340,12 @@ class VectorStoreShard:
             valid = (sc > -1e37) & (rid >= 0) & (rid < n_valid)
             sc, rid = sc[valid], rid[valid]
             out.append((fc.row_map[rid], sc.astype(np.float32)))
-        phases = dict(phases)
         phases["merge_nanos"] += _time.perf_counter_ns() - t0
         self.knn_stats["ivf_searches"] += 1
         if phases.get("engine") == "tpu_ivf_mesh":
             self.knn_stats["mesh_searches"] += 1
+        if phases.get("fused_probe"):
+            self.knn_stats["fused_probe_searches"] += 1
         for ph in ("route_nanos", "score_nanos", "merge_nanos"):
             self.knn_stats[ph] += phases[ph]
         self.last_knn_phases = phases

@@ -86,6 +86,98 @@ def test_interpret_binned_validity_mask_excludes_padding(data):
     assert (ids < 100).all()
 
 
+# ---------------------------------------------------------------------------
+# fused IVF gather+score kernel (ops/pallas_ivf_fused.py): the scalar-
+# prefetch gather must reproduce the scan-based probe scorer exactly
+# ---------------------------------------------------------------------------
+
+IVF_N, IVF_D, IVF_NLIST, IVF_NPROBE = 2048, 64, 32, 8
+
+
+@pytest.fixture(scope="module")
+def ivf_layouts():
+    from elasticsearch_tpu.ann.ivf_index import build_ivf_index
+    rng = np.random.default_rng(17)
+    vecs = rng.standard_normal((IVF_N, IVF_D)).astype(np.float32)
+    qs = rng.standard_normal((NQ, IVF_D)).astype(np.float32)
+    out = {}
+    for dt in ("f32", "bf16", "int8", "int4"):
+        out[dt] = build_ivf_index(vecs, metric=sim.COSINE,
+                                  nlist=IVF_NLIST, dtype=dt)
+    return vecs, qs, out
+
+
+@pytest.mark.parametrize("dt", ["f32", "bf16", "int8", "int4"])
+def test_interpret_fused_probe_matches_scan_scorer(ivf_layouts, dt):
+    """Byte parity of the fused gather+score board against the
+    jnp.take-based scan scorer: identical winner rows, near-identical
+    scores (both run the same bf16 matmul + dequant math)."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import knn_ivf
+    from elasticsearch_tpu.ops import pallas_ivf_fused as fused
+    _, qs, layouts = ivf_layouts
+    parts = layouts[dt].device_partitions()
+    q = knn_ivf._prep_queries(jnp.asarray(qs), sim.COSINE)
+    probe_ids, _ = knn_ivf.route(q, parts, IVF_NPROBE, metric=sim.COSINE)
+    s_scan, r_scan = knn_ivf.score_probes(q, parts, probe_ids, 10,
+                                          metric=sim.COSINE)
+    s_f, r_f = fused.fused_probe_scores(q, parts, probe_ids, 10,
+                                        metric=sim.COSINE, interpret=True)
+    np.testing.assert_array_equal(np.asarray(r_scan), np.asarray(r_f))
+    np.testing.assert_allclose(np.asarray(s_scan), np.asarray(s_f),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_interpret_fused_probe_validity_mask_excludes_padding(ivf_layouts):
+    """Partition-capacity padding rows (part_rows == -1, zero scales)
+    must never win a top-k slot, even when probed partitions are mostly
+    padding."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ann.ivf_index import build_ivf_index
+    from elasticsearch_tpu.ops import knn_ivf
+    from elasticsearch_tpu.ops import pallas_ivf_fused as fused
+    rng = np.random.default_rng(23)
+    tiny = rng.standard_normal((40, IVF_D)).astype(np.float32)
+    idx = build_ivf_index(tiny, metric=sim.COSINE, nlist=4, dtype="f32")
+    parts = idx.device_partitions()
+    qs = rng.standard_normal((8, IVF_D)).astype(np.float32)
+    q = knn_ivf._prep_queries(jnp.asarray(qs), sim.COSINE)
+    probe_ids, _ = knn_ivf.route(q, parts, 4, metric=sim.COSINE)
+    s, r = fused.fused_probe_scores(q, parts, probe_ids, 16,
+                                    metric=sim.COSINE, interpret=True)
+    s, r = np.asarray(s), np.asarray(r)
+    real = r >= 0
+    assert (r[real] < 40).all()
+    assert (s[~real] < -1e37).all()  # padding slots carry the sentinel
+
+
+def test_interpret_fused_probe_zero_recompile_second_pass(ivf_layouts):
+    """The fused kernel's compile set is closed: a second pass over the
+    warmed (Q bucket, nprobe, k) grid compiles nothing under strict
+    dispatch."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import knn_ivf
+    from elasticsearch_tpu.ops import pallas_ivf_fused as fused
+    _, qs, layouts = ivf_layouts
+    parts = layouts["int8"].device_partitions()
+    q = knn_ivf._prep_queries(jnp.asarray(qs), sim.COSINE)
+    probe_ids, _ = knn_ivf.route(q, parts, IVF_NPROBE, metric=sim.COSINE)
+    fused.fused_probe_scores(q, parts, probe_ids, 10, metric=sim.COSINE,
+                             interpret=True)
+    before = dispatch.DISPATCH.compile_count()
+    strict_before = dispatch.DISPATCH.strict
+    dispatch.DISPATCH.strict = True
+    try:
+        fused.fused_probe_scores(q, parts, probe_ids, 10,
+                                 metric=sim.COSINE, interpret=True)
+    finally:
+        dispatch.DISPATCH.strict = strict_before
+    assert dispatch.DISPATCH.compile_count() == before
+
+
 def test_interpret_binned_steady_state_zero_recompile(data):
     vecs, qs, _, _ = data
     corpus = knn_ops.build_corpus(vecs, metric=sim.COSINE, dtype="f32",

@@ -1,0 +1,32 @@
+"""TPU013 clean: quantization routed through the codec registry; other
+clip/round/shift arithmetic stays out of scope."""
+
+import numpy as np
+
+from elasticsearch_tpu.quant import codec as quant_codec
+
+
+def encode_rows(matrix, encoding):
+    """The sanctioned shape: the registry owns the recipe."""
+    enc = quant_codec.get(encoding).encode_np(matrix)
+    return enc.data, enc.scales
+
+
+def quantize_queries(q):
+    return quant_codec.quantize_queries_int8_jnp(q)
+
+
+def unrelated_clip(scores):
+    # clip without a round-of-division inside is score clamping, not
+    # quantization (the binned kernel's CLAMP window)
+    return np.clip(scores, -3.0, 3.0)
+
+
+def rounded_ratio(a, b):
+    # round of a division OUTSIDE a clip is ordinary arithmetic
+    return np.round(a / b)
+
+
+def shifted_masks(ids, bits):
+    # shifts of non-sign data are bit bookkeeping, not sign packing
+    return (ids & ~((1 << bits) - 1)) | (ids << 2)

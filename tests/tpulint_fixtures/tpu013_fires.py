@@ -1,0 +1,26 @@
+"""TPU013 fires: hand-rolled quantization arithmetic outside quant/."""
+
+import numpy as np
+
+
+def quantize_rows_int8(matrix):
+    """A fifth private copy of the int8 recipe (the drift class)."""
+    scales = np.abs(matrix).max(axis=-1) / 127.0
+    q8 = np.clip(np.round(matrix / scales[:, None]), -127, 127)  # [expect]
+    return q8.astype(np.int8), scales
+
+
+def quantize_rows_int4(matrix, scales):
+    return np.clip(np.rint(matrix / scales[:, None]), -7, 7)  # [expect]
+
+
+def pack_signs_shift(rows):
+    bits = (rows >= 0).astype(np.uint32)
+    words = 0
+    for j in range(32):
+        words = words | (bits[:, j] << j)
+    return words | ((rows[:, 0] >= 0) << 31)  # [expect]
+
+
+def pack_signs_packbits(rows):
+    return np.packbits(rows >= 0, axis=-1)  # [expect]

@@ -50,6 +50,9 @@ DEFAULT_X64_ALLOWED = ("*/ops/dispatch.py",)
 # the one package allowed to hold per-segment extraction caches
 # (TPU011): the shared segment block store every consumer reads through
 DEFAULT_SEG_CACHE_ALLOWED = ("*/columnar/*.py",)
+# the one package allowed to hand-roll quantize/dequantize arithmetic
+# (TPU013): the vector codec registry every encoding routes through
+DEFAULT_QUANT_ALLOWED = ("*/quant/*.py",)
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -85,6 +88,7 @@ class Config:
     raw_shard_map_allowed: Sequence[str] = DEFAULT_RAW_SHARD_MAP_ALLOWED
     x64_allowed: Sequence[str] = DEFAULT_X64_ALLOWED
     seg_cache_allowed: Sequence[str] = DEFAULT_SEG_CACHE_ALLOWED
+    quant_allowed: Sequence[str] = DEFAULT_QUANT_ALLOWED
     select: Optional[Sequence[str]] = None   # rule ids; None = all
 
 

@@ -1271,9 +1271,105 @@ class TelemetryDisciplineRule(Rule):
                 "finally:, or the retroactive record_span(name, dur_ns)"))
 
 
+# ---------------------------------------------------------------------------
+# TPU013 — hand-rolled quantization arithmetic outside quant/
+# ---------------------------------------------------------------------------
+
+_ROUND_NAMES = frozenset({"round", "rint"})
+
+
+class HandRolledQuantRule(Rule):
+    """TPU013: quantize/dequantize arithmetic outside the vector codec
+    registry (`elasticsearch_tpu/quant/`).
+
+    Historical context (ISSUE 15): by PR 14 the int8 recipe existed in
+    four hand-rolled copies — `ops/quantization` (the nominal owner),
+    the binned Pallas kernel's in-trace query quantization, the host
+    VNNI mirror's packer, and the bench harness's jit — and the int4 /
+    binary rungs would have added four more each. A recipe drift between
+    any pair breaks byte parity between host twins and device kernels,
+    which the two-phase rescore contract depends on. The codec registry
+    (`quant/codec.py`) now owns every encode/decode, with np+jnp twins
+    pinned byte-identical by test; this rule keeps a fifth copy from
+    growing back. Two patterns fire outside `quant/`:
+
+    * scale-divide-round-clip — a `clip(...)` call whose first argument
+      contains a `round`/`rint` of a division: the symmetric scalar
+      quantization idiom (`clip(round(x / scale), lo, hi)`), however the
+      calls are spelled (np/jnp/method form);
+    * sign-bit packing — `packbits(...)`, or a left-shift whose left
+      operand derives from a sign comparison against zero
+      (`(x >= 0) << j`): the binary-encoding idiom.
+
+    Route through `quant.codec.get(name).encode_np/encode_jnp` (or the
+    codec helpers for in-kernel unpack) instead.
+    """
+
+    rule_id = "TPU013"
+    summary = "hand-rolled quantization arithmetic outside quant/"
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if ctx.matches(ctx.config.quant_allowed):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node).split(".")[-1]
+                if name == "clip" and node.args \
+                        and self._has_round_of_div(node.args[0]):
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "scale-divide-round-clip quantization outside "
+                        "elasticsearch_tpu/quant/ — the codec registry "
+                        "owns every encoding recipe (quant.codec.get("
+                        "...).encode_np / encode_jnp); a drifted copy "
+                        "breaks host-twin/device byte parity"))
+                elif name == "packbits":
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        "sign-bit packing outside elasticsearch_tpu/"
+                        "quant/ — the binary codec owns the bit layout "
+                        "(quant.codec.get('binary') / "
+                        "pack_sign_bits_jnp)"))
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.LShift) \
+                    and self._has_sign_compare(node.left):
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "sign-bit packing ((x >= 0) << ...) outside "
+                    "elasticsearch_tpu/quant/ — the binary codec owns "
+                    "the bit layout (quant.codec.get('binary') / "
+                    "pack_sign_bits_jnp)"))
+        return findings
+
+    @staticmethod
+    def _has_round_of_div(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and call_name(sub).split(".")[-1] in _ROUND_NAMES \
+                    and any(isinstance(inner, ast.BinOp)
+                            and isinstance(inner.op, ast.Div)
+                            for arg in sub.args
+                            for inner in ast.walk(arg)):
+                return True
+        return False
+
+    @staticmethod
+    def _has_sign_compare(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Compare) and len(sub.ops) == 1 \
+                    and isinstance(sub.ops[0], (ast.GtE, ast.Lt)) \
+                    and len(sub.comparators) == 1 \
+                    and isinstance(sub.comparators[0], ast.Constant) \
+                    and sub.comparators[0].value == 0:
+                return True
+        return False
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
     ModuleCacheLockRule(), LockedSyncRule(), UnguardedFanoutRule(),
     PrivateSegmentCacheRule(), TelemetryDisciplineRule(),
+    HandRolledQuantRule(),
 ]
