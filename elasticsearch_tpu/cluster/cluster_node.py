@@ -120,6 +120,14 @@ class LocalShard:
             # same degradation contract as the knn settings above: a bad
             # replicated value must not crash the state applier
             segments_settings = {}
+        try:
+            from elasticsearch_tpu.indices.service import (
+                validate_semantic_cache_settings)
+            semantic_cache_settings = validate_semantic_cache_settings(s)
+        except Exception:
+            # same degradation contract: bad replicated value -> feature
+            # stays off, never crash the state applier
+            semantic_cache_settings = {}
         self.vector_store = VectorStoreShard(
             dtype=s.get("index.knn.vector_dtype", "bf16"),
             knn_engine=knn_engine, knn_nlist=knn_nlist,
@@ -128,7 +136,7 @@ class LocalShard:
             target_batch_latency_ms=float(
                 s.get("index.knn.target_batch_latency_ms", 2.0)),
             async_depth=int(s.get("index.knn.async_depth", 2)),
-            **segments_settings)
+            **segments_settings, **semantic_cache_settings)
         self._attach_engine(engine)
 
     def _attach_engine(self, engine: Engine) -> None:
@@ -381,9 +389,11 @@ class ClusterNode:
         # prefix, which is what keeps reserved metadata sections
         # (REGISTRIES_KEY) unreachable as indices
         from elasticsearch_tpu.indices.service import (
-            IndicesService, validate_knn_settings)
+            IndicesService, validate_knn_settings,
+            validate_semantic_cache_settings)
         IndicesService.validate_index_name(name)
         validate_knn_settings(dict(request.get("settings") or {}))
+        validate_semantic_cache_settings(dict(request.get("settings") or {}))
 
         def update(base: ClusterState) -> ClusterState:
             if name in base.metadata:
@@ -1139,9 +1149,19 @@ class ClusterNode:
 
         # can_match pre-filter round (CanMatchPreFilterSearchPhase.java:57):
         # above the threshold, a lightweight range-vs-field-stats RPC prunes
-        # shards that provably cannot match before the query phase fans out
-        prefilter_size = int(body.get("pre_filter_shard_size", 128))
-        if len(targets) > prefilter_size and body.get("query") is not None:
+        # shards that provably cannot match before the query phase fans out.
+        # Time-range queries prefilter at ANY fan-out width (the reference's
+        # default-on-range behavior): the field-stats min/max comparison is
+        # exactly the evidence class those queries prune on, and a dashboard
+        # time window typically rules out most of a rolling-index target set
+        explicit = body.get("pre_filter_shard_size")
+        prefilter_size = int(explicit) if explicit is not None else 128
+        from elasticsearch_tpu.search.caches import has_range_clauses
+        auto_range = (explicit is None
+                      and has_range_clauses(body.get("query")))
+        if body.get("query") is not None \
+                and (len(targets) > prefilter_size
+                     or (auto_range and len(targets) > 1)):
             self._can_match_phase(
                 body, targets,
                 lambda kept, skipped: self._query_phase(
@@ -1213,6 +1233,10 @@ class ClusterNode:
                 # keep one shard so the response still carries proper
                 # formatting (reference keeps the first skipped shard)
                 kept, skipped = targets[:1], len(targets) - 1
+            # pruning yield of the round, next to its launched/ok/failed
+            # counters in _nodes/stats `fanout.phases.can_match`
+            pc = self.fanout_stats.phase("can_match")
+            pc["skipped_shards"] = pc.get("skipped_shards", 0) + skipped
             proceed(kept, skipped)
 
         # an unresponsive shard defaults to can_match=True (never prune on
@@ -1567,10 +1591,13 @@ class ClusterNode:
 
         reader = local.engine.acquire_searcher()
         # shard request cache: whole serialized query-phase responses for
-        # size=0 requests, keyed on reader generation (IndicesRequestCache)
+        # size=0 requests, keyed on the reader CONTENT fingerprint
+        # (IndicesRequestCache; a no-op refresh keeps its entries)
         cache_key = None
-        if RequestCache.cacheable(body):
-            cache_key = self.caches.request.key(key, reader.gen, body)
+        if self.caches.request.cacheable_tracked(body):
+            from elasticsearch_tpu.search.caches import reader_fingerprint
+            cache_key = self.caches.request.key(
+                key, reader_fingerprint(reader), body)
             cached = self.caches.request.get(cache_key)
             if cached is not None:
                 answer(cached)

@@ -114,7 +114,8 @@ class IndexShardHandle:
                  knn_nprobe="auto", knn_topup: bool = True,
                  knn_target_batch_latency_ms: float = 2.0,
                  knn_async_depth: int = 2,
-                 segments_settings: Optional[dict] = None):
+                 segments_settings: Optional[dict] = None,
+                 semantic_cache_settings: Optional[dict] = None):
         self.index_name = index_name
         self.shard_id = shard_id
         self.engine = Engine(path, mapper_service,
@@ -126,7 +127,8 @@ class IndexShardHandle:
             topup=knn_topup,
             target_batch_latency_ms=knn_target_batch_latency_ms,
             async_depth=knn_async_depth,
-            **(segments_settings or {}))
+            **(segments_settings or {}),
+            **(semantic_cache_settings or {}))
         self.mapper_service = mapper_service
         self._sync_vectors(self.engine.acquire_searcher())
         self.engine.add_refresh_listener(self._sync_vectors)
@@ -207,6 +209,41 @@ def validate_segments_settings(settings: dict) -> dict:
                 f"[index.segments.merge_budget_ms] must be a number "
                 f"> 0, got [{raw}]")
         out["segments_merge_budget_ms"] = val
+    return out
+
+
+def validate_semantic_cache_settings(settings: dict) -> dict:
+    """Validate + normalize the `index.knn.semantic_cache.*` settings
+    (vectors/semantic_cache.py: opt-in device-resident ring of recent
+    query embeddings) into `VectorStoreShard` constructor kwargs. ONE
+    owner for the single-node create path and the cluster master's
+    create-index handler (like `validate_knn_settings`)."""
+    from elasticsearch_tpu.common.settings import setting_bool
+    out = {"semantic_cache_enabled": setting_bool(
+        settings.get("index.knn.semantic_cache.enabled", False),
+        default=False)}
+    raw = settings.get("index.knn.semantic_cache.size")
+    if raw is not None:
+        try:
+            val = int(raw)
+        except (TypeError, ValueError):
+            val = 0
+        if val < 1 or val > 65536:
+            raise IllegalArgumentError(
+                f"[index.knn.semantic_cache.size] must be an integer in "
+                f"[1, 65536], got [{raw}]")
+        out["semantic_cache_size"] = val
+    raw = settings.get("index.knn.semantic_cache.threshold")
+    if raw is not None:
+        try:
+            val = float(raw)
+        except (TypeError, ValueError):
+            val = -1.0
+        if not (0.5 <= val <= 1.0):
+            raise IllegalArgumentError(
+                f"[index.knn.semantic_cache.threshold] must be a number "
+                f"in [0.5, 1.0], got [{raw}]")
+        out["semantic_cache_threshold"] = val
     return out
 
 
@@ -293,6 +330,10 @@ class IndexService:
         # seal/tombstone/merge lifecycle knobs of the vector store
         segments_settings = validate_segments_settings(
             settings.as_flat_dict())
+        # device-resident semantic cache (`index.knn.semantic_cache.*`):
+        # opt-in near-duplicate query reuse on the kNN path
+        semantic_cache_settings = validate_semantic_cache_settings(
+            settings.as_flat_dict())
         self.shards: List[IndexShardHandle] = []
         for s in range(self.num_shards):
             self.shards.append(IndexShardHandle(
@@ -303,7 +344,8 @@ class IndexService:
                 knn_topup=knn_topup,
                 knn_target_batch_latency_ms=knn_target_ms,
                 knn_async_depth=knn_async_depth,
-                segments_settings=segments_settings))
+                segments_settings=segments_settings,
+                semantic_cache_settings=semantic_cache_settings))
         self.aliases: Dict[str, dict] = {}
 
     @property

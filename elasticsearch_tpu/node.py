@@ -1339,6 +1339,8 @@ class Node:
                "fuse_nanos": 0, "hydrate_nanos": 0, "queue_wait_nanos": 0,
                "dispatch_nanos": 0, "sync_nanos": 0, "rejected_depth": 0,
                "shed_deadline": 0, "max_queue_depth_seen": 0,
+               "request_cache_hits": 0, "request_cache_misses": 0,
+               "request_cache_stores": 0,
                "scheduler": {"topups": 0, "deadline_sheds": 0,
                              "overlap_hits": 0, "pipelined_batches": 0}}
         self._evict_stale_hybrid()
@@ -1346,7 +1348,9 @@ class Node:
             for key in ("searches", "batches", "plan_cache_hits",
                         "plan_cache_misses", "plan_nanos", "score_nanos",
                         "fuse_nanos", "hydrate_nanos", "queue_wait_nanos",
-                        "dispatch_nanos", "sync_nanos"):
+                        "dispatch_nanos", "sync_nanos",
+                        "request_cache_hits", "request_cache_misses",
+                        "request_cache_stores"):
                 out[key] += ex.stats.get(key, 0)
             bs = ex.batcher.stats
             out["rejected_depth"] += bs.get("rejected_depth", 0)
@@ -1529,20 +1533,44 @@ class Node:
                     # steady state; `profile.dispatch` renders it)
                     from elasticsearch_tpu.ops import dispatch as _dispatch
                     _dispatch.DISPATCH.record_events(True)
-                # shard request cache: size=0 (aggs/count) responses keyed on
-                # the reader generation — a refresh invalidates implicitly
-                from elasticsearch_tpu.search.caches import RequestCache
+                # shard request cache: query-phase results keyed on the
+                # reader CONTENT fingerprint (search/caches.reader_
+                # fingerprint) — a refresh that changed nothing keeps
+                # its hits, any ingest/delete/merge invalidates. Two
+                # rungs share the policy: the legacy host rung (size=0
+                # aggs/counts, the device-agg engine's dashboard shape)
+                # and the device rung (kNN-bearing bodies, size > 0 —
+                # the query phase IS the device dispatch there).
+                from elasticsearch_tpu.search.caches import (
+                    reader_fingerprint)
                 cache_key = None
+                cache_used = None
+                cache_hit = False
                 result = None
-                if RequestCache.cacheable(body):
+                # device rung first: it claims every knn-bearing body
+                # (flag-opted-in ones included), so the host rung keeps
+                # its original host-side population (size=0 aggs/counts)
+                if self._device_request_cache_enabled() \
+                        and self.caches.device_request.device_cacheable(
+                            body):
+                    cache_used = self.caches.device_request
+                elif self.caches.request.cacheable_tracked(body):
+                    cache_used = self.caches.request
+                if cache_used is not None:
                     # partial vs finalized agg trees differ per request shape
                     # (multi-index searches ship partials); max_buckets is
-                    # dynamic, so a changed limit must miss the cache
-                    cache_key = self.caches.request.key(
-                        (svc.name, use_partial_aggs, self._max_buckets(),
-                         self._allow_expensive()),
-                        reader.gen, body)
-                    result = self.caches.request.get(cache_key)
+                    # dynamic, so a changed limit must miss the cache, and a
+                    # mesh-policy reconfigure must miss rather than serve a
+                    # result (and its routing diagnostics) computed under
+                    # the old serving config
+                    from elasticsearch_tpu.parallel import policy as _policy
+                    cache_key = cache_used.key(
+                        (svc.name, svc.uuid, use_partial_aggs,
+                         self._max_buckets(), self._allow_expensive(),
+                         _policy.config_epoch()),
+                        reader_fingerprint(reader), body)
+                    result = cache_used.get(cache_key)
+                    cache_hit = result is not None
                 if result is None:
                     from elasticsearch_tpu.common.settings import setting_bool
                     frozen = setting_bool(svc.settings.get("index.frozen"))
@@ -1591,7 +1619,7 @@ class Node:
                             body, use_partial_aggs, frozen)
                         cache_key = None  # partial result: never cache
                     if cache_key is not None:
-                        self.caches.request.put(cache_key, result)
+                        cache_used.put(cache_key, result)
                 q_nanos = time.perf_counter_ns() - q_start
                 phase_nanos["query_nanos"] += q_nanos
                 _teletrace.record_span(f"query[{svc.name}]", q_nanos,
@@ -1636,12 +1664,20 @@ class Node:
                     from elasticsearch_tpu.search.profile import shard_profile
                     events = _dispatch.DISPATCH.drain_events()
                     _dispatch.DISPATCH.record_events(False)
+                    cache_note = None
+                    if cache_used is not None:
+                        cache_note = {
+                            "rung": ("shard_request"
+                                     if cache_used is self.caches.request
+                                     else "device_request"),
+                            "hit": cache_hit}
                     profile_shards.append(shard_profile(
                         svc.name, body, q_nanos, f_nanos,
                         result.total_hits,
                         knn_phases=result.knn_phases,
                         dispatch_events=events,
-                        aggs_profile=result.aggs_profile))
+                        aggs_profile=result.aggs_profile,
+                        cache=cache_note))
         finally:
             self.breakers.release("request", breaker_bytes)
             if profile_enabled:
@@ -2055,6 +2091,17 @@ class Node:
         v = self._cluster_setting("search.allow_expensive_queries")
         return v is None or str(v).lower() != "false"
 
+    def _device_request_cache_enabled(self) -> bool:
+        """`search.request_cache.device_paths` (default on): the shard
+        request cache rung on the fused device paths — hybrid executor
+        responses and kNN/device-agg query-phase results. Dynamic
+        cluster setting wins over the node setting, so a live cluster
+        can turn the rung off without restart."""
+        v = self._cluster_setting("search.request_cache.device_paths")
+        if v is None:
+            v = self.settings.get("search.request_cache.device_paths")
+        return v is None or str(v).lower() != "false"
+
     def _max_buckets(self) -> Optional[int]:
         v = self._cluster_setting("search.max_buckets")
         return int(v) if v is not None else None
@@ -2382,14 +2429,22 @@ class Node:
                     if _match_any(g, groups) and n > 0}
         if "query_cache" in keep and "query_cache" in agg:
             agg["query_cache"].update(
+                memory_size_in_bytes=self.caches.query.bytes,
                 hit_count=self.caches.query.hits,
                 miss_count=self.caches.query.misses,
                 evictions=self.caches.query.evictions)
         if "request_cache" in keep and "request_cache" in agg:
+            # both rungs of the shard request cache: the legacy host
+            # path and the device-path cache (hybrid/kNN/device-agg);
+            # bytes are the LruCache's tracked approximation, not 0
+            host, dev = self.caches.request, self.caches.device_request
             agg["request_cache"].update(
-                hit_count=self.caches.request.hits,
-                miss_count=self.caches.request.misses,
-                evictions=self.caches.request.evictions)
+                memory_size_in_bytes=host.bytes + dev.bytes,
+                hit_count=host.hits + dev.hits,
+                miss_count=host.misses + dev.misses,
+                evictions=host.evictions + dev.evictions,
+                skipped_uncacheable=(host.skipped_uncacheable
+                                     + dev.skipped_uncacheable))
         if "bulk" in keep and "bulk" in agg:
             # node-global counter: once at _all, not summed per index
             agg["bulk"]["total_operations"] = self.counters.get("bulk", 0)
@@ -2472,10 +2527,24 @@ class Node:
             "indexing": {"index_total":
                          self.counters.get("index", 0)},
             "request_cache": {
-                "hit_count": self.caches.request.hits,
-                "miss_count": self.caches.request.misses,
-                "evictions": self.caches.request.evictions},
+                "memory_size_in_bytes": (self.caches.request.bytes
+                                         + self.caches.device_request.bytes),
+                "hit_count": (self.caches.request.hits
+                              + self.caches.device_request.hits),
+                "miss_count": (self.caches.request.misses
+                               + self.caches.device_request.misses),
+                "evictions": (self.caches.request.evictions
+                              + self.caches.device_request.evictions),
+                "skipped_uncacheable": (
+                    self.caches.request.skipped_uncacheable
+                    + self.caches.device_request.skipped_uncacheable),
+                # per-rung breakdown: `device` is the fused hybrid /
+                # kNN / device-agg request cache (fingerprint-keyed),
+                # the top-level counters remain the combined view
+                "host": self.caches.request.stats(),
+                "device": self.caches.device_request.stats()},
             "query_cache": {
+                "memory_size_in_bytes": self.caches.query.bytes,
                 "hit_count": self.caches.query.hits,
                 "miss_count": self.caches.query.misses,
                 "evictions": self.caches.query.evictions},
@@ -2588,7 +2657,10 @@ class Node:
                "mesh_searches": 0, "fused_probe_searches": 0,
                "rescore_searches": 0, "rescore_window_rows": 0,
                "rescore_promoted": 0, "rescore_nanos": 0,
-               "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
+               "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0,
+               "semantic_probes": 0, "semantic_hits": 0,
+               "semantic_rejects": 0, "semantic_inserts": 0,
+               "semantic_invalidations": 0, "semantic_probe_nanos": 0}
         sched: dict = {}
         fields: dict = {}
         for svc in self.indices.indices.values():

@@ -105,6 +105,16 @@ _counters = {
 
 _UNSET = object()
 
+# bumped on every configure()/full reset(): the request-cache "live
+# settings epoch" component (search/caches.request_cache_key) — a
+# serving-policy change must MISS the read-path caches, not serve a
+# result (and its route diagnostics) computed under the old config
+_cfg_epoch = 0
+
+
+def config_epoch() -> int:
+    return _cfg_epoch
+
 
 def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET,
               dp=_UNSET, hbm_budget_bytes=_UNSET) -> None:
@@ -115,8 +125,9 @@ def configure(enabled=_UNSET, num_shards=_UNSET, min_rows=_UNSET,
     None explicitly resets that key to auto/default. Drops the cached
     mesh (and its dp groups / secondary shard meshes) so the next
     dispatch rebuilds against the new config."""
-    global _mesh, _mesh_built
+    global _mesh, _mesh_built, _cfg_epoch
     with _lock:
+        _cfg_epoch += 1
         if enabled is not _UNSET:
             _cfg["enabled"] = enabled
         if num_shards is not _UNSET:
@@ -486,8 +497,9 @@ def stats() -> dict:
 def reset(full: bool = False) -> None:
     """Zero the counters (tests). full=True also drops the config and the
     cached mesh back to auto defaults."""
-    global _mesh, _mesh_built, _rr
+    global _mesh, _mesh_built, _rr, _cfg_epoch
     with _lock:
+        _cfg_epoch += 1
         _counters["decisions_mesh"] = 0
         _counters["decisions_single_device"] = 0
         _counters["reasons"].clear()

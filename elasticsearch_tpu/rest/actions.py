@@ -473,6 +473,12 @@ def register_all(rc: RestController, node: Node) -> None:
                 req.params.get("index"), body, keep_alive=scroll,
                 ignore_throttled=req.bool_param("ignore_throttled", True))
         else:
+            if req.param("request_cache") is not None:
+                # the URI param form of the per-request cache opt-in/out
+                # (RestSearchAction); the cache policy reads it from the
+                # body (search/caches.RequestCache)
+                body["request_cache"] = req.bool_param(
+                    "request_cache", True)
             resp = node.search(req.params.get("index"), body,
                                ignore_throttled=req.bool_param(
                                    "ignore_throttled", True),

@@ -213,6 +213,14 @@ class HybridPlan:
         return bound
 
 
+def _canonical_settings(svc) -> str:
+    """Flat index settings as canonical JSON — the settings component of
+    the request-cache epoch (a put_settings change must miss)."""
+    import json
+    return json.dumps(svc.settings.as_flat_dict(), sort_keys=True,
+                      default=str)
+
+
 def plan_cache_key(body: dict) -> str:
     """Normalized plan-cache key: the body with per-query VALUE slots
     scrubbed — `knn.query_vector` → its length (shape is structural,
@@ -420,7 +428,9 @@ class HybridExecutor:
                       "plan_cache_hits": 0, "plan_cache_misses": 0,
                       "plan_nanos": 0, "score_nanos": 0, "fuse_nanos": 0,
                       "hydrate_nanos": 0, "queue_wait_nanos": 0,
-                      "dispatch_nanos": 0, "sync_nanos": 0}
+                      "dispatch_nanos": 0, "sync_nanos": 0,
+                      "request_cache_hits": 0, "request_cache_misses": 0,
+                      "request_cache_stores": 0}
         # finalize stages of different batches run CONCURRENTLY when
         # async_depth > 1; their stats writes must not lose updates
         # (dispatch-stage writes serialize under the batcher lock)
@@ -428,7 +438,83 @@ class HybridExecutor:
 
     # ------------------------------------------------------------- entry
     def submit(self, body: dict) -> dict:
-        return self.batcher.submit(body)
+        """Request-cache short-circuit, then the bounded batcher.
+
+        The shard request cache sits BEFORE the batcher: a repeated
+        dashboard body (same shape, same values, same reader content,
+        same live settings) returns the stored response without
+        occupying a batch slot or a device dispatch. Refresh rotates the
+        reader fingerprint inside the key, so invalidation is free.
+        Profiled bodies are never SERVED from cache — the profile must
+        describe a real execution — but report the cache state in a
+        `cache` annotation."""
+        key = self._request_cache_key(body)
+        if key is None:
+            return self.batcher.submit(body)
+        cache = self.node.caches.device_request
+        if not body.get("profile"):
+            cached = cache.get(key)
+            if cached is not None:
+                with self._stats_lock:
+                    self.stats["request_cache_hits"] += 1
+                return self._serve_cached(cached)
+            with self._stats_lock:
+                self.stats["request_cache_misses"] += 1
+        resp = self.batcher.submit(body)
+        if body.get("profile"):
+            prof = resp.get("profile")
+            if prof is not None and "hybrid" in prof:
+                prof["hybrid"]["cache"] = {
+                    "rung": "device_request", "served": False,
+                    "policy": "profile_bypass"}
+        else:
+            import copy as _copy
+            entry = _copy.deepcopy(
+                {k: v for k, v in resp.items()
+                 if k not in ("took", "_took_phases")})
+            cache.put(key, entry)
+            with self._stats_lock:
+                self.stats["request_cache_stores"] += 1
+        return resp
+
+    def _request_cache_key(self, body: dict):
+        """None when this body must not cache (disabled, opted out, or
+        non-deterministic); otherwise the sanctioned layered key:
+        normalized plan key + value digest + reader content fingerprint
+        + live settings epoch (`search/caches.request_cache_key`)."""
+        node = self.node
+        if not getattr(node, "_device_request_cache_enabled", lambda: False)():
+            return None
+        from elasticsearch_tpu.search import caches as _caches
+        cache = node.caches.device_request
+        flag = body.get("request_cache")
+        if flag is False:
+            return None
+        if not cache.deterministic(body):
+            if flag is True:
+                cache.skipped_uncacheable += 1
+            return None
+        svc = self.svc
+        reader = svc.combined_reader()
+        # epoch: everything outside the body the response depends on —
+        # the index identity (uuid guards same-name recreation reusing
+        # segment ids), its live settings, and the node's dynamic limits
+        from elasticsearch_tpu.parallel import policy as _policy
+        epoch = (svc.name, getattr(svc, "uuid", None),
+                 hash(_canonical_settings(svc)),
+                 node._max_buckets(), node._allow_expensive(),
+                 _policy.config_epoch())
+        return _caches.request_cache_key(
+            plan_cache_key(body), body,
+            fingerprint=_caches.reader_fingerprint(reader),
+            epoch=epoch)
+
+    @staticmethod
+    def _serve_cached(entry: dict) -> dict:
+        import copy as _copy
+        resp = _copy.deepcopy(entry)
+        resp["took"] = 0
+        return resp
 
     def _warmup(self) -> None:
         """Batcher-start warmup (runs on the batcher's daemon thread):

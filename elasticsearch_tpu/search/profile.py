@@ -117,7 +117,8 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
                   fetch_nanos: int, total_hits: int,
                   knn_phases: Optional[dict] = None,
                   dispatch_events: Optional[list] = None,
-                  aggs_profile: Optional[dict] = None) -> dict:
+                  aggs_profile: Optional[dict] = None,
+                  cache: Optional[dict] = None) -> dict:
     kind, description = _describe_query(body)
     breakdown = {
         "score": query_nanos * 7 // 10,
@@ -253,6 +254,11 @@ def shard_profile(index_name: str, body: dict, query_nanos: int,
             # composition mode) — the profile half of
             # `_nodes/stats indices.columnar`
             profile["columnar"] = aggs_profile["columnar"]
+    if cache is not None:
+        # shard request-cache state of THIS execution: which rung the
+        # body was eligible for and whether the query phase was served
+        # from it (a hit's query_nanos covers only the fetch re-run)
+        profile["cache"] = cache
     return profile
 
 

@@ -46,6 +46,7 @@ _SEARCH_BODY_KEYS = {
     "indices_boost", "knn", "rank", "pit", "runtime_mappings", "slice",
     "ext", "stats", "point_in_time", "batched_reduce_size",
     "pre_filter_shard_size", "scroll", "max_concurrent_shard_requests",
+    "request_cache",
 }
 
 

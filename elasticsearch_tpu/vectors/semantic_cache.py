@@ -1,0 +1,320 @@
+"""Device-resident semantic cache: near-duplicate query reuse for kNN.
+
+Zipf-shaped query streams (autocomplete, RAG front-ends, dashboard
+refreshes) re-ask the same handful of embeddings with tiny numerical
+drift — close enough that the exact top-k barely moves, far enough that
+a byte-keyed request cache never hits. This cache keeps a small ring of
+recent query embeddings RESIDENT on the accelerator and answers "have I
+seen a query within `threshold` cosine of this one?" with one batched
+matmul per coalesced batch, probed through `ops/dispatch` under its own
+closed grid (`semcache.probe`: query count on the shared bucket ladder,
+ring slots a fixed power of two) so steady-state probing costs zero
+recompiles.
+
+A probe hit is never served blind. The candidate entry carries the
+exact f32 vectors of its cached top-k window (gathered once, at insert,
+through the columnar `RowSource`), and the incoming query is rescored
+against that window in exact f32 (`quant/rescore.exact_scores`). The
+guard then checks dominance: for normalized metrics (cosine,
+dot_product — the mapper enforces unit vectors for the latter), any doc
+OUTSIDE the cached window scores at most `floor + ||q' - q||` for the
+new query, where `floor` is the cached query's k-th exact score and
+`||q' - q|| = sqrt(2 - 2*sim)`. Serving happens only when the rescored
+k-th score clears that bound — otherwise the probe REJECTS and the
+query falls through to the full device dispatch. Unnormalized metrics
+(l2_norm, max_inner_product) admit no such bound from a cosine probe,
+so they serve only effectively-identical resends. Windows that covered
+the whole corpus (`complete`) have no "outside" and serve whenever the
+threshold matches.
+
+Invalidation is by reader identity: the store drops the ring whenever
+the field's columnar fingerprint (`fc.version`) moves — refresh,
+delete, or merge each mint a new fingerprint, so a stale ring can never
+serve rows from a superseded snapshot. Filtered queries bypass the
+cache entirely (the window is computed unfiltered).
+
+Opt-in per index: `index.knn.semantic_cache.{enabled,size,threshold}`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.ops import dispatch
+from elasticsearch_tpu.ops import similarity as sim
+from elasticsearch_tpu.quant import rescore as quant_rescore
+
+# guard slack: exact-f32 rescore vs the sqrt-derived drift bound — one
+# part in a thousand of the score scale, far below any ranking margin
+# the threshold (default 0.995) admits
+GUARD_EPS = 1e-3
+
+# "effectively identical" query drift for unnormalized metrics
+_IDENTICAL_EPS = 1e-5
+
+_MIN_SLOTS = 8
+
+
+def _probe_impl(ring, queries):
+    """Max cosine similarity of each (normalized) query against the
+    (normalized) resident ring — ONE [B, D] @ [D, S] matmul plus the
+    row-wise max/argmax. f32 accumulation: the threshold compare
+    happens at ~1e-3 granularity and bf16 products would smear it."""
+    import jax.numpy as jnp
+    sims = jnp.matmul(queries, ring.T,
+                      preferred_element_type=jnp.float32)
+    return (jnp.max(sims, axis=1),
+            jnp.argmax(sims, axis=1).astype(jnp.int32))
+
+
+def _grid_semcache(statics, sigs) -> bool:
+    """Closed grid: ring slots a power of two (fixed per cache
+    lifetime), query count on the shared bucket ladder."""
+    s_slots = sigs[0][0][0]       # ring [S, D]
+    q_count = sigs[1][0][0]       # queries [B, D]
+    return (dispatch.is_query_bucket(q_count)
+            and s_slots >= _MIN_SLOTS
+            and (s_slots & (s_slots - 1)) == 0)
+
+
+dispatch.DISPATCH.register("semcache.probe", _probe_impl,
+                           grid_check=_grid_semcache)
+
+
+def _pow2_slots(n: int) -> int:
+    p = _MIN_SLOTS
+    while p < n:
+        p *= 2
+    return p
+
+
+def _normalize(q: np.ndarray) -> np.ndarray:
+    q = np.asarray(q, dtype=np.float32).reshape(-1)
+    return q / max(float(np.linalg.norm(q)), 1e-30)
+
+
+def gather_exact_rows(fc, rows: np.ndarray) -> Optional[np.ndarray]:
+    """Exact f32 vectors for engine GLOBAL rows, in `rows` order, via
+    whichever exact row source the field carries: the monolithic
+    columnar RowSource (rows positional in the ascending row_map), or
+    the generational corpus' per-generation sources (flat-id space).
+    None when no source can resolve every row — e.g. a board landed
+    against a superseded snapshot — so callers skip instead of caching
+    a wrong window."""
+    rows = np.asarray(rows, dtype=np.int64)
+    if rows.size == 0:
+        return np.zeros((0, fc.dims), dtype=np.float32)
+    try:
+        if fc.source is not None:
+            row_map = fc.row_map
+            pos = np.searchsorted(row_map, rows)
+            if (np.any(pos >= len(row_map))
+                    or np.any(row_map[np.minimum(pos, len(row_map) - 1)]
+                              != rows)):
+                return None
+            order = np.argsort(pos, kind="stable")
+            vecs = np.asarray(fc.source.gather(pos[order]),
+                              dtype=np.float32)
+        elif fc.gens is not None:
+            snap = fc.gens.snapshot()
+            flat = np.full(rows.shape, -1, dtype=np.int64)
+            for gen, off in zip(snap.generations, snap.offsets[:-1]):
+                rm = gen.row_map
+                if len(rm) == 0:
+                    continue
+                p = np.searchsorted(rm, rows)
+                ok = ((p < len(rm))
+                      & (rm[np.minimum(p, len(rm) - 1)] == rows)
+                      & (flat < 0))
+                flat[ok] = int(off) + p[ok]
+            if np.any(flat < 0):
+                return None
+            order = np.argsort(flat, kind="stable")
+            vecs = np.asarray(snap.gather_rows(flat[order]),
+                              dtype=np.float32)
+        else:
+            return None
+    except (ValueError, IndexError, AttributeError):
+        return None
+    if vecs.shape[0] != rows.size:
+        return None
+    inv = np.empty_like(order)
+    inv[order] = np.arange(order.size)
+    return vecs[inv]                             # back to board order
+
+
+class SemanticCache:
+    """Ring of recent query embeddings + their exact top-k windows for
+    ONE (field, reader-fingerprint) pair. Thread-safe; the store holds
+    one per field and replaces it when the fingerprint moves."""
+
+    def __init__(self, size: int, threshold: float, dims: int,
+                 metric: str, version: tuple):
+        self.slots = _pow2_slots(max(int(size), 1))
+        self.threshold = float(threshold)
+        self.dims = int(dims)
+        self.metric = metric
+        self.version = version    # reader fingerprint this ring serves
+        # probe side: normalized embeddings, padded rows stay zero
+        # (cosine vs a zero row is 0, below any sane threshold)
+        self._ring = np.zeros((self.slots, self.dims), dtype=np.float32)
+        self._entries: List[Optional[dict]] = [None] * self.slots
+        self._next = 0            # round-robin insertion cursor
+        self._device_ring = None  # lazily uploaded; dropped on insert
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ probe
+    def probe(self, requests, k: int, precision: str,
+              num_candidates) -> Tuple[Dict[int, tuple], dict]:
+        """Probe one coalesced batch of (query_vector, filter_rows)
+        requests. Returns (served, stats): `served` maps request index
+        -> (global_rows, raw_scores) for guard-approved hits; `stats`
+        counts {"probed", "hits", "rejects", "nanos"}. Filtered
+        requests and empty rings are never probed."""
+        stats = {"probed": 0, "hits": 0, "rejects": 0, "nanos": 0}
+        served: Dict[int, tuple] = {}
+        eligible = [i for i, (q, fr) in enumerate(requests) if fr is None]
+        if not eligible:
+            return served, stats
+        with self._lock:
+            if not any(e is not None for e in self._entries):
+                return served, stats
+            import jax.numpy as jnp
+            if self._device_ring is None:
+                self._device_ring = jnp.asarray(self._ring)
+            ring_dev = self._device_ring
+            # snapshot entries under the lock; the guard below runs
+            # lock-free on the immutable entry dicts
+            entries = list(self._entries)
+        t0 = time.monotonic_ns()
+        n = len(eligible)
+        qs = np.zeros((dispatch.bucket_queries(n), self.dims),
+                      dtype=np.float32)
+        for row, i in enumerate(eligible):
+            qs[row] = _normalize(requests[i][0])
+        best_sim, best_idx = dispatch.call(
+            "semcache.probe", ring_dev, jnp.asarray(qs))
+        # one bulk sync of the tiny [B] verdict boards
+        best_sim = np.asarray(best_sim)[:n]
+        best_idx = np.asarray(best_idx)[:n]
+        stats["probed"] = n
+        for row, i in enumerate(eligible):
+            s = float(best_sim[row])
+            if s < self.threshold:
+                continue
+            entry = entries[int(best_idx[row])]
+            res = self._try_serve(entry, requests[i][0], k, precision,
+                                  num_candidates, s)
+            if res is not None:
+                served[i] = res
+                stats["hits"] += 1
+            else:
+                stats["rejects"] += 1
+        stats["nanos"] = time.monotonic_ns() - t0
+        return served, stats
+
+    def _try_serve(self, entry: Optional[dict], query: np.ndarray,
+                   k: int, precision: str, num_candidates,
+                   probe_sim: float) -> Optional[tuple]:
+        """Exact-f32 rescore of the cached window for the NEW query +
+        the dominance guard. None = reject (fall through to device)."""
+        if entry is None:
+            return None
+        if (entry["k"] < k or entry["precision"] != precision
+                or entry["num_candidates"] != num_candidates):
+            return None
+        q = np.asarray(query, dtype=np.float32).reshape(-1)
+        if q.shape[0] != self.dims:
+            return None
+        w = entry["rows"].shape[0]
+        if w == 0:
+            # complete-and-empty window: the snapshot genuinely had no
+            # rows to return
+            return ((np.zeros(0, dtype=np.int64),
+                     np.zeros(0, dtype=np.float32))
+                    if entry["complete"] else None)
+        exact = quant_rescore.exact_scores(
+            q[None, :], entry["vecs"][None], self.metric)[0]
+        order = np.argsort(-exact, kind="stable")[:k]
+        if not entry["complete"]:
+            if self.metric in (sim.COSINE, sim.DOT_PRODUCT):
+                margin = float(np.sqrt(max(0.0, 2.0 - 2.0 * probe_sim)))
+            else:
+                # cosine probe bounds nothing for l2/mip: only serve
+                # effectively-identical resends
+                if float(np.linalg.norm(q - entry["query"])) > _IDENTICAL_EPS:
+                    return None
+                margin = 0.0
+            kth = float(exact[order[-1]])
+            floor = float(entry["floor"])
+            if kth < floor + margin - GUARD_EPS:
+                return None
+        return (entry["rows"][order].astype(np.int64),
+                exact[order].astype(np.float32))
+
+    # ----------------------------------------------------------- insert
+    def insert_many(self, requests, results, fc, k: int, precision: str,
+                    num_candidates) -> int:
+        """Record freshly computed (query, top-k) pairs. `results` are
+        the landed (global_rows, raw_scores) boards for `requests`
+        (parallel lists). The window's exact f32 vectors are gathered
+        once HERE through the columnar RowSource (or the generational
+        corpus' per-generation sources) and its scores recomputed
+        exactly — the floor the serve-time guard compares against must
+        be exact, not coarse. Returns inserts done."""
+        inserted = 0
+        for (query, filter_rows), res in zip(requests, results):
+            if filter_rows is not None or res is None:
+                continue
+            rows = np.asarray(res[0], dtype=np.int64)
+            vecs = gather_exact_rows(fc, rows)
+            if vecs is None:
+                # the board and this snapshot disagree (or no exact row
+                # source exists) — skip rather than cache a wrong window
+                continue
+            q = np.asarray(query, dtype=np.float32).reshape(-1)
+            if rows.size:
+                exact = quant_rescore.exact_scores(
+                    q[None, :], vecs[None], self.metric)[0]
+            else:
+                exact = np.zeros(0, dtype=np.float32)
+            # fewer rows than asked = the window IS the corpus: no doc
+            # exists outside it, the dominance floor vanishes
+            complete = rows.size < k
+            entry = {
+                "query": q,
+                "rows": rows,
+                "vecs": vecs,
+                "floor": (float(exact.min()) if exact.size else -np.inf),
+                "complete": bool(complete),
+                "k": int(k),
+                "precision": precision,
+                "num_candidates": num_candidates,
+            }
+            with self._lock:
+                slot = self._next
+                self._next = (self._next + 1) % self.slots
+                self._entries[slot] = entry
+                self._ring[slot] = _normalize(q)
+                self._device_ring = None         # re-upload lazily
+            inserted += 1
+        return inserted
+
+    # ------------------------------------------------------------ intro
+    def memory_size_in_bytes(self) -> int:
+        with self._lock:
+            total = int(self._ring.nbytes)
+            for e in self._entries:
+                if e is None:
+                    continue
+                total += int(e["query"].nbytes + e["rows"].nbytes
+                             + e["vecs"].nbytes) + 64
+            return total
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries if e is not None)

@@ -188,7 +188,10 @@ class VectorStoreShard:
                  segments_tier_size: int = 4,
                  segments_max_l0: int = 8,
                  segments_merge_budget_ms: float = 50.0,
-                 segments_background_merge: bool = True):
+                 segments_background_merge: bool = True,
+                 semantic_cache_enabled: bool = False,
+                 semantic_cache_size: int = 128,
+                 semantic_cache_threshold: float = 0.995):
         self.dtype = dtype
         self.host_mirror_max_bytes = host_mirror_max_bytes
         self.knn_engine = knn_engine        # "tpu" (exhaustive) | "tpu_ivf"
@@ -241,6 +244,14 @@ class VectorStoreShard:
         # per-field quantization-ladder plan (`_encoding_plan`): target
         # encoding + two-phase rescore windows, refreshed every sync
         self._field_plans: Dict[str, dict] = {}
+        # device-resident semantic cache (vectors/semantic_cache.py):
+        # opt-in ring of recent query embeddings per field, probed with
+        # one batched matmul before the full dispatch; invalidated by
+        # the field's reader fingerprint (fc.version)
+        self.semantic_cache_enabled = semantic_cache_enabled
+        self.semantic_cache_size = semantic_cache_size
+        self.semantic_cache_threshold = semantic_cache_threshold
+        self._sem_caches: Dict[str, object] = {}
         self._fields: Dict[str, FieldCorpus] = {}
         self._batchers: Dict[tuple, CombiningBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -261,7 +272,10 @@ class VectorStoreShard:
             "mesh_searches": 0, "fused_probe_searches": 0,
             "rescore_searches": 0, "rescore_window_rows": 0,
             "rescore_promoted": 0, "rescore_nanos": 0,
-            "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0}
+            "route_nanos": 0, "score_nanos": 0, "merge_nanos": 0,
+            "semantic_probes": 0, "semantic_hits": 0,
+            "semantic_rejects": 0, "semantic_inserts": 0,
+            "semantic_invalidations": 0, "semantic_probe_nanos": 0}
         self.last_knn_phases: dict = {}
 
     def _field_engine(self, mapper: DenseVectorFieldMapper) -> str:
@@ -859,15 +873,16 @@ class VectorStoreShard:
             batcher = self._batchers.get(key)
             if batcher is None:
                 def execute(reqs, fc=fc, k=k, precision=precision,
-                            num_candidates=num_candidates):
+                            num_candidates=num_candidates, field=field):
                     return self._execute_batch(fc, k, precision, reqs,
-                                               num_candidates=num_candidates)
+                                               num_candidates=num_candidates,
+                                               field=field)
 
                 def dispatch_fn(reqs, fc=fc, k=k, precision=precision,
-                                num_candidates=num_candidates):
+                                num_candidates=num_candidates, field=field):
                     return self._dispatch_many(
                         fc, k, precision, reqs,
-                        num_candidates=num_candidates)
+                        num_candidates=num_candidates, field=field)
 
                 # pipelined: the runner holds the batch lock only for the
                 # un-synced device dispatch; the d2h sync + row-map join
@@ -921,7 +936,8 @@ class VectorStoreShard:
         reqs = [(np.asarray(q, dtype=np.float32), fr)
                 for q, fr in requests]
         return self._dispatch_many(fc, k, precision, reqs,
-                                   num_candidates=num_candidates)
+                                   num_candidates=num_candidates,
+                                   field=field)
 
     def finalize_many(self, handle) -> list:
         """Land the results of a `search_many_async` handle: one bulk
@@ -931,6 +947,22 @@ class VectorStoreShard:
         kind, payload, *rest = handle
         if kind == "done":
             return payload
+        if kind == "sem":
+            # semantic-cache wrapper: land the miss dispatch, feed the
+            # fresh boards back into the ring, splice served + computed
+            # results back into request order
+            (sem, inner, served, miss_idx, miss_reqs, fc,
+             k, precision, num_candidates) = payload
+            miss_results = self.finalize_many(inner)
+            self.knn_stats["semantic_inserts"] += sem.insert_many(
+                miss_reqs, miss_results, fc, k, precision,
+                num_candidates)
+            out = [None] * (len(miss_idx) + len(served))
+            for pos, i in enumerate(miss_idx):
+                out[i] = miss_results[pos]
+            for i, res in served.items():
+                out[i] = res
+            return out
         try:
             if kind == "mesh":
                 return self._finalize_mesh(payload)
@@ -952,22 +984,82 @@ class VectorStoreShard:
                 slot.release()
 
     def _execute_batch(self, fc: FieldCorpus, k: int, precision: str,
-                       requests, num_candidates: Optional[int] = None
-                       ) -> list:
+                       requests, num_candidates: Optional[int] = None,
+                       field: Optional[str] = None) -> list:
         """Serve one coalesced batch of (query_vector, filter_rows)
         synchronously (dispatch + finalize back to back — the combining
         batcher's serial-retry path and the non-pipelined callers)."""
         return self.finalize_many(
             self._dispatch_many(fc, k, precision, requests,
-                                num_candidates=num_candidates))
+                                num_candidates=num_candidates,
+                                field=field))
+
+    def _semantic_cache_for(self, field: Optional[str], fc: FieldCorpus):
+        """The field's live SemanticCache, or None (feature off, no
+        field identity, or no columnar source to gather exact windows
+        through). A ring keyed to a superseded reader fingerprint is
+        DROPPED here — refresh/delete/merge each mint a new fc.version,
+        so stale entries can never serve rows from an old snapshot."""
+        if not self.semantic_cache_enabled or field is None:
+            return None
+        if fc.source is None and fc.gens is None:
+            # no exact row source to build guard windows through
+            return None
+        from elasticsearch_tpu.vectors import semantic_cache as _semc
+        cur = self._sem_caches.get(field)
+        if cur is not None and cur.version != fc.version:
+            self.knn_stats["semantic_invalidations"] += 1
+            cur = None
+        if cur is None:
+            cur = _semc.SemanticCache(
+                self.semantic_cache_size, self.semantic_cache_threshold,
+                fc.dims, fc.metric, fc.version)
+            self._sem_caches[field] = cur
+        return cur
 
     def _dispatch_many(self, fc: FieldCorpus, k: int, precision: str,
-                       requests, num_candidates: Optional[int] = None):
-        """Dispatch stage of one coalesced batch: route, build masks, and
-        LAUNCH the device program. The exhaustive device paths (single-
-        device AND mesh) return un-synced arrays in the handle;
-        host/IVF routes complete here (they are host-side or sync
-        internally). Tracks the in-flight gauge the dp router reads."""
+                       requests, num_candidates: Optional[int] = None,
+                       field: Optional[str] = None):
+        """Dispatch stage of one coalesced batch, fronted by the
+        semantic cache when the index opted in: probe the device ring
+        first, dispatch only the misses, and hand `finalize_many` a
+        handle that splices served and computed boards back into
+        request order (and feeds the misses back into the ring)."""
+        sem = (self._semantic_cache_for(field, fc) if requests else None)
+        served = {}
+        if sem is not None:
+            served, pstats = sem.probe(requests, k, precision,
+                                       num_candidates)
+            st = self.knn_stats
+            st["semantic_probes"] += pstats["probed"]
+            st["semantic_hits"] += pstats["hits"]
+            st["semantic_rejects"] += pstats["rejects"]
+            st["semantic_probe_nanos"] += pstats["nanos"]
+            if len(served) == len(requests):
+                # whole batch served from the ring: no device dispatch
+                self.last_knn_phases = {
+                    "engine": "semantic_cache", "queries": len(requests),
+                    "k": int(k)}
+                return ("done",
+                        [served[i] for i in range(len(requests))])
+        miss_idx = [i for i in range(len(requests)) if i not in served]
+        miss_reqs = ([requests[i] for i in miss_idx] if served
+                     else requests)
+        inner = self._dispatch_many_inner(
+            fc, k, precision, miss_reqs, num_candidates=num_candidates)
+        if sem is None:
+            return inner
+        return ("sem", (sem, inner, served, miss_idx, miss_reqs, fc,
+                        k, precision, num_candidates))
+
+    def _dispatch_many_inner(self, fc: FieldCorpus, k: int,
+                             precision: str, requests,
+                             num_candidates: Optional[int] = None):
+        """Route, build masks, and LAUNCH the device program. The
+        exhaustive device paths (single-device AND mesh) return
+        un-synced arrays in the handle; host/IVF routes complete here
+        (they are host-side or sync internally). Tracks the in-flight
+        gauge the dp router reads."""
         others = self._begin_dispatch()
         slot = _InflightSlot(self)
         try:

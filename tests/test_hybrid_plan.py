@@ -220,7 +220,10 @@ class TestPlanCache:
                 "knn": {"field": "v",
                         "query_vector": rng.standard_normal(8).tolist(),
                         "k": 20},
-                "size": 5}
+                "size": 5,
+                # the shard request cache would serve the repeat before
+                # the planner runs; this test is about the PLAN cache
+                "request_cache": False}
         misses0 = ex.stats["plan_cache_misses"]
         hits0 = ex.stats["plan_cache_hits"]
         r1 = n.search("h", dict(body))

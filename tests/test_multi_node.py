@@ -653,13 +653,31 @@ def test_can_match_prefilter_skips_shards(cluster):
     assert all(1000 <= h["_source"]["n"] < 2000
                for h in resp["hits"]["hits"])
 
-    # without the pre-filter param the same search returns the same hits,
-    # no skipping (threshold defaults to 128)
+    # without the param, range queries prefilter by DEFAULT (the
+    # reference's default-on-range behavior): same hits, other shards
+    # still skipped
     resp2 = c.call(c.any_node().client_search, "pref",
                    {"query": {"range": {"n": {"gte": 1000, "lt": 2000}}},
                     "size": 30})
-    assert resp2["_shards"]["skipped"] == 0
+    assert resp2["_shards"]["skipped"] == 2, resp2["_shards"]
     assert resp2["hits"]["total"]["value"] == per_shard[1]
+
+    # an EXPLICIT pre_filter_shard_size above the fan-out width disables
+    # the auto-range round: no skipping, same hits
+    resp3 = c.call(c.any_node().client_search, "pref",
+                   {"query": {"range": {"n": {"gte": 1000, "lt": 2000}}},
+                    "size": 30, "pre_filter_shard_size": 128})
+    assert resp3["_shards"]["skipped"] == 0
+    assert resp3["hits"]["total"]["value"] == per_shard[1]
+
+    # pruning yield lands in the coordinator's fan-out phase counters
+    pc = c.any_node().fanout_stats.phases.get("can_match", {})
+    assert pc.get("skipped_shards", 0) >= 4, pc
+
+    # non-range queries below the threshold keep the single-round path
+    resp4 = c.call(c.any_node().client_search, "pref",
+                   {"query": {"match_all": {}}, "size": 0})
+    assert resp4["_shards"]["skipped"] == 0
 
 
 def test_request_cache_serves_agg_search(cluster):

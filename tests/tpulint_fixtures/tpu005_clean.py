@@ -14,3 +14,20 @@ def plan_for(body, compile_plan):
         plan = compile_plan(body)
         _plan_cache[key] = plan
     return plan
+
+
+_request_cache = {}
+
+
+def request_cache_key(plan_key, scrubbed, fingerprint):
+    return (plan_key, scrubbed, fingerprint)
+
+
+def shard_search(plan_key, scrubbed, reader, run_query):
+    # reader fingerprint in the key: refresh/delete/merge invalidate
+    cached = _request_cache.get(
+        request_cache_key(plan_key, scrubbed, fingerprint=reader.gen))
+    if cached is None:
+        cached = run_query()
+        _request_cache[(plan_key, scrubbed, reader.gen)] = cached
+    return cached

@@ -11,3 +11,16 @@ def plan_for(body, compile_plan):
         plan = compile_plan(body)
         _plan_cache[json.dumps(body, sort_keys=True)] = plan  # [expect]
     return plan, key
+
+
+_request_cache = {}
+
+
+def shard_search(plan_key, scrubbed, run_query):
+    # device-path request cache keyed on the (scrubbed) body alone: no
+    # reader fingerprint, so a refresh never invalidates
+    cached = _request_cache.get((plan_key, scrubbed))  # [expect]
+    if cached is None:
+        cached = run_query()
+        _request_cache[(plan_key, scrubbed)] = cached  # [expect]
+    return cached

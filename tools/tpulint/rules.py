@@ -413,6 +413,12 @@ _REQUEST_NAMES = frozenset(
      "aggs_spec", "query"})
 _SANCTIONED_WRAPPER = re.compile(r"key|normali[sz]e|scrub|fingerprint",
                                  re.IGNORECASE)
+# reader-identity evidence inside a request-cache key expression: a
+# fingerprint/epoch-named value, a reader generation, or a call to the
+# sanctioned `search/caches.request_cache_key` helper (which REQUIRES
+# the fingerprint argument)
+_READER_IDENTITY = re.compile(r"fingerprint|reader_gen|epoch"
+                              r"|request_cache_key", re.IGNORECASE)
 
 
 class UnscrubbedCacheKeyRule(Rule):
@@ -428,6 +434,17 @@ class UnscrubbedCacheKeyRule(Rule):
     whose key expression touches a request-payload name (`body`,
     `request`, `aggs_spec`, ...) without passing it through a
     key/normalize/scrub/fingerprint-named function rebuilds that bug.
+
+    Second check (PR 16): REQUEST caches on the device read paths must
+    key on reader identity. A request-cache access whose key is built
+    INLINE (a tuple or call right in the get/put) with no reader
+    fingerprint / reader gen / epoch in it — and no call to the
+    sanctioned `search/caches.request_cache_key` helper, which requires
+    the fingerprint argument — caches query-phase results across
+    refreshes: stale hits after every ingest/delete/merge. Keys bound
+    to a variable first are out of scope (provenance unknowable
+    intra-module); the inline form is the one that reads plausibly
+    correct in review and isn't.
     """
 
     rule_id = "TPU005"
@@ -438,15 +455,18 @@ class UnscrubbedCacheKeyRule(Rule):
         for node in ast.walk(ctx.tree):
             key_expr = None
             where = None
+            target = ""
             if isinstance(node, ast.Subscript) \
                     and "cache" in (dotted(node.value) or "").lower():
                 key_expr, where = node.slice, "subscript"
+                target = (dotted(node.value) or "").lower()
             elif isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Attribute) \
                     and node.func.attr in ("get", "put", "setdefault") \
                     and node.args \
                     and "cache" in dotted(node.func.value).lower():
                 key_expr, where = node.args[0], f".{node.func.attr}()"
+                target = dotted(node.func.value).lower()
             if key_expr is None:
                 continue
             name = self._raw_payload_name(ctx, key_expr)
@@ -458,7 +478,36 @@ class UnscrubbedCacheKeyRule(Rule):
                     "in the key defeat the cache and leak payload data "
                     "into key storage; scrub through a plan_cache_key-"
                     "style normalizer first"))
+            elif "request" in target \
+                    and isinstance(key_expr, (ast.Tuple, ast.Call)) \
+                    and not self._has_reader_identity(key_expr):
+                findings.append(ctx.finding(
+                    self.rule_id, key_expr,
+                    f"request cache {where} keyed without a reader "
+                    "fingerprint — a key that ignores reader identity "
+                    "serves stale query-phase results across refresh/"
+                    "delete/merge; build the key with search/caches."
+                    "request_cache_key (fingerprint required) or "
+                    "include the reader fingerprint/gen explicitly"))
         return findings
+
+    @staticmethod
+    def _has_reader_identity(key_expr: ast.AST) -> bool:
+        for node in ast.walk(key_expr):
+            if isinstance(node, ast.Name) \
+                    and _READER_IDENTITY.search(node.id):
+                return True
+            if isinstance(node, ast.Attribute) \
+                    and (node.attr == "gen"
+                         or _READER_IDENTITY.search(node.attr)):
+                return True
+            if isinstance(node, ast.keyword) and node.arg \
+                    and _READER_IDENTITY.search(node.arg):
+                return True
+            if isinstance(node, ast.Call) \
+                    and _READER_IDENTITY.search(call_name(node)):
+                return True
+        return False
 
     @staticmethod
     def _raw_payload_name(ctx: ModuleContext,
