@@ -2386,6 +2386,266 @@ def run_fanout_node_kill(pre_ms: int = 4_000, post_ms: int = 12_000,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_kill_and_replace(pre_ms: int = 4_000, green_max_ms: int = 120_000,
+                         settle_ms: int = 4_000, n_docs: int = 96,
+                         n_clients: int = 3):
+    """Config 14: kill a copy-holding node mid-closed-loop, join a FRESH
+    node, and measure the durable-elasticity contract (ISSUE 17): how
+    long until the cluster is green again, how deep the completeness dip
+    goes and that it recovers to 1.0, that the replacement copy is built
+    from shipped blocks rather than re-ingest (`segment_counters`
+    full-rebuilds stay flat everywhere, `gate_no_reingest`), and that a
+    pinned knn query serves byte-identical results after recovery.
+
+    Same virtual-time regime as config 10 (seeded 1-50ms transport hops,
+    `virtual_time: true`): the row measures recovery orchestration —
+    block manifest diff, chunked block transfer, translog tail replay,
+    warm finalize — not kernel throughput.
+
+    Gates:
+      gate_time_to_green      kill -> every copy STARTED on live nodes
+                              within `green_max_ms` virtual ms
+      gate_completeness_dips  the kill was actually felt: at least one
+                              post-kill window saw partial coverage
+      gate_completeness_recovers  the final window serves full coverage
+      gate_no_reingest        full_rebuilds delta == 0 on survivors AND
+                              the replacement (blocks, not re-encode)
+      gate_blocks_shipped     the replacement's recovery shipped > 0
+                              blocks (the block path ran, ops-only
+                              replay of a flushed shard is impossible)
+      gate_byte_identical     the pinned knn query returns identical
+                              (id, score) lists before and after
+    """
+    import os as _os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+    from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport)
+    from elasticsearch_tpu.testing.faults import FaultInjectingTransport
+
+    dims = 16
+    queue = DeterministicTaskQueue(seed=37)
+    faults = FaultInjectingTransport(DisruptableTransport(queue),
+                                     scheduler=queue)
+    tmp = tempfile.mkdtemp()
+    ids = ["n0", "n1", "n2"]
+    initial = bootstrap_state(ids)
+    saved_repl = ClusterNode._REPLICATION_BUDGET_MS
+    ClusterNode._REPLICATION_BUDGET_MS = 3_000
+    nodes = {nid: ClusterNode(nid, _os.path.join(tmp, nid), faults, queue,
+                              [p for p in ids if p != nid], initial)
+             for nid in ids}
+
+    def vec(i):
+        rng = np.random.default_rng(5000 + i)
+        x = rng.standard_normal(dims)
+        return [float(f) for f in x / np.linalg.norm(x)]
+
+    try:
+        for n in nodes.values():
+            n.start()
+        for _ in range(600):
+            queue.run_for(200)
+            masters = [n for n in nodes.values() if n.is_master]
+            if masters and len(masters[0].cluster_state.nodes) == 3:
+                break
+        coord = nodes["n0"]
+
+        def call(fn, *args, **kw):
+            box = {}
+            fn(*args, **kw, on_done=lambda r: box.update(r=r))
+            for _ in range(600):
+                queue.run_for(200)
+                if "r" in box:
+                    return box["r"]
+            raise RuntimeError(f"no response from {fn.__name__}")
+
+        # 1 shard x 2 replicas on 3 nodes: every node holds a copy, so
+        # once one dies the joining FRESH node is the only legal home
+        # for the replacement — the bench measures ITS block recovery,
+        # not a spare survivor's
+        call(coord.client_create_index, "elastic",
+             settings={"index.number_of_shards": 1,
+                       "index.number_of_replicas": 2},
+             mappings={"properties": {
+                 "n": {"type": "long"},
+                 "v": {"type": "dense_vector", "dims": dims,
+                       "index": True, "similarity": "dot_product",
+                       "index_options": {"type": "int4_flat"}}}})
+
+        def live_nodes():
+            return {nid: n for nid, n in nodes.items()
+                    if not n.coordinator.stopped}
+
+        def all_green(exclude=()):
+            rs = coord.cluster_state.shards_of("elastic")
+            return bool(rs) and all(
+                r.state == ShardRoutingEntry.STARTED
+                and r.node_id not in exclude for r in rs)
+
+        for _ in range(600):
+            queue.run_for(200)
+            if all_green():
+                break
+        # tight fanout budgets (config-10 regime): a dead copy shows as
+        # a bounded timed-out partial, so the completeness dip is
+        # visible instead of queries stalling on the victim
+        call(coord.client_update_settings,
+             {"search.fanout.query_budget_ms": 400,
+              "search.fanout.fetch_budget_ms": 400,
+              "search.fanout.deadline_grace_ms": 100})
+        for i in range(n_docs):
+            call(coord.client_write, "elastic",
+                 {"type": "index", "id": f"d{i}",
+                  "source": {"n": i, "v": vec(i)}})
+        call(coord.client_refresh, "elastic")
+
+        # flush every copy: the translog trims, so the replacement can
+        # ONLY bootstrap through the block manifest path
+        for n in live_nodes().values():
+            sh = n.local_shards.get(("elastic", 0))
+            if sh is not None:
+                sh.engine.flush()
+
+        # pinned identity query, captured before the kill
+        knn_body = {"knn": {"field": "v", "query_vector": vec(9999),
+                            "k": 5, "num_candidates": n_docs}, "size": 5}
+        pre_hits = [(h["_id"], h["_score"]) for h in
+                    call(coord.client_search, "elastic", dict(knn_body))
+                    ["hits"]["hits"]]
+
+        rebuilds_pre = {
+            nid: n.local_shards[("elastic", 0)].vector_store
+            .segment_counters["full_rebuilds"]
+            for nid, n in live_nodes().items()
+            if ("elastic", 0) in n.local_shards}
+
+        # closed-loop clients: coverage tracking through the disruption
+        records = []  # (t_done_ms, ok_shards, total_shards, err)
+
+        def issue(client_id):
+            def done(resp):
+                sh = resp.get("_shards") or {}
+                records.append((queue.now_ms, sh.get("successful", 0),
+                                sh.get("total", 1), "error" in resp))
+                queue.schedule_in(10, lambda: issue(client_id),
+                                  f"bench_client:{client_id}")
+
+            coord.client_search("elastic",
+                                {"query": {"match_all": {}}, "size": 5},
+                                done)
+
+        for ci in range(n_clients):
+            issue(ci)
+        queue.run_for(pre_ms)
+
+        # victim: a copy holder that is neither master nor coordinator
+        master_id = next(n.node_id for n in nodes.values() if n.is_master)
+        holders = {r.node_id for r in
+                   coord.cluster_state.shards_of("elastic") if r.node_id}
+        victim = next(nid for nid in sorted(holders)
+                      if nid not in (coord.node_id, master_id))
+        kill_at = queue.now_ms
+        # rank the victim first in adaptive replica selection so the
+        # kill hits copies that are actually serving (config-10 idiom)
+        getattr(coord, "_ars_ewma", {}).pop(victim, None)
+        faults.kill_node(victim)
+        nodes[victim].stop()
+
+        # the REPLACEMENT: a brand-new empty node joins the cluster
+        fresh = ClusterNode("n9", _os.path.join(tmp, "n9"), faults, queue,
+                            [nid for nid in live_nodes()],
+                            coord.cluster_state)
+        nodes["n9"] = fresh
+        fresh.start()
+
+        green_at = None
+        while queue.now_ms - kill_at < green_max_ms:
+            queue.run_for(200)
+            if all_green(exclude={victim}):
+                green_at = queue.now_ms
+                break
+        time_to_green = (green_at - kill_at) if green_at else None
+        queue.run_for(settle_ms)  # post-green settle window
+
+        post = [r for r in records if r[0] > kill_at]
+        completeness = [r[1] / max(r[2], 1) for r in post]
+        final_window = [r[1] / max(r[2], 1) for r in post
+                        if r[0] > queue.now_ms - 2_000]
+        errors = sum(1 for r in records if r[3])
+
+        rebuilds_post = {
+            nid: n.local_shards[("elastic", 0)].vector_store
+            .segment_counters["full_rebuilds"]
+            for nid, n in live_nodes().items()
+            if ("elastic", 0) in n.local_shards}
+        survivors_flat = all(
+            rebuilds_post.get(nid, v) == v
+            for nid, v in rebuilds_pre.items() if nid != victim)
+        replacement_flat = all(
+            v == 0 for nid, v in rebuilds_post.items()
+            if nid not in rebuilds_pre)
+        rec = fresh.recovery_summary()
+
+        for n in live_nodes().values():
+            n.refresh_all()
+        post_hits = [(h["_id"], h["_score"]) for h in
+                     call(coord.client_search, "elastic", dict(knn_body))
+                     ["hits"]["hits"]]
+
+        row = {
+            "config": "14_kill_and_replace",
+            "virtual_time": True,
+            "backend": jax.devices()[0].platform,
+            "n_docs": n_docs, "dims": dims, "shards": 1, "replicas": 2,
+            "n_clients": n_clients, "victim": victim,
+            "time_to_green_ms": time_to_green,
+            "completeness_min": round(min(completeness), 3)
+            if completeness else 0.0,
+            "completeness_final_window": round(
+                sum(final_window) / len(final_window), 3)
+            if final_window else 0.0,
+            "searches_post": len(post),
+            "error_responses": errors,
+            "recovery_blocks_shipped": rec["blocks_shipped"],
+            "recovery_blocks_reused": rec["blocks_reused"],
+            "recovery_bytes_shipped": rec["bytes_shipped"],
+            "recovery_attempts": rec["attempts"],
+            "recovery_throttle_ms": rec["throttle_time_in_millis"],
+            "full_rebuilds_pre": sum(rebuilds_pre.values()),
+            "full_rebuilds_post": sum(rebuilds_post.values()),
+            "gate_time_to_green": bool(time_to_green is not None),
+            "gate_completeness_dips": bool(
+                completeness and min(completeness) < 1.0),
+            "gate_completeness_recovers": bool(
+                final_window and
+                sum(final_window) / len(final_window) >= 0.999),
+            "gate_no_reingest": bool(survivors_flat and replacement_flat),
+            "gate_blocks_shipped": bool(rec["blocks_shipped"] > 0),
+            "gate_byte_identical": bool(post_hits == pre_hits),
+        }
+        row["gate_durable_elasticity"] = bool(
+            row["gate_time_to_green"] and row["gate_completeness_recovers"]
+            and row["gate_no_reingest"] and row["gate_blocks_shipped"]
+            and row["gate_byte_identical"])
+        print(json.dumps(row), flush=True)
+    finally:
+        ClusterNode._REPLICATION_BUDGET_MS = saved_repl
+        for n in nodes.values():
+            try:
+                if not n.coordinator.stopped:
+                    n.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_rest_closed_loop_dp():
     """PR 11 leftover (b): the REST closed-loop rows (`1cl`/`4cl`,
     hybrid) served dp=1 shapes — point their corpora at a dp mesh
@@ -2465,6 +2725,7 @@ def main():
     guarded(run_rest_closed_loop_dp)
     guarded(run_telemetry_overhead)
     guarded(run_fanout_node_kill)
+    guarded(run_kill_and_replace)
     guarded(run_config, "1_cosine_sift1m", 1_000_000, 128, "cosine",
             "bf16")
     guarded(run_config, "2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16")

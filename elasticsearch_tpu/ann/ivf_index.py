@@ -284,6 +284,85 @@ class IVFIndex:
         return self._device_sharded.setdefault(mesh, sharded)
 
 
+def export_layout(index: IVFIndex) -> dict:
+    """The trained layout as a corpus-independent dict: centroids +
+    shape + counters, NOT the bucket mirrors (those are corpus-sized
+    and reconstruct deterministically by re-placing the rows). This is
+    the IVF block durable elasticity snapshots — restore re-places
+    instead of re-training k-means."""
+    return {
+        "nlist": int(index.nlist), "cap": int(index.cap),
+        "dims": int(index.dims), "metric": index.metric,
+        "dtype": index.dtype,
+        "retrain_threshold": float(index.retrain_threshold),
+        "trained_on": int(index.trained_on),
+        # already routing-normalized at train time
+        "centroids": np.asarray(index.centroids, dtype=np.float32).copy(),
+    }
+
+
+def layout_compatible(layout: dict, n: int, dims: int, metric: str,
+                      dtype: str) -> bool:
+    """Can a restored layout serve `n` rows of this field without an
+    immediate retrain? Mirrors `needs_retrain`'s growth gate plus the
+    hard capacity bound — an incompatible layout falls back to a fresh
+    `build_ivf_index` (counted as a train, which is the point of the
+    check: never serve from a layout that would spill)."""
+    try:
+        trained_on = int(layout.get("trained_on", 0))
+        return (int(layout["dims"]) == int(dims)
+                and layout["metric"] == metric
+                and layout["dtype"] == dtype
+                and n <= int(layout["nlist"]) * int(layout["cap"])
+                and 0 < trained_on and n <= 2 * trained_on)
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+def ivf_from_layout(layout: dict, vectors: np.ndarray,
+                    rows: Optional[np.ndarray] = None) -> IVFIndex:
+    """Rebuild an IVFIndex from an exported layout WITHOUT re-training:
+    the restored centroids route, rows re-place greedily exactly like
+    the initial build (same chunking, displacement not counted). With
+    the same vectors in the same order this reproduces the layout the
+    source trained — restored probes score the same buckets."""
+    vectors = np.asarray(vectors, dtype=np.float32)
+    n, dims = vectors.shape
+    if not layout_compatible(layout, n, dims, layout["metric"],
+                             layout["dtype"]):
+        raise ValueError("IVF layout incompatible with corpus")
+    if rows is None:
+        rows = np.arange(n, dtype=np.int32)
+    if layout["metric"] == sim.COSINE:
+        norms = np.linalg.norm(vectors, axis=-1, keepdims=True)
+        vectors = vectors / np.maximum(norms, 1e-30)
+    index = IVFIndex.__new__(IVFIndex)
+    index.metric = layout["metric"]
+    index.dtype = layout["dtype"]
+    index.dims = dims
+    index.nlist = int(layout["nlist"])
+    index.cap = int(layout["cap"])
+    index.retrain_threshold = float(layout["retrain_threshold"])
+    index.centroids = np.asarray(layout["centroids"], dtype=np.float32)
+    index.part_vecs = np.zeros((index.nlist, index.cap, dims),
+                               dtype=np.float32)
+    index.part_rows = np.full((index.nlist, index.cap), -1,
+                              dtype=np.int32)
+    index.counts = np.zeros(index.nlist, dtype=np.int64)
+    index.trained_on = 0
+    index.displaced = 0
+    index.spilled = 0
+    index._device = None
+    index._device_sharded = {}
+    rows = np.asarray(rows, dtype=np.int32)
+    chunk = 131_072
+    for lo in range(0, n, chunk):
+        index._place(vectors[lo:lo + chunk], rows[lo:lo + chunk],
+                     count_displaced=False)
+    index.trained_on = int(layout.get("trained_on") or n)
+    return index
+
+
 def pick_nlist(n: int, dims: int) -> int:
     """Default partition count: ~sqrt(n) rounded to a power of two, the
     Faiss guidance that balances route cost (nlist·D) against scored rows

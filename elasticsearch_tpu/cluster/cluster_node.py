@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster import allocation
@@ -142,6 +143,11 @@ class LocalShard:
     def _attach_engine(self, engine: Engine) -> None:
         self.engine = engine
         engine.retained_seq_no_provider = self._min_retained_seq_no
+        # restored/recovered engines carry a seed sidecar (columnar
+        # blocks + IVF layout); apply it BEFORE the first vector sync so
+        # block recovery never re-encodes or re-trains (recovery/seed.py)
+        from elasticsearch_tpu.recovery import seed as recovery_seed
+        recovery_seed.maybe_apply(engine, self.vector_store)
         engine.add_refresh_listener(self._sync_vectors)
         self._sync_vectors(engine.acquire_searcher())
 
@@ -210,6 +216,21 @@ class ClusterNode:
             ClusterSnapshotLifecycle)
         self.snapshot_lifecycle = ClusterSnapshotLifecycle(self)
         self.shard_restore_hook: Optional[Callable] = None
+        # durable elasticity (recovery/): node-local content-addressed
+        # block cache — peer recoveries diff the source manifest against
+        # it, so retries resume from the last acked block and a restored
+        # shard's blocks never re-ship
+        from elasticsearch_tpu.recovery.peer import BlockCache
+        self.block_cache = BlockCache(os.path.join(data_path, "_blocks"))
+        # per-recovery progress (allocation_id -> recovery/progress.py
+        # dict, kept after completion for `_cat/recovery`) + lifetime
+        # retry counters for `_nodes/stats indices.recovery`
+        self.recoveries: Dict[str, dict] = {}
+        self.recovery_stats = {"attempts": 0, "retries": 0,
+                               "giveups": 0, "completed": 0}
+        self._recovery_attempts: Dict[str, int] = {}
+        self._recovery_pending: Set[str] = set()
+        self._recovery_sources: Set[str] = set()
 
     # ------------------------------------------------------------------ admin
     def start(self):
@@ -639,37 +660,39 @@ class ClusterNode:
 
     def _start_replica_recovery(self, local: LocalShard, state: ClusterState) -> None:
         entry = local.routing
+        prog = self._track_recovery(local)
+        self.recovery_stats["attempts"] += 1
+        self._recovery_attempts[entry.allocation_id] = \
+            self._recovery_attempts.get(entry.allocation_id, 0) + 1
+        prog["attempts"] = self._recovery_attempts[entry.allocation_id]
         primary = state.primary_of(entry.index, entry.shard)
         if primary is None or primary.node_id is None:
-            # retry when a primary shows up
-            self.scheduler.schedule_in(500, lambda: self._retry_recovery(entry),
-                                       f"recovery_retry:{entry.allocation_id}")
+            # counting this as an attempt keeps the backoff escalating
+            # (and eventually gives up -> master reroutes) instead of
+            # polling a missing primary at the base interval forever
+            self._schedule_recovery_retry(entry, "no active primary")
             return
+        prog["source_node"] = primary.node_id
 
         def on_ops(response):
             if "phase1" in response:
-                # translog can't cover the gap: copy the primary's commit
-                # files first (RecoverySourceHandler.java:262), then re-enter
-                # ops recovery from the snapshot's checkpoint
+                # translog can't cover the gap: ship the missing blocks
+                # first (RecoverySourceHandler.java:262 phase1, at block
+                # rather than file granularity), then re-enter ops
+                # recovery from the block checkpoint
                 self._run_phase1(local, primary.node_id, response["phase1"])
                 return
+            from elasticsearch_tpu.recovery import progress as rp
+            prog["stage"] = rp.STAGE_TRANSLOG
             for op in response["ops"]:
                 self._apply_replica_op(local, op)
-            # make the replayed history searchable BEFORE reporting started:
-            # without this, a post-failover copy serves 0 docs until the next
-            # user-triggered refresh broadcast — in a read-mostly workload,
-            # forever (the ROADMAP "green but empty copy" data-loss repro;
-            # reference: IndexShard#finalizeRecovery refreshes before the
-            # shard moves to POST_RECOVERY)
-            local.engine.refresh()
-            self._send_to_master(MASTER_SHARD_STARTED,
-                                 {"allocation_id": entry.allocation_id})
+            prog["ops_replayed"] += len(response["ops"])
+            self._finalize_recovery(local, prog)
 
         def on_fail(_err):
             # primary not ready yet (e.g. promotion not applied there) or the
             # request raced a topology change: retry while still INITIALIZING
-            self.scheduler.schedule_in(1000, lambda: self._retry_recovery(entry),
-                                       f"recovery_retry:{entry.allocation_id}")
+            self._schedule_recovery_retry(entry, str(_err))
 
         self.transport.send(
             self.node_id, primary.node_id, RECOVERY_START,
@@ -679,72 +702,205 @@ class ClusterNode:
             on_response=on_ops, on_failure=on_fail)
         # dropped-message safety net: if neither response nor failure arrives
         # (partition during recovery), retry while still INITIALIZING
-        self.scheduler.schedule_in(5000, lambda: self._retry_recovery(entry),
-                                   f"recovery_timeout:{entry.allocation_id}")
+        self.scheduler.schedule_in(
+            5000, lambda: self._recovery_watchdog(entry),
+            f"recovery_timeout:{entry.allocation_id}")
+
+    def _track_recovery(self, local: LocalShard) -> dict:
+        """The progress record for one recovery target (created once per
+        allocation; retries mutate the same record)."""
+        from elasticsearch_tpu.recovery import progress as rp
+        entry = local.routing
+        prog = self.recoveries.get(entry.allocation_id)
+        if prog is None:
+            rtype = "RELOCATION" if entry.relocation_source else "PEER"
+            prog = rp.new_progress(entry.index, entry.shard,
+                                   entry.allocation_id, rtype,
+                                   target_node=self.node_id,
+                                   now_ms=int(time.time() * 1000))
+            self.recoveries[entry.allocation_id] = prog
+        return prog
+
+    def _finalize_recovery(self, local: LocalShard, prog: dict) -> None:
+        """Refresh + (for relocations) warm the device path, then report
+        started — reference: IndexShard#finalizeRecovery refreshes before
+        POST_RECOVERY, so a post-failover copy never serves 0 docs while
+        waiting for the next user refresh."""
+        from elasticsearch_tpu.recovery import progress as rp
+        entry = local.routing
+        prog["stage"] = rp.STAGE_FINALIZE
+        local.engine.refresh()
+        if entry.relocation_source is not None:
+            # live relocation: compile the dispatch grid and touch the
+            # device arrays through the real serving entry BEFORE routing
+            # flips to this copy — the first user search lands warm
+            from elasticsearch_tpu.recovery import relocation
+            prog["warm"] = relocation.warm_handoff(local)
+        prog["stage"] = rp.STAGE_DONE
+        prog["stop_ms"] = int(time.time() * 1000)
+        self.recovery_stats["completed"] += 1
+        self._recovery_attempts.pop(entry.allocation_id, None)
+        self._send_to_master(MASTER_SHARD_STARTED,
+                             {"allocation_id": entry.allocation_id})
+
+    # recovery retry policy: jittered exponential backoff, capped, with a
+    # bounded attempt count — a permanently failing copy is reported to
+    # the master (giveup -> reroute) instead of retrying at a fixed
+    # interval forever
+    _RECOVERY_RETRY_BASE_MS = 500
+    _RECOVERY_RETRY_CAP_MS = 30_000
+    _RECOVERY_MAX_ATTEMPTS = 10
+
+    def _schedule_recovery_retry(self, entry: ShardRoutingEntry,
+                                 reason: str = "") -> None:
+        alloc = entry.allocation_id
+        local = self.local_shards.get((entry.index, entry.shard))
+        if local is None or local.routing.allocation_id != alloc \
+                or local.routing.state != ShardRoutingEntry.INITIALIZING:
+            return
+        n = self._recovery_attempts.get(alloc, 0)
+        if n >= self._RECOVERY_MAX_ATTEMPTS:
+            self.recovery_stats["giveups"] += 1
+            prog = self.recoveries.get(alloc)
+            if prog is not None:
+                prog["stop_ms"] = int(time.time() * 1000)
+            self._recovery_attempts.pop(alloc, None)
+            self._send_to_master(
+                MASTER_SHARD_FAILED,
+                {"allocation_id": alloc,
+                 "reason": f"recovery gave up after {n} attempts: {reason}"})
+            return
+        if alloc in self._recovery_pending:
+            return  # a retry is already scheduled; don't stack them
+        delay = min(self._RECOVERY_RETRY_CAP_MS,
+                    self._RECOVERY_RETRY_BASE_MS << n)
+        # deterministic jitter (±25%): decorrelates a herd of replicas
+        # retrying against one reborn primary without wall clock or the
+        # process hash seed (which would break the simulator's replay)
+        span = delay // 2
+        delay = delay - span // 2 + \
+            zlib.crc32(f"{alloc}:{n}".encode()) % (span + 1)
+        self.recovery_stats["retries"] += 1
+        prog = self.recoveries.get(alloc)
+        if prog is not None:
+            prog["throttle_ms"] += delay
+        self._recovery_pending.add(alloc)
+        self.scheduler.schedule_in(delay,
+                                   lambda: self._retry_recovery(entry),
+                                   f"recovery_retry:{alloc}")
+
+    def _recovery_watchdog(self, entry: ShardRoutingEntry) -> None:
+        """Dropped-message backstop. Unlike a real retry it must not act
+        when the recovery finished or a backoff retry is already queued —
+        otherwise it would double-fire attempts and defeat the backoff."""
+        from elasticsearch_tpu.recovery import progress as rp
+        prog = self.recoveries.get(entry.allocation_id)
+        if prog is not None and prog["stage"] == rp.STAGE_DONE:
+            return
+        if entry.allocation_id in self._recovery_pending:
+            return
+        self._retry_recovery(entry)
+
+    def recovery_summary(self) -> dict:
+        """`_nodes/stats indices.recovery` section for this node."""
+        from elasticsearch_tpu.recovery import progress as rp
+        return rp.summarize(self.recoveries.values(), self.recovery_stats,
+                            current_as_source=len(self._recovery_sources))
 
     def _run_phase1(self, local: LocalShard, primary_node: str,
                     phase1: dict) -> None:
-        """Target side of the segment-file copy: pull every manifest file in
-        CRC-checked chunks into a temp dir, atomically swap the local engine
-        to the copied commit, then resume ops recovery (phase 2) from the
-        snapshot's checkpoint (PeerRecoveryTargetService analog)."""
+        """Target side of block recovery (PeerRecoveryTargetService
+        analog): diff the source's block manifest against the node block
+        cache, pull ONLY the missing blocks in CRC-framed chunks (each
+        landing in the cache as soon as it verifies — a retry after a
+        dead source resumes from the last acked block for free), then
+        assemble the shard and resume ops recovery from the block
+        checkpoint."""
         import base64
         import shutil
         import zlib as _zlib
 
+        from elasticsearch_tpu.recovery import progress as rp
+        from elasticsearch_tpu.recovery.manifest import (
+            diff_entries, manifest_totals)
+        from elasticsearch_tpu.recovery.snapshot import assemble_shard
+
         entry = local.routing
-        files = list(phase1.get("files", []))
-        tmp_dir = local.engine.path + ".phase1_tmp"
-        shutil.rmtree(tmp_dir, ignore_errors=True)
-        os.makedirs(tmp_dir, exist_ok=True)
-        state = {"file_idx": 0, "offset": 0,
-                 "handle": None, "crc": 0}
+        prog = self._track_recovery(local)
+        entries = list(phase1.get("blocks", []))
+        meta = phase1.get("meta")
+        if not entries or meta is None:
+            return self._schedule_recovery_retry(entry, "empty phase1 manifest")
+        missing, _present = diff_entries(entries, self.block_cache.held())
+        need, seen = [], set()
+        for e in missing:
+            if e["digest"] not in seen:
+                seen.add(e["digest"])
+                need.append(e)
+        totals = manifest_totals(entries)
+        prog["stage"] = rp.STAGE_BLOCKS
+        prog["blocks_total"] = totals["blocks_total"]
+        prog["bytes_total"] = totals["bytes_total"]
+        prog["blocks_reused"] = totals["blocks_total"] - len(need)
+        state = {"idx": 0, "offset": 0, "buf": []}
 
         def fail(reason):
-            shutil.rmtree(tmp_dir, ignore_errors=True)
-            self.scheduler.schedule_in(
-                1000, lambda: self._retry_recovery(entry),
-                f"recovery_retry:{entry.allocation_id}")
+            self._schedule_recovery_retry(entry, reason)
 
-        def next_chunk():
+        def next_block():
             if local.routing.allocation_id != entry.allocation_id:
-                return fail("reassigned")
-            spec = files[state["file_idx"]]
-            if state["handle"] is None:
-                state["handle"] = open(
-                    os.path.join(tmp_dir, os.path.basename(spec["name"])),
-                    "wb")
-                state["crc"] = 0
-            self.transport.send(
-                self.node_id, primary_node, RECOVERY_FILE_CHUNK,
+                return
+            if state["idx"] >= len(need):
+                return finish()
+            e = need[state["idx"]]
+            if self.block_cache.has(e["digest"]):
+                # landed via a concurrent restore or an earlier attempt
+                state["idx"] += 1
+                state["offset"] = 0
+                state["buf"] = []
+                return next_block()
+            # budgeted single-RPC (PR-12 ScatterGather): a source that
+            # dies mid-transfer resolves as a failure, never a hang
+            self._send_guarded(
+                primary_node, RECOVERY_FILE_CHUNK,
                 {"index": entry.index, "shard": entry.shard,
                  "allocation_id": entry.allocation_id,
-                 "name": spec["name"], "offset": state["offset"]},
-                on_response=on_chunk, on_failure=lambda e: fail(str(e)))
+                 "digest": e["digest"], "offset": state["offset"]},
+                on_chunk, lambda err: fail(str(err)),
+                budget_ms=self._REPLICATION_BUDGET_MS, phase="recovery")
 
         def on_chunk(resp):
-            spec = files[state["file_idx"]]
+            e = need[state["idx"]]
             data = base64.b64decode(resp["data"])
             if (_zlib.crc32(data) & 0xFFFFFFFF) != resp["crc32"]:
                 return fail("chunk crc mismatch")
-            state["handle"].write(data)
-            state["crc"] = _zlib.crc32(data, state["crc"]) & 0xFFFFFFFF
+            state["buf"].append(data)
             state["offset"] += len(data)
-            if resp.get("last") or state["offset"] >= spec["size"]:
-                state["handle"].close()
-                state["handle"] = None
-                if state["offset"] != spec["size"] or \
-                        state["crc"] != spec["crc32"]:
-                    return fail(f"file {spec['name']} failed verification")
-                state["file_idx"] += 1
+            if resp.get("last") or state["offset"] >= e["size"]:
+                blob = b"".join(state["buf"])
+                try:
+                    # content-addressed write verifies the digest; a
+                    # torn/corrupt transfer is rejected and retried
+                    self.block_cache.put(e["digest"], blob)
+                except ValueError:
+                    return fail(
+                        f"block {e['digest'][:8]} failed digest verification")
+                prog["blocks_shipped"] += 1
+                prog["bytes_shipped"] += len(blob)
+                state["idx"] += 1
                 state["offset"] = 0
-                if state["file_idx"] >= len(files):
-                    return finish()
-            next_chunk()
+                state["buf"] = []
+            next_block()
 
         def finish():
-            # swap: close the stale engine, replace the shard dir contents
-            # with the verified commit files, reopen, resume with phase 2
+            # stage every block in memory first (digest-verified reads) so
+            # the engine swap below can't strand the shard half-assembled
+            blocks = {}
+            for e in entries:
+                data = self.block_cache.get(e["digest"])
+                if data is None:
+                    return fail(f"cache lost block {e['digest'][:8]}")
+                blocks[e["digest"]] = data
             path = local.engine.path
             local.engine.close()
             for name in os.listdir(path):
@@ -753,20 +909,17 @@ class ClusterNode:
                     shutil.rmtree(full, ignore_errors=True)
                 else:
                     os.unlink(full)
-            for name in os.listdir(tmp_dir):
-                os.replace(os.path.join(tmp_dir, name),
-                           os.path.join(path, name))
-            shutil.rmtree(tmp_dir, ignore_errors=True)
+            assemble_shard(path, entries, meta, blocks.__getitem__)
             engine = Engine(path, local.mapper_service,
                             translog_sync="async")
             local.replace_engine(engine)
+            prog["stage"] = rp.STAGE_TRANSLOG
             self._start_replica_recovery(local, self.cluster_state)
 
-        if not files:
-            return fail("empty phase1 manifest")
-        next_chunk()
+        next_block()
 
     def _retry_recovery(self, entry: ShardRoutingEntry) -> None:
+        self._recovery_pending.discard(entry.allocation_id)
         local = self.local_shards.get((entry.index, entry.shard))
         if local is not None and local.routing.allocation_id == entry.allocation_id \
                 and local.routing.state == ShardRoutingEntry.INITIALIZING:
@@ -802,11 +955,14 @@ class ClusterNode:
         return os.path.join(local.engine.path, f"_recovery_{safe}")
 
     def _prepare_phase1(self, local: LocalShard, alloc: str) -> dict:
-        """Flush, snapshot the commit files, lease the history above the
-        commit (RecoverySourceHandler.java:262 phase1 + CcrRetentionLeases
-        -style lease so a concurrent flush cannot trim phase-2 ops)."""
+        """Flush, collect the shard into content-addressed blocks staged
+        under a per-recovery dir (so concurrent flushes can't mutate what
+        the target is copying), lease the history above the commit
+        (RecoverySourceHandler.java:262 phase1 + CcrRetentionLeases-style
+        lease so a concurrent flush cannot trim phase-2 ops)."""
         import shutil
-        import zlib as _zlib
+
+        from elasticsearch_tpu.recovery.snapshot import collect_shard_blocks
 
         engine = local.engine
         engine.flush()
@@ -817,48 +973,45 @@ class ClusterNode:
                                               "peer_recovery")
         except IllegalArgumentError:
             local.tracker.renew_retention_lease(lease_id, retaining)
+        entries, payloads, meta = collect_shard_blocks(
+            engine, getattr(local, "vector_store", None))
         snap_dir = self._phase1_dir(local, alloc)
         shutil.rmtree(snap_dir, ignore_errors=True)
         os.makedirs(snap_dir, exist_ok=True)
-        files = []
-        for name in ("commit.bin", "commit.json"):
-            src = os.path.join(engine.path, name)
-            if not os.path.exists(src):
-                continue
-            dst = os.path.join(snap_dir, name)
-            shutil.copyfile(src, dst)
-            with open(dst, "rb") as f:
-                data = f.read()
-            files.append({"name": name, "size": len(data),
-                          "crc32": _zlib.crc32(data) & 0xFFFFFFFF})
-        return {"files": files,
+        for digest, data in payloads.items():
+            with open(os.path.join(snap_dir, digest), "wb") as f:
+                f.write(data)
+        self._recovery_sources.add(alloc)
+        return {"blocks": entries, "meta": meta,
                 "from_seq_no": (engine.last_commit_checkpoint or -1) + 1}
 
     def _cleanup_phase1(self, local: LocalShard, alloc: str) -> None:
         import shutil
         shutil.rmtree(self._phase1_dir(local, alloc), ignore_errors=True)
+        self._recovery_sources.discard(alloc)
         try:
             local.tracker.remove_retention_lease(f"peer_recovery/{alloc}")
         except Exception:
             pass
 
     def _on_recovery_file_chunk(self, sender, request, respond):
-        """Primary side: serve one CRC-framed chunk of a snapshotted file
-        (MultiFileTransfer / RecoverySourceHandler.sendFiles analog)."""
+        """Primary side: serve one CRC-framed chunk of a staged block,
+        addressed by content digest (MultiFileTransfer /
+        RecoverySourceHandler.sendFiles analog)."""
         key = (request["index"], request["shard"])
         local = self.local_shards.get(key)
         if local is None or not local.routing.primary:
             raise SearchEngineError(f"not primary for {key}")
-        name = os.path.basename(request["name"])  # no traversal
-        path = os.path.join(self._phase1_dir(local, request["allocation_id"]),
-                            name)
+        from elasticsearch_tpu.recovery.peer import safe_digest
+        snap_dir = self._phase1_dir(local, request["allocation_id"])
+        path = os.path.join(snap_dir, safe_digest(request["digest"]))
         offset = int(request["offset"])
         with open(path, "rb") as f:
             f.seek(offset)
             data = f.read(self._RECOVERY_CHUNK)
         import base64
         import zlib as _zlib
-        respond({"name": name, "offset": offset,
+        respond({"digest": request["digest"], "offset": offset,
                  "data": base64.b64encode(data).decode("ascii"),
                  "crc32": _zlib.crc32(data) & 0xFFFFFFFF,
                  "last": offset + len(data) >= os.path.getsize(path)})

@@ -261,16 +261,23 @@ class ClusterAwareNode(Node):
             return {self.cluster.node_id: {
                 "tasks": {t.task_id: t.to_dict(self.cluster.node_id)}}}
 
+        def _stats(p):
+            st = {**self.local_node_stats(
+                p.get("level"), bool(p.get("include_segment_file_sizes"))),
+                "fanout": self.cluster.fanout_stats.snapshot()}
+            # block-level recovery progress (peer recovery, relocation,
+            # restore) replaces the single-node stub: live targets,
+            # sources serving phase 1, reused/shipped blocks, backoff
+            # throttle time and retry/giveup counters
+            st.setdefault("indices", {})["recovery"] = c.recovery_summary()
+            return st
+
         c.node_collectors.update({
             "info": lambda p: self.local_node_info(),
             # the cross-node serving path's counters ride the stats
             # section: coordinator-side per-phase fan-out tallies +
             # data-plane remote deadline sheds (serving/fanout.py)
-            "stats": lambda p: {
-                **self.local_node_stats(
-                    p.get("level"),
-                    bool(p.get("include_segment_file_sizes"))),
-                "fanout": self.cluster.fanout_stats.snapshot()},
+            "stats": _stats,
             "hot_threads": lambda p: self.local_hot_threads(
                 float(p.get("interval_s", 0.05)),
                 top_n=int(p.get("top_n", 3))),
@@ -383,23 +390,32 @@ class ClusterAwareNode(Node):
             self.thread_pool.submit, "generic")
 
         def shard_uploader(repo_name, index, shard_id):
+            from elasticsearch_tpu.recovery.snapshot import snapshot_shard
             repo = svc.get_repository(repo_name)
             shard = self.cluster.local_shards.get((index, shard_id))
             if shard is None:
                 raise ResourceNotFoundError(
                     f"shard [{index}][{shard_id}] is not allocated here")
-            shard.engine.flush()
-            files = {}
-            commit = os.path.join(shard.engine.path, "commit.bin")
-            if os.path.exists(commit):
-                files["commit.bin"] = repo.put_blob(commit)
-            return files
+            # block-level snapshot (recovery/snapshot.py): sealed
+            # segments, cached columnar blocks, the ledger and trained
+            # IVF layouts as content-addressed blobs — only blocks the
+            # repository has never seen upload
+            return snapshot_shard(repo, shard.engine,
+                                  getattr(shard, "vector_store", None))
 
         lifecycle.shard_uploader = shard_uploader
 
         def shard_restore_hook(restore, index, shard_id, path):
+            from elasticsearch_tpu.recovery.snapshot import restore_shard
             repo = svc.get_repository(restore["repo"])
             entry = restore["shards"].get(str(shard_id)) or {}
+            if "blocks" in entry:
+                # digest-verified reassembly; fetched blobs also land in
+                # the node block cache, so a later peer recovery of the
+                # same data re-ships nothing
+                restore_shard(repo, entry, path,
+                              cache=self.cluster.block_cache)
+                return
             for fname, digest in (entry.get("files") or {}).items():
                 repo.get_blob(digest, os.path.join(path, fname))
 

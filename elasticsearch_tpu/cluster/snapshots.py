@@ -51,7 +51,9 @@ class ClusterSnapshotLifecycle:
 
     Data-plane hooks (installed by the REST layer):
       repo_factory(repo_name) -> Repository
-      shard_uploader(repo_name, index, shard_id) -> {"files": {name: digest}}
+      shard_uploader(repo_name, index, shard_id) -> block shard entry
+          ({"blocks": [...], "meta": {...}, "stats": {...}} —
+          recovery/snapshot.py `snapshot_shard`)
       executor(fn) — run blob IO off the event loop
     """
 
@@ -340,10 +342,19 @@ class ClusterSnapshotLifecycle:
                     for shard_key, sh in shards.items():
                         idx, _, sid = shard_key.rpartition("#")
                         if idx == name:
-                            ientry["shards"][sid] = {
-                                "files": sh.get("files") or {},
-                                "state": sh["state"],
-                                "node": sh["node"]}
+                            payload = sh.get("files") or {}
+                            if "blocks" in payload:
+                                # block manifest (recovery/snapshot.py):
+                                # flatten to the same shard-entry shape
+                                # the single-node SnapshotService writes
+                                ientry["shards"][sid] = {
+                                    **payload, "state": sh["state"],
+                                    "node": sh["node"]}
+                            else:  # pre-block uploads: raw files by digest
+                                ientry["shards"][sid] = {
+                                    "files": payload,
+                                    "state": sh["state"],
+                                    "node": sh["node"]}
                     manifest["indices"][name] = ientry
                 repo.put_manifest(entry["snapshot"], manifest)
             finally:

@@ -83,7 +83,7 @@ class SegmentBlockStore:
         # segment (refs hash/compare by referent identity while alive)
         self._entries: Dict[weakref.ref, Dict[tuple, object]] = {}
         self._counters = {
-            "hits": 0, "extracts": 0, "evictions": 0,
+            "hits": 0, "extracts": 0, "seeds": 0, "evictions": 0,
             "extract_nanos": 0, "evicted_bytes": 0,
             # reader-wide composition classification: every block cached
             # / some extracted (the append-only refresh shape) / all
@@ -125,6 +125,38 @@ class SegmentBlockStore:
     def _count(self, field: str, kind: str, counter: str) -> None:
         self._counters[counter] += 1
         self._fields.setdefault((field, kind), _field_slot())[counter] += 1
+
+    # ---------------------------------------------------- durable blocks
+    def cached_blocks(self, seg) -> Dict[tuple, object]:
+        """Every block currently cached for one segment, keyed by the
+        store's (kind, field, ...) entry key — the recovery subsystem
+        snapshots THESE so a restored shard seeds its caches instead of
+        re-extracting/re-encoding. Absent markers are skipped (nothing
+        to ship for a field the segment does not carry)."""
+        with self._lock:
+            entry = self._entries.get(weakref.ref(seg))
+            if not entry:
+                return {}
+            return {key: blk for key, blk in entry.items()
+                    if not isinstance(blk, _Absent)}
+
+    def install(self, view, key: tuple, blk) -> bool:
+        """Install one restored block for a live SegmentView under its
+        original entry key, VERIFIED against the view: the block's
+        fingerprint must name this exact segment state (seg_id, size,
+        live count) or the install is refused — restored derived state
+        never outranks the restored source of truth. Returns True when
+        installed (counted as a `seeds`, not an extract)."""
+        seg = view.segment
+        fp = getattr(blk, "fingerprint", None)
+        if fp is None or tuple(fp[:3]) != fingerprint(view, ()):
+            return False
+        kind, field = key[0], key[1]
+        with self._lock:
+            self._count(field, kind, "seeds")
+            ref = weakref.ref(seg, self._evicted)
+            self._entries.setdefault(ref, {})[tuple(key)] = blk
+        return True
 
     def _evicted(self, ref) -> None:
         """Weakref callback: the engine dropped a segment — release its
@@ -289,6 +321,7 @@ class SegmentBlockStore:
                 "zero_copy_blocks": zero_copy,
                 "hits": self._counters["hits"],
                 "extracts": self._counters["extracts"],
+                "seeds": self._counters["seeds"],
                 "extract_nanos": self._counters["extract_nanos"],
                 "evictions": self._counters["evictions"],
                 "evicted_bytes": self._counters["evicted_bytes"],
@@ -302,13 +335,13 @@ class SegmentBlockStore:
             self._entries.clear()
             self._fields.clear()
             self._counters.update({
-                "hits": 0, "extracts": 0, "evictions": 0,
+                "hits": 0, "extracts": 0, "seeds": 0, "evictions": 0,
                 "extract_nanos": 0, "evicted_bytes": 0,
                 "compositions": {"cached": 0, "delta": 0, "full": 0}})
 
 
 def _field_slot() -> dict:
-    return {"hits": 0, "extracts": 0, "extract_nanos": 0,
+    return {"hits": 0, "extracts": 0, "seeds": 0, "extract_nanos": 0,
             "compositions": {"cached": 0, "delta": 0, "full": 0}}
 
 

@@ -130,6 +130,11 @@ class IndexShardHandle:
             **(segments_settings or {}),
             **(semantic_cache_settings or {}))
         self.mapper_service = mapper_service
+        # seed restored derived state (columnar blocks, IVF layout)
+        # BEFORE the first vector sync, so a snapshot-restored shard
+        # serves without re-encoding or re-training (recovery/seed.py)
+        from elasticsearch_tpu.recovery import seed as recovery_seed
+        recovery_seed.maybe_apply(self.engine, self.vector_store)
         self._sync_vectors(self.engine.acquire_searcher())
         self.engine.add_refresh_listener(self._sync_vectors)
 

@@ -2516,7 +2516,7 @@ class Node:
                    if include_segment_file_sizes else {})},
             "get": {"total": self.counters.get("get", 0)},
             "merges": {"total": self.counters.get("merge", 0)},
-            "recovery": {"current_as_source": 0, "current_as_target": 0},
+            "recovery": self._recovery_section(),
             "translog": {"operations": 0},
             "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
             "completion": {"size_in_bytes": 0},
@@ -2579,6 +2579,25 @@ class Node:
                 "breakers": self.breakers.stats(),
                 "thread_pool": self.thread_pool.stats(),
                 "telemetry": self._telemetry_stats_section()}
+
+    def _recovery_section(self) -> dict:
+        """`indices.recovery` for a single node: block-level restore
+        accounting folded over every index restored from a repository
+        (recovery/progress.py shape; cluster nodes report live peer
+        recoveries through the same keys via `recovery_summary`)."""
+        done = reused = shipped = bytes_shipped = 0
+        for svc in self.indices.indices.values():
+            for st in (getattr(svc, "recovery_block_stats", None)
+                       or {}).values():
+                done += 1
+                reused += int(st.get("blocks_reused", 0))
+                shipped += int(st.get("blocks_shipped", 0))
+                bytes_shipped += int(st.get("bytes_shipped", 0))
+        return {"current_as_source": 0, "current_as_target": 0,
+                "completed": done, "blocks_reused": reused,
+                "blocks_shipped": shipped, "bytes_shipped": bytes_shipped,
+                "throttle_time_in_millis": 0,
+                "attempts": 0, "retries": 0, "giveups": 0}
 
     def _device_segments_section(self) -> dict:
         """Generational device-corpus counters summed over local shards

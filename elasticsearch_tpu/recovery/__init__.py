@@ -1,0 +1,42 @@
+"""Durable elasticity: the block-level lifecycle of the device corpus.
+
+Re-design of the reference's `indices/recovery/` + `snapshots/` layer
+(PAPER.md §1, §3.5) on top of the segment subsystem this repo already
+has: sealed engine segments and per-(segment, field) columnar blocks are
+immutable and fingerprinted, so THEY are the unit of durability —
+
+- `blocks`    : deterministic block <-> bytes serialization + digests,
+                and reconstruction of the exact engine commit files;
+- `manifest`  : the per-shard block manifest (digest-addressed entries)
+                and the digest-diff that makes everything incremental;
+- `snapshot`  : collect/assemble a shard as blocks; repository snapshot
+                and restore built on the content-addressed blob store;
+- `seed`      : re-install restored columnar blocks + the trained IVF
+                layout into the live caches so a restored shard serves
+                byte-identically with ZERO re-encoding / IVF retraining;
+- `peer`      : the node-local content-addressed block cache peer
+                recovery diffs against (retry resumes from the last
+                acked block);
+- `progress`  : block-level recovery progress records + node summary
+                (`_nodes/stats indices.recovery`, `_cat/recovery`);
+- `relocation`: warm-HBM handoff — device arrays laid out and the
+                dispatch grid warmed on the target BEFORE routing flips.
+"""
+
+from elasticsearch_tpu.recovery.blocks import (  # noqa: F401
+    block_digest, dumps_block, loads_block, serialize_ledger,
+    serialize_segment, write_commit_files,
+)
+from elasticsearch_tpu.recovery.manifest import (  # noqa: F401
+    diff_entries, entry_key,
+)
+from elasticsearch_tpu.recovery.peer import BlockCache  # noqa: F401
+from elasticsearch_tpu.recovery.progress import (  # noqa: F401
+    new_progress, summarize,
+)
+from elasticsearch_tpu.recovery.seed import (  # noqa: F401
+    load_sidecar, maybe_apply, write_sidecar,
+)
+from elasticsearch_tpu.recovery.snapshot import (  # noqa: F401
+    assemble_shard, collect_shard_blocks, restore_shard, snapshot_shard,
+)

@@ -1,0 +1,47 @@
+"""The per-shard block manifest and its digest-diff.
+
+A manifest is a JSON-safe list of entries, one per block:
+
+  {"kind": "segment",   "seg_id": 3, "digest": "...", "size": 1234}
+  {"kind": "cache",     "seg_id": 3, "key": ["vector_enc", "emb",
+                                             "int4", "cosine"],
+                        "digest": "...", "size": 99}
+  {"kind": "ledger",    "digest": "...", "size": 321}
+  {"kind": "ivf",       "field": "emb", "digest": "...", "size": 42}
+
+Segment entries appear in reader order — assembly rebuilds the commit's
+segment list from that order. `diff_entries` is the whole incremental
+story: everything (snapshot dedup, peer-recovery resume, relocation)
+reduces to "which digests is the holder missing".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+
+def entry_key(entry: dict) -> tuple:
+    """Stable identity of one manifest entry (for tests/debugging)."""
+    return (entry["kind"], entry.get("seg_id"),
+            tuple(entry.get("key") or ()), entry.get("field"),
+            entry["digest"])
+
+
+def diff_entries(entries: Iterable[dict],
+                 held: Set[str]) -> Tuple[List[dict], List[dict]]:
+    """Split manifest entries into (missing, present) against a set of
+    digests the target already holds — locally cached blocks never
+    re-ship, which is both snapshot incrementality and the
+    resume-from-last-acked-block retry contract."""
+    missing, present = [], []
+    for entry in entries:
+        (present if entry["digest"] in held else missing).append(entry)
+    return missing, present
+
+
+def manifest_totals(entries: Iterable[dict]) -> Dict[str, int]:
+    entries = list(entries)
+    return {
+        "blocks_total": len(entries),
+        "bytes_total": sum(int(e.get("size", 0)) for e in entries),
+    }

@@ -1,0 +1,85 @@
+"""Node-local content-addressed block cache for peer recovery.
+
+One directory per node (`<data_path>/_blocks/`), one file per block
+named by its sha256 digest. The recovery target diffs the source's
+manifest against this cache: blocks it already holds (from an earlier
+attempt that died mid-way, from a previous life of the same shard, or
+from a snapshot restore) are REUSED, not re-shipped — a retry resumes
+from the last acked block for free, because acked blocks live here.
+
+Both directions verify the digest: `put` refuses bytes that do not hash
+to their claimed address, `get` re-hashes what it reads back (a torn
+write or bit rot surfaces as a miss, never as a corrupt shard).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Set
+
+
+def safe_digest(digest: str) -> str:
+    """Validate a wire digest before it becomes a path component —
+    digests are hex, but never trust a remote value as a filename."""
+    safe = "".join(c for c in digest if c in "0123456789abcdef")
+    if safe != digest or not safe:
+        raise ValueError(f"invalid block digest [{digest}]")
+    return safe
+
+
+class BlockCache:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.directory, safe_digest(digest))
+
+    def has(self, digest: str) -> bool:
+        try:
+            return os.path.exists(self._path(digest))
+        except ValueError:
+            return False
+
+    def held(self) -> Set[str]:
+        try:
+            return set(os.listdir(self.directory))
+        except OSError:
+            return set()
+
+    def put(self, digest: str, data: bytes) -> None:
+        if hashlib.sha256(data).hexdigest() != digest:
+            raise ValueError(
+                f"block digest mismatch on write: expected [{digest}]")
+        path = self._path(digest)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get(self, digest: str) -> Optional[bytes]:
+        """The block's bytes, digest-verified on read-back; None when
+        missing OR corrupt (a corrupt cached block is dropped so the
+        next attempt re-fetches it)."""
+        path = self._path(digest)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        if hashlib.sha256(data).hexdigest() != digest:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        return data
+
+    def evict(self, digest: str) -> None:
+        try:
+            os.unlink(self._path(digest))
+        except (OSError, ValueError):
+            pass

@@ -1,0 +1,72 @@
+"""Block-level recovery progress records.
+
+One dict per recovery (target side owns it; sources count themselves in
+the node summary), mutated in place as stages advance:
+
+  INIT -> BLOCKS (manifest diff + block transfer)
+       -> TRANSLOG (ops tail replay past the block checkpoint)
+       -> FINALIZE (refresh + warm handoff)
+       -> DONE
+
+`summarize` folds a node's live + finished recoveries and its retry
+counters into the `_nodes/stats indices.recovery` section.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+STAGE_INIT = "INIT"
+STAGE_BLOCKS = "BLOCKS"
+STAGE_TRANSLOG = "TRANSLOG"
+STAGE_FINALIZE = "FINALIZE"
+STAGE_DONE = "DONE"
+
+
+def new_progress(index: str, shard_id: int, allocation_id: str,
+                 rtype: str, source_node: str = "",
+                 target_node: str = "", now_ms: int = 0) -> dict:
+    """rtype: "PEER" | "RELOCATION" | "SNAPSHOT" | "EMPTY_STORE"."""
+    return {
+        "index": index, "shard": shard_id,
+        "allocation_id": allocation_id,
+        "type": rtype, "stage": STAGE_INIT,
+        "source_node": source_node, "target_node": target_node,
+        "blocks_total": 0, "blocks_reused": 0, "blocks_shipped": 0,
+        "bytes_total": 0, "bytes_shipped": 0,
+        "ops_replayed": 0,
+        # time spent waiting in backoff between attempts — the recovery
+        # analog of the reference's throttle_time
+        "throttle_ms": 0,
+        "attempts": 0,
+        "start_ms": now_ms, "stop_ms": None,
+    }
+
+
+def summarize(recoveries: Iterable[dict], stats: Dict[str, int],
+              current_as_source: int = 0) -> dict:
+    """`_nodes/stats indices.recovery`: live counts + lifetime block and
+    retry counters for one node."""
+    live = done = 0
+    blocks_reused = blocks_shipped = bytes_shipped = throttle = 0
+    for rec in recoveries:
+        if rec["stage"] == STAGE_DONE:
+            done += 1
+        else:
+            live += 1
+        blocks_reused += rec["blocks_reused"]
+        blocks_shipped += rec["blocks_shipped"]
+        bytes_shipped += rec["bytes_shipped"]
+        throttle += rec["throttle_ms"]
+    return {
+        "current_as_source": int(current_as_source),
+        "current_as_target": live,
+        "completed": done,
+        "blocks_reused": blocks_reused,
+        "blocks_shipped": blocks_shipped,
+        "bytes_shipped": bytes_shipped,
+        "throttle_time_in_millis": throttle,
+        "attempts": int(stats.get("attempts", 0)),
+        "retries": int(stats.get("retries", 0)),
+        "giveups": int(stats.get("giveups", 0)),
+    }

@@ -1,0 +1,47 @@
+"""Warm-HBM handoff for live shard relocation.
+
+A relocation target that reports started the moment its blocks land
+would flip routing onto cold state: device arrays not yet laid out on
+the target's mesh, dispatch grid not compiled — the first real queries
+eat the upload + XLA compile stall the source had already paid. The
+handoff runs BEFORE the target sends MASTER_SHARD_STARTED (the source
+keeps serving until the routing flip, so this latency is invisible):
+
+1. refresh: the vector sync lays the corpus out on the target's
+   devices through `parallel/layout.py`'s rule table (mesh shard_put /
+   extend_or_build inside the store), seeded by the shipped columnar
+   blocks + IVF layout so nothing re-encodes or re-trains;
+2. probe: one tiny kNN per vector field through the REAL serving entry
+   (`VectorStoreShard.search`) compiles and caches the dispatch grid
+   programs the first user query would otherwise compile.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def warm_handoff(local_shard) -> dict:
+    """Warm one relocated/recovered shard; returns a summary for the
+    recovery progress record. Never raises — a warmup failure costs the
+    first query a compile, not the relocation."""
+    t0 = time.perf_counter_ns()
+    warmed = []
+    try:
+        local_shard.engine.refresh()
+    except Exception:
+        return {"warmed_fields": [], "warm_nanos": 0}
+    store = getattr(local_shard, "vector_store", None)
+    mapper = getattr(local_shard, "mapper_service", None)
+    if store is not None and mapper is not None:
+        for field, fm in (mapper.vector_fields() or {}).items():
+            try:
+                probe = np.ones(int(fm.dims), dtype=np.float32)
+                store.search(field, probe, k=1)
+                warmed.append(field)
+            except Exception:
+                continue
+    return {"warmed_fields": warmed,
+            "warm_nanos": time.perf_counter_ns() - t0}

@@ -1,0 +1,90 @@
+"""Seed restored derived state into the live caches.
+
+Restoring a shard's raw segments is only half of "byte-identical with
+zero re-encoding": the codec-encoded columnar blocks and the trained
+IVF layout must come back too, or the first sync after restore would
+re-encode every row and re-train k-means. Assembly writes a SIDECAR
+file next to the commit; `maybe_apply` runs after the engine opens and
+BEFORE the first vector sync:
+
+- cached columnar blocks re-install into `columnar.STORE` against the
+  freshly-loaded Segment objects, fingerprint-verified (a block whose
+  fingerprint does not match the live segment view is dropped, not
+  installed — stale derived state must lose to the source of truth);
+- IVF layouts hand to the vector store, whose next sync re-places rows
+  into the restored centroids instead of calling `build_ivf_index`.
+
+The sidecar is consumed (deleted) on apply, so a later reopen of the
+same path syncs normally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from elasticsearch_tpu.recovery.blocks import (
+    SIDECAR_FILE, dumps_block, loads_block,
+)
+
+
+def write_sidecar(path: str, cache_entries, ivf_layouts) -> None:
+    """cache_entries: [{"seg_id", "key", "block"}]; ivf_layouts:
+    {field: layout}. Written atomically next to commit.bin."""
+    os.makedirs(path, exist_ok=True)
+    data = dumps_block({"cache": list(cache_entries),
+                        "ivf": dict(ivf_layouts or {})})
+    tmp = os.path.join(path, SIDECAR_FILE + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, SIDECAR_FILE))
+
+
+def load_sidecar(path: str, consume: bool = True) -> Optional[dict]:
+    sidecar = os.path.join(path, SIDECAR_FILE)
+    try:
+        with open(sidecar, "rb") as f:
+            payload = loads_block(f.read())
+    except OSError:
+        return None
+    except Exception:
+        # a torn/corrupt sidecar only costs a re-encode; drop it
+        payload = None
+    if consume:
+        try:
+            os.unlink(sidecar)
+        except OSError:
+            pass
+    return payload
+
+
+def maybe_apply(engine, vector_store) -> Optional[dict]:
+    """Load + apply the sidecar for `engine.path` if one exists.
+    Returns a summary dict ({"seeded", "skipped", "ivf_fields"}) or
+    None when there was nothing to seed."""
+    payload = load_sidecar(engine.path)
+    if payload is None:
+        return None
+    from elasticsearch_tpu import columnar
+
+    reader = engine.acquire_searcher()
+    views = {view.segment.seg_id: view for view in reader.views}
+    seeded = skipped = 0
+    for entry in payload.get("cache", ()):
+        view = views.get(entry.get("seg_id"))
+        blk = entry.get("block")
+        key = tuple(entry.get("key") or ())
+        if view is None or blk is None or len(key) < 2:
+            skipped += 1
+            continue
+        if columnar.STORE.install(view, key, blk):
+            seeded += 1
+        else:
+            skipped += 1
+    ivf = payload.get("ivf") or {}
+    if ivf and vector_store is not None:
+        vector_store.restore_ivf_layout(ivf)
+    return {"seeded": seeded, "skipped": skipped,
+            "ivf_fields": sorted(ivf)}

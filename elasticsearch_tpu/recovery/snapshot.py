@@ -1,0 +1,161 @@
+"""Collect a shard into blocks / assemble a shard from blocks.
+
+The SAME collect/assemble pair serves every durability flow:
+
+- repository snapshot:  collect -> put missing blobs (content-addressed
+  dedup makes the second snapshot O(new blocks)) -> manifest entry;
+- repository restore:   fetch blobs (digest-verified) -> assemble;
+- peer recovery:        source collects into a staging dir, target
+  diffs + fetches missing blocks over chunked transport -> assemble;
+- relocation:           identical to peer recovery; the warm handoff
+  happens after assembly.
+
+Assembly writes the exact commit files `Engine.flush` would have
+written plus the seed sidecar (`recovery/seed.py`), so the reopened
+engine is byte-identical and its derived caches never recompute.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.recovery.blocks import (
+    block_digest, commit_meta, dumps_block, ledger_state, loads_block,
+    serialize_ledger, serialize_segment, write_commit_files,
+)
+from elasticsearch_tpu.recovery.manifest import manifest_totals
+from elasticsearch_tpu.recovery.seed import write_sidecar
+
+
+def collect_shard_blocks(engine, vector_store=None
+                         ) -> Tuple[List[dict], Dict[str, bytes], dict]:
+    """Serialize one shard's durable state into (manifest entries,
+    {digest: bytes}, commit meta). Callers flush first — this reads the
+    committed segment set. Derived blocks are taken from whatever the
+    columnar store has ALREADY cached (snapshotting must not trigger
+    extractions of its own); the IVF layout comes from the vector
+    store's live routers."""
+    from elasticsearch_tpu import columnar
+
+    entries: List[dict] = []
+    payloads: Dict[str, bytes] = {}
+
+    def add(entry: dict, data: bytes) -> None:
+        digest = block_digest(data)
+        entry["digest"] = digest
+        entry["size"] = len(data)
+        entry["crc32"] = zlib.crc32(data) & 0xFFFFFFFF
+        entries.append(entry)
+        payloads.setdefault(digest, data)
+
+    reader = engine.acquire_searcher()
+    for view in reader.views:
+        seg = view.segment
+        add({"kind": "segment", "seg_id": int(seg.seg_id)},
+            serialize_segment(seg))
+        for key, blk in columnar.STORE.cached_blocks(seg).items():
+            if key[0] == "vector":
+                # f32 vector blocks are zero-copy views of segment
+                # arrays the segment blob above already carries
+                continue
+            add({"kind": "cache", "seg_id": int(seg.seg_id),
+                 "key": list(key)},
+                dumps_block(blk))
+    add({"kind": "ledger"},
+        serialize_ledger(engine.deleted_rows, engine.version_map))
+    if vector_store is not None:
+        for field, layout in vector_store.export_ivf_layout().items():
+            add({"kind": "ivf", "field": field}, dumps_block(layout))
+    return entries, payloads, commit_meta(engine)
+
+
+def assemble_shard(path: str, entries: List[dict], meta: dict,
+                   fetch: Callable[[str], bytes]) -> dict:
+    """Materialize a shard directory from manifest entries: rebuild the
+    commit files + translog checkpoint and stage the derived blocks in
+    the seed sidecar. Every fetched block is digest-verified HERE as
+    well — `fetch` implementations verify too, but assembly is the last
+    line before bytes become an engine."""
+    segments = []
+    seg_entries = sorted(
+        (e for e in entries if e["kind"] == "segment"),
+        key=lambda e: int(e["seg_id"]))
+    ledger_entry = next(e for e in entries if e["kind"] == "ledger")
+    cache_entries = []
+    ivf_layouts = {}
+
+    def verified(entry: dict) -> bytes:
+        data = fetch(entry["digest"])
+        if data is None or block_digest(data) != entry["digest"]:
+            raise ValueError(
+                f"block [{entry['digest']}] failed digest verification")
+        return data
+
+    for entry in seg_entries:
+        segments.append(loads_block(verified(entry)))
+    deleted_rows, version_map = ledger_state(verified(ledger_entry))
+    for entry in entries:
+        if entry["kind"] == "cache":
+            cache_entries.append({
+                "seg_id": int(entry["seg_id"]),
+                "key": tuple(entry["key"]),
+                "block": loads_block(verified(entry))})
+        elif entry["kind"] == "ivf":
+            ivf_layouts[entry["field"]] = loads_block(verified(entry))
+    write_commit_files(path, segments, deleted_rows, version_map, meta)
+    write_sidecar(path, cache_entries, ivf_layouts)
+    return {**manifest_totals(entries),
+            "segments": len(segments),
+            "cache_blocks": len(cache_entries),
+            "ivf_fields": sorted(ivf_layouts)}
+
+
+# ------------------------------------------------------------ repository
+
+def snapshot_shard(repo, engine, vector_store=None) -> dict:
+    """Upload one shard's blocks to a content-addressed repository;
+    returns the shard's manifest entry. Blocks whose digest the repo
+    already holds are REUSED (counted, not re-uploaded) — that is the
+    incremental-snapshot contract the acceptance gate measures."""
+    entries, payloads, meta = collect_shard_blocks(engine, vector_store)
+    reused = shipped = bytes_shipped = 0
+    for digest, data in payloads.items():
+        if repo.has_blob(digest):
+            reused += 1
+        else:
+            repo.put_bytes(data)
+            shipped += 1
+            bytes_shipped += len(data)
+    return {"blocks": entries, "meta": meta,
+            "stats": {**manifest_totals(entries),
+                      "blocks_reused": reused,
+                      "blocks_shipped": shipped,
+                      "bytes_shipped": bytes_shipped}}
+
+
+def restore_shard(repo, shard_entry: dict, path: str,
+                  cache=None) -> Optional[dict]:
+    """Materialize one shard from its snapshot manifest entry. With a
+    node block cache, fetched blobs also land there so a later peer
+    recovery of the same data re-ships nothing."""
+    entries = shard_entry.get("blocks")
+    if entries is None:
+        return None
+    stats = {"blocks_reused": 0, "blocks_shipped": 0, "bytes_shipped": 0}
+
+    def fetch(digest: str) -> bytes:
+        if cache is not None:
+            held = cache.get(digest)
+            if held is not None:
+                stats["blocks_reused"] += 1
+                return held
+        data = repo.get_bytes(digest)
+        stats["blocks_shipped"] += 1
+        stats["bytes_shipped"] += len(data)
+        if cache is not None:
+            cache.put(digest, data)
+        return data
+
+    return {**assemble_shard(path, entries, shard_entry["meta"], fetch),
+            **stats}

@@ -343,6 +343,7 @@ def register_admin(rc: RestController, node: Node) -> None:
             started = svc.creation_date
             iso = _time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
                                  _time.gmtime(started / 1000))
+            bstats = getattr(svc, "recovery_block_stats", None) or {}
             shards_out = []
             for sh in svc.shards:
                 files = []
@@ -371,13 +372,24 @@ def register_admin(rc: RestController, node: Node) -> None:
                               "index": rsrc.get("index")}
                 elif rtype == "EMPTY_STORE":
                     source = {}
-                findex = {"total": len(files),
-                          "reused": len(files) - recovered_files,
-                          "recovered": recovered_files,
-                          "percent": "100.0%"}
+                bs = bstats.get(sh.shard_id)
+                if bs:
+                    # block-level restore: the unit of transfer is the
+                    # content-addressed block, not the walked file tree
+                    findex = {"total": int(bs.get("blocks_total", 0)),
+                              "reused": int(bs.get("blocks_reused", 0)),
+                              "recovered": int(bs.get("blocks_shipped", 0)),
+                              "percent": "100.0%"}
+                    size = int(bs.get("bytes_total", size))
+                    recovered_bytes = int(bs.get("bytes_shipped", 0))
+                else:
+                    findex = {"total": len(files),
+                              "reused": len(files) - recovered_files,
+                              "recovered": recovered_files,
+                              "percent": "100.0%"}
                 if detailed:
                     findex["details"] = files if from_snapshot else []
-                shards_out.append({
+                shard_out = {
                     "id": sh.shard_id, "type": rtype, "stage": "DONE",
                     "primary": True,
                     "start_time": iso, "start_time_in_millis": started,
@@ -398,7 +410,18 @@ def register_admin(rc: RestController, node: Node) -> None:
                                  "total_time_in_millis": 0},
                     "verify_index": {"check_index_time_in_millis": 0,
                                      "total_time_in_millis": 0},
-                })
+                }
+                if bs:
+                    shard_out["blocks"] = {
+                        "total": int(bs.get("blocks_total", 0)),
+                        "reused": int(bs.get("blocks_reused", 0)),
+                        "shipped": int(bs.get("blocks_shipped", 0)),
+                        "bytes_total": int(bs.get("bytes_total", 0)),
+                        "bytes_shipped": int(bs.get("bytes_shipped", 0)),
+                        "segments": int(bs.get("segments", 0)),
+                        "cache_blocks": int(bs.get("cache_blocks", 0)),
+                        "ivf_fields": list(bs.get("ivf_fields", []))}
+                shards_out.append(shard_out)
             out[svc.name] = {"shards": shards_out}
         return 200, out
 
@@ -633,12 +656,18 @@ def register_admin(rc: RestController, node: Node) -> None:
         Col("translog_ops", "to", "number of translog ops to recover", right=True),
         Col("translog_ops_recovered", "tor", "translog ops recovered", right=True),
         Col("translog_ops_percent", "top", "percent of translog ops recovered", right=True),
+        Col("blocks_total", "blt", "total content-addressed blocks in the shard manifest", right=True),
+        Col("blocks_reused", "blr", "blocks already held (cache or repository dedup)", right=True),
+        Col("blocks_shipped", "bls", "blocks transferred", right=True),
+        Col("throttle_time", "tht", "time spent waiting in retry backoff", right=True, default=False),
     ]
 
     def cat_recovery(req):
         rows = []
         for svc in node.indices.resolve(req.params.get("index"),
                                         expand_hidden=True):
+            bstats = getattr(svc, "recovery_block_stats", None) or {}
+            rsrc = getattr(svc, "recovery_source", None) or {}
             for sh in svc.shards:
                 import os as _os
                 # a shard with committed state recovers from its own files
@@ -646,6 +675,9 @@ def register_admin(rc: RestController, node: Node) -> None:
                 has_commit = _os.path.exists(
                     _os.path.join(sh.engine.path, "commit.bin")) \
                     or sh.engine.local_checkpoint >= 0
+                bs = bstats.get(sh.shard_id) or {}
+                rtype = "snapshot" if bs or rsrc.get("type") == "SNAPSHOT" \
+                    else ("existing_store" if has_commit else "empty_store")
                 rows.append([
                     svc.name, sh.shard_id,
                     _fmt_time_of(svc.creation_date),
@@ -653,13 +685,21 @@ def register_admin(rc: RestController, node: Node) -> None:
                     _fmt_time_of(svc.creation_date),
                     svc.creation_date,
                     Millis(1),
-                    "existing_store" if has_commit else "empty_store",
+                    rtype,
                     "done",
                     "n/a", "n/a", "127.0.0.1", node.node_name,
-                    "n/a", "n/a",
+                    rsrc.get("repository", "n/a") if bs else "n/a",
+                    rsrc.get("snapshot", "n/a") if bs else "n/a",
                     0, 0, "100.0%", 0,
-                    Bytes(0), Bytes(0), "100.0%", Bytes(0),
-                    0, 0, "100.0%"])
+                    Bytes(int(bs.get("bytes_total", 0))),
+                    Bytes(int(bs.get("bytes_shipped", 0))),
+                    "100.0%",
+                    Bytes(int(bs.get("bytes_total", 0))),
+                    0, 0, "100.0%",
+                    int(bs.get("blocks_total", 0)),
+                    int(bs.get("blocks_reused", 0)),
+                    int(bs.get("blocks_shipped", 0)),
+                    Millis(int(bs.get("throttle_ms", 0)))])
         return _render(req, _RECOVERY_COLS, rows)
 
     _fmt_time_of = fmt_iso_millis

@@ -132,14 +132,22 @@ def register_conf(rc: "RestController", node: "Node") -> None:
             for iname, ientry in (m.get("indices") or {}).items():
                 istats = {}
                 for sid, sentry in (ientry.get("shards") or {}).items():
-                    files = sentry.get("files") or {}
-                    fc = len(files)
-                    sz = 0
-                    for digest in files.values():
-                        try:
-                            sz += len(repo.store.read_blob(f"blobs/{digest}"))
-                        except Exception:
-                            pass
+                    blocks = sentry.get("blocks")
+                    if blocks is not None:
+                        # block-manifest shard: sizes come from the
+                        # manifest entries — no blob reads at all
+                        uniq = {e["digest"]: int(e["size"]) for e in blocks}
+                        fc = len(uniq)
+                        sz = sum(uniq.values())
+                    else:
+                        files = sentry.get("files") or {}
+                        fc = len(files)
+                        sz = 0
+                        for digest in files.values():
+                            try:
+                                sz += len(repo.get_bytes(digest))
+                            except Exception:
+                                pass
                     file_count += fc
                     size_bytes += sz
                     istats[sid] = {

@@ -267,8 +267,13 @@ class VectorStoreShard:
         # _nodes/stats)
         self._sched_retired: Dict[str, int] = {}
         # per-phase serving telemetry (profile "knn" section, _nodes/stats)
+        # restored IVF layouts (recovery/seed.py): consumed by the next
+        # sync's IVF build so a restored/relocated shard re-places rows
+        # into the snapshotted centroids instead of re-training k-means
+        self._restored_ivf: Dict[str, dict] = {}
         self.knn_stats: Dict[str, int] = {
             "searches": 0, "ivf_searches": 0, "fallback_searches": 0,
+            "ivf_trains": 0, "ivf_restores": 0,
             "mesh_searches": 0, "fused_probe_searches": 0,
             "rescore_searches": 0, "rescore_window_rows": 0,
             "rescore_promoted": 0, "rescore_nanos": 0,
@@ -323,6 +328,28 @@ class VectorStoreShard:
             # driven — the `"rescore": true` small fix
             "rescore_candidates": max(oversample, 1) * 32,
         }
+
+    # ------------------------------------------------- durable elasticity
+    def export_ivf_layout(self) -> Dict[str, dict]:
+        """Trained IVF layouts of every field currently routed through
+        an IVFIndex (corpus-independent: centroids + shape), for the
+        recovery subsystem's shard snapshots."""
+        from elasticsearch_tpu.ann.ivf_index import export_layout
+        out: Dict[str, dict] = {}
+        with self._views_lock:
+            fields = dict(self._fields)
+        for field, fc in fields.items():
+            router = getattr(fc, "router", None)
+            index = getattr(router, "index", None)
+            if index is not None:
+                out[field] = export_layout(index)
+        return out
+
+    def restore_ivf_layout(self, layouts: Dict[str, dict]) -> None:
+        """Stage restored layouts for the next sync's IVF build (see
+        `sync`); unknown/incompatible layouts are simply never consumed
+        and the build falls back to training."""
+        self._restored_ivf.update(layouts or {})
 
     @staticmethod
     def _fingerprint(reader: ShardReader, field: str) -> tuple:
@@ -469,10 +496,26 @@ class VectorStoreShard:
                 if router is None:
                     nlist = opts.get("nlist", self.knn_nlist)
                     nprobe = opts.get("nprobe", self.knn_nprobe)
-                    ivf = build_ivf_index(
-                        full, metric=metric,
-                        nlist=int(nlist) if nlist is not None else None,
-                        dtype=dtype, seed=0)
+                    ivf = None
+                    layout = self._restored_ivf.pop(field, None)
+                    if layout is not None:
+                        # durable elasticity: a restored/relocated shard
+                        # re-places rows into the snapshotted trained
+                        # centroids — zero k-means retraining, identical
+                        # probe routing (recovery/seed.py installs the
+                        # layout before this first sync)
+                        from elasticsearch_tpu.ann.ivf_index import (
+                            ivf_from_layout, layout_compatible)
+                        if layout_compatible(layout, len(row_map),
+                                             mapper.dims, metric, dtype):
+                            ivf = ivf_from_layout(layout, full)
+                            self.knn_stats["ivf_restores"] += 1
+                    if ivf is None:
+                        ivf = build_ivf_index(
+                            full, metric=metric,
+                            nlist=int(nlist) if nlist is not None else None,
+                            dtype=dtype, seed=0)
+                        self.knn_stats["ivf_trains"] += 1
                     router = IVFRouter(
                         ivf, nprobe=nprobe,
                         recall_target=self.knn_recall_target)

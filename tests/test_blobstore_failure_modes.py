@@ -1,0 +1,197 @@
+"""Blobstore failure modes and backend contract parity.
+
+The durable-elasticity gates lean on three promises this file pins:
+
+* backend parity — `MemoryBlobStore` and `FsBlobStore` expose the SAME
+  observable contract (roundtrip, overwrite, missing-read error,
+  idempotent delete, prefix listing), so every snapshot/recovery test
+  that runs against memory holds for fs and vice versa;
+* corrupt/partial blobs are REJECTED AND RETRYABLE — a content-
+  addressed blob whose bytes stop hashing to their name raises on read,
+  is evicted so the dedup fast-path cannot pin the corruption, and the
+  next put+get round-trips cleanly;
+* concurrent snapshot + delete — snapshots share blobs by content;
+  deleting one snapshot while others are being created must never
+  corrupt a survivor's restore.
+"""
+
+import threading
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.snapshots.blobstore import (
+    BlobStoreError, FsBlobStore, MemoryBlobStore,
+)
+from elasticsearch_tpu.snapshots.service import Repository, RepositoryError
+
+BACKENDS = ("fs", "memory")
+
+
+def _store(kind, tmp_path, tag):
+    if kind == "fs":
+        return FsBlobStore(str(tmp_path / f"fs_{tag}"))
+    # memory stores are shared by name: key them on the test's tmp dir
+    # so parallel tests never collide
+    return MemoryBlobStore(f"{tmp_path.name}_{tag}")
+
+
+def _repository(kind, tmp_path, tag):
+    if kind == "fs":
+        return Repository(f"r_{tag}", "fs",
+                          {"location": str(tmp_path / f"repo_{tag}")})
+    return Repository(f"r_{tag}", "memory",
+                      {"location": f"{tmp_path.name}_repo_{tag}"})
+
+
+# ---------------------------------------------------------------------------
+# shared contract suite: every assertion runs identically per backend
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_contract_roundtrip_overwrite_list_delete(kind, tmp_path):
+    store = _store(kind, tmp_path, "contract")
+    store.write_blob("blobs/aa", b"alpha")
+    store.write_blob("blobs/bb", b"beta")
+    store.write_blob("snapshots/s1.json", b"{}")
+
+    assert store.read_blob("blobs/aa") == b"alpha"
+    assert store.exists("blobs/aa")
+    assert not store.exists("blobs/zz")
+
+    # overwrite is last-write-wins, not append
+    store.write_blob("blobs/aa", b"alpha2")
+    assert store.read_blob("blobs/aa") == b"alpha2"
+
+    # listing is prefix-scoped and sorted
+    assert store.list_blobs("blobs/") == ["blobs/aa", "blobs/bb"]
+    assert store.list_blobs("snapshots/") == ["snapshots/s1.json"]
+
+    # delete is effective and idempotent (a retried cleanup must not
+    # blow up because the first attempt already won)
+    store.delete_blob("blobs/aa")
+    store.delete_blob("blobs/aa")
+    assert not store.exists("blobs/aa")
+    assert store.list_blobs("blobs/") == ["blobs/bb"]
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_contract_missing_read_raises(kind, tmp_path):
+    store = _store(kind, tmp_path, "missing")
+    with pytest.raises(BlobStoreError):
+        store.read_blob("blobs/never_written")
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_corrupt_blob_rejected_evicted_and_retryable(kind, tmp_path):
+    """Bit rot / partial upload: the verified read fails, the corrupt
+    blob stops existing (so put_bytes' dedup can't keep skipping the
+    repair), and a retried put+get round-trips."""
+    repo = _repository(kind, tmp_path, "corrupt")
+    payload = b"block-bytes" * 512
+    digest = repo.put_bytes(payload)
+    assert repo.get_bytes(digest) == payload
+
+    # corrupt the stored copy underneath the repository (truncation —
+    # the partial-upload shape — plus flipped tail bytes)
+    repo.store.write_blob(f"blobs/{digest}", payload[:-7] + b"XXXXXXX")
+    with pytest.raises(RepositoryError, match="digest verification"):
+        repo.get_bytes(digest)
+    assert not repo.has_blob(digest), \
+        "corrupt blob survived the failed read — dedup would pin it"
+
+    # the retry: re-upload actually writes (no stale dedup), read heals
+    assert repo.put_bytes(payload) == digest
+    assert repo.get_bytes(digest) == payload
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_missing_blob_is_repository_error(kind, tmp_path):
+    repo = _repository(kind, tmp_path, "gone")
+    digest = repo.put_bytes(b"here today")
+    repo.store.delete_blob(f"blobs/{digest}")
+    with pytest.raises(RepositoryError, match="missing blob"):
+        repo.get_bytes(digest)
+
+
+def test_fs_partial_upload_never_visible(tmp_path):
+    """FsBlobStore writes through a `.tmp` + atomic rename: a crash
+    mid-upload leaves only the temp file, which must read as ABSENT —
+    not as a truncated blob."""
+    store = FsBlobStore(str(tmp_path / "fs_partial"))
+    store.write_blob("blobs/good", b"complete")
+    # a torn upload: the temp file exists, the final name never did
+    with open(store._path("blobs/torn") + ".tmp", "wb") as f:
+        f.write(b"half a blo")
+    assert not store.exists("blobs/torn")
+    assert store.list_blobs("blobs/") == ["blobs/good"]
+    with pytest.raises(BlobStoreError):
+        store.read_blob("blobs/torn")
+
+
+# ---------------------------------------------------------------------------
+# concurrent snapshot + delete
+# ---------------------------------------------------------------------------
+
+def test_concurrent_snapshot_create_and_delete(tmp_path):
+    """Creates race deletes against one repository: content-addressed
+    blobs are shared across snapshots, so deleting older snapshots while
+    new ones are being cut must leave every surviving manifest fully
+    restorable (the delete removes the manifest, never a blob a
+    survivor still references)."""
+    node = Node(str(tmp_path))
+    try:
+        node.create_index_with_templates(
+            "race", mappings={"properties": {"n": {"type": "long"}}})
+        ops = []
+        for i in range(40):
+            ops.append({"index": {"_index": "race", "_id": str(i)}})
+            ops.append({"n": i})
+        node.bulk(ops)
+        node.indices.get("race").refresh()
+        node.snapshots.put_repository("mem", {
+            "type": "memory",
+            "settings": {"location": f"{tmp_path.name}_race"}})
+
+        errors = []
+        created = []
+
+        def creator():
+            for i in range(6):
+                try:
+                    node.snapshots.create_snapshot(
+                        "mem", f"c{i}", {"indices": "race"})
+                    created.append(f"c{i}")
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(("create", f"c{i}", exc))
+
+        def deleter():
+            # chase the creator: delete everything but the newest
+            for _ in range(60):
+                names = sorted(created)
+                for name in names[:-1]:
+                    try:
+                        node.snapshots.delete_snapshot("mem", name)
+                    except Exception:
+                        pass  # already deleted by a previous lap
+                if len(created) >= 6:
+                    break
+
+        t1 = threading.Thread(target=creator)
+        t2 = threading.Thread(target=deleter)
+        t1.start(); t2.start()
+        t1.join(); t2.join()
+        assert not errors, errors
+
+        repo = node.snapshots.get_repository("mem")
+        survivors = repo.list_snapshots()
+        assert "c5" in survivors, survivors
+
+        # the newest survivor restores completely despite the churn
+        node.indices.delete_index("race")
+        node.snapshots.restore_snapshot("mem", "c5", {"indices": "race"})
+        node.indices.get("race").refresh()
+        resp = node.search("race", {"query": {"match_all": {}}, "size": 0})
+        assert resp["hits"]["total"]["value"] == 40
+    finally:
+        node.close()

@@ -1,0 +1,327 @@
+"""Durable elasticity at the cluster level: block-based peer recovery
+(manifest diff + chunked block fetch), node kill-and-replace without
+re-ingest, live relocation with the warm-HBM handoff, and the jittered
+recovery backoff / giveup policy.
+
+These ride the deterministic multi-node harness (test_multi_node.py's
+InternalTestCluster analog) so every schedule — including the backoff
+jitter, which is CRC-derived rather than wall-clock — replays exactly.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.cluster_node import (
+    RECOVERY_START, ClusterNode,
+)
+from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+from elasticsearch_tpu.recovery import progress as rp
+
+from tests.test_multi_node import TestCluster
+
+DIMS = 16
+
+
+def _vector_mapping():
+    return {"properties": {
+        "n": {"type": "long"},
+        "v": {"type": "dense_vector", "dims": DIMS, "index": True,
+              "similarity": "dot_product",
+              "index_options": {"type": "int4_flat"}}}}
+
+
+def _vec(i):
+    rng = np.random.default_rng(1000 + i)
+    x = rng.standard_normal(DIMS)
+    return [float(f) for f in x / np.linalg.norm(x)]
+
+
+def _copy_holders(c, index):
+    """(primary_node_id, replica_node_id) for shard 0 of `index`."""
+    primary = replica = None
+    for nid, node in c.nodes.items():
+        sh = node.local_shards.get((index, 0))
+        if sh is None:
+            continue
+        if sh.routing.primary:
+            primary = nid
+        else:
+            replica = nid
+    return primary, replica
+
+
+def _stop_all(c):
+    for n in c.nodes.values():
+        if not n.coordinator.stopped:
+            n.stop()
+
+
+def _block_recovery_fixture(tmp_path, seed, mappings=None):
+    """3-node cluster, 1 shard + 1 replica, primary flushed so a fresh
+    copy CANNOT recover ops-only — phase 1 must ship blocks."""
+    c = TestCluster(tmp_path, n_nodes=3, seed=seed)
+    assert c.run_until(lambda: c.master() is not None
+                       and len(c.master().cluster_state.nodes) == 3)
+    c.any_node().client_create_index(
+        "dur", settings={"index.number_of_shards": 1,
+                         "index.number_of_replicas": 1},
+        mappings=mappings or {"properties": {"n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("dur"))
+    w = c.any_node()
+    for i in range(30):
+        doc = {"n": i}
+        if mappings is not None and "v" in mappings["properties"]:
+            doc["v"] = _vec(i)
+        r = c.call(w.client_write, "dur",
+                   {"type": "index", "id": str(i), "source": doc})
+        assert r["result"] == "created"
+    primary, replica = _copy_holders(c, "dur")
+    pshard = c.nodes[primary].local_shards[("dur", 0)]
+    pshard.engine.flush()
+    assert not pshard.engine.can_replay_from(0)
+    return c, primary, replica
+
+
+def _replica_started_on(c, via, spare, index="dur"):
+    state = c.nodes[via].cluster_state
+    return any(r.node_id == spare and not r.primary
+               and r.state == ShardRoutingEntry.STARTED
+               for r in state.shards_of(index))
+
+
+def test_block_peer_recovery_ships_blocks_and_tracks_progress(tmp_path):
+    """A post-trim replica recovery runs the block path: the target's
+    progress record walks INIT->BLOCKS->TRANSLOG->DONE, ships a non-zero
+    block set, and the node summary (the `_nodes/stats indices.recovery`
+    source) reflects it."""
+    c, primary, replica = _block_recovery_fixture(tmp_path, seed=61)
+    spare = next(n for n in c.nodes if n not in (primary, replica))
+    c.transport.blackhole(replica)
+    c.nodes[replica].stop()
+
+    assert c.run_until(lambda: _replica_started_on(c, primary, spare),
+                       max_ms=240_000), "replica never recovered on spare"
+
+    target = c.nodes[spare]
+    new_shard = target.local_shards[("dur", 0)]
+    assert new_shard.engine.doc_count() == 30
+
+    progs = [p for p in target.recoveries.values()
+             if p["index"] == "dur" and p["stage"] == rp.STAGE_DONE]
+    assert progs, f"no completed recovery tracked: {target.recoveries}"
+    prog = progs[-1]
+    assert prog["type"] == "PEER"
+    assert prog["blocks_total"] > 0
+    assert prog["blocks_shipped"] > 0
+    assert prog["bytes_shipped"] > 0
+    assert prog["source_node"] == primary
+    # every shipped block landed (content-addressed) in the node cache
+    assert len(target.block_cache.held()) >= prog["blocks_shipped"]
+
+    summary = target.recovery_summary()
+    assert summary["completed"] >= 1
+    assert summary["blocks_shipped"] == sum(
+        p["blocks_shipped"] for p in target.recoveries.values())
+    assert target.recovery_stats["giveups"] == 0
+
+    # the recovered copy keeps receiving live writes (phase 2 handoff)
+    r = c.call(c.nodes[primary].client_write, "dur",
+               {"type": "index", "id": "99", "source": {"n": 99}})
+    assert r["result"] == "created"
+    assert c.run_until(lambda: new_shard.engine.doc_count() == 31,
+                       max_ms=30_000)
+    _stop_all(c)
+
+
+def test_primed_block_cache_skips_shipping(tmp_path):
+    """The manifest diff is real: a target whose block cache already
+    holds every block (here primed out-of-band, in production by an
+    earlier attempt or a snapshot restore) ships NOTHING — recovery
+    reuses the local copies and only replays the translog tail."""
+    from elasticsearch_tpu.recovery.snapshot import collect_shard_blocks
+
+    c, primary, replica = _block_recovery_fixture(tmp_path, seed=67)
+    spare = next(n for n in c.nodes if n not in (primary, replica))
+
+    pshard = c.nodes[primary].local_shards[("dur", 0)]
+    _entries, payloads, _meta = collect_shard_blocks(
+        pshard.engine, getattr(pshard, "vector_store", None))
+    for digest, data in payloads.items():
+        c.nodes[spare].block_cache.put(digest, data)
+
+    c.transport.blackhole(replica)
+    c.nodes[replica].stop()
+    assert c.run_until(lambda: _replica_started_on(c, primary, spare),
+                       max_ms=240_000), "replica never recovered on spare"
+
+    target = c.nodes[spare]
+    assert target.local_shards[("dur", 0)].engine.doc_count() == 30
+    progs = [p for p in target.recoveries.values()
+             if p["index"] == "dur" and p["stage"] == rp.STAGE_DONE]
+    assert progs, target.recoveries
+    prog = progs[-1]
+    assert prog["blocks_total"] > 0
+    assert prog["blocks_reused"] == prog["blocks_total"], prog
+    assert prog["blocks_shipped"] == 0, \
+        f"primed cache still shipped {prog['blocks_shipped']} blocks"
+    assert prog["bytes_shipped"] == 0
+    _stop_all(c)
+
+
+def test_kill_and_replace_no_reingest_identical_results(tmp_path):
+    """ISSUE acceptance: kill a copy-holding node, join a fresh one, and
+    the cluster goes green again with (a) zero full re-ingests — the
+    survivors' vector segment_counters stay flat and the replacement
+    seeds from blocks instead of re-encoding — and (b) knn results
+    byte-identical to pre-kill."""
+    c, primary, replica = _block_recovery_fixture(
+        tmp_path, seed=71, mappings=_vector_mapping())
+
+    for n in c.nodes.values():
+        n.refresh_all()
+    q = _vec(999)
+    body = {"knn": {"field": "v", "query_vector": q, "k": 5,
+                    "num_candidates": 30}, "size": 5}
+    before = c.call(c.any_node().client_search, "dur", dict(body))
+    hits_before = [(h["_id"], h["_score"]) for h in before["hits"]["hits"]]
+    assert len(hits_before) == 5
+
+    rebuilds_before = {}
+    for nid in (primary, replica):
+        sh = c.nodes[nid].local_shards[("dur", 0)]
+        rebuilds_before[nid] = \
+            sh.vector_store.segment_counters["full_rebuilds"]
+
+    # kill the replica holder; a brand-new node joins as its replacement
+    c.transport.blackhole(replica)
+    c.nodes[replica].stop()
+    c.add_node("n9", tmp_path)
+
+    def green_without_victim():
+        state = c.nodes[primary].cluster_state
+        shards = [s for s in state.shards_of("dur")
+                  if s.node_id and s.node_id != replica]
+        return len(shards) == 2 and all(
+            s.state == ShardRoutingEntry.STARTED for s in shards)
+
+    assert c.run_until(green_without_victim, max_ms=240_000), \
+        "cluster never re-established both copies"
+
+    # survivors never re-encoded, and whichever node took the new copy
+    # seeded it from shipped blocks (fresh store -> rebuild counter 0)
+    psh = c.nodes[primary].local_shards[("dur", 0)]
+    assert psh.vector_store.segment_counters["full_rebuilds"] == \
+        rebuilds_before[primary], "primary re-ingested during recovery"
+    new_holder = next(
+        nid for nid, n in c.nodes.items()
+        if nid not in (primary, replica)
+        and ("dur", 0) in n.local_shards and not n.coordinator.stopped)
+    new_sh = c.nodes[new_holder].local_shards[("dur", 0)]
+    assert new_sh.engine.doc_count() == 30
+    assert new_sh.vector_store.segment_counters["full_rebuilds"] == 0
+
+    for nid, n in c.nodes.items():
+        if nid != replica and not n.coordinator.stopped:
+            n.refresh_all()
+    after = c.call(c.nodes[primary].client_search, "dur", dict(body))
+    hits_after = [(h["_id"], h["_score"]) for h in after["hits"]["hits"]]
+    assert hits_after == hits_before, \
+        f"post-recovery results diverged:\n{hits_before}\nvs\n{hits_after}"
+    _stop_all(c)
+
+
+def test_relocation_recovery_warms_before_routing_flip(tmp_path):
+    """Draining a node relocates its shard through the block recovery
+    path; the target's progress record is typed RELOCATION and carries
+    the warm-handoff report — the dispatch grid was compiled and the
+    device arrays touched BEFORE the routing flip, so the first search
+    on the new home never pays compile latency."""
+    c = TestCluster(tmp_path, n_nodes=3, seed=73)
+    assert c.run_until(lambda: c.master() is not None
+                       and len(c.master().cluster_state.nodes) == 3)
+    c.any_node().client_create_index(
+        "move", settings={"index.number_of_shards": 1,
+                          "index.number_of_replicas": 0},
+        mappings=_vector_mapping())
+    assert c.run_until(lambda: c.all_started("move"))
+    w = c.any_node()
+    for i in range(25):
+        r = c.call(w.client_write, "move",
+                   {"type": "index", "id": str(i),
+                    "source": {"n": i, "v": _vec(i)}})
+        assert r["result"] == "created"
+
+    holder = next(nid for nid, n in c.nodes.items()
+                  if ("move", 0) in n.local_shards)
+    shard = c.nodes[holder].local_shards[("move", 0)]
+    shard.engine.flush()  # force the block path for the relocation too
+
+    r = c.call(c.any_node().client_update_settings,
+               {"cluster.routing.allocation.exclude._name": holder})
+    assert r.get("acknowledged"), r
+
+    def moved():
+        state = c.any_node().cluster_state
+        shards = state.shards_of("move")
+        return len(shards) == 1 \
+            and shards[0].state == ShardRoutingEntry.STARTED \
+            and shards[0].node_id != holder
+
+    assert c.run_until(moved, max_ms=240_000), \
+        [s.to_dict() for s in c.any_node().cluster_state.shards_of("move")]
+
+    new_home = c.any_node().cluster_state.shards_of("move")[0].node_id
+    target = c.nodes[new_home]
+    assert target.local_shards[("move", 0)].engine.doc_count() == 25
+    progs = [p for p in target.recoveries.values()
+             if p["index"] == "move" and p["stage"] == rp.STAGE_DONE]
+    assert progs, target.recoveries
+    prog = progs[-1]
+    assert prog["type"] == "RELOCATION"
+    assert "warm" in prog, "relocation finished without the warm handoff"
+    assert prog["warm"]["warmed_fields"] == ["v"]
+    assert prog["warm"]["warm_nanos"] >= 0
+    _stop_all(c)
+
+
+def test_recovery_backoff_retries_then_gives_up(tmp_path):
+    """A copy whose source keeps failing retries on the jittered
+    exponential schedule (throttle time accrues, no fixed interval) and,
+    at the attempt cap, reports the shard FAILED to the master instead
+    of spinning forever; once the source heals, the master's reroute
+    recovers the copy."""
+    c, primary, replica = _block_recovery_fixture(tmp_path, seed=79)
+    spare = next(n for n in c.nodes if n not in (primary, replica))
+    for n in c.nodes.values():
+        n._RECOVERY_RETRY_BASE_MS = 100
+        n._RECOVERY_MAX_ATTEMPTS = 4
+
+    # the recovery source now fails every RECOVERY_START deterministically
+    def broken(sender, request, respond):
+        raise RuntimeError("injected: source refuses recovery")
+
+    real = c.transport._handlers[primary][RECOVERY_START]
+    c.transport.register(primary, RECOVERY_START, broken)
+
+    c.transport.blackhole(replica)
+    c.nodes[replica].stop()
+
+    target = c.nodes[spare]
+    assert c.run_until(lambda: target.recovery_stats["giveups"] >= 1,
+                       max_ms=240_000), \
+        f"never gave up: {target.recovery_stats}"
+    stats = target.recovery_stats
+    assert stats["retries"] >= 3
+    assert stats["attempts"] >= 4
+    # the backoff wait was recorded as throttle time, and grew past the
+    # fixed-interval baseline (3 retries at base would be 300ms)
+    throttle = sum(p["throttle_ms"] for p in target.recoveries.values())
+    assert throttle > 3 * 100, throttle
+
+    # heal the source: the master's reroute after the failure report
+    # must eventually bring the copy back green
+    c.transport.register(primary, RECOVERY_START, real)
+    assert c.run_until(lambda: _replica_started_on(c, primary, spare),
+                       max_ms=240_000), "no recovery after heal"
+    assert target.local_shards[("dur", 0)].engine.doc_count() == 30
+    _stop_all(c)

@@ -33,7 +33,7 @@ from tools.tpulint.engine import (  # noqa: E402
     write_baseline,
 )
 
-RULE_IDS = tuple(f"TPU{i:03d}" for i in range(1, 14))
+RULE_IDS = tuple(f"TPU{i:03d}" for i in range(1, 15))
 
 
 def lint_fixture(name: str, rule: str):

@@ -1,0 +1,36 @@
+"""TPU014 clean: digest-verified blob reads, non-content-addressed
+keys, and read-only (or non-sealed) uses of engine state."""
+
+import hashlib
+
+
+def read_blob_verified(store, digest):
+    """The sanctioned shape: verify before the bytes escape."""
+    data = store.read_blob(f"blobs/{digest}")
+    if hashlib.sha256(data).hexdigest() != digest:
+        raise ValueError(f"blob [{digest}] failed digest verification")
+    return data
+
+
+def read_manifest(store, name):
+    # manifests are named, not content-addressed — out of scope
+    return store.read_blob(f"manifests/{name}.json")
+
+
+def inspect_engine(engine, doc_id):
+    # reading sealed state is fine; only mutation desyncs the commit
+    vv = engine.version_map.get(doc_id)
+    live = sum(len(rows) for rows in engine.deleted_rows.values())
+    return vv, live, len(engine.segments)
+
+
+def local_segments_are_not_engine_state(items):
+    segments = []
+    for item in items:
+        segments.append(item)
+    return segments
+
+
+def non_sealed_attrs_mutate_freely(node, alloc):
+    node.recoveries.pop(alloc, None)
+    node.recovery_stats.update({"attempts": 0})

@@ -1,0 +1,30 @@
+"""TPU014 fires: unverified content-blob reads and sealed-generation
+state mutated outside index/engine.py, segments/, recovery/."""
+
+
+def read_blob_no_verification(store):
+    """The 'just a peek' class: bytes flow out unverified."""
+    return store.read_blob("blobs/abc123")  # [expect]
+
+
+def size_probe(store, digests):
+    """Sizing blobs still reads them — a truncated blob reports a
+    plausible size and nobody ever notices."""
+    total = 0
+    for digest in digests:
+        total += len(store.read_blob(f"blobs/{digest}"))  # [expect]
+    return total
+
+
+def hijack_deleted_rows(engine, seg_id):
+    engine.deleted_rows[seg_id] = set()  # [expect]
+
+
+def hijack_version_map(engine, doc_id, vv):
+    engine.version_map.update({doc_id: vv})  # [expect]
+    del engine.version_map[doc_id]  # [expect]
+
+
+def hijack_segments(engine, seg):
+    engine.segments.append(seg)  # [expect]
+    engine.segments = []  # [expect]

@@ -53,6 +53,14 @@ DEFAULT_SEG_CACHE_ALLOWED = ("*/columnar/*.py",)
 # the one package allowed to hand-roll quantize/dequantize arithmetic
 # (TPU013): the vector codec registry every encoding routes through
 DEFAULT_QUANT_ALLOWED = ("*/quant/*.py",)
+# the modules allowed to mutate sealed-generation durable state
+# (TPU014): the engine that owns the commit point, the merge machinery,
+# and the recovery assembler that rebuilds commits byte-identically
+DEFAULT_DURABILITY_ALLOWED = (
+    "*/index/engine.py",
+    "*/segments/*.py",
+    "*/recovery/*.py",
+)
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -89,6 +97,7 @@ class Config:
     x64_allowed: Sequence[str] = DEFAULT_X64_ALLOWED
     seg_cache_allowed: Sequence[str] = DEFAULT_SEG_CACHE_ALLOWED
     quant_allowed: Sequence[str] = DEFAULT_QUANT_ALLOWED
+    durability_allowed: Sequence[str] = DEFAULT_DURABILITY_ALLOWED
     select: Optional[Sequence[str]] = None   # rule ids; None = all
 
 

@@ -1415,10 +1415,159 @@ class HandRolledQuantRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# TPU014 — durability discipline: verify content blobs, don't mutate
+# sealed-generation state outside its owners
+# ---------------------------------------------------------------------------
+
+class DurabilityRule(Rule):
+    """TPU014: durable-elasticity discipline (ISSUE 17).
+
+    Every byte in the content-addressed areas — repository `blobs/` and
+    the peer-recovery block cache — is named by its sha256, and every
+    consumer between the wire and an `Engine` re-verifies it: a torn
+    upload, a bit-rotted file, or a truncated chunk must surface as a
+    retryable digest failure, never as a silently corrupt commit the
+    shard then serves. Likewise the sealed-generation trio the commit
+    point captures (`segments` list, `deleted_rows`, `version_map`) is
+    mutated ONLY by its owners — the engine (indexing/merge), the
+    segments machinery, and the recovery assembler that rebuilds commits
+    byte-identically; a mutation anywhere else desyncs the live state
+    from the durable one, and the divergence only shows up after the
+    next restore. Two patterns fire:
+
+    * a `read_blob(...)` call whose key names the content-addressed
+      `blobs/` area, in a function with no digest-verification call
+      (sha256/digest/verify/crc32 in the callee name) — size probes and
+      "just a peek" reads included: route through the repository's
+      verified `get_bytes`, or verify inline;
+    * assignment to / deletion of / a mutating method call on an
+      attribute named `segments`, `deleted_rows` or `version_map`
+      outside the owning modules (`durability_allowed` globs).
+    """
+
+    rule_id = "TPU014"
+    summary = ("unverified content-blob read, or sealed-generation "
+               "state mutated outside its owners")
+
+    _SEALED = frozenset({"segments", "deleted_rows", "version_map"})
+    _MUTATORS = frozenset({"append", "add", "update", "pop", "popitem",
+                           "clear", "setdefault", "discard", "remove",
+                           "extend", "insert"})
+    _VERIFY_TOKENS = ("sha256", "digest", "verify", "crc32")
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        findings = self._unverified_blob_reads(ctx)
+        if not ctx.matches(ctx.config.durability_allowed):
+            findings.extend(self._sealed_mutations(ctx))
+        return findings
+
+    # -- unverified reads of content-addressed blobs ------------------------
+
+    def _unverified_blob_reads(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node).split(".")[-1] == "read_blob"
+                    and node.args
+                    and self._names_blob_area(node.args[0])):
+                continue
+            if self._scope_verifies(ctx, node):
+                continue
+            findings.append(ctx.finding(
+                self.rule_id, node,
+                "content-addressed blob read without digest "
+                "verification — a torn or bit-rotted blob flows "
+                "straight into the caller; route through the "
+                "repository's get_bytes (sha256-verified, raises "
+                "RepositoryError on mismatch) or verify the digest "
+                "in this function"))
+        return findings
+
+    @staticmethod
+    def _names_blob_area(arg: ast.AST) -> bool:
+        """The key expression mentions the content-addressed `blobs/`
+        prefix (plain string or any piece of an f-string)."""
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                    and "blobs/" in sub.value:
+                return True
+        return False
+
+    def _scope_verifies(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """Does the enclosing function (or the module, for top-level
+        code) CALL anything that verifies bytes? Mentioning a digest is
+        not enough — only a sha256/…/verify call counts as evidence."""
+        scope: ast.AST = ctx.tree
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = cur
+                break
+            cur = ctx.parents.get(cur)
+        for sub in ast.walk(scope):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not scope:
+                continue
+            if isinstance(sub, ast.Call):
+                callee = call_name(sub).split(".")[-1].lower()
+                if any(tok in callee for tok in self._VERIFY_TOKENS):
+                    return True
+        return False
+
+    # -- sealed-generation state mutated outside its owners -----------------
+
+    def _sealed_mutations(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def sealed_attr(expr: ast.AST):
+            """The sealed attribute an expression reaches through (e.g.
+            `eng.deleted_rows[k]` or `eng.version_map`), if any."""
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr in self._SEALED:
+                    return sub.attr
+            return None
+
+        def fire(node: ast.AST, attr: str, how: str) -> None:
+            findings.append(ctx.finding(
+                self.rule_id, node,
+                f"{how} of sealed-generation state [.{attr}] outside "
+                "its owners (index/engine.py, segments/, recovery/) — "
+                "the commit point no longer matches the live state, "
+                "and the divergence surfaces only after the next "
+                "restore; go through the engine's API instead"))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in self._SEALED:
+                        fire(node, t.attr, "assignment")
+                    elif isinstance(t, ast.Subscript):
+                        attr = sealed_attr(t.value)
+                        if attr is not None:
+                            fire(node, attr, "item assignment")
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = sealed_attr(t)
+                    if attr is not None:
+                        fire(node, attr, "deletion")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self._MUTATORS:
+                attr = sealed_attr(node.func.value)
+                if attr is not None:
+                    fire(node, attr, f"{node.func.attr}() mutation")
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
     ModuleCacheLockRule(), LockedSyncRule(), UnguardedFanoutRule(),
     PrivateSegmentCacheRule(), TelemetryDisciplineRule(),
-    HandRolledQuantRule(),
+    HandRolledQuantRule(), DurabilityRule(),
 ]
