@@ -2646,6 +2646,546 @@ def run_kill_and_replace(pre_ms: int = 4_000, green_max_ms: int = 120_000,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ------------------------------------------------------- 15_real_cluster
+
+def _rc_pump(loop, seconds: float) -> None:
+    """Run the coordinator's event loop for a wall-clock window. One
+    continuous `run_until_complete` per window (not a pump-in-slices
+    loop): callbacks fire on their real deadlines throughout."""
+    import asyncio
+    loop.run_until_complete(asyncio.sleep(seconds))
+
+
+def _rc_wait(loop, pred, timeout_s: float, what: str) -> None:
+    import asyncio
+
+    async def wait():
+        deadline = loop.time() + timeout_s
+        while not pred():
+            if loop.time() > deadline:
+                raise RuntimeError(f"timed out waiting for {what}")
+            await asyncio.sleep(0.02)
+
+    loop.run_until_complete(wait())
+
+
+def _rc_call(loop, fn, *args, timeout_s: float = 120.0, **kw):
+    """Callback API -> blocking call, driving the loop while waiting."""
+    box = {}
+    fn(*args, **kw, on_done=lambda r: box.update(r=r))
+    _rc_wait(loop, lambda: "r" in box, timeout_s,
+             getattr(fn, "__name__", "call"))
+    return box["r"]
+
+
+def _rc_boot(child_ids, tmp, *, cluster_settings=None, policy_config=None,
+             env=None, coord_id="coord"):
+    """Launch one OS process per child id and join an in-parent
+    coordinating-only node (roles={"master"}: it votes and coordinates
+    but never holds copies, so every data leg crosses a real socket)."""
+    import asyncio
+    import os as _os
+
+    from elasticsearch_tpu.cluster.launcher import (
+        find_free_ports, join_cluster, launch_nodes)
+
+    all_ids = list(child_ids) + [coord_id]
+    ports = find_free_ports(len(all_ids))
+    peers = {nid: ("127.0.0.1", p) for nid, p in zip(all_ids, ports)}
+    procs = launch_nodes(list(child_ids), tmp, peers, masters=all_ids,
+                         policy_config=policy_config,
+                         cluster_settings=cluster_settings, env=env)
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    try:
+        coord, transport = join_cluster(
+            coord_id, _os.path.join(tmp, coord_id), peers, all_ids, loop,
+            cluster_settings=cluster_settings, roles={"master"})
+        _rc_wait(loop,
+                 lambda: (len(coord.cluster_state.nodes) == len(all_ids)
+                          and coord.cluster_state.master_node_id),
+                 90.0, "cluster formation")
+    except Exception:
+        for p in procs:
+            p.terminate()
+        raise
+    return procs, coord, transport, loop
+
+
+def _rc_teardown(procs, coord, transport, loop) -> None:
+    try:
+        coord.stop()
+    except Exception:
+        pass
+    try:
+        loop.run_until_complete(transport.close())
+    except Exception:
+        pass
+    for p in procs:
+        try:
+            p.terminate()
+        except Exception:
+            pass
+    try:
+        loop.close()
+    except Exception:
+        pass
+
+
+def _rc_write_docs(loop, coord, index, docs, chunk: int = 32) -> None:
+    """Index (doc_id, source) pairs with `chunk` writes in flight."""
+    i = 0
+    while i < len(docs):
+        part = docs[i:i + chunk]
+        box = {"n": 0}
+        bump = lambda *_a, b=box: b.__setitem__("n", b["n"] + 1)  # noqa: E731
+        for doc_id, src in part:
+            coord.client_write(index, {"type": "index", "id": doc_id,
+                                       "source": src},
+                               on_done=bump, on_failure=bump)
+        _rc_wait(loop, lambda: box["n"] == len(part), 120.0,
+                 f"write chunk at {i}")
+        i += chunk
+
+
+def _rc_pct(lats, q):
+    if not lats:
+        return 0.0
+    return float(np.percentile(np.asarray(lats, dtype=np.float64), q))
+
+
+def _rc_sim_closed_loop(n_docs: int, shards: int, n_clients: int,
+                        per_client: int):
+    """The virtual-time baseline: the IDENTICAL workload (coordinating-
+    only coordinator + 3 data nodes, same index shape, same doc count,
+    same closed-loop client count) on the deterministic simulator with
+    its seeded 1-50ms hops. Returns (p50_ms, p99_ms) in VIRTUAL ms —
+    the wall-clock row reports itself against these so the record shows
+    what the sim regime claimed for the same topology."""
+    import os as _os
+    import shutil
+    import tempfile
+
+    from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+    from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport)
+
+    queue = DeterministicTaskQueue(seed=29)
+    transport = DisruptableTransport(queue)
+    tmp = tempfile.mkdtemp()
+    data_ids = ["d0", "d1", "d2"]
+    all_ids = data_ids + ["coord"]
+    initial = bootstrap_state(sorted(all_ids))
+    nodes = {nid: ClusterNode(
+        nid, _os.path.join(tmp, nid), transport, queue,
+        [p for p in all_ids if p != nid], initial,
+        roles={"master"} if nid == "coord" else None)
+        for nid in all_ids}
+    try:
+        for n in nodes.values():
+            n.start()
+        for _ in range(600):
+            queue.run_for(200)
+            ms = [n for n in nodes.values() if n.is_master]
+            if ms and len(ms[0].cluster_state.nodes) == len(all_ids):
+                break
+        coord = nodes["coord"]
+
+        def call(fn, *args, **kw):
+            box = {}
+            fn(*args, **kw, on_done=lambda r: box.update(r=r))
+            for _ in range(600):
+                queue.run_for(200)
+                if "r" in box:
+                    return box["r"]
+            raise RuntimeError(f"no response from {fn.__name__}")
+
+        call(coord.client_create_index, "docs",
+             settings={"index.number_of_shards": shards,
+                       "index.number_of_replicas": 1},
+             mappings={"properties": {"title": {"type": "text"},
+                                      "n": {"type": "long"}}})
+
+        def all_started():
+            rs = coord.cluster_state.shards_of("docs")
+            return bool(rs) and all(
+                r.state == ShardRoutingEntry.STARTED for r in rs)
+
+        for _ in range(600):
+            queue.run_for(200)
+            if all_started():
+                break
+        for i in range(n_docs):
+            call(coord.client_write, "docs",
+                 {"type": "index", "id": f"d{i}",
+                  "source": {"title": f"doc {i}", "n": i}})
+        call(coord.client_refresh, "docs")
+
+        lats = []
+        left = {"n": n_clients * per_client}
+
+        def issue(ci, remaining):
+            t0 = queue.now_ms
+
+            def done(resp):
+                lats.append(queue.now_ms - t0)
+                left["n"] -= 1
+                if remaining > 1:
+                    queue.schedule_in(5, lambda: issue(ci, remaining - 1),
+                                      f"sim_client:{ci}")
+
+            coord.client_search("docs", {"query": {"match_all": {}},
+                                         "size": 10}, done)
+
+        for ci in range(n_clients):
+            issue(ci, per_client)
+        for _ in range(2000):
+            queue.run_for(200)
+            if left["n"] == 0:
+                break
+        return _rc_pct(lats, 50), _rc_pct(lats, 99)
+    finally:
+        for n in nodes.values():
+            try:
+                if not n.coordinator.stopped:
+                    n.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_real_cluster(pre_s: float = 4.0, post_s: float = 12.0,
+                     n_docs: int = 120, shards: int = 4,
+                     n_clients: int = 4, per_client: int = 60):
+    """Config 15: the first WALL-CLOCK cross-node rows — every number in
+    configs 10/14 and the fan-out suite before this PR was virtual-time
+    simulation. Three data nodes run as separate OS processes booted by
+    `cluster/launcher.py`, each serving `transport/tcp.py`'s framed
+    binary protocol on a real socket; the coordinator joins in-process
+    as a coordinating-only node (no data role), so every query leg,
+    write replication hop, and cluster-state publication crosses a
+    kernel socket boundary between processes. Rows carry
+    `simulated: false, virtual_time: false`.
+
+    Scenario `closed_loop`: fixed-count closed-loop match_all clients;
+    reports wall p50/p99/qps next to the sim-regime baseline (the same
+    topology and workload on the deterministic simulator, virtual ms).
+
+    Scenario `node_kill`: config 10 re-measured over sockets — closed-
+    loop clients + a 25/s write ticker, then SIGKILL a copy-holding
+    child (no FIN help from a closing runtime; peers learn from dead
+    sockets and fault timeouts). Same gates as config 10 with one
+    honest difference: over real sockets node death is DETECTABLE (a
+    reset/EOF fails the leg fast), so degradation shows as failed-shard
+    partials as often as budget timeouts — `degraded_partials` counts
+    both and feeds the `partials > 0` term of
+    `gate_graceful_degradation`.
+    """
+    import shutil
+    import tempfile
+    import time as _time
+
+    from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+    from elasticsearch_tpu.serving import router as router_lib
+
+    query_budget_ms, grace_ms = 400, 100
+    tmp = tempfile.mkdtemp()
+    child_ids = ["d0", "d1", "d2"]
+    settings = {"search.fanout.query_budget_ms": query_budget_ms,
+                "search.fanout.fetch_budget_ms": query_budget_ms,
+                "search.fanout.deadline_grace_ms": grace_ms}
+    router_lib.reset()
+    procs, coord, transport, loop = _rc_boot(
+        child_ids, tmp, cluster_settings=settings)
+    try:
+        _rc_call(loop, coord.client_create_index, "kill",
+                 settings={"index.number_of_shards": shards,
+                           "index.number_of_replicas": 1},
+                 mappings={"properties": {"title": {"type": "text"},
+                                          "n": {"type": "long"}}})
+
+        def all_started():
+            rs = coord.cluster_state.shards_of("kill")
+            return bool(rs) and all(
+                r.state == ShardRoutingEntry.STARTED for r in rs)
+
+        _rc_wait(loop, all_started, 120.0, "shards STARTED")
+        _rc_write_docs(loop, coord, "kill",
+                       [(f"d{i}", {"title": f"doc {i}", "n": i})
+                        for i in range(n_docs)])
+        refreshed = _rc_call(loop, coord.client_refresh, "kill")
+        body = {"query": {"match_all": {}}, "size": 10}
+        for _ in range(6):  # warm per-shard query paths in every child
+            _rc_call(loop, coord.client_search, "kill", dict(body))
+
+        # ---------------------------------------- scenario: closed_loop
+        lats = []
+        left = {"n": n_clients * per_client}
+
+        def issue_fixed(ci, remaining):
+            t0 = loop.time()
+
+            def done(resp):
+                lats.append((loop.time() - t0) * 1000.0)
+                left["n"] -= 1
+                if remaining > 1:
+                    issue_fixed(ci, remaining - 1)
+
+            coord.client_search("kill", dict(body), done)
+
+        t_wall = _time.perf_counter()
+        for ci in range(n_clients):
+            issue_fixed(ci, per_client)
+        _rc_wait(loop, lambda: left["n"] == 0, 180.0, "closed-loop drain")
+        wall = _time.perf_counter() - t_wall
+        p50, p99 = _rc_pct(lats, 50), _rc_pct(lats, 99)
+        sim_p50, sim_p99 = _rc_sim_closed_loop(n_docs, shards, n_clients,
+                                               per_client)
+        print(json.dumps({
+            "config": "15_real_cluster", "scenario": "closed_loop",
+            "simulated": False, "virtual_time": False,
+            "transport": "tcp_sockets",
+            "processes": len(child_ids) + 1,
+            "n_docs": n_docs, "shards": shards, "replicas": 1,
+            "n_clients": n_clients, "searches": len(lats),
+            "refresh_failed_shards": (refreshed.get("_shards") or {})
+            .get("failed"),
+            "qps": round(n_clients * per_client / wall, 1),
+            "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+            "p99_over_p50": round(p99 / max(p50, 1e-9), 2),
+            "gate_p99_le_3x_p50": bool(p99 <= 3 * p50),
+            "sim_baseline": {"virtual_time": True,
+                             "p50_ms": round(sim_p50, 1),
+                             "p99_ms": round(sim_p99, 1)},
+        }), flush=True)
+
+        # ------------------------------------------ scenario: node_kill
+        ingest = {"sent": 0, "acked": 0}
+        stop = {"done": False}
+
+        def write_tick():
+            if stop["done"]:
+                return
+            i = ingest["sent"]
+            ingest["sent"] += 1
+            coord.client_write(
+                "kill", {"type": "index", "id": f"w{i}",
+                         "source": {"title": f"live {i}", "n": i}},
+                on_done=lambda r: ingest.__setitem__(
+                    "acked", ingest["acked"] + 1),
+                on_failure=lambda e: None)
+            loop.call_later(0.04, write_tick)
+
+        # (t_done_s, took_ms, ok_shards, total, timed_out, err, client)
+        records = []
+
+        def issue(ci):
+            t0 = loop.time()
+
+            def done(resp):
+                sh = resp.get("_shards") or {}
+                records.append((loop.time(), (loop.time() - t0) * 1000.0,
+                                sh.get("successful", 0),
+                                sh.get("total", shards),
+                                bool(resp.get("timed_out")),
+                                "error" in resp, ci))
+                if not stop["done"]:
+                    loop.call_later(0.005, issue, ci)
+
+            coord.client_search("kill", dict(body), done)
+
+        write_tick()
+        for ci in range(n_clients):
+            issue(ci)
+        _rc_pump(loop, pre_s)
+        kill_at = loop.time()
+        pre = list(records)
+
+        master_id = coord.cluster_state.master_node_id
+        held = {}
+        for r in coord.cluster_state.shards_of("kill"):
+            if r.state == ShardRoutingEntry.STARTED and r.node_id:
+                held[r.node_id] = held.get(r.node_id, 0) + 1
+        victim = next(nid for nid in sorted(held)
+                      if nid not in (coord.node_id, master_id))
+        # config-10 idiom: drop the victim from the cost table so copy
+        # selection probes it (unmeasured ranks first) — the kill must
+        # hit a node that is actually serving
+        coord._ars_ewma.pop(victim, None)
+        next(p for p in procs if p.node_id == victim).kill()
+        _rc_pump(loop, post_s)
+        stop["done"] = True
+        _rc_pump(loop, 1.0)  # drain in-flight responses
+
+        post = [r for r in records if r[0] > kill_at]
+        pre_p99 = _rc_pct([r[1] for r in pre], 99)
+        post_p99 = _rc_pct([r[1] for r in post], 99)
+        completeness = [r[2] / max(r[3], 1) for r in post]
+        final_window = [r[2] / max(r[3], 1) for r in post
+                        if r[0] > kill_at + post_s - 2.0]
+        errors = sum(1 for r in records if r[5])
+        timeouts = sum(1 for r in post if r[4])
+        degraded = sum(1 for r in post if r[4] or r[2] < r[3])
+        bound_ms = pre_p99 + query_budget_ms + grace_ms + 200
+        row = {
+            "config": "15_real_cluster", "scenario": "node_kill",
+            "simulated": False, "virtual_time": False,
+            "transport": "tcp_sockets",
+            "processes": len(child_ids) + 1,
+            "n_docs": n_docs, "shards": shards, "replicas": 1,
+            "n_clients": n_clients, "victim": victim,
+            "searches_pre": len(pre), "searches_post": len(post),
+            "pre_p50_ms": round(_rc_pct([r[1] for r in pre], 50), 1),
+            "pre_p99_ms": round(pre_p99, 1),
+            "post_p50_ms": round(_rc_pct([r[1] for r in post], 50), 1),
+            "post_p99_ms": round(post_p99, 1),
+            "p99_bound_ms": round(bound_ms, 1),
+            "timed_out_partials": timeouts,
+            "degraded_partials": degraded,
+            "error_responses": errors,
+            "completeness_min": round(min(completeness), 3)
+            if completeness else 0.0,
+            "completeness_final_window": round(
+                sum(final_window) / len(final_window), 3)
+            if final_window else 0.0,
+            "ingest_sent": ingest["sent"], "ingest_acked": ingest["acked"],
+            "router": router_lib.stats(),
+            "gate_no_hang": bool(post and all(
+                any(r[6] == ci and r[0] > kill_at + post_s - 2.0
+                    for r in post)
+                for ci in range(n_clients))),
+            "gate_no_error_cliff": bool(errors == 0),
+            "gate_p99_bounded": bool(post_p99 <= bound_ms),
+            "gate_completeness_recovers": bool(
+                final_window and
+                sum(final_window) / len(final_window) >= 0.999),
+        }
+        row["gate_graceful_degradation"] = bool(
+            row["gate_no_hang"] and row["gate_no_error_cliff"]
+            and row["gate_p99_bounded"]
+            and row["gate_completeness_recovers"] and degraded > 0)
+        print(json.dumps(row), flush=True)
+    finally:
+        _rc_teardown(procs, coord, transport, loop)
+        shutil.rmtree(tmp, ignore_errors=True)
+    _rc_dp_sweep()
+
+
+def _rc_dp_sweep(dims: int = 64, n_docs: int = 2048, n_clients: int = 4,
+                 per_client: int = 25):
+    """Config 15 dp rows: the config-6 dp qps sweep re-measured with the
+    query arriving over a REAL socket. One data child is launched with 8
+    forced host devices and the mesh policy configured at boot
+    (`--policy`); the coordinator fans kNN bodies to it over TCP, so
+    each row's qps includes framing, the socket round trip, and the
+    child's dp-vs-shard split decision under live queue depth. dp=1 is
+    the full-mesh-only baseline; the sweep reports the dp=4 ratio and a
+    cross-run parity check on a pinned query (the dp split must never
+    change bytes)."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    rng = np.random.default_rng(71)
+    vecs = rng.standard_normal((n_docs, dims)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    pin = rng.standard_normal(dims).astype(np.float32)
+    pin /= np.linalg.norm(pin)
+    results = {}
+    for dp in (1, 4):
+        tmp = tempfile.mkdtemp()
+        procs, coord, transport, loop = _rc_boot(
+            ["v0"], tmp,
+            policy_config={"enabled": True, "dp": dp,
+                           "num_shards": 8 // dp, "min_rows": 1},
+            env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+        try:
+            from elasticsearch_tpu.cluster.state import ShardRoutingEntry
+            _rc_call(loop, coord.client_create_index, "vec",
+                     settings={"index.number_of_shards": 1,
+                               "index.number_of_replicas": 0},
+                     mappings={"properties": {
+                         "n": {"type": "long"},
+                         "v": {"type": "dense_vector", "dims": dims,
+                               "index": True,
+                               "similarity": "dot_product"}}})
+            _rc_wait(loop, lambda: all(
+                r.state == ShardRoutingEntry.STARTED
+                for r in (coord.cluster_state.shards_of("vec") or [None])
+                if r is not None) and bool(
+                    coord.cluster_state.shards_of("vec")),
+                120.0, "vec shard STARTED")
+            _rc_write_docs(loop, coord, "vec",
+                           [(f"d{i}", {"n": i,
+                                       "v": [float(x) for x in vecs[i]]})
+                            for i in range(n_docs)], chunk=64)
+            _rc_call(loop, coord.client_refresh, "vec")
+
+            def knn_body(q):
+                return {"knn": {"field": "v",
+                                "query_vector": [float(x) for x in q],
+                                "k": 10, "num_candidates": 64},
+                        "size": 10, "_source": False}
+
+            # warmup: both route families (full mesh + dp group) compile
+            # in the child before the timed loop
+            for i in range(8):
+                _rc_call(loop, coord.client_search, "vec",
+                         knn_body(vecs[i]), timeout_s=300.0)
+            pinned = _rc_call(loop, coord.client_search, "vec",
+                              knn_body(pin))
+            pinned_hits = [(h["_id"], h["_score"])
+                           for h in pinned["hits"]["hits"]]
+
+            lats = []
+            left = {"n": n_clients * per_client}
+
+            def issue(ci, remaining):
+                t0 = loop.time()
+
+                def done(resp):
+                    lats.append((loop.time() - t0) * 1000.0)
+                    left["n"] -= 1
+                    if remaining > 1:
+                        issue(ci, remaining - 1)
+
+                q = vecs[(ci * per_client + remaining) % n_docs]
+                coord.client_search("vec", knn_body(q), done)
+
+            t_wall = _time.perf_counter()
+            for ci in range(n_clients):
+                issue(ci, per_client)
+            _rc_wait(loop, lambda: left["n"] == 0, 300.0, "dp sweep drain")
+            wall = _time.perf_counter() - t_wall
+            qps = n_clients * per_client / wall
+            results[dp] = (qps, pinned_hits)
+            print(json.dumps({
+                "config": "15_real_cluster", "scenario": "dp_sweep",
+                "simulated": False, "virtual_time": False,
+                "transport": "tcp_sockets", "dp": dp,
+                "num_shards": 8 // dp, "devices_in_child": 8,
+                "n_docs": n_docs, "dims": dims,
+                "n_clients": n_clients, "searches": len(lats),
+                "qps": round(qps, 1),
+                "p50_ms": round(_rc_pct(lats, 50), 2),
+                "p99_ms": round(_rc_pct(lats, 99), 2),
+                "measures": "socket_rtt_plus_scheduling_not_ici",
+            }), flush=True)
+        finally:
+            _rc_teardown(procs, coord, transport, loop)
+            shutil.rmtree(tmp, ignore_errors=True)
+    q1, q4 = results[1][0], results[4][0]
+    print(json.dumps({
+        "config": "15_real_cluster", "scenario": "dp_sweep_summary",
+        "simulated": False, "virtual_time": False,
+        "qps_dp1": round(q1, 1), "qps_dp4": round(q4, 1),
+        "speedup_dp4_vs_dp1": round(q4 / max(q1, 1e-9), 2),
+        "parity_dp4_vs_dp1": bool(results[1][1] == results[4][1]),
+    }), flush=True)
+
+
 def run_rest_closed_loop_dp():
     """PR 11 leftover (b): the REST closed-loop rows (`1cl`/`4cl`,
     hybrid) served dp=1 shapes — point their corpora at a dp mesh
@@ -2692,6 +3232,13 @@ def main():
         run_dp_replicated()
         return
 
+    if "--real-cluster-only" in sys.argv:
+        # the wall-clock multi-process rows alone (config 15): boots
+        # child node processes, so it gets its own entry point for
+        # re-measurement without re-running the kernel matrix
+        run_real_cluster()
+        return
+
     if "--sharded-only" in sys.argv:
         # the simulated-mesh child re-exec (run_sharded_fused): emit the
         # config-6 rows only, on whatever device mesh this process sees
@@ -2726,6 +3273,7 @@ def main():
     guarded(run_telemetry_overhead)
     guarded(run_fanout_node_kill)
     guarded(run_kill_and_replace)
+    guarded(run_real_cluster)
     guarded(run_config, "1_cosine_sift1m", 1_000_000, 128, "cosine",
             "bf16")
     guarded(run_config, "2_l2_gist_960d", 262_144, 960, "l2_norm", "bf16")

@@ -370,9 +370,15 @@ def _weights(state: ClusterState, index: str) -> Dict[str, float]:
 def _pick_node(entry: ShardRoutingEntry, ctx: AllocationContext,
                exclude: Set[str]) -> Optional[str]:
     """Lowest-weight node the decider chain allows (THROTTLE defers:
-    reroute() runs again on the next state change)."""
+    reroute() runs again on the next state change). Weight ties break on
+    the unified dispatch cost model (serving/router.py) — a new copy
+    lands on the less-loaded of two equally-balanced nodes — and then on
+    node name, so allocation with no serving traffic stays the
+    historical deterministic order."""
+    from elasticsearch_tpu.serving import router as dispatch_router
     weights = _weights(ctx.state, entry.index)
-    candidates = sorted((w, n) for n, w in weights.items() if n not in exclude)
+    candidates = dispatch_router.placement_order(
+        (w, n) for n, w in weights.items() if n not in exclude)
     for _, node in candidates:
         if decide_allocate(entry, node, ctx) == YES:
             return node

@@ -129,7 +129,13 @@ class LocalShard:
             # same degradation contract: bad replicated value -> feature
             # stays off, never crash the state applier
             semantic_cache_settings = {}
-        self.vector_store = VectorStoreShard(
+        # lightweight-shard materialization (lazy device store): the
+        # VectorStoreShard — batcher threads, device mirrors, IVF state —
+        # is only built when the index actually has vector fields or a
+        # recovery seed to apply. A text-only shard on a 3-node cluster
+        # costs a bare engine, not 3x device-store setup.
+        self._vector_store: Optional[VectorStoreShard] = None
+        self._vector_store_kwargs = dict(
             dtype=s.get("index.knn.vector_dtype", "bf16"),
             knn_engine=knn_engine, knn_nlist=knn_nlist,
             knn_nprobe=knn_nprobe,
@@ -140,6 +146,13 @@ class LocalShard:
             **segments_settings, **semantic_cache_settings)
         self._attach_engine(engine)
 
+    @property
+    def vector_store(self) -> VectorStoreShard:
+        if self._vector_store is None:
+            self._vector_store = VectorStoreShard(
+                **self._vector_store_kwargs)
+        return self._vector_store
+
     def _attach_engine(self, engine: Engine) -> None:
         self.engine = engine
         engine.retained_seq_no_provider = self._min_retained_seq_no
@@ -147,7 +160,9 @@ class LocalShard:
         # blocks + IVF layout); apply it BEFORE the first vector sync so
         # block recovery never re-encodes or re-trains (recovery/seed.py)
         from elasticsearch_tpu.recovery import seed as recovery_seed
-        recovery_seed.maybe_apply(engine, self.vector_store)
+        if (self.mapper_service.vector_fields()
+                or recovery_seed.has_sidecar(engine.path)):
+            recovery_seed.maybe_apply(engine, self.vector_store)
         engine.add_refresh_listener(self._sync_vectors)
         self._sync_vectors(engine.acquire_searcher())
 
@@ -166,12 +181,22 @@ class LocalShard:
         if vf:
             self.vector_store.sync(reader, vf)
 
+    def active_vector_store(self) -> Optional[VectorStoreShard]:
+        """The device store when this shard serves vectors; None for a
+        text-only shard, so the query path never materializes the lazy
+        store just to ignore it."""
+        if self._vector_store is not None:
+            return self._vector_store
+        return self.vector_store if self.mapper_service.vector_fields() \
+            else None
+
 
 class ClusterNode:
     def __init__(self, node_id: str, data_path: str, transport, scheduler,
                  seed_peers: List[str], initial_state: ClusterState,
                  rng=None, address: str = "",
-                 attributes: Optional[Dict[str, str]] = None):
+                 attributes: Optional[Dict[str, str]] = None,
+                 roles: Optional[Set[str]] = None):
         self.node_id = node_id
         self.data_path = data_path
         self.transport = transport
@@ -199,7 +224,21 @@ class ClusterNode:
         # per-phase fan-out accounting + data-plane remote-shed tallies;
         # surfaced through `_nodes/stats fanout` and `profile.fanout`
         self.fanout_stats = fanout_lib.FanoutStats()
-        node = DiscoveryNode(node_id, address=address, attributes=attributes)
+        # unified dispatch cost router (serving/router.py): queue wait +
+        # transport RTT EWMA + device-leg estimate per candidate route.
+        # The RTT feed exists only on the TCP transport; the sim
+        # transport's cost collapses to the classic ARS ranking.
+        from elasticsearch_tpu.serving import router as router_lib
+        self._router = router_lib.DispatchRouter(
+            node_id, rtt_provider=getattr(transport, "rtt_ms", None))
+        # ARS back-compat alias: tests and the bench harness read/pop
+        # this dict directly — it IS the router's service-time EWMA table
+        self._ars_ewma = self._router.service_ewma
+        # roles gate allocation: a coordinating-only node (no "data")
+        # never receives shard copies — the multi-process bench joins its
+        # in-parent coordinator this way so every search leg is remote
+        node = DiscoveryNode(node_id, address=address, roles=roles,
+                             attributes=attributes)
         # durable gateway: term + last-accepted state survive full-cluster
         # restarts (PersistedClusterStateService/GatewayMetaState analog);
         # initial_state seeds only a never-booted node
@@ -804,8 +843,18 @@ class ClusterNode:
     def recovery_summary(self) -> dict:
         """`_nodes/stats indices.recovery` section for this node."""
         from elasticsearch_tpu.recovery import progress as rp
-        return rp.summarize(self.recoveries.values(), self.recovery_stats,
-                            current_as_source=len(self._recovery_sources))
+        from elasticsearch_tpu.recovery.snapshot import NODE_STREAM_LIMITER
+        out = rp.summarize(self.recoveries.values(), self.recovery_stats,
+                           current_as_source=len(self._recovery_sources))
+        streams = dict(NODE_STREAM_LIMITER.stats)
+        streams["max_streams"] = NODE_STREAM_LIMITER.max_streams
+        streams["max_bytes_per_sec"] = NODE_STREAM_LIMITER.max_bytes_per_sec
+        # bounded-concurrency snapshot block upload + per-node byte-rate
+        # throttle (recovery/snapshot.py limiter)
+        out["snapshot_streams"] = streams
+        out["throttle_time_in_millis"] = int(
+            streams["throttle_time_in_millis"])
+        return out
 
     def _run_phase1(self, local: LocalShard, primary_node: str,
                     phase1: dict) -> None:
@@ -1194,24 +1243,14 @@ class ClusterNode:
     # ------------------------------------------------------------ search path
     def _select_copy(self, copies: List[ShardRoutingEntry],
                      sid: int) -> ShardRoutingEntry:
-        """Adaptive replica selection: rank copies by the node's query-
-        latency EWMA (SearchExecutionStatsCollector analog); unmeasured
-        nodes rank first so every copy gets probed, ties rotate by shard."""
-        ewma = getattr(self, "_ars_ewma", {})
-
-        def rank(i_copy):
-            i, copy = i_copy
-            stat = ewma.get(copy.node_id)
-            return (0 if stat is None else 1, stat or 0.0, (i + sid) % len(copies))
-
-        return min(enumerate(copies), key=rank)[1]
+        """Adaptive replica selection through the unified dispatch cost
+        router (SearchExecutionStatsCollector analog): lowest estimated
+        queue-wait + RTT + device-leg cost wins; unmeasured nodes rank
+        first so every copy gets probed, ties rotate by shard."""
+        return self._router.select_copy(copies, sid)
 
     def _ars_observe(self, node_id: str, took_ms: float) -> None:
-        ewma = getattr(self, "_ars_ewma", None)
-        if ewma is None:
-            ewma = self._ars_ewma = {}
-        prev = ewma.get(node_id)
-        ewma[node_id] = took_ms if prev is None else 0.7 * prev + 0.3 * took_ms
+        self._router.observe(node_id, float(took_ms))
 
     def resolve_indices(self, expression: Optional[str]) -> List[str]:
         """Index-name expression → concrete index names from the cluster
@@ -1768,7 +1807,7 @@ class ClusterNode:
                 result = execute_query_phase(
                     reader, local.mapper_service, body,
                     shard_id=request["shard"],
-                    vector_store=local.vector_store,
+                    vector_store=local.active_vector_store(),
                     partial_aggs=True,
                     query_cache=self.caches.query,
                     deadline_at=deadline_at)
@@ -1847,7 +1886,7 @@ class ClusterNode:
         body.pop("aggregations", None)
         result = execute_query_phase(reader, local.mapper_service, body,
                                      shard_id=request["shard"],
-                                     vector_store=local.vector_store,
+                                     vector_store=local.active_vector_store(),
                                      query_cache=self.caches.query)
         ctx_id = _uuid.uuid4().hex
         keep_s = float(request.get("keep_alive_s", 300))

@@ -1,0 +1,298 @@
+"""Multi-process cluster launcher: boot N data nodes as real processes.
+
+Each data node is its own OS process with its own event loop, engine set,
+columnar store, and device corpus, serving the framed binary protocol of
+`transport/tcp.py` on a real socket — the production counterpart of the
+in-process simulator clusters the test suite runs. The coordinator (the
+parent process, or any other launched node) joins the same cluster over
+TCP; `ClusterNode`/`Coordinator` code is identical on both sides.
+
+Two surfaces:
+
+* CLI (child side): `python -m elasticsearch_tpu.cluster.launcher
+  --node-id n1 --port 9301 --data-path /tmp/n1 \
+  --peers n0=127.0.0.1:9300,n1=127.0.0.1:9301 --masters n0,n1`
+  boots ONE data node and serves until killed. Prints
+  `LAUNCHER_READY <node_id> <port>` on stdout once bound.
+
+* `launch_nodes(...)` (parent side): picks ports, spawns the children,
+  waits for their ready lines, and returns `NodeProcess` handles with
+  `kill()` (SIGKILL — the node-death bench primitive) and
+  `terminate()`. `join_cluster(...)` then builds the parent's own
+  in-process `ClusterNode` wired to the same peer set over TCP.
+
+The launcher is how `15_real_cluster` bench rows get their
+`simulated: false` label: every cross-node byte crosses a kernel socket
+boundary between processes, and time is wall-clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_HOST = "127.0.0.1"
+READY_PREFIX = "LAUNCHER_READY"
+
+
+# --------------------------------------------------------------- addressing
+
+def find_free_ports(n: int, host: str = DEFAULT_HOST) -> List[int]:
+    """Reserve n distinct ephemeral ports by binding then releasing them.
+    The small release-to-rebind race is acceptable on loopback — the
+    alternative (children choosing ports) needs a rendezvous channel
+    before the cluster exists to provide one."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def format_peers(peers: Dict[str, Tuple[str, int]]) -> str:
+    return ",".join(f"{nid}={host}:{port}"
+                    for nid, (host, port) in sorted(peers.items()))
+
+
+def parse_peers(spec: str) -> Dict[str, Tuple[str, int]]:
+    peers: Dict[str, Tuple[str, int]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        nid, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        peers[nid] = (host, int(port))
+    return peers
+
+
+# ------------------------------------------------------------- child process
+
+def run_data_node(node_id: str, port: int, data_path: str,
+                  peers: Dict[str, Tuple[str, int]],
+                  masters: List[str], host: str = DEFAULT_HOST,
+                  policy_config: Optional[dict] = None,
+                  cluster_settings: Optional[dict] = None,
+                  ready_out=None) -> None:
+    """Child-process entry: boot one data node and serve forever.
+
+    Builds the node's own event loop, binds the TCP transport, seeds the
+    peer address book, and starts a `ClusterNode` whose discovery address
+    is this socket — so any node that learns of us through a committed
+    cluster state can also dial us. Blocks in `loop.run_forever()`."""
+    from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+    from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.transport.tcp import (
+        AsyncioScheduler, TcpTransportService)
+
+    if policy_config:
+        from elasticsearch_tpu.parallel import policy
+        policy.configure(**policy_config)
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    transport = TcpTransportService(node_id, host=host, port=port, loop=loop)
+    loop.run_until_complete(transport.bind())
+    for peer_id, (phost, pport) in peers.items():
+        if peer_id != node_id:
+            transport.add_peer_address(peer_id, phost, pport)
+    # bootstrap_state is deterministic for a fixed master list: every
+    # process persists the identical version-0 state before first start
+    initial = bootstrap_state(sorted(masters))
+    if cluster_settings:
+        initial = initial.with_(settings={**initial.settings,
+                                          **cluster_settings})
+    seed = sum(ord(c) for c in node_id)  # stable per node, differs by id
+    scheduler = AsyncioScheduler(loop, seed=seed)
+    node = ClusterNode(
+        node_id, data_path, transport, scheduler,
+        seed_peers=[p for p in sorted(peers) if p != node_id],
+        initial_state=initial,
+        address=f"{host}:{transport.port}")
+    node.start()
+    out = ready_out or sys.stdout
+    print(f"{READY_PREFIX} {node_id} {transport.port}", file=out, flush=True)
+    try:
+        loop.run_forever()
+    finally:
+        try:
+            node.stop()
+            loop.run_until_complete(transport.close())
+        except Exception:
+            pass
+        loop.close()
+
+
+# ------------------------------------------------------------ parent helpers
+
+@dataclass
+class NodeProcess:
+    node_id: str
+    host: str
+    port: int
+    proc: subprocess.Popen = field(repr=False)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.host, self.port
+
+    def kill(self) -> None:
+        """SIGKILL — the unclean node-death the fault benches measure:
+        no FIN handshake help from a closing runtime, peers discover the
+        death from dead sockets and fault timeouts alone."""
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=30)
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+def launch_nodes(node_ids: List[str], base_dir: str,
+                 peers: Dict[str, Tuple[str, int]],
+                 masters: List[str],
+                 policy_config: Optional[dict] = None,
+                 cluster_settings: Optional[dict] = None,
+                 env: Optional[dict] = None,
+                 ready_timeout_s: float = 120.0) -> List[NodeProcess]:
+    """Spawn one data-node process per id (each id must appear in
+    `peers` with its pre-reserved port) and wait for every child's
+    ready line. Children inherit JAX_PLATFORMS etc. from `env`."""
+    procs: List[NodeProcess] = []
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    if env:
+        child_env.update(env)
+    for nid in node_ids:
+        host, port = peers[nid]
+        cmd = [sys.executable, "-m", "elasticsearch_tpu.cluster.launcher",
+               "--node-id", nid, "--host", host, "--port", str(port),
+               "--data-path", os.path.join(base_dir, nid),
+               "--peers", format_peers(peers),
+               "--masters", ",".join(sorted(masters))]
+        if policy_config:
+            cmd += ["--policy", json.dumps(policy_config)]
+        if cluster_settings:
+            cmd += ["--settings", json.dumps(cluster_settings)]
+        proc = subprocess.Popen(cmd, env=child_env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        procs.append(NodeProcess(nid, host, port, proc))
+    deadline = time.monotonic() + ready_timeout_s
+    for np_ in procs:
+        while True:
+            if time.monotonic() > deadline:
+                for p in procs:
+                    p.terminate()
+                raise TimeoutError(
+                    f"node [{np_.node_id}] not ready in {ready_timeout_s}s")
+            line = np_.proc.stdout.readline()
+            if not line:
+                if np_.proc.poll() is not None:
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        f"node [{np_.node_id}] exited rc={np_.proc.returncode}"
+                        " before ready")
+                continue
+            if line.startswith(READY_PREFIX):
+                break
+    return procs
+
+
+def join_cluster(node_id: str, data_path: str,
+                 peers: Dict[str, Tuple[str, int]],
+                 masters: List[str], loop,
+                 cluster_settings: Optional[dict] = None,
+                 host: str = DEFAULT_HOST, port: int = 0,
+                 roles: Optional[set] = None):
+    """Build the parent process's own `ClusterNode` (typically the bench
+    coordinator) on `loop`, wired into the same TCP peer set the
+    children were launched with. Returns (node, transport).
+
+    `roles={"master"}` joins a coordinating-only node: it can vote and
+    coordinate searches but never holds shard copies, so every data leg
+    of a fan-out crosses a socket to a child process."""
+    from elasticsearch_tpu.cluster.cluster_node import ClusterNode
+    from elasticsearch_tpu.cluster.coordination import bootstrap_state
+    from elasticsearch_tpu.transport.tcp import (
+        AsyncioScheduler, TcpTransportService)
+
+    want = peers.get(node_id, (host, port))
+    transport = TcpTransportService(node_id, host=want[0], port=want[1],
+                                    loop=loop)
+    loop.run_until_complete(transport.bind())
+    for peer_id, (phost, pport) in peers.items():
+        if peer_id != node_id:
+            transport.add_peer_address(peer_id, phost, pport)
+    initial = bootstrap_state(sorted(masters))
+    if cluster_settings:
+        initial = initial.with_(settings={**initial.settings,
+                                          **cluster_settings})
+    scheduler = AsyncioScheduler(loop, seed=sum(ord(c) for c in node_id))
+    node = ClusterNode(
+        node_id, data_path, transport, scheduler,
+        seed_peers=[p for p in sorted(peers) if p != node_id],
+        initial_state=initial,
+        address=f"{want[0]}:{transport.port}", roles=roles)
+    node.start()
+    return node, transport
+
+
+# -------------------------------------------------------------------- CLI
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Boot one TCP data node of a multi-process cluster")
+    ap.add_argument("--node-id", required=True)
+    ap.add_argument("--host", default=DEFAULT_HOST)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data-path", required=True)
+    ap.add_argument("--peers", required=True,
+                    help="comma list of node_id=host:port for ALL nodes")
+    ap.add_argument("--masters", required=True,
+                    help="comma list of initial master-eligible node ids")
+    ap.add_argument("--policy", default=None,
+                    help="JSON kwargs for parallel.policy.configure")
+    ap.add_argument("--settings", default=None,
+                    help="JSON dict merged into the bootstrap cluster "
+                         "settings")
+    args = ap.parse_args(argv)
+    os.makedirs(args.data_path, exist_ok=True)
+    run_data_node(
+        args.node_id, args.port, args.data_path,
+        peers=parse_peers(args.peers),
+        masters=[m.strip() for m in args.masters.split(",") if m.strip()],
+        host=args.host,
+        policy_config=json.loads(args.policy) if args.policy else None,
+        cluster_settings=json.loads(args.settings) if args.settings else None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

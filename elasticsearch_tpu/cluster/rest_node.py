@@ -400,8 +400,11 @@ class ClusterAwareNode(Node):
             # segments, cached columnar blocks, the ledger and trained
             # IVF layouts as content-addressed blobs — only blocks the
             # repository has never seen upload
-            return snapshot_shard(repo, shard.engine,
-                                  getattr(shard, "vector_store", None))
+            # active_vector_store(): a text-only shard must not
+            # materialize its lazy device store just to snapshot nothing
+            return snapshot_shard(
+                repo, shard.engine, shard.active_vector_store(),
+                settings=self.cluster.cluster_state.settings)
 
         lifecycle.shard_uploader = shard_uploader
 

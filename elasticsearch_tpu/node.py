@@ -2593,10 +2593,18 @@ class Node:
                 reused += int(st.get("blocks_reused", 0))
                 shipped += int(st.get("blocks_shipped", 0))
                 bytes_shipped += int(st.get("bytes_shipped", 0))
+        from elasticsearch_tpu.recovery.snapshot import NODE_STREAM_LIMITER
+        streams = dict(NODE_STREAM_LIMITER.stats)
+        streams["max_streams"] = NODE_STREAM_LIMITER.max_streams
+        streams["max_bytes_per_sec"] = NODE_STREAM_LIMITER.max_bytes_per_sec
         return {"current_as_source": 0, "current_as_target": 0,
                 "completed": done, "blocks_reused": reused,
                 "blocks_shipped": shipped, "bytes_shipped": bytes_shipped,
-                "throttle_time_in_millis": 0,
+                "throttle_time_in_millis":
+                    int(streams["throttle_time_in_millis"]),
+                # bounded-concurrency snapshot block upload + per-node
+                # byte-rate throttle (recovery/snapshot.py limiter)
+                "snapshot_streams": streams,
                 "attempts": 0, "retries": 0, "giveups": 0}
 
     def _device_segments_section(self) -> dict:

@@ -341,27 +341,13 @@ def _choose_split(batch, n_rows: int, queue_depth: int, dp: int,
     devices), leaving the other groups free — concurrent batches overlap
     on disjoint device groups, the throughput shape. "shard" runs the
     full-mesh program (queries split along dp, corpus along shard) — all
-    devices cooperate on this one batch, the latency shape. Queue depth
-    × corpus size decides: queued work means the other groups will be
-    busy immediately; an idle large corpus wants every shard's slice of
-    the matmul."""
-    if batch is None:
-        # no batch signal (legacy leg — device aggs): its kernels carry
-        # shard-only specs and cache device mirrors against the full
-        # serving mesh, so the full-mesh program is the only safe route
-        return "shard", "no_batch_signal"
-    if batch < dp or batch % dp:
-        # the full-mesh program splits the query batch along dp; a batch
-        # its bucket can't split must take a group (where dp=1 admits
-        # any bucket)
-        return "dp", "batch_below_dp"
-    if queue_depth > 0:
-        return "dp", "queue_pressure"
-    if n_rows < _cfg["min_rows"] * dp:
-        # small corpus: the full-mesh program's S-way fixed costs
-        # outweigh the per-device scan saving vs a group's S/1 shards
-        return "dp", "small_corpus_group"
-    return "shard", "idle_large_corpus"
+    devices cooperate on this one batch, the latency shape. The decision
+    is the unified dispatch cost model's (serving/router.py): queue wait
+    vs device-leg estimate per route, calibrated so the historical
+    min_rows*dp break-even (and the five pinned reason strings) hold."""
+    from elasticsearch_tpu.serving import router as dispatch_router
+    return dispatch_router.choose_split(
+        batch, n_rows, int(queue_depth), dp, n_shards, _cfg["min_rows"])
 
 
 def decide(leg: str, n_rows: int, has_mesh_state: bool = True,
@@ -454,6 +440,7 @@ def gather_bytes(n_shards: int, n_queries: int, k: int,
 def stats() -> dict:
     """`_nodes/stats indices.mesh` section."""
     from elasticsearch_tpu.parallel import mesh as mesh_lib
+    from elasticsearch_tpu.serving import router as dispatch_router
     mesh = serving_mesh()
     # shard-axis size, not devices.size: the two differ once dp > 1
     n_shards = 0 if mesh is None else mesh_lib.shard_size(mesh)
@@ -488,6 +475,10 @@ def stats() -> dict:
                         str(g): n for g, n in sorted(
                             _counters["dp_group_dispatches"].items())},
                 },
+                # unified per-dispatch cost router (serving/router.py):
+                # copy-selection / split / placement decisions with
+                # reasons, plus the live per-node cost estimates
+                "dispatch": dispatch_router.stats(),
             },
             "legs": {leg: dict(v)
                      for leg, v in sorted(_counters["legs"].items())},
@@ -498,6 +489,8 @@ def reset(full: bool = False) -> None:
     """Zero the counters (tests). full=True also drops the config and the
     cached mesh back to auto defaults."""
     global _mesh, _mesh_built, _rr, _cfg_epoch
+    from elasticsearch_tpu.serving import router as dispatch_router
+    dispatch_router.reset()
     with _lock:
         _cfg_epoch += 1
         _counters["decisions_mesh"] = 0

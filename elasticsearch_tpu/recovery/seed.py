@@ -42,6 +42,12 @@ def write_sidecar(path: str, cache_entries, ivf_layouts) -> None:
     os.replace(tmp, os.path.join(path, SIDECAR_FILE))
 
 
+def has_sidecar(path: str) -> bool:
+    """Cheap existence probe: lets a lazily-materialized shard decide
+    whether a recovery seed is waiting without building a device store."""
+    return os.path.exists(os.path.join(path, SIDECAR_FILE))
+
+
 def load_sidecar(path: str, consume: bool = True) -> Optional[dict]:
     sidecar = os.path.join(path, SIDECAR_FILE)
     try:

@@ -17,7 +17,10 @@ engine is byte-identical and its derived caches never recompute.
 
 from __future__ import annotations
 
+import threading
+import time
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.recovery.blocks import (
@@ -113,25 +116,137 @@ def assemble_shard(path: str, entries: List[dict], meta: dict,
 
 # ------------------------------------------------------------ repository
 
-def snapshot_shard(repo, engine, vector_store=None) -> dict:
+class SnapshotStreamLimiter:
+    """Per-node upload governor for snapshot block streams: bounded
+    concurrency (`snapshot.max_concurrent_streams`) plus a byte-rate
+    token bucket (`snapshot.max_bytes_per_sec`, 0 = unthrottled). The
+    reference throttles snapshots the same way (`indices.recovery.
+    max_bytes_per_sec` / SnapshotShardsService); the accumulated wait is
+    surfaced in `_nodes/stats indices.recovery.snapshot_streams` so an
+    operator can see when the throttle — not the repository — is the
+    snapshot's critical path."""
+
+    def __init__(self, max_streams: int = 4, max_bytes_per_sec: int = 0):
+        self._lock = threading.Lock()
+        self._allowance = 0.0
+        self._last_refill = time.monotonic()
+        self._in_flight = 0
+        self.stats = {"throttle_time_in_millis": 0,
+                      "blocks_throttled": 0,
+                      "blocks_uploaded": 0,
+                      "bytes_uploaded": 0,
+                      "max_concurrent_streams": 0}
+        self.configure(max_streams, max_bytes_per_sec)
+
+    def configure(self, max_streams=None, max_bytes_per_sec=None) -> None:
+        with self._lock:
+            if max_streams is not None:
+                self.max_streams = max(1, int(max_streams))
+            if max_bytes_per_sec is not None:
+                rate = max(0, int(max_bytes_per_sec))
+                if rate != getattr(self, "max_bytes_per_sec", None):
+                    # a CHANGED rate restarts the bucket full; re-applying
+                    # the same setting (every shard upload re-reads the
+                    # cluster settings) must not refund spent allowance
+                    self.max_bytes_per_sec = rate
+                    self._allowance = float(rate)
+                    self._last_refill = time.monotonic()
+
+    def configure_from_settings(self, settings) -> None:
+        from elasticsearch_tpu.common.settings import parse_byte_size
+        raw_rate = settings.get("snapshot.max_bytes_per_sec")
+        try:
+            rate = parse_byte_size(raw_rate) if raw_rate else None
+        except Exception:
+            rate = None
+        try:
+            raw_streams = settings.get("snapshot.max_concurrent_streams")
+            streams = int(raw_streams) if raw_streams else None
+        except Exception:
+            streams = None
+        self.configure(max_streams=streams, max_bytes_per_sec=rate)
+
+    def throttle(self, nbytes: int) -> None:
+        """Debit `nbytes` from the token bucket, sleeping out any
+        deficit. Runs on upload-stream worker threads — never on a node's
+        event loop."""
+        if self.max_bytes_per_sec <= 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            self._allowance = min(
+                float(self.max_bytes_per_sec),
+                self._allowance
+                + (now - self._last_refill) * self.max_bytes_per_sec)
+            self._last_refill = now
+            deficit = nbytes - self._allowance
+            self._allowance -= nbytes
+            if deficit <= 0:
+                return
+            wait_s = deficit / self.max_bytes_per_sec
+            self.stats["blocks_throttled"] += 1
+            self.stats["throttle_time_in_millis"] += int(wait_s * 1000)
+        time.sleep(wait_s)
+
+    def _enter(self) -> None:
+        with self._lock:
+            self._in_flight += 1
+            self.stats["max_concurrent_streams"] = max(
+                self.stats["max_concurrent_streams"], self._in_flight)
+
+    def _exit(self, nbytes: int) -> None:
+        with self._lock:
+            self._in_flight -= 1
+            self.stats["blocks_uploaded"] += 1
+            self.stats["bytes_uploaded"] += int(nbytes)
+
+
+# node-wide default: every snapshot upload in the process shares one
+# throttle budget, which is the per-node semantic the setting names
+NODE_STREAM_LIMITER = SnapshotStreamLimiter()
+
+
+def snapshot_shard(repo, engine, vector_store=None, limiter=None,
+                   settings=None) -> dict:
     """Upload one shard's blocks to a content-addressed repository;
     returns the shard's manifest entry. Blocks whose digest the repo
     already holds are REUSED (counted, not re-uploaded) — that is the
-    incremental-snapshot contract the acceptance gate measures."""
+    incremental-snapshot contract the acceptance gate measures. Missing
+    blocks upload CONCURRENTLY (bounded by the stream limiter) under the
+    per-node byte-rate throttle."""
     entries, payloads, meta = collect_shard_blocks(engine, vector_store)
-    reused = shipped = bytes_shipped = 0
+    limiter = limiter or NODE_STREAM_LIMITER
+    if settings:
+        limiter.configure_from_settings(settings)
+    reused = 0
+    to_ship: List[bytes] = []
     for digest, data in payloads.items():
         if repo.has_blob(digest):
             reused += 1
         else:
+            to_ship.append(data)
+
+    def upload(data: bytes) -> int:
+        limiter._enter()
+        try:
+            limiter.throttle(len(data))
             repo.put_bytes(data)
-            shipped += 1
-            bytes_shipped += len(data)
+            return len(data)
+        finally:
+            limiter._exit(len(data))
+
+    if len(to_ship) > 1 and limiter.max_streams > 1:
+        with ThreadPoolExecutor(
+                max_workers=min(limiter.max_streams, len(to_ship)),
+                thread_name_prefix="snapshot-stream") as pool:
+            sizes = list(pool.map(upload, to_ship))
+    else:
+        sizes = [upload(data) for data in to_ship]
     return {"blocks": entries, "meta": meta,
             "stats": {**manifest_totals(entries),
                       "blocks_reused": reused,
-                      "blocks_shipped": shipped,
-                      "bytes_shipped": bytes_shipped}}
+                      "blocks_shipped": len(to_ship),
+                      "bytes_shipped": sum(sizes)}}
 
 
 def restore_shard(repo, shard_entry: dict, path: str,

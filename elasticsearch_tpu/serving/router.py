@@ -1,0 +1,255 @@
+"""Unified per-dispatch cost router.
+
+ONE cost model now answers every routing question the serving path used
+to answer three different ways:
+
+* **copy selection** — which STARTED copy of a shard a coordinator fans
+  a query leg to (previously a private ARS EWMA ranking in
+  `ClusterNode._select_copy`),
+* **dp-vs-shard split** — whether a mesh-accepted dispatch takes one dp
+  group or the full-mesh program (previously ad-hoc thresholds in
+  `parallel/policy._choose_split`), and
+* **remote placement** — which node receives a new shard copy when
+  balancer weights tie (previously node-name order in
+  `allocation._pick_node`).
+
+The per-route cost is the sum the reference's adaptive replica selection
+approximates (`SearchExecutionStatsCollector`), made explicit:
+
+    cost(route) = estimated queue wait   (outstanding dispatches we routed
+                                          there x the node's service EWMA)
+                + transport RTT EWMA     (TcpTransportService.rtt_ms over
+                                          real sockets; 0 in-process/sim)
+                + device-leg estimate    (service EWMA net of transport —
+                                          the remote engine + device time)
+
+Every decision is counted with its reason; the counts surface under
+`_nodes/stats indices.mesh.router.dispatch` (assembled by
+`parallel/policy.stats()`), so a tail regression is attributable to the
+routing tier that caused it.
+
+`DispatchRouter` is per-coordinator (one per `ClusterNode`) because the
+queue-wait term is "dispatches *I* have in flight there". The counters
+and the observation table are process-global, mirroring the policy
+module: placement runs in pure allocation functions with no node handle,
+and `_nodes/stats` reports one router section per process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# same smoothing as the reference's ARS response-time EWMA (and the
+# pre-unification ClusterNode._ars_observe): new = 0.7*prev + 0.3*obs
+EWMA_ALPHA = 0.3
+
+_lock = threading.Lock()
+
+_counters = {
+    "copy": {"decisions": 0, "reasons": {}},
+    "split": {"decisions": 0, "reasons": {}},
+    "placement": {"decisions": 0, "reasons": {}},
+}
+
+# process-global per-node observation table: the static placement path
+# (pure functions in cluster/allocation.py) reads route costs from here;
+# DispatchRouter instances publish into it on every select/observe.
+# node_id -> {"service_ewma_ms", "rtt_ewma_ms", "inflight"}
+_observations: Dict[str, dict] = {}
+
+
+def _count(kind: str, reason: str) -> None:
+    with _lock:
+        c = _counters[kind]
+        c["decisions"] += 1
+        c["reasons"][reason] = c["reasons"].get(reason, 0) + 1
+
+
+class DispatchRouter:
+    """Per-coordinator routing state: service-time EWMA, in-flight
+    dispatch counts, and the transport RTT feed."""
+
+    def __init__(self, node_id: str = "",
+                 rtt_provider: Optional[Callable[[str], Optional[float]]]
+                 = None):
+        self.node_id = node_id
+        # rtt_provider(node_id) -> ms or None. Over TCP this is
+        # TcpTransportService.rtt_ms; the sim transport has none, so the
+        # RTT term is 0 and the cost collapses to the classic ARS rank.
+        self.rtt_provider = rtt_provider
+        # node_id -> coordinator-observed took EWMA (ms). ClusterNode
+        # aliases this dict as `_ars_ewma` — tests and the bench harness
+        # read and pop it directly, so it must stay a plain mutable dict.
+        self.service_ewma: Dict[str, float] = {}
+        # node_id -> dispatches selected but not yet observed back
+        self.inflight: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- cost
+    def rtt_ms(self, node_id: str) -> float:
+        if self.rtt_provider is None:
+            return 0.0
+        try:
+            return float(self.rtt_provider(node_id) or 0.0)
+        except Exception:
+            return 0.0
+
+    def route_cost(self, node_id: str) -> Optional[float]:
+        """Estimated ms until a dispatch routed to `node_id` completes;
+        None for an unmeasured node (which must be probed, not costed)."""
+        service = self.service_ewma.get(node_id)
+        if service is None:
+            return None
+        rtt = self.rtt_ms(node_id)
+        # the coordinator-observed took already contains the transport
+        # round trip; subtracting it out keeps the three terms honest
+        # instead of double-counting the wire
+        device_leg = max(service - rtt, 0.0)
+        queue_wait = self.inflight.get(node_id, 0) * service
+        return queue_wait + rtt + device_leg
+
+    # --------------------------------------------------- copy selection
+    def select_copy(self, copies: Sequence, sid: int):
+        """Pick the copy with the lowest route cost. Unmeasured nodes
+        rank first so every copy gets probed (the ARS bootstrap rule);
+        ties rotate by shard id so probe load spreads."""
+        if len(copies) == 1:
+            chosen, reason = copies[0], "single_copy"
+        else:
+            def rank(i_copy):
+                i, copy = i_copy
+                cost = self.route_cost(copy.node_id)
+                return (0 if cost is None else 1, cost or 0.0,
+                        (i + sid) % len(copies))
+            best_i, chosen = min(enumerate(copies), key=rank)
+            reason = ("unmeasured_probe"
+                      if self.route_cost(chosen.node_id) is None
+                      else "lowest_cost")
+        node = chosen.node_id
+        self.inflight[node] = self.inflight.get(node, 0) + 1
+        self._publish(node)
+        _count("copy", reason)
+        return chosen
+
+    def observe(self, node_id: str, took_ms: float) -> None:
+        """Feed one completed (or timed-out-at-budget) dispatch back into
+        the cost model. Late/duplicate observations only clamp inflight
+        at zero — the estimate self-corrects."""
+        n = self.inflight.get(node_id, 0)
+        if n > 0:
+            self.inflight[node_id] = n - 1
+        prev = self.service_ewma.get(node_id)
+        self.service_ewma[node_id] = (
+            float(took_ms) if prev is None
+            else (1.0 - EWMA_ALPHA) * prev + EWMA_ALPHA * float(took_ms))
+        self._publish(node_id)
+
+    def _publish(self, node_id: str) -> None:
+        with _lock:
+            _observations[node_id] = {
+                "service_ewma_ms": self.service_ewma.get(node_id),
+                "rtt_ewma_ms": self.rtt_ms(node_id),
+                "inflight": self.inflight.get(node_id, 0),
+            }
+
+
+# ------------------------------------------------------- dp-vs-shard split
+def choose_split(batch, n_rows: int, queue_depth: int, dp: int,
+                 n_shards: int, min_rows: int) -> Tuple[str, str]:
+    """dp-vs-shard split for one mesh-accepted dispatch, as a cost
+    comparison in corpus-row units.
+
+    The "dp" route runs on ONE dp group (S shards): its device leg scans
+    n_rows/S per device and, because the other dp-1 groups stay free,
+    queued batches land on disjoint devices — its queue-wait term is 0.
+    The "shard" route runs the full-mesh program (S*dp devices): the
+    device leg scans n_rows/(S*dp) but pays the wider program's fixed
+    dispatch+gather costs, and every queued batch must wait a full
+    service time (all devices are busy). The fixed-cost delta is
+    calibrated so the break-even corpus is exactly `min_rows * dp` —
+    the measured threshold the policy module has always enforced — which
+    keeps the five pinned decision reasons byte-stable."""
+    if batch is None:
+        # no batch signal (legacy leg — device aggs): its kernels carry
+        # shard-only specs and cache device mirrors against the full
+        # serving mesh, so the full-mesh program is the only safe route
+        split, reason = "shard", "no_batch_signal"
+    elif batch < dp or batch % dp:
+        # the full-mesh program splits the query batch along dp; a batch
+        # its bucket can't split must take a group
+        split, reason = "dp", "batch_below_dp"
+    else:
+        s = max(int(n_shards), 1)
+        d = max(int(dp), 1)
+        dp_cost = n_rows / s
+        # full-mesh fixed-cost delta: min_rows*(dp-1)/S row-units makes
+        # shard_cost == dp_cost exactly at n_rows == min_rows*dp
+        shard_cost = (n_rows / (s * d)
+                      + min_rows * (d - 1) / s
+                      + int(queue_depth) * (n_rows / s + min_rows * d))
+        if shard_cost > dp_cost:
+            split = "dp"
+            reason = ("queue_pressure" if queue_depth > 0
+                      else "small_corpus_group")
+        else:
+            split, reason = "shard", "idle_large_corpus"
+    _count("split", reason)
+    return split, reason
+
+
+# ------------------------------------------------------------- placement
+def placement_cost(node_id: str) -> float:
+    """Route cost of a node from the process-global observation table;
+    0.0 when unobserved, so allocation with no serving traffic stays
+    deterministic by (weight, node-name) — the historical order every
+    pure-allocation test pins."""
+    with _lock:
+        obs = _observations.get(node_id)
+    if not obs or obs.get("service_ewma_ms") is None:
+        return 0.0
+    service = float(obs["service_ewma_ms"])
+    rtt = float(obs.get("rtt_ewma_ms") or 0.0)
+    return (int(obs.get("inflight") or 0) * service + rtt
+            + max(service - rtt, 0.0))
+
+
+def placement_order(candidates) -> List[Tuple[float, str]]:
+    """Order balancer candidates [(weight, node), ...] by (weight, route
+    cost, node name): the balancer weight still dominates — the cost
+    model only breaks weight ties, steering new copies away from hot
+    nodes. Counts whether the cost term actually changed the order."""
+    cands = list(candidates)
+    if not cands:
+        return []
+    ranked = sorted((w, placement_cost(n), n) for w, n in cands)
+    by_name = sorted(cands)
+    ordered = [(w, n) for w, _, n in ranked]
+    _count("placement",
+           "cost_tiebreak" if ordered != by_name else "weight_order")
+    return ordered
+
+
+# ------------------------------------------------------------------ stats
+def stats() -> dict:
+    """`_nodes/stats indices.mesh.router.dispatch` section."""
+    with _lock:
+        return {
+            "copy": {"decisions": _counters["copy"]["decisions"],
+                     "reasons": dict(_counters["copy"]["reasons"])},
+            "split": {"decisions": _counters["split"]["decisions"],
+                      "reasons": dict(_counters["split"]["reasons"])},
+            "placement": {
+                "decisions": _counters["placement"]["decisions"],
+                "reasons": dict(_counters["placement"]["reasons"])},
+            "nodes": {n: dict(o)
+                      for n, o in sorted(_observations.items())},
+        }
+
+
+def reset() -> None:
+    """Zero the process-global counters and observations (tests)."""
+    with _lock:
+        for c in _counters.values():
+            c["decisions"] = 0
+            c["reasons"].clear()
+        _observations.clear()

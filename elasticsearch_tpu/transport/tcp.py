@@ -36,6 +36,7 @@ from elasticsearch_tpu.transport.wire import (
 )
 
 HANDSHAKE_ACTION = "internal:tcp/handshake"
+PING_ACTION = "internal:tcp/ping"
 
 # channel classes by action prefix (reference: ConnectionProfile channel
 # types — recovery, bulk, reg, state, ping)
@@ -52,6 +53,26 @@ def channel_type_for(action: str) -> str:
         if action.startswith(prefix):
             return channel
     return "reg"
+
+
+class ConnectionProfile:
+    """Connections per channel type (reference `ConnectionProfile.java`).
+
+    A recovery file copy saturating its socket must not head-of-line-
+    block a query fan-out: each channel type gets its OWN pool of TCP
+    connections, and senders round-robin within a type so concurrent
+    query legs spread across `reg` sockets instead of serializing behind
+    one kernel send buffer."""
+
+    DEFAULT_CONNECTIONS = {"reg": 2, "bulk": 1, "state": 1, "recovery": 1}
+
+    def __init__(self, connections_per_type: Optional[Dict[str, int]] = None):
+        self.connections_per_type = dict(self.DEFAULT_CONNECTIONS)
+        for ctype, n in (connections_per_type or {}).items():
+            self.connections_per_type[ctype] = max(1, int(n))
+
+    def num_connections(self, channel_type: str) -> int:
+        return self.connections_per_type.get(channel_type, 1)
 
 
 class RemoteTransportError(SearchEngineError):
@@ -124,7 +145,8 @@ class TcpTransportService:
                  *, loop: Optional[asyncio.AbstractEventLoop] = None,
                  keepalive_interval_ms: int = 15_000,
                  default_timeout_ms: Optional[int] = 30_000,
-                 tls=None, auth=None):
+                 tls=None, auth=None,
+                 connection_profile: Optional[ConnectionProfile] = None):
         self.node_id = node_id
         self.host = host
         self.port = port  # 0 = ephemeral; real port known after bind()
@@ -137,12 +159,19 @@ class TcpTransportService:
         self.default_timeout_ms = default_timeout_ms
         self._server: Optional[asyncio.AbstractServer] = None
         self._handlers: Dict[str, Callable] = {}
+        self.connection_profile = connection_profile or ConnectionProfile()
         self._request_id = 0
-        # request_id -> (on_response, on_failure, timeout_handle, action)
+        # request_id -> (on_response, on_failure, timeout_handle, action,
+        #                target, sent_monotonic)
         self._pending: Dict[int, Tuple] = {}
-        # peer node_id -> {channel_type: _Channel}
+        # peer node_id -> {channel slot ("reg#0", "recovery#0"): _Channel}
         self._channels: Dict[str, Dict[str, _Channel]] = {}
+        # per-(peer, channel_type) round-robin cursor over profile slots
+        self._channel_rr: Dict[Tuple[str, str], int] = {}
         self._addresses: Dict[str, Tuple[str, int]] = {}
+        # per-peer request->response round-trip EWMA (ms): the transport
+        # leg of the unified dispatch cost router (serving/router.py)
+        self._rtt_ewma: Dict[str, float] = {}
         self._connecting: Dict[Tuple[str, str], asyncio.Future] = {}
         self._keepalive_task: Optional[asyncio.Task] = None
         self._pumps: List[asyncio.Task] = []
@@ -209,7 +238,7 @@ class TcpTransportService:
             lambda err: ok.set_exception(err) if not ok.done() else None,
             self.loop.call_later(10.0, self._on_request_timeout, rid,
                                  f"{host}:{port}"),
-            HANDSHAKE_ACTION)
+            HANDSHAKE_ACTION, None, time.monotonic())
         channel.pending_rids.add(rid)
         channel.write_frame(encode_frame(
             rid, STATUS_REQUEST | STATUS_HANDSHAKE, WIRE_VERSION,
@@ -233,6 +262,21 @@ class TcpTransportService:
     @property
     def bound_address(self) -> Tuple[str, int]:
         return self.host, self.port
+
+    # ------------------------------------------------------------ telemetry
+    def rtt_ms(self, node_id: str) -> Optional[float]:
+        """Request->response round-trip EWMA to `node_id` in ms, or None
+        when unmeasured — the transport-leg term of the unified dispatch
+        cost router."""
+        return self._rtt_ewma.get(node_id)
+
+    def _observe_rtt(self, node_id: Optional[str], sent_monotonic) -> None:
+        if not node_id or sent_monotonic is None:
+            return
+        rtt = max((time.monotonic() - sent_monotonic) * 1000.0, 0.0)
+        prev = self._rtt_ewma.get(node_id)
+        self._rtt_ewma[node_id] = (rtt if prev is None
+                                   else 0.7 * prev + 0.3 * rtt)
 
     # ------------------------------------------------------------- handlers
     def register(self, node_id: str, action: str, handler: Callable) -> None:
@@ -295,7 +339,8 @@ class TcpTransportService:
         if timeout_ms is not None:
             timeout_handle = self.loop.call_later(
                 timeout_ms / 1000.0, self._on_request_timeout, rid, target)
-        self._pending[rid] = (on_response, on_failure, timeout_handle, action)
+        self._pending[rid] = (on_response, on_failure, timeout_handle,
+                              action, target, time.monotonic())
         channel.pending_rids.add(rid)
         envelope = {"sender": self.node_id, "request": request}
         if self.auth is not None:
@@ -319,7 +364,7 @@ class TcpTransportService:
         entry = self._pending.pop(rid, None)
         if entry is None:
             return
-        _, on_failure, timeout_handle, _ = entry
+        _, on_failure, timeout_handle, _, _, _ = entry
         if timeout_handle:
             timeout_handle.cancel()
         if on_failure:
@@ -327,17 +372,54 @@ class TcpTransportService:
 
     # --------------------------------------------------------- connections
     async def _get_channel(self, target: str, channel_type: str) -> _Channel:
-        existing = self._channels.get(target, {}).get(channel_type)
-        if existing is not None and not existing.closed:
-            return existing
-        key = (target, channel_type)
+        """One of the profile's sockets for (target, channel_type).
+
+        Slots are independent TCP connections, so a saturated recovery
+        stream and a query fan-out never share a kernel send buffer.
+        Reuse policy: an IDLE open channel is always reused (a serial
+        request stream stays on one socket); when every open channel of
+        the type has requests in flight, the profile widens to its next
+        unopened slot, and once the profile is exhausted concurrent
+        requests round-robin across the open slots."""
+        slots = self.connection_profile.num_connections(channel_type)
+        chans = self._channels.get(target, {})
+        busy = []
+        connecting = []
+        slot = None
+        for i in range(slots):
+            name = f"{channel_type}#{i}"
+            ch = chans.get(name)
+            if ch is not None and not ch.closed:
+                if not ch.pending_rids:
+                    return ch          # idle open channel: reuse
+                busy.append(ch)
+                continue
+            fut = self._connecting.get((target, name))
+            if fut is not None:
+                # a slot mid-connect counts as busy: a concurrent request
+                # widens to the NEXT slot instead of piling onto it
+                connecting.append(fut)
+            elif slot is None:
+                slot = name            # first unopened/closed slot
+        if slot is None:
+            if connecting:
+                # profile exhausted but sockets still opening: join the
+                # soonest-to-open one rather than queueing behind an
+                # already-busy channel
+                return await asyncio.shield(connecting[0])
+            # profile exhausted, all channels busy: round-robin
+            rr_key = (target, channel_type)
+            cursor = self._channel_rr.get(rr_key, 0)
+            self._channel_rr[rr_key] = (cursor + 1) % len(busy)
+            return busy[cursor % len(busy)]
+        key = (target, slot)
         fut = self._connecting.get(key)
         if fut is None:
             fut = self.loop.create_future()
             self._connecting[key] = fut
             try:
                 channel = await self._open_channel(target)
-                self._channels.setdefault(target, {})[channel_type] = channel
+                self._channels.setdefault(target, {})[slot] = channel
                 fut.set_result(channel)
             except Exception as e:
                 fut.set_exception(e)
@@ -369,7 +451,7 @@ class TcpTransportService:
                 lambda resp: ok.set_result(resp) if not ok.done() else None,
                 lambda err: ok.set_exception(err) if not ok.done() else None,
                 self.loop.call_later(10.0, self._on_request_timeout, rid, target),
-                HANDSHAKE_ACTION)
+                HANDSHAKE_ACTION, target, time.monotonic())
             channel.pending_rids.add(rid)
             channel.write_frame(encode_frame(
                 rid, STATUS_REQUEST | STATUS_HANDSHAKE, WIRE_VERSION,
@@ -448,9 +530,16 @@ class TcpTransportService:
             channel.pending_rids.discard(rid)
             if entry is None:
                 return  # late response after timeout
-            on_response, on_failure, timeout_handle, req_action = entry
+            (on_response, on_failure, timeout_handle, req_action,
+             target, sent_at) = entry
             if timeout_handle:
                 timeout_handle.cancel()
+            # RTT samples come only from control exchanges whose remote
+            # handler is O(1) — a data response would fold the remote's
+            # service time into the wire estimate and double-count it
+            # against the cost router's device-leg term
+            if req_action in (HANDSHAKE_ACTION, PING_ACTION):
+                self._observe_rtt(target, sent_at)
             if status & STATUS_ERROR:
                 if on_failure:
                     err = RemoteTransportError(
@@ -474,6 +563,12 @@ class TcpTransportService:
             channel.write_frame(encode_frame(
                 rid, STATUS_HANDSHAKE, WIRE_VERSION, None,
                 {"node_id": self.node_id, "version": WIRE_VERSION}))
+            return
+        if action == PING_ACTION:
+            # O(1) echo for the keepalive RTT probe: carries no state, so
+            # (like the handshake) it answers before authn
+            channel.write_frame(encode_frame(
+                rid, 0, WIRE_VERSION, None, {"node_id": self.node_id}))
             return
         # authenticate BEFORE even the handler lookup: a peer that completed
         # the socket handshake may not invoke actions — nor enumerate which
@@ -523,8 +618,14 @@ class TcpTransportService:
             while not self.closed:
                 await asyncio.sleep(self.keepalive_interval_ms / 1000.0)
                 ping = encode_ping()
-                for chans in self._channels.values():
+                for target, chans in list(self._channels.items()):
                     for ch in chans.values():
                         ch.write_frame(ping)
+                    # request/response ping refreshes the per-peer RTT
+                    # EWMA the dispatch cost router consumes; the raw
+                    # wire ping above only defeats idle-connection reaping
+                    self.send(self.node_id, target, PING_ACTION, {},
+                              timeout_ms=min(self.keepalive_interval_ms,
+                                             10_000))
         except asyncio.CancelledError:
             pass

@@ -1,0 +1,223 @@
+"""Unified per-dispatch cost router (serving/router.py).
+
+One cost model — queue wait + transport RTT + device leg — now drives
+copy selection (ARS), the dp-vs-shard split, and placement tie-breaks.
+These tests pin the cost arithmetic, the decision reasons, the EWMA
+smoothing (0.7/0.3, byte-compatible with the pre-unification ARS
+observer), and the `_nodes/stats indices.mesh.router.dispatch` surface.
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from elasticsearch_tpu.serving import router
+
+
+@pytest.fixture(autouse=True)
+def _clean_router():
+    router.reset()
+    yield
+    router.reset()
+
+
+def _copy(node_id):
+    return SimpleNamespace(node_id=node_id)
+
+
+# ---------------------------------------------------------------- cost model
+
+def test_route_cost_is_none_until_observed():
+    r = router.DispatchRouter("coord")
+    assert r.route_cost("n1") is None
+    r.observe("n1", 40.0)
+    assert r.route_cost("n1") == pytest.approx(40.0)  # 0 queued + device leg
+
+
+def test_route_cost_sums_queue_rtt_and_device_leg():
+    rtts = {"n1": 6.0}
+    r = router.DispatchRouter("coord", rtt_provider=rtts.get)
+    r.observe("n1", 20.0)
+    r.inflight["n1"] = 2
+    # queue wait 2*20 + rtt 6 + device leg (20-6)
+    assert r.route_cost("n1") == pytest.approx(2 * 20.0 + 6.0 + 14.0)
+
+
+def test_device_leg_never_negative_when_rtt_exceeds_service():
+    r = router.DispatchRouter("coord", rtt_provider=lambda n: 50.0)
+    r.observe("n1", 10.0)
+    assert r.route_cost("n1") == pytest.approx(50.0 + 0.0)
+
+
+def test_rtt_provider_failures_degrade_to_zero():
+    def boom(node_id):
+        raise RuntimeError("transport closed")
+    r = router.DispatchRouter("coord", rtt_provider=boom)
+    assert r.rtt_ms("n1") == 0.0
+    r.observe("n1", 12.0)
+    assert r.route_cost("n1") == pytest.approx(12.0)
+
+
+def test_ewma_matches_historical_ars_smoothing():
+    r = router.DispatchRouter("coord")
+    r.observe("n1", 100.0)
+    r.observe("n1", 10.0)
+    # new = 0.7*prev + 0.3*obs — the exact pre-unification constant
+    assert r.service_ewma["n1"] == pytest.approx(0.7 * 100.0 + 0.3 * 10.0)
+
+
+# ------------------------------------------------------------ copy selection
+
+def test_single_copy_short_circuits_with_reason():
+    r = router.DispatchRouter("coord")
+    chosen = r.select_copy([_copy("n1")], sid=0)
+    assert chosen.node_id == "n1"
+    assert router.stats()["copy"]["reasons"] == {"single_copy": 1}
+
+
+def test_unmeasured_copies_are_probed_with_sid_rotation():
+    r = router.DispatchRouter("coord")
+    picks = {r.select_copy([_copy("a"), _copy("b"), _copy("c")],
+                           sid=sid).node_id for sid in range(3)}
+    # the (i + sid) % n tie-break spreads probes over all three copies
+    assert picks == {"a", "b", "c"}
+    assert router.stats()["copy"]["reasons"] == {"unmeasured_probe": 3}
+
+
+def test_measured_copies_route_to_lowest_cost():
+    r = router.DispatchRouter("coord")
+    r.observe("fast", 5.0)
+    r.observe("slow", 50.0)
+    chosen = r.select_copy([_copy("slow"), _copy("fast")], sid=0)
+    assert chosen.node_id == "fast"
+    assert router.stats()["copy"]["reasons"] == {"lowest_cost": 1}
+
+
+def test_inflight_tracks_select_and_observe_with_clamping():
+    r = router.DispatchRouter("coord")
+    r.observe("n1", 5.0)
+    r.observe("n2", 50.0)
+    for _ in range(3):
+        r.select_copy([_copy("n1"), _copy("n2")], sid=0)
+    assert r.inflight["n1"] == 3
+    r.observe("n1", 5.0)
+    assert r.inflight["n1"] == 2
+    # late/duplicate observations clamp at zero, never go negative
+    for _ in range(5):
+        r.observe("n1", 5.0)
+    assert r.inflight["n1"] == 0
+
+
+def test_queue_wait_steers_away_from_backed_up_copy():
+    """The classic ARS behavior the unified model must preserve: a fast
+    node with a deep outstanding queue loses to a slower idle node."""
+    r = router.DispatchRouter("coord")
+    r.observe("fast", 10.0)
+    r.observe("slower", 25.0)
+    for _ in range(4):   # 4 un-acked dispatches on the fast node
+        r.inflight["fast"] = r.inflight.get("fast", 0) + 1
+    # fast: 4*10 + 10 = 50 > slower: 25
+    chosen = r.select_copy([_copy("fast"), _copy("slower")], sid=0)
+    assert chosen.node_id == "slower"
+
+
+# ---------------------------------------------------------- dp-vs-shard split
+
+def test_split_reasons_are_byte_stable():
+    min_rows, dp = 1000, 4
+    cases = [
+        # (batch, n_rows, queue_depth) -> (split, reason)
+        ((None, 8000, 0), ("shard", "no_batch_signal")),
+        ((2, 8000, 0), ("dp", "batch_below_dp")),      # batch < dp
+        ((6, 8000, 0), ("dp", "batch_below_dp")),      # batch % dp != 0
+        ((4, 8000, 2), ("dp", "queue_pressure")),
+        ((4, 2000, 0), ("dp", "small_corpus_group")),
+        ((4, 8000, 0), ("shard", "idle_large_corpus")),
+    ]
+    for (batch, n_rows, q), want in cases:
+        got = router.choose_split(batch, n_rows, q, dp=dp, n_shards=2,
+                                  min_rows=min_rows)
+        assert got == want, f"batch={batch} n_rows={n_rows} q={q}: {got}"
+    reasons = router.stats()["split"]["reasons"]
+    assert reasons == {"no_batch_signal": 1, "batch_below_dp": 2,
+                       "queue_pressure": 1, "small_corpus_group": 1,
+                       "idle_large_corpus": 1}
+
+
+def test_split_break_even_is_exactly_min_rows_times_dp():
+    """The fixed-cost calibration: the cost comparison flips at the same
+    `min_rows * dp` threshold the policy module has always enforced —
+    equality takes the full-mesh program."""
+    min_rows, dp = 500, 4
+    at = router.choose_split(4, min_rows * dp, 0, dp=dp, n_shards=3,
+                             min_rows=min_rows)
+    below = router.choose_split(4, min_rows * dp - 1, 0, dp=dp, n_shards=3,
+                                min_rows=min_rows)
+    assert at == ("shard", "idle_large_corpus")
+    assert below == ("dp", "small_corpus_group")
+
+
+# ---------------------------------------------------------------- placement
+
+def test_placement_weight_dominates_cost():
+    r = router.DispatchRouter("coord")
+    r.observe("heavy", 500.0)   # terrible route cost, but lowest weight
+    ordered = router.placement_order([(2.0, "idle"), (1.0, "heavy")])
+    assert ordered == [(1.0, "heavy"), (2.0, "idle")]
+    assert router.stats()["placement"]["reasons"] == {"weight_order": 1}
+
+
+def test_placement_cost_breaks_weight_ties():
+    r = router.DispatchRouter("coord")
+    # "a_hot" sorts FIRST by name but carries the worse route cost: only
+    # the cost term can put "z_cool" ahead of it
+    r.observe("a_hot", 80.0)
+    r.observe("z_cool", 5.0)
+    ordered = router.placement_order([(1.0, "a_hot"), (1.0, "z_cool")])
+    assert ordered == [(1.0, "z_cool"), (1.0, "a_hot")]
+    assert router.stats()["placement"]["reasons"] == {"cost_tiebreak": 1}
+
+
+def test_placement_with_no_traffic_is_name_deterministic():
+    ordered = router.placement_order([(1.0, "b"), (1.0, "a"), (0.5, "c")])
+    assert ordered == [(0.5, "c"), (1.0, "a"), (1.0, "b")]
+    assert router.stats()["placement"]["reasons"] == {"weight_order": 1}
+
+
+# ------------------------------------------------------------ stats surface
+
+def test_stats_shape_and_node_observations():
+    r = router.DispatchRouter("coord", rtt_provider=lambda n: 3.0)
+    r.observe("n1", 30.0)
+    r.select_copy([_copy("n1")], sid=0)
+    s = router.stats()
+    assert set(s) == {"copy", "split", "placement", "nodes"}
+    assert s["copy"]["decisions"] == 1
+    assert s["nodes"]["n1"]["service_ewma_ms"] == pytest.approx(30.0)
+    assert s["nodes"]["n1"]["rtt_ewma_ms"] == pytest.approx(3.0)
+    assert s["nodes"]["n1"]["inflight"] == 1
+
+
+def test_dispatch_section_rides_nodes_stats(tmp_path):
+    """The router's per-reason counts surface verbatim under
+    `_nodes/stats indices.mesh.router.dispatch` via the REST tier."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.actions import register_all
+    from elasticsearch_tpu.rest.controller import RestController
+
+    router.choose_split(None, 100, 0, dp=1, n_shards=1, min_rows=10)
+    n = Node(str(tmp_path / "data"))
+    try:
+        rc = RestController()
+        register_all(rc, n)
+        st, body = rc.dispatch("GET", "/_nodes/stats", {}, b"",
+                               "application/json")
+        assert st == 200
+        node_stats = next(iter(body["nodes"].values()))
+        dispatch = node_stats["indices"]["mesh"]["router"]["dispatch"]
+        assert dispatch["split"]["reasons"]["no_batch_signal"] >= 1
+        assert set(dispatch) == {"copy", "split", "placement", "nodes"}
+        json.dumps(dispatch)  # the section must be JSON-serializable
+    finally:
+        n.close()

@@ -13,10 +13,17 @@ The four contracts the fan-out subsystem (serving/fanout.py) must hold:
 * fault harness installed but idle → byte-identical accumulator behavior
   to a bare cluster (the wrapper must be invisible at zero faults).
 
-All scenarios run on the deterministic simulator with the
-`FaultInjectingTransport` wrapper (testing/faults.py) injecting the
-drop/delay/kill behaviors.
+All scenarios run with the `FaultInjectingTransport` wrapper
+(testing/faults.py) injecting the drop/delay/kill behaviors. The core
+fault scenarios run TWICE — once on the deterministic simulator
+(virtual clock) and once over real TCP sockets (`transport/tcp.py`,
+wall clock) — proving the fan-out contracts are properties of the
+serving code, not artifacts of the simulated transport. A final parity
+test pins the sim and socket paths to byte-identical kNN responses.
 """
+
+import asyncio
+import json
 
 import numpy as np
 import pytest
@@ -37,34 +44,88 @@ DIMS = 4
 
 
 class FaultyCluster:
-    """TestCluster (test_multi_node) + the fault-injection wrapper."""
+    """TestCluster (test_multi_node) + the fault-injection wrapper.
 
-    def __init__(self, tmp_path, n_nodes=3, seed=0, with_faults=True):
-        self.queue = DeterministicTaskQueue(seed=seed)
-        inner = DisruptableTransport(self.queue)
-        if with_faults:
-            self.faults = FaultInjectingTransport(inner,
-                                                  scheduler=self.queue)
-            self.transport = self.faults
-        else:
-            self.faults = None
-            self.transport = inner
+    backend="sim": one shared DisruptableTransport on the deterministic
+    task queue (virtual time). backend="tcp": one TcpTransportService
+    per node on a real event loop (wall time), each wrapped in its own
+    FaultInjectingTransport — the wrappers SHARE one rule set / killed
+    set / stats dict, so `c.faults.inject(...)` and `kill_node` govern
+    the whole cluster exactly as the sim's single shared wrapper does.
+    """
+
+    def __init__(self, tmp_path, n_nodes=3, seed=0, with_faults=True,
+                 backend="sim"):
+        self.backend = backend
         ids = [f"n{i}" for i in range(n_nodes)]
         initial = bootstrap_state(ids)
         self.nodes = {}
-        for nid in ids:
-            self.nodes[nid] = ClusterNode(
-                nid, str(tmp_path / nid), self.transport, self.queue,
-                seed_peers=[p for p in ids if p != nid],
-                initial_state=initial)
+        if backend == "sim":
+            self.queue = DeterministicTaskQueue(seed=seed)
+            inner = DisruptableTransport(self.queue)
+            if with_faults:
+                self.faults = FaultInjectingTransport(inner,
+                                                      scheduler=self.queue)
+                self.transport = self.faults
+            else:
+                self.faults = None
+                self.transport = inner
+            for nid in ids:
+                self.nodes[nid] = ClusterNode(
+                    nid, str(tmp_path / nid), self.transport, self.queue,
+                    seed_peers=[p for p in ids if p != nid],
+                    initial_state=initial)
+        else:
+            from elasticsearch_tpu.transport.tcp import (
+                AsyncioScheduler, TcpTransportService)
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self._tcp_inners = {nid: TcpTransportService(nid, loop=self.loop)
+                                for nid in ids}
+            self.loop.run_until_complete(asyncio.gather(
+                *[t.bind() for t in self._tcp_inners.values()]))
+            for nid, t in self._tcp_inners.items():
+                for other, ot in self._tcp_inners.items():
+                    if other != nid:
+                        t.add_peer_address(other, *ot.bound_address)
+            self.faults = None
+            for i, nid in enumerate(ids):
+                sched = AsyncioScheduler(self.loop, seed=seed + i)
+                transport = self._tcp_inners[nid]
+                if with_faults:
+                    wrapper = FaultInjectingTransport(transport,
+                                                      scheduler=sched)
+                    if self.faults is None:
+                        self.faults = wrapper
+                    else:
+                        wrapper.rules = self.faults.rules
+                        wrapper._killed = self.faults._killed
+                        wrapper.stats = self.faults.stats
+                    transport = wrapper
+                self.nodes[nid] = ClusterNode(
+                    nid, str(tmp_path / nid), transport, sched,
+                    seed_peers=[p for p in ids if p != nid],
+                    initial_state=initial)
         for n in self.nodes.values():
             n.start()
 
+    def now_ms(self):
+        if self.backend == "sim":
+            return self.queue.now_ms
+        return self.loop.time() * 1000.0
+
     def run_until(self, cond, max_ms=120_000, step=200):
-        waited = 0
-        while waited < max_ms:
-            self.queue.run_for(step)
-            waited += step
+        if self.backend == "sim":
+            waited = 0
+            while waited < max_ms:
+                self.queue.run_for(step)
+                waited += step
+                if cond():
+                    return True
+            return cond()
+        deadline = self.loop.time() + min(max_ms, 60_000) / 1000.0
+        while self.loop.time() < deadline:
+            self.loop.run_until_complete(asyncio.sleep(0.02))
             if cond():
                 return True
         return cond()
@@ -92,6 +153,10 @@ class FaultyCluster:
         for n in self.nodes.values():
             if not n.coordinator.stopped:
                 n.stop()
+        if self.backend == "tcp":
+            self.loop.run_until_complete(asyncio.gather(
+                *[t.close() for t in self._tcp_inners.values()]))
+            self.loop.close()
 
 
 def _rng(seed=7):
@@ -149,12 +214,28 @@ def cluster(tmp_path):
     c.stop()
 
 
+@pytest.fixture(params=["sim", "tcp"])
+def wire_cluster(tmp_path, request):
+    """The core fault scenarios run on BOTH transports: deterministic
+    simulator and real asyncio TCP sockets."""
+    c = FaultyCluster(tmp_path, n_nodes=3, seed=17,
+                      backend=request.param)
+
+    def stable():
+        m = c.master()
+        return m is not None and len(m.cluster_state.nodes) == 3
+
+    assert c.run_until(stable), f"{request.param} cluster did not stabilize"
+    yield c
+    c.stop()
+
+
 # ---------------------------------------------------------------------------
 # expired budget → partial results
 # ---------------------------------------------------------------------------
 
-def test_expired_budget_returns_partial_with_shard_accounting(cluster):
-    c = cluster
+def test_expired_budget_returns_partial_with_shard_accounting(wire_cluster):
+    c = wire_cluster
     coord = _build(c, vectors=False)
     victim, victim_shards = _victim(c, "docs")
     # tight phase budget so the per-shard timers fire fast
@@ -167,7 +248,7 @@ def test_expired_budget_returns_partial_with_shard_accounting(cluster):
     # partition shape — no response, no failure)
     c.faults.inject(FaultRule(target=victim, action=QUERY_SHARD,
                               drop=True))
-    t0 = c.queue.now_ms
+    t0 = c.now_ms()
     resp = c.call(coord.client_search, "docs",
                   {"query": {"match_all": {}}, "size": 30})
     assert resp["timed_out"] is True
@@ -180,8 +261,8 @@ def test_expired_budget_returns_partial_with_shard_accounting(cluster):
     assert len(resp["hits"]["hits"]) > 0
     assert resp["hits"]["total"]["relation"] == "gte"
     # the response arrived via the budget timer, not a hang: bounded by
-    # budget + scheduler slack
-    assert c.queue.now_ms - t0 < 5_000
+    # budget + scheduler slack (virtual OR wall-clock ms, per backend)
+    assert c.now_ms() - t0 < 5_000
     phase = coord.fanout_stats.phases["query"]
     assert phase["timed_out"] == len(victim_shards)
     assert coord.fanout_stats.partial_responses >= 1
@@ -212,8 +293,8 @@ def test_partial_results_disallowed_is_an_error(cluster):
 # dead node → no hang, failure counted
 # ---------------------------------------------------------------------------
 
-def test_dead_node_fanout_completes_with_failures(cluster):
-    c = cluster
+def test_dead_node_fanout_completes_with_failures(wire_cluster):
+    c = wire_cluster
     coord = _build(c, vectors=False)
     victim, victim_shards = _victim(c, "docs")
     assert c.call(coord.client_update_settings,
@@ -261,8 +342,8 @@ def test_all_copies_red_early_return_matches_response_contract(cluster):
 # slow node → remote shed via the continuous batcher's EDF queue
 # ---------------------------------------------------------------------------
 
-def test_slow_node_sheds_at_remote_batcher_not_coordinator_timer(cluster):
-    c = cluster
+def test_slow_node_sheds_at_remote_batcher_not_coordinator_timer(wire_cluster):
+    c = wire_cluster
     coord = _build(c, vectors=True)
     victim, victim_shards = _victim(c, "docs")
     # deliver the victim's QUERY sub-requests 500ms late — past the
@@ -344,6 +425,32 @@ def test_accumulator_parity_with_no_fault_path(tmp_path):
                                             "docs", body)))
         c.stop()
     assert responses[0] == responses[1]
+
+
+def test_knn_response_byte_parity_sim_vs_sockets(tmp_path):
+    """The same kNN+match+aggs search against the same corpus must
+    produce a byte-identical JSON response whether the cluster runs on
+    the in-process simulator or over real TCP sockets — serialization
+    through the wire must not perturb scores, ordering, or shapes
+    (modulo timing fields, which are stripped)."""
+    payloads = []
+    for backend in ("sim", "tcp"):
+        c = FaultyCluster(tmp_path / backend, n_nodes=3, seed=17,
+                          with_faults=False, backend=backend)
+        assert c.run_until(lambda: c.master() is not None
+                           and len(c.master().cluster_state.nodes) == 3)
+        coord = _build(c)
+        body = {"query": {"match": {"title": "doc"}},
+                "knn": {"field": "v",
+                        "query_vector": _rng(5).standard_normal(
+                            DIMS).astype(float).tolist(),
+                        "k": 4, "num_candidates": 4},
+                "size": 10,
+                "aggs": {"m": {"max": {"field": "n"}}}}
+        resp = _strip_took(c.call(coord.client_search, "docs", body))
+        payloads.append(json.dumps(resp, sort_keys=True).encode())
+        c.stop()
+    assert payloads[0] == payloads[1]
 
 
 # ---------------------------------------------------------------------------

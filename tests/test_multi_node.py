@@ -808,3 +808,51 @@ def test_scripted_metric_across_shards(cluster):
     assert resp["aggregations"]["total"]["value"] == float(sum(range(40)))
     # two shards -> two combined states folded in the reduce
     assert resp["_shards"]["successful"] == 2
+
+
+def test_text_only_shards_never_materialize_vector_store(cluster):
+    """Remote-shard stubs stay LIGHT: a shard whose mapping has no
+    vector fields must never build a VectorStoreShard (device corpus,
+    batcher, routers) — writes and searches run host-only. A vector
+    mapping materializes the store lazily on first access."""
+    c = cluster
+    c.any_node().client_create_index(
+        "plain", settings={"index.number_of_shards": 2,
+                           "index.number_of_replicas": 1},
+        mappings={"properties": {"title": {"type": "text"},
+                                 "n": {"type": "long"}}})
+    assert c.run_until(lambda: c.all_started("plain"))
+    writer = c.any_node()
+    for i in range(6):
+        r = c.call(writer.client_write, "plain",
+                   {"type": "index", "id": str(i),
+                    "source": {"title": f"doc {i}", "n": i}})
+        assert r["result"] == "created", r
+    c.call(writer.client_refresh, "plain")
+    resp = c.call(writer.client_search, "plain",
+                  {"query": {"match_all": {}}, "size": 10})
+    assert resp["hits"]["total"]["value"] == 6
+    # the full write+replicate+search lifecycle ran; no copy ever paid
+    # for a device vector store
+    n_copies = 0
+    for node in c.nodes.values():
+        for (idx, _sid), shard in node.local_shards.items():
+            if idx != "plain":
+                continue
+            n_copies += 1
+            assert shard._vector_store is None, \
+                f"text-only shard materialized a vector store on {node.node_id}"
+            assert shard.active_vector_store() is None
+    assert n_copies == 4  # 2 shards x (primary + replica)
+
+    # a vector-mapped index DOES materialize — but only on access
+    c.any_node().client_create_index(
+        "vec", settings={"index.number_of_shards": 1,
+                         "index.number_of_replicas": 0},
+        mappings={"properties": {"v": {"type": "dense_vector", "dims": 4}}})
+    assert c.run_until(lambda: c.all_started("vec"))
+    holder = next(node for node in c.nodes.values()
+                  if ("vec", 0) in node.local_shards)
+    vshard = holder.local_shards[("vec", 0)]
+    assert vshard.active_vector_store() is not None
+    assert vshard._vector_store is not None

@@ -298,3 +298,124 @@ def test_ivf_layout_restore_skips_training():
     rows_b, scores_b = restored.search("v", q, 10)
     np.testing.assert_array_equal(rows_a, rows_b)
     np.testing.assert_allclose(scores_a, scores_b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# snapshot stream limiter: bounded concurrency + per-node byte throttle
+# ---------------------------------------------------------------------------
+
+class _CountingRepo:
+    """In-memory repo that records upload concurrency high-water."""
+
+    def __init__(self):
+        import threading
+        self.blobs = {}
+        self._lock = threading.Lock()
+        self._active = 0
+        self.max_active = 0
+
+    def has_blob(self, digest):
+        return digest in self.blobs
+
+    def put_bytes(self, data):
+        import time
+        with self._lock:
+            self._active += 1
+            self.max_active = max(self.max_active, self._active)
+        time.sleep(0.02)  # widen the overlap window
+        with self._lock:
+            self.blobs[block_digest(data)] = data
+            self._active -= 1
+
+
+def test_stream_limiter_token_bucket_sleeps_out_deficit():
+    import time
+    from elasticsearch_tpu.recovery.snapshot import SnapshotStreamLimiter
+    lim = SnapshotStreamLimiter(max_streams=1, max_bytes_per_sec=100_000)
+    lim.throttle(100_000)            # consumes the initial 1s burst
+    t0 = time.monotonic()
+    lim.throttle(15_000)             # ~150ms deficit at 100KB/s
+    waited = time.monotonic() - t0
+    assert waited >= 0.1
+    assert lim.stats["blocks_throttled"] == 1
+    assert lim.stats["throttle_time_in_millis"] > 0
+
+
+def test_stream_limiter_reapplying_same_rate_keeps_spent_allowance():
+    from elasticsearch_tpu.recovery.snapshot import SnapshotStreamLimiter
+    lim = SnapshotStreamLimiter(max_streams=1, max_bytes_per_sec=100_000)
+    lim.throttle(100_000)
+    # every shard upload re-reads cluster settings: the SAME rate must
+    # not refund the spent bucket...
+    lim.configure(max_bytes_per_sec=100_000)
+    assert lim._allowance <= 1_000
+    # ...but a CHANGED rate restarts the bucket full
+    lim.configure(max_bytes_per_sec=50_000)
+    assert lim._allowance == 50_000.0
+
+
+def test_stream_limiter_configure_from_settings_parses_units():
+    from elasticsearch_tpu.recovery.snapshot import SnapshotStreamLimiter
+    lim = SnapshotStreamLimiter()
+    lim.configure_from_settings({"snapshot.max_bytes_per_sec": "2mb",
+                                 "snapshot.max_concurrent_streams": "3"})
+    assert lim.max_bytes_per_sec == 2 * 1024 * 1024
+    assert lim.max_streams == 3
+    # garbage values are ignored, not fatal (snapshots must not break on
+    # a bad setting)
+    lim.configure_from_settings({"snapshot.max_bytes_per_sec": "alot"})
+    assert lim.max_bytes_per_sec == 2 * 1024 * 1024
+
+
+def test_snapshot_shard_uploads_concurrently_under_limiter(tmp_path):
+    from elasticsearch_tpu.recovery.snapshot import (
+        SnapshotStreamLimiter, snapshot_shard)
+    src = Engine(str(tmp_path / "src"), MapperService(MAPPING))
+    try:
+        # two refresh generations -> >=3 blocks (2 segments + ledger)
+        for i in range(10):
+            src.index(str(i), {"title": f"doc {i}", "tag": "a", "views": i})
+        src.refresh()
+        for i in range(10, 20):
+            src.index(str(i), {"title": f"doc {i}", "tag": "b", "views": i})
+        src.flush()
+        repo = _CountingRepo()
+        lim = SnapshotStreamLimiter(max_streams=3, max_bytes_per_sec=0)
+        entry = snapshot_shard(repo, src, limiter=lim)
+        assert entry["stats"]["blocks_shipped"] >= 3
+        assert repo.max_active >= 2, "uploads never overlapped"
+        assert lim.stats["max_concurrent_streams"] >= 2
+        # every manifest digest landed in the repo
+        for e in entry["blocks"]:
+            assert repo.has_blob(e["digest"])
+        # second snapshot of identical state ships nothing
+        entry2 = snapshot_shard(repo, src, limiter=lim)
+        assert entry2["stats"]["blocks_shipped"] == 0
+        assert entry2["stats"]["blocks_reused"] > 0
+    finally:
+        src.close()
+
+
+def test_snapshot_stream_stats_ride_nodes_stats(tmp_path):
+    """`_nodes/stats indices.recovery.snapshot_streams` surfaces the
+    node-wide limiter's counters and configuration."""
+    import json
+    node = Node(str(tmp_path / "data"))
+    try:
+        from elasticsearch_tpu.rest.actions import register_all
+        from elasticsearch_tpu.rest.controller import RestController
+        rc = RestController()
+        register_all(rc, node)
+        st, body = rc.dispatch("GET", "/_nodes/stats", {}, b"",
+                               "application/json")
+        assert st == 200
+        node_stats = next(iter(body["nodes"].values()))
+        streams = node_stats["indices"]["recovery"]["snapshot_streams"]
+        for key in ("throttle_time_in_millis", "blocks_throttled",
+                    "blocks_uploaded", "bytes_uploaded",
+                    "max_concurrent_streams", "max_streams",
+                    "max_bytes_per_sec"):
+            assert key in streams, key
+        json.dumps(streams)
+    finally:
+        node.close()

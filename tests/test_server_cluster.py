@@ -229,6 +229,7 @@ def test_tls_cluster_forms_and_rejects_plaintext(tmp_path):
     """Two CLI-booted processes form a cluster over mutual-TLS transport
     with signed auth contexts; a plaintext socket poking the transport port
     gets no cluster access (transport/tls.py)."""
+    pytest.importorskip("cryptography")
     from elasticsearch_tpu.transport.tls import generate_ca, generate_node_cert
 
     certs_dir = str(tmp_path / "certs")

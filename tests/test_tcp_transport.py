@@ -298,3 +298,82 @@ def test_full_cluster_over_tcp(tmp_path):
         c.close()
     finally:
         loop.close()
+
+
+# ------------------------------------------- connection profile + RTT feed
+
+def test_connection_profile_widens_reg_only_under_concurrency():
+    """Serial traffic stays on one socket (pinned above); CONCURRENT
+    requests widen to the profile's reg allowance (2) and no further —
+    the third in-flight request round-robins over the busy pair."""
+    async def body():
+        a, b = await make_pair()
+        held = []
+        b.register("b", "hold", lambda s, r, respond: held.append(respond))
+        boxes = [{} for _ in range(3)]
+        for i, box in enumerate(boxes):
+            a.send("a", "b", "hold", {"i": i},
+                   on_response=lambda r, box=box: box.update(r=r))
+        deadline = asyncio.get_event_loop().time() + 5
+        while len(held) < 3:
+            assert asyncio.get_event_loop().time() < deadline, held
+            await asyncio.sleep(0.005)
+        assert a.stats["connections_opened"] == 2
+        for respond in list(held):
+            respond({"ok": True})
+        for box in boxes:
+            await wait_for(box, "r")
+        # all channels idle again: a follow-up request reuses, not opens
+        held.clear()
+        box = {}
+        a.send("a", "b", "hold", {}, on_response=lambda r: box.update(r=r))
+        while not held:
+            await asyncio.sleep(0.005)
+        held[0]({"ok": True})
+        await wait_for(box, "r")
+        assert a.stats["connections_opened"] == 2
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_recovery_stream_does_not_hol_block_queries():
+    """A recovery transfer saturating its channel must not head-of-line
+    block query fan-out: recovery actions ride their OWN socket."""
+    async def body():
+        a, b = await make_pair()
+        rec_held = []
+        b.register("b", "internal:index/shard/recovery/chunk",
+                   lambda s, r, respond: rec_held.append(respond))
+        b.register("b", "echo", lambda s, r, respond: respond({"ok": True}))
+        box = {}
+        a.send("a", "b", "internal:index/shard/recovery/chunk",
+               {"blob": "x" * 1000}, on_response=lambda r: box.update(rec=r))
+        a.send("a", "b", "echo", {}, on_response=lambda r: box.update(q=r))
+        await wait_for(box, "q")
+        assert "rec" not in box      # the query finished FIRST
+        assert a.stats["connections_opened"] == 2  # recovery + reg sockets
+        rec_held[0]({"done": True})
+        await wait_for(box, "rec")
+        await a.close(); await b.close()
+    run(body())
+
+
+def test_rtt_comes_from_control_exchanges_not_service_time():
+    """The RTT EWMA feeds the dispatch cost router's wire term; it must
+    sample only O(1) control exchanges (handshake/ping). A slow handler
+    (service time) must NOT inflate it — the service EWMA already
+    carries that, and double-counting would poison the device-leg
+    estimate."""
+    async def body():
+        a, b = await make_pair()
+        loop = asyncio.get_event_loop()
+        b.register("b", "work", lambda s, r, respond: loop.call_later(
+            0.25, lambda: respond({"ok": True})))
+        box = {}
+        a.send("a", "b", "work", {}, on_response=lambda r: box.update(r=r))
+        await wait_for(box, "r")
+        rtt = a.rtt_ms("b")
+        assert rtt is not None and rtt < 150, \
+            f"loopback handshake RTT, not the 250ms service time: {rtt}"
+        await a.close(); await b.close()
+    run(body())

@@ -33,17 +33,30 @@ from tools.tpulint.engine import (  # noqa: E402
     write_baseline,
 )
 
-RULE_IDS = tuple(f"TPU{i:03d}" for i in range(1, 15))
+RULE_IDS = tuple(f"TPU{i:03d}" for i in range(1, 16))
+
+
+def _fixture_path(name: str) -> str:
+    """Fixtures live flat under tpulint_fixtures/ — except path-scoped
+    rules (e.g. TPU015's transport/ scope), whose fixtures sit in a
+    subdirectory matching the rule's globs."""
+    flat = os.path.join(FIXTURES, name)
+    if os.path.exists(flat):
+        return flat
+    for root, _dirs, files in os.walk(FIXTURES):
+        if name in files:
+            return os.path.join(root, name)
+    raise FileNotFoundError(f"no fixture named {name} under {FIXTURES}")
 
 
 def lint_fixture(name: str, rule: str):
-    path = os.path.join(FIXTURES, name)
-    return lint_paths([path], config=Config(select=(rule,)), root=REPO)
+    return lint_paths([_fixture_path(name)],
+                      config=Config(select=(rule,)), root=REPO)
 
 
 def expected_lines(name: str):
     """Line numbers carrying an `# [expect]` marker in a fires fixture."""
-    with open(os.path.join(FIXTURES, name)) as f:
+    with open(_fixture_path(name)) as f:
         return {i for i, text in enumerate(f.read().splitlines(), 1)
                 if "[expect]" in text}
 

@@ -5,6 +5,11 @@ import asyncio
 
 import pytest
 
+# cert generation needs the optional `cryptography` package; without it
+# these tests SKIP (the TLS code itself imports it lazily, so the rest
+# of the transport suite is unaffected)
+pytest.importorskip("cryptography")
+
 from elasticsearch_tpu.transport import TcpTransportService
 from elasticsearch_tpu.transport.tls import (
     TlsConfig, TlsConfigError, TransportAuth, TransportAuthError, current_auth,

@@ -1,0 +1,32 @@
+"""TPU015 fires: blocking calls lexically on the asyncio event loop.
+
+Lives under a `transport/` path segment so the rule's
+`async_actor_globs` scope applies, mirroring the real transport tier.
+"""
+import asyncio
+import socket
+import subprocess
+import time
+
+
+class Transport:
+    def __init__(self, loop):
+        self.loop = loop
+
+    async def handle_request(self, request):
+        time.sleep(0.05)                                      # [expect]
+        with open("/tmp/spool", "wb") as f:                   # [expect]
+            f.write(request)
+        return subprocess.run(["true"])                       # [expect]
+
+    async def open_channel(self, host, port):
+        return socket.create_connection((host, port))         # [expect]
+
+    def arm_retry(self):
+        self.loop.call_later(
+            1.0, lambda: time.sleep(0.2))                     # [expect]
+
+    def arm_flush(self):
+        def flush_cb():
+            open("/tmp/wal", "ab").close()                    # [expect]
+        self.loop.call_soon(flush_cb)

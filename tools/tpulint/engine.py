@@ -61,6 +61,13 @@ DEFAULT_DURABILITY_ALLOWED = (
     "*/segments/*.py",
     "*/recovery/*.py",
 )
+# the modules whose handlers run ON an asyncio event loop (TPU015): the
+# TCP transport tier and the cluster nodes it serves — one blocking call
+# there stalls every in-flight RPC and keepalive on that node's loop
+DEFAULT_ASYNC_ACTOR_GLOBS = (
+    "*/transport/*.py",
+    "*/cluster/*.py",
+)
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -98,6 +105,7 @@ class Config:
     seg_cache_allowed: Sequence[str] = DEFAULT_SEG_CACHE_ALLOWED
     quant_allowed: Sequence[str] = DEFAULT_QUANT_ALLOWED
     durability_allowed: Sequence[str] = DEFAULT_DURABILITY_ALLOWED
+    async_actor_globs: Sequence[str] = DEFAULT_ASYNC_ACTOR_GLOBS
     select: Optional[Sequence[str]] = None   # rule ids; None = all
 
 

@@ -1564,10 +1564,110 @@ class DurabilityRule(Rule):
         return findings
 
 
+class EventLoopBlockingRule(Rule):
+    """TPU015: blocking IO / sleeps lexically on an asyncio event loop.
+
+    The multi-process cluster serves ALL of a node's RPCs on one asyncio
+    loop (`transport/tcp.py`): a single `time.sleep` or synchronous
+    socket/file/subprocess call inside an `async def` — or inside a
+    callback handed to the loop's own scheduling primitives
+    (`call_soon`/`call_later`/`call_at`) — parks every in-flight
+    request, response, and keepalive on that node. The symptom is a
+    cross-node p99 spike with no device work to blame; the first
+    real-socket bench run surfaced exactly this shape. Blocking work
+    belongs on a worker thread (`run_in_executor`, or the recovery tier's
+    upload pools).
+
+    Scope is `async_actor_globs` (transport/, cluster/) and the rule is
+    LEXICAL: it only judges code that demonstrably runs on the loop.
+    Plain sync helpers in the same files — thread-loop bodies, CLI
+    entry points, `AsyncioScheduler.schedule` callbacks (which run
+    engine work by design, on the sim queue and loop alike) — are out
+    of scope: being in the file is not evidence of running on the loop.
+    """
+
+    rule_id = "TPU015"
+
+    _BLOCKING = {
+        "time.sleep": "parks the whole event loop for the duration",
+        "socket.create_connection": "synchronous connect stalls the loop",
+        "subprocess.run": "waiting on a child process stalls the loop",
+        "subprocess.check_output":
+            "waiting on a child process stalls the loop",
+        "subprocess.check_call":
+            "waiting on a child process stalls the loop",
+        "urllib.request.urlopen": "synchronous HTTP stalls the loop",
+    }
+    _BARE = {"open": "synchronous file IO stalls the loop"}
+    _LOOP_SCHEDULERS = {"call_soon", "call_soon_threadsafe",
+                        "call_later", "call_at"}
+
+    def run(self, ctx: ModuleContext, index: ProjectIndex) -> List[Finding]:
+        if not ctx.matches(getattr(ctx.config, "async_actor_globs", ())):
+            return []
+        # local sync defs by name, to resolve `loop.call_soon(pump)`
+        local_defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                local_defs.setdefault(node.name, node)
+        targets: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                targets.append((node, f"async handler [{node.name}]"))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self._LOOP_SCHEDULERS:
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for arg in args:
+                    if isinstance(arg, ast.Lambda):
+                        targets.append(
+                            (arg, f"{node.func.attr}() callback"))
+                    elif isinstance(arg, ast.Name) \
+                            and arg.id in local_defs:
+                        targets.append((local_defs[arg.id],
+                                        f"{node.func.attr}() callback "
+                                        f"[{arg.id}]"))
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for fn, how in targets:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            findings.extend(self._scan(ctx, fn, how))
+        findings.sort(key=lambda f: (f.line, f.col))
+        return findings
+
+    def _scan(self, ctx: ModuleContext, fn: ast.AST,
+              how: str) -> List[Finding]:
+        # lexically inside THIS function only: nested defs get their own
+        # judgment (a nested sync def may run on a thread)
+        if isinstance(fn, ast.Lambda):
+            exprs: List[ast.AST] = list(ast.walk(fn.body))
+        else:
+            exprs = []
+            for stmt, _ in _body_statements(fn.body):
+                exprs.extend(_stmt_expressions(stmt))
+        out: List[Finding] = []
+        for node in exprs:
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            why = self._BLOCKING.get(name) or self._BARE.get(name)
+            if why is None:
+                continue
+            out.append(ctx.finding(
+                self.rule_id, node,
+                f"blocking call [{name}] inside {how} — {why}; every "
+                "in-flight RPC and keepalive on this node's loop stalls "
+                "behind it. Move it to a worker thread "
+                "(run_in_executor) or make it async"))
+        return out
+
+
 ALL_RULES: List[Rule] = [
     RawJitRule(), HostSyncRule(), IdKeyedCacheRule(), ReadAfterDonateRule(),
     UnscrubbedCacheKeyRule(), ScopedX64Rule(), SpecRankRule(),
     ModuleCacheLockRule(), LockedSyncRule(), UnguardedFanoutRule(),
     PrivateSegmentCacheRule(), TelemetryDisciplineRule(),
-    HandRolledQuantRule(), DurabilityRule(),
+    HandRolledQuantRule(), DurabilityRule(), EventLoopBlockingRule(),
 ]
