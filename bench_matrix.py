@@ -1450,11 +1450,15 @@ def run_small_batch_serving(n: int = 1_000_000, d: int = 128):
 def run_device_aggs(n_docs: int = 100_000):
     """Config 8: device-resident aggregations (ops/aggs.py +
     search/agg_plan.py) — dashboard-shaped bodies (terms+stats,
-    date_histogram+stats over a range-filtered match set) served by the
-    fused filter→aggregate device plan vs the host numpy walkers, with
-    byte-parity asserted between the two. `dispatch` records the aggs.*
-    executable-cache behavior of the measured (post-warm) window — a
-    steady-state dashboard must show zero compiles."""
+    CALENDAR date_histogram, 2-level sub-agg trees, cardinality over a
+    range-filtered match set) served by the fused filter→aggregate
+    device plan vs the host numpy walkers, with byte-parity asserted
+    between the two. `dispatch` records the aggs.* executable-cache
+    behavior of the measured (post-warm) window — a steady-state
+    dashboard must show zero compiles — and `gate_device_ratio` holds
+    the device-routed fraction of agg nodes at ≥ 0.9 (the cost router
+    is pinned off for the device rows so the gate measures ELIGIBILITY,
+    not the router's tiny-corpus escape hatch)."""
     import os
     import tempfile
 
@@ -1464,6 +1468,7 @@ def run_device_aggs(n_docs: int = 100_000):
         n_docs = min(n_docs, 4_000)
     rng = np.random.default_rng(23)
     node = Node(tempfile.mkdtemp())
+    node.settings["search.aggs.cost_router"] = "false"
     try:
         node.create_index_with_templates("dash", mappings={"properties": {
             "cat": {"type": "keyword"}, "status": {"type": "keyword"},
@@ -1498,6 +1503,20 @@ def run_device_aggs(n_docs: int = 100_000):
                         "over_time": {"date_histogram": {
                             "field": "ts", "fixed_interval": "1h"},
                             "aggs": {"b": {"sum": {"field": "bytes"}}}},
+                        # rung 2: calendar interval (boundary table),
+                        # 2-level sub-agg tree (composite-id boards),
+                        # cardinality (HLL register boards)
+                        "per_hour": {"date_histogram": {
+                            "field": "ts", "calendar_interval": "hour"},
+                            "aggs": {"uc": {"cardinality":
+                                            {"field": "cat"}}}},
+                        "cat_status": {"terms": {"field": "cat",
+                                                 "size": 5},
+                                       "aggs": {"st": {"terms": {
+                                           "field": "status"},
+                                           "aggs": {"b": {"sum": {
+                                               "field": "bytes"}}}}}},
+                        "services": {"cardinality": {"field": "cat"}},
                         "tiers": {"range": {"field": "bytes", "ranges": [
                             {"to": 1 << 14}, {"from": 1 << 14,
                                               "to": 1 << 18},
@@ -1520,6 +1539,11 @@ def run_device_aggs(n_docs: int = 100_000):
         agg_stats = {k: eng.stats[k] for k in
                      ("device_nodes", "host_nodes", "plan_cache_hits",
                       "plan_cache_misses", "mesh_dispatches")}
+        agg_stats["fallback_reasons"] = {
+            r: dict(ent) for r, ent in
+            eng.stats["fallback_reasons"].items()}
+        routed = agg_stats["device_nodes"] + agg_stats["host_nodes"]
+        device_ratio = agg_stats["device_nodes"] / max(routed, 1)
 
         node.settings["search.aggs.device_enabled"] = "false"
         host_lats = []
@@ -1542,6 +1566,8 @@ def run_device_aggs(n_docs: int = 100_000):
             "host_p50_ms": round(host_p50, 2),
             "speedup_vs_host": round(host_p50 / max(dev_p50, 1e-9), 2),
             "parity_vs_host": parity,
+            "device_ratio": round(device_ratio, 3),
+            "gate_device_ratio": device_ratio >= 0.9,
             "n_docs": n_docs,
             "aggs": agg_stats,
             "build_s": round(build_s, 1),

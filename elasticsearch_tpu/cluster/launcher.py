@@ -44,13 +44,22 @@ DEFAULT_HOST = "127.0.0.1"
 READY_PREFIX = "LAUNCHER_READY"
 
 
+def default_host() -> str:
+    """Bind/advertise host for launched nodes: `ES_TPU_BIND_HOST` when
+    set, else loopback. Resolved at CALL time (not import) so a test or
+    wrapper can flip the env var per launch."""
+    return os.environ.get("ES_TPU_BIND_HOST") or DEFAULT_HOST
+
+
 # --------------------------------------------------------------- addressing
 
-def find_free_ports(n: int, host: str = DEFAULT_HOST) -> List[int]:
+def find_free_ports(n: int, host: Optional[str] = None) -> List[int]:
     """Reserve n distinct ephemeral ports by binding then releasing them.
     The small release-to-rebind race is acceptable on loopback — the
     alternative (children choosing ports) needs a rendezvous channel
     before the cluster exists to provide one."""
+    if host is None:
+        host = default_host()
     socks, ports = [], []
     try:
         for _ in range(n):
@@ -85,7 +94,7 @@ def parse_peers(spec: str) -> Dict[str, Tuple[str, int]]:
 
 def run_data_node(node_id: str, port: int, data_path: str,
                   peers: Dict[str, Tuple[str, int]],
-                  masters: List[str], host: str = DEFAULT_HOST,
+                  masters: List[str], host: Optional[str] = None,
                   policy_config: Optional[dict] = None,
                   cluster_settings: Optional[dict] = None,
                   ready_out=None) -> None:
@@ -100,6 +109,8 @@ def run_data_node(node_id: str, port: int, data_path: str,
     from elasticsearch_tpu.transport.tcp import (
         AsyncioScheduler, TcpTransportService)
 
+    if host is None:
+        host = default_host()
     if policy_config:
         from elasticsearch_tpu.parallel import policy
         policy.configure(**policy_config)
@@ -229,7 +240,7 @@ def join_cluster(node_id: str, data_path: str,
                  peers: Dict[str, Tuple[str, int]],
                  masters: List[str], loop,
                  cluster_settings: Optional[dict] = None,
-                 host: str = DEFAULT_HOST, port: int = 0,
+                 host: Optional[str] = None, port: int = 0,
                  roles: Optional[set] = None):
     """Build the parent process's own `ClusterNode` (typically the bench
     coordinator) on `loop`, wired into the same TCP peer set the
@@ -243,7 +254,8 @@ def join_cluster(node_id: str, data_path: str,
     from elasticsearch_tpu.transport.tcp import (
         AsyncioScheduler, TcpTransportService)
 
-    want = peers.get(node_id, (host, port))
+    want = peers.get(node_id, (host if host is not None
+                               else default_host(), port))
     transport = TcpTransportService(node_id, host=want[0], port=want[1],
                                     loop=loop)
     loop.run_until_complete(transport.bind())
@@ -270,7 +282,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Boot one TCP data node of a multi-process cluster")
     ap.add_argument("--node-id", required=True)
-    ap.add_argument("--host", default=DEFAULT_HOST)
+    ap.add_argument("--host", default=None,
+                    help="bind/advertise address (default: "
+                         "$ES_TPU_BIND_HOST or 127.0.0.1)")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--data-path", required=True)
     ap.add_argument("--peers", required=True,

@@ -1244,8 +1244,11 @@ class Node:
             if cached is not None and cached[0] is svc:
                 return cached[1]
             from elasticsearch_tpu.search.agg_plan import AggEngine
+            router = self.settings.get("search.aggs.cost_router")
             engine = AggEngine(svc.mapper_service,
-                               warmup=self._dispatch_warmup)
+                               warmup=self._dispatch_warmup,
+                               cost_router=(router is None
+                                            or setting_bool(router)))
 
             def _resync(_reader, svc=svc, engine=engine):
                 def run():
@@ -1273,8 +1276,9 @@ class Node:
         footprint."""
         out = {"searches": 0, "device_nodes": 0, "host_nodes": 0,
                "plan_cache_hits": 0, "plan_cache_misses": 0,
-               "device_nanos": 0, "assemble_nanos": 0,
-               "mesh_dispatches": 0, "fallback_reasons": {},
+               "device_nanos": 0, "assemble_nanos": 0, "host_nanos": 0,
+               "mesh_dispatches": 0, "router_host_routed": 0,
+               "router_probes": 0, "fallback_reasons": {},
                "columns": 0, "column_bytes": 0, "column_rebuilds": 0}
         with self._aggs_lock:
             self._evict_stale_aggs()
@@ -1282,13 +1286,22 @@ class Node:
         for eng in engines:
             for key in ("searches", "device_nodes", "host_nodes",
                         "plan_cache_hits", "plan_cache_misses",
-                        "device_nanos", "assemble_nanos",
-                        "mesh_dispatches"):
+                        "device_nanos", "assemble_nanos", "host_nanos",
+                        "mesh_dispatches", "router_host_routed",
+                        "router_probes"):
                 out[key] += eng.stats.get(key, 0)
-            for reason, n in eng.stats.get("fallback_reasons",
-                                           {}).items():
-                out["fallback_reasons"][reason] = \
-                    out["fallback_reasons"].get(reason, 0) + n
+            # per-reason entries are {count, docs[, observed_max]}: doc
+            # totals rank reasons by routed WORK, observed_max sizes
+            # ladder growth (e.g. the ordinal count that busted the grid)
+            for reason, ent in eng.stats.get("fallback_reasons",
+                                             {}).items():
+                agg = out["fallback_reasons"].setdefault(
+                    reason, {"count": 0, "docs": 0})
+                agg["count"] += ent["count"]
+                agg["docs"] += ent["docs"]
+                if "observed_max" in ent:
+                    agg["observed_max"] = max(ent["observed_max"],
+                                              agg.get("observed_max", 0))
             out["columns"] += eng.store.stats.get("columns", 0)
             out["column_bytes"] += eng.store.stats.get("bytes", 0)
             out["column_rebuilds"] += eng.store.stats.get("rebuilds", 0)

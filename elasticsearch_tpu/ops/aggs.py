@@ -73,6 +73,29 @@ _EXACT_INT = float(1 << 53)
 # steady traffic fill the tail
 WARMUP_AGG_BUCKETS = (8, 64)
 
+# ladder-top warmup clamp: a single pathological high-cardinality field
+# must not AOT-compile the giant rungs at column build — those compile on
+# first use (and persist) instead of burning warmup time for every column
+WARMUP_MAX_ORD_B = 4096
+
+# HLL register geometry — MUST mirror search/agg_partials.py (_HLL_P /
+# _HLL_M) so device register boards pack into host-identical `$p` states
+HLL_P = 12
+HLL_M = 1 << HLL_P
+
+# composite sub-agg trees: per-level bucket counts ride the same ladder;
+# the flat board is the PRODUCT of the levels, so trees cap on total
+# lanes (HLL boards are HLL_M registers per lane and cap much lower)
+TREE_MAX_DEPTH = 3
+TREE_MAX_LANES = 65536
+HLL_MAX_LANES = 256
+
+# per-level kernel-arg arity for the composite tree kernels: level args
+# flatten in level order, each level contributing (row-shaped..., then
+# replicated params...) — see _split_level_args
+_LEVEL_ROW = {"ord": 1, "hist": 2, "cal": 2}
+_LEVEL_REPL = {"ord": 1, "hist": 1, "cal": 2}
+
 
 def bucket_count(n: int) -> Optional[int]:
     """Round a bucket count up the AGG_B_LADDER; None = off the grid
@@ -210,13 +233,119 @@ def _agg_range_metric(keys, kpresent, mask, bounds, rparams, mparams, vals,
     return cnt, s, mn, mx
 
 
+# ------------------------------------------------- calendar / tree / HLL ---
+
+def _cal_ids(keys, kpresent, cbounds, cparams, n_buckets: int):
+    """Bucket ids for calendar-interval date_histograms from a
+    precomputed sorted boundary table: cbounds f64[B] holds the
+    `_calendar_floor` outputs over the offset-shifted millis domain
+    (+inf pads past the real span), cparams f64[2] = (div, offset).
+    One searchsorted pass — no wall-clock arithmetic in traced code.
+    Rows first truncate exactly like the host's `int(v - offset)`
+    (toward zero, not floor)."""
+    import jax.numpy as jnp
+    shifted = jnp.trunc(keys / cparams[0] - cparams[1])
+    idx = jnp.searchsorted(cbounds, shifted, side="right") - 1
+    ids = idx.astype(jnp.int32)
+    ok = kpresent & (ids >= 0) & (ids < n_buckets)
+    return jnp.where(ok, ids, 0), ok
+
+
+def _agg_cal_counts(keys, kpresent, mask, cbounds, cparams, n_buckets: int):
+    import jax.numpy as jnp
+    ids, ok = _cal_ids(keys, kpresent, cbounds, cparams, n_buckets)
+    tgt = jnp.where(ok, ids, n_buckets)
+    return jnp.zeros(n_buckets + 1, dtype=jnp.int64).at[tgt].add(
+        jnp.where(mask & ok, jnp.int64(1), jnp.int64(0)))
+
+
+def _agg_cal_metric(keys, kpresent, mask, cbounds, cparams, mparams, vals,
+                    present, n_buckets: int):
+    import jax.numpy as jnp
+    ids, ok = _cal_ids(keys, kpresent, cbounds, cparams, n_buckets)
+    tgt = jnp.where(ok, ids, n_buckets)
+    v_eff, p_eff = _metric_eff(vals, present, mparams)
+    return _metric_boards(tgt, mask & ok & p_eff, v_eff, n_buckets)
+
+
+def _tree_targets(mask, levels, n_buckets, flat_args):
+    """Composite bucket ids over a chain of bucket levels: per level the
+    id derives like the single-level kernels, the composite folds as
+    `cid = cid * k_level + id`. A row is ok only if EVERY level resolves
+    (the global trash lane catches the rest). Level arg layout:
+    ord → (ords, oparams f64[1]: missing-lane flag), hist → (keys,
+    kpresent, hparams), cal → (keys, kpresent, cbounds, cparams).
+    Returns (tgt, ok, total) with tgt == total for not-ok rows."""
+    import jax.numpy as jnp
+    cid = jnp.zeros(mask.shape, dtype=jnp.int32)
+    ok = mask
+    total = 1
+    i = 0
+    for kind, k in zip(levels, n_buckets):
+        if kind == "ord":
+            ords, op = flat_args[i], flat_args[i + 1]
+            i += 2
+            absent = ords < 0
+            # with a `missing` param the level's last lane IS the missing
+            # bucket (k was sized for it); otherwise absent rows drop out
+            ids = jnp.where(absent, jnp.int32(k - 1), ords)
+            lok = (~absent) | (op[0] > 0.0)
+        elif kind == "hist":
+            keys, kp, hp = flat_args[i], flat_args[i + 1], flat_args[i + 2]
+            i += 3
+            tgt_l, lok = _hist_ids(keys, kp, hp, k)
+            ids = jnp.where(lok, tgt_l, 0).astype(jnp.int32)
+        else:  # "cal"
+            keys, kp, cb, cp = (flat_args[i], flat_args[i + 1],
+                                flat_args[i + 2], flat_args[i + 3])
+            i += 4
+            ids, lok = _cal_ids(keys, kp, cb, cp, k)
+        cid = cid * k + jnp.where(lok, ids, 0)
+        ok = ok & lok
+        total *= k
+    return jnp.where(ok, cid, total), ok, total
+
+
+def _agg_tree_counts(mask, *level_args, levels, n_buckets):
+    """Composite doc counts: int64[prod(n_buckets) + 1]; the last lane is
+    the global trash (pad rows + rows failing any level)."""
+    import jax.numpy as jnp
+    tgt, ok, total = _tree_targets(mask, levels, n_buckets, level_args)
+    return jnp.zeros(total + 1, dtype=jnp.int64).at[tgt].add(
+        jnp.where(ok, jnp.int64(1), jnp.int64(0)))
+
+
+def _agg_tree_metric(mask, mparams, vals, present, *level_args, levels,
+                     n_buckets):
+    """Per-composite-bucket metric boards (count/sum/min/max)."""
+    tgt, ok, total = _tree_targets(mask, levels, n_buckets, level_args)
+    v_eff, p_eff = _metric_eff(vals, present, mparams)
+    return _metric_boards(tgt, ok & p_eff, v_eff, total)
+
+
+def _agg_hll_board(mask, hidx, hrho, *level_args, levels, n_buckets):
+    """Per-composite-bucket HLL register board: int32[total+1, HLL_M],
+    max-merged per (bucket, register). hidx/hrho are the precomputed
+    per-row register index and rank (rho == 0 marks an absent value, so
+    absent rows never raise a register). levels may be empty: the
+    top-level cardinality board with every matched row in lane 0."""
+    import jax.numpy as jnp
+    tgt, ok, total = _tree_targets(mask, levels, n_buckets, level_args)
+    rho = jnp.where(ok, hrho, 0)
+    board = jnp.zeros((total + 1, HLL_M), dtype=jnp.int32)
+    return board.at[tgt, hidx].max(rho)
+
+
 # ----------------------------------------------------------------- mesh ----
 
-def _mesh_reduce(local_fn, mesh, row_args, repl_args, n_boards):
+def _mesh_reduce(local_fn, mesh, row_args, repl_args, n_boards,
+                 merges=None):
     """Run a board-producing local reduce per shard over row-sharded
     columns and merge boards with psum/pmin/pmax (exact under the
     integral-sum contract). Boards are (cnt int64[, sum f64, min f64,
-    max f64]): index 0 and 1 merge by sum, 2 by min, 3 by max."""
+    max f64]): index 0 and 1 merge by sum, 2 by min, 3 by max — unless
+    `merges` names a per-board rule ('sum' | 'min' | 'max') explicitly
+    (the HLL register board merges by max)."""
     import jax
     import jax.numpy as jnp
 
@@ -233,9 +362,11 @@ def _mesh_reduce(local_fn, mesh, row_args, repl_args, n_boards):
             boards = (boards,)
         merged = []
         for i, b in enumerate(boards):
-            if i == 2:
+            rule = merges[i] if merges is not None else (
+                "min" if i == 2 else "max" if i == 3 else "sum")
+            if rule == "min":
                 merged.append(jax.lax.pmin(b, axis))
-            elif i == 3:
+            elif rule == "max":
                 merged.append(jax.lax.pmax(b, axis))
             else:
                 merged.append(jax.lax.psum(b, axis))
@@ -295,6 +426,92 @@ def _agg_mesh_range_metric(keys, kpresent, mask, vals, present, bounds,
         (bounds, rparams, mparams), 4)
 
 
+def _agg_mesh_cal_counts(keys, kpresent, mask, cbounds, cparams,
+                         n_buckets: int, mesh=None):
+    return _mesh_reduce(
+        lambda k, kp, m, cb, cp: _agg_cal_counts(k, kp, m, cb, cp,
+                                                 n_buckets),
+        mesh, (keys, kpresent, mask), (cbounds, cparams), 1)
+
+
+def _agg_mesh_cal_metric(keys, kpresent, mask, vals, present, cbounds,
+                         cparams, mparams, n_buckets: int, mesh=None):
+    return _mesh_reduce(
+        lambda k, kp, m, v, p, cb, cp, mp: _agg_cal_metric(
+            k, kp, m, cb, cp, mp, v, p, n_buckets),
+        mesh, (keys, kpresent, mask, vals, present),
+        (cbounds, cparams, mparams), 4)
+
+
+def _split_level_args(levels, level_args):
+    """Split the flat per-level args into (row-shaped, replicated) tuples
+    for shard_map in_specs, plus a rebuild() that restores the interleaved
+    layout `_tree_targets` expects inside the mesh body."""
+    rows: list = []
+    repls: list = []
+    i = 0
+    for kind in levels:
+        nr, np_ = _LEVEL_ROW[kind], _LEVEL_REPL[kind]
+        rows.extend(level_args[i:i + nr])
+        repls.extend(level_args[i + nr:i + nr + np_])
+        i += nr + np_
+
+    def rebuild(row_args, repl_args):
+        out: list = []
+        ri = pi = 0
+        for kind in levels:
+            nr, np_ = _LEVEL_ROW[kind], _LEVEL_REPL[kind]
+            out.extend(row_args[ri:ri + nr])
+            ri += nr
+            out.extend(repl_args[pi:pi + np_])
+            pi += np_
+        return tuple(out)
+
+    return tuple(rows), tuple(repls), rebuild
+
+
+def _agg_mesh_tree_counts(mask, *level_args, levels, n_buckets, mesh=None):
+    rows, repls, rebuild = _split_level_args(levels, level_args)
+    nr = len(rows)
+
+    def local(m, *args):
+        la = rebuild(args[:nr], args[nr:])
+        return _agg_tree_counts(m, *la, levels=levels, n_buckets=n_buckets)
+
+    return _mesh_reduce(local, mesh, (mask,) + rows, repls, 1)
+
+
+def _agg_mesh_tree_metric(mask, mparams, vals, present, *level_args,
+                          levels, n_buckets, mesh=None):
+    rows, repls, rebuild = _split_level_args(levels, level_args)
+    nr = len(rows)
+
+    def local(m, v, p, *args):
+        la = rebuild(args[:nr], args[nr:-1])
+        return _agg_tree_metric(m, args[-1], v, p, *la, levels=levels,
+                                n_buckets=n_buckets)
+
+    return _mesh_reduce(local, mesh, (mask, vals, present) + rows,
+                        repls + (mparams,), 4)
+
+
+def _agg_mesh_hll_board(mask, hidx, hrho, *level_args, levels, n_buckets,
+                        mesh=None):
+    """HLL register boards merge per (bucket, register) by MAX across the
+    shard axis — the only board family whose cross-shard merge is not the
+    positional default."""
+    rows, repls, rebuild = _split_level_args(levels, level_args)
+    nr = len(rows)
+
+    def local(m, hi, hr, *args):
+        la = rebuild(args[:nr], args[nr:])
+        return _agg_hll_board(m, hi, hr, *la, levels=levels,
+                              n_buckets=n_buckets)
+
+    return _mesh_reduce(local, mesh, (mask, hidx, hrho) + rows, repls, 1,
+                        merges=("max",))
+
+
 # ------------------------------------------------------------ grid checks --
 
 def _row_bucket_ok(r: int) -> bool:
@@ -320,6 +537,38 @@ def _grid_range(statics, sigs) -> bool:
             b = s[0][0]
             break
     return _row_bucket_ok(int(r)) and (b is None or in_b_grid(int(b)))
+
+
+def _grid_cal(statics, sigs) -> bool:
+    r = sigs[0][0][0]
+    return _row_bucket_ok(int(r)) and in_b_grid(int(statics["n_buckets"]))
+
+
+def _tree_lanes(statics):
+    """(ladder_ok, total lanes) for a tuple-valued n_buckets static."""
+    total = 1
+    for k in statics["n_buckets"]:
+        if not in_b_grid(int(k)):
+            return False, 0
+        total *= int(k)
+    return True, total
+
+
+def _grid_tree(statics, sigs) -> bool:
+    r = sigs[0][0][0]
+    nb = tuple(statics["n_buckets"])
+    ok, total = _tree_lanes(statics)
+    return (_row_bucket_ok(int(r)) and ok
+            and 1 <= len(nb) <= TREE_MAX_DEPTH + 1
+            and total <= TREE_MAX_LANES)
+
+
+def _grid_hll(statics, sigs) -> bool:
+    r = sigs[0][0][0]
+    nb = tuple(statics["n_buckets"])
+    ok, total = _tree_lanes(statics)
+    return (_row_bucket_ok(int(r)) and ok and len(nb) <= TREE_MAX_DEPTH
+            and total <= HLL_MAX_LANES)
 
 
 def _register():
@@ -352,6 +601,34 @@ def _register():
         static_argnames=("mesh",), grid_check=_grid_range, x64=True)
     reg("aggs.mesh_range_metric", _agg_mesh_range_metric,
         static_argnames=("mesh",), grid_check=_grid_range, x64=True)
+    reg("aggs.cal_counts", _agg_cal_counts,
+        static_argnames=("n_buckets",), grid_check=_grid_cal, x64=True)
+    reg("aggs.cal_metric", _agg_cal_metric,
+        static_argnames=("n_buckets",), grid_check=_grid_cal, x64=True)
+    reg("aggs.tree_counts", _agg_tree_counts,
+        static_argnames=("levels", "n_buckets"), grid_check=_grid_tree,
+        x64=True)
+    reg("aggs.tree_metric", _agg_tree_metric,
+        static_argnames=("levels", "n_buckets"), grid_check=_grid_tree,
+        x64=True)
+    reg("aggs.hll_board", _agg_hll_board,
+        static_argnames=("levels", "n_buckets"), grid_check=_grid_hll,
+        x64=True)
+    reg("aggs.mesh_cal_counts", _agg_mesh_cal_counts,
+        static_argnames=("n_buckets", "mesh"), grid_check=_grid_cal,
+        x64=True)
+    reg("aggs.mesh_cal_metric", _agg_mesh_cal_metric,
+        static_argnames=("n_buckets", "mesh"), grid_check=_grid_cal,
+        x64=True)
+    reg("aggs.mesh_tree_counts", _agg_mesh_tree_counts,
+        static_argnames=("levels", "n_buckets", "mesh"),
+        grid_check=_grid_tree, x64=True)
+    reg("aggs.mesh_tree_metric", _agg_mesh_tree_metric,
+        static_argnames=("levels", "n_buckets", "mesh"),
+        grid_check=_grid_tree, x64=True)
+    reg("aggs.mesh_hll_board", _agg_mesh_hll_board,
+        static_argnames=("levels", "n_buckets", "mesh"),
+        grid_check=_grid_hll, x64=True)
 
 
 _register()
@@ -379,7 +656,9 @@ class AggColumn:
     __slots__ = ("field", "version", "n_rows", "r_pad", "vals", "present",
                  "numeric", "integral_exact", "multi_valued", "ords_built",
                  "ords", "ord_keys", "vmin", "vmax",
-                 "_device", "_device_mesh", "_device_mesh_key")
+                 "hll_built", "hll_idx", "hll_rho",
+                 "_device", "_device_mesh", "_device_mesh_key",
+                 "_device_hll", "_device_hll_mesh", "_device_hll_mesh_key")
 
     def __init__(self, field: str):
         self.field = field
@@ -396,9 +675,15 @@ class AggColumn:
         self.ord_keys: List[Any] = []             # ord -> raw key value
         self.vmin = None
         self.vmax = None
+        self.hll_built = False
+        self.hll_idx: Optional[np.ndarray] = None  # int32[r_pad] register
+        self.hll_rho: Optional[np.ndarray] = None  # int32[r_pad], 0 absent
         self._device = None
         self._device_mesh = None
         self._device_mesh_key = None
+        self._device_hll = None
+        self._device_hll_mesh = None
+        self._device_hll_mesh_key = None
 
     # ------------------------------------------------------------- device
     def device_arrays(self):
@@ -435,6 +720,32 @@ class AggColumn:
         self._device_mesh = (vals, present, ords)
         self._device_mesh_key = mesh
         return self._device_mesh
+
+    def hll_device_arrays(self):
+        """(hidx int32, hrho int32) resident jax arrays — the per-row HLL
+        register index and rank columns."""
+        if self._device_hll is not None:
+            return self._device_hll
+        import jax.numpy as jnp
+        self._device_hll = (jnp.asarray(self.hll_idx),
+                            jnp.asarray(self.hll_rho))
+        return self._device_hll
+
+    def hll_device_arrays_mesh(self, mesh):
+        if (self._device_hll_mesh is not None
+                and self._device_hll_mesh_key is mesh):
+            return self._device_hll_mesh
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elasticsearch_tpu.parallel import mesh as mesh_lib
+        row = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
+        self._device_hll_mesh = (
+            jax.device_put(jnp.asarray(self.hll_idx), row),
+            jax.device_put(jnp.asarray(self.hll_rho), row))
+        self._device_hll_mesh_key = mesh
+        return self._device_hll_mesh
 
 
 class StoreSnapshot:
@@ -502,7 +813,8 @@ class AggFieldStore:
             return sorted(self._columns)
 
     def column(self, reader, field: str, want_ords: bool = False,
-               snap: Optional[StoreSnapshot] = None) -> AggColumn:
+               snap: Optional[StoreSnapshot] = None,
+               want_hll: bool = False) -> AggColumn:
         """The field's column for this reader snapshot, building or
         delta-rebuilding as needed. The returned column is consistent
         with `snap` (same version/row bucket) by construction."""
@@ -511,21 +823,27 @@ class AggFieldStore:
         with self._lock:
             col = self._columns.get(field)
             if col is not None and col.version == snap.version \
-                    and (not want_ords or col.ords_built):
+                    and (not want_ords or col.ords_built) \
+                    and (not want_hll or col.hll_built):
                 return col
-            col = self._build(reader, snap, field, want_ords
-                              or (col is not None and col.ords_built))
+            col = self._build(reader, snap, field,
+                              want_ords
+                              or (col is not None and col.ords_built),
+                              want_hll
+                              or (col is not None and col.hll_built))
             self._columns[field] = col
             self.stats["rebuilds"] += 1
             self.stats["columns"] = len(self._columns)
             self.stats["bytes"] = sum(
                 c.vals.nbytes + c.present.nbytes
                 + (c.ords.nbytes if c.ords is not None else 0)
+                + (c.hll_idx.nbytes + c.hll_rho.nbytes
+                   if c.hll_idx is not None else 0)
                 for c in self._columns.values())
             return col
 
     def _build(self, reader, snap: StoreSnapshot, field: str,
-               want_ords: bool) -> AggColumn:
+               want_ords: bool, want_hll: bool = False) -> AggColumn:
         from elasticsearch_tpu import columnar
         col = AggColumn(field)
         col.version = snap.version
@@ -537,6 +855,7 @@ class AggFieldStore:
         off = 0
         multi = False
         n_cached = n_extracted = 0
+        want_objs = want_ords or want_hll
         for view in reader.views:
             n_live = int(view.live.sum())
             # shared block-store read: append-only refreshes find every
@@ -544,7 +863,7 @@ class AggFieldStore:
             # delta segments (one block per (segment, field, live-set),
             # shared with every consumer)
             sc, was_cached = columnar.STORE.values_block(
-                view, field, want_ords)
+                view, field, want_objs)
             if was_cached:
                 n_cached += 1
             else:
@@ -553,7 +872,7 @@ class AggFieldStore:
             present[off:off + n_live] = sc.present
             if sc.objs is not None:
                 obj_parts.append(sc.objs)
-            elif want_ords:
+            elif want_objs:
                 obj_parts.append(np.empty(n_live, dtype=object))
             multi = multi or sc.multi_valued
             off += n_live
@@ -599,6 +918,29 @@ class AggFieldStore:
                     ords[i] = o
             col.ords = ords
             col.ord_keys = keys
+        # like ords_built, hll_built marks the REQUEST satisfied even for
+        # multi-valued columns (arrays stay None; the plan falls back on
+        # multi_valued before touching them) so the cache check holds
+        col.hll_built = bool(want_hll)
+        if want_hll and not multi:
+            # per-row HLL register columns over the same hash the host's
+            # partial walker uses — so device register boards pack into
+            # `$p` states any shard's host partial merges with exactly
+            from elasticsearch_tpu.search.agg_partials import _hll_hash
+            from elasticsearch_tpu.search.aggregations import _hashable
+            hidx = np.zeros(snap.r_pad, dtype=np.int32)
+            hrho = np.zeros(snap.r_pad, dtype=np.int32)
+            if obj_parts:
+                objs = np.concatenate(obj_parts)
+                for i in range(off):
+                    v = objs[i]
+                    if v is None:
+                        continue
+                    h = _hll_hash(_hashable(v))
+                    hidx[i] = h & (HLL_M - 1)
+                    hrho[i] = (64 - HLL_P) - (h >> HLL_P).bit_length() + 1
+            col.hll_idx = hidx
+            col.hll_rho = hrho
         return col
 
     # ------------------------------------------------------------- warmup
@@ -613,23 +955,37 @@ class AggFieldStore:
         i32 = jax.ShapeDtypeStruct((r,), np.dtype(np.int32))
         hp = jax.ShapeDtypeStruct((6,), np.dtype(np.float64))
         mp = jax.ShapeDtypeStruct((2,), np.dtype(np.float64))
+        op = jax.ShapeDtypeStruct((1,), np.dtype(np.float64))
         entries = []
         rungs = set(WARMUP_AGG_BUCKETS)
         if col.ords is not None and col.ord_keys:
             b_ord = bucket_count(len(col.ord_keys))
             if b_ord is not None:
-                rungs.add(b_ord)
+                # clamp: one pathological high-cardinality field must not
+                # AOT-compile the giant rungs for every column build
+                rungs.add(min(b_ord, WARMUP_MAX_ORD_B))
         for b in sorted(rungs):
             if col.ords is not None:
                 entries.append(("aggs.ord_counts", (i32, b1),
                                 {"n_buckets": b}))
                 entries.append(("aggs.ord_metric", (i32, b1, mp, f64, b1),
                                 {"n_buckets": b}))
+                entries.append(("aggs.tree_counts", (b1, i32, op),
+                                {"levels": ("ord",), "n_buckets": (b,)}))
+                entries.append(("aggs.tree_metric",
+                                (b1, mp, f64, b1, i32, op),
+                                {"levels": ("ord",), "n_buckets": (b,)}))
             if col.numeric:
                 entries.append(("aggs.hist_counts", (f64, b1, b1, hp),
                                 {"n_buckets": b}))
                 entries.append(("aggs.hist_metric",
                                 (f64, b1, b1, hp, mp, f64, b1),
+                                {"n_buckets": b}))
+                cb = jax.ShapeDtypeStruct((b,), np.dtype(np.float64))
+                entries.append(("aggs.cal_counts", (f64, b1, b1, cb, mp),
+                                {"n_buckets": b}))
+                entries.append(("aggs.cal_metric",
+                                (f64, b1, b1, cb, mp, mp, f64, b1),
                                 {"n_buckets": b}))
         if col.numeric:
             bounds = jax.ShapeDtypeStruct((AGG_B_LADDER[0], 2),
@@ -638,6 +994,9 @@ class AggFieldStore:
                             {}))
             entries.append(("aggs.range_metric",
                             (f64, b1, b1, bounds, mp, mp, f64, b1), {}))
+        if col.hll_built and col.hll_idx is not None:
+            entries.append(("aggs.hll_board", (b1, i32, i32),
+                            {"levels": (), "n_buckets": ()}))
         return entries
 
     def schedule_warmup(self, col: AggColumn) -> None:

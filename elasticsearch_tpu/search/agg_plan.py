@@ -73,6 +73,17 @@ _DATE_HISTO_ALLOWED_KEYS = {"field", "interval", "fixed_interval",
                             "calendar_interval", "offset", "min_doc_count",
                             "format", "time_zone"}
 _RANGE_ALLOWED_KEYS = {"field", "ranges", "keyed"}
+_CARD_ALLOWED_KEYS = {"field", "precision_threshold", "missing"}
+
+# composite sub-agg trees: bucket-in-bucket nesting compiles to ONE flat
+# board per (depth, metric) whose lane is parent_id * k_child + child_id
+MAX_TREE_DEPTH = aggs_ops.TREE_MAX_DEPTH
+
+# nominal calendar-unit lengths in millis — probe steps for the boundary
+# walk, NOT bucket widths (DST/leap realities come from _calendar_floor)
+_CAL_NOMINAL = {"T": 60_000, "H": 3_600_000, "D": 86_400_000,
+                "W": 604_800_000, "M": 28 * 86_400_000,
+                "Q": 90 * 86_400_000, "Y": 365 * 86_400_000}
 
 
 
@@ -89,11 +100,14 @@ def _mesh_call(name, *args, mesh, **kw):
 
 
 class _Fallback(Exception):
-    """Bind-time device rejection: run this node on the host instead."""
+    """Bind-time device rejection: run this node on the host instead.
+    `observed` optionally carries the measured quantity that busted the
+    grid (e.g. the ordinal cardinality) so ladder growth is data-driven."""
 
-    def __init__(self, reason: str):
+    def __init__(self, reason: str, observed: Optional[int] = None):
         super().__init__(reason)
         self.reason = reason
+        self.observed = observed
 
 
 class _SubMetric:
@@ -106,19 +120,25 @@ class _SubMetric:
 
 
 class _Node:
-    """One top-level agg's compiled classification. mode: 'host' |
-    'metric' | 'terms' | 'histogram' | 'date_histogram' | 'range'."""
+    """One agg's compiled classification. mode: 'host' | 'metric' |
+    'cardinality' | 'terms' | 'histogram' | 'date_histogram' | 'range'.
+    Bucket nodes may carry `children` (nested bucket _Nodes — the
+    composite-id tree) and `cards` (cardinality leaves) next to the
+    metric `subs`."""
 
-    __slots__ = ("name", "mode", "kind", "field", "subs", "host_reason")
+    __slots__ = ("name", "mode", "kind", "field", "subs", "host_reason",
+                 "children", "cards")
 
     def __init__(self, name, mode, kind=None, field=None, subs=(),
-                 host_reason=None):
+                 host_reason=None, children=(), cards=()):
         self.name = name
         self.mode = mode
         self.kind = kind
         self.field = field
         self.subs = list(subs)
         self.host_reason = host_reason
+        self.children = list(children)
+        self.cards = list(cards)
 
 
 class AggPlan:
@@ -170,7 +190,7 @@ def plan_cache_key(aggs_spec: dict) -> str:
                          for k, v in r.items()} if isinstance(r, dict)
                         else r
                         for r in b["ranges"]]
-            elif kind in SUPPORTED_METRICS:
+            elif kind in SUPPORTED_METRICS or kind == "cardinality":
                 if "missing" in b:
                     b["missing"] = "__v__"
             out[kind] = b
@@ -208,24 +228,91 @@ def _classify_metric(kind: str, body, mapper_service) -> Optional[str]:
     return None
 
 
-def _classify_subs(sub_spec: dict, mapper_service) -> Tuple[list, str]:
+def _classify_cardinality(body, mapper_service) -> Optional[str]:
+    """None = device-eligible cardinality; otherwise the fallback
+    reason. Keyword fields are in: the HLL register columns hash the raw
+    doc values, not the f64 view."""
+    if not isinstance(body, dict):
+        return "malformed"
+    if not set(body) <= _CARD_ALLOWED_KEYS:
+        return "unsupported_param"
+    if body.get("script") is not None:
+        return "script"
+    field = body.get("field")
+    if not isinstance(field, str):
+        return "no_field"
+    mapper = mapper_service.get(field)
+    tname = getattr(mapper, "type_name", None)
+    if tname is None:
+        return "unmapped_field"
+    if tname not in _NUMERIC_TNAMES + ("keyword",):
+        return "unsupported_field_type"
+    return None
+
+
+def _classify_subs(sub_spec: dict, mapper_service, depth: int = 1,
+                   allow_buckets: bool = True
+                   ) -> Tuple[list, list, list, str]:
+    """Classify one bucket agg's sub-agg spec → (metric leaves,
+    cardinality leaves, nested bucket children, reason). Bucket children
+    recurse up to MAX_TREE_DEPTH levels (the composite-id tree); range
+    parents pass allow_buckets=False (ranges overlap, so their members
+    don't partition into composite ids)."""
     subs: List[_SubMetric] = []
+    cards: List[_SubMetric] = []
+    children: List[_Node] = []
     for sname, sspec in (sub_spec or {}).items():
         if not isinstance(sspec, dict):
-            return [], "malformed_sub"
+            return [], [], [], "malformed_sub"
         skinds = [k for k in sspec
                   if k not in ("aggs", "aggregations", "meta")]
-        if len(skinds) != 1 or skinds[0] not in SUPPORTED_METRICS:
-            return [], "unsupported_sub_agg"
-        if sspec.get("aggs") or sspec.get("aggregations"):
-            return [], "sub_sub_aggs"
-        reason = _classify_metric(skinds[0], sspec[skinds[0]],
-                                  mapper_service)
-        if reason is not None:
-            return [], f"sub_{reason}"
-        subs.append(_SubMetric(sname, skinds[0],
-                               sspec[skinds[0]]["field"]))
-    return subs, ""
+        if len(skinds) != 1:
+            return [], [], [], "unsupported_sub_agg"
+        skind = skinds[0]
+        inner = sspec.get("aggs") or sspec.get("aggregations") or {}
+        if skind in SUPPORTED_METRICS:
+            if inner:
+                return [], [], [], "sub_sub_aggs"
+            reason = _classify_metric(skind, sspec[skind], mapper_service)
+            if reason is not None:
+                return [], [], [], f"sub_{reason}"
+            subs.append(_SubMetric(sname, skind, sspec[skind]["field"]))
+            continue
+        if skind == "cardinality":
+            if inner:
+                return [], [], [], "unsupported_sub_agg"
+            reason = _classify_cardinality(sspec[skind], mapper_service)
+            if reason is not None:
+                return [], [], [], f"sub_{reason}"
+            cards.append(_SubMetric(sname, skind, sspec[skind]["field"]))
+            continue
+        if skind in ("terms", "histogram", "date_histogram") \
+                and isinstance(sspec[skind], dict):
+            if not allow_buckets:
+                return [], [], [], "unsupported_sub_agg"
+            if depth >= MAX_TREE_DEPTH:
+                return [], [], [], "tree_too_deep"
+            body = sspec[skind]
+            reason = _classify_bucket(skind, body, mapper_service)
+            if reason:
+                return [], [], [], f"sub_{reason}"
+            if skind == "terms" and isinstance(body.get("order"), dict) \
+                    and next(iter(body["order"])) == "_count":
+                # explicit _count order below the root would need per-row
+                # first-occurrence tie-breaks inside every parent bucket —
+                # host business (the DEFAULT sort's count tie-break is by
+                # _key, which the device reproduces fine)
+                return [], [], [], "order_count_in_subtree"
+            csubs, ccards, cchildren, creason = _classify_subs(
+                inner, mapper_service, depth + 1)
+            if creason:
+                return [], [], [], creason
+            children.append(_Node(sname, skind, kind=skind,
+                                  field=body.get("field"), subs=csubs,
+                                  cards=ccards, children=cchildren))
+            continue
+        return [], [], [], "unsupported_sub_agg"
+    return subs, cards, children, ""
 
 
 def compile_plan(aggs_spec: dict, mapper_service) -> AggPlan:
@@ -255,15 +342,31 @@ def compile_plan(aggs_spec: dict, mapper_service) -> AggPlan:
                 nodes[name] = _Node(name, "host", kind=kind,
                                     host_reason=reason)
             continue
+        if kind == "cardinality" and not sub_spec:
+            reason = _classify_cardinality(body, mapper_service)
+            if reason is None:
+                nodes[name] = _Node(name, "cardinality", kind=kind,
+                                    field=body["field"])
+            else:
+                nodes[name] = _Node(name, "host", kind=kind,
+                                    host_reason=reason)
+            continue
         if kind in ("terms", "histogram", "date_histogram", "range") \
                 and isinstance(body, dict):
             reason = _classify_bucket(kind, body, mapper_service)
-            subs, sub_reason = (([], "") if reason else
-                                _classify_subs(sub_spec, mapper_service))
-            reason = reason or sub_reason
+            subs, cards, children = [], [], []
+            if not reason:
+                subs, cards, children, reason = _classify_subs(
+                    sub_spec, mapper_service,
+                    allow_buckets=kind != "range")
+            if not reason and kind == "range" and cards:
+                # range members overlap — no composite-id partition for
+                # the per-bucket HLL boards to scatter into
+                reason = "unsupported_sub_agg"
             if not reason:
                 nodes[name] = _Node(name, kind, kind=kind,
-                                    field=body.get("field"), subs=subs)
+                                    field=body.get("field"), subs=subs,
+                                    cards=cards, children=children)
                 continue
             nodes[name] = _Node(name, "host", kind=kind,
                                 host_reason=reason)
@@ -319,6 +422,93 @@ def _classify_bucket(kind: str, body: dict, mapper_service) -> str:
 
 
 # ---------------------------------------------------------------------------
+# measured cost router
+# ---------------------------------------------------------------------------
+
+
+class CostRouter:
+    """Per-kernel-family device-vs-host cost model calibrated from live
+    timings: device legs record end-to-end (dispatch + assembly) nanos
+    per family, host walkers record nanos per matched doc. A node routes
+    to the device only when the device estimate beats the host estimate
+    with margin — so tiny corpora on CPU floors take the host walker
+    instead of paying the fixed dispatch cost — and every REPROBE-th
+    otherwise-host decision probes the device to keep the model live.
+
+    Priors (before any measurement) deliberately favor the device: the
+    router exists to catch the measured-slow case, not to predict it."""
+
+    EWMA = 0.25
+    MARGIN = 1.25
+    REPROBE = 32
+    DEV_PRIOR_BASE = 250_000.0      # ~fixed dispatch+assembly floor (ns)
+    DEV_PRIOR_PER_ROW = 0.5         # ns per padded row
+    HOST_PRIOR_BASE = 30_000.0
+    HOST_PRIOR_PER_DOC = 400.0      # ns per matched doc (python walker)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dev: Dict[str, float] = {}       # family -> ewma ns
+        self._host: Dict[str, float] = {}      # family -> ewma ns/doc
+        self._miss: Dict[str, int] = {}        # family -> host streak
+
+    def est_device(self, fam: str, r_pad: int) -> float:
+        with self._lock:
+            d = self._dev.get(fam)
+        return d if d is not None else (
+            self.DEV_PRIOR_BASE + self.DEV_PRIOR_PER_ROW * r_pad)
+
+    def est_host(self, fam: str, n_docs: int) -> float:
+        with self._lock:
+            rate = self._host.get(fam)
+        if rate is None:
+            rate = self.HOST_PRIOR_PER_DOC
+        return self.HOST_PRIOR_BASE + rate * max(n_docs, 1)
+
+    def decide(self, fam: str, n_docs: int, r_pad: int) -> str:
+        """'device' | 'probe' | 'host'. A probe runs on the device and
+        feeds the model, keeping a stale host-favored estimate honest."""
+        if self.est_host(fam, n_docs) * self.MARGIN \
+                >= self.est_device(fam, r_pad):
+            with self._lock:
+                self._miss.pop(fam, None)
+            return "device"
+        with self._lock:
+            streak = self._miss.get(fam, 0) + 1
+            if streak >= self.REPROBE:
+                self._miss[fam] = 0
+                return "probe"
+            self._miss[fam] = streak
+        return "host"
+
+    def _ewma(self, table: Dict[str, float], fam: str, x: float) -> None:
+        with self._lock:
+            prev = table.get(fam)
+            table[fam] = x if prev is None else (
+                prev + self.EWMA * (x - prev))
+
+    def observe_device(self, fam: str, nanos: int) -> None:
+        self._ewma(self._dev, fam, float(nanos))
+
+    def observe_host(self, fam: str, nanos: int, n_docs: int) -> None:
+        self._ewma(self._host, fam, float(nanos) / max(n_docs, 1))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"device_ns": dict(self._dev),
+                    "host_ns_per_doc": dict(self._host)}
+
+
+def _family(node: _Node) -> str:
+    """Cost-model family: the top-level mode, with '_tree' marking the
+    composite multi-board shape (very different cost profile)."""
+    fam = node.mode
+    if node.children or node.cards:
+        fam += "_tree"
+    return fam
+
+
+# ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
 
@@ -331,17 +521,21 @@ class AggEngine:
     (the caller then runs the unchanged host path)."""
 
     def __init__(self, mapper_service, plan_cache_entries: int = 128,
-                 warmup: Optional[bool] = None):
+                 warmup: Optional[bool] = None,
+                 cost_router: bool = False):
         from elasticsearch_tpu.search.caches import LruCache
         self.mapper_service = mapper_service
         self.store = aggs_ops.AggFieldStore(warmup=warmup)
         self.plan_cache = LruCache(max_entries=plan_cache_entries)
+        self.cost_router = CostRouter() if cost_router else None
         self._lock = threading.Lock()
+        self._cal_cache = LruCache(max_entries=64)
         self.stats = {
             "searches": 0, "device_nodes": 0, "host_nodes": 0,
             "plan_cache_hits": 0, "plan_cache_misses": 0,
-            "device_nanos": 0, "assemble_nanos": 0,
-            "mesh_dispatches": 0, "fallback_reasons": {},
+            "device_nanos": 0, "assemble_nanos": 0, "host_nanos": 0,
+            "mesh_dispatches": 0, "router_host_routed": 0,
+            "router_probes": 0, "fallback_reasons": {},
         }
 
     # ---------------------------------------------------------------- plan
@@ -362,10 +556,22 @@ class AggEngine:
         with self._lock:
             self.stats[key] += n
 
-    def _reason(self, reason: str) -> None:
+    def _reason(self, reason: str, docs: int = 0,
+                observed: Optional[int] = None) -> None:
+        """fallback_reasons entries are {count, docs[, observed_max]}:
+        matched-doc totals rank reasons by WORK routed host, not request
+        volume, and observed_max (e.g. the ordinal cardinality that
+        busted the ladder) makes grid growth data-driven."""
         with self._lock:
             r = self.stats["fallback_reasons"]
-            r[reason] = r.get(reason, 0) + 1
+            ent = r.get(reason)
+            if ent is None:
+                ent = r[reason] = {"count": 0, "docs": 0}
+            ent["count"] += 1
+            ent["docs"] += int(docs)
+            if observed is not None:
+                ent["observed_max"] = max(int(observed),
+                                          ent.get("observed_max", 0))
 
     # ------------------------------------------------------------- compute
     def compute(self, ctx, rows: np.ndarray, aggs_spec: dict,
@@ -384,6 +590,7 @@ class AggEngine:
         prof_nodes: List[dict] = []
         device_nanos = 0
         assemble_nanos = 0
+        host_nanos = 0
         for name, spec in aggs_spec.items():
             if not isinstance(spec, dict):
                 raise ParsingError(f"aggregation [{name}] must be an object")
@@ -400,43 +607,65 @@ class AggEngine:
             node = plan.nodes.get(name)
             res = None
             engine = "host"
+            fam = None
             reason = node.host_reason if node is not None else None
             if node is not None and node.mode != "host":
-                try:
-                    t0 = time.perf_counter_ns()
-                    boards, mesh_used = self._run_device_node(
-                        ctx, node, spec, rows, mask_box)
-                    t1 = time.perf_counter_ns()
-                    res = self._assemble_node(
-                        ctx, node, spec, rows, boards, partial)
-                    t2 = time.perf_counter_ns()
-                    device_nanos += t1 - t0
-                    assemble_nanos += t2 - t1
-                    engine = "device_mesh" if mesh_used else "device"
-                    self._count("device_nodes")
-                except _Fallback as fb:
-                    reason = fb.reason
-                    self._reason(fb.reason)
-                except SearchEngineError:
-                    raise  # parity errors (max_buckets, bad params)
-                except Exception as exc:  # pragma: no cover - safety net
-                    reason = "device_error"
-                    self._reason("device_error")
-                    logger.warning(
-                        "device agg [%s] failed; serving from host: %s",
-                        name, exc)
+                fam = _family(node)
+                route = "device"
+                if self.cost_router is not None:
+                    route = self.cost_router.decide(
+                        fam, len(rows), mask_box["snap"].r_pad)
+                if route == "host":
+                    reason = "routed_host_cheaper"
+                    self._reason(reason, docs=len(rows))
+                    self._count("router_host_routed")
+                else:
+                    if route == "probe":
+                        self._count("router_probes")
+                    try:
+                        t0 = time.perf_counter_ns()
+                        boards, mesh_used = self._run_device_node(
+                            ctx, node, spec, rows, mask_box, partial)
+                        t1 = time.perf_counter_ns()
+                        res = self._assemble_node(
+                            ctx, node, spec, rows, boards, partial)
+                        t2 = time.perf_counter_ns()
+                        device_nanos += t1 - t0
+                        assemble_nanos += t2 - t1
+                        engine = "device_mesh" if mesh_used else "device"
+                        self._count("device_nodes")
+                        if self.cost_router is not None:
+                            self.cost_router.observe_device(fam, t2 - t0)
+                    except _Fallback as fb:
+                        reason = fb.reason
+                        self._reason(fb.reason, docs=len(rows),
+                                     observed=fb.observed)
+                    except SearchEngineError:
+                        raise  # parity errors (max_buckets, bad params)
+                    except Exception as exc:  # pragma: no cover - safety
+                        reason = "device_error"
+                        self._reason("device_error", docs=len(rows))
+                        logger.warning(
+                            "device agg [%s] failed; serving from host: %s",
+                            name, exc)
             if res is None:
                 if node is not None and node.mode == "host" \
                         and node.host_reason:
-                    self._reason(node.host_reason)
+                    self._reason(node.host_reason, docs=len(rows))
                 sub = {name: spec}
+                th0 = time.perf_counter_ns()
                 if partial:
                     from elasticsearch_tpu.search.agg_partials import (
                         compute_partial_aggs)
                     res = compute_partial_aggs(ctx, rows, sub).get(name)
                 else:
                     res = A.compute_aggs(ctx, rows, sub).get(name)
+                th1 = time.perf_counter_ns()
+                host_nanos += th1 - th0
                 self._count("host_nodes")
+                if self.cost_router is not None and fam is not None:
+                    self.cost_router.observe_host(fam, th1 - th0,
+                                                  len(rows))
             elif not partial and isinstance(spec.get("meta"), dict) \
                     and isinstance(res, dict):
                 res["meta"] = spec["meta"]
@@ -454,6 +683,7 @@ class AggEngine:
         with self._lock:
             self.stats["device_nanos"] += device_nanos
             self.stats["assemble_nanos"] += assemble_nanos
+            self.stats["host_nanos"] += host_nanos
         profile = {"nodes": prof_nodes, "device_nanos": device_nanos,
                    "assemble_nanos": assemble_nanos}
         if self.store.columnar_refresh:
@@ -522,10 +752,14 @@ class AggEngine:
         with _x64_scope(True):
             return [jax.device_put(jnp.asarray(a), row) for a in arrays]
 
-    def _run_device_node(self, ctx, node, spec, rows, mask_box):
+    def _run_device_node(self, ctx, node, spec, rows, mask_box,
+                         partial=False):
         store = self.store
         reader = ctx.reader
         snap = mask_box["snap"]
+        if node.mode == "cardinality" or node.children or node.cards:
+            return self._run_tree_node(ctx, node, spec, rows, mask_box,
+                                       partial)
         body = spec[node.kind]
         mask = self._mask_for(rows, mask_box)
         mesh = self._mesh_for(mask_box)
@@ -539,7 +773,8 @@ class AggEngine:
                 raise _Fallback("multi_valued_field")
             b = aggs_ops.bucket_count(max(len(col.ord_keys), 1))
             if b is None:
-                raise _Fallback("cardinality_off_grid")
+                raise _Fallback("cardinality_off_grid",
+                                observed=len(col.ord_keys))
             mcols = self._metric_cols(ctx, node, snap)
             if mesh is not None:
                 vals_d, pres_d, ords_d = col.device_arrays_mesh(mesh)
@@ -584,32 +819,62 @@ class AggEngine:
                              for n in mcols},
                     col=col)
                 return boards, False
+            cal_args = meta.get("cal_args")
             if mesh is not None:
                 keys_d, kp_d, _ = col.device_arrays_mesh(mesh)
                 (mask_d,) = self._sharded(mesh, [mask])
-                counts = _mesh_call("aggs.mesh_hist_counts", keys_d,
-                                       kp_d, mask_d, hparams,
-                                       n_buckets=b, mesh=mesh)
+                if cal_args is not None:
+                    cbounds, cparams = cal_args
+                    counts = _mesh_call("aggs.mesh_cal_counts", keys_d,
+                                        kp_d, mask_d, cbounds, cparams,
+                                        n_buckets=b, mesh=mesh)
+                else:
+                    counts = _mesh_call("aggs.mesh_hist_counts", keys_d,
+                                        kp_d, mask_d, hparams,
+                                        n_buckets=b, mesh=mesh)
                 mboards = {}
                 for mname, (m, mc) in mcols.items():
                     mv_d, mp_d, _ = mc.device_arrays_mesh(mesh)
-                    mboards[mname] = _mesh_call(
-                        "aggs.mesh_hist_metric", keys_d, kp_d, mask_d,
-                        mv_d, mp_d, hparams,
-                        self._mparams(_sub_body(spec, mname)),
-                        n_buckets=b, mesh=mesh)
+                    if cal_args is not None:
+                        cbounds, cparams = cal_args
+                        mboards[mname] = _mesh_call(
+                            "aggs.mesh_cal_metric", keys_d, kp_d, mask_d,
+                            mv_d, mp_d, cbounds, cparams,
+                            self._mparams(_sub_body(spec, mname)),
+                            n_buckets=b, mesh=mesh)
+                    else:
+                        mboards[mname] = _mesh_call(
+                            "aggs.mesh_hist_metric", keys_d, kp_d, mask_d,
+                            mv_d, mp_d, hparams,
+                            self._mparams(_sub_body(spec, mname)),
+                            n_buckets=b, mesh=mesh)
                 mesh_used = True
             else:
                 keys_d, kp_d, _ = col.device_arrays()
-                counts = dispatch.call("aggs.hist_counts", keys_d, kp_d,
-                                       mask, hparams, n_buckets=b)
+                if cal_args is not None:
+                    cbounds, cparams = cal_args
+                    counts = dispatch.call("aggs.cal_counts", keys_d,
+                                           kp_d, mask, cbounds, cparams,
+                                           n_buckets=b)
+                else:
+                    counts = dispatch.call("aggs.hist_counts", keys_d,
+                                           kp_d, mask, hparams,
+                                           n_buckets=b)
                 mboards = {}
                 for mname, (m, mc) in mcols.items():
                     mv_d, mp_d, _ = mc.device_arrays()
-                    mboards[mname] = dispatch.call(
-                        "aggs.hist_metric", keys_d, kp_d, mask, hparams,
-                        self._mparams(_sub_body(spec, mname)), mv_d,
-                        mp_d, n_buckets=b)
+                    if cal_args is not None:
+                        cbounds, cparams = cal_args
+                        mboards[mname] = dispatch.call(
+                            "aggs.cal_metric", keys_d, kp_d, mask,
+                            cbounds, cparams,
+                            self._mparams(_sub_body(spec, mname)), mv_d,
+                            mp_d, n_buckets=b)
+                    else:
+                        mboards[mname] = dispatch.call(
+                            "aggs.hist_metric", keys_d, kp_d, mask,
+                            hparams, self._mparams(_sub_body(spec, mname)),
+                            mv_d, mp_d, n_buckets=b)
             boards.update(counts=np.asarray(counts),
                           metrics=_np_boards(mboards), col=col)
 
@@ -679,17 +944,274 @@ class AggEngine:
             self._count("mesh_dispatches")
         return boards, mesh_used
 
+    # ------------------------------------------------- composite trees --
+    def _run_tree_node(self, ctx, node, spec, rows, mask_box, partial):
+        """Composite-id tree dispatch: each bucket level along a path
+        binds an in-kernel id source (ordinals / histogram floor /
+        calendar table), and every tree node gets ONE flat board per
+        (counts | metric leaf | cardinality leaf) whose lane is the
+        composite `parent_id * k_child + child_id` over its level chain.
+        Top-level `cardinality` is the zero-level degenerate case."""
+        store = self.store
+        reader = ctx.reader
+        snap = mask_box["snap"]
+        mask = self._mask_for(rows, mask_box)
+        mesh = self._mesh_for(mask_box)
+        boards: Dict[str, Any] = {"n_matched": int(len(rows)),
+                                  "mask": mask}
+        mesh_used = mesh is not None
+        n_dispatch = [0]
+        lanes_out = [0]
+        if mesh is not None:
+            (mask_io,) = self._sharded(mesh, [mask])
+        else:
+            mask_io = mask
+
+        def level_arrays(col):
+            return (col.device_arrays_mesh(mesh) if mesh is not None
+                    else col.device_arrays())
+
+        def call(name, *args, **statics):
+            n_dispatch[0] += 1
+            if mesh is not None:
+                return _mesh_call(name.replace("aggs.", "aggs.mesh_"),
+                                  *args, mesh=mesh, **statics)
+            return dispatch.call(name, *args, **statics)
+
+        def bind_level(child, body):
+            if child.kind == "terms":
+                col = store.column(reader, child.field, want_ords=True,
+                                   snap=snap)
+                if col.multi_valued:
+                    raise _Fallback("multi_valued_field")
+                n_keys = len(col.ord_keys)
+                miss = body.get("missing") is not None
+                k = aggs_ops.bucket_count(max(n_keys, 1)
+                                          + (1 if miss else 0))
+                if k is None:
+                    raise _Fallback("cardinality_off_grid",
+                                    observed=n_keys)
+                _v, _p, ords_d = level_arrays(col)
+                oparams = np.asarray([1.0 if miss else 0.0],
+                                     dtype=np.float64)
+                return {"kind": "ord", "k": k, "args": (ords_d, oparams),
+                        "col": col, "miss": miss, "meta": None,
+                        "body": body}
+            col = store.column(reader, child.field, snap=snap)
+            hparams, meta = self._hist_params(child, body, col)
+            k = meta["n_buckets"]
+            if k == 0:
+                # empty key column and no missing substitute: the whole
+                # subtree reduces to zero boards (assembly-only)
+                return {"kind": "empty", "k": 0, "args": (), "col": col,
+                        "miss": False, "meta": meta, "body": body}
+            keys_d, kp_d, _ = level_arrays(col)
+            if meta.get("cal_args") is not None:
+                cbounds, cparams = meta["cal_args"]
+                return {"kind": "cal", "k": k,
+                        "args": (keys_d, kp_d, cbounds, cparams),
+                        "col": col, "miss": False, "meta": meta,
+                        "body": body}
+            return {"kind": "hist", "k": k,
+                    "args": (keys_d, kp_d, hparams), "col": col,
+                    "miss": False, "meta": meta, "body": body}
+
+        def bind_card(body, levels, ks, flat, empty):
+            field = body.get("field")
+            total = 1
+            for kk in ks:
+                total *= kk
+            if partial:
+                # partial mode mirrors the host's HLL walker (which
+                # ignores `missing` — host parity, not an oversight)
+                col = store.column(reader, field, want_hll=True,
+                                   snap=snap)
+                if col.multi_valued:
+                    raise _Fallback("multi_valued_field")
+                if total > aggs_ops.HLL_MAX_LANES:
+                    raise _Fallback("hll_off_grid")
+                if empty:
+                    return {"partial": True, "board": None, "col": col,
+                            "body": body}
+                hh = (col.hll_device_arrays_mesh(mesh)
+                      if mesh is not None else col.hll_device_arrays())
+                board = call("aggs.hll_board", mask_io, hh[0], hh[1],
+                             *flat, levels=levels, n_buckets=ks)
+                lanes_out[0] += (total + 1) * aggs_ops.HLL_M
+                return {"partial": True, "board": np.asarray(board),
+                        "col": col, "body": body}
+            # final mode is EXACT (host counts a distinct set): the card
+            # field rides one more ord level on the counts board
+            col = store.column(reader, field, want_ords=True, snap=snap)
+            if col.multi_valued:
+                raise _Fallback("multi_valued_field")
+            n_keys = len(col.ord_keys)
+            miss = body.get("missing") is not None
+            k_card = aggs_ops.bucket_count(max(n_keys, 1)
+                                           + (1 if miss else 0))
+            if k_card is None:
+                raise _Fallback("cardinality_off_grid", observed=n_keys)
+            if total * k_card > aggs_ops.TREE_MAX_LANES:
+                raise _Fallback("tree_off_grid")
+            if empty:
+                return {"partial": False, "board": None, "k": k_card,
+                        "col": col, "miss": miss, "body": body}
+            _v, _p, ords_d = level_arrays(col)
+            oparams = np.asarray([1.0 if miss else 0.0],
+                                 dtype=np.float64)
+            board = call("aggs.tree_counts", mask_io, *flat, ords_d,
+                         oparams, levels=levels + ("ord",),
+                         n_buckets=ks + (k_card,))
+            lanes_out[0] += total * k_card + 1
+            return {"partial": False, "board": np.asarray(board),
+                    "k": k_card, "col": col, "miss": miss, "body": body}
+
+        def run_node(node_, spec_node, chain):
+            levels = tuple(lv["kind"] for lv in chain)
+            ks = tuple(lv["k"] for lv in chain)
+            empty = "empty" in levels
+            total = 1
+            for kk in ks:
+                total *= kk
+            if not empty and total > aggs_ops.TREE_MAX_LANES:
+                raise _Fallback("tree_off_grid")
+            flat = tuple(a for lv in chain for a in lv["args"])
+            tnode: Dict[str, Any] = {"node": node_, "chain": chain,
+                                     "ks": ks}
+            if empty:
+                tnode["counts"] = None
+            else:
+                tnode["counts"] = np.asarray(call(
+                    "aggs.tree_counts", mask_io, *flat, levels=levels,
+                    n_buckets=ks))
+                lanes_out[0] += total + 1
+            metrics = {}
+            for m in node_.subs:
+                mcol = store.column(reader, m.field, snap=snap)
+                self._check_metric_col(m.kind, mcol)
+                if empty:
+                    metrics[m.name] = None
+                    continue
+                mv_d, mp_d, _ = level_arrays(mcol)
+                mp = self._mparams(_sub_body(spec_node, m.name))
+                metrics[m.name] = _np_board(call(
+                    "aggs.tree_metric", mask_io, mp, mv_d, mp_d, *flat,
+                    levels=levels, n_buckets=ks))
+                lanes_out[0] += 4 * (total + 1)
+            tnode["metrics"] = metrics
+            cards = {}
+            for c in node_.cards:
+                cards[c.name] = bind_card(_sub_body(spec_node, c.name),
+                                          levels, ks, flat, empty)
+            tnode["cards"] = cards
+            children = {}
+            sub_spec = (spec_node.get("aggs")
+                        or spec_node.get("aggregations") or {})
+            for ch in node_.children:
+                ch_spec = sub_spec[ch.name]
+                lvl = bind_level(ch, ch_spec[ch.kind])
+                children[ch.name] = run_node(ch, ch_spec,
+                                             chain + [lvl])
+            tnode["children"] = children
+            return tnode
+
+        if node.mode == "cardinality":
+            troot: Dict[str, Any] = {
+                "node": node, "chain": [], "ks": (), "counts": None,
+                "metrics": {}, "children": {},
+                "cards": {node.name: bind_card(spec[node.kind], (), (),
+                                               (), False)}}
+        else:
+            lvl0 = bind_level(node, spec[node.kind])
+            troot = run_node(node, spec, [lvl0])
+        boards["tree"] = troot
+        if mesh is not None and n_dispatch[0]:
+            from elasticsearch_tpu.parallel import mesh as mesh_lib
+            from elasticsearch_tpu.parallel import policy
+            s = int(mesh.shape[mesh_lib.SHARD_AXIS])
+            policy.record_leg("aggs", 0, 0,
+                              policy.gather_bytes(s, 1, lanes_out[0]))
+            self._count("mesh_dispatches")
+        return boards, mesh_used
+
+    def _calendar_bounds(self, field, col, unit, tz_spec, offset, div):
+        """Sorted `_calendar_floor` boundary table spanning the column's
+        [vmin, vmax] for one (unit, tz): host wall-clock math runs ONCE
+        here (cached per column version), the kernel only searchsorts.
+        Walks boundary-to-boundary by probing a nominal step then
+        correcting with the true floor, so DST-shifted days and variable
+        months/years land exactly where the host walker puts them."""
+        key = (field, col.version, unit, str(tz_spec), offset, div)
+        cached = self._cal_cache.get(key)
+        if cached is not None:
+            return cached
+        tz = A._resolve_tz(tz_spec)
+        lo = math.trunc(col.vmin / div - offset)
+        hi = math.trunc(col.vmax / div - offset)
+        nominal = _CAL_NOMINAL[unit]
+        if (hi - lo) / nominal + 2 > aggs_ops.AGG_B_LADDER[-1]:
+            raise _Fallback("span_off_grid")
+        start = A._calendar_floor(int(lo), unit, tz)
+        bounds = [start]
+        cur = start
+        limit = aggs_ops.AGG_B_LADDER[-1] + 2
+        while True:
+            # probe past the current boundary, escalating if a short
+            # nominal step lands inside the same bucket (long months)
+            step = nominal
+            nxt = A._calendar_floor(int(cur + step), unit, tz)
+            while nxt <= cur:
+                step += 3_600_000
+                nxt = A._calendar_floor(int(cur + step), unit, tz)
+            # back up if the probe overshot a boundary (DST-short days)
+            back = A._calendar_floor(int(nxt - 1), unit, tz)
+            while back > cur:
+                nxt = back
+                back = A._calendar_floor(int(nxt - 1), unit, tz)
+            if nxt > hi:
+                break
+            bounds.append(nxt)
+            cur = nxt
+            if len(bounds) > limit:
+                raise _Fallback("span_off_grid")
+        entry = (tuple(bounds), tz)
+        self._cal_cache.put(key, entry)
+        return entry
+
     def _hist_params(self, node, body, col):
         date = node.mode == "date_histogram"
         if date:
             interval, calendar = A._date_interval(body)
-            if calendar:
-                raise _Fallback("calendar_interval")
             offset = A._date_offset_ms(body.get("offset"))
             mapper = self.mapper_service.get(node.field)
             div = 1e6 if getattr(mapper, "type_name", None) == "date_nanos" \
                 else 1.0
             missing = None
+            if calendar:
+                fmt = body.get("format")
+                if col.vmin is None:
+                    meta = {"interval": 0.0, "offset": offset, "base": 0.0,
+                            "date": True, "n_buckets": 0, "fmt": fmt,
+                            "tz": A._resolve_tz(body.get("time_zone")),
+                            "cal_bounds": ()}
+                    return None, meta
+                if not (math.isfinite(col.vmin)
+                        and math.isfinite(col.vmax)):
+                    raise _Fallback("non_finite_keys")
+                real, tz = self._calendar_bounds(
+                    node.field, col, calendar, body.get("time_zone"),
+                    offset, div)
+                b = aggs_ops.bucket_count(len(real))
+                if b is None:
+                    raise _Fallback("span_off_grid")
+                cbounds = np.full(b, np.inf, dtype=np.float64)
+                cbounds[: len(real)] = real
+                cparams = np.asarray([div, offset], dtype=np.float64)
+                meta = {"interval": 0.0, "offset": offset, "base": 0.0,
+                        "date": True, "n_buckets": b, "fmt": fmt,
+                        "tz": tz, "cal_bounds": real,
+                        "cal_args": (cbounds, cparams)}
+                return None, meta
         else:
             try:
                 interval = float(body["interval"])
@@ -759,6 +1281,12 @@ class AggEngine:
 
     # ----------------------------------------------------------- assembly
     def _assemble_node(self, ctx, node, spec, rows, boards, partial):
+        if "tree" in boards:
+            if node.mode == "cardinality":
+                rec = boards["tree"]["cards"][node.name]
+                return self._card_out(ctx, rec, [0], partial, node.name)
+            return self._assemble_tree(ctx, boards["tree"], spec, [0],
+                                       partial, boards)
         body = spec[node.kind]
         sub_bodies = {m.name: _sub_body(spec, m.name) for m in node.subs}
         sub_kinds = {m.name: m.kind for m in node.subs}
@@ -979,11 +1507,19 @@ class AggEngine:
         min_count = -1 if partial else int(body.get("min_doc_count", 0))
         extended_bounds = body.get("extended_bounds")
 
+        cal_bounds = meta.get("cal_bounds")
         groups: Dict[float, int] = {}  # float key -> board lane
-        for i in range(n_b):
-            if int(counts[i]) > 0:
-                key = float((base + i) * interval + offset)
-                groups[key] = i
+        if cal_bounds is not None:
+            # calendar lanes map to the precomputed boundary table, not
+            # to a fixed-width arithmetic progression
+            for i in range(min(n_b, len(cal_bounds))):
+                if int(counts[i]) > 0:
+                    groups[float(cal_bounds[i] + offset)] = i
+        else:
+            for i in range(n_b):
+                if int(counts[i]) > 0:
+                    key = float((base + i) * interval + offset)
+                    groups[key] = i
         all_keys = sorted(groups)
 
         def _guard_span(lo_key, hi_key):
@@ -1067,6 +1603,300 @@ class AggEngine:
             buckets.append(b)
         buckets.sort(key=lambda b: b.pop("_sort"))
         return {"buckets": buckets}
+
+    # ------------------------------------------------- tree assembly ----
+    def _tree_eff_counts(self, tnode, P) -> np.ndarray:
+        """Per-lane doc counts of this node's level given the parent
+        composite selection P (ids over the chain MINUS the last level).
+        The flat board reshapes to (parents, k) and the selected parent
+        rows sum — exact int64 adds, order-free."""
+        ks = tnode["ks"]
+        k = ks[-1]
+        counts = tnode["counts"]
+        if counts is None or not P or k == 0:
+            return np.zeros(max(k, 0), dtype=np.int64)
+        total = 1
+        for kk in ks:
+            total *= kk
+        return counts[:total].reshape(total // k, k)[
+            np.asarray(P)].sum(axis=0)
+
+    def _tree_sub_outputs(self, b, P_i, tnode, spec_node, partial):
+        for mname, board4 in tnode["metrics"].items():
+            mbody = _sub_body(spec_node, mname)
+            kind = next(k for k in (spec_node.get("aggs")
+                                    or spec_node.get("aggregations")
+                                    or {})[mname]
+                        if k not in ("aggs", "aggregations", "meta"))
+            if board4 is None or not P_i:
+                c, ss, m1, m2 = 0, 0.0, float("inf"), float("-inf")
+            else:
+                cnt, s, mn, mx = board4
+                idx = np.asarray(P_i)
+                c = int(cnt[idx].sum())
+                ss = float(s[idx].sum())
+                m1 = float(mn[idx].min())
+                m2 = float(mx[idx].max())
+            b[mname] = self._metric_out(kind, mbody, c, ss, m1, m2,
+                                        mbody.get("field"), partial)
+
+    def _card_out(self, ctx, rec, P, partial, name):
+        from elasticsearch_tpu.search import agg_partials as AP
+        body = rec["body"]
+        if partial:
+            board = rec["board"]
+            if board is None or not P:
+                regs: Dict[int, int] = {}
+            else:
+                v = board[np.asarray(P)].max(axis=0)
+                nz = np.nonzero(v)[0]
+                regs = {int(i): int(v[i]) for i in nz}
+            return AP._hll_pack(regs)
+        pt = body.get("precision_threshold")
+        if pt is not None and int(pt) < 0:
+            raise IllegalArgumentError(
+                f"[precisionThreshold] must be greater than or equal to "
+                f"0. Found [{int(pt)}] in [{name}]")
+        board = rec["board"]
+        k_card = rec["k"]
+        col = rec["col"]
+        n_keys = len(col.ord_keys)
+        if board is None or not P:
+            sub = np.zeros(k_card, dtype=np.int64)
+        else:
+            total = (len(board) - 1) // k_card
+            sub = board[: total * k_card].reshape(total, k_card)[
+                np.asarray(P)].sum(axis=0)
+        distinct = int(np.count_nonzero(sub[:n_keys]))
+        if rec["miss"] and int(sub[k_card - 1]) > 0:
+            # the host adds _hashable(missing) to the distinct SET — it
+            # only grows the count when no counted key already equals it
+            mi = None
+            mv = A._hashable(body.get("missing"))
+            for i, kk in enumerate(col.ord_keys):
+                if A._hashable(kk) == mv:
+                    mi = i
+                    break
+            if mi is None or int(sub[mi]) == 0:
+                distinct += 1
+        return {"value": distinct}
+
+    def _assemble_tree(self, ctx, tnode, spec_node, P, partial, boards):
+        """Assemble one tree node's bucket list for the parent composite
+        selection P, recursing into children with each bucket's own
+        composite list — the flat boards decompose into exactly the
+        nested JSON the host's `_bucketize` recursion emits."""
+        node_ = tnode["node"]
+        lvl = tnode["chain"][-1]
+        k = lvl["k"]
+        body = spec_node[node_.kind]
+        eff = self._tree_eff_counts(tnode, P)
+
+        def bucket_fill(b, P_i):
+            self._tree_sub_outputs(b, P_i, tnode, spec_node, partial)
+            for cname, rec in tnode["cards"].items():
+                b[cname] = self._card_out(ctx, rec, P_i, partial, cname)
+            sub_spec = (spec_node.get("aggs")
+                        or spec_node.get("aggregations") or {})
+            for chname, ch in tnode["children"].items():
+                res = self._assemble_tree(ctx, ch, sub_spec[chname],
+                                          P_i, partial, boards)
+                if not partial \
+                        and isinstance(sub_spec[chname].get("meta"),
+                                       dict) and isinstance(res, dict):
+                    res["meta"] = sub_spec[chname]["meta"]
+                b[chname] = res
+
+        if lvl["kind"] == "ord":
+            return self._tree_terms(ctx, node_, body, lvl, eff, P, k,
+                                    partial, bucket_fill, tnode, boards)
+        return self._tree_histo(ctx, node_, body, lvl, eff, P, k,
+                                partial, bucket_fill)
+
+    def _tree_terms(self, ctx, node, body, lvl, eff, P, k, partial,
+                    bucket_fill, tnode, boards):
+        from elasticsearch_tpu.index.mapping import parse_date_millis
+        col = lvl["col"]
+        field = node.field
+        mapper = self.mapper_service.get(field) if field else None
+        tname = getattr(mapper, "type_name", None) or body.get(
+            "value_type")
+        size = int(body.get("size", 10))
+        if partial:
+            size = int(body.get("shard_size") or (size * 3 // 2 + 10))
+
+        key_index = {A._hashable(kk): i
+                     for i, kk in enumerate(col.ord_keys)}
+        items: List[list] = []
+        for i, kk in enumerate(col.ord_keys):
+            items.append([A._hashable(kk), int(eff[i]), i, None])
+
+        missing_val = body.get("missing")
+        if missing_val is not None:
+            mv = missing_val
+            if tname in ("date", "date_nanos") and isinstance(mv, str):
+                try:
+                    mv = parse_date_millis(mv)
+                except Exception:
+                    pass
+            elif tname in ("long", "integer", "short", "byte"):
+                try:
+                    mv = int(mv)
+                except (TypeError, ValueError):
+                    raise ParsingError(
+                        f"failed to parse [missing] value [{mv}] as a "
+                        f"long")
+            elif tname in ("double", "float", "half_float"):
+                try:
+                    mv = float(mv)
+                except (TypeError, ValueError):
+                    raise ParsingError(
+                        f"failed to parse [missing] value [{mv}] as a "
+                        f"double")
+            miss_cnt = int(eff[k - 1])
+            ki = key_index.get(A._hashable(mv))
+            if ki is not None:
+                items[ki][1] += miss_cnt
+                items[ki][3] = k - 1
+            elif miss_cnt > 0:
+                items.append([A._hashable(mv), miss_cnt, k - 1, None])
+
+        mdc = int(body.get("min_doc_count", 1))
+        if mdc != 0:
+            items = [it for it in items if it[1] > 0]
+
+        if mapper is not None:
+            _tn = getattr(mapper, "type_name", None)
+            if (_tn == "keyword" or (_tn == "text"
+                                     and (mapper.params or {})
+                                     .get("fielddata"))):
+                self.mapper_service.mark_fielddata_loaded(field)
+
+        order_spec = body.get("order")
+        if not partial and order_spec and isinstance(order_spec, dict):
+            ((okey, odir),) = order_spec.items()
+            reverse = odir == "desc"
+            if okey == "_key":
+                items.sort(key=lambda it: A._sort_key(it[0]),
+                           reverse=reverse)
+            else:
+                # "_count" compiles to the tree only at depth 1 (the
+                # classifier rejects it deeper): the host tie-break is
+                # first occurrence among matched rows, recovered from
+                # the mask exactly like the single-level path
+                mask = boards["mask"]
+                marr = col.ords[: col.n_rows][mask[: col.n_rows]]
+                marr = marr[marr >= 0]
+                uniq, first = np.unique(marr, return_index=True)
+                pos = {int(o): int(f) for o, f in zip(uniq, first)}
+                items.sort(key=lambda it: pos.get(it[2], float("inf")))
+                items.sort(key=lambda it: (it[1],), reverse=reverse)
+        else:
+            items.sort(key=lambda it: (-it[1], A._sort_key(it[0])))
+
+        total_other = sum(it[1] for it in items[size:])
+        A._check_max_buckets(ctx, min(len(items), size))
+        buckets = []
+        for key, c, lane, merge_lane in items[:size]:
+            b = {"key": key, "doc_count": int(c)}
+            P_i = [p * k + lane for p in P]
+            if merge_lane is not None:
+                P_i += [p * k + merge_lane for p in P]
+            bucket_fill(b, P_i)
+            buckets.append(b)
+        if tname == "ip":
+            from elasticsearch_tpu.index.mapping import IpFieldMapper
+            for b in buckets:
+                try:
+                    b["key"] = IpFieldMapper.format_value(int(b["key"]))
+                except (ValueError, TypeError):
+                    pass
+        elif tname == "boolean":
+            for b in buckets:
+                truthy = bool(b["key"])
+                b["key"] = 1 if truthy else 0
+                b["key_as_string"] = "true" if truthy else "false"
+        elif tname == "date":
+            for b in buckets:
+                if isinstance(b["key"], (int, float)):
+                    b["key_as_string"] = A._millis_to_iso(int(b["key"]))
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": int(total_other),
+                "buckets": buckets}
+
+    def _tree_histo(self, ctx, node, body, lvl, eff, P, k, partial,
+                    bucket_fill):
+        meta = lvl["meta"]
+        interval = meta["interval"]
+        offset = meta["offset"]
+        base = meta["base"]
+        date = meta["date"]
+        fmt = meta["fmt"]
+        tz = meta["tz"]
+        cal_bounds = meta.get("cal_bounds")
+        min_count = -1 if partial else int(body.get("min_doc_count", 0))
+        extended_bounds = body.get("extended_bounds")
+
+        groups: Dict[float, int] = {}
+        if cal_bounds is not None:
+            for i in range(len(cal_bounds)):
+                if i < len(eff) and int(eff[i]) > 0:
+                    groups[float(cal_bounds[i] + offset)] = i
+        else:
+            for i in range(k):
+                if int(eff[i]) > 0:
+                    groups[float((base + i) * interval + offset)] = i
+        all_keys = sorted(groups)
+
+        def _guard_span(lo_key, hi_key):
+            if interval and (hi_key - lo_key) / interval > A.MAX_BUCKETS:
+                raise IllegalArgumentError(
+                    f"Trying to create too many buckets. Must be less "
+                    f"than or equal to: [{A.MAX_BUCKETS}].")
+
+        if extended_bounds and interval:
+            lo = float(extended_bounds.get("min", np.inf))
+            hi = float(extended_bounds.get("max", -np.inf))
+            kk = min([lo] + all_keys) if all_keys or lo != np.inf else lo
+            top = max([hi] + all_keys) if all_keys or hi != -np.inf \
+                else hi
+            _guard_span(kk, top)
+            cur = kk
+            full = []
+            while cur <= top + 1e-9:
+                full.append(round(cur, 10))
+                cur += interval
+            all_keys = full
+        elif min_count == 0 and all_keys and interval:
+            _guard_span(all_keys[0], all_keys[-1])
+            full = []
+            cur = all_keys[0]
+            while cur <= all_keys[-1] + 1e-9:
+                full.append(round(cur, 10))
+                cur += interval
+            all_keys = full
+        A._check_max_buckets(ctx, len(all_keys))
+        buckets = []
+        for key in all_keys:
+            lane = groups.get(key)
+            c = int(eff[lane]) if lane is not None else 0
+            if c < min_count and min_count > 0:
+                continue
+            b = {"key": int(key) if date else key, "doc_count": c}
+            if date:
+                b["key_as_string"] = A._format_date_key(int(key), fmt,
+                                                        tz) \
+                    if fmt else A._millis_to_iso_tz(int(key), tz)
+            P_i = [p * k + lane for p in P] if lane is not None else []
+            bucket_fill(b, P_i)
+            buckets.append(b)
+        out = {"buckets": buckets}
+        if not date:
+            f = body.get("format")
+            if f:
+                for b in out["buckets"]:
+                    b["key_as_string"] = A._decimal_format(b["key"], f)
+        return out
 
 
 def _sub_body(spec: dict, sub_name: str) -> dict:

@@ -578,6 +578,9 @@ class TestStatsAndProfile:
     def test_aggs_profile_carries_columnar_annotation(self, tmp_path):
         from elasticsearch_tpu.node import Node
         node = Node(str(tmp_path))
+        # the annotation is a device-path artifact (column builds); the
+        # measured cost router would route this tiny corpus host
+        node.settings["search.aggs.cost_router"] = "false"
         try:
             node.create_index_with_templates(
                 "logs", mappings={"properties": {

@@ -112,6 +112,18 @@ DEVICE_SPECS = [
                               "offset": "+30m",
                               "format": "yyyy-MM-dd HH:mm"},
            "aggs": {"mn": {"min": {"field": "v"}}}}},
+    # calendar intervals ride the boundary-table kernel (rung 2)
+    {"d": {"date_histogram": {"field": "ts",
+                              "calendar_interval": "hour"}}},
+    # cardinality: device HLL boards (rung 2)
+    {"c": {"cardinality": {"field": "cat"}}},
+    {"t": {"terms": {"field": "cat"},
+           "aggs": {"cd": {"cardinality": {"field": "v"}}}}},
+    # 2-level sub-agg tree: composite-id boards (rung 2)
+    {"t": {"terms": {"field": "cat"},
+           "aggs": {"by_flag": {"terms": {"field": "flag"},
+                                "aggs": {"s": {"stats": {"field":
+                                                         "v"}}}}}}},
     # range: open ends / keys / overlaps / sub-aggs
     {"r": {"range": {"field": "v",
                      "ranges": [{"to": 50}, {"from": 50, "to": 150,
@@ -141,9 +153,7 @@ FALLBACK_SPECS = [
     {"t": {"terms": {"field": "cat"},
            "aggs": {"c": {"value_count": {"field": "nums"}}}}},
     {"t": {"terms": {"field": "tags"}}},                   # multi-valued
-    {"d": {"date_histogram": {"field": "ts",
-                              "calendar_interval": "hour"}}},
-    {"c": {"cardinality": {"field": "cat"}}},              # HLL family
+    {"c": {"cardinality": {"field": "tags"}}},             # multi-valued HLL
     {"t": {"terms": {"field": "cat", "include": ["red", "blue"]}}},
 ]
 
@@ -411,6 +421,9 @@ class TestMeshAggs:
 def _mk_node(tmp):
     from elasticsearch_tpu.node import Node
     node = Node(tmp)
+    # the measured router may (correctly) route this tiny corpus host;
+    # these tests pin device-vs-host PARITY, so force the device path
+    node.settings["search.aggs.cost_router"] = "false"
     node.create_index_with_templates("logs", mappings={"properties": {
         "cat": {"type": "keyword"}, "v": {"type": "long"},
         "ts": {"type": "date"}}})

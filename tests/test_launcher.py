@@ -15,8 +15,8 @@ import asyncio
 import pytest
 
 from elasticsearch_tpu.cluster.launcher import (
-    DEFAULT_HOST, NodeProcess, find_free_ports, format_peers, join_cluster,
-    launch_nodes, parse_peers,
+    DEFAULT_HOST, NodeProcess, default_host, find_free_ports, format_peers,
+    join_cluster, launch_nodes, parse_peers,
 )
 from elasticsearch_tpu.cluster.state import ShardRoutingEntry
 
@@ -31,6 +31,43 @@ def test_find_free_ports_distinct():
     ports = find_free_ports(4)
     assert len(set(ports)) == 4
     assert all(p > 0 for p in ports)
+
+
+def test_bind_host_env_resolves_at_call_time(monkeypatch):
+    monkeypatch.delenv("ES_TPU_BIND_HOST", raising=False)
+    assert default_host() == DEFAULT_HOST
+    monkeypatch.setenv("ES_TPU_BIND_HOST", "127.0.0.2")
+    assert default_host() == "127.0.0.2"
+
+
+def test_node_advertises_configured_bind_host(tmp_path, monkeypatch):
+    """ES_TPU_BIND_HOST steers both the bound socket and the address the
+    node publishes into the cluster state — the contract cross-machine
+    topologies depend on (peers dial what the state advertises)."""
+    monkeypatch.setenv("ES_TPU_BIND_HOST", "127.0.0.2")
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    node = transport = None
+    try:
+        node, transport = join_cluster(
+            "solo", str(tmp_path / "solo"), peers={}, masters=["solo"],
+            loop=loop)
+        deadline = loop.time() + 30.0
+        while loop.time() < deadline:
+            loop.run_until_complete(asyncio.sleep(0.02))
+            if node.cluster_state.master_node_id == "solo":
+                break
+        me = node.cluster_state.nodes["solo"]
+        assert me.address == f"127.0.0.2:{transport.port}"
+    finally:
+        if node is not None:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        if transport is not None:
+            loop.run_until_complete(transport.close())
+        loop.close()
 
 
 class LaunchedCluster:
