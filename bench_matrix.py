@@ -1576,6 +1576,205 @@ def run_device_aggs(n_docs: int = 100_000):
         node.close()
 
 
+def run_retrieval_workloads(n_docs: int = 20_000, dims: int = 64):
+    """Config 16: learned-sparse + late-interaction retrieval on the
+    device kernel substrates (ops/sparse.py + ops/pallas_maxsim.py +
+    vectors/late_interaction.py), on a token-bearing corpus shape the
+    matrix didn't previously cover: every doc carries a `rank_features`
+    weight map AND a ragged [2-8, dims] token matrix (int8 columnar
+    blocks) AND a text body.
+
+    Three rows: sparse-only (device `sparse.topk` vs the pure-host
+    `weighted_tokens` walker, byte parity asserted), late-interaction-
+    only (fused coarse+MaxSim vs the exact host MaxSim walker, recall@10
+    gated), and the 3-leg rank.rrf hybrid (match + sparse + late legs
+    through the fused plan executor, `gate_p99_le_3x_p50`). Each row
+    carries its own dispatch delta — steady state must read compiles=0 —
+    and rows on the CPU floor label interpret-mode/compile noise."""
+    import os
+    import tempfile
+
+    import jax
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.ops import dispatch
+
+    if os.environ.get("BENCH_SMALL") == "1":
+        n_docs = min(n_docs, 2_000)
+    rng = np.random.default_rng(29)
+    backend = jax.devices()[0].platform
+    cpu_fallback = not dispatch.is_accelerator_backend()
+    node = Node(tempfile.mkdtemp())
+    try:
+        node.create_index_with_templates("ret", mappings={"properties": {
+            "body": {"type": "text"},
+            "feats": {"type": "rank_features"},
+            "colv": {"type": "rank_vectors", "dims": dims,
+                     "encoding": "int8", "oversample": 8}}})
+        vocab = [f"feat{i}" for i in range(2_000)]
+        words = [f"w{i}" for i in range(500)]
+        topics = rng.standard_normal((64, dims)).astype(np.float32)
+        t0 = time.perf_counter()
+        for c0 in range(0, n_docs, 2_000):
+            ops = []
+            for i in range(c0, min(c0 + 2_000, n_docs)):
+                nt = int(rng.integers(2, 9))
+                toks = (topics[i % 64]
+                        + 0.6 * rng.standard_normal((nt, dims))) \
+                    .astype(np.float32)
+                ops.append({"index": {"_index": "ret", "_id": str(i)}})
+                ops.append({
+                    "body": " ".join(rng.choice(words, 6)),
+                    "feats": {v: float(rng.uniform(0.05, 8.0))
+                              for v in rng.choice(vocab, 5,
+                                                  replace=False)},
+                    "colv": toks.tolist()})
+            node.bulk(ops)
+        node.indices.get("ret").force_merge()
+        node.indices.get("ret").refresh()
+        build_s = time.perf_counter() - t0
+
+        svc = node.indices.get("ret")
+        reader = svc.combined_reader()
+        ex = node._hybrid_executor(svc)
+        n_q = 40
+
+        def sparse_q(i):
+            return {vocab[int(v)]: float(rng.uniform(0.5, 3.0))
+                    for v in rng.integers(0, 2_000, 4)}
+
+        # ---- row 1: learned sparse, device kernel vs host walker ----
+        sqs = [sparse_q(i) for i in range(n_q)]
+        for q in sqs[:5]:
+            ex.sparse.search_batch(reader, "feats", [(q, 1.0)], 100,
+                                   route="device")
+        mark = _dispatch_mark()
+        dev_lats, dev_out = [], []
+        for q in sqs:
+            t1 = time.perf_counter()
+            out = ex.sparse.search_batch(reader, "feats", [(q, 1.0)],
+                                         100, route="device")
+            dev_lats.append((time.perf_counter() - t1) * 1000)
+            dev_out.append(out[0])
+        disp = _dispatch_delta(mark)
+        host_lats = []
+        parity = True
+        for q, (drows, dscores) in zip(sqs, dev_out):
+            t1 = time.perf_counter()
+            resp = node.search("ret", {
+                "query": {"sparse_vector": {"field": "feats",
+                                            "query_vector": q}},
+                "size": 100})
+            host_lats.append((time.perf_counter() - t1) * 1000)
+            hids = [h["_id"] for h in resp["hits"]["hits"]]
+            dids = [reader.get_id(int(r)) for r in drows[:len(hids)]]
+            if dids != hids:
+                parity = False
+        dev_p50 = float(np.percentile(dev_lats, 50))
+        host_p50 = float(np.percentile(host_lats, 50))
+        print(json.dumps({
+            "config": "16_retrieval_workloads", "row": "sparse_only",
+            "p50_ms": round(dev_p50, 2),
+            "p99_ms": round(float(np.percentile(dev_lats, 99)), 2),
+            "host_walker_p50_ms": round(host_p50, 2),
+            "speedup_vs_host": round(host_p50 / max(dev_p50, 1e-9), 2),
+            "parity_vs_host": parity,
+            "gate_zero_steady_compiles": disp["compiles"] == 0,
+            "n_docs": n_docs, "backend": backend,
+            **({"cpu_fallback": True} if cpu_fallback else {}),
+            "dispatch": disp, "build_s": round(build_s, 1),
+            **_compile_noise_label(disp)}), flush=True)
+
+        # ---- row 2: late interaction, fused rescore vs exact oracle --
+        mapper = svc.mapper_service.get("colv")
+        lqs = []
+        for i in range(n_q):
+            t = topics[int(rng.integers(64))]
+            lqs.append((t + 0.3 * rng.standard_normal((4, dims)))
+                       .astype(np.float32))
+        for qt in lqs[:5]:
+            ex.late.search_batch(reader, mapper, [(qt, 1.0)], 10)
+        mark = _dispatch_mark()
+        dev_lats, dev_rows = [], []
+        for qt in lqs:
+            t1 = time.perf_counter()
+            (rows, _), = ex.late.search_batch(reader, mapper,
+                                              [(qt, 1.0)], 10)
+            dev_lats.append((time.perf_counter() - t1) * 1000)
+            dev_rows.append(rows)
+        disp = _dispatch_delta(mark)
+        host_lats, hits = [], 0
+        for qt, drows in zip(lqs, dev_rows):
+            t1 = time.perf_counter()
+            resp = node.search("ret", {
+                "query": {"late_interaction": {
+                    "field": "colv", "query_tokens": qt.tolist()}},
+                "size": 10})
+            host_lats.append((time.perf_counter() - t1) * 1000)
+            oids = {h["_id"] for h in resp["hits"]["hits"]}
+            hits += len({reader.get_id(int(r))
+                         for r in drows.tolist()} & oids)
+        recall = hits / (n_q * 10)
+        dev_p50 = float(np.percentile(dev_lats, 50))
+        host_p50 = float(np.percentile(host_lats, 50))
+        lf = ex.late.field(reader, mapper)
+        print(json.dumps({
+            "config": "16_retrieval_workloads",
+            "row": "late_interaction_only",
+            "p50_ms": round(dev_p50, 2),
+            "p99_ms": round(float(np.percentile(dev_lats, 99)), 2),
+            "host_walker_p50_ms": round(host_p50, 2),
+            "speedup_vs_host": round(host_p50 / max(dev_p50, 1e-9), 2),
+            "recall_at_10_vs_exact": round(recall, 3),
+            "gate_recall": recall >= 0.95,
+            "gate_zero_steady_compiles": disp["compiles"] == 0,
+            "encoding": lf.encoding, "cap": lf.cap,
+            "coarse_window": lf.coarse_window(10),
+            "tile_mb": round(lf.nbytes() / 1e6, 1),
+            "n_docs": n_docs, "backend": backend,
+            **({"cpu_fallback": True} if cpu_fallback else {}),
+            "dispatch": disp,
+            **_compile_noise_label(disp)}), flush=True)
+
+        # ---- row 3: 3-leg rank.rrf hybrid through the fused plan ----
+        def rrf_body(i):
+            return {"rank": {"rrf": {}}, "sub_searches": [
+                {"query": {"match": {"body": " ".join(
+                    rng.choice(words, 2))}}},
+                {"query": {"sparse_vector": {"field": "feats",
+                                             "query_vector": sqs[i]}}},
+                {"query": {"late_interaction": {
+                    "field": "colv", "query_tokens": lqs[i].tolist(),
+                    "k": 10}}}], "size": 10}
+
+        for i in range(5):
+            node.search("ret", rrf_body(i))
+        mark = _dispatch_mark()
+        lats = []
+        for i in range(n_q):
+            t1 = time.perf_counter()
+            node.search("ret", rrf_body(i))
+            lats.append((time.perf_counter() - t1) * 1000)
+        disp = _dispatch_delta(mark)
+        p50 = float(np.percentile(lats, 50))
+        p99 = float(np.percentile(lats, 99))
+        print(json.dumps({
+            "config": "16_retrieval_workloads", "row": "hybrid_rrf_3leg",
+            "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+            "gate_p99_le_3x_p50": bool(p99 <= 3 * p50),
+            "gate_zero_steady_compiles": disp["compiles"] == 0,
+            "plan_cache_hits": ex.stats["plan_cache_hits"],
+            "plan_cache_misses": ex.stats["plan_cache_misses"],
+            "sparse_grid_fallbacks": ex.stats["sparse_grid_fallbacks"],
+            "maxsim_grid_fallbacks": ex.stats["maxsim_grid_fallbacks"],
+            "n_docs": n_docs, "backend": backend,
+            **({"cpu_fallback": True} if cpu_fallback else {}),
+            "dispatch": disp,
+            **_compile_noise_label(disp)}), flush=True)
+    finally:
+        node.close()
+
+
 def run_ingest_while_search(n_seed: int = 200_000, d: int = 64,
                             docs_per_sec: int = 4000,
                             duration_s: float = 8.0,
@@ -3312,6 +3511,7 @@ def main():
     guarded(run_ivf_config)
     guarded(run_density_ladder)
     guarded(run_device_aggs)
+    guarded(run_retrieval_workloads)
     guarded(run_ingest_while_search)
     guarded(run_sharded_fused)
     guarded(run_dp_replicated)

@@ -7,9 +7,13 @@ block shapes. The process-wide instance is `columnar.STORE`."""
 
 from elasticsearch_tpu.columnar.blocks import (
     PostingsBlock,
+    SparsePostingsBlock,
+    TokenVectorBlock,
     ValuesBlock,
     VectorBlock,
     extract_postings_block,
+    extract_sparse_postings_block,
+    extract_token_vector_block,
     extract_values_block,
     extract_vector_block,
     fingerprint,
@@ -23,7 +27,8 @@ from elasticsearch_tpu.columnar.store import (
 
 __all__ = [
     "STORE", "SegmentBlockStore", "FieldRowsView", "RowSource",
-    "VectorBlock", "ValuesBlock", "PostingsBlock",
-    "extract_vector_block", "extract_values_block",
-    "extract_postings_block", "fingerprint",
+    "VectorBlock", "ValuesBlock", "PostingsBlock", "SparsePostingsBlock",
+    "TokenVectorBlock", "extract_vector_block", "extract_values_block",
+    "extract_postings_block", "extract_sparse_postings_block",
+    "extract_token_vector_block", "fingerprint",
 ]

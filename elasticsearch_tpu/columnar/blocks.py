@@ -21,6 +21,14 @@ Block kinds:
   `aggregations.numeric_values` coercion.
 * ``PostingsBlock`` — one segment's live postings in dense live-slot
   space (the BM25 CSR input), via `SegmentView.live_postings`.
+* ``SparsePostingsBlock`` — one segment's live `rank_features` maps
+  inverted to feature-major (slots, weights) runs — the SAME CSR input
+  shape as ``PostingsBlock``, with stored weights where BM25 has term
+  freqs (the learned-sparse `ops/sparse.py` layout reads these).
+* ``TokenVectorBlock`` — one segment's live `rank_vectors` token
+  matrices, codec-encoded ragged (per-token rows + per-doc counts) plus
+  the f32 pooled centroid per doc that feeds the coarse single-vector
+  retrieval phase (`vectors/late_interaction.py`).
 
 Extraction math is byte-identical to the three retired extractors (the
 parity suite in `tests/test_columnar.py` pins it).
@@ -223,3 +231,121 @@ class PostingsBlock:
 def extract_postings_block(view, field: str) -> PostingsBlock:
     terms, lengths, n_live = view.live_postings(field)
     return PostingsBlock(fingerprint(view), terms, lengths, n_live)
+
+
+class SparsePostingsBlock:
+    """One segment's live `rank_features` maps inverted to feature-major
+    runs in dense live-slot space — the learned-sparse CSR input.
+
+    ``features`` maps feature name -> (live slots ascending int32,
+    stored weights f32): exactly ``PostingsBlock.terms`` with weights in
+    the freq position, so `ops/sparse.py` tile-pads it with the same
+    code BM25 uses (weights ARE the impacts — no idf/length math).
+    ``n_live`` spans ALL live docs of the segment (docs without the
+    field simply appear in no feature's run), keeping the slot space
+    identical to the lexical layout's."""
+
+    __slots__ = ("fingerprint", "features", "n_live", "nbytes")
+
+    def __init__(self, fp: tuple, features, n_live: int):
+        self.fingerprint = fp
+        self.features = features
+        self.n_live = n_live
+        self.nbytes = sum(s.nbytes + w.nbytes
+                          for s, w in features.values())
+
+
+def extract_sparse_postings_block(view, field: str) -> SparsePostingsBlock:
+    seg = view.segment
+    col = seg.doc_values.get(field)
+    live_idx = np.nonzero(view.live)[0]
+    acc: dict = {}
+    if col is not None:
+        for slot, loc in enumerate(live_idx):
+            v = col.values[int(loc)]
+            if not isinstance(v, dict):
+                continue
+            for feat, w in v.items():
+                lists = acc.get(feat)
+                if lists is None:
+                    lists = acc[feat] = ([], [])
+                lists[0].append(slot)
+                lists[1].append(w)
+    features = {
+        feat: (np.asarray(slots, dtype=np.int32),
+               np.asarray(weights, dtype=np.float32))
+        for feat, (slots, weights) in acc.items()}
+    return SparsePostingsBlock(fingerprint(view), features, len(live_idx))
+
+
+class TokenVectorBlock:
+    """One segment's live `rank_vectors` token matrices, codec-encoded
+    ragged: ``data`` [total_tokens, W] packed token rows (lane-padded
+    width), ``scales`` [total_tokens] per-token codec aux, ``counts``
+    [n] tokens per doc, ``pooled`` [n, dims] f32 coarse centroids,
+    ``rows`` [n] engine global row ids. Only docs carrying at least one
+    token appear. Cached per (segment, field, encoding, metric, dims)
+    like the encoded single-vector blocks, so refresh re-encodes only
+    delta segments."""
+
+    __slots__ = ("fingerprint", "data", "scales", "counts", "pooled",
+                 "rows", "dims", "nbytes")
+
+    def __init__(self, fp: tuple, data, scales, counts, pooled, rows,
+                 dims: int):
+        self.fingerprint = fp
+        self.data = data
+        self.scales = scales
+        self.counts = counts
+        self.pooled = pooled
+        self.rows = rows
+        self.dims = dims
+        self.nbytes = (data.nbytes + scales.nbytes + counts.nbytes
+                       + pooled.nbytes + rows.nbytes)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.rows)
+
+
+def extract_token_vector_block(view, field: str, variant: tuple
+                               ) -> Optional[TokenVectorBlock]:
+    """Gather, metric-prep, and codec-encode one segment's live token
+    matrices (all packing math in `quant/tokens.py` — the token twin of
+    `extract_encoded_vector_block`). variant = (encoding, metric, dims);
+    None when the segment carries no such field."""
+    from elasticsearch_tpu.quant import tokens as quant_tokens
+    encoding, metric, dims = variant
+    seg = view.segment
+    col = seg.doc_values.get(field)
+    if col is None:
+        return None
+    fp = fingerprint(view, (variant,))
+    live_idx = np.nonzero(view.live)[0]
+    tok_parts, pooled_parts, counts, rows = [], [], [], []
+    for loc in live_idx:
+        v = col.values[int(loc)]
+        if v is None:
+            continue
+        toks = quant_tokens.prep_tokens(
+            np.asarray(v, dtype=np.float32).reshape(-1, dims), metric)
+        if not len(toks):
+            continue
+        tok_parts.append(toks)
+        pooled_parts.append(quant_tokens.pool_doc(toks, metric))
+        counts.append(len(toks))
+        rows.append(int(loc) + seg.base)
+    if not tok_parts:
+        return TokenVectorBlock(
+            fp,
+            np.zeros((0, quant_tokens.packed_width(encoding, dims)),
+                     dtype=np.uint8),
+            np.zeros(0, dtype=np.float32),
+            np.zeros(0, dtype=np.int32),
+            np.zeros((0, dims), dtype=np.float32),
+            np.zeros(0, dtype=np.int64), dims)
+    data, scales = quant_tokens.encode_tokens(
+        np.concatenate(tok_parts), encoding, dims)
+    return TokenVectorBlock(
+        fp, data, scales, np.asarray(counts, dtype=np.int32),
+        np.stack(pooled_parts), np.asarray(rows, dtype=np.int64), dims)

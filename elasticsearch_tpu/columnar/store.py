@@ -42,10 +42,14 @@ import numpy as np
 from elasticsearch_tpu.columnar.blocks import (
     EncodedVectorBlock,
     PostingsBlock,
+    SparsePostingsBlock,
+    TokenVectorBlock,
     ValuesBlock,
     VectorBlock,
     extract_encoded_vector_block,
     extract_postings_block,
+    extract_sparse_postings_block,
+    extract_token_vector_block,
     extract_values_block,
     extract_vector_block,
     fingerprint,
@@ -56,6 +60,9 @@ _EXTRACTORS = {
     "values": extract_values_block,
     "postings": lambda view, field, variant: extract_postings_block(
         view, field),
+    "sparse_postings": lambda view, field, variant:
+        extract_sparse_postings_block(view, field),
+    "tokens": extract_token_vector_block,
 }
 
 
@@ -288,6 +295,18 @@ class SegmentBlockStore:
     def postings_block(self, view, field: str
                        ) -> Tuple[PostingsBlock, bool]:
         return self.block(view, field, "postings")
+
+    def sparse_postings_block(self, view, field: str
+                              ) -> Tuple[SparsePostingsBlock, bool]:
+        return self.block(view, field, "sparse_postings")
+
+    def token_block(self, view, field: str, encoding: str, metric: str,
+                    dims: int) -> Tuple[Optional[TokenVectorBlock], bool]:
+        """The encoded token block of one (segment, field) at one
+        (encoding, metric, dims) variant — delta-cached like the
+        single-vector encoded blocks, evicted with the segment."""
+        return self.block(view, field, "tokens",
+                          variant=(encoding, metric, dims))
 
     # -------------------------------------------------------------- stats
     def stats(self) -> dict:

@@ -639,6 +639,92 @@ class RankFeaturesFieldMapper(FieldMapper):
         return self.coerce(value)
 
 
+class RankVectorsFieldMapper(FieldMapper):
+    """`rank_vectors` (reference: x-pack rank-vectors
+    RankVectorsFieldMapper, the late-interaction field): each doc holds
+    a ragged LIST of token vectors, scored by MaxSim against a multi-
+    token query.
+
+    params: dims (required), similarity (cosine|dot_product, default
+    cosine — l2/MIP have no max-sum decomposition on the dot kernel),
+    index_options.encoding — the token-block storage rung in the device
+    columnar store (f32|bf16|int8|int4, default int8; binary has no
+    MaxSim kernel), index_options.oversample — the coarse pooled-
+    centroid window multiplier (k·oversample candidates rescored by the
+    fused MaxSim kernel, default 4), index_options.coarse — storage
+    rung of the pooled centroid matrix (any `dense_vector` flat rung,
+    default f32)."""
+
+    type_name = "rank_vectors"
+
+    ENCODINGS = ("f32", "bf16", "int8", "int4")
+    COARSE = ("f32", "bf16", "int8", "int4", "binary")
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.dims = self.params.get("dims")
+        if self.dims is None:
+            raise MapperParsingError(f"[{name}] rank_vectors requires [dims]")
+        self.dims = int(self.dims)
+        self.similarity = self.params.get("similarity", "cosine")
+        if self.similarity not in ("cosine", "dot_product"):
+            raise MapperParsingError(
+                f"[{name}] unknown similarity [{self.similarity}] for "
+                "rank_vectors; expected cosine or dot_product")
+        # storage knobs read from index_options with top-level fallback
+        # (the REST mapping surface accepts either placement)
+        opts = self.params.get("index_options") or {}
+        self.encoding = opts.get("encoding",
+                                 self.params.get("encoding", "int8"))
+        if self.encoding not in self.ENCODINGS:
+            raise MapperParsingError(
+                f"[{name}] unknown index_options encoding "
+                f"[{self.encoding}]; expected one of {list(self.ENCODINGS)}")
+        if self.encoding == "int4" and self.dims % 2:
+            raise MapperParsingError(
+                f"[{name}] index_options encoding [int4] requires even "
+                f"[dims], got [{self.dims}]")
+        self.coarse = opts.get("coarse", self.params.get("coarse", "f32"))
+        if self.coarse not in self.COARSE:
+            raise MapperParsingError(
+                f"[{name}] unknown index_options coarse [{self.coarse}]; "
+                f"expected one of {list(self.COARSE)}")
+        oversample = opts.get("oversample",
+                              self.params.get("oversample", 4))
+        try:
+            ok = int(oversample) >= 1
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise MapperParsingError(
+                f"[{name}] index_options [oversample] must be an integer "
+                f">= 1, got [{oversample}]")
+        self.oversample = int(oversample)
+
+    def coerce(self, value) -> np.ndarray:
+        if not isinstance(value, (list, tuple)) or not value:
+            raise MapperParsingError(
+                f"[{self.name}] rank_vectors value must be a non-empty "
+                "array of vectors")
+        arr = np.asarray(value, dtype=np.float32)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        if arr.ndim != 2 or arr.shape[1] != self.dims:
+            raise MapperParsingError(
+                f"[{self.name}] rank_vectors rows must have [{self.dims}] "
+                "dimensions")
+        if not np.isfinite(arr).all():
+            raise MapperParsingError(
+                f"[{self.name}] rank_vectors contains non-finite values")
+        return arr
+
+    def index_terms(self, value):
+        return []
+
+    def doc_value(self, value):
+        return self.coerce(value)
+
+
 class JoinFieldMapper(FieldMapper):
     """`join` (reference: modules/parent-join ParentJoinFieldMapper):
     relations define parent→children; doc value keeps {name, parent}."""
@@ -1213,6 +1299,7 @@ FIELD_TYPES = {
               GeoPointFieldMapper,
               DenseVectorFieldMapper, ObjectMapper, NestedMapper,
               RankFeatureFieldMapper, RankFeaturesFieldMapper,
+              RankVectorsFieldMapper,
               JoinFieldMapper, PercolatorFieldMapper,
               BinaryFieldMapper, IntegerRangeFieldMapper, LongRangeFieldMapper,
               FloatRangeFieldMapper, DoubleRangeFieldMapper,

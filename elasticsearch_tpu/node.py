@@ -1247,8 +1247,10 @@ class Node:
             router = self.settings.get("search.aggs.cost_router")
             engine = AggEngine(svc.mapper_service,
                                warmup=self._dispatch_warmup,
-                               cost_router=(router is None
-                                            or setting_bool(router)))
+                               cost_router=(self._agg_cost_router()
+                                            if router is None
+                                            or setting_bool(router)
+                                            else False))
 
             def _resync(_reader, svc=svc, engine=engine):
                 def run():
@@ -1268,6 +1270,24 @@ class Node:
             self._aggs[svc.name] = (svc, engine)
             return engine
 
+    def _agg_cost_router(self):
+        """The node's ONE shared cost router, disk-backed at
+        `<data>/_state/agg_router.json`: every index's agg engine trains
+        the same per-node EWMA tables, each observation persists them,
+        and a restart seeds them back instead of re-probing cold (the
+        PR 19 leftover — `router_restores` counts the seeded families)."""
+        router = getattr(self, "_agg_router", None)
+        if router is None:
+            import os as _os
+
+            from elasticsearch_tpu.search.agg_plan import CostRouter
+            state_dir = _os.path.join(self.indices.data_path, "_state")
+            _os.makedirs(state_dir, exist_ok=True)
+            router = CostRouter(
+                persist_path=_os.path.join(state_dir, "agg_router.json"))
+            self._agg_router = router
+        return router
+
     def _aggs_stats_section(self) -> dict:
         """Device-aggregation counters summed over local indices
         (`_nodes/stats indices.aggs`): per-node device vs host-fallback
@@ -1278,8 +1298,12 @@ class Node:
                "plan_cache_hits": 0, "plan_cache_misses": 0,
                "device_nanos": 0, "assemble_nanos": 0, "host_nanos": 0,
                "mesh_dispatches": 0, "router_host_routed": 0,
-               "router_probes": 0, "fallback_reasons": {},
+               "router_probes": 0, "router_restores": 0,
+               "fallback_reasons": {},
                "columns": 0, "column_bytes": 0, "column_rebuilds": 0}
+        router = getattr(self, "_agg_router", None)
+        if router is not None:
+            out["router_restores"] = router.restores
         with self._aggs_lock:
             self._evict_stale_aggs()
             engines = [eng for _svc, eng in self._aggs.values()]
@@ -1355,7 +1379,12 @@ class Node:
                "request_cache_hits": 0, "request_cache_misses": 0,
                "request_cache_stores": 0,
                "scheduler": {"topups": 0, "deadline_sheds": 0,
-                             "overlap_hits": 0, "pipelined_batches": 0}}
+                             "overlap_hits": 0, "pipelined_batches": 0},
+               "sparse": {"searches": 0, "queries": 0, "rebuilds": 0,
+                          "score_nanos": 0, "grid_fallbacks": 0},
+               "late_interaction": {"searches": 0, "queries": 0,
+                                    "rebuilds": 0, "score_nanos": 0,
+                                    "grid_fallbacks": 0, "fields": {}}}
         self._evict_stale_hybrid()
         for ex in self._hybrid.values():
             for key in ("searches", "batches", "plan_cache_hits",
@@ -1365,6 +1394,14 @@ class Node:
                         "request_cache_hits", "request_cache_misses",
                         "request_cache_stores"):
                 out[key] += ex.stats.get(key, 0)
+            for key in ("searches", "queries", "rebuilds", "score_nanos"):
+                out["sparse"][key] += ex.sparse.stats.get(key, 0)
+                out["late_interaction"][key] += ex.late.stats.get(key, 0)
+            out["sparse"]["grid_fallbacks"] += ex.stats.get(
+                "sparse_grid_fallbacks", 0)
+            out["late_interaction"]["grid_fallbacks"] += ex.stats.get(
+                "maxsim_grid_fallbacks", 0)
+            out["late_interaction"]["fields"].update(ex.late.field_stats())
             bs = ex.batcher.stats
             out["rejected_depth"] += bs.get("rejected_depth", 0)
             out["shed_deadline"] += bs.get("shed_deadline", 0)

@@ -96,7 +96,18 @@ class LexicalField:
 
     Host arrays are the source of truth (and the host scoring twin);
     device mirrors upload lazily on the first device-routed dispatch.
+
+    Subclasses retarget the SAME scoring program at other posting
+    sources by overriding the kernel/family names plus `sync` and
+    `plan_queries` (`ops/sparse.py` does this for `rank_features`
+    learned-sparse fields: stored weights become the impacts, query
+    token weights fold into the boosts, everything below — boards,
+    buckets, mesh twin, tie-breaks — is shared verbatim).
     """
+
+    KERNEL = "bm25.topk"
+    MESH_KERNEL = "bm25.mesh_topk"
+    FAMILY = "bm25"
 
     def __init__(self, field: str, dtype: str = "f32"):
         self.field = field
@@ -213,7 +224,15 @@ class LexicalField:
                 freq_flat[gather], len_flat[gather], idf, avg_len,
                 BM25_K1, BM25_B, 1.0)
 
-        # tile-pad term-major: each term's run rounds up to whole tiles
+        self._install_tiles(terms, dfs, ptr, slot_flat, impact_flat)
+        self.version = version
+        return True
+
+    def _install_tiles(self, terms, dfs, ptr, slot_flat, impact_flat):
+        """Tile-pad term-major flat (slot, impact) runs: each term's run
+        rounds up to whole TILE-lane tiles. Shared verbatim with the
+        learned-sparse subclass — the layout below the impact math is
+        identical by construction."""
         n_tiles_per = [max(1, -(-df // TILE)) if df else 0 for df in dfs]
         total_tiles = sum(n_tiles_per)
         tile_slots = np.full((max(total_tiles, 1), TILE), -1, dtype=np.int32)
@@ -233,8 +252,6 @@ class LexicalField:
             tile += nt
         self.tile_slots = tile_slots[:max(tile, 1)]
         self.tile_impacts = tile_impacts[:max(tile, 1)]
-        self.version = version
-        return True
 
     # ------------------------------------------------------------ search
     def nbytes(self) -> int:
@@ -372,7 +389,7 @@ class LexicalField:
         # must enqueue in one order (parallel/mesh.launch_guard)
         with mesh_lib.launch_guard(mesh):
             vals, gslots = dispatch.call(
-                "bm25.mesh_topk", jnp.asarray(tile_ids),
+                self.MESH_KERNEL, jnp.asarray(tile_ids),
                 jnp.asarray(boosts),
                 jnp.asarray(required.astype(np.int32)), slots_d,
                 impacts_d, scales_d, k=k_b, width=width, mesh=mesh)
@@ -386,7 +403,7 @@ class LexicalField:
             v, si = v[keep], si[keep]
             out.append((self.row_map[si], v.astype(np.float32)))
         t2 = _time.perf_counter_ns()
-        policy.record_leg("bm25", t1 - t0, t2 - t1,
+        policy.record_leg(self.FAMILY, t1 - t0, t2 - t1,
                           policy.gather_bytes(n_shards, n_pad, k_b))
         return out
 
@@ -395,7 +412,7 @@ class LexicalField:
         from elasticsearch_tpu.parallel import policy
 
         mesh = policy.decide(
-            "bm25", self.n_slots,
+            self.FAMILY, self.n_slots,
             batch=dispatch.bucket_queries(tile_ids.shape[0]))
         if mesh is not None:
             out = self._score_device_mesh(tile_ids, boosts, required, k,
@@ -405,7 +422,8 @@ class LexicalField:
             # ranked window deeper than one shard's slot range: the
             # sharded merge would be lossy, so this dispatch ran
             # single-device after all — keep the router stats honest
-            policy.reclassify_single("bm25_window_deeper_than_shard")
+            policy.reclassify_single(
+                self.FAMILY + "_window_deeper_than_shard")
 
         n_real = tile_ids.shape[0]
         tile_ids, boosts, required, n_pad = _pad_query_bucket(
@@ -427,7 +445,7 @@ class LexicalField:
         scores0 = jnp.zeros((n_pad, n_slots_pad + 1), dtype=jnp.float32)
         counts0 = jnp.zeros((n_pad, n_slots_pad + 1), dtype=jnp.int32)
         vals, slot_idx = dispatch.call(
-            "bm25.topk", scores0, counts0, jnp.asarray(tile_ids),
+            self.KERNEL, scores0, counts0, jnp.asarray(tile_ids),
             jnp.asarray(boosts), jnp.asarray(required.astype(np.int32)),
             slots_d, impacts_d, scales_d, k=k_b)
         vals = np.asarray(vals)[:, :k_req]
@@ -647,6 +665,8 @@ class LexicalShard:
     listener — most refreshes never serve a hybrid query, and the build
     is a full tokenized-postings pass)."""
 
+    FIELD_CLS: type = None  # set below (LexicalField) — subclasses override
+
     def __init__(self, dtype: str = "f32"):
         self.dtype = dtype
         self._fields: Dict[str, LexicalField] = {}
@@ -658,7 +678,7 @@ class LexicalShard:
         with self._lock:
             lf = self._fields.get(name)
             if lf is None:
-                lf = LexicalField(name, dtype=self.dtype)
+                lf = self.FIELD_CLS(name, dtype=self.dtype)
                 self._fields[name] = lf
             if lf.sync(reader):
                 self.stats["rebuilds"] += 1
@@ -675,3 +695,6 @@ class LexicalShard:
         self.stats["queries"] += len(queries)
         self.stats["score_nanos"] += time.perf_counter_ns() - t0
         return out
+
+
+LexicalShard.FIELD_CLS = LexicalField

@@ -1,0 +1,196 @@
+"""Learned-sparse retrieval (`rank_features`) on the BM25 kernel substrate.
+
+SPLADE-family learned-sparse models emit per-doc (term, weight) maps and
+score a query's token weights by a weighted dot product over the shared
+vocabulary — structurally the SAME computation BM25's impact layout
+already serves: a term-major scatter-add of per-posting values into a
+score board, masked, top-k'd. This module is therefore a thin mapping,
+not a new kernel: `SparseField` subclasses `ops/bm25.py`'s
+`LexicalField` and overrides exactly the two ends the docstring there
+promises —
+
+* build: postings come from the stored `rank_features` doc values
+  (`columnar.STORE.sparse_postings_block`, refresh-delta cached like the
+  tokenized postings), and the stored WEIGHTS are installed directly as
+  the impacts (no idf/tf math — the model already folded relevance into
+  the weight). The tile-padded CSR below (`_install_tiles`), the dtype
+  ladder (f32/bf16/int8 per-tile codec scales), the donated score
+  boards, and the doc-range-sharded mesh twin are inherited verbatim.
+
+* search: a query is a {token: weight} map; each token's weight (times
+  the leg boost) becomes that token's per-tile boost, so the kernel's
+  `impact * boost` multiply computes `doc_weight * query_weight` — the
+  sparse dot product. `required=1` (any overlapping token matches; the
+  weighted union IS the score, there is no operator=and analogue).
+
+The scoring programs register under their own dispatch names
+(`sparse.topk` / `sparse.mesh_topk`) pointing at the SAME compiled
+callables as the bm25 grid — separate names keep per-kernel dispatch
+stats, warmup ledgers, and strict-mode grids honest about which workload
+is running, while XLA still shares the underlying executables per shape.
+
+Queries wider than MAX_QUERY_TOKENS fall back to the host walker (the
+plan layer counts the fallback reason): the tile-id matrix is [Q, m]
+with m a pow2 over the widest query in the batch, so one pathological
+1k-token query would re-specialize the program AND drag every other
+query in the batch through its scan width.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.ops.bm25 import (
+    LexicalField,
+    LexicalShard,
+    _bm25_topk,
+    _bm25_topk_sharded,
+    _grid_bm25,
+    _grid_bm25_mesh,
+    _pow2,
+)
+
+# widest device-eligible query, in distinct tokens; SPLADE-style
+# expansions run 20-120 tokens, so 256 covers real models while capping
+# the scan width one outlier can impose on a shared batch
+MAX_QUERY_TOKENS = 256
+
+
+class SparseField(LexicalField):
+    """One `rank_features` field's tile-padded weight layout.
+
+    Same layout, boards, buckets, tie-breaks, host/device/mesh routing
+    as the BM25 parent — only the posting source (stored weights) and
+    the query planner (token weights as boosts) differ.
+    """
+
+    KERNEL = "sparse.topk"
+    MESH_KERNEL = "sparse.mesh_topk"
+    FAMILY = "sparse"
+
+    # ------------------------------------------------------------- build
+    def sync(self, reader) -> bool:
+        """(Re)build from the stored (feature -> weight) doc values.
+        Stored weights land as the impacts unchanged: corpus-global
+        stats don't exist here, so unlike BM25 the cached per-segment
+        extractions need NO recompute pass on refresh."""
+        from elasticsearch_tpu import columnar
+        version = tuple((v.segment.seg_id, v.segment.num_docs,
+                         int(v.live.sum())) for v in reader.views)
+        if version == self.version:
+            return False
+        segs: List = []
+        n_cached = n_extracted = 0
+        for view in reader.views:
+            blk, was_cached = columnar.STORE.sparse_postings_block(
+                view, self.field)
+            if was_cached:
+                n_cached += 1
+            else:
+                n_extracted += 1
+            segs.append(blk)
+        mode = columnar.STORE.note_composition(
+            self.field, "sparse_postings", n_cached, n_extracted)
+        self.columnar_refresh = {
+            "blocks": n_cached + n_extracted, "cached": n_cached,
+            "extracted": n_extracted, "mode": mode}
+
+        # dense slot space over ALL live docs (docs without the field
+        # simply appear in no feature's run) — identical to the lexical
+        # slot space, so slot-index tie-breaks equal row tie-breaks
+        bases = []
+        total = 0
+        row_parts = []
+        for view, sp in zip(reader.views, segs):
+            bases.append(total)
+            live_locals = np.nonzero(view.live)[0]
+            row_parts.append(live_locals.astype(np.int64)
+                            + view.segment.base)
+            total += sp.n_live
+        self.n_slots = total
+        self.row_map = (np.concatenate(row_parts) if row_parts
+                        else np.zeros(0, dtype=np.int64))
+
+        merged: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        for base, sp in zip(bases, segs):
+            for feat, (slots, weights) in sp.features.items():
+                merged.setdefault(feat, []).append((slots + base, weights))
+
+        terms = sorted(merged)
+        ptr = [0]
+        slot_parts, weight_parts, dfs = [], [], []
+        for t in terms:
+            chunks = merged[t]
+            s = (np.concatenate([c[0] for c in chunks])
+                 if len(chunks) > 1 else chunks[0][0])
+            w = (np.concatenate([c[1] for c in chunks])
+                 if len(chunks) > 1 else chunks[0][1])
+            slot_parts.append(s)
+            weight_parts.append(w)
+            dfs.append(len(s))
+            ptr.append(ptr[-1] + len(s))
+        slot_flat = (np.concatenate(slot_parts) if slot_parts
+                     else np.zeros(0, dtype=np.int32))
+        impact_flat = (np.concatenate(weight_parts) if weight_parts
+                       else np.zeros(0, dtype=np.float32))
+        self.nnz = len(slot_flat)
+
+        self._install_tiles(terms, dfs, ptr, slot_flat, impact_flat)
+        self.version = version
+        return True
+
+    # ------------------------------------------------------------ search
+    def plan_queries(self, queries: Sequence[Tuple[Dict[str, float], float]]
+                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Resolve ({token: weight}, boost) per query: every tile of a
+        matched token carries boost = f32(weight * leg_boost), so the
+        kernel's impact*boost multiply IS the sparse dot product.
+        Token order is the query dict's iteration order — the host
+        oracle (`search/queries_ext.py`) folds its f32 sums in the same
+        order, which is what makes host/device scores byte-identical."""
+        per_q: List[List[Tuple[int, float]]] = []
+        for tokens, boost in queries:
+            tiles: List[Tuple[int, float]] = []
+            for t, w in tokens.items():
+                span = self.term_tiles.get(str(t))
+                if span is None:
+                    continue
+                b = np.float32(np.float32(w) * np.float32(boost))
+                first, nt = span
+                tiles.extend((first + j, b) for j in range(nt))
+            per_q.append(tiles)
+        m = _pow2(max(max((len(t) for t in per_q), default=1), 1))
+        tile_ids = np.full((len(per_q), m), -1, dtype=np.int32)
+        boosts = np.zeros((len(per_q), m), dtype=np.float32)
+        for qi, tiles in enumerate(per_q):
+            for j, (tid, b) in enumerate(tiles):
+                tile_ids[qi, j] = tid
+                boosts[qi, j] = b
+        return tile_ids, boosts, m
+
+
+class SparseShard(LexicalShard):
+    """Per-reader learned-sparse store: one SparseField per
+    `rank_features` field, lazily synced — the parent's locking, stats,
+    and search_batch timing apply unchanged."""
+
+
+SparseShard.FIELD_CLS = SparseField
+
+
+def _register_sparse():
+    """`sparse.*` dispatch names over the SAME scoring callables as the
+    bm25 grid — per-name stats/warmup/strict-grids, shared executables."""
+    from elasticsearch_tpu.ops import dispatch
+    dispatch.DISPATCH.register("sparse.topk", _bm25_topk,
+                               static_argnames=("k",),
+                               donate_argnums=(0, 1),
+                               grid_check=_grid_bm25)
+    dispatch.DISPATCH.register("sparse.mesh_topk", _bm25_topk_sharded,
+                               static_argnames=("k", "width", "mesh"),
+                               grid_check=_grid_bm25_mesh)
+
+
+_register_sparse()

@@ -436,7 +436,15 @@ class CostRouter:
     otherwise-host decision probes the device to keep the model live.
 
     Priors (before any measurement) deliberately favor the device: the
-    router exists to catch the measured-slow case, not to predict it."""
+    router exists to catch the measured-slow case, not to predict it.
+
+    `persist_path` makes the learned EWMAs durable: every observation
+    writes the snapshot (atomic tmp+rename, a few hundred bytes) and a
+    restart seeds the tables back from disk instead of re-probing cold —
+    the per-NODE router state, so one file serves every index's engine
+    (`<data>/_state/agg_router.json`, wired in `node._agg_cost_router`).
+    `restores` counts families seeded at boot (`_nodes/stats
+    indices.aggs router_restores`)."""
 
     EWMA = 0.25
     MARGIN = 1.25
@@ -446,11 +454,54 @@ class CostRouter:
     HOST_PRIOR_BASE = 30_000.0
     HOST_PRIOR_PER_DOC = 400.0      # ns per matched doc (python walker)
 
-    def __init__(self):
+    def __init__(self, persist_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._dev: Dict[str, float] = {}       # family -> ewma ns
         self._host: Dict[str, float] = {}      # family -> ewma ns/doc
         self._miss: Dict[str, int] = {}        # family -> host streak
+        self.persist_path = persist_path
+        self.restores = 0
+        if persist_path:
+            self._load(persist_path)
+
+    def _load(self, path: str) -> None:
+        """Seed the EWMA tables from a prior run's snapshot. Corrupt or
+        missing files mean cold priors, never a boot failure."""
+        import json as _json
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                state = _json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(state, dict):
+            return
+        restored = 0
+        with self._lock:
+            for table, key in ((self._dev, "device_ns"),
+                               (self._host, "host_ns_per_doc")):
+                ent = state.get(key)
+                if not isinstance(ent, dict):
+                    continue
+                for fam, v in ent.items():
+                    try:
+                        table[str(fam)] = float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    restored += 1
+        self.restores = restored
+
+    def _persist(self) -> None:
+        if not self.persist_path:
+            return
+        import json as _json
+        import os as _os
+        tmp = self.persist_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                _json.dump(self.snapshot(), f, sort_keys=True)
+            _os.replace(tmp, self.persist_path)
+        except OSError:  # pragma: no cover - disk-full/readonly boot
+            pass
 
     def est_device(self, fam: str, r_pad: int) -> float:
         with self._lock:
@@ -489,9 +540,11 @@ class CostRouter:
 
     def observe_device(self, fam: str, nanos: int) -> None:
         self._ewma(self._dev, fam, float(nanos))
+        self._persist()
 
     def observe_host(self, fam: str, nanos: int, n_docs: int) -> None:
         self._ewma(self._host, fam, float(nanos) / max(n_docs, 1))
+        self._persist()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -522,12 +575,16 @@ class AggEngine:
 
     def __init__(self, mapper_service, plan_cache_entries: int = 128,
                  warmup: Optional[bool] = None,
-                 cost_router: bool = False):
+                 cost_router=False):
         from elasticsearch_tpu.search.caches import LruCache
         self.mapper_service = mapper_service
         self.store = aggs_ops.AggFieldStore(warmup=warmup)
         self.plan_cache = LruCache(max_entries=plan_cache_entries)
-        self.cost_router = CostRouter() if cost_router else None
+        # bool (own fresh router) or a CostRouter INSTANCE — the node
+        # passes one shared, disk-backed router so every index's engine
+        # trains (and restores) the same per-node cost model
+        self.cost_router = (cost_router if isinstance(cost_router, CostRouter)
+                            else (CostRouter() if cost_router else None))
         self._lock = threading.Lock()
         self._cal_cache = LruCache(max_entries=64)
         self.stats = {

@@ -151,6 +151,90 @@ class GenericTemplate:
         return GenericLeg(qspec)
 
 
+class SparseTemplate:
+    """Compile-time half of a learned-sparse leg (`sparse_vector` /
+    `weighted_tokens` on a rank_features-style field): the query TOKEN
+    MAP is normalized out of the plan-cache key and bound per query;
+    field/boost are structural. Token COUNT is a bind-time concern: a
+    body wider than the device grid binds to a counted host-walker
+    fallback (the EmptyLeg precedent — same template, per-body leg)."""
+
+    __slots__ = ("field", "kind", "boost")
+
+    def __init__(self, field: str, kind: str, boost: float):
+        self.field = field
+        self.kind = kind          # "sparse_vector" | "weighted_tokens"
+        self.boost = boost
+
+    def bind(self, qspec: dict):
+        from elasticsearch_tpu.ops.sparse import MAX_QUERY_TOKENS
+        spec = qspec[self.kind]
+        if self.kind == "sparse_vector":
+            tokens = spec.get("query_vector") or {}
+        else:
+            tokens = (spec[self.field] or {}).get("tokens") or {}
+        if not tokens:
+            return EmptyLeg()
+        if len(tokens) > MAX_QUERY_TOKENS:
+            return SparseFallbackLeg(
+                qspec, f"query tokens {len(tokens)} exceed device grid "
+                f"cap {MAX_QUERY_TOKENS}")
+        return SparseLeg(self.field, tokens, self.boost)
+
+
+class MaxSimTemplate:
+    """Compile-time half of a late-interaction leg (`late_interaction`
+    on a `rank_vectors` field): query TOKEN VECTORS are normalized out
+    of the key (their dimensionality is structural, like knn's);
+    field/k/boost are structural. Over-grid token counts bind to a
+    counted host-walker fallback."""
+
+    __slots__ = ("field", "dims", "k", "boost")
+
+    def __init__(self, field: str, dims: int, k: int, boost: float):
+        self.field = field
+        self.dims = dims
+        self.k = k
+        self.boost = boost
+
+    def bind(self, qspec: dict):
+        from elasticsearch_tpu.vectors.late_interaction import (
+            MAX_QUERY_TOKENS)
+        spec = qspec["late_interaction"]
+        qt = np.asarray(spec["query_tokens"], dtype=np.float32)
+        if qt.ndim == 1:
+            qt = qt.reshape(1, -1)
+        if qt.ndim != 2 or qt.shape[1] != self.dims:
+            raise IllegalArgumentError(
+                f"[late_interaction] query tokens have "
+                f"{qt.shape[-1] if qt.ndim else 0} dims, field "
+                f"[{self.field}] expects {self.dims}")
+        if qt.shape[0] > MAX_QUERY_TOKENS:
+            return MaxSimFallbackLeg(
+                qspec, f"query tokens {qt.shape[0]} exceed device grid "
+                f"cap {MAX_QUERY_TOKENS}")
+        return MaxSimLeg(self.field, qt, self.k, self.boost)
+
+
+class SparseLeg:
+    __slots__ = ("field", "tokens", "boost")
+
+    def __init__(self, field: str, tokens: Dict[str, float], boost: float):
+        self.field = field
+        self.tokens = tokens
+        self.boost = boost
+
+
+class MaxSimLeg:
+    __slots__ = ("field", "query_tokens", "k", "boost")
+
+    def __init__(self, field: str, query_tokens, k: int, boost: float):
+        self.field = field
+        self.query_tokens = query_tokens
+        self.k = k
+        self.boost = boost
+
+
 class KnnLeg:
     __slots__ = ("field", "query_vector", "k", "num_candidates",
                  "filter_spec", "boost", "metric")
@@ -176,6 +260,29 @@ class GenericLeg:
 
     def __init__(self, query: dict):
         self.query = query
+
+
+class SparseFallbackLeg(GenericLeg):
+    """A sparse leg that fell off the device grid (query wider than the
+    tile-scan cap): runs the host walker via the query phase, with the
+    reason surfaced in leg profiles and counted in executor stats."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, query: dict, reason: str):
+        super().__init__(query)
+        self.reason = reason
+
+
+class MaxSimFallbackLeg(GenericLeg):
+    """A late-interaction leg that fell off the device grid: runs the
+    exact host MaxSim walker via the query phase, reason counted."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, query: dict, reason: str):
+        super().__init__(query)
+        self.reason = reason
 
 
 class HybridPlan:
@@ -208,6 +315,8 @@ class HybridPlan:
                                            mapper_service))
             elif isinstance(template, KnnTemplate):
                 bound.append(template.bind(q["knn"]))
+            elif isinstance(template, (SparseTemplate, MaxSimTemplate)):
+                bound.append(template.bind(q))
             else:
                 bound.append(GenericTemplate.bind(q))
         return bound
@@ -238,6 +347,23 @@ def plan_cache_key(body: dict) -> str:
                     "query_vector": {"__dims__": len(qv)
                                      if hasattr(qv, "__len__") else 0}}
             return {kind: spec}
+        if kind == "sparse_vector" and isinstance(spec, dict) \
+                and "query_vector" in spec:
+            # token MAPS scrub whole (count is NOT structural — the tile
+            # planner pads it, and over-cap bodies fall back at bind)
+            return {kind: {**spec, "query_vector": "__tokens__"}}
+        if kind == "weighted_tokens" and isinstance(spec, dict) \
+                and len(spec) == 1:
+            ((field, v),) = spec.items()
+            if isinstance(v, dict) and "tokens" in v:
+                return {kind: {field: {**v, "tokens": "__tokens__"}}}
+            return q
+        if kind == "late_interaction" and isinstance(spec, dict) \
+                and "query_tokens" in spec:
+            qt = spec["query_tokens"]
+            first = qt[0] if isinstance(qt, (list, tuple)) and qt else qt
+            dims = len(first) if hasattr(first, "__len__") else 0
+            return {kind: {**spec, "query_tokens": {"__dims__": dims}}}
         if kind in ("match", "term") and isinstance(spec, dict) \
                 and len(spec) == 1:
             ((field, v),) = spec.items()
@@ -313,6 +439,32 @@ def _compile_lexical(spec_kind: str, qspec: dict,
     return LexicalTemplate(field, "match", operator, msm, boost)
 
 
+def _compile_sparse(spec_kind: str, qspec,
+                    mapper_service) -> Optional[SparseTemplate]:
+    """Lower a sparse_vector/weighted_tokens sub-search to the learned-
+    sparse device engine when the field stores feature→weight maps
+    (`rank_features` or the legacy `sparse_vector` mapping). Purely
+    STRUCTURAL, like `_compile_lexical` — token values never reach the
+    plan-cache key."""
+    if not isinstance(qspec, dict):
+        return None
+    if spec_kind == "sparse_vector":
+        field = qspec.get("field")
+        boost = float(qspec.get("boost", 1.0))
+    else:
+        if len(qspec) != 1:
+            return None
+        ((field, v),) = qspec.items()
+        boost = float(v.get("boost", 1.0)) if isinstance(v, dict) else 1.0
+    if not field:
+        return None
+    mapper = mapper_service.get(field)
+    if getattr(mapper, "type_name", "") not in ("rank_features",
+                                                "sparse_vector"):
+        return None
+    return SparseTemplate(field, spec_kind, boost)
+
+
 def compile_plan(body: dict, mapper_service) -> HybridPlan:
     """Parse + classify ONE hybrid body into an executable plan."""
     rrf = (body.get("rank") or {}).get("rrf") or {}
@@ -350,6 +502,17 @@ def compile_plan(body: dict, mapper_service) -> HybridPlan:
                         _METRIC_MAP[mapper.similarity])
             elif kind in ("match", "term"):
                 leg = _compile_lexical(kind, spec, mapper_service)
+            elif kind in ("sparse_vector", "weighted_tokens"):
+                leg = _compile_sparse(kind, spec, mapper_service)
+            elif kind == "late_interaction" and isinstance(spec, dict):
+                from elasticsearch_tpu.index.mapping import (
+                    RankVectorsFieldMapper)
+                mapper = mapper_service.get(spec.get("field", ""))
+                if isinstance(mapper, RankVectorsFieldMapper):
+                    leg = MaxSimTemplate(
+                        spec["field"], mapper.dims,
+                        int(spec.get("k", 10)),
+                        float(spec.get("boost", 1.0)))
         if leg is None:
             leg = GenericTemplate()
         legs.append(leg)
@@ -405,6 +568,13 @@ class HybridExecutor:
         self.lexical = LexicalShard(
             dtype=str(svc.settings.get("index.lexical.impact_dtype",
                                        "f32")))
+        from elasticsearch_tpu.ops.sparse import SparseShard
+        from elasticsearch_tpu.vectors.late_interaction import (
+            LateInteractionShard)
+        self.sparse = SparseShard(
+            dtype=str(svc.settings.get("index.sparse.impact_dtype",
+                                       "f32")))
+        self.late = LateInteractionShard()
         self.plan_cache = LruCache(max_entries=plan_cache_entries)
         # pipelined continuous batching: the runner holds the scheduler
         # lock only for plan-bind + the un-synced leg dispatches
@@ -430,7 +600,9 @@ class HybridExecutor:
                       "hydrate_nanos": 0, "queue_wait_nanos": 0,
                       "dispatch_nanos": 0, "sync_nanos": 0,
                       "request_cache_hits": 0, "request_cache_misses": 0,
-                      "request_cache_stores": 0}
+                      "request_cache_stores": 0,
+                      "sparse_grid_fallbacks": 0,
+                      "maxsim_grid_fallbacks": 0}
         # finalize stages of different batches run CONCURRENTLY when
         # async_depth > 1; their stats writes must not lose updates
         # (dispatch-stage writes serialize under the batcher lock)
@@ -531,29 +703,28 @@ class HybridExecutor:
         from elasticsearch_tpu.ops.bm25 import _pow2
         reader = self.svc.combined_reader()
         entries = []
-        for field, mapper in self.svc.mapper_service.all_mappers():
-            if not isinstance(mapper, TextFieldMapper):
-                continue
-            lf = self.lexical.field(reader, field)
-            if lf.n_slots == 0:
-                continue
+
+        def scatter_entries(lf, kernel: str):
+            """Shape-only warmup entries for one impact layout (bm25 or
+            learned-sparse — same scoring program, own dispatch name).
+
+            The kernel's term-tile dimension pads pow-2 to the batch's
+            max TOTAL tile count (`plan_queries` sums a query's terms),
+            and a zipf-popular term alone can span dozens of impact
+            tiles — warm the m ladder up to a few-wide-term query over
+            this field's layout (4 × widest term), not a fixed {1,2,4}.
+            The r06-shape closed-loop bench showed exactly this gap: a
+            timed-loop batch hit m=16 and paid a 750 ms XLA compile
+            mid-flight. Still a floor, not a ceiling — a many-term
+            query over several wide terms can exceed the cap and
+            compile once; the persistent cache absorbs it across
+            restarts."""
             width = _pow2(max(lf.n_slots, 1)) + 1
             imp_dtype = {"f32": _jnp.float32, "bf16": _jnp.bfloat16,
                          "int8": _jnp.int8}[lf.dtype]
             n_tiles = max(int(lf.tile_slots.shape[0]), 1)
             scales = (jax.ShapeDtypeStruct((n_tiles,), _jnp.float32)
                       if lf.dtype == "int8" else None)
-            # the kernel's term-tile dimension pads pow-2 to the batch's
-            # max TOTAL tile count (`plan_queries` sums a query's terms),
-            # and a zipf-popular term alone can span dozens of impact
-            # tiles — warm the m ladder up to a few-wide-term query over
-            # this field's layout (4 × widest term), not a fixed {1,2,4}.
-            # The r06-shape closed-loop bench showed exactly this gap: a
-            # timed-loop batch hit m=16 and paid a 750 ms XLA compile
-            # mid-flight. Still a floor, not a ceiling — a many-term
-            # query over several wide terms can exceed the cap and
-            # compile once; the persistent cache absorbs it across
-            # restarts.
             max_nt = max((nt for _first, nt in lf.term_tiles.values()),
                          default=1)
             m_cap = _pow2(min(max(4 * max_nt, 4), 256))
@@ -562,7 +733,7 @@ class HybridExecutor:
             for q in (1, 8, 16):
                 for m in m_rungs:
                     entries.append((
-                        "bm25.topk",
+                        kernel,
                         (jax.ShapeDtypeStruct((q, width), _jnp.float32),
                          jax.ShapeDtypeStruct((q, width), _jnp.int32),
                          jax.ShapeDtypeStruct((q, m), _jnp.int32),
@@ -574,6 +745,19 @@ class HybridExecutor:
                         {"k": _dispatch.bucket_k(
                             min(DEFAULT_WINDOW, lf.n_slots),
                             limit=width - 1)}))
+
+        for field, mapper in self.svc.mapper_service.all_mappers():
+            type_name = getattr(mapper, "type_name", "")
+            if isinstance(mapper, TextFieldMapper):
+                lf = self.lexical.field(reader, field)
+                if lf.n_slots:
+                    scatter_entries(lf, "bm25.topk")
+            elif type_name in ("rank_features", "sparse_vector"):
+                sf = self.sparse.field(reader, field)
+                if sf.n_slots:
+                    scatter_entries(sf, "sparse.topk")
+            elif type_name == "rank_vectors":
+                entries.extend(self.late.warmup_entries(reader, mapper))
         if entries:
             _dispatch.DISPATCH.warmup(entries, background=False)
 
@@ -837,6 +1021,9 @@ class HybridExecutor:
         leg_info: Dict[Tuple[int, int], dict] = {}
 
         lex_groups: Dict[str, List[Tuple[int, int, LexicalLeg]]] = {}
+        sparse_groups: Dict[str, List[Tuple[int, int, SparseLeg]]] = {}
+        maxsim_groups: Dict[Tuple[str, int],
+                            List[Tuple[int, int, MaxSimLeg]]] = {}
         knn_groups: Dict[Tuple[str, int, Optional[int]],
                          List[Tuple[int, int, KnnLeg]]] = {}
         for bi, legs in enumerate(bound):
@@ -847,6 +1034,12 @@ class HybridExecutor:
                 elif isinstance(leg, LexicalLeg):
                     lex_groups.setdefault(leg.field, []).append(
                         (bi, li, leg))
+                elif isinstance(leg, SparseLeg):
+                    sparse_groups.setdefault(leg.field, []).append(
+                        (bi, li, leg))
+                elif isinstance(leg, MaxSimLeg):
+                    maxsim_groups.setdefault((leg.field, leg.k),
+                                             []).append((bi, li, leg))
                 elif isinstance(leg, KnnLeg):
                     knn_groups.setdefault(
                         (leg.field, leg.k, leg.num_candidates),
@@ -863,7 +1056,17 @@ class HybridExecutor:
                         index_name=self.svc.name)
                     leg_results[(bi, li)] = np.asarray(result.rows,
                                                        dtype=np.int64)
-                    leg_info[(bi, li)] = {"type": "query_phase"}
+                    if isinstance(leg, (SparseFallbackLeg,
+                                        MaxSimFallbackLeg)):
+                        key = ("sparse_grid_fallbacks"
+                               if isinstance(leg, SparseFallbackLeg)
+                               else "maxsim_grid_fallbacks")
+                        self.stats[key] += 1
+                        leg_info[(bi, li)] = {
+                            "type": "query_phase_fallback",
+                            "reason": leg.reason}
+                    else:
+                        leg_info[(bi, li)] = {"type": "query_phase"}
 
         for field, entries in lex_groups.items():
             window = max(plans[bi].window for bi, _li, _leg in entries)
@@ -878,6 +1081,37 @@ class HybridExecutor:
                     "type": "lexical_device", "field": field,
                     "terms": len(leg.terms), "corpus_slots": lf.n_slots,
                     "impact_tiles": int(lf.tile_slots.shape[0])}
+
+        for field, entries in sparse_groups.items():
+            window = max(plans[bi].window for bi, _li, _leg in entries)
+            queries = [(leg.tokens, leg.boost) for _bi, _li, leg in entries]
+            results = self.sparse.search_batch(reader, field, queries,
+                                               window)
+            sf = self.sparse.field(reader, field)
+            for (bi, li, leg), (rows, _scores) in zip(entries, results):
+                leg_results[(bi, li)] = rows[:plans[bi].window]
+                leg_info[(bi, li)] = {
+                    "type": "sparse_device", "field": field,
+                    "tokens": len(leg.tokens), "corpus_slots": sf.n_slots,
+                    "impact_tiles": int(sf.tile_slots.shape[0])}
+
+        # MaxSim legs complete synchronously in the dispatch stage: the
+        # fused rescore's inputs depend on its own coarse phase's ids,
+        # so there is no un-synced board to land later
+        for (field, k), entries in maxsim_groups.items():
+            mapper = self.svc.mapper_service.get(field)
+            queries = [(leg.query_tokens, leg.boost)
+                       for _bi, _li, leg in entries]
+            results = self.late.search_batch(reader, mapper, queries, k)
+            lf = self.late.field(reader, mapper)
+            for (bi, li, leg), (rows, _scores) in zip(entries, results):
+                leg_results[(bi, li)] = rows[:plans[bi].window]
+                leg_info[(bi, li)] = {
+                    "type": "maxsim_device", "field": field, "k": k,
+                    "encoding": lf.encoding,
+                    "coarse_window": (lf.coarse_window(k)
+                                      if lf.n_docs else 0),
+                    "docs": lf.n_docs}
 
         pending = []
         for (field, k, num_candidates), entries in knn_groups.items():

@@ -339,6 +339,135 @@ class RankFeatureQuery(Query):
 
 
 # ---------------------------------------------------------------------------
+# learned-sparse / late-interaction (host reference walkers)
+# ---------------------------------------------------------------------------
+
+class WeightedTokensQuery(Query):
+    """`sparse_vector` / `weighted_tokens` (reference: x-pack ml
+    WeightedTokensQueryBuilder): score = sum over overlapping tokens of
+    stored_weight * query_weight * boost — the learned-sparse dot
+    product over `rank_features` doc values.
+
+    This walker is the byte-parity ORACLE for the device leg
+    (`ops/sparse.py`): accumulation is f32, FEATURE-major in the query
+    dict's iteration order — exactly the device kernel's term-major
+    scan order, where each (feature, doc) posting lands in one tile —
+    so per-doc f32 sums fold in the same order and the scores (and
+    their ties, broken by ascending row downstream) are bit-identical
+    to the `sparse.topk` board."""
+
+    def __init__(self, field: str, tokens: Dict[str, float],
+                 boost: float = 1.0):
+        self.field = field
+        self.tokens = {str(k): float(v) for k, v in tokens.items()}
+        self.boost = float(boost)
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        rows: List[int] = []
+        vals: List[Any] = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            col = seg.doc_values.get(self.field)
+            for loc in np.nonzero(view.live)[0]:
+                v = col.values[int(loc)] if col is not None else None
+                if isinstance(v, dict):
+                    rows.append(seg.base + int(loc))
+                    vals.append(v)
+        if not rows:
+            return DocSet(np.zeros(0, dtype=np.int64),
+                          np.zeros(0, dtype=np.float32))
+        wanted = set(self.tokens)
+        postings: Dict[str, Tuple[List[int], List[float]]] = {}
+        for i, v in enumerate(vals):
+            for feat, w in v.items():
+                if feat in wanted:
+                    lists = postings.get(feat)
+                    if lists is None:
+                        lists = postings[feat] = ([], [])
+                    lists[0].append(i)
+                    lists[1].append(w)
+        scores = np.zeros(len(rows), dtype=np.float32)
+        counts = np.zeros(len(rows), dtype=np.int64)
+        for t, w in self.tokens.items():        # query dict order
+            lists = postings.get(t)
+            if lists is None:
+                continue
+            b = np.float32(np.float32(w) * np.float32(self.boost))
+            idx = np.asarray(lists[0], dtype=np.int64)
+            scores[idx] += np.asarray(lists[1], dtype=np.float32) * b
+            counts[idx] += 1
+        keep = counts > 0
+        return DocSet(np.asarray(rows, dtype=np.int64)[keep], scores[keep])
+
+    def to_dict(self):
+        return {"sparse_vector": {"field": self.field,
+                                  "query_vector": dict(self.tokens)}}
+
+
+class LateInteractionQuery(Query):
+    """`late_interaction`: exact MaxSim over `rank_vectors` doc values —
+    score = sum over query tokens of max over doc tokens of their dot
+    product (cosine similarity normalizes both sides per token, per the
+    field mapping).
+
+    This walker IS the exact oracle the fused device leg
+    (`ops/pallas_maxsim.py`) is recall-gated against: it reads the raw
+    f32 stored token vectors (no quantization) and prunes nothing (no
+    coarse centroid phase), in f32 numpy."""
+
+    def __init__(self, field: str, query_tokens, boost: float = 1.0):
+        self.field = field
+        q = np.asarray(query_tokens, dtype=np.float32)
+        if q.ndim == 1:
+            q = q.reshape(1, -1)
+        if q.ndim != 2 or not q.size:
+            raise ParsingError(
+                "[late_interaction] query_tokens must be a non-empty "
+                "array of vectors")
+        self.query_tokens = q
+        self.boost = float(boost)
+
+    def execute(self, ctx: SearchContext) -> DocSet:
+        mapper = ctx.mapper_service.get(self.field)
+        cosine = getattr(mapper, "similarity", "cosine") == "cosine"
+        q = self.query_tokens
+        if cosine:
+            q = q / np.maximum(
+                np.linalg.norm(q, axis=-1, keepdims=True), 1e-30)
+        rows: List[int] = []
+        scores: List[float] = []
+        for view in ctx.reader.views:
+            seg = view.segment
+            col = seg.doc_values.get(self.field)
+            if col is None:
+                continue
+            for loc in np.nonzero(view.live)[0]:
+                v = col.values[int(loc)]
+                if v is None:
+                    continue
+                # multi-valued doc values land as a list of per-token
+                # rows; coerce exactly like the columnar extractor does
+                t = np.asarray(v, dtype=np.float32).reshape(
+                    -1, int(getattr(mapper, "dims", 0)) or
+                    np.shape(v)[-1])
+                if not t.size:
+                    continue
+                if cosine:
+                    t = t / np.maximum(
+                        np.linalg.norm(t, axis=-1, keepdims=True), 1e-30)
+                dots = q @ t.T                           # [Tq, Td] f32
+                rows.append(seg.base + int(loc))
+                scores.append(float(dots.max(axis=1).sum()) * self.boost)
+        return DocSet(np.asarray(rows, dtype=np.int64),
+                      np.asarray(scores, dtype=np.float32))
+
+    def to_dict(self):
+        return {"late_interaction": {
+            "field": self.field,
+            "query_tokens": self.query_tokens.tolist()}}
+
+
+# ---------------------------------------------------------------------------
 # more_like_this
 # ---------------------------------------------------------------------------
 
@@ -1252,6 +1381,17 @@ def parse_extended(kind: str, spec: Any) -> Optional[Query]:
                                 sigmoid=spec.get("sigmoid"),
                                 linear=spec.get("linear"),
                                 boost=float(spec.get("boost", 1.0)))
+    if kind == "sparse_vector":
+        return WeightedTokensQuery(spec["field"],
+                                   dict(spec.get("query_vector") or {}),
+                                   float(spec.get("boost", 1.0)))
+    if kind == "weighted_tokens":
+        field, v = _single(spec)
+        return WeightedTokensQuery(field, dict(v.get("tokens") or {}),
+                                   float(v.get("boost", 1.0)))
+    if kind == "late_interaction":
+        return LateInteractionQuery(spec["field"], spec["query_tokens"],
+                                    float(spec.get("boost", 1.0)))
     if kind == "more_like_this":
         like = spec.get("like", [])
         if not isinstance(like, list):

@@ -367,6 +367,52 @@ def test_cost_router_measurements_flip_decision():
     assert "hist" in snap["device_ns"] and "hist" in snap["host_ns_per_doc"]
 
 
+def test_cost_router_persists_and_restores_ewmas(tmp_path):
+    """PR 19 leftover: learned EWMAs are durable. Every observation
+    snapshots to disk; a fresh router on the same path boots with the
+    tables (counted in `restores`) instead of cold priors."""
+    path = str(tmp_path / "agg_router.json")
+    r = CostRouter(persist_path=path)
+    assert r.restores == 0                        # nothing to seed yet
+    for _ in range(8):
+        r.observe_device("terms", 50_000)
+        r.observe_host("terms", 500_000, 100)
+    snap = r.snapshot()
+
+    r2 = CostRouter(persist_path=path)            # "restart"
+    assert r2.restores == 2                       # one family, two tables
+    assert r2.snapshot() == snap
+    # the measured flip survives the restart: 100 docs would route host
+    # on priors, but the restored model knows the device is faster here
+    assert r2.decide("terms", 100, 1024) == "device"
+
+
+def test_cost_router_restart_round_trip_through_node(tmp_path):
+    """Node-level: train the shared router, restart the node on the
+    same data path, and find the seeded families in
+    `_nodes/stats indices.aggs router_restores`."""
+    from elasticsearch_tpu.node import Node
+
+    data = str(tmp_path / "data")
+    n = Node(data)
+    router = n._agg_cost_router()
+    for _ in range(4):
+        router.observe_device("terms", 50_000)
+        router.observe_host("terms", 500_000, 100)
+    snap = router.snapshot()
+    assert n.local_node_stats()["indices"]["aggs"]["router_restores"] == 0
+    n.close()
+
+    n2 = Node(data)
+    try:
+        r2 = n2._agg_cost_router()
+        assert r2.snapshot() == snap
+        stats = n2.local_node_stats()["indices"]["aggs"]
+        assert stats["router_restores"] == 2
+    finally:
+        n2.close()
+
+
 def test_cost_router_engine_counts_and_parity(ctx):
     engine = AggEngine(ctx.mapper_service, cost_router=True)
     rows = _rows(ctx)

@@ -1,0 +1,299 @@
+"""Learned-sparse retrieval on the BM25 impact substrate (ops/sparse.py).
+
+`rank_features` postings land in the SAME tile-padded CSR layout the
+lexical engine scores — stored weights ARE the impacts, the query's
+term weights ride the per-tile boost lane — so the parity contract is
+inherited verbatim: device `sparse.topk` output must be BYTE-IDENTICAL
+(rows and f32 scores) to the pure-host `weighted_tokens` walker in
+search/queries_ext.py, across append/delete lifecycles, and the fused
+rrf leg must be json-identical to the two-phase oracle.  The grid is
+closed: a query body over MAX_QUERY_TOKENS falls back to the walker as
+a counted fallback LEG, never an unseen device shape.
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import native
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.ops import dispatch
+from elasticsearch_tpu.ops.bm25 import TILE
+from elasticsearch_tpu.ops.sparse import (MAX_QUERY_TOKENS, SparseField,
+                                          SparseShard)
+from elasticsearch_tpu.search.queries import SearchContext, parse_query
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    ms = MapperService({"properties": {
+        "feats": {"type": "rank_features"},
+        "body": {"type": "text"}}})
+    eng = Engine(tempfile.mkdtemp(), ms)
+    rng = np.random.default_rng(42)
+    vocab = [f"tok{i}" for i in range(60)]
+    for i in range(400):
+        feats = {t: float(rng.uniform(0.05, 8.0))
+                 for t in rng.choice(vocab, size=rng.integers(2, 9),
+                                     replace=False)}
+        eng.index(str(i), {"feats": feats, "body": f"doc {i}"})
+    eng.refresh()
+    return ms, eng, rng
+
+
+def _reference(reader, ms, tokens, boost=1.0, window=100):
+    """The host walker the device kernel must reproduce bit-for-bit."""
+    ctx = SearchContext(reader, ms)
+    q = parse_query({"sparse_vector": {"field": "feats",
+                                       "query_vector": dict(tokens),
+                                       "boost": boost}})
+    ds = q.execute(ctx)
+    idx = native.topk(ds.scores, min(window, len(ds.rows)))
+    return ds.rows[idx], ds.scores[idx]
+
+
+class TestParity:
+    @pytest.mark.parametrize("route", ["host", "device"])
+    def test_byte_identical_to_walker(self, corpus, route):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        sp = SparseShard()
+        for toks in ({"tok1": 2.0, "tok2": 0.5},
+                     {"tok5": 1.0},
+                     {"tok10": 3.0, "tok11": 1.0, "tok12": 0.25,
+                      "tok13": 4.0}):
+            ref_rows, ref_scores = _reference(reader, ms, toks)
+            (rows, scores), = sp.search_batch(
+                reader, "feats", [(toks, 1.0)], 100, route=route)
+            assert np.array_equal(rows, ref_rows)
+            # byte-identical, not approx: same f32 weights, same tile
+            # fold order as the walker's feature-major accumulation
+            assert scores.tobytes() == ref_scores.tobytes()
+
+    @pytest.mark.parametrize("route", ["host", "device"])
+    def test_boost_folds_into_query_weights(self, corpus, route):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        sp = SparseShard()
+        toks = {"tok3": 1.5, "tok7": 0.75}
+        ref_rows, ref_scores = _reference(reader, ms, toks, boost=2.5)
+        (rows, scores), = sp.search_batch(
+            reader, "feats", [(toks, 2.5)], 100, route=route)
+        assert np.array_equal(rows, ref_rows)
+        assert scores.tobytes() == ref_scores.tobytes()
+
+    def test_batch_matches_single_dispatch(self, corpus):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        sp = SparseShard()
+        queries = [({"tok1": 1.0, "tok2": 2.0}, 1.0),
+                   ({"tok9": 0.5}, 1.0),
+                   ({"tok3": 1.0, "tok4": 1.0, "tok5": 1.0}, 2.0)]
+        batched = sp.search_batch(reader, "feats", queries, 50,
+                                  route="device")
+        for q, (rows, scores) in zip(queries, batched):
+            (r1, s1), = sp.search_batch(reader, "feats", [q], 50,
+                                        route="device")
+            assert np.array_equal(rows, r1)
+            assert scores.tobytes() == s1.tobytes()
+
+    def test_oov_feature_matches_nothing(self, corpus):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        sp = SparseShard()
+        (rows, _), = sp.search_batch(
+            reader, "feats", [({"zzz_never_indexed": 5.0}, 1.0)], 100,
+            route="host")
+        assert len(rows) == 0
+
+
+class TestLifecycle:
+    def test_append_delete_rebuild_parity(self):
+        ms = MapperService({"properties": {
+            "feats": {"type": "rank_features"}}})
+        eng = Engine(tempfile.mkdtemp(), ms)
+        for i in range(50):
+            eng.index(str(i), {"feats": {"alpha": 1.0 + i % 7,
+                                         f"tok{i % 5}": 2.0}})
+        eng.refresh()
+        sp = SparseShard()
+        reader = eng.acquire_searcher()
+        sp.search_batch(reader, "feats", [({"alpha": 1.0}, 1.0)], 100)
+        assert sp.stats["rebuilds"] == 1
+        sp.search_batch(reader, "feats", [({"alpha": 1.0}, 1.0)], 100)
+        assert sp.stats["rebuilds"] == 1  # same reader: no rebuild
+
+        for i in range(50, 80):
+            eng.index(str(i), {"feats": {"alpha": 0.5, "beta": 3.0}})
+        eng.refresh()
+        reader2 = eng.acquire_searcher()
+        ref_rows, ref_scores = _reference(reader2, ms, {"alpha": 1.0})
+        (rows, scores), = sp.search_batch(reader2, "feats",
+                                          [({"alpha": 1.0}, 1.0)], 100)
+        assert sp.stats["rebuilds"] == 2
+        assert np.array_equal(rows, ref_rows)
+        assert scores.tobytes() == ref_scores.tobytes()
+
+        eng.delete("3")
+        eng.refresh()
+        reader3 = eng.acquire_searcher()
+        ref_rows, ref_scores = _reference(reader3, ms, {"alpha": 1.0})
+        (rows, scores), = sp.search_batch(reader3, "feats",
+                                          [({"alpha": 1.0}, 1.0)], 100)
+        assert np.array_equal(rows, ref_rows)
+        assert scores.tobytes() == ref_scores.tobytes()
+        assert not any(reader3.get_id(int(r)) == "3" for r in rows)
+
+    def test_docs_without_field_never_match(self, corpus):
+        ms, eng, _ = corpus
+        eng2 = Engine(tempfile.mkdtemp(), MapperService({"properties": {
+            "feats": {"type": "rank_features"}}}))
+        eng2.index("a", {"feats": {"x": 1.0}})
+        eng2.index("b", {})                       # no field
+        eng2.index("c", {"feats": {"y": 2.0}})
+        eng2.refresh()
+        reader = eng2.acquire_searcher()
+        sp = SparseShard()
+        (rows, _), = sp.search_batch(reader, "feats",
+                                     [({"x": 1.0, "y": 1.0}, 1.0)], 10)
+        assert {reader.get_id(int(r)) for r in rows} == {"a", "c"}
+
+
+class TestLayout:
+    def test_tiles_are_lane_padded_and_weights_are_impacts(self, corpus):
+        ms, eng, _ = corpus
+        reader = eng.acquire_searcher()
+        sf = SparseField("feats")
+        sf.sync(reader)
+        assert sf.tile_slots.shape[1] == TILE
+        pad = sf.tile_slots < 0
+        assert np.all(sf.tile_impacts[pad] == 0.0)
+        real = sf.tile_slots[~pad]
+        assert real.min() >= 0 and real.max() < sf.n_slots
+        assert np.all(np.diff(sf.row_map) > 0)
+        # impacts are the STORED weights, not a BM25 recompute: spot a
+        # doc's weight back through the tile layout
+        first, nt = sf.term_tiles["tok1"]
+        tile_w = sf.tile_impacts[first:first + nt]
+        assert tile_w.max() <= 8.0 + 1e-6 and tile_w[~(
+            sf.tile_slots[first:first + nt] < 0)].min() > 0.0
+
+
+class TestNodePath:
+    @pytest.fixture()
+    def node(self):
+        from elasticsearch_tpu.node import Node
+        rng = np.random.default_rng(5)
+        n = Node(tempfile.mkdtemp())
+        n.create_index_with_templates("s", mappings={"properties": {
+            "feats": {"type": "rank_features"},
+            "body": {"type": "text"}}})
+        vocab = [f"tok{j}" for j in range(30)]
+        ops = []
+        for i in range(150):
+            ops.append({"index": {"_index": "s", "_id": str(i)}})
+            ops.append({"feats": {t: float(rng.uniform(0.1, 4.0))
+                                  for t in rng.choice(vocab, 5,
+                                                      replace=False)},
+                        "body": " ".join(rng.choice(list("abcd"), 4))})
+        n.bulk(ops)
+        n.indices.get("s").refresh()
+        yield n
+        n.close()
+
+    def _compare(self, n, body):
+        fused = n.search("s", dict(body))
+        oracle = n.search("s", {**body, "__rrf_two_phase__": True})
+        fused.pop("took")
+        oracle.pop("took")
+        assert json.dumps(fused, sort_keys=True) \
+            == json.dumps(oracle, sort_keys=True)
+        return fused
+
+    def test_fused_rrf_leg_json_identical_to_oracle(self, node):
+        toks = {"tok1": 2.0, "tok5": 1.0, "tok9": 0.5}
+        self._compare(node, {"rank": {"rrf": {}}, "sub_searches": [
+            {"query": {"sparse_vector": {"field": "feats",
+                                         "query_vector": toks}}},
+            {"query": {"match": {"body": "a b"}}}], "size": 10})
+        # weighted_tokens body form binds to the same leg
+        self._compare(node, {"rank": {"rrf": {}}, "sub_searches": [
+            {"query": {"weighted_tokens": {"feats": {"tokens": toks}}}},
+            {"query": {"match": {"body": "c"}}}], "size": 10})
+
+    def test_over_grid_body_falls_back_and_is_counted(self, node):
+        big = {f"t{j}": 1.0 for j in range(MAX_QUERY_TOKENS + 10)}
+        body = {"rank": {"rrf": {}}, "sub_searches": [
+            {"query": {"sparse_vector": {"field": "feats",
+                                         "query_vector": big}}},
+            {"query": {"match": {"body": "a"}}}], "size": 5}
+        self._compare(node, body)
+        ex = node._hybrid[node.indices.get("s").name]
+        # fused + oracle runs bind the template twice -> 2 fallback legs
+        assert ex.stats["sparse_grid_fallbacks"] >= 1
+
+    def test_sparse_stats_surface_in_nodes_stats(self, node):
+        toks = {"tok1": 1.0}
+        node.search("s", {"rank": {"rrf": {}}, "sub_searches": [
+            {"query": {"sparse_vector": {"field": "feats",
+                                         "query_vector": toks}}},
+            {"query": {"match": {"body": "a"}}}], "size": 5})
+        hyb = node.local_node_stats()["indices"]["hybrid"]
+        assert hyb["sparse"]["searches"] >= 1
+        assert hyb["sparse"]["queries"] >= 1
+
+
+def test_strict_zero_recompile_second_pass(corpus):
+    ms, eng, _ = corpus
+    reader = eng.acquire_searcher()
+    sp = SparseShard()
+    queries = [({"tok1": 1.0, "tok2": 2.0}, 1.0), ({"tok8": 1.0}, 1.0)]
+    sp.search_batch(reader, "feats", queries, 100, route="device")  # warm
+    before = dispatch.DISPATCH.compile_count()
+    strict_before = dispatch.DISPATCH.strict
+    dispatch.DISPATCH.strict = True
+    try:
+        got = sp.search_batch(reader, "feats", queries, 100,
+                              route="device")
+    finally:
+        dispatch.DISPATCH.strict = strict_before
+    assert got is not None
+    assert dispatch.DISPATCH.compile_count() == before
+
+
+@pytest.mark.multidevice
+class TestMeshParity:
+    def test_ragged_shard_mesh_parity(self, mesh_serving):
+        """sparse.mesh_topk through the serving mesh: byte-identical to
+        the single-device board on a corpus whose slot count does not
+        divide the mesh (ragged last shard)."""
+        ms = MapperService({"properties": {
+            "feats": {"type": "rank_features"}}})
+        eng = Engine(tempfile.mkdtemp(), ms)
+        rng = np.random.default_rng(13)
+        vocab = [f"tok{i}" for i in range(40)]
+        for i in range(301):                       # odd: ragged shards
+            feats = {t: float(rng.uniform(0.1, 5.0))
+                     for t in rng.choice(vocab, size=rng.integers(2, 8),
+                                         replace=False)}
+            eng.index(str(i), {"feats": feats})
+        eng.refresh()
+        reader = eng.acquire_searcher()
+        sp = SparseShard()
+        queries = [({"tok1": 1.0, "tok2": 2.0}, 1.0),
+                   ({"tok5": 0.5}, 2.0),
+                   ({"tok7": 1.0, "tok8": 1.0, "tok9": 3.0}, 1.0)]
+        mesh_res = sp.search_batch(reader, "feats", queries, 10,
+                                   route="device")
+        assert mesh_serving.stats()["router"]["mesh"] >= 1, \
+            "sparse dispatch did not route to the mesh"
+        mesh_serving.configure(enabled=False)
+        one_res = sp.search_batch(reader, "feats", queries, 10,
+                                  route="device")
+        for (m_rows, m_scores), (o_rows, o_scores) in zip(mesh_res,
+                                                          one_res):
+            assert np.array_equal(m_rows, o_rows)
+            assert m_scores.tobytes() == o_scores.tobytes()

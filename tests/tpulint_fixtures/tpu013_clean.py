@@ -30,3 +30,14 @@ def rounded_ratio(a, b):
 def shifted_masks(ids, bits):
     # shifts of non-sign data are bit bookkeeping, not sign packing
     return (ids & ~((1 << bits) - 1)) | (ids << 2)
+
+
+def encode_uid_nibbles(doc_id):
+    # scalar nibble pairs from plain ints (the Uid _id encoding) carry
+    # no array evidence — not token-block packing
+    out = bytearray([0xFE])
+    for i in range(0, len(doc_id), 2):
+        b1 = ord(doc_id[i]) - ord("0")
+        b2 = ord(doc_id[i + 1]) - ord("0") if i + 1 < len(doc_id) else 0x0F
+        out.append((b1 << 4) | b2)
+    return bytes(out)

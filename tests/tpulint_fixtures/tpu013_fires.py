@@ -24,3 +24,17 @@ def pack_signs_shift(rows):
 
 def pack_signs_packbits(rows):
     return np.packbits(rows >= 0, axis=-1)  # [expect]
+
+
+def pack_token_block_int4(tokens, scales):
+    """A private int4 token-block packer (the token-packing drift
+    class quant/tokens.py exists to prevent)."""
+    q = (np.clip(np.round(tokens / scales[:, None]), -8, 7)  # [expect]
+         .astype(np.int32) + 8)
+    return (q[:, 0::2].astype(np.uint8)  # [expect]
+            | (q[:, 1::2].astype(np.uint8) << 4))
+
+
+def pack_planes_sliced(q):
+    # plane-slice evidence alone (no astype) also marks nibble packing
+    return q[:, 0::2] | (q[:, 1::2] << 4)  # [expect]

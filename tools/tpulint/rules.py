@@ -1348,10 +1348,17 @@ class HandRolledQuantRule(Rule):
       calls are spelled (np/jnp/method form);
     * sign-bit packing — `packbits(...)`, or a left-shift whose left
       operand derives from a sign comparison against zero
-      (`(x >= 0) << j`): the binary-encoding idiom.
+      (`(x >= 0) << j`): the binary-encoding idiom;
+    * nibble-plane packing — a bitwise-or of a `<< 4` where the
+      expression carries array evidence (an `.astype(...)` cast or a
+      step-2 plane slice like `q[:, 0::2]`): the int4 token-block
+      idiom `lo | (hi << 4)` that `quant/tokens.py` owns for
+      `rank_vectors` fields. Scalar nibble pairs built from plain ints
+      (the Uid `_id` encoding) carry neither signal and stay clean.
 
-    Route through `quant.codec.get(name).encode_np/encode_jnp` (or the
-    codec helpers for in-kernel unpack) instead.
+    Route through `quant.codec.get(name).encode_np/encode_jnp` or
+    `quant.tokens.encode_tokens` (or the codec helpers for in-kernel
+    unpack) instead.
     """
 
     rule_id = "TPU013"
@@ -1389,6 +1396,16 @@ class HandRolledQuantRule(Rule):
                     "elasticsearch_tpu/quant/ — the binary codec owns "
                     "the bit layout (quant.codec.get('binary') / "
                     "pack_sign_bits_jnp)"))
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.BitOr) \
+                    and self._is_nibble_pack(node):
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "nibble-plane packing (lo | (hi << 4) on array "
+                    "data) outside elasticsearch_tpu/quant/ — "
+                    "quant.tokens.encode_tokens owns the int4 "
+                    "token-block layout; a drifted plane order breaks "
+                    "the fused MaxSim kernel's even/odd dim convention"))
         return findings
 
     @staticmethod
@@ -1400,6 +1417,33 @@ class HandRolledQuantRule(Rule):
                             and isinstance(inner.op, ast.Div)
                             for arg in sub.args
                             for inner in ast.walk(arg)):
+                return True
+        return False
+
+    @staticmethod
+    def _is_nibble_pack(node: ast.BinOp) -> bool:
+        """`x | (y << 4)` (either order) with array evidence somewhere
+        in the expression: an `.astype(...)` call, or an extended slice
+        whose step is the literal 2 (the `q[:, 0::2]` plane split).
+        Plain-int nibble pairs (`(b1 << 4) | b2` in the Uid encoder)
+        carry neither signal."""
+        shift = None
+        for side in (node.left, node.right):
+            if isinstance(side, ast.BinOp) \
+                    and isinstance(side.op, ast.LShift) \
+                    and isinstance(side.right, ast.Constant) \
+                    and side.right.value == 4:
+                shift = side
+        if shift is None:
+            return False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "astype":
+                return True
+            if isinstance(sub, ast.Slice) and sub.step is not None \
+                    and isinstance(sub.step, ast.Constant) \
+                    and sub.step.value == 2:
                 return True
         return False
 
